@@ -1,0 +1,135 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle's capabilities.
+
+Built from scratch on JAX/XLA/PJRT idioms (see SURVEY.md for the reference map):
+- eager tensors are jax.Arrays in HBM; every op is a cached XLA computation
+- autograd is a Python tape over jax.vjp pullbacks (fluid/eager analog)
+- graph capture (`jit.to_static`) compiles whole training steps with jax.jit
+- parallelism is mesh/GSPMD-first: shard_tensor/reshard + fleet hybrid-parallel wrappers
+"""
+from __future__ import annotations
+
+import os as _os
+
+import jax as _jax
+
+# float64/int64 support (paddle has first-class fp64); default creation dtype stays fp32.
+_jax.config.update("jax_enable_x64", True)
+
+# Explicit platform override (e.g. PADDLE_TPU_PLATFORM=cpu for CPU-only test runs in
+# environments whose sitecustomize force-registers an accelerator plugin).
+if _os.environ.get("PADDLE_TPU_PLATFORM"):
+    _jax.config.update("jax_platforms", _os.environ["PADDLE_TPU_PLATFORM"])
+
+from .framework import dtype as _dtype_mod  # noqa: E402
+from .framework.dtype import (  # noqa: F401,E402
+    bfloat16, bool_, complex64, complex128, float16, float32, float64, get_default_dtype,
+    int8, int16, int32, int64, set_default_dtype, uint8,
+)
+from .framework.core import Parameter, Tensor, to_tensor  # noqa: F401,E402
+from .framework.flags import get_flags, set_flags  # noqa: F401,E402
+from .framework import random as _random  # noqa: E402
+from .framework.random import get_rng_state, set_rng_state  # noqa: F401,E402
+from .autograd import enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401,E402
+from .ops import *  # noqa: F401,F403,E402
+from .ops import (  # noqa: F401,E402  (names shadowed by python builtins in *)
+    abs, all, any, max, min, pow, round, slice, sum, complex,
+)
+
+from . import amp  # noqa: F401,E402
+from . import autograd  # noqa: F401,E402
+from . import device  # noqa: F401,E402
+from . import framework  # noqa: F401,E402
+from . import linalg  # noqa: F401,E402
+from . import nn  # noqa: F401,E402
+from . import optimizer  # noqa: F401,E402
+from . import io  # noqa: F401,E402
+from . import ops  # noqa: F401,E402
+
+
+def seed(s):
+    """paddle.seed: reseed the global generator."""
+    return _random.seed(s)
+
+
+def rank(x):
+    return x.ndim
+
+
+def shape(x):
+    from .ops import to_tensor as _tt
+
+    import jax.numpy as jnp
+
+    return Tensor(jnp.asarray(x.value.shape, dtype="int64"))
+
+
+def save(obj, path, **kwargs):
+    from .framework_io import save as _save
+
+    return _save(obj, path, **kwargs)
+
+
+def load(path, **kwargs):
+    from .framework_io import load as _load
+
+    return _load(path, **kwargs)
+
+
+def set_device(dev):
+    return device.set_device(dev)
+
+
+def get_device():
+    return device.get_device()
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_cinn():
+    return False
+
+
+def is_compiled_with_distribute():
+    return True
+
+
+def is_compiled_with_custom_device(name="tpu"):
+    return name == "tpu"
+
+
+def in_dynamic_mode():
+    from .autograd import tape as _tape
+
+    return not _tape.in_functional_mode()
+
+
+def disable_static(place=None):
+    pass
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu is dynamic-first; use paddle_tpu.jit.to_static for compiled graphs"
+    )
+
+
+def disable_signal_handler():
+    pass
+
+
+CPUPlace = type("CPUPlace", (), {"__repr__": lambda self: "Place(cpu)"})
+TPUPlace = type("TPUPlace", (), {"__repr__": lambda self: "Place(tpu:0)"})
+CUDAPlace = TPUPlace  # alias so reference-style code keeps running on TPU
+CustomPlace = TPUPlace
+
+__version__ = "0.1.0"

@@ -1,0 +1,14 @@
+"""paddle_tpu.amp — automatic mixed precision (reference: python/paddle/amp)."""
+from . import amp_lists  # noqa: F401
+from .auto_cast import (  # noqa: F401
+    amp_guard,
+    amp_state,
+    auto_cast,
+    decorate,
+    get_amp_dtype,
+    is_auto_cast_enabled,
+)
+from .grad_scaler import AmpScaler, GradScaler  # noqa: F401
+
+white_list = amp_lists.white_list
+black_list = amp_lists.black_list

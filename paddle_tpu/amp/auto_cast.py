@@ -1,0 +1,148 @@
+"""AMP auto-cast.
+
+Reference analog: the AMP logic injected into every generated op
+(fluid/eager/auto_code_generator/generator/eager_gen.py:645 AMP_LOGIC_TEMPLATE,
+imperative/amp_auto_cast.cc controller) and python/paddle/amp/auto_cast.py. The op
+dispatcher (ops/_apply.py) calls amp_cast_inputs() on every op when an amp context is
+active: O1 casts white-list op inputs to the low-precision dtype and black-list op inputs to
+fp32; O2 casts everything except the black list. bf16 is the TPU-native choice; fp16 is kept
+for API parity.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..framework import dtype as dtype_mod
+from ..framework.core import Tensor
+from . import amp_lists
+
+_STATE = []
+
+
+class _AmpState:
+    __slots__ = ("enable", "dtype", "level", "custom_white", "custom_black")
+
+    def __init__(self, enable, dtype, level, custom_white, custom_black):
+        self.enable = enable
+        self.dtype = np.dtype(dtype_mod.convert_dtype(dtype))
+        self.level = level
+        self.custom_white = set(custom_white or [])
+        self.custom_black = set(custom_black or [])
+
+
+def _amp_state():
+    return _STATE[-1] if _STATE else None
+
+
+def amp_state():
+    return _amp_state()
+
+
+def amp_cast_inputs(opdef, args, kwargs):
+    state = _amp_state()
+    if state is None or not state.enable:
+        return args, kwargs
+    name = opdef.name
+    white = (name in amp_lists.WHITE_LIST or name in state.custom_white
+             or opdef.amp_category == "white")
+    black = name in amp_lists.BLACK_LIST or name in state.custom_black
+    if name in state.custom_white:
+        black = False
+    if state.level == "O2":
+        target = np.dtype(np.float32) if black else state.dtype
+    else:  # O1
+        if white and not black:
+            target = state.dtype
+        elif black:
+            target = np.dtype(np.float32)
+        else:
+            return args, kwargs
+
+    def cast_leaf(x):
+        if isinstance(x, Tensor) and dtype_mod.is_floating(x.dtype) and np.dtype(x.dtype) != target:
+            # cast through the op layer so autograd casts the grad back
+            from ..ops.manipulation import cast
+
+            return cast(x, target)
+        return x
+
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs),
+                                                 is_leaf=lambda x: isinstance(x, Tensor))
+    leaves = [cast_leaf(l) for l in leaves]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None, level="O1",
+              dtype="float16", use_promote=True):
+    """paddle.amp.auto_cast (python/paddle/amp/auto_cast.py:1006)."""
+    if level not in ("O0", "O1", "O2", "OD"):
+        raise ValueError(f"level must be O0/OD/O1/O2, got {level}")
+    if level == "O0":
+        enable = False
+    state = _AmpState(enable, dtype, "O1" if level == "OD" else level,
+                      custom_white_list, custom_black_list)
+    _STATE.append(state)
+    try:
+        yield
+    finally:
+        _STATE.pop()
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O1", dtype="float16", master_weight=None,
+             save_dtype=None, master_grad=False, excluded_layers=None):
+    """paddle.amp.decorate (auto_cast.py:1091): O2 casts model params to low precision and
+    lets the optimizer keep fp32 master weights."""
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        d = dtype_mod.convert_dtype(dtype)
+        for m in model_list:
+            for p in m.parameters():
+                if dtype_mod.is_floating(p.dtype) and np.dtype(p.dtype) == np.float32:
+                    p._replace_value(p.value.astype(d))
+        if optimizers is not None:
+            opt_list = optimizers if isinstance(optimizers, (list, tuple)) else [optimizers]
+            for opt in opt_list:
+                if hasattr(opt, "_use_master_weights"):
+                    opt._use_master_weights = True if master_weight is None else master_weight
+                if hasattr(opt, "_use_master_grad"):
+                    opt._use_master_grad = bool(master_grad)
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+def is_auto_cast_enabled():
+    s = _amp_state()
+    return bool(s and s.enable)
+
+
+def get_amp_dtype():
+    s = _amp_state()
+    return dtype_mod.dtype_name(s.dtype) if s else "float32"
+
+
+class AMPGlobalState:
+    """Mirror of amp/auto_cast.py:122 AMPGlobalState (master-grad bookkeeping)."""
+
+    def __init__(self):
+        self.model_parameters = []
+        self.use_master_grad = False
+        self.already_register_final_backward_hook = False
+
+
+_amp_global_state = AMPGlobalState()
+
+
+def amp_global_state():
+    return _amp_global_state

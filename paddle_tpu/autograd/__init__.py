@@ -1,0 +1,18 @@
+"""paddle_tpu.autograd — public autograd API.
+
+Reference analog: python/paddle/autograd + fluid/eager engine entry points.
+"""
+from .tape import (  # noqa: F401
+    backward,
+    enable_grad,
+    grad,
+    is_grad_enabled,
+    no_grad,
+    set_grad_enabled,
+)
+from .py_layer import PyLayer, PyLayerContext  # noqa: F401
+
+
+def hessian(func, xs, batch_axis=None):
+    """Minimal hessian via double grad."""
+    raise NotImplementedError("use paddle_tpu.incubate.autograd for functional transforms")

@@ -1,0 +1,95 @@
+"""PyLayer: user-defined autograd functions.
+
+Reference analog: fluid/eager/pylayer/ + pybind/eager_py_layer.cc, python surface
+python/paddle/autograd/py_layer.py. The forward runs under no_grad with a context for saving
+tensors; a single tape node is recorded whose pullback invokes the user's backward.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from . import tape
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace_tensors = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return self._saved
+
+    saved_tensors = saved_tensor
+
+
+class _PyLayerNodeRecorder:
+    pass
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, attrs):
+        super().__init__(name, bases, attrs)
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)] + [
+            v for v in kwargs.values() if isinstance(v, Tensor)
+        ]
+        requires_grad = tape.is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs
+        )
+        with tape.no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outs, (tuple, list))
+        out_list = [outs] if single else list(outs)
+        out_tensors = [o for o in out_list if isinstance(o, Tensor)]
+
+        if requires_grad:
+            out_avals = [jax.ShapeDtypeStruct(tuple(o.value.shape), o.value.dtype)
+                         for o in out_tensors]
+
+            def vjp_fn(cots):
+                cot_tensors = [Tensor(c) for c in cots]
+                with tape.no_grad():
+                    grads = cls.backward(ctx, *cot_tensors)
+                if not isinstance(grads, (tuple, list)):
+                    grads = (grads,)
+                vals = []
+                for g in grads:
+                    vals.append(g.value if isinstance(g, Tensor) else g)
+                # align with tensor_inputs; missing grads -> zeros
+                while len(vals) < len(tensor_inputs):
+                    vals.append(None)
+                out = []
+                for g, t in zip(vals, tensor_inputs):
+                    if g is None:
+                        out.append(jnp.zeros(t.value.shape, t.value.dtype))
+                    else:
+                        out.append(g)
+                return tuple(out)
+
+            for o in out_tensors:
+                o.stop_gradient = False
+            tape.record(cls.__name__, tensor_inputs, vjp_fn, None, out_avals, out_tensors)
+        return outs
+
+
+class LegacyPyLayer(PyLayer):
+    pass

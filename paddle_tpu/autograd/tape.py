@@ -1,0 +1,442 @@
+"""Eager autograd engine.
+
+Reference analog: the GradNode graph + backward queue in paddle/fluid/eager/
+(grad_node_info.h:197 GradNodeBase, backward.cc:106 RunBackward, backward.cc:473 Backward,
+accumulation_node.h:26 leaf accumulation). TPU-first redesign: each recorded op holds a
+jax.vjp-produced pullback whose residuals are jax.Arrays in HBM; the backward pass walks the
+tape in reverse-topological order exactly like RunBackward's in-degree queue, but every
+"kernel" is a cached XLA executable, and higher-order grads (create_graph) re-enter the op
+dispatch layer so grad-of-grad is taped too.
+"""
+from __future__ import annotations
+
+import contextlib
+import weakref
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+
+# --------------------------------------------------------------------------
+# Global recording state
+# --------------------------------------------------------------------------
+_GRAD_ENABLED = [True]
+# Functional mode: graph capture (jit.to_static) computes grads with jax.grad over the pure
+# function; the Python tape is suspended so tracing costs nothing.
+_FUNCTIONAL_MODE = [False]
+
+
+def is_grad_enabled() -> bool:
+    return _GRAD_ENABLED[0] and not _FUNCTIONAL_MODE[0]
+
+
+def set_grad_enabled(mode: bool):
+    class _Guard:
+        def __init__(self, prev):
+            self.prev = prev
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            _GRAD_ENABLED[0] = self.prev
+
+    prev = _GRAD_ENABLED[0]
+    _GRAD_ENABLED[0] = bool(mode)
+    return _Guard(prev)
+
+
+class no_grad:
+    """Context manager + decorator disabling tape recording (paddle.no_grad)."""
+
+    def __enter__(self):
+        self.prev = _GRAD_ENABLED[0]
+        _GRAD_ENABLED[0] = False
+        return self
+
+    def __exit__(self, *exc):
+        _GRAD_ENABLED[0] = self.prev
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with no_grad():
+                return fn(*a, **k)
+
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self.prev = _GRAD_ENABLED[0]
+        _GRAD_ENABLED[0] = True
+        return self
+
+    def __exit__(self, *exc):
+        _GRAD_ENABLED[0] = self.prev
+        return False
+
+
+@contextlib.contextmanager
+def functional_mode():
+    prev = _FUNCTIONAL_MODE[0]
+    _FUNCTIONAL_MODE[0] = True
+    try:
+        yield
+    finally:
+        _FUNCTIONAL_MODE[0] = prev
+
+
+def in_functional_mode() -> bool:
+    return _FUNCTIONAL_MODE[0]
+
+
+# --------------------------------------------------------------------------
+# Grad nodes
+# --------------------------------------------------------------------------
+class _InputRef:
+    """Snapshot of an input tensor's autograd identity at record time.
+
+    In-place APIs (add_, setitem_) rebind a Tensor's value and producer node after the op is
+    recorded; routing cotangents through the live object would then cycle into the in-place
+    op's own node. The snapshot pins (producer, out_index, stop_gradient, value) as they were
+    when the op consumed the tensor — the same reason the reference saves inputs through
+    TensorWrapper (fluid/eager/tensor_wrapper.h) with inplace-version checks.
+    """
+
+    __slots__ = ("tensor", "node", "out_index", "stop_gradient", "value")
+
+    def __init__(self, t: Tensor):
+        self.tensor = t
+        self.node = t._grad_node
+        self.out_index = t._out_index
+        self.stop_gradient = t.stop_gradient
+        self.value = t._value
+
+
+class GradNode:
+    """One recorded op on the tape.
+
+    inputs: _InputRef per tensor leaf of the op call (order matches the pullback's cotangents).
+    vjp_fn: pullback from jax.vjp over the op's pure function.
+    pure_fn: the op's pure function itself, kept for create_graph re-linearization.
+    out_avals: jax.ShapeDtypeStruct per output (zero-fill for dead branches).
+    """
+
+    __slots__ = ("name", "inputs", "vjp_fn", "pure_fn", "out_avals", "hooks", "__weakref__")
+
+    def __init__(self, name, inputs, vjp_fn, pure_fn, out_avals):
+        self.name = name
+        self.inputs = inputs
+        self.vjp_fn = vjp_fn
+        self.pure_fn = pure_fn
+        self.out_avals = out_avals
+        self.hooks = None  # list of (out_index, hook) applied to incoming cotangents
+
+
+def record(name, inputs, vjp_fn, pure_fn, out_avals, outputs):
+    node = GradNode(name, [_InputRef(t) for t in inputs], vjp_fn, pure_fn, list(out_avals))
+    for i, t in enumerate(outputs):
+        t._grad_node = node
+        t._out_index = i
+    return node
+
+
+def register_tensor_hook(tensor: Tensor, hook):
+    """Run `hook(grad)->grad|None` when the cotangent for `tensor` is finalized."""
+    node = tensor._grad_node
+    if node is None:
+        if tensor.stop_gradient:
+            raise RuntimeError("cannot register hook on a tensor that stops gradient")
+        if tensor._leaf_hooks is None:
+            tensor._leaf_hooks = []
+        tensor._leaf_hooks.append(hook)
+        return _RemovableHandle(tensor._leaf_hooks, hook)
+    if node.hooks is None:
+        node.hooks = []
+    entry = (tensor._out_index, hook)
+    node.hooks.append(entry)
+    return _RemovableHandle(node.hooks, entry)
+
+
+class _RemovableHandle:
+    def __init__(self, container, entry):
+        self._container = container
+        self._entry = entry
+
+    def remove(self):
+        try:
+            self._container.remove(self._entry)
+        except ValueError:
+            pass
+
+
+# --------------------------------------------------------------------------
+# Backward engine
+# --------------------------------------------------------------------------
+def _is_inexact(dt):
+    return jnp.issubdtype(np.dtype(dt), jnp.inexact)
+
+
+def _zeros_like(aval):
+    # integer/bool outputs carry symbolic-zero float0 cotangents in jax
+    if not _is_inexact(aval.dtype):
+        return np.zeros(aval.shape, jax.dtypes.float0)
+    return jnp.zeros(aval.shape, aval.dtype)
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward: accumulate into leaf .grad."""
+    _run_backward(
+        tensors,
+        grad_tensors,
+        retain_graph=retain_graph,
+        create_graph=False,
+        accumulate_leaves=True,
+        wanted=None,
+    )
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=None,
+    create_graph=False,
+    only_inputs=True,
+    allow_unused=False,
+    no_grad_vars=None,
+):
+    """paddle.grad (eager GeneralGrad, fluid/eager/backward.cc GeneralGrad)."""
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if retain_graph is None:
+        retain_graph = create_graph
+    skip = set()
+    if no_grad_vars:
+        skip = {id(t) for t in no_grad_vars}
+    got = _run_backward(
+        outputs,
+        grad_outputs,
+        retain_graph=retain_graph,
+        create_graph=create_graph,
+        accumulate_leaves=False,
+        wanted=[t for t in inputs],
+        skip_ids=skip,
+    )
+    results = []
+    for t in inputs:
+        g = got.get(id(t))
+        if g is None and not allow_unused:
+            raise RuntimeError(
+                f"One of the differentiated tensors ({t.name}) appears unused in the graph; "
+                "pass allow_unused=True to return None for it."
+            )
+        results.append(g)
+    return results
+
+
+def _run_backward(
+    tensors,
+    grad_tensors,
+    retain_graph,
+    create_graph,
+    accumulate_leaves,
+    wanted,
+    skip_ids=frozenset(),
+):
+    tensors = [t for t in tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    grad_tensors = list(grad_tensors)
+
+    # cotangent buffers: (id(node), out_idx) -> value; node kept alive via nodes set
+    buf = {}
+    nodes = {}
+
+    def seed(t, g):
+        node = t._grad_node
+        if node is None:
+            return None
+        nodes[id(node)] = node
+        key = (id(node), t._out_index)
+        buf[key] = g if key not in buf else _acc(buf[key], g)
+
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient:
+            raise RuntimeError("cannot run backward on a tensor with stop_gradient=True")
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; got shape "
+                    f"{t.shape}"
+                )
+            g = jnp.ones(t.value.shape, t.value.dtype)
+        else:
+            g = g.value if isinstance(g, Tensor) else jnp.asarray(g)
+        if t._grad_node is None:
+            # output IS a leaf
+            if accumulate_leaves:
+                _leaf_accumulate(t, g, create_graph)
+        else:
+            seed(t, g)
+
+    # ---- reachability + in-(consumer)-edge count ----
+    pending = {}
+    visited = set()
+    stack = [nodes[k] for k in nodes]
+    reachable = dict(nodes)
+    while stack:
+        node = stack.pop()
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        for ref in node.inputs:
+            if ref.stop_gradient or id(ref.tensor) in skip_ids:
+                continue
+            p = ref.node
+            if p is not None:
+                pending[id(p)] = pending.get(id(p), 0) + 1
+                if id(p) not in reachable:
+                    reachable[id(p)] = p
+                    stack.append(p)
+
+    wanted_ids = {id(t) for t in (wanted or [])}
+    collected = {}
+
+    ready = [n for nid, n in nodes.items() if pending.get(nid, 0) == 0]
+    # roots with no pending consumers run first; consumers seed producers as they run
+    processed = set()
+
+    def deliver(ref, cot):
+        """Route a cotangent contribution to the input's producer or leaf storage."""
+        if cot is None or ref.stop_gradient or id(ref.tensor) in skip_ids:
+            return
+        cval = cot.value if isinstance(cot, Tensor) else cot
+        if getattr(cval, "dtype", None) == jax.dtypes.float0:
+            return
+        if id(ref.tensor) in wanted_ids:
+            prev = collected.get(id(ref.tensor))
+            collected[id(ref.tensor)] = (
+                cot if prev is None else _acc_tensorish(prev, cot, create_graph)
+            )
+        p = ref.node
+        if p is None:
+            if accumulate_leaves:
+                _leaf_accumulate(ref.tensor, cot, create_graph)
+            return
+        key = (id(p), ref.out_index)
+        buf[key] = cot if key not in buf else _acc_tensorish(buf[key], cot, create_graph)
+        pending[id(p)] -= 1
+        if pending[id(p)] == 0:
+            ready.append(p)
+
+    while ready:
+        node = ready.pop()
+        if id(node) in processed:
+            continue
+        processed.add(id(node))
+        # gather output cotangents, zero-filling unvisited outputs
+        cots = []
+        for i, aval in enumerate(node.out_avals):
+            g = buf.pop((id(node), i), None)
+            cots.append(g if g is not None else _zeros_like(aval))
+        if node.hooks:
+            for idx, hook in node.hooks:
+                h = hook(_as_tensor(cots[idx]))
+                if h is not None:
+                    cots[idx] = h.value if isinstance(h, Tensor) else h
+        if node.vjp_fn is None and not (create_graph and node.pure_fn is not None):
+            raise RuntimeError(
+                f"backward through {node.name} a second time: set retain_graph=True"
+            )
+        in_cots = _run_vjp(node, cots, create_graph)
+        if not retain_graph:
+            node.vjp_fn = None
+        for ref, c in zip(node.inputs, in_cots):
+            deliver(ref, c)
+
+    out = {}
+    for t in wanted or []:
+        g = collected.get(id(t))
+        if g is not None:
+            out[id(t)] = g if isinstance(g, Tensor) else Tensor(g, stop_gradient=not create_graph)
+    return out
+
+
+def _acc(a, b):
+    return a + b
+
+
+def _acc_tensorish(a, b, create_graph):
+    if isinstance(a, Tensor) or isinstance(b, Tensor):
+        from .. import ops
+
+        return ops.add(_as_tensor(a), _as_tensor(b))
+    return a + b
+
+
+def _as_tensor(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _run_vjp(node, cots, create_graph):
+    """Execute the node's pullback.
+
+    create_graph: re-linearize through the op dispatcher so the computation is taped and
+    residual-paths stay differentiable (the stored pullback treats residuals as constants,
+    which would silently drop second-order terms)."""
+    if create_graph and node.pure_fn is not None:
+        from ..ops._apply import apply_raw
+
+        def grad_fn(*args):
+            n_in = len(node.inputs)
+            ins, cs = args[:n_in], args[n_in:]
+            _, vjp_fn = jax.vjp(node.pure_fn, *ins)
+            return vjp_fn(tuple(cs))
+
+        # reuse the live tensors when unmutated (keeps identity for grad(..., inputs=) );
+        # fall back to a snapshot copy if an in-place op rebound them since
+        in_tensors = []
+        for ref in node.inputs:
+            live = ref.tensor
+            if live._value is ref.value and live._grad_node is ref.node:
+                in_tensors.append(live)
+            else:
+                t = Tensor(ref.value, stop_gradient=ref.stop_gradient)
+                t._grad_node, t._out_index = ref.node, ref.out_index
+                in_tensors.append(t)
+        cot_tensors = [_as_tensor(c) for c in cots]
+        outs = apply_raw(
+            node.name + "_grad", grad_fn, in_tensors + cot_tensors, n_outs=len(node.inputs)
+        )
+        return list(outs)
+    cot_vals = [c.value if isinstance(c, Tensor) else c for c in cots]
+    cot_vals = [
+        c
+        if not _is_inexact(a.dtype)
+        else (jnp.asarray(c, a.dtype) if np.dtype(c.dtype) != a.dtype else c)
+        for c, a in zip(cot_vals, node.out_avals)
+    ]
+    # op pure functions always return a tuple of outputs (see ops/_apply.py)
+    return list(node.vjp_fn(tuple(cot_vals)))
+
+
+def _leaf_accumulate(t: Tensor, g, create_graph=False):
+    hooks = t._leaf_hooks
+    if hooks:
+        for hook in list(hooks):
+            h = hook(_as_tensor(g))
+            if h is not None:
+                g = h.value if isinstance(h, Tensor) else h
+    g_val = g.value if isinstance(g, Tensor) else g
+    if t._grad is None:
+        t._grad = Tensor(g_val, stop_gradient=True)
+    else:
+        t._grad._replace_value(t._grad.value + g_val)

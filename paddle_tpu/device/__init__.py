@@ -1,0 +1,123 @@
+"""paddle_tpu.device — device management.
+
+Reference analog: python/paddle/device (set_device/get_device, streams, events). TPU-first:
+devices are PJRT devices from jax; streams/events have no user-managed analog (XLA orders
+execution), so the Stream/Event API is a semantically-correct ordering shim built on
+jax.block_until_ready.
+"""
+from __future__ import annotations
+
+import jax
+
+_CURRENT = [None]
+
+
+def _platforms():
+    return {d.platform for d in jax.devices()}
+
+
+def set_device(device: str):
+    """'tpu', 'cpu', 'tpu:0', ... Maps to jax default device."""
+    name = device.split(":")[0]
+    idx = int(device.split(":")[1]) if ":" in device else 0
+    if name in ("gpu", "cuda", "custom_device", "axon"):
+        name = "tpu"  # reference-style code asking for the accelerator gets the TPU
+    try:
+        devs = jax.devices(name)
+    except RuntimeError:
+        devs = jax.devices()
+    dev = devs[min(idx, len(devs) - 1)]
+    jax.config.update("jax_default_device", dev)
+    _CURRENT[0] = f"{name}:{idx}"
+    return dev
+
+
+def get_device() -> str:
+    if _CURRENT[0] is not None:
+        return _CURRENT[0]
+    d = jax.devices()[0]
+    plat = "tpu" if d.platform != "cpu" else "cpu"
+    return f"{plat}:{d.id}" if plat != "cpu" else "cpu"
+
+
+def get_all_device_type():
+    return sorted(_platforms())
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return [f"tpu:{d.id}" for d in jax.devices() if d.platform != "cpu"]
+
+
+def device_count():
+    return jax.device_count()
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def cuda_device_count():
+    return 0
+
+
+def synchronize(device=None):
+    """Block until all queued work is done (jax dispatch is async)."""
+    (jax.device_put(0) + 0).block_until_ready()
+
+
+def current_stream(device=None):
+    return Stream()
+
+
+def set_stream(stream):
+    return stream
+
+
+class Stream:
+    """Ordering shim: XLA executes in dispatch order; wait_* is a barrier."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        synchronize()
+
+    def wait_stream(self, stream):
+        synchronize()
+
+    def record_event(self, event=None):
+        return event or Event()
+
+    def query(self):
+        return True
+
+
+class Event:
+    def __init__(self, device=None, enable_timing=False, blocking=False, interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+def stream_guard(stream):
+    import contextlib
+
+    @contextlib.contextmanager
+    def guard():
+        yield
+
+    return guard()

@@ -1,0 +1,317 @@
+"""Tensor: the eager tensor handle.
+
+Reference analog: paddle::Tensor (phi/api/include/tensor.h:82) over DenseTensor
+(phi/core/dense_tensor.h:37). TPU-first redesign: storage is a jax.Array living in HBM via
+PJRT; every op is a traced-and-cached XLA computation; autograd metadata (grad node pointer,
+stop_gradient, accumulated .grad) hangs off this Python handle the way AutogradMeta
+(fluid/eager/autograd_meta.h) hangs off the reference tensor. Under graph capture the wrapped
+value may be a jax tracer, which is how one codebase serves both eager and compiled modes.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import dtype as dtype_mod
+
+_tensor_methods_installed = False
+
+
+class Tensor:
+    __slots__ = (
+        "_value",
+        "stop_gradient",
+        "_grad",
+        "_grad_node",
+        "_out_index",
+        "name",
+        "persistable",
+        "_dist_attr",
+        "_leaf_hooks",
+        "__weakref__",
+    )
+
+    def __init__(self, value, stop_gradient: bool = True, name: str = None):
+        if isinstance(value, Tensor):
+            value = value._value
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self._grad = None
+        self._grad_node = None
+        self._out_index = 0
+        self.name = name
+        self.persistable = False
+        self._dist_attr = None
+        self._leaf_hooks = None
+
+    # -- storage ------------------------------------------------------------
+    @property
+    def value(self):
+        return self._value
+
+    def _replace_value(self, new_value):
+        """In-place storage swap (optimizer updates, load_state_dict). Bypasses autograd."""
+        self._value = new_value
+        return self
+
+    # -- meta ---------------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._value.dtype)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def T(self):
+        from .. import ops
+
+        return ops.transpose(self, list(range(self.ndim))[::-1])
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    @property
+    def place(self):
+        try:
+            devs = self._value.devices()
+            return next(iter(devs))
+        except Exception:
+            return jax.devices()[0]
+
+    def numel(self):
+        return self.size
+
+    def dim(self):
+        return self.ndim
+
+    def rank(self):
+        return self.ndim
+
+    # -- grad ---------------------------------------------------------------
+    @property
+    def grad(self):
+        return self._grad
+
+    @grad.setter
+    def grad(self, g):
+        self._grad = g
+
+    def clear_grad(self):
+        self._grad = None
+
+    clear_gradient = clear_grad
+
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        from ..autograd import tape
+
+        tape.backward([self], [grad_tensor] if grad_tensor is not None else None, retain_graph)
+
+    def detach(self):
+        t = Tensor(self._value, stop_gradient=True, name=self.name)
+        t.persistable = self.persistable
+        return t
+
+    def detach_(self):
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def register_hook(self, hook):
+        from ..autograd import tape
+
+        return tape.register_tensor_hook(self, hook)
+
+    # -- conversion ---------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def astype(self, dtype):
+        from .. import ops
+
+        return ops.cast(self, dtype)
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    def clone(self):
+        from .. import ops
+
+        return ops.assign(self)
+
+    def cpu(self):
+        return Tensor(jax.device_put(self._value, jax.devices("cpu")[0]), self.stop_gradient)
+
+    def to(self, *args, **kwargs):
+        out = self
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, str) and a.lower() in dtype_mod._STR2DTYPE:
+                out = out.astype(a)
+            elif isinstance(a, (np.dtype, type)) or hasattr(a, "itemsize"):
+                out = out.astype(a)
+        return out
+
+    def pin_memory(self):
+        return self
+
+    def contiguous(self):
+        return self
+
+    def is_contiguous(self):
+        return True
+
+    def get_tensor(self):
+        return self
+
+    def _is_initialized(self):
+        return True
+
+    # -- python protocol ----------------------------------------------------
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __bool__(self):
+        return bool(self.numpy())
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __index__(self):
+        return int(self.numpy())
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.numpy().item(), spec)
+        return format(str(self), spec)
+
+    def __repr__(self):
+        grad_info = "" if self.stop_gradient else ", stop_gradient=False"
+        try:
+            data = np.array2string(
+                np.asarray(jax.device_get(self._value)), precision=6, separator=", "
+            )
+        except Exception:
+            data = f"<traced {self._value}>"
+        return (
+            f"Tensor(shape={self.shape}, dtype={dtype_mod.dtype_name(self.dtype)}"
+            f"{grad_info},\n       {data})"
+        )
+
+    # jax pytree-compatible hashing is NOT provided: tensors are mutable handles.
+    __hash__ = object.__hash__
+
+    def __eq__(self, other):  # elementwise, paddle semantics
+        from .. import ops
+
+        return ops.equal(self, other)
+
+    def __ne__(self, other):
+        from .. import ops
+
+        return ops.not_equal(self, other)
+
+    def __getitem__(self, idx):
+        from ..ops import indexing
+
+        return indexing.getitem(self, idx)
+
+    def __setitem__(self, idx, value):
+        from ..ops import indexing
+
+        indexing.setitem_(self, idx, value)
+
+    def __array__(self, dtype=None):
+        arr = self.numpy()
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def __dlpack__(self, *a, **k):
+        return self._value.__dlpack__(*a, **k)
+
+
+class Parameter(Tensor):
+    """Trainable tensor: stop_gradient defaults False, persistable True.
+
+    Reference analog: paddle.base.framework.EagerParamBase.
+    """
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip", "is_distributed")
+
+    _name_counter = [0]
+
+    def __init__(self, value, name=None, trainable=True):
+        if name is None:
+            Parameter._name_counter[0] += 1
+            name = f"param_{Parameter._name_counter[0]}"
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.persistable = True
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+        self.is_distributed = False
+
+    @property
+    def requires_grad(self):
+        return not self.stop_gradient
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor equivalent (python/paddle/tensor/creation.py to_tensor)."""
+    dtype = dtype_mod.convert_dtype(dtype)
+    if isinstance(data, Tensor):
+        val = data.value
+        if dtype is not None and np.dtype(val.dtype) != dtype:
+            val = val.astype(dtype)
+        return Tensor(val, stop_gradient=stop_gradient)
+    if isinstance(data, (jnp.ndarray, jax.Array)):
+        val = data
+    else:
+        arr = np.asarray(data)
+        if dtype is None:
+            # paddle default: python floats -> default float dtype; ints -> int64
+            if arr.dtype == np.float64:
+                dtype = dtype_mod.get_default_dtype()
+            elif arr.dtype == np.int32 and not isinstance(data, np.ndarray):
+                dtype = np.dtype(np.int64)
+        val = jnp.asarray(arr, dtype=dtype)
+        dtype = None
+    if dtype is not None and np.dtype(val.dtype) != dtype:
+        val = val.astype(dtype)
+    return Tensor(val, stop_gradient=stop_gradient)
+
+
+def _unwrap(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _wrap(val, stop_gradient=True):
+    return Tensor(val, stop_gradient=stop_gradient)

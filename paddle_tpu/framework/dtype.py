@@ -1,0 +1,102 @@
+"""Dtype system for paddle_tpu.
+
+The reference keeps a DataType enum in phi (paddle/phi/common/data_type.h) and exposes
+string/`paddle.float32` style handles in Python. Here dtypes are thin aliases over numpy/jax
+dtypes; bfloat16 is first-class (TPU native matmul dtype).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects (jnp dtypes).
+bfloat16 = jnp.bfloat16
+float16 = jnp.float16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+uint16 = jnp.uint16
+uint32 = jnp.uint32
+uint64 = jnp.uint64
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_STR2DTYPE = {
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float16": float16,
+    "fp16": float16,
+    "half": float16,
+    "float32": float32,
+    "fp32": float32,
+    "float": float32,
+    "float64": float64,
+    "fp64": float64,
+    "double": float64,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "uint8": uint8,
+    "uint16": uint16,
+    "uint32": uint32,
+    "uint64": uint64,
+    "bool": bool_,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+_DEFAULT_DTYPE = [np.dtype("float32")]
+
+
+def set_default_dtype(d):
+    _DEFAULT_DTYPE[0] = convert_dtype(d)
+
+
+def get_default_dtype():
+    return _DEFAULT_DTYPE[0]
+
+
+def convert_dtype(dtype):
+    """Normalize a user-provided dtype (str / np dtype / jnp dtype / None) to np.dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        key = dtype.lower()
+        if key not in _STR2DTYPE:
+            raise ValueError(f"Unknown dtype string: {dtype!r}")
+        return np.dtype(_STR2DTYPE[key])
+    return np.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    """Canonical paddle-style name ('float32', 'bfloat16', ...)."""
+    return np.dtype(dtype).name if np.dtype(dtype) != np.dtype(jnp.bfloat16) else "bfloat16"
+
+
+def is_floating(dtype) -> bool:
+    d = np.dtype(dtype)
+    return np.issubdtype(d, np.floating) or d == np.dtype(jnp.bfloat16)
+
+
+def is_integer(dtype) -> bool:
+    return np.issubdtype(np.dtype(dtype), np.integer)
+
+
+def is_complex(dtype) -> bool:
+    return np.issubdtype(np.dtype(dtype), np.complexfloating)
+
+
+def is_bool(dtype) -> bool:
+    return np.dtype(dtype) == np.dtype(np.bool_)
+
+
+# Type-promotion helper mirroring the reference's promotion pass
+# (paddle/fluid/eager/type_promotion_utils.h); jax/numpy promotion semantics are used.
+def promote_types(a, b):
+    return jnp.promote_types(a, b)

@@ -1,0 +1,76 @@
+"""Global FLAGS registry.
+
+The reference defines ~188 exported FLAGS_* in paddle/common/flags.cc with env-var pickup and
+runtime get/set surfaced through paddle.set_flags/get_flags
+(python/paddle/base/framework.py:144). Here the registry is a plain dict with typed defaults,
+env ingestion at import, and the same public get/set API.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+_REGISTRY: Dict[str, dict] = {}
+
+
+def define_flag(name: str, default: Any, doc: str = ""):
+    if not name.startswith("FLAGS_"):
+        name = "FLAGS_" + name
+    value = default
+    env = os.environ.get(name)
+    if env is not None:
+        value = _parse(env, type(default))
+    _REGISTRY[name] = {"value": value, "default": default, "doc": doc, "type": type(default)}
+    return value
+
+
+def _parse(text: str, ty):
+    if ty is bool:
+        return text.lower() in ("1", "true", "yes", "on")
+    if ty in (int, float):
+        return ty(text)
+    return text
+
+
+def set_flags(flags: Dict[str, Any]):
+    for k, v in flags.items():
+        if not k.startswith("FLAGS_"):
+            k = "FLAGS_" + k
+        if k not in _REGISTRY:
+            define_flag(k, v)
+        else:
+            _REGISTRY[k]["value"] = _parse(v, _REGISTRY[k]["type"]) if isinstance(v, str) else v
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for k in flags:
+        key = k if k.startswith("FLAGS_") else "FLAGS_" + k
+        if key not in _REGISTRY:
+            raise KeyError(f"Unknown flag {k}")
+        out[k] = _REGISTRY[key]["value"]
+    return out
+
+
+def flag(name: str):
+    key = name if name.startswith("FLAGS_") else "FLAGS_" + name
+    return _REGISTRY[key]["value"]
+
+
+def exported_flags() -> Dict[str, dict]:
+    return dict(_REGISTRY)
+
+
+# Core flags (subset of the reference's set that is meaningful on TPU).
+define_flag("check_nan_inf", False, "scan op outputs for NaN/Inf after each eager op")
+define_flag("check_nan_inf_level", 0, "0: error on nan/inf; >0: print statistics only")
+define_flag("use_stride_kernel", True, "allow zero-copy view ops (reshape/slice return views)")
+define_flag("eager_delete_tensor_gb", 0.0, "kept for API parity; XLA/PJRT manages memory")
+define_flag("allocator_strategy", "auto_growth", "kept for API parity; PJRT allocates HBM")
+define_flag("tpu_matmul_precision", "default", "jax matmul precision: default|high|highest")
+define_flag("embedding_deterministic", 0, "kept for API parity (determinism is XLA default)")
+define_flag("cudnn_deterministic", False, "API parity alias; TPU execution is deterministic")
+define_flag("max_inplace_grad_add", 0, "API parity; tape always accumulates functionally")
+define_flag("log_level", 0, "verbosity of paddle_tpu host-side logging")

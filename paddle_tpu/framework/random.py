@@ -1,0 +1,75 @@
+"""Global RNG state.
+
+The reference keeps per-device Generator objects (paddle/phi/core/generator.cc) seeded by
+paddle.seed. TPU-first equivalent: a functional jax PRNG key threaded through a global state
+object; every random op calls `next_key()` which splits the state. Under graph capture the
+key may be a tracer (to_static threads an explicit seed input), making compiled training
+steps correctly randomized per call instead of baking one sample into the trace.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+class _GlobalRNG:
+    def __init__(self, seed: int = 0):
+        self._key = jax.random.key(seed)
+        self.initial_seed = seed
+
+    def seed(self, s: int):
+        self._key = jax.random.key(s)
+        self.initial_seed = s
+
+    def next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def get_state(self):
+        return self._key
+
+    def set_state(self, key):
+        self._key = key
+
+
+_GLOBAL = _GlobalRNG(0)
+
+# When tracing a static program, a traced key is pushed here so that random ops
+# draw from the traced key (folded with a counter) instead of the host state.
+_TRACE_STACK = []
+
+
+def seed(s: int):
+    _GLOBAL.seed(int(s))
+    return _GLOBAL
+
+
+def initial_seed() -> int:
+    return _GLOBAL.initial_seed
+
+
+def next_key():
+    if _TRACE_STACK:
+        entry = _TRACE_STACK[-1]
+        entry["count"] += 1
+        return jax.random.fold_in(entry["key"], entry["count"])
+    return _GLOBAL.next_key()
+
+
+def get_rng_state():
+    return _GLOBAL.get_state()
+
+
+def set_rng_state(state):
+    _GLOBAL.set_state(state)
+
+
+@contextlib.contextmanager
+def trace_key(key):
+    """Route next_key() through `key` (possibly a tracer) for the duration."""
+    _TRACE_STACK.append({"key": key, "count": 0})
+    try:
+        yield
+    finally:
+        _TRACE_STACK.pop()

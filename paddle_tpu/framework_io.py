@@ -1,0 +1,84 @@
+"""paddle.save / paddle.load.
+
+Reference analog: python/paddle/framework/io.py:773 save, :1020 load (pickle-based
+state_dict persistence). Tensors are serialized as (numpy array, dtype, stop_gradient);
+bfloat16 goes through a uint16 view since pickle+numpy lack native bf16.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .framework.core import Parameter, Tensor
+
+
+_BF16_TAG = "__bf16_as_uint16__"
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        arr = np.asarray(obj.value)
+        if arr.dtype == np.dtype(jnp.bfloat16):
+            arr = arr.view(np.uint16)
+            return {
+                "__tensor__": True,
+                "data": arr,
+                "dtype": _BF16_TAG,
+                "stop_gradient": obj.stop_gradient,
+                "is_param": isinstance(obj, Parameter),
+                "name": obj.name,
+            }
+        return {
+            "__tensor__": True,
+            "data": arr,
+            "dtype": str(arr.dtype),
+            "stop_gradient": obj.stop_gradient,
+            "is_param": isinstance(obj, Parameter),
+            "name": obj.name,
+        }
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        packed = [_pack(v) for v in obj]
+        return packed if isinstance(obj, list) else tuple(packed)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, dict):
+        if obj.get("__tensor__"):
+            arr = obj["data"]
+            if obj["dtype"] == _BF16_TAG:
+                arr = arr.view(jnp.bfloat16)
+            if return_numpy:
+                return arr
+            if obj.get("is_param"):
+                t = Parameter(jnp.asarray(arr), name=obj.get("name"))
+                t.stop_gradient = obj["stop_gradient"]
+                return t
+            t = Tensor(jnp.asarray(arr), stop_gradient=obj["stop_gradient"], name=obj.get("name"))
+            return t
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_unpack(v, return_numpy) for v in obj]
+    if isinstance(obj, tuple):
+        return tuple(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy=return_numpy)

@@ -1,0 +1,8 @@
+"""paddle_tpu.linalg namespace (reference: paddle.linalg)."""
+from .ops.linalg import (  # noqa: F401
+    cholesky, cholesky_inverse, cholesky_solve, cond, corrcoef, cov, det, eig, eigh, eigvals,
+    eigvalsh, householder_product, inv, lstsq, lu, lu_unpack, matrix_exp, matrix_power,
+    matrix_rank, multi_dot, pinv, qr, slogdet, solve, svd, svd_lowrank, triangular_solve,
+)
+from .ops.reduction import norm  # noqa: F401
+from .ops.linalg import matmul  # noqa: F401

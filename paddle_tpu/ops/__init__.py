@@ -1,0 +1,191 @@
+"""paddle_tpu.ops — the flat functional op surface (paddle.* tensor ops).
+
+Reference analog: python/paddle/tensor/* re-exported at the paddle.* top level, plus the
+monkey-patching of methods onto Tensor (python/paddle/base/dygraph/math_op_patch.py).
+"""
+from __future__ import annotations
+
+from ..framework.core import Tensor
+from ._apply import apply, apply_raw, defop, get_registry, register_op  # noqa: F401
+
+from .creation import (  # noqa: F401
+    arange, assign, clone, complex, diag, diag_embed, diagflat, empty, empty_like, eye, full,
+    full_like, linspace, logspace, meshgrid, numel, ones, ones_like, polar, to_tensor, tril,
+    tril_indices, triu, triu_indices, zeros, zeros_like,
+)
+from .math import (  # noqa: F401
+    abs, acos, acosh, add, add_, addmm, allclose, angle, asin, asinh, atan, atan2, atanh,
+    bitwise_and, bitwise_left_shift, bitwise_not, bitwise_or, bitwise_right_shift, bitwise_xor,
+    ceil, clip, clip_, conj, copysign, cos, cosh, cross, cummax, cummin, cumprod, cumsum,
+    deg2rad, digamma, divide, divide_, dot, equal, equal_all, erf, erfinv, exp, expm1, floor,
+    floor_divide, floor_mod, fmax, fmin, frac, gcd, greater, greater_equal, greater_than,
+    heaviside, hypot, i0, i0e, i1, i1e, imag, inner, isclose, isfinite, isinf, isnan, kron,
+    lcm, ldexp, lerp, less, less_equal, less_than, lgamma, log, log1p, log2, log10, logaddexp,
+    logcumsumexp, logical_and, logical_not, logical_or, logical_xor, logit, maximum, minimum,
+    mod, multiplex, multiply, multiply_, nan_to_num, neg, negative, nextafter, not_equal,
+    outer, pow, rad2deg, real, reciprocal, remainder, round, rsqrt, scale, scale_, sigmoid,
+    sign, sin, sinh, sqrt, square, stanh, subtract, subtract_, tan, tanh, trace, diagonal,
+    trapezoid, trunc, vander,
+)
+from .reduction import (  # noqa: F401
+    all, amax, amin, any, count_nonzero, dist, logsumexp, max, mean, median, min, nanmean,
+    nanmedian, nanquantile, nansum, norm, prod, quantile, std, sum, var,
+)
+from .manipulation import (  # noqa: F401
+    as_strided, atleast_1d, atleast_2d, atleast_3d, broadcast_shape, broadcast_tensors,
+    broadcast_to, cast, chunk, concat, crop, expand, expand_as, flatten, flip, gather,
+    gather_nd, index_add, index_fill, index_put, index_sample, index_select, masked_fill,
+    masked_scatter, masked_select, moveaxis, nonzero, pad, repeat_interleave, reshape,
+    reshape_, roll, rot90, scatter, scatter_, scatter_nd, scatter_nd_add, shard_index, slice,
+    split, squeeze, squeeze_, stack, strided_slice, swapaxes, t, take_along_axis, tensor_split,
+    tile, transpose, unbind, unique, unique_consecutive, unsqueeze, unsqueeze_, unstack, view,
+    view_as, where, put_along_axis,
+)
+from .linalg import (  # noqa: F401
+    bincount, bmm, cholesky, cholesky_inverse, cholesky_solve, cond, corrcoef, cov, det, eig,
+    eigh, eigvals, eigvalsh, histogram, histogramdd, householder_product, inv, inverse, lstsq,
+    lu, lu_unpack, matmul, matrix_exp, matrix_power, matrix_rank, mm, multi_dot, mv, pinv, qr,
+    slogdet, solve, svd, svd_lowrank, triangular_solve,
+)
+from .search import (  # noqa: F401
+    argmax, argmin, argsort, bucketize, kthvalue, mode, searchsorted, sort, topk,
+)
+from .random_ops import (  # noqa: F401
+    bernoulli, bernoulli_, cauchy_, exponential_, geometric_, gumbel_softmax, log_normal_,
+    multinomial, normal, normal_, poisson, rand, rand_like, randint, randint_like, randn,
+    randn_like, randperm, standard_normal, uniform, uniform_,
+)
+from .einsum_op import einsum  # noqa: F401
+
+import numpy as _np
+
+
+def item(x):
+    return x.item()
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_floating_point(x):
+    from ..framework import dtype as _dt
+
+    return _dt.is_floating(x.dtype)
+
+
+def is_integer(x):
+    from ..framework import dtype as _dt
+
+    return _dt.is_integer(x.dtype)
+
+
+def is_complex(x):
+    from ..framework import dtype as _dt
+
+    return _dt.is_complex(x.dtype)
+
+
+def iinfo(dtype):
+    from ..framework import dtype as _dt
+
+    return _np.iinfo(_dt.convert_dtype(dtype))
+
+
+def finfo(dtype):
+    from ..framework import dtype as _dt
+
+    import jax.numpy as jnp
+
+    return jnp.finfo(_dt.convert_dtype(dtype))
+
+
+def increment(x, value=1.0, name=None):
+    out = add(x, to_tensor(value, dtype=x.dtype))
+    x._replace_value(out.value)
+    return x
+
+
+# --------------------------------------------------------------------------
+# Install methods on Tensor (math_op_patch equivalent)
+# --------------------------------------------------------------------------
+_METHOD_NAMES = [
+    # math
+    "abs", "acos", "acosh", "add", "add_", "addmm", "allclose", "angle", "asin", "asinh",
+    "atan", "atanh", "bitwise_and", "bitwise_not", "bitwise_or", "bitwise_xor", "ceil", "clip",
+    "clip_", "conj", "cos", "cosh", "cross", "cummax", "cummin", "cumprod", "cumsum",
+    "digamma", "divide", "dot", "equal", "equal_all", "erf", "erfinv", "exp", "expm1", "floor",
+    "floor_divide", "floor_mod", "fmax", "fmin", "frac", "gcd", "greater_equal",
+    "greater_than", "heaviside", "imag", "inner", "isclose", "isfinite", "isinf", "isnan",
+    "kron", "lcm", "lerp", "less_equal", "less_than", "lgamma", "log", "log1p", "log2",
+    "log10", "logical_and", "logical_not", "logical_or", "logical_xor", "logit", "maximum",
+    "minimum", "mod", "multiplex", "multiply", "multiply_", "nan_to_num", "neg", "nextafter",
+    "not_equal", "outer", "pow", "rad2deg", "deg2rad", "real", "reciprocal", "remainder",
+    "round", "rsqrt", "scale", "scale_", "sigmoid", "sign", "sin", "sinh", "sqrt", "square",
+    "stanh", "subtract", "subtract_", "tan", "tanh", "trace", "diagonal", "trunc",
+    # reduction
+    "all", "amax", "amin", "any", "count_nonzero", "dist", "logsumexp", "max", "mean",
+    "median", "min", "nanmean", "nanmedian", "nansum", "norm", "prod", "quantile", "std",
+    "sum", "var",
+    # manipulation
+    "as_strided", "broadcast_to", "cast", "chunk", "concat", "crop", "expand", "expand_as",
+    "flatten", "flip", "gather", "gather_nd", "index_add", "index_fill", "index_put",
+    "index_sample", "index_select", "masked_fill", "masked_scatter", "masked_select",
+    "moveaxis", "nonzero", "pad", "repeat_interleave", "reshape", "reshape_", "roll", "rot90",
+    "scatter", "scatter_", "scatter_nd_add", "slice", "split", "squeeze", "squeeze_", "stack",
+    "strided_slice", "t", "take_along_axis", "tensor_split", "tile", "transpose", "unbind",
+    "unique", "unique_consecutive", "unsqueeze", "unsqueeze_", "unstack", "view", "view_as",
+    "where", "put_along_axis", "tril", "triu", "diag", "diag_embed", "zeros_like",
+    "ones_like", "full_like",
+    # linalg
+    "bincount", "bmm", "cholesky", "cholesky_solve", "cov", "det", "eig", "eigvals",
+    "histogram", "inverse", "lstsq", "lu", "matmul", "matrix_power", "mm", "mv", "pinv", "qr",
+    "slogdet", "solve", "svd",
+    # search
+    "argmax", "argmin", "argsort", "bucketize", "kthvalue", "mode", "searchsorted", "sort",
+    "topk",
+    # random inplace
+    "bernoulli_", "cauchy_", "exponential_", "geometric_", "log_normal_", "normal_",
+    "uniform_",
+]
+
+_g = globals()
+for _name in _METHOD_NAMES:
+    if _name in _g:
+        setattr(Tensor, _name, _g[_name])
+
+# dunders
+Tensor.__add__ = lambda self, o: add(self, o)
+Tensor.__radd__ = lambda self, o: add(self, o)
+Tensor.__sub__ = lambda self, o: subtract(self, o)
+Tensor.__rsub__ = lambda self, o: subtract(to_tensor(o, dtype=None), self)
+Tensor.__mul__ = lambda self, o: multiply(self, o)
+Tensor.__rmul__ = lambda self, o: multiply(self, o)
+Tensor.__truediv__ = lambda self, o: divide(self, o)
+Tensor.__rtruediv__ = lambda self, o: divide(to_tensor(o, dtype=None), self)
+Tensor.__floordiv__ = lambda self, o: floor_divide(self, o)
+Tensor.__rfloordiv__ = lambda self, o: floor_divide(to_tensor(o), self)
+Tensor.__mod__ = lambda self, o: remainder(self, o)
+Tensor.__rmod__ = lambda self, o: remainder(to_tensor(o), self)
+Tensor.__pow__ = lambda self, o: pow(self, o)
+Tensor.__rpow__ = lambda self, o: pow(to_tensor(o), self)
+Tensor.__neg__ = lambda self: neg(self)
+Tensor.__abs__ = lambda self: abs(self)
+Tensor.__matmul__ = lambda self, o: matmul(self, o)
+Tensor.__rmatmul__ = lambda self, o: matmul(o, self)
+Tensor.__lt__ = lambda self, o: less_than(self, o)
+Tensor.__le__ = lambda self, o: less_equal(self, o)
+Tensor.__gt__ = lambda self, o: greater_than(self, o)
+Tensor.__ge__ = lambda self, o: greater_equal(self, o)
+Tensor.__invert__ = lambda self: (
+    bitwise_not(self) if self.dtype != _np.dtype(_np.bool_) else logical_not(self)
+)
+Tensor.__and__ = lambda self, o: (
+    bitwise_and(self, o) if self.dtype != _np.dtype(_np.bool_) else logical_and(self, o)
+)
+Tensor.__or__ = lambda self, o: (
+    bitwise_or(self, o) if self.dtype != _np.dtype(_np.bool_) else logical_or(self, o)
+)
+Tensor.__xor__ = lambda self, o: (
+    bitwise_xor(self, o) if self.dtype != _np.dtype(_np.bool_) else logical_xor(self, o)
+)

@@ -1,0 +1,161 @@
+"""Op dispatch: the eager hot path.
+
+Reference analog: the generated `*_ad_func` forwards (fluid/eager/auto_code_generator/
+generator/eager_gen.py:367) that do AMP cast -> type promotion -> kernel dispatch -> GradNode
+creation, and the generated C++ API's kernel selection (phi/api/generator/api_base.py:1327).
+TPU-first redesign: every op is a pure jax function; "kernel launch" is jax primitive dispatch
+(each primitive is a cached tiny XLA executable); when grad is required the op is linearized
+with jax.vjp and the pullback recorded on the Python tape. Under graph capture the same
+functions trace into one HLO program, so there is exactly one op implementation for eager,
+jit, and SPMD execution.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd import tape
+from ..framework import flags
+from ..framework.core import Tensor
+
+_REGISTRY = {}
+
+
+class OpDef:
+    __slots__ = ("name", "fn", "differentiable", "amp_category")
+
+    def __init__(self, name, fn, differentiable=True, amp_category=None):
+        self.name = name
+        self.fn = fn
+        self.differentiable = differentiable
+        self.amp_category = amp_category
+
+
+def register_op(name, fn, differentiable=True, amp_category=None):
+    opdef = OpDef(name, fn, differentiable, amp_category)
+    _REGISTRY[name] = opdef
+    return opdef
+
+
+def get_registry():
+    return dict(_REGISTRY)
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def _check_nan_inf(name, vals):
+    for v in vals:
+        if hasattr(v, "dtype") and jnp.issubdtype(np.dtype(v.dtype), jnp.inexact):
+            bad = bool(jnp.any(~jnp.isfinite(v)))
+            if bad:
+                if flags.flag("check_nan_inf_level") > 0:
+                    print(f"[paddle_tpu] nan/inf detected in output of op {name}")
+                else:
+                    raise FloatingPointError(f"nan/inf detected in output of op {name}")
+
+
+def apply(opdef: OpDef, *args, **kwargs):
+    """Dispatch one op call. Tensor leaves anywhere in args/kwargs are traced inputs."""
+    # ---- AMP auto-cast (O1/O2), mirroring eager_gen.py:645 AMP_LOGIC_TEMPLATE ----
+    from ..amp.auto_cast import _amp_state, amp_cast_inputs
+
+    if _amp_state() is not None:
+        args, kwargs = amp_cast_inputs(opdef, args, kwargs)
+
+    leaves, treedef = jax.tree_util.tree_flatten(
+        (args, kwargs), is_leaf=_is_tensor
+    )
+    t_idx = [i for i, l in enumerate(leaves) if _is_tensor(l)]
+    t_leaves = [leaves[i] for i in t_idx]
+    vals = [t.value for t in t_leaves]
+    stop_flags = [t.stop_gradient for t in t_leaves]
+
+    fn = opdef.fn
+
+    def pure(*tvals):
+        buf = list(leaves)
+        for i, v, sg in zip(t_idx, tvals, stop_flags):
+            buf[i] = jax.lax.stop_gradient(v) if sg else v
+        a, k = jax.tree_util.tree_unflatten(treedef, buf)
+        out = fn(*a, **k)
+        return out if isinstance(out, tuple) else (out,)
+
+    requires_grad = (
+        opdef.differentiable
+        and tape.is_grad_enabled()
+        and any(not sg for sg in stop_flags)
+    )
+
+    if requires_grad:
+        out_vals, vjp_fn = jax.vjp(pure, *vals)
+    else:
+        out_vals = pure(*vals)
+
+    if flags.flag("check_nan_inf"):
+        _check_nan_inf(opdef.name, out_vals)
+
+    outputs = []
+    for v in out_vals:
+        sg = not (requires_grad and jnp.issubdtype(np.dtype(v.dtype), jnp.inexact))
+        outputs.append(Tensor(v, stop_gradient=sg))
+
+    if requires_grad:
+        out_avals = [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in out_vals]
+        tape.record(opdef.name, t_leaves, vjp_fn, pure, out_avals, outputs)
+
+    if len(outputs) == 1:
+        return outputs[0]
+    return tuple(outputs)
+
+
+def apply_raw(name, fn, tensor_args, n_outs=1):
+    """Tape-aware call where fn takes raw positional values (used by create_graph replay
+    and PyLayer)."""
+    vals = [t.value for t in tensor_args]
+    stop_flags = [t.stop_gradient for t in tensor_args]
+
+    def pure(*tvals):
+        tvals = [jax.lax.stop_gradient(v) if sg else v for v, sg in zip(tvals, stop_flags)]
+        out = fn(*tvals)
+        return tuple(out) if isinstance(out, (tuple, list)) else (out,)
+
+    requires_grad = tape.is_grad_enabled() and any(not sg for sg in stop_flags)
+    if requires_grad:
+        out_vals, vjp_fn = jax.vjp(pure, *vals)
+    else:
+        out_vals = pure(*vals)
+    outputs = []
+    for v in out_vals:
+        sg = not (requires_grad and jnp.issubdtype(np.dtype(v.dtype), jnp.inexact))
+        outputs.append(Tensor(v, stop_gradient=sg))
+    if requires_grad:
+        out_avals = [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in out_vals]
+        tape.record(name, list(tensor_args), vjp_fn, pure, out_avals, outputs)
+    return tuple(outputs)
+
+
+def defop(name, differentiable=True, amp_category=None):
+    """Decorator: define an op from its pure jax function and return the public wrapper.
+
+    The wrapped function receives raw jax values in place of Tensors; the public wrapper
+    accepts Tensors/python scalars and returns Tensors with autograd wired.
+    """
+
+    def deco(fn):
+        opdef = register_op(name, fn, differentiable, amp_category)
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            kwargs.pop("name", None)  # paddle APIs accept a cosmetic name= kwarg
+            return apply(opdef, *args, **kwargs)
+
+        wrapper.opdef = opdef
+        return wrapper
+
+    return deco

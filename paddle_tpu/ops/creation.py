@@ -1,0 +1,249 @@
+"""Tensor creation ops.
+
+Reference analog: python/paddle/tensor/creation.py (zeros/ones/full/arange/linspace/eye/...).
+All creation lowers to jnp constants; default float dtype comes from
+framework.dtype.get_default_dtype() (paddle default float32), integer default int64.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..framework import dtype as dtype_mod
+from ..framework.core import Tensor, to_tensor  # noqa: F401  (re-export)
+from ._apply import defop
+
+
+def _dt(dtype, default=None):
+    d = dtype_mod.convert_dtype(dtype)
+    if d is None:
+        d = default if default is not None else dtype_mod.get_default_dtype()
+    return d
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) if not isinstance(s, Tensor) else int(s.numpy()) for s in shape)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.numpy().item()
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = np.bool_
+        elif isinstance(fill_value, int):
+            dtype = np.int64
+        else:
+            dtype = dtype_mod.get_default_dtype()
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+@defop("zeros_like")
+def _zeros_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=dtype_mod.convert_dtype(dtype))
+
+
+@defop("ones_like")
+def _ones_like(x, dtype=None):
+    return jnp.ones_like(x, dtype=dtype_mod.convert_dtype(dtype))
+
+
+def zeros_like(x, dtype=None, name=None):
+    return _zeros_like(x, dtype=dtype)
+
+
+def ones_like(x, dtype=None, name=None):
+    return _ones_like(x, dtype=dtype)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    d = dtype_mod.convert_dtype(dtype) or x.dtype
+    return Tensor(jnp.full(x.value.shape, fill_value, d))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _scalar(v):
+        return v.numpy().item() if isinstance(v, Tensor) else v
+
+    start, end, step = _scalar(start), _scalar(end), _scalar(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = (
+            np.int64
+            if all(isinstance(v, (int, np.integer)) for v in (start, end, step))
+            else dtype_mod.get_default_dtype()
+        )
+    return Tensor(jnp.arange(start, end, step, _dt(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def _scalar(v):
+        return v.numpy().item() if isinstance(v, Tensor) else v
+
+    return Tensor(
+        jnp.linspace(_scalar(start), _scalar(stop), int(_scalar(num)), dtype=_dt(dtype))
+    )
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(float(start), float(stop), int(num), base=base, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows), num_columns and int(num_columns), dtype=_dt(dtype)))
+
+
+@defop("assign")
+def _assign(x):
+    return jnp.asarray(x)
+
+
+def assign(x, output=None):
+    if not isinstance(x, Tensor):
+        x = to_tensor(x)
+    out = _assign(x)
+    if output is not None:
+        output._replace_value(out.value)
+        return output
+    return out
+
+
+def clone(x):
+    return assign(x)
+
+
+@defop("tril")
+def _tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+@defop("triu")
+def _triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+def tril(x, diagonal=0, name=None):
+    return _tril(x, diagonal=int(diagonal))
+
+
+def triu(x, diagonal=0, name=None):
+    return _triu(x, diagonal=int(diagonal))
+
+
+def tril_indices(row, col=None, offset=0, dtype=np.int64):
+    col = col if col is not None else row
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]).astype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype=np.int64):
+    col = col if col is not None else row
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]).astype(dtype)))
+
+
+@defop("diag")
+def _diag(x, offset=0, padding_value=0):
+    if x.ndim == 1:
+        out = jnp.diag(x, k=offset)
+        if padding_value != 0:
+            mask = jnp.diag(jnp.ones_like(x, dtype=bool), k=offset)
+            out = jnp.where(mask, out, jnp.asarray(padding_value, out.dtype))
+        return out
+    return jnp.diagonal(x, offset=offset)
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    return _diag(x, offset=int(offset), padding_value=padding_value)
+
+
+def diagflat(x, offset=0, name=None):
+    return Tensor(jnp.diagflat(x.value, k=offset))
+
+
+@defop("diag_embed")
+def _diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    n = x.shape[-1]
+    m = n + abs(offset)
+    idx = jnp.arange(n)
+    rows = idx + max(-offset, 0)
+    cols = idx + max(offset, 0)
+    out = jnp.zeros(x.shape[:-1] + (m, m), x.dtype)
+    out = out.at[..., rows, cols].set(x)
+    nd = out.ndim
+    d1, d2 = dim1 % nd, dim2 % nd
+    if (d1, d2) != (nd - 2, nd - 1):
+        perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+        full = []
+        src = iter(perm)
+        for i in range(nd):
+            if i == d1:
+                full.append(nd - 2)
+            elif i == d2:
+                full.append(nd - 1)
+            else:
+                full.append(next(src))
+        out = jnp.transpose(out, full)
+    return out
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    return _diag_embed(x, offset=int(offset), dim1=int(dim1), dim2=int(dim2))
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    outs = jnp.meshgrid(*[a.value for a in args], indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+@defop("complex")
+def _complex(real, imag):
+    return jax.lax.complex(real, imag)
+
+
+import jax  # noqa: E402
+
+
+def complex(real, imag, name=None):  # noqa: A001
+    return _complex(real, imag)
+
+
+@defop("polar")
+def _polar(abs_, angle):
+    return jax.lax.complex(abs_ * jnp.cos(angle), abs_ * jnp.sin(angle))
+
+
+def polar(abs_, angle, name=None):
+    return _polar(abs_, angle)
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size, np.int64))
+
+
+def clone_detached(x):
+    return Tensor(x.value)

@@ -1,0 +1,17 @@
+"""einsum (reference: python/paddle/tensor/einsum.py) — delegates to jnp.einsum (MXU)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework import flags
+from ._apply import defop
+
+
+@defop("einsum", amp_category="white")
+def _einsum(operands, equation):
+    p = flags.flag("tpu_matmul_precision")
+    return jnp.einsum(equation, *operands, precision=None if p == "default" else p)
+
+
+def einsum(equation, *operands):
+    return _einsum(list(operands), equation=equation)

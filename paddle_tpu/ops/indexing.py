@@ -1,0 +1,95 @@
+"""__getitem__ / __setitem__ with paddle indexing semantics.
+
+Reference analog: the eager slice path in paddle/fluid/pybind/slice_utils.h +
+python/paddle/base/variable_index.py. Tensor indices become jnp advanced indexing; boolean
+mask indexing is dynamic-shape and therefore eager-only (same constraint XLA imposes).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ._apply import defop
+
+
+def _norm_index(idx):
+    """Convert a user index into (static_parts, tensor_parts) for the op call."""
+    if not isinstance(idx, tuple):
+        idx = (idx,)
+    out = []
+    for it in idx:
+        if isinstance(it, Tensor):
+            if np.dtype(it.dtype) == np.bool_:
+                # boolean mask: dynamic shape — materialize indices eagerly
+                out.append(Tensor(jnp.asarray(np.nonzero(it.numpy()))[0])
+                           if it.ndim == 1 else it)
+            else:
+                out.append(it)
+        elif isinstance(it, (list, np.ndarray)):
+            arr = np.asarray(it)
+            if arr.dtype == np.bool_:
+                out.append(Tensor(jnp.asarray(np.nonzero(arr)[0])))
+            else:
+                out.append(Tensor(jnp.asarray(arr)))
+        else:
+            out.append(it)
+    return tuple(out)
+
+
+@defop("getitem")
+def _getitem(x, idx):
+    return x[idx]
+
+
+def getitem(x, idx):
+    idx = _norm_index(idx)
+    # bool Tensor mask: dynamic-shape selection, eager-only (numpy semantics: a k-dim mask
+    # selects cells over the first k dims, result [n_true, *trailing_dims])
+    has_bool = any(isinstance(i, Tensor) and np.dtype(i.dtype) == np.bool_ for i in idx)
+    if has_bool:
+        if len(idx) == 1:
+            mask = idx[0]
+            from .manipulation import gather, masked_select, reshape
+
+            m = np.asarray(mask.numpy())
+            if m.ndim == x.ndim:
+                return masked_select(x, mask)
+            k = m.ndim
+            lead = int(np.prod(x.value.shape[:k]))
+            flat = reshape(x, [lead] + list(x.value.shape[k:]))
+            sel = Tensor(jnp.asarray(np.nonzero(m.reshape(-1))[0]))
+            return gather(flat, sel, axis=0)
+        raise NotImplementedError("mixed boolean advanced indexing")
+    return _getitem(x, idx=idx)
+
+
+@defop("setitem")
+def _setitem(x, idx, value):
+    return x.at[idx].set(jnp.asarray(value, x.dtype) if not hasattr(value, "dtype") else
+                         value.astype(x.dtype))
+
+
+def setitem_(x, idx, value):
+    """In-place x[idx] = value with autograd support (functional under the hood)."""
+    idx = _norm_index(idx)
+    has_bool = any(isinstance(i, Tensor) and np.dtype(i.dtype) == np.bool_ for i in idx)
+    if has_bool and len(idx) == 1:
+        from .manipulation import _masked_fill, _where
+
+        mask = idx[0]
+        if isinstance(value, Tensor):
+            # route through the op layer so autograd flows into both x and value
+            v = value.astype(x.dtype) if np.dtype(value.dtype) != x.dtype else value
+            out = _where(mask, v, x)
+        else:
+            out = _masked_fill(x, mask, value)
+    else:
+        if not isinstance(value, Tensor):
+            value = Tensor(jnp.asarray(value))
+        out = _setitem(x, idx, value)
+    x._replace_value(out.value)
+    x._grad_node, x._out_index = out._grad_node, out._out_index
+    x.stop_gradient = x.stop_gradient and out.stop_gradient
+    return x

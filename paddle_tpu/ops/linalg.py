@@ -1,0 +1,331 @@
+"""Linear algebra ops.
+
+Reference analog: python/paddle/tensor/linalg.py (matmul at linalg.py:220 routing to
+_C_ops.matmul) + paddle.linalg decompositions backed by cuSOLVER kernels. TPU-first: matmul
+is THE MXU op; precision is controlled by FLAGS_tpu_matmul_precision (bf16 inputs hit the MXU
+natively). Decompositions lower to XLA's linalg ops (QR/SVD/Cholesky/Eigh run on-device;
+general eig falls back to host lapack like jax does).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import flags
+from ..framework.core import Tensor
+from ._apply import defop
+
+
+def _precision():
+    p = flags.flag("tpu_matmul_precision")
+    return None if p == "default" else p
+
+
+@defop("matmul", amp_category="white")
+def _matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        if x.ndim == 1:
+            pass
+        else:
+            x = jnp.swapaxes(x, -1, -2)
+    if transpose_y:
+        if y.ndim == 1:
+            pass
+        else:
+            y = jnp.swapaxes(y, -1, -2)
+    return jnp.matmul(x, y, precision=_precision())
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    return _matmul(x, y, transpose_x=bool(transpose_x), transpose_y=bool(transpose_y))
+
+
+mm = matmul
+
+
+@defop("bmm", amp_category="white")
+def bmm(x, y):
+    return jnp.matmul(x, y, precision=_precision())
+
+
+@defop("mv", amp_category="white")
+def mv(x, vec):
+    return jnp.matmul(x, vec, precision=_precision())
+
+
+@defop("multi_dot", amp_category="white")
+def _multi_dot(xs):
+    return jnp.linalg.multi_dot(xs, precision=_precision())
+
+
+def multi_dot(x, name=None):
+    return _multi_dot(list(x))
+
+
+@defop("cholesky")
+def _cholesky(x, upper=False):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2).conj() if upper else L
+
+
+def cholesky(x, upper=False, name=None):
+    return _cholesky(x, upper=bool(upper))
+
+
+@defop("cholesky_solve")
+def _cholesky_solve(x, y, upper=False):
+    if upper:
+        y = jnp.swapaxes(y, -1, -2).conj()
+    z = jax.scipy.linalg.cho_solve((y, True), x)
+    return z
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    return _cholesky_solve(x, y, upper=bool(upper))
+
+
+@defop("cholesky_inverse")
+def _cholesky_inverse(x, upper=False):
+    L = jnp.swapaxes(x, -1, -2).conj() if upper else x
+    eye = jnp.eye(L.shape[-1], dtype=L.dtype)
+    inv = jax.scipy.linalg.cho_solve((L, True), eye)
+    return inv
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    return _cholesky_inverse(x, upper=bool(upper))
+
+
+@defop("qr")
+def _qr(x, mode="reduced"):
+    q, r = jnp.linalg.qr(x, mode=mode)
+    return q, r
+
+
+def qr(x, mode="reduced", name=None):
+    if mode == "r":
+        r = jnp.linalg.qr(x.value, mode="r")
+        return Tensor(r)
+    return _qr(x, mode=mode)
+
+
+@defop("svd")
+def _svd(x, full_matrices=False):
+    u, s, vh = jnp.linalg.svd(x, full_matrices=full_matrices)
+    return u, s, vh
+
+
+def svd(x, full_matrices=False, name=None):
+    u, s, vh = _svd(x, full_matrices=bool(full_matrices))
+    return u, s, vh
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    u, s, vh = _svd(x, full_matrices=False)
+    from .manipulation import transpose
+
+    q = min(q, s.value.shape[-1])
+    return u[..., :q], s[..., :q], transpose(vh, list(range(vh.ndim - 2)) + [vh.ndim - 1, vh.ndim - 2])[..., :q]
+
+
+@defop("eigh")
+def _eigh(x, UPLO="L"):
+    w, v = jnp.linalg.eigh(x, symmetrize_input=True)
+    return w, v
+
+
+def eigh(x, UPLO="L", name=None):
+    return _eigh(x, UPLO=UPLO)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    w, _ = _eigh(x, UPLO=UPLO)
+    return w
+
+
+def eig(x, name=None):
+    # general eig is host-lapack in jax (CPU only); keep eager
+    w, v = np.linalg.eig(np.asarray(x.numpy()))
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigvals(x, name=None):
+    w = np.linalg.eigvals(np.asarray(x.numpy()))
+    return Tensor(jnp.asarray(w))
+
+
+@defop("inverse")
+def inv(x):
+    return jnp.linalg.inv(x)
+
+
+inverse = inv
+
+
+@defop("pinv")
+def _pinv(x, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return _pinv(x, rcond=float(rcond), hermitian=bool(hermitian))
+
+
+@defop("solve")
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+@defop("triangular_solve")
+def _triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular
+    )
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    return _triangular_solve(x, y, upper=bool(upper), transpose=bool(transpose),
+                             unitriangular=bool(unitriangular))
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x.value, y.value, rcond=rcond)
+    return Tensor(sol), Tensor(res), Tensor(jnp.asarray(rank)), Tensor(sv)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    lu_mat, piv = jax.scipy.linalg.lu_factor(x.value)
+    piv = piv.astype(jnp.int32) + 1  # paddle returns 1-based pivots
+    if get_infos:
+        info = jnp.zeros((), jnp.int32)
+        return Tensor(lu_mat), Tensor(piv), Tensor(info)
+    return Tensor(lu_mat), Tensor(piv)
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    lu_mat = x.value
+    m, n = lu_mat.shape[-2:]
+    k = min(m, n)
+    L = jnp.tril(lu_mat[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_mat.dtype)
+    U = jnp.triu(lu_mat[..., :k, :])
+    piv = np.asarray(y.numpy()) - 1
+    P = np.eye(m)
+    perm = np.arange(m)
+    for i, p in enumerate(piv):
+        perm[[i, p]] = perm[[p, i]]
+    P = P[:, perm]
+    return Tensor(jnp.asarray(P, lu_mat.dtype)), Tensor(L), Tensor(U)
+
+
+@defop("det")
+def det(x):
+    return jnp.linalg.det(x)
+
+
+@defop("slogdet")
+def _slogdet(x):
+    sign, logdet = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logdet])
+
+
+def slogdet(x, name=None):
+    return _slogdet(x)
+
+
+@defop("matrix_power")
+def _matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+def matrix_power(x, n, name=None):
+    return _matrix_power(x, n=int(n))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    if tol is not None and isinstance(tol, Tensor):
+        tol = float(tol.numpy())
+    return Tensor(jnp.linalg.matrix_rank(x.value, rtol=tol).astype(jnp.int64))
+
+
+def cond(x, p=None, name=None):
+    return Tensor(jnp.linalg.cond(x.value, p=p))
+
+
+@defop("matrix_exp")
+def matrix_exp(x):
+    return jax.scipy.linalg.expm(x)
+
+
+@defop("householder_product")
+def householder_product(x, tau):
+    m, n = x.shape[-2], x.shape[-1]
+
+    def one(mat, t):
+        q = jnp.eye(m, dtype=mat.dtype)
+        for i in range(n):
+            v = jnp.concatenate([jnp.zeros(i, mat.dtype), jnp.ones(1, mat.dtype), mat[i + 1 :, i]])
+            q = q @ (jnp.eye(m, dtype=mat.dtype) - t[i] * jnp.outer(v, v))
+        return q[:, :n]
+
+    if x.ndim == 2:
+        return one(x, tau)
+    batch = x.reshape((-1,) + x.shape[-2:])
+    taub = tau.reshape((-1, tau.shape[-1]))
+    outs = jnp.stack([one(batch[i], taub[i]) for i in range(batch.shape[0])])
+    return outs.reshape(x.shape[:-2] + (m, n))
+
+
+@defop("corrcoef")
+def _corrcoef(x, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return _corrcoef(x, rowvar=bool(rowvar))
+
+
+@defop("cov")
+def _cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0, fweights=fweights,
+                   aweights=aweights)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return _cov(x, rowvar=bool(rowvar), ddof=bool(ddof), fweights=fweights, aweights=aweights)
+
+
+@defop("histogram", differentiable=False)
+def _histogram(x, bins=100, min=0, max=0, weight=None, density=False):  # noqa: A002
+    rng = None if (min == 0 and max == 0) else (min, max)
+    hist, _ = jnp.histogram(x.reshape(-1), bins=bins, range=rng,
+                            weights=None if weight is None else weight.reshape(-1),
+                            density=density)
+    return hist
+
+
+def histogram(x, bins=100, min=0, max=0, weight=None, density=False, name=None):  # noqa: A002
+    return _histogram(x, bins=int(bins), min=min, max=max, weight=weight, density=density)
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    hist, edges = jnp.histogramdd(x.value, bins=bins, range=ranges, density=density,
+                                  weights=None if weights is None else weights.value)
+    return Tensor(hist), [Tensor(e) for e in edges]
+
+
+@defop("bincount", differentiable=False)
+def _bincount(x, weights=None, minlength=0):
+    return jnp.bincount(x, weights=weights, minlength=minlength,
+                        length=None)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    # dynamic output length: eager-only
+    from .manipulation import _require_concrete
+
+    _require_concrete(x, "bincount")
+    length = max(int(np.asarray(x.numpy()).max(initial=-1)) + 1, minlength)
+    return Tensor(jnp.bincount(x.value, weights=None if weights is None else weights.value,
+                               minlength=minlength, length=length))

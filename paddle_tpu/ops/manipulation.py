@@ -1,0 +1,682 @@
+"""Shape/layout manipulation ops.
+
+Reference analog: python/paddle/tensor/manipulation.py backed by phi stride/view kernels
+(phi/kernels/stride/). On TPU all of these are free or cheap under XLA (reshape/transpose
+fold into surrounding fusions); there is no stride concept to manage.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtype as dtype_mod
+from ..framework.core import Tensor
+from ._apply import defop
+
+
+def _ints(seq):
+    if isinstance(seq, Tensor):
+        return tuple(int(v) for v in np.atleast_1d(seq.numpy()))
+    if isinstance(seq, (int, np.integer)):
+        return (int(seq),)
+    return tuple(int(v) if not isinstance(v, Tensor) else int(v.numpy()) for v in seq)
+
+
+@defop("cast")
+def _cast(x, dtype):
+    return jax.lax.convert_element_type(x, dtype)
+
+
+def cast(x, dtype):
+    d = dtype_mod.convert_dtype(dtype)
+    if np.dtype(x.dtype) == d:
+        from .creation import assign
+
+        return assign(x)
+    return _cast(x, dtype=d)
+
+
+@defop("reshape")
+def _reshape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+def reshape(x, shape, name=None):
+    shape = list(_ints(shape))
+    # paddle semantics: 0 means "copy dim from input"
+    for i, s in enumerate(shape):
+        if s == 0:
+            shape[i] = x.value.shape[i]
+    return _reshape(x, shape=tuple(shape))
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._replace_value(out.value)
+    x._grad_node, x._out_index, x.stop_gradient = out._grad_node, out._out_index, out.stop_gradient
+    return x
+
+
+view = reshape
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+@defop("transpose")
+def _transpose(x, perm):
+    return jnp.transpose(x, perm)
+
+
+def transpose(x, perm, name=None):
+    return _transpose(x, perm=_ints(perm))
+
+
+def t(x, name=None):
+    if x.ndim < 2:
+        from .creation import assign
+
+        return assign(x)
+    return transpose(x, [1, 0])
+
+
+@defop("concat")
+def _concat(xs, axis=0):
+    return jnp.concatenate(xs, axis=axis)
+
+
+def concat(x, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.numpy())
+    return _concat(list(x), axis=axis)
+
+
+@defop("stack")
+def _stack(xs, axis=0):
+    return jnp.stack(xs, axis=axis)
+
+
+def stack(x, axis=0, name=None):
+    return _stack(list(x), axis=int(axis))
+
+
+@defop("split_op")
+def _split(x, indices, axis):
+    return tuple(jnp.split(x, indices, axis=axis))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(axis if not isinstance(axis, Tensor) else axis.numpy())
+    dim = x.value.shape[axis]
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        indices = [dim // n * i for i in range(1, n)]
+    else:
+        secs = list(_ints(num_or_sections))
+        total_known = sum(s for s in secs if s > 0)
+        secs = [s if s > 0 else dim - total_known for s in secs]
+        indices = list(np.cumsum(secs)[:-1])
+    out = _split(x, indices=tuple(int(i) for i in indices), axis=axis)
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, int(chunks), axis)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    if isinstance(num_or_indices, int):
+        outs = jnp.array_split(x.value, num_or_indices, axis=int(axis))
+        return [Tensor(o, stop_gradient=x.stop_gradient) for o in outs]
+    # list = cut indices (numpy array_split semantics), NOT section sizes
+    cuts = list(_ints(num_or_indices))
+    out = _split(x, indices=tuple(cuts), axis=int(axis))
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+@defop("squeeze")
+def _squeeze(x, axis=None):
+    return jnp.squeeze(x, axis=axis)
+
+
+def squeeze(x, axis=None, name=None):
+    if axis is not None:
+        ax = _ints(axis)
+        ax = tuple(a for a in ax if x.value.shape[a] == 1)
+        if not ax:
+            from .creation import assign
+
+            return assign(x)
+        return _squeeze(x, axis=ax)
+    return _squeeze(x, axis=None)
+
+
+squeeze_ = squeeze
+
+
+@defop("unsqueeze")
+def _unsqueeze(x, axis):
+    return jnp.expand_dims(x, axis)
+
+
+def unsqueeze(x, axis, name=None):
+    return _unsqueeze(x, axis=_ints(axis))
+
+
+unsqueeze_ = unsqueeze
+
+
+@defop("flatten_op")
+def _flatten(x, start_axis=0, stop_axis=-1):
+    shape = x.shape
+    nd = len(shape)
+    sa = start_axis % nd if nd else 0
+    ea = stop_axis % nd if nd else 0
+    new_shape = shape[:sa] + (int(np.prod(shape[sa : ea + 1] or (1,))),) + shape[ea + 1 :]
+    return jnp.reshape(x, new_shape)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    return _flatten(x, start_axis=int(start_axis), stop_axis=int(stop_axis))
+
+
+@defop("tile")
+def _tile(x, repeat_times):
+    return jnp.tile(x, repeat_times)
+
+
+def tile(x, repeat_times, name=None):
+    return _tile(x, repeat_times=_ints(repeat_times))
+
+
+@defop("expand")
+def _expand(x, shape):
+    shape = list(shape)
+    nd = len(shape)
+    xshape = (1,) * (nd - x.ndim) + x.shape
+    for i, s in enumerate(shape):
+        if s == -1:
+            shape[i] = xshape[i]
+    return jnp.broadcast_to(jnp.reshape(x, xshape), tuple(shape))
+
+
+def expand(x, shape, name=None):
+    return _expand(x, shape=_ints(shape))
+
+
+broadcast_to = expand
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    vals = jnp.broadcast_arrays(*[t.value for t in inputs])
+    return [Tensor(v, stop_gradient=i.stop_gradient) for v, i in zip(vals, inputs)]
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+@defop("flip")
+def _flip(x, axis):
+    return jnp.flip(x, axis=axis)
+
+
+def flip(x, axis, name=None):
+    return _flip(x, axis=_ints(axis))
+
+
+@defop("rot90")
+def _rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=axes)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return _rot90(x, k=int(k), axes=tuple(_ints(axes)))
+
+
+@defop("roll")
+def _roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def roll(x, shifts, axis=None, name=None):
+    return _roll(x, shifts=_ints(shifts) if not isinstance(shifts, int) else shifts,
+                 axis=_ints(axis) if axis is not None else None)
+
+
+@defop("gather")
+def _gather(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.numpy())
+    idx = index
+    if idx.ndim == 2 and idx.value.shape[1] == 1:
+        idx = idx.reshape([-1])
+    return _gather(x, idx, axis=int(axis))
+
+
+@defop("gather_nd")
+def _gather_nd(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+def gather_nd(x, index, name=None):
+    return _gather_nd(x, index)
+
+
+@defop("scatter_op")
+def _scatter(x, index, updates, overwrite=True):
+    if index.ndim == 2:
+        index = index[:, 0]
+    if overwrite:
+        return x.at[index].set(updates)
+    # paddle overwrite=False: zero the rows then accumulate
+    zeroed = x.at[index].set(jnp.zeros_like(updates))
+    return zeroed.at[index].add(updates)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    return _scatter(x, index, updates, overwrite=bool(overwrite))
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    out = scatter(x, index, updates, overwrite)
+    x._replace_value(out.value)
+    x._grad_node, x._out_index, x.stop_gradient = out._grad_node, out._out_index, out.stop_gradient
+    return x
+
+
+@defop("scatter_nd_add")
+def _scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    return _scatter_nd_add(x, index, updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+
+    base = zeros(shape, dtype=dtype_mod.dtype_name(updates.dtype))
+    return scatter_nd_add(base, index, updates)
+
+
+@defop("index_select")
+def _index_select(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+def index_select(x, index, axis=0, name=None):
+    return _index_select(x, index, axis=int(axis))
+
+
+@defop("index_sample")
+def _index_sample(x, index):
+    rows = jnp.arange(x.shape[0])[:, None]
+    return x[rows, index]
+
+
+def index_sample(x, index, name=None):
+    return _index_sample(x, index)
+
+
+@defop("index_add")
+def _index_add(x, index, value, axis=0):
+    xm = jnp.moveaxis(x, axis, 0)
+    vm = jnp.moveaxis(value, axis, 0)
+    out = xm.at[index].add(vm)
+    return jnp.moveaxis(out, 0, axis)
+
+
+def index_add(x, index, axis, value, name=None):
+    return _index_add(x, index, value, axis=int(axis))
+
+
+@defop("index_put")
+def _index_put(x, indices, value, accumulate=False):
+    idx = tuple(indices)
+    if accumulate:
+        return x.at[idx].add(value)
+    return x.at[idx].set(value)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    return _index_put(x, tuple(indices), value, accumulate=bool(accumulate))
+
+
+@defop("index_fill")
+def _index_fill(x, index, value, axis=0):
+    xm = jnp.moveaxis(x, axis, 0)
+    out = xm.at[index].set(jnp.asarray(value, x.dtype))
+    return jnp.moveaxis(out, 0, axis)
+
+
+def index_fill(x, index, axis, value, name=None):
+    return _index_fill(x, index, value, axis=int(axis))
+
+
+@defop("masked_fill")
+def _masked_fill(x, mask, value):
+    return jnp.where(mask, jnp.asarray(value, x.dtype), x)
+
+
+def masked_fill(x, mask, value, name=None):
+    if isinstance(value, Tensor):
+        return _masked_fill(x, mask, value.value.astype(x.value.dtype))
+    return _masked_fill(x, mask, value)
+
+
+@defop("where_op")
+def _where(condition, x, y):
+    return jnp.where(condition, x, y)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return _where(condition, x, y)
+
+
+@defop("take_along_axis")
+def _take_along_axis(x, indices, axis):
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return _take_along_axis(arr, indices, axis=int(axis))
+
+
+@defop("put_along_axis")
+def _put_along_axis(x, indices, values, axis, reduce="assign", include_self=True):
+    if reduce == "assign":
+        return jnp.put_along_axis(x, indices, values, axis=axis, inplace=False)
+    base = x if include_self else jnp.put_along_axis(
+        x, indices, jnp.zeros_like(values), axis=axis, inplace=False
+    )
+    if reduce in ("add", "sum"):
+        # scatter-add along axis
+        xm = jnp.moveaxis(base, axis, -1)
+        im = jnp.moveaxis(jnp.broadcast_to(indices, x.shape), axis, -1)
+        vm = jnp.moveaxis(jnp.broadcast_to(values, x.shape), axis, -1)
+        flat_x = xm.reshape(-1, xm.shape[-1])
+        flat_i = im.reshape(-1, im.shape[-1])
+        flat_v = vm.reshape(-1, vm.shape[-1])
+        rows = jnp.arange(flat_x.shape[0])[:, None]
+        out = flat_x.at[rows, flat_i].add(flat_v)
+        return jnp.moveaxis(out.reshape(xm.shape), -1, axis)
+    if reduce in ("mul", "multiply"):
+        xm = jnp.moveaxis(base, axis, -1)
+        im = jnp.moveaxis(jnp.broadcast_to(indices, x.shape), axis, -1)
+        vm = jnp.moveaxis(jnp.broadcast_to(values, x.shape), axis, -1)
+        flat_x = xm.reshape(-1, xm.shape[-1])
+        flat_i = im.reshape(-1, im.shape[-1])
+        flat_v = vm.reshape(-1, vm.shape[-1])
+        rows = jnp.arange(flat_x.shape[0])[:, None]
+        out = flat_x.at[rows, flat_i].multiply(flat_v)
+        return jnp.moveaxis(out.reshape(xm.shape), -1, axis)
+    raise ValueError(f"unknown reduce {reduce}")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True,
+                   broadcast=True, name=None):
+    if not isinstance(values, Tensor):
+        values = Tensor(jnp.asarray(values, arr.value.dtype))
+    idx = indices
+    if broadcast:
+        tgt = list(arr.shape)
+        tgt[int(axis)] = idx.value.shape[int(axis)]
+        idx = Tensor(jnp.broadcast_to(idx.value, tuple(tgt)))
+        values = Tensor(jnp.broadcast_to(values.value, tuple(tgt)), stop_gradient=values.stop_gradient)
+    return _put_along_axis(arr, idx, values, axis=int(axis), reduce=reduce,
+                           include_self=bool(include_self))
+
+
+@defop("repeat_interleave")
+def _repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        repeats = np.asarray(repeats.numpy())
+        total = int(repeats.sum())
+        return Tensor(
+            jnp.repeat(x.value, jnp.asarray(repeats), axis=axis, total_repeat_length=total),
+            stop_gradient=x.stop_gradient,
+        )
+    return _repeat_interleave(x, repeats=int(repeats), axis=axis)
+
+
+def unbind(x, axis=0, name=None):
+    n = x.value.shape[int(axis)]
+    outs = split(x, n, axis)
+    return [squeeze(o, [int(axis)]) for o in outs]
+
+
+unstack = unbind
+
+
+@defop("moveaxis")
+def _moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+def moveaxis(x, source, destination, name=None):
+    return _moveaxis(x, source=_ints(source), destination=_ints(destination))
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    perm = list(range(x.ndim))
+    perm[axis0], perm[axis1] = perm[axis1], perm[axis0]
+    return transpose(x, perm)
+
+
+transpose_last_2 = None
+
+
+@defop("as_strided")
+def _as_strided(x, shape, stride, offset=0):
+    flat = jnp.ravel(x)
+    idx = np.zeros(tuple(shape), dtype=np.int64) + offset
+    for dim, (s, st) in enumerate(zip(shape, stride)):
+        rng = np.arange(s) * st
+        idx = idx + rng.reshape([-1 if i == dim else 1 for i in range(len(shape))])
+    return flat[jnp.asarray(idx)]
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    return _as_strided(x, shape=_ints(shape), stride=_ints(stride), offset=int(offset))
+
+
+_py_slice = slice  # capture the builtin before the public `slice` op shadows it
+
+
+@defop("slice_op")
+def _slice(x, axes, starts, ends):
+    nd = x.ndim
+    idx = [_py_slice(None)] * nd
+    for a, s, e in zip(axes, starts, ends):
+        idx[a] = _py_slice(s, e)
+    return x[tuple(idx)]
+
+
+def slice(x, axes, starts, ends):  # noqa: A001
+    shape = x.value.shape
+    axes = _ints(axes)
+    starts = [int(s) if not isinstance(s, Tensor) else int(s.numpy()) for s in starts]
+    ends = [int(e) if not isinstance(e, Tensor) else int(e.numpy()) for e in ends]
+    norm_s, norm_e = [], []
+    for a, s, e in zip(axes, starts, ends):
+        n = shape[a]
+        s = s + n if s < 0 else s
+        e = e + n if e < 0 else e
+        norm_s.append(np.clip(s, 0, n))
+        norm_e.append(np.clip(e, 0, n))
+    return _slice(x, axes=tuple(axes), starts=tuple(int(v) for v in norm_s),
+                  ends=tuple(int(v) for v in norm_e))
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    idx = [_py_slice(None)] * x.ndim
+    for a, s, e, st in zip(_ints(axes), _ints(starts), _ints(ends), _ints(strides)):
+        idx[a] = _py_slice(s, e, st)
+    from .indexing import getitem
+
+    return getitem(x, tuple(idx))
+
+
+@defop("pad_op")
+def _pad(x, pad, mode="constant", value=0.0):
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # paddle NCHW convention: pad applies to trailing spatial dims, reversed pairs
+        k = len(pad) // 2
+        cfg = [(0, 0)] * (nd - k)
+        for i in range(k):
+            cfg.append((pad[2 * i], pad[2 * i + 1]))
+    if mode == "constant":
+        return jnp.pad(x, cfg, mode="constant", constant_values=value)
+    jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    return jnp.pad(x, cfg, mode=jmode)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
+    pad = list(_ints(pad))
+    nd = x.ndim
+    if len(pad) != 2 * nd:
+        # paddle's functional.pad: pad is [left,right,top,bottom,...] over spatial dims
+        k = len(pad) // 2
+        pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(k)][::-1]
+        if data_format.endswith("C") and nd >= 3:  # NHWC / NLC / NDHWC: spatial before channel
+            cfg = [(0, 0)] + list(pairs) + [(0, 0)]
+            cfg += [(0, 0)] * (nd - len(cfg))
+            flat = [v for p in cfg for v in p]
+            return _pad(x, pad=tuple(flat), mode=mode, value=float(value))
+        cfg = [(0, 0)] * (nd - k) + list(pairs)
+        flat = [v for p in cfg for v in p]
+        return _pad(x, pad=tuple(flat), mode=mode, value=float(value))
+    return _pad(x, pad=tuple(pad), mode=mode, value=float(value))
+
+
+# ---- dynamic-shape ops: eager-only (host round trip), error under trace ----
+def _require_concrete(x, opname):
+    if isinstance(x.value, jax.core.Tracer):
+        raise RuntimeError(
+            f"{opname} produces a data-dependent shape and cannot be captured in a static "
+            "program on TPU; compute it eagerly or use a masked formulation."
+        )
+
+
+def nonzero(x, as_tuple=False):
+    _require_concrete(x, "nonzero")
+    idx = np.nonzero(np.asarray(x.numpy()))
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i[:, None])) for i in idx)
+    return Tensor(jnp.asarray(np.stack(idx, axis=1).astype(np.int64)))
+
+
+def masked_select(x, mask, name=None):
+    _require_concrete(x, "masked_select")
+    m = np.asarray(mask.numpy()).astype(bool)
+    flat_idx = np.nonzero(np.broadcast_to(m, x.value.shape).reshape(-1))[0]
+    idx_t = Tensor(jnp.asarray(flat_idx))
+    return gather(reshape(x, [-1]), idx_t)
+
+
+@defop("masked_scatter")
+def _masked_scatter(x, mask, value):
+    cnt = jnp.cumsum(mask.reshape(-1).astype(jnp.int32)) - 1
+    flat_v = value.reshape(-1)
+    picked = flat_v[jnp.clip(cnt, 0, flat_v.shape[0] - 1)].reshape(x.shape)
+    return jnp.where(mask, picked, x)
+
+
+def masked_scatter(x, mask, value, name=None):
+    return _masked_scatter(x, mask, value)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None,
+           dtype="int64", name=None):
+    _require_concrete(x, "unique")
+    arr = np.asarray(x.numpy())
+    res = np.unique(arr, return_index=True, return_inverse=True, return_counts=True, axis=axis)
+    vals, index, inverse, counts = res
+    outs = [Tensor(jnp.asarray(vals))]
+    if return_index:
+        outs.append(Tensor(jnp.asarray(index.astype(np.int64))))
+    if return_inverse:
+        outs.append(Tensor(jnp.asarray(inverse.astype(np.int64))))
+    if return_counts:
+        outs.append(Tensor(jnp.asarray(counts.astype(np.int64))))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64",
+                       name=None):
+    _require_concrete(x, "unique_consecutive")
+    arr = np.asarray(x.numpy())
+    if axis is None:
+        arr = arr.reshape(-1)
+        keep = np.ones(arr.shape[0], bool)
+        keep[1:] = arr[1:] != arr[:-1]
+        vals = arr[keep]
+        inv = np.cumsum(keep) - 1
+        counts = np.diff(np.append(np.nonzero(keep)[0], arr.shape[0]))
+    else:
+        raise NotImplementedError("unique_consecutive over axis")
+    outs = [Tensor(jnp.asarray(vals))]
+    if return_inverse:
+        outs.append(Tensor(jnp.asarray(inv.astype(np.int64))))
+    if return_counts:
+        outs.append(Tensor(jnp.asarray(counts.astype(np.int64))))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [Tensor(jnp.atleast_1d(t.value), stop_gradient=t.stop_gradient) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [Tensor(jnp.atleast_2d(t.value), stop_gradient=t.stop_gradient) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [Tensor(jnp.atleast_3d(t.value), stop_gradient=t.stop_gradient) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shape = _ints(shape)
+    offsets = _ints(offsets) if offsets is not None else (0,) * x.ndim
+    idx = tuple(
+        _py_slice(o, o + (s if s != -1 else x.value.shape[i] - o))
+        for i, (o, s) in enumerate(zip(offsets, shape))
+    )
+    from .indexing import getitem
+
+    return getitem(x, idx)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):  # noqa: A002
+    size = index_num // nshards
+    lo, hi = shard_id * size, (shard_id + 1) * size
+    v = input.value
+    out = jnp.where((v >= lo) & (v < hi), v - lo, ignore_value)
+    return Tensor(out)

@@ -1,0 +1,177 @@
+"""Random sampling ops.
+
+Reference analog: python/paddle/tensor/random.py over phi RNG kernels seeded by per-device
+Generators. TPU-first: functional jax PRNG keys drawn from the global state
+(framework/random.py); under graph capture the key is threaded explicitly so compiled steps
+re-randomize per invocation.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtype as dtype_mod
+from ..framework import random as rng
+from ..framework.core import Tensor
+from ._apply import defop
+
+
+def _dt(dtype, default=None):
+    d = dtype_mod.convert_dtype(dtype)
+    return d if d is not None else (default or dtype_mod.get_default_dtype())
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) if not isinstance(s, Tensor) else int(s.numpy()) for s in shape)
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype=dtype, min=0.0, max=1.0)
+
+
+def randn(shape, dtype=None, name=None):
+    d = _dt(dtype)
+    return Tensor(jax.random.normal(rng.next_key(), _shape(shape), d))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean.value if isinstance(mean, Tensor) else mean
+        s = std.value if isinstance(std, Tensor) else std
+        out_shape = jnp.broadcast_shapes(
+            jnp.shape(m), jnp.shape(s)
+        )
+        d = jnp.result_type(m, s) if hasattr(m, "dtype") or hasattr(s, "dtype") else dtype_mod.get_default_dtype()
+        return Tensor(jax.random.normal(rng.next_key(), out_shape, d) * s + m)
+    shape = _shape(shape if shape is not None else [1])
+    d = dtype_mod.get_default_dtype()
+    return Tensor(jax.random.normal(rng.next_key(), shape, d) * std + mean)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):  # noqa: A002
+    d = _dt(dtype)
+    key = jax.random.key(seed) if seed else rng.next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), d, minval=float(min), maxval=float(max)))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):  # noqa: A002
+    out = uniform(x.shape, dtype_mod.dtype_name(x.dtype), min, max, seed)
+    x._replace_value(out.value)
+    return x
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(
+        jax.random.randint(rng.next_key(), _shape(shape), int(low), int(high), _dt(dtype, np.int64))
+    )
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    return randint(low, high, x.shape, dtype or dtype_mod.dtype_name(x.dtype))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(rng.next_key(), int(n)).astype(_dt(dtype, np.int64)))
+
+
+def bernoulli(x, name=None):
+    p = x.value
+    return Tensor(jax.random.bernoulli(rng.next_key(), p).astype(p.dtype))
+
+
+def bernoulli_(x, p=0.5, name=None):
+    out = jax.random.bernoulli(rng.next_key(), p, tuple(x.value.shape)).astype(x.value.dtype)
+    x._replace_value(out)
+    return x
+
+
+def poisson(x, name=None):
+    return Tensor(jax.random.poisson(rng.next_key(), x.value).astype(x.value.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    p = x.value
+    key = rng.next_key()
+    if p.ndim == 1:
+        out = jax.random.choice(
+            key, p.shape[0], (int(num_samples),), replace=bool(replacement), p=p / p.sum()
+        )
+        return Tensor(out.astype(np.int64))
+    keys = jax.random.split(key, p.shape[0])
+    outs = [
+        jax.random.choice(
+            keys[i], p.shape[1], (int(num_samples),), replace=bool(replacement),
+            p=p[i] / p[i].sum()
+        )
+        for i in range(p.shape[0])
+    ]
+    return Tensor(jnp.stack(outs).astype(np.int64))
+
+
+def exponential_(x, lam=1.0, name=None):
+    out = jax.random.exponential(rng.next_key(), tuple(x.value.shape), x.value.dtype) / lam
+    x._replace_value(out)
+    return x
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    out = loc + scale * jax.random.cauchy(rng.next_key(), tuple(x.value.shape), x.value.dtype)
+    x._replace_value(out)
+    return x
+
+
+def geometric_(x, probs, name=None):
+    u = jax.random.uniform(rng.next_key(), tuple(x.value.shape), jnp.float32)
+    out = jnp.ceil(jnp.log1p(-u) / np.log1p(-probs)).astype(x.value.dtype)
+    x._replace_value(out)
+    return x
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    out = jnp.exp(mean + std * jax.random.normal(rng.next_key(), tuple(x.value.shape), x.value.dtype))
+    x._replace_value(out)
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    out = mean + std * jax.random.normal(rng.next_key(), tuple(x.value.shape), x.value.dtype)
+    x._replace_value(out)
+    return x
+
+
+def rand_like(x, dtype=None, name=None):
+    return rand(x.shape, dtype or dtype_mod.dtype_name(x.dtype))
+
+
+def randn_like(x, dtype=None, name=None):
+    return randn(x.shape, dtype or dtype_mod.dtype_name(x.dtype))
+
+
+@defop("gumbel_softmax_inner")
+def _gs(x, g, temperature=1.0, hard=False, axis=-1):
+    y = jax.nn.softmax((x + g.astype(x.dtype)) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        y_hard = jnp.zeros_like(y)
+        y_hard = jnp.put_along_axis(y_hard, idx, jnp.ones_like(y, shape=idx.shape), axis=axis,
+                                    inplace=False)
+        # straight-through estimator: forward = y_hard, backward = softmax grad
+        y = y_hard - jax.lax.stop_gradient(y) + y
+    return y
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    g = -jnp.log(-jnp.log(jax.random.uniform(rng.next_key(), tuple(x.value.shape)) + 1e-20) + 1e-20)
+    return _gs(x, Tensor(g), temperature=float(temperature), hard=bool(hard), axis=int(axis))

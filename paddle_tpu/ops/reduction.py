@@ -1,0 +1,225 @@
+"""Reduction ops.
+
+Reference analog: python/paddle/tensor/math.py sum/mean/... and stat.py, backed by phi reduce
+kernels (phi/kernels/funcs/reduce_function.h). XLA maps these onto MXU/VPU reductions.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..framework import dtype as dtype_mod
+from ..framework.core import Tensor
+from ._apply import defop
+
+
+def _axes(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        a = axis.numpy()
+        return tuple(int(v) for v in np.atleast_1d(a))
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+@defop("sum")
+def _sum(x, axis=None, keepdim=False, dtype=None):
+    return jnp.sum(x, axis=axis, keepdims=keepdim, dtype=dtype)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    return _sum(x, axis=_axes(axis), keepdim=keepdim, dtype=dtype_mod.convert_dtype(dtype))
+
+
+@defop("mean")
+def _mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=axis, keepdims=keepdim)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return _mean(x, axis=_axes(axis), keepdim=keepdim)
+
+
+@defop("prod")
+def _prod(x, axis=None, keepdim=False, dtype=None):
+    return jnp.prod(x, axis=axis, keepdims=keepdim, dtype=dtype)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    return _prod(x, axis=_axes(axis), keepdim=keepdim, dtype=dtype_mod.convert_dtype(dtype))
+
+
+@defop("max")
+def _max(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=axis, keepdims=keepdim)
+
+
+def max(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return _max(x, axis=_axes(axis), keepdim=keepdim)
+
+
+@defop("min")
+def _min(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=axis, keepdims=keepdim)
+
+
+def min(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return _min(x, axis=_axes(axis), keepdim=keepdim)
+
+
+amax = max
+amin = min
+
+
+@defop("std")
+def _std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return _std(x, axis=_axes(axis), unbiased=unbiased, keepdim=keepdim)
+
+
+@defop("var")
+def _var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return _var(x, axis=_axes(axis), unbiased=unbiased, keepdim=keepdim)
+
+
+@defop("all", differentiable=False)
+def _all(x, axis=None, keepdim=False):
+    return jnp.all(x, axis=axis, keepdims=keepdim)
+
+
+def all(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return _all(x, axis=_axes(axis), keepdim=keepdim)
+
+
+@defop("any", differentiable=False)
+def _any(x, axis=None, keepdim=False):
+    return jnp.any(x, axis=axis, keepdims=keepdim)
+
+
+def any(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return _any(x, axis=_axes(axis), keepdim=keepdim)
+
+
+@defop("logsumexp")
+def _logsumexp(x, axis=None, keepdim=False):
+    import jax
+
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return _logsumexp(x, axis=_axes(axis), keepdim=keepdim)
+
+
+@defop("nansum")
+def _nansum(x, axis=None, keepdim=False, dtype=None):
+    return jnp.nansum(x, axis=axis, keepdims=keepdim, dtype=dtype)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return _nansum(x, axis=_axes(axis), keepdim=keepdim, dtype=dtype_mod.convert_dtype(dtype))
+
+
+@defop("nanmean")
+def _nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=axis, keepdims=keepdim)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return _nanmean(x, axis=_axes(axis), keepdim=keepdim)
+
+
+@defop("median")
+def _median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=axis, keepdims=keepdim)
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    if mode == "min":
+        n = x.value.shape[_axes(axis)] if axis is not None else x.size
+        k = (n - 1) // 2
+        sorted_x = jnp.sort(x.value, axis=_axes(axis) if axis is not None else None)
+        val = jnp.take(sorted_x, k, axis=_axes(axis) if axis is not None else 0)
+        return Tensor(val)
+    return _median(x, axis=_axes(axis), keepdim=keepdim)
+
+
+@defop("nanmedian")
+def _nanmedian(x, axis=None, keepdim=False):
+    return jnp.nanmedian(x, axis=axis, keepdims=keepdim)
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    return _nanmedian(x, axis=_axes(axis), keepdim=keepdim)
+
+
+@defop("quantile")
+def _quantile(x, q, axis=None, keepdim=False, interpolation="linear"):
+    return jnp.quantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim, method=interpolation)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    return _quantile(x, q, axis=_axes(axis), keepdim=keepdim, interpolation=interpolation)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return Tensor(jnp.nanquantile(x.value, jnp.asarray(q), axis=_axes(axis), keepdims=keepdim))
+
+
+@defop("count_nonzero", differentiable=False)
+def _count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=axis, keepdims=keepdim)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    out = _count_nonzero(x, axis=_axes(axis), keepdim=keepdim)
+    return out.astype(np.int64)
+
+
+@defop("norm_op")
+def _norm(x, p=None, axis=None, keepdim=False):
+    if p is None or p == "fro":
+        if axis is None:
+            return jnp.sqrt(jnp.sum(jnp.square(jnp.abs(x))))
+        return jnp.linalg.norm(x, ord=None if isinstance(axis, tuple) and len(axis) > 1 else None,
+                               axis=axis, keepdims=keepdim)
+    if p == "nuc":
+        return jnp.linalg.norm(x, ord="nuc", axis=axis, keepdims=keepdim)
+    if p == float("inf"):
+        r = jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+        return r
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    return jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=keepdim) ** (1.0 / p)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    return _norm(x, p=p, axis=_axes(axis), keepdim=keepdim)
+
+
+@defop("dist")
+def _dist(x, y, p=2.0):
+    d = x - y
+    if p == float("inf"):
+        return jnp.max(jnp.abs(d))
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(d))
+    if p == 0:
+        return jnp.sum((d != 0).astype(d.dtype))
+    return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+
+
+def dist(x, y, p=2.0, name=None):
+    return _dist(x, y, p=float(p))

@@ -1,0 +1,155 @@
+"""Search/sort ops.
+
+Reference analog: python/paddle/tensor/search.py (argmax/argsort/topk/...), phi kernels
+kernels/{cpu,gpu}/arg_*_kernel. Sorts/top-k lower to XLA's sort HLO.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtype as dtype_mod
+from ..framework.core import Tensor
+from ._apply import defop
+
+
+@defop("argmax", differentiable=False)
+def _argmax(x, axis=None, keepdim=False):
+    out = jnp.argmax(x, axis=axis)
+    if keepdim and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    out = _argmax(x, axis=axis if axis is None else int(axis), keepdim=keepdim)
+    return out.astype(dtype_mod.convert_dtype(dtype))
+
+
+@defop("argmin", differentiable=False)
+def _argmin(x, axis=None, keepdim=False):
+    out = jnp.argmin(x, axis=axis)
+    if keepdim and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    out = _argmin(x, axis=axis if axis is None else int(axis), keepdim=keepdim)
+    return out.astype(dtype_mod.convert_dtype(dtype))
+
+
+@defop("argsort", differentiable=False)
+def _argsort(x, axis=-1, descending=False, stable=False):
+    out = jnp.argsort(x, axis=axis, descending=descending, stable=stable or descending)
+    return out
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    return _argsort(x, axis=int(axis), descending=bool(descending), stable=bool(stable)).astype(
+        np.int64
+    )
+
+
+@defop("sort")
+def _sort(x, axis=-1, descending=False):
+    out = jnp.sort(x, axis=axis, descending=descending)
+    return out
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    return _sort(x, axis=int(axis), descending=bool(descending))
+
+
+@defop("topk")
+def _topk(x, k, axis=-1, largest=True, sorted=True):  # noqa: A002
+    if axis != -1 and axis != x.ndim - 1:
+        xm = jnp.moveaxis(x, axis, -1)
+        v, i = jax.lax.top_k(xm if largest else -xm, k)
+        if not largest:
+            v = -v
+        return jnp.moveaxis(v, -1, axis), jnp.moveaxis(i, -1, axis)
+    v, i = jax.lax.top_k(x if largest else -x, k)
+    if not largest:
+        v = -v
+    return v, i
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):  # noqa: A002
+    if isinstance(k, Tensor):
+        k = int(k.numpy())
+    v, i = _topk(x, k=int(k), axis=int(axis), largest=bool(largest), sorted=bool(sorted))
+    return v, i.astype(np.int64)
+
+
+@defop("kthvalue")
+def _kthvalue(x, k, axis=-1, keepdim=False):
+    s = jnp.sort(x, axis=axis)
+    si = jnp.argsort(x, axis=axis)
+    v = jnp.take(s, k - 1, axis=axis)
+    i = jnp.take(si, k - 1, axis=axis)
+    if keepdim:
+        v = jnp.expand_dims(v, axis)
+        i = jnp.expand_dims(i, axis)
+    return v, i
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    v, i = _kthvalue(x, k=int(k), axis=int(axis), keepdim=bool(keepdim))
+    return v, i.astype(np.int64)
+
+
+@defop("mode_op")
+def _mode(x, axis=-1, keepdim=False):
+    def mode_1d(v):
+        sorted_v = jnp.sort(v)
+        n = v.shape[0]
+        first = jnp.concatenate([jnp.array([True]), sorted_v[1:] != sorted_v[:-1]])
+        grp = jnp.cumsum(first) - 1
+        counts = jnp.zeros(n, jnp.int32).at[grp].add(1)
+        runcnt = counts[grp]
+        best = jnp.argmax(runcnt)  # first index of the longest run: ties -> smallest value
+        val = sorted_v[best]
+        idx = jnp.argmax(jnp.where(v == val, jnp.arange(n), -1))
+        return val, idx
+
+    xm = jnp.moveaxis(x, axis, -1)
+    flat = xm.reshape(-1, xm.shape[-1])
+    vals, idxs = jax.vmap(mode_1d)(flat)
+    vals = vals.reshape(xm.shape[:-1])
+    idxs = idxs.reshape(xm.shape[:-1])
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idxs = jnp.expand_dims(idxs, axis)
+    return vals, idxs
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    v, i = _mode(x, axis=int(axis), keepdim=bool(keepdim))
+    return v, i.astype(np.int64)
+
+
+@defop("searchsorted", differentiable=False)
+def _searchsorted(sorted_sequence, values, right=False):
+    side = "right" if right else "left"
+    if sorted_sequence.ndim == 1:
+        return jnp.searchsorted(sorted_sequence, values, side=side)
+    flat_seq = sorted_sequence.reshape(-1, sorted_sequence.shape[-1])
+    flat_val = values.reshape(-1, values.shape[-1])
+    out = jax.vmap(lambda s, v: jnp.searchsorted(s, v, side=side))(flat_seq, flat_val)
+    return out.reshape(values.shape)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    out = _searchsorted(sorted_sequence, values, right=bool(right))
+    return out.astype(np.int32 if out_int32 else np.int64)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def index_of_max(x):
+    return argmax(x)

@@ -1,0 +1,21 @@
+"""Test config: force an 8-device virtual CPU mesh BEFORE jax backends initialize.
+
+Mirrors the reference's test strategy (SURVEY.md §4): distributed features are tested
+single-host on a fake multi-device backend (their fake_cpu_device / gloo path; here XLA-CPU
+with --xla_force_host_platform_device_count=8).
+
+Note: this environment's sitecustomize registers a TPU PJRT plugin and forces
+jax_platforms='axon,cpu' in every process; jax.config.update('jax_platforms', 'cpu') after
+import (but before backend init) restores a pure-CPU test environment without touching the
+TPU tunnel.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,144 @@
+"""Autograd tape tests (reference analog: test/legacy_test OpTest grad checks +
+test_imperative_* backward tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_chain_and_accumulate():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    z1 = y.sum()
+    z2 = (y * y).sum()
+    loss = z1 + z2
+    loss.backward()
+    # d/dx (2x + 4x^2) = 2 + 8x
+    np.testing.assert_allclose(x.grad.numpy(), [10.0, 18.0])
+
+
+def test_backward_twice_accumulates():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 3).sum().backward()
+    (x * 2).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+
+def test_matmul_grad():
+    a = np.random.rand(3, 4).astype(np.float32)
+    b = np.random.rand(4, 2).astype(np.float32)
+    x = paddle.to_tensor(a, stop_gradient=False)
+    w = paddle.to_tensor(b, stop_gradient=False)
+    paddle.matmul(x, w).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.ones((3, 2)) @ b.T, rtol=1e-5)
+    np.testing.assert_allclose(w.grad.numpy(), a.T @ np.ones((3, 2)), rtol=1e-5)
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0], stop_gradient=True)
+    (x * y).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_detach():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = (x * 2).detach()
+    assert y.stop_gradient
+    z = x * y
+    z.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0])
+
+
+def test_no_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+
+
+def test_grad_api():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x * x
+    (gx,) = paddle.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), [12.0])
+    assert x.grad is None  # paddle.grad does not write .grad
+
+
+def test_double_grad():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x * x
+    (gx,) = paddle.grad(y, x, create_graph=True)
+    assert not gx.stop_gradient
+    (ggx,) = paddle.grad(gx, x)
+    np.testing.assert_allclose(ggx.numpy(), [12.0])  # d2/dx2 x^3 = 6x
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor([3.0, 1.0, 2.0], stop_gradient=False)
+    v, i = paddle.topk(x, 2)
+    v.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.0, 0.0, 1.0])
+
+
+def test_retain_graph_error():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).sum()
+    y.backward(retain_graph=True)
+    y.backward()  # second time OK because first retained
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_tensor_hook():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    seen = []
+    y.register_hook(lambda g: seen.append(g.numpy().copy()))
+    y.sum().backward()
+    assert seen and seen[0][0] == 1.0
+
+
+def test_hook_modifies_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    y.register_hook(lambda g: g * 10)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [20.0])
+
+
+def test_pylayer():
+    class Double(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, gy):
+            return gy * 2
+
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = Double.apply(x)
+    y.sum().backward()
+    np.testing.assert_allclose(y.numpy(), [6.0])
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_branching_graph():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    a = x * 2
+    b = x * 3
+    c = a + b
+    d = a * b
+    (c.sum() + d.sum()).backward()
+    # d/dx (5x + 6x^2) = 5 + 12x
+    np.testing.assert_allclose(x.grad.numpy(), [17.0, 29.0])

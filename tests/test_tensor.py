@@ -1,0 +1,124 @@
+"""Core Tensor + op tests (reference analog: test/legacy_test per-op numeric tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_basic():
+    t = paddle.to_tensor([1.0, 2.0, 3.0])
+    assert t.shape == [3]
+    assert t.dtype == np.float32
+    np.testing.assert_allclose(t.numpy(), [1, 2, 3])
+
+
+def test_default_int_dtype():
+    t = paddle.to_tensor([1, 2, 3])
+    assert t.dtype == np.int64
+
+
+def test_arith_and_broadcast():
+    a = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    b = paddle.to_tensor(np.ones((3,), dtype=np.float32))
+    c = a + b * 2 - 1
+    np.testing.assert_allclose(c.numpy(), np.arange(6).reshape(2, 3) + 1)
+    assert (a * 2.0).dtype == np.float32  # weak scalar does not upcast
+
+
+def test_matmul():
+    a = paddle.to_tensor(np.random.rand(4, 5).astype(np.float32))
+    b = paddle.to_tensor(np.random.rand(5, 3).astype(np.float32))
+    np.testing.assert_allclose((a @ b).numpy(), a.numpy() @ b.numpy(), rtol=1e-5)
+
+
+def test_reshape_transpose_concat():
+    a = paddle.arange(12).reshape([3, 4])
+    b = paddle.transpose(a, [1, 0])
+    assert b.shape == [4, 3]
+    c = paddle.concat([a, a], axis=0)
+    assert c.shape == [6, 4]
+    s = paddle.split(c, 2, axis=0)
+    assert len(s) == 2 and s[0].shape == [3, 4]
+
+
+def test_reductions():
+    x = paddle.to_tensor(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    np.testing.assert_allclose(paddle.sum(x, axis=1).numpy(), x.numpy().sum(1))
+    np.testing.assert_allclose(paddle.mean(x).numpy(), x.numpy().mean())
+    np.testing.assert_allclose(paddle.max(x, axis=-1).numpy(), x.numpy().max(-1))
+    assert paddle.argmax(x, axis=2).dtype == np.int64
+
+
+def test_indexing():
+    x = paddle.arange(12).reshape([3, 4])
+    np.testing.assert_array_equal(x[1].numpy(), np.arange(12).reshape(3, 4)[1])
+    np.testing.assert_array_equal(x[:, 1:3].numpy(), np.arange(12).reshape(3, 4)[:, 1:3])
+    idx = paddle.to_tensor([0, 2])
+    np.testing.assert_array_equal(paddle.gather(x, idx).numpy(),
+                                  np.arange(12).reshape(3, 4)[[0, 2]])
+    x[0] = 0
+    assert x.numpy()[0].sum() == 0
+
+
+def test_setitem_grad():
+    x = paddle.to_tensor(np.ones((3, 3), np.float32), stop_gradient=False)
+    y = x * 2
+    y[0] = 5.0
+    loss = y.sum()
+    loss.backward()
+    g = x.grad.numpy()
+    np.testing.assert_allclose(g[0], 0.0)
+    np.testing.assert_allclose(g[1:], 2.0)
+
+
+def test_where_sort_topk():
+    x = paddle.to_tensor([3.0, 1.0, 2.0])
+    v, i = paddle.topk(x, 2)
+    np.testing.assert_allclose(v.numpy(), [3, 2])
+    np.testing.assert_array_equal(i.numpy(), [0, 2])
+    s = paddle.sort(x)
+    np.testing.assert_allclose(s.numpy(), [1, 2, 3])
+    w = paddle.where(x > 1.5, x, paddle.zeros_like(x))
+    np.testing.assert_allclose(w.numpy(), [3, 0, 2])
+
+
+def test_cast_astype():
+    x = paddle.to_tensor([1.7, 2.3])
+    assert x.astype("int32").dtype == np.int32
+    assert x.astype("bfloat16").dtype.itemsize == 2
+
+
+def test_dynamic_ops_eager():
+    x = paddle.to_tensor([0.0, 1.0, 0.0, 2.0])
+    nz = paddle.nonzero(x)
+    assert nz.shape == [2, 1]
+    m = paddle.masked_select(x, x > 0)
+    np.testing.assert_allclose(m.numpy(), [1, 2])
+    u = paddle.unique(paddle.to_tensor([3, 1, 3, 2]))
+    np.testing.assert_array_equal(u.numpy(), [1, 2, 3])
+
+
+def test_linalg():
+    a = np.random.rand(4, 4).astype(np.float64)
+    a = a @ a.T + 4 * np.eye(4)
+    x = paddle.to_tensor(a)
+    np.testing.assert_allclose(paddle.linalg.cholesky(x).numpy(), np.linalg.cholesky(a),
+                               rtol=1e-6)
+    np.testing.assert_allclose(paddle.linalg.det(x).numpy(), np.linalg.det(a), rtol=1e-6)
+    np.testing.assert_allclose(paddle.linalg.inv(x).numpy(), np.linalg.inv(a), rtol=1e-6)
+
+
+def test_random_reproducible():
+    paddle.seed(42)
+    a = paddle.randn([4, 4])
+    paddle.seed(42)
+    b = paddle.randn([4, 4])
+    np.testing.assert_allclose(a.numpy(), b.numpy())
+    assert paddle.rand([2, 2]).dtype == np.float32
+
+
+def test_einsum():
+    a = np.random.rand(3, 4).astype(np.float32)
+    b = np.random.rand(4, 5).astype(np.float32)
+    out = paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
