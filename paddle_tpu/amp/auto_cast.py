@@ -49,7 +49,8 @@ def amp_cast_inputs(opdef, args, kwargs):
     name = opdef.name
     white = (name in amp_lists.WHITE_LIST or name in state.custom_white
              or opdef.amp_category == "white")
-    black = name in amp_lists.BLACK_LIST or name in state.custom_black
+    black = (name in amp_lists.BLACK_LIST or name in state.custom_black
+             or opdef.amp_category == "black")
     if name in state.custom_white:
         black = False
     if state.level == "O2":

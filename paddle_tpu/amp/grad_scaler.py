@@ -53,15 +53,15 @@ class GradScaler:
     def unscale_(self, optimizer):
         if not self._enable or self._unscaled:
             return
-        self._found_inf = False
         inv = 1.0 / self._scale
+        finite_parts = []
         for p in optimizer._parameter_list_flat():
             if p.grad is not None:
                 g = p.grad.value
-                finite = bool(jnp.all(jnp.isfinite(g)))
-                if not finite:
-                    self._found_inf = True
+                finite_parts.append(jnp.all(jnp.isfinite(g)))
                 p.grad._replace_value(g * inv)
+        # single fused reduction + ONE host transfer (not one blocking sync per param)
+        self._found_inf = (not bool(jnp.all(jnp.stack(finite_parts)))) if finite_parts else False
         self._unscaled = True
 
     def step(self, optimizer):

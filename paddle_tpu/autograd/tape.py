@@ -268,6 +268,8 @@ def _run_backward(
         key = (id(node), t._out_index)
         buf[key] = g if key not in buf else _acc(buf[key], g)
 
+    wanted_ids_early = {id(t) for t in (wanted or [])}
+    collected_early = {}
     for t, g in zip(tensors, grad_tensors):
         if t.stop_gradient:
             raise RuntimeError("cannot run backward on a tensor with stop_gradient=True")
@@ -280,6 +282,10 @@ def _run_backward(
             g = jnp.ones(t.value.shape, t.value.dtype)
         else:
             g = g.value if isinstance(g, Tensor) else jnp.asarray(g)
+        if id(t) in wanted_ids_early:
+            # an output that is also a requested input receives its seed directly
+            prev = collected_early.get(id(t))
+            collected_early[id(t)] = g if prev is None else prev + g
         if t._grad_node is None:
             # output IS a leaf
             if accumulate_leaves:
@@ -307,8 +313,8 @@ def _run_backward(
                     reachable[id(p)] = p
                     stack.append(p)
 
-    wanted_ids = {id(t) for t in (wanted or [])}
-    collected = {}
+    wanted_ids = wanted_ids_early
+    collected = dict(collected_early)
 
     ready = [n for nid, n in nodes.items() if pending.get(nid, 0) == 0]
     # roots with no pending consumers run first; consumers seed producers as they run

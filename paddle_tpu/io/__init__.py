@@ -1,0 +1,17 @@
+"""paddle_tpu.io — datasets, samplers, DataLoader.
+
+Reference analog: python/paddle/io (Dataset/DataLoader with multiprocess workers + shared
+memory + C++ buffered_reader double-buffering to device). TPU-first: the loader is a
+threaded prefetch pipeline that collates numpy batches and stages them to device ahead of
+time (host->HBM overlap); worker parallelism uses threads (numpy collate releases the GIL)
+with a multiprocessing option for heavy __getitem__.
+"""
+from .dataset import (  # noqa: F401
+    ChainDataset, ComposeDataset, ConcatDataset, Dataset, IterableDataset, Subset,
+    TensorDataset, random_split,
+)
+from .sampler import (  # noqa: F401
+    BatchSampler, DistributedBatchSampler, RandomSampler, Sampler, SequenceSampler,
+    SubsetRandomSampler, WeightedRandomSampler,
+)
+from .dataloader import DataLoader, default_collate_fn, get_worker_info  # noqa: F401

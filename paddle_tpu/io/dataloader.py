@@ -1,0 +1,185 @@
+"""DataLoader with threaded prefetch + device staging.
+
+Reference analog: python/paddle/io/dataloader/dataloader_iter.py (multiprocess workers +
+shared-memory queues) and the C++ double-buffer prefetcher
+(phi/core/operators/reader/buffered_reader.h). TPU-first redesign: a thread pool maps
+__getitem__ over index batches (numpy work releases the GIL), a bounded queue holds
+collated numpy batches, and jax.device_put stages the next batch to HBM while the current
+step runs — the host->device overlap the reference gets from buffered_reader.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ..framework.core import Tensor
+from .dataset import IterableDataset
+from .sampler import BatchSampler
+
+_worker_info = threading.local()
+
+
+def get_worker_info():
+    return getattr(_worker_info, "info", None)
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        import jax.numpy as jnp
+
+        return Tensor(jnp.stack([b.value for b in batch]))
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, np.float32)
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, (tuple, list)):
+        return [default_collate_fn(list(items)) for items in zip(*batch)]
+    return batch
+
+
+def _to_device(batch, to_tensor=True):
+    """Stage a collated numpy batch into device Tensors (async dispatch)."""
+    if isinstance(batch, np.ndarray):
+        import jax.numpy as jnp
+
+        return Tensor(jnp.asarray(batch))
+    if isinstance(batch, Tensor):
+        return batch
+    if isinstance(batch, dict):
+        return {k: _to_device(v) for k, v in batch.items()}
+    if isinstance(batch, (list, tuple)):
+        out = [_to_device(v) for v in batch]
+        return out if isinstance(batch, list) else tuple(out)
+    return batch
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None, return_list=True,
+                 batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
+                 collate_fn=None, num_workers=0, use_buffer_reader=True,
+                 prefetch_factor=2, use_shared_memory=True, timeout=0,
+                 worker_init_fn=None, persistent_workers=False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = max(prefetch_factor, 2)
+        self.use_buffer_reader = use_buffer_reader
+        self.worker_init_fn = worker_init_fn
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            if batch_size is None:
+                self.batch_sampler = None
+                self.batch_size = None
+            else:
+                self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
+                                                  batch_size=batch_size, drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset has no len()")
+        if self.batch_sampler is None:
+            return len(self.dataset)
+        return len(self.batch_sampler)
+
+    def _batches(self):
+        if self._iterable_mode:
+            batch = []
+            for item in self.dataset:
+                batch.append(item)
+                if len(batch) == self.batch_size:
+                    yield self.collate_fn(batch)
+                    batch = []
+            if batch and not self.drop_last:
+                yield self.collate_fn(batch)
+            return
+        if self.batch_sampler is None:
+            for i in range(len(self.dataset)):
+                yield self.collate_fn([self.dataset[i]])
+            return
+        if self.num_workers > 0:
+            with ThreadPoolExecutor(self.num_workers) as pool:
+                def fetch(indices):
+                    return self.collate_fn([self.dataset[i] for i in indices])
+
+                futures = []
+                it = iter(self.batch_sampler)
+                # keep prefetch_factor*workers futures in flight
+                depth = self.num_workers * self.prefetch_factor
+                try:
+                    for _ in range(depth):
+                        futures.append(pool.submit(fetch, next(it)))
+                except StopIteration:
+                    it = None
+                while futures:
+                    f = futures.pop(0)
+                    if it is not None:
+                        try:
+                            futures.append(pool.submit(fetch, next(it)))
+                        except StopIteration:
+                            it = None
+                    yield f.result()
+            return
+        for indices in self.batch_sampler:
+            yield self.collate_fn([self.dataset[i] for i in indices])
+
+    def __iter__(self):
+        if not self.use_buffer_reader:
+            for b in self._batches():
+                yield _to_device(b)
+            return
+        # double-buffer: stage the next batch to device while the current one is consumed
+        q: queue.Queue = queue.Queue(maxsize=self.prefetch_factor)
+        sentinel = object()
+        err = []
+        stop = threading.Event()
+
+        def producer():
+            try:
+                for b in self._batches():
+                    staged = _to_device(b)
+                    while not stop.is_set():
+                        try:
+                            q.put(staged, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
+            except BaseException as e:  # noqa: BLE001
+                err.append(e)
+            finally:
+                try:
+                    q.put_nowait(sentinel)
+                except queue.Full:
+                    pass
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is sentinel:
+                    break
+                yield item
+            t.join()
+            if err:
+                raise err[0]
+        finally:
+            # consumer abandoned the iterator (break/early stop): release the producer
+            stop.set()

@@ -1,0 +1,176 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py).
+
+All map to jax.nn / jnp primitives; XLA fuses them into surrounding matmuls, which is the
+TPU replacement for the reference's fused activation CUDA kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops._apply import defop
+from ...framework.core import Tensor
+
+relu = defop("relu")(lambda x: jax.nn.relu(x))
+relu6 = defop("relu6")(lambda x: jax.nn.relu6(x))
+sigmoid = defop("sigmoid_fn")(lambda x: jax.nn.sigmoid(x))
+tanh = defop("tanh_fn")(lambda x: jnp.tanh(x))
+silu = defop("silu")(lambda x: jax.nn.silu(x))
+swish = silu
+mish = defop("mish")(lambda x: jax.nn.mish(x))
+hardswish = defop("hardswish")(lambda x: x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0)
+hardsigmoid = defop("hardsigmoid")(lambda x, slope=1.0 / 6, offset=0.5: jnp.clip(slope * x + offset, 0.0, 1.0))
+hardtanh = defop("hardtanh")(lambda x, min=-1.0, max=1.0: jnp.clip(x, min, max))  # noqa: A002
+tanhshrink = defop("tanhshrink")(lambda x: x - jnp.tanh(x))
+softsign = defop("softsign")(lambda x: jax.nn.soft_sign(x))
+selu = defop("selu")(
+    lambda x, scale=1.0507009873554805, alpha=1.6732632423543772:
+    scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+)
+celu = defop("celu")(lambda x, alpha=1.0: jax.nn.celu(x, alpha))
+elu = defop("elu")(lambda x, alpha=1.0: jax.nn.elu(x, alpha))
+
+
+@defop("leaky_relu")
+def _leaky_relu(x, negative_slope=0.01):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _leaky_relu(x, negative_slope=float(negative_slope))
+
+
+@defop("prelu_op")
+def _prelu(x, weight, data_format="NCHW"):
+    if weight.size == 1:
+        w = weight.reshape(())
+    else:
+        axis = 1 if data_format == "NCHW" else x.ndim - 1
+        shape = [1] * x.ndim
+        shape[axis] = weight.shape[0]
+        w = weight.reshape(shape)
+    return jnp.where(x > 0, x, w * x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    return _prelu(x, weight, data_format=data_format)
+
+
+@defop("gelu")
+def _gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def gelu(x, approximate=False, name=None):
+    return _gelu(x, approximate=bool(approximate))
+
+
+@defop("softmax", amp_category="black")
+def _softmax(x, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...ops.manipulation import cast
+
+    if dtype is not None:
+        x = cast(x, dtype)
+    return _softmax(x, axis=int(axis))
+
+
+@defop("log_softmax", amp_category="black")
+def _log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...ops.manipulation import cast
+
+    if dtype is not None:
+        x = cast(x, dtype)
+    return _log_softmax(x, axis=int(axis))
+
+
+@defop("softplus")
+def _softplus(x, beta=1.0, threshold=20.0):
+    scaled = beta * x
+    return jnp.where(scaled > threshold, x, jax.nn.softplus(scaled) / beta)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return _softplus(x, beta=float(beta), threshold=float(threshold))
+
+
+@defop("softshrink")
+def _softshrink(x, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold, jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return _softshrink(x, threshold=float(threshold))
+
+
+@defop("hardshrink")
+def _hardshrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, jnp.zeros_like(x))
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return _hardshrink(x, threshold=float(threshold))
+
+
+@defop("thresholded_relu")
+def _thresholded_relu(x, threshold=1.0, value=0.0):
+    return jnp.where(x > threshold, x, jnp.asarray(value, x.dtype))
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return _thresholded_relu(x, threshold=float(threshold), value=float(value))
+
+
+@defop("maxout")
+def _maxout(x, groups, axis=1):
+    c = x.shape[axis]
+    new_shape = list(x.shape)
+    new_shape[axis] = c // groups
+    new_shape.insert(axis + 1, groups)
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)
+
+
+def maxout(x, groups, axis=1, name=None):
+    return _maxout(x, groups=int(groups), axis=int(axis))
+
+
+@defop("glu")
+def _glu(x, axis=-1):
+    return jax.nn.glu(x, axis=axis)
+
+
+def glu(x, axis=-1, name=None):
+    return _glu(x, axis=int(axis))
+
+
+@defop("swiglu")
+def _swiglu(x, y=None):
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(x) * y
+
+
+def swiglu(x, y=None, name=None):
+    """Fused SwiGLU (reference: python/paddle/incubate/nn/functional/swiglu.py)."""
+    return _swiglu(x, y)
+
+
+def relu_(x):
+    out = relu(x)
+    x._replace_value(out.value)
+    x._grad_node, x._out_index, x.stop_gradient = out._grad_node, out._out_index, out.stop_gradient
+    return x
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    out = softmax(x, axis, dtype)
+    x._replace_value(out.value)
+    x._grad_node, x._out_index, x.stop_gradient = out._grad_node, out._out_index, out.stop_gradient
+    return x

@@ -1,0 +1,364 @@
+"""Common functionals: linear, dropout, embedding, one_hot, interpolate, pixel ops.
+
+Reference analog: python/paddle/nn/functional/common.py + input.py + vision.py. Dropout
+draws from the functional PRNG (trace-safe); embedding is a gather that under GSPMD shards
+over the vocab axis (the c_embedding story, SURVEY.md §2.5).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...framework import random as rng
+from ...framework.core import Tensor
+from ...ops._apply import defop
+
+
+@defop("linear", amp_category="white")
+def _linear(x, weight, bias=None):
+    out = jnp.matmul(x, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def linear(x, weight, bias=None, name=None):
+    return _linear(x, weight, bias)
+
+
+@defop("dropout_op")
+def _dropout(x, mask_key, p=0.5, mode="upscale_in_train"):
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(mask_key, keep, x.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(mask, x / keep, jnp.zeros_like(x))
+    return jnp.where(mask, x, jnp.zeros_like(x))
+
+
+@defop("dropout_axis")
+def _dropout_axis(x, mask_key, p=0.5, shape=None, mode="upscale_in_train"):
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(mask_key, keep, tuple(shape))
+    if mode == "upscale_in_train":
+        return jnp.where(mask, x / keep, jnp.zeros_like(x))
+    return jnp.where(mask, x, jnp.zeros_like(x))
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            from ...ops.math import scale as scale_op
+
+            return scale_op(x, scale=1.0 - p)
+        return x
+    if p == 1.0:
+        from ...ops.creation import zeros_like
+
+        return zeros_like(x)
+    key = rng.next_key()
+    if axis is not None:
+        # shared mask along the non-listed axes
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        shape = [s if i in axes else 1 for i, s in enumerate(x.value.shape)]
+        return _dropout_axis(x, key, p=float(p), shape=tuple(shape), mode=mode)
+    return _dropout(x, key, p=float(p), mode=mode)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    if data_format == "NCHW":
+        return dropout(x, p, axis=[0, 1], training=training)
+    return dropout(x, p, axis=[0, 3], training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    if data_format == "NCDHW":
+        return dropout(x, p, axis=[0, 1], training=training)
+    return dropout(x, p, axis=[0, 4], training=training)
+
+
+@defop("alpha_dropout_op")
+def _ad(x, mask_key, p=0.5, a=1.0, b=0.0, alpha_p=0.0):
+    keep = jax.random.bernoulli(mask_key, 1 - p, x.shape)
+    return a * jnp.where(keep, x, jnp.asarray(alpha_p, x.dtype)) + b
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    key = rng.next_key()
+    a = ((1 - p) * (1 + p * alpha_p**2)) ** -0.5
+    b = -a * alpha_p * p
+    return _ad(x, key, p=float(p), a=float(a), b=float(b), alpha_p=float(alpha_p))
+
+
+@defop("embedding_op")
+def _embedding(weight, x, padding_idx=None):
+    out = jnp.take(weight, x, axis=0)
+    if padding_idx is not None:
+        mask = (x != padding_idx)[..., None]
+        out = out * mask.astype(out.dtype)
+    return out
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    idx = padding_idx
+    if idx is not None and idx < 0:
+        idx = weight.value.shape[0] + idx
+    return _embedding(weight, x, padding_idx=idx)
+
+
+def one_hot(x, num_classes, name=None):
+    return Tensor(jax.nn.one_hot(x.value, int(num_classes), dtype=jnp.float32))
+
+
+@defop("cosine_similarity", amp_category="black")
+def _cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.sqrt(jnp.sum(x1 * x1, axis=axis))
+    n2 = jnp.sqrt(jnp.sum(x2 * x2, axis=axis))
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    return _cosine_similarity(x1, x2, axis=int(axis), eps=float(eps))
+
+
+@defop("normalize_op")
+def _normalize(x, p=2, axis=1, epsilon=1e-12):
+    if p == 2:
+        n = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True))
+    else:
+        n = jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+    return x / jnp.maximum(n, epsilon)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    return _normalize(x, p=float(p), axis=int(axis), epsilon=float(epsilon))
+
+
+# ---- interpolate (nearest/bilinear/bicubic/trilinear/area) -----------------
+@defop("interpolate_op")
+def _interp(v, size=None, method="nearest", align_corners=False):
+    out_shape = (v.shape[0],) + tuple(size) + (v.shape[-1],)
+    if not align_corners or method == "nearest":
+        return jax.image.resize(v, out_shape, method=method)
+    # align_corners=True: corner pixels map exactly — gather with explicit coordinates
+    in_spatial = v.shape[1:-1]
+    out = v
+    for d, (n_in, n_out) in enumerate(zip(in_spatial, size)):
+        axis = 1 + d
+        if n_out == 1 or n_in == 1:
+            coords = jnp.zeros(n_out)
+        else:
+            coords = jnp.linspace(0.0, n_in - 1.0, n_out)
+        lo = jnp.floor(coords).astype(jnp.int32)
+        hi = jnp.minimum(lo + 1, n_in - 1)
+        frac = (coords - lo).astype(v.dtype)
+        shape = [1] * out.ndim
+        shape[axis] = n_out
+        frac = frac.reshape(shape)
+        out = (jnp.take(out, lo, axis=axis) * (1 - frac)
+               + jnp.take(out, hi, axis=axis) * frac)
+    return out
+
+
+@defop("interp_area")
+def _interp_area(v, size=None):
+    # 'area' mode = adaptive average pooling over each output bin (channel-last layout)
+    out = v
+    for d, n_out in enumerate(size):
+        axis = 1 + d
+        n_in = out.shape[axis]
+        if n_in % n_out == 0:
+            k = n_in // n_out
+            shp = list(out.shape)
+            shp[axis : axis + 1] = [n_out, k]
+            out = jnp.mean(out.reshape(shp), axis=axis + 1)
+        else:
+            starts = [int(np.floor(i * n_in / n_out)) for i in range(n_out)]
+            ends = [int(np.ceil((i + 1) * n_in / n_out)) for i in range(n_out)]
+            pieces = []
+            for s, e in zip(starts, ends):
+                sl = [slice(None)] * out.ndim
+                sl[axis] = slice(s, e)
+                pieces.append(jnp.mean(out[tuple(sl)], axis=axis, keepdims=True))
+            out = jnp.concatenate(pieces, axis=axis)
+    return out
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+                align_mode=0, data_format=None, name=None):
+    from ...ops.manipulation import transpose as _tr
+
+    nd = x.ndim
+    if data_format is None:
+        data_format = {3: "NCW", 4: "NCHW", 5: "NCDHW"}[nd]
+    channel_last = data_format[-1] == "C"
+    spatial = nd - 2
+    xc = x if channel_last else _tr(x, [0] + list(range(2, nd)) + [1])
+    in_spatial = xc.value.shape[1:-1]
+    if size is None:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * spatial
+        size = [int(s * f) for s, f in zip(in_spatial, scale_factor)]
+    else:
+        if isinstance(size, Tensor):
+            size = [int(s) for s in size.numpy()]
+        size = [int(s) if not isinstance(s, Tensor) else int(s.numpy()) for s in size]
+    mode_l = mode.lower()
+    if mode_l == "area":
+        out = _interp_area(xc, size=tuple(size))
+    else:
+        method = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+                  "trilinear": "linear", "bicubic": "cubic"}[mode_l]
+        out = _interp(xc, size=tuple(size), method=method, align_corners=bool(align_corners))
+    if not channel_last:
+        return _tr(out, [0, nd - 1] + list(range(1, nd - 1)))
+    return out
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format=None, name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+@defop("pixel_shuffle_op")
+def _pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    r = upscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        oc = c // (r * r)
+        x = x.reshape(n, oc, r, r, h, w)
+        x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+        return x.reshape(n, oc, h * r, w * r)
+    n, h, w, c = x.shape
+    oc = c // (r * r)
+    x = x.reshape(n, h, w, r, r, oc)
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return x.reshape(n, h * r, w * r, oc)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    return _pixel_shuffle(x, upscale_factor=int(upscale_factor), data_format=data_format)
+
+
+@defop("pixel_unshuffle_op")
+def _pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
+    r = downscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c, h // r, r, w // r, r)
+        x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
+        return x.reshape(n, c * r * r, h // r, w // r)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // r, r, w // r, r, c)
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return x.reshape(n, h // r, w // r, c * r * r)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    return _pixel_unshuffle(x, downscale_factor=int(downscale_factor), data_format=data_format)
+
+
+@defop("channel_shuffle_op")
+def _cs(x, groups, data_format="NCHW"):
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, groups, c // groups, h, w)
+        x = jnp.transpose(x, (0, 2, 1, 3, 4))
+        return x.reshape(n, c, h, w)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, w, groups, c // groups)
+    x = jnp.transpose(x, (0, 1, 2, 4, 3))
+    return x.reshape(n, h, w, c)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    return _cs(x, groups=int(groups), data_format=data_format)
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+@defop("unfold_op")
+def _unfold(x, kh=1, kw=1, sh=1, sw=1, ph=0, pw=0, dh=1, dw=1):
+    n, c, h, w = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    oh = (h + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (w + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        xp, (kh, kw), (sh, sw), "VALID", rhs_dilation=(dh, dw),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return patches.reshape(n, c * kh * kw, oh * ow)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference: nn/functional/common.py unfold)."""
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings) if not (isinstance(paddings, (list, tuple)) and len(paddings) == 4) \
+        else (paddings[0], paddings[1])
+    dh, dw = _pair(dilations)
+    return _unfold(x, kh=kh, kw=kw, sh=sh, sw=sw, ph=ph, pw=pw, dh=dh, dw=dw)
+
+
+@defop("fold_op")
+def _fold(x, oh, ow, kh, kw, sh, sw, ph, pw, dh, dw):
+    n, ckk, l = x.shape
+    c = ckk // (kh * kw)
+    nh = (oh + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    nw = (ow + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    cols = x.reshape(n, c, kh, kw, nh, nw)
+    out = jnp.zeros((n, c, oh + 2 * ph, ow + 2 * pw), x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            hi = i * dh
+            wj = j * dw
+            out = out.at[:, :, hi : hi + nh * sh : sh, wj : wj + nw * sw : sw].add(
+                cols[:, :, i, j]
+            )
+    return out[:, :, ph : ph + oh, pw : pw + ow]
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    oh, ow = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings)
+    dh, dw = _pair(dilations)
+    return _fold(x, oh=oh, ow=ow, kh=kh, kw=kw, sh=sh, sw=sw, ph=ph, pw=pw, dh=dh, dw=dw)
+
+
+@defop("label_smooth_op")
+def _ls(label, prior_dist=None, epsilon=0.1):
+    k = label.shape[-1]
+    if prior_dist is not None:
+        return (1 - epsilon) * label + epsilon * prior_dist
+    return (1 - epsilon) * label + epsilon / k
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    return _ls(label, prior_dist, epsilon=float(epsilon))
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    v = x.value
+    if maxlen is None:
+        maxlen = int(np.asarray(jax.device_get(v)).max())
+    from ...framework import dtype as dtype_mod
+
+    rng_ = jnp.arange(maxlen)
+    mask = rng_[None, :] < v[..., None]
+    return Tensor(mask.astype(dtype_mod.convert_dtype(dtype)))
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    from ...ops.creation import diag_embed as _de
+
+    return _de(x, offset, dim1, dim2)

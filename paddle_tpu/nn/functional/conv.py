@@ -1,0 +1,222 @@
+"""Convolution functionals.
+
+Reference analog: python/paddle/nn/functional/conv.py over phi conv kernels (cuDNN). TPU:
+lax.conv_general_dilated maps straight onto the MXU; weight layout is kept OIHW
+(paddle-compatible) and XLA handles the internal re-layout.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...ops._apply import defop
+
+
+def _tup(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(x) for x in v)
+
+
+def _norm_padding(padding, n, strides=None):
+    """paddle padding: int, list[int], list[pair], or 'SAME'/'VALID'."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        # may include batch/channel dims: take the last n entries
+        pairs = [tuple(p) for p in padding][-n:]
+        return pairs
+    raise ValueError(f"bad padding {padding}")
+
+
+@defop("conv2d", amp_category="white")
+def _conv2d(x, weight, bias=None, stride=(1, 1), padding="VALID", dilation=(1, 1), groups=1,
+            data_format="NCHW"):
+    dn = (data_format, "OIHW", data_format)
+    out = jax.lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=padding, rhs_dilation=dilation,
+        dimension_numbers=dn, feature_group_count=groups,
+    )
+    if bias is not None:
+        if data_format == "NCHW":
+            out = out + bias.reshape(1, -1, 1, 1)
+        else:
+            out = out + bias
+    return out
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv2d(x, weight, bias, stride=_tup(stride, 2),
+                   padding=_norm_padding(padding, 2), dilation=_tup(dilation, 2),
+                   groups=int(groups), data_format=data_format)
+
+
+@defop("conv1d", amp_category="white")
+def _conv1d(x, weight, bias=None, stride=(1,), padding="VALID", dilation=(1,), groups=1,
+            data_format="NCL"):
+    dn = ("NCH" if data_format == "NCL" else "NHC", "OIH", "NCH" if data_format == "NCL" else "NHC")
+    out = jax.lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=padding, rhs_dilation=dilation,
+        dimension_numbers=dn, feature_group_count=groups,
+    )
+    if bias is not None:
+        if data_format == "NCL":
+            out = out + bias.reshape(1, -1, 1)
+        else:
+            out = out + bias
+    return out
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv1d(x, weight, bias, stride=_tup(stride, 1),
+                   padding=_norm_padding(padding, 1), dilation=_tup(dilation, 1),
+                   groups=int(groups), data_format=data_format)
+
+
+@defop("conv3d", amp_category="white")
+def _conv3d(x, weight, bias=None, stride=(1, 1, 1), padding="VALID", dilation=(1, 1, 1),
+            groups=1, data_format="NCDHW"):
+    dn = (data_format, "OIDHW", data_format)
+    out = jax.lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=padding, rhs_dilation=dilation,
+        dimension_numbers=dn, feature_group_count=groups,
+    )
+    if bias is not None:
+        if data_format == "NCDHW":
+            out = out + bias.reshape(1, -1, 1, 1, 1)
+        else:
+            out = out + bias
+    return out
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv3d(x, weight, bias, stride=_tup(stride, 3),
+                   padding=_norm_padding(padding, 3), dilation=_tup(dilation, 3),
+                   groups=int(groups), data_format=data_format)
+
+
+@defop("conv2d_transpose", amp_category="white")
+def _conv2d_transpose(x, weight, bias=None, stride=(1, 1), padding=((0, 0), (0, 0)),
+                      output_padding=(0, 0), dilation=(1, 1), groups=1, data_format="NCHW"):
+    # weight layout paddle: (in_channels, out_channels//groups, kH, kW) = IOHW
+    kh, kw = weight.shape[2], weight.shape[3]
+    if isinstance(padding, str):
+        pad_cfg = padding
+    else:
+        # transpose conv: effective padding = dilation*(k-1) - pad
+        pad_cfg = [
+            (dilation[i] * (k - 1) - padding[i][0],
+             dilation[i] * (k - 1) - padding[i][1] + output_padding[i])
+            for i, k in enumerate((kh, kw))
+        ]
+    dn = (data_format, "IOHW", data_format)
+    if groups > 1:
+        n_in = x.shape[1] if data_format == "NCHW" else x.shape[-1]
+        xs = jnp.split(x, groups, axis=1 if data_format == "NCHW" else -1)
+        ws = jnp.split(weight, groups, axis=0)
+        outs = [
+            jax.lax.conv_general_dilated(
+                xg, wg, window_strides=(1, 1), padding=pad_cfg, lhs_dilation=stride,
+                rhs_dilation=dilation, dimension_numbers=dn,
+            )
+            for xg, wg in zip(xs, ws)
+        ]
+        out = jnp.concatenate(outs, axis=1 if data_format == "NCHW" else -1)
+    else:
+        out = jax.lax.conv_general_dilated(
+            x, weight, window_strides=(1, 1), padding=pad_cfg, lhs_dilation=stride,
+            rhs_dilation=dilation, dimension_numbers=dn,
+        )
+    # flip kernel spatially: conv_transpose = conv with flipped kernel and lhs dilation
+    if bias is not None:
+        if data_format == "NCHW":
+            out = out + bias.reshape(1, -1, 1, 1)
+        else:
+            out = out + bias
+    return out
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, data_format="NCHW", output_size=None, name=None):
+    import jax.numpy as jnp_
+
+    stride = _tup(stride, 2)
+    dilation = _tup(dilation, 2)
+    pad = _norm_padding(padding, 2)
+    opad = _tup(output_padding, 2)
+    # conv_transpose needs the spatially-flipped kernel for exact gradient semantics
+    from ...framework.core import Tensor
+    from ...ops.manipulation import flip
+
+    wf = flip(weight, [2, 3])
+    return _conv2d_transpose(x, wf, bias, stride=stride, padding=pad,
+                             output_padding=opad, dilation=dilation, groups=int(groups),
+                             data_format=data_format)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, data_format="NCL", output_size=None, name=None):
+    from ...ops.manipulation import squeeze, unsqueeze
+
+    x4 = unsqueeze(x, [3 if data_format == "NCL" else 2])
+    w4 = unsqueeze(weight, [3])
+    df = "NCHW" if data_format == "NCL" else "NHWC"
+    s = _tup(stride, 1)
+    d = _tup(dilation, 1)
+    p = padding if isinstance(padding, str) else _norm_padding(padding, 1)
+    out = conv2d_transpose(
+        x4, w4, bias,
+        stride=(s[0], 1),
+        padding=p if isinstance(p, str) else [tuple(p[0]), (0, 0)],
+        output_padding=(_tup(output_padding, 1)[0], 0),
+        groups=groups, dilation=(d[0], 1), data_format=df,
+    )
+    return squeeze(out, [3 if data_format == "NCL" else 2])
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, data_format="NCDHW", output_size=None, name=None):
+    from ...ops.manipulation import flip
+
+    stride = _tup(stride, 3)
+    dilation = _tup(dilation, 3)
+    pad = _norm_padding(padding, 3)
+    opad = _tup(output_padding, 3)
+    wf = flip(weight, [2, 3, 4])
+    kd, kh, kw = weight.value.shape[2:]
+
+    @defop("conv3d_transpose_inner", amp_category="white")
+    def _c3t(x, w, bias=None, stride=None, pad=None, opad=None, dilation=None, groups=1,
+             data_format="NCDHW"):
+        ks = w.shape[2:]
+        if isinstance(pad, str):
+            cfg = pad
+        else:
+            cfg = [
+                (dilation[i] * (k - 1) - pad[i][0],
+                 dilation[i] * (k - 1) - pad[i][1] + opad[i])
+                for i, k in enumerate(ks)
+            ]
+        dn = (data_format, "IODHW", data_format)
+        out = jax.lax.conv_general_dilated(
+            x, w, window_strides=(1, 1, 1), padding=cfg, lhs_dilation=stride,
+            rhs_dilation=dilation, dimension_numbers=dn,
+        )
+        if bias is not None:
+            out = out + (bias.reshape(1, -1, 1, 1, 1) if data_format == "NCDHW" else bias)
+        return out
+
+    return _c3t(x, wf, bias, stride=stride, pad=pad, opad=opad, dilation=dilation,
+                groups=int(groups), data_format=data_format)

@@ -1,0 +1,390 @@
+"""Loss functionals.
+
+Reference analog: python/paddle/nn/functional/loss.py (~25 losses over phi kernels,
+softmax_with_cross_entropy at its core). cross_entropy uses a numerically-stable fused
+log_softmax+gather form — the shape XLA fuses into one kernel.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ...ops._apply import defop
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+@defop("cross_entropy", amp_category="black")
+def _cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",  # noqa: A002
+                   soft_label=False, axis=-1, label_smoothing=0.0):
+    logp = jax.nn.log_softmax(input, axis=axis)
+    if soft_label:
+        soft = label
+        if label_smoothing > 0.0:
+            k = input.shape[axis]
+            soft = soft * (1 - label_smoothing) + label_smoothing / k
+        loss = -jnp.sum(soft * logp, axis=axis)
+        return _reduce(loss, reduction)
+    lbl = label
+    if lbl.ndim == input.ndim and lbl.shape[axis] == 1:
+        lbl = jnp.squeeze(lbl, axis)
+    lbl = lbl.astype(jnp.int32)
+    valid = lbl != ignore_index
+    safe = jnp.where(valid, lbl, 0)
+    picked = jnp.take_along_axis(logp, safe[..., None] if axis in (-1, input.ndim - 1)
+                                 else jnp.expand_dims(safe, axis), axis=axis)
+    picked = jnp.squeeze(picked, axis)
+    if label_smoothing > 0.0:
+        k = input.shape[axis]
+        smooth_term = jnp.mean(logp, axis=axis)
+        nll = -(1 - label_smoothing) * picked - label_smoothing * smooth_term
+    else:
+        nll = -picked
+    if weight is not None:
+        w = weight[safe]
+        nll = nll * w
+        nll = jnp.where(valid, nll, 0.0)
+        if reduction == "mean":
+            return jnp.sum(nll) / jnp.maximum(jnp.sum(jnp.where(valid, w, 0.0)), 1e-12)
+    else:
+        nll = jnp.where(valid, nll, 0.0)
+        if reduction == "mean":
+            return jnp.sum(nll) / jnp.maximum(jnp.sum(valid.astype(nll.dtype)), 1.0)
+    return _reduce(nll, reduction)
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",  # noqa: A002
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None):
+    if not use_softmax:
+        # input is already a probability distribution; loss = NLL of log-probs
+        return nll_loss(input.log(), label, weight, ignore_index, reduction)
+    return _cross_entropy(input, label, weight, ignore_index=int(ignore_index),
+                          reduction=reduction, soft_label=bool(soft_label), axis=int(axis),
+                          label_smoothing=float(label_smoothing))
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = _cross_entropy(logits, label, None, ignore_index=int(ignore_index),
+                          reduction="none", soft_label=bool(soft_label), axis=int(axis))
+    from .activation import softmax as softmax_fn
+
+    from ...ops.manipulation import unsqueeze
+
+    loss = unsqueeze(loss, [int(axis)]) if not soft_label else loss
+    if return_softmax:
+        return loss, softmax_fn(logits, axis=axis)
+    return loss
+
+
+@defop("nll_loss_op", amp_category="black")
+def _nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean"):  # noqa: A002
+    lbl = label.astype(jnp.int32)
+    valid = lbl != ignore_index
+    safe = jnp.where(valid, lbl, 0)
+    picked = jnp.take_along_axis(input, safe[:, None] if input.ndim == 2
+                                 else jnp.expand_dims(safe, 1), axis=1)
+    picked = jnp.squeeze(picked, 1)
+    loss = -picked
+    if weight is not None:
+        w = weight[safe]
+        loss = loss * w
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(jnp.where(valid, w, 0.0)), 1e-12)
+    loss = jnp.where(valid, loss, 0.0)
+    return _reduce(loss, reduction)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):  # noqa: A002
+    return _nll_loss(input, label, weight, ignore_index=int(ignore_index), reduction=reduction)
+
+
+@defop("mse_loss")
+def _mse_loss(input, label, reduction="mean"):  # noqa: A002
+    return _reduce(jnp.square(input - label), reduction)
+
+
+def mse_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return _mse_loss(input, label, reduction=reduction)
+
+
+@defop("l1_loss")
+def _l1_loss(input, label, reduction="mean"):  # noqa: A002
+    return _reduce(jnp.abs(input - label), reduction)
+
+
+def l1_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return _l1_loss(input, label, reduction=reduction)
+
+
+@defop("smooth_l1_loss")
+def _smooth_l1(input, label, delta=1.0, reduction="mean"):  # noqa: A002
+    d = jnp.abs(input - label)
+    loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+    return _reduce(loss, reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):  # noqa: A002
+    return _smooth_l1(input, label, delta=float(delta), reduction=reduction)
+
+
+@defop("huber_loss")
+def _huber(input, label, delta=1.0, reduction="mean"):  # noqa: A002
+    d = jnp.abs(input - label)
+    loss = jnp.where(d <= delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+    return _reduce(loss, reduction)
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):  # noqa: A002
+    return _huber(input, label, delta=float(delta), reduction=reduction)
+
+
+@defop("bce_loss", amp_category="black")
+def _bce(input, label, weight=None, reduction="mean"):  # noqa: A002
+    eps = 1e-12
+    loss = -(label * jnp.log(jnp.maximum(input, eps))
+             + (1 - label) * jnp.log(jnp.maximum(1 - input, eps)))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):  # noqa: A002
+    return _bce(input, label, weight, reduction=reduction)
+
+
+@defop("bce_with_logits", amp_category="black")
+def _bce_logits(logit, label, weight=None, pos_weight=None, reduction="mean"):
+    max_val = jnp.maximum(-logit, 0.0)
+    if pos_weight is not None:
+        log_w = (pos_weight - 1.0) * label + 1.0
+        loss = (1 - label) * logit + log_w * (jnp.log1p(jnp.exp(-jnp.abs(logit))) + max_val)
+    else:
+        loss = (1 - label) * logit + jnp.log1p(jnp.exp(-jnp.abs(logit))) + max_val
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    return _bce_logits(logit, label, weight, pos_weight, reduction=reduction)
+
+
+def sigmoid_cross_entropy_with_logits(logit, label, normalize=False, ignore_index=-100):
+    out = _bce_logits(logit, label, None, None, reduction="none")
+    return out
+
+
+@defop("kl_div", amp_category="black")
+def _kl_div(input, label, reduction="mean", log_target=False):  # noqa: A002
+    if log_target:
+        loss = jnp.exp(label) * (label - input)
+    else:
+        loss = label * (jnp.log(jnp.maximum(label, 1e-12)) - input)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / input.shape[0]
+    return _reduce(loss, reduction)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):  # noqa: A002
+    return _kl_div(input, label, reduction=reduction, log_target=bool(log_target))
+
+
+@defop("margin_ranking")
+def _margin_ranking(input, other, label, margin=0.0, reduction="mean"):  # noqa: A002
+    loss = jnp.maximum(0.0, -label * (input - other) + margin)
+    return _reduce(loss, reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):  # noqa: A002
+    return _margin_ranking(input, other, label, margin=float(margin), reduction=reduction)
+
+
+@defop("hinge_embedding")
+def _hinge_embedding(input, label, margin=1.0, reduction="mean"):  # noqa: A002
+    loss = jnp.where(label == 1.0, input, jnp.maximum(0.0, margin - input))
+    return _reduce(loss, reduction)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):  # noqa: A002
+    return _hinge_embedding(input, label, margin=float(margin), reduction=reduction)
+
+
+@defop("cosine_embedding")
+def _cosine_embedding(input1, input2, label, margin=0.0, reduction="mean"):
+    cos = jnp.sum(input1 * input2, -1) / jnp.maximum(
+        jnp.linalg.norm(input1, axis=-1) * jnp.linalg.norm(input2, axis=-1), 1e-12
+    )
+    loss = jnp.where(label == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+    return _reduce(loss, reduction)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    return _cosine_embedding(input1, input2, label, margin=float(margin), reduction=reduction)
+
+
+@defop("triplet_margin")
+def _triplet_margin(anchor, positive, negative, margin=1.0, p=2.0, eps=1e-6, swap=False,
+                    reduction="mean"):
+    def dist(a, b):
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a - b) + eps, p), -1), 1.0 / p)
+
+    dp = dist(anchor, positive)
+    dn = dist(anchor, negative)
+    if swap:
+        dn = jnp.minimum(dn, dist(positive, negative))
+    return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6,  # noqa: A002
+                        swap=False, reduction="mean", name=None):
+    return _triplet_margin(input, positive, negative, margin=float(margin), p=float(p),
+                           eps=float(epsilon), swap=bool(swap), reduction=reduction)
+
+
+@defop("soft_margin")
+def _soft_margin(input, label, reduction="mean"):  # noqa: A002
+    return _reduce(jnp.log1p(jnp.exp(-label * input)), reduction)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return _soft_margin(input, label, reduction=reduction)
+
+
+@defop("multi_label_soft_margin")
+def _mlsm(input, label, weight=None, reduction="mean"):  # noqa: A002
+    loss = -(label * jax.nn.log_sigmoid(input) + (1 - label) * jax.nn.log_sigmoid(-input))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(jnp.mean(loss, -1), reduction)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean", name=None):  # noqa: A002
+    return _mlsm(input, label, weight, reduction=reduction)
+
+
+@defop("poisson_nll")
+def _poisson_nll(input, label, log_input=True, full=False, epsilon=1e-8, reduction="mean"):  # noqa: A002
+    if log_input:
+        loss = jnp.exp(input) - label * input
+    else:
+        loss = input - label * jnp.log(input + epsilon)
+    if full:
+        stirling = label * jnp.log(jnp.maximum(label, 1.0)) - label + 0.5 * jnp.log(
+            2 * np.pi * jnp.maximum(label, 1.0)
+        )
+        loss = loss + jnp.where(label > 1, stirling, 0.0)
+    return _reduce(loss, reduction)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,  # noqa: A002
+                     reduction="mean", name=None):
+    return _poisson_nll(input, label, log_input=bool(log_input), full=bool(full),
+                        epsilon=float(epsilon), reduction=reduction)
+
+
+@defop("gaussian_nll")
+def _gaussian_nll(input, label, variance, full=False, epsilon=1e-6, reduction="mean"):  # noqa: A002
+    var = jnp.maximum(variance, epsilon)
+    loss = 0.5 * (jnp.log(var) + jnp.square(input - label) / var)
+    if full:
+        loss = loss + 0.5 * np.log(2 * np.pi)
+    return _reduce(loss, reduction)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6, reduction="mean",  # noqa: A002
+                      name=None):
+    return _gaussian_nll(input, label, variance, full=bool(full), epsilon=float(epsilon),
+                         reduction=reduction)
+
+
+def square_error_cost(input, label):  # noqa: A002
+    return _mse_loss(input, label, reduction="none")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):  # noqa: A002
+    @defop("log_loss_op")
+    def _ll(input, label, epsilon=1e-4):  # noqa: A002
+        return -label * jnp.log(input + epsilon) - (1 - label) * jnp.log(1 - input + epsilon)
+
+    return _ll(input, label, epsilon=float(epsilon))
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean",
+             norm_by_times=False):
+    """CTC via the standard forward algorithm under lax.scan (reference:
+    nn/functional/loss.py ctc_loss over warpctc)."""
+    @defop("ctc_loss_op", amp_category="black")
+    def _ctc(log_probs, labels, input_lengths, label_lengths, blank=0):
+        # log_probs: (T, N, C) paddle layout
+        T, N, C = log_probs.shape
+        L = labels.shape[1]
+        S = 2 * L + 1
+        lbl = labels.astype(jnp.int32)
+        ext = jnp.full((N, S), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lbl)
+        neg_inf = -1e30
+
+        # alpha init
+        alpha0 = jnp.full((N, S), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(log_probs[0, jnp.arange(N), blank])
+        first_lbl = log_probs[0, jnp.arange(N), ext[:, 1]]
+        alpha0 = alpha0.at[:, 1].set(jnp.where(label_lengths > 0, first_lbl, neg_inf))
+
+        same_as_prev2 = jnp.concatenate(
+            [jnp.zeros((N, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1
+        )
+
+        def step(alpha, t):
+            a_shift1 = jnp.concatenate([jnp.full((N, 1), neg_inf), alpha[:, :-1]], axis=1)
+            a_shift2 = jnp.concatenate([jnp.full((N, 2), neg_inf), alpha[:, :-2]], axis=1)
+            a_shift2 = jnp.where(same_as_prev2, neg_inf, a_shift2)
+            merged = jnp.logaddexp(alpha, jnp.logaddexp(a_shift1, a_shift2))
+            emit = log_probs[t][jnp.arange(N)[:, None], ext]
+            new_alpha = merged + emit
+            new_alpha = jnp.where(t < input_lengths[:, None], new_alpha, alpha)
+            return new_alpha, None
+
+        alphaT, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        end_idx = 2 * label_lengths
+        last = alphaT[jnp.arange(N), end_idx]
+        last2 = jnp.where(end_idx - 1 >= 0, alphaT[jnp.arange(N), jnp.maximum(end_idx - 1, 0)],
+                          neg_inf)
+        ll = jnp.logaddexp(last, last2)
+        return -ll
+
+    loss = _ctc(log_probs, labels, input_lengths, label_lengths, blank=int(blank))
+    if reduction == "mean":
+        from ...ops.reduction import mean as mean_op
+        from ...ops.math import divide
+
+        return mean_op(divide(loss, label_lengths.astype(loss.dtype)))
+    if reduction == "sum":
+        from ...ops.reduction import sum as sum_op
+
+        return sum_op(loss)
+    return loss
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):  # noqa: A002
+    @defop("dice_loss_op")
+    def _dice(input, label, epsilon=1e-5):  # noqa: A002
+        lbl = jax.nn.one_hot(label.squeeze(-1), input.shape[-1], dtype=input.dtype)
+        red = tuple(range(1, input.ndim))
+        inter = jnp.sum(input * lbl, axis=red)
+        union = jnp.sum(input, axis=red) + jnp.sum(lbl, axis=red)
+        return jnp.mean(1.0 - (2 * inter + epsilon) / (union + epsilon))
+
+    return _dice(input, label, epsilon=float(epsilon))

@@ -1,0 +1,184 @@
+"""Normalization functionals.
+
+Reference analog: python/paddle/nn/functional/norm.py (batch_norm/layer_norm/instance_norm
+over cuDNN/phi kernels) + incubate fused_rms_norm. On TPU these are VPU elementwise chains
+XLA fuses; rms_norm additionally has a Pallas kernel (ops/pallas) used on the hot path.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ...ops._apply import defop
+
+
+@defop("layer_norm", amp_category="black")
+def _layer_norm(x, weight=None, bias=None, epsilon=1e-5, begin_norm_axis=None):
+    axes = tuple(range(begin_norm_axis, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    begin = x.ndim - len(normalized_shape)
+    return _layer_norm(x, weight, bias, epsilon=float(epsilon), begin_norm_axis=begin)
+
+
+@defop("rms_norm", amp_category="black")
+def _rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=None):
+    axes = tuple(range(begin_norm_axis, x.ndim))
+    ms = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=axes, keepdims=True)
+    out = (x.astype(jnp.float32) * jax.lax.rsqrt(ms + epsilon)).astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1, name=None):
+    """Reference: python/paddle/incubate/nn/functional/fused_rms_norm.py."""
+    begin = begin_norm_axis % x.ndim
+    return _rms_norm(x, weight, bias, epsilon=float(epsilon), begin_norm_axis=begin)
+
+
+@defop("batch_norm_infer", amp_category="black")
+def _bn_infer(x, rm, rv, weight=None, bias=None, epsilon=1e-5, axis=1):
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    inv = jax.lax.rsqrt(rv.reshape(shape) + epsilon)
+    out = (x - rm.reshape(shape)) * inv
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+@defop("batch_norm_train", amp_category="black")
+def _bn_train(x, weight=None, bias=None, epsilon=1e-5, axis=1):
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    mean = jnp.mean(x, axis=red)
+    var = jnp.mean(jnp.square(x), axis=red) - jnp.square(mean)
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    inv = jax.lax.rsqrt(var.reshape(shape) + epsilon)
+    out = (x - mean.reshape(shape)) * inv
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out, mean, var
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-5, data_format="NCHW", use_global_stats=None,
+               name=None):
+    axis = 1 if data_format.startswith("NC") or data_format == "NC" else x.ndim - 1
+    if use_global_stats is None:
+        use_global_stats = not training
+    if use_global_stats:
+        return _bn_infer(x, running_mean, running_var, weight, bias,
+                         epsilon=float(epsilon), axis=axis)
+    out, mean, var = _bn_train(x, weight, bias, epsilon=float(epsilon), axis=axis)
+    # update running stats in-place (buffers), matching the reference's momentum convention:
+    # running = momentum * running + (1-momentum) * batch
+    if running_mean is not None:
+        n = x.size // x.value.shape[axis]
+        unbiased = var.value * n / max(n - 1, 1)
+        running_mean._replace_value(momentum * running_mean.value
+                                    + (1 - momentum) * mean.value)
+        running_var._replace_value(momentum * running_var.value + (1 - momentum) * unbiased)
+    return out
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW",
+                  name=None):
+    @defop("instance_norm_op", amp_category="black")
+    def _in(x, weight=None, bias=None, eps=1e-5, axis=1):
+        red = tuple(range(2, x.ndim)) if axis == 1 else tuple(range(1, x.ndim - 1))
+        mean = jnp.mean(x, axis=red, keepdims=True)
+        var = jnp.var(x, axis=red, keepdims=True)
+        out = (x - mean) * jax.lax.rsqrt(var + eps)
+        if weight is not None:
+            shape = [1] * x.ndim
+            shape[axis] = x.shape[axis]
+            out = out * weight.reshape(shape)
+        if bias is not None:
+            shape = [1] * x.ndim
+            shape[axis] = x.shape[axis]
+            out = out + bias.reshape(shape)
+        return out
+
+    axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    return _in(x, weight, bias, eps=float(eps), axis=axis)
+
+
+@defop("group_norm_op", amp_category="black")
+def _group_norm(x, weight=None, bias=None, epsilon=1e-5, groups=1, axis=1):
+    if axis == 1:
+        n, c = x.shape[0], x.shape[1]
+        spatial = x.shape[2:]
+        g = x.reshape((n, groups, c // groups) + spatial)
+        red = tuple(range(2, g.ndim))
+        mean = jnp.mean(g, axis=red, keepdims=True)
+        var = jnp.var(g, axis=red, keepdims=True)
+        g = (g - mean) * jax.lax.rsqrt(var + epsilon)
+        out = g.reshape(x.shape)
+        shape = [1, c] + [1] * len(spatial)
+    else:
+        n, c = x.shape[0], x.shape[-1]
+        spatial = x.shape[1:-1]
+        g = x.reshape((n,) + spatial + (groups, c // groups))
+        red = tuple(range(1, g.ndim - 2)) + (g.ndim - 1,)
+        mean = jnp.mean(g, axis=red, keepdims=True)
+        var = jnp.var(g, axis=red, keepdims=True)
+        g = (g - mean) * jax.lax.rsqrt(var + epsilon)
+        out = g.reshape(x.shape)
+        shape = [1] * (x.ndim - 1) + [c]
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None, data_format="NCHW",
+               name=None):
+    axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    return _group_norm(x, weight, bias, epsilon=float(epsilon), groups=int(num_groups),
+                       axis=axis)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW",
+                        name=None):
+    @defop("lrn_op")
+    def _lrn(x, size, alpha, beta, k, axis):
+        sq = jnp.square(x)
+        half = size // 2
+        cdim = x.shape[axis]
+        acc = jnp.zeros_like(x)
+        for off in range(-half, half + 1):
+            sl = [slice(None)] * x.ndim
+            lo = max(0, -off)
+            hi = min(cdim, cdim - off)
+            src = [slice(None)] * x.ndim
+            sl[axis] = slice(lo, hi)
+            src[axis] = slice(lo + off, hi + off)
+            acc = acc.at[tuple(sl)].add(sq[tuple(src)])
+        return x / jnp.power(k + alpha * acc / size, beta)
+
+    axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    return _lrn(x, size=int(size), alpha=float(alpha), beta=float(beta), k=float(k), axis=axis)

@@ -1,0 +1,258 @@
+"""Pooling functionals.
+
+Reference analog: python/paddle/nn/functional/pooling.py over phi pool kernels. TPU:
+lax.reduce_window lowers to fused windowed reductions.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ...ops._apply import defop
+
+
+def _tup(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(x) for x in v)
+
+
+def _pool_padding(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    return [tuple(p) for p in padding][-n:]
+
+
+def _window(x_ndim, ksize, stride, data_format):
+    if data_format.startswith("NC"):
+        dims = (1, 1) + ksize
+        strides = (1, 1) + stride
+    else:
+        dims = (1,) + ksize + (1,)
+        strides = (1,) + stride + (1,)
+    return dims, strides
+
+
+@defop("max_pool")
+def _max_pool(x, ksize, stride, padding, data_format="NCHW", ceil_mode=False):
+    n = len(ksize)
+    dims, strides = _window(x.ndim, ksize, stride, data_format)
+    if isinstance(padding, str):
+        pad = padding
+    else:
+        if data_format.startswith("NC"):
+            pad = [(0, 0), (0, 0)] + list(padding)
+        else:
+            pad = [(0, 0)] + list(padding) + [(0, 0)]
+        if ceil_mode:
+            pad = [
+                (lo, hi + s - 1) if i >= (2 if data_format.startswith("NC") else 1)
+                and i < (2 + n if data_format.startswith("NC") else 1 + n) else (lo, hi)
+                for i, ((lo, hi), s) in enumerate(zip(pad, strides))
+            ]
+    init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    return jax.lax.reduce_window(x, init, jax.lax.max, dims, strides, pad)
+
+
+@defop("avg_pool")
+def _avg_pool(x, ksize, stride, padding, data_format="NCHW", exclusive=True,
+              ceil_mode=False):
+    dims, strides = _window(x.ndim, ksize, stride, data_format)
+    if isinstance(padding, str):
+        pad = padding
+    else:
+        if data_format.startswith("NC"):
+            pad = [(0, 0), (0, 0)] + list(padding)
+        else:
+            pad = [(0, 0)] + list(padding) + [(0, 0)]
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides, pad)
+    if exclusive and not isinstance(pad, str):
+        ones = jnp.ones_like(x)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims, strides, pad)
+        return summed / counts
+    return summed / float(np.prod(ksize))
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
+               data_format="NCHW", name=None):
+    ksize = _tup(kernel_size, 2)
+    stride = _tup(stride, 2) if stride is not None else ksize
+    pad = _pool_padding(padding, 2)
+    out = _max_pool(x, ksize=ksize, stride=stride, padding=pad, data_format=data_format,
+                    ceil_mode=bool(ceil_mode))
+    if return_mask:
+        mask = _argmax_pool_mask(x, ksize, stride, pad, data_format)
+        return out, mask
+    return out
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
+               divisor_override=None, data_format="NCHW", name=None):
+    ksize = _tup(kernel_size, 2)
+    stride = _tup(stride, 2) if stride is not None else ksize
+    pad = _pool_padding(padding, 2)
+    return _avg_pool(x, ksize=ksize, stride=stride, padding=pad, data_format=data_format,
+                     exclusive=bool(exclusive), ceil_mode=bool(ceil_mode))
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
+               name=None):
+    ksize = _tup(kernel_size, 1)
+    stride = _tup(stride, 1) if stride is not None else ksize
+    pad = _pool_padding(padding, 1)
+    return _max_pool(x, ksize=ksize, stride=stride, padding=pad, data_format="NCL")
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False,
+               name=None):
+    ksize = _tup(kernel_size, 1)
+    stride = _tup(stride, 1) if stride is not None else ksize
+    pad = _pool_padding(padding, 1)
+    return _avg_pool(x, ksize=ksize, stride=stride, padding=pad, data_format="NCL",
+                     exclusive=bool(exclusive))
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
+               data_format="NCDHW", name=None):
+    ksize = _tup(kernel_size, 3)
+    stride = _tup(stride, 3) if stride is not None else ksize
+    pad = _pool_padding(padding, 3)
+    return _max_pool(x, ksize=ksize, stride=stride, padding=pad, data_format=data_format)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
+               divisor_override=None, data_format="NCDHW", name=None):
+    ksize = _tup(kernel_size, 3)
+    stride = _tup(stride, 3) if stride is not None else ksize
+    pad = _pool_padding(padding, 3)
+    return _avg_pool(x, ksize=ksize, stride=stride, padding=pad, data_format=data_format,
+                     exclusive=bool(exclusive))
+
+
+def _argmax_pool_mask(x, ksize, stride, pad, data_format):
+    """Indices of maxima (flattened per-channel spatial index), eager helper."""
+    from ...ops.manipulation import _require_concrete
+
+    v = x.value
+    if data_format != "NCHW":
+        v = jnp.transpose(v, (0, 3, 1, 2))
+    n, c, h, w = v.shape
+    kh, kw = ksize
+    sh, sw = stride
+    if isinstance(pad, str):
+        ph = pw = 0
+    else:
+        ph, pw = pad[0][0], pad[1][0]
+    vp = jnp.pad(v, ((0, 0), (0, 0), (ph, ph), (pw, pw)), constant_values=-jnp.inf)
+    oh = (h + 2 * ph - kh) // sh + 1
+    ow = (w + 2 * pw - kw) // sw + 1
+    idx_map = jnp.arange(h * w).reshape(1, 1, h, w).astype(jnp.float32)
+    idx_map = jnp.pad(idx_map, ((0, 0), (0, 0), (ph, ph), (pw, pw)), constant_values=-1)
+    cols = []
+    for i in range(kh):
+        for j in range(kw):
+            patch = vp[:, :, i : i + oh * sh : sh, j : j + ow * sw : sw]
+            cols.append(patch)
+    stackv = jnp.stack(cols, axis=-1)
+    best = jnp.argmax(stackv, axis=-1)
+    rows = best // kw
+    colsb = best % kw
+    base_i = jnp.arange(oh)[:, None] * sh
+    base_j = jnp.arange(ow)[None, :] * sw
+    abs_i = base_i[None, None] + rows - ph
+    abs_j = base_j[None, None] + colsb - pw
+    flat = abs_i * w + abs_j
+    return Tensor(flat.astype(jnp.int64))
+
+
+@defop("adaptive_avg_pool")
+def _adaptive_avg_pool(x, out_size, data_format="NCHW"):
+    nsp = len(out_size)
+    if data_format.startswith("NC"):
+        spatial = x.shape[2:]
+    else:
+        spatial = x.shape[1 : 1 + nsp]
+    # adaptive pooling with uniform splits when divisible; general case via mean over bins
+    outs = x
+    for d in range(nsp):
+        in_s, out_s = spatial[d], out_size[d]
+        axis = (2 + d) if data_format.startswith("NC") else (1 + d)
+        if in_s % out_s == 0:
+            k = in_s // out_s
+            shape = list(outs.shape)
+            shape[axis : axis + 1] = [out_s, k]
+            outs = jnp.mean(outs.reshape(shape), axis=axis + 1)
+        else:
+            # general: gather-based bins (start/end per output index)
+            starts = [int(np.floor(i * in_s / out_s)) for i in range(out_s)]
+            ends = [int(np.ceil((i + 1) * in_s / out_s)) for i in range(out_s)]
+            pieces = []
+            for s, e in zip(starts, ends):
+                sl = [slice(None)] * outs.ndim
+                sl[axis] = slice(s, e)
+                pieces.append(jnp.mean(outs[tuple(sl)], axis=axis, keepdims=True))
+            outs = jnp.concatenate(pieces, axis=axis)
+    return outs
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_avg_pool(x, out_size=_tup(output_size, 2), data_format=data_format)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_avg_pool(x, out_size=_tup(output_size, 1), data_format="NCL")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_avg_pool(x, out_size=_tup(output_size, 3), data_format=data_format)
+
+
+@defop("adaptive_max_pool")
+def _adaptive_max_pool(x, out_size, data_format="NCHW"):
+    nsp = len(out_size)
+    spatial = x.shape[2:] if data_format.startswith("NC") else x.shape[1 : 1 + nsp]
+    outs = x
+    for d in range(nsp):
+        in_s, out_s = spatial[d], out_size[d]
+        axis = (2 + d) if data_format.startswith("NC") else (1 + d)
+        if in_s % out_s == 0:
+            k = in_s // out_s
+            shape = list(outs.shape)
+            shape[axis : axis + 1] = [out_s, k]
+            outs = jnp.max(outs.reshape(shape), axis=axis + 1)
+        else:
+            starts = [int(np.floor(i * in_s / out_s)) for i in range(out_s)]
+            ends = [int(np.ceil((i + 1) * in_s / out_s)) for i in range(out_s)]
+            pieces = []
+            for s, e in zip(starts, ends):
+                sl = [slice(None)] * outs.ndim
+                sl[axis] = slice(s, e)
+                pieces.append(jnp.max(outs[tuple(sl)], axis=axis, keepdims=True))
+            outs = jnp.concatenate(pieces, axis=axis)
+    return outs
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_max_pool(x, out_size=_tup(output_size, 2))
+    if return_mask:
+        raise NotImplementedError("adaptive_max_pool2d return_mask")
+    return out
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_max_pool(x, out_size=_tup(output_size, 1), data_format="NCL")
+    return out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_max_pool(x, out_size=_tup(output_size, 3), data_format="NCDHW")

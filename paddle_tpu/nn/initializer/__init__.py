@@ -1,0 +1,223 @@
+"""Weight initializers.
+
+Reference analog: python/paddle/nn/initializer (Constant/Normal/Xavier/Kaiming/...). Each
+initializer is a callable shape->jax array drawn from the global functional PRNG
+(framework/random.py), applied at Parameter creation.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...framework import dtype as dtype_mod
+from ...framework import random as rng
+
+
+def calculate_gain(nonlinearity, param=None):
+    recommended = {
+        "sigmoid": 1.0,
+        "linear": 1.0,
+        "conv1d": 1.0,
+        "conv2d": 1.0,
+        "conv3d": 1.0,
+        "tanh": 5.0 / 3,
+        "relu": math.sqrt(2.0),
+        "leaky_relu": math.sqrt(2.0 / (1 + (param if param is not None else 0.01) ** 2)),
+        "selu": 3.0 / 4,
+    }
+    if nonlinearity not in recommended:
+        raise ValueError(f"unsupported nonlinearity {nonlinearity}")
+    return recommended[nonlinearity]
+
+
+def _fan_in_out(shape):
+    shape = tuple(shape)
+    if len(shape) < 2:
+        return (shape[0] if shape else 1), (shape[0] if shape else 1)
+    receptive = int(np.prod(shape[2:])) if len(shape) > 2 else 1
+    # paddle convention: fan_in from shape[0], fan_out from shape[1] for 2-D weights
+    fan_in = shape[0] * receptive
+    fan_out = shape[1] * receptive
+    return fan_in, fan_out
+
+
+class Initializer:
+    def __call__(self, shape, dtype=None):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype=None):
+        return jnp.full(tuple(shape), self.value,
+                        dtype_mod.convert_dtype(dtype) or dtype_mod.get_default_dtype())
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=None):
+        d = dtype_mod.convert_dtype(dtype) or dtype_mod.get_default_dtype()
+        return self.mean + self.std * jax.random.normal(rng.next_key(), tuple(shape), d)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, a=-2.0, b=2.0):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def __call__(self, shape, dtype=None):
+        d = dtype_mod.convert_dtype(dtype) or dtype_mod.get_default_dtype()
+        z = jax.random.truncated_normal(rng.next_key(), self.a, self.b, tuple(shape), d)
+        return self.mean + self.std * z
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype=None):
+        d = dtype_mod.convert_dtype(dtype) or dtype_mod.get_default_dtype()
+        return jax.random.uniform(rng.next_key(), tuple(shape), d, self.low, self.high)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=None):
+        d = dtype_mod.convert_dtype(dtype) or dtype_mod.get_default_dtype()
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        return std * jax.random.normal(rng.next_key(), tuple(shape), d)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=None):
+        d = dtype_mod.convert_dtype(dtype) or dtype_mod.get_default_dtype()
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(rng.next_key(), tuple(shape), d, -limit, limit)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in, self.negative_slope, self.nonlinearity = fan_in, negative_slope, nonlinearity
+
+    def __call__(self, shape, dtype=None):
+        d = dtype_mod.convert_dtype(dtype) or dtype_mod.get_default_dtype()
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        std = gain / math.sqrt(fi)
+        return std * jax.random.normal(rng.next_key(), tuple(shape), d)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in, self.negative_slope, self.nonlinearity = fan_in, negative_slope, nonlinearity
+
+    def __call__(self, shape, dtype=None):
+        d = dtype_mod.convert_dtype(dtype) or dtype_mod.get_default_dtype()
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        limit = gain * math.sqrt(3.0 / fi)
+        return jax.random.uniform(rng.next_key(), tuple(shape), d, -limit, limit)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype=None):
+        from ...framework.core import Tensor
+
+        v = self.value
+        if isinstance(v, Tensor):
+            v = v.value
+        arr = jnp.asarray(np.asarray(v) if not isinstance(v, (jnp.ndarray, jax.Array)) else v)
+        d = dtype_mod.convert_dtype(dtype)
+        if d is not None and np.dtype(arr.dtype) != d:
+            arr = arr.astype(d)
+        assert tuple(arr.shape) == tuple(shape), f"Assign shape {arr.shape} != {shape}"
+        return arr
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype=None):
+        d = dtype_mod.convert_dtype(dtype) or dtype_mod.get_default_dtype()
+        return self.gain * jax.nn.initializers.orthogonal()(rng.next_key(), tuple(shape), d)
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def __call__(self, shape, dtype=None):
+        d = dtype_mod.convert_dtype(dtype) or dtype_mod.get_default_dtype()
+        arr = np.zeros(tuple(shape), np.float32)
+        out_c, in_c = shape[0], shape[1]
+        per = out_c // self.groups
+        for g in range(self.groups):
+            for i in range(min(per, in_c)):
+                center = tuple(s // 2 for s in shape[2:])
+                arr[(g * per + i, i) + center] = 1.0
+        return jnp.asarray(arr, d)
+
+
+# paddle-style lowercase aliases
+constant = Constant
+normal = Normal
+uniform = Uniform
+
+
+class ParamAttr:
+    """Parameter attribute bundle (reference: python/paddle/base/param_attr.py)."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0, regularizer=None,
+                 trainable=True, do_model_average=True, need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        if isinstance(attr, Initializer):
+            return ParamAttr(initializer=attr)
+        if attr is False:
+            return False
+        return ParamAttr()
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    _GLOBAL_INIT[0] = weight_init
+    _GLOBAL_INIT[1] = bias_init
+
+
+_GLOBAL_INIT = [None, None]

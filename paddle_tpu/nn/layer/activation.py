@@ -1,0 +1,78 @@
+"""Activation layers (reference: python/paddle/nn/layer/activation.py)."""
+from __future__ import annotations
+
+from .. import functional as F
+from ..initializer import Constant
+from .layers import Layer
+
+
+def _simple(fn_name, **fixed):
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            self._kwargs = {**fixed}
+            # positional args map onto the functional's named params in order
+            fn = getattr(F, fn_name)
+            import inspect
+
+            params = [p for p in inspect.signature(fn).parameters if p not in ("x", "name")]
+            for name_, val in zip(params, args):
+                self._kwargs[name_] = val
+            for k, v in kwargs.items():
+                if k != "name":
+                    self._kwargs[k] = v
+
+        def forward(self, x):
+            return getattr(F, fn_name)(x, **self._kwargs)
+
+    _Act.__name__ = fn_name
+    return _Act
+
+
+ReLU = _simple("relu")
+ReLU6 = _simple("relu6")
+Sigmoid = _simple("sigmoid")
+Tanh = _simple("tanh")
+Silu = _simple("silu")
+Swish = _simple("swish")
+Mish = _simple("mish")
+Hardswish = _simple("hardswish")
+Hardsigmoid = _simple("hardsigmoid")
+Hardtanh = _simple("hardtanh")
+Tanhshrink = _simple("tanhshrink")
+Softsign = _simple("softsign")
+Softshrink = _simple("softshrink")
+Hardshrink = _simple("hardshrink")
+Softplus = _simple("softplus")
+ELU = _simple("elu")
+CELU = _simple("celu")
+SELU = _simple("selu")
+LeakyReLU = _simple("leaky_relu")
+GELU = _simple("gelu")
+LogSoftmax = _simple("log_softmax")
+Softmax = _simple("softmax")
+ThresholdedReLU = _simple("thresholded_relu")
+Maxout = _simple("maxout")
+GLU = _simple("glu")
+
+
+class Tanh_(Layer):
+    def forward(self, x):
+        return F.tanh(x)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter([num_parameters], attr=weight_attr,
+                                            default_initializer=Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self._data_format)
+
+
+class Softmax2D(Layer):
+    def forward(self, x):
+        return F.softmax(x, axis=-3)

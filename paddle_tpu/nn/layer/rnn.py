@@ -1,0 +1,324 @@
+"""Recurrent layers: SimpleRNN / LSTM / GRU (+ cells).
+
+Reference analog: python/paddle/nn/layer/rnn.py over phi cudnn_lstm kernels. TPU-first: the
+time loop is lax.scan (compiler-friendly sequential control flow); gate matmuls batch onto
+the MXU; layers/directions unroll in Python at trace time.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ...ops._apply import defop
+from ..initializer import Uniform
+from .layers import Layer
+
+
+def _cell_step(mode, x_t, h, c, w_ih, w_hh, b_ih, b_hh):
+    gates = x_t @ w_ih.T + h @ w_hh.T
+    if b_ih is not None:
+        gates = gates + b_ih + b_hh
+    if mode == "LSTM":
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return h_new, c_new
+    if mode == "GRU":
+        # paddle/torch gate order: reset, update, new
+        xr, xz, xn = jnp.split(x_t @ w_ih.T + (b_ih if b_ih is not None else 0.0), 3, -1)
+        hr, hz, hn = jnp.split(h @ w_hh.T + (b_hh if b_hh is not None else 0.0), 3, -1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        n = jnp.tanh(xn + r * hn)
+        h_new = (1 - z) * n + z * h
+        return h_new, c
+    act = jnp.tanh if mode == "RNN_TANH" else jax.nn.relu
+    return act(gates), c
+
+
+@defop("rnn_scan")
+def _rnn_forward(x, init_h, init_c, weights, mode="LSTM", num_layers=1, bidirectional=False,
+                 has_bias=True, seq_lens=None):
+    """x: (B, T, I). weights: flat list per (layer, direction):
+    [w_ih, w_hh, (b_ih, b_hh)]."""
+    num_dir = 2 if bidirectional else 1
+    per = 4 if has_bias else 2
+    outputs = x
+    h_stack, c_stack = [], []
+    idx = 0
+    for layer in range(num_layers):
+        dir_outs = []
+        for d in range(num_dir):
+            w_ih = weights[idx]
+            w_hh = weights[idx + 1]
+            b_ih = weights[idx + 2] if has_bias else None
+            b_hh = weights[idx + 3] if has_bias else None
+            idx += per
+            h0 = init_h[layer * num_dir + d]
+            c0 = init_c[layer * num_dir + d] if init_c is not None else jnp.zeros_like(h0)
+            seq = outputs if d == 0 else jnp.flip(outputs, axis=1)
+            xs = jnp.swapaxes(seq, 0, 1)  # (T, B, I)
+
+            def step(carry, x_t, w_ih=w_ih, w_hh=w_hh, b_ih=b_ih, b_hh=b_hh):
+                h, c = carry
+                h2, c2 = _cell_step(mode, x_t, h, c, w_ih, w_hh, b_ih, b_hh)
+                return (h2, c2), h2
+
+            (h_T, c_T), ys = jax.lax.scan(step, (h0, c0), xs)
+            ys = jnp.swapaxes(ys, 0, 1)  # (B, T, H)
+            if d == 1:
+                ys = jnp.flip(ys, axis=1)
+            dir_outs.append(ys)
+            h_stack.append(h_T)
+            c_stack.append(c_T)
+        outputs = dir_outs[0] if num_dir == 1 else jnp.concatenate(dir_outs, axis=-1)
+    h_n = jnp.stack(h_stack)
+    if mode == "LSTM":
+        return outputs, h_n, jnp.stack(c_stack)
+    return outputs, h_n
+
+
+class RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        self.num_directions = 2 if self.bidirectional else 1
+        gate_mult = {"LSTM": 4, "GRU": 3, "RNN_TANH": 1, "RNN_RELU": 1}[mode]
+        std = 1.0 / np.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self._weight_names = []
+        for layer in range(num_layers):
+            in_sz = input_size if layer == 0 else hidden_size * self.num_directions
+            for d in range(self.num_directions):
+                sfx = f"l{layer}" + ("_reverse" if d == 1 else "")
+                w_ih = self.create_parameter([gate_mult * hidden_size, in_sz],
+                                             attr=weight_ih_attr, default_initializer=init)
+                w_hh = self.create_parameter([gate_mult * hidden_size, hidden_size],
+                                             attr=weight_hh_attr, default_initializer=init)
+                b_ih = self.create_parameter([gate_mult * hidden_size], attr=bias_ih_attr,
+                                             is_bias=True, default_initializer=init)
+                b_hh = self.create_parameter([gate_mult * hidden_size], attr=bias_hh_attr,
+                                             is_bias=True, default_initializer=init)
+                self.add_parameter(f"weight_ih_{sfx}", w_ih)
+                self.add_parameter(f"weight_hh_{sfx}", w_hh)
+                self.add_parameter(f"bias_ih_{sfx}", b_ih)
+                self.add_parameter(f"bias_hh_{sfx}", b_hh)
+                self._weight_names += [f"weight_ih_{sfx}", f"weight_hh_{sfx}",
+                                       f"bias_ih_{sfx}", f"bias_hh_{sfx}"]
+
+    def _flat_weights(self):
+        return [self._parameters[n] for n in self._weight_names]
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops.creation import zeros
+        from ...ops.manipulation import transpose, unbind
+
+        x = inputs
+        if self.time_major:
+            x = transpose(x, [1, 0, 2])
+        b = x.shape[0]
+        n_state = self.num_layers * self.num_directions
+        if self.mode == "LSTM":
+            if initial_states is None:
+                h0 = zeros([n_state, b, self.hidden_size], "float32")
+                c0 = zeros([n_state, b, self.hidden_size], "float32")
+            else:
+                h0, c0 = initial_states
+            out, h_n, c_n = _rnn_forward(x, h0, c0, self._flat_weights(), mode=self.mode,
+                                         num_layers=self.num_layers,
+                                         bidirectional=self.bidirectional, has_bias=True)
+            if self.time_major:
+                out = transpose(out, [1, 0, 2])
+            return out, (h_n, c_n)
+        if initial_states is None:
+            h0 = zeros([n_state, b, self.hidden_size], "float32")
+        else:
+            h0 = initial_states
+        out, h_n = _rnn_forward(x, h0, None, self._flat_weights(), mode=self.mode,
+                                num_layers=self.num_layers,
+                                bidirectional=self.bidirectional, has_bias=True)
+        if self.time_major:
+            out = transpose(out, [1, 0, 2])
+        return out, h_n
+
+
+class SimpleRNN(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kwargs):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction, time_major,
+                         dropout, **kwargs)
+
+
+class LSTM(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kwargs):
+        kwargs.pop("proj_size", None)
+        super().__init__("LSTM", input_size, hidden_size, num_layers, direction, time_major,
+                         dropout, **kwargs)
+
+
+class GRU(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction, time_major,
+                         dropout, **kwargs)
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None, init_value=0.0,
+                           batch_dim_idx=0):
+        from ...ops.creation import full
+
+        b = batch_ref.shape[batch_dim_idx]
+        return full([b, self.hidden_size], init_value, dtype or "float32")
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / np.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter([hidden_size, input_size], weight_ih_attr,
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size], weight_hh_attr,
+                                               default_initializer=init)
+        self.bias_ih = self.create_parameter([hidden_size], bias_ih_attr, is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter([hidden_size], bias_hh_attr, is_bias=True,
+                                             default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        @defop("simple_rnn_cell")
+        def _cell(x, h, w_ih, w_hh, b_ih, b_hh, activation="tanh"):
+            g = x @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+            return jnp.tanh(g) if activation == "tanh" else jax.nn.relu(g)
+
+        h = _cell(inputs, states, self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh,
+                  activation=self.activation)
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        std = 1.0 / np.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size], weight_ih_attr,
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size],
+                                               weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([4 * hidden_size], bias_ih_attr, is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter([4 * hidden_size], bias_hh_attr, is_bias=True,
+                                             default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs)
+            c = self.get_initial_states(inputs)
+        else:
+            h, c = states
+
+        @defop("lstm_cell")
+        def _cell(x, h, c, w_ih, w_hh, b_ih, b_hh):
+            h2, c2 = _cell_step("LSTM", x, h, c, w_ih, w_hh, b_ih, b_hh)
+            return h2, c2
+
+        h2, c2 = _cell(inputs, h, c, self.weight_ih, self.weight_hh, self.bias_ih,
+                       self.bias_hh)
+        return h2, (h2, c2)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        std = 1.0 / np.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size], weight_ih_attr,
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size],
+                                               weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([3 * hidden_size], bias_ih_attr, is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter([3 * hidden_size], bias_hh_attr, is_bias=True,
+                                             default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        @defop("gru_cell")
+        def _cell(x, h, w_ih, w_hh, b_ih, b_hh):
+            h2, _ = _cell_step("GRU", x, h, None, w_ih, w_hh, b_ih, b_hh)
+            return h2
+
+        h2 = _cell(inputs, states, self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh)
+        return h2, h2
+
+
+class RNN(Layer):
+    """Generic RNN wrapper running a cell over time (reference: nn/layer/rnn.py RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops.manipulation import stack, transpose, unbind
+
+        x = inputs if not self.time_major else transpose(inputs, [1, 0, 2])
+        steps = unbind(x, axis=1)
+        if self.is_reverse:
+            steps = steps[::-1]
+        states = initial_states
+        outs = []
+        for s in steps:
+            out, states = self.cell(s, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        y = stack(outs, axis=1)
+        if self.time_major:
+            y = transpose(y, [1, 0, 2])
+        return y, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops.manipulation import concat
+
+        st_fw, st_bw = (initial_states if initial_states is not None else (None, None))
+        y1, s1 = self.rnn_fw(inputs, st_fw, sequence_length)
+        y2, s2 = self.rnn_bw(inputs, st_bw, sequence_length)
+        return concat([y1, y2], axis=-1), (s1, s2)

@@ -460,7 +460,9 @@ def _make_inplace(fn):
         x._replace_value(out.value)
         x._grad_node = out._grad_node
         x._out_index = out._out_index
-        x.stop_gradient = out.stop_gradient
+        # never flip a trainable tensor to stop_gradient just because the op ran under
+        # no_grad — only tighten, never loosen, matches indexing.setitem_
+        x.stop_gradient = x.stop_gradient and out.stop_gradient
         return x
 
     return inplace

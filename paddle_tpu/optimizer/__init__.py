@@ -1,0 +1,6 @@
+"""paddle_tpu.optimizer (reference: python/paddle/optimizer)."""
+from . import lr  # noqa: F401
+from .optimizer import (  # noqa: F401
+    ASGD, LBFGS, Adadelta, Adagrad, Adam, Adamax, AdamW, L1Decay, L2Decay, Lamb, Momentum,
+    NAdam, Optimizer, RAdam, RMSProp, Rprop, SGD,
+)

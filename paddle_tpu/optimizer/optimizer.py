@@ -1,0 +1,616 @@
+"""Optimizers.
+
+Reference analog: python/paddle/optimizer/optimizer.py base + per-optimizer phi kernels
+(adamw_kernel etc.). TPU-first: each optimizer defines a pure per-leaf update rule; step()
+executes ONE jitted function over the whole parameter pytree (the fused multi-tensor apply —
+XLA fuses all per-param updates into one executable, replacing the reference's
+multi_tensor_adam CUDA path). Master weights (AMP O2) keep an fp32 shadow per low-precision
+param, matching optimizer.py:318 _master_weights.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtype as dtype_mod
+from ..framework.core import Parameter, Tensor
+from .lr import LRScheduler
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+
+class Optimizer:
+    _rule_name = "base"
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        if parameters is None:
+            raise ValueError(
+                "parameters is required in eager mode: pass model.parameters()"
+            )
+        # param groups (reference optimizer.py supports dict groups)
+        self._param_groups = []
+        params = list(parameters)
+        if params and isinstance(params[0], dict):
+            for g in params:
+                grp = dict(g)
+                grp["params"] = list(g["params"])
+                self._param_groups.append(grp)
+        else:
+            self._param_groups.append({"params": params})
+        self._learning_rate = learning_rate
+        if isinstance(weight_decay, (L2Decay,)):
+            self._weight_decay = weight_decay.coeff
+            self._coupled_decay = True
+        elif isinstance(weight_decay, L1Decay):
+            self._weight_decay = weight_decay.coeff
+            self._coupled_decay = "l1"
+        else:
+            self._weight_decay = float(weight_decay) if weight_decay else 0.0
+            self._coupled_decay = True
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        self._use_master_weights = multi_precision
+        self._use_master_grad = False
+        self._accumulators = {}  # id(param) -> state dict
+        self._master_weights = {}  # id(param) -> fp32 jax array
+        self._step_count = 0
+        self._jit_cache = {}
+
+    # -- lr ------------------------------------------------------------------
+    def get_lr(self):
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    def _parameter_list_flat(self):
+        return [p for g in self._param_groups for p in g["params"]]
+
+    @property
+    def _parameter_list(self):
+        return self._parameter_list_flat()
+
+    # -- state ---------------------------------------------------------------
+    def _init_state(self, p):
+        """Return dict of state arrays for param p (fp32)."""
+        return {}
+
+    def _rule(self, p, g, state, lr, **hyper):
+        """Pure update: (p32, g32, state, lr) -> (new_p32, new_state)."""
+        raise NotImplementedError
+
+    def _hyper(self, group):
+        return {}
+
+    # -- step ----------------------------------------------------------------
+    @jax.named_scope("optimizer_step")
+    def step(self):
+        self._step_count += 1
+        lr_scalar = jnp.asarray(self.get_lr(), jnp.float32)
+        for group in self._param_groups:
+            params = [p for p in group["params"] if not p.stop_gradient or p.grad is not None]
+            # plain Tensors (stop_gradient=False) are accepted alongside Parameters
+            pg = [(p, p.grad) for p in params
+                  if p.grad is not None and getattr(p, "trainable", True)]
+            if not pg:
+                continue
+            if self._grad_clip is not None:
+                pg = self._grad_clip(pg)
+            hyper = self._hyper(group)
+            wd = group.get("weight_decay", self._weight_decay)
+            if isinstance(wd, (L2Decay, L1Decay)):
+                wd = wd.coeff
+            # gather values + states
+            p_vals, g_vals, states, masters = [], [], [], []
+            for p, g in pg:
+                if id(p) not in self._accumulators:
+                    self._accumulators[id(p)] = self._init_state(p)
+                    if self._use_master_weights and np.dtype(p.dtype) in (
+                        np.dtype(np.float16), np.dtype(jnp.bfloat16)
+                    ):
+                        self._master_weights[id(p)] = p.value.astype(jnp.float32)
+                states.append(self._accumulators[id(p)])
+                masters.append(self._master_weights.get(id(p)))
+                p_vals.append(p.value)
+                g_vals.append(g.value)
+            new_ps, new_states, new_masters = self._fused_apply(
+                p_vals, g_vals, states, masters, lr_scalar, float(wd), hyper,
+                [getattr(p, "optimize_attr", {}).get("learning_rate", 1.0) for p, _ in pg],
+            )
+            for (p, _), np_, ns, nm in zip(pg, new_ps, new_states, new_masters):
+                p._replace_value(np_)
+                self._accumulators[id(p)] = ns
+                if nm is not None:
+                    self._master_weights[id(p)] = nm
+
+    def _fused_apply(self, p_vals, g_vals, states, masters, lr, wd, hyper, lr_mults):
+        """One jitted call updating every parameter (fused multi-tensor apply)."""
+        key = (len(p_vals), tuple(v.shape for v in p_vals),
+               tuple(str(v.dtype) for v in p_vals), tuple(sorted(hyper.items())), wd,
+               tuple(lr_mults), tuple(m is not None for m in masters))
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            rule = self._rule
+            coupled = self._coupled_decay
+
+            def apply_all(p_vals, g_vals, states, masters, lr, step):
+                outs, out_states, out_masters = [], [], []
+                for pv, gv, st, mw, mult in zip(p_vals, g_vals, states, masters,
+                                                list(lr_mults)):
+                    p32 = mw if mw is not None else pv.astype(jnp.float32)
+                    g32 = gv.astype(jnp.float32)
+                    if wd and coupled is True:
+                        g32 = g32 + wd * p32
+                    elif wd and coupled == "l1":
+                        g32 = g32 + wd * jnp.sign(p32)
+                    new_p32, new_st = rule(p32, g32, st, lr * mult, step=step, wd=wd,
+                                           **hyper)
+                    outs.append(new_p32.astype(pv.dtype))
+                    out_states.append(new_st)
+                    out_masters.append(new_p32 if mw is not None else None)
+                return outs, out_states, out_masters
+
+            fn = jax.jit(apply_all)
+            self._jit_cache[key] = fn
+        step_arr = jnp.asarray(self._step_count, jnp.float32)
+        return fn(p_vals, g_vals, states, masters, lr, step_arr)
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._parameter_list_flat():
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    # -- persistence ----------------------------------------------------------
+    def state_dict(self):
+        state = {"LR_Scheduler": {}, "master_weights": {}}
+        if isinstance(self._learning_rate, LRScheduler):
+            state["LR_Scheduler"] = self._learning_rate.state_dict()
+        for i, p in enumerate(self._parameter_list_flat()):
+            name = p.name or f"param_{i}"
+            acc = self._accumulators.get(id(p))
+            if acc:
+                for k, v in acc.items():
+                    state[f"{name}_{k}"] = Tensor(v)
+            if id(p) in self._master_weights:
+                state["master_weights"][name] = Tensor(self._master_weights[id(p)])
+        state["@step"] = self._step_count
+        return state
+
+    def set_state_dict(self, state):
+        self._step_count = state.get("@step", 0)
+        if isinstance(self._learning_rate, LRScheduler) and state.get("LR_Scheduler"):
+            self._learning_rate.set_state_dict(state["LR_Scheduler"])
+        for i, p in enumerate(self._parameter_list_flat()):
+            name = p.name or f"param_{i}"
+            acc = self._init_state(p)
+            found = False
+            for k in list(acc):
+                sk = f"{name}_{k}"
+                if sk in state:
+                    v = state[sk]
+                    acc[k] = v.value if isinstance(v, Tensor) else jnp.asarray(v)
+                    found = True
+            if found:
+                self._accumulators[id(p)] = acc
+            mw = state.get("master_weights", {}).get(name)
+            if mw is not None:
+                self._master_weights[id(p)] = mw.value if isinstance(mw, Tensor) else mw
+
+    load_state_dict = set_state_dict
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision)
+
+    def _rule(self, p, g, state, lr, **kw):
+        return p - lr * g, state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, rescale_grad=1.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_state(self, p):
+        return {"velocity": jnp.zeros(p.value.shape, jnp.float32)}
+
+    def _hyper(self, group):
+        return {"momentum": group.get("momentum", self._momentum),
+                "nesterov": self._nesterov}
+
+    def _rule(self, p, g, state, lr, momentum=0.9, nesterov=False, **kw):
+        v = momentum * state["velocity"] + g
+        if nesterov:
+            p_new = p - lr * (g + momentum * v)
+        else:
+            p_new = p - lr * v
+        return p_new, {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, use_multi_tensor=False, amsgrad=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._amsgrad = amsgrad
+
+    def _init_state(self, p):
+        st = {"moment1": jnp.zeros(p.value.shape, jnp.float32),
+              "moment2": jnp.zeros(p.value.shape, jnp.float32)}
+        if self._amsgrad:
+            st["moment2_max"] = jnp.zeros(p.value.shape, jnp.float32)
+        return st
+
+    def _hyper(self, group):
+        return {
+            "beta1": group.get("beta1", self._beta1),
+            "beta2": group.get("beta2", self._beta2),
+            "eps": self._eps,
+        }
+
+    def _rule(self, p, g, state, lr, beta1=0.9, beta2=0.999, eps=1e-8, step=1.0, **kw):
+        m = beta1 * state["moment1"] + (1 - beta1) * g
+        v = beta2 * state["moment2"] + (1 - beta2) * jnp.square(g)
+        mhat = m / (1 - jnp.power(beta1, step))
+        vhat = v / (1 - jnp.power(beta2, step))
+        new_state = {"moment1": m, "moment2": v}
+        if self._amsgrad:
+            vmax = jnp.maximum(state["moment2_max"], vhat)
+            new_state["moment2_max"] = vmax
+            vhat = vmax
+        p_new = p - lr * mhat / (jnp.sqrt(vhat) + eps)
+        return p_new, new_state
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False, amsgrad=False,
+                 name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters, None, grad_clip,
+                         lazy_mode, multi_precision, amsgrad=amsgrad)
+        self._weight_decay = float(weight_decay) if weight_decay else 0.0
+        self._coupled_decay = False  # decoupled
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _rule(self, p, g, state, lr, beta1=0.9, beta2=0.999, eps=1e-8, step=1.0, wd=0.0,
+              **kw):
+        p = p * (1 - lr * wd)
+        return super()._rule(p, g, state, lr, beta1, beta2, eps, step=step)
+
+    def step(self):
+        # honor apply_decay_param_fun by zeroing decay for excluded params via groups
+        if self._apply_decay_param_fun is not None:
+            include, exclude = [], []
+            for p in self._parameter_list_flat():
+                (include if self._apply_decay_param_fun(p.name) else exclude).append(p)
+            saved = self._param_groups
+            self._param_groups = [
+                {"params": include, "weight_decay": self._weight_decay},
+                {"params": exclude, "weight_decay": 0.0},
+            ]
+            try:
+                super().step()
+            finally:
+                self._param_groups = saved
+        else:
+            super().step()
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _init_state(self, p):
+        return {"moment": jnp.zeros(p.value.shape, jnp.float32),
+                "inf_norm": jnp.zeros(p.value.shape, jnp.float32)}
+
+    def _hyper(self, group):
+        return {"beta1": self._beta1, "beta2": self._beta2, "eps": self._eps}
+
+    def _rule(self, p, g, state, lr, beta1=0.9, beta2=0.999, eps=1e-8, step=1.0, **kw):
+        m = beta1 * state["moment"] + (1 - beta1) * g
+        u = jnp.maximum(beta2 * state["inf_norm"], jnp.abs(g))
+        p_new = p - lr / (1 - jnp.power(beta1, step)) * m / (u + eps)
+        return p_new, {"moment": m, "inf_norm": u}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None, weight_decay=None,
+                 grad_clip=None, initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_state(self, p):
+        return {"moment": jnp.full(p.value.shape, self._init_acc, jnp.float32)}
+
+    def _hyper(self, group):
+        return {"eps": self._eps}
+
+    def _rule(self, p, g, state, lr, eps=1e-6, **kw):
+        acc = state["moment"] + jnp.square(g)
+        return p - lr * g / (jnp.sqrt(acc) + eps), {"moment": acc}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._eps, self._rho = epsilon, rho
+
+    def _init_state(self, p):
+        return {"avg_squared_grad": jnp.zeros(p.value.shape, jnp.float32),
+                "avg_squared_update": jnp.zeros(p.value.shape, jnp.float32)}
+
+    def _hyper(self, group):
+        return {"eps": self._eps, "rho": self._rho}
+
+    def _rule(self, p, g, state, lr, eps=1e-6, rho=0.95, **kw):
+        eg = rho * state["avg_squared_grad"] + (1 - rho) * jnp.square(g)
+        update = -jnp.sqrt(state["avg_squared_update"] + eps) / jnp.sqrt(eg + eps) * g
+        eu = rho * state["avg_squared_update"] + (1 - rho) * jnp.square(update)
+        return p + lr * update, {"avg_squared_grad": eg, "avg_squared_update": eu}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0, centered=False,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho, self._eps, self._momentum, self._centered = rho, epsilon, momentum, centered
+
+    def _init_state(self, p):
+        return {"mean_square": jnp.zeros(p.value.shape, jnp.float32),
+                "mean_grad": jnp.zeros(p.value.shape, jnp.float32),
+                "momentum_acc": jnp.zeros(p.value.shape, jnp.float32)}
+
+    def _hyper(self, group):
+        return {"rho": self._rho, "eps": self._eps, "momentum": self._momentum,
+                "centered": self._centered}
+
+    def _rule(self, p, g, state, lr, rho=0.95, eps=1e-6, momentum=0.0, centered=False, **kw):
+        ms = rho * state["mean_square"] + (1 - rho) * jnp.square(g)
+        mg = rho * state["mean_grad"] + (1 - rho) * g if centered else state["mean_grad"]
+        denom = ms - jnp.square(mg) if centered else ms
+        mom = momentum * state["momentum_acc"] + lr * g / jnp.sqrt(denom + eps)
+        return p - mom, {"mean_square": ms, "mean_grad": mg, "momentum_acc": mom}
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, parameters=None, grad_clip=None, exclude_from_weight_decay_fn=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, multi_precision)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+        self._coupled_decay = False
+
+    def _init_state(self, p):
+        return {"moment1": jnp.zeros(p.value.shape, jnp.float32),
+                "moment2": jnp.zeros(p.value.shape, jnp.float32)}
+
+    def _hyper(self, group):
+        return {"beta1": self._beta1, "beta2": self._beta2, "eps": self._eps,
+                "lamb_wd": self._lamb_wd}
+
+    def _rule(self, p, g, state, lr, beta1=0.9, beta2=0.999, eps=1e-6, lamb_wd=0.01,
+              step=1.0, **kw):
+        m = beta1 * state["moment1"] + (1 - beta1) * g
+        v = beta2 * state["moment2"] + (1 - beta2) * jnp.square(g)
+        mhat = m / (1 - jnp.power(beta1, step))
+        vhat = v / (1 - jnp.power(beta2, step))
+        r = mhat / (jnp.sqrt(vhat) + eps) + lamb_wd * p
+        w_norm = jnp.linalg.norm(p)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return p - lr * trust * r, {"moment1": m, "moment2": v}
+
+
+class NAdam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 momentum_decay=0.004, parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._psi = momentum_decay
+
+    def _init_state(self, p):
+        return {"moment1": jnp.zeros(p.value.shape, jnp.float32),
+                "moment2": jnp.zeros(p.value.shape, jnp.float32),
+                "mu_product": jnp.ones((), jnp.float32)}
+
+    def _hyper(self, group):
+        return {"beta1": self._beta1, "beta2": self._beta2, "eps": self._eps,
+                "psi": self._psi}
+
+    def _rule(self, p, g, state, lr, beta1=0.9, beta2=0.999, eps=1e-8, psi=0.004, step=1.0,
+              **kw):
+        mu_t = beta1 * (1 - 0.5 * jnp.power(0.96, step * psi))
+        mu_t1 = beta1 * (1 - 0.5 * jnp.power(0.96, (step + 1) * psi))
+        mu_prod = state["mu_product"] * mu_t
+        m = beta1 * state["moment1"] + (1 - beta1) * g
+        v = beta2 * state["moment2"] + (1 - beta2) * jnp.square(g)
+        mhat = mu_t1 * m / (1 - mu_prod * mu_t1) + (1 - mu_t) * g / (1 - mu_prod)
+        vhat = v / (1 - jnp.power(beta2, step))
+        return p - lr * mhat / (jnp.sqrt(vhat) + eps), {
+            "moment1": m, "moment2": v, "mu_product": mu_prod
+        }
+
+
+class RAdam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _init_state(self, p):
+        return {"moment1": jnp.zeros(p.value.shape, jnp.float32),
+                "moment2": jnp.zeros(p.value.shape, jnp.float32)}
+
+    def _hyper(self, group):
+        return {"beta1": self._beta1, "beta2": self._beta2, "eps": self._eps}
+
+    def _rule(self, p, g, state, lr, beta1=0.9, beta2=0.999, eps=1e-8, step=1.0, **kw):
+        m = beta1 * state["moment1"] + (1 - beta1) * g
+        v = beta2 * state["moment2"] + (1 - beta2) * jnp.square(g)
+        mhat = m / (1 - jnp.power(beta1, step))
+        rho_inf = 2.0 / (1 - beta2) - 1
+        beta2t = jnp.power(beta2, step)
+        rho_t = rho_inf - 2 * step * beta2t / (1 - beta2t)
+
+        def rect_update():
+            r = jnp.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf)
+                         / ((rho_inf - 4) * (rho_inf - 2) * rho_t))
+            vhat = jnp.sqrt(v / (1 - beta2t))
+            return p - lr * r * mhat / (vhat + eps)
+
+        p_new = jnp.where(rho_t > 5.0, rect_update(), p - lr * mhat)
+        return p_new, {"moment1": m, "moment2": v}
+
+
+class ASGD(Optimizer):
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision)
+        self._batch_num = batch_num
+
+    def _init_state(self, p):
+        return {"d": jnp.zeros(p.value.shape, jnp.float32),
+                "ys": jnp.zeros((self._batch_num,) + tuple(p.value.shape), jnp.float32)}
+
+    def _hyper(self, group):
+        return {"batch_num": self._batch_num}
+
+    def _rule(self, p, g, state, lr, batch_num=1, step=1.0, **kw):
+        idx = (jnp.asarray(step, jnp.int32) - 1) % batch_num
+        y_old = state["ys"][idx]
+        d = state["d"] - y_old + g
+        ys = state["ys"].at[idx].set(g)
+        n = jnp.minimum(step, float(batch_num))
+        return p - lr * d / n, {"d": d, "ys": ys}
+
+
+class Rprop(Optimizer):
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, multi_precision)
+        self._lr_range = learning_rate_range
+        self._etas = etas
+
+    def _init_state(self, p):
+        return {"prev_grad": jnp.zeros(p.value.shape, jnp.float32),
+                "lrs": jnp.full(p.value.shape, self.get_lr(), jnp.float32)}
+
+    def _hyper(self, group):
+        return {"eta_neg": self._etas[0], "eta_pos": self._etas[1],
+                "lr_min": self._lr_range[0], "lr_max": self._lr_range[1]}
+
+    def _rule(self, p, g, state, lr, eta_neg=0.5, eta_pos=1.2, lr_min=1e-5, lr_max=50.0,
+              **kw):
+        sign = jnp.sign(g * state["prev_grad"])
+        factor = jnp.where(sign > 0, eta_pos, jnp.where(sign < 0, eta_neg, 1.0))
+        lrs = jnp.clip(state["lrs"] * factor, lr_min, lr_max)
+        g_eff = jnp.where(sign < 0, 0.0, g)
+        return p - lrs * jnp.sign(g_eff), {"prev_grad": g_eff, "lrs": lrs}
+
+
+class LBFGS(Optimizer):
+    """L-BFGS (reference: python/paddle/optimizer/lbfgs.py). Runs closure-based full-batch
+    optimization; history kept on host."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None, tolerance_grad=1e-7,
+                 tolerance_change=1e-9, history_size=100, line_search_fn=None,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._max_iter = max_iter
+        self._hist = history_size
+        self._tol_g = tolerance_grad
+        self._tol_c = tolerance_change
+        self._s, self._y = [], []
+        self._prev_flat_g = None
+        self._prev_flat_x = None
+
+    def _flat(self, vals):
+        return jnp.concatenate([v.reshape(-1).astype(jnp.float32) for v in vals])
+
+    def _unflat(self, flat):
+        outs, off = [], 0
+        for p in self._parameter_list_flat():
+            n = int(np.prod(p.value.shape)) if p.value.shape else 1
+            outs.append(flat[off : off + n].reshape(p.value.shape).astype(p.value.dtype))
+            off += n
+        return outs
+
+    def step(self, closure=None):
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure returning the loss")
+        loss = closure()
+        params = self._parameter_list_flat()
+        g = self._flat([p.grad.value for p in params])
+        x = self._flat([p.value for p in params])  # pre-update iterate
+        if self._prev_flat_g is not None:
+            s = x - self._prev_flat_x
+            y = g - self._prev_flat_g
+            if float(jnp.dot(s, y)) > 1e-10:
+                self._s.append(s)
+                self._y.append(y)
+                if len(self._s) > self._hist:
+                    self._s.pop(0)
+                    self._y.pop(0)
+        q = g
+        alphas = []
+        for s, y in zip(reversed(self._s), reversed(self._y)):
+            rho = 1.0 / jnp.dot(y, s)
+            a = rho * jnp.dot(s, q)
+            q = q - a * y
+            alphas.append((a, rho))
+        if self._s:
+            gamma = jnp.dot(self._s[-1], self._y[-1]) / jnp.dot(self._y[-1], self._y[-1])
+            q = gamma * q
+        for (a, rho), s, y in zip(reversed(alphas), self._s, self._y):
+            b = rho * jnp.dot(y, q)
+            q = q + (a - b) * s
+        direction = -q
+        lr = self.get_lr()
+        new_x = x + lr * direction
+        for p, nv in zip(params, self._unflat(new_x)):
+            p._replace_value(nv)
+        self._prev_flat_g = g
+        self._prev_flat_x = x  # curvature pair s = x_{k+1} - x_k needs the PRE-update x
+        return loss

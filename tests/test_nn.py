@@ -1,0 +1,190 @@
+"""nn layer tests (reference analog: test/legacy_test per-layer tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear_shapes_and_grad():
+    l = nn.Linear(8, 4)
+    x = paddle.randn([3, 8])
+    y = l(x)
+    assert y.shape == [3, 4]
+    y.sum().backward()
+    assert l.weight.grad is not None and l.weight.grad.shape == [8, 4]
+    assert l.bias.grad.shape == [4]
+
+
+def test_conv2d_matches_manual():
+    conv = nn.Conv2D(2, 3, 3, padding=1)
+    x = paddle.randn([1, 2, 8, 8])
+    y = conv(x)
+    assert y.shape == [1, 3, 8, 8]
+    y.mean().backward()
+    assert conv.weight.grad.shape == [3, 2, 3, 3]
+
+
+def test_conv2d_vs_numpy():
+    import jax
+
+    w = np.random.rand(1, 1, 3, 3).astype(np.float32)
+    x = np.random.rand(1, 1, 5, 5).astype(np.float32)
+    out = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), padding=0)
+    # direct correlation
+    expect = np.zeros((3, 3), np.float32)
+    for i in range(3):
+        for j in range(3):
+            expect[i, j] = (x[0, 0, i : i + 3, j : j + 3] * w[0, 0]).sum()
+    np.testing.assert_allclose(out.numpy()[0, 0], expect, rtol=1e-5)
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(3)
+    x = paddle.randn([4, 3, 5, 5])
+    bn.train()
+    y = bn(x)
+    out = y.numpy()
+    np.testing.assert_allclose(out.mean(axis=(0, 2, 3)), 0.0, atol=1e-5)
+    np.testing.assert_allclose(out.std(axis=(0, 2, 3)), 1.0, atol=1e-2)
+    # running stats updated
+    assert not np.allclose(bn._mean.numpy(), 0.0)
+    bn.eval()
+    y2 = bn(x)
+    assert y2.shape == y.shape
+
+
+def test_layernorm_rmsnorm():
+    ln = nn.LayerNorm(16)
+    x = paddle.randn([2, 8, 16])
+    y = ln(x)
+    np.testing.assert_allclose(y.numpy().mean(-1), 0.0, atol=1e-5)
+    rn = nn.RMSNorm(16)
+    y2 = rn(x)
+    assert y2.shape == [2, 8, 16]
+
+
+def test_dropout_modes():
+    d = nn.Dropout(0.5)
+    x = paddle.ones([1000])
+    d.train()
+    y = d(x)
+    kept = (y.numpy() > 0).mean()
+    assert 0.3 < kept < 0.7
+    np.testing.assert_allclose(y.numpy()[y.numpy() > 0], 2.0)  # upscale_in_train
+    d.eval()
+    np.testing.assert_allclose(d(x).numpy(), 1.0)
+
+
+def test_embedding_padding_idx():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    x = paddle.to_tensor([[0, 1], [2, 0]])
+    y = emb(x)
+    np.testing.assert_allclose(y.numpy()[0, 0], 0.0)
+    y.sum().backward()
+    assert emb.weight.grad is not None
+
+
+def test_sequential_and_state_dict():
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    sd = m.state_dict()
+    assert set(sd) == {"0.weight", "0.bias", "2.weight", "2.bias"}
+    m2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    m2.set_state_dict(sd)
+    np.testing.assert_allclose(m2[0].weight.numpy(), m[0].weight.numpy())
+    x = paddle.randn([3, 4])
+    np.testing.assert_allclose(m(x).numpy(), m2(x).numpy(), rtol=1e-6)
+
+
+def test_save_load_state_dict(tmp_path):
+    m = nn.Linear(4, 2)
+    path = str(tmp_path / "model.pdparams")
+    paddle.save(m.state_dict(), path)
+    m2 = nn.Linear(4, 2)
+    m2.set_state_dict(paddle.load(path))
+    np.testing.assert_allclose(m.weight.numpy(), m2.weight.numpy())
+
+
+def test_losses():
+    logits = paddle.to_tensor(np.random.rand(4, 5).astype(np.float32), stop_gradient=False)
+    label = paddle.to_tensor([0, 1, 2, 3])
+    loss = F.cross_entropy(logits, label)
+    assert loss.shape == []
+    loss.backward()
+    assert logits.grad is not None
+    # vs manual
+    lx = logits.numpy()
+    p = np.exp(lx) / np.exp(lx).sum(-1, keepdims=True)
+    expect = -np.log(p[np.arange(4), [0, 1, 2, 3]]).mean()
+    np.testing.assert_allclose(float(loss), expect, rtol=1e-5)
+    assert float(F.mse_loss(paddle.ones([3]), paddle.zeros([3]))) == 1.0
+    bce = F.binary_cross_entropy_with_logits(paddle.zeros([3]), paddle.ones([3]))
+    np.testing.assert_allclose(float(bce), np.log(2), rtol=1e-5)
+
+
+def test_cross_entropy_ignore_index_and_smoothing():
+    logits = paddle.to_tensor(np.random.rand(4, 5).astype(np.float32))
+    label = paddle.to_tensor([0, -100, 2, -100])
+    loss = F.cross_entropy(logits, label, ignore_index=-100)
+    lx = logits.numpy()
+    p = np.exp(lx) / np.exp(lx).sum(-1, keepdims=True)
+    expect = -np.log(p[[0, 2], [0, 2]]).mean()
+    np.testing.assert_allclose(float(loss), expect, rtol=1e-5)
+    loss2 = F.cross_entropy(logits, paddle.to_tensor([0, 1, 2, 3]), label_smoothing=0.1)
+    assert float(loss2) > 0
+
+
+def test_pooling():
+    x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    y = F.max_pool2d(x, 2)
+    np.testing.assert_allclose(y.numpy()[0, 0], [[5, 7], [13, 15]])
+    y2 = F.avg_pool2d(x, 2)
+    np.testing.assert_allclose(y2.numpy()[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+    y3 = F.adaptive_avg_pool2d(x, 1)
+    np.testing.assert_allclose(y3.numpy()[0, 0, 0, 0], 7.5)
+
+
+def test_mha_and_transformer():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.randn([2, 6, 16])
+    y = mha(x)
+    assert y.shape == [2, 6, 16]
+    enc_layer = nn.TransformerEncoderLayer(16, 4, 32)
+    enc = nn.TransformerEncoder(enc_layer, 2)
+    out = enc(x)
+    assert out.shape == [2, 6, 16]
+    out.sum().backward()
+    assert enc.layers[0].linear1.weight.grad is not None
+    # distinct copies: layer 1 params differ from layer 0
+    assert not np.allclose(enc.layers[0].linear1.weight.numpy(),
+                           enc.layers[1].linear1.weight.numpy())
+
+
+def test_sdpa_causal():
+    q = paddle.randn([1, 4, 2, 8])
+    out = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+    assert out.shape == [1, 4, 2, 8]
+
+
+def test_lstm_gru():
+    lstm = nn.LSTM(8, 16, num_layers=2, direction="bidirect")
+    x = paddle.randn([3, 5, 8])
+    y, (h, c) = lstm(x)
+    assert y.shape == [3, 5, 32]
+    assert h.shape == [4, 3, 16] and c.shape == [4, 3, 16]
+    y.sum().backward()
+    gru = nn.GRU(8, 16)
+    y2, h2 = gru(x)
+    assert y2.shape == [3, 5, 16] and h2.shape == [1, 3, 16]
+
+
+def test_param_freeze_and_hooks():
+    l = nn.Linear(4, 4)
+    l.bias.stop_gradient = True
+    calls = []
+    l.register_forward_post_hook(lambda layer, inp, out: calls.append(1))
+    y = l(paddle.randn([2, 4]))
+    y.sum().backward()
+    assert calls == [1]
+    assert l.bias.grad is None and l.weight.grad is not None
