@@ -38,6 +38,7 @@ from .ops import (  # noqa: F401,E402  (names shadowed by python builtins in *)
 from . import amp  # noqa: F401,E402
 from . import autograd  # noqa: F401,E402
 from . import device  # noqa: F401,E402
+from . import distributed  # noqa: F401,E402
 from . import framework  # noqa: F401,E402
 from . import linalg  # noqa: F401,E402
 from . import nn  # noqa: F401,E402

@@ -1,0 +1,58 @@
+"""paddle_tpu.distributed: mesh/GSPMD-first distributed stack.
+
+Reference analog: python/paddle/distributed/ (SURVEY.md §1 L6, §2.5-2.8). Collectives are
+XLA collectives over ICI/DCN; semi-auto parallel delegates sharding propagation to GSPMD;
+fleet's manual hybrid parallelism is expressed as mesh-axis shardings.
+"""
+from .placement import DistAttr, Partial, Placement, Replicate, Shard  # noqa: F401
+from .process_mesh import ProcessMesh, auto_mesh, get_current_mesh  # noqa: F401
+from .collective import (  # noqa: F401
+    Group,
+    ReduceOp,
+    all_gather,
+    all_gather_concat,
+    all_gather_object,
+    all_reduce,
+    alltoall,
+    alltoall_single,
+    barrier,
+    broadcast,
+    broadcast_object_list,
+    destroy_process_group,
+    get_group,
+    new_group,
+    p2p_rank,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    stack_locals,
+    unstack_locals,
+    wait,
+)
+from .api import (  # noqa: F401
+    ShardingStage1,
+    ShardingStage2,
+    ShardingStage3,
+    dist_attr,
+    dtensor_from_fn,
+    dtensor_from_local,
+    is_dist_tensor,
+    local_value,
+    reshard,
+    shard_layer,
+    shard_optimizer,
+    shard_tensor,
+    unshard_dtensor,
+)
+from .parallel import (  # noqa: F401
+    DataParallel,
+    ParallelEnv,
+    device_count,
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+    is_initialized,
+)
+from . import in_jit  # noqa: F401

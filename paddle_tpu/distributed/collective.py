@@ -1,0 +1,382 @@
+"""Eager collective communication API + process groups.
+
+Reference analog: the ProcessGroup interface (phi/core/distributed/collective/
+process_group.h:48 — AllGather/AllReduce/AllToAll/Broadcast/Reduce/ReduceScatter/Scatter/
+Send/Recv with async Task handles) and python/paddle/distributed/communication/*.
+
+TPU-first redesign: there is no NCCL and no per-rank process making its own call — the
+framework is single-controller SPMD. A "rank's local tensor" is one row of a globally
+addressable array stacked on axis 0 and sharded over the group's devices, so every
+collective is a tiny XLA program over that array and the compiler lays the data movement
+onto ICI. The same ops run inside `shard_map`-captured code via `paddle_tpu.distributed.
+in_jit` (lax.psum & co.), which is the path compiled training steps use. Under real
+multi-host, the stacked array spans hosts (jax.make_array_from_process_local_data) and the
+same code runs unchanged over ICI+DCN.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..framework.core import Tensor
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+_REDUCE_FNS = {
+    ReduceOp.SUM: lambda v, axis: v.sum(axis=axis),
+    ReduceOp.MAX: lambda v, axis: v.max(axis=axis),
+    ReduceOp.MIN: lambda v, axis: v.min(axis=axis),
+    ReduceOp.PROD: lambda v, axis: v.prod(axis=axis),
+    ReduceOp.AVG: lambda v, axis: v.mean(axis=axis),
+}
+
+
+class Group:
+    """A communication group: an ordered set of global device ids."""
+
+    def __init__(self, ranks, gid=0, name=None):
+        self.ranks = list(int(r) for r in ranks)
+        self.id = gid
+        self.name = name or f"group_{gid}"
+        self._mesh = None
+
+    @property
+    def nranks(self):
+        return len(self.ranks)
+
+    @property
+    def world_size(self):
+        return len(self.ranks)
+
+    @property
+    def process_group(self):
+        return self
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def jax_mesh(self):
+        if self._mesh is None:
+            devices = jax.devices()
+            self._mesh = Mesh(
+                np.array([devices[r] for r in self.ranks]), axis_names=("g",)
+            )
+        return self._mesh
+
+    def __repr__(self):
+        return f"Group(id={self.id}, ranks={self.ranks})"
+
+
+_GROUPS = {}
+_GROUP_COUNTER = [0]
+_DEFAULT_GROUP = [None]
+
+
+def _world_group():
+    if _DEFAULT_GROUP[0] is None:
+        _DEFAULT_GROUP[0] = Group(range(jax.device_count()), gid=0, name="world")
+        _GROUPS[0] = _DEFAULT_GROUP[0]
+    return _DEFAULT_GROUP[0]
+
+
+def new_group(ranks=None, backend=None, timeout=None):
+    """paddle.distributed.new_group (python/paddle/distributed/collective.py)."""
+    if ranks is None:
+        ranks = list(range(jax.device_count()))
+    _GROUP_COUNTER[0] += 1
+    g = Group(ranks, gid=_GROUP_COUNTER[0])
+    _GROUPS[g.id] = g
+    return g
+
+
+def get_group(gid=0):
+    if gid == 0:
+        return _world_group()
+    return _GROUPS.get(gid)
+
+
+def destroy_process_group(group=None):
+    if group is None:
+        _GROUPS.clear()
+        _DEFAULT_GROUP[0] = None
+    else:
+        _GROUPS.pop(group.id, None)
+
+
+def _resolve_group(group):
+    return group if group is not None else _world_group()
+
+
+def _val(t):
+    return t.value if isinstance(t, Tensor) else jnp.asarray(t)
+
+
+def _stacked_sharding(group):
+    return NamedSharding(group.jax_mesh(), P("g"))
+
+
+def _shard_stacked(v, group):
+    """Lay the per-rank stacked array [n, ...] one row per group device."""
+    return jax.device_put(v, _stacked_sharding(group))
+
+
+def stack_locals(tensors_or_arrays, group=None):
+    """Build the stacked per-rank representation from a list of local tensors."""
+    group = _resolve_group(group)
+    vals = [_val(t) for t in tensors_or_arrays]
+    return Tensor(_shard_stacked(jnp.stack(vals), group))
+
+
+def unstack_locals(t, group=None):
+    group = _resolve_group(group)
+    v = _val(t)
+    return [Tensor(v[i]) for i in range(v.shape[0])]
+
+
+class _Task:
+    """Completed-on-creation async handle (XLA dispatch is already async)."""
+
+    def __init__(self, result=None):
+        self._result = result
+
+    def wait(self):
+        return self._result
+
+    def is_completed(self):
+        return True
+
+
+def _maybe_inplace(tensor, new_val, sync_op=True):
+    if isinstance(tensor, Tensor):
+        tensor._replace_value(new_val)
+    return _Task(new_val) if not sync_op else None
+
+
+# ---------------------------------------------------------------------------
+# Collectives over stacked per-rank tensors ([world, ...] with row i = rank i's local view)
+# ---------------------------------------------------------------------------
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Rows of the stacked tensor are reduced; every rank sees the result."""
+    group = _resolve_group(group)
+    v = _val(tensor)
+    red = _REDUCE_FNS[op](v, 0)
+    out = jnp.broadcast_to(red[None], v.shape)
+    out = _shard_stacked(out, group)
+    return _maybe_inplace(tensor, out, sync_op)
+
+
+def reduce(tensor, dst, op=ReduceOp.SUM, group=None, sync_op=True):
+    group = _resolve_group(group)
+    v = _val(tensor)
+    red = _REDUCE_FNS[op](v, 0)
+    dst_idx = group.get_group_rank(dst)
+    if dst_idx < 0:
+        raise ValueError(f"reduce dst rank {dst} is not in group {group.ranks}")
+    out = v.at[dst_idx].set(red)
+    out = _shard_stacked(out, group)
+    return _maybe_inplace(tensor, out, sync_op)
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    """Each rank's row is gathered; tensor_list receives the n rows (replicated)."""
+    group = _resolve_group(group)
+    v = _val(tensor)
+    if isinstance(tensor_list, list):
+        del tensor_list[:]
+        for i in range(v.shape[0]):
+            tensor_list.append(Tensor(v[i]))
+    return _Task(v) if not sync_op else None
+
+
+def all_gather_concat(tensor, group=None, axis=0):
+    """Functional all-gather: stacked [n, ...] -> concatenated along `axis`, replicated."""
+    group = _resolve_group(group)
+    v = _val(tensor)
+    parts = [v[i] for i in range(v.shape[0])]
+    out = jnp.concatenate(parts, axis=axis)
+    out = jnp.broadcast_to(out[None], (v.shape[0],) + out.shape)
+    return Tensor(_shard_stacked(out, group))
+
+
+def broadcast(tensor, src, group=None, sync_op=True):
+    group = _resolve_group(group)
+    v = _val(tensor)
+    src_idx = group.get_group_rank(src)
+    if src_idx < 0:
+        raise ValueError(f"broadcast src rank {src} is not in group {group.ranks}")
+    out = jnp.broadcast_to(v[src_idx][None], v.shape)
+    out = _shard_stacked(out, group)
+    return _maybe_inplace(tensor, out, sync_op)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    """src's list of n tensors scattered: rank i receives tensor_list[i]."""
+    group = _resolve_group(group)
+    if tensor_list is not None:
+        vals = jnp.stack([_val(t) for t in tensor_list])
+        out = _shard_stacked(vals, group)
+        return _maybe_inplace(tensor, out, sync_op)
+    v = _val(tensor)
+    return _maybe_inplace(tensor, _shard_stacked(v, group), sync_op)
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Reduce rows then scatter slices: rank i gets slice i of the reduction."""
+    group = _resolve_group(group)
+    src = tensor_or_tensor_list
+    if isinstance(src, (list, tuple)):
+        v = jnp.stack([jnp.stack([_val(t) for t in src])] * len(src))  # replicated input
+        red = _REDUCE_FNS[op](v, 0)
+    else:
+        v = _val(src)  # [n, n*chunk, ...] or [n, n, chunk...]
+        red = _REDUCE_FNS[op](v, 0)
+    n = group.nranks
+    if red.shape[0] == n:
+        out = red  # already [n, chunk...] — row i to rank i
+    else:
+        out = red.reshape((n, red.shape[0] // n) + red.shape[1:])
+    out = _shard_stacked(out, group)
+    return _maybe_inplace(tensor, out, sync_op)
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    """in_tensor_list[i][j] row goes to rank j position i: a block transpose."""
+    group = _resolve_group(group)
+    if isinstance(in_tensor_list, (list, tuple)):
+        v = jnp.stack([_val(t) for t in in_tensor_list])
+    else:
+        v = _val(in_tensor_list)
+    n = group.nranks
+    # v: [n_src, n_dst, ...] per-rank rows of per-dst chunks -> transpose src/dst
+    if v.ndim >= 2 and v.shape[0] == n and v.shape[1] == n:
+        out = jnp.swapaxes(v, 0, 1)
+    else:
+        # [n, n*chunk, ...] split-concat form (alltoall_single)
+        chunk = v.shape[1] // n
+        out = (
+            v.reshape((n, n, chunk) + v.shape[2:])
+            .swapaxes(0, 1)
+            .reshape((n, n * chunk) + v.shape[2:])
+        )
+    out = _shard_stacked(out, group)
+    if isinstance(out_tensor_list, list):
+        del out_tensor_list[:]
+        for i in range(n):
+            out_tensor_list.append(Tensor(out[i]))
+        return None
+    return _maybe_inplace(out_tensor_list, out, sync_op)
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None, out_split_sizes=None,
+                    group=None, sync_op=True):
+    group = _resolve_group(group)
+    if in_split_sizes is None and out_split_sizes is None:
+        return alltoall(out_tensor, in_tensor, group=group, sync_op=sync_op)
+    # uneven splits: rank i's row is cut by in_split_sizes; chunk j goes to rank j;
+    # rank j's output row is the concat of chunk j from every rank
+    v = _val(in_tensor)
+    n = group.nranks
+    sizes = list(in_split_sizes)
+    if len(sizes) != n or sum(sizes) != v.shape[1]:
+        raise ValueError(
+            f"in_split_sizes {sizes} must have {n} entries summing to {v.shape[1]}"
+        )
+    offsets = np.cumsum([0] + sizes)
+    rows = []
+    for j in range(n):
+        chunks = [v[i, offsets[j]:offsets[j + 1]] for i in range(n)]
+        rows.append(jnp.concatenate(chunks, axis=0))
+    widths = {r.shape[0] for r in rows}
+    if len(widths) != 1:
+        raise ValueError(
+            "uneven out row sizes need equal per-rank totals in this stacked "
+            f"representation; got {[r.shape[0] for r in rows]}"
+        )
+    out = _shard_stacked(jnp.stack(rows), group)
+    return _maybe_inplace(out_tensor, out, sync_op)
+
+
+# Single-controller P2P: channels keyed by (src, dst). The caller states which rank it is
+# acting as via `p2p_rank(r)` — the PP schedule emulation wraps each simulated rank's slice
+# of the schedule in that context. Real multi-host P2P rides collective_permute inside
+# compiled steps (distributed.in_jit.shift / ppermute).
+_P2P_CHANNEL = {}
+_CURRENT_P2P_RANK = [0]
+
+
+class p2p_rank:
+    """Context manager declaring which rank the enclosed send/recv calls act as."""
+
+    def __init__(self, rank):
+        self.rank = int(rank)
+
+    def __enter__(self):
+        self.prev = _CURRENT_P2P_RANK[0]
+        _CURRENT_P2P_RANK[0] = self.rank
+        return self
+
+    def __exit__(self, *exc):
+        _CURRENT_P2P_RANK[0] = self.prev
+        return False
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """P2P: stage the tensor on dst's device (single-controller: a device_put)."""
+    group = _resolve_group(group)
+    v = _val(tensor)
+    g_dst = group.ranks[group.get_group_rank(dst)] if dst in group.ranks else dst
+    src = _CURRENT_P2P_RANK[0]
+    _P2P_CHANNEL.setdefault((src, g_dst), []).append(
+        jax.device_put(v, jax.devices()[g_dst])
+    )
+    return _Task() if not sync_op else None
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    group = _resolve_group(group)
+    g_src = group.ranks[group.get_group_rank(src)] if src in group.ranks else src
+    chan = _P2P_CHANNEL.get((g_src, _CURRENT_P2P_RANK[0]))
+    if not chan:
+        raise RuntimeError(
+            f"recv(src={g_src}) as rank {_CURRENT_P2P_RANK[0]} with empty channel: "
+            "single-controller P2P requires the matching send first (see p2p_rank)"
+        )
+    v = chan.pop(0)
+    return _maybe_inplace(tensor, v, sync_op)
+
+
+def barrier(group=None):
+    """Block until all outstanding device work is flushed."""
+    jax.block_until_ready(jax.live_arrays())
+    return None
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    v = _val(tensor)
+    jax.block_until_ready(v)
+
+
+# ---------------------------------------------------------------------------
+# Object collectives (host-side; DCN in real deployments)
+# ---------------------------------------------------------------------------
+_OBJECT_STORE = {}
+
+
+def all_gather_object(object_list, obj, group=None):
+    group = _resolve_group(group)
+    del object_list[:]
+    object_list.extend([obj] * group.nranks)
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    return object_list

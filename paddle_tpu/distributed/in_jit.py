@@ -1,0 +1,65 @@
+"""Collectives usable inside compiled SPMD code (shard_map / pjit bodies).
+
+Reference analog: the static-graph collective kernels (fluid/operators/collective/ —
+c_allreduce_sum, c_allgather, c_concat, c_split, (partial_)send/recv_v2) that parallel
+passes insert into the compiled program. TPU-first: these ARE jax.lax collectives — XLA
+schedules them on ICI; no comm streams, no ring ids, ordering comes from data dependence.
+Axis names refer to the enclosing mesh's named axes.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def all_reduce(x, axis_name, op="sum"):
+    if op == "sum":
+        return lax.psum(x, axis_name)
+    if op == "max":
+        return lax.pmax(x, axis_name)
+    if op == "min":
+        return lax.pmin(x, axis_name)
+    if op == "avg" or op == "mean":
+        return lax.pmean(x, axis_name)
+    raise ValueError(f"unsupported reduce op {op}")
+
+
+def all_gather(x, axis_name, axis=0, tiled=True):
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name, scatter_dim=0):
+    return lax.psum_scatter(x, axis_name, scatter_dimension=scatter_dim, tiled=True)
+
+
+def all_to_all(x, axis_name, split_axis, concat_axis):
+    return lax.all_to_all(x, axis_name, split_axis=split_axis, concat_axis=concat_axis,
+                          tiled=True)
+
+
+def ppermute(x, axis_name, perm):
+    return lax.ppermute(x, axis_name, perm)
+
+
+def shift(x, axis_name, offset=1, n=None):
+    """Ring shift: send to (i+offset) mod n — the PP stage-to-stage primitive."""
+    if n is None:
+        n = lax.axis_size(axis_name)
+    perm = [(i, (i + offset) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def axis_index(axis_name):
+    return lax.axis_index(axis_name)
+
+
+def axis_size(axis_name):
+    return lax.axis_size(axis_name)
+
+
+def broadcast(x, axis_name, src=0):
+    """Every member takes src's value: masked psum (compiles to a collective-broadcast)."""
+    idx = lax.axis_index(axis_name)
+    masked = jnp.where(idx == src, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis_name)

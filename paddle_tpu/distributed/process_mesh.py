@@ -1,0 +1,130 @@
+"""ProcessMesh: the logical device mesh.
+
+Reference analog: python/paddle/distributed/auto_parallel/process_mesh.py (ProcessMesh) and
+phi/core/distributed/auto_parallel/process_mesh.h:34. TPU-first redesign: a ProcessMesh is a
+named view over jax.devices() that lowers to jax.sharding.Mesh, so every sharding annotation
+rides XLA's GSPMD partitioner and collectives are laid onto ICI by the compiler. "Process id"
+means global device index (one device per reference-world rank).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+_CURRENT_MESH = []
+
+
+class ProcessMesh:
+    def __init__(self, mesh, dim_names=None, shape=None, process_ids=None):
+        if mesh is None and process_ids is not None:
+            mesh = np.asarray(process_ids).reshape(shape)
+        arr = np.asarray(mesh, dtype=np.int64)
+        if arr.ndim == 0:
+            arr = arr.reshape(1)
+        self._mesh = arr
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        if len(dim_names) != arr.ndim:
+            raise ValueError(
+                f"dim_names {dim_names} does not match mesh ndim {arr.ndim}"
+            )
+        self._dim_names = [str(d) for d in dim_names]
+        self._jax_mesh = None
+
+    # -- paddle-parity surface ----------------------------------------------
+    @property
+    def mesh(self):
+        return self._mesh
+
+    @property
+    def shape(self):
+        return list(self._mesh.shape)
+
+    @property
+    def ndim(self):
+        return self._mesh.ndim
+
+    @property
+    def dim_names(self):
+        return self._dim_names
+
+    @property
+    def process_ids(self):
+        return self._mesh.flatten().tolist()
+
+    @property
+    def size(self):
+        return int(self._mesh.size)
+
+    def get_dim_size(self, dim_name):
+        return self._mesh.shape[self._dim_names.index(dim_name)]
+
+    def get_mesh_with_dim(self, dim_name, index=None):
+        """Sub-mesh: move `dim_name` to front (or slice it at `index`)."""
+        axis = self._dim_names.index(dim_name)
+        order = [axis] + [i for i in range(self.ndim) if i != axis]
+        names = [self._dim_names[i] for i in order]
+        new = self._mesh.transpose(order)
+        if index is not None:
+            return ProcessMesh(new[index], names[1:] or ["d0"])
+        return ProcessMesh(new, names)
+
+    def get_rank_by_dim_and_process_id(self, dim_name, process_id):
+        axis = self._dim_names.index(dim_name)
+        pos = np.argwhere(self._mesh == process_id)
+        if len(pos) == 0:
+            return -1
+        return int(pos[0][axis])
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, ProcessMesh)
+            and np.array_equal(self._mesh, other._mesh)
+            and self._dim_names == other._dim_names
+        )
+
+    def __hash__(self):
+        return hash((self._mesh.tobytes(), tuple(self._dim_names), self._mesh.shape))
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self._dim_names})"
+
+    def __enter__(self):
+        _CURRENT_MESH.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _CURRENT_MESH.pop()
+        return False
+
+    # -- jax lowering --------------------------------------------------------
+    def jax_mesh(self) -> Mesh:
+        """Lower to jax.sharding.Mesh (cached). Device order follows process ids."""
+        if self._jax_mesh is None:
+            devices = jax.devices()
+            if self._mesh.size > len(devices):
+                raise RuntimeError(
+                    f"ProcessMesh needs {self._mesh.size} devices; only "
+                    f"{len(devices)} visible. For tests set "
+                    "XLA_FLAGS=--xla_force_host_platform_device_count=N."
+                )
+            dev_arr = np.empty(self._mesh.shape, dtype=object)
+            for idx, pid in np.ndenumerate(self._mesh):
+                dev_arr[idx] = devices[int(pid)]
+            self._jax_mesh = Mesh(dev_arr, axis_names=tuple(self._dim_names))
+        return self._jax_mesh
+
+
+def get_current_mesh():
+    return _CURRENT_MESH[-1] if _CURRENT_MESH else None
+
+
+def auto_mesh(*dim_names, shape=None):
+    """Build a mesh over all visible devices with the given axis names."""
+    n = len(jax.devices())
+    if shape is None:
+        shape = [n] + [1] * (len(dim_names) - 1)
+    return ProcessMesh(np.arange(int(np.prod(shape))).reshape(shape), list(dim_names))
