@@ -44,6 +44,7 @@ from . import linalg  # noqa: F401,E402
 from . import nn  # noqa: F401,E402
 from . import optimizer  # noqa: F401,E402
 from . import io  # noqa: F401,E402
+from . import jit  # noqa: F401,E402
 from . import ops  # noqa: F401,E402
 
 

@@ -33,6 +33,11 @@ def is_grad_enabled() -> bool:
     return _GRAD_ENABLED[0] and not _FUNCTIONAL_MODE[0]
 
 
+def grad_flag() -> bool:
+    """The raw no_grad/enable_grad flag, independent of functional (capture) mode."""
+    return _GRAD_ENABLED[0]
+
+
 def set_grad_enabled(mode: bool):
     class _Guard:
         def __init__(self, prev):

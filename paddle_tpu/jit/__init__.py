@@ -1,0 +1,10 @@
+"""paddle_tpu.jit: graph capture and whole-program compilation (python/paddle/jit)."""
+from .api import (  # noqa: F401
+    InputSpec,
+    StaticFunction,
+    enable_to_static,
+    ignore_module,
+    not_to_static,
+    to_static,
+)
+from .serialization import load, save  # noqa: F401

@@ -1,0 +1,260 @@
+"""jit.to_static: graph capture + whole-program XLA compilation.
+
+Reference analog: python/paddle/jit/api.py:197 to_static and the two capture paths behind
+it (AST dy2static and the SOT bytecode tracer over eval_frame.c), which build a PIR program
+run by the PirInterpreter with optional CINN fusion (SURVEY.md §3.5).
+
+TPU-first redesign: capture IS jax tracing. Every framework op is already a pure jax
+function, so calling the user's Python function with tracer-valued Tensors yields the whole
+computation as one XLA program — no bytecode interpreter, no IR of our own, no separate
+fusion compiler (XLA is both the IR and CINN). The tape is suspended during trace
+(functional_mode); gradients of a compiled call are jax.vjp over the compiled function, so
+a to_static model trains exactly like eager with one fused step program. Mutable state
+(buffers like BN running stats, the RNG key) is threaded functionally: state in, new state
+out, written back after each call — recompilation happens only on new (shapes, dtypes,
+training-mode) signatures, mirroring the reference's program cache keyed on input spec.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd import tape
+from ..framework import random as rng
+from ..framework.core import Tensor
+from ..nn.layer.layers import Layer
+
+
+class InputSpec:
+    """paddle.static.InputSpec: symbolic input signature (shape with None = dynamic)."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def _gather_state(layer: Layer):
+    """(names, tensors) for parameters + buffers — everything a trace may read/write."""
+    names, tensors = [], []
+    for n, p in layer.named_parameters():
+        names.append("P:" + n)
+        tensors.append(p)
+    for n, b in layer.named_buffers():
+        if b is not None:
+            names.append("B:" + n)
+            tensors.append(b)
+    return names, tensors
+
+
+class StaticFunction:
+    """A callable whose body executes as one cached XLA program per input signature."""
+
+    def __init__(self, function, layer=None, input_spec=None, full_graph=True):
+        self._function = function
+        self._layer = layer
+        self._input_spec = input_spec
+        self._cache = {}
+        functools.update_wrapper(self, function)
+
+    # -- cache key ----------------------------------------------------------
+    def _mode_key(self):
+        if self._layer is None:
+            return ()
+        return tuple(l.training for l in self._layer.sublayers(include_self=True))
+
+    @staticmethod
+    def _const_key(leaf):
+        """Hashable identity for a non-tensor leaf baked into the trace as a constant."""
+        if isinstance(leaf, np.ndarray):
+            return (leaf.shape, str(leaf.dtype), leaf.tobytes())
+        try:
+            hash(leaf)
+            return leaf
+        except TypeError:
+            return repr(leaf)
+
+    def _signature(self, leaves, t_idx, tvals, treedef, state_tensors):
+        consts = tuple(
+            self._const_key(l) for i, l in enumerate(leaves) if i not in set(t_idx)
+        )
+        return (
+            treedef,
+            tuple((v.shape, str(v.dtype)) for v in tvals),
+            tuple(leaves[i].stop_gradient for i in t_idx),
+            tuple(t.stop_gradient for t in state_tensors),
+            consts,
+            self._mode_key(),
+            tape.is_grad_enabled(),
+        )
+
+    # -- trace --------------------------------------------------------------
+    def _build(self, treedef, leaves, t_idx, state_tensors):
+        fn = self._function
+        out_box = {}
+
+        def pure(state_vals, rng_key, *tvals):
+            with tape.functional_mode(), rng.trace_key(rng_key):
+                saved = [(t, t._value) for t in state_tensors]
+                try:
+                    for t, v in zip(state_tensors, state_vals):
+                        t._replace_value(v)
+                    buf = list(leaves)
+                    for i, v in zip(t_idx, tvals):
+                        t = Tensor(v)
+                        t.stop_gradient = leaves[i].stop_gradient
+                        buf[i] = t
+                    args, kwargs = jax.tree_util.tree_unflatten(treedef, buf)
+                    out = fn(*args, **kwargs)
+                    out_leaves, out_tree = jax.tree_util.tree_flatten(
+                        out, is_leaf=_is_tensor
+                    )
+                    out_box["tree"] = out_tree
+                    out_box["is_tensor"] = [_is_tensor(o) for o in out_leaves]
+                    out_vals = tuple(
+                        o.value if _is_tensor(o) else o for o in out_leaves
+                    )
+                    # buffers may have been swapped in place (BN running stats)
+                    new_state = tuple(t._value for t in state_tensors)
+                finally:
+                    for t, v in saved:
+                        t._replace_value(v)
+            return out_vals + new_state
+
+        return jax.jit(pure), out_box
+
+    # -- call ---------------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        if not _TO_STATIC_STATE[0]:
+            return self._function(*args, **kwargs)
+        if self._layer is not None:
+            state_names, state_tensors = _gather_state(self._layer)
+        else:
+            state_names, state_tensors = [], []
+        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs), is_leaf=_is_tensor)
+        t_idx = [i for i, l in enumerate(leaves) if _is_tensor(l)]
+        t_leaves = [leaves[i] for i in t_idx]
+        tvals = [t.value for t in t_leaves]
+
+        key = self._signature(leaves, t_idx, tvals, treedef, state_tensors)
+        if key not in self._cache:
+            self._cache[key] = self._build(treedef, leaves, t_idx, state_tensors)
+        jitted, out_box = self._cache[key]
+
+        rng_key = rng.next_key()
+
+        requires_grad = tape.is_grad_enabled() and (
+            any(not t.stop_gradient for t in state_tensors)
+            or any(not t.stop_gradient for t in t_leaves)
+        )
+
+        if requires_grad:
+            from ..ops._apply import apply_raw
+
+            n_state = len(state_tensors)
+
+            def raw(*vals):
+                sv, rest = vals[:n_state], vals[n_state:]
+                return jitted(sv, rest[0], *rest[1:])
+
+            key_t = Tensor(rng_key)
+            outs = apply_raw(
+                "to_static." + getattr(self._function, "__name__", "fn"),
+                raw,
+                list(state_tensors) + [key_t] + t_leaves,
+                n_outs=None,
+            )
+            flat_vals = [o.value for o in outs]
+            out_tensors = list(outs)
+        else:
+            flat_vals = list(jitted([t.value for t in state_tensors], rng_key, *tvals))
+            out_tensors = [Tensor(v) for v in flat_vals]
+
+        n_state = len(state_tensors)
+        n_user = len(flat_vals) - n_state
+        # write back threaded state (buffer updates, e.g. BN running stats);
+        # parameters are never rebound by a forward pass
+        for t, v in zip(state_tensors, flat_vals[n_user:]):
+            if t.stop_gradient:
+                t._replace_value(v)
+
+        out_tree = out_box["tree"]
+        is_tensor_flags = out_box["is_tensor"]
+        user_out = []
+        for i in range(n_user):
+            if is_tensor_flags[i]:
+                user_out.append(out_tensors[i])
+            else:
+                user_out.append(flat_vals[i])
+        return jax.tree_util.tree_unflatten(out_tree, user_out)
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def code(self):
+        import inspect
+
+        try:
+            return inspect.getsource(self._function)
+        except Exception:
+            return "<source unavailable>"
+
+    def concrete_program_specs(self):
+        return list(self._cache.keys())
+
+    def rollback(self):
+        """Undo to_static on a layer's forward."""
+        if self._layer is not None and hasattr(self._layer, "_orig_forward"):
+            self._layer.forward = self._layer._orig_forward
+        return self._function
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
+              full_graph=True, **kwargs):
+    """Compile a function or a Layer's forward into one cached XLA program."""
+
+    def decorate(obj):
+        if isinstance(obj, Layer):
+            layer = obj
+            fwd = layer.forward
+            layer._orig_forward = fwd
+            sf = StaticFunction(fwd, layer=layer, input_spec=input_spec,
+                                full_graph=full_graph)
+            layer.forward = sf
+            return layer
+        # plain function or unbound method; bind layer at call time if it's a method
+        sf = StaticFunction(obj, layer=None, input_spec=input_spec,
+                            full_graph=full_graph)
+        return sf
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    """Marker: exclude from capture (runs inline during trace — jax traces through it)."""
+    fn._not_to_static = True
+    return fn
+
+
+def enable_to_static(flag=True):
+    _TO_STATIC_STATE[0] = bool(flag)
+
+
+_TO_STATIC_STATE = [True]
+
+
+def ignore_module(modules):
+    return None

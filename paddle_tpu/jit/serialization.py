@@ -1,0 +1,132 @@
+"""jit.save / jit.load: deployable compiled-program serialization.
+
+Reference analog: paddle.jit.save/load (python/paddle/jit/api.py) which exports a pruned
+static program + params for the inference engine (fluid/inference), and jit.load's
+TranslatedLayer. TPU-first redesign: the portable program format IS StableHLO —
+jax.export serializes the traced forward with its calling convention; parameters are saved
+beside it. A loaded TranslatedLayer re-executes the StableHLO on any XLA backend with no
+Python model code, which is this framework's AnalysisPredictor path.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework import dtype as dtype_mod
+from ..nn.layer.layers import Layer
+from ..autograd import tape
+from ..framework import random as rng
+
+
+def _trace_fn_for(layer: Layer):
+    from .api import StaticFunction, _gather_state
+
+    fwd = layer._orig_forward if hasattr(layer, "_orig_forward") else layer.forward
+    if isinstance(fwd, StaticFunction):
+        fwd = fwd._function
+    names, tensors = _gather_state(layer)
+
+    def pure(state_vals, *input_vals):
+        with tape.functional_mode(), rng.trace_key(jax.random.key(0)):
+            saved = [(t, t._value) for t in tensors]
+            try:
+                for t, v in zip(tensors, state_vals):
+                    t._replace_value(v)
+                args = [Tensor(v) for v in input_vals]
+                out = fwd(*args)
+                leaves = jax.tree_util.tree_leaves(out, is_leaf=lambda x: isinstance(x, Tensor))
+                out_vals = tuple(l.value if isinstance(l, Tensor) else l for l in leaves)
+            finally:
+                for t, v in saved:
+                    t._replace_value(v)
+        return out_vals
+
+    return pure, names, tensors
+
+
+def save(layer, path, input_spec=None, **config):
+    """Serialize `layer` as StableHLO program + params (paddle.jit.save)."""
+    from .api import InputSpec, StaticFunction
+
+    if input_spec is None:
+        # fall back to the spec registered at to_static time (paddle convention)
+        sf = getattr(layer, "forward", None)
+        if isinstance(sf, StaticFunction):
+            input_spec = sf._input_spec
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec (list of InputSpec or example "
+                         "Tensors) to fix the exported signature")
+    specs = []
+    scope = jax.export.SymbolicScope()
+    sym_count = [0]
+
+    def _sym_dims(shape):
+        dims = []
+        for d in shape:
+            if d is None or (isinstance(d, int) and d < 0):
+                sym_count[0] += 1
+                dims.append(f"_dyn{sym_count[0]}")
+            else:
+                dims.append(str(int(d)))
+        if any(d.startswith("_dyn") for d in dims):
+            return jax.export.symbolic_shape(",".join(dims), scope=scope)
+        return tuple(int(d) for d in dims)
+
+    for s in input_spec:
+        if isinstance(s, InputSpec):
+            specs.append(jax.ShapeDtypeStruct(_sym_dims(s.shape),
+                                              dtype_mod.convert_dtype(s.dtype)))
+        elif isinstance(s, Tensor):
+            specs.append(jax.ShapeDtypeStruct(s.value.shape, s.value.dtype))
+        else:
+            arr = jnp.asarray(s)
+            specs.append(jax.ShapeDtypeStruct(arr.shape, arr.dtype))
+
+    was_training = layer.training
+    layer.eval()
+    try:
+        pure, names, tensors = _trace_fn_for(layer)
+        state_specs = [jax.ShapeDtypeStruct(t.value.shape, t.value.dtype)
+                       for t in tensors]
+        exported = jax.export.export(jax.jit(pure))(state_specs, *specs)
+        blob = exported.serialize()
+    finally:
+        if was_training:
+            layer.train()
+
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(blob)
+    state = {n: np.asarray(t.value) for n, t in zip(names, tensors)}
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump({"state_names": names, "state": state}, f)
+
+
+class TranslatedLayer(Layer):
+    """A loaded, compiled program callable like a Layer (paddle.jit.load result)."""
+
+    def __init__(self, exported, state_vals):
+        super().__init__()
+        self._exported = exported
+        self._state_vals = [jnp.asarray(v) for v in state_vals]
+
+    def forward(self, *inputs):
+        vals = [x.value if isinstance(x, Tensor) else jnp.asarray(x) for x in inputs]
+        outs = self._exported.call(self._state_vals, *vals)
+        outs = [Tensor(o) for o in outs]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def load(path, **config):
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jax.export.deserialize(f.read())
+    with open(path + ".pdiparams", "rb") as f:
+        meta = pickle.load(f)
+    state_vals = [meta["state"][n] for n in meta["state_names"]]
+    return TranslatedLayer(exported, state_vals)

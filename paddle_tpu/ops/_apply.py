@@ -100,9 +100,22 @@ def apply(opdef: OpDef, *args, **kwargs):
     if flags.flag("check_nan_inf"):
         _check_nan_inf(opdef.name, out_vals)
 
+    # Under graph capture the tape is off but the outer jax.vjp differentiates the whole
+    # trace: stop_gradient must then propagate from inputs (paddle semantics: an output
+    # requires grad iff any input does), or per-input lax.stop_gradient guards in the NEXT
+    # op would sever the chain at every intermediate.
+    if tape.in_functional_mode():
+        # grad_flag keeps no_grad blocks inside a captured function severing the chain
+        # exactly like eager (EMA/target-network patterns must not diverge when compiled)
+        rg_out = (
+            opdef.differentiable and tape.grad_flag()
+            and any(not sg for sg in stop_flags)
+        )
+    else:
+        rg_out = requires_grad
     outputs = []
     for v in out_vals:
-        sg = not (requires_grad and jnp.issubdtype(np.dtype(v.dtype), jnp.inexact))
+        sg = not (rg_out and jnp.issubdtype(np.dtype(v.dtype), jnp.inexact))
         outputs.append(Tensor(v, stop_gradient=sg))
 
     if requires_grad:
@@ -130,9 +143,13 @@ def apply_raw(name, fn, tensor_args, n_outs=1):
         out_vals, vjp_fn = jax.vjp(pure, *vals)
     else:
         out_vals = pure(*vals)
+    if tape.in_functional_mode():
+        rg_out = tape.grad_flag() and any(not sg for sg in stop_flags)
+    else:
+        rg_out = requires_grad
     outputs = []
     for v in out_vals:
-        sg = not (requires_grad and jnp.issubdtype(np.dtype(v.dtype), jnp.inexact))
+        sg = not (rg_out and jnp.issubdtype(np.dtype(v.dtype), jnp.inexact))
         outputs.append(Tensor(v, stop_gradient=sg))
     if requires_grad:
         out_avals = [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in out_vals]
