@@ -56,3 +56,14 @@ from .parallel import (  # noqa: F401
     is_initialized,
 )
 from . import in_jit  # noqa: F401
+from . import fleet  # noqa: F401
+from .fleet.mpu.mp_ops import split  # noqa: F401
+
+
+class sharding:
+    """paddle.distributed.sharding namespace (group_sharded_parallel entry)."""
+
+    from .fleet.hybrid_optimizer import (  # noqa: F401
+        group_sharded_parallel,
+        save_group_sharded_model,
+    )

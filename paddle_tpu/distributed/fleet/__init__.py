@@ -1,0 +1,50 @@
+"""paddle.distributed.fleet equivalent: manual hybrid parallelism.
+
+Reference analog: python/paddle/distributed/fleet/ (48.5k LoC). The facade functions are
+module-level (fleet.init(...), fleet.distributed_model(...)) exactly like the reference's
+singleton Fleet instance.
+"""
+from .fleet import (  # noqa: F401
+    PaddleCloudRoleMaker,
+    UserDefinedRoleMaker,
+    barrier_worker,
+    distributed_model,
+    distributed_optimizer,
+    distributed_scaler,
+    get_hybrid_communicate_group,
+    init,
+    init_server,
+    is_first_worker,
+    is_initialized,
+    run_server,
+    save_persistables,
+    stop_worker,
+    worker_endpoints,
+    worker_index,
+    worker_num,
+)
+from .strategy import DistributedStrategy, Strategy  # noqa: F401
+from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
+from .hybrid_optimizer import (  # noqa: F401
+    DygraphShardingOptimizer,
+    DygraphShardingOptimizerV2,
+    HybridParallelClipGrad,
+    HybridParallelOptimizer,
+    group_sharded_parallel,
+    save_group_sharded_model,
+)
+from .recompute import recompute, recompute_hybrid, recompute_sequential  # noqa: F401
+from . import meta_parallel  # noqa: F401
+from . import utils  # noqa: F401
+
+# reference exposes these under paddle.distributed.fleet.meta_parallel too
+from .meta_parallel import (  # noqa: F401
+    LayerDesc,
+    PipelineLayer,
+    PipelineParallel,
+    PipelineParallelWithInterleave,
+    SegmentParallel,
+    SharedLayerDesc,
+    ShardingParallel,
+    TensorParallel,
+)

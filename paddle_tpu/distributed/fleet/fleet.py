@@ -1,0 +1,215 @@
+"""Fleet facade: init / distributed_model / distributed_optimizer.
+
+Reference analog: python/paddle/distributed/fleet/fleet.py (2,123 LoC — fleet.init :218
+builds a RoleMaker from env + init_parallel_env; _init_hybrid_parallel_env :674 builds
+CommunicateTopology + HybridCommunicateGroup; fleet/model.py:33 picks the wrapper;
+fleet/fleet.py distributed_optimizer wraps with HybridParallelOptimizer).
+
+TPU-first redesign: "init" builds the global hybrid ProcessMesh (the GSPMD backbone) and
+axis-view Groups; there is no per-rank NCCL bootstrap because the mesh IS the communicator.
+RoleMaker env parsing is kept for launch compatibility (PADDLE_TRAINER_ID & co.).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+from ...nn.layer.layers import Layer
+from .. import parallel as parallel_mod
+from .strategy import DistributedStrategy
+from .topology import (CommunicateTopology, HybridCommunicateGroup,
+                       _set_hybrid_parallel_group, get_hybrid_parallel_group)
+
+
+class RoleMakerBase:
+    def __init__(self, is_collective=True, **kwargs):
+        self._is_collective = is_collective
+
+    def worker_index(self):
+        return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+    def worker_num(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        if eps:
+            return len(eps.split(","))
+        return max(1, jax.process_count())
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def is_first_worker(self):
+        return self.worker_index() == 0
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """Env-var cluster discovery (fleet/base/role_maker.py)."""
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    def __init__(self, is_collective=True, init_gloo=False, **kwargs):
+        super().__init__(is_collective)
+        self._kwargs = kwargs
+
+
+class _FleetState:
+    def __init__(self):
+        self.initialized = False
+        self.strategy = None
+        self.role_maker = None
+        self.hcg = None
+
+
+_STATE = _FleetState()
+
+
+def init(role_maker=None, is_collective=False, strategy=None, log_level="INFO"):
+    """fleet.init (fleet/fleet.py:218)."""
+    _STATE.strategy = strategy or DistributedStrategy()
+    _STATE.role_maker = role_maker or PaddleCloudRoleMaker(is_collective=is_collective)
+    parallel_mod.init_parallel_env()
+
+    hybrid = _STATE.strategy.hybrid_configs
+    order = list(hybrid.get("order") or ["pp", "dp", "sharding", "sep", "mp"])
+    degrees = {
+        "dp": int(hybrid.get("dp_degree", 1)),
+        "mp": int(hybrid.get("mp_degree", 1)),
+        "pp": int(hybrid.get("pp_degree", 1)),
+        "sharding": int(hybrid.get("sharding_degree", 1)),
+        "sep": int(hybrid.get("sep_degree", 1)),
+    }
+    n_dev = jax.device_count()
+    specified = 1
+    for d in degrees.values():
+        specified *= d
+    # reference behavior: dp fills whatever is left of the world size
+    if degrees["dp"] <= 1 and specified < n_dev and n_dev % specified == 0:
+        degrees["dp"] = n_dev // specified
+    topo = CommunicateTopology(order, [degrees[n] for n in order])
+    if topo.world_size() > n_dev:
+        raise RuntimeError(
+            f"hybrid degrees {degrees} need {topo.world_size()} devices; "
+            f"{n_dev} visible")
+    hcg = HybridCommunicateGroup(topo)
+    _set_hybrid_parallel_group(hcg)
+    _STATE.hcg = hcg
+    _STATE.initialized = True
+    return None
+
+
+def is_initialized():
+    return _STATE.initialized
+
+
+def get_hybrid_communicate_group():
+    return _STATE.hcg or get_hybrid_parallel_group()
+
+
+def _strategy():
+    if _STATE.strategy is None:
+        _STATE.strategy = DistributedStrategy()
+    return _STATE.strategy
+
+
+def worker_index():
+    return _STATE.role_maker.worker_index() if _STATE.role_maker else 0
+
+
+def worker_num():
+    return _STATE.role_maker.worker_num() if _STATE.role_maker else 1
+
+
+def is_first_worker():
+    return worker_index() == 0
+
+
+def worker_endpoints(to_string=False):
+    eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+    eps = [e for e in eps if e]
+    return ",".join(eps) if to_string else eps
+
+
+def barrier_worker():
+    from .. import collective
+
+    collective.barrier()
+
+
+def distributed_model(model):
+    """Pick the meta-parallel wrapper per strategy (fleet/model.py:33,135-163)."""
+    from .meta_parallel.pipeline_parallel import (PipelineParallel,
+                                                  PipelineParallelWithInterleave,
+                                                  SegmentParallel, ShardingParallel,
+                                                  TensorParallel)
+    from .meta_parallel.pp_layers import PipelineLayer
+
+    hcg = get_hybrid_communicate_group()
+    strategy = _strategy()
+    if hcg is None:
+        return parallel_mod.DataParallel(model)
+
+    dp = hcg.get_data_parallel_world_size()
+    mp = hcg.get_model_parallel_world_size()
+    pp = hcg.get_pipe_parallel_world_size()
+    sharding = hcg.get_sharding_parallel_world_size()
+    sep = hcg.get_sep_parallel_world_size()
+
+    if pp > 1:
+        if isinstance(model, PipelineLayer) and model._num_virtual_stages > 1:
+            return PipelineParallelWithInterleave(model, hcg, strategy)
+        return PipelineParallel(model, hcg, strategy)
+    if mp > 1:
+        return TensorParallel(model, hcg, strategy)
+    if sep > 1:
+        return SegmentParallel(model, hcg, strategy)
+    if sharding > 1:
+        return ShardingParallel(model, hcg, strategy)
+    if dp > 1:
+        mesh = hcg.global_mesh
+        return parallel_mod.DataParallel(model, mesh=mesh)
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """Wrap with HybridParallelOptimizer (fleet/fleet.py distributed_optimizer)."""
+    from .hybrid_optimizer import HybridParallelOptimizer
+
+    if strategy is not None:
+        _STATE.strategy = strategy
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        return optimizer
+    return HybridParallelOptimizer(optimizer, hcg, _strategy())
+
+
+def distributed_scaler(scaler):
+    return scaler
+
+
+# -- save/load (fleet.save_persistables etc.) --------------------------------
+def save_persistables(executor_or_model, dirname, main_program=None, mode=0):
+    from ...framework_io import save as _save
+
+    model = executor_or_model
+    if isinstance(model, Layer):
+        import os as _os
+
+        _os.makedirs(dirname, exist_ok=True)
+        _save(model.state_dict(), os.path.join(dirname, "model.pdparams"))
+
+
+def init_server(*args, **kwargs):
+    raise NotImplementedError(
+        "parameter-server mode is out of scope for the TPU build (SURVEY.md §2.6); "
+        "use collective training")
+
+
+def run_server():
+    init_server()
+
+
+def stop_worker():
+    pass

@@ -1,0 +1,16 @@
+from ..mpu.mp_layers import (  # noqa: F401
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from ..mpu.random import get_rng_state_tracker, model_parallel_random_seed  # noqa: F401
+from .pp_layers import LayerDesc, PipelineLayer, SegmentLayers, SharedLayerDesc  # noqa: F401
+from .pipeline_parallel import (  # noqa: F401
+    MetaParallelBase,
+    PipelineParallel,
+    PipelineParallelWithInterleave,
+    SegmentParallel,
+    ShardingParallel,
+    TensorParallel,
+)

@@ -1,0 +1,155 @@
+"""PipelineLayer: stage-partitioned model description.
+
+Reference analog: python/paddle/distributed/fleet/meta_parallel/parallel_layers/
+pp_layers.py (PipelineLayer :258, LayerDesc, SharedLayerDesc; segmentation by layer count
+or uniform/fast cost). There each rank constructs only its stage's layers.
+
+TPU-first redesign: the single controller constructs every layer; stage membership decides
+the pp mesh coordinate whose devices hold that stage's parameters (jax.device_put onto the
+stage's sub-mesh). The compiled path re-uses the same partition to build a stacked,
+pp-sharded parameter pytree for the shard_map/ppermute pipeline (distributed/pipelining.py).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+
+from ....framework.core import Tensor
+from ....nn.layer.layers import Layer
+from ..topology import get_hybrid_parallel_group
+
+
+class LayerDesc:
+    def __init__(self, layer_cls, *inputs, **kwargs):
+        self.layer_cls = layer_cls
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_cls, Layer):
+            raise TypeError(f"{layer_cls} must be a Layer subclass")
+
+    def build_layer(self):
+        return self.layer_cls(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_cls.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """A layer whose parameters are shared across stages (embedding <-> lm head)."""
+
+    def __init__(self, key, layer_cls, forward_func=None, shared_weight_attr="weight",
+                 *inputs, **kwargs):
+        super().__init__(layer_cls, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """Partition N layers into `num_parts` stages (pp_layers.py SegmentLayers)."""
+
+    def __init__(self, layers_desc, num_parts, method="uniform"):
+        self.descs = layers_desc
+        self.num_parts = num_parts
+        self.method = method
+
+    def do_segment(self):
+        n = len(self.descs)
+        if self.method.startswith("layer:"):
+            # cut at layers of the named class, distributing them evenly
+            name = self.method.split(":", 1)[1]
+            marks = [i for i, d in enumerate(self.descs)
+                     if getattr(d, "layer_cls", type(d)).__name__ == name]
+            if len(marks) >= self.num_parts:
+                per = len(marks) // self.num_parts
+                bounds = [0]
+                for s in range(1, self.num_parts):
+                    bounds.append(marks[s * per])
+                bounds.append(n)
+                return bounds
+        per = n / self.num_parts
+        return [int(math.floor(per * i)) for i in range(self.num_parts)] + [n]
+
+
+class PipelineLayer(Layer):
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0, recompute_ctx=None,
+                 num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
+        hcg = get_hybrid_parallel_group()
+        if num_stages is None:
+            num_stages = hcg.get_pipe_parallel_world_size() if hcg is not None else 1
+        self._num_stages = num_stages
+        self._num_virtual_stages = num_virtual_pipeline_stages or 1
+        self._topo = topology or (hcg.topology() if hcg is not None else None)
+
+        self._layers_desc = list(layers)
+        bounds = SegmentLayers(self._layers_desc, num_stages, seg_method).do_segment()
+        self.segment_parts = bounds
+
+        # build every layer (single controller); shared descs build once per key
+        self._shared = {}
+        self.run_function = []
+        self._stage_of = []
+        for idx, desc in enumerate(self._layers_desc):
+            stage = self._stage_for_index(idx, bounds)
+            if isinstance(desc, SharedLayerDesc):
+                if desc.layer_name not in self._shared:
+                    self._shared[desc.layer_name] = desc.build_layer()
+                layer = self._shared[desc.layer_name]
+                fwd = desc.forward_func
+                if fwd is not None:
+                    run = (lambda l, f: lambda *xs: f(l, *xs))(layer, fwd)
+                else:
+                    run = layer
+            elif isinstance(desc, LayerDesc):
+                layer = desc.build_layer()
+                run = layer
+            elif isinstance(desc, Layer):
+                layer = desc
+                run = layer
+            elif callable(desc):
+                layer = None
+                run = desc
+            else:
+                raise TypeError(f"unsupported pipeline entry {desc!r}")
+            if layer is not None:
+                self.add_sublayer(str(idx), layer)
+            self.run_function.append(run)
+            self._stage_of.append(stage)
+
+    @staticmethod
+    def _stage_for_index(idx, bounds):
+        for s in range(len(bounds) - 1):
+            if bounds[s] <= idx < bounds[s + 1]:
+                return s
+        return len(bounds) - 2
+
+    def get_num_stages(self):
+        return self._num_stages
+
+    def stage_of(self, idx):
+        return self._stage_of[idx]
+
+    def get_stage_funcs(self, stage):
+        return [f for f, s in zip(self.run_function, self._stage_of) if s == stage]
+
+    def forward(self, input):  # noqa: A002
+        x = input
+        for i, fn in enumerate(self.run_function):
+            if (self._recompute_interval > 0 and isinstance(fn, Layer)
+                    and i % self._recompute_interval == 0):
+                from ..recompute import recompute
+
+                x = recompute(fn, x)
+            else:
+                x = fn(x) if not isinstance(x, tuple) else fn(*x)
+        return x
+
+    def loss(self, output, label):
+        if self._loss_fn is None:
+            return output
+        return self._loss_fn(output, label)

@@ -1,0 +1,13 @@
+from . import mp_ops  # noqa: F401
+from .mp_layers import (  # noqa: F401
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from .random import (  # noqa: F401
+    RNGStatesTracker,
+    dropout,
+    get_rng_state_tracker,
+    model_parallel_random_seed,
+)

@@ -1,0 +1,99 @@
+"""TP-safe random state: RNGStatesTracker + parallel dropout.
+
+Reference analog: python/paddle/distributed/fleet/layers/mpu/random.py (RNGStatesTracker,
+get_rng_state_tracker, model_parallel_random_seed, dropout with a `rng_name`): TP needs
+dropout INSIDE a column/row-parallel block to draw different masks per mp rank (activations
+are sharded) but the same mask across dp replicas.
+
+TPU-first redesign: the tracker keeps named jax PRNG keys. "local_seed" folds in the mp
+coordinate so per-shard draws differ; under GSPMD a mask generated from a replicated key on
+a sharded activation is already per-shard unique (each device computes its slice of one
+global random tensor), so the tracker mainly preserves the reference's API + determinism
+control (get/set state for recompute replay).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from ....framework import random as global_rng
+from ....framework.core import Tensor
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        self.seeds_.add(seed)
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.states_[name] = jax.random.key(seed)
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    @contextlib.contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        orig = global_rng.get_rng_state()
+        global_rng.set_rng_state(self.states_[name])
+        try:
+            yield
+        finally:
+            self.states_[name] = global_rng.get_rng_state()
+            global_rng.set_rng_state(orig)
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    """Seed global + mp-local streams (random.py model_parallel_random_seed)."""
+    from ..topology import get_hybrid_parallel_group
+
+    hcg = get_hybrid_parallel_group()
+    mp_rank = hcg.get_model_parallel_rank() if hcg is not None else 0
+    if seed is None:
+        seed = 0
+    global_seed = seed
+    local_seed = seed + 1024 + mp_rank
+    _RNG_STATE_TRACKER.reset()
+    _RNG_STATE_TRACKER.add(MODEL_PARALLEL_RNG, local_seed)
+    global_rng.seed(global_seed)
+
+
+def determinate_seed(rng_name):
+    t = _RNG_STATE_TRACKER
+    if rng_name in t.states_:
+        return rng_name
+    return None
+
+
+def dropout(x, p=0.5, axis=None, rng_name=None, training=True, mode="upscale_in_train",
+            name=None):
+    """Dropout drawing from a tracker stream when rng_name is given (random.py dropout)."""
+    from ....nn import functional as F
+
+    if rng_name is None or rng_name not in _RNG_STATE_TRACKER.states_:
+        return F.dropout(x, p=p, axis=axis, training=training, mode=mode)
+    with _RNG_STATE_TRACKER.rng_state(rng_name):
+        return F.dropout(x, p=p, axis=axis, training=training, mode=mode)

@@ -1,0 +1,142 @@
+"""Activation recomputation (gradient checkpointing).
+
+Reference analog: python/paddle/distributed/fleet/recompute/recompute.py — a PyLayer that
+stashes inputs + RNG state, drops intermediate activations, and re-runs the forward inside
+backward with the RNG replayed (`paddle.distributed.fleet.utils.recompute`);
+recompute_hybrid.py adds mp-aware offload.
+
+TPU-first redesign: recompute IS jax.checkpoint (remat). The segment's forward is traced as
+a pure function of (inputs, params, rng key) and wrapped in jax.checkpoint, so the vjp
+stores only the segment boundaries and rematerializes inside the backward pass — including
+under whole-step jit, where it becomes an XLA-level remat region (the actual HBM saving).
+RNG replay is exact: the same key is threaded into both the forward and the recomputed
+trace. `sr`/selective strategies map onto jax.checkpoint policies.
+"""
+from __future__ import annotations
+
+import jax
+
+from ...autograd import tape
+from ...framework import random as rng
+from ...framework.core import Tensor
+from ...nn.layer.layers import Layer
+from ...ops._apply import apply_raw
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def _find_layer(function):
+    if isinstance(function, Layer):
+        return function
+    owner = getattr(function, "__self__", None)
+    if isinstance(owner, Layer):
+        return owner
+    return None
+
+
+_POLICIES = {
+    None: None,
+    "full": None,
+    # save matmul outputs, recompute the cheap elementwise ops (selective recompute)
+    "dots_saveable": jax.checkpoint_policies.dots_saveable,
+    "dots_with_no_batch_dims_saveable":
+        jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
+}
+
+
+def recompute(function, *args, **kwargs):
+    """Run `function(*args)` without keeping its intermediate activations.
+
+    fleet/recompute/recompute.py analog. `function` should be a Layer (or a bound method
+    of one) so its parameters join the differentiation set.
+    """
+    preserve_rng_state = kwargs.pop("preserve_rng_state", True)
+    kwargs.pop("use_reentrant", None)
+    policy = _POLICIES.get(kwargs.pop("checkpoint_policy", None))
+
+    layer = _find_layer(function)
+    state_tensors = []
+    if layer is not None:
+        state_tensors = [p for _, p in layer.named_parameters()]
+        state_tensors += [b for _, b in layer.named_buffers() if b is not None]
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs), is_leaf=_is_tensor)
+    t_idx = [i for i, l in enumerate(leaves) if _is_tensor(l)]
+    t_leaves = [leaves[i] for i in t_idx]
+    n_state = len(state_tensors)
+    key = rng.next_key() if preserve_rng_state else rng.get_rng_state()
+    out_box = {}
+
+    def segment(rng_key, *vals):
+        state_vals, arg_vals = vals[:n_state], vals[n_state:]
+        with tape.functional_mode(), rng.trace_key(rng_key):
+            saved = [(t, t._value) for t in state_tensors]
+            try:
+                for t, v in zip(state_tensors, state_vals):
+                    t._replace_value(v)
+                buf = list(leaves)
+                for i, v, src in zip(t_idx, arg_vals, t_leaves):
+                    t = Tensor(v)
+                    t.stop_gradient = src.stop_gradient
+                    buf[i] = t
+                a, k = jax.tree_util.tree_unflatten(treedef, buf)
+                out = function(*a, **k)
+                out_leaves, out_tree = jax.tree_util.tree_flatten(out, is_leaf=_is_tensor)
+                out_box["tree"] = out_tree
+                out_box["is_tensor"] = [_is_tensor(o) for o in out_leaves]
+                return tuple(o.value if _is_tensor(o) else o for o in out_leaves)
+            finally:
+                for t, v in saved:
+                    t._replace_value(v)
+
+    ckpt = jax.checkpoint(segment, policy=policy) if policy is not None else (
+        jax.checkpoint(segment))
+
+    key_t = Tensor(key)
+    outs = apply_raw("recompute", ckpt, [key_t] + state_tensors + t_leaves)
+    out_vals = []
+    for i, flag in enumerate(out_box["is_tensor"]):
+        out_vals.append(outs[i] if flag else outs[i].numpy())
+    return jax.tree_util.tree_unflatten(out_box["tree"], out_vals)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """paddle.incubate.distributed.fleet.recompute_sequential analog."""
+    segments = int((ctx or {}).get("segments", 1))
+    if isinstance(functions, (list, tuple)):
+        fns = list(functions)
+    else:
+        fns = list(functions)  # Sequential is iterable over sublayers
+    if segments <= 1:
+        out = args
+        for f in fns:
+            out = (recompute(f, *out, **kwargs),)
+        return out[0]
+    size = max(1, len(fns) // segments)
+    out = args
+
+    class _Seg(Layer):
+        def __init__(self, sub):
+            super().__init__()
+            for i, s in enumerate(sub):
+                self.add_sublayer(str(i), s)
+            self._sub = sub
+
+        def forward(self, *xs):
+            for s in self._sub:
+                xs = (s(*xs),) if not isinstance(xs, tuple) else (s(*xs),)
+            return xs[0]
+
+    for start in range(0, len(fns), size):
+        seg = _Seg(fns[start:start + size])
+        out = (recompute(seg, *out, **kwargs),)
+    return out[0]
+
+
+def recompute_hybrid(ctx, function, *args, **kwargs):
+    """mp-aware variant (recompute_hybrid.py): offload/partition knobs are XLA's remat
+    placement decisions here; semantics equal recompute."""
+    return recompute(function, *args, **kwargs)

@@ -1,0 +1,101 @@
+"""DistributedStrategy: every hybrid-parallel/optimization knob in one config object.
+
+Reference analog: python/paddle/distributed/fleet/base/distributed_strategy.py (2,826 LoC,
+backed by framework/distributed_strategy.proto). The TPU build keeps the same attribute
+surface on plain Python state — there is no protobuf round-trip because no C++ pass
+pipeline consumes it; the Python wrappers read the knobs directly.
+"""
+from __future__ import annotations
+
+import copy
+
+
+_DEFAULT_HYBRID = {
+    "dp_degree": 1,
+    "mp_degree": 1,
+    "pp_degree": 1,
+    "sharding_degree": 1,
+    "sep_degree": 1,
+    "order": ["pp", "dp", "sharding", "sep", "mp"],
+    "mp_configs": {},
+    "pp_configs": {},
+}
+
+
+class DistributedStrategy:
+    def __init__(self):
+        # hybrid parallel
+        self.hybrid_configs = copy.deepcopy(_DEFAULT_HYBRID)
+        # amp
+        self.amp = False
+        self.amp_configs = {
+            "init_loss_scaling": 32768.0,
+            "use_dynamic_loss_scaling": True,
+            "use_pure_fp16": False,
+            "use_bf16": True,  # TPU-first default: bf16 needs no loss scaling
+            "custom_white_list": [],
+            "custom_black_list": [],
+        }
+        # recompute
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": [], "enable_offload": False}
+        # sharding (ZeRO)
+        self.sharding = False
+        self.sharding_configs = {
+            "sharding_degree": 1,
+            "stage": 1,
+            "offload": False,
+            "comm_buffer_size_MB": 25,
+        }
+        # pipeline
+        self.pipeline = False
+        self.pipeline_configs = {
+            "accumulate_steps": 1,
+            "micro_batch_size": 1,
+            "schedule_mode": "1F1B",
+            "p2p_cache_shape": True,
+        }
+        # misc optimizations (accepted for parity; XLA does the fusion work)
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        self.lamb = False
+        self.lars = False
+        self.dgc = False
+        self.localsgd = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.find_unused_parameters = False
+        self.gradient_scale_configs = {"scale_strategy": "avg"}
+        self.heter_ccl_mode = False
+        self.without_graph_optimization = True
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {}
+        self.a_sync = False
+        self.a_sync_configs = {}
+        self.auto_tuner = False
+
+    def __setattr__(self, key, value):
+        if key == "hybrid_configs" and hasattr(self, "hybrid_configs"):
+            merged = copy.deepcopy(_DEFAULT_HYBRID)
+            merged.update(value or {})
+            object.__setattr__(self, key, merged)
+            return
+        object.__setattr__(self, key, value)
+
+    @property
+    def hybrid_parallel_order(self):
+        return list(self.hybrid_configs.get("order", _DEFAULT_HYBRID["order"]))
+
+    def to_dict(self):
+        return {k: v for k, v in self.__dict__.items()}
+
+    def __repr__(self):
+        lines = ["DistributedStrategy("]
+        for k, v in sorted(self.__dict__.items()):
+            lines.append(f"  {k}={v!r},")
+        lines.append(")")
+        return "\n".join(lines)
+
+
+class Strategy(DistributedStrategy):
+    """auto_parallel Strategy (auto_parallel/strategy.py) — same knobs, dot-access groups."""

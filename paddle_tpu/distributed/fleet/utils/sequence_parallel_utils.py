@@ -1,0 +1,150 @@
+"""Megatron-style sequence parallelism inside the TP group.
+
+Reference analog: python/paddle/distributed/fleet/utils/sequence_parallel_utils.py —
+ScatterOp/GatherOp/AllGatherOp/ReduceScatterOp PyLayers (:85-127) move activations between
+"sharded along seq over mp" and "whole" around the TP linear blocks;
+ColumnSequenceParallelLinear :429 / RowSequenceParallelLinear keep activations seq-sharded
+outside matmuls; register_sequence_parallel_allreduce_hooks :192 all-reduces grads of
+sequence-parallel params (LayerNorm scales etc.) over mp.
+
+TPU-first redesign: seq-parallelism is a sharding annotation on the sequence dim over the
+same `mp` mesh axis; XLA emits the reference's all-gather before the column matmul and
+reduce-scatter after the row matmul from the annotations alone (the identity+constraint
+pattern), with backward transposes derived automatically.
+"""
+from __future__ import annotations
+
+import jax
+
+from ....framework.core import Tensor
+from ....nn.layer.layers import Layer
+from ....nn import functional as F
+from ....nn.initializer import Constant
+from ... import api as dist_api
+from ...placement import Replicate, Shard
+from ..topology import get_hybrid_parallel_group
+from ..mpu import mp_ops
+from ..mpu.mp_layers import _mp_context, _shard_param
+
+
+def scatter(input, axis=0):  # noqa: A002
+    """Whole -> seq-sharded over mp (ScatterOp). Backward = all-gather."""
+    return mp_ops.mark_sharded(input, dim=axis, mesh_axis="mp")
+
+
+def all_gather(input, axis=0):  # noqa: A002
+    """Seq-sharded -> whole (AllGatherOp). Backward = reduce-scatter of the grad."""
+    return mp_ops.mark_replicated(input)
+
+
+def gather(input, axis=0):  # noqa: A002
+    """GatherOp: same data movement as all_gather under a global-tensor view."""
+    return mp_ops.mark_replicated(input)
+
+
+def reduce_scatter(input, axis=0):  # noqa: A002
+    """Partial-over-mp -> seq-sharded (ReduceScatterOp): psum fused with the re-shard."""
+    return mp_ops.mark_sharded(input, dim=axis, mesh_axis="mp")
+
+
+class ScatterOp:
+    apply = staticmethod(scatter)
+
+
+class GatherOp:
+    apply = staticmethod(gather)
+
+
+class AllGatherOp:
+    apply = staticmethod(all_gather)
+
+
+class ReduceScatterOp:
+    apply = staticmethod(reduce_scatter)
+
+
+_SP_PARAMS = None
+
+
+def _sp_registry():
+    global _SP_PARAMS
+    if _SP_PARAMS is None:
+        import weakref
+
+        _SP_PARAMS = weakref.WeakSet()
+    return _SP_PARAMS
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    _sp_registry().add(parameter)
+
+
+def is_sequence_parallel_parameter(parameter):
+    return parameter in _sp_registry()
+
+
+def create_fused_allreduce_gradient_hooks(parameter_list, accumulation_steps):
+    return None
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_sequence_parallel_allreduce=False):
+    """No-op under GSPMD: sequence-parallel params are replicated global tensors whose
+    grads XLA already psums over mp; kept for API parity (:192)."""
+    return None
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """Column-parallel linear whose input arrives seq-sharded (:429).
+
+    all-gather(seq) -> matmul with output-dim-sharded weight -> output stays mp-sharded.
+    """
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=None,
+                 gather_output=False, fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        mesh, axis_idx, degree = _mp_context()
+        self.is_mp = degree > 1
+        w = self.create_parameter(shape=[in_features, out_features], attr=weight_attr)
+        self.weight = _shard_param(w, mesh, axis_idx, 1)
+        if has_bias is None or has_bias:
+            b = self.create_parameter(shape=[out_features], attr=None, is_bias=True,
+                                      default_initializer=Constant(0.0))
+            self.bias = _shard_param(b, mesh, axis_idx, 0)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        x = all_gather(x)
+        out = F.linear(x, self.weight, self.bias)
+        return mp_ops.mark_sharded(out, dim=-1)
+
+
+class RowSequenceParallelLinear(Layer):
+    """Row-parallel linear producing a seq-sharded output (reduce-scatter fused)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 input_is_parallel=True, fuse_matmul_bias=False, mp_group=None,
+                 name=None):
+        super().__init__()
+        mesh, axis_idx, degree = _mp_context()
+        self.is_mp = degree > 1
+        self.input_is_parallel = input_is_parallel
+        w = self.create_parameter(shape=[in_features, out_features], attr=weight_attr)
+        self.weight = _shard_param(w, mesh, axis_idx, 0)
+        if has_bias:
+            self.bias = self.create_parameter(
+                shape=[out_features], attr=None, is_bias=True,
+                default_initializer=Constant(0.0))
+            mark_as_sequence_parallel_parameter(self.bias)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if not self.input_is_parallel:
+            x = mp_ops.mark_sharded(x, dim=-1)
+        out = F.linear(x, self.weight)
+        out = reduce_scatter(out, axis=0)
+        if self.bias is not None:
+            out = out + self.bias
+        return out

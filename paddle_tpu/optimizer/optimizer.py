@@ -66,6 +66,10 @@ class Optimizer:
         self._master_weights = {}  # id(param) -> fp32 jax array
         self._step_count = 0
         self._jit_cache = {}
+        # ZeRO-style state placement hook (set by dist.shard_optimizer / fleet sharding):
+        # called as _shard_fn(state_name, param, accumulator_tensor) -> sharded tensor
+        self._shard_fn = None
+        self._is_dist = False
 
     # -- lr ------------------------------------------------------------------
     def get_lr(self):
@@ -80,6 +84,10 @@ class Optimizer:
 
     def set_lr_scheduler(self, scheduler):
         self._learning_rate = scheduler
+
+    @staticmethod
+    def _as_value(x):
+        return x.value if isinstance(x, Tensor) else x
 
     def _parameter_list_flat(self):
         return [p for g in self._param_groups for p in g["params"]]
@@ -122,7 +130,13 @@ class Optimizer:
             p_vals, g_vals, states, masters = [], [], [], []
             for p, g in pg:
                 if id(p) not in self._accumulators:
-                    self._accumulators[id(p)] = self._init_state(p)
+                    state = self._init_state(p)
+                    if self._shard_fn is not None:
+                        state = {
+                            k: self._as_value(self._shard_fn(k, p, Tensor(v)))
+                            for k, v in state.items()
+                        }
+                    self._accumulators[id(p)] = state
                     if self._use_master_weights and np.dtype(p.dtype) in (
                         np.dtype(np.float16), np.dtype(jnp.bfloat16)
                     ):
