@@ -1,0 +1,144 @@
+"""Benchmark: flagship LLaMA training throughput on the available chip.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+The reference publishes no in-tree numbers (BASELINE.md); vs_baseline is therefore
+reported against the analytic hardware roofline: achieved model FLOP/s utilisation (MFU)
+— the fraction of the chip's peak matmul throughput the training step sustains. That is
+the cross-hardware-comparable number (A100 Paddle LLM pretraining typically lands at
+0.3-0.5 MFU; matching it = parity per BASELINE.json's >=90% per-chip goal).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def _peak_flops(device):
+    """Peak bf16 FLOP/s for known platforms (used for the MFU denominator)."""
+    kind = getattr(device, "device_kind", "").lower()
+    table = {
+        # chip: peak bf16 matmul FLOP/s
+        "tpu v2": 45e12, "tpu v3": 123e12, "tpu v4": 275e12,
+        "tpu v5 lite": 197e12, "tpu v5e": 197e12, "tpu v5": 459e12,
+        "tpu v5p": 459e12, "tpu v6 lite": 918e12, "tpu v6e": 918e12,
+    }
+    for k, v in table.items():
+        if k in kind:
+            return v
+    if device.platform == "tpu":
+        return 197e12  # conservative default: v5e
+    return 0.5e12  # CPU-ish fallback so local runs still print a line
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.autograd import tape
+    from paddle_tpu.framework import random as rng
+    from paddle_tpu.framework.core import Tensor
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+
+    # ~350M-param model in bf16 on TPU; tiny on CPU so the smoke run finishes fast
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=16, num_attention_heads=16, num_key_value_heads=16,
+            max_position_embeddings=2048, dtype="bfloat16")
+        batch, seq, iters = 8, 2048, 10
+    else:
+        cfg = LlamaConfig(
+            vocab_size=2048, hidden_size=256, intermediate_size=704,
+            num_hidden_layers=4, num_attention_heads=8, num_key_value_heads=8,
+            max_position_embeddings=512)
+        batch, seq, iters = 4, 256, 3
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        model.to(dtype="bfloat16")
+    optimizer = paddle.optimizer.AdamW(
+        learning_rate=1e-4, parameters=model.parameters())
+
+    params = [p for _, p in model.named_parameters()]
+    for p in params:
+        if id(p) not in optimizer._accumulators:
+            optimizer._accumulators[id(p)] = optimizer._init_state(p)
+    acc_keys = [sorted(optimizer._accumulators[id(p)].keys()) for p in params]
+
+    def train_step(param_values, acc_values, ids, labels):
+        with rng.trace_key(jax.random.PRNGKey(0)):
+            saved_p = [(p, p._value) for p in params]
+            saved_a = {id(p): dict(optimizer._accumulators[id(p)]) for p in params}
+            try:
+                for p, v in zip(params, param_values):
+                    p._replace_value(v)
+                for p, ks, vs in zip(params, acc_keys, acc_values):
+                    for k, v in zip(ks, vs):
+                        optimizer._accumulators[id(p)][k] = v
+                loss, _ = model(Tensor(ids), labels=Tensor(labels))
+                loss.backward()
+                optimizer.step()
+                optimizer.clear_grad()
+                new_p = [p._value for p in params]
+                new_a = [[optimizer._accumulators[id(p)][k] for k in ks]
+                         for p, ks in zip(params, acc_keys)]
+                return loss.value, new_p, new_a
+            finally:
+                for p, v in saved_p:
+                    p._replace_value(v)
+                for p in params:
+                    optimizer._accumulators[id(p)] = saved_a[id(p)]
+
+    r = np.random.RandomState(0)
+    ids = jnp.asarray(r.randint(0, cfg.vocab_size, (batch, seq)), jnp.int32)
+    labels = jnp.asarray(r.randint(0, cfg.vocab_size, (batch, seq)), jnp.int32)
+    pv = [p.value for p in params]
+    av = [[optimizer._accumulators[id(p)][k] for k in ks]
+          for p, ks in zip(params, acc_keys)]
+
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+
+    # warmup/compile
+    loss, pv, av = step(pv, av, ids, labels)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss, pv, av = step(pv, av, ids, labels)
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / iters
+
+    tokens_per_s = batch * seq / dt
+
+    # 6*N FLOPs/token (fwd+bwd) + attention term
+    n_params = sum(int(np.prod(p.shape)) for p in params)
+    attn_flops = 12 * cfg.num_hidden_layers * cfg.hidden_size * seq
+    flops_per_token = 6 * n_params + attn_flops
+    mfu = tokens_per_s * flops_per_token / _peak_flops(dev)
+
+    print(json.dumps({
+        "metric": "llama_train_tokens_per_sec",
+        "value": round(tokens_per_s, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu, 4),
+        "detail": {
+            "model_params": n_params,
+            "batch": batch, "seq": seq,
+            "step_ms": round(dt * 1e3, 2),
+            "device": str(getattr(dev, "device_kind", dev.platform)),
+            "mfu": round(mfu, 4),
+            "loss": float(jax.device_get(loss)),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

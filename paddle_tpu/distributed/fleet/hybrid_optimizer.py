@@ -74,10 +74,14 @@ class HybridParallelOptimizer:
         clip = getattr(optimizer, "_grad_clip", None)
         if isinstance(clip, ClipGradByGlobalNorm):
             optimizer._grad_clip = HybridParallelClipGrad(clip, self._hcg)
-        if (strategy is not None and strategy.sharding
-                and strategy.sharding_configs.get("stage", 1) >= 1):
-            _shard_optimizer_states(optimizer, self._hcg,
-                                    stage=strategy.sharding_configs.get("stage", 1))
+        # reference fleet wraps with DygraphShardingOptimizer whenever the carved
+        # sharding axis is non-trivial, regardless of the strategy.sharding knob
+        stage = 1
+        if strategy is not None and strategy.sharding:
+            stage = strategy.sharding_configs.get("stage", 1)
+        if (self._hcg is not None
+                and self._hcg.get_sharding_parallel_world_size() > 1):
+            _shard_optimizer_states(optimizer, self._hcg, stage=stage)
 
     def __getattr__(self, item):
         return getattr(self._inner_opt, item)
@@ -93,15 +97,20 @@ class HybridParallelOptimizer:
         return self._inner_opt
 
 
-def _sharding_placements(mesh):
-    idx = mesh.dim_names.index("sharding")
+def _make_state_shard_fn(mesh, axis_idx, degree):
+    """The one placement builder every ZeRO entry point shares: accumulators whose
+    leading dim divides the sharding degree get Shard(0) on that axis, else stay put."""
 
-    def for_dim(tensor_dim=0):
+    def shard_fn(key, param, accumulator):
+        v = accumulator.value if isinstance(accumulator, Tensor) else accumulator
+        if v.ndim == 0 or v.shape[0] % degree != 0:
+            return accumulator
+        t = accumulator if isinstance(accumulator, Tensor) else Tensor(accumulator)
         placements = [Replicate()] * mesh.ndim
-        placements[idx] = Shard(tensor_dim)
-        return placements
+        placements[axis_idx] = Shard(0)
+        return dist_api.shard_tensor(t, mesh, placements)
 
-    return for_dim
+    return shard_fn
 
 
 def _shard_optimizer_states(optimizer, hcg, stage=1):
@@ -110,16 +119,9 @@ def _shard_optimizer_states(optimizer, hcg, stage=1):
     if hcg is None or hcg.get_sharding_parallel_world_size() <= 1:
         return
     mesh = hcg.global_mesh
-    for_dim = _sharding_placements(mesh)
-
-    def shard_fn(key, param, accumulator):
-        v = accumulator.value if isinstance(accumulator, Tensor) else accumulator
-        if v.ndim == 0 or v.shape[0] % hcg.get_sharding_parallel_world_size() != 0:
-            return accumulator
-        t = accumulator if isinstance(accumulator, Tensor) else Tensor(accumulator)
-        return dist_api.shard_tensor(t, mesh, for_dim(0))
-
-    optimizer._shard_fn = shard_fn
+    optimizer._shard_fn = _make_state_shard_fn(
+        mesh, mesh.dim_names.index("sharding"),
+        hcg.get_sharding_parallel_world_size())
     optimizer._is_dist = True
 
 
@@ -162,13 +164,7 @@ def group_sharded_parallel(model, optimizer, level="os_g", scaler=None, group=No
         placements[axis_idx] = Shard(0)
         return placements
 
-    def shard_fn(key, param, accumulator):
-        t = accumulator if isinstance(accumulator, Tensor) else Tensor(accumulator)
-        if t.ndim == 0 or t.shape[0] % degree != 0:
-            return accumulator
-        return dist_api.shard_tensor(t, mesh, state_placements())
-
-    optimizer._shard_fn = shard_fn
+    optimizer._shard_fn = _make_state_shard_fn(mesh, axis_idx, degree)
     optimizer._is_dist = True
 
     if level == "p_g_os":

@@ -114,13 +114,14 @@ class PipelineParallel(MetaParallelBase):
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         """pipeline_parallel.py:940 train_batch."""
         self._layers.train()
-        if self.accumulate_steps * self.micro_batch_size > 0:
-            # infer accumulate_steps from the global batch if unset
-            inputs = data[0]
-            if isinstance(inputs, (list, tuple)):
-                inputs = inputs[0]
-            if isinstance(inputs, Tensor):
-                total = inputs.shape[0]
+        # infer accumulate_steps from the global batch only when the configured
+        # schedule doesn't cover it (reference: accumulate_steps is authoritative)
+        inputs = data[0]
+        if isinstance(inputs, (list, tuple)):
+            inputs = inputs[0]
+        if isinstance(inputs, Tensor):
+            total = inputs.shape[0]
+            if self.accumulate_steps * self.micro_batch_size != total:
                 self.accumulate_steps = max(1, total // self.micro_batch_size)
         loss = self.forward_backward_pipeline(data, scaler)
         if scaler is not None:

@@ -144,7 +144,8 @@ class PipelineLayer(Layer):
                     and i % self._recompute_interval == 0):
                 from ..recompute import recompute
 
-                x = recompute(fn, x)
+                x = recompute(fn, x) if not isinstance(x, tuple) \
+                    else recompute(fn, *x)
             else:
                 x = fn(x) if not isinstance(x, tuple) else fn(*x)
         return x

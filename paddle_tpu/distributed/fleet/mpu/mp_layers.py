@@ -57,7 +57,7 @@ class VocabParallelEmbedding(Layer):
         mesh, axis_idx, degree = _mp_context()
         if num_embeddings % degree != 0:
             raise ValueError(
-                f"vocab size {num_embeddings} must divide mp degree {degree}"
+                f"vocab size {num_embeddings} must be divisible by mp degree {degree}"
             )
         self._num_embeddings = num_embeddings
         self._embedding_dim = embedding_dim
@@ -82,7 +82,7 @@ class ColumnParallelLinear(Layer):
         mesh, axis_idx, degree = _mp_context()
         if out_features % degree != 0:
             raise ValueError(
-                f"out_features {out_features} must divide mp degree {degree}"
+                f"out_features {out_features} must be divisible by mp degree {degree}"
             )
         self._in_features = in_features
         self._out_features = out_features
@@ -114,7 +114,7 @@ class RowParallelLinear(Layer):
         mesh, axis_idx, degree = _mp_context()
         if in_features % degree != 0:
             raise ValueError(
-                f"in_features {in_features} must divide mp degree {degree}"
+                f"in_features {in_features} must be divisible by mp degree {degree}"
             )
         self._in_features = in_features
         self._out_features = out_features

@@ -55,7 +55,12 @@ def recompute(function, *args, **kwargs):
     """
     preserve_rng_state = kwargs.pop("preserve_rng_state", True)
     kwargs.pop("use_reentrant", None)
-    policy = _POLICIES.get(kwargs.pop("checkpoint_policy", None))
+    policy_name = kwargs.pop("checkpoint_policy", None)
+    if policy_name not in _POLICIES:
+        raise ValueError(
+            f"unknown checkpoint_policy {policy_name!r}; "
+            f"expected one of {sorted(k for k in _POLICIES if k)}")
+    policy = _POLICIES[policy_name]
 
     layer = _find_layer(function)
     state_tensors = []

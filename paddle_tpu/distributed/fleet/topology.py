@@ -121,7 +121,8 @@ class HybridCommunicateGroup:
             self._groups[name] = self._build_group(name)
         # fused dp+sep group (reference topology.py:260): gradients of non-sequence-
         # sharded params all-reduce over dp and sep together
-        self._dp_sep_group = self._build_fused_group(["dp", "sep"])
+        self._dp_sep_group = self._build_fused_group(
+            [n for n in ("dp", "sep") if n in names])
         # "check" group = everything except dp (model replicas hold identical data)
         self._check_group = self._build_fused_group(
             [n for n in names if n != "dp"]

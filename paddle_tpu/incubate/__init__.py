@@ -1,0 +1,6 @@
+"""paddle.incubate equivalent: experimental / fused APIs.
+
+Reference analog: python/paddle/incubate/ (fused ops in incubate/nn/functional, MoE models
+in incubate/distributed/models/moe).
+"""
+from . import nn  # noqa: F401

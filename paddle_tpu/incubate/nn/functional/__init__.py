@@ -1,0 +1,164 @@
+"""Fused op surface (paddle.incubate.nn.functional).
+
+Reference analog: python/paddle/incubate/nn/functional/{fused_rotary_position_embedding,
+fused_rms_norm, fused_layer_norm, swiglu, fused_dropout_add, fused_linear}.py — hand-fused
+CUDA kernels. TPU-first: each is ONE defop (a single jax-traceable function), so XLA fuses
+it into neighbouring HLO; the per-op eager path still runs it as one cached executable.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....nn.functional.activation import swiglu  # noqa: F401  (already fused)
+from ....ops._apply import defop
+
+
+def _rotate_half(x):
+    half = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+
+
+def _rope_tables(seq_len, head_dim, theta, dtype, position_ids=None):
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    if position_ids is None:
+        t = jnp.arange(seq_len, dtype=jnp.float32)
+    else:
+        t = position_ids.astype(jnp.float32)
+    freqs = jnp.einsum("...s,d->...sd", t, inv_freq)
+    emb = jnp.concatenate([freqs, freqs], axis=-1)
+    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
+
+
+@defop("fused_rotary_position_embedding", amp_category="white")
+def _fused_rope(q, k=None, v=None, sin=None, cos=None, position_ids=None,
+                use_neox_rotary_style=True, rotary_theta=10000.0):
+    """q/k/v: (B, S, H, D). Returns rotated (q, k, v) — v passes through (parity with
+    incubate/nn/functional/fused_rotary_position_embedding.py)."""
+    S, D = q.shape[1], q.shape[-1]
+    if cos is None or sin is None:
+        cos, sin = _rope_tables(S, D, rotary_theta, q.dtype, position_ids)
+    # broadcast (…S,D) over batch/head axes of (B,S,H,D)
+    if cos.ndim == 2:
+        cos_b = cos[None, :, None, :]
+        sin_b = sin[None, :, None, :]
+    else:  # (B,S,D) from position_ids
+        cos_b = cos[:, :, None, :]
+        sin_b = sin[:, :, None, :]
+
+    def rot(x):
+        return x * cos_b + _rotate_half(x) * sin_b
+
+    outs = [rot(q)]
+    outs.append(rot(k) if k is not None else None)
+    outs.append(v)
+    return tuple(o for o in outs if o is not None)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    rotary_theta=10000.0, name=None):
+    out = _fused_rope(q, k, v, sin=sin, cos=cos, position_ids=position_ids,
+                      use_neox_rotary_style=use_neox_rotary_style,
+                      rotary_theta=rotary_theta)
+    if not isinstance(out, tuple):
+        out = (out,)
+    res = list(out)
+    while len(res) < 3:
+        res.append(None)
+    return tuple(res[:3])
+
+
+@defop("fused_rms_norm", amp_category="fp32")
+def _fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6, begin_norm_axis=-1):
+    axes = tuple(range(begin_norm_axis % x.ndim, x.ndim))
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=axes, keepdims=True)
+    y = (xf * jax.lax.rsqrt(var + epsilon)).astype(x.dtype)
+    if norm_weight is not None:
+        y = y * norm_weight
+    if norm_bias is not None:
+        y = y + norm_bias
+    return y
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6, begin_norm_axis=-1,
+                   name=None):
+    return _fused_rms_norm(x, norm_weight, norm_bias, epsilon=epsilon,
+                           begin_norm_axis=begin_norm_axis)
+
+
+@defop("fused_layer_norm", amp_category="fp32")
+def _fused_layer_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-5,
+                      begin_norm_axis=-1, residual=None):
+    if residual is not None:
+        x = x + residual
+    axes = tuple(range(begin_norm_axis % x.ndim, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    y = ((xf - mean) * jax.lax.rsqrt(var + epsilon)).astype(x.dtype)
+    if norm_weight is not None:
+        y = y * norm_weight
+    if norm_bias is not None:
+        y = y + norm_bias
+    return y
+
+
+def fused_layer_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-5,
+                     begin_norm_axis=-1, residual=None, name=None):
+    return _fused_layer_norm(x, norm_weight, norm_bias, epsilon=epsilon,
+                             begin_norm_axis=begin_norm_axis, residual=residual)
+
+
+@defop("fused_dropout_add")
+def _fused_dropout_add(x, y, key=None, p=0.5, training=True,
+                       mode="upscale_in_train"):
+    if not training or p == 0.0 or key is None:
+        return x + y
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    if mode == "upscale_in_train":
+        dropped = jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+    else:
+        dropped = jnp.where(keep, x, 0.0).astype(x.dtype)
+    return dropped + y
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train", name=None):
+    from ....framework import random as rng
+
+    key = rng.next_key() if (training and p > 0.0) else None
+    return _fused_dropout_add(x, y, key=key, p=p, training=training, mode=mode)
+
+
+@defop("fused_linear")
+def _fused_linear(x, weight, bias=None, transpose_weight=False):
+    w = weight.T if transpose_weight else weight
+    y = jnp.matmul(x, w)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    return _fused_linear(x, weight, bias, transpose_weight=transpose_weight)
+
+
+@defop("fused_bias_act")
+def _fused_bias_act(x, bias=None, act_method="gelu"):
+    if bias is not None:
+        x = x + bias
+    if act_method in ("gelu", "geglu"):
+        return jax.nn.gelu(x, approximate=False)
+    if act_method == "relu":
+        return jax.nn.relu(x)
+    if act_method in ("swiglu",):
+        a, b = jnp.split(x, 2, axis=-1)
+        return jax.nn.silu(a) * b
+    if act_method in ("silu", "swish"):
+        return jax.nn.silu(x)
+    raise ValueError(f"unsupported act_method {act_method}")
+
+
+def fused_bias_act(x, bias=None, act_method="gelu", name=None, **kwargs):
+    return _fused_bias_act(x, bias, act_method=act_method)

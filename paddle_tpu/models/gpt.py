@@ -1,0 +1,226 @@
+"""GPT model family (GPT-2/3 style) — the reference's hybrid-parallel benchmark model.
+
+Reference analog: the GPT used across the reference's collective/fleet hybrid tests and
+the ERNIE/GPT-3 1.3B benchmark config (BASELINE.md config 4): learned position embeddings,
+pre-LN transformer decoder with GELU MLP, tied or separate LM head, TP via the mpu layers.
+Same TPU-first structure as models/llama.py: pure functional compute + GSPMD sharding.
+"""
+from __future__ import annotations
+
+from .. import ops
+from ..nn import functional as F
+from ..nn.initializer import Normal
+from ..nn.layer.common import Dropout, Embedding, Linear
+from ..nn.layer.container import LayerList
+from ..nn.layer.layers import Layer
+from ..nn.layer.norm import LayerNorm
+
+
+class GPTConfig:
+    def __init__(
+        self,
+        vocab_size=50304,
+        hidden_size=768,
+        intermediate_size=None,
+        num_hidden_layers=12,
+        num_attention_heads=12,
+        max_position_embeddings=1024,
+        hidden_dropout_prob=0.1,
+        attention_probs_dropout_prob=0.1,
+        initializer_range=0.02,
+        layer_norm_epsilon=1e-5,
+        use_flash_attention=True,
+        tie_word_embeddings=True,
+        tensor_parallel_degree=1,
+        sequence_parallel=False,
+        pipeline_parallel_degree=1,
+        recompute=False,
+        **kwargs,
+    ):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.intermediate_size = intermediate_size or 4 * hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.max_position_embeddings = max_position_embeddings
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.initializer_range = initializer_range
+        self.layer_norm_epsilon = layer_norm_epsilon
+        self.use_flash_attention = use_flash_attention
+        self.tie_word_embeddings = tie_word_embeddings
+        self.tensor_parallel_degree = tensor_parallel_degree
+        self.sequence_parallel = sequence_parallel
+        self.pipeline_parallel_degree = pipeline_parallel_degree
+        self.recompute = recompute
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+def _tp(config):
+    return config.tensor_parallel_degree > 1
+
+
+class GPTAttention(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        h = config.hidden_size
+        init = Normal(std=config.initializer_range)
+        if _tp(config):
+            from ..distributed.fleet.mpu.mp_layers import (
+                ColumnParallelLinear, RowParallelLinear)
+
+            self.qkv_proj = ColumnParallelLinear(
+                h, 3 * h, has_bias=True, gather_output=False, weight_attr=init)
+            self.out_proj = RowParallelLinear(
+                h, h, has_bias=True, input_is_parallel=True, weight_attr=init)
+        else:
+            self.qkv_proj = Linear(h, 3 * h, weight_attr=init)
+            self.out_proj = Linear(h, h, weight_attr=init)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x):
+        B, S = x.shape[0], x.shape[1]
+        H, D = self.config.num_attention_heads, self.config.head_dim
+        qkv = self.qkv_proj(x)
+        qkv = ops.reshape(qkv, [B, S, 3, H, D])
+        q, k, v = ops.unbind(qkv, axis=2)
+        out = F.scaled_dot_product_attention(
+            q, k, v, dropout_p=self.config.attention_probs_dropout_prob,
+            is_causal=True, training=self.training)
+        out = ops.reshape(out, [B, S, H * D])
+        return self.dropout(self.out_proj(out))
+
+
+class GPTMLP(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        h, m = config.hidden_size, config.intermediate_size
+        init = Normal(std=config.initializer_range)
+        if _tp(config):
+            from ..distributed.fleet.mpu.mp_layers import (
+                ColumnParallelLinear, RowParallelLinear)
+
+            self.fc1 = ColumnParallelLinear(h, m, has_bias=True, gather_output=False,
+                                            weight_attr=init)
+            self.fc2 = RowParallelLinear(m, h, has_bias=True, input_is_parallel=True,
+                                         weight_attr=init)
+        else:
+            self.fc1 = Linear(h, m, weight_attr=init)
+            self.fc2 = Linear(m, h, weight_attr=init)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x):
+        return self.dropout(self.fc2(F.gelu(self.fc1(x), approximate=True)))
+
+
+class GPTDecoderLayer(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln_1 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+        self.attn = GPTAttention(config)
+        self.ln_2 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+        self.mlp = GPTMLP(config)
+        self._recompute = config.recompute
+
+    def _block(self, x):
+        x = x + self.attn(self.ln_1(x))
+        return x + self.mlp(self.ln_2(x))
+
+    def forward(self, x):
+        if self._recompute and self.training:
+            from ..distributed.fleet.recompute import recompute
+
+            return recompute(self._block, x)
+        return self._block(x)
+
+
+class GPTEmbeddings(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        init = Normal(std=config.initializer_range)
+        if _tp(config):
+            from ..distributed.fleet.mpu.mp_layers import VocabParallelEmbedding
+
+            self.word_embeddings = VocabParallelEmbedding(
+                config.vocab_size, config.hidden_size, weight_attr=init)
+        else:
+            self.word_embeddings = Embedding(
+                config.vocab_size, config.hidden_size, weight_attr=init)
+        self.position_embeddings = Embedding(
+            config.max_position_embeddings, config.hidden_size, weight_attr=init)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids):
+        S = input_ids.shape[-1]
+        pos = ops.arange(0, S, dtype="int64")
+        h = self.word_embeddings(input_ids) + self.position_embeddings(pos)
+        return self.dropout(h)
+
+
+class GPTModel(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = GPTEmbeddings(config)
+        self.h = LayerList([GPTDecoderLayer(config)
+                            for _ in range(config.num_hidden_layers)])
+        self.ln_f = LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+
+    def forward(self, input_ids):
+        x = self.embeddings(input_ids)
+        for layer in self.h:
+            x = layer(x)
+        return self.ln_f(x)
+
+
+class GPTPretrainingCriterion(Layer):
+    def __init__(self, config: GPTConfig, ignore_index=-100):
+        super().__init__()
+        self.config = config
+        self.ignore_index = ignore_index
+
+    def forward(self, logits, labels):
+        if _tp(self.config):
+            from ..distributed.fleet.mpu.mp_layers import ParallelCrossEntropy
+
+            tok = ParallelCrossEntropy(ignore_index=self.ignore_index)(logits, labels)
+        else:
+            tok = F.softmax_with_cross_entropy(
+                logits, labels, ignore_index=self.ignore_index)
+        tok = ops.squeeze(tok, -1) if tok.ndim > labels.ndim else tok
+        mask = (labels != self.ignore_index).astype(tok.dtype)
+        denom = ops.maximum(mask.sum(), ops.to_tensor(1.0, dtype=tok.dtype))
+        return (tok * mask).sum() / denom
+
+
+class GPTForCausalLM(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+        if not config.tie_word_embeddings:
+            init = Normal(std=config.initializer_range)
+            self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                                  weight_attr=init, bias_attr=False)
+        self.criterion = GPTPretrainingCriterion(config)
+
+    def forward(self, input_ids, labels=None):
+        h = self.gpt(input_ids)
+        if self.config.tie_word_embeddings:
+            w = ops.transpose(self.gpt.embeddings.word_embeddings.weight, [1, 0])
+            logits = ops.matmul(h, w)
+            if _tp(self.config):
+                from ..distributed.fleet.mpu import mp_ops
+
+                logits = mp_ops.mark_sharded(logits, dim=-1)
+        else:
+            logits = self.lm_head(h)
+        if labels is not None:
+            return self.criterion(logits, labels), logits
+        return logits

@@ -1,0 +1,421 @@
+"""LLaMA model family — the flagship LLM used for parallelism validation and benchmarks.
+
+Reference analog: the reference validates its whole hybrid/semi-auto parallel stack on a
+LLaMA implementation (test/auto_parallel/hybrid_strategy/semi_auto_parallel_llama_model.py;
+run under dp+mp+pp in semi_auto_llama.py). Capability parity here means: RMSNorm + rotary
+attention (GQA) + SwiGLU MLP decoder, a causal-LM head with optional weight tying, a
+pretraining criterion that masks ignored tokens, and the same four parallel modes —
+plain single-device, tensor parallel (mp), Megatron sequence parallel inside mp, and
+pipeline parallel via PipelineLayer descs.
+
+TPU-first design: the compute is pure functional jnp under the framework's ops layer, so
+a whole training step jits into ONE XLA program; parallelism comes from GSPMD sharding
+annotations carried by the fleet TP/SP layers rather than hand-placed collectives. Flash
+attention dispatches to the Pallas TPU kernel via F.scaled_dot_product_attention.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import ops
+from ..framework.core import Tensor
+from ..nn import functional as F
+from ..nn.initializer import Normal
+from ..nn.layer.common import Embedding, Linear
+from ..nn.layer.container import LayerList
+from ..nn.layer.layers import Layer
+from ..nn.layer.norm import RMSNorm
+
+
+class LlamaConfig:
+    """Plain config object (PaddleNLP LlamaConfig field names)."""
+
+    def __init__(
+        self,
+        vocab_size=32000,
+        hidden_size=4096,
+        intermediate_size=11008,
+        num_hidden_layers=32,
+        num_attention_heads=32,
+        num_key_value_heads=None,
+        max_position_embeddings=4096,
+        initializer_range=0.02,
+        rms_norm_eps=1e-6,
+        rope_theta=10000.0,
+        use_flash_attention=True,
+        tie_word_embeddings=False,
+        tensor_parallel_degree=1,
+        sequence_parallel=False,
+        pipeline_parallel_degree=1,
+        recompute=False,
+        recompute_granularity="full",
+        dtype="float32",
+        **kwargs,
+    ):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.intermediate_size = intermediate_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.num_key_value_heads = num_key_value_heads or num_attention_heads
+        self.max_position_embeddings = max_position_embeddings
+        self.initializer_range = initializer_range
+        self.rms_norm_eps = rms_norm_eps
+        self.rope_theta = rope_theta
+        self.use_flash_attention = use_flash_attention
+        self.tie_word_embeddings = tie_word_embeddings
+        self.tensor_parallel_degree = tensor_parallel_degree
+        self.sequence_parallel = sequence_parallel
+        self.pipeline_parallel_degree = pipeline_parallel_degree
+        self.recompute = recompute
+        self.recompute_granularity = recompute_granularity
+        self.dtype = dtype
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+def _tp(config):
+    return config.tensor_parallel_degree > 1
+
+
+def _mp_linears(config):
+    """(ColumnParallel, RowParallel) classes honoring the sequence_parallel switch."""
+    if config.sequence_parallel:
+        from ..distributed.fleet.utils.sequence_parallel_utils import (
+            ColumnSequenceParallelLinear, RowSequenceParallelLinear)
+
+        return ColumnSequenceParallelLinear, RowSequenceParallelLinear
+    from ..distributed.fleet.mpu.mp_layers import (
+        ColumnParallelLinear, RowParallelLinear)
+
+    return ColumnParallelLinear, RowParallelLinear
+
+
+def _rope_cos_sin(seq_len, head_dim, theta, dtype):
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)            # (S, D/2)
+    emb = jnp.concatenate([freqs, freqs], -1)  # (S, D)
+    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
+
+
+def _rotate_half(x):
+    half = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+
+
+def apply_rotary_pos_emb(q, k, cos, sin):
+    """q,k: (B, S, H, D); cos/sin: (S, D) broadcast over batch and heads."""
+    cos = cos[None, :, None, :]
+    sin = sin[None, :, None, :]
+    q2 = q * cos + _rotate_half(q) * sin
+    k2 = k * cos + _rotate_half(k) * sin
+    return q2, k2
+
+
+class LlamaRotaryEmbedding(Layer):
+    def __init__(self, head_dim, max_position_embeddings=4096, base=10000.0,
+                 dtype="float32"):
+        super().__init__()
+        self.head_dim = head_dim
+        self.max_position_embeddings = max_position_embeddings
+        self.base = base
+
+    def forward(self, x, seq_len):
+        cos, sin = _rope_cos_sin(seq_len, self.head_dim, self.base, x.dtype)
+        return cos, sin
+
+
+class LlamaAttention(Layer):
+    """Multi-head attention with rotary embeddings and grouped KV heads."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.num_heads = config.num_attention_heads
+        self.num_kv_heads = config.num_key_value_heads
+        self.head_dim = config.head_dim
+        h = config.hidden_size
+        kv = self.num_kv_heads * self.head_dim
+        init = Normal(std=config.initializer_range)
+        if _tp(config):
+            Col, Row = _mp_linears(config)
+            self.q_proj = Col(h, h, has_bias=False, gather_output=False, weight_attr=init)
+            self.k_proj = Col(h, kv, has_bias=False, gather_output=False, weight_attr=init)
+            self.v_proj = Col(h, kv, has_bias=False, gather_output=False, weight_attr=init)
+            self.o_proj = Row(h, h, has_bias=False, input_is_parallel=True,
+                              weight_attr=init)
+        else:
+            self.q_proj = Linear(h, h, weight_attr=init, bias_attr=False)
+            self.k_proj = Linear(h, kv, weight_attr=init, bias_attr=False)
+            self.v_proj = Linear(h, kv, weight_attr=init, bias_attr=False)
+            self.o_proj = Linear(h, h, weight_attr=init, bias_attr=False)
+        self.rotary_emb = LlamaRotaryEmbedding(
+            self.head_dim, config.max_position_embeddings, config.rope_theta)
+
+    def forward(self, hidden_states, attn_mask=None):
+        # sequence_parallel: Column fwd all-gathers the seq-sharded input, so q/k/v
+        # hold the full sequence here regardless of the SP switch.
+        q = self.q_proj(hidden_states)
+        k = self.k_proj(hidden_states)
+        v = self.v_proj(hidden_states)
+        B = q.shape[0] if not self.config.sequence_parallel else None
+        # under SP the layer input is (S/sp, B, H); Column output is (S, B, H)
+        if self.config.sequence_parallel:
+            S, B = q.shape[0], q.shape[1]
+            q = ops.transpose(q, [1, 0, 2])
+            k = ops.transpose(k, [1, 0, 2])
+            v = ops.transpose(v, [1, 0, 2])
+        else:
+            B, S = q.shape[0], q.shape[1]
+        q = ops.reshape(q, [B, S, self.num_heads, self.head_dim])
+        k = ops.reshape(k, [B, S, self.num_kv_heads, self.head_dim])
+        v = ops.reshape(v, [B, S, self.num_kv_heads, self.head_dim])
+
+        from ..incubate.nn.functional import fused_rotary_position_embedding
+
+        q, k, _ = fused_rotary_position_embedding(
+            q, k, rotary_theta=self.config.rope_theta)
+
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None,
+            training=self.training)
+        out = ops.reshape(out, [B, S, self.num_heads * self.head_dim])
+        if self.config.sequence_parallel:
+            out = ops.transpose(out, [1, 0, 2])  # back to (S, B, H) for Row
+        return self.o_proj(out)
+
+
+class LlamaMLP(Layer):
+    """SwiGLU feed-forward: down(silu(gate(x)) * up(x))."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        h, m = config.hidden_size, config.intermediate_size
+        init = Normal(std=config.initializer_range)
+        if _tp(config):
+            Col, Row = _mp_linears(config)
+            self.gate_proj = Col(h, m, has_bias=False, gather_output=False,
+                                 weight_attr=init)
+            self.up_proj = Col(h, m, has_bias=False, gather_output=False,
+                               weight_attr=init)
+            self.down_proj = Row(m, h, has_bias=False, input_is_parallel=True,
+                                 weight_attr=init)
+        else:
+            self.gate_proj = Linear(h, m, weight_attr=init, bias_attr=False)
+            self.up_proj = Linear(h, m, weight_attr=init, bias_attr=False)
+            self.down_proj = Linear(m, h, weight_attr=init, bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(F.swiglu(self.gate_proj(x), self.up_proj(x)))
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.self_attn = LlamaAttention(config)
+        self.mlp = LlamaMLP(config)
+        self.input_layernorm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        self.post_attention_layernorm = RMSNorm(
+            config.hidden_size, epsilon=config.rms_norm_eps)
+        self._recompute = config.recompute
+
+    def _block(self, hidden_states, attn_mask=None):
+        residual = hidden_states
+        h = self.input_layernorm(hidden_states)
+        h = self.self_attn(h, attn_mask)
+        h = residual + h
+        residual = h
+        h = self.post_attention_layernorm(h)
+        h = self.mlp(h)
+        return residual + h
+
+    def forward(self, hidden_states, attn_mask=None):
+        if self._recompute and self.training:
+            from ..distributed.fleet.recompute import recompute
+
+            return recompute(self._block, hidden_states, attn_mask)
+        return self._block(hidden_states, attn_mask)
+
+
+class LlamaModel(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        init = Normal(std=config.initializer_range)
+        if _tp(config):
+            from ..distributed.fleet.mpu.mp_layers import VocabParallelEmbedding
+
+            self.embed_tokens = VocabParallelEmbedding(
+                config.vocab_size, config.hidden_size, weight_attr=init)
+        else:
+            self.embed_tokens = Embedding(
+                config.vocab_size, config.hidden_size, weight_attr=init)
+        self.layers = LayerList(
+            [LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)])
+        self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+
+    def forward(self, input_ids, attn_mask=None):
+        h = self.embed_tokens(input_ids)
+        if self.config.sequence_parallel:
+            from ..distributed.fleet.utils.sequence_parallel_utils import scatter
+
+            h = ops.transpose(h, [1, 0, 2])  # (B,S,H) -> (S,B,H)
+            h = scatter(h)                   # shard seq over mp
+        for layer in self.layers:
+            h = layer(h, attn_mask)
+        h = self.norm(h)
+        if self.config.sequence_parallel:
+            from ..distributed.fleet.utils.sequence_parallel_utils import all_gather
+
+            h = all_gather(h)
+            h = ops.transpose(h, [1, 0, 2])  # back to (B,S,H)
+        return h
+
+
+class LlamaLMHead(Layer):
+    def __init__(self, config: LlamaConfig, embedding=None):
+        super().__init__()
+        self.config = config
+        self._tied = config.tie_word_embeddings and embedding is not None
+        if self._tied:
+            self._embedding = [embedding]  # list: not a registered sublayer
+        else:
+            init = Normal(std=config.initializer_range)
+            w = self.create_parameter(
+                shape=[config.hidden_size, config.vocab_size],
+                attr=None, default_initializer=init)
+            if _tp(config):
+                from ..distributed.fleet.mpu.mp_layers import _mp_context, _shard_param
+
+                mesh, axis_idx, _ = _mp_context()
+                w = _shard_param(w, mesh, axis_idx, 1)
+            self.weight = w
+
+    def forward(self, hidden_states):
+        if self._tied:
+            w = ops.transpose(self._embedding[0].weight, [1, 0])
+        else:
+            w = self.weight
+        logits = ops.matmul(hidden_states, w)
+        if _tp(self.config):
+            from ..distributed.fleet.mpu import mp_ops
+
+            logits = mp_ops.mark_sharded(logits, dim=-1)
+        return logits
+
+
+class LlamaPretrainingCriterion(Layer):
+    """Token-mean causal-LM loss with ignore_index masking (reference criterion shape)."""
+
+    def __init__(self, config: LlamaConfig, ignore_index=-100):
+        super().__init__()
+        self.config = config
+        self.ignore_index = ignore_index
+
+    def forward(self, logits, labels):
+        if _tp(self.config):
+            from ..distributed.fleet.mpu.mp_layers import ParallelCrossEntropy
+
+            tok_loss = ParallelCrossEntropy(ignore_index=self.ignore_index)(
+                logits, labels)
+        else:
+            tok_loss = F.softmax_with_cross_entropy(
+                logits, labels, ignore_index=self.ignore_index)
+        tok_loss = ops.squeeze(tok_loss, -1) if tok_loss.ndim > labels.ndim else tok_loss
+        mask = (labels != self.ignore_index).astype(tok_loss.dtype)
+        denom = ops.maximum(mask.sum(), ops.to_tensor(1.0, dtype=tok_loss.dtype))
+        return (tok_loss * mask).sum() / denom
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.llama = LlamaModel(config)
+        self.lm_head = LlamaLMHead(
+            config, embedding=self.llama.embed_tokens
+            if config.tie_word_embeddings else None)
+        self.criterion = LlamaPretrainingCriterion(config)
+
+    def forward(self, input_ids, labels=None, attn_mask=None):
+        h = self.llama(input_ids, attn_mask)
+        logits = self.lm_head(h)
+        if labels is not None:
+            return self.criterion(logits, labels), logits
+        return logits
+
+    def generate(self, input_ids, max_new_tokens=32, temperature=0.0):
+        """Greedy / temperature sampling, recomputing the prefix each step.
+
+        (A KV-cache decode path is the inference engine's job; this is the
+        correctness-oriented generate used by tests.)
+        """
+        out = input_ids
+        for _ in range(max_new_tokens):
+            logits = self.forward(out)
+            nxt = logits[:, -1, :]
+            if temperature and temperature > 0.0:
+                nxt = nxt / temperature
+                probs = F.softmax(nxt, axis=-1)
+                tok = ops.multinomial(probs, 1)
+            else:
+                tok = ops.argmax(nxt, axis=-1, keepdim=True)
+            out = ops.concat([out, tok.astype(out.dtype)], axis=1)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Pipeline-parallel variant (PipelineLayer descs), reference: llama_pp tests
+# ---------------------------------------------------------------------------
+class _EmbeddingPipe(Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.config = config
+        init = Normal(std=config.initializer_range)
+        if _tp(config):
+            from ..distributed.fleet.mpu.mp_layers import VocabParallelEmbedding
+
+            self.embed_tokens = VocabParallelEmbedding(
+                config.vocab_size, config.hidden_size, weight_attr=init)
+        else:
+            self.embed_tokens = Embedding(
+                config.vocab_size, config.hidden_size, weight_attr=init)
+
+    def forward(self, input_ids):
+        return self.embed_tokens(input_ids)
+
+
+class _NormPipe(Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+
+    def forward(self, h):
+        return self.norm(h)
+
+
+class _LMHeadPipe(LlamaLMHead):
+    pass
+
+
+def LlamaForCausalLMPipe(config: LlamaConfig, **pp_kwargs):
+    """Build the PipelineLayer form: embedding | decoder x N | norm | lm_head."""
+    from ..distributed.fleet.meta_parallel.pp_layers import LayerDesc, PipelineLayer
+
+    descs = [LayerDesc(_EmbeddingPipe, config)]
+    descs += [LayerDesc(LlamaDecoderLayer, config)
+              for _ in range(config.num_hidden_layers)]
+    descs += [LayerDesc(_NormPipe, config), LayerDesc(_LMHeadPipe, config)]
+    crit = LlamaPretrainingCriterion(config)
+    return PipelineLayer(
+        descs,
+        num_stages=config.pipeline_parallel_degree or None,
+        loss_fn=lambda out, label: crit(out, label),
+        seg_method="layer:LlamaDecoderLayer",
+        **pp_kwargs,
+    )

@@ -229,6 +229,13 @@ class Optimizer:
                     acc[k] = v.value if isinstance(v, Tensor) else jnp.asarray(v)
                     found = True
             if found:
+                # re-apply the ZeRO placement hook: loaded accumulators must come back
+                # sharded exactly as freshly-created ones are in step()
+                if self._shard_fn is not None:
+                    acc = {
+                        k: self._as_value(self._shard_fn(k, p, Tensor(v)))
+                        for k, v in acc.items()
+                    }
                 self._accumulators[id(p)] = acc
             mw = state.get("master_weights", {}).get(name)
             if mw is not None:

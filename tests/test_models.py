@@ -1,0 +1,200 @@
+"""Flagship model tests (LLaMA/GPT) incl. hybrid-parallel modes.
+
+Mirrors the reference's end-to-end parallelism validation
+(test/auto_parallel/hybrid_strategy/semi_auto_llama.py: the same llama run under
+dp/mp/pp combinations with loss checks) on the 8-device virtual CPU mesh.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet
+from paddle_tpu.models import (
+    GPTConfig, GPTForCausalLM, LlamaConfig, LlamaForCausalLM,
+)
+from paddle_tpu.models.llama import LlamaForCausalLMPipe
+
+
+def _tiny_cfg(**kw):
+    base = dict(vocab_size=128, hidden_size=64, intermediate_size=128,
+                num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+                max_position_embeddings=64)
+    base.update(kw)
+    return LlamaConfig(**base)
+
+
+def _data(batch=4, seq=16, vocab=128, seed=0):
+    r = np.random.RandomState(seed)
+    ids = paddle.to_tensor(r.randint(0, vocab, (batch, seq)))
+    labels = paddle.to_tensor(r.randint(0, vocab, (batch, seq)))
+    return ids, labels
+
+
+class TestLlama:
+    def test_forward_backward(self):
+        paddle.seed(0)
+        m = LlamaForCausalLM(_tiny_cfg())
+        ids, labels = _data()
+        loss, logits = m(ids, labels=labels)
+        assert logits.shape == [4, 16, 128]
+        assert np.isfinite(float(loss.numpy()))
+        loss.backward()
+        g = m.llama.layers[0].self_attn.q_proj.weight.grad
+        assert g is not None and np.isfinite(g.numpy()).all()
+
+    def test_loss_decreases(self):
+        paddle.seed(1)
+        m = LlamaForCausalLM(_tiny_cfg(num_hidden_layers=1))
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2, parameters=m.parameters())
+        ids, labels = _data(seed=3)
+        first = last = None
+        for _ in range(8):
+            loss, _ = m(ids, labels=labels)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            last = float(loss.numpy())
+            first = first if first is not None else last
+        assert last < first
+
+    def test_ignore_index_masking(self):
+        paddle.seed(0)
+        m = LlamaForCausalLM(_tiny_cfg(num_hidden_layers=1))
+        ids, labels = _data()
+        # all-ignored labels -> zero loss (masked mean with safe denominator)
+        ign = paddle.to_tensor(np.full((4, 16), -100))
+        loss, _ = m(ids, labels=ign)
+        assert float(loss.numpy()) == 0.0
+
+    def test_generate(self):
+        paddle.seed(0)
+        m = LlamaForCausalLM(_tiny_cfg(num_hidden_layers=1))
+        ids, _ = _data(batch=2, seq=4)
+        out = m.generate(ids, max_new_tokens=3)
+        assert out.shape == [2, 7]
+
+    def test_tied_embeddings(self):
+        paddle.seed(0)
+        m = LlamaForCausalLM(_tiny_cfg(num_hidden_layers=1, tie_word_embeddings=True))
+        names = [n for n, _ in m.named_parameters()]
+        assert not any("lm_head" in n for n in names)
+        ids, labels = _data()
+        loss, _ = m(ids, labels=labels)
+        loss.backward()
+        assert m.llama.embed_tokens.weight.grad is not None
+
+
+class TestLlamaParallel:
+    def test_tp_matches_single(self):
+        # same seed -> same init -> TP forward must match the plain forward
+        paddle.seed(42)
+        m_ref = LlamaForCausalLM(_tiny_cfg())
+        s = fleet.DistributedStrategy()
+        s.hybrid_configs = {"dp_degree": 1, "mp_degree": 2, "pp_degree": 1}
+        fleet.init(is_collective=True, strategy=s)
+        paddle.seed(42)
+        m_tp = LlamaForCausalLM(_tiny_cfg(tensor_parallel_degree=2))
+        ids, labels = _data()
+        l_ref, _ = m_ref(ids, labels=labels)
+        l_tp, _ = m_tp(ids, labels=labels)
+        np.testing.assert_allclose(l_ref.numpy(), l_tp.numpy(), rtol=2e-4, atol=2e-4)
+
+    def test_sequence_parallel(self):
+        s = fleet.DistributedStrategy()
+        s.hybrid_configs = {"dp_degree": 1, "mp_degree": 2, "pp_degree": 1}
+        fleet.init(is_collective=True, strategy=s)
+        paddle.seed(42)
+        m_sp = LlamaForCausalLM(
+            _tiny_cfg(tensor_parallel_degree=2, sequence_parallel=True))
+        paddle.seed(42)
+        m_tp = LlamaForCausalLM(_tiny_cfg(tensor_parallel_degree=2))
+        ids, labels = _data()
+        l_sp, _ = m_sp(ids, labels=labels)
+        l_tp, _ = m_tp(ids, labels=labels)
+        np.testing.assert_allclose(l_sp.numpy(), l_tp.numpy(), rtol=2e-4, atol=2e-4)
+        l_sp.backward()
+        assert m_sp.llama.layers[0].mlp.gate_proj.weight.grad is not None
+
+    def test_pipeline_train_batch(self):
+        s = fleet.DistributedStrategy()
+        s.hybrid_configs = {"dp_degree": 1, "mp_degree": 2, "pp_degree": 2}
+        s.pipeline_configs = {"accumulate_steps": 2, "micro_batch_size": 2}
+        fleet.init(is_collective=True, strategy=s)
+        paddle.seed(0)
+        cfg = _tiny_cfg(num_hidden_layers=4, tensor_parallel_degree=2,
+                        pipeline_parallel_degree=2)
+        model = fleet.distributed_model(LlamaForCausalLMPipe(cfg))
+        opt = fleet.distributed_optimizer(
+            paddle.optimizer.AdamW(learning_rate=1e-3,
+                                   parameters=model.parameters()))
+        ids, labels = _data()
+        losses = [float(model.train_batch([ids, labels], opt).numpy())
+                  for _ in range(3)]
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0]
+
+
+class TestGPT:
+    def test_forward_backward(self):
+        paddle.seed(0)
+        cfg = GPTConfig(vocab_size=128, hidden_size=64, num_hidden_layers=2,
+                        num_attention_heads=4, max_position_embeddings=64)
+        m = GPTForCausalLM(cfg)
+        ids, labels = _data()
+        loss, logits = m(ids, labels=labels)
+        assert logits.shape == [4, 16, 128]
+        loss.backward()
+        assert m.gpt.embeddings.word_embeddings.weight.grad is not None
+
+    def test_eval_deterministic(self):
+        paddle.seed(0)
+        cfg = GPTConfig(vocab_size=128, hidden_size=64, num_hidden_layers=1,
+                        num_attention_heads=4, max_position_embeddings=64,
+                        hidden_dropout_prob=0.5, attention_probs_dropout_prob=0.5)
+        m = GPTForCausalLM(cfg)
+        m.eval()
+        ids, _ = _data()
+        a = m(ids).numpy()
+        b = m(ids).numpy()
+        np.testing.assert_array_equal(a, b)
+
+
+class TestFusedOps:
+    def test_fused_rope_matches_manual(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.incubate.nn.functional import (
+            fused_rotary_position_embedding)
+
+        r = np.random.RandomState(0)
+        q = paddle.to_tensor(r.randn(2, 8, 4, 16).astype("float32"),
+                             stop_gradient=False)
+        k = paddle.to_tensor(r.randn(2, 8, 4, 16).astype("float32"))
+        q2, k2, v2 = fused_rotary_position_embedding(q, k)
+        assert q2.shape == q.shape and k2.shape == k.shape and v2 is None
+        # position 0 is identity rotation
+        np.testing.assert_allclose(q2.numpy()[:, 0], q.numpy()[:, 0], rtol=1e-5)
+        q2.sum().backward()
+        assert q.grad is not None
+
+    def test_fused_rms_norm(self):
+        from paddle_tpu.incubate.nn.functional import fused_rms_norm
+
+        r = np.random.RandomState(0)
+        x = paddle.to_tensor(r.randn(4, 8).astype("float32"))
+        w = paddle.to_tensor(np.ones(8, dtype="float32"))
+        y = fused_rms_norm(x, w)
+        ref = x.numpy() / np.sqrt((x.numpy() ** 2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(y.numpy(), ref, rtol=1e-5)
+
+    def test_fused_layer_norm_residual(self):
+        from paddle_tpu.incubate.nn.functional import fused_layer_norm
+
+        r = np.random.RandomState(0)
+        x = paddle.to_tensor(r.randn(4, 8).astype("float32"))
+        res = paddle.to_tensor(r.randn(4, 8).astype("float32"))
+        y = fused_layer_norm(x, residual=res)
+        s = x.numpy() + res.numpy()
+        ref = (s - s.mean(-1, keepdims=True)) / np.sqrt(
+            s.var(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(y.numpy(), ref, rtol=1e-4, atol=1e-5)
