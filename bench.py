@@ -46,12 +46,13 @@ def main():
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
 
-    # ~350M-param model in bf16 on TPU; tiny on CPU so the smoke run finishes fast
+    # ~350M-param model in bf16 on TPU (per-layer remat + Pallas flash attention keep
+    # activations O(S)); tiny on CPU so the smoke run finishes fast
     if on_tpu:
         cfg = LlamaConfig(
             vocab_size=32000, hidden_size=1024, intermediate_size=2816,
             num_hidden_layers=16, num_attention_heads=16, num_key_value_heads=16,
-            max_position_embeddings=2048, dtype="bfloat16")
+            max_position_embeddings=2048, dtype="bfloat16", recompute=True)
         batch, seq, iters = 8, 2048, 10
     else:
         cfg = LlamaConfig(
@@ -65,24 +66,32 @@ def main():
     if on_tpu:
         model.to(dtype="bfloat16")
     optimizer = paddle.optimizer.AdamW(
-        learning_rate=1e-4, parameters=model.parameters())
+        learning_rate=1e-4, parameters=model.parameters(),
+        multi_precision=on_tpu)
 
     params = [p for _, p in model.named_parameters()]
     for p in params:
         if id(p) not in optimizer._accumulators:
             optimizer._accumulators[id(p)] = optimizer._init_state(p)
+        if optimizer._use_master_weights and id(p) not in optimizer._master_weights:
+            optimizer._master_weights[id(p)] = p.value.astype(jnp.float32)
     acc_keys = [sorted(optimizer._accumulators[id(p)].keys()) for p in params]
+    use_masters = optimizer._use_master_weights
 
-    def train_step(param_values, acc_values, ids, labels):
+    def train_step(param_values, acc_values, master_values, ids, labels):
         with rng.trace_key(jax.random.PRNGKey(0)):
             saved_p = [(p, p._value) for p in params]
             saved_a = {id(p): dict(optimizer._accumulators[id(p)]) for p in params}
+            saved_m = dict(optimizer._master_weights)
             try:
                 for p, v in zip(params, param_values):
                     p._replace_value(v)
                 for p, ks, vs in zip(params, acc_keys, acc_values):
                     for k, v in zip(ks, vs):
                         optimizer._accumulators[id(p)][k] = v
+                if use_masters:
+                    for p, mv in zip(params, master_values):
+                        optimizer._master_weights[id(p)] = mv
                 loss, _ = model(Tensor(ids), labels=Tensor(labels))
                 loss.backward()
                 optimizer.step()
@@ -90,12 +99,15 @@ def main():
                 new_p = [p._value for p in params]
                 new_a = [[optimizer._accumulators[id(p)][k] for k in ks]
                          for p, ks in zip(params, acc_keys)]
-                return loss.value, new_p, new_a
+                new_m = ([optimizer._master_weights[id(p)] for p in params]
+                         if use_masters else master_values)
+                return loss.value, new_p, new_a, new_m
             finally:
                 for p, v in saved_p:
                     p._replace_value(v)
                 for p in params:
                     optimizer._accumulators[id(p)] = saved_a[id(p)]
+                optimizer._master_weights = saved_m
 
     r = np.random.RandomState(0)
     ids = jnp.asarray(r.randint(0, cfg.vocab_size, (batch, seq)), jnp.int32)
@@ -103,16 +115,18 @@ def main():
     pv = [p.value for p in params]
     av = [[optimizer._accumulators[id(p)][k] for k in ks]
           for p, ks in zip(params, acc_keys)]
+    mv = ([optimizer._master_weights[id(p)] for p in params]
+          if use_masters else [])
 
-    step = jax.jit(train_step, donate_argnums=(0, 1))
+    step = jax.jit(train_step, donate_argnums=(0, 1, 2))
 
     # warmup/compile
-    loss, pv, av = step(pv, av, ids, labels)
+    loss, pv, av, mv = step(pv, av, mv, ids, labels)
     jax.block_until_ready(loss)
 
     t0 = time.perf_counter()
     for _ in range(iters):
-        loss, pv, av = step(pv, av, ids, labels)
+        loss, pv, av, mv = step(pv, av, mv, ids, labels)
     jax.block_until_ready(loss)
     dt = (time.perf_counter() - t0) / iters
 

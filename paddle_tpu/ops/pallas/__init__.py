@@ -1,0 +1,4 @@
+"""Pallas TPU kernels: the hand-fused hot ops (reference: third_party/flashattn + the
+fused CUDA kernels under paddle/phi/kernels/fusion/). Written per the MXU/VMEM tiling
+rules in the TPU kernel playbook; every kernel has an interpret-mode path so CPU CI
+validates the same kernel code the TPU runs."""
