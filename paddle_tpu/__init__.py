@@ -46,6 +46,14 @@ from . import optimizer  # noqa: F401,E402
 from . import io  # noqa: F401,E402
 from . import jit  # noqa: F401,E402
 from . import ops  # noqa: F401,E402
+from . import incubate  # noqa: F401,E402
+from . import metric  # noqa: F401,E402
+
+# vision/hapi/models import lazily-heavy deps; exposed as regular submodules
+from . import vision  # noqa: F401,E402
+from . import hapi  # noqa: F401,E402
+from . import models  # noqa: F401,E402
+from .hapi import Model, summary  # noqa: F401,E402
 
 
 def seed(s):

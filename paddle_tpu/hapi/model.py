@@ -1,0 +1,259 @@
+"""paddle.Model: the Keras-like high-level trainer.
+
+Reference analog: python/paddle/hapi/model.py (Model.prepare/fit/evaluate/predict/
+save/load/summary; DynamicGraphAdapter.train_batch :759). TPU-first: one adapter —
+eager steps whose ops are cached XLA executables; `paddle.Model(net).prepare(...)` then
+`fit()` drives DataLoaders, callbacks and metrics exactly like the reference.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from .. import optimizer as _opt_mod
+from ..autograd import no_grad
+from ..framework.core import Tensor
+from ..framework_io import load as _load, save as _save
+from ..io.dataloader import DataLoader
+from ..metric import Metric
+from ..nn.layer.layers import Layer
+from .callbacks import config_callbacks
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+def _to_tensor(x):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(np.asarray(x))
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+
+    # -- setup ---------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        for m in _to_list(metrics):
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric {m} must be a paddle.metric.Metric")
+        self._metrics = _to_list(metrics)
+        return self
+
+    # -- single-batch APIs ----------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = [_to_tensor(x) for x in _to_list(inputs)]
+        labels = [_to_tensor(x) for x in _to_list(labels)]
+        outputs = self.network(*inputs)
+        outs = _to_list(outputs)
+        losses = _to_list(self._loss(*(outs + labels))) if self._loss else outs
+        total = losses[0]
+        for l in losses[1:]:  # noqa: E741
+            total = total + l
+        total.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = []
+        for m in self._metrics:
+            metrics.append(m.update(*_to_list(m.compute(*(outs + labels)))))
+        out_losses = [float(np.asarray(l.numpy()).reshape(-1)[0]) for l in losses]
+        return (out_losses, metrics) if metrics else out_losses
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        with no_grad():
+            inputs = [_to_tensor(x) for x in _to_list(inputs)]
+            labels = [_to_tensor(x) for x in _to_list(labels)]
+            outs = _to_list(self.network(*inputs))
+            losses = (_to_list(self._loss(*(outs + labels)))
+                      if self._loss else outs)
+            metrics = []
+            for m in self._metrics:
+                metrics.append(m.update(*_to_list(m.compute(*(outs + labels)))))
+            out_losses = [float(np.asarray(l.numpy()).reshape(-1)[0])
+                          for l in losses]
+        return (out_losses, metrics) if metrics else out_losses
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        with no_grad():
+            inputs = [_to_tensor(x) for x in _to_list(inputs)]
+            outs = _to_list(self.network(*inputs))
+            return [o.numpy() for o in outs]
+
+    # -- loops ----------------------------------------------------------------
+    @staticmethod
+    def _loader(data, batch_size, shuffle, num_workers, drop_last=False):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          num_workers=num_workers, drop_last=drop_last)
+
+    @staticmethod
+    def _split_batch(batch):
+        batch = list(batch) if isinstance(batch, (list, tuple)) else [batch]
+        if len(batch) == 1:
+            return batch, []
+        return batch[:-1], batch[-1:]
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        loader = self._loader(train_data, batch_size, shuffle, num_workers,
+                              drop_last=drop_last)
+        eval_loader = self._loader(eval_data, batch_size, False, num_workers)
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs, steps=steps,
+                                log_freq=log_freq, verbose=verbose,
+                                save_freq=save_freq, save_dir=save_dir,
+                                metrics=self._metrics_name())
+        self.stop_training = False
+        cbks.on_train_begin()
+        it_count = 0
+        for epoch in range(epochs):
+            for m in self._metrics:
+                m.reset()
+            cbks.on_epoch_begin(epoch)
+            logs = {}
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
+                ins, labels = self._split_batch(batch)
+                update = (step + 1) % accumulate_grad_batches == 0
+                res = self.train_batch(ins, labels, update=update)
+                logs = self._make_logs(res)
+                cbks.on_train_batch_end(step, logs)
+                it_count += 1
+                if num_iters is not None and it_count >= num_iters:
+                    self.stop_training = True
+                    break
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_loader, verbose=verbose, callbacks=cbks,
+                              _inner=True)
+            if self.stop_training:
+                break
+        cbks.on_train_end()
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None, _inner=False):
+        loader = self._loader(eval_data, batch_size, False, num_workers)
+        cbks = callbacks if _inner else config_callbacks(
+            callbacks, model=self, steps=None, log_freq=log_freq, verbose=verbose,
+            metrics=self._metrics_name(), mode="eval")
+        for m in self._metrics:
+            m.reset()
+        cbks.on_eval_begin()
+        logs = {}
+        seen = 0
+        for step, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step)
+            ins, labels = self._split_batch(batch)
+            res = self.eval_batch(ins, labels)
+            logs = self._make_logs(res, prefix="eval_" if not _inner else "")
+            cbks.on_eval_batch_end(step, logs)
+            seen += ins[0].shape[0] if hasattr(ins[0], "shape") else 1
+            if num_samples is not None and seen >= num_samples:
+                break
+        final = {}
+        for m in self._metrics:
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = m.accumulate()
+            vals = vals if isinstance(vals, list) else [vals]
+            final.update(dict(zip(names, vals)))
+        if "loss" in logs:
+            final["loss"] = logs["loss"]
+        cbks.on_eval_end(final)
+        return final
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = self._loader(test_data, batch_size, False, num_workers)
+        outputs = []
+        for batch in loader:
+            ins, _ = self._split_batch(batch)
+            outputs.append(self.predict_batch(ins))
+        if stack_outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs]) for i in range(n_out)]
+        return outputs
+
+    def _make_logs(self, res, prefix=""):
+        logs = {}
+        if isinstance(res, tuple):
+            losses, metrics = res
+        else:
+            losses, metrics = res, []
+        logs[prefix + "loss"] = losses[0] if len(losses) == 1 else losses
+        for m, v in zip(self._metrics, metrics):
+            name = m.name() if isinstance(m.name(), str) else m.name()[0]
+            logs[prefix + name] = np.asarray(m.accumulate()).reshape(-1)[0]
+        return logs
+
+    def _metrics_name(self):
+        names = ["loss"]
+        for m in self._metrics:
+            n = m.name()
+            names += n if isinstance(n, list) else [n]
+        return names
+
+    # -- persistence -----------------------------------------------------------
+    def save(self, path, training=True):
+        dirname = os.path.dirname(path)
+        if dirname:
+            os.makedirs(dirname, exist_ok=True)
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        state = _load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(_load(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        return summary(self.network, input_size, dtypes=dtype)
+
+
+def summary(net, input_size=None, dtypes=None, input=None):  # noqa: A002
+    """paddle.summary (hapi/model_summary.py): parameter table + totals."""
+    rows = []
+    total = 0
+    trainable = 0
+    for name, p in net.named_parameters():
+        n = int(np.prod(p.shape)) if len(p.shape) else 1
+        total += n
+        if not p.stop_gradient:
+            trainable += n
+        rows.append((name, list(p.shape), n))
+    width = max([len(r[0]) for r in rows], default=20) + 2
+    lines = [f"{'Layer (param)':<{width}}{'Shape':<20}{'Param #':<12}"]
+    lines += [f"{r[0]:<{width}}{str(r[1]):<20}{r[2]:<12,}" for r in rows]
+    lines.append(f"Total params: {total:,}")
+    lines.append(f"Trainable params: {trainable:,}")
+    lines.append(f"Non-trainable params: {total - trainable:,}")
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}

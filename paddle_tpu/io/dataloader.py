@@ -164,10 +164,15 @@ class DataLoader:
             except BaseException as e:  # noqa: BLE001
                 err.append(e)
             finally:
-                try:
-                    q.put_nowait(sentinel)
-                except queue.Full:
-                    pass
+                # the sentinel must be delivered or the consumer blocks forever on
+                # q.get(); block (stop-aware) rather than put_nowait — a full queue
+                # at end-of-epoch would otherwise silently drop it
+                while not stop.is_set():
+                    try:
+                        q.put(sentinel, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()

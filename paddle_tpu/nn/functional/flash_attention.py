@@ -65,11 +65,14 @@ def _use_pallas(q):
 def _sdpa(q, k, v, attn_mask=None, dropout_key=None, dropout_p=0.0, causal=False,
           scale=None, use_pallas=False):
     if use_pallas and attn_mask is None and dropout_p == 0.0:
-        try:
-            from ...ops.pallas.flash_attention import flash_attention_fwd
+        from ...ops.pallas.flash_attention import flash_attention_fwd
 
+        try:
             return flash_attention_fwd(q, k, v, causal=causal, scale=scale)
-        except Exception:
+        except ValueError:
+            # documented fallback contract: unsupported shapes -> math path.
+            # anything else (lowering/VMEM/compile errors) must surface, not
+            # silently degrade to O(S^2) attention
             pass
     return _math_sdpa(q, k, v, attn_mask, causal, dropout_key, dropout_p, scale)
 

@@ -46,7 +46,7 @@ def _interpret():
 # forward
 # ---------------------------------------------------------------------------
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
-                block_q, block_k, seq_k):
+                block_q, block_k, seq_k, causal_offset=0):
     qi = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32) * scale          # (Bq, D)
     d = q.shape[-1]
@@ -54,8 +54,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     num_kv = seq_k // block_k
     if causal:
         # only blocks at or before the diagonal contribute
-        hi = _cdiv_i32(jax.lax.mul(jax.lax.add(qi, _i32(1)), _i32(block_q)),
-                       block_k)
+        hi = _cdiv_i32(jax.lax.add(
+            jax.lax.mul(jax.lax.add(qi, _i32(1)), _i32(block_q)),
+            _i32(causal_offset)), block_k)
         hi = jnp.minimum(hi, _i32(num_kv))
     else:
         hi = num_kv
@@ -71,7 +72,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
                 jnp.int32, (block_q, block_k), 0)
             cols = jax.lax.mul(j, _i32(block_k)) + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, _NEG_INF)
+            s = jnp.where(rows + _i32(causal_offset) >= cols, s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
         alpha = jnp.exp(m - m_new)
@@ -98,7 +99,7 @@ def _fwd(q, k, v, scale, causal, block_q, block_k):
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, seq_k=Sk)
+        block_q=block_q, block_k=block_k, seq_k=Sk, causal_offset=Sk - Sq)
 
     out, lse = pl.pallas_call(
         kernel,
@@ -125,7 +126,7 @@ def _fwd(q, k, v, scale, causal, block_q, block_k):
 # backward
 # ---------------------------------------------------------------------------
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-                   scale, causal, block_q, block_k, seq_k):
+                   scale, causal, block_q, block_k, seq_k, causal_offset=0):
     qi = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32)
     do = do_ref[0, 0].astype(jnp.float32)
@@ -135,8 +136,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
 
     num_kv = seq_k // block_k
     if causal:
-        hi = _cdiv_i32(jax.lax.mul(jax.lax.add(qi, _i32(1)), _i32(block_q)),
-                       block_k)
+        hi = _cdiv_i32(jax.lax.add(
+            jax.lax.mul(jax.lax.add(qi, _i32(1)), _i32(block_q)),
+            _i32(causal_offset)), block_k)
         hi = jnp.minimum(hi, _i32(num_kv))
     else:
         hi = num_kv
@@ -151,7 +153,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
                 jnp.int32, (block_q, block_k), 0)
             cols = jax.lax.mul(j, _i32(block_k)) + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, _NEG_INF)
+            s = jnp.where(rows + _i32(causal_offset) >= cols, s, _NEG_INF)
         p = jnp.exp(s - lse[:, None])                     # (Bq, Bk)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -165,7 +167,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, scale, causal, block_q, block_k, seq_q):
+                    dk_ref, dv_ref, *, scale, causal, block_q, block_k, seq_q,
+                    causal_offset=0):
     ki = pl.program_id(2)
     k = k_ref[0, 0].astype(jnp.float32)                   # (Bk, D)
     v = v_ref[0, 0].astype(jnp.float32)
@@ -173,7 +176,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     num_q = seq_q // block_q
     if causal:
-        lo = jax.lax.div(jax.lax.mul(ki, _i32(block_k)), _i32(block_q))
+        lo = jax.lax.div(
+            jnp.maximum(jax.lax.sub(jax.lax.mul(ki, _i32(block_k)),
+                                    _i32(causal_offset)), _i32(0)),
+            _i32(block_q))
     else:
         lo = 0
 
@@ -190,7 +196,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (block_q, block_k), 0)
             cols = jax.lax.mul(ki, _i32(block_k)) + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, _NEG_INF)
+            s = jnp.where(rows + _i32(causal_offset) >= cols, s, _NEG_INF)
         p = jnp.exp(s - lse[:, None])                     # (Bq, Bk)
         dv_new = dv + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
@@ -220,7 +226,8 @@ def _bwd(scale, causal, block_q, block_k, res, g):
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, seq_k=Sk),
+                          block_q=block_q, block_k=block_k, seq_k=Sk,
+                          causal_offset=Sk - Sq),
         grid=(B, Hq, Sq // block_q),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
@@ -238,7 +245,8 @@ def _bwd(scale, causal, block_q, block_k, res, g):
     # dk/dv computed per q-head, then group-summed over the GQA repeat factor
     dk_rep, dv_rep = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, seq_q=Sq),
+                          block_q=block_q, block_k=block_k, seq_q=Sq,
+                          causal_offset=Sk - Sq),
         grid=(B, Hq, Sk // block_k),
         in_specs=[
             pl.BlockSpec((1, 1, Sq, D), lambda b, h, i: (b, h, 0, 0)),

@@ -1,0 +1,24 @@
+"""paddle.vision equivalent: model zoo, transforms, datasets, detection ops.
+
+Reference analog: python/paddle/vision/ (models/{lenet,alexnet,vgg,resnet,mobilenet*,
+densenet,googlenet,inceptionv3,shufflenetv2,squeezenet}.py, transforms/, datasets/,
+ops.py).
+"""
+from . import datasets  # noqa: F401
+from . import models  # noqa: F401
+from . import ops  # noqa: F401
+from . import transforms  # noqa: F401
+from .models import *  # noqa: F401,F403
+
+def set_image_backend(backend):
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(f"unsupported image backend {backend}")
+    global _IMAGE_BACKEND
+    _IMAGE_BACKEND = backend
+
+
+def get_image_backend():
+    return _IMAGE_BACKEND
+
+
+_IMAGE_BACKEND = "pil"

@@ -1,0 +1,135 @@
+"""paddle.vision.datasets equivalent.
+
+Reference analog: python/paddle/vision/datasets/{mnist,cifar,flowers,voc2012}.py.
+This environment has no network egress, so `download=True` raises with a clear message;
+the parsers read the standard file formats from `data_file`/`image_path` the same way
+the reference does once files exist locally. FakeData provides a synthetic stand-in for
+tests and benchmarks.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ...io.dataset import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData"]
+
+
+def _no_download(cls, path_arg):
+    raise RuntimeError(
+        f"{cls} auto-download is unavailable (no network); pass {path_arg} "
+        "pointing at a locally available copy of the standard archive")
+
+
+class MNIST(Dataset):
+    """IDX-format MNIST reader (python/paddle/vision/datasets/mnist.py)."""
+
+    NAME = "mnist"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        self.mode = mode.lower()
+        self.transform = transform
+        self.backend = backend or "numpy"
+        if image_path is None or label_path is None:
+            _no_download(type(self).__name__, "image_path/label_path")
+        self.images = self._parse_images(image_path)
+        self.labels = self._parse_labels(label_path)
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
+
+    def _parse_images(self, path):
+        with self._open(path) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            data = np.frombuffer(f.read(n * rows * cols), dtype=np.uint8)
+        return data.reshape(n, rows, cols)
+
+    def _parse_labels(self, path):
+        with self._open(path) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            return np.frombuffer(f.read(n), dtype=np.uint8).astype("int64")
+
+    def __getitem__(self, idx):
+        img, label = self.images[idx], self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    NAME = "fashion-mnist"
+
+
+class Cifar10(Dataset):
+    """CIFAR tar.gz pickle reader (python/paddle/vision/datasets/cifar.py)."""
+
+    _mode_meta = {"train": "data_batch", "test": "test_batch"}
+
+    def __init__(self, data_file=None, mode="train", transform=None, download=True,
+                 backend=None):
+        self.mode = mode.lower()
+        self.transform = transform
+        if data_file is None:
+            _no_download(type(self).__name__, "data_file")
+        self.data = self._load(data_file)
+
+    def _load(self, path):
+        marker = self._mode_meta[self.mode]
+        out = []
+        with tarfile.open(path, "r:*") as tf:
+            for member in tf.getmembers():
+                if marker in member.name:
+                    batch = pickle.load(tf.extractfile(member), encoding="bytes")
+                    images = batch[b"data"]
+                    labels = batch.get(b"labels", batch.get(b"fine_labels"))
+                    for im, lb in zip(images, labels):
+                        out.append((im.reshape(3, 32, 32).transpose(1, 2, 0),
+                                    int(lb)))
+        return out
+
+    def __getitem__(self, idx):
+        img, label = self.data[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(label)
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Cifar100(Cifar10):
+    _mode_meta = {"train": "train", "test": "test"}
+
+
+class FakeData(Dataset):
+    """Synthetic dataset for tests/benchmarks (deterministic per index)."""
+
+    def __init__(self, size=1000, image_shape=(3, 224, 224), num_classes=1000,
+                 transform=None, dtype="float32"):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.dtype = dtype
+
+    def __getitem__(self, idx):
+        r = np.random.RandomState(idx)
+        img = r.randn(*self.image_shape).astype(self.dtype)
+        label = np.int64(r.randint(0, self.num_classes))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return self.size

@@ -1,0 +1,485 @@
+"""Remaining zoo families: SqueezeNet, ShuffleNetV2, DenseNet, GoogLeNet, InceptionV3.
+
+Reference analog: python/paddle/vision/models/{squeezenet,shufflenetv2,densenet,
+googlenet,inceptionv3}.py.
+"""
+from __future__ import annotations
+
+from ... import nn, ops
+
+
+# ---------------------------------------------------------------------------
+# SqueezeNet
+# ---------------------------------------------------------------------------
+class _Fire(nn.Layer):
+    def __init__(self, in_c, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = nn.Conv2D(in_c, squeeze, 1)
+        self.expand1 = nn.Conv2D(squeeze, e1, 1)
+        self.expand3 = nn.Conv2D(squeeze, e3, 3, padding=1)
+
+    def forward(self, x):
+        x = nn.functional.relu(self.squeeze(x))
+        return ops.concat([
+            nn.functional.relu(self.expand1(x)),
+            nn.functional.relu(self.expand3(x)),
+        ], axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if version == "1.0":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 96, 7, stride=2), nn.ReLU(), nn.MaxPool2D(3, 2),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128), nn.MaxPool2D(3, 2),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                nn.MaxPool2D(3, 2), _Fire(512, 64, 256, 256),
+            )
+        else:
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(), nn.MaxPool2D(3, 2),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64), nn.MaxPool2D(3, 2),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                nn.MaxPool2D(3, 2), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                _Fire(512, 64, 256, 256),
+            )
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1), nn.ReLU(),
+                nn.AdaptiveAvgPool2D(1))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.num_classes > 0:
+            x = self.classifier(x)
+            x = x.flatten(1)
+        return x
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights are not bundled")
+    return SqueezeNet("1.0", **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights are not bundled")
+    return SqueezeNet("1.1", **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# ShuffleNetV2
+# ---------------------------------------------------------------------------
+def _channel_shuffle(x, groups):
+    return nn.functional.channel_shuffle(x, groups)
+
+
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, in_c, out_c, stride):
+        super().__init__()
+        self.stride = stride
+        branch_c = out_c // 2
+        if stride == 1:
+            self.branch2 = nn.Sequential(
+                nn.Conv2D(branch_c, branch_c, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_c), nn.ReLU(),
+                nn.Conv2D(branch_c, branch_c, 3, stride=1, padding=1,
+                          groups=branch_c, bias_attr=False),
+                nn.BatchNorm2D(branch_c),
+                nn.Conv2D(branch_c, branch_c, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_c), nn.ReLU(),
+            )
+        else:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(in_c, in_c, 3, stride=stride, padding=1, groups=in_c,
+                          bias_attr=False),
+                nn.BatchNorm2D(in_c),
+                nn.Conv2D(in_c, branch_c, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_c), nn.ReLU(),
+            )
+            self.branch2 = nn.Sequential(
+                nn.Conv2D(in_c, branch_c, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_c), nn.ReLU(),
+                nn.Conv2D(branch_c, branch_c, 3, stride=stride, padding=1,
+                          groups=branch_c, bias_attr=False),
+                nn.BatchNorm2D(branch_c),
+                nn.Conv2D(branch_c, branch_c, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_c), nn.ReLU(),
+            )
+
+    def forward(self, x):
+        if self.stride == 1:
+            x1, x2 = ops.split(x, 2, axis=1)
+            out = ops.concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = ops.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        stage_repeats = [4, 8, 4]
+        channels = {
+            0.25: [24, 24, 48, 96, 512], 0.33: [24, 32, 64, 128, 512],
+            0.5: [24, 48, 96, 192, 1024], 1.0: [24, 116, 232, 464, 1024],
+            1.5: [24, 176, 352, 704, 1024], 2.0: [24, 244, 488, 976, 2048],
+        }[scale]
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, channels[0], 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(channels[0]), nn.ReLU())
+        self.max_pool = nn.MaxPool2D(3, 2, padding=1)
+        stages = []
+        in_c = channels[0]
+        for i, reps in enumerate(stage_repeats):
+            out_c = channels[i + 1]
+            units = [_ShuffleUnit(in_c, out_c, 2)]
+            units += [_ShuffleUnit(out_c, out_c, 1) for _ in range(reps - 1)]
+            stages.append(nn.Sequential(*units))
+            in_c = out_c
+        self.stages = nn.Sequential(*stages)
+        self.conv_last = nn.Sequential(
+            nn.Conv2D(in_c, channels[-1], 1, bias_attr=False),
+            nn.BatchNorm2D(channels[-1]), nn.ReLU())
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(channels[-1], num_classes)
+
+    def forward(self, x):
+        x = self.max_pool(self.conv1(x))
+        x = self.stages(x)
+        x = self.conv_last(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.fc(x)
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights are not bundled")
+    return ShuffleNetV2(scale=0.25, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights are not bundled")
+    return ShuffleNetV2(scale=0.5, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights are not bundled")
+    return ShuffleNetV2(scale=1.0, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights are not bundled")
+    return ShuffleNetV2(scale=1.5, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights are not bundled")
+    return ShuffleNetV2(scale=2.0, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# DenseNet
+# ---------------------------------------------------------------------------
+class _DenseLayer(nn.Layer):
+    def __init__(self, in_c, growth_rate, bn_size, dropout):
+        super().__init__()
+        self.norm1 = nn.BatchNorm2D(in_c)
+        self.conv1 = nn.Conv2D(in_c, bn_size * growth_rate, 1, bias_attr=False)
+        self.norm2 = nn.BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = nn.Conv2D(bn_size * growth_rate, growth_rate, 3, padding=1,
+                               bias_attr=False)
+        self.dropout = nn.Dropout(dropout)
+
+    def forward(self, x):
+        out = self.conv1(nn.functional.relu(self.norm1(x)))
+        out = self.conv2(nn.functional.relu(self.norm2(out)))
+        out = self.dropout(out)
+        return ops.concat([x, out], axis=1)
+
+
+class _Transition(nn.Sequential):
+    def __init__(self, in_c, out_c):
+        super().__init__(
+            nn.BatchNorm2D(in_c), nn.ReLU(),
+            nn.Conv2D(in_c, out_c, 1, bias_attr=False),
+            nn.AvgPool2D(2, 2))
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        cfg = {
+            121: (32, [6, 12, 24, 16]), 161: (48, [6, 12, 36, 24]),
+            169: (32, [6, 12, 32, 32]), 201: (32, [6, 12, 48, 32]),
+            264: (32, [6, 12, 64, 48]),
+        }
+        growth_rate, block_config = cfg[layers]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        num_init = 2 * growth_rate
+        feats = [
+            nn.Conv2D(3, num_init, 7, stride=2, padding=3, bias_attr=False),
+            nn.BatchNorm2D(num_init), nn.ReLU(), nn.MaxPool2D(3, 2, padding=1),
+        ]
+        ch = num_init
+        for i, n in enumerate(block_config):
+            for _ in range(n):
+                feats.append(_DenseLayer(ch, growth_rate, bn_size, dropout))
+                ch += growth_rate
+            if i != len(block_config) - 1:
+                feats.append(_Transition(ch, ch // 2))
+                ch //= 2
+        feats += [nn.BatchNorm2D(ch), nn.ReLU()]
+        self.features = nn.Sequential(*feats)
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.classifier(x)
+        return x
+
+
+def densenet121(pretrained=False, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights are not bundled")
+    return DenseNet(121, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights are not bundled")
+    return DenseNet(161, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights are not bundled")
+    return DenseNet(169, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights are not bundled")
+    return DenseNet(201, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights are not bundled")
+    return DenseNet(264, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# GoogLeNet (Inception v1)
+# ---------------------------------------------------------------------------
+class _Inception(nn.Layer):
+    def __init__(self, in_c, c1, c3r, c3, c5r, c5, pool_proj):
+        super().__init__()
+        self.b1 = nn.Sequential(nn.Conv2D(in_c, c1, 1), nn.ReLU())
+        self.b2 = nn.Sequential(nn.Conv2D(in_c, c3r, 1), nn.ReLU(),
+                                nn.Conv2D(c3r, c3, 3, padding=1), nn.ReLU())
+        self.b3 = nn.Sequential(nn.Conv2D(in_c, c5r, 1), nn.ReLU(),
+                                nn.Conv2D(c5r, c5, 5, padding=2), nn.ReLU())
+        self.b4 = nn.Sequential(nn.MaxPool2D(3, 1, padding=1),
+                                nn.Conv2D(in_c, pool_proj, 1), nn.ReLU())
+
+    def forward(self, x):
+        return ops.concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)], axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, 64, 7, stride=2, padding=3), nn.ReLU(),
+            nn.MaxPool2D(3, 2, padding=1),
+            nn.Conv2D(64, 64, 1), nn.ReLU(),
+            nn.Conv2D(64, 192, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(3, 2, padding=1))
+        self.inc3 = nn.Sequential(
+            _Inception(192, 64, 96, 128, 16, 32, 32),
+            _Inception(256, 128, 128, 192, 32, 96, 64),
+            nn.MaxPool2D(3, 2, padding=1))
+        self.inc4 = nn.Sequential(
+            _Inception(480, 192, 96, 208, 16, 48, 64),
+            _Inception(512, 160, 112, 224, 24, 64, 64),
+            _Inception(512, 128, 128, 256, 24, 64, 64),
+            _Inception(512, 112, 144, 288, 32, 64, 64),
+            _Inception(528, 256, 160, 320, 32, 128, 128),
+            nn.MaxPool2D(3, 2, padding=1))
+        self.inc5 = nn.Sequential(
+            _Inception(832, 256, 160, 320, 32, 128, 128),
+            _Inception(832, 384, 192, 384, 48, 128, 128))
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.2)
+            self.fc = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.inc5(self.inc4(self.inc3(self.stem(x))))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.fc(self.dropout(x))
+        return x
+
+
+def googlenet(pretrained=False, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights are not bundled")
+    return GoogLeNet(**kwargs)
+
+
+# ---------------------------------------------------------------------------
+# InceptionV3 (compact faithful topology)
+# ---------------------------------------------------------------------------
+class _BNConv(nn.Sequential):
+    def __init__(self, in_c, out_c, kernel, **kw):
+        super().__init__(nn.Conv2D(in_c, out_c, kernel, bias_attr=False, **kw),
+                         nn.BatchNorm2D(out_c), nn.ReLU())
+
+
+class _IncA(nn.Layer):
+    def __init__(self, in_c, pool_c):
+        super().__init__()
+        self.b1 = _BNConv(in_c, 64, 1)
+        self.b5 = nn.Sequential(_BNConv(in_c, 48, 1), _BNConv(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(_BNConv(in_c, 64, 1), _BNConv(64, 96, 3, padding=1),
+                                _BNConv(96, 96, 3, padding=1))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, 1, padding=1), _BNConv(in_c, pool_c, 1))
+
+    def forward(self, x):
+        return ops.concat([self.b1(x), self.b5(x), self.b3(x), self.bp(x)], axis=1)
+
+
+class _IncRedA(nn.Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = _BNConv(in_c, 384, 3, stride=2)
+        self.b3d = nn.Sequential(_BNConv(in_c, 64, 1), _BNConv(64, 96, 3, padding=1),
+                                 _BNConv(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, 2)
+
+    def forward(self, x):
+        return ops.concat([self.b3(x), self.b3d(x), self.pool(x)], axis=1)
+
+
+class _IncB(nn.Layer):
+    def __init__(self, in_c, c7):
+        super().__init__()
+        self.b1 = _BNConv(in_c, 192, 1)
+        self.b7 = nn.Sequential(
+            _BNConv(in_c, c7, 1), _BNConv(c7, c7, (1, 7), padding=(0, 3)),
+            _BNConv(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7d = nn.Sequential(
+            _BNConv(in_c, c7, 1), _BNConv(c7, c7, (7, 1), padding=(3, 0)),
+            _BNConv(c7, c7, (1, 7), padding=(0, 3)),
+            _BNConv(c7, c7, (7, 1), padding=(3, 0)),
+            _BNConv(c7, 192, (1, 7), padding=(0, 3)))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, 1, padding=1), _BNConv(in_c, 192, 1))
+
+    def forward(self, x):
+        return ops.concat([self.b1(x), self.b7(x), self.b7d(x), self.bp(x)], axis=1)
+
+
+class _IncRedB(nn.Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = nn.Sequential(_BNConv(in_c, 192, 1), _BNConv(192, 320, 3, stride=2))
+        self.b7 = nn.Sequential(
+            _BNConv(in_c, 192, 1), _BNConv(192, 192, (1, 7), padding=(0, 3)),
+            _BNConv(192, 192, (7, 1), padding=(3, 0)), _BNConv(192, 192, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, 2)
+
+    def forward(self, x):
+        return ops.concat([self.b3(x), self.b7(x), self.pool(x)], axis=1)
+
+
+class _IncC(nn.Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b1 = _BNConv(in_c, 320, 1)
+        self.b3_stem = _BNConv(in_c, 384, 1)
+        self.b3_a = _BNConv(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _BNConv(384, 384, (3, 1), padding=(1, 0))
+        self.bd_stem = nn.Sequential(_BNConv(in_c, 448, 1),
+                                     _BNConv(448, 384, 3, padding=1))
+        self.bd_a = _BNConv(384, 384, (1, 3), padding=(0, 1))
+        self.bd_b = _BNConv(384, 384, (3, 1), padding=(1, 0))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, 1, padding=1), _BNConv(in_c, 192, 1))
+
+    def forward(self, x):
+        s = self.b3_stem(x)
+        d = self.bd_stem(x)
+        return ops.concat([
+            self.b1(x), self.b3_a(s), self.b3_b(s), self.bd_a(d), self.bd_b(d),
+            self.bp(x)], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _BNConv(3, 32, 3, stride=2), _BNConv(32, 32, 3),
+            _BNConv(32, 64, 3, padding=1), nn.MaxPool2D(3, 2),
+            _BNConv(64, 80, 1), _BNConv(80, 192, 3), nn.MaxPool2D(3, 2))
+        self.blocks = nn.Sequential(
+            _IncA(192, 32), _IncA(256, 64), _IncA(288, 64),
+            _IncRedA(288),
+            _IncB(768, 128), _IncB(768, 160), _IncB(768, 160), _IncB(768, 192),
+            _IncRedB(768),
+            _IncC(1280), _IncC(2048))
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.5)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.fc(self.dropout(x))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights are not bundled")
+    return InceptionV3(**kwargs)

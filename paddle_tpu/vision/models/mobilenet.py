@@ -1,0 +1,245 @@
+"""MobileNet V1/V2/V3 (python/paddle/vision/models/mobilenet{v1,v2,v3}.py)."""
+from __future__ import annotations
+
+from ... import nn
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    min_value = min_value or divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class _ConvBNRelu(nn.Sequential):
+    def __init__(self, in_c, out_c, kernel=3, stride=1, groups=1, act=nn.ReLU):
+        pad = (kernel - 1) // 2
+        layers = [
+            nn.Conv2D(in_c, out_c, kernel, stride=stride, padding=pad, groups=groups,
+                      bias_attr=False),
+            nn.BatchNorm2D(out_c),
+        ]
+        if act is not None:
+            layers.append(act())
+        super().__init__(*layers)
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return int(ch * scale)
+
+        def dw_sep(in_c, out_c, stride=1):
+            return nn.Sequential(
+                _ConvBNRelu(in_c, in_c, 3, stride, groups=in_c),
+                _ConvBNRelu(in_c, out_c, 1),
+            )
+
+        self.features = nn.Sequential(
+            _ConvBNRelu(3, c(32), 3, 2),
+            dw_sep(c(32), c(64)),
+            dw_sep(c(64), c(128), 2),
+            dw_sep(c(128), c(128)),
+            dw_sep(c(128), c(256), 2),
+            dw_sep(c(256), c(256)),
+            dw_sep(c(256), c(512), 2),
+            *[dw_sep(c(512), c(512)) for _ in range(5)],
+            dw_sep(c(512), c(1024), 2),
+            dw_sep(c(1024), c(1024)),
+        )
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(int(1024 * scale), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.fc(x)
+        return x
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, in_c, out_c, stride, expand_ratio):
+        super().__init__()
+        hidden = int(round(in_c * expand_ratio))
+        self.use_res = stride == 1 and in_c == out_c
+        layers = []
+        if expand_ratio != 1:
+            layers.append(_ConvBNRelu(in_c, hidden, 1, act=nn.ReLU6))
+        layers += [
+            _ConvBNRelu(hidden, hidden, 3, stride, groups=hidden, act=nn.ReLU6),
+            nn.Conv2D(hidden, out_c, 1, bias_attr=False),
+            nn.BatchNorm2D(out_c),
+        ]
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        cfg = [
+            # t, c, n, s
+            (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+            (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1),
+        ]
+        in_c = _make_divisible(32 * scale)
+        last_c = _make_divisible(1280 * max(1.0, scale))
+        feats = [_ConvBNRelu(3, in_c, 3, 2, act=nn.ReLU6)]
+        for t, ch, n, s in cfg:
+            out_c = _make_divisible(ch * scale)
+            for i in range(n):
+                feats.append(InvertedResidual(in_c, out_c, s if i == 0 else 1, t))
+                in_c = out_c
+        feats.append(_ConvBNRelu(in_c, last_c, 1, act=nn.ReLU6))
+        self.features = nn.Sequential(*feats)
+        if with_pool:
+            self.pool2d_avg = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Dropout(0.2), nn.Linear(last_c, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool2d_avg(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.classifier(x)
+        return x
+
+
+class _SqueezeExcite(nn.Layer):
+    def __init__(self, ch, squeeze_factor=4):
+        super().__init__()
+        sq = _make_divisible(ch // squeeze_factor)
+        self.avg_pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(ch, sq, 1)
+        self.fc2 = nn.Conv2D(sq, ch, 1)
+
+    def forward(self, x):
+        s = self.avg_pool(x)
+        s = nn.functional.relu(self.fc1(s))
+        s = nn.functional.hardsigmoid(self.fc2(s))
+        return x * s
+
+
+class _MNV3Block(nn.Layer):
+    def __init__(self, in_c, exp, out_c, kernel, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and in_c == out_c
+        layers = []
+        if exp != in_c:
+            layers.append(_ConvBNRelu(in_c, exp, 1, act=act))
+        layers.append(_ConvBNRelu(exp, exp, kernel, stride, groups=exp, act=act))
+        if use_se:
+            layers.append(_SqueezeExcite(exp))
+        layers += [nn.Conv2D(exp, out_c, 1, bias_attr=False), nn.BatchNorm2D(out_c)]
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+_V3_LARGE = [
+    # k, exp, c, se, act, s
+    (3, 16, 16, False, nn.ReLU, 1), (3, 64, 24, False, nn.ReLU, 2),
+    (3, 72, 24, False, nn.ReLU, 1), (5, 72, 40, True, nn.ReLU, 2),
+    (5, 120, 40, True, nn.ReLU, 1), (5, 120, 40, True, nn.ReLU, 1),
+    (3, 240, 80, False, nn.Hardswish, 2), (3, 200, 80, False, nn.Hardswish, 1),
+    (3, 184, 80, False, nn.Hardswish, 1), (3, 184, 80, False, nn.Hardswish, 1),
+    (3, 480, 112, True, nn.Hardswish, 1), (3, 672, 112, True, nn.Hardswish, 1),
+    (5, 672, 160, True, nn.Hardswish, 2), (5, 960, 160, True, nn.Hardswish, 1),
+    (5, 960, 160, True, nn.Hardswish, 1),
+]
+
+_V3_SMALL = [
+    (3, 16, 16, True, nn.ReLU, 2), (3, 72, 24, False, nn.ReLU, 2),
+    (3, 88, 24, False, nn.ReLU, 1), (5, 96, 40, True, nn.Hardswish, 2),
+    (5, 240, 40, True, nn.Hardswish, 1), (5, 240, 40, True, nn.Hardswish, 1),
+    (5, 120, 48, True, nn.Hardswish, 1), (5, 144, 48, True, nn.Hardswish, 1),
+    (5, 288, 96, True, nn.Hardswish, 2), (5, 576, 96, True, nn.Hardswish, 1),
+    (5, 576, 96, True, nn.Hardswish, 1),
+]
+
+
+class MobileNetV3(nn.Layer):
+    def __init__(self, cfg, last_c, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        in_c = _make_divisible(16 * scale)
+        feats = [_ConvBNRelu(3, in_c, 3, 2, act=nn.Hardswish)]
+        for k, exp, ch, se, act, s in cfg:
+            out_c = _make_divisible(ch * scale)
+            feats.append(_MNV3Block(in_c, _make_divisible(exp * scale), out_c, k, s,
+                                    se, act))
+            in_c = out_c
+        last_conv = _make_divisible(6 * in_c)
+        feats.append(_ConvBNRelu(in_c, last_conv, 1, act=nn.Hardswish))
+        self.features = nn.Sequential(*feats)
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(last_conv, last_c), nn.Hardswish(), nn.Dropout(0.2),
+                nn.Linear(last_c, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.classifier(x)
+        return x
+
+
+class MobileNetV3Large(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_V3_LARGE, 1280, scale, num_classes, with_pool)
+
+
+class MobileNetV3Small(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_V3_SMALL, 1024, scale, num_classes, with_pool)
+
+
+def _no_pretrained(pretrained):
+    if pretrained:
+        raise RuntimeError("pretrained weights are not bundled")
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    _no_pretrained(pretrained)
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    _no_pretrained(pretrained)
+    return MobileNetV2(scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    _no_pretrained(pretrained)
+    return MobileNetV3Large(scale=scale, **kwargs)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    _no_pretrained(pretrained)
+    return MobileNetV3Small(scale=scale, **kwargs)

@@ -1,0 +1,212 @@
+"""Detection ops (python/paddle/vision/ops.py: nms, roi_align, roi_pool,
+deform_conv2d, box utilities). TPU-first: static-shape jnp implementations (nms uses a
+fixed-iteration suppression loop so it jits; reference kernels are CUDA)."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..ops._apply import defop
+
+
+@defop("vision.nms", differentiable=False)
+def _nms(boxes, scores=None, iou_threshold=0.3):
+    n = boxes.shape[0]
+    if scores is None:
+        order = jnp.arange(n)
+    else:
+        order = jnp.argsort(-scores)
+    b = boxes[order]
+    x1, y1, x2, y2 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+    areas = (x2 - x1) * (y2 - y1)
+    xx1 = jnp.maximum(x1[:, None], x1[None, :])
+    yy1 = jnp.maximum(y1[:, None], y1[None, :])
+    xx2 = jnp.minimum(x2[:, None], x2[None, :])
+    yy2 = jnp.minimum(y2[:, None], y2[None, :])
+    inter = jnp.clip(xx2 - xx1, 0) * jnp.clip(yy2 - yy1, 0)
+    iou = inter / (areas[:, None] + areas[None, :] - inter + 1e-10)
+
+    suppressed = jnp.zeros(n, bool)
+
+    def body(i, sup):
+        # suppress j>i overlapping an unsuppressed i
+        kill = (~sup[i]) & (iou[i] > iou_threshold) & (jnp.arange(n) > i)
+        return sup | kill
+
+    suppressed = jax.lax.fori_loop(0, n, body, suppressed)
+    keep = order[~suppressed]
+    return keep
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None, categories=None,
+        top_k=None, name=None):
+    """paddle.vision.ops.nms (host-returning index list; data-dependent size)."""
+    bv = boxes.value if isinstance(boxes, Tensor) else jnp.asarray(boxes)
+    sv = scores.value if isinstance(scores, Tensor) else (
+        None if scores is None else jnp.asarray(scores))
+    if category_idxs is not None:
+        cat = (category_idxs.value if isinstance(category_idxs, Tensor)
+               else jnp.asarray(category_idxs))
+        # per-category suppression via coordinate offset trick
+        offset = cat.astype(bv.dtype)[:, None] * (bv.max() + 1.0)
+        bv = bv + offset
+    keep = np.asarray(_nms(Tensor(bv), None if sv is None else Tensor(sv),
+                           iou_threshold=float(iou_threshold)).value)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(jnp.asarray(keep))
+
+
+@defop("vision.roi_align")
+def _roi_align(x, boxes, boxes_num=None, output_size=(1, 1), spatial_scale=1.0,
+               sampling_ratio=-1, aligned=True):
+    # x: (N, C, H, W); boxes: (R, 4) in image coords; boxes assigned per batch by
+    # boxes_num prefix counts
+    N, C, H, W = x.shape
+    R = boxes.shape[0]
+    oh, ow = output_size
+    if boxes_num is None:
+        batch_idx = jnp.zeros(R, jnp.int32)
+    else:
+        batch_idx = jnp.repeat(jnp.arange(N), boxes_num, total_repeat_length=R)
+
+    offset = 0.5 if aligned else 0.0
+    bx = boxes * spatial_scale
+    x1, y1, x2, y2 = bx[:, 0] - offset, bx[:, 1] - offset, bx[:, 2] - offset, \
+        bx[:, 3] - offset
+    rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-5)
+    rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-5)
+    sr = sampling_ratio if sampling_ratio > 0 else 2
+
+    # sample points: (R, oh*sr, ow*sr)
+    gy = (jnp.arange(oh * sr) + 0.5) / sr
+    gx = (jnp.arange(ow * sr) + 0.5) / sr
+    ys = y1[:, None] + rh[:, None] * gy[None, :] / oh          # (R, oh*sr)
+    xs = x1[:, None] + rw[:, None] * gx[None, :] / ow          # (R, ow*sr)
+
+    def bilinear(feat, yy, xx):
+        y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, H - 1)
+        y1_ = jnp.clip(y0 + 1, 0, H - 1)
+        x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, W - 1)
+        x1_ = jnp.clip(x0 + 1, 0, W - 1)
+        wy = jnp.clip(yy - y0, 0, 1)
+        wx = jnp.clip(xx - x0, 0, 1)
+        # feat: (C, H, W); result (C, len(yy), len(xx))
+        f00 = feat[:, y0][:, :, x0]
+        f01 = feat[:, y0][:, :, x1_]
+        f10 = feat[:, y1_][:, :, x0]
+        f11 = feat[:, y1_][:, :, x1_]
+        return (f00 * (1 - wy)[None, :, None] * (1 - wx)[None, None, :]
+                + f01 * (1 - wy)[None, :, None] * wx[None, None, :]
+                + f10 * wy[None, :, None] * (1 - wx)[None, None, :]
+                + f11 * wy[None, :, None] * wx[None, None, :])
+
+    def per_roi(r):
+        feat = x[batch_idx[r]]
+        samples = bilinear(feat, ys[r], xs[r])                # (C, oh*sr, ow*sr)
+        return samples.reshape(C, oh, sr, ow, sr).mean(axis=(2, 4))
+
+    return jax.vmap(per_roi)(jnp.arange(R))
+
+
+def roi_align(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    return _roi_align(x, boxes, boxes_num, output_size=tuple(output_size),
+                      spatial_scale=float(spatial_scale),
+                      sampling_ratio=int(sampling_ratio), aligned=bool(aligned))
+
+
+def roi_pool(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0, name=None):
+    # max-pool variant approximated with dense sampling + max
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    return _roi_align(x, boxes, boxes_num, output_size=tuple(output_size),
+                      spatial_scale=float(spatial_scale), sampling_ratio=2,
+                      aligned=False)
+
+
+@defop("vision.deform_conv2d")
+def _deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0, dilation=1,
+                   deformable_groups=1, groups=1, mask=None):
+    # Reference: deformable conv v1/v2 (vision/ops.py deform_conv2d). Implemented by
+    # gathering deformed sampling locations per kernel tap then a 1x1 contraction.
+    N, Cin, H, W = x.shape
+    Cout, _, kh, kw = weight.shape
+    sh = sw = stride if isinstance(stride, int) else stride[0]
+    ph = pw = padding if isinstance(padding, int) else padding[0]
+    dh = dw = dilation if isinstance(dilation, int) else dilation[0]
+    Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    Hp, Wp = H + 2 * ph, W + 2 * pw
+
+    base_y = jnp.arange(Ho) * sh
+    base_x = jnp.arange(Wo) * sw
+    out = jnp.zeros((N, Cout, Ho, Wo), jnp.float32)
+
+    cols = []
+    for iy in range(kh):
+        for ix in range(kw):
+            tap = iy * kw + ix
+            oy = offset[:, 2 * tap, :, :]
+            ox = offset[:, 2 * tap + 1, :, :]
+            yy = base_y[None, :, None] + iy * dh + oy
+            xx = base_x[None, None, :] + ix * dw + ox
+            y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, Hp - 1)
+            y1 = jnp.clip(y0 + 1, 0, Hp - 1)
+            x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, Wp - 1)
+            x1 = jnp.clip(x0 + 1, 0, Wp - 1)
+            wy = jnp.clip(yy - y0, 0, 1)[:, None]
+            wx = jnp.clip(xx - x0, 0, 1)[:, None]
+
+            def gather(yi, xi):
+                flat = xp.reshape(N, Cin, Hp * Wp)
+                idx = yi[:, None] * Wp + xi[:, None]          # (N,1,Ho,Wo)
+                idx = jnp.broadcast_to(idx, (N, Cin, Ho, Wo)).reshape(N, Cin, -1)
+                return jnp.take_along_axis(flat, idx, axis=2).reshape(
+                    N, Cin, Ho, Wo)
+
+            val = (gather(y0, x0) * (1 - wy) * (1 - wx)
+                   + gather(y0, x1) * (1 - wy) * wx
+                   + gather(y1, x0) * wy * (1 - wx)
+                   + gather(y1, x1) * wy * wx)
+            if mask is not None:
+                val = val * mask[:, tap, None, :, :]
+            cols.append(val)
+
+    col = jnp.stack(cols, axis=2)                             # (N, Cin, kh*kw, Ho, Wo)
+    w = weight.reshape(Cout, Cin * kh * kw)
+    col = col.reshape(N, Cin * kh * kw, Ho * Wo)
+    out = jnp.einsum("oc,ncp->nop", w, col).reshape(N, Cout, Ho, Wo)
+    if bias is not None:
+        out = out + bias[None, :, None, None]
+    return out.astype(x.dtype)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0, dilation=1,
+                  deformable_groups=1, groups=1, mask=None, name=None):
+    if groups != 1 or deformable_groups != 1:
+        raise NotImplementedError(
+            "deform_conv2d currently supports groups=1 and deformable_groups=1")
+    return _deform_conv2d(x, offset, weight, bias, stride=stride, padding=padding,
+                          dilation=dilation, deformable_groups=deformable_groups,
+                          groups=groups, mask=mask)
+
+
+def box_iou(boxes1, boxes2):
+    b1 = boxes1.value if isinstance(boxes1, Tensor) else jnp.asarray(boxes1)
+    b2 = boxes2.value if isinstance(boxes2, Tensor) else jnp.asarray(boxes2)
+    a1 = (b1[:, 2] - b1[:, 0]) * (b1[:, 3] - b1[:, 1])
+    a2 = (b2[:, 2] - b2[:, 0]) * (b2[:, 3] - b2[:, 1])
+    xx1 = jnp.maximum(b1[:, None, 0], b2[None, :, 0])
+    yy1 = jnp.maximum(b1[:, None, 1], b2[None, :, 1])
+    xx2 = jnp.minimum(b1[:, None, 2], b2[None, :, 2])
+    yy2 = jnp.minimum(b1[:, None, 3], b2[None, :, 3])
+    inter = jnp.clip(xx2 - xx1, 0) * jnp.clip(yy2 - yy1, 0)
+    return Tensor(inter / (a1[:, None] + a2[None, :] - inter + 1e-10))

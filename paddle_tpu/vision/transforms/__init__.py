@@ -1,0 +1,238 @@
+"""paddle.vision.transforms equivalent (numpy-array backend).
+
+Reference analog: python/paddle/vision/transforms/{transforms,functional}.py. Images are
+HWC uint8/float numpy arrays (or CHW Tensors after ToTensor); transforms compose on the
+host in the DataLoader workers, exactly where the reference runs them.
+"""
+from __future__ import annotations
+
+import numbers
+import random
+
+import numpy as np
+
+from ...framework.core import Tensor
+from . import functional  # noqa: F401
+from .functional import (  # noqa: F401
+    adjust_brightness, adjust_contrast, adjust_hue, center_crop, crop, hflip,
+    normalize, pad, resize, rotate, to_grayscale, to_tensor, vflip,
+)
+
+
+class BaseTransform:
+    """transforms.BaseTransform: keys-aware callable."""
+
+    def __init__(self, keys=None):
+        self.keys = keys or ("image",)
+
+    def _apply_image(self, image):
+        raise NotImplementedError
+
+    def __call__(self, inputs):
+        if isinstance(inputs, tuple):
+            return tuple([self._apply_image(inputs[0])] + list(inputs[1:]))
+        return self._apply_image(inputs)
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        super().__init__(keys)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False, keys=None):
+        super().__init__(keys)
+        if isinstance(mean, numbers.Number):
+            mean = [mean, mean, mean]
+        if isinstance(std, numbers.Number):
+            std = [std, std, std]
+        self.mean = mean
+        self.std = std
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def _apply_image(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+        self.pad_if_needed = pad_if_needed
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        if self.padding is not None:
+            img = pad(img, self.padding, self.fill, self.padding_mode)
+        h, w = img.shape[0], img.shape[1]
+        th, tw = self.size
+        if self.pad_if_needed and (h < th or w < tw):
+            img = pad(img, [0, 0, max(0, tw - w), max(0, th - h)], self.fill,
+                      self.padding_mode)
+            h, w = img.shape[0], img.shape[1]
+        i = random.randint(0, h - th)
+        j = random.randint(0, w - tw)
+        return crop(img, i, j, th, tw)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return hflip(img) if random.random() < self.prob else img
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return vflip(img) if random.random() < self.prob else img
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4, 4.0 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        h, w = img.shape[0], img.shape[1]
+        area = h * w
+        for _ in range(10):
+            target_area = random.uniform(*self.scale) * area
+            aspect = np.exp(random.uniform(np.log(self.ratio[0]),
+                                           np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target_area * aspect)))
+            ch = int(round(np.sqrt(target_area / aspect)))
+            if 0 < cw <= w and 0 < ch <= h:
+                i = random.randint(0, h - ch)
+                j = random.randint(0, w - cw)
+                return resize(crop(img, i, j, ch, cw), self.size,
+                              self.interpolation)
+        return resize(center_crop(img, (min(h, w), min(h, w))), self.size,
+                      self.interpolation)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False, center=None,
+                 fill=0, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+
+    def _apply_image(self, img):
+        angle = random.uniform(*self.degrees)
+        return rotate(img, angle)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = order
+
+    def _apply_image(self, img):
+        if isinstance(img, Tensor):
+            return img.transpose(list(self.order))
+        return np.transpose(np.asarray(img), self.order)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        factor = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_brightness(img, factor)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        factor = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_contrast(img, factor)
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0, keys=None):
+        super().__init__(keys)
+        self.brightness = BrightnessTransform(brightness)
+        self.contrast = ContrastTransform(contrast)
+
+    def _apply_image(self, img):
+        ts = [self.brightness, self.contrast]
+        random.shuffle(ts)
+        for t in ts:
+            img = t._apply_image(img)
+        return img
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)

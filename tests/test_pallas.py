@@ -92,3 +92,29 @@ class TestFlashAttentionKernel:
         np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=2e-5, atol=2e-5)
         out.sum().backward()
         assert q.grad is not None and np.isfinite(q.grad.numpy()).all()
+
+
+class TestCrossLengthCausal:
+    def test_decode_style_bottom_right_alignment(self):
+        # Sq < Sk causal must align bottom-right like the math path (_math_sdpa)
+        r = np.random.RandomState(3)
+        q = jnp.asarray(r.randn(1, 128, 2, 64), jnp.float32)
+        k = jnp.asarray(r.randn(1, 256, 2, 64), jnp.float32)
+        v = jnp.asarray(r.randn(1, 256, 2, 64), jnp.float32)
+        out = flash_attention_fwd(q, k, v, causal=True)
+        qt, kt, vt = [jnp.swapaxes(x, 1, 2) for x in (q, k, v)]
+        s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) / np.sqrt(64)
+        m = jnp.tril(jnp.ones((128, 256), bool), k=128)
+        s = jnp.where(m, s, -1e30)
+        ref = jnp.swapaxes(
+            jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), vt), 1, 2)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+
+class TestTruncNormTail:
+    def test_far_tail_window_terminates(self):
+        from paddle_tpu.nn.initializer import TruncatedNormal
+
+        arr = np.asarray(TruncatedNormal(a=6.0, b=7.0)((8, 8)))
+        assert ((arr >= 6.0) & (arr <= 7.0)).all()
