@@ -1,0 +1,6 @@
+from .profiler import (  # noqa: F401
+    Profiler, ProfilerState, ProfilerTarget, RecordEvent, SummaryView,
+    export_chrome_tracing, export_protobuf, load_profiler_result, make_scheduler,
+)
+from .timer import benchmark  # noqa: F401
+from .profiler_statistic import SortedKeys  # noqa: F401
