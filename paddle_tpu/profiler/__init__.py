@@ -1,6 +1,18 @@
+"""paddle_tpu.profiler — host-span profiler + XLA device-trace bridge.
+
+Parity surface: /root/reference/python/paddle/profiler/__init__.py.
+"""
 from .profiler import (  # noqa: F401
-    Profiler, ProfilerState, ProfilerTarget, RecordEvent, SummaryView,
-    export_chrome_tracing, export_protobuf, load_profiler_result, make_scheduler,
+    Profiler, ProfilerResult, ProfilerState, ProfilerTarget, RecordEvent,
+    SummaryView, TracerEventType, export_chrome_tracing, export_protobuf,
+    get_profiler, load_profiler_result, make_scheduler,
 )
-from .timer import benchmark  # noqa: F401
 from .profiler_statistic import SortedKeys  # noqa: F401
+from .timer import Benchmark, benchmark  # noqa: F401
+
+__all__ = [
+    "Profiler", "ProfilerResult", "ProfilerState", "ProfilerTarget",
+    "RecordEvent", "TracerEventType", "SummaryView", "SortedKeys",
+    "export_chrome_tracing", "export_protobuf", "get_profiler",
+    "load_profiler_result", "make_scheduler", "benchmark", "Benchmark",
+]

@@ -1,0 +1,523 @@
+"""Profiler: state machine + scheduler + chrome-trace export, TPU-native.
+
+Parity target: the reference profiler surface
+(/root/reference/python/paddle/profiler/profiler.py:358 Profiler, :129 make_scheduler,
+:227 export_chrome_tracing, :280 export_protobuf). The reference drives a C++ tracer
+(CPU + CUPTI); on TPU the device-side story is XLA's own profiler, so this
+implementation records host-side spans natively (RecordEvent, perf_counter_ns) and —
+when ProfilerTarget.TPU is requested and real TPU/GPU devices exist — brackets the
+RECORD window with ``jax.profiler.start_trace``/``stop_trace`` so XLA emits a full
+device trace (viewable in TensorBoard/XProf) alongside our chrome trace.
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from enum import Enum
+from typing import Any, Callable, Iterable, Sequence
+
+
+class SummaryView(Enum):
+    """Which summary table to print (reference profiler.py:55)."""
+
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+class ProfilerState(Enum):
+    """Profiler state machine states (reference profiler.py:89).
+
+    CLOSED -> no collection; READY -> warmup (tracing overhead primed, data
+    discarded); RECORD -> collecting; RECORD_AND_RETURN -> last collecting step of a
+    cycle, hands the finished profile to ``on_trace_ready``.
+    """
+
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(Enum):
+    """What to profile (reference profiler.py:110). GPU/CUSTOM_DEVICE are accepted
+    for API compatibility; on this build they alias the XLA device trace."""
+
+    CPU = 0
+    GPU = 1
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+class TracerEventType(Enum):
+    """Host-event categories, mirroring the reference's TracerEventType."""
+
+    Operator = 0
+    Dataloader = 1
+    ProfileStep = 2
+    Forward = 3
+    Backward = 4
+    Optimization = 5
+    Communication = 6
+    PythonOp = 7
+    PythonUserDefined = 8
+    UserDefined = 9
+
+
+class HostEvent:
+    """One completed host-side span."""
+
+    __slots__ = ("name", "event_type", "start_ns", "end_ns", "tid", "step")
+
+    def __init__(self, name, event_type, start_ns, end_ns, tid, step):
+        self.name = name
+        self.event_type = event_type
+        self.start_ns = start_ns
+        self.end_ns = end_ns
+        self.tid = tid
+        self.step = step
+
+    @property
+    def duration_ns(self):
+        return self.end_ns - self.start_ns
+
+
+class _Collector:
+    """Process-wide host-event sink. RecordEvent spans land here while a Profiler
+    is in a RECORD state."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.events: list[HostEvent] = []
+        self.enabled = False
+        self.current_step = 0
+
+    def emit(self, name, event_type, start_ns, end_ns):
+        if not self.enabled:
+            return
+        ev = HostEvent(name, event_type, start_ns, end_ns,
+                       threading.get_ident(), self.current_step)
+        with self._lock:
+            self.events.append(ev)
+
+    def drain(self):
+        with self._lock:
+            out, self.events = self.events, []
+        return out
+
+
+_collector = _Collector()
+
+
+class RecordEvent:
+    """User-defined span; context manager / decorator (reference utils.py:47).
+
+    Only records while a Profiler is in a RECORD state. Usable as::
+
+        with RecordEvent("my_span"):
+            ...
+    or explicitly via begin()/end().
+    """
+
+    def __init__(self, name: str,
+                 event_type: TracerEventType = TracerEventType.PythonUserDefined):
+        self.name = name
+        self.event_type = event_type
+        self._start_ns = None
+
+    def begin(self):
+        self._start_ns = time.perf_counter_ns()
+
+    def end(self):
+        if self._start_ns is None:
+            return
+        _collector.emit(self.name, self.event_type, self._start_ns,
+                        time.perf_counter_ns())
+        self._start_ns = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with RecordEvent(self.name, self.event_type):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """Cyclic profiling schedule (reference profiler.py:129).
+
+    Each cycle is ``closed`` CLOSED steps, ``ready`` READY steps, then ``record``
+    RECORD steps (last one RECORD_AND_RETURN). ``repeat=0`` cycles forever;
+    ``skip_first`` initial steps are CLOSED and not part of any cycle.
+    """
+    if closed < 0 or ready < 0 or record <= 0 or repeat < 0 or skip_first < 0:
+        raise ValueError(
+            "make_scheduler requires closed>=0, ready>=0, record>0, "
+            f"repeat>=0, skip_first>=0; got closed={closed}, ready={ready}, "
+            f"record={record}, repeat={repeat}, skip_first={skip_first}")
+    period = closed + ready + record
+
+    def schedule(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        step -= skip_first
+        if repeat > 0 and step >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = step % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return schedule
+
+
+def _default_state_scheduler(step: int) -> ProfilerState:
+    """Always-on (reference profiler.py:220)."""
+    return ProfilerState.RECORD
+
+
+def export_chrome_tracing(dir_name: str,
+                          worker_name: str | None = None) -> Callable:
+    """on_trace_ready handler writing chrome://tracing JSON
+    (reference profiler.py:227)."""
+    os.makedirs(dir_name, exist_ok=True)
+
+    def handle_fn(prof: "Profiler"):
+        nonlocal worker_name
+        if not worker_name:
+            worker_name = f"host_{socket.gethostname()}_pid_{os.getpid()}"
+        filename = f"{worker_name}_time_{int(time.time())}.paddle_trace.json"
+        prof.export(os.path.join(dir_name, filename), format="json")
+
+    return handle_fn
+
+
+def export_protobuf(dir_name: str, worker_name: str | None = None) -> Callable:
+    """on_trace_ready handler (reference profiler.py:280). This build has no
+    protobuf trace format; emits the same JSON with a .pb.json suffix."""
+    os.makedirs(dir_name, exist_ok=True)
+
+    def handle_fn(prof: "Profiler"):
+        nonlocal worker_name
+        if not worker_name:
+            worker_name = f"host_{socket.gethostname()}_pid_{os.getpid()}"
+        filename = f"{worker_name}_time_{int(time.time())}.paddle_trace.pb.json"
+        prof.export(os.path.join(dir_name, filename), format="json")
+
+    return handle_fn
+
+
+def _get_supported_targets() -> Iterable[ProfilerTarget]:
+    targets = [ProfilerTarget.CPU]
+    try:
+        import jax
+
+        if any(d.platform in ("tpu", "gpu") for d in jax.devices()):
+            targets += [ProfilerTarget.TPU, ProfilerTarget.GPU]
+    except Exception:
+        pass
+    return targets
+
+
+class ProfilerResult:
+    """Finished profile data handed to on_trace_ready (host events + step range)."""
+
+    def __init__(self, events: list[HostEvent], steps: tuple[int, int],
+                 xla_trace_dir: str | None):
+        self.events = events
+        self.steps = steps
+        self.xla_trace_dir = xla_trace_dir
+
+    def save(self, path: str):
+        _write_chrome_trace(self.events, path, self.xla_trace_dir)
+
+
+def _write_chrome_trace(events, path, xla_trace_dir=None):
+    pid = os.getpid()
+    trace_events: list[dict[str, Any]] = [{
+        "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+        "args": {"name": f"paddle_tpu host (pid {pid})"},
+    }]
+    for ev in events:
+        trace_events.append({
+            "name": ev.name,
+            "cat": ev.event_type.name,
+            "ph": "X",
+            "ts": ev.start_ns / 1e3,  # chrome trace wants microseconds
+            "dur": ev.duration_ns / 1e3,
+            "pid": pid,
+            "tid": ev.tid % 10**6,
+            "args": {"step": ev.step},
+        })
+    doc = {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+    if xla_trace_dir:
+        doc["otherData"] = {"xla_trace_dir": xla_trace_dir}
+    with open(path, "w") as f:
+        json.dump(doc, f)
+
+
+def load_profiler_result(filename: str) -> ProfilerResult:
+    """Re-load a chrome trace exported by this profiler (reference parity)."""
+    with open(filename) as f:
+        doc = json.load(f)
+    events = []
+    for te in doc.get("traceEvents", []):
+        if te.get("ph") != "X":
+            continue
+        start_ns = int(te["ts"] * 1e3)
+        events.append(HostEvent(
+            te["name"], TracerEventType[te.get("cat", "UserDefined")],
+            start_ns, start_ns + int(te["dur"] * 1e3), te.get("tid", 0),
+            te.get("args", {}).get("step", 0)))
+    xla_dir = doc.get("otherData", {}).get("xla_trace_dir")
+    return ProfilerResult(events, (0, 0), xla_dir)
+
+
+class Profiler:
+    """Performance profiler (reference profiler.py:358).
+
+    Typical use::
+
+        with profiler.Profiler(
+                targets=[profiler.ProfilerTarget.CPU, profiler.ProfilerTarget.TPU],
+                scheduler=(2, 5),
+                on_trace_ready=profiler.export_chrome_tracing("./log")) as p:
+            for batch in loader:
+                train_step(batch)
+                p.step()
+        p.summary()
+
+    ``scheduler`` may be None (always RECORD), a (start, end) batch-range tuple, or
+    a callable step->ProfilerState (see make_scheduler).
+    """
+
+    def __init__(self, *,
+                 targets: Sequence[ProfilerTarget] | None = None,
+                 scheduler: Callable[[int], ProfilerState] | tuple | None = None,
+                 on_trace_ready: Callable | None = None,
+                 record_shapes: bool = False,
+                 profile_memory: bool = False,
+                 timer_only: bool = False,
+                 emit_nvtx: bool = False,
+                 custom_device_types: list[str] | None = None,
+                 with_flops: bool = False):
+        supported = list(_get_supported_targets())
+        if targets:
+            self.targets = [t for t in targets if t in supported]
+        else:
+            self.targets = supported
+        if scheduler is None:
+            self._scheduler = _default_state_scheduler
+        elif isinstance(scheduler, (tuple, list)):
+            start, end = scheduler
+            if start < 0 or end <= start:
+                raise ValueError(f"invalid scheduler range ({start}, {end})")
+            self._scheduler = make_scheduler(
+                closed=max(start - 1, 0), ready=min(start, 1),
+                record=end - start, repeat=1)
+        elif callable(scheduler):
+            self._scheduler = scheduler
+        else:
+            raise TypeError(f"invalid scheduler: {scheduler!r}")
+        self.on_trace_ready = on_trace_ready
+        self.record_shapes = record_shapes
+        self.profile_memory = profile_memory
+        self.timer_only = timer_only
+        self.with_flops = with_flops
+        self.current_state = ProfilerState.CLOSED
+        self.step_num = 0
+        self._record_start_step = 0
+        self._profile_step_span: RecordEvent | None = None
+        self._xla_tracing = False
+        self._xla_trace_dir: str | None = None
+        self._last_result: ProfilerResult | None = None
+        self._timer = None
+
+    # -- lifecycle -----------------------------------------------------------
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def start(self):
+        """Enter the schedule's state for step 0 and begin collection
+        (reference profiler.py:592)."""
+        from .timer import benchmark
+
+        self._timer = benchmark()
+        self._timer.begin()
+        if self.timer_only:
+            return
+        self.current_state = self._scheduler(self.step_num)
+        if self.current_state in (ProfilerState.RECORD,
+                                  ProfilerState.RECORD_AND_RETURN):
+            self._start_record()
+        self._open_step_span()
+
+    def stop(self):
+        """Flush collection; fire on_trace_ready if we were recording
+        (reference profiler.py:641)."""
+        if self._timer is not None:
+            self._timer.end()
+        if self.timer_only:
+            return
+        self._close_step_span()
+        if self.current_state in (ProfilerState.RECORD,
+                                  ProfilerState.RECORD_AND_RETURN):
+            self._finish_record()
+            if self.on_trace_ready and self._last_result is not None:
+                self.on_trace_ready(self)
+        self.current_state = ProfilerState.CLOSED
+
+    def step(self, num_samples: int | None = None):
+        """Advance one step; drive the state machine (reference profiler.py:691)."""
+        if self._timer is not None:
+            self._timer.after_step(num_samples)
+        if self.timer_only:
+            self.step_num += 1
+            return
+        self._close_step_span()
+        _collector.current_step = self.step_num + 1
+        next_state = self._scheduler(self.step_num + 1)
+        self._trigger_action(self.current_state, next_state)
+        self.step_num += 1
+        self.current_state = next_state
+        self._open_step_span()
+
+    def step_info(self, unit: str | None = None) -> str:
+        """Mean step/reader timing since the last call (reference profiler.py:735)."""
+        if self._timer is None:
+            return ""
+        return self._timer.step_info(unit)
+
+    # -- state transitions ---------------------------------------------------
+    def _trigger_action(self, cur: ProfilerState, nxt: ProfilerState):
+        recording = (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        if cur not in recording and nxt in recording:
+            self._start_record()
+        if cur is ProfilerState.RECORD_AND_RETURN:
+            self._finish_record()
+            if self.on_trace_ready and self._last_result is not None:
+                self.on_trace_ready(self)
+            if nxt in recording:  # back-to-back cycles
+                self._start_record()
+        elif cur in recording and nxt not in recording:
+            # schedule left the record window without RECORD_AND_RETURN; keep the
+            # data but don't hand it off (matches reference semantics of partial
+            # windows being flushed on stop()).
+            self._finish_record()
+
+    def _start_record(self):
+        _collector.enabled = True
+        _collector.current_step = self.step_num
+        self._record_start_step = self.step_num
+        if (ProfilerTarget.TPU in self.targets
+                or ProfilerTarget.GPU in self.targets):
+            try:
+                import jax
+
+                if any(d.platform in ("tpu", "gpu") for d in jax.devices()):
+                    self._xla_trace_dir = os.path.join(
+                        os.environ.get("PADDLE_TPU_TRACE_DIR", "/tmp"),
+                        f"paddle_tpu_xla_trace_{os.getpid()}_{self.step_num}")
+                    jax.profiler.start_trace(self._xla_trace_dir)
+                    self._xla_tracing = True
+            except Exception:
+                self._xla_tracing = False
+
+    def _finish_record(self):
+        if self._xla_tracing:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._xla_tracing = False
+        _collector.enabled = False
+        events = _collector.drain()
+        self._last_result = ProfilerResult(
+            events, (self._record_start_step, self.step_num),
+            self._xla_trace_dir)
+
+    def _open_step_span(self):
+        if self.current_state in (ProfilerState.RECORD,
+                                  ProfilerState.RECORD_AND_RETURN):
+            self._profile_step_span = RecordEvent(
+                f"ProfileStep#{self.step_num}", TracerEventType.ProfileStep)
+            self._profile_step_span.begin()
+
+    def _close_step_span(self):
+        if self._profile_step_span is not None:
+            self._profile_step_span.end()
+            self._profile_step_span = None
+
+    # -- results -------------------------------------------------------------
+    def export(self, path: str = "", format: str = "json"):
+        """Write the last finished profile as a chrome trace
+        (reference profiler.py:853)."""
+        if format not in ("json", "pb"):
+            raise ValueError(f"unsupported export format: {format}")
+        if self._last_result is None:
+            raise RuntimeError(
+                "no finished profile to export; run a RECORD window first")
+        self._last_result.save(path)
+
+    def summary(self, sorted_by=None, op_detail: bool = True,
+                thread_sep: bool = False, time_unit: str = "ms",
+                views=None):
+        """Print statistics tables for the last profile
+        (reference profiler.py:883)."""
+        from .profiler_statistic import SortedKeys, _build_summary
+
+        if self._last_result is None:
+            return
+        if sorted_by is None:
+            sorted_by = SortedKeys.CPUTotal
+        print(_build_summary(self._last_result, sorted_by=sorted_by,
+                             time_unit=time_unit))
+
+
+def get_profiler(config_path: str | None = None) -> Profiler:
+    """Build a Profiler from a JSON config file (reference profiler.py:951)."""
+    kwargs: dict[str, Any] = {}
+    if config_path:
+        with open(config_path) as f:
+            cfg = json.load(f)
+        if "targets" in cfg:
+            kwargs["targets"] = [ProfilerTarget[t] for t in cfg["targets"]]
+        if "scheduler" in cfg:
+            sch = cfg["scheduler"]
+            kwargs["scheduler"] = (make_scheduler(**sch)
+                                   if isinstance(sch, dict) else tuple(sch))
+        if "timer_only" in cfg:
+            kwargs["timer_only"] = bool(cfg["timer_only"])
+    return Profiler(**kwargs)

@@ -1,0 +1,98 @@
+"""Summary statistics over collected host events.
+
+Parity target: the reference's statistic tables
+(/root/reference/python/paddle/profiler/profiler_statistic.py — SortedKeys:49,
+EventSummary:503). The reference aggregates a C++ host/device node tree; here the
+inputs are flat HostEvent spans, so the aggregation is a per-name rollup with the
+same sort keys and a plain-text table in the reference's style.
+"""
+from __future__ import annotations
+
+from enum import Enum
+
+
+class SortedKeys(Enum):
+    """Sort orders for summary tables (reference profiler_statistic.py:49)."""
+
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class EventStat:
+    __slots__ = ("name", "calls", "total_ns", "max_ns", "min_ns")
+
+    def __init__(self, name):
+        self.name = name
+        self.calls = 0
+        self.total_ns = 0
+        self.max_ns = 0
+        self.min_ns = None
+
+    def add(self, dur_ns):
+        self.calls += 1
+        self.total_ns += dur_ns
+        self.max_ns = max(self.max_ns, dur_ns)
+        self.min_ns = dur_ns if self.min_ns is None else min(self.min_ns, dur_ns)
+
+    @property
+    def avg_ns(self):
+        return self.total_ns / self.calls if self.calls else 0.0
+
+
+_SORT_ATTR = {
+    SortedKeys.CPUTotal: "total_ns", SortedKeys.GPUTotal: "total_ns",
+    SortedKeys.CPUAvg: "avg_ns", SortedKeys.GPUAvg: "avg_ns",
+    SortedKeys.CPUMax: "max_ns", SortedKeys.GPUMax: "max_ns",
+    SortedKeys.CPUMin: "min_ns", SortedKeys.GPUMin: "min_ns",
+}
+
+_UNIT_DIV = {"s": 1e9, "ms": 1e6, "us": 1e3, "ns": 1.0}
+
+
+def gather_stats(events) -> dict[str, EventStat]:
+    stats: dict[str, EventStat] = {}
+    for ev in events:
+        st = stats.get(ev.name)
+        if st is None:
+            st = stats[ev.name] = EventStat(ev.name)
+        st.add(ev.duration_ns)
+    return stats
+
+
+def _fmt(ns, unit):
+    return f"{ns / _UNIT_DIV[unit]:.3f}"
+
+
+def _build_summary(result, sorted_by=SortedKeys.CPUTotal,
+                   time_unit: str = "ms") -> str:
+    if time_unit not in _UNIT_DIV:
+        raise ValueError(f"time_unit must be one of {list(_UNIT_DIV)}")
+    stats = gather_stats(result.events)
+    reverse = sorted_by not in (SortedKeys.CPUMin, SortedKeys.GPUMin)
+    rows = sorted(stats.values(),
+                  key=lambda s: getattr(s, _SORT_ATTR[sorted_by]) or 0,
+                  reverse=reverse)
+    wall_ns = sum(s.total_ns for s in rows) or 1
+    name_w = max([len("Name")] + [min(len(s.name), 60) for s in rows])
+    header = (f"{'Name':<{name_w}}  {'Calls':>7}  {'Total(' + time_unit + ')':>12}  "
+              f"{'Avg(' + time_unit + ')':>12}  {'Max(' + time_unit + ')':>12}  "
+              f"{'Min(' + time_unit + ')':>12}  {'Ratio(%)':>8}")
+    sep = "-" * len(header)
+    lines = ["", "Host Event Summary "
+             f"(steps {result.steps[0]}..{result.steps[1]})", sep, header, sep]
+    for s in rows:
+        lines.append(
+            f"{s.name[:60]:<{name_w}}  {s.calls:>7}  {_fmt(s.total_ns, time_unit):>12}  "
+            f"{_fmt(s.avg_ns, time_unit):>12}  {_fmt(s.max_ns, time_unit):>12}  "
+            f"{_fmt(s.min_ns or 0, time_unit):>12}  "
+            f"{100.0 * s.total_ns / wall_ns:>8.2f}")
+    lines.append(sep)
+    if result.xla_trace_dir:
+        lines.append(f"XLA device trace (TensorBoard/XProf): {result.xla_trace_dir}")
+    return "\n".join(lines)
