@@ -7,13 +7,127 @@ reported against the analytic hardware roofline: achieved model FLOP/s utilisati
 — the fraction of the chip's peak matmul throughput the training step sustains. That is
 the cross-hardware-comparable number (A100 Paddle LLM pretraining typically lands at
 0.3-0.5 MFU; matching it = parity per BASELINE.json's >=90% per-chip goal).
+
+Robustness contract (VERDICT r1 #1): this script ALWAYS exits 0 and ALWAYS prints
+exactly one JSON line on stdout. The default entry point is an orchestrator that runs
+the real bench in a child process (`bench.py --worker`); TPU backend-init failures are
+retried, then the bench falls back to CPU with the TPU error recorded in
+detail.tpu_error. The worker additionally validates the Pallas flash-attention kernel
+on-device (correctness vs the math path + timing) and reports it in
+detail.flash_attention.
 """
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
+WORKER_TIMEOUT_TPU = int(os.environ.get("BENCH_TPU_TIMEOUT", "1500"))
+WORKER_TIMEOUT_CPU = int(os.environ.get("BENCH_CPU_TIMEOUT", "900"))
+PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", "300"))
+TPU_ATTEMPTS = int(os.environ.get("BENCH_TPU_ATTEMPTS", "2"))
+
+
+# --------------------------------------------------------------------------- #
+# orchestrator
+# --------------------------------------------------------------------------- #
+
+def _extract_json_line(text: str):
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                doc = json.loads(line)
+                if "metric" in doc:
+                    return doc
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def _run_worker(extra_env: dict, timeout: int):
+    env = dict(os.environ)
+    env.update(extra_env)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--worker"],
+            capture_output=True, text=True, timeout=timeout, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)) or ".")
+        doc = _extract_json_line(proc.stdout)
+        if proc.returncode == 0 and doc is not None:
+            return doc, None
+        tail = (proc.stderr or proc.stdout or "")[-2000:]
+        return None, f"rc={proc.returncode}: {tail}"
+    except subprocess.TimeoutExpired as e:
+        tail = ((e.stderr or b"").decode(errors="replace")
+                if isinstance(e.stderr, bytes) else (e.stderr or ""))[-500:]
+        return None, f"timeout after {timeout}s: {tail}"
+    except Exception as e:  # noqa: BLE001 - must never crash the bench
+        return None, f"spawn failure: {e!r}"
+
+
+def _probe_backend(timeout: int):
+    """Cheap subprocess probe: can the default backend initialize and run one op?
+    Bounds the cost of a hanging TPU tunnel before we commit to a full bench run."""
+    code = ("import jax, jax.numpy as jnp; d = jax.devices()[0]; "
+            "x = (jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready(); "
+            "print('PROBE_OK', d.platform)")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                              text=True, timeout=timeout, env=dict(os.environ))
+        if proc.returncode == 0 and "PROBE_OK" in proc.stdout:
+            return True, proc.stdout.strip()
+        return False, (proc.stderr or proc.stdout or "")[-800:]
+    except subprocess.TimeoutExpired:
+        return False, f"probe hang: backend init exceeded {timeout}s"
+    except Exception as e:  # noqa: BLE001
+        return False, f"probe spawn failure: {e!r}"
+
+
+def orchestrate():
+    errors = []
+    # 0) cheap probe so a hanging TPU tunnel costs minutes, not the full worker
+    #    timeout. A probe failure is retried once — the r1 failure mode was a
+    #    transient "UNAVAILABLE: TPU backend setup/compile error" at first dispatch.
+    probe_ok, probe_info = _probe_backend(PROBE_TIMEOUT)
+    if not probe_ok:
+        errors.append(f"probe 1: {probe_info}")
+        time.sleep(20)
+        probe_ok, probe_info = _probe_backend(PROBE_TIMEOUT)
+        if not probe_ok:
+            errors.append(f"probe 2: {probe_info}")
+    # 1) real backend (axon TPU in the driver environment), with retry.
+    attempts = TPU_ATTEMPTS if probe_ok else 1
+    for attempt in range(attempts):
+        doc, err = _run_worker({}, WORKER_TIMEOUT_TPU if probe_ok else PROBE_TIMEOUT)
+        if doc is not None:
+            if errors:
+                doc.setdefault("detail", {})["earlier_errors"] = errors
+            print(json.dumps(doc))
+            return
+        errors.append(f"attempt {attempt + 1}: {err}")
+        time.sleep(15)
+    # 2) CPU fallback so the driver still records a real (if slow) number, with the
+    #    TPU failure preserved for diagnosis.
+    doc, err = _run_worker({"JAX_PLATFORMS": "cpu", "BENCH_FORCE_CPU": "1"},
+                           WORKER_TIMEOUT_CPU)
+    if doc is not None:
+        doc.setdefault("detail", {})["tpu_error"] = errors
+        print(json.dumps(doc))
+        return
+    errors.append(f"cpu fallback: {err}")
+    print(json.dumps({
+        "metric": "llama_train_tokens_per_sec", "value": 0.0,
+        "unit": "tokens/s", "vs_baseline": 0.0,
+        "detail": {"error": errors},
+    }))
+
+
+# --------------------------------------------------------------------------- #
+# worker
+# --------------------------------------------------------------------------- #
 
 def _peak_flops(device):
     """Peak bf16 FLOP/s for known platforms (used for the MFU denominator)."""
@@ -23,6 +137,7 @@ def _peak_flops(device):
         "tpu v2": 45e12, "tpu v3": 123e12, "tpu v4": 275e12,
         "tpu v5 lite": 197e12, "tpu v5e": 197e12, "tpu v5": 459e12,
         "tpu v5p": 459e12, "tpu v6 lite": 918e12, "tpu v6e": 918e12,
+        "tpu7x": 2307e12, "tpu v7": 2307e12,
     }
     for k, v in table.items():
         if k in kind:
@@ -32,19 +147,127 @@ def _peak_flops(device):
     return 0.5e12  # CPU-ish fallback so local runs still print a line
 
 
-def main():
-    import jax
-    import jax.numpy as jnp
+def _log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _check_flash_attention(on_tpu):
+    """Prove the Pallas kernel on the actual device: correctness vs the math path
+    and kernel-vs-math timing. Returns a JSON-able dict; never raises."""
     import numpy as np
 
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.nn.functional.flash_attention import _math_sdpa
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention_fwd
+
+    info = {"device": jax.devices()[0].platform, "ok": False}
+    try:
+        # small on CPU: the Pallas interpreter is orders of magnitude slower
+        B, S, H, D = (2, 1024, 8, 128) if on_tpu else (1, 256, 2, 64)
+        dtype = jnp.bfloat16 if on_tpu else jnp.float32
+        r = np.random.RandomState(0)
+        q = jnp.asarray(r.standard_normal((B, S, H, D)), dtype)
+        k = jnp.asarray(r.standard_normal((B, S, H, D)), dtype)
+        v = jnp.asarray(r.standard_normal((B, S, H, D)), dtype)
+
+        flash = jax.jit(lambda q, k, v: flash_attention_fwd(q, k, v, causal=True))
+        math = jax.jit(lambda q, k, v: _math_sdpa(q, k, v, causal=True))
+        out_f = jax.block_until_ready(flash(q, k, v))
+        out_m = jax.block_until_ready(math(q, k, v))
+        err = float(jnp.max(jnp.abs(out_f.astype(jnp.float32)
+                                    - out_m.astype(jnp.float32))))
+        tol = 2e-2 if dtype == jnp.bfloat16 else 2e-5
+        info["max_abs_err"] = err
+        info["ok"] = err < tol
+
+        def _time(fn, iters=20 if on_tpu else 2):
+            jax.block_until_ready(fn(q, k, v))
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn(q, k, v)
+            jax.block_until_ready(out)
+            return (time.perf_counter() - t0) / iters * 1e3
+
+        info["flash_ms"] = round(_time(flash), 3)
+        info["math_ms"] = round(_time(math), 3)
+
+        # backward through the custom VJP as well
+        g = jax.jit(jax.grad(lambda q: flash(q, k, v).astype(jnp.float32).sum()))
+        jax.block_until_ready(g(q))
+        info["bwd_ok"] = True
+    except Exception as e:  # noqa: BLE001
+        info["error"] = f"{type(e).__name__}: {e}"[:500]
+    return info
+
+
+def _build_step(model, optimizer, params, acc_keys, use_masters, rng, Tensor, jax):
+    """One fused train step (fwd+bwd+AdamW) with functional state threading."""
+
+    def train_step(param_values, acc_values, master_values, ids, labels):
+        with rng.trace_key(jax.random.PRNGKey(0)):
+            saved_p = [(p, p._value) for p in params]
+            saved_a = {id(p): dict(optimizer._accumulators[id(p)]) for p in params}
+            saved_m = dict(optimizer._master_weights)
+            try:
+                for p, v in zip(params, param_values):
+                    p._replace_value(v)
+                for p, ks, vs in zip(params, acc_keys, acc_values):
+                    for k, v in zip(ks, vs):
+                        optimizer._accumulators[id(p)][k] = v
+                if use_masters:
+                    for p, mv in zip(params, master_values):
+                        optimizer._master_weights[id(p)] = mv
+                loss, _ = model(Tensor(ids), labels=Tensor(labels))
+                loss.backward()
+                optimizer.step()
+                optimizer.clear_grad()
+                new_p = [p._value for p in params]
+                new_a = [[optimizer._accumulators[id(p)][k] for k in ks]
+                         for p, ks in zip(params, acc_keys)]
+                new_m = ([optimizer._master_weights[id(p)] for p in params]
+                         if use_masters else master_values)
+                return loss.value, new_p, new_a, new_m
+            finally:
+                for p, v in saved_p:
+                    p._replace_value(v)
+                for p in params:
+                    optimizer._accumulators[id(p)] = saved_a[id(p)]
+                optimizer._master_weights = saved_m
+
+    return jax.jit(train_step, donate_argnums=(0, 1, 2))
+
+
+def worker():
+    import numpy as np
+
+    import jax
+
+    if os.environ.get("BENCH_FORCE_CPU"):
+        # this environment's sitecustomize force-selects the axon TPU platform in
+        # every process regardless of JAX_PLATFORMS; config.update after import
+        # (before backend init) is the supported way back to pure CPU.
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+
     import paddle_tpu as paddle
-    from paddle_tpu.autograd import tape
+    from paddle_tpu.autograd import tape  # noqa: F401 - keeps tape module hot
     from paddle_tpu.framework import random as rng
     from paddle_tpu.framework.core import Tensor
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
 
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
+    _log(f"[bench] device={dev} kind={getattr(dev, 'device_kind', '?')}")
+
+    flash_info = _check_flash_attention(on_tpu)
+    _log(f"[bench] flash_attention check: {flash_info}")
+    if on_tpu and not flash_info.get("ok"):
+        # kernel unproven on this chip -> train on the XLA math path rather than
+        # risk a mid-bench compile failure; the JSON records why.
+        os.environ["PADDLE_TPU_DISABLE_PALLAS"] = "1"
 
     # ~350M-param model in bf16 on TPU (per-layer remat + Pallas flash attention keep
     # activations O(S)); tiny on CPU so the smoke run finishes fast
@@ -78,37 +301,6 @@ def main():
     acc_keys = [sorted(optimizer._accumulators[id(p)].keys()) for p in params]
     use_masters = optimizer._use_master_weights
 
-    def train_step(param_values, acc_values, master_values, ids, labels):
-        with rng.trace_key(jax.random.PRNGKey(0)):
-            saved_p = [(p, p._value) for p in params]
-            saved_a = {id(p): dict(optimizer._accumulators[id(p)]) for p in params}
-            saved_m = dict(optimizer._master_weights)
-            try:
-                for p, v in zip(params, param_values):
-                    p._replace_value(v)
-                for p, ks, vs in zip(params, acc_keys, acc_values):
-                    for k, v in zip(ks, vs):
-                        optimizer._accumulators[id(p)][k] = v
-                if use_masters:
-                    for p, mv in zip(params, master_values):
-                        optimizer._master_weights[id(p)] = mv
-                loss, _ = model(Tensor(ids), labels=Tensor(labels))
-                loss.backward()
-                optimizer.step()
-                optimizer.clear_grad()
-                new_p = [p._value for p in params]
-                new_a = [[optimizer._accumulators[id(p)][k] for k in ks]
-                         for p, ks in zip(params, acc_keys)]
-                new_m = ([optimizer._master_weights[id(p)] for p in params]
-                         if use_masters else master_values)
-                return loss.value, new_p, new_a, new_m
-            finally:
-                for p, v in saved_p:
-                    p._replace_value(v)
-                for p in params:
-                    optimizer._accumulators[id(p)] = saved_a[id(p)]
-                optimizer._master_weights = saved_m
-
     r = np.random.RandomState(0)
     ids = jnp.asarray(r.randint(0, cfg.vocab_size, (batch, seq)), jnp.int32)
     labels = jnp.asarray(r.randint(0, cfg.vocab_size, (batch, seq)), jnp.int32)
@@ -118,11 +310,34 @@ def main():
     mv = ([optimizer._master_weights[id(p)] for p in params]
           if use_masters else [])
 
-    step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+    attention_path = ("pallas_flash"
+                      if not os.environ.get("PADDLE_TPU_DISABLE_PALLAS") and on_tpu
+                      else "xla_math")
 
-    # warmup/compile
-    loss, pv, av, mv = step(pv, av, mv, ids, labels)
-    jax.block_until_ready(loss)
+    def compile_and_warm():
+        step = _build_step(model, optimizer, params, acc_keys, use_masters,
+                           rng, Tensor, jax)
+        _log("[bench] compiling train step...")
+        t0 = time.perf_counter()
+        out = step(pv, av, mv, ids, labels)
+        jax.block_until_ready(out[0])
+        _log(f"[bench] compiled in {time.perf_counter() - t0:.1f}s")
+        return step, out
+
+    try:
+        step, (loss, pv2, av2, mv2) = compile_and_warm()
+    except Exception as e:  # noqa: BLE001
+        if attention_path == "pallas_flash":
+            # Pallas lowering/compile failure inside the full model: fall back to
+            # the XLA math path and recompile rather than dying without a number.
+            _log(f"[bench] pallas path failed in full model: {e!r}; retrying "
+                 "with PADDLE_TPU_DISABLE_PALLAS=1")
+            os.environ["PADDLE_TPU_DISABLE_PALLAS"] = "1"
+            attention_path = "xla_math_after_pallas_failure"
+            step, (loss, pv2, av2, mv2) = compile_and_warm()
+        else:
+            raise
+    pv, av, mv = pv2, av2, mv2
 
     t0 = time.perf_counter()
     for _ in range(iters):
@@ -132,7 +347,8 @@ def main():
 
     tokens_per_s = batch * seq / dt
 
-    # 6*N FLOPs/token (fwd+bwd) + attention term
+    # 6*N FLOPs/token (fwd+bwd) + causal attention term 12*L*H*S/2... use the
+    # standard PaLM appendix-B accounting: 6N + 12*L*h*S (h=hidden) per token.
     n_params = sum(int(np.prod(p.shape)) for p in params)
     attn_flops = 12 * cfg.num_hidden_layers * cfg.hidden_size * seq
     flops_per_token = 6 * n_params + attn_flops
@@ -150,9 +366,14 @@ def main():
             "device": str(getattr(dev, "device_kind", dev.platform)),
             "mfu": round(mfu, 4),
             "loss": float(jax.device_get(loss)),
+            "attention_path": attention_path,
+            "flash_attention": flash_info,
         },
     }))
 
 
 if __name__ == "__main__":
-    main()
+    if "--worker" in sys.argv:
+        worker()
+    else:
+        orchestrate()

@@ -139,9 +139,33 @@ class DataLoader:
             yield self.collate_fn([self.dataset[i] for i in indices])
 
     def __iter__(self):
+        # throughput-timer hooks (profiler.timer): time this loader's fetches when
+        # it is the outermost reader of the current benchmark run
+        from ..profiler.timer import benchmark
+
+        bm = benchmark()
+        bm.check_if_need_record(self)
+        timed = bm.is_recording_reader(self)
+        try:
+            yield from self._iter_impl(bm if timed else None)
+        finally:
+            if timed:
+                bm.release_reader(self)
+
+    def _iter_impl(self, bm):
         if not self.use_buffer_reader:
-            for b in self._batches():
-                yield _to_device(b)
+            it = iter(self._batches())
+            while True:
+                if bm is not None:
+                    bm.before_reader()
+                try:
+                    b = next(it)
+                except StopIteration:
+                    return
+                staged = _to_device(b)
+                if bm is not None:
+                    bm.after_reader()
+                yield staged
             return
         # double-buffer: stage the next batch to device while the current one is consumed
         q: queue.Queue = queue.Queue(maxsize=self.prefetch_factor)
@@ -178,9 +202,13 @@ class DataLoader:
         t.start()
         try:
             while True:
+                if bm is not None:
+                    bm.before_reader()
                 item = q.get()
                 if item is sentinel:
                     break
+                if bm is not None:
+                    bm.after_reader()
                 yield item
             t.join()
             if err:

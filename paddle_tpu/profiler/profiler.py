@@ -211,7 +211,9 @@ def export_chrome_tracing(dir_name: str,
         nonlocal worker_name
         if not worker_name:
             worker_name = f"host_{socket.gethostname()}_pid_{os.getpid()}"
-        filename = f"{worker_name}_time_{int(time.time())}.paddle_trace.json"
+        # step range in the name keeps back-to-back cycles from colliding
+        filename = (f"{worker_name}_time_{time.time_ns()}"
+                    f"_step_{prof.step_num}.paddle_trace.json")
         prof.export(os.path.join(dir_name, filename), format="json")
 
     return handle_fn
@@ -226,7 +228,8 @@ def export_protobuf(dir_name: str, worker_name: str | None = None) -> Callable:
         nonlocal worker_name
         if not worker_name:
             worker_name = f"host_{socket.gethostname()}_pid_{os.getpid()}"
-        filename = f"{worker_name}_time_{int(time.time())}.paddle_trace.pb.json"
+        filename = (f"{worker_name}_time_{time.time_ns()}"
+                    f"_step_{prof.step_num}.paddle_trace.pb.json")
         prof.export(os.path.join(dir_name, filename), format="json")
 
     return handle_fn
@@ -379,7 +382,7 @@ class Profiler:
         self.current_state = self._scheduler(self.step_num)
         if self.current_state in (ProfilerState.RECORD,
                                   ProfilerState.RECORD_AND_RETURN):
-            self._start_record()
+            self._start_record(self.step_num)
         self._open_step_span()
 
     def stop(self):
@@ -407,7 +410,7 @@ class Profiler:
         self._close_step_span()
         _collector.current_step = self.step_num + 1
         next_state = self._scheduler(self.step_num + 1)
-        self._trigger_action(self.current_state, next_state)
+        self._trigger_action(self.current_state, next_state, self.step_num + 1)
         self.step_num += 1
         self.current_state = next_state
         self._open_step_span()
@@ -419,37 +422,40 @@ class Profiler:
         return self._timer.step_info(unit)
 
     # -- state transitions ---------------------------------------------------
-    def _trigger_action(self, cur: ProfilerState, nxt: ProfilerState):
+    def _trigger_action(self, cur: ProfilerState, nxt: ProfilerState,
+                        next_step: int):
         recording = (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
         if cur not in recording and nxt in recording:
-            self._start_record()
+            self._start_record(next_step)
         if cur is ProfilerState.RECORD_AND_RETURN:
             self._finish_record()
             if self.on_trace_ready and self._last_result is not None:
                 self.on_trace_ready(self)
             if nxt in recording:  # back-to-back cycles
-                self._start_record()
+                self._start_record(next_step)
         elif cur in recording and nxt not in recording:
             # schedule left the record window without RECORD_AND_RETURN; keep the
             # data but don't hand it off (matches reference semantics of partial
             # windows being flushed on stop()).
             self._finish_record()
 
-    def _start_record(self):
+    def _start_record(self, start_step: int):
         _collector.enabled = True
-        _collector.current_step = self.step_num
-        self._record_start_step = self.step_num
+        _collector.current_step = start_step
+        self._record_start_step = start_step
+        self._xla_trace_dir = None
         if (ProfilerTarget.TPU in self.targets
                 or ProfilerTarget.GPU in self.targets):
             try:
                 import jax
 
                 if any(d.platform in ("tpu", "gpu") for d in jax.devices()):
-                    self._xla_trace_dir = os.path.join(
+                    trace_dir = os.path.join(
                         os.environ.get("PADDLE_TPU_TRACE_DIR", "/tmp"),
-                        f"paddle_tpu_xla_trace_{os.getpid()}_{self.step_num}")
-                    jax.profiler.start_trace(self._xla_trace_dir)
+                        f"paddle_tpu_xla_trace_{os.getpid()}_{start_step}")
+                    jax.profiler.start_trace(trace_dir)
                     self._xla_tracing = True
+                    self._xla_trace_dir = trace_dir
             except Exception:
                 self._xla_tracing = False
 

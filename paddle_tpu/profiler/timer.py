@@ -128,6 +128,7 @@ class Benchmark:
         self.running = False
         self.events: list[Event] = []
         self.current_event: Event | None = None
+        self._recording_reader: int | None = None
 
     # -- lifecycle (driven by Profiler / DataLoader / user) -------------------
     def begin(self):
@@ -162,10 +163,21 @@ class Benchmark:
 
     def check_if_need_record(self, reader):
         """DataLoader hook: only the outermost reader of a run is timed
-        (reference timer.py:419)."""
+        (reference timer.py:419). The first reader to register wins; nested
+        readers see need_record=False and are not counted."""
         if self.current_event is None:
             return
-        self.current_event.need_record = True
+        if self._recording_reader is None:
+            self._recording_reader = id(reader)
+        self.current_event.need_record = (id(reader) == self._recording_reader)
+
+    def is_recording_reader(self, reader) -> bool:
+        return self._recording_reader in (None, id(reader))
+
+    def release_reader(self, reader):
+        """Called when a reader's epoch ends so the next run can re-register."""
+        if self._recording_reader == id(reader):
+            self._recording_reader = None
 
     # -- reporting ------------------------------------------------------------
     def step_info(self, unit=None):
