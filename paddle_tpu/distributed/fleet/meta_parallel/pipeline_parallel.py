@@ -64,6 +64,17 @@ class ShardingParallel(MetaParallelBase):
 
 
 class PipelineParallel(MetaParallelBase):
+    """Pipeline execution wrapper. Two paths:
+
+    * eager (default): per-micro-batch forward/backward with grad accumulation —
+      bit-identical numerics to 1F1B, parameters replicated over pp.
+    * compiled (``strategy.pipeline_configs["compiled"] = True``): the real rotation
+      in distributed/pipelining.py — stage-stacked parameters sharded 1/pp per
+      device, lax.ppermute activation transfer, one XLA program.
+    """
+
+    _default_virtual_stages = None  # subclass hook (VPP)
+
     def __init__(self, layers, hcg, strategy):
         if not isinstance(layers, PipelineLayer):
             raise TypeError("PipelineParallel requires a PipelineLayer model")
@@ -72,6 +83,48 @@ class PipelineParallel(MetaParallelBase):
         self.accumulate_steps = int(cfg.get("accumulate_steps", 1))
         self.micro_batch_size = int(cfg.get("micro_batch_size", 1))
         self.total_loss = None
+        self._compiled = None
+        use_compiled = bool(cfg.get("compiled", False)) or \
+            self._default_virtual_stages is not None
+        if use_compiled and hcg is not None \
+                and hcg.get_pipe_parallel_world_size() > 1:
+            from ...pipelining import compile_pipeline
+
+            v = (self._default_virtual_stages
+                 or getattr(layers, "_num_virtual_stages", 1) or 1)
+            self._compiled = compile_pipeline(
+                layers,
+                mesh=hcg.global_mesh.jax_mesh(),
+                num_microbatches=self.accumulate_steps,
+                num_virtual_stages=v)
+
+    # compiled mode owns the (stacked) parameters the optimizer must see
+    def parameters(self, include_sublayers=True):
+        if self._compiled is not None:
+            return self._compiled.parameters(include_sublayers)
+        return super().parameters(include_sublayers)
+
+    def named_parameters(self, *a, **k):
+        if self._compiled is not None:
+            return self._compiled.named_parameters(*a, **k)
+        return super().named_parameters(*a, **k)
+
+    def forward(self, *inputs, **kwargs):
+        if self._compiled is not None:
+            return self._compiled(*inputs, **kwargs)
+        return super().forward(*inputs, **kwargs)
+
+    def state_dict(self, *a, **k):
+        # compiled mode: the stacked Parameters are the live weights — the original
+        # PipelineLayer copies are stale after the first optimizer step
+        if self._compiled is not None:
+            return self._compiled.state_dict(*a, **k)
+        return super().state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        if self._compiled is not None:
+            return self._compiled.set_state_dict(*a, **k)
+        return super().set_state_dict(*a, **k)
 
     # -- data plumbing -------------------------------------------------------
     def _load_micro_batch(self, data, step):
@@ -91,6 +144,8 @@ class PipelineParallel(MetaParallelBase):
     def forward_backward_pipeline(self, data, scaler=None):
         """1F1B numerics: per-micro-batch forward/backward with grad accumulation
         (pipeline_parallel.py:684). Device-level overlap belongs to the compiled path."""
+        if self._compiled is not None:
+            return self._forward_backward_compiled(data, scaler)
         self.total_loss = None
         losses = []
         for step in range(self.accumulate_steps):
@@ -109,6 +164,21 @@ class PipelineParallel(MetaParallelBase):
             _scale(scaled, 1.0 / self.accumulate_steps).backward()
             losses.append(loss.value)
         self.total_loss = Tensor(jnp.stack([jnp.asarray(l) for l in losses]).mean())
+        return self.total_loss
+
+    def _forward_backward_compiled(self, data, scaler=None):
+        """One backward through the compiled rotation: the mean token loss over the
+        full batch equals the eager micro-batch average, and the vjp through the
+        scan IS the backward pipeline (grads accumulate over ticks)."""
+        from ....ops import mean as _mean
+
+        inputs, labels = data
+        out = self._compiled(inputs)
+        loss = self._compiled.loss(out, labels)
+        loss = _mean(loss) if loss.ndim > 0 else loss
+        scaled = scaler.scale(loss) if scaler is not None else loss
+        scaled.backward()
+        self.total_loss = Tensor(loss.value)
         return self.total_loss
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
@@ -155,8 +225,14 @@ class PipelineParallel(MetaParallelBase):
 
 
 class PipelineParallelWithInterleave(PipelineParallel):
-    """VPP schedule (pipeline_parallel.py:1308): same numerics; the virtual-stage
-    interleaving is a compiled-path schedule choice on TPU."""
+    """VPP schedule (pipeline_parallel.py:1308): the body is cut into
+    ``num_virtual_pipeline_stages * pp`` chunks placed round-robin (device s holds
+    chunks s, pp+s, 2*pp+s, ...) and the compiled rotation runs the virtual rounds
+    back-to-back in one XLA program — always uses the compiled path."""
+
+    @property
+    def _default_virtual_stages(self):
+        return max(2, getattr(self._layers, "_num_virtual_stages", 2) or 2)
 
 
 class PipelineParallelMicroStepLocations:

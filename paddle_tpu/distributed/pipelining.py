@@ -1,0 +1,313 @@
+"""Compiled pipeline parallelism: stage rotation over the pp mesh axis.
+
+Reference analog: python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py
+(forward_backward_pipeline :684, train_batch :940 — 1F1B over NCCL isend/irecv;
+PipelineParallelWithInterleave :1308 — virtual/VPP stages) and the P2P engine
+(pp_utils/p2p_communication.py:52 SendRecvMeta shape handshake).
+
+TPU-first redesign — no point-to-point runtime at all:
+
+* Stage parameters live STACKED on a leading stage axis that is sharded over the mesh's
+  ``pp`` axis (``NamedSharding P(None, 'pp')``): each device physically holds only its
+  stage's slice — 1/pp of the pipeline body's bytes — the placement the reference
+  achieves by constructing per-rank sub-models.
+* One ``jax.shard_map`` (manual over ``pp`` only; dp/mp/sep axes stay under GSPMD, so
+  tensor-parallel annotations inside a stage still work) runs the whole schedule:
+  at every tick each device applies its stage to its current micro-batch and the
+  activation ring rotates one hop via ``lax.ppermute`` — XLA lowers that to a
+  neighbour ICI transfer, the TPU replacement for isend/irecv.
+* The schedule is DIFFERENTIABLE: grads of ``ppermute`` are the reverse rotation, so
+  ``jax.vjp`` of the forward IS the backward pipeline (reverse tick order, grads
+  flowing last-stage -> first-stage), and micro-batch gradient accumulation falls out
+  of the sum over ticks. With per-tick rematerialisation (``jax.checkpoint``,
+  ``schedule='1f1b'``) the live-activation footprint matches 1F1B's O(S + M)
+  micro-batch residency; ``schedule='gpipe'`` keeps all residuals.
+* Virtual (interleaved) stages: the body is cut into ``v * S`` chunks placed
+  round-robin — device s holds chunks ``s, S+s, 2S+s, ...`` (leaf layout
+  ``(v, S, ...)``, stage axis sharded) — exactly VPP's placement; the v rounds run
+  back-to-back inside the same compiled program.
+
+Determinism note: stages run under one fixed RNG trace key, so dropout inside the
+pipelined body draws the same mask pattern per tick; pipelined pretraining configs
+(dropout=0) are unaffected.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..autograd import tape
+from ..framework import random as rng
+from ..framework.core import Parameter, Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["pipeline_forward", "PipelinedModule", "compile_pipeline"]
+
+
+def _ring(axis_size):
+    return [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+
+def pipeline_forward(stage_fn, stacked_params, x_microbatches, *, mesh,
+                     axis_name="pp", num_virtual=1, remat=True):
+    """Run ``num_virtual`` rotation rounds of the compiled pipeline.
+
+    stage_fn(params_tree, x) -> y must be shape-preserving (y.shape == x.shape) and
+    pure. ``stacked_params`` is a pytree whose leaves have leading shape
+    ``(num_virtual, S)`` (S = mesh.shape[axis_name]); ``x_microbatches`` has leading
+    shape ``(M, micro_batch, ...)`` and is replicated over the pp axis. Returns the
+    last virtual round's outputs, same shape as ``x_microbatches``, replicated over pp.
+    """
+    S = mesh.shape[axis_name]
+    M = x_microbatches.shape[0]
+    leaves, treedef = jax.tree_util.tree_flatten(stacked_params)
+    apply_one = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    def body(x_all, *leaf_vals):
+        # each leaf arrives as (v, 1, ...): drop the sharded stage axis
+        local = [lv[:, 0] for lv in leaf_vals]
+        idx = lax.axis_index(axis_name)
+
+        def one_round(chunk_leaves, x_all):
+            params = jax.tree_util.tree_unflatten(treedef, chunk_leaves)
+            state = lax.pcast(jnp.zeros_like(x_all[0]), (axis_name,),
+                              to="varying")
+            outbuf = lax.pcast(jnp.zeros_like(x_all), (axis_name,),
+                               to="varying")
+
+            def tick(carry, t):
+                state, outbuf = carry
+                inject = lax.dynamic_index_in_dim(
+                    x_all, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+                cur = jnp.where(idx == 0, inject, state)
+                y = apply_one(params, cur)
+                out_idx = jnp.clip(t - (S - 1), 0, M - 1)
+                valid = (t >= S - 1) & (idx == S - 1)
+                new = lax.dynamic_update_index_in_dim(outbuf, y, out_idx, 0)
+                outbuf = jnp.where(valid, new, outbuf)
+                state = lax.ppermute(y, axis_name, _ring(S))
+                return (state, outbuf), None
+
+            (state, outbuf), _ = lax.scan(
+                tick, (state, outbuf), jnp.arange(S + M - 1))
+            # only the last stage's lanes hold data; the psum is the broadcast
+            # back to every pp rank (feeds round r+1's stage 0 / the epilogue)
+            return lax.psum(outbuf, axis_name)
+
+        for r in range(num_virtual):
+            x_all = one_round([lv[r] for lv in local], x_all)
+        return x_all
+
+    in_specs = (P(),) + tuple(P(None, axis_name) for _ in leaves)
+    return jax.shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=P(),
+                         axis_names={axis_name})(x_microbatches, *leaves)
+
+
+def _layer_signature(layer):
+    """Structural identity of a layer's parameters: equal signature <=> the layers
+    can share one traced stage program with stacked values."""
+    if not isinstance(layer, Layer):
+        return None
+    ps = list(layer.named_parameters())
+    if not ps:
+        return None
+    return tuple((n, tuple(p.shape), str(np.dtype(p.dtype)))
+                 for n, p in ps)
+
+
+def _find_body_run(entries):
+    """Longest run of consecutive entries with identical parameter signatures."""
+    best = (0, 0)  # (start, length)
+    i = 0
+    n = len(entries)
+    while i < n:
+        sig = _layer_signature(entries[i])
+        if sig is None:
+            i += 1
+            continue
+        j = i + 1
+        while j < n and _layer_signature(entries[j]) == sig:
+            j += 1
+        if j - i > best[1]:
+            best = (i, j - i)
+        i = j
+    return best
+
+
+class PipelinedModule(Layer):
+    """Compiled-pipeline form of a PipelineLayer.
+
+    The homogeneous middle run of the layer list (e.g. the N identical decoder
+    blocks) becomes the rotated, pp-sharded pipeline body; the heterogeneous
+    prologue (embedding) and epilogue (final norm, lm head, leftover blocks) run as
+    ordinary GSPMD compute outside the rotation. Parameters of the body are exposed
+    as stacked ``(v, S, ...)`` Parameters sharded over the pp mesh axis, so each
+    device holds 1/pp of the body bytes; `parameters()` returns these stacked
+    Parameters plus the prologue/epilogue ones — an optimizer updates the stacked
+    form directly (elementwise updates commute with stacking).
+    """
+
+    def __init__(self, pipe_layer, *, mesh, axis_name="pp",
+                 num_microbatches=None, schedule="1f1b",
+                 num_virtual_stages=None):
+        super().__init__()
+        if schedule not in ("1f1b", "gpipe"):
+            raise ValueError(f"unknown pipeline schedule {schedule!r}")
+        self._mesh = mesh
+        self._axis_name = axis_name
+        self._schedule = schedule
+        self._pipe_layer = pipe_layer
+        self._loss_fn = getattr(pipe_layer, "_loss_fn", None)
+        S = mesh.shape[axis_name]
+        self._num_stages = S
+        v = int(num_virtual_stages
+                or getattr(pipe_layer, "_num_virtual_stages", 1) or 1)
+        self._num_virtual = v
+        self.num_microbatches = num_microbatches  # None -> whole batch at once
+
+        entries = list(pipe_layer.run_function)
+        start, length = _find_body_run(entries)
+        chunk_count = S * v
+        usable = (length // chunk_count) * chunk_count
+        if usable < chunk_count:
+            raise ValueError(
+                f"pipeline body needs at least {chunk_count} structurally "
+                f"identical consecutive layers (pp={S} x virtual={v}); found a "
+                f"run of {length}. Make the repeated block count divisible or "
+                "lower the pp degree.")
+        self._body_start = start
+        self._body_len = usable
+        body = entries[start:start + usable]
+        self._prologue = entries[:start]
+        # leftover homogeneous layers that don't fill a chunk slide into the epilogue
+        self._epilogue = entries[start + usable:]
+
+        layers_per_chunk = usable // chunk_count
+        self._template = body[:layers_per_chunk]
+        self._template_params = [p for lyr in self._template
+                                 for _, p in lyr.named_parameters()]
+
+        # stack chunk j's parameter leaves; chunk j = virtual round j//S, stage j%S
+        chunks = [body[j * layers_per_chunk:(j + 1) * layers_per_chunk]
+                  for j in range(chunk_count)]
+        per_chunk_values = []
+        for ch in chunks:
+            vals = [p.value for lyr in ch for _, p in lyr.named_parameters()]
+            per_chunk_values.append(vals)
+        self._stacked_params = []
+        spec = None
+        for i in range(len(per_chunk_values[0])):
+            stacked = jnp.stack([vals[i] for vals in per_chunk_values])
+            stacked = stacked.reshape(v, S, *stacked.shape[1:])
+            spec = P(None, axis_name, *([None] * (stacked.ndim - 2)))
+            stacked = jax.device_put(stacked, NamedSharding(mesh, spec))
+            param = Parameter(stacked, name=f"pipeline_stack_{i}")
+            self.add_parameter(f"pipeline_stack_{i}", param)
+            self._stacked_params.append(param)
+
+        # prologue/epilogue layers stay live sublayers (their params train as-is)
+        for k, fn in enumerate(self._prologue):
+            if isinstance(fn, Layer):
+                self.add_sublayer(f"prologue_{k}", fn)
+        for k, fn in enumerate(self._epilogue):
+            if isinstance(fn, Layer):
+                self.add_sublayer(f"epilogue_{k}", fn)
+
+    # -- stage program -------------------------------------------------------
+    def _stage_apply(self, leaf_vals, x):
+        """Pure per-stage program: template layers with values swapped in."""
+        with tape.functional_mode(), rng.trace_key(jax.random.PRNGKey(0)):
+            saved = [(p, p._value) for p in self._template_params]
+            try:
+                for p, val in zip(self._template_params, leaf_vals):
+                    p._replace_value(val)
+                h = Tensor(x, stop_gradient=False)
+                for lyr in self._template:
+                    h = lyr(h) if not isinstance(h, tuple) else lyr(*h)
+                return h.value
+            finally:
+                for p, val in saved:
+                    p._replace_value(val)
+
+    @functools.cached_property
+    def _pipeline_fn(self):
+        # jit'd so the eager path executes the rotation as one compiled program
+        # (and so vjp sees a closed jaxpr; un-jitted shard_map autodiff needs an
+        # ambient mesh context that eager op dispatch doesn't provide)
+        @jax.jit
+        def fn(x_mb, *stacked_vals):
+            return pipeline_forward(
+                lambda params, x: self._stage_apply(params, x),
+                list(stacked_vals), x_mb, mesh=self._mesh,
+                axis_name=self._axis_name, num_virtual=self._num_virtual,
+                remat=self._schedule == "1f1b")
+
+        return fn
+
+    # -- module surface ------------------------------------------------------
+    def _run_segment(self, fns, x):
+        for fn in fns:
+            x = fn(x) if not isinstance(x, tuple) else fn(*x)
+        return x
+
+    def forward(self, input):  # noqa: A002
+        from ..ops import reshape
+
+        h = self._run_segment(self._prologue, input)
+        if isinstance(h, tuple):
+            raise TypeError(
+                "compiled pipeline body carries a single activation tensor; got a "
+                "tuple from the prologue")
+        B = h.shape[0]
+        M = self.num_microbatches or 1
+        if B % M:
+            raise ValueError(f"batch {B} not divisible by micro-batches {M}")
+        rest = list(h.shape[1:])
+        h_mb = reshape(h, [M, B // M] + rest)
+        from ..ops._apply import apply_raw
+
+        (out,) = apply_raw(
+            "pipeline_body", self._pipeline_fn,
+            [h_mb] + list(self._stacked_params))
+        out = reshape(out, [B] + rest)
+        return self._run_segment(self._epilogue, out)
+
+    def loss(self, output, label):
+        if self._loss_fn is None:
+            return output
+        return self._loss_fn(output, label)
+
+    # -- interop -------------------------------------------------------------
+    def stacked_parameter_map(self):
+        """leaf index -> list of (chunk, template param name) for checkpoint tools."""
+        names = []
+        for lyr in self._template:
+            names += [n for n, _ in lyr.named_parameters()]
+        return {i: name for i, name in enumerate(names)}
+
+
+def compile_pipeline(pipe_layer, *, mesh=None, axis_name="pp",
+                     num_microbatches=None, schedule="1f1b",
+                     num_virtual_stages=None):
+    """Build the compiled-pipeline module for a PipelineLayer.
+
+    ``mesh`` defaults to the fleet topology's global mesh (the one every other
+    hybrid axis annotates over)."""
+    if mesh is None:
+        from .fleet.topology import get_hybrid_parallel_group
+
+        hcg = get_hybrid_parallel_group()
+        if hcg is None:
+            raise RuntimeError(
+                "no mesh given and fleet.init() has not built a topology")
+        mesh = hcg.global_mesh.jax_mesh()
+    return PipelinedModule(
+        pipe_layer, mesh=mesh, axis_name=axis_name,
+        num_microbatches=num_microbatches, schedule=schedule,
+        num_virtual_stages=num_virtual_stages)
