@@ -38,11 +38,27 @@ def _mp_mesh_axis(group=None):
 
 
 def _constrain(v, mesh, spec):
-    """Apply a sharding constraint: device_put in eager, with_sharding_constraint traced."""
-    sharding = NamedSharding(mesh, spec)
+    """Apply a sharding constraint: device_put in eager, with_sharding_constraint traced.
+
+    Inside a shard_map body (e.g. TP layers running within the compiled pipeline's
+    manual pp axis) the constraint must be expressed on the context's abstract mesh —
+    whose axis types mark the manual axes — with manual axes dropped from the spec;
+    a constraint over the concrete mesh would type pp as Auto and fail vma checks."""
     if isinstance(v, jax.core.Tracer):
-        return jax.lax.with_sharding_constraint(v, sharding)
-    return jax.device_put(v, sharding)
+        am = jax.sharding.get_abstract_mesh()
+        manual = set(getattr(am, "manual_axes", ()) or ())
+        if am is not None and not am.empty and manual:
+            cleaned = []
+            for entry in tuple(spec):
+                if isinstance(entry, (tuple, list)):
+                    kept = tuple(a for a in entry if a not in manual)
+                    cleaned.append(kept if kept else None)
+                else:
+                    cleaned.append(None if entry in manual else entry)
+            return jax.lax.with_sharding_constraint(
+                v, NamedSharding(am, P(*cleaned)))
+        return jax.lax.with_sharding_constraint(v, NamedSharding(mesh, spec))
+    return jax.device_put(v, NamedSharding(mesh, spec))
 
 
 def _spec_last_dim(axis, ndim):

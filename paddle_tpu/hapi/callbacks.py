@@ -149,8 +149,14 @@ class EarlyStopping(Callback):
         else:
             self.monitor_op = np.less
             self.min_delta *= -1
-        self.best = None
+        # reference hapi/callbacks.py EarlyStopping: baseline seeds self.best so a
+        # model that never beats it stops after `patience` evals
+        self.best = baseline
         self.wait = 0
+        self.save_dir = None
+
+    def on_train_begin(self, logs=None):
+        self.save_dir = (self.params or {}).get("save_dir")
 
     def on_eval_end(self, logs=None):
         value = (logs or {}).get(self.monitor)
@@ -160,6 +166,9 @@ class EarlyStopping(Callback):
         if self.best is None or self.monitor_op(value - self.min_delta, self.best):
             self.best = value
             self.wait = 0
+            if self.save_best_model and self.save_dir is not None:
+                import os
+                self.model.save(os.path.join(self.save_dir, "best_model"))
         else:
             self.wait += 1
             if self.wait >= self.patience:
@@ -203,5 +212,5 @@ def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,
     lst = CallbackList(cbks)
     lst.set_model(model)
     lst.set_params({"epochs": epochs, "steps": steps, "verbose": verbose,
-                    "metrics": metrics or []})
+                    "metrics": metrics or [], "save_dir": save_dir})
     return lst

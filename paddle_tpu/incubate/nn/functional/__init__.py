@@ -19,25 +19,52 @@ def _rotate_half(x):
     return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
 
 
-def _rope_tables(seq_len, head_dim, theta, dtype, position_ids=None):
+def _rotate_every_two(x):
+    # interleaved layout: rotation pairs are (2i, 2i+1)
+    x1 = x[..., ::2]
+    x2 = x[..., 1::2]
+    return jnp.stack([-x2, x1], axis=-1).reshape(x.shape)
+
+
+def _rope_tables(seq_len, head_dim, theta, dtype, position_ids=None, every_two=True):
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
     if position_ids is None:
         t = jnp.arange(seq_len, dtype=jnp.float32)
     else:
         t = position_ids.astype(jnp.float32)
     freqs = jnp.einsum("...s,d->...sd", t, inv_freq)
-    emb = jnp.concatenate([freqs, freqs], axis=-1)
+    if every_two:
+        emb = jnp.repeat(freqs, 2, axis=-1)                  # [f0, f0, f1, f1, ...]
+    else:
+        emb = jnp.concatenate([freqs, freqs], axis=-1)       # [f0..f_{D/2-1}, f0..]
     return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
+
+
+def _normalize_rope_table(tbl):
+    """Accept (S,D), (B,S,D), (1,S,1,D)/(B,S,1,D) layouts → (S,D) or (B,S,D)."""
+    if tbl.ndim == 4:                                        # (B,S,1,D) head axis
+        tbl = tbl.reshape(tbl.shape[0], tbl.shape[1], tbl.shape[3])
+    if tbl.ndim == 3 and tbl.shape[0] == 1:
+        tbl = tbl[0]
+    return tbl
 
 
 @defop("fused_rotary_position_embedding", amp_category="white")
 def _fused_rope(q, k=None, v=None, sin=None, cos=None, position_ids=None,
                 use_neox_rotary_style=True, rotary_theta=10000.0):
-    """q/k/v: (B, S, H, D). Returns rotated (q, k, v) — v passes through (parity with
-    incubate/nn/functional/fused_rotary_position_embedding.py)."""
+    """q/k/v: (B, S, H, D). RoPE applies to EVERY provided input (the reference
+    kernel loops all of q/k/v: fused_rope_utils.h rotate_every_two iterates
+    num_inputs). use_neox_rotary_style=True selects the interleaved rotate-every-two
+    pairing, False the half-split rotate-half pairing — per the kernel dispatch at
+    fused_rope_kernel.cu:188-190 (NOT the usual HF naming). Auto-generated tables use
+    the pairing-consistent frequency layout for each style."""
     S, D = q.shape[1], q.shape[-1]
     if cos is None or sin is None:
-        cos, sin = _rope_tables(S, D, rotary_theta, q.dtype, position_ids)
+        cos, sin = _rope_tables(S, D, rotary_theta, q.dtype, position_ids,
+                                every_two=use_neox_rotary_style)
+    else:
+        cos = _normalize_rope_table(cos)
+        sin = _normalize_rope_table(sin)
     # broadcast (…S,D) over batch/head axes of (B,S,H,D)
     if cos.ndim == 2:
         cos_b = cos[None, :, None, :]
@@ -46,13 +73,15 @@ def _fused_rope(q, k=None, v=None, sin=None, cos=None, position_ids=None,
         cos_b = cos[:, :, None, :]
         sin_b = sin[:, :, None, :]
 
-    def rot(x):
-        return x * cos_b + _rotate_half(x) * sin_b
+    if use_neox_rotary_style:
+        def rot(x):
+            return x * cos_b + _rotate_every_two(x) * sin_b
+    else:
+        def rot(x):
+            return x * cos_b + _rotate_half(x) * sin_b
 
-    outs = [rot(q)]
-    outs.append(rot(k) if k is not None else None)
-    outs.append(v)
-    return tuple(o for o in outs if o is not None)
+    outs = tuple(rot(t) for t in (q, k, v) if t is not None)
+    return outs[0] if len(outs) == 1 else outs
 
 
 def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
@@ -63,10 +92,11 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
                       rotary_theta=rotary_theta)
     if not isinstance(out, tuple):
         out = (out,)
-    res = list(out)
-    while len(res) < 3:
-        res.append(None)
-    return tuple(res[:3])
+    # fixed positional slots: None inputs yield None outputs in their own slot
+    res, it = [], iter(out)
+    for t in (q, k, v):
+        res.append(next(it) if t is not None else None)
+    return tuple(res)
 
 
 @defop("fused_rms_norm", amp_category="fp32")

@@ -178,8 +178,10 @@ class LlamaAttention(Layer):
 
         from ..incubate.nn.functional import fused_rotary_position_embedding
 
+        # use_neox_rotary_style=False = rotate-half pairing (fused_rope_kernel.cu:188
+        # maps True→rotate_every_two) — matches apply_rotary_pos_emb above.
         q, k, _ = fused_rotary_position_embedding(
-            q, k, rotary_theta=self.config.rope_theta)
+            q, k, rotary_theta=self.config.rope_theta, use_neox_rotary_style=False)
 
         out = F.scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None,

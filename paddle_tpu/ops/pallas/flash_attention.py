@@ -312,6 +312,10 @@ def flash_attention_fwd(q, k, v, causal=False, scale=None,
                          f"blocks ({block_q},{block_k})")
     if Hq % Hkv != 0:
         raise ValueError(f"GQA head counts {Hq}/{Hkv} not divisible")
+    if causal and Sq > Sk:
+        # rows past Sk attend to nothing: forward would emit zeros and the
+        # p=exp(s-lse) trick in the dk/dv kernel would add exp(0)=1 garbage terms
+        raise ValueError(f"causal flash attention requires Sq<=Sk, got ({Sq},{Sk})")
     s = scale if scale is not None else 1.0 / np.sqrt(D)
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)

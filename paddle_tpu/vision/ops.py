@@ -62,7 +62,7 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None, categories=No
 
 @defop("vision.roi_align")
 def _roi_align(x, boxes, boxes_num=None, output_size=(1, 1), spatial_scale=1.0,
-               sampling_ratio=-1, aligned=True):
+               sampling_ratio=-1, aligned=True, reduce="mean"):
     # x: (N, C, H, W); boxes: (R, 4) in image coords; boxes assigned per batch by
     # boxes_num prefix counts
     N, C, H, W = x.shape
@@ -107,7 +107,10 @@ def _roi_align(x, boxes, boxes_num=None, output_size=(1, 1), spatial_scale=1.0,
     def per_roi(r):
         feat = x[batch_idx[r]]
         samples = bilinear(feat, ys[r], xs[r])                # (C, oh*sr, ow*sr)
-        return samples.reshape(C, oh, sr, ow, sr).mean(axis=(2, 4))
+        binned = samples.reshape(C, oh, sr, ow, sr)
+        if reduce == "max":
+            return binned.max(axis=(2, 4))
+        return binned.mean(axis=(2, 4))
 
     return jax.vmap(per_roi)(jnp.arange(R))
 
@@ -122,12 +125,13 @@ def roi_align(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0,
 
 
 def roi_pool(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0, name=None):
-    # max-pool variant approximated with dense sampling + max
+    # max-pool variant: dense bilinear sampling reduced with max (reference roi_pool
+    # takes the max over integer bins; dense sampling + max converges to the same)
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
     return _roi_align(x, boxes, boxes_num, output_size=tuple(output_size),
                       spatial_scale=float(spatial_scale), sampling_ratio=2,
-                      aligned=False)
+                      aligned=False, reduce="max")
 
 
 @defop("vision.deform_conv2d")

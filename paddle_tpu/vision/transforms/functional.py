@@ -177,8 +177,43 @@ def adjust_contrast(img, contrast_factor):
 
 
 def adjust_hue(img, hue_factor):
-    # lightweight approximation: channel roll proportional to hue shift
+    """Shift hue by hue_factor (in [-0.5, 0.5] turns) via RGB→HSV→RGB.
+
+    Reference: python/paddle/vision/transforms/functional_cv2.py adjust_hue
+    (cv2 HSV roundtrip); same math on float channels here."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError(f"hue_factor {hue_factor} is not in [-0.5, 0.5]")
     arr = _np(img)
     if abs(hue_factor) < 1e-6 or arr.ndim != 3 or arr.shape[2] < 3:
         return arr
-    return arr  # hue adjustment is a no-op approximation (parity: API accepted)
+    dtype = arr.dtype
+    x = arr.astype("float32")
+    scale = 255.0 if dtype == np.uint8 else 1.0
+    x = x / scale
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    maxc = np.max(x[..., :3], axis=-1)
+    minc = np.min(x[..., :3], axis=-1)
+    v = maxc
+    c = maxc - minc
+    s = np.where(maxc > 0, c / np.maximum(maxc, 1e-12), 0.0)
+    cc = np.maximum(c, 1e-12)
+    h = np.where(maxc == r, ((g - b) / cc) % 6.0,
+                 np.where(maxc == g, (b - r) / cc + 2.0, (r - g) / cc + 4.0))
+    h = np.where(c == 0, 0.0, h) / 6.0                      # hue in [0,1) turns
+    h = (h + hue_factor) % 1.0
+    # HSV → RGB
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * f)
+    t = v * (1.0 - s * (1.0 - f))
+    i = i.astype("int32") % 6
+    r2 = np.choose(i, [v, q, p, p, t, v])
+    g2 = np.choose(i, [t, v, v, q, p, p])
+    b2 = np.choose(i, [p, p, t, v, v, q])
+    out = np.stack([r2, g2, b2], axis=-1) * scale
+    if arr.shape[2] > 3:                                    # preserve alpha etc.
+        out = np.concatenate([out, arr[..., 3:].astype("float32")], axis=-1)
+    if dtype == np.uint8:
+        return out.round().clip(0, 255).astype(np.uint8)
+    return out.astype(dtype)

@@ -198,3 +198,65 @@ class TestFusedOps:
         ref = (s - s.mean(-1, keepdims=True)) / np.sqrt(
             s.var(-1, keepdims=True) + 1e-5)
         np.testing.assert_allclose(y.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+class TestFusedRopeSemantics:
+    """ADVICE round-1: fixed slots, v rotation, neox-flag pairing, 4-D tables.
+
+    Ground truth is the reference kernel (fused_rope_kernel.cu:188-190:
+    use_neox_rotary_style=True -> rotate_every_two, False -> rotate_half;
+    fused_rope_utils.h rotate_every_two loops over ALL provided q/k/v inputs)."""
+
+    def _qkv(self):
+        r = np.random.RandomState(0)
+        mk = lambda: paddle.to_tensor(r.randn(2, 8, 4, 16).astype("float32"))
+        return mk(), mk(), mk()
+
+    def test_slots_fixed_when_k_none(self):
+        from paddle_tpu.incubate.nn.functional import (
+            fused_rotary_position_embedding)
+
+        q, _, v = self._qkv()
+        oq, ok, ov = fused_rotary_position_embedding(q, None, v)
+        assert ok is None and ov is not None
+        # v is rotated too (position 0 = identity)
+        np.testing.assert_allclose(ov.numpy()[:, 0], v.numpy()[:, 0], rtol=1e-5)
+        assert not np.allclose(ov.numpy()[:, 1:], v.numpy()[:, 1:])
+
+    def test_styles_differ_and_half_matches_llama(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.incubate.nn.functional import (
+            fused_rotary_position_embedding)
+        from paddle_tpu.models.llama import _rope_cos_sin, apply_rotary_pos_emb
+
+        q, k, _ = self._qkv()
+        q_h, k_h, _ = fused_rotary_position_embedding(
+            q, k, use_neox_rotary_style=False)
+        q_i, _, _ = fused_rotary_position_embedding(
+            q, k, use_neox_rotary_style=True)
+        assert not np.allclose(q_h.numpy(), q_i.numpy())
+        cos, sin = _rope_cos_sin(8, 16, 10000.0, jnp.float32)
+        q2, k2 = apply_rotary_pos_emb(q.value, k.value, cos, sin)
+        np.testing.assert_allclose(q_h.numpy(), np.asarray(q2), rtol=1e-5,
+                                   atol=1e-5)
+        np.testing.assert_allclose(k_h.numpy(), np.asarray(k2), rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_4d_sin_cos_tables(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.incubate.nn.functional import (
+            fused_rotary_position_embedding)
+        from paddle_tpu.models.llama import _rope_cos_sin
+
+        q, k, _ = self._qkv()
+        cos, sin = _rope_cos_sin(8, 16, 10000.0, jnp.float32)
+        cos4 = paddle.to_tensor(np.asarray(cos)[None, :, None, :])
+        sin4 = paddle.to_tensor(np.asarray(sin)[None, :, None, :])
+        ref, _, _ = fused_rotary_position_embedding(
+            q, k, use_neox_rotary_style=False)
+        got, _, _ = fused_rotary_position_embedding(
+            q, k, sin=sin4, cos=cos4, use_neox_rotary_style=False)
+        np.testing.assert_allclose(got.numpy(), ref.numpy(), rtol=1e-5,
+                                   atol=1e-5)

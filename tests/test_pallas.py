@@ -118,3 +118,15 @@ class TestTruncNormTail:
 
         arr = np.asarray(TruncatedNormal(a=6.0, b=7.0)((8, 8)))
         assert ((arr >= 6.0) & (arr <= 7.0)).all()
+
+
+def test_causal_sq_gt_sk_rejected():
+    """ADVICE round-1: rows attending to nothing would produce garbage grads."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention_fwd
+
+    q = jnp.zeros((1, 8, 2, 16))
+    kv = jnp.zeros((1, 4, 2, 16))
+    with pytest.raises(ValueError, match="Sq<=Sk"):
+        flash_attention_fwd(q, kv, kv, causal=True)

@@ -274,3 +274,55 @@ class TestReviewRegressions:
         model.fit(_TinyDs(n=20), epochs=1, batch_size=8, verbose=0,
                   drop_last=True, callbacks=[Spy()])
         assert len(seen) == 2  # 20 // 8, ragged batch dropped
+
+
+class TestAdviceFixes:
+    """Regressions for round-1 advisor findings (ADVICE.md)."""
+
+    def test_roi_pool_is_max_not_mean(self):
+        x = paddle.to_tensor(np.random.RandomState(0).randn(1, 2, 16, 16)
+                             .astype("float32"))
+        boxes = paddle.to_tensor(np.array([[0, 0, 8, 8]], "float32"))
+        mean_out = paddle.vision.ops.roi_align(x, boxes, output_size=2,
+                                               sampling_ratio=2, aligned=False)
+        max_out = paddle.vision.ops.roi_pool(x, boxes, output_size=2)
+        assert max_out.shape == [1, 2, 2, 2]
+        # max over the same sample grid dominates the mean everywhere
+        assert (max_out.numpy() >= mean_out.numpy() - 1e-5).all()
+        assert not np.allclose(max_out.numpy(), mean_out.numpy())
+
+    def test_adjust_hue_shifts_colors(self):
+        from paddle_tpu.vision.transforms import functional as VF
+
+        img = (np.random.RandomState(0).rand(4, 4, 3) * 255).astype(np.uint8)
+        assert np.array_equal(VF.adjust_hue(img, 0.0), img)
+        assert not np.array_equal(VF.adjust_hue(img, 0.5), img)
+        red = np.zeros((1, 1, 3), np.uint8)
+        red[..., 0] = 255
+        green = VF.adjust_hue(red, 1.0 / 3.0)
+        assert green[0, 0, 1] == 255 and green[0, 0, 0] == 0
+
+    def test_adjust_hue_rejects_out_of_range(self):
+        from paddle_tpu.vision.transforms import functional as VF
+
+        try:
+            VF.adjust_hue(np.zeros((2, 2, 3), np.uint8), 0.7)
+            raise AssertionError("expected ValueError")
+        except ValueError:
+            pass
+
+    def test_early_stopping_baseline_and_best_model(self, tmp_path):
+        paddle.seed(0)
+        model = paddle.Model(_TinyNet())
+        model.prepare(optimizer=paddle.optimizer.Adam(
+            learning_rate=0.0, parameters=model.parameters()),
+            loss=paddle.nn.CrossEntropyLoss(), metrics=Accuracy())
+        # baseline no run will ever beat -> stops after patience evals
+        es = paddle.hapi.EarlyStopping(monitor="acc", mode="max", patience=1,
+                                       verbose=0, baseline=2.0,
+                                       save_best_model=True)
+        ds = _TinyDs()
+        model.fit(ds, eval_data=ds, epochs=5, batch_size=8, verbose=0,
+                  save_dir=str(tmp_path), callbacks=[es])
+        assert model.stop_training
+        assert es.best == 2.0  # baseline never beaten
