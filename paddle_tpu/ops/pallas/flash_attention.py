@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-_NEG_INF = -1e30
+_NEG_INF = np.float32(-1e30)
 
 
 def _i32(x):
@@ -86,9 +86,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     acc0 = jnp.zeros((block_q, d), jnp.float32)
     m, l, acc = jax.lax.fori_loop(_i32(0), _i32(hi), body, (m0, l0, acc0))
 
-    l_safe = jnp.maximum(l, 1e-30)
+    l_safe = jnp.maximum(l, np.float32(1e-30))
     o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0, 0, :, 0] = m + jnp.log(l_safe)
+    # full 2-D store: a scalar-indexed lse_ref[0,0,:,0] store lowers through a
+    # strided-store path that infinitely recurses in Mosaic (i64->i32 convert)
+    lse_ref[0, 0] = (m + jnp.log(l_safe))[:, None]
 
 
 def _fwd(q, k, v, scale, causal, block_q, block_k):
@@ -105,13 +107,13 @@ def _fwd(q, k, v, scale, causal, block_q, block_k):
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, Sk, D), lambda b, h, i, rep=rep: (b, h // rep, 0, 0)),
-            pl.BlockSpec((1, 1, Sk, D), lambda b, h, i, rep=rep: (b, h // rep, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, np.int32(0))),
+            pl.BlockSpec((1, 1, Sk, D), lambda b, h, i, rep=rep: (b, jax.lax.div(h, np.int32(rep)), np.int32(0), np.int32(0))),
+            pl.BlockSpec((1, 1, Sk, D), lambda b, h, i, rep=rep: (b, jax.lax.div(h, np.int32(rep)), np.int32(0), np.int32(0))),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, np.int32(0))),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, np.int32(0))),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, Hq, Sq, D), q.dtype),
@@ -130,8 +132,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     qi = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32)
     do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0, :, 0]                             # (Bq,)
-    delta = delta_ref[0, 0, :, 0]                         # (Bq,)
+    lse = lse_ref[0, 0][:, 0]                             # (Bq,)
+    delta = delta_ref[0, 0][:, 0]                         # (Bq,)
     d = q.shape[-1]
 
     num_kv = seq_k // block_k
@@ -187,8 +189,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         q = q_ref[0, 0, pl.ds(jax.lax.mul(i, _i32(block_q)), block_q), :].astype(jnp.float32)
         do = do_ref[0, 0, pl.ds(jax.lax.mul(i, _i32(block_q)), block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(jax.lax.mul(i, _i32(block_q)), block_q), 0]
-        delta = delta_ref[0, 0, pl.ds(jax.lax.mul(i, _i32(block_q)), block_q), 0]
+        lse = lse_ref[0, 0, pl.ds(jax.lax.mul(i, _i32(block_q)), block_q), :][:, 0]
+        delta = delta_ref[0, 0, pl.ds(jax.lax.mul(i, _i32(block_q)), block_q), :][:, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
@@ -230,14 +232,14 @@ def _bwd(scale, causal, block_q, block_k, res, g):
                           causal_offset=Sk - Sq),
         grid=(B, Hq, Sq // block_q),
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, Sk, D), lambda b, h, i, rep=rep: (b, h // rep, 0, 0)),
-            pl.BlockSpec((1, 1, Sk, D), lambda b, h, i, rep=rep: (b, h // rep, 0, 0)),
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, np.int32(0))),
+            pl.BlockSpec((1, 1, Sk, D), lambda b, h, i, rep=rep: (b, jax.lax.div(h, np.int32(rep)), np.int32(0), np.int32(0))),
+            pl.BlockSpec((1, 1, Sk, D), lambda b, h, i, rep=rep: (b, jax.lax.div(h, np.int32(rep)), np.int32(0), np.int32(0))),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, np.int32(0))),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, np.int32(0))),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, np.int32(0))),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
+        out_specs=pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, np.int32(0))),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)
@@ -249,16 +251,16 @@ def _bwd(scale, causal, block_q, block_k, res, g):
                           causal_offset=Sk - Sq),
         grid=(B, Hq, Sk // block_k),
         in_specs=[
-            pl.BlockSpec((1, 1, Sq, D), lambda b, h, i: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, rep=rep: (b, h // rep, i, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, rep=rep: (b, h // rep, i, 0)),
-            pl.BlockSpec((1, 1, Sq, D), lambda b, h, i: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, Sq, 1), lambda b, h, i: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, Sq, 1), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, Sq, D), lambda b, h, i: (b, h, np.int32(0), np.int32(0))),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, rep=rep: (b, jax.lax.div(h, np.int32(rep)), i, np.int32(0))),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, rep=rep: (b, jax.lax.div(h, np.int32(rep)), i, np.int32(0))),
+            pl.BlockSpec((1, 1, Sq, D), lambda b, h, i: (b, h, np.int32(0), np.int32(0))),
+            pl.BlockSpec((1, 1, Sq, 1), lambda b, h, i: (b, h, np.int32(0), np.int32(0))),
+            pl.BlockSpec((1, 1, Sq, 1), lambda b, h, i: (b, h, np.int32(0), np.int32(0))),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i: (b, h, i, np.int32(0))),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i: (b, h, i, np.int32(0))),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, Hq, Sk, D), k.dtype),
@@ -316,9 +318,9 @@ def flash_attention_fwd(q, k, v, causal=False, scale=None,
         # rows past Sk attend to nothing: forward would emit zeros and the
         # p=exp(s-lse) trick in the dk/dv kernel would add exp(0)=1 garbage terms
         raise ValueError(f"causal flash attention requires Sq<=Sk, got ({Sq},{Sk})")
-    s = scale if scale is not None else 1.0 / np.sqrt(D)
+    s = np.float32(scale if scale is not None else 1.0 / np.sqrt(D))
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    out = _flash(qt, kt, vt, float(s), bool(causal), block_q, block_k)
+    out = _flash(qt, kt, vt, s, bool(causal), block_q, block_k)
     return jnp.swapaxes(out, 1, 2)
