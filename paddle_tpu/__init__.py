@@ -20,6 +20,14 @@ _jax.config.update("jax_enable_x64", True)
 if _os.environ.get("PADDLE_TPU_PLATFORM"):
     _jax.config.update("jax_platforms", _os.environ["PADDLE_TPU_PLATFORM"])
 
+# Multi-process bootstrap MUST precede any XLA backend touch (jax.distributed's
+# contract), and importing the op library below initializes the backend — so when
+# the launcher's env contract marks a multi-process run, rendezvous now.
+from ._bootstrap import early_init_distributed as _early_init  # noqa: E402
+
+_early_init()  # no-op unless the env marks a multi-process run
+del _early_init
+
 from .framework import dtype as _dtype_mod  # noqa: E402
 from .framework.dtype import (  # noqa: F401,E402
     bfloat16, bool_, complex64, complex128, float16, float32, float64, get_default_dtype,

@@ -55,8 +55,10 @@ from .parallel import (  # noqa: F401
     init_parallel_env,
     is_initialized,
 )
+from .store import TCPStore, create_or_get_global_tcp_store  # noqa: F401
 from . import in_jit  # noqa: F401
 from . import fleet  # noqa: F401
+from . import launch  # noqa: F401
 from .fleet.mpu.mp_ops import split  # noqa: F401
 
 

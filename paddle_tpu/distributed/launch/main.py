@@ -1,0 +1,180 @@
+"""Process launcher: `python -m paddle_tpu.distributed.launch`.
+
+Reference analog: python/paddle/distributed/launch/main.py:23 (controller build,
+pod/容器 model) with the flag surface of launch/context/args_envs.py:59-230
+(--master, --nnodes, --nproc_per_node, --rank, --devices, --log_dir, --job_id,
+elastic --max_restart).
+
+TPU-first shape: on TPU pods the natural unit is ONE process per worker VM (each
+process owns that host's chips through PJRT), so `--nproc_per_node` defaults to 1
+there; on CPU it spawns N virtual-device processes for tests. The launcher:
+
+1. picks/validates the master endpoint (rank 0 hosts the TCPStore),
+2. spawns `nproc_per_node` child processes with the reference's env contract
+   (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_LOCAL_RANK / PADDLE_MASTER /
+   PADDLE_NNODES / PADDLE_RANK_IN_NODE),
+3. tees each rank's output to --log_dir/workerlog.N,
+4. watches children: first failure tears the pod down (reference
+   launch/controllers/controller.py watch loop); --max_restart>0 relaunches the
+   pod on failure, the elastic manager's restart semantic.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="launch distributed training (reference launch/main.py)")
+    p.add_argument("--master", default=None,
+                   help="rendezvous endpoint ip:port; rank 0 hosts the store")
+    p.add_argument("--nnodes", type=int, default=1, help="number of nodes")
+    p.add_argument("--rank", type=int, default=0, help="this node's rank")
+    p.add_argument("--nproc_per_node", type=int, default=None,
+                   help="processes on this node (default: 1, the per-host model)")
+    p.add_argument("--devices", default=None,
+                   help="visible device ids for this node (informational on TPU)")
+    p.add_argument("--job_id", default="default", help="job name for logs")
+    p.add_argument("--log_dir", default=None, help="directory for per-rank logs")
+    p.add_argument("--log_level", default="INFO")
+    p.add_argument("--run_mode", default="collective",
+                   choices=["collective", "ps"],
+                   help="ps mode is not supported by the TPU build")
+    p.add_argument("--max_restart", type=int, default=0,
+                   help="relaunch the pod up to N times on failure (elastic)")
+    p.add_argument("training_script", help="script or module to run")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p
+
+
+def _spawn(args, master, base_env):
+    nproc = args.nproc_per_node or 1
+    procs = []
+    logs = []
+    for local_rank in range(nproc):
+        global_rank = args.rank * nproc + local_rank
+        env = dict(base_env)
+        env.update({
+            "PADDLE_MASTER": master,
+            "MASTER_ADDR": master.rsplit(":", 1)[0],
+            "MASTER_PORT": master.rsplit(":", 1)[1],
+            "PADDLE_NNODES": str(args.nnodes),
+            "PADDLE_TRAINER_ID": str(global_rank),
+            "PADDLE_TRAINERS_NUM": str(args.nnodes * nproc),
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "PADDLE_RANK_IN_NODE": str(local_rank),
+            "PADDLE_JOB_ID": args.job_id,
+        })
+        if args.devices is not None:
+            env["PADDLE_DEVICES"] = args.devices
+        # run as a file when it exists on disk; only fall back to module form
+        # (python -m) for a dotted name with no file behind it
+        if os.path.exists(args.training_script):
+            cmd = [sys.executable, "-u", args.training_script,
+                   *args.training_script_args]
+        elif not args.training_script.endswith(".py"):
+            cmd = [sys.executable, "-u", "-m", args.training_script,
+                   *args.training_script_args]
+        else:
+            raise FileNotFoundError(
+                f"training script {args.training_script!r} does not exist")
+        if args.log_dir:
+            os.makedirs(args.log_dir, exist_ok=True)
+            log_path = os.path.join(args.log_dir, f"workerlog.{global_rank}")
+            log_f = open(log_path, "w")
+            logs.append(log_f)
+            proc = subprocess.Popen(cmd, env=env, stdout=log_f,
+                                    stderr=subprocess.STDOUT)
+        else:
+            proc = subprocess.Popen(cmd, env=env)
+        procs.append(proc)
+    return procs, logs
+
+
+def _watch(procs):
+    """Wait for children; on first failure kill the rest (controller.py watch)."""
+    try:
+        while True:
+            alive = False
+            for p in procs:
+                rc = p.poll()
+                if rc is None:
+                    alive = True
+                elif rc != 0:
+                    for q in procs:
+                        if q.poll() is None:
+                            q.terminate()
+                    deadline = time.time() + 10
+                    for q in procs:
+                        try:
+                            q.wait(max(0.1, deadline - time.time()))
+                        except subprocess.TimeoutExpired:
+                            q.kill()
+                    return rc
+            if not alive:
+                return 0
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        for q in procs:
+            if q.poll() is None:
+                q.send_signal(signal.SIGINT)
+        for q in procs:
+            q.wait()
+        return 130
+
+
+def launch(argv=None):
+    args = build_parser().parse_args(argv)
+    if args.run_mode == "ps":
+        raise NotImplementedError(
+            "parameter-server mode is not part of the TPU build (SURVEY §2.6); "
+            "use collective mode")
+    master = args.master
+    if master is None:
+        if args.nnodes > 1:
+            raise ValueError("--master ip:port is required when nnodes > 1")
+        master = f"127.0.0.1:{_free_port()}"
+    elif ":" not in master:
+        if args.nnodes > 1:
+            # a per-node random port would rendezvous each node at a different
+            # endpoint; all nodes must agree on the full address
+            raise ValueError(
+                f"--master {master!r} needs an explicit port when nnodes > 1 "
+                "(e.g. --master 10.0.0.1:6170)")
+        master = f"{master}:{_free_port()}"
+
+    base_env = dict(os.environ)
+    attempt = 0
+    while True:
+        procs, logs = _spawn(args, master, base_env)
+        rc = _watch(procs)
+        for f in logs:
+            f.close()
+        if rc == 0 or attempt >= args.max_restart:
+            return rc
+        attempt += 1
+        print(f"[launch] pod failed rc={rc}; restart {attempt}/{args.max_restart}",
+              file=sys.stderr)
+
+
+def main():
+    sys.exit(launch())
+
+
+if __name__ == "__main__":
+    main()

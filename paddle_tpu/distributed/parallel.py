@@ -39,11 +39,11 @@ class ParallelEnv:
 
     @property
     def local_rank(self):
-        return get_rank()
+        return int(os.environ.get("PADDLE_LOCAL_RANK", get_rank()))
 
     @property
     def dev_id(self):
-        return 0
+        return self.local_rank
 
     @property
     def nranks(self):
@@ -51,19 +51,22 @@ class ParallelEnv:
 
 
 def init_parallel_env():
-    """Bootstrap the distributed runtime (parallel.py:978 analog)."""
+    """Bootstrap the distributed runtime (reference parallel.py:978 init_parallel_env).
+
+    Reference flow: TCPStore rendezvous (parallel.py:1134) then ProcessGroupNCCL
+    creation. Here: TCPStore rendezvous (our stdlib store) exchanges the JAX
+    coordinator address, then `jax.distributed.initialize` brings up the
+    coordination service — after which every compiled program sees the global
+    (multi-host) device set and XLA emits cross-host collectives itself; no
+    per-process-group comm objects are needed.
+    """
     if _INITIALIZED[0]:
         return ParallelEnv()
-    coord = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR")
-    nnodes = int(os.environ.get("PADDLE_NNODES", "1"))
-    if coord and nnodes > 1 and jax.process_count() == 1:
-        port = os.environ.get("MASTER_PORT", "8476")
-        addr = coord if ":" in coord else f"{coord}:{port}"
-        jax.distributed.initialize(
-            coordinator_address=addr,
-            num_processes=nnodes,
-            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")),
-        )
+    # normally already done by paddle_tpu/__init__ (must precede backend init);
+    # idempotent for direct callers in single-process runs
+    from .._bootstrap import early_init_distributed
+
+    early_init_distributed()
     _INITIALIZED[0] = True
     return ParallelEnv()
 

@@ -1,0 +1,279 @@
+"""TCPStore: the bootstrap key-value store for multi-process rendezvous.
+
+Reference analog: paddle/phi/core/distributed/store/tcp_store.cc (master/client KV
+with blocking waits and counter-barriers; pybind at fluid/pybind/communication.cc:124).
+
+TPU-first note: the *collectives* never go through this store — they ride XLA's
+ICI/DCN collectives inside compiled programs. The store exists for what sits around
+them: rank rendezvous before `jax.distributed.initialize`, exchanging the
+coordinator address, cross-process barriers in tests and the launcher's health
+bookkeeping. Implementation is a small length-prefixed binary protocol over TCP
+(master holds a dict; clients block on waits), stdlib-only.
+
+Protocol: 1-byte command, then length-prefixed key/value byte strings.
+Commands: SET, GET (blocking), ADD (atomic add, returns new value), WAIT (block
+until key exists), DELETE, NUM_KEYS.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+import time
+
+_CMD_SET = 0
+_CMD_GET = 1
+_CMD_ADD = 2
+_CMD_WAIT = 3
+_CMD_DELETE = 4
+_CMD_NUM_KEYS = 5
+
+
+def _send_bytes(sock, data: bytes):
+    sock.sendall(struct.pack("<I", len(data)) + data)
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("TCPStore peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_bytes(sock) -> bytes:
+    (n,) = struct.unpack("<I", _recv_exact(sock, 4))
+    return _recv_exact(sock, n)
+
+
+class _MasterDaemon(threading.Thread):
+    """Serves the KV dict; one handler thread per client connection."""
+
+    def __init__(self, host, port):
+        super().__init__(daemon=True)
+        self._kv = {}
+        self._cv = threading.Condition()
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind((host, port))
+        self._server.listen(128)
+        self.port = self._server.getsockname()[1]
+        self._stop = False
+
+    def run(self):
+        while not self._stop:
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            while True:
+                cmd = _recv_exact(conn, 1)[0]
+                if cmd == _CMD_SET:
+                    key = _recv_bytes(conn)
+                    val = _recv_bytes(conn)
+                    with self._cv:
+                        self._kv[key] = val
+                        self._cv.notify_all()
+                    _send_bytes(conn, b"ok")
+                elif cmd in (_CMD_GET, _CMD_WAIT):
+                    key = _recv_bytes(conn)
+                    (timeout_ms,) = struct.unpack("<I", _recv_exact(conn, 4))
+                    deadline = time.monotonic() + timeout_ms / 1000.0
+                    with self._cv:
+                        while key not in self._kv:
+                            remaining = deadline - time.monotonic()
+                            if remaining <= 0:
+                                break
+                            self._cv.wait(remaining)
+                        if key not in self._kv:
+                            conn.sendall(b"\x01")
+                        else:
+                            conn.sendall(b"\x00")
+                            _send_bytes(
+                                conn,
+                                b"" if cmd == _CMD_WAIT else self._kv[key])
+                elif cmd == _CMD_ADD:
+                    key = _recv_bytes(conn)
+                    (delta,) = struct.unpack("<q", _recv_exact(conn, 8))
+                    with self._cv:
+                        cur = int(self._kv.get(key, b"0"))
+                        cur += delta
+                        self._kv[key] = str(cur).encode()
+                        self._cv.notify_all()
+                    conn.sendall(struct.pack("<q", cur))
+                elif cmd == _CMD_DELETE:
+                    key = _recv_bytes(conn)
+                    with self._cv:
+                        existed = self._kv.pop(key, None) is not None
+                    conn.sendall(b"\x01" if existed else b"\x00")
+                elif cmd == _CMD_NUM_KEYS:
+                    with self._cv:
+                        n = len(self._kv)
+                    conn.sendall(struct.pack("<q", n))
+                else:
+                    raise ValueError(f"bad TCPStore command {cmd}")
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def shutdown(self):
+        self._stop = True
+        try:
+            self._server.close()
+        except OSError:
+            pass
+
+
+class TCPStore:
+    """Client (and optionally host) of the rendezvous KV store.
+
+    Matches the reference constructor shape (tcp_store.cc / communication.cc:124):
+    ``TCPStore(host, port, is_master, world_size, timeout)``.
+    """
+
+    def __init__(self, host="127.0.0.1", port=0, is_master=False, world_size=1,
+                 timeout=900):
+        self.host = host
+        self.is_master = is_master
+        self.world_size = world_size
+        self.timeout = timeout
+        self._daemon = None
+        if is_master:
+            self._daemon = _MasterDaemon(host if host else "0.0.0.0", port)
+            self._daemon.start()
+            port = self._daemon.port
+        self.port = port
+        self._sock = None
+        self._lock = threading.Lock()
+        self._connect()
+
+    def _connect(self):
+        deadline = time.monotonic() + self.timeout
+        last_err = None
+        while time.monotonic() < deadline:
+            try:
+                self._sock = socket.create_connection((self.host, self.port),
+                                                      timeout=self.timeout)
+                self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                return
+            except OSError as e:  # master not up yet
+                last_err = e
+                time.sleep(0.05)
+        raise ConnectionError(
+            f"TCPStore could not reach master at {self.host}:{self.port}: {last_err}")
+
+    # -- KV API (reference: Store::set/get/add/wait) -------------------------
+    def set(self, key: str, value):
+        if isinstance(value, str):
+            value = value.encode()
+        with self._lock:
+            self._sock.sendall(bytes([_CMD_SET]))
+            _send_bytes(self._sock, key.encode())
+            _send_bytes(self._sock, bytes(value))
+            _recv_bytes(self._sock)
+
+    def _blocking_request(self, cmd, key, timeout):
+        """GET/WAIT block server-side until the key exists; run them on their own
+        connection so a waiting thread doesn't hold the shared socket's lock and
+        deadlock a concurrent set() from another thread of this process."""
+        t = int((timeout if timeout is not None else self.timeout) * 1000)
+        sock = socket.create_connection((self.host, self.port),
+                                        timeout=max(t / 1000.0 + 5.0, 10.0))
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.sendall(bytes([cmd]))
+            _send_bytes(sock, key.encode())
+            sock.sendall(struct.pack("<I", t))
+            status = _recv_exact(sock, 1)
+            if status == b"\x01":
+                op = "get" if cmd == _CMD_GET else "wait"
+                raise TimeoutError(f"TCPStore.{op}({key!r}) timed out")
+            return _recv_bytes(sock)
+        finally:
+            sock.close()
+
+    def get(self, key: str, timeout=None) -> bytes:
+        return self._blocking_request(_CMD_GET, key, timeout)
+
+    def add(self, key: str, delta: int) -> int:
+        with self._lock:
+            self._sock.sendall(bytes([_CMD_ADD]))
+            _send_bytes(self._sock, key.encode())
+            self._sock.sendall(struct.pack("<q", int(delta)))
+            (val,) = struct.unpack("<q", _recv_exact(self._sock, 8))
+            return val
+
+    def wait(self, key: str, timeout=None):
+        self._blocking_request(_CMD_WAIT, key, timeout)
+
+    def delete_key(self, key: str) -> bool:
+        with self._lock:
+            self._sock.sendall(bytes([_CMD_DELETE]))
+            _send_bytes(self._sock, key.encode())
+            return _recv_exact(self._sock, 1) == b"\x01"
+
+    def num_keys(self) -> int:
+        with self._lock:
+            self._sock.sendall(bytes([_CMD_NUM_KEYS]))
+            (val,) = struct.unpack("<q", _recv_exact(self._sock, 8))
+            return val
+
+    def barrier(self, name="_barrier", timeout=None):
+        """Counter barrier over all world_size participants."""
+        arrived = self.add(f"{name}/count", 1)
+        round_key = f"{name}/release/{(arrived - 1) // self.world_size}"
+        if arrived % self.world_size == 0:
+            self.set(round_key, b"1")
+        self.wait(round_key, timeout=timeout)
+
+    def shutdown(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        if self._daemon is not None:
+            self._daemon.shutdown()
+            self._daemon = None
+
+
+_GLOBAL_STORE = [None]
+
+
+def create_or_get_global_tcp_store():
+    """Build the process-global store from launcher env vars
+    (reference: parallel.py:1134 core.create_or_get_global_tcp_store)."""
+    if _GLOBAL_STORE[0] is not None:
+        return _GLOBAL_STORE[0]
+    # the early bootstrap (paddle_tpu._bootstrap) may already hold the store —
+    # it loads this file as a shadow module before the package is importable,
+    # and a second master would fail to bind the listening rendezvous port
+    try:
+        from paddle_tpu._bootstrap import _STORE
+
+        if _STORE[0] is not None:
+            _GLOBAL_STORE[0] = _STORE[0]
+            return _GLOBAL_STORE[0]
+    except ImportError:
+        pass
+    master = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR", "127.0.0.1")
+    if ":" in master:
+        host, port = master.rsplit(":", 1)
+        port = int(port)
+    else:
+        host, port = master, int(os.environ.get("MASTER_PORT", "6170"))
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    _GLOBAL_STORE[0] = TCPStore(host, port, is_master=(rank == 0),
+                                world_size=world,
+                                timeout=float(os.environ.get("PADDLE_STORE_TIMEOUT", "900")))
+    return _GLOBAL_STORE[0]

@@ -1,0 +1,237 @@
+"""Multi-process runtime: TCPStore rendezvous + launch CLI + 2-process DP training.
+
+Mirrors the reference's distributed test strategy (SURVEY §4 harness B/C: spawn real
+OS subprocesses on one host, compare losses across ranks — test_dist_base.py:957,
+test_parallel_dygraph_dataparallel.py:30)."""
+import os
+import socket
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class TestTCPStore:
+    def test_set_get_add(self):
+        from paddle_tpu.distributed.store import TCPStore
+
+        master = TCPStore("127.0.0.1", 0, is_master=True, world_size=2, timeout=10)
+        client = TCPStore("127.0.0.1", master.port, is_master=False,
+                          world_size=2, timeout=10)
+        master.set("alpha", b"1")
+        assert client.get("alpha") == b"1"
+        assert client.add("ctr", 2) == 2
+        assert master.add("ctr", 3) == 5
+        assert master.num_keys() == 2
+        assert client.delete_key("alpha")
+        with pytest.raises(TimeoutError):
+            client.get("alpha", timeout=0.2)
+        client.shutdown()
+        master.shutdown()
+
+    def test_wait_blocks_until_set(self):
+        from paddle_tpu.distributed.store import TCPStore
+
+        master = TCPStore("127.0.0.1", 0, is_master=True, world_size=1, timeout=10)
+        client = TCPStore("127.0.0.1", master.port, is_master=False,
+                          world_size=1, timeout=10)
+        seen = []
+
+        def waiter():
+            client.wait("late-key", timeout=10)
+            seen.append(client.get("late-key"))
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        master.set("late-key", b"payload")
+        t.join(timeout=10)
+        assert seen == [b"payload"]
+        client.shutdown()
+        master.shutdown()
+
+    def test_barrier(self):
+        from paddle_tpu.distributed.store import TCPStore
+
+        master = TCPStore("127.0.0.1", 0, is_master=True, world_size=3, timeout=10)
+        clients = [TCPStore("127.0.0.1", master.port, world_size=3, timeout=10)
+                   for _ in range(2)]
+        done = []
+
+        def arrive(st, idx):
+            st.barrier("b0", timeout=10)
+            done.append(idx)
+
+        ts = [threading.Thread(target=arrive, args=(st, i))
+              for i, st in enumerate(clients)]
+        for t in ts:
+            t.start()
+        assert not done  # two of three arrived; barrier must still hold
+        master.barrier("b0", timeout=10)
+        for t in ts:
+            t.join(timeout=10)
+        assert sorted(done) == [0, 1]
+        for st in clients:
+            st.shutdown()
+        master.shutdown()
+
+
+_TRAINER = """
+import os, sys
+import numpy as np
+import paddle_tpu as paddle  # noqa: F401  (configures platform, x64)
+import paddle_tpu.distributed as dist
+
+env = dist.init_parallel_env()
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 8, jax.device_count()
+
+mesh = Mesh(np.array(jax.devices()), ("dp",))
+rng = np.random.RandomState(0)
+X = rng.randn(32, 4).astype("float32")
+W_true = np.array([[1.0], [-2.0], [0.5], [3.0]], "float32")
+Y = X @ W_true
+
+rows = NamedSharding(mesh, P("dp"))
+rep = NamedSharding(mesh, P())
+rank = jax.process_index()
+# each process contributes its local half of the global batch
+local = slice(rank * 16, (rank + 1) * 16)
+Xg = jax.make_array_from_process_local_data(rows, X[local], X.shape)
+Yg = jax.make_array_from_process_local_data(rows, Y[local], Y.shape)
+
+def step(w, x, y):
+    def loss_fn(w):
+        return jnp.mean((x @ w - y) ** 2)
+    loss, g = jax.value_and_grad(loss_fn)(w)
+    return w - 0.1 * g, loss
+
+step_c = jax.jit(step, in_shardings=(rep, rows, rows), out_shardings=(rep, rep))
+w = jax.device_put(jnp.zeros((4, 1)), rep)
+for i in range(60):
+    w, loss = step_c(w, Xg, Yg)
+    # serialize dispatches: deep pipelines of cross-process gloo collectives can
+    # deadlock on the single-host CPU transport; real TPU steps sync on the loss too
+    jax.block_until_ready(loss)
+print(f"FINAL_LOSS={float(loss):.10f}", flush=True)
+"""
+
+
+@pytest.mark.timeout(300)
+def test_launch_two_process_dp_training(tmp_path):
+    """Launcher spawns 2 OS processes; both rendezvous via TCPStore, initialize
+    jax.distributed over CPU (4 virtual devices each -> 8 global), and run a
+    compiled DP training step whose loss must match bit-for-bit across ranks."""
+    script = tmp_path / "trainer.py"
+    script.write_text(_TRAINER)
+    log_dir = tmp_path / "logs"
+    env = dict(os.environ)
+    env["PADDLE_TPU_PLATFORM"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env.pop("JAX_PLATFORMS", None)
+    port = _free_port()
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--master", f"127.0.0.1:{port}", "--nproc_per_node", "2",
+         "--log_dir", str(log_dir), str(script)],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=280)
+    logs = {}
+    for i in range(2):
+        path = log_dir / f"workerlog.{i}"
+        logs[i] = path.read_text() if path.exists() else "<missing>"
+    assert proc.returncode == 0, f"launcher rc={proc.returncode}\n" \
+        f"stdout={proc.stdout}\nstderr={proc.stderr}\nlogs={logs}"
+    losses = []
+    for i in range(2):
+        lines = [ln for ln in logs[i].splitlines() if ln.startswith("FINAL_LOSS=")]
+        assert lines, f"rank {i} produced no loss:\n{logs[i]}"
+        losses.append(float(lines[-1].split("=")[1]))
+    assert losses[0] == losses[1]
+    assert losses[0] < 1e-3  # converged
+
+
+def test_launch_parser_flags():
+    from paddle_tpu.distributed.launch import build_parser
+
+    args = build_parser().parse_args(
+        ["--master", "10.0.0.1:6170", "--nnodes", "2", "--rank", "1",
+         "--nproc_per_node", "4", "--log_dir", "/tmp/x", "--max_restart", "3",
+         "train.py", "--lr", "0.1"])
+    assert args.master == "10.0.0.1:6170"
+    assert args.nnodes == 2 and args.rank == 1 and args.nproc_per_node == 4
+    assert args.training_script == "train.py"
+    assert args.training_script_args == ["--lr", "0.1"]
+
+
+def test_launch_ps_mode_rejected():
+    from paddle_tpu.distributed.launch import launch
+
+    with pytest.raises(NotImplementedError):
+        launch(["--run_mode", "ps", "x.py"])
+
+
+class TestReviewFixes:
+    """Regressions for the round-2 review of the multi-process runtime."""
+
+    def test_same_store_concurrent_wait_and_set(self):
+        # a thread blocked in wait() must not hold the socket lock that set() needs
+        from paddle_tpu.distributed.store import TCPStore
+
+        master = TCPStore("127.0.0.1", 0, is_master=True, world_size=1, timeout=10)
+        got = []
+
+        def waiter():
+            got.append(master.get("self-release", timeout=10))
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        import time
+        time.sleep(0.2)
+        master.set("self-release", b"v")  # same object as the waiter uses
+        t.join(timeout=10)
+        assert got == [b"v"]
+        master.shutdown()
+
+    def test_portless_master_rejected_multinode(self):
+        from paddle_tpu.distributed.launch import launch
+
+        with pytest.raises(ValueError, match="explicit port"):
+            launch(["--master", "10.0.0.1", "--nnodes", "2", "x.py"])
+
+    def test_missing_script_rejected(self, tmp_path):
+        from paddle_tpu.distributed.launch import launch
+
+        with pytest.raises(FileNotFoundError):
+            launch(["--nproc_per_node", "1", str(tmp_path / "nope.py")])
+
+    def test_global_store_shared_with_bootstrap(self):
+        # create_or_get_global_tcp_store must return the bootstrap's instance
+        # instead of binding a second master on the same port
+        import paddle_tpu._bootstrap as bs
+        from paddle_tpu.distributed import store as store_mod
+
+        sentinel = object()
+        old_bs, old_global = bs._STORE[0], store_mod._GLOBAL_STORE[0]
+        bs._STORE[0] = sentinel
+        store_mod._GLOBAL_STORE[0] = None
+        try:
+            assert store_mod.create_or_get_global_tcp_store() is sentinel
+        finally:
+            bs._STORE[0] = old_bs
+            store_mod._GLOBAL_STORE[0] = old_global
