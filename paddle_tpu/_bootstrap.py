@@ -28,6 +28,13 @@ def early_init_distributed():
     if world <= 1:
         _DONE[0] = True
         return
+    # normalize the env so every consumer (store bootstrap, ParallelEnv) sees one
+    # consistent contract, whichever launcher set it (ours: PADDLE_TRAINERS_NUM/
+    # PADDLE_TRAINER_ID; external SLURM/mpirun-style: MASTER_ADDR+PADDLE_NNODES
+    # with PADDLE_TRAINER_ID or RANK holding the process rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(world)
+    os.environ.setdefault(
+        "PADDLE_TRAINER_ID", os.environ.get("RANK", "0"))
     # load store.py by path: importing paddle_tpu.distributed (the package) pulls
     # in modules that may touch the backend, which must not happen yet
     import importlib.util
@@ -70,9 +77,16 @@ def is_bootstrapped():
 
 def _world_size_from_env():
     """Launcher contract (PADDLE_TRAINERS_NUM) with fallback to the external
-    SLURM/mpirun-style contract (MASTER_ADDR + PADDLE_NNODES, one proc/node)."""
+    SLURM/mpirun-style contract (MASTER_ADDR + PADDLE_NNODES, one proc/node,
+    rank in PADDLE_TRAINER_ID or RANK)."""
     if "PADDLE_TRAINERS_NUM" in os.environ:
         return int(os.environ["PADDLE_TRAINERS_NUM"])
     if os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR"):
-        return int(os.environ.get("PADDLE_NNODES", "1"))
+        nnodes = int(os.environ.get("PADDLE_NNODES", "1"))
+        if nnodes > 1 and ("PADDLE_TRAINER_ID" not in os.environ
+                           and "RANK" not in os.environ):
+            raise RuntimeError(
+                "multi-node env detected (MASTER_ADDR + PADDLE_NNODES>1) but no "
+                "rank variable: set PADDLE_TRAINER_ID or RANK per process")
+        return nnodes
     return 1

@@ -1,0 +1,13 @@
+"""paddle.distributed.checkpoint: flat-shard distributed checkpoint format.
+
+Reference analog: python/paddle/distributed/checkpoint/ (metadata.py:41 global
+offsets, save_state_dict.py:48 async save, load_state_dict.py:526 redistribution).
+"""
+from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata  # noqa: F401
+from .save_state_dict import (  # noqa: F401
+    flatten_state_dict,
+    save_state_dict,
+    unflatten_state_dict,
+    wait_async_save,
+)
+from .load_state_dict import load_merged_state_dict, load_state_dict  # noqa: F401

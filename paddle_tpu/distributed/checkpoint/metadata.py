@@ -1,0 +1,87 @@
+"""Checkpoint metadata: where each local shard sits in its global tensor.
+
+Reference analog: python/paddle/distributed/checkpoint/metadata.py:41 (Metadata /
+LocalTensorMetadata / LocalTensorIndex — the global-offset flat-shard format).
+SURVEY §7.8 endorses reusing this *format design*: it is device-agnostic, and
+redistribution on load is pure interval arithmetic over global offsets.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field
+
+
+@dataclass
+class LocalTensorMetadata:
+    """Placement of one saved shard inside its global tensor."""
+
+    global_offset: tuple
+    local_shape: tuple
+    dtype: str
+
+
+@dataclass(frozen=True)
+class LocalTensorIndex:
+    """Identity of one saved shard: (tensor key, its global offset)."""
+
+    tensor_key: str
+    global_offset: tuple
+
+
+@dataclass
+class Metadata:
+    """One checkpoint's map: every tensor's shard list + where each shard's bytes
+    live (file name + key inside the file)."""
+
+    state_dict_metadata: dict = field(default_factory=dict)   # key -> [LocalTensorMetadata]
+    storage_metadata: dict = field(default_factory=dict)      # LocalTensorIndex -> "file::arraykey"
+    global_shapes: dict = field(default_factory=dict)         # key -> tuple
+    flat_mapping: dict = field(default_factory=dict)          # flat key -> original nested path
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "state_dict_metadata": {
+                k: [asdict(m) for m in v]
+                for k, v in self.state_dict_metadata.items()
+            },
+            "storage_metadata": [
+                {"tensor_key": idx.tensor_key,
+                 "global_offset": list(idx.global_offset),
+                 "location": loc}
+                for idx, loc in self.storage_metadata.items()
+            ],
+            "global_shapes": {k: list(v) for k, v in self.global_shapes.items()},
+            "flat_mapping": {k: list(v) for k, v in self.flat_mapping.items()},
+        })
+
+    @classmethod
+    def from_json(cls, text: str) -> "Metadata":
+        raw = json.loads(text)
+        md = cls()
+        for k, v in raw.get("state_dict_metadata", {}).items():
+            md.state_dict_metadata[k] = [
+                LocalTensorMetadata(tuple(m["global_offset"]),
+                                    tuple(m["local_shape"]), m["dtype"])
+                for m in v
+            ]
+        for ent in raw.get("storage_metadata", []):
+            md.storage_metadata[
+                LocalTensorIndex(ent["tensor_key"], tuple(ent["global_offset"]))
+            ] = ent["location"]
+        md.global_shapes = {k: tuple(v)
+                            for k, v in raw.get("global_shapes", {}).items()}
+        md.flat_mapping = {k: tuple(v)
+                           for k, v in raw.get("flat_mapping", {}).items()}
+        return md
+
+    def merge(self, other: "Metadata"):
+        for k, v in other.state_dict_metadata.items():
+            mine = self.state_dict_metadata.setdefault(k, [])
+            seen = {(tuple(m.global_offset), tuple(m.local_shape)) for m in mine}
+            for m in v:
+                if (tuple(m.global_offset), tuple(m.local_shape)) not in seen:
+                    mine.append(m)
+        self.storage_metadata.update(other.storage_metadata)
+        self.global_shapes.update(other.global_shapes)
+        self.flat_mapping.update(other.flat_mapping)
+        return self

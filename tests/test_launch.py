@@ -77,6 +77,9 @@ class TestTCPStore:
               for i, st in enumerate(clients)]
         for t in ts:
             t.start()
+        import time
+
+        time.sleep(0.3)  # give both clients time to reach the barrier
         assert not done  # two of three arrived; barrier must still hold
         master.barrier("b0", timeout=10)
         for t in ts:
