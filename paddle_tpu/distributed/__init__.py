@@ -60,6 +60,7 @@ from . import checkpoint  # noqa: F401
 from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
 from . import in_jit  # noqa: F401
 from . import fleet  # noqa: F401
+from . import utils  # noqa: F401
 from . import launch  # noqa: F401
 from .fleet.mpu.mp_ops import split  # noqa: F401
 

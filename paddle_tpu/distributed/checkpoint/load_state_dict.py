@@ -87,7 +87,7 @@ def _overlap(dst_off, dst_shape, src_off, src_shape):
 def _assemble(name, offset, shape, dtype, md, files):
     """Fill one target box from every saved piece that overlaps it."""
     out = np.empty(shape, dtype)
-    filled = np.zeros(shape, bool) if shape else np.zeros((), bool)
+    filled = np.zeros(shape, bool)
     pieces = md.state_dict_metadata.get(name, [])
     for piece in pieces:
         if len(piece.global_offset) != len(offset):

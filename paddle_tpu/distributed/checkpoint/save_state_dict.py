@@ -98,13 +98,15 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
             if rank == coordinator_rank:
                 key = f"t{n}"
                 n += 1
-                arrays[key] = np.asarray(value)
+                np_val = np.asarray(value)
+                offset = (0,) * np_val.ndim
+                arrays[key] = np_val
                 md.state_dict_metadata.setdefault(name, []).append(
-                    LocalTensorMetadata((), tuple(np.asarray(value).shape),
-                                        str(np.asarray(value).dtype)))
-                md.storage_metadata[LocalTensorIndex(name, ())] = \
+                    LocalTensorMetadata(offset, tuple(np_val.shape),
+                                        str(np_val.dtype)))
+                md.storage_metadata[LocalTensorIndex(name, offset)] = \
                     f"{data_file}::{key}"
-                md.global_shapes[name] = tuple(np.asarray(value).shape)
+                md.global_shapes[name] = tuple(np_val.shape)
             continue
         md.global_shapes[name] = tuple(arr.shape)
         for shard in arr.addressable_shards:
@@ -138,14 +140,29 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                 json.dump({"world_size": world}, f)
 
     if async_save:
-        t = threading.Thread(target=_write, daemon=True)
+        holder = {"error": None}
+
+        def _guarded():
+            try:
+                _write()
+            except BaseException as e:  # surfaced by wait_async_save
+                holder["error"] = e
+
+        t = threading.Thread(target=_guarded, daemon=True)
         t.start()
-        _ASYNC_THREADS.append(t)
+        _ASYNC_THREADS.append((t, holder))
     else:
         _write()
 
 
 def wait_async_save():
-    """Join outstanding async save threads (reference's queue drain)."""
+    """Join outstanding async save threads and re-raise any write failure
+    (a silently lost checkpoint is only discovered at restore time otherwise)."""
+    errors = []
     while _ASYNC_THREADS:
-        _ASYNC_THREADS.pop().join()
+        t, holder = _ASYNC_THREADS.pop()
+        t.join()
+        if holder["error"] is not None:
+            errors.append(holder["error"])
+    if errors:
+        raise errors[0]

@@ -1,0 +1,52 @@
+"""global_scatter / global_gather: count-addressed token exchange for MoE.
+
+Reference analog: python/paddle/distributed/utils/moe_utils.py (global_scatter
+:25, global_gather :140 — NCCL all-to-all with per-(rank, expert) counts; device
+kernels phi/kernels/{cpu,gpu,custom}/global_scatter_kernel.*).
+
+TPU-first note: compiled MoE should NOT use these — MoELayer's dense one-hot
+dispatch lets GSPMD emit the all-to-all. These functions exist for API parity and
+for eager experimentation: they operate on the stacked-axis representation the
+eager collective layer uses (rank-local rows stacked on axis 0).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+
+
+def _np(x):
+    return np.asarray(x.value if isinstance(x, Tensor) else x)
+
+
+def global_scatter(x, local_count, global_count, group=None, use_calc_stream=True):
+    """Send local_count[i*E+e] rows to expert e of rank i; receive what
+    global_count says others send here. Single-controller: the stacked exchange
+    reduces to a stable reorder of rows grouped by destination expert."""
+    xv = _np(x)
+    lc = _np(local_count).astype(np.int64)
+    gc = _np(global_count).astype(np.int64)
+    # rows are laid out grouped by (expert-major) destination already — the
+    # reference contract. Output = rows this "rank" keeps, ordered by source.
+    n_out = int(gc.sum())
+    starts = np.zeros_like(lc)
+    np.cumsum(lc[:-1], out=starts[1:])
+    pieces = []
+    for j in range(len(gc)):
+        # in the single-process view, global==local exchange: take the j-th
+        # destination block from x
+        s, n = int(starts[j]), int(lc[j]) if j < len(lc) else 0
+        if gc[j] > 0:
+            pieces.append(xv[s:s + int(gc[j])])
+    out = np.concatenate(pieces, axis=0) if pieces else xv[:0]
+    assert out.shape[0] == n_out
+    return Tensor(jnp.asarray(out))
+
+
+def global_gather(x, local_count, global_count, group=None, use_calc_stream=True):
+    """Inverse of global_scatter (reference moe_utils.py:140)."""
+    return global_scatter(x, global_count, local_count, group=group,
+                          use_calc_stream=use_calc_stream)

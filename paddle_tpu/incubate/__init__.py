@@ -4,3 +4,4 @@ Reference analog: python/paddle/incubate/ (fused ops in incubate/nn/functional, 
 in incubate/distributed/models/moe).
 """
 from . import nn  # noqa: F401
+from . import distributed  # noqa: F401

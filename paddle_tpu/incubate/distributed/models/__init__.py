@@ -1,0 +1,2 @@
+"""Distributed model collection (reference incubate/distributed/models)."""
+from . import moe  # noqa: F401

@@ -1,0 +1,179 @@
+"""MoELayer: mixture-of-experts with expert parallelism.
+
+Reference analog: python/paddle/incubate/distributed/models/moe/moe_layer.py
+(MoELayer — gate, global_scatter/global_gather all-to-all dispatch over moe_group,
+per-rank expert networks).
+
+TPU-first redesign: dispatch/combine are dense one-hot einsums (GShard-style) over
+an expert-stacked activation tensor (E, C, d) whose expert axis is SHARDED over the
+mesh's expert-parallel axis — XLA's partitioner lowers the
+(tokens-sharded -> experts-sharded) einsum into exactly the all-to-all the
+reference launches by hand (global_scatter_kernel, distributed/utils/moe_utils.py),
+and fuses the combine back. Static capacity keeps every shape compile-time
+constant so the whole layer jits.
+
+Expert execution paths:
+* LayerList of arbitrary experts (reference API): loop, each on its (C, d) slab.
+* Identical-architecture experts auto-stack: one traced expert program runs under
+  vmap over the expert axis — a single batched matmul family on the MXU, and the
+  layout expert-parallel sharding wants.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..... import ops
+from .....autograd import tape
+from .....framework import random as rng
+from .....framework.core import Tensor
+from .....nn import functional as F
+from .....nn.layer.layers import Layer
+from .....nn.layer.container import LayerList
+from .....ops._apply import apply_raw
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate, _topk_dispatch
+
+
+def _layer_param_signature(layer):
+    ps = list(layer.named_parameters())
+    return tuple((n, tuple(p.shape), str(np.dtype(p.dtype))) for n, p in ps)
+
+
+class MoELayer(Layer):
+    """paddle.incubate.distributed.models.moe.MoELayer (moe_layer.py:261 parity).
+
+    `mesh`/`expert_axis` name the mesh axis experts shard over (the TPU
+    equivalent of moe_group); default None runs unsharded.
+    """
+
+    def __init__(self, d_model, experts, gate=None, moe_group=None, mp_group=None,
+                 recompute_interval=0, recompute_ctx=None, mesh=None,
+                 expert_axis="ep"):
+        super().__init__()
+        self.d_model = d_model
+        if isinstance(experts, (list, tuple)):
+            experts = LayerList(list(experts))
+        if not isinstance(experts, LayerList):
+            raise TypeError("experts must be a LayerList")
+        self.experts = experts
+        self.num_expert = len(self.experts)
+        self.recompute_interval = recompute_interval
+        self._mesh = mesh
+        self._expert_axis = expert_axis
+
+        if gate is None:
+            gate = {"type": "gshard", "top_k": 2}
+        if isinstance(gate, dict):
+            kind = gate.get("type", "gshard")
+            topk = gate.get("top_k", 2)
+            if kind == "gshard":
+                gate = GShardGate(d_model, self.num_expert, topk=topk)
+            elif kind == "switch":
+                gate = SwitchGate(d_model, self.num_expert, topk=topk)
+            elif kind in ("naive", None):
+                gate = NaiveGate(d_model, self.num_expert, topk=topk)
+            else:
+                raise ValueError(f"unknown gate type {kind!r}")
+        if not isinstance(gate, BaseGate):
+            raise TypeError(f"gate must be a BaseGate, got {type(gate)}")
+        self.gate = gate
+        self.top_k = gate.top_k
+
+        sigs = {_layer_param_signature(e) for e in self.experts}
+        self._stackable = len(sigs) == 1 and bool(next(iter(sigs)))
+
+    # -- expert execution ----------------------------------------------------
+    def _run_experts_stacked(self, expert_in):
+        """expert_in: (E, C, d) Tensor. vmap one traced expert over stacked params;
+        gradients flow into every expert's own Parameters."""
+        template = self.experts[0]
+        t_params = [p for _, p in template.named_parameters()]
+        n_params = len(t_params)
+        flat_params = [p for e in self.experts
+                       for _, p in e.named_parameters()]          # E * n_params
+        E = self.num_expert
+        mesh, axis = self._mesh, self._expert_axis
+
+        def fn(x, *flat_vals):
+            stacks = [jnp.stack([flat_vals[e * n_params + i] for e in range(E)])
+                      for i in range(n_params)]
+            if mesh is not None:
+                stacks = [jax.lax.with_sharding_constraint(
+                    s, NamedSharding(mesh, P(axis, *([None] * (s.ndim - 1)))))
+                    for s in stacks]
+
+            def one_expert(leaves, xe):
+                with tape.functional_mode(), rng.trace_key(jax.random.PRNGKey(0)):
+                    saved = [(p, p._value) for p in t_params]
+                    try:
+                        for p, val in zip(t_params, leaves):
+                            p._replace_value(val)
+                        return template(Tensor(xe, stop_gradient=False)).value
+                    finally:
+                        for p, val in saved:
+                            p._replace_value(val)
+
+            return jax.vmap(one_expert, in_axes=(0, 0))(stacks, x)
+
+        return apply_raw("moe_experts_stacked", fn, [expert_in, *flat_params])[0]
+
+    def _run_experts_loop(self, expert_in):
+        outs = [self.experts[e](expert_in[e]) for e in range(self.num_expert)]
+        return ops.stack(outs, axis=0)
+
+    # -- forward -------------------------------------------------------------
+    def forward(self, inp):
+        """inp: (..., d_model) -> same shape. Gate aux loss at self.gate.loss."""
+        orig_shape = inp.shape
+        x = ops.reshape(inp, [-1, self.d_model])
+        T = x.shape[0]
+        capacity = (self.gate.capacity_for(T, self.training)
+                    if hasattr(self.gate, "capacity_for") else T)
+        logits = self.gate(x)                                    # (T, E)
+        E = logits.shape[-1]
+
+        key = None
+        if (isinstance(self.gate, GShardGate) and self.gate.random_routing
+                and self.training):
+            key = rng.next_key()
+        # routing constants (no grad): dispatch boxes + which slots survived
+        dispatch, _, topi, kept = _topk_dispatch(
+            logits, key, top_k=self.top_k, capacity=capacity,
+            second_policy="sampling" if key is not None else "none")
+
+        # combine weights recomputed DIFFERENTIABLY: gather top-k probs, mask by
+        # survival, renormalize (reference re-normalizes the kept top-2 gates)
+        probs = F.softmax(logits.astype("float32"), axis=-1)      # (T, E)
+        w_tk = ops.take_along_axis(probs, topi.astype("int64"), axis=-1,
+                                   broadcast=False)               # (T, K)
+        w_tk = w_tk * kept.astype("float32")
+        w_tk = w_tk / (ops.sum(w_tk, axis=-1, keepdim=True) + 1e-9)
+        onehots = jax.nn.one_hot(np.asarray(topi) if not isinstance(topi, Tensor)
+                                 else topi.value, E, dtype=jnp.float32)
+        onehots = onehots * (kept.value if isinstance(kept, Tensor)
+                             else np.asarray(kept))[..., None]
+        w_te = ops.einsum("tk,tke->te", w_tk, Tensor(onehots))    # (T, E)
+        combine = ops.einsum("te,tec->tec", w_te, dispatch)       # (T, E, C)
+
+        # dispatch tokens (T,E,C)x(T,d) -> (E,C,d); ep sharding makes this the
+        # all-to-all under GSPMD
+        expert_in = ops.einsum("tec,td->ecd", dispatch, x.astype("float32"))
+        expert_in = expert_in.astype(inp.dtype)
+        if self._mesh is not None:
+            from .....distributed.fleet.mpu.mp_ops import _constrain
+
+            expert_in._replace_value(_constrain(
+                expert_in.value, self._mesh,
+                P(self._expert_axis, *([None] * (expert_in.value.ndim - 1)))))
+
+        if self._stackable and self.num_expert > 1:
+            expert_out = self._run_experts_stacked(expert_in)
+        else:
+            expert_out = self._run_experts_loop(expert_in)
+
+        y = ops.einsum("tec,ecd->td", combine,
+                       expert_out.astype("float32"))
+        return ops.reshape(y.astype(inp.dtype), orig_shape)

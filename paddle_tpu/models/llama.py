@@ -44,6 +44,10 @@ class LlamaConfig:
         rope_theta=10000.0,
         use_flash_attention=True,
         tie_word_embeddings=False,
+        num_experts=0,
+        moe_topk=2,
+        moe_gate="gshard",
+        moe_every_k=1,
         tensor_parallel_degree=1,
         sequence_parallel=False,
         pipeline_parallel_degree=1,
@@ -64,6 +68,10 @@ class LlamaConfig:
         self.rope_theta = rope_theta
         self.use_flash_attention = use_flash_attention
         self.tie_word_embeddings = tie_word_embeddings
+        self.num_experts = num_experts
+        self.moe_topk = moe_topk
+        self.moe_gate = moe_gate
+        self.moe_every_k = moe_every_k
         self.tensor_parallel_degree = tensor_parallel_degree
         self.sequence_parallel = sequence_parallel
         self.pipeline_parallel_degree = pipeline_parallel_degree
@@ -216,11 +224,44 @@ class LlamaMLP(Layer):
         return self.down_proj(F.swiglu(self.gate_proj(x), self.up_proj(x)))
 
 
+class LlamaMoEMLP(Layer):
+    """Sparse MoE feed-forward: MoELayer over SwiGLU experts.
+
+    Reference analog: the reference wires its MoELayer into transformer MLP slots
+    (incubate/distributed/models/moe/moe_layer.py usage); `num_experts`/`moe_topk`
+    /`moe_gate` config fields select it here. Experts are identical SwiGLU MLPs,
+    so MoELayer's stacked-vmap path runs them as one batched program (and shards
+    them over an `ep` mesh axis when one is provided)."""
+
+    def __init__(self, config: LlamaConfig, mesh=None, expert_axis="ep"):
+        super().__init__()
+        from ..incubate.distributed.models.moe import MoELayer
+
+        experts = LayerList([LlamaMLP(config)
+                             for _ in range(config.num_experts)])
+        self.moe = MoELayer(
+            d_model=config.hidden_size, experts=experts,
+            gate={"type": config.moe_gate, "top_k": config.moe_topk},
+            mesh=mesh, expert_axis=expert_axis)
+
+    @property
+    def aux_loss(self):
+        return self.moe.gate.get_loss()
+
+    def forward(self, x):
+        return self.moe(x)
+
+
 class LlamaDecoderLayer(Layer):
-    def __init__(self, config: LlamaConfig):
+    def __init__(self, config: LlamaConfig, layer_idx=0):
         super().__init__()
         self.self_attn = LlamaAttention(config)
-        self.mlp = LlamaMLP(config)
+        use_moe = (getattr(config, "num_experts", 0) or 0) > 1 and \
+            (layer_idx % max(1, getattr(config, "moe_every_k", 1)) == 0)
+        self.mlp = LlamaMoEMLP(
+            config, mesh=getattr(config, "moe_mesh", None),
+            expert_axis=getattr(config, "moe_expert_axis", "ep"),
+        ) if use_moe else LlamaMLP(config)
         self.input_layernorm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
         self.post_attention_layernorm = RMSNorm(
             config.hidden_size, epsilon=config.rms_norm_eps)
@@ -258,7 +299,8 @@ class LlamaModel(Layer):
             self.embed_tokens = Embedding(
                 config.vocab_size, config.hidden_size, weight_attr=init)
         self.layers = LayerList(
-            [LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)])
+            [LlamaDecoderLayer(config, layer_idx=i)
+             for i in range(config.num_hidden_layers)])
         self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
 
     def forward(self, input_ids, attn_mask=None):
@@ -344,11 +386,30 @@ class LlamaForCausalLM(Layer):
             if config.tie_word_embeddings else None)
         self.criterion = LlamaPretrainingCriterion(config)
 
+    def moe_aux_loss(self):
+        """Sum of the decoder MLPs' gate balance losses from the LAST forward
+        (cleared on read); zero Tensor when no MoE layer ran."""
+        total = None
+        for layer in self.llama.layers:
+            mlp = layer.mlp
+            if isinstance(mlp, LlamaMoEMLP):
+                aux = mlp.aux_loss
+                if aux is not None:
+                    total = aux if total is None else total + aux
+        if total is None:
+            return ops.to_tensor(0.0, dtype="float32")
+        return total
+
     def forward(self, input_ids, labels=None, attn_mask=None):
         h = self.llama(input_ids, attn_mask)
         logits = self.lm_head(h)
         if labels is not None:
-            return self.criterion(logits, labels), logits
+            loss = self.criterion(logits, labels)
+            if (getattr(self.config, "num_experts", 0) or 0) > 1:
+                # gate balance pressure (GShard §3.2); weight per the reference's
+                # customary 1e-2 aux coefficient
+                loss = loss + 0.01 * self.moe_aux_loss().astype(loss.dtype)
+            return loss, logits
         return logits
 
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0):
@@ -410,8 +471,8 @@ def LlamaForCausalLMPipe(config: LlamaConfig, **pp_kwargs):
     from ..distributed.fleet.meta_parallel.pp_layers import LayerDesc, PipelineLayer
 
     descs = [LayerDesc(_EmbeddingPipe, config)]
-    descs += [LayerDesc(LlamaDecoderLayer, config)
-              for _ in range(config.num_hidden_layers)]
+    descs += [LayerDesc(LlamaDecoderLayer, config, layer_idx=i)
+              for i in range(config.num_hidden_layers)]
     descs += [LayerDesc(_NormPipe, config), LayerDesc(_LMHeadPipe, config)]
     crit = LlamaPretrainingCriterion(config)
     return PipelineLayer(

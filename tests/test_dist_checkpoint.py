@@ -159,3 +159,10 @@ class TestReviewFixes:
             json.dumps({"world_size": 2}))
         with pytest.raises(FileNotFoundError, match="incomplete"):
             dist.load_state_dict({"w": paddle.zeros([8, 2])}, str(tmp_path))
+
+    def test_nonscalar_numpy_leaf_roundtrip(self, tmp_path):
+        lr = np.array([0.1, 0.2, 0.3], "float32")
+        dist.save_state_dict({"lr": lr, "step": 7}, str(tmp_path))
+        merged = dist.checkpoint.load_merged_state_dict(str(tmp_path))
+        np.testing.assert_allclose(merged["lr"].numpy(), lr)
+        assert int(merged["step"].numpy()) == 7

@@ -1,0 +1,240 @@
+"""MoE / expert parallelism.
+
+Mirrors the reference's MoE semantics (incubate/distributed/models/moe/
+moe_layer.py, gate/gshard_gate.py, gate/switch_gate.py) on the 8-device virtual
+CPU mesh."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import ProcessMesh
+from paddle_tpu.incubate.distributed.models.moe import (
+    GShardGate,
+    MoELayer,
+    NaiveGate,
+    SwitchGate,
+)
+
+
+class Expert(paddle.nn.Layer):
+    def __init__(self, d_model, d_hidden):
+        super().__init__()
+        self.htoh4 = paddle.nn.Linear(d_model, d_hidden)
+        self.h4toh = paddle.nn.Linear(d_hidden, d_model)
+
+    def forward(self, x):
+        return self.h4toh(paddle.nn.functional.relu(self.htoh4(x)))
+
+
+def _make_moe(d_model=8, d_hidden=16, num_experts=4, gate=None, mesh=None):
+    paddle.seed(0)
+    experts = paddle.nn.LayerList(
+        [Expert(d_model, d_hidden) for _ in range(num_experts)])
+    return MoELayer(d_model=d_model, experts=experts, gate=gate, mesh=mesh)
+
+
+def _dense_reference(layer, x):
+    """Dense mixture: y_t = sum_k gate_w[t,k] * expert_{topk[t,k]}(x_t), with the
+    same top-k selection, no capacity drops."""
+    import jax
+    import jax.numpy as jnp
+
+    logits = layer.gate.gate(paddle.to_tensor(x)).numpy()
+    probs = np.asarray(jax.nn.softmax(jnp.asarray(logits, jnp.float32), axis=-1))
+    k = layer.top_k
+    topi = np.argsort(-probs, axis=-1)[:, :k]
+    topw = np.take_along_axis(probs, topi, axis=-1)
+    topw = topw / topw.sum(-1, keepdims=True)
+    outs = np.stack([layer.experts[e](paddle.to_tensor(x)).numpy()
+                     for e in range(layer.num_expert)])           # (E, T, d)
+    y = np.zeros_like(x)
+    for t in range(x.shape[0]):
+        for j in range(k):
+            y[t] += topw[t, j] * outs[topi[t, j], t]
+    return y
+
+
+class TestMoEForward:
+    def test_matches_dense_reference_no_drops(self):
+        layer = _make_moe(gate={"type": "naive", "top_k": 2})
+        layer.eval()  # capacity = T for naive gate; nothing dropped
+        x = np.random.RandomState(0).randn(24, 8).astype("float32")
+        y = layer(paddle.to_tensor(x)).numpy()
+        ref = _dense_reference(layer, x)
+        np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-5)
+
+    def test_batch_shape_preserved(self):
+        layer = _make_moe(gate={"type": "gshard", "top_k": 2})
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(2, 6, 8).astype("float32"))
+        y = layer(x)
+        assert y.shape == [2, 6, 8]
+
+    def test_capacity_respected(self):
+        from paddle_tpu.incubate.distributed.models.moe.gate import _topk_dispatch
+
+        r = np.random.RandomState(0)
+        logits = paddle.to_tensor(r.randn(32, 4).astype("float32"))
+        dispatch, _, _, kept = _topk_dispatch(logits, None, top_k=2, capacity=5)
+        d = dispatch.numpy()
+        # each expert's token queue never exceeds capacity, one token per slot
+        per_slot = d.sum(axis=0)               # (E, C)
+        assert (per_slot <= 1.0 + 1e-6).all()
+        tokens_per_expert = d.sum(axis=(0, 2))  # (E,)
+        assert (tokens_per_expert <= 5 + 1e-6).all()
+
+    def test_gshard_aux_loss_and_grads(self):
+        layer = _make_moe(gate={"type": "gshard", "top_k": 2})
+        x = paddle.to_tensor(
+            np.random.RandomState(2).randn(32, 8).astype("float32"))
+        y = layer(x)
+        aux = layer.gate.get_loss()
+        assert aux is not None and float(aux.numpy()) > 0
+        (y.mean() + 0.01 * aux).backward()
+        # gate and at least one expert receive gradients
+        assert layer.gate.gate.weight.grad is not None
+        grads = [e.htoh4.weight.grad for e in layer.experts]
+        assert any(g is not None and float(np.abs(g.numpy()).sum()) > 0
+                   for g in grads)
+
+    def test_switch_gate_top1(self):
+        layer = _make_moe(gate={"type": "switch", "top_k": 1})
+        layer.eval()
+        x = paddle.to_tensor(
+            np.random.RandomState(3).randn(16, 8).astype("float32"))
+        y = layer(x)
+        assert y.shape == [16, 8]
+        assert isinstance(layer.gate, SwitchGate)
+
+    def test_gate_class_instances_accepted(self):
+        g = NaiveGate(8, 4, topk=2)
+        experts = paddle.nn.LayerList([Expert(8, 16) for _ in range(4)])
+        layer = MoELayer(d_model=8, experts=experts, gate=g)
+        assert layer.gate is g
+        g2 = GShardGate(8, 4, topk=2)
+        assert g2.top_k == 2
+
+
+class TestExpertParallel:
+    def test_expert_axis_sharding_8dev(self):
+        """Experts shard over an 8-device ep axis; outputs match unsharded run."""
+        mesh = ProcessMesh(np.arange(8), ["ep"]).jax_mesh()
+        layer = _make_moe(num_experts=8, gate={"type": "naive", "top_k": 2},
+                          mesh=mesh)
+        layer.eval()
+        x = np.random.RandomState(4).randn(16, 8).astype("float32")
+        y_sharded = layer(paddle.to_tensor(x)).numpy()
+
+        layer2 = _make_moe(num_experts=8, gate={"type": "naive", "top_k": 2})
+        layer2.eval()
+        y_plain = layer2(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(y_sharded, y_plain, rtol=1e-4, atol=1e-5)
+
+    def test_tokens_balanced_under_aux_loss_training(self):
+        """Training with the aux loss drives routing toward balance."""
+        paddle.seed(0)
+        layer = _make_moe(d_model=8, num_experts=4,
+                          gate={"type": "gshard", "top_k": 2})
+        opt = paddle.optimizer.Adam(learning_rate=5e-3,
+                                    parameters=layer.parameters())
+        r = np.random.RandomState(5)
+        x = paddle.to_tensor(r.randn(64, 8).astype("float32"))
+        for _ in range(30):
+            y = layer(x)
+            aux = layer.gate.get_loss()
+            loss = ((y - 1.0) ** 2).mean() + 0.1 * aux
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        # after training, top-1 assignment is not fully collapsed on one expert
+        import jax
+
+        logits = layer.gate.gate(x).numpy()
+        top1 = logits.argmax(-1)
+        counts = np.bincount(top1, minlength=4)
+        assert counts.max() < 64  # not all tokens on a single expert
+        assert (counts > 0).sum() >= 2
+
+
+class TestMoEGradClip:
+    def test_clip_scales_grads(self):
+        from paddle_tpu.incubate.distributed.models.moe import (
+            ClipGradForMOEByGlobalNorm)
+
+        p = paddle.to_tensor(np.ones(4, "float32"), stop_gradient=False)
+        g = paddle.to_tensor(np.full(4, 10.0, "float32"))
+        clip = ClipGradForMOEByGlobalNorm(clip_norm=1.0)
+        out = clip([(p, g)])
+        norm = float(np.sqrt((out[0][1].numpy() ** 2).sum()))
+        assert abs(norm - 1.0) < 1e-5
+
+
+class TestGlobalScatterGather:
+    def test_roundtrip(self):
+        from paddle_tpu.distributed.utils import global_gather, global_scatter
+
+        x = paddle.to_tensor(np.arange(12, dtype="float32").reshape(6, 2))
+        counts = paddle.to_tensor(np.array([2, 4], "int64"))
+        y = global_scatter(x, counts, counts)
+        np.testing.assert_array_equal(y.numpy(), x.numpy())
+        z = global_gather(y, counts, counts)
+        np.testing.assert_array_equal(z.numpy(), x.numpy())
+
+
+class TestLlamaMoE:
+    def test_llama_with_moe_mlp_trains(self):
+        from paddle_tpu.models.llama import (
+            LlamaConfig, LlamaForCausalLM, LlamaMoEMLP)
+
+        paddle.seed(0)
+        cfg = LlamaConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_experts=4, moe_topk=2, moe_gate="naive",
+            use_flash_attention=False)
+        model = LlamaForCausalLM(cfg)
+        mlps = [l.mlp for l in model.llama.layers]
+        assert all(isinstance(m, LlamaMoEMLP) for m in mlps)
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 128, (2, 8)).astype("int64"))
+        logits = model(ids)
+        assert logits.shape == [2, 8, 128]
+        loss = paddle.nn.functional.cross_entropy(
+            paddle.reshape(logits, [-1, 128]),
+            paddle.reshape(ids, [-1])).mean()
+        loss.backward()
+        g = mlps[0].moe.experts[0].gate_proj.weight.grad
+        assert g is not None
+
+    def test_moe_aux_loss_enters_training_loss(self):
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+        paddle.seed(0)
+        cfg = LlamaConfig(
+            vocab_size=64, hidden_size=16, intermediate_size=32,
+            num_hidden_layers=1, num_attention_heads=2,
+            num_experts=4, moe_topk=2, moe_gate="gshard",
+            use_flash_attention=False)
+        model = LlamaForCausalLM(cfg)
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 64, (2, 8)).astype("int64"))
+        loss, _ = model(ids, labels=ids)
+        # with the gate cleared by the loss path, a second read returns zero
+        assert float(model.moe_aux_loss().numpy()) == 0.0
+        loss.backward()
+        assert model.llama.layers[0].mlp.moe.gate.gate.weight.grad is not None
+
+    def test_pipe_descs_respect_moe_every_k(self):
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLMPipe, LlamaMoEMLP
+
+        cfg = LlamaConfig(
+            vocab_size=64, hidden_size=16, intermediate_size=32,
+            num_hidden_layers=4, num_attention_heads=2,
+            num_experts=2, moe_topk=2, moe_gate="naive", moe_every_k=2,
+            use_flash_attention=False, pipeline_parallel_degree=1)
+        pipe = LlamaForCausalLMPipe(cfg)
+        decs = [l for l in pipe.run_function
+                if l.__class__.__name__ == "LlamaDecoderLayer"]
+        kinds = [isinstance(l.mlp, LlamaMoEMLP) for l in decs]
+        assert kinds == [True, False, True, False]
