@@ -56,6 +56,14 @@ from .parallel import (  # noqa: F401
     is_initialized,
 )
 from .store import TCPStore, create_or_get_global_tcp_store  # noqa: F401
+from . import auto_parallel  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    DistModel,
+    Engine,
+    ShardDataloader,
+    shard_dataloader,
+    to_static,
+)
 from . import checkpoint  # noqa: F401
 from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
 from . import in_jit  # noqa: F401

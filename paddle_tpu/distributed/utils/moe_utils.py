@@ -29,21 +29,15 @@ def global_scatter(x, local_count, global_count, group=None, use_calc_stream=Tru
     xv = _np(x)
     lc = _np(local_count).astype(np.int64)
     gc = _np(global_count).astype(np.int64)
-    # rows are laid out grouped by (expert-major) destination already — the
-    # reference contract. Output = rows this "rank" keeps, ordered by source.
-    n_out = int(gc.sum())
-    starts = np.zeros_like(lc)
-    np.cumsum(lc[:-1], out=starts[1:])
-    pieces = []
-    for j in range(len(gc)):
-        # in the single-process view, global==local exchange: take the j-th
-        # destination block from x
-        s, n = int(starts[j]), int(lc[j]) if j < len(lc) else 0
-        if gc[j] > 0:
-            pieces.append(xv[s:s + int(gc[j])])
-    out = np.concatenate(pieces, axis=0) if pieces else xv[:0]
-    assert out.shape[0] == n_out
-    return Tensor(jnp.asarray(out))
+    if not np.array_equal(lc, gc):
+        # with one controller there are no "other ranks" whose rows could fill
+        # the asymmetric receive counts; slicing local data at global counts
+        # would silently duplicate/drop rows
+        raise ValueError(
+            "single-controller global_scatter emulation requires "
+            "local_count == global_count (the symmetric self-exchange); "
+            "compiled MoE uses MoELayer's GSPMD all-to-all instead")
+    return Tensor(jnp.asarray(xv.copy()))
 
 
 def global_gather(x, local_count, global_count, group=None, use_calc_stream=True):

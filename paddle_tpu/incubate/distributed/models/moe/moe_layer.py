@@ -97,7 +97,11 @@ class MoELayer(Layer):
         E = self.num_expert
         mesh, axis = self._mesh, self._expert_axis
 
-        def fn(x, *flat_vals):
+        # live per-expert RNG keys so dropout-style ops inside experts vary per
+        # step and per expert (the loop path gets this from the global stream)
+        keys = Tensor(jax.random.split(rng.next_key(), E))
+
+        def fn(keys_val, x, *flat_vals):
             stacks = [jnp.stack([flat_vals[e * n_params + i] for e in range(E)])
                       for i in range(n_params)]
             if mesh is not None:
@@ -105,8 +109,8 @@ class MoELayer(Layer):
                     s, NamedSharding(mesh, P(axis, *([None] * (s.ndim - 1)))))
                     for s in stacks]
 
-            def one_expert(leaves, xe):
-                with tape.functional_mode(), rng.trace_key(jax.random.PRNGKey(0)):
+            def one_expert(key, leaves, xe):
+                with tape.functional_mode(), rng.trace_key(key):
                     saved = [(p, p._value) for p in t_params]
                     try:
                         for p, val in zip(t_params, leaves):
@@ -116,9 +120,10 @@ class MoELayer(Layer):
                         for p, val in saved:
                             p._replace_value(val)
 
-            return jax.vmap(one_expert, in_axes=(0, 0))(stacks, x)
+            return jax.vmap(one_expert, in_axes=(0, 0, 0))(keys_val, stacks, x)
 
-        return apply_raw("moe_experts_stacked", fn, [expert_in, *flat_params])[0]
+        return apply_raw("moe_experts_stacked", fn,
+                         [keys, expert_in, *flat_params])[0]
 
     def _run_experts_loop(self, expert_in):
         outs = [self.experts[e](expert_in[e]) for e in range(self.num_expert)]
@@ -169,10 +174,16 @@ class MoELayer(Layer):
                 expert_in.value, self._mesh,
                 P(self._expert_axis, *([None] * (expert_in.value.ndim - 1)))))
 
-        if self._stackable and self.num_expert > 1:
-            expert_out = self._run_experts_stacked(expert_in)
+        run = (self._run_experts_stacked
+               if self._stackable and self.num_expert > 1
+               else self._run_experts_loop)
+        if self.recompute_interval and self.training:
+            # reference: recompute_interval>0 checkpoints the expert segment
+            from .....distributed.fleet.recompute import recompute
+
+            expert_out = recompute(run, expert_in)
         else:
-            expert_out = self._run_experts_loop(expert_in)
+            expert_out = run(expert_in)
 
         y = ops.einsum("tec,ecd->td", combine,
                        expert_out.astype("float32"))

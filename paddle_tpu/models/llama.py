@@ -405,9 +405,9 @@ class LlamaForCausalLM(Layer):
         logits = self.lm_head(h)
         if labels is not None:
             loss = self.criterion(logits, labels)
-            if (getattr(self.config, "num_experts", 0) or 0) > 1:
-                # gate balance pressure (GShard §3.2); weight per the reference's
-                # customary 1e-2 aux coefficient
+            if self.training and (getattr(self.config, "num_experts", 0) or 0) > 1:
+                # gate balance pressure (GShard §3.2), training only — eval
+                # loss/perplexity must stay pure cross-entropy
                 loss = loss + 0.01 * self.moe_aux_loss().astype(loss.dtype)
             return loss, logits
         return logits
@@ -475,10 +475,29 @@ def LlamaForCausalLMPipe(config: LlamaConfig, **pp_kwargs):
               for i in range(config.num_hidden_layers)]
     descs += [LayerDesc(_NormPipe, config), LayerDesc(_LMHeadPipe, config)]
     crit = LlamaPretrainingCriterion(config)
-    return PipelineLayer(
+    pipe = PipelineLayer(
         descs,
         num_stages=config.pipeline_parallel_degree or None,
         loss_fn=lambda out, label: crit(out, label),
         seg_method="layer:LlamaDecoderLayer",
         **pp_kwargs,
     )
+
+    if (getattr(config, "num_experts", 0) or 0) > 1:
+        moe_mlps = [l.mlp for l in pipe.run_function
+                    if isinstance(l, LlamaDecoderLayer)
+                    and isinstance(l.mlp, LlamaMoEMLP)]
+
+        def loss_with_aux(out, label):
+            loss = crit(out, label)
+            aux = None
+            for mlp in moe_mlps:
+                a = mlp.aux_loss
+                if a is not None:
+                    aux = a if aux is None else aux + a
+            if aux is not None:
+                loss = loss + 0.01 * aux.astype(loss.dtype)
+            return loss
+
+        pipe._loss_fn = loss_with_aux
+    return pipe

@@ -238,3 +238,30 @@ class TestLlamaMoE:
                 if l.__class__.__name__ == "LlamaDecoderLayer"]
         kinds = [isinstance(l.mlp, LlamaMoEMLP) for l in decs]
         assert kinds == [True, False, True, False]
+
+    def test_eval_loss_excludes_aux(self):
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+        paddle.seed(0)
+        cfg = LlamaConfig(
+            vocab_size=64, hidden_size=16, intermediate_size=32,
+            num_hidden_layers=1, num_attention_heads=2,
+            num_experts=4, moe_topk=2, moe_gate="gshard",
+            use_flash_attention=False)
+        model = LlamaForCausalLM(cfg)
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 64, (2, 8)).astype("int64"))
+        model.eval()
+        loss_eval, logits = model(ids, labels=ids)
+        pure_ce = model.criterion(logits, ids)
+        np.testing.assert_allclose(loss_eval.numpy(), pure_ce.numpy(),
+                                   rtol=1e-6)
+
+    def test_global_scatter_rejects_asymmetric_counts(self):
+        from paddle_tpu.distributed.utils import global_scatter
+
+        x = paddle.to_tensor(np.arange(12, dtype="float32").reshape(6, 2))
+        lc = paddle.to_tensor(np.array([2, 4], "int64"))
+        gc = paddle.to_tensor(np.array([4, 2], "int64"))
+        with pytest.raises(ValueError, match="symmetric"):
+            global_scatter(x, lc, gc)
