@@ -9,6 +9,7 @@ from .auto_cast import (  # noqa: F401
     is_auto_cast_enabled,
 )
 from .grad_scaler import AmpScaler, GradScaler  # noqa: F401
+from . import debugging  # noqa: F401
 
 white_list = amp_lists.white_list
 black_list = amp_lists.black_list

@@ -1,0 +1,227 @@
+"""AMP / numerics debugging tools.
+
+Reference analog: python/paddle/amp/debugging.py:321 (check_numerics + the
+FLAGS_check_nan_inf per-op scanner backed by eager/nan_inf_utils.cc) plus the
+operator-stats collection (:480 enable_operator_stats_collection, :559
+collect_operator_stats), tensor checker (:653 enable_tensor_checker /
+TensorCheckerConfig :173, DebugMode :56) and compare_accuracy (:594).
+
+TPU-first mapping: the per-op hook lives in the op dispatcher
+(ops/_apply.py — every defop output is scanned when FLAGS check_nan_inf is on,
+the XLA-world stand-in for the CUDA kernel-side scan); this module provides the
+user-facing switches, per-op dtype call statistics, and tensor stat utilities.
+"""
+from __future__ import annotations
+
+import contextlib
+from enum import Enum
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..framework import flags
+from ..framework.core import Tensor
+
+__all__ = [
+    "DebugMode",
+    "TensorCheckerConfig",
+    "check_numerics",
+    "check_layer_numerics",
+    "enable_operator_stats_collection",
+    "disable_operator_stats_collection",
+    "collect_operator_stats",
+    "enable_tensor_checker",
+    "disable_tensor_checker",
+    "set_checked_op_list",
+    "set_skipped_op_list",
+    "compare_accuracy",
+]
+
+
+class DebugMode(Enum):
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL_FOR_OVERFLOW = 2
+    CHECK_ALL = 3
+
+
+class TensorCheckerConfig:
+    """reference debugging.py:173 — which ops to scan and what to do on hit."""
+
+    def __init__(self, enable=True, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir=None, checked_op_list=None, skipped_op_list=None,
+                 debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = list(checked_op_list or [])
+        self.skipped_op_list = list(skipped_op_list or [])
+        self.debug_step = debug_step
+        self.stack_height_limit = stack_height_limit
+
+
+_CHECKED_OPS = [None]   # None = all
+_SKIPPED_OPS = [set()]
+
+
+def set_checked_op_list(checked_op_list):
+    _CHECKED_OPS[0] = set(checked_op_list) if checked_op_list else None
+
+
+def set_skipped_op_list(skipped_op_list):
+    _SKIPPED_OPS[0] = set(skipped_op_list or [])
+
+
+def _op_filter(op_name):
+    if op_name in _SKIPPED_OPS[0]:
+        return False
+    if _CHECKED_OPS[0] is not None and op_name not in _CHECKED_OPS[0]:
+        return False
+    return True
+
+
+def enable_tensor_checker(checker_config: TensorCheckerConfig):
+    """Turn on the per-op NaN/Inf scan (reference debugging.py:653)."""
+    if not checker_config.enable:
+        return
+    set_checked_op_list(checker_config.checked_op_list or None)
+    set_skipped_op_list(checker_config.skipped_op_list)
+    level = (0 if checker_config.debug_mode
+             == DebugMode.CHECK_NAN_INF_AND_ABORT else 1)
+    flags.set_flags({"check_nan_inf": True, "check_nan_inf_level": level})
+
+
+def disable_tensor_checker():
+    flags.set_flags({"check_nan_inf": False})
+    set_checked_op_list(None)
+    set_skipped_op_list(None)
+
+
+def tensor_stats(x):
+    """(num_nan, num_inf, num_zero, min, max, mean) of a tensor — the stats row
+    the reference prints per offending tensor."""
+    v = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+    vf = v.astype(jnp.float32)
+    finite = jnp.isfinite(vf)
+    num_nan = int(jnp.isnan(vf).sum())
+    num_inf = int(jnp.isinf(vf).sum())
+    num_zero = int((vf == 0).sum())
+    safe = jnp.where(finite, vf, 0.0)
+    n_finite = int(finite.sum())
+    stats = {
+        "num_nan": num_nan,
+        "num_inf": num_inf,
+        "num_zero": num_zero,
+        "min": float(jnp.where(finite, vf, jnp.inf).min()) if n_finite else None,
+        "max": float(jnp.where(finite, vf, -jnp.inf).max()) if n_finite else None,
+        "mean": float(safe.sum() / max(n_finite, 1)) if n_finite else None,
+    }
+    return stats
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=None,
+                   stack_height_limit=1):
+    """Scan one tensor; raise (abort mode) or print stats (reference :361)."""
+    stats = tensor_stats(tensor)
+    bad = stats["num_nan"] > 0 or stats["num_inf"] > 0
+    if bad:
+        msg = (f"[check_numerics] op={op_type or '?'} var={var_name or '?'} "
+               f"nan={stats['num_nan']} inf={stats['num_inf']} "
+               f"zero={stats['num_zero']} min={stats['min']} max={stats['max']}")
+        if debug_mode in (None, DebugMode.CHECK_NAN_INF_AND_ABORT):
+            raise FloatingPointError(msg)
+        print(msg)
+    return stats
+
+
+def check_layer_numerics(func):
+    """Decorator: scan a layer's inputs/outputs (reference :78)."""
+    import functools
+
+    @functools.wraps(func)
+    def wrapper(self, *args, **kwargs):
+        for i, a in enumerate(args):
+            if isinstance(a, Tensor):
+                check_numerics(a, op_type=type(self).__name__,
+                               var_name=f"input{i}")
+        out = func(self, *args, **kwargs)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        for i, o in enumerate(outs):
+            if isinstance(o, Tensor):
+                check_numerics(o, op_type=type(self).__name__,
+                               var_name=f"output{i}")
+        return out
+
+    return wrapper
+
+
+# -- operator stats ----------------------------------------------------------
+_OP_STATS = [None]  # dict: op name -> [fp16, bf16, fp32, other] call counts
+
+
+def _record_op_call(op_name, out_vals):
+    table = _OP_STATS[0]
+    if table is None:
+        return
+    row = table.setdefault(op_name, [0, 0, 0, 0])
+    col = 3
+    for v in out_vals:
+        d = str(getattr(v, "dtype", ""))
+        if d == "float16":
+            col = 0
+            break
+        if d == "bfloat16":
+            col = 1
+            break
+        if d == "float32":
+            col = 2
+            break
+    row[col] += 1
+
+
+def enable_operator_stats_collection():
+    """Count op calls by output dtype (reference :480)."""
+    _OP_STATS[0] = {}
+
+
+def disable_operator_stats_collection():
+    table = _OP_STATS[0]
+    _OP_STATS[0] = None
+    if table:
+        _print_operator_stats(table)
+    return table
+
+
+def _print_operator_stats(table):
+    print("<" + "-" * 86 + ">")
+    print(f"{'Op Name':<40} {'FP16':>10} {'BF16':>10} {'FP32':>10} {'Other':>10}")
+    for name in sorted(table):
+        f16, bf16, f32, other = table[name]
+        print(f"{name:<40} {f16:>10} {bf16:>10} {f32:>10} {other:>10}")
+    print("<" + "-" * 86 + ">")
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    """Context form (reference :559)."""
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+def operator_stats():
+    """Live view of the current collection (None when disabled)."""
+    return _OP_STATS[0]
+
+
+def compare_accuracy(dump_path, another_dump_path, output_filename,
+                     loss_scale=1, dump_all_tensors=False):
+    """Reference :594 compares two runs' tensor dump dirs. The TPU build's
+    equivalent workflow is jax's deterministic CPU replay; file-dump comparison
+    is not implemented."""
+    raise NotImplementedError(
+        "compare_accuracy requires the tensor-dump workflow; use "
+        "paddle_tpu.amp.debugging.tensor_stats / check_numerics instead")

@@ -283,6 +283,10 @@ class Engine:
             log_freq=10, verbose=0):
         dm = self._ensure().train()
         loader = self._as_loader(train_data, batch_size, shuffle=True)
+        if epochs > 1 and iter(loader) is loader:
+            # a bare generator would be exhausted after epoch 0, silently
+            # turning the remaining epochs into no-ops
+            loader = list(loader)
         for epoch in range(epochs):
             for step, batch in enumerate(loader):
                 if steps_per_epoch is not None and step >= steps_per_epoch:

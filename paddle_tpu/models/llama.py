@@ -277,10 +277,34 @@ class LlamaDecoderLayer(Layer):
         h = self.mlp(h)
         return residual + h
 
+    def _block_with_aux(self, hidden_states, attn_mask=None):
+        # bound method: recompute() collects this layer's parameters into the
+        # differentiation set (a plain closure would sever their gradients)
+        out = self._block(hidden_states, attn_mask)
+        aux = self.mlp.aux_loss
+        if aux is None:
+            aux = ops.to_tensor(0.0, dtype="float32")
+        return out, aux
+
+    def consume_moe_aux(self):
+        """This layer's gate balance loss from the last forward (cleared on
+        read), threaded out of any recompute segment as a real output — reading
+        gate.loss after the segment closes would leak an inner-trace tracer."""
+        aux = self._moe_aux
+        self._moe_aux = None
+        if aux is None and isinstance(self.mlp, LlamaMoEMLP):
+            aux = self.mlp.aux_loss
+        return aux
+
     def forward(self, hidden_states, attn_mask=None):
+        self._moe_aux = None
         if self._recompute and self.training:
             from ..distributed.fleet.recompute import recompute
 
+            if isinstance(self.mlp, LlamaMoEMLP):
+                out, self._moe_aux = recompute(self._block_with_aux,
+                                               hidden_states, attn_mask)
+                return out
             return recompute(self._block, hidden_states, attn_mask)
         return self._block(hidden_states, attn_mask)
 
@@ -391,11 +415,9 @@ class LlamaForCausalLM(Layer):
         (cleared on read); zero Tensor when no MoE layer ran."""
         total = None
         for layer in self.llama.layers:
-            mlp = layer.mlp
-            if isinstance(mlp, LlamaMoEMLP):
-                aux = mlp.aux_loss
-                if aux is not None:
-                    total = aux if total is None else total + aux
+            aux = layer.consume_moe_aux()
+            if aux is not None:
+                total = aux if total is None else total + aux
         if total is None:
             return ops.to_tensor(0.0, dtype="float32")
         return total
@@ -484,16 +506,18 @@ def LlamaForCausalLMPipe(config: LlamaConfig, **pp_kwargs):
     )
 
     if (getattr(config, "num_experts", 0) or 0) > 1:
-        moe_mlps = [l.mlp for l in pipe.run_function
+        moe_decs = [l for l in pipe.run_function
                     if isinstance(l, LlamaDecoderLayer)
                     and isinstance(l.mlp, LlamaMoEMLP)]
 
         def loss_with_aux(out, label):
             loss = crit(out, label)
             aux = None
-            for mlp in moe_mlps:
-                a = mlp.aux_loss
-                if a is not None:
+            for dec in moe_decs:
+                a = dec.consume_moe_aux()
+                # training only: eval loss/perplexity stays pure cross-entropy
+                # (matches LlamaForCausalLM.forward)
+                if a is not None and dec.training:
                     aux = a if aux is None else aux + a
             if aux is not None:
                 loss = loss + 0.01 * aux.astype(loss.dtype)

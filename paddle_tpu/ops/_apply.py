@@ -50,6 +50,10 @@ def _is_tensor(x):
 
 
 def _check_nan_inf(name, vals):
+    from ..amp.debugging import _op_filter
+
+    if not _op_filter(name):
+        return
     for v in vals:
         if hasattr(v, "dtype") and jnp.issubdtype(np.dtype(v.dtype), jnp.inexact):
             bad = bool(jnp.any(~jnp.isfinite(v)))
@@ -58,6 +62,21 @@ def _check_nan_inf(name, vals):
                     print(f"[paddle_tpu] nan/inf detected in output of op {name}")
                 else:
                     raise FloatingPointError(f"nan/inf detected in output of op {name}")
+
+
+_DBG_OP_STATS = None  # lazily bound to amp.debugging._OP_STATS (hot-path guard)
+
+
+def _maybe_record_op_stats(name, vals):
+    global _DBG_OP_STATS
+    if _DBG_OP_STATS is None:
+        from ..amp import debugging as _dbg
+
+        _DBG_OP_STATS = _dbg._OP_STATS
+    if _DBG_OP_STATS[0] is not None:
+        from ..amp.debugging import _record_op_call
+
+        _record_op_call(name, vals)
 
 
 def apply(opdef: OpDef, *args, **kwargs):
@@ -99,6 +118,7 @@ def apply(opdef: OpDef, *args, **kwargs):
 
     if flags.flag("check_nan_inf"):
         _check_nan_inf(opdef.name, out_vals)
+    _maybe_record_op_stats(opdef.name, out_vals)
 
     # Under graph capture the tape is off but the outer jax.vjp differentiates the whole
     # trace: stop_gradient must then propagate from inputs (paddle semantics: an output

@@ -1,0 +1,94 @@
+"""NaN/Inf checks + AMP debugging tools (reference amp/debugging.py:321)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.amp import debugging as dbg
+
+
+@pytest.fixture(autouse=True)
+def _clean_flags():
+    yield
+    dbg.disable_tensor_checker()
+    dbg._OP_STATS[0] = None
+
+
+class TestNanInfScan:
+    def test_injected_nan_reports_op_name(self):
+        dbg.enable_tensor_checker(dbg.TensorCheckerConfig(enable=True))
+        x = paddle.to_tensor(np.array([1.0, 0.0], "float32"))
+        with pytest.raises(FloatingPointError, match="divide"):
+            _ = x / paddle.to_tensor(np.array([0.0, 0.0], "float32"))
+
+    def test_print_mode_does_not_raise(self, capsys):
+        dbg.enable_tensor_checker(dbg.TensorCheckerConfig(
+            enable=True, debug_mode=dbg.DebugMode.CHECK_NAN_INF))
+        x = paddle.to_tensor(np.array([1.0], "float32"))
+        y = x / paddle.to_tensor(np.array([0.0], "float32"))
+        assert "nan/inf" in capsys.readouterr().out
+        assert np.isinf(y.numpy()).any()
+
+    def test_skipped_op_list(self):
+        cfg = dbg.TensorCheckerConfig(enable=True, skipped_op_list=["divide"])
+        dbg.enable_tensor_checker(cfg)
+        x = paddle.to_tensor(np.array([1.0], "float32"))
+        y = x / paddle.to_tensor(np.array([0.0], "float32"))  # not scanned
+        assert np.isinf(y.numpy()).any()
+
+    def test_checked_op_list_restricts(self):
+        cfg = dbg.TensorCheckerConfig(enable=True, checked_op_list=["matmul"])
+        dbg.enable_tensor_checker(cfg)
+        x = paddle.to_tensor(np.array([1.0], "float32"))
+        _ = x / paddle.to_tensor(np.array([0.0], "float32"))  # divide unchecked
+
+    def test_disable(self):
+        dbg.enable_tensor_checker(dbg.TensorCheckerConfig(enable=True))
+        dbg.disable_tensor_checker()
+        x = paddle.to_tensor(np.array([1.0], "float32"))
+        y = x / paddle.to_tensor(np.array([0.0], "float32"))
+        assert np.isinf(y.numpy()).any()
+
+
+class TestCheckNumerics:
+    def test_clean_tensor_stats(self):
+        stats = dbg.check_numerics(
+            paddle.to_tensor(np.array([1.0, -2.0, 0.0], "float32")), "op", "x")
+        assert stats["num_nan"] == 0 and stats["num_zero"] == 1
+        assert stats["min"] == -2.0 and stats["max"] == 1.0
+
+    def test_nan_aborts(self):
+        with pytest.raises(FloatingPointError, match="myop"):
+            dbg.check_numerics(
+                paddle.to_tensor(np.array([np.nan], "float32")), "myop", "x")
+
+    def test_layer_decorator(self):
+        class Net(paddle.nn.Layer):
+            @dbg.check_layer_numerics
+            def forward(self, x):
+                return x * 2
+
+        net = Net()
+        out = net(paddle.to_tensor(np.ones(3, "float32")))
+        np.testing.assert_array_equal(out.numpy(), [2, 2, 2])
+        with pytest.raises(FloatingPointError):
+            net(paddle.to_tensor(np.array([np.inf], "float32")))
+
+
+class TestOperatorStats:
+    def test_collect_counts_by_dtype(self, capsys):
+        with dbg.collect_operator_stats():
+            a = paddle.to_tensor(np.ones((2, 2), "float32"))
+            b = a.astype("bfloat16")
+            _ = paddle.matmul(a, a)
+            _ = b + b
+            table = dict(dbg.operator_stats())
+        out = capsys.readouterr().out
+        assert "matmul" in table and "Op Name" in out
+        assert table["matmul"][2] >= 1  # fp32 column
+        add_rows = [v for k, v in table.items() if "add" in k]
+        assert any(r[1] >= 1 for r in add_rows)  # bf16 column
+
+    def test_disabled_by_default(self):
+        assert dbg.operator_stats() is None
+        _ = paddle.to_tensor(np.ones(2, "float32")) * 2
+        assert dbg.operator_stats() is None

@@ -265,3 +265,21 @@ class TestLlamaMoE:
         gc = paddle.to_tensor(np.array([4, 2], "int64"))
         with pytest.raises(ValueError, match="symmetric"):
             global_scatter(x, lc, gc)
+
+    def test_recompute_moe_aux_no_tracer_leak(self):
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+        paddle.seed(0)
+        cfg = LlamaConfig(
+            vocab_size=64, hidden_size=16, intermediate_size=32,
+            num_hidden_layers=1, num_attention_heads=2,
+            num_experts=2, moe_topk=2, moe_gate="naive",
+            recompute=True, use_flash_attention=False)
+        model = LlamaForCausalLM(cfg)
+        model.train()
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 64, (2, 8)).astype("int64"))
+        loss, _ = model(ids, labels=ids)  # must not raise UnexpectedTracerError
+        loss.backward()
+        experts = model.llama.layers[0].mlp.moe.experts
+        assert any(e.gate_proj.weight.grad is not None for e in experts)
