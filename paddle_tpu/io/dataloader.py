@@ -23,6 +23,11 @@ _worker_info = threading.local()
 
 
 def get_worker_info():
+    from .worker import get_worker_info as _mp_worker_info
+
+    info = _mp_worker_info()  # set inside forked subprocess workers
+    if info is not None:
+        return info
     return getattr(_worker_info, "info", None)
 
 
@@ -74,7 +79,11 @@ class DataLoader:
         self.num_workers = num_workers
         self.prefetch_factor = max(prefetch_factor, 2)
         self.use_buffer_reader = use_buffer_reader
+        self.use_shared_memory_workers = use_shared_memory
+        self.timeout = timeout
         self.worker_init_fn = worker_init_fn
+        self.persistent_workers = persistent_workers
+        self._persistent_pool = None
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -113,30 +122,91 @@ class DataLoader:
                 yield self.collate_fn([self.dataset[i]])
             return
         if self.num_workers > 0:
-            with ThreadPoolExecutor(self.num_workers) as pool:
-                def fetch(indices):
-                    return self.collate_fn([self.dataset[i] for i in indices])
-
-                futures = []
-                it = iter(self.batch_sampler)
-                # keep prefetch_factor*workers futures in flight
-                depth = self.num_workers * self.prefetch_factor
-                try:
-                    for _ in range(depth):
-                        futures.append(pool.submit(fetch, next(it)))
-                except StopIteration:
-                    it = None
-                while futures:
-                    f = futures.pop(0)
-                    if it is not None:
-                        try:
-                            futures.append(pool.submit(fetch, next(it)))
-                        except StopIteration:
-                            it = None
-                    yield f.result()
+            if self._use_subprocess_workers():
+                yield from self._mp_batches()
+            else:
+                yield from self._thread_batches()
             return
         for indices in self.batch_sampler:
             yield self.collate_fn([self.dataset[i] for i in indices])
+
+    def _use_subprocess_workers(self):
+        """Subprocess workers (reference dataloader_iter.py:368 multiprocess
+        path) unless fork is unavailable or sample 0 is a device Tensor —
+        forked children must never touch jax, so Tensor-producing datasets fall
+        back to the GIL-sharing thread pool. The probe reads dataset[0] directly
+        (NOT through the batch sampler — that would consume one-shot samplers
+        and advance the shuffle RNG); it is best-effort, and the worker itself
+        rejects Tensors with a clear error for datasets that mix types."""
+        from .worker import fork_available
+
+        if not self.use_shared_memory_workers or not fork_available():
+            return False
+        try:
+            sample = self.dataset[0]
+        except Exception:
+            return False
+        jax_leaves = []
+
+        def scan(obj):
+            if isinstance(obj, Tensor):
+                jax_leaves.append(obj)
+            elif isinstance(obj, dict):
+                for v in obj.values():
+                    scan(v)
+            elif isinstance(obj, (list, tuple)):
+                for v in obj:
+                    scan(v)
+
+        scan(sample)
+        return not jax_leaves
+
+    def _mp_batches(self):
+        from .worker import MultiprocessBatchLoader
+
+        pool = self._persistent_pool
+        if pool is None or pool._closed:
+            pool = MultiprocessBatchLoader(
+                self.dataset, self.collate_fn,
+                num_workers=self.num_workers,
+                prefetch_factor=self.prefetch_factor,
+                use_shared_memory=True,
+                timeout=self.timeout,
+                worker_init_fn=self.worker_init_fn,
+                # python's random stream, NOT np.random: drawing from np here
+                # would advance the sampler's shuffle RNG and make batch order
+                # depend on num_workers
+                base_seed=__import__("random").getrandbits(30))
+            if self.persistent_workers:
+                self._persistent_pool = pool
+        try:
+            yield from pool.epoch(iter(self.batch_sampler))
+        finally:
+            if not self.persistent_workers:
+                pool.shutdown()
+
+    def _thread_batches(self):
+        with ThreadPoolExecutor(self.num_workers) as pool:
+            def fetch(indices):
+                return self.collate_fn([self.dataset[i] for i in indices])
+
+            futures = []
+            it = iter(self.batch_sampler)
+            # keep prefetch_factor*workers futures in flight
+            depth = self.num_workers * self.prefetch_factor
+            try:
+                for _ in range(depth):
+                    futures.append(pool.submit(fetch, next(it)))
+            except StopIteration:
+                it = None
+            while futures:
+                f = futures.pop(0)
+                if it is not None:
+                    try:
+                        futures.append(pool.submit(fetch, next(it)))
+                    except StopIteration:
+                        it = None
+                yield f.result()
 
     def __iter__(self):
         # throughput-timer hooks (profiler.timer): time this loader's fetches when

@@ -1,0 +1,290 @@
+"""Multiprocess DataLoader workers with shared-memory batch transport.
+
+Reference analog: python/paddle/io/dataloader/dataloader_iter.py:154,368
+(_DataLoaderIterMultiProcess — per-worker index queues, shared-memory tensor
+transport via core._convert_to_shared_memory, reorder by receive index, worker
+liveness watch) and worker.py (_worker_loop, WorkerInfo).
+
+TPU-first note: workers run PYTHON transform code — numpy/PIL augmentation that
+is GIL-bound under the thread pool — in forked processes; they must never touch
+jax (the forked XLA runtime is not fork-safe). The worker refuses device-Tensor
+samples with a clear error instead of hanging inside XLA. Arrays travel through
+multiprocessing.shared_memory segments: the worker writes bytes once, the queue
+carries only a descriptor, and the parent copies out and unlinks.
+"""
+from __future__ import annotations
+
+import multiprocessing
+import os
+import queue as queue_mod
+import time
+import traceback
+from multiprocessing import shared_memory
+
+import numpy as np
+
+
+class WorkerInfo:
+    def __init__(self, id, num_workers, dataset, seed=None):  # noqa: A002
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+        self.seed = seed
+
+    def __repr__(self):
+        return (f"WorkerInfo(id={self.id}, num_workers={self.num_workers})")
+
+
+_WORKER_INFO = [None]  # set inside forked worker processes
+
+
+def get_worker_info():
+    return _WORKER_INFO[0]
+
+
+# -- shared-memory packing ---------------------------------------------------
+_SHM_TAG = "__paddle_tpu_shm__"
+
+
+def _pack(obj, segments):
+    """Replace ndarrays in a collated batch tree with shm descriptors."""
+    if isinstance(obj, np.ndarray) and obj.nbytes > 0:
+        shm = shared_memory.SharedMemory(create=True, size=obj.nbytes)
+        segments.append(shm)  # appended FIRST so a later failure can clean up
+        # the PARENT owns cleanup (it unlinks after copying out); deregister
+        # from this worker's resource tracker or every worker exit spews
+        # warnings for names the parent already unlinked
+        try:
+            from multiprocessing import resource_tracker
+
+            resource_tracker.unregister(shm._name, "shared_memory")
+        except Exception:
+            pass
+        view = np.ndarray(obj.shape, obj.dtype, buffer=shm.buf)
+        view[...] = obj
+        return (_SHM_TAG, shm.name, obj.shape, str(obj.dtype))
+    if isinstance(obj, dict):
+        return {k: _pack(v, segments) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        packed = [_pack(v, segments) for v in obj]
+        return packed if isinstance(obj, list) else tuple(packed)
+    return obj
+
+
+def _unpack(obj):
+    """Reconstruct ndarrays from shm descriptors (copy out, close + unlink)."""
+    if isinstance(obj, tuple) and len(obj) == 4 and obj[0] == _SHM_TAG:
+        _, name, shape, dtype = obj
+        shm = shared_memory.SharedMemory(name=name)
+        try:
+            arr = np.array(
+                np.ndarray(shape, np.dtype(dtype), buffer=shm.buf))
+        finally:
+            shm.close()
+            try:
+                shm.unlink()
+            except FileNotFoundError:
+                pass
+        return arr
+    if isinstance(obj, dict):
+        return {k: _unpack(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_unpack(v) for v in obj]
+    if isinstance(obj, tuple):
+        return tuple(_unpack(v) for v in obj)
+    return obj
+
+
+def _contains_device_tensor(obj):
+    """Type-name check only — must not import jax in the worker."""
+    tname = type(obj).__name__
+    if tname in ("Tensor", "Parameter", "ArrayImpl"):
+        return True
+    if isinstance(obj, dict):
+        return any(_contains_device_tensor(v) for v in obj.values())
+    if isinstance(obj, (list, tuple)):
+        return any(_contains_device_tensor(v) for v in obj)
+    return False
+
+
+def _worker_loop(dataset, collate_fn, index_queue, result_queue, worker_id,
+                 num_workers, use_shared_memory, worker_init_fn, base_seed):
+    """Body of one forked worker (reference worker.py _worker_loop)."""
+    _WORKER_INFO[0] = WorkerInfo(worker_id, num_workers, dataset,
+                                 seed=(base_seed + worker_id
+                                       if base_seed is not None else None))
+    if base_seed is not None:
+        np.random.seed((base_seed + worker_id) % (2 ** 31))
+    try:
+        if worker_init_fn is not None:
+            worker_init_fn(worker_id)
+    except Exception:  # noqa: BLE001
+        result_queue.put(("error", -1, traceback.format_exc()))
+        return
+    while True:
+        job = index_queue.get()
+        if job is None:
+            return
+        seq, indices = job
+        segments = []
+        try:
+            samples = [dataset[i] for i in indices]
+            if _contains_device_tensor(samples):
+                raise TypeError(
+                    "dataset returned device Tensors inside a forked worker; "
+                    "forked children must not touch jax — use a numpy-returning "
+                    "dataset or set DataLoader(use_shared_memory=False) for the "
+                    "thread fallback")
+            batch = collate_fn(samples)
+            if use_shared_memory:
+                payload = _pack(batch, segments)
+                result_queue.put(("ok", seq, payload))
+                for shm in segments:
+                    shm.close()  # parent unlinks after copying out
+            else:
+                result_queue.put(("ok", seq, batch))
+        except Exception:  # noqa: BLE001 — surfaced in the parent
+            for shm in segments:  # partial _pack: reclaim created segments
+                try:
+                    shm.close()
+                    shm.unlink()
+                except Exception:
+                    pass
+            result_queue.put(("error", seq, traceback.format_exc()))
+
+
+class MultiprocessBatchLoader:
+    """Order-preserving fan-out of index batches over forked workers.
+
+    Reusable across epochs (reference persistent_workers): call ``epoch(it)``
+    per pass; ``shutdown()`` when done.
+    """
+
+    _POLL_S = 2.0  # liveness check cadence while waiting on results
+
+    def __init__(self, dataset, collate_fn, num_workers,
+                 prefetch_factor=2, use_shared_memory=True, timeout=0,
+                 worker_init_fn=None, base_seed=None):
+        self._ctx = multiprocessing.get_context("fork")
+        self._index_queues = [self._ctx.Queue() for _ in range(num_workers)]
+        self._result_queue = self._ctx.Queue()
+        self._timeout = timeout or None
+        self._num_workers = num_workers
+        self._max_outstanding = num_workers * max(prefetch_factor, 2)
+        self._send_seq = 0
+        self._recv_seq = 0
+        self._workers = [
+            self._ctx.Process(
+                target=_worker_loop,
+                args=(dataset, collate_fn, self._index_queues[wid],
+                      self._result_queue, wid, num_workers, use_shared_memory,
+                      worker_init_fn, base_seed),
+                daemon=True)
+            for wid in range(num_workers)
+        ]
+        for p in self._workers:
+            p.start()
+        self._closed = False
+
+    def _check_alive(self):
+        dead = [i for i, p in enumerate(self._workers) if not p.is_alive()]
+        if dead:
+            self.shutdown()
+            raise RuntimeError(
+                f"DataLoader worker(s) {dead} exited unexpectedly "
+                "(killed or crashed before reporting)")
+
+    def _get_result(self):
+        """result_queue.get with liveness polling so a dead worker raises
+        instead of blocking forever (reference watchdog semantics)."""
+        deadline = (time.monotonic() + self._timeout
+                    if self._timeout is not None else None)
+        while True:
+            try:
+                return self._result_queue.get(timeout=self._POLL_S)
+            except queue_mod.Empty:
+                self._check_alive()
+                if deadline is not None and time.monotonic() > deadline:
+                    self.shutdown()
+                    raise TimeoutError(
+                        f"DataLoader worker timed out after {self._timeout}s "
+                        "(stuck transform?)") from None
+
+    def epoch(self, batch_indices_iter):
+        """Yield collated batches for one pass over the given index batches."""
+        if self._closed:
+            raise RuntimeError("MultiprocessBatchLoader already shut down")
+        it = iter(batch_indices_iter)
+        outstanding = 0
+        reorder = {}
+
+        def feed():
+            nonlocal it, outstanding
+            while outstanding < self._max_outstanding and it is not None:
+                try:
+                    indices = next(it)
+                except StopIteration:
+                    it = None
+                    return
+                wid = self._send_seq % self._num_workers
+                self._index_queues[wid].put((self._send_seq, list(indices)))
+                self._send_seq += 1
+                outstanding += 1
+
+        try:
+            feed()
+            while outstanding > 0:
+                while self._recv_seq in reorder:
+                    batch = reorder.pop(self._recv_seq)
+                    self._recv_seq += 1
+                    outstanding -= 1
+                    feed()
+                    yield batch
+                if outstanding == 0:
+                    break
+                status, seq, payload = self._get_result()
+                if status == "error":
+                    self.shutdown()
+                    raise RuntimeError(
+                        f"DataLoader worker failed:\n{payload}")
+                reorder[seq] = _unpack(payload)
+        except GeneratorExit:
+            # consumer abandoned the epoch mid-way: outstanding results would
+            # desynchronize seq bookkeeping; tear the pool down
+            self.shutdown()
+            raise
+
+    def shutdown(self):
+        if self._closed:
+            return
+        self._closed = True
+        for q in self._index_queues:
+            try:
+                q.put(None)
+            except (ValueError, OSError):
+                pass
+        for p in self._workers:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+        # drain in-flight results and unlink their shm segments; a feeder
+        # thread may still be flushing, so poll with a short timeout until
+        # the pipe stays empty
+        empty_rounds = 0
+        while empty_rounds < 2:
+            try:
+                status, _, payload = self._result_queue.get(timeout=0.2)
+                if status == "ok":
+                    _unpack(payload)
+            except queue_mod.Empty:
+                empty_rounds += 1
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass
+
+
+def fork_available():
+    return os.name == "posix" and "fork" in multiprocessing.get_all_start_methods()

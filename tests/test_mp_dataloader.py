@@ -1,0 +1,205 @@
+"""Multiprocess DataLoader workers (reference dataloader_iter.py:154,368).
+
+The subprocess path must beat the GIL-bound thread pool on Python-heavy
+transforms, preserve batch order, propagate worker errors, and fall back to
+threads for Tensor-producing datasets."""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import DataLoader, Dataset
+
+
+class HeavyTransformDs(Dataset):
+    """Pure-Python CPU work per item — the GIL-bound worst case for threads."""
+
+    def __init__(self, n=48, work=30000):
+        self.n = n
+        self.work = work
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        acc = 0
+        for k in range(self.work):  # deliberately GIL-holding Python loop
+            acc += (i * k) % 7
+        return np.full((16,), float(acc % 100), "float32"), np.int64(i % 3)
+
+
+class SimpleDs(Dataset):
+    def __init__(self, n=32):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return np.full((4,), float(i), "float32"), np.int64(i)
+
+
+class FailingDs(Dataset):
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        if i == 5:
+            raise ValueError("boom at index 5")
+        return np.zeros(2, "float32")
+
+
+class TensorDs(Dataset):
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        return paddle.to_tensor(np.full((2,), float(i), "float32"))
+
+
+def _drain(loader):
+    return [b for b in loader]
+
+
+class TestCorrectness:
+    def test_order_and_values_match_sequential(self):
+        ds = SimpleDs(32)
+        seq = _drain(DataLoader(ds, batch_size=4, num_workers=0,
+                                use_buffer_reader=False))
+        mp = _drain(DataLoader(ds, batch_size=4, num_workers=3,
+                               use_buffer_reader=False))
+        assert len(seq) == len(mp) == 8
+        for a, b in zip(seq, mp):
+            np.testing.assert_array_equal(np.asarray(a[0].value),
+                                          np.asarray(b[0].value))
+            np.testing.assert_array_equal(np.asarray(a[1].value),
+                                          np.asarray(b[1].value))
+
+    def test_worker_error_propagates(self):
+        loader = DataLoader(FailingDs(), batch_size=2, num_workers=2,
+                            use_buffer_reader=False)
+        with pytest.raises(RuntimeError, match="boom at index 5"):
+            _drain(loader)
+
+    def test_tensor_dataset_falls_back_to_threads(self):
+        loader = DataLoader(TensorDs(), batch_size=2, num_workers=2,
+                            use_buffer_reader=False)
+        assert not loader._use_subprocess_workers()
+        out = _drain(loader)
+        assert len(out) == 4
+
+    def test_worker_init_fn_and_info(self):
+        seen = []
+
+        class InfoDs(Dataset):
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                from paddle_tpu.io.dataloader import get_worker_info
+
+                info = get_worker_info()
+                return np.asarray(
+                    [i, -1 if info is None else info.id], "int64")
+
+        loader = DataLoader(InfoDs(), batch_size=1, num_workers=2,
+                            use_buffer_reader=False)
+        rows = np.concatenate([np.asarray(b.value) for b in _drain(loader)])
+        # every row carries a real worker id (0..1), not the parent's None
+        assert set(rows[:, 1].tolist()) <= {0, 1}
+
+    def test_shared_memory_roundtrip_types(self):
+        class MixedDs(Dataset):
+            def __len__(self):
+                return 6
+
+            def __getitem__(self, i):
+                return {"x": np.full((3, 2), i, "float32"),
+                        "meta": {"idx": np.int64(i)},
+                        "name": f"s{i}"}
+
+        loader = DataLoader(MixedDs(), batch_size=3, num_workers=2,
+                            use_buffer_reader=False)
+        batches = _drain(loader)
+        assert len(batches) == 2
+        assert batches[0]["x"].shape == [3, 3, 2]
+        assert batches[0]["name"] == ["s0", "s1", "s2"]
+
+
+class TestThroughput:
+    @pytest.mark.skipif((__import__("os").cpu_count() or 1) < 2,
+                        reason="parallel speedup needs >1 physical core "
+                               "(forked workers verified correct on 1 core)")
+    def test_subprocess_workers_beat_threads_on_python_transforms(self):
+        """VERDICT round-1 #10: transform-heavy loading must scale past the GIL."""
+        ds = HeavyTransformDs(n=64, work=400000)
+
+        def timed(num_workers, force_threads=False):
+            loader = DataLoader(ds, batch_size=4, num_workers=num_workers,
+                                use_buffer_reader=False)
+            if force_threads:
+                loader.use_shared_memory_workers = False  # thread fallback
+            start = time.perf_counter()
+            n = len(_drain(loader))
+            assert n == 16
+            return time.perf_counter() - start
+
+        t_seq = timed(0)
+        t_threads = timed(4, force_threads=True)
+        t_mp = timed(4)
+        # forked workers parallelize the GIL-bound transform; threads cannot
+        assert t_mp < t_seq / 1.8, (t_mp, t_seq, t_threads)
+        assert t_mp < t_threads / 1.5, (t_mp, t_seq, t_threads)
+
+
+class TestReviewFixes:
+    def test_persistent_workers_reused_across_epochs(self):
+        loader = DataLoader(SimpleDs(16), batch_size=4, num_workers=2,
+                            use_buffer_reader=False, persistent_workers=True)
+        e1 = _drain(loader)
+        pool = loader._persistent_pool
+        assert pool is not None and not pool._closed
+        e2 = _drain(loader)
+        assert loader._persistent_pool is pool  # same forked pool both epochs
+        assert len(e1) == len(e2) == 4
+        pool.shutdown()
+
+    def test_probe_does_not_consume_sampler(self):
+        """The subprocess-path probe must not draw from the batch sampler: a
+        seeded shuffle must produce identical batch order for 0 and N workers."""
+        ds = SimpleDs(32)
+        np.random.seed(123)
+        seq = [np.asarray(b[1].value).tolist()
+               for b in DataLoader(ds, batch_size=4, shuffle=True,
+                                   num_workers=0, use_buffer_reader=False)]
+        np.random.seed(123)
+        mp = [np.asarray(b[1].value).tolist()
+              for b in DataLoader(ds, batch_size=4, shuffle=True,
+                                  num_workers=2, use_buffer_reader=False)]
+        assert seq == mp
+
+    def test_tensor_sample_in_worker_raises_clearly(self):
+        class LateTensorDs(Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                if i >= 4:  # probe sees numpy; workers later hit Tensors
+                    return paddle.to_tensor(np.zeros(2, "float32"))
+                return np.zeros(2, "float32")
+
+        loader = DataLoader(LateTensorDs(), batch_size=2, num_workers=2,
+                            use_buffer_reader=False)
+        with pytest.raises(RuntimeError, match="must not touch jax"):
+            _drain(loader)
+
+    def test_early_break_shuts_down_pool(self):
+        loader = DataLoader(SimpleDs(32), batch_size=2, num_workers=2,
+                            use_buffer_reader=False)
+        for b in loader:
+            break  # abandon mid-epoch; pool must tear down without leaks
+        import glob
+        leaked = glob.glob("/dev/shm/psm_*")
+        # no unbounded growth of shm segments from the abandoned epoch
+        assert len(leaked) < 50
