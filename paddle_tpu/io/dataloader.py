@@ -84,6 +84,7 @@ class DataLoader:
         self.worker_init_fn = worker_init_fn
         self.persistent_workers = persistent_workers
         self._persistent_pool = None
+        self._mp_decision = None
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -140,11 +141,15 @@ class DataLoader:
         rejects Tensors with a clear error for datasets that mix types."""
         from .worker import fork_available
 
+        if self._mp_decision is not None:
+            return self._mp_decision  # probe once, not one sample per epoch
         if not self.use_shared_memory_workers or not fork_available():
+            self._mp_decision = False
             return False
         try:
             sample = self.dataset[0]
         except Exception:
+            self._mp_decision = False
             return False
         jax_leaves = []
 
@@ -159,7 +164,8 @@ class DataLoader:
                     scan(v)
 
         scan(sample)
-        return not jax_leaves
+        self._mp_decision = not jax_leaves
+        return self._mp_decision
 
     def _mp_batches(self):
         from .worker import MultiprocessBatchLoader

@@ -51,15 +51,6 @@ def _pack(obj, segments):
     if isinstance(obj, np.ndarray) and obj.nbytes > 0:
         shm = shared_memory.SharedMemory(create=True, size=obj.nbytes)
         segments.append(shm)  # appended FIRST so a later failure can clean up
-        # the PARENT owns cleanup (it unlinks after copying out); deregister
-        # from this worker's resource tracker or every worker exit spews
-        # warnings for names the parent already unlinked
-        try:
-            from multiprocessing import resource_tracker
-
-            resource_tracker.unregister(shm._name, "shared_memory")
-        except Exception:
-            pass
         view = np.ndarray(obj.shape, obj.dtype, buffer=shm.buf)
         view[...] = obj
         return (_SHM_TAG, shm.name, obj.shape, str(obj.dtype))
@@ -107,6 +98,23 @@ def _contains_device_tensor(obj):
     return False
 
 
+def _disown_and_close(segments, unlink=False):
+    for shm in segments:
+        try:
+            from multiprocessing import resource_tracker
+
+            resource_tracker.unregister(shm._name, "shared_memory")
+        except Exception:
+            pass
+        try:
+            shm.close()
+            if unlink:
+                shm.unlink()
+        except Exception:
+            pass
+    segments.clear()
+
+
 def _worker_loop(dataset, collate_fn, index_queue, result_queue, worker_id,
                  num_workers, use_shared_memory, worker_init_fn, base_seed):
     """Body of one forked worker (reference worker.py _worker_loop)."""
@@ -114,7 +122,12 @@ def _worker_loop(dataset, collate_fn, index_queue, result_queue, worker_id,
                                  seed=(base_seed + worker_id
                                        if base_seed is not None else None))
     if base_seed is not None:
+        import random
+
         np.random.seed((base_seed + worker_id) % (2 ** 31))
+        # python's random too: forked workers otherwise share the parent's
+        # Mersenne state and draw identical augmentation streams
+        random.seed(base_seed + worker_id)
     try:
         if worker_init_fn is not None:
             worker_init_fn(worker_id)
@@ -136,20 +149,25 @@ def _worker_loop(dataset, collate_fn, index_queue, result_queue, worker_id,
                     "dataset or set DataLoader(use_shared_memory=False) for the "
                     "thread fallback")
             batch = collate_fn(samples)
+            if _contains_device_tensor(batch):
+                raise TypeError(
+                    "collate_fn produced device Tensors inside a forked "
+                    "worker; forked children must not touch jax — collate to "
+                    "numpy (the parent stages to device) or set "
+                    "DataLoader(use_shared_memory=False)")
             if use_shared_memory:
                 payload = _pack(batch, segments)
                 result_queue.put(("ok", seq, payload))
-                for shm in segments:
-                    shm.close()  # parent unlinks after copying out
+                # only after a successful put does the parent own cleanup:
+                # deregister from this worker's resource tracker (so worker
+                # exit doesn't warn about names the parent unlinks) and close.
+                # If the worker is killed BEFORE the put, the tracker still
+                # owns the segments and reclaims them at exit.
+                _disown_and_close(segments)
             else:
                 result_queue.put(("ok", seq, batch))
         except Exception:  # noqa: BLE001 — surfaced in the parent
-            for shm in segments:  # partial _pack: reclaim created segments
-                try:
-                    shm.close()
-                    shm.unlink()
-                except Exception:
-                    pass
+            _disown_and_close(segments, unlink=True)  # reclaim partial packs
             result_queue.put(("error", seq, traceback.format_exc()))
 
 
@@ -214,6 +232,14 @@ class MultiprocessBatchLoader:
         """Yield collated batches for one pass over the given index batches."""
         if self._closed:
             raise RuntimeError("MultiprocessBatchLoader already shut down")
+        if getattr(self, "_epoch_active", False):
+            # two interleaved epochs would steal each other's results off the
+            # shared result queue and hang on sequence numbers the other took
+            raise RuntimeError(
+                "a previous epoch over this worker pool is still active; "
+                "finish it (or use a second DataLoader) before starting "
+                "another pass")
+        self._epoch_active = True
         it = iter(batch_indices_iter)
         outstanding = 0
         reorder = {}
@@ -253,6 +279,8 @@ class MultiprocessBatchLoader:
             # desynchronize seq bookkeeping; tear the pool down
             self.shutdown()
             raise
+        finally:
+            self._epoch_active = False
 
     def shutdown(self):
         if self._closed:

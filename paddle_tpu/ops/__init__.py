@@ -33,7 +33,7 @@ from .reduction import (  # noqa: F401
 )
 from .manipulation import (  # noqa: F401
     as_strided, atleast_1d, atleast_2d, atleast_3d, broadcast_shape, broadcast_tensors,
-    broadcast_to, cast, chunk, concat, crop, expand, expand_as, flatten, flip, gather,
+    broadcast_to, cast, chunk, concat, crop, diff, expand, expand_as, flatten, flip, gather,
     gather_nd, index_add, index_fill, index_put, index_sample, index_select, masked_fill,
     masked_scatter, masked_select, moveaxis, nonzero, pad, repeat_interleave, reshape,
     reshape_, roll, rot90, scatter, scatter_, scatter_nd, scatter_nd_add, shard_index, slice,

@@ -251,6 +251,18 @@ def roll(x, shifts, axis=None, name=None):
                  axis=_ints(axis) if axis is not None else None)
 
 
+@defop("diff")
+def _diff(x, prepend=None, append=None, n=1, axis=-1):
+    # reference: python/paddle/tensor/math.py diff (n-th forward difference)
+    parts = [p for p in (prepend, x, append) if p is not None]
+    v = jnp.concatenate(parts, axis=axis) if len(parts) > 1 else parts[0]
+    return jnp.diff(v, n=n, axis=axis)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    return _diff(x, prepend, append, n=int(n), axis=int(axis))
+
+
 @defop("gather")
 def _gather(x, index, axis=0):
     return jnp.take(x, index, axis=axis)

@@ -1,0 +1,150 @@
+"""OpTest: numeric rigor harness for the op library.
+
+Reference analog: test/legacy_test/op_test.py:418 (OpTest.check_output /
+check_grad — forward vs numpy, analytic grad vs central finite difference,
+dtype/place sweeps with per-dtype thresholds).
+
+TPU-first shape: one declarative OpCase per op; the harness
+1. runs the eager op on float32 and compares against the numpy reference,
+2. re-runs on bfloat16 with loose thresholds (the TPU production dtype),
+3. checks the tape's analytic gradient against a float64 central finite
+   difference of the op itself (x64 is enabled, so fp64 FD is trustworthy),
+4. optionally runs integer-dtype forwards.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+class OpCase:
+    def __init__(self, name, fn, ref, inputs, kwargs=None, grad=True,
+                 dtypes=("float32", "bfloat16"), int_dtypes=(),
+                 rtol=1e-5, atol=1e-6, bf16_rtol=2e-2, bf16_atol=2e-2,
+                 grad_rtol=5e-3, grad_atol=5e-4, positive=False,
+                 grad_inputs=None):
+        self.name = name
+        self.fn = fn            # callable over paddle Tensors
+        self.ref = ref          # callable over numpy arrays
+        self.inputs = inputs    # list of shapes (tuples)
+        self.kwargs = kwargs or {}
+        self.grad = grad
+        self.dtypes = dtypes
+        self.int_dtypes = int_dtypes
+        self.rtol, self.atol = rtol, atol
+        self.bf16_rtol, self.bf16_atol = bf16_rtol, bf16_atol
+        self.grad_rtol, self.grad_atol = grad_rtol, grad_atol
+        self.positive = positive          # draw inputs in (0.2, 2) not (-1, 1)
+        self.grad_inputs = grad_inputs    # indices to grad-check (default: all)
+
+    def _draw(self, rng, shape, dtype):
+        if self.positive:
+            arr = rng.uniform(0.25, 2.0, size=shape)
+        else:
+            arr = rng.uniform(-1.0, 1.0, size=shape)
+        return arr.astype(dtype)
+
+    # -- forward -------------------------------------------------------------
+    def run_forward(self):
+        rng = np.random.RandomState(hash(self.name) % (2 ** 31))
+        base = [self._draw(rng, s, "float64") for s in self.inputs]
+        expect = self.ref(*[b.copy() for b in base], **self.kwargs)
+        for dtype in self.dtypes:
+            arrs = [b.astype(np.float32) for b in base]
+            tensors = [paddle.to_tensor(a) for a in arrs]
+            if dtype == "bfloat16":
+                tensors = [t.astype("bfloat16") for t in tensors]
+                rtol, atol = self.bf16_rtol, self.bf16_atol
+            else:
+                rtol, atol = self.rtol, self.atol
+            out = self.fn(*tensors, **self.kwargs)
+            outs = out if isinstance(out, (tuple, list)) else [out]
+            exps = expect if isinstance(expect, (tuple, list)) else [expect]
+            for o, e in zip(outs, exps):
+                got = np.asarray(o.value, dtype=np.float64) \
+                    if hasattr(o, "value") else np.asarray(o, np.float64)
+                np.testing.assert_allclose(
+                    got, np.asarray(e, np.float64), rtol=rtol, atol=atol,
+                    err_msg=f"{self.name} forward mismatch on {dtype}")
+
+    def run_int_forward(self):
+        rng = np.random.RandomState(hash(self.name) % (2 ** 31))
+        for dtype in self.int_dtypes:
+            base = [rng.randint(1, 8, size=s).astype(dtype)
+                    for s in self.inputs]
+            expect = self.ref(*[b.copy() for b in base], **self.kwargs)
+            out = self.fn(*[paddle.to_tensor(b) for b in base], **self.kwargs)
+            outs = out if isinstance(out, (tuple, list)) else [out]
+            exps = expect if isinstance(expect, (tuple, list)) else [expect]
+            for o, e in zip(outs, exps):
+                np.testing.assert_allclose(
+                    np.asarray(o.value, np.float64),
+                    np.asarray(e, np.float64), rtol=0, atol=0,
+                    err_msg=f"{self.name} int forward mismatch on {dtype}")
+
+    # -- gradient ------------------------------------------------------------
+    def run_grad(self):
+        """Analytic tape gradient vs float64 central finite difference of a
+        fixed random scalarization L = sum(op(x) * w)."""
+        if not self.grad:
+            return
+        rng = np.random.RandomState(hash(self.name) % (2 ** 31) + 1)
+        base = [self._draw(rng, s, "float64") for s in self.inputs]
+
+        def scalarize(out):
+            outs = out if isinstance(out, (tuple, list)) else [out]
+            total = 0.0
+            for i, o in enumerate(outs):
+                arr = o if isinstance(o, np.ndarray) else None
+                if arr is None:
+                    w = self._w[i]
+                    total = total + (o.astype("float64") * paddle.to_tensor(w)).sum()
+                else:
+                    total = total + float((arr * self._w[i]).sum())
+            return total
+
+        # fixed weights per output
+        probe = self.ref(*[b.copy() for b in base], **self.kwargs)
+        probes = probe if isinstance(probe, (tuple, list)) else [probe]
+        wrng = np.random.RandomState(7)
+        self._w = [wrng.uniform(0.5, 1.5, size=np.shape(p)) for p in probes]
+
+        which = (self.grad_inputs if self.grad_inputs is not None
+                 else range(len(base)))
+
+        # analytic: float64 tensors through the tape
+        tensors = [paddle.to_tensor(b, stop_gradient=(i not in which))
+                   for i, b in enumerate(base)]
+        loss = scalarize(self.fn(*tensors, **self.kwargs))
+        loss.backward()
+        analytic = {i: np.asarray(tensors[i].grad.value, np.float64)
+                    for i in which}
+
+        # FD on the numpy reference-independent op itself (float64)
+        eps = 1e-5
+        for i in which:
+            fd = np.zeros_like(base[i])
+            flat = base[i].reshape(-1)
+            fdf = fd.reshape(-1)
+            for j in range(flat.size):
+                orig = flat[j]
+                flat[j] = orig + eps
+                lp = float(np.sum([
+                    (np.asarray(o) * w).sum() for o, w in zip(
+                        _aslist(self.ref(*[b.copy() for b in base],
+                                         **self.kwargs)), self._w)]))
+                flat[j] = orig - eps
+                lm = float(np.sum([
+                    (np.asarray(o) * w).sum() for o, w in zip(
+                        _aslist(self.ref(*[b.copy() for b in base],
+                                         **self.kwargs)), self._w)]))
+                flat[j] = orig
+                fdf[j] = (lp - lm) / (2 * eps)
+            np.testing.assert_allclose(
+                analytic[i], fd, rtol=self.grad_rtol, atol=self.grad_atol,
+                err_msg=f"{self.name} grad mismatch on input {i}")
+
+
+def _aslist(x):
+    return x if isinstance(x, (tuple, list)) else [x]

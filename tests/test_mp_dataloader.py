@@ -203,3 +203,39 @@ class TestReviewFixes:
         leaked = glob.glob("/dev/shm/psm_*")
         # no unbounded growth of shm segments from the abandoned epoch
         assert len(leaked) < 50
+
+    def test_collate_fn_producing_tensors_raises(self):
+        loader = DataLoader(
+            SimpleDs(8), batch_size=2, num_workers=2, use_buffer_reader=False,
+            collate_fn=lambda b: paddle.to_tensor(np.stack([x for x, _ in b])))
+        with pytest.raises(RuntimeError, match="must not touch jax"):
+            _drain(loader)
+
+    def test_concurrent_epochs_on_persistent_pool_rejected(self):
+        loader = DataLoader(SimpleDs(16), batch_size=2, num_workers=2,
+                            use_buffer_reader=False, persistent_workers=True)
+        it1 = iter(loader)
+        next(it1)
+        it2 = iter(loader)
+        with pytest.raises(RuntimeError, match="still active"):
+            next(it2)
+        it1.close()
+        loader._persistent_pool and loader._persistent_pool.shutdown()
+
+    def test_probe_decision_cached(self):
+        calls = []
+
+        class CountingDs(Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                calls.append(i)
+                return np.zeros(2, "float32")
+
+        loader = DataLoader(CountingDs(), batch_size=2, num_workers=2,
+                            use_buffer_reader=False)
+        _drain(loader)
+        parent_probe_calls = calls.count(0)  # parent-side list (fork copies)
+        _drain(loader)
+        assert calls.count(0) == parent_probe_calls  # no re-probe on epoch 2

@@ -1,0 +1,326 @@
+"""OpTest sweep: forward vs numpy + analytic-vs-FD grads + dtype coverage for
+the top ~100 ops (reference test/legacy_test/op_test.py methodology)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+from op_test import OpCase
+
+S = (3, 4)          # default test shape
+V = (6,)            # vector shape
+SQ = (4, 4)         # square
+
+
+def _sp(x):  # numpy softplus without overflow
+    return np.logaddexp(0.0, x)
+
+
+CASES = [
+    # ---- unary math ----
+    OpCase("abs", paddle.abs, np.abs, [S]),
+    OpCase("exp", paddle.exp, np.exp, [S]),
+    OpCase("expm1", paddle.expm1, np.expm1, [S]),
+    OpCase("log", paddle.log, np.log, [S], positive=True),
+    OpCase("log2", paddle.log2, np.log2, [S], positive=True),
+    OpCase("log10", paddle.log10, np.log10, [S], positive=True),
+    OpCase("log1p", paddle.log1p, np.log1p, [S], positive=True),
+    OpCase("sqrt", paddle.sqrt, np.sqrt, [S], positive=True),
+    OpCase("rsqrt", paddle.rsqrt, lambda x: 1 / np.sqrt(x), [S], positive=True),
+    OpCase("sin", paddle.sin, np.sin, [S]),
+    OpCase("cos", paddle.cos, np.cos, [S]),
+    OpCase("tan", paddle.tan, np.tan, [S]),
+    OpCase("asin", paddle.asin, np.arcsin, [S]),
+    OpCase("acos", paddle.acos, np.arccos, [S]),
+    OpCase("atan", paddle.atan, np.arctan, [S]),
+    OpCase("sinh", paddle.sinh, np.sinh, [S]),
+    OpCase("cosh", paddle.cosh, np.cosh, [S]),
+    OpCase("tanh", paddle.tanh, np.tanh, [S]),
+    OpCase("asinh", paddle.asinh, np.arcsinh, [S]),
+    OpCase("acosh", lambda x: paddle.acosh(x + 1.5),
+           lambda x: np.arccosh(x + 1.5), [S], positive=True),
+    OpCase("atanh", paddle.atanh, np.arctanh, [S]),
+    OpCase("floor", paddle.floor, np.floor, [S], grad=False,
+           dtypes=("float32",)),  # bf16 quantization crosses integer steps
+    OpCase("ceil", paddle.ceil, np.ceil, [S], grad=False,
+           dtypes=("float32",)),  # bf16 quantization crosses integer steps
+    OpCase("round", paddle.round, np.round, [S], grad=False,
+           dtypes=("float32",)),  # bf16 quantization crosses integer steps
+    OpCase("sign", paddle.sign, np.sign, [S], grad=False),
+    OpCase("square", paddle.square, np.square, [S]),
+    OpCase("reciprocal", paddle.reciprocal, np.reciprocal, [S], positive=True),
+    OpCase("neg", paddle.neg, np.negative, [S]),
+    OpCase("erf", paddle.erf, None, [S]),
+    OpCase("lgamma", paddle.lgamma, None, [S], positive=True, grad=False),
+    OpCase("digamma", paddle.digamma, None, [S], positive=True, grad=False),
+    OpCase("frac", paddle.frac, lambda x: x - np.trunc(x), [S], grad=False,
+           dtypes=("float32",)),
+    OpCase("trunc", paddle.trunc, np.trunc, [S], grad=False,
+           dtypes=("float32",)),  # bf16 quantization crosses integer steps
+    OpCase("deg2rad", paddle.deg2rad, np.deg2rad, [S]),
+    OpCase("rad2deg", paddle.rad2deg, np.rad2deg, [S]),
+    OpCase("logit", lambda x: paddle.logit(x * 0.3 + 0.5),
+           lambda x: (lambda p: np.log(p / (1 - p)))(x * 0.3 + 0.5), [S]),
+    # ---- binary math ----
+    OpCase("add", paddle.add, np.add, [S, S], int_dtypes=("int32", "int64")),
+    OpCase("subtract", paddle.subtract, np.subtract, [S, S],
+           int_dtypes=("int32",)),
+    OpCase("multiply", paddle.multiply, np.multiply, [S, S],
+           int_dtypes=("int32",)),
+    OpCase("divide", paddle.divide, np.divide, [S, S], positive=True),
+    OpCase("pow", paddle.pow, np.power, [S, S], positive=True),
+    OpCase("maximum", paddle.maximum, np.maximum, [S, S]),
+    OpCase("minimum", paddle.minimum, np.minimum, [S, S]),
+    OpCase("fmax", paddle.fmax, np.fmax, [S, S]),
+    OpCase("fmin", paddle.fmin, np.fmin, [S, S]),
+    OpCase("mod", paddle.mod, np.mod, [S, S], positive=True, grad=False),
+    OpCase("floor_divide", paddle.floor_divide, np.floor_divide, [S, S],
+           positive=True, grad=False),
+    OpCase("atan2", paddle.atan2, np.arctan2, [S, S]),
+    OpCase("hypot", paddle.hypot, np.hypot, [S, S]),
+    OpCase("logaddexp", paddle.logaddexp, np.logaddexp, [S, S]),
+    OpCase("copysign", paddle.copysign, np.copysign, [S, S], grad=False),
+    OpCase("heaviside", paddle.heaviside, np.heaviside, [S, S], grad=False),
+    OpCase("lerp",
+           lambda x, y, w: paddle.lerp(x, y, w),
+           lambda x, y, w: x + w * (y - x), [S, S, S]),
+    OpCase("nextafter", paddle.nextafter, np.nextafter, [S, S], grad=False,
+           dtypes=("float32",)),
+    # ---- broadcasting ----
+    OpCase("add_broadcast", paddle.add, np.add, [(3, 1), (1, 4)]),
+    OpCase("mul_broadcast", paddle.multiply, np.multiply, [(2, 3, 1), (3, 4)]),
+    # ---- reductions ----
+    OpCase("sum", paddle.sum, lambda x: np.sum(x), [S]),
+    OpCase("sum_axis", lambda x: paddle.sum(x, axis=1),
+           lambda x: np.sum(x, axis=1), [S]),
+    OpCase("sum_keepdim", lambda x: paddle.sum(x, axis=0, keepdim=True),
+           lambda x: np.sum(x, axis=0, keepdims=True), [S]),
+    OpCase("mean", paddle.mean, lambda x: np.mean(x), [S]),
+    OpCase("mean_axis", lambda x: paddle.mean(x, axis=-1),
+           lambda x: np.mean(x, axis=-1), [S]),
+    OpCase("prod", paddle.prod, lambda x: np.prod(x), [V], positive=True),
+    OpCase("max_red", lambda x: paddle.max(x, axis=1),
+           lambda x: np.max(x, axis=1), [S], grad=False),
+    OpCase("min_red", lambda x: paddle.min(x, axis=1),
+           lambda x: np.min(x, axis=1), [S], grad=False),
+    OpCase("amax", lambda x: paddle.amax(x, axis=0),
+           lambda x: np.max(x, axis=0), [S], grad=False),
+    OpCase("amin", lambda x: paddle.amin(x, axis=0),
+           lambda x: np.min(x, axis=0), [S], grad=False),
+    OpCase("std", lambda x: paddle.std(x),
+           lambda x: np.std(x, ddof=1), [S]),
+    OpCase("var", lambda x: paddle.var(x),
+           lambda x: np.var(x, ddof=1), [S]),
+    OpCase("logsumexp", lambda x: paddle.logsumexp(x, axis=1),
+           lambda x: np.log(np.sum(np.exp(x), axis=1)), [S]),
+    OpCase("nansum", paddle.nansum, lambda x: np.nansum(x), [S]),
+    OpCase("nanmean", paddle.nanmean, lambda x: np.nanmean(x), [S]),
+    OpCase("count_nonzero", paddle.count_nonzero,
+           lambda x: np.count_nonzero(x), [S], grad=False),
+    # ---- cumulative ----
+    OpCase("cumsum", lambda x: paddle.cumsum(x, axis=1),
+           lambda x: np.cumsum(x, axis=1), [S]),
+    OpCase("cumprod", lambda x: paddle.cumprod(x, dim=1),
+           lambda x: np.cumprod(x, axis=1), [S], positive=True),
+    OpCase("logcumsumexp", lambda x: paddle.logcumsumexp(x, axis=1),
+           lambda x: np.log(np.cumsum(np.exp(x), axis=1)), [S]),
+    # ---- linalg ----
+    OpCase("matmul", paddle.matmul, np.matmul, [(3, 4), (4, 5)]),
+    OpCase("matmul_batched", paddle.matmul, np.matmul,
+           [(2, 3, 4), (2, 4, 5)]),
+    OpCase("bmm", paddle.bmm, np.matmul, [(2, 3, 4), (2, 4, 5)]),
+    OpCase("mm", paddle.mm, np.matmul, [(3, 4), (4, 2)]),
+    OpCase("mv", paddle.mv, lambda a, b: a @ b, [(3, 4), (4,)]),
+    OpCase("dot", paddle.dot, np.dot, [V, V]),
+    OpCase("inner", paddle.inner, np.inner, [(3, 4), (5, 4)]),
+    OpCase("outer", paddle.outer, np.outer, [V, V]),
+    OpCase("cross", lambda a, b: paddle.cross(a, b, axis=-1),
+           lambda a, b: np.cross(a, b, axis=-1), [(4, 3), (4, 3)]),
+    OpCase("norm_fro", lambda x: paddle.norm(x),
+           lambda x: np.linalg.norm(x), [S]),
+    OpCase("trace", paddle.trace, np.trace, [SQ]),
+    OpCase("diagonal", paddle.diagonal, lambda x: np.diagonal(x), [SQ]),
+    OpCase("triu", paddle.triu, np.triu, [SQ]),
+    OpCase("tril", paddle.tril, np.tril, [SQ]),
+    OpCase("kron", paddle.kron, np.kron, [(2, 2), (2, 3)]),
+    OpCase("addmm",
+           lambda c, a, b: paddle.addmm(c, a, b, alpha=0.5, beta=2.0),
+           lambda c, a, b: 2.0 * c + 0.5 * (a @ b),
+           [(3, 5), (3, 4), (4, 5)]),
+    OpCase("einsum_ij",
+           lambda a, b: paddle.einsum("ij,jk->ik", a, b),
+           lambda a, b: a @ b, [(3, 4), (4, 5)]),
+    OpCase("matrix_power", lambda x: paddle.matrix_power(x, 3),
+           lambda x: np.linalg.matrix_power(x, 3), [SQ], grad=False),
+    # ---- manipulation ----
+    OpCase("reshape", lambda x: paddle.reshape(x, [4, 3]),
+           lambda x: np.reshape(x, (4, 3)), [S]),
+    OpCase("transpose", lambda x: paddle.transpose(x, [1, 0]),
+           lambda x: np.transpose(x), [S]),
+    OpCase("concat", lambda a, b: paddle.concat([a, b], axis=0),
+           lambda a, b: np.concatenate([a, b], 0), [S, S]),
+    OpCase("stack", lambda a, b: paddle.stack([a, b], axis=0),
+           lambda a, b: np.stack([a, b], 0), [S, S]),
+    OpCase("split",
+           lambda x: paddle.split(x, 2, axis=1),
+           lambda x: np.split(x, 2, axis=1), [S]),
+    OpCase("chunk",
+           lambda x: paddle.chunk(x, 2, axis=0),
+           lambda x: np.split(x, 2, axis=0), [(4, 3)]),
+    OpCase("squeeze", lambda x: paddle.squeeze(x, axis=1),
+           lambda x: np.squeeze(x, 1), [(3, 1, 4)]),
+    OpCase("unsqueeze", lambda x: paddle.unsqueeze(x, axis=0),
+           lambda x: np.expand_dims(x, 0), [S]),
+    OpCase("flatten", paddle.flatten, np.ravel, [S]),
+    OpCase("flip", lambda x: paddle.flip(x, axis=[0]),
+           lambda x: np.flip(x, 0).copy(), [S]),
+    OpCase("roll", lambda x: paddle.roll(x, 1, axis=0),
+           lambda x: np.roll(x, 1, 0), [S]),
+    OpCase("tile", lambda x: paddle.tile(x, [2, 1]),
+           lambda x: np.tile(x, (2, 1)), [S]),
+    OpCase("broadcast_to", lambda x: paddle.broadcast_to(x, [3, 4]),
+           lambda x: np.broadcast_to(x, (3, 4)).copy(), [(1, 4)]),
+    OpCase("expand", lambda x: paddle.expand(x, [3, 4]),
+           lambda x: np.broadcast_to(x, (3, 4)).copy(), [(1, 4)]),
+    OpCase("clip", lambda x: paddle.clip(x, -0.5, 0.5),
+           lambda x: np.clip(x, -0.5, 0.5), [S]),
+    OpCase("pad",
+           lambda x: paddle.nn.functional.pad(x, [1, 1, 0, 2]),
+           # 2*ndim flat pads apply first dim -> last dim (reference contract)
+           lambda x: np.pad(x, ((1, 1), (0, 2))), [S]),
+    OpCase("moveaxis", lambda x: paddle.moveaxis(x, 0, 1),
+           lambda x: np.moveaxis(x, 0, 1), [S]),
+    OpCase("diff", lambda x: paddle.diff(x, axis=0),
+           lambda x: np.diff(x, axis=0), [S]),
+    OpCase("masked_fill",
+           lambda x: paddle.masked_fill(
+               x, paddle.to_tensor(np.eye(3, 4) > 0), 9.0),
+           lambda x: np.where(np.eye(3, 4) > 0, 9.0, x), [S]),
+    # ---- indexing ----
+    OpCase("gather",
+           lambda x: paddle.gather(x, paddle.to_tensor(
+               np.array([2, 0], "int64")), axis=0),
+           lambda x: x[[2, 0]], [S]),
+    OpCase("index_select",
+           lambda x: paddle.index_select(x, paddle.to_tensor(
+               np.array([1, 3], "int64")), axis=1),
+           lambda x: x[:, [1, 3]], [S]),
+    OpCase("take_along_axis",
+           lambda x: paddle.take_along_axis(
+               x, paddle.to_tensor(np.zeros((3, 1), "int64")), axis=1,
+               broadcast=False),
+           lambda x: np.take_along_axis(x, np.zeros((3, 1), np.int64), 1),
+           [S]),
+    OpCase("index_sample",
+           lambda x: paddle.index_sample(x, paddle.to_tensor(
+               np.array([[0, 1], [2, 3], [1, 0]], "int64"))),
+           lambda x: np.take_along_axis(
+               x, np.array([[0, 1], [2, 3], [1, 0]]), 1), [S]),
+    # ---- search / sort ----
+    OpCase("argmax", lambda x: paddle.argmax(x, axis=1),
+           lambda x: np.argmax(x, 1), [S], grad=False),
+    OpCase("argmin", lambda x: paddle.argmin(x, axis=1),
+           lambda x: np.argmin(x, 1), [S], grad=False),
+    OpCase("argsort", lambda x: paddle.argsort(x, axis=1),
+           lambda x: np.argsort(x, 1, kind="stable"), [S], grad=False),
+    OpCase("sort", lambda x: paddle.sort(x, axis=1),
+           lambda x: np.sort(x, 1), [S]),
+    OpCase("topk",
+           lambda x: paddle.topk(x, 2, axis=1)[0],
+           lambda x: np.sort(x, 1)[:, ::-1][:, :2].copy(), [S], grad=False),
+    OpCase("kthvalue",
+           lambda x: paddle.kthvalue(x, 2, axis=1)[0],
+           lambda x: np.sort(x, 1)[:, 1], [S], grad=False),
+    OpCase("where",
+           lambda a, b: paddle.where(paddle.to_tensor(
+               np.eye(3, 4) > 0), a, b),
+           lambda a, b: np.where(np.eye(3, 4) > 0, a, b), [S, S]),
+    OpCase("median", lambda x: paddle.median(x, axis=1),
+           lambda x: np.median(x, axis=1), [(3, 5)], grad=False),
+    OpCase("bucketize",
+           lambda x: paddle.bucketize(x, paddle.to_tensor(
+               np.array([-0.5, 0.0, 0.5]))),
+           lambda x: np.searchsorted(np.array([-0.5, 0.0, 0.5]), x,
+                                     side="left"), [S], grad=False),
+    # ---- comparison / logical (forward only) ----
+    OpCase("equal", paddle.equal, np.equal, [S, S], grad=False),
+    OpCase("greater_than", paddle.greater_than, np.greater, [S, S],
+           grad=False),
+    OpCase("less_equal", paddle.less_equal, np.less_equal, [S, S],
+           grad=False),
+    OpCase("isnan", paddle.isnan, np.isnan, [S], grad=False),
+    OpCase("isinf", paddle.isinf, np.isinf, [S], grad=False),
+    OpCase("isfinite", paddle.isfinite, np.isfinite, [S], grad=False),
+    OpCase("sgn_allclose", lambda a, b: paddle.allclose(a, a),
+           lambda a, b: np.array(True), [S, S], grad=False),
+    # ---- activations (nn.functional) ----
+    OpCase("relu", F.relu, lambda x: np.maximum(x, 0), [S]),
+    OpCase("sigmoid", F.sigmoid, lambda x: 1 / (1 + np.exp(-x)), [S]),
+    OpCase("silu", F.silu, lambda x: x / (1 + np.exp(-x)), [S]),
+    OpCase("gelu_tanh",
+           lambda x: F.gelu(x, approximate=True),
+           lambda x: 0.5 * x * (1 + np.tanh(
+               np.sqrt(2 / np.pi) * (x + 0.044715 * x ** 3))), [S]),
+    OpCase("leaky_relu", lambda x: F.leaky_relu(x, 0.1),
+           lambda x: np.where(x >= 0, x, 0.1 * x), [S]),
+    OpCase("elu", lambda x: F.elu(x, 1.0),
+           lambda x: np.where(x > 0, x, np.exp(x) - 1), [S]),
+    OpCase("softplus", F.softplus, _sp, [S]),
+    OpCase("softsign", F.softsign, lambda x: x / (1 + np.abs(x)), [S]),
+    OpCase("hardtanh", F.hardtanh, lambda x: np.clip(x, -1, 1), [S]),
+    OpCase("mish", F.mish, lambda x: x * np.tanh(_sp(x)), [S]),
+    OpCase("tanhshrink", F.tanhshrink, lambda x: x - np.tanh(x), [S]),
+    OpCase("softmax",
+           lambda x: F.softmax(x, axis=-1),
+           lambda x: np.exp(x - x.max(-1, keepdims=True))
+           / np.exp(x - x.max(-1, keepdims=True)).sum(-1, keepdims=True),
+           [S]),
+    OpCase("log_softmax",
+           lambda x: F.log_softmax(x, axis=-1),
+           lambda x: x - x.max(-1, keepdims=True) - np.log(
+               np.exp(x - x.max(-1, keepdims=True)).sum(-1, keepdims=True)),
+           [S]),
+]
+
+# special-cased references that need scipy-free implementations
+import math
+
+_ERF = np.vectorize(math.erf)
+_LGAMMA = np.vectorize(math.lgamma)
+for case in CASES:
+    if case.name == "erf":
+        case.ref = lambda x: _ERF(x)
+    if case.name == "lgamma":
+        case.ref = lambda x: _LGAMMA(x)
+    if case.name == "digamma":
+        try:
+            from scipy.special import psi
+
+            case.ref = lambda x: psi(x)
+        except ImportError:
+            CASES.remove(case)
+
+
+_BY_NAME = {c.name: c for c in CASES}
+
+
+@pytest.mark.parametrize("name", sorted(_BY_NAME), ids=str)
+def test_forward(name):
+    _BY_NAME[name].run_forward()
+
+
+_GRAD_CASES = sorted(n for n, c in _BY_NAME.items() if c.grad)
+
+
+@pytest.mark.parametrize("name", _GRAD_CASES, ids=str)
+def test_grad_finite_difference(name):
+    _BY_NAME[name].run_grad()
+
+
+_INT_CASES = sorted(n for n, c in _BY_NAME.items() if c.int_dtypes)
+
+
+@pytest.mark.parametrize("name", _INT_CASES, ids=str)
+def test_int_forward(name):
+    _BY_NAME[name].run_int_forward()
