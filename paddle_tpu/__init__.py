@@ -47,6 +47,10 @@ from . import amp  # noqa: F401,E402
 from . import autograd  # noqa: F401,E402
 from . import device  # noqa: F401,E402
 from . import distributed  # noqa: F401,E402
+from . import distribution  # noqa: F401,E402
+from . import fft  # noqa: F401,E402
+from . import sparse  # noqa: F401,E402
+from . import signal  # noqa: F401,E402
 from . import framework  # noqa: F401,E402
 from . import linalg  # noqa: F401,E402
 from . import nn  # noqa: F401,E402
@@ -56,6 +60,8 @@ from . import jit  # noqa: F401,E402
 from . import ops  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
 from . import metric  # noqa: F401,E402
+from . import utils  # noqa: F401,E402
+from . import static  # noqa: F401,E402
 
 # vision/hapi/models import lazily-heavy deps; exposed as regular submodules
 from . import vision  # noqa: F401,E402

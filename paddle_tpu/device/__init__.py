@@ -113,6 +113,59 @@ class Event:
         synchronize()
 
 
+# -- memory introspection (reference: paddle.device.cuda.*_memory_* over the
+# allocator's stats; here PJRT's per-device memory_stats) ---------------------
+def _dev(device=None):
+    if device is None:
+        return jax.devices()[0]
+    if isinstance(device, int):
+        return jax.devices()[device]
+    if isinstance(device, str) and ":" in device:
+        return jax.devices()[int(device.split(":")[1])]
+    return jax.devices()[0]
+
+
+def memory_stats(device=None):
+    """Raw PJRT allocator stats dict (empty on backends without support)."""
+    return _dev(device).memory_stats() or {}
+
+
+def memory_allocated(device=None):
+    """Bytes currently held in device buffers (reference memory_allocated)."""
+    stats = memory_stats(device)
+    if "bytes_in_use" in stats:
+        return int(stats["bytes_in_use"])
+    d = _dev(device)
+    return sum(int(np.prod(b.shape)) * b.dtype.itemsize
+               for b in jax.live_arrays() if d in b.devices())
+
+
+def max_memory_allocated(device=None):
+    stats = memory_stats(device)
+    return int(stats.get("peak_bytes_in_use", memory_allocated(device)))
+
+
+def memory_reserved(device=None):
+    # NOT bytes_limit: that is total allocatable capacity, not a reservation
+    stats = memory_stats(device)
+    return int(stats.get("bytes_reserved", memory_allocated(device)))
+
+
+def max_memory_reserved(device=None):
+    stats = memory_stats(device)
+    return int(stats.get("peak_bytes_reserved", memory_reserved(device)))
+
+
+def empty_cache():
+    """PJRT manages the HBM pool; deleting dead python refs is the only lever."""
+    import gc
+
+    gc.collect()
+
+
+import numpy as np  # noqa: E402
+
+
 def stream_guard(stream):
     import contextlib
 

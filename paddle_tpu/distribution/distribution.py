@@ -1,0 +1,110 @@
+"""Probability distributions (paddle.distribution).
+
+Reference analog: python/paddle/distribution/ — ~30 distribution classes over
+the Distribution base (distribution.py: sample/rsample/log_prob/prob/entropy/
+kl_divergence), the KL registry (kl.py) and transforms (transform.py).
+
+TPU-first design: every density/statistic is a pure tape-tracked op composition
+over Tensors (differentiable through log_prob for variational objectives, and
+reparameterized `rsample` wherever the reference provides it); sampling draws
+from the framework's global PRNG stream (jax.random under the hood) so compiled
+and eager paths share one RNG discipline.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import ops
+from ..framework import random as rng
+from ..framework.core import Tensor
+
+__all__ = ["Distribution", "register_kl", "kl_divergence"]
+
+_TWO_PI = float(2.0 * np.pi)
+
+
+def _t(x, dtype="float32"):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(jnp.asarray(np.asarray(x, dtype)))
+
+
+def _shape(*tensors):
+    s = ()
+    for t in tensors:
+        s = np.broadcast_shapes(s, tuple(t.shape))
+    return s
+
+
+class Distribution:
+    """Base class (reference distribution.py Distribution)."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        try:
+            return self.rsample(shape).detach()
+        except NotImplementedError:
+            return self._sample(shape)
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def _sample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return ops.exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+    def _extend(self, shape):
+        return tuple(shape) + self._batch_shape + self._event_shape
+
+
+# -- KL registry (reference kl.py) -------------------------------------------
+_KL_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    def deco(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p, q):
+    for (cp, cq), fn in _KL_REGISTRY.items():
+        if isinstance(p, cp) and isinstance(q, cq):
+            return fn(p, q)
+    raise NotImplementedError(
+        f"no KL(p||q) registered for ({type(p).__name__}, {type(q).__name__})")

@@ -1,0 +1,144 @@
+"""Bijective transforms + TransformedDistribution.
+
+Reference analog: python/paddle/distribution/transform.py (Transform base with
+forward/inverse/forward_log_det_jacobian, Affine/Exp/Sigmoid/Tanh/Power/Chain/
+Stack) and transformed_distribution.py.
+"""
+from __future__ import annotations
+
+import math
+
+from .. import ops
+from ..framework.core import Tensor
+from .distribution import Distribution, _t
+
+
+class Transform:
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def inverse_log_det_jacobian(self, y):
+        return -self.forward_log_det_jacobian(self.inverse(y))
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def forward(self, x):
+        return self.loc + self.scale * x
+
+    def inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def forward_log_det_jacobian(self, x):
+        return ops.log(ops.abs(self.scale)) * ops.ones_like(x)
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return ops.exp(x)
+
+    def inverse(self, y):
+        return ops.log(y)
+
+    def forward_log_det_jacobian(self, x):
+        return x
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return ops.sigmoid(x)
+
+    def inverse(self, y):
+        return ops.log(y) - ops.log1p(-y)
+
+    def forward_log_det_jacobian(self, x):
+        from ..nn import functional as F
+
+        return -F.softplus(-x) - F.softplus(x)
+
+
+class TanhTransform(Transform):
+    def forward(self, x):
+        return ops.tanh(x)
+
+    def inverse(self, y):
+        return ops.atanh(y)
+
+    def forward_log_det_jacobian(self, x):
+        from ..nn import functional as F
+
+        return 2.0 * (math.log(2.0) - x - F.softplus(-2.0 * x))
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _t(power)
+
+    def forward(self, x):
+        return x ** self.power
+
+    def inverse(self, y):
+        return y ** (1.0 / self.power)
+
+    def forward_log_det_jacobian(self, x):
+        return ops.log(ops.abs(self.power * x ** (self.power - 1.0)))
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = None
+        for t in self.transforms:
+            j = t.forward_log_det_jacobian(x)
+            total = j if total is None else total + j
+            x = t.forward(x)
+        return total
+
+
+class TransformedDistribution(Distribution):
+    """transformed_distribution.py: push a base through transforms."""
+
+    def __init__(self, base, transforms, name=None):
+        self.base = base
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.transforms = list(transforms)
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def _chain(self):
+        return ChainTransform(self.transforms)
+
+    def rsample(self, shape=()):
+        return self._chain().forward(self.base.rsample(shape))
+
+    def _sample(self, shape=()):
+        return self._chain().forward(self.base.sample(shape))
+
+    def log_prob(self, value):
+        chain = self._chain()
+        x = chain.inverse(_t(value))
+        return self.base.log_prob(x) - chain.forward_log_det_jacobian(x)

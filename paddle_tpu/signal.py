@@ -1,0 +1,107 @@
+"""paddle.signal: STFT / ISTFT (reference python/paddle/signal.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .framework.core import Tensor
+from .ops._apply import defop
+
+
+@defop("signal.frame")
+def _frame(x, frame_length=512, hop_length=128, axis=-1):
+    n = x.shape[axis]
+    num = 1 + (n - frame_length) // hop_length
+    starts = jnp.arange(num) * hop_length
+    idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+    moved = jnp.moveaxis(x, axis, -1)
+    framed = moved[..., idx]                      # (..., num, frame_length)
+    return jnp.moveaxis(framed, (-2, -1), (-1, -2))  # (..., frame_length, num)
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    return _frame(x, frame_length=int(frame_length),
+                  hop_length=int(hop_length), axis=int(axis))
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """reference signal.py stft: frames -> window -> rfft/fft per frame.
+
+    x: (T,) or (B, T); output (freq, frames) or (B, freq, frames)."""
+    from . import fft as pfft
+    from . import ops
+
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    was_1d = x.ndim == 1
+    if was_1d:
+        x = ops.unsqueeze(x, 0)                    # (1, T): batch axis
+    if center:
+        pad = n_fft // 2
+        from .nn import functional as F
+
+        # pad the TIME axis: NCL layout needs (B, C=1, T)
+        x = F.pad(ops.unsqueeze(x, 1), [pad, pad], mode=pad_mode,
+                  data_format="NCL").squeeze(1)
+    frames = frame(x, n_fft, hop_length, axis=-1)   # (B, n_fft, num_frames)
+    if window is not None:
+        w = window.value if isinstance(window, Tensor) else jnp.asarray(window)
+        if win_length < n_fft:
+            lpad = (n_fft - win_length) // 2
+            w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+        frames = frames * Tensor(w[:, None])
+    spec = (pfft.rfft(frames, axis=-2) if onesided
+            else pfft.fft(frames, axis=-2))
+    if normalized:
+        spec = spec * (1.0 / np.sqrt(n_fft))
+    return spec.squeeze(0) if was_1d else spec
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    from . import fft as pfft
+    from . import ops
+
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    was_1d = x.ndim == 2          # (freq, frames): unbatched spectrogram
+    if was_1d:
+        x = ops.unsqueeze(x, 0)
+    if normalized:
+        x = x * float(np.sqrt(n_fft))
+    if onesided:
+        frames = pfft.irfft(x, n=n_fft, axis=-2)
+        fv = frames.value
+    else:
+        fv = pfft.ifft(x, axis=-2).value
+        if not return_complex:
+            fv = fv.real  # caller asserts the reconstruction is real-valued
+    if window is not None:
+        w = window.value if isinstance(window, Tensor) else jnp.asarray(window)
+        if win_length < n_fft:
+            lpad = (n_fft - win_length) // 2
+            w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+    else:
+        w = jnp.ones((n_fft,), jnp.float32)
+    num_frames = fv.shape[-1]
+    out_len = n_fft + hop_length * (num_frames - 1)
+    lead = fv.shape[:-2]
+    sig = jnp.zeros(lead + (out_len,), fv.dtype)
+    norm = jnp.zeros((out_len,), jnp.float32)
+    for t in range(num_frames):  # python loop: num_frames is static
+        s = t * hop_length
+        sig = sig.at[..., s:s + n_fft].add(fv[..., :, t] * w)
+        norm = norm.at[s:s + n_fft].add(w * w)
+    sig = sig / jnp.maximum(norm, 1e-10)
+    if center:
+        pad = n_fft // 2
+        sig = sig[..., pad:out_len - pad]
+    if length is not None:
+        sig = sig[..., :length]
+    if was_1d:
+        sig = sig[0]
+    return Tensor(sig)

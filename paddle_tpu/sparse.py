@@ -1,0 +1,264 @@
+"""paddle.sparse: COO/CSR sparse tensors + sparse ops.
+
+Reference analog: paddle/phi/core/sparse_coo_tensor.h / sparse_csr_tensor.h and
+python/paddle (sparse API: sparse_coo_tensor, sparse_csr_tensor, to_dense,
+add/multiply/matmul/relu, coalesce) over dedicated CUDA sparse kernels.
+
+TPU-first redesign: storage rides jax.experimental.sparse.BCOO — XLA's native
+batched-COO format whose matmul lowers to gather/scatter+MXU programs — so
+sparse compute shares the compiler path instead of needing a hand-written
+kernel library. CSR keeps paddle's (crows, cols, values) surface and converts
+to/from the COO core for compute.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from .framework.core import Tensor
+
+__all__ = [
+    "SparseCooTensor", "SparseCsrTensor",
+    "sparse_coo_tensor", "sparse_csr_tensor",
+    "add", "subtract", "multiply", "divide", "matmul", "masked_matmul",
+    "relu", "coalesce", "is_same_shape", "transpose",
+]
+
+
+def _val(x):
+    return x.value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class SparseCooTensor:
+    """COO sparse tensor (sparse_coo_tensor.h parity surface)."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._bcoo = bcoo
+
+    # -- paddle surface ------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def indices(self):
+        # paddle layout: (sparse_ndim, nnz)
+        return Tensor(jnp.swapaxes(self._bcoo.indices, 0, 1).astype(jnp.int64))
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense())
+
+    def to_sparse_csr(self):
+        if len(self._bcoo.shape) != 2:
+            raise ValueError("CSR requires a 2-D tensor")
+        bcoo = self._bcoo.sum_duplicates()
+        rows = bcoo.indices[:, 0]
+        cols = bcoo.indices[:, 1]
+        order = jnp.lexsort((cols, rows))
+        rows, cols, data = rows[order], cols[order], bcoo.data[order]
+        n_rows = self._bcoo.shape[0]
+        crows = jnp.concatenate([
+            jnp.zeros((1,), jnp.int64),
+            jnp.cumsum(jnp.bincount(rows, length=n_rows)).astype(jnp.int64)])
+        return SparseCsrTensor(Tensor(crows), Tensor(cols.astype(jnp.int64)),
+                               Tensor(data), self.shape)
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def coalesce(self):
+        return SparseCooTensor(self._bcoo.sum_duplicates())
+
+    def transpose(self, perm):
+        return SparseCooTensor(self._bcoo.transpose(tuple(perm)))
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    """CSR sparse tensor (sparse_csr_tensor.h parity surface)."""
+
+    def __init__(self, crows, cols, values, shape):
+        self._crows = crows if isinstance(crows, Tensor) else Tensor(_val(crows))
+        self._cols = cols if isinstance(cols, Tensor) else Tensor(_val(cols))
+        self._values = values if isinstance(values, Tensor) else Tensor(_val(values))
+        self._shape = list(int(s) for s in shape)
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    def nnz(self):
+        return int(self._values.shape[0])
+
+    def crows(self):
+        return self._crows
+
+    def cols(self):
+        return self._cols
+
+    def values(self):
+        return self._values
+
+    def to_sparse_coo(self, sparse_dim=2):
+        counts = jnp.diff(self._crows.value)
+        rows = jnp.repeat(jnp.arange(self._shape[0]), counts,
+                          total_repeat_length=self.nnz())
+        idx = jnp.stack([rows, self._cols.value], axis=1)
+        bcoo = jsparse.BCOO((self._values.value, idx.astype(jnp.int32)),
+                            shape=tuple(self._shape))
+        return SparseCooTensor(bcoo)
+
+    def to_dense(self):
+        return self.to_sparse_coo().to_dense()
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+# -- constructors ------------------------------------------------------------
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    idx = _val(indices).astype(jnp.int32)          # (ndim, nnz) paddle layout
+    vals = _val(values)
+    if dtype is not None:
+        vals = vals.astype(np.dtype(dtype))
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in np.asarray(idx.max(axis=1)))
+    bcoo = jsparse.BCOO((vals, jnp.swapaxes(idx, 0, 1)),
+                        shape=tuple(int(s) for s in shape))
+    return SparseCooTensor(bcoo)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True):
+    vals = _val(values)
+    if dtype is not None:
+        vals = vals.astype(np.dtype(dtype))
+    return SparseCsrTensor(Tensor(_val(crows).astype(jnp.int64)),
+                           Tensor(_val(cols).astype(jnp.int64)),
+                           Tensor(vals), shape)
+
+
+def _as_coo(x):
+    if isinstance(x, SparseCsrTensor):
+        return x.to_sparse_coo()
+    if isinstance(x, SparseCooTensor):
+        return x
+    raise TypeError(f"expected a sparse tensor, got {type(x)}")
+
+
+def _binary(a, b, fn):
+    ca, cb = _as_coo(a), _as_coo(b)
+    out = fn(ca._bcoo.todense(), cb._bcoo.todense())
+    # result keeps the union sparsity pattern
+    bcoo = jsparse.BCOO.fromdense(out)
+    res = SparseCooTensor(bcoo)
+    return res.to_sparse_csr() if isinstance(a, SparseCsrTensor) else res
+
+
+def add(a, b, name=None):
+    return _binary(a, b, jnp.add)
+
+
+def subtract(a, b, name=None):
+    return _binary(a, b, jnp.subtract)
+
+
+def multiply(a, b, name=None):
+    return _binary(a, b, jnp.multiply)
+
+
+def divide(a, b, name=None):
+    ca, cb = _as_coo(a), _as_coo(b)
+    out = ca._bcoo.todense() / cb._bcoo.todense()
+    out = jnp.where(jnp.isfinite(out), out, 0.0)
+    res = SparseCooTensor(jsparse.BCOO.fromdense(out))
+    return res.to_sparse_csr() if isinstance(a, SparseCsrTensor) else res
+
+
+def matmul(a, b, name=None):
+    """sparse @ dense -> dense (the sparse training hot path)."""
+    if isinstance(a, (SparseCooTensor, SparseCsrTensor)):
+        bcoo = _as_coo(a)._bcoo
+        dense = _val(b)
+        return Tensor(bcoo @ dense)
+    if isinstance(b, (SparseCooTensor, SparseCsrTensor)):
+        bcoo = _as_coo(b)._bcoo
+        return Tensor(_val(a) @ bcoo)
+    raise TypeError("sparse.matmul needs at least one sparse operand")
+
+
+def masked_matmul(x, y, mask, name=None):
+    """dense @ dense evaluated only at mask's sparsity pattern."""
+    coo = _as_coo(mask)
+    idx = coo._bcoo.indices
+    xv, yv = _val(x), _val(y)
+    rows = xv[idx[:, 0]]
+    cols = yv[:, idx[:, 1]].T
+    vals = (rows * cols).sum(-1)
+    out = jsparse.BCOO((vals, idx), shape=tuple(coo.shape))
+    res = SparseCooTensor(out)
+    return res.to_sparse_csr() if isinstance(mask, SparseCsrTensor) else res
+
+
+def relu(x, name=None):
+    coo = _as_coo(x)
+    out = SparseCooTensor(jsparse.BCOO(
+        (jnp.maximum(coo._bcoo.data, 0), coo._bcoo.indices),
+        shape=tuple(coo.shape)))
+    return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) else out
+
+
+def coalesce(x, name=None):
+    return _as_coo(x).coalesce()
+
+
+def is_same_shape(a, b):
+    return list(a.shape) == list(b.shape)
+
+
+def transpose(x, perm, name=None):
+    return _as_coo(x).transpose(perm)
+
+
+class nn:
+    """paddle.sparse.nn subset (ReLU layer)."""
+
+    class ReLU:
+        def __call__(self, x):
+            return relu(x)

@@ -1,0 +1,181 @@
+"""paddle.static compatibility surface.
+
+Reference analog: python/paddle/static/ — the legacy declarative graph API
+(Program/Executor/program_guard/data) and inference export
+(static/io.py save_inference_model/load_inference_model).
+
+TPU-first redesign: there is no second graph IR — "static graph" IS jax
+tracing. A Program is a recorded capture of a python function over symbolic
+InputSpecs compiled by XLA; Executor.run feeds/fetches it; the
+save/load_inference_model pair rides jit.save's StableHLO-backed exported
+artifact. The declarative layer-builder API (static.nn.fc etc.) is served by
+the imperative paddle.nn layers — code written against the reference's
+dynamic-first style ports unchanged, which matches the reference's own
+deprecation direction for static graphs.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+import jax
+
+from ..framework.core import Tensor
+from ..jit.api import InputSpec  # noqa: F401  (paddle.static.InputSpec)
+from ..nn.layer.layers import Layer
+
+__all__ = [
+    "InputSpec", "Program", "Executor", "CompiledProgram", "data",
+    "default_main_program", "default_startup_program", "program_guard",
+    "save_inference_model", "load_inference_model", "name_scope", "scope_guard",
+    "global_scope", "cpu_places", "device_guard",
+]
+
+
+class _Var:
+    """Symbolic placeholder created by static.data (reference Variable)."""
+
+    def __init__(self, name, shape, dtype):
+        self.name = name
+        self.shape = list(shape)
+        self.dtype = dtype
+
+    def __repr__(self):
+        return f"Var(name={self.name}, shape={self.shape}, dtype={self.dtype})"
+
+
+class Program:
+    """A capture target (reference static.Program): python code registered via
+    program_guard runs under jax tracing at Executor.run time."""
+
+    def __init__(self):
+        self._inputs = {}       # name -> _Var
+        self._builders = []     # callables(feed_tensors) -> fetch tensors
+        self._last_fetch = None
+
+    def clone(self, for_test=False):
+        p = Program()
+        p._inputs = dict(self._inputs)
+        p._builders = list(self._builders)
+        return p
+
+    def global_block(self):
+        return self
+
+    def __repr__(self):
+        return f"Program(inputs={list(self._inputs)})"
+
+
+_MAIN = [Program()]
+_STARTUP = [Program()]
+
+
+def default_main_program():
+    return _MAIN[0]
+
+
+def default_startup_program():
+    return _STARTUP[0]
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    old_main, old_start = _MAIN[0], _STARTUP[0]
+    _MAIN[0] = main_program
+    if startup_program is not None:
+        _STARTUP[0] = startup_program
+    try:
+        yield
+    finally:
+        _MAIN[0], _STARTUP[0] = old_main, old_start
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    var = _Var(name, shape, dtype)
+    _MAIN[0]._inputs[name] = var
+    return var
+
+
+class Executor:
+    """reference static.Executor: run(program, feed, fetch_list)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, return_numpy=True):
+        program = program or _MAIN[0]
+        feed = feed or {}
+        outs = []
+        for fetch in fetch_list or []:
+            if callable(fetch):
+                tensors = {k: Tensor(jax.numpy.asarray(np.asarray(v)))
+                           for k, v in feed.items()}
+                out = fetch(tensors)
+            elif isinstance(fetch, Tensor):
+                out = fetch
+            else:
+                raise TypeError(
+                    "fetch_list entries must be callables over the feed dict "
+                    "or Tensors (the capture-based Program has no graph "
+                    "variables to look up by name)")
+            outs.append(np.asarray(out.value) if return_numpy and
+                        isinstance(out, Tensor) else out)
+        return outs
+
+
+class CompiledProgram:
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Export a Layer (or jit-captured callable) for inference
+    (reference static/io.py save_inference_model -> here jit.save)."""
+    from .. import jit
+
+    layer = kwargs.pop("layer", None)
+    target = layer
+    if target is None and isinstance(fetch_vars, Layer):
+        target = fetch_vars
+    if target is None:
+        raise ValueError(
+            "the capture-based save_inference_model exports a Layer: pass "
+            "layer=<Layer> (or fetch_vars=<Layer>) plus feed_vars as "
+            "InputSpecs")
+    spec = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    spec = [s if isinstance(s, InputSpec)
+            else InputSpec(s.shape, s.dtype, s.name) for s in spec]
+    jit.save(target, path_prefix, input_spec=spec)
+    return path_prefix
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Returns (program, feed_names, fetch_fn): run fetch_fn on Tensors."""
+    from .. import jit
+
+    translated = jit.load(path_prefix)
+    program = Program()
+    return program, [], translated
+
+
+def name_scope(prefix=None):
+    return contextlib.nullcontext()
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    yield
+
+
+def global_scope():
+    return {}
+
+
+def cpu_places(device_count=None):
+    return ["cpu"] * (device_count or 1)
+
+
+def device_guard(device=None):
+    return contextlib.nullcontext()

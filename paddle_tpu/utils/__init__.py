@@ -1,0 +1,3 @@
+"""paddle.utils parity namespace."""
+from . import custom_op  # noqa: F401
+from .custom_op import get_custom_op, register_custom_op  # noqa: F401

@@ -1,0 +1,73 @@
+"""Custom op extension point: register user ops into the framework.
+
+Reference analog: the PD_BUILD_OP C++ macro (phi/api/ext/op_meta_info.h:1145),
+runtime registration (fluid/framework/custom_operator.cc) and the
+python/paddle/utils/cpp_extension build helpers — out-of-tree CUDA kernels
+compiled and loaded into the op registry.
+
+TPU-first redesign: a "kernel" here is any jax-traceable function — jnp code or
+a Pallas TPU kernel — so registration needs no compiler toolchain: the function
+becomes a first-class framework op (tape autograd via jax.vjp, optional custom
+backward, AMP category, eager caching, jit capture) through the same `defop`
+machinery every built-in op uses.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..ops._apply import defop, get_registry
+
+__all__ = ["register_custom_op", "get_custom_op", "CustomOpError"]
+
+
+class CustomOpError(RuntimeError):
+    pass
+
+
+_CUSTOM_OPS = {}
+
+
+def register_custom_op(name, forward=None, backward=None, amp_category=None,
+                       differentiable=True):
+    """Register `forward` (a jax-traceable function over raw arrays) as op
+    `name`; returns the public Tensor-level callable.
+
+    With `backward`, gradients use it instead of jax's autodiff:
+    ``backward(residuals, *grads) -> input grads`` where forward must then
+    return ``(outputs, residuals)`` from its `fwd` companion — the
+    jax.custom_vjp contract, mirroring PD_BUILD_GRAD_OP.
+
+    Usable as a decorator: ``@register_custom_op("my_op")``.
+    """
+    if forward is None:
+        def deco(fn):
+            return register_custom_op(name, fn, backward=backward,
+                                      amp_category=amp_category,
+                                      differentiable=differentiable)
+
+        return deco
+
+    if name in get_registry() or name in _CUSTOM_OPS:
+        raise CustomOpError(f"op {name!r} is already registered")
+
+    fn = forward
+    if backward is not None:
+        wrapped = jax.custom_vjp(forward)
+
+        def fwd(*args):
+            out = forward(*args)
+            return out, args
+
+        wrapped.defvjp(fwd, backward)
+        fn = wrapped
+
+    op = defop(name, differentiable=differentiable,
+               amp_category=amp_category)(fn)
+    _CUSTOM_OPS[name] = op
+    return op
+
+
+def get_custom_op(name):
+    if name not in _CUSTOM_OPS:
+        raise CustomOpError(f"no custom op {name!r} registered")
+    return _CUSTOM_OPS[name]

@@ -1,0 +1,262 @@
+"""paddle.distribution: densities vs scipy, sampling moments, KL, transforms.
+
+Mirrors the reference's distribution test strategy (log_prob/entropy against
+scipy.stats, sample-mean convergence, registered KL identities)."""
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import paddle_tpu as paddle
+import paddle_tpu.distribution as D
+
+
+def _lp(dist, value):
+    return np.asarray(dist.log_prob(paddle.to_tensor(
+        np.asarray(value, "float32"))).value, np.float64)
+
+
+XS = np.array([0.1, 0.5, 1.3, 2.7], "float32")
+
+
+class TestLogProbVsScipy:
+    def test_normal(self):
+        d = D.Normal(0.5, 1.5)
+        np.testing.assert_allclose(_lp(d, XS), st.norm.logpdf(XS, 0.5, 1.5),
+                                   rtol=1e-5)
+
+    def test_lognormal(self):
+        d = D.LogNormal(0.2, 0.7)
+        np.testing.assert_allclose(
+            _lp(d, XS), st.lognorm.logpdf(XS, 0.7, scale=np.exp(0.2)),
+            rtol=1e-5)
+
+    def test_uniform(self):
+        d = D.Uniform(0.0, 3.0)
+        np.testing.assert_allclose(_lp(d, XS),
+                                   st.uniform.logpdf(XS, 0, 3), rtol=1e-5)
+
+    def test_exponential(self):
+        d = D.Exponential(1.7)
+        np.testing.assert_allclose(_lp(d, XS),
+                                   st.expon.logpdf(XS, scale=1 / 1.7),
+                                   rtol=1e-5)
+
+    def test_laplace(self):
+        d = D.Laplace(0.3, 1.2)
+        np.testing.assert_allclose(_lp(d, XS),
+                                   st.laplace.logpdf(XS, 0.3, 1.2), rtol=1e-5)
+
+    def test_cauchy(self):
+        d = D.Cauchy(0.5, 2.0)
+        np.testing.assert_allclose(_lp(d, XS),
+                                   st.cauchy.logpdf(XS, 0.5, 2.0), rtol=1e-5)
+
+    def test_gumbel(self):
+        d = D.Gumbel(0.5, 2.0)
+        np.testing.assert_allclose(_lp(d, XS),
+                                   st.gumbel_r.logpdf(XS, 0.5, 2.0), rtol=1e-5)
+
+    def test_gamma(self):
+        d = D.Gamma(2.5, 1.3)
+        np.testing.assert_allclose(
+            _lp(d, XS), st.gamma.logpdf(XS, 2.5, scale=1 / 1.3), rtol=1e-5)
+
+    def test_chi2(self):
+        d = D.Chi2(3.0)
+        np.testing.assert_allclose(_lp(d, XS), st.chi2.logpdf(XS, 3.0),
+                                   rtol=1e-5)
+
+    def test_beta(self):
+        xs = np.array([0.1, 0.4, 0.8], "float32")
+        d = D.Beta(2.0, 3.5)
+        np.testing.assert_allclose(_lp(d, xs), st.beta.logpdf(xs, 2.0, 3.5),
+                                   rtol=1e-5)
+
+    def test_student_t(self):
+        d = D.StudentT(5.0, 0.5, 2.0)
+        np.testing.assert_allclose(_lp(d, XS),
+                                   st.t.logpdf(XS, 5.0, 0.5, 2.0), rtol=1e-5)
+
+    def test_bernoulli(self):
+        xs = np.array([0.0, 1.0, 1.0, 0.0], "float32")
+        d = D.Bernoulli(probs=0.3)
+        np.testing.assert_allclose(_lp(d, xs), st.bernoulli.logpmf(xs, 0.3),
+                                   rtol=1e-5)
+
+    def test_geometric(self):
+        ks = np.array([0.0, 1.0, 4.0], "float32")
+        d = D.Geometric(0.35)
+        # scipy geom counts trials (k>=1); ours counts failures (k>=0)
+        np.testing.assert_allclose(_lp(d, ks),
+                                   st.geom.logpmf(ks + 1, 0.35), rtol=1e-5)
+
+    def test_poisson(self):
+        ks = np.array([0.0, 2.0, 5.0], "float32")
+        d = D.Poisson(2.5)
+        np.testing.assert_allclose(_lp(d, ks), st.poisson.logpmf(ks, 2.5),
+                                   rtol=1e-5)
+
+    def test_binomial(self):
+        ks = np.array([0.0, 3.0, 7.0], "float32")
+        d = D.Binomial(10.0, 0.4)
+        np.testing.assert_allclose(_lp(d, ks), st.binom.logpmf(ks, 10, 0.4),
+                                   rtol=1e-5)
+
+    def test_dirichlet(self):
+        x = np.array([0.2, 0.3, 0.5], "float32")
+        a = np.array([1.5, 2.0, 3.0], "float32")
+        d = D.Dirichlet(paddle.to_tensor(a))
+        np.testing.assert_allclose(float(_lp(d, x)),
+                                   st.dirichlet.logpdf(x, a), rtol=1e-5)
+
+    def test_categorical(self):
+        logits = np.log(np.array([0.2, 0.3, 0.5], "float32"))
+        d = D.Categorical(paddle.to_tensor(logits))
+        got = np.asarray(d.log_prob(paddle.to_tensor(
+            np.array([0, 2], "int64"))).value)
+        np.testing.assert_allclose(got, np.log([0.2, 0.5]), rtol=1e-5)
+
+    def test_multinomial(self):
+        x = np.array([2.0, 3.0, 5.0], "float32")
+        p = np.array([0.2, 0.3, 0.5], "float32")
+        d = D.Multinomial(10, paddle.to_tensor(p))
+        np.testing.assert_allclose(float(_lp(d, x)),
+                                   st.multinomial.logpmf(x, 10, p), rtol=1e-5)
+
+    def test_multivariate_normal(self):
+        mu = np.array([0.5, -0.3], "float32")
+        cov = np.array([[2.0, 0.5], [0.5, 1.0]], "float32")
+        d = D.MultivariateNormal(paddle.to_tensor(mu), paddle.to_tensor(cov))
+        x = np.array([0.2, 0.1], "float32")
+        np.testing.assert_allclose(float(_lp(d, x)),
+                                   st.multivariate_normal.logpdf(x, mu, cov),
+                                   rtol=1e-5)
+
+
+class TestEntropyAndMoments:
+    def test_entropies_vs_scipy(self):
+        pairs = [
+            (D.Normal(0.0, 2.0), st.norm.entropy(0, 2)),
+            (D.Uniform(1.0, 4.0), st.uniform.entropy(1, 3)),
+            (D.Exponential(0.8), st.expon.entropy(scale=1 / 0.8)),
+            (D.Laplace(0.0, 1.5), st.laplace.entropy(0, 1.5)),
+            (D.Gamma(2.0, 1.5), st.gamma.entropy(2.0, scale=1 / 1.5)),
+            (D.Beta(2.0, 3.0), st.beta.entropy(2.0, 3.0)),
+            (D.Gumbel(0.0, 2.0), st.gumbel_r.entropy(0, 2)),
+        ]
+        for d, expect in pairs:
+            np.testing.assert_allclose(float(np.asarray(d.entropy().value)),
+                                       float(expect), rtol=1e-5,
+                                       err_msg=type(d).__name__)
+
+    def test_sample_means(self):
+        paddle.seed(0)
+        for d, mean in [
+            (D.Normal(1.0, 2.0), 1.0),
+            (D.Exponential(2.0), 0.5),
+            (D.Gamma(3.0, 2.0), 1.5),
+            (D.Beta(2.0, 2.0), 0.5),
+            (D.Poisson(4.0), 4.0),
+            (D.Bernoulli(probs=0.3), 0.3),
+            (D.Gumbel(0.0, 1.0), float(np.euler_gamma)),
+        ]:
+            s = np.asarray(d.sample((4000,)).value, np.float64)
+            assert abs(s.mean() - mean) < 0.15, (type(d).__name__, s.mean())
+
+    def test_rsample_differentiable(self):
+        paddle.seed(0)
+        loc = paddle.to_tensor(np.array(0.5, "float32"), stop_gradient=False)
+        scale = paddle.to_tensor(np.array(1.2, "float32"), stop_gradient=False)
+        d = D.Normal(loc, scale)
+        s = d.rsample((256,))
+        (s ** 2).mean().backward()
+        assert loc.grad is not None and scale.grad is not None
+
+
+class TestKL:
+    def test_normal_kl_closed_form(self):
+        p, q = D.Normal(0.0, 1.0), D.Normal(1.0, 2.0)
+        got = float(np.asarray(D.kl_divergence(p, q).value))
+        expect = np.log(2.0) + (1.0 + 1.0) / (2 * 4.0) - 0.5
+        np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+    def test_kl_nonnegative_and_zero_on_self(self):
+        cases = [
+            (D.Gamma(2.0, 1.0), D.Gamma(3.0, 2.0)),
+            (D.Beta(2.0, 3.0), D.Beta(4.0, 1.5)),
+            (D.Bernoulli(probs=0.3), D.Bernoulli(probs=0.6)),
+            (D.Exponential(1.0), D.Exponential(2.5)),
+            (D.Laplace(0.0, 1.0), D.Laplace(0.5, 2.0)),
+            (D.Poisson(2.0), D.Poisson(3.0)),
+        ]
+        for p, q in cases:
+            kl_pq = float(np.asarray(D.kl_divergence(p, q).value))
+            kl_pp = float(np.asarray(D.kl_divergence(p, p).value))
+            assert kl_pq > 0, type(p).__name__
+            assert abs(kl_pp) < 1e-6, type(p).__name__
+
+    def test_kl_categorical_matches_manual(self):
+        p = D.Categorical(paddle.to_tensor(np.log(
+            np.array([0.2, 0.8], "float32"))))
+        q = D.Categorical(paddle.to_tensor(np.log(
+            np.array([0.5, 0.5], "float32"))))
+        got = float(np.asarray(D.kl_divergence(p, q).value))
+        expect = 0.2 * np.log(0.2 / 0.5) + 0.8 * np.log(0.8 / 0.5)
+        np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+    def test_unregistered_raises(self):
+        with pytest.raises(NotImplementedError):
+            D.kl_divergence(D.Normal(0.0, 1.0), D.Gamma(1.0, 1.0))
+
+
+class TestTransformed:
+    def test_exp_transform_equals_lognormal(self):
+        base = D.Normal(0.2, 0.7)
+        td = D.TransformedDistribution(base, [D.ExpTransform()])
+        ln = D.LogNormal(0.2, 0.7)
+        xs = np.array([0.5, 1.5, 3.0], "float32")
+        np.testing.assert_allclose(_lp(td, xs), _lp(ln, xs), rtol=1e-5)
+
+    def test_affine_chain(self):
+        base = D.Normal(0.0, 1.0)
+        td = D.TransformedDistribution(
+            base, [D.AffineTransform(1.0, 2.0)])
+        xs = np.array([0.0, 1.0, 2.0], "float32")
+        np.testing.assert_allclose(_lp(td, xs),
+                                   st.norm.logpdf(xs, 1.0, 2.0), rtol=1e-5)
+
+    def test_sigmoid_transform_samples_in_unit_interval(self):
+        paddle.seed(0)
+        td = D.TransformedDistribution(D.Normal(0.0, 1.0),
+                                       [D.SigmoidTransform()])
+        s = np.asarray(td.sample((512,)).value)
+        assert ((s > 0) & (s < 1)).all()
+
+
+class TestIndependent:
+    def test_reinterprets_batch_as_event(self):
+        loc = paddle.to_tensor(np.zeros((3, 4), "float32"))
+        scale = paddle.to_tensor(np.ones((3, 4), "float32"))
+        d = D.Independent(D.Normal(loc, scale), 1)
+        assert d.batch_shape == (3,) and d.event_shape == (4,)
+        x = paddle.to_tensor(np.zeros((3, 4), "float32"))
+        lp = d.log_prob(x)
+        assert tuple(lp.shape) == (3,)
+        np.testing.assert_allclose(np.asarray(lp.value),
+                                   4 * st.norm.logpdf(0.0), rtol=1e-5)
+
+
+class TestGeometricKL:
+    def test_zero_on_self_and_positive(self):
+        p, q = D.Geometric(0.35), D.Geometric(0.6)
+        assert abs(float(np.asarray(D.kl_divergence(p, p).value))) < 1e-6
+        assert float(np.asarray(D.kl_divergence(p, q).value)) > 0
+
+    def test_matches_monte_carlo(self):
+        p, q = D.Geometric(0.4), D.Geometric(0.25)
+        ks = np.arange(0, 200, dtype="float32")
+        lp = _lp(p, ks)
+        lq = _lp(q, ks)
+        expect = float((np.exp(lp) * (lp - lq)).sum())
+        got = float(np.asarray(D.kl_divergence(p, q).value))
+        np.testing.assert_allclose(got, expect, rtol=1e-4)

@@ -1,0 +1,193 @@
+"""Domain kits: paddle.fft, paddle.sparse, paddle.signal.
+
+Oracles: numpy.fft / scipy.signal / dense math."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestFFT:
+    def test_fft_roundtrip_and_values(self):
+        r = np.random.RandomState(0)
+        x = r.randn(8).astype("float32") + 1j * r.randn(8).astype("float32")
+        xt = paddle.to_tensor(x.astype("complex64"))
+        y = paddle.fft.fft(xt)
+        np.testing.assert_allclose(np.asarray(y.value), np.fft.fft(x),
+                                   rtol=1e-4, atol=1e-4)
+        back = paddle.fft.ifft(y)
+        np.testing.assert_allclose(np.asarray(back.value), x, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_rfft_irfft(self):
+        r = np.random.RandomState(1)
+        x = r.randn(16).astype("float32")
+        y = paddle.fft.rfft(paddle.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(y.value), np.fft.rfft(x),
+                                   rtol=1e-4, atol=1e-4)
+        back = paddle.fft.irfft(y, n=16)
+        np.testing.assert_allclose(np.asarray(back.value), x, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_fft2_and_norm(self):
+        r = np.random.RandomState(2)
+        x = r.randn(4, 6).astype("float32")
+        y = paddle.fft.fft2(paddle.to_tensor(x), norm="ortho")
+        np.testing.assert_allclose(np.asarray(y.value),
+                                   np.fft.fft2(x, norm="ortho"),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_helpers(self):
+        np.testing.assert_allclose(
+            np.asarray(paddle.fft.fftfreq(8, 0.5).value),
+            np.fft.fftfreq(8, 0.5), rtol=1e-6)
+        x = paddle.to_tensor(np.arange(6, dtype="float32"))
+        np.testing.assert_allclose(
+            np.asarray(paddle.fft.fftshift(x).value),
+            np.fft.fftshift(np.arange(6.0)), rtol=0)
+
+    def test_fft_grad_flows(self):
+        x = paddle.to_tensor(np.random.RandomState(3).randn(8)
+                             .astype("float32"), stop_gradient=False)
+        y = paddle.fft.rfft(x)
+        (y.abs() ** 2).sum().backward()
+        assert x.grad is not None
+
+
+class TestSparseCoo:
+    def _coo(self):
+        indices = paddle.to_tensor(np.array([[0, 1, 2], [1, 2, 0]], "int64"))
+        values = paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32"))
+        return paddle.sparse.sparse_coo_tensor(indices, values, [3, 3])
+
+    def test_construct_and_to_dense(self):
+        s = self._coo()
+        dense = np.zeros((3, 3), "float32")
+        dense[0, 1], dense[1, 2], dense[2, 0] = 1, 2, 3
+        np.testing.assert_array_equal(s.to_dense().numpy(), dense)
+        assert s.nnz() == 3 and s.is_sparse_coo()
+
+    def test_indices_values_layout(self):
+        s = self._coo()
+        assert s.indices().shape == [2, 3]  # (ndim, nnz) paddle layout
+        np.testing.assert_array_equal(s.values().numpy(), [1, 2, 3])
+
+    def test_add_multiply(self):
+        a, b = self._coo(), self._coo()
+        np.testing.assert_array_equal(
+            paddle.sparse.add(a, b).to_dense().numpy(),
+            2 * a.to_dense().numpy())
+        np.testing.assert_array_equal(
+            paddle.sparse.multiply(a, b).to_dense().numpy(),
+            a.to_dense().numpy() ** 2)
+
+    def test_matmul_sparse_dense(self):
+        s = self._coo()
+        d = np.random.RandomState(0).randn(3, 4).astype("float32")
+        out = paddle.sparse.matmul(s, paddle.to_tensor(d))
+        np.testing.assert_allclose(out.numpy(), s.to_dense().numpy() @ d,
+                                   rtol=1e-5)
+
+    def test_relu_and_coalesce(self):
+        indices = paddle.to_tensor(np.array([[0, 0, 1], [1, 1, 0]], "int64"))
+        values = paddle.to_tensor(np.array([1.0, -3.0, -2.0], "float32"))
+        s = paddle.sparse.sparse_coo_tensor(indices, values, [2, 2])
+        c = paddle.sparse.coalesce(s)
+        assert c.nnz() == 2  # duplicate (0,1) summed
+        r = paddle.sparse.relu(c)
+        np.testing.assert_array_equal(
+            r.to_dense().numpy(), np.maximum(c.to_dense().numpy(), 0))
+
+    def test_masked_matmul(self):
+        r = np.random.RandomState(0)
+        x = r.randn(3, 5).astype("float32")
+        y = r.randn(5, 3).astype("float32")
+        mask = self._coo()
+        out = paddle.sparse.masked_matmul(
+            paddle.to_tensor(x), paddle.to_tensor(y), mask)
+        full = x @ y
+        expect = np.where(mask.to_dense().numpy() != 0, full, 0)
+        np.testing.assert_allclose(out.to_dense().numpy(), expect, rtol=1e-5)
+
+
+class TestSparseCsr:
+    def test_csr_roundtrip(self):
+        crows = paddle.to_tensor(np.array([0, 2, 3, 5], "int64"))
+        cols = paddle.to_tensor(np.array([1, 3, 2, 0, 1], "int64"))
+        values = paddle.to_tensor(np.arange(1, 6, dtype="float32"))
+        s = paddle.sparse.sparse_csr_tensor(crows, cols, values, [3, 4])
+        dense = s.to_dense().numpy()
+        expect = np.zeros((3, 4), "float32")
+        expect[0, 1], expect[0, 3], expect[1, 2] = 1, 2, 3
+        expect[2, 0], expect[2, 1] = 4, 5
+        np.testing.assert_array_equal(dense, expect)
+        # and back: coo -> csr preserves content
+        back = s.to_sparse_coo().to_sparse_csr()
+        np.testing.assert_array_equal(back.to_dense().numpy(), expect)
+        assert back.is_sparse_csr()
+
+
+class TestSignal:
+    def test_stft_matches_scipy(self):
+        from scipy.signal import stft as sp_stft
+
+        r = np.random.RandomState(0)
+        x = r.randn(2, 512).astype("float32")
+        n_fft, hop = 128, 32
+        win = np.hanning(n_fft).astype("float32")
+        got = paddle.signal.stft(
+            paddle.to_tensor(x), n_fft, hop_length=hop,
+            window=paddle.to_tensor(win), center=True, pad_mode="constant")
+        _, _, ref = sp_stft(x, nperseg=n_fft, noverlap=n_fft - hop,
+                            window=win, boundary="zeros", padded=False,
+                            return_onesided=True)
+        # scipy scales by 1/win.sum(); undo for comparison
+        ref = ref * win.sum()
+        got_np = np.asarray(got.value)
+        n = min(got_np.shape[-1], ref.shape[-1])
+        np.testing.assert_allclose(got_np[..., :n], ref[..., :n],
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_stft_istft_roundtrip(self):
+        r = np.random.RandomState(1)
+        x = r.randn(1, 400).astype("float32")
+        n_fft, hop = 64, 16
+        win = np.hanning(n_fft).astype("float32")
+        spec = paddle.signal.stft(paddle.to_tensor(x), n_fft, hop_length=hop,
+                                  window=paddle.to_tensor(win),
+                                  pad_mode="constant")
+        back = paddle.signal.istft(spec, n_fft, hop_length=hop,
+                                   window=paddle.to_tensor(win),
+                                   length=400)
+        np.testing.assert_allclose(np.asarray(back.value), x, rtol=1e-3,
+                                   atol=1e-3)
+
+
+class TestSignal1D:
+    def test_stft_1d_matches_batched(self):
+        r = np.random.RandomState(5)
+        x = r.randn(512).astype("float32")
+        win = np.hanning(128).astype("float32")
+        one = paddle.signal.stft(paddle.to_tensor(x), 128, hop_length=32,
+                                 window=paddle.to_tensor(win),
+                                 pad_mode="constant")
+        batched = paddle.signal.stft(paddle.to_tensor(x[None]), 128,
+                                     hop_length=32,
+                                     window=paddle.to_tensor(win),
+                                     pad_mode="constant")
+        assert one.ndim == 2  # (freq, frames), not a fake batch
+        np.testing.assert_allclose(np.asarray(one.value),
+                                   np.asarray(batched.value)[0], rtol=1e-5)
+
+    def test_istft_1d_roundtrip(self):
+        r = np.random.RandomState(6)
+        x = r.randn(400).astype("float32")
+        win = np.hanning(64).astype("float32")
+        spec = paddle.signal.stft(paddle.to_tensor(x), 64, hop_length=16,
+                                  window=paddle.to_tensor(win),
+                                  pad_mode="constant")
+        back = paddle.signal.istft(spec, 64, hop_length=16,
+                                   window=paddle.to_tensor(win), length=400)
+        assert back.ndim == 1
+        np.testing.assert_allclose(np.asarray(back.value), x, rtol=1e-3,
+                                   atol=1e-3)

