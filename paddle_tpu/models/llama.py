@@ -266,6 +266,12 @@ class LlamaDecoderLayer(Layer):
         self.post_attention_layernorm = RMSNorm(
             config.hidden_size, epsilon=config.rms_norm_eps)
         self._recompute = config.recompute
+        # reference recompute_granularity: "full" re-runs everything;
+        # "full_attn"/"core_attn" map onto XLA remat policies that keep matmul
+        # outputs resident and recompute only the cheap elementwise ops
+        gran = getattr(config, "recompute_granularity", "full") or "full"
+        self._recompute_policy = (None if gran == "full"
+                                  else "dots_with_no_batch_dims_saveable")
 
     def _block(self, hidden_states, attn_mask=None):
         residual = hidden_states
@@ -302,10 +308,12 @@ class LlamaDecoderLayer(Layer):
             from ..distributed.fleet.recompute import recompute
 
             if isinstance(self.mlp, LlamaMoEMLP):
-                out, self._moe_aux = recompute(self._block_with_aux,
-                                               hidden_states, attn_mask)
+                out, self._moe_aux = recompute(
+                    self._block_with_aux, hidden_states, attn_mask,
+                    checkpoint_policy=self._recompute_policy)
                 return out
-            return recompute(self._block, hidden_states, attn_mask)
+            return recompute(self._block, hidden_states, attn_mask,
+                             checkpoint_policy=self._recompute_policy)
         return self._block(hidden_states, attn_mask)
 
 
