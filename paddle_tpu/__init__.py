@@ -62,6 +62,10 @@ from . import incubate  # noqa: F401,E402
 from . import metric  # noqa: F401,E402
 from . import utils  # noqa: F401,E402
 from . import static  # noqa: F401,E402
+from . import audio  # noqa: F401,E402
+from . import geometric  # noqa: F401,E402
+from . import quantization  # noqa: F401,E402
+from . import text  # noqa: F401,E402
 
 # vision/hapi/models import lazily-heavy deps; exposed as regular submodules
 from . import vision  # noqa: F401,E402

@@ -1,0 +1,165 @@
+"""paddle.audio: spectral features.
+
+Reference analog: python/paddle/audio/ (functional: window/mel/fbank helpers;
+features: Spectrogram/MelSpectrogram/LogMelSpectrogram/MFCC layers).
+Built on paddle.signal.stft so feature extraction compiles with the model.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .. import ops, signal
+from ..framework.core import Tensor
+from ..nn.layer.layers import Layer
+
+
+def hz_to_mel(freq, htk=False):
+    if htk:
+        return 2595.0 * np.log10(1.0 + np.asarray(freq) / 700.0)
+    f = np.asarray(freq, np.float64)
+    mel = 3.0 * f / 200.0
+    min_log_hz, logstep = 1000.0, np.log(6.4) / 27.0
+    above = f >= min_log_hz
+    return np.where(above, 15.0 + np.log(np.maximum(f, 1e-10) / min_log_hz)
+                    / logstep, mel)
+
+
+def mel_to_hz(mel, htk=False):
+    if htk:
+        return 700.0 * (10.0 ** (np.asarray(mel) / 2595.0) - 1.0)
+    m = np.asarray(mel, np.float64)
+    hz = 200.0 * m / 3.0
+    min_log_hz, logstep = 1000.0, np.log(6.4) / 27.0
+    above = m >= 15.0
+    return np.where(above, min_log_hz * np.exp(logstep * (m - 15.0)), hz)
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """(n_mels, n_fft//2 + 1) triangular mel filterbank
+    (reference audio/functional/functional.py compute_fbank_matrix)."""
+    f_max = f_max or sr / 2.0
+    n_freqs = n_fft // 2 + 1
+    freqs = np.linspace(0, sr / 2.0, n_freqs)
+    mel_pts = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk),
+                          n_mels + 2)
+    hz_pts = mel_to_hz(mel_pts, htk)
+    fb = np.zeros((n_mels, n_freqs))
+    for m in range(n_mels):
+        lo, ctr, hi = hz_pts[m], hz_pts[m + 1], hz_pts[m + 2]
+        up = (freqs - lo) / max(ctr - lo, 1e-10)
+        down = (hi - freqs) / max(hi - ctr, 1e-10)
+        fb[m] = np.maximum(0.0, np.minimum(up, down))
+    if norm == "slaney":
+        enorm = 2.0 / (hz_pts[2:] - hz_pts[:-2])
+        fb *= enorm[:, None]
+    return Tensor(jnp.asarray(fb.astype(dtype)))
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    n = win_length
+    if window in ("hann", "hanning"):
+        w = np.hanning(n + 1)[:-1] if fftbins else np.hanning(n)
+    elif window == "hamming":
+        w = np.hamming(n + 1)[:-1] if fftbins else np.hamming(n)
+    elif window == "blackman":
+        w = np.blackman(n + 1)[:-1] if fftbins else np.blackman(n)
+    elif window in ("rect", "boxcar", "ones"):
+        w = np.ones(n)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return Tensor(jnp.asarray(w.astype(dtype)))
+
+
+class Spectrogram(Layer):
+    """features/layers.py Spectrogram: |stft|^power."""
+
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.window = get_window(window, self.win_length, dtype=dtype)
+
+    def forward(self, x):
+        spec = signal.stft(x, self.n_fft, hop_length=self.hop_length,
+                           win_length=self.win_length, window=self.window,
+                           center=self.center, pad_mode=self.pad_mode)
+        mag = spec.abs()
+        return mag ** self.power if self.power != 1.0 else mag
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                       power, center, pad_mode, dtype)
+        self.fbank = compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max,
+                                          htk, norm, dtype)
+
+    def forward(self, x):
+        spec = self.spectrogram(x)          # (..., n_freqs, frames)
+        return ops.matmul(self.fbank, spec)
+
+
+class LogMelSpectrogram(MelSpectrogram):
+    def __init__(self, *args, ref_value=1.0, amin=1e-10, top_db=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = super().forward(x)
+        log_spec = 10.0 * ops.log10(ops.maximum(
+            mel, ops.full_like(mel, self.amin)))
+        log_spec = log_spec - 10.0 * math.log10(max(self.ref_value, self.amin))
+        if self.top_db is not None:
+            log_spec = ops.maximum(
+                log_spec, ops.full_like(log_spec,
+                                        float(log_spec.max().numpy())
+                                        - self.top_db))
+        return log_spec
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=13, n_fft=512, n_mels=64, **kwargs):
+        super().__init__()
+        self.logmel = LogMelSpectrogram(sr=sr, n_fft=n_fft, n_mels=n_mels,
+                                        **kwargs)
+        # DCT-II basis
+        n = np.arange(n_mels)
+        basis = np.cos(np.pi / n_mels * (n[None, :] + 0.5)
+                       * np.arange(n_mfcc)[:, None])
+        basis *= np.sqrt(2.0 / n_mels)
+        basis[0] /= np.sqrt(2.0)
+        self.dct = Tensor(jnp.asarray(basis.astype("float32")))
+
+    def forward(self, x):
+        return ops.matmul(self.dct, self.logmel(x))
+
+
+class functional:
+    hz_to_mel = staticmethod(hz_to_mel)
+    mel_to_hz = staticmethod(mel_to_hz)
+    compute_fbank_matrix = staticmethod(compute_fbank_matrix)
+    get_window = staticmethod(get_window)
+
+
+class features:
+    Spectrogram = Spectrogram
+    MelSpectrogram = MelSpectrogram
+    LogMelSpectrogram = LogMelSpectrogram
+    MFCC = MFCC

@@ -69,6 +69,9 @@ from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
 from . import in_jit  # noqa: F401
 from . import fleet  # noqa: F401
 from . import utils  # noqa: F401
+from . import auto_tuner  # noqa: F401
+from . import watchdog  # noqa: F401
+from .watchdog import CommWatchdog  # noqa: F401
 from . import launch  # noqa: F401
 from .fleet.mpu.mp_ops import split  # noqa: F401
 

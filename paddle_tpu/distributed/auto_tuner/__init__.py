@@ -1,0 +1,123 @@
+"""AutoTuner: search the parallelism configuration space.
+
+Reference analog: python/paddle/distributed/auto_tuner/{tuner,search,prune,
+recorder,utils}.py — enumerate (dp, mp, pp, micro_batch, sharding) candidates,
+prune invalid ones, launch trial jobs, record metrics, pick the best.
+
+TPU-first mapping: candidates describe mesh factorizations; pruning knows the
+TPU constraints (mp should ride the fastest ICI axis and divide heads; pp
+divides layers; memory estimate = params*(2+4+4+4)/dp_shard + activations).
+Trials run through a user callable (compile+time one step — in-process on the
+single-controller runtime instead of launching subprocess jobs).
+"""
+from __future__ import annotations
+
+import itertools
+
+__all__ = ["SearchSpace", "prune_candidates", "AutoTuner", "Recorder"]
+
+
+class SearchSpace:
+    def __init__(self, num_devices, max_mp=8, max_pp=8,
+                 micro_batch_sizes=(1, 2, 4, 8), shardings=(0, 1, 2, 3)):
+        self.num_devices = num_devices
+        self.max_mp = max_mp
+        self.max_pp = max_pp
+        self.micro_batch_sizes = tuple(micro_batch_sizes)
+        self.shardings = tuple(shardings)
+
+    def candidates(self):
+        n = self.num_devices
+        for mp, pp in itertools.product(range(1, self.max_mp + 1),
+                                        range(1, self.max_pp + 1)):
+            if n % (mp * pp) != 0:
+                continue
+            dp = n // (mp * pp)
+            for mbs, stage in itertools.product(self.micro_batch_sizes,
+                                                self.shardings):
+                yield {"dp_degree": dp, "mp_degree": mp, "pp_degree": pp,
+                       "micro_batch_size": mbs, "sharding_stage": stage}
+
+
+def _estimate_bytes(cand, model_params, hidden, layers, seq, dtype_bytes=2):
+    """Per-device memory estimate (reference prune.py memory heuristics)."""
+    dp, mp, pp = cand["dp_degree"], cand["mp_degree"], cand["pp_degree"]
+    stage = cand["sharding_stage"]
+    shard = mp * pp
+    param_b = model_params * dtype_bytes / shard
+    master_opt = model_params * 12 / shard          # fp32 master + 2 moments
+    if stage >= 1:
+        master_opt /= dp
+    if stage >= 3:
+        param_b /= dp
+    act = (cand["micro_batch_size"] * seq * hidden * layers
+           * 4 * dtype_bytes) / (mp * pp)
+    return param_b + master_opt + act
+
+
+def prune_candidates(space, model_params=0, hidden=0, layers=0, seq=0,
+                     num_heads=None, global_batch=None, hbm_bytes=None):
+    """Drop invalid/overflowing candidates (reference prune.py rules)."""
+    out = []
+    for cand in space.candidates():
+        mp, pp = cand["mp_degree"], cand["pp_degree"]
+        dp, mbs = cand["dp_degree"], cand["micro_batch_size"]
+        if num_heads is not None and num_heads % mp != 0:
+            continue
+        if layers and pp > layers:
+            continue
+        if global_batch is not None:
+            if global_batch % (dp * mbs) != 0:
+                continue
+        if hbm_bytes is not None and model_params:
+            if _estimate_bytes(cand, model_params, hidden, layers, seq) \
+                    > hbm_bytes:
+                continue
+        out.append(cand)
+    return out
+
+
+class Recorder:
+    """Trial metric store, best-first (reference recorder.py)."""
+
+    def __init__(self, metric="tokens_per_sec", maximize=True):
+        self.metric = metric
+        self.maximize = maximize
+        self.history = []
+
+    def add(self, candidate, metrics, error=None):
+        self.history.append(
+            {"candidate": dict(candidate), "metrics": dict(metrics or {}),
+             "error": error})
+
+    def best(self):
+        scored = [h for h in self.history
+                  if h["error"] is None and self.metric in h["metrics"]]
+        if not scored:
+            return None
+        key = lambda h: h["metrics"][self.metric]
+        return (max if self.maximize else min)(scored, key=key)
+
+
+class AutoTuner:
+    """Drive trials over the pruned space (reference tuner.py)."""
+
+    def __init__(self, space, trial_fn, metric="tokens_per_sec",
+                 maximize=True, max_trials=None, **prune_kwargs):
+        self.space = space
+        self.trial_fn = trial_fn
+        self.recorder = Recorder(metric, maximize)
+        self.max_trials = max_trials
+        self.prune_kwargs = prune_kwargs
+
+    def tune(self):
+        cands = prune_candidates(self.space, **self.prune_kwargs)
+        if self.max_trials is not None:
+            cands = cands[: self.max_trials]
+        for cand in cands:
+            try:
+                metrics = self.trial_fn(cand)
+                self.recorder.add(cand, metrics)
+            except Exception as e:  # noqa: BLE001 — a failed trial is data
+                self.recorder.add(cand, None, error=str(e))
+        return self.recorder.best()

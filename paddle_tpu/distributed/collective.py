@@ -144,16 +144,41 @@ def unstack_locals(t, group=None):
 
 
 class _Task:
-    """Completed-on-creation async handle (XLA dispatch is already async)."""
+    """Async collective handle (process_group.h:48 Task contract).
+
+    XLA dispatch is already asynchronous: the returned arrays are futures the
+    runtime fills in. wait() blocks on device completion; is_completed() polls
+    the buffer's ready state without blocking."""
 
     def __init__(self, result=None):
         self._result = result
 
-    def wait(self):
+    def wait(self, timeout=None):
+        if self._result is None:
+            return None
+        if timeout is None:
+            jax.block_until_ready(self._result)
+            return self._result
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        while not self.is_completed():
+            if _time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"collective result not ready within {timeout}s")
+            _time.sleep(0.001)
+        jax.block_until_ready(self._result)  # ready: returns immediately
         return self._result
 
     def is_completed(self):
-        return True
+        r = self._result
+        if r is None:
+            return True
+        ready = getattr(r, "is_ready", None)
+        return bool(ready()) if callable(ready) else True
+
+    def synchronize(self):
+        self.wait()
 
 
 def _maybe_inplace(tensor, new_val, sync_op=True):

@@ -1,0 +1,137 @@
+"""Elastic training manager: node registry, heartbeats, scale detection.
+
+Reference analog: python/paddle/distributed/fleet/elastic/manager.py:125
+(ElasticManager — etcd node registry with lease heartbeat, watch for
+scale-in/out, trainer relaunch) and distributed/elastic.py's CLI entry.
+
+TPU-first mapping: the registry rides the framework's TCPStore (the DCN KV
+service) instead of etcd — each node owns a heartbeat key refreshed by a
+daemon thread; liveness = heartbeat age, scale events = membership change.
+On a detected change the manager invokes the restart callback (the launcher's
+pod relaunch, --max_restart in launch/main.py).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    def __init__(self, store, node_id, np=1, heartbeat_interval=1.0,
+                 dead_after=5.0, on_scale=None, job_id="default"):
+        """store: a TCPStore(-like) KV with set/get/add/num_keys.
+        on_scale(old_nodes, new_nodes) fires on membership change."""
+        self._store = store
+        self._node_id = str(node_id)
+        self._np = np
+        self._interval = heartbeat_interval
+        self._dead_after = dead_after
+        self._on_scale = on_scale
+        self._job = job_id
+        self._stop = threading.Event()
+        self._threads = []
+        self._known = set()
+        self.status = ElasticStatus.HOLD
+
+    # -- registry ------------------------------------------------------------
+    def _hb_key(self, node=None):
+        return f"elastic/{self._job}/hb/{node or self._node_id}"
+
+    def _members_key(self):
+        return f"elastic/{self._job}/members"
+
+    def _with_members_lock(self, mutate):
+        """Ticket-lock serialized read-modify-write of the members list —
+        bare set(get()+modify) loses concurrent registrations."""
+        lock_key = f"elastic/{self._job}/reg_ticket"
+        turn_key = f"elastic/{self._job}/reg_turn"
+        ticket = self._store.add(lock_key, 1)          # atomic sequence number
+        deadline = time.time() + 30
+        while self._store.add(turn_key, 0) != ticket - 1:
+            if time.time() > deadline:
+                raise TimeoutError("elastic members lock timed out")
+            time.sleep(0.01)
+        try:
+            members = self._members()
+            new = mutate(list(members))
+            self._store.set(self._members_key(), json.dumps(sorted(new)))
+        finally:
+            self._store.add(turn_key, 1)               # pass the turn on
+
+    def register(self):
+        self._with_members_lock(
+            lambda m: m + [self._node_id] if self._node_id not in m else m)
+        self._beat()
+        self._known = set(self._members())
+
+    def _members(self):
+        try:
+            raw = self._store.get(self._members_key(), timeout=0.2)
+            return list(json.loads(raw.decode()))
+        except Exception:
+            return []
+
+    def _beat(self):
+        self._store.set(self._hb_key(), str(time.time()))
+
+    def alive_nodes(self):
+        """Members whose heartbeat is fresher than dead_after seconds."""
+        now = time.time()
+        alive = []
+        for node in self._members():
+            try:
+                ts = float(self._store.get(self._hb_key(node), timeout=0.2))
+            except Exception:
+                continue
+            if now - ts <= self._dead_after:
+                alive.append(node)
+        return sorted(alive)
+
+    # -- watch loop ----------------------------------------------------------
+    def start(self):
+        self.register()
+        self.status = ElasticStatus.HOLD
+
+        def heartbeat():
+            while not self._stop.is_set():
+                self._beat()
+                self._stop.wait(self._interval)
+
+        def watch():
+            while not self._stop.is_set():
+                alive = set(self.alive_nodes())
+                if alive != self._known and alive:
+                    old = sorted(self._known)
+                    self._known = alive
+                    self.status = ElasticStatus.RESTART
+                    if self._on_scale is not None:
+                        self._on_scale(old, sorted(alive))
+                self._stop.wait(self._interval)
+
+        for fn in (heartbeat, watch):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def exit(self, completed=True):
+        self.status = (ElasticStatus.COMPLETED if completed
+                       else ElasticStatus.ERROR)
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
+        # deregister (same serialized RMW as register)
+        try:
+            self._with_members_lock(
+                lambda m: [x for x in m if x != self._node_id])
+            self._store.delete_key(self._hb_key())
+        except Exception:
+            pass

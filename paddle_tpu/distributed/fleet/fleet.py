@@ -22,37 +22,12 @@ from .topology import (CommunicateTopology, HybridCommunicateGroup,
                        _set_hybrid_parallel_group, get_hybrid_parallel_group)
 
 
-class RoleMakerBase:
-    def __init__(self, is_collective=True, **kwargs):
-        self._is_collective = is_collective
-
-    def worker_index(self):
-        return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
-
-    def worker_num(self):
-        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
-        if eps:
-            return len(eps.split(","))
-        return max(1, jax.process_count())
-
-    def is_worker(self):
-        return True
-
-    def is_server(self):
-        return False
-
-    def is_first_worker(self):
-        return self.worker_index() == 0
-
-
-class PaddleCloudRoleMaker(RoleMakerBase):
-    """Env-var cluster discovery (fleet/base/role_maker.py)."""
-
-
-class UserDefinedRoleMaker(RoleMakerBase):
-    def __init__(self, is_collective=True, init_gloo=False, **kwargs):
-        super().__init__(is_collective)
-        self._kwargs = kwargs
+from .role_maker import (  # noqa: F401
+    PaddleCloudRoleMaker,
+    Role,
+    RoleMakerBase,
+    UserDefinedRoleMaker,
+)
 
 
 class _FleetState:

@@ -1,0 +1,114 @@
+"""RoleMaker: cluster role discovery from environment variables.
+
+Reference analog: python/paddle/distributed/fleet/base/role_maker.py
+(PaddleCloudRoleMaker — env-var cluster discovery with Gloo barrier init;
+UserDefinedRoleMaker for explicit topologies).
+
+TPU-first mapping: role discovery reads the same env contract the launcher
+writes (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ENDPOINTS);
+the Gloo barrier becomes a TCPStore barrier. Collective mode only — the
+parameter-server roles raise (SURVEY §2.6: PS is out of the TPU north star).
+"""
+from __future__ import annotations
+
+import os
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class RoleMakerBase:
+    def _worker_num(self):
+        raise NotImplementedError
+
+    def _worker_index(self):
+        raise NotImplementedError
+
+    def _is_worker(self):
+        raise NotImplementedError
+
+    # reference public surface
+    def worker_num(self):
+        return self._worker_num()
+
+    def worker_index(self):
+        return self._worker_index()
+
+    def is_worker(self):
+        return self._is_worker()
+
+    def is_server(self):
+        return False
+
+    def is_first_worker(self):
+        return self._worker_index() == 0
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """Env-var role discovery (role_maker.py PaddleCloudRoleMaker)."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        if not is_collective:
+            raise NotImplementedError(
+                "parameter-server role discovery is not part of the TPU build; "
+                "use is_collective=True")
+        self._is_collective = is_collective
+        self._generate_role()
+
+    def _generate_role(self):
+        self._trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self._trainers_num = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self._worker_endpoints = [e for e in eps.split(",") if e]
+        self._current_endpoint = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+        self._role = Role.WORKER
+
+    def _worker_num(self):
+        return self._trainers_num
+
+    def _worker_index(self):
+        return self._trainer_id
+
+    def _is_worker(self):
+        return True
+
+    def get_trainer_endpoints(self):
+        return list(self._worker_endpoints)
+
+    def _barrier(self, comm_world="worker"):
+        if self._trainers_num <= 1:
+            return
+        from ..store import create_or_get_global_tcp_store
+
+        create_or_get_global_tcp_store().barrier(f"rolemaker/{comm_world}")
+
+    def barrier_worker(self):
+        self._barrier("worker")
+
+    def barrier_all(self):
+        self._barrier("all")
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """Explicit topology (role_maker.py UserDefinedRoleMaker)."""
+
+    def __init__(self, is_collective=True, current_id=0, role=Role.WORKER,
+                 worker_num=1, worker_endpoints=None, **kwargs):
+        self._user = dict(current_id=current_id, role=role,
+                          worker_num=worker_num,
+                          worker_endpoints=worker_endpoints or [])
+        super().__init__(is_collective=is_collective)
+
+    def _generate_role(self):
+        self._trainer_id = self._user["current_id"]
+        self._trainers_num = self._user["worker_num"]
+        self._worker_endpoints = list(self._user["worker_endpoints"])
+        self._current_endpoint = (
+            self._worker_endpoints[self._trainer_id]
+            if self._trainer_id < len(self._worker_endpoints) else "")
+        self._role = self._user["role"]

@@ -1,0 +1,105 @@
+"""Communication watchdog: hang detection for collective operations.
+
+Reference analog: paddle/phi/core/distributed/{nccl_comm_task,
+comm_task_manager}.cc — ONE async scanner thread watches all in-flight
+collective tasks, aborts on timeout and dumps traces.
+
+TPU-first mapping: XLA owns collective execution, so the watchable boundary is
+the host-side blocking wait. `CommWatchdog.watch(desc)` wraps any blocking
+section (Task.wait, block_until_ready, TCPStore barriers); a single daemon
+scanner checks every in-flight section's age each tick and fires the timeout
+callback once per stuck section. Completed sections land in a bounded history
+for post-mortem dumps.
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+import threading
+import time
+
+
+class WatchdogTimeout(RuntimeError):
+    pass
+
+
+class CommWatchdog:
+    def __init__(self, timeout=1800.0, on_timeout=None, max_history=10000):
+        self.timeout = timeout
+        self.on_timeout = on_timeout
+        self._lock = threading.Lock()
+        self._inflight = {}                         # id -> (desc, start)
+        self._ids = itertools.count()
+        self.events = collections.deque(maxlen=max_history)  # (desc, start, end)
+        self.timed_out = []
+        self._stop = threading.Event()
+        self._scanner = None
+
+    # -- scanner (comm_task_manager.cc watchdog loop) ------------------------
+    def _ensure_scanner(self):
+        if self._scanner is None or not self._scanner.is_alive():
+            self._stop.clear()
+            self._scanner = threading.Thread(target=self._scan_loop,
+                                             daemon=True)
+            self._scanner.start()
+
+    def _scan_loop(self):
+        tick = max(min(1.0, self.timeout / 4.0), 0.01)
+        fired = set()
+        while not self._stop.wait(tick):
+            now = time.monotonic()
+            with self._lock:
+                inflight = list(self._inflight.items())
+                if not inflight:
+                    continue
+            for wid, (desc, start) in inflight:
+                if wid in fired:
+                    continue
+                if now - start > self.timeout:
+                    fired.add(wid)
+                    self.timed_out.append(desc)
+                    if self.on_timeout is not None:
+                        self.on_timeout(desc, self.dump())
+                    else:
+                        print(f"[comm watchdog] {desc} exceeded "
+                              f"{self.timeout}s\n{self.dump()}")
+
+    def stop(self):
+        self._stop.set()
+        if self._scanner is not None:
+            self._scanner.join(timeout=5)
+
+    # -- watch sections ------------------------------------------------------
+    def watch(self, desc="collective"):
+        return _Watch(self, desc)
+
+    def dump(self):
+        """Trace dump: in-flight sections first, then recent history."""
+        with self._lock:
+            now = time.monotonic()
+            lines = [f"[comm] {desc}: {(now - start) * 1000:.1f} ms (IN FLIGHT)"
+                     for desc, start in self._inflight.values()]
+            lines += [f"[comm] {desc}: {(end - start) * 1000:.1f} ms (done)"
+                      for desc, start, end in self.events]
+            return "\n".join(lines)
+
+
+class _Watch:
+    def __init__(self, dog, desc):
+        self._dog = dog
+        self._desc = desc
+
+    def __enter__(self):
+        dog = self._dog
+        with dog._lock:
+            self._id = next(dog._ids)
+            dog._inflight[self._id] = (self._desc, time.monotonic())
+        dog._ensure_scanner()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dog = self._dog
+        with dog._lock:
+            desc, start = dog._inflight.pop(self._id)
+            dog.events.append((desc, start, time.monotonic()))
+        return False

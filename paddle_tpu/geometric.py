@@ -1,0 +1,130 @@
+"""paddle.geometric: graph message passing + segment reductions.
+
+Reference analog: python/paddle/geometric/ (message_passing/send_recv.py
+send_u_recv/send_ue_recv, math segment_{sum,mean,max,min}, sampling) over
+dedicated scatter CUDA kernels.
+
+TPU-first: every primitive is a jax segment op (ops.segment_sum et al. lower
+to sorted-scatter HLO), so message passing fuses with the surrounding model.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .framework.core import Tensor
+from .ops._apply import defop
+
+
+@defop("geometric.segment_reduce")
+def _segment_reduce(data, segment_ids, num_segments=0, pool_type="sum"):
+    n = int(num_segments)
+    ids = segment_ids.astype(jnp.int32)
+    if pool_type == "sum":
+        return jax.ops.segment_sum(data, ids, n)
+    if pool_type == "mean":
+        s = jax.ops.segment_sum(data, ids, n)
+        cnt = jax.ops.segment_sum(jnp.ones((data.shape[0],), data.dtype), ids, n)
+        return s / jnp.maximum(cnt, 1.0)[(...,) + (None,) * (data.ndim - 1)]
+    if pool_type == "max":
+        return jax.ops.segment_max(data, ids, n)
+    if pool_type == "min":
+        return jax.ops.segment_min(data, ids, n)
+    raise ValueError(f"unknown pool_type {pool_type!r}")
+
+
+def _segments_from(ids, count):
+    """Static segment count: the caller's `count`, or max(ids)+1 host-computed
+    when ids is concrete. Under a trace, XLA needs a compile-time output size —
+    raise a clear error asking for `count` instead of crashing on int(tracer)."""
+    if count is not None:
+        return int(count)
+    ids_val = ids.value if isinstance(ids, Tensor) else ids
+    if isinstance(ids_val, jax.core.Tracer):
+        raise ValueError(
+            "segment ops inside a traced/compiled region need a static "
+            "segment count: pass count=<num_segments>")
+    return int(jnp.max(ids_val)) + 1
+
+
+def segment_sum(data, segment_ids, count=None, name=None):
+    return _segment_reduce(data, segment_ids,
+                           num_segments=_segments_from(segment_ids, count),
+                           pool_type="sum")
+
+
+def segment_mean(data, segment_ids, count=None, name=None):
+    return _segment_reduce(data, segment_ids,
+                           num_segments=_segments_from(segment_ids, count),
+                           pool_type="mean")
+
+
+def segment_max(data, segment_ids, count=None, name=None):
+    return _segment_reduce(data, segment_ids,
+                           num_segments=_segments_from(segment_ids, count),
+                           pool_type="max")
+
+
+def segment_min(data, segment_ids, count=None, name=None):
+    return _segment_reduce(data, segment_ids,
+                           num_segments=_segments_from(segment_ids, count),
+                           pool_type="min")
+
+
+@defop("geometric.send_u_recv")
+def _send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=0):
+    msgs = x[src_index]                      # gather source features
+    n = int(out_size) if out_size else x.shape[0]
+    ids = dst_index.astype(jnp.int32)
+    if reduce_op == "sum":
+        return jax.ops.segment_sum(msgs, ids, n)
+    if reduce_op == "mean":
+        s = jax.ops.segment_sum(msgs, ids, n)
+        cnt = jax.ops.segment_sum(jnp.ones((msgs.shape[0],), msgs.dtype),
+                                  ids, n)
+        return s / jnp.maximum(cnt, 1.0)[(...,) + (None,) * (msgs.ndim - 1)]
+    if reduce_op == "max":
+        return jax.ops.segment_max(msgs, ids, n)
+    if reduce_op == "min":
+        return jax.ops.segment_min(msgs, ids, n)
+    raise ValueError(f"unknown reduce_op {reduce_op!r}")
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x[src] and scatter-reduce onto dst
+    (reference send_recv.py send_u_recv)."""
+    return _send_u_recv(x, src_index, dst_index, reduce_op=reduce_op,
+                        out_size=int(out_size) if out_size else 0)
+
+
+@defop("geometric.send_ue_recv")
+def _send_ue_recv(x, e, src_index, dst_index, message_op="add",
+                  reduce_op="sum", out_size=0):
+    msgs = x[src_index]
+    if message_op == "add":
+        msgs = msgs + e
+    elif message_op == "mul":
+        msgs = msgs * e
+    else:
+        raise ValueError(f"unknown message_op {message_op!r}")
+    n = int(out_size) if out_size else x.shape[0]
+    ids = dst_index.astype(jnp.int32)
+    if reduce_op == "sum":
+        return jax.ops.segment_sum(msgs, ids, n)
+    if reduce_op == "mean":
+        s = jax.ops.segment_sum(msgs, ids, n)
+        cnt = jax.ops.segment_sum(jnp.ones((msgs.shape[0],), msgs.dtype),
+                                  ids, n)
+        return s / jnp.maximum(cnt, 1.0)[(...,) + (None,) * (msgs.ndim - 1)]
+    if reduce_op == "max":
+        return jax.ops.segment_max(msgs, ids, n)
+    raise ValueError(f"unknown reduce_op {reduce_op!r}")
+
+
+def send_ue_recv(x, e, src_index, dst_index, message_op="add", reduce_op="sum",
+                 out_size=None, name=None):
+    """Edge-featured message passing (reference send_recv.py send_ue_recv)."""
+    return _send_ue_recv(x, e, src_index, dst_index, message_op=message_op,
+                         reduce_op=reduce_op,
+                         out_size=int(out_size) if out_size else 0)

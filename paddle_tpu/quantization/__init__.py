@@ -1,0 +1,201 @@
+"""paddle.quantization: QAT / PTQ simulation framework.
+
+Reference analog: python/paddle/quantization/ (QuantConfig, QAT/PTQ entries,
+fake-quant observers and quanters over dedicated CUDA kernels).
+
+TPU-first redesign: fake-quantization is pure tensor algebra (scale ->
+round -> clip -> dequant) with a straight-through estimator, so it rides the
+tape/XLA like any op. QAT wraps Linear/Conv sublayers with weight+activation
+quanters; PTQ runs calibration batches through absmax observers then freezes
+scales. Int8 execution on TPU lowers through XLA's int8 dot support when the
+simulated graph is exported.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import ops
+from ..framework.core import Tensor
+from ..nn.layer.layers import Layer
+from ..ops._apply import defop
+
+__all__ = ["QuantConfig", "QAT", "PTQ", "FakeQuanterWithAbsMax",
+           "AbsmaxObserver", "quant_dequant"]
+
+
+@defop("fake_quant_dequant")
+def _fake_qdq(x, scale, bits=8):
+    qmax = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    y = q * s / qmax
+    # straight-through estimator: gradient flows as identity
+    return x + jax.lax.stop_gradient(y - x)
+
+
+def quant_dequant(x, scale, bits=8):
+    return _fake_qdq(x, scale, bits=bits)
+
+
+class AbsmaxObserver:
+    """PTQ calibration observer (reference observers.abs_max)."""
+
+    def __init__(self, quant_bits=8):
+        self.quant_bits = quant_bits
+        self._absmax = 0.0
+
+    def observe(self, x):
+        v = float(ops.abs(x).max().numpy())
+        self._absmax = max(self._absmax, v)
+
+    def scale(self):
+        return self._absmax
+
+
+class FakeQuanterWithAbsMax(Layer):
+    """QAT quanter (reference quanters.FakeQuanterWithAbsMaxObserver).
+
+    moving_rate=float -> EMA of per-batch absmax (QAT semantics);
+    moving_rate=None  -> running MAX (the reference abs_max PTQ observer).
+    Under a trace (recompute / jit capture) the host-side statistic cannot be
+    updated, so the quanter falls back to the current batch's absmax computed
+    on-device (dynamic quantization) — no tracer leaks, no host sync."""
+
+    def __init__(self, quant_bits=8, moving_rate=0.9):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.moving_rate = moving_rate
+        self._scale = 0.0
+        self._calibrated = False
+
+    def forward(self, x):
+        import jax as _jax
+
+        traced = isinstance(x.value, _jax.core.Tracer)
+        if self.training and traced:
+            s = ops.abs(x).max().detach()
+            return quant_dequant(x, s, bits=self.quant_bits)
+        if self.training:
+            cur = float(ops.abs(x).max().numpy())
+            if not self._calibrated:
+                self._scale = cur
+                self._calibrated = True
+            elif self.moving_rate is None:
+                self._scale = max(self._scale, cur)      # PTQ running absmax
+            else:
+                self._scale = (self.moving_rate * self._scale
+                               + (1 - self.moving_rate) * cur)
+        s = Tensor(jnp.asarray(max(self._scale, 1e-8), jnp.float32))
+        return quant_dequant(x, s, bits=self.quant_bits)
+
+
+class QuantConfig:
+    """reference config.QuantConfig: which layer types get which quanters."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation or (lambda: FakeQuanterWithAbsMax())
+        self.weight = weight or (lambda: FakeQuanterWithAbsMax())
+        self._type_cfg = {}     # layer type -> (activation factory, weight factory)
+
+    def add_type_config(self, layer_types, activation=None, weight=None):
+        if not isinstance(layer_types, (list, tuple)):
+            layer_types = [layer_types]
+        for t in layer_types:
+            self._type_cfg[t] = (activation or self.activation,
+                                 weight or self.weight)
+
+    def quantable_types(self):
+        if self._type_cfg:
+            return tuple(self._type_cfg)
+        from ..nn.layer.common import Linear
+        from ..nn.layer.conv import Conv2D
+
+        return (Linear, Conv2D)
+
+    def quanters_for(self, layer):
+        """(activation quanter, weight quanter) honoring per-type overrides."""
+        for t, (act, wt) in self._type_cfg.items():
+            if isinstance(layer, t):
+                return act(), wt()
+        return self.activation(), self.weight()
+
+
+class _QuantedWrapper(Layer):
+    """Wraps a Linear/Conv: fake-quantizes activation input and weight."""
+
+    def __init__(self, inner, config):
+        super().__init__()
+        self.inner = inner
+        self.act_quanter, self.weight_quanter = config.quanters_for(inner)
+
+    def forward(self, x):
+        xq = self.act_quanter(x)
+        w = self.inner.weight
+        wq = self.weight_quanter(w)
+        saved = w._value
+        try:
+            w._replace_value(wq.value)
+            return self.inner(xq)
+        finally:
+            w._replace_value(saved)
+
+
+def _swap_quantable(model, config):
+    count = 0
+    types = config.quantable_types()
+    for layer in model.sublayers(include_self=True):
+        for name, sub in list(layer._sub_layers.items()):
+            if isinstance(sub, types) and not isinstance(sub, _QuantedWrapper):
+                layer._sub_layers[name] = _QuantedWrapper(sub, config)
+                count += 1
+    return count
+
+
+class QAT:
+    """Quantization-aware training entry (reference qat.QAT)."""
+
+    def __init__(self, config=None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model, inplace=True):
+        n = _swap_quantable(model, self.config)
+        if n == 0:
+            raise ValueError("no quantable layers found")
+        return model
+
+    def convert(self, model, inplace=True):
+        """Freeze quanters (stop updating running scales)."""
+        for layer in model.sublayers(include_self=True):
+            if isinstance(layer, FakeQuanterWithAbsMax):
+                layer.eval()
+        return model
+
+
+class PTQ:
+    """Post-training quantization (reference ptq.PTQ): observe then freeze.
+
+    Default config uses running-ABSMAX quanters (moving_rate=None) — the
+    reference observers.abs_max semantics — so one large calibration batch is
+    never decayed away like an EMA would."""
+
+    def __init__(self, config=None):
+        self.config = config or QuantConfig(
+            activation=lambda: FakeQuanterWithAbsMax(moving_rate=None),
+            weight=lambda: FakeQuanterWithAbsMax(moving_rate=None))
+
+    def quantize(self, model, inplace=True):
+        return QAT(self.config).quantize(model, inplace=inplace)
+
+    def calibrate(self, model, data_iter, steps=None):
+        model.train()  # quanters update running absmax during calibration
+        for i, batch in enumerate(data_iter):
+            if steps is not None and i >= steps:
+                break
+            model(batch if isinstance(batch, Tensor) else batch[0])
+        return self.convert(model)
+
+    def convert(self, model, inplace=True):
+        return QAT(self.config).convert(model, inplace=inplace)

@@ -1,0 +1,86 @@
+"""paddle.text: sequence decoding utilities.
+
+Reference analog: python/paddle/text/viterbi_decode.py (viterbi_decode op +
+ViterbiDecoder layer over a CUDA kernel).
+
+TPU-first: the Viterbi recursion is a lax.scan over time steps — static
+shapes, one compiled program, batch-parallel on the VPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .framework.core import Tensor
+from .nn.layer.layers import Layer
+from .ops._apply import defop
+
+
+@defop("viterbi_decode", differentiable=False)
+def _viterbi(potentials, transitions, lengths, include_bos_eos_tag=True):
+    """potentials: (B, T, N) emission scores; transitions: (N, N);
+    lengths: (B,). Returns (scores (B,), paths (B, T))."""
+    B, T, N = potentials.shape
+    if include_bos_eos_tag:
+        # reference convention: tag N-2 = BOS, N-1 = EOS
+        start = transitions[N - 2][None, :]      # (1, N)
+    else:
+        start = jnp.zeros((1, N), potentials.dtype)
+    alpha0 = potentials[:, 0, :] + start          # (B, N)
+
+    def step(carry, t):
+        alpha, _ = carry
+        # (B, N_prev, 1) + (N_prev, N_cur) -> max over prev
+        scores = alpha[:, :, None] + transitions[None, :, :]
+        best_prev = jnp.argmax(scores, axis=1).astype(jnp.int32)  # (B, N)
+        alpha_new = jnp.max(scores, axis=1) + potentials[:, t, :]
+        # freeze once past each sequence's length
+        active = (t < lengths)[:, None]
+        alpha_new = jnp.where(active, alpha_new, alpha)
+        best_prev = jnp.where(
+            active, best_prev,
+            jnp.broadcast_to(jnp.arange(N, dtype=jnp.int32)[None, :],
+                             best_prev.shape))
+        return (alpha_new, best_prev), best_prev
+
+    (alpha, _), backptrs = lax.scan(
+        step, (alpha0, jnp.zeros((B, N), jnp.int32)), jnp.arange(1, T))
+    if include_bos_eos_tag:
+        alpha = alpha + transitions[:, N - 1][None, :]
+    scores = jnp.max(alpha, axis=-1)
+    last_tag = jnp.argmax(alpha, axis=-1).astype(jnp.int32)  # (B,)
+
+    def backtrack(carry, bp_t):
+        tag_next = carry
+        prev = jnp.take_along_axis(bp_t, tag_next[:, None], axis=1)[:, 0]
+        # ys[t] must be tag_t (the resolved tag at THIS step), i.e. prev
+        return prev, prev
+
+    _, tags_rev = lax.scan(backtrack, last_tag, backptrs, reverse=True)
+    paths = jnp.concatenate(
+        [jnp.swapaxes(tags_rev, 0, 1),
+         last_tag[:, None]], axis=1)                          # (B, T)
+    # mask past-length positions to the last valid tag
+    t_idx = jnp.arange(T)[None, :]
+    valid = t_idx < lengths[:, None]
+    paths = jnp.where(valid, paths, 0)
+    return scores, paths
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    return _viterbi(potentials, transition_params, lengths,
+                    include_bos_eos_tag=include_bos_eos_tag)
+
+
+class ViterbiDecoder(Layer):
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = (transitions if isinstance(transitions, Tensor)
+                            else Tensor(jnp.asarray(transitions)))
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -1,0 +1,221 @@
+"""Distributed auxiliaries: RoleMaker, ElasticManager, AutoTuner, CommWatchdog,
+async collective Task handles."""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+
+class TestRoleMaker:
+    def test_paddlecloud_env_discovery(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "4")
+        monkeypatch.setenv("PADDLE_TRAINER_ENDPOINTS",
+                           "h0:6170,h1:6170,h2:6170,h3:6170")
+        monkeypatch.setenv("PADDLE_CURRENT_ENDPOINT", "h2:6170")
+        rm = dist.fleet.PaddleCloudRoleMaker(is_collective=True)
+        assert rm.worker_index() == 2
+        assert rm.worker_num() == 4
+        assert rm.is_worker() and not rm.is_server()
+        assert not rm.is_first_worker()
+        assert rm.get_trainer_endpoints()[2] == "h2:6170"
+
+    def test_user_defined(self):
+        rm = dist.fleet.UserDefinedRoleMaker(
+            current_id=1, worker_num=3,
+            worker_endpoints=["a:1", "b:2", "c:3"])
+        assert rm.worker_index() == 1 and rm.worker_num() == 3
+        assert rm._current_endpoint == "b:2"
+
+    def test_ps_mode_rejected(self):
+        with pytest.raises(NotImplementedError):
+            dist.fleet.PaddleCloudRoleMaker(is_collective=False)
+
+
+class TestElastic:
+    def test_heartbeat_membership_and_scale_event(self):
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager
+        from paddle_tpu.distributed.store import TCPStore
+
+        store = TCPStore("127.0.0.1", 0, is_master=True, world_size=1,
+                         timeout=10)
+        events = []
+        m0 = ElasticManager(store, "node0", heartbeat_interval=0.1,
+                            dead_after=1.0,
+                            on_scale=lambda old, new: events.append((old, new)))
+        m0.start()
+        time.sleep(0.3)
+        assert m0.alive_nodes() == ["node0"]
+
+        m1 = ElasticManager(store, "node1", heartbeat_interval=0.1,
+                            dead_after=1.0)
+        m1.start()
+        deadline = time.time() + 5
+        while time.time() < deadline and not events:
+            time.sleep(0.05)
+        assert events and events[-1][1] == ["node0", "node1"]
+
+        # scale-in: node1 leaves; node0 sees membership shrink
+        m1.exit()
+        deadline = time.time() + 5
+        while time.time() < deadline and (not events
+                                          or events[-1][1] != ["node0"]):
+            time.sleep(0.05)
+        assert events[-1][1] == ["node0"]
+        m0.exit()
+        store.shutdown()
+
+
+class TestAutoTuner:
+    def test_prune_rules(self):
+        from paddle_tpu.distributed.auto_tuner import (SearchSpace,
+                                                       prune_candidates)
+
+        space = SearchSpace(8, max_mp=8, max_pp=8, micro_batch_sizes=(2,),
+                            shardings=(0,))
+        cands = prune_candidates(space, num_heads=4, layers=4,
+                                 global_batch=16)
+        for c in cands:
+            assert c["dp_degree"] * c["mp_degree"] * c["pp_degree"] == 8
+            assert 4 % c["mp_degree"] == 0
+            assert c["pp_degree"] <= 4
+            assert 16 % (c["dp_degree"] * c["micro_batch_size"]) == 0
+
+    def test_memory_prune(self):
+        from paddle_tpu.distributed.auto_tuner import (SearchSpace,
+                                                       prune_candidates)
+
+        space = SearchSpace(8, micro_batch_sizes=(1,), shardings=(0, 3))
+        tight = prune_candidates(space, model_params=1e9, hidden=2048,
+                                 layers=16, seq=2048, num_heads=16,
+                                 hbm_bytes=4e9)
+        loose = prune_candidates(space, model_params=1e9, hidden=2048,
+                                 layers=16, seq=2048, num_heads=16,
+                                 hbm_bytes=1e12)
+        assert len(tight) < len(loose)
+        # surviving tight candidates shard state hard (sharding or mp*pp)
+        assert all(c["sharding_stage"] >= 1 or
+                   c["mp_degree"] * c["pp_degree"] > 1 for c in tight)
+
+    def test_tune_picks_best(self):
+        from paddle_tpu.distributed.auto_tuner import AutoTuner, SearchSpace
+
+        def trial(cand):
+            if cand["pp_degree"] > 2:
+                raise RuntimeError("oom")
+            score = (cand["dp_degree"] * 10 + cand["mp_degree"]
+                     + cand["micro_batch_size"])
+            return {"tokens_per_sec": score}
+
+        tuner = AutoTuner(SearchSpace(8, micro_batch_sizes=(1, 2),
+                                      shardings=(0,)),
+                          trial, num_heads=8, layers=8)
+        best = tuner.best if False else tuner.tune()
+        assert best is not None
+        assert best["candidate"]["dp_degree"] == 8  # dp dominates the score
+        assert best["candidate"]["micro_batch_size"] == 2
+        errors = [h for h in tuner.recorder.history if h["error"]]
+        assert errors  # failed trials are recorded, not fatal
+
+
+class TestWatchdog:
+    def test_fast_section_no_fire(self):
+        dog = dist.CommWatchdog(timeout=5.0)
+        with dog.watch("allreduce#0"):
+            pass
+        assert dog.timed_out == []
+        assert "allreduce#0" in dog.dump()
+
+    def test_timeout_fires_callback(self):
+        fired = []
+        dog = dist.CommWatchdog(timeout=0.2,
+                                on_timeout=lambda d, dump: fired.append(d))
+        with dog.watch("stuck-collective"):
+            time.sleep(0.5)
+        assert fired == ["stuck-collective"]
+        assert "stuck-collective" in dog.timed_out
+
+
+class TestAsyncTask:
+    def test_sync_op_false_returns_waitable_task(self):
+        x = paddle.to_tensor(np.ones((8, 4), "float32"))
+        task = dist.all_reduce(x, sync_op=False)
+        assert task is not None
+        assert hasattr(task, "wait") and hasattr(task, "is_completed")
+        task.wait()
+        assert task.is_completed()
+        np.testing.assert_allclose(x.numpy()[0], np.full(4, 8.0))
+
+
+class TestReviewFixes:
+    def test_quant_type_overrides_honored(self):
+        from paddle_tpu.quantization import (QAT, FakeQuanterWithAbsMax,
+                                             QuantConfig, _QuantedWrapper)
+        from paddle_tpu.nn.layer.common import Linear
+
+        cfg = QuantConfig()
+        cfg.add_type_config(
+            Linear, activation=lambda: FakeQuanterWithAbsMax(quant_bits=4),
+            weight=lambda: FakeQuanterWithAbsMax(quant_bits=4))
+        model = paddle.nn.Sequential(paddle.nn.Linear(4, 4))
+        QAT(cfg).quantize(model)
+        w = [l for l in model.sublayers() if isinstance(l, _QuantedWrapper)]
+        assert w and w[0].weight_quanter.quant_bits == 4
+
+    def test_qat_works_under_recompute_trace(self):
+        from paddle_tpu.quantization import QAT
+        from paddle_tpu.distributed.fleet.recompute import recompute
+
+        model = paddle.nn.Sequential(paddle.nn.Linear(4, 4))
+        QAT().quantize(model)
+        model.train()
+        x = paddle.to_tensor(np.ones((2, 4), "float32"), stop_gradient=False)
+        y = recompute(model, x)  # tracer-valued forward must not crash
+        y.sum().backward()
+        assert x.grad is not None
+
+    def test_segment_count_kwarg_and_trace_error(self):
+        data = paddle.to_tensor(np.ones((3, 2), "float32"))
+        ids = paddle.to_tensor(np.array([0, 0, 1], "int64"))
+        out = paddle.geometric.segment_sum(data, ids, count=4)
+        assert out.shape == [4, 2]
+
+    def test_task_wait_timeout_param(self):
+        x = paddle.to_tensor(np.ones((8, 4), "float32"))
+        task = dist.all_reduce(x, sync_op=False)
+        task.wait(timeout=30)  # bounded wait completes
+        assert task.is_completed()
+
+    def test_elastic_concurrent_registration_atomic(self):
+        import threading
+
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager
+        from paddle_tpu.distributed.store import TCPStore
+
+        store = TCPStore("127.0.0.1", 0, is_master=True, world_size=1,
+                         timeout=10)
+        managers = [ElasticManager(store, f"n{i}", heartbeat_interval=0.1,
+                                   dead_after=5.0) for i in range(4)]
+        ts = [threading.Thread(target=m.register) for m in managers]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(10)
+        assert managers[0]._members() == ["n0", "n1", "n2", "n3"]
+        store.shutdown()
+
+    def test_watchdog_single_scanner_bounded_history(self):
+        dog = dist.CommWatchdog(timeout=60.0, max_history=8)
+        for i in range(20):
+            with dog.watch(f"c{i}"):
+                pass
+        assert len(dog.events) == 8  # bounded
+        import threading
+        scanners = [t for t in threading.enumerate()
+                    if t is dog._scanner]
+        assert len(scanners) == 1
+        dog.stop()

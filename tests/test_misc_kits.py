@@ -1,0 +1,153 @@
+"""Misc domain kits: quantization, audio, text (viterbi), geometric."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestQuantization:
+    def _model(self):
+        paddle.seed(0)
+        return paddle.nn.Sequential(
+            paddle.nn.Linear(8, 16), paddle.nn.ReLU(), paddle.nn.Linear(16, 4))
+
+    def test_qat_wraps_and_stays_close(self):
+        from paddle_tpu.quantization import QAT, QuantConfig, _QuantedWrapper
+
+        model = self._model()
+        x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8)
+                             .astype("float32"))
+        ref = model(x).numpy()
+        QAT(QuantConfig()).quantize(model)
+        wrapped = [l for l in model.sublayers()
+                   if isinstance(l, _QuantedWrapper)]
+        assert len(wrapped) == 2
+        model.train()
+        got = model(x).numpy()
+        # int8 fake-quant of a small net stays within quantization error
+        assert np.abs(got - ref).max() < 0.1
+        assert not np.allclose(got, ref)  # but it IS quantized
+
+    def test_qat_trains_through_ste(self):
+        from paddle_tpu.quantization import QAT
+
+        model = self._model()
+        QAT().quantize(model)
+        model.train()
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=model.parameters())
+        x = paddle.to_tensor(np.random.RandomState(1).randn(8, 8)
+                             .astype("float32"))
+        first = None
+        for _ in range(10):
+            loss = (model(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if first is None:
+                first = float(loss.numpy())
+        assert float(loss.numpy()) < first  # straight-through grads train
+
+    def test_ptq_calibrate_freezes_scales(self):
+        from paddle_tpu.quantization import PTQ, FakeQuanterWithAbsMax
+
+        model = self._model()
+        ptq = PTQ()
+        ptq.quantize(model)
+        data = [paddle.to_tensor(np.random.RandomState(i).randn(4, 8)
+                                 .astype("float32")) for i in range(3)]
+        ptq.calibrate(model, data)
+        quanters = [l for l in model.sublayers()
+                    if isinstance(l, FakeQuanterWithAbsMax)]
+        assert quanters and all(q._scale > 0 for q in quanters)
+        assert all(not q.training for q in quanters)  # frozen
+
+
+class TestAudio:
+    def test_fbank_matrix_shape_and_partition(self):
+        fb = paddle.audio.compute_fbank_matrix(16000, 512, n_mels=40).numpy()
+        assert fb.shape == (40, 257)
+        assert (fb >= 0).all()
+        assert fb.sum(axis=1).min() > 0  # every filter covers some bins
+
+    def test_mel_spectrogram_runs(self):
+        x = paddle.to_tensor(np.random.RandomState(0).randn(1, 4000)
+                             .astype("float32"))
+        mel = paddle.audio.MelSpectrogram(sr=16000, n_fft=512, n_mels=40,
+                                          pad_mode="constant")(x)
+        assert mel.shape[1] == 40 and (mel.numpy() >= 0).all()
+
+    def test_logmel_and_mfcc(self):
+        x = paddle.to_tensor(np.random.RandomState(1).randn(1, 4000)
+                             .astype("float32"))
+        logmel = paddle.audio.LogMelSpectrogram(
+            sr=16000, n_fft=512, n_mels=40, pad_mode="constant")(x)
+        assert np.isfinite(logmel.numpy()).all()
+        mfcc = paddle.audio.MFCC(sr=16000, n_fft=512, n_mels=40,
+                                 pad_mode="constant")(x)
+        assert mfcc.shape[1] == 13
+
+
+class TestViterbi:
+    def test_matches_bruteforce(self):
+        r = np.random.RandomState(0)
+        B, T, N = 2, 5, 4
+        pots = r.randn(B, T, N).astype("float32")
+        trans = r.randn(N, N).astype("float32")
+        lengths = np.array([5, 5], "int64")
+        scores, paths = paddle.text.viterbi_decode(
+            paddle.to_tensor(pots), paddle.to_tensor(trans),
+            paddle.to_tensor(lengths), include_bos_eos_tag=False)
+
+        # brute force over all tag sequences
+        import itertools
+
+        for b in range(B):
+            best, best_path = -1e30, None
+            for seq in itertools.product(range(N), repeat=T):
+                s = pots[b, 0, seq[0]]
+                for t in range(1, T):
+                    s += trans[seq[t - 1], seq[t]] + pots[b, t, seq[t]]
+                if s > best:
+                    best, best_path = s, seq
+            np.testing.assert_allclose(float(scores.numpy()[b]), best,
+                                       rtol=1e-5)
+            assert tuple(paths.numpy()[b]) == best_path
+
+
+class TestGeometric:
+    def test_segment_reductions(self):
+        data = paddle.to_tensor(np.array(
+            [[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]], "float32"))
+        ids = paddle.to_tensor(np.array([0, 0, 1], "int64"))
+        np.testing.assert_allclose(
+            paddle.geometric.segment_sum(data, ids).numpy(),
+            [[4, 6], [5, 6]])
+        np.testing.assert_allclose(
+            paddle.geometric.segment_mean(data, ids).numpy(),
+            [[2, 3], [5, 6]])
+        np.testing.assert_allclose(
+            paddle.geometric.segment_max(data, ids).numpy(),
+            [[3, 4], [5, 6]])
+        np.testing.assert_allclose(
+            paddle.geometric.segment_min(data, ids).numpy(),
+            [[1, 2], [5, 6]])
+
+    def test_send_u_recv(self):
+        x = paddle.to_tensor(np.array([[1.0], [2.0], [4.0]], "float32"))
+        src = paddle.to_tensor(np.array([0, 1, 2, 0], "int64"))
+        dst = paddle.to_tensor(np.array([1, 2, 1, 0], "int64"))
+        out = paddle.geometric.send_u_recv(x, src, dst, reduce_op="sum")
+        np.testing.assert_allclose(out.numpy(), [[1.0], [5.0], [2.0]])
+
+    def test_send_ue_recv_and_grad(self):
+        x = paddle.to_tensor(np.array([[1.0], [2.0], [4.0]], "float32"),
+                             stop_gradient=False)
+        e = paddle.to_tensor(np.array([[0.5], [0.5], [1.0]], "float32"))
+        src = paddle.to_tensor(np.array([0, 1, 2], "int64"))
+        dst = paddle.to_tensor(np.array([1, 1, 0], "int64"))
+        out = paddle.geometric.send_ue_recv(x, e, src, dst,
+                                            message_op="mul", reduce_op="sum")
+        np.testing.assert_allclose(out.numpy(), [[4.0], [1.5], [0.0]])
+        out.sum().backward()
+        assert x.grad is not None
