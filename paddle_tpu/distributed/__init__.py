@@ -72,6 +72,7 @@ from . import utils  # noqa: F401
 from . import auto_tuner  # noqa: F401
 from . import watchdog  # noqa: F401
 from .watchdog import CommWatchdog  # noqa: F401
+from .ring_attention import RingAttention, ring_attention  # noqa: F401
 from . import launch  # noqa: F401
 from .fleet.mpu.mp_ops import split  # noqa: F401
 

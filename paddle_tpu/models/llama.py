@@ -191,9 +191,26 @@ class LlamaAttention(Layer):
         q, k, _ = fused_rotary_position_embedding(
             q, k, rotary_theta=self.config.rope_theta, use_neox_rotary_style=False)
 
-        out = F.scaled_dot_product_attention(
-            q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None,
-            training=self.training)
+        if getattr(self.config, "use_ring_attention", False):
+            # context parallelism: exact attention with S/P per device, k/v
+            # ring-rotating over the sep axis (distributed/ring_attention.py);
+            # GQA k/v rotate UN-repeated — the ring's grouped einsum shares
+            # each kv head across its query group
+            if attn_mask is not None:
+                raise NotImplementedError(
+                    "ring attention supports causal masking only; a custom "
+                    "attn_mask would be silently ignored — use the math "
+                    "attention path for masked inputs")
+            from ..distributed.ring_attention import ring_attention
+
+            out = ring_attention(
+                q, k, v, mesh=getattr(self.config, "ring_mesh", None),
+                axis_name=getattr(self.config, "ring_axis", "sep"),
+                causal=True)
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None,
+                training=self.training)
         out = ops.reshape(out, [B, S, self.num_heads * self.head_dim])
         if self.config.sequence_parallel:
             out = ops.transpose(out, [1, 0, 2])  # back to (S, B, H) for Row
