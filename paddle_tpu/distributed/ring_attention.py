@@ -79,16 +79,32 @@ def _ring_attention_values(q, k, v, mesh, axis_name="sep", causal=True,
         B, Sl, H, Dh = q_loc.shape
         idx = lax.axis_index(axis_name)
         q_off = idx * Sl
-        m = jnp.full((B, H, Sl), _NEG_INF, jnp.float32)
-        l = jnp.zeros((B, H, Sl), jnp.float32)
-        acc = jnp.zeros((B, H, Sl, Dh), jnp.float32)
+        # accumulators must carry the varying-over-sep type from the start or
+        # lax.cond's branches disagree on vma (same discipline as pipelining.py)
+        m = lax.pcast(jnp.full((B, H, Sl), _NEG_INF, jnp.float32),
+                      (axis_name,), to="varying")
+        l = lax.pcast(jnp.zeros((B, H, Sl), jnp.float32),
+                      (axis_name,), to="varying")
+        acc = lax.pcast(jnp.zeros((B, H, Sl, Dh), jnp.float32),
+                        (axis_name,), to="varying")
         k_cur, v_cur = k_loc, v_loc
         step_fn = jax.checkpoint(_ring_step, static_argnums=(6,))
         for step in range(p_count):
             src = (idx - step) % p_count            # original owner of k_cur
             k_off = src * Sl
-            m, l, acc = step_fn(q_loc, k_cur, v_cur, s_scale, q_off, k_off,
-                                causal, m, l, acc)
+            if causal:
+                # blocks entirely in the future are fully masked — skip their
+                # einsums (~half the attention FLOPs); the hop still runs so
+                # the ring stays in step
+                m, l, acc = lax.cond(
+                    k_off <= q_off + Sl - 1,
+                    lambda kc, vc, m_, l_, a_, ko: step_fn(
+                        q_loc, kc, vc, s_scale, q_off, ko, True, m_, l_, a_),
+                    lambda kc, vc, m_, l_, a_, ko: (m_, l_, a_),
+                    k_cur, v_cur, m, l, acc, k_off)
+            else:
+                m, l, acc = step_fn(q_loc, k_cur, v_cur, s_scale, q_off,
+                                    k_off, False, m, l, acc)
             if step < p_count - 1:
                 # one ICI neighbour hop: block moves to the next rank
                 k_cur = lax.ppermute(k_cur, axis_name, perm)
@@ -119,6 +135,12 @@ def ring_attention(q, k, v, mesh=None, axis_name="sep", causal=True,
         raise ValueError(
             f"sequence length {q.shape[1]} must be divisible by the ring "
             f"size {mesh.shape[axis_name]}")
+    if k.shape[1] != q.shape[1] or v.shape[1] != q.shape[1]:
+        # block offsets derive from the shared local length; a differing k/v
+        # length would silently misalign the causal mask
+        raise ValueError(
+            f"ring attention is self-attention over ONE sequence: q/k/v "
+            f"lengths must match, got {q.shape[1]}/{k.shape[1]}/{v.shape[1]}")
 
     jfn = _jitted_ring(mesh, axis_name, bool(causal),
                        None if scale is None else float(scale))

@@ -116,11 +116,20 @@ def _disown_and_close(segments, unlink=False):
 
 
 def _worker_loop(dataset, collate_fn, index_queue, result_queue, worker_id,
-                 num_workers, use_shared_memory, worker_init_fn, base_seed):
+                 num_workers, use_shared_memory, worker_init_fn, base_seed,
+                 ring_name=None):
     """Body of one forked worker (reference worker.py _worker_loop)."""
     _WORKER_INFO[0] = WorkerInfo(worker_id, num_workers, dataset,
                                  seed=(base_seed + worker_id
                                        if base_seed is not None else None))
+    ring = None
+    if ring_name is not None:
+        try:
+            from .native_shm import ShmRing
+
+            ring = ShmRing(ring_name)
+        except Exception:
+            ring = None  # fall back to the per-array SharedMemory path
     if base_seed is not None:
         import random
 
@@ -155,6 +164,34 @@ def _worker_loop(dataset, collate_fn, index_queue, result_queue, worker_id,
                     "worker; forked children must not touch jax — collate to "
                     "numpy (the parent stages to device) or set "
                     "DataLoader(use_shared_memory=False)")
+            if ring is not None:
+                # native transport: one memcpy into the shared ring instead of
+                # per-array shm segments / pickled pipe chunks
+                import pickle
+
+                blob = pickle.dumps((seq, batch), protocol=5)
+                try:
+                    pushed = False
+                    while not pushed:
+                        pushed = ring.push(blob, timeout=1.0)
+                        if not pushed:
+                            # parent shut down mid-epoch? a sentinel in the
+                            # index queue or a reparented process means stop
+                            # retrying so the sentinel/join path can proceed
+                            if os.getppid() == 1:
+                                return
+                            try:
+                                job2 = index_queue.get_nowait()
+                            except queue_mod.Empty:
+                                continue
+                            if job2 is None:
+                                return  # shutdown requested while blocked
+                            # not a sentinel: keep it for after this push
+                            index_queue.put(job2)
+                    result_queue.put(("ring", seq, worker_id))
+                    continue
+                except ValueError:
+                    pass  # batch larger than the ring: per-array shm fallback
             if use_shared_memory:
                 payload = _pack(batch, segments)
                 result_queue.put(("ok", seq, payload))
@@ -182,7 +219,8 @@ class MultiprocessBatchLoader:
 
     def __init__(self, dataset, collate_fn, num_workers,
                  prefetch_factor=2, use_shared_memory=True, timeout=0,
-                 worker_init_fn=None, base_seed=None):
+                 worker_init_fn=None, base_seed=None,
+                 ring_capacity=64 << 20):
         self._ctx = multiprocessing.get_context("fork")
         self._index_queues = [self._ctx.Queue() for _ in range(num_workers)]
         self._result_queue = self._ctx.Queue()
@@ -191,12 +229,31 @@ class MultiprocessBatchLoader:
         self._max_outstanding = num_workers * max(prefetch_factor, 2)
         self._send_seq = 0
         self._recv_seq = 0
+        # native shared-memory rings (one SPSC ring per worker) when the C++
+        # transport compiled; workers fall back per-batch when a batch exceeds
+        # the ring, and entirely when attach fails
+        self._rings = {}
+        ring_names = [None] * num_workers
+        if use_shared_memory:
+            try:
+                from .native_shm import ShmRing, available
+
+                if available():
+                    uid = f"{os.getpid()}_{id(self) & 0xFFFFFF:x}"
+                    for wid in range(num_workers):
+                        name = f"/pt_dl_{uid}_{wid}"
+                        self._rings[wid] = ShmRing(
+                            name, capacity=ring_capacity, create=True)
+                        ring_names[wid] = name
+            except Exception:
+                self._rings = {}
+                ring_names = [None] * num_workers
         self._workers = [
             self._ctx.Process(
                 target=_worker_loop,
                 args=(dataset, collate_fn, self._index_queues[wid],
                       self._result_queue, wid, num_workers, use_shared_memory,
-                      worker_init_fn, base_seed),
+                      worker_init_fn, base_seed, ring_names[wid]),
                 daemon=True)
             for wid in range(num_workers)
         ]
@@ -273,7 +330,21 @@ class MultiprocessBatchLoader:
                     self.shutdown()
                     raise RuntimeError(
                         f"DataLoader worker failed:\n{payload}")
-                reorder[seq] = _unpack(payload)
+                if status == "ring":
+                    import pickle
+
+                    blob = self._rings[payload].pop(timeout=self._timeout
+                                                    or 300)
+                    if blob is None:
+                        self.shutdown()
+                        raise TimeoutError(
+                            "ring marker arrived but payload never did "
+                            f"(worker {payload})")
+                    ring_seq, batch = pickle.loads(blob)
+                    assert ring_seq == seq  # SPSC FIFO: marker order == data order
+                    reorder[seq] = batch
+                else:
+                    reorder[seq] = _unpack(payload)
         except GeneratorExit:
             # consumer abandoned the epoch mid-way: outstanding results would
             # desynchronize seq bookkeeping; tear the pool down
@@ -306,6 +377,13 @@ class MultiprocessBatchLoader:
                     _unpack(payload)
             except queue_mod.Empty:
                 empty_rounds += 1
+        for ring in self._rings.values():
+            try:
+                ring.close()
+                ring.unlink()
+            except Exception:
+                pass
+        self._rings = {}
 
     def __del__(self):
         try:

@@ -239,3 +239,48 @@ class TestReviewFixes:
         parent_probe_calls = calls.count(0)  # parent-side list (fork copies)
         _drain(loader)
         assert calls.count(0) == parent_probe_calls  # no re-probe on epoch 2
+
+
+class TestNativeRingTransport:
+    def test_native_ring_available_and_used(self):
+        from paddle_tpu.io.native_shm import available
+
+        assert available()  # g++ is baked into the image
+        from paddle_tpu.io.worker import MultiprocessBatchLoader
+        from paddle_tpu.io.dataloader import default_collate_fn
+
+        pool = MultiprocessBatchLoader(SimpleDs(16), default_collate_fn,
+                                       num_workers=2)
+        assert len(pool._rings) == 2  # one SPSC ring per worker
+        out = list(pool.epoch(iter([[0, 1], [2, 3], [4, 5], [6, 7]])))
+        assert len(out) == 4
+        np.testing.assert_array_equal(out[0][0], [[0.0] * 4, [1.0] * 4])
+        pool.shutdown()
+
+    def test_oversized_batch_falls_back_to_segments(self):
+        from paddle_tpu.io.worker import MultiprocessBatchLoader
+        from paddle_tpu.io.dataloader import default_collate_fn
+
+        class BigDs(Dataset):
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                return np.full((1 << 18,), float(i), "float32")  # 1MB each
+
+        pool = MultiprocessBatchLoader(BigDs(), default_collate_fn,
+                                       num_workers=1, ring_capacity=1 << 20)
+        out = list(pool.epoch(iter([[0, 1], [2, 3]])))  # 2MB batches > ring
+        assert len(out) == 2
+        np.testing.assert_array_equal(out[1][:, 0], [2.0, 3.0])
+        pool.shutdown()
+
+    def test_loader_results_identical_with_ring(self):
+        ds = SimpleDs(24)
+        seq = _drain(DataLoader(ds, batch_size=4, num_workers=0,
+                                use_buffer_reader=False))
+        mp = _drain(DataLoader(ds, batch_size=4, num_workers=3,
+                               use_buffer_reader=False))
+        for a, b in zip(seq, mp):
+            np.testing.assert_array_equal(np.asarray(a[0].value),
+                                          np.asarray(b[0].value))

@@ -178,3 +178,9 @@ class TestRingReviewFixes:
         mask = paddle.to_tensor(np.zeros((1, 1, 32, 32), "float32"))
         with pytest.raises(NotImplementedError, match="causal"):
             model(ids, attn_mask=mask)
+
+    def test_kv_length_mismatch_rejected(self):
+        q = paddle.to_tensor(np.zeros((1, 32, 2, 8), "float32"))
+        k = paddle.to_tensor(np.zeros((1, 64, 2, 8), "float32"))
+        with pytest.raises(ValueError, match="ONE sequence"):
+            dist.ring_attention(q, k, k, mesh=_mesh(), causal=True)
