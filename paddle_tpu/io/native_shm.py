@@ -67,7 +67,7 @@ def _lib():
             lib.shmring_free_bytes.restype = ctypes.c_uint64
             lib.shmring_free_bytes.argtypes = [ctypes.c_void_p]
             lib.shmring_try_push.restype = ctypes.c_int
-            lib.shmring_try_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+            lib.shmring_try_push.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                              ctypes.c_uint64]
             lib.shmring_peek_len.restype = ctypes.c_int64
             lib.shmring_peek_len.argtypes = [ctypes.c_void_p]
@@ -111,10 +111,21 @@ class ShmRing:
     def capacity(self):
         return int(self._lib.shmring_capacity(self._ptr))
 
-    def try_push(self, data: bytes) -> int:
-        return int(self._lib.shmring_try_push(self._ptr, data, len(data)))
+    def try_push(self, data) -> int:
+        """data: bytes or a buffer-protocol object (memoryview/PickleBuffer
+        raw view) — writable buffers push zero-copy via from_buffer."""
+        if isinstance(data, bytes):
+            # ctypes passes the bytes' internal pointer for c_void_p args
+            return int(self._lib.shmring_try_push(self._ptr, data, len(data)))
+        mv = memoryview(data).cast("B")
+        n = len(mv)
+        try:
+            carr = (ctypes.c_ubyte * n).from_buffer(mv)     # zero-copy
+        except TypeError:  # read-only buffer
+            carr = (ctypes.c_ubyte * n).from_buffer_copy(mv)
+        return int(self._lib.shmring_try_push(self._ptr, ctypes.byref(carr), n))
 
-    def push(self, data: bytes, timeout=None, poll=0.0005) -> bool:
+    def push(self, data, timeout=None, poll=0.0005) -> bool:
         """Blocking push; False on timeout, raises ValueError if it can never fit."""
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:

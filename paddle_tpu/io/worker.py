@@ -122,6 +122,7 @@ def _worker_loop(dataset, collate_fn, index_queue, result_queue, worker_id,
     _WORKER_INFO[0] = WorkerInfo(worker_id, num_workers, dataset,
                                  seed=(base_seed + worker_id
                                        if base_seed is not None else None))
+    parent_pid = os.getppid()  # the REAL parent; may legitimately be pid 1
     ring = None
     if ring_name is not None:
         try:
@@ -169,26 +170,36 @@ def _worker_loop(dataset, collate_fn, index_queue, result_queue, worker_id,
                 # per-array shm segments / pickled pipe chunks
                 import pickle
 
-                blob = pickle.dumps((seq, batch), protocol=5)
+                # out-of-band buffers: array bytes go to the ring RAW instead
+                # of being copied into the pickle stream first
+                oob = []
+                header = pickle.dumps((seq, batch), protocol=5,
+                                      buffer_callback=oob.append)
+                frames = [header] + [b.raw() for b in oob]
                 try:
-                    pushed = False
-                    while not pushed:
-                        pushed = ring.push(blob, timeout=1.0)
-                        if not pushed:
-                            # parent shut down mid-epoch? a sentinel in the
-                            # index queue or a reparented process means stop
-                            # retrying so the sentinel/join path can proceed
-                            if os.getppid() == 1:
-                                return
+                    total = sum(len(f) + 16 for f in frames)
+                    if total + 16 > ring.capacity:
+                        raise ValueError("batch exceeds ring")
+
+                    def push_frame(f):
+                        while not ring.push(f, timeout=1.0):
+                            # parent gone (reparented away from the ORIGINAL
+                            # parent) or shutdown sentinel: stop retrying so
+                            # join can proceed
+                            if os.getppid() != parent_pid:
+                                return False
                             try:
                                 job2 = index_queue.get_nowait()
                             except queue_mod.Empty:
                                 continue
                             if job2 is None:
-                                return  # shutdown requested while blocked
-                            # not a sentinel: keep it for after this push
-                            index_queue.put(job2)
-                    result_queue.put(("ring", seq, worker_id))
+                                return False
+                            index_queue.put(job2)  # keep for after this push
+                        return True
+
+                    if not all(push_frame(f) for f in frames):
+                        return
+                    result_queue.put(("ring", seq, (worker_id, len(oob))))
                     continue
                 except ValueError:
                     pass  # batch larger than the ring: per-array shm fallback
@@ -333,15 +344,25 @@ class MultiprocessBatchLoader:
                 if status == "ring":
                     import pickle
 
-                    blob = self._rings[payload].pop(timeout=self._timeout
-                                                    or 300)
-                    if blob is None:
+                    wid, n_oob = payload
+                    ring = self._rings[wid]
+                    frames = []
+                    for _ in range(1 + n_oob):
+                        blob = ring.pop(timeout=self._timeout or 300)
+                        if blob is None:
+                            self.shutdown()
+                            raise TimeoutError(
+                                "ring marker arrived but payload never did "
+                                f"(worker {wid})")
+                        frames.append(blob)
+                    # out-of-band reconstruct: arrays view the popped frames
+                    ring_seq, batch = pickle.loads(frames[0],
+                                                   buffers=frames[1:])
+                    if ring_seq != seq:  # SPSC FIFO: marker order == data order
                         self.shutdown()
-                        raise TimeoutError(
-                            "ring marker arrived but payload never did "
-                            f"(worker {payload})")
-                    ring_seq, batch = pickle.loads(blob)
-                    assert ring_seq == seq  # SPSC FIFO: marker order == data order
+                        raise RuntimeError(
+                            f"ring transport desynchronized: marker seq {seq} "
+                            f"!= payload seq {ring_seq} (worker {wid})")
                     reorder[seq] = batch
                 else:
                     reorder[seq] = _unpack(payload)

