@@ -13,6 +13,24 @@ a to_static model trains exactly like eager with one fused step program. Mutable
 (buffers like BN running stats, the RNG key) is threaded functionally: state in, new state
 out, written back after each call — recompilation happens only on new (shapes, dtypes,
 training-mode) signatures, mirroring the reference's program cache keyed on input spec.
+
+GRAPH-BREAK CONTRACT (differs from the reference's SOT bytecode path, jit/sot/):
+the reference's bytecode tracer falls back to eager at unsupported Python
+constructs ("graph breaks"); here there is NO fallback — the whole function
+traces or nothing does. Concretely:
+
+* Python control flow on TENSOR VALUES (`if x.sum() > 0:`) does not create a
+  dynamic branch: the branch taken during tracing is baked into the compiled
+  program for every later call with that signature. Use `paddle.where` /
+  `lax.cond`-style ops for data-dependent behavior.
+* `print`/pdb inside the function see tracers; side effects run once at trace
+  time, not per call.
+* `.numpy()`, `float()`, `.item()` on intermediate values raise under the
+  trace (jax ConcretizationTypeError) instead of silently graph-breaking — the
+  error names the offending line; hoist host reads out of the compiled region.
+* Shape changes retrace: InputSpec dims of None accept any size but each new
+  concrete size compiles its own program (there is no shape-polymorphic
+  executable).
 """
 from __future__ import annotations
 
