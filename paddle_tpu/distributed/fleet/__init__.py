@@ -14,8 +14,11 @@ from .fleet import (  # noqa: F401
     get_hybrid_communicate_group,
     init,
     init_server,
+    init_worker,
     is_first_worker,
     is_initialized,
+    is_server,
+    is_worker,
     run_server,
     save_persistables,
     stop_worker,

@@ -36,6 +36,7 @@ class _FleetState:
         self.strategy = None
         self.role_maker = None
         self.hcg = None
+        self.ps_mode = False
 
 
 _STATE = _FleetState()
@@ -45,6 +46,16 @@ def init(role_maker=None, is_collective=False, strategy=None, log_level="INFO"):
     """fleet.init (fleet/fleet.py:218)."""
     _STATE.strategy = strategy or DistributedStrategy()
     _STATE.role_maker = role_maker or PaddleCloudRoleMaker(is_collective=is_collective)
+    collective = getattr(_STATE.role_maker, "_is_collective", is_collective)
+    pserver_eps = getattr(_STATE.role_maker, "get_pserver_endpoints",
+                          lambda: [])()
+    if not collective and pserver_eps:
+        # parameter-server mode: no device mesh; the PS runtime owns comms
+        _STATE.ps_mode = True
+        _STATE.hcg = None
+        _STATE.initialized = True
+        return None
+    _STATE.ps_mode = False
     parallel_mod.init_parallel_env()
 
     hybrid = _STATE.strategy.hybrid_configs
@@ -108,13 +119,23 @@ def worker_endpoints(to_string=False):
 
 
 def barrier_worker():
+    if _STATE.ps_mode:
+        from ..ps.the_one_ps import runtime
+
+        if runtime().client is not None:
+            runtime().client.barrier("worker")
+        return
     from .. import collective
 
     collective.barrier()
 
 
 def distributed_model(model):
-    """Pick the meta-parallel wrapper per strategy (fleet/model.py:33,135-163)."""
+    """Pick the meta-parallel wrapper per strategy (fleet/model.py:33,135-163);
+    in PS mode, binds DistributedEmbedding layers and returns the model as-is."""
+    if _STATE.ps_mode:
+        _STATE.ps_model = model
+        return model
     from .meta_parallel.pipeline_parallel import (PipelineParallel,
                                                   PipelineParallelWithInterleave,
                                                   SegmentParallel, ShardingParallel,
@@ -149,11 +170,22 @@ def distributed_model(model):
 
 
 def distributed_optimizer(optimizer, strategy=None):
-    """Wrap with HybridParallelOptimizer (fleet/fleet.py distributed_optimizer)."""
+    """Wrap with HybridParallelOptimizer (fleet/fleet.py distributed_optimizer);
+    in PS mode (is_collective=False) with PSOptimizer (ps/the_one_ps.py)."""
     from .hybrid_optimizer import HybridParallelOptimizer
 
     if strategy is not None:
         _STATE.strategy = strategy
+    if _STATE.ps_mode:
+        from ..ps.the_one_ps import PSOptimizer, runtime
+
+        if runtime().client is None:
+            init_worker()
+        ps_opt = PSOptimizer(optimizer, _strategy(), runtime().client)
+        model = getattr(_STATE, "ps_model", None)
+        if model is not None:
+            ps_opt._attach_embeddings(model)
+        return ps_opt
     hcg = get_hybrid_communicate_group()
     if hcg is None:
         return optimizer
@@ -174,17 +206,56 @@ def save_persistables(executor_or_model, dirname, main_program=None, mode=0):
 
         _os.makedirs(dirname, exist_ok=True)
         _save(model.state_dict(), os.path.join(dirname, "model.pdparams"))
+    if _STATE.ps_mode:
+        # server-resident state (sparse rows, dense masters) lives in the PS
+        # shards; trainer 0 asks every server to write its shard
+        from ..ps.the_one_ps import runtime
+
+        client = runtime().client
+        if client is not None and is_first_worker():
+            client.save(dirname)
 
 
-def init_server(*args, **kwargs):
-    raise NotImplementedError(
-        "parameter-server mode is out of scope for the TPU build (SURVEY.md §2.6); "
-        "use collective training")
+def is_server():
+    rm = _STATE.role_maker
+    return bool(rm is not None and rm.is_server())
+
+
+def is_worker():
+    rm = _STATE.role_maker
+    return rm is None or not rm.is_server()
+
+
+def init_server(model_dir=None, **kwargs):
+    from ..ps.the_one_ps import runtime
+
+    runtime().init_server(_STATE.role_maker, model_dir=model_dir)
 
 
 def run_server():
-    init_server()
+    from ..ps.the_one_ps import runtime
+
+    if runtime().server is None:
+        init_server()
+    runtime().run_server()
+
+
+def init_worker(scopes=None):
+    from ..ps.the_one_ps import runtime
+
+    if runtime().client is None:
+        runtime().init_worker(_STATE.role_maker)
+    return runtime().client
 
 
 def stop_worker():
-    pass
+    from ..ps.the_one_ps import runtime
+
+    client = runtime().client
+    if client is None:
+        return
+    client.barrier("stop")  # all trainers finished before servers die
+    if _STATE.role_maker is None or _STATE.role_maker.is_first_worker():
+        client.stop_servers()
+    client.close()
+    runtime().client = None

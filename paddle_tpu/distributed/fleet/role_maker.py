@@ -6,8 +6,10 @@ UserDefinedRoleMaker for explicit topologies).
 
 TPU-first mapping: role discovery reads the same env contract the launcher
 writes (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ENDPOINTS);
-the Gloo barrier becomes a TCPStore barrier. Collective mode only — the
-parameter-server roles raise (SURVEY §2.6: PS is out of the TPU north star).
+the Gloo barrier becomes a TCPStore barrier. PS mode (is_collective=False)
+reads the reference's PS env contract (TRAINING_ROLE=TRAINER|PSERVER,
+PADDLE_PSERVERS_IP_PORT_LIST, POD_IP/PADDLE_PORT) and feeds
+paddle_tpu.distributed.ps (the host-side parameter-server stack).
 """
 from __future__ import annotations
 
@@ -53,10 +55,6 @@ class PaddleCloudRoleMaker(RoleMakerBase):
     """Env-var role discovery (role_maker.py PaddleCloudRoleMaker)."""
 
     def __init__(self, is_collective=True, **kwargs):
-        if not is_collective:
-            raise NotImplementedError(
-                "parameter-server role discovery is not part of the TPU build; "
-                "use is_collective=True")
         self._is_collective = is_collective
         self._generate_role()
 
@@ -67,6 +65,19 @@ class PaddleCloudRoleMaker(RoleMakerBase):
         self._worker_endpoints = [e for e in eps.split(",") if e]
         self._current_endpoint = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
         self._role = Role.WORKER
+        self._server_endpoints = []
+        if not self._is_collective:
+            # PS env contract (reference role_maker.py _ps_env)
+            sv = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+            self._server_endpoints = [e for e in sv.split(",") if e]
+            training_role = os.environ.get("TRAINING_ROLE", "TRAINER")
+            if training_role == "PSERVER":
+                self._role = Role.SERVER
+                host = os.environ.get("POD_IP", "127.0.0.1")
+                port = os.environ.get("PADDLE_PORT", "")
+                self._current_endpoint = (
+                    f"{host}:{port}" if port else
+                    (self._server_endpoints[0] if self._server_endpoints else ""))
 
     def _worker_num(self):
         return self._trainers_num
@@ -79,6 +90,26 @@ class PaddleCloudRoleMaker(RoleMakerBase):
 
     def get_trainer_endpoints(self):
         return list(self._worker_endpoints)
+
+    def is_server(self):
+        return self._role == Role.SERVER
+
+    def _is_server(self):
+        return self.is_server()
+
+    def server_num(self):
+        return len(self._server_endpoints)
+
+    def server_index(self):
+        if self._current_endpoint in self._server_endpoints:
+            return self._server_endpoints.index(self._current_endpoint)
+        return 0
+
+    def get_pserver_endpoints(self):
+        return list(self._server_endpoints)
+
+    def get_current_endpoint(self):
+        return self._current_endpoint
 
     def _barrier(self, comm_world="worker"):
         if self._trainers_num <= 1:
@@ -98,17 +129,25 @@ class UserDefinedRoleMaker(PaddleCloudRoleMaker):
     """Explicit topology (role_maker.py UserDefinedRoleMaker)."""
 
     def __init__(self, is_collective=True, current_id=0, role=Role.WORKER,
-                 worker_num=1, worker_endpoints=None, **kwargs):
+                 worker_num=1, worker_endpoints=None,
+                 server_endpoints=None, **kwargs):
         self._user = dict(current_id=current_id, role=role,
                           worker_num=worker_num,
-                          worker_endpoints=worker_endpoints or [])
+                          worker_endpoints=worker_endpoints or [],
+                          server_endpoints=server_endpoints or [])
         super().__init__(is_collective=is_collective)
 
     def _generate_role(self):
         self._trainer_id = self._user["current_id"]
         self._trainers_num = self._user["worker_num"]
         self._worker_endpoints = list(self._user["worker_endpoints"])
-        self._current_endpoint = (
-            self._worker_endpoints[self._trainer_id]
-            if self._trainer_id < len(self._worker_endpoints) else "")
+        self._server_endpoints = list(self._user["server_endpoints"])
         self._role = self._user["role"]
+        if self._role == Role.SERVER:
+            self._current_endpoint = (
+                self._server_endpoints[self._trainer_id]
+                if self._trainer_id < len(self._server_endpoints) else "")
+        else:
+            self._current_endpoint = (
+                self._worker_endpoints[self._trainer_id]
+                if self._trainer_id < len(self._worker_endpoints) else "")

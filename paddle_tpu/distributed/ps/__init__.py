@@ -1,0 +1,27 @@
+"""Parameter-server training stack (sparse recsys capability).
+
+Reference analog: paddle/fluid/distributed/ps/ (brpc PSClient/PSServer with
+dense/sparse tables and server-side optimizers) surfaced through
+python/paddle/distributed/ps/the_one_ps.py and fleet.init(is_collective=False).
+
+TPU-first redesign: the data-plane stays host-side — PS training is a CPU/host
+workload (sparse embedding tables too large for HBM); the dense math on the
+trainer still runs through the normal jax op path. The brpc transport is
+replaced by a compact length-prefixed TCP protocol (same family as
+distributed/store.py TCPStore); tables and server-side optimizers are numpy.
+Sync mode is exact synchronous SGD (server accumulates grads from all
+trainers, applies once, version-gated pulls); async applies per-push; geo
+pushes local parameter deltas every k steps.
+"""
+from .tables import DenseTable, SparseTable
+from .service import PSServer, PSClient
+from .the_one_ps import (
+    TheOnePS,
+    PSOptimizer,
+    DistributedEmbedding,
+)
+
+__all__ = [
+    "DenseTable", "SparseTable", "PSServer", "PSClient",
+    "TheOnePS", "PSOptimizer", "DistributedEmbedding",
+]

@@ -1,0 +1,402 @@
+"""PSServer / PSClient: the parameter-server RPC transport.
+
+Reference analog: paddle/fluid/distributed/ps/service/{brpc_ps_server,
+brpc_ps_client}.cc. The brpc transport becomes a length-prefixed pickle
+protocol over TCP (the same framing family as distributed/store.py TCPStore);
+each client connection gets a handler thread on the server, so blocking
+version-gated pulls (sync SGD) ride their own connections without stalling
+other trainers.
+
+Partitioning (ps/table/table.h shard logic): dense tables live whole on
+server `hash(name) % nservers`; sparse rows are sharded `id % nservers`.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import socket
+import struct
+import threading
+
+import numpy as np
+
+from .tables import DenseTable, SparseTable, _ServerOptimizer
+
+_CMD_REGISTER_DENSE = 0
+_CMD_PULL_DENSE = 1
+_CMD_PUSH_DENSE = 2
+_CMD_SET_DENSE = 3
+_CMD_REGISTER_SPARSE = 4
+_CMD_PULL_SPARSE = 5
+_CMD_PUSH_SPARSE = 6
+_CMD_BARRIER = 7
+_CMD_SAVE = 8
+_CMD_LOAD = 9
+_CMD_STAT = 10
+_CMD_STOP = 11
+
+
+def _send_msg(sock, cmd, payload):
+    body = pickle.dumps((cmd, payload), protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("<I", len(body)) + body)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("PS peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock):
+    (n,) = struct.unpack("<I", _recv_exact(sock, 4))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def _dense_home(name, nservers):
+    h = int.from_bytes(hashlib.md5(name.encode()).digest()[:4], "little")
+    return h % nservers
+
+
+class PSServer:
+    """One parameter-server process/thread: owns a shard of every table."""
+
+    def __init__(self, endpoint, warm_dir=None):
+        host, port = endpoint.rsplit(":", 1)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, int(port)))
+        self._sock.listen(128)
+        self.endpoint = f"{host}:{self._sock.getsockname()[1]}"
+        self._warm_dir = warm_dir  # fleet.init_server(model_dir=...) warm start
+        self._dense = {}
+        self._sparse = {}
+        self._lock = threading.Lock()
+        self._barriers = {}
+        self._bcv = threading.Condition()
+        self._stop = threading.Event()
+        self._stopped = threading.Event()
+        self._thread = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        """Serve in a daemon thread (tests / in-process servers)."""
+        self._thread = threading.Thread(target=self.run, daemon=True)
+        self._thread.start()
+        return self
+
+    def run(self):
+        """Serve until STOP (blocking; fleet.run_server)."""
+        self._sock.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+        self._sock.close()
+        self._stopped.set()
+
+    def shutdown(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self._thread is not None:
+            # the in-flight accept() holds the listening fd until its timeout
+            # expires; wait so the port is genuinely free on return
+            self._stopped.wait(timeout=2.0)
+
+    # -- request handling ---------------------------------------------------
+    def _serve_conn(self, conn):
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while not self._stop.is_set():
+                cmd, payload = _recv_msg(conn)
+                try:
+                    reply = self._dispatch(cmd, payload)
+                    _send_msg(conn, 0, reply)
+                except Exception as e:  # surface server errors to the client
+                    _send_msg(conn, 1, f"{type(e).__name__}: {e}")
+                if cmd == _CMD_STOP:
+                    break
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def _dispatch(self, cmd, p):
+        if cmd == _CMD_REGISTER_DENSE:
+            name, init_value, opt_cfg, trainers, sync = p
+            with self._lock:
+                t = self._dense.get(name)
+                if t is None:
+                    t = DenseTable(name, init_value,
+                                   _ServerOptimizer(**opt_cfg),
+                                   trainers=trainers, sync=sync)
+                    self._warm_load_dense(name, t)
+                    self._dense[name] = t
+            return t.version
+        if cmd == _CMD_PULL_DENSE:
+            name, min_version = p
+            return self._dense[name].pull(min_version)
+        if cmd == _CMD_PUSH_DENSE:
+            name, grad, lr = p
+            return self._dense[name].push_grad(grad, lr)
+        if cmd == _CMD_SET_DENSE:
+            name, value = p
+            self._dense[name].set_value(value)
+            return None
+        if cmd == _CMD_REGISTER_SPARSE:
+            name, dim, opt_cfg, init_scale, seed, trainers, sync = p
+            with self._lock:
+                if name not in self._sparse:
+                    t = SparseTable(
+                        name, dim, _ServerOptimizer(**opt_cfg),
+                        init_scale=init_scale, seed=seed,
+                        trainers=trainers, sync=sync)
+                    self._warm_load_sparse(name, t)
+                    self._sparse[name] = t
+            return None
+        if cmd == _CMD_PULL_SPARSE:
+            name, ids = p
+            return self._sparse[name].pull(ids)
+        if cmd == _CMD_PUSH_SPARSE:
+            name, ids, grads, lr = p
+            self._sparse[name].push_grad(ids, grads, lr)
+            return None
+        if cmd == _CMD_BARRIER:
+            key, n = p
+            with self._bcv:
+                self._barriers[key] = self._barriers.get(key, 0) + 1
+                gen_key = f"{key}/gen"
+                if self._barriers[key] >= n:
+                    self._barriers[key] = 0
+                    self._barriers[gen_key] = self._barriers.get(gen_key, 0) + 1
+                    self._bcv.notify_all()
+                    return None
+                gen = self._barriers.get(gen_key, 0)
+                ok = self._bcv.wait_for(
+                    lambda: self._barriers.get(gen_key, 0) > gen, 120.0)
+                if not ok:
+                    raise TimeoutError(f"PS barrier {key!r} timed out")
+            return None
+        if cmd == _CMD_SAVE:
+            (dirname,) = p
+            return self._save(dirname)
+        if cmd == _CMD_LOAD:
+            (dirname,) = p
+            return self._load(dirname)
+        if cmd == _CMD_STAT:
+            with self._lock:
+                return {
+                    "dense": {n: list(t.value.shape)
+                              for n, t in self._dense.items()},
+                    "sparse": {n: t.n_rows() for n, t in self._sparse.items()},
+                }
+        if cmd == _CMD_STOP:
+            self.shutdown()
+            return None
+        raise ValueError(f"unknown PS command {cmd}")
+
+    def _save(self, dirname):
+        os.makedirs(dirname, exist_ok=True)
+        tag = self.endpoint.replace(":", "_")
+        blob = {}
+        with self._lock:
+            for n, t in self._dense.items():
+                blob[f"dense/{n}"] = t.value
+            for n, t in self._sparse.items():
+                ids, vals = t.dump()
+                blob[f"sparse_ids/{n}"] = ids
+                blob[f"sparse_vals/{n}"] = vals
+        np.savez(os.path.join(dirname, f"ps_shard_{tag}.npz"), **blob)
+        return None
+
+    def _warm_npz(self):
+        if not self._warm_dir:
+            return None
+        path = os.path.join(self._warm_dir,
+                            f"ps_shard_{self.endpoint.replace(':', '_')}.npz")
+        return np.load(path) if os.path.exists(path) else None
+
+    def _warm_load_dense(self, name, table):
+        z = self._warm_npz()
+        if z is not None:
+            with z:
+                if f"dense/{name}" in z.files:
+                    table.value = np.asarray(z[f"dense/{name}"], np.float32)
+
+    def _warm_load_sparse(self, name, table):
+        z = self._warm_npz()
+        if z is not None:
+            with z:
+                if f"sparse_ids/{name}" in z.files:
+                    table.load(z[f"sparse_ids/{name}"], z[f"sparse_vals/{name}"])
+
+    def _load(self, dirname):
+        tag = self.endpoint.replace(":", "_")
+        path = os.path.join(dirname, f"ps_shard_{tag}.npz")
+        with np.load(path) as z:
+            with self._lock:
+                for key in z.files:
+                    kind, name = key.split("/", 1)
+                    if kind == "dense" and name in self._dense:
+                        self._dense[name].set_value(z[key])
+                for name, t in self._sparse.items():
+                    ik, vk = f"sparse_ids/{name}", f"sparse_vals/{name}"
+                    if ik in z.files:
+                        t.load(z[ik], z[vk])
+        return None
+
+
+class PSClient:
+    """Trainer-side handle to every server; thread-safe per-connection."""
+
+    def __init__(self, server_endpoints, trainer_id=0, trainers=1,
+                 connect_timeout=120.0):
+        self.endpoints = list(server_endpoints)
+        self.trainer_id = int(trainer_id)
+        self.trainers = int(trainers)
+        self._socks, self._locks = [], []
+        for ep in self.endpoints:
+            self._socks.append(self._connect(ep, connect_timeout))
+            self._locks.append(threading.Lock())
+        self._dense_home = {}
+        self._sparse_dims = {}
+        self._sparse_sync = {}
+
+    @staticmethod
+    def _connect(ep, deadline_s):
+        """Retry until the server is up (trainers often start first) —
+        same pattern as store.py TCPStore._connect."""
+        import time
+
+        host, port = ep.rsplit(":", 1)
+        deadline = time.monotonic() + deadline_s
+        while True:
+            try:
+                s = socket.create_connection((host, int(port)), timeout=5)
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                return s
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"PS server {ep} unreachable after {deadline_s}s")
+                time.sleep(0.2)
+
+    @property
+    def nservers(self):
+        return len(self.endpoints)
+
+    def _call(self, idx, cmd, payload, timeout=70.0):
+        # timeout must exceed any server-side blocking wait for this command,
+        # else a late reply desynchronizes the length-prefixed stream
+        with self._locks[idx]:
+            sock = self._socks[idx]
+            sock.settimeout(timeout)
+            _send_msg(sock, cmd, payload)
+            status, reply = _recv_msg(sock)
+        if status != 0:
+            raise RuntimeError(f"PS server {self.endpoints[idx]}: {reply}")
+        return reply
+
+    def _home(self, name):
+        h = self._dense_home.get(name)
+        if h is None:
+            h = self._dense_home[name] = _dense_home(name, self.nservers)
+        return h
+
+    # -- dense --------------------------------------------------------------
+    def register_dense(self, name, init_value, opt_cfg=None, sync=True):
+        return self._call(self._home(name), _CMD_REGISTER_DENSE,
+                          (name, np.asarray(init_value, np.float32),
+                           opt_cfg or {"kind": "sgd", "lr": 0.01},
+                           self.trainers, sync))
+
+    def pull_dense(self, name, min_version=0):
+        return self._call(self._home(name), _CMD_PULL_DENSE, (name, min_version))
+
+    def push_dense(self, name, grad, lr=None):
+        return self._call(self._home(name), _CMD_PUSH_DENSE,
+                          (name, np.asarray(grad, np.float32), lr))
+
+    def set_dense(self, name, value):
+        return self._call(self._home(name), _CMD_SET_DENSE,
+                          (name, np.asarray(value, np.float32)))
+
+    # -- sparse -------------------------------------------------------------
+    def register_sparse(self, name, dim, opt_cfg=None, init_scale=0.01, seed=0,
+                        sync=False):
+        cfg = opt_cfg or {"kind": "adagrad", "lr": 0.05}
+        self._sparse_dims[name] = int(dim)
+        self._sparse_sync[name] = bool(sync)
+        for idx in range(self.nservers):
+            self._call(idx, _CMD_REGISTER_SPARSE,
+                       (name, dim, cfg, init_scale, seed, self.trainers, sync))
+
+    def pull_sparse(self, name, ids):
+        ids = np.asarray(ids, np.int64).ravel()
+        if ids.size == 0:
+            return np.zeros((0, self._sparse_dims.get(name, 0)), np.float32)
+        out = None
+        for idx in range(self.nservers):
+            mask = (ids % self.nservers) == idx
+            if not mask.any():
+                continue
+            rows = self._call(idx, _CMD_PULL_SPARSE, (name, ids[mask]))
+            if out is None:
+                out = np.empty((ids.size, rows.shape[1]), np.float32)
+            out[np.flatnonzero(mask)] = rows
+        return out
+
+    def push_sparse(self, name, ids, grads, lr=None):
+        ids = np.asarray(ids, np.int64).ravel()
+        dim = self._sparse_dims.get(name) or 0
+        grads = np.asarray(grads, np.float32).reshape(
+            ids.size, -1 if ids.size else dim)
+        sync = self._sparse_sync.get(name, False)
+        for idx in range(self.nservers):
+            mask = (ids % self.nservers) == idx
+            if mask.any() or sync:
+                # sync tables count one push per trainer per step on EVERY
+                # shard, so empty pushes must still be sent
+                self._call(idx, _CMD_PUSH_SPARSE,
+                           (name, ids[mask], grads[mask], lr))
+
+    # -- control ------------------------------------------------------------
+    def barrier(self, key="worker"):
+        self._call(0, _CMD_BARRIER, (key, self.trainers), timeout=125.0)
+
+    def save(self, dirname):
+        for idx in range(self.nservers):
+            self._call(idx, _CMD_SAVE, (dirname,))
+
+    def load(self, dirname):
+        for idx in range(self.nservers):
+            self._call(idx, _CMD_LOAD, (dirname,))
+
+    def stat(self):
+        return [self._call(i, _CMD_STAT, ()) for i in range(self.nservers)]
+
+    def stop_servers(self):
+        for idx in range(self.nservers):
+            try:
+                self._call(idx, _CMD_STOP, ())
+            except (RuntimeError, ConnectionError, OSError):
+                pass
+
+    def close(self):
+        for s in self._socks:
+            try:
+                s.close()
+            except OSError:
+                pass

@@ -1,0 +1,216 @@
+"""Server-side tables and optimizers for the parameter server.
+
+Reference analog: paddle/fluid/distributed/ps/table/ (memory_dense_table.cc,
+memory_sparse_table.cc, sparse accessors with server-side adagrad/adam) —
+rebuilt as numpy state machines: the server owns fp32 master copies and the
+optimizer state; trainers only ever see parameter values.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+
+class _ServerOptimizer:
+    """Server-side rule applied to a table's values. (ps/table accessors.)"""
+
+    def __init__(self, kind="sgd", lr=0.01, beta1=0.9, beta2=0.999,
+                 eps=1e-8):
+        self.kind = kind
+        self.lr = float(lr)
+        self.beta1, self.beta2, self.eps = float(beta1), float(beta2), float(eps)
+
+    def make_state(self, shape):
+        if self.kind == "sgd":
+            return {}
+        if self.kind == "adagrad":
+            return {"g2": np.zeros(shape, np.float32)}
+        if self.kind == "adam":
+            return {"m": np.zeros(shape, np.float32),
+                    "v": np.zeros(shape, np.float32), "t": 0}
+        if self.kind == "summer":  # geo-sgd delta accumulation: w += delta
+            return {}
+        raise ValueError(f"unknown server optimizer {self.kind!r}")
+
+    def apply(self, value, grad, state, lr=None):
+        # lr rides along with every push so trainer-side LR schedulers work
+        lr = self.lr if lr is None else float(lr)
+        if self.kind == "sgd":
+            value -= lr * grad
+        elif self.kind == "summer":
+            value += grad  # "grad" is a parameter delta in geo mode
+        elif self.kind == "adagrad":
+            state["g2"] += grad * grad
+            value -= lr * grad / (np.sqrt(state["g2"]) + self.eps)
+        elif self.kind == "adam":
+            state["t"] += 1
+            t = state["t"]
+            state["m"] = self.beta1 * state["m"] + (1 - self.beta1) * grad
+            state["v"] = self.beta2 * state["v"] + (1 - self.beta2) * grad * grad
+            mhat = state["m"] / (1 - self.beta1 ** t)
+            vhat = state["v"] / (1 - self.beta2 ** t)
+            value -= lr * mhat / (np.sqrt(vhat) + self.eps)
+        return value
+
+
+class DenseTable:
+    """One dense parameter: fp32 value + optimizer state + sync accumulation.
+
+    Sync protocol (exact synchronous SGD): each trainer pushes one grad per
+    step; the table accumulates; when `trainers` grads arrived it averages,
+    applies the optimizer, and bumps `version`. Pulls can block on a minimum
+    version so every trainer sees the post-step weights.
+    """
+
+    def __init__(self, name, init_value, optimizer: _ServerOptimizer,
+                 trainers=1, sync=True):
+        self.name = name
+        self.value = np.asarray(init_value, np.float32).copy()
+        self.opt = optimizer
+        self.state = optimizer.make_state(self.value.shape)
+        self.trainers = int(trainers)
+        self.sync = bool(sync)
+        self.version = 0
+        self._pending = None
+        self._pending_count = 0
+        self._cv = threading.Condition()
+
+    def push_grad(self, grad, lr=None):
+        grad = np.asarray(grad, np.float32)
+        with self._cv:
+            if not self.sync:
+                self.value = self.opt.apply(self.value, grad, self.state, lr)
+                self.version += 1
+                self._cv.notify_all()
+                return self.version
+            if self._pending is None:
+                self._pending = grad.copy()
+            else:
+                self._pending += grad
+            self._pending_count += 1
+            if self._pending_count >= self.trainers:
+                avg = self._pending / self._pending_count
+                self.value = self.opt.apply(self.value, avg, self.state, lr)
+                self._pending = None
+                self._pending_count = 0
+                self.version += 1
+                self._cv.notify_all()
+            return self.version
+
+    def set_value(self, value):
+        with self._cv:
+            self.value = np.asarray(value, np.float32).copy()
+            self.version += 1
+            self._cv.notify_all()
+
+    def pull(self, min_version=0, timeout=60.0):
+        with self._cv:
+            ok = self._cv.wait_for(lambda: self.version >= min_version, timeout)
+            if not ok:
+                raise TimeoutError(
+                    f"dense table {self.name!r}: version {min_version} not "
+                    f"reached (at {self.version}) within {timeout}s")
+            return self.value.copy(), self.version
+
+
+class SparseTable:
+    """id -> embedding row, lazily initialized, server-side optimizer.
+
+    Reference analog: memory_sparse_table.cc — rows materialize on first pull
+    (deterministic per-id uniform init so every server/trainer agrees), grads
+    are scatter-accumulated by id then applied row-wise.
+
+    Sync mode mirrors DenseTable: every trainer pushes exactly once per step
+    (possibly with zero ids); the merged per-id grads are averaged over the
+    trainer count and applied once — order-independent, same effective lr as
+    the dense path.
+    """
+
+    def __init__(self, name, dim, optimizer: _ServerOptimizer,
+                 init_scale=0.01, seed=0, trainers=1, sync=False):
+        self.name = name
+        self.dim = int(dim)
+        self.opt = optimizer
+        self.init_scale = float(init_scale)
+        self.seed = int(seed)
+        self.trainers = int(trainers)
+        self.sync = bool(sync)
+        self.rows = {}
+        self.states = {}
+        self._pending = {}
+        self._pending_count = 0
+        self._lock = threading.Lock()
+
+    def _init_row(self, i):
+        rng = np.random.default_rng((self.seed, int(i)))
+        return rng.uniform(-self.init_scale, self.init_scale,
+                           self.dim).astype(np.float32)
+
+    def pull(self, ids):
+        ids = np.asarray(ids, np.int64).ravel()
+        out = np.empty((ids.size, self.dim), np.float32)
+        with self._lock:
+            for k, i in enumerate(ids):
+                i = int(i)
+                row = self.rows.get(i)
+                if row is None:
+                    row = self._init_row(i)
+                    self.rows[i] = row
+                out[k] = row
+        return out
+
+    def push_grad(self, ids, grads, lr=None):
+        ids = np.asarray(ids, np.int64).ravel()
+        grads = np.asarray(grads, np.float32).reshape(ids.size, self.dim)
+        # dedupe: accumulate grads per unique id (rows repeated in a batch)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        acc = np.zeros((uniq.size, self.dim), np.float32)
+        np.add.at(acc, inv, grads)
+        with self._lock:
+            if not self.sync:
+                self._apply_locked(uniq, acc, lr, scale=1.0)
+                return
+            for k, i in enumerate(uniq):
+                i = int(i)
+                cur = self._pending.get(i)
+                self._pending[i] = acc[k] if cur is None else cur + acc[k]
+            self._pending_count += 1
+            if self._pending_count >= self.trainers:
+                if self._pending:
+                    pids = np.fromiter(self._pending.keys(), np.int64,
+                                       len(self._pending))
+                    pacc = np.stack([self._pending[int(i)] for i in pids])
+                    self._apply_locked(pids, pacc, lr,
+                                       scale=1.0 / self.trainers)
+                self._pending = {}
+                self._pending_count = 0
+
+    def _apply_locked(self, uniq, acc, lr, scale):
+        for k, i in enumerate(uniq):
+            i = int(i)
+            row = self.rows.get(i)
+            if row is None:
+                row = self._init_row(i)
+            st = self.states.get(i)
+            if st is None:
+                st = self.opt.make_state((self.dim,))
+                self.states[i] = st
+            self.rows[i] = self.opt.apply(row, acc[k] * scale, st, lr)
+
+    def n_rows(self):
+        with self._lock:
+            return len(self.rows)
+
+    def dump(self):
+        with self._lock:
+            if not self.rows:
+                return np.empty(0, np.int64), np.empty((0, self.dim), np.float32)
+            ids = np.fromiter(self.rows.keys(), np.int64, len(self.rows))
+            vals = np.stack([self.rows[int(i)] for i in ids])
+            return ids, vals
+
+    def load(self, ids, vals):
+        with self._lock:
+            for i, v in zip(np.asarray(ids, np.int64), vals):
+                self.rows[int(i)] = np.asarray(v, np.float32).copy()

@@ -1,0 +1,262 @@
+"""TheOnePS: wires the PS client/server into the fleet facade.
+
+Reference analog: python/paddle/distributed/ps/the_one_ps.py (builds the PS
+runtime from DistributedStrategy: server/worker launch, table construction,
+sync/async/geo modes) + fleet.init(is_collective=False) role flow.
+
+Trainer flow (dygraph-first instead of the reference's program rewriting):
+  fleet.init(is_collective=False)          # role from env (TRAINING_ROLE)
+  if fleet.is_server(): fleet.init_server(); fleet.run_server()   # blocks
+  else:
+      fleet.init_worker()                  # connect PSClient
+      opt = fleet.distributed_optimizer(opt, strategy)  -> PSOptimizer
+      ... loss.backward(); opt.step()      # push grads / pull params
+      fleet.stop_worker()
+
+Modes (strategy.a_sync / a_sync_configs):
+  sync  (a_sync=False): server averages grads from all trainers, applies
+        once, version-gated pulls — exact synchronous SGD.
+  async (a_sync=True):  server applies each push immediately.
+  geo   (a_sync=True, a_sync_configs={"k_steps": k}): trainers step locally
+        with their own optimizer and every k steps push parameter deltas
+        (server table optimizer "summer" sums them) and re-pull.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ...nn.layer.layers import Layer
+from .service import PSClient, PSServer
+
+
+class TheOnePS:
+    """Process-global PS runtime state (client, role, mode)."""
+
+    def __init__(self):
+        self.client = None
+        self.server = None
+        self.role = None
+
+    def init_worker(self, role):
+        self.role = role
+        self.client = PSClient(
+            role.get_pserver_endpoints(),
+            trainer_id=role.worker_index(),
+            trainers=role.worker_num(),
+        )
+        return self.client
+
+    def init_server(self, role, model_dir=None):
+        self.role = role
+        self.server = PSServer(role.get_current_endpoint(),
+                               warm_dir=model_dir)
+        return self.server
+
+    def run_server(self):
+        self.server.run()
+
+
+_RUNTIME = TheOnePS()
+
+
+def runtime():
+    return _RUNTIME
+
+
+def _mode_from_strategy(strategy):
+    a_sync = bool(getattr(strategy, "a_sync", False))
+    cfgs = dict(getattr(strategy, "a_sync_configs", None) or {})
+    k = int(cfgs.get("k_steps", -1))
+    if a_sync and k > 0:
+        return "geo", k
+    return ("async", 0) if a_sync else ("sync", 0)
+
+
+class PSOptimizer:
+    """Trainer-side optimizer for PS mode (the reference's fleet
+    distributed_optimizer when is_collective=False).
+
+    Dense parameters are registered as server tables on first step (server
+    keeps the optimizer state; the inner optimizer's hyperparameters map to a
+    server-side rule). DistributedEmbedding layers flush their sparse pushes
+    here.
+    """
+
+    def __init__(self, inner, strategy, client: PSClient):
+        self._inner = inner
+        self._client = client
+        self.mode, self.k_steps = _mode_from_strategy(strategy)
+        self._registered = False
+        self._step_count = 0
+        self._versions = {}
+        self._geo_anchors = {}
+        self._embeddings = []
+
+    # fleet.distributed_model registers embeddings it finds; manual also ok
+    def _attach_embeddings(self, model):
+        for layer in model.sublayers(include_self=True):
+            if isinstance(layer, DistributedEmbedding):
+                layer._bind(self._client, sync=self.mode == "sync")
+                self._embeddings.append(layer)
+
+    def _opt_cfg(self):
+        name = type(self._inner).__name__.lower()
+        lr = float(self._inner.get_lr())
+        if self.mode == "geo":
+            return {"kind": "summer"}
+        if "adam" in name:
+            return {"kind": "adam", "lr": lr}
+        if "adagrad" in name:
+            return {"kind": "adagrad", "lr": lr}
+        return {"kind": "sgd", "lr": lr}
+
+    def _named_params(self):
+        for i, p in enumerate(self._inner._parameter_list_flat()):
+            name = getattr(p, "name", None) or f"param_{i}"
+            yield name, p
+
+    def _register(self):
+        sync = self.mode == "sync"
+        cfg = self._opt_cfg()
+        for name, p in self._named_params():
+            self._client.register_dense(name, np.asarray(p.numpy(), np.float32),
+                                        opt_cfg=cfg, sync=sync)
+            # every trainer starts from the server's copy (rank-0 init wins)
+            val, ver = self._client.pull_dense(name, 0)
+            p._replace_value(jnp.asarray(val, p.value.dtype))
+            self._versions[name] = ver
+            if self.mode == "geo":
+                self._geo_anchors[name] = val.copy()
+        self._registered = True
+
+    def step(self):
+        if not self._registered:
+            self._register()
+        self._step_count += 1
+        lr = float(self._inner.get_lr())  # live: LR schedulers reach the server
+        for emb in self._embeddings:
+            emb._flush(self.mode, lr)
+        if self.mode == "geo":
+            self._inner.step()
+            if self._step_count % self.k_steps == 0:
+                for name, p in self._named_params():
+                    cur = np.asarray(p.numpy(), np.float32)
+                    delta = cur - self._geo_anchors[name]
+                    self._client.push_dense(name, delta)
+                    val, ver = self._client.pull_dense(
+                        name, self._versions[name] + 1)
+                    self._versions[name] = ver
+                    p._replace_value(jnp.asarray(val, p.value.dtype))
+                    self._geo_anchors[name] = val.copy()
+            return
+        pushed = []
+        for name, p in self._named_params():
+            g = p.grad
+            if g is None and self.mode != "sync":
+                continue
+            # sync tables count one push per trainer per step: a trainer whose
+            # batch left this param untouched must still contribute (zeros)
+            grad_np = (np.zeros(tuple(p.shape), np.float32) if g is None
+                       else np.asarray(g.numpy(), np.float32))
+            self._client.push_dense(name, grad_np, lr=lr)
+            pushed.append((name, p))
+        for name, p in pushed:
+            val, ver = self._client.pull_dense(name, self._versions[name] + 1)
+            self._versions[name] = ver
+            p._replace_value(jnp.asarray(val, p.value.dtype))
+
+    def clear_grad(self, set_to_zero=True):
+        self._inner.clear_grad(set_to_zero)
+
+    def get_lr(self):
+        return self._inner.get_lr()
+
+    def state_dict(self):
+        return self._inner.state_dict()
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+class DistributedEmbedding(Layer):
+    """Sparse embedding backed by a server-side SparseTable.
+
+    Reference analog: paddle.static.nn.sparse_embedding /
+    DistributedLookupTable — the embedding never materializes on the trainer;
+    rows for the batch's ids are pulled, gradients for them are pushed back
+    on optimizer step (accumulated + deduped server-side).
+    """
+
+    _COUNT = 0
+
+    def __init__(self, num_embeddings, embedding_dim, name=None,
+                 init_scale=0.01, optimizer_cfg=None):
+        super().__init__()
+        if name is None:
+            name = f"dist_embedding_{DistributedEmbedding._COUNT}"
+            DistributedEmbedding._COUNT += 1
+        self.table_name = name
+        self.num_embeddings = int(num_embeddings)
+        self.embedding_dim = int(embedding_dim)
+        self.init_scale = float(init_scale)
+        self.optimizer_cfg = optimizer_cfg
+        self._client = None
+        self._pending = []  # (ids, rows_tensor) awaiting grad flush
+
+    def _bind(self, client: PSClient, sync=False):
+        if self._client is None:
+            self._client = client
+            client.register_sparse(self.table_name, self.embedding_dim,
+                                   opt_cfg=self.optimizer_cfg,
+                                   init_scale=self.init_scale, sync=sync)
+
+    def forward(self, ids):
+        if self._client is None:
+            raise RuntimeError(
+                "DistributedEmbedding used before fleet.init_worker() + "
+                "fleet.distributed_optimizer() bound a PS client")
+        from ...autograd import tape
+
+        ids_np = np.asarray(ids.numpy() if isinstance(ids, Tensor) else ids,
+                            np.int64)
+        flat = ids_np.ravel()
+        out_shape = tuple(ids_np.shape) + (self.embedding_dim,)
+        if flat.size == 0:
+            return Tensor(jnp.zeros(out_shape, jnp.float32))
+        rows_np = self._client.pull_sparse(self.table_name, flat)
+        training = tape.is_grad_enabled() and self.training
+        rows = Tensor(jnp.asarray(rows_np), stop_gradient=not training)
+        if training:  # eval/no_grad forwards must not accumulate pendings
+            self._pending.append((flat, rows))
+        return rows.reshape(out_shape) if hasattr(rows, "reshape") else rows
+
+    def _flush(self, mode, lr=None):
+        if mode == "sync":
+            # sync tables count exactly one push per trainer per step (even
+            # with no grads this step) — merge all pending forwards into one
+            ids_list, grad_list = [], []
+            for flat, rows in self._pending:
+                g = rows.grad
+                if g is not None:
+                    ids_list.append(flat)
+                    grad_list.append(np.asarray(g.numpy(), np.float32)
+                                     .reshape(flat.size, -1))
+            ids = (np.concatenate(ids_list) if ids_list
+                   else np.zeros(0, np.int64))
+            grads = (np.concatenate(grad_list) if grad_list
+                     else np.zeros((0, self.embedding_dim), np.float32))
+            self._client.push_sparse(self.table_name, ids, grads, lr=lr)
+        else:
+            for flat, rows in self._pending:
+                g = rows.grad
+                if g is not None:
+                    self._client.push_sparse(
+                        self.table_name, flat,
+                        np.asarray(g.numpy(), np.float32)
+                        .reshape(flat.size, -1), lr=lr)
+        self._pending.clear()
