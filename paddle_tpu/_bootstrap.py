@@ -24,12 +24,12 @@ def early_init_distributed():
     """Idempotent; no-op unless the launcher env marks a multi-process run."""
     if _DONE[0]:
         return
-    if (os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST")
-            and os.environ.get("TRAINING_ROLE") in ("PSERVER", "TRAINER")):
+    if os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST"):
         # parameter-server mode: processes talk through the PS service
         # (distributed/ps), not through a collective jax.distributed world.
-        # NOT latched (_DONE stays False): a later explicit collective
-        # bootstrap in the same process still works.
+        # Matches role_maker's PS contract, where a missing TRAINING_ROLE
+        # defaults to TRAINER. NOT latched (_DONE stays False): a later
+        # explicit collective bootstrap in the same process still works.
         return
     world = _world_size_from_env()
     if world <= 1:

@@ -37,6 +37,7 @@ class _FleetState:
         self.role_maker = None
         self.hcg = None
         self.ps_mode = False
+        self.ps_model = None
 
 
 _STATE = _FleetState()
@@ -52,10 +53,12 @@ def init(role_maker=None, is_collective=False, strategy=None, log_level="INFO"):
     if not collective and pserver_eps:
         # parameter-server mode: no device mesh; the PS runtime owns comms
         _STATE.ps_mode = True
+        _STATE.ps_model = None  # a fresh init never inherits a prior job's model
         _STATE.hcg = None
         _STATE.initialized = True
         return None
     _STATE.ps_mode = False
+    _STATE.ps_model = None
     parallel_mod.init_parallel_env()
 
     hybrid = _STATE.strategy.hybrid_configs
@@ -120,10 +123,9 @@ def worker_endpoints(to_string=False):
 
 def barrier_worker():
     if _STATE.ps_mode:
-        from ..ps.the_one_ps import runtime
-
-        if runtime().client is not None:
-            runtime().client.barrier("worker")
+        # always participate — a silent no-op here would unpair barriers
+        # across trainers that initialized their clients at different times
+        init_worker().barrier("worker")
         return
     from .. import collective
 
@@ -259,3 +261,4 @@ def stop_worker():
         client.stop_servers()
     client.close()
     runtime().client = None
+    _STATE.ps_model = None

@@ -13,6 +13,7 @@ server `hash(name) % nservers`; sparse rows are sharded `id % nservers`.
 from __future__ import annotations
 
 import hashlib
+import io
 import os
 import pickle
 import socket
@@ -22,6 +23,32 @@ import threading
 import numpy as np
 
 from .tables import DenseTable, SparseTable, _ServerOptimizer
+
+# the wire carries only primitives, dicts/tuples/lists, and numpy arrays —
+# unpickling anything else (i.e. classes with a __reduce__ payload) is
+# refused, so a hostile peer cannot turn deserialization into code execution
+_SAFE_GLOBALS = {
+    ("numpy", "ndarray"),
+    ("numpy", "dtype"),
+    ("numpy.core.multiarray", "_reconstruct"),
+    ("numpy.core.multiarray", "scalar"),
+    ("numpy._core.multiarray", "_reconstruct"),  # numpy 2.x module path
+    ("numpy._core.multiarray", "scalar"),
+    ("numpy.core.numeric", "_frombuffer"),  # protocol-5 array payloads
+    ("numpy._core.numeric", "_frombuffer"),
+}
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    def find_class(self, module, name):
+        if (module, name) in _SAFE_GLOBALS:
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"PS wire refuses to unpickle {module}.{name}")
+
+
+def _safe_loads(data: bytes):
+    return _RestrictedUnpickler(io.BytesIO(data)).load()
 
 _CMD_REGISTER_DENSE = 0
 _CMD_PULL_DENSE = 1
@@ -54,7 +81,7 @@ def _recv_exact(sock, n):
 
 def _recv_msg(sock):
     (n,) = struct.unpack("<I", _recv_exact(sock, 4))
-    return pickle.loads(_recv_exact(sock, n))
+    return _safe_loads(_recv_exact(sock, n))
 
 
 def _dense_home(name, nservers):
@@ -120,7 +147,15 @@ class PSServer:
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         try:
             while not self._stop.is_set():
-                cmd, payload = _recv_msg(conn)
+                (n,) = struct.unpack("<I", _recv_exact(conn, 4))
+                raw = _recv_exact(conn, n)
+                try:
+                    # framing is intact even when the payload is refused, so
+                    # a decode error is answered, not fatal to the connection
+                    cmd, payload = _safe_loads(raw)
+                except Exception as e:
+                    _send_msg(conn, 1, f"{type(e).__name__}: {e}")
+                    continue
                 try:
                     reply = self._dispatch(cmd, payload)
                     _send_msg(conn, 0, reply)
@@ -377,12 +412,14 @@ class PSClient:
         self._call(0, _CMD_BARRIER, (key, self.trainers), timeout=125.0)
 
     def save(self, dirname):
+        # unbounded server-side work (stacks + writes every table): a short
+        # timeout here would desynchronize the stream on a slow disk
         for idx in range(self.nservers):
-            self._call(idx, _CMD_SAVE, (dirname,))
+            self._call(idx, _CMD_SAVE, (dirname,), timeout=600.0)
 
     def load(self, dirname):
         for idx in range(self.nservers):
-            self._call(idx, _CMD_LOAD, (dirname,))
+            self._call(idx, _CMD_LOAD, (dirname,), timeout=600.0)
 
     def stat(self):
         return [self._call(i, _CMD_STAT, ()) for i in range(self.nservers)]

@@ -16,10 +16,11 @@ class _ServerOptimizer:
     """Server-side rule applied to a table's values. (ps/table accessors.)"""
 
     def __init__(self, kind="sgd", lr=0.01, beta1=0.9, beta2=0.999,
-                 eps=1e-8):
+                 eps=1e-8, weight_decay=0.0):
         self.kind = kind
         self.lr = float(lr)
         self.beta1, self.beta2, self.eps = float(beta1), float(beta2), float(eps)
+        self.weight_decay = float(weight_decay)  # decoupled (AdamW-style)
 
     def make_state(self, shape):
         if self.kind == "sgd":
@@ -36,6 +37,8 @@ class _ServerOptimizer:
     def apply(self, value, grad, state, lr=None):
         # lr rides along with every push so trainer-side LR schedulers work
         lr = self.lr if lr is None else float(lr)
+        if self.weight_decay:
+            value *= 1.0 - lr * self.weight_decay
         if self.kind == "sgd":
             value -= lr * grad
         elif self.kind == "summer":

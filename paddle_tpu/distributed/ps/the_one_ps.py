@@ -103,14 +103,42 @@ class PSOptimizer:
                 self._embeddings.append(layer)
 
     def _opt_cfg(self):
-        name = type(self._inner).__name__.lower()
-        lr = float(self._inner.get_lr())
+        """Map the trainer optimizer onto a server-side rule, carrying the
+        hyperparameters the server rule supports; warn on what it can't."""
+        import warnings
+
+        inner = self._inner
+        name = type(inner).__name__.lower()
+        lr = float(inner.get_lr())
         if self.mode == "geo":
-            return {"kind": "summer"}
-        if "adam" in name:
-            return {"kind": "adam", "lr": lr}
+            return {"kind": "summer"}  # trainer's own optimizer does the math
+        if getattr(inner, "_grad_clip", None) is not None:
+            warnings.warn(
+                "PS mode: grad_clip is applied by the server-side optimizer "
+                "rule, which does not implement clipping; the configured "
+                "grad_clip is ignored", stacklevel=3)
+        if "adam" in name:  # Adam / AdamW share the moment math
+            wd = float(getattr(inner, "_weight_decay", 0.0) or 0.0)
+            decoupled = getattr(inner, "_coupled_decay", True) is False
+            if wd and not decoupled:
+                warnings.warn(
+                    "PS mode: coupled L2 decay on Adam is not implemented "
+                    "server-side; applying it decoupled (AdamW-style)",
+                    stacklevel=3)
+            return {
+                "kind": "adam", "lr": lr,
+                "beta1": float(getattr(inner, "_beta1", 0.9)),
+                "beta2": float(getattr(inner, "_beta2", 0.999)),
+                "eps": float(getattr(inner, "_eps", 1e-8)),
+                "weight_decay": wd,
+            }
         if "adagrad" in name:
-            return {"kind": "adagrad", "lr": lr}
+            return {"kind": "adagrad", "lr": lr,
+                    "eps": float(getattr(inner, "_eps", 1e-8))}
+        if name not in ("sgd", "momentum"):
+            warnings.warn(
+                f"PS mode: no server-side rule for {type(inner).__name__}; "
+                "falling back to plain SGD on the server", stacklevel=3)
         return {"kind": "sgd", "lr": lr}
 
     def _named_params(self):
@@ -209,8 +237,9 @@ class DistributedEmbedding(Layer):
         self._pending = []  # (ids, rows_tensor) awaiting grad flush
 
     def _bind(self, client: PSClient, sync=False):
-        if self._client is None:
+        if self._client is not client:  # rebind after stop_worker/new job
             self._client = client
+            self._pending.clear()
             client.register_sparse(self.table_name, self.embedding_dim,
                                    opt_cfg=self.optimizer_cfg,
                                    init_scale=self.init_scale, sync=sync)

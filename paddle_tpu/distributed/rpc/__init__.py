@@ -1,0 +1,24 @@
+"""paddle.distributed.rpc: simple cross-process RPC.
+
+Reference analog: python/paddle/distributed/rpc/rpc.py (init_rpc :85,
+rpc_sync :160, rpc_async :206, shutdown :305, get_worker_info :336) over a
+brpc C++ agent. TPU-first note: RPC is host-side control-plane traffic — it
+never touches the accelerator — so the agent is a Python TCP server with the
+same length-prefixed pickle framing as the PS service and TCPStore rendezvous
+for worker-info exchange (stdlib-only, no brpc).
+"""
+from .rpc import (
+    WorkerInfo,
+    get_all_worker_infos,
+    get_current_worker_info,
+    get_worker_info,
+    init_rpc,
+    rpc_async,
+    rpc_sync,
+    shutdown,
+)
+
+__all__ = [
+    "WorkerInfo", "init_rpc", "rpc_sync", "rpc_async", "shutdown",
+    "get_worker_info", "get_all_worker_infos", "get_current_worker_info",
+]

@@ -1,0 +1,245 @@
+"""RPC agent: named workers invoke Python functions on each other.
+
+Reference analog: python/paddle/distributed/rpc/rpc.py — init_rpc exchanges
+WorkerInfo(name, rank, ip, port) through a master TCPStore, rpc_sync/rpc_async
+ship a pickled (fn, args, kwargs) to the target worker's agent and return the
+(pickled) result; shutdown barriers all workers then stops the agents.
+
+The agent executes each request on its own thread, so concurrent in-flight
+RPCs (including re-entrant worker->worker calls) don't serialize.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+from collections import namedtuple
+from concurrent.futures import Future
+
+from ..store import TCPStore
+
+WorkerInfo = namedtuple("WorkerInfo", ["name", "rank", "ip", "port"])
+
+_DEFAULT_RPC_TIMEOUT = -1
+
+
+class _AgentState:
+    def __init__(self):
+        self.self_info = None
+        self.workers = {}  # name -> WorkerInfo
+        self.server = None
+        self.store = None
+        self.barrier_count = 0
+
+
+_STATE = _AgentState()
+
+
+def _send_frame(sock, payload: bytes):
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock):
+    (n,) = struct.unpack("<Q", _recv_exact(sock, 8))
+    return _recv_exact(sock, n)
+
+
+class _RpcServer(threading.Thread):
+    """Accept loop; one executor thread per request connection."""
+
+    def __init__(self, host):
+        super().__init__(daemon=True)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, 0))
+        self._sock.listen(128)
+        self._sock.settimeout(0.2)
+        self.host, self.port = self._sock.getsockname()[:2]
+        self._stop = threading.Event()
+        self._stopped = threading.Event()
+
+    def run(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+        self._sock.close()
+        self._stopped.set()
+
+    def _serve(self, conn):
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while not self._stop.is_set():
+                req = _recv_frame(conn)
+                try:
+                    fn, args, kwargs = pickle.loads(req)
+                    result = fn(*args, **kwargs)
+                    reply = pickle.dumps((0, result),
+                                         protocol=pickle.HIGHEST_PROTOCOL)
+                except Exception as e:  # ship the exception back to the caller
+                    try:
+                        reply = pickle.dumps((1, e))
+                    except Exception:
+                        reply = pickle.dumps(
+                            (1, RuntimeError(f"{type(e).__name__}: {e}")))
+                _send_frame(conn, reply)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def shutdown(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._stopped.wait(timeout=2.0)
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Start this worker's agent and exchange worker infos (rpc.py:85).
+
+    Env fallbacks mirror the reference: PADDLE_WORKER_ENDPOINT for the agent
+    bind address, PADDLE_MASTER for the rendezvous store, PADDLE_TRAINER_ID /
+    PADDLE_TRAINERS_NUM for rank / world_size.
+    """
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0")) if rank is None else rank
+    world_size = (int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+                  if world_size is None else world_size)
+    master_endpoint = master_endpoint or os.environ.get("PADDLE_MASTER")
+    server = _RpcServer(os.environ.get("PADDLE_WORKER_HOST", "127.0.0.1"))
+    server.start()
+    info = WorkerInfo(name, rank, server.host, server.port)
+
+    if world_size > 1:
+        host, port = master_endpoint.rsplit(":", 1)
+        store = TCPStore(host, int(port), is_master=(rank == 0),
+                         world_size=world_size, timeout=120)
+        store.set(f"rpc/worker/{rank}",
+                  pickle.dumps(tuple(info), protocol=pickle.HIGHEST_PROTOCOL))
+        workers = {}
+        for r in range(world_size):
+            w = WorkerInfo(*pickle.loads(store.get(f"rpc/worker/{r}",
+                                                   timeout=120)))
+            workers[w.name] = w
+        _STATE.store = store
+    else:
+        workers = {name: info}
+    _STATE.self_info = info
+    _STATE.workers = workers
+    _STATE.server = server
+    _barrier("init")
+
+
+class _Connection:
+    """One pooled connection per target worker (thread-safe)."""
+
+    def __init__(self, info):
+        self.sock = socket.create_connection((info.ip, info.port), timeout=120)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.lock = threading.Lock()
+
+
+_CONNS = {}
+_CONNS_LOCK = threading.Lock()
+
+
+def _connection(to):
+    with _CONNS_LOCK:
+        conn = _CONNS.get(to)
+        if conn is None:
+            info = get_worker_info(to)
+            conn = _CONNS[to] = _Connection(info)
+        return conn
+
+
+def _invoke(to, fn, args, kwargs, timeout):
+    payload = pickle.dumps((fn, tuple(args or ()), dict(kwargs or {})),
+                           protocol=pickle.HIGHEST_PROTOCOL)
+    conn = _connection(to)
+    with conn.lock:
+        conn.sock.settimeout(None if timeout in (None, _DEFAULT_RPC_TIMEOUT)
+                             else float(timeout))
+        _send_frame(conn.sock, payload)
+        status, result = pickle.loads(_recv_frame(conn.sock))
+    if status != 0:
+        raise result
+    return result
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=_DEFAULT_RPC_TIMEOUT):
+    """Run fn on worker `to`; block for the result (rpc.py:160)."""
+    return _invoke(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=_DEFAULT_RPC_TIMEOUT):
+    """Run fn on worker `to`; return a future with .wait() (rpc.py:206)."""
+    fut = Future()
+
+    def runner():
+        try:
+            fut.set_result(_invoke(to, fn, args, kwargs, timeout))
+        except BaseException as e:
+            fut.set_exception(e)
+
+    threading.Thread(target=runner, daemon=True).start()
+    fut.wait = fut.result  # reference future API
+    return fut
+
+
+def _barrier(tag):
+    if _STATE.store is not None:
+        _STATE.barrier_count += 1
+        _STATE.store.barrier(f"rpc/barrier/{tag}/{_STATE.barrier_count}",
+                             timeout=120)
+
+
+def shutdown():
+    """Barrier all workers, then stop the agent (rpc.py:305)."""
+    if _STATE.server is None:
+        return
+    _barrier("shutdown")
+    with _CONNS_LOCK:
+        for conn in _CONNS.values():
+            try:
+                conn.sock.close()
+            except OSError:
+                pass
+        _CONNS.clear()
+    _STATE.server.shutdown()
+    if _STATE.store is not None:
+        _STATE.store.shutdown()
+    _STATE.__init__()
+
+
+def get_worker_info(name):
+    """WorkerInfo by name (rpc.py:336)."""
+    return _STATE.workers[name]
+
+
+def get_all_worker_infos():
+    """All workers sorted by rank (rpc.py:366)."""
+    return sorted(_STATE.workers.values(), key=lambda w: w.rank)
+
+
+def get_current_worker_info():
+    """This worker's info (rpc.py:393)."""
+    return _STATE.self_info

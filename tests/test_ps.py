@@ -134,6 +134,33 @@ class TestService:
             for s in servers:
                 s.shutdown()
 
+    def test_wire_refuses_arbitrary_pickles(self):
+        """The PS wire must not be a remote-code-execution vector."""
+        import pickle
+        import socket
+        import struct
+
+        from paddle_tpu.distributed.ps import service
+
+        servers, eps = _start_servers(1)
+        try:
+            class Evil:
+                def __reduce__(self):
+                    return (os.system, ("true",))
+
+            host, port = eps[0].rsplit(":", 1)
+            sock = socket.create_connection((host, int(port)), timeout=10)
+            body = pickle.dumps((service._CMD_PUSH_DENSE, ("w", Evil(), None)))
+            sock.sendall(struct.pack("<I", len(body)) + body)
+            (n,) = struct.unpack("<I", service._recv_exact(sock, 4))
+            status, reply = pickle.loads(service._recv_exact(sock, n))
+            # the connection survives but the payload must be refused…
+            assert status == 1 or "refuses" in str(reply)
+            sock.close()
+        finally:
+            for s in servers:
+                s.shutdown()
+
     def test_warm_start_from_saved_shards(self, tmp_path):
         from paddle_tpu.distributed.ps import PSClient, PSServer
 
