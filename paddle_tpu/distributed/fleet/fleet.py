@@ -123,8 +123,12 @@ def worker_endpoints(to_string=False):
 
 def barrier_worker():
     if _STATE.ps_mode:
-        # always participate — a silent no-op here would unpair barriers
-        # across trainers that initialized their clients at different times
+        from ..ps.the_one_ps import runtime
+
+        if runtime().stopped:
+            return  # post-stop_worker teardown: servers are gone
+        # otherwise always participate — a silent no-op here would unpair
+        # barriers across trainers that initialize at different times
         init_worker().barrier("worker")
         return
     from .. import collective
@@ -261,4 +265,5 @@ def stop_worker():
         client.stop_servers()
     client.close()
     runtime().client = None
+    runtime().stopped = True
     _STATE.ps_model = None

@@ -39,9 +39,11 @@ class TheOnePS:
         self.client = None
         self.server = None
         self.role = None
+        self.stopped = False  # set by fleet.stop_worker: servers are gone
 
     def init_worker(self, role):
         self.role = role
+        self.stopped = False
         self.client = PSClient(
             role.get_pserver_endpoints(),
             trainer_id=role.worker_index(),
@@ -117,10 +119,15 @@ class PSOptimizer:
                 "PS mode: grad_clip is applied by the server-side optimizer "
                 "rule, which does not implement clipping; the configured "
                 "grad_clip is ignored", stacklevel=3)
+        wd = float(getattr(inner, "_weight_decay", 0.0) or 0.0)
+        coupled = getattr(inner, "_coupled_decay", True)
+        if wd and coupled == "l1":
+            warnings.warn(
+                "PS mode: L1 decay is not implemented server-side; "
+                "the regularizer is ignored", stacklevel=3)
+            wd = 0.0
         if "adam" in name:  # Adam / AdamW share the moment math
-            wd = float(getattr(inner, "_weight_decay", 0.0) or 0.0)
-            decoupled = getattr(inner, "_coupled_decay", True) is False
-            if wd and not decoupled:
+            if wd and coupled is True:
                 warnings.warn(
                     "PS mode: coupled L2 decay on Adam is not implemented "
                     "server-side; applying it decoupled (AdamW-style)",
@@ -134,12 +141,14 @@ class PSOptimizer:
             }
         if "adagrad" in name:
             return {"kind": "adagrad", "lr": lr,
-                    "eps": float(getattr(inner, "_eps", 1e-8))}
-        if name not in ("sgd", "momentum"):
+                    "eps": float(getattr(inner, "_eps", 1e-8)),
+                    "weight_decay": wd}
+        if name != "sgd":
             warnings.warn(
                 f"PS mode: no server-side rule for {type(inner).__name__}; "
                 "falling back to plain SGD on the server", stacklevel=3)
-        return {"kind": "sgd", "lr": lr}
+        # decoupled lr*wd*value decay == coupled L2 for plain SGD
+        return {"kind": "sgd", "lr": lr, "weight_decay": wd}
 
     def _named_params(self):
         for i, p in enumerate(self._inner._parameter_list_flat()):

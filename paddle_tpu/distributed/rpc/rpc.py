@@ -117,9 +117,10 @@ class _RpcServer(threading.Thread):
 def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     """Start this worker's agent and exchange worker infos (rpc.py:85).
 
-    Env fallbacks mirror the reference: PADDLE_WORKER_ENDPOINT for the agent
-    bind address, PADDLE_MASTER for the rendezvous store, PADDLE_TRAINER_ID /
-    PADDLE_TRAINERS_NUM for rank / world_size.
+    Env fallbacks mirror the reference: PADDLE_WORKER_HOST for the agent bind
+    address (the host advertised to peers; default 127.0.0.1 — set it to the
+    routable interface on multi-host runs), PADDLE_MASTER for the rendezvous
+    store, PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM for rank / world_size.
     """
     rank = int(os.environ.get("PADDLE_TRAINER_ID", "0")) if rank is None else rank
     world_size = (int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
@@ -150,12 +151,27 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
 
 
 class _Connection:
-    """One pooled connection per target worker (thread-safe)."""
+    """Pooled connection to one target worker; dialed lazily under its own
+    lock (a slow peer must not block RPC to healthy peers)."""
 
     def __init__(self, info):
-        self.sock = socket.create_connection((info.ip, info.port), timeout=120)
-        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.info = info
+        self.sock = None
         self.lock = threading.Lock()
+
+    def ensure(self):
+        if self.sock is None:
+            self.sock = socket.create_connection(
+                (self.info.ip, self.info.port), timeout=120)
+            self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def reset(self):
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            self.sock = None
 
 
 _CONNS = {}
@@ -163,11 +179,10 @@ _CONNS_LOCK = threading.Lock()
 
 
 def _connection(to):
-    with _CONNS_LOCK:
+    with _CONNS_LOCK:  # dict access only — dialing happens under conn.lock
         conn = _CONNS.get(to)
         if conn is None:
-            info = get_worker_info(to)
-            conn = _CONNS[to] = _Connection(info)
+            conn = _CONNS[to] = _Connection(get_worker_info(to))
         return conn
 
 
@@ -176,10 +191,18 @@ def _invoke(to, fn, args, kwargs, timeout):
                            protocol=pickle.HIGHEST_PROTOCOL)
     conn = _connection(to)
     with conn.lock:
-        conn.sock.settimeout(None if timeout in (None, _DEFAULT_RPC_TIMEOUT)
-                             else float(timeout))
-        _send_frame(conn.sock, payload)
-        status, result = pickle.loads(_recv_frame(conn.sock))
+        try:
+            conn.ensure()
+            conn.sock.settimeout(
+                None if timeout in (None, _DEFAULT_RPC_TIMEOUT)
+                else float(timeout))
+            _send_frame(conn.sock, payload)
+            status, result = pickle.loads(_recv_frame(conn.sock))
+        except (OSError, ConnectionError):
+            # a timed-out/broken stream may still carry the late reply —
+            # drop the connection so the next call starts clean
+            conn.reset()
+            raise
     if status != 0:
         raise result
     return result
@@ -219,10 +242,7 @@ def shutdown():
     _barrier("shutdown")
     with _CONNS_LOCK:
         for conn in _CONNS.values():
-            try:
-                conn.sock.close()
-            except OSError:
-                pass
+            conn.reset()
         _CONNS.clear()
     _STATE.server.shutdown()
     if _STATE.store is not None:

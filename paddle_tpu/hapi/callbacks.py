@@ -199,6 +199,98 @@ class LRScheduler(Callback):
             s.step()
 
 
+class VisualDL(Callback):
+    """Scalar logging callback (reference hapi/callbacks.py VisualDL).
+
+    The reference writes VisualDL event files; here scalars land in an
+    append-only `scalars.jsonl` under log_dir (one JSON object per record:
+    tag, step, value) — grep/pandas-friendly and dependency-free. If the
+    `visualdl` package happens to be importable, it is used instead.
+    """
+
+    def __init__(self, log_dir):
+        super().__init__()
+        self.log_dir = log_dir
+        self._writer = None
+        self._file = None
+        self._step = 0
+        self.epoch = 0
+
+    def _ensure(self):
+        import os
+
+        if self._writer is None and self._file is None:
+            os.makedirs(self.log_dir, exist_ok=True)
+            try:
+                from visualdl import LogWriter  # optional
+
+                self._writer = LogWriter(logdir=self.log_dir)
+            except ImportError:
+                self._file = open(
+                    os.path.join(self.log_dir, "scalars.jsonl"), "a")
+
+    def _add_scalar(self, tag, value, step):
+        import json
+
+        self._ensure()
+        if self._writer is not None:
+            self._writer.add_scalar(tag=tag, value=float(value), step=step)
+        else:
+            self._file.write(json.dumps(
+                {"tag": tag, "step": int(step), "value": float(value)}) + "\n")
+            self._file.flush()
+
+    def _log(self, prefix, logs, step):
+        for k, v in (logs or {}).items():
+            try:
+                self._add_scalar(f"{prefix}/{k}", float(np.mean(v)), step)
+            except (TypeError, ValueError):
+                continue  # non-scalar entries (e.g. batch_size lists) skipped
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        self._log("train", logs, self._step)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self.epoch = epoch
+        self._log("train_epoch", logs, epoch)
+
+    def on_eval_end(self, logs=None):
+        self._log("eval", logs, self.epoch)
+
+    def on_train_end(self, logs=None):
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+        if self._writer is not None:
+            self._writer.close()  # flush buffered VisualDL events
+            self._writer = None
+
+
+class WandbCallback(Callback):
+    """Weights & Biases hook (reference hapi/callbacks.py WandbCallback);
+    requires the `wandb` package — constructing without it raises."""
+
+    def __init__(self, project=None, run_name=None, **kwargs):
+        super().__init__()
+        try:
+            import wandb
+        except ImportError as e:
+            raise ImportError(
+                "WandbCallback requires the wandb package") from e
+        self._wandb = wandb
+        self._run = wandb.init(project=project, name=run_name, **kwargs)
+
+    def on_train_batch_end(self, step, logs=None):
+        self._run.log({f"train/{k}": v for k, v in (logs or {}).items()})
+
+    def on_eval_end(self, logs=None):
+        self._run.log({f"eval/{k}": v for k, v in (logs or {}).items()})
+
+    def on_train_end(self, logs=None):
+        self._run.finish()
+
+
 def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,
                      log_freq=2, verbose=2, save_freq=1, save_dir=None,
                      metrics=None, mode="train"):

@@ -1,0 +1,188 @@
+"""paddle.inference: the deploy-engine API veneer.
+
+Reference analog: paddle/fluid/inference/api/ AnalysisPredictor surfaced as
+python paddle.inference (Config -> create_predictor -> get_input_handle /
+run / get_output_handle; paddle_infer tutorial flow). TPU-first redesign: the
+"analysis + IR fusion + engine subgraphs" pipeline IS XLA — a saved program
+(jit.save's StableHLO-backed artifact) reloads as one compiled callable, so
+the Predictor is a thin stateful shell holding named input/output handles
+around that callable. GPU/TensorRT/MKLDNN toggles are accepted for API
+compatibility and recorded; device placement follows the active platform.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from .framework.core import Tensor
+
+
+class PrecisionType:
+    Float32 = 0
+    Half = 1
+    Bfloat16 = 2
+    Int8 = 3
+
+
+class PlaceType:
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM = 3
+
+
+class Config:
+    """Predictor configuration (inference_api.cc Config / AnalysisConfig)."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        # jit.save artifacts use one path prefix; accept both call shapes
+        self.prog_file = prog_file
+        self.params_file = params_file
+        self._model_dir = prog_file
+        self._use_gpu = False
+        self._device_id = 0
+        self._enable_memory_optim = True
+        self._switch_ir_optim = True
+        self._cpu_math_threads = 1
+        self._precision = PrecisionType.Float32
+        self._extra = {}
+
+    # -- device toggles (recorded; XLA owns actual placement) ---------------
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0,
+                       precision=PrecisionType.Float32):
+        self._use_gpu = True
+        self._device_id = device_id
+        self._precision = precision
+
+    def disable_gpu(self):
+        self._use_gpu = False
+
+    def use_gpu(self):
+        return self._use_gpu
+
+    def enable_xpu(self, *a, **k):
+        self._extra["xpu"] = True
+
+    def enable_custom_device(self, device_type, device_id=0):
+        self._extra["custom_device"] = (device_type, device_id)
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._cpu_math_threads = int(n)
+
+    # -- optimization toggles (XLA always fuses; kept for API parity) -------
+    def switch_ir_optim(self, flag=True):
+        self._switch_ir_optim = bool(flag)
+
+    def enable_memory_optim(self, flag=True):
+        self._enable_memory_optim = bool(flag)
+
+    def enable_tensorrt_engine(self, *a, **k):
+        self._extra["tensorrt"] = True  # no-op: XLA is the engine
+
+    def enable_mkldnn(self):
+        self._extra["mkldnn"] = True
+
+    def set_model(self, prog_file, params_file=None):
+        self.prog_file = prog_file
+        self.params_file = params_file
+        self._model_dir = prog_file
+
+    def model_dir(self):
+        return self._model_dir
+
+    def summary(self):
+        return (f"Config(model={self._model_dir}, use_gpu={self._use_gpu}, "
+                f"ir_optim={self._switch_ir_optim})")
+
+
+class _IOHandle:
+    """Named input/output tensor handle (ZeroCopyTensor analog)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+
+    def reshape(self, shape):
+        pass  # shapes flow from copy_from_cpu; kept for API parity
+
+    def copy_from_cpu(self, arr):
+        self._value = np.asarray(arr)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._value)
+
+    def share_external_data(self, arr):
+        self.copy_from_cpu(np.asarray(arr))
+
+
+class Predictor:
+    """Compiled-program predictor (AnalysisPredictor analog).
+
+    Wraps a translated function from paddle.jit.load: run() feeds the input
+    handles in declaration order, executes the compiled program, and fills
+    the output handles.
+    """
+
+    def __init__(self, config: Config):
+        from . import jit
+
+        self.config = config
+        self._fn = jit.load(config.prog_file)
+        names = list(getattr(self._fn, "_input_names", None) or ["input_0"])
+        self._inputs = {n: _IOHandle(n) for n in names}
+        self._input_order = names
+        self._outputs = []
+
+    def get_input_names(self):
+        return list(self._input_order)
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def run(self, inputs=None):
+        """Execute. `inputs` (list of arrays) may bypass the handle API."""
+        if inputs is not None:
+            for n, a in zip(self._input_order, inputs):
+                self._inputs[n].copy_from_cpu(a)
+        args = [Tensor(jax.numpy.asarray(self._inputs[n]._value))
+                for n in self._input_order]
+        out = self._fn(*args)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        self._outputs = []
+        for i, o in enumerate(outs):
+            h = _IOHandle(f"output_{i}")
+            h._value = np.asarray(o.numpy() if hasattr(o, "numpy") else o)
+            self._outputs.append(h)
+        if inputs is not None:
+            return [h.copy_to_cpu() for h in self._outputs]
+        return None
+
+    def get_output_names(self):
+        return [h.name for h in self._outputs] or ["output_0"]
+
+    def get_output_handle(self, name):
+        for h in self._outputs:
+            if h.name == name:
+                return h
+        raise KeyError(name)
+
+    def clear_intermediate_tensor(self):
+        pass
+
+    def try_shrink_memory(self):
+        pass
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
+
+
+def get_version():
+    from . import __version__
+
+    return __version__
+
+
+__all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
+           "PlaceType", "get_version"]

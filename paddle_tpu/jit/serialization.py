@@ -104,17 +104,21 @@ def save(layer, path, input_spec=None, **config):
     with open(path + ".pdmodel", "wb") as f:
         f.write(blob)
     state = {n: np.asarray(t.value) for n, t in zip(names, tensors)}
+    input_names = [getattr(s, "name", None) or f"input_{i}"
+                   for i, s in enumerate(input_spec)]
     with open(path + ".pdiparams", "wb") as f:
-        pickle.dump({"state_names": names, "state": state}, f)
+        pickle.dump({"state_names": names, "state": state,
+                     "input_names": input_names}, f)
 
 
 class TranslatedLayer(Layer):
     """A loaded, compiled program callable like a Layer (paddle.jit.load result)."""
 
-    def __init__(self, exported, state_vals):
+    def __init__(self, exported, state_vals, input_names=None):
         super().__init__()
         self._exported = exported
         self._state_vals = [jnp.asarray(v) for v in state_vals]
+        self._input_names = list(input_names or [])  # paddle.inference handles
 
     def forward(self, *inputs):
         vals = [x.value if isinstance(x, Tensor) else jnp.asarray(x) for x in inputs]
@@ -129,4 +133,5 @@ def load(path, **config):
     with open(path + ".pdiparams", "rb") as f:
         meta = pickle.load(f)
     state_vals = [meta["state"][n] for n in meta["state_names"]]
-    return TranslatedLayer(exported, state_vals)
+    return TranslatedLayer(exported, state_vals,
+                           input_names=meta.get("input_names"))

@@ -1,0 +1,88 @@
+"""The op table: one queryable source of truth for every registered op.
+
+Reference analog: phi/ops/yaml/ops.yaml + backward.yaml (the YAML op registry
+that drives the reference's codegen) and the generated API docs. TPU-first
+redesign: `defop` registrations ARE the registry — one decorator captures the
+op name, AMP category, differentiability, and the pure-jax kernel in a single
+place — so the "YAML table" becomes a runtime introspection surface plus a
+generated markdown document (docs/ops.md) kept in sync by a test.
+"""
+from __future__ import annotations
+
+import inspect
+
+from ._apply import get_registry
+
+
+def op_table(include_custom=False):
+    """All registered ops, sorted by name.
+
+    Each row: name, module (which ops/*.py file defines the kernel),
+    signature (of the pure-jax kernel = the public argument contract),
+    differentiable, amp_category, summary (first docstring line).
+    User ops added via paddle.utils.register_custom_op are excluded unless
+    include_custom=True (they are session-local, not framework surface).
+    """
+    rows = []
+    for name, opdef in sorted(get_registry().items()):
+        fn = opdef.fn
+        module = getattr(fn, "__module__", "") or ""
+        if not include_custom and not module.startswith("paddle_tpu."):
+            continue
+        try:
+            sig = str(inspect.signature(fn))
+        except (TypeError, ValueError):
+            sig = "(...)"
+        doc = inspect.getdoc(fn) or ""
+        rows.append({
+            "name": name,
+            "module": getattr(fn, "__module__", ""),
+            "signature": sig,
+            "differentiable": bool(opdef.differentiable),
+            "amp_category": opdef.amp_category or "-",
+            "summary": doc.splitlines()[0] if doc else "",
+        })
+    return rows
+
+
+def generate_op_docs(path=None):
+    """Render the op table to markdown (docs/ops.md when path is None)."""
+    import os
+
+    if path is None:
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        path = os.path.join(repo, "docs", "ops.md")
+    rows = op_table()
+    by_module = {}
+    for r in rows:
+        by_module.setdefault(r["module"].rsplit(".", 1)[-1], []).append(r)
+    lines = [
+        "# paddle_tpu op registry",
+        "",
+        f"{len(rows)} ops registered via `defop` "
+        "(paddle_tpu/ops/_apply.py) — the single source of truth for the "
+        "eager/jit/SPMD op surface. Regenerate with "
+        "`python -m paddle_tpu.ops.optable`.",
+        "",
+    ]
+    for module in sorted(by_module):
+        lines += [f"## {module} ({len(by_module[module])} ops)", "",
+                  "| op | signature | grad | amp |", "|---|---|---|---|"]
+        for r in by_module[module]:
+            sig = r["signature"].replace("|", "\\|")
+            lines.append(
+                f"| `{r['name']}` | `{sig}` | "
+                f"{'yes' if r['differentiable'] else 'no'} | "
+                f"{r['amp_category']} |")
+        lines.append("")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write("\n".join(lines))
+    return path
+
+
+if __name__ == "__main__":
+    import paddle_tpu  # noqa: F401  (populate the registry)
+
+    print(generate_op_docs())

@@ -1,3 +1,4 @@
 """paddle.utils parity namespace."""
 from . import custom_op  # noqa: F401
 from .custom_op import get_custom_op, register_custom_op  # noqa: F401
+from ..ops.optable import generate_op_docs, op_table  # noqa: F401

@@ -326,3 +326,115 @@ class TestAdviceFixes:
                   save_dir=str(tmp_path), callbacks=[es])
         assert model.stop_training
         assert es.best == 2.0  # baseline never beaten
+
+
+class TestDatasetParsers:
+    """Exercise the real on-disk parser paths with synthetic files (the
+    reference's download-backed datasets, minus the network)."""
+
+    @staticmethod
+    def _write_idx(tmp_path, n=7, rows=4, cols=5, gz=True):
+        import gzip
+        import struct
+
+        rng = np.random.RandomState(0)
+        images = rng.randint(0, 256, (n, rows, cols)).astype(np.uint8)
+        labels = rng.randint(0, 10, n).astype(np.uint8)
+        ip = tmp_path / ("img.idx3.gz" if gz else "img.idx3")
+        lp = tmp_path / ("lab.idx1.gz" if gz else "lab.idx1")
+        opener = gzip.open if gz else open
+        with opener(str(ip), "wb") as f:
+            f.write(struct.pack(">IIII", 2051, n, rows, cols))
+            f.write(images.tobytes())
+        with opener(str(lp), "wb") as f:
+            f.write(struct.pack(">II", 2049, n))
+            f.write(labels.tobytes())
+        return str(ip), str(lp), images, labels
+
+    @pytest.mark.parametrize("gz", [True, False])
+    def test_mnist_idx_parser(self, tmp_path, gz):
+        from paddle_tpu.vision.datasets import MNIST
+
+        ip, lp, images, labels = self._write_idx(tmp_path, gz=gz)
+        ds = MNIST(image_path=ip, label_path=lp)
+        assert len(ds) == 7
+        img, lab = ds[3]
+        np.testing.assert_array_equal(img, images[3])
+        assert int(lab) == int(labels[3]) and lab.dtype == np.int64
+
+    def test_mnist_with_transform(self, tmp_path):
+        from paddle_tpu.vision.datasets import MNIST
+
+        ip, lp, images, _ = self._write_idx(tmp_path)
+        ds = MNIST(image_path=ip, label_path=lp,
+                   transform=lambda im: im.astype("float32") / 255.0)
+        img, _ = ds[0]
+        assert img.dtype == np.float32 and img.max() <= 1.0
+
+    @staticmethod
+    def _write_cifar(tmp_path, n=6, cifar100=False):
+        import io
+        import pickle
+        import tarfile
+
+        rng = np.random.RandomState(1)
+        data = rng.randint(0, 256, (n, 3 * 32 * 32)).astype(np.uint8)
+        labels = [int(x) for x in rng.randint(0, 10, n)]
+        key = b"fine_labels" if cifar100 else b"labels"
+        name = "train" if cifar100 else "data_batch_1"
+        blob = pickle.dumps({b"data": data, key: labels})
+        path = tmp_path / "cifar.tar.gz"
+        with tarfile.open(str(path), "w:gz") as tf:
+            info = tarfile.TarInfo(name=f"cifar/{name}")
+            info.size = len(blob)
+            tf.addfile(info, io.BytesIO(blob))
+        return str(path), data, labels
+
+    def test_cifar10_parser(self, tmp_path):
+        from paddle_tpu.vision.datasets import Cifar10
+
+        path, data, labels = self._write_cifar(tmp_path)
+        ds = Cifar10(data_file=path, mode="train")
+        assert len(ds) == 6
+        img, lab = ds[2]
+        assert img.shape == (32, 32, 3)  # CHW pickle -> HWC output
+        np.testing.assert_array_equal(
+            img, data[2].reshape(3, 32, 32).transpose(1, 2, 0))
+        assert int(lab) == labels[2]
+
+    def test_cifar100_parser(self, tmp_path):
+        from paddle_tpu.vision.datasets import Cifar100
+
+        path, data, labels = self._write_cifar(tmp_path, cifar100=True)
+        ds = Cifar100(data_file=path, mode="train")
+        assert len(ds) == 6 and int(ds[0][1]) == labels[0]
+
+    def test_cifar_test_mode_filters_members(self, tmp_path):
+        from paddle_tpu.vision.datasets import Cifar10
+
+        path, _, _ = self._write_cifar(tmp_path)
+        assert len(Cifar10(data_file=path, mode="test")) == 0
+
+
+class TestVisualDLCallback:
+    def test_scalars_logged_to_jsonl(self, tmp_path):
+        import json
+
+        from paddle_tpu.hapi import VisualDL
+        from paddle_tpu.io import DataLoader
+
+        paddle.seed(0)
+        net = paddle.nn.Sequential(paddle.nn.Flatten(), paddle.nn.Linear(12, 4))
+        model = paddle.Model(net)
+        model.prepare(paddle.optimizer.SGD(learning_rate=0.01,
+                                           parameters=net.parameters()),
+                      paddle.nn.CrossEntropyLoss())
+        data = FakeData(size=8, image_shape=(3, 2, 2), num_classes=4)
+        cb = VisualDL(log_dir=str(tmp_path / "vdl"))
+        model.fit(data, batch_size=4, epochs=2, verbose=0, callbacks=[cb])
+        lines = [json.loads(l) for l in
+                 open(tmp_path / "vdl" / "scalars.jsonl")]
+        tags = {l["tag"] for l in lines}
+        assert any(t.startswith("train/loss") for t in tags), tags
+        steps = [l["step"] for l in lines if l["tag"].startswith("train/")]
+        assert steps == sorted(steps) and steps[-1] >= 4  # 2 epochs x 2 steps
