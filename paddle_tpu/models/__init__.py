@@ -12,6 +12,15 @@ from .llama import (  # noqa: F401
     LlamaModel,
     LlamaPretrainingCriterion,
 )
+from .bert import (  # noqa: F401
+    BertConfig,
+    BertForPretraining,
+    BertModel,
+    BertPretrainingCriterion,
+    ErnieConfig,
+    ErnieForPretraining,
+    ErnieModel,
+)
 from .gpt import (  # noqa: F401
     GPTConfig,
     GPTForCausalLM,

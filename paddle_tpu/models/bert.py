@@ -1,0 +1,302 @@
+"""BERT / ERNIE encoder family (BASELINE configs 3-4's model).
+
+Reference analog: the transformer encoder stack the reference trains as
+BERT-base / ERNIE-3.0 (encoder layers from python/paddle/nn/layer/
+transformer.py; the model recipes live in PaddleNLP). TPU-first notes: the
+attention core routes through F.scaled_dot_product_attention (Pallas flash
+attention when shapes allow), bias-ful projections shard with the same
+Column/RowParallel mpu layers as the Llama family, and the MLM decoder ties
+to the word embeddings so the big vocab matmul stays a single MXU-friendly
+contraction.
+
+ERNIE (ErnieModel/ErnieForPretraining) shares the architecture with an extra
+task-type embedding table, mirroring the reference's ERNIE recipe.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import ops
+from ..nn import functional as F
+from ..nn.initializer import Normal
+from ..nn.layer.common import Dropout, Embedding, Linear
+from ..nn.layer.layers import Layer
+from ..nn.layer.norm import LayerNorm
+from .llama import _mp_linears, _tp
+
+
+class BertConfig:
+    """Plain config object (bert-base defaults)."""
+
+    def __init__(
+        self,
+        vocab_size=30522,
+        hidden_size=768,
+        num_hidden_layers=12,
+        num_attention_heads=12,
+        intermediate_size=3072,
+        max_position_embeddings=512,
+        type_vocab_size=2,
+        task_type_vocab_size=0,  # >0 = ERNIE-style task embeddings
+        hidden_dropout_prob=0.1,
+        attention_probs_dropout_prob=0.1,
+        initializer_range=0.02,
+        layer_norm_eps=1e-12,
+        tensor_parallel_degree=1,
+        sequence_parallel=False,
+        use_flash_attention=True,
+        dtype="float32",
+        **kwargs,
+    ):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.max_position_embeddings = max_position_embeddings
+        self.type_vocab_size = type_vocab_size
+        self.task_type_vocab_size = task_type_vocab_size
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.initializer_range = initializer_range
+        self.layer_norm_eps = layer_norm_eps
+        self.tensor_parallel_degree = tensor_parallel_degree
+        self.sequence_parallel = sequence_parallel
+        self.use_flash_attention = use_flash_attention
+        self.head_dim = hidden_size // num_attention_heads
+        self.dtype = dtype
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+
+class BertEmbeddings(Layer):
+    """word + position + token_type (+ task_type for ERNIE) + LN + dropout."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        init = Normal(std=config.initializer_range)
+        if _tp(config):
+            from ..distributed.fleet.mpu.mp_layers import VocabParallelEmbedding
+
+            self.word_embeddings = VocabParallelEmbedding(
+                config.vocab_size, config.hidden_size, weight_attr=init)
+        else:
+            self.word_embeddings = Embedding(config.vocab_size,
+                                             config.hidden_size,
+                                             weight_attr=init)
+        self.position_embeddings = Embedding(config.max_position_embeddings,
+                                             config.hidden_size,
+                                             weight_attr=init)
+        self.token_type_embeddings = Embedding(config.type_vocab_size,
+                                               config.hidden_size,
+                                               weight_attr=init)
+        self.task_type_embeddings = (
+            Embedding(config.task_type_vocab_size, config.hidden_size,
+                      weight_attr=init)
+            if config.task_type_vocab_size > 0 else None)
+        self.layer_norm = LayerNorm(config.hidden_size,
+                                    epsilon=config.layer_norm_eps)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                task_type_ids=None):
+        B, S = input_ids.shape
+        if position_ids is None:
+            position_ids = ops.broadcast_to(
+                ops.unsqueeze(ops.arange(S, dtype="int64"), 0), [B, S])
+        if token_type_ids is None:
+            token_type_ids = ops.zeros([B, S], dtype="int64")
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        if self.task_type_embeddings is not None:
+            if task_type_ids is None:
+                task_type_ids = ops.zeros([B, S], dtype="int64")
+            x = x + self.task_type_embeddings(task_type_ids)
+        return self.dropout(self.layer_norm(x))
+
+
+class BertSelfAttention(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.num_heads = config.num_attention_heads
+        self.head_dim = config.head_dim
+        h = config.hidden_size
+        init = Normal(std=config.initializer_range)
+        if _tp(config):
+            Col, Row = _mp_linears(config)
+            self.q_proj = Col(h, h, has_bias=True, gather_output=False,
+                              weight_attr=init)
+            self.k_proj = Col(h, h, has_bias=True, gather_output=False,
+                              weight_attr=init)
+            self.v_proj = Col(h, h, has_bias=True, gather_output=False,
+                              weight_attr=init)
+            self.out_proj = Row(h, h, has_bias=True, input_is_parallel=True,
+                                weight_attr=init)
+        else:
+            self.q_proj = Linear(h, h, weight_attr=init)
+            self.k_proj = Linear(h, h, weight_attr=init)
+            self.v_proj = Linear(h, h, weight_attr=init)
+            self.out_proj = Linear(h, h, weight_attr=init)
+        self.dropout_p = config.attention_probs_dropout_prob
+
+    def forward(self, x, attn_mask=None):
+        B, S = x.shape[0], x.shape[1]
+        q = ops.reshape(self.q_proj(x), [B, S, self.num_heads, self.head_dim])
+        k = ops.reshape(self.k_proj(x), [B, S, self.num_heads, self.head_dim])
+        v = ops.reshape(self.v_proj(x), [B, S, self.num_heads, self.head_dim])
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, is_causal=False,
+            dropout_p=self.dropout_p, training=self.training)
+        out = ops.reshape(out, [B, S, self.num_heads * self.head_dim])
+        return self.out_proj(out)
+
+
+class BertLayer(Layer):
+    """Post-LN encoder block (original BERT residual placement)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        init = Normal(std=config.initializer_range)
+        self.attention = BertSelfAttention(config)
+        self.attn_norm = LayerNorm(config.hidden_size,
+                                   epsilon=config.layer_norm_eps)
+        h, inter = config.hidden_size, config.intermediate_size
+        if _tp(config):
+            Col, Row = _mp_linears(config)
+            self.ffn_in = Col(h, inter, has_bias=True, gather_output=False,
+                              weight_attr=init)
+            self.ffn_out = Row(inter, h, has_bias=True, input_is_parallel=True,
+                               weight_attr=init)
+        else:
+            self.ffn_in = Linear(h, inter, weight_attr=init)
+            self.ffn_out = Linear(inter, h, weight_attr=init)
+        self.ffn_norm = LayerNorm(config.hidden_size,
+                                  epsilon=config.layer_norm_eps)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x, attn_mask=None):
+        x = self.attn_norm(x + self.dropout(self.attention(x, attn_mask)))
+        y = self.ffn_out(F.gelu(self.ffn_in(x)))
+        return self.ffn_norm(x + self.dropout(y))
+
+
+class BertPooler(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.dense = Linear(config.hidden_size, config.hidden_size,
+                            weight_attr=Normal(std=config.initializer_range))
+
+    def forward(self, hidden_states):
+        return ops.tanh(self.dense(hidden_states[:, 0]))
+
+
+class BertModel(Layer):
+    """Encoder: embeddings -> N BertLayers -> pooled [CLS]."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        self.layers = [BertLayer(config)
+                       for _ in range(config.num_hidden_layers)]
+        for i, l in enumerate(self.layers):
+            self.add_sublayer(f"layer_{i}", l)
+        self.pooler = BertPooler(config)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                position_ids=None, task_type_ids=None):
+        if attention_mask is not None and attention_mask.ndim == 2:
+            # (B, S) padding mask -> additive (B, 1, 1, S) bias
+            neg = (1.0 - ops.cast(attention_mask, "float32")) * -1e4
+            attention_mask = ops.unsqueeze(ops.unsqueeze(neg, 1), 1)
+        x = self.embeddings(input_ids, token_type_ids, position_ids,
+                            task_type_ids)
+        for layer in self.layers:
+            x = layer(x, attention_mask)
+        return x, self.pooler(x)
+
+
+class BertPretrainingHeads(Layer):
+    """MLM transform + vocab decoder (weight-tied) + NSP classifier."""
+
+    def __init__(self, config: BertConfig, embedding_weights):
+        super().__init__()
+        init = Normal(std=config.initializer_range)
+        self.transform = Linear(config.hidden_size, config.hidden_size,
+                                weight_attr=init)
+        self.transform_norm = LayerNorm(config.hidden_size,
+                                        epsilon=config.layer_norm_eps)
+        self.decoder_weight = embedding_weights  # tied: (vocab, hidden)
+        self.decoder_bias = self.create_parameter(
+            [config.vocab_size], is_bias=True)
+        self.seq_relationship = Linear(config.hidden_size, 2, weight_attr=init)
+
+    def forward(self, sequence_output, pooled_output):
+        x = self.transform_norm(F.gelu(self.transform(sequence_output)))
+        logits = ops.matmul(x, self.decoder_weight,
+                            transpose_y=True) + self.decoder_bias
+        return logits, self.seq_relationship(pooled_output)
+
+
+class BertForPretraining(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.bert = BertModel(config)
+        self.cls = BertPretrainingHeads(
+            config, self.bert.embeddings.word_embeddings.weight)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                position_ids=None, task_type_ids=None):
+        seq, pooled = self.bert(input_ids, token_type_ids, attention_mask,
+                                position_ids, task_type_ids)
+        return self.cls(seq, pooled)
+
+
+class BertPretrainingCriterion(Layer):
+    """masked-LM CE (ignore_index=-100 positions) + NSP CE."""
+
+    def __init__(self, vocab_size):
+        super().__init__()
+        self.vocab_size = vocab_size
+
+    def forward(self, prediction_scores, seq_relationship_score,
+                masked_lm_labels, next_sentence_labels=None):
+        logits = ops.reshape(prediction_scores, [-1, self.vocab_size])
+        labels = ops.reshape(masked_lm_labels, [-1])
+        mask = ops.cast(labels != -100, "float32")
+        safe = ops.where(labels != -100, labels, ops.zeros_like(labels))
+        per_tok = F.cross_entropy(logits, safe, reduction="none")
+        per_tok = ops.reshape(per_tok, [-1])
+        mlm = ops.sum(per_tok * mask) / ops.clip(ops.sum(mask), min=1.0)
+        if next_sentence_labels is None:
+            return mlm
+        nsp = F.cross_entropy(seq_relationship_score,
+                              ops.reshape(next_sentence_labels, [-1]))
+        return mlm + nsp
+
+
+# -- ERNIE: same encoder with task-type embeddings ---------------------------
+class ErnieConfig(BertConfig):
+    def __init__(self, task_type_vocab_size=3, **kwargs):
+        super().__init__(task_type_vocab_size=task_type_vocab_size, **kwargs)
+
+
+class ErnieModel(BertModel):
+    """ERNIE-3.0-style encoder (BertModel + task-type embedding table)."""
+
+    def __init__(self, config=None, **kwargs):
+        super().__init__(config or ErnieConfig(**kwargs))
+
+
+class ErnieForPretraining(BertForPretraining):
+    def __init__(self, config=None, **kwargs):
+        super().__init__(config or ErnieConfig(**kwargs))
+
+
+__all__ = [
+    "BertConfig", "BertModel", "BertForPretraining",
+    "BertPretrainingCriterion", "ErnieConfig", "ErnieModel",
+    "ErnieForPretraining",
+]

@@ -260,3 +260,99 @@ class TestFusedRopeSemantics:
             q, k, sin=sin4, cos=cos4, use_neox_rotary_style=False)
         np.testing.assert_allclose(got.numpy(), ref.numpy(), rtol=1e-5,
                                    atol=1e-5)
+
+
+class TestBert:
+    @staticmethod
+    def _cfg(**kw):
+        from paddle_tpu.models import BertConfig
+
+        base = dict(vocab_size=96, hidden_size=32, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=64,
+                    max_position_embeddings=16, hidden_dropout_prob=0.0,
+                    attention_probs_dropout_prob=0.0)
+        base.update(kw)
+        return BertConfig(**base)
+
+    @staticmethod
+    def _batch(vocab=96, B=4, S=8, seed=0):
+        r = np.random.RandomState(seed)
+        ids = paddle.to_tensor(r.randint(0, vocab, (B, S)).astype("int64"))
+        labels = r.randint(0, vocab, (B, S))
+        labels[:, ::2] = -100  # unmasked positions ignored by the criterion
+        nsp = paddle.to_tensor(r.randint(0, 2, B).astype("int64"))
+        return ids, paddle.to_tensor(labels.astype("int64")), nsp
+
+    def test_pretraining_loss_decreases(self):
+        from paddle_tpu.models import BertForPretraining, BertPretrainingCriterion
+
+        paddle.seed(0)
+        cfg = self._cfg()
+        model = BertForPretraining(cfg)
+        crit = BertPretrainingCriterion(cfg.vocab_size)
+        opt = paddle.optimizer.AdamW(learning_rate=3e-3,
+                                     parameters=model.parameters())
+        ids, labels, nsp = self._batch()
+        losses = []
+        for _ in range(30):
+            logits, rel = model(ids)
+            loss = crit(logits, rel, labels, nsp)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < 0.7 * losses[0], losses
+
+    def test_padding_mask_changes_output(self):
+        from paddle_tpu.models import BertForPretraining
+
+        paddle.seed(1)
+        model = BertForPretraining(self._cfg())
+        model.eval()
+        ids, _, _ = self._batch()
+        full = np.ones((4, 8), "int64")
+        part = full.copy()
+        part[:, 6:] = 0  # mask the tail tokens out of attention
+        out_full, _ = model(ids, attention_mask=paddle.to_tensor(full))
+        out_part, _ = model(ids, attention_mask=paddle.to_tensor(part))
+        assert not np.allclose(out_full.numpy()[:, :6], out_part.numpy()[:, :6])
+
+    def test_mlm_decoder_tied_to_embeddings(self):
+        from paddle_tpu.models import BertForPretraining
+
+        model = BertForPretraining(self._cfg())
+        assert model.cls.decoder_weight is model.bert.embeddings.word_embeddings.weight
+
+    def test_ernie_task_embeddings(self):
+        from paddle_tpu.models import ErnieForPretraining
+
+        paddle.seed(2)
+        model = ErnieForPretraining(
+            vocab_size=64, hidden_size=16, num_hidden_layers=1,
+            num_attention_heads=2, intermediate_size=32,
+            max_position_embeddings=16, hidden_dropout_prob=0.0,
+            attention_probs_dropout_prob=0.0)
+        model.eval()
+        ids = paddle.to_tensor(np.arange(8, dtype="int64").reshape(1, 8))
+        t0 = paddle.to_tensor(np.zeros((1, 8), "int64"))
+        t1 = paddle.to_tensor(np.ones((1, 8), "int64"))
+        out0, _ = model(ids, task_type_ids=t0)
+        out1, _ = model(ids, task_type_ids=t1)
+        assert not np.allclose(out0.numpy(), out1.numpy())
+
+    def test_tp_matches_single(self):
+        from paddle_tpu.models import BertForPretraining
+
+        paddle.seed(7)
+        m_ref = BertForPretraining(self._cfg())
+        s = fleet.DistributedStrategy()
+        s.hybrid_configs = {"dp_degree": 1, "mp_degree": 2, "pp_degree": 1}
+        fleet.init(is_collective=True, strategy=s)
+        paddle.seed(7)
+        m_tp = BertForPretraining(self._cfg(tensor_parallel_degree=2))
+        m_ref.eval(); m_tp.eval()
+        ids, _, _ = self._batch()
+        out_ref, _ = m_ref(ids)
+        out_tp, _ = m_tp(ids)
+        np.testing.assert_allclose(out_ref.numpy(), out_tp.numpy(),
+                                   rtol=2e-4, atol=2e-4)
