@@ -791,3 +791,93 @@ def _kl_geometric(p, q):
     mean = (1.0 - p.probs) / p.probs
     return (mean * (ops.log1p(-p.probs) - ops.log1p(-q.probs))
             + ops.log(p.probs) - ops.log(q.probs))
+
+
+class ExponentialFamily(Distribution):
+    """exponential_family.py ExponentialFamily: distributions of the form
+    p(x) = h(x) exp(<t(x), theta> - A(theta)).
+
+    Subclasses provide `_natural_parameters` (tuple of Tensors) and
+    `_log_normalizer(*theta)`; `entropy` then follows from the Bregman
+    identity A(theta) - <theta, grad A(theta)> + E[-log h(x)] via autodiff
+    (the reference computes exactly this with paddle.grad)."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        theta = [v.detach() for v in self._natural_parameters]
+        vals = [t.value for t in theta]
+
+        lognorm = lambda *vs: self._log_normalizer(  # noqa: E731
+            *[Tensor(v, stop_gradient=False) for v in vs]).value
+        a_val = lognorm(*vals)
+        grads = jax.grad(lambda *vs: jnp.sum(lognorm(*vs)),
+                         argnums=tuple(range(len(vals))))(*vals)
+        ent = -float(self._mean_carrier_measure) + a_val
+        for v, g in zip(vals, grads):
+            ent = ent - v * g
+        return Tensor(ent)
+
+
+class LKJCholesky(Distribution):
+    """lkj_cholesky.py LKJCholesky(dim, concentration): Cholesky factors of
+    correlation matrices; sampling by the onion method."""
+
+    def __init__(self, dim, concentration=1.0, sample_method="onion"):
+        if dim < 2:
+            raise ValueError("LKJCholesky requires dim >= 2")
+        self.dim = int(dim)
+        self.concentration = _t(concentration)
+        self.sample_method = sample_method
+        super().__init__(batch_shape=tuple(self.concentration.shape),
+                         event_shape=(dim, dim))
+
+    def sample(self, shape=()):
+        d = self.dim
+        eta = self.concentration.value
+        shape = tuple(shape) + tuple(self.concentration.shape)
+        key = _key()
+        ks = jax.random.split(key, 3)
+        # onion method (Lewandowski/Kurowicka/Joe 2009)
+        beta0 = eta + (d - 2) / 2.0
+        u = jax.random.beta(ks[0], beta0, beta0, shape)
+        r = 2.0 * u - 1.0  # first off-diagonal entry
+        L = jnp.zeros(shape + (d, d))
+        L = L.at[..., 0, 0].set(1.0)
+        L = L.at[..., 1, 0].set(r)
+        L = L.at[..., 1, 1].set(jnp.sqrt(jnp.clip(1.0 - r ** 2, 1e-12)))
+        for i in range(2, d):
+            b = eta + (d - 1 - i) / 2.0
+            ky, kn = jax.random.split(jax.random.fold_in(ks[1], i))
+            y = jax.random.beta(ky, i / 2.0, b, shape)  # squared row norm
+            n = jax.random.normal(kn, shape + (i,))
+            n = n / jnp.linalg.norm(n, axis=-1, keepdims=True)
+            row = jnp.sqrt(y)[..., None] * n
+            L = L.at[..., i, :i].set(row)
+            L = L.at[..., i, i].set(jnp.sqrt(jnp.clip(1.0 - y, 1e-12)))
+        return Tensor(L)
+
+    def log_prob(self, value):
+        L = _t(value).value
+        d = self.dim
+        eta = self.concentration.value
+        diag = jnp.diagonal(L, axis1=-2, axis2=-1)[..., 1:]
+        orders = 2.0 * (eta[..., None] - 1.0) + d - jnp.arange(2, d + 1)
+        unnorm = jnp.sum(orders * jnp.log(diag), axis=-1)
+        # normalizer (reference lkj_cholesky.py log_normalizer)
+        alpha = eta + 0.5 * (d - 1)
+        k = jnp.arange(1, d)
+        lognorm = jnp.sum(
+            0.5 * k * jnp.log(jnp.pi)
+            + jax.scipy.special.gammaln(alpha[..., None] - 0.5 * k)
+            - jax.scipy.special.gammaln(alpha[..., None]), axis=-1)
+        return Tensor(unnorm - lognorm)

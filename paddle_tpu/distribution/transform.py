@@ -8,6 +8,9 @@ from __future__ import annotations
 
 import math
 
+import jax
+import jax.numpy as jnp
+
 from .. import ops
 from ..framework.core import Tensor
 from .distribution import Distribution, _t
@@ -142,3 +145,132 @@ class TransformedDistribution(Distribution):
         chain = self._chain()
         x = chain.inverse(_t(value))
         return self.base.log_prob(x) - chain.forward_log_det_jacobian(x)
+
+
+class AbsTransform(Transform):
+    """transform.py AbsTransform (y=|x|; not bijective — inverse picks +)."""
+
+    def forward(self, x):
+        return ops.abs(_t(x))
+
+    def inverse(self, y):
+        return _t(y)
+
+    def forward_log_det_jacobian(self, x):
+        return ops.zeros_like(_t(x))
+
+
+class SoftmaxTransform(Transform):
+    """transform.py SoftmaxTransform (last axis; inverse = log)."""
+
+    def forward(self, x):
+        x = _t(x)
+        e = ops.exp(x - ops.max(x, axis=-1, keepdim=True))
+        return e / ops.sum(e, axis=-1, keepdim=True)
+
+    def inverse(self, y):
+        return ops.log(_t(y))
+
+
+class StickBreakingTransform(Transform):
+    """transform.py StickBreakingTransform: R^{K} -> K+1 simplex."""
+
+    def forward(self, x):
+        x = _t(x).value
+        k = jnp.arange(x.shape[-1], dtype=x.dtype)
+        z = jax.nn.sigmoid(x - jnp.log(x.shape[-1] - k))
+        cum = jnp.cumprod(1.0 - z, axis=-1)
+        head = z * jnp.concatenate(
+            [jnp.ones_like(cum[..., :1]), cum[..., :-1]], axis=-1)
+        return Tensor(jnp.concatenate([head, cum[..., -1:]], axis=-1))
+
+    def inverse(self, y):
+        y = _t(y).value
+        cum = jnp.cumsum(y[..., :-1], axis=-1)
+        rest = 1.0 - jnp.concatenate(
+            [jnp.zeros_like(cum[..., :1]), cum[..., :-1]], axis=-1)
+        z = y[..., :-1] / rest
+        k = jnp.arange(z.shape[-1], dtype=y.dtype)
+        return Tensor(jnp.log(z / (1.0 - z)) + jnp.log(z.shape[-1] - k))
+
+    def forward_log_det_jacobian(self, x):
+        x = _t(x).value
+        k = jnp.arange(x.shape[-1], dtype=x.dtype)
+        off = x - jnp.log(x.shape[-1] - k)
+        z = jax.nn.sigmoid(off)
+        cum = jnp.cumprod(1.0 - z, axis=-1)
+        stick = jnp.concatenate(
+            [jnp.ones_like(cum[..., :1]), cum[..., :-1]], axis=-1)
+        # d y_k / d x_k = sigmoid(off)*sigmoid(-off)*stick_k (triangular jac)
+        return Tensor(
+            jnp.sum(jax.nn.log_sigmoid(off) + jax.nn.log_sigmoid(-off)
+                    + jnp.log(stick), axis=-1))
+
+
+class ReshapeTransform(Transform):
+    """transform.py ReshapeTransform(in_event_shape, out_event_shape)."""
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+
+    def forward(self, x):
+        x = _t(x)
+        batch = tuple(x.shape)[:x.ndim - len(self.in_event_shape)]
+        return x.reshape(batch + self.out_event_shape)
+
+    def inverse(self, y):
+        y = _t(y)
+        batch = tuple(y.shape)[:y.ndim - len(self.out_event_shape)]
+        return y.reshape(batch + self.in_event_shape)
+
+    def forward_log_det_jacobian(self, x):
+        x = _t(x)
+        batch = tuple(x.shape)[:x.ndim - len(self.in_event_shape)]
+        return ops.zeros(list(batch) or [1])
+
+
+class IndependentTransform(Transform):
+    """transform.py IndependentTransform: sum the log-det over the rightmost
+    reinterpreted_batch_ndims dims."""
+
+    def __init__(self, base, reinterpreted_batch_ndims):
+        self.base = base
+        self.reinterpreted_batch_ndims = int(reinterpreted_batch_ndims)
+
+    def forward(self, x):
+        return self.base.forward(x)
+
+    def inverse(self, y):
+        return self.base.inverse(y)
+
+    def forward_log_det_jacobian(self, x):
+        ld = self.base.forward_log_det_jacobian(x)
+        for _ in range(self.reinterpreted_batch_ndims):
+            ld = ops.sum(ld, axis=-1)
+        return ld
+
+
+class StackTransform(Transform):
+    """transform.py StackTransform: apply transforms[i] to slice i of `axis`."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+
+    def _map(self, method, x):
+        x = _t(x)
+        n = x.shape[self.axis]
+        parts = ops.split(x, n, axis=self.axis)
+        outs = [ops.squeeze(getattr(t, method)(p), axis=self.axis)
+                for t, p in zip(self.transforms, parts)]
+        return ops.stack(outs, axis=self.axis)
+
+    def forward(self, x):
+        return self._map("forward", x)
+
+    def inverse(self, y):
+        return self._map("inverse", y)
+
+    def forward_log_det_jacobian(self, x):
+        return self._map("forward_log_det_jacobian", x)

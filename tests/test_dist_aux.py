@@ -31,9 +31,20 @@ class TestRoleMaker:
         assert rm.worker_index() == 1 and rm.worker_num() == 3
         assert rm._current_endpoint == "b:2"
 
-    def test_ps_mode_rejected(self):
-        with pytest.raises(NotImplementedError):
-            dist.fleet.PaddleCloudRoleMaker(is_collective=False)
+    def test_ps_mode_role_discovery(self, monkeypatch):
+        # PS mode is implemented (distributed/ps): roles come from the
+        # reference's env contract
+        monkeypatch.setenv("PADDLE_PSERVERS_IP_PORT_LIST", "a:1,b:2")
+        monkeypatch.setenv("TRAINING_ROLE", "PSERVER")
+        monkeypatch.setenv("POD_IP", "b")
+        monkeypatch.setenv("PADDLE_PORT", "2")
+        rm = dist.fleet.PaddleCloudRoleMaker(is_collective=False)
+        assert rm.is_server()
+        assert rm.server_num() == 2 and rm.server_index() == 1
+        assert rm.get_pserver_endpoints() == ["a:1", "b:2"]
+        monkeypatch.setenv("TRAINING_ROLE", "TRAINER")
+        rm2 = dist.fleet.PaddleCloudRoleMaker(is_collective=False)
+        assert not rm2.is_server() and rm2.is_worker()
 
 
 class TestElastic:
