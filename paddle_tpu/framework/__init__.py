@@ -1,3 +1,3 @@
 """paddle_tpu.framework — core runtime (tensor handle, dtypes, flags, RNG)."""
-from . import dtype, flags, random  # noqa: F401
+from . import dtype, enforce, flags, random  # noqa: F401
 from .core import Parameter, Tensor, to_tensor  # noqa: F401

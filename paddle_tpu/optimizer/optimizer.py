@@ -35,7 +35,9 @@ class Optimizer:
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, multi_precision=False, name=None):
         if parameters is None:
-            raise ValueError(
+            from ..framework.enforce import InvalidArgumentError
+
+            raise InvalidArgumentError(
                 "parameters is required in eager mode: pass model.parameters()"
             )
         # param groups (reference optimizer.py supports dict groups)

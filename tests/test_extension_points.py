@@ -186,3 +186,50 @@ class TestInferenceAPI:
         (out,) = predictor.run([x])
         np.testing.assert_allclose(out, net(paddle.to_tensor(x)).numpy(),
                                    rtol=1e-5)
+
+
+class TestEnforce:
+    """Enforce/error system (phi/core/enforce.h analog)."""
+
+    def test_typed_errors_and_hint_format(self):
+        from paddle_tpu.framework import enforce as E
+
+        with pytest.raises(E.InvalidArgumentError) as ei:
+            E.enforce(False, "bad arg", hint="pass a positive value")
+        assert "[Hint: pass a positive value]" in str(ei.value)
+        # typed errors double as the stdlib taxonomy (except-clauses port over)
+        assert issubclass(E.NotFoundError, LookupError)
+        assert issubclass(E.OutOfRangeError, IndexError)
+        assert issubclass(E.UnimplementedError, NotImplementedError)
+        assert issubclass(E.ExecutionTimeoutError, TimeoutError)
+        for cls in [E.InvalidArgumentError, E.NotFoundError, E.FatalError]:
+            assert issubclass(cls, E.EnforceNotMet)
+
+    def test_comparison_helpers(self):
+        from paddle_tpu.framework import enforce as E
+
+        E.enforce_eq(3, 3)
+        E.enforce_gt(4, 3)
+        E.enforce_le(3, 3)
+        with pytest.raises(E.InvalidArgumentError):
+            E.enforce_ne(5, 5)
+        with pytest.raises(E.InvalidArgumentError):
+            E.enforce_lt(5, 5)
+
+    def test_shape_and_dtype_checks(self):
+        from paddle_tpu.framework import enforce as E
+
+        x = paddle.to_tensor(np.zeros((2, 3), "float32"))
+        assert E.enforce_shape(x, (2, 3)) == (2, 3)
+        assert E.enforce_shape(x, (None, 3)) == (2, 3)
+        with pytest.raises(E.InvalidArgumentError):
+            E.enforce_shape(x, (2, 4))
+        E.enforce_dtype(x, ["float32", "bfloat16"])
+        with pytest.raises(E.InvalidArgumentError):
+            E.enforce_dtype(x, "int64")
+
+    def test_optimizer_uses_typed_error(self):
+        from paddle_tpu.framework.enforce import InvalidArgumentError
+
+        with pytest.raises(InvalidArgumentError):
+            paddle.optimizer.SGD(learning_rate=0.1)
