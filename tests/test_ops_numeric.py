@@ -302,6 +302,216 @@ for case in list(CASES):
             CASES.remove(case)
 
 
+# ---- round-2 expansion: activations, losses, linalg, indexing, misc --------
+import scipy.special as sps
+
+_rs = np.random.RandomState(11)
+_IDX2 = np.array([[0, 1], [2, 3], [1, 0]], "int64")       # gather_nd rows of S
+_LBL = np.array([1, 0, 3], "int64")                       # cross_entropy labels
+_SELU_A, _SELU_S = 1.6732632423543772, 1.0507009873554805
+
+
+def _np_layer_norm(x, w, b, eps=1e-5):
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    return (x - mu) / np.sqrt(var + eps) * w + b
+
+
+def _np_ce(logits, labels=_LBL):
+    ls = logits - sps.logsumexp(logits, axis=-1, keepdims=True)
+    return -ls[np.arange(len(labels)), labels].mean()
+
+
+CASES += [
+    # ---- activations ----
+    OpCase("celu", F.celu, lambda x: np.where(x > 0, x, np.expm1(x)), [S]),
+    OpCase("gelu", F.gelu,
+           lambda x: x * 0.5 * (1 + sps.erf(x / np.sqrt(2.0))), [S]),
+    OpCase("glu", F.glu,
+           lambda x: x[:, :2] * sps.expit(x[:, 2:]), [S]),
+    OpCase("hardshrink", F.hardshrink,
+           lambda x: np.where(np.abs(x) > 0.5, x, 0.0), [S]),
+    OpCase("hardsigmoid", F.hardsigmoid,
+           lambda x: np.clip(x / 6.0 + 0.5, 0.0, 1.0), [S]),
+    OpCase("hardswish", F.hardswish,
+           lambda x: x * np.clip(x + 3.0, 0.0, 6.0) / 6.0, [S]),
+    OpCase("relu6", F.relu6, lambda x: np.clip(x, 0.0, 6.0), [S]),
+    OpCase("selu", F.selu,
+           lambda x: _SELU_S * np.where(x > 0, x, _SELU_A * np.expm1(x)), [S]),
+    OpCase("softshrink", F.softshrink,
+           lambda x: np.sign(x) * np.maximum(np.abs(x) - 0.5, 0.0), [S]),
+    OpCase("stanh", paddle.stanh,
+           lambda x: 1.7159 * np.tanh(0.67 * x), [S]),
+    OpCase("thresholded_relu",
+           lambda x: F.thresholded_relu(x, threshold=0.3),
+           lambda x: np.where(x > 0.3, x, 0.0), [S]),
+    OpCase("maxout", lambda x: F.maxout(x, 2),
+           lambda x: x.reshape(2, 2, 2, 3).max(axis=2), [(2, 4, 3)]),
+    # ---- losses ----
+    OpCase("mse_loss", F.mse_loss,
+           lambda x, y: ((x - y) ** 2).mean(), [S, S]),
+    OpCase("l1_loss", F.l1_loss,
+           lambda x, y: np.abs(x - y).mean(), [S, S]),
+    OpCase("smooth_l1_loss", F.smooth_l1_loss,
+           lambda x, y: np.where(np.abs(x - y) < 1.0,
+                                 0.5 * (x - y) ** 2,
+                                 np.abs(x - y) - 0.5).mean(), [S, S]),
+    OpCase("kl_div",
+           lambda x, y: F.kl_div(x, y, reduction="sum"),
+           lambda x, y: (y * (np.log(y) - x)).sum(), [S, S], positive=True),
+    OpCase("bce_with_logits",
+           lambda x, z: F.binary_cross_entropy_with_logits(
+               x, 1.0 / (1.0 + (-z).exp())),
+           lambda x, z: np.mean(_sp(x) - sps.expit(z) * x), [S, S]),
+    OpCase("soft_margin",
+           lambda x, y: F.soft_margin_loss(x, paddle.sign(y)),
+           lambda x, y: np.mean(np.log1p(np.exp(-np.sign(y) * x))),
+           [S, S], grad_inputs=[0]),
+    OpCase("poisson_nll",
+           lambda x, y: F.poisson_nll_loss(x, y),
+           lambda x, y: np.mean(np.exp(x) - y * x), [S, S],
+           positive=True, grad_inputs=[0]),
+    OpCase("cross_entropy",
+           lambda x: F.cross_entropy(x, paddle.to_tensor(_LBL)),
+           _np_ce, [S]),
+    # ---- fixed-weight nn primitives ----
+    OpCase("linear", F.linear,
+           lambda x, w, b: x @ w + b, [S, (4, 5), (5,)]),
+    OpCase("layer_norm",
+           lambda x, w, b: F.layer_norm(x, 4, weight=w, bias=b),
+           _np_layer_norm, [S, (4,), (4,)], grad_atol=2e-3),
+    # ---- linalg ----
+    OpCase("cholesky",
+           lambda x: paddle.linalg.cholesky(
+               x.matmul(paddle.transpose(x, [1, 0])) + 2.0 * paddle.eye(4)),
+           lambda x: np.linalg.cholesky(x @ x.T + 2.0 * np.eye(4)), [SQ]),
+    OpCase("det",
+           lambda x: paddle.linalg.det(x + 3.0 * paddle.eye(4)),
+           lambda x: np.linalg.det(x + 3.0 * np.eye(4)), [SQ]),
+    OpCase("slogdet",
+           lambda x: paddle.linalg.slogdet(x + 3.0 * paddle.eye(4)),
+           lambda x: np.stack(np.linalg.slogdet(x + 3.0 * np.eye(4))),
+           [SQ], grad=False),
+    OpCase("inverse",
+           lambda x: paddle.linalg.inv(
+               x.matmul(paddle.transpose(x, [1, 0])) + 2.0 * paddle.eye(4)),
+           lambda x: np.linalg.inv(x @ x.T + 2.0 * np.eye(4)), [SQ],
+           bf16_rtol=5e-2, bf16_atol=5e-2),
+    OpCase("solve",
+           lambda x, b: paddle.linalg.solve(x + 3.0 * paddle.eye(4), b),
+           lambda x, b: np.linalg.solve(x + 3.0 * np.eye(4), b),
+           [SQ, (4, 2)]),
+    OpCase("triangular_solve",
+           lambda x, b: paddle.linalg.triangular_solve(
+               paddle.tril(x) + 2.0 * paddle.eye(4), b, upper=False),
+           lambda x, b: np.linalg.solve(np.tril(x) + 2.0 * np.eye(4), b),
+           [SQ, (4, 2)]),
+    OpCase("pinv",
+           lambda x: paddle.linalg.pinv(x),
+           lambda x: np.linalg.pinv(x), [(4, 3)], grad=False,
+           dtypes=("float32",)),
+    OpCase("multi_dot",
+           lambda a, b, c: paddle.linalg.multi_dot([a, b, c]),
+           lambda a, b, c: a @ b @ c, [(3, 4), (4, 2), (2, 5)]),
+    OpCase("matrix_exp",
+           lambda x: paddle.linalg.matrix_exp(0.3 * x),
+           lambda x: __import__("scipy.linalg",
+                                fromlist=["expm"]).expm(0.3 * x),
+           [SQ], dtypes=("float32",)),
+    OpCase("corrcoef", paddle.linalg.corrcoef,
+           lambda x: np.corrcoef(x), [S], grad=False, dtypes=("float32",)),
+    OpCase("cov", paddle.linalg.cov,
+           lambda x: np.cov(x), [S], grad=False, dtypes=("float32",)),
+    # ---- comparisons / logical (forward-only) ----
+    OpCase("greater_equal", paddle.greater_equal, np.greater_equal,
+           [S, S], grad=False, int_dtypes=("int32", "int64")),
+    OpCase("less_than", paddle.less_than, np.less,
+           [S, S], grad=False, int_dtypes=("int32",)),
+    OpCase("not_equal", paddle.not_equal, np.not_equal,
+           [S, S], grad=False, int_dtypes=("int32",)),
+    OpCase("logical_and",
+           lambda x, y: paddle.logical_and(x > 0, y > 0),
+           lambda x, y: (x > 0) & (y > 0), [S, S], grad=False),
+    OpCase("logical_or",
+           lambda x, y: paddle.logical_or(x > 0, y > 0),
+           lambda x, y: (x > 0) | (y > 0), [S, S], grad=False),
+    OpCase("logical_xor",
+           lambda x, y: paddle.logical_xor(x > 0, y > 0),
+           lambda x, y: (x > 0) ^ (y > 0), [S, S], grad=False),
+    OpCase("logical_not",
+           lambda x: paddle.logical_not(x > 0),
+           lambda x: ~(x > 0), [S], grad=False),
+    # ---- bitwise (int-only) ----
+    OpCase("bitwise_and", paddle.bitwise_and, np.bitwise_and, [S, S],
+           grad=False, dtypes=(), int_dtypes=("int32", "int64")),
+    OpCase("bitwise_or", paddle.bitwise_or, np.bitwise_or, [S, S],
+           grad=False, dtypes=(), int_dtypes=("int32",)),
+    OpCase("bitwise_xor", paddle.bitwise_xor, np.bitwise_xor, [S, S],
+           grad=False, dtypes=(), int_dtypes=("int32",)),
+    OpCase("bitwise_not", paddle.bitwise_not, np.invert, [S],
+           grad=False, dtypes=(), int_dtypes=("int32",)),
+    OpCase("bitwise_left_shift", paddle.bitwise_left_shift, np.left_shift,
+           [S, S], grad=False, dtypes=(), int_dtypes=("int32",)),
+    OpCase("bitwise_right_shift", paddle.bitwise_right_shift, np.right_shift,
+           [S, S], grad=False, dtypes=(), int_dtypes=("int32",)),
+    OpCase("gcd", paddle.gcd, np.gcd, [S, S], grad=False, dtypes=(),
+           int_dtypes=("int32", "int64")),
+    OpCase("lcm", paddle.lcm, np.lcm, [S, S], grad=False, dtypes=(),
+           int_dtypes=("int32",)),
+    # ---- indexing / manipulation ----
+    OpCase("gather_nd",
+           lambda x: paddle.gather_nd(x, paddle.to_tensor(_IDX2)),
+           lambda x: x[_IDX2[:, 0], _IDX2[:, 1]], [S]),
+    OpCase("repeat_interleave",
+           lambda x: paddle.repeat_interleave(x, 2, axis=0),
+           lambda x: np.repeat(x, 2, axis=0), [S]),
+    OpCase("rot90", lambda x: paddle.rot90(x),
+           lambda x: np.rot90(x), [S]),
+    OpCase("trace_sum", paddle.trace, lambda x: np.trace(x), [SQ]),
+    OpCase("diag_vec", paddle.diag, lambda x: np.diag(x), [V]),
+    OpCase("diag_embed", paddle.diag_embed,
+           lambda x: np.stack([np.diag(r) for r in x]), [S]),
+    OpCase("vander", lambda x: paddle.vander(x, 4),
+           lambda x: np.vander(x, 4), [V], dtypes=("float32",)),
+    OpCase("searchsorted",
+           lambda x, v: paddle.searchsorted(paddle.sort(x), v),
+           lambda x, v: np.searchsorted(np.sort(x), v),
+           [V, S], grad=False, dtypes=("float32",)),
+    OpCase("where_select",
+           lambda x, y: paddle.where(x > 0, x, y),
+           lambda x, y: np.where(x > 0, x, y), [S, S]),
+    OpCase("max_axis", lambda x: paddle.max(x, axis=1),
+           lambda x: np.max(x, axis=1), [S]),
+    OpCase("min_axis", lambda x: paddle.min(x, axis=1),
+           lambda x: np.min(x, axis=1), [S]),
+    OpCase("pad2d",
+           lambda x: F.pad(x, [1, 2], value=0.3),
+           lambda x: np.pad(x, ((0, 0), (1, 2)), constant_values=0.3), [S]),
+    OpCase("scale", paddle.scale,
+           lambda x, scale, bias: scale * x + bias, [S],
+           kwargs={"scale": 2.0, "bias": 0.5}),
+    # ---- special functions / stats ----
+    OpCase("erfinv", paddle.erfinv, sps.erfinv, [S], grad_atol=5e-3),
+    OpCase("i0", paddle.i0, sps.i0, [S]),
+    OpCase("i0e", paddle.i0e, sps.i0e, [S]),
+    OpCase("i1", paddle.i1, sps.i1, [S]),
+    OpCase("i1e", paddle.i1e, sps.i1e, [S]),
+    OpCase("nan_to_num", paddle.nan_to_num, np.nan_to_num, [S]),
+    OpCase("histogram",
+           lambda x: paddle.histogram(x, bins=4, min=-1.0, max=1.0),
+           lambda x: np.histogram(x, 4, (-1.0, 1.0))[0],
+           [V], grad=False, dtypes=("float32",)),
+    OpCase("bincount", paddle.bincount, np.bincount, [V], grad=False,
+           dtypes=(), int_dtypes=("int64",)),
+    OpCase("quantile",
+           lambda x: paddle.quantile(x, 0.3),
+           lambda x: np.quantile(x, 0.3), [V], grad=False,
+           dtypes=("float32",)),
+    OpCase("trapezoid",
+           lambda x: paddle.trapezoid(x, axis=-1),
+           lambda x: np.trapz(x, axis=-1), [S]),
+]
+
 _BY_NAME = {c.name: c for c in CASES}
 
 
