@@ -256,9 +256,201 @@ def transpose(x, perm, name=None):
     return _as_coo(x).transpose(perm)
 
 
+def _unary(x, fn):
+    """Apply fn to the stored values only (zeros stay zero for all ops here,
+    which is exactly the reference's sparse-unary contract)."""
+    coo = _as_coo(x)
+    out = SparseCooTensor(jsparse.BCOO(
+        (fn(coo._bcoo.data), coo._bcoo.indices), shape=tuple(coo.shape)))
+    return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) else out
+
+
+def sin(x, name=None):
+    return _unary(x, jnp.sin)
+
+
+def tan(x, name=None):
+    return _unary(x, jnp.tan)
+
+
+def asin(x, name=None):
+    return _unary(x, jnp.arcsin)
+
+
+def atan(x, name=None):
+    return _unary(x, jnp.arctan)
+
+
+def sinh(x, name=None):
+    return _unary(x, jnp.sinh)
+
+
+def tanh(x, name=None):
+    return _unary(x, jnp.tanh)
+
+
+def asinh(x, name=None):
+    return _unary(x, jnp.arcsinh)
+
+
+def atanh(x, name=None):
+    return _unary(x, jnp.arctanh)
+
+
+def sqrt(x, name=None):
+    return _unary(x, jnp.sqrt)
+
+
+def square(x, name=None):
+    return _unary(x, jnp.square)
+
+
+def log1p(x, name=None):
+    return _unary(x, jnp.log1p)
+
+
+def abs(x, name=None):
+    return _unary(x, jnp.abs)
+
+
+def pow(x, factor, name=None):
+    return _unary(x, lambda v: jnp.power(v, factor))
+
+
+def neg(x, name=None):
+    return _unary(x, jnp.negative)
+
+
+def expm1(x, name=None):
+    return _unary(x, jnp.expm1)
+
+
+def deg2rad(x, name=None):
+    return _unary(x, jnp.deg2rad)
+
+
+def rad2deg(x, name=None):
+    return _unary(x, jnp.rad2deg)
+
+
+def isnan(x, name=None):
+    return _unary(x, jnp.isnan)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    coo = _as_coo(x)
+    data = coo._bcoo.data
+    idx = coo._bcoo.indices
+    if value_dtype is not None:
+        data = data.astype(value_dtype)
+    if index_dtype is not None:
+        idx = idx.astype(index_dtype)
+    out = SparseCooTensor(jsparse.BCOO((data, idx), shape=tuple(coo.shape)))
+    return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) else out
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    from .framework.core import Tensor
+
+    dense = _as_coo(x).to_dense()
+    val = jnp.sum(dense.value if hasattr(dense, "value") else dense,
+                  axis=axis, keepdims=keepdim)
+    if dtype is not None:
+        val = val.astype(dtype)
+    return Tensor(val)
+
+
+def reshape(x, shape, name=None):
+    coo = _as_coo(x)
+    dense = coo._bcoo.todense().reshape(shape)
+    out = SparseCooTensor(jsparse.BCOO.fromdense(dense))
+    return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) else out
+
+
+def mv(x, vec, name=None):
+    """sparse matrix (2-D) x dense vector."""
+    from .framework.core import Tensor
+
+    coo = _as_coo(x)
+    v = vec.value if hasattr(vec, "value") else jnp.asarray(vec)
+    return Tensor(coo._bcoo @ v)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x @ y); x sparse, input/y dense."""
+    from .framework.core import Tensor
+
+    coo = _as_coo(x)
+    inp = input.value if hasattr(input, "value") else jnp.asarray(input)
+    yv = y.value if hasattr(y, "value") else jnp.asarray(y)
+    return Tensor(beta * inp + alpha * (coo._bcoo @ yv))
+
+
+def mask_as(x, mask, name=None):
+    """Take dense x's values at `mask`'s sparsity pattern."""
+    coo = _as_coo(mask)
+    xv = x.value if hasattr(x, "value") else jnp.asarray(x)
+    idx = coo._bcoo.indices
+    vals = xv[tuple(idx[:, d] for d in range(idx.shape[1]))]
+    out = SparseCooTensor(jsparse.BCOO((vals, idx), shape=tuple(coo.shape)))
+    return out.to_sparse_csr() if isinstance(mask, SparseCsrTensor) else out
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _unary(x, lambda v: jnp.where(v >= 0, v, negative_slope * v))
+
+
+def relu6(x, name=None):
+    return _unary(x, lambda v: jnp.clip(v, 0.0, 6.0))
+
+
+def softmax(x, axis=-1, name=None):
+    """Row-wise softmax over the STORED values (reference sparse softmax
+    semantics: implicit zeros are excluded, rows renormalize over nnz)."""
+    if axis != -1:
+        raise NotImplementedError("sparse softmax supports axis=-1")
+    coo = _as_coo(x).coalesce()
+    idx = coo._bcoo.indices
+    data = coo._bcoo.data
+    # group by all-but-last index dims: use a dense segment id
+    shape = tuple(coo.shape)
+    if len(shape) != 2:
+        raise NotImplementedError("sparse softmax implemented for 2-D")
+    row = idx[:, 0]
+    rowmax = jnp.full((shape[0],), -jnp.inf).at[row].max(data)
+    e = jnp.exp(data - rowmax[row])
+    denom = jnp.zeros((shape[0],)).at[row].add(e)
+    out = SparseCooTensor(jsparse.BCOO((e / denom[row], idx), shape=shape))
+    return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) else out
+
+
 class nn:
-    """paddle.sparse.nn subset (ReLU layer)."""
+    """paddle.sparse.nn subset (reference python/paddle/sparse/nn)."""
 
     class ReLU:
         def __call__(self, x):
             return relu(x)
+
+    class ReLU6:
+        def __call__(self, x):
+            return relu6(x)
+
+    class LeakyReLU:
+        def __init__(self, negative_slope=0.01):
+            self.negative_slope = negative_slope
+
+        def __call__(self, x):
+            return leaky_relu(x, self.negative_slope)
+
+    class Softmax:
+        def __init__(self, axis=-1):
+            self.axis = axis
+
+        def __call__(self, x):
+            return softmax(x, self.axis)
+
+    class functional:
+        relu = staticmethod(relu)
+        relu6 = staticmethod(relu6)
+        leaky_relu = staticmethod(leaky_relu)
+        softmax = staticmethod(softmax)

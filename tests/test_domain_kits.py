@@ -191,3 +191,77 @@ class TestSignal1D:
         assert back.ndim == 1
         np.testing.assert_allclose(np.asarray(back.value), x, rtol=1e-3,
                                    atol=1e-3)
+
+
+class TestSparseExtendedOps:
+    """Round-2 sparse surface: unary value ops, mv/addmm/mask_as, softmax,
+    sparse.nn layers (reference python/paddle/sparse/__all__)."""
+
+    @staticmethod
+    def _coo():
+        indices = paddle.to_tensor(np.array([[0, 1, 2], [1, 0, 2]], "int64"))
+        values = paddle.to_tensor(np.array([0.5, -1.5, 2.0], "float32"))
+        return paddle.sparse.sparse_coo_tensor(indices, values, [3, 3])
+
+    def test_unary_ops_act_on_values_only(self):
+        s = self._coo()
+        out = paddle.sparse.tanh(s)
+        assert out.nnz() == 3
+        dense = out.to_dense().numpy()
+        np.testing.assert_allclose(dense[0, 1], np.tanh(0.5), rtol=1e-6)
+        np.testing.assert_allclose(dense[0, 0], 0.0)  # zeros stay zero
+        np.testing.assert_allclose(
+            paddle.sparse.square(s).to_dense().numpy()[1, 0], 2.25)
+        np.testing.assert_allclose(
+            paddle.sparse.neg(s).to_dense().numpy()[2, 2], -2.0)
+        np.testing.assert_allclose(
+            paddle.sparse.pow(s, 2).to_dense().numpy()[2, 2], 4.0)
+
+    def test_mv_addmm(self):
+        s = self._coo()
+        v = paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32"))
+        np.testing.assert_allclose(
+            paddle.sparse.mv(s, v).numpy(),
+            s.to_dense().numpy() @ v.numpy(), rtol=1e-6)
+        inp = paddle.to_tensor(np.ones((3, 2), "float32"))
+        y = paddle.to_tensor(np.arange(6, dtype="float32").reshape(3, 2))
+        out = paddle.sparse.addmm(inp, s, y, beta=0.5, alpha=2.0)
+        np.testing.assert_allclose(
+            out.numpy(),
+            0.5 * np.ones((3, 2)) + 2.0 * (s.to_dense().numpy() @ y.numpy()),
+            rtol=1e-6)
+
+    def test_mask_as_and_sum_and_cast(self):
+        s = self._coo()
+        dense = paddle.to_tensor(np.arange(9, dtype="float32").reshape(3, 3))
+        masked = paddle.sparse.mask_as(dense, s)
+        assert masked.nnz() == 3
+        np.testing.assert_allclose(masked.to_dense().numpy()[1, 0], 3.0)
+        np.testing.assert_allclose(float(paddle.sparse.sum(s).numpy()), 1.0)
+        c = paddle.sparse.cast(s, value_dtype="float64")
+        assert "float64" in str(c.values().dtype)
+
+    def test_softmax_over_stored_values(self):
+        s = self._coo()
+        sm = paddle.sparse.softmax(s).to_dense().numpy()
+        # rows 0,1,2 each hold ONE stored value -> softmax gives 1.0 there
+        np.testing.assert_allclose(sm[0, 1], 1.0, rtol=1e-6)
+        np.testing.assert_allclose(sm[1, 0], 1.0, rtol=1e-6)
+        # two values in one row renormalize over the row's nnz
+        idx = paddle.to_tensor(np.array([[0, 0], [0, 2]], "int64"))
+        vals = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+        s2 = paddle.sparse.sparse_coo_tensor(idx, vals, [2, 3])
+        sm2 = paddle.sparse.softmax(s2).to_dense().numpy()
+        e = np.exp(np.array([1.0, 2.0]) - 2.0)
+        np.testing.assert_allclose(sm2[0, [0, 2]], e / e.sum(), rtol=1e-6)
+
+    def test_nn_layers(self):
+        s = self._coo()
+        relu_out = paddle.sparse.nn.ReLU()(s).to_dense().numpy()
+        assert relu_out[1, 0] == 0.0 and relu_out[2, 2] == 2.0
+        lk = paddle.sparse.nn.LeakyReLU(0.1)(s).to_dense().numpy()
+        np.testing.assert_allclose(lk[1, 0], -0.15, rtol=1e-6)
+        r6 = paddle.sparse.nn.ReLU6()(s).to_dense().numpy()
+        assert r6[2, 2] == 2.0
+        sm = paddle.sparse.nn.Softmax()(s)
+        assert sm.nnz() == 3
