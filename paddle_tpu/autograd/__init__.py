@@ -11,8 +11,11 @@ from .tape import (  # noqa: F401
     set_grad_enabled,
 )
 from .py_layer import PyLayer, PyLayerContext  # noqa: F401
-
-
-def hessian(func, xs, batch_axis=None):
-    """Minimal hessian via double grad."""
-    raise NotImplementedError("use paddle_tpu.incubate.autograd for functional transforms")
+from .functional import (  # noqa: F401
+    Hessian,
+    Jacobian,
+    hessian,
+    jacobian,
+    jvp,
+    vjp,
+)

@@ -1,0 +1,118 @@
+"""Functional autograd transforms: jacobian, hessian, jvp, vjp.
+
+Reference analog: python/paddle/autograd/autograd.py (jacobian/hessian lazy
+objects) and python/paddle/incubate/autograd/functional.py (jvp :33, vjp).
+TPU-first redesign: these ARE jax transforms — the user function (built from
+paddle_tpu ops, which are pure jax functions under the hood) is lifted to a
+pure function over jax values and handed to jax.jacrev / jax.jacfwd /
+jax.jvp / jax.vjp; no second autograd engine needed.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from . import tape
+
+
+def _lift(func, n_in):
+    """Pure (jax-value) version of a Tensor->Tensor function. Runs under
+    no_grad so the eager tape never sees tracer values."""
+
+    def pure(*vals):
+        # functional mode: tape recording off, but stop_gradient propagates
+        # from inputs (sg=False here), so the jax chain stays differentiable
+        with tape.functional_mode():
+            out = func(*[Tensor(v, stop_gradient=False) for v in vals])
+        if isinstance(out, (tuple, list)):
+            return tuple(o.value if isinstance(o, Tensor) else jnp.asarray(o)
+                         for o in out)
+        return out.value if isinstance(out, Tensor) else jnp.asarray(out)
+
+    return pure
+
+
+def _unpack(xs):
+    single = not isinstance(xs, (tuple, list))
+    lst = [xs] if single else list(xs)
+    return single, [x.value if isinstance(x, Tensor) else jnp.asarray(x)
+                    for x in lst]
+
+
+def _wrap(v):
+    if isinstance(v, (tuple, list)):
+        return tuple(_wrap(x) for x in v)
+    return Tensor(v)
+
+
+def jacobian(func, xs, batch_axis=None):
+    """d func(xs) / d xs (autograd.py jacobian). Returns Tensor (or tuple per
+    input); with batch_axis=0, per-sample jacobians via vmap."""
+    single, vals = _unpack(xs)
+    pure = _lift(func, len(vals))
+    jac_fn = jax.jacrev(pure, argnums=tuple(range(len(vals))))
+    if batch_axis == 0:
+        jac_fn = jax.vmap(jac_fn)
+    jacs = jac_fn(*vals)
+    # jacrev with tuple argnums returns (per-input,) possibly nested per-output
+    if single:
+        jacs = jacs[0] if isinstance(jacs, tuple) and len(jacs) == 1 else jacs
+    return _wrap(jacs)
+
+
+def hessian(func, xs, batch_axis=None):
+    """d^2 func(xs) / d xs^2 for scalar-output func (autograd.py hessian)."""
+    single, vals = _unpack(xs)
+    pure = _lift(func, len(vals))
+
+    def scalar(*vs):
+        out = pure(*vs)
+        out = out[0] if isinstance(out, tuple) else out
+        return jnp.reshape(out, ())
+
+    hess_fn = jax.hessian(scalar, argnums=tuple(range(len(vals))))
+    if batch_axis == 0:
+        hess_fn = jax.vmap(hess_fn)
+    h = hess_fn(*vals)
+    if single:
+        h = h[0][0]
+    return _wrap(h)
+
+
+def jvp(func, xs, v=None):
+    """Forward-mode: (func(xs), J @ v) (incubate/autograd functional.py:33)."""
+    single, vals = _unpack(xs)
+    if v is None:
+        tangents = [jnp.ones_like(x) for x in vals]
+    else:
+        _, tangents = _unpack(v)
+    pure = _lift(func, len(vals))
+    out, tangent_out = jax.jvp(pure, tuple(vals), tuple(tangents))
+    return _wrap(out), _wrap(tangent_out)
+
+
+def vjp(func, xs, v=None):
+    """Reverse-mode: (func(xs), v @ J) (incubate/autograd functional.py vjp)."""
+    single, vals = _unpack(xs)
+    pure = _lift(func, len(vals))
+    out, pullback = jax.vjp(pure, *vals)
+    if v is None:
+        cot = (jax.tree_util.tree_map(jnp.ones_like, out)
+               if isinstance(out, tuple) else jnp.ones_like(out))
+    else:
+        cv_single, cv = _unpack(v)
+        cot = tuple(cv) if isinstance(out, tuple) else cv[0]
+    grads = pullback(cot)
+    if single:
+        grads = grads[0]
+    return _wrap(out), _wrap(grads)
+
+
+# lazy-view classes for API parity (reference returns sliceable objects)
+def Jacobian(func, xs, is_batched=False):
+    return jacobian(func, xs, batch_axis=0 if is_batched else None)
+
+
+def Hessian(func, xs, is_batched=False):
+    return hessian(func, xs, batch_axis=0 if is_batched else None)

@@ -1,0 +1,18 @@
+"""paddle.incubate.autograd: functional transforms (incubate surface).
+
+Reference analog: python/paddle/incubate/autograd/{functional,primapi}.py.
+The jvp/vjp/Jacobian/Hessian family delegates to paddle_tpu.autograd
+.functional (jax transforms); the prim/primapi static-graph machinery is
+subsumed by jax tracing (SURVEY §2.4: prim/decomposition is n/a-by-design —
+jax.vjp re-entry covers grad-of-grad).
+"""
+from ..autograd.functional import (  # noqa: F401
+    Hessian,
+    Jacobian,
+    hessian,
+    jacobian,
+    jvp,
+    vjp,
+)
+
+__all__ = ["jvp", "vjp", "Jacobian", "Hessian", "jacobian", "hessian"]

@@ -142,3 +142,52 @@ def test_branching_graph():
     (c.sum() + d.sum()).backward()
     # d/dx (5x + 6x^2) = 5 + 12x
     np.testing.assert_allclose(x.grad.numpy(), [17.0, 29.0])
+
+
+class TestFunctionalTransforms:
+    """paddle.autograd.{jacobian,hessian,jvp,vjp} (reference autograd.py +
+    incubate/autograd/functional.py) — checked against analytic results."""
+
+    def test_jacobian_single_input(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32"))
+        J = paddle.autograd.jacobian(lambda x: (x * x).sum(), x)
+        np.testing.assert_allclose(J.numpy(), [2.0, 4.0, 6.0], rtol=1e-6)
+
+    def test_jacobian_vector_output(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+        J = paddle.autograd.jacobian(lambda x: x ** 3, x)
+        np.testing.assert_allclose(J.numpy(), np.diag([3.0, 12.0]), rtol=1e-6)
+
+    def test_hessian(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+        H = paddle.autograd.hessian(lambda x: (x ** 3).sum(), x)
+        np.testing.assert_allclose(H.numpy(), np.diag([6.0, 12.0]), rtol=1e-6)
+
+    def test_jvp_vjp_consistency(self):
+        from paddle_tpu.incubate.autograd import jvp, vjp
+
+        x = paddle.to_tensor(np.array([0.5, -1.0], "float32"))
+        v = paddle.to_tensor(np.array([1.0, 0.0], "float32"))
+        out, tangent = jvp(lambda x: paddle.sin(x), x, v)
+        np.testing.assert_allclose(out.numpy(), np.sin([0.5, -1.0]), rtol=1e-6)
+        np.testing.assert_allclose(tangent.numpy(),
+                                   [np.cos(0.5), 0.0], atol=1e-7)
+        out2, grads = vjp(lambda x: paddle.sin(x), x, v)
+        np.testing.assert_allclose(grads.numpy(),
+                                   [np.cos(0.5), 0.0], atol=1e-7)
+
+    def test_batched_jacobian(self):
+        x = paddle.to_tensor(np.ones((4, 3), "float32"))
+        J = paddle.autograd.Jacobian(lambda x: (x * 2).sum(), x,
+                                     is_batched=True)
+        assert tuple(J.shape) == (4, 3)
+        np.testing.assert_allclose(J.numpy(), 2.0)
+
+    def test_hessian_through_model_ops(self):
+        # transforms compose with the op library, not just raw arithmetic
+        w = paddle.to_tensor(np.array([[0.5], [1.5]], "float32"))
+        X = paddle.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]], "float32"))
+        H = paddle.autograd.hessian(
+            lambda w: (paddle.matmul(X, w) ** 2).sum(), w)
+        expect = 2.0 * (X.numpy().T @ X.numpy())
+        np.testing.assert_allclose(H.numpy().reshape(2, 2), expect, rtol=1e-5)
