@@ -6,3 +6,4 @@ in incubate/distributed/models/moe).
 from . import nn  # noqa: F401
 from . import distributed  # noqa: F401
 from . import autograd  # noqa: F401
+from . import asp  # noqa: F401

@@ -151,3 +151,66 @@ class TestGeometric:
         np.testing.assert_allclose(out.numpy(), [[4.0], [1.5], [0.0]])
         out.sum().backward()
         assert x.grad is not None
+
+
+class TestASP:
+    """2:4 structured sparsity (reference python/paddle/incubate/asp)."""
+
+    def test_mask_1d_validity_and_magnitude(self):
+        from paddle_tpu.incubate import asp
+
+        r = np.random.RandomState(0)
+        mat = r.randn(8, 16).astype("float32")
+        mask = asp.get_mask_1d(mat, 2, 4)
+        assert asp.check_mask_1d(mask, 2, 4)
+        assert asp.calculate_density(mask) == 0.5
+        # the kept entries are the 2 largest-|.| of each group of 4
+        groups = np.abs(mat).reshape(-1, 4)
+        kept = mask.reshape(-1, 4).astype(bool)
+        for g, k in zip(groups, kept):
+            assert set(np.argsort(g)[2:]) == set(np.flatnonzero(k))
+
+    def test_mask_2d_rows_and_cols(self):
+        from paddle_tpu.incubate import asp
+
+        r = np.random.RandomState(1)
+        mat = r.randn(8, 8).astype("float32")
+        mask = asp.get_mask_2d_best(mat, 2, 4)
+        assert asp.check_mask_2d(mask, 2, 4)
+        assert not asp.check_mask_2d(np.ones((8, 8)), 2, 4)
+
+    def test_prune_model_and_decorate_keep_sparsity(self):
+        from paddle_tpu.incubate import asp
+
+        paddle.seed(0)
+        net = paddle.nn.Sequential(paddle.nn.Linear(8, 16), paddle.nn.ReLU(),
+                                   paddle.nn.Linear(16, 4))
+        opt = asp.decorate(paddle.optimizer.SGD(
+            learning_rate=0.1, parameters=net.parameters()))
+        masks = asp.prune_model(net)
+        assert len(masks) == 2  # both Linear weights, no biases
+        for _, p in net.named_parameters():
+            if len(p.shape) == 2:
+                assert asp.check_sparsity(p.numpy(), "check_1d")
+        # train: sparsity must survive optimizer updates
+        x = paddle.to_tensor(np.random.RandomState(2)
+                             .randn(4, 8).astype("float32"))
+        for _ in range(3):
+            loss = (net(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        for _, p in net.named_parameters():
+            if len(p.shape) == 2:
+                assert asp.check_sparsity(p.numpy(), "check_1d")
+                assert asp.calculate_density(p.numpy()) <= 0.5 + 1e-6
+
+    def test_excluded_layers(self):
+        from paddle_tpu.incubate import asp
+
+        net = paddle.nn.Linear(8, 8)
+        asp.set_excluded_layers([net])
+        try:
+            assert asp.prune_model(net) == {}
+        finally:
+            asp.reset_excluded_layers()
