@@ -163,3 +163,99 @@ class features:
     MelSpectrogram = MelSpectrogram
     LogMelSpectrogram = LogMelSpectrogram
     MFCC = MFCC
+
+
+# -- backends: wav io (reference audio/backends/wave_backend.py) -------------
+class AudioInfo:
+    def __init__(self, sample_rate, num_samples, num_channels,
+                 bits_per_sample, encoding="PCM_S"):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+
+def _wav_info(filepath):
+    """backends.info (wave_backend.py:43) — stdlib wave, 16-bit PCM."""
+    import wave
+
+    with wave.open(filepath, "rb") as w:
+        return AudioInfo(w.getframerate(), w.getnframes(), w.getnchannels(),
+                         8 * w.getsampwidth())
+
+
+def _wav_load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+              channels_first=True):
+    """backends.load (wave_backend.py:95): (Tensor[C,L] or [L,C], sr)."""
+    import wave
+
+    import numpy as np
+
+    from ..framework.core import Tensor
+
+    with wave.open(filepath, "rb") as w:
+        sr, nch, width = w.getframerate(), w.getnchannels(), w.getsampwidth()
+        if width != 2:
+            raise ValueError("wave backend supports 16-bit PCM only")
+        w.setpos(frame_offset)
+        n = w.getnframes() - frame_offset if num_frames < 0 else num_frames
+        data = np.frombuffer(w.readframes(n), dtype="<i2")
+    data = data.reshape(-1, nch)
+    arr = data.astype("float32") / 32768.0 if normalize \
+        else data.astype("int16")
+    if channels_first:
+        arr = arr.T
+    return Tensor(jnp.asarray(arr)), sr
+
+
+def _wav_save(filepath, src, sample_rate, channels_first=True,
+              encoding="PCM_S", bits_per_sample=16):
+    """backends.save (wave_backend.py:174)."""
+    import wave
+
+    import numpy as np
+
+    arr = np.asarray(src.numpy() if hasattr(src, "numpy") else src)
+    if channels_first:
+        arr = arr.T  # -> (L, C)
+    if arr.dtype != np.int16:
+        arr = (np.clip(arr, -1.0, 1.0) * 32767.0).astype("<i2")
+    with wave.open(filepath, "wb") as w:
+        w.setnchannels(arr.shape[1] if arr.ndim > 1 else 1)
+        w.setsampwidth(2)
+        w.setframerate(int(sample_rate))
+        w.writeframes(arr.tobytes())
+
+
+class backends:
+    """paddle.audio.backends (wave_backend default; soundfile if installed)."""
+
+    info = staticmethod(_wav_info)
+    load = staticmethod(_wav_load)
+    save = staticmethod(_wav_save)
+
+    @staticmethod
+    def list_available_backends():
+        avail = ["wave_backend"]
+        try:
+            import soundfile  # noqa: F401
+
+            avail.append("soundfile")
+        except ImportError:
+            pass
+        return avail
+
+    @staticmethod
+    def get_current_backend():
+        return "wave_backend"
+
+    @staticmethod
+    def set_backend(name):
+        if name != "wave_backend":
+            raise ValueError("only wave_backend is available in this build")
+
+
+load = _wav_load
+save = _wav_save
+info = _wav_info

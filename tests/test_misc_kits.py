@@ -214,3 +214,27 @@ class TestASP:
             assert asp.prune_model(net) == {}
         finally:
             asp.reset_excluded_layers()
+
+
+class TestAudioBackends:
+    def test_wav_roundtrip(self, tmp_path):
+        from paddle_tpu import audio
+
+        sr = 16000
+        t = np.arange(sr // 10) / sr
+        sig = (0.5 * np.sin(2 * np.pi * 440 * t)).astype("float32")
+        stereo = np.stack([sig, -sig])  # (C, L)
+        path = str(tmp_path / "tone.wav")
+        audio.save(path, stereo, sr)
+        meta = audio.info(path)
+        assert (meta.sample_rate, meta.num_channels,
+                meta.bits_per_sample) == (sr, 2, 16)
+        out, sr2 = audio.load(path)
+        assert sr2 == sr and tuple(out.shape) == stereo.shape
+        np.testing.assert_allclose(out.numpy(), stereo, atol=2e-4)
+        # offset/limited reads
+        part, _ = audio.load(path, frame_offset=100, num_frames=50)
+        assert tuple(part.shape) == (2, 50)
+        np.testing.assert_allclose(part.numpy(), stereo[:, 100:150],
+                                   atol=2e-4)
+        assert "wave_backend" in audio.backends.list_available_backends()
