@@ -16,8 +16,12 @@ training-mode) signatures, mirroring the reference's program cache keyed on inpu
 
 GRAPH-BREAK CONTRACT (differs from the reference's SOT bytecode path, jit/sot/):
 the reference's bytecode tracer falls back to eager at unsupported Python
-constructs ("graph breaks"); here there is NO fallback — the whole function
-traces or nothing does. Concretely:
+constructs ("graph breaks"). Here the granularity is the whole function:
+with full_graph=False, a concretization error during trace marks the function
+permanently eager (one warning, correct results, no compilation) — the
+coarse-grained analog of SOT's per-frame fallback; with full_graph=True (the
+default) the same condition is a hard error naming the offending line.
+Concretely:
 
 * Python control flow on TENSOR VALUES (`if x.sum() > 0:`) does not create a
   dynamic branch: the branch taken during tracing is baked into the compiled
@@ -84,6 +88,8 @@ class StaticFunction:
         self._function = function
         self._layer = layer
         self._input_spec = input_spec
+        self._full_graph = full_graph
+        self._fallback = False  # graph-broken: permanently eager (SOT analog)
         self._cache = {}
         functools.update_wrapper(self, function)
 
@@ -155,8 +161,32 @@ class StaticFunction:
 
     # -- call ---------------------------------------------------------------
     def __call__(self, *args, **kwargs):
-        if not _TO_STATIC_STATE[0]:
+        if not _TO_STATIC_STATE[0] or self._fallback:
             return self._function(*args, **kwargs)
+        try:
+            return self._traced_call(*args, **kwargs)
+        except (jax.errors.ConcretizationTypeError,
+                jax.errors.TracerBoolConversionError,
+                jax.errors.TracerIntegerConversionError,
+                jax.errors.TracerArrayConversionError) as e:
+            # graph break: the function's Python control flow needs concrete
+            # values. With full_graph=False (the reference's SOT default) the
+            # whole call falls back to eager, permanently for this function —
+            # the coarse-grained analog of SOT's per-frame fallback.
+            if self._full_graph:
+                raise
+            import warnings
+
+            warnings.warn(
+                f"to_static: graph break in "
+                f"{getattr(self._function, '__name__', '?')} "
+                f"({type(e).__name__}); running eagerly from now on. "
+                "Use paddle.static.nn.cond / lax-style control flow, or "
+                "full_graph=True to make this an error.", stacklevel=2)
+            self._fallback = True
+            return self._function(*args, **kwargs)
+
+    def _traced_call(self, *args, **kwargs):
         if self._layer is not None:
             state_names, state_tensors = _gather_state(self._layer)
         else:

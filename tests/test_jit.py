@@ -107,3 +107,47 @@ def test_jit_save_load(tmp_path):
     loaded = paddle.jit.load(path)
     out = loaded(paddle.to_tensor(xn)).numpy()
     np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+class TestGraphBreakFallback:
+    """SOT-analog graph breaks: full_graph=False falls back to eager on
+    data-dependent Python control flow; full_graph=True (default) errors."""
+
+    def test_full_graph_false_falls_back(self):
+        calls = []
+
+        @paddle.jit.to_static(full_graph=False)
+        def f(x):
+            calls.append(1)
+            if float(x.sum()) > 0:  # data-dependent python branch
+                return x * 2
+            return x - 1
+
+        x = paddle.to_tensor(np.ones(3, "float32"))
+        with pytest.warns(UserWarning, match="graph break"):
+            out = f(x)
+        np.testing.assert_allclose(out.numpy(), 2.0)
+        out2 = f(paddle.to_tensor(-np.ones(3, "float32")))  # eager now
+        np.testing.assert_allclose(out2.numpy(), -2.0)  # branch re-evaluated
+
+    def test_full_graph_true_raises(self):
+        @paddle.jit.to_static(full_graph=True)
+        def f(x):
+            if float(x.sum()) > 0:
+                return x * 2
+            return x - 1
+
+        import jax
+
+        with pytest.raises(jax.errors.JAXTypeError):
+            f(paddle.to_tensor(np.ones(3, "float32")))
+
+    def test_clean_functions_stay_compiled(self):
+        @paddle.jit.to_static(full_graph=False)
+        def g(x):
+            return paddle.where(x > 0, x * 2, x - 1)  # traceable branch
+
+        out = g(paddle.to_tensor(np.array([1.0, -1.0], "float32")))
+        np.testing.assert_allclose(out.numpy(), [2.0, -2.0])
+        assert not g._fallback
+        assert len(g._cache) == 1
