@@ -84,3 +84,8 @@ class ViterbiDecoder(Layer):
     def forward(self, potentials, lengths):
         return viterbi_decode(potentials, self.transitions, lengths,
                               self.include_bos_eos_tag)
+
+
+# -- datasets (reference python/paddle/text/datasets/) -----------------------
+from . import text_datasets as datasets  # noqa: E402,F401
+from .text_datasets import Imdb, Imikolov, UCIHousing  # noqa: E402,F401

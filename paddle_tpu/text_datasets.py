@@ -1,0 +1,171 @@
+"""paddle.text.datasets: classic NLP benchmark dataset readers.
+
+Reference analog: python/paddle/text/datasets/{uci_housing,imikolov,imdb}.py —
+same on-disk formats (whitespace housing table, PTB-style token files, the
+aclImdb tar layout) parsed from local files; downloading is disabled in this
+build, so `data_file` (or the relevant path argument) is required.
+"""
+from __future__ import annotations
+
+import re
+import tarfile
+
+import numpy as np
+
+from .io import Dataset
+
+UCI_FEATURE_NAMES = [
+    "CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE",
+    "DIS", "RAD", "TAX", "PTRATIO", "B", "LSTAT", "convert",
+]
+
+
+def _no_download(cls, arg):
+    raise ValueError(
+        f"{cls}: automatic download is disabled in this build; pass {arg} "
+        "pointing at a local copy of the dataset")
+
+
+class UCIHousing(Dataset):
+    """Whitespace 14-column table; features min-max/avg normalized, 80/20
+    train/test split (uci_housing.py:135)."""
+
+    def __init__(self, data_file=None, mode="train", dtype="float32"):
+        if data_file is None:
+            _no_download("UCIHousing", "data_file")
+        self.mode = mode.lower()
+        self.dtype = dtype
+        data = np.fromfile(data_file, sep=" ")
+        data = data.reshape(data.shape[0] // 14, 14)
+        maxs, mins = data.max(axis=0), data.min(axis=0)
+        avgs = data.sum(axis=0) / data.shape[0]
+        for i in range(13):  # label column stays raw
+            rng = maxs[i] - mins[i]
+            data[:, i] = (data[:, i] - avgs[i]) / (rng if rng else 1.0)
+        offset = int(data.shape[0] * 0.8)
+        self.data = data[:offset] if self.mode == "train" else data[offset:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return (row[:-1].astype(self.dtype), row[-1:].astype(self.dtype))
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imikolov(Dataset):
+    """PTB-style n-gram dataset (imikolov.py): builds a frequency-cutoff word
+    dict from the train split, yields n-gram windows of word ids."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50):
+        if data_file is None:
+            _no_download("Imikolov", "data_file")
+        self.data_type = data_type.upper()
+        self.window_size = window_size
+        self.mode = mode.lower()
+        self.min_word_freq = min_word_freq
+        with tarfile.open(data_file, "r:*") as tf:
+            names = {m.name: m for m in tf.getmembers()}
+
+            def read(which):
+                for name, m in names.items():
+                    if which in name and name.endswith(".txt"):
+                        return tf.extractfile(m).read().decode().splitlines()
+                raise ValueError(f"no {which} split found in {data_file}")
+
+            train_lines = read("train")
+            lines = train_lines if self.mode == "train" else read("valid")
+        self.word_idx = self._build_dict(train_lines)
+        self.data = self._to_samples(lines)
+
+    def _build_dict(self, lines):
+        freq = {}
+        for ln in lines:
+            for w in ln.strip().split():
+                freq[w] = freq.get(w, 0) + 1
+        freq = {w: c for w, c in freq.items() if c >= self.min_word_freq}
+        freq.pop("<unk>", None)
+        ordered = sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(ordered)}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+
+    def _to_samples(self, lines):
+        unk = self.word_idx["<unk>"]
+        out = []
+        for ln in lines:
+            if self.data_type == "NGRAM":
+                toks = ["<s>"] + ln.strip().split() + ["<e>"]
+                ids = [self.word_idx.get(w, unk) for w in toks]
+                for i in range(self.window_size, len(ids) + 1):
+                    out.append(np.array(ids[i - self.window_size:i], "int64"))
+            else:  # SEQ
+                toks = ln.strip().split()
+                ids = [self.word_idx.get(w, unk) for w in toks]
+                src = [self.word_idx.get("<s>", unk)] + ids
+                trg = ids + [self.word_idx.get("<e>", unk)]
+                out.append((np.array(src, "int64"), np.array(trg, "int64")))
+        return out
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imdb(Dataset):
+    """aclImdb sentiment tarball (imdb.py): word dict from train pos/neg docs
+    with frequency cutoff, samples = (ids, label) with pos=0, neg=1."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150):
+        if data_file is None:
+            _no_download("Imdb", "data_file")
+        self.data_file = data_file
+        self.mode = mode.lower()
+        self.word_idx = self._build_word_dict(cutoff)
+        self.docs, self.labels = self._load_anno()
+
+    def _tokenize(self, pattern):
+        docs = []
+        with tarfile.open(self.data_file, "r:*") as tf:
+            for m in tf.getmembers():
+                if pattern.match(m.name):
+                    text = tf.extractfile(m).read().decode(errors="replace")
+                    docs.append(text.rstrip("\n\r").translate(
+                        str.maketrans("", "", "!\"#$%&'()*+,-./:;<=>?@[]^_`{|}~")
+                    ).lower().split())
+        return docs
+
+    def _build_word_dict(self, cutoff):
+        pattern = re.compile(r"aclImdb/train/((pos)|(neg))/.*\.txt$")
+        freq = {}
+        for doc in self._tokenize(pattern):
+            for w in doc:
+                freq[w] = freq.get(w, 0) + 1
+        freq = {w: c for w, c in freq.items() if c > cutoff}
+        ordered = sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(ordered)}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+
+    def _load_anno(self):
+        unk = self.word_idx["<unk>"]
+        docs, labels = [], []
+        for label, tag in [(0, "pos"), (1, "neg")]:
+            pattern = re.compile(rf"aclImdb/{self.mode}/{tag}/.*\.txt$")
+            for doc in self._tokenize(pattern):
+                docs.append(np.array(
+                    [self.word_idx.get(w, unk) for w in doc], "int64"))
+                labels.append(label)
+        return docs, labels
+
+    def __getitem__(self, idx):
+        return self.docs[idx], np.int64(self.labels[idx])
+
+    def __len__(self):
+        return len(self.docs)
+
+
+__all__ = ["UCIHousing", "Imikolov", "Imdb", "UCI_FEATURE_NAMES"]

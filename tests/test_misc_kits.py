@@ -238,3 +238,70 @@ class TestAudioBackends:
         np.testing.assert_allclose(part.numpy(), stereo[:, 100:150],
                                    atol=2e-4)
         assert "wave_backend" in audio.backends.list_available_backends()
+
+
+class TestTextDatasets:
+    """Text dataset parsers over synthetic local files (download disabled)."""
+
+    def test_uci_housing(self, tmp_path):
+        from paddle_tpu.text import UCIHousing
+
+        r = np.random.RandomState(0)
+        table = np.abs(r.randn(10, 14)) + 0.1
+        path = tmp_path / "housing.data"
+        path.write_text("\n".join(" ".join(f"{v:.4f}" for v in row)
+                                  for row in table))
+        train = UCIHousing(data_file=str(path), mode="train")
+        test = UCIHousing(data_file=str(path), mode="test")
+        assert len(train) == 8 and len(test) == 2
+        x, y = train[0]
+        assert x.shape == (13,) and y.shape == (1,)
+        np.testing.assert_allclose(y[0], table[0, -1], rtol=1e-3)
+
+    def test_imikolov_ngram_and_seq(self, tmp_path):
+        import tarfile
+        from paddle_tpu.text import Imikolov
+
+        text = "the cat sat on the mat\nthe dog sat on the log\n" * 5
+        path = tmp_path / "ptb.tar.gz"
+        with tarfile.open(path, "w:gz") as tf:
+            for split in ["train", "valid"]:
+                data = text.encode()
+                import io as _io
+                info = tarfile.TarInfo(f"simple/ptb.{split}.txt")
+                info.size = len(data)
+                tf.addfile(info, _io.BytesIO(data))
+        ds = Imikolov(data_file=str(path), data_type="NGRAM", window_size=3,
+                      min_word_freq=5)
+        assert len(ds) > 0
+        assert all(s.shape == (3,) for s in [ds[0], ds[1]])
+        seq = Imikolov(data_file=str(path), data_type="SEQ", min_word_freq=5)
+        src, trg = seq[0]
+        assert len(src) == len(trg)
+        # "the" is the most frequent word -> id 0
+        assert ds.word_idx["the"] == 0
+
+    def test_imdb(self, tmp_path):
+        import io as _io
+        import tarfile
+        from paddle_tpu.text import Imdb
+
+        docs = {
+            "aclImdb/train/pos/0.txt": b"a great great movie!",
+            "aclImdb/train/neg/0.txt": b"a terrible movie.",
+            "aclImdb/test/pos/0.txt": b"great fun",
+            "aclImdb/test/neg/0.txt": b"boring and terrible",
+        }
+        path = tmp_path / "aclImdb.tar.gz"
+        with tarfile.open(path, "w:gz") as tf:
+            for name, data in docs.items():
+                info = tarfile.TarInfo(name)
+                info.size = len(data)
+                tf.addfile(info, _io.BytesIO(data))
+        ds = Imdb(data_file=str(path), mode="train", cutoff=0)
+        assert len(ds) == 2
+        ids, label = ds[0]
+        assert label == 0 and ids.dtype == np.int64  # pos doc first
+        assert "great" in ds.word_idx and "movie" in ds.word_idx
+        test = Imdb(data_file=str(path), mode="test", cutoff=0)
+        assert [int(test[i][1]) for i in range(2)] == [0, 1]
