@@ -66,6 +66,8 @@ class MoELayer(Layer):
 
         if gate is None:
             gate = {"type": "gshard", "top_k": 2}
+        if isinstance(gate, str):
+            gate = {"type": gate, "top_k": 1 if gate == "switch" else 2}
         if isinstance(gate, dict):
             kind = gate.get("type", "gshard")
             topk = gate.get("top_k", 2)
