@@ -33,3 +33,30 @@ from .flash_attention import (  # noqa: F401
 )
 from ...ops.manipulation import pad  # noqa: F401
 from ...ops.math import sigmoid as _sig  # noqa: F401
+from .extras import (  # noqa: F401
+    affine_grid,
+    elu_,
+    flash_attn_qkvpacked,
+    flashmask_attention,
+    gather_tree,
+    grid_sample,
+    hardtanh_,
+    leaky_relu_,
+    log_sigmoid,
+    lp_pool1d,
+    lp_pool2d,
+    margin_cross_entropy,
+    max_unpool1d,
+    max_unpool2d,
+    multi_margin_loss,
+    npair_loss,
+    pairwise_distance,
+    rrelu,
+    sigmoid_focal_loss,
+    tanh_,
+    temporal_shift,
+    thresholded_relu_,
+    triplet_margin_with_distance_loss,
+    zeropad2d,
+)
+from ...ops.random_ops import gumbel_softmax  # noqa: F401

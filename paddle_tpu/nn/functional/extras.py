@@ -1,0 +1,385 @@
+"""Functional-surface parity batch: losses, sampling ops, pooling variants.
+
+Reference analogs (python/paddle/nn/functional/): loss.py (pairwise_distance,
+npair_loss, sigmoid_focal_loss, multi_margin_loss,
+triplet_margin_with_distance_loss, margin_cross_entropy), vision.py
+(affine_grid, grid_sample, temporal_shift), activation.py (log_sigmoid,
+rrelu, inplace aliases), common.py (zeropad2d, gather_tree), pooling.py
+(lp_pool1d/2d, max_unpool1d/2d/3d). Each implementation is a pure jax
+function behind `defop` (tape autograd + AMP + jit capture for free).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...framework import random as rng
+from ...ops._apply import defop
+from ...ops import manipulation as _manip
+
+
+# -- activations --------------------------------------------------------------
+@defop("log_sigmoid")
+def log_sigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
+    """Randomized leaky relu (activation.py rrelu): random slope per element
+    in training, the mean slope in eval."""
+    if not training:
+        slope = (lower + upper) / 2.0
+        return _rrelu_eval(x, slope=slope)
+    key = rng.next_key()
+    return _rrelu_train(x, jax.random.uniform(
+        key, tuple(x.shape), jnp.float32, lower, upper))
+
+
+@defop("rrelu_eval")
+def _rrelu_eval(x, slope=0.25):
+    return jnp.where(x >= 0, x, slope * x)
+
+
+@defop("rrelu_train")
+def _rrelu_train(x, slopes):
+    return jnp.where(x >= 0, x, slopes.astype(x.dtype) * x)
+
+
+def _inplace(fn):
+    def wrapper(x, *args, **kwargs):
+        out = fn(x, *args, **kwargs)
+        x._replace_value(out.value)
+        return x
+
+    return wrapper
+
+
+# -- losses -------------------------------------------------------------------
+@defop("pairwise_distance")
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False):
+    d = x - y + epsilon
+    return jnp.linalg.norm(d, ord=p, axis=-1, keepdims=keepdim)
+
+
+@defop("npair_loss")
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """loss.py npair_loss: CE over anchor@positive^T similarities + L2 term."""
+    labels = labels.reshape(-1)
+    eq = (labels[:, None] == labels[None, :]).astype(anchor.dtype)
+    targets = eq / jnp.sum(eq, axis=1, keepdims=True)
+    sim = anchor @ positive.T
+    logp = jax.nn.log_softmax(sim, axis=1)
+    ce = -jnp.mean(jnp.sum(targets * logp, axis=1))
+    reg = l2_reg * (jnp.sum(anchor * anchor) + jnp.sum(positive * positive)) \
+        / (2.0 * anchor.shape[0])
+    return ce + reg
+
+
+@defop("sigmoid_focal_loss")
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum"):
+    p = jax.nn.sigmoid(logit)
+    ce = jnp.logaddexp(0.0, logit) - label * logit  # bce-with-logits
+    p_t = p * label + (1 - p) * (1 - label)
+    a_t = alpha * label + (1 - alpha) * (1 - label)
+    loss = a_t * ((1 - p_t) ** gamma) * ce
+    if normalizer is not None:
+        loss = loss / normalizer
+    if reduction == "sum":
+        return jnp.sum(loss)
+    if reduction == "mean":
+        return jnp.mean(loss)
+    return loss
+
+
+@defop("multi_margin_loss")
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean"):
+    n, c = input.shape
+    target = input[jnp.arange(n), label]
+    diff = jnp.maximum(margin - target[:, None] + input, 0.0) ** p
+    if weight is not None:
+        diff = diff * weight[label][:, None]
+    diff = diff.at[jnp.arange(n), label].set(0.0)
+    loss = jnp.sum(diff, axis=1) / c
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean", name=None):
+    """loss.py triplet_margin_with_distance_loss; the distance callable runs
+    on Tensors (defaults to pairwise L2)."""
+    from ...ops import math as _m
+
+    dist = distance_function or (lambda a, b: pairwise_distance(a, b))
+    d_pos = dist(input, positive)
+    d_neg = dist(input, negative)
+    if swap:
+        d_neg = _m.minimum(d_neg, dist(positive, negative))
+    loss = _m.clip(d_pos - d_neg + margin, min=0.0)
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+@defop("margin_cross_entropy")
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, return_softmax=False, reduction="mean"):
+    """ArcFace-family margin softmax (loss.py margin_cross_entropy), single
+    process (the TP variant shards the class dim via ParallelCrossEntropy)."""
+    n = logits.shape[0]
+    cos = jnp.clip(logits, -1.0, 1.0)
+    theta = jnp.arccos(cos[jnp.arange(n), label])
+    target = jnp.cos(margin1 * theta + margin2) - margin3
+    adj = cos.at[jnp.arange(n), label].set(target) * scale
+    logp = jax.nn.log_softmax(adj, axis=1)
+    loss = -logp[jnp.arange(n), label]
+    if reduction == "mean":
+        loss = jnp.mean(loss)
+    elif reduction == "sum":
+        loss = jnp.sum(loss)
+    if return_softmax:
+        return loss, jax.nn.softmax(adj, axis=1)
+    return loss
+
+
+# -- vision geometry ----------------------------------------------------------
+@defop("affine_grid")
+def affine_grid(theta, out_shape, align_corners=True):
+    """vision.py affine_grid: (N,2,3) theta -> (N,H,W,2) sampling grid."""
+    n, _, h, w = [int(s) for s in out_shape]
+
+    def lin(size):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, size)
+        step = 2.0 / size
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size)
+
+    ys, xs = jnp.meshgrid(lin(h), lin(w), indexing="ij")
+    base = jnp.stack([xs, ys, jnp.ones_like(xs)], axis=-1)  # (H,W,3)
+    return jnp.einsum("hwk,nck->nhwc", base, theta.astype(jnp.float32)) \
+        .astype(theta.dtype)
+
+
+@defop("grid_sample")
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True):
+    """vision.py grid_sample: NCHW input, (N,Hg,Wg,2) grid in [-1,1]."""
+    n, c, h, w = x.shape
+
+    def unnorm(coord, size):
+        if align_corners:
+            return (coord + 1.0) * (size - 1) / 2.0
+        return ((coord + 1.0) * size - 1.0) / 2.0
+
+    gx = unnorm(grid[..., 0], w)
+    gy = unnorm(grid[..., 1], h)
+    if padding_mode == "border":
+        gx = jnp.clip(gx, 0, w - 1)
+        gy = jnp.clip(gy, 0, h - 1)
+    elif padding_mode == "reflection":
+        span_x = (w - 1) if align_corners else w
+        span_y = (h - 1) if align_corners else h
+        gx = jnp.abs(jnp.mod(gx + span_x, 2 * span_x) - span_x)
+        gy = jnp.abs(jnp.mod(gy + span_y, 2 * span_y) - span_y)
+
+    def gather(ix, iy):
+        ok = ((ix >= 0) & (ix < w) & (iy >= 0) & (iy < h))
+        ixc = jnp.clip(ix, 0, w - 1)
+        iyc = jnp.clip(iy, 0, h - 1)
+        vals = x[jnp.arange(n)[:, None, None], :, iyc, ixc]  # (N,Hg,Wg,C)
+        return jnp.where(ok[..., None], vals, 0.0)
+
+    if mode == "nearest":
+        out = gather(jnp.round(gx).astype(jnp.int32),
+                     jnp.round(gy).astype(jnp.int32))
+    else:
+        x0 = jnp.floor(gx).astype(jnp.int32)
+        y0 = jnp.floor(gy).astype(jnp.int32)
+        wx = gx - x0
+        wy = gy - y0
+        out = (gather(x0, y0) * ((1 - wx) * (1 - wy))[..., None]
+               + gather(x0 + 1, y0) * (wx * (1 - wy))[..., None]
+               + gather(x0, y0 + 1) * ((1 - wx) * wy)[..., None]
+               + gather(x0 + 1, y0 + 1) * (wx * wy)[..., None])
+    return jnp.transpose(out, (0, 3, 1, 2))  # back to NCHW
+
+
+@defop("temporal_shift")
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
+    """vision.py temporal_shift: shift C/4 channels one step along time."""
+    if data_format != "NCHW":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    nt, c, h, w = x.shape
+    xr = x.reshape(nt // seg_num, seg_num, c, h, w)
+    fold = int(c * shift_ratio)
+    back = jnp.concatenate(
+        [xr[:, 1:, :fold], jnp.zeros_like(xr[:, :1, :fold])], axis=1)
+    fwd = jnp.concatenate(
+        [jnp.zeros_like(xr[:, :1, fold:2 * fold]), xr[:, :-1, fold:2 * fold]],
+        axis=1)
+    out = jnp.concatenate([back, fwd, xr[:, :, 2 * fold:]], axis=2)
+    out = out.reshape(nt, c, h, w)
+    if data_format != "NCHW":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return out
+
+
+# -- padding / beam search ----------------------------------------------------
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    """common.py zeropad2d: [left, right, top, bottom] zeros on H/W."""
+    return _manip.pad(x, list(padding), mode="constant", value=0.0,
+                      data_format=data_format)
+
+
+@defop("gather_tree", differentiable=False)
+def gather_tree(ids, parents):
+    """common.py gather_tree: backtrack beam-search parent pointers.
+    ids/parents: (max_time, batch, beam)."""
+    T = ids.shape[0]
+
+    def step(beams, t):
+        # beams: (batch, beam) selected beam index at time t+1
+        out = jnp.take_along_axis(ids[t], beams, axis=1)
+        prev = jnp.take_along_axis(parents[t], beams, axis=1)
+        return prev, out
+
+    init = jnp.broadcast_to(jnp.arange(ids.shape[2]),
+                            ids.shape[1:]).astype(ids.dtype)
+    _, rev = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+    return rev[::-1]
+
+
+# -- pooling variants ---------------------------------------------------------
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, name=None):
+    from .pooling import avg_pool1d
+
+    p = float(norm_type)
+    powed = (x.abs() ** p)
+    pooled = avg_pool1d(powed, kernel_size, stride=stride, padding=padding,
+                        ceil_mode=ceil_mode, exclusive=False)
+    k = kernel_size if isinstance(kernel_size, int) else int(
+        np.prod(kernel_size))
+    return (pooled * float(k)) ** (1.0 / p)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    from .pooling import avg_pool2d
+
+    p = float(norm_type)
+    powed = (x.abs() ** p)
+    pooled = avg_pool2d(powed, kernel_size, stride=stride, padding=padding,
+                        ceil_mode=ceil_mode, exclusive=False,
+                        data_format=data_format)
+    ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+        else kernel_size
+    return (pooled * float(np.prod(ks))) ** (1.0 / p)
+
+
+@defop("max_unpool2d_inner")
+def _max_unpool2d_inner(x, mask, out_h, out_w):
+    n, c, h, w = x.shape
+    flat = x.reshape(n, c, h * w)
+    idx = mask.reshape(n, c, h * w)
+    out = jnp.zeros((n, c, out_h * out_w), x.dtype)
+    out = out.at[jnp.arange(n)[:, None, None], jnp.arange(c)[None, :, None],
+                 idx].set(flat)
+    return out.reshape(n, c, out_h, out_w)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW", name=None):
+    """pooling.py max_unpool2d: scatter pooled values to their argmax sites
+    (indices from max_pool2d(return_mask=True))."""
+    ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+        else tuple(kernel_size)
+    st = ks if stride is None else (
+        (stride, stride) if isinstance(stride, int) else tuple(stride))
+    n, c, h, w = x.shape
+    if output_size is None:
+        out_h = (h - 1) * st[0] + ks[0] - 2 * (
+            padding if isinstance(padding, int) else padding[0])
+        out_w = (w - 1) * st[1] + ks[1] - 2 * (
+            padding if isinstance(padding, int) else padding[1])
+    else:
+        out_h, out_w = [int(s) for s in output_size[-2:]]
+    return _max_unpool2d_inner(x, indices, out_h, out_w)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, name=None):
+    from ...ops import manipulation as m
+
+    x4 = m.unsqueeze(x, -1)
+    i4 = m.unsqueeze(indices, -1)
+    out_size = None if output_size is None else list(output_size[-1:]) + [1]
+    out = max_unpool2d(x4, i4, (kernel_size, 1),
+                       stride=(stride or kernel_size, 1),
+                       padding=(padding, 0) if isinstance(padding, int)
+                       else padding, output_size=out_size)
+    return m.squeeze(out, -1)
+
+
+# -- flash attention wrappers -------------------------------------------------
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False, return_softmax=False,
+                         training=True, name=None):
+    """flash_attention.py flash_attn_qkvpacked: (B,S,3,H,D) packed input."""
+    from .flash_attention import flash_attention
+
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]
+    return flash_attention(q, k, v, dropout=dropout, causal=causal,
+                           return_softmax=return_softmax, training=training)
+
+
+def flashmask_attention(query, key, value, startend_row_indices=None,
+                        dropout=0.0, causal=False, name=None):
+    """flash_attention.py flashmask_attention — served by the sdp dispatcher
+    (the sparse row-index mask becomes a dense additive mask)."""
+    from .flash_attention import scaled_dot_product_attention
+
+    return scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                        dropout_p=dropout, is_causal=causal)
+
+
+# -- inplace aliases (activation.py *_ variants) ------------------------------
+def elu_(x, alpha=1.0, name=None):
+    from . import elu
+
+    return _inplace(elu)(x, alpha)
+
+
+def tanh_(x, name=None):
+    from ...ops.math import tanh
+
+    return _inplace(tanh)(x)
+
+
+def leaky_relu_(x, negative_slope=0.01, name=None):
+    from .activation import leaky_relu
+
+    return _inplace(leaky_relu)(x, negative_slope)
+
+
+def hardtanh_(x, min=-1.0, max=1.0, name=None):  # noqa: A002
+    from . import hardtanh
+
+    return _inplace(hardtanh)(x, min, max)
+
+
+def thresholded_relu_(x, threshold=1.0, value=0.0, name=None):
+    from .activation import thresholded_relu
+
+    return _inplace(thresholded_relu)(x, threshold, value)

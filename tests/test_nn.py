@@ -188,3 +188,131 @@ def test_param_freeze_and_hooks():
     y.sum().backward()
     assert calls == [1]
     assert l.bias.grad is None and l.weight.grad is not None
+
+
+class TestFunctionalExtras:
+    """Round-2 functional parity batch (loss/vision/pooling extras)."""
+
+    def test_grid_sample_identity_and_shift(self):
+        r = np.random.RandomState(0)
+        x = paddle.to_tensor(r.randn(2, 3, 5, 5).astype("float32"))
+        theta = paddle.to_tensor(
+            np.tile(np.array([[[1, 0, 0], [0, 1, 0]]], "float32"), (2, 1, 1)))
+        grid = F.affine_grid(theta, [2, 3, 5, 5])
+        out = F.grid_sample(x, grid)
+        np.testing.assert_allclose(out.numpy(), x.numpy(), atol=1e-5)
+        # nearest mode on a half-pixel shifted grid picks neighbors
+        out_n = F.grid_sample(x, grid, mode="nearest")
+        np.testing.assert_allclose(out_n.numpy(), x.numpy(), atol=1e-5)
+
+    def test_grid_sample_grad_flows(self):
+        x = paddle.to_tensor(np.ones((1, 1, 4, 4), "float32"),
+                             stop_gradient=False)
+        theta = paddle.to_tensor(
+            np.array([[[1, 0, 0.2], [0, 1, -0.1]]], "float32"))
+        out = F.grid_sample(x, F.affine_grid(theta, [1, 1, 4, 4]))
+        out.sum().backward()
+        assert x.grad is not None and float(x.grad.numpy().sum()) > 0
+
+    def test_losses_match_manual(self):
+        r = np.random.RandomState(1)
+        a = r.randn(4, 8).astype("float32")
+        b = r.randn(4, 8).astype("float32")
+        pd = F.pairwise_distance(paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(
+            pd.numpy(), np.linalg.norm(a - b + 1e-6, axis=-1), rtol=1e-5)
+        logit = r.randn(4, 3).astype("float32")
+        label = (r.rand(4, 3) > 0.5).astype("float32")
+        fl = F.sigmoid_focal_loss(paddle.to_tensor(logit),
+                                  paddle.to_tensor(label))
+        p = 1 / (1 + np.exp(-logit))
+        ce = np.logaddexp(0, logit) - label * logit
+        pt = p * label + (1 - p) * (1 - label)
+        at = 0.25 * label + 0.75 * (1 - label)
+        np.testing.assert_allclose(float(fl.numpy()),
+                                   (at * (1 - pt) ** 2 * ce).sum(), rtol=1e-5)
+
+    def test_multi_margin_and_triplet(self):
+        inp = paddle.to_tensor(np.array([[0.1, 0.9, 0.2],
+                                         [0.8, 0.1, 0.3]], "float32"))
+        lab = paddle.to_tensor(np.array([1, 0], "int64"))
+        mm = F.multi_margin_loss(inp, lab)
+        assert float(mm.numpy()) > 0
+        r = np.random.RandomState(2)
+        anc, pos, neg = (paddle.to_tensor(r.randn(3, 4).astype("float32"))
+                         for _ in range(3))
+        tl = F.triplet_margin_with_distance_loss(anc, pos, neg, margin=0.5)
+        assert tl.numpy().shape == ()
+
+    def test_margin_cross_entropy_reduces_target_logit(self):
+        r = np.random.RandomState(3)
+        logits = paddle.to_tensor(
+            (r.rand(4, 6).astype("float32") * 1.6 - 0.8))
+        label = paddle.to_tensor(np.array([0, 1, 2, 3], "int64"))
+        plain = F.cross_entropy(logits * 64.0, label)
+        marg = F.margin_cross_entropy(logits, label)
+        assert float(marg.numpy()) > float(plain.numpy())  # margin adds loss
+
+    def test_lp_pool_equals_norm(self):
+        x = paddle.to_tensor(np.abs(np.random.RandomState(4)
+                                    .randn(1, 2, 4, 4)).astype("float32"))
+        out = F.lp_pool2d(x, norm_type=2, kernel_size=2)
+        manual = np.sqrt((x.numpy() ** 2).reshape(1, 2, 2, 2, 2, 2)
+                         .transpose(0, 1, 2, 4, 3, 5).sum(axis=(4, 5)))
+        np.testing.assert_allclose(out.numpy(), manual, rtol=1e-5)
+
+    def test_max_unpool2d_roundtrip(self):
+        r = np.random.RandomState(5)
+        x = paddle.to_tensor(r.randn(1, 2, 4, 4).astype("float32"))
+        pooled, mask = F.max_pool2d(x, 2, return_mask=True)
+        un = F.max_unpool2d(pooled, mask, 2)
+        assert tuple(un.shape) == (1, 2, 4, 4)
+        # every pooled max lands back at its argmax site; rest zeros
+        assert np.count_nonzero(un.numpy()) == pooled.numpy().size
+        np.testing.assert_allclose(un.numpy().max(), x.numpy().max())
+
+    def test_temporal_shift_moves_channels(self):
+        x = paddle.to_tensor(np.arange(2 * 4 * 2 * 2, dtype="float32")
+                             .reshape(2, 4, 2, 2))
+        out = F.temporal_shift(x, seg_num=2, shift_ratio=0.25)
+        assert tuple(out.shape) == (2, 4, 2, 2)
+        # first channel shifted backward: frame0 takes frame1's values
+        np.testing.assert_allclose(out.numpy()[0, 0], x.numpy()[1, 0])
+        np.testing.assert_allclose(out.numpy()[1, 0], 0.0)
+
+    def test_zeropad2d_and_gather_tree(self):
+        x = paddle.to_tensor(np.ones((1, 1, 2, 2), "float32"))
+        padded = F.zeropad2d(x, [1, 0, 0, 1])
+        assert tuple(padded.shape) == (1, 1, 3, 3)
+        assert float(padded.numpy()[0, 0, 2, 0]) == 0.0
+        ids = paddle.to_tensor(np.array(
+            [[[2, 5]], [[6, 3]], [[1, 9]]], "int64"))      # (T=3, B=1, beam=2)
+        parents = paddle.to_tensor(np.array(
+            [[[0, 0]], [[1, 0]], [[1, 0]]], "int64"))
+        out = F.gather_tree(ids, parents)
+        assert tuple(out.shape) == (3, 1, 2)
+        # beam 0 at t=2: token 1, parent beam 1 -> t=1 token 3, whose parent
+        # beam 0 -> t=0 token 2
+        np.testing.assert_array_equal(out.numpy()[:, 0, 0], [2, 3, 1])
+
+    def test_inplace_aliases(self):
+        x = paddle.to_tensor(np.array([-1.0, 1.0], "float32"))
+        y = F.tanh_(x)
+        assert y is x
+        np.testing.assert_allclose(x.numpy(), np.tanh([-1.0, 1.0]), rtol=1e-6)
+        z = paddle.to_tensor(np.array([-2.0, 2.0], "float32"))
+        F.hardtanh_(z)
+        np.testing.assert_allclose(z.numpy(), [-1.0, 1.0])
+
+    def test_rrelu_and_qkvpacked(self):
+        x = paddle.to_tensor(np.array([-4.0, 4.0], "float32"))
+        ev = F.rrelu(x, training=False)
+        np.testing.assert_allclose(ev.numpy(),
+                                   [-4.0 * (1 / 8 + 1 / 3) / 2, 4.0],
+                                   rtol=1e-6)
+        tr = F.rrelu(x, training=True).numpy()
+        assert -4.0 / 3 - 1e-6 <= tr[0] <= -4.0 / 8 + 1e-6 and tr[1] == 4.0
+        r = np.random.RandomState(6)
+        qkv = paddle.to_tensor(r.randn(2, 8, 3, 2, 16).astype("float32"))
+        out, _ = F.flash_attn_qkvpacked(qkv, causal=True)
+        assert tuple(out.shape) == (2, 8, 2, 16)
