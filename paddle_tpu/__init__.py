@@ -37,6 +37,16 @@ from .framework.core import Parameter, Tensor, to_tensor  # noqa: F401,E402
 from .framework.flags import get_flags, set_flags  # noqa: F401,E402
 from .framework import random as _random  # noqa: E402
 from .framework.random import get_rng_state, set_rng_state  # noqa: F401,E402
+
+bool = bool_  # noqa: A001  (reference exports the dtype as paddle.bool)
+dtype = _dtype_mod.convert_dtype  # dtype constructor (paddle.dtype('float32'))
+# CUDA rng-state APIs map onto the single global threefry state
+get_cuda_rng_state = get_rng_state
+set_cuda_rng_state = set_rng_state
+try:  # fp8 dtypes exist on current jax; keep optional
+    from jax.numpy import float8_e4m3fn, float8_e5m2  # noqa: F401,E402
+except ImportError:
+    pass
 from .autograd import enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401,E402
 from .ops import *  # noqa: F401,F403,E402
 from .ops import (  # noqa: F401,E402  (names shadowed by python builtins in *)
@@ -162,3 +172,72 @@ CUDAPlace = TPUPlace  # alias so reference-style code keeps running on TPU
 CustomPlace = TPUPlace
 
 __version__ = "0.1.0"
+CUDAPinnedPlace = CPUPlace  # pinned host staging == host memory here
+
+from .distributed.parallel import DataParallel  # noqa: F401,E402
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """paddle.create_parameter (tensor/creation.py): a trainable Parameter
+    via the same attr/initializer pipeline as Layer.create_parameter."""
+    from .nn.layer.layers import Layer
+
+    holder = Layer()
+    holder._dtype = dtype
+    p = holder.create_parameter(list(shape), attr=attr, dtype=dtype,
+                                is_bias=is_bias,
+                                default_initializer=default_initializer)
+    if name is not None and p is not None:
+        p.name = name
+    return p
+
+
+def reduce_as(x, target, name=None):
+    """Sum x over leading/broadcast axes until it matches target's shape."""
+    from .ops import reduction as _red
+
+    xs, ts = list(x.shape), list(target.shape)
+    while len(xs) > len(ts):
+        x = _red.sum(x, axis=0)
+        xs = list(x.shape)
+    axes = [i for i, (a, b) in enumerate(zip(xs, ts)) if a != b and b == 1]
+    if axes:
+        x = _red.sum(x, axis=axes, keepdim=True)
+    return x
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Legacy reader combinator (paddle.batch): groups samples into lists."""
+
+    def batched():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched
+
+
+class LazyGuard:
+    """paddle.LazyGuard: the reference delays parameter materialization; this
+    build initializes eagerly (PJRT buffers are cheap on host), so the guard
+    is a transparent context that exists for API compatibility."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Model FLOPs estimate by forward hooks (hapi/dynamic_flops.py)."""
+    from .hapi.flops_counter import count_flops
+
+    return count_flops(net, input_size, custom_ops=custom_ops,
+                       print_detail=print_detail)

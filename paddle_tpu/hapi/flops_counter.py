@@ -1,0 +1,97 @@
+"""Model FLOPs estimation via forward hooks.
+
+Reference analog: python/paddle/hapi/dynamic_flops.py (flops(net, input_size)
+— per-layer multiply-add counting through registered forward hooks, with a
+custom_ops override table keyed by layer class).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _numel(shape):
+    return int(np.prod([int(s) for s in shape])) if len(shape) else 1
+
+
+def _count_linear(layer, inputs, output):
+    in_f = int(layer.weight.shape[0])
+    return _numel(output.shape) * in_f
+
+
+def _count_conv(layer, inputs, output):
+    w = layer.weight
+    kernel = _numel(w.shape[2:]) if len(w.shape) > 2 else 1
+    cin = int(w.shape[1])
+    groups = int(getattr(layer, "_groups", 1) or 1)
+    return _numel(output.shape) * cin * kernel // max(groups, 1)
+
+
+def _count_norm(layer, inputs, output):
+    return 2 * _numel(output.shape)
+
+
+def _count_act(layer, inputs, output):
+    return _numel(output.shape)
+
+
+_DEFAULT_COUNTERS = {
+    "Linear": _count_linear,
+    "Conv1D": _count_conv,
+    "Conv2D": _count_conv,
+    "Conv3D": _count_conv,
+    "Conv2DTranspose": _count_conv,
+    "BatchNorm": _count_norm, "BatchNorm1D": _count_norm,
+    "BatchNorm2D": _count_norm, "BatchNorm3D": _count_norm,
+    "LayerNorm": _count_norm, "GroupNorm": _count_norm,
+    "ReLU": _count_act, "GELU": _count_act, "Sigmoid": _count_act,
+    "Tanh": _count_act, "Softmax": _count_act,
+    "AvgPool2D": _count_act, "MaxPool2D": _count_act,
+    "AdaptiveAvgPool2D": _count_act,
+}
+
+
+def count_flops(net, input_size, custom_ops=None, print_detail=False):
+    """FLOPs (multiply-adds x2) for one forward at `input_size`."""
+    import jax.numpy as jnp
+
+    from ..framework.core import Tensor
+
+    # activation layer classes are generated with lowercase names ("relu")
+    counters = {k.lower(): v for k, v in _DEFAULT_COUNTERS.items()}
+    for cls, fn in (custom_ops or {}).items():
+        counters[(cls if isinstance(cls, str) else cls.__name__).lower()] = fn
+
+    totals = {}
+    handles = []
+
+    def make_hook(name, counter, layer_ref):
+        def hook(layer, inputs, output):
+            out = output[0] if isinstance(output, (tuple, list)) else output
+            totals[name] = totals.get(name, 0) + 2 * int(
+                counter(layer, inputs, out))
+
+        return hook
+
+    for name, layer in net.named_sublayers(include_self=True):
+        counter = counters.get(type(layer).__name__.lower())
+        if counter is not None:
+            handles.append(layer.register_forward_post_hook(
+                make_hook(name or type(layer).__name__, counter, layer)))
+
+    was_training = net.training
+    net.eval()
+    try:
+        x = Tensor(jnp.zeros([int(s) for s in input_size], jnp.float32))
+        net(x)
+    finally:
+        if was_training:
+            net.train()
+        for h in handles:
+            h.remove()
+
+    total = sum(totals.values())
+    if print_detail:
+        for name, v in sorted(totals.items(), key=lambda kv: -kv[1]):
+            print(f"  {name:<40s} {v:>14,d} FLOPs")
+        print(f"Total FLOPs: {total:,d} ({total / 1e9:.4f} GFLOPs)")
+    return total

@@ -189,3 +189,29 @@ Tensor.__or__ = lambda self, o: (
 Tensor.__xor__ = lambda self, o: (
     bitwise_xor(self, o) if self.dtype != _np.dtype(_np.bool_) else logical_xor(self, o)
 )
+
+from . import compat as _compat  # noqa: E402
+from .compat import (  # noqa: F401
+    add_n, as_complex, as_real, binomial, block_diag, cartesian_prod, cdist,
+    column_stack, combinations, cumulative_trapezoid, diagonal_scatter,
+    dsplit, dstack, frexp, from_dlpack, gammainc, gammaincc, gammaln,
+    histogram_bin_edges, hsplit, hstack, is_empty, isin, isneginf, isposinf,
+    isreal, log_normal, matrix_transpose, multigammaln, pdist, polygamma,
+    positive, renorm, reverse, row_stack, select_scatter, set_printoptions,
+    sgn, signbit, sinc, slice_scatter, standard_gamma, take, tensordot,
+    to_dlpack, tolist, unflatten, unfold, vecdot, vsplit, vstack,
+)
+
+bitwise_invert = bitwise_not  # noqa: F405  (reference alias)
+bitwise_invert_ = None  # rebound below by the inplace generator
+
+_generated_inplace = _compat._install_inplace(globals())
+globals().update(_generated_inplace)
+bitwise_invert_ = globals()["bitwise_not_"]
+
+# numeric constants + dtype aliases (python/paddle/__init__ exports these)
+pi = 3.141592653589793
+e = 2.718281828459045
+inf = float("inf")
+nan = float("nan")
+newaxis = None

@@ -122,3 +122,124 @@ def test_einsum():
     b = np.random.rand(4, 5).astype(np.float32)
     out = paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(b))
     np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+
+
+class TestCompatSurface:
+    """Round-2 top-level parity batch (ops/compat.py)."""
+
+    def test_stacking_family(self):
+        a = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+        b = paddle.to_tensor(np.array([3.0, 4.0], "float32"))
+        np.testing.assert_allclose(paddle.hstack([a, b]).numpy(),
+                                   [1, 2, 3, 4])
+        assert tuple(paddle.vstack([a, b]).shape) == (2, 2)
+        assert tuple(paddle.column_stack([a, b]).shape) == (2, 2)
+        assert tuple(paddle.dstack([a, b]).shape) == (1, 2, 2)
+        m = paddle.ones([2, 4])
+        assert len(paddle.hsplit(m, 2)) == 2
+        assert len(paddle.vsplit(m, 2)) == 2
+        bd = paddle.block_diag([paddle.ones([2, 2]), paddle.ones([1, 1])])
+        assert tuple(bd.shape) == (3, 3) and float(bd.numpy()[2, 0]) == 0.0
+
+    def test_scatter_views(self):
+        x = paddle.zeros([3, 3])
+        y = paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32"))
+        d = paddle.diagonal_scatter(x, y)
+        np.testing.assert_allclose(d.numpy(), np.diag([1, 2, 3]))
+        s = paddle.select_scatter(x, y, axis=0, index=1)
+        np.testing.assert_allclose(s.numpy()[1], [1, 2, 3])
+        sl = paddle.slice_scatter(x, paddle.ones([3, 1]), axes=[1],
+                                  starts=[2], ends=[3], strides=[1])
+        np.testing.assert_allclose(sl.numpy()[:, 2], 1.0)
+
+    def test_math_family(self):
+        x = paddle.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]], "float32"))
+        np.testing.assert_allclose(
+            paddle.tensordot(x, x, axes=1).numpy(), x.numpy() @ x.numpy(),
+            rtol=1e-6)
+        np.testing.assert_allclose(
+            paddle.vecdot(x, x).numpy(), (x.numpy() ** 2).sum(-1), rtol=1e-6)
+        c = paddle.cdist(x, x)
+        assert float(c.numpy()[0, 0]) < 1e-4
+        np.testing.assert_allclose(
+            c.numpy()[0, 1], np.sqrt(8.0), rtol=1e-5)
+        np.testing.assert_allclose(paddle.pdist(x).numpy(), [np.sqrt(8.0)],
+                                   rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.sgn(paddle.to_tensor(np.array([-3.0, 0.0, 5.0],
+                                                 "float32"))).numpy(),
+            [-1, 0, 1])
+        assert bool(paddle.signbit(
+            paddle.to_tensor(np.float32(-0.0))).numpy())
+        m, e = paddle.frexp(paddle.to_tensor(np.array([8.0], "float32")))
+        np.testing.assert_allclose(m.numpy() * 2.0 ** e.numpy(), 8.0)
+        r = paddle.renorm(paddle.ones([2, 4]), p=2.0, axis=0, max_norm=1.0)
+        np.testing.assert_allclose(np.linalg.norm(r.numpy(), axis=1), 1.0,
+                                   rtol=1e-5)
+
+    def test_special_functions(self):
+        import scipy.special as sps
+
+        x = paddle.to_tensor(np.array([1.5, 2.5], "float32"))
+        np.testing.assert_allclose(paddle.gammaln(x).numpy(),
+                                   sps.gammaln([1.5, 2.5]), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.gammainc(x, x).numpy(), sps.gammainc([1.5, 2.5],
+                                                        [1.5, 2.5]),
+            rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.multigammaln(x, 2).numpy(),
+            [sps.multigammaln(v, 2) for v in [1.5, 2.5]], rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.polygamma(x, 1).numpy(), sps.polygamma(1, [1.5, 2.5]),
+            rtol=1e-4)
+
+    def test_take_unflatten_unfold(self):
+        x = paddle.to_tensor(np.arange(12, dtype="float32").reshape(3, 4))
+        np.testing.assert_allclose(
+            paddle.take(x, paddle.to_tensor(np.array([0, 5, -1]))).numpy(),
+            [0, 5, 11])
+        u = paddle.unflatten(x, 1, [2, 2])
+        assert tuple(u.shape) == (3, 2, 2)
+        w = paddle.unfold(paddle.arange(5, dtype="float32"), 0, 3, 1)
+        assert tuple(w.shape) == (3, 3)
+        np.testing.assert_allclose(w.numpy()[1], [1, 2, 3])
+
+    def test_complex_views_and_sampling(self):
+        x = paddle.to_tensor(np.array([[1.0, 2.0]], "float32"))
+        z = paddle.as_complex(x)
+        np.testing.assert_allclose(z.numpy(), [1 + 2j])
+        back = paddle.as_real(z)
+        np.testing.assert_allclose(back.numpy(), x.numpy())
+        paddle.seed(0)
+        g = paddle.standard_gamma(paddle.full([1000], 3.0))
+        assert abs(float(g.numpy().mean()) - 3.0) < 0.3
+        b = paddle.binomial(paddle.full([1000], 10.0),
+                            paddle.full([1000], 0.5))
+        assert abs(float(b.numpy().mean()) - 5.0) < 0.5
+
+    def test_inplace_generated_family(self):
+        x = paddle.to_tensor(np.array([4.0], "float32"))
+        y = paddle.log_(x)
+        assert y is x
+        np.testing.assert_allclose(x.numpy(), np.log(4.0), rtol=1e-6)
+        z = paddle.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]], "float32"))
+        paddle.tril_(z)
+        np.testing.assert_allclose(z.numpy(), [[1, 0], [3, 4]])
+        w = paddle.to_tensor(np.array([1, 2], "int32"))
+        paddle.bitwise_invert_(w)
+        np.testing.assert_array_equal(w.numpy(), [-2, -3])
+
+    def test_constants_and_misc(self):
+        assert abs(paddle.pi - np.pi) < 1e-12
+        assert paddle.inf == float("inf") and np.isnan(paddle.nan)
+        assert paddle.newaxis is None
+        assert not bool(paddle.is_empty(paddle.ones([2])).numpy())
+        assert bool(paddle.is_empty(paddle.ones([0, 2])).numpy())
+        reader = paddle.batch(lambda: iter(range(5)), batch_size=2)
+        assert [len(b) for b in reader()] == [2, 2, 1]
+        with paddle.LazyGuard():
+            lin = paddle.nn.Linear(2, 2)
+        assert lin.weight is not None
+        n = paddle.flops(paddle.nn.Linear(8, 4), [2, 8])
+        assert n == 2 * 2 * 4 * 8
