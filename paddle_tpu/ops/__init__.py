@@ -215,3 +215,16 @@ e = 2.718281828459045
 inf = float("inf")
 nan = float("nan")
 newaxis = None
+
+# patch the compat batch onto Tensor as methods (math_op_patch analog)
+_COMPAT_METHODS = [
+    "as_complex", "as_real", "cdist", "diagonal_scatter", "frexp",
+    "gammainc", "gammaincc", "gammaln", "isin", "isneginf", "isposinf",
+    "isreal", "matrix_transpose", "multigammaln", "pdist", "polygamma",
+    "renorm", "select_scatter", "sgn", "signbit", "sinc", "slice_scatter",
+    "take", "tensordot", "tolist", "unflatten", "unfold", "vecdot",
+] + sorted(_generated_inplace)
+for _name in _COMPAT_METHODS:
+    if _name in globals() and not hasattr(Tensor, _name):
+        setattr(Tensor, _name, globals()[_name])
+del _name

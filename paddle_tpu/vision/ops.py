@@ -214,3 +214,306 @@ def box_iou(boxes1, boxes2):
     yy2 = jnp.minimum(b1[:, None, 3], b2[None, :, 3])
     inter = jnp.clip(xx2 - xx1, 0) * jnp.clip(yy2 - yy1, 0)
     return Tensor(inter / (a1[:, None] + a2[None, :] - inter + 1e-10))
+
+
+# -- round-2 detection batch --------------------------------------------------
+@defop("vision.box_coder")
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, axis=0):
+    """ops.py box_coder: encode/decode boxes against priors (SSD-family)."""
+    pw = prior_box[:, 2] - prior_box[:, 0] + (0 if box_normalized else 1)
+    ph = prior_box[:, 3] - prior_box[:, 1] + (0 if box_normalized else 1)
+    pcx = prior_box[:, 0] + pw * 0.5
+    pcy = prior_box[:, 1] + ph * 0.5
+    if prior_box_var is None:
+        var = jnp.ones((1, 4), target_box.dtype)
+    elif jnp.ndim(prior_box_var) == 1:
+        var = jnp.reshape(prior_box_var, (1, 4))
+    else:
+        var = prior_box_var
+    if code_type == "encode_center_size":
+        tw = target_box[:, 2] - target_box[:, 0] + (0 if box_normalized else 1)
+        th = target_box[:, 3] - target_box[:, 1] + (0 if box_normalized else 1)
+        tcx = target_box[:, 0] + tw * 0.5
+        tcy = target_box[:, 1] + th * 0.5
+        out = jnp.stack([
+            (tcx[:, None] - pcx[None, :]) / pw[None, :],
+            (tcy[:, None] - pcy[None, :]) / ph[None, :],
+            jnp.log(tw[:, None] / pw[None, :]),
+            jnp.log(th[:, None] / ph[None, :]),
+        ], axis=-1) / var[None, :, :] if var.shape[0] != 1 else jnp.stack([
+            (tcx[:, None] - pcx[None, :]) / pw[None, :] / var[0, 0],
+            (tcy[:, None] - pcy[None, :]) / ph[None, :] / var[0, 1],
+            jnp.log(tw[:, None] / pw[None, :]) / var[0, 2],
+            jnp.log(th[:, None] / ph[None, :]) / var[0, 3],
+        ], axis=-1)
+        return out
+    # decode_center_size: target_box (N, M, 4) deltas against priors on `axis`
+    t = target_box
+    if axis == 0:
+        pcx_, pcy_, pw_, ph_ = (v[None, :] for v in (pcx, pcy, pw, ph))
+        v0, v1, v2, v3 = var[None, :, 0], var[None, :, 1], var[None, :, 2], \
+            var[None, :, 3]
+    else:
+        pcx_, pcy_, pw_, ph_ = (v[:, None] for v in (pcx, pcy, pw, ph))
+        v0, v1, v2, v3 = var[:, None, 0], var[:, None, 1], var[:, None, 2], \
+            var[:, None, 3]
+    cx = v0 * t[..., 0] * pw_ + pcx_
+    cy = v1 * t[..., 1] * ph_ + pcy_
+    w = jnp.exp(v2 * t[..., 2]) * pw_
+    h = jnp.exp(v3 * t[..., 3]) * ph_
+    norm = 0.0 if box_normalized else 1.0
+    return jnp.stack([cx - w / 2, cy - h / 2,
+                      cx + w / 2 - norm, cy + h / 2 - norm], axis=-1)
+
+
+@defop("vision.prior_box", differentiable=False)
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False):
+    """ops.py prior_box: SSD anchor generation over the feature map grid."""
+    fh, fw = input.shape[2], input.shape[3]
+    ih, iw = image.shape[2], image.shape[3]
+    step_w = steps[0] or float(iw) / fw
+    step_h = steps[1] or float(ih) / fh
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    boxes = []
+    for ms in min_sizes:
+        ms = float(ms)
+        for ar in ars:
+            boxes.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        if max_sizes:
+            pass
+    if max_sizes:
+        for ms, mx in zip(min_sizes, max_sizes):
+            boxes.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+    wh = jnp.asarray(np.array(boxes, "float32"))  # (A, 2)
+    cx = (jnp.arange(fw) + offset) * step_w
+    cy = (jnp.arange(fh) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy, indexing="xy")
+    centers = jnp.stack([cxg, cyg], -1)[..., None, :]       # (fh, fw, 1, 2)
+    half = wh[None, None, :, :] / 2.0
+    out = jnp.concatenate([
+        (centers[..., 0:1] - half[..., 0:1]) / iw,
+        (centers[..., 1:2] - half[..., 1:2]) / ih,
+        (centers[..., 0:1] + half[..., 0:1]) / iw,
+        (centers[..., 1:2] + half[..., 1:2]) / ih,
+    ], axis=-1)                                             # (fh, fw, A, 4)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance, out.dtype), out.shape)
+    return out, var
+
+
+@defop("vision.yolo_box", differentiable=False)
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, scale_x_y=1.0, iou_aware=False,
+             iou_aware_factor=0.5):
+    """ops.py yolo_box: decode YOLOv3 head output into boxes + scores."""
+    n, c, h, w = x.shape
+    na = len(anchors) // 2
+    an = jnp.asarray(np.array(anchors, "float32").reshape(na, 2))
+    xr = x.reshape(n, na, 5 + class_num, h, w)
+    gx = (jnp.arange(w) + 0.0)[None, None, None, :]
+    gy = (jnp.arange(h) + 0.0)[None, None, :, None]
+    sig = jax.nn.sigmoid
+    bx = (sig(xr[:, :, 0]) * scale_x_y - (scale_x_y - 1) / 2 + gx) / w
+    by = (sig(xr[:, :, 1]) * scale_x_y - (scale_x_y - 1) / 2 + gy) / h
+    input_h = downsample_ratio * h
+    input_w = downsample_ratio * w
+    bw = jnp.exp(xr[:, :, 2]) * an[None, :, 0, None, None] / input_w
+    bh = jnp.exp(xr[:, :, 3]) * an[None, :, 1, None, None] / input_h
+    conf = sig(xr[:, :, 4])
+    probs = sig(xr[:, :, 5:]) * conf[:, :, None]
+    mask = (conf > conf_thresh).astype(x.dtype)
+    imh = img_size[:, 0].astype(x.dtype)[:, None, None, None]
+    imw = img_size[:, 1].astype(x.dtype)[:, None, None, None]
+    x1 = (bx - bw / 2) * imw
+    y1 = (by - bh / 2) * imh
+    x2 = (bx + bw / 2) * imw
+    y2 = (by + bh / 2) * imh
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0)
+        y1 = jnp.clip(y1, 0)
+        x2 = jnp.minimum(x2, imw - 1)
+        y2 = jnp.minimum(y2, imh - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], -1) * mask[..., None]
+    boxes = boxes.reshape(n, -1, 4)
+    scores = (probs * mask[:, :, None]).transpose(0, 1, 3, 4, 2) \
+        .reshape(n, -1, class_num)
+    return boxes, scores
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """ops.py psroi_pool: position-sensitive ROI average pooling."""
+    os = (output_size, output_size) if isinstance(output_size, int) \
+        else tuple(output_size)
+    C = int(x.shape[1])
+    out_c = C // (os[0] * os[1])
+    pooled = _roi_align(x, boxes, boxes_num, output_size=os,
+                        spatial_scale=spatial_scale, sampling_ratio=1,
+                        aligned=False)
+    # position-sensitive: output channel c at bin (i,j) reads input channel
+    # c*os0*os1 + i*os1 + j
+    idx = jnp.arange(out_c * os[0] * os[1]).reshape(out_c, os[0], os[1])
+    n = pooled.shape[0]
+    gi = jnp.broadcast_to(idx[None], (n, out_c, os[0], os[1]))
+    ii = jnp.broadcast_to(jnp.arange(os[0])[None, None, :, None],
+                          (n, out_c, os[0], os[1]))
+    jj = jnp.broadcast_to(jnp.arange(os[1])[None, None, None, :],
+                          (n, out_c, os[0], os[1]))
+    pv = pooled.value if isinstance(pooled, Tensor) else pooled
+    out = pv[jnp.arange(n)[:, None, None, None], gi, ii, jj]
+    return Tensor(out)
+
+
+@defop("vision.matrix_nms", differentiable=False)
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True):
+    """ops.py matrix_nms: soft suppression by pairwise IoU decay (SOLOv2)."""
+    n, c, m = scores.shape  # (batch, classes, boxes)
+    outs = []
+    for b in range(n):
+        cls_scores = scores[b]
+        boxes = bboxes[b]
+        flat_scores = cls_scores.reshape(-1)
+        labels = jnp.repeat(jnp.arange(c), m)
+        box_idx = jnp.tile(jnp.arange(m), c)
+        k = min(nms_top_k, int(flat_scores.shape[0]))
+        top, ti = jax.lax.top_k(flat_scores, k)
+        sel_boxes = boxes[box_idx[ti]]
+        sel_labels = labels[ti]
+        x1, y1, x2, y2 = (sel_boxes[:, i] for i in range(4))
+        off = 0.0 if normalized else 1.0
+        areas = (x2 - x1 + off) * (y2 - y1 + off)
+        xx1 = jnp.maximum(x1[:, None], x1[None, :])
+        yy1 = jnp.maximum(y1[:, None], y1[None, :])
+        xx2 = jnp.minimum(x2[:, None], x2[None, :])
+        yy2 = jnp.minimum(y2[:, None], y2[None, :])
+        inter = jnp.clip(xx2 - xx1 + off, 0) * jnp.clip(yy2 - yy1 + off, 0)
+        iou = inter / (areas[:, None] + areas[None, :] - inter + 1e-10)
+        same = (sel_labels[:, None] == sel_labels[None, :])
+        upper = jnp.triu(jnp.ones((k, k), bool), 1)  # j decayed by better i
+        ious = jnp.where(same & upper.T, iou, 0.0)
+        comp = jnp.max(ious, axis=1)          # worst overlap with better box
+        if use_gaussian:
+            decay = jnp.min(jnp.where(
+                same & upper.T,
+                jnp.exp(-(ious ** 2 - comp[None, :] ** 2) / gaussian_sigma),
+                1.0), axis=1)
+        else:
+            decay = jnp.min(jnp.where(same & upper.T,
+                                      (1 - ious) / (1 - comp[None, :] + 1e-10),
+                                      1.0), axis=1)
+        new_scores = top * decay
+        keep = new_scores > jnp.maximum(post_threshold, score_threshold)
+        new_scores = jnp.where(keep, new_scores, 0.0)
+        kk = min(keep_top_k, k)
+        fin, fi = jax.lax.top_k(new_scores, kk)
+        out = jnp.concatenate([
+            sel_labels[fi][:, None].astype(bboxes.dtype),
+            fin[:, None], sel_boxes[fi]], axis=1)
+        outs.append(out)
+    return jnp.stack(outs)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    """ops.py distribute_fpn_proposals: route each ROI to its FPN level by
+    sqrt-area scale (eager: host-side grouping like the reference CPU path)."""
+    rois = np.asarray(fpn_rois.numpy() if isinstance(fpn_rois, Tensor)
+                      else fpn_rois)
+    off = 1.0 if pixel_offset else 0.0
+    scale = np.sqrt(np.clip((rois[:, 2] - rois[:, 0] + off)
+                            * (rois[:, 3] - rois[:, 1] + off), 0, None))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype("int64")
+    outs, idx_in_level, counts = [], [], []
+    order = []
+    for level in range(min_level, max_level + 1):
+        sel = np.flatnonzero(lvl == level)
+        order.append(sel)
+        outs.append(Tensor(jnp.asarray(rois[sel])))
+        counts.append(len(sel))
+    restore = np.argsort(np.concatenate(order)) if order else np.zeros(0)
+    return (outs, Tensor(jnp.asarray(restore.astype("int32")[:, None])),
+            [Tensor(jnp.asarray(np.array([c], "int32"))) for c in counts]
+            if rois_num is not None else None)
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False, name=None):
+    """ops.py generate_proposals (RPN): decode deltas -> clip -> filter ->
+    nms -> top-k, composed from box_coder + nms."""
+    n = scores.shape[0]
+    all_rois, all_scores, nums = [], [], []
+    for b in range(n):
+        s = scores[b].reshape([-1])
+        d = bbox_deltas[b].transpose([1, 2, 0]).reshape([-1, 4])
+        a = anchors.reshape([-1, 4])
+        v = variances.reshape([-1, 4])
+        k = min(pre_nms_top_n, int(s.shape[0]))
+        top_s, ti = jax.lax.top_k(s.value, k)
+        props = box_coder(a[Tensor(ti)], v[Tensor(ti)],
+                          d[Tensor(ti)].unsqueeze(0),
+                          code_type="decode_center_size", axis=0)
+        props = props.squeeze(0)
+        h, w = img_size[b].numpy()[:2]
+        pv = jnp.stack([
+            jnp.clip(props.value[:, 0], 0, w - (1.0 if pixel_offset else 0.0)),
+            jnp.clip(props.value[:, 1], 0, h - (1.0 if pixel_offset else 0.0)),
+            jnp.clip(props.value[:, 2], 0, w - (1.0 if pixel_offset else 0.0)),
+            jnp.clip(props.value[:, 3], 0, h - (1.0 if pixel_offset else 0.0)),
+        ], axis=1)
+        wide = (pv[:, 2] - pv[:, 0]) >= min_size
+        tall = (pv[:, 3] - pv[:, 1]) >= min_size
+        ok = wide & tall
+        masked_scores = jnp.where(ok, top_s, -jnp.inf)
+        keep = _nms(pv, scores=masked_scores, iou_threshold=nms_thresh)
+        keep_v = keep.value if isinstance(keep, Tensor) else keep
+        keep_v = keep_v[:post_nms_top_n]
+        all_rois.append(Tensor(pv[keep_v]))
+        all_scores.append(Tensor(jnp.sort(masked_scores)[::-1][:len(keep_v)]))
+        nums.append(len(keep_v))
+    rois = Tensor(jnp.concatenate([r.value for r in all_rois]))
+    rscores = Tensor(jnp.concatenate([s.value for s in all_scores]))
+    if return_rois_num:
+        return rois, rscores, Tensor(jnp.asarray(np.array(nums, "int32")))
+    return rois, rscores
+
+
+def read_file(filename, name=None):
+    """ops.py read_file: raw bytes as a uint8 tensor."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    return Tensor(jnp.asarray(np.frombuffer(data, np.uint8)))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """ops.py decode_jpeg via PIL (the reference uses nvjpeg on GPU)."""
+    import io
+
+    from PIL import Image
+
+    data = bytes(np.asarray(x.numpy() if isinstance(x, Tensor) else x,
+                            np.uint8))
+    img = Image.open(io.BytesIO(data))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None, :, :]
+    else:
+        arr = arr.transpose(2, 0, 1)  # HWC -> CHW
+    return Tensor(jnp.asarray(arr))

@@ -438,3 +438,114 @@ class TestVisualDLCallback:
         assert any(t.startswith("train/loss") for t in tags), tags
         steps = [l["step"] for l in lines if l["tag"].startswith("train/")]
         assert steps == sorted(steps) and steps[-1] >= 4  # 2 epochs x 2 steps
+
+
+class TestDetectionOps:
+    """Round-2 detection op batch (reference vision/ops.py)."""
+
+    def test_box_coder_roundtrip(self):
+        from paddle_tpu.vision.ops import box_coder
+
+        priors = paddle.to_tensor(np.array(
+            [[0.1, 0.1, 0.5, 0.5], [0.2, 0.2, 0.8, 0.9]], "float32"))
+        var = paddle.to_tensor(np.array([0.1, 0.1, 0.2, 0.2], "float32"))
+        targets = paddle.to_tensor(np.array(
+            [[0.15, 0.1, 0.55, 0.6]], "float32"))
+        enc = box_coder(priors, var, targets, code_type="encode_center_size")
+        assert tuple(enc.shape) == (1, 2, 4)
+        dec = box_coder(priors, var, enc, code_type="decode_center_size",
+                        axis=0)
+        np.testing.assert_allclose(
+            dec.numpy()[0, 0], targets.numpy()[0], atol=1e-5)
+
+    def test_prior_box_shapes_and_range(self):
+        from paddle_tpu.vision.ops import prior_box
+
+        feat = paddle.zeros([1, 8, 4, 4])
+        image = paddle.zeros([1, 3, 32, 32])
+        boxes, var = prior_box(feat, image, min_sizes=[8.0],
+                               aspect_ratios=[2.0], clip=True)
+        assert tuple(boxes.shape) == (4, 4, 2, 4)
+        b = boxes.numpy()
+        assert b.min() >= 0.0 and b.max() <= 1.0
+        assert (b[..., 2] >= b[..., 0]).all()
+
+    def test_yolo_box_decode(self):
+        from paddle_tpu.vision.ops import yolo_box
+
+        n, na, cls, h, w = 1, 2, 3, 2, 2
+        x = paddle.zeros([n, na * (5 + cls), h, w])
+        img_size = paddle.to_tensor(np.array([[64, 64]], "int64"))
+        boxes, scores = yolo_box(x, img_size, anchors=[8, 8, 16, 16],
+                                 class_num=cls, conf_thresh=0.4,
+                                 downsample_ratio=32)
+        assert tuple(boxes.shape) == (1, na * h * w, 4)
+        assert tuple(scores.shape) == (1, na * h * w, cls)
+        # zero logits -> conf 0.5 > 0.4: center boxes decode around cells
+        assert float(scores.numpy().max()) <= 0.5 * 0.5 + 1e-6
+
+    def test_psroi_pool_position_sensitive(self):
+        from paddle_tpu.vision.ops import psroi_pool
+
+        # 8 channels, 2x2 bins -> 2 output channels
+        x = paddle.to_tensor(
+            np.arange(1 * 8 * 4 * 4, dtype="float32").reshape(1, 8, 4, 4))
+        boxes = paddle.to_tensor(np.array([[0.0, 0.0, 3.0, 3.0]], "float32"))
+        out = psroi_pool(x, boxes, paddle.to_tensor(np.array([1], "int32")), 2)
+        assert tuple(out.shape) == (1, 2, 2, 2)
+
+    def test_matrix_nms_decays_overlaps(self):
+        from paddle_tpu.vision.ops import matrix_nms
+
+        boxes = paddle.to_tensor(np.array([[
+            [0, 0, 10, 10], [0, 0, 10, 10], [20, 20, 30, 30]]], "float32"))
+        scores = paddle.to_tensor(np.array(
+            [[[0.9, 0.8, 0.7]]], "float32"))  # one class
+        out = matrix_nms(boxes, scores, score_threshold=0.05,
+                         nms_top_k=3, keep_top_k=3)
+        o = out.numpy()[0]  # (k, 6): label, score, box — resorted by score
+        assert o[0, 1] == pytest.approx(0.9)       # best box untouched
+        assert o[1, 1] == pytest.approx(0.7, abs=1e-4)  # disjoint box kept
+        assert o[2, 1] < 0.2                       # duplicate heavily decayed
+
+    def test_distribute_fpn_and_read_decode(self, tmp_path):
+        from paddle_tpu.vision.ops import (decode_jpeg,
+                                           distribute_fpn_proposals,
+                                           read_file)
+
+        rois = paddle.to_tensor(np.array(
+            [[0, 0, 16, 16], [0, 0, 224, 224]], "float32"))
+        outs, restore, _ = distribute_fpn_proposals(
+            rois, min_level=2, max_level=5, refer_level=4, refer_scale=224)
+        sizes = [int(o.shape[0]) for o in outs]
+        assert sum(sizes) == 2 and sizes[0] == 1  # small roi -> lowest level
+        from PIL import Image
+
+        img = Image.fromarray((np.random.RandomState(0).rand(8, 8, 3) * 255)
+                              .astype("uint8"))
+        path = str(tmp_path / "t.jpg")
+        img.save(path)
+        raw = read_file(path)
+        assert raw.numpy().dtype == np.uint8
+        decoded = decode_jpeg(raw, mode="rgb")
+        assert tuple(decoded.shape) == (3, 8, 8)
+
+    def test_generate_proposals_shapes(self):
+        from paddle_tpu.vision.ops import generate_proposals
+
+        r = np.random.RandomState(0)
+        h = w = 4
+        na = 2
+        scores = paddle.to_tensor(r.rand(1, na, h, w).astype("float32"))
+        deltas = paddle.to_tensor(
+            (r.randn(1, na * 4, h, w) * 0.1).astype("float32"))
+        anchors = paddle.to_tensor(
+            np.tile(np.array([0, 0, 8, 8], "float32"), (h, w, na, 1)))
+        variances = paddle.to_tensor(np.tile(
+            np.array([1, 1, 1, 1], "float32"), (h, w, na, 1)))
+        img_size = paddle.to_tensor(np.array([[32, 32]], "float32"))
+        rois, rscores, num = generate_proposals(
+            scores, deltas, img_size, anchors, variances,
+            pre_nms_top_n=16, post_nms_top_n=8, return_rois_num=True)
+        assert rois.shape[1] == 4
+        assert int(num.numpy()[0]) == rois.shape[0] <= 8
