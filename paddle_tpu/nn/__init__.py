@@ -44,3 +44,17 @@ from .layer.rnn import (  # noqa: F401
     GRU, LSTM, RNN, BiRNN, GRUCell, LSTMCell, SimpleRNN, SimpleRNNCell,
 )
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+from .layer.rnn import RNNCellBase  # noqa: F401
+from .layer.extras import (  # noqa: F401
+    FeatureAlphaDropout,
+    LogSigmoid,
+    LPPool1D,
+    LPPool2D,
+    MaxUnPool1D,
+    MaxUnPool2D,
+    MultiMarginLoss,
+    RReLU,
+    TripletMarginWithDistanceLoss,
+    ZeroPad1D,
+    ZeroPad3D,
+)

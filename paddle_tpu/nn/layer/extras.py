@@ -1,0 +1,157 @@
+"""Layer wrappers for the round-2 functional batch.
+
+Reference analogs: python/paddle/nn/layer/{activation,loss,pooling,common}.py
+classes whose functional backends live in nn/functional/extras.py.
+"""
+from __future__ import annotations
+
+from .. import functional as F
+from .common import Pad2D
+from .layers import Layer
+
+
+class LogSigmoid(Layer):
+    def forward(self, x):
+        return F.log_sigmoid(x)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self._lower, self._upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self._lower, self._upper, training=self.training)
+
+
+class LPPool1D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, name=None):
+        super().__init__()
+        self._args = (norm_type, kernel_size, stride, padding, ceil_mode)
+
+    def forward(self, x):
+        n, k, s, p, c = self._args
+        return F.lp_pool1d(x, n, k, stride=s, padding=p, ceil_mode=c)
+
+
+class LPPool2D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__()
+        self._args = (norm_type, kernel_size, stride, padding, ceil_mode,
+                      data_format)
+
+    def forward(self, x):
+        n, k, s, p, c, df = self._args
+        return F.lp_pool2d(x, n, k, stride=s, padding=p, ceil_mode=c,
+                           data_format=df)
+
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, output_size=None,
+                 name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, o = self._args
+        return F.max_unpool1d(x, indices, k, stride=s, padding=p,
+                              output_size=o)
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, output_size=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding, output_size, data_format)
+
+    def forward(self, x, indices):
+        k, s, p, o, df = self._args
+        return F.max_unpool2d(x, indices, k, stride=s, padding=p,
+                              output_size=o, data_format=df)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._args = (p, margin, weight, reduction)
+
+    def forward(self, input, label):
+        p, m, w, r = self._args
+        return F.multi_margin_loss(input, label, p=p, margin=m, weight=w,
+                                   reduction=r)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self._args = (distance_function, margin, swap, reduction)
+
+    def forward(self, input, positive, negative):
+        d, m, s, r = self._args
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative, distance_function=d, margin=m, swap=s,
+            reduction=r)
+
+
+class FeatureAlphaDropout(Layer):
+    """Whole-channel alpha dropout (common.py FeatureAlphaDropout): SELU-
+    preserving dropout applied per feature map."""
+
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        if not self.training or self._p == 0.0:
+            return x
+        import jax
+
+        from ...framework import random as rng
+        from ...framework.core import Tensor
+        from ..functional.common import alpha_dropout
+
+        # per-channel keep decision broadcast over spatial dims: sample a
+        # (N, C) mask and run alpha dropout with it expanded
+        shape = tuple(x.shape[:2]) + (1,) * (x.ndim - 2)
+        keep = jax.random.bernoulli(rng.next_key(), 1.0 - self._p, shape)
+        alpha_p = -1.7580993408473766
+        a = (1.0 - self._p * (1 + self._p * alpha_p ** 2)) ** -0.5
+        b = -a * alpha_p * self._p
+        import jax.numpy as jnp
+
+        val = jnp.where(keep, x.value, alpha_p)
+        return Tensor(a * val + b)
+
+
+class ZeroPad1D(Layer):
+    def __init__(self, padding, data_format="NCL", name=None):
+        super().__init__()
+        self._padding = padding
+        self._data_format = data_format
+
+    def forward(self, x):
+        from ...ops.manipulation import pad
+
+        return pad(x, list(self._padding)
+                   if not isinstance(self._padding, int)
+                   else [self._padding, self._padding],
+                   mode="constant", value=0.0, data_format=self._data_format)
+
+
+class ZeroPad3D(Layer):
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__()
+        self._padding = padding
+        self._data_format = data_format
+
+    def forward(self, x):
+        from ...ops.manipulation import pad
+
+        p = self._padding
+        p = [p] * 6 if isinstance(p, int) else list(p)
+        return pad(x, p, mode="constant", value=0.0,
+                   data_format=self._data_format)
