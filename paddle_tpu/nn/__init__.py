@@ -58,3 +58,4 @@ from .layer.extras import (  # noqa: F401
     ZeroPad1D,
     ZeroPad3D,
 )
+from .decode import BeamSearchDecoder, Decoder, dynamic_decode  # noqa: F401

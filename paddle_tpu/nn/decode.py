@@ -1,0 +1,167 @@
+"""Beam-search decoding: BeamSearchDecoder + dynamic_decode.
+
+Reference analog: python/paddle/nn/decode.py (Decoder/BeamSearchDecoder and
+the dynamic_decode driver loop). TPU-first note: the per-step math (embed ->
+cell -> project -> top-k over beam*vocab) is jax ops on (batch*beam, ...)
+tensors; the step loop runs on the host (decode lengths are data-dependent —
+the reference's while_op becomes a Python loop over compiled steps), and the
+final backtrack is the gather_tree op.
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import ops
+from ..framework.core import Tensor
+from .functional.extras import gather_tree
+from .layer.layers import Layer
+
+BeamSearchState = namedtuple(
+    "BeamSearchState", ["cell_states", "log_probs", "finished", "lengths"])
+BeamSearchOutput = namedtuple(
+    "BeamSearchOutput", ["scores", "predicted_ids", "parent_ids"])
+
+
+class Decoder:
+    """Abstract step-decoder interface (decode.py Decoder)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+def _tile_beam(x, beam_size):
+    v = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+    expanded = jnp.repeat(v[:, None], beam_size, axis=1)
+    return jnp.reshape(expanded, (-1,) + v.shape[1:])
+
+
+class BeamSearchDecoder(Decoder):
+    """decode.py BeamSearchDecoder: beam-expanded RNN decoding with length
+    penalty-free cumulative log-prob scoring."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        return Tensor(_tile_beam(x, beam_size))
+
+    def initialize(self, initial_cell_states):
+        states = initial_cell_states
+        if not isinstance(states, (tuple, list)):
+            states = (states,)
+        self._batch = int(states[0].shape[0])
+        B, K = self._batch, self.beam_size
+        cell_states = tuple(Tensor(_tile_beam(s, K)) for s in states)
+        # only beam 0 is live at t=0 (all beams hold identical states)
+        log_probs = jnp.tile(
+            jnp.asarray([0.0] + [-1e9] * (K - 1), jnp.float32)[None, :],
+            (B, 1))
+        init = BeamSearchState(
+            cell_states=cell_states,
+            log_probs=log_probs,
+            finished=jnp.zeros((B, K), bool),
+            lengths=jnp.zeros((B, K), jnp.int64),
+        )
+        start = Tensor(jnp.full((B * K,), self.start_token, jnp.int64))
+        return start, init, init.finished
+
+    def step(self, time, inputs, states, **kwargs):
+        B, K = self._batch, self.beam_size
+        emb = self.embedding_fn(inputs) if self.embedding_fn else inputs
+        cell_out, next_cell_states = self.cell(emb, states.cell_states
+                                               if len(states.cell_states) > 1
+                                               else states.cell_states[0])
+        if not isinstance(next_cell_states, (tuple, list)):
+            next_cell_states = (next_cell_states,)
+        logits = self.output_fn(cell_out) if self.output_fn else cell_out
+        V = int(logits.shape[-1])
+        logp = jnp.reshape(
+            jnp.log(jnp.clip(jnp.exp(logits.value - jnp.max(
+                logits.value, -1, keepdims=True)) / jnp.sum(
+                jnp.exp(logits.value - jnp.max(logits.value, -1,
+                                               keepdims=True)),
+                -1, keepdims=True), 1e-20)), (B, K, V))
+        # finished beams only extend with end_token at zero cost
+        fin_mask = states.finished[..., None]
+        end_only = jnp.full((V,), -1e9).at[self.end_token].set(0.0)
+        logp = jnp.where(fin_mask, end_only[None, None, :], logp)
+        total = states.log_probs[..., None] + logp             # (B, K, V)
+        flat = jnp.reshape(total, (B, K * V))
+        top_scores, top_idx = jax.lax.top_k(flat, K)
+        parent = (top_idx // V).astype(jnp.int64)              # beam index
+        token = (top_idx % V).astype(jnp.int64)
+        batch_ix = jnp.arange(B)[:, None]
+        new_finished = jnp.take_along_axis(states.finished, parent, axis=1) \
+            | (token == self.end_token)
+        prev_len = jnp.take_along_axis(states.lengths, parent, axis=1)
+        prev_fin = jnp.take_along_axis(states.finished, parent, axis=1)
+        new_lengths = prev_len + (~prev_fin).astype(jnp.int64)
+        # gather cell states along the chosen parents
+        flat_parent = (batch_ix * K + parent).reshape(-1)
+        new_cell_states = tuple(
+            Tensor(s.value[flat_parent]) for s in next_cell_states)
+        next_state = BeamSearchState(new_cell_states, top_scores,
+                                     new_finished, new_lengths)
+        out = BeamSearchOutput(scores=Tensor(top_scores),
+                               predicted_ids=Tensor(token),
+                               parent_ids=Tensor(parent))
+        next_inputs = Tensor(token.reshape(-1))
+        return out, next_state, next_inputs, Tensor(new_finished)
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        # outputs.*: (T, B, K) stacked — backtrack the beam pointers
+        preds = gather_tree(outputs.predicted_ids, outputs.parent_ids)
+        return preds, final_states
+
+    @property
+    def tracks_own_finished(self):
+        return True
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=100,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """decode.py dynamic_decode: run decoder.step until every sequence
+    finished or max_step_num."""
+    inputs, states, finished = decoder.initialize(inits)
+    step_outputs = []
+    for t in range(int(max_step_num)):
+        out, states, inputs, finished_t = decoder.step(t, inputs, states,
+                                                       **kwargs)
+        step_outputs.append(out)
+        finished = finished_t.value if isinstance(finished_t, Tensor) \
+            else finished_t
+        if bool(jnp.all(finished)):
+            break
+    stacked = type(step_outputs[0])(*[
+        Tensor(jnp.stack([getattr(o, f).value for o in step_outputs]))
+        for f in step_outputs[0]._fields])
+    preds, final_states = decoder.finalize(stacked, states, states.lengths)
+    lengths = Tensor(states.lengths)
+    if not output_time_major:
+        preds = ops.transpose(preds, [1, 0, 2])
+    if return_length:
+        return preds, final_states, lengths
+    return preds, final_states

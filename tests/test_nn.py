@@ -316,3 +316,74 @@ class TestFunctionalExtras:
         qkv = paddle.to_tensor(r.randn(2, 8, 3, 2, 16).astype("float32"))
         out, _ = F.flash_attn_qkvpacked(qkv, causal=True)
         assert tuple(out.shape) == (2, 8, 2, 16)
+
+
+class TestBeamSearchDecode:
+    """nn.BeamSearchDecoder + dynamic_decode (reference nn/decode.py)."""
+
+    def _parts(self, V=7, H=16):
+        paddle.seed(0)
+        cell = paddle.nn.GRUCell(H, H)
+        emb = paddle.nn.Embedding(V, H)
+        proj = paddle.nn.Linear(H, V)
+        return cell, emb, proj
+
+    def test_shapes_and_score_order(self):
+        cell, emb, proj = self._parts()
+        dec = paddle.nn.BeamSearchDecoder(cell, start_token=0, end_token=1,
+                                          beam_size=3, embedding_fn=emb,
+                                          output_fn=proj)
+        preds, states, lengths = paddle.nn.dynamic_decode(
+            dec, inits=paddle.zeros([2, 16]), max_step_num=5,
+            return_length=True)
+        assert tuple(preds.shape) == (2, 5, 3)
+        lp = np.asarray(states.log_probs)
+        assert (np.diff(lp, axis=1) <= 1e-5).all()  # beams sorted best-first
+
+    def test_greedy_beam1_matches_manual_argmax(self):
+        cell, emb, proj = self._parts()
+        dec = paddle.nn.BeamSearchDecoder(cell, start_token=0, end_token=1,
+                                          beam_size=1, embedding_fn=emb,
+                                          output_fn=proj)
+        init = paddle.zeros([1, 16])
+        preds, _ = paddle.nn.dynamic_decode(dec, inits=init, max_step_num=4)
+        # manual greedy unroll
+        h = paddle.zeros([1, 16])
+        tok = paddle.to_tensor(np.array([0], "int64"))
+        manual = []
+        for _ in range(4):
+            out, h = cell(emb(tok), h)
+            tok = paddle.argmax(proj(out), axis=-1)
+            manual.append(int(tok.numpy()[0]))
+            if manual[-1] == 1:
+                break
+        np.testing.assert_array_equal(preds.numpy()[0, :len(manual), 0],
+                                      manual)
+
+    def test_end_token_stops_and_lengths(self):
+        cell, emb, _ = self._parts(V=5)
+
+        class EndBias(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = paddle.nn.Linear(16, 5)
+                self.step = [0]
+
+            def forward(self, x):
+                out = self.lin(x)
+                self.step[0] += 1
+                if self.step[0] >= 2:  # force end token from step 2 on
+                    bias = np.zeros(5, "float32")
+                    bias[1] = 100.0
+                    out = out + paddle.to_tensor(bias)
+                return out
+
+        dec = paddle.nn.BeamSearchDecoder(cell, start_token=0, end_token=1,
+                                          beam_size=2, embedding_fn=emb,
+                                          output_fn=EndBias())
+        preds, states, lengths = paddle.nn.dynamic_decode(
+            dec, inits=paddle.zeros([1, 16]), max_step_num=10,
+            return_length=True)
+        assert preds.shape[1] < 10      # stopped early
+        assert int(np.asarray(states.lengths).max()) == 2
+        np.testing.assert_array_equal(preds.numpy()[0, 1, :], 1)  # end token
