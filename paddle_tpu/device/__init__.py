@@ -174,3 +174,6 @@ def stream_guard(stream):
         yield
 
     return guard()
+
+
+from . import cuda  # noqa: E402,F401  (paddle.device.cuda compat namespace)

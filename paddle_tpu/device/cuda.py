@@ -1,0 +1,86 @@
+"""paddle.device.cuda compatibility namespace, served by the TPU runtime.
+
+Reference analog: python/paddle/device/cuda/__init__.py. Reference-trained
+code calls paddle.device.cuda.* unconditionally; on this build "the
+accelerator" is the TPU, so every query maps onto the PJRT device behind
+paddle.device (streams are ordering shims — XLA owns scheduling; memory
+stats come from PJRT memory_stats).
+"""
+from __future__ import annotations
+
+from . import (
+    Event,
+    Stream,
+    _dev,
+    current_stream,
+    empty_cache,
+    max_memory_allocated,
+    max_memory_reserved,
+    memory_allocated,
+    memory_reserved,
+    stream_guard,
+    synchronize,
+)
+
+
+def device_count():
+    import jax
+
+    try:
+        return len([d for d in jax.devices() if d.platform != "cpu"]) or \
+            len(jax.devices())
+    except RuntimeError:
+        return 0
+
+
+def extract_cuda_device_id(device, op_name=""):
+    if isinstance(device, int):
+        return device
+    s = str(device)
+    return int(s.rsplit(":", 1)[1]) if ":" in s else 0
+
+
+def reset_max_memory_allocated(device=None):
+    pass  # PJRT peak counters reset with the client
+
+
+def reset_max_memory_reserved(device=None):
+    pass
+
+
+class _DeviceProperties:
+    def __init__(self, dev):
+        self.name = getattr(dev, "device_kind", str(dev))
+        self.major, self.minor = 0, 0
+        stats = {}
+        try:
+            stats = dev.memory_stats() or {}
+        except Exception:
+            pass
+        self.total_memory = stats.get("bytes_limit", 0)
+        self.multi_processor_count = 1
+
+    def __repr__(self):
+        return (f"_DeviceProperties(name='{self.name}', "
+                f"total_memory={self.total_memory // (1024 ** 2)}MB)")
+
+
+def get_device_properties(device=None):
+    return _DeviceProperties(_dev(device))
+
+
+def get_device_name(device=None):
+    return getattr(_dev(device), "device_kind", str(_dev(device)))
+
+
+def get_device_capability(device=None):
+    return 0, 0  # CUDA compute capability has no TPU analog
+
+
+__all__ = [
+    "Stream", "Event", "current_stream", "device_count", "empty_cache",
+    "extract_cuda_device_id", "get_device_capability", "get_device_name",
+    "get_device_properties", "max_memory_allocated", "max_memory_reserved",
+    "memory_allocated", "memory_reserved", "reset_max_memory_allocated",
+    "reset_max_memory_reserved", "stream_guard", "synchronize",
+]

@@ -88,4 +88,6 @@ class ViterbiDecoder(Layer):
 
 # -- datasets (reference python/paddle/text/datasets/) -----------------------
 from . import text_datasets as datasets  # noqa: E402,F401
-from .text_datasets import Imdb, Imikolov, UCIHousing  # noqa: E402,F401
+from .text_datasets import (  # noqa: E402,F401
+    Imdb, Imikolov, Movielens, UCIHousing,
+)

@@ -168,4 +168,96 @@ class Imdb(Dataset):
         return len(self.docs)
 
 
-__all__ = ["UCIHousing", "Imikolov", "Imdb", "UCI_FEATURE_NAMES"]
+__all__ = ["UCIHousing", "Imikolov", "Imdb", "Movielens",
+           "MovieInfo", "UserInfo", "UCI_FEATURE_NAMES"]
+
+
+AGE_TABLE = [1, 18, 25, 35, 45, 50, 56]
+
+
+class MovieInfo:
+    """movielens.py MovieInfo (id, categories, title)."""
+
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self, categories_dict, movie_title_dict):
+        return [
+            [self.index],
+            [categories_dict[c] for c in self.categories],
+            [movie_title_dict[w.lower()] for w in self.title.split()],
+        ]
+
+
+class UserInfo:
+    """movielens.py UserInfo (id, gender, age bucket, job)."""
+
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = AGE_TABLE.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [[self.index], [0 if self.is_male else 1], [self.age],
+                [self.job_id]]
+
+
+class Movielens(Dataset):
+    """ml-1m zip reader (movielens.py): '::'-separated movies/users/ratings
+    tables; samples = user fields + movie fields + [rating*2-5]."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0):
+        import re
+        import zipfile
+
+        if data_file is None:
+            _no_download("Movielens", "data_file")
+        self.mode = mode.lower()
+        rng = np.random.RandomState(rand_seed)
+        pattern = re.compile(r"^(.*)\((\d+)\)$")
+        self.movie_info, self.user_info = {}, {}
+        title_words, categories = set(), set()
+        with zipfile.ZipFile(data_file) as z:
+            with z.open("ml-1m/movies.dat") as f:
+                for line in f:
+                    mid, title, cats = line.decode("latin").strip().split("::")
+                    cats = cats.split("|")
+                    categories.update(cats)
+                    m = pattern.match(title)
+                    title = m.group(1) if m else title
+                    title_words.update(w.lower() for w in title.split())
+                    self.movie_info[int(mid)] = MovieInfo(mid, cats, title)
+            self.movie_title_dict = {w: i
+                                     for i, w in enumerate(sorted(title_words))}
+            self.categories_dict = {c: i
+                                    for i, c in enumerate(sorted(categories))}
+            with z.open("ml-1m/users.dat") as f:
+                for line in f:
+                    uid, gender, age, job, _ = \
+                        line.decode("latin").strip().split("::")
+                    self.user_info[int(uid)] = UserInfo(uid, gender, age, job)
+            self.data = []
+            is_test = self.mode == "test"
+            with z.open("ml-1m/ratings.dat") as f:
+                for line in f:
+                    if (rng.random_sample() < test_ratio) != is_test:
+                        continue
+                    uid, mid, rating, _ = \
+                        line.decode("latin").strip().split("::")
+                    usr = self.user_info[int(uid)]
+                    mov = self.movie_info[int(mid)]
+                    self.data.append(
+                        usr.value()
+                        + mov.value(self.categories_dict,
+                                    self.movie_title_dict)
+                        + [[float(rating) * 2 - 5.0]])
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)

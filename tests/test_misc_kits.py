@@ -305,3 +305,30 @@ class TestTextDatasets:
         assert "great" in ds.word_idx and "movie" in ds.word_idx
         test = Imdb(data_file=str(path), mode="test", cutoff=0)
         assert [int(test[i][1]) for i in range(2)] == [0, 1]
+
+
+class TestMovielens:
+    def test_ml1m_zip_parser(self, tmp_path):
+        import zipfile
+        from paddle_tpu.text import Movielens
+
+        path = tmp_path / "ml-1m.zip"
+        movies = ("1::Toy Story (1995)::Animation|Comedy\n"
+                  "2::Heat (1995)::Action|Crime\n")
+        users = "1::M::25::10::90210\n2::F::35::5::10001\n"
+        ratings = ("1::1::5::978300760\n1::2::3::978300761\n"
+                   "2::1::4::978300762\n2::2::2::978300763\n")
+        with zipfile.ZipFile(path, "w") as z:
+            z.writestr("ml-1m/movies.dat", movies)
+            z.writestr("ml-1m/users.dat", users)
+            z.writestr("ml-1m/ratings.dat", ratings)
+        train = Movielens(data_file=str(path), mode="train", test_ratio=0.0)
+        assert len(train) == 4
+        sample = train[0]
+        assert len(sample) == 8  # uid, gender, age, job, mid, cats, title, y
+        uid, gender, age, job, mid, cats, title, y = sample
+        assert int(uid[0]) == 1 and int(gender[0]) == 0  # male -> 0
+        assert y[0] == 5.0 * 2 - 5.0
+        # test split takes everything when test_ratio=1.0
+        test = Movielens(data_file=str(path), mode="test", test_ratio=1.0)
+        assert len(test) == 4
