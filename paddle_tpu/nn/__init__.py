@@ -47,6 +47,8 @@ from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa:
 from .layer.rnn import RNNCellBase  # noqa: F401
 from .layer.extras import (  # noqa: F401
     FeatureAlphaDropout,
+    HSigmoidLoss,
+    MaxUnPool3D,
     LogSigmoid,
     LPPool1D,
     LPPool2D,

@@ -60,3 +60,4 @@ from .extras import (  # noqa: F401
     zeropad2d,
 )
 from ...ops.random_ops import gumbel_softmax  # noqa: F401
+from .extras import hsigmoid_loss, max_unpool3d  # noqa: F401

@@ -383,3 +383,99 @@ def thresholded_relu_(x, threshold=1.0, value=0.0, name=None):
     from .activation import thresholded_relu
 
     return _inplace(thresholded_relu)(x, threshold, value)
+
+
+# -- hierarchical sigmoid -----------------------------------------------------
+def _default_huffman_paths(num_classes):
+    """Complete-binary-tree path tables (loss.py hsigmoid_loss default tree):
+    internal nodes 0..num_classes-2; leaf c sits at heap position
+    num_classes-1+c; path = internal ancestors root->parent, code = branch
+    taken (1 = right child)."""
+    n_internal = num_classes - 1
+    tables, codes = [], []
+    max_len = 0
+    for c in range(num_classes):
+        pos = n_internal + c          # heap index of the leaf
+        path, code = [], []
+        while pos > 0:
+            parent = (pos - 1) // 2
+            path.append(parent)
+            code.append((pos - 1) % 2)  # 0 = left, 1 = right
+            pos = parent
+        path.reverse()
+        code.reverse()
+        tables.append(path)
+        codes.append(code)
+        max_len = max(max_len, len(path))
+    pt = np.full((num_classes, max_len), -1, np.int64)
+    pc = np.full((num_classes, max_len), -1, np.int64)
+    for c in range(num_classes):
+        pt[c, :len(tables[c])] = tables[c]
+        pc[c, :len(codes[c])] = codes[c]
+    return pt, pc
+
+
+@defop("hsigmoid_loss")
+def _hsigmoid_inner(x, w, bias, paths, codes):
+    # paths/codes: (N, L) with -1 padding; w: (num_nodes, D)
+    valid = paths >= 0
+    safe = jnp.maximum(paths, 0)
+    wsel = w[safe]                                   # (N, L, D)
+    logits = jnp.einsum("nld,nd->nl", wsel, x)
+    if bias is not None:
+        logits = logits + bias[safe]
+    # BCE with target = code: -[c*log s(z) + (1-c)*log(1-s(z))]
+    c = codes.astype(logits.dtype)
+    per_node = jnp.logaddexp(0.0, logits) - c * logits
+    per_node = jnp.where(valid, per_node, 0.0)
+    return jnp.sum(per_node, axis=1, keepdims=True)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """loss.py hsigmoid_loss: O(log C) hierarchical-sigmoid classification
+    cost over a complete binary tree (or a custom path_table/path_code)."""
+    import numpy as _np
+
+    from ...framework.core import Tensor as _T
+
+    label_np = _np.asarray(label.numpy() if isinstance(label, _T) else label,
+                           _np.int64).ravel()
+    if path_table is None:
+        pt, pc = _default_huffman_paths(int(num_classes))
+        paths = pt[label_np]
+        codes = pc[label_np]
+    else:
+        paths = _np.asarray(path_table.numpy()
+                            if isinstance(path_table, _T) else path_table)
+        codes = _np.asarray(path_code.numpy()
+                            if isinstance(path_code, _T) else path_code)
+        if paths.ndim == 2 and paths.shape[0] == int(num_classes):
+            paths, codes = paths[label_np], codes[label_np]
+    return _hsigmoid_inner(input, weight,
+                           bias if bias is not None else None,
+                           jnp.asarray(paths), jnp.asarray(codes))
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCDHW", name=None):
+    """pooling.py max_unpool3d via the flat-index 2d scatter (D*H*W plane)."""
+    from ...ops import manipulation as m
+
+    n, c, d, h, w = [int(s) for s in x.shape]
+    ks = (kernel_size,) * 3 if isinstance(kernel_size, int) \
+        else tuple(kernel_size)
+    st = ks if stride is None else (
+        (stride,) * 3 if isinstance(stride, int) else tuple(stride))
+    pd = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+    if output_size is None:
+        out_d = (d - 1) * st[0] + ks[0] - 2 * pd[0]
+        out_h = (h - 1) * st[1] + ks[1] - 2 * pd[1]
+        out_w = (w - 1) * st[2] + ks[2] - 2 * pd[2]
+    else:
+        out_d, out_h, out_w = [int(s) for s in output_size[-3:]]
+    x2 = m.reshape(x, [n, c, d * h * w, 1])
+    i2 = m.reshape(indices, [n, c, d * h * w, 1])
+    flat = _max_unpool2d_inner(x2, i2, out_d * out_h * out_w, 1)
+    return m.reshape(flat, [n, c, out_d, out_h, out_w])

@@ -127,7 +127,10 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_m
     ksize = _tup(kernel_size, 3)
     stride = _tup(stride, 3) if stride is not None else ksize
     pad = _pool_padding(padding, 3)
-    return _max_pool(x, ksize=ksize, stride=stride, padding=pad, data_format=data_format)
+    out = _max_pool(x, ksize=ksize, stride=stride, padding=pad, data_format=data_format)
+    if return_mask:
+        return out, _argmax_pool_mask3d(x, ksize, stride, pad, data_format)
+    return out
 
 
 def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
@@ -137,6 +140,42 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusiv
     pad = _pool_padding(padding, 3)
     return _avg_pool(x, ksize=ksize, stride=stride, padding=pad, data_format=data_format,
                      exclusive=bool(exclusive))
+
+
+def _argmax_pool_mask3d(x, ksize, stride, pad, data_format):
+    """3-D variant: flat per-channel D*H*W indices of each pooled maximum."""
+    v = x.value
+    if data_format != "NCDHW":
+        v = jnp.transpose(v, (0, 4, 1, 2, 3))
+    n, c, d, h, w = v.shape
+    kd, kh, kw = ksize
+    sd, sh, sw = stride
+    if isinstance(pad, str):
+        pd = ph = pw = 0
+    else:
+        pd, ph, pw = pad[0][0], pad[1][0], pad[2][0]
+    vp = jnp.pad(v, ((0, 0), (0, 0), (pd, pd), (ph, ph), (pw, pw)),
+                 constant_values=-jnp.inf)
+    od = (d + 2 * pd - kd) // sd + 1
+    oh = (h + 2 * ph - kh) // sh + 1
+    ow = (w + 2 * pw - kw) // sw + 1
+    cols = []
+    for a in range(kd):
+        for i in range(kh):
+            for j in range(kw):
+                cols.append(vp[:, :, a: a + od * sd: sd,
+                               i: i + oh * sh: sh, j: j + ow * sw: sw])
+    best = jnp.argmax(jnp.stack(cols, axis=-1), axis=-1)
+    da = best // (kh * kw)
+    ri = (best // kw) % kh
+    cj = best % kw
+    base_d = jnp.arange(od)[:, None, None] * sd
+    base_i = jnp.arange(oh)[None, :, None] * sh
+    base_j = jnp.arange(ow)[None, None, :] * sw
+    abs_d = base_d[None, None] + da - pd
+    abs_i = base_i[None, None] + ri - ph
+    abs_j = base_j[None, None] + cj - pw
+    return Tensor(((abs_d * h + abs_i) * w + abs_j).astype(jnp.int64))
 
 
 def _argmax_pool_mask(x, ksize, stride, pad, data_format):

@@ -155,3 +155,36 @@ class ZeroPad3D(Layer):
         p = [p] * 6 if isinstance(p, int) else list(p)
         return pad(x, p, mode="constant", value=0.0,
                    data_format=self._data_format)
+
+
+class MaxUnPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, output_size=None,
+                 data_format="NCDHW", name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding, output_size, data_format)
+
+    def forward(self, x, indices):
+        k, s, p, o, df = self._args
+        return F.max_unpool3d(x, indices, k, stride=s, padding=p,
+                              output_size=o, data_format=df)
+
+
+class HSigmoidLoss(Layer):
+    """loss.py HSigmoidLoss: holds the (num_classes-1, D) internal-node
+    weights (+bias) for the hierarchical sigmoid cost."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        if num_classes < 2:
+            raise ValueError("num_classes must be >= 2")
+        self.num_classes = num_classes
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size], attr=weight_attr)
+        self.bias = self.create_parameter([num_classes - 1], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias, path_table=path_table,
+                               path_code=path_code)

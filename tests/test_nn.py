@@ -387,3 +387,61 @@ class TestBeamSearchDecode:
         assert preds.shape[1] < 10      # stopped early
         assert int(np.asarray(states.lengths).max()) == 2
         np.testing.assert_array_equal(preds.numpy()[0, 1, :], 1)  # end token
+
+
+class TestHSigmoidAndUnpool3D:
+    def test_hsigmoid_matches_manual_path_bce(self):
+        paddle.seed(0)
+        C, D, N = 6, 8, 4
+        layer = nn.HSigmoidLoss(D, C)
+        r = np.random.RandomState(0)
+        x = paddle.to_tensor(r.randn(N, D).astype("float32"),
+                             stop_gradient=False)
+        label = paddle.to_tensor(np.array([0, 2, 5, 3], "int64"))
+        loss = layer(x, label)
+        assert tuple(loss.shape) == (N, 1)
+        # manual: walk the complete binary tree for sample 0 (label 0)
+        from paddle_tpu.nn.functional.extras import _default_huffman_paths
+
+        pt, pc = _default_huffman_paths(C)
+        w = layer.weight.numpy()
+        b = layer.bias.numpy()
+        xi = x.numpy()[0]
+        manual = 0.0
+        for node, code in zip(pt[0], pc[0]):
+            if node < 0:
+                continue
+            z = xi @ w[node] + b[node]
+            manual += np.logaddexp(0.0, z) - code * z
+        np.testing.assert_allclose(float(loss.numpy()[0, 0]), manual,
+                                   rtol=1e-5)
+        loss.sum().backward()
+        assert x.grad is not None and layer.weight.grad is not None
+
+    def test_hsigmoid_loss_decreases_under_training(self):
+        paddle.seed(1)
+        C, D = 8, 16
+        layer = nn.HSigmoidLoss(D, C)
+        x = paddle.to_tensor(np.random.RandomState(2)
+                             .randn(16, D).astype("float32"))
+        label = paddle.to_tensor(
+            np.random.RandomState(3).randint(0, C, 16).astype("int64"))
+        opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                    parameters=layer.parameters())
+        losses = []
+        for _ in range(20):
+            loss = layer(x, label).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < 0.3 * losses[0]
+
+    def test_max_unpool3d_roundtrip(self):
+        r = np.random.RandomState(5)
+        x = paddle.to_tensor(r.randn(1, 2, 4, 4, 4).astype("float32"))
+        pooled, mask = F.max_pool3d(x, 2, return_mask=True)
+        un = nn.MaxUnPool3D(2)(pooled, mask)
+        assert tuple(un.shape) == (1, 2, 4, 4, 4)
+        assert np.count_nonzero(un.numpy()) == pooled.numpy().size
+        np.testing.assert_allclose(un.numpy().max(), x.numpy().max())
