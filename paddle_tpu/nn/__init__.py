@@ -46,6 +46,7 @@ from .layer.rnn import (  # noqa: F401
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
 from .layer.rnn import RNNCellBase  # noqa: F401
 from .layer.extras import (  # noqa: F401
+    AdaptiveLogSoftmaxWithLoss,
     FeatureAlphaDropout,
     HSigmoidLoss,
     MaxUnPool3D,

@@ -188,3 +188,76 @@ class HSigmoidLoss(Layer):
         return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
                                self.bias, path_table=path_table,
                                path_code=path_code)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """loss.py AdaptiveLogSoftmaxWithLoss: frequency-partitioned softmax —
+    a head over the first cutoff + one token per tail cluster, each tail
+    cluster projected to in_features/div_value^(i+1) before its own softmax.
+    Returns (per-sample target log-prob, mean nll loss)."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        cutoffs = list(cutoffs)
+        if (cutoffs != sorted(cutoffs) or min(cutoffs) <= 0
+                or max(cutoffs) > n_classes - 1 or len(set(cutoffs)) != len(cutoffs)):
+            raise ValueError("cutoffs must be unique, positive, increasing "
+                             "and < n_classes")
+        self.in_features = in_features
+        self.n_classes = n_classes
+        self.cutoffs = cutoffs + [n_classes]
+        self.div_value = div_value
+        self.shortlist_size = self.cutoffs[0]
+        self.n_clusters = len(self.cutoffs) - 1
+        self.head_size = self.shortlist_size + self.n_clusters
+        self.head_weight = self.create_parameter(
+            [in_features, self.head_size])
+        self.head_bias = (self.create_parameter([self.head_size],
+                                                is_bias=True)
+                          if head_bias else None)
+        self.tail_weights = []
+        for i in range(self.n_clusters):
+            hsz = max(1, int(in_features / (div_value ** (i + 1))))
+            osz = self.cutoffs[i + 1] - self.cutoffs[i]
+            proj = self.create_parameter([in_features, hsz])
+            out = self.create_parameter([hsz, osz])
+            self.add_parameter(f"tail_proj_{i}", proj)
+            self.add_parameter(f"tail_out_{i}", out)
+            self.tail_weights.append((proj, out))
+
+    def _head_logprob(self, input):
+        h = input.matmul(self.head_weight)
+        if self.head_bias is not None:
+            h = h + self.head_bias
+        return F.log_softmax(h, axis=-1)
+
+    def _full_log_prob(self, input):
+        """(N, n_classes) full log-probabilities (log_prob method)."""
+        from ... import ops
+
+        head_lp = self._head_logprob(input)
+        parts = [head_lp[:, :self.shortlist_size]]
+        for i, (proj, out) in enumerate(self.tail_weights):
+            cluster_lp = F.log_softmax(
+                input.matmul(proj).matmul(out), axis=-1)
+            gate = head_lp[:, self.shortlist_size + i:
+                           self.shortlist_size + i + 1]
+            parts.append(cluster_lp + gate)
+        return ops.concat(parts, axis=-1)
+
+    def log_prob(self, input):
+        return self._full_log_prob(input)
+
+    def predict(self, input):
+        from ... import ops
+
+        return ops.argmax(self._full_log_prob(input), axis=-1)
+
+    def forward(self, input, label):
+        from ... import ops
+
+        full = self._full_log_prob(input)
+        out = ops.squeeze(ops.take_along_axis(
+            full, ops.unsqueeze(label.astype("int64"), -1), axis=-1), -1)
+        return out, -out.mean()

@@ -445,3 +445,45 @@ class TestHSigmoidAndUnpool3D:
         assert tuple(un.shape) == (1, 2, 4, 4, 4)
         assert np.count_nonzero(un.numpy()) == pooled.numpy().size
         np.testing.assert_allclose(un.numpy().max(), x.numpy().max())
+
+
+class TestAdaptiveLogSoftmax:
+    def test_log_probs_normalize_and_loss(self):
+        paddle.seed(0)
+        m = nn.AdaptiveLogSoftmaxWithLoss(16, 20, cutoffs=[4, 10],
+                                          head_bias=True)
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(8, 16).astype("float32"))
+        lp = m.log_prob(x)
+        assert tuple(lp.shape) == (8, 20)
+        # a proper distribution: logsumexp over classes == 0
+        np.testing.assert_allclose(
+            np.log(np.exp(lp.numpy()).sum(-1)), 0.0, atol=1e-5)
+        label = paddle.to_tensor(np.array([0, 3, 4, 9, 10, 19, 5, 1], "int64"))
+        out, loss = m(x, label)
+        np.testing.assert_allclose(
+            out.numpy(), lp.numpy()[np.arange(8), label.numpy()], rtol=1e-5)
+        np.testing.assert_allclose(float(loss.numpy()),
+                                   -out.numpy().mean(), rtol=1e-6)
+
+    def test_trains_and_predicts(self):
+        paddle.seed(1)
+        m = nn.AdaptiveLogSoftmaxWithLoss(8, 12, cutoffs=[3])
+        x = paddle.to_tensor(np.random.RandomState(1)
+                             .randn(24, 8).astype("float32"))
+        label = paddle.to_tensor(
+            np.random.RandomState(2).randint(0, 12, 24).astype("int64"))
+        opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                    parameters=m.parameters())
+        losses = []
+        for _ in range(30):
+            _, loss = m(x, label)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < 0.5 * losses[0]
+        acc = (m.predict(x).numpy() == label.numpy()).mean()
+        # tail clusters pass through a div_value bottleneck, so perfect
+        # memorization isn't reachable; well above the 1/12 chance level is
+        assert acc > 0.3
