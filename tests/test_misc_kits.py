@@ -332,3 +332,32 @@ class TestMovielens:
         # test split takes everything when test_ratio=1.0
         test = Movielens(data_file=str(path), mode="test", test_ratio=1.0)
         assert len(test) == 4
+
+
+class TestReaderCombinators:
+    def test_compose_and_transforms(self):
+        import paddle_tpu.reader as reader
+
+        r1 = lambda: iter(range(5))
+        r2 = lambda: iter(range(10, 15))
+        composed = reader.compose(r1, r2)
+        assert list(composed()) == [(i, 10 + i) for i in range(5)]
+        assert list(reader.firstn(r1, 3)()) == [0, 1, 2]
+        assert list(reader.chain(r1, r1)()) == list(range(5)) * 2
+        assert list(reader.map_readers(lambda a, b: a + b, r1, r2)()) == \
+            [10 + 2 * i for i in range(5)]
+        assert sorted(reader.shuffle(r1, 3)()) == list(range(5))
+        assert list(reader.buffered(r1, 2)()) == list(range(5))
+        calls = []
+        def once():
+            calls.append(1)
+            return iter(range(3))
+        cached = reader.cache(once)
+        assert list(cached()) == [0, 1, 2] and list(cached()) == [0, 1, 2]
+        assert len(calls) == 1
+        assert sorted(reader.xmap_readers(lambda x: x * 2, r1, 2, 4)()) == \
+            [0, 2, 4, 6, 8]
+        merged = sorted(reader.multiprocess_reader([r1, r2])())
+        assert merged == sorted(list(range(5)) + list(range(10, 15)))
+        with pytest.raises(reader.ComposeNotAligned):
+            list(reader.compose(r1, lambda: iter(range(3)))())
