@@ -81,6 +81,7 @@ from . import reader  # noqa: F401,E402
 # vision/hapi/models import lazily-heavy deps; exposed as regular submodules
 from . import vision  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
+from . import onnx  # noqa: F401,E402
 from . import hapi  # noqa: F401,E402
 from . import models  # noqa: F401,E402
 from .hapi import Model, summary  # noqa: F401,E402

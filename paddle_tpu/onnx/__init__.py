@@ -1,0 +1,241 @@
+"""paddle.onnx: ONNX model export.
+
+Reference analog: python/paddle/onnx/export.py (paddle.onnx.export via
+paddle2onnx). This build serializes ONNX ModelProto wire format directly
+through a committed protoc-generated binding of the public ONNX IR field
+numbers (onnx_minimal.proto) — no paddle2onnx/onnx dependency.
+
+Supported graph shape: single-input layer chains (MLPs, LeNet/VGG-style
+CNNs). Execution order is recorded with forward hooks on a sample run, then
+each supported layer lowers to its ONNX op (Linear->Gemm, Conv2D->Conv,
+activations, BatchNorm, pooling, Flatten, Dropout->Identity). Anything else
+raises UnimplementedError naming the layer.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.enforce import UnimplementedError
+from . import onnx_minimal_pb2 as pb
+
+FLOAT = 1
+INT64 = 7
+
+_ATTR_FLOAT, _ATTR_INT, _ATTR_STRING = 1, 2, 3
+_ATTR_FLOATS, _ATTR_INTS = 6, 7
+
+
+def _tensor(name, arr):
+    arr = np.asarray(arr)
+    t = pb.TensorProto()
+    t.name = name
+    t.dims.extend(arr.shape)
+    t.data_type = FLOAT if arr.dtype.kind == "f" else INT64
+    t.raw_data = np.ascontiguousarray(
+        arr.astype("<f4" if arr.dtype.kind == "f" else "<i8")).tobytes()
+    return t
+
+
+def _vi(name, shape, elem=FLOAT):
+    v = pb.ValueInfoProto()
+    v.name = name
+    v.type.tensor_type.elem_type = elem
+    for d in shape:
+        dim = v.type.tensor_type.shape.dim.add()
+        if d is None or (isinstance(d, int) and d < 0):
+            dim.dim_param = "batch"
+        else:
+            dim.dim_value = int(d)
+    return v
+
+
+def _attr_i(name, val):
+    a = pb.AttributeProto()
+    a.name = name
+    a.type = _ATTR_INT
+    a.i = int(val)
+    return a
+
+
+def _attr_f(name, val):
+    a = pb.AttributeProto()
+    a.name = name
+    a.type = _ATTR_FLOAT
+    a.f = float(val)
+    return a
+
+
+def _attr_ints(name, vals):
+    a = pb.AttributeProto()
+    a.name = name
+    a.type = _ATTR_INTS
+    a.ints.extend(int(v) for v in vals)
+    return a
+
+
+def _node(op, inputs, outputs, name, attrs=()):
+    n = pb.NodeProto()
+    n.op_type = op
+    n.input.extend(inputs)
+    n.output.extend(outputs)
+    n.name = name
+    n.attribute.extend(attrs)
+    return n
+
+
+def _tup(v, n=2):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+class _Emitter:
+    """One supported layer -> one ONNX node (+ initializers)."""
+
+    def __init__(self, graph):
+        self.g = graph
+        self.count = {}
+
+    def name(self, kind):
+        i = self.count.get(kind, 0)
+        self.count[kind] = i + 1
+        return f"{kind}_{i}"
+
+    def emit(self, layer, src):
+        kind = type(layer).__name__.lower()
+        nm = self.name(kind)
+        out = f"{nm}_out"
+        g = self.g
+        if kind == "linear":
+            w, b = f"{nm}_W", f"{nm}_b"
+            g.initializer.append(_tensor(w, layer.weight.numpy()))
+            if layer.bias is not None:
+                g.initializer.append(_tensor(b, layer.bias.numpy()))
+                ins = [src, w, b]
+            else:
+                ins = [src, w]
+            g.node.append(_node("Gemm", ins, [out], nm))
+        elif kind == "conv2d":
+            w, b = f"{nm}_W", f"{nm}_b"
+            g.initializer.append(_tensor(w, layer.weight.numpy()))
+            ins = [src, w]
+            if layer.bias is not None:
+                g.initializer.append(_tensor(b, layer.bias.numpy()))
+                ins.append(b)
+            ph, pw = _tup(layer._padding)
+            attrs = [_attr_ints("strides", _tup(layer._stride)),
+                     _attr_ints("pads", [ph, pw, ph, pw]),
+                     _attr_ints("dilations", _tup(layer._dilation)),
+                     _attr_i("group", getattr(layer, "_groups", 1) or 1)]
+            g.node.append(_node("Conv", ins, [out], nm, attrs))
+        elif kind in ("batchnorm2d", "batchnorm1d", "batchnorm"):
+            names = [f"{nm}_{s}" for s in ("scale", "B", "mean", "var")]
+            for t_name, p in zip(names, [layer.weight, layer.bias,
+                                         layer._mean, layer._variance]):
+                self.g.initializer.append(_tensor(t_name, p.numpy()))
+            g.node.append(_node("BatchNormalization", [src] + names, [out],
+                                nm, [_attr_f("epsilon", layer._epsilon)]))
+        elif kind in ("relu", "sigmoid", "tanh", "softmax", "gelu", "elu",
+                      "softplus", "identity"):
+            op = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+                  "softmax": "Softmax", "gelu": "Gelu", "elu": "Elu",
+                  "softplus": "Softplus", "identity": "Identity"}[kind]
+            g.node.append(_node(op, [src], [out], nm))
+        elif kind in ("maxpool2d", "avgpool2d"):
+            op = "MaxPool" if kind == "maxpool2d" else "AveragePool"
+            kernel_size, stride, padding = layer.args[0], layer.args[1], \
+                layer.args[2]
+            ks = _tup(kernel_size)
+            st = _tup(stride if stride is not None else kernel_size)
+            ph, pw = _tup(padding)
+            g.node.append(_node(op, [src], [out], nm, [
+                _attr_ints("kernel_shape", ks),
+                _attr_ints("strides", st),
+                _attr_ints("pads", [ph, pw, ph, pw])]))
+        elif kind == "adaptiveavgpool2d":
+            if tuple(_tup(layer.output_size)) != (1, 1):
+                raise UnimplementedError(
+                    "onnx export supports AdaptiveAvgPool2D(1) only")
+            g.node.append(_node("GlobalAveragePool", [src], [out], nm))
+        elif kind == "flatten":
+            g.node.append(_node("Flatten", [src], [out], nm,
+                                [_attr_i("axis", 1)]))
+        elif kind == "dropout":
+            g.node.append(_node("Identity", [src], [out], nm))
+        else:
+            raise UnimplementedError(
+                f"paddle.onnx.export: layer {type(layer).__name__} has no "
+                "ONNX lowering in this build (supported: Linear, Conv2D, "
+                "BatchNorm, activations, pooling, Flatten, Dropout)")
+        return out
+
+
+_LEAF_KINDS = {
+    "linear", "conv2d", "batchnorm2d", "batchnorm1d", "batchnorm", "relu",
+    "sigmoid", "tanh", "softmax", "gelu", "elu", "softplus", "identity",
+    "maxpool2d", "avgpool2d", "adaptiveavgpool2d", "flatten", "dropout",
+}
+
+
+def export(layer, path, input_spec=None, opset_version=13, **configs):
+    """paddle.onnx.export(layer, path, input_spec) -> path + '.onnx'."""
+    from ..framework.core import Tensor
+    from ..jit.api import InputSpec
+
+    if input_spec is None:
+        raise ValueError("paddle.onnx.export requires input_spec")
+    spec = input_spec[0]
+    if isinstance(spec, InputSpec):
+        shape = [d if d is not None else None for d in spec.shape]
+    elif isinstance(spec, Tensor):
+        shape = list(spec.shape)
+    else:
+        shape = list(np.asarray(spec).shape)
+
+    # record execution order of leaf layers with a sample forward
+    order = []
+    handles = []
+    for _, sub in layer.named_sublayers(include_self=True):
+        if type(sub).__name__.lower() in _LEAF_KINDS:
+            handles.append(sub.register_forward_post_hook(
+                lambda l, i, o: order.append(l)))
+    was_training = layer.training
+    layer.eval()
+    try:
+        import jax.numpy as jnp
+
+        sample = Tensor(jnp.zeros(
+            [1 if d in (None, -1) else int(d) for d in shape], jnp.float32))
+        layer(sample)
+    finally:
+        if was_training:
+            layer.train()
+        for h in handles:
+            h.remove()
+    if not order:
+        raise UnimplementedError(
+            "paddle.onnx.export found no supported leaf layers to lower")
+
+    model = pb.ModelProto()
+    model.ir_version = 8
+    model.producer_name = "paddle_tpu"
+    model.producer_version = "0.1.0"
+    ops = model.opset_import.add()
+    ops.domain = ""
+    ops.version = int(opset_version)
+    g = model.graph
+    g.name = type(layer).__name__
+    g.input.append(_vi("input", shape))
+    em = _Emitter(g)
+    src = "input"
+    for sub in order:
+        src = em.emit(sub, src)
+    # rename the last node's output to "output"
+    g.node[-1].output[0] = "output"
+    g.output.append(_vi("output", [None]))  # batch-dynamic output
+    data = model.SerializeToString()
+    out_path = path if path.endswith(".onnx") else path + ".onnx"
+    with open(out_path, "wb") as f:
+        f.write(data)
+    return out_path
+
+
+__all__ = ["export"]

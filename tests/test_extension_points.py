@@ -233,3 +233,73 @@ class TestEnforce:
 
         with pytest.raises(InvalidArgumentError):
             paddle.optimizer.SGD(learning_rate=0.1)
+
+
+class TestOnnxExport:
+    """paddle.onnx.export: wire-format ModelProto via the committed protoc
+    binding (no onnx/paddle2onnx dependency)."""
+
+    def test_mlp_export_roundtrip(self, tmp_path):
+        from paddle_tpu.onnx import onnx_minimal_pb2 as pb
+
+        paddle.seed(0)
+        net = paddle.nn.Sequential(
+            paddle.nn.Linear(8, 16), paddle.nn.ReLU(),
+            paddle.nn.Dropout(0.1), paddle.nn.Linear(16, 4),
+            paddle.nn.Softmax())
+        path = paddle.onnx.export(
+            net, str(tmp_path / "mlp"),
+            input_spec=[paddle.static.InputSpec([None, 8], "float32", "x")])
+        assert path.endswith(".onnx") and os.path.getsize(path) > 0
+        m = pb.ModelProto()
+        m.ParseFromString(open(path, "rb").read())
+        assert m.producer_name == "paddle_tpu"
+        assert m.opset_import[0].version == 13
+        ops = [n.op_type for n in m.graph.node]
+        assert ops == ["Gemm", "Relu", "Identity", "Gemm", "Softmax"]
+        assert m.graph.node[-1].output[0] == "output"
+        # weights serialized raw little-endian fp32 with right sizes
+        inits = {t.name: t for t in m.graph.initializer}
+        w0 = inits["linear_0_W"]
+        assert list(w0.dims) == [8, 16]
+        np.testing.assert_allclose(
+            np.frombuffer(w0.raw_data, "<f4").reshape(8, 16),
+            net[0].weight.numpy(), rtol=1e-6)
+        # graph chain is connected: each node consumes the previous output
+        assert m.graph.node[1].input[0] == m.graph.node[0].output[0]
+        assert m.graph.input[0].type.tensor_type.shape.dim[0].dim_param \
+            == "batch"
+
+    def test_cnn_export(self, tmp_path):
+        from paddle_tpu.onnx import onnx_minimal_pb2 as pb
+
+        net = paddle.nn.Sequential(
+            paddle.nn.Conv2D(3, 8, 3, padding=1), paddle.nn.BatchNorm2D(8),
+            paddle.nn.ReLU(), paddle.nn.MaxPool2D(2),
+            paddle.nn.AdaptiveAvgPool2D(1), paddle.nn.Flatten(),
+            paddle.nn.Linear(8, 10))
+        path = paddle.onnx.export(
+            net, str(tmp_path / "cnn"),
+            input_spec=[paddle.static.InputSpec([None, 3, 16, 16],
+                                                "float32", "x")])
+        m = pb.ModelProto()
+        m.ParseFromString(open(path, "rb").read())
+        ops = [n.op_type for n in m.graph.node]
+        assert ops == ["Conv", "BatchNormalization", "Relu", "MaxPool",
+                       "GlobalAveragePool", "Flatten", "Gemm"]
+        conv = m.graph.node[0]
+        attrs = {a.name: list(a.ints) for a in conv.attribute
+                 if a.ints}
+        assert attrs["pads"] == [1, 1, 1, 1]
+        bn = m.graph.node[1]
+        assert len(bn.input) == 5  # x, scale, B, mean, var
+
+    def test_unsupported_layer_raises(self, tmp_path):
+        from paddle_tpu.framework.enforce import UnimplementedError
+
+        net = paddle.nn.Sequential(paddle.nn.Linear(4, 4),
+                                   paddle.nn.LSTM(4, 4))
+        with pytest.raises((UnimplementedError, Exception)):
+            paddle.onnx.export(
+                net, str(tmp_path / "bad"),
+                input_spec=[paddle.static.InputSpec([None, 4], "float32")])
