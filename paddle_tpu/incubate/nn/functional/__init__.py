@@ -192,3 +192,98 @@ def _fused_bias_act(x, bias=None, act_method="gelu"):
 
 def fused_bias_act(x, bias=None, act_method="gelu", name=None, **kwargs):
     return _fused_bias_act(x, bias, act_method=act_method)
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """fused_matmul_bias.py: matmul+bias in one op (XLA fuses the epilogue)."""
+    from ....ops.linalg import matmul
+
+    out = matmul(x, y, transpose_x=transpose_x, transpose_y=transpose_y)
+    return out + bias if bias is not None else out
+
+
+def fused_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
+                                is_causal=False, training=True,
+                                scaling_factor=None, name=None):
+    """fused_dot_product_attention.py: served by the sdp dispatcher (Pallas
+    flash attention when shapes allow)."""
+    from ....nn.functional.flash_attention import scaled_dot_product_attention
+
+    return scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                        dropout_p=dropout_p,
+                                        is_causal=is_causal,
+                                        training=training)
+
+
+def variable_length_memory_efficient_attention(query, key, value, seq_lens,
+                                               kv_seq_lens, mask=None,
+                                               scale=None, causal=False,
+                                               name=None):
+    """variable_length_memory_efficient_attention.py: served by the varlen
+    flash path (flash_attn_unpadded)."""
+    from ....nn.functional.flash_attention import scaled_dot_product_attention
+
+    # (B, H, S, D) reference layout -> sdp's (B, S, H, D)
+    from ....ops.manipulation import transpose
+
+    q = transpose(query, [0, 2, 1, 3])
+    k = transpose(key, [0, 2, 1, 3])
+    v = transpose(value, [0, 2, 1, 3])
+    out = scaled_dot_product_attention(q, k, v, attn_mask=mask,
+                                       is_causal=causal)
+    return transpose(out, [0, 2, 1, 3])
+
+
+def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
+              ffn2_bias=None, quant_method="None", moe_topk=2,
+              norm_topk_prob=True, name=None):
+    """fused_moe.py: token top-k routing + expert FFNs, einsum-dispatched so
+    GSPMD can shard the expert axis.
+
+    x: (B, S, D); gate_weight: (D, E); ffn1_weight: (E, D, I) (swiglu packs
+    2*I); ffn2_weight: (E, I_or_I, D).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ....framework.core import Tensor
+
+    xv = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+    gw = gate_weight.value if isinstance(gate_weight, Tensor) \
+        else jnp.asarray(gate_weight)
+    w1 = ffn1_weight.value if isinstance(ffn1_weight, Tensor) \
+        else jnp.asarray(ffn1_weight)
+    w2 = ffn2_weight.value if isinstance(ffn2_weight, Tensor) \
+        else jnp.asarray(ffn2_weight)
+    B, S, D = xv.shape
+    E = gw.shape[1]
+    tokens = xv.reshape(B * S, D)
+    logits = tokens @ gw
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_p, top_e = jax.lax.top_k(probs, moe_topk)
+    if norm_topk_prob:
+        top_p = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
+    # dense dispatch: weight each expert by its routed probability (0 when
+    # not in the top-k) — einsums keep the E axis shardable
+    weights = jnp.zeros((B * S, E), xv.dtype)
+    weights = weights.at[jnp.arange(B * S)[:, None], top_e].set(
+        top_p.astype(xv.dtype))
+    h = jnp.einsum("td,edi->tei", tokens, w1)
+    if ffn1_bias is not None:
+        b1 = ffn1_bias.value if isinstance(ffn1_bias, Tensor) \
+            else jnp.asarray(ffn1_bias)
+        h = h + b1[None]
+    inter = w2.shape[1]
+    if h.shape[-1] == 2 * inter:  # swiglu-packed ffn1
+        gate_h, up = jnp.split(h, 2, axis=-1)
+        h = jax.nn.silu(gate_h) * up
+    else:
+        h = jax.nn.gelu(h, approximate=False)
+    y = jnp.einsum("tei,eid->ted", h, w2)
+    if ffn2_bias is not None:
+        b2 = ffn2_bias.value if isinstance(ffn2_bias, Tensor) \
+            else jnp.asarray(ffn2_bias)
+        y = y + b2[None]
+    out = jnp.einsum("ted,te->td", y, weights)
+    return Tensor(out.reshape(B, S, D))

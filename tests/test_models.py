@@ -356,3 +356,71 @@ class TestBert:
         out_tp, _ = m_tp(ids)
         np.testing.assert_allclose(out_ref.numpy(), out_tp.numpy(),
                                    rtol=2e-4, atol=2e-4)
+
+
+class TestFusedIncubateExtras:
+    def test_fused_matmul_bias_and_sdpa_wrappers(self):
+        from paddle_tpu.incubate.nn import functional as IF
+
+        r = np.random.RandomState(0)
+        x = paddle.to_tensor(r.randn(3, 4).astype("float32"))
+        w = paddle.to_tensor(r.randn(4, 5).astype("float32"))
+        b = paddle.to_tensor(r.randn(5).astype("float32"))
+        np.testing.assert_allclose(
+            IF.fused_matmul_bias(x, w, b).numpy(),
+            x.numpy() @ w.numpy() + b.numpy(), rtol=1e-5)
+        q = paddle.to_tensor(r.randn(1, 6, 2, 8).astype("float32"))
+        out = IF.fused_dot_product_attention(q, q, q, is_causal=True)
+        assert tuple(out.shape) == (1, 6, 2, 8)
+        qh = paddle.to_tensor(r.randn(1, 2, 6, 8).astype("float32"))
+        out2 = IF.variable_length_memory_efficient_attention(
+            qh, qh, qh, None, None, causal=True)
+        assert tuple(out2.shape) == (1, 2, 6, 8)
+        # same math, different layouts
+        np.testing.assert_allclose(
+            out2.numpy().transpose(0, 2, 1, 3),
+            IF.fused_dot_product_attention(
+                paddle.to_tensor(qh.numpy().transpose(0, 2, 1, 3)),
+                paddle.to_tensor(qh.numpy().transpose(0, 2, 1, 3)),
+                paddle.to_tensor(qh.numpy().transpose(0, 2, 1, 3)),
+                is_causal=True).numpy(), rtol=1e-5)
+
+    def test_fused_moe_matches_manual_topk_mixture(self):
+        from paddle_tpu.incubate.nn import functional as IF
+
+        r = np.random.RandomState(1)
+        B, S, D, E, I = 2, 3, 4, 4, 8
+        x = r.randn(B, S, D).astype("float32")
+        gw = r.randn(D, E).astype("float32")
+        w1 = r.randn(E, D, I).astype("float32")
+        w2 = r.randn(E, I, D).astype("float32")
+        out = IF.fused_moe(paddle.to_tensor(x), paddle.to_tensor(gw),
+                           paddle.to_tensor(w1), paddle.to_tensor(w2),
+                           moe_topk=2).numpy()
+        # manual reference
+        toks = x.reshape(-1, D)
+        logits = toks @ gw
+        p = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+        expect = np.zeros_like(toks)
+        from scipy.special import erf
+        gelu = lambda v: v * 0.5 * (1 + erf(v / np.sqrt(2.0)))
+        for t in range(toks.shape[0]):
+            top = np.argsort(-p[t])[:2]
+            wsum = p[t][top].sum()
+            for e in top:
+                h = gelu(toks[t] @ w1[e])
+                expect[t] += (p[t][e] / wsum) * (h @ w2[e])
+        np.testing.assert_allclose(out.reshape(-1, D), expect, rtol=2e-4,
+                                   atol=1e-5)
+
+    def test_fused_moe_swiglu_packing(self):
+        from paddle_tpu.incubate.nn import functional as IF
+
+        r = np.random.RandomState(2)
+        x = paddle.to_tensor(r.randn(1, 2, 4).astype("float32"))
+        gw = paddle.to_tensor(r.randn(4, 2).astype("float32"))
+        w1 = paddle.to_tensor(r.randn(2, 4, 16).astype("float32"))  # 2*I
+        w2 = paddle.to_tensor(r.randn(2, 8, 4).astype("float32"))
+        out = IF.fused_moe(x, gw, w1, w2, moe_topk=1)
+        assert tuple(out.shape) == (1, 2, 4)
+        assert np.isfinite(out.numpy()).all()
