@@ -63,6 +63,13 @@ from . import sparse  # noqa: F401,E402
 from . import signal  # noqa: F401,E402
 from . import framework  # noqa: F401,E402
 from . import linalg  # noqa: F401,E402
+
+# `from .ops import *` bound `linalg` to the ops submodule first, which makes
+# the from-import above a no-op (the parent attr already exists) — import the
+# public module explicitly and force it to win
+import importlib as _importlib  # noqa: E402
+
+linalg = _importlib.import_module("paddle_tpu.linalg")
 from . import nn  # noqa: F401,E402
 from . import optimizer  # noqa: F401,E402
 from . import io  # noqa: F401,E402

@@ -6,3 +6,77 @@ from .ops.linalg import (  # noqa: F401
 )
 from .ops.reduction import norm  # noqa: F401
 from .ops.linalg import matmul  # noqa: F401
+from .ops.math import cross, diagonal  # noqa: F401,E402
+from .ops.compat import matrix_transpose, vecdot  # noqa: F401,E402
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    """linalg.vector_norm (reference linalg.py): entry-wise p-norm."""
+    from .ops.reduction import norm as _norm
+
+    return _norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    """linalg.matrix_norm: fro/nuc/±1/±2/±inf over the trailing matrix dims."""
+    import jax.numpy as jnp
+
+    from .framework.core import Tensor
+
+    v = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+    ord_map = {"fro": "fro", "nuc": "nuc"}
+    ordv = ord_map.get(p, p)
+    out = jnp.linalg.norm(v, ord=ordv, axis=tuple(axis), keepdims=keepdim)
+    return Tensor(out)
+
+
+def svdvals(x, name=None):
+    """linalg.svdvals: singular values only."""
+    import jax.numpy as jnp
+
+    from .framework.core import Tensor
+
+    v = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jnp.linalg.svd(v, compute_uv=False))
+
+
+def ormqr(x, tau, other, left=True, transpose=False, name=None):
+    """linalg.ormqr: multiply by Q from a householder QR (geqrf output)."""
+    import jax.numpy as jnp
+
+    from .framework.core import Tensor
+    from .ops.linalg import householder_product
+
+    q = householder_product(x, tau)
+    qv = q.value if isinstance(q, Tensor) else q
+    ov = other.value if isinstance(other, Tensor) else jnp.asarray(other)
+    if transpose:
+        qv = jnp.swapaxes(qv, -1, -2)
+    return Tensor(qv @ ov if left else ov @ qv)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """linalg.pca_lowrank: randomized PCA (torch-compatible semantics the
+    reference mirrors): returns (U, S, V) of the centered matrix."""
+    import jax
+    import jax.numpy as jnp
+
+    from .framework import random as rng
+    from .framework.core import Tensor
+
+    v = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+    m, n = v.shape[-2], v.shape[-1]
+    if q is None:
+        q = min(6, m, n)
+    if center:
+        v = v - jnp.mean(v, axis=-2, keepdims=True)
+    # randomized range finder
+    omega = jax.random.normal(rng.next_key(), v.shape[:-2] + (n, q), v.dtype)
+    y = v @ omega
+    for _ in range(niter):
+        y = v @ (jnp.swapaxes(v, -1, -2) @ y)
+    Q, _ = jnp.linalg.qr(y)
+    b = jnp.swapaxes(Q, -1, -2) @ v
+    u_b, s, vt = jnp.linalg.svd(b, full_matrices=False)
+    return (Tensor(Q @ u_b), Tensor(s),
+            Tensor(jnp.swapaxes(vt, -1, -2)))

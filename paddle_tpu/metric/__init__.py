@@ -165,3 +165,17 @@ class Auc(Metric):
 
     def name(self):
         return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Functional top-k accuracy (paddle.metric.accuracy)."""
+    import jax.numpy as jnp
+
+    from ..framework.core import Tensor
+
+    v = input.value if isinstance(input, Tensor) else jnp.asarray(input)
+    lv = label.value if isinstance(label, Tensor) else jnp.asarray(label)
+    lv = lv.reshape(lv.shape[0], -1)[:, 0]
+    topk = jnp.argsort(-v, axis=-1)[:, :k]
+    hit = jnp.any(topk == lv[:, None], axis=-1)
+    return Tensor(jnp.mean(hit.astype(jnp.float32)))

@@ -243,3 +243,35 @@ class TestCompatSurface:
         assert lin.weight is not None
         n = paddle.flops(paddle.nn.Linear(8, 4), [2, 8])
         assert n == 2 * 2 * 4 * 8
+
+
+class TestLinalgExtras:
+    def test_norms_svdvals_ormqr_pca(self):
+        import paddle_tpu.linalg as L
+
+        r = np.random.RandomState(0)
+        x = paddle.to_tensor(r.randn(6, 4).astype("float32"))
+        np.testing.assert_allclose(
+            float(L.vector_norm(x).numpy()),
+            np.linalg.norm(x.numpy()), rtol=1e-5)
+        np.testing.assert_allclose(
+            float(L.matrix_norm(x).numpy()),
+            np.linalg.norm(x.numpy(), "fro"), rtol=1e-5)
+        np.testing.assert_allclose(
+            L.svdvals(x).numpy(),
+            np.linalg.svd(x.numpy(), compute_uv=False), rtol=1e-5)
+        U, S, V = L.pca_lowrank(x, q=4)
+        centered = x.numpy() - x.numpy().mean(0)
+        rec = U.numpy() @ np.diag(S.numpy()) @ V.numpy().T
+        np.testing.assert_allclose(rec, centered, atol=1e-4)
+        assert paddle.linalg.__name__ == "paddle_tpu.linalg"  # shadow guard
+
+    def test_metric_accuracy_functional(self):
+        logits = paddle.to_tensor(np.array(
+            [[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]], "float32"))
+        label = paddle.to_tensor(np.array([1, 0, 0], "int64"))
+        np.testing.assert_allclose(
+            float(paddle.metric.accuracy(logits, label).numpy()), 2.0 / 3.0,
+            rtol=1e-6)
+        np.testing.assert_allclose(
+            float(paddle.metric.accuracy(logits, label, k=2).numpy()), 1.0)
