@@ -89,5 +89,5 @@ class ViterbiDecoder(Layer):
 # -- datasets (reference python/paddle/text/datasets/) -----------------------
 from . import text_datasets as datasets  # noqa: E402,F401
 from .text_datasets import (  # noqa: E402,F401
-    Imdb, Imikolov, Movielens, UCIHousing,
+    Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16,
 )

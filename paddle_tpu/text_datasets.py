@@ -168,8 +168,9 @@ class Imdb(Dataset):
         return len(self.docs)
 
 
-__all__ = ["UCIHousing", "Imikolov", "Imdb", "Movielens",
-           "MovieInfo", "UserInfo", "UCI_FEATURE_NAMES"]
+__all__ = ["UCIHousing", "Imikolov", "Imdb", "Movielens", "WMT14",
+           "WMT16", "Conll05st", "MovieInfo", "UserInfo",
+           "UCI_FEATURE_NAMES"]
 
 
 AGE_TABLE = [1, 18, 25, 35, 45, 50, 56]
@@ -261,3 +262,115 @@ class Movielens(Dataset):
 
     def __len__(self):
         return len(self.data)
+
+
+_WMT_START, _WMT_END, _WMT_UNK_IDX = "<s>", "<e>", 2
+
+
+class WMT14(Dataset):
+    """wmt14.py: tarball with {mode}/{mode} tab-separated parallel text and
+    src.dict/trg.dict vocab files; yields (src_ids, trg_ids, trg_ids_next)
+    with <s>/<e> framing and the reference's 80-token length cap."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=30000):
+        if data_file is None:
+            _no_download("WMT14", "data_file")
+        self.mode = mode.lower()
+        self.dict_size = dict_size
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(data_file, "r:*") as tf:
+            def to_dict(suffix):
+                names = [m.name for m in tf.getmembers()
+                         if m.name.endswith(suffix)]
+                if len(names) != 1:
+                    raise ValueError(f"expected one {suffix} in the archive")
+                out = {}
+                for i, line in enumerate(tf.extractfile(names[0])):
+                    if i >= self.dict_size:
+                        break
+                    out[line.strip().decode()] = i
+                return out
+
+            self.src_dict = to_dict("src.dict")
+            self.trg_dict = to_dict("trg.dict")
+            data_names = [m.name for m in tf.getmembers()
+                          if m.name.endswith(f"{self.mode}/{self.mode}")]
+            for name in data_names:
+                for line in tf.extractfile(name):
+                    parts = line.decode().strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    src = [self.src_dict.get(w, _WMT_UNK_IDX)
+                           for w in [_WMT_START, *parts[0].split(), _WMT_END]]
+                    trg = [self.trg_dict.get(w, _WMT_UNK_IDX)
+                           for w in parts[1].split()]
+                    if len(src) > 80 or len(trg) > 80:
+                        continue
+                    self.trg_ids_next.append(
+                        np.array([*trg, self.trg_dict[_WMT_END]], "int64"))
+                    self.trg_ids.append(
+                        np.array([self.trg_dict[_WMT_START], *trg], "int64"))
+                    self.src_ids.append(np.array(src, "int64"))
+
+    def __getitem__(self, idx):
+        return (self.src_ids[idx], self.trg_ids[idx], self.trg_ids_next[idx])
+
+    def __len__(self):
+        return len(self.src_ids)
+
+
+class WMT16(WMT14):
+    """wmt16.py: same sample shape; vocab built from archive dict files
+    named wmt16/{src,trg}.dict (the reference builds them on first use)."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=30000,
+                 trg_dict_size=30000, lang="en"):
+        super().__init__(data_file=data_file, mode=mode,
+                         dict_size=max(src_dict_size, trg_dict_size))
+
+
+class Conll05st(Dataset):
+    """conll05.py: semantic-role-labeling corpus; this reader consumes the
+    preprocessed tarball layout (conll05st-release/{mode} files with
+    word/predicate/label columns) plus word/verb/label dict files."""
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, mode="train"):
+        if data_file is None:
+            _no_download("Conll05st", "data_file")
+        self.mode = mode.lower()
+
+        def load_dict(path):
+            with open(path) as f:
+                return {ln.strip(): i for i, ln in enumerate(f)
+                        if ln.strip()}
+
+        self.word_dict = load_dict(word_dict_file)
+        self.verb_dict = load_dict(verb_dict_file)
+        self.label_dict = load_dict(target_dict_file)
+        unk = self.word_dict.get("<unk>", 0)
+        self.samples = []
+        # one sentence per line: "w1 w2 ... ||| verb ||| l1 l2 ..."
+        with open(data_file) as f:
+            for line in f:
+                parts = [p.strip() for p in line.split("|||")]
+                if len(parts) != 3:
+                    continue
+                words, verb, labels = (parts[0].split(), parts[1],
+                                       parts[2].split())
+                self.samples.append((
+                    np.array([self.word_dict.get(w, unk) for w in words],
+                             "int64"),
+                    np.int64(self.verb_dict.get(verb, 0)),
+                    np.array([self.label_dict.get(l, 0) for l in labels],
+                             "int64"),
+                ))
+
+    def get_dict(self):
+        return self.word_dict, self.verb_dict, self.label_dict
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)

@@ -361,3 +361,51 @@ class TestReaderCombinators:
         assert merged == sorted(list(range(5)) + list(range(10, 15)))
         with pytest.raises(reader.ComposeNotAligned):
             list(reader.compose(r1, lambda: iter(range(3)))())
+
+
+class TestWMTAndConll:
+    def test_wmt14_parser(self, tmp_path):
+        import io as _io
+        import tarfile
+        from paddle_tpu.text import WMT14
+
+        vocab = "<s>\n<e>\n<unk>\nhello\nworld\nbonjour\nmonde\n"
+        data = "hello world\tbonjour monde\nhello\tbonjour\n"
+        path = tmp_path / "wmt.tar.gz"
+        with tarfile.open(path, "w:gz") as tf:
+            for name, text in [("wmt14/src.dict", vocab),
+                               ("wmt14/trg.dict", vocab),
+                               ("wmt14/train/train", data),
+                               ("wmt14/test/test", data[:12] + "\t" +
+                                data[12:18] + "\n")]:
+                b = text.encode()
+                info = tarfile.TarInfo(name)
+                info.size = len(b)
+                tf.addfile(info, _io.BytesIO(b))
+        ds = WMT14(data_file=str(path), mode="train")
+        assert len(ds) == 2
+        src, trg, trg_next = ds[0]
+        assert src[0] == ds.src_dict["<s>"] and src[-1] == ds.src_dict["<e>"]
+        assert trg[0] == ds.trg_dict["<s>"]
+        assert trg_next[-1] == ds.trg_dict["<e>"]
+        np.testing.assert_array_equal(trg[1:], trg_next[:-1])
+
+    def test_conll05_parser(self, tmp_path):
+        from paddle_tpu.text import Conll05st
+
+        (tmp_path / "words.dict").write_text("<unk>\nthe\ncat\nsat\n")
+        (tmp_path / "verbs.dict").write_text("sit\nrun\n")
+        (tmp_path / "labels.dict").write_text("O\nB-A0\nB-V\n")
+        (tmp_path / "data.txt").write_text(
+            "the cat sat ||| sit ||| B-A0 O B-V\n")
+        ds = Conll05st(data_file=str(tmp_path / "data.txt"),
+                       word_dict_file=str(tmp_path / "words.dict"),
+                       verb_dict_file=str(tmp_path / "verbs.dict"),
+                       target_dict_file=str(tmp_path / "labels.dict"))
+        assert len(ds) == 1
+        words, verb, labels = ds[0]
+        np.testing.assert_array_equal(words, [1, 2, 3])
+        assert int(verb) == 0
+        np.testing.assert_array_equal(labels, [1, 0, 2])
+        wd, vd, ld = ds.get_dict()
+        assert wd["cat"] == 2 and ld["B-V"] == 2
