@@ -18,7 +18,8 @@ import numpy as np
 
 from ...io.dataset import Dataset
 
-__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData"]
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData",
+           "DatasetFolder", "ImageFolder", "Flowers", "VOC2012"]
 
 
 def _no_download(cls, path_arg):
@@ -133,3 +134,171 @@ class FakeData(Dataset):
 
     def __len__(self):
         return self.size
+
+
+class DatasetFolder(Dataset):
+    """folder.py DatasetFolder: root/class_x/xxx.ext layout; classes from
+    subdirectory names, samples loaded with PIL (or a custom loader)."""
+
+    IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm",
+                      ".tif", ".tiff", ".webp")
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        import os
+
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self.default_loader
+        extensions = tuple(extensions or self.IMG_EXTENSIONS)
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise ValueError(f"no class folders found under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for fn in sorted(files):
+                    ok = (is_valid_file(fn) if is_valid_file
+                          else fn.lower().endswith(extensions))
+                    if ok:
+                        self.samples.append((os.path.join(dirpath, fn),
+                                             self.class_to_idx[c]))
+        if not self.samples:
+            raise ValueError(f"no valid files found under {root}")
+
+    @staticmethod
+    def default_loader(path):
+        from PIL import Image
+
+        with Image.open(path) as img:
+            return np.asarray(img.convert("RGB"))
+
+    def __getitem__(self, idx):
+        path, label = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """folder.py ImageFolder: flat (recursive) folder of images, no labels."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        import os
+
+        self.root = root
+        self.transform = transform
+        self.loader = loader or DatasetFolder.default_loader
+        extensions = tuple(extensions or DatasetFolder.IMG_EXTENSIONS)
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fn in sorted(files):
+                ok = (is_valid_file(fn) if is_valid_file
+                      else fn.lower().endswith(extensions))
+                if ok:
+                    self.samples.append(os.path.join(dirpath, fn))
+        if not self.samples:
+            raise ValueError(f"no valid files found under {root}")
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Flowers(Dataset):
+    """flowers.py: 102-category flowers; image tgz + scipy .mat label/setid
+    files (train/valid/test splits via the setid arrays)."""
+
+    _split_key = {"train": "trnid", "valid": "valid", "test": "tstid"}
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, backend=None):
+        if data_file is None or label_file is None or setid_file is None:
+            _no_download("Flowers", "data_file/label_file/setid_file")
+        import scipy.io as sio
+
+        self.transform = transform
+        labels = sio.loadmat(label_file)["labels"].ravel()
+        indexes = sio.loadmat(setid_file)[
+            self._split_key[mode.lower()]].ravel()
+        self._tar = tarfile.open(data_file, "r:*")
+        members = {m.name.rsplit("/", 1)[-1]: m
+                   for m in self._tar.getmembers() if m.name.endswith(".jpg")}
+        self.samples = []
+        for idx in indexes:
+            name = f"image_{int(idx):05d}.jpg"
+            if name in members:
+                self.samples.append((members[name],
+                                     int(labels[int(idx) - 1]) - 1))
+
+    def __getitem__(self, idx):
+        from PIL import Image
+
+        member, label = self.samples[idx]
+        img = np.asarray(Image.open(
+            self._tar.extractfile(member)).convert("RGB"))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(label)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class VOC2012(Dataset):
+    """voc2012.py: segmentation pairs (JPEGImages/x.jpg,
+    SegmentationClass/x.png) selected by ImageSets/Segmentation/{mode}.txt."""
+
+    _mode_file = {"train": "train.txt", "valid": "val.txt", "test": "val.txt",
+                  "trainval": "trainval.txt"}
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 backend=None):
+        if data_file is None:
+            _no_download("VOC2012", "data_file")
+        self.transform = transform
+        self._tar = tarfile.open(data_file, "r:*")
+        names = {m.name: m for m in self._tar.getmembers()}
+        list_name = next(
+            (n for n in names if n.endswith(
+                "ImageSets/Segmentation/" + self._mode_file[mode.lower()])),
+            None)
+        if list_name is None:
+            raise ValueError("no ImageSets/Segmentation split list in archive")
+        ids = self._tar.extractfile(names[list_name]).read().decode().split()
+        self.samples = []
+        for i in ids:
+            jpg = next((n for n in names
+                        if n.endswith(f"JPEGImages/{i}.jpg")), None)
+            png = next((n for n in names
+                        if n.endswith(f"SegmentationClass/{i}.png")), None)
+            if jpg and png:
+                self.samples.append((names[jpg], names[png]))
+
+    def __getitem__(self, idx):
+        from PIL import Image
+
+        jpg, png = self.samples[idx]
+        img = np.asarray(Image.open(self._tar.extractfile(jpg))
+                         .convert("RGB"))
+        label = np.asarray(Image.open(self._tar.extractfile(png)))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.samples)

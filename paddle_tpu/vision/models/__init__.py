@@ -5,8 +5,9 @@ from .misc import (  # noqa: F401
     DenseNet, GoogLeNet, InceptionV3, ShuffleNetV2, SqueezeNet,
     densenet121, densenet161, densenet169, densenet201, densenet264,
     googlenet, inception_v3,
-    shufflenet_v2_x0_25, shufflenet_v2_x0_5, shufflenet_v2_x1_0,
-    shufflenet_v2_x1_5, shufflenet_v2_x2_0,
+    shufflenet_v2_swish, shufflenet_v2_x0_25, shufflenet_v2_x0_33,
+    shufflenet_v2_x0_5, shufflenet_v2_x1_0, shufflenet_v2_x1_5,
+    shufflenet_v2_x2_0,
     squeezenet1_0, squeezenet1_1,
 )
 from .mobilenet import (  # noqa: F401

@@ -82,19 +82,20 @@ def _channel_shuffle(x, groups):
 
 
 class _ShuffleUnit(nn.Layer):
-    def __init__(self, in_c, out_c, stride):
+    def __init__(self, in_c, out_c, stride, act="relu"):
         super().__init__()
         self.stride = stride
+        Act = nn.Swish if act == "swish" else nn.ReLU
         branch_c = out_c // 2
         if stride == 1:
             self.branch2 = nn.Sequential(
                 nn.Conv2D(branch_c, branch_c, 1, bias_attr=False),
-                nn.BatchNorm2D(branch_c), nn.ReLU(),
+                nn.BatchNorm2D(branch_c), Act(),
                 nn.Conv2D(branch_c, branch_c, 3, stride=1, padding=1,
                           groups=branch_c, bias_attr=False),
                 nn.BatchNorm2D(branch_c),
                 nn.Conv2D(branch_c, branch_c, 1, bias_attr=False),
-                nn.BatchNorm2D(branch_c), nn.ReLU(),
+                nn.BatchNorm2D(branch_c), Act(),
             )
         else:
             self.branch1 = nn.Sequential(
@@ -102,16 +103,16 @@ class _ShuffleUnit(nn.Layer):
                           bias_attr=False),
                 nn.BatchNorm2D(in_c),
                 nn.Conv2D(in_c, branch_c, 1, bias_attr=False),
-                nn.BatchNorm2D(branch_c), nn.ReLU(),
+                nn.BatchNorm2D(branch_c), Act(),
             )
             self.branch2 = nn.Sequential(
                 nn.Conv2D(in_c, branch_c, 1, bias_attr=False),
-                nn.BatchNorm2D(branch_c), nn.ReLU(),
+                nn.BatchNorm2D(branch_c), Act(),
                 nn.Conv2D(branch_c, branch_c, 3, stride=stride, padding=1,
                           groups=branch_c, bias_attr=False),
                 nn.BatchNorm2D(branch_c),
                 nn.Conv2D(branch_c, branch_c, 1, bias_attr=False),
-                nn.BatchNorm2D(branch_c), nn.ReLU(),
+                nn.BatchNorm2D(branch_c), Act(),
             )
 
     def forward(self, x):
@@ -134,22 +135,24 @@ class ShuffleNetV2(nn.Layer):
             0.5: [24, 48, 96, 192, 1024], 1.0: [24, 116, 232, 464, 1024],
             1.5: [24, 176, 352, 704, 1024], 2.0: [24, 244, 488, 976, 2048],
         }[scale]
+        Act = nn.Swish if act == "swish" else nn.ReLU
         self.conv1 = nn.Sequential(
             nn.Conv2D(3, channels[0], 3, stride=2, padding=1, bias_attr=False),
-            nn.BatchNorm2D(channels[0]), nn.ReLU())
+            nn.BatchNorm2D(channels[0]), Act())
         self.max_pool = nn.MaxPool2D(3, 2, padding=1)
         stages = []
         in_c = channels[0]
         for i, reps in enumerate(stage_repeats):
             out_c = channels[i + 1]
-            units = [_ShuffleUnit(in_c, out_c, 2)]
-            units += [_ShuffleUnit(out_c, out_c, 1) for _ in range(reps - 1)]
+            units = [_ShuffleUnit(in_c, out_c, 2, act)]
+            units += [_ShuffleUnit(out_c, out_c, 1, act)
+                      for _ in range(reps - 1)]
             stages.append(nn.Sequential(*units))
             in_c = out_c
         self.stages = nn.Sequential(*stages)
         self.conv_last = nn.Sequential(
             nn.Conv2D(in_c, channels[-1], 1, bias_attr=False),
-            nn.BatchNorm2D(channels[-1]), nn.ReLU())
+            nn.BatchNorm2D(channels[-1]), Act())
         if with_pool:
             self.pool = nn.AdaptiveAvgPool2D(1)
         if num_classes > 0:
@@ -177,6 +180,18 @@ def shufflenet_v2_x0_5(pretrained=False, **kwargs):
     if pretrained:
         raise RuntimeError("pretrained weights are not bundled")
     return ShuffleNetV2(scale=0.5, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights are not bundled")
+    return ShuffleNetV2(scale=0.33, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights are not bundled")
+    return ShuffleNetV2(scale=1.0, act="swish", **kwargs)
 
 
 def shufflenet_v2_x1_0(pretrained=False, **kwargs):

@@ -549,3 +549,96 @@ class TestDetectionOps:
             pre_nms_top_n=16, post_nms_top_n=8, return_rois_num=True)
         assert rois.shape[1] == 4
         assert int(num.numpy()[0]) == rois.shape[0] <= 8
+
+
+class TestFolderDatasets:
+    @staticmethod
+    def _write_img(path, color):
+        from PIL import Image
+
+        arr = np.full((6, 6, 3), color, "uint8")
+        Image.fromarray(arr).save(path)
+
+    def test_dataset_folder_and_image_folder(self, tmp_path):
+        from paddle_tpu.vision.datasets import DatasetFolder, ImageFolder
+
+        for cls, color in [("cats", 10), ("dogs", 200)]:
+            d = tmp_path / cls
+            d.mkdir()
+            for i in range(2):
+                self._write_img(str(d / f"{i}.png"), color)
+        (tmp_path / "cats" / "notes.txt").write_text("skip me")
+        ds = DatasetFolder(str(tmp_path))
+        assert ds.classes == ["cats", "dogs"]
+        assert len(ds) == 4
+        img, label = ds[0]
+        assert img.shape == (6, 6, 3) and label == 0
+        assert img.max() == 10  # cats first
+        flat = ImageFolder(str(tmp_path))
+        assert len(flat) == 4
+        (sample,) = flat[0]
+        assert sample.shape == (6, 6, 3)
+
+    def test_voc2012_pairs(self, tmp_path):
+        import io as _io
+        import tarfile
+        from PIL import Image
+
+        from paddle_tpu.vision.datasets import VOC2012
+
+        def img_bytes(mode, color):
+            arr = np.full((4, 4, 3), color, "uint8") if mode == "RGB" \
+                else np.full((4, 4), color, "uint8")
+            buf = _io.BytesIO()
+            Image.fromarray(arr).save(buf, "JPEG" if mode == "RGB" else "PNG")
+            return buf.getvalue()
+
+        path = tmp_path / "voc.tar"
+        with tarfile.open(path, "w") as tf:
+            entries = {
+                "VOC2012/ImageSets/Segmentation/train.txt": b"a\nb\n",
+                "VOC2012/JPEGImages/a.jpg": img_bytes("RGB", 100),
+                "VOC2012/JPEGImages/b.jpg": img_bytes("RGB", 50),
+                "VOC2012/SegmentationClass/a.png": img_bytes("L", 1),
+                "VOC2012/SegmentationClass/b.png": img_bytes("L", 2),
+            }
+            for name, data in entries.items():
+                info = tarfile.TarInfo(name)
+                info.size = len(data)
+                tf.addfile(info, _io.BytesIO(data))
+        ds = VOC2012(data_file=str(path), mode="train")
+        assert len(ds) == 2
+        img, seg = ds[0]
+        assert img.shape == (4, 4, 3) and seg.shape == (4, 4)
+        assert int(seg.max()) == 1
+
+    def test_flowers_split(self, tmp_path):
+        import io as _io
+        import tarfile
+
+        import scipy.io as sio
+        from PIL import Image
+
+        from paddle_tpu.vision.datasets import Flowers
+
+        n = 4
+        sio.savemat(str(tmp_path / "labels.mat"),
+                    {"labels": np.array([[1, 2, 1, 2]])})
+        sio.savemat(str(tmp_path / "setid.mat"),
+                    {"trnid": np.array([[1, 3]]), "valid": np.array([[2]]),
+                     "tstid": np.array([[4]])})
+        path = tmp_path / "imgs.tgz"
+        with tarfile.open(path, "w:gz") as tf:
+            for i in range(1, n + 1):
+                buf = _io.BytesIO()
+                Image.fromarray(np.full((5, 5, 3), i * 20, "uint8")) \
+                    .save(buf, "JPEG")
+                data = buf.getvalue()
+                info = tarfile.TarInfo(f"jpg/image_{i:05d}.jpg")
+                info.size = len(data)
+                tf.addfile(info, _io.BytesIO(data))
+        ds = Flowers(data_file=str(path), label_file=str(tmp_path / "labels.mat"),
+                     setid_file=str(tmp_path / "setid.mat"), mode="train")
+        assert len(ds) == 2
+        img, label = ds[0]
+        assert img.shape == (5, 5, 3) and int(label) == 0  # labels 1-based
