@@ -13,6 +13,10 @@ import numpy as np
 
 from ...framework.core import Tensor
 from . import functional  # noqa: F401
+from . import functional as F  # noqa: F401
+from .functional import (  # noqa: F401
+    adjust_saturation, affine, erase, perspective,
+)
 from .functional import (  # noqa: F401
     adjust_brightness, adjust_contrast, adjust_hue, center_crop, crop, hflip,
     normalize, pad, resize, rotate, to_grayscale, to_tensor, vflip,
@@ -236,3 +240,124 @@ class Grayscale(BaseTransform):
 
     def _apply_image(self, img):
         return to_grayscale(img, self.num_output_channels)
+
+
+class SaturationTransform(BaseTransform):
+    """transforms.SaturationTransform(value): random saturation in
+    [1-value, 1+value]."""
+
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        f = float(np.random.uniform(max(0.0, 1 - self.value), 1 + self.value))
+        return F.adjust_saturation(img, f)
+
+
+class HueTransform(BaseTransform):
+    """transforms.HueTransform(value): random hue shift in [-value, value]
+    (value <= 0.5)."""
+
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        return F.adjust_hue(img, float(np.random.uniform(-self.value,
+                                                         self.value)))
+
+
+class RandomErasing(BaseTransform):
+    """transforms.RandomErasing: erase a random rectangle with prob p."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob, self.scale, self.ratio = prob, scale, ratio
+        self.value, self.inplace = value, inplace
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        if np.random.rand() >= self.prob:
+            return arr
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = np.random.uniform(*self.scale) * area
+            aspect = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                              np.log(self.ratio[1])))
+            eh = int(round(np.sqrt(target * aspect)))
+            ew = int(round(np.sqrt(target / aspect)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh + 1)
+                j = np.random.randint(0, w - ew + 1)
+                v = (np.random.standard_normal((eh, ew) + arr.shape[2:])
+                     if self.value == "random" else self.value)
+                return F.erase(arr, i, j, eh, ew, v, inplace=self.inplace)
+        return arr
+
+
+class RandomAffine(BaseTransform):
+    """transforms.RandomAffine: random rotation/translate/scale/shear."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        self.degrees = (-degrees, degrees) if isinstance(
+            degrees, (int, float)) else tuple(degrees)
+        self.translate, self.scale_rng, self.shear = translate, scale, shear
+        self.interpolation, self.fill, self.center = interpolation, fill, center
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        h, w = arr.shape[:2]
+        angle = float(np.random.uniform(*self.degrees))
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = float(np.random.uniform(-self.translate[0],
+                                         self.translate[0]) * w)
+            ty = float(np.random.uniform(-self.translate[1],
+                                         self.translate[1]) * h)
+        sc = float(np.random.uniform(*self.scale_rng)) \
+            if self.scale_rng else 1.0
+        sh = (0.0, 0.0)
+        if self.shear is not None:
+            s = self.shear
+            sh = ((float(np.random.uniform(-s, s)), 0.0)
+                  if isinstance(s, (int, float))
+                  else (float(np.random.uniform(s[0], s[1])), 0.0))
+        return F.affine(arr, angle, (tx, ty), sc, sh,
+                        interpolation=self.interpolation, fill=self.fill,
+                        center=self.center)
+
+
+class RandomPerspective(BaseTransform):
+    """transforms.RandomPerspective: random 4-corner homography with prob."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        if np.random.rand() >= self.prob:
+            return arr
+        h, w = arr.shape[:2]
+        d = self.distortion_scale
+        dx = int(d * w / 2)
+        dy = int(d * h / 2)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [(np.random.randint(0, dx + 1), np.random.randint(0, dy + 1)),
+               (w - 1 - np.random.randint(0, dx + 1),
+                np.random.randint(0, dy + 1)),
+               (w - 1 - np.random.randint(0, dx + 1),
+                h - 1 - np.random.randint(0, dy + 1)),
+               (np.random.randint(0, dx + 1),
+                h - 1 - np.random.randint(0, dy + 1))]
+        return F.perspective(arr, start, end,
+                             interpolation=self.interpolation, fill=self.fill)

@@ -1,6 +1,7 @@
 """Functional image ops on HWC numpy arrays (python/paddle/vision/transforms/functional.py)."""
 from __future__ import annotations
 
+import math
 import numbers
 
 import numpy as np
@@ -217,3 +218,107 @@ def adjust_hue(img, hue_factor):
     if dtype == np.uint8:
         return out.round().clip(0, 255).astype(np.uint8)
     return out.astype(dtype)
+
+
+def adjust_saturation(img, saturation_factor):
+    """functional.adjust_saturation: blend with the grayscale image."""
+    arr = np.asarray(img)
+    dtype = arr.dtype
+    f = arr.astype("float32")
+    gray = (0.299 * f[..., 0] + 0.587 * f[..., 1]
+            + 0.114 * f[..., 2])[..., None]
+    out = gray + saturation_factor * (f[..., :3] - gray)
+    if arr.shape[-1] > 3:
+        out = np.concatenate([out, f[..., 3:]], axis=-1)
+    if dtype == np.uint8:
+        return out.round().clip(0, 255).astype(np.uint8)
+    return out.astype(dtype)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """functional.erase: fill the (i:i+h, j:j+w) region with v."""
+    arr = np.asarray(img)
+    out = arr if inplace else arr.copy()
+    out[i:i + h, j:j + w] = np.asarray(v, out.dtype)
+    return out
+
+
+def _affine_matrix(angle, translate, scale, shear, center):
+    angle = math.radians(angle)
+    sx, sy = (math.radians(s) for s in
+              (shear if isinstance(shear, (list, tuple)) else (shear, 0.0)))
+    cx, cy = center
+    # RSS = rotate * shear * scale (torchvision/paddle parameterization)
+    a = math.cos(angle - sy) / math.cos(sy)
+    b = -math.cos(angle - sy) * math.tan(sx) / math.cos(sy) - math.sin(angle)
+    c = math.sin(angle - sy) / math.cos(sy)
+    d = -math.sin(angle - sy) * math.tan(sx) / math.cos(sy) + math.cos(angle)
+    m = np.array([[a, b, 0.0], [c, d, 0.0]]) * scale
+    m[0, 2] = translate[0] + cx - m[0, 0] * cx - m[0, 1] * cy
+    m[1, 2] = translate[1] + cy - m[1, 0] * cx - m[1, 1] * cy
+    return m
+
+
+def affine(img, angle, translate=(0, 0), scale=1.0, shear=(0.0, 0.0),
+           interpolation="nearest", fill=0, center=None):
+    """functional.affine: rotation/translation/scale/shear warp (scipy
+    map_coordinates backend; order 0/1 for nearest/bilinear)."""
+    from scipy import ndimage
+
+    arr = np.asarray(img)
+    h, w = arr.shape[:2]
+    if center is None:
+        center = ((w - 1) * 0.5, (h - 1) * 0.5)
+    m = _affine_matrix(angle, translate, scale, shear, center)
+    # output pixel -> input pixel: invert the 2x3 matrix
+    inv = np.linalg.inv(np.vstack([m, [0, 0, 1]]))[:2]
+    ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    coords = np.round(np.stack(
+        [inv[1, 0] * xs + inv[1, 1] * ys + inv[1, 2],
+         inv[0, 0] * xs + inv[0, 1] * ys + inv[0, 2]]), 6)
+    order = 1 if interpolation in ("bilinear", 1) else 0
+    chans = [ndimage.map_coordinates(arr[..., ch].astype("float32"), coords,
+                                     order=order, cval=float(fill))
+             for ch in range(arr.shape[2])] if arr.ndim == 3 else \
+        [ndimage.map_coordinates(arr.astype("float32"), coords, order=order,
+                                 cval=float(fill))]
+    out = np.stack(chans, axis=-1) if arr.ndim == 3 else chans[0]
+    return out.round().clip(0, 255).astype(arr.dtype) \
+        if arr.dtype == np.uint8 else out.astype(arr.dtype)
+
+
+def _perspective_coeffs(startpoints, endpoints):
+    a = []
+    b = []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        a.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        a.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        b += [sx, sy]
+    res, *_ = np.linalg.lstsq(np.array(a, "float64"),
+                              np.array(b, "float64"), rcond=None)
+    return res  # 8 homography coefficients (maps OUTPUT -> INPUT)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest", fill=0):
+    """functional.perspective: 4-point homography warp."""
+    from scipy import ndimage
+
+    arr = np.asarray(img)
+    h, w = arr.shape[:2]
+    co = _perspective_coeffs(startpoints, endpoints)
+    ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    den = co[6] * xs + co[7] * ys + 1.0
+    # snap numerical noise (±1e-15 around integer grid points) so borders
+    # aren't misclassified as out-of-range and filled with cval
+    in_x = np.round((co[0] * xs + co[1] * ys + co[2]) / den, 6)
+    in_y = np.round((co[3] * xs + co[4] * ys + co[5]) / den, 6)
+    coords = np.stack([in_y, in_x])
+    order = 1 if interpolation in ("bilinear", 1) else 0
+    chans = [ndimage.map_coordinates(arr[..., ch].astype("float32"), coords,
+                                     order=order, cval=float(fill))
+             for ch in range(arr.shape[2])] if arr.ndim == 3 else \
+        [ndimage.map_coordinates(arr.astype("float32"), coords, order=order,
+                                 cval=float(fill))]
+    out = np.stack(chans, axis=-1) if arr.ndim == 3 else chans[0]
+    return out.round().clip(0, 255).astype(arr.dtype) \
+        if arr.dtype == np.uint8 else out.astype(arr.dtype)

@@ -642,3 +642,44 @@ class TestFolderDatasets:
         assert len(ds) == 2
         img, label = ds[0]
         assert img.shape == (5, 5, 3) and int(label) == 0  # labels 1-based
+
+
+class TestGeometricTransforms:
+    def test_affine_identity_and_translate(self):
+        img = (np.random.RandomState(0).rand(8, 8, 3) * 255).astype("uint8")
+        same = transforms.functional.affine(img, angle=0)
+        np.testing.assert_array_equal(same, img)
+        shifted = transforms.functional.affine(img, angle=0,
+                                               translate=(2, 0))
+        np.testing.assert_array_equal(shifted[:, 2:], img[:, :-2])
+
+    def test_perspective_identity(self):
+        img = (np.random.RandomState(1).rand(6, 6, 3) * 255).astype("uint8")
+        pts = [(0, 0), (5, 0), (5, 5), (0, 5)]
+        same = transforms.functional.perspective(img, pts, pts)
+        np.testing.assert_array_equal(same, img)
+
+    def test_erase_and_random_erasing(self):
+        img = np.full((8, 8, 3), 100, "uint8")
+        out = transforms.functional.erase(img, 2, 3, 2, 2, 0)
+        assert out[2:4, 3:5].max() == 0 and out[0, 0, 0] == 100
+        re = transforms.RandomErasing(prob=1.0, value=0)
+        erased = re(img)
+        assert erased.min() == 0 and img.min() == 100  # not inplace
+
+    def test_saturation_and_hue_classes(self):
+        img = (np.random.RandomState(2).rand(5, 5, 3) * 255).astype("uint8")
+        st = transforms.SaturationTransform(0.5)
+        ht = transforms.HueTransform(0.2)
+        assert st(img).shape == img.shape and ht(img).shape == img.shape
+        # saturation 0 == grayscale
+        gray = transforms.functional.adjust_saturation(img, 0.0)
+        assert np.allclose(gray[..., 0], gray[..., 1], atol=1)
+
+    def test_random_affine_and_perspective_classes(self):
+        img = (np.random.RandomState(3).rand(9, 9, 3) * 255).astype("uint8")
+        ra = transforms.RandomAffine(15, translate=(0.1, 0.1),
+                                     scale=(0.9, 1.1), shear=5)
+        rp = transforms.RandomPerspective(prob=1.0, distortion_scale=0.3)
+        assert ra(img).shape == img.shape
+        assert rp(img).shape == img.shape
