@@ -84,6 +84,7 @@ from . import geometric  # noqa: F401,E402
 from . import quantization  # noqa: F401,E402
 from . import text  # noqa: F401,E402
 from . import reader  # noqa: F401,E402
+from . import dataset  # noqa: F401,E402
 
 # vision/hapi/models import lazily-heavy deps; exposed as regular submodules
 from . import vision  # noqa: F401,E402

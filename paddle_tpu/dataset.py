@@ -1,0 +1,235 @@
+"""paddle.dataset: the legacy reader-style dataset namespace.
+
+Reference analog: python/paddle/dataset/ (mnist/cifar/imdb/... modules whose
+train()/test() return sample readers, plus common.py utilities). This build
+delegates to the modern parsers (paddle.vision.datasets / paddle.text
+datasets) and keeps the reader contract: each train()/test() returns a
+zero-arg callable yielding samples. Downloading is disabled — every reader
+takes the local file path(s) the underlying parser needs.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+
+
+class common:
+    """dataset.common utilities (md5file/split/cluster_files_reader)."""
+
+    @staticmethod
+    def must_mkdirs(path):
+        os.makedirs(path, exist_ok=True)
+
+    @staticmethod
+    def md5file(fname):
+        h = hashlib.md5()
+        with open(fname, "rb") as f:
+            for chunk in iter(lambda: f.read(4096), b""):
+                h.update(chunk)
+        return h.hexdigest()
+
+    @staticmethod
+    def download(url, module_name, md5sum, save_name=None):
+        raise ValueError(
+            "dataset downloads are disabled in this build; place the file "
+            "locally and pass its path to the reader")
+
+    @staticmethod
+    def split(reader, line_count, suffix="%05d.pickle", dumper=pickle.dump):
+        """Shard a reader's samples into pickle files (common.py:152)."""
+        buf, index, written = [], 0, []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == line_count:
+                path = suffix % index
+                with open(path, "wb") as f:
+                    dumper(buf, f)
+                written.append(path)
+                buf, index = [], index + 1
+        if buf:
+            path = suffix % index
+            with open(path, "wb") as f:
+                dumper(buf, f)
+            written.append(path)
+        return written
+
+    @staticmethod
+    def cluster_files_reader(files_pattern, trainer_count, trainer_id,
+                             loader=pickle.load):
+        """Round-robin shard files across trainers (common.py:190)."""
+        import glob
+
+        def reader():
+            paths = sorted(glob.glob(files_pattern))
+            for i, path in enumerate(paths):
+                if i % trainer_count == trainer_id:
+                    with open(path, "rb") as f:
+                        for sample in loader(f):
+                            yield sample
+
+        return reader
+
+
+def _ds_reader(ds):
+    def reader():
+        for i in range(len(ds)):
+            yield ds[i]
+
+    return reader
+
+
+class mnist:
+    @staticmethod
+    def train(image_path=None, label_path=None):
+        from .vision.datasets import MNIST
+
+        return _ds_reader(MNIST(image_path=image_path, label_path=label_path,
+                                mode="train"))
+
+    test = train
+
+
+class cifar:
+    @staticmethod
+    def train10(data_file=None):
+        from .vision.datasets import Cifar10
+
+        return _ds_reader(Cifar10(data_file=data_file, mode="train"))
+
+    @staticmethod
+    def test10(data_file=None):
+        from .vision.datasets import Cifar10
+
+        return _ds_reader(Cifar10(data_file=data_file, mode="test"))
+
+    @staticmethod
+    def train100(data_file=None):
+        from .vision.datasets import Cifar100
+
+        return _ds_reader(Cifar100(data_file=data_file, mode="train"))
+
+    @staticmethod
+    def test100(data_file=None):
+        from .vision.datasets import Cifar100
+
+        return _ds_reader(Cifar100(data_file=data_file, mode="test"))
+
+
+class uci_housing:
+    feature_names = None  # bound below
+
+    @staticmethod
+    def train(data_file=None):
+        from .text_datasets import UCIHousing
+
+        return _ds_reader(UCIHousing(data_file=data_file, mode="train"))
+
+    @staticmethod
+    def test(data_file=None):
+        from .text_datasets import UCIHousing
+
+        return _ds_reader(UCIHousing(data_file=data_file, mode="test"))
+
+
+class imdb:
+    @staticmethod
+    def train(word_idx=None, data_file=None, cutoff=150):
+        from .text_datasets import Imdb
+
+        return _ds_reader(Imdb(data_file=data_file, mode="train",
+                               cutoff=cutoff))
+
+    @staticmethod
+    def test(word_idx=None, data_file=None, cutoff=150):
+        from .text_datasets import Imdb
+
+        return _ds_reader(Imdb(data_file=data_file, mode="test",
+                               cutoff=cutoff))
+
+    @staticmethod
+    def word_dict(data_file=None, cutoff=150):
+        from .text_datasets import Imdb
+
+        return Imdb(data_file=data_file, mode="train", cutoff=cutoff).word_idx
+
+
+class imikolov:
+    @staticmethod
+    def train(word_idx=None, n=5, data_type="NGRAM", data_file=None):
+        from .text_datasets import Imikolov
+
+        return _ds_reader(Imikolov(data_file=data_file, data_type=data_type,
+                                   window_size=n, mode="train"))
+
+    @staticmethod
+    def test(word_idx=None, n=5, data_type="NGRAM", data_file=None):
+        from .text_datasets import Imikolov
+
+        return _ds_reader(Imikolov(data_file=data_file, data_type=data_type,
+                                   window_size=n, mode="valid"))
+
+    @staticmethod
+    def build_dict(min_word_freq=50, data_file=None):
+        from .text_datasets import Imikolov
+
+        return Imikolov(data_file=data_file, mode="train",
+                        min_word_freq=min_word_freq).word_idx
+
+
+class movielens:
+    @staticmethod
+    def train(data_file=None):
+        from .text_datasets import Movielens
+
+        return _ds_reader(Movielens(data_file=data_file, mode="train"))
+
+    @staticmethod
+    def test(data_file=None):
+        from .text_datasets import Movielens
+
+        return _ds_reader(Movielens(data_file=data_file, mode="test"))
+
+
+class wmt14:
+    @staticmethod
+    def train(dict_size=30000, data_file=None):
+        from .text_datasets import WMT14
+
+        return _ds_reader(WMT14(data_file=data_file, mode="train",
+                                dict_size=dict_size))
+
+    @staticmethod
+    def test(dict_size=30000, data_file=None):
+        from .text_datasets import WMT14
+
+        return _ds_reader(WMT14(data_file=data_file, mode="test",
+                                dict_size=dict_size))
+
+
+class flowers:
+    @staticmethod
+    def train(data_file=None, label_file=None, setid_file=None):
+        from .vision.datasets import Flowers
+
+        return _ds_reader(Flowers(data_file=data_file, label_file=label_file,
+                                  setid_file=setid_file, mode="train"))
+
+    @staticmethod
+    def test(data_file=None, label_file=None, setid_file=None):
+        from .vision.datasets import Flowers
+
+        return _ds_reader(Flowers(data_file=data_file, label_file=label_file,
+                                  setid_file=setid_file, mode="test"))
+
+
+def _bind_feature_names():
+    from .text_datasets import UCI_FEATURE_NAMES
+
+    uci_housing.feature_names = UCI_FEATURE_NAMES[:-1]
+
+
+_bind_feature_names()
+
+__all__ = ["common", "mnist", "cifar", "uci_housing", "imdb", "imikolov",
+           "movielens", "wmt14", "flowers"]

@@ -409,3 +409,31 @@ class TestWMTAndConll:
         np.testing.assert_array_equal(labels, [1, 0, 2])
         wd, vd, ld = ds.get_dict()
         assert wd["cat"] == 2 and ld["B-V"] == 2
+
+
+class TestLegacyDatasetNamespace:
+    def test_uci_reader_and_common(self, tmp_path):
+        import glob
+
+        table = np.abs(np.random.RandomState(0).randn(10, 14)) + 0.1
+        path = tmp_path / "housing.data"
+        path.write_text("\n".join(" ".join(f"{v:.4f}" for v in row)
+                                  for row in table))
+        reader = paddle.dataset.uci_housing.train(data_file=str(path))
+        samples = list(reader())
+        assert len(samples) == 8 and samples[0][0].shape == (13,)
+        assert len(paddle.dataset.uci_housing.feature_names) == 13
+        # common.split + cluster_files_reader shard/reload roundtrip
+        suffix = str(tmp_path / "part-%05d.pickle")
+        files = paddle.dataset.common.split(reader, 3, suffix=suffix)
+        assert len(files) == 3  # 3+3+2
+        r0 = paddle.dataset.common.cluster_files_reader(
+            str(tmp_path / "part-*.pickle"), 2, 0)
+        r1 = paddle.dataset.common.cluster_files_reader(
+            str(tmp_path / "part-*.pickle"), 2, 1)
+        total = len(list(r0())) + len(list(r1()))
+        assert total == 8
+        md5 = paddle.dataset.common.md5file(str(path))
+        assert len(md5) == 32
+        with pytest.raises(ValueError):
+            paddle.dataset.common.download("http://x", "m", "d")
