@@ -479,3 +479,66 @@ def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
     i2 = m.reshape(indices, [n, c, d * h * w, 1])
     flat = _max_unpool2d_inner(x2, i2, out_d * out_h * out_w, 1)
     return m.reshape(flat, [n, c, out_d, out_h, out_w])
+
+
+# -- RNN-T (transducer) loss --------------------------------------------------
+@defop("rnnt_loss")
+def _rnnt_inner(logits, labels, input_lengths, label_lengths, blank=0):
+    """Transducer forward-variable recursion in log space.
+
+    logits: (B, Tmax, Umax+1, V) joint-network outputs; labels: (B, Umax);
+    alpha[t, u] = logprob of consuming t frames while emitting u labels;
+    loss = -(alpha[T-1, U] + blank(T-1, U)). lax.scan over t, with the in-row
+    u-recursion as an inner scan — the lattice stays jittable and the VJP
+    comes from autodiff of the recursion.
+    """
+    NEG = -1e30
+    logp = jax.nn.log_softmax(logits, axis=-1)
+
+    def one(lp, lab, T, U):
+        Tmax, Umax1, V = lp.shape
+        blankp = lp[:, :, blank]                       # (Tmax, Umax+1)
+        emitp = jnp.take_along_axis(
+            lp[:, :-1, :], lab[None, :, None], 2)[..., 0]  # (Tmax, Umax)
+
+        # row 0: only emissions: alpha[0, u] = sum_{k<u} emit(0, k)
+        row0 = jnp.concatenate(
+            [jnp.zeros((1,)), jnp.cumsum(emitp[0])])   # (Umax+1,)
+
+        def step(alpha_prev, t):
+            from_top = alpha_prev + blankp[t - 1]      # (Umax+1,)
+
+            def cell(left, u):
+                v = jnp.logaddexp(
+                    from_top[u],
+                    jnp.where(u > 0,
+                              left + emitp[t, jnp.maximum(u - 1, 0)], NEG))
+                return v, v
+
+            _, row = jax.lax.scan(cell, NEG, jnp.arange(Umax1))
+            return row
+
+        def step_keep(alpha_prev, t):
+            row = step(alpha_prev, t)
+            return row, row
+
+        _, all_rows = jax.lax.scan(step_keep, row0, jnp.arange(1, Tmax))
+        alphas = jnp.concatenate([row0[None], all_rows])   # (Tmax, Umax+1)
+        final = alphas[T - 1, U] + blankp[T - 1, U]
+        return -final
+
+    return jax.vmap(one)(logp, labels,
+                         input_lengths.astype(jnp.int32),
+                         label_lengths.astype(jnp.int32))
+
+
+def rnnt_loss(logits, labels, input_lengths, label_lengths, blank=0,
+              reduction="mean", fastemit_lambda=0.0, name=None):
+    """loss.py rnnt_loss: RNA/RNN-T transducer loss over the (T, U) lattice."""
+    out = _rnnt_inner(logits, labels, input_lengths, label_lengths,
+                      blank=blank)
+    if reduction == "mean":
+        return out.mean()
+    if reduction == "sum":
+        return out.sum()
+    return out

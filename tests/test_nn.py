@@ -487,3 +487,89 @@ class TestAdaptiveLogSoftmax:
         # tail clusters pass through a div_value bottleneck, so perfect
         # memorization isn't reachable; well above the 1/12 chance level is
         assert acc > 0.3
+
+
+class TestRNNTLoss:
+    @staticmethod
+    def _np_rnnt(logp, labels, T, U, blank=0):
+        # straightforward numpy DP mirror
+        alpha = np.full((T, U + 1), -np.inf)
+        alpha[0, 0] = 0.0
+        for u in range(1, U + 1):
+            alpha[0, u] = alpha[0, u - 1] + logp[0, u - 1, labels[u - 1]]
+        for t in range(1, T):
+            alpha[t, 0] = alpha[t - 1, 0] + logp[t - 1, 0, blank]
+            for u in range(1, U + 1):
+                alpha[t, u] = np.logaddexp(
+                    alpha[t - 1, u] + logp[t - 1, u, blank],
+                    alpha[t, u - 1] + logp[t, u - 1, labels[u - 1]])
+        return -(alpha[T - 1, U] + logp[T - 1, U, blank])
+
+    def test_matches_numpy_dp_and_exhaustive(self):
+        r = np.random.RandomState(0)
+        B, T, U, V = 2, 3, 2, 4
+        logits = r.randn(B, T, U + 1, V).astype("float32")
+        labels = r.randint(1, V, (B, U)).astype("int64")
+        logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+        loss = F.rnnt_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                           paddle.to_tensor(np.full(B, T, "int64")),
+                           paddle.to_tensor(np.full(B, U, "int64")),
+                           reduction="none")
+        for b in range(B):
+            np.testing.assert_allclose(
+                float(loss.numpy()[b]),
+                self._np_rnnt(logp[b], labels[b], T, U), rtol=1e-5)
+        # exhaustive path enumeration for sample 0 (T=3 blanks, U=2 emits)
+        import itertools
+
+        total = -np.inf
+        # every path: some interleaving of T-1 blanks and U emits, then the
+        # mandatory final blank at (T-1, U)
+        for prefix in set(itertools.permutations("b" * (T - 1) + "e" * U)):
+            path = prefix + ("b",)
+            t = u = 0
+            lpsum = 0.0
+            ok = True
+            for stepc in path:
+                if t >= T or u > U:
+                    ok = False
+                    break
+                if stepc == "b":
+                    lpsum += logp[0][t, u, 0]
+                    t += 1
+                else:
+                    if u >= U:
+                        ok = False
+                        break
+                    lpsum += logp[0][t, u, labels[0][u]]
+                    u += 1
+            if ok and t == T and u == U:
+                total = np.logaddexp(total, lpsum)
+        np.testing.assert_allclose(float(loss.numpy()[0]), -total, rtol=1e-5)
+
+    def test_variable_lengths_and_grad(self):
+        r = np.random.RandomState(1)
+        B, Tmax, Umax, V = 2, 4, 3, 5
+        logits = paddle.to_tensor(
+            r.randn(B, Tmax, Umax + 1, V).astype("float32"),
+            stop_gradient=False)
+        labels = paddle.to_tensor(r.randint(1, V, (B, Umax)).astype("int64"))
+        tl = paddle.to_tensor(np.array([4, 2], "int64"))
+        ul = paddle.to_tensor(np.array([3, 1], "int64"))
+        loss = F.rnnt_loss(logits, labels, tl, ul)
+        assert np.isfinite(float(loss.numpy()))
+        loss.backward()
+        g = logits.grad.numpy()
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+        # sample 1's padding region (t >= 2 rows feeding only unused cells)
+        # still gets zero grad at fully-unreachable cells
+        np.testing.assert_allclose(
+            float(loss.numpy()),
+            (self._np_rnnt(
+                (logits.numpy()[0] - np.log(np.exp(logits.numpy()[0])
+                                            .sum(-1, keepdims=True))),
+                labels.numpy()[0], 4, 3)
+             + self._np_rnnt(
+                 (logits.numpy()[1] - np.log(np.exp(logits.numpy()[1])
+                                             .sum(-1, keepdims=True))),
+                 labels.numpy()[1], 2, 1)) / 2, rtol=1e-5)
