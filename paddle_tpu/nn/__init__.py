@@ -48,6 +48,8 @@ from .layer.rnn import RNNCellBase  # noqa: F401
 from .layer.extras import (  # noqa: F401
     AdaptiveLogSoftmaxWithLoss,
     FeatureAlphaDropout,
+    FractionalMaxPool2D,
+    FractionalMaxPool3D,
     HSigmoidLoss,
     MaxUnPool3D,
     LogSigmoid,

@@ -542,3 +542,86 @@ def rnnt_loss(logits, labels, input_lengths, label_lengths, blank=0,
     if reduction == "sum":
         return out.sum()
     return out
+
+
+# -- fractional max pooling ---------------------------------------------------
+def _frac_bounds(in_size, out_size, u):
+    import math as _math
+
+    alpha = in_size / out_size
+    starts = [max(0, _math.ceil(alpha * (i + u) - 1)) for i in range(out_size)]
+    ends = [min(in_size, _math.ceil(alpha * (i + 1 + u) - 1))
+            for i in range(out_size)]
+    # guarantee non-empty windows (reference: pseudo-random region sequence)
+    ends = [max(e, s + 1) for s, e in zip(starts, ends)]
+    return starts, ends
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """pooling.py fractional_max_pool2d (Graham 2015): pseudo-random pooling
+    regions from the alpha*(i+u) index sequence."""
+    from ...framework import random as rng_mod
+    from ...ops import manipulation as m
+
+    n, c, h, w = [int(s) for s in x.shape]
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) \
+        else tuple(output_size)
+    if random_u is None:
+        import jax as _jax
+
+        random_u = float(_jax.random.uniform(rng_mod.next_key(), ()))
+    hs, he = _frac_bounds(h, oh, random_u)
+    ws, we = _frac_bounds(w, ow, random_u)
+    rows = []
+    masks = []
+    for i in range(oh):
+        cols = []
+        mcols = []
+        for j in range(ow):
+            window = x[:, :, hs[i]:he[i], ws[j]:we[j]]
+            flat = m.reshape(window, [n, c, -1])
+            cols.append(m.reshape(flat.max(axis=-1), [n, c, 1, 1]))
+            if return_mask:
+                local = flat.argmax(axis=-1)
+                lw = we[j] - ws[j]
+                gi = hs[i] + local // lw
+                gj = ws[j] + local % lw
+                mcols.append(m.reshape(gi * w + gj, [n, c, 1, 1]))
+        rows.append(m.concat(cols, axis=3))
+        if return_mask:
+            masks.append(m.concat(mcols, axis=3))
+    out = m.concat(rows, axis=2)
+    if return_mask:
+        return out, m.concat(masks, axis=2)
+    return out
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """pooling.py fractional_max_pool3d via the same index sequences."""
+    from ...framework import random as rng_mod
+    from ...ops import manipulation as m
+
+    n, c, d, h, w = [int(s) for s in x.shape]
+    od, oh, ow = (output_size,) * 3 if isinstance(output_size, int) \
+        else tuple(output_size)
+    if random_u is None:
+        import jax as _jax
+
+        random_u = float(_jax.random.uniform(rng_mod.next_key(), ()))
+    ds_, de = _frac_bounds(d, od, random_u)
+    hs, he = _frac_bounds(h, oh, random_u)
+    ws, we = _frac_bounds(w, ow, random_u)
+    planes = []
+    for a in range(od):
+        rows = []
+        for i in range(oh):
+            cols = []
+            for j in range(ow):
+                win = x[:, :, ds_[a]:de[a], hs[i]:he[i], ws[j]:we[j]]
+                flat = m.reshape(win, [n, c, -1])
+                cols.append(m.reshape(flat.max(axis=-1), [n, c, 1, 1, 1]))
+            rows.append(m.concat(cols, axis=4))
+        planes.append(m.concat(rows, axis=3))
+    return m.concat(planes, axis=2)

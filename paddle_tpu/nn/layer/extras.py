@@ -261,3 +261,27 @@ class AdaptiveLogSoftmaxWithLoss(Layer):
         out = ops.squeeze(ops.take_along_axis(
             full, ops.unsqueeze(label.astype("int64"), -1), axis=-1), -1)
         return out, -out.mean()
+
+
+class FractionalMaxPool2D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self._args = (output_size, kernel_size, random_u, return_mask)
+
+    def forward(self, x):
+        o, k, u, rm = self._args
+        return F.fractional_max_pool2d(x, o, kernel_size=k, random_u=u,
+                                       return_mask=rm)
+
+
+class FractionalMaxPool3D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self._args = (output_size, kernel_size, random_u, return_mask)
+
+    def forward(self, x):
+        o, k, u, rm = self._args
+        return F.fractional_max_pool3d(x, o, kernel_size=k, random_u=u,
+                                       return_mask=rm)

@@ -573,3 +573,35 @@ class TestRNNTLoss:
                  (logits.numpy()[1] - np.log(np.exp(logits.numpy()[1])
                                              .sum(-1, keepdims=True))),
                  labels.numpy()[1], 2, 1)) / 2, rtol=1e-5)
+
+
+class TestFractionalMaxPool:
+    def test_2d_windows_cover_and_max(self):
+        x = paddle.to_tensor(np.arange(36, dtype="float32").reshape(1, 1, 6, 6))
+        out = F.fractional_max_pool2d(x, 3, random_u=0.4)
+        assert tuple(out.shape) == (1, 1, 3, 3)
+        # bottom-right output must see the global max (last window reaches the end)
+        assert float(out.numpy().max()) == 35.0
+        # monotone rows/cols for a monotone input
+        o = out.numpy()[0, 0]
+        assert (np.diff(o, axis=0) > 0).all() and (np.diff(o, axis=1) > 0).all()
+
+    def test_2d_mask_and_layer(self):
+        r = np.random.RandomState(0)
+        x = paddle.to_tensor(r.randn(2, 3, 8, 8).astype("float32"))
+        out, mask = F.fractional_max_pool2d(x, 4, random_u=0.25,
+                                            return_mask=True)
+        assert tuple(out.shape) == tuple(mask.shape) == (2, 3, 4, 4)
+        # mask holds flat h*w argmax positions of each selected max
+        flat = x.numpy().reshape(2, 3, -1)
+        picked = np.take_along_axis(flat, mask.numpy().reshape(2, 3, -1), -1)
+        np.testing.assert_allclose(picked.reshape(out.shape), out.numpy())
+        layer = nn.FractionalMaxPool2D(4, random_u=0.25)
+        np.testing.assert_allclose(layer(x).numpy(), out.numpy())
+
+    def test_3d(self):
+        x = paddle.to_tensor(np.random.RandomState(1)
+                             .randn(1, 2, 6, 6, 6).astype("float32"))
+        out = nn.FractionalMaxPool3D(2, random_u=0.7)(x)
+        assert tuple(out.shape) == (1, 2, 2, 2, 2)
+        assert float(out.numpy().max()) == float(x.numpy().max())
