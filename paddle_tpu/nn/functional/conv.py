@@ -186,6 +186,28 @@ def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0
     return squeeze(out, [3 if data_format == "NCL" else 2])
 
 
+@defop("conv3d_transpose_inner", amp_category="white")
+def _c3t(x, w, bias=None, stride=None, pad=None, opad=None, dilation=None, groups=1,
+         data_format="NCDHW"):
+    ks = w.shape[2:]
+    if isinstance(pad, str):
+        cfg = pad
+    else:
+        cfg = [
+            (dilation[i] * (k - 1) - pad[i][0],
+             dilation[i] * (k - 1) - pad[i][1] + opad[i])
+            for i, k in enumerate(ks)
+        ]
+    dn = (data_format, "IODHW", data_format)
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=(1, 1, 1), padding=cfg, lhs_dilation=stride,
+        rhs_dilation=dilation, dimension_numbers=dn,
+    )
+    if bias is not None:
+        out = out + (bias.reshape(1, -1, 1, 1, 1) if data_format == "NCDHW" else bias)
+    return out
+
+
 def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
                      groups=1, dilation=1, data_format="NCDHW", output_size=None, name=None):
     from ...ops.manipulation import flip
@@ -196,27 +218,6 @@ def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0
     opad = _tup(output_padding, 3)
     wf = flip(weight, [2, 3, 4])
     kd, kh, kw = weight.value.shape[2:]
-
-    @defop("conv3d_transpose_inner", amp_category="white")
-    def _c3t(x, w, bias=None, stride=None, pad=None, opad=None, dilation=None, groups=1,
-             data_format="NCDHW"):
-        ks = w.shape[2:]
-        if isinstance(pad, str):
-            cfg = pad
-        else:
-            cfg = [
-                (dilation[i] * (k - 1) - pad[i][0],
-                 dilation[i] * (k - 1) - pad[i][1] + opad[i])
-                for i, k in enumerate(ks)
-            ]
-        dn = (data_format, "IODHW", data_format)
-        out = jax.lax.conv_general_dilated(
-            x, w, window_strides=(1, 1, 1), padding=cfg, lhs_dilation=stride,
-            rhs_dilation=dilation, dimension_numbers=dn,
-        )
-        if bias is not None:
-            out = out + (bias.reshape(1, -1, 1, 1, 1) if data_format == "NCDHW" else bias)
-        return out
 
     return _c3t(x, wf, bias, stride=stride, pad=pad, opad=opad, dilation=dilation,
                 groups=int(groups), data_format=data_format)

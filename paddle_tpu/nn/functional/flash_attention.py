@@ -94,6 +94,19 @@ def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax
     return out, None
 
 
+@defop("flash_attn_varlen", amp_category="white")
+def _varlen(q, k, v, seg_q, seg_k, scale=None, causal=False):
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / np.sqrt(d)
+    logits = jnp.einsum("qhd,khd->hqk", q, k) * s
+    mask = seg_q[:, None] == seg_k[None, :]
+    if causal:
+        mask = mask & (jnp.arange(q.shape[0])[:, None] >= jnp.arange(k.shape[0])[None, :])
+    logits = jnp.where(mask[None], logits, -1e30)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(q.dtype)
+    return jnp.einsum("hqk,khd->qhd", probs, v)
+
+
 def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
                         max_seqlen_k, scale=None, dropout=0.0, causal=False,
                         return_softmax=False, fixed_seed_offset=None, rng_name="",
@@ -110,18 +123,6 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k, max_seqle
     seg_k = jnp.cumsum(
         jnp.zeros(total_k, jnp.int32).at[cu_k[1:-1]].add(1)
     )
-
-    @defop("flash_attn_varlen", amp_category="white")
-    def _varlen(q, k, v, seg_q, seg_k, scale=None, causal=False):
-        d = q.shape[-1]
-        s = scale if scale is not None else 1.0 / np.sqrt(d)
-        logits = jnp.einsum("qhd,khd->hqk", q, k) * s
-        mask = seg_q[:, None] == seg_k[None, :]
-        if causal:
-            mask = mask & (jnp.arange(q.shape[0])[:, None] >= jnp.arange(k.shape[0])[None, :])
-        logits = jnp.where(mask[None], logits, -1e30)
-        probs = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(q.dtype)
-        return jnp.einsum("hqk,khd->qhd", probs, v)
 
     out = _varlen(query, key, value, Tensor(seg_q), Tensor(seg_k),
                   scale=scale, causal=bool(causal))

@@ -103,11 +103,8 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=Fa
     return out
 
 
-def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
-                  use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW",
-                  name=None):
-    @defop("instance_norm_op", amp_category="black")
-    def _in(x, weight=None, bias=None, eps=1e-5, axis=1):
+@defop("instance_norm_op", amp_category="black")
+def _in(x, weight=None, bias=None, eps=1e-5, axis=1):
         red = tuple(range(2, x.ndim)) if axis == 1 else tuple(range(1, x.ndim - 1))
         mean = jnp.mean(x, axis=red, keepdims=True)
         var = jnp.var(x, axis=red, keepdims=True)
@@ -122,6 +119,10 @@ def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None
             out = out + bias.reshape(shape)
         return out
 
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW",
+                  name=None):
     axis = 1 if data_format.startswith("NC") else x.ndim - 1
     return _in(x, weight, bias, eps=float(eps), axis=axis)
 
@@ -162,10 +163,8 @@ def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None, data_format=
                        axis=axis)
 
 
-def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW",
-                        name=None):
-    @defop("lrn_op")
-    def _lrn(x, size, alpha, beta, k, axis):
+@defop("lrn_op")
+def _lrn(x, size, alpha, beta, k, axis):
         sq = jnp.square(x)
         half = size // 2
         cdim = x.shape[axis]
@@ -180,5 +179,8 @@ def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW
             acc = acc.at[tuple(sl)].add(sq[tuple(src)])
         return x / jnp.power(k + alpha * acc / size, beta)
 
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW",
+                        name=None):
     axis = 1 if data_format.startswith("NC") else x.ndim - 1
     return _lrn(x, size=int(size), alpha=float(alpha), beta=float(beta), k=float(k), axis=axis)

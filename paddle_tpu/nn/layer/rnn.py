@@ -41,6 +41,12 @@ def _cell_step(mode, x_t, h, c, w_ih, w_hh, b_ih, b_hh):
     return act(gates), c
 
 
+@defop("gru_cell")
+def _gru_cell_op(x, h, w_ih, w_hh, b_ih, b_hh):
+    h2, _ = _cell_step("GRU", x, h, None, w_ih, w_hh, b_ih, b_hh)
+    return h2
+
+
 @defop("rnn_scan")
 def _rnn_forward(x, init_h, init_c, weights, mode="LSTM", num_layers=1, bidirectional=False,
                  has_bias=True, seq_lens=None):
@@ -270,13 +276,8 @@ class GRUCell(RNNCellBase):
     def forward(self, inputs, states=None):
         if states is None:
             states = self.get_initial_states(inputs)
-
-        @defop("gru_cell")
-        def _cell(x, h, w_ih, w_hh, b_ih, b_hh):
-            h2, _ = _cell_step("GRU", x, h, None, w_ih, w_hh, b_ih, b_hh)
-            return h2
-
-        h2 = _cell(inputs, states, self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh)
+        h2 = _gru_cell_op(inputs, states, self.weight_ih, self.weight_hh,
+                          self.bias_ih, self.bias_hh)
         return h2, h2
 
 

@@ -56,6 +56,7 @@ from .random_ops import (  # noqa: F401
     randn_like, randperm, standard_normal, uniform, uniform_,
 )
 from .einsum_op import einsum  # noqa: F401
+from . import indexing as _indexing  # noqa: F401  (registers getitem/setitem)
 
 import numpy as _np
 
