@@ -1,5 +1,5 @@
 from .callbacks import (  # noqa: F401
     Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
-    VisualDL, WandbCallback,
+    ReduceLROnPlateau, VisualDL, WandbCallback,
 )
 from .model import Model, summary  # noqa: F401

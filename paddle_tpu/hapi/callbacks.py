@@ -306,3 +306,71 @@ def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,
     lst.set_params({"epochs": epochs, "steps": steps, "verbose": verbose,
                     "metrics": metrics or [], "save_dir": save_dir})
     return lst
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce LR when the monitored metric plateaus (hapi/callbacks.py:1274)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+        self._reset()
+
+    def _reset(self):
+        self.best = -np.inf if self.mode == "max" else np.inf
+        self.wait = 0
+        self.cooldown_counter = 0
+
+    def on_train_begin(self, logs=None):
+        self._reset()
+
+    def _better(self, current):
+        if self.mode == "max":
+            return current > self.best + self.min_delta
+        return current < self.best - self.min_delta
+
+    def _epoch_end(self, logs):
+        logs = logs or {}
+        current = logs.get(self.monitor)
+        if current is None:
+            return
+        current = float(np.mean(current))
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if self._better(current):
+            self.best = current
+            self.wait = 0
+            return
+        if self.cooldown_counter > 0:
+            return
+        self.wait += 1
+        if self.wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is None:
+                return
+            old = float(opt.get_lr())
+            new = max(old * self.factor, self.min_lr)
+            if old - new > 1e-12:
+                opt.set_lr(new)
+                if self.verbose:
+                    print(f"ReduceLROnPlateau: reducing learning rate "
+                          f"{old:.2e} -> {new:.2e}")
+            self.cooldown_counter = self.cooldown
+            self.wait = 0
+
+    def on_eval_end(self, logs=None):
+        self._epoch_end(logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._epoch_end(logs)

@@ -683,3 +683,30 @@ class TestGeometricTransforms:
         rp = transforms.RandomPerspective(prob=1.0, distortion_scale=0.3)
         assert ra(img).shape == img.shape
         assert rp(img).shape == img.shape
+
+
+class TestReduceLROnPlateau:
+    def test_lr_drops_after_patience(self):
+        from paddle_tpu.hapi import ReduceLROnPlateau
+
+        paddle.seed(0)
+        net = paddle.nn.Linear(4, 2)
+        model = paddle.Model(net)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        model.prepare(opt, paddle.nn.CrossEntropyLoss())
+        cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=2,
+                               verbose=0)
+        cb.set_model(model)
+        cb.on_train_begin()
+        cb.on_epoch_end(0, {"loss": 1.0})     # best
+        cb.on_epoch_end(1, {"loss": 1.0})     # wait 1
+        assert float(opt.get_lr()) == 0.1
+        cb.on_epoch_end(2, {"loss": 1.0})     # wait 2 -> reduce
+        np.testing.assert_allclose(float(opt.get_lr()), 0.05)
+        cb.on_epoch_end(3, {"loss": 0.5})     # improvement resets
+        cb.on_epoch_end(4, {"loss": 0.6})
+        assert float(opt.get_lr()) == 0.05
+        # max mode tracks accuracy upward
+        cb2 = ReduceLROnPlateau(monitor="acc", patience=1, verbose=0)
+        assert cb2.mode == "max"
