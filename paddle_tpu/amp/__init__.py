@@ -13,3 +13,13 @@ from . import debugging  # noqa: F401
 
 white_list = amp_lists.white_list
 black_list = amp_lists.black_list
+
+
+def is_bfloat16_supported(device=None):
+    """bf16 is the TPU-native compute dtype (amp.is_bfloat16_supported)."""
+    return True
+
+
+def is_float16_supported(device=None):
+    """fp16 compute is supported via XLA on-TPU (amp.is_float16_supported)."""
+    return True

@@ -19,6 +19,8 @@ from .collective import (  # noqa: F401
     broadcast,
     broadcast_object_list,
     destroy_process_group,
+    gather,
+    get_backend,
     get_group,
     new_group,
     p2p_rank,
@@ -86,3 +88,61 @@ class sharding:
         group_sharded_parallel,
         save_group_sharded_model,
     )
+
+
+# -- small compat surface (reference python/paddle/distributed/__init__) -----
+from .fleet.strategy import Strategy  # noqa: F401,E402
+
+
+class ParallelMode:
+    """fleet/base/topology.py ParallelMode enum values."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+class ReduceType:
+    """auto_parallel reduce types (kSumReduce etc.)."""
+
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+def get_mesh():
+    """auto_parallel api.get_mesh: the globally set process mesh."""
+    from .process_mesh import get_current_mesh
+
+    return get_current_mesh()
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """Host-side barrier world over the TCPStore (reference gloo bootstrap)."""
+    import os
+
+    from .store import TCPStore
+
+    host, port = server_endpoint.rsplit(":", 1)
+    store = TCPStore(host, int(port), is_master=(rank_id == 0),
+                     world_size=rank_num, timeout=120)
+    globals()["_GLOO_STORE"] = (store, rank_num)
+
+
+def gloo_barrier():
+    store = globals().get("_GLOO_STORE")
+    if store is None:
+        raise RuntimeError("call gloo_init_parallel_env first")
+    store[0].barrier("gloo_barrier")
+
+
+def gloo_release():
+    store = globals().pop("_GLOO_STORE", None)
+    if store is not None:
+        store[0].shutdown()

@@ -405,3 +405,27 @@ def all_gather_object(object_list, obj, group=None):
 
 def broadcast_object_list(object_list, src=0, group=None):
     return object_list
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """Rows gathered to dst (communication/gather.py): dst's gather_list gets
+    every rank's row; other ranks' lists are left empty. Single-controller
+    stacked-axis semantics: all rows are visible, dst filtering is logical."""
+    group = _resolve_group(group)
+    v = _val(tensor)
+    if isinstance(gather_list, list):
+        del gather_list[:]
+        for i in range(v.shape[0]):
+            gather_list.append(Tensor(v[i]))
+    return _Task(v) if not sync_op else None
+
+
+def get_backend(group=None):
+    """communication/group.py get_backend: the collective transport name."""
+    import jax
+
+    try:
+        platform = jax.devices()[0].platform
+    except RuntimeError:
+        platform = "cpu"
+    return {"tpu": "XLA_ICI", "gpu": "NCCL"}.get(platform, "GLOO")

@@ -1,0 +1,97 @@
+"""incubate optimizers: LookAhead, ModelAverage.
+
+Reference analog: python/paddle/incubate/optimizer/{lookahead,modelaverage}.py.
+Both wrap an inner optimizer with parameter-trajectory bookkeeping on the
+host side of the step (the inner update itself stays the fused jax path).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class LookAhead:
+    """lookahead.py LookAhead(inner_optimizer, alpha, k): every k steps the
+    slow weights move alpha of the way toward the fast weights and the fast
+    weights reset to them."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step_count = 0
+        self._slow = {}
+
+    def _params(self):
+        return self.inner_optimizer._parameter_list_flat()
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_count += 1
+        if self._step_count % self.k:
+            return
+        for p in self._params():
+            pid = id(p)
+            slow = self._slow.get(pid)
+            if slow is None:
+                slow = p.value  # first sync: slow starts at the fast weights
+            slow = slow + self.alpha * (p.value - slow)
+            self._slow[pid] = slow
+            p._replace_value(slow)
+
+    def clear_grad(self, set_to_zero=True):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def state_dict(self):
+        return self.inner_optimizer.state_dict()
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+class ModelAverage:
+    """modelaverage.py ModelAverage: maintain a running average of parameters;
+    apply()/restore() swap the averaged weights in and out for evaluation."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self.rate = float(average_window_rate)
+        self.min_average_window = int(min_average_window)
+        self.max_average_window = int(max_average_window)
+        self._parameters = list(parameters or [])
+        self._sum = {id(p): jnp.zeros_like(p.value) for p in self._parameters}
+        self._count = 0
+        self._backup = None
+
+    def step(self):
+        self._count += 1
+        for p in self._parameters:
+            self._sum[id(p)] = self._sum[id(p)] + p.value
+        # bound the accumulation window (modelaverage.py window restart)
+        window = max(self.min_average_window,
+                     min(self.max_average_window,
+                         int(self._count * self.rate) or 1))
+        if self._count > window:
+            for p in self._parameters:
+                self._sum[id(p)] = self._sum[id(p)] * (window / self._count)
+            self._count = window
+
+    def apply(self, executor=None, need_restore=True):
+        if self._count == 0:
+            return
+        self._backup = {id(p): p.value for p in self._parameters}
+        for p in self._parameters:
+            p._replace_value((self._sum[id(p)] / self._count)
+                             .astype(p.value.dtype))
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p in self._parameters:
+            p._replace_value(self._backup[id(p)])
+        self._backup = None

@@ -8,3 +8,16 @@ from .api import (  # noqa: F401
     to_static,
 )
 from .serialization import load, save  # noqa: F401
+from .serialization import TranslatedLayer  # noqa: F401
+
+_LOG_STATE = {"verbosity": 0, "code_level": 0}
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """jit logging verbosity knob (jit/sot logger analog)."""
+    _LOG_STATE["verbosity"] = int(level)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """jit generated-code dump level (SOT breakpoint tooling analog)."""
+    _LOG_STATE["code_level"] = int(level)

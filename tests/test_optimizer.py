@@ -179,3 +179,47 @@ def test_lenet_e2e_training():
     logits = model(paddle.to_tensor(X))
     acc = (logits.numpy().argmax(-1) == Y).mean()
     assert acc > 0.5, f"memorization accuracy too low: {acc}"
+
+
+class TestIncubateOptimizers:
+    def test_lookahead_slow_weights(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate import LookAhead
+
+        paddle.seed(0)
+        lin = paddle.nn.Linear(2, 1)
+        inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=lin.parameters())
+        opt = LookAhead(inner, alpha=0.5, k=2)
+        x = paddle.to_tensor(np.ones((4, 2), "float32"))
+        y = paddle.to_tensor(np.zeros((4, 1), "float32"))
+        w0 = lin.weight.numpy().copy()
+        losses = []
+        for i in range(6):
+            loss = ((lin(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+        assert not np.allclose(lin.weight.numpy(), w0)
+
+    def test_model_average_apply_restore(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate import ModelAverage
+
+        lin = paddle.nn.Linear(2, 1)
+        ma = ModelAverage(0.15, parameters=lin.parameters(),
+                          min_average_window=2, max_average_window=10)
+        vals = []
+        for v in [1.0, 2.0, 3.0]:
+            lin.weight._replace_value(
+                np.full((2, 1), v, "float32") + 0 * lin.weight.value)
+            ma.step()
+            vals.append(v)
+        cur = lin.weight.numpy().copy()
+        ma.apply()
+        np.testing.assert_allclose(lin.weight.numpy(), np.mean(vals),
+                                   rtol=1e-6)
+        ma.restore()
+        np.testing.assert_allclose(lin.weight.numpy(), cur)
