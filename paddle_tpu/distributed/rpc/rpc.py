@@ -7,11 +7,20 @@ ship a pickled (fn, args, kwargs) to the target worker's agent and return the
 
 The agent executes each request on its own thread, so concurrent in-flight
 RPCs (including re-entrant worker->worker calls) don't serialize.
+
+Trust model: RPC executes arbitrary callables by design (same as the
+reference), so the listener authenticates peers before accepting frames —
+an HMAC challenge-response over a shared secret that rank 0 generates and
+distributes through the rendezvous TCPStore (override with
+PADDLE_RPC_AUTH_KEY). Unauthenticated connections are dropped without
+unpickling anything.
 """
 from __future__ import annotations
 
+import hmac
 import os
 import pickle
+import secrets as _secrets
 import socket
 import struct
 import threading
@@ -32,6 +41,7 @@ class _AgentState:
         self.server = None
         self.store = None
         self.barrier_count = 0
+        self.auth_key = None  # bytes: shared HMAC secret for this RPC group
 
 
 _STATE = _AgentState()
@@ -54,6 +64,29 @@ def _recv_exact(sock, n):
 def _recv_frame(sock):
     (n,) = struct.unpack("<Q", _recv_exact(sock, 8))
     return _recv_exact(sock, n)
+
+
+def _server_handshake(conn, key):
+    """Mutual challenge-response before any frame is unpickled. Server sends
+    nonce_s; client replies HMAC(key, nonce_s) + nonce_c; server verifies and
+    answers HMAC(key, nonce_c) so the dialer also authenticates the listener
+    (neither side unpickles bytes from an unauthenticated peer)."""
+    nonce_s = _secrets.token_bytes(32)
+    conn.sendall(nonce_s)
+    reply = _recv_exact(conn, 64)
+    mac, nonce_c = reply[:32], reply[32:]
+    if not hmac.compare_digest(mac, hmac.new(key, nonce_s, "sha256").digest()):
+        raise ConnectionError("rpc auth failure")
+    conn.sendall(hmac.new(key, nonce_c, "sha256").digest())
+
+
+def _client_handshake(sock, key):
+    nonce_s = _recv_exact(sock, 32)
+    nonce_c = _secrets.token_bytes(32)
+    sock.sendall(hmac.new(key, nonce_s, "sha256").digest() + nonce_c)
+    mac = _recv_exact(sock, 32)
+    if not hmac.compare_digest(mac, hmac.new(key, nonce_c, "sha256").digest()):
+        raise ConnectionError("rpc auth failure: server not authenticated")
 
 
 class _RpcServer(threading.Thread):
@@ -86,6 +119,9 @@ class _RpcServer(threading.Thread):
     def _serve(self, conn):
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         try:
+            conn.settimeout(30.0)
+            _server_handshake(conn, _STATE.auth_key)
+            conn.settimeout(None)
             while not self._stop.is_set():
                 req = _recv_frame(conn)
                 try:
@@ -126,6 +162,9 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     world_size = (int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
                   if world_size is None else world_size)
     master_endpoint = master_endpoint or os.environ.get("PADDLE_MASTER")
+    env_key = os.environ.get("PADDLE_RPC_AUTH_KEY")
+    _STATE.auth_key = (env_key.encode() if env_key
+                       else _secrets.token_bytes(32))
     server = _RpcServer(os.environ.get("PADDLE_WORKER_HOST", "127.0.0.1"))
     server.start()
     info = WorkerInfo(name, rank, server.host, server.port)
@@ -134,6 +173,12 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
         host, port = master_endpoint.rsplit(":", 1)
         store = TCPStore(host, int(port), is_master=(rank == 0),
                          world_size=world_size, timeout=120)
+        if env_key is None:
+            # rank 0's random secret becomes the group key, distributed over
+            # the rendezvous store (the already-trusted bootstrap channel)
+            if rank == 0:
+                store.set("rpc/auth_key", _STATE.auth_key)
+            _STATE.auth_key = store.get("rpc/auth_key", timeout=120)
         store.set(f"rpc/worker/{rank}",
                   pickle.dumps(tuple(info), protocol=pickle.HIGHEST_PROTOCOL))
         workers = {}
@@ -161,9 +206,15 @@ class _Connection:
 
     def ensure(self):
         if self.sock is None:
-            self.sock = socket.create_connection(
+            sock = socket.create_connection(
                 (self.info.ip, self.info.port), timeout=120)
-            self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            try:
+                _client_handshake(sock, _STATE.auth_key)
+            except BaseException:
+                sock.close()
+                raise
+            self.sock = sock
 
     def reset(self):
         if self.sock is not None:

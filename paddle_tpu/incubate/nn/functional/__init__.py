@@ -220,9 +220,12 @@ def variable_length_memory_efficient_attention(query, key, value, seq_lens,
                                                kv_seq_lens, mask=None,
                                                scale=None, causal=False,
                                                name=None):
-    """variable_length_memory_efficient_attention.py: served by the varlen
-    flash path (flash_attn_unpadded)."""
-    from ....nn.functional.flash_attention import scaled_dot_product_attention
+    """variable_length_memory_efficient_attention.py: padding positions beyond
+    kv_seq_lens are masked out (the reference kernel's varlen semantics)."""
+    import jax.numpy as jnp
+
+    from ....framework.core import Tensor
+    from ....nn.functional.flash_attention import _sdpa, _use_pallas
 
     # (B, H, S, D) reference layout -> sdp's (B, S, H, D)
     from ....ops.manipulation import transpose
@@ -230,8 +233,24 @@ def variable_length_memory_efficient_attention(query, key, value, seq_lens,
     q = transpose(query, [0, 2, 1, 3])
     k = transpose(key, [0, 2, 1, 3])
     v = transpose(value, [0, 2, 1, 3])
-    out = scaled_dot_product_attention(q, k, v, attn_mask=mask,
-                                       is_causal=causal)
+
+    sk = int(k.shape[1])
+    kv_lens = kv_seq_lens if kv_seq_lens is not None else seq_lens
+    if kv_lens is not None:
+        lens = (kv_lens.value if isinstance(kv_lens, Tensor)
+                else jnp.asarray(kv_lens)).reshape(-1)
+        # keep key column j for batch b iff j < kv_len[b]; (B, 1, 1, Sk)
+        keep = (jnp.arange(sk)[None, :] < lens[:, None])[:, None, None, :]
+        if mask is None:
+            mask = keep
+        else:
+            mv = mask.value if isinstance(mask, Tensor) else jnp.asarray(mask)
+            if mv.dtype == jnp.bool_:
+                mask = mv & keep
+            else:
+                mask = mv + jnp.where(keep, 0.0, -1e30).astype(mv.dtype)
+    out = _sdpa(q, k, v, mask, None, dropout_p=0.0, causal=bool(causal),
+                scale=scale, use_pallas=_use_pallas(q))
     return transpose(out, [0, 2, 1, 3])
 
 

@@ -344,14 +344,56 @@ def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False, return_softmax=False,
                            return_softmax=return_softmax, training=training)
 
 
+def _flashmask_to_dense(sri, seq_len, causal):
+    """Densify FlashMask startend_row_indices -> boolean keep mask (True = attend).
+
+    Reference semantics (flash_attention.py:1555 flashmask_to_densemask):
+    sri is (B, KH, S, k); per key-column j, rows [start, end) (or [start, S))
+    of the score matrix are masked; causal=True additionally masks i < j;
+    non-causal variants carry upper-triangle bounds in the trailing slots."""
+    import jax.numpy as jnp
+
+    k = sri.shape[-1]
+    has_end = (causal and k == 2) or ((not causal) and k == 4)
+    i = jnp.arange(seq_len)[None, None, :, None]   # query row
+    j = jnp.arange(seq_len)[None, None, None, :]   # key column
+    ds = sri[..., 0][:, :, None, :]                # (B, KH, 1, S_j)
+    if has_end:
+        de = sri[..., 1][:, :, None, :]
+        masked = (i >= ds) & (i < de)
+    else:
+        masked = i >= ds
+    if causal:
+        masked = masked | (i < j)
+    elif has_end:
+        us = sri[..., 2][:, :, None, :]
+        ue = sri[..., 3][:, :, None, :]
+        masked = masked | ((i >= us) & (i < ue))
+    else:
+        ue = sri[..., 1][:, :, None, :]
+        masked = masked | (i < ue)
+    return ~masked
+
+
 def flashmask_attention(query, key, value, startend_row_indices=None,
                         dropout=0.0, causal=False, name=None):
-    """flash_attention.py flashmask_attention — served by the sdp dispatcher
-    (the sparse row-index mask becomes a dense additive mask)."""
+    """flash_attention.py flashmask_attention — served by the sdp dispatcher;
+    the sparse row-index mask is densified to a boolean mask (causal folded in).
+
+    Note: densification is O(S^2) memory and routes through the math path (the
+    Pallas kernel takes no mask yet) — correct for all mask families, but long-
+    sequence FlashMask workloads want a block-sparse Pallas variant (tracked as
+    a perf follow-up)."""
     from .flash_attention import scaled_dot_product_attention
 
-    return scaled_dot_product_attention(query, key, value, attn_mask=None,
-                                        dropout_p=dropout, is_causal=causal)
+    if startend_row_indices is None:
+        return scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                            dropout_p=dropout, is_causal=causal)
+    sri = getattr(startend_row_indices, "value", startend_row_indices)
+    seq_len = query.shape[1]
+    keep = _flashmask_to_dense(sri, seq_len, causal)
+    return scaled_dot_product_attention(query, key, value, attn_mask=keep,
+                                        dropout_p=dropout, is_causal=False)
 
 
 # -- inplace aliases (activation.py *_ variants) ------------------------------
@@ -614,14 +656,36 @@ def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
     hs, he = _frac_bounds(h, oh, random_u)
     ws, we = _frac_bounds(w, ow, random_u)
     planes = []
+    mplanes = []
     for a in range(od):
         rows = []
+        mrows = []
         for i in range(oh):
             cols = []
+            mcols = []
             for j in range(ow):
                 win = x[:, :, ds_[a]:de[a], hs[i]:he[i], ws[j]:we[j]]
                 flat = m.reshape(win, [n, c, -1])
                 cols.append(m.reshape(flat.max(axis=-1), [n, c, 1, 1, 1]))
+                if return_mask:
+                    # flat D*H*W argmax index, global coordinates (2-D variant
+                    # convention extended with the depth stride)
+                    local = flat.argmax(axis=-1)
+                    lh = he[i] - hs[i]
+                    lw = we[j] - ws[j]
+                    ga = ds_[a] + local // (lh * lw)
+                    rem = local % (lh * lw)
+                    gi = hs[i] + rem // lw
+                    gj = ws[j] + rem % lw
+                    mcols.append(m.reshape((ga * h + gi) * w + gj,
+                                           [n, c, 1, 1, 1]))
             rows.append(m.concat(cols, axis=4))
+            if return_mask:
+                mrows.append(m.concat(mcols, axis=4))
         planes.append(m.concat(rows, axis=3))
-    return m.concat(planes, axis=2)
+        if return_mask:
+            mplanes.append(m.concat(mrows, axis=3))
+    out = m.concat(planes, axis=2)
+    if return_mask:
+        return out, m.concat(mplanes, axis=2)
+    return out

@@ -480,9 +480,12 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
         masked_scores = jnp.where(ok, top_s, -jnp.inf)
         keep = _nms(pv, scores=masked_scores, iou_threshold=nms_thresh)
         keep_v = keep.value if isinstance(keep, Tensor) else keep
+        # drop sub-min_size boxes entirely (they were only demoted to -inf for
+        # the NMS ranking; the reference removes them before NMS)
+        keep_v = keep_v[jnp.isfinite(masked_scores[keep_v])]
         keep_v = keep_v[:post_nms_top_n]
         all_rois.append(Tensor(pv[keep_v]))
-        all_scores.append(Tensor(jnp.sort(masked_scores)[::-1][:len(keep_v)]))
+        all_scores.append(Tensor(masked_scores[keep_v]))
         nums.append(len(keep_v))
     rois = Tensor(jnp.concatenate([r.value for r in all_rois]))
     rscores = Tensor(jnp.concatenate([s.value for s in all_scores]))

@@ -1,0 +1,243 @@
+"""Regression tests for the round-2 advisor findings (ADVICE.md):
+
+- flashmask_attention must densify startend_row_indices (was: silently unmasked)
+- generate_proposals must return scores gathered at kept indices (was: sorted
+  truncation, misaligned with rois when NMS suppresses a high-ranked box)
+- fractional_max_pool3d return_mask must return (out, mask)
+- variable_length_memory_efficient_attention must mask padding beyond
+  kv_seq_lens (was: padding attended as real tokens)
+- RPC listener must reject unauthenticated peers before unpickling
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _densemask_reference(sri, seq_len, causal):
+    """Reference flashmask_to_densemask loop (flash_attention.py:1555),
+    re-implemented in numpy as the test oracle."""
+    bz, nh, _, k = sri.shape
+    m = np.zeros((bz, nh, seq_len, seq_len), np.float32)
+    has_end = (causal and k == 2) or ((not causal) and k == 4)
+    for bi in range(bz):
+        for hi in range(nh):
+            for j in range(seq_len):
+                ds = sri[bi, hi, j, 0]
+                if has_end:
+                    de = sri[bi, hi, j, 1]
+                    m[bi, hi, ds:de, j] = -np.inf
+                else:
+                    m[bi, hi, ds:, j] = -np.inf
+                if causal:
+                    m[bi, hi, :j, j] = -np.inf
+                elif has_end:
+                    us = sri[bi, hi, j, 2]
+                    ue = sri[bi, hi, j, 3]
+                    m[bi, hi, us:ue, j] = -np.inf
+                else:
+                    ue = sri[bi, hi, j, 1]
+                    m[bi, hi, :ue, j] = -np.inf
+    return m
+
+
+def _sdpa_numpy(q, k, v, add_mask):
+    # q,k,v: (B, S, H, D); add_mask: (B, H, S, S) additive
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    logits = qt @ kt.transpose(0, 1, 3, 2) / np.sqrt(q.shape[-1])
+    if add_mask is not None:
+        logits = logits + add_mask
+    logits = logits - logits.max(-1, keepdims=True)
+    p = np.exp(logits)
+    p = p / p.sum(-1, keepdims=True)
+    return (p @ vt).transpose(0, 2, 1, 3)
+
+
+class TestFlashmaskAttention:
+    @pytest.mark.parametrize("causal,bounds", [
+        (True, 1), (True, 2), (False, 2), (False, 4)])
+    def test_matches_dense_reference(self, causal, bounds):
+        from paddle_tpu.nn.functional.extras import flashmask_attention
+
+        r = np.random.RandomState(0)
+        B, S, H, D = 2, 8, 2, 4
+        q = r.randn(B, S, H, D).astype("float32")
+        k = r.randn(B, S, H, D).astype("float32")
+        v = r.randn(B, S, H, D).astype("float32")
+        if bounds == 1:
+            sri = r.randint(1, S + 1, (B, 1, S, 1))
+        elif bounds == 2 and causal:
+            lo = r.randint(1, S, (B, 1, S, 1))
+            sri = np.concatenate([lo, np.minimum(lo + 2, S)], -1)
+        elif bounds == 2:
+            lts = r.randint(4, S + 1, (B, 1, S, 1))
+            ute = r.randint(0, 4, (B, 1, S, 1))
+            sri = np.concatenate([lts, ute], -1)
+        else:
+            lts = r.randint(4, S + 1, (B, 1, S, 1))
+            lte = np.minimum(lts + 2, S)
+            uts = r.randint(0, 2, (B, 1, S, 1))
+            ute = np.minimum(uts + 2, 4)
+            sri = np.concatenate([lts, lte, uts, ute], -1)
+        sri = sri.astype("int32")
+
+        out = flashmask_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            startend_row_indices=paddle.to_tensor(sri), causal=causal)
+        dense = _densemask_reference(sri, S, causal)
+        want = _sdpa_numpy(q, k, v, np.broadcast_to(dense, (B, H, S, S)))
+        # rows fully masked by the pattern are NaN in the -inf oracle but a
+        # finite uniform mix under the kernel's -1e30; compare attendable rows
+        valid = np.isfinite(want)
+        assert valid.any()
+        np.testing.assert_allclose(out.numpy()[valid], want[valid],
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_mask_actually_changes_output(self):
+        from paddle_tpu.nn.functional.extras import flashmask_attention
+
+        r = np.random.RandomState(1)
+        q = paddle.to_tensor(r.randn(1, 6, 1, 4).astype("float32"))
+        # mask everything below row 1 in every column -> only row 0 attends
+        sri = paddle.to_tensor(np.full((1, 1, 6, 1), 1, "int32"))
+        masked = flashmask_attention(q, q, q, startend_row_indices=sri,
+                                     causal=True)
+        unmasked = flashmask_attention(q, q, q, causal=True)
+        assert not np.allclose(masked.numpy(), unmasked.numpy())
+
+
+class TestGenerateProposalsScores:
+    def test_scores_follow_kept_boxes(self):
+        """NMS suppresses the 2nd-ranked box; the 2nd returned score must be
+        the 3rd box's score, not the suppressed one's."""
+        from paddle_tpu.vision.ops import generate_proposals
+
+        # anchors: A and B overlap heavily; C is disjoint
+        anchors = np.array([[0, 0, 10, 10],
+                            [1, 1, 11, 11],
+                            [40, 40, 50, 50]], "float32")
+        scores = np.array([0.9, 0.8, 0.5], "float32").reshape(1, 3, 1, 1)
+        deltas = np.zeros((1, 12, 1, 1), "float32")
+        var = np.ones_like(anchors)
+        img = np.array([[100.0, 100.0]], "float32")
+        rois, rscores, num = generate_proposals(
+            paddle.to_tensor(scores), paddle.to_tensor(deltas),
+            paddle.to_tensor(img), paddle.to_tensor(anchors),
+            paddle.to_tensor(var), pre_nms_top_n=3, post_nms_top_n=3,
+            nms_thresh=0.5, min_size=0.0, return_rois_num=True)
+        got = sorted(rscores.numpy().tolist(), reverse=True)
+        assert not any(abs(g - 0.8) < 1e-5 for g in got)
+        np.testing.assert_allclose(got[:2], [0.9, 0.5], rtol=1e-5)
+        # score i belongs to roi i: the 0.5 score rides the [40,40,50,50] box
+        idx = int(np.argmin(np.abs(rscores.numpy() - 0.5)))
+        np.testing.assert_allclose(rois.numpy()[idx], [40, 40, 50, 50])
+
+
+class TestFractionalMaxPool3dMask:
+    def test_return_mask_tuple_and_consistency(self):
+        import paddle_tpu.nn.functional as F
+
+        r = np.random.RandomState(0)
+        x = r.randn(2, 3, 8, 8, 8).astype("float32")
+        res = F.fractional_max_pool3d(paddle.to_tensor(x), output_size=4,
+                                      random_u=0.3, return_mask=True)
+        assert isinstance(res, tuple) and len(res) == 2
+        out, mask = res
+        assert tuple(out.shape) == (2, 3, 4, 4, 4)
+        assert tuple(mask.shape) == (2, 3, 4, 4, 4)
+        # mask holds flat D*H*W indices of the max sites
+        flat = x.reshape(2, 3, -1)
+        gathered = np.take_along_axis(flat, mask.numpy().reshape(2, 3, -1), -1)
+        np.testing.assert_allclose(gathered.reshape(out.shape), out.numpy())
+
+    def test_no_mask_returns_bare_tensor(self):
+        import paddle_tpu.nn.functional as F
+
+        x = paddle.to_tensor(np.zeros((1, 1, 4, 4, 4), "float32"))
+        out = F.fractional_max_pool3d(x, output_size=2, random_u=0.5)
+        assert tuple(out.shape) == (1, 1, 2, 2, 2)
+
+
+class TestVarlenAttentionSeqLens:
+    def test_padding_is_masked(self):
+        from paddle_tpu.incubate.nn import functional as IF
+
+        r = np.random.RandomState(0)
+        B, H, S, D = 2, 2, 8, 4
+        q = r.randn(B, H, S, D).astype("float32")
+        k = r.randn(B, H, S, D).astype("float32")
+        v = r.randn(B, H, S, D).astype("float32")
+        kv_lens = np.array([5, 3], "int32")
+
+        out = IF.variable_length_memory_efficient_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            paddle.to_tensor(kv_lens), paddle.to_tensor(kv_lens))
+        # oracle: attention over only the valid kv prefix, per batch
+        for b in range(B):
+            L = kv_lens[b]
+            want = _sdpa_numpy(q[b:b + 1].transpose(0, 2, 1, 3),
+                               k[b:b + 1, :, :L].transpose(0, 2, 1, 3),
+                               v[b:b + 1, :, :L].transpose(0, 2, 1, 3), None)
+            np.testing.assert_allclose(
+                out.numpy()[b].transpose(1, 0, 2), want[0],
+                rtol=1e-4, atol=1e-5)
+
+    def test_garbage_in_padding_does_not_leak(self):
+        from paddle_tpu.incubate.nn import functional as IF
+
+        r = np.random.RandomState(1)
+        q = r.randn(1, 1, 4, 4).astype("float32")
+        k = r.randn(1, 1, 4, 4).astype("float32")
+        v = r.randn(1, 1, 4, 4).astype("float32")
+        k2, v2 = k.copy(), v.copy()
+        k2[:, :, 2:] = 1e3   # garbage beyond the valid length
+        v2[:, :, 2:] = -1e3
+        lens = paddle.to_tensor(np.array([2], "int32"))
+        a = IF.variable_length_memory_efficient_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            lens, lens)
+        b = IF.variable_length_memory_efficient_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k2), paddle.to_tensor(v2),
+            lens, lens)
+        np.testing.assert_allclose(a.numpy(), b.numpy(), rtol=1e-5)
+
+
+class TestRpcAuth:
+    def test_unauthenticated_peer_rejected(self):
+        """A raw socket that fails the HMAC handshake must be dropped without
+        its frame being unpickled (no RCE for unauthenticated peers)."""
+        import socket
+        import struct
+
+        from paddle_tpu.distributed.rpc import rpc as rpc_mod
+
+        rpc_mod.init_rpc("w0", rank=0, world_size=1)
+        try:
+            info = rpc_mod.get_current_worker_info()
+            s = socket.create_connection((info.ip, info.port), timeout=5)
+            s.settimeout(5)
+            nonce = s.recv(32)          # server challenge
+            assert len(nonce) == 32
+            s.sendall(b"\x00" * 32)      # wrong MAC
+            # a malicious frame after the bad MAC: server must close, not exec
+            evil = b"not-a-real-pickle"
+            try:
+                s.sendall(struct.pack("<Q", len(evil)) + evil)
+                got = s.recv(1)
+            except (ConnectionError, OSError):
+                got = b""
+            assert got == b""            # connection dropped, no reply
+            s.close()
+        finally:
+            rpc_mod.shutdown()
+
+    def test_authenticated_rpc_still_works(self):
+        from paddle_tpu.distributed.rpc import rpc as rpc_mod
+
+        rpc_mod.init_rpc("solo", rank=0, world_size=1)
+        try:
+            assert rpc_mod.rpc_sync("solo", divmod, args=(7, 3)) == (2, 1)
+        finally:
+            rpc_mod.shutdown()
