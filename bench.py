@@ -117,8 +117,10 @@ def _load_cache():
         max_age_h = float(os.environ.get("BENCH_CACHE_MAX_AGE_H", "48"))
         measured = doc.get("detail", {}).get("measured_at")
         if measured:
-            age = time.time() - time.mktime(
-                time.strptime(measured, "%Y-%m-%dT%H:%M:%SZ")) + time.timezone
+            import calendar
+
+            age = time.time() - calendar.timegm(
+                time.strptime(measured, "%Y-%m-%dT%H:%M:%SZ"))
             if age > max_age_h * 3600:
                 return None
         return doc

@@ -13,7 +13,11 @@ reference), so the listener authenticates peers before accepting frames —
 an HMAC challenge-response over a shared secret that rank 0 generates and
 distributes through the rendezvous TCPStore (override with
 PADDLE_RPC_AUTH_KEY). Unauthenticated connections are dropped without
-unpickling anything.
+unpickling anything. The key is deleted from the store once every rank has
+fetched it, but during bootstrap it transits the store in cleartext — the
+master port must be protected exactly like the worker RPC ports (same
+firewall perimeter); for a stronger posture pre-share PADDLE_RPC_AUTH_KEY
+out of band so no key ever touches the store.
 """
 from __future__ import annotations
 
@@ -193,6 +197,11 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     _STATE.workers = workers
     _STATE.server = server
     _barrier("init")
+    if world_size > 1 and env_key is None and rank == 0:
+        # every rank holds the key now (worker infos publish after the key
+        # fetch, and all ranks passed the barrier) — remove it from the store
+        # so late/unauthorized store clients cannot read it
+        _STATE.store.delete_key("rpc/auth_key")
 
 
 class _Connection:

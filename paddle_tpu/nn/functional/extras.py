@@ -389,9 +389,14 @@ def flashmask_attention(query, key, value, startend_row_indices=None,
     if startend_row_indices is None:
         return scaled_dot_product_attention(query, key, value, attn_mask=None,
                                             dropout_p=dropout, is_causal=causal)
+    import jax.numpy as jnp
+
     sri = getattr(startend_row_indices, "value", startend_row_indices)
     seq_len = query.shape[1]
     keep = _flashmask_to_dense(sri, seq_len, causal)
+    hq, kh = int(query.shape[2]), int(keep.shape[1])
+    if kh not in (1, hq):  # GQA: kv-head mask -> repeat to query heads
+        keep = jnp.repeat(keep, hq // kh, axis=1)
     return scaled_dot_product_attention(query, key, value, attn_mask=keep,
                                         dropout_p=dropout, is_causal=False)
 

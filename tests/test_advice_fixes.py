@@ -95,6 +95,21 @@ class TestFlashmaskAttention:
         np.testing.assert_allclose(out.numpy()[valid], want[valid],
                                    rtol=1e-4, atol=1e-5)
 
+    def test_gqa_kv_head_mask_repeats_to_query_heads(self):
+        from paddle_tpu.nn.functional.extras import flashmask_attention
+
+        r = np.random.RandomState(2)
+        B, S, HQ, HK, D = 1, 8, 4, 2, 4
+        q = paddle.to_tensor(r.randn(B, S, HQ, D).astype("float32"))
+        k = paddle.to_tensor(r.randn(B, S, HK, D).astype("float32"))
+        v = paddle.to_tensor(r.randn(B, S, HK, D).astype("float32"))
+        sri = paddle.to_tensor(
+            r.randint(1, S + 1, (B, HK, S, 1)).astype("int32"))
+        out = flashmask_attention(q, k, v, startend_row_indices=sri,
+                                  causal=True)
+        assert tuple(out.shape) == (B, S, HQ, D)
+        assert np.isfinite(out.numpy()).all()
+
     def test_mask_actually_changes_output(self):
         from paddle_tpu.nn.functional.extras import flashmask_attention
 

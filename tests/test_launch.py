@@ -135,6 +135,129 @@ print(f"FINAL_LOSS={float(loss):.10f}", flush=True)
 """
 
 
+_HYBRID_TRAINER = """
+import os, sys
+import numpy as np
+import paddle_tpu as paddle  # noqa: F401  (configures platform, x64, bootstrap)
+import paddle_tpu.distributed as dist
+
+env = dist.init_parallel_env()
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+expect_procs = int(os.environ.get("EXPECT_PROCS", "1"))
+assert jax.process_count() == expect_procs, jax.process_count()
+assert jax.device_count() == 8, jax.device_count()
+
+# one global dp2 x mp4 mesh spanning all processes: each process owns one dp row
+mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("dp", "mp"))
+rows = NamedSharding(mesh, P("dp"))
+col_w = NamedSharding(mesh, P(None, "mp"))
+row_w = NamedSharding(mesh, P("mp", None))
+rep = NamedSharding(mesh, P())
+
+rng = np.random.RandomState(0)
+X = rng.randn(32, 4).astype("float32")
+W_true = np.array([[1.0], [-2.0], [0.5], [3.0]], "float32")
+Y = X @ W_true
+W1 = (rng.randn(4, 8) * 0.5).astype("float32")
+W2 = (rng.randn(8, 1) * 0.5).astype("float32")
+
+rank, nproc = jax.process_index(), jax.process_count()
+per = 32 // nproc
+local = slice(rank * per, (rank + 1) * per)
+Xg = jax.make_array_from_process_local_data(rows, X[local], X.shape)
+Yg = jax.make_array_from_process_local_data(rows, Y[local], Y.shape)
+W1g = jax.make_array_from_process_local_data(col_w, W1, W1.shape)
+W2g = jax.make_array_from_process_local_data(row_w, W2, W2.shape)
+
+def step(w1, w2, x, y):
+    def loss_fn(w1, w2):
+        h = x @ w1                 # (32, 8) mp-sharded activations
+        return jnp.mean((h @ w2 - y) ** 2)
+    loss, (g1, g2) = jax.value_and_grad(loss_fn, argnums=(0, 1))(w1, w2)
+    return w1 - 0.1 * g1, w2 - 0.1 * g2, loss
+
+step_c = jax.jit(step, in_shardings=(col_w, row_w, rows, rows),
+                 out_shardings=(col_w, row_w, rep))
+for i in range(250):
+    W1g, W2g, loss = step_c(W1g, W2g, Xg, Yg)
+    jax.block_until_ready(loss)   # serialize cross-process gloo dispatches
+    if i == 0:
+        print(f"FIRST_LOSS={float(loss):.10f}", flush=True)
+print(f"FINAL_LOSS={float(loss):.10f}", flush=True)
+"""
+
+
+def _extract(tag, text):
+    return float([ln for ln in text.splitlines()
+                  if ln.startswith(tag + "=")][-1].split("=")[1])
+
+
+@pytest.mark.timeout(300)
+def test_multinode_style_dp_mp_matches_single_process(tmp_path):
+    """The round-2 verdict's multi-host proof: 2 launcher invocations in
+    --nnodes 2 --rank {0,1} form (one proc per 'node', 4 virtual devices each)
+    rendezvous through the TCPStore-selected coordinator into ONE 8-device
+    global mesh, run a compiled dp2 x mp4 train step, and the final loss
+    matches the single-process 8-device run of the same program.
+
+    Mirrors the reference's multi-node collective tests
+    (test/collective/ via paddle.distributed.launch, SURVEY §4)."""
+    script = tmp_path / "hybrid_trainer.py"
+    script.write_text(_HYBRID_TRAINER)
+    base_env = dict(os.environ)
+    base_env["PADDLE_TPU_PLATFORM"] = "cpu"
+    base_env["PYTHONPATH"] = REPO + os.pathsep + base_env.get("PYTHONPATH", "")
+    base_env.pop("JAX_PLATFORMS", None)
+
+    # reference run: one process, 8 virtual devices, no launcher env
+    ref_env = dict(base_env)
+    ref_env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    ref_env["EXPECT_PROCS"] = "1"
+    for k in ("PADDLE_TRAINERS_NUM", "PADDLE_TRAINER_ID", "PADDLE_MASTER"):
+        ref_env.pop(k, None)
+    ref = subprocess.run([sys.executable, str(script)], env=ref_env, cwd=REPO,
+                         capture_output=True, text=True, timeout=240)
+    assert ref.returncode == 0, ref.stdout + ref.stderr
+    ref_first = _extract("FIRST_LOSS", ref.stdout)
+    ref_loss = _extract("FINAL_LOSS", ref.stdout)
+
+    # multi-'node' run: two launchers, one proc each, 4 virtual devices each
+    env2 = dict(base_env)
+    env2["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env2["EXPECT_PROCS"] = "2"
+    port = _free_port()
+    log_dir = tmp_path / "logs"
+    launchers = []
+    for node_rank in range(2):
+        launchers.append(subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--master", f"127.0.0.1:{port}", "--nnodes", "2",
+             "--rank", str(node_rank), "--nproc_per_node", "1",
+             "--log_dir", str(log_dir), str(script)],
+            env=env2, cwd=REPO))
+    rcs = [p.wait(timeout=240) for p in launchers]
+    logs = {}
+    for i in range(2):
+        path = log_dir / f"workerlog.{i}"
+        logs[i] = path.read_text() if path.exists() else "<missing>"
+    assert rcs == [0, 0], f"launcher rcs={rcs}\nlogs={logs}"
+    firsts, losses = [], []
+    for i in range(2):
+        assert "FINAL_LOSS=" in logs[i], f"rank {i} produced no loss:\n{logs[i]}"
+        firsts.append(_extract("FIRST_LOSS", logs[i]))
+        losses.append(_extract("FINAL_LOSS", logs[i]))
+    assert losses[0] == losses[1], losses        # bit-identical across ranks
+    # the cross-process 8-device run reproduces the single-process result up to
+    # f32 reduction-order drift (gloo ring vs in-process reduce): tight on the
+    # first step, convergence-level at the end
+    assert abs(firsts[0] - ref_first) < 1e-6, (firsts[0], ref_first)
+    assert abs(losses[0] - ref_loss) < 1e-5, (losses[0], ref_loss)
+    assert ref_loss < 1e-3 and losses[0] < 1e-3  # both converged
+
+
 @pytest.mark.timeout(300)
 def test_launch_two_process_dp_training(tmp_path):
     """Launcher spawns 2 OS processes; both rendezvous via TCPStore, initialize
