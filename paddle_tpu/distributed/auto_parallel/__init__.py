@@ -97,7 +97,7 @@ class DistModel:
         params = self._params()
         for p in params:
             if id(p) not in inner._accumulators:
-                inner._accumulators[id(p)] = inner._init_state(p)
+                inner._accumulators[id(p)] = inner._init_sharded_state(p)
         keys = [sorted(inner._accumulators[id(p)].keys()) for p in params]
         return inner, keys
 

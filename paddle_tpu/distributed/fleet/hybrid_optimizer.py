@@ -97,17 +97,52 @@ class HybridParallelOptimizer:
         return self._inner_opt
 
 
+def _existing_placements(value, mesh):
+    """Recover per-mesh-axis placements from a value's NamedSharding so ZeRO
+    annotation composes with shardings already on the state (e.g. the compiled
+    pipeline's pp-stacked parameters, NamedSharding P(None, 'pp'))."""
+    placements = [Replicate()] * mesh.ndim
+    sh = getattr(value, "sharding", None)
+    spec = getattr(sh, "spec", None)
+    if spec is None:
+        return placements, set()
+    claimed = set()
+    for dim, names in enumerate(spec):
+        if names is None:
+            continue
+        for name in (names if isinstance(names, tuple) else (names,)):
+            if name in mesh.dim_names:
+                placements[mesh.dim_names.index(name)] = Shard(dim)
+                claimed.add(dim)
+    return placements, claimed
+
+
 def _make_state_shard_fn(mesh, axis_idx, degree):
-    """The one placement builder every ZeRO entry point shares: accumulators whose
-    leading dim divides the sharding degree get Shard(0) on that axis, else stay put."""
+    """The one placement builder every ZeRO entry point shares: the accumulator
+    gets Shard(dim) over the sharding axis on its first free dim divisible by
+    the degree, PRESERVING any sharding already on it (pp-stacked stage params
+    keep their pp axis — the pp x ZeRO composition the reference treats as a
+    first-class config, dygraph_sharding_optimizer.py:592 V2 + PP)."""
 
     def shard_fn(key, param, accumulator):
         v = accumulator.value if isinstance(accumulator, Tensor) else accumulator
-        if v.ndim == 0 or v.shape[0] % degree != 0:
+        if v.ndim == 0:
             return accumulator
+        # the param's live sharding is the source of truth (a fresh accumulator
+        # may not have inherited it yet); same-shape states mirror the param
+        pv = getattr(param, "value", None) if param is not None else None
+        base = pv if (pv is not None and pv.shape == v.shape) else v
+        placements, claimed = _existing_placements(base, mesh)
+        if isinstance(placements[axis_idx], Replicate):
+            for dim in range(v.ndim):
+                if dim not in claimed and v.shape[dim] % degree == 0:
+                    placements[axis_idx] = Shard(dim)
+                    break
+            else:
+                return accumulator  # no free divisible dim
+        # else: the param already carries the ZeRO axis (stage-3) — the state
+        # must be laid out to the inherited placements, not left replicated
         t = accumulator if isinstance(accumulator, Tensor) else Tensor(accumulator)
-        placements = [Replicate()] * mesh.ndim
-        placements[axis_idx] = Shard(0)
         return dist_api.shard_tensor(t, mesh, placements)
 
     return shard_fn
@@ -169,16 +204,31 @@ def group_sharded_parallel(model, optimizer, level="os_g", scaler=None, group=No
 
     if level == "p_g_os":
         # stage 3: parameters themselves live sharded; forward reads re-gather via GSPMD
+        replaced = {}
         for _, sub in model.named_sublayers(include_self=True):
             for pname, p in list(sub._parameters.items()):
                 if p is None:
                     continue
                 if p.ndim >= 1 and p.shape[0] % degree == 0:
-                    sub._parameters[pname] = dist_api.shard_tensor(
-                        p, mesh, state_placements())
+                    new = dist_api.shard_tensor(p, mesh, state_placements())
                 else:
-                    sub._parameters[pname] = dist_api.shard_tensor(
+                    new = dist_api.shard_tensor(
                         p, mesh, [Replicate()] * mesh.ndim)
+                sub._parameters[pname] = new
+                replaced[id(p)] = new
+        # the optimizer must update the REPLACED params (the ones the forward
+        # reads and grads flow to), not the stale originals — and any state it
+        # already holds (loaded checkpoints, prior steps) must follow the keys
+        inner = getattr(optimizer, "inner_opt", optimizer)
+        for pg in getattr(inner, "_param_groups", []):
+            pg["params"] = [replaced.get(id(p), p) for p in pg["params"]]
+        for attr in ("_accumulators", "_master_weights"):
+            table = getattr(inner, attr, None)
+            if not table:
+                continue
+            for old_id, new in replaced.items():
+                if old_id in table:
+                    table[id(new)] = table.pop(old_id)
     elif level not in ("os", "os_g"):
         raise ValueError(f"unsupported group_sharded level {level!r}")
     return model, optimizer, scaler

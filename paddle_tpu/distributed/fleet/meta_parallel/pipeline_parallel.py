@@ -92,10 +92,21 @@ class PipelineParallel(MetaParallelBase):
 
             v = (self._default_virtual_stages
                  or getattr(layers, "_num_virtual_stages", 1) or 1)
+            # reference schedule_mode names (pipeline_scheduler_pass/) -> ours
+            mode = str(cfg.get("schedule_mode", "1F1B"))
+            known = {"1f1b": "1f1b", "fthenb": "gpipe", "gpipe": "gpipe",
+                     "zbh1": "zb", "zb": "zb", "zero_bubble": "zb",
+                     "vpp": "1f1b"}
+            if mode.lower() not in known:
+                raise ValueError(
+                    f"unknown pipeline schedule_mode {mode!r}; "
+                    f"supported: {sorted(known)}")
+            schedule = known[mode.lower()]
             self._compiled = compile_pipeline(
                 layers,
                 mesh=hcg.global_mesh.jax_mesh(),
                 num_microbatches=self.accumulate_steps,
+                schedule=schedule,
                 num_virtual_stages=v)
 
     # compiled mode owns the (stacked) parameters the optimizer must see

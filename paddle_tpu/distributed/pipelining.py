@@ -47,11 +47,47 @@ from ..framework import random as rng
 from ..framework.core import Parameter, Tensor
 from ..nn.layer.layers import Layer
 
-__all__ = ["pipeline_forward", "PipelinedModule", "compile_pipeline"]
+__all__ = ["pipeline_forward", "pipeline_forward_zb", "pipeline_schedule_stats",
+           "PipelinedModule", "compile_pipeline"]
 
 
 def _ring(axis_size):
     return [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+
+def _forward_rotation(apply_fn, params, x_all, idx, axis_name, S, M,
+                      save_inputs=False):
+    """The one forward rotation both schedules share: S+M-1 lockstep ticks,
+    stage 0 injecting micro-batches, stage S-1 collecting outputs, activations
+    hopping one stage per tick via ppermute. With ``save_inputs`` each tick's
+    stage input is also recorded (the zb backward's residuals).
+
+    Returns (outputs_psummed_over_axis, xsave_or_None)."""
+    T = S + M - 1
+    zero = lax.pcast(jnp.zeros_like(x_all[0]), (axis_name,), to="varying")
+    outbuf = lax.pcast(jnp.zeros_like(x_all), (axis_name,), to="varying")
+    xsave0 = lax.pcast(
+        jnp.zeros((T,) + x_all.shape[1:], x_all.dtype) if save_inputs
+        else jnp.zeros(()), (axis_name,), to="varying")
+
+    def tick(carry, t):
+        state, outbuf, xsave = carry
+        inject = lax.dynamic_index_in_dim(
+            x_all, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+        cur = jnp.where(idx == 0, inject, state)
+        if save_inputs:
+            xsave = lax.dynamic_update_index_in_dim(xsave, cur, t, 0)
+        y = apply_fn(params, cur)
+        out_idx = jnp.clip(t - (S - 1), 0, M - 1)
+        valid = (t >= S - 1) & (idx == S - 1)
+        new = lax.dynamic_update_index_in_dim(outbuf, y, out_idx, 0)
+        outbuf = jnp.where(valid, new, outbuf)
+        state = lax.ppermute(y, axis_name, _ring(S))
+        return (state, outbuf, xsave), None
+
+    (_, outbuf, xsave), _ = lax.scan(
+        tick, (zero, outbuf, xsave0), jnp.arange(T))
+    return lax.psum(outbuf, axis_name), (xsave if save_inputs else None)
 
 
 def pipeline_forward(stage_fn, stacked_params, x_microbatches, *, mesh,
@@ -74,39 +110,169 @@ def pipeline_forward(stage_fn, stacked_params, x_microbatches, *, mesh,
         local = [lv[:, 0] for lv in leaf_vals]
         idx = lax.axis_index(axis_name)
 
-        def one_round(chunk_leaves, x_all):
-            params = jax.tree_util.tree_unflatten(treedef, chunk_leaves)
-            state = lax.pcast(jnp.zeros_like(x_all[0]), (axis_name,),
-                              to="varying")
-            outbuf = lax.pcast(jnp.zeros_like(x_all), (axis_name,),
-                               to="varying")
-
-            def tick(carry, t):
-                state, outbuf = carry
-                inject = lax.dynamic_index_in_dim(
-                    x_all, jnp.clip(t, 0, M - 1), 0, keepdims=False)
-                cur = jnp.where(idx == 0, inject, state)
-                y = apply_one(params, cur)
-                out_idx = jnp.clip(t - (S - 1), 0, M - 1)
-                valid = (t >= S - 1) & (idx == S - 1)
-                new = lax.dynamic_update_index_in_dim(outbuf, y, out_idx, 0)
-                outbuf = jnp.where(valid, new, outbuf)
-                state = lax.ppermute(y, axis_name, _ring(S))
-                return (state, outbuf), None
-
-            (state, outbuf), _ = lax.scan(
-                tick, (state, outbuf), jnp.arange(S + M - 1))
-            # only the last stage's lanes hold data; the psum is the broadcast
-            # back to every pp rank (feeds round r+1's stage 0 / the epilogue)
-            return lax.psum(outbuf, axis_name)
-
         for r in range(num_virtual):
-            x_all = one_round([lv[r] for lv in local], x_all)
+            params = jax.tree_util.tree_unflatten(
+                treedef, [lv[r] for lv in local])
+            # psum broadcasts the last stage's outputs back to every pp rank
+            # (feeds round r+1's stage 0 / the epilogue)
+            x_all, _ = _forward_rotation(
+                apply_one, params, x_all, idx, axis_name, S, M)
         return x_all
 
     in_specs = (P(),) + tuple(P(None, axis_name) for _ in leaves)
     return jax.shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=P(),
                          axis_names={axis_name})(x_microbatches, *leaves)
+
+
+def pipeline_forward_zb(stage_fn, stacked_params, x_microbatches, *, mesh,
+                        axis_name="pp", num_virtual=1):
+    """Zero-bubble (ZB-H1-style) schedule: B/W-split backward.
+
+    Reference analog: python/paddle/distributed/passes/pipeline_scheduler_pass/
+    pipeline_zero_bubble.py:1 (ZB-H1: backward is split into B — the activation
+    gradient, which sits on the inter-stage critical path — and W — the weight
+    gradient, which depends only on saved activations and the incoming grad and
+    is scheduled into the tail bubble).
+
+    Compiled-rotation translation: the forward rotation additionally saves each
+    tick's stage input; the custom-VJP backward runs a REVERSE rotation whose
+    per-tick program computes only dx (the W computation is never built into
+    the tick, so each backward tick is ~B instead of B+W), then computes every
+    dW in ONE batched, bubble-free vmap over the device's M valid slots.
+    Wasted-lane (bubble) compute drops from (S-1)/(S+M-1) of everything to
+    (S-1) ticks of only fwd+B work — see ``pipeline_schedule_stats``. Memory is
+    1F1B-like: one saved stage-input per tick (O(S+M) micro-activations), not
+    gpipe's full residuals.
+    """
+    S = mesh.shape[axis_name]
+    M = x_microbatches.shape[0]
+    T = S + M - 1
+    leaves, treedef = jax.tree_util.tree_flatten(stacked_params)
+    ring_rev = [(i, (i - 1) % S) for i in range(S)]
+
+    def _apply(leaf_vals, x):
+        return stage_fn(jax.tree_util.tree_unflatten(treedef, leaf_vals), x)
+
+    # ---- forward rotation: also saves per-tick stage inputs ---------------
+    def fwd_body(x_all, *leaf_vals):
+        local = [lv[0] for lv in leaf_vals]   # drop sharded stage axis
+        idx = lax.axis_index(axis_name)
+        out, xsave = _forward_rotation(
+            lambda lv, x: _apply(lv, x), local, x_all, idx, axis_name, S, M,
+            save_inputs=True)
+        return out, xsave[None]               # (1, T, ...) per stage
+
+    in_specs = (P(),) + tuple(P(axis_name) for _ in leaves)
+    fwd_sm = jax.shard_map(fwd_body, mesh=mesh, in_specs=in_specs,
+                           out_specs=(P(), P(axis_name)),
+                           axis_names={axis_name})
+
+    # ---- backward: dx-only reverse rotation + batched dW phase ------------
+    def bwd_body(g_out, xsave_g, *leaf_vals):
+        local = [lv[0] for lv in leaf_vals]
+        xsave = xsave_g[0]
+        idx = lax.axis_index(axis_name)
+        zero = lax.pcast(jnp.zeros_like(g_out[0]), (axis_name,), to="varying")
+        gsave = lax.pcast(jnp.zeros((T,) + g_out.shape[1:], g_out.dtype),
+                          (axis_name,), to="varying")
+        dxbuf = lax.pcast(jnp.zeros_like(g_out), (axis_name,), to="varying")
+
+        def tick(carry, u):
+            state, gsave, dxbuf = carry
+            t = T - 1 - u
+            m = t - idx                      # micro handled by this stage now
+            validm = (m >= 0) & (m < M)
+            inject = lax.dynamic_index_in_dim(
+                g_out, jnp.clip(M - 1 - u, 0, M - 1), 0, keepdims=False)
+            g_cur = jnp.where(idx == S - 1, inject, state)
+            g_cur = jnp.where(validm, g_cur, jnp.zeros_like(g_cur))
+            gsave = lax.dynamic_update_index_in_dim(gsave, g_cur, t, 0)
+            x_t = lax.dynamic_index_in_dim(xsave, t, 0, keepdims=False)
+            # B phase: dx only — the params cotangent is never requested, so
+            # the tick's program contains no W work
+            _, pull_x = jax.vjp(lambda xx: _apply(local, xx), x_t)
+            (dx,) = pull_x(g_cur)
+            write = validm & (idx == 0)
+            new = lax.dynamic_update_index_in_dim(
+                dxbuf, dx, jnp.clip(m, 0, M - 1), 0)
+            dxbuf = jnp.where(write, new, dxbuf)
+            state = lax.ppermute(dx, axis_name, ring_rev)
+            return (state, gsave, dxbuf), None
+
+        (_, gsave, dxbuf), _ = lax.scan(
+            tick, (zero, gsave, dxbuf), jnp.arange(T))
+
+        # W phase: this stage's valid slots are exactly ticks [idx, idx+M) —
+        # one batched vmap, no rotation, no bubble
+        xs = lax.dynamic_slice_in_dim(xsave, idx, M, 0)
+        gs = lax.dynamic_slice_in_dim(gsave, idx, M, 0)
+
+        def per_slot(x, g):
+            _, pull_p = jax.vjp(lambda lv: _apply(lv, x), local)
+            (dlv,) = pull_p(g)
+            return dlv
+
+        dlv = jax.vmap(per_slot)(xs, gs)
+        dlocal = [d.sum(0)[None] for d in dlv]     # (1, ...) stage-axis leaf
+        return (lax.psum(dxbuf, axis_name), *dlocal)
+
+    bwd_in_specs = (P(), P(axis_name)) + tuple(P(axis_name) for _ in leaves)
+    bwd_sm = jax.shard_map(bwd_body, mesh=mesh, in_specs=bwd_in_specs,
+                           out_specs=(P(),) + tuple(P(axis_name)
+                                                    for _ in leaves),
+                           axis_names={axis_name})
+
+    @jax.custom_vjp
+    def round_fn(x_mb, *leaf_vals):
+        out, _ = fwd_sm(x_mb, *leaf_vals)
+        return out
+
+    def round_fwd(x_mb, *leaf_vals):
+        out, xsave = fwd_sm(x_mb, *leaf_vals)
+        return out, (xsave, leaf_vals)
+
+    def round_bwd(res, g_out):
+        xsave, leaf_vals = res
+        return bwd_sm(g_out, xsave, *leaf_vals)
+
+    round_fn.defvjp(round_fwd, round_bwd)
+
+    x = x_microbatches
+    for r in range(num_virtual):
+        x = round_fn(x, *[lv[r] for lv in leaves])
+    return x
+
+
+def pipeline_schedule_stats(schedule, num_stages, num_microbatches,
+                            num_virtual=1):
+    """Analytic per-device compute accounting in forward-FLOP units (F = one
+    stage forward; B = activation-grad = F; W = weight-grad = F; remat = F).
+
+    ``bubble_fraction`` is the wasted-lane share of total device compute: the
+    rotation runs S+M-1 lockstep ticks per round of which only M carry valid
+    data per device; zb removes the W work from those bubbled ticks entirely
+    (its W phase is bubble-free), so its bubble fraction is strictly below
+    1F1B's for every S>1. Matches the reference's schedule accounting role
+    (pipeline_scheduler_pass/pipeline_zero_bubble.py ZB-H1)."""
+    S, M, v = num_stages, num_microbatches, num_virtual
+    T = S + M - 1  # ticks per round
+    if schedule == "gpipe":       # no remat: fwd tick F, bwd tick B+W
+        total = v * (T * 1 + T * 2)
+        wasted = v * (T - M) * 3
+    elif schedule == "1f1b":      # remat: bwd tick = remat F + B + W
+        total = v * (T * 1 + T * 3)
+        wasted = v * (T - M) * 4
+    elif schedule == "zb":        # bwd tick = remat F + B; W phase M*(F+W)
+        total = v * (T * 1 + T * 2 + M * 2)
+        wasted = v * (T - M) * 3
+    else:
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    return {
+        "schedule": schedule, "num_stages": S, "num_microbatches": M,
+        "num_virtual": v, "ticks": v * T,
+        "total_flops_F": total, "wasted_flops_F": wasted,
+        "bubble_fraction": wasted / total,
+    }
 
 
 def _layer_signature(layer):
@@ -157,7 +323,7 @@ class PipelinedModule(Layer):
                  num_microbatches=None, schedule="1f1b",
                  num_virtual_stages=None):
         super().__init__()
-        if schedule not in ("1f1b", "gpipe"):
+        if schedule not in ("1f1b", "gpipe", "zb"):
             raise ValueError(f"unknown pipeline schedule {schedule!r}")
         self._mesh = mesh
         self._axis_name = axis_name
@@ -240,13 +406,22 @@ class PipelinedModule(Layer):
         # jit'd so the eager path executes the rotation as one compiled program
         # (and so vjp sees a closed jaxpr; un-jitted shard_map autodiff needs an
         # ambient mesh context that eager op dispatch doesn't provide)
-        @jax.jit
-        def fn(x_mb, *stacked_vals):
-            return pipeline_forward(
-                lambda params, x: self._stage_apply(params, x),
-                list(stacked_vals), x_mb, mesh=self._mesh,
-                axis_name=self._axis_name, num_virtual=self._num_virtual,
-                remat=self._schedule == "1f1b")
+        if self._schedule == "zb":
+            @jax.jit
+            def fn(x_mb, *stacked_vals):
+                return pipeline_forward_zb(
+                    lambda params, x: self._stage_apply(params, x),
+                    list(stacked_vals), x_mb, mesh=self._mesh,
+                    axis_name=self._axis_name,
+                    num_virtual=self._num_virtual)
+        else:
+            @jax.jit
+            def fn(x_mb, *stacked_vals):
+                return pipeline_forward(
+                    lambda params, x: self._stage_apply(params, x),
+                    list(stacked_vals), x_mb, mesh=self._mesh,
+                    axis_name=self._axis_name, num_virtual=self._num_virtual,
+                    remat=self._schedule == "1f1b")
 
         return fn
 
@@ -257,25 +432,40 @@ class PipelinedModule(Layer):
         return x
 
     def forward(self, input):  # noqa: A002
-        from ..ops import reshape
+        from ..ops import reshape, transpose
 
         h = self._run_segment(self._prologue, input)
         if isinstance(h, tuple):
             raise TypeError(
                 "compiled pipeline body carries a single activation tensor; got a "
                 "tuple from the prologue")
-        B = h.shape[0]
+        # the batch dim to micro-slice: axis 1 for sequence-major (S, B, H)
+        # bodies (sequence parallel), axis 0 otherwise — declared by the
+        # PipelineLayer (e.g. LlamaForCausalLMPipe sets _microbatch_axis)
+        ax = getattr(self._pipe_layer, "_microbatch_axis", 0)
+        shape = list(h.shape)
+        B = shape[ax]
         M = self.num_microbatches or 1
         if B % M:
             raise ValueError(f"batch {B} not divisible by micro-batches {M}")
-        rest = list(h.shape[1:])
-        h_mb = reshape(h, [M, B // M] + rest)
         from ..ops._apply import apply_raw
 
+        if ax == 0:
+            h_mb = reshape(h, [M, B // M] + shape[1:])
+        else:
+            n = len(shape) + 1
+            h_mb = reshape(h, shape[:ax] + [M, B // M] + shape[ax + 1:])
+            h_mb = transpose(h_mb, [ax] + [i for i in range(n) if i != ax])
         (out,) = apply_raw(
             "pipeline_body", self._pipeline_fn,
             [h_mb] + list(self._stacked_params))
-        out = reshape(out, [B] + rest)
+        if ax == 0:
+            out = reshape(out, shape)
+        else:
+            n = len(shape) + 1
+            out = transpose(out, list(range(1, ax + 1)) + [0]
+                            + list(range(ax + 1, n)))
+            out = reshape(out, shape)
         return self._run_segment(self._epilogue, out)
 
     def loss(self, output, label):

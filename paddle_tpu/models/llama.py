@@ -497,16 +497,31 @@ class _EmbeddingPipe(Layer):
                 config.vocab_size, config.hidden_size, weight_attr=init)
 
     def forward(self, input_ids):
-        return self.embed_tokens(input_ids)
+        h = self.embed_tokens(input_ids)
+        if self.config.sequence_parallel:
+            # same layout contract as LlamaModel.forward: decoder blocks run
+            # sequence-major (S, B, H), seq-sharded over mp
+            from ..distributed.fleet.utils.sequence_parallel_utils import scatter
+
+            h = ops.transpose(h, [1, 0, 2])
+            h = scatter(h)
+        return h
 
 
 class _NormPipe(Layer):
     def __init__(self, config):
         super().__init__()
+        self.config = config
         self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
 
     def forward(self, h):
-        return self.norm(h)
+        h = self.norm(h)
+        if self.config.sequence_parallel:
+            from ..distributed.fleet.utils.sequence_parallel_utils import all_gather
+
+            h = all_gather(h)
+            h = ops.transpose(h, [1, 0, 2])  # (S,B,H) -> (B,S,H) for the head
+        return h
 
 
 class _LMHeadPipe(LlamaLMHead):
@@ -529,6 +544,9 @@ def LlamaForCausalLMPipe(config: LlamaConfig, **pp_kwargs):
         seg_method="layer:LlamaDecoderLayer",
         **pp_kwargs,
     )
+    # under sequence parallel the inter-block activation is sequence-major
+    # (S, B, H): the compiled pipeline must micro-batch along axis 1
+    pipe._microbatch_axis = 1 if config.sequence_parallel else 0
 
     if (getattr(config, "num_experts", 0) or 0) > 1:
         moe_decs = [l for l in pipe.run_function

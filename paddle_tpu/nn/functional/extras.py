@@ -351,8 +351,6 @@ def _flashmask_to_dense(sri, seq_len, causal):
     sri is (B, KH, S, k); per key-column j, rows [start, end) (or [start, S))
     of the score matrix are masked; causal=True additionally masks i < j;
     non-causal variants carry upper-triangle bounds in the trailing slots."""
-    import jax.numpy as jnp
-
     k = sri.shape[-1]
     has_end = (causal and k == 2) or ((not causal) and k == 4)
     i = jnp.arange(seq_len)[None, None, :, None]   # query row
@@ -389,8 +387,6 @@ def flashmask_attention(query, key, value, startend_row_indices=None,
     if startend_row_indices is None:
         return scaled_dot_product_attention(query, key, value, attn_mask=None,
                                             dropout_p=dropout, is_causal=causal)
-    import jax.numpy as jnp
-
     sri = getattr(startend_row_indices, "value", startend_row_indices)
     seq_len = query.shape[1]
     keep = _flashmask_to_dense(sri, seq_len, causal)

@@ -103,6 +103,18 @@ class Optimizer:
         """Return dict of state arrays for param p (fp32)."""
         return {}
 
+    def _apply_shard_fn(self, p, state):
+        """Run the ZeRO placement hook (if installed) over a state dict — the
+        single path every state-creation site (step, set_state_dict, DistModel
+        pre-init) must go through so placements never diverge."""
+        if self._shard_fn is None:
+            return state
+        return {k: self._as_value(self._shard_fn(k, p, Tensor(v)))
+                for k, v in state.items()}
+
+    def _init_sharded_state(self, p):
+        return self._apply_shard_fn(p, self._init_state(p))
+
     def _rule(self, p, g, state, lr, **hyper):
         """Pure update: (p32, g32, state, lr) -> (new_p32, new_state)."""
         raise NotImplementedError
@@ -132,13 +144,7 @@ class Optimizer:
             p_vals, g_vals, states, masters = [], [], [], []
             for p, g in pg:
                 if id(p) not in self._accumulators:
-                    state = self._init_state(p)
-                    if self._shard_fn is not None:
-                        state = {
-                            k: self._as_value(self._shard_fn(k, p, Tensor(v)))
-                            for k, v in state.items()
-                        }
-                    self._accumulators[id(p)] = state
+                    self._accumulators[id(p)] = self._init_sharded_state(p)
                     if self._use_master_weights and np.dtype(p.dtype) in (
                         np.dtype(np.float16), np.dtype(jnp.bfloat16)
                     ):
@@ -233,12 +239,7 @@ class Optimizer:
             if found:
                 # re-apply the ZeRO placement hook: loaded accumulators must come back
                 # sharded exactly as freshly-created ones are in step()
-                if self._shard_fn is not None:
-                    acc = {
-                        k: self._as_value(self._shard_fn(k, p, Tensor(v)))
-                        for k, v in acc.items()
-                    }
-                self._accumulators[id(p)] = acc
+                self._accumulators[id(p)] = self._apply_shard_fn(p, acc)
             mw = state.get("master_weights", {}).get(name)
             if mw is not None:
                 self._master_weights[id(p)] = mw.value if isinstance(mw, Tensor) else mw

@@ -204,6 +204,129 @@ class TestSharding:
         out.mean().backward()
         opt.step()
         assert np.isfinite(out.numpy()).all()
+        # stage-3: optimizer state must be sharded like the param, not
+        # replicated (regression: shard_fn returned state untouched when the
+        # PARAM already carried the ZeRO axis)
+        w = model[0].weight
+        st = opt._accumulators[id(w)]
+        mv = next(iter(st.values()))
+        shard = mv.addressable_shards[0].data
+        assert int(np.prod(shard.shape)) == int(np.prod(mv.shape)) // 2, (
+            f"stage-3 state replicated: {shard.shape} of {mv.shape}")
+
+
+class TestParallelComposition:
+    """Products of the hybrid axes (round-2 verdict #4): ZeRO state sharding
+    under pp>1, sequence-parallel under pp>1, and the full dp x mp x pp x ZeRO
+    stack — loss parity with the unsharded run + per-device byte shrink.
+    Reference analog: dygraph_sharding_optimizer.py:592 V2 + PP as a
+    first-class config."""
+
+    def _square_pipe(self, n_layers=4):
+        paddle.seed(0)
+        return PipelineLayer(
+            layers=[LayerDesc(nn.Linear, 8, 8) for _ in range(n_layers)])
+
+    def test_pp_x_zero_state_sharding(self):
+        hcg = _init_fleet(dp=2, pp=2, sharding=2,
+                          accumulate_steps=2, micro_batch_size=2,
+                          compiled=True)
+        pipe = self._square_pipe()
+        model = fleet.distributed_model(pipe)
+        assert model._compiled is not None
+        opt = fleet.distributed_optimizer(
+            paddle.optimizer.AdamW(learning_rate=1e-3,
+                                   parameters=model.parameters()))
+
+        # unsharded oracle: same seed -> identical weights, plain sequential
+        paddle.seed(0)
+        ref = nn.Sequential(*[nn.Linear(8, 8) for _ in range(4)])
+        x = np.random.RandomState(0).randn(4, 8).astype("float32")
+        out = model(paddle.to_tensor(x))
+        out_ref = ref(paddle.to_tensor(x))
+        np.testing.assert_allclose(out.numpy(), out_ref.numpy(),
+                                   rtol=2e-5, atol=2e-5)
+
+        loss = (out ** 2).mean()
+        before = float(loss)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+
+        # the stacked pipeline param: pp shards the stage axis (after the step
+        # the update's output sharding may ALSO carry the ZeRO axis — stricter
+        # than ZeRO-2 residency, re-gathered at the rotation boundary)
+        p = model._compiled._stacked_params[0]
+        full = int(np.prod(p.value.shape))
+        pshard = p.value.addressable_shards[0].data
+        assert pshard.shape[1] == p.value.shape[1] // 2, "pp axis not sharded"
+        assert int(np.prod(pshard.shape)) <= full // 2
+        # ...and ZeRO-1/2 additionally shards its optimizer state on a free dim
+        st = opt.inner_opt._accumulators[id(p)]
+        m = next(iter(st.values()))
+        mshard = m.addressable_shards[0] if hasattr(m, "addressable_shards") \
+            else m.value.addressable_shards[0]
+        mv = m if hasattr(m, "shape") else m.value
+        assert int(np.prod(mshard.data.shape)) == int(np.prod(mv.shape)) // 4, (
+            f"state not pp x sharding sharded: {mshard.data.shape} of {mv.shape}")
+
+        after = float((model(paddle.to_tensor(x)) ** 2).mean())
+        assert after < before  # the composed step actually optimizes
+
+    def test_pp_x_sep_sequence_parallel(self):
+        """Sequence parallel (sep rides the mp axis) inside pp>1 stages must
+        reproduce the replicated sequential forward."""
+        from paddle_tpu.models import LlamaConfig
+        from paddle_tpu.models.llama import LlamaForCausalLMPipe
+
+        _init_fleet(dp=2, mp=2, pp=2,
+                    accumulate_steps=2, micro_batch_size=2, compiled=True)
+        paddle.seed(0)
+        cfg = LlamaConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=4,
+            max_position_embeddings=16,
+            tensor_parallel_degree=2, sequence_parallel=True,
+            pipeline_parallel_degree=2)
+        pipe = LlamaForCausalLMPipe(cfg)
+        model = fleet.distributed_model(pipe)
+        assert model._compiled is not None
+
+        r = np.random.RandomState(0)
+        ids = paddle.to_tensor(r.randint(0, 64, (4, 16)).astype("int64"))
+        out_mod = model(ids)            # sep x mp x pp compiled rotation
+        out_pipe = pipe(ids)            # replicated sequential forward
+        np.testing.assert_allclose(
+            np.asarray(out_mod.value), np.asarray(out_pipe.value),
+            rtol=2e-5, atol=2e-5)
+
+    def test_zero_shard_fn_preserves_existing_axes(self):
+        """The state-shard hook must ADD the sharding axis without wiping a
+        pre-existing pp placement (regression for the composition fix)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        hcg = _init_fleet(pp=2, sharding=2, dp=2)
+        from paddle_tpu.distributed.fleet.hybrid_optimizer import (
+            _make_state_shard_fn,
+        )
+
+        mesh = hcg.global_mesh
+        jmesh = mesh.jax_mesh()
+        shard_fn = _make_state_shard_fn(
+            mesh, mesh.dim_names.index("sharding"), 2)
+        # a pp-stacked accumulator: (v=1, S=2, 8, 8), pp on dim 1
+        acc = jax.device_put(
+            jnp.zeros((1, 2, 8, 8)),
+            NamedSharding(jmesh, P(None, "pp")))
+        out = shard_fn("m", None, paddle.Tensor(acc))
+        spec = out.value.sharding.spec
+        flat = [n for names in spec if names is not None
+                for n in (names if isinstance(names, tuple) else (names,))]
+        assert "pp" in flat and "sharding" in flat, spec
+        shard = out.value.addressable_shards[0].data
+        assert int(np.prod(shard.shape)) == (1 * 2 * 8 * 8) // 4
 
 
 class TestRecompute:

@@ -9,7 +9,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 import paddle_tpu as paddle
 from paddle_tpu.distributed.pipelining import (
-    PipelinedModule, compile_pipeline, pipeline_forward,
+    PipelinedModule, compile_pipeline, pipeline_forward, pipeline_forward_zb,
+    pipeline_schedule_stats,
 )
 
 
@@ -92,6 +93,120 @@ class TestPipelineForward:
         shard = sharded.addressable_shards[0].data
         assert shard.shape == (1, 1, 8, 8)
         assert shard.size * len(jax.devices()) // 2 == ws.size  # 8 devs, pp=4
+
+
+class TestZeroBubbleSchedule:
+    """ZB-H1-style B/W-split backward (pipeline_forward_zb): numeric parity
+    with the sequential reference + bubble accounting strictly below 1F1B.
+    Reference: pipeline_scheduler_pass/pipeline_zero_bubble.py."""
+
+    _stage = staticmethod(TestPipelineForward._stage)
+    _setup = TestPipelineForward._setup
+    _seq = TestPipelineForward._seq
+
+    @pytest.mark.parametrize("S,M", [(4, 4), (2, 6), (4, 2), (1, 3)])
+    def test_forward_matches_sequential(self, S, M):
+        mesh = _mesh(pp=S)
+        ws, x = self._setup(S=S, M=M)
+        out = jax.jit(lambda w, x: pipeline_forward_zb(
+            self._stage, [w], x, mesh=mesh))(ws, x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(self._seq(ws, x)),
+                                   rtol=1e-6, atol=1e-6)
+
+    @pytest.mark.parametrize("S,M", [(4, 4), (2, 6)])
+    def test_grads_match_sequential(self, S, M):
+        mesh = _mesh(pp=S)
+        ws, x = self._setup(S=S, M=M)
+
+        def loss_zb(w, x):
+            return (pipeline_forward_zb(self._stage, [w], x, mesh=mesh) ** 2).sum()
+
+        def loss_s(w, x):
+            return (self._seq(w, x) ** 2).sum()
+
+        gw1, gx1 = jax.jit(jax.grad(loss_zb, argnums=(0, 1)))(ws, x)
+        gw2, gx2 = jax.jit(jax.grad(loss_s, argnums=(0, 1)))(ws, x)
+        np.testing.assert_allclose(np.asarray(gw1), np.asarray(gw2),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(gx1), np.asarray(gx2),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_virtual_stages_grads(self):
+        # v=2 rounds over S=2 devices == 4 sequential stages, through the vjp
+        mesh = _mesh(pp=2)
+        ws, x = self._setup(S=2, v=2)
+        g1 = jax.jit(jax.grad(lambda w: pipeline_forward_zb(
+            self._stage, [w], x, mesh=mesh, num_virtual=2).sum()))(ws)
+        g2 = jax.jit(jax.grad(lambda w: self._seq(w, x).sum()))(ws)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_module_training_step(self):
+        mesh = _mesh(pp=4, dp=2)
+        pipe, cfg = TestPipelinedModule._pipe_model(None, pp_degree=4)
+        mod = PipelinedModule(pipe, mesh=mesh, num_microbatches=2,
+                              schedule="zb")
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=mod.parameters())
+        r = np.random.RandomState(0)
+        ids = paddle.to_tensor(r.randint(0, 64, (4, 16)).astype("int64"))
+        labels = paddle.to_tensor(r.randint(0, 64, (4, 16)).astype("int64"))
+        loss = mod.loss(mod(ids), labels)
+        before = float(loss)
+        loss.backward()
+        assert all(p.grad is not None for p in mod._stacked_params)
+        opt.step()
+        opt.clear_grad()
+        assert float(mod.loss(mod(ids), labels)) < before
+
+    def test_zb_matches_1f1b_module_numerics(self):
+        mesh = _mesh(pp=4, dp=2)
+        pipe, _ = TestPipelinedModule._pipe_model(None, pp_degree=4)
+        mod_zb = PipelinedModule(pipe, mesh=mesh, num_microbatches=2,
+                                 schedule="zb")
+        mod_1f = PipelinedModule(pipe, mesh=mesh, num_microbatches=2,
+                                 schedule="1f1b")
+        r = np.random.RandomState(0)
+        ids = paddle.to_tensor(r.randint(0, 64, (4, 16)).astype("int64"))
+        np.testing.assert_allclose(
+            np.asarray(mod_zb(ids).value), np.asarray(mod_1f(ids).value),
+            rtol=2e-5, atol=2e-5)
+
+    def test_bubble_fraction_below_1f1b(self):
+        for S, M, v in [(4, 4, 1), (8, 8, 1), (4, 16, 1), (2, 4, 2)]:
+            zb = pipeline_schedule_stats("zb", S, M, v)
+            f1 = pipeline_schedule_stats("1f1b", S, M, v)
+            gp = pipeline_schedule_stats("gpipe", S, M, v)
+            if S > 1:
+                assert zb["bubble_fraction"] < f1["bubble_fraction"], (S, M)
+                assert zb["bubble_fraction"] < gp["bubble_fraction"], (S, M)
+            else:
+                assert zb["bubble_fraction"] == 0.0
+
+    def test_strategy_schedule_mode_plumbs_through(self):
+        """strategy.pipeline_configs['schedule_mode']='ZBH1' (the reference's
+        pass name) must select the zb schedule in the compiled wrapper."""
+        from paddle_tpu import nn
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            LayerDesc, PipelineLayer,
+        )
+
+        s = fleet.DistributedStrategy()
+        s.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 2,
+                            "sharding_degree": 1}
+        s.pipeline_configs = {"accumulate_steps": 2, "micro_batch_size": 2,
+                              "compiled": True, "schedule_mode": "ZBH1"}
+        fleet.init(is_collective=True, strategy=s)
+        paddle.seed(0)
+        pipe = PipelineLayer(
+            layers=[LayerDesc(nn.Linear, 8, 8) for _ in range(4)])
+        model = fleet.distributed_model(pipe)
+        assert model._compiled is not None
+        assert model._compiled._schedule == "zb"
+        out = model(paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 8).astype("float32")))
+        assert tuple(out.shape) == (4, 8)
 
 
 class TestPipelinedModule:
