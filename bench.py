@@ -270,6 +270,39 @@ def _check_flash_attention(on_tpu):
     return info
 
 
+def _dispatch_bench():
+    """Eager per-op dispatch overhead (us/op): the reference's C++ hot path is
+    ~us (SURVEY §3.1); ours is Python defop dispatch + lazy jit-cached vjp.
+    Measured on tiny tensors so the number is dispatch, not compute."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 4).astype("float32"))
+    y = paddle.to_tensor(np.random.RandomState(1).randn(4, 4).astype("float32"))
+    xg = paddle.to_tensor(np.random.RandomState(2).randn(4, 4).astype("float32"),
+                          stop_gradient=False)
+
+    def _t(f, n=300):
+        f()  # warm (fills the per-signature caches)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            f()
+        return round((time.perf_counter() - t0) / n * 1e6, 1)
+
+    def fwd_bwd():
+        xg.clear_grad()
+        (xg + y).sum().backward()
+
+    out = {
+        "add_tape_off": _t(lambda: x + y),
+        "add_tape_on_fwd": _t(lambda: xg + y),
+        "matmul_tape_off": _t(lambda: x @ y),
+        "add_fwd_bwd": _t(fwd_bwd, 150),
+    }
+    return out
+
+
 def _build_step(model, optimizer, params, acc_keys, use_masters, rng, Tensor, jax):
     """One fused train step (fwd+bwd+AdamW) with functional state threading."""
 
@@ -335,6 +368,12 @@ def worker():
     else:
         flash_info = _check_flash_attention(on_tpu)
     _log(f"[bench] flash_attention check: {flash_info}")
+
+    try:
+        dispatch_us = _dispatch_bench()
+    except Exception as e:  # noqa: BLE001 - the headline metric must survive
+        dispatch_us = {"error": f"{type(e).__name__}: {e}"[:200]}
+    _log(f"[bench] dispatch_us: {dispatch_us}")
     if on_tpu and not flash_info.get("skipped") and not flash_info.get("ok"):
         # kernel unproven on this chip -> train on the XLA math path rather than
         # risk a mid-bench compile failure; the JSON records why.
@@ -449,6 +488,7 @@ def worker():
             "loss": float(jax.device_get(loss)),
             "attention_path": attention_path,
             "flash_attention": flash_info,
+            "dispatch_us": dispatch_us,
         },
     }))
 

@@ -199,8 +199,12 @@ def group_sharded_parallel(model, optimizer, level="os_g", scaler=None, group=No
         placements[axis_idx] = Shard(0)
         return placements
 
-    optimizer._shard_fn = _make_state_shard_fn(mesh, axis_idx, degree)
-    optimizer._is_dist = True
+    # the hook must land on the INNER optimizer — that is the object whose
+    # step() consults _shard_fn (a HybridParallelOptimizer wrapper only
+    # delegates reads via __getattr__, so setting on the wrapper is invisible)
+    inner = getattr(optimizer, "inner_opt", optimizer)
+    inner._shard_fn = _make_state_shard_fn(mesh, axis_idx, degree)
+    inner._is_dist = True
 
     if level == "p_g_os":
         # stage 3: parameters themselves live sharded; forward reads re-gather via GSPMD
@@ -219,16 +223,19 @@ def group_sharded_parallel(model, optimizer, level="os_g", scaler=None, group=No
         # the optimizer must update the REPLACED params (the ones the forward
         # reads and grads flow to), not the stale originals — and any state it
         # already holds (loaded checkpoints, prior steps) must follow the keys
-        inner = getattr(optimizer, "inner_opt", optimizer)
+        # AND be re-laid-out by the freshly installed placement hook
         for pg in getattr(inner, "_param_groups", []):
             pg["params"] = [replaced.get(id(p), p) for p in pg["params"]]
-        for attr in ("_accumulators", "_master_weights"):
-            table = getattr(inner, attr, None)
-            if not table:
-                continue
+        acc = getattr(inner, "_accumulators", None)
+        if acc:
+            for old_id, new in list(replaced.items()):
+                if old_id in acc:
+                    acc[id(new)] = inner._apply_shard_fn(new, acc.pop(old_id))
+        mw = getattr(inner, "_master_weights", None)
+        if mw:
             for old_id, new in replaced.items():
-                if old_id in table:
-                    table[id(new)] = table.pop(old_id)
+                if old_id in mw:
+                    mw[id(new)] = mw.pop(old_id)
     elif level not in ("os", "os_g"):
         raise ValueError(f"unsupported group_sharded level {level!r}")
     return model, optimizer, scaler

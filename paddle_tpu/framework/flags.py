@@ -11,6 +11,14 @@ import os
 from typing import Any, Dict
 
 _REGISTRY: Dict[str, dict] = {}
+# bumped on every set_flags: caches of traced/compiled programs that baked a
+# flag value at trace time (ops/_apply.py's jit-cached backwards) key on this
+# so a flag change forces a retrace instead of silently using stale values
+_EPOCH = [0]
+
+
+def epoch() -> int:
+    return _EPOCH[0]
 
 
 def define_flag(name: str, default: Any, doc: str = ""):
@@ -33,6 +41,7 @@ def _parse(text: str, ty):
 
 
 def set_flags(flags: Dict[str, Any]):
+    _EPOCH[0] += 1
     for k, v in flags.items():
         if not k.startswith("FLAGS_"):
             k = "FLAGS_" + k

@@ -49,6 +49,65 @@ def _is_tensor(x):
     return isinstance(x, Tensor)
 
 
+_AMP = None  # lazily bound amp.auto_cast module (hot-path import guard)
+_INEXACT_MEMO = {}
+
+
+def _inexact(dt):
+    r = _INEXACT_MEMO.get(dt)
+    if r is None:
+        r = _INEXACT_MEMO[dt] = bool(
+            jnp.issubdtype(np.dtype(dt), jnp.inexact))
+    return r
+
+
+class _LazyVjp:
+    """Deferred pullback: the linearization runs at BACKWARD time through a
+    per-signature jit cache instead of retracing jax.vjp on every forward call.
+
+    The reference keeps the eager per-op hot path in C++ (~us, SURVEY §3.1);
+    here the equivalent is: forward = plain primitive dispatch, backward =
+    jit-cached (one trace+compile per (op, treedef, static-args, avals)
+    signature, then cache hits). Holds the op's input values as residuals —
+    the same lifetime the eager pullback closure would have."""
+
+    __slots__ = ("bwd", "vals")
+
+    def __init__(self, bwd, vals):
+        self.bwd = bwd
+        self.vals = vals
+
+    def __call__(self, cots):
+        return self.bwd(tuple(self.vals), tuple(cots))
+
+
+@functools.lru_cache(maxsize=8192)
+def _cached_op_fns(opdef, treedef, n_leaves, static_items, t_idx, stop_flags,
+                   flags_epoch):
+    """One stable (pure, jitted-backward) pair per op-call signature, so jax.jit's
+    own (fn, avals) cache turns repeated backward passes into cache hits.
+    ``flags_epoch`` keys the cache on the global flags generation: ops that read
+    a flag at trace time (e.g. tpu_matmul_precision) retrace after set_flags
+    instead of replaying a stale compiled backward."""
+    fn = opdef.fn
+
+    def pure(*tvals):
+        buf = [None] * n_leaves
+        for i, v in static_items:
+            buf[i] = v
+        for i, v, sg in zip(t_idx, tvals, stop_flags):
+            buf[i] = jax.lax.stop_gradient(v) if sg else v
+        a, k = jax.tree_util.tree_unflatten(treedef, buf)
+        out = fn(*a, **k)
+        return out if isinstance(out, tuple) else (out,)
+
+    @jax.jit
+    def bwd(tvals, cots):
+        return jax.vjp(pure, *tvals)[1](cots)
+
+    return pure, bwd
+
+
 def _check_nan_inf(name, vals):
     from ..amp.debugging import _op_filter
 
@@ -82,10 +141,13 @@ def _maybe_record_op_stats(name, vals):
 def apply(opdef: OpDef, *args, **kwargs):
     """Dispatch one op call. Tensor leaves anywhere in args/kwargs are traced inputs."""
     # ---- AMP auto-cast (O1/O2), mirroring eager_gen.py:645 AMP_LOGIC_TEMPLATE ----
-    from ..amp.auto_cast import _amp_state, amp_cast_inputs
+    global _AMP
+    if _AMP is None:
+        from ..amp.auto_cast import _amp_state, amp_cast_inputs
 
-    if _amp_state() is not None:
-        args, kwargs = amp_cast_inputs(opdef, args, kwargs)
+        _AMP = (_amp_state, amp_cast_inputs)
+    if _AMP[0]() is not None:
+        args, kwargs = _AMP[1](opdef, args, kwargs)
 
     leaves, treedef = jax.tree_util.tree_flatten(
         (args, kwargs), is_leaf=_is_tensor
@@ -97,13 +159,16 @@ def apply(opdef: OpDef, *args, **kwargs):
 
     fn = opdef.fn
 
-    def pure(*tvals):
-        buf = list(leaves)
-        for i, v, sg in zip(t_idx, tvals, stop_flags):
-            buf[i] = jax.lax.stop_gradient(v) if sg else v
-        a, k = jax.tree_util.tree_unflatten(treedef, buf)
-        out = fn(*a, **k)
-        return out if isinstance(out, tuple) else (out,)
+    def make_pure():
+        def pure(*tvals):
+            buf = list(leaves)
+            for i, v, sg in zip(t_idx, tvals, stop_flags):
+                buf[i] = jax.lax.stop_gradient(v) if sg else v
+            a, k = jax.tree_util.tree_unflatten(treedef, buf)
+            out = fn(*a, **k)
+            return out if isinstance(out, tuple) else (out,)
+
+        return pure
 
     requires_grad = (
         opdef.differentiable
@@ -111,9 +176,29 @@ def apply(opdef: OpDef, *args, **kwargs):
         and any(not sg for sg in stop_flags)
     )
 
+    vjp_fn = None
     if requires_grad:
-        out_vals, vjp_fn = jax.vjp(pure, *vals)
+        # fast path: per-signature cached (pure, jitted-bwd) — the forward runs
+        # plain primitive dispatch; linearization is deferred to backward where
+        # the jit cache amortizes it. Unhashable static leaves (raw arrays in
+        # kwargs) fall back to the direct jax.vjp path.
+        t_set = set(t_idx)
+        try:
+            static_items = tuple(
+                (i, l) for i, l in enumerate(leaves) if i not in t_set)
+            pure, bwd = _cached_op_fns(
+                opdef, treedef, len(leaves), static_items,
+                tuple(t_idx), tuple(stop_flags), flags.epoch())
+        except TypeError:
+            pure = None
+        if pure is not None:
+            out_vals = pure(*vals)
+            vjp_fn = _LazyVjp(bwd, vals)
+        else:
+            pure = make_pure()
+            out_vals, vjp_fn = jax.vjp(pure, *vals)
     else:
+        pure = make_pure()
         out_vals = pure(*vals)
 
     if flags.flag("check_nan_inf"):
@@ -135,7 +220,7 @@ def apply(opdef: OpDef, *args, **kwargs):
         rg_out = requires_grad
     outputs = []
     for v in out_vals:
-        sg = not (rg_out and jnp.issubdtype(np.dtype(v.dtype), jnp.inexact))
+        sg = not (rg_out and _inexact(v.dtype))
         outputs.append(Tensor(v, stop_gradient=sg))
 
     if requires_grad:
@@ -169,7 +254,7 @@ def apply_raw(name, fn, tensor_args, n_outs=1):
         rg_out = requires_grad
     outputs = []
     for v in out_vals:
-        sg = not (rg_out and jnp.issubdtype(np.dtype(v.dtype), jnp.inexact))
+        sg = not (rg_out and _inexact(v.dtype))
         outputs.append(Tensor(v, stop_gradient=sg))
     if requires_grad:
         out_avals = [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in out_vals]
