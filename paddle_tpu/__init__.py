@@ -94,6 +94,26 @@ from . import hapi  # noqa: F401,E402
 from . import models  # noqa: F401,E402
 from .hapi import Model, summary  # noqa: F401,E402
 
+# round-3 export-surface sweep: these reference namespaces must exist on BARE
+# import (the round-2 probe found paddle.profiler absent until explicitly
+# imported; python/paddle/__init__.py exports all of these)
+from . import base  # noqa: F401,E402
+from . import version  # noqa: F401,E402
+from . import callbacks  # noqa: F401,E402
+from . import regularizer  # noqa: F401,E402
+from . import sysconfig  # noqa: F401,E402
+from . import hub  # noqa: F401,E402
+from . import profiler  # noqa: F401,E402
+from . import ops as tensor  # noqa: F401,E402  (paddle.tensor == the op surface)
+import sys as _sys  # noqa: E402
+
+# submodule-import syntax ("import paddle.tensor", "from paddle.tensor import
+# x") needs a sys.modules entry, not just the attribute alias
+_sys.modules[__name__ + ".tensor"] = tensor
+from .tensor_array import (  # noqa: F401,E402
+    array_length, array_read, array_write, create_array,
+)
+
 
 def seed(s):
     """paddle.seed: reseed the global generator."""
@@ -159,17 +179,36 @@ def is_compiled_with_custom_device(name="tpu"):
 def in_dynamic_mode():
     from .autograd import tape as _tape
 
+    if _STATIC_MODE[0]:
+        return False  # reference contract: enable_static() flips this
     return not _tape.in_functional_mode()
 
 
+_STATIC_MODE = [False]
+
+
 def disable_static(place=None):
-    pass
+    from .framework import capture as _capture
+
+    _STATIC_MODE[0] = False
+    _capture.set_active(None)
 
 
 def enable_static():
-    raise NotImplementedError(
-        "paddle_tpu is dynamic-first; use paddle_tpu.jit.to_static for compiled graphs"
-    )
+    """Reference static mode: ops dispatched from here on are recorded into
+    the default main Program (capture-replay, paddle_tpu/static) so the
+    guard-less reference idiom — enable_static + static.data + ops +
+    Executor.run — replays against the feed instead of silently returning
+    placeholder results. program_guard still scopes recording to an explicit
+    Program."""
+    from .framework import capture as _capture
+
+    _STATIC_MODE[0] = True
+    _capture.set_active(static.default_main_program())
+
+
+def in_static_mode():
+    return _STATIC_MODE[0]
 
 
 def disable_signal_handler():

@@ -1,0 +1,25 @@
+"""paddle.base compatibility shim (reference python/paddle/base/: the legacy
+fluid core surface that old reference-portable code still imports from).
+
+Only the names ported code most commonly touches are provided; everything maps
+onto the TPU build's real implementations (static capture-replay Program /
+Executor, framework core, dygraph helpers)."""
+from ..framework import core  # noqa: F401
+from ..framework.core import Tensor  # noqa: F401
+from ..static import (  # noqa: F401
+    CompiledProgram,
+    Executor,
+    Program,
+    default_main_program,
+    default_startup_program,
+    program_guard,
+)
+
+
+def in_dygraph_mode():
+    from .. import in_dynamic_mode
+
+    return in_dynamic_mode()
+
+
+dygraph = type("dygraph", (), {"base": None})

@@ -45,6 +45,7 @@ from .api import (  # noqa: F401
     reshard,
     shard_layer,
     shard_optimizer,
+    shard_scaler,
     shard_tensor,
     unshard_dtensor,
 )

@@ -235,6 +235,18 @@ def shard_optimizer(optimizer, shard_fn=None):
     return optimizer
 
 
+def shard_scaler(scaler):
+    """Distributed view of a GradScaler (api.py:1786 shard_scaler).
+
+    The reference patches the scaler's unscale so per-rank found-inf flags
+    all-reduce across the mesh. Here gradients are GLOBAL tensors under GSPMD:
+    the scaler's `jnp.isfinite` reduction already spans every shard (XLA emits
+    the cross-device all-reduce), so the distributed view is the scaler itself;
+    this marks it and returns it for API parity."""
+    scaler._is_dist = True
+    return scaler
+
+
 class _ShardingStageBase:
     def __init__(self, mesh=None, sharding_mesh_dim=None):
         self._mesh = mesh

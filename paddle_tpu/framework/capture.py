@@ -1,0 +1,34 @@
+"""Static-graph capture hook: program_guard records eager ops for replay.
+
+Reference analog: python/paddle/base/framework.py Program/Block op recording —
+under the reference's static mode, layer calls append OpDescs to the active
+Program and Executor.run feeds/fetches the graph. TPU-first redesign: the
+construction code EXECUTES eagerly on placeholder tensors (shapes with dynamic
+dims filled with 1), and every dispatched op is recorded here; Executor.run
+replays the recorded sequence through the normal eager dispatcher with the
+feed tensors substituted — so the replay builds a fresh autograd tape, layers'
+live Parameters are read at replay time (training updates persist across
+run() calls), and XLA sees the same ops as dynamic mode.
+
+This module only holds the active-program cell so ops/_apply.py (the hot
+path) and static/__init__.py avoid a circular import; the one extra branch
+per dispatch is a list-index check.
+"""
+from __future__ import annotations
+
+_ACTIVE = [None]  # the Program currently recording (static.program_guard)
+
+
+def active():
+    return _ACTIVE[0]
+
+
+def set_active(program):
+    _ACTIVE[0] = program
+
+
+def record(kind, payload, t_leaves, outputs):
+    """Append one dispatched op to the active program (no-op when inactive)."""
+    prog = _ACTIVE[0]
+    if prog is not None:
+        prog._record_op(kind, payload, t_leaves, outputs)

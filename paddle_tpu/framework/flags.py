@@ -83,3 +83,50 @@ define_flag("embedding_deterministic", 0, "kept for API parity (determinism is X
 define_flag("cudnn_deterministic", False, "API parity alias; TPU execution is deterministic")
 define_flag("max_inplace_grad_add", 0, "API parity; tape always accumulates functionally")
 define_flag("log_level", 0, "verbosity of paddle_tpu host-side logging")
+define_flag("eager_cached_vjp", True,
+            "eager backward via per-signature jit cache (remat-style: primal "
+            "re-runs in backward); False = residual capture at forward time")
+
+# Reference flags accepted for parity (paddle/common/flags.cc): ported code
+# sets these freely; most govern CUDA/allocator behavior that PJRT/XLA owns
+# here, so they are accepted no-ops with the reference defaults. set_flags on
+# any OTHER unknown flag also succeeds (define-on-set above) — the reference's
+# own behavior is to accept every registered flag, and defining-on-set keeps
+# ported set_flags/get_flags pairs working.
+for _name, _default in [
+    ("benchmark", False), ("check_kernel_launch", False),
+    ("conv2d_disable_cudnn", False), ("conv_workspace_size_limit", 512),
+    ("cublaslt_exhaustive_search_times", 0), ("cudnn_batchnorm_spatial_persistent", False),
+    ("cudnn_exhaustive_search", False), ("cudnn_exhaustive_search_times", -1),
+    ("enable_cublas_tensor_op_math", False), ("embedding_deterministic_level", 0),
+    ("gemm_use_half_precision_compute_type", False),
+    ("gpu_allocator_retry_time", 2000), ("gpu_memory_limit_mb", 0),
+    ("fraction_of_gpu_memory_to_use", 0.92), ("initial_gpu_memory_in_mb", 0),
+    ("reallocate_gpu_memory_in_mb", 0), ("fraction_of_cpu_memory_to_use", 1.0),
+    ("init_allocated_mem", False), ("memory_fraction_of_eager_deletion", 1.0),
+    ("fast_eager_deletion_mode", True), ("use_pinned_memory", True),
+    ("use_cuda_managed_memory", False), ("use_virtual_memory_auto_growth", False),
+    ("free_idle_chunk", False), ("free_when_no_cache_hit", False),
+    ("enable_cudnn_frontend", False), ("cudnn_cache_saturation_count", 1),
+    ("low_precision_op_list", 0), ("enable_api_kernel_fallback", True),
+    ("use_mkldnn", False), ("use_autotune", False),
+    ("inner_op_parallelism", 0), ("enable_parallel_graph", False),
+    ("sync_nccl_allreduce", True), ("nccl_blocking_wait", False),
+    ("fuse_parameter_groups_size", 3), ("fuse_parameter_memory_size", -1.0),
+    ("apply_pass_to_program", False), ("convert_all_blocks", True),
+    ("new_executor_serial_run", False), ("new_executor_static_build", False),
+    ("new_executor_use_inplace", False), ("new_executor_use_local_scope", True),
+    ("enable_pir_api", False), ("enable_pir_in_executor", False),
+    ("print_ir", False), ("call_stack_level", 1),
+    ("check_nan_inf_op_list", ""), ("skip_nan_inf_op_list", ""),
+    ("tracer_mkldnn_ops_on", ""), ("tracer_mkldnn_ops_off", ""),
+    ("prim_all", False), ("prim_backward", False), ("prim_forward", False),
+    ("set_to_1d", True), ("jit_engine_type", "PE"),
+    ("multiple_of_cupti_buffer_size", 1), ("enable_gpu_memory_usage_log", False),
+    ("allreduce_record_one_event", False), ("rpc_retry_times", 3),
+    ("rpc_deadline", 180000), ("eager_communication_connection", False),
+    ("dynamic_static_unified_comm", True), ("enable_async_trace", False),
+    ("flash_attn_version", 2), ("cudnn_deterministic_level", 0),
+]:
+    define_flag(_name, _default, "accepted for reference parity (flags.cc)")
+del _name, _default

@@ -1,0 +1,73 @@
+"""paddle.hub (reference python/paddle/hapi/hub.py: list/help/load entrypoints
+from a hubconf.py in a local dir or remote repo).
+
+TPU build: the local-dir source works fully; remote github/gitee sources
+require network egress and raise a clear error instead of hanging.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load", "load_state_dict_from_url"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no {_HUBCONF} found in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["paddle_tpu_hubconf"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _resolve(repo_dir, source):
+    if source not in ("local", "github", "gitee"):
+        raise ValueError(
+            f"unknown source {source!r}: expected 'local', 'github' or 'gitee'")
+    if source != "local":
+        raise RuntimeError(
+            "remote hub sources need network access; clone the repo and use "
+            "source='local' (hub.py:_resolve)")
+    return repo_dir
+
+
+def list(repo_dir, source="github", force_reload=False):  # noqa: A001
+    """Entrypoint names exported by the repo's hubconf (hub.py:188)."""
+    mod = _load_hubconf(_resolve(repo_dir, source))
+    return [name for name, v in vars(mod).items()
+            if callable(v) and not name.startswith("_")]
+
+
+def help(repo_dir, model, source="github", force_reload=False):  # noqa: A001
+    """The entrypoint's docstring (hub.py:238)."""
+    mod = _load_hubconf(_resolve(repo_dir, source))
+    entry = getattr(mod, model, None)
+    if entry is None or not callable(entry):
+        raise RuntimeError(f"no callable entrypoint {model!r} in hubconf")
+    return entry.__doc__
+
+
+def load(repo_dir, model, source="github", force_reload=False, **kwargs):
+    """Build the entrypoint's model (hub.py:286)."""
+    mod = _load_hubconf(_resolve(repo_dir, source))
+    entry = getattr(mod, model, None)
+    if entry is None or not callable(entry):
+        raise RuntimeError(f"no callable entrypoint {model!r} in hubconf")
+    return entry(**kwargs)
+
+
+def load_state_dict_from_url(url, model_dir=None, check_hash=False,
+                             file_name=None, map_location=None):
+    """Load a cached state dict downloaded from `url` (hub.py:337). Only the
+    already-downloaded cache works without egress."""
+    from .framework_io import load as _load
+    from .utils.download import get_weights_path_from_url
+
+    path = get_weights_path_from_url(url)
+    return _load(path)

@@ -229,3 +229,8 @@ for _name in _COMPAT_METHODS:
     if _name in globals() and not hasattr(Tensor, _name):
         setattr(Tensor, _name, globals()[_name])
 del _name
+
+# TensorArray container APIs (reference python/paddle/tensor/array.py)
+from ..tensor_array import (  # noqa: F401,E402
+    array_length, array_read, array_write, create_array,
+)

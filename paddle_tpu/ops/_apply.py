@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from ..autograd import tape
+from ..framework import capture as _capture
 from ..framework import flags
 from ..framework.core import Tensor
 
@@ -93,7 +94,7 @@ def _cached_op_fns(opdef, treedef, n_leaves, static_items, t_idx, stop_flags,
 
     def pure(*tvals):
         buf = [None] * n_leaves
-        for i, v in static_items:
+        for i, _ty, v in static_items:
             buf[i] = v
         for i, v, sg in zip(t_idx, tvals, stop_flags):
             buf[i] = jax.lax.stop_gradient(v) if sg else v
@@ -101,6 +102,11 @@ def _cached_op_fns(opdef, treedef, n_leaves, static_items, t_idx, stop_flags,
         out = fn(*a, **k)
         return out if isinstance(out, tuple) else (out,)
 
+    # note the rematerialization tradeoff: this backward re-runs the primal to
+    # rebuild residuals (fwd FLOPs x2 per differentiable op) in exchange for
+    # removing the ~ms Python retrace from every forward call. For eager loops
+    # over very large single ops set FLAGS_eager_cached_vjp=False to restore
+    # forward-time residual capture.
     @jax.jit
     def bwd(tvals, cots):
         return jax.vjp(pure, *tvals)[1](cots)
@@ -184,8 +190,13 @@ def apply(opdef: OpDef, *args, **kwargs):
         # kwargs) fall back to the direct jax.vjp path.
         t_set = set(t_idx)
         try:
+            if not flags.flag("eager_cached_vjp"):
+                raise TypeError  # operator opt-out -> direct-vjp path
+            # the type name is part of the key: hash(True)==hash(1)==hash(1.0)
+            # would otherwise alias specializations across scalar Python types
             static_items = tuple(
-                (i, l) for i, l in enumerate(leaves) if i not in t_set)
+                (i, type(l).__name__, l)
+                for i, l in enumerate(leaves) if i not in t_set)
             pure, bwd = _cached_op_fns(
                 opdef, treedef, len(leaves), static_items,
                 tuple(t_idx), tuple(stop_flags), flags.epoch())
@@ -227,6 +238,10 @@ def apply(opdef: OpDef, *args, **kwargs):
         out_avals = [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in out_vals]
         tape.record(opdef.name, t_leaves, vjp_fn, pure, out_avals, outputs)
 
+    if _capture._ACTIVE[0] is not None:
+        _capture.record("op", (opdef, leaves, treedef, t_idx),
+                        t_leaves, outputs)
+
     if len(outputs) == 1:
         return outputs[0]
     return tuple(outputs)
@@ -259,6 +274,8 @@ def apply_raw(name, fn, tensor_args, n_outs=1):
     if requires_grad:
         out_avals = [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in out_vals]
         tape.record(name, list(tensor_args), vjp_fn, pure, out_avals, outputs)
+    if _capture._ACTIVE[0] is not None:
+        _capture.record("raw", (name, fn), list(tensor_args), outputs)
     return tuple(outputs)
 
 

@@ -202,6 +202,15 @@ class Optimizer:
     clear_gradients = clear_grad
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from ..framework import capture
+
+        prog = capture.active()
+        if prog is not None:
+            # static capture (program_guard): the reference appends backward +
+            # update ops to the Program; here Executor.run performs
+            # backward+step on the replayed loss each run() call
+            prog._train_hooks.append((loss, self))
+            return None, None
         loss.backward()
         self.step()
         return None, None

@@ -1,0 +1,5 @@
+"""paddle.regularizer namespace (reference python/paddle/regularizer.py:
+L1Decay/L2Decay weight-decay coefficients consumed by the optimizers)."""
+from .optimizer import L1Decay, L2Decay  # noqa: F401
+
+__all__ = ["L1Decay", "L2Decay"]

@@ -46,25 +46,45 @@ class _Var:
 
 
 class Program:
-    """A capture target (reference static.Program): python code registered via
-    program_guard runs under jax tracing at Executor.run time."""
+    """reference static.Program, capture-replay form.
+
+    Construction code inside ``program_guard`` executes eagerly on placeholder
+    tensors and every dispatched op is recorded (framework/capture.py hook in
+    ops/_apply.py); ``Executor.run`` replays the recorded sequence through the
+    normal eager dispatcher with the feed substituted. Layer Parameters are
+    live objects read at replay time, so ``optimizer.minimize`` registered
+    during the guard trains them across ``run()`` calls — the reference's
+    append-backward-ops semantics, expressed as deferred eager execution.
+    """
 
     def __init__(self):
-        self._inputs = {}       # name -> _Var
-        self._builders = []     # callables(feed_tensors) -> fetch tensors
-        self._last_fetch = None
+        self._inputs = {}       # name -> placeholder Tensor (static.data)
+        self._ops = []          # recorded (kind, payload, in_tensors, outputs)
+        self._out_tensors = []  # every captured output (for fetch-by-name)
+        self._train_hooks = []  # (loss_tensor, optimizer) from minimize()
+
+    # called by framework.capture.record while this program is active
+    def _record_op(self, kind, payload, t_leaves, outputs):
+        self._ops.append((kind, payload, list(t_leaves), list(outputs)))
+        self._out_tensors.extend(outputs)
 
     def clone(self, for_test=False):
         p = Program()
         p._inputs = dict(self._inputs)
-        p._builders = list(self._builders)
+        p._ops = list(self._ops)
+        p._out_tensors = list(self._out_tensors)
+        p._train_hooks = [] if for_test else list(self._train_hooks)
         return p
 
     def global_block(self):
         return self
 
+    def list_vars(self):
+        return list(self._inputs.values()) + list(self._out_tensors)
+
     def __repr__(self):
-        return f"Program(inputs={list(self._inputs)})"
+        return (f"Program(inputs={list(self._inputs)}, "
+                f"ops={len(self._ops)})")
 
 
 _MAIN = [Program()]
@@ -81,44 +101,130 @@ def default_startup_program():
 
 @contextlib.contextmanager
 def program_guard(main_program, startup_program=None):
+    from ..framework import capture
+
     old_main, old_start = _MAIN[0], _STARTUP[0]
+    old_active = capture.active()
     _MAIN[0] = main_program
     if startup_program is not None:
         _STARTUP[0] = startup_program
+    capture.set_active(main_program)
     try:
         yield
     finally:
         _MAIN[0], _STARTUP[0] = old_main, old_start
+        capture.set_active(old_active)
 
 
 def data(name, shape, dtype="float32", lod_level=0):
-    var = _Var(name, shape, dtype)
-    _MAIN[0]._inputs[name] = var
-    return var
+    """Placeholder tensor: dynamic dims (None/-1) are built as 1 for the
+    capture pass; Executor.run substitutes the real feed (shapes re-execute
+    polymorphically through the eager dispatcher)."""
+    import jax.numpy as jnp
+
+    concrete = [1 if (s is None or (isinstance(s, int) and s < 0)) else int(s)
+                for s in shape]
+    ph = Tensor(jnp.zeros(concrete, np.dtype(dtype)))
+    ph.name = name
+    _MAIN[0]._inputs[name] = ph
+    return ph
 
 
 class Executor:
-    """reference static.Executor: run(program, feed, fetch_list)."""
+    """reference static.Executor: run(program, feed, fetch_list).
+
+    fetch_list entries may be captured Tensors (the objects built inside the
+    guard), names (matched against tensor ``.name``, e.g. ``"loss"`` after
+    ``loss.name = "loss"``, or a static.data input name), or legacy callables
+    over the feed dict."""
 
     def __init__(self, place=None):
         self.place = place
 
+    def _resolve(self, program, env, fetch):
+        if isinstance(fetch, Tensor):
+            return env.get(id(fetch), fetch)
+        if isinstance(fetch, _Var):
+            fetch = fetch.name
+        if isinstance(fetch, str):
+            for t in program.list_vars():
+                if getattr(t, "name", None) == fetch:
+                    return env.get(id(t), t)
+            raise KeyError(
+                f"fetch {fetch!r}: no captured tensor or input carries that "
+                "name (assign `t.name = ...` inside the program_guard)")
+        raise TypeError(f"unsupported fetch_list entry {fetch!r}")
+
     def run(self, program=None, feed=None, fetch_list=None, return_numpy=True):
+        import jax.numpy as jnp
+
+        from ..framework import capture
+        from ..ops._apply import apply as _dispatch
+
         program = program or _MAIN[0]
         feed = feed or {}
+        # the reference errors on a missing feed entry; replaying the
+        # capture-time zeros placeholder instead would return feed-independent
+        # results with no signal (and its dim-1 dynamic dims broadcast, hiding
+        # even the shape mismatch)
+        if program._ops:
+            missing = [n for n in program._inputs if n not in feed]
+            if missing:
+                raise RuntimeError(
+                    f"feed is missing input(s) {missing}; static.data inputs "
+                    "must all be fed (reference executor.py feed check)")
+        env = {}
+        for name, ph in program._inputs.items():
+            if name in feed:
+                v = feed[name]
+                val = v.value if isinstance(v, Tensor) \
+                    else jnp.asarray(np.asarray(v))
+                env[id(ph)] = Tensor(val)
+
+        def sub(t):
+            return env.get(id(t), t)
+
+        # snapshot + deactivate capture: replay dispatches through apply(),
+        # which must not re-record into the program being iterated (run()
+        # inside an active program_guard would otherwise never terminate)
+        ops_snapshot = list(program._ops)
+        prev_active = capture.active()
+        capture.set_active(None)
+        try:
+            for kind, payload, t_leaves, outputs in ops_snapshot:
+                if kind == "op":
+                    opdef, leaves, treedef, t_idx = payload
+                    buf = list(leaves)
+                    for i in t_idx:
+                        buf[i] = sub(buf[i])
+                    a, k = jax.tree_util.tree_unflatten(treedef, buf)
+                    new = _dispatch(opdef, *a, **k)
+                else:  # "raw"
+                    from ..ops._apply import apply_raw
+
+                    name, fn = payload
+                    new = apply_raw(name, fn, [sub(t) for t in t_leaves],
+                                    n_outs=len(outputs))
+                new = new if isinstance(new, tuple) else (new,)
+                for orig, repl in zip(outputs, new):
+                    env[id(orig)] = repl
+
+            for loss_t, opt in program._train_hooks:
+                live = env.get(id(loss_t), loss_t)
+                live.backward()
+                opt.step()
+                opt.clear_grad()
+        finally:
+            capture.set_active(prev_active)
+
         outs = []
         for fetch in fetch_list or []:
-            if callable(fetch):
-                tensors = {k: Tensor(jax.numpy.asarray(np.asarray(v)))
+            if callable(fetch) and not isinstance(fetch, Tensor):
+                tensors = {k: Tensor(jnp.asarray(np.asarray(v)))
                            for k, v in feed.items()}
                 out = fetch(tensors)
-            elif isinstance(fetch, Tensor):
-                out = fetch
             else:
-                raise TypeError(
-                    "fetch_list entries must be callables over the feed dict "
-                    "or Tensors (the capture-based Program has no graph "
-                    "variables to look up by name)")
+                out = self._resolve(program, env, fetch)
             outs.append(np.asarray(out.value) if return_numpy and
                         isinstance(out, Tensor) else out)
         return outs

@@ -1,0 +1,65 @@
+"""TensorArray container APIs (reference python/paddle/tensor/array.py and
+phi/core/tensor_array.h).
+
+Dynamic-mode semantics (the only mode here — the capture-replay static surface
+executes eagerly too): a TensorArray IS a Python list of Tensors, exactly the
+reference's dygraph behavior. These functions are the landing pad for
+reference-portable code using paddle.tensor.array_* / create_array.
+"""
+from __future__ import annotations
+
+from .framework.core import Tensor
+
+__all__ = ["create_array", "array_length", "array_read", "array_write"]
+
+
+def _index(i):
+    if isinstance(i, Tensor):
+        i = i.value
+    try:
+        return int(i if not hasattr(i, "reshape") else i.reshape(-1)[0])
+    except TypeError:
+        return int(i)
+
+
+def create_array(dtype="float32", initialized_list=None):
+    """array.py create_array: a fresh (optionally pre-filled) TensorArray."""
+    if initialized_list is None:
+        return []
+    out = list(initialized_list)
+    for v in out:
+        if not isinstance(v, Tensor):
+            raise TypeError(
+                f"initialized_list entries must be Tensors, got {type(v)}")
+    return out
+
+
+def array_length(array):
+    """array.py array_length."""
+    if not isinstance(array, list):
+        raise TypeError("array must be a list (dygraph TensorArray)")
+    return len(array)
+
+
+def array_read(array, i):
+    """array.py array_read: array[i]."""
+    if not isinstance(array, list):
+        raise TypeError("array must be a list (dygraph TensorArray)")
+    return array[_index(i)]
+
+
+def array_write(x, i, array=None):
+    """array.py array_write: write x at index i (appending at the end)."""
+    idx = _index(i)
+    if array is None:
+        array = []
+    if not isinstance(array, list):
+        raise TypeError("array must be a list (dygraph TensorArray)")
+    if idx < len(array):
+        array[idx] = x
+    elif idx == len(array):
+        array.append(x)
+    else:
+        raise ValueError(
+            f"array_write index {idx} out of range (len {len(array)})")
+    return array

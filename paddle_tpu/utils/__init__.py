@@ -1,4 +1,72 @@
-"""paddle.utils parity namespace."""
+"""paddle.utils parity namespace (reference python/paddle/utils/__init__.py:
+download helpers, try_import, deprecated, run_check, unique_name)."""
+import functools as _functools
+import importlib as _importlib
+import warnings as _warnings
+
 from . import custom_op  # noqa: F401
+from . import download  # noqa: F401
 from .custom_op import get_custom_op, register_custom_op  # noqa: F401
 from ..ops.optable import generate_op_docs, op_table  # noqa: F401
+
+
+def try_import(module_name, err_msg=None):
+    """reference utils/lazy_import.py try_import: import or raise with hint."""
+    try:
+        return _importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            err_msg or f"{module_name} is required but not installed: {e}"
+        ) from e
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """reference utils/deprecated.py: warn-on-call decorator."""
+
+    def deco(fn):
+        @_functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            msg = f"API {fn.__name__} is deprecated since {since}"
+            if update_to:
+                msg += f", use {update_to} instead"
+            if reason:
+                msg += f" ({reason})"
+            _warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def run_check():
+    """reference utils/install_check.py run_check: one compiled matmul on the
+    available device proves the install works."""
+    import jax
+    import jax.numpy as jnp
+
+    d = jax.devices()[0]
+    out = jax.jit(lambda a, b: a @ b)(jnp.ones((64, 64)), jnp.ones((64, 64)))
+    assert float(out[0, 0]) == 64.0
+    print(f"PaddlePaddle(TPU build) works on {d.platform} "
+          f"({getattr(d, 'device_kind', '?')})!")
+
+
+class _UniqueName:
+    """reference base/unique_name.py: generate() with per-prefix counters."""
+
+    def __init__(self):
+        self._counters = {}
+
+    def generate(self, key="tmp"):
+        n = self._counters.get(key, 0)
+        self._counters[key] = n + 1
+        return f"{key}_{n}"
+
+    def guard(self, new_generator=None):
+        import contextlib
+
+        return contextlib.nullcontext()
+
+
+unique_name = _UniqueName()

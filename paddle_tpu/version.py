@@ -1,0 +1,88 @@
+"""paddle.version parity surface.
+
+Reference analog: python/paddle/version/__init__.py (generated at build time by
+setup.py write_version_py — full_version/major/minor/rc/commit plus the
+capability probes show()/cuda()/cudnn()/xpu()). Here the capability probes
+answer for the TPU build: there is no CUDA/cuDNN; the accelerator is whatever
+PJRT exposes.
+"""
+from __future__ import annotations
+
+full_version = "0.3.0"
+major = "0"
+minor = "3"
+patch = "0"
+rc = "0"
+nccl_version = "0"
+cuda_version = "False"
+cudnn_version = "False"
+tensorrt_version = "False"
+xpu_version = "False"
+xpu_xccl_version = "False"
+xpu_xhpc_version = "False"
+istaged = False
+commit = "unknown"
+with_pip_cuda_libraries = "OFF"
+with_pip_tensorrt = "OFF"
+
+__all__ = ["cuda", "cudnn", "nccl", "show", "xpu", "xpu_xccl", "xpu_xhpc",
+           "tpu"]
+
+
+def show():
+    """Print the version/build info (reference version.show)."""
+    if istaged:
+        print("full_version:", full_version)
+        print("major:", major)
+        print("minor:", minor)
+        print("patch:", patch)
+        print("rc:", rc)
+    else:
+        print("commit:", commit)
+    print("cuda:", cuda_version)
+    print("cudnn:", cudnn_version)
+    print("nccl:", nccl_version)
+    print("xpu:", xpu_version)
+    print("tpu:", tpu())
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version
+
+
+def nccl():
+    return nccl_version
+
+
+def xpu():
+    return xpu_version
+
+
+def xpu_xccl():
+    return xpu_xccl_version
+
+
+def xpu_xhpc():
+    return xpu_xhpc_version
+
+
+def tensorrt():
+    return tensorrt_version
+
+
+def tpu():
+    """TPU generation string via PJRT, or "False" off-device (TPU analog of
+    version.cuda())."""
+    try:
+        import jax
+
+        d = jax.devices()[0]
+        if d.platform == "tpu":
+            return getattr(d, "device_kind", "tpu")
+    except Exception:  # noqa: BLE001 - version probe must never raise
+        pass
+    return "False"

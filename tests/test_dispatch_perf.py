@@ -132,6 +132,37 @@ class TestLazyVjpCorrectness:
         finally:
             paddle.set_flags({"FLAGS_tpu_matmul_precision": old})
 
+    def test_scalar_python_type_does_not_alias_cache(self):
+        """hash(True)==hash(1)==hash(1.0): the cache key must include the
+        scalar's Python type so bool/int/float specializations stay distinct."""
+        from paddle_tpu.ops._apply import defop
+
+        @defop("_test_scalar_type_key")
+        def _op(x, flag=0):
+            # isinstance-branching op: True and 1 behave differently
+            if flag is True:
+                return x * 10.0
+            return x + float(flag)
+
+        x = paddle.to_tensor(np.ones(2, "float32"), stop_gradient=False)
+        a = _op(x, flag=1)
+        b = _op(x, flag=True)
+        np.testing.assert_allclose(a.numpy(), [2.0, 2.0])
+        np.testing.assert_allclose(b.numpy(), [10.0, 10.0])
+
+    def test_cached_vjp_opt_out_flag(self):
+        from paddle_tpu.framework import flags
+
+        y = paddle.to_tensor(np.random.randn(3).astype("float32"))
+        x = paddle.to_tensor(np.random.randn(3).astype("float32"),
+                             stop_gradient=False)
+        paddle.set_flags({"FLAGS_eager_cached_vjp": False})
+        try:
+            (x * y).sum().backward()
+            np.testing.assert_allclose(x.grad.numpy(), y.numpy(), rtol=1e-6)
+        finally:
+            paddle.set_flags({"FLAGS_eager_cached_vjp": True})
+
     def test_integer_output_float0_cotangent(self):
         # ops with integer outputs (argmax) alongside float outputs must not
         # break the jitted pullback's float0 handling

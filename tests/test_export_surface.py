@@ -1,0 +1,144 @@
+"""Export-surface parity sweep (round-2 verdict #9).
+
+Every public reference namespace must exist on BARE `import paddle_tpu`
+(python/paddle/__init__.py export list), plus the round-1-style probes for the
+named stragglers: paddle.version, paddle.callbacks, eager paddle.profiler,
+shard_scaler, set_flags unknown-flag policy, TensorArray landing pad.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestNamespaceParity:
+    # reference python/paddle/__init__.py public sub-namespaces that make
+    # sense off-GPU (tensorrt/cinn/pir are compiler internals n/a-by-design)
+    NAMESPACES = [
+        "amp", "audio", "autograd", "base", "callbacks", "device",
+        "distributed", "distribution", "fft", "framework", "geometric",
+        "hub", "incubate", "inference", "io", "jit", "linalg", "metric",
+        "nn", "onnx", "optimizer", "profiler", "quantization", "reader",
+        "regularizer", "signal", "sparse", "static", "sysconfig", "tensor",
+        "text", "utils", "version", "vision",
+    ]
+
+    def test_all_namespaces_present_on_bare_import(self):
+        missing = [n for n in self.NAMESPACES if not hasattr(paddle, n)]
+        assert not missing, f"absent on bare import: {missing}"
+
+    def test_profiler_eager(self):
+        # round-2 probe failure: hasattr(paddle, "profiler") was False
+        assert paddle.profiler.Profiler is not None
+
+    def test_version_surface(self):
+        v = paddle.version
+        assert isinstance(v.full_version, str)
+        for probe in ("cuda", "cudnn", "nccl", "xpu", "show", "tpu"):
+            assert callable(getattr(v, probe))
+        assert paddle.__version__
+
+    def test_callbacks_namespace(self):
+        for name in ("Callback", "EarlyStopping", "ModelCheckpoint",
+                     "ProgBarLogger", "LRScheduler", "VisualDL",
+                     "ReduceLROnPlateau"):
+            assert hasattr(paddle.callbacks, name), name
+
+    def test_regularizer_namespace(self):
+        assert paddle.regularizer.L2Decay(1e-4).coeff == pytest.approx(1e-4)
+
+
+class TestFlagsPolicy:
+    def test_reference_flags_accepted(self):
+        # common reference flags.cc names must set/get without KeyError
+        for name in ("FLAGS_cudnn_exhaustive_search", "FLAGS_benchmark",
+                     "FLAGS_fraction_of_gpu_memory_to_use",
+                     "FLAGS_call_stack_level", "FLAGS_use_mkldnn"):
+            old = paddle.get_flags(name)[name]
+            paddle.set_flags({name: old})
+
+    def test_unknown_flag_define_on_set(self):
+        paddle.set_flags({"FLAGS_round3_test_flag": 7})
+        got = paddle.get_flags("FLAGS_round3_test_flag")
+        assert got["FLAGS_round3_test_flag"] == 7
+
+
+class TestShardScaler:
+    def test_shard_scaler_marks_and_scales(self):
+        import paddle_tpu.distributed as dist
+
+        scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0)
+        scaler = dist.shard_scaler(scaler)
+        assert getattr(scaler, "_is_dist", False)
+        x = paddle.to_tensor(np.asarray([2.0], "float32"),
+                             stop_gradient=False)
+        scaled = scaler.scale(x.sum())
+        assert float(scaled) == pytest.approx(2048.0)
+
+
+class TestTensorArray:
+    def test_write_read_length(self):
+        arr = paddle.create_array(dtype="float32")
+        x = paddle.to_tensor(np.full((3, 3), 5.0, "float32"))
+        i = paddle.to_tensor(np.zeros((1,), "int32"))
+        arr = paddle.array_write(x, i, array=arr)
+        assert paddle.array_length(arr) == 1
+        got = paddle.array_read(arr, i)
+        np.testing.assert_allclose(got.numpy(), x.numpy())
+
+    def test_overwrite_and_append(self):
+        arr = paddle.create_array()
+        a = paddle.to_tensor(np.ones(2, "float32"))
+        b = paddle.to_tensor(np.zeros(2, "float32"))
+        paddle.array_write(a, 0, arr)
+        paddle.array_write(b, 1, arr)
+        paddle.array_write(b, 0, arr)  # overwrite
+        assert paddle.array_length(arr) == 2
+        np.testing.assert_allclose(paddle.array_read(arr, 0).numpy(),
+                                   b.numpy())
+        with pytest.raises(ValueError):
+            paddle.array_write(a, 5, arr)
+
+    def test_tensor_namespace_alias(self):
+        assert paddle.tensor.create_array is paddle.create_array
+        assert callable(paddle.tensor.matmul)
+
+    def test_tensor_submodule_import_syntax(self):
+        import importlib
+
+        mod = importlib.import_module("paddle_tpu.tensor")
+        assert mod is paddle.tensor
+        from paddle_tpu.tensor import matmul  # noqa: F401
+
+
+class TestUtilsAndHub:
+    def test_run_check(self, capsys):
+        paddle.utils.run_check()
+        assert "works" in capsys.readouterr().out
+
+    def test_try_import(self):
+        assert paddle.utils.try_import("json").dumps({}) == "{}"
+        with pytest.raises(ImportError):
+            paddle.utils.try_import("definitely_not_a_module_xyz")
+
+    def test_hub_local_roundtrip(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "def tiny_model(scale=1):\n"
+            "    '''A tiny model.'''\n"
+            "    import paddle_tpu as paddle\n"
+            "    return paddle.nn.Linear(2 * scale, 2)\n")
+        names = paddle.hub.list(str(tmp_path), source="local")
+        assert "tiny_model" in names
+        assert "tiny" in paddle.hub.help(str(tmp_path), "tiny_model",
+                                         source="local")
+        m = paddle.hub.load(str(tmp_path), "tiny_model", source="local",
+                            scale=2)
+        assert tuple(m.weight.shape) == (4, 2)
+
+    def test_hub_remote_raises_clearly(self):
+        with pytest.raises(RuntimeError, match="network"):
+            paddle.hub.list("user/repo", source="github")
+
+    def test_base_shim(self):
+        assert paddle.base.Program is paddle.static.Program
+        assert paddle.base.in_dygraph_mode() in (True, False)

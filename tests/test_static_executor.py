@@ -1,0 +1,163 @@
+"""Capture-replay static graph surface (round-2 verdict #7).
+
+A reference-style static script — program_guard + static.data + layers +
+optimizer.minimize + Executor.run(feed, fetch_list) — must run unmodified and
+actually TRAIN (the round-2 veneer could not fetch by variable and never
+executed the graph). Reference: python/paddle/base/executor.py Executor.run.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+class TestStaticExecutor:
+    def test_reference_style_mnist_script_trains(self):
+        """The ported reference idiom end-to-end: build under program_guard,
+        fetch loss BY NAME, weights update across exe.run calls."""
+        paddle.enable_static()
+        try:
+            paddle.seed(0)
+            main = paddle.static.Program()
+            startup = paddle.static.Program()
+            with paddle.static.program_guard(main, startup):
+                x = paddle.static.data(name="x", shape=[None, 16],
+                                       dtype="float32")
+                y = paddle.static.data(name="y", shape=[None, 1],
+                                       dtype="int64")
+                net = paddle.nn.Sequential(
+                    paddle.nn.Linear(16, 32), paddle.nn.ReLU(),
+                    paddle.nn.Linear(32, 10))
+                logits = net(x)
+                loss = paddle.nn.functional.cross_entropy(logits, y)
+                loss.name = "loss"
+                opt = paddle.optimizer.SGD(learning_rate=0.5,
+                                           parameters=net.parameters())
+                opt.minimize(loss)
+
+            exe = paddle.static.Executor()
+            exe.run(startup)  # params already initialized eagerly; no-op
+
+            r = np.random.RandomState(0)
+            xb = r.randn(32, 16).astype("float32")
+            yb = r.randint(0, 10, (32, 1)).astype("int64")
+            losses = []
+            for _ in range(15):
+                (lv,) = exe.run(main, feed={"x": xb, "y": yb},
+                                fetch_list=["loss"])
+                losses.append(float(lv))
+            assert losses[-1] < losses[0] * 0.7, losses
+        finally:
+            paddle.disable_static()
+
+    def test_fetch_by_tensor_and_different_batch_size(self):
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data("x", [None, 4], "float32")
+            out = (x * 2.0).sum(axis=1)
+        exe = paddle.static.Executor()
+        for bs in (2, 7):
+            feed = {"x": np.ones((bs, 4), "float32")}
+            (got,) = exe.run(main, feed=feed, fetch_list=[out])
+            np.testing.assert_allclose(got, np.full((bs,), 8.0))
+
+    def test_fetch_input_by_name(self):
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data("x", [2, 2], "float32")
+            y = x + 1.0
+            y.name = "y_out"
+        exe = paddle.static.Executor()
+        xv = np.arange(4, dtype="float32").reshape(2, 2)
+        got_x, got_y = exe.run(main, feed={"x": xv},
+                               fetch_list=["x", "y_out"])
+        np.testing.assert_allclose(got_x, xv)
+        np.testing.assert_allclose(got_y, xv + 1.0)
+
+    def test_unknown_fetch_name_raises(self):
+        import pytest
+
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            paddle.static.data("x", [2], "float32")
+        exe = paddle.static.Executor()
+        with pytest.raises(KeyError, match="nope"):
+            exe.run(main, feed={"x": np.zeros(2, "float32")},
+                    fetch_list=["nope"])
+
+    def test_clone_for_test_drops_train_hooks(self):
+        paddle.seed(0)
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data("x", [None, 4], "float32")
+            lin = paddle.nn.Linear(4, 2)
+            loss = lin(x).sum()
+            loss.name = "loss"
+            opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                       parameters=lin.parameters())
+            opt.minimize(loss)
+        test_prog = main.clone(for_test=True)
+        exe = paddle.static.Executor()
+        w0 = lin.weight.numpy().copy()
+        exe.run(test_prog, feed={"x": np.ones((2, 4), "float32")},
+                fetch_list=["loss"])
+        np.testing.assert_array_equal(lin.weight.numpy(), w0)  # eval: no step
+        exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                fetch_list=["loss"])
+        assert not np.array_equal(lin.weight.numpy(), w0)      # train: step
+
+    def test_guardless_default_program_idiom(self):
+        """enable_static + static.data + ops WITHOUT program_guard (the
+        reference's default-main-program idiom) must record and replay."""
+        paddle.enable_static()
+        try:
+            main = paddle.static.default_main_program()
+            n_before = len(main._ops)
+            x = paddle.static.data("gx", [None, 3], "float32")
+            y = x * 3.0
+            y.name = "gy"
+            assert len(main._ops) > n_before  # recorded without a guard
+            assert not paddle.in_dynamic_mode()  # reference mode contract
+            exe = paddle.static.Executor()
+            xv = np.ones((2, 3), "float32")
+            (got,) = exe.run(main, feed={"gx": xv}, fetch_list=["gy"])
+            np.testing.assert_allclose(got, xv * 3.0)
+        finally:
+            paddle.disable_static()
+        assert paddle.in_dynamic_mode()
+
+    def test_missing_feed_raises(self):
+        import pytest
+
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data("a", [None, 2], "float32")
+            (x + 1.0).name  # noqa: B018 - records one op
+        exe = paddle.static.Executor()
+        with pytest.raises(RuntimeError, match="missing input"):
+            exe.run(main, feed={}, fetch_list=[])
+
+    def test_run_inside_active_guard_terminates(self):
+        """Replay must not re-record into the program being iterated."""
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data("x", [None, 2], "float32")
+            y = x + 1.0
+            exe = paddle.static.Executor()
+            n_ops = len(main._ops)
+            (got,) = exe.run(main, feed={"x": np.zeros((1, 2), "float32")},
+                             fetch_list=[y])
+            assert len(main._ops) == n_ops  # no growth from the replay
+        np.testing.assert_allclose(got, np.ones((1, 2)))
+
+    def test_legacy_callable_fetch_still_works(self):
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            paddle.static.data("x", [None, 4], "float32")
+        exe = paddle.static.Executor()
+
+        def fetch(tensors):
+            return (tensors["x"] * 2).sum()
+
+        (out,) = exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                         fetch_list=[fetch])
+        assert float(out) == 16.0
