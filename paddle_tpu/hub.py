@@ -44,30 +44,40 @@ def list(repo_dir, source="github", force_reload=False):  # noqa: A001
             if callable(v) and not name.startswith("_")]
 
 
-def help(repo_dir, model, source="github", force_reload=False):  # noqa: A001
-    """The entrypoint's docstring (hub.py:238)."""
+def _get_entry(repo_dir, model, source):
     mod = _load_hubconf(_resolve(repo_dir, source))
     entry = getattr(mod, model, None)
     if entry is None or not callable(entry):
         raise RuntimeError(f"no callable entrypoint {model!r} in hubconf")
-    return entry.__doc__
+    return entry
+
+
+def help(repo_dir, model, source="github", force_reload=False):  # noqa: A001
+    """The entrypoint's docstring (hub.py:238)."""
+    return _get_entry(repo_dir, model, source).__doc__
 
 
 def load(repo_dir, model, source="github", force_reload=False, **kwargs):
     """Build the entrypoint's model (hub.py:286)."""
-    mod = _load_hubconf(_resolve(repo_dir, source))
-    entry = getattr(mod, model, None)
-    if entry is None or not callable(entry):
-        raise RuntimeError(f"no callable entrypoint {model!r} in hubconf")
-    return entry(**kwargs)
+    return _get_entry(repo_dir, model, source)(**kwargs)
 
 
 def load_state_dict_from_url(url, model_dir=None, check_hash=False,
                              file_name=None, map_location=None):
     """Load a cached state dict downloaded from `url` (hub.py:337). Only the
-    already-downloaded cache works without egress."""
-    from .framework_io import load as _load
-    from .utils.download import get_weights_path_from_url
+    already-downloaded cache works without egress; model_dir/file_name pick
+    the cache location exactly like the reference."""
+    import os.path as osp
 
-    path = get_weights_path_from_url(url)
-    return _load(path)
+    from .framework_io import load as _load
+    from .utils import download as dl
+
+    root = model_dir or dl.WEIGHTS_HOME
+    if file_name:
+        path = osp.join(root, file_name)
+        if not osp.exists(path):
+            raise RuntimeError(
+                f"{url} is not cached at {path} and this build has no "
+                "network egress; place the file there and retry")
+        return _load(path)
+    return _load(dl._cached(url, root))

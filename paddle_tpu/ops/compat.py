@@ -178,9 +178,10 @@ def unfold(x, axis, size, step):
     moved = jnp.moveaxis(x, axis, 0)
     windows = jax.vmap(
         lambda s: jax.lax.dynamic_slice_in_dim(moved, s, size, 0))(starts)
-    # (n, size, ...rest) -> axis back in place with window dim last
-    windows = jnp.moveaxis(windows, 0, axis)
-    return jnp.moveaxis(windows, axis + 1, -1)
+    # (n, size, ...rest) -> original dims with n at `axis`, size appended LAST
+    # (reference Tensor.unfold layout, e.g. (4,5).unfold(1,3,2) -> (4,2,3))
+    windows = jnp.moveaxis(windows, 1, -1)   # (n, ...rest, size)
+    return jnp.moveaxis(windows, 0, axis)
 
 
 def reverse(x, axis, name=None):

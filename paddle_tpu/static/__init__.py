@@ -167,12 +167,11 @@ class Executor:
         # capture-time zeros placeholder instead would return feed-independent
         # results with no signal (and its dim-1 dynamic dims broadcast, hiding
         # even the shape mismatch)
-        if program._ops:
-            missing = [n for n in program._inputs if n not in feed]
-            if missing:
-                raise RuntimeError(
-                    f"feed is missing input(s) {missing}; static.data inputs "
-                    "must all be fed (reference executor.py feed check)")
+        missing = [n for n in program._inputs if n not in feed]
+        if missing:
+            raise RuntimeError(
+                f"feed is missing input(s) {missing}; static.data inputs "
+                "must all be fed (reference executor.py feed check)")
         env = {}
         for name, ph in program._inputs.items():
             if name in feed:
@@ -214,19 +213,21 @@ class Executor:
                 live.backward()
                 opt.step()
                 opt.clear_grad()
+
+            # fetch while capture is still off: a legacy callable fetch
+            # dispatches ops that must not be recorded into the program
+            outs = []
+            for fetch in fetch_list or []:
+                if callable(fetch) and not isinstance(fetch, Tensor):
+                    tensors = {k: Tensor(jnp.asarray(np.asarray(v)))
+                               for k, v in feed.items()}
+                    out = fetch(tensors)
+                else:
+                    out = self._resolve(program, env, fetch)
+                outs.append(np.asarray(out.value) if return_numpy and
+                            isinstance(out, Tensor) else out)
         finally:
             capture.set_active(prev_active)
-
-        outs = []
-        for fetch in fetch_list or []:
-            if callable(fetch) and not isinstance(fetch, Tensor):
-                tensors = {k: Tensor(jnp.asarray(np.asarray(v)))
-                           for k, v in feed.items()}
-                out = fetch(tensors)
-            else:
-                out = self._resolve(program, env, fetch)
-            outs.append(np.asarray(out.value) if return_numpy and
-                        isinstance(out, Tensor) else out)
         return outs
 
 

@@ -220,7 +220,10 @@ def box_iou(boxes1, boxes2):
 @defop("vision.box_coder")
 def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
               box_normalized=True, axis=0):
-    """ops.py box_coder: encode/decode boxes against priors (SSD-family)."""
+    """ops.py box_coder: encode/decode boxes against priors (SSD-family).
+    prior_box_var accepts a 4-list of floats like the reference."""
+    if isinstance(prior_box_var, (list, tuple)):
+        prior_box_var = jnp.asarray(prior_box_var, jnp.float32)
     pw = prior_box[:, 2] - prior_box[:, 0] + (0 if box_normalized else 1)
     ph = prior_box[:, 3] - prior_box[:, 1] + (0 if box_normalized else 1)
     pcx = prior_box[:, 0] + pw * 0.5
