@@ -340,6 +340,41 @@ def _build_step(model, optimizer, params, acc_keys, use_masters, rng, Tensor, ja
     return jax.jit(train_step, donate_argnums=(0, 1, 2))
 
 
+def _decode_bench(model, cfg, on_tpu):
+    """Serving metric: KV-cache greedy decode latency/throughput on the same
+    flagship model (the inference-engine number next to the training MFU)."""
+    import numpy as np
+
+    import jax
+
+    from paddle_tpu.models.llama_decode import LlamaDecodeEngine
+
+    batch = 8 if on_tpu else 2
+    prefill, steps = (128, 64) if on_tpu else (16, 8)
+    eng = LlamaDecodeEngine(model, max_len=prefill + steps + 1)
+    r = np.random.RandomState(0)
+    ids = r.randint(0, cfg.vocab_size, (batch, prefill)).astype("int32")
+
+    logits, cache, pos = eng.prefill(ids)
+    tok = logits.argmax(-1).astype("int32")[:, None]
+    logits, cache = eng.decode_step(tok, cache, pos)   # compile the step
+    jax.block_until_ready(logits)
+    pos += 1
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        tok = logits.argmax(-1).astype("int32")[:, None]
+        logits, cache = eng.decode_step(tok, cache, pos)
+        pos += 1
+    jax.block_until_ready(logits)
+    dt = time.perf_counter() - t0
+    return {
+        "batch": batch, "prefill": prefill, "steps": steps,
+        "ms_per_token": round(dt / steps * 1e3, 3),
+        "tokens_per_sec": round(batch * steps / dt, 1),
+    }
+
+
 def worker():
     import numpy as np
 
@@ -467,6 +502,17 @@ def worker():
 
     tokens_per_s = batch * seq / dt
 
+    # the compiled step donated the params' original buffers; rebind the live
+    # Parameters to the final trained values before anything reads them again
+    for p, v in zip(params, pv):
+        p._replace_value(v)
+
+    try:
+        decode_info = _decode_bench(model, cfg, on_tpu)
+    except Exception as e:  # noqa: BLE001 - headline metric must survive
+        decode_info = {"error": f"{type(e).__name__}: {e}"[:200]}
+    _log(f"[bench] decode: {decode_info}")
+
     # 6*N FLOPs/token (fwd+bwd) + causal attention term 12*L*H*S/2... use the
     # standard PaLM appendix-B accounting: 6N + 12*L*h*S (h=hidden) per token.
     n_params = sum(int(np.prod(p.shape)) for p in params)
@@ -489,6 +535,7 @@ def worker():
             "attention_path": attention_path,
             "flash_attention": flash_info,
             "dispatch_us": dispatch_us,
+            "decode": decode_info,
         },
     }))
 

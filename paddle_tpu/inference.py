@@ -88,6 +88,20 @@ class Config:
         self.params_file = params_file
         self._model_dir = prog_file
 
+    def exp_set_warmup_shapes(self, shapes):
+        """Input shapes to AOT-compile at predictor creation (the analysis
+        pass + engine-build role of analysis_predictor.cc, TPU-natively: each
+        shape's executable is compiled ONCE at load and every run() with that
+        shape is a cache hit). Each entry is one input's shape tuple, or a
+        (shape, dtype) pair for non-float inputs (e.g. ((1, 128), "int32"))."""
+        norm = []
+        for s in shapes:
+            if len(s) == 2 and isinstance(s[1], str):
+                norm.append((tuple(s[0]), s[1]))
+            else:
+                norm.append((tuple(s), "float32"))
+        self._extra["warmup_shapes"] = norm
+
     def model_dir(self):
         return self._model_dir
 
@@ -133,6 +147,31 @@ class Predictor:
         self._inputs = {n: _IOHandle(n) for n in names}
         self._input_order = names
         self._outputs = []
+        self._warmed_shapes = []
+        for shape, dtype in config._extra.get("warmup_shapes", []):
+            try:
+                self._warm(shape, dtype)
+            except Exception as e:  # noqa: BLE001 - warmup is best-effort:
+                # a bad shape/dtype must not abort predictor construction
+                import warnings
+
+                warnings.warn(f"predictor warmup for {shape} ({dtype}) "
+                              f"failed: {e}", stacklevel=2)
+
+    def _warm(self, shape, dtype="float32"):
+        """AOT-compile the executable for one input shape (XLA jit cache).
+        Single-input programs only — multi-input programs warm on first run."""
+        import jax.numpy as jnp
+
+        if len(self._input_order) != 1:
+            raise ValueError(
+                "warmup shapes support single-input programs; this program "
+                f"takes {len(self._input_order)} inputs")
+        sample = Tensor(jnp.zeros(shape, jnp.dtype(dtype)))
+        out = self._fn(sample)
+        jax.block_until_ready(
+            out[0].value if isinstance(out, (tuple, list)) else out.value)
+        self._warmed_shapes.append(tuple(shape))
 
     def get_input_names(self):
         return list(self._input_order)

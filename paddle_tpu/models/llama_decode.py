@@ -1,0 +1,179 @@
+"""KV-cache decode engine for LLaMA serving.
+
+Reference analog: the inference engine's decode path
+(fluid/inference/api/analysis_predictor.cc execution role +
+paddle/fluid/operators fused attention decode kernels; the reference's
+generation stack caches K/V per layer and attends each new token against it).
+
+TPU-first design: the cache is a STATIC-shape ring of (B, max_len, Hkv, D)
+arrays per layer; each step writes the new K/V at position `pos` via
+lax.dynamic_update_slice and attends against the full buffer under a
+position mask — no dynamic shapes, so the whole decode step is ONE compiled
+XLA program reused for every token (the AOT executable the Predictor caches).
+Weights are pulled from the trained model once; a parity test pins this
+functional path against the model's own forward.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _rms(x, w, eps):
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * lax.rsqrt(var + eps)).astype(x.dtype) * w
+
+
+def _rotate_half(x):
+    half = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+
+
+def _rope_at(x, positions, theta):
+    """x: (B, S, H, D) rotated at absolute 1-D `positions` (S,) — the same
+    rotate-half pairing as models/llama.py apply_rotary_pos_emb."""
+    d = x.shape[-1]
+    inv = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    freqs = jnp.asarray(positions, jnp.float32)[:, None] * inv   # (S, D/2)
+    emb = jnp.concatenate([freqs, freqs], -1)                    # (S, D)
+    cos = jnp.cos(emb).astype(x.dtype)[None, :, None, :]
+    sin = jnp.sin(emb).astype(x.dtype)[None, :, None, :]
+    return x * cos + _rotate_half(x) * sin
+
+
+class LlamaDecodeEngine:
+    """Greedy/temperature decoding with a per-layer KV cache."""
+
+    def __init__(self, model, max_len=None):
+        cfg = model.config
+        self.config = cfg
+        self.max_len = int(max_len or cfg.max_position_embeddings)
+        self.num_heads = cfg.num_attention_heads
+        self.num_kv = cfg.num_key_value_heads
+        self.head_dim = cfg.head_dim
+        self.eps = cfg.rms_norm_eps
+        self.theta = cfg.rope_theta
+
+        self.layers = []
+        for lyr in model.llama.layers:
+            a, m = lyr.self_attn, lyr.mlp
+            self.layers.append(dict(
+                ln1=lyr.input_layernorm.weight.value,
+                ln2=lyr.post_attention_layernorm.weight.value,
+                wq=a.q_proj.weight.value, wk=a.k_proj.weight.value,
+                wv=a.v_proj.weight.value, wo=a.o_proj.weight.value,
+                gate=m.gate_proj.weight.value, up=m.up_proj.weight.value,
+                down=m.down_proj.weight.value))
+        self.emb = model.llama.embed_tokens.weight.value
+        self.norm_w = model.llama.norm.weight.value
+        head = model.lm_head
+        self.head_w = (jnp.swapaxes(self.emb, 0, 1) if head._tied
+                       else head.weight.value)
+
+    # -- cache ---------------------------------------------------------------
+    def init_cache(self, batch):
+        shape = (batch, self.max_len, self.num_kv, self.head_dim)
+        dt = self.emb.dtype
+        return [(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
+                for _ in self.layers]
+
+    # -- functional blocks ---------------------------------------------------
+    def _attend(self, q, ck, cv, pos_mask):
+        """q: (B, S, Hq, D) vs full cache (B, max_len, Hkv, D)."""
+        rep = self.num_heads // self.num_kv
+        if rep > 1:
+            ck = jnp.repeat(ck, rep, axis=2)
+            cv = jnp.repeat(cv, rep, axis=2)
+        logits = jnp.einsum("bshd,bthd->bhst", q, ck) / np.sqrt(self.head_dim)
+        logits = jnp.where(pos_mask[:, None, :, :], logits,
+                           jnp.asarray(-1e30, logits.dtype))
+        probs = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(q.dtype)
+        return jnp.einsum("bhst,bthd->bshd", probs, cv)
+
+    def _block(self, p, x, cache_kv, positions, pos_mask):
+        B, S, _ = x.shape
+        h = _rms(x, p["ln1"], self.eps)
+        q = (h @ p["wq"]).reshape(B, S, self.num_heads, self.head_dim)
+        k = (h @ p["wk"]).reshape(B, S, self.num_kv, self.head_dim)
+        v = (h @ p["wv"]).reshape(B, S, self.num_kv, self.head_dim)
+        q = _rope_at(q, positions, self.theta)
+        k = _rope_at(k, positions, self.theta)
+        ck, cv = cache_kv
+        start = positions[0]
+        ck = lax.dynamic_update_slice(ck, k, (0, start, 0, 0))
+        cv = lax.dynamic_update_slice(cv, v, (0, start, 0, 0))
+        attn = self._attend(q, ck, cv, pos_mask)
+        x = x + attn.reshape(B, S, -1) @ p["wo"]
+        h2 = _rms(x, p["ln2"], self.eps)
+        mlp = (jax.nn.silu(h2 @ p["gate"]) * (h2 @ p["up"])) @ p["down"]
+        return x + mlp, (ck, cv)
+
+    def _forward(self, ids, cache, start_pos):
+        """ids: (B, S) absolute positions start_pos..start_pos+S-1."""
+        B, S = ids.shape
+        x = self.emb[ids]
+        positions = start_pos + jnp.arange(S)
+        t = jnp.arange(self.max_len)[None, None, :]          # cache slots
+        s = positions[None, :, None]                          # query slots
+        pos_mask = jnp.broadcast_to(t <= s, (B, S, self.max_len))
+        new_cache = []
+        for p, ckv in zip(self.layers, cache):
+            x, ckv = self._block(p, x, ckv, positions, pos_mask)
+            new_cache.append(ckv)
+        x = _rms(x, self.norm_w, self.eps)
+        return x @ self.head_w, new_cache
+
+    # -- public API ----------------------------------------------------------
+    @functools.cached_property
+    def _prefill_jit(self):
+        return jax.jit(lambda ids, cache: self._forward(ids, cache, 0))
+
+    @functools.cached_property
+    def _step_jit(self):
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def step(token, cache, pos):
+            logits, cache = self._forward(token, cache, pos)
+            return logits[:, -1], cache
+
+        return step
+
+    def prefill(self, input_ids):
+        ids = jnp.asarray(getattr(input_ids, "value", input_ids), jnp.int32)
+        cache = self.init_cache(ids.shape[0])
+        logits, cache = self._prefill_jit(ids, cache)
+        return logits[:, -1], cache, ids.shape[1]
+
+    def decode_step(self, token, cache, pos):
+        """token (B, 1) int32 -> (next-token logits (B, V), cache')."""
+        if int(pos) >= self.max_len:
+            # dynamic_update_slice would silently CLAMP the write position,
+            # overwriting the last slot while RoPE keeps advancing
+            raise ValueError(
+                f"decode position {int(pos)} exceeds the cache "
+                f"(max_len={self.max_len}); build the engine with a larger "
+                "max_len")
+        return self._step_jit(jnp.asarray(token, jnp.int32), cache,
+                              jnp.asarray(pos, jnp.int32))
+
+    def generate(self, input_ids, max_new_tokens=32):
+        """Greedy decode with the cache: O(S + T) attention work per token
+        instead of generate()'s O((S+T)^2) prefix recompute."""
+        ids = getattr(input_ids, "value", input_ids)
+        need = int(ids.shape[1]) + int(max_new_tokens)
+        if need > self.max_len:
+            raise ValueError(
+                f"prompt ({ids.shape[1]}) + max_new_tokens ({max_new_tokens})"
+                f" = {need} exceeds the cache (max_len={self.max_len})")
+        logits, cache, pos = self.prefill(input_ids)
+        out = [jnp.argmax(logits, -1).astype(jnp.int32)[:, None]]
+        for _ in range(max_new_tokens - 1):
+            logits, cache = self.decode_step(out[-1], cache, pos)
+            pos += 1
+            out.append(jnp.argmax(logits, -1).astype(jnp.int32)[:, None])
+        return jnp.concatenate(out, axis=1)

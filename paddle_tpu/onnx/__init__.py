@@ -19,6 +19,7 @@ from ..framework.enforce import UnimplementedError
 from . import onnx_minimal_pb2 as pb
 
 FLOAT = 1
+INT8 = 3
 INT64 = 7
 
 _ATTR_FLOAT, _ATTR_INT, _ATTR_STRING = 1, 2, 3
@@ -30,9 +31,15 @@ def _tensor(name, arr):
     t = pb.TensorProto()
     t.name = name
     t.dims.extend(arr.shape)
-    t.data_type = FLOAT if arr.dtype.kind == "f" else INT64
-    t.raw_data = np.ascontiguousarray(
-        arr.astype("<f4" if arr.dtype.kind == "f" else "<i8")).tobytes()
+    if arr.dtype == np.int8:
+        t.data_type = INT8
+        t.raw_data = np.ascontiguousarray(arr).tobytes()
+    elif arr.dtype.kind == "f":
+        t.data_type = FLOAT
+        t.raw_data = np.ascontiguousarray(arr.astype("<f4")).tobytes()
+    else:
+        t.data_type = INT64
+        t.raw_data = np.ascontiguousarray(arr.astype("<i8")).tobytes()
     return t
 
 
@@ -160,6 +167,70 @@ class _Emitter:
                                 [_attr_i("axis", 1)]))
         elif kind == "dropout":
             g.node.append(_node("Identity", [src], [out], nm))
+        elif kind == "_quantedwrapper" and \
+                type(layer.inner).__name__.lower() != "linear":
+            raise UnimplementedError(
+                "onnx QDQ export supports quantized Linear only; got "
+                f"_QuantedWrapper({type(layer.inner).__name__})")
+        elif kind == "_quantedwrapper":
+            # QDQ form: QuantizeLinear/DequantizeLinear around the activation,
+            # int8 weight initializer + DequantizeLinear, then the inner Gemm
+            # (reference quantized-model export; ONNX QDQ format)
+            qmax = float(2 ** (layer.act_quanter.quant_bits - 1) - 1)
+            raw = getattr(layer.act_quanter, "_scale", 0.0)
+            # per-channel activation quanters carry an array (or None before
+            # calibration); QDQ activation scale is per-tensor -> use the max
+            scalar = float(np.max(raw)) if raw is not None else 0.0
+            a_scale = max(scalar, 1e-8) / qmax
+            zp = f"{nm}_zp"
+            g.initializer.append(_tensor(zp, np.zeros((), np.int8)))
+            g.initializer.append(_tensor(f"{nm}_a_scale",
+                                         np.float32(a_scale)))
+            g.node.append(_node("QuantizeLinear",
+                                [src, f"{nm}_a_scale", zp],
+                                [f"{nm}_aq"], nm + "_q"))
+            g.node.append(_node("DequantizeLinear",
+                                [f"{nm}_aq", f"{nm}_a_scale", zp],
+                                [f"{nm}_adq"], nm + "_dq"))
+            wnp = layer.inner.weight.numpy()
+            w_absmax = np.maximum(np.abs(wnp).max(), 1e-8)
+            w_scale = np.float32(w_absmax / qmax)
+            wq = np.clip(np.round(wnp / w_scale), -qmax, qmax).astype(np.int8)
+            g.initializer.append(_tensor(f"{nm}_Wq", wq))
+            g.initializer.append(_tensor(f"{nm}_w_scale", w_scale))
+            g.node.append(_node("DequantizeLinear",
+                                [f"{nm}_Wq", f"{nm}_w_scale", zp],
+                                [f"{nm}_Wdq"], nm + "_wdq"))
+            ins = [f"{nm}_adq", f"{nm}_Wdq"]
+            if getattr(layer.inner, "bias", None) is not None:
+                g.initializer.append(
+                    _tensor(f"{nm}_b", layer.inner.bias.numpy()))
+                ins.append(f"{nm}_b")
+            g.node.append(_node("Gemm", ins, [out], nm))
+        elif kind == "weightonlylinear" and layer.algo != "weight_only_int8":
+            raise UnimplementedError(
+                "onnx export of WeightOnlyLinear supports weight_only_int8 "
+                f"(got {layer.algo}: the int4 nibble packing has no ONNX "
+                "initializer form in this build)")
+        elif kind == "weightonlylinear":
+            # weight-only int8: int8 weight + DequantizeLinear (per-channel
+            # scale, axis=1 of the (in, out) weight), fp activations
+            zp = f"{nm}_zp"
+            g.initializer.append(_tensor(zp, np.zeros((), np.int8)))
+            g.initializer.append(_tensor(
+                f"{nm}_Wq", np.asarray(layer.quant_weight.numpy(), np.int8)))
+            g.initializer.append(_tensor(
+                f"{nm}_w_scale",
+                np.asarray(layer.weight_scale.numpy(), np.float32)))
+            g.node.append(_node("DequantizeLinear",
+                                [f"{nm}_Wq", f"{nm}_w_scale", zp],
+                                [f"{nm}_Wdq"], nm + "_wdq",
+                                [_attr_i("axis", 1)]))
+            ins = [src, f"{nm}_Wdq"]
+            if layer.bias is not None:
+                g.initializer.append(_tensor(f"{nm}_b", layer.bias.numpy()))
+                ins.append(f"{nm}_b")
+            g.node.append(_node("Gemm", ins, [out], nm))
         else:
             raise UnimplementedError(
                 f"paddle.onnx.export: layer {type(layer).__name__} has no "
@@ -172,6 +243,7 @@ _LEAF_KINDS = {
     "linear", "conv2d", "batchnorm2d", "batchnorm1d", "batchnorm", "relu",
     "sigmoid", "tanh", "softmax", "gelu", "elu", "softplus", "identity",
     "maxpool2d", "avgpool2d", "adaptiveavgpool2d", "flatten", "dropout",
+    "_quantedwrapper", "weightonlylinear",
 }
 
 
@@ -190,13 +262,22 @@ def export(layer, path, input_spec=None, opset_version=13, **configs):
     else:
         shape = list(np.asarray(spec).shape)
 
-    # record execution order of leaf layers with a sample forward
+    # record execution order of leaf layers with a sample forward; a leaf's
+    # own sublayers (e.g. the Linear inside a _QuantedWrapper) must NOT hook
+    # too or the graph would emit both
     order = []
     handles = []
-    for _, sub in layer.named_sublayers(include_self=True):
-        if type(sub).__name__.lower() in _LEAF_KINDS:
-            handles.append(sub.register_forward_post_hook(
+
+    def _collect(mod):
+        if type(mod).__name__.lower() in _LEAF_KINDS:
+            handles.append(mod.register_forward_post_hook(
                 lambda l, i, o: order.append(l)))
+            return
+        for sub in mod._sub_layers.values():
+            if sub is not None:
+                _collect(sub)
+
+    _collect(layer)
     was_training = layer.training
     layer.eval()
     try:

@@ -22,8 +22,29 @@ from ..framework.core import Tensor
 from ..nn.layer.layers import Layer
 from ..ops._apply import defop
 
+from .observers import (  # noqa: F401
+    AbsmaxChannelWiseObserver,
+    AbsmaxObserver,
+    GroupWiseWeightObserver,
+    HistObserver,
+)
+from .weight_only import (  # noqa: F401
+    WeightOnlyLinear,
+    quantize_for_inference,
+    weight_dequantize,
+    weight_only_linear,
+    weight_quantize,
+)
+
 __all__ = ["QuantConfig", "QAT", "PTQ", "FakeQuanterWithAbsMax",
-           "AbsmaxObserver", "quant_dequant"]
+           "FakeQuanterChannelWiseAbsMax", "AbsmaxObserver",
+           "AbsmaxChannelWiseObserver", "HistObserver",
+           "GroupWiseWeightObserver", "quant_dequant", "weight_quantize",
+           "weight_dequantize", "weight_only_linear", "WeightOnlyLinear",
+           "quantize_for_inference",
+           # reference quanter-factory aliases
+           "FakeQuanterWithAbsMaxObserver",
+           "FakeQuanterChannelWiseAbsMaxObserver"]
 
 
 @defop("fake_quant_dequant")
@@ -38,21 +59,6 @@ def _fake_qdq(x, scale, bits=8):
 
 def quant_dequant(x, scale, bits=8):
     return _fake_qdq(x, scale, bits=bits)
-
-
-class AbsmaxObserver:
-    """PTQ calibration observer (reference observers.abs_max)."""
-
-    def __init__(self, quant_bits=8):
-        self.quant_bits = quant_bits
-        self._absmax = 0.0
-
-    def observe(self, x):
-        v = float(ops.abs(x).max().numpy())
-        self._absmax = max(self._absmax, v)
-
-    def scale(self):
-        return self._absmax
 
 
 class FakeQuanterWithAbsMax(Layer):
@@ -90,6 +96,54 @@ class FakeQuanterWithAbsMax(Layer):
                                + (1 - self.moving_rate) * cur)
         s = Tensor(jnp.asarray(max(self._scale, 1e-8), jnp.float32))
         return quant_dequant(x, s, bits=self.quant_bits)
+
+
+@defop("fake_channel_quant_dequant")
+def _fake_qdq_channel(x, scale, bits=8, axis=-1):
+    qmax = float(2 ** (bits - 1) - 1)
+    shape = [1] * x.ndim
+    shape[axis] = -1
+    s = jnp.maximum(scale, 1e-8).reshape(shape)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    y = q * s / qmax
+    return x + jax.lax.stop_gradient(y - x)
+
+
+class FakeQuanterChannelWiseAbsMax(Layer):
+    """Per-channel QAT/PTQ quanter (reference
+    quanters.FakeQuanterChannelWiseAbsMaxObserver): one scale per slice along
+    ``axis`` (the output-channel dim for weights), running max across calls."""
+
+    def __init__(self, quant_bits=8, axis=-1):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.axis = axis
+        self._scale = None
+
+    def forward(self, x):
+        import jax as _jax
+
+        ax = self.axis % len(x.shape)
+        reduce_axes = tuple(i for i in range(len(x.shape)) if i != ax)
+        traced = isinstance(x.value, _jax.core.Tracer)
+        if self.training and traced:
+            s = ops.abs(x).max(axis=reduce_axes).detach() \
+                if reduce_axes else ops.abs(x).detach()
+            return _fake_qdq_channel(x, s, bits=self.quant_bits, axis=ax)
+        if self.training:
+            cur = np.abs(np.asarray(x.numpy(), np.float64))
+            cur = cur.max(axis=reduce_axes) if reduce_axes else cur
+            self._scale = cur if self._scale is None \
+                else np.maximum(self._scale, cur)
+        s = Tensor(jnp.asarray(
+            np.maximum(self._scale if self._scale is not None
+                       else np.ones(x.shape[ax]), 1e-8), jnp.float32))
+        return _fake_qdq_channel(x, s, bits=self.quant_bits, axis=ax)
+
+
+# reference factory names resolve to the layer-level quanters here
+FakeQuanterWithAbsMaxObserver = FakeQuanterWithAbsMax
+FakeQuanterChannelWiseAbsMaxObserver = FakeQuanterChannelWiseAbsMax
 
 
 class QuantConfig:

@@ -1,0 +1,138 @@
+"""Calibration observers.
+
+Reference analog: python/paddle/quantization/observers/ (abs_max.py
+AbsmaxObserver, groupwise.py GroupWiseWeightObserver) plus the histogram/
+percentile observers of the imperative stack
+(python/paddle/quantization/imperative/ptq_quantizer.py HistQuantizer,
+AbsmaxQuantizer, PerChannelAbsmaxQuantizer).
+
+An observer accumulates statistics over calibration batches and yields the
+quantization scale: absmax (global or per-channel), or a histogram percentile
+that clips outliers (the TPU-relevant serving path is weight-only int8/int4,
+see weight_only.py, where per-channel scales come from these observers).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import ops
+
+
+def _abs_np(x):
+    return np.abs(np.asarray(
+        x.numpy() if hasattr(x, "numpy") else x, np.float64))
+
+
+class AbsmaxObserver:
+    """Running absmax over every observed batch (observers/abs_max.py)."""
+
+    def __init__(self, quant_bits=8):
+        self.quant_bits = quant_bits
+        self._absmax = 0.0
+
+    def observe(self, x):
+        v = float(ops.abs(x).max().numpy())
+        self._absmax = max(self._absmax, v)
+
+    def scale(self):
+        return self._absmax
+
+    # reference observer API aliases
+    def cal_thresholds(self):
+        return self.scale()
+
+
+class AbsmaxChannelWiseObserver:
+    """Per-channel absmax (imperative PerChannelAbsmaxQuantizer / the
+    channel-wise weight observer): one scale per slice along ``axis``."""
+
+    def __init__(self, quant_bits=8, axis=0):
+        self.quant_bits = quant_bits
+        self.axis = axis
+        self._absmax = None
+
+    def observe(self, x):
+        a = _abs_np(x)
+        reduce_axes = tuple(i for i in range(a.ndim) if i != self.axis)
+        cur = a.max(axis=reduce_axes) if reduce_axes else a
+        self._absmax = cur if self._absmax is None \
+            else np.maximum(self._absmax, cur)
+
+    def scale(self):
+        if self._absmax is None:
+            return None
+        return self._absmax.astype(np.float32)
+
+
+class HistObserver:
+    """Histogram/percentile observer (imperative HistQuantizer): accumulate a
+    histogram of |x| and take the ``percent`` quantile as the scale, clipping
+    the long tail that would otherwise waste int8 range on outliers."""
+
+    def __init__(self, quant_bits=8, bins=2048, percent=0.9999):
+        self.quant_bits = quant_bits
+        self.bins = bins
+        self.percent = percent
+        self._hist = None
+        self._range = 0.0
+
+    def observe(self, x):
+        a = _abs_np(x).ravel()
+        mx = float(a.max()) if a.size else 0.0
+        if self._hist is None:
+            self._range = max(mx, 1e-12)
+            self._hist = np.histogram(a, bins=self.bins,
+                                      range=(0.0, self._range))[0].astype(
+                                          np.float64)
+            return
+        if mx > self._range:
+            # re-bin the existing histogram onto the wider range: counts fold
+            # into the coarser bins by index mapping (error <= one bin width)
+            ratio = mx / self._range
+            new = np.zeros(self.bins, np.float64)
+            old_centers = (np.arange(self.bins) + 0.5) * (self._range
+                                                          / self.bins)
+            idx = np.minimum((old_centers / mx * self.bins).astype(int),
+                             self.bins - 1)
+            np.add.at(new, idx, self._hist)
+            self._hist = new
+            self._range = mx
+        self._hist += np.histogram(a, bins=self.bins,
+                                   range=(0.0, self._range))[0]
+
+    def scale(self):
+        if self._hist is None:
+            return 0.0
+        cum = np.cumsum(self._hist)
+        total = cum[-1]
+        if total == 0:
+            return 0.0
+        k = int(np.searchsorted(cum, self.percent * total))
+        return float((k + 1) * self._range / self.bins)
+
+    cal_thresholds = scale
+
+
+class GroupWiseWeightObserver:
+    """Group-wise absmax for weight-only int4 (observers/groupwise.py): one
+    scale per ``group_size`` input-dim slice per output channel."""
+
+    def __init__(self, quant_bits=4, group_size=64):
+        self.quant_bits = quant_bits
+        self.group_size = group_size
+        self._absmax = None
+
+    def observe(self, w):
+        a = _abs_np(w)              # (in, out) layout of Linear.weight
+        k, n = a.shape
+        g = self.group_size
+        pad = (-k) % g
+        if pad:
+            a = np.concatenate([a, np.zeros((pad, n))], 0)
+        cur = a.reshape(-1, g, n).max(axis=1)   # (groups, out)
+        self._absmax = cur if self._absmax is None \
+            else np.maximum(self._absmax, cur)
+
+    def scale(self):
+        return None if self._absmax is None \
+            else self._absmax.astype(np.float32)

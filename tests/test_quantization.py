@@ -1,0 +1,186 @@
+"""Quantization framework depth (round-2 verdict #8): per-channel + histogram
+observers, channel-wise quanter, weight-only int8/int4 serving path, QDQ ONNX
+export. Reference: python/paddle/quantization/{observers,quanters}/ +
+nn/quant/quantized_linear.py.
+"""
+import io
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import quantization as Q
+
+
+class TestObservers:
+    def test_per_channel_absmax(self):
+        obs = Q.AbsmaxChannelWiseObserver(axis=1)
+        w = np.array([[1.0, -4.0], [2.0, 3.0], [-0.5, 1.0]], "float32")
+        obs.observe(paddle.to_tensor(w))
+        np.testing.assert_allclose(obs.scale(), [2.0, 4.0])
+        obs.observe(paddle.to_tensor(w * 0.5))  # running max keeps the peak
+        np.testing.assert_allclose(obs.scale(), [2.0, 4.0])
+
+    def test_histogram_percentile_clips_outliers(self):
+        obs = Q.HistObserver(percent=0.999)
+        r = np.random.RandomState(0)
+        x = r.randn(10000).astype("float32")
+        x[0] = 1000.0  # one extreme outlier
+        obs.observe(paddle.to_tensor(x))
+        s = obs.scale()
+        assert s < 50.0, f"outlier not clipped: scale={s}"
+        assert s > np.percentile(np.abs(x), 99) * 0.5
+
+    def test_histogram_rebins_on_growing_range(self):
+        obs = Q.HistObserver(percent=1.0)
+        obs.observe(paddle.to_tensor(np.ones(100, "float32")))
+        obs.observe(paddle.to_tensor(np.full(100, 8.0, "float32")))
+        assert obs.scale() == pytest.approx(8.0, rel=0.01)
+
+    def test_groupwise_weight_observer(self):
+        obs = Q.GroupWiseWeightObserver(group_size=2)
+        w = np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0], [7.0, 8.0]],
+                     "float32")
+        obs.observe(paddle.to_tensor(w))
+        np.testing.assert_allclose(obs.scale(), [[3.0, 4.0], [7.0, 8.0]])
+
+
+class TestChannelWiseQuanter:
+    def test_per_channel_scales_beat_per_tensor_on_skewed_weights(self):
+        # channel 0 tiny, channel 1 huge: per-tensor quant destroys channel 0
+        r = np.random.RandomState(0)
+        w = np.concatenate([r.randn(16, 8) * 0.01, r.randn(16, 8) * 10.0],
+                           axis=1).astype("float32")
+        wt = paddle.to_tensor(w)
+        per_tensor = Q.FakeQuanterWithAbsMax()
+        per_tensor.train()
+        err_t = np.abs(per_tensor(wt).numpy() - w)[:, :8].mean()
+        per_chan = Q.FakeQuanterChannelWiseAbsMax(axis=1)
+        per_chan.train()
+        err_c = np.abs(per_chan(wt).numpy() - w)[:, :8].mean()
+        assert err_c < err_t / 10.0, (err_c, err_t)
+
+
+class TestQATLeNet:
+    def _data(self):
+        r = np.random.RandomState(0)
+        x = r.randn(64, 1, 8, 8).astype("float32")
+        y = r.randint(0, 4, (64,)).astype("int64")
+        return paddle.to_tensor(x), paddle.to_tensor(y)
+
+    def _lenet(self):
+        paddle.seed(0)
+        return nn.Sequential(
+            nn.Conv2D(1, 4, 3), nn.ReLU(), nn.Flatten(),
+            nn.Linear(4 * 6 * 6, 16), nn.ReLU(), nn.Linear(16, 4))
+
+    def test_qat_trains_and_tracks_float_accuracy(self):
+        x, y = self._data()
+        ce = nn.CrossEntropyLoss()
+
+        def train(model):
+            opt = paddle.optimizer.Adam(learning_rate=5e-3,
+                                        parameters=model.parameters())
+            model.train()
+            for _ in range(30):
+                loss = ce(model(x), y)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+            model.eval()
+            pred = model(x).numpy().argmax(1)
+            return (pred == y.numpy()).mean()
+
+        acc_float = train(self._lenet())
+        qat_model = Q.QAT().quantize(self._lenet())
+        acc_qat = train(qat_model)
+        # int8 fake-quant training must stay within a few points of float
+        assert acc_qat >= acc_float - 0.15, (acc_qat, acc_float)
+
+
+class TestWeightOnly:
+    def test_int8_roundtrip_error_bounded(self):
+        r = np.random.RandomState(0)
+        w = r.randn(64, 32).astype("float32")
+        qw, s = Q.weight_quantize(paddle.to_tensor(w), "weight_only_int8")
+        assert qw.numpy().dtype == np.int8
+        wd = Q.weight_dequantize(qw, s, "weight_only_int8").numpy()
+        # absmax int8: error bounded by scale/2 per channel
+        assert np.abs(wd - w).max() <= (np.abs(w).max(0) / 127).max() * 0.51
+
+    def test_int4_pack_unpack_roundtrip(self):
+        r = np.random.RandomState(1)
+        w = r.randn(10, 6).astype("float32")
+        qw, s = Q.weight_quantize(paddle.to_tensor(w), "weight_only_int4")
+        assert qw.numpy().shape == (5, 6)  # packed two-per-byte
+        wd = Q.weight_dequantize(qw, s, "weight_only_int4", k=10).numpy()
+        assert np.abs(wd - w).max() <= (np.abs(w).max(0) / 7).max() * 0.51
+
+    def test_weight_only_linear_matches_dequant_matmul(self):
+        r = np.random.RandomState(2)
+        x = paddle.to_tensor(r.randn(4, 16).astype("float32"))
+        lin = nn.Linear(16, 8)
+        wol = Q.WeightOnlyLinear(lin)
+        want = x.numpy() @ Q.weight_dequantize(
+            wol.quant_weight, wol.weight_scale).numpy() + lin.bias.numpy()
+        np.testing.assert_allclose(wol(x).numpy(), want, rtol=1e-5, atol=1e-5)
+
+    def test_llama_block_weight_only_int8_accuracy(self):
+        """Weight-only int8 on a LLaMA decoder block: outputs stay close to
+        fp32 (the serving-path accuracy assertion the verdict asked for)."""
+        from paddle_tpu.models import LlamaConfig
+        from paddle_tpu.models.llama import LlamaDecoderLayer
+
+        paddle.seed(0)
+        cfg = LlamaConfig(vocab_size=64, hidden_size=64,
+                          intermediate_size=128, num_hidden_layers=1,
+                          num_attention_heads=4, num_key_value_heads=4,
+                          max_position_embeddings=16)
+        block = LlamaDecoderLayer(cfg)
+        block.eval()
+        r = np.random.RandomState(0)
+        h = paddle.to_tensor(r.randn(2, 16, 64).astype("float32") * 0.5)
+        ref = block(h).numpy()
+        n = Q.quantize_for_inference(block, algo="weight_only_int8")
+        assert n >= 4  # q/k/v/o + mlp projections swapped
+        got = block(h).numpy()
+        rel = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert rel < 0.05, f"int8 block diverges: rel={rel}"
+
+    def test_quantize_for_inference_min_features(self):
+        model = nn.Sequential(nn.Linear(4, 4), nn.Linear(64, 4))
+        n = Q.quantize_for_inference(model, min_features=16)
+        assert n == 1  # the tiny layer is skipped
+        assert isinstance(model[1], Q.WeightOnlyLinear)
+        assert isinstance(model[0], nn.Linear)
+
+
+class TestQDQExport:
+    def test_qat_model_exports_qdq_nodes(self, tmp_path):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        model = Q.QAT().quantize(model)
+        model.train()
+        model(paddle.to_tensor(np.random.RandomState(0)
+                               .randn(4, 8).astype("float32")))
+        model.eval()
+        path = str(tmp_path / "qat_model")
+        paddle.onnx.export(model, path,
+                           input_spec=[paddle.static.InputSpec([None, 8],
+                                                               "float32")])
+        blob = open(path + ".onnx", "rb").read()
+        assert b"QuantizeLinear" in blob and b"DequantizeLinear" in blob
+
+    def test_weight_only_model_exports_int8_initializers(self, tmp_path):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        Q.quantize_for_inference(model)
+        path = str(tmp_path / "wol_model")
+        paddle.onnx.export(model, path,
+                           input_spec=[paddle.static.InputSpec([None, 8],
+                                                               "float32")])
+        blob = open(path + ".onnx", "rb").read()
+        assert b"DequantizeLinear" in blob
+        assert os.path.getsize(path + ".onnx") < 8 * 16 * 4 + 16 * 4 * 4 + 4096
