@@ -170,6 +170,9 @@ class LlamaDecodeEngine:
             raise ValueError(
                 f"prompt ({ids.shape[1]}) + max_new_tokens ({max_new_tokens})"
                 f" = {need} exceeds the cache (max_len={self.max_len})")
+        if max_new_tokens <= 0:
+            ids2 = jnp.asarray(ids, jnp.int32)
+            return ids2[:, :0]
         logits, cache, pos = self.prefill(input_ids)
         out = [jnp.argmax(logits, -1).astype(jnp.int32)[:, None]]
         for _ in range(max_new_tokens - 1):

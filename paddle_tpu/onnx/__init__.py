@@ -193,14 +193,27 @@ class _Emitter:
                                 [f"{nm}_aq", f"{nm}_a_scale", zp],
                                 [f"{nm}_adq"], nm + "_dq"))
             wnp = layer.inner.weight.numpy()
-            w_absmax = np.maximum(np.abs(wnp).max(), 1e-8)
-            w_scale = np.float32(w_absmax / qmax)
-            wq = np.clip(np.round(wnp / w_scale), -qmax, qmax).astype(np.int8)
+            wq_scale = getattr(layer.weight_quanter, "_scale", None)
+            wdq_attrs = []
+            if wq_scale is not None and np.ndim(wq_scale) == 1 and \
+                    len(wq_scale) == wnp.shape[1]:
+                # the network trained with per-OUTPUT-channel weight scales:
+                # export them as-is (DequantizeLinear axis=1 over (in, out))
+                w_scale = np.maximum(np.asarray(wq_scale, np.float32),
+                                     1e-8) / qmax
+                wq = np.clip(np.round(wnp / w_scale), -qmax, qmax) \
+                    .astype(np.int8)
+                wdq_attrs = [_attr_i("axis", 1)]
+            else:
+                w_absmax = np.maximum(np.abs(wnp).max(), 1e-8)
+                w_scale = np.float32(w_absmax / qmax)
+                wq = np.clip(np.round(wnp / w_scale), -qmax, qmax) \
+                    .astype(np.int8)
             g.initializer.append(_tensor(f"{nm}_Wq", wq))
             g.initializer.append(_tensor(f"{nm}_w_scale", w_scale))
             g.node.append(_node("DequantizeLinear",
                                 [f"{nm}_Wq", f"{nm}_w_scale", zp],
-                                [f"{nm}_Wdq"], nm + "_wdq"))
+                                [f"{nm}_Wdq"], nm + "_wdq", wdq_attrs))
             ins = [f"{nm}_adq", f"{nm}_Wdq"]
             if getattr(layer.inner, "bias", None) is not None:
                 g.initializer.append(

@@ -56,43 +56,138 @@ _UNIT_DIV = {"s": 1e9, "ms": 1e6, "us": 1e3, "ns": 1.0}
 
 
 def gather_stats(events) -> dict[str, EventStat]:
-    stats: dict[str, EventStat] = {}
-    for ev in events:
-        st = stats.get(ev.name)
-        if st is None:
-            st = stats[ev.name] = EventStat(ev.name)
-        st.add(ev.duration_ns)
-    return stats
+    """Flat per-name rollup; delegates to the tree aggregation so the two
+    paths cannot drift (self-time callers use gather_tree_stats directly)."""
+    return gather_tree_stats(events)[0]
 
 
 def _fmt(ns, unit):
     return f"{ns / _UNIT_DIV[unit]:.3f}"
 
 
+# -- event tree ---------------------------------------------------------------
+class EventNode:
+    """One span in the nesting tree (reference HostStatisticNode analog)."""
+
+    __slots__ = ("event", "children")
+
+    def __init__(self, event):
+        self.event = event
+        self.children = []
+
+    @property
+    def total_ns(self):
+        return self.event.duration_ns
+
+    @property
+    def self_ns(self):
+        """Time not covered by child spans (reference self_cpu_time_ms)."""
+        return self.total_ns - sum(c.total_ns for c in self.children)
+
+
+def build_event_tree(events):
+    """Nest flat spans by containment per thread (the reference aggregates a
+    C++ node tree; here the tree is rebuilt from (start, end, tid))."""
+    roots = []
+    by_tid = {}
+    for ev in events:
+        by_tid.setdefault(ev.tid, []).append(ev)
+    for evs in by_tid.values():
+        evs.sort(key=lambda e: (e.start_ns, -e.end_ns))
+        stack = []
+        for ev in evs:
+            node = EventNode(ev)
+            while stack and stack[-1].event.end_ns <= ev.start_ns:
+                stack.pop()
+            if stack and ev.end_ns <= stack[-1].event.end_ns:
+                stack[-1].children.append(node)
+            else:
+                roots.append(node)
+            stack.append(node)
+    return roots
+
+
+def _walk(nodes):
+    for n in nodes:
+        yield n
+        yield from _walk(n.children)
+
+
+def gather_tree_stats(events):
+    """Per-name rollup with SELF time (children excluded), so nested spans do
+    not double-count into their parents' ratios."""
+    stats = {}
+    selfs = {}
+    for node in _walk(build_event_tree(events)):
+        name = node.event.name
+        st = stats.get(name)
+        if st is None:
+            st = stats[name] = EventStat(name)
+            selfs[name] = 0
+        st.add(node.total_ns)
+        selfs[name] += node.self_ns
+    return stats, selfs
+
+
+def _category_totals(events):
+    """Wall time per TracerEventType over ROOT self-containment (reference
+    'Model Perspective' / overview tables)."""
+    totals = {}
+    for node in _walk(build_event_tree(events)):
+        cat = node.event.event_type.name
+        totals[cat] = totals.get(cat, 0) + node.self_ns
+    return totals
+
+
+def _table(title, header_cols, rows, lines):
+    header = "  ".join(header_cols)
+    sep = "-" * len(header)
+    lines += ["", title, sep, header, sep]
+    lines += rows
+    lines.append(sep)
+
+
 def _build_summary(result, sorted_by=SortedKeys.CPUTotal,
                    time_unit: str = "ms") -> str:
     if time_unit not in _UNIT_DIV:
         raise ValueError(f"time_unit must be one of {list(_UNIT_DIV)}")
-    stats = gather_stats(result.events)
+    stats, selfs = gather_tree_stats(result.events)
     reverse = sorted_by not in (SortedKeys.CPUMin, SortedKeys.GPUMin)
     rows = sorted(stats.values(),
                   key=lambda s: getattr(s, _SORT_ATTR[sorted_by]) or 0,
                   reverse=reverse)
-    wall_ns = sum(s.total_ns for s in rows) or 1
+    wall_ns = sum(selfs.values()) or 1
+    lines = []
+
+    # 1) overview by category (reference Overview / Model Perspective table)
+    cats = sorted(_category_totals(result.events).items(),
+                  key=lambda kv: kv[1], reverse=True)
+    _table(f"Overview Summary (steps {result.steps[0]}..{result.steps[1]}, "
+           f"by category self time)",
+           [f"{'Category':<24}", f"{'Total(' + time_unit + ')':>12}",
+            f"{'Ratio(%)':>8}"],
+           [f"{name:<24}  {_fmt(ns, time_unit):>12}  "
+            f"{100.0 * ns / wall_ns:>8.2f}" for name, ns in cats],
+           lines)
+
+    # 2) per-name event summary with total vs self time (nested spans do not
+    #    double-count; reference EventSummary:503)
     name_w = max([len("Name")] + [min(len(s.name), 60) for s in rows])
-    header = (f"{'Name':<{name_w}}  {'Calls':>7}  {'Total(' + time_unit + ')':>12}  "
-              f"{'Avg(' + time_unit + ')':>12}  {'Max(' + time_unit + ')':>12}  "
-              f"{'Min(' + time_unit + ')':>12}  {'Ratio(%)':>8}")
-    sep = "-" * len(header)
-    lines = ["", "Host Event Summary "
-             f"(steps {result.steps[0]}..{result.steps[1]})", sep, header, sep]
-    for s in rows:
-        lines.append(
-            f"{s.name[:60]:<{name_w}}  {s.calls:>7}  {_fmt(s.total_ns, time_unit):>12}  "
-            f"{_fmt(s.avg_ns, time_unit):>12}  {_fmt(s.max_ns, time_unit):>12}  "
-            f"{_fmt(s.min_ns or 0, time_unit):>12}  "
-            f"{100.0 * s.total_ns / wall_ns:>8.2f}")
-    lines.append(sep)
+    _table("Host Event Summary",
+           [f"{'Name':<{name_w}}", f"{'Calls':>7}",
+            f"{'Total(' + time_unit + ')':>12}",
+            f"{'Self(' + time_unit + ')':>12}",
+            f"{'Avg(' + time_unit + ')':>12}",
+            f"{'Max(' + time_unit + ')':>12}",
+            f"{'Min(' + time_unit + ')':>12}", f"{'Ratio(%)':>8}"],
+           [(f"{s.name[:60]:<{name_w}}  {s.calls:>7}  "
+             f"{_fmt(s.total_ns, time_unit):>12}  "
+             f"{_fmt(selfs[s.name], time_unit):>12}  "
+             f"{_fmt(s.avg_ns, time_unit):>12}  "
+             f"{_fmt(s.max_ns, time_unit):>12}  "
+             f"{_fmt(s.min_ns or 0, time_unit):>12}  "
+             f"{100.0 * selfs[s.name] / wall_ns:>8.2f}") for s in rows],
+           lines)
     if result.xla_trace_dir:
         lines.append(f"XLA device trace (TensorBoard/XProf): {result.xla_trace_dir}")
     return "\n".join(lines)

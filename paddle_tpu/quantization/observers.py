@@ -88,7 +88,6 @@ class HistObserver:
         if mx > self._range:
             # re-bin the existing histogram onto the wider range: counts fold
             # into the coarser bins by index mapping (error <= one bin width)
-            ratio = mx / self._range
             new = np.zeros(self.bins, np.float64)
             old_centers = (np.arange(self.bins) + 0.5) * (self._range
                                                           / self.bins)

@@ -31,7 +31,20 @@ def weight_quantize(weight, algo="weight_only_int8", group_size=-1):
     along the input dim). Matches quantized_linear.py weight_quantize."""
     w = np.asarray(weight.numpy() if isinstance(weight, Tensor) else weight,
                    np.float32)
+    if group_size and group_size > 0 and algo != "weight_only_int8":
+        raise NotImplementedError(
+            "group_size is supported for weight_only_int8 only in this build")
     if algo == "weight_only_int8":
+        if group_size and group_size > 0:
+            k, n = w.shape
+            if k % group_size:
+                raise ValueError(
+                    f"in_features {k} not divisible by group_size {group_size}")
+            wg = w.reshape(-1, group_size, n)
+            s = np.maximum(np.abs(wg).max(axis=1), 1e-8) / 127.0  # (groups, out)
+            q = np.clip(np.round(wg / s[:, None, :]), -127, 127) \
+                .astype(np.int8).reshape(k, n)
+            return Tensor(jnp.asarray(q)), Tensor(jnp.asarray(s))
         s = np.maximum(np.abs(w).max(axis=0), 1e-8) / 127.0      # (out,)
         q = np.clip(np.round(w / s), -127, 127).astype(np.int8)
         return Tensor(jnp.asarray(q)), Tensor(jnp.asarray(s))
@@ -76,7 +89,11 @@ def _wol(x, qweight, scale, bias=None, algo="weight_only_int8", k=None):
         w = _unpack_int4(qweight, k)
     else:
         w = qweight
-    wd = w.astype(x.dtype) * scale.astype(x.dtype)
+    s = scale.astype(x.dtype)
+    if s.ndim == 2:  # group-wise: (groups, out) -> per-row scales
+        group = w.shape[0] // s.shape[0]
+        s = jnp.repeat(s, group, axis=0)[:w.shape[0]]
+    wd = w.astype(x.dtype) * s
     out = x @ wd
     return out + bias.astype(x.dtype) if bias is not None else out
 
@@ -84,6 +101,9 @@ def _wol(x, qweight, scale, bias=None, algo="weight_only_int8", k=None):
 def weight_only_linear(x, weight, bias=None, weight_scale=None,
                        weight_dtype="int8", group_size=-1, name=None):
     """quantized_linear.py weight_only_linear: x @ dequant(int weight)."""
+    if weight_scale is None:
+        raise ValueError(
+            "weight_only_linear requires weight_scale (from weight_quantize)")
     algo = "weight_only_int4" if str(weight_dtype) == "int4" \
         else "weight_only_int8"
     return _wol(x, weight, weight_scale, bias, algo=algo,

@@ -6,6 +6,8 @@ compiled distributed step must track eager losses)."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 import paddle_tpu.distributed as dist
 from paddle_tpu.distributed import ProcessMesh, fleet

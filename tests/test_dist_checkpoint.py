@@ -5,6 +5,8 @@ shards; load_state_dict.py:526 works across changed parallelism)."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 import paddle_tpu.distributed as dist
 from paddle_tpu.distributed import ProcessMesh, Replicate, Shard

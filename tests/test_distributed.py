@@ -7,6 +7,8 @@ the reference likewise tests distributed features without real multi-device hard
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 import paddle_tpu.distributed as dist
 from paddle_tpu.distributed import Partial, Replicate, Shard

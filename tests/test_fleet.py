@@ -7,6 +7,8 @@ vs plain large-batch training, sharding state placement, recompute grad equivale
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
 import paddle_tpu.nn.functional as F

@@ -5,6 +5,8 @@ analysis_predictor.cc's role, TPU-natively (one compiled decode executable).
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
 from paddle_tpu.models.llama_decode import LlamaDecodeEngine

@@ -7,6 +7,8 @@ dp/mp/pp combinations with loss checks) on the 8-device virtual CPU mesh.
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 from paddle_tpu.distributed import fleet
 from paddle_tpu.models import (

@@ -139,6 +139,30 @@ def _cosine_embedding_ref(a, b):
     return loss.mean()
 
 
+def _chan_scale(x):
+    return np.maximum(np.abs(x).max(axis=0, keepdims=True), 1e-8)
+
+
+def _fcqd_fn(x):
+    from paddle_tpu.quantization import _fake_qdq_channel
+
+    s = paddle.to_tensor(np.abs(x.numpy()).max(axis=0).astype("float32"))
+    return _fake_qdq_channel(x, s, bits=8, axis=1)
+
+
+_WOL_RNG = np.random.RandomState(11)
+_WOL_W = _WOL_RNG.randn(5, 3).astype("float32")
+_WOL_Q = np.clip(np.round(_WOL_W / (np.abs(_WOL_W).max(0) / 127)),
+                 -127, 127).astype(np.int8)
+_WOL_S = (np.abs(_WOL_W).max(0) / 127).astype("float32")
+
+
+def _wol_fn(x):
+    from paddle_tpu.quantization.weight_only import _wol
+
+    return _wol(x, paddle.to_tensor(_WOL_Q), paddle.to_tensor(_WOL_S))
+
+
 def _huber_fn(x, y):
     from paddle_tpu.nn.functional.loss import huber_loss
 
@@ -770,6 +794,14 @@ TAIL_CASES = [
     OpCase("rrelu_eval",
            lambda x: F.rrelu(x, lower=0.2, upper=0.4, training=False),
            lambda x: np.where(x >= 0, x, x * 0.3), [S]),
+    OpCase("fake_channel_quant_dequant",
+           lambda x: _fcqd_fn(x),
+           lambda x: np.round(np.clip(x / _chan_scale(x) * 127, -127, 127))
+           * _chan_scale(x) / 127, [S], grad=False, dtypes=("float32",)),
+    OpCase("weight_only_linear",
+           lambda x: _wol_fn(x),
+           lambda x: x @ (_WOL_Q.astype("float64") * _WOL_S), [S],
+           rtol=1e-4, atol=1e-4, dtypes=("float32",)),
 ]
 
 

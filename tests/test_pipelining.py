@@ -3,6 +3,8 @@ pp-sharded parameter bytes, VPP chunking, and the full-model bridge."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P

@@ -104,6 +104,44 @@ def test_summary_prints(capsys):
     assert "alpha" in out and "Calls" in out
 
 
+def test_event_tree_self_time():
+    """Nested spans: the parent's SELF time excludes children (reference
+    event-tree analysis, profiler_statistic.py EventSummary)."""
+    import time as _time
+
+    from paddle_tpu.profiler.profiler_statistic import (
+        _walk, build_event_tree, gather_tree_stats,
+    )
+
+    with Profiler(targets=[ProfilerTarget.CPU]) as p:
+        with RecordEvent("outer"):
+            with RecordEvent("inner"):
+                _time.sleep(0.02)
+            _time.sleep(0.005)
+        p.step()
+    res = p._last_result
+    nodes = list(_walk(build_event_tree(res.events)))
+    outer = [n for n in nodes if n.event.name == "outer"]
+    assert outer and outer[0].children, "inner must nest under outer"
+    assert outer[0].children[0].event.name == "inner"
+    stats, selfs = gather_tree_stats(res.events)
+    assert selfs["outer"] < stats["outer"].total_ns  # children excluded
+    assert stats["inner"].total_ns > 15e6            # ~20ms
+    assert selfs["outer"] < 15e6                     # outer self ~5ms
+
+
+def test_summary_has_overview_and_self_column(capsys):
+    with Profiler(targets=[ProfilerTarget.CPU]) as p:
+        with RecordEvent("top"):
+            with RecordEvent("nested"):
+                pass
+        p.step()
+    p.summary()
+    out = capsys.readouterr().out
+    assert "Overview Summary" in out
+    assert "Self(" in out and "nested" in out
+
+
 def test_load_profiler_result_roundtrip(tmp_path):
     path = str(tmp_path / "t.json")
     with Profiler(targets=[ProfilerTarget.CPU]) as p:

@@ -9,6 +9,8 @@ import os
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 from paddle_tpu.metric import Accuracy, Auc, Precision, Recall
 from paddle_tpu.vision import transforms
