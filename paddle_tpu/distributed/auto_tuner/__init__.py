@@ -7,14 +7,17 @@ prune invalid ones, launch trial jobs, record metrics, pick the best.
 TPU-first mapping: candidates describe mesh factorizations; pruning knows the
 TPU constraints (mp should ride the fastest ICI axis and divide heads; pp
 divides layers; memory estimate = params*(2+4+4+4)/dp_shard + activations).
-Trials run through a user callable (compile+time one step — in-process on the
-single-controller runtime instead of launching subprocess jobs).
+Trials run through a user callable (compile+time one step in-process) or —
+like the reference's tuner.py loop — as REAL subprocess jobs via
+LaunchTrialRunner, which launches each candidate through the distributed
+launcher and parses the metric line the script reports.
 """
 from __future__ import annotations
 
 import itertools
 
-__all__ = ["SearchSpace", "prune_candidates", "AutoTuner", "Recorder"]
+__all__ = ["SearchSpace", "prune_candidates", "AutoTuner", "Recorder",
+           "LaunchTrialRunner", "get_trial_config", "report_metric"]
 
 
 class SearchSpace:
@@ -121,3 +124,80 @@ class AutoTuner:
             except Exception as e:  # noqa: BLE001 — a failed trial is data
                 self.recorder.add(cand, None, error=str(e))
         return self.recorder.best()
+
+
+# --------------------------------------------------------------------------
+# subprocess trial jobs (reference tuner.py + utils.py launch/record loop)
+# --------------------------------------------------------------------------
+_METRIC_TAG = "AUTO_TUNER_METRIC="
+
+
+def get_trial_config():
+    """Inside a trial job: the candidate this process was launched with
+    (reference utils.py reads the tuner config the launcher injected)."""
+    import json
+    import os
+
+    raw = os.environ.get("PADDLE_AUTO_TUNER_CONFIG")
+    return json.loads(raw) if raw else None
+
+
+def report_metric(**metrics):
+    """Inside a trial job: emit the metric line the runner parses."""
+    import json
+
+    print(_METRIC_TAG + json.dumps(metrics), flush=True)
+
+
+class LaunchTrialRunner:
+    """Trial function that LAUNCHES each candidate as a real job through
+    `python -m paddle_tpu.distributed.launch` (the reference's subprocess
+    trial loop, tuner.py:launch + utils.py:read_metric_log) instead of an
+    in-process callable: the script reads its candidate via
+    get_trial_config(), trains, and calls report_metric(...).
+
+    A non-zero exit, a timeout, or a missing metric line raises — AutoTuner
+    records it as a failed trial and moves on."""
+
+    def __init__(self, training_script, script_args=(), nproc_per_node=1,
+                 timeout=600, log_root=None, extra_env=None):
+        self.training_script = training_script
+        self.script_args = list(script_args)
+        self.nproc_per_node = int(nproc_per_node)
+        self.timeout = timeout
+        self.log_root = log_root
+        self.extra_env = dict(extra_env or {})
+        self._trial_idx = 0
+
+    def __call__(self, cand):
+        import json
+        import os
+        import subprocess
+        import sys
+        import tempfile
+
+        self._trial_idx += 1
+        log_root = self.log_root or tempfile.mkdtemp(prefix="auto_tuner_")
+        log_dir = os.path.join(log_root, f"trial_{self._trial_idx}")
+        env = dict(os.environ)
+        env.update(self.extra_env)
+        env["PADDLE_AUTO_TUNER_CONFIG"] = json.dumps(cand)
+        cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+               "--nproc_per_node", str(self.nproc_per_node),
+               "--log_dir", log_dir,
+               self.training_script, *self.script_args]
+        proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                              timeout=self.timeout)
+        logs = ""
+        log_path = os.path.join(log_dir, "workerlog.0")
+        if os.path.exists(log_path):
+            with open(log_path) as f:
+                logs = f.read()
+        if proc.returncode != 0:
+            tail = (logs or proc.stderr or proc.stdout)[-800:]
+            raise RuntimeError(f"trial rc={proc.returncode}: {tail}")
+        for line in reversed(logs.splitlines()):
+            if line.startswith(_METRIC_TAG):
+                return json.loads(line[len(_METRIC_TAG):])
+        raise RuntimeError(
+            f"trial produced no '{_METRIC_TAG}' line in {log_path}")

@@ -16,11 +16,13 @@ training-mode) signatures, mirroring the reference's program cache keyed on inpu
 
 GRAPH-BREAK CONTRACT (differs from the reference's SOT bytecode path, jit/sot/):
 the reference's bytecode tracer falls back to eager at unsupported Python
-constructs ("graph breaks"). Here the granularity is the whole function:
-with full_graph=False, a concretization error during trace marks the function
-permanently eager (one warning, correct results, no compilation) — the
-coarse-grained analog of SOT's per-frame fallback; with full_graph=True (the
-default) the same condition is a hard error naming the offending line.
+constructs ("graph breaks"). Here the granularity is the CALL SIGNATURE:
+with full_graph=False, a concretization error during trace marks that
+(shapes, dtypes, consts, train/eval mode, grad-enabled) signature eager
+(one warning, correct results) while every other signature keeps its
+compiled program — a function whose `.item()` hides in an eval-only branch
+still trains compiled. With full_graph=True (the default) the same
+condition is a hard error naming the offending line.
 Concretely:
 
 * Python control flow on TENSOR VALUES (`if x.sum() > 0:`) does not create a
@@ -81,6 +83,16 @@ def _gather_state(layer: Layer):
     return names, tensors
 
 
+class _GraphBreak(Exception):
+    """Internal: a concretization error during trace, tagged with the call
+    signature it broke under (cause=None marks a known-broken signature)."""
+
+    def __init__(self, key, cause):
+        super().__init__("graph break")
+        self.key = key
+        self.cause = cause
+
+
 class StaticFunction:
     """A callable whose body executes as one cached XLA program per input signature."""
 
@@ -89,9 +101,14 @@ class StaticFunction:
         self._layer = layer
         self._input_spec = input_spec
         self._full_graph = full_graph
-        self._fallback = False  # graph-broken: permanently eager (SOT analog)
+        self._fallback_keys = set()  # graph-broken SIGNATURES (eager per-key)
         self._cache = {}
         functools.update_wrapper(self, function)
+
+    @property
+    def _fallback(self):
+        """True once any signature graph-broke (back-compat diagnostic)."""
+        return bool(self._fallback_keys)
 
     # -- cache key ----------------------------------------------------------
     def _mode_key(self):
@@ -161,29 +178,31 @@ class StaticFunction:
 
     # -- call ---------------------------------------------------------------
     def __call__(self, *args, **kwargs):
-        if not _TO_STATIC_STATE[0] or self._fallback:
+        if not _TO_STATIC_STATE[0]:
             return self._function(*args, **kwargs)
         try:
             return self._traced_call(*args, **kwargs)
-        except (jax.errors.ConcretizationTypeError,
-                jax.errors.TracerBoolConversionError,
-                jax.errors.TracerIntegerConversionError,
-                jax.errors.TracerArrayConversionError) as e:
+        except _GraphBreak as gb:
             # graph break: the function's Python control flow needs concrete
-            # values. With full_graph=False (the reference's SOT default) the
-            # whole call falls back to eager, permanently for this function —
-            # the coarse-grained analog of SOT's per-frame fallback.
-            if self._full_graph:
-                raise
-            import warnings
+            # values. With full_graph=False (the reference's SOT default)
+            # THIS SIGNATURE falls back to eager; other signatures (e.g. the
+            # training mode when only an eval branch concretizes) keep their
+            # compiled programs — the per-signature analog of SOT's
+            # per-frame fallback.
+            if gb.cause is not None:
+                if self._full_graph:
+                    raise gb.cause
+                import warnings
 
-            warnings.warn(
-                f"to_static: graph break in "
-                f"{getattr(self._function, '__name__', '?')} "
-                f"({type(e).__name__}); running eagerly from now on. "
-                "Use paddle.static.nn.cond / lax-style control flow, or "
-                "full_graph=True to make this an error.", stacklevel=2)
-            self._fallback = True
+                warnings.warn(
+                    f"to_static: graph break in "
+                    f"{getattr(self._function, '__name__', '?')} "
+                    f"({type(gb.cause).__name__}); running THIS signature "
+                    "eagerly from now on (other signatures stay compiled). "
+                    "Use paddle.where / lax-style control flow, or "
+                    "full_graph=True to make this an error.", stacklevel=2)
+                self._fallback_keys.add(gb.key)
+                self._cache.pop(gb.key, None)  # drop the dead jit entry
             return self._function(*args, **kwargs)
 
     def _traced_call(self, *args, **kwargs):
@@ -197,6 +216,19 @@ class StaticFunction:
         tvals = [t.value for t in t_leaves]
 
         key = self._signature(leaves, t_idx, tvals, treedef, state_tensors)
+        if key in self._fallback_keys:
+            raise _GraphBreak(key, None)  # known-broken signature -> eager
+        try:
+            return self._traced_call_keyed(key, treedef, leaves, t_idx,
+                                           t_leaves, tvals, state_tensors)
+        except (jax.errors.ConcretizationTypeError,
+                jax.errors.TracerBoolConversionError,
+                jax.errors.TracerIntegerConversionError,
+                jax.errors.TracerArrayConversionError) as e:
+            raise _GraphBreak(key, e) from e
+
+    def _traced_call_keyed(self, key, treedef, leaves, t_idx, t_leaves,
+                           tvals, state_tensors):
         if key not in self._cache:
             self._cache[key] = self._build(treedef, leaves, t_idx, state_tensors)
         jitted, out_box = self._cache[key]
