@@ -161,24 +161,26 @@ class LaunchTrialRunner:
 
     def __init__(self, training_script, script_args=(), nproc_per_node=1,
                  timeout=600, log_root=None, extra_env=None):
+        import tempfile
+
         self.training_script = training_script
         self.script_args = list(script_args)
         self.nproc_per_node = int(nproc_per_node)
         self.timeout = timeout
-        self.log_root = log_root
+        # resolved once: all trials' logs accumulate under ONE root
+        self.log_root = log_root or tempfile.mkdtemp(prefix="auto_tuner_")
         self.extra_env = dict(extra_env or {})
         self._trial_idx = 0
 
     def __call__(self, cand):
         import json
         import os
+        import signal
         import subprocess
         import sys
-        import tempfile
 
         self._trial_idx += 1
-        log_root = self.log_root or tempfile.mkdtemp(prefix="auto_tuner_")
-        log_dir = os.path.join(log_root, f"trial_{self._trial_idx}")
+        log_dir = os.path.join(self.log_root, f"trial_{self._trial_idx}")
         env = dict(os.environ)
         env.update(self.extra_env)
         env["PADDLE_AUTO_TUNER_CONFIG"] = json.dumps(cand)
@@ -186,8 +188,22 @@ class LaunchTrialRunner:
                "--nproc_per_node", str(self.nproc_per_node),
                "--log_dir", log_dir,
                self.training_script, *self.script_args]
-        proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
-                              timeout=self.timeout)
+        # own session: a timeout must kill the WHOLE trial job tree (the
+        # launcher's workers included), or a hung candidate keeps holding the
+        # devices for every later trial
+        popen = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                                 stderr=subprocess.PIPE, text=True,
+                                 start_new_session=True)
+        try:
+            out, err = popen.communicate(timeout=self.timeout)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(os.getpgid(popen.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            popen.wait()
+            raise
+        proc = subprocess.CompletedProcess(cmd, popen.returncode, out, err)
         logs = ""
         log_path = os.path.join(log_dir, "workerlog.0")
         if os.path.exists(log_path):

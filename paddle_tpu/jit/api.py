@@ -190,6 +190,9 @@ class StaticFunction:
             # compiled programs — the per-signature analog of SOT's
             # per-frame fallback.
             if gb.cause is not None:
+                # either way the entry inserted before the trace failed is
+                # dead — keep the cache truthful
+                self._cache.pop(gb.key, None)
                 if self._full_graph:
                     raise gb.cause
                 import warnings
@@ -202,7 +205,6 @@ class StaticFunction:
                     "Use paddle.where / lax-style control flow, or "
                     "full_graph=True to make this an error.", stacklevel=2)
                 self._fallback_keys.add(gb.key)
-                self._cache.pop(gb.key, None)  # drop the dead jit entry
             return self._function(*args, **kwargs)
 
     def _traced_call(self, *args, **kwargs):
