@@ -275,14 +275,19 @@ class TestParallelComposition:
         after = float((model(paddle.to_tensor(x)) ** 2).mean())
         assert after < before  # the composed step actually optimizes
 
-    def test_pp_x_sep_sequence_parallel(self):
+    @pytest.mark.parametrize("schedule_mode", [None, "ZBH1"])
+    def test_pp_x_sep_sequence_parallel(self, schedule_mode):
         """Sequence parallel (sep rides the mp axis) inside pp>1 stages must
-        reproduce the replicated sequential forward."""
+        reproduce the replicated sequential forward — under BOTH the default
+        1F1B rotation and the zb schedule's custom-VJP rotation (sequence-
+        major micro-batching on axis 1 composes with each)."""
         from paddle_tpu.models import LlamaConfig
         from paddle_tpu.models.llama import LlamaForCausalLMPipe
 
-        _init_fleet(dp=2, mp=2, pp=2,
-                    accumulate_steps=2, micro_batch_size=2, compiled=True)
+        cfg_kw = dict(accumulate_steps=2, micro_batch_size=2, compiled=True)
+        if schedule_mode:
+            cfg_kw["schedule_mode"] = schedule_mode
+        _init_fleet(dp=2, mp=2, pp=2, **cfg_kw)
         paddle.seed(0)
         cfg = LlamaConfig(
             vocab_size=64, hidden_size=32, intermediate_size=64,
@@ -293,6 +298,8 @@ class TestParallelComposition:
         pipe = LlamaForCausalLMPipe(cfg)
         model = fleet.distributed_model(pipe)
         assert model._compiled is not None
+        if schedule_mode == "ZBH1":
+            assert model._compiled._schedule == "zb"
 
         r = np.random.RandomState(0)
         ids = paddle.to_tensor(r.randint(0, 64, (4, 16)).astype("int64"))
@@ -301,6 +308,11 @@ class TestParallelComposition:
         np.testing.assert_allclose(
             np.asarray(out_mod.value), np.asarray(out_pipe.value),
             rtol=2e-5, atol=2e-5)
+        if schedule_mode == "ZBH1":
+            # the zb backward flows grads into the stacked params
+            (out_mod ** 2).mean().backward()
+            assert all(p.grad is not None
+                       for p in model._compiled._stacked_params)
 
     def test_zero_shard_fn_preserves_existing_axes(self):
         """The state-shard hook must ADD the sharding axis without wiping a
