@@ -1,0 +1,30 @@
+"""Dynamic-graph training loop: eager tensors, autograd tape, AdamW."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def main():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(16, 64), nn.GELU(), nn.Linear(64, 1))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=net.parameters())
+    r = np.random.RandomState(0)
+    x = paddle.to_tensor(r.randn(256, 16).astype("float32"))
+    w = r.randn(16, 1).astype("float32")
+    y = paddle.to_tensor(x.numpy() @ w)
+
+    for step in range(100):
+        loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if step % 25 == 0:
+            print(f"step {step:3d}  loss {float(loss):.5f}")
+    print(f"final loss {float(loss):.6f}")
+    assert float(loss) < 0.05
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""High-level API: paddle.Model.fit with callbacks on a synthetic dataset."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+class RandomDigits(paddle.io.Dataset):
+    def __init__(self, n=256):
+        r = np.random.RandomState(0)
+        self.x = r.randn(n, 1, 28, 28).astype("float32")
+        self.y = r.randint(0, 10, (n, 1)).astype("int64")
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def main():
+    paddle.seed(0)
+    net = nn.Sequential(
+        nn.Conv2D(1, 8, 3, stride=2), nn.ReLU(),
+        nn.Conv2D(8, 16, 3, stride=2), nn.ReLU(),
+        nn.Flatten(), nn.Linear(16 * 6 * 6, 10))
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.Adam(learning_rate=1e-3,
+                                        parameters=net.parameters()),
+                  nn.CrossEntropyLoss(),
+                  paddle.metric.Accuracy())
+    model.fit(RandomDigits(), epochs=1, batch_size=32, verbose=1)
+    res = model.evaluate(RandomDigits(64), batch_size=32, verbose=0)
+    print("eval:", res)
+
+
+if __name__ == "__main__":
+    main()

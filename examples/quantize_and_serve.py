@@ -1,0 +1,55 @@
+"""Serving pipeline: PTQ calibration, weight-only int8 swap, Predictor with
+AOT warmup, and KV-cache greedy decoding."""
+import os
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import inference, jit, quantization as Q
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.llama_decode import LlamaDecodeEngine
+
+
+def main():
+    paddle.seed(0)
+    # --- PTQ on a small classifier -------------------------------------
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    ptq = Q.PTQ()
+    ptq.quantize(net)
+    calib = [paddle.to_tensor(np.random.RandomState(i).randn(8, 16)
+                              .astype("float32")) for i in range(4)]
+    ptq.calibrate(net, calib)
+    print("PTQ calibrated")
+
+    # --- weight-only int8 serving swap on a LLaMA + KV-cache decode ----
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=4, max_position_embeddings=64)
+    lm = LlamaForCausalLM(cfg)
+    lm.eval()
+    n = Q.quantize_for_inference(lm, algo="weight_only_int8", min_features=32)
+    print(f"{n} Linear layers -> WeightOnlyLinear")
+    eng = LlamaDecodeEngine(lm, max_len=48)
+    prompt = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, 128, (1, 8)).astype("int64"))
+    tokens = eng.generate(prompt, max_new_tokens=16)
+    print("decoded:", np.asarray(tokens)[0].tolist())
+
+    # --- Predictor over a saved artifact with declared-shape warmup ----
+    d = tempfile.mkdtemp()
+    prefix = os.path.join(d, "clf")
+    paddle.seed(0)
+    clf = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    jit.save(clf, prefix,
+             input_spec=[paddle.static.InputSpec([None, 16], "float32")])
+    conf = inference.Config(prefix)
+    conf.exp_set_warmup_shapes([(1, 16), (8, 16)])
+    pred = inference.create_predictor(conf)
+    out = pred.run([np.ones((8, 16), "float32")])
+    print("predictor output:", out[0].shape)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+"""The reference's legacy static-graph idiom, running on the capture-replay
+Program/Executor: build under program_guard, train via Executor.run, fetch
+the loss by name."""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def main():
+    paddle.enable_static()
+    paddle.seed(0)
+    main_prog = paddle.static.Program()
+    startup = paddle.static.Program()
+    with paddle.static.program_guard(main_prog, startup):
+        x = paddle.static.data(name="x", shape=[None, 64], dtype="float32")
+        y = paddle.static.data(name="y", shape=[None, 1], dtype="int64")
+        net = paddle.nn.Sequential(
+            paddle.nn.Linear(64, 128), paddle.nn.ReLU(),
+            paddle.nn.Linear(128, 10))
+        loss = paddle.nn.functional.cross_entropy(net(x), y)
+        loss.name = "loss"
+        opt = paddle.optimizer.SGD(learning_rate=0.5,
+                                   parameters=net.parameters())
+        opt.minimize(loss)
+
+    exe = paddle.static.Executor()
+    exe.run(startup)
+    r = np.random.RandomState(0)
+    xb = r.randn(128, 64).astype("float32")
+    yb = r.randint(0, 10, (128, 1)).astype("int64")
+    for epoch in range(10):
+        (lv,) = exe.run(main_prog, feed={"x": xb, "y": yb},
+                        fetch_list=["loss"])
+    print(f"final loss {float(lv):.4f}")
+    paddle.disable_static()
+
+
+if __name__ == "__main__":
+    main()

@@ -5,6 +5,10 @@ Only the names ported code most commonly touches are provided; everything maps
 onto the TPU build's real implementations (static capture-replay Program /
 Executor, framework core, dygraph helpers)."""
 from ..framework import core  # noqa: F401
+from ..framework.containers import (  # noqa: F401
+    SelectedRows,
+    StringTensor,
+)
 from ..framework.core import Tensor  # noqa: F401
 from ..static import (  # noqa: F401
     CompiledProgram,

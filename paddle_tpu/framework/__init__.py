@@ -1,3 +1,5 @@
 """paddle_tpu.framework — core runtime (tensor handle, dtypes, flags, RNG)."""
 from . import dtype, enforce, flags, random  # noqa: F401
 from .core import Parameter, Tensor, to_tensor  # noqa: F401
+
+from .containers import SelectedRows, StringTensor  # noqa: F401,E402

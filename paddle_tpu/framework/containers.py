@@ -1,0 +1,105 @@
+"""SelectedRows and StringTensor landing pads.
+
+Reference analogs: paddle/phi/core/selected_rows.h (sparse-gradient container:
+a {rows, value, height} triple produced by sparse embedding backward) and
+paddle/phi/core/string_tensor.h (variable-length string tensor feeding the
+tokenizer ops).
+
+TPU-first: gradients here are dense global arrays (XLA scatters embedding
+grads itself), so SelectedRows exists for reference-portable code that
+constructs/consumes the container explicitly — it holds the same triple and
+densifies on demand. StringTensor wraps a numpy object array; string data
+lives host-side (tokenization is host preprocessing on TPU pipelines).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .core import Tensor
+
+__all__ = ["SelectedRows", "StringTensor"]
+
+
+class SelectedRows:
+    """{height, rows, value}: rows[i] is the dense row index of value[i]."""
+
+    def __init__(self, rows=None, height=0, value=None):
+        self._rows = list(int(r) for r in (rows or []))
+        self._height = int(height)
+        self._value = value
+
+    # -- reference accessor surface (selected_rows.h) -----------------------
+    def rows(self):
+        return list(self._rows)
+
+    def set_rows(self, rows):
+        self._rows = [int(r) for r in rows]
+
+    def height(self):
+        return self._height
+
+    def set_height(self, h):
+        self._height = int(h)
+
+    def get_tensor(self):
+        return self._value
+
+    def set_tensor(self, value):
+        self._value = value
+
+    def sync_index(self):
+        pass  # the id->offset map is rebuilt on every to_dense here
+
+    def to_dense(self):
+        """Densify: duplicate row ids accumulate (the reference's
+        MergeAdd + scatter semantics for sparse gradients)."""
+        if self._value is None:
+            raise ValueError("SelectedRows has no value tensor")
+        if self._rows and max(self._rows) >= self._height:
+            # JAX scatter would silently DROP out-of-range updates; the
+            # reference contract (rows[i] < height) must fail loudly
+            raise ValueError(
+                f"SelectedRows row {max(self._rows)} out of range for "
+                f"height {self._height}")
+        v = self._value.value if isinstance(self._value, Tensor) \
+            else jnp.asarray(self._value)
+        out = jnp.zeros((self._height,) + tuple(v.shape[1:]), v.dtype)
+        idx = jnp.asarray(np.asarray(self._rows, np.int64))
+        return Tensor(out.at[idx].add(v))
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self._height}, "
+                f"rows={self._rows}, value_shape="
+                f"{getattr(self._value, 'shape', None)})")
+
+
+class StringTensor:
+    """Variable-length string tensor (string_tensor.h): numpy object storage
+    with the tensor-like surface tokenizer-adjacent code expects."""
+
+    def __init__(self, data=None, name=""):
+        arr = np.asarray(data if data is not None else [], dtype=object)
+        self._data = arr
+        self.name = name
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    def numpy(self):
+        return self._data
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        return out if isinstance(out, str) else StringTensor(out, self.name)
+
+    def __len__(self):
+        return len(self._data)
+
+    def __iter__(self):
+        return iter(self._data.ravel())
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, data={self._data!r})"

@@ -60,16 +60,28 @@ class LlamaDecodeEngine:
         self.eps = cfg.rms_norm_eps
         self.theta = cfg.rope_theta
 
+        def _w(layer):
+            """Dense weight of a Linear OR a WeightOnlyLinear (dequantized
+            once at engine build; the per-step bandwidth saving of the int8
+            form belongs to the weight_only_linear op path)."""
+            if hasattr(layer, "weight"):
+                return layer.weight.value
+            from ..quantization.weight_only import weight_dequantize
+
+            return weight_dequantize(layer.quant_weight, layer.weight_scale,
+                                     algo=layer.algo,
+                                     k=layer.in_features).value
+
         self.layers = []
         for lyr in model.llama.layers:
             a, m = lyr.self_attn, lyr.mlp
             self.layers.append(dict(
                 ln1=lyr.input_layernorm.weight.value,
                 ln2=lyr.post_attention_layernorm.weight.value,
-                wq=a.q_proj.weight.value, wk=a.k_proj.weight.value,
-                wv=a.v_proj.weight.value, wo=a.o_proj.weight.value,
-                gate=m.gate_proj.weight.value, up=m.up_proj.weight.value,
-                down=m.down_proj.weight.value))
+                wq=_w(a.q_proj), wk=_w(a.k_proj),
+                wv=_w(a.v_proj), wo=_w(a.o_proj),
+                gate=_w(m.gate_proj), up=_w(m.up_proj),
+                down=_w(m.down_proj)))
         self.emb = model.llama.embed_tokens.weight.value
         self.norm_w = model.llama.norm.weight.value
         head = model.lm_head

@@ -1,0 +1,68 @@
+"""Every example script must run end-to-end (the switching user's first
+touch of the framework; reference keeps its demos green the same way)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EX = os.path.join(REPO, "examples")
+
+
+def _run(script, extra_env=None, timeout=300):
+    env = dict(os.environ)
+    env["PADDLE_TPU_PLATFORM"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra_env or {})
+    proc = subprocess.run([sys.executable, os.path.join(EX, script)],
+                          env=env, capture_output=True, text=True,
+                          timeout=timeout, cwd=REPO)
+    assert proc.returncode == 0, f"{script}:\n{proc.stdout}\n{proc.stderr}"
+    return proc.stdout
+
+
+def test_eager_train():
+    out = _run("eager_train.py")
+    assert "final loss" in out
+
+
+def test_mnist_hapi():
+    out = _run("mnist_hapi.py")
+    assert "eval:" in out
+
+
+def test_static_mnist():
+    out = _run("static_mnist.py")
+    assert "final loss" in out
+
+
+def test_jit_to_static():
+    out = _run("jit_to_static.py")
+    assert "reloaded output shape" in out
+
+
+def test_llama_pretrain_hybrid():
+    out = _run("llama_pretrain_hybrid.py",
+               {"XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
+               timeout=420)
+    assert "step 2" in out
+
+
+def test_quantize_and_serve():
+    out = _run("quantize_and_serve.py")
+    assert "decoded:" in out and "predictor output" in out
+
+
+def test_launch_dp_under_launcher():
+    env = dict(os.environ)
+    env["PADDLE_TPU_PLATFORM"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", os.path.join(EX, "launch_dp.py")],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
