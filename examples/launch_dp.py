@@ -1,49 +1,45 @@
-"""Data-parallel training under the process launcher.
+"""Data-parallel training under the process launcher — the framework way.
 
     PADDLE_TPU_PLATFORM=cpu XLA_FLAGS=--xla_force_host_platform_device_count=4 \
         python -m paddle_tpu.distributed.launch --nproc_per_node 2 \
         examples/launch_dp.py
 
 Each of the 2 processes owns 4 virtual devices; init_parallel_env builds the
-8-device global mesh and the dp-sharded batch trains with one fused
-all-reduce per gradient, emitted by XLA from the shardings alone.
-(Run directly — no launcher — it trains single-process on all local devices.)
+8-device global runtime, paddle.DataParallel replicates the parameters over
+the dp mesh and shards the batch, dist.to_static compiles the WHOLE train
+step (fwd + bwd + SGD) into one GSPMD program — XLA emits one fused
+all-reduce per gradient from the shardings alone — and the loop just calls
+it. (Run directly — no launcher — it trains single-process on all local
+devices.)
 """
 import numpy as np
 
 import paddle_tpu as paddle
 import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
 
 
 def main():
     dist.init_parallel_env()
-    import jax
-    import jax.numpy as jnp
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    rank, nranks = dist.get_rank(), dist.get_world_size()
 
-    mesh = Mesh(np.array(jax.devices()), ("dp",))
-    rows, rep = NamedSharding(mesh, P("dp")), NamedSharding(mesh, P())
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 1))
+    model = paddle.DataParallel(net)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    dm = dist.to_static(model, loss=nn.MSELoss(), optimizer=opt)
+    dm.train()
+
     r = np.random.RandomState(0)
     X = r.randn(32, 8).astype("float32")
-    Y = X @ r.randn(8, 1).astype("float32")
-    nproc, rank = jax.process_count(), jax.process_index()
-    per = 32 // nproc
-    local = slice(rank * per, (rank + 1) * per)
-    Xg = jax.make_array_from_process_local_data(rows, X[local], X.shape)
-    Yg = jax.make_array_from_process_local_data(rows, Y[local], Y.shape)
+    Y = (X @ r.randn(8, 1)).astype("float32")
+    x, y = model.scatter_batch(paddle.to_tensor(X), paddle.to_tensor(Y))
 
-    def step(w, x, y):
-        loss, g = jax.value_and_grad(
-            lambda w: jnp.mean((x @ w - y) ** 2))(w)
-        return w - 0.1 * g, loss
-
-    stepc = jax.jit(step, in_shardings=(rep, rows, rows),
-                    out_shardings=(rep, rep))
-    w = jax.device_put(jnp.zeros((8, 1)), rep)
-    for i in range(150):
-        w, loss = stepc(w, Xg, Yg)
-        jax.block_until_ready(loss)
-    print(f"rank {rank}: final loss {float(loss):.2e}")
+    for step in range(200):
+        loss = dm(x, y)   # ONE compiled program: fwd + bwd + SGD update
+    print(f"rank {rank}/{nranks}: final loss {float(loss):.2e}")
+    assert float(loss) < 1e-2
 
 
 if __name__ == "__main__":

@@ -26,7 +26,8 @@ class SelectedRows:
     """{height, rows, value}: rows[i] is the dense row index of value[i]."""
 
     def __init__(self, rows=None, height=0, value=None):
-        self._rows = list(int(r) for r in (rows or []))
+        # NOT `rows or []`: numpy arrays are ambiguous/falsy-for-[0] there
+        self._rows = [int(r) for r in (rows if rows is not None else [])]
         self._height = int(height)
         self._value = value
 
@@ -57,12 +58,14 @@ class SelectedRows:
         MergeAdd + scatter semantics for sparse gradients)."""
         if self._value is None:
             raise ValueError("SelectedRows has no value tensor")
-        if self._rows and max(self._rows) >= self._height:
-            # JAX scatter would silently DROP out-of-range updates; the
-            # reference contract (rows[i] < height) must fail loudly
+        if self._rows and not (0 <= min(self._rows)
+                               and max(self._rows) < self._height):
+            # JAX scatter would silently DROP too-large rows and WRAP negative
+            # ones; the reference contract (0 <= rows[i] < height) must fail
+            # loudly
             raise ValueError(
-                f"SelectedRows row {max(self._rows)} out of range for "
-                f"height {self._height}")
+                f"SelectedRows rows {min(self._rows)}..{max(self._rows)} out "
+                f"of range for height {self._height}")
         v = self._value.value if isinstance(self._value, Tensor) \
             else jnp.asarray(self._value)
         out = jnp.zeros((self._height,) + tuple(v.shape[1:]), v.dtype)

@@ -1,1 +1,8 @@
 from . import functional  # noqa: F401
+from .layer_fused import (  # noqa: F401,E402
+    FusedFeedForward,
+    FusedLinear,
+    FusedMultiHeadAttention,
+    FusedMultiTransformer,
+    FusedTransformerEncoderLayer,
+)

@@ -1,0 +1,111 @@
+"""incubate.nn fused transformer layer classes (reference
+incubate/nn/layer/fused_transformer.py): numerics vs manual composition,
+pre/post-LN variants, training, and the multi-layer stack."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.incubate import nn as inn
+
+
+def _np_ln(x, g, b, eps=1e-5):
+    m = x.mean(-1, keepdims=True)
+    v = x.var(-1, keepdims=True)
+    return (x - m) / np.sqrt(v + eps) * g + b
+
+
+class TestFusedMultiHeadAttention:
+    @pytest.mark.parametrize("pre_ln", [False, True])
+    def test_matches_manual_composition(self, pre_ln):
+        paddle.seed(0)
+        E, H, B, S = 16, 4, 2, 6
+        attn = inn.FusedMultiHeadAttention(
+            E, H, dropout_rate=0.0, attn_dropout_rate=0.0,
+            normalize_before=pre_ln)
+        attn.eval()
+        r = np.random.RandomState(0)
+        x = r.randn(B, S, E).astype("float32")
+        out = attn(paddle.to_tensor(x)).numpy()
+
+        # manual: (pre-LN) -> packed qkv -> sdpa -> proj -> +residual -> (post-LN)
+        h = _np_ln(x, attn.pre_ln_scale.numpy(), attn.pre_ln_bias.numpy()) \
+            if pre_ln else x
+        w = attn.qkv_weight.numpy().reshape(3 * E, E)
+        bias = attn.qkv_bias.numpy().reshape(3 * E)
+        qkv = (h @ w.T + bias).reshape(B, S, 3, H, E // H)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        qt = q.transpose(0, 2, 1, 3)
+        kt = k.transpose(0, 2, 1, 3)
+        vt = v.transpose(0, 2, 1, 3)
+        logits = qt @ kt.transpose(0, 1, 3, 2) / np.sqrt(E // H)
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        a = (p @ vt).transpose(0, 2, 1, 3).reshape(B, S, E)
+        proj = a @ attn.linear_weight.numpy() + attn.linear_bias.numpy()
+        want = x + proj
+        if not pre_ln:
+            want = _np_ln(want, attn.ln_scale.numpy(), attn.ln_bias.numpy())
+        np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+    def test_need_weights_rejected(self):
+        with pytest.raises(NotImplementedError):
+            inn.FusedMultiHeadAttention(8, 2, need_weights=True)
+
+
+class TestFusedFeedForward:
+    def test_matches_manual(self):
+        paddle.seed(0)
+        ffn = inn.FusedFeedForward(8, 32, dropout_rate=0.0,
+                                   act_dropout_rate=0.0, activation="relu")
+        ffn.eval()
+        r = np.random.RandomState(1)
+        x = r.randn(2, 5, 8).astype("float32")
+        out = ffn(paddle.to_tensor(x)).numpy()
+        h = np.maximum(x @ ffn.linear1.weight.numpy()
+                       + ffn.linear1.bias.numpy(), 0.0)
+        want = x + (h @ ffn.linear2.weight.numpy()
+                    + ffn.linear2.bias.numpy())
+        want = _np_ln(want, ffn.ln2_scale.numpy(), ffn.ln2_bias.numpy())
+        np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+class TestFusedEncoderAndStack:
+    def test_encoder_layer_trains(self):
+        paddle.seed(0)
+        layer = inn.FusedTransformerEncoderLayer(
+            d_model=16, nhead=4, dim_feedforward=32, dropout_rate=0.0)
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=layer.parameters())
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 6, 16).astype("float32"))
+        first = None
+        for _ in range(8):
+            loss = (layer(x) ** 2).mean()
+            first = first or float(loss)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float(loss) < first
+
+    def test_multi_transformer_stack(self):
+        paddle.seed(0)
+        stack = inn.FusedMultiTransformer(16, 4, 32, num_layers=3)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 4, 16).astype("float32"))
+        out = stack(x)
+        assert tuple(out.shape) == (2, 4, 16)
+        assert len(stack.layers) == 3
+        with pytest.raises(NotImplementedError):
+            inn.FusedMultiTransformer(16, 4, 32, normalize_before=False)
+
+    def test_fused_linear_transpose_weight(self):
+        paddle.seed(0)
+        lin = inn.FusedLinear(8, 4, transpose_weight=True)
+        assert tuple(lin.weight.shape) == (4, 8)
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(3, 8).astype("float32"))
+        np.testing.assert_allclose(
+            lin(x).numpy(),
+            x.numpy() @ lin.weight.numpy().T + lin.bias.numpy(), rtol=1e-5)
