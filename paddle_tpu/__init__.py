@@ -290,3 +290,38 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
 
     return count_flops(net, input_size, custom_ops=custom_ops,
                        print_detail=print_detail)
+
+# last reference top-level __all__ stragglers (python/paddle/__init__.py)
+from .nn.initializer import ParamAttr  # noqa: F401,E402
+from .ops import (  # noqa: F401,E402
+    addmm_, index_add_, index_fill_, index_put_, renorm_,
+)
+
+# string/raw dtype sentinels (framework/dtype.py pstring/raw; tokenizer and
+# extension-op surfaces reference them — see framework/containers.StringTensor)
+pstring = "pstring"
+raw = "raw"
+
+
+def check_shape(shape):
+    """utils/layers_utils.py:483 check_shape: validate a fill_constant shape
+    (same check ORDER as the reference: negative -> ValueError first, then
+    non-integer -> TypeError; bool passes as int there and here)."""
+    from .framework.core import Tensor as _T
+
+    if isinstance(shape, _T):
+        return
+    if isinstance(shape, (list, tuple)):
+        for ele in shape:
+            if isinstance(ele, _T):
+                continue
+            import numpy as _np
+
+            if ele < 0:
+                raise ValueError(
+                    "All elements in ``shape`` must be positive when it's "
+                    "a list or tuple")
+            if not isinstance(ele, (int, _np.integer)):
+                raise TypeError(
+                    "All elements in ``shape`` must be integers when it's "
+                    "a list or tuple")

@@ -60,6 +60,10 @@ class FusedMultiHeadAttention(Layer):
         if need_weights:
             raise NotImplementedError("need_weights=True is not supported "
                                       "(matches the reference)")
+        if transpose_qkv_wb:
+            raise NotImplementedError(
+                "transpose_qkv_wb=True ([hidden, 3*hidden] qkv layout) is not "
+                "implemented; the packed [3, H, D, E] layout is")
         self.embed_dim = embed_dim
         self.num_heads = num_heads
         self.head_dim = embed_dim // num_heads
@@ -192,14 +196,20 @@ class FusedTransformerEncoderLayer(Layer):
         super().__init__()
         attn_dropout_rate = dropout_rate if attn_dropout_rate is None \
             else attn_dropout_rate
+        # the reference routes weight_attrs/bias_attrs into both sublayers;
+        # a single attr here applies to every weight/bias respectively
         self.fused_attn = FusedMultiHeadAttention(
             d_model, nhead, dropout_rate=dropout_rate,
             attn_dropout_rate=attn_dropout_rate,
-            normalize_before=normalize_before)
+            normalize_before=normalize_before,
+            qkv_weight_attr=weight_attr, qkv_bias_attr=bias_attr,
+            linear_weight_attr=weight_attr, linear_bias_attr=bias_attr)
         self.ffn = FusedFeedForward(
             d_model, dim_feedforward, dropout_rate=dropout_rate,
             activation=activation, act_dropout_rate=act_dropout_rate,
-            normalize_before=normalize_before)
+            normalize_before=normalize_before,
+            linear1_weight_attr=weight_attr, linear1_bias_attr=bias_attr,
+            linear2_weight_attr=weight_attr, linear2_bias_attr=bias_attr)
 
     def forward(self, src, src_mask=None, cache=None):
         return self.ffn(self.fused_attn(src, attn_mask=src_mask))

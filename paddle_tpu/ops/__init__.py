@@ -234,3 +234,17 @@ del _name
 from ..tensor_array import (  # noqa: F401,E402
     array_length, array_read, array_write, create_array,
 )
+
+# remaining reference top-level __all__ stragglers (python/paddle/__init__.py)
+# — the ONE guarded inplace helper (math.py keeps stop_gradient monotone)
+from .math import _make_inplace as _mk_inplace  # noqa: E402
+
+addmm_ = _mk_inplace(addmm)
+renorm_ = _mk_inplace(renorm)
+index_add_ = _mk_inplace(index_add)
+index_put_ = _mk_inplace(index_put)
+index_fill_ = _mk_inplace(index_fill)
+for _n in ("addmm_", "renorm_", "index_add_", "index_put_", "index_fill_"):
+    if not hasattr(Tensor, _n):
+        setattr(Tensor, _n, globals()[_n])
+del _n
