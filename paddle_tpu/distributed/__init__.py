@@ -6,9 +6,15 @@ fleet's manual hybrid parallelism is expressed as mesh-axis shardings.
 """
 from .placement import DistAttr, Partial, Placement, Replicate, Shard  # noqa: F401
 from .process_mesh import ProcessMesh, auto_mesh, get_current_mesh  # noqa: F401
+from . import stream  # noqa: F401
 from .collective import (  # noqa: F401
     Group,
+    P2POp,
     ReduceOp,
+    batch_isend_irecv,
+    irecv,
+    isend,
+    scatter_object_list,
     all_gather,
     all_gather_concat,
     all_gather_object,

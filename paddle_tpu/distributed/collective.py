@@ -429,3 +429,63 @@ def get_backend(group=None):
     except RuntimeError:
         platform = "cpu"
     return {"tpu": "XLA_ICI", "gpu": "NCCL"}.get(platform, "GLOO")
+
+
+def isend(tensor, dst=0, group=None):
+    """communication/send.py isend: async send returning a waitable Task."""
+    return send(tensor, dst=dst, group=group, sync_op=False)
+
+
+def irecv(tensor, src=0, group=None):
+    """communication/recv.py irecv: async recv returning a waitable Task."""
+    return recv(tensor, src=src, group=group, sync_op=False)
+
+
+class P2POp:
+    """communication/batch_isend_irecv.py P2POp: one queued p2p operation."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        if op not in (isend, irecv, send, recv):
+            raise ValueError(
+                "op must be paddle.distributed.isend or paddle.distributed."
+                "irecv")
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    """communication/batch_isend_irecv.py: run queued p2p ops; sends first so
+    the single-controller channel is populated before the matching recvs."""
+    if not p2p_op_list:
+        return []
+    if not all(isinstance(p, P2POp) for p in p2p_op_list):
+        raise ValueError("batch_isend_irecv expects a list of P2POp")
+    # execute sends before recvs (the single-controller channel must be
+    # populated first) but return tasks in INPUT order — the reference
+    # contract is tasks[i] pairs with p2p_op_list[i]
+    tasks = [None] * len(p2p_op_list)
+    send_first = sorted(range(len(p2p_op_list)),
+                        key=lambda i: p2p_op_list[i].op in (irecv, recv))
+    for i in send_first:
+        p = p2p_op_list[i]
+        t = p.op(p.tensor, p.peer, group=p.group)
+        tasks[i] = t if isinstance(t, _Task) else _Task()
+    return tasks
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """communication/scatter.py scatter_object_list: host-side object scatter
+    (single-controller: rank src's list is authoritative)."""
+    group = _resolve_group(group)
+    rank = _CURRENT_P2P_RANK[0]
+    key = ("scatter", id(group))
+    if rank == src and in_object_list is not None:
+        # only the src rank's list is authoritative (reference contract);
+        # other ranks' in_object_list args are ignored
+        _OBJECT_STORE[key] = list(in_object_list)
+    data = _OBJECT_STORE.get(key, list(in_object_list or []))
+    idx = group.get_group_rank(rank) if rank in group.ranks else 0
+    out_object_list[:] = [data[idx]] if data else []

@@ -1,0 +1,44 @@
+"""paddle.distributed.stream: stream-variant collectives.
+
+Reference analog: python/paddle/distributed/communication/stream/ — the same
+verbs with use_calc_stream control (run on the compute stream instead of the
+comm stream). XLA owns stream assignment on TPU, so these delegate to the
+eager collectives; `use_calc_stream=True` additionally blocks on the result
+(calc-stream semantics: the value is ready for the next compute op).
+"""
+from __future__ import annotations
+
+from . import collective as _c
+
+
+def _wrap(name):
+    fn = getattr(_c, name)
+
+    def stream_fn(*args, use_calc_stream=False, **kwargs):
+        sync = kwargs.pop("sync_op", not use_calc_stream)
+        out = fn(*args, sync_op=sync, **kwargs)
+        if use_calc_stream:
+            import jax
+
+            jax.block_until_ready(jax.live_arrays())
+        return out
+
+    stream_fn.__name__ = name
+    stream_fn.__doc__ = f"stream/{name}.py: {name} with use_calc_stream."
+    return stream_fn
+
+
+all_reduce = _wrap("all_reduce")
+all_gather = _wrap("all_gather")
+reduce = _wrap("reduce")
+reduce_scatter = _wrap("reduce_scatter")
+broadcast = _wrap("broadcast")
+scatter = _wrap("scatter")
+alltoall = _wrap("alltoall")
+alltoall_single = _wrap("alltoall_single")
+send = _wrap("send")
+recv = _wrap("recv")
+
+__all__ = ["all_reduce", "all_gather", "reduce", "reduce_scatter",
+           "broadcast", "scatter", "alltoall", "alltoall_single", "send",
+           "recv"]

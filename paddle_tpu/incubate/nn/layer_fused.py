@@ -196,20 +196,32 @@ class FusedTransformerEncoderLayer(Layer):
         super().__init__()
         attn_dropout_rate = dropout_rate if attn_dropout_rate is None \
             else attn_dropout_rate
-        # the reference routes weight_attrs/bias_attrs into both sublayers;
-        # a single attr here applies to every weight/bias respectively
+        # the reference's _convert_param_attr_to_list(attr, 2) contract:
+        # a 2-list routes [0] to attention, [1] to the FFN; a single attr
+        # applies to both
+        def _pair(attr):
+            if isinstance(attr, (list, tuple)):
+                if len(attr) != 2:
+                    raise ValueError(
+                        "weight_attr/bias_attr lists must have 2 entries "
+                        "(attention, ffn)")
+                return attr[0], attr[1]
+            return attr, attr
+
+        w_attn, w_ffn = _pair(weight_attr)
+        b_attn, b_ffn = _pair(bias_attr)
         self.fused_attn = FusedMultiHeadAttention(
             d_model, nhead, dropout_rate=dropout_rate,
             attn_dropout_rate=attn_dropout_rate,
             normalize_before=normalize_before,
-            qkv_weight_attr=weight_attr, qkv_bias_attr=bias_attr,
-            linear_weight_attr=weight_attr, linear_bias_attr=bias_attr)
+            qkv_weight_attr=w_attn, qkv_bias_attr=b_attn,
+            linear_weight_attr=w_attn, linear_bias_attr=b_attn)
         self.ffn = FusedFeedForward(
             d_model, dim_feedforward, dropout_rate=dropout_rate,
             activation=activation, act_dropout_rate=act_dropout_rate,
             normalize_before=normalize_before,
-            linear1_weight_attr=weight_attr, linear1_bias_attr=bias_attr,
-            linear2_weight_attr=weight_attr, linear2_bias_attr=bias_attr)
+            linear1_weight_attr=w_ffn, linear1_bias_attr=b_ffn,
+            linear2_weight_attr=w_ffn, linear2_bias_attr=b_ffn)
 
     def forward(self, src, src_mask=None, cache=None):
         return self.ffn(self.fused_attn(src, attn_mask=src_mask))

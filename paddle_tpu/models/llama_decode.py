@@ -173,9 +173,32 @@ class LlamaDecodeEngine:
         return self._step_jit(jnp.asarray(token, jnp.int32), cache,
                               jnp.asarray(pos, jnp.int32))
 
-    def generate(self, input_ids, max_new_tokens=32):
-        """Greedy decode with the cache: O(S + T) attention work per token
-        instead of generate()'s O((S+T)^2) prefix recompute."""
+    def _select(self, logits, temperature, top_k, top_p, key):
+        """Greedy (temperature 0) or temperature/top-k/top-p sampling —
+        the generation config surface of the reference's generate stack."""
+        if not temperature:
+            return jnp.argmax(logits, -1).astype(jnp.int32)[:, None]
+        logits = logits.astype(jnp.float32) / float(temperature)
+        if top_k:
+            kth = jax.lax.top_k(logits, int(top_k))[0][:, -1:]
+            logits = jnp.where(logits < kth, -1e30, logits)
+        if top_p is not None and top_p < 1.0:
+            sort = jnp.sort(logits, axis=-1)[:, ::-1]
+            probs = jax.nn.softmax(sort, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            # smallest set whose mass >= top_p: cutoff at the first crossing
+            mask_sorted = cum - probs < top_p
+            kth = jnp.where(mask_sorted, sort, jnp.inf).min(
+                axis=-1, keepdims=True)
+            logits = jnp.where(logits < kth, -1e30, logits)
+        tok = jax.random.categorical(key, logits, axis=-1)
+        return tok.astype(jnp.int32)[:, None]
+
+    def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
+                 top_k=0, top_p=1.0, seed=0):
+        """Decode with the cache: O(S + T) attention work per token instead of
+        generate()'s O((S+T)^2) prefix recompute. temperature=0 is greedy;
+        otherwise temperature/top-k/top-p sampling."""
         ids = getattr(input_ids, "value", input_ids)
         need = int(ids.shape[1]) + int(max_new_tokens)
         if need > self.max_len:
@@ -185,10 +208,13 @@ class LlamaDecodeEngine:
         if max_new_tokens <= 0:
             ids2 = jnp.asarray(ids, jnp.int32)
             return ids2[:, :0]
+        key = jax.random.PRNGKey(seed)
         logits, cache, pos = self.prefill(input_ids)
-        out = [jnp.argmax(logits, -1).astype(jnp.int32)[:, None]]
+        key, sub = jax.random.split(key)
+        out = [self._select(logits, temperature, top_k, top_p, sub)]
         for _ in range(max_new_tokens - 1):
             logits, cache = self.decode_step(out[-1], cache, pos)
             pos += 1
-            out.append(jnp.argmax(logits, -1).astype(jnp.int32)[:, None])
+            key, sub = jax.random.split(key)
+            out.append(self._select(logits, temperature, top_k, top_p, sub))
         return jnp.concatenate(out, axis=1)

@@ -86,3 +86,65 @@ class TestPredictorWarmup:
         assert pred._warmed_shapes == [(1, 8), (4, 8)]
         out = pred.run([np.ones((4, 8), "float32")])
         assert out[0].shape == (4, 4)
+
+
+class TestSamplingDecode:
+    def test_temperature_topk_topp_sampling(self):
+        model, cfg = _model()
+        eng = LlamaDecodeEngine(model, max_len=32)
+        ids = np.random.RandomState(0).randint(0, 64, (2, 4)).astype("int64")
+        a = np.asarray(eng.generate(ids, max_new_tokens=6, temperature=0.8,
+                                    top_k=10, top_p=0.9, seed=1))
+        b = np.asarray(eng.generate(ids, max_new_tokens=6, temperature=0.8,
+                                    top_k=10, top_p=0.9, seed=1))
+        c = np.asarray(eng.generate(ids, max_new_tokens=6, temperature=0.8,
+                                    top_k=10, top_p=0.9, seed=2))
+        assert a.shape == (2, 6)
+        np.testing.assert_array_equal(a, b)       # same seed, same draw
+        assert (a != c).any()                     # different seed differs
+        assert (a >= 0).all() and (a < 64).all()
+
+    def test_top_k_one_equals_greedy(self):
+        model, _ = _model()
+        eng = LlamaDecodeEngine(model, max_len=32)
+        ids = np.random.RandomState(1).randint(0, 64, (1, 4)).astype("int64")
+        greedy = np.asarray(eng.generate(ids, max_new_tokens=5))
+        topk1 = np.asarray(eng.generate(ids, max_new_tokens=5,
+                                        temperature=1.0, top_k=1))
+        np.testing.assert_array_equal(greedy, topk1)
+
+
+class TestBatchP2PAndStream:
+    def test_batch_isend_irecv_roundtrip(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.collective import p2p_rank
+
+        t = paddle.to_tensor(np.arange(4, dtype="float32"))
+        out = paddle.zeros([4])
+        with p2p_rank(0):
+            tasks = dist.batch_isend_irecv([dist.P2POp(dist.isend, t, 1)])
+        with p2p_rank(1):
+            tasks += dist.batch_isend_irecv([dist.P2POp(dist.irecv, out, 0)])
+        for tk in tasks:
+            tk.wait()
+        np.testing.assert_allclose(out.numpy(), t.numpy())
+
+    def test_p2pop_validates_op(self):
+        import paddle_tpu.distributed as dist
+
+        with pytest.raises(ValueError):
+            dist.P2POp(print, paddle.zeros([1]), 0)
+
+    def test_stream_namespace(self):
+        import paddle_tpu.distributed as dist
+
+        x = paddle.to_tensor(np.ones(8, "float32"))
+        dist.stream.all_reduce(x, use_calc_stream=True)
+        assert np.isfinite(x.numpy()).all()
+
+    def test_scatter_object_list(self):
+        import paddle_tpu.distributed as dist
+
+        objs = [None]
+        dist.scatter_object_list(objs, [{"k": 7}], src=0)
+        assert objs == [{"k": 7}]
