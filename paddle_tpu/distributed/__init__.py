@@ -6,7 +6,33 @@ fleet's manual hybrid parallelism is expressed as mesh-axis shardings.
 """
 from .placement import DistAttr, Partial, Placement, Replicate, Shard  # noqa: F401
 from .process_mesh import ProcessMesh, auto_mesh, get_current_mesh  # noqa: F401
+from . import io  # noqa: F401
 from . import stream  # noqa: F401
+from .fleet_dataset import (  # noqa: F401
+    CountFilterEntry,
+    InMemoryDataset,
+    ProbabilityEntry,
+    QueueDataset,
+    ShowClickEntry,
+)
+from .parallelize import (  # noqa: F401
+    ColWiseParallel,
+    LocalLayer,
+    PrepareLayerInput,
+    PrepareLayerOutput,
+    RowWiseParallel,
+    SequenceParallelBegin,
+    SequenceParallelDisable,
+    SequenceParallelEnable,
+    SequenceParallelEnd,
+    SplitPoint,
+    get_mesh,
+    is_available,
+    parallelize,
+    set_mesh,
+    spawn,
+    to_distributed,
+)
 from .collective import (  # noqa: F401
     Group,
     P2POp,
@@ -121,13 +147,6 @@ class ReduceType:
     kRedAvg = 4
     kRedAny = 5
     kRedAll = 6
-
-
-def get_mesh():
-    """auto_parallel api.get_mesh: the globally set process mesh."""
-    from .process_mesh import get_current_mesh
-
-    return get_current_mesh()
 
 
 def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):

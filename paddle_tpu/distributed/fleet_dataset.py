@@ -1,0 +1,149 @@
+"""Fleet dataset surface (PS-style file-fed datasets) + dist IO module.
+
+Reference analogs: python/paddle/distributed/fleet/dataset/dataset.py
+(InMemoryDataset :388, QueueDataset :1200, the sparse-feature Entry configs)
+and python/paddle/distributed/io.py. The reference's datasets stream
+example-format files through a C++ DataFeed into PS trainers; here they are
+host-side file readers with the same configuration surface — batches feed
+the eager/compiled trainers, and the Entry classes carry their accessor
+configs for the PS sparse tables.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["InMemoryDataset", "QueueDataset", "ProbabilityEntry",
+           "CountFilterEntry", "ShowClickEntry"]
+
+
+class _Entry:
+    def _to_attr(self):
+        return repr(self)
+
+
+class ProbabilityEntry(_Entry):
+    """dataset.py ProbabilityEntry: sample-keep probability accessor."""
+
+    def __init__(self, probability):
+        if not 0.0 <= probability <= 1.0:
+            raise ValueError("probability must be in [0, 1]")
+        self.probability = float(probability)
+
+    def __repr__(self):
+        return f"probability_entry:{self.probability}"
+
+
+class CountFilterEntry(_Entry):
+    """dataset.py CountFilterEntry: show-count threshold accessor."""
+
+    def __init__(self, count_filter):
+        if count_filter < 0:
+            raise ValueError("count_filter must be >= 0")
+        self.count_filter = int(count_filter)
+
+    def __repr__(self):
+        return f"count_filter_entry:{self.count_filter}"
+
+
+class ShowClickEntry(_Entry):
+    """dataset.py ShowClickEntry: show/click slot names for CTR tables."""
+
+    def __init__(self, show_slot, click_slot):
+        self.show_slot = str(show_slot)
+        self.click_slot = str(click_slot)
+
+    def __repr__(self):
+        return f"show_click_entry:{self.show_slot}:{self.click_slot}"
+
+
+class _FileDataset:
+    def __init__(self):
+        self._filelist = []
+        self._batch_size = 1
+        self._thread_num = 1
+        self._use_var = []
+        self._pipe_command = None
+        self._parse_fn = None
+
+    def init(self, batch_size=1, thread_num=1, use_var=None,
+             pipe_command=None, input_type=0, fs_name="", fs_ugi="",
+             download_cmd="cat", **kwargs):
+        self._batch_size = int(batch_size)
+        self._thread_num = int(thread_num)
+        self._use_var = list(use_var or [])
+        self._pipe_command = pipe_command
+        return self
+
+    def set_filelist(self, filelist):
+        missing = [f for f in filelist if not os.path.exists(f)]
+        if missing:
+            raise FileNotFoundError(f"dataset files not found: {missing}")
+        self._filelist = list(filelist)
+
+    def get_filelist(self):
+        return list(self._filelist)
+
+    def set_parse_fn(self, fn):
+        """TPU-build extension: line -> sample parser (the reference parses
+        via the C++ DataFeed proto; a Python callable is the analog here)."""
+        self._parse_fn = fn
+
+    def _iter_lines(self):
+        for path in self._filelist:
+            with open(path) as f:
+                for line in f:
+                    line = line.rstrip("\n")
+                    yield self._parse_fn(line) if self._parse_fn else line
+
+    def batch_iter(self):
+        batch = []
+        for sample in self._iter_lines():
+            batch.append(sample)
+            if len(batch) == self._batch_size:
+                yield batch
+                batch = []
+        if batch:
+            yield batch
+
+
+class InMemoryDataset(_FileDataset):
+    """dataset.py:388 InMemoryDataset: load files into memory, shuffle, feed."""
+
+    def __init__(self):
+        super().__init__()
+        self._samples = None
+
+    def load_into_memory(self):
+        self._samples = list(self._iter_lines())
+
+    def local_shuffle(self, seed=0):
+        import random
+
+        if self._samples is None:
+            raise RuntimeError("call load_into_memory() first")
+        random.Random(seed).shuffle(self._samples)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        self.local_shuffle()
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._samples or [])
+
+    def release_memory(self):
+        self._samples = None
+
+    def batch_iter(self):
+        if self._samples is None:
+            self.load_into_memory()
+        batch = []
+        for sample in self._samples:
+            batch.append(sample)
+            if len(batch) == self._batch_size:
+                yield batch
+                batch = []
+        if batch:
+            yield batch
+
+
+class QueueDataset(_FileDataset):
+    """dataset.py:1200 QueueDataset: streaming file feed (no memory stage)."""

@@ -1,0 +1,45 @@
+"""paddle.distributed.io (reference python/paddle/distributed/io.py:
+save/load persistables for distributed training — here riding the sharded
+distributed checkpoint and the single-process save/load)."""
+from __future__ import annotations
+
+__all__ = ["save_persistables", "load_persistables", "is_persistable"]
+
+
+def is_persistable(var):
+    """io.py is_persistable: parameters and buffers persist."""
+    return getattr(var, "persistable", True)
+
+
+def save_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    """io.py save_persistables: save a program's (or Layer's) parameters."""
+    from ..framework_io import save as _save
+
+    target = main_program
+    if hasattr(target, "state_dict"):
+        state = target.state_dict()
+    else:
+        raise TypeError(
+            "save_persistables expects a Layer-like object with state_dict "
+            "as main_program (the capture-based Program has no variables)")
+    import os
+
+    path = os.path.join(dirname, filename or "persistables.pdparams")
+    os.makedirs(dirname, exist_ok=True)
+    _save(state, path)
+    return path
+
+
+def load_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    """io.py load_persistables."""
+    import os
+
+    from ..framework_io import load as _load
+
+    path = os.path.join(dirname, filename or "persistables.pdparams")
+    state = _load(path)
+    if hasattr(main_program, "set_state_dict"):
+        main_program.set_state_dict(state)
+    return state

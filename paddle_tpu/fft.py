@@ -73,6 +73,45 @@ fft2 = _mk2d("fft2", jnp.fft.fft2)
 ifft2 = _mk2d("ifft2", jnp.fft.ifft2)
 rfft2 = _mk2d("rfft2", jnp.fft.rfft2)
 irfft2 = _mk2d("irfft2", jnp.fft.irfft2)
+def _hfft2(x, s=None, axes=(-2, -1), norm="backward"):
+    # Hermitian 2-D: hfft over the last axis, full fft over the other
+    # (reference fft.py hfft2 composition)
+    y = jnp.fft.fft(x, n=None if s is None else s[0], axis=axes[0], norm=norm)
+    return jnp.fft.hfft(y, n=None if s is None else s[1], axis=axes[1],
+                        norm=norm)
+
+
+def _ihfft2(x, s=None, axes=(-2, -1), norm="backward"):
+    y = jnp.fft.ihfft(x, n=None if s is None else s[1], axis=axes[1],
+                      norm=norm)
+    return jnp.fft.ifft(y, n=None if s is None else s[0], axis=axes[0],
+                        norm=norm)
+
+
+def _hfftn(x, s=None, axes=None, norm="backward"):
+    if axes is None:
+        axes = tuple(range(-x.ndim, 0))
+    y = x
+    for i, ax in enumerate(axes[:-1]):
+        y = jnp.fft.fft(y, n=None if s is None else s[i], axis=ax, norm=norm)
+    return jnp.fft.hfft(y, n=None if s is None else s[-1], axis=axes[-1],
+                        norm=norm)
+
+
+def _ihfftn(x, s=None, axes=None, norm="backward"):
+    if axes is None:
+        axes = tuple(range(-x.ndim, 0))
+    y = jnp.fft.ihfft(x, n=None if s is None else s[-1], axis=axes[-1],
+                      norm=norm)
+    for i, ax in enumerate(axes[:-1]):
+        y = jnp.fft.ifft(y, n=None if s is None else s[i], axis=ax, norm=norm)
+    return y
+
+
+hfft2 = _mk2d("hfft2", _hfft2)
+ihfft2 = _mk2d("ihfft2", _ihfft2)
+hfftn = _mknd("hfftn", _hfftn)
+ihfftn = _mknd("ihfftn", _ihfftn)
 fftn = _mknd("fftn", jnp.fft.fftn)
 ifftn = _mknd("ifftn", jnp.fft.ifftn)
 rfftn = _mknd("rfftn", jnp.fft.rfftn)

@@ -58,3 +58,9 @@ def _softmax_mask_triu(x):
     return _jax.nn.softmax(_jnp.where(causal, x, -1e30), axis=-1)
 
 from .optimizer import LookAhead, ModelAverage  # noqa: F401,E402
+from .graph_ops import (  # noqa: F401,E402
+    graph_khop_sampler,
+    graph_reindex,
+    graph_sample_neighbors,
+)
+from .. import inference  # noqa: F401,E402  (paddle.incubate.inference alias)

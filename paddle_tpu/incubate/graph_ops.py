@@ -1,0 +1,110 @@
+"""Graph sampling operators.
+
+Reference analogs: python/paddle/incubate/operators/{graph_khop_sampler,
+graph_sample_neighbors, graph_reindex}.py — CSR-graph neighbor sampling for
+GNN mini-batching. Host-side numpy implementations: sampling is data
+preparation (runs in DataLoader workers on TPU pipelines), the gathered
+subgraph tensors then feed paddle.geometric's message passing.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+
+
+def _np(x):
+    return np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                           eids=None, return_eids=False, perm_buffer=None,
+                           flag_perm_buffer=False, name=None):
+    """operators/graph_sample_neighbors.py: sample up to sample_size
+    neighbors of each input node from the CSC graph (row, colptr). Draws ride
+    the framework RNG stream (paddle.seed), fresh per call."""
+    import jax
+
+    from ..framework import random as rng_mod
+
+    seed = int(jax.random.randint(rng_mod.next_key(), (), 0, 2 ** 31 - 1))
+    rng = np.random.RandomState(seed)
+    rows = _np(row)
+    ptr = _np(colptr)
+    nodes = _np(input_nodes)
+    out_nb, out_cnt, out_eid = [], [], []
+    for n in nodes.ravel():
+        lo, hi = int(ptr[n]), int(ptr[n + 1])
+        nb = rows[lo:hi]
+        ids = np.arange(lo, hi)
+        if sample_size >= 0 and len(nb) > sample_size:
+            pick = rng.choice(len(nb), sample_size, replace=False)
+            nb, ids = nb[pick], ids[pick]
+        out_nb.append(nb)
+        out_eid.append(_np(eids)[ids] if eids is not None else ids)
+        out_cnt.append(len(nb))
+    neighbors = Tensor(jnp.asarray(np.concatenate(out_nb)
+                                   if out_nb else np.zeros(0, rows.dtype)))
+    counts = Tensor(jnp.asarray(np.asarray(out_cnt, np.int32)))
+    if return_eids:
+        return neighbors, counts, Tensor(jnp.asarray(
+            np.concatenate(out_eid) if out_eid else np.zeros(0, np.int64)))
+    return neighbors, counts
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    """operators/graph_reindex.py: map (x | neighbors) node ids onto a dense
+    0..n-1 index space, x first."""
+    xs = _np(x).ravel()
+    nb = _np(neighbors).ravel()
+    order = {}
+    for v in list(xs) + list(nb):
+        v = int(v)
+        if v not in order:
+            order[v] = len(order)
+    reindex_src = np.asarray([order[int(v)] for v in nb], np.int64)
+    counts = _np(count).ravel()
+    reindex_dst = np.repeat(np.arange(len(xs), dtype=np.int64), counts)
+    out_nodes = np.asarray(sorted(order, key=order.get), np.int64)
+    return (Tensor(jnp.asarray(reindex_src)),
+            Tensor(jnp.asarray(reindex_dst)),
+            Tensor(jnp.asarray(out_nodes)))
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """operators/graph_khop_sampler.py: multi-hop sampling = repeated
+    one-hop sampling + reindex over the union frontier."""
+    frontier = _np(input_nodes).ravel()
+    all_nb, all_cnt = [], []
+    sampled_centers = []          # one center per count entry, hop order
+    seen_set = set(int(v) for v in frontier)
+    seen = [int(v) for v in frontier]
+    for size in sample_sizes:
+        if len(frontier) == 0:
+            break                 # frontier exhausted: no further hops
+        nb, cnt = graph_sample_neighbors(row, colptr, frontier,
+                                         sample_size=size)
+        nbv = _np(nb)
+        all_nb.append(nbv)
+        all_cnt.append(_np(cnt))
+        sampled_centers.extend(int(v) for v in frontier)
+        new = []
+        for v in nbv:              # dedupe within the hop AND against seen
+            v = int(v)
+            if v not in seen_set:
+                seen_set.add(v)
+                seen.append(v)
+                new.append(v)
+        frontier = np.asarray(new, frontier.dtype)
+    neighbors = np.concatenate(all_nb) if all_nb else np.zeros(0, np.int64)
+    counts = np.concatenate(all_cnt) if all_cnt else np.zeros(0, np.int32)
+    src, dst, nodes = graph_reindex(
+        Tensor(jnp.asarray(np.asarray(sampled_centers, np.int64))),
+        Tensor(jnp.asarray(neighbors)), Tensor(jnp.asarray(counts)))
+    if return_eids:
+        return src, dst, nodes, Tensor(jnp.asarray(counts)), None
+    return src, dst, nodes, Tensor(jnp.asarray(counts))

@@ -80,3 +80,46 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
     u_b, s, vt = jnp.linalg.svd(b, full_matrices=False)
     return (Tensor(Q @ u_b), Tensor(s),
             Tensor(jnp.swapaxes(vt, -1, -2)))
+
+
+def fp8_fp8_half_gemm_fused(x, y, transpose_x=False, transpose_y=False,
+                            bias=None, scale=1.0, output_dtype="float16",
+                            activation_type="identity", name=None):
+    """linalg fp8_fp8_half_gemm_fused: fp8 x fp8 -> half gemm. TPU path:
+    cast operands to float8_e4m3, dot with a half-precision accumulator
+    preferred type (XLA fuses the epilogue bias/activation)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .framework.core import Tensor
+    from .ops._apply import apply_raw
+
+    def fn(a, b, *rest):
+        bb = rest[0] if rest else None
+        a8 = a.astype(jnp.float8_e4m3fn)
+        b8 = b.astype(jnp.float8_e4m3fn)
+        if transpose_x:
+            a8 = jnp.swapaxes(a8, -1, -2)
+        if transpose_y:
+            b8 = jnp.swapaxes(b8, -1, -2)
+        out_dt = jnp.dtype(output_dtype)
+        nbatch = max(a8.ndim, b8.ndim) - 2
+        if a8.ndim != b8.ndim or any(a8.shape[i] != b8.shape[i]
+                                     for i in range(nbatch)):
+            raise ValueError(
+                "fp8_fp8_half_gemm_fused needs matching batch dims: "
+                f"{a8.shape} vs {b8.shape}")
+        batch = tuple(range(nbatch))
+        out = jax.lax.dot_general(
+            a8, b8, (((a8.ndim - 1,), (b8.ndim - 2,)), (batch, batch)),
+            preferred_element_type=jnp.float32) * scale
+        if bb is not None:
+            out = out + bb.astype(jnp.float32)
+        if activation_type in ("gelu",):
+            out = jax.nn.gelu(out)
+        elif activation_type in ("relu",):
+            out = jax.nn.relu(out)
+        return out.astype(out_dt)
+
+    args = [x, y] + ([bias] if bias is not None else [])
+    return apply_raw("fp8_fp8_half_gemm_fused", fn, args)[0]

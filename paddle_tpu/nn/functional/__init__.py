@@ -63,3 +63,11 @@ from ...ops.random_ops import gumbel_softmax  # noqa: F401
 from .extras import hsigmoid_loss, max_unpool3d  # noqa: F401
 from .extras import rnnt_loss  # noqa: F401
 from .extras import fractional_max_pool2d, fractional_max_pool3d  # noqa: F401
+from .extras import (  # noqa: F401
+    adaptive_log_softmax_with_loss,
+    bilinear,
+    class_center_sample,
+    feature_alpha_dropout,
+    flash_attn_varlen_qkvpacked,
+    sparse_attention,
+)

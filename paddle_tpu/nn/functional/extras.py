@@ -690,3 +690,154 @@ def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
     if return_mask:
         return out, m.concat(mplanes, axis=2)
     return out
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """common.py feature_alpha_dropout: alpha dropout that drops whole
+    channel maps (dim 1) instead of single elements."""
+    if not training or p == 0.0:
+        return x
+    from ...framework import random as rng_mod
+    from ...framework.core import Tensor
+    import jax
+    import jax.numpy as jnp
+
+    v = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+    alpha_p = -1.7580993408473766  # -alpha * scale of SELU
+    if p >= 1.0:
+        # fully dropped: every feature is the (affinely-recentered) alpha
+        # value, which degenerates to zeros at the p->1 limit
+        from ...ops.creation import zeros_like as _zl
+
+        return _zl(x if isinstance(x, Tensor) else Tensor(v))
+    shape = ((v.shape[0], v.shape[1]) + (1,) * (v.ndim - 2)
+             if v.ndim >= 2 else v.shape)
+    keep = jax.random.bernoulli(rng_mod.next_key(), 1.0 - p, shape)
+    a = (1.0 / ((1 - p) * (1 + p * alpha_p ** 2)) ** 0.5)
+    b = -a * alpha_p * p
+    from ...ops._apply import apply_raw
+
+    def fn(val):
+        return a * jnp.where(keep, val, alpha_p) + b
+
+    return apply_raw("feature_alpha_dropout", fn, [x if isinstance(x, Tensor)
+                                                   else Tensor(v)])[0]
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """common.py bilinear: out[., k] = x1 W[k] x2^T (+ b)."""
+    return _bilinear_op(x1, x2, weight, bias)
+
+
+@defop("bilinear")
+def _bilinear_op(x1, x2, weight, bias=None):
+    # weight: (out, in1, in2); x1: (N, in1); x2: (N, in2)
+    out = jnp.einsum("ni,oij,nj->no", x1, weight, x2)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def class_center_sample(label, num_classes, num_samples, group=None,
+                        name=None):
+    """common.py class_center_sample (PartialFC sampling): remap labels into
+    the sampled-center index space and return the sampled class ids."""
+    import numpy as np
+
+    from ...framework.core import Tensor
+
+    lab = np.asarray(label.numpy() if isinstance(label, Tensor) else label)
+    pos = np.unique(lab)
+    if len(pos) >= num_samples:
+        # the reference NEVER drops a positive center: the sampled set may
+        # exceed num_samples so every in-batch label stays addressable
+        sampled = pos
+    else:
+        rest = np.setdiff1d(np.arange(num_classes), pos, assume_unique=True)
+        from ...framework import random as rng_mod
+        import jax
+
+        k = rng_mod.next_key()
+        idx = np.asarray(jax.random.permutation(k, len(rest)))
+        sampled = np.concatenate([pos, rest[idx[: num_samples - len(pos)]]])
+    remap = {int(c): i for i, c in enumerate(sampled)}
+    remapped = np.asarray([remap.get(int(c), -1) for c in lab.ravel()],
+                          np.int64).reshape(lab.shape)
+    return (Tensor(jnp.asarray(remapped)),
+            Tensor(jnp.asarray(sampled.astype(np.int64))))
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """sparse_attention.py: block-sparse attention given a CSR layout. TPU
+    emulation: densify the CSR pattern into a boolean mask (XLA fuses it);
+    a Pallas block-sparse kernel is the perf follow-up."""
+    import numpy as np
+
+    from ...framework.core import Tensor
+    from .flash_attention import scaled_dot_product_attention
+
+    offs = np.asarray(sparse_csr_offset.numpy()
+                      if isinstance(sparse_csr_offset, Tensor)
+                      else sparse_csr_offset)
+    cols = np.asarray(sparse_csr_columns.numpy()
+                      if isinstance(sparse_csr_columns, Tensor)
+                      else sparse_csr_columns)
+    B, H, S, D = query.shape
+    keep = np.zeros((B, H, S, S), bool)
+    for b in range(B):
+        for h in range(H):
+            for i in range(S):
+                lo, hi = offs[b, h, i], offs[b, h, i + 1]
+                keep[b, h, i, cols[b, h, lo:hi]] = True
+    from ...ops import manipulation as m
+
+    q = m.transpose(query, [0, 2, 1, 3])
+    k = m.transpose(key, [0, 2, 1, 3])
+    v = m.transpose(value, [0, 2, 1, 3])
+    out = scaled_dot_product_attention(q, k, v,
+                                       attn_mask=Tensor(jnp.asarray(keep)))
+    return m.transpose(out, [0, 2, 1, 3])
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,  # noqa: A002
+                                   cutoffs, head_bias=None, name=None):
+    """loss.py adaptive_log_softmax_with_loss: the functional form of
+    nn.AdaptiveLogSoftmaxWithLoss with explicit parameters.
+
+    head_weight: (in, shortlist + n_clusters); tail_weights: list of
+    (proj (in, h_i), out (h_i, size_i)) pairs; cutoffs: ascending cluster
+    boundaries (without n_classes). Returns (target log-prob, mean nll)."""
+    from .. import functional as F
+    from ...ops import concat, take_along_axis
+
+    h = input.matmul(head_weight)
+    if head_bias is not None:
+        h = h + head_bias
+    head_lp = F.log_softmax(h, axis=-1)
+    shortlist = int(head_weight.shape[1]) - len(tail_weights)
+    parts = [head_lp[:, :shortlist]]
+    for i, (proj, out) in enumerate(tail_weights):
+        cluster_lp = F.log_softmax(input.matmul(proj).matmul(out), axis=-1)
+        parts.append(cluster_lp + head_lp[:, shortlist + i:shortlist + i + 1])
+    full = concat(parts, axis=-1)
+    lab = label.reshape([-1, 1])
+    target_lp = take_along_axis(full, lab, axis=1).reshape([-1])
+    return target_lp, -target_lp.mean()
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens, max_seqlen, scale=None,
+                                dropout=0.0, causal=False,
+                                return_softmax=False, training=True,
+                                name=None):
+    """flash_attention.py flash_attn_varlen_qkvpacked: (total, 3, H, D)
+    packed ragged batches through the varlen path."""
+    from .flash_attention import flash_attn_unpadded
+
+    q = qkv[:, 0]
+    k = qkv[:, 1]
+    v = qkv[:, 2]
+    return flash_attn_unpadded(q, k, v, cu_seqlens, cu_seqlens, max_seqlen,
+                               max_seqlen, scale=scale, dropout=dropout,
+                               causal=causal, return_softmax=return_softmax,
+                               training=training)

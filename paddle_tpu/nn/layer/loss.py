@@ -190,3 +190,22 @@ class CTCLoss(Layer):
     def forward(self, log_probs, labels, input_lengths, label_lengths, norm_by_times=False):
         return F.ctc_loss(log_probs, labels, input_lengths, label_lengths, self.blank,
                           self.reduction, norm_by_times)
+
+
+class RNNTLoss(Layer):
+    """loss.py RNNTLoss: layer form of functional.rnnt_loss (the transducer
+    lattice recursion in nn/functional/extras.py)."""
+
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.blank = blank
+        self.fastemit_lambda = fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):  # noqa: A002
+        from ..functional.extras import rnnt_loss
+
+        return rnnt_loss(input, label, input_lengths, label_lengths,
+                         blank=self.blank, reduction=self.reduction,
+                         fastemit_lambda=self.fastemit_lambda)

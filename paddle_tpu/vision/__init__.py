@@ -22,3 +22,17 @@ def get_image_backend():
 
 
 _IMAGE_BACKEND = "pil"
+
+
+def image_load(path, backend=None):
+    """vision/image.py image_load: PIL (default) or 'cv2' backend."""
+    if backend in (None, "pil"):
+        from PIL import Image
+
+        return Image.open(path)
+    if backend == "cv2":
+        import numpy as np
+        from PIL import Image
+
+        return np.asarray(Image.open(path).convert("RGB"))[:, :, ::-1]
+    raise ValueError(f"unsupported image_load backend {backend!r}")

@@ -1,0 +1,179 @@
+"""The intermediate parallelize plan API + distributed runtime stragglers
+(reference auto_parallel/intermediate/ + distributed/spawn.py + fleet
+datasets + distributed/io.py)."""
+import os
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+
+
+class _MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 32)
+        self.fc2 = nn.Linear(32, 8)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+
+def _mesh():
+    return dist.ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "mp"])
+
+
+class TestParallelizePlan:
+    def test_col_row_plan_shards_and_trains(self):
+        dist.set_mesh(_mesh())
+        assert dist.get_mesh() is not None
+        paddle.seed(0)
+        m = _MLP()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=m.parameters())
+        m2, opt2 = dist.parallelize(m, opt, config={
+            "mp_config": {"parallelize_plan": {
+                "fc1": dist.ColWiseParallel(),
+                "fc2": dist.RowWiseParallel(),
+            }},
+            "dp_config": {"sharding_level": 1},
+        })
+        w1 = m.fc1.weight.value
+        assert w1.addressable_shards[0].data.shape[1] == w1.shape[1] // 4
+        w2 = m.fc2.weight.value
+        assert w2.addressable_shards[0].data.shape[0] == w2.shape[0] // 4
+
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 8).astype("float32"))
+        first = None
+        for _ in range(5):
+            loss = (m2(x) ** 2).mean()
+            first = first or float(loss)
+            loss.backward()
+            opt2.step()
+            opt2.clear_grad()
+        assert float(loss) < first
+        # the optimizer followed the replaced params, and ZeRO level 1 put
+        # the state on the dp axis too
+        st = opt2._accumulators[id(m.fc1.weight)]
+        spec = next(iter(st.values())).sharding.spec
+        flat = [n for names in spec if names is not None
+                for n in (names if isinstance(names, tuple) else (names,))]
+        assert "dp" in flat and "mp" in flat, spec
+
+    def test_parallelize_numerics_match_single_card(self):
+        dist.set_mesh(_mesh())
+        paddle.seed(0)
+        ref = _MLP()
+        paddle.seed(0)
+        m = _MLP()
+        m, _ = dist.parallelize(m, None, config={
+            "mp_config": {"parallelize_plan": {
+                "fc1": dist.ColWiseParallel(),
+                "fc2": dist.RowWiseParallel(),
+            }}})
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(4, 8).astype("float32"))
+        np.testing.assert_allclose(m(x).numpy(), ref(x).numpy(),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_sequence_parallel_marks_run(self):
+        dist.set_mesh(_mesh())
+        paddle.seed(0)
+        m = _MLP()
+        m, _ = dist.parallelize(m, None, config={
+            "mp_config": {"parallelize_plan": {
+                "fc1": dist.SequenceParallelEnable(),
+                "fc2": dist.SequenceParallelDisable(),
+            }}})
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(4, 8, 8).astype("float32"))
+        out = m(x)
+        assert tuple(out.shape) == (4, 8, 8)
+
+    def test_split_point_recorded(self):
+        m = _MLP()
+        m, _ = dist.parallelize(m, None, config={
+            "pp_config": {"split_spec": {"fc1": dist.SplitPoint.END}}})
+        assert m._pp_split_spec == {"fc1": dist.SplitPoint.END}
+
+    def test_local_layer(self):
+        class Square(dist.LocalLayer):
+            def forward(self, x):
+                return x * x
+
+        sq = Square()
+        x = paddle.to_tensor(np.full((2, 2), 3.0, "float32"))
+        np.testing.assert_allclose(sq(x).numpy(), 9.0)
+
+    def test_to_distributed_roundtrip(self):
+        m = _MLP()
+        m2, opt2, loader = dist.to_distributed(m, None, "loader-sentinel")
+        assert m2 is m and loader == "loader-sentinel"
+
+    def test_is_available(self):
+        assert dist.is_available()
+
+
+def _spawn_child(tag_dir):
+    import os
+
+    rank = os.environ["PADDLE_TRAINER_ID"]
+    with open(os.path.join(tag_dir, f"rank_{rank}"), "w") as f:
+        f.write(os.environ["PADDLE_TRAINERS_NUM"])
+
+
+class TestSpawn:
+    def test_spawn_runs_ranks_with_env_contract(self, tmp_path):
+        dist.spawn(_spawn_child, args=(str(tmp_path),), nprocs=2)
+        assert sorted(os.listdir(tmp_path)) == ["rank_0", "rank_1"]
+        assert open(tmp_path / "rank_0").read() == "2"
+
+
+class TestFleetDatasets:
+    def test_in_memory_dataset(self, tmp_path):
+        f = tmp_path / "part-0"
+        f.write_text("1 a\n2 b\n3 c\n4 d\n")
+        ds = dist.InMemoryDataset()
+        ds.init(batch_size=2, thread_num=1, use_var=["x"])
+        ds.set_filelist([str(f)])
+        ds.load_into_memory()
+        assert ds.get_memory_data_size() == 4
+        ds.local_shuffle(seed=3)
+        batches = list(ds.batch_iter())
+        assert len(batches) == 2 and len(batches[0]) == 2
+        ds.release_memory()
+        assert ds.get_memory_data_size() == 0
+
+    def test_queue_dataset_streams(self, tmp_path):
+        f = tmp_path / "part-0"
+        f.write_text("a\nb\nc\n")
+        ds = dist.QueueDataset()
+        ds.init(batch_size=2)
+        ds.set_filelist([str(f)])
+        ds.set_parse_fn(str.upper)
+        assert list(ds.batch_iter()) == [["A", "B"], ["C"]]
+        with pytest.raises(FileNotFoundError):
+            ds.set_filelist([str(tmp_path / "nope")])
+
+    def test_entries(self):
+        assert "0.5" in repr(dist.ProbabilityEntry(0.5))
+        assert "7" in repr(dist.CountFilterEntry(7))
+        assert "show:click" in repr(dist.ShowClickEntry("show", "click"))
+        with pytest.raises(ValueError):
+            dist.ProbabilityEntry(2.0)
+
+
+class TestDistIO:
+    def test_save_load_persistables(self, tmp_path):
+        paddle.seed(0)
+        m = _MLP()
+        w0 = m.fc1.weight.numpy().copy()
+        dist.io.save_persistables(dirname=str(tmp_path), main_program=m)
+        m.fc1.weight._replace_value(m.fc1.weight.value * 0)
+        dist.io.load_persistables(dirname=str(tmp_path), main_program=m)
+        np.testing.assert_allclose(m.fc1.weight.numpy(), w0)
