@@ -259,3 +259,5 @@ class backends:
 load = _wav_load
 save = _wav_save
 info = _wav_info
+
+from . import datasets  # noqa: F401,E402

@@ -1,0 +1,62 @@
+"""paddle.audio.datasets (reference python/paddle/audio/datasets/: TESS,
+ESC50 — folder-of-wavs datasets with label parsing from filenames). No
+network egress here: point `path` at a pre-downloaded archive folder."""
+from __future__ import annotations
+
+import os
+
+from ..io import Dataset
+
+__all__ = ["TESS", "ESC50"]
+
+
+class _FolderAudioDataset(Dataset):
+    def __init__(self, path, sample_rate=None, feat_type="raw", **kwargs):
+        if path is None or not os.path.isdir(path):
+            raise RuntimeError(
+                f"{type(self).__name__} needs a local dataset folder (no "
+                f"network egress in this build); got path={path!r}")
+        self.path = path
+        self.feat_type = feat_type
+        self.files = []
+        self.labels = []
+        for root, _, names in sorted(os.walk(path)):
+            for nm in sorted(names):
+                if nm.lower().endswith(".wav"):
+                    self.files.append(os.path.join(root, nm))
+                    self.labels.append(self._label_of(nm, root))
+
+    def _label_of(self, name, root):  # pragma: no cover - subclass hook
+        return 0
+
+    def __getitem__(self, idx):
+        from . import _wav_load
+
+        wav, sr = _wav_load(self.files[idx])
+        return wav, self.labels[idx]
+
+    def __len__(self):
+        return len(self.files)
+
+
+class TESS(_FolderAudioDataset):
+    """datasets/tess.py: Toronto emotional speech set; the emotion is the
+    last underscore-separated token of the filename."""
+
+    EMOTIONS = ["angry", "disgust", "fear", "happy", "neutral", "ps", "sad"]
+
+    def _label_of(self, name, root):
+        tok = name.rsplit("_", 1)[-1].split(".")[0].lower()
+        return self.EMOTIONS.index(tok) if tok in self.EMOTIONS else 0
+
+
+class ESC50(_FolderAudioDataset):
+    """datasets/esc50.py: ESC-50; the target class is the last dash token of
+    the filename (<fold>-<id>-<take>-<target>.wav)."""
+
+    def _label_of(self, name, root):
+        stem = name.split(".")[0]
+        try:
+            return int(stem.split("-")[-1])
+        except ValueError:
+            return 0

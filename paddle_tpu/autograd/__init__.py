@@ -19,3 +19,27 @@ from .functional import (  # noqa: F401
     jvp,
     vjp,
 )
+
+
+class saved_tensors_hooks:  # noqa: N801 (reference casing)
+    """autograd/saved_tensors_hooks (reference autograd/saved_tensors_hooks.py):
+    pack/unpack hooks over tensors the tape saves for backward — the CPU-
+    offload / compression hook point. Applies to the cached-vjp fast path's
+    saved inputs (the default eager path); compiled steps manage residency
+    via XLA remat instead."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        from ..ops import _apply
+
+        _apply._SAVED_HOOKS.append((self.pack_hook, self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        from ..ops import _apply
+
+        _apply._SAVED_HOOKS.pop()
+        return False

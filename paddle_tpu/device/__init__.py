@@ -177,3 +177,50 @@ def stream_guard(stream):
 
 
 from . import cuda  # noqa: E402,F401  (paddle.device.cuda compat namespace)
+
+
+# reference device/__init__.py __all__ completion (round-3 sweep)
+def get_cudnn_version():
+    """No cuDNN on TPU: None, the reference's value when unavailable."""
+    return None
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_cinn():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_distribute():
+    return True
+
+
+def is_compiled_with_custom_device(device_type="tpu"):
+    return device_type == "tpu"
+
+
+def get_all_custom_device_type():
+    return ["tpu"]
+
+
+class XPUPlace:
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place(xpu:{self.device_id})"
+
+
+class IPUPlace:
+    def __repr__(self):
+        return "Place(ipu)"

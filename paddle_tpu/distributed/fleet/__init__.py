@@ -5,6 +5,12 @@ module-level (fleet.init(...), fleet.distributed_model(...)) exactly like the re
 singleton Fleet instance.
 """
 from .fleet import (  # noqa: F401
+    Fleet,
+    MultiSlotDataGenerator,
+    MultiSlotStringDataGenerator,
+    Role,
+    UtilBase,
+    util,
     PaddleCloudRoleMaker,
     UserDefinedRoleMaker,
     barrier_worker,

@@ -267,3 +267,111 @@ def stop_worker():
     runtime().client = None
     runtime().stopped = True
     _STATE.ps_model = None
+
+
+class UtilBase:
+    """fleet/utils/fs + util functions surface (base/util_factory.py UtilBase):
+    host-side helpers trainers call through fleet.util."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):  # noqa: A002
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        from ...distributed.collective import ReduceOp, all_reduce
+        from ...framework.core import Tensor
+
+        t = input if isinstance(input, Tensor)             else Tensor(jnp.asarray(np.asarray(input)))
+        op = {"sum": ReduceOp.SUM, "max": ReduceOp.MAX,
+              "min": ReduceOp.MIN}[mode]
+        all_reduce(t, op=op)
+        return t.numpy()
+
+    def barrier(self, comm_world="worker"):
+        from ...distributed.collective import barrier
+
+        barrier()
+
+    def get_file_shard(self, files):
+        """Split a file list evenly over workers (util_factory.py): the first
+        len(files) % num workers take one extra file — no worker ends up
+        empty-handed while others hold surplus."""
+        idx = worker_index()
+        num = max(worker_num(), 1)
+        base, extra = divmod(len(files), num)
+        start = idx * base + min(idx, extra)
+        return files[start:start + base + (1 if idx < extra else 0)]
+
+    def print_on_rank(self, message, rank_id=0):
+        if worker_index() == rank_id:
+            print(message)
+
+
+util = UtilBase()
+
+
+class Role:
+    """role_maker.Role enum values (WORKER/SERVER...)."""
+
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class MultiSlotDataGenerator:
+    """fleet/data_generator/data_generator.py MultiSlotDataGenerator: line ->
+    [(slot_name, [ints/floats])] samples, emitted in the PS text protocol
+    '<len> <ids...>' per slot."""
+
+    def __init__(self):
+        self._proto_info = None
+
+    def generate_sample(self, line):  # pragma: no cover - user hook
+        raise NotImplementedError(
+            "implement generate_sample(line) returning an iterator of "
+            "[(slot_name, values), ...]")
+
+    def _format(self, sample):
+        parts = []
+        for _name, values in sample:
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts)
+
+    def run_from_memory(self, lines):
+        out = []
+        for line in lines:
+            for sample in self.generate_sample(line)():
+                out.append(self._format(sample))
+        return out
+
+    def run_from_stdin(self):
+        import sys
+
+        for line in sys.stdin:
+            for sample in self.generate_sample(line)():
+                sys.stdout.write(self._format(sample) + "\n")
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    """String-valued slots variant (data_generator.py)."""
+
+
+# the module itself acts as the Fleet singleton in this build (fleet.init /
+# fleet.distributed_model are module functions); Fleet is the TYPE exposed
+# for isinstance checks and direct construction in reference-portable code.
+class Fleet:
+    """fleet/fleet.py Fleet: thin instance facade over the module API."""
+
+    def __init__(self):
+        self.util = util
+
+    def init(self, *args, **kwargs):
+        return init(*args, **kwargs)
+
+    def __getattr__(self, item):
+        import sys
+
+        return getattr(sys.modules[__name__], item)

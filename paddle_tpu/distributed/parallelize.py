@@ -210,9 +210,12 @@ class SplitPoint(Enum):
 
 
 def _match(name, pattern):
-    return (fnmatch.fnmatch(name, pattern)
-            or re.fullmatch(pattern.replace(".", r"\."), name) is not None
-            or name == pattern)
+    if name == pattern or fnmatch.fnmatch(name, pattern):
+        return True
+    try:
+        return re.fullmatch(pattern.replace(".", r"\."), name) is not None
+    except re.error:
+        return False  # not a valid regex: fnmatch already said no
 
 
 def parallelize(model, optimizer=None, mesh=None, config=None):

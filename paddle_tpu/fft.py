@@ -89,8 +89,11 @@ def _ihfft2(x, s=None, axes=(-2, -1), norm="backward"):
 
 
 def _hfftn(x, s=None, axes=None, norm="backward"):
+    # numpy semantics: axes default to the LAST len(s) axes (or all of them)
     if axes is None:
-        axes = tuple(range(-x.ndim, 0))
+        axes = tuple(range(-len(s), 0)) if s is not None             else tuple(range(-x.ndim, 0))
+    if s is not None and len(s) != len(axes):
+        raise ValueError(f"s {s} and axes {axes} must have the same length")
     y = x
     for i, ax in enumerate(axes[:-1]):
         y = jnp.fft.fft(y, n=None if s is None else s[i], axis=ax, norm=norm)
@@ -100,7 +103,9 @@ def _hfftn(x, s=None, axes=None, norm="backward"):
 
 def _ihfftn(x, s=None, axes=None, norm="backward"):
     if axes is None:
-        axes = tuple(range(-x.ndim, 0))
+        axes = tuple(range(-len(s), 0)) if s is not None             else tuple(range(-x.ndim, 0))
+    if s is not None and len(s) != len(axes):
+        raise ValueError(f"s {s} and axes {axes} must have the same length")
     y = jnp.fft.ihfft(x, n=None if s is None else s[-1], axis=axes[-1],
                       norm=norm)
     for i, ax in enumerate(axes[:-1]):

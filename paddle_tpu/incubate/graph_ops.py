@@ -34,6 +34,7 @@ def graph_sample_neighbors(row, colptr, input_nodes, sample_size=-1,
     rows = _np(row)
     ptr = _np(colptr)
     nodes = _np(input_nodes)
+    eids_np = _np(eids) if eids is not None else None  # one host copy
     out_nb, out_cnt, out_eid = [], [], []
     for n in nodes.ravel():
         lo, hi = int(ptr[n]), int(ptr[n + 1])
@@ -43,7 +44,7 @@ def graph_sample_neighbors(row, colptr, input_nodes, sample_size=-1,
             pick = rng.choice(len(nb), sample_size, replace=False)
             nb, ids = nb[pick], ids[pick]
         out_nb.append(nb)
-        out_eid.append(_np(eids)[ids] if eids is not None else ids)
+        out_eid.append(eids_np[ids] if eids_np is not None else ids)
         out_cnt.append(len(nb))
     neighbors = Tensor(jnp.asarray(np.concatenate(out_nb)
                                    if out_nb else np.zeros(0, rows.dtype)))
@@ -79,15 +80,21 @@ def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
     """operators/graph_khop_sampler.py: multi-hop sampling = repeated
     one-hop sampling + reindex over the union frontier."""
     frontier = _np(input_nodes).ravel()
-    all_nb, all_cnt = [], []
+    all_nb, all_cnt, all_eid = [], [], []
     sampled_centers = []          # one center per count entry, hop order
     seen_set = set(int(v) for v in frontier)
     seen = [int(v) for v in frontier]
     for size in sample_sizes:
         if len(frontier) == 0:
             break                 # frontier exhausted: no further hops
-        nb, cnt = graph_sample_neighbors(row, colptr, frontier,
-                                         sample_size=size)
+        if return_eids:
+            nb, cnt, eid = graph_sample_neighbors(
+                row, colptr, frontier, sample_size=size, eids=sorted_eids,
+                return_eids=True)
+            all_eid.append(_np(eid))
+        else:
+            nb, cnt = graph_sample_neighbors(row, colptr, frontier,
+                                             sample_size=size)
         nbv = _np(nb)
         all_nb.append(nbv)
         all_cnt.append(_np(cnt))
@@ -106,5 +113,8 @@ def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
         Tensor(jnp.asarray(np.asarray(sampled_centers, np.int64))),
         Tensor(jnp.asarray(neighbors)), Tensor(jnp.asarray(counts)))
     if return_eids:
-        return src, dst, nodes, Tensor(jnp.asarray(counts)), None
+        eid_all = (np.concatenate(all_eid) if all_eid
+                   else np.zeros(0, np.int64))
+        return (src, dst, nodes, Tensor(jnp.asarray(counts)),
+                Tensor(jnp.asarray(eid_all)))
     return src, dst, nodes, Tensor(jnp.asarray(counts))

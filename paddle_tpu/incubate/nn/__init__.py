@@ -1,5 +1,7 @@
 from . import functional  # noqa: F401
 from .layer_fused import (  # noqa: F401,E402
+    FusedBiasDropoutResidualLayerNorm,
+    FusedDropoutAdd,
     FusedFeedForward,
     FusedLinear,
     FusedMultiHeadAttention,

@@ -253,3 +253,49 @@ class FusedMultiTransformer(Layer):
         for lyr in self.layers:
             out = lyr(out, src_mask=attn_mask)
         return out
+
+
+class FusedDropoutAdd(Layer):
+    """incubate/nn/layer/fused_dropout_add.py: dropout(x) + y in one op."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        return IF.fused_dropout_add(x, y, p=self.p, training=self.training,
+                                    mode=self.mode)
+
+    def extra_repr(self):
+        return f"p={self.p}, mode={self.mode}"
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """incubate/nn/layer/fused_dropout_nd.py FusedBiasDropoutResidualLayerNorm:
+    ln(residual + dropout(x + bias))."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.dropout_rate = dropout_rate
+        self._epsilon = epsilon
+        self.linear_bias = self.create_parameter(
+            [embed_dim], attr=bias_attr, default_initializer=Constant(0.0),
+            is_bias=True)
+        self.ln_scale = self.create_parameter(
+            [embed_dim], attr=weight_attr, default_initializer=Constant(1.0))
+        self.ln_bias = self.create_parameter(
+            [embed_dim], attr=None, default_initializer=Constant(0.0),
+            is_bias=True)
+
+    def forward(self, x, residual):
+        h = x + self.linear_bias
+        if self.dropout_rate:
+            h = F.dropout(h, p=self.dropout_rate, training=self.training)
+        return F.layer_norm(residual + h, [self.embed_dim], self.ln_scale,
+                            self.ln_bias, self._epsilon)
+
+    def extra_repr(self):
+        return f"embed_dim={self.embed_dim}, dropout_rate={self.dropout_rate}"

@@ -811,16 +811,15 @@ def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,  # n
     from .. import functional as F
     from ...ops import concat, take_along_axis
 
-    h = input.matmul(head_weight)
-    if head_bias is not None:
-        h = h + head_bias
-    head_lp = F.log_softmax(h, axis=-1)
     shortlist = int(head_weight.shape[1]) - len(tail_weights)
-    parts = [head_lp[:, :shortlist]]
-    for i, (proj, out) in enumerate(tail_weights):
-        cluster_lp = F.log_softmax(input.matmul(proj).matmul(out), axis=-1)
-        parts.append(cluster_lp + head_lp[:, shortlist + i:shortlist + i + 1])
-    full = concat(parts, axis=-1)
+    if cutoffs and int(cutoffs[0]) != shortlist:
+        raise ValueError(
+            f"cutoffs[0]={cutoffs[0]} inconsistent with head_weight: the "
+            f"head covers a shortlist of {shortlist} classes")
+    from ...nn.layer.extras import _adaptive_full_log_prob
+
+    full = _adaptive_full_log_prob(input, head_weight, head_bias,
+                                   tail_weights, shortlist)
     lab = label.reshape([-1, 1])
     target_lp = take_along_axis(full, lab, axis=1).reshape([-1])
     return target_lp, -target_lp.mean()

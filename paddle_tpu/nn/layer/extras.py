@@ -190,6 +190,23 @@ class HSigmoidLoss(Layer):
                                path_code=path_code)
 
 
+def _adaptive_full_log_prob(input, head_weight, head_bias, tail_weights,  # noqa: A002
+                            shortlist):
+    """(N, n_classes) full log-probs for the adaptive softmax: the ONE
+    implementation both the layer and the functional form share."""
+    h = input.matmul(head_weight)
+    if head_bias is not None:
+        h = h + head_bias
+    head_lp = F.log_softmax(h, axis=-1)
+    from ... import ops
+
+    parts = [head_lp[:, :shortlist]]
+    for i, (proj, out) in enumerate(tail_weights):
+        cluster_lp = F.log_softmax(input.matmul(proj).matmul(out), axis=-1)
+        parts.append(cluster_lp + head_lp[:, shortlist + i:shortlist + i + 1])
+    return ops.concat(parts, axis=-1)
+
+
 class AdaptiveLogSoftmaxWithLoss(Layer):
     """loss.py AdaptiveLogSoftmaxWithLoss: frequency-partitioned softmax —
     a head over the first cutoff + one token per tail cluster, each tail
@@ -234,17 +251,9 @@ class AdaptiveLogSoftmaxWithLoss(Layer):
 
     def _full_log_prob(self, input):
         """(N, n_classes) full log-probabilities (log_prob method)."""
-        from ... import ops
-
-        head_lp = self._head_logprob(input)
-        parts = [head_lp[:, :self.shortlist_size]]
-        for i, (proj, out) in enumerate(self.tail_weights):
-            cluster_lp = F.log_softmax(
-                input.matmul(proj).matmul(out), axis=-1)
-            gate = head_lp[:, self.shortlist_size + i:
-                           self.shortlist_size + i + 1]
-            parts.append(cluster_lp + gate)
-        return ops.concat(parts, axis=-1)
+        return _adaptive_full_log_prob(input, self.head_weight,
+                                       self.head_bias, self.tail_weights,
+                                       self.shortlist_size)
 
     def log_prob(self, input):
         return self._full_log_prob(input)

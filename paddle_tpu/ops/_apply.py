@@ -51,6 +51,7 @@ def _is_tensor(x):
 
 
 _AMP = None  # lazily bound amp.auto_cast module (hot-path import guard)
+_SAVED_HOOKS = []  # autograd.saved_tensors_hooks (pack, unpack) stack
 _INEXACT_MEMO = {}
 
 
@@ -72,14 +73,23 @@ class _LazyVjp:
     signature, then cache hits). Holds the op's input values as residuals —
     the same lifetime the eager pullback closure would have."""
 
-    __slots__ = ("bwd", "vals")
+    __slots__ = ("bwd", "vals", "_unpack")
 
     def __init__(self, bwd, vals):
         self.bwd = bwd
-        self.vals = vals
+        if _SAVED_HOOKS:
+            pack, self._unpack = _SAVED_HOOKS[-1]
+            self.vals = [pack(Tensor(v)) for v in vals]
+        else:
+            self._unpack = None
+            self.vals = vals
 
     def __call__(self, cots):
-        return self.bwd(tuple(self.vals), tuple(cots))
+        vals = self.vals
+        if self._unpack is not None:
+            unpacked = [self._unpack(v) for v in vals]
+            vals = [u.value if isinstance(u, Tensor) else u for u in unpacked]
+        return self.bwd(tuple(vals), tuple(cots))
 
 
 @functools.lru_cache(maxsize=8192)

@@ -454,3 +454,51 @@ class nn:
         relu6 = staticmethod(relu6)
         leaky_relu = staticmethod(leaky_relu)
         softmax = staticmethod(softmax)
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001
+    """sparse slice (reference sparse/unary.py slice): densify -> slice ->
+    re-sparsify in the input's format (XLA has no sparse slice kernel; COO
+    sizes are static here so the dense hop is the TPU-correct move)."""
+    from .ops.manipulation import _slice as dense_slice
+
+    dense = x.to_dense() if hasattr(x, "to_dense") else x
+    out = dense_slice(dense, axes=tuple(int(a) for a in axes),
+                      starts=tuple(int(s) for s in starts),
+                      ends=tuple(int(e) for e in ends))
+    if hasattr(x, "is_sparse_csr") and x.is_sparse_csr():
+        return out.to_sparse_csr()
+    if hasattr(x, "is_sparse_coo") and x.is_sparse_coo():
+        return out.to_sparse_coo(len(out.shape))
+    return out
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """sparse pca_lowrank (reference sparse/multiary.py): randomized PCA of a
+    sparse matrix — computed on the densified matrix (same numerics; the
+    sparsity only saved flops on GPU kernels)."""
+    import numpy as np
+
+    from .framework.core import Tensor
+
+    dense = x.to_dense() if hasattr(x, "to_dense") else x
+    a = np.asarray(dense.numpy(), np.float64)
+    m, n = a.shape
+    if q is None:
+        q = min(6, m, n)
+    if center:
+        a = a - a.mean(axis=0, keepdims=True)
+    rng = np.random.RandomState(0)
+    omega = rng.standard_normal((n, q))
+    y = a @ omega
+    for _ in range(niter):
+        y = a @ (a.T @ y)
+    Q, _ = np.linalg.qr(y)
+    b = Q.T @ a
+    u_hat, s, vt = np.linalg.svd(b, full_matrices=False)
+    u = Q @ u_hat
+    import jax.numpy as jnp
+
+    return (Tensor(jnp.asarray(u.astype(np.float32))),
+            Tensor(jnp.asarray(s.astype(np.float32))),
+            Tensor(jnp.asarray(vt.T.astype(np.float32))))

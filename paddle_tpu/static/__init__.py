@@ -286,3 +286,337 @@ def cpu_places(device_count=None):
 
 def device_guard(device=None):
     return contextlib.nullcontext()
+
+
+# ---------------------------------------------------------------------------
+# reference static/__init__.py __all__ completion (round-3 sweep)
+# ---------------------------------------------------------------------------
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """static gradients (base/backward.py): d targets / d inputs through the
+    capture-replay tape (same engine as paddle.grad)."""
+    from ..autograd import grad as _grad
+
+    outs = targets if isinstance(targets, (list, tuple)) else [targets]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    return list(_grad(outs, ins, grad_outputs=target_gradients,
+                      allow_unused=True))
+
+
+class _NoOptimizer:
+    """append_backward without an optimizer: backward only per run()."""
+
+    def __init__(self, params):
+        self._params = params
+
+    def step(self):
+        pass
+
+    def clear_grad(self):
+        pass
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """base/backward.py append_backward: under capture-replay, registering the
+    loss on the active program makes every Executor.run do backward (+step if
+    an optimizer was appended via minimize); standalone use runs backward now
+    and returns (param, grad) pairs."""
+    from ..framework import capture
+
+    prog = capture.active()
+    params = parameter_list or []
+    if prog is None:
+        loss.backward()
+        return [(p, p.grad) for p in params]
+    prog._train_hooks.append((loss, _NoOptimizer(params)))
+    return [(p, None) for p in params]
+
+
+class BuildStrategy:
+    """compiler.BuildStrategy: accepted for parity; XLA owns every pass the
+    reference toggles here (fusion, memory optimize, reduce strategy)."""
+
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    def __init__(self):
+        self.memory_optimize = None
+        self.enable_inplace = None
+        self.fuse_all_optimizer_ops = None
+        self.fuse_elewise_add_act_ops = None
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.build_cinn_pass = False
+
+
+def Print(input, first_n=-1, message=None, summarize=20,  # noqa: A002,N802
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """static.Print (base/layers/control_flow.py): host print + passthrough
+    (fires at replay too via the recorded op; jax.debug.callback under jit)."""
+    from ..ops._apply import apply_raw
+
+    def fn(v):
+        def cb(x):
+            head = f"{message or ''} shape={x.shape} dtype={x.dtype}"
+            print(f"[static.Print] {head}\n{np.asarray(x).ravel()[:summarize]}")
+
+        jax.debug.callback(cb, v)
+        return v
+
+    return apply_raw("static_print", fn, [input])[0]
+
+
+def py_func(func, x, out=None, backward_func=None,
+            skip_vars_in_backward_input=None):
+    """static.py_func (base/layers/nn.py): run a host python function over
+    tensors (eager host call; the backward_func rides PyLayer semantics when
+    grads are needed — pass differentiable fns through custom ops instead)."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    return func(*xs)
+
+
+class WeightNormParamAttr:
+    """static WeightNormParamAttr: accepted for parity; weight-norm itself is
+    nn.utils.weight_norm here."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.trainable = trainable
+
+
+class ExponentialMovingAverage:
+    """static ExponentialMovingAverage: shadow parameters updated as
+    ema = decay*ema + (1-decay)*param; apply()/restore() swap them."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._shadow = {}
+        self._backup = {}
+        self._params = []
+
+    def update(self, parameters=None):
+        import jax.numpy as jnp
+
+        if parameters is not None:
+            self._params = list(parameters)
+        for p in self._params:
+            prev = self._shadow.get(id(p))
+            v = p.value.astype(jnp.float32)
+            self._shadow[id(p)] = (v if prev is None
+                                   else self._decay * prev
+                                   + (1.0 - self._decay) * v)
+
+    def apply(self, executor=None, need_restore=True):
+        for p in self._params:
+            self._backup[id(p)] = p._value
+            p._replace_value(self._shadow[id(p)].astype(p.value.dtype))
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._replace_value(self._backup.pop(id(p)))
+
+
+def save(program, model_path, protocol=4, **configs):
+    """static.save: persist a Layer-backed program's parameters."""
+    from ..framework_io import save as _save
+
+    if not hasattr(program, "state_dict"):
+        raise TypeError("static.save expects a Layer-like object here")
+    _save(program.state_dict(), model_path + ".pdparams")
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """static.load: restore parameters saved by static.save."""
+    from ..framework_io import load as _load
+
+    state = _load(model_path + ".pdparams")
+    program.set_state_dict(state)
+    return state
+
+
+def load_program_state(model_path, var_list=None):
+    """static.load_program_state -> dict of numpy arrays."""
+    from ..framework_io import load as _load
+
+    state = _load(model_path + ".pdparams")
+    return {k: (v.numpy() if hasattr(v, "numpy") else np.asarray(v))
+            for k, v in state.items()}
+
+
+def set_program_state(program, state_dict):
+    """static.set_program_state: push a numpy state dict into the Layer."""
+    program.set_state_dict(state_dict)
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """static.normalize_program: prune to the feed->fetch slice — the
+    capture-based program is already minimal; returns a test-mode clone."""
+    return program.clone(for_test=True)
+
+
+def serialize_program(feed_vars, fetch_vars, **kwargs):
+    """static/io.py serialize_program (pickled IO description; the executable
+    form is jit.save's StableHLO artifact)."""
+    import pickle
+
+    feeds = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    fetches = fetch_vars if isinstance(fetch_vars, (list, tuple)) \
+        else [fetch_vars]
+    return pickle.dumps({"feed": [getattr(v, "name", None) for v in feeds],
+                         "fetch": [getattr(v, "name", None) for v in fetches]})
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None, **kwargs):
+    """static/io.py serialize_persistables (Layer-backed flow)."""
+    import pickle
+
+    target = kwargs.get("layer")
+    if target is None:
+        raise ValueError("pass layer=<Layer> (capture-based persistables)")
+    return pickle.dumps({k: v.numpy() for k, v in
+                         target.state_dict().items()})
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def deserialize_program(data):
+    import pickle
+
+    return pickle.loads(data)
+
+
+def deserialize_persistables(program, data, executor=None):
+    import pickle
+
+    return pickle.loads(data)
+
+
+Variable = Tensor  # reference static.Variable == the tensor handle
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """static.create_parameter: a trainable Parameter outside any Layer."""
+    import jax.numpy as jnp
+
+    from ..framework.core import Parameter
+    from ..nn.initializer import Constant, XavierUniform
+
+    init = default_initializer or (Constant(0.0) if is_bias
+                                   else XavierUniform())
+    val = init(tuple(int(s) for s in shape), np.dtype(dtype))
+    return Parameter(jnp.asarray(val, np.dtype(dtype)), name=name)
+
+
+def create_global_var(shape, value, dtype, persistable=False, force_cpu=False,
+                      name=None):
+    """static.create_global_var: a filled non-trainable tensor."""
+    import jax.numpy as jnp
+
+    t = Tensor(jnp.full(tuple(int(s) for s in shape), value, np.dtype(dtype)))
+    t.name = name
+    t.persistable = persistable
+    return t
+
+
+def accuracy(input, label, k=1, correct=None, total=None):  # noqa: A002
+    """static.accuracy -> paddle.metric.accuracy."""
+    from ..metric import accuracy as _acc
+
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,  # noqa: A002
+        slide_steps=1, ins_tag_weight=None):
+    """static.auc: batch AUC via the running Auc metric (returns
+    (auc_value, batch_auc_value, state placeholders...) like the reference)."""
+    from ..metric import Auc
+
+    m = Auc(num_thresholds=num_thresholds)
+    import jax.numpy as jnp
+
+    preds = input.numpy() if hasattr(input, "numpy") else np.asarray(input)
+    labels = label.numpy() if hasattr(label, "numpy") else np.asarray(label)
+    m.update(preds, labels)
+    val = Tensor(jnp.asarray(m.accumulate(), jnp.float32))
+    return val, val, []
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):  # noqa: A002
+    """static.ctr_metric_bundle: (abserr, sqrerr, prob, q, pos, total) sums
+    used by CTR jobs (base/layers/metric_op.py)."""
+    from .. import ops
+
+    preds = input if isinstance(input, Tensor) else Tensor(input)
+    labels = label if isinstance(label, Tensor) else Tensor(label)
+    lab = labels.astype(preds.dtype)
+    abserr = ops.abs(preds - lab).sum()
+    sqrerr = ((preds - lab) ** 2).sum()
+    prob = preds.sum()
+    q = (preds * preds).sum()
+    pos = lab.sum()
+    total = Tensor(jax.numpy.asarray(float(np.prod(preds.shape))))
+    return abserr, sqrerr, prob, q, pos, total
+
+
+def cuda_places(device_ids=None):
+    """No CUDA: the accelerator places (reference returns CUDAPlace list)."""
+    n = len(device_ids) if device_ids else 1
+    return ["tpu"] * n
+
+
+def xpu_places(device_ids=None):
+    return []
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    """No-IPU build: identity decorator."""
+    return call_func
+
+
+def ipu_shard_guard(index=-1, stage=-1):
+    """No-IPU build: accepted no-op guard."""
+    return contextlib.nullcontext()
+
+
+class IpuStrategy:
+    def __init__(self):
+        self._opts = {}
+
+    def set_graph_config(self, **kwargs):
+        self._opts.update(kwargs)
+
+
+class IpuCompiledProgram:
+    def __init__(self, program=None, scope=None, ipu_strategy=None):
+        self.program = program
+
+    def compile(self, feed_list, fetch_list):
+        return self.program
+
+
+__all__ += [
+    "append_backward", "gradients", "BuildStrategy", "Print", "py_func",
+    "WeightNormParamAttr", "ExponentialMovingAverage", "save", "load",
+    "load_program_state", "set_program_state", "normalize_program",
+    "serialize_program", "serialize_persistables", "save_to_file",
+    "deserialize_program", "deserialize_persistables", "load_from_file",
+    "Variable", "create_parameter", "create_global_var", "accuracy", "auc",
+    "ctr_metric_bundle", "cuda_places", "xpu_places", "set_ipu_shard",
+    "ipu_shard_guard", "IpuCompiledProgram", "IpuStrategy",
+]

@@ -9,6 +9,7 @@ import jax
 import jax.numpy as jnp
 
 from ..framework.core import Tensor
+from ..nn.layer.layers import Layer
 from ..ops._apply import defop
 
 
@@ -523,3 +524,195 @@ def decode_jpeg(x, mode="unchanged", name=None):
     else:
         arr = arr.transpose(2, 0, 1)  # HWC -> CHW
     return Tensor(jnp.asarray(arr))
+
+
+# -- layer classes over the functionals (reference vision/ops.py classes) ----
+class DeformConv2D(Layer):
+    """vision/ops.py DeformConv2D: layer form of deform_conv2d."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        from ..nn.initializer import Constant, XavierUniform
+
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._deformable_groups = deformable_groups
+        self._groups = groups
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, ks[0], ks[1]],
+            attr=weight_attr, default_initializer=XavierUniform())
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_channels], attr=bias_attr,
+            default_initializer=Constant(0.0), is_bias=True)
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, bias=self.bias,
+                             stride=self._stride, padding=self._padding,
+                             dilation=self._dilation,
+                             deformable_groups=self._deformable_groups,
+                             groups=self._groups, mask=mask)
+
+
+class RoIAlign(Layer):
+    """vision/ops.py RoIAlign: layer form of roi_align."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self._output_size,
+                         self._spatial_scale)
+
+
+class RoIPool(Layer):
+    """vision/ops.py RoIPool: layer form of roi_pool."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._output_size,
+                        self._spatial_scale)
+
+
+class PSRoIPool(Layer):
+    """vision/ops.py PSRoIPool: layer form of psroi_pool."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._output_size,
+                          self._spatial_scale)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """vision/ops.py yolo_loss (YOLOv3): per-cell objectness + box + class
+    loss against assigned ground-truth boxes.
+
+    Decodes predictions exactly like yolo_box, assigns each gt to the best
+    anchor of this head's mask, and sums MSE box terms + BCE
+    objectness/class terms — the reference kernel's loss shape
+    (paddle/phi/kernels/impl/yolov3_loss_kernel_impl.h), host-vectorized."""
+    import jax
+
+    from ..ops._apply import apply_raw
+
+    gb = gt_box.value if isinstance(gt_box, Tensor) else jnp.asarray(gt_box)
+    gl = gt_label.value if isinstance(gt_label, Tensor) else jnp.asarray(gt_label)
+
+    gs = None if gt_score is None else (
+        gt_score.value if isinstance(gt_score, Tensor) else jnp.asarray(gt_score))
+
+    def _loss_fn(xv):
+        return _yolo_loss_impl(xv, gb, gl, anchors, anchor_mask, class_num,
+                               downsample_ratio, use_label_smooth,
+                               ignore_thresh, gs, scale_x_y)
+
+    return apply_raw("vision.yolo_loss", _loss_fn,
+                     [x if isinstance(x, Tensor) else Tensor(x)])[0]
+
+
+def _yolo_loss_impl(xv, gb, gl, anchors, anchor_mask, class_num,
+                    downsample_ratio, use_label_smooth, ignore_thresh=0.7,
+                    gt_score=None, scale_x_y=1.0):
+    import jax
+
+    n, c, h, w = xv.shape
+    an_num = len(anchor_mask)
+    preds = xv.reshape(n, an_num, 5 + class_num, h, w)
+    tx, ty = preds[:, :, 0], preds[:, :, 1]
+    tw, th = preds[:, :, 2], preds[:, :, 3]
+    obj_logit = preds[:, :, 4]
+    cls_logit = preds[:, :, 5:]
+
+    input_size = downsample_ratio * h
+    masked_anchors = np.asarray([(anchors[2 * i], anchors[2 * i + 1])
+                                 for i in anchor_mask], np.float32)
+
+    loss = jnp.zeros((n,), jnp.float32)
+    obj_target = jnp.zeros((n, an_num, h, w), jnp.float32)
+    # decode every predicted box once (yolo_box semantics, scale_x_y bias)
+    gyx, gxx = jnp.meshgrid(jnp.arange(h), jnp.arange(w), indexing="ij")
+    px = (jax.nn.sigmoid(tx) * scale_x_y - 0.5 * (scale_x_y - 1.0)
+          + gxx[None, None]) / w
+    py = (jax.nn.sigmoid(ty) * scale_x_y - 0.5 * (scale_x_y - 1.0)
+          + gyx[None, None]) / h
+    pw = jnp.exp(tw) * masked_anchors[None, :, 0, None, None] / input_size
+    phh = jnp.exp(th) * masked_anchors[None, :, 1, None, None] / input_size
+    # best IoU of each predicted box against ANY gt of its sample
+    best_iou = jnp.zeros((n, an_num, h, w), jnp.float32)
+    b_count = gb.shape[1]
+    for bi in range(b_count):
+        ggx, ggy = gb[:, bi, 0], gb[:, bi, 1]
+        ggw, ggh = gb[:, bi, 2], gb[:, bi, 3]
+        valid = ((ggw > 0) & (ggh > 0)).astype(jnp.float32)
+        x1 = jnp.maximum(px - pw / 2, (ggx - ggw / 2)[:, None, None, None])
+        y1 = jnp.maximum(py - phh / 2, (ggy - ggh / 2)[:, None, None, None])
+        x2 = jnp.minimum(px + pw / 2, (ggx + ggw / 2)[:, None, None, None])
+        y2 = jnp.minimum(py + phh / 2, (ggy + ggh / 2)[:, None, None, None])
+        inter = jnp.clip(x2 - x1, 0) * jnp.clip(y2 - y1, 0)
+        union = pw * phh + (ggw * ggh)[:, None, None, None] - inter
+        iou = inter / jnp.maximum(union, 1e-9)
+        best_iou = jnp.maximum(best_iou, iou * valid[:, None, None, None])
+    # the reference's ignore mask: unmatched cells whose best IoU exceeds
+    # ignore_thresh take NO objectness penalty
+    obj_weight_base = (best_iou < ignore_thresh).astype(jnp.float32)
+    for bi in range(b_count):
+        # gt boxes are (cx, cy, w, h) normalized to [0,1]
+        gx, gy = gb[:, bi, 0], gb[:, bi, 1]
+        gw, gh = gb[:, bi, 2], gb[:, bi, 3]
+        valid = (gw > 0) & (gh > 0)
+        gi = jnp.clip((gx * w).astype(jnp.int32), 0, w - 1)
+        gj = jnp.clip((gy * h).astype(jnp.int32), 0, h - 1)
+        # best anchor by IoU of (w, h) only (the reference's assignment)
+        gwa = gw[:, None] * input_size
+        gha = gh[:, None] * input_size
+        inter = jnp.minimum(gwa, masked_anchors[None, :, 0]) * \
+            jnp.minimum(gha, masked_anchors[None, :, 1])
+        union = gwa * gha + masked_anchors[None, :, 0] * \
+            masked_anchors[None, :, 1] - inter
+        best = jnp.argmax(inter / jnp.maximum(union, 1e-9), axis=1)
+        bidx = jnp.arange(n)
+        sel = (bidx, best, gj, gi)
+        tgt_x = gx * w - gi
+        tgt_y = gy * h - gj
+        tgt_w = jnp.log(jnp.maximum(
+            gw * input_size / masked_anchors[best, 0], 1e-9))
+        tgt_h = jnp.log(jnp.maximum(
+            gh * input_size / masked_anchors[best, 1], 1e-9))
+        scale = 2.0 - gw * gh
+        vf = valid.astype(jnp.float32)
+        if gt_score is not None:
+            vf = vf * gt_score[:, bi]
+        loss = loss + vf * scale * (
+            (jax.nn.sigmoid(tx[sel]) - tgt_x) ** 2
+            + (jax.nn.sigmoid(ty[sel]) - tgt_y) ** 2
+            + (tw[sel] - tgt_w) ** 2 + (th[sel] - tgt_h) ** 2)
+        cls_t = jax.nn.one_hot(gl[:, bi], class_num)
+        if use_label_smooth:
+            delta = 1.0 / max(class_num, 1)
+            cls_t = cls_t * (1.0 - delta) + delta / class_num
+        clg = cls_logit[bidx, best, :, gj, gi]
+        bce = jnp.logaddexp(0.0, clg) - cls_t * clg
+        loss = loss + vf * jnp.sum(bce, axis=-1)
+        obj_target = obj_target.at[sel].set(
+            jnp.maximum(obj_target[sel], vf))
+    obj_bce = jnp.logaddexp(0.0, obj_logit) - obj_target * obj_logit
+    # matched cells always count; unmatched count unless ignored by IoU
+    obj_weight = jnp.maximum(obj_weight_base, obj_target)
+    loss = loss + jnp.sum(obj_bce * obj_weight, axis=(1, 2, 3))
+    return loss

@@ -163,6 +163,17 @@ def _wol_fn(x):
     return _wol(x, paddle.to_tensor(_WOL_Q), paddle.to_tensor(_WOL_S))
 
 
+_BILINEAR_W = None
+
+
+def _get_bilinear_w():
+    global _BILINEAR_W
+    if _BILINEAR_W is None:
+        _BILINEAR_W = paddle.to_tensor(
+            np.random.RandomState(5).randn(6, 3, 5).astype("float32"))
+    return _BILINEAR_W
+
+
 def _huber_fn(x, y):
     from paddle_tpu.nn.functional.loss import huber_loss
 
@@ -759,6 +770,23 @@ TAIL_CASES = [
            lambda x: np.fft.ihfft(x).real, [S], grad=False, dtypes=("float32",)),
     OpCase("fft.fftshift", lambda x: paddle.fft.fftshift(x),
            np.fft.fftshift, [S]),
+    OpCase("bilinear",
+           lambda a, b: F.bilinear(a, b, _get_bilinear_w()),
+           lambda a, b: np.einsum("ni,oij,nj->no", a,
+                                  _get_bilinear_w().numpy().astype("float64"),
+                                  b), [(4, 3), (4, 5)]),
+    OpCase("fft.hfft2", lambda x: paddle.fft.hfft2(paddle.complex(x, x)),
+           lambda x: np.fft.hfft(np.fft.fft(x + 1j * x, axis=-2), axis=-1),
+           [S], grad=False, dtypes=("float32",)),
+    OpCase("fft.ihfft2", lambda x: paddle.fft.ihfft2(x).real(),
+           lambda x: np.fft.ifft(np.fft.ihfft(x, axis=-1), axis=-2).real,
+           [S], grad=False, dtypes=("float32",)),
+    OpCase("fft.hfftn", lambda x: paddle.fft.hfftn(paddle.complex(x, x)),
+           lambda x: np.fft.hfft(np.fft.fft(x + 1j * x, axis=-2), axis=-1),
+           [S], grad=False, dtypes=("float32",)),
+    OpCase("fft.ihfftn", lambda x: paddle.fft.ihfftn(x).real(),
+           lambda x: np.fft.ifft(np.fft.ihfft(x, axis=-1), axis=-2).real,
+           [S], grad=False, dtypes=("float32",)),
     OpCase("fft.ifftshift", lambda x: paddle.fft.ifftshift(x),
            np.fft.ifftshift, [S]),
     # ---- signal / geometric ------------------------------------------------
