@@ -17,11 +17,20 @@ from ._apply import defop
 
 
 def _ints(seq):
+    # int instances pass through unconverted: static.data's _SymDim dynamic
+    # dims are int subclasses that must survive into recorded op args so the
+    # Executor can re-resolve them from the feed at replay
     if isinstance(seq, Tensor):
         return tuple(int(v) for v in np.atleast_1d(seq.numpy()))
-    if isinstance(seq, (int, np.integer)):
+    if isinstance(seq, bool):
         return (int(seq),)
-    return tuple(int(v) if not isinstance(v, Tensor) else int(v.numpy()) for v in seq)
+    if isinstance(seq, int):
+        return (seq,)
+    if isinstance(seq, np.integer):
+        return (int(seq),)
+    return tuple(v if (isinstance(v, int) and not isinstance(v, bool))
+                 else int(v.numpy() if isinstance(v, Tensor) else v)
+                 for v in seq)
 
 
 @defop("cast")

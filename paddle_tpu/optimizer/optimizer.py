@@ -34,12 +34,21 @@ class Optimizer:
 
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, multi_precision=False, name=None):
+        self._static_bind = False
         if parameters is None:
-            from ..framework.enforce import InvalidArgumentError
+            from ..framework import capture
 
-            raise InvalidArgumentError(
-                "parameters is required in eager mode: pass model.parameters()"
-            )
+            if capture.active() is None:
+                from ..framework.enforce import InvalidArgumentError
+
+                raise InvalidArgumentError(
+                    "parameters is required in eager mode: pass "
+                    "model.parameters()")
+            # static-mode construction (reference optimizer collects params
+            # from the Program): bind the builder-registered parameters at
+            # minimize() time
+            self._static_bind = True
+            parameters = []
         # param groups (reference optimizer.py supports dict groups)
         self._param_groups = []
         params = list(parameters)
@@ -209,6 +218,18 @@ class Optimizer:
             # static capture (program_guard): the reference appends backward +
             # update ops to the Program; here Executor.run performs
             # backward+step on the replayed loss each run() call
+            if self._static_bind:
+                if parameters is not None:
+                    self._param_groups[0]["params"] = list(parameters)
+                elif getattr(prog, "_parameters", None):
+                    self._param_groups[0]["params"] = prog.all_parameters()
+                if not self._param_groups[0]["params"]:
+                    from ..framework.enforce import InvalidArgumentError
+
+                    raise InvalidArgumentError(
+                        "minimize() found no parameters: pass parameters= "
+                        "or build the net with static.nn builders (which "
+                        "register their parameters on the Program)")
             prog._train_hooks.append((loss, self))
             return None, None
         loss.backward()

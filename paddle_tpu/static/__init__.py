@@ -45,6 +45,67 @@ class _Var:
         return f"Var(name={self.name}, shape={self.shape}, dtype={self.dtype})"
 
 
+class _SymDim(int):
+    """A dynamic dim read from a placeholder's .shape during capture.
+
+    static.data builds dynamic dims (None/-1) as 1 for the capture pass; a
+    Python value derived from them (the reference idiom
+    ``reshape(x, [x.shape[0], -1])``) would otherwise be baked into recorded
+    op args as the literal 1 and silently replayed against real feeds
+    (round-3 advisor finding). The dim therefore carries its
+    (placeholder, axis) origin: Executor.run re-resolves any _SymDim found in
+    a recorded op's static args from the actual feed. Arithmetic degrades to
+    a plain (baked) int with a warning, since the derived value can no longer
+    be re-resolved."""
+
+    def __new__(cls, val, ph, axis):
+        o = int.__new__(cls, val)
+        o._ph = ph
+        o._axis = axis
+        return o
+
+    def _degrade(self, op):
+        import warnings
+
+        warnings.warn(
+            f"arithmetic ({op}) on a dynamic placeholder dim bakes the "
+            "capture-time value 1 into the program; pass -1 to reshape or "
+            "move the computation into the fed tensor instead",
+            stacklevel=3)
+
+    def __reduce__(self):  # pickling a program drops the symbolic link
+        return (int, (int(self),))
+
+
+def _sym_degrading(name):
+    base = getattr(int, name)
+
+    def op(self, *a):
+        self._degrade(name)
+        return base(int(self), *a)
+
+    return op
+
+
+for _n in ("__add__", "__radd__", "__sub__", "__rsub__", "__mul__",
+           "__rmul__", "__floordiv__", "__rfloordiv__", "__mod__",
+           "__neg__", "__truediv__", "__rtruediv__"):
+    setattr(_SymDim, _n, _sym_degrading(_n))
+
+
+class _PlaceholderTensor(Tensor):
+    """static.data result: .shape returns _SymDim for dynamic axes."""
+
+    _dyn_axes = ()
+
+    @property
+    def shape(self):
+        dims = list(self._value.shape)
+        for ax in self._dyn_axes:
+            dims[ax] = _SymDim(dims[ax], self, ax)
+        return dims
+
+
 class Program:
     """reference static.Program, capture-replay form.
 
@@ -62,6 +123,7 @@ class Program:
         self._ops = []          # recorded (kind, payload, in_tensors, outputs)
         self._out_tensors = []  # every captured output (for fetch-by-name)
         self._train_hooks = []  # (loss_tensor, optimizer) from minimize()
+        self._parameters = []   # static.nn builder-created Parameters
 
     # called by framework.capture.record while this program is active
     def _record_op(self, kind, payload, t_leaves, outputs):
@@ -74,7 +136,13 @@ class Program:
         p._ops = list(self._ops)
         p._out_tensors = list(self._out_tensors)
         p._train_hooks = [] if for_test else list(self._train_hooks)
+        p._parameters = list(self._parameters)
         return p
+
+    def all_parameters(self):
+        """Parameters created by static.nn builders under this program's
+        guard (reference Program.all_parameters)."""
+        return list(self._parameters)
 
     def global_block(self):
         return self
@@ -119,12 +187,15 @@ def program_guard(main_program, startup_program=None):
 def data(name, shape, dtype="float32", lod_level=0):
     """Placeholder tensor: dynamic dims (None/-1) are built as 1 for the
     capture pass; Executor.run substitutes the real feed (shapes re-execute
-    polymorphically through the eager dispatcher)."""
+    polymorphically through the eager dispatcher). Reads of dynamic dims via
+    ``.shape`` return _SymDim markers re-resolved from the feed at replay."""
     import jax.numpy as jnp
 
-    concrete = [1 if (s is None or (isinstance(s, int) and s < 0)) else int(s)
-                for s in shape]
-    ph = Tensor(jnp.zeros(concrete, np.dtype(dtype)))
+    dyn_axes = tuple(i for i, s in enumerate(shape)
+                     if s is None or (isinstance(s, int) and s < 0))
+    concrete = [1 if i in dyn_axes else int(s) for i, s in enumerate(shape)]
+    ph = _PlaceholderTensor(jnp.zeros(concrete, np.dtype(dtype)))
+    ph._dyn_axes = dyn_axes
     ph.name = name
     _MAIN[0]._inputs[name] = ph
     return ph
@@ -183,6 +254,14 @@ class Executor:
         def sub(t):
             return env.get(id(t), t)
 
+        def resolve_dims(leaf):
+            """Re-resolve placeholder-derived dynamic dims from the feed."""
+            if isinstance(leaf, _SymDim):
+                live = env.get(id(leaf._ph))
+                if live is not None:
+                    return int(live.value.shape[leaf._axis])
+            return leaf
+
         # snapshot + deactivate capture: replay dispatches through apply(),
         # which must not re-record into the program being iterated (run()
         # inside an active program_guard would otherwise never terminate)
@@ -193,11 +272,23 @@ class Executor:
             for kind, payload, t_leaves, outputs in ops_snapshot:
                 if kind == "op":
                     opdef, leaves, treedef, t_idx = payload
-                    buf = list(leaves)
-                    for i in t_idx:
-                        buf[i] = sub(buf[i])
+                    t_set = set(t_idx)
+                    buf = [sub(l) if i in t_set else resolve_dims(l)
+                           for i, l in enumerate(leaves)]
                     a, k = jax.tree_util.tree_unflatten(treedef, buf)
                     new = _dispatch(opdef, *a, **k)
+                elif kind == "cond":
+                    # static.nn.cond select: both branches were captured;
+                    # re-decide from the replayed predicate per run
+                    n = payload
+                    pred = sub(t_leaves[0])
+                    taken = bool(np.asarray(pred.value).reshape(()))
+                    chosen = t_leaves[1:1 + n] if taken else t_leaves[1 + n:]
+                    new = tuple(sub(t) for t in chosen)
+                elif kind == "pyctrl":
+                    # static.nn while_loop / static_pylayer: re-execute the
+                    # recorded control entry on the live tensors
+                    new = payload([sub(t) for t in t_leaves])
                 else:  # "raw"
                     from ..ops._apply import apply_raw
 
@@ -610,7 +701,10 @@ class IpuCompiledProgram:
         return self.program
 
 
+from . import nn  # noqa: E402  (static.nn: control flow + builders)
+
 __all__ += [
+    "nn",
     "append_backward", "gradients", "BuildStrategy", "Print", "py_func",
     "WeightNormParamAttr", "ExponentialMovingAverage", "save", "load",
     "load_program_state", "set_program_state", "normalize_program",

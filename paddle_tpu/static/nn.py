@@ -1,0 +1,926 @@
+"""paddle.static.nn: compiled control flow + declarative layer builders.
+
+Reference analog: python/paddle/static/nn/{control_flow.py,common.py,
+sequence_lod.py,static_pylayer.py} — cond (control_flow.py:1637), while_loop
+(:755), case (:1067), switch_case (:1213), fc (common.py:48), embedding,
+conv/norm builders, the LoD sequence ops, and static_pylayer.py.
+
+TPU-first redesign (three execution modes per construct):
+
+* under a jax trace (jit.to_static / functional mode): ``cond``/``case``/
+  ``switch_case`` lower to ``lax.cond`` and ``while_loop`` to
+  ``lax.while_loop`` — real compiled data-dependent control flow on the XLA
+  side, with gradients through ``cond`` provided by jax's cond vjp.
+* eager (dygraph): the reference's own dygraph semantics — the predicate is
+  concretized and one branch runs on the autograd tape (reference
+  control_flow.py in_dygraph_mode branches do exactly this).
+* static capture (``program_guard``): ``cond`` builds BOTH branches into the
+  Program (the reference's documented net-building semantics) and records a
+  native select entry re-evaluated against the real feed at every
+  ``Executor.run``; ``while_loop``/``static_pylayer`` record a re-executed
+  control entry (loop state must flow through ``loop_vars``/``inputs`` — the
+  reference has the same contract).
+
+The declarative builders (fc, embedding, conv2d, batch_norm, ...) instantiate
+the imperative ``paddle.nn`` layers once per call site and register their
+parameters on the active Program, so ``optimizer.minimize(loss)`` with no
+explicit parameter list trains them (reference static-mode parameter
+collection). Sequence ops operate on dense padded ``[batch, time, ...]``
+tensors (optionally masked by a ``seq_lens`` argument) — the TPU build has no
+LoD tensor: ragged layouts defeat XLA's static shapes, and padded+masked is
+the idiomatic accelerator encoding of the same information.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd import tape
+from ..framework import capture as _capture
+from ..framework.core import Parameter, Tensor
+
+__all__ = [
+    "fc", "batch_norm", "bilinear_tensor_product", "embedding", "case",
+    "cond", "static_pylayer", "conv2d", "conv2d_transpose", "conv3d",
+    "conv3d_transpose", "data_norm", "deform_conv2d", "group_norm",
+    "instance_norm", "layer_norm", "nce", "prelu", "py_func", "row_conv",
+    "spectral_norm", "switch_case", "while_loop", "sparse_embedding",
+    "sequence_conv", "sequence_softmax", "sequence_pool",
+    "sequence_first_step", "sequence_last_step", "sequence_expand",
+]
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def _under_trace(*tensors):
+    """True when values are jax tracers (inside jit.to_static / lax scopes)."""
+    if tape.in_functional_mode():
+        return True
+    return any(isinstance(t.value, jax.core.Tracer)
+               for t in tensors if isinstance(t, Tensor))
+
+
+def _concrete_bool(pred):
+    v = pred.value if isinstance(pred, Tensor) else pred
+    arr = np.asarray(v)
+    if arr.size != 1:
+        raise ValueError(
+            f"condition input's numel should be 1, got shape {arr.shape}")
+    return bool(arr.reshape(()))
+
+
+def _out_stop_gradient(inputs):
+    rg = (tape.grad_flag() if tape.in_functional_mode()
+          else tape.is_grad_enabled())
+    return not (rg and any(not t.stop_gradient
+                           for t in inputs if isinstance(t, Tensor)))
+
+
+# --------------------------------------------------------------------------- #
+# control flow
+# --------------------------------------------------------------------------- #
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """reference static/nn/control_flow.py:1637 cond.
+
+    Returns ``true_fn()`` if ``pred`` else ``false_fn()``. Under a jax trace
+    both branches are staged into one ``lax.cond`` (branch outputs must share
+    pytree structure and shapes/dtypes — XLA's dataflow requirement, same as
+    the reference's same-nest-structure rule); gradients flow through the
+    taken branch. Eagerly, the predicate is concretized and one branch runs
+    (reference dygraph semantics). Under program capture both branches are
+    built and a select entry re-decides per Executor.run.
+    """
+    if true_fn is None and false_fn is None:
+        return None
+    for fn, nm in ((true_fn, "true_fn"), (false_fn, "false_fn")):
+        if fn is not None and not callable(fn):
+            raise TypeError(f"The {nm} in cond must be callable")
+    tfn = true_fn if true_fn is not None else (lambda: None)
+    ffn = false_fn if false_fn is not None else (lambda: None)
+    pred_t = pred if isinstance(pred, Tensor) else Tensor(jnp.asarray(pred))
+
+    if _under_trace(pred_t):
+        return _traced_cond(pred_t, tfn, ffn)
+    prog = _capture.active()
+    if prog is not None:
+        return _captured_cond(prog, pred_t, tfn, ffn)
+    return tfn() if _concrete_bool(pred_t) else ffn()
+
+
+def _traced_cond(pred_t, true_fn, false_fn):
+    box = {}
+
+    def wrap(fn, tag):
+        def g(_):
+            out = fn()
+            flat, tree = jax.tree_util.tree_flatten(out, is_leaf=_is_tensor)
+            box[tag] = (tree, [_is_tensor(o) for o in flat])
+            return tuple(o.value if _is_tensor(o) else jnp.asarray(o)
+                         for o in flat)
+
+        return g
+
+    pred_val = jnp.reshape(pred_t.value, ()).astype(bool)
+    out_vals = jax.lax.cond(pred_val, wrap(true_fn, "t"), wrap(false_fn, "f"),
+                            None)
+    tree, _is_t = box["t"]
+    sg = _out_stop_gradient([pred_t])
+    outs = [Tensor(v, stop_gradient=sg or not jnp.issubdtype(v.dtype,
+                                                             jnp.inexact))
+            for v in out_vals]
+    return jax.tree_util.tree_unflatten(tree, outs)
+
+
+def _captured_cond(prog, pred_t, true_fn, false_fn):
+    # both branches execute (and record) during capture: the reference's
+    # net-building semantics for static cond
+    t_out = true_fn()
+    f_out = false_fn()
+    t_flat, t_tree = jax.tree_util.tree_flatten(t_out, is_leaf=_is_tensor)
+    f_flat, f_tree = jax.tree_util.tree_flatten(f_out, is_leaf=_is_tensor)
+    if t_tree != f_tree:
+        raise TypeError(
+            "true_fn and false_fn must return the same nest structure "
+            f"(got {t_tree} vs {f_tree})")
+    if not t_flat:
+        return t_out
+    if not all(_is_tensor(x) for x in t_flat + f_flat):
+        raise TypeError("cond branches must return tensors under capture")
+    outs = [Tensor(t.value, stop_gradient=t.stop_gradient and f.stop_gradient)
+            for t, f in zip(t_flat, f_flat)]
+    prog._record_op("cond", len(t_flat), [pred_t] + t_flat + f_flat, outs)
+    return jax.tree_util.tree_unflatten(t_tree, outs)
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):  # noqa: A002
+    """reference static/nn/control_flow.py:755 while_loop.
+
+    ``cond(*loop_vars) -> bool scalar``, ``body(*loop_vars) -> new loop_vars``
+    (same structure/shapes — the loop-invariant XLA requires). Under a jax
+    trace this is ``lax.while_loop`` (compiled, forward-only: reverse-mode
+    through an unbounded loop is undefined — use ``lax.scan``-style bounded
+    loops for that, same limitation XLA imposes everywhere). Eagerly it is a
+    python loop over the tape (reference dygraph semantics, fully
+    differentiable). Under capture the loop is recorded as one entry and
+    re-executed per run — state must flow through ``loop_vars`` (reference
+    contract: vars mutated by the loop must be loop vars).
+    """
+    if not callable(cond) or not callable(body):
+        raise TypeError("cond and body in while_loop must be callable")
+    if not isinstance(loop_vars, (list, tuple)) or not loop_vars:
+        raise ValueError("loop_vars must be a non-empty list/tuple")
+    loop_vars = list(loop_vars)
+    flat, tree = jax.tree_util.tree_flatten(loop_vars, is_leaf=_is_tensor)
+    t_idx = [i for i, x in enumerate(flat) if _is_tensor(x)]
+
+    if _under_trace(*[flat[i] for i in t_idx]):
+        return _traced_while(cond, body, flat, tree, t_idx)
+    prog = _capture.active()
+    if prog is not None:
+        return _captured_while(prog, cond, body, flat, tree, t_idx)
+    return _eager_while(cond, body, loop_vars)
+
+
+def _eager_while(cond, body, loop_vars):  # noqa: A002
+    args = list(loop_vars)
+    n = len(args)
+    while _concrete_bool(cond(*args)):
+        out = body(*args)
+        args = list(out) if isinstance(out, (list, tuple)) else [out]
+        if len(args) != n:
+            raise ValueError(
+                f"body must return the same arity as loop_vars ({n}), "
+                f"got {len(args)}")
+    return args
+
+
+def _traced_while(cond, body, flat, tree, t_idx):  # noqa: A002
+    def rebuild(vals):
+        buf = list(flat)
+        for i, v in zip(t_idx, vals):
+            buf[i] = Tensor(v)
+        return jax.tree_util.tree_unflatten(tree, buf)
+
+    def c(vals):
+        r = cond(*rebuild(vals))
+        rv = r.value if _is_tensor(r) else jnp.asarray(r)
+        return jnp.reshape(rv, ()).astype(bool)
+
+    def b(vals):
+        out = body(*rebuild(vals))
+        out = list(out) if isinstance(out, (tuple, list)) else [out]
+        oflat, _ = jax.tree_util.tree_flatten(out, is_leaf=_is_tensor)
+        return tuple(oflat[i].value if _is_tensor(oflat[i])
+                     else jnp.asarray(oflat[i]) for i in t_idx)
+
+    init = tuple(flat[i].value for i in t_idx)
+    final = jax.lax.while_loop(c, b, init)
+    sg = _out_stop_gradient([flat[i] for i in t_idx])
+    buf = list(flat)
+    for i, v in zip(t_idx, final):
+        buf[i] = Tensor(v, stop_gradient=sg)
+    return jax.tree_util.tree_unflatten(tree, buf)
+
+
+def _captured_while(prog, cond, body, flat, tree, t_idx):  # noqa: A002
+    tensors = [flat[i] for i in t_idx]
+    outs = [Tensor(t.value, stop_gradient=t.stop_gradient) for t in tensors]
+
+    def runner(live):
+        buf = list(flat)
+        for i, t in zip(t_idx, live):
+            buf[i] = t
+        loop_vars = jax.tree_util.tree_unflatten(tree, buf)
+        result = _eager_while(cond, body, loop_vars)
+        rflat, _ = jax.tree_util.tree_flatten(result, is_leaf=_is_tensor)
+        return tuple(rflat[i] if _is_tensor(rflat[i]) else Tensor(
+            jnp.asarray(rflat[i])) for i in t_idx)
+
+    prog._record_op("pyctrl", runner, tensors, outs)
+    buf = list(flat)
+    for i, o in zip(t_idx, outs):
+        buf[i] = o
+    return jax.tree_util.tree_unflatten(tree, buf)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """reference control_flow.py:1067 case: runs the fn of the first pred
+    that is True; ``default`` (or the last pair's fn) otherwise. Composed from
+    ``cond`` so each mode (traced/eager/captured) inherits its semantics."""
+    if not isinstance(pred_fn_pairs, (list, tuple)) or not pred_fn_pairs:
+        raise TypeError("pred_fn_pairs must be a non-empty list/tuple")
+    pairs = []
+    for item in pred_fn_pairs:
+        if not isinstance(item, (list, tuple)) or len(item) != 2:
+            raise TypeError("each pred_fn_pair must be a (pred, fn) 2-tuple")
+        pred, fn = item
+        if not callable(fn):
+            raise TypeError("fn in pred_fn_pairs must be callable")
+        pairs.append((pred, fn))
+    if default is None:
+        pairs, (_, default) = pairs[:-1], pairs[-1]
+        if not pairs:
+            return default()
+
+    def chain(i):
+        if i == len(pairs):
+            return default
+        pred, fn = pairs[i]
+        return lambda: cond(pred, fn, chain(i + 1))
+
+    return chain(0)()
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """reference control_flow.py:1213 switch_case: dispatch on an int scalar.
+
+    ``branch_fns``: dict {int: fn}, list of (int, fn), or a plain list of fns
+    (keyed 0..n-1). Under a jax trace this lowers to ``lax.switch`` when the
+    keys are dense 0..n-1 with a default, else to a ``cond`` chain."""
+    idx_t = (branch_index if isinstance(branch_index, Tensor)
+             else Tensor(jnp.asarray(branch_index)))
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items(), key=lambda kv: kv[0])
+    else:
+        branch_fns = list(branch_fns)
+        if branch_fns and callable(branch_fns[0]):
+            items = list(enumerate(branch_fns))
+        else:
+            items = sorted(((int(k), f) for k, f in branch_fns),
+                           key=lambda kv: kv[0])
+    for k, f in items:
+        if not isinstance(k, (int, np.integer)):
+            raise TypeError(f"branch key must be int, got {type(k).__name__}")
+        if not callable(f):
+            raise TypeError("branch fns must be callable")
+    keys = [int(k) for k, _ in items]
+    if len(set(keys)) != len(keys):
+        raise ValueError(f"duplicate branch keys {keys}")
+
+    if (_under_trace(idx_t) and default is not None
+            and keys == list(range(len(keys)))):
+        box = {}
+
+        def wrap(fn, tag):
+            def g(_):
+                out = fn()
+                flat, tree = jax.tree_util.tree_flatten(out,
+                                                        is_leaf=_is_tensor)
+                box[tag] = tree
+                return tuple(o.value if _is_tensor(o) else jnp.asarray(o)
+                             for o in flat)
+
+            return g
+
+        branches = [wrap(f, i) for i, (_, f) in enumerate(items)]
+        branches.append(wrap(default, "d"))
+        raw = jnp.reshape(idx_t.value, ()).astype(jnp.int32)
+        # out-of-range indices (either side) take the default branch
+        in_range = (raw >= 0) & (raw < len(keys))
+        iv = jnp.where(in_range, jnp.clip(raw, 0, len(branches) - 1),
+                       len(branches) - 1)
+        out_vals = jax.lax.switch(iv, branches, None)
+        sg = _out_stop_gradient([idx_t])
+        outs = [Tensor(v, stop_gradient=sg) for v in out_vals]
+        return jax.tree_util.tree_unflatten(box[0], outs)
+
+    from .. import ops
+
+    pairs = [(ops.equal(idx_t, Tensor(jnp.asarray(k, idx_t.value.dtype))), f)
+             for k, f in items]
+    if default is None:
+        default = items[-1][1]
+    return case(pairs, default=default)
+
+
+def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
+    """reference static/nn/static_pylayer.py: a forward fn with a
+    user-supplied backward. Rides PyLayer (one tape node whose pullback calls
+    ``backward_fn``); under capture the whole block is recorded as one
+    re-executed entry, so the custom backward applies at replay too."""
+    from ..autograd.py_layer import PyLayer
+
+    inputs = list(inputs)
+
+    class _StaticPyLayer(PyLayer):
+        @staticmethod
+        def forward(ctx, *args):
+            return forward_fn(*args)
+
+        @staticmethod
+        def backward(ctx, *grads):
+            return backward_fn(*grads)
+
+    if backward_fn is None:
+        with tape.no_grad():
+            out = forward_fn(*inputs)
+        for o in jax.tree_util.tree_flatten(out, is_leaf=_is_tensor)[0]:
+            if _is_tensor(o):
+                o.stop_gradient = True
+        return out
+
+    prog = _capture.active()
+    if prog is None:
+        return _StaticPyLayer.apply(*inputs)
+
+    # capture: run once (capture suspended) for shapes, record one entry
+    _capture.set_active(None)
+    try:
+        out = _StaticPyLayer.apply(*inputs)
+    finally:
+        _capture.set_active(prog)
+    flat, tree = jax.tree_util.tree_flatten(out, is_leaf=_is_tensor)
+    outs = [Tensor(o.value, stop_gradient=o.stop_gradient) if _is_tensor(o)
+            else o for o in flat]
+
+    def runner(live):
+        res = _StaticPyLayer.apply(*live)
+        rflat, _ = jax.tree_util.tree_flatten(res, is_leaf=_is_tensor)
+        return tuple(r if _is_tensor(r) else Tensor(jnp.asarray(r))
+                     for r in rflat)
+
+    prog._record_op("pyctrl", runner, inputs,
+                    [o for o in outs if _is_tensor(o)])
+    return jax.tree_util.tree_unflatten(tree, outs)
+
+
+def py_func(func, x, out=None, backward_func=None,
+            skip_vars_in_backward_input=None):
+    """static.nn.py_func — same host-call shim as paddle.static.py_func."""
+    from . import py_func as _pf
+
+    return _pf(func, x, out=out, backward_func=backward_func,
+               skip_vars_in_backward_input=skip_vars_in_backward_input)
+
+
+# --------------------------------------------------------------------------- #
+# declarative builders (reference static/nn/common.py)
+# --------------------------------------------------------------------------- #
+
+_UNIQUE = [0]
+
+
+def _uname(base):
+    _UNIQUE[0] += 1
+    return f"{base}_{_UNIQUE[0]}"
+
+
+def _register(layer_or_params, base):
+    """Register builder-created parameters on the active Program so
+    ``optimizer.minimize`` with no parameter list finds them (reference
+    static-mode program parameter collection)."""
+    prog = _capture.active()
+    params = (layer_or_params.parameters()
+              if hasattr(layer_or_params, "parameters")
+              else list(layer_or_params))
+    name = _uname(base)
+    for i, p in enumerate(params):
+        if not p.name:
+            p.name = f"{name}.w_{i}"
+        if prog is not None:
+            prog._parameters.append(p)
+    return params
+
+
+def _act(activation, out):
+    if activation is None:
+        return out
+    from ..nn import functional as F
+
+    fn = getattr(F, activation, None)
+    if fn is None:
+        raise ValueError(f"unknown activation {activation!r}")
+    return fn(out)
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """reference static/nn/common.py:48 fc: flatten trailing dims, one weight
+    per input (multiple inputs are summed), shared bias, optional act."""
+    from .. import ops
+    from ..nn.initializer import XavierUniform
+
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = []
+    ws = []
+    for xi in xs:
+        shape = xi.shape
+        if num_flatten_dims < 0:
+            num_flatten_dims = len(shape) + num_flatten_dims
+        in_dim = int(np.prod([int(s) for s in shape[num_flatten_dims:]]))
+        w_init = XavierUniform()
+        w = Parameter(jnp.asarray(
+            w_init((in_dim, size), np.dtype(xi.dtype))))
+        ws.append(w)
+        # leading dims pass through untouched (a placeholder's _SymDim dim
+        # re-resolves from the feed at replay); the first one becomes -1 so
+        # the projection is batch-size polymorphic even on derived tensors
+        lead = list(shape[:num_flatten_dims])
+        if lead:
+            lead[0] = -1
+        flat = ops.reshape(xi, lead + [in_dim])
+        outs.append(ops.matmul(flat, w))
+    out = outs[0]
+    for o in outs[1:]:
+        out = ops.add(out, o)
+    params = list(ws)
+    if bias_attr is not False:
+        b = Parameter(jnp.zeros((size,), out.value.dtype))
+        out = ops.add(out, b)
+        params.append(b)
+    _register(params, name or "fc")
+    return _act(activation, out)
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,  # noqa: A002
+              padding_idx=None, param_attr=None, dtype="float32", name=None):
+    """reference common.py embedding: lookup table [size[0], size[1]]."""
+    from ..nn import functional as F
+    from ..nn.initializer import XavierUniform
+
+    w_init = XavierUniform()
+    w = Parameter(jnp.asarray(w_init(tuple(int(s) for s in size),
+                                     np.dtype(dtype))))
+    _register([w], name or "embedding")
+    return F.embedding(input, w, padding_idx=padding_idx)
+
+
+def sparse_embedding(input, size, padding_idx=None, param_attr=None,  # noqa: A002
+                     dtype="float32", **kwargs):
+    """reference sparse_embedding (PS large-scale table): on TPU the table is
+    a dense HBM-resident parameter — same lookup semantics, GSPMD-shardable
+    along the vocab axis (the id-sharded PS tier lives in distributed/ps)."""
+    return embedding(input, size, padding_idx=padding_idx,
+                     param_attr=param_attr, dtype=dtype)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9,  # noqa: A002
+               epsilon=1e-5, param_attr=None, bias_attr=None,
+               data_layout="NCHW", in_place=False, name=None,
+               moving_mean_name=None, moving_variance_name=None,
+               do_model_average_for_mean_and_var=True, use_global_stats=False):
+    from .. import nn
+
+    ch_axis = 1 if data_layout in ("NCHW", "NCDHW", "NCL") else -1
+    num_channels = int(input.shape[ch_axis])
+    layer = nn.BatchNorm(num_channels, momentum=momentum, epsilon=epsilon,
+                         data_format=data_layout)
+    if is_test or use_global_stats:
+        layer.eval()
+    _register(layer, name or "batch_norm")
+    return _act(act, layer(input))
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,  # noqa: A002
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    from .. import nn
+
+    normalized_shape = [int(s) for s in input.shape[begin_norm_axis:]]
+    layer = nn.LayerNorm(normalized_shape, epsilon=epsilon)
+    if not scale:
+        layer.weight = None
+    if not shift:
+        layer.bias = None
+    _register([p for p in (layer.weight, layer.bias) if p is not None],
+              name or "layer_norm")
+    return _act(act, layer(input))
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,  # noqa: A002
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    from .. import nn
+
+    ch_axis = 1 if data_layout == "NCHW" else -1
+    layer = nn.GroupNorm(num_groups=groups,
+                         num_channels=int(input.shape[ch_axis]),
+                         epsilon=epsilon)
+    _register(layer, name or "group_norm")
+    return _act(act, layer(input))
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,  # noqa: A002
+                  name=None):
+    from .. import nn
+
+    n_ch = int(input.shape[1])
+    cls = {3: nn.InstanceNorm1D, 4: nn.InstanceNorm2D,
+           5: nn.InstanceNorm3D}[input.ndim]
+    layer = cls(n_ch, epsilon=epsilon)
+    _register(layer, name or "instance_norm")
+    return layer(input)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,  # noqa: A002
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999,
+              enable_scale_and_shift=False):
+    """reference common.py data_norm: normalization by accumulated batch
+    statistics (batch_size/batch_sum/batch_square_sum), CTR-style."""
+    from .. import ops
+
+    d = int(input.shape[-1])
+    dt = input.value.dtype
+    batch_size = Parameter(jnp.full((d,), 1e4, dt))
+    batch_sum = Parameter(jnp.zeros((d,), dt))
+    batch_sq = Parameter(jnp.full((d,), 1e4, dt))
+    for p in (batch_size, batch_sum, batch_sq):
+        p.stop_gradient = True
+    _register([batch_size, batch_sum, batch_sq], name or "data_norm")
+    mean = ops.divide(batch_sum, batch_size)
+    scale = ops.rsqrt(ops.add(ops.divide(batch_sq, batch_size),
+                              Tensor(jnp.asarray(epsilon, dt))))
+    out = ops.multiply(ops.subtract(input, mean), scale)
+    return _act(act, out)
+
+
+def _conv(builder_cls, input, num_filters, filter_size, stride, padding,  # noqa: A002
+          dilation, groups, bias_attr, act, data_format, name, base):
+    layer = builder_cls(
+        in_channels=int(input.shape[1 if data_format.startswith("NC") else -1]),
+        out_channels=num_filters, kernel_size=filter_size, stride=stride,
+        padding=padding, dilation=dilation, groups=groups or 1,
+        bias_attr=bias_attr, data_format=data_format)
+    _register(layer, name or base)
+    return _act(act, layer(input))
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,  # noqa: A002
+           dilation=1, groups=None, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None, data_format="NCHW"):
+    from .. import nn
+
+    return _conv(nn.Conv2D, input, num_filters, filter_size, stride, padding,
+                 dilation, groups, bias_attr, act, data_format, name, "conv2d")
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,  # noqa: A002
+           dilation=1, groups=None, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None, data_format="NCDHW"):
+    from .. import nn
+
+    return _conv(nn.Conv3D, input, num_filters, filter_size, stride, padding,
+                 dilation, groups, bias_attr, act, data_format, name, "conv3d")
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,  # noqa: A002
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCHW"):
+    from .. import nn
+
+    if filter_size is None:
+        raise ValueError("filter_size is required (output_size-only inference "
+                         "is not provided in the TPU build)")
+    return _conv(nn.Conv2DTranspose, input, num_filters, filter_size, stride,
+                 padding, dilation, groups, bias_attr, act, data_format, name,
+                 "conv2d_transpose")
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,  # noqa: A002
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCDHW"):
+    from .. import nn
+
+    if filter_size is None:
+        raise ValueError("filter_size is required (output_size-only inference "
+                         "is not provided in the TPU build)")
+    return _conv(nn.Conv3DTranspose, input, num_filters, filter_size, stride,
+                 padding, dilation, groups, bias_attr, act, data_format, name,
+                 "conv3d_transpose")
+
+
+def deform_conv2d(input, offset, mask, num_filters, filter_size, stride=1,  # noqa: A002
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None, name=None):
+    from ..nn.initializer import XavierUniform
+    from ..vision.ops import deform_conv2d as _dcn
+
+    ks = (filter_size if isinstance(filter_size, (list, tuple))
+          else (filter_size, filter_size))
+    c_in = int(input.shape[1])
+    w_init = XavierUniform()
+    weight = Parameter(jnp.asarray(w_init(
+        (num_filters, c_in // groups, int(ks[0]), int(ks[1])),
+        np.dtype(input.dtype))))
+    params = [weight]
+    bias = None
+    if bias_attr is not False:
+        bias = Parameter(jnp.zeros((num_filters,), input.value.dtype))
+        params.append(bias)
+    _register(params, name or "deform_conv2d")
+    return _dcn(input, offset, weight, bias=bias, stride=stride,
+                padding=padding, dilation=dilation,
+                deformable_groups=deformable_groups, groups=groups, mask=mask)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None, param_attr=None,
+                            bias_attr=None):
+    from .. import nn
+
+    layer = nn.Bilinear(int(x.shape[-1]), int(y.shape[-1]), size,
+                        bias_attr=bias_attr)
+    _register(layer, name or "bilinear_tensor_product")
+    return _act(act, layer(x, y))
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    """reference common.py prelu: modes all (one alpha), channel (C alphas),
+    element (per-element alphas)."""
+    from ..nn import functional as F
+
+    if mode == "all":
+        shape = (1,)
+    elif mode == "channel":
+        ch_axis = 1 if data_format == "NCHW" else -1
+        shape = (int(x.shape[ch_axis]),)
+    elif mode == "element":
+        shape = tuple(int(s) for s in x.shape[1:])
+    else:
+        raise ValueError(f"mode must be all/channel/element, got {mode!r}")
+    alpha = Parameter(jnp.full(shape, 0.25, x.value.dtype))
+    _register([alpha], name or "prelu")
+    return F.prelu(x, alpha, data_format=data_format)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):  # noqa: A002
+    """reference common.py row_conv (lookahead conv over time, [B, T, D]):
+    out[t] = sum_{i=0..k} x[t+i] * w[i] with per-channel weights."""
+    from .. import ops
+
+    k = int(future_context_size)
+    d = int(input.shape[-1])
+    w = Parameter(jnp.full((k + 1, d), 1.0 / (k + 1), input.value.dtype))
+    _register([w], "row_conv")
+    t_len = int(input.shape[1])
+    zeros_row = ops.zeros_like(ops.slice(input, [1], [0], [1]))
+    padded = ops.concat([input, ops.tile(zeros_row, [1, k, 1])], axis=1)
+    out = None
+    for i in range(k + 1):
+        term = ops.multiply(ops.slice(padded, [1], [i], [i + t_len]),
+                            ops.slice(w, [0], [i], [i + 1]))
+        out = term if out is None else ops.add(out, term)
+    return _act(act, out)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """reference common.py spectral_norm: normalize a weight by its largest
+    singular value via power iteration (fresh u per call; the iterative state
+    wraps into the graph — XLA fuses the few matvecs)."""
+    from .. import ops
+
+    w = weight
+    shape = [int(s) for s in w.shape]
+    if dim != 0:
+        perm = [dim] + [i for i in range(len(shape)) if i != dim]
+        w = ops.transpose(w, perm)
+        shape = [shape[p] for p in perm]
+    h = shape[0]
+    mat = ops.reshape(w, [h, -1])
+    u = Tensor(jax.random.normal(jax.random.PRNGKey(0), (h,),
+                                 mat.value.dtype))
+    epsilon = Tensor(jnp.asarray(eps, mat.value.dtype))
+    for _ in range(max(1, power_iters)):
+        v = ops.matmul(mat, u, transpose_x=True)
+        v = ops.divide(v, ops.add(ops.norm(v), epsilon))
+        u = ops.matmul(mat, v)
+        u = ops.divide(u, ops.add(ops.norm(u), epsilon))
+    sigma = ops.matmul(u, ops.matmul(mat, v))
+    out = ops.divide(w, ops.add(sigma, epsilon))
+    if dim != 0:
+        inv = list(np.argsort(perm))
+        out = ops.transpose(out, inv)
+    return out
+
+
+def nce(input, label, num_total_classes, sample_weight=None,  # noqa: A002
+        param_attr=None, bias_attr=None, num_neg_samples=None, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """reference common.py nce: noise-contrastive estimation loss. Uniform
+    negative sampling on-device via the framework RNG (fresh negatives per
+    execution — under capture the sampling op itself is recorded, so every
+    Executor.run resamples); returns per-example loss [B, 1]."""
+    from .. import ops
+    from ..nn import functional as F
+    from ..nn.initializer import XavierUniform
+
+    d = int(input.shape[-1])
+    k = int(num_neg_samples or 10)
+    w_init = XavierUniform()
+    w = Parameter(jnp.asarray(w_init((num_total_classes, d),
+                                     np.dtype(input.dtype))))
+    bias = Parameter(jnp.zeros((num_total_classes,), input.value.dtype))
+    _register([w, bias], name or "nce")
+
+    lab = ops.reshape(label, [-1, 1]).astype("int64")
+
+    # sampling rides the op tape/capture (apply_raw) so each Executor.run —
+    # and each eager call — draws fresh negatives at the live batch size
+    from ..framework import random as _rng
+    from ..ops._apply import apply_raw
+
+    def _sample(lab_val):
+        return jax.random.randint(_rng.next_key(), (lab_val.shape[0], k),
+                                  0, num_total_classes)
+
+    (neg,) = apply_raw("nce_negative_sample", _sample, [lab])
+    # logits for the true class and k sampled negatives: [B, 1+k]
+    idx = ops.concat([lab, neg], axis=1)
+    w_rows = ops.gather(w, ops.reshape(idx, [-1]))
+    w_rows = ops.reshape(w_rows, [-1, 1 + k, d])
+    b_rows = ops.reshape(ops.gather(bias, ops.reshape(idx, [-1])),
+                         [-1, 1 + k])
+    logits = ops.add(ops.squeeze(
+        ops.matmul(w_rows, ops.unsqueeze(input, axis=-1)), axis=-1), b_rows)
+    # bce-with-logits against target [1, 0...0] without materializing targets:
+    # positive column -> softplus(-x), negative columns -> softplus(x)
+    pos = F.softplus(ops.scale(ops.slice(logits, [1], [0], [1]), -1.0))
+    negl = F.softplus(ops.slice(logits, [1], [1], [1 + k]))
+    return ops.add(ops.sum(pos, axis=1, keepdim=True),
+                   ops.sum(negl, axis=1, keepdim=True))
+
+
+# --------------------------------------------------------------------------- #
+# sequence ops — dense padded [batch, time, ...] (+ optional seq_lens mask)
+# --------------------------------------------------------------------------- #
+
+def _time_mask(x, seq_lens):
+    """[B, T] float mask from per-row lengths (None -> all valid)."""
+    if seq_lens is None:
+        return None
+    lens = seq_lens.value if isinstance(seq_lens, Tensor) else jnp.asarray(
+        seq_lens)
+    t = int(x.shape[1])
+    return Tensor((jnp.arange(t)[None, :] < lens[:, None]).astype(
+        x.value.dtype))
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,  # noqa: A002
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None):
+    """reference sequence_lod.py sequence_conv: context-window projection
+    over time. Dense form: concat the window's frames, one fc."""
+    from .. import ops
+
+    if filter_stride != 1:
+        raise NotImplementedError("sequence_conv supports stride 1 "
+                                  "(reference kernel has the same limit)")
+    t, d = int(input.shape[1]), int(input.shape[2])
+    k = int(filter_size)
+    start = -((k - 1) // 2) if padding_start is None else int(padding_start)
+    cols = []
+    # batch-polymorphic zero row (derived from the input, never a baked dim)
+    zeros_row = ops.zeros_like(ops.slice(input, [1], [0], [1]))
+    for i in range(k):
+        off = start + i
+        if off <= -t or off >= t:
+            shifted = ops.tile(zeros_row, [1, t, 1])
+        elif off < 0:
+            pad = ops.tile(zeros_row, [1, -off, 1])
+            shifted = ops.concat([pad, ops.slice(input, [1], [0], [t + off])],
+                                 axis=1)
+        elif off == 0:
+            shifted = input
+        else:
+            pad = ops.tile(zeros_row, [1, off, 1])
+            shifted = ops.concat([ops.slice(input, [1], [off], [t]), pad],
+                                 axis=1)
+        cols.append(shifted)
+    window = ops.concat(cols, axis=-1)  # [B, T, k*D]
+    return fc(window, num_filters, num_flatten_dims=2, bias_attr=bias_attr,
+              activation=act, name=name or "sequence_conv")
+
+
+def sequence_softmax(input, use_cudnn=False, name=None, seq_lens=None):  # noqa: A002
+    """softmax within each sequence (over the time axis), padding masked."""
+    from .. import ops
+    from ..nn import functional as F
+
+    mask = _time_mask(input, seq_lens)
+    x = input
+    if mask is not None:
+        m = mask if x.ndim == 2 else ops.unsqueeze(mask, axis=-1)
+        neg = Tensor(jnp.asarray(-1e9, x.value.dtype))
+        x = ops.add(ops.multiply(x, m),
+                    ops.multiply(ops.subtract(
+                        Tensor(jnp.asarray(1.0, x.value.dtype)), m), neg))
+    return F.softmax(x, axis=1)
+
+
+def sequence_pool(input, pool_type="average", is_test=False, pad_value=0.0,  # noqa: A002
+                  seq_lens=None):
+    """reference sequence_pool: max/average/sum/sqrt/first/last over time."""
+    from .. import ops
+
+    pool_type = pool_type.lower()
+    mask = _time_mask(input, seq_lens)
+    x = input
+    if mask is not None and pool_type in ("average", "sum", "sqrt", "max"):
+        m = ops.unsqueeze(mask, axis=-1) if x.ndim > 2 else mask
+        if pool_type == "max":
+            neg = Tensor(jnp.asarray(-1e9, x.value.dtype))
+            x = ops.add(ops.multiply(x, m), ops.multiply(
+                ops.subtract(Tensor(jnp.asarray(1.0, x.value.dtype)), m), neg))
+        else:
+            x = ops.multiply(x, m)
+    if pool_type == "max":
+        return ops.max(x, axis=1)
+    if pool_type == "sum":
+        return ops.sum(x, axis=1)
+    if pool_type in ("average", "mean", "sqrt"):
+        s = ops.sum(x, axis=1)
+        if mask is not None:
+            n = ops.sum(mask, axis=1, keepdim=x.ndim > 2)
+        else:
+            n = Tensor(jnp.asarray(float(int(input.shape[1])),
+                                   x.value.dtype))
+        if pool_type == "sqrt":
+            return ops.divide(s, ops.sqrt(n))
+        return ops.divide(s, n)
+    if pool_type == "first":
+        return sequence_first_step(input)
+    if pool_type == "last":
+        return sequence_last_step(input, seq_lens=seq_lens)
+    raise ValueError(f"unknown pool_type {pool_type!r}")
+
+
+def sequence_first_step(input):  # noqa: A002
+    from .. import ops
+
+    return ops.squeeze(ops.slice(input, [1], [0], [1]), axis=1)
+
+
+def sequence_last_step(input, seq_lens=None):  # noqa: A002
+    from .. import ops
+    from ..nn import functional as F
+
+    t = int(input.shape[1])
+    if seq_lens is None:
+        return ops.squeeze(ops.slice(input, [1], [t - 1], [t]), axis=1)
+    lens = (seq_lens if isinstance(seq_lens, Tensor)
+            else Tensor(jnp.asarray(seq_lens)))
+    idx = ops.subtract(lens.astype("int64"),
+                       Tensor(jnp.asarray(1, jnp.int64)))
+    # one-hot contraction over time: gather-free, differentiable, MXU-friendly
+    m = F.one_hot(idx, t).astype(str(input.dtype))  # [B, T]
+    for _ in range(input.ndim - 2):
+        m = ops.unsqueeze(m, axis=-1)
+    return ops.sum(ops.multiply(input, m), axis=1)
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    """reference sequence_expand: broadcast each row of x along y's time
+    axis. Dense form: x [B, D] (or [B, 1, D]) -> [B, T_y, D]."""
+    from .. import ops
+
+    t = int(y.shape[1])
+    xe = x if x.ndim == 3 else ops.unsqueeze(x, axis=1)
+    return ops.tile(xe, [1, t] + [1] * (xe.ndim - 2))

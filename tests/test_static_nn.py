@@ -1,0 +1,580 @@
+"""paddle.static.nn: control flow (cond/while_loop/case/switch_case/
+static_pylayer) across the three execution modes, declarative builders, and
+the _SymDim dynamic-dim re-resolution fix (round-3 advisor medium finding).
+
+Reference analog: test/legacy_test/test_cond.py, test_while_loop_op.py,
+test_case.py, test_switch_case.py, test_static_pylayer.py, test_fc_op.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.static import nn as snn
+
+
+def _t(x, **kw):
+    return paddle.to_tensor(np.asarray(x), **kw)
+
+
+# --------------------------------------------------------------------------- #
+# cond
+# --------------------------------------------------------------------------- #
+
+class TestCondEager:
+    def test_picks_branch(self):
+        a = _t([1.0])
+        b = _t([2.0])
+        out = snn.cond(a < b, lambda: a + b, lambda: a * b)
+        assert float(out.numpy()[0]) == 3.0
+        out = snn.cond(a > b, lambda: a + b, lambda: a * b)
+        assert float(out.numpy()[0]) == 2.0
+
+    def test_none_fns(self):
+        assert snn.cond(_t([1.0]) > 0) is None
+
+    def test_nest_structure(self):
+        p = _t([0.1]) < _t([0.23])
+        a, b = snn.cond(p, lambda: (_t([1]), _t([2])),
+                        lambda: (_t([3]), _t([4])))
+        assert int(a.numpy()[0]) == 1 and int(b.numpy()[0]) == 2
+
+    def test_grad_through_taken_branch(self):
+        x = _t([3.0], stop_gradient=False)
+        out = snn.cond(x.sum() > 0, lambda: x * 2.0, lambda: x * 5.0)
+        out.backward()
+        assert float(x.grad.numpy()[0]) == 2.0
+
+    def test_numel_check(self):
+        with pytest.raises(ValueError):
+            snn.cond(_t([1.0, 2.0]) > 0, lambda: _t([1.0]), lambda: _t([2.0]))
+
+
+class TestCondTraced:
+    def test_compiled_dynamic_branch(self):
+        """The capability round-3 VERDICT flagged as impossible: compiled
+        data-dependent control flow — one program, both branches staged."""
+
+        @paddle.jit.to_static
+        def f(x):
+            return snn.cond(x.sum() > 0, lambda: x * 2.0, lambda: x - 1.0)
+
+        x = _t([1.0, 2.0])
+        np.testing.assert_allclose(f(x).numpy(), [2.0, 4.0])
+        # same compiled signature, opposite predicate -> other branch taken
+        y = _t([-1.0, -2.0])
+        np.testing.assert_allclose(f(y).numpy(), [-2.0, -3.0])
+        assert len(f.concrete_program_specs()) == 1  # ONE program, real cond
+
+    def test_grad_through_traced_cond(self):
+        def f(x):
+            return snn.cond(x.sum() > 0, lambda: (x * 2.0).sum(),
+                            lambda: (x * 5.0).sum())
+
+        x = _t([1.0, 2.0], stop_gradient=False)
+        sf = paddle.jit.to_static(f)
+        out = sf(x)
+        out.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+        x2 = _t([-1.0, -2.0], stop_gradient=False)
+        out2 = sf(x2)
+        out2.backward()
+        np.testing.assert_allclose(x2.grad.numpy(), [5.0, 5.0])
+
+
+class TestCondCaptured:
+    def test_executor_redecides_per_run(self):
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            with static.program_guard(main, static.Program()):
+                x = static.data("x", [2], "float32")
+                out = snn.cond(x.sum() > 0, lambda: x * 2.0, lambda: x - 1.0)
+                out.name = "out"
+            exe = static.Executor()
+            (r1,) = exe.run(main, feed={"x": np.array([1., 2.], "float32")},
+                            fetch_list=["out"])
+            np.testing.assert_allclose(r1, [2.0, 4.0])
+            (r2,) = exe.run(main, feed={"x": np.array([-1., -2.], "float32")},
+                            fetch_list=["out"])
+            np.testing.assert_allclose(r2, [-2.0, -3.0])
+        finally:
+            paddle.disable_static()
+
+    def test_structure_mismatch_raises(self):
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            with static.program_guard(main, static.Program()):
+                x = static.data("x", [2], "float32")
+                with pytest.raises(TypeError):
+                    snn.cond(x.sum() > 0, lambda: (x, x), lambda: x)
+        finally:
+            paddle.disable_static()
+
+
+# --------------------------------------------------------------------------- #
+# while_loop
+# --------------------------------------------------------------------------- #
+
+class TestWhileLoop:
+    def test_eager(self):
+        i = _t(np.asarray(0, "int64"))
+        ten = _t(np.asarray(10, "int64"))
+        out = snn.while_loop(lambda i: i < ten, lambda i: i + 1, [i])
+        assert int(out[0].numpy()) == 10
+
+    def test_eager_multi_var(self):
+        i = _t(np.asarray(0, "int64"))
+        s = _t([0.0])
+        out = snn.while_loop(lambda i, s: i < 5,
+                             lambda i, s: [i + 1, s + 2.0], [i, s])
+        assert int(out[0].numpy()) == 5
+        assert float(out[1].numpy()[0]) == 10.0
+
+    def test_eager_grad(self):
+        x = _t([2.0], stop_gradient=False)
+        i = _t(np.asarray(0, "int64"))
+        out = snn.while_loop(lambda i, v: i < 3,
+                             lambda i, v: [i + 1, v * 2.0], [i, x])
+        out[1].backward()
+        assert float(x.grad.numpy()[0]) == 8.0  # d(8x)/dx
+
+    def test_traced_lax_while(self):
+        @paddle.jit.to_static
+        def f(x):
+            n = paddle.to_tensor(np.asarray(0, "int64"))
+            out = snn.while_loop(
+                lambda i, v: i < 4,
+                lambda i, v: [i + 1, v * 2.0], [n, x])
+            return out[1]
+
+        x = _t([1.0, 3.0])
+        np.testing.assert_allclose(f(x).numpy(), [16.0, 48.0])
+        # data-dependent trip count inside ONE compiled program
+        assert len(f.concrete_program_specs()) == 1
+
+    def test_traced_data_dependent_bound(self):
+        @paddle.jit.to_static
+        def f(x, bound):
+            i = paddle.to_tensor(np.asarray(0, "int64"))
+            out = snn.while_loop(lambda i, v: i < bound,
+                                 lambda i, v: [i + 1, v + 1.0], [i, x])
+            return out[1]
+
+        x = _t([0.0])
+        np.testing.assert_allclose(
+            f(x, _t(np.asarray(3, "int64"))).numpy(), [3.0])
+        np.testing.assert_allclose(
+            f(x, _t(np.asarray(7, "int64"))).numpy(), [7.0])
+        assert len(f.concrete_program_specs()) == 1
+
+    def test_captured_reexecutes_per_feed(self):
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            with static.program_guard(main, static.Program()):
+                x = static.data("x", [1], "float32")
+                i = paddle.to_tensor(np.asarray(0, "int64"))
+                out = snn.while_loop(lambda i, v: v.sum() < 20.0,
+                                     lambda i, v: [i + 1, v * 2.0], [i, x])
+                out[1].name = "out"
+            exe = static.Executor()
+            (r,) = exe.run(main, feed={"x": np.array([1.0], "float32")},
+                           fetch_list=["out"])
+            np.testing.assert_allclose(r, [32.0])
+            (r2,) = exe.run(main, feed={"x": np.array([15.0], "float32")},
+                            fetch_list=["out"])
+            np.testing.assert_allclose(r2, [30.0])
+        finally:
+            paddle.disable_static()
+
+    def test_validation(self):
+        with pytest.raises(TypeError):
+            snn.while_loop(1, lambda i: i, [_t([1.0])])
+        with pytest.raises(ValueError):
+            snn.while_loop(lambda: True, lambda: 1, [])
+
+
+# --------------------------------------------------------------------------- #
+# case / switch_case
+# --------------------------------------------------------------------------- #
+
+class TestCase:
+    def test_first_true_wins(self):
+        x = _t([0.3])
+        y = _t([0.1])
+        out = snn.case([(x < y, lambda: x + y), (x > y, lambda: x - y)],
+                       default=lambda: x * y)
+        np.testing.assert_allclose(out.numpy(), [0.2], atol=1e-6)
+
+    def test_default_when_none_match(self):
+        x = _t([0.3])
+        y = _t([0.1])
+        out = snn.case([(x < y, lambda: x + y)], default=lambda: x * y)
+        np.testing.assert_allclose(out.numpy(), [0.03], atol=1e-6)
+
+    def test_last_fn_is_default(self):
+        x = _t([0.3])
+        y = _t([0.1])
+        out = snn.case([(x < y, lambda: x + y), (x < y, lambda: x - y)])
+        np.testing.assert_allclose(out.numpy(), [0.2], atol=1e-6)
+
+    def test_traced(self):
+        @paddle.jit.to_static
+        def f(x):
+            return snn.case([(x.sum() < 0, lambda: x * 0.0),
+                             (x.sum() < 10, lambda: x * 2.0)],
+                            default=lambda: x * 3.0)
+
+        np.testing.assert_allclose(f(_t([1.0])).numpy(), [2.0])
+        np.testing.assert_allclose(f(_t([20.0])).numpy(), [60.0])
+        np.testing.assert_allclose(f(_t([-5.0])).numpy(), [-0.0])
+
+
+class TestSwitchCase:
+    def test_dict_fns(self):
+        idx = _t(np.asarray(2, "int32"))
+        out = snn.switch_case(idx, {1: lambda: _t([1.0]),
+                                    2: lambda: _t([2.0])},
+                              default=lambda: _t([9.0]))
+        assert float(out.numpy()[0]) == 2.0
+
+    def test_default_on_miss(self):
+        idx = _t(np.asarray(7, "int32"))
+        out = snn.switch_case(idx, {1: lambda: _t([1.0]),
+                                    2: lambda: _t([2.0])},
+                              default=lambda: _t([9.0]))
+        assert float(out.numpy()[0]) == 9.0
+
+    def test_traced_lax_switch(self):
+        @paddle.jit.to_static
+        def f(idx, x):
+            return snn.switch_case(
+                idx, [lambda: x * 1.0, lambda: x * 2.0, lambda: x * 3.0],
+                default=lambda: x * 0.0)
+
+        x = _t([1.0, 1.0])
+        np.testing.assert_allclose(f(_t(np.asarray(1, "int32")), x).numpy(),
+                                   [2.0, 2.0])
+        np.testing.assert_allclose(f(_t(np.asarray(5, "int32")), x).numpy(),
+                                   [0.0, 0.0])
+        assert len(f.concrete_program_specs()) == 1
+
+    def test_duplicate_keys(self):
+        with pytest.raises(ValueError):
+            snn.switch_case(_t(np.asarray(0, "int32")),
+                            [(0, lambda: _t([1.0])), (0, lambda: _t([2.0]))])
+
+
+# --------------------------------------------------------------------------- #
+# static_pylayer
+# --------------------------------------------------------------------------- #
+
+class TestStaticPyLayer:
+    def test_custom_backward_eager(self):
+        x = _t([2.0], stop_gradient=False)
+        out = snn.static_pylayer(lambda v: v * 3.0, [x],
+                                 backward_fn=lambda g: g * 100.0)
+        out.backward()
+        np.testing.assert_allclose(out.numpy(), [6.0])
+        np.testing.assert_allclose(x.grad.numpy(), [100.0])
+
+    def test_no_backward_stops_gradient(self):
+        x = _t([2.0], stop_gradient=False)
+        out = snn.static_pylayer(lambda v: v * 3.0, [x])
+        assert out.stop_gradient
+
+    def test_captured_replay_custom_backward(self):
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            with static.program_guard(main, static.Program()):
+                x = static.data("x", [1], "float32")
+                x.stop_gradient = False
+                out = snn.static_pylayer(lambda v: v * 3.0, [x],
+                                         backward_fn=lambda g: g * 100.0)
+                out.name = "out"
+            exe = static.Executor()
+            (r,) = exe.run(main, feed={"x": np.array([5.0], "float32")},
+                           fetch_list=["out"])
+            np.testing.assert_allclose(r, [15.0])
+        finally:
+            paddle.disable_static()
+
+
+# --------------------------------------------------------------------------- #
+# builders
+# --------------------------------------------------------------------------- #
+
+class TestBuilders:
+    def test_fc_shapes_and_multi_input(self):
+        x = _t(np.random.RandomState(0).randn(4, 8).astype("float32"))
+        out = snn.fc(x, 16)
+        assert out.shape == [4, 16]
+        out2 = snn.fc([x, x], 16)
+        assert out2.shape == [4, 16]
+
+    def test_fc_num_flatten_dims(self):
+        x = _t(np.random.RandomState(0).randn(2, 3, 4, 5).astype("float32"))
+        out = snn.fc(x, 7, num_flatten_dims=2)
+        assert out.shape == [2, 3, 7]
+
+    def test_embedding(self):
+        ids = _t(np.array([[1, 2], [3, 0]], "int64"))
+        out = snn.embedding(ids, (10, 6))
+        assert out.shape == [2, 2, 6]
+        out2 = snn.sparse_embedding(ids, (10, 6))
+        assert out2.shape == [2, 2, 6]
+
+    def test_norm_builders(self):
+        x = _t(np.random.RandomState(0).randn(2, 6, 4, 4).astype("float32"))
+        assert snn.batch_norm(x).shape == [2, 6, 4, 4]
+        assert snn.layer_norm(x, begin_norm_axis=1).shape == [2, 6, 4, 4]
+        assert snn.group_norm(x, groups=3).shape == [2, 6, 4, 4]
+        assert snn.instance_norm(x).shape == [2, 6, 4, 4]
+
+    def test_conv_builders(self):
+        x = _t(np.random.RandomState(0).randn(2, 3, 8, 8).astype("float32"))
+        assert snn.conv2d(x, 5, 3, padding=1).shape == [2, 5, 8, 8]
+        assert snn.conv2d_transpose(x, 5, filter_size=2,
+                                    stride=2).shape == [2, 5, 16, 16]
+        x3 = _t(np.random.RandomState(0).randn(1, 2, 4, 4, 4)
+                .astype("float32"))
+        assert snn.conv3d(x3, 3, 3, padding=1).shape == [1, 3, 4, 4, 4]
+
+    def test_bilinear_prelu_spectral(self):
+        r = np.random.RandomState(0)
+        x = _t(r.randn(3, 4).astype("float32"))
+        y = _t(r.randn(3, 5).astype("float32"))
+        assert snn.bilinear_tensor_product(x, y, 6).shape == [3, 6]
+        img = _t(r.randn(2, 3, 4, 4).astype("float32"))
+        assert snn.prelu(img, mode="channel").shape == [2, 3, 4, 4]
+        w = _t(r.randn(6, 8).astype("float32"))
+        sn = snn.spectral_norm(w, power_iters=4)
+        # largest singular value of the normalized matrix ~ 1
+        s = np.linalg.svd(sn.numpy(), compute_uv=False)[0]
+        assert abs(s - 1.0) < 0.15
+
+    def test_data_norm_and_row_conv(self):
+        r = np.random.RandomState(0)
+        x = _t(r.randn(4, 6).astype("float32"))
+        assert snn.data_norm(x).shape == [4, 6]
+        seq = _t(r.randn(2, 5, 3).astype("float32"))
+        assert snn.row_conv(seq, 2).shape == [2, 5, 3]
+
+    def test_nce_loss(self):
+        r = np.random.RandomState(0)
+        x = _t(r.randn(4, 8).astype("float32"))
+        lab = _t(r.randint(0, 20, (4, 1)).astype("int64"))
+        loss = snn.nce(x, lab, 20, num_neg_samples=5)
+        assert loss.shape == [4, 1]
+        assert np.all(np.isfinite(loss.numpy()))
+
+    def test_builders_train_via_minimize(self):
+        """fc params register on the Program; minimize() with no parameter
+        list trains them (reference static-mode param collection)."""
+        paddle.enable_static()
+        try:
+            paddle.seed(0)
+            main = static.Program()
+            with static.program_guard(main, static.Program()):
+                x = static.data("x", [None, 4], "float32")
+                y = static.data("y", [None, 1], "float32")
+                h = snn.fc(x, 8, activation="relu")
+                pred = snn.fc(h, 1)
+                loss = ((pred - y) ** 2).mean()
+                loss.name = "loss"
+                opt = paddle.optimizer.SGD(learning_rate=0.1)
+                opt.minimize(loss)
+            assert len(main.all_parameters()) == 4
+            exe = static.Executor()
+            r = np.random.RandomState(0)
+            xb = r.randn(16, 4).astype("float32")
+            yb = (xb.sum(1, keepdims=True) * 0.5).astype("float32")
+            losses = []
+            for _ in range(30):
+                (lv,) = exe.run(main, feed={"x": xb, "y": yb},
+                                fetch_list=["loss"])
+                losses.append(float(lv))
+            assert losses[-1] < losses[0] * 0.5
+        finally:
+            paddle.disable_static()
+
+
+# --------------------------------------------------------------------------- #
+# sequence ops (dense padded form)
+# --------------------------------------------------------------------------- #
+
+class TestSequenceOps:
+    def setup_method(self):
+        r = np.random.RandomState(0)
+        self.x = r.randn(2, 4, 3).astype("float32")
+        self.lens = np.array([2, 4], "int64")
+
+    def test_sequence_pool_modes(self):
+        x = _t(self.x)
+        lens = _t(self.lens)
+        np.testing.assert_allclose(
+            snn.sequence_pool(x, "sum", seq_lens=lens).numpy(),
+            np.stack([self.x[0, :2].sum(0), self.x[1].sum(0)]), rtol=1e-5)
+        np.testing.assert_allclose(
+            snn.sequence_pool(x, "average", seq_lens=lens).numpy(),
+            np.stack([self.x[0, :2].mean(0), self.x[1].mean(0)]), rtol=1e-5)
+        np.testing.assert_allclose(
+            snn.sequence_pool(x, "max", seq_lens=lens).numpy(),
+            np.stack([self.x[0, :2].max(0), self.x[1].max(0)]), rtol=1e-5)
+
+    def test_first_last_step(self):
+        x = _t(self.x)
+        np.testing.assert_allclose(snn.sequence_first_step(x).numpy(),
+                                   self.x[:, 0], rtol=1e-6)
+        np.testing.assert_allclose(snn.sequence_last_step(x).numpy(),
+                                   self.x[:, -1], rtol=1e-6)
+        np.testing.assert_allclose(
+            snn.sequence_last_step(x, seq_lens=_t(self.lens)).numpy(),
+            np.stack([self.x[0, 1], self.x[1, 3]]), rtol=1e-6)
+
+    def test_sequence_softmax_masked(self):
+        x = _t(self.x[:, :, 0])  # [B, T]
+        out = snn.sequence_softmax(x, seq_lens=_t(self.lens)).numpy()
+        np.testing.assert_allclose(out.sum(1), [1.0, 1.0], rtol=1e-5)
+        assert out[0, 2] < 1e-6 and out[0, 3] < 1e-6  # padding masked
+
+    def test_sequence_conv_expand(self):
+        x = _t(self.x)
+        out = snn.sequence_conv(x, 5, filter_size=3)
+        assert out.shape == [2, 4, 5]
+        small = _t(np.random.RandomState(1).randn(2, 3).astype("float32"))
+        assert snn.sequence_expand(small, x).shape == [2, 4, 3]
+
+
+# --------------------------------------------------------------------------- #
+# _SymDim: placeholder-derived dynamic dims re-resolve at replay
+# --------------------------------------------------------------------------- #
+
+class TestSymbolicDims:
+    def test_reshape_with_placeholder_batch_dim(self):
+        """The round-3 advisor medium finding: reshape(x, [x.shape[0], -1])
+        under capture must not bake the dim-1 placeholder batch size."""
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            with static.program_guard(main, static.Program()):
+                x = static.data("x", [None, 2, 3], "float32")
+                out = x.reshape([x.shape[0], 6])
+                out.name = "out"
+            exe = static.Executor()
+            feed = np.random.RandomState(0).randn(5, 2, 3).astype("float32")
+            (r,) = exe.run(main, feed={"x": feed}, fetch_list=["out"])
+            assert r.shape == (5, 6)
+            np.testing.assert_allclose(r, feed.reshape(5, 6), rtol=1e-6)
+        finally:
+            paddle.disable_static()
+
+    def test_arithmetic_on_dynamic_dim_warns(self):
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            with static.program_guard(main, static.Program()):
+                x = static.data("x", [None, 4], "float32")
+                with pytest.warns(UserWarning, match="dynamic placeholder"):
+                    _ = x.shape[0] * 2
+        finally:
+            paddle.disable_static()
+
+    def test_static_dims_stay_plain_ints(self):
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            with static.program_guard(main, static.Program()):
+                x = static.data("x", [None, 4], "float32")
+                assert type(x.shape[1]) is int
+                assert int(x.shape[1]) == 4
+        finally:
+            paddle.disable_static()
+
+
+# --------------------------------------------------------------------------- #
+# round-4 review regressions
+# --------------------------------------------------------------------------- #
+
+class TestReviewRegressions:
+    def test_switch_case_negative_index_takes_default_traced(self):
+        @paddle.jit.to_static
+        def f(idx, x):
+            return snn.switch_case(idx, [lambda: x * 1.0, lambda: x * 2.0],
+                                   default=lambda: x * 9.0)
+
+        xv = _t([1.0])
+        assert float(f(_t(np.asarray(-5, "int32")), xv).numpy()[0]) == 9.0
+
+    def test_minimize_explicit_parameters_in_static(self):
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            with static.program_guard(main, static.Program()):
+                x = static.data("x", [None, 4], "float32")
+                w = static.create_parameter([4, 1], "float32")
+                y = static.data("y", [None, 1], "float32")
+                loss = ((x @ w - y) ** 2).mean()
+                loss.name = "loss"
+                paddle.optimizer.SGD(learning_rate=0.1).minimize(
+                    loss, parameters=[w])
+            exe = static.Executor()
+            r = np.random.RandomState(0)
+            xb = r.randn(8, 4).astype("float32")
+            yb = xb.sum(1, keepdims=True).astype("float32")
+            l0 = float(exe.run(main, feed={"x": xb, "y": yb},
+                               fetch_list=["loss"])[0])
+            for _ in range(40):
+                lv = exe.run(main, feed={"x": xb, "y": yb},
+                             fetch_list=["loss"])[0]
+            assert float(lv) < l0 * 0.1
+        finally:
+            paddle.disable_static()
+
+    def test_minimize_without_any_parameters_raises(self):
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            with pytest.raises(Exception, match="no parameters"):
+                with static.program_guard(main, static.Program()):
+                    x = static.data("x", [2], "float32")
+                    loss = (x * 2.0).mean()
+                    paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        finally:
+            paddle.disable_static()
+
+    def test_dynamic_batch_sequence_and_nce_replay(self):
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            with static.program_guard(main, static.Program()):
+                x = static.data("seq", [None, 4, 3], "float32")
+                snn.sequence_conv(x, 2, filter_size=3).name = "sc"
+                snn.row_conv(x, 2).name = "rc"
+                feat = static.data("feat", [None, 8], "float32")
+                lab = static.data("lab", [None, 1], "int64")
+                snn.nce(feat, lab, 20, num_neg_samples=5).name = "nce"
+            exe = static.Executor()
+            r = np.random.RandomState(0)
+            feed = {"seq": r.randn(5, 4, 3).astype("float32"),
+                    "feat": r.randn(5, 8).astype("float32"),
+                    "lab": r.randint(0, 20, (5, 1)).astype("int64")}
+            sc, rc, nl = exe.run(main, feed=feed,
+                                 fetch_list=["sc", "rc", "nce"])
+            assert sc.shape == (5, 4, 2)
+            assert rc.shape == (5, 4, 3)
+            assert nl.shape == (5, 1)
+            # negatives resample per run (fresh noise for the estimator)
+            nl2 = exe.run(main, feed=feed, fetch_list=["nce"])[0]
+            assert not np.allclose(nl, nl2)
+        finally:
+            paddle.disable_static()
+
+    def test_ints_accepts_bool_scalar(self):
+        from paddle_tpu.ops.manipulation import _ints
+
+        assert _ints(True) == (1,)
+        assert _ints(np.int32(3)) == (3,)
