@@ -112,8 +112,13 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
     return _embedding(weight, x, padding_idx=idx)
 
 
+@defop("one_hot", differentiable=False)
+def _one_hot(x, num_classes):
+    return jax.nn.one_hot(x, num_classes, dtype=jnp.float32)
+
+
 def one_hot(x, num_classes, name=None):
-    return Tensor(jax.nn.one_hot(x.value, int(num_classes), dtype=jnp.float32))
+    return _one_hot(x, num_classes=int(num_classes))
 
 
 @defop("cosine_similarity", amp_category="black")
@@ -347,15 +352,19 @@ def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
     return _ls(label, prior_dist, epsilon=float(epsilon))
 
 
+@defop("sequence_mask", differentiable=False)
+def _sequence_mask(x, maxlen, np_dtype):
+    rng_ = jnp.arange(maxlen)
+    return (rng_[None, :] < x[..., None]).astype(np_dtype)
+
+
 def sequence_mask(x, maxlen=None, dtype="int64", name=None):
-    v = x.value
     if maxlen is None:
-        maxlen = int(np.asarray(jax.device_get(v)).max())
+        maxlen = int(np.asarray(jax.device_get(x.value)).max())
     from ...framework import dtype as dtype_mod
 
-    rng_ = jnp.arange(maxlen)
-    mask = rng_[None, :] < v[..., None]
-    return Tensor(mask.astype(dtype_mod.convert_dtype(dtype)))
+    return _sequence_mask(x, maxlen=int(maxlen),
+                          np_dtype=dtype_mod.convert_dtype(dtype))
 
 
 def diag_embed(x, offset=0, dim1=-2, dim2=-1):

@@ -214,14 +214,16 @@ class Optimizer:
         from ..framework import capture
 
         prog = capture.active()
+        # minimize's parameters= narrows the optimized set (reference
+        # parameter_list semantics) on any path, not just static binding
+        if parameters is not None:
+            self._param_groups[0]["params"] = list(parameters)
         if prog is not None:
             # static capture (program_guard): the reference appends backward +
             # update ops to the Program; here Executor.run performs
             # backward+step on the replayed loss each run() call
             if self._static_bind:
-                if parameters is not None:
-                    self._param_groups[0]["params"] = list(parameters)
-                elif getattr(prog, "_parameters", None):
+                if parameters is None and getattr(prog, "_parameters", None):
                     self._param_groups[0]["params"] = prog.all_parameters()
                 if not self._param_groups[0]["params"]:
                     from ..framework.enforce import InvalidArgumentError

@@ -105,3 +105,30 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None,
     if was_1d:
         sig = sig[0]
     return Tensor(sig)
+
+
+@defop("signal.overlap_add")
+def _overlap_add(x, hop_length=128, axis=-1):
+    # x: (..., frame_length, num_frames) when axis=-1, or
+    #    (num_frames, frame_length, ...) when axis=0 (reference contract;
+    #    the output keeps the signal on the same end: (..., seq) / (seq, ...))
+    if axis == 0:
+        x = jnp.moveaxis(x, (0, 1), (-1, -2))
+    frame_length = x.shape[-2]
+    num_frames = x.shape[-1]
+    out_len = frame_length + hop_length * (num_frames - 1)
+    sig = jnp.zeros(x.shape[:-2] + (out_len,), x.dtype)
+    for t in range(num_frames):  # static trip count: unrolls into one XLA op
+        s = t * hop_length
+        sig = sig.at[..., s:s + frame_length].add(x[..., :, t])
+    if axis == 0:
+        sig = jnp.moveaxis(sig, -1, 0)
+    return sig
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """reference python/paddle/signal.py overlap_add: reconstruct a signal
+    from overlapping frames (the istft primitive, exposed)."""
+    if axis not in (0, -1):
+        raise ValueError("axis must be 0 or -1 (reference contract)")
+    return _overlap_add(x, hop_length=int(hop_length), axis=int(axis))

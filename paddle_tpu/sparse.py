@@ -502,3 +502,26 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
     return (Tensor(jnp.asarray(u.astype(np.float32))),
             Tensor(jnp.asarray(s.astype(np.float32))),
             Tensor(jnp.asarray(vt.T.astype(np.float32))))
+
+
+# --------------------------------------------------------------------------- #
+# dense Tensor -> sparse conversion methods (reference Tensor.to_sparse_coo /
+# to_sparse_csr, pybind eager_method.cc tensor methods)
+# --------------------------------------------------------------------------- #
+
+def _tensor_to_sparse_coo(self, sparse_dim=None):
+    nd = self.ndim
+    sparse_dim = nd if sparse_dim is None else int(sparse_dim)
+    if not 0 < sparse_dim <= nd:
+        raise ValueError(f"sparse_dim must be in (0, {nd}], got {sparse_dim}")
+    return SparseCooTensor(jsparse.BCOO.fromdense(self.value,
+                                                  n_batch=0,
+                                                  n_dense=nd - sparse_dim))
+
+
+def _tensor_to_sparse_csr(self):
+    return _tensor_to_sparse_coo(self, 2).to_sparse_csr()
+
+
+Tensor.to_sparse_coo = _tensor_to_sparse_coo
+Tensor.to_sparse_csr = _tensor_to_sparse_csr

@@ -377,15 +377,17 @@ def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
     flat, tree = jax.tree_util.tree_flatten(out, is_leaf=_is_tensor)
     outs = [Tensor(o.value, stop_gradient=o.stop_gradient) if _is_tensor(o)
             else o for o in flat]
+    # only tensor positions are env-bound at replay; non-tensor leaves stay
+    # the capture-time constants — the runner must return the same positions
+    t_pos = [i for i, o in enumerate(flat) if _is_tensor(o)]
 
     def runner(live):
         res = _StaticPyLayer.apply(*live)
         rflat, _ = jax.tree_util.tree_flatten(res, is_leaf=_is_tensor)
-        return tuple(r if _is_tensor(r) else Tensor(jnp.asarray(r))
-                     for r in rflat)
+        return tuple(rflat[i] if _is_tensor(rflat[i])
+                     else Tensor(jnp.asarray(rflat[i])) for i in t_pos)
 
-    prog._record_op("pyctrl", runner, inputs,
-                    [o for o in outs if _is_tensor(o)])
+    prog._record_op("pyctrl", runner, inputs, [outs[i] for i in t_pos])
     return jax.tree_util.tree_unflatten(tree, outs)
 
 
@@ -793,14 +795,17 @@ def nce(input, label, num_total_classes, sample_weight=None,  # noqa: A002
 # --------------------------------------------------------------------------- #
 
 def _time_mask(x, seq_lens):
-    """[B, T] float mask from per-row lengths (None -> all valid)."""
+    """[B, T] float mask from per-row lengths (None -> all valid). Built from
+    the recorded sequence_mask op so static capture replays it against the
+    fed lengths (not a baked capture-time constant)."""
     if seq_lens is None:
         return None
-    lens = seq_lens.value if isinstance(seq_lens, Tensor) else jnp.asarray(
-        seq_lens)
-    t = int(x.shape[1])
-    return Tensor((jnp.arange(t)[None, :] < lens[:, None]).astype(
-        x.value.dtype))
+    from ..nn import functional as F
+
+    lens = (seq_lens if isinstance(seq_lens, Tensor)
+            else Tensor(jnp.asarray(seq_lens)))
+    return F.sequence_mask(lens, maxlen=int(x.shape[1]),
+                           dtype=str(x.dtype))
 
 
 def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,  # noqa: A002

@@ -437,3 +437,16 @@ class TestLegacyDatasetNamespace:
         assert len(md5) == 32
         with pytest.raises(ValueError):
             paddle.dataset.common.download("http://x", "m", "d")
+
+
+def test_overlap_add_axis0_ndim3_layout():
+    """reference signal.overlap_add axis=0 keeps the signal on axis 0."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor(np.ones((4, 3, 2), "float32"))  # (frames, flen, b)
+    out = paddle.signal.overlap_add(x, 2, axis=0)
+    assert out.shape == [9, 2]
+    # interiors overlap once: frame_len 3, hop 2 -> positions 2,4,6 sum 2
+    np.testing.assert_allclose(out.numpy()[2], [2.0, 2.0])

@@ -1,0 +1,83 @@
+"""ops.yaml parity report stays current and the missing bucket stays closed.
+
+Reference analog: the yaml registry (paddle/phi/ops/yaml/) is the reference's
+own source of truth for its op surface; this test pins our mapping of it
+(VERDICT round-3 item #3: 'generate the ops.yaml parity diff and close or
+waive the tail')."""
+import os
+import re
+
+import pytest
+
+from paddle_tpu.ops.parity import (REFERENCE_YAML_DIR, classify,
+                                   generate_report, parse_yaml_ops)
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REFERENCE_YAML_DIR),
+    reason="reference yaml dir not present")
+
+
+def test_yaml_parse_counts():
+    ops = parse_yaml_ops(os.path.join(REFERENCE_YAML_DIR, "ops.yaml"))
+    fused = parse_yaml_ops(os.path.join(REFERENCE_YAML_DIR,
+                                        "fused_ops.yaml"))
+    sparse = parse_yaml_ops(os.path.join(REFERENCE_YAML_DIR,
+                                         "sparse_ops.yaml"))
+    assert len(ops) == 470
+    assert len(fused) == 80
+    assert len(sparse) == 51
+
+
+def test_missing_bucket_closed():
+    cls = classify()
+    missing = [op for op, (b, _, _) in cls.items() if b == "missing"]
+    # VERDICT target: < 30 with every waiver justified. Current state: 0.
+    assert len(missing) < 30, f"missing bucket regressed: {sorted(missing)}"
+
+
+def test_every_waiver_has_a_reason():
+    cls = classify()
+    for op, (bucket, note, _) in cls.items():
+        if bucket == "waived":
+            assert len(note) > 10, f"waiver for {op} lacks a reason"
+
+
+def test_committed_report_is_current(tmp_path):
+    path, counts = generate_report(str(tmp_path / "ops_parity.md"))
+    committed = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "ops_parity.md")
+    assert os.path.exists(committed), \
+        "docs/ops_parity.md missing: python -m paddle_tpu.ops.parity"
+    with open(committed) as f:
+        text = f.read()
+    m = re.search(r"mapped (\d+), waived (\d+), missing (\d+)", text)
+    assert m, "committed report lacks the counts line"
+    assert (int(m.group(1)), int(m.group(2)), int(m.group(3))) == (
+        counts["mapped"], counts["waived"], counts["missing"]), (
+        "docs/ops_parity.md is stale: regenerate with "
+        "python -m paddle_tpu.ops.parity")
+
+
+def test_alias_spot_checks_resolve():
+    """A sample of mapped aliases must point at real attributes."""
+    import paddle_tpu as paddle
+
+    checks = {
+        "bicubic_interp": (paddle.nn.functional, "interpolate"),
+        "fft_c2c": (paddle.fft, "fft"),
+        "overlap_add": (paddle.signal, "overlap_add"),
+        "to_sparse_coo": (paddle.Tensor, "to_sparse_coo"),
+        "to_sparse_csr": (paddle.Tensor, "to_sparse_csr"),
+        "logsigmoid": (paddle.nn.functional, "log_sigmoid"),
+        "tanh_shrink": (paddle.nn.functional, "tanhshrink"),
+        "max_pool2d_with_index": (paddle.nn.functional, "max_pool2d"),
+        "roi_align": (paddle.vision.ops, "roi_align"),
+        "adamw_": (paddle.optimizer, "AdamW"),
+        "svd": (paddle.linalg, "svd"),
+        "sequence_conv": (paddle.static.nn, "sequence_conv"),
+        "flash_attn": (paddle.nn.functional, "flash_attention"),
+    }
+    cls = classify()
+    for op, (mod, attr) in checks.items():
+        assert cls[op][0] == "mapped", f"{op} not mapped: {cls[op]}"
+        assert hasattr(mod, attr), f"alias target for {op} missing: {attr}"

@@ -578,3 +578,55 @@ class TestReviewRegressions:
 
         assert _ints(True) == (1,)
         assert _ints(np.int32(3)) == (3,)
+
+    def test_seq_lens_mask_replays_against_feed(self):
+        """Masks from fed seq_lens must be recorded ops, not baked constants."""
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            with static.program_guard(main, static.Program()):
+                x = static.data("x", [None, 4, 3], "float32")
+                lens = static.data("lens", [None], "int64")
+                snn.sequence_pool(x, "average", seq_lens=lens).name = "avg"
+                snn.sequence_last_step(x, seq_lens=lens).name = "last"
+            exe = static.Executor()
+            r = np.random.RandomState(0)
+            xv = r.randn(2, 4, 3).astype("float32")
+            lv = np.array([2, 4], "int64")
+            avg, last = exe.run(main, feed={"x": xv, "lens": lv},
+                                fetch_list=["avg", "last"])
+            np.testing.assert_allclose(
+                avg, np.stack([xv[0, :2].mean(0), xv[1].mean(0)]), rtol=1e-5)
+            np.testing.assert_allclose(
+                last, np.stack([xv[0, 1], xv[1, 3]]), rtol=1e-5)
+        finally:
+            paddle.disable_static()
+
+    def test_static_pylayer_mixed_output_alignment(self):
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            with static.program_guard(main, static.Program()):
+                x = static.data("x", [1], "float32")
+                const, out = snn.static_pylayer(
+                    lambda v: (7, v * 3.0), [x],
+                    backward_fn=lambda g: g)
+                out.name = "out"
+            assert const == 7
+            exe = static.Executor()
+            (r,) = exe.run(main, feed={"x": np.array([5.0], "float32")},
+                           fetch_list=["out"])
+            np.testing.assert_allclose(r, [15.0])
+        finally:
+            paddle.disable_static()
+
+    def test_minimize_parameters_narrows_eagerly(self):
+        w1 = paddle.to_tensor(np.ones((2,), "float32"), stop_gradient=False)
+        w2 = paddle.to_tensor(np.ones((2,), "float32"), stop_gradient=False)
+        from paddle_tpu.framework.core import Parameter
+        p1, p2 = Parameter(w1.value), Parameter(w2.value)
+        opt = paddle.optimizer.SGD(learning_rate=0.5, parameters=[p1, p2])
+        loss = (p1 * 2.0).sum() + (p2 * 3.0).sum()
+        opt.minimize(loss, parameters=[p1])
+        assert not np.allclose(p1.numpy(), 1.0)  # updated
+        np.testing.assert_allclose(p2.numpy(), [1.0, 1.0])  # untouched
