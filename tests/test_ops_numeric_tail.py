@@ -125,6 +125,15 @@ def _frame_ref(x, frame_length, hop_length):
                      for i in range(n)], axis=-1)
 
 
+def _overlap_add_ref(x, hop_length):
+    # x: (..., frame_length, num_frames)
+    fl, n = x.shape[-2], x.shape[-1]
+    out = np.zeros(x.shape[:-2] + (fl + hop_length * (n - 1),), x.dtype)
+    for t in range(n):
+        out[..., t * hop_length:t * hop_length + fl] += x[..., :, t]
+    return out
+
+
 # ---- fixture-dependent refs / fns used by the cases below --------------------------------
 _HINGE_LBL = np.sign(_MASK.astype("float64") - 0.5)
 
@@ -793,6 +802,9 @@ TAIL_CASES = [
     OpCase("signal.frame",
            lambda x: paddle.signal.frame(x, frame_length=4, hop_length=2),
            lambda x: _frame_ref(x, 4, 2), [(2, 10)]),
+    OpCase("signal.overlap_add",
+           lambda x: paddle.signal.overlap_add(x, 2),
+           lambda x: _overlap_add_ref(x, 2), [(4, 3)]),
     OpCase("geometric.segment_reduce",
            lambda x: paddle.geometric.segment_sum(
                x, paddle.to_tensor(np.array([0, 0, 1, 1], "int64"))),
