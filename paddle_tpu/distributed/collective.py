@@ -486,6 +486,11 @@ def scatter_object_list(out_object_list, in_object_list=None, src=0,
         # only the src rank's list is authoritative (reference contract);
         # other ranks' in_object_list args are ignored
         _OBJECT_STORE[key] = list(in_object_list)
+    if rank not in group.ranks:
+        # non-member ranks don't participate: leave out_object_list
+        # untouched (reference group-membership contract; previously this
+        # silently handed rank 0's shard to outsiders)
+        return
     data = _OBJECT_STORE.get(key, list(in_object_list or []))
-    idx = group.get_group_rank(rank) if rank in group.ranks else 0
+    idx = group.get_group_rank(rank)
     out_object_list[:] = [data[idx]] if data else []

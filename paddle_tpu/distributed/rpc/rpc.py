@@ -11,13 +11,14 @@ RPCs (including re-entrant worker->worker calls) don't serialize.
 Trust model: RPC executes arbitrary callables by design (same as the
 reference), so the listener authenticates peers before accepting frames —
 an HMAC challenge-response over a shared secret that rank 0 generates and
-distributes through the rendezvous TCPStore (override with
-PADDLE_RPC_AUTH_KEY). Unauthenticated connections are dropped without
-unpickling anything. The key is deleted from the store once every rank has
-fetched it, but during bootstrap it transits the store in cleartext — the
-master port must be protected exactly like the worker RPC ports (same
-firewall perimeter); for a stronger posture pre-share PADDLE_RPC_AUTH_KEY
-out of band so no key ever touches the store.
+distributes through the rendezvous TCPStore via finite-field Diffie-Hellman
+(RFC 3526 group 14): the group key is wrapped per rank under a pairwise DH
+shared secret, so the raw key never transits the store; all exchange
+material is deleted after the init barrier. Unauthenticated connections are
+dropped without unpickling anything. A passive eavesdropper on the store
+learns nothing key-derived; an *active* man-in-the-middle on the store
+could still substitute public keys — pre-share PADDLE_RPC_AUTH_KEY out of
+band to close that too.
 """
 from __future__ import annotations
 
@@ -91,6 +92,36 @@ def _client_handshake(sock, key):
     mac = _recv_exact(sock, 32)
     if not hmac.compare_digest(mac, hmac.new(key, nonce_c, "sha256").digest()):
         raise ConnectionError("rpc auth failure: server not authenticated")
+
+
+# --- group-key agreement over the rendezvous store ---------------------------
+# RFC 3526 group 14 (2048-bit MODP) finite-field Diffie-Hellman: rank 0 wraps
+# the random group key under a per-rank DH shared secret, so the raw key never
+# transits the store in cleartext (round-3 advisor finding). A passive store
+# eavesdropper learns only public keys and wrapped blobs; active MITM on the
+# store still requires out-of-band PADDLE_RPC_AUTH_KEY to defeat (documented).
+_DH_P = int(
+    "FFFFFFFFFFFFFFFFC90FDAA22168C234C4C6628B80DC1CD129024E088A67CC74"
+    "020BBEA63B139B22514A08798E3404DDEF9519B3CD3A431B302B0A6DF25F1437"
+    "4FE1356D6D51C245E485B576625E7EC6F44C42E9A637ED6B0BFF5CB6F406B7ED"
+    "EE386BFB5A899FA5AE9F24117C4B1FE649286651ECE45B3DC2007CB8A163BF05"
+    "98DA48361C55D39A69163FA8FD24CF5F83655D23DCA3AD961C62F356208552BB"
+    "9ED529077096966D670C354E4ABC9804F1746C08CA18217C32905E462E36CE3B"
+    "E39E772C180E86039B2783A2EC07A28FB5C55DF06F4C52C9DE2BCBF695581718"
+    "3995497CEA956AE515D2261898FA051015728E5A8AACAA68FFFFFFFFFFFFFFFF",
+    16)
+_DH_G = 2
+
+
+def _dh_keypair():
+    x = _secrets.randbits(512)
+    return x, pow(_DH_G, x, _DH_P)
+
+
+def _dh_wrap(shared, key32, tag):
+    pad = hmac.new(shared.to_bytes(256, "big"),
+                   b"paddle-rpc-keywrap/" + tag, "sha256").digest()
+    return bytes(a ^ b for a, b in zip(key32, pad))
 
 
 class _RpcServer(threading.Thread):
@@ -178,11 +209,40 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
         store = TCPStore(host, int(port), is_master=(rank == 0),
                          world_size=world_size, timeout=120)
         if env_key is None:
-            # rank 0's random secret becomes the group key, distributed over
-            # the rendezvous store (the already-trusted bootstrap channel)
+            # DH key agreement: rank 0's random secret becomes the group
+            # key, wrapped per rank under a pairwise DH shared secret — the
+            # raw key never appears on the store
+            x, pub = _dh_keypair()
+            store.set(f"rpc/dh_pub/{rank}", pub.to_bytes(256, "big"))
+
+            def _checked_pub(raw, who):
+                peer = int.from_bytes(raw, "big")
+                # reject degenerate keys (0/1/p-1/>=p) that collapse the
+                # shared secret to a predictable value
+                if not 2 <= peer <= _DH_P - 2:
+                    raise ConnectionError(
+                        f"rpc bootstrap: invalid DH public key from {who}")
+                return peer
+
             if rank == 0:
-                store.set("rpc/auth_key", _STATE.auth_key)
-            _STATE.auth_key = store.get("rpc/auth_key", timeout=120)
+                for r in range(1, world_size):
+                    peer = _checked_pub(
+                        store.get(f"rpc/dh_pub/{r}", timeout=120), f"rank {r}")
+                    shared = pow(peer, x, _DH_P)
+                    store.set(f"rpc/keywrap/{r}",
+                              _dh_wrap(shared, _STATE.auth_key,
+                                       str(r).encode()))
+            else:
+                pub0 = _checked_pub(store.get("rpc/dh_pub/0", timeout=120),
+                                    "rank 0")
+                shared = pow(pub0, x, _DH_P)
+                wrapped = store.get(f"rpc/keywrap/{rank}", timeout=120)
+                if len(wrapped) != 32:
+                    raise ConnectionError(
+                        "rpc bootstrap: malformed key-wrap blob "
+                        f"({len(wrapped)} bytes, expected 32)")
+                _STATE.auth_key = _dh_wrap(shared, wrapped,
+                                           str(rank).encode())
         store.set(f"rpc/worker/{rank}",
                   pickle.dumps(tuple(info), protocol=pickle.HIGHEST_PROTOCOL))
         workers = {}
@@ -199,9 +259,12 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     _barrier("init")
     if world_size > 1 and env_key is None and rank == 0:
         # every rank holds the key now (worker infos publish after the key
-        # fetch, and all ranks passed the barrier) — remove it from the store
-        # so late/unauthorized store clients cannot read it
-        _STATE.store.delete_key("rpc/auth_key")
+        # fetch, and all ranks passed the barrier) — clear the exchange
+        # material so late store clients see nothing key-derived at all
+        for r in range(world_size):
+            _STATE.store.delete_key(f"rpc/dh_pub/{r}")
+            if r:
+                _STATE.store.delete_key(f"rpc/keywrap/{r}")
 
 
 class _Connection:

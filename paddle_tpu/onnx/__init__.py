@@ -211,8 +211,15 @@ class _Emitter:
                     .astype(np.int8)
             g.initializer.append(_tensor(f"{nm}_Wq", wq))
             g.initializer.append(_tensor(f"{nm}_w_scale", w_scale))
+            # ONNX spec: per-axis DequantizeLinear requires zero_point shaped
+            # like the scale (round-3 advisor finding)
+            w_zp = zp
+            if wdq_attrs:
+                w_zp = f"{nm}_w_zp"
+                g.initializer.append(_tensor(
+                    w_zp, np.zeros(np.shape(w_scale), np.int8)))
             g.node.append(_node("DequantizeLinear",
-                                [f"{nm}_Wq", f"{nm}_w_scale", zp],
+                                [f"{nm}_Wq", f"{nm}_w_scale", w_zp],
                                 [f"{nm}_Wdq"], nm + "_wdq", wdq_attrs))
             ins = [f"{nm}_adq", f"{nm}_Wdq"]
             if getattr(layer.inner, "bias", None) is not None:
@@ -228,13 +235,14 @@ class _Emitter:
         elif kind == "weightonlylinear":
             # weight-only int8: int8 weight + DequantizeLinear (per-channel
             # scale, axis=1 of the (in, out) weight), fp activations
+            w_scale_arr = np.asarray(layer.weight_scale.numpy(), np.float32)
             zp = f"{nm}_zp"
-            g.initializer.append(_tensor(zp, np.zeros((), np.int8)))
+            # per-axis dequant: zero_point must match the scale's shape
+            g.initializer.append(_tensor(
+                zp, np.zeros(w_scale_arr.shape, np.int8)))
             g.initializer.append(_tensor(
                 f"{nm}_Wq", np.asarray(layer.quant_weight.numpy(), np.int8)))
-            g.initializer.append(_tensor(
-                f"{nm}_w_scale",
-                np.asarray(layer.weight_scale.numpy(), np.float32)))
+            g.initializer.append(_tensor(f"{nm}_w_scale", w_scale_arr))
             g.node.append(_node("DequantizeLinear",
                                 [f"{nm}_Wq", f"{nm}_w_scale", zp],
                                 [f"{nm}_Wdq"], nm + "_wdq",

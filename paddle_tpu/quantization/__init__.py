@@ -83,19 +83,33 @@ class FakeQuanterWithAbsMax(Layer):
         traced = isinstance(x.value, _jax.core.Tracer)
         if self.training and traced:
             s = ops.abs(x).max().detach()
+            # keep the host-side running scale calibrated under to_static
+            # (same io_callback fold as the channel-wise quanter)
+            _jax.experimental.io_callback(
+                self._accumulate_scale, None, s.value.astype(jnp.float32),
+                ordered=False)
             return quant_dequant(x, s, bits=self.quant_bits)
         if self.training:
-            cur = float(ops.abs(x).max().numpy())
-            if not self._calibrated:
-                self._scale = cur
-                self._calibrated = True
-            elif self.moving_rate is None:
-                self._scale = max(self._scale, cur)      # PTQ running absmax
-            else:
-                self._scale = (self.moving_rate * self._scale
-                               + (1 - self.moving_rate) * cur)
+            self._accumulate_scale(float(ops.abs(x).max().numpy()))
+        if not self.training and not self._calibrated:
+            import warnings
+
+            warnings.warn(
+                "FakeQuanterWithAbsMax evaluated with no calibrated scale; "
+                "run at least one training step first", stacklevel=2)
         s = Tensor(jnp.asarray(max(self._scale, 1e-8), jnp.float32))
         return quant_dequant(x, s, bits=self.quant_bits)
+
+    def _accumulate_scale(self, cur):
+        cur = float(np.asarray(cur))
+        if not self._calibrated:
+            self._scale = cur
+            self._calibrated = True
+        elif self.moving_rate is None:
+            self._scale = max(self._scale, cur)          # PTQ running absmax
+        else:
+            self._scale = (self.moving_rate * self._scale
+                           + (1 - self.moving_rate) * cur)
 
 
 @defop("fake_channel_quant_dequant")
@@ -129,16 +143,34 @@ class FakeQuanterChannelWiseAbsMax(Layer):
         if self.training and traced:
             s = ops.abs(x).max(axis=reduce_axes).detach() \
                 if reduce_axes else ops.abs(x).detach()
+            # fold the per-call scales into the running host-side _scale via
+            # io_callback so a QAT model trained only under to_static still
+            # reaches eval/export calibrated (round-3 advisor finding)
+            _jax.experimental.io_callback(
+                self._accumulate_scale, None,
+                s.value.astype(jnp.float32), ordered=False)
             return _fake_qdq_channel(x, s, bits=self.quant_bits, axis=ax)
         if self.training:
             cur = np.abs(np.asarray(x.numpy(), np.float64))
             cur = cur.max(axis=reduce_axes) if reduce_axes else cur
             self._scale = cur if self._scale is None \
                 else np.maximum(self._scale, cur)
+        if not self.training and self._scale is None:
+            import warnings
+
+            warnings.warn(
+                "FakeQuanterChannelWiseAbsMax evaluated with no calibrated "
+                "scale (falling back to ones); run at least one training "
+                "step first", stacklevel=2)
         s = Tensor(jnp.asarray(
             np.maximum(self._scale if self._scale is not None
                        else np.ones(x.shape[ax]), 1e-8), jnp.float32))
         return _fake_qdq_channel(x, s, bits=self.quant_bits, axis=ax)
+
+    def _accumulate_scale(self, cur):
+        cur = np.asarray(cur, np.float64)
+        self._scale = cur if self._scale is None \
+            else np.maximum(self._scale, cur)
 
 
 # reference factory names resolve to the layer-level quanters here

@@ -256,3 +256,86 @@ class TestRpcAuth:
             assert rpc_mod.rpc_sync("solo", divmod, args=(7, 3)) == (2, 1)
         finally:
             rpc_mod.shutdown()
+
+
+# --------------------------------------------------------------------------- #
+# round-3 advisor findings
+# --------------------------------------------------------------------------- #
+
+class TestRound3AdviceFixes:
+    def test_onnx_per_axis_zero_point_matches_scale_shape(self):
+        """ONNX spec: per-axis DequantizeLinear zero_point must be shaped
+        like the scale (was: scalar zp with 1-D per-channel scale)."""
+        from paddle_tpu.quantization import (QAT, QuantConfig,
+                                             FakeQuanterChannelWiseAbsMax,
+                                             FakeQuanterWithAbsMax)
+        import paddle_tpu.onnx as ponnx
+
+        net = paddle.nn.Sequential(paddle.nn.Linear(4, 3))
+        cfg = QuantConfig(
+            activation=lambda: FakeQuanterWithAbsMax(),
+            weight=lambda: FakeQuanterChannelWiseAbsMax(axis=1))
+        q = QAT(cfg).quantize(net)
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(2, 4).astype("float32"))
+        q(x)  # calibrate
+        import os
+        import tempfile
+
+        from paddle_tpu.onnx import onnx_minimal_pb2 as pb
+
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "m")
+            ponnx.export(q, path, input_spec=[
+                paddle.static.InputSpec([1, 4], "float32")])
+            with open(path + ".onnx", "rb") as f:
+                model = pb.ModelProto.FromString(f.read())
+        inits = {t.name: t for t in model.graph.initializer}
+        for node in model.graph.node:
+            if node.op_type == "DequantizeLinear" and any(
+                    a.name == "axis" for a in node.attribute):
+                scale = inits[node.input[1]]
+                zp = inits[node.input[2]]
+                assert list(zp.dims) == list(scale.dims), (
+                    node.name, zp.dims, scale.dims)
+
+    def test_channelwise_quanter_calibrates_under_jit(self):
+        """QAT trained only under to_static must still reach eval with a
+        calibrated running _scale (io_callback accumulation)."""
+        from paddle_tpu.quantization import FakeQuanterChannelWiseAbsMax
+
+        q = FakeQuanterChannelWiseAbsMax(axis=1)
+        q.train()
+
+        @paddle.jit.to_static
+        def step(x):
+            return q(x)
+
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(4, 3).astype("float32"))
+        step(x)
+        assert q._scale is not None
+        np.testing.assert_allclose(
+            np.asarray(q._scale), np.abs(x.numpy()).max(0), rtol=1e-5)
+
+    def test_scatter_object_list_nonmember_untouched(self):
+        import paddle_tpu.distributed as dist
+
+        out = ["sentinel"]
+        g = dist.collective.Group(ranks=[5, 6], name="sub")
+        dist.scatter_object_list(out, ["a", "b"], src=5, group=g)
+        assert out == ["sentinel"]  # current rank 0 is not in the group
+
+    def test_rpc_dh_keywrap_roundtrip(self):
+        from paddle_tpu.distributed.rpc.rpc import (_dh_keypair, _dh_wrap,
+                                                    _DH_P)
+
+        x0, pub0 = _dh_keypair()
+        x1, pub1 = _dh_keypair()
+        s0 = pow(pub1, x0, _DH_P)
+        s1 = pow(pub0, x1, _DH_P)
+        assert s0 == s1
+        key = bytes(range(32))
+        wrapped = _dh_wrap(s0, key, b"1")
+        assert wrapped != key
+        assert _dh_wrap(s1, wrapped, b"1") == key
