@@ -62,7 +62,7 @@ class PyLayer(metaclass=PyLayerMeta):
         out_tensors = [o for o in out_list if isinstance(o, Tensor)]
 
         if requires_grad:
-            out_avals = [jax.ShapeDtypeStruct(tuple(o.value.shape), o.value.dtype)
+            out_avals = [tape.OutAval(tuple(o.value.shape), o.value.dtype)
                          for o in out_tensors]
 
             def vjp_fn(cots):

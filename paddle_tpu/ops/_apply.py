@@ -93,6 +93,31 @@ class _LazyVjp:
 
 
 @functools.lru_cache(maxsize=8192)
+def _cached_pos_fns(opdef, n_leaves, static_items, t_idx, stop_flags,
+                    flags_epoch):
+    """Positional-call variant of _cached_op_fns: all args are flat (no
+    nested containers, no kwargs), so the rebuilt buffer feeds fn(*buf)
+    directly — no tree flatten/unflatten on the hot path."""
+    fn = opdef.fn
+
+    def pure(*tvals):
+        buf = [None] * n_leaves
+        for i, _ty, v in static_items:
+            buf[i] = v
+        for i, v, sg in zip(t_idx, tvals, stop_flags):
+            buf[i] = (jax.lax.stop_gradient(v)
+                      if sg and isinstance(v, jax.core.Tracer) else v)
+        out = fn(*buf)
+        return out if isinstance(out, tuple) else (out,)
+
+    @jax.jit
+    def bwd(tvals, cots):
+        return jax.vjp(pure, *tvals)[1](cots)
+
+    return pure, bwd
+
+
+@functools.lru_cache(maxsize=8192)
 def _cached_op_fns(opdef, treedef, n_leaves, static_items, t_idx, stop_flags,
                    flags_epoch):
     """One stable (pure, jitted-backward) pair per op-call signature, so jax.jit's
@@ -107,7 +132,11 @@ def _cached_op_fns(opdef, treedef, n_leaves, static_items, t_idx, stop_flags,
         for i, _ty, v in static_items:
             buf[i] = v
         for i, v, sg in zip(t_idx, tvals, stop_flags):
-            buf[i] = jax.lax.stop_gradient(v) if sg else v
+            # stop_gradient is a ~17us eager no-op on concrete values; it
+            # only carries meaning under a trace (the jitted bwd / vjp),
+            # where v is a Tracer
+            buf[i] = (jax.lax.stop_gradient(v)
+                      if sg and isinstance(v, jax.core.Tracer) else v)
         a, k = jax.tree_util.tree_unflatten(treedef, buf)
         out = fn(*a, **k)
         return out if isinstance(out, tuple) else (out,)
@@ -154,6 +183,32 @@ def _maybe_record_op_stats(name, vals):
         _record_op_call(name, vals)
 
 
+def _finish_outputs(opdef, name, out_vals, requires_grad, vjp_fn, pure,
+                    t_leaves, stop_flags):
+    """Shared dispatch postlude: nan scan, op stats, output Tensor wrap with
+    stop_gradient propagation, tape record."""
+    if flags.flag("check_nan_inf"):
+        _check_nan_inf(name, out_vals)
+    _maybe_record_op_stats(name, out_vals)
+
+    if tape.in_functional_mode():
+        rg_out = (
+            opdef.differentiable and tape.grad_flag()
+            and any(not sg for sg in stop_flags)
+        )
+    else:
+        rg_out = requires_grad
+    outputs = []
+    for v in out_vals:
+        sg = not (rg_out and _inexact(v.dtype))
+        outputs.append(Tensor(v, stop_gradient=sg))
+
+    if requires_grad:
+        out_avals = [tape.OutAval(v.shape, v.dtype) for v in out_vals]
+        tape.record(name, t_leaves, vjp_fn, pure, out_avals, outputs)
+    return outputs
+
+
 def apply(opdef: OpDef, *args, **kwargs):
     """Dispatch one op call. Tensor leaves anywhere in args/kwargs are traced inputs."""
     # ---- AMP auto-cast (O1/O2), mirroring eager_gen.py:645 AMP_LOGIC_TEMPLATE ----
@@ -164,6 +219,51 @@ def apply(opdef: OpDef, *args, **kwargs):
         _AMP = (_amp_state, amp_cast_inputs)
     if _AMP[0]() is not None:
         args, kwargs = _AMP[1](opdef, args, kwargs)
+
+    # ---- fast path: flat positional call (the overwhelmingly common shape:
+    # no kwargs, no nested containers) skips tree flatten/unflatten and calls
+    # fn(*buf) directly; capture mode takes the generic path (it records the
+    # treedef) ----
+    if not kwargs and _capture._ACTIVE[0] is None:
+        flat_ok = True
+        t_idx = []
+        t_leaves = []
+        for i, a in enumerate(args):
+            if isinstance(a, Tensor):
+                t_idx.append(i)
+                t_leaves.append(a)
+            elif isinstance(a, (list, tuple, dict)):
+                flat_ok = False
+                break
+        if flat_ok:
+            vals = [t._value for t in t_leaves]
+            stop_flags = [t.stop_gradient for t in t_leaves]
+            requires_grad = (
+                opdef.differentiable
+                and tape.is_grad_enabled()
+                and any(not sg for sg in stop_flags)
+            )
+            pure = None
+            try:
+                if flags.flag("eager_cached_vjp"):
+                    t_set = set(t_idx)
+                    static_items = tuple(
+                        (i, type(a).__name__, a)
+                        for i, a in enumerate(args) if i not in t_set)
+                    pure, bwd = _cached_pos_fns(
+                        opdef, len(args), static_items, tuple(t_idx),
+                        tuple(stop_flags), flags.epoch())
+            except TypeError:
+                pure = None  # unhashable static arg -> generic path
+            if pure is not None:
+                out_vals = pure(*vals)
+                vjp_fn = _LazyVjp(bwd, vals) if requires_grad else None
+                outputs = _finish_outputs(
+                    opdef, opdef.name, out_vals, requires_grad, vjp_fn,
+                    pure, t_leaves, stop_flags)
+                if len(outputs) == 1:
+                    return outputs[0]
+                return tuple(outputs)
 
     leaves, treedef = jax.tree_util.tree_flatten(
         (args, kwargs), is_leaf=_is_tensor
@@ -179,7 +279,8 @@ def apply(opdef: OpDef, *args, **kwargs):
         def pure(*tvals):
             buf = list(leaves)
             for i, v, sg in zip(t_idx, tvals, stop_flags):
-                buf[i] = jax.lax.stop_gradient(v) if sg else v
+                buf[i] = (jax.lax.stop_gradient(v)
+                          if sg and isinstance(v, jax.core.Tracer) else v)
             a, k = jax.tree_util.tree_unflatten(treedef, buf)
             out = fn(*a, **k)
             return out if isinstance(out, tuple) else (out,)
@@ -222,31 +323,12 @@ def apply(opdef: OpDef, *args, **kwargs):
         pure = make_pure()
         out_vals = pure(*vals)
 
-    if flags.flag("check_nan_inf"):
-        _check_nan_inf(opdef.name, out_vals)
-    _maybe_record_op_stats(opdef.name, out_vals)
-
-    # Under graph capture the tape is off but the outer jax.vjp differentiates the whole
-    # trace: stop_gradient must then propagate from inputs (paddle semantics: an output
-    # requires grad iff any input does), or per-input lax.stop_gradient guards in the NEXT
-    # op would sever the chain at every intermediate.
-    if tape.in_functional_mode():
-        # grad_flag keeps no_grad blocks inside a captured function severing the chain
-        # exactly like eager (EMA/target-network patterns must not diverge when compiled)
-        rg_out = (
-            opdef.differentiable and tape.grad_flag()
-            and any(not sg for sg in stop_flags)
-        )
-    else:
-        rg_out = requires_grad
-    outputs = []
-    for v in out_vals:
-        sg = not (rg_out and _inexact(v.dtype))
-        outputs.append(Tensor(v, stop_gradient=sg))
-
-    if requires_grad:
-        out_avals = [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in out_vals]
-        tape.record(opdef.name, t_leaves, vjp_fn, pure, out_avals, outputs)
+    # Under graph capture the tape is off but the outer jax.vjp differentiates
+    # the whole trace: stop_gradient must then propagate from inputs (paddle
+    # semantics: an output requires grad iff any input does) — handled inside
+    # _finish_outputs via the functional-mode grad_flag branch.
+    outputs = _finish_outputs(opdef, opdef.name, out_vals, requires_grad,
+                              vjp_fn, pure, t_leaves, stop_flags)
 
     if _capture._ACTIVE[0] is not None:
         _capture.record("op", (opdef, leaves, treedef, t_idx),
@@ -264,7 +346,9 @@ def apply_raw(name, fn, tensor_args, n_outs=1):
     stop_flags = [t.stop_gradient for t in tensor_args]
 
     def pure(*tvals):
-        tvals = [jax.lax.stop_gradient(v) if sg else v for v, sg in zip(tvals, stop_flags)]
+        tvals = [jax.lax.stop_gradient(v)
+                 if sg and isinstance(v, jax.core.Tracer) else v
+                 for v, sg in zip(tvals, stop_flags)]
         out = fn(*tvals)
         return tuple(out) if isinstance(out, (tuple, list)) else (out,)
 
@@ -282,7 +366,7 @@ def apply_raw(name, fn, tensor_args, n_outs=1):
         sg = not (rg_out and _inexact(v.dtype))
         outputs.append(Tensor(v, stop_gradient=sg))
     if requires_grad:
-        out_avals = [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in out_vals]
+        out_avals = [tape.OutAval(v.shape, v.dtype) for v in out_vals]
         tape.record(name, list(tensor_args), vjp_fn, pure, out_avals, outputs)
     if _capture._ACTIVE[0] is not None:
         _capture.record("raw", (name, fn), list(tensor_args), outputs)

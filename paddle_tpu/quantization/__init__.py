@@ -84,10 +84,9 @@ class FakeQuanterWithAbsMax(Layer):
         if self.training and traced:
             s = ops.abs(x).max().detach()
             # keep the host-side running scale calibrated under to_static
-            # (same io_callback fold as the channel-wise quanter)
-            _jax.experimental.io_callback(
-                self._accumulate_scale, None, s.value.astype(jnp.float32),
-                ordered=False)
+            # (same debug.callback fold as the channel-wise quanter)
+            _jax.debug.callback(self._accumulate_scale,
+                                s.value.astype(jnp.float32))
             return quant_dequant(x, s, bits=self.quant_bits)
         if self.training:
             self._accumulate_scale(float(ops.abs(x).max().numpy()))
@@ -144,11 +143,12 @@ class FakeQuanterChannelWiseAbsMax(Layer):
             s = ops.abs(x).max(axis=reduce_axes).detach() \
                 if reduce_axes else ops.abs(x).detach()
             # fold the per-call scales into the running host-side _scale via
-            # io_callback so a QAT model trained only under to_static still
-            # reaches eval/export calibrated (round-3 advisor finding)
-            _jax.experimental.io_callback(
-                self._accumulate_scale, None,
-                s.value.astype(jnp.float32), ordered=False)
+            # debug.callback (transform-compatible, unlike io_callback whose
+            # missing JVP rule breaks recompute) so a QAT model trained only
+            # under to_static still reaches eval/export calibrated; remat may
+            # replay the fold (harmless for max, negligible EMA bias)
+            _jax.debug.callback(self._accumulate_scale,
+                                s.value.astype(jnp.float32))
             return _fake_qdq_channel(x, s, bits=self.quant_bits, axis=ax)
         if self.training:
             cur = np.abs(np.asarray(x.numpy(), np.float64))
