@@ -18,10 +18,19 @@ from . import dtype as dtype_mod
 
 _tensor_methods_installed = False
 
+# host-read (concretization) observer: jit/sot.py installs a recorder here
+# during its cold run to find graph-break points; one None-check per .numpy()
+_CONCRETIZE_HOOK = [None]
+
+import itertools as _itertools  # noqa: E402
+
+_BIRTH = _itertools.count()  # Tensor creation stamps (see Tensor.__init__)
+
 
 class Tensor:
     __slots__ = (
         "_value",
+        "_birth",
         "stop_gradient",
         "_grad",
         "_grad_node",
@@ -37,6 +46,10 @@ class Tensor:
         if isinstance(value, Tensor):
             value = value._value
         self._value = value
+        # creation stamp: lets jit/sot.py tell true externals (pre-existing
+        # params/globals) from tensors created mid-capture by non-recorded
+        # constructors (detach/views), which cannot replay
+        self._birth = next(_BIRTH)
         self.stop_gradient = stop_gradient
         self._grad = None
         self._grad_node = None
@@ -136,6 +149,9 @@ class Tensor:
 
     # -- conversion ---------------------------------------------------------
     def numpy(self):
+        h = _CONCRETIZE_HOOK[0]
+        if h is not None:
+            h(self)
         return np.asarray(self._value)
 
     def item(self, *args):

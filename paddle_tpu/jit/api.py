@@ -101,7 +101,8 @@ class StaticFunction:
         self._layer = layer
         self._input_spec = input_spec
         self._full_graph = full_graph
-        self._fallback_keys = set()  # graph-broken SIGNATURES (eager per-key)
+        self._fallback_keys = set()  # graph-broken SIGNATURES (segmented)
+        self._segmented = {}         # signature -> sot.SegmentedFunction
         self._cache = {}
         functools.update_wrapper(self, function)
 
@@ -147,8 +148,16 @@ class StaticFunction:
         out_box = {}
 
         def pure(state_vals, rng_key, *tvals):
+            from ..framework import capture as _capture
+
+            # trace-time execution is internal: ops dispatched while jax
+            # retraces this program must not leak into an active capture
+            # (static Program or SOT recorder) — the CALL is recorded at the
+            # apply_raw boundary instead
+            prev_capture = _capture.active()
             with tape.functional_mode(), rng.trace_key(rng_key):
                 saved = [(t, t._value) for t in state_tensors]
+                _capture.set_active(None)
                 try:
                     for t, v in zip(state_tensors, state_vals):
                         t._replace_value(v)
@@ -172,6 +181,7 @@ class StaticFunction:
                 finally:
                     for t, v in saved:
                         t._replace_value(v)
+                    _capture.set_active(prev_capture)
             return out_vals + new_state
 
         return jax.jit(pure), out_box
@@ -185,10 +195,11 @@ class StaticFunction:
         except _GraphBreak as gb:
             # graph break: the function's Python control flow needs concrete
             # values. With full_graph=False (the reference's SOT default)
-            # THIS SIGNATURE falls back to eager; other signatures (e.g. the
-            # training mode when only an eval branch concretizes) keep their
-            # compiled programs — the per-signature analog of SOT's
-            # per-frame fallback.
+            # THIS SIGNATURE switches to mid-function segmentation
+            # (jit/sot.py): the op runs between host reads compile into
+            # jitted segments, guarded on the concretized values — the
+            # SOT capability without bytecode interception. Other signatures
+            # keep their whole-function compiled programs.
             if gb.cause is not None:
                 # either way the entry inserted before the trace failed is
                 # dead — keep the cache truthful
@@ -200,12 +211,21 @@ class StaticFunction:
                 warnings.warn(
                     f"to_static: graph break in "
                     f"{getattr(self._function, '__name__', '?')} "
-                    f"({type(gb.cause).__name__}); running THIS signature "
-                    "eagerly from now on (other signatures stay compiled). "
-                    "Use paddle.where / lax-style control flow, or "
+                    f"({type(gb.cause).__name__}); attempting mid-function "
+                    "segmentation for THIS signature: compiled segments "
+                    "around the host read when possible, plain eager "
+                    "otherwise (check compiled_segment_counts()). Other "
+                    "signatures stay whole-compiled. Use paddle.where / "
+                    "static.nn.cond for fully-compiled control flow, or "
                     "full_graph=True to make this an error.", stacklevel=2)
                 self._fallback_keys.add(gb.key)
-            return self._function(*args, **kwargs)
+            seg = self._segmented.get(gb.key)
+            if seg is None:
+                from .sot import SegmentedFunction
+
+                seg = self._segmented[gb.key] = SegmentedFunction(
+                    self._function)
+            return seg(*args, **kwargs)
 
     def _traced_call(self, *args, **kwargs):
         if self._layer is not None:
@@ -242,7 +262,12 @@ class StaticFunction:
             or any(not t.stop_gradient for t in t_leaves)
         )
 
-        if requires_grad:
+        from ..framework import capture as _capture
+
+        if requires_grad or _capture.active() is not None:
+            # apply_raw also RECORDS the call into any active capture (static
+            # program or SOT segment recorder) — a nested compiled call under
+            # no_grad must not become an invisible baked constant at replay
             from ..ops._apply import apply_raw
 
             n_state = len(state_tensors)
@@ -294,6 +319,13 @@ class StaticFunction:
 
     def concrete_program_specs(self):
         return list(self._cache.keys())
+
+    def compiled_segment_counts(self):
+        """signature -> number of compiled SOT segments (graph-broken
+        signatures only; whole-compiled signatures live in the program
+        cache)."""
+        return {k: s.compiled_segment_count
+                for k, s in self._segmented.items()}
 
     def rollback(self):
         """Undo to_static on a layer's forward."""

@@ -1,0 +1,429 @@
+"""Mid-function graph breaks: guarded compiled segments around host reads.
+
+Reference analog: python/paddle/jit/sot/ + fluid/pybind/sot/eval_frame.c — the
+reference intercepts Python bytecode (PEP 523), simulates it into a symbolic
+FunctionGraph, and at an unsupported construct "breaks the graph": the traced
+prefix stays compiled, the break runs eagerly, tracing resumes after, and a
+guard system re-validates cached traces per call.
+
+TPU-first redesign — no bytecode interception. The op tape IS the program:
+
+1. cold run: when a whole-function trace graph-breaks (a concretization like
+   ``.item()`` / ``if tensor:``), the function runs once EAGERLY (results are
+   correct by construction) with the dispatch capture hook recording every op
+   and a concretization observer marking each host read as a break point with
+   the value read (the GUARD).
+2. segmentation: the recorded op list is cut at the break points; each run of
+   ops between breaks compiles into one jitted segment over its live inputs
+   (function args, earlier-segment outputs, and externals like Parameters,
+   whose values are fetched per call — never baked). Variants hold integer
+   SLOTS, not the cold run's tensors, so intermediate activations are freed.
+3. replay: later calls execute segment -> guard check -> segment...; a guard
+   mismatch (the host read concretized a different value, so the baked Python
+   path may diverge) discards the variant for this call and re-captures a new
+   one — the guard-tree semantics of SOT at concretization granularity.
+
+Gradients flow through replay: each compiled segment is dispatched via
+``apply_raw`` (one tape node whose vjp is jax.vjp over the segment), so a
+broken function still trains with every non-break op compiled.
+
+Known limits (documented, reference SOT shares the flavor of each):
+* python side effects between ops run once at capture, not per call;
+* in-place buffer mutation inside a segment does not replay;
+* tensors created by non-recorded constructors (fresh ``paddle.randn`` inside
+  the function) replay as captured constants — breaks stay correct because
+  the guard detects divergence only through concretized values;
+* a non-scalar host read (``.numpy()`` of a big array) disables segmentation
+  for that signature (plain eager, still correct);
+* guards are exact-value: a ``bool(tensor)`` / ``if tensor:`` break (the
+  common control-flow shape) replays stably, but a raw ``float(x)`` whose
+  value drifts every step (e.g. reading a training loss) mismatches each
+  call — after MAX_VARIANTS recaptures the signature flips to plain eager,
+  bounding the recompile cost. Prefer comparing tensors (``if x > 0:``) so
+  the guard is the branch decision, as in the reference's guard system.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from ..autograd import tape
+from ..framework import capture as _capture
+from ..framework import core as _core
+from ..framework.core import Tensor
+
+MAX_VARIANTS = 8          # guard-tree width per signature before eager-forever
+MAX_GUARD_ELEMS = 16      # host reads bigger than this disable segmentation
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def _is_prng_key(x):
+    try:
+        return (isinstance(x, jax.Array)
+                and jax.numpy.issubdtype(x.dtype, jax.dtypes.prng_key))
+    except Exception:  # noqa: BLE001
+        return False
+
+
+class _Recorder:
+    """Capture sink (framework.capture protocol) + concretization observer."""
+
+    def __init__(self):
+        self.ops = []           # (kind, payload, t_leaves, outputs)
+        self.breaks = []        # (op_index, tensor, guard ndarray)
+        self.ok = True
+        self.start_birth = next(_core._BIRTH)
+
+    def _record_op(self, kind, payload, t_leaves, outputs):
+        if kind not in ("op", "raw"):
+            self.ok = False     # static.nn control entries: not segmentable
+        elif kind == "op":
+            # a raw PRNG key as a static op leaf (dropout's per-call key)
+            # would replay the cold run's mask forever — not segmentable
+            for l in payload[1]:
+                if _is_prng_key(l):
+                    self.ok = False
+                    break
+        self.ops.append((kind, payload, list(t_leaves), list(outputs)))
+
+    def on_concretize(self, t):
+        try:
+            v = np.asarray(t._value)
+        except Exception:  # noqa: BLE001 - tracers etc.: not a host read
+            return
+        if v.size > MAX_GUARD_ELEMS:
+            self.ok = False
+            return
+        self.breaks.append((len(self.ops), t, v.copy()))
+
+
+class _Slot:
+    """Index of a call-local tensor (arg or intermediate) in the replay env.
+    Externals (Parameters, module-level constants) stay as live Tensor
+    references; everything call-local is a slot so the cold run's
+    activations are not pinned by the variant."""
+
+    __slots__ = ("i",)
+
+    def __init__(self, i):
+        self.i = i
+
+
+class _Segment:
+    __slots__ = ("inputs", "out_slots", "jitted", "n_ops")
+
+    def __init__(self, inputs, out_slots, jitted, n_ops):
+        self.inputs = inputs        # list of _Slot | external Tensor
+        self.out_slots = out_slots  # list of int
+        self.jitted = jitted
+        self.n_ops = n_ops
+
+
+class _Guard:
+    __slots__ = ("seg", "ref", "value")
+
+    def __init__(self, seg, ref, value):
+        self.seg = seg
+        self.ref = ref              # _Slot | external Tensor
+        self.value = value
+
+
+class _Variant:
+    """One captured trace: arg slots, compiled segments, guards, return."""
+
+    __slots__ = ("arg_slots", "alias_pattern", "arg_consts", "segments",
+                 "guards", "ret_tree", "ret_leaves")
+
+    def __init__(self, arg_slots, alias_pattern, arg_consts, segments,
+                 guards, ret_tree, ret_leaves):
+        self.arg_slots = arg_slots      # slot per arg position (aliases share)
+        self.alias_pattern = alias_pattern
+        self.arg_consts = arg_consts
+        self.segments = segments
+        self.guards = guards
+        self.ret_tree = ret_tree        # leaves: _Slot | external Tensor |
+        self.ret_leaves = ret_leaves    # baked non-tensor python value
+
+
+def _alias_pattern(tensors):
+    """Canonical aliasing shape of the arg list: position of each tensor's
+    first occurrence. f(x, x) and f(a, b) must not share a variant."""
+    first = {}
+    out = []
+    for i, t in enumerate(tensors):
+        out.append(first.setdefault(id(t), i))
+    return tuple(out)
+
+
+def _const_key(leaves):
+    """Non-tensor call leaves: baked into recorded op payloads, so a variant
+    only replays for calls with identical constants (same identity rule as
+    StaticFunction's signature consts)."""
+    from .api import StaticFunction
+
+    return tuple(StaticFunction._const_key(l) for l in leaves
+                 if not _is_tensor(l))
+
+
+def _make_segment_fn(ops_slice, input_refs, out_slot_ids, slot_of):
+    """A pure positional function replaying ops_slice over raw values —
+    jax.jit compiles the whole run into one XLA program. Call-local tensors
+    resolve through the positional inputs; any Tensor still referenced in a
+    payload is an external whose live value arrives as an input too (all op
+    leaves are segment inputs by construction)."""
+    # rewrite payload tensor positions to slots/externals once, here, so the
+    # jitted closure holds no intermediate activations
+    rewritten = []
+    for kind, payload, t_leaves, outputs in ops_slice:
+        if kind == "op":
+            opdef, leaves, treedef, t_idx = payload
+            new_leaves = list(leaves)
+            for i in t_idx:
+                t = new_leaves[i]
+                s = slot_of.get(id(t))
+                new_leaves[i] = _Slot(s) if s is not None else t
+            rewritten.append(("op", (opdef, new_leaves, treedef, t_idx),
+                              None, [slot_of[id(o)] for o in outputs]))
+        else:
+            refs = [(_Slot(slot_of[id(t)]) if id(t) in slot_of else t)
+                    for t in t_leaves]
+            rewritten.append(("raw", payload[1], refs,
+                              [slot_of[id(o)] for o in outputs]))
+
+    in_keys = []
+    for r in input_refs:
+        in_keys.append(r.i if isinstance(r, _Slot) else ("x", id(r)))
+
+    def seg(*in_vals):
+        env = dict(zip(in_keys, in_vals))
+
+        def val(x):
+            if isinstance(x, _Slot):
+                return env[x.i]
+            return env.get(("x", id(x)), None)
+
+        for kind, payload, refs, out_slots in rewritten:
+            if kind == "op":
+                opdef, leaves, treedef, t_idx = payload
+                buf = list(leaves)
+                for i in t_idx:
+                    buf[i] = val(buf[i])
+                a, k = jax.tree_util.tree_unflatten(treedef, buf)
+                new = opdef.fn(*a, **k)
+            else:
+                new = payload(*[val(r) for r in refs])
+            new = new if isinstance(new, tuple) else (new,)
+            for s, nv in zip(out_slots, new):
+                env[s] = nv
+        return tuple(env[s] for s in out_slot_ids)
+
+    return seg
+
+
+class SegmentedFunction:
+    """Per-signature guarded segment cache for one broken function."""
+
+    def __init__(self, function):
+        self._function = function
+        self._variants = []
+        self._eager_only = False
+
+    # -- capture -------------------------------------------------------------
+    def _capture_variant(self, args, kwargs):
+        rec = _Recorder()
+        arg_leaves, _ = jax.tree_util.tree_flatten((args, kwargs),
+                                                   is_leaf=_is_tensor)
+        arg_tensors = [l for l in arg_leaves if _is_tensor(l)]
+
+        prev_active = _capture.active()
+        prev_hook = _core._CONCRETIZE_HOOK[0]
+        _capture.set_active(rec)
+        _core._CONCRETIZE_HOOK[0] = rec.on_concretize
+        try:
+            result = self._function(*args, **kwargs)
+        finally:
+            _capture.set_active(prev_active)
+            _core._CONCRETIZE_HOOK[0] = prev_hook
+
+        if not rec.ok or len(self._variants) >= MAX_VARIANTS:
+            # un-segmentable trace, or the guard tree stopped converging
+            # (drifting float guards): plain eager from now on; drop the dead
+            # variants so they stop pinning their compiled segments
+            self._eager_only = True
+            self._variants.clear()
+            return result
+
+        variant = self._build_variant(rec, arg_tensors,
+                                      _const_key(arg_leaves), result)
+        if variant is None:
+            # call-local unrecorded tensors detected: replay cannot be sound
+            self._eager_only = True
+            self._variants.clear()
+            import warnings
+
+            warnings.warn(
+                "to_static graph break: function consumes tensors from "
+                "non-recorded constructors (detach/view/random inside the "
+                "body); running this signature fully eagerly", stacklevel=3)
+            return result
+        self._variants.append(variant)
+        return result
+
+    def _build_variant(self, rec, arg_tensors, arg_consts, result):
+        ops = rec.ops
+
+        # slot assignment: args first, then every produced output. Externals
+        # (consumed, never produced, not args) keep live Tensor references.
+        slot_of = {}
+        for t in arg_tensors:
+            slot_of.setdefault(id(t), len(slot_of))
+        arg_slots = [slot_of[id(t)] for t in arg_tensors]
+        for _k, _p, _tl, outs in ops:
+            for o in outs:
+                slot_of.setdefault(id(o), len(slot_of))
+
+        def ref_of(t):
+            s = slot_of.get(id(t))
+            return _Slot(s) if s is not None else t
+
+        # externals born during the capture are call-local tensors created by
+        # non-recorded constructors (detach, views, fresh randn): their data
+        # would bake into replay with no guard able to notice — bail to eager
+        for _k, _p, t_leaves, _o in ops:
+            for t in t_leaves:
+                if (id(t) not in slot_of
+                        and t._birth > rec.start_birth):
+                    return None
+
+        ret_leaves, ret_tree = jax.tree_util.tree_flatten(result,
+                                                          is_leaf=_is_tensor)
+        needed = {id(l) for l in ret_leaves if _is_tensor(l)}
+        for _bi, t, _g in rec.breaks:
+            needed.add(id(t))
+
+        # segment boundaries: unique break op-indices, plus the end
+        bounds = sorted({bi for bi, _t, _g in rec.breaks if 0 < bi})
+        if not bounds or bounds[-1] != len(ops):
+            bounds.append(len(ops))
+        seg_ranges = []
+        start = 0
+        for end in bounds:
+            if end > start:
+                seg_ranges.append((start, end))
+            start = end
+
+        consumed_at = {}
+        for oi, (_k, _p, t_leaves, _o) in enumerate(ops):
+            for t in t_leaves:
+                consumed_at.setdefault(id(t), []).append(oi)
+
+        segments = []
+        for (s, e) in seg_ranges:
+            ops_slice = ops[s:e]
+            in_refs, seen_in = [], set()
+            local_produced = set()
+            for _kind, _payload, t_leaves, outs in ops_slice:
+                for t in t_leaves:
+                    ti = id(t)
+                    if ti not in local_produced and ti not in seen_in:
+                        seen_in.add(ti)
+                        in_refs.append(ref_of(t))
+                for o in outs:
+                    local_produced.add(id(o))
+            out_slots, seen_out = [], set()
+            for _kind, _payload, _tl, outs in ops_slice:
+                for o in outs:
+                    oid = id(o)
+                    if oid in seen_out:
+                        continue
+                    later = any(c >= e for c in consumed_at.get(oid, ()))
+                    if later or oid in needed:
+                        seen_out.add(oid)
+                        out_slots.append(slot_of[oid])
+            seg_fn = _make_segment_fn(ops_slice, in_refs, out_slots, slot_of)
+            segments.append(_Segment(in_refs, out_slots, jax.jit(seg_fn),
+                                     e - s))
+
+        # map each break to the segment after which its guard is checked
+        guards = []
+        for bi, t, g in rec.breaks:
+            seg_idx = -1  # before any segment (pure arg/external read)
+            for k, (s, e) in enumerate(seg_ranges):
+                if e <= bi:
+                    seg_idx = k
+                else:
+                    break
+            guards.append(_Guard(seg_idx, ref_of(t), g))
+        guards.sort(key=lambda g: g.seg)
+
+        ret_refs = [ref_of(l) if _is_tensor(l) else l for l in ret_leaves]
+        return _Variant(arg_slots, _alias_pattern(arg_tensors), arg_consts,
+                        segments, guards, ret_tree, ret_refs)
+
+    # -- replay --------------------------------------------------------------
+    def _replay(self, variant, args, kwargs):
+        from ..ops._apply import apply_raw
+
+        arg_leaves, _ = jax.tree_util.tree_flatten((args, kwargs),
+                                                   is_leaf=_is_tensor)
+        live_args = [l for l in arg_leaves if _is_tensor(l)]
+        if (len(live_args) != len(variant.arg_slots)
+                or _alias_pattern(live_args) != variant.alias_pattern
+                or _const_key(arg_leaves) != variant.arg_consts):
+            return _MISMATCH
+        env = {s: l for s, l in zip(variant.arg_slots, live_args)}
+
+        def live(ref):
+            return env[ref.i] if isinstance(ref, _Slot) else ref
+
+        def check(guard):
+            return np.array_equal(np.asarray(live(guard.ref)._value),
+                                  guard.value)
+
+        gi = 0
+        while gi < len(variant.guards) and variant.guards[gi].seg < 0:
+            if not check(variant.guards[gi]):
+                return _MISMATCH
+            gi += 1
+
+        for k, seg in enumerate(variant.segments):
+            tensor_args = [live(r) for r in seg.inputs]
+            outs = apply_raw(f"sot_segment_{k}", seg.jitted, tensor_args)
+            for s, new in zip(seg.out_slots, outs):
+                env[s] = new
+            while gi < len(variant.guards) and variant.guards[gi].seg == k:
+                if not check(variant.guards[gi]):
+                    return _MISMATCH
+                gi += 1
+
+        leaves = [live(r) if isinstance(r, (_Slot, Tensor)) else r
+                  for r in variant.ret_leaves]
+        return jax.tree_util.tree_unflatten(variant.ret_tree, leaves)
+
+    # -- entry ---------------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        if (self._eager_only or _capture.active() is not None
+                or not tape_safe()):
+            return self._function(*args, **kwargs)
+        for variant in self._variants:
+            out = self._replay(variant, args, kwargs)
+            if out is not _MISMATCH:
+                return out
+        return self._capture_variant(args, kwargs)
+
+    @property
+    def compiled_segment_count(self):
+        """Total compiled segments across cached variants (diagnostics)."""
+        return sum(len(v.segments) for v in self._variants)
+
+
+_MISMATCH = object()
+
+
+def tape_safe():
+    """Segment replay needs normal eager dispatch (not an outer trace)."""
+    return not tape.in_functional_mode()

@@ -1,0 +1,227 @@
+"""Mid-function graph breaks (jit/sot.py): the SOT-equivalent capability.
+
+Reference analog: test/sot/ — the reference's bytecode tracer splits a
+function at unsupported constructs, keeps the rest compiled, and guards
+cached traces. Here the same contract rides the op tape: compiled segments
+around host reads, guarded on the concretized values (VERDICT round-3 #4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _t(x, **kw):
+    return paddle.to_tensor(np.asarray(x), **kw)
+
+
+def _seg_count(sf):
+    return sum(sf.compiled_segment_counts().values())
+
+
+class TestThreeSegment:
+    def test_compiled_eager_compiled_matches_eager(self):
+        """The VERDICT acceptance test: a 3-part function (compiled prefix,
+        host-read break, compiled suffix) matches eager numerics and shows
+        more than one compiled segment."""
+        calls = []
+
+        def f(x):
+            h = paddle.tanh(x) * 2.0          # segment 1
+            gate = float(h.sum())              # BREAK: host read
+            calls.append(gate)
+            if gate > 0:
+                out = h * 3.0                  # segment 2 (this variant)
+            else:
+                out = h - 1.0
+            return out.sum()
+
+        sf = paddle.jit.to_static(f, full_graph=False)
+        x = _t(np.random.RandomState(0).rand(3, 3).astype("float32") + 0.1)
+        with pytest.warns(UserWarning, match="compiled segments"):
+            first = sf(x)                      # trace fails -> cold capture
+        eager = f(_t(x.numpy()))
+        np.testing.assert_allclose(first.numpy(), eager.numpy(), rtol=1e-6)
+        # replay path (compiled segments + guard)
+        second = sf(x)
+        np.testing.assert_allclose(second.numpy(), eager.numpy(), rtol=1e-6)
+        assert _seg_count(sf) >= 2, sf.compiled_segment_counts()
+
+    def test_guard_divergence_recaptures_other_branch(self):
+        def f(x):
+            s = x.sum()
+            if bool(s > 0):                    # BREAK with bool guard
+                return x * 2.0
+            return x * 5.0
+
+        sf = paddle.jit.to_static(f, full_graph=False)
+        pos = _t(np.array([1.0, 2.0], "float32"))
+        neg = _t(np.array([-1.0, -2.0], "float32"))
+        with pytest.warns(UserWarning):
+            np.testing.assert_allclose(sf(pos).numpy(), [2.0, 4.0])
+        np.testing.assert_allclose(sf(pos).numpy(), [2.0, 4.0])  # replay
+        # same shapes, opposite predicate -> guard mismatch -> new variant
+        np.testing.assert_allclose(sf(neg).numpy(), [-5.0, -10.0])
+        np.testing.assert_allclose(sf(neg).numpy(), [-5.0, -10.0])
+        np.testing.assert_allclose(sf(pos).numpy(), [2.0, 4.0])
+
+    def test_gradients_flow_through_segments(self):
+        def f(x):
+            h = x * 3.0
+            k = float(h.sum())                 # BREAK
+            if k > 0:
+                return (h * 2.0).sum()
+            return (h * 7.0).sum()
+
+        sf = paddle.jit.to_static(f, full_graph=False)
+        x = _t(np.array([1.0, 1.0], "float32"), stop_gradient=False)
+        with pytest.warns(UserWarning):
+            out = sf(x)                        # cold capture (eager tape)
+        out.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [6.0, 6.0])
+        # replay with grads: segments dispatch through the tape
+        x2 = _t(np.array([2.0, 0.5], "float32"), stop_gradient=False)
+        out2 = sf(x2)
+        out2.backward()
+        np.testing.assert_allclose(x2.grad.numpy(), [6.0, 6.0])
+
+    def test_replay_reads_live_parameter_values(self):
+        lin = paddle.nn.Linear(2, 2)
+
+        def f(x):
+            h = lin(x)
+            if float(h.sum()) > -1e30:         # BREAK (always true)
+                return h * 1.0
+            return h
+
+        sf = paddle.jit.to_static(f, full_graph=False)
+        x = _t(np.ones((1, 2), "float32"))
+        with pytest.warns(UserWarning):
+            a = sf(x)
+        b = sf(x)
+        np.testing.assert_allclose(a.numpy(), b.numpy(), rtol=1e-6)
+        # mutate the weight: replay must see the new value, not a baked one
+        import jax.numpy as jnp
+        lin.weight._replace_value(jnp.zeros((2, 2), jnp.float32))
+        lin.bias._replace_value(jnp.asarray([7.0, 7.0], jnp.float32))
+        c = sf(x)
+        np.testing.assert_allclose(c.numpy(), [[7.0, 7.0]], rtol=1e-6)
+
+    def test_large_host_read_stays_eager(self):
+        def f(x):
+            _ = x.numpy()                      # non-scalar host read
+            return x * 2.0
+
+        sf = paddle.jit.to_static(f, full_graph=False)
+        x = _t(np.random.RandomState(0).randn(8, 8).astype("float32"))
+        with pytest.warns(UserWarning):
+            out = sf(x)
+        np.testing.assert_allclose(out.numpy(), x.numpy() * 2.0, rtol=1e-6)
+        sf(x)
+        assert _seg_count(sf) == 0  # segmentation disabled, still correct
+
+    def test_other_signatures_stay_whole_compiled(self):
+        def f(x, flag=False):
+            if flag:
+                float(x.sum())                 # break only under flag=True
+            return x * 2.0
+
+        sf = paddle.jit.to_static(f, full_graph=False)
+        x = _t(np.ones((2,), "float32"))
+        np.testing.assert_allclose(sf(x).numpy(), [2.0, 2.0])
+        assert len(sf.concrete_program_specs()) == 1
+        with pytest.warns(UserWarning):
+            sf(x, flag=True)
+        np.testing.assert_allclose(sf(x, flag=True).numpy(), [2.0, 2.0])
+        # the flag=False program is still cached and compiled
+        assert len(sf.concrete_program_specs()) >= 1
+        np.testing.assert_allclose(sf(x).numpy(), [2.0, 2.0])
+
+    def test_multi_break_three_segments(self):
+        def f(x):
+            a = x * 2.0
+            s1 = float(a.sum())                # BREAK 1
+            b = a + s1
+            s2 = float(b.max())                # BREAK 2
+            return b * (1.0 if s2 > 0 else -1.0)
+
+        sf = paddle.jit.to_static(f, full_graph=False)
+        x = _t(np.array([0.5, 1.5], "float32"))
+        with pytest.warns(UserWarning):
+            cold = sf(x)
+        warm = sf(x)
+        eager = f(_t(x.numpy()))
+        np.testing.assert_allclose(cold.numpy(), eager.numpy(), rtol=1e-6)
+        np.testing.assert_allclose(warm.numpy(), eager.numpy(), rtol=1e-6)
+        assert _seg_count(sf) >= 3
+
+    def test_aliased_args_do_not_poison_variant(self):
+        def f(u, v):
+            s = u.sum() + v.sum()
+            if bool(s > 0):
+                return u - v
+            return u + v
+
+        sf = paddle.jit.to_static(f, full_graph=False)
+        x = _t(np.array([5.0, 5.0], "float32"))
+        with pytest.warns(UserWarning):
+            out_aliased = sf(x, x)             # capture with u is v
+        np.testing.assert_allclose(out_aliased.numpy(), [0.0, 0.0])
+        a = _t(np.array([5.0, 5.0], "float32"))
+        b = _t(np.array([1.0, 1.0], "float32"))
+        out_distinct = sf(a, b)                # distinct args: new variant
+        np.testing.assert_allclose(out_distinct.numpy(), [4.0, 4.0])
+        np.testing.assert_allclose(sf(x, x).numpy(), [0.0, 0.0])  # replay
+        np.testing.assert_allclose(sf(a, b).numpy(), [4.0, 4.0])  # replay
+
+    def test_nested_to_static_under_no_grad_replays_live(self):
+        inner = paddle.jit.to_static(lambda x: x * 10.0)
+
+        def f(x):
+            h = inner(x)
+            if bool(h.sum() > -1e30):          # always-true break
+                return h + 1.0
+            return h
+
+        sf = paddle.jit.to_static(f, full_graph=False)
+        with paddle.no_grad():
+            with pytest.warns(UserWarning):
+                first = sf(_t(np.array([1.0], "float32")))
+            np.testing.assert_allclose(first.numpy(), [11.0])
+            # replay with a different input: the nested compiled call must
+            # re-execute, not replay a baked cold-run constant
+            second = sf(_t(np.array([3.0], "float32")))
+        np.testing.assert_allclose(second.numpy(), [31.0])
+
+    def test_detach_inside_body_bails_to_eager(self):
+        """Tensors from non-recorded constructors (detach) cannot replay:
+        the signature must fall back to full eager, never stale data."""
+        def f(x):
+            d = x.detach() + 0.0
+            if bool(x.sum() > 0):
+                return d * 2.0
+            return d
+
+        sf = paddle.jit.to_static(f, full_graph=False)
+        with pytest.warns(UserWarning):
+            out1 = sf(_t(np.array([1.0, 2.0], "float32")))
+        np.testing.assert_allclose(out1.numpy(), [2.0, 4.0])
+        out2 = sf(_t(np.array([10.0, 20.0], "float32")))
+        np.testing.assert_allclose(out2.numpy(), [20.0, 40.0])  # not stale
+        assert _seg_count(sf) == 0
+
+    def test_dropout_key_bails_to_eager(self):
+        """Raw PRNG-key op leaves (per-call dropout masks) cannot replay."""
+        def f(x):
+            h = paddle.nn.functional.dropout(x, p=0.5, training=True)
+            if bool(x.sum() > -1e30):
+                return h * 1.0
+            return h
+
+        sf = paddle.jit.to_static(f, full_graph=False)
+        x = _t(np.ones((64,), "float32"))
+        with pytest.warns(UserWarning):
+            a = sf(x)
+        b = sf(x)
+        # fresh mask per call, not a replayed constant
+        assert not np.allclose(a.numpy(), b.numpy())
+        assert _seg_count(sf) == 0
