@@ -338,5 +338,54 @@ class Engine:
                               shuffle=shuffle, drop_last=True)
         return data
 
-    def cost(self, *a, **k):
-        return None
+    def cost(self, mode="train", model_desc=None, parallel=None,
+             hardware=None, batch_size=None, **k):
+        """Analytic step-time/memory estimate for this engine's model under a
+        parallel config (reference static/engine.py cost() over the
+        static/cost/ estimator; here the roofline model in cost_model.py).
+
+        model_desc/parallel/hardware accept cost_model objects or are
+        derived: the model's parameter count + a LlamaConfig-like ``config``
+        attribute when present, the strategy's hybrid degrees, and the local
+        device's hardware profile. Returns a CostEstimate (or None when the
+        model shape cannot be derived — pass model_desc explicitly)."""
+        import numpy as np
+
+        from .cost_model import (HardwareProfile, ModelDesc, ParallelConfig,
+                                 estimate_cost)
+
+        if model_desc is None and self._model is not None:
+            cfg = getattr(self._model, "config", None)
+            try:
+                n_params = sum(int(np.prod(p.shape))
+                               for p in self._model.parameters())
+            except Exception:  # noqa: BLE001
+                n_params = 0
+            if cfg is not None and hasattr(cfg, "hidden_size"):
+                model_desc = ModelDesc.from_llama_config(cfg,
+                                                         n_params=n_params)
+            elif n_params:
+                # shape-less fallback: a generic 1024-seq transformer of the
+                # same parameter count (batch_size feeds the parallel
+                # config's micro batch, never the sequence length)
+                model_desc = ModelDesc(n_params, hidden=1024, layers=1,
+                                       seq=1024)
+        if model_desc is None:
+            return None
+        if parallel is None:
+            hc = getattr(self._strategy, "hybrid_configs", None) or {}
+            parallel = ParallelConfig(
+                dp=hc.get("dp_degree", 1), mp=hc.get("mp_degree", 1),
+                pp=hc.get("pp_degree", 1), sep=hc.get("sep_degree", 1),
+                micro_batch_size=batch_size or 1,
+                sharding_stage=hc.get("sharding_degree", 1) > 1 and 1 or 0)
+        if hardware is None:
+            import jax
+
+            kind = getattr(jax.devices()[0], "device_kind",
+                           jax.devices()[0].platform)
+            try:
+                hardware = HardwareProfile.named(str(kind))
+            except KeyError:
+                hardware = HardwareProfile.named("cpu")
+        return estimate_cost(model_desc, parallel, hardware)

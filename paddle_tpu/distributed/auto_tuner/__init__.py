@@ -22,12 +22,14 @@ __all__ = ["SearchSpace", "prune_candidates", "AutoTuner", "Recorder",
 
 class SearchSpace:
     def __init__(self, num_devices, max_mp=8, max_pp=8,
-                 micro_batch_sizes=(1, 2, 4, 8), shardings=(0, 1, 2, 3)):
+                 micro_batch_sizes=(1, 2, 4, 8), shardings=(0, 1, 2, 3),
+                 recomputes=(False,)):
         self.num_devices = num_devices
         self.max_mp = max_mp
         self.max_pp = max_pp
         self.micro_batch_sizes = tuple(micro_batch_sizes)
         self.shardings = tuple(shardings)
+        self.recomputes = tuple(recomputes)
 
     def candidates(self):
         n = self.num_devices
@@ -36,10 +38,14 @@ class SearchSpace:
             if n % (mp * pp) != 0:
                 continue
             dp = n // (mp * pp)
-            for mbs, stage in itertools.product(self.micro_batch_sizes,
-                                                self.shardings):
-                yield {"dp_degree": dp, "mp_degree": mp, "pp_degree": pp,
-                       "micro_batch_size": mbs, "sharding_stage": stage}
+            for mbs, stage, rc in itertools.product(self.micro_batch_sizes,
+                                                    self.shardings,
+                                                    self.recomputes):
+                cand = {"dp_degree": dp, "mp_degree": mp, "pp_degree": pp,
+                        "micro_batch_size": mbs, "sharding_stage": stage}
+                if len(self.recomputes) > 1 or rc:
+                    cand["recompute"] = rc
+                yield cand
 
 
 def _estimate_bytes(cand, model_params, hidden, layers, seq, dtype_bytes=2):
@@ -103,18 +109,43 @@ class Recorder:
 
 
 class AutoTuner:
-    """Drive trials over the pruned space (reference tuner.py)."""
+    """Drive trials over the pruned space (reference tuner.py).
+
+    With ``cost_model=(ModelDesc, HardwareProfile)`` the analytic estimator
+    (auto_parallel/cost_model.py) orders the pruned candidates by predicted
+    step time and drops anything ``cost_keep_within``x slower than the best
+    estimate BEFORE any subprocess trial runs — the reference tuner's
+    cost-model pre-pruning, so max_trials budget goes to the plausible
+    configs instead of the lexicographic head of the space."""
 
     def __init__(self, space, trial_fn, metric="tokens_per_sec",
-                 maximize=True, max_trials=None, **prune_kwargs):
+                 maximize=True, max_trials=None, cost_model=None,
+                 cost_keep_within=3.0, **prune_kwargs):
         self.space = space
         self.trial_fn = trial_fn
         self.recorder = Recorder(metric, maximize)
         self.max_trials = max_trials
+        self.cost_model = cost_model
+        self.cost_keep_within = cost_keep_within
         self.prune_kwargs = prune_kwargs
+        self.cost_ranking = None  # [(candidate, CostEstimate)] after tune()
 
     def tune(self):
-        cands = prune_candidates(self.space, **self.prune_kwargs)
+        prune_kwargs = dict(self.prune_kwargs)
+        if self.cost_model is not None:
+            # one memory model on this path: rank_candidates' estimate does
+            # the hbm pruning, not _estimate_bytes
+            hbm = prune_kwargs.pop("hbm_bytes", None)
+        cands = prune_candidates(self.space, **prune_kwargs)
+        if self.cost_model is not None:
+            from ..auto_parallel.cost_model import rank_candidates
+
+            model_desc, hardware = self.cost_model
+            self.cost_ranking = rank_candidates(
+                cands, model_desc, hardware,
+                global_batch=prune_kwargs.get("global_batch"),
+                hbm_bytes=hbm, keep_within=self.cost_keep_within)
+            cands = [c for c, _e in self.cost_ranking]
         if self.max_trials is not None:
             cands = cands[: self.max_trials]
         for cand in cands:

@@ -290,20 +290,33 @@ class SegmentedFunction:
             s = slot_of.get(id(t))
             return _Slot(s) if s is not None else t
 
-        # externals born during the capture are call-local tensors created by
-        # non-recorded constructors (detach, views, fresh randn): their data
-        # would bake into replay with no guard able to notice — bail to eager
-        for _k, _p, t_leaves, _o in ops:
-            for t in t_leaves:
-                if (id(t) not in slot_of
-                        and t._birth > rec.start_birth):
-                    return None
-
         ret_leaves, ret_tree = jax.tree_util.tree_flatten(result,
                                                           is_leaf=_is_tensor)
         needed = {id(l) for l in ret_leaves if _is_tensor(l)}
         for _bi, t, _g in rec.breaks:
             needed.add(id(t))
+
+        # externals born during the capture are call-local tensors created by
+        # non-recorded constructors (detach, views, fresh randn): their data
+        # would bake into replay with no guard able to notice — bail to
+        # eager. Scan every place a tensor can escape to: op inputs, return
+        # leaves, and guard tensors. PRNG-key tensors are exempt: replay
+        # substitutes a fresh key (see _replay.live), so a nested compiled
+        # call's rng stays live instead of forcing eager.
+        def _unreplayable(t):
+            return (id(t) not in slot_of and t._birth > rec.start_birth
+                    and not _is_prng_key(t._value))
+
+        for _k, _p, t_leaves, _o in ops:
+            for t in t_leaves:
+                if _unreplayable(t):
+                    return None
+        for l in ret_leaves:
+            if _is_tensor(l) and _unreplayable(l):
+                return None
+        for _bi, t, _g in rec.breaks:
+            if _unreplayable(t):
+                return None
 
         # segment boundaries: unique break op-indices, plus the end
         bounds = sorted({bi for bi, _t, _g in rec.breaks if 0 < bi})
@@ -378,7 +391,15 @@ class SegmentedFunction:
         env = {s: l for s, l in zip(variant.arg_slots, live_args)}
 
         def live(ref):
-            return env[ref.i] if isinstance(ref, _Slot) else ref
+            if isinstance(ref, _Slot):
+                return env[ref.i]
+            if _is_prng_key(ref._value):
+                # per-call randomness: a captured key external (a nested
+                # compiled call's rng) gets a fresh key each replay
+                from ..framework import random as _rng
+
+                return Tensor(_rng.next_key())
+            return ref
 
         def check(guard):
             return np.array_equal(np.asarray(live(guard.ref)._value),

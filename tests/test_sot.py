@@ -225,3 +225,37 @@ class TestThreeSegment:
         # fresh mask per call, not a replayed constant
         assert not np.allclose(a.numpy(), b.numpy())
         assert _seg_count(sf) == 0
+
+    def test_detach_in_return_bails(self):
+        """Unrecorded tensors escaping via RETURN leaves must also bail."""
+        def f(x):
+            s = x * 2.0
+            float(s.sum())
+            return x.detach()
+
+        sf = paddle.jit.to_static(f, full_graph=False)
+        with pytest.warns(UserWarning):
+            a = sf(_t(np.array([1.0], "float32")))
+        np.testing.assert_allclose(a.numpy(), [1.0])
+        b = sf(_t(np.array([9.0], "float32")))
+        np.testing.assert_allclose(b.numpy(), [9.0])  # not the stale [1.0]
+        assert _seg_count(sf) == 0
+
+    def test_nested_to_static_segments_despite_rng_key(self):
+        """A nested compiled call's fresh PRNG-key tensor must not force
+        eager: replay substitutes a fresh key and keeps the segments."""
+        inner = paddle.jit.to_static(lambda x: x * 10.0)
+
+        def f(x):
+            h = inner(x)
+            if bool(h.sum() > -1e30):
+                return h + 1.0
+            return h
+
+        sf = paddle.jit.to_static(f, full_graph=False)
+        with paddle.no_grad():
+            with pytest.warns(UserWarning):
+                sf(_t(np.array([1.0], "float32")))
+            out = sf(_t(np.array([3.0], "float32")))
+        np.testing.assert_allclose(out.numpy(), [31.0])
+        assert _seg_count(sf) >= 1  # segmentation survived the key external
