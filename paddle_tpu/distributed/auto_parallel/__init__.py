@@ -346,11 +346,10 @@ class Engine:
 
         model_desc/parallel/hardware accept cost_model objects or are
         derived: the model's parameter count + a LlamaConfig-like ``config``
-        attribute when present, the strategy's hybrid degrees, and the local
+        attribute when present, the strategy's hybrid degrees (including the
+        ZeRO stage, pipeline accumulate_steps, and recompute), and the local
         device's hardware profile. Returns a CostEstimate (or None when the
         model shape cannot be derived — pass model_desc explicitly)."""
-        import numpy as np
-
         from .cost_model import (HardwareProfile, ModelDesc, ParallelConfig,
                                  estimate_cost)
 
@@ -374,14 +373,21 @@ class Engine:
             return None
         if parallel is None:
             hc = getattr(self._strategy, "hybrid_configs", None) or {}
+            sc = getattr(self._strategy, "sharding_configs", None) or {}
+            pc = getattr(self._strategy, "pipeline_configs", None) or {}
+            sharding_deg = max(hc.get("sharding_degree", 1),
+                               sc.get("sharding_degree", 1))
             parallel = ParallelConfig(
-                dp=hc.get("dp_degree", 1), mp=hc.get("mp_degree", 1),
+                dp=hc.get("dp_degree", 1) * max(1, sharding_deg),
+                mp=hc.get("mp_degree", 1),
                 pp=hc.get("pp_degree", 1), sep=hc.get("sep_degree", 1),
-                micro_batch_size=batch_size or 1,
-                sharding_stage=hc.get("sharding_degree", 1) > 1 and 1 or 0)
+                micro_batch_size=pc.get("micro_batch_size",
+                                        batch_size or 1),
+                n_micro=pc.get("accumulate_steps", 1),
+                sharding_stage=(sc.get("stage", 1) if sharding_deg > 1
+                                else 0),
+                recompute=bool(getattr(self._strategy, "recompute", False)))
         if hardware is None:
-            import jax
-
             kind = getattr(jax.devices()[0], "device_kind",
                            jax.devices()[0].platform)
             try:

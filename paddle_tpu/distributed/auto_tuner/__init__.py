@@ -49,19 +49,18 @@ class SearchSpace:
 
 
 def _estimate_bytes(cand, model_params, hidden, layers, seq, dtype_bytes=2):
-    """Per-device memory estimate (reference prune.py memory heuristics)."""
-    dp, mp, pp = cand["dp_degree"], cand["mp_degree"], cand["pp_degree"]
-    stage = cand["sharding_stage"]
-    shard = mp * pp
-    param_b = model_params * dtype_bytes / shard
-    master_opt = model_params * 12 / shard          # fp32 master + 2 moments
-    if stage >= 1:
-        master_opt /= dp
-    if stage >= 3:
-        param_b /= dp
-    act = (cand["micro_batch_size"] * seq * hidden * layers
-           * 4 * dtype_bytes) / (mp * pp)
-    return param_b + master_opt + act
+    """Per-device memory estimate — delegates to the one memory model
+    (auto_parallel/cost_model.py estimate_cost), so the hbm pruning here and
+    the cost-ranked path cannot diverge."""
+    from ..auto_parallel.cost_model import (HardwareProfile, ModelDesc,
+                                            ParallelConfig, estimate_cost)
+
+    model = ModelDesc(model_params, hidden or 1, layers or 1, seq or 1,
+                      dtype_bytes=dtype_bytes)
+    par = ParallelConfig.from_candidate(cand)
+    # any profile works: memory_bytes does not depend on the hardware peaks
+    est = estimate_cost(model, par, HardwareProfile.named("tpu v5e"))
+    return est.memory_bytes
 
 
 def prune_candidates(space, model_params=0, hidden=0, layers=0, seq=0,

@@ -136,10 +136,10 @@ class _Variant:
     """One captured trace: arg slots, compiled segments, guards, return."""
 
     __slots__ = ("arg_slots", "alias_pattern", "arg_consts", "segments",
-                 "guards", "ret_tree", "ret_leaves")
+                 "guards", "ret_tree", "ret_leaves", "capture_birth")
 
     def __init__(self, arg_slots, alias_pattern, arg_consts, segments,
-                 guards, ret_tree, ret_leaves):
+                 guards, ret_tree, ret_leaves, capture_birth):
         self.arg_slots = arg_slots      # slot per arg position (aliases share)
         self.alias_pattern = alias_pattern
         self.arg_consts = arg_consts
@@ -147,6 +147,7 @@ class _Variant:
         self.guards = guards
         self.ret_tree = ret_tree        # leaves: _Slot | external Tensor |
         self.ret_leaves = ret_leaves    # baked non-tensor python value
+        self.capture_birth = capture_birth
 
 
 def _alias_pattern(tensors):
@@ -375,7 +376,8 @@ class SegmentedFunction:
 
         ret_refs = [ref_of(l) if _is_tensor(l) else l for l in ret_leaves]
         return _Variant(arg_slots, _alias_pattern(arg_tensors), arg_consts,
-                        segments, guards, ret_tree, ret_refs)
+                        segments, guards, ret_tree, ret_refs,
+                        rec.start_birth)
 
     # -- replay --------------------------------------------------------------
     def _replay(self, variant, args, kwargs):
@@ -393,9 +395,11 @@ class SegmentedFunction:
         def live(ref):
             if isinstance(ref, _Slot):
                 return env[ref.i]
-            if _is_prng_key(ref._value):
-                # per-call randomness: a captured key external (a nested
-                # compiled call's rng) gets a fresh key each replay
+            if (ref._birth > variant.capture_birth
+                    and _is_prng_key(ref._value)):
+                # per-call randomness: a key external BORN DURING capture (a
+                # nested compiled call's rng) gets a fresh key each replay; a
+                # user's pre-existing fixed key stays fixed
                 from ..framework import random as _rng
 
                 return Tensor(_rng.next_key())

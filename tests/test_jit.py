@@ -23,10 +23,11 @@ def test_to_static_function_matches_eager():
     def f(x, y):
         return paddle.matmul(x, y) + x.sum()
 
-    xn = np.random.randn(3, 3).astype(np.float32)
+    xn = np.random.RandomState(0).randn(3, 3).astype(np.float32)
     x = paddle.to_tensor(xn)
     out = f(x, x)
-    np.testing.assert_allclose(out.numpy(), xn @ xn + xn.sum(), rtol=1e-5)
+    np.testing.assert_allclose(out.numpy(), xn @ xn + xn.sum(),
+                               rtol=1e-5, atol=1e-6)
     # second call hits the cache (no retrace) and matches
     out2 = f(x, x)
     np.testing.assert_allclose(out2.numpy(), out.numpy())
