@@ -56,7 +56,11 @@ def build_parser():
                    choices=["collective", "ps"],
                    help="ps mode is not supported by the TPU build")
     p.add_argument("--max_restart", type=int, default=0,
-                   help="relaunch the pod up to N times on failure (elastic)")
+                   help="relaunch the pod up to N times on failure (elastic); with nnodes>1 the launchers coordinate through a side store on master_port+1 (keep that port free)")
+    p.add_argument("--elastic_timeout", type=float, default=10.0,
+                   help="seconds without a peer node's heartbeat before it "
+                        "is declared dead and the pod restarts (nnodes>1 "
+                        "with --max_restart>0)")
     p.add_argument("training_script", help="script or module to run")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p
@@ -106,8 +110,23 @@ def _spawn(args, master, base_env):
     return procs, logs
 
 
-def _watch(procs):
-    """Wait for children; on first failure kill the rest (controller.py watch)."""
+def _kill_pod(procs):
+    for q in procs:
+        if q.poll() is None:
+            q.terminate()
+    deadline = time.time() + 10
+    for q in procs:
+        try:
+            q.wait(max(0.1, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            q.kill()
+
+
+def _watch(procs, peer_dead=None):
+    """Wait for children; on first failure kill the rest (controller.py
+    watch). ``peer_dead`` (a threading.Event set by the elastic manager on a
+    remote node's lease expiry) also tears the local pod down — a dead peer
+    leaves local ranks blocked in collectives forever otherwise."""
     try:
         while True:
             alive = False
@@ -116,18 +135,15 @@ def _watch(procs):
                 if rc is None:
                     alive = True
                 elif rc != 0:
-                    for q in procs:
-                        if q.poll() is None:
-                            q.terminate()
-                    deadline = time.time() + 10
-                    for q in procs:
-                        try:
-                            q.wait(max(0.1, deadline - time.time()))
-                        except subprocess.TimeoutExpired:
-                            q.kill()
+                    _kill_pod(procs)
                     return rc
             if not alive:
+                # all children exited 0: success wins over a concurrent
+                # peer-dead signal (our work is durably done)
                 return 0
+            if peer_dead is not None and peer_dead.is_set():
+                _kill_pod(procs)
+                return _PEER_DEAD_RC
             time.sleep(0.2)
     except KeyboardInterrupt:
         for q in procs:
@@ -136,6 +152,9 @@ def _watch(procs):
         for q in procs:
             q.wait()
         return 130
+
+
+_PEER_DEAD_RC = 3801  # sentinel: pod torn down because a peer node died
 
 
 def launch(argv=None):
@@ -159,17 +178,190 @@ def launch(argv=None):
         master = f"{master}:{_free_port()}"
 
     base_env = dict(os.environ)
+    elastic = None
+    if args.nnodes > 1 and args.max_restart > 0:
+        elastic = _ElasticCoordinator(args, master)
+
     attempt = 0
     while True:
+        if elastic is not None:
+            # publish this pod generation and wait for peers to reach it, so
+            # a restarted node doesn't rendezvous against a pod that is about
+            # to be torn down (sync_attempt also clears the peer event once
+            # its own attempt view is current — ordering matters for the
+            # watcher race)
+            attempt, peers_ok = elastic.sync_attempt(attempt)
+            if not peers_ok:
+                print("[launch] elastic: peers never reached generation "
+                      f"{attempt} (node lost for good?); giving up",
+                      file=sys.stderr)
+                elastic.shutdown(completed=False)
+                return _PEER_DEAD_RC
         procs, logs = _spawn(args, master, base_env)
-        rc = _watch(procs)
+        rc = _watch(procs, peer_dead=elastic.peer_event if elastic else None)
         for f in logs:
             f.close()
-        if rc == 0 or attempt >= args.max_restart:
+        if rc == 130:  # user interrupt is never a restartable failure
+            if elastic is not None:
+                elastic.shutdown(completed=False)
+            return rc
+        if rc == 0 or attempt >= args.max_restart or (
+                elastic is not None and elastic.store_lost):
+            if elastic is not None and elastic.store_lost and rc != 0:
+                print("[launch] elastic: coordinator store unreachable "
+                      "(rank-0 launcher died?); giving up", file=sys.stderr)
+            if elastic is not None:
+                elastic.shutdown(completed=(rc == 0))
             return rc
         attempt += 1
-        print(f"[launch] pod failed rc={rc}; restart {attempt}/{args.max_restart}",
+        why = ("peer node failure" if rc == _PEER_DEAD_RC
+               else f"pod failed rc={rc}")
+        print(f"[launch] {why}; restart {attempt}/{args.max_restart}",
               file=sys.stderr)
+
+
+class _ElasticCoordinator:
+    """Launcher-side elastic wiring (reference fleet/elastic/manager.py:125
+    relaunch semantics over the TCPStore registry in fleet/elastic.py).
+
+    Each node's LAUNCHER heartbeats on a side store at master_port+1 (rank 0
+    hosts it; it outlives trainer crashes). Two restart triggers feed the
+    watch loop's peer_dead event:
+    * lease expiry — a peer launcher died (node loss);
+    * generation bump — a peer launcher restarted its pod (its trainer
+      crashed), so this node's ranks are blocked in dead collectives and the
+      whole world must re-form.
+    sync_attempt() publishes the pod generation and waits for every live
+    peer to reach it before (re)spawning, so re-rendezvous starts aligned."""
+
+    def __init__(self, args, master):
+        import threading
+
+        from ..fleet.elastic import ElasticManager
+        from ..store import TCPStore
+
+        self.args = args
+        host, port = master.rsplit(":", 1)
+        # convention: the elastic side store lives at master_port+1 — make
+        # sure that port is free for the job (help text documents it)
+        self.store = TCPStore(host, int(port) + 1,
+                              is_master=(args.rank == 0),
+                              world_size=args.nnodes, timeout=120)
+        self.peer_event = threading.Event()
+        self.store_lost = False
+        self._attempt = 0
+        self._stop = threading.Event()
+        self._store_err_since = None
+
+        def on_scale(old, new):
+            missing = set(old) - set(new)
+            # a peer that marked itself done completed normally: its
+            # deregistration is not a failure
+            if any(not self._peer_done(m) for m in missing):
+                self.peer_event.set()
+
+        self.manager = ElasticManager(
+            self.store, node_id=args.rank, np=args.nnodes,
+            heartbeat_interval=max(0.5, args.elastic_timeout / 5),
+            dead_after=args.elastic_timeout, on_scale=on_scale,
+            job_id=args.job_id)
+        self.manager.start()
+        t = threading.Thread(target=self._watch_generations, daemon=True)
+        t.start()
+
+    def _key(self, kind, rank):
+        return f"elastic/{self.args.job_id}/{kind}/{rank}"
+
+    def _peer_done(self, rank):
+        try:
+            return self.store.get(self._key("done", rank),
+                                  timeout=0.05) == b"1"
+        except Exception:  # noqa: BLE001
+            return False
+
+    def _peer_attempts(self):
+        # NOTE: one short-timeout get per peer per poll; fine for pod-scale
+        # nnodes. A single JSON map key (members-list style) is the upgrade
+        # path if nnodes grows past tens.
+        out = {}
+        err = False
+        for r in range(self.args.nnodes):
+            if r == self.args.rank:
+                continue
+            try:
+                out[r] = int(self.store.get(self._key("attempt", r),
+                                            timeout=0.05))
+            except (ConnectionError, OSError):
+                err = True
+            except Exception:  # noqa: BLE001 - peer not registered yet
+                pass
+        self._note_store_health(err and not out)
+        return out
+
+    def _note_store_health(self, all_failed):
+        import time as _time
+
+        if not all_failed:
+            self._store_err_since = None
+            return
+        now = _time.time()
+        if self._store_err_since is None:
+            self._store_err_since = now
+        elif now - self._store_err_since > self.args.elastic_timeout:
+            # the side store itself is gone (rank-0 launcher death): local
+            # ranks are blocked forever and restarting cannot help — surface
+            # it so launch() exits with a diagnosable error
+            self.store_lost = True
+            self.peer_event.set()
+
+    def _watch_generations(self):
+        while not self._stop.is_set():
+            peers = self._peer_attempts()
+            if peers and max(peers.values()) > self._attempt:
+                self.peer_event.set()
+            self._stop.wait(0.5)
+
+    def sync_attempt(self, attempt):
+        """Returns (attempt, peers_ok). Updates the local attempt view BEFORE
+        clearing the peer event so the generation watcher cannot re-arm it
+        from a stale comparison."""
+        import time as _time
+
+        attempt = max([attempt] + list(self._peer_attempts().values()))
+        self._attempt = attempt
+        self.peer_event.clear()
+        try:
+            self.store.set(self._key("attempt", self.args.rank),
+                           str(attempt))
+        except Exception:  # noqa: BLE001
+            self.store_lost = True
+            return attempt, False
+        deadline = _time.time() + self.args.elastic_timeout * 3
+        while _time.time() < deadline:
+            peers = self._peer_attempts()
+            done = sum(1 for r in range(self.args.nnodes)
+                       if r != self.args.rank and self._peer_done(r))
+            if len(peers) + done >= self.args.nnodes - 1 and all(
+                    a >= attempt for a in peers.values()):
+                return attempt, True
+            if self.store_lost:
+                return attempt, False
+            _time.sleep(0.2)
+        return attempt, False
+
+    def shutdown(self, completed):
+        self._stop.set()
+        try:
+            # publish completion BEFORE deregistering, so peers' on_scale
+            # treats the membership shrink as a normal exit, not a death
+            self.store.set(self._key("done", self.args.rank), b"1")
+        except Exception:  # noqa: BLE001
+            pass
+        self.manager.exit(completed=completed)
+        try:
+            self.store.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
 
 
 def main():

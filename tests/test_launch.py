@@ -363,3 +363,146 @@ class TestReviewFixes:
         finally:
             bs._STORE[0] = old_bs
             store_mod._GLOBAL_STORE[0] = old_global
+
+
+_ELASTIC_TRAINER = """
+import os, signal, sys
+import numpy as np
+import paddle_tpu as paddle  # noqa: F401
+import paddle_tpu.distributed as dist
+
+dist.init_parallel_env()
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from paddle_tpu.distributed.checkpoint import save_state_dict, load_state_dict
+from paddle_tpu.framework.core import Tensor
+
+assert jax.device_count() == 8, jax.device_count()
+mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("dp", "mp"))
+rows = NamedSharding(mesh, P("dp"))
+col_w = NamedSharding(mesh, P(None, "mp"))
+row_w = NamedSharding(mesh, P("mp", None))
+rep = NamedSharding(mesh, P())
+
+rng = np.random.RandomState(0)
+X = rng.randn(16, 4).astype("float32")
+Y = X @ np.array([[1.0], [-2.0], [0.5], [3.0]], "float32")
+W1 = (rng.randn(4, 8) * 0.5).astype("float32")
+W2 = (rng.randn(8, 1) * 0.5).astype("float32")
+rank, nproc = jax.process_index(), jax.process_count()
+per = 16 // nproc
+local = slice(rank * per, (rank + 1) * per)
+Xg = jax.make_array_from_process_local_data(rows, X[local], X.shape)
+Yg = jax.make_array_from_process_local_data(rows, Y[local], Y.shape)
+W1g = jax.make_array_from_process_local_data(col_w, W1, W1.shape)
+W2g = jax.make_array_from_process_local_data(row_w, W2, W2.shape)
+
+CKPT = os.environ["CKPT_DIR"]
+MARKER = os.environ["KILL_MARKER"]
+KILL_AT = int(os.environ.get("KILL_AT", "-1"))
+TOTAL = int(os.environ.get("TOTAL_STEPS", "10"))
+
+def step(w1, w2, x, y):
+    def loss_fn(w1, w2):
+        return jnp.mean(((x @ w1) @ w2 - y) ** 2)
+    loss, (g1, g2) = jax.value_and_grad(loss_fn, argnums=(0, 1))(w1, w2)
+    return w1 - 0.1 * g1, w2 - 0.1 * g2, loss
+
+step_c = jax.jit(step, in_shardings=(col_w, row_w, rows, rows),
+                 out_shardings=(col_w, row_w, rep))
+
+start = 0
+step_file = os.path.join(CKPT, "step.txt")
+if os.path.exists(step_file):
+    start = int(open(step_file).read().strip())
+    state = {"W1": Tensor(W1g), "W2": Tensor(W2g)}
+    load_state_dict(state, os.path.join(CKPT, f"step_{start}"))
+    W1g, W2g = state["W1"].value, state["W2"].value
+    print(f"RESUMED_AT={start}", flush=True)
+
+for i in range(start, TOTAL):
+    W1g, W2g, loss = step_c(W1g, W2g, Xg, Yg)
+    jax.block_until_ready(loss)
+    print(f"STEP={i} LOSS={float(loss):.10f}", flush=True)
+    ck = os.path.join(CKPT, f"step_{i + 1}")
+    save_state_dict({"W1": Tensor(W1g), "W2": Tensor(W2g)}, ck)
+    dist.barrier()            # both ranks' shards durable before step.txt
+    if rank == 0:
+        tmp = step_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(i + 1))
+        os.replace(tmp, step_file)
+    if i + 1 == KILL_AT and rank == 1 and not os.path.exists(MARKER):
+        with open(MARKER, "w") as f:
+            f.write("x")
+        os.kill(os.getpid(), signal.SIGKILL)
+print(f"FINAL_LOSS={float(loss):.10f}", flush=True)
+"""
+
+
+@pytest.mark.timeout(420)
+def test_elastic_kill_rank_relaunch_resume(tmp_path):
+    """Fault injection e2e (round-3 VERDICT #5): SIGKILL one rank mid-step;
+    its launcher restarts locally, the OTHER node's launcher learns through
+    the elastic generation registry, tears down its blocked pod, and both
+    re-rendezvous; training resumes from the distributed checkpoint with
+    loss continuity vs an uninterrupted reference run.
+
+    Reference analog: fleet/elastic/manager.py:125 relaunch + the
+    distributed checkpoint resume path."""
+    script = tmp_path / "elastic_trainer.py"
+    script.write_text(_ELASTIC_TRAINER)
+    base_env = dict(os.environ)
+    base_env["PADDLE_TPU_PLATFORM"] = "cpu"
+    base_env["PYTHONPATH"] = REPO + os.pathsep + base_env.get("PYTHONPATH", "")
+    base_env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    base_env.pop("JAX_PLATFORMS", None)
+    base_env["TOTAL_STEPS"] = "8"
+
+    def run_pair(ckpt, marker, kill_at, log_dir, max_restart):
+        port = _free_port()
+        env = dict(base_env)
+        env["CKPT_DIR"] = str(ckpt)
+        env["KILL_MARKER"] = str(marker)
+        env["KILL_AT"] = str(kill_at)
+        os.makedirs(ckpt, exist_ok=True)
+        launchers = [subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--master", f"127.0.0.1:{port}", "--nnodes", "2",
+             "--rank", str(r), "--nproc_per_node", "1",
+             "--max_restart", str(max_restart), "--elastic_timeout", "6",
+             "--log_dir", str(log_dir), str(script)],
+            env=env, cwd=REPO) for r in range(2)]
+        rcs = [p.wait(timeout=360) for p in launchers]
+        logs = {}
+        for i in range(2):
+            path = log_dir / f"workerlog.{i}"
+            logs[i] = path.read_text() if path.exists() else "<missing>"
+        return rcs, logs
+
+    # uninterrupted reference
+    rcs, ref_logs = run_pair(tmp_path / "ck_ref", tmp_path / "m_ref",
+                             -1, tmp_path / "logs_ref", 0)
+    assert rcs == [0, 0], ref_logs
+    ref_losses = {int(l.split()[0].split("=")[1]): float(l.split()[1].split("=")[1])
+                  for l in ref_logs[0].splitlines() if l.startswith("STEP=")}
+    assert "FINAL_LOSS=" in ref_logs[0]
+
+    # faulted run: rank 1 SIGKILLs itself after step 4's checkpoint
+    rcs, logs = run_pair(tmp_path / "ck_f", tmp_path / "m_f",
+                         4, tmp_path / "logs_f", 2)
+    assert rcs == [0, 0], logs
+    both = logs[0] + logs[1]
+    assert "RESUMED_AT=4" in both, both
+    for i in range(2):
+        assert "FINAL_LOSS=" in logs[i], logs[i]
+    # loss continuity: post-resume losses match the uninterrupted run
+    post = {int(l.split()[0].split("=")[1]): float(l.split()[1].split("=")[1])
+            for l in logs[0].splitlines() if l.startswith("STEP=")}
+    for s in range(4, 8):
+        assert abs(post[s] - ref_losses[s]) < 1e-6, (s, post[s], ref_losses[s])
+    finals = [float([l for l in logs[i].splitlines()
+                     if l.startswith("FINAL_LOSS=")][-1].split("=")[1])
+              for i in range(2)]
+    assert finals[0] == finals[1]
