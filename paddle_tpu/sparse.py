@@ -525,3 +525,326 @@ def _tensor_to_sparse_csr(self):
 
 Tensor.to_sparse_coo = _tensor_to_sparse_coo
 Tensor.to_sparse_csr = _tensor_to_sparse_csr
+
+
+# --------------------------------------------------------------------------- #
+# sparse NN layers: submanifold / regular conv, batch norm, max pooling
+# (reference python/paddle/sparse/nn/layer/{conv,norm,pooling}.py)
+#
+# TPU-first formulation: sparse convolution is a static python loop over the
+# kernel volume of gather -> matmul -> accumulate steps (one [nnz, C_in] x
+# [C_in, C_out] matmul per kernel offset — MXU work), with neighbor lookup
+# through a dense linear-position map (scatter once, gather per offset).
+# Point layout matches the reference: indices over (batch, *spatial), dense
+# trailing channel axis, channels-last.
+#
+# Scope note: these layers are inference/forward surfaces this round —
+# training a sparse conv net end-to-end needs cotangents threaded through
+# SparseCooTensor (the reference's sparse grad kernels); the dense-hop
+# pattern (to_dense() before the loss) trains today.
+# --------------------------------------------------------------------------- #
+
+def _ravel_coords(batch, coords, dims):
+    """(batch, [nnz, ndim] coords) -> linear ids over (N, *dims)."""
+    lin = batch
+    for d in range(coords.shape[1]):
+        lin = lin * dims[d] + coords[:, d]
+    return lin
+
+
+def _position_map(lin, size, nnz):
+    return jnp.full((size,), -1, jnp.int32).at[lin].set(
+        jnp.arange(nnz, dtype=jnp.int32))
+
+
+def _build_pos_map(idx, spatial, n_batch, nnz):
+    """Dense linear-position map: coords -> row index in the values array."""
+    size = n_batch
+    for s in spatial:
+        size *= s
+    return _position_map(_ravel_coords(idx[:, 0], idx[:, 1:], spatial),
+                         size, nnz)
+
+
+def _gather_neighbor(feats, pos_map, batch, nb_coords, spatial, n_batch):
+    """Features of the point at nb_coords (zeros when absent/out of range)."""
+    ndim = nb_coords.shape[1]
+    valid = jnp.ones(nb_coords.shape[0], bool)
+    for d in range(ndim):
+        valid &= (nb_coords[:, d] >= 0) & (nb_coords[:, d] < spatial[d])
+    clipped = jnp.clip(nb_coords, 0,
+                       jnp.asarray(spatial, nb_coords.dtype) - 1)
+    lin = _ravel_coords(batch, clipped, spatial)
+    row = pos_map[lin]
+    ok = valid & (row >= 0)
+    gathered = feats[jnp.clip(row, 0)] * ok[:, None].astype(feats.dtype)
+    return gathered, ok
+
+
+def _check_point_features(feats, who):
+    if feats.ndim != 2:
+        raise ValueError(
+            f"{who} expects COO points with a dense trailing channel axis "
+            "(values [nnz, C]); build the input with "
+            "to_sparse_coo(ndim - 1) so the channel dim stays dense "
+            f"(got values of rank {feats.ndim})")
+
+
+def _sparse_conv_values(out_batch, out_coords, in_coo, weight, bias, stride,
+                        padding, spatial, n_batch):
+    """values[j] = sum_over_kernel_offsets W[off] @ x[out*stride-pad+off]."""
+    idx = in_coo._bcoo.indices
+    feats = in_coo._bcoo.data
+    pos_map = _build_pos_map(idx, spatial, n_batch, feats.shape[0])
+    kdims = weight.shape[:-2]                      # (kd, kh, kw) / (kh, kw)
+    c_in, c_out = weight.shape[-2], weight.shape[-1]
+    out = jnp.zeros((out_coords.shape[0], c_out), feats.dtype)
+    for flat_off in range(int(np.prod(kdims))):
+        off = np.unravel_index(flat_off, kdims)
+        nb = jnp.stack([
+            out_coords[:, d] * stride[d] - padding[d] + off[d]
+            for d in range(len(kdims))], axis=1)
+        gathered, _ok = _gather_neighbor(feats, pos_map, out_batch, nb,
+                                         spatial, n_batch)
+        out = out + gathered @ weight[off]
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def _conv_out_pattern(np_idx, kdims, stride, padding, spatial):
+    """Host-side output sparsity pattern: every output position reached by
+    an input point (reference sparse conv rulebook construction). Eager-only
+    by design — the pattern size is data-dependent."""
+    batch = np_idx[:, :1]
+    coords = np_idx[:, 1:]
+    outs = []
+    out_spatial = [
+        (spatial[d] + 2 * padding[d] - kdims[d]) // stride[d] + 1
+        for d in range(len(kdims))]
+    for flat_off in range(int(np.prod(kdims))):
+        off = np.unravel_index(flat_off, kdims)
+        num = coords + np.asarray(padding) - np.asarray(off)
+        ok = (num % np.asarray(stride) == 0).all(axis=1)
+        oc = num // np.asarray(stride)
+        for d in range(len(kdims)):
+            ok &= (oc[:, d] >= 0) & (oc[:, d] < out_spatial[d])
+        outs.append(np.concatenate([batch[ok], oc[ok]], axis=1))
+    allc = np.unique(np.concatenate(outs, axis=0), axis=0)
+    return allc, out_spatial
+
+
+class _SparseConvNd(object):
+    """Shared impl; subm=True keeps the input's sparsity pattern."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, subm=False, ndim=3,
+                 bias_attr=None, padding_mode="zeros", data_format=None,
+                 weight_attr=None, key=None):
+        from .nn.initializer import XavierUniform
+
+        if weight_attr is not None:
+            raise NotImplementedError(
+                "sparse conv weight_attr is not honored in this build; "
+                "assign layer.weight directly after construction")
+        if groups != 1:
+            raise NotImplementedError("sparse conv supports groups=1")
+        if dilation not in (1, (1,) * ndim, [1] * ndim):
+            raise NotImplementedError("sparse conv supports dilation=1")
+        ks = (kernel_size,) * ndim if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self.kernel_size = ks
+        self.stride = ((stride,) * ndim if isinstance(stride, int)
+                       else tuple(stride))
+        self.padding = ((padding,) * ndim if isinstance(padding, int)
+                        else tuple(padding))
+        self.subm = subm
+        # explicit fans: the channels-last kernel layout (*k, Cin, Cout)
+        # would mislead the (Cout, Cin, *k)-assuming default fan inference
+        vol = int(np.prod(ks))
+        init = XavierUniform(fan_in=in_channels * vol,
+                             fan_out=out_channels * vol)
+        from .framework.core import Parameter
+
+        self.weight = Parameter(jnp.asarray(init(
+            ks + (in_channels, out_channels), np.dtype("float32"))))
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = Parameter(jnp.zeros((out_channels,), jnp.float32))
+
+    def parameters(self):
+        return [p for p in (self.weight, self.bias) if p is not None]
+
+    def __call__(self, x):
+        return self.forward(x)
+
+    def forward(self, x):
+        coo = _as_coo(x).coalesce()
+        _check_point_features(coo._bcoo.data, type(self).__name__)
+        shape = tuple(coo.shape)
+        n_batch = shape[0]
+        spatial = shape[1:-1]
+        idx = coo._bcoo.indices
+        if self.subm:
+            if any(s != 1 for s in self.stride):
+                raise ValueError("SubmConv requires stride 1")
+            out_batch, out_coords = idx[:, 0], idx[:, 1:]
+            # centered offsets: output position p gathers p + (off - center)
+            pad = tuple(k // 2 for k in self.kernel_size)
+            vals = _sparse_conv_values(out_batch, out_coords, coo,
+                                       self.weight.value,
+                                       None if self.bias is None
+                                       else self.bias.value,
+                                       (1,) * len(spatial), pad, spatial,
+                                       n_batch)
+            out_shape = shape[:-1] + (self.weight.shape[-1],)
+            out_idx = idx
+        else:
+            np_idx = np.asarray(jax.device_get(idx))
+            allc, out_spatial = _conv_out_pattern(
+                np_idx, self.kernel_size, self.stride, self.padding, spatial)
+            out_idx = jnp.asarray(allc, idx.dtype)
+            vals = _sparse_conv_values(out_idx[:, 0], out_idx[:, 1:], coo,
+                                       self.weight.value,
+                                       None if self.bias is None
+                                       else self.bias.value,
+                                       self.stride, self.padding, spatial,
+                                       n_batch)
+            out_shape = (n_batch, *out_spatial, self.weight.shape[-1])
+        return SparseCooTensor(jsparse.BCOO((vals, out_idx),
+                                            shape=out_shape))
+
+
+class SparseConv3D(_SparseConvNd):
+    """reference sparse/nn/layer/conv.py:308 Conv3D (channels-last NDHWC)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, subm=False, ndim=3,
+                         bias_attr=bias_attr, weight_attr=weight_attr)
+
+
+class SparseSubmConv3D(_SparseConvNd):
+    """reference conv.py:578 SubmConv3D: output pattern == input pattern."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 key=None):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, subm=True, ndim=3,
+                         bias_attr=bias_attr, weight_attr=weight_attr)
+
+
+class SparseConv2D(_SparseConvNd):
+    """reference conv.py:443 Conv2D (channels-last NHWC)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, subm=False, ndim=2,
+                         bias_attr=bias_attr, weight_attr=weight_attr)
+
+
+class SparseSubmConv2D(_SparseConvNd):
+    """reference conv.py:720 SubmConv2D."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NHWC",
+                 key=None):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, subm=True, ndim=2,
+                         bias_attr=bias_attr, weight_attr=weight_attr)
+
+
+class SparseBatchNorm(object):
+    """reference sparse/nn/layer/norm.py:35 BatchNorm: dense BN over the nnz
+    point features (the per-channel statistics see stored points only)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        from . import nn as dense_nn
+
+        if weight_attr is not None or bias_attr not in (None, False):
+            raise NotImplementedError(
+                "sparse BatchNorm weight_attr/bias_attr are not honored; "
+                "assign the dense sub-layer's parameters directly")
+        self._bn = dense_nn.BatchNorm1D(num_features, momentum=momentum,
+                                        epsilon=epsilon)
+
+    def train(self):
+        self._bn.train()
+        return self
+
+    def eval(self):
+        self._bn.eval()
+        return self
+
+    def parameters(self):
+        return self._bn.parameters()
+
+    def __call__(self, x):
+        from .framework.core import Tensor
+
+        coo = _as_coo(x).coalesce()
+        out_vals = self._bn(Tensor(coo._bcoo.data))
+        return SparseCooTensor(jsparse.BCOO(
+            (out_vals.value, coo._bcoo.indices), shape=tuple(coo.shape)))
+
+
+class SparseMaxPool3D(object):
+    """reference sparse/nn/layer/pooling.py:33 MaxPool3D: window max over
+    PRESENT points only (missing neighbors don't contribute zeros)."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NDHWC", name=None):
+        self.kernel_size = ((kernel_size,) * 3
+                            if isinstance(kernel_size, int)
+                            else tuple(kernel_size))
+        st = stride if stride is not None else kernel_size
+        self.stride = (st,) * 3 if isinstance(st, int) else tuple(st)
+        self.padding = ((padding,) * 3 if isinstance(padding, int)
+                        else tuple(padding))
+
+    def __call__(self, x):
+        coo = _as_coo(x).coalesce()
+        _check_point_features(coo._bcoo.data, type(self).__name__)
+        shape = tuple(coo.shape)
+        n_batch, spatial = shape[0], shape[1:-1]
+        idx = coo._bcoo.indices
+        feats = coo._bcoo.data
+        np_idx = np.asarray(jax.device_get(idx))
+        allc, out_spatial = _conv_out_pattern(
+            np_idx, self.kernel_size, self.stride, self.padding, spatial)
+        out_idx = jnp.asarray(allc, idx.dtype)
+        out_batch, out_coords = out_idx[:, 0], out_idx[:, 1:]
+        pos_map = _build_pos_map(idx, spatial, n_batch, feats.shape[0])
+        neg = jnp.asarray(-jnp.inf, feats.dtype)
+        acc = jnp.full((out_idx.shape[0], feats.shape[1]), neg)
+        for flat_off in range(int(np.prod(self.kernel_size))):
+            off = np.unravel_index(flat_off, self.kernel_size)
+            nb = jnp.stack([
+                out_coords[:, d] * self.stride[d] - self.padding[d] + off[d]
+                for d in range(len(self.kernel_size))], axis=1)
+            gathered, ok = _gather_neighbor(feats, pos_map, out_batch, nb,
+                                            spatial, n_batch)
+            cand = jnp.where(ok[:, None], gathered, neg)
+            acc = jnp.maximum(acc, cand)
+        out_shape = (n_batch, *out_spatial, feats.shape[1])
+        return SparseCooTensor(jsparse.BCOO((acc, out_idx),
+                                            shape=out_shape))
+
+
+# register on the sparse.nn namespace (reference import surface)
+nn.Conv2D = SparseConv2D
+nn.Conv3D = SparseConv3D
+nn.SubmConv2D = SparseSubmConv2D
+nn.SubmConv3D = SparseSubmConv3D
+nn.BatchNorm = SparseBatchNorm
+nn.SyncBatchNorm = SparseBatchNorm  # one-process group == BatchNorm
+nn.MaxPool3D = SparseMaxPool3D

@@ -1,0 +1,153 @@
+"""Sparse NN layers (paddle.sparse.nn): Conv2D/3D, SubmConv2D/3D, BatchNorm,
+MaxPool3D — gather-scatter formulation validated numerically against the
+dense reference computation.
+
+Reference analog: python/paddle/sparse/nn/layer/{conv,norm,pooling}.py and
+test/legacy_test/test_sparse_conv_op.py."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.sparse as sparse
+
+
+def _voxels(shape_spatial, c_in, density=0.3, batch=2, seed=0):
+    """Random channels-last sparse volume [N, *spatial, C] + its dense twin."""
+    r = np.random.RandomState(seed)
+    dense = r.randn(batch, *shape_spatial, c_in).astype("float32")
+    mask = r.rand(batch, *shape_spatial) < density
+    dense = dense * mask[..., None]
+    t = paddle.to_tensor(dense)
+    coo = t.to_sparse_coo(1 + len(shape_spatial))  # dense trailing channel
+    return coo, dense
+
+
+def _dense_conv(dense, w, b, stride, padding, ndim):
+    """lax cross-correlation on NHWC/NDHWC with kernel [*k, Cin, Cout]."""
+    dn = jax.lax.conv_dimension_numbers(
+        dense.shape, w.shape,
+        ("NHWC", "HWIO", "NHWC") if ndim == 2 else
+        ("NDHWC", "DHWIO", "NDHWC"))
+    out = jax.lax.conv_general_dilated(
+        jnp.asarray(dense), jnp.asarray(w), (stride,) * ndim,
+        [(padding, padding)] * ndim, dimension_numbers=dn)
+    if b is not None:
+        out = out + jnp.asarray(b)
+    return np.asarray(out)
+
+
+class TestSubmConv:
+    @pytest.mark.parametrize("ndim", [2, 3])
+    def test_matches_dense_conv_at_input_points(self, ndim):
+        spatial = (6, 6) if ndim == 2 else (4, 5, 6)
+        coo, dense = _voxels(spatial, c_in=3)
+        cls = sparse.nn.SubmConv2D if ndim == 2 else sparse.nn.SubmConv3D
+        layer = cls(3, 5, kernel_size=3)
+        out = layer(coo)
+        # same sparsity pattern as the input
+        np.testing.assert_array_equal(np.asarray(out._bcoo.indices),
+                                      np.asarray(coo._bcoo.indices))
+        ref = _dense_conv(dense, layer.weight.numpy(), layer.bias.numpy(),
+                          1, 1, ndim)
+        idx = np.asarray(coo._bcoo.indices)
+        got = np.asarray(out._bcoo.data)
+        for row in range(idx.shape[0]):
+            point = tuple(idx[row])
+            np.testing.assert_allclose(got[row], ref[point], rtol=2e-5,
+                                       atol=2e-5)
+
+    def test_stride_rejected(self):
+        with pytest.raises(ValueError):
+            coo, _ = _voxels((4, 4), c_in=2)
+            layer = sparse.nn.SubmConv2D(2, 2, 3, stride=2)
+            layer(coo)
+
+
+class TestSparseConv:
+    @pytest.mark.parametrize("ndim,stride,padding", [(2, 1, 1), (2, 2, 0),
+                                                     (3, 1, 1), (3, 2, 1)])
+    def test_matches_dense_conv(self, ndim, stride, padding):
+        spatial = (6, 6) if ndim == 2 else (4, 6, 6)
+        coo, dense = _voxels(spatial, c_in=2)
+        cls = sparse.nn.Conv2D if ndim == 2 else sparse.nn.Conv3D
+        layer = cls(2, 4, kernel_size=3, stride=stride, padding=padding)
+        out = layer(coo)
+        ref = _dense_conv(dense, layer.weight.numpy(),
+                          layer.bias.numpy(), stride, padding, ndim)
+        assert tuple(out.shape)[:-1] == ref.shape[:-1]
+        idx = np.asarray(out._bcoo.indices)
+        got = np.asarray(out._bcoo.data)
+        for row in range(idx.shape[0]):
+            np.testing.assert_allclose(got[row], ref[tuple(idx[row])],
+                                       rtol=2e-5, atol=2e-5)
+        # the output pattern covers every position with receptive-field
+        # support: dense outputs off the pattern are exactly bias-only
+        covered = np.zeros(ref.shape[:-1], bool)
+        for row in range(idx.shape[0]):
+            covered[tuple(idx[row])] = True
+        off_pattern = ref[~covered]
+        np.testing.assert_allclose(
+            off_pattern, np.broadcast_to(layer.bias.numpy(),
+                                         off_pattern.shape), atol=1e-6)
+
+
+class TestSparseBatchNorm:
+    def test_matches_dense_bn_over_points(self):
+        coo, _dense = _voxels((4, 4, 4), c_in=3)
+        bn = sparse.nn.BatchNorm(3)
+        out = bn(coo)
+        vals = np.asarray(coo._bcoo.data)
+        mean = vals.mean(0)
+        var = vals.var(0)
+        expect = (vals - mean) / np.sqrt(var + 1e-5)
+        np.testing.assert_allclose(np.asarray(out._bcoo.data), expect,
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_array_equal(np.asarray(out._bcoo.indices),
+                                      np.asarray(coo._bcoo.indices))
+
+    def test_eval_uses_running_stats(self):
+        coo, _ = _voxels((4, 4, 4), c_in=3, seed=1)
+        bn = sparse.nn.BatchNorm(3)
+        for _ in range(3):
+            bn(coo)
+        bn.eval()
+        out = bn(coo)
+        assert np.isfinite(np.asarray(out._bcoo.data)).all()
+
+
+class TestSparseMaxPool:
+    def test_matches_dense_pool_on_present_points(self):
+        coo, dense = _voxels((4, 4, 4), c_in=2, density=0.5)
+        pool = sparse.nn.MaxPool3D(2, stride=2)
+        out = pool(coo)
+        # dense reference with -inf at empty voxels (present-points-only max)
+        mask = (dense != 0).any(-1, keepdims=True)
+        neg = np.where(mask, dense, -np.inf).astype("float32")
+        ref = np.asarray(jax.lax.reduce_window(
+            jnp.asarray(neg), -jnp.inf, jax.lax.max,
+            (1, 2, 2, 2, 1), (1, 2, 2, 2, 1), "VALID"))
+        idx = np.asarray(out._bcoo.indices)
+        got = np.asarray(out._bcoo.data)
+        for row in range(idx.shape[0]):
+            np.testing.assert_allclose(got[row], ref[tuple(idx[row])],
+                                       rtol=1e-6)
+
+
+class TestSparseConvNet:
+    def test_small_net_forward(self):
+        """The reference's typical stack: SubmConv -> BN -> ReLU -> Conv
+        (downsample) -> MaxPool, end to end on sparse voxels."""
+        coo, _ = _voxels((6, 6, 6), c_in=2, density=0.2)
+        net = [sparse.nn.SubmConv3D(2, 8, 3),
+               sparse.nn.BatchNorm(8),
+               sparse.nn.ReLU(),
+               sparse.nn.Conv3D(8, 16, 3, stride=2, padding=1),
+               sparse.nn.MaxPool3D(2, stride=2)]
+        x = coo
+        for layer in net:
+            x = layer(x)
+        assert x.shape[-1] == 16
+        assert np.isfinite(np.asarray(x._bcoo.data)).all()
