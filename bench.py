@@ -409,7 +409,9 @@ def worker():
     _log(f"[bench] flash_attention check: {flash_info}")
 
     try:
-        dispatch_us = _dispatch_bench()
+        dispatch_us = ({"skipped": True}
+                       if os.environ.get("BENCH_SKIP_DISPATCH")
+                       else _dispatch_bench())
     except Exception as e:  # noqa: BLE001 - the headline metric must survive
         dispatch_us = {"error": f"{type(e).__name__}: {e}"[:200]}
     _log(f"[bench] dispatch_us: {dispatch_us}")
@@ -434,7 +436,9 @@ def worker():
             num_hidden_layers=layers,
             num_attention_heads=hidden // 128,
             num_key_value_heads=hidden // 128,
-            max_position_embeddings=seq, dtype="bfloat16", recompute=True)
+            max_position_embeddings=seq, dtype="bfloat16",
+            recompute=os.environ.get("BENCH_REMAT", "1") != "0",
+            recompute_granularity=os.environ.get("BENCH_REMAT_GRAN", "full"))
         batch, iters = int(os.environ.get("BENCH_BATCH", "8")), 10
     else:
         cfg = LlamaConfig(
@@ -512,7 +516,9 @@ def worker():
         p._replace_value(v)
 
     try:
-        decode_info = _decode_bench(model, cfg, on_tpu)
+        decode_info = ({"skipped": True}
+                       if os.environ.get("BENCH_SKIP_DECODE")
+                       else _decode_bench(model, cfg, on_tpu))
     except Exception as e:  # noqa: BLE001 - headline metric must survive
         decode_info = {"error": f"{type(e).__name__}: {e}"[:200]}
     _log(f"[bench] decode: {decode_info}")
@@ -537,6 +543,9 @@ def worker():
             "mfu": round(mfu, 4),
             "loss": float(jax.device_get(loss)),
             "attention_path": attention_path,
+            "remat": {"on": cfg.recompute,
+                      "granularity": getattr(cfg, "recompute_granularity",
+                                             "full")},
             "flash_attention": flash_info,
             "dispatch_us": dispatch_us,
             "decode": decode_info,

@@ -191,13 +191,24 @@ class PSServer:
             self._dense[name].set_value(value)
             return None
         if cmd == _CMD_REGISTER_SPARSE:
-            name, dim, opt_cfg, init_scale, seed, trainers, sync = p
+            name, dim, opt_cfg, init_scale, seed, trainers, sync = p[:7]
+            table_cfg = p[7] if len(p) > 7 else {}
             with self._lock:
                 if name not in self._sparse:
-                    t = SparseTable(
-                        name, dim, _ServerOptimizer(**opt_cfg),
-                        init_scale=init_scale, seed=seed,
-                        trainers=trainers, sync=sync)
+                    if table_cfg.get("type") == "ssd":
+                        from .tables import SSDSparseTable
+
+                        t = SSDSparseTable(
+                            name, dim, _ServerOptimizer(**opt_cfg),
+                            init_scale=init_scale, seed=seed,
+                            trainers=trainers, sync=sync,
+                            cache_rows=table_cfg.get("cache_rows", 100_000),
+                            db_path=table_cfg.get("db_path"))
+                    else:
+                        t = SparseTable(
+                            name, dim, _ServerOptimizer(**opt_cfg),
+                            init_scale=init_scale, seed=seed,
+                            trainers=trainers, sync=sync)
                     self._warm_load_sparse(name, t)
                     self._sparse[name] = t
             return None
@@ -370,13 +381,14 @@ class PSClient:
 
     # -- sparse -------------------------------------------------------------
     def register_sparse(self, name, dim, opt_cfg=None, init_scale=0.01, seed=0,
-                        sync=False):
+                        sync=False, table_cfg=None):
         cfg = opt_cfg or {"kind": "adagrad", "lr": 0.05}
         self._sparse_dims[name] = int(dim)
         self._sparse_sync[name] = bool(sync)
         for idx in range(self.nservers):
             self._call(idx, _CMD_REGISTER_SPARSE,
-                       (name, dim, cfg, init_scale, seed, self.trainers, sync))
+                       (name, dim, cfg, init_scale, seed, self.trainers, sync,
+                        table_cfg or {}))
 
     def pull_sparse(self, name, ids):
         ids = np.asarray(ids, np.int64).ravel()

@@ -217,3 +217,189 @@ class SparseTable:
         with self._lock:
             for i, v in zip(np.asarray(ids, np.int64), vals):
                 self.rows[int(i)] = np.asarray(v, np.float32).copy()
+
+
+class SSDSparseTable(SparseTable):
+    """Two-tier sparse table: LRU hot rows in memory, cold rows on disk.
+
+    Reference analog: paddle/fluid/distributed/ps/table/ssd_sparse_table.h:63
+    (MemorySparseTable subclass whose overflow tier is a rocksdb instance) —
+    rebuilt on sqlite3 (stdlib): hot rows live in the in-memory dict exactly
+    like SparseTable; when the hot set exceeds `cache_rows`, the least
+    recently used rows (value + optimizer state) spill to an on-disk table
+    and are transparently faulted back on the next pull/push. `shrink()`
+    drops rows whose access count is below a threshold (the reference's
+    show-clicks decay pass).
+    """
+
+    def __init__(self, name, dim, optimizer: _ServerOptimizer,
+                 init_scale=0.01, seed=0, trainers=1, sync=False,
+                 cache_rows=100_000, db_path=None):
+        super().__init__(name, dim, optimizer, init_scale=init_scale,
+                         seed=seed, trainers=trainers, sync=sync)
+        import collections
+        import sqlite3
+        import tempfile
+
+        self.cache_rows = int(cache_rows)
+        self._lru = collections.OrderedDict()  # id -> None, most-recent last
+        self._access = {}  # id -> access count since last shrink
+        if db_path is None:
+            self._db_file = tempfile.NamedTemporaryFile(
+                prefix=f"ssd_table_{name}_", suffix=".db", delete=False)
+            db_path = self._db_file.name
+        self.db_path = db_path
+        self._db = sqlite3.connect(db_path, check_same_thread=False)
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS rows ("
+            "id INTEGER PRIMARY KEY, val BLOB, state BLOB)")
+        self._db.commit()
+
+    # ---- tier plumbing (all called with self._lock held) ----
+
+    def _touch(self, i):
+        self._lru.pop(i, None)
+        self._lru[i] = None
+        self._access[i] = self._access.get(i, 0) + 1
+
+    def _fault_in(self, i):
+        """Disk -> memory. Returns the row or None if absent on both tiers."""
+        row = self.rows.get(i)
+        if row is not None:
+            return row
+        cur = self._db.execute(
+            "SELECT val, state FROM rows WHERE id=?", (i,)).fetchone()
+        if cur is None:
+            return None
+        val = np.frombuffer(cur[0], np.float32).copy()
+        self.rows[i] = val
+        if cur[1]:
+            import pickle
+
+            self.states[i] = pickle.loads(cur[1])
+        self._db.execute("DELETE FROM rows WHERE id=?", (i,))
+        self._db_dirty = True
+        return val
+
+    def _evict_cold(self):
+        import pickle
+
+        n_evict = len(self.rows) - self.cache_rows
+        if n_evict <= 0:
+            return
+        batch = []
+        for i in list(self._lru):
+            if n_evict <= 0:
+                break
+            row = self.rows.pop(i, None)
+            if row is None:
+                self._lru.pop(i, None)
+                continue
+            st = self.states.pop(i, None)
+            batch.append((i, row.astype(np.float32).tobytes(),
+                          pickle.dumps(st) if st else b""))
+            self._lru.pop(i, None)
+            n_evict -= 1
+        if batch:
+            self._db.executemany(
+                "INSERT OR REPLACE INTO rows VALUES (?,?,?)", batch)
+            self._db_dirty = True
+
+    def _commit(self):
+        """Flush fault-in DELETEs / eviction INSERTs: without this, close()
+        would roll the implicit transaction back and faulted-in rows would
+        resurrect on disk with their pre-fault values."""
+        if getattr(self, "_db_dirty", False):
+            self._db.commit()
+            self._db_dirty = False
+
+    # ---- public surface: same contract as SparseTable ----
+
+    def pull(self, ids):
+        ids = np.asarray(ids, np.int64).ravel()
+        out = np.empty((ids.size, self.dim), np.float32)
+        with self._lock:
+            for k, i in enumerate(ids):
+                i = int(i)
+                row = self._fault_in(i)
+                if row is None:
+                    row = self._init_row(i)
+                    self.rows[i] = row
+                self._touch(i)
+                out[k] = row
+            self._evict_cold()
+            self._commit()
+        return out
+
+    def _apply_locked(self, uniq, acc, lr, scale):
+        for i in uniq:  # fault the whole update set in first
+            self._fault_in(int(i))
+            self._touch(int(i))
+        super()._apply_locked(uniq, acc, lr, scale)
+        self._evict_cold()
+        self._commit()
+
+    def load(self, ids, vals):
+        """Restored rows are authoritative: enter them through the LRU (so
+        the cache_rows cap keeps working after a warm restore) and drop any
+        stale spilled copy a persistent db_path may still hold."""
+        ids = np.asarray(ids, np.int64)
+        with self._lock:
+            self._db.executemany("DELETE FROM rows WHERE id=?",
+                                 [(int(i),) for i in ids])
+            for i, v in zip(ids, vals):
+                i = int(i)
+                self.rows[i] = np.asarray(v, np.float32).copy()
+                self._lru.pop(i, None)
+                self._lru[i] = None  # recently-restored = recently-used
+            self._evict_cold()
+            self._db.commit()
+            self._db_dirty = False
+
+    def n_rows(self):
+        with self._lock:
+            n_disk = self._db.execute("SELECT COUNT(*) FROM rows").fetchone()[0]
+            return len(self.rows) + n_disk
+
+    def n_hot(self):
+        with self._lock:
+            return len(self.rows)
+
+    def shrink(self, min_access=1):
+        """Drop rows accessed fewer than `min_access` times since the last
+        shrink pass; reset access counts. (ssd_sparse_table.cc Shrink.)"""
+        with self._lock:
+            dead = [i for i in list(self.rows)
+                    if self._access.get(i, 0) < min_access]
+            for i in dead:
+                self.rows.pop(i, None)
+                self.states.pop(i, None)
+                self._lru.pop(i, None)
+            # disk rows keep their pre-eviction access counts in _access
+            disk_ids = [r[0] for r in
+                        self._db.execute("SELECT id FROM rows").fetchall()]
+            dead_disk = [(i,) for i in disk_ids
+                         if self._access.get(i, 0) < min_access]
+            self._db.executemany("DELETE FROM rows WHERE id=?", dead_disk)
+            self._db.commit()
+            dead += [i for (i,) in dead_disk]
+            self._access = {}
+            return len(dead)
+
+    def dump(self):
+        with self._lock:
+            ids_mem = list(self.rows.keys())
+            disk = self._db.execute("SELECT id, val FROM rows").fetchall()
+            ids = np.asarray(
+                ids_mem + [r[0] for r in disk], np.int64)
+            if ids.size == 0:
+                return ids, np.empty((0, self.dim), np.float32)
+            vals = np.stack(
+                [self.rows[i] for i in ids_mem]
+                + [np.frombuffer(r[1], np.float32) for r in disk])
+            return ids, vals
+
+    def close(self):
+        with self._lock:
+            self._commit()
+            self._db.close()

@@ -232,11 +232,15 @@ class DistributedEmbedding(Layer):
     _COUNT = 0
 
     def __init__(self, num_embeddings, embedding_dim, name=None,
-                 init_scale=0.01, optimizer_cfg=None):
+                 init_scale=0.01, optimizer_cfg=None, table_cfg=None):
         super().__init__()
         if name is None:
             name = f"dist_embedding_{DistributedEmbedding._COUNT}"
             DistributedEmbedding._COUNT += 1
+        # table_cfg selects the server table tier, e.g. {"type": "ssd",
+        # "cache_rows": N} for the disk-backed table
+        # (ssd_sparse_table.h:63); default is the in-memory table.
+        self.table_cfg = table_cfg
         self.table_name = name
         self.num_embeddings = int(num_embeddings)
         self.embedding_dim = int(embedding_dim)
@@ -251,7 +255,8 @@ class DistributedEmbedding(Layer):
             self._pending.clear()
             client.register_sparse(self.table_name, self.embedding_dim,
                                    opt_cfg=self.optimizer_cfg,
-                                   init_scale=self.init_scale, sync=sync)
+                                   init_scale=self.init_scale, sync=sync,
+                                   table_cfg=self.table_cfg)
 
     def forward(self, ids):
         if self._client is None:

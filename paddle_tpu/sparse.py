@@ -770,12 +770,11 @@ class SparseBatchNorm(object):
                  use_global_stats=None, name=None):
         from . import nn as dense_nn
 
-        if weight_attr is not None or bias_attr not in (None, False):
-            raise NotImplementedError(
-                "sparse BatchNorm weight_attr/bias_attr are not honored; "
-                "assign the dense sub-layer's parameters directly")
         self._bn = dense_nn.BatchNorm1D(num_features, momentum=momentum,
-                                        epsilon=epsilon)
+                                        epsilon=epsilon,
+                                        weight_attr=weight_attr,
+                                        bias_attr=bias_attr,
+                                        use_global_stats=use_global_stats)
 
     def train(self):
         self._bn.train()

@@ -108,6 +108,15 @@ class TestSparseBatchNorm:
         np.testing.assert_array_equal(np.asarray(out._bcoo.indices),
                                       np.asarray(coo._bcoo.indices))
 
+    def test_bias_attr_false_disables_beta(self):
+        bn = sparse.nn.BatchNorm(3, weight_attr=False, bias_attr=False)
+        assert bn._bn.weight is None and bn._bn.bias is None
+        assert len(bn.parameters()) == 0
+        coo, _ = _voxels((4, 4, 4), c_in=3)
+        out = bn(coo)  # affine-free BN still normalizes
+        vals = np.asarray(out._bcoo.data)
+        np.testing.assert_allclose(vals.mean(0), 0.0, atol=1e-4)
+
     def test_eval_uses_running_stats(self):
         coo, _ = _voxels((4, 4, 4), c_in=3, seed=1)
         bn = sparse.nn.BatchNorm(3)
