@@ -111,6 +111,7 @@ from .ring_attention import RingAttention, ring_attention  # noqa: F401
 from . import launch  # noqa: F401
 from . import ps  # noqa: F401
 from . import rpc  # noqa: F401
+from . import transpiler  # noqa: F401
 from .fleet.mpu.mp_ops import split  # noqa: F401
 
 

@@ -64,14 +64,16 @@ class _FileDataset:
         self._use_var = []
         self._pipe_command = None
         self._parse_fn = None
+        self._queue_size = 1024
 
     def init(self, batch_size=1, thread_num=1, use_var=None,
              pipe_command=None, input_type=0, fs_name="", fs_ugi="",
-             download_cmd="cat", **kwargs):
+             download_cmd="cat", queue_size=1024, **kwargs):
         self._batch_size = int(batch_size)
-        self._thread_num = int(thread_num)
+        self._thread_num = max(1, int(thread_num))
         self._use_var = list(use_var or [])
         self._pipe_command = pipe_command
+        self._queue_size = int(queue_size)
         return self
 
     def set_filelist(self, filelist):
@@ -88,12 +90,87 @@ class _FileDataset:
         via the C++ DataFeed proto; a Python callable is the analog here)."""
         self._parse_fn = fn
 
-    def _iter_lines(self):
-        for path in self._filelist:
+    def _read_file(self, path):
+        """One file -> parsed samples. pipe_command (reference DataFeed's
+        preprocessing pipe, e.g. ``"awk ..."`` ) filters the raw line stream
+        through a shell subprocess before parsing."""
+        if self._pipe_command:
+            import subprocess
+
+            with open(path, "rb") as f:
+                proc = subprocess.run(self._pipe_command, shell=True,
+                                      stdin=f, capture_output=True)
+            # rc 1 with silent stderr is the filter-matched-nothing
+            # convention (grep & co.), not a failure
+            if proc.returncode != 0 and not (
+                    proc.returncode == 1 and not proc.stderr):
+                raise RuntimeError(
+                    f"pipe_command failed on {path}: "
+                    f"{proc.stderr.decode(errors='replace')[-500:]}")
+            lines = proc.stdout.decode().splitlines()
+        else:
             with open(path) as f:
-                for line in f:
-                    line = line.rstrip("\n")
-                    yield self._parse_fn(line) if self._parse_fn else line
+                lines = [ln.rstrip("\n") for ln in f]
+        if self._parse_fn:
+            return [self._parse_fn(ln) for ln in lines]
+        return lines
+
+    def _iter_lines(self):
+        """Multithreaded ingest (reference data_feed.cc worker pool): files
+        are a work queue consumed by thread_num readers; samples stream out
+        through a bounded queue so parsing overlaps consumption. File order
+        is preserved so a single-threaded run is reproducible."""
+        if not self._filelist:
+            return
+        if self._thread_num == 1 or len(self._filelist) == 1:
+            for path in self._filelist:
+                yield from self._read_file(path)
+            return
+        import queue
+        import threading
+
+        n_threads = min(self._thread_num, len(self._filelist))
+        max_staged = 2 * n_threads  # backpressure: bound staged files
+        results = {}  # file index -> samples | exception
+        done = threading.Condition()
+        stop = threading.Event()  # consumer abandoned the iterator
+        work = queue.Queue()
+        for idx, path in enumerate(self._filelist):
+            work.put((idx, path))
+
+        def reader():
+            while not stop.is_set():
+                try:
+                    idx, path = work.get_nowait()
+                except queue.Empty:
+                    return
+                try:
+                    out = self._read_file(path)
+                except Exception as e:  # surfaced to the consumer below
+                    out = e
+                with done:
+                    done.wait_for(lambda: len(results) < max_staged
+                                  or stop.is_set())
+                    results[idx] = out
+                    done.notify_all()
+
+        threads = [threading.Thread(target=reader, daemon=True)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        try:
+            for idx in range(len(self._filelist)):
+                with done:
+                    done.wait_for(lambda: idx in results)
+                    out = results.pop(idx)
+                    done.notify_all()  # a staging slot freed
+                if isinstance(out, Exception):
+                    raise out
+                yield from out
+        finally:
+            with done:
+                stop.set()
+                done.notify_all()
 
     def batch_iter(self):
         batch = []
@@ -109,6 +186,8 @@ class _FileDataset:
 class InMemoryDataset(_FileDataset):
     """dataset.py:388 InMemoryDataset: load files into memory, shuffle, feed."""
 
+    _SHUFFLE_GEN = 0  # distinct store keys per global_shuffle call
+
     def __init__(self):
         super().__init__()
         self._samples = None
@@ -123,8 +202,50 @@ class InMemoryDataset(_FileDataset):
             raise RuntimeError("call load_into_memory() first")
         random.Random(seed).shuffle(self._samples)
 
-    def global_shuffle(self, fleet=None, thread_num=12):
-        self.local_shuffle()
+    def global_shuffle(self, fleet=None, thread_num=12, seed=0):
+        """Redistribute samples across all trainers (dataset.py InMemoryDataset
+        global_shuffle): every sample lands on hash(sample) % world trainers,
+        so each trainer ends with a random, disjoint, collectively-complete
+        partition. Falls back to local_shuffle when not running distributed."""
+        if self._samples is None:
+            raise RuntimeError("call load_into_memory() first")
+        from . import parallel
+
+        if not parallel.is_initialized() or parallel.get_world_size() <= 1:
+            self.local_shuffle(seed=seed)
+            return
+        import pickle
+        import random
+        import zlib
+
+        from .store import create_or_get_global_tcp_store
+
+        world, rank = parallel.get_world_size(), parallel.get_rank()
+        buckets = [[] for _ in range(world)]
+        for s in self._samples:
+            # stable across processes (builtin hash is salted per-interpreter)
+            h = zlib.crc32(pickle.dumps(s)) ^ seed
+            buckets[h % world].append(s)
+        # all-to-all by object over the rendezvous TCPStore: post my buckets,
+        # collect my column from every rank's post
+        store = create_or_get_global_tcp_store()
+        gen = InMemoryDataset._SHUFFLE_GEN
+        InMemoryDataset._SHUFFLE_GEN += 1
+        prefix = f"fleet_ds/gs/{gen}/{seed}"
+        store.set(f"{prefix}/{rank}", pickle.dumps(buckets))
+        mine = []
+        for r in range(world):
+            data = store.get(f"{prefix}/{r}", timeout=120)
+            mine.extend(pickle.loads(data)[rank])
+        # every rank read every key: reclaim the store memory (the posted
+        # buckets are whole-dataset-sized; leaking them per epoch would OOM
+        # the rendezvous store). Counter barrier, then each deletes its post.
+        if store.add(f"{prefix}/readers_done", 1) == world:
+            store.set(f"{prefix}/all_done", b"1")
+        store.wait(f"{prefix}/all_done", timeout=120)
+        store.delete_key(f"{prefix}/{rank}")
+        random.Random(seed * 10007 + rank).shuffle(mine)
+        self._samples = mine
 
     def get_memory_data_size(self, fleet=None):
         return len(self._samples or [])
@@ -146,4 +267,58 @@ class InMemoryDataset(_FileDataset):
 
 
 class QueueDataset(_FileDataset):
-    """dataset.py:1200 QueueDataset: streaming file feed (no memory stage)."""
+    """dataset.py:1200 QueueDataset: streaming file feed (no memory stage).
+
+    Producer/consumer form of the reference's C++ DataFeed channel: reader
+    threads parse files into a bounded queue while the trainer consumes
+    batches, so ingest overlaps the training step instead of staging the
+    whole dataset first."""
+
+    def batch_iter(self):
+        if not self._filelist:
+            return
+        import queue
+        import threading
+
+        q = queue.Queue(maxsize=self._queue_size)
+        _DONE = object()
+        abandoned = threading.Event()
+
+        def _put(item):
+            """put() that gives up when the consumer abandoned the iterator
+            (break / exception in the training loop) — otherwise the producer
+            would block on a full queue forever, leaking the thread."""
+            while not abandoned.is_set():
+                try:
+                    q.put(item, timeout=0.2)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def producer():
+            try:
+                for sample in self._iter_lines():
+                    if not _put(sample):
+                        return
+                _put(_DONE)
+            except Exception as e:  # noqa: BLE001 - raise in the consumer
+                _put(e)
+
+        threading.Thread(target=producer, daemon=True).start()
+        batch = []
+        try:
+            while True:
+                item = q.get()
+                if item is _DONE:
+                    break
+                if isinstance(item, Exception):
+                    raise item
+                batch.append(item)
+                if len(batch) == self._batch_size:
+                    yield batch
+                    batch = []
+            if batch:
+                yield batch
+        finally:
+            abandoned.set()

@@ -141,6 +141,12 @@ class PSServer:
             # the in-flight accept() holds the listening fd until its timeout
             # expires; wait so the port is genuinely free on return
             self._stopped.wait(timeout=2.0)
+        for t in self._sparse.values():
+            if hasattr(t, "close"):  # SSD tier: flush + drop temp spill file
+                try:
+                    t.close()
+                except Exception:  # noqa: BLE001 - shutdown must not raise
+                    pass
 
     # -- request handling ---------------------------------------------------
     def _serve_conn(self, conn):

@@ -16,17 +16,20 @@ class _ServerOptimizer:
     """Server-side rule applied to a table's values. (ps/table accessors.)"""
 
     def __init__(self, kind="sgd", lr=0.01, beta1=0.9, beta2=0.999,
-                 eps=1e-8, weight_decay=0.0):
+                 eps=1e-8, weight_decay=0.0, momentum=0.9):
         self.kind = kind
         self.lr = float(lr)
         self.beta1, self.beta2, self.eps = float(beta1), float(beta2), float(eps)
         self.weight_decay = float(weight_decay)  # decoupled (AdamW-style)
+        self.momentum = float(momentum)
 
     def make_state(self, shape):
         if self.kind == "sgd":
             return {}
         if self.kind == "adagrad":
             return {"g2": np.zeros(shape, np.float32)}
+        if self.kind == "momentum":
+            return {"v": np.zeros(shape, np.float32)}
         if self.kind == "adam":
             return {"m": np.zeros(shape, np.float32),
                     "v": np.zeros(shape, np.float32), "t": 0}
@@ -41,6 +44,9 @@ class _ServerOptimizer:
             value *= 1.0 - lr * self.weight_decay
         if self.kind == "sgd":
             value -= lr * grad
+        elif self.kind == "momentum":
+            state["v"] = self.momentum * state["v"] + grad
+            value -= lr * state["v"]
         elif self.kind == "summer":
             value += grad  # "grad" is a parameter delta in geo mode
         elif self.kind == "adagrad":
@@ -244,10 +250,12 @@ class SSDSparseTable(SparseTable):
         self.cache_rows = int(cache_rows)
         self._lru = collections.OrderedDict()  # id -> None, most-recent last
         self._access = {}  # id -> access count since last shrink
+        self._owns_db = db_path is None
         if db_path is None:
-            self._db_file = tempfile.NamedTemporaryFile(
+            f = tempfile.NamedTemporaryFile(
                 prefix=f"ssd_table_{name}_", suffix=".db", delete=False)
-            db_path = self._db_file.name
+            db_path = f.name
+            f.close()
         self.db_path = db_path
         self._db = sqlite3.connect(db_path, check_same_thread=False)
         self._db.execute(
@@ -400,6 +408,13 @@ class SSDSparseTable(SparseTable):
             return ids, vals
 
     def close(self):
+        import os as _os
+
         with self._lock:
             self._commit()
             self._db.close()
+            if self._owns_db:  # self-generated temp spill file
+                try:
+                    _os.unlink(self.db_path)
+                except OSError:
+                    pass

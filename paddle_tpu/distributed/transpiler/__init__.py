@@ -1,0 +1,184 @@
+"""DistributeTranspiler: rewrite a captured static Program for PS training.
+
+Reference analog: python/paddle/distributed/transpiler/distribute_transpiler.py
+(legacy program-rewrite path: the trainer program's optimizer ops are replaced
+by send/recv against parameter servers; the pserver program serves parameter
+shards and applies the optimizer server-side).
+
+Capture-replay form: a paddle_tpu static Program records its ops plus
+``(loss, optimizer)`` train hooks from ``optimizer.minimize``.  Transpiling
+
+- ``get_trainer_program()`` clones the program and swaps the local
+  optimizer-step hook for a PS hook: backward locally, push dense grads to
+  the servers (which average over `trainers` in sync mode and apply the
+  optimizer rule), pull the stepped weights back.
+- ``get_pserver_program(endpoint)`` returns a server program; running it on
+  an Executor starts a blocking PSServer on that endpoint (the reference's
+  listen_and_serv op).
+- ``get_startup_program()`` returns an empty Program: parameters initialize
+  on first registration from the trainers' (identically-seeded) capture.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["DistributeTranspiler", "DistributeTranspilerConfig"]
+
+
+class DistributeTranspilerConfig:
+    """Knob parity with the reference config (distribute_transpiler.py).
+
+    slice_var_up/min_block_size are accepted for compatibility; the PSClient
+    already shards dense tables across servers whole-tensor round-robin, so
+    sub-tensor block slicing is not load-bearing here.
+    """
+
+    def __init__(self):
+        self.slice_var_up = True
+        self.split_method = "RoundRobin"
+        self.min_block_size = 8192
+        self.sync_mode = True
+
+
+def _server_opt_cfg(opt):
+    """Map a trainer-side Optimizer instance onto a server-side rule."""
+    kind = type(opt).__name__.lower()
+    cfg = {"kind": "sgd", "lr": opt.get_lr()}
+    if kind == "adagrad":
+        cfg["kind"] = "adagrad"
+    elif kind in ("adam", "adamw"):
+        cfg["kind"] = "adam"
+        cfg["beta1"] = getattr(opt, "_beta1", 0.9)
+        cfg["beta2"] = getattr(opt, "_beta2", 0.999)
+        cfg["eps"] = getattr(opt, "_epsilon", 1e-8)
+        if kind == "adamw":
+            cfg["weight_decay"] = getattr(opt, "_weight_decay", 0.01) or 0.0
+    elif kind == "momentum":
+        cfg["kind"] = "momentum"
+        cfg["momentum"] = getattr(opt, "_momentum", 0.9)
+    return cfg
+
+
+class _PSTrainHook:
+    """Replaces a Program's (loss, optimizer) train hook on the trainer side.
+
+    Statically shaped like the optimizer the Executor expects (step /
+    clear_grad), but step() routes through the parameter server: push grads,
+    block for the synchronized version, pull stepped weights.
+    """
+
+    def __init__(self, opt, pserver_endpoints, trainer_id, trainers,
+                 sync_mode):
+        self._opt = opt
+        self._eps = list(pserver_endpoints)
+        self._trainer_id = int(trainer_id)
+        self._trainers = int(trainers)
+        self._sync = bool(sync_mode)
+        self._client = None
+        self._params = None  # [(name, Parameter)]
+        self._step_n = 0
+
+    def _ensure_client(self):
+        if self._client is None:
+            from ..ps.service import PSClient
+
+            self._client = PSClient(self._eps, trainer_id=self._trainer_id,
+                                    trainers=self._trainers)
+            self._params = [(f"dt_param_{i}", p) for i, p in
+                            enumerate(self._opt._parameter_list_flat())]
+            cfg = _server_opt_cfg(self._opt)
+            for name, p in self._params:
+                self._client.register_dense(
+                    name, np.asarray(p.value, np.float32), opt_cfg=cfg,
+                    sync=self._sync)
+        return self._client
+
+    def step(self):
+        c = self._ensure_client()
+        self._step_n += 1
+        lr = self._opt.get_lr()
+        for name, p in self._params:
+            g = p.grad
+            gv = (np.zeros(p.shape, np.float32) if g is None
+                  else np.asarray(g.value, np.float32))
+            c.push_dense(name, gv, lr=lr)
+        for name, p in self._params:
+            val, _ = c.pull_dense(
+                name, min_version=self._step_n if self._sync else 0)
+            p._replace_value(
+                np.asarray(val, np.float32).astype(np.asarray(p.value).dtype))
+
+    def clear_grad(self):
+        self._opt.clear_grad()
+
+    def close(self):
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+
+
+class _PServerProgram:
+    """Server-side 'program': Executor.run(...) serves until STOP.
+
+    The reference pserver program is one listen_and_serv op; here it is a
+    blocking PSServer whose tables materialize on trainer registration.
+    """
+
+    def __init__(self, endpoint):
+        self.endpoint = endpoint
+        self._server = None
+        self._inputs = {}  # Executor feed check compatibility
+
+    def _serve(self):
+        from ..ps.service import PSServer
+
+        self._server = PSServer(self.endpoint)
+        # blocking serve, like exe.run(pserver_program) in reference scripts
+        self._server.run()
+        return []
+
+    def __repr__(self):
+        return f"PServerProgram(endpoint={self.endpoint!r})"
+
+
+class DistributeTranspiler:
+    def __init__(self, config: DistributeTranspilerConfig | None = None):
+        self.config = config or DistributeTranspilerConfig()
+        self._program = None
+        self._trainer_id = 0
+        self._trainers = 1
+        self._pservers = []
+        self._sync = True
+
+    def transpile(self, trainer_id, program=None, pservers="", trainers=1,
+                  sync_mode=True, startup_program=None, current_endpoint=""):
+        from ...static import default_main_program
+
+        self._trainer_id = int(trainer_id)
+        self._program = program or default_main_program()
+        self._pservers = ([e.strip() for e in pservers.split(",") if e.strip()]
+                          if isinstance(pservers, str) else list(pservers))
+        self._trainers = int(trainers)
+        self._sync = bool(sync_mode) and self.config.sync_mode
+        self._current_endpoint = current_endpoint
+
+    def get_trainer_program(self, wait_port=True):
+        if self._program is None:
+            raise RuntimeError("call transpile() before get_trainer_program()")
+        p = self._program.clone()
+        p._train_hooks = [
+            (loss, _PSTrainHook(opt, self._pservers, self._trainer_id,
+                                self._trainers, self._sync))
+            for loss, opt in self._program._train_hooks]
+        return p
+
+    def get_pserver_program(self, endpoint):
+        return _PServerProgram(endpoint)
+
+    def get_pserver_programs(self, endpoint):
+        return self.get_pserver_program(endpoint), self.get_startup_program()
+
+    def get_startup_program(self, endpoint=None, pserver_program=None):
+        from ...static import Program
+
+        return Program()

@@ -233,6 +233,11 @@ class Executor:
         from ..ops._apply import apply as _dispatch
 
         program = program or _MAIN[0]
+        from ..distributed.transpiler import _PServerProgram
+
+        if isinstance(program, _PServerProgram):
+            # transpiler pserver program: one blocking listen-and-serve "op"
+            return program._serve()
         feed = feed or {}
         # the reference errors on a missing feed entry; replaying the
         # capture-time zeros placeholder instead would return feed-independent

@@ -119,6 +119,28 @@ class TestParallelizePlan:
         assert dist.is_available()
 
 
+def _global_shuffle_child(tag_dir):
+    import os
+
+    import paddle_tpu.distributed as d
+
+    d.init_parallel_env()
+    rank = d.get_rank()
+    # reference flow: each trainer loads its own shard of the filelist;
+    # global_shuffle then redistributes samples by content hash
+    data = os.path.join(tag_dir, f"shard_{rank}.txt")
+    lo, hi = (0, 20) if rank == 0 else (20, 40)
+    with open(data, "w") as f:
+        f.write("".join(f"{i}\n" for i in range(lo, hi)))
+    ds = d.InMemoryDataset()
+    ds.init(batch_size=4)
+    ds.set_filelist([data])
+    ds.load_into_memory()
+    ds.global_shuffle(seed=5)
+    with open(os.path.join(tag_dir, f"out_{rank}"), "w") as f:
+        f.write(" ".join(ds._samples))
+
+
 def _spawn_child(tag_dir):
     import os
 
@@ -159,6 +181,58 @@ class TestFleetDatasets:
         assert list(ds.batch_iter()) == [["A", "B"], ["C"]]
         with pytest.raises(FileNotFoundError):
             ds.set_filelist([str(tmp_path / "nope")])
+
+    def test_multithreaded_load_preserves_file_order(self, tmp_path):
+        for i in range(6):
+            (tmp_path / f"part-{i}").write_text(
+                "".join(f"{i}:{j}\n" for j in range(50)))
+        ds = dist.InMemoryDataset()
+        ds.init(batch_size=10, thread_num=4)
+        ds.set_filelist([str(tmp_path / f"part-{i}") for i in range(6)])
+        ds.load_into_memory()
+        assert ds.get_memory_data_size() == 300
+        expect = [f"{i}:{j}" for i in range(6) for j in range(50)]
+        assert ds._samples == expect  # worker pool, deterministic order
+
+    def test_pipe_command_preprocesses_lines(self, tmp_path):
+        f = tmp_path / "part-0"
+        f.write_text("keep 1\ndrop 2\nkeep 3\n")
+        ds = dist.QueueDataset()
+        ds.init(batch_size=8, pipe_command="grep keep")
+        ds.set_filelist([str(f)])
+        assert list(ds.batch_iter()) == [["keep 1", "keep 3"]]
+
+    def test_queue_dataset_reader_error_surfaces(self, tmp_path):
+        f = tmp_path / "part-0"
+        f.write_text("1\nboom\n")
+        ds = dist.QueueDataset()
+        ds.init(batch_size=1)
+        ds.set_filelist([str(f)])
+        ds.set_parse_fn(int)
+        # the reader thread's parse error must surface in the consumer,
+        # not die silently in the producer thread
+        with pytest.raises(ValueError):
+            list(ds.batch_iter())
+
+    def test_global_shuffle_single_process_falls_back_local(self, tmp_path):
+        f = tmp_path / "part-0"
+        f.write_text("".join(f"{i}\n" for i in range(20)))
+        ds = dist.InMemoryDataset()
+        ds.init(batch_size=4)
+        ds.set_filelist([str(f)])
+        ds.load_into_memory()
+        ds.global_shuffle(seed=1)
+        assert sorted(ds._samples, key=int) == [str(i) for i in range(20)]
+        assert ds._samples != [str(i) for i in range(20)]  # actually shuffled
+
+    def test_global_shuffle_two_process_partition(self, tmp_path):
+        """Cross-process redistribution over the rendezvous TCPStore: the two
+        ranks end with disjoint partitions whose union is the full dataset."""
+        dist.spawn(_global_shuffle_child, args=(str(tmp_path),), nprocs=2)
+        parts = [open(tmp_path / f"out_{r}").read().split() for r in (0, 1)]
+        assert not (set(parts[0]) & set(parts[1]))
+        assert sorted(parts[0] + parts[1], key=int) == \
+            [str(i) for i in range(40)]
 
     def test_entries(self):
         assert "0.5" in repr(dist.ProbabilityEntry(0.5))

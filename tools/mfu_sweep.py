@@ -51,12 +51,22 @@ def run_variant(name: str, env_over: dict, timeout: int):
     env.setdefault("BENCH_SKIP_DISPATCH", "1")
     env.setdefault("BENCH_SKIP_DECODE", "1")
     t0 = time.time()
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(ROOT, "bench.py"), "--worker"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+        cwd=ROOT)
+    overtime = False
     try:
-        proc = subprocess.run(
-            [sys.executable, os.path.join(ROOT, "bench.py"), "--worker"],
-            capture_output=True, text=True, timeout=timeout, env=env, cwd=ROOT)
+        stdout, stderr = proc.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
-        return {"variant": name, "env": env_over, "error": f"timeout {timeout}s"}
+        # NEVER kill an in-flight TPU client (it wedges the tunnel for
+        # hours); note the overrun and wait it out
+        overtime = True
+        print(f"[sweep] {name} over {timeout}s soft limit; waiting it out "
+              "(killing would wedge the tunnel)", file=sys.stderr)
+        stdout, stderr = proc.communicate()
+    proc = type("R", (), {"stdout": stdout, "stderr": stderr,
+                          "returncode": proc.returncode})
     doc = None
     for line in reversed(proc.stdout.strip().splitlines()):
         line = line.strip()
@@ -73,10 +83,13 @@ def run_variant(name: str, env_over: dict, timeout: int):
                 "error": f"rc={proc.returncode}: "
                          f"{(proc.stderr or proc.stdout)[-800:]}"}
     d = doc.get("detail", {})
-    return {"variant": name, "env": env_over,
-            "tokens_per_s": doc["value"], "mfu": d.get("mfu"),
-            "step_ms": d.get("step_ms"), "device": d.get("device"),
-            "loss": d.get("loss"), "wall_s": round(time.time() - t0, 1)}
+    res = {"variant": name, "env": env_over,
+           "tokens_per_s": doc["value"], "mfu": d.get("mfu"),
+           "step_ms": d.get("step_ms"), "device": d.get("device"),
+           "loss": d.get("loss"), "wall_s": round(time.time() - t0, 1)}
+    if overtime:
+        res["overtime"] = True
+    return res
 
 
 def main():
