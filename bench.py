@@ -251,11 +251,12 @@ def _check_flash_attention(on_tpu):
         info["ok"] = err < tol
 
         def _time(fn, iters=20 if on_tpu else 2):
-            jax.block_until_ready(fn(q, k, v))
+            _force(fn(q, k, v))
             t0 = time.perf_counter()
+            out = None
             for _ in range(iters):
                 out = fn(q, k, v)
-            jax.block_until_ready(out)
+            _force(out)
             return (time.perf_counter() - t0) / iters * 1e3
 
         info["flash_ms"] = round(_time(flash), 3)
@@ -263,7 +264,7 @@ def _check_flash_attention(on_tpu):
 
         # backward through the custom VJP as well
         g = jax.jit(jax.grad(lambda q: flash(q, k, v).astype(jnp.float32).sum()))
-        jax.block_until_ready(g(q))
+        _force(g(q))
         info["bwd_ok"] = True
     except Exception as e:  # noqa: BLE001
         info["error"] = f"{type(e).__name__}: {e}"[:500]
@@ -362,7 +363,7 @@ def _decode_bench(model, cfg, on_tpu):
     logits, cache, pos = eng.prefill(ids)
     tok = logits.argmax(-1).astype("int32")[:, None]
     logits, cache = eng.decode_step(tok, cache, pos)   # compile the step
-    jax.block_until_ready(logits)
+    _force(logits)
     pos += 1
 
     t0 = time.perf_counter()
@@ -370,13 +371,26 @@ def _decode_bench(model, cfg, on_tpu):
         tok = logits.argmax(-1).astype("int32")[:, None]
         logits, cache = eng.decode_step(tok, cache, pos)
         pos += 1
-    jax.block_until_ready(logits)
+    _force(logits)
     dt = time.perf_counter() - t0
     return {
         "batch": batch, "prefill": prefill, "steps": steps,
         "ms_per_token": round(dt / steps * 1e3, 3),
         "tokens_per_sec": round(batch * steps / dt, 1),
     }
+
+
+def _force(x):
+    """Execution barrier that works on tunneled PJRT backends where
+    block_until_ready returns before execution: fetching a value is the only
+    reliable fence. Fetches ONE element (downloads over the tunnel run at
+    ~MB/s, so device_get of a whole activation would dominate the timing)."""
+    import jax
+    import jax.numpy as jnp
+
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    jax.device_get(jnp.ravel(leaf)[:1])
+    jax.block_until_ready(leaf)  # real barrier on non-tunneled backends
 
 
 def worker():
@@ -439,7 +453,8 @@ def worker():
             max_position_embeddings=seq, dtype="bfloat16",
             recompute=os.environ.get("BENCH_REMAT", "1") != "0",
             recompute_granularity=os.environ.get("BENCH_REMAT_GRAN", "full"))
-        batch, iters = int(os.environ.get("BENCH_BATCH", "8")), 10
+        batch = int(os.environ.get("BENCH_BATCH", "8"))
+        iters = int(os.environ.get("BENCH_ITERS", "10"))
     else:
         cfg = LlamaConfig(
             vocab_size=2048, hidden_size=256, intermediate_size=704,
@@ -483,8 +498,11 @@ def worker():
         _log("[bench] compiling train step...")
         t0 = time.perf_counter()
         out = step(pv, av, mv, ids, labels)
-        jax.block_until_ready(out[0])
-        _log(f"[bench] compiled in {time.perf_counter() - t0:.1f}s")
+        t1 = time.perf_counter()
+        _log(f"[bench] enqueue+compile returned in {t1 - t0:.1f}s; forcing "
+             "first step...")
+        _force(out[0])
+        _log(f"[bench] first step executed in {time.perf_counter() - t1:.1f}s")
         return step, out
 
     try:
@@ -502,11 +520,15 @@ def worker():
             raise
     pv, av, mv = pv2, av2, mv2
 
+    _log(f"[bench] timed loop: {iters} steps...")
     t0 = time.perf_counter()
     for _ in range(iters):
         loss, pv, av, mv = step(pv, av, mv, ids, labels)
-    jax.block_until_ready(loss)
+    # one fetch at the end forces the whole chained queue; its fixed
+    # round-trip overhead amortizes over iters
+    _force(loss)
     dt = (time.perf_counter() - t0) / iters
+    _log(f"[bench] timed loop done: {dt * 1e3:.1f} ms/step")
 
     tokens_per_s = batch * seq / dt
 

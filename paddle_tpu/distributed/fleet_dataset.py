@@ -90,30 +90,42 @@ class _FileDataset:
         via the C++ DataFeed proto; a Python callable is the analog here)."""
         self._parse_fn = fn
 
-    def _read_file(self, path):
-        """One file -> parsed samples. pipe_command (reference DataFeed's
-        preprocessing pipe, e.g. ``"awk ..."`` ) filters the raw line stream
-        through a shell subprocess before parsing."""
+    def _stream_file(self, path):
+        """One file -> parsed samples, line-streamed (O(1) file memory so a
+        single huge file still feeds QueueDataset without staging).
+        pipe_command (reference DataFeed's preprocessing pipe, e.g.
+        ``"awk ..."``) filters the raw line stream through a shell
+        subprocess."""
         if self._pipe_command:
             import subprocess
 
             with open(path, "rb") as f:
-                proc = subprocess.run(self._pipe_command, shell=True,
-                                      stdin=f, capture_output=True)
+                proc = subprocess.Popen(
+                    self._pipe_command, shell=True, stdin=f,
+                    stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+            try:
+                for raw in proc.stdout:
+                    ln = raw.decode().rstrip("\n")
+                    yield self._parse_fn(ln) if self._parse_fn else ln
+            finally:
+                proc.stdout.close()
+                stderr = proc.stderr.read()
+                proc.stderr.close()
+                rc = proc.wait()
             # rc 1 with silent stderr is the filter-matched-nothing
             # convention (grep & co.), not a failure
-            if proc.returncode != 0 and not (
-                    proc.returncode == 1 and not proc.stderr):
+            if rc != 0 and not (rc == 1 and not stderr):
                 raise RuntimeError(
                     f"pipe_command failed on {path}: "
-                    f"{proc.stderr.decode(errors='replace')[-500:]}")
-            lines = proc.stdout.decode().splitlines()
+                    f"{stderr.decode(errors='replace')[-500:]}")
         else:
             with open(path) as f:
-                lines = [ln.rstrip("\n") for ln in f]
-        if self._parse_fn:
-            return [self._parse_fn(ln) for ln in lines]
-        return lines
+                for raw in f:
+                    ln = raw.rstrip("\n")
+                    yield self._parse_fn(ln) if self._parse_fn else ln
+
+    def _read_file(self, path):
+        return list(self._stream_file(path))
 
     def _iter_lines(self):
         """Multithreaded ingest (reference data_feed.cc worker pool): files
@@ -124,7 +136,7 @@ class _FileDataset:
             return
         if self._thread_num == 1 or len(self._filelist) == 1:
             for path in self._filelist:
-                yield from self._read_file(path)
+                yield from self._stream_file(path)  # O(1) file memory
             return
         import queue
         import threading
@@ -132,6 +144,7 @@ class _FileDataset:
         n_threads = min(self._thread_num, len(self._filelist))
         max_staged = 2 * n_threads  # backpressure: bound staged files
         results = {}  # file index -> samples | exception
+        next_needed = [0]  # consumer cursor
         done = threading.Condition()
         stop = threading.Event()  # consumer abandoned the iterator
         work = queue.Queue()
@@ -149,8 +162,14 @@ class _FileDataset:
                 except Exception as e:  # surfaced to the consumer below
                     out = e
                 with done:
-                    done.wait_for(lambda: len(results) < max_staged
-                                  or stop.is_set())
+                    # backpressure gate keyed on the CONSUMER CURSOR, not the
+                    # staged count: the reader holding the next-needed index
+                    # always passes (idx == next_needed < next_needed +
+                    # max_staged), so the window can never fill with
+                    # later files and deadlock the pipeline
+                    done.wait_for(
+                        lambda: idx < next_needed[0] + max_staged
+                        or stop.is_set())
                     results[idx] = out
                     done.notify_all()
 
@@ -163,7 +182,8 @@ class _FileDataset:
                 with done:
                     done.wait_for(lambda: idx in results)
                     out = results.pop(idx)
-                    done.notify_all()  # a staging slot freed
+                    next_needed[0] = idx + 1
+                    done.notify_all()  # the staging window advanced
                 if isinstance(out, Exception):
                     raise out
                 yield from out

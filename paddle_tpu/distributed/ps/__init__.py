@@ -13,8 +13,9 @@ Sync mode is exact synchronous SGD (server accumulates grads from all
 trainers, applies once, version-gated pulls); async applies per-push; geo
 pushes local parameter deltas every k steps.
 """
-from .tables import DenseTable, SparseTable
+from .tables import DenseTable, SparseTable, SSDSparseTable
 from .service import PSServer, PSClient
+from .heter_ps import HeterPSCache
 from .the_one_ps import (
     TheOnePS,
     PSOptimizer,
@@ -22,6 +23,6 @@ from .the_one_ps import (
 )
 
 __all__ = [
-    "DenseTable", "SparseTable", "PSServer", "PSClient",
-    "TheOnePS", "PSOptimizer", "DistributedEmbedding",
+    "DenseTable", "SparseTable", "SSDSparseTable", "PSServer", "PSClient",
+    "HeterPSCache", "TheOnePS", "PSOptimizer", "DistributedEmbedding",
 ]

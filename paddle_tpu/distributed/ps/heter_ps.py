@@ -1,0 +1,152 @@
+"""HeterPS: accelerator-resident hot-embedding cache over the PS tables.
+
+Reference analog: paddle/fluid/framework/fleet/heter_ps/ (PSGPU — a GPU
+hashtable that caches hot sparse-feature rows between the trainer and the
+parameter-server tables, so most pulls/pushes never leave the device).
+
+TPU-first form: the cache is ONE device array of shape (capacity, dim) —
+gathers and scatter-adds are what the hardware does well — with a host-side
+id->slot map and an LRU clock. A batch pull
+
+1. splits ids into hits (resident) and misses,
+2. fetches miss rows from the PSClient in one RPC,
+3. installs them into free/least-recently-used slots with one scatter,
+4. returns one device gather over the slots.
+
+Gradients accumulate into a device-side (capacity, dim) buffer via
+scatter-add; ``flush()`` ships the accumulated rows to the server in one
+push RPC (the reference's pull/push aggregation in heter_comm).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["HeterPSCache"]
+
+
+class HeterPSCache:
+    def __init__(self, client, table_name, dim, capacity=4096,
+                 dtype="float32"):
+        import jax.numpy as jnp
+
+        self._jnp = jnp
+        self.client = client
+        self.table_name = table_name
+        self.dim = int(dim)
+        self.capacity = int(capacity)
+        self._rows = jnp.zeros((self.capacity, self.dim), dtype)
+        self._grad = jnp.zeros((self.capacity, self.dim), jnp.float32)
+        self._slot_of = {}        # id -> slot
+        self._id_of = {}          # slot -> id
+        self._clock = 0
+        self._last_used = np.zeros(self.capacity, np.int64)
+        self._dirty = set()       # slots with unflushed grads
+        self._free = list(range(self.capacity - 1, -1, -1))
+        self.stats = {"hits": 0, "misses": 0, "evictions": 0, "flushes": 0}
+
+    # -- slot management ----------------------------------------------------
+
+    def _take_slot(self, pinned=()):
+        """A free or LRU-evicted slot; `pinned` slots (the current batch's
+        rows, including ones installed a moment ago) are never victims."""
+        if self._free:
+            return self._free.pop()
+        # evict the least-recently-used CLEAN slot; flush first if every
+        # evictable slot is dirty (grads must reach the server first)
+        order = np.argsort(self._last_used)
+        for s in order:
+            if int(s) not in self._dirty and int(s) not in pinned:
+                self._evict(int(s))
+                return int(s)
+        self.flush()
+        for s in order:
+            if int(s) not in pinned:
+                self._evict(int(s))
+                return int(s)
+        raise RuntimeError(
+            f"heter_ps cache capacity {self.capacity} is smaller than one "
+            "batch's unique id count — raise capacity")
+
+    def _evict(self, slot):
+        old = self._id_of.pop(slot, None)
+        if old is not None:
+            del self._slot_of[old]
+            self.stats["evictions"] += 1
+
+    # -- pull/push ----------------------------------------------------------
+
+    def pull(self, ids):
+        """Device (n, dim) array of rows for ``ids`` (hits never leave the
+        accelerator; misses arrive in one PS RPC)."""
+        jnp = self._jnp
+        ids = np.asarray(ids, np.int64).ravel()
+        uniq = list(dict.fromkeys(int(i) for i in ids))
+        missing = [i for i in uniq if i not in self._slot_of]
+        if missing:
+            self.stats["misses"] += len(missing)
+            rows = self.client.pull_sparse(self.table_name, missing)
+            pinned = {self._slot_of[i] for i in uniq if i in self._slot_of}
+            slots = []
+            for i in missing:
+                s = self._take_slot(pinned)
+                self._slot_of[i] = s
+                self._id_of[s] = i
+                pinned.add(s)
+                slots.append(s)
+            self._rows = self._rows.at[jnp.asarray(slots)].set(
+                jnp.asarray(np.asarray(rows, np.float32),
+                            self._rows.dtype))
+        self.stats["hits"] += len(uniq) - len(missing)
+        self._clock += 1
+        for i in uniq:
+            self._last_used[self._slot_of[i]] = self._clock
+        gather = jnp.asarray([self._slot_of[int(i)] for i in ids])
+        return self._rows[gather]
+
+    def push_grad(self, ids, grads, lr=None):
+        """Accumulate grads on-device; rows must be resident (grads come
+        from a pull in the same step). ``lr`` (the trainer's current
+        scheduled rate) is remembered so an eviction-forced flush applies
+        the pending grads at the right rate, not the table default."""
+        jnp = self._jnp
+        if lr is not None:
+            self._pending_lr = float(lr)
+        ids = np.asarray(ids, np.int64).ravel()
+        slots = []
+        for i in ids:
+            s = self._slot_of.get(int(i))
+            if s is None:
+                raise KeyError(
+                    f"push_grad for id {int(i)} with no resident row — "
+                    "pull() it first (heter_ps keeps grad slots device-side)")
+            slots.append(s)
+            self._dirty.add(s)
+        g = jnp.asarray(np.asarray(grads, np.float32)).reshape(
+            len(slots), self.dim)
+        self._grad = self._grad.at[jnp.asarray(slots)].add(g)
+
+    def flush(self, lr=None):
+        """One push RPC with every accumulated grad; clears the buffer and
+        refreshes the affected resident rows from the server."""
+        if not self._dirty:
+            return 0
+        if lr is None:
+            lr = getattr(self, "_pending_lr", None)
+        jnp = self._jnp
+        slots = sorted(self._dirty)
+        ids = [self._id_of[s] for s in slots]
+        g = np.asarray(self._grad[jnp.asarray(slots)])
+        self.client.push_sparse(self.table_name, ids, g, lr=lr)
+        self._grad = self._grad.at[jnp.asarray(slots)].set(0.0)
+        # server applied the optimizer: re-pull so the cache serves the
+        # stepped values
+        fresh = self.client.pull_sparse(self.table_name, ids)
+        self._rows = self._rows.at[jnp.asarray(slots)].set(
+            jnp.asarray(np.asarray(fresh, np.float32), self._rows.dtype))
+        n = len(slots)
+        self._dirty.clear()
+        self.stats["flushes"] += 1
+        return n
+
+    def n_resident(self):
+        return len(self._slot_of)

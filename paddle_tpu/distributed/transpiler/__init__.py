@@ -41,21 +41,37 @@ class DistributeTranspilerConfig:
 
 
 def _server_opt_cfg(opt):
-    """Map a trainer-side Optimizer instance onto a server-side rule."""
+    """Map a trainer-side Optimizer instance onto a server-side rule.
+
+    Only optimizers with a server-side counterpart are accepted — a silent
+    SGD fallback would make the transpiled run diverge from the
+    single-process training this module promises to reproduce.
+    """
     kind = type(opt).__name__.lower()
     cfg = {"kind": "sgd", "lr": opt.get_lr()}
-    if kind == "adagrad":
+    if kind == "sgd":
+        pass
+    elif kind == "adagrad":
         cfg["kind"] = "adagrad"
     elif kind in ("adam", "adamw"):
         cfg["kind"] = "adam"
-        cfg["beta1"] = getattr(opt, "_beta1", 0.9)
-        cfg["beta2"] = getattr(opt, "_beta2", 0.999)
-        cfg["eps"] = getattr(opt, "_epsilon", 1e-8)
+        cfg["beta1"] = opt._beta1
+        cfg["beta2"] = opt._beta2
+        cfg["eps"] = opt._eps
         if kind == "adamw":
-            cfg["weight_decay"] = getattr(opt, "_weight_decay", 0.01) or 0.0
+            cfg["weight_decay"] = getattr(opt, "_weight_decay", 0.0) or 0.0
     elif kind == "momentum":
+        if getattr(opt, "_nesterov", False):
+            raise NotImplementedError(
+                "DistributeTranspiler: Nesterov momentum has no server-side "
+                "rule; use plain Momentum/SGD/Adagrad/Adam/AdamW")
         cfg["kind"] = "momentum"
-        cfg["momentum"] = getattr(opt, "_momentum", 0.9)
+        cfg["momentum"] = opt._momentum
+    else:
+        raise NotImplementedError(
+            f"DistributeTranspiler: no server-side optimizer rule for "
+            f"{type(opt).__name__}; supported: SGD, Momentum, Adagrad, "
+            "Adam, AdamW")
     return cfg
 
 

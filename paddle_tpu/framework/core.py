@@ -312,11 +312,13 @@ def to_tensor(data, dtype=None, place=None, stop_gradient=True):
         val = data
     else:
         arr = np.asarray(data)
-        if dtype is None:
-            # paddle default: python floats -> default float dtype; ints -> int64
+        if dtype is None and not isinstance(data, np.ndarray):
+            # paddle default for python scalars/lists: floats -> default
+            # float dtype, ints -> int64. Real numpy arrays keep their dtype
+            # (reference to_tensor preserves ndarray dtypes, incl. float64).
             if arr.dtype == np.float64:
                 dtype = dtype_mod.get_default_dtype()
-            elif arr.dtype == np.int32 and not isinstance(data, np.ndarray):
+            elif arr.dtype == np.int32:
                 dtype = np.dtype(np.int64)
         val = jnp.asarray(arr, dtype=dtype)
         dtype = None

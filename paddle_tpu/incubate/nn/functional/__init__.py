@@ -102,7 +102,8 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
 @defop("fused_rms_norm", amp_category="fp32")
 def _fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6, begin_norm_axis=-1):
     axes = tuple(range(begin_norm_axis % x.ndim, x.ndim))
-    xf = x.astype(jnp.float32)
+    # promote, don't demote: bf16 -> f32 for stability, f64 stays f64
+    xf = x.astype(jnp.promote_types(x.dtype, jnp.float32))
     var = jnp.mean(xf * xf, axis=axes, keepdims=True)
     y = (xf * jax.lax.rsqrt(var + epsilon)).astype(x.dtype)
     if norm_weight is not None:
@@ -124,7 +125,8 @@ def _fused_layer_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-5,
     if residual is not None:
         x = x + residual
     axes = tuple(range(begin_norm_axis % x.ndim, x.ndim))
-    xf = x.astype(jnp.float32)
+    # promote, don't demote: bf16 -> f32 for stability, f64 stays f64
+    xf = x.astype(jnp.promote_types(x.dtype, jnp.float32))
     mean = jnp.mean(xf, axis=axes, keepdims=True)
     var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
     y = ((xf - mean) * jax.lax.rsqrt(var + epsilon)).astype(x.dtype)

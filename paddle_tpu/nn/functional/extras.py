@@ -166,7 +166,8 @@ def affine_grid(theta, out_shape, align_corners=True):
 
     ys, xs = jnp.meshgrid(lin(h), lin(w), indexing="ij")
     base = jnp.stack([xs, ys, jnp.ones_like(xs)], axis=-1)  # (H,W,3)
-    return jnp.einsum("hwk,nck->nhwc", base, theta.astype(jnp.float32)) \
+    ct = jnp.promote_types(theta.dtype, jnp.float32)
+    return jnp.einsum("hwk,nck->nhwc", base, theta.astype(ct)) \
         .astype(theta.dtype)
 
 

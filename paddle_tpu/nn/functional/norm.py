@@ -38,8 +38,10 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=N
 @defop("rms_norm", amp_category="black")
 def _rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=None):
     axes = tuple(range(begin_norm_axis, x.ndim))
-    ms = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=axes, keepdims=True)
-    out = (x.astype(jnp.float32) * jax.lax.rsqrt(ms + epsilon)).astype(x.dtype)
+    # stability upcast must PROMOTE (bf16->f32) without demoting f64 inputs
+    ct = jnp.promote_types(x.dtype, jnp.float32)
+    ms = jnp.mean(jnp.square(x.astype(ct)), axis=axes, keepdims=True)
+    out = (x.astype(ct) * jax.lax.rsqrt(ms + epsilon)).astype(x.dtype)
     if weight is not None:
         out = out * weight
     if bias is not None:

@@ -25,7 +25,7 @@ class OpCase:
                  dtypes=("float32", "bfloat16"), int_dtypes=(),
                  rtol=1e-5, atol=1e-6, bf16_rtol=2e-2, bf16_atol=2e-2,
                  grad_rtol=5e-3, grad_atol=5e-4, positive=False,
-                 grad_inputs=None):
+                 grad_inputs=None, fp64=True, fp64_rtol=1e-9, fp64_atol=1e-10):
         self.name = name
         self.fn = fn            # callable over paddle Tensors
         self.ref = ref          # callable over numpy arrays
@@ -39,6 +39,11 @@ class OpCase:
         self.grad_rtol, self.grad_atol = grad_rtol, grad_atol
         self.positive = positive          # draw inputs in (0.2, 2) not (-1, 1)
         self.grad_inputs = grad_inputs    # indices to grad-check (default: all)
+        # fp64 forward lane: x64 is enabled, so the op must reproduce the
+        # numpy fp64 reference to near machine precision — pins
+        # accumulation-order/casting bugs the bf16/fp32 tolerances hide
+        self.fp64 = fp64 and "float32" in dtypes
+        self.fp64_rtol, self.fp64_atol = fp64_rtol, fp64_atol
 
     def _draw(self, rng, shape, dtype):
         if self.positive:
@@ -54,13 +59,19 @@ class OpCase:
         rng = np.random.RandomState(zlib.crc32(self.name.encode()) % (2 ** 31))
         base = [self._draw(rng, s, "float64") for s in self.inputs]
         expect = self.ref(*[b.copy() for b in base], **self.kwargs)
-        for dtype in self.dtypes:
-            arrs = [b.astype(np.float32) for b in base]
-            tensors = [paddle.to_tensor(a) for a in arrs]
-            if dtype == "bfloat16":
-                tensors = [t.astype("bfloat16") for t in tensors]
+        lanes = list(self.dtypes) + (["float64"] if self.fp64 else [])
+        for dtype in lanes:
+            if dtype == "float64":
+                tensors = [paddle.to_tensor(b) for b in base]
+                rtol, atol = self.fp64_rtol, self.fp64_atol
+            elif dtype == "bfloat16":
+                arrs = [b.astype(np.float32) for b in base]
+                tensors = [paddle.to_tensor(a).astype("bfloat16")
+                           for a in arrs]
                 rtol, atol = self.bf16_rtol, self.bf16_atol
             else:
+                arrs = [b.astype(np.float32) for b in base]
+                tensors = [paddle.to_tensor(a) for a in arrs]
                 rtol, atol = self.rtol, self.atol
             out = self.fn(*tensors, **self.kwargs)
             outs = out if isinstance(out, (tuple, list)) else [out]

@@ -106,13 +106,15 @@ def _in_ref(x, g, b):
 
 
 def _lrn_ref(x, n=5, k=1.0, alpha=1e-4, beta=0.75):
+    # reference local_response_norm is an avg_pool over the squared window
+    # (zero-padded, always / n) — norm.py:654 avg_pool2d then scale(alpha)
     c = x.shape[1]
     sq = np.zeros_like(x)
     half = n // 2
     for i in range(c):
         lo, hi = max(0, i - half), min(c, i + half + 1)
         sq[:, i] = (x[:, lo:hi] ** 2).sum(axis=1)
-    return x / (k + alpha * sq) ** beta
+    return x / (k + alpha * sq / n) ** beta
 
 
 def _rms_norm_ref(x, g):
@@ -280,8 +282,8 @@ def _bn_train_fn(x, g, b):
 
 
 def _bn_infer_fn(x, g, b):
-    rm = paddle.zeros([3])
-    rv = paddle.ones([3])
+    rm = paddle.zeros([3], dtype=str(x.dtype))
+    rv = paddle.ones([3], dtype=str(x.dtype))
     return F.batch_norm(x, rm, rv, weight=g, bias=b, training=False,
                         epsilon=1e-5)
 
@@ -356,7 +358,8 @@ def _spd():
 
 
 def _chol_solve_fn(b):
-    u = paddle.to_tensor(np.linalg.cholesky(_spd()).astype("float32"))
+    u = paddle.to_tensor(
+        np.linalg.cholesky(_spd()).astype(str(b.dtype)))
     return paddle.linalg.cholesky_solve(b, u, upper=False)
 
 
@@ -365,7 +368,8 @@ def _chol_solve_ref(b):
 
 
 def _chol_inverse_fn(x):
-    u = paddle.to_tensor(np.linalg.cholesky(_spd()).astype("float32"))
+    u = paddle.to_tensor(
+        np.linalg.cholesky(_spd()).astype(str(x.dtype)))
     return paddle.linalg.cholesky_inverse(u, upper=False) + x * 0.0
 
 
@@ -405,7 +409,8 @@ TAIL_CASES = [
     # ---- trivial elementwise / aliases ------------------------------------
     OpCase("assign", paddle.assign, lambda x: x, [S]),
     OpCase("cast", lambda x: paddle.cast(x, "float32"),
-           lambda x: x.astype(x.dtype), [S]),
+           lambda x: x.astype(x.dtype), [S],
+           fp64=False),  # the case itself casts to f32 by design
     OpCase("positive", paddle.positive, lambda x: +x, [S]),
     OpCase("sgn", paddle.sgn, np.sign, [S], grad=False),
     OpCase("sinc", paddle.sinc, np.sinc, [S]),
@@ -561,14 +566,16 @@ TAIL_CASES = [
            [S, S]),
     OpCase("pairwise_distance",
            lambda x, y: F.pairwise_distance(x, y, p=2.0),
-           lambda x, y: np.sqrt(((x - y) ** 2).sum(-1) + 0), [S, S]),
+           # reference distance.py adds epsilon to the difference pre-norm
+           lambda x, y: np.sqrt((((x - y) + 1e-6) ** 2).sum(-1)), [S, S]),
     OpCase("vecdot", paddle.vecdot,
            lambda x, y: (x * y).sum(-1), [S, S]),
     OpCase("tensordot", lambda x, y: paddle.tensordot(x, y, axes=1),
            lambda x, y: np.tensordot(x, y, axes=1), [(3, 4), (4, 5)]),
     OpCase("renorm", lambda x: paddle.renorm(x, 2.0, 0, 1.0),
            lambda x: x * np.minimum(
-               1.0, 1.0 / np.sqrt((x ** 2).sum(1, keepdims=True))), [S]),
+               1.0, 1.0 / (np.sqrt((x ** 2).sum(1, keepdims=True)) + 1e-7)),
+           [S]),
     OpCase("einsum", lambda x, y: paddle.einsum("ij,jk->ik", x, y),
            lambda x, y: x @ y, [(3, 4), (4, 5)]),
     # ---- losses ------------------------------------------------------------
@@ -610,9 +617,11 @@ TAIL_CASES = [
            _multi_margin_ref, [S]),
     OpCase("triplet_margin",
            lambda a, p, n: F.triplet_margin_loss(a, p, n, margin=1.0),
+           # epsilon rides on |a-b| before the p-norm (reference loss.py)
            lambda a, p, n: np.maximum(
-               np.sqrt(((a - p) ** 2).sum(-1) + 1e-6 * 0)
-               - np.sqrt(((a - n) ** 2).sum(-1)) + 1.0, 0.0).mean(),
+               np.sqrt(((np.abs(a - p) + 1e-6) ** 2).sum(-1))
+               - np.sqrt(((np.abs(a - n) + 1e-6) ** 2).sum(-1)) + 1.0,
+               0.0).mean(),
            [S, S, S], grad=False),
     OpCase("npair_loss",
            lambda a, p: F.npair_loss(a, p, paddle.to_tensor(_LBL4),
@@ -642,7 +651,8 @@ TAIL_CASES = [
            grad_rtol=2e-2, grad_atol=2e-3),
     OpCase("batch_norm_infer",
            lambda x, g, b: _bn_infer_fn(x, g, b),
-           lambda x, g, b: x * g.reshape(1, -1, 1, 1)
+           # unit variance still passes through rsqrt(rv + eps)
+           lambda x, g, b: x / np.sqrt(1 + 1e-5) * g.reshape(1, -1, 1, 1)
            + b.reshape(1, -1, 1, 1), [(2, 3, 4, 4), (3,), (3,)]),
     OpCase("group_norm_op",
            lambda x, g, b: F.group_norm(x, 2, weight=g, bias=b, epsilon=1e-5),
@@ -830,18 +840,21 @@ TAIL_CASES = [
            grad=False),
     OpCase("vision.box_coder",
            lambda d: _box_coder_fn(d), _box_coder_ref, [(3, 4)],
-           grad=False, dtypes=("float32",)),
+           grad=False, dtypes=("float32",),
+           fp64=False),  # prior boxes are f32 constants in the case
     OpCase("rrelu_eval",
            lambda x: F.rrelu(x, lower=0.2, upper=0.4, training=False),
            lambda x: np.where(x >= 0, x, x * 0.3), [S]),
     OpCase("fake_channel_quant_dequant",
            lambda x: _fcqd_fn(x),
            lambda x: np.round(np.clip(x / _chan_scale(x) * 127, -127, 127))
-           * _chan_scale(x) / 127, [S], grad=False, dtypes=("float32",)),
+           * _chan_scale(x) / 127, [S], grad=False, dtypes=("float32",),
+           fp64=False),  # quant scales are f32-native by design
     OpCase("weight_only_linear",
            lambda x: _wol_fn(x),
            lambda x: x @ (_WOL_Q.astype("float64") * _WOL_S), [S],
-           rtol=1e-4, atol=1e-4, dtypes=("float32",)),
+           rtol=1e-4, atol=1e-4, dtypes=("float32",),
+           fp64=False),  # int8 weight dequant is f32-native by design
 ]
 
 

@@ -194,6 +194,42 @@ class TestFleetDatasets:
         expect = [f"{i}:{j}" for i in range(6) for j in range(50)]
         assert ds._samples == expect  # worker pool, deterministic order
 
+    def test_many_files_small_window_no_deadlock(self, tmp_path):
+        """More files than the staging window (2*threads): readers must not
+        fill the window with later files while the next-needed file is still
+        reading (code-review r4 deadlock finding)."""
+        for i in range(20):
+            (tmp_path / f"p{i:02d}").write_text(f"{i}a\n{i}b\n")
+        ds = dist.InMemoryDataset()
+        ds.init(batch_size=5, thread_num=4)
+        ds.set_filelist([str(tmp_path / f"p{i:02d}") for i in range(20)])
+        ds.load_into_memory()
+        assert ds.get_memory_data_size() == 40
+        assert ds._samples[:2] == ["0a", "0b"]  # order still deterministic
+
+    def test_single_file_streams_without_staging(self, tmp_path):
+        """QueueDataset over one file must go through the line-streaming
+        path (no whole-file materialization)."""
+        f = tmp_path / "big"
+        f.write_text("".join(f"{i}\n" for i in range(1000)))
+        ds = dist.QueueDataset()
+        ds.init(batch_size=100, queue_size=8)  # queue far smaller than file
+        ds.set_filelist([str(f)])
+        it = ds.batch_iter()
+        assert next(it)[0] == "0"
+        n = 1
+        for b in it:
+            n += len(b) / 100
+        assert n == 10
+
+    def test_pipe_command_no_match_is_not_an_error(self, tmp_path):
+        (tmp_path / "a").write_text("keep 1\n")
+        (tmp_path / "b").write_text("nothing here\n")
+        ds = dist.QueueDataset()
+        ds.init(batch_size=8, pipe_command="grep keep")
+        ds.set_filelist([str(tmp_path / "a"), str(tmp_path / "b")])
+        assert list(ds.batch_iter()) == [["keep 1"]]  # rc-1 shard tolerated
+
     def test_pipe_command_preprocesses_lines(self, tmp_path):
         f = tmp_path / "part-0"
         f.write_text("keep 1\ndrop 2\nkeep 3\n")

@@ -151,6 +151,94 @@ class TestSSDSparseTable:
         assert t.n_rows() == 6
 
 
+class TestHeterPSCache:
+    """Device-resident hot-row cache over the PS tables. Reference analog:
+    paddle/fluid/framework/fleet/heter_ps/ (PSGPU hashtable cache)."""
+
+    def _setup(self, capacity=8):
+        from paddle_tpu.distributed.ps import HeterPSCache, PSClient
+
+        servers, eps = _start_servers(1)
+        c = PSClient(eps, trainer_id=0, trainers=1)
+        c.register_sparse("emb", 3, opt_cfg={"kind": "sgd", "lr": 1.0},
+                          init_scale=0.0)
+        return servers, c, HeterPSCache(c, "emb", 3, capacity=capacity)
+
+    def test_hits_stay_on_device(self):
+        servers, c, cache = self._setup()
+        try:
+            r1 = cache.pull([1, 2, 3])
+            assert cache.stats["misses"] == 3
+            r2 = cache.pull([1, 2, 3, 2])
+            assert cache.stats["misses"] == 3  # all hits, no new RPC
+            assert r2.shape == (4, 3)
+            np.testing.assert_allclose(np.asarray(r1), 0.0)
+        finally:
+            c.close()
+            for s in servers:
+                s.shutdown()
+
+    def test_grads_accumulate_and_flush_applies_server_side(self):
+        servers, c, cache = self._setup()
+        try:
+            ids = [5, 6]
+            cache.pull(ids)
+            cache.push_grad(ids, np.ones((2, 3)))
+            cache.push_grad(ids, np.ones((2, 3)))  # accumulates on device
+            # not yet on the server
+            np.testing.assert_allclose(c.pull_sparse("emb", ids), 0.0)
+            cache.flush()
+            # server stepped once with the summed grad (sgd lr=1 -> -2)
+            np.testing.assert_allclose(c.pull_sparse("emb", ids), -2.0)
+            # the cache now serves the stepped values device-side
+            np.testing.assert_allclose(np.asarray(cache.pull(ids)), -2.0)
+        finally:
+            c.close()
+            for s in servers:
+                s.shutdown()
+
+    def test_eviction_under_capacity_pressure(self):
+        servers, c, cache = self._setup(capacity=4)
+        try:
+            cache.pull([0, 1, 2, 3])
+            cache.pull([10, 11])  # evicts two LRU clean slots
+            assert cache.n_resident() == 4
+            assert cache.stats["evictions"] == 2
+            # evicted rows re-fetch correctly
+            np.testing.assert_allclose(np.asarray(cache.pull([0])), 0.0)
+        finally:
+            c.close()
+            for s in servers:
+                s.shutdown()
+
+    def test_forced_flush_uses_trainer_lr(self):
+        """An eviction-forced flush must apply the lr the grads were pushed
+        under, not the table's registered default (review r4 finding)."""
+        servers, c, cache = self._setup(capacity=2)
+        try:
+            cache.pull([1, 2])
+            cache.push_grad([1, 2], np.ones((2, 3)), lr=0.5)
+            cache.pull([3])  # forces flush: must ride lr=0.5, not table 1.0
+            np.testing.assert_allclose(c.pull_sparse("emb", [1, 2]), -0.5)
+        finally:
+            c.close()
+            for s in servers:
+                s.shutdown()
+
+    def test_all_dirty_forces_flush_before_evict(self):
+        servers, c, cache = self._setup(capacity=2)
+        try:
+            cache.pull([1, 2])
+            cache.push_grad([1, 2], np.ones((2, 3)))
+            cache.pull([3])  # both slots dirty -> flush, then evict
+            assert cache.stats["flushes"] == 1
+            np.testing.assert_allclose(c.pull_sparse("emb", [1, 2]), -1.0)
+        finally:
+            c.close()
+            for s in servers:
+                s.shutdown()
+
+
 class TestService:
     def test_dense_roundtrip_and_partition(self):
         from paddle_tpu.distributed.ps import PSClient

@@ -93,7 +93,7 @@ class TestDistributeTranspiler:
             for th in ts:
                 th.start()
             for th in ts:
-                th.join(timeout=120)
+                th.join(timeout=300)  # generous: the test box is 1 core
             srv.shutdown()
 
             assert set(results) == {0, 1}
@@ -105,6 +105,19 @@ class TestDistributeTranspiler:
                                        atol=2e-5)
         finally:
             paddle.disable_static()
+
+    def test_unsupported_optimizer_raises(self):
+        from paddle_tpu.distributed.transpiler import _server_opt_cfg
+
+        import pytest as _pytest
+
+        lin = paddle.nn.Linear(2, 2)
+        cfg = _server_opt_cfg(paddle.optimizer.Adam(
+            learning_rate=0.1, epsilon=1e-6, parameters=lin.parameters()))
+        assert cfg["kind"] == "adam" and cfg["eps"] == 1e-6  # real _eps read
+        with _pytest.raises(NotImplementedError):
+            _server_opt_cfg(paddle.optimizer.RMSProp(
+                learning_rate=0.1, parameters=lin.parameters()))
 
     def test_pserver_program_serves_until_stop(self):
         from paddle_tpu.distributed.ps.service import PSClient
