@@ -191,7 +191,7 @@ def disable_static(place=None):
     from .framework import capture as _capture
 
     _STATIC_MODE[0] = False
-    _capture.set_active(None)
+    _capture.set_default(None)
 
 
 def enable_static():
@@ -204,7 +204,10 @@ def enable_static():
     from .framework import capture as _capture
 
     _STATIC_MODE[0] = True
-    _capture.set_active(static.default_main_program())
+    # the PROCESS-GLOBAL default main program, not default_main_program()
+    # (which resolves thread-locally and inside a program_guard would
+    # install the transient guarded program as the process-wide default)
+    _capture.set_default(static._MAIN[0])
 
 
 def in_static_mode():

@@ -98,18 +98,28 @@ class _FileDataset:
         subprocess."""
         if self._pipe_command:
             import subprocess
+            import threading
 
             with open(path, "rb") as f:
                 proc = subprocess.Popen(
                     self._pipe_command, shell=True, stdin=f,
                     stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+            # drain stderr concurrently: a chatty filter writing more than
+            # the ~64KB pipe buffer would otherwise block, stop producing
+            # stdout, and deadlock this loop
+            err_chunks = []
+            drain = threading.Thread(
+                target=lambda: err_chunks.append(proc.stderr.read()),
+                daemon=True)
+            drain.start()
             try:
                 for raw in proc.stdout:
                     ln = raw.decode().rstrip("\n")
                     yield self._parse_fn(ln) if self._parse_fn else ln
             finally:
                 proc.stdout.close()
-                stderr = proc.stderr.read()
+                drain.join(timeout=30)
+                stderr = b"".join(err_chunks)
                 proc.stderr.close()
                 rc = proc.wait()
             # rc 1 with silent stderr is the filter-matched-nothing

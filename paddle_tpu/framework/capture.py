@@ -16,19 +16,87 @@ per dispatch is a list-index check.
 """
 from __future__ import annotations
 
-_ACTIVE = [None]  # the Program currently recording (static.program_guard)
+import threading
+
+# The active program resolves THREAD-LOCAL first, then the process-global
+# default: concurrent trainer threads (the DistributeTranspiler sync-trainer
+# pattern) each capture their own program under their own program_guard — a
+# single process-global cell interleaves their op records — while
+# paddle.enable_static() still applies to every thread via the default cell
+# (a thread that never opened a program_guard records into the default main
+# program, the reference's static-mode semantics). A thread-local entry masks
+# the default even when it is explicitly None (Executor.run suppresses
+# re-recording during replay that way).
+#
+# _ANY_ACTIVE is a lock-maintained bool — "some capture target exists
+# anywhere" — so the dispatch hot path checks one module global (same cost as
+# the old list-index check) and only pays the thread-local resolution when
+# something may actually be recording.
+_TLS = threading.local()
+_UNSET = object()
+_DEFAULT = [None]      # process-global default program (paddle.enable_static)
+_LOCK = threading.Lock()
+_TLS_COUNT = 0         # threads holding an explicit non-None thread-local program
+_ANY_ACTIVE = False
 
 
 def active():
-    return _ACTIVE[0]
+    v = getattr(_TLS, "program", _UNSET)
+    if v is _UNSET:
+        return _DEFAULT[0]
+    return v
+
+
+def _set_raw(value):
+    """Set this thread's raw TLS slot (value may be _UNSET to clear it)."""
+    global _TLS_COUNT, _ANY_ACTIVE
+    with _LOCK:
+        prev = getattr(_TLS, "program", _UNSET)
+        prev_counted = prev is not _UNSET and prev is not None
+        now_counted = value is not _UNSET and value is not None
+        _TLS_COUNT += int(now_counted) - int(prev_counted)
+        if value is _UNSET:
+            try:
+                del _TLS.program
+            except AttributeError:
+                pass
+        else:
+            _TLS.program = value
+        _ANY_ACTIVE = _TLS_COUNT > 0 or _DEFAULT[0] is not None
 
 
 def set_active(program):
-    _ACTIVE[0] = program
+    """Set the calling thread's capture target (program_guard / replay)."""
+    _set_raw(program)
+
+
+def swap(program):
+    """set_active that returns an opaque token for restore(): the token
+    preserves the three-way raw state (unset / explicit None / a program),
+    so nested guards and replays restore exactly what they found — restoring
+    the RESOLVED value would freeze the process-global default into this
+    thread's slot and outlive enable_static/disable_static."""
+    token = getattr(_TLS, "program", _UNSET)
+    _set_raw(program)
+    return token
+
+
+def restore(token):
+    """Undo a swap() with its returned token."""
+    _set_raw(token)
+
+
+def set_default(program):
+    """Set the process-global default program (paddle.enable_static)."""
+    global _ANY_ACTIVE
+    with _LOCK:
+        _DEFAULT[0] = program
+        _ANY_ACTIVE = _TLS_COUNT > 0 or program is not None
 
 
 def record(kind, payload, t_leaves, outputs):
-    """Append one dispatched op to the active program (no-op when inactive)."""
-    prog = _ACTIVE[0]
+    """Append one dispatched op to the calling thread's active program
+    (no-op when this thread resolves to no capture target)."""
+    prog = active()
     if prog is not None:
         prog._record_op(kind, payload, t_leaves, outputs)

@@ -154,10 +154,9 @@ class StaticFunction:
             # retraces this program must not leak into an active capture
             # (static Program or SOT recorder) — the CALL is recorded at the
             # apply_raw boundary instead
-            prev_capture = _capture.active()
             with tape.functional_mode(), rng.trace_key(rng_key):
                 saved = [(t, t._value) for t in state_tensors]
-                _capture.set_active(None)
+                cap_token = _capture.swap(None)
                 try:
                     for t, v in zip(state_tensors, state_vals):
                         t._replace_value(v)
@@ -181,7 +180,7 @@ class StaticFunction:
                 finally:
                     for t, v in saved:
                         t._replace_value(v)
-                    _capture.set_active(prev_capture)
+                    _capture.restore(cap_token)
             return out_vals + new_state
 
         return jax.jit(pure), out_box

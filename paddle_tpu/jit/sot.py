@@ -240,14 +240,13 @@ class SegmentedFunction:
                                                    is_leaf=_is_tensor)
         arg_tensors = [l for l in arg_leaves if _is_tensor(l)]
 
-        prev_active = _capture.active()
         prev_hook = _core._CONCRETIZE_HOOK[0]
-        _capture.set_active(rec)
+        cap_token = _capture.swap(rec)
         _core._CONCRETIZE_HOOK[0] = rec.on_concretize
         try:
             result = self._function(*args, **kwargs)
         finally:
-            _capture.set_active(prev_active)
+            _capture.restore(cap_token)
             _core._CONCRETIZE_HOOK[0] = prev_hook
 
         if not rec.ok or len(self._variants) >= MAX_VARIANTS:

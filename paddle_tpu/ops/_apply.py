@@ -224,7 +224,7 @@ def apply(opdef: OpDef, *args, **kwargs):
     # no kwargs, no nested containers) skips tree flatten/unflatten and calls
     # fn(*buf) directly; capture mode takes the generic path (it records the
     # treedef) ----
-    if not kwargs and _capture._ACTIVE[0] is None:
+    if not kwargs and (not _capture._ANY_ACTIVE or _capture.active() is None):
         flat_ok = True
         t_idx = []
         t_leaves = []
@@ -330,7 +330,7 @@ def apply(opdef: OpDef, *args, **kwargs):
     outputs = _finish_outputs(opdef, opdef.name, out_vals, requires_grad,
                               vjp_fn, pure, t_leaves, stop_flags)
 
-    if _capture._ACTIVE[0] is not None:
+    if _capture._ANY_ACTIVE:
         _capture.record("op", (opdef, leaves, treedef, t_idx),
                         t_leaves, outputs)
 
@@ -368,7 +368,7 @@ def apply_raw(name, fn, tensor_args, n_outs=1):
     if requires_grad:
         out_avals = [tape.OutAval(v.shape, v.dtype) for v in out_vals]
         tape.record(name, list(tensor_args), vjp_fn, pure, out_avals, outputs)
-    if _capture._ACTIVE[0] is not None:
+    if _capture._ANY_ACTIVE:
         _capture.record("raw", (name, fn), list(tensor_args), outputs)
     return tuple(outputs)
 

@@ -16,6 +16,7 @@ deprecation direction for static graphs.
 from __future__ import annotations
 
 import contextlib
+import threading
 
 import numpy as np
 
@@ -159,29 +160,36 @@ _MAIN = [Program()]
 _STARTUP = [Program()]
 
 
+_PROG_TLS = threading.local()
+
+
 def default_main_program():
-    return _MAIN[0]
+    """The current main program: the guarded one inside this thread's
+    program_guard (reference switch_main_program semantics), else the
+    process-global default. Thread-local so concurrent trainer threads'
+    guards don't displace each other's program."""
+    return getattr(_PROG_TLS, "main", None) or _MAIN[0]
 
 
 def default_startup_program():
-    return _STARTUP[0]
+    return getattr(_PROG_TLS, "startup", None) or _STARTUP[0]
 
 
 @contextlib.contextmanager
 def program_guard(main_program, startup_program=None):
     from ..framework import capture
 
-    old_main, old_start = _MAIN[0], _STARTUP[0]
-    old_active = capture.active()
-    _MAIN[0] = main_program
+    old_main = getattr(_PROG_TLS, "main", None)
+    old_start = getattr(_PROG_TLS, "startup", None)
+    _PROG_TLS.main = main_program
     if startup_program is not None:
-        _STARTUP[0] = startup_program
-    capture.set_active(main_program)
+        _PROG_TLS.startup = startup_program
+    token = capture.swap(main_program)
     try:
         yield
     finally:
-        _MAIN[0], _STARTUP[0] = old_main, old_start
-        capture.set_active(old_active)
+        _PROG_TLS.main, _PROG_TLS.startup = old_main, old_start
+        capture.restore(token)
 
 
 def data(name, shape, dtype="float32", lod_level=0):
@@ -197,7 +205,7 @@ def data(name, shape, dtype="float32", lod_level=0):
     ph = _PlaceholderTensor(jnp.zeros(concrete, np.dtype(dtype)))
     ph._dyn_axes = dyn_axes
     ph.name = name
-    _MAIN[0]._inputs[name] = ph
+    default_main_program()._inputs[name] = ph
     return ph
 
 
@@ -232,7 +240,7 @@ class Executor:
         from ..framework import capture
         from ..ops._apply import apply as _dispatch
 
-        program = program or _MAIN[0]
+        program = program or default_main_program()
         from ..distributed.transpiler import _PServerProgram
 
         if isinstance(program, _PServerProgram):
@@ -271,8 +279,7 @@ class Executor:
         # which must not re-record into the program being iterated (run()
         # inside an active program_guard would otherwise never terminate)
         ops_snapshot = list(program._ops)
-        prev_active = capture.active()
-        capture.set_active(None)
+        token = capture.swap(None)
         try:
             for kind, payload, t_leaves, outputs in ops_snapshot:
                 if kind == "op":
@@ -323,7 +330,7 @@ class Executor:
                 outs.append(np.asarray(out.value) if return_numpy and
                             isinstance(out, Tensor) else out)
         finally:
-            capture.set_active(prev_active)
+            capture.restore(token)
         return outs
 
 

@@ -369,11 +369,11 @@ def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
         return _StaticPyLayer.apply(*inputs)
 
     # capture: run once (capture suspended) for shapes, record one entry
-    _capture.set_active(None)
+    token = _capture.swap(None)
     try:
         out = _StaticPyLayer.apply(*inputs)
     finally:
-        _capture.set_active(prog)
+        _capture.restore(token)
     flat, tree = jax.tree_util.tree_flatten(out, is_leaf=_is_tensor)
     outs = [Tensor(o.value, stop_gradient=o.stop_gradient) if _is_tensor(o)
             else o for o in flat]

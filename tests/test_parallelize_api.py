@@ -230,6 +230,21 @@ class TestFleetDatasets:
         ds.set_filelist([str(tmp_path / "a"), str(tmp_path / "b")])
         assert list(ds.batch_iter()) == [["keep 1"]]  # rc-1 shard tolerated
 
+    def test_pipe_command_chatty_stderr_does_not_deadlock(self, tmp_path):
+        """A filter writing more than the ~64KB pipe buffer to stderr must
+        not stall the stdout stream (stderr is drained concurrently)."""
+        f = tmp_path / "part-0"
+        f.write_text("".join(f"row {i}\n" for i in range(2000)))
+        ds = dist.QueueDataset()
+        # awk echoes a ~120B padded line to stderr per input line AND passes
+        # the line through: 2000 x 120B comfortably exceeds a 64KB pipe buffer
+        ds.init(batch_size=1000, pipe_command=(
+            'awk \'{pad = sprintf("%0120d", NR);'
+            ' print pad > "/dev/stderr"; print}\''))
+        ds.set_filelist([str(f)])
+        out = [ln for b in ds.batch_iter() for ln in b]
+        assert len(out) == 2000 and out[0] == "row 0"
+
     def test_pipe_command_preprocesses_lines(self, tmp_path):
         f = tmp_path / "part-0"
         f.write_text("keep 1\ndrop 2\nkeep 3\n")

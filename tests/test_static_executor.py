@@ -161,3 +161,70 @@ class TestStaticExecutor:
         (out,) = exe.run(main, feed={"x": np.ones((2, 4), "float32")},
                          fetch_list=[fetch])
         assert float(out) == 16.0
+
+
+class TestCaptureThreading:
+    """The capture cell is thread-local with a process-global default
+    (framework/capture.py): concurrent program_guards must not interleave
+    records, while enable_static still reaches guard-less threads."""
+
+    def test_concurrent_program_guards_do_not_interleave(self):
+        import threading
+
+        progs = {}
+
+        def build(tid):
+            main = paddle.static.Program()
+            with paddle.static.program_guard(main):
+                x = paddle.static.data(f"x{tid}", [None, 4], "float32")
+                out = (x * float(tid + 1)).sum()
+                out.name = "out"
+            progs[tid] = main
+
+        ts = [threading.Thread(target=build, args=(i,)) for i in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+
+        exe = paddle.static.Executor()
+        for tid, main in progs.items():
+            assert f"x{tid}" in main._inputs  # own placeholder only
+            (got,) = exe.run(main,
+                             feed={f"x{tid}": np.ones((2, 4), "float32")},
+                             fetch_list=["out"])
+            assert float(got) == 8.0 * (tid + 1)
+
+    def test_enable_static_records_on_other_threads(self):
+        import threading
+
+        paddle.enable_static()
+        try:
+            main = paddle.static.default_main_program()
+            n0 = len(main._ops)
+
+            def work():
+                x = paddle.static.data("tl_x", [None, 2], "float32")
+                (x + 1.0).name  # noqa: B018 - records into the default program
+
+            th = threading.Thread(target=work)
+            th.start()
+            th.join(timeout=60)
+            assert len(main._ops) > n0  # the other thread recorded here
+        finally:
+            paddle.disable_static()
+
+    def test_guard_masks_default_then_restores(self):
+        from paddle_tpu.framework import capture
+
+        paddle.enable_static()
+        try:
+            default = capture.active()
+            assert default is not None
+            own = paddle.static.Program()
+            with paddle.static.program_guard(own):
+                assert capture.active() is own
+            assert capture.active() is default
+        finally:
+            paddle.disable_static()
+        assert capture.active() is None

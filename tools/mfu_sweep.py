@@ -50,6 +50,9 @@ def run_variant(name: str, env_over: dict, timeout: int):
     env.setdefault("BENCH_SKIP_FLASHCHECK", "1")
     env.setdefault("BENCH_SKIP_DISPATCH", "1")
     env.setdefault("BENCH_SKIP_DECODE", "1")
+    # sweep variants are experiments, not the flagship bench result: don't
+    # let them overwrite bench_cache.json (the replay-on-wedge artifact)
+    env.setdefault("BENCH_NO_CACHE", "1")
     t0 = time.time()
     proc = subprocess.Popen(
         [sys.executable, os.path.join(ROOT, "bench.py"), "--worker"],
