@@ -352,41 +352,8 @@ def _dispatch_bench():
     return out
 
 
-def _build_step(model, optimizer, params, acc_keys, use_masters, rng, Tensor, jax):
-    """One fused train step (fwd+bwd+AdamW) with functional state threading."""
-
-    def train_step(param_values, acc_values, master_values, ids, labels):
-        with rng.trace_key(jax.random.PRNGKey(0)):
-            saved_p = [(p, p._value) for p in params]
-            saved_a = {id(p): dict(optimizer._accumulators[id(p)]) for p in params}
-            saved_m = dict(optimizer._master_weights)
-            try:
-                for p, v in zip(params, param_values):
-                    p._replace_value(v)
-                for p, ks, vs in zip(params, acc_keys, acc_values):
-                    for k, v in zip(ks, vs):
-                        optimizer._accumulators[id(p)][k] = v
-                if use_masters:
-                    for p, mv in zip(params, master_values):
-                        optimizer._master_weights[id(p)] = mv
-                loss, _ = model(Tensor(ids), labels=Tensor(labels))
-                loss.backward()
-                optimizer.step()
-                optimizer.clear_grad()
-                new_p = [p._value for p in params]
-                new_a = [[optimizer._accumulators[id(p)][k] for k in ks]
-                         for p, ks in zip(params, acc_keys)]
-                new_m = ([optimizer._master_weights[id(p)] for p in params]
-                         if use_masters else master_values)
-                return loss.value, new_p, new_a, new_m
-            finally:
-                for p, v in saved_p:
-                    p._replace_value(v)
-                for p in params:
-                    optimizer._accumulators[id(p)] = saved_a[id(p)]
-                optimizer._master_weights = saved_m
-
-    return jax.jit(train_step, donate_argnums=(0, 1, 2))
+# the donated fused train step + timing-loop machinery is shared with
+# bench_suite.py — see bench_common.py (the tunnel rules live there)
 
 
 def _decode_bench(model, cfg, on_tpu):
@@ -431,16 +398,9 @@ def _decode_bench(model, cfg, on_tpu):
 
 
 def _force(x):
-    """Execution barrier that works on tunneled PJRT backends where
-    block_until_ready returns before execution: fetching a value is the only
-    reliable fence. Fetches ONE element (downloads over the tunnel run at
-    ~MB/s, so device_get of a whole activation would dominate the timing)."""
-    import jax
-    import jax.numpy as jnp
+    from bench_common import force
 
-    leaf = jax.tree_util.tree_leaves(x)[0]
-    jax.device_get(jnp.ravel(leaf)[:1])
-    jax.block_until_ready(leaf)  # real barrier on non-tunneled backends
+    force(x)
 
 
 def worker():
@@ -458,8 +418,6 @@ def worker():
 
     import paddle_tpu as paddle
     from paddle_tpu.autograd import tape  # noqa: F401 - keeps tape module hot
-    from paddle_tpu.framework import random as rng
-    from paddle_tpu.framework.core import Tensor
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
 
     dev = jax.devices()[0]
@@ -502,7 +460,8 @@ def worker():
             num_key_value_heads=hidden // 128,
             max_position_embeddings=seq, dtype="bfloat16",
             recompute=os.environ.get("BENCH_REMAT", "1") != "0",
-            recompute_granularity=os.environ.get("BENCH_REMAT_GRAN", "full"))
+            recompute_granularity=os.environ.get("BENCH_REMAT_GRAN", "full"),
+            fused_head_ce=os.environ.get("BENCH_FUSED_CE", "0") != "0")
         batch = int(os.environ.get("BENCH_BATCH", "8"))
         iters = int(os.environ.get("BENCH_ITERS", "10"))
     else:
@@ -520,43 +479,37 @@ def worker():
         learning_rate=1e-4, parameters=model.parameters(),
         multi_precision=on_tpu)
 
-    params = [p for _, p in model.named_parameters()]
-    for p in params:
-        if id(p) not in optimizer._accumulators:
-            optimizer._accumulators[id(p)] = optimizer._init_state(p)
-        if optimizer._use_master_weights and id(p) not in optimizer._master_weights:
-            optimizer._master_weights[id(p)] = p.value.astype(jnp.float32)
-    acc_keys = [sorted(optimizer._accumulators[id(p)].keys()) for p in params]
-    use_masters = optimizer._use_master_weights
+    from bench_common import build_step, timed_loop
 
     r = np.random.RandomState(0)
     ids = jnp.asarray(r.randint(0, cfg.vocab_size, (batch, seq)), jnp.int32)
     labels = jnp.asarray(r.randint(0, cfg.vocab_size, (batch, seq)), jnp.int32)
-    pv = [p.value for p in params]
-    av = [[optimizer._accumulators[id(p)][k] for k in ks]
-          for p, ks in zip(params, acc_keys)]
-    mv = ([optimizer._master_weights[id(p)] for p in params]
-          if use_masters else [])
 
     attention_path = ("pallas_flash"
                       if not os.environ.get("PADDLE_TPU_DISABLE_PALLAS") and on_tpu
                       else "xla_math")
 
-    def compile_and_warm():
-        step = _build_step(model, optimizer, params, acc_keys, use_masters,
-                           rng, Tensor, jax)
-        _log("[bench] compiling train step...")
-        t0 = time.perf_counter()
-        out = step(pv, av, mv, ids, labels)
-        t1 = time.perf_counter()
-        _log(f"[bench] enqueue+compile returned in {t1 - t0:.1f}s; forcing "
-             "first step...")
-        _force(out[0])
-        _log(f"[bench] first step executed in {time.perf_counter() - t1:.1f}s")
-        return step, out
+    def loss_fn(m, ids_t, labels_t):
+        loss, _ = m(ids_t, labels=labels_t)
+        return loss
+
+    # forcing cadence: the tunneled backend executes a long donated chain
+    # pathologically slowly when it is only forced at the end (PERF.md
+    # round-4 rules — attempt-1 of the round-4 bench spent >25 min in a
+    # 10-step unforced queue); timed_loop (bench_common.py) forces in
+    # force_every-sized chunks, recorded in detail.force_every
+    force_every = max(1, int(os.environ.get("BENCH_FORCE_EVERY", "2")))
+
+    def measure():
+        step, state_fn, params = build_step(model, optimizer, loss_fn)
+        _log(f"[bench] timed loop: {iters} steps (force every {force_every})...")
+        dt, (pv, av, mv), loss = timed_loop(
+            step, state_fn(), (ids, labels), iters, force_every,
+            log=lambda m: _log(f"[bench]   {m}"))
+        return dt, params, pv, loss
 
     try:
-        step, (loss, pv2, av2, mv2) = compile_and_warm()
+        dt, params, pv, loss = measure()
     except Exception as e:  # noqa: BLE001
         if attention_path == "pallas_flash":
             # Pallas lowering/compile failure inside the full model: fall back to
@@ -565,30 +518,9 @@ def worker():
                  "with PADDLE_TPU_DISABLE_PALLAS=1")
             os.environ["PADDLE_TPU_DISABLE_PALLAS"] = "1"
             attention_path = "xla_math_after_pallas_failure"
-            step, (loss, pv2, av2, mv2) = compile_and_warm()
+            dt, params, pv, loss = measure()
         else:
             raise
-    pv, av, mv = pv2, av2, mv2
-
-    # Force every few steps: the tunneled backend executes a long donated
-    # chain pathologically slowly when it is only forced at the end (PERF.md
-    # round-4 operational rules — attempt-1 of the round-4 bench spent >25 min
-    # in a 10-step unforced queue). Small chunks keep the queue shallow; the
-    # per-chunk one-element fetch RTT inflates step_ms slightly and is
-    # recorded in detail.force_every for comparability.
-    force_every = max(1, int(os.environ.get("BENCH_FORCE_EVERY", "2")))
-    _log(f"[bench] timed loop: {iters} steps (force every {force_every})...")
-    t0 = time.perf_counter()
-    done = 0
-    while done < iters:
-        n = min(force_every, iters - done)
-        for _ in range(n):
-            loss, pv, av, mv = step(pv, av, mv, ids, labels)
-        _force(loss)
-        done += n
-        _log(f"[bench]   step {done}/{iters} forced "
-             f"({(time.perf_counter() - t0) / done * 1e3:.1f} ms/step avg)")
-    dt = (time.perf_counter() - t0) / iters
     _log(f"[bench] timed loop done: {dt * 1e3:.1f} ms/step")
 
     tokens_per_s = batch * seq / dt

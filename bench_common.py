@@ -1,0 +1,123 @@
+"""Shared machinery for bench.py (flagship) and bench_suite.py (BASELINE
+configs): the tunnel-safe execution fence, the donated fused train step, and
+the chunk-forced timing loop. The PERF.md round-4 tunnel rules live HERE and
+only here: block_until_ready is not an execution fence over the tunneled
+backend (fetch one element instead), and long unforced donated chains are
+pathologically slow (force every couple of steps)."""
+from __future__ import annotations
+
+import time
+
+
+def force(x):
+    """Execution barrier that works on tunneled PJRT backends where
+    block_until_ready returns before execution: fetching a value is the only
+    reliable fence. Fetches ONE element (downloads over the tunnel run at
+    ~MB/s, so device_get of a whole activation would dominate the timing)."""
+    import jax
+    import jax.numpy as jnp
+
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    jax.device_get(jnp.ravel(leaf)[:1])
+    jax.block_until_ready(leaf)  # real barrier on non-tunneled backends
+
+
+def build_step(model, optimizer, loss_fn):
+    """One donated fused train step (fwd+bwd+optimizer) with functional state
+    threading over the live Layer/Optimizer objects.
+
+    Returns (jitted_step, state_fn, params):
+      jitted_step(param_values, acc_values, master_values, *batch)
+        -> (loss_value, new_params, new_accs, new_masters)
+      state_fn() -> the current (params, accs, masters) value lists
+      params    -> the live Parameter objects (rebind after the run with
+                   p._replace_value since the step donates their buffers)
+
+    ``loss_fn(model, *batch_tensors)`` returns the scalar loss Tensor.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.framework import random as rng
+    from paddle_tpu.framework.core import Tensor
+
+    params = [p for _, p in model.named_parameters()]
+    for p in params:
+        if id(p) not in optimizer._accumulators:
+            optimizer._accumulators[id(p)] = optimizer._init_state(p)
+        if (optimizer._use_master_weights
+                and id(p) not in optimizer._master_weights):
+            optimizer._master_weights[id(p)] = p.value.astype(jnp.float32)
+    acc_keys = [sorted(optimizer._accumulators[id(p)].keys()) for p in params]
+    use_masters = optimizer._use_master_weights
+
+    def train_step(param_values, acc_values, master_values, *batch):
+        with rng.trace_key(jax.random.PRNGKey(0)):
+            saved_p = [(p, p._value) for p in params]
+            saved_a = {id(p): dict(optimizer._accumulators[id(p)])
+                       for p in params}
+            saved_m = dict(optimizer._master_weights)
+            try:
+                for p, v in zip(params, param_values):
+                    p._replace_value(v)
+                for p, ks, vs in zip(params, acc_keys, acc_values):
+                    for k, v in zip(ks, vs):
+                        optimizer._accumulators[id(p)][k] = v
+                if use_masters:
+                    for p, mv in zip(params, master_values):
+                        optimizer._master_weights[id(p)] = mv
+                loss = loss_fn(model, *[Tensor(b) for b in batch])
+                loss.backward()
+                optimizer.step()
+                optimizer.clear_grad()
+                new_p = [p._value for p in params]
+                new_a = [[optimizer._accumulators[id(p)][k] for k in ks]
+                         for p, ks in zip(params, acc_keys)]
+                new_m = ([optimizer._master_weights[id(p)] for p in params]
+                         if use_masters else master_values)
+                return loss.value, new_p, new_a, new_m
+            finally:
+                for p, v in saved_p:
+                    p._replace_value(v)
+                for p in params:
+                    optimizer._accumulators[id(p)] = saved_a[id(p)]
+                optimizer._master_weights = saved_m
+
+    jitted = jax.jit(train_step, donate_argnums=(0, 1, 2))
+
+    def state_fn():
+        pv = [p.value for p in params]
+        av = [[optimizer._accumulators[id(p)][k] for k in ks]
+              for p, ks in zip(params, acc_keys)]
+        mv = ([optimizer._master_weights[id(p)] for p in params]
+              if use_masters else [])
+        return pv, av, mv
+
+    return jitted, state_fn, params
+
+
+def timed_loop(step, state0, batch, iters, force_every=2, log=None):
+    """Warm (compile + 1 step), then time ``iters`` steps forcing every
+    ``force_every`` steps (shallow queue — tunnel rule). Returns
+    (seconds_per_step, final_state, final_loss_device_value)."""
+    pv, av, mv = state0
+    if log is not None:
+        log("compiling + executing first step...")
+    t_w = time.perf_counter()
+    loss, pv, av, mv = step(pv, av, mv, *batch)
+    force(loss)
+    if log is not None:
+        log(f"warm (compile + step 1) done in {time.perf_counter() - t_w:.1f}s")
+    t0 = time.perf_counter()
+    done = 0
+    while done < iters:
+        n = min(force_every, iters - done)
+        for _ in range(n):
+            loss, pv, av, mv = step(pv, av, mv, *batch)
+        force(loss)
+        done += n
+        if log is not None:
+            log(f"step {done}/{iters} forced "
+                f"({(time.perf_counter() - t0) / done * 1e3:.1f} ms/step avg)")
+    dt = (time.perf_counter() - t0) / iters
+    return dt, (pv, av, mv), loss

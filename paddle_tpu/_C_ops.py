@@ -1,0 +1,67 @@
+"""paddle._C_ops compatibility module.
+
+Reference analog: the generated python-C op-function module
+(fluid/eager/auto_code_generator/generator/python_c_gen.py -> paddle._C_ops)
+— every yaml op as a C-accelerated function; huge amounts of downstream user
+code calls `paddle._C_ops.<op>(...)` directly.
+
+TPU-first form: there is no generated C layer — the defop registry IS the op
+table — so this module resolves `_C_ops.foo` lazily (PEP 562) onto the same
+callables the public namespaces expose: `paddle_tpu.ops` (including the
+generated inplace `foo_` variants), `paddle_tpu.tensor` ops, and
+`nn.functional`. Legacy `final_state_foo` spellings map to `foo`. Arguments
+follow the op signature order (the parity mapping in ops/parity.py keeps
+those aligned with the reference yaml), so the common positional call sites
+port unchanged.
+"""
+from __future__ import annotations
+
+_CACHE = {}
+
+
+def _resolve(name):
+    if name in _CACHE:
+        return _CACHE[name]
+    target = name
+    if target.startswith("final_state_"):  # legacy generated spelling
+        target = target[len("final_state_"):]
+
+    from . import nn, ops, tensor  # noqa: PLC0415
+
+    sources = [ops, tensor, nn.functional]
+    for src in sources:
+        fn = getattr(src, target, None)
+        if callable(fn):
+            _CACHE[name] = fn
+            return fn
+    # registry fallback: a defop with no public namespace binding still
+    # resolves (dispatches through the normal eager apply path)
+    from .ops._apply import apply, get_registry  # noqa: PLC0415
+
+    opdef = get_registry().get(target)
+    # an unbound inplace spelling must NOT silently fall back to the
+    # out-of-place op — callers rely on the mutation; the public-namespace
+    # inplace variants (resolved above) are the real in-place surface
+    if opdef is not None:
+        def fn(*args, _opdef=opdef, **kwargs):
+            return apply(_opdef, *args, **kwargs)
+
+        fn.__name__ = name
+        _CACHE[name] = fn
+        return fn
+    return None
+
+
+def __getattr__(name):
+    fn = _resolve(name)
+    if fn is None:
+        raise AttributeError(
+            f"paddle._C_ops has no op {name!r} (not in the defop registry "
+            "or any public namespace — see docs/ops_parity.md)")
+    return fn
+
+
+def __dir__():
+    from .ops._apply import get_registry  # noqa: PLC0415
+
+    return sorted(set(list(get_registry()) + list(_CACHE)))

@@ -105,6 +105,9 @@ from . import sysconfig  # noqa: F401,E402
 from . import hub  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from . import ops as tensor  # noqa: F401,E402  (paddle.tensor == the op surface)
+from . import _C_ops  # noqa: F401,E402  (generated-op-module compat; lazy resolution)
+from . import _legacy_C_ops  # noqa: F401,E402
+from . import cost_model  # noqa: F401,E402
 import sys as _sys  # noqa: E402
 
 # submodule-import syntax ("import paddle.tensor", "from paddle.tensor import

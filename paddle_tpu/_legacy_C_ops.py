@@ -1,0 +1,4 @@
+"""paddle._legacy_C_ops compatibility: the pre-eager generated op module.
+Resolves identically to paddle._C_ops (the defop registry is the single op
+table here — there is no second legacy kernel world to dispatch into)."""
+from ._C_ops import __dir__, __getattr__  # noqa: F401

@@ -47,6 +47,10 @@ def amp_cast_inputs(opdef, args, kwargs):
     if state is None or not state.enable:
         return args, kwargs
     name = opdef.name
+    if name == "cast" or opdef.amp_category == "skip":
+        # dtype-control ops are never themselves AMP-cast: under O2 the
+        # hook would cast `cast`'s input via another cast, recursing forever
+        return args, kwargs
     white = (name in amp_lists.WHITE_LIST or name in state.custom_white
              or opdef.amp_category == "white")
     black = (name in amp_lists.BLACK_LIST or name in state.custom_black

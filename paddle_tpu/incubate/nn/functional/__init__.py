@@ -308,3 +308,52 @@ def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
         y = y + b2[None]
     out = jnp.einsum("ted,te->td", y, weights)
     return Tensor(out.reshape(B, S, D))
+
+
+@defop("fused_linear_cross_entropy", amp_category="black")
+def _fused_linear_cross_entropy(hidden, weight, labels, ignore_index=-100,
+                                chunk_size=512):
+    """Chunked LM-head matmul + softmax cross-entropy that never materializes
+    the full [B, S, V] logits (at V=32k, B8 x S2048 that is >1 GB bf16 /
+    >4 GB fp32 of HBM traffic). Sequence chunks run under jax.checkpoint
+    inside lax.map: forward keeps only [B, C, V] live; backward recomputes
+    each chunk's logits. The matmul stays in the input dtype (bf16 on the
+    MXU); the softmax runs in fp32.
+
+    Reference capability analog: fused_softmax_mask + c_softmax_with_
+    cross_entropy family (fused_ops.yaml) — the TPU-first formulation is
+    remat-chunking rather than a custom kernel, since the inner matmul and
+    the online logsumexp are exactly what XLA already schedules well.
+    Returns per-token loss [B, S] (0.0 at ignore_index positions).
+    """
+    B, S, H = hidden.shape
+    C = min(int(chunk_size), S)
+    pad = (-S) % C
+    if pad:
+        hidden = jnp.pad(hidden, ((0, 0), (0, pad), (0, 0)))
+        labels = jnp.pad(labels, ((0, 0), (0, pad)),
+                         constant_values=ignore_index)
+    sp = S + pad
+    n = sp // C
+    hs = jnp.moveaxis(hidden.reshape(B, n, C, H), 1, 0)   # [n, B, C, H]
+    ls = jnp.moveaxis(labels.reshape(B, n, C), 1, 0)      # [n, B, C]
+
+    @jax.checkpoint
+    def chunk_fn(hc, lc):
+        logits = jnp.einsum("bch,hv->bcv", hc, weight).astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        safe = jnp.where(lc == ignore_index, 0, lc).astype(jnp.int32)
+        picked = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+        return jnp.where(lc == ignore_index, 0.0, lse - picked)
+
+    tok = jax.lax.map(lambda args: chunk_fn(*args), (hs, ls))  # [n, B, C]
+    return jnp.moveaxis(tok, 0, 1).reshape(B, sp)[:, :S]
+
+
+def fused_linear_cross_entropy(hidden, weight, labels, ignore_index=-100,
+                               chunk_size=512, name=None):
+    """Per-token causal-LM loss fused with the LM-head projection — see
+    `_fused_linear_cross_entropy`. `weight` is [hidden, vocab]."""
+    return _fused_linear_cross_entropy(hidden, weight, labels,
+                                       ignore_index=int(ignore_index),
+                                       chunk_size=int(chunk_size))

@@ -53,6 +53,7 @@ class LlamaConfig:
         pipeline_parallel_degree=1,
         recompute=False,
         recompute_granularity="full",
+        fused_head_ce=False,
         dtype="float32",
         **kwargs,
     ):
@@ -77,6 +78,7 @@ class LlamaConfig:
         self.pipeline_parallel_degree = pipeline_parallel_degree
         self.recompute = recompute
         self.recompute_granularity = recompute_granularity
+        self.fused_head_ce = fused_head_ce
         self.dtype = dtype
         for k, v in kwargs.items():
             setattr(self, k, v)
@@ -420,6 +422,11 @@ class LlamaPretrainingCriterion(Layer):
             tok_loss = F.softmax_with_cross_entropy(
                 logits, labels, ignore_index=self.ignore_index)
         tok_loss = ops.squeeze(tok_loss, -1) if tok_loss.ndim > labels.ndim else tok_loss
+        return self.masked_mean(tok_loss, labels)
+
+    def masked_mean(self, tok_loss, labels):
+        """Token-mean over non-ignored positions (shared by the materialized
+        and the fused head+CE paths)."""
         mask = (labels != self.ignore_index).astype(tok_loss.dtype)
         denom = ops.maximum(mask.sum(), ops.to_tensor(1.0, dtype=tok_loss.dtype))
         return (tok_loss * mask).sum() / denom
@@ -449,6 +456,25 @@ class LlamaForCausalLM(Layer):
 
     def forward(self, input_ids, labels=None, attn_mask=None):
         h = self.llama(input_ids, attn_mask)
+        if (labels is not None
+                and getattr(self.config, "fused_head_ce", False)
+                and not _tp(self.config)):
+            # fused LM-head + CE: the [B, S, V] logits are never
+            # materialized (sequence-chunked matmul + fp32 online softmax
+            # under remat — incubate.nn.functional.fused_linear_cross_entropy).
+            # Returns logits=None; training callers only consume the loss.
+            from ..incubate.nn.functional import fused_linear_cross_entropy
+
+            w = (ops.transpose(self.lm_head._embedding[0].weight, [1, 0])
+                 if self.lm_head._tied else self.lm_head.weight)
+            if labels.ndim == 3:  # reference [B, S, 1] label convention
+                labels = ops.squeeze(labels, -1)
+            tok_loss = fused_linear_cross_entropy(
+                h, w, labels, ignore_index=self.criterion.ignore_index)
+            loss = self.criterion.masked_mean(tok_loss, labels)
+            if self.training and (getattr(self.config, "num_experts", 0) or 0) > 1:
+                loss = loss + 0.01 * self.moe_aux_loss().astype(loss.dtype)
+            return loss, None
         logits = self.lm_head(h)
         if labels is not None:
             loss = self.criterion(logits, labels)

@@ -30,7 +30,7 @@ __all__ = [
     "InputSpec", "Program", "Executor", "CompiledProgram", "data",
     "default_main_program", "default_startup_program", "program_guard",
     "save_inference_model", "load_inference_model", "name_scope", "scope_guard",
-    "global_scope", "cpu_places", "device_guard",
+    "global_scope", "cpu_places", "device_guard", "amp",
 ]
 
 
@@ -280,6 +280,20 @@ class Executor:
         # inside an active program_guard would otherwise never terminate)
         ops_snapshot = list(program._ops)
         token = capture.swap(None)
+        # static AMP (static/amp.py decorate / cast_model_to_fp16): the
+        # recorded ops re-dispatch through the eager path, so replay under
+        # auto_cast applies the same list-driven casting the reference
+        # inserts as cast ops at program-rewrite time
+        amp_ctx = getattr(program, "_amp_ctx", None)
+        amp_stack = contextlib.ExitStack()
+        if amp_ctx is not None:
+            from ..amp.auto_cast import auto_cast
+
+            lists = amp_ctx.get("lists")
+            amp_stack.enter_context(auto_cast(
+                enable=True, level=amp_ctx["level"], dtype=amp_ctx["dtype"],
+                custom_white_list=sorted(lists.white_list) or None,
+                custom_black_list=sorted(lists.black_list) or None))
         try:
             for kind, payload, t_leaves, outputs in ops_snapshot:
                 if kind == "op":
@@ -311,8 +325,18 @@ class Executor:
                 for orig, repl in zip(outputs, new):
                     env[id(orig)] = repl
 
+            # the AMP replay context covers the recorded FORWARD ops only:
+            # the train hooks must run outside it — GradScaler.scale would
+            # otherwise dispatch under O2 and cast the loss to fp16 BEFORE
+            # multiplying by the 2**15 loss scale, overflowing to inf
+            amp_stack.close()
             for loss_t, opt in program._train_hooks:
                 live = env.get(id(loss_t), loss_t)
+                if hasattr(opt, "_amp_train_step"):
+                    # static.amp decorated optimizer: scaled backward +
+                    # dynamic loss scaling (GradScaler) in one hook
+                    opt._amp_train_step(live)
+                    continue
                 live.backward()
                 opt.step()
                 opt.clear_grad()
@@ -330,6 +354,7 @@ class Executor:
                 outs.append(np.asarray(out.value) if return_numpy and
                             isinstance(out, Tensor) else out)
         finally:
+            amp_stack.close()
             capture.restore(token)
         return outs
 
@@ -714,6 +739,7 @@ class IpuCompiledProgram:
 
 
 from . import nn  # noqa: E402  (static.nn: control flow + builders)
+from . import amp  # noqa: E402  (static.amp: mixed precision for capture-replay)
 
 __all__ += [
     "nn",

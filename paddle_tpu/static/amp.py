@@ -1,0 +1,218 @@
+"""paddle.static.amp — mixed precision for the capture-replay static graph.
+
+Reference analog: python/paddle/static/amp/ (decorator.py:762 decorate,
+fp16_lists.py:146 AutoMixedPrecisionLists, fp16_utils.py cast_model_to_fp16 /
+cast_parameters_to_fp16 / fp16_guard, bf16/ submodule) — there, decorate()
+rewrites the static Program: inserts cast ops per the white/black lists,
+scales the loss, and appends check_finite + update_loss_scaling ops.
+
+TPU-first redesign: a captured Program replays through the normal eager
+dispatcher (static/__init__.py Executor.run), and the eager dispatcher
+already carries the AMP hook (ops/_apply.py) — so static AMP needs no
+program rewrite at all. decorate() tags the Program: Executor.run replays
+the recorded ops under `paddle.amp.auto_cast` (same lists machinery as
+dygraph), and the train hook becomes scale-loss -> backward -> unscale ->
+dynamic-loss-scale step via `paddle.amp.GradScaler`. bf16 needs no loss
+scaling (the TPU-native dtype); fp16 keeps the reference's dynamic-scaling
+behavior for parity.
+"""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = [
+    "decorate", "AutoMixedPrecisionLists", "CustomOpLists",
+    "cast_model_to_fp16", "cast_parameters_to_fp16", "fp16_guard", "bf16",
+]
+
+
+class AutoMixedPrecisionLists:
+    """White/black op lists for static AMP (reference fp16_lists.py:146).
+    Feeds the same list machinery the dygraph auto_cast uses."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None,
+                 custom_black_varnames=None, dtype="float16"):
+        self.white_list = set(custom_white_list or ())
+        self.black_list = set(custom_black_list or ())
+        # varname-granular blacklisting needs per-tensor identity through the
+        # replay; op-granularity is what the eager AMP hook supports
+        self.black_varnames = set(custom_black_varnames or ())
+        self.dtype = dtype
+
+
+CustomOpLists = AutoMixedPrecisionLists
+
+
+class OptimizerWithMixedPrecision:
+    """The decorated optimizer (reference decorator.py:55): delegates to the
+    inner optimizer, and as a Program train hook runs the AMP train step
+    (scaled backward + GradScaler) with the replay wrapped in auto_cast."""
+
+    def __init__(self, optimizer, amp_lists, level, dtype,
+                 init_loss_scaling, incr_every_n_steps,
+                 decr_every_n_nan_or_inf, incr_ratio, decr_ratio,
+                 use_dynamic_loss_scaling):
+        from ..amp.grad_scaler import GradScaler
+
+        self._inner = optimizer
+        self._amp_lists = amp_lists or AutoMixedPrecisionLists(dtype=dtype)
+        self._level = level
+        self._dtype = dtype
+        use_scaler = use_dynamic_loss_scaling and dtype == "float16"
+        self._scaler = (GradScaler(
+            enable=True, init_loss_scaling=init_loss_scaling,
+            incr_ratio=incr_ratio, decr_ratio=decr_ratio,
+            incr_every_n_steps=incr_every_n_steps,
+            decr_every_n_nan_or_inf=decr_every_n_nan_or_inf)
+            if use_scaler else None)
+
+    # -- optimizer façade ---------------------------------------------------
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        from ..framework import capture
+
+        prog = capture.active()
+        out = self._inner.minimize(loss, startup_program=startup_program,
+                                   parameters=parameters,
+                                   no_grad_set=no_grad_set)
+        if prog is not None:
+            # replace the inner hook registered by minimize with this
+            # wrapper so Executor.run's train step goes through AMP
+            prog._train_hooks = [
+                (lt, self if opt is self._inner else opt)
+                for lt, opt in prog._train_hooks]
+            prog._amp_ctx = {"level": self._level, "dtype": self._dtype,
+                             "lists": self._amp_lists}
+        return out
+
+    def amp_init(self, place=None, scope=None, test_program=None,
+                 use_fp16_test=False):
+        """Pure-fp16/bf16 (O2) init: cast the optimized parameters to the
+        low-precision dtype (reference decorator.py amp_init); master
+        weights stay fp32 inside the optimizer when multi_precision."""
+        if self._level == "O2":
+            params = [p for g in self._inner._param_groups
+                      for p in g["params"]]
+            cast_parameters_to_fp16(place, None, params=params,
+                                    dtype=self._dtype)
+
+    # -- Program train-hook protocol (static/__init__.py Executor.run) ------
+    def _amp_train_step(self, live_loss):
+        if self._scaler is not None:
+            scaled = self._scaler.scale(live_loss)
+            scaled.backward()
+            self._scaler.step(self._inner)
+            self._scaler.update()
+        else:
+            live_loss.backward()
+            self._inner.step()
+        self._inner.clear_grad()
+
+    def step(self):
+        self._inner.step()
+
+    def clear_grad(self):
+        self._inner.clear_grad()
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=2 ** 15,
+             incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+             incr_ratio=2.0, decr_ratio=0.8, use_dynamic_loss_scaling=True,
+             use_pure_fp16=False, use_fp16_guard=None, use_bf16=False,
+             use_promote=False, level=None, dtype=None, master_weight=None):
+    """reference static/amp/decorator.py:762 — wrap an optimizer for
+    mixed-precision static training. O1 = auto_cast lists during replay;
+    O2 (`use_pure_fp16`) additionally casts parameters via amp_init()."""
+    dtype = dtype or ("bfloat16" if use_bf16 else "float16")
+    level = level or ("O2" if use_pure_fp16 else "O1")
+    if master_weight and hasattr(optimizer, "_use_master_weights"):
+        optimizer._use_master_weights = True
+    return OptimizerWithMixedPrecision(
+        optimizer, amp_lists, level, dtype, init_loss_scaling,
+        incr_every_n_steps, decr_every_n_nan_or_inf, incr_ratio, decr_ratio,
+        use_dynamic_loss_scaling)
+
+
+def cast_model_to_fp16(program, amp_lists=None, use_fp16_guard=True,
+                       dtype="float16", level="O2"):
+    """reference fp16_utils.cast_model_to_fp16: in capture-replay form the
+    op-level casting happens at replay under auto_cast, so this only needs
+    to tag the program (idempotent with decorate())."""
+    if program is not None:
+        program._amp_ctx = {"level": level, "dtype": dtype,
+                            "lists": amp_lists or AutoMixedPrecisionLists(dtype=dtype)}
+    return program
+
+
+def cast_parameters_to_fp16(place=None, program=None, scope=None,
+                            to_fp16_var_names=None, dtype="float16",
+                            params=None):
+    """Cast live Parameters to the low-precision dtype (O2). In the
+    capture-replay world parameters are live Layer/builder tensors read at
+    replay time, so casting them IS casting the model."""
+    if params is None and program is not None:
+        params = getattr(program, "_parameters", None) or []
+        if hasattr(program, "all_parameters"):
+            params = program.all_parameters()
+    for p in params or []:
+        if to_fp16_var_names and getattr(p, "name", None) not in to_fp16_var_names:
+            continue
+        if str(p.dtype).endswith(("float32", "float64")):
+            p._replace_value(p.value.astype(dtype))
+    return set(getattr(p, "name", "") for p in params or [])
+
+
+@contextlib.contextmanager
+def fp16_guard():
+    """reference fp16_utils.fp16_guard: scope ops that are allowed to run in
+    fp16 under use_fp16_guard. Here the same effect is an explicit
+    auto_cast(enable=True) region during capture — provided for source
+    compatibility."""
+    from ..amp.auto_cast import auto_cast
+
+    with auto_cast(enable=True, level="O1", dtype="float16"):
+        yield
+
+
+class _BF16Namespace:
+    """paddle.static.amp.bf16 (reference static/amp/bf16/): same machinery
+    with bfloat16 — the TPU-native dtype, no loss scaling."""
+
+    class AutoMixedPrecisionListsBF16(AutoMixedPrecisionLists):
+        def __init__(self, custom_bf16_list=None, custom_fp32_list=None,
+                     custom_fp32_varnames=None):
+            super().__init__(custom_white_list=custom_bf16_list,
+                             custom_black_list=custom_fp32_list,
+                             custom_black_varnames=custom_fp32_varnames,
+                             dtype="bfloat16")
+
+    @staticmethod
+    def decorate_bf16(optimizer, amp_lists=None, use_pure_bf16=False,
+                      use_bf16_guard=None):
+        return decorate(optimizer, amp_lists=amp_lists,
+                        use_dynamic_loss_scaling=False,
+                        use_pure_fp16=use_pure_bf16, use_bf16=True)
+
+    @staticmethod
+    def cast_model_to_bf16(program, amp_lists=None, use_bf16_guard=True):
+        return cast_model_to_fp16(program, amp_lists, dtype="bfloat16")
+
+    @staticmethod
+    def cast_parameters_to_bf16(place=None, program=None, scope=None,
+                                to_bf16_var_names=None):
+        return cast_parameters_to_fp16(place, program, scope,
+                                       to_bf16_var_names, dtype="bfloat16")
+
+    @staticmethod
+    @contextlib.contextmanager
+    def bf16_guard():
+        from ..amp.auto_cast import auto_cast
+
+        with auto_cast(enable=True, level="O1", dtype="bfloat16"):
+            yield
+
+
+bf16 = _BF16Namespace()

@@ -1,0 +1,48 @@
+"""bench_suite.py: the five BASELINE configs must run end-to-end on CPU
+(smoke shapes) and emit well-formed result rows. Reference analog: the
+configs named in BASELINE.json (LeNet / ResNet-50 AMP / BERT-base DP /
+GPT hybrid / LLaMA — the last is bench.py's flagship)."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SUITE = os.path.join(ROOT, "bench_suite.py")
+
+
+def _run(configs, timeout=560):
+    env = dict(os.environ)
+    env["PADDLE_TPU_PLATFORM"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, SUITE, "--configs", configs],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=ROOT)
+    assert out.returncode == 0, out.stderr[-800:]
+    rows = json.loads(out.stdout)
+    assert [r["config"] for r in rows] == configs.split(",")
+    for r in rows:
+        assert "error" not in r, r
+        assert r["value"] > 0
+    return rows
+
+
+@pytest.mark.slow
+class TestBenchSuite:
+    def test_lenet_and_bert(self):
+        rows = _run("lenet,bert_dp")
+        assert rows[0]["unit"] == "images/s"
+        assert rows[0]["detail"]["mode"] == "eager"
+        assert rows[1]["unit"] == "tokens/s"
+        assert rows[1]["detail"]["dp_degree"] == 1
+
+    def test_resnet50_amp(self):
+        (row,) = _run("resnet50")
+        assert row["detail"]["amp"] in ("O1", "O2")
+        assert row["detail"]["step_ms"] > 0
+
+    def test_gpt_hybrid_trains_on_virtual_mesh(self):
+        (row,) = _run("gpt_hybrid")
+        assert row["detail"]["mesh"].startswith("dp2 x mp2 x pp2")
+        assert row["detail"]["trains"] is True

@@ -1,0 +1,71 @@
+"""paddle._C_ops / paddle._legacy_C_ops / paddle.cost_model compat surfaces.
+
+Reference analogs: the generated python-C op module (python_c_gen.py ->
+paddle._C_ops — called directly by downstream user code), its legacy twin,
+and python/paddle/cost_model/cost_model.py:33 CostModel."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import _C_ops, _legacy_C_ops
+
+
+class TestCOps:
+    def test_op_resolution_and_call(self):
+        x = paddle.to_tensor(np.ones((2, 3), "float32"))
+        y = paddle.to_tensor(np.ones((3, 4), "float32"))
+        out = _C_ops.matmul(x, y)
+        assert out.shape == [2, 4] and float(out.sum()) == 24.0
+
+    def test_final_state_prefix_maps(self):
+        x = paddle.to_tensor(np.ones((2,), "float32"))
+        assert float(_C_ops.final_state_add(x, x).sum()) == 4.0
+
+    def test_inplace_variant(self):
+        t = paddle.to_tensor(np.array([-1.0, 2.0], "float32"))
+        out = _C_ops.relu_(t)
+        np.testing.assert_array_equal(out.numpy(), [0.0, 2.0])
+        np.testing.assert_array_equal(t.numpy(), [0.0, 2.0])  # in place
+
+    def test_legacy_module_same_table(self):
+        x = paddle.to_tensor(np.ones((3,), "float32"))
+        assert float(_legacy_C_ops.add(x, x).sum()) == 6.0
+
+    def test_unknown_op_raises_with_pointer(self):
+        with pytest.raises(AttributeError, match="ops_parity"):
+            _C_ops.definitely_not_an_op  # noqa: B018
+
+    def test_dir_lists_registry(self):
+        names = dir(_C_ops)
+        assert len(names) > 300 and "matmul" in names
+
+    def test_grad_flows_through_c_ops_call(self):
+        x = paddle.to_tensor(np.ones((2, 2), "float32"), stop_gradient=False)
+        loss = _C_ops.matmul(x, x).sum()
+        loss.backward()
+        assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+
+
+class TestCostModel:
+    def test_static_cost_data_default(self):
+        est = paddle.cost_model.CostModel().static_cost_data()
+        assert est.step_time > 0
+
+    def test_profile_measure_callable(self):
+        x = paddle.to_tensor(np.ones((8, 8), "float32"))
+        t = paddle.cost_model.CostModel().profile_measure(
+            fn=lambda: (x @ x).numpy(), iters=2)
+        assert t > 0
+
+    def test_profile_measure_program(self):
+        paddle.seed(0)
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            a = paddle.static.data("a", [2, 2], "float32")
+            (a * 2.0).name = "out"
+        # Program path needs a feed; profile with the callable form instead
+        exe = paddle.static.Executor()
+        t = paddle.cost_model.CostModel().profile_measure(
+            fn=lambda: exe.run(main, feed={"a": np.ones((2, 2), "float32")},
+                               fetch_list=["out"]), iters=2)
+        assert t > 0

@@ -59,6 +59,40 @@ class TestLlama:
             first = first if first is not None else last
         assert last < first
 
+    def test_fused_head_ce_matches_standard(self):
+        """fused_head_ce=True (chunked LM-head + CE, no [B,S,V] logits) must
+        match the materialized-logits path: same loss, same grads — incl.
+        through tied embeddings and ignore_index masking."""
+        for tied in (False, True):
+            paddle.seed(7)
+            m_std = LlamaForCausalLM(_tiny_cfg(tie_word_embeddings=tied))
+            paddle.seed(7)
+            m_fused = LlamaForCausalLM(
+                _tiny_cfg(tie_word_embeddings=tied, fused_head_ce=True))
+            ids, labels = _data(seed=5)
+            lab = np.asarray(labels.numpy()).copy()
+            lab[0, :4] = -100  # exercise masking
+            labels = paddle.to_tensor(lab)
+
+            loss_s, logits = m_std(ids, labels=labels)
+            assert logits is not None
+            loss_f, none_logits = m_fused(ids, labels=labels)
+            assert none_logits is None  # fused path skips materialization
+            np.testing.assert_allclose(float(loss_s), float(loss_f),
+                                       rtol=1e-5, atol=1e-6)
+
+            loss_s.backward()
+            loss_f.backward()
+            for (n1, p1), (n2, p2) in zip(m_std.named_parameters(),
+                                          m_fused.named_parameters()):
+                assert n1 == n2
+                if p1.grad is None:
+                    assert p2.grad is None or not np.any(p2.grad.numpy())
+                    continue
+                np.testing.assert_allclose(
+                    p1.grad.numpy(), p2.grad.numpy(), rtol=2e-4, atol=2e-5,
+                    err_msg=f"grad mismatch {n1} (tied={tied})")
+
     def test_ignore_index_masking(self):
         paddle.seed(0)
         m = LlamaForCausalLM(_tiny_cfg(num_hidden_layers=1))

@@ -302,6 +302,26 @@ def _fused_rms_norm_fn(x, g):
     return out[0] if isinstance(out, tuple) else out
 
 
+_FLCE_LABELS = np.random.RandomState(11).randint(0, 13, (2, 9))
+_FLCE_LABELS[0, :2] = -100  # exercise ignore_index and the pad path (9 % 4)
+
+
+def _flce_fn(h, w):
+    from paddle_tpu.incubate.nn.functional import fused_linear_cross_entropy
+
+    return fused_linear_cross_entropy(
+        h, w, paddle.to_tensor(_FLCE_LABELS), ignore_index=-100, chunk_size=4)
+
+
+def _flce_ref(h, w):
+    logits = np.asarray(h) @ np.asarray(w)
+    m = logits.max(-1, keepdims=True)
+    lse = np.log(np.exp(logits - m).sum(-1)) + m[..., 0]
+    safe = np.where(_FLCE_LABELS == -100, 0, _FLCE_LABELS)
+    picked = np.take_along_axis(logits, safe[..., None], -1)[..., 0]
+    return np.where(_FLCE_LABELS == -100, 0.0, lse - picked).astype(logits.dtype)
+
+
 def _fused_ln_fn(x, g, b):
     from paddle_tpu.incubate.nn.functional import fused_layer_norm
 
@@ -666,6 +686,11 @@ TAIL_CASES = [
            lambda x, g: _rms_norm_fn(x, g), _rms_norm_ref, [S, (5,)]),
     OpCase("fused_rms_norm",
            lambda x, g: _fused_rms_norm_fn(x, g), _rms_norm_ref, [S, (5,)]),
+    OpCase("fused_linear_cross_entropy", _flce_fn, _flce_ref,
+           [(2, 9, 6), (6, 13)],
+           # the op fixes fp32 softmax internally; the fp64 numpy reference
+           # therefore disagrees past fp32 resolution by design
+           fp64=False, rtol=1e-5, atol=1e-5, grad_rtol=1e-2, grad_atol=1e-3),
     OpCase("fused_layer_norm",
            lambda x, g, b: _fused_ln_fn(x, g, b),
            lambda x, g, b: (x - x.mean(-1, keepdims=True))

@@ -1,0 +1,113 @@
+"""paddle.static.amp: mixed precision for the capture-replay static graph.
+
+Reference analog: python/paddle/static/amp/decorator.py:762 decorate,
+fp16_lists.py:146 AutoMixedPrecisionLists, bf16/ submodule. Here decorate()
+tags the Program so Executor.run replays under auto_cast and the train hook
+runs scaled-backward + GradScaler (static/amp.py)."""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def _build(lr=0.05, decorate_kw=None):
+    paddle.seed(0)
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [None, 8], "float32")
+        y = paddle.static.data("y", [None, 1], "float32")
+        net = paddle.nn.Linear(8, 1)
+        loss = ((net(x) - y) ** 2).mean()
+        loss.name = "loss"
+        opt = paddle.optimizer.SGD(learning_rate=lr,
+                                   parameters=net.parameters())
+        dec = paddle.static.amp.decorate(opt, **(decorate_kw or {}))
+        dec.minimize(loss)
+    return main, net, dec
+
+
+def _regress(main, n_steps=30):
+    exe = paddle.static.Executor()
+    r = np.random.RandomState(0)
+    x = r.randn(64, 8).astype("float32")
+    w = r.randn(8, 1).astype("float32")
+    y = (x @ w).astype("float32")
+    losses = []
+    for _ in range(n_steps):
+        (lv,) = exe.run(main, feed={"x": x, "y": y}, fetch_list=["loss"])
+        losses.append(float(lv))
+    return losses
+
+
+class TestStaticAmp:
+    def test_fp16_o1_dynamic_scaling_trains(self):
+        main, net, dec = _build()
+        assert dec._scaler is not None  # fp16 default = dynamic loss scaling
+        losses = _regress(main)
+        assert losses[-1] < losses[0] * 0.5
+        assert main._amp_ctx["dtype"] == "float16"
+
+    def test_bf16_no_scaler_trains(self):
+        main, net, dec = _build(
+            decorate_kw=dict(use_bf16=True, use_dynamic_loss_scaling=False))
+        assert dec._scaler is None  # bf16 needs no loss scaling
+        assert main._amp_ctx["dtype"] == "bfloat16"
+        losses = _regress(main)
+        assert losses[-1] < losses[0] * 0.5
+
+    def test_custom_black_list_respected(self):
+        lists = paddle.static.amp.AutoMixedPrecisionLists(
+            custom_black_list=["matmul_v2", "matmul"])
+        main, net, dec = _build(decorate_kw=dict(amp_lists=lists))
+        losses = _regress(main, n_steps=5)
+        assert np.isfinite(losses).all()
+        assert "matmul" in main._amp_ctx["lists"].black_list
+
+    def test_bf16_namespace_shapes(self):
+        bf16 = paddle.static.amp.bf16
+        lists = bf16.AutoMixedPrecisionListsBF16(custom_bf16_list=["matmul"])
+        assert lists.dtype == "bfloat16" and "matmul" in lists.white_list
+        paddle.seed(0)
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data("x", [None, 4], "float32")
+            net = paddle.nn.Linear(4, 2)
+            out = net(x).sum()
+            out.name = "s"
+            opt = paddle.optimizer.SGD(learning_rate=0.01,
+                                       parameters=net.parameters())
+            dec = bf16.decorate_bf16(opt, use_pure_bf16=False)
+            dec.minimize(out)
+        exe = paddle.static.Executor()
+        (v,) = exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                       fetch_list=["s"])
+        assert np.isfinite(v)
+
+    def test_o2_amp_init_casts_parameters(self):
+        main, net, dec = _build(
+            decorate_kw=dict(use_bf16=True, use_pure_fp16=True,
+                             use_dynamic_loss_scaling=False))
+        dec.amp_init(place=None)
+        assert str(net.weight.dtype).endswith("bfloat16")
+        losses = _regress(main, n_steps=10)
+        assert losses[-1] < losses[0]
+
+    def test_fp16_guard_casts_inside(self):
+        with paddle.static.amp.fp16_guard():
+            a = paddle.to_tensor(np.ones((4, 4), "float32"))
+            b = paddle.to_tensor(np.ones((4, 4), "float32"))
+            out = a @ b
+        assert str(out.dtype).endswith("float16")
+
+    def test_o2_fp16_scaler_not_defeated_by_replay_context(self):
+        """Round-4 review regression: the replay auto_cast must close before
+        the train hook, else GradScaler.scale casts the fp32 loss to fp16
+        BEFORE multiplying by 2**15 and overflows to inf every step."""
+        main, net, dec = _build(
+            lr=0.01,
+            decorate_kw=dict(use_pure_fp16=True, init_loss_scaling=2.0 ** 15))
+        assert dec._scaler is not None
+        losses = _regress(main, n_steps=12)
+        # with the overflow bug every step is skipped (flat losses) and the
+        # scale decays; healthy training reduces the loss
+        assert losses[-1] < losses[0] * 0.9, losses
+        assert float(dec._scaler._scale) >= 1.0

@@ -39,6 +39,12 @@ VARIANTS = {
     "batch16_remat_off": {"BENCH_BATCH": "16", "BENCH_REMAT": "0"},
     # long-context leg
     "seq4096_b4": {"BENCH_SEQ": "4096", "BENCH_BATCH": "4"},
+    # fused LM-head + chunked CE: drops the [B,S,V] logits materialization
+    # (models/llama.py fused_head_ce) — frees HBM for bigger batch/remat-off
+    "fused_ce": {"BENCH_FUSED_CE": "1"},
+    "fused_ce_batch16": {"BENCH_FUSED_CE": "1", "BENCH_BATCH": "16"},
+    "fused_ce_b16_core_attn": {"BENCH_FUSED_CE": "1", "BENCH_BATCH": "16",
+                               "BENCH_REMAT_GRAN": "core_attn"},
 }
 
 
