@@ -397,10 +397,7 @@ def _decode_bench(model, cfg, on_tpu):
     }
 
 
-def _force(x):
-    from bench_common import force
-
-    force(x)
+from bench_common import force as _force  # noqa: E402
 
 
 def worker():

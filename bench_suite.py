@@ -8,7 +8,7 @@ itself publishes no in-tree numbers — BASELINE.md):
   3. bert_dp    — BERT-base pretraining step (fleet DataParallel surface;
                   dp mechanics proven in tests/test_launch.py — here the
                   per-chip step is measured)
-  4. gpt_hybrid — GPT under TP2 x PP2 x dp2 (+ sharding stage 2) on the
+  4. gpt_hybrid — GPT under tp2 x pp2 x sharding2 (ZeRO stage 2) on the
                   8-device virtual CPU mesh (hybrid mechanics + step time;
                   per-chip perf for the transformer family is the flagship
                   llama number)
@@ -208,7 +208,7 @@ def run_bert_dp():
 
 
 def run_gpt_hybrid():
-    """Config 4 — GPT under fleet hybrid parallel TP2 x PP2 x dp2 on the
+    """Config 4 — GPT under fleet hybrid parallel tp2 x pp2 x sharding2 on the
     8-device virtual CPU mesh (run via orchestrator with
     xla_force_host_platform_device_count=8): proves the ERNIE/GPT hybrid
     recipe end-to-end and reports the compiled step time. Not a per-chip
@@ -221,9 +221,11 @@ def run_gpt_hybrid():
     from paddle_tpu.models.llama import LlamaForCausalLMPipe
 
     strategy = fleet.DistributedStrategy()
-    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2,
-                               "pp_degree": 2}
-    strategy.hybrid_configs["sharding_degree"] = 1
+    # BASELINE config 4 is "TP+PP+sharding stage2": tp2 x pp2 x sharding2
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 2,
+                               "pp_degree": 2, "sharding_degree": 2}
+    strategy.sharding = True
+    strategy.sharding_configs = {"sharding_degree": 2, "stage": 2}
     strategy.pipeline_configs = {"accumulate_steps": 2,
                                  "micro_batch_size": 2, "compiled": True,
                                  "schedule_mode": "1F1B"}
@@ -259,7 +261,7 @@ def run_gpt_hybrid():
     dt = (time.perf_counter() - t0) / max(1, iters - 1)
     _emit({"config": "gpt_hybrid", "value": round(batch * seq / dt, 1),
            "unit": "tokens/s",
-           "detail": {"mesh": "dp2 x mp2 x pp2 (8 virtual cpu devices)",
+           "detail": {"mesh": "tp2 x pp2 x sharding2 (8 virtual cpu devices)",
                       "schedule": "1F1B", "batch": batch, "seq": seq,
                       "step_ms": round(dt * 1e3, 2),
                       "loss_first": losses[0], "loss_last": losses[-1],

@@ -40,10 +40,11 @@ class CostModel:
         return estimate_cost(model, parallel, hardware)
 
     def profile_measure(self, program=None, fn=None, args=(), iters=3,
-                        device=None):
+                        device=None, feed=None, fetch_list=None):
         """Measure a compiled program/callable: median wall time per run.
-        `program` may be a paddle.static.Program (replayed via Executor) or
-        `fn` a callable; returns seconds per iteration."""
+        `program` may be a paddle.static.Program (replayed via Executor with
+        the given ``feed``/``fetch_list``) or `fn` a callable; returns
+        seconds per iteration."""
         import numpy as np
 
         if program is not None:
@@ -52,7 +53,8 @@ class CostModel:
             exe = Executor(device)
 
             def fn():  # noqa: A001 - deliberate rebinding
-                return exe.run(program, feed={}, fetch_list=[])
+                return exe.run(program, feed=feed or {},
+                               fetch_list=fetch_list or [])
 
         if fn is None:
             raise ValueError("pass a static Program or a callable")

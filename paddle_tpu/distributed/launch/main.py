@@ -44,9 +44,18 @@ def build_parser():
     p.add_argument("--master", default=None,
                    help="rendezvous endpoint ip:port; rank 0 hosts the store")
     p.add_argument("--nnodes", type=int, default=1, help="number of nodes")
-    p.add_argument("--rank", type=int, default=0, help="this node's rank")
+    p.add_argument("--rank", type=int, default=None,
+                   help="this node's rank (default 0; derived from the "
+                        "position of this machine's address in --ips when "
+                        "that flag is used)")
     p.add_argument("--nproc_per_node", type=int, default=None,
                    help="processes on this node (default: 1, the per-host model)")
+    p.add_argument("--ips", default=None,
+                   help="comma-separated node ips (reference compat): sets "
+                        "--nnodes from its length; first ip is the master "
+                        "host unless --master is given")
+    p.add_argument("--gpus", dest="devices", default=None,
+                   help=argparse.SUPPRESS)  # reference alias for --devices
     p.add_argument("--devices", default=None,
                    help="visible device ids for this node (informational on TPU)")
     p.add_argument("--job_id", default="default", help="job name for logs")
@@ -54,7 +63,17 @@ def build_parser():
     p.add_argument("--log_level", default="INFO")
     p.add_argument("--run_mode", default="collective",
                    choices=["collective", "ps"],
-                   help="ps mode is not supported by the TPU build")
+                   help="collective (default) or parameter-server mode")
+    p.add_argument("--server_num", type=int, default=None,
+                   help="ps mode: number of parameter servers to spawn")
+    p.add_argument("--trainer_num", type=int, default=None,
+                   help="ps mode: number of trainer processes to spawn")
+    p.add_argument("--servers", default=None,
+                   help="ps mode: explicit comma-separated server "
+                        "ip:port endpoints (overrides --server_num)")
+    p.add_argument("--trainers", default=None,
+                   help="ps mode: explicit comma-separated trainer "
+                        "endpoints (their count sets --trainer_num)")
     p.add_argument("--max_restart", type=int, default=0,
                    help="relaunch the pod up to N times on failure (elastic); with nnodes>1 the launchers coordinate through a side store on master_port+1 (keep that port free)")
     p.add_argument("--elastic_timeout", type=float, default=10.0,
@@ -86,27 +105,102 @@ def _spawn(args, master, base_env):
         })
         if args.devices is not None:
             env["PADDLE_DEVICES"] = args.devices
-        # run as a file when it exists on disk; only fall back to module form
-        # (python -m) for a dotted name with no file behind it
-        if os.path.exists(args.training_script):
-            cmd = [sys.executable, "-u", args.training_script,
-                   *args.training_script_args]
-        elif not args.training_script.endswith(".py"):
-            cmd = [sys.executable, "-u", "-m", args.training_script,
-                   *args.training_script_args]
-        else:
-            raise FileNotFoundError(
-                f"training script {args.training_script!r} does not exist")
-        if args.log_dir:
-            os.makedirs(args.log_dir, exist_ok=True)
-            log_path = os.path.join(args.log_dir, f"workerlog.{global_rank}")
-            log_f = open(log_path, "w")
-            logs.append(log_f)
-            proc = subprocess.Popen(cmd, env=env, stdout=log_f,
-                                    stderr=subprocess.STDOUT)
-        else:
-            proc = subprocess.Popen(cmd, env=env)
-        procs.append(proc)
+        _start_proc(_resolve_cmd(args), env, args, f"workerlog.{global_rank}",
+                    procs, logs)
+    return procs, logs
+
+
+def _resolve_cmd(args):
+    """Run as a file when it exists on disk; only fall back to module form
+    (python -m) for a dotted name with no file behind it."""
+    if os.path.exists(args.training_script):
+        return [sys.executable, "-u", args.training_script,
+                *args.training_script_args]
+    if not args.training_script.endswith(".py"):
+        return [sys.executable, "-u", "-m", args.training_script,
+                *args.training_script_args]
+    raise FileNotFoundError(
+        f"training script {args.training_script!r} does not exist")
+
+
+def _start_proc(cmd, env, args, log_name, procs, logs):
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+        log_f = open(os.path.join(args.log_dir, log_name), "w")
+        logs.append(log_f)
+        procs.append(subprocess.Popen(cmd, env=env, stdout=log_f,
+                                      stderr=subprocess.STDOUT))
+    else:
+        procs.append(subprocess.Popen(cmd, env=env))
+
+
+def _local_hosts():
+    """Names/addresses that mean THIS machine (for --servers filtering)."""
+    import socket
+
+    hosts = {"127.0.0.1", "localhost", "0.0.0.0"}
+    try:
+        hostname = socket.gethostname()
+        hosts.add(hostname)
+        hosts.update(info[4][0] for info in socket.getaddrinfo(
+            hostname, None, family=socket.AF_INET))
+    except OSError:
+        pass
+    return hosts
+
+
+def _spawn_ps(args, base_env):
+    """Parameter-server mode: spawn PSERVER + TRAINER processes under the
+    reference env contract (TRAINING_ROLE / PADDLE_PSERVERS_IP_PORT_LIST /
+    PADDLE_TRAINER_ID — fleet/role_maker.py reads these; reference analog
+    launch/controllers/ps.py). One training script serves both roles by
+    branching on fleet.is_server(). Single-node: endpoints default to
+    loopback with free ports; --servers lists explicit endpoints."""
+    if args.servers:
+        eps = [e.strip() for e in args.servers.split(",") if e.strip()]
+        # every node sees the SAME full endpoint list (the trainers need it),
+        # but each node must only spawn the servers that live on it — the
+        # multi-node recipe (one launcher per node, shared --servers) would
+        # otherwise start duplicate servers on every node
+        local = _local_hosts()
+        spawn_eps = [(i, ep) for i, ep in enumerate(eps)
+                     if ep.rsplit(":", 1)[0] in local]
+    else:
+        eps = [f"127.0.0.1:{_free_port()}"
+               for _ in range(args.server_num or 1)]
+        spawn_eps = list(enumerate(eps))
+    if args.trainers:
+        trainer_num = len([e for e in args.trainers.split(",") if e.strip()])
+    else:
+        trainer_num = args.trainer_num or args.nproc_per_node or 1
+    # multi-node ps: each node launches trainer_num LOCAL trainers whose ids
+    # occupy this node's slice of the GLOBAL trainer space — without the
+    # offset every node would claim ids 0..trainer_num-1, corrupting the
+    # sync barrier's push counting and letting two nodes both believe they
+    # own trainer 0 (stop_servers rights)
+    rank = args.rank or 0
+    tid_base = rank * trainer_num
+    global_trainers = args.nnodes * trainer_num
+
+    common = dict(base_env)
+    common.update({
+        "PADDLE_PSERVERS_IP_PORT_LIST": ",".join(eps),
+        "PADDLE_TRAINERS_NUM": str(global_trainers),
+        "PADDLE_JOB_ID": args.job_id,
+    })
+
+    cmd = _resolve_cmd(args)
+    procs, logs = [], []
+    for i, ep in spawn_eps:
+        host, port = ep.rsplit(":", 1)
+        env = dict(common, TRAINING_ROLE="PSERVER", POD_IP=host,
+                   PADDLE_PORT=port)
+        _start_proc(cmd, env, args, f"serverlog.{i}", procs, logs)
+    for local_tid in range(trainer_num):
+        env = dict(common, TRAINING_ROLE="TRAINER",
+                   PADDLE_TRAINER_ID=str(tid_base + local_tid))
+        _start_proc(cmd, env, args, f"workerlog.{tid_base + local_tid}",
+                    procs, logs)
     return procs, logs
 
 
@@ -159,10 +253,43 @@ _PEER_DEAD_RC = 3801  # sentinel: pod torn down because a peer node died
 
 def launch(argv=None):
     args = build_parser().parse_args(argv)
+    if args.ips:
+        ips = [h.strip() for h in args.ips.split(",") if h.strip()]
+        if args.nnodes == 1 and len(ips) > 1:
+            args.nnodes = len(ips)
+        if args.master is None and len(ips) > 1:
+            # reference-style --ips carries no port: every node must derive
+            # the SAME master endpoint, so use the deterministic default
+            # port (a per-node random port could never rendezvous)
+            args.master = f"{ips[0]}:6170"
+        if args.rank is None and len(ips) > 1:
+            # the reference contract runs the IDENTICAL command on every
+            # node: this node's rank is its position in the ip list
+            local = _local_hosts()
+            mine = [i for i, h in enumerate(ips) if h in local]
+            if len(mine) == 1:
+                args.rank = mine[0]
+            elif not mine:
+                raise ValueError(
+                    f"--ips {args.ips!r}: none of the addresses is this "
+                    "machine; pass --rank explicitly")
+            else:
+                raise ValueError(
+                    f"--ips {args.ips!r}: {len(mine)} entries resolve to "
+                    "this machine; pass --rank explicitly")
+    if args.rank is None:
+        args.rank = 0
     if args.run_mode == "ps":
-        raise NotImplementedError(
-            "parameter-server mode is not part of the TPU build (SURVEY §2.6); "
-            "use collective mode")
+        if args.nnodes > 1 and not args.servers:
+            raise ValueError(
+                "multi-node ps needs --servers listing every node's server "
+                "endpoints (per-node random loopback ports cannot be shared)")
+        procs, logs = _spawn_ps(args, dict(os.environ))
+        try:
+            return _watch(procs)
+        finally:
+            for f in logs:
+                f.close()
     master = args.master
     if master is None:
         if args.nnodes > 1:

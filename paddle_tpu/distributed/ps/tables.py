@@ -7,6 +7,7 @@ optimizer state; trainers only ever see parameter values.
 """
 from __future__ import annotations
 
+import os
 import threading
 
 import numpy as np
@@ -113,7 +114,12 @@ class DenseTable:
             self.version += 1
             self._cv.notify_all()
 
-    def pull(self, min_version=0, timeout=60.0):
+    def pull(self, min_version=0, timeout=None):
+        if timeout is None:
+            # sync pulls block until every trainer's push lands; on a loaded
+            # single-core box (the CI suite) a peer trainer can be starved
+            # for a long time, so the deadlock guard is env-tunable
+            timeout = float(os.environ.get("PADDLE_PS_SYNC_TIMEOUT", "60"))
         with self._cv:
             ok = self._cv.wait_for(lambda: self.version >= min_version, timeout)
             if not ok:

@@ -218,3 +218,85 @@ class LlamaDecodeEngine:
             key, sub = jax.random.split(key)
             out.append(self._select(logits, temperature, top_k, top_p, sub))
         return jnp.concatenate(out, axis=1)
+
+    # -- beam search ---------------------------------------------------------
+    @functools.cached_property
+    def _reorder_jit(self):
+        @jax.jit
+        def reorder(cache, flat_parent):
+            return [(jnp.take(ck, flat_parent, axis=0),
+                     jnp.take(cv, flat_parent, axis=0)) for ck, cv in cache]
+
+        return reorder
+
+    def beam_search(self, input_ids, beam_size=4, max_new_tokens=32,
+                    length_penalty=0.0, eos_token_id=None):
+        """Beam-search decoding over the KV cache (the reference's
+        beam_search op family / BeamSearchDecoder capability, KV-cache form:
+        beams ride the batch axis, so every step is the same compiled
+        decode_step at batch B*K plus one compiled cache reorder).
+
+        Returns (tokens (B, K, T) int32, scores (B, K) fp32), beams sorted
+        best-first per batch row. ``length_penalty`` alpha normalizes final
+        scores by len**alpha (0 = raw log-prob sum). EOS-finished beams are
+        frozen (their score stops accumulating and the tail pads with EOS).
+        """
+        ids = jnp.asarray(getattr(input_ids, "value", input_ids), jnp.int32)
+        B, S = ids.shape
+        K, V = int(beam_size), self.head_w.shape[-1]
+        if S + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({S}) + max_new_tokens ({max_new_tokens}) exceeds "
+                f"the cache (max_len={self.max_len})")
+        if max_new_tokens <= 0:  # mirror generate(): nothing requested
+            return (jnp.zeros((B, K, 0), jnp.int32),
+                    jnp.zeros((B, K), jnp.float32))
+
+        logits, cache, pos = self.prefill(ids)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)  # (B, V)
+        scores, first = jax.lax.top_k(logp, K)                     # (B, K)
+        # expand the cache to B*K rows: beam k of row b lives at b*K + k
+        base = (jnp.arange(B)[:, None] * jnp.ones((1, K), jnp.int32)
+                ).reshape(-1).astype(jnp.int32)
+        cache = self._reorder_jit(cache, base)
+        tokens = first.reshape(B, K, 1).astype(jnp.int32)
+        finished = (jnp.zeros((B, K), bool) if eos_token_id is None
+                    else first == eos_token_id)
+
+        for _ in range(int(max_new_tokens) - 1):
+            flat_tok = tokens[:, :, -1].reshape(B * K, 1)
+            logits, cache = self.decode_step(flat_tok, cache, pos)
+            pos += 1
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+            logp = logp.reshape(B, K, V)
+            if eos_token_id is not None:
+                # frozen beams may only extend with EOS at zero cost
+                frozen = jnp.full((V,), -jnp.inf).at[eos_token_id].set(0.0)
+                logp = jnp.where(finished[:, :, None], frozen[None, None],
+                                 logp)
+            total = scores[:, :, None] + logp                      # (B, K, V)
+            scores, idx = jax.lax.top_k(total.reshape(B, K * V), K)
+            parent = (idx // V).astype(jnp.int32)                  # (B, K)
+            tok = (idx % V).astype(jnp.int32)
+            # reorder histories + caches to the surviving parents
+            tokens = jnp.take_along_axis(tokens, parent[:, :, None], axis=1)
+            tokens = jnp.concatenate([tokens, tok[:, :, None]], axis=-1)
+            flat_parent = (jnp.arange(B)[:, None] * K + parent).reshape(-1)
+            cache = self._reorder_jit(cache, flat_parent.astype(jnp.int32))
+            if eos_token_id is not None:
+                finished = jnp.take_along_axis(finished, parent, axis=1)
+                finished = finished | (tok == eos_token_id)
+
+        if length_penalty:
+            if eos_token_id is None:
+                lens = jnp.full((B, K), tokens.shape[-1], jnp.float32)
+            else:
+                lens = (tokens != eos_token_id).sum(-1).astype(jnp.float32)
+                lens = jnp.maximum(lens, 1.0)
+            final = scores / (lens ** float(length_penalty))
+        else:
+            final = scores
+        order = jnp.argsort(-final, axis=-1)
+        tokens = jnp.take_along_axis(tokens, order[:, :, None], axis=1)
+        final = jnp.take_along_axis(final, order, axis=1)
+        return tokens, final

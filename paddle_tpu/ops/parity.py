@@ -512,8 +512,10 @@ WAIVERS = {
     "reduce_as": "paddle.sum reduce-to-shape: broadcast-aware sum",
     "comm_init_all": "distributed.init_parallel_env",
     "batch_fc": "recsys batched fc; einsum expresses it",
-    "beam_search": "text decoding beam search (models sampled decoding)",
-    "beam_search_decode": "text decoding",
+    "beam_search": ("KV-cache beam search: models.llama_decode."
+                    "LlamaDecodeEngine.beam_search (no LoD step op needed)"),
+    "beam_search_decode": ("sequence readout happens inside "
+                           "LlamaDecodeEngine.beam_search (no LoD arrays)"),
     "chunk_eval": "chunking metric (text); metric module scope",
     "crf_decoding": "text crf",
     "ctc_align": "ctc alignment post-process",

@@ -44,5 +44,5 @@ class TestBenchSuite:
 
     def test_gpt_hybrid_trains_on_virtual_mesh(self):
         (row,) = _run("gpt_hybrid")
-        assert row["detail"]["mesh"].startswith("dp2 x mp2 x pp2")
+        assert row["detail"]["mesh"].startswith("tp2 x pp2 x sharding2")
         assert row["detail"]["trains"] is True

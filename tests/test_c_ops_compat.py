@@ -63,9 +63,7 @@ class TestCostModel:
         with paddle.static.program_guard(main):
             a = paddle.static.data("a", [2, 2], "float32")
             (a * 2.0).name = "out"
-        # Program path needs a feed; profile with the callable form instead
-        exe = paddle.static.Executor()
         t = paddle.cost_model.CostModel().profile_measure(
-            fn=lambda: exe.run(main, feed={"a": np.ones((2, 2), "float32")},
-                               fetch_list=["out"]), iters=2)
+            program=main, feed={"a": np.ones((2, 2), "float32")},
+            fetch_list=["out"], iters=2)
         assert t > 0

@@ -148,3 +148,54 @@ class TestBatchP2PAndStream:
         objs = [None]
         dist.scatter_object_list(objs, [{"k": 7}], src=0)
         assert objs == [{"k": 7}]
+
+
+class TestBeamSearch:
+    def test_beam_one_equals_greedy(self):
+        model, _ = _model()
+        r = np.random.RandomState(1)
+        ids = paddle.to_tensor(r.randint(0, 64, (2, 5)).astype("int64"))
+        eng = LlamaDecodeEngine(model, max_len=32)
+        greedy = np.asarray(eng.generate(ids, max_new_tokens=8))
+        beams, scores = eng.beam_search(ids, beam_size=1, max_new_tokens=8)
+        np.testing.assert_array_equal(np.asarray(beams)[:, 0], greedy)
+        assert np.isfinite(np.asarray(scores)).all()
+
+    def test_wider_beam_never_scores_worse(self):
+        """The best of 4 beams must reach at least the greedy (beam-1)
+        sequence log-probability — the defining beam-search property."""
+        model, _ = _model()
+        r = np.random.RandomState(2)
+        ids = paddle.to_tensor(r.randint(0, 64, (2, 4)).astype("int64"))
+        eng = LlamaDecodeEngine(model, max_len=32)
+        _, s1 = eng.beam_search(ids, beam_size=1, max_new_tokens=6)
+        beams4, s4 = eng.beam_search(ids, beam_size=4, max_new_tokens=6)
+        s1, s4 = np.asarray(s1), np.asarray(s4)
+        assert (s4[:, 0] >= s1[:, 0] - 1e-4).all(), (s4[:, 0], s1[:, 0])
+        # sorted best-first
+        assert (np.diff(s4, axis=1) <= 1e-6).all()
+        assert np.asarray(beams4).shape == (2, 4, 6)
+
+    def test_eos_freezes_beams(self):
+        model, _ = _model()
+        r = np.random.RandomState(3)
+        ids = paddle.to_tensor(r.randint(0, 64, (1, 4)).astype("int64"))
+        eng = LlamaDecodeEngine(model, max_len=32)
+        eos = 7
+        beams, scores = eng.beam_search(ids, beam_size=3, max_new_tokens=8,
+                                        eos_token_id=eos,
+                                        length_penalty=0.6)
+        b = np.asarray(beams)[0]
+        for row in b:
+            hit = np.where(row == eos)[0]
+            if hit.size:  # after the first EOS, only EOS follows (frozen)
+                assert (row[hit[0]:] == eos).all()
+        assert np.isfinite(np.asarray(scores)).all()
+
+    def test_zero_new_tokens_is_empty(self):
+        model, _ = _model()
+        ids = paddle.to_tensor(np.zeros((2, 3), "int64"))
+        eng = LlamaDecodeEngine(model, max_len=32)
+        beams, scores = eng.beam_search(ids, beam_size=2, max_new_tokens=0)
+        assert np.asarray(beams).shape == (2, 2, 0)
+        assert np.asarray(scores).shape == (2, 2)

@@ -307,11 +307,41 @@ def test_launch_parser_flags():
     assert args.training_script_args == ["--lr", "0.1"]
 
 
-def test_launch_ps_mode_rejected():
+def test_launch_ps_multinode_needs_explicit_servers():
     from paddle_tpu.distributed.launch import launch
 
-    with pytest.raises(NotImplementedError):
-        launch(["--run_mode", "ps", "x.py"])
+    # multi-node ps requires a shared endpoint list: per-node random
+    # loopback ports cannot rendezvous
+    with pytest.raises(ValueError, match="--servers"):
+        launch(["--run_mode", "ps", "--nnodes", "2", "x.py"])
+
+
+def test_launch_ps_multinode_trainer_id_slices():
+    """Each node's trainers must occupy its slice of the global id space
+    (rank offset) and PADDLE_TRAINERS_NUM must be the GLOBAL count."""
+    from unittest import mock
+
+    from paddle_tpu.distributed.launch.main import _spawn_ps, build_parser
+
+    args = build_parser().parse_args(
+        ["--run_mode", "ps", "--nnodes", "2", "--rank", "1",
+         "--trainer_num", "2",
+         "--servers", "198.51.100.7:7000,127.0.0.1:7001", "x.py"])
+    spawned = []
+    with mock.patch("subprocess.Popen",
+                    side_effect=lambda cmd, env=None, **kw: spawned.append(env)
+                    or mock.MagicMock()), \
+         mock.patch("paddle_tpu.distributed.launch.main._resolve_cmd",
+                    return_value=["true"]):
+        _spawn_ps(args, {})
+    servers = [e for e in spawned if e.get("TRAINING_ROLE") == "PSERVER"]
+    trainers = [e for e in spawned if e.get("TRAINING_ROLE") == "TRAINER"]
+    # only the LOCAL server endpoint spawns here (198.51.100.7 is foreign)
+    assert len(servers) == 1 and servers[0]["PADDLE_PORT"] == "7001"
+    assert [t["PADDLE_TRAINER_ID"] for t in trainers] == ["2", "3"]
+    assert all(t["PADDLE_TRAINERS_NUM"] == "4" for t in trainers)
+    assert all(t["PADDLE_PSERVERS_IP_PORT_LIST"]
+               == "198.51.100.7:7000,127.0.0.1:7001" for t in trainers)
 
 
 class TestReviewFixes:
@@ -506,3 +536,61 @@ def test_elastic_kill_rank_relaunch_resume(tmp_path):
                      if l.startswith("FINAL_LOSS=")][-1].split("=")[1])
               for i in range(2)]
     assert finals[0] == finals[1]
+
+
+@pytest.mark.slow
+class TestPSLaunch:
+    def test_ps_mode_spawns_servers_and_trainers(self, tmp_path):
+        """--run_mode ps: the launcher owns the reference PS env contract
+        (launch/controllers/ps.py analog) — one script branches on
+        fleet.is_server(); sync SGD trainers converge and agree."""
+        script = tmp_path / "ps_job.py"
+        script.write_text(
+            "import os\n"
+            "import numpy as np\n"
+            "import paddle_tpu as paddle\n"
+            "from paddle_tpu.distributed import fleet\n"
+            "fleet.init(is_collective=False)\n"
+            "if fleet.is_server():\n"
+            "    fleet.init_server()\n"
+            "    fleet.run_server()\n"
+            "else:\n"
+            "    lin = paddle.nn.Linear(2, 1)\n"
+            "    fleet.distributed_model(lin)\n"
+            "    opt = fleet.distributed_optimizer(paddle.optimizer.SGD(\n"
+            "        learning_rate=0.1, parameters=lin.parameters()))\n"
+            "    X = paddle.to_tensor(np.eye(2, dtype=np.float32))\n"
+            "    y = paddle.to_tensor(np.array([[1.0], [2.0]], np.float32))\n"
+            "    first = last = None\n"
+            "    for _ in range(25):\n"
+            "        loss = ((lin(X) - y) ** 2).mean()\n"
+            "        loss.backward(); opt.step(); opt.clear_grad()\n"
+            "        v = float(loss.numpy())\n"
+            "        first = v if first is None else first; last = v\n"
+            "    assert last < 0.2 * first, (first, last)\n"
+            "    fleet.stop_worker()\n"
+            "    print('TRAINER_OK', np.asarray(lin.weight.numpy()).ravel().tolist())\n"
+        )
+        log_dir = tmp_path / "logs"
+        env_keep = dict(os.environ)
+        os.environ["PADDLE_TPU_PLATFORM"] = "cpu"
+        os.environ["PYTHONPATH"] = (REPO + os.pathsep
+                                    + os.environ.get("PYTHONPATH", ""))
+        try:
+            from paddle_tpu.distributed.launch.main import launch
+
+            rc = launch(["--run_mode", "ps", "--server_num", "1",
+                         "--trainer_num", "2", "--log_dir", str(log_dir),
+                         str(script)])
+        finally:
+            os.environ.clear()
+            os.environ.update(env_keep)
+        assert rc == 0
+        outs = []
+        for tid in range(2):
+            text = (log_dir / f"workerlog.{tid}").read_text()
+            assert "TRAINER_OK" in text, text[-800:]
+            outs.append([ln for ln in text.splitlines()
+                         if "TRAINER_OK" in ln][-1])
+        assert outs[0] == outs[1]  # sync SGD: identical final weights
+        assert (log_dir / "serverlog.0").exists()

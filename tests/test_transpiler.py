@@ -52,10 +52,16 @@ class TestDistributeTranspiler:
     def test_sync_two_trainers_matches_single_process(self):
         """2 trainers + 1 pserver (sync SGD averaging both grads) must equal
         the single-process run over the concatenated batch."""
+        import os
+
         from paddle_tpu.distributed.ps import PSServer
         from paddle_tpu.distributed.transpiler import DistributeTranspiler
 
         paddle.enable_static()
+        # a fully loaded single-core CI box can starve one trainer thread
+        # past the 60s default sync-deadlock guard; widen it for the test
+        os.environ["PADDLE_PS_SYNC_TIMEOUT"] = "240"
+        errors = []
         try:
             x, y = _data()
             half = len(x) // 2
@@ -74,18 +80,24 @@ class TestDistributeTranspiler:
             results = {}
 
             def trainer(tid):
-                main, _, net = _build_program(7)  # identical init: same seed
-                t = DistributeTranspiler()
-                t.transpile(tid, program=main, pservers=srv.endpoint,
-                            trainers=2, sync_mode=True)
-                tp = t.get_trainer_program()
-                exe = paddle.static.Executor()
-                xs, ys = shards[tid]
-                for _ in range(5):
-                    exe.run(tp, feed={"x": xs, "y": ys}, fetch_list=["loss"])
-                results[tid] = np.asarray(net.weight.value).copy()
-                for _, hook in tp._train_hooks:
-                    hook.close()
+                try:
+                    main, _, net = _build_program(7)  # identical init: same seed
+                    t = DistributeTranspiler()
+                    t.transpile(tid, program=main, pservers=srv.endpoint,
+                                trainers=2, sync_mode=True)
+                    tp = t.get_trainer_program()
+                    exe = paddle.static.Executor()
+                    xs, ys = shards[tid]
+                    for _ in range(5):
+                        exe.run(tp, feed={"x": xs, "y": ys},
+                                fetch_list=["loss"])
+                    results[tid] = np.asarray(net.weight.value).copy()
+                    for _, hook in tp._train_hooks:
+                        hook.close()
+                except BaseException as e:  # noqa: BLE001 - surfaced below
+                    import traceback
+
+                    errors.append((tid, e, traceback.format_exc()))
 
             # trainer threads hold the GIL only between jax dispatches; the
             # sync table blocks each until both grads of a step arrived
@@ -96,6 +108,7 @@ class TestDistributeTranspiler:
                 th.join(timeout=300)  # generous: the test box is 1 core
             srv.shutdown()
 
+            assert not errors, "\n".join(tb for _, _, tb in errors)
             assert set(results) == {0, 1}
             # both trainers end on the identical server-stepped weights
             np.testing.assert_array_equal(results[0], results[1])
@@ -105,6 +118,7 @@ class TestDistributeTranspiler:
                                        atol=2e-5)
         finally:
             paddle.disable_static()
+            os.environ.pop("PADDLE_PS_SYNC_TIMEOUT", None)
 
     def test_unsupported_optimizer_raises(self):
         from paddle_tpu.distributed.transpiler import _server_opt_cfg
