@@ -170,17 +170,24 @@ def _spawn_ps(args, base_env):
                for _ in range(args.server_num or 1)]
         spawn_eps = list(enumerate(eps))
     if args.trainers:
-        trainer_num = len([e for e in args.trainers.split(",") if e.strip()])
+        # --trainers is a GLOBAL endpoint list (the reference contract,
+        # like --servers): every node sees the same list, each node spawns
+        # only ITS endpoints, and a trainer's id is its list position
+        tr_eps = [e.strip() for e in args.trainers.split(",") if e.strip()]
+        local = _local_hosts()
+        local_tids = [i for i, ep in enumerate(tr_eps)
+                      if ep.rsplit(":", 1)[0] in local]
+        global_trainers = len(tr_eps)
     else:
+        # count form: each node launches trainer_num LOCAL trainers whose
+        # ids occupy this node's slice of the GLOBAL trainer space — without
+        # the offset every node would claim ids 0..trainer_num-1, corrupting
+        # the sync barrier's push counting and letting two nodes both
+        # believe they own trainer 0 (stop_servers rights)
         trainer_num = args.trainer_num or args.nproc_per_node or 1
-    # multi-node ps: each node launches trainer_num LOCAL trainers whose ids
-    # occupy this node's slice of the GLOBAL trainer space — without the
-    # offset every node would claim ids 0..trainer_num-1, corrupting the
-    # sync barrier's push counting and letting two nodes both believe they
-    # own trainer 0 (stop_servers rights)
-    rank = args.rank or 0
-    tid_base = rank * trainer_num
-    global_trainers = args.nnodes * trainer_num
+        tid_base = (args.rank or 0) * trainer_num
+        local_tids = list(range(tid_base, tid_base + trainer_num))
+        global_trainers = args.nnodes * trainer_num
 
     common = dict(base_env)
     common.update({
@@ -196,11 +203,10 @@ def _spawn_ps(args, base_env):
         env = dict(common, TRAINING_ROLE="PSERVER", POD_IP=host,
                    PADDLE_PORT=port)
         _start_proc(cmd, env, args, f"serverlog.{i}", procs, logs)
-    for local_tid in range(trainer_num):
+    for tid in local_tids:
         env = dict(common, TRAINING_ROLE="TRAINER",
-                   PADDLE_TRAINER_ID=str(tid_base + local_tid))
-        _start_proc(cmd, env, args, f"workerlog.{tid_base + local_tid}",
-                    procs, logs)
+                   PADDLE_TRAINER_ID=str(tid))
+        _start_proc(cmd, env, args, f"workerlog.{tid}", procs, logs)
     return procs, logs
 
 

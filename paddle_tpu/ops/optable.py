@@ -24,7 +24,13 @@ def op_table(include_custom=False):
     include_custom=True (they are session-local, not framework surface).
     """
     rows = []
+    from ..utils.custom_op import _CUSTOM_OPS
+
     for name, opdef in sorted(get_registry().items()):
+        if name in _CUSTOM_OPS and not include_custom:
+            # user extensions (register_custom_op / cpp_extension.def_op)
+            # are session-local, not framework op-table surface
+            continue
         fn = opdef.fn
         module = getattr(fn, "__module__", "") or ""
         if not include_custom and not module.startswith("paddle_tpu."):

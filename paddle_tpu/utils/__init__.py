@@ -4,6 +4,7 @@ import functools as _functools
 import importlib as _importlib
 import warnings as _warnings
 
+from . import cpp_extension  # noqa: F401
 from . import custom_op  # noqa: F401
 from . import download  # noqa: F401
 from .custom_op import get_custom_op, register_custom_op  # noqa: F401

@@ -1,0 +1,184 @@
+"""paddle.utils.cpp_extension — build and load user C++ extensions.
+
+Reference analog: python/paddle/utils/cpp_extension/cpp_extension.py
+(`load` at :895 JIT-compiles sources and imports the resulting module;
+`CppExtension`/`CUDAExtension` + `setup` wrap setuptools for ahead-of-time
+builds; the C++ side uses the PD_BUILD_OP macro family).
+
+TPU-first redesign: there is no paddle C++ header world to compile against
+— the accelerator path for custom kernels is Pallas via
+`paddle.utils.register_custom_op`. What C++ extensions remain for is HOST
+compute (feature engineering, tokenization, custom CPU math), so:
+
+* ``load(name, sources, ...)`` compiles the sources with the system C++
+  toolchain into a shared library and returns a ``CppExtensionModule``
+  wrapping it (ctypes).
+* ``CppExtensionModule.def_op`` registers an exported C symbol as a
+  first-class framework op: the call crosses into C++ through
+  ``jax.pure_callback``, so it works in eager AND inside jit (XLA treats it
+  as a host callback), with optional custom backward.
+* richer signatures bind through ``.lib`` (the raw ctypes CDLL) and wrap
+  with ``register_custom_op`` directly.
+
+The simple def_op C ABI (float32, same-shape outputs):
+    1 input : void sym(const float* x, float* y, int64_t n);
+    2 inputs: void sym(const float* a, const float* b, float* y, int64_t n);
+    backward (unary): void bwd(const float* x, const float* gy, float* gx,
+                               int64_t n);
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+
+__all__ = ["load", "setup", "CppExtension", "CUDAExtension",
+           "CppExtensionModule", "BuildError"]
+
+
+class BuildError(RuntimeError):
+    pass
+
+
+def _compile(name, sources, extra_cflags=(), extra_ldflags=(),
+             extra_include_paths=(), build_directory=None, verbose=False):
+    build_directory = build_directory or os.path.join(
+        tempfile.gettempdir(), "paddle_tpu_extensions")
+    os.makedirs(build_directory, exist_ok=True)
+    out = os.path.join(build_directory, f"lib{name}.so")
+    srcs = [s for s in sources if not s.endswith((".cu", ".cuh"))]
+    if len(srcs) != len(sources) and verbose:
+        print(f"[cpp_extension] skipping CUDA sources on the TPU build: "
+              f"{sorted(set(sources) - set(srcs))}")
+    if not srcs:
+        raise BuildError("no C++ sources to build (CUDA-only extension?)")
+    cmd = None
+    last_err = ""
+    for cc in ("c++", "g++"):
+        cmd = [cc, "-O2", "-std=c++17", "-shared", "-fPIC",
+               *[f"-I{p}" for p in extra_include_paths], *extra_cflags,
+               *srcs, "-o", out, *extra_ldflags]
+        if verbose:
+            print("[cpp_extension]", " ".join(cmd))
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=600)
+        except (FileNotFoundError, subprocess.TimeoutExpired) as e:
+            last_err = repr(e)
+            continue
+        if proc.returncode == 0:
+            return out
+        last_err = proc.stderr[-2000:]
+    raise BuildError(f"compilation failed: {last_err}")
+
+
+class CppExtensionModule:
+    """A loaded extension: ``.lib`` is the raw ctypes CDLL; ``def_op``
+    registers an exported symbol as a framework op."""
+
+    def __init__(self, name, path):
+        self.name = name
+        self.path = path
+        self.lib = ctypes.CDLL(path)
+
+    def def_op(self, op_name, symbol=None, n_inputs=1, backward_symbol=None):
+        """Register C symbol ``symbol`` (default: ``op_name``) as op
+        ``op_name`` under the simple float32 elementwise ABI (module
+        docstring). Returns the public op callable (Tensor -> Tensor),
+        usable in eager and under jit (host callback)."""
+        import numpy as np
+
+        import jax
+
+        from .custom_op import register_custom_op
+
+        fwd_c = getattr(self.lib, symbol or op_name)
+        fwd_c.restype = None
+
+        def _call_c(cfn, *arrays):
+            arrays = [np.ascontiguousarray(a, np.float32) for a in arrays]
+            out = np.empty_like(arrays[0])
+            ptrs = [a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+                    for a in arrays]
+            cfn(*ptrs, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                ctypes.c_int64(arrays[0].size))
+            return out
+
+        def forward(*xs):
+            if len(xs) != n_inputs:
+                raise TypeError(
+                    f"{op_name} takes {n_inputs} input(s), got {len(xs)}")
+            spec = jax.ShapeDtypeStruct(xs[0].shape, np.float32)
+            return jax.pure_callback(
+                lambda *a: _call_c(fwd_c, *a), spec,
+                *[x.astype(np.float32) for x in xs], vmap_method="sequential")
+
+        backward = None
+        if backward_symbol is not None:
+            if n_inputs != 1:
+                raise NotImplementedError(
+                    "backward_symbol is supported for unary ops; bind "
+                    "multi-input gradients via .lib + register_custom_op")
+            bwd_c = getattr(self.lib, backward_symbol)
+            bwd_c.restype = None
+
+            def backward(residuals, gy):
+                (x,) = residuals
+                spec = jax.ShapeDtypeStruct(x.shape, np.float32)
+                gx = jax.pure_callback(
+                    lambda xx, g: _call_c(bwd_c, xx, g), spec,
+                    x.astype(np.float32), gy.astype(np.float32),
+                    vmap_method="sequential")
+                return (gx,)
+
+        return register_custom_op(op_name, forward, backward=backward)
+
+
+def load(name, sources, extra_cflags=None, extra_ldflags=None,
+         extra_include_paths=None, build_directory=None, verbose=False,
+         **unused_reference_kwargs):
+    """reference cpp_extension.load:895 — JIT-build the sources, return the
+    loaded extension module."""
+    path = _compile(name, list(sources), tuple(extra_cflags or ()),
+                    tuple(extra_ldflags or ()),
+                    tuple(extra_include_paths or ()), build_directory,
+                    verbose)
+    return CppExtensionModule(name, path)
+
+
+class CppExtension:
+    """Ahead-of-time build description (reference cpp_extension.py:250)."""
+
+    def __init__(self, sources, name=None, include_dirs=None,
+                 extra_compile_args=None, extra_link_args=None, **kw):
+        self.name = name
+        self.sources = list(sources)
+        self.include_dirs = list(include_dirs or ())
+        self.extra_compile_args = extra_compile_args or []
+        self.extra_link_args = extra_link_args or []
+
+
+def CUDAExtension(sources, *args, **kwargs):  # noqa: N802 - reference name
+    """reference cpp_extension.py:302 — on the TPU build the .cu sources are
+    skipped (no CUDA toolchain) and the remaining C++ builds host-side;
+    on-accelerator custom kernels are Pallas (`register_custom_op`)."""
+    return CppExtension(sources, *args, **kwargs)
+
+
+def setup(name=None, ext_modules=(), **kw):
+    """reference cpp_extension.setup:92 — ahead-of-time build: compiles each
+    extension into the current directory (or PADDLE_EXTENSION_DIR)."""
+    outdir = os.environ.get("PADDLE_EXTENSION_DIR", os.getcwd())
+    built = []
+    for ext in ext_modules:
+        ext_name = ext.name or name
+        if not ext_name:
+            raise BuildError("extension needs a name (CppExtension(name=...) "
+                             "or setup(name=...))")
+        path = _compile(ext_name, ext.sources,
+                        tuple(ext.extra_compile_args),
+                        tuple(ext.extra_link_args),
+                        tuple(ext.include_dirs), build_directory=outdir)
+        built.append(path)
+    return built

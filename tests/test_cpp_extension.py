@@ -1,0 +1,103 @@
+"""paddle.utils.cpp_extension: build + load user C++ host ops.
+
+Reference analog: python/paddle/utils/cpp_extension/cpp_extension.py
+(load:895 JIT build, CppExtension:250/setup:92 AOT build). Here the C++
+runs host-side through jax.pure_callback; accelerator custom kernels are
+Pallas via register_custom_op."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils.cpp_extension import (BuildError, CppExtension, load,
+                                            setup)
+
+SRC = r"""
+#include <cstdint>
+#include <cmath>
+extern "C" void softsign_fwd(const float* x, float* y, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) y[i] = x[i] / (1.0f + std::fabs(x[i]));
+}
+extern "C" void softsign_bwd(const float* x, const float* gy, float* gx,
+                             int64_t n) {
+  for (int64_t i = 0; i < n; ++i) {
+    float d = 1.0f + std::fabs(x[i]);
+    gx[i] = gy[i] / (d * d);
+  }
+}
+extern "C" void scaled_add(const float* a, const float* b, float* y,
+                           int64_t n) {
+  for (int64_t i = 0; i < n; ++i) y[i] = a[i] + 2.0f * b[i];
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def ext(tmp_path_factory):
+    d = tmp_path_factory.mktemp("cppext")
+    src = d / "ops.cc"
+    src.write_text(SRC)
+    return load("t_cppext", [str(src)], build_directory=str(d))
+
+
+class TestCppExtension:
+    def test_unary_op_with_custom_backward(self, ext):
+        op = ext.def_op("t_softsign", "softsign_fwd",
+                        backward_symbol="softsign_bwd")
+        x = paddle.to_tensor(np.array([-2.0, 0.0, 3.0], "float32"),
+                             stop_gradient=False)
+        y = op(x)
+        np.testing.assert_allclose(y.numpy(), [-2 / 3, 0.0, 0.75], rtol=1e-6)
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [1 / 9, 1.0, 1 / 16],
+                                   rtol=1e-6)
+
+    def test_binary_op_and_jit(self, ext):
+        op = ext.def_op("t_scaled_add", "scaled_add", n_inputs=2)
+        a = paddle.to_tensor(np.ones((2, 3), "float32"))
+        b = paddle.to_tensor(np.full((2, 3), 3.0, "float32"))
+        np.testing.assert_allclose(op(a, b).numpy(), np.full((2, 3), 7.0))
+
+        import paddle_tpu.jit as jit
+
+        f = jit.to_static(lambda u, v: op(u, v) + 1.0)
+        np.testing.assert_allclose(np.asarray(f(a, b).numpy()),
+                                   np.full((2, 3), 8.0))
+
+    def test_raw_ctypes_binding_available(self, ext):
+        import ctypes
+
+        fn = ext.lib.scaled_add
+        a = np.ones(3, np.float32)
+        b = np.ones(3, np.float32)
+        out = np.empty(3, np.float32)
+        fn(a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+           b.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+           out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+           ctypes.c_int64(3))
+        np.testing.assert_allclose(out, [3.0, 3.0, 3.0])
+
+    def test_setup_aot_build(self, tmp_path):
+        src = tmp_path / "aot.cc"
+        src.write_text(SRC)
+        os.environ["PADDLE_EXTENSION_DIR"] = str(tmp_path)
+        try:
+            built = setup(name="t_aot", ext_modules=[
+                CppExtension([str(src)], name="t_aot")])
+        finally:
+            os.environ.pop("PADDLE_EXTENSION_DIR", None)
+        assert built == [str(tmp_path / "libt_aot.so")]
+        assert os.path.exists(built[0])
+
+    def test_cuda_only_extension_rejected(self, tmp_path):
+        cu = tmp_path / "k.cu"
+        cu.write_text("__global__ void k() {}")
+        with pytest.raises(BuildError, match="CUDA-only"):
+            load("t_cuda", [str(cu)], build_directory=str(tmp_path))
+
+    def test_bad_source_reports_compiler_error(self, tmp_path):
+        bad = tmp_path / "bad.cc"
+        bad.write_text("this is not C++")
+        with pytest.raises(BuildError, match="compilation failed"):
+            load("t_bad", [str(bad)], build_directory=str(tmp_path))

@@ -594,3 +594,28 @@ class TestPSLaunch:
                          if "TRAINER_OK" in ln][-1])
         assert outs[0] == outs[1]  # sync SGD: identical final weights
         assert (log_dir / "serverlog.0").exists()
+
+
+def test_launch_ps_trainers_endpoint_list_is_global():
+    """--trainers is a global endpoint list: each node spawns only its own
+    endpoints, with ids = list positions (reference contract)."""
+    from unittest import mock
+
+    from paddle_tpu.distributed.launch.main import _spawn_ps, build_parser
+
+    args = build_parser().parse_args(
+        ["--run_mode", "ps", "--nnodes", "2",
+         "--servers", "198.51.100.7:7000,127.0.0.1:7001",
+         "--trainers", "198.51.100.7:8200,127.0.0.1:8200,127.0.0.1:8201",
+         "x.py"])
+    spawned = []
+    with mock.patch("subprocess.Popen",
+                    side_effect=lambda cmd, env=None, **kw: spawned.append(env)
+                    or mock.MagicMock()), \
+         mock.patch("paddle_tpu.distributed.launch.main._resolve_cmd",
+                    return_value=["true"]):
+        _spawn_ps(args, {})
+    trainers = [e for e in spawned if e.get("TRAINING_ROLE") == "TRAINER"]
+    # only the two loopback endpoints are local; ids are LIST positions
+    assert sorted(t["PADDLE_TRAINER_ID"] for t in trainers) == ["1", "2"]
+    assert all(t["PADDLE_TRAINERS_NUM"] == "3" for t in trainers)
