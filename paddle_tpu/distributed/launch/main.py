@@ -178,6 +178,13 @@ def _spawn_ps(args, base_env):
         local_tids = [i for i, ep in enumerate(tr_eps)
                       if ep.rsplit(":", 1)[0] in local]
         global_trainers = len(tr_eps)
+        if not local_tids:
+            # spawning zero trainers would leave every OTHER node blocked at
+            # the global sync barrier with no diagnostic anywhere
+            raise ValueError(
+                f"--trainers {args.trainers!r}: no endpoint resolves to this "
+                f"machine (known local addresses: {sorted(local)}); check "
+                "the list or use --trainer_num with --rank instead")
     else:
         # count form: each node launches trainer_num LOCAL trainers whose
         # ids occupy this node's slice of the GLOBAL trainer space — without

@@ -194,6 +194,9 @@ class GPTPretrainingCriterion(Layer):
             tok = F.softmax_with_cross_entropy(
                 logits, labels, ignore_index=self.ignore_index)
         tok = ops.squeeze(tok, -1) if tok.ndim > labels.ndim else tok
+        return self.masked_mean(tok, labels)
+
+    def masked_mean(self, tok, labels):
         mask = (labels != self.ignore_index).astype(tok.dtype)
         denom = ops.maximum(mask.sum(), ops.to_tensor(1.0, dtype=tok.dtype))
         return (tok * mask).sum() / denom
@@ -212,6 +215,21 @@ class GPTForCausalLM(Layer):
 
     def forward(self, input_ids, labels=None):
         h = self.gpt(input_ids)
+        if (labels is not None
+                and getattr(self.config, "fused_head_ce", False)
+                and not _tp(self.config)):
+            # fused LM-head + CE (models/llama.py docstring): [B, S, V]
+            # logits never materialize; callers only consume the loss
+            from ..incubate.nn.functional import fused_linear_cross_entropy
+
+            w = (ops.transpose(self.gpt.embeddings.word_embeddings.weight,
+                               [1, 0])
+                 if self.config.tie_word_embeddings else self.lm_head.weight)
+            if labels.ndim == 3:
+                labels = ops.squeeze(labels, -1)
+            tok = fused_linear_cross_entropy(
+                h, w, labels, ignore_index=self.criterion.ignore_index)
+            return self.criterion.masked_mean(tok, labels), None
         if self.config.tie_word_embeddings:
             w = ops.transpose(self.gpt.embeddings.word_embeddings.weight, [1, 0])
             logits = ops.matmul(h, w)

@@ -29,6 +29,7 @@ The simple def_op C ABI (float32, same-shape outputs):
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import tempfile
@@ -42,19 +43,37 @@ class BuildError(RuntimeError):
 
 
 def _compile(name, sources, extra_cflags=(), extra_ldflags=(),
-             extra_include_paths=(), build_directory=None, verbose=False):
+             extra_include_paths=(), build_directory=None, verbose=False,
+             versioned=True):
     build_directory = build_directory or os.path.join(
-        tempfile.gettempdir(), "paddle_tpu_extensions")
+        tempfile.gettempdir(), f"paddle_tpu_extensions_{os.getuid()}")
     os.makedirs(build_directory, exist_ok=True)
-    out = os.path.join(build_directory, f"lib{name}.so")
     srcs = [s for s in sources if not s.endswith((".cu", ".cuh"))]
     if len(srcs) != len(sources) and verbose:
         print(f"[cpp_extension] skipping CUDA sources on the TPU build: "
               f"{sorted(set(sources) - set(srcs))}")
     if not srcs:
         raise BuildError("no C++ sources to build (CUDA-only extension?)")
-    cmd = None
-    last_err = ""
+    # version the output by source content: re-load()ing edited sources in
+    # one process must produce a NEW .so (dlopen caches by path, and
+    # rewriting a still-mapped .so in place can SIGBUS), and same-named
+    # extensions from different projects must not clobber each other
+    if versioned:
+        h = hashlib.sha256()
+        for s in srcs:
+            with open(s, "rb") as f:
+                h.update(f.read())
+        h.update(" ".join((*extra_cflags, *extra_ldflags,
+                           *extra_include_paths)).encode())
+        out = os.path.join(build_directory,
+                           f"lib{name}.{h.hexdigest()[:12]}.so")
+        if os.path.exists(out):
+            return out
+    else:
+        # AOT packaging (setup) needs the stable, predictable name
+        out = os.path.join(build_directory, f"lib{name}.so")
+    compile_err = ""
+    spawn_err = ""
     for cc in ("c++", "g++"):
         cmd = [cc, "-O2", "-std=c++17", "-shared", "-fPIC",
                *[f"-I{p}" for p in extra_include_paths], *extra_cflags,
@@ -65,12 +84,15 @@ def _compile(name, sources, extra_cflags=(), extra_ldflags=(),
             proc = subprocess.run(cmd, capture_output=True, text=True,
                                   timeout=600)
         except (FileNotFoundError, subprocess.TimeoutExpired) as e:
-            last_err = repr(e)
-            continue
+            spawn_err = repr(e)
+            continue  # try the next toolchain name
         if proc.returncode == 0:
             return out
-        last_err = proc.stderr[-2000:]
-    raise BuildError(f"compilation failed: {last_err}")
+        # a real compiler diagnostic: report it rather than trying another
+        # compiler and risking burying it under a FileNotFoundError
+        compile_err = proc.stderr[-2000:]
+        break
+    raise BuildError(f"compilation failed: {compile_err or spawn_err}")
 
 
 class CppExtensionModule:
@@ -109,6 +131,12 @@ class CppExtensionModule:
             if len(xs) != n_inputs:
                 raise TypeError(
                     f"{op_name} takes {n_inputs} input(s), got {len(xs)}")
+            if any(x.shape != xs[0].shape for x in xs[1:]):
+                # the C ABI iterates arrays[0].size over every pointer: a
+                # smaller input would be read out of bounds
+                raise TypeError(
+                    f"{op_name}: all inputs must share one shape, got "
+                    f"{[tuple(x.shape) for x in xs]}")
             spec = jax.ShapeDtypeStruct(xs[0].shape, np.float32)
             return jax.pure_callback(
                 lambda *a: _call_c(fwd_c, *a), spec,
@@ -179,6 +207,7 @@ def setup(name=None, ext_modules=(), **kw):
         path = _compile(ext_name, ext.sources,
                         tuple(ext.extra_compile_args),
                         tuple(ext.extra_link_args),
-                        tuple(ext.include_dirs), build_directory=outdir)
+                        tuple(ext.include_dirs), build_directory=outdir,
+                        versioned=False)
         built.append(path)
     return built

@@ -101,3 +101,29 @@ class TestCppExtension:
         bad.write_text("this is not C++")
         with pytest.raises(BuildError, match="compilation failed"):
             load("t_bad", [str(bad)], build_directory=str(tmp_path))
+
+    def test_reload_after_edit_gets_new_code(self, tmp_path):
+        """load() versions the .so by source hash: editing the source and
+        re-loading must run the NEW code (no stale dlopen cache)."""
+        src = tmp_path / "v.cc"
+        src.write_text('#include <cstdint>\nextern "C" void get_v('
+                       'const float* x, float* y, int64_t n) '
+                       '{ for (int64_t i=0;i<n;++i) y[i] = 1.0f; }')
+        m1 = load("t_ver", [str(src)], build_directory=str(tmp_path))
+        op1 = m1.def_op("t_ver_op1", "get_v")
+        src.write_text('#include <cstdint>\nextern "C" void get_v('
+                       'const float* x, float* y, int64_t n) '
+                       '{ for (int64_t i=0;i<n;++i) y[i] = 2.0f; }')
+        m2 = load("t_ver", [str(src)], build_directory=str(tmp_path))
+        op2 = m2.def_op("t_ver_op2", "get_v")
+        assert m1.path != m2.path  # distinct versioned artifacts
+        x = paddle.to_tensor(np.zeros(3, "float32"))
+        np.testing.assert_allclose(op1(x).numpy(), 1.0)
+        np.testing.assert_allclose(op2(x).numpy(), 2.0)
+
+    def test_mismatched_shapes_rejected(self, ext):
+        op = ext.def_op("t_scaled_add2", "scaled_add", n_inputs=2)
+        a = paddle.to_tensor(np.ones((2, 3), "float32"))
+        b = paddle.to_tensor(np.ones((3,), "float32"))
+        with pytest.raises(TypeError, match="share one shape"):
+            op(a, b)

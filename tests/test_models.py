@@ -120,6 +120,41 @@ class TestLlama:
         assert m.llama.embed_tokens.weight.grad is not None
 
 
+    def test_gpt_fused_head_ce_matches_standard(self):
+        """GPT's fused_head_ce path must match the materialized-logits
+        criterion (same loss + grads), tied and untied."""
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+        for tied in (True, False):
+            kw = dict(vocab_size=96, hidden_size=48, num_hidden_layers=2,
+                      num_attention_heads=4, max_position_embeddings=32,
+                      hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0,
+                      tie_word_embeddings=tied)
+            paddle.seed(11)
+            m_std = GPTForCausalLM(GPTConfig(**kw))
+            paddle.seed(11)
+            m_fused = GPTForCausalLM(GPTConfig(fused_head_ce=True, **kw))
+            m_std.eval(); m_fused.eval()
+            r = np.random.RandomState(4)
+            ids = paddle.to_tensor(r.randint(0, 96, (2, 17)))
+            labels = paddle.to_tensor(r.randint(0, 96, (2, 17)))
+
+            loss_s, logits = m_std(ids, labels=labels)
+            loss_f, none_logits = m_fused(ids, labels=labels)
+            assert logits is not None and none_logits is None
+            np.testing.assert_allclose(float(loss_s), float(loss_f),
+                                       rtol=1e-5, atol=1e-6)
+            loss_s.backward(); loss_f.backward()
+            for (n1, p1), (n2, p2) in zip(m_std.named_parameters(),
+                                          m_fused.named_parameters()):
+                if p1.grad is None:
+                    continue
+                np.testing.assert_allclose(
+                    p1.grad.numpy(), p2.grad.numpy(), rtol=2e-4, atol=2e-5,
+                    err_msg=f"grad mismatch {n1} (tied={tied})")
+
+
 class TestLlamaParallel:
     def test_tp_matches_single(self):
         # same seed -> same init -> TP forward must match the plain forward

@@ -24,27 +24,30 @@ RESULTS = os.path.join(ROOT, "tools", "sweep_results.jsonl")
 
 # name -> env overrides. The flagship default is hidden=2048 L8 S2048 B8,
 # full-granularity per-layer remat, 512x512 flash tiles.
+# Ordered by expected MFU gain per tunnel-minute (the tunnel can die at any
+# point — the dict order IS the run order, so a short window still yields
+# the most valuable data points first).
 VARIANTS = {
     # remat is the biggest lever: full remat re-runs the whole fwd (~8N/6N
     # actual-to-counted FLOPs => MFU ceiling ~0.75 of utilisation); core_attn
     # keeps matmul outputs resident; none removes recompute entirely.
     "remat_core_attn": {"BENCH_REMAT_GRAN": "core_attn"},
+    # fused LM-head + chunked CE: drops the [B,S,V] logits materialization
+    # (models/llama.py fused_head_ce) — frees HBM for bigger batch/remat-off
+    "fused_ce": {"BENCH_FUSED_CE": "1"},
+    "fused_ce_b16_core_attn": {"BENCH_FUSED_CE": "1", "BENCH_BATCH": "16",
+                               "BENCH_REMAT_GRAN": "core_attn"},
+    # batch scaling (memory permitting)
+    "batch16": {"BENCH_BATCH": "16"},
+    "fused_ce_batch16": {"BENCH_FUSED_CE": "1", "BENCH_BATCH": "16"},
     "remat_off": {"BENCH_REMAT": "0"},
+    "batch16_remat_off": {"BENCH_BATCH": "16", "BENCH_REMAT": "0"},
     # flash tile shapes around the measured 512x512 optimum
     "flash_q1024_k512": {"PADDLE_TPU_FLASH_BLOCK_Q": "1024"},
     "flash_q512_k1024": {"PADDLE_TPU_FLASH_BLOCK_K": "1024"},
     "flash_q256_k512": {"PADDLE_TPU_FLASH_BLOCK_Q": "256"},
-    # batch scaling (memory permitting)
-    "batch16": {"BENCH_BATCH": "16"},
-    "batch16_remat_off": {"BENCH_BATCH": "16", "BENCH_REMAT": "0"},
     # long-context leg
     "seq4096_b4": {"BENCH_SEQ": "4096", "BENCH_BATCH": "4"},
-    # fused LM-head + chunked CE: drops the [B,S,V] logits materialization
-    # (models/llama.py fused_head_ce) — frees HBM for bigger batch/remat-off
-    "fused_ce": {"BENCH_FUSED_CE": "1"},
-    "fused_ce_batch16": {"BENCH_FUSED_CE": "1", "BENCH_BATCH": "16"},
-    "fused_ce_b16_core_attn": {"BENCH_FUSED_CE": "1", "BENCH_BATCH": "16",
-                               "BENCH_REMAT_GRAN": "core_attn"},
 }
 
 
