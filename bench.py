@@ -108,9 +108,13 @@ def _run_worker(extra_env: dict, timeout: int, allow_overtime: bool = False):
 def _probe_backend(timeout: int):
     """Cheap subprocess probe: can the default backend initialize and run one op?
     Bounds the cost of a hanging TPU tunnel before we commit to a full bench run."""
+    # fetch a VALUE as the fence: the tunneled backend's block_until_ready
+    # returns before execution (PERF.md round-4), so a probe built on it
+    # could claim OK while execution hangs
     code = ("import jax, jax.numpy as jnp; d = jax.devices()[0]; "
-            "x = (jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready(); "
-            "print('PROBE_OK', d.platform)")
+            "x = jnp.ones((8, 8)) @ jnp.ones((8, 8)); "
+            "v = jax.device_get(jnp.ravel(x)[:1]); "
+            "print('PROBE_OK', d.platform, float(v[0]))")
     try:
         proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
                               text=True, timeout=timeout, env=dict(os.environ))

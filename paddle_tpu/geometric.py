@@ -128,3 +128,156 @@ def send_ue_recv(x, e, src_index, dst_index, message_op="add", reduce_op="sum",
     return _send_ue_recv(x, e, src_index, dst_index, message_op=message_op,
                          reduce_op=reduce_op,
                          out_size=int(out_size) if out_size else 0)
+
+
+@defop("graph_send_uv")
+def _send_uv(x, y, src_index, dst_index, message_op="add"):
+    xs = x[src_index.astype(jnp.int32)]
+    yd = y[dst_index.astype(jnp.int32)]
+    if message_op == "add":
+        return xs + yd
+    if message_op == "sub":
+        return xs - yd
+    if message_op == "mul":
+        return xs * yd
+    if message_op == "div":
+        return xs / yd
+    raise ValueError(f"unknown message_op {message_op!r}")
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message from source and destination node features
+    (reference geometric/message_passing/send_recv.py:413 send_uv):
+    out[i] = op(x[src_index[i]], y[dst_index[i]])."""
+    return _send_uv(x, y, src_index, dst_index, message_op=message_op)
+
+
+def _reindex(x_np, neigh_np, count_np=None):
+    """Renumber: input nodes first, then neighbors by first appearance.
+    Returns (reindex_src, reindex_dst, out_nodes); reindex_dst is None when
+    count_np is (the heterogeneous caller builds its own per-type repeat)."""
+    import numpy as np
+
+    mapping = {int(v): i for i, v in enumerate(x_np)}
+    out_nodes = list(x_np)
+    reindex_src = np.empty(len(neigh_np), np.int64)
+    for i, v in enumerate(neigh_np):
+        v = int(v)
+        idx = mapping.get(v)
+        if idx is None:
+            idx = len(out_nodes)
+            mapping[v] = idx
+            out_nodes.append(v)
+        reindex_src[i] = idx
+    reindex_dst = (np.repeat(np.arange(len(x_np), dtype=np.int64), count_np)
+                   if count_np is not None else None)
+    return reindex_src, reindex_dst, np.asarray(out_nodes, x_np.dtype)
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Renumber sampled subgraph nodes from 0 (reference reindex.py:34):
+    input nodes first, then neighbors in order of first appearance. Returns
+    (reindex_src, reindex_dst, out_nodes). Host-side by nature — the output
+    size is data-dependent (graph sampling is a data-pipeline step)."""
+    import numpy as np
+
+    x_np = np.asarray(getattr(x, "value", x)).reshape(-1)
+    neigh_np = np.asarray(getattr(neighbors, "value", neighbors)).reshape(-1)
+    count_np = np.asarray(getattr(count, "value", count)).reshape(-1)
+    rs, rd, out = _reindex(x_np, neigh_np, count_np)
+    return Tensor(jnp.asarray(rs)), Tensor(jnp.asarray(rd)), \
+        Tensor(jnp.asarray(out))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """reference reindex.py:153 — reindex a heterogeneous sampled graph:
+    per-edge-type neighbor/count lists share ONE node renumbering."""
+    import numpy as np
+
+    x_np = np.asarray(getattr(x, "value", x)).reshape(-1)
+    neighs = [np.asarray(getattr(n, "value", n)).reshape(-1)
+              for n in neighbors]
+    counts = [np.asarray(getattr(c, "value", c)).reshape(-1) for c in count]
+    # each edge type carries its own per-input-node count vector; the dst
+    # index is the per-type repeat, concatenated in type order
+    rd = np.concatenate([
+        np.repeat(np.arange(len(x_np), dtype=np.int64), c) for c in counts])
+    rs, _, out = _reindex(x_np, np.concatenate(neighs))
+    return Tensor(jnp.asarray(rs)), Tensor(jnp.asarray(rd)), \
+        Tensor(jnp.asarray(out))
+
+
+def _sample_from_csc(row, colptr, input_nodes, sample_size, eids=None,
+                     weights=None, seed=None):
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    out_n, out_c, out_e = [], [], []
+    for node in input_nodes:
+        beg, end = int(colptr[node]), int(colptr[node + 1])
+        idx = np.arange(beg, end)
+        if 0 <= sample_size < len(idx):
+            if weights is not None:
+                w = np.asarray(weights[beg:end], np.float64)
+                p = w / w.sum() if w.sum() > 0 else None
+                idx = rng.choice(idx, size=sample_size, replace=False, p=p)
+            else:
+                idx = rng.choice(idx, size=sample_size, replace=False)
+        out_n.append(row[idx])
+        out_c.append(len(idx))
+        if eids is not None:
+            out_e.append(eids[idx])
+    dt = np.asarray(row).dtype
+    neigh = np.concatenate(out_n) if out_n else np.empty(0, dt)
+    cnt = np.asarray(out_c, dt)
+    es = None
+    if eids is not None:  # empty input_nodes still yields an empty eids
+        es = np.concatenate(out_e) if out_e else np.empty(0, dt)
+    return neigh, cnt, es
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Uniform neighbor sampling over a CSC graph (reference
+    sampling/neighbors.py:30). Returns (out_neighbors, out_count[, out_eids]).
+    Host-side: output size is data-dependent."""
+    import numpy as np
+
+    if return_eids and eids is None:
+        raise ValueError("return_eids=True needs eids")
+    row_np = np.asarray(getattr(row, "value", row)).reshape(-1)
+    col_np = np.asarray(getattr(colptr, "value", colptr)).reshape(-1)
+    in_np = np.asarray(getattr(input_nodes, "value", input_nodes)).reshape(-1)
+    e_np = (np.asarray(getattr(eids, "value", eids)).reshape(-1)
+            if eids is not None else None)
+    neigh, cnt, es = _sample_from_csc(row_np, col_np, in_np,
+                                      int(sample_size), e_np)
+    outs = (Tensor(jnp.asarray(neigh)), Tensor(jnp.asarray(cnt)))
+    if return_eids:
+        return (*outs, Tensor(jnp.asarray(es)))
+    return outs
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Weight-proportional neighbor sampling without replacement (reference
+    sampling/neighbors.py weighted_sample_neighbors)."""
+    import numpy as np
+
+    if return_eids and eids is None:
+        raise ValueError("return_eids=True needs eids")
+    row_np = np.asarray(getattr(row, "value", row)).reshape(-1)
+    col_np = np.asarray(getattr(colptr, "value", colptr)).reshape(-1)
+    w_np = np.asarray(getattr(edge_weight, "value", edge_weight)).reshape(-1)
+    in_np = np.asarray(getattr(input_nodes, "value", input_nodes)).reshape(-1)
+    e_np = (np.asarray(getattr(eids, "value", eids)).reshape(-1)
+            if eids is not None else None)
+    neigh, cnt, es = _sample_from_csc(row_np, col_np, in_np,
+                                      int(sample_size), e_np, weights=w_np)
+    outs = (Tensor(jnp.asarray(neigh)), Tensor(jnp.asarray(cnt)))
+    if return_eids:
+        return (*outs, Tensor(jnp.asarray(es)))
+    return outs

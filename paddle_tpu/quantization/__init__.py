@@ -285,3 +285,93 @@ class PTQ:
 
     def convert(self, model, inplace=True):
         return QAT(self.config).convert(model, inplace=inplace)
+
+
+class BaseQuanter(Layer):
+    """reference quantization/base_quanter.py:29 — the extension base for
+    custom quanters: subclasses implement forward (fake-quantized output),
+    scales(), zero_points(), quant_axis(), bit_length()."""
+
+    def forward(self, input):  # noqa: A002 - reference arg name
+        raise NotImplementedError
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        raise NotImplementedError
+
+    def quant_axis(self):
+        return -1
+
+    def bit_length(self):
+        return 8
+
+
+class BaseObserver(BaseQuanter):
+    """reference quantization/base_observer.py:23 — a quanter that
+    calibrates: cal_thresholds() finalizes observed statistics."""
+
+    def cal_thresholds(self):
+        raise NotImplementedError
+
+
+class QuanterFactory:
+    """What the ``@quanter`` annotation's alias produces when instantiated:
+    a zero-arg factory holding the constructor args — exactly the callable
+    ``QuantConfig(activation=..., weight=...)`` expects (quanters_for calls
+    it once per wrapped layer). ``instance()`` is the reference-style
+    explicit spelling of the same thing."""
+
+    def __init__(self, cls, args, kwargs):
+        self._cls = cls
+        self._args = args
+        self._kwargs = kwargs
+
+    def __call__(self):
+        return self._cls(*self._args, **self._kwargs)
+
+    instance = __call__
+
+    def __repr__(self):
+        return f"QuanterFactory({self._cls.__name__})"
+
+
+def quanter(class_name):
+    """reference quantization/factory.py:78 — declare a factory alias for a
+    custom quanter class:
+
+        @quanter("CustomizedQuanter")
+        class CustomizedQuanterLayer(BaseQuanter): ...
+
+    creates ``CustomizedQuanter`` in the layer's module and in
+    ``paddle.quantization``; calling it with constructor args returns a
+    zero-arg QuanterFactory ready for ``QuantConfig(activation=...,
+    weight=...)`` (QuantConfig invokes it once per wrapped layer).
+    """
+    import sys
+
+    def deco(cls):
+        def factory(*args, **kwargs):
+            return QuanterFactory(cls, args, kwargs)
+
+        factory.__name__ = class_name
+        factory.__qualname__ = class_name
+        factory.__doc__ = f"Factory for {cls.__name__} (quanter annotation)."
+        existing = globals().get(class_name)
+        if existing is not None:
+            raise ValueError(
+                f"@quanter({class_name!r}): paddle.quantization already "
+                "exports that name; pick another factory name")
+        # install into the decorated class's module (the reference contract:
+        # the factory is importable from where the layer is defined)
+        mod = sys.modules.get(cls.__module__)
+        if mod is not None and not hasattr(mod, class_name):
+            setattr(mod, class_name, factory)
+        globals()[class_name] = factory
+        return cls
+
+    return deco
+
+
+__all__ += ["BaseQuanter", "BaseObserver", "quanter", "QuanterFactory"]

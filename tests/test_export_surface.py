@@ -6,6 +6,8 @@ named stragglers: paddle.version, paddle.callbacks, eager paddle.profiler,
 shard_scaler, set_flags unknown-flag policy, TensorArray landing pad.
 """
 import numpy as np
+import os
+
 import pytest
 
 import paddle_tpu as paddle
@@ -189,3 +191,82 @@ class TestUtilsAndHub:
     def test_base_shim(self):
         assert paddle.base.Program is paddle.static.Program
         assert paddle.base.in_dygraph_mode() in (True, False)
+
+
+class TestSecondLevelNamespaceParity:
+    """Every name in the reference's second-level __all__ lists must exist
+    here (parsed live from /root/reference, like TestReferenceAllParity)."""
+
+    REF = "/root/reference/python/paddle"
+    MODULES = [
+        "nn/__init__.py", "nn/functional/__init__.py",
+        "distributed/__init__.py", "optimizer/__init__.py",
+        "vision/__init__.py", "io/__init__.py", "amp/__init__.py",
+        "jit/__init__.py", "sparse/__init__.py", "signal.py", "fft.py",
+        "linalg.py", "profiler/__init__.py", "metric/__init__.py",
+        "distribution/__init__.py", "autograd/__init__.py",
+        "incubate/__init__.py", "quantization/__init__.py", "text/__init__.py",
+        "audio/__init__.py", "geometric/__init__.py", "utils/__init__.py",
+    ]
+
+    @staticmethod
+    def _ref_all(relpath):
+        """Names contributed to __all__ by literal assigns, += and
+        .extend(...) calls — anything non-literal contributes nothing, so a
+        floor assertion below guards against the check going vacuous."""
+        import ast
+
+        path = os.path.join(TestSecondLevelNamespaceParity.REF, relpath)
+        try:
+            tree = ast.parse(open(path).read())
+        except (OSError, SyntaxError):
+            return []
+
+        def literals(node):
+            if isinstance(node, (ast.List, ast.Tuple)):
+                return [e.value for e in node.elts
+                        if isinstance(e, ast.Constant)]
+            if isinstance(node, ast.BinOp):  # a + b
+                return literals(node.left) + literals(node.right)
+            return []
+
+        names = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and any(
+                    getattr(t, "id", None) == "__all__"
+                    for t in node.targets):
+                names.extend(literals(node.value))
+            elif (isinstance(node, ast.AugAssign)
+                  and getattr(node.target, "id", None) == "__all__"):
+                names.extend(literals(node.value))
+            elif (isinstance(node, ast.Expr)
+                  and isinstance(node.value, ast.Call)
+                  and isinstance(node.value.func, ast.Attribute)
+                  and node.value.func.attr == "extend"
+                  and getattr(node.value.func.value, "id", None) == "__all__"
+                  and node.value.args):
+                names.extend(literals(node.value.args[0]))
+        return names
+
+    @pytest.mark.skipif(not os.path.isdir("/root/reference"),
+                        reason="reference tree not present")
+    def test_all_names_exist(self):
+        import importlib
+
+        missing = {}
+        total = 0
+        for rel in self.MODULES:
+            names = self._ref_all(rel)
+            total += len(names)
+            mod_name = ("paddle_tpu." +
+                        rel.replace("/__init__.py", "").replace(".py", "")
+                        .replace("/", "."))
+            mod = importlib.import_module(mod_name)
+            bad = [n for n in names if not hasattr(mod, n)]
+            if bad:
+                missing[rel] = bad
+        assert not missing, missing
+        # vacuousness guard: the 22 reference namespaces currently yield
+        # ~596 literal __all__ names; a parser regression that silently
+        # drops most of them must fail loudly
+        assert total > 450, f"only {total} names parsed from the reference"

@@ -450,3 +450,137 @@ def test_overlap_add_axis0_ndim3_layout():
     assert out.shape == [9, 2]
     # interiors overlap once: frame_len 3, hop 2 -> positions 2,4,6 sum 2
     np.testing.assert_allclose(out.numpy()[2], [2.0, 2.0])
+
+
+class TestGeometricSampling:
+    """Graph sampling/reindex APIs (reference geometric/{reindex.py:34,153,
+    sampling/neighbors.py:30, message_passing/send_recv.py:413})."""
+
+    def test_send_uv_reference_example(self):
+        import paddle_tpu.geometric as G
+
+        x = paddle.to_tensor(np.array([[0, 2, 3], [1, 4, 5], [2, 6, 7]],
+                                      "float32"))
+        y = paddle.to_tensor(np.array([[0, 1, 2], [2, 3, 4], [4, 5, 6]],
+                                      "float32"))
+        src = paddle.to_tensor(np.array([0, 1, 2, 0]))
+        dst = paddle.to_tensor(np.array([1, 2, 1, 0]))
+        out = G.send_uv(x, y, src, dst, "add")
+        np.testing.assert_array_equal(
+            out.numpy(), [[2, 5, 7], [5, 9, 11], [4, 9, 11], [0, 3, 5]])
+
+    def test_reindex_graph_reference_example(self):
+        import paddle_tpu.geometric as G
+
+        xs = paddle.to_tensor(np.array([0, 1, 2]))
+        nb = paddle.to_tensor(np.array([8, 9, 0, 4, 7, 6, 7]))
+        ct = paddle.to_tensor(np.array([2, 3, 2]))
+        rs, rd, on = G.reindex_graph(xs, nb, ct)
+        np.testing.assert_array_equal(rs.numpy(), [3, 4, 0, 5, 6, 7, 6])
+        np.testing.assert_array_equal(rd.numpy(), [0, 0, 1, 1, 1, 2, 2])
+        np.testing.assert_array_equal(on.numpy(), [0, 1, 2, 8, 9, 4, 7, 6])
+
+    def test_reindex_heter_graph_shares_renumbering(self):
+        import paddle_tpu.geometric as G
+
+        xs = paddle.to_tensor(np.array([0, 1, 2]))
+        nb = paddle.to_tensor(np.array([8, 9, 0, 4, 7, 6, 7]))
+        ct = paddle.to_tensor(np.array([2, 3, 2]))
+        rs, rd, on = G.reindex_graph(xs, nb, ct)
+        rs2, rd2, on2 = G.reindex_heter_graph(xs, [nb, nb], [ct, ct])
+        np.testing.assert_array_equal(on2.numpy(), on.numpy())
+        np.testing.assert_array_equal(
+            rs2.numpy(), np.concatenate([rs.numpy(), rs.numpy()]))
+        np.testing.assert_array_equal(
+            rd2.numpy(), np.concatenate([rd.numpy(), rd.numpy()]))
+
+    def test_sample_neighbors(self):
+        import paddle_tpu.geometric as G
+
+        row = paddle.to_tensor(
+            np.array([3, 7, 0, 9, 1, 4, 2, 9, 3, 9, 1, 9, 7], "int64"))
+        colptr = paddle.to_tensor(
+            np.array([0, 2, 4, 5, 6, 7, 9, 11, 11, 13, 13], "int64"))
+        nodes = paddle.to_tensor(np.array([0, 8, 1, 2], "int64"))
+        nb, cnt = G.sample_neighbors(row, colptr, nodes, sample_size=2)
+        assert cnt.numpy().tolist() == [2, 2, 2, 1]
+        assert len(nb.numpy()) == 7
+        # sample_size=-1 returns every neighbor
+        nb_all, cnt_all = G.sample_neighbors(row, colptr, nodes)
+        assert cnt_all.numpy().tolist() == [2, 2, 2, 1]
+
+    def test_weighted_sample_neighbors_with_eids(self):
+        import paddle_tpu.geometric as G
+
+        row = paddle.to_tensor(
+            np.array([3, 7, 0, 9, 1, 4, 2, 9, 3, 9, 1, 9, 7], "int64"))
+        colptr = paddle.to_tensor(
+            np.array([0, 2, 4, 5, 6, 7, 9, 11, 11, 13, 13], "int64"))
+        nodes = paddle.to_tensor(np.array([0, 6, 8], "int64"))
+        w = paddle.to_tensor(np.arange(1.0, 14.0, dtype="float32"))
+        eids = paddle.to_tensor(np.arange(13, dtype="int64"))
+        nb, cnt, es = G.weighted_sample_neighbors(
+            row, colptr, w, nodes, sample_size=1, eids=eids,
+            return_eids=True)
+        assert len(es.numpy()) == int(cnt.numpy().sum())
+        with pytest.raises(ValueError):
+            G.weighted_sample_neighbors(row, colptr, w, nodes,
+                                        return_eids=True)
+
+
+class TestQuanterFactory:
+    def test_quanter_annotation_and_bases(self):
+        from paddle_tpu.quantization import BaseObserver, BaseQuanter, quanter
+
+        @quanter("TQuanterFactory")
+        class TQuanterLayer(BaseQuanter):
+            def __init__(self, k=1):
+                super().__init__()
+                self.k = k
+
+            def forward(self, t):
+                return t
+
+            def scales(self):
+                return None
+
+            def zero_points(self):
+                return None
+
+        import paddle_tpu.quantization as Q
+
+        handle = Q.TQuanterFactory(k=5)  # zero-arg factory (QuantConfig contract)
+        inst = handle()
+        assert isinstance(inst, TQuanterLayer) and inst.k == 5
+        assert isinstance(handle.instance(), TQuanterLayer)
+        assert inst.bit_length() == 8 and inst.quant_axis() == -1
+        assert issubclass(BaseObserver, BaseQuanter)
+        # QuantConfig can consume the handle directly
+        cfg = Q.QuantConfig(activation=handle, weight=handle)
+        lin = paddle.nn.Linear(2, 2)
+        a, w = cfg.quanters_for(lin)
+        assert isinstance(a, TQuanterLayer) and isinstance(w, TQuanterLayer)
+        # factory names may not clobber real exports
+        with pytest.raises(ValueError, match="already exports"):
+            Q.quanter("QuantConfig")(TQuanterLayer)
+
+
+class TestRequireVersion:
+    def test_require_version(self):
+        paddle.utils.require_version("0.0.0")
+        with pytest.raises(Exception, match="min_version"):
+            paddle.utils.require_version("999.0.0")
+        with pytest.raises(Exception, match="max_version"):
+            paddle.utils.require_version("0.0.0", max_version="0.0.0.dev")
+
+    def test_sampling_empty_inputs_with_eids(self):
+        import paddle_tpu.geometric as G
+
+        row = paddle.to_tensor(np.array([1, 2], "int64"))
+        colptr = paddle.to_tensor(np.array([0, 1, 2], "int64"))
+        empty = paddle.to_tensor(np.empty(0, "int64"))
+        eids = paddle.to_tensor(np.array([5, 6], "int64"))
+        nb, cnt, es = G.sample_neighbors(row, colptr, empty, sample_size=1,
+                                         eids=eids, return_eids=True)
+        assert len(nb.numpy()) == 0 and len(cnt.numpy()) == 0
+        assert len(es.numpy()) == 0
