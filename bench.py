@@ -371,7 +371,14 @@ def _decode_bench(model, cfg, on_tpu):
 
     batch = 8 if on_tpu else 2
     prefill, steps = (128, 32) if on_tpu else (16, 8)
-    eng = LlamaDecodeEngine(model, max_len=prefill + steps + 1)
+    # BENCH_DECODE_KV=int8 measures the quantized KV cache (half the KV
+    # read bandwidth — the decode bottleneck); any other value (bf16/fp16/
+    # unset) runs the full-precision default
+    kv_env = (os.environ.get("BENCH_DECODE_KV") or "").strip().lower()
+    kv_dtype = "int8" if kv_env == "int8" else None
+    eng = LlamaDecodeEngine(model, max_len=prefill + steps + 1,
+                            kv_cache_dtype=kv_dtype)
+    kv_label = "int8" if kv_dtype else str(eng.emb.dtype)
     r = np.random.RandomState(0)
     ids = r.randint(0, cfg.vocab_size, (batch, prefill)).astype("int32")
 
@@ -395,7 +402,7 @@ def _decode_bench(model, cfg, on_tpu):
     dt = time.perf_counter() - t0
     return {
         "batch": batch, "prefill": prefill, "steps": steps,
-        "force_every": force_every,
+        "force_every": force_every, "kv_cache": kv_label,
         "ms_per_token": round(dt / steps * 1e3, 3),
         "tokens_per_sec": round(batch * steps / dt, 1),
     }

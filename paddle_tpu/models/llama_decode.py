@@ -50,9 +50,18 @@ def _rope_at(x, positions, theta):
 class LlamaDecodeEngine:
     """Greedy/temperature decoding with a per-layer KV cache."""
 
-    def __init__(self, model, max_len=None):
+    def __init__(self, model, max_len=None, kv_cache_dtype=None):
+        """``kv_cache_dtype="int8"`` stores K/V quantized per (token, head)
+        with fp32 absmax scales: half the KV-cache HBM footprint and read
+        bandwidth — decode attention is KV-bandwidth-bound, so this is the
+        serving lever (the reference's cache-KV int8 capability in
+        quantized inference); dequantization happens after the int8 loads,
+        inside the compiled step."""
         cfg = model.config
         self.config = cfg
+        if kv_cache_dtype not in (None, "int8"):
+            raise ValueError(f"unsupported kv_cache_dtype {kv_cache_dtype!r}")
+        self.kv_int8 = kv_cache_dtype == "int8"
         self.max_len = int(max_len or cfg.max_position_embeddings)
         self.num_heads = cfg.num_attention_heads
         self.num_kv = cfg.num_key_value_heads
@@ -91,9 +100,48 @@ class LlamaDecodeEngine:
     # -- cache ---------------------------------------------------------------
     def init_cache(self, batch):
         shape = (batch, self.max_len, self.num_kv, self.head_dim)
+        if self.kv_int8:
+            sshape = shape[:-1]  # one absmax scale per (token, kv head)
+            return [(jnp.zeros(shape, jnp.int8), jnp.zeros(sshape, jnp.float32),
+                     jnp.zeros(shape, jnp.int8), jnp.zeros(sshape, jnp.float32))
+                    for _ in self.layers]
         dt = self.emb.dtype
         return [(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
                 for _ in self.layers]
+
+    @staticmethod
+    def _quantize_kv(x):
+        """(B, S, H, D) -> int8 values + per-(token, head) fp32 scales."""
+        scale = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1) / 127.0
+        scale = jnp.maximum(scale, 1e-8)
+        q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
+                     -127, 127).astype(jnp.int8)
+        return q, scale
+
+    def _attend_int8(self, q, ck_q, ck_s, cv_q, cv_s, pos_mask):
+        """Attention over the int8 cache WITHOUT materializing a
+        dequantized copy (that would re-create the full-precision HBM
+        traffic the int8 cache exists to remove): the per-(token, head)
+        scales fold into the score and value einsums —
+        logits[b,h,s,t] = (q . k_q) * ck_s[b,t,h];
+        out = (probs * cv_s)[b,h,s,t] @ v_q[b,t,h,d]."""
+        rep = self.num_heads // self.num_kv
+        if rep > 1:
+            ck_q = jnp.repeat(ck_q, rep, axis=2)
+            cv_q = jnp.repeat(cv_q, rep, axis=2)
+            ck_s = jnp.repeat(ck_s, rep, axis=2)
+            cv_s = jnp.repeat(cv_s, rep, axis=2)
+        logits = jnp.einsum("bshd,bthd->bhst", q, ck_q.astype(q.dtype))
+        logits = (logits.astype(jnp.float32)
+                  * jnp.transpose(ck_s, (0, 2, 1))[:, :, None, :]
+                  / np.sqrt(self.head_dim))
+        logits = jnp.where(pos_mask[:, None, :, :], logits,
+                           jnp.asarray(-1e30, logits.dtype))
+        probs = jax.nn.softmax(logits, -1)
+        pv = probs * jnp.transpose(cv_s, (0, 2, 1))[:, :, None, :]
+        out = jnp.einsum("bhst,bthd->bshd", pv.astype(q.dtype),
+                         cv_q.astype(q.dtype))
+        return out
 
     # -- functional blocks ---------------------------------------------------
     def _attend(self, q, ck, cv, pos_mask):
@@ -116,15 +164,27 @@ class LlamaDecodeEngine:
         v = (h @ p["wv"]).reshape(B, S, self.num_kv, self.head_dim)
         q = _rope_at(q, positions, self.theta)
         k = _rope_at(k, positions, self.theta)
-        ck, cv = cache_kv
         start = positions[0]
-        ck = lax.dynamic_update_slice(ck, k, (0, start, 0, 0))
-        cv = lax.dynamic_update_slice(cv, v, (0, start, 0, 0))
-        attn = self._attend(q, ck, cv, pos_mask)
+        if self.kv_int8:
+            ck_q, ck_s, cv_q, cv_s = cache_kv
+            kq, ks = self._quantize_kv(k)
+            vq, vs = self._quantize_kv(v)
+            ck_q = lax.dynamic_update_slice(ck_q, kq, (0, start, 0, 0))
+            ck_s = lax.dynamic_update_slice(ck_s, ks, (0, start, 0))
+            cv_q = lax.dynamic_update_slice(cv_q, vq, (0, start, 0, 0))
+            cv_s = lax.dynamic_update_slice(cv_s, vs, (0, start, 0))
+            new_cache = (ck_q, ck_s, cv_q, cv_s)
+            attn = self._attend_int8(q, ck_q, ck_s, cv_q, cv_s, pos_mask)
+        else:
+            ck, cv = cache_kv
+            ck = lax.dynamic_update_slice(ck, k, (0, start, 0, 0))
+            cv = lax.dynamic_update_slice(cv, v, (0, start, 0, 0))
+            new_cache = (ck, cv)
+            attn = self._attend(q, ck, cv, pos_mask)
         x = x + attn.reshape(B, S, -1) @ p["wo"]
         h2 = _rms(x, p["ln2"], self.eps)
         mlp = (jax.nn.silu(h2 @ p["gate"]) * (h2 @ p["up"])) @ p["down"]
-        return x + mlp, (ck, cv)
+        return x + mlp, new_cache
 
     def _forward(self, ids, cache, start_pos):
         """ids: (B, S) absolute positions start_pos..start_pos+S-1."""
@@ -224,8 +284,10 @@ class LlamaDecodeEngine:
     def _reorder_jit(self):
         @jax.jit
         def reorder(cache, flat_parent):
-            return [(jnp.take(ck, flat_parent, axis=0),
-                     jnp.take(cv, flat_parent, axis=0)) for ck, cv in cache]
+            # each layer's cache entry is a tuple of batch-major arrays
+            # ((k, v) or the int8 form (k_q, k_s, v_q, v_s))
+            return [tuple(jnp.take(a, flat_parent, axis=0) for a in entry)
+                    for entry in cache]
 
         return reorder
 
