@@ -192,10 +192,14 @@ def distributed_optimizer(optimizer, strategy=None):
         if model is not None:
             ps_opt._attach_embeddings(model)
         return ps_opt
+    from .meta_optimizers import (apply_inner_meta_optimizers,
+                                  apply_outer_meta_optimizers)
+
+    optimizer = apply_inner_meta_optimizers(optimizer, _strategy())
     hcg = get_hybrid_communicate_group()
-    if hcg is None:
-        return optimizer
-    return HybridParallelOptimizer(optimizer, hcg, _strategy())
+    if hcg is not None:
+        optimizer = HybridParallelOptimizer(optimizer, hcg, _strategy())
+    return apply_outer_meta_optimizers(optimizer, _strategy())
 
 
 def distributed_scaler(scaler):

@@ -1,0 +1,92 @@
+"""Fleet meta-optimizers: strategy-driven optimizer wrapping.
+
+Reference analog: python/paddle/distributed/fleet/meta_optimizers/ —
+GradientMergeOptimizer (apply every k steps, accumulating in between),
+LambOptimizer (swap the inner optimizer for Lamb). The GPU-era members
+(DGC sparse-compressed allreduce, LocalSGD) have no TPU analog: gradient
+reduction is compiler-emitted over ICI, so there is no NCCL ring to
+compress or desynchronize (`DistributedStrategy` accepts the flags as
+documented no-ops, like the reference's own knobs on unsupported
+hardware).
+"""
+from __future__ import annotations
+
+from ...framework.core import Tensor
+
+
+class GradientMergeOptimizer:
+    """reference meta_optimizers/gradient_merge_optimizer.py: accumulate
+    gradients for ``k_steps`` micro-steps, then apply the inner optimizer
+    once on the (optionally averaged) sum. Between applies, ``step()``
+    only banks the gradients and ``clear_grad()`` clears the per-micro
+    grads as usual."""
+
+    def __init__(self, inner, k_steps=1, avg=True):
+        self._inner = inner
+        self._k = max(1, int(k_steps))
+        self._avg = bool(avg)
+        self._step_n = 0
+        self._acc = {}  # id(param) -> accumulated raw grad value
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    @property
+    def k_steps(self):
+        return self._k
+
+    def step(self):
+        self._step_n += 1
+        params = self._inner._parameter_list_flat()
+        for p in params:
+            if p.grad is None:
+                continue
+            a = self._acc.get(id(p))
+            gv = p.grad.value
+            self._acc[id(p)] = gv if a is None else a + gv
+        if self._step_n % self._k:
+            return  # accumulation micro-step: no parameter update
+        for p in params:
+            a = self._acc.pop(id(p), None)
+            if a is None:
+                continue
+            p.grad = Tensor(a / self._k if self._avg else a)
+        self._inner.step()
+
+    def clear_grad(self, set_to_zero=True):
+        self._inner.clear_grad(set_to_zero)
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        return self._inner.minimize(loss, startup_program=startup_program,
+                                    parameters=parameters,
+                                    no_grad_set=no_grad_set)
+
+
+def apply_inner_meta_optimizers(optimizer, strategy):
+    """Meta-optimizers that REPLACE the inner optimizer (applied before
+    hybrid wrapping, so HybridParallelOptimizer's setattr hooks — clip
+    replacement, ZeRO shard fn — land on the real optimizer)."""
+    if getattr(strategy, "lamb", False):
+        from ...optimizer.optimizer import Lamb
+
+        if not isinstance(optimizer, Lamb):
+            cfg = dict(getattr(strategy, "lamb_configs", {}) or {})
+            optimizer = Lamb(
+                learning_rate=optimizer.get_lr(),
+                parameters=optimizer._parameter_list_flat(),
+                lamb_weight_decay=float(cfg.get("lamb_weight_decay", 0.01)))
+    return optimizer
+
+
+def apply_outer_meta_optimizers(optimizer, strategy):
+    """Meta-optimizers that WRAP the (possibly hybrid) optimizer: gradient
+    merge goes outermost so global-norm clipping and sharding act on the
+    MERGED gradients, and so the hybrid wrapper's attribute hooks were
+    already installed on the true inner optimizer."""
+    if getattr(strategy, "gradient_merge", False):
+        cfg = dict(getattr(strategy, "gradient_merge_configs", {}) or {})
+        optimizer = GradientMergeOptimizer(
+            optimizer, k_steps=cfg.get("k_steps", 1),
+            avg=cfg.get("avg", True))
+    return optimizer

@@ -403,3 +403,61 @@ class TestHybridClip:
         opt.step()
         # grads clipped to ~0 -> params unchanged
         np.testing.assert_allclose(col.weight.numpy(), before, atol=1e-6)
+
+
+class TestMetaOptimizers:
+    """fleet meta-optimizers (reference fleet/meta_optimizers/): strategy
+    flags that wrap or swap the inner optimizer."""
+
+    def test_gradient_merge_applies_every_k_steps(self):
+        from paddle_tpu.distributed.fleet.meta_optimizers import (
+            GradientMergeOptimizer)
+
+        paddle.seed(0)
+        lin = paddle.nn.Linear(2, 1)
+        inner = paddle.optimizer.SGD(learning_rate=0.5,
+                                     parameters=lin.parameters())
+        opt = GradientMergeOptimizer(inner, k_steps=2, avg=True)
+        x = paddle.to_tensor(np.ones((4, 2), "float32"))
+        y = paddle.to_tensor(np.zeros((4, 1), "float32"))
+        w0 = np.asarray(lin.weight.numpy()).copy()
+
+        loss = ((lin(x) - y) ** 2).mean()
+        loss.backward()
+        g1 = np.asarray(lin.weight.grad.numpy()).copy()
+        opt.step()           # micro-step 1: banked, no update
+        opt.clear_grad()
+        np.testing.assert_array_equal(lin.weight.numpy(), w0)
+
+        loss = ((lin(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()           # micro-step 2: applies the averaged grad
+        opt.clear_grad()
+        w2 = np.asarray(lin.weight.numpy())
+        assert not np.array_equal(w2, w0)
+        # both micro-grads were identical, so avg == g1: one SGD step
+        np.testing.assert_allclose(w2, w0 - 0.5 * g1, rtol=1e-6)
+
+    def test_strategy_wires_gradient_merge_and_lamb(self):
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed.fleet.meta_optimizers import (
+            GradientMergeOptimizer, apply_inner_meta_optimizers,
+            apply_outer_meta_optimizers)
+        from paddle_tpu.optimizer.optimizer import Lamb
+
+        strategy = fleet.DistributedStrategy()
+        strategy.gradient_merge = True
+        strategy.gradient_merge_configs = {"k_steps": 4, "avg": True}
+        strategy.lamb = True
+        strategy.lamb_configs = {"lamb_weight_decay": 0.02}
+        lin = paddle.nn.Linear(2, 2)
+        sgd = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=lin.parameters())
+        inner = apply_inner_meta_optimizers(sgd, strategy)
+        assert isinstance(inner, Lamb) and inner._lamb_wd == 0.02
+        opt = apply_outer_meta_optimizers(inner, strategy)
+        assert isinstance(opt, GradientMergeOptimizer)
+        assert opt.k_steps == 4
+        # gradient merge wraps OUTSIDE hybrid so the hybrid's setattr hooks
+        # (clip replacement, ZeRO shard fn) reach the true inner optimizer
+        assert opt._inner is inner

@@ -302,6 +302,21 @@ def _fused_rms_norm_fn(x, g):
     return out[0] if isinstance(out, tuple) else out
 
 
+_GSU_SRC = np.array([0, 1, 2, 0])
+_GSU_DST = np.array([1, 2, 1, 0])
+
+
+def _gsu_fn(x, y):
+    import paddle_tpu.geometric as G
+
+    return G.send_uv(x, y, paddle.to_tensor(_GSU_SRC),
+                     paddle.to_tensor(_GSU_DST), "mul")
+
+
+def _gsu_ref(x, y):
+    return x[_GSU_SRC] * y[_GSU_DST]
+
+
 _FLCE_LABELS = np.random.RandomState(11).randint(0, 13, (2, 9))
 _FLCE_LABELS[0, :2] = -100  # exercise ignore_index and the pad path (9 % 4)
 
@@ -686,6 +701,7 @@ TAIL_CASES = [
            lambda x, g: _rms_norm_fn(x, g), _rms_norm_ref, [S, (5,)]),
     OpCase("fused_rms_norm",
            lambda x, g: _fused_rms_norm_fn(x, g), _rms_norm_ref, [S, (5,)]),
+    OpCase("graph_send_uv", _gsu_fn, _gsu_ref, [(3, 5), (3, 5)]),
     OpCase("fused_linear_cross_entropy", _flce_fn, _flce_ref,
            [(2, 9, 6), (6, 13)],
            # the op fixes fp32 softmax internally; the fp64 numpy reference
