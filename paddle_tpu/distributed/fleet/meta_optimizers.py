@@ -58,9 +58,50 @@ class GradientMergeOptimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
-        return self._inner.minimize(loss, startup_program=startup_program,
-                                    parameters=parameters,
-                                    no_grad_set=no_grad_set)
+        """Backward + MERGED step. Inner.minimize would call the inner
+        step() directly and silently bypass the merge; in static capture it
+        also registers the train hook, which must point at this wrapper."""
+        from ...framework import capture
+
+        prog = capture.active()
+        if prog is not None:
+            out = self._inner.minimize(loss, startup_program=startup_program,
+                                       parameters=parameters,
+                                       no_grad_set=no_grad_set)
+            prog._train_hooks = [
+                (lt, self if opt is self._inner else opt)
+                for lt, opt in prog._train_hooks]
+            return out
+        if parameters is not None:
+            self._inner._param_groups[0]["params"] = list(parameters)
+        loss.backward()
+        self.step()
+        return None
+
+    # -- checkpointing: the banked gradients and the micro-step counter are
+    # training state (an elastic resume mid-accumulation must not lose the
+    # already-banked micro-batches) -----------------------------------------
+    def state_dict(self):
+        import numpy as np
+
+        sd = dict(self._inner.state_dict())
+        params = self._inner._parameter_list_flat()
+        acc = {i: np.asarray(self._acc[id(p)])
+               for i, p in enumerate(params) if id(p) in self._acc}
+        sd["_gradient_merge"] = {"step_n": self._step_n, "acc": acc}
+        return sd
+
+    def set_state_dict(self, sd):
+        import jax.numpy as jnp
+
+        sd = dict(sd)
+        gm = sd.pop("_gradient_merge", None)
+        self._inner.set_state_dict(sd)
+        if gm:
+            self._step_n = int(gm.get("step_n", 0))
+            params = self._inner._parameter_list_flat()
+            self._acc = {id(params[int(i)]): jnp.asarray(v)
+                         for i, v in (gm.get("acc") or {}).items()}
 
 
 def apply_inner_meta_optimizers(optimizer, strategy):
@@ -72,9 +113,14 @@ def apply_inner_meta_optimizers(optimizer, strategy):
 
         if not isinstance(optimizer, Lamb):
             cfg = dict(getattr(strategy, "lamb_configs", {}) or {})
+            # carry the inner optimizer's training contract over: the live
+            # LR scheduler object (not a frozen float), grad clip, master
+            # weights, and the param groups with their per-group options
             optimizer = Lamb(
-                learning_rate=optimizer.get_lr(),
-                parameters=optimizer._parameter_list_flat(),
+                learning_rate=optimizer._learning_rate,
+                parameters=[dict(g) for g in optimizer._param_groups],
+                grad_clip=optimizer._grad_clip,
+                multi_precision=optimizer._use_master_weights,
                 lamb_weight_decay=float(cfg.get("lamb_weight_decay", 0.01)))
     return optimizer
 

@@ -455,6 +455,10 @@ class TestMetaOptimizers:
                                    parameters=lin.parameters())
         inner = apply_inner_meta_optimizers(sgd, strategy)
         assert isinstance(inner, Lamb) and inner._lamb_wd == 0.02
+        # the training contract survives the swap
+        assert inner._grad_clip is sgd._grad_clip
+        assert inner._learning_rate == sgd._learning_rate
+        assert inner._parameter_list_flat() == sgd._parameter_list_flat()
         opt = apply_outer_meta_optimizers(inner, strategy)
         assert isinstance(opt, GradientMergeOptimizer)
         assert opt.k_steps == 4
