@@ -1,0 +1,55 @@
+"""Parameter-server training under the launcher.
+
+One script serves both roles (the reference PS idiom): the launcher spawns
+it once per server and per trainer with the TRAINING_ROLE env contract.
+
+Run (CPU box):
+    PADDLE_TPU_PLATFORM=cpu python -m paddle_tpu.distributed.launch \
+        --run_mode ps --server_num 1 --trainer_num 2 examples/ps_train.py
+
+Direct invocation (no launcher) runs a tiny single-process demo instead.
+"""
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet
+
+
+def train():
+    lin = paddle.nn.Linear(4, 1)
+    fleet.distributed_model(lin)
+    opt = fleet.distributed_optimizer(paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=lin.parameters()))
+    r = np.random.RandomState(0)
+    x = paddle.to_tensor(r.randn(32, 4).astype("float32"))
+    w = r.randn(4, 1).astype("float32")
+    y = paddle.to_tensor((np.asarray(x.value) @ w).astype("float32"))
+    for step in range(30):
+        loss = ((lin(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()          # grads push to the server; weights pull back
+        opt.clear_grad()
+        if step % 10 == 0:
+            print(f"[trainer {fleet.worker_index()}] step {step} "
+                  f"loss {float(loss):.4f}")
+    fleet.stop_worker()
+    print(f"[trainer {fleet.worker_index()}] done loss {float(loss):.4f}")
+
+
+def main():
+    if "TRAINING_ROLE" not in os.environ:
+        print("run under the launcher (see module docstring); demoing the "
+              "env contract in-process is tests/test_ps.py's job")
+        return
+    fleet.init(is_collective=False)   # role from TRAINING_ROLE
+    if fleet.is_server():
+        fleet.init_server()
+        fleet.run_server()            # blocks until trainers stop_worker()
+    else:
+        train()
+
+
+if __name__ == "__main__":
+    main()

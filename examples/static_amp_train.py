@@ -1,0 +1,46 @@
+"""Mixed-precision static-graph training: paddle.static.amp.
+
+The reference static AMP idiom — decorate the optimizer, train through
+Executor.run — ports unchanged: the capture replays under auto_cast and the
+train hook runs scaled-backward + dynamic loss scaling (fp16) or plain
+bf16 (the TPU-native dtype, no scaling needed).
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def main():
+    paddle.seed(0)
+    main_prog = paddle.static.Program()
+    startup = paddle.static.Program()
+    with paddle.static.program_guard(main_prog, startup):
+        x = paddle.static.data("x", [None, 16], "float32")
+        y = paddle.static.data("y", [None, 1], "float32")
+        net = paddle.nn.Sequential(
+            paddle.nn.Linear(16, 32), paddle.nn.ReLU(),
+            paddle.nn.Linear(32, 1))
+        loss = ((net(x) - y) ** 2).mean()
+        loss.name = "loss"
+        opt = paddle.optimizer.Momentum(learning_rate=0.01, momentum=0.9,
+                                        parameters=net.parameters())
+        opt = paddle.static.amp.decorate(opt, use_bf16=True,
+                                         use_dynamic_loss_scaling=False)
+        opt.minimize(loss)
+
+    exe = paddle.static.Executor()
+    r = np.random.RandomState(0)
+    xs = r.randn(128, 16).astype("float32")
+    w = r.randn(16, 1).astype("float32")
+    ys = (xs @ w + 0.1 * r.randn(128, 1)).astype("float32")
+    for epoch in range(40):
+        (lv,) = exe.run(main_prog, feed={"x": xs, "y": ys},
+                        fetch_list=["loss"])
+        if epoch % 10 == 0:
+            print(f"epoch {epoch}  loss {float(lv):.4f}")
+    print(f"final loss {float(lv):.4f}")
+    assert float(lv) < 1.0
+
+
+if __name__ == "__main__":
+    main()

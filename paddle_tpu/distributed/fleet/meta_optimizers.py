@@ -68,15 +68,38 @@ class GradientMergeOptimizer:
             out = self._inner.minimize(loss, startup_program=startup_program,
                                        parameters=parameters,
                                        no_grad_set=no_grad_set)
-            prog._train_hooks = [
-                (lt, self if opt is self._inner else opt)
-                for lt, opt in prog._train_hooks]
+            prog.retarget_train_hook(self._inner, self)
             return out
         if parameters is not None:
             self._inner._param_groups[0]["params"] = list(parameters)
         loss.backward()
         self.step()
-        return None
+        return None, None
+
+    def _amp_train_step(self, live_loss):
+        """Executor train-hook entry (static/__init__.py): defined on the
+        CLASS so the executor routes through the MERGED step — __getattr__
+        delegation would otherwise hand it the inner static-amp wrapper's
+        hook and apply k unmerged updates per intended merged one. Dynamic
+        fp16 loss scaling cannot compose with banking (the scale changes
+        between banked micro-steps); bf16 static AMP (no scaler) and plain
+        static programs both route here."""
+        scaler = None
+        obj = self._inner
+        seen = set()
+        while obj is not None and id(obj) not in seen:
+            seen.add(id(obj))
+            scaler = getattr(obj, "_scaler", None) or scaler
+            obj = getattr(obj, "_inner", None) or getattr(obj, "_inner_opt",
+                                                          None)
+        if scaler is not None:
+            raise NotImplementedError(
+                "gradient_merge + fp16 dynamic loss scaling in static mode "
+                "is unsupported (the loss scale would change between banked "
+                "micro-steps); use bf16 static AMP or eager mode")
+        live_loss.backward()
+        self.step()
+        self.clear_grad()
 
     # -- checkpointing: the banked gradients and the micro-step counter are
     # training state (an elastic resume mid-accumulation must not lose the
@@ -102,6 +125,12 @@ class GradientMergeOptimizer:
             params = self._inner._parameter_list_flat()
             self._acc = {id(params[int(i)]): jnp.asarray(v)
                          for i, v in (gm.get("acc") or {}).items()}
+        else:
+            # a checkpoint without merge state (e.g. from a plain inner
+            # optimizer): keeping pre-load banked grads would pollute the
+            # loaded weights with discarded training
+            self._step_n = 0
+            self._acc = {}
 
 
 def apply_inner_meta_optimizers(optimizer, strategy):

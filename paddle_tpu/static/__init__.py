@@ -145,6 +145,15 @@ class Program:
         guard (reference Program.all_parameters)."""
         return list(self._parameters)
 
+    def retarget_train_hook(self, old_opt, new_opt):
+        """Point train hooks registered for ``old_opt`` at ``new_opt`` —
+        the optimizer-wrapper idiom (static.amp decorate, fleet gradient
+        merge, the transpiler) shared in one place so the hook tuple shape
+        has a single owner."""
+        self._train_hooks = [
+            (lt, new_opt if opt is old_opt else opt)
+            for lt, opt in self._train_hooks]
+
     def global_block(self):
         return self
 

@@ -81,9 +81,7 @@ class OptimizerWithMixedPrecision:
         if prog is not None:
             # replace the inner hook registered by minimize with this
             # wrapper so Executor.run's train step goes through AMP
-            prog._train_hooks = [
-                (lt, self if opt is self._inner else opt)
-                for lt, opt in prog._train_hooks]
+            prog.retarget_train_hook(self._inner, self)
             prog._amp_ctx = {"level": self._level, "dtype": self._dtype,
                              "lists": self._amp_lists}
         return out
@@ -114,8 +112,8 @@ class OptimizerWithMixedPrecision:
     def step(self):
         self._inner.step()
 
-    def clear_grad(self):
-        self._inner.clear_grad()
+    def clear_grad(self, set_to_zero=True):
+        self._inner.clear_grad(set_to_zero)
 
 
 def decorate(optimizer, amp_lists=None, init_loss_scaling=2 ** 15,

@@ -72,13 +72,15 @@ class TestBenchContract:
                               "measured_at": "2020-01-01T00:00:00Z"}}
             with open(CACHE, "w") as f:
                 json.dump(doc, f)
+            # NO BENCH_FORCE_CPU here: the step-1 worker must genuinely
+            # fail (bogus backend) so the cache IS consulted; the expired
+            # entry must be skipped en route to the step-3 CPU fallback
             out = _run_bench({"BENCH_PROBE_TIMEOUT": "1",
                               "BENCH_TPU_ATTEMPTS": "1",
-                              "JAX_PLATFORMS": "definitely_not_a_backend",
-                              "BENCH_FORCE_CPU": "1"})
-            # fell through to the CPU fallback, not the ancient cache
+                              "JAX_PLATFORMS": "definitely_not_a_backend"})
             assert out["detail"].get("stale") is not True
             assert out["detail"]["device"] == "cpu"
+            assert "tpu_error" in out["detail"]
         finally:
             if backup is not None:
                 shutil.copy(backup, CACHE)

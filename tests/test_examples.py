@@ -66,3 +66,20 @@ def test_launch_dp_under_launcher():
          "--nproc_per_node", "2", os.path.join(EX, "launch_dp.py")],
         env=env, capture_output=True, text=True, timeout=300, cwd=REPO)
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_static_amp_train():
+    out = _run("static_amp_train.py")
+    assert "final loss" in out
+
+
+def test_ps_train_under_launcher():
+    env = dict(os.environ)
+    env["PADDLE_TPU_PLATFORM"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--run_mode", "ps", "--server_num", "1", "--trainer_num", "2",
+         os.path.join(EX, "ps_train.py")],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -465,3 +465,57 @@ class TestMetaOptimizers:
         # gradient merge wraps OUTSIDE hybrid so the hybrid's setattr hooks
         # (clip replacement, ZeRO shard fn) reach the true inner optimizer
         assert opt._inner is inner
+
+    def test_gradient_merge_composes_with_static_amp_bf16(self):
+        """GM must own the executor train hook even when the inner is a
+        static.amp wrapper — delegation would apply k unmerged updates."""
+        from paddle_tpu.distributed.fleet.meta_optimizers import (
+            GradientMergeOptimizer)
+
+        paddle.seed(0)
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data("x", [None, 4], "float32")
+            y = paddle.static.data("y", [None, 1], "float32")
+            net = paddle.nn.Linear(4, 1)
+            loss = ((net(x) - y) ** 2).mean()
+            loss.name = "loss"
+            amp_opt = paddle.static.amp.decorate(
+                paddle.optimizer.SGD(learning_rate=0.05,
+                                     parameters=net.parameters()),
+                use_bf16=True, use_dynamic_loss_scaling=False)
+            gm = GradientMergeOptimizer(amp_opt, k_steps=2)
+            gm.minimize(loss)
+        exe = paddle.static.Executor()
+        r = np.random.RandomState(0)
+        xs = r.randn(16, 4).astype("float32")
+        ys = (xs @ r.randn(4, 1)).astype("float32")
+        w0 = np.asarray(net.weight.numpy()).copy()
+        exe.run(main, feed={"x": xs, "y": ys}, fetch_list=["loss"])
+        # micro-step 1 banked: no parameter update yet
+        np.testing.assert_array_equal(net.weight.numpy(), w0)
+        exe.run(main, feed={"x": xs, "y": ys}, fetch_list=["loss"])
+        assert not np.array_equal(np.asarray(net.weight.numpy()), w0)
+
+    def test_gradient_merge_rejects_fp16_scaler_in_static(self):
+        import pytest as _pytest
+
+        from paddle_tpu.distributed.fleet.meta_optimizers import (
+            GradientMergeOptimizer)
+
+        paddle.seed(0)
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data("x", [None, 2], "float32")
+            net = paddle.nn.Linear(2, 1)
+            loss = net(x).mean()
+            loss.name = "loss"
+            amp_opt = paddle.static.amp.decorate(
+                paddle.optimizer.SGD(learning_rate=0.05,
+                                     parameters=net.parameters()))
+            gm = GradientMergeOptimizer(amp_opt, k_steps=2)
+            gm.minimize(loss)
+        exe = paddle.static.Executor()
+        with _pytest.raises(NotImplementedError, match="loss scaling"):
+            exe.run(main, feed={"x": np.ones((2, 2), "float32")},
+                    fetch_list=["loss"])
