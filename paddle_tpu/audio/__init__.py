@@ -151,18 +151,8 @@ class MFCC(Layer):
         return ops.matmul(self.dct, self.logmel(x))
 
 
-class functional:
-    hz_to_mel = staticmethod(hz_to_mel)
-    mel_to_hz = staticmethod(mel_to_hz)
-    compute_fbank_matrix = staticmethod(compute_fbank_matrix)
-    get_window = staticmethod(get_window)
 
 
-class features:
-    Spectrogram = Spectrogram
-    MelSpectrogram = MelSpectrogram
-    LogMelSpectrogram = LogMelSpectrogram
-    MFCC = MFCC
 
 
 # -- backends: wav io (reference audio/backends/wave_backend.py) -------------
@@ -261,3 +251,8 @@ save = _wav_save
 info = _wav_info
 
 from . import datasets  # noqa: F401,E402
+
+
+# real submodules (importable as paddle.audio.features / .functional)
+from . import features  # noqa: E402,F401
+from . import functional  # noqa: E402,F401

@@ -1,0 +1,6 @@
+"""paddle.audio.features as an importable submodule (reference
+audio/features/layers.py): re-exports the feature Layers defined in the
+package root."""
+from . import MFCC, LogMelSpectrogram, MelSpectrogram, Spectrogram  # noqa: F401
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]

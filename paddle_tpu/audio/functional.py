@@ -1,0 +1,85 @@
+"""paddle.audio.functional as an importable submodule (reference
+audio/functional/{functional,window}.py): re-exports the functional
+helpers defined in the package root."""
+from . import (compute_fbank_matrix, get_window, hz_to_mel,  # noqa: F401
+               mel_to_hz)
+
+# reference also exports the inverse mappings under these names
+power_to_db = None  # assigned below if the package root provides it
+try:
+    from . import power_to_db  # noqa: F401
+except ImportError:
+    from .. import ops as _ops
+
+    def power_to_db(x, ref_value=1.0, amin=1e-10, top_db=80.0):
+        """10 * log10(max(x, amin) / ref) clipped to top_db below the peak
+        (reference audio/functional/functional.py power_to_db)."""
+        import jax.numpy as jnp
+
+        from ..framework.core import Tensor
+
+        xv = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+        log_spec = 10.0 * jnp.log10(jnp.maximum(xv, amin))
+        log_spec = log_spec - 10.0 * jnp.log10(jnp.maximum(ref_value, amin))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+        return Tensor(log_spec)
+
+__all__ = ["compute_fbank_matrix", "get_window", "hz_to_mel", "mel_to_hz",
+           "power_to_db"]
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """DCT-II matrix (n_mels, n_mfcc) for MFCC extraction (reference
+    audio/functional/functional.py:306)."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from ..framework.core import Tensor
+
+    n = np.arange(n_mels, dtype="float64")
+    k = np.arange(n_mfcc, dtype="float64")
+    dct = np.cos(np.pi / n_mels * (n[:, None] + 0.5) * k[None, :])
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / np.sqrt(2.0)
+        dct *= np.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return Tensor(jnp.asarray(dct, dtype))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    """Center frequencies of rfft bins (reference functional.py)."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from ..framework.core import Tensor
+
+    return Tensor(jnp.asarray(
+        np.linspace(0, sr / 2.0, 1 + n_fft // 2), dtype))
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    """n_mels frequencies evenly spaced on the mel scale (reference
+    functional.py mel_frequencies)."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from . import hz_to_mel, mel_to_hz
+    from ..framework.core import Tensor
+
+    def as_np(x):
+        return np.asarray(x.value if isinstance(x, Tensor) else x)
+
+    lo = float(as_np(hz_to_mel(f_min, htk)))
+    hi = float(as_np(hz_to_mel(f_max, htk)))
+    mels = np.linspace(lo, hi, n_mels)
+    hz = as_np(mel_to_hz(jnp.asarray(mels), htk))  # one vectorized call
+    return Tensor(jnp.asarray(hz, dtype))
+
+
+__all__ += ["create_dct", "fft_frequencies", "mel_frequencies"]

@@ -1,2 +1,34 @@
 from ..recompute import recompute  # noqa: F401
 from . import sequence_parallel_utils  # noqa: F401
+
+
+class DistributedInfer:
+    """reference fleet/utils/__init__.py DistributedInfer: pull the latest
+    sparse/dense parameters from the parameter servers before running
+    inference with a trained PS model."""
+
+    def __init__(self, main_program=None, startup_program=None):
+        self._main = main_program
+        self._startup = startup_program
+
+    def init_distributed_infer_env(self, exe=None, loss=None,
+                                   role_maker=None, dirname=None):
+        """PS mode: ensure the worker-side client exists and, with
+        ``dirname``, tell the servers to load saved tables so inference
+        runs against the checkpointed parameters. Collective mode (no PS
+        runtime) is a no-op, matching the reference's trainer-only path."""
+        from ...ps.the_one_ps import runtime as ps_runtime
+
+        rt = ps_runtime()
+        if rt.client is None:
+            return  # collective mode / worker not initialized: nothing to pull
+        if dirname:
+            rt.client.load(dirname)
+
+    def get_dist_infer_program(self):
+        """In capture-replay form the trainer program IS the infer program
+        (parameters are live objects already synced by init)."""
+        return self._main
+
+
+from .fs import HDFSClient, LocalFS  # noqa: E402,F401

@@ -1,0 +1,158 @@
+"""Filesystem helpers: paddle.distributed.fleet.utils.{LocalFS, HDFSClient}.
+
+Reference analog: python/paddle/distributed/fleet/utils/fs.py:134 LocalFS
+(and the hadoop-CLI-backed HDFSClient). LocalFS is fully functional;
+HDFSClient shells out to the configured ``hadoop fs`` binary exactly like
+the reference and therefore needs one in PATH (this environment has none —
+construction succeeds, operations raise with a clear message if the CLI is
+absent)."""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+
+__all__ = ["LocalFS", "HDFSClient", "FSFileExistsError", "FSFileNotExistsError"]
+
+
+class FSFileExistsError(RuntimeError):
+    pass
+
+
+class FSFileNotExistsError(RuntimeError):
+    pass
+
+
+class LocalFS:
+    """reference fs.py:134 — local filesystem with the FS API shape."""
+
+    def ls_dir(self, fs_path):
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for name in sorted(os.listdir(fs_path)):
+            (dirs if os.path.isdir(os.path.join(fs_path, name))
+             else files).append(name)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def rename(self, fs_src_path, fs_dst_path):
+        os.rename(fs_src_path, fs_dst_path)
+
+    def delete(self, fs_path):
+        if os.path.isdir(fs_path):
+            shutil.rmtree(fs_path, ignore_errors=True)
+        elif os.path.exists(fs_path):
+            os.remove(fs_path)
+
+    def need_upload_download(self):
+        return False
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if os.path.exists(fs_path):
+            if not exist_ok:
+                raise FSFileExistsError(fs_path)
+            return
+        open(fs_path, "a").close()
+
+    def mv(self, src_path, dst_path, overwrite=False, test_exists=False):
+        if not overwrite and os.path.exists(dst_path):
+            raise FSFileExistsError(dst_path)
+        if test_exists and not os.path.exists(src_path):
+            raise FSFileNotExistsError(src_path)
+        shutil.move(src_path, dst_path)
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+
+class HDFSClient:
+    """reference fs.py HDFSClient: every operation is one ``hadoop fs``
+    CLI call with the configs rendered as -D options."""
+
+    def __init__(self, hadoop_home=None, configs=None, time_out=300,
+                 sleep_inter=1000):
+        self._hadoop = (os.path.join(hadoop_home, "bin", "hadoop")
+                        if hadoop_home else "hadoop")
+        self._configs = configs or {}
+        self._timeout = time_out
+
+    def _cmd(self, *args, check=False):
+        base = [self._hadoop, "fs"]
+        for k, v in self._configs.items():
+            base += ["-D", f"{k}={v}"]
+        try:
+            proc = subprocess.run(base + list(args), capture_output=True,
+                                  text=True, timeout=self._timeout)
+        except FileNotFoundError as e:
+            raise RuntimeError(
+                f"hadoop CLI not found ({self._hadoop!r}); HDFSClient needs "
+                "a hadoop installation (pass hadoop_home=)") from e
+        if check and proc.returncode != 0:
+            # the reference raises ExecuteError on CLI failure — silent
+            # success on a failed transfer corrupts the caller's world
+            raise RuntimeError(
+                f"hadoop fs {' '.join(args)} failed "
+                f"(rc={proc.returncode}): {proc.stderr[-500:]}")
+        return proc
+
+    def is_exist(self, fs_path):
+        return self._cmd("-test", "-e", fs_path).returncode == 0
+
+    def is_dir(self, fs_path):
+        return self._cmd("-test", "-d", fs_path).returncode == 0
+
+    def is_file(self, fs_path):
+        return (self.is_exist(fs_path) and not self.is_dir(fs_path))
+
+    def ls_dir(self, fs_path):
+        out = self._cmd("-ls", fs_path)
+        dirs, files = [], []
+        for line in out.stdout.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = parts[-1].rsplit("/", 1)[-1]
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        self._cmd("-mkdir", "-p", fs_path, check=True)
+
+    def delete(self, fs_path):
+        self._cmd("-rm", "-r", "-f", fs_path, check=True)
+
+    def upload(self, local_path, fs_path, multi_processes=1, overwrite=False):
+        if overwrite:
+            self.delete(fs_path)
+        self._cmd("-put", local_path, fs_path, check=True)
+
+    def download(self, fs_path, local_path, multi_processes=1,
+                 overwrite=False):
+        if overwrite and os.path.exists(local_path):
+            LocalFS().delete(local_path)
+        self._cmd("-get", fs_path, local_path, check=True)
+
+    def need_upload_download(self):
+        return True
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False):
+        if overwrite:
+            self.delete(fs_dst_path)
+        self._cmd("-mv", fs_src_path, fs_dst_path, check=True)
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path) and not exist_ok:
+            raise FSFileExistsError(fs_path)
+        self._cmd("-touchz", fs_path, check=True)

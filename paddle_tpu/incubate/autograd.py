@@ -16,3 +16,54 @@ from ..autograd.functional import (  # noqa: F401
 )
 
 __all__ = ["jvp", "vjp", "Jacobian", "Hessian", "jacobian", "hessian"]
+
+
+# -- prim API (reference incubate/autograd/primapi.py) -----------------------
+_PRIM_ENABLED = [False]
+
+
+def enable_prim():
+    """reference primapi: switch composite ops to primitive decomposition
+    before autodiff. Here jax traces to primitives ALWAYS (jaxpr is the
+    primitive IR), so the flag only gates the primapi entry points."""
+    _PRIM_ENABLED[0] = True
+
+
+def disable_prim():
+    _PRIM_ENABLED[0] = False
+
+
+def prim_enabled():
+    return _PRIM_ENABLED[0]
+
+
+def forward_grad(outputs, inputs, grad_inputs=None):
+    """reference primapi.py:36 forward_grad — forward-mode (JVP) gradients.
+    The reference form is static-prim-only (outputs/inputs are program
+    tensors); the equivalent here is the functional jvp over the producing
+    function, so pass a CALLABLE as ``outputs`` (jax.jvp pushes tangents
+    through the primitive jvp rules — exactly what the reference's prim
+    lowering does)."""
+    if callable(outputs):
+        _, tangents = jvp(outputs, inputs, grad_inputs)
+        return tangents
+    raise NotImplementedError(
+        "forward_grad over already-built tensors is the reference's "
+        "static-prim mode; here pass the function: forward_grad(fn, xs, vs) "
+        "(or use paddle.incubate.autograd.jvp directly)")
+
+
+def grad(outputs, inputs, grad_outputs=None):
+    """reference primapi.py:132 grad — reverse-mode gradients through
+    primitive rules. Tensor outputs go through the tape (paddle.grad
+    semantics); a callable goes through functional vjp."""
+    if callable(outputs):
+        _, grads = vjp(outputs, inputs, grad_outputs)
+        return grads
+    from ..autograd import grad as tape_grad
+
+    return tape_grad(outputs, inputs, grad_outputs=grad_outputs)
+
+
+__all__ += ["enable_prim", "disable_prim", "prim_enabled", "forward_grad",
+            "grad"]

@@ -357,3 +357,237 @@ def fused_linear_cross_entropy(hidden, weight, labels, ignore_index=-100,
     return _fused_linear_cross_entropy(hidden, weight, labels,
                                        ignore_index=int(ignore_index),
                                        chunk_size=int(chunk_size))
+
+
+def fused_linear_activation(x, y, bias=None, trans_x=False, trans_y=False,
+                            activation=None, name=None):
+    """reference fused_ops fused_linear_activation: matmul + bias + act in
+    one op (XLA fuses the epilogue into the matmul on TPU)."""
+    out = fused_matmul_bias(x, y, bias, transpose_x=trans_x,
+                            transpose_y=trans_y)
+    if activation in (None, "", "none"):
+        return out
+    from ....nn import functional as F
+
+    act = {"relu": F.relu, "gelu": F.gelu, "swish": F.silu,
+           "silu": F.silu}.get(activation)
+    if act is None:
+        raise ValueError(f"unsupported activation {activation!r}")
+    return act(out)
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        mode="upscale_in_train", name=None):
+    """reference fused_transformer.py fused_bias_dropout_residual_layer_norm:
+    out = LN(residual + dropout(x + bias))."""
+    from ....nn import functional as F
+
+    y = x if bias is None else x + bias
+    if dropout_rate:
+        y = F.dropout(y, p=dropout_rate, training=training, mode=mode)
+    y = residual + y
+    norm_shape = [int(y.shape[-1])]
+    return F.layer_norm(y, norm_shape, ln_scale, ln_bias, ln_epsilon)
+
+
+def fused_multi_head_attention(
+        x, qkv_weight, linear_weight, pre_layer_norm=False,
+        pre_ln_scale=None, pre_ln_bias=None, ln_scale=None, ln_bias=None,
+        pre_ln_epsilon=1e-5, qkv_bias=None, linear_bias=None, cache_kv=None,
+        attn_mask=None, dropout_rate=0.5, attn_dropout_rate=0.5,
+        ln_epsilon=1e-5, training=True, mode="upscale_in_train", ring_id=-1,
+        add_residual=True, num_heads=-1, transpose_qkv_wb=False, name=None):
+    """reference fused_transformer.py fused_multi_head_attention — the
+    functional form of FusedMultiHeadAttention (packed [3, H, D, E] qkv
+    weight; XLA fuses what the reference hand-fuses in CUDA)."""
+    from ....nn import functional as F
+    from ....ops import manipulation as m
+
+    if transpose_qkv_wb:
+        raise NotImplementedError(
+            "transpose_qkv_wb=True is not implemented (packed [3, H, D, E] "
+            "layout is — matches incubate.nn.FusedMultiHeadAttention)")
+    three, heads, head_dim, embed = (int(s) for s in qkv_weight.shape)
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, [embed], pre_ln_scale, pre_ln_bias,
+                         pre_ln_epsilon)
+    w = m.reshape(qkv_weight, [3 * embed, embed])
+    qkv = fused_matmul_bias(
+        x, w, None if qkv_bias is None else m.reshape(qkv_bias, [3 * embed]),
+        transpose_y=True)
+    qkv = m.reshape(qkv, [0, 0, 3, heads, head_dim])
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    cache_out = None
+    if cache_kv is not None:
+        # reference contract: cache_kv [2, B, H, T, D] holds past K/V; the
+        # new tokens append and the call returns (out, updated_cache)
+        from ....framework.core import Tensor as _T
+        from ....ops import manipulation as _m
+
+        cv = cache_kv.value if isinstance(cache_kv, _T) \
+            else jnp.asarray(cache_kv)
+        past_k = _T(jnp.swapaxes(cv[0], 1, 2))  # -> (B, T, H, D)
+        past_v = _T(jnp.swapaxes(cv[1], 1, 2))
+        k = _m.concat([past_k, k], axis=1)
+        v = _m.concat([past_v, v], axis=1)
+        cache_out = _T(jnp.stack([jnp.swapaxes(k.value, 1, 2),
+                                  jnp.swapaxes(v.value, 1, 2)]))
+    out = F.scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask, dropout_p=attn_dropout_rate,
+        is_causal=False, training=training)
+    out = m.reshape(out, [0, 0, embed])
+    out = fused_matmul_bias(out, linear_weight, linear_bias)
+    if dropout_rate:
+        out = F.dropout(out, p=dropout_rate, training=training, mode=mode)
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, [embed], ln_scale, ln_bias, ln_epsilon)
+    if cache_out is not None:
+        return out, cache_out
+    return out
+
+
+def fused_feedforward(
+        x, linear1_weight, linear2_weight, linear1_bias=None,
+        linear2_bias=None, ln1_scale=None, ln1_bias=None, ln2_scale=None,
+        ln2_bias=None, dropout1_rate=0.5, dropout2_rate=0.5,
+        activation="relu", ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+        pre_layer_norm=False, training=True, mode="upscale_in_train",
+        ring_id=-1, add_residual=True, name=None):
+    """reference fused_transformer.py fused_feedforward — functional form of
+    FusedFeedForward: [LN ->] linear1 -> act -> dropout -> linear2 ->
+    dropout -> residual [-> LN]."""
+    from ....nn import functional as F
+
+    embed = int(x.shape[-1])
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, [embed], ln1_scale, ln1_bias, ln1_epsilon)
+    h = fused_linear_activation(x, linear1_weight, linear1_bias,
+                                activation=activation)
+    if dropout1_rate:
+        h = F.dropout(h, p=dropout1_rate, training=training, mode=mode)
+    h = fused_matmul_bias(h, linear2_weight, linear2_bias)
+    if dropout2_rate:
+        h = F.dropout(h, p=dropout2_rate, training=training, mode=mode)
+    out = residual + h if add_residual else h
+    if not pre_layer_norm:
+        out = F.layer_norm(out, [embed], ln2_scale, ln2_bias, ln2_epsilon)
+    return out
+
+
+def fused_multi_transformer(
+        x, ln_scales, ln_biases, qkv_weights, qkv_biases, linear_weights,
+        linear_biases, ffn_ln_scales, ffn_ln_biases, ffn1_weights,
+        ffn1_biases, ffn2_weights, ffn2_biases, pre_layer_norm=True,
+        epsilon=1e-5, cache_kvs=None, pre_caches=None, seq_lens=None,
+        rotary_embs=None, time_step=None, attn_mask=None,
+        dropout_rate=0.0, activation="gelu", training=False,
+        mode="upscale_in_train", trans_qkvw=True, ring_id=-1, name=None,
+        **unused):
+    """reference fused_transformer.py fused_multi_transformer — the whole
+    decoder stack as one call: per layer, fused attention + fused FFN."""
+    out = x
+    for i in range(len(qkv_weights)):
+        out = fused_multi_head_attention(
+            out, qkv_weights[i], linear_weights[i],
+            pre_layer_norm=pre_layer_norm,
+            pre_ln_scale=ln_scales[i] if ln_scales else None,
+            pre_ln_bias=ln_biases[i] if ln_biases else None,
+            ln_scale=ln_scales[i] if ln_scales else None,
+            ln_bias=ln_biases[i] if ln_biases else None,
+            pre_ln_epsilon=epsilon, ln_epsilon=epsilon,
+            qkv_bias=qkv_biases[i] if qkv_biases else None,
+            linear_bias=linear_biases[i] if linear_biases else None,
+            attn_mask=attn_mask, dropout_rate=dropout_rate,
+            attn_dropout_rate=dropout_rate, training=training, mode=mode)
+        out = fused_feedforward(
+            out, ffn1_weights[i], ffn2_weights[i],
+            linear1_bias=ffn1_biases[i] if ffn1_biases else None,
+            linear2_bias=ffn2_biases[i] if ffn2_biases else None,
+            ln1_scale=ffn_ln_scales[i] if ffn_ln_scales else None,
+            ln1_bias=ffn_ln_biases[i] if ffn_ln_biases else None,
+            ln2_scale=ffn_ln_scales[i] if ffn_ln_scales else None,
+            ln2_bias=ffn_ln_biases[i] if ffn_ln_biases else None,
+            dropout1_rate=dropout_rate, dropout2_rate=dropout_rate,
+            activation=activation, ln1_epsilon=epsilon, ln2_epsilon=epsilon,
+            pre_layer_norm=pre_layer_norm, training=training, mode=mode)
+    if cache_kvs is not None:
+        raise NotImplementedError(
+            "fused_multi_transformer with cache_kvs (decode loop) is not "
+            "provided; use models.llama_decode.LlamaDecodeEngine for cached "
+            "generation")
+    return out
+
+
+def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size=None,
+                     name=None):
+    """reference blha_get_max_len: the (max encoder len, max decoder len)
+    pair the block-attention kernels size their launch by."""
+    from ....framework.core import Tensor
+
+    enc = seq_lens_encoder.value if isinstance(seq_lens_encoder, Tensor) \
+        else jnp.asarray(seq_lens_encoder)
+    dec = seq_lens_decoder.value if isinstance(seq_lens_decoder, Tensor) \
+        else jnp.asarray(seq_lens_decoder)
+    return (Tensor(jnp.max(enc).reshape(1)),
+            Tensor(jnp.max(dec).reshape(1)))
+
+
+def masked_multihead_attention(
+        x, cache_kv=None, bias=None, src_mask=None, sequence_lengths=None,
+        rotary_tensor=None, beam_cache_offset=None, qkv_out_scale=None,
+        out_shift=None, out_smooth=None, seq_len=1, rotary_emb_dims=0,
+        use_neox_rotary_style=False, compute_dtype="default",
+        out_scale=-1.0, quant_round_type=1, quant_max_bound=127.0,
+        quant_min_bound=-127.0, name=None):
+    """reference masked_multihead_attention: ONE decode step of multi-head
+    attention against a growing [2, B, H, T, D] cache — the generation-loop
+    kernel. x is the packed qkv for the new token: (B, 3*H*D)."""
+    from ....framework.core import Tensor
+
+    if cache_kv is None:
+        raise ValueError("cache_kv is required (shape [2, B, H, T, D])")
+    xv = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+    cv = cache_kv.value if isinstance(cache_kv, Tensor) \
+        else jnp.asarray(cache_kv)
+    if bias is not None:
+        xv = xv + (bias.value if isinstance(bias, Tensor)
+                   else jnp.asarray(bias)).reshape(-1)
+    two, B, H, T, D = cv.shape
+    qkv = xv.reshape(B, 3, H, D)
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]        # (B, H, D)
+    if sequence_lengths is None:
+        raise ValueError(
+            "sequence_lengths is required: it is the per-row cache write "
+            "position — without it every step would overwrite slot 0 and "
+            "decode with no history")
+    sl = (sequence_lengths.value if isinstance(sequence_lengths, Tensor)
+          else jnp.asarray(sequence_lengths)).reshape(-1)
+    pos = sl.astype(jnp.int32)                        # write position per row
+    bidx = jnp.arange(B)
+    ck = cv[0].at[bidx, :, pos].set(k)
+    cvv = cv[1].at[bidx, :, pos].set(v)
+    t = jnp.arange(T)[None, None, :]
+    mask = t <= pos[:, None, None]                    # (B, 1, T)
+    logits = jnp.einsum("bhd,bhtd->bht", q, ck) / jnp.sqrt(jnp.asarray(D, jnp.float32)).astype(q.dtype)
+    logits = jnp.where(mask, logits.astype(jnp.float32), -1e30)
+    probs = jax.nn.softmax(logits, -1).astype(q.dtype)
+    out = jnp.einsum("bht,bhtd->bhd", probs, cvv).reshape(B, H * D)
+    return Tensor(out), Tensor(jnp.stack([ck, cvv]))
+
+
+def block_multihead_attention(*args, **kwargs):
+    """reference block_multihead_attention: paged-KV (block table) serving
+    attention. The paged block layout is a CUDA serving-kernel contract;
+    this build's serving path is models.llama_decode.LlamaDecodeEngine
+    (dense KV cache, optional int8 quantization, beam search), which covers
+    the capability without the page-table indirection."""
+    raise NotImplementedError(
+        "paged block-table attention is not provided; use "
+        "models.llama_decode.LlamaDecodeEngine (dense or int8 KV cache) "
+        "for serving decode")

@@ -262,3 +262,31 @@ def set_global_initializer(weight_init, bias_init=None):
 
 
 _GLOBAL_INIT = [None, None]
+
+
+class Bilinear(Initializer):
+    """Bilinear-interpolation kernel init for transposed-conv upsampling
+    (reference nn/initializer/Bilinear): weight shape (C_out, C_in, kH, kW)
+    gets the classic bilinear upsampling kernel on its spatial dims."""
+
+    def __call__(self, shape, dtype=None):
+        d = dtype_mod.convert_dtype(dtype) or dtype_mod.get_default_dtype()
+        shape = tuple(int(s) for s in shape)
+        if len(shape) < 3:
+            raise ValueError(
+                f"Bilinear initializer needs a conv weight (>=3D), got "
+                f"{shape}")
+        import numpy as np
+
+        w = np.zeros(shape, dtype="float64")
+        spatial = shape[2:]
+        grids = []
+        for k in spatial:
+            f = (k + 1) // 2
+            c = (2 * f - 1 - f % 2) / (2.0 * f)
+            grids.append(1 - np.abs(np.arange(k) / f - c))
+        kernel = grids[0]
+        for g in grids[1:]:
+            kernel = np.multiply.outer(kernel, g)
+        w[...] = kernel  # every (c_out, c_in) gets the spatial kernel
+        return jnp.asarray(w, d)

@@ -1,0 +1,164 @@
+"""paddle.nn.utils — parameter reparameterization + transform helpers.
+
+Reference analog: python/paddle/nn/utils/ (weight_norm_hook.py,
+spectral_norm_hook.py, clip_grad_{norm_,value_}.py,
+transform_parameters.py). TPU-first form: the reparameterizations are
+forward pre-hooks that rebind the live Parameter value — pure functional
+math underneath, so they trace cleanly under jit."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ..clip import clip_grad_norm_, clip_grad_value_  # noqa: F401
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters",
+           "clip_grad_norm_", "clip_grad_value_"]
+
+
+def _norm_except(v, dim):
+    if dim is None:
+        return jnp.sqrt(jnp.sum(v * v))
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(v * v, axis=axes, keepdims=True))
+
+
+class _WeightNormHook:
+    def __init__(self, layer, name, dim):
+        self.name = name
+        self.dim = dim
+        w = getattr(layer, name)
+        from ...framework.core import Parameter
+
+        v = w.value
+        g = _norm_except(v, dim)
+        layer.add_parameter(name + "_v", Parameter(v))
+        layer.add_parameter(name + "_g", Parameter(g))
+        # the original weight becomes a DERIVED tensor recomputed per call
+        del layer._parameters[name]
+        object.__setattr__(layer, name, Tensor(v))
+        self._recompute(layer)
+
+    def _recompute(self, layer):
+        # composed through TRACED tensor ops so gradients flow to g and v
+        v = getattr(layer, self.name + "_v")
+        g = getattr(layer, self.name + "_g")
+        axes = (None if self.dim is None
+                else [i for i in range(v.ndim) if i != self.dim])
+        norm = (v * v).sum(axis=axes, keepdim=self.dim is not None)
+        w = g * v * (norm.clip(min=1e-24) ** -0.5)
+        object.__setattr__(layer, self.name, w)
+
+    def __call__(self, layer, inputs):
+        self._recompute(layer)
+        return inputs
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """reference weight_norm_hook.py: w = g * v / ||v|| with g, v trained
+    in w's place; the recomputation runs as a forward pre-hook."""
+    hook = _WeightNormHook(layer, name, dim)
+    handle = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_hooks = getattr(layer, "_weight_norm_hooks", {})
+    layer._weight_norm_hooks[name] = (hook, handle)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Fold g*v/||v|| back into a plain trained weight parameter."""
+    from ...framework.core import Parameter
+
+    hooks = getattr(layer, "_weight_norm_hooks", {})
+    if name not in hooks:
+        raise ValueError(f"weight_norm was not applied to {name!r}")
+    hook, handle = hooks.pop(name)
+    hook._recompute(layer)
+    w = getattr(layer, name).value
+    handle.remove()
+    del layer._parameters[name + "_v"]
+    del layer._parameters[name + "_g"]
+    if hasattr(layer, name):
+        object.__delattr__(layer, name)
+    layer.add_parameter(name, Parameter(w))
+    return layer
+
+
+class _SpectralNormHook:
+    def __init__(self, layer, name, n_power_iterations, eps, dim):
+        self.name = name
+        self.n = max(1, int(n_power_iterations))
+        self.eps = float(eps)
+        self.dim = dim
+        w = getattr(layer, name).value
+        mat = self._as_matrix(w)
+        r = np.random.RandomState(0)
+        self.u = jnp.asarray(r.randn(mat.shape[0]), w.dtype)
+
+    def _as_matrix(self, w):
+        if self.dim != 0:
+            w = jnp.moveaxis(w, self.dim, 0)
+        return w.reshape(w.shape[0], -1)
+
+    def __call__(self, layer, inputs):
+        orig = layer._parameters.get(self.name + "_orig")
+        w = orig.value
+        mat = self._as_matrix(w)
+        u = self.u
+        for _ in range(self.n):
+            v = mat.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), self.eps)
+            u = mat @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), self.eps)
+        self.u = u
+        # sigma = u^T W v = sum(W * (u (x) v)), with u/v detached (the
+        # standard power-iteration treatment) and W the TRACED parameter so
+        # gradients flow through both the numerator and sigma
+        outer = jnp.einsum("i,j->ij", u, v).reshape(
+            jnp.moveaxis(w, self.dim, 0).shape if self.dim != 0 else w.shape)
+        if self.dim != 0:
+            outer = jnp.moveaxis(outer, 0, self.dim)
+        sigma = (orig * Tensor(outer.astype(w.dtype))).sum()
+        object.__setattr__(layer, self.name, orig * (sigma ** -1.0))
+        return inputs
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=0):
+    """reference spectral_norm_hook.py: divide the weight by its largest
+    singular value (power iteration) before every forward."""
+    from ...framework.core import Parameter
+
+    w = getattr(layer, name)
+    layer.add_parameter(name + "_orig", Parameter(w.value))
+    del layer._parameters[name]
+    object.__setattr__(layer, name, Tensor(w.value))
+    hook = _SpectralNormHook(layer, name, n_power_iterations, eps, dim)
+    layer.register_forward_pre_hook(hook)
+    hook(layer, ())  # initialize the normalized weight
+    return layer
+
+
+def parameters_to_vector(parameters, name=None):
+    """Flatten parameters into one 1-D tensor (reference
+    transform_parameters.py)."""
+    vals = [jnp.ravel(p.value) for p in parameters]
+    return Tensor(jnp.concatenate(vals) if vals else jnp.zeros((0,)))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    """Write slices of ``vec`` back into the parameters (in-place)."""
+    v = vec.value if isinstance(vec, Tensor) else jnp.asarray(vec)
+    parameters = list(parameters)
+    sizes = [int(np.prod(p.shape)) if p.ndim else 1 for p in parameters]
+    if sum(sizes) != v.shape[0]:
+        raise ValueError(
+            f"vector length {v.shape[0]} != total parameter size "
+            f"{sum(sizes)}")
+    off = 0
+    for p, n in zip(parameters, sizes):
+        p._replace_value(v[off:off + n].reshape(p.value.shape)
+                         .astype(p.value.dtype))
+        off += n

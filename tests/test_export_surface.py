@@ -207,6 +207,17 @@ class TestSecondLevelNamespaceParity:
         "distribution/__init__.py", "autograd/__init__.py",
         "incubate/__init__.py", "quantization/__init__.py", "text/__init__.py",
         "audio/__init__.py", "geometric/__init__.py", "utils/__init__.py",
+        # third level
+        "nn/initializer/__init__.py", "nn/utils/__init__.py",
+        "vision/transforms/__init__.py", "vision/ops.py",
+        "vision/models/__init__.py", "vision/datasets/__init__.py",
+        "distributed/fleet/__init__.py",
+        "distributed/fleet/utils/__init__.py",
+        "distributed/checkpoint/__init__.py", "incubate/nn/__init__.py",
+        "incubate/nn/functional/__init__.py",
+        "incubate/autograd/__init__.py", "optimizer/lr.py",
+        "regularizer.py", "audio/features/__init__.py",
+        "audio/functional/__init__.py",
     ]
 
     @staticmethod

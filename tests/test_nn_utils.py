@@ -1,0 +1,83 @@
+"""paddle.nn.utils: weight/spectral norm reparameterization + parameter
+vector transforms (reference python/paddle/nn/utils/)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import utils as U
+
+
+class TestWeightNorm:
+    def test_forward_preserved_and_grads_flow(self):
+        paddle.seed(0)
+        lin = paddle.nn.Linear(4, 3)
+        w0 = np.asarray(lin.weight.numpy()).copy()
+        U.weight_norm(lin, dim=0)
+        out = lin(paddle.to_tensor(np.ones((2, 4), "float32")))
+        np.testing.assert_allclose(np.asarray(lin.weight.numpy()), w0,
+                                   rtol=1e-5)
+        out.sum().backward()
+        assert lin.weight_v.grad is not None
+        assert lin.weight_g.grad is not None
+        names = [n for n, _ in lin.named_parameters()]
+        assert "weight_v" in names and "weight_g" in names
+        assert "weight" not in names  # replaced by the reparameterization
+
+    def test_training_moves_g_and_v(self):
+        paddle.seed(1)
+        lin = paddle.nn.Linear(3, 2)
+        U.weight_norm(lin)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=lin.parameters())
+        x = paddle.to_tensor(np.ones((4, 3), "float32"))
+        g0 = np.asarray(lin.weight_g.numpy()).copy()
+        for _ in range(3):
+            loss = (lin(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert not np.array_equal(np.asarray(lin.weight_g.numpy()), g0)
+
+    def test_remove_restores_plain_parameter(self):
+        paddle.seed(0)
+        lin = paddle.nn.Linear(4, 3)
+        w0 = np.asarray(lin.weight.numpy()).copy()
+        U.weight_norm(lin)
+        U.remove_weight_norm(lin)
+        np.testing.assert_allclose(np.asarray(lin.weight.numpy()), w0,
+                                   rtol=1e-5)
+        names = [n for n, _ in lin.named_parameters()]
+        assert "weight" in names and "weight_v" not in names
+        lin(paddle.to_tensor(np.ones((1, 4), "float32")))  # still runs
+
+
+class TestSpectralNorm:
+    def test_unit_spectral_norm_and_grads(self):
+        paddle.seed(0)
+        lin = paddle.nn.Linear(6, 5)
+        U.spectral_norm(lin, n_power_iterations=20)
+        out = lin(paddle.to_tensor(np.ones((1, 6), "float32")))
+        s = np.linalg.svd(np.asarray(lin.weight.numpy()),
+                          compute_uv=False)[0]
+        assert abs(s - 1.0) < 1e-3
+        out.sum().backward()
+        assert lin.weight_orig.grad is not None
+
+
+class TestParameterVector:
+    def test_round_trip(self):
+        paddle.seed(0)
+        lin = paddle.nn.Linear(3, 2)
+        vec = U.parameters_to_vector(lin.parameters())
+        n = sum(int(np.prod(p.shape)) for p in lin.parameters())
+        assert vec.shape == [n]
+        U.vector_to_parameters(vec * 0 + 1.0, lin.parameters())
+        assert float(lin.bias.numpy()[0]) == 1.0
+        np.testing.assert_allclose(np.asarray(lin.weight.numpy()), 1.0)
+
+    def test_length_mismatch_raises(self):
+        import pytest
+
+        lin = paddle.nn.Linear(3, 2)
+        bad = paddle.to_tensor(np.ones(3, "float32"))
+        with pytest.raises(ValueError, match="length"):
+            U.vector_to_parameters(bad, lin.parameters())
