@@ -48,6 +48,11 @@ VARIANTS = {
     "flash_q256_k512": {"PADDLE_TPU_FLASH_BLOCK_Q": "256"},
     # long-context leg
     "seq4096_b4": {"BENCH_SEQ": "4096", "BENCH_BATCH": "4"},
+    # width scaling: MFU rises with matmul width (measured 0.17 -> 0.37
+    # going 1024 -> 2048); probe the next steps up at similar memory
+    "hidden2816_L6": {"BENCH_HIDDEN": "2816", "BENCH_LAYERS": "6"},
+    "hidden4096_L4_b4": {"BENCH_HIDDEN": "4096", "BENCH_LAYERS": "4",
+                         "BENCH_BATCH": "4"},
 }
 
 
