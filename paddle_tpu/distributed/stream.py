@@ -38,7 +38,8 @@ alltoall = _wrap("alltoall")
 alltoall_single = _wrap("alltoall_single")
 send = _wrap("send")
 recv = _wrap("recv")
+gather = _wrap("gather")
 
 __all__ = ["all_reduce", "all_gather", "reduce", "reduce_scatter",
            "broadcast", "scatter", "alltoall", "alltoall_single", "send",
-           "recv"]
+           "recv", "gather"]

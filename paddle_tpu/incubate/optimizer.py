@@ -95,3 +95,8 @@ class ModelAverage:
         for p in self._parameters:
             p._replace_value(self._backup[id(p)])
         self._backup = None
+
+
+# reference incubate/optimizer exports LBFGS (later promoted to
+# paddle.optimizer.LBFGS — same class here)
+from ..optimizer.optimizer import LBFGS  # noqa: E402,F401

@@ -1,0 +1,43 @@
+"""paddle.nn.quant — quantization building blocks on the nn surface.
+
+Reference analog: python/paddle/nn/quant/ (Stub, the weight-only linear
+functional family promoted from the quantization kit, format converters).
+The heavy machinery lives in paddle.quantization / quantization.weight_only;
+this namespace re-exports the nn-facing pieces."""
+from ..quantization.weight_only import (  # noqa: F401
+    weight_dequantize,
+    weight_only_linear,
+    weight_quantize,
+)
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None,
+                    threshold=6.0, name=None):
+    """reference nn/quant/functional_layers llm_int8_linear: int8 weight
+    matmul with outlier fallback. The TPU build's weight-only path handles
+    the whole activation in one int8 matmul (no outlier split — the MXU has
+    no mixed-row fast path), so this aliases weight_only_linear."""
+    return weight_only_linear(x, weight, bias=bias,
+                              weight_scale=weight_scale,
+                              weight_dtype="int8")
+
+
+from .layer.layers import Layer as _Layer
+
+
+class Stub(_Layer):
+    """reference nn/quant/stub.py Stub: a placeholder LAYER the QAT pass
+    replaces with a quanter — it must be a Layer so sublayers()/named
+    traversals (and the quantization pass) can find it; calling it before
+    conversion is identity."""
+
+    def __init__(self, observer=None):
+        super().__init__()
+        self._observer = observer
+
+    def forward(self, x):
+        return x
+
+
+__all__ = ["Stub", "weight_only_linear", "llm_int8_linear",
+           "weight_quantize", "weight_dequantize"]
