@@ -1,0 +1,155 @@
+"""paddle.incubate.nn.functional fused transformer FUNCTIONAL forms
+(reference incubate/nn/functional/fused_transformer.py) + nn.quant.
+
+The layer classes are covered by test_fused_layers.py; these pin the raw
+functional surface: packed-qkv attention (with cache append), feedforward,
+the whole-stack call, single-step masked attention, and the nn.quant
+re-exports."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.nn import functional as IF
+
+E, H, D = 16, 4, 4
+B, S = 2, 5
+
+
+def _weights(seed=0):
+    r = np.random.RandomState(seed)
+    return dict(
+        x=paddle.to_tensor(r.randn(B, S, E).astype("float32")),
+        qkv_w=paddle.to_tensor((r.randn(3, H, D, E) * 0.1).astype("float32")),
+        lin_w=paddle.to_tensor((r.randn(E, E) * 0.1).astype("float32")),
+        ln_s=paddle.to_tensor(np.ones(E, "float32")),
+        ln_b=paddle.to_tensor(np.zeros(E, "float32")),
+        ffn1=paddle.to_tensor((r.randn(E, 32) * 0.1).astype("float32")),
+        ffn2=paddle.to_tensor((r.randn(32, E) * 0.1).astype("float32")))
+
+
+class TestFusedMHA:
+    def test_forward_shape_and_grads(self):
+        w = _weights()
+        x = paddle.to_tensor(np.asarray(w["x"].numpy()),
+                             stop_gradient=False)
+        out = IF.fused_multi_head_attention(
+            x, w["qkv_w"], w["lin_w"], ln_scale=w["ln_s"],
+            ln_bias=w["ln_b"], dropout_rate=0.0, attn_dropout_rate=0.0,
+            training=False)
+        assert out.shape == [B, S, E]
+        out.sum().backward()
+        assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+
+    def test_cache_append_contract(self):
+        w = _weights()
+        T = 3
+        cache = paddle.to_tensor(
+            np.random.RandomState(1).randn(2, B, H, T, D).astype("float32"))
+        out, c2 = IF.fused_multi_head_attention(
+            w["x"], w["qkv_w"], w["lin_w"], ln_scale=w["ln_s"],
+            ln_bias=w["ln_b"], cache_kv=cache, dropout_rate=0.0,
+            attn_dropout_rate=0.0, training=False)
+        assert out.shape == [B, S, E]
+        assert c2.shape == [2, B, H, T + S, D]  # past + new tokens
+        # the past keys survive unchanged at the front of the cache
+        np.testing.assert_allclose(np.asarray(c2.numpy())[0, :, :, :T],
+                                   np.asarray(cache.numpy())[0], rtol=1e-6)
+
+    def test_pre_ln_variant(self):
+        w = _weights()
+        out = IF.fused_multi_head_attention(
+            w["x"], w["qkv_w"], w["lin_w"], pre_layer_norm=True,
+            pre_ln_scale=w["ln_s"], pre_ln_bias=w["ln_b"],
+            dropout_rate=0.0, attn_dropout_rate=0.0, training=False)
+        assert np.isfinite(out.numpy()).all()
+
+
+class TestFusedFFNAndStack:
+    def test_feedforward(self):
+        w = _weights()
+        out = IF.fused_feedforward(
+            w["x"], w["ffn1"], w["ffn2"], ln2_scale=w["ln_s"],
+            ln2_bias=w["ln_b"], dropout1_rate=0.0, dropout2_rate=0.0,
+            training=False, activation="gelu")
+        assert out.shape == [B, S, E]
+
+    def test_multi_transformer_two_layers(self):
+        w = _weights()
+        out = IF.fused_multi_transformer(
+            w["x"], [w["ln_s"]] * 2, [w["ln_b"]] * 2, [w["qkv_w"]] * 2,
+            None, [w["lin_w"]] * 2, None, [w["ln_s"]] * 2, [w["ln_b"]] * 2,
+            [w["ffn1"]] * 2, None, [w["ffn2"]] * 2, None, dropout_rate=0.0)
+        assert out.shape == [B, S, E]
+
+    def test_multi_transformer_cache_rejected(self):
+        w = _weights()
+        with pytest.raises(NotImplementedError, match="LlamaDecodeEngine"):
+            IF.fused_multi_transformer(
+                w["x"], [w["ln_s"]], [w["ln_b"]], [w["qkv_w"]], None,
+                [w["lin_w"]], None, [w["ln_s"]], [w["ln_b"]], [w["ffn1"]],
+                None, [w["ffn2"]], None, cache_kvs=[paddle.to_tensor(
+                    np.zeros((2, B, H, 4, D), "float32"))])
+
+    def test_linear_activation_and_bias_dropout_residual_ln(self):
+        w = _weights()
+        h = IF.fused_linear_activation(w["x"], w["ffn1"], activation="relu")
+        assert (np.asarray(h.numpy()) >= 0).all()
+        out = IF.fused_bias_dropout_residual_layer_norm(
+            w["x"], w["x"], ln_scale=w["ln_s"], ln_bias=w["ln_b"],
+            dropout_rate=0.0, training=False)
+        assert out.shape == [B, S, E]
+
+
+class TestMaskedMHA:
+    def test_single_step_against_manual(self):
+        r = np.random.RandomState(2)
+        T = 6
+        cache = paddle.to_tensor(r.randn(2, B, H, T, D).astype("float32"))
+        xstep = paddle.to_tensor(r.randn(B, 3 * E).astype("float32"))
+        sl = np.array([2, 4], "int32")  # per-row write positions
+        out, c2 = IF.masked_multihead_attention(
+            xstep, cache_kv=cache, sequence_lengths=sl)
+        assert out.shape == [B, E] and c2.shape == [2, B, H, T, D]
+        # the new k landed at each row's write position
+        qkv = np.asarray(xstep.numpy()).reshape(B, 3, H, D)
+        np.testing.assert_allclose(np.asarray(c2.numpy())[0, 0, :, 2],
+                                   qkv[0, 1], rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(c2.numpy())[0, 1, :, 4],
+                                   qkv[1, 1], rtol=1e-6)
+
+    def test_requires_sequence_lengths(self):
+        cache = paddle.to_tensor(np.zeros((2, B, H, 4, D), "float32"))
+        x = paddle.to_tensor(np.zeros((B, 3 * E), "float32"))
+        with pytest.raises(ValueError, match="sequence_lengths"):
+            IF.masked_multihead_attention(x, cache_kv=cache)
+
+    def test_blha_get_max_len(self):
+        mx_e, mx_d = IF.blha_get_max_len(np.array([3, 9]), np.array([1, 2]))
+        assert int(mx_e.numpy()[0]) == 9 and int(mx_d.numpy()[0]) == 2
+
+
+class TestNNQuant:
+    def test_llm_int8_linear_and_stub(self):
+        import paddle_tpu.nn.quant as Q
+
+        r = np.random.RandomState(0)
+        w = paddle.to_tensor(r.randn(8, 4).astype("float32"))
+        qw, scale = Q.weight_quantize(w, algo="weight_only_int8")
+        x = paddle.to_tensor(np.ones((2, 8), "float32"))
+        out = Q.llm_int8_linear(x, qw, weight_scale=scale)
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   np.asarray((x @ w).numpy()),
+                                   rtol=0.05, atol=0.1)
+
+        class M(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.stub = Q.Stub()
+                self.lin = paddle.nn.Linear(2, 2)
+
+            def forward(self, t):
+                return self.lin(self.stub(t))
+
+        m = M()
+        assert any(isinstance(s, Q.Stub) for s in m.sublayers())
+        m(paddle.to_tensor(np.ones((1, 2), "float32")))
