@@ -255,10 +255,12 @@ class LlamaDecodeEngine:
         return tok.astype(jnp.int32)[:, None]
 
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
-                 top_k=0, top_p=1.0, seed=0):
+                 top_k=0, top_p=1.0, seed=0, eos_token_id=None):
         """Decode with the cache: O(S + T) attention work per token instead of
         generate()'s O((S+T)^2) prefix recompute. temperature=0 is greedy;
-        otherwise temperature/top-k/top-p sampling."""
+        otherwise temperature/top-k/top-p sampling. With ``eos_token_id``, a
+        finished row keeps emitting EOS (shapes stay static for the compiled
+        step; the host loop exits early once EVERY row has finished)."""
         ids = getattr(input_ids, "value", input_ids)
         need = int(ids.shape[1]) + int(max_new_tokens)
         if need > self.max_len:
@@ -271,12 +273,30 @@ class LlamaDecodeEngine:
         key = jax.random.PRNGKey(seed)
         logits, cache, pos = self.prefill(input_ids)
         key, sub = jax.random.split(key)
-        out = [self._select(logits, temperature, top_k, top_p, sub)]
-        for _ in range(max_new_tokens - 1):
+        tok = self._select(logits, temperature, top_k, top_p, sub)
+        finished = None
+        if eos_token_id is not None:
+            finished = tok[:, 0] == eos_token_id
+        out = [tok]
+        for i in range(max_new_tokens - 1):
+            # poll for all-finished only every few steps: the .all() read is
+            # a host-device sync that would otherwise serialize the async
+            # dispatch pipeline on every token (frozen rows are already
+            # masked to EOS, so a late exit is correct, just not early)
+            if (finished is not None and i % 8 == 7
+                    and bool(finished.all())):
+                # pad the remainder with EOS without running the model
+                pad = jnp.full_like(out[-1], eos_token_id)
+                out.extend([pad] * (max_new_tokens - len(out)))
+                break
             logits, cache = self.decode_step(out[-1], cache, pos)
             pos += 1
             key, sub = jax.random.split(key)
-            out.append(self._select(logits, temperature, top_k, top_p, sub))
+            tok = self._select(logits, temperature, top_k, top_p, sub)
+            if finished is not None:
+                tok = jnp.where(finished[:, None], eos_token_id, tok)
+                finished = finished | (tok[:, 0] == eos_token_id)
+            out.append(tok)
         return jnp.concatenate(out, axis=1)
 
     # -- beam search ---------------------------------------------------------

@@ -277,3 +277,22 @@ class TestInt8KVCache:
         model, _ = _model()
         with pytest.raises(ValueError, match="kv_cache_dtype"):
             LlamaDecodeEngine(model, kv_cache_dtype="fp4")
+
+
+class TestGenerateEOS:
+    def test_eos_freezes_rows_and_pads(self):
+        model, _ = _model()
+        r = np.random.RandomState(9)
+        ids = paddle.to_tensor(r.randint(0, 64, (2, 5)).astype("int64"))
+        eng = LlamaDecodeEngine(model, max_len=32)
+        base = np.asarray(eng.generate(ids, max_new_tokens=8))
+        # pick the token row 0 emits at step 2 as the "eos" and regenerate:
+        # everything after that step in row 0 must be eos
+        eos = int(base[0, 2])
+        out = np.asarray(eng.generate(ids, max_new_tokens=8,
+                                      eos_token_id=eos))
+        assert out.shape == (2, 8)
+        hit = np.where(out[0] == eos)[0]
+        assert hit.size and (out[0, hit[0]:] == eos).all()
+        # prefix before the first eos matches the unconstrained run
+        np.testing.assert_array_equal(out[0, :hit[0]], base[0, :hit[0]])
