@@ -135,10 +135,15 @@ def _start_proc(cmd, env, args, log_name, procs, logs):
 
 
 def _local_hosts():
-    """Names/addresses that mean THIS machine (for --servers filtering)."""
+    """Names/addresses that mean THIS machine (for --servers filtering).
+
+    Wildcard addresses ("0.0.0.0", "::") are deliberately NOT included:
+    a --servers endpoint written as 0.0.0.0:port would match as local on
+    EVERY node and spawn duplicate servers — _reject_wildcards raises on
+    them instead (advisor r4)."""
     import socket
 
-    hosts = {"127.0.0.1", "localhost", "0.0.0.0"}
+    hosts = {"127.0.0.1", "localhost"}
     try:
         hostname = socket.gethostname()
         hosts.add(hostname)
@@ -147,6 +152,20 @@ def _local_hosts():
     except OSError:
         pass
     return hosts
+
+
+def _reject_wildcards(flag, hosts):
+    """Raise on wildcard bind addresses in an endpoint list: they cannot
+    identify WHICH machine an endpoint lives on. Hosts arrive as the text
+    left of the last ':', so a bracketed IPv6 wildcard '[::]:8000' shows up
+    as '[::' — strip brackets before comparing."""
+    bad = [h for h in hosts
+           if h.strip("[]") in ("0.0.0.0", "::", "*", "")]
+    if bad:
+        raise ValueError(
+            f"{flag}: wildcard address(es) {bad} are invalid here — each "
+            "endpoint must name the specific machine it runs on (a wildcard "
+            "would match every node and spawn duplicates)")
 
 
 def _spawn_ps(args, base_env):
@@ -162,6 +181,7 @@ def _spawn_ps(args, base_env):
         # but each node must only spawn the servers that live on it — the
         # multi-node recipe (one launcher per node, shared --servers) would
         # otherwise start duplicate servers on every node
+        _reject_wildcards("--servers", [ep.rsplit(":", 1)[0] for ep in eps])
         local = _local_hosts()
         spawn_eps = [(i, ep) for i, ep in enumerate(eps)
                      if ep.rsplit(":", 1)[0] in local]
@@ -174,6 +194,8 @@ def _spawn_ps(args, base_env):
         # like --servers): every node sees the same list, each node spawns
         # only ITS endpoints, and a trainer's id is its list position
         tr_eps = [e.strip() for e in args.trainers.split(",") if e.strip()]
+        _reject_wildcards("--trainers",
+                          [ep.rsplit(":", 1)[0] for ep in tr_eps])
         local = _local_hosts()
         local_tids = [i for i, ep in enumerate(tr_eps)
                       if ep.rsplit(":", 1)[0] in local]
@@ -278,6 +300,7 @@ def launch(argv=None):
         if args.rank is None and len(ips) > 1:
             # the reference contract runs the IDENTICAL command on every
             # node: this node's rank is its position in the ip list
+            _reject_wildcards("--ips", ips)
             local = _local_hosts()
             mine = [i for i, h in enumerate(ips) if h in local]
             if len(mine) == 1:

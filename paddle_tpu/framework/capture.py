@@ -17,6 +17,7 @@ per dispatch is a list-index check.
 from __future__ import annotations
 
 import threading
+import weakref
 
 # The active program resolves THREAD-LOCAL first, then the process-global
 # default: concurrent trainer threads (the DistributeTranspiler sync-trainer
@@ -32,11 +33,16 @@ import threading
 # anywhere" — so the dispatch hot path checks one module global (same cost as
 # the old list-index check) and only pays the thread-local resolution when
 # something may actually be recording.
+#
+# Holder threads are tracked in a WeakSet pruned of dead threads on every
+# recount: a thread that exits (or crashes between swap/restore) while
+# holding a non-None program must not leave _ANY_ACTIVE stuck true and the
+# eager fast path disabled process-wide (advisor r4).
 _TLS = threading.local()
 _UNSET = object()
 _DEFAULT = [None]      # process-global default program (paddle.enable_static)
 _LOCK = threading.Lock()
-_TLS_COUNT = 0         # threads holding an explicit non-None thread-local program
+_HOLDERS = weakref.WeakSet()  # live threads holding a non-None TLS program
 _ANY_ACTIVE = False
 
 
@@ -47,14 +53,22 @@ def active():
     return v
 
 
+def _recount_locked():
+    """Recompute _ANY_ACTIVE under _LOCK, dropping dead holder threads."""
+    global _ANY_ACTIVE
+    dead = [t for t in _HOLDERS if not t.is_alive()]
+    for t in dead:
+        _HOLDERS.discard(t)
+    _ANY_ACTIVE = bool(_HOLDERS) or _DEFAULT[0] is not None
+
+
 def _set_raw(value):
     """Set this thread's raw TLS slot (value may be _UNSET to clear it)."""
-    global _TLS_COUNT, _ANY_ACTIVE
     with _LOCK:
-        prev = getattr(_TLS, "program", _UNSET)
-        prev_counted = prev is not _UNSET and prev is not None
-        now_counted = value is not _UNSET and value is not None
-        _TLS_COUNT += int(now_counted) - int(prev_counted)
+        if value is not _UNSET and value is not None:
+            _HOLDERS.add(threading.current_thread())
+        else:
+            _HOLDERS.discard(threading.current_thread())
         if value is _UNSET:
             try:
                 del _TLS.program
@@ -62,7 +76,7 @@ def _set_raw(value):
                 pass
         else:
             _TLS.program = value
-        _ANY_ACTIVE = _TLS_COUNT > 0 or _DEFAULT[0] is not None
+        _recount_locked()
 
 
 def set_active(program):
@@ -88,10 +102,9 @@ def restore(token):
 
 def set_default(program):
     """Set the process-global default program (paddle.enable_static)."""
-    global _ANY_ACTIVE
     with _LOCK:
         _DEFAULT[0] = program
-        _ANY_ACTIVE = _TLS_COUNT > 0 or program is not None
+        _recount_locked()
 
 
 def record(kind, payload, t_leaves, outputs):

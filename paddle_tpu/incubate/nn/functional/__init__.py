@@ -491,6 +491,28 @@ def fused_multi_transformer(
         **unused):
     """reference fused_transformer.py fused_multi_transformer — the whole
     decoder stack as one call: per layer, fused attention + fused FFN."""
+    # Semantically significant decode/rotary args must not be silently
+    # dropped: a GPT-NeoX-style caller passing rotary_embs would get wrong
+    # numerics without any signal (advisor r4).
+    if cache_kvs is not None:
+        raise NotImplementedError(
+            "fused_multi_transformer with cache_kvs (decode loop) is not "
+            "provided; use models.llama_decode.LlamaDecodeEngine for cached "
+            "generation")
+    for arg_name, arg in (("rotary_embs", rotary_embs),
+                          ("pre_caches", pre_caches),
+                          ("seq_lens", seq_lens),
+                          ("time_step", time_step)):
+        if arg is not None:
+            raise NotImplementedError(
+                f"fused_multi_transformer: {arg_name} is not supported by "
+                "this build; apply rotary embeddings in the model (see "
+                "models/llama.py) or use models.llama_decode."
+                "LlamaDecodeEngine for cached decoding")
+    if unused:
+        raise TypeError(
+            "fused_multi_transformer: unexpected keyword arguments "
+            f"{sorted(unused)}")
     out = x
     for i in range(len(qkv_weights)):
         out = fused_multi_head_attention(
@@ -516,11 +538,6 @@ def fused_multi_transformer(
             dropout1_rate=dropout_rate, dropout2_rate=dropout_rate,
             activation=activation, ln1_epsilon=epsilon, ln2_epsilon=epsilon,
             pre_layer_norm=pre_layer_norm, training=training, mode=mode)
-    if cache_kvs is not None:
-        raise NotImplementedError(
-            "fused_multi_transformer with cache_kvs (decode loop) is not "
-            "provided; use models.llama_decode.LlamaDecodeEngine for cached "
-            "generation")
     return out
 
 
@@ -550,6 +567,30 @@ def masked_multihead_attention(
     kernel. x is the packed qkv for the new token: (B, 3*H*D)."""
     from ....framework.core import Tensor
 
+    # Reject (rather than silently ignore) args that change the attention
+    # result: rotary embedding, masking, and the int8 quantization contract
+    # (advisor r4 — mirrors the existing explicit rejections below).
+    if rotary_tensor is not None or rotary_emb_dims:
+        raise NotImplementedError(
+            "masked_multihead_attention: rotary_tensor/rotary_emb_dims are "
+            "not supported; apply rotary embeddings to q/k before the call "
+            "(see models/llama.py apply_rotary)")
+    if src_mask is not None:
+        raise NotImplementedError(
+            "masked_multihead_attention: src_mask is not supported; decode "
+            "masking here is the causal write-position mask only")
+    if beam_cache_offset is not None:
+        raise NotImplementedError(
+            "masked_multihead_attention: beam_cache_offset (beam-search KV "
+            "reordering) is not supported; use LlamaDecodeEngine's beam "
+            "search for reordered-cache generation")
+    if qkv_out_scale is not None or out_shift is not None \
+            or out_smooth is not None or out_scale != -1.0:
+        raise NotImplementedError(
+            "masked_multihead_attention: int8 quantization params "
+            "(qkv_out_scale/out_shift/out_smooth/out_scale) are not "
+            "supported; use LlamaDecodeEngine(kv_cache_dtype='int8') for "
+            "quantized-KV decoding")
     if cache_kv is None:
         raise ValueError("cache_kv is required (shape [2, B, H, T, D])")
     xv = x.value if isinstance(x, Tensor) else jnp.asarray(x)

@@ -25,12 +25,12 @@ def _median_us(f, n=60):
 
 
 class TestDispatchBudget:
-    # bounds sit ~4.5-5x above the measured medians (round-4: tape-on add
+    # bounds sit ~2x above the measured medians (round-4: tape-on add
     # ~20us, fwd+bwd ~260us on the 1-core dev box; raw jnp.add alone is
-    # ~11us there) so CI noise passes but regressions to the pre-fast-path
-    # dispatch (~50us round-3, ~900us round-2) fail loudly
-    BUDGET_FWD_US = 100
-    BUDGET_FWD_BWD_US = 1200
+    # ~11us there) so CI noise passes but a 2-3x dispatch regression
+    # actually fails (round-4 verdict: the old 100us budget was 5x slack)
+    BUDGET_FWD_US = 40
+    BUDGET_FWD_BWD_US = 600
 
     def test_tape_on_forward_budget(self):
         y = paddle.to_tensor(np.random.randn(4, 4).astype("float32"))

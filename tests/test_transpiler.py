@@ -79,13 +79,23 @@ class TestDistributeTranspiler:
             srv = PSServer("127.0.0.1:0").start()
             results = {}
 
+            # build programs SEQUENTIALLY in the main thread: _build_program
+            # seeds the process-global RNG then draws the parameter init —
+            # two threads interleaving seed(7)/draw would give one trainer an
+            # advanced RNG state, so both trainers would agree on the wrong
+            # init (the server takes whichever init is pushed first) and the
+            # baseline comparison would fail (the round-4/5 flake)
+            preps = {}
+            for tid in (0, 1):
+                main, _, net = _build_program(7)  # identical init: same seed
+                t = DistributeTranspiler()
+                t.transpile(tid, program=main, pservers=srv.endpoint,
+                            trainers=2, sync_mode=True)
+                preps[tid] = (t.get_trainer_program(), net)
+
             def trainer(tid):
                 try:
-                    main, _, net = _build_program(7)  # identical init: same seed
-                    t = DistributeTranspiler()
-                    t.transpile(tid, program=main, pservers=srv.endpoint,
-                                trainers=2, sync_mode=True)
-                    tp = t.get_trainer_program()
+                    tp, net = preps[tid]
                     exe = paddle.static.Executor()
                     xs, ys = shards[tid]
                     for _ in range(5):
