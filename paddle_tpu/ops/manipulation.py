@@ -421,7 +421,16 @@ def take_along_axis(arr, indices, axis, broadcast=True, name=None):
 
 
 @defop("put_along_axis")
-def _put_along_axis(x, indices, values, axis, reduce="assign", include_self=True):
+def _put_along_axis(x, indices, values, axis, reduce="assign", include_self=True,
+                    broadcast=False):
+    if broadcast:
+        # broadcast INSIDE the dispatched op: doing it in the Python wrapper
+        # would bake the capture-time placeholder values in as constants and
+        # the static Executor would replay zeros instead of the feed
+        tgt = list(x.shape)
+        tgt[axis] = indices.shape[axis]
+        indices = jnp.broadcast_to(indices, tuple(tgt))
+        values = jnp.broadcast_to(values, tuple(tgt))
     if reduce == "assign":
         return jnp.put_along_axis(x, indices, values, axis=axis, inplace=False)
     base = x if include_self else jnp.put_along_axis(
@@ -455,14 +464,9 @@ def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=Tru
                    broadcast=True, name=None):
     if not isinstance(values, Tensor):
         values = Tensor(jnp.asarray(values, arr.value.dtype))
-    idx = indices
-    if broadcast:
-        tgt = list(arr.shape)
-        tgt[int(axis)] = idx.value.shape[int(axis)]
-        idx = Tensor(jnp.broadcast_to(idx.value, tuple(tgt)))
-        values = Tensor(jnp.broadcast_to(values.value, tuple(tgt)), stop_gradient=values.stop_gradient)
-    return _put_along_axis(arr, idx, values, axis=int(axis), reduce=reduce,
-                           include_self=bool(include_self))
+    return _put_along_axis(arr, indices, values, axis=int(axis), reduce=reduce,
+                           include_self=bool(include_self),
+                           broadcast=bool(broadcast))
 
 
 @defop("repeat_interleave")

@@ -9,7 +9,10 @@ TPU-first shape: one declarative OpCase per op; the harness
 2. re-runs on bfloat16 with loose thresholds (the TPU production dtype),
 3. checks the tape's analytic gradient against a float64 central finite
    difference of the op itself (x64 is enabled, so fp64 FD is trustworthy),
-4. optionally runs integer-dtype forwards.
+4. optionally runs integer-dtype forwards,
+5. pushes the op through BOTH capture paths — jit trace capture and the
+   capture-replay static Program/Executor — and asserts parity with eager
+   (the reference's dygraph/static/PIR consistency lane, op_test.py:418).
 """
 from __future__ import annotations
 
@@ -25,7 +28,8 @@ class OpCase:
                  dtypes=("float32", "bfloat16"), int_dtypes=(),
                  rtol=1e-5, atol=1e-6, bf16_rtol=2e-2, bf16_atol=2e-2,
                  grad_rtol=5e-3, grad_atol=5e-4, positive=False,
-                 grad_inputs=None, fp64=True, fp64_rtol=1e-9, fp64_atol=1e-10):
+                 grad_inputs=None, fp64=True, fp64_rtol=1e-9, fp64_atol=1e-10,
+                 static=True, static_waiver=None):
         self.name = name
         self.fn = fn            # callable over paddle Tensors
         self.ref = ref          # callable over numpy arrays
@@ -44,6 +48,14 @@ class OpCase:
         # accumulation-order/casting bugs the bf16/fp32 tolerances hide
         self.fp64 = fp64 and "float32" in dtypes
         self.fp64_rtol, self.fp64_atol = fp64_rtol, fp64_atol
+        # static-consistency lane: static=False requires static_waiver, a
+        # one-line reason (the reference runs every op in dygraph AND
+        # static/PIR modes; waivers here are audited by test_ops_parity-style
+        # bound tests in the numeric files)
+        self.static = static
+        self.static_waiver = static_waiver
+        if not static and not static_waiver:
+            raise ValueError(f"OpCase {name}: static=False needs a waiver")
 
     def _draw(self, rng, shape, dtype):
         if self.positive:
@@ -159,6 +171,75 @@ class OpCase:
             np.testing.assert_allclose(
                 analytic[i], fd, rtol=self.grad_rtol, atol=self.grad_atol,
                 err_msg=f"{self.name} grad mismatch on input {i}")
+
+
+    # -- static consistency --------------------------------------------------
+    def run_static(self):
+        """Dygraph/static consistency (reference op_test.py:418 checks every
+        op in dygraph AND static/PIR modes): the op must produce
+        eager-identical results through (a) jit trace capture and (b) the
+        capture-replay static Program/Executor — so a capture-path regression
+        in any single op surfaces here, not in a model-level test."""
+        if not self.static:
+            return
+        rng = np.random.RandomState(
+            zlib.crc32(self.name.encode()) % (2 ** 31) + 2)
+        if self.dtypes and "float32" in self.dtypes:
+            dtype = "float32"
+            base = [self._draw(rng, s, "float64").astype(np.float32)
+                    for s in self.inputs]
+        elif self.int_dtypes:
+            dtype = self.int_dtypes[0]
+            base = [rng.randint(1, 8, size=s).astype(dtype)
+                    for s in self.inputs]
+        else:
+            # a case the lane cannot drive must be explicitly waived, not
+            # silently green (it would count as static-covered otherwise)
+            raise AssertionError(
+                f"{self.name}: no float32/int dtype for the static lane — "
+                "mark static=False with a static_waiver")
+
+        def _tonp(o):
+            arr = np.asarray(o.value if hasattr(o, "value") else o)
+            # complex outputs compare as complex — a float64 cast would
+            # silently drop the imaginary half of the check
+            return arr.astype(np.complex128 if np.iscomplexobj(arr)
+                              else np.float64)
+
+        eager = _aslist(self.fn(*[paddle.to_tensor(b) for b in base],
+                                **self.kwargs))
+        eager_np = [_tonp(o) for o in eager]
+
+        # (a) jit trace capture: whole-fn jax trace must match per-op eager.
+        # Tolerance is tight-but-not-bitwise: XLA may fuse/reassociate.
+        jfn = paddle.jit.to_static(
+            lambda *ts: self.fn(*ts, **self.kwargs))
+        jout = _aslist(jfn(*[paddle.to_tensor(b) for b in base]))
+        assert len(jout) == len(eager_np), (
+            f"{self.name}: jit capture returned {len(jout)} outputs, "
+            f"eager returned {len(eager_np)}")
+        for g, e in zip(jout, eager_np):
+            np.testing.assert_allclose(
+                _tonp(g), e, rtol=1e-6, atol=1e-7,
+                err_msg=f"{self.name}: jit-captured output != eager")
+
+        # (b) static Program capture + Executor replay (fetch by tensor)
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            xs = [paddle.static.data(f"x{i}", list(s), dtype)
+                  for i, s in enumerate(self.inputs)]
+            out = _aslist(self.fn(*xs, **self.kwargs))
+        exe = paddle.static.Executor()
+        got = exe.run(main,
+                      feed={f"x{i}": b for i, b in enumerate(base)},
+                      fetch_list=list(out))
+        assert len(got) == len(eager_np), (
+            f"{self.name}: static Executor returned {len(got)} outputs, "
+            f"eager returned {len(eager_np)}")
+        for g, e in zip(got, eager_np):
+            np.testing.assert_allclose(
+                _tonp(g), e, rtol=1e-6, atol=1e-7,
+                err_msg=f"{self.name}: static Executor output != eager")
 
 
 def _aslist(x):

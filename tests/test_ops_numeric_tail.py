@@ -157,7 +157,9 @@ def _chan_scale(x):
 def _fcqd_fn(x):
     from paddle_tpu.quantization import _fake_qdq_channel
 
-    s = paddle.to_tensor(np.abs(x.numpy()).max(axis=0).astype("float32"))
+    # scale through dispatched ops (not x.numpy()) so the case stays
+    # jit-capturable — the static-consistency lane traces this fn
+    s = paddle.max(paddle.abs(x), axis=0)
     return _fake_qdq_channel(x, s, bits=8, axis=1)
 
 
@@ -857,8 +859,10 @@ TAIL_CASES = [
            lambda x: paddle.signal.overlap_add(x, 2),
            lambda x: _overlap_add_ref(x, 2), [(4, 3)]),
     OpCase("geometric.segment_reduce",
+           # count= is the documented jit-capturable form (segment ops need
+           # a static segment count inside traced regions)
            lambda x: paddle.geometric.segment_sum(
-               x, paddle.to_tensor(np.array([0, 0, 1, 1], "int64"))),
+               x, paddle.to_tensor(np.array([0, 0, 1, 1], "int64")), count=2),
            lambda x: np.stack([x[:2].sum(0), x[2:].sum(0)]), [(4, 3)]),
     OpCase("geometric.send_u_recv",
            lambda x: paddle.geometric.send_u_recv(
@@ -957,6 +961,29 @@ _GRAD = sorted(n for n, c in _TAIL_BY_NAME.items() if c.grad)
 @pytest.mark.parametrize("name", _GRAD, ids=str)
 def test_grad_finite_difference(name):
     _TAIL_BY_NAME[name].run_grad()
+
+
+_STATIC_CASES = sorted(n for n, c in _TAIL_BY_NAME.items() if c.static)
+
+
+@pytest.mark.parametrize("name", _STATIC_CASES, ids=str)
+def test_static_consistency(name):
+    """Every op through jit capture + the static Executor (VERDICT r4 #5;
+    reference op_test.py:418 dygraph/static/PIR consistency)."""
+    _TAIL_BY_NAME[name].run_static()
+
+
+def test_static_waivers_bounded():
+    """GLOBAL bound across both registry files — per-file bounds would let
+    the repo-wide count silently reach 2x the budget."""
+    import test_ops_numeric as base_mod
+
+    all_cases = {**base_mod._BY_NAME, **_TAIL_BY_NAME}
+    waived = sorted(n for n, c in all_cases.items() if not c.static)
+    assert len(waived) < 5, (
+        "static-consistency waivers must stay below 5 repo-wide "
+        "(VERDICT r4 #5): "
+        f"{[(n, all_cases[n].static_waiver) for n in waived]}")
 
 
 class TestCoverageEnforcement:
