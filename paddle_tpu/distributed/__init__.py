@@ -112,6 +112,7 @@ from . import launch  # noqa: F401
 from . import ps  # noqa: F401
 from . import rpc  # noqa: F401
 from . import transpiler  # noqa: F401
+from . import passes  # noqa: F401
 from .fleet.mpu.mp_ops import split  # noqa: F401
 
 

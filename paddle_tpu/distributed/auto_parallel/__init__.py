@@ -49,6 +49,19 @@ class DistModel:
         self._loss = loss
         self._optimizer = optimizer
         self._strategy = strategy
+        # strategy-enabled knobs run as the composable pass pipeline
+        # (distributed/passes) over this step context BEFORE the trace —
+        # the reference's _parallel_pir phase stack (engine.py:669)
+        self._pass_ctx = None
+        if strategy is not None:
+            from ..passes import PassContext, build_pipeline_from_strategy
+
+            pm = build_pipeline_from_strategy(strategy)
+            if pm.names:
+                ctx = PassContext(layer, loss, optimizer, strategy)
+                pm.apply(ctx)
+                self._pass_ctx = ctx
+        self._gm_state = None   # gradient-merge banks + counter (threaded)
         # fleet pipeline wrappers compute the loss inside train_batch, so a
         # separate loss module is optional for them
         trainable = optimizer is not None and (
@@ -101,19 +114,67 @@ class DistModel:
         keys = [sorted(inner._accumulators[id(p)].keys()) for p in params]
         return inner, keys
 
+    def _mw_params(self, inner):
+        """Params whose fp32 master weights must thread through the compiled
+        step (amp-O2 / multi_precision): creating them lazily INSIDE the
+        trace would store tracers in the optimizer dict and leak."""
+        if inner is None or not getattr(inner, "_use_master_weights", False):
+            return []
+        low = (np.dtype(np.float16), np.dtype(jnp.bfloat16))
+        params = self._params()
+        for p in params:
+            if np.dtype(p.dtype) in low and id(p) not in inner._master_weights:
+                inner._master_weights[id(p)] = p.value.astype(jnp.float32)
+        return [p for p in params if id(p) in inner._master_weights]
+
+    # gm gating + trainable filter live HERE only: _build and __call__ must
+    # agree on them or the threaded bank list misaligns with the traced one
+    # (the __call__ cache key carries both signatures so any change retraces)
+    def _gm_active(self, mode):
+        return (self._pass_ctx is not None
+                and self._pass_ctx.gradient_merge is not None
+                and mode == "train"
+                and not hasattr(self._layer, "train_batch"))
+
+    def _gm_param_list(self):
+        return [p for p in self._params()
+                if getattr(p, "trainable", True) and not p.stop_gradient]
+
     def _build(self, mode, n_args, treedef):
+        import contextlib
+
         layer, loss_fn, optimizer = self._layer, self._loss, self._optimizer
         params = self._params()
         buffers = self._buffers()
         state = params + buffers
         inner, acc_keys = (self._acc_state() if mode == "train" else (None, []))
+        mw_params = self._mw_params(inner) if mode == "train" else []
         uses_train_batch = mode == "train" and hasattr(layer, "train_batch")
+        guards = (self._pass_ctx.forward_guards if self._pass_ctx else [])
+        # gradient merge applies to the plain train step; fleet pipeline
+        # wrappers own their micro-batch accumulation already
+        gm = (self._pass_ctx.gradient_merge if self._gm_active(mode)
+              else None)
+        gm_params = self._gm_param_list() if gm else []
 
-        def step(state_vals, acc_vals, key, *data_vals):
+        def step(state_vals, acc_vals, mw_vals, gm_vals, sc_val, key,
+                 *data_vals):
+            # alignment contract with __call__ (checked at trace time): the
+            # threaded lists must match the build-time param lists exactly —
+            # zip truncation here would silently cross-wire state
+            assert len(mw_vals) == len(mw_params), \
+                f"master-weight threading misaligned: {len(mw_vals)} vs " \
+                f"{len(mw_params)}"
+            assert len(gm_vals) == (len(gm_params) + 1 if gm else 0), \
+                f"gradient-merge threading misaligned: {len(gm_vals)} vs " \
+                f"{len(gm_params)} params"
             with rng.trace_key(key):
                 saved_s = [(t, t._value) for t in state]
                 saved_a = ({id(p): dict(inner._accumulators[id(p)])
                             for p in params} if inner is not None else None)
+                saved_m = ({id(p): inner._master_weights[id(p)]
+                            for p in mw_params} if mw_params else None)
+                saved_sc = inner._step_count if inner is not None else None
                 try:
                     for t, v in zip(state, state_vals):
                         t._replace_value(v)
@@ -121,69 +182,184 @@ class DistModel:
                         for p, ks, vs in zip(params, acc_keys, acc_vals):
                             for k, v in zip(ks, vs):
                                 inner._accumulators[id(p)][k] = v
+                        # step_count threads as traced state: baked in as a
+                        # Python int it would freeze at its trace-time value
+                        # and Adam bias correction would never advance
+                        inner._step_count = sc_val
+                    for p, v in zip(mw_params, mw_vals):
+                        inner._master_weights[id(p)] = v
                     data = jax.tree_util.tree_unflatten(
                         treedef, [Tensor(v) for v in data_vals])
+                    new_gm = []
+                    # forward (+loss) runs under the pass pipeline's guards
+                    # (amp cast policy); backward/update stay outside, the
+                    # reference auto_cast semantics
+                    with contextlib.ExitStack() as es:
+                        for g in guards:
+                            es.enter_context(g())
+                        if uses_train_batch:
+                            # fleet pipeline wrapper: its micro-batch
+                            # schedule IS the step
+                            loss = layer.train_batch(list(data), optimizer)
+                        elif mode == "train":
+                            *inputs, label = data
+                            out = layer(*inputs)
+                            loss = loss_fn(out, label)
+                        elif mode == "eval":
+                            *inputs, label = data
+                            out = layer(*inputs)
+                            loss = loss_fn(out, label)
+                        else:
+                            out = layer(*data)
                     if uses_train_batch:
-                        # fleet pipeline wrapper: its micro-batch schedule IS the step
-                        loss = layer.train_batch(list(data), optimizer)
                         out_val = loss.value
                     elif mode == "train":
-                        *inputs, label = data
-                        out = layer(*inputs)
-                        loss = loss_fn(out, label)
                         loss.backward()
-                        optimizer.step()
-                        optimizer.clear_grad()
+                        if gm is None:
+                            optimizer.step()
+                            optimizer.clear_grad()
+                        else:
+                            new_gm = self._gm_step(
+                                gm, gm_params, gm_vals, params, acc_keys,
+                                mw_params, inner, optimizer)
                         out_val = loss.value
                     elif mode == "eval":
-                        *inputs, label = data
-                        out = layer(*inputs)
-                        out_val = loss_fn(out, label).value
+                        out_val = loss.value
                     else:
-                        out = layer(*data)
                         out_val = (out.value if isinstance(out, Tensor)
                                    else tuple(o.value for o in out))
                     new_state = [t._value for t in state]
                     new_acc = ([[inner._accumulators[id(p)][k] for k in ks]
                                 for p, ks in zip(params, acc_keys)]
                                if inner is not None else [])
-                    return out_val, new_state, new_acc
+                    new_mw = [inner._master_weights[id(p)] for p in mw_params]
+                    new_sc = (jnp.asarray(inner._step_count, jnp.int32)
+                              if inner is not None
+                              else jnp.zeros((), jnp.int32))
+                    return out_val, new_state, new_acc, new_mw, new_gm, new_sc
                 finally:
                     for t, v in saved_s:
                         t._replace_value(v)
                     if saved_a is not None:
                         for p in params:
                             inner._accumulators[id(p)] = saved_a[id(p)]
+                    if saved_m is not None:
+                        for p in mw_params:
+                            inner._master_weights[id(p)] = saved_m[id(p)]
+                    if inner is not None:
+                        inner._step_count = saved_sc
 
-        return jax.jit(step, donate_argnums=(0, 1))
+        return jax.jit(step, donate_argnums=(0, 1, 2, 3))
+
+    @staticmethod
+    def _gm_step(gm, gm_params, gm_vals, params, acc_keys, mw_params, inner,
+                 optimizer):
+        """Gradient merge inside ONE traced step, branchless: bank the grad,
+        compute the update unconditionally (its FLOPs are negligible next to
+        fwd+bwd), and jnp.where-select between banked and applied states on
+        the micro-step counter. The reference's gradient-merge pass builds
+        the same conditional as program regions
+        (passes/auto_parallel_gradient_merge.py); lax.cond is the other
+        option here but select keeps the program structurally identical
+        across micro-steps, which XLA prefers."""
+        k = gm["k_steps"]
+        counter, banks = gm_vals[-1], gm_vals[:-1]
+        new_banks = []
+        for p, b in zip(gm_params, banks):
+            g = p.grad
+            new_banks.append(b if g is None
+                             else b + g.value.astype(b.dtype))
+        is_apply = ((counter + 1) % k) == 0
+        for p, b in zip(gm_params, new_banks):
+            if p.grad is not None:
+                merged = b / float(k) if gm["avg"] else b
+                p.grad = Tensor(merged.astype(p.grad.value.dtype))
+        pre_p = [t._value for t in params]
+        pre_acc = [[inner._accumulators[id(p)][kk] for kk in ks]
+                   for p, ks in zip(params, acc_keys)]
+        pre_mw = [inner._master_weights[id(p)] for p in mw_params]
+        pre_sc = inner._step_count
+        optimizer.step()
+        optimizer.clear_grad()
+
+        def sel(new, old):
+            return jnp.where(is_apply, new, old)
+
+        for t, pre in zip(params, pre_p):
+            t._replace_value(sel(t._value, pre))
+        for p, ks, pres in zip(params, acc_keys, pre_acc):
+            for kk, pre in zip(ks, pres):
+                inner._accumulators[id(p)][kk] = sel(
+                    inner._accumulators[id(p)][kk], pre)
+        for p, pre in zip(mw_params, pre_mw):
+            inner._master_weights[id(p)] = sel(
+                inner._master_weights[id(p)], pre)
+        # the optimizer's step counter only advances on APPLY steps (the
+        # eager GradientMergeOptimizer calls inner.step() k times less often)
+        inner._step_count = sel(jnp.asarray(inner._step_count, jnp.int32),
+                                jnp.asarray(pre_sc, jnp.int32))
+        return [jnp.where(is_apply, jnp.zeros_like(b), b)
+                for b in new_banks] + [counter + 1]
 
     def __call__(self, *args):
         mode = self._mode
         leaves, treedef = jax.tree_util.tree_flatten(
             list(args), is_leaf=lambda x: isinstance(x, Tensor))
         data_vals = [_to_value(l) for l in leaves]
-        sig = (mode, treedef,
-               tuple((tuple(v.shape), str(v.dtype)) for v in data_vals))
-        if sig not in self._cache:
-            self._cache[sig] = self._build(mode, len(data_vals), treedef)
-        step = self._cache[sig]
 
         params = self._params()
         buffers = self._buffers()
         state = params + buffers
         inner, acc_keys = (self._acc_state() if mode == "train" else (None, []))
+        mw_params = self._mw_params(inner) if mode == "train" else []
+        gm_on = self._gm_active(mode)
+        gm_params = self._gm_param_list() if gm_on else []
+        # the threading signatures are part of the cache key: if the
+        # master-weight or trainable set changes (amp.decorate after a step,
+        # freezing a layer), the step REBUILDS with the current lists instead
+        # of zip-truncating against a stale closure
+        sig = (mode, treedef,
+               tuple((tuple(v.shape), str(v.dtype)) for v in data_vals),
+               tuple(id(p) for p in mw_params),
+               tuple(id(p) for p in gm_params) if gm_on else None)
+        if sig not in self._cache:
+            self._cache[sig] = self._build(mode, len(data_vals), treedef)
+        step = self._cache[sig]
+
         state_vals = [t.value for t in state]
         acc_vals = ([[inner._accumulators[id(p)][k] for k in ks]
                      for p, ks in zip(params, acc_keys)]
                     if inner is not None else [])
-        out_val, new_state, new_acc = step(
-            state_vals, acc_vals, rng.next_key(), *data_vals)
+        mw_vals = [inner._master_weights[id(p)] for p in mw_params]
+        if gm_on:
+            gm_ids = tuple(id(p) for p in gm_params)
+            if self._gm_state is None or self._gm_state[0] != gm_ids:
+                # (re)start the banks: a changed trainable set discards any
+                # partial accumulation — explicit reset beats cross-wiring
+                self._gm_state = (gm_ids,
+                                  [jnp.zeros_like(p.value) for p in gm_params]
+                                  + [jnp.zeros((), jnp.int32)])
+            gm_vals = self._gm_state[1]
+        else:
+            gm_vals = []
+        sc_val = (jnp.asarray(inner._step_count, jnp.int32)
+                  if inner is not None else jnp.zeros((), jnp.int32))
+        out_val, new_state, new_acc, new_mw, new_gm, new_sc = step(
+            state_vals, acc_vals, mw_vals, gm_vals, sc_val, rng.next_key(),
+            *data_vals)
         for t, v in zip(state, new_state):
             t._replace_value(v)
         if inner is not None:
             for p, ks, vs in zip(params, acc_keys, new_acc):
                 for k, v in zip(ks, vs):
                     inner._accumulators[id(p)][k] = v
+            # stays a device array between calls (an int() here would force
+            # a sync per step); eager += and asarray both accept it
+            inner._step_count = new_sc
+        for p, v in zip(mw_params, new_mw):
+            inner._master_weights[id(p)] = v
+        if gm_on:
+            self._gm_state = (gm_ids, list(new_gm))
         if isinstance(out_val, tuple):
             return tuple(Tensor(v) for v in out_val)
         return Tensor(out_val)

@@ -1,0 +1,270 @@
+"""Composable distributed pass pipeline for the Engine d2s path.
+
+Reference analog: python/paddle/distributed/passes/ (pass_base.py new_pass/
+PassManager/PassContext; auto_parallel_amp.py, auto_parallel_fp16.py,
+auto_parallel_recompute.py, auto_parallel_sharding.py,
+auto_parallel_gradient_merge.py) composed in order by
+auto_parallel/static/engine.py:_parallel_pir (:669): amp decorate before
+autodiff, then recompute/sharding as program rewrites, then gradient-merge
+and pipeline scheduling as optimization passes over one program.
+
+TPU-first redesign: the reference's "program" is a PIR module each pass
+rewrites; here the program is the ONE jax trace DistModel compiles, so a
+pass is an ordered transformation of the StepContext — the model / loss /
+optimizer / forward-scope guards / step-state extensions that the trace is
+assembled from. Applying the pipeline then tracing produces the same single
+XLA program the reference's pass stack hand-builds, with GSPMD doing the
+partitioning and XLA the fusion:
+
+  - auto_parallel_amp      -> dtype policy: amp.auto_cast guard around the
+                              traced forward+loss, amp.decorate on model/
+                              optimizer (O2 master weights)
+  - auto_parallel_recompute-> forward segments rewritten under
+                              jax.checkpoint (fleet.recompute)
+  - auto_parallel_sharding -> ZeRO placements on optimizer state
+                              (api.ShardingStage1/2/3 shard_fn)
+  - auto_parallel_gradient_merge -> k-step gradient banking; the traced
+                              step computes the update every micro-step and
+                              SELECTS (jnp.where on the bank counter)
+                              between banked and applied states — branchless
+                              and jit-compatible, the optimizer-update FLOPs
+                              being negligible next to fwd+bwd
+
+Pass-order contract (PASS_ORDER): amp < recompute < sharding <
+gradient_merge. PassManager sorts its passes by this order and refuses
+unknown names, so a mis-ordered user list still applies correctly — the
+reference enforces the same implicitly by _parallel_pir's phase structure.
+"""
+from __future__ import annotations
+
+import fnmatch
+
+__all__ = ["new_pass", "PassBase", "PassContext", "PassManager",
+           "PASS_ORDER", "build_pipeline_from_strategy"]
+
+
+# the explicit order contract (see module docstring for the why of each edge)
+PASS_ORDER = (
+    "auto_parallel_amp",
+    "auto_parallel_fp16",        # alias lane: fp16 == amp at O2/fp16
+    "auto_parallel_recompute",
+    "auto_parallel_sharding",
+    "auto_parallel_gradient_merge",
+)
+
+_REGISTRY: dict[str, type] = {}
+
+
+def register_pass(name):
+    def deco(cls):
+        cls.name = name
+        _REGISTRY[name] = cls
+        return cls
+    return deco
+
+
+def new_pass(name, attrs=None):
+    """reference pass_base.py new_pass(name, attrs): instantiate by name."""
+    if name not in _REGISTRY:
+        raise ValueError(
+            f"unknown pass {name!r}; registered: {sorted(_REGISTRY)}")
+    return _REGISTRY[name](attrs)
+
+
+class PassContext:
+    """What the pass pipeline transforms (reference PassContext carries the
+    program + dist_context; here: the pieces the one-trace step is built
+    from)."""
+
+    def __init__(self, model=None, loss=None, optimizer=None, strategy=None):
+        self.model = model
+        self.loss = loss
+        self.optimizer = optimizer
+        self.strategy = strategy
+        # callables returning context managers, entered (in order) around
+        # the traced forward+loss
+        self.forward_guards = []
+        # None or {"k_steps": int, "avg": bool} — consumed by DistModel
+        self.gradient_merge = None
+        self.applied = []           # pass names, in application order
+
+
+class PassBase:
+    name = None
+
+    def __init__(self, attrs=None):
+        self.attrs = dict(attrs or {})
+
+    def check(self, ctx):  # noqa: ARG002 - subclass hook
+        return True
+
+    def apply(self, ctx):
+        raise NotImplementedError
+
+
+class PassManager:
+    """Ordered application of a pass list (reference pass_base.py
+    PassManager). Passes are sorted by PASS_ORDER; unknown passes raise."""
+
+    def __init__(self, passes):
+        for p in passes:
+            if p.name not in PASS_ORDER:
+                raise ValueError(
+                    f"pass {p.name!r} has no position in PASS_ORDER; "
+                    "register it there with an explicit ordering rationale")
+        self._passes = sorted(passes, key=lambda p: PASS_ORDER.index(p.name))
+
+    @property
+    def names(self):
+        return [p.name for p in self._passes]
+
+    def apply(self, ctx):
+        for p in self._passes:
+            if not p.check(ctx):
+                raise ValueError(f"pass {p.name} check failed on this context")
+            p.apply(ctx)
+            ctx.applied.append(p.name)
+        return ctx
+
+
+@register_pass("auto_parallel_amp")
+class AMPPass(PassBase):
+    """reference auto_parallel_amp.py: dtype-policy rewrite. O1 wraps compute
+    in the cast policy; O2 additionally casts params low-precision with fp32
+    master weights on the optimizer (amp/auto_cast.py decorate)."""
+
+    def apply(self, ctx):
+        from ...amp import auto_cast, decorate
+
+        level = str(self.attrs.get("level", "O1")).upper()
+        dtype = self.attrs.get("dtype", "bfloat16")
+        if self.attrs.get("use_pure_fp16"):
+            level, dtype = "O2", "float16"
+        white = self.attrs.get("custom_white_list") or None
+        black = self.attrs.get("custom_black_list") or None
+        if level == "O2" and ctx.model is not None:
+            decorate(ctx.model, ctx.optimizer, level="O2", dtype=dtype,
+                     master_weight=self.attrs.get("master_weight"))
+        ctx.forward_guards.append(
+            lambda: auto_cast(True, custom_white_list=white,
+                              custom_black_list=black, level=level,
+                              dtype=dtype))
+
+
+@register_pass("auto_parallel_fp16")
+class FP16Pass(AMPPass):
+    """reference auto_parallel_fp16.py — pure-fp16 lane of the amp pass."""
+
+    def apply(self, ctx):
+        self.attrs.setdefault("level", "O2")
+        self.attrs.setdefault("dtype", "float16")
+        super().apply(ctx)
+
+
+@register_pass("auto_parallel_recompute")
+class RecomputePass(PassBase):
+    """reference auto_parallel_recompute.py: rewrite checkpointed segments so
+    activations are rematerialized in backward. Here: wrap matching
+    sublayers' forwards in fleet.recompute (jax.checkpoint)."""
+
+    def apply(self, ctx):
+        from ..fleet.recompute import recompute
+
+        if ctx.model is None:
+            return
+        patterns = [p for p in (self.attrs.get("checkpoints") or []) if p]
+        policy = self.attrs.get("checkpoint_policy")
+        wrapped = 0
+        for name, sub in ctx.model.named_sublayers():
+            if getattr(sub, "_recompute_pass_wrapped", False):
+                continue
+            if patterns:
+                if not any(fnmatch.fnmatch(name, pat) or name == pat
+                           for pat in patterns):
+                    continue
+            else:
+                # default segmentation: direct children that own parameters
+                # (the reference's PipelineLayer-style per-block checkpoints)
+                if "." in name or not any(
+                        True for _ in sub.parameters()):
+                    continue
+            orig = sub.forward
+
+            def make(fwd):
+                def fwd_recompute(*a, **k):
+                    if policy is not None:
+                        k = dict(k, checkpoint_policy=policy)
+                    return recompute(fwd, *a, **k)
+                return fwd_recompute
+
+            sub.forward = make(orig)
+            sub._recompute_pass_wrapped = True
+            wrapped += 1
+        if patterns and not wrapped:
+            raise ValueError(
+                f"recompute pass: no sublayer matched checkpoints={patterns}")
+
+
+@register_pass("auto_parallel_sharding")
+class ShardingPass(PassBase):
+    """reference auto_parallel_sharding.py: ZeRO. Stage 1/2 put Shard(0)
+    placements on optimizer state (gradients reduce-scatter under GSPMD);
+    stage 3 additionally shards the parameters themselves."""
+
+    def apply(self, ctx):
+        from ..api import (ShardingStage1, ShardingStage2, ShardingStage3,
+                           shard_optimizer)
+
+        if ctx.optimizer is None:
+            return
+        stage = int(self.attrs.get("stage", 1))
+        cls = {1: ShardingStage1, 2: ShardingStage2, 3: ShardingStage3}.get(stage)
+        if cls is None:
+            raise ValueError(f"sharding stage must be 1/2/3, got {stage}")
+        fn = cls(mesh=self.attrs.get("mesh"),
+                 sharding_mesh_dim=self.attrs.get("sharding_mesh_dim"))
+        inner = getattr(ctx.optimizer, "inner_opt", ctx.optimizer)
+        shard_optimizer(inner, fn)
+        if stage == 3 and ctx.model is not None:
+            for p in ctx.model.parameters():
+                fn.apply_to_param(p)
+
+
+@register_pass("auto_parallel_gradient_merge")
+class GradientMergePass(PassBase):
+    """reference auto_parallel_gradient_merge.py: accumulate grads k steps,
+    apply once. Consumed by DistModel's trace as branchless select state
+    (see module docstring) — this pass only records the config, which is
+    why it must sort last: it changes WHEN the update applies, not what any
+    earlier pass computes."""
+
+    def apply(self, ctx):
+        k = int(self.attrs.get("k_steps", 1))
+        if k < 1:
+            raise ValueError(f"gradient merge k_steps must be >= 1, got {k}")
+        ctx.gradient_merge = {"k_steps": k,
+                              "avg": bool(self.attrs.get("avg", True))}
+
+
+def build_pipeline_from_strategy(strategy):
+    """Map a DistributedStrategy/Strategy's enabled knobs onto the pass
+    pipeline (the reference Engine does this wiring inside _parallel_pir)."""
+    passes = []
+    if getattr(strategy, "amp", False):
+        cfg = dict(getattr(strategy, "amp_configs", {}) or {})
+        if "level" not in cfg:
+            cfg["level"] = "O2" if cfg.get("use_pure_fp16") else "O1"
+        if "dtype" not in cfg:
+            cfg["dtype"] = ("bfloat16" if cfg.get("use_bf16", True)
+                            else "float16")
+        passes.append(new_pass("auto_parallel_amp", cfg))
+    if getattr(strategy, "recompute", False):
+        passes.append(new_pass("auto_parallel_recompute",
+                               getattr(strategy, "recompute_configs", {})))
+    if getattr(strategy, "sharding", False):
+        passes.append(new_pass("auto_parallel_sharding",
+                               getattr(strategy, "sharding_configs", {})))
+    if getattr(strategy, "gradient_merge", False):
+        passes.append(new_pass("auto_parallel_gradient_merge",
+                               getattr(strategy, "gradient_merge_configs", {})))
+    return PassManager(passes)

@@ -1,0 +1,161 @@
+"""Composable dist-pass pipeline (VERDICT r4 #6).
+
+Reference analog: python/paddle/distributed/passes/ (new_pass/PassManager
+composition) driven by auto_parallel/static/engine.py:_parallel_pir — amp +
+recompute + sharding + gradient-merge stack as ordered passes over one
+program. Here the pipeline transforms the StepContext DistModel traces into
+ONE XLA program; the acceptance check is the reference's own: the composed
+d2s run must reproduce the eager composition's loss curve.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.passes import (
+    PASS_ORDER, PassContext, PassManager, build_pipeline_from_strategy,
+    new_pass)
+
+
+class TestPassRegistry:
+    def test_new_pass_unknown_raises(self):
+        with pytest.raises(ValueError, match="unknown pass"):
+            new_pass("no_such_pass")
+
+    def test_manager_sorts_by_order_contract(self):
+        pm = PassManager([
+            new_pass("auto_parallel_gradient_merge", {"k_steps": 2}),
+            new_pass("auto_parallel_sharding", {"stage": 1}),
+            new_pass("auto_parallel_amp", {"level": "O1"}),
+            new_pass("auto_parallel_recompute", {}),
+        ])
+        assert pm.names == [
+            "auto_parallel_amp", "auto_parallel_recompute",
+            "auto_parallel_sharding", "auto_parallel_gradient_merge"]
+        assert pm.names == [n for n in PASS_ORDER if n in pm.names]
+
+    def test_gradient_merge_validates_k(self):
+        with pytest.raises(ValueError, match="k_steps"):
+            new_pass("auto_parallel_gradient_merge", {"k_steps": 0}).apply(
+                PassContext())
+
+    def test_strategy_wiring_enables_all_four(self):
+        s = paddle.distributed.fleet.DistributedStrategy()
+        s.amp = True
+        s.amp_configs = {"level": "O2", "dtype": "bfloat16"}
+        s.recompute = True
+        s.sharding = True
+        s.sharding_configs = {"stage": 1}
+        s.gradient_merge = True
+        s.gradient_merge_configs = {"k_steps": 2, "avg": True}
+        pm = build_pipeline_from_strategy(s)
+        assert pm.names == [
+            "auto_parallel_amp", "auto_parallel_recompute",
+            "auto_parallel_sharding", "auto_parallel_gradient_merge"]
+
+
+def _make_model(seed):
+    paddle.seed(seed)
+    return paddle.nn.Sequential(
+        paddle.nn.Linear(16, 32), paddle.nn.ReLU(),
+        paddle.nn.Linear(32, 32), paddle.nn.ReLU(),
+        paddle.nn.Linear(32, 4))
+
+
+def _data(n=8, steps=6):
+    # ONE fixed batch repeated: the loss-decrease acceptance below needs a
+    # stationary objective (per-step random batches make the curve jump)
+    r = np.random.RandomState(0)
+    xb = r.randn(n, 16).astype("float32")
+    yb = r.randint(0, 4, (n,)).astype("int64")
+    return [(xb, yb) for _ in range(steps)]
+
+
+@pytest.mark.slow
+class TestComposedPipelineTrains:
+    """Acceptance (VERDICT r4 #6): Engine.fit with amp-O2 + recompute +
+    sharding + gradient-merge enabled produces the same loss curve as the
+    eager composition of the same four features."""
+
+    def test_all_four_passes_match_eager_composition(self):
+        from paddle_tpu.amp import auto_cast, decorate
+        from paddle_tpu.distributed.auto_parallel import Engine
+        from paddle_tpu.distributed.fleet.meta_optimizers import (
+            GradientMergeOptimizer)
+        from paddle_tpu.distributed.fleet.recompute import recompute
+
+        batches = _data()
+        loss_fn = paddle.nn.CrossEntropyLoss()
+
+        # ---- eager composition (the reference semantics baseline)
+        model_e = _make_model(3)
+        opt_e = paddle.optimizer.Momentum(
+            learning_rate=0.1, momentum=0.9,
+            parameters=model_e.parameters(), multi_precision=True)
+        decorate(model_e, opt_e, level="O2", dtype="bfloat16")
+        for sub in model_e:          # same segmentation the pass defaults to
+            if any(True for _ in sub.parameters()):
+                orig = sub.forward
+                sub.forward = (lambda f: lambda *a, **k: recompute(f, *a, **k))(orig)
+        gm_e = GradientMergeOptimizer(opt_e, k_steps=2, avg=True)
+        eager_losses = []
+        for xb, yb in batches:
+            with auto_cast(True, level="O2", dtype="bfloat16"):
+                out = model_e(paddle.to_tensor(xb))
+                loss = loss_fn(out, paddle.to_tensor(yb))
+            loss.backward()
+            gm_e.step()
+            gm_e.clear_grad()
+            eager_losses.append(float(np.asarray(loss.value)))
+
+        # ---- d2s composition through the pass pipeline
+        model_s = _make_model(3)
+        opt_s = paddle.optimizer.Momentum(
+            learning_rate=0.1, momentum=0.9,
+            parameters=model_s.parameters(), multi_precision=True)
+        strategy = paddle.distributed.fleet.DistributedStrategy()
+        strategy.amp = True
+        strategy.amp_configs = {"level": "O2", "dtype": "bfloat16"}
+        strategy.recompute = True
+        strategy.sharding = True
+        strategy.sharding_configs = {"stage": 1}
+        strategy.gradient_merge = True
+        strategy.gradient_merge_configs = {"k_steps": 2, "avg": True}
+
+        eng = Engine(model=model_s, loss=loss_fn, optimizer=opt_s,
+                     strategy=strategy)
+        hist = eng.fit([(x, y) for x, y in batches], epochs=1)
+        d2s_losses = hist["loss"]
+
+        assert len(d2s_losses) == len(eager_losses)
+        # both sides compute forward in bf16; jit fusion reassociates, so
+        # exact-equality is not expected — but the curves must track
+        np.testing.assert_allclose(d2s_losses, eager_losses,
+                                   rtol=5e-2, atol=5e-2)
+        # and training must actually progress (the merged update applied)
+        assert d2s_losses[-1] < d2s_losses[0], d2s_losses
+
+    def test_gradient_merge_only_updates_every_k(self):
+        """Bank micro-steps must leave parameters untouched; apply steps
+        must change them — directly, not just via the loss curve."""
+        from paddle_tpu.distributed.auto_parallel import Engine
+
+        model = _make_model(5)
+        opt = paddle.optimizer.SGD(learning_rate=0.5,
+                                   parameters=model.parameters())
+        strategy = paddle.distributed.fleet.DistributedStrategy()
+        strategy.gradient_merge = True
+        strategy.gradient_merge_configs = {"k_steps": 2, "avg": True}
+        loss_fn = paddle.nn.CrossEntropyLoss()
+        eng = Engine(model=model, loss=loss_fn, optimizer=opt,
+                     strategy=strategy)
+        dm = eng.prepare()._dist_model.train()
+
+        w = model[0].weight
+        batches = _data(steps=3)
+        w0 = np.asarray(w.value).copy()
+        dm(paddle.to_tensor(batches[0][0]), paddle.to_tensor(batches[0][1]))
+        w1 = np.asarray(w.value).copy()
+        np.testing.assert_array_equal(w0, w1)   # bank step: no update
+        dm(paddle.to_tensor(batches[1][0]), paddle.to_tensor(batches[1][1]))
+        w2 = np.asarray(w.value).copy()
+        assert np.abs(w2 - w1).max() > 0        # apply step: update landed
