@@ -102,26 +102,26 @@ class DistModel:
     def _buffers(self):
         return [b for _, b in self._layer.named_buffers() if b is not None]
 
-    def _acc_state(self):
+    def _acc_state(self, params=None):
         opt = self._optimizer
         if opt is None:
             return [], []
         inner = getattr(opt, "inner_opt", opt)
-        params = self._params()
+        params = self._params() if params is None else params
         for p in params:
             if id(p) not in inner._accumulators:
                 inner._accumulators[id(p)] = inner._init_sharded_state(p)
         keys = [sorted(inner._accumulators[id(p)].keys()) for p in params]
         return inner, keys
 
-    def _mw_params(self, inner):
+    def _mw_params(self, inner, params=None):
         """Params whose fp32 master weights must thread through the compiled
         step (amp-O2 / multi_precision): creating them lazily INSIDE the
         trace would store tracers in the optimizer dict and leak."""
         if inner is None or not getattr(inner, "_use_master_weights", False):
             return []
         low = (np.dtype(np.float16), np.dtype(jnp.bfloat16))
-        params = self._params()
+        params = self._params() if params is None else params
         for p in params:
             if np.dtype(p.dtype) in low and id(p) not in inner._master_weights:
                 inner._master_weights[id(p)] = p.value.astype(jnp.float32)
@@ -136,8 +136,8 @@ class DistModel:
                 and mode == "train"
                 and not hasattr(self._layer, "train_batch"))
 
-    def _gm_param_list(self):
-        return [p for p in self._params()
+    def _gm_param_list(self, params=None):
+        return [p for p in (self._params() if params is None else params)
                 if getattr(p, "trainable", True) and not p.stop_gradient]
 
     def _build(self, mode, n_args, treedef):
@@ -147,15 +147,17 @@ class DistModel:
         params = self._params()
         buffers = self._buffers()
         state = params + buffers
-        inner, acc_keys = (self._acc_state() if mode == "train" else (None, []))
-        mw_params = self._mw_params(inner) if mode == "train" else []
+        inner, acc_keys = (self._acc_state(params) if mode == "train"
+                           else (None, []))
+        mw_params = (self._mw_params(inner, params) if mode == "train"
+                     else [])
         uses_train_batch = mode == "train" and hasattr(layer, "train_batch")
         guards = (self._pass_ctx.forward_guards if self._pass_ctx else [])
         # gradient merge applies to the plain train step; fleet pipeline
         # wrappers own their micro-batch accumulation already
         gm = (self._pass_ctx.gradient_merge if self._gm_active(mode)
               else None)
-        gm_params = self._gm_param_list() if gm else []
+        gm_params = self._gm_param_list(params) if gm else []
 
         def step(state_vals, acc_vals, mw_vals, gm_vals, sc_val, key,
                  *data_vals):
@@ -310,10 +312,12 @@ class DistModel:
         params = self._params()
         buffers = self._buffers()
         state = params + buffers
-        inner, acc_keys = (self._acc_state() if mode == "train" else (None, []))
-        mw_params = self._mw_params(inner) if mode == "train" else []
+        inner, acc_keys = (self._acc_state(params) if mode == "train"
+                           else (None, []))
+        mw_params = (self._mw_params(inner, params) if mode == "train"
+                     else [])
         gm_on = self._gm_active(mode)
-        gm_params = self._gm_param_list() if gm_on else []
+        gm_params = self._gm_param_list(params) if gm_on else []
         # the threading signatures are part of the cache key: if the
         # master-weight or trainable set changes (amp.decorate after a step,
         # freezing a layer), the step REBUILDS with the current lists instead

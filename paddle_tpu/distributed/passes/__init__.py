@@ -227,7 +227,14 @@ class ShardingPass(PassBase):
         shard_optimizer(inner, fn)
         if stage == 3 and ctx.model is not None:
             for p in ctx.model.parameters():
-                fn.apply_to_param(p)
+                # apply_to_param RETURNS a resharded Parameter (shard_tensor
+                # builds a new one); swap the placement into the live param
+                # or stage 3 would silently degrade to stage 1
+                new = fn.apply_to_param(p)
+                if new is not p:
+                    p._replace_value(new.value)
+                    p._dist_attr = new._dist_attr
+                    p.is_distributed = True
 
 
 @register_pass("auto_parallel_gradient_merge")

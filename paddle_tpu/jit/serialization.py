@@ -9,6 +9,7 @@ Python model code, which is this framework's AnalysisPredictor path.
 """
 from __future__ import annotations
 
+import hashlib
 import os
 import pickle
 
@@ -22,6 +23,28 @@ from ..framework import dtype as dtype_mod
 from ..nn.layer.layers import Layer
 from ..autograd import tape
 from ..framework import random as rng
+
+# Artifact format version (reference analog:
+# paddle/fluid/pir/serialize_deserialize/ versions its program format and
+# applies version patches on load). Bump ONLY on layout changes to the
+# .pdiparams dict or the .pdmodel/.pdiparams pairing contract; the .pdmodel
+# payload itself is jax.export-serialized StableHLO, which carries jax's own
+# serialization versioning. Loaders accept every version <= FORMAT_VERSION
+# (0 = pre-versioning artifacts from rounds 1-4) and refuse newer with a
+# clear error; tests/fixtures/jit_save_v1/ pins that v1 artifacts stay
+# loadable.
+FORMAT_VERSION = 1
+
+
+def _op_registry_hash():
+    """Short hash of the defop registry. Recorded for provenance/diagnosis —
+    NOT enforced on load: the exported StableHLO is self-contained, so an
+    artifact from a build with a different op set still executes; the hash
+    tells a debugger which registry produced it."""
+    from ..ops.optable import op_table
+
+    names = sorted(str(r.get("name")) for r in op_table())
+    return hashlib.sha256(",".join(names).encode()).hexdigest()[:16]
 
 
 def _trace_fn_for(layer: Layer):
@@ -108,7 +131,10 @@ def save(layer, path, input_spec=None, **config):
                    for i, s in enumerate(input_spec)]
     with open(path + ".pdiparams", "wb") as f:
         pickle.dump({"state_names": names, "state": state,
-                     "input_names": input_names}, f)
+                     "input_names": input_names,
+                     "format_version": FORMAT_VERSION,
+                     "op_registry_hash": _op_registry_hash(),
+                     "producer": "paddle_tpu"}, f)
 
 
 class TranslatedLayer(Layer):
@@ -128,10 +154,18 @@ class TranslatedLayer(Layer):
 
 
 def load(path, **config):
-    with open(path + ".pdmodel", "rb") as f:
-        exported = jax.export.deserialize(f.read())
     with open(path + ".pdiparams", "rb") as f:
         meta = pickle.load(f)
+    ver = int(meta.get("format_version", 0))  # 0 = pre-versioning artifact
+    if ver > FORMAT_VERSION:
+        raise RuntimeError(
+            f"jit.load: artifact {path!r} has format version {ver}, newer "
+            f"than this build's {FORMAT_VERSION} (producer "
+            f"{meta.get('producer', 'unknown')!r}, op registry "
+            f"{meta.get('op_registry_hash', '?')}) — load it with the "
+            "paddle_tpu build that produced it, or re-export")
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jax.export.deserialize(f.read())
     state_vals = [meta["state"][n] for n in meta["state_names"]]
     return TranslatedLayer(exported, state_vals,
                            input_names=meta.get("input_names"))
