@@ -7,6 +7,7 @@ import warnings as _warnings
 from . import cpp_extension  # noqa: F401
 from . import custom_op  # noqa: F401
 from . import download  # noqa: F401
+from . import weights  # noqa: F401
 from .custom_op import get_custom_op, register_custom_op  # noqa: F401
 from ..ops.optable import generate_op_docs, op_table  # noqa: F401
 

@@ -1,0 +1,177 @@
+"""Pretrained-weight loading & cross-framework conversion.
+
+Reference analog: python/paddle/vision/models/resnet.py — every zoo entry
+downloads hub weights (get_weights_path_from_url) and set_state_dict()s
+them. This zero-egress TPU build takes a local checkpoint PATH wherever the
+reference takes ``pretrained=True``:
+
+  model = paddle.vision.models.resnet18(pretrained="/path/ckpt.pdparams")
+
+Formats read WITHOUT importing the reference framework (or torch):
+  - ``.pdparams`` / ``.pkl`` / anything else: the reference's paddle.save
+    state-dict format — a plain pickle of {name: ndarray} (paddle pickles
+    parameter values as numpy arrays; framework/io.py:773)
+  - ``.safetensors``: via safetensors.numpy
+
+Conversion handles the two layout/naming gaps between ecosystems:
+  - torch nn.Linear stores weight as [out, in]; this build (like the
+    reference) stores [in, out] -> 2-D non-embedding weights transpose
+  - torch BatchNorm running stats are running_mean/running_var; here (as in
+    the reference) they are _mean/_variance; num_batches_tracked is dropped
+"""
+from __future__ import annotations
+
+import pickle
+import re
+
+import numpy as np
+
+__all__ = ["load_checkpoint", "convert_torch_state_dict",
+           "convert_hf_bert_state_dict", "load_pretrained",
+           "load_zoo_pretrained"]
+
+
+def load_checkpoint(path):
+    """Read a checkpoint file into {name: np.ndarray} (no reference-framework
+    import). Handles: safetensors; the reference's plain pickle of
+    {name: ndarray}; and THIS build's paddle.save format (framework_io packs
+    each tensor as a {'__tensor__': ...} dict — _unpack decodes it, incl.
+    the bf16 uint16 view)."""
+    path = str(path)
+    if path.endswith(".safetensors"):
+        from safetensors.numpy import load_file
+
+        return dict(load_file(path))
+    from ..framework_io import _unpack
+
+    with open(path, "rb") as f:
+        sd = pickle.load(f)
+    if not isinstance(sd, dict):
+        raise ValueError(
+            f"checkpoint {path!r} did not unpickle to a state dict "
+            f"(got {type(sd).__name__})")
+    out = {}
+    for k, v in sd.items():
+        if k == "StructuredToParameterName@@":  # reference bookkeeping entry
+            continue
+        out[str(k)] = np.asarray(_unpack(v, return_numpy=True))
+    return out
+
+
+_TORCH_RENAMES = (
+    (re.compile(r"\.running_mean$"), "._mean"),
+    (re.compile(r"\.running_var$"), "._variance"),
+)
+
+
+def convert_torch_state_dict(sd, no_transpose=("embed",)):
+    """Map a torch-convention state dict onto this build's conventions:
+    rename BN running stats, drop num_batches_tracked, strip a DataParallel
+    'module.' prefix, and transpose 2-D linear weights ([out,in] -> [in,out]).
+    Keys whose name contains any of ``no_transpose`` keep their layout
+    (embedding tables are [vocab, dim] on both sides)."""
+    out = {}
+    for k, v in sd.items():
+        v = np.asarray(v)
+        if k.startswith("module."):
+            k = k[len("module."):]
+        if k.endswith("num_batches_tracked"):
+            continue
+        for pat, rep in _TORCH_RENAMES:
+            k = pat.sub(rep, k)
+        if (v.ndim == 2 and k.endswith("weight")
+                and not any(t in k for t in no_transpose)):
+            v = v.T
+        out[k] = v
+    return out
+
+
+_HF_BERT_RENAMES = (
+    (re.compile(r"^embeddings\.LayerNorm\."), "embeddings.layer_norm."),
+    (re.compile(r"^encoder\.layer\.(\d+)\.attention\.self\.query\."),
+     r"layer_\1.attention.q_proj."),
+    (re.compile(r"^encoder\.layer\.(\d+)\.attention\.self\.key\."),
+     r"layer_\1.attention.k_proj."),
+    (re.compile(r"^encoder\.layer\.(\d+)\.attention\.self\.value\."),
+     r"layer_\1.attention.v_proj."),
+    (re.compile(r"^encoder\.layer\.(\d+)\.attention\.output\.dense\."),
+     r"layer_\1.attention.out_proj."),
+    (re.compile(r"^encoder\.layer\.(\d+)\.attention\.output\.LayerNorm\."),
+     r"layer_\1.attn_norm."),
+    (re.compile(r"^encoder\.layer\.(\d+)\.intermediate\.dense\."),
+     r"layer_\1.ffn_in."),
+    (re.compile(r"^encoder\.layer\.(\d+)\.output\.dense\."),
+     r"layer_\1.ffn_out."),
+    (re.compile(r"^encoder\.layer\.(\d+)\.output\.LayerNorm\."),
+     r"layer_\1.ffn_norm."),
+)
+
+
+def convert_hf_bert_state_dict(sd):
+    """HuggingFace/torch BertModel state dict -> models/bert.py BertModel.
+
+    The naming map covers embeddings + every encoder sublayer + pooler; the
+    layout rules are convert_torch_state_dict's (linear transposes, no
+    transpose for the three embedding tables)."""
+    renamed = {}
+    for k, v in sd.items():
+        if k.endswith("position_ids"):  # HF buffer, not a weight
+            continue
+        for pat, rep in _HF_BERT_RENAMES:
+            k = pat.sub(rep, k)
+        renamed[k] = np.asarray(v)
+    return convert_torch_state_dict(renamed)
+
+
+def load_pretrained(model, path, source="auto", strict=True):
+    """Load a checkpoint file into ``model`` (the reference zoo's
+    pretrained-load step, local-file form).
+
+    source: "paddle" (keys already match), "torch" (apply layout/name
+    conversion), or "auto" — if the raw keys don't exactly cover the model,
+    apply the torch conversion when it lines the keys up strictly better
+    (torch resnet checkpoints share most key names and differ only in the
+    BN running-stat names, so overlap alone cannot decide). A torch
+    checkpoint whose keys happen to all match without conversion (no BN) is
+    undetectable by name — pass source="torch" explicitly there; the shape
+    check below catches the untransposed non-square linears."""
+    sd = load_checkpoint(path)
+    target = model.state_dict()
+    if source == "torch":
+        sd = convert_torch_state_dict(sd)
+    elif source == "auto" and set(sd) != set(target):
+        conv = convert_torch_state_dict(sd)
+        if len(set(conv) ^ set(target)) < len(set(sd) ^ set(target)):
+            sd = conv
+    if strict:
+        missing = sorted(set(target) - set(sd))
+        unexpected = sorted(set(sd) - set(target))
+        if missing or unexpected:
+            raise ValueError(
+                f"checkpoint {path!r} does not match the model: "
+                f"missing={missing[:8]}{'...' if len(missing) > 8 else ''} "
+                f"unexpected={unexpected[:8]}"
+                f"{'...' if len(unexpected) > 8 else ''}")
+    for name, arr in sd.items():
+        if name in target and tuple(target[name].shape) != tuple(arr.shape):
+            raise ValueError(
+                f"checkpoint {path!r}: shape mismatch for {name}: "
+                f"model {tuple(target[name].shape)} vs file "
+                f"{tuple(arr.shape)} (wrong source= layout?)")
+    model.set_state_dict({k: v for k, v in sd.items() if k in target})
+    return model
+
+
+def load_zoo_pretrained(model, pretrained):
+    """The vision-zoo pretrained hook, shared by every model family: the
+    reference downloads hub weights here; this zero-egress build requires a
+    local checkpoint path (.pdparams pickle or .safetensors, paddle- or
+    torch-layout)."""
+    if not pretrained:
+        return model
+    if pretrained is True:
+        raise RuntimeError(
+            "pretrained=True needs a weight download, which this build does "
+            "not do; pass pretrained=<path to a .pdparams/.safetensors "
+            "checkpoint> instead (paddle_tpu.utils.weights.load_pretrained)")
+    return load_pretrained(model, pretrained)

@@ -34,6 +34,5 @@ class AlexNet(nn.Layer):
 
 
 def alexnet(pretrained=False, **kwargs):
-    if pretrained:
-        raise RuntimeError("pretrained weights are not bundled")
-    return AlexNet(**kwargs)
+    from ...utils.weights import load_zoo_pretrained
+    return load_zoo_pretrained(AlexNet(**kwargs), pretrained)

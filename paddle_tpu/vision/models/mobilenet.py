@@ -220,26 +220,21 @@ class MobileNetV3Small(MobileNetV3):
         super().__init__(_V3_SMALL, 1024, scale, num_classes, with_pool)
 
 
-def _no_pretrained(pretrained):
-    if pretrained:
-        raise RuntimeError("pretrained weights are not bundled")
-
-
 def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
-    _no_pretrained(pretrained)
-    return MobileNetV1(scale=scale, **kwargs)
+    from ...utils.weights import load_zoo_pretrained
+    return load_zoo_pretrained(MobileNetV1(scale=scale, **kwargs), pretrained)
 
 
 def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
-    _no_pretrained(pretrained)
-    return MobileNetV2(scale=scale, **kwargs)
+    from ...utils.weights import load_zoo_pretrained
+    return load_zoo_pretrained(MobileNetV2(scale=scale, **kwargs), pretrained)
 
 
 def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
-    _no_pretrained(pretrained)
-    return MobileNetV3Large(scale=scale, **kwargs)
+    from ...utils.weights import load_zoo_pretrained
+    return load_zoo_pretrained(MobileNetV3Large(scale=scale, **kwargs), pretrained)
 
 
 def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
-    _no_pretrained(pretrained)
-    return MobileNetV3Small(scale=scale, **kwargs)
+    from ...utils.weights import load_zoo_pretrained
+    return load_zoo_pretrained(MobileNetV3Small(scale=scale, **kwargs), pretrained)

@@ -128,11 +128,13 @@ class ResNet(nn.Layer):
 
 
 def _resnet(block, depth, pretrained=False, **kwargs):
-    if pretrained:
-        raise RuntimeError(
-            "pretrained weights are not bundled; load a state_dict with "
-            "model.set_state_dict() instead")
-    return ResNet(block, depth, **kwargs)
+    # the reference downloads hub weights here (resnet.py
+    # get_weights_path_from_url); this zero-egress build loads a LOCAL
+    # checkpoint instead: pass a path (.pdparams pickle or .safetensors,
+    # paddle- or torch-layout — utils/weights.py converts)
+    from ...utils.weights import load_zoo_pretrained
+
+    return load_zoo_pretrained(ResNet(block, depth, **kwargs), pretrained)
 
 
 def resnet18(pretrained=False, **kwargs):

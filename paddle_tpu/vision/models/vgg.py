@@ -52,9 +52,8 @@ class VGG(nn.Layer):
 
 
 def _vgg(cfg, batch_norm, pretrained, **kwargs):
-    if pretrained:
-        raise RuntimeError("pretrained weights are not bundled")
-    return VGG(_make_layers(_CFGS[cfg], batch_norm), **kwargs)
+    from ...utils.weights import load_zoo_pretrained
+    return load_zoo_pretrained(VGG(_make_layers(_CFGS[cfg], batch_norm), **kwargs), pretrained)
 
 
 def vgg11(pretrained=False, batch_norm=False, **kwargs):

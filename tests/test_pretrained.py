@@ -1,0 +1,250 @@
+"""Pretrained-weight loading + cross-framework accuracy parity (VERDICT r4 #7).
+
+Reference analog: every vision-zoo entry downloads hub weights and
+set_state_dict()s them (python/paddle/vision/models/resnet.py); parity with
+the reference is demonstrated by loading a FOREIGN framework's weights and
+reproducing its logits. Torch (cpu) is the independent oracle here: a torch
+resnet18 and a HuggingFace BertModel run the same weights this build loads
+through utils/weights.py, and the logits must match to 1e-4.
+"""
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils.weights import (
+    convert_hf_bert_state_dict, convert_torch_state_dict, load_checkpoint,
+    load_pretrained)
+
+
+class TestCheckpointFormats:
+    def test_pdparams_roundtrip_into_pretrained_arg(self, tmp_path):
+        """Save the reference's .pdparams pickle format, reload via
+        pretrained=<path>: logits identical."""
+        paddle.seed(7)
+        src = paddle.vision.models.resnet18(num_classes=10)
+        src.eval()
+        x = np.random.RandomState(0).randn(2, 3, 32, 32).astype("float32")
+        ref = src(paddle.to_tensor(x)).numpy()
+
+        path = str(tmp_path / "resnet18.pdparams")
+        sd = {k: np.asarray(v.value) for k, v in src.state_dict().items()}
+        sd["StructuredToParameterName@@"] = {}   # reference bookkeeping entry
+        with open(path, "wb") as f:
+            pickle.dump(sd, f)
+
+        dst = paddle.vision.models.resnet18(pretrained=path, num_classes=10)
+        dst.eval()
+        np.testing.assert_array_equal(dst(paddle.to_tensor(x)).numpy(), ref)
+
+    def test_safetensors_roundtrip(self, tmp_path):
+        from safetensors.numpy import save_file
+
+        paddle.seed(8)
+        src = paddle.vision.models.resnet18(num_classes=4)
+        src.eval()
+        x = np.random.RandomState(1).randn(2, 3, 32, 32).astype("float32")
+        ref = src(paddle.to_tensor(x)).numpy()
+
+        path = str(tmp_path / "resnet18.safetensors")
+        save_file({k: np.ascontiguousarray(np.asarray(v.value))
+                   for k, v in src.state_dict().items()}, path)
+        dst = paddle.vision.models.resnet18(num_classes=4)
+        load_pretrained(dst, path)
+        dst.eval()
+        np.testing.assert_array_equal(dst(paddle.to_tensor(x)).numpy(), ref)
+
+    def test_pretrained_true_raises_clear_error(self):
+        with pytest.raises(RuntimeError, match="pass pretrained=<path"):
+            paddle.vision.models.resnet18(pretrained=True)
+
+    def test_pretrained_path_wired_zoo_wide(self, tmp_path):
+        """Every family accepts pretrained=<path>, not just resnet (the
+        reference wires hub weights into all of them)."""
+        paddle.seed(11)
+        src = paddle.vision.models.mobilenet_v2(num_classes=4, scale=0.25)
+        path = str(tmp_path / "mnv2.pdparams")
+        with open(path, "wb") as f:
+            pickle.dump({k: np.asarray(v.value)
+                         for k, v in src.state_dict().items()}, f)
+        dst = paddle.vision.models.mobilenet_v2(
+            pretrained=path, num_classes=4, scale=0.25)
+        for (k, a), (_, b) in zip(sorted(src.state_dict().items()),
+                                  sorted(dst.state_dict().items())):
+            np.testing.assert_array_equal(np.asarray(a.value),
+                                          np.asarray(b.value), err_msg=k)
+        for fam in ("vgg11", "alexnet", "squeezenet1_0"):
+            with pytest.raises(RuntimeError, match="pass pretrained=<path"):
+                getattr(paddle.vision.models, fam)(pretrained=True)
+
+    def test_own_paddle_save_format_loads(self, tmp_path):
+        """paddle.save(state_dict) -> pretrained=<path> round-trips (the
+        framework_io packed-tensor format, not just raw ndarray pickles)."""
+        paddle.seed(12)
+        src = paddle.vision.models.resnet18(num_classes=4)
+        src.eval()
+        path = str(tmp_path / "own.pdparams")
+        paddle.save(src.state_dict(), path)
+        x = np.random.RandomState(2).randn(1, 3, 32, 32).astype("float32")
+        ref = src(paddle.to_tensor(x)).numpy()
+        dst = paddle.vision.models.resnet18(pretrained=path, num_classes=4)
+        dst.eval()
+        np.testing.assert_array_equal(dst(paddle.to_tensor(x)).numpy(), ref)
+
+    def test_mismatched_checkpoint_raises_with_key_lists(self, tmp_path):
+        path = str(tmp_path / "bad.pdparams")
+        with open(path, "wb") as f:
+            pickle.dump({"not_a_real_key": np.zeros((2, 2), "float32")}, f)
+        model = paddle.vision.models.resnet18(num_classes=4)
+        with pytest.raises(ValueError, match="does not match the model"):
+            load_pretrained(model, path)
+
+
+def _torch_resnet18(num_classes):
+    """Independent oracle: torchvision-architecture resnet18 in plain torch
+    (torchvision itself is not installed). Matches the reference zoo
+    architecture (vision/models/resnet.py BasicBlock stack 2-2-2-2)."""
+    import torch
+    import torch.nn as nn
+
+    class BasicBlock(nn.Module):
+        def __init__(self, cin, cout, stride=1):
+            super().__init__()
+            self.conv1 = nn.Conv2d(cin, cout, 3, stride, 1, bias=False)
+            self.bn1 = nn.BatchNorm2d(cout)
+            self.conv2 = nn.Conv2d(cout, cout, 3, 1, 1, bias=False)
+            self.bn2 = nn.BatchNorm2d(cout)
+            self.relu = nn.ReLU()
+            if stride != 1 or cin != cout:
+                self.downsample = nn.Sequential(
+                    nn.Conv2d(cin, cout, 1, stride, bias=False),
+                    nn.BatchNorm2d(cout))
+            else:
+                self.downsample = None
+
+        def forward(self, x):
+            idn = x if self.downsample is None else self.downsample(x)
+            out = self.relu(self.bn1(self.conv1(x)))
+            out = self.bn2(self.conv2(out))
+            return self.relu(out + idn)
+
+    class ResNet18(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv1 = nn.Conv2d(3, 64, 7, 2, 3, bias=False)
+            self.bn1 = nn.BatchNorm2d(64)
+            self.relu = nn.ReLU()
+            self.maxpool = nn.MaxPool2d(3, 2, 1)
+            self.layer1 = nn.Sequential(BasicBlock(64, 64), BasicBlock(64, 64))
+            self.layer2 = nn.Sequential(BasicBlock(64, 128, 2),
+                                        BasicBlock(128, 128))
+            self.layer3 = nn.Sequential(BasicBlock(128, 256, 2),
+                                        BasicBlock(256, 256))
+            self.layer4 = nn.Sequential(BasicBlock(256, 512, 2),
+                                        BasicBlock(512, 512))
+            self.avgpool = nn.AdaptiveAvgPool2d(1)
+            self.fc = nn.Linear(512, num_classes)
+
+        def forward(self, x):
+            x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+            x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
+            x = self.avgpool(x).flatten(1)
+            return self.fc(x)
+
+    return ResNet18()
+
+
+@pytest.mark.slow
+class TestCrossFrameworkGoldenLogits:
+    """The acceptance proof: foreign weights -> this build reproduces the
+    foreign framework's own logits (VERDICT r4 #7: 'resnet18 forward matches
+    reference logits to 1e-4 on one batch')."""
+
+    def test_torch_resnet18_logits_match_1e4(self, tmp_path):
+        import torch
+
+        torch.manual_seed(0)
+        tm = _torch_resnet18(num_classes=10).double().eval()
+        x = np.random.RandomState(0).randn(2, 3, 64, 64)
+        with torch.no_grad():
+            golden = tm(torch.from_numpy(x)).numpy()
+
+        # downsample.0/.1 (torch Sequential) -> downsample uses the same
+        # indexed naming in our zoo? our ResNet names them via Sequential
+        # too — keys must line up after the generic torch conversion
+        sd = {k: v.numpy() for k, v in tm.state_dict().items()}
+        path = str(tmp_path / "torch_resnet18.pdparams")
+        with open(path, "wb") as f:
+            pickle.dump(sd, f)
+
+        # source defaults to "auto": the torch key set differs from ours only
+        # in the BN running-stat names, and the auto heuristic must pick the
+        # conversion by key-fit (a plain-overlap check would skip it)
+        model = paddle.vision.models.resnet18(pretrained=path, num_classes=10)
+        model = model.astype("float64")
+        model.eval()
+        ours = model(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(ours, golden, rtol=1e-4, atol=1e-4)
+
+    def test_hf_bert_hidden_states_match_1e4(self):
+        import torch
+        from transformers import BertConfig as HFConfig
+        from transformers import BertModel as HFBert
+
+        from paddle_tpu.models.bert import BertConfig, BertModel
+
+        torch.manual_seed(0)
+        hf_cfg = HFConfig(vocab_size=97, hidden_size=48, num_hidden_layers=3,
+                          num_attention_heads=4, intermediate_size=96,
+                          max_position_embeddings=40,
+                          hidden_dropout_prob=0.0,
+                          attention_probs_dropout_prob=0.0)
+        hf = HFBert(hf_cfg).double().eval()
+        r = np.random.RandomState(3)
+        ids = r.randint(0, 97, (2, 17)).astype("int64")
+        with torch.no_grad():
+            out = hf(input_ids=torch.from_numpy(ids))
+            golden_h = out.last_hidden_state.numpy()
+            golden_p = out.pooler_output.numpy()
+
+        cfg = BertConfig(vocab_size=97, hidden_size=48, num_hidden_layers=3,
+                         num_attention_heads=4, intermediate_size=96,
+                         max_position_embeddings=40,
+                         hidden_dropout_prob=0.0,
+                         attention_probs_dropout_prob=0.0)
+        model = BertModel(cfg)
+        sd = convert_hf_bert_state_dict(
+            {k: v.numpy() for k, v in hf.state_dict().items()})
+        target = set(model.state_dict())
+        assert set(sd) == target, (
+            sorted(set(sd) - target)[:6], sorted(target - set(sd))[:6])
+        model.set_state_dict(sd)
+        model = model.astype("float64")
+        model.eval()
+        h, p = model(paddle.to_tensor(ids))
+        np.testing.assert_allclose(h.numpy(), golden_h, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(p.numpy(), golden_p, rtol=1e-4, atol=1e-4)
+
+
+class TestConversionRules:
+    def test_linear_transposed_embedding_kept(self):
+        sd = {"fc.weight": np.zeros((10, 4), "float32"),
+              "embeddings.word_embeddings.weight": np.zeros((50, 8), "float32"),
+              "bn.running_mean": np.zeros((4,), "float32"),
+              "bn.num_batches_tracked": np.zeros((), "int64"),
+              "module.head.bias": np.zeros((4,), "float32")}
+        out = convert_torch_state_dict(sd)
+        assert out["fc.weight"].shape == (4, 10)
+        assert out["embeddings.word_embeddings.weight"].shape == (50, 8)
+        assert "bn._mean" in out and "bn.running_mean" not in out
+        assert not any("num_batches_tracked" in k for k in out)
+        assert "head.bias" in out
+
+    def test_load_checkpoint_rejects_non_dict(self, tmp_path):
+        path = str(tmp_path / "junk.pdparams")
+        with open(path, "wb") as f:
+            pickle.dump([1, 2, 3], f)
+        with pytest.raises(ValueError, match="state dict"):
+            load_checkpoint(path)
