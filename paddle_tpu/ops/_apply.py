@@ -209,8 +209,38 @@ def _finish_outputs(opdef, name, out_vals, requires_grad, vjp_fn, pure,
     return outputs
 
 
+_PROF = None   # (collector, Operator event type), resolved on first use
+
+
+def _prof():
+    global _PROF
+    if _PROF is None:
+        from ..profiler.profiler import TracerEventType, _collector
+
+        _PROF = (_collector, TracerEventType.Operator)
+    return _PROF
+
+
 def apply(opdef: OpDef, *args, **kwargs):
-    """Dispatch one op call. Tensor leaves anywhere in args/kwargs are traced inputs."""
+    """Dispatch one op call. Tensor leaves anywhere in args/kwargs are traced
+    inputs. While a Profiler RECORD window is open, every dispatch emits an
+    Operator host span (the reference records an event per generated op
+    forward, eager_gen.py record-event preamble); the merged chrome trace
+    then shows these host defop spans over the XLA device kernel spans."""
+    prof = _prof()
+    if prof[0].enabled:
+        import time as _time
+
+        t0 = _time.perf_counter_ns()
+        try:
+            return _apply_impl(opdef, *args, **kwargs)
+        finally:
+            prof[0].emit(f"op::{opdef.name}", prof[1], t0,
+                         _time.perf_counter_ns())
+    return _apply_impl(opdef, *args, **kwargs)
+
+
+def _apply_impl(opdef: OpDef, *args, **kwargs):
     # ---- AMP auto-cast (O1/O2), mirroring eager_gen.py:645 AMP_LOGIC_TEMPLATE ----
     global _AMP
     if _AMP is None:

@@ -248,19 +248,46 @@ def _get_supported_targets() -> Iterable[ProfilerTarget]:
 
 
 class ProfilerResult:
-    """Finished profile data handed to on_trace_ready (host events + step range)."""
+    """Events + device-trace handle of one finished RECORD window. The saved
+    chrome trace is ONE timeline: host spans, with the XLA device spans from
+    the xplane trace folded in on the host clock (reference
+    chrometracing_logger.cc merges host + CUPTI the same way)."""
 
     def __init__(self, events: list[HostEvent], steps: tuple[int, int],
-                 xla_trace_dir: str | None):
+                 xla_trace_dir: str | None,
+                 xla_t0_ns: int | None = None):
         self.events = events
         self.steps = steps
         self.xla_trace_dir = xla_trace_dir
+        self.xla_t0_ns = xla_t0_ns
+        self._device_events = None
+
+    def device_events(self):
+        """Device-side op spans parsed from the xplane trace (cached)."""
+        if self._device_events is None:
+            if self.xla_trace_dir:
+                from .xplane import collect_device_events
+
+                self._device_events = collect_device_events(self.xla_trace_dir)
+            else:
+                self._device_events = []
+        return self._device_events
+
+    def device_op_stats(self):
+        """Per-op device-time aggregate rows (reference per-op device-time
+        table in profiler_statistic.py)."""
+        from .xplane import device_op_stats
+
+        return device_op_stats(self.device_events())
 
     def save(self, path: str):
-        _write_chrome_trace(self.events, path, self.xla_trace_dir)
+        _write_chrome_trace(self.events, path, self.xla_trace_dir,
+                            device_events=self.device_events(),
+                            xla_t0_ns=self.xla_t0_ns)
 
 
-def _write_chrome_trace(events, path, xla_trace_dir=None):
+def _write_chrome_trace(events, path, xla_trace_dir=None, device_events=None,
+                        xla_t0_ns=None):
     pid = os.getpid()
     trace_events: list[dict[str, Any]] = [{
         "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
@@ -277,6 +304,14 @@ def _write_chrome_trace(events, path, xla_trace_dir=None):
             "tid": ev.tid % 10**6,
             "args": {"step": ev.step},
         })
+    if device_events:
+        from .xplane import chrome_events
+
+        # host events anchor at perf_counter_ns; missing t0 (older results)
+        # falls back to the first host event so the spans stay visible
+        t0 = xla_t0_ns if xla_t0_ns is not None else (
+            min((e.start_ns for e in events), default=0))
+        trace_events.extend(chrome_events(device_events, t0))
     doc = {"traceEvents": trace_events, "displayTimeUnit": "ms"}
     if xla_trace_dir:
         doc["otherData"] = {"xla_trace_dir": xla_trace_dir}
@@ -444,16 +479,26 @@ class Profiler:
         _collector.current_step = start_step
         self._record_start_step = start_step
         self._xla_trace_dir = None
+        force_xla = os.environ.get(
+            "PADDLE_TPU_PROFILER_FORCE_XLA", "").lower() in (
+            "1", "true", "yes", "on")
         if (ProfilerTarget.TPU in self.targets
-                or ProfilerTarget.GPU in self.targets):
+                or ProfilerTarget.GPU in self.targets
+                or force_xla):
             try:
                 import jax
 
-                if any(d.platform in ("tpu", "gpu") for d in jax.devices()):
+                # PADDLE_TPU_PROFILER_FORCE_XLA=1 brackets the XLA trace on
+                # any backend (the CPU tests drive the merge path with it)
+                if any(d.platform in ("tpu", "gpu") for d in jax.devices()) \
+                        or force_xla:
                     trace_dir = os.path.join(
                         os.environ.get("PADDLE_TPU_TRACE_DIR", "/tmp"),
                         f"paddle_tpu_xla_trace_{os.getpid()}_{start_step}")
                     jax.profiler.start_trace(trace_dir)
+                    # host-clock anchor for the device timeline: xplane event
+                    # times are relative to the trace start (xplane.py)
+                    self._xla_t0_ns = time.perf_counter_ns()
                     self._xla_tracing = True
                     self._xla_trace_dir = trace_dir
             except Exception:
@@ -472,7 +517,8 @@ class Profiler:
         events = _collector.drain()
         self._last_result = ProfilerResult(
             events, (self._record_start_step, self.step_num),
-            self._xla_trace_dir)
+            self._xla_trace_dir,
+            xla_t0_ns=getattr(self, "_xla_t0_ns", None))
 
     def _open_step_span(self):
         if self.current_state in (ProfilerState.RECORD,

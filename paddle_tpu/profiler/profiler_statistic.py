@@ -188,6 +188,24 @@ def _build_summary(result, sorted_by=SortedKeys.CPUTotal,
              f"{_fmt(s.min_ns or 0, time_unit):>12}  "
              f"{100.0 * selfs[s.name] / wall_ns:>8.2f}") for s in rows],
            lines)
+    # 3) per-op DEVICE time from the merged xplane trace (reference device
+    #    perspective of the EventSummary — kernel time per op)
+    dev_rows = result.device_op_stats() if hasattr(result, "device_op_stats") \
+        else []
+    if dev_rows:
+        dev_rows = dev_rows[:40]
+        dn_w = max([len("Op")] + [min(len(r["name"]), 60) for r in dev_rows])
+        _table("Device Op Summary (XLA trace)",
+               [f"{'Op':<{dn_w}}", f"{'Calls':>7}",
+                f"{'Total(' + time_unit + ')':>12}",
+                f"{'Avg(' + time_unit + ')':>12}",
+                f"{'Max(' + time_unit + ')':>12}", f"{'Ratio(%)':>8}"],
+               [(f"{r['name'][:60]:<{dn_w}}  {r['calls']:>7}  "
+                 f"{_fmt(r['total_ns'], time_unit):>12}  "
+                 f"{_fmt(r['avg_ns'], time_unit):>12}  "
+                 f"{_fmt(r['max_ns'], time_unit):>12}  "
+                 f"{100.0 * r['ratio']:>8.2f}") for r in dev_rows],
+               lines)
     if result.xla_trace_dir:
         lines.append(f"XLA device trace (TensorBoard/XProf): {result.xla_trace_dir}")
     return "\n".join(lines)

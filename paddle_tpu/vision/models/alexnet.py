@@ -1,5 +1,6 @@
 """AlexNet (python/paddle/vision/models/alexnet.py)."""
 from ... import nn
+from ...utils.weights import load_zoo_pretrained
 
 
 class AlexNet(nn.Layer):
@@ -34,5 +35,4 @@ class AlexNet(nn.Layer):
 
 
 def alexnet(pretrained=False, **kwargs):
-    from ...utils.weights import load_zoo_pretrained
     return load_zoo_pretrained(AlexNet(**kwargs), pretrained)

@@ -6,6 +6,7 @@ googlenet,inceptionv3}.py.
 from __future__ import annotations
 
 from ... import nn, ops
+from ...utils.weights import load_zoo_pretrained
 
 
 # ---------------------------------------------------------------------------
@@ -63,12 +64,10 @@ class SqueezeNet(nn.Layer):
 
 
 def squeezenet1_0(pretrained=False, **kwargs):
-    from ...utils.weights import load_zoo_pretrained
     return load_zoo_pretrained(SqueezeNet("1.0", **kwargs), pretrained)
 
 
 def squeezenet1_1(pretrained=False, **kwargs):
-    from ...utils.weights import load_zoo_pretrained
     return load_zoo_pretrained(SqueezeNet("1.1", **kwargs), pretrained)
 
 
@@ -169,37 +168,30 @@ class ShuffleNetV2(nn.Layer):
 
 
 def shufflenet_v2_x0_25(pretrained=False, **kwargs):
-    from ...utils.weights import load_zoo_pretrained
     return load_zoo_pretrained(ShuffleNetV2(scale=0.25, **kwargs), pretrained)
 
 
 def shufflenet_v2_x0_5(pretrained=False, **kwargs):
-    from ...utils.weights import load_zoo_pretrained
     return load_zoo_pretrained(ShuffleNetV2(scale=0.5, **kwargs), pretrained)
 
 
 def shufflenet_v2_x0_33(pretrained=False, **kwargs):
-    from ...utils.weights import load_zoo_pretrained
     return load_zoo_pretrained(ShuffleNetV2(scale=0.33, **kwargs), pretrained)
 
 
 def shufflenet_v2_swish(pretrained=False, **kwargs):
-    from ...utils.weights import load_zoo_pretrained
     return load_zoo_pretrained(ShuffleNetV2(scale=1.0, act="swish", **kwargs), pretrained)
 
 
 def shufflenet_v2_x1_0(pretrained=False, **kwargs):
-    from ...utils.weights import load_zoo_pretrained
     return load_zoo_pretrained(ShuffleNetV2(scale=1.0, **kwargs), pretrained)
 
 
 def shufflenet_v2_x1_5(pretrained=False, **kwargs):
-    from ...utils.weights import load_zoo_pretrained
     return load_zoo_pretrained(ShuffleNetV2(scale=1.5, **kwargs), pretrained)
 
 
 def shufflenet_v2_x2_0(pretrained=False, **kwargs):
-    from ...utils.weights import load_zoo_pretrained
     return load_zoo_pretrained(ShuffleNetV2(scale=2.0, **kwargs), pretrained)
 
 
@@ -274,27 +266,22 @@ class DenseNet(nn.Layer):
 
 
 def densenet121(pretrained=False, **kwargs):
-    from ...utils.weights import load_zoo_pretrained
     return load_zoo_pretrained(DenseNet(121, **kwargs), pretrained)
 
 
 def densenet161(pretrained=False, **kwargs):
-    from ...utils.weights import load_zoo_pretrained
     return load_zoo_pretrained(DenseNet(161, **kwargs), pretrained)
 
 
 def densenet169(pretrained=False, **kwargs):
-    from ...utils.weights import load_zoo_pretrained
     return load_zoo_pretrained(DenseNet(169, **kwargs), pretrained)
 
 
 def densenet201(pretrained=False, **kwargs):
-    from ...utils.weights import load_zoo_pretrained
     return load_zoo_pretrained(DenseNet(201, **kwargs), pretrained)
 
 
 def densenet264(pretrained=False, **kwargs):
-    from ...utils.weights import load_zoo_pretrained
     return load_zoo_pretrained(DenseNet(264, **kwargs), pretrained)
 
 
@@ -358,7 +345,6 @@ class GoogLeNet(nn.Layer):
 
 
 def googlenet(pretrained=False, **kwargs):
-    from ...utils.weights import load_zoo_pretrained
     return load_zoo_pretrained(GoogLeNet(**kwargs), pretrained)
 
 
@@ -480,5 +466,4 @@ class InceptionV3(nn.Layer):
 
 
 def inception_v3(pretrained=False, **kwargs):
-    from ...utils.weights import load_zoo_pretrained
     return load_zoo_pretrained(InceptionV3(**kwargs), pretrained)

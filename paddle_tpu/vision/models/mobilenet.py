@@ -2,6 +2,7 @@
 from __future__ import annotations
 
 from ... import nn
+from ...utils.weights import load_zoo_pretrained
 
 
 def _make_divisible(v, divisor=8, min_value=None):
@@ -221,20 +222,16 @@ class MobileNetV3Small(MobileNetV3):
 
 
 def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
-    from ...utils.weights import load_zoo_pretrained
     return load_zoo_pretrained(MobileNetV1(scale=scale, **kwargs), pretrained)
 
 
 def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
-    from ...utils.weights import load_zoo_pretrained
     return load_zoo_pretrained(MobileNetV2(scale=scale, **kwargs), pretrained)
 
 
 def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
-    from ...utils.weights import load_zoo_pretrained
     return load_zoo_pretrained(MobileNetV3Large(scale=scale, **kwargs), pretrained)
 
 
 def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
-    from ...utils.weights import load_zoo_pretrained
     return load_zoo_pretrained(MobileNetV3Small(scale=scale, **kwargs), pretrained)

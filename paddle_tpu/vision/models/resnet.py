@@ -6,6 +6,7 @@ ResNet with groups/width_per_group for the resnext and wide variants).
 from __future__ import annotations
 
 from ... import nn
+from ...utils.weights import load_zoo_pretrained
 
 
 class BasicBlock(nn.Layer):
@@ -132,7 +133,6 @@ def _resnet(block, depth, pretrained=False, **kwargs):
     # get_weights_path_from_url); this zero-egress build loads a LOCAL
     # checkpoint instead: pass a path (.pdparams pickle or .safetensors,
     # paddle- or torch-layout — utils/weights.py converts)
-    from ...utils.weights import load_zoo_pretrained
 
     return load_zoo_pretrained(ResNet(block, depth, **kwargs), pretrained)
 

@@ -1,5 +1,6 @@
 """VGG 11/13/16/19 (+_bn variants) (python/paddle/vision/models/vgg.py)."""
 from ... import nn
+from ...utils.weights import load_zoo_pretrained
 
 _CFGS = {
     "A": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
@@ -52,7 +53,6 @@ class VGG(nn.Layer):
 
 
 def _vgg(cfg, batch_norm, pretrained, **kwargs):
-    from ...utils.weights import load_zoo_pretrained
     return load_zoo_pretrained(VGG(_make_layers(_CFGS[cfg], batch_norm), **kwargs), pretrained)
 
 

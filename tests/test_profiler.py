@@ -5,6 +5,7 @@ import json
 import os
 import pkgutil
 
+import numpy as np
 import pytest
 
 import paddle_tpu as paddle
@@ -177,3 +178,64 @@ def test_tuple_scheduler():
     assert got[1] in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
     assert got[2] == ProfilerState.RECORD_AND_RETURN
     assert got[3] == ProfilerState.CLOSED
+
+
+def test_merged_host_device_trace_lenet_step(tmp_path, monkeypatch):
+    """VERDICT r4 #10 acceptance: ONE chrome trace containing host defop
+    spans AND the XLA device kernel spans (clock-translated), plus a per-op
+    device-time table. PADDLE_TPU_PROFILER_FORCE_XLA drives the same merge
+    path the TPU uses (reference chrometracing_logger.cc one-timeline
+    merge)."""
+    monkeypatch.setenv("PADDLE_TPU_PROFILER_FORCE_XLA", "1")
+    monkeypatch.setenv("PADDLE_TPU_TRACE_DIR", str(tmp_path))
+
+    model = paddle.vision.models.LeNet()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    results = []
+    sch = make_scheduler(closed=0, ready=1, record=1, repeat=1)
+    with Profiler(targets=[ProfilerTarget.CPU, ProfilerTarget.TPU],
+                  scheduler=sch,
+                  on_trace_ready=lambda p: results.append(p._last_result)) as p:
+        for _ in range(3):
+            x = paddle.randn([2, 1, 28, 28])
+            y = paddle.to_tensor(np.array([1, 2], "int64"))
+            loss = paddle.nn.functional.cross_entropy(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            p.step()
+    assert results
+    res = results[0]
+
+    # per-op device-time table (reference profiler_statistic device view)
+    rows = res.device_op_stats()
+    assert rows, "no device events parsed from the xplane trace"
+    assert all(r["calls"] >= 1 and r["total_ns"] > 0 for r in rows)
+    assert abs(sum(r["ratio"] for r in rows) - 1.0) < 1e-6
+
+    # ONE json: host defop spans + device kernel spans, distinct pids
+    out = str(tmp_path / "merged.json")
+    res.save(out)
+    doc = json.loads(open(out).read())
+    evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    host_ops = [e for e in evs if e["name"].startswith("op::")]
+    dev_ops = [e for e in evs if e.get("cat") == "DeviceOp"]
+    assert host_ops, "host defop spans missing from the merged trace"
+    assert dev_ops, "device kernel spans missing from the merged trace"
+    assert {e["pid"] for e in host_ops}.isdisjoint({e["pid"] for e in dev_ops})
+    # clock translation puts the device spans inside the host window (wide
+    # margin: the anchor is taken right after start_trace returns)
+    host_lo = min(e["ts"] for e in host_ops)
+    host_hi = max(e["ts"] + e["dur"] for e in host_ops)
+    dev_mid = sorted(e["ts"] for e in dev_ops)[len(dev_ops) // 2]
+    assert host_lo - 2e6 < dev_mid < host_hi + 2e6
+
+    # summary renders the device table
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        p.summary()
+    assert "Device Op Summary" in buf.getvalue()
