@@ -327,9 +327,18 @@ def load_profiler_result(filename: str) -> ProfilerResult:
     for te in doc.get("traceEvents", []):
         if te.get("ph") != "X":
             continue
+        cat = te.get("cat", "UserDefined")
+        if cat == "DeviceOp":
+            # merged XLA device spans (xplane.chrome_events) are not host
+            # events; the loader reconstructs the HOST side only
+            continue
+        try:
+            etype = TracerEventType[cat]
+        except KeyError:
+            etype = TracerEventType.UserDefined
         start_ns = int(te["ts"] * 1e3)
         events.append(HostEvent(
-            te["name"], TracerEventType[te.get("cat", "UserDefined")],
+            te["name"], etype,
             start_ns, start_ns + int(te["dur"] * 1e3), te.get("tid", 0),
             te.get("args", {}).get("step", 0)))
     xla_dir = doc.get("otherData", {}).get("xla_trace_dir")

@@ -239,3 +239,31 @@ def test_merged_host_device_trace_lenet_step(tmp_path, monkeypatch):
     with redirect_stdout(buf):
         p.summary()
     assert "Device Op Summary" in buf.getvalue()
+
+
+def test_load_profiler_result_skips_merged_device_events(tmp_path):
+    """A merged trace (host + DeviceOp spans, exactly what
+    ProfilerResult.save writes when XLA tracing was active) must round-trip
+    through load_profiler_result without KeyError: the loader reconstructs
+    the host side, skips device cats, and tolerates unknown cats."""
+    out = str(tmp_path / "merged_roundtrip.json")
+    doc = {"traceEvents": [
+        {"name": "span", "cat": "PythonUserDefined", "ph": "X",
+         "ts": 10.0, "dur": 5.0, "pid": 1, "tid": 1, "args": {"step": 0}},
+        {"name": "fusion.1", "cat": "DeviceOp", "ph": "X",
+         "ts": 11.0, "dur": 2.0, "pid": 900000, "tid": 1,
+         "args": {"hlo_module": "jit_step"}},
+        {"name": "mystery", "cat": "SomeFutureCat", "ph": "X",
+         "ts": 12.0, "dur": 1.0, "pid": 1, "tid": 1, "args": {}},
+    ]}
+    with open(out, "w") as f:
+        json.dump(doc, f)
+    res = profiler.load_profiler_result(out)
+    names = {e.name for e in res.events}
+    assert "span" in names
+    assert "fusion.1" not in names            # device spans skipped
+    assert "mystery" in names                 # unknown cat -> UserDefined
+    from paddle_tpu.profiler.profiler import TracerEventType
+
+    mystery = [e for e in res.events if e.name == "mystery"][0]
+    assert mystery.event_type is TracerEventType.UserDefined

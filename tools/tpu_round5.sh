@@ -1,0 +1,79 @@
+#!/bin/bash
+# Round-5 TPU measurement agenda (VERDICT r4 asks #1-#4), one command.
+#
+# Run when the axon tunnel is live (probe first!). Strictly sequential —
+# the tunnel is single-client and a killed in-flight client wedges it for
+# hours (PERF.md round-4 operational rules), so every stage waits its
+# subprocess out rather than killing.
+#
+#   bash tools/tpu_round5.sh [logdir]
+#
+# Stages (each skipped if its marker file exists, so the script resumes):
+#   1. flagship bench.py            — >=10-iter live measurement, worker
+#                                     self-saves bench_cache.json
+#   2. MFU sweep priority variants  — remat granularity, fused-CE, batch 16
+#                                     (the 0.528 -> >=0.60 levers)
+#   3. int8-KV decode comparison    — serving ms/token, bf16 vs int8 cache
+#   4. BASELINE suite               — resnet50 AMP O2 @224px, BERT-base
+#                                     @seq128, lenet eager, gpt hybrid
+set -u
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+LOG="${1:-$ROOT/tools/tpu_round5_logs}"
+mkdir -p "$LOG"
+cd "$ROOT"
+
+stage() {  # stage <name> <cmd...>
+  local name="$1"; shift
+  if [ -f "$LOG/$name.done" ]; then
+    echo "[tpu_round5] $name: already done, skipping"
+    return 0
+  fi
+  echo "[tpu_round5] $name: starting at $(date -u +%H:%M:%SZ)"
+  ( "$@" ) >"$LOG/$name.log" 2>&1
+  local rc=$?
+  echo "rc=$rc" > "$LOG/$name.rc"
+  if [ $rc -eq 0 ]; then touch "$LOG/$name.done"; fi
+  echo "[tpu_round5] $name: rc=$rc ($(date -u +%H:%M:%SZ)); log: $LOG/$name.log"
+  return 0   # keep going: later stages may still land data points
+}
+
+# 0) bounded probe: do not start the agenda against a wedged tunnel
+if ! timeout 420 python -c "
+import time; t0 = time.time()
+import jax, jax.numpy as jnp
+v = jax.device_get((jnp.ones((8, 8)) @ jnp.ones((8, 8))).ravel()[:1])
+assert jax.devices()[0].platform == 'tpu', jax.devices()
+print('PROBE_OK %.1fs' % (time.time() - t0))
+" > "$LOG/probe.log" 2>&1; then
+  echo "[tpu_round5] probe FAILED (tunnel wedged?) — see $LOG/probe.log"
+  exit 1
+fi
+echo "[tpu_round5] probe OK: $(tail -1 "$LOG/probe.log")"
+
+# 1) flagship (>=10 iters; orchestrator handles retry/fallback/caching)
+stage flagship env BENCH_ITERS=10 BENCH_LOG_FILE="$LOG/flagship_phases.log" \
+    python bench.py
+
+# 2) priority sweep variants first (the MFU levers), then the rest if the
+#    tunnel is still alive
+stage sweep_priority python tools/mfu_sweep.py \
+    --variants remat_core_attn,fused_ce,fused_ce_b16_core_attn,batch16,fused_ce_batch16
+stage sweep_rest python tools/mfu_sweep.py \
+    --variants remat_off,flash_q1024_k512,flash_q512_k1024,seq4096_b4,hidden2816_L6,hidden4096_L4_b4
+
+# 3) decode: int8 KV vs the flagship bf16 decode block (the flagship stage
+#    already measured bf16; this is the quantized-cache comparison).
+#    BENCH_NO_CACHE: a decode variant must not displace the flagship artifact.
+stage decode_int8 env BENCH_DECODE_KV=int8 BENCH_NO_CACHE=1 \
+    BENCH_SKIP_FLASHCHECK=1 BENCH_SKIP_DISPATCH=1 BENCH_ITERS=3 \
+    python bench.py --worker
+
+# 4) BASELINE suite at faithful TPU shapes (batch128/224px O2 resnet,
+#    BERT-base seq128, ...; shapes auto-select on_tpu in bench_suite.py)
+stage suite python bench_suite.py --configs lenet,resnet50,bert_dp
+
+echo "[tpu_round5] agenda complete; results:"
+echo "  - bench_cache.json (flagship live)"
+echo "  - tools/sweep_results.jsonl (device rows)"
+echo "  - tools/suite_results.jsonl"
+echo "  - $LOG/*.log"
