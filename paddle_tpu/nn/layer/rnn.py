@@ -137,10 +137,19 @@ class RNNBase(Layer):
             x = transpose(x, [1, 0, 2])
         b = x.shape[0]
         n_state = self.num_layers * self.num_directions
+        # default states follow the PROMOTED input x weight dtype — that is
+        # what _cell_step's matmuls produce, so the lax.scan carry stays
+        # type-stable for fp64 parity runs (f64 weights) AND bf16 inputs
+        # through f32 weights (a hardcoded float32 broke the former; the
+        # bare input dtype would break the latter)
+        import jax.numpy as jnp
+
+        sdtype = str(jnp.result_type(x.value,
+                                     self._flat_weights()[0].value))
         if self.mode == "LSTM":
             if initial_states is None:
-                h0 = zeros([n_state, b, self.hidden_size], "float32")
-                c0 = zeros([n_state, b, self.hidden_size], "float32")
+                h0 = zeros([n_state, b, self.hidden_size], sdtype)
+                c0 = zeros([n_state, b, self.hidden_size], sdtype)
             else:
                 h0, c0 = initial_states
             out, h_n, c_n = _rnn_forward(x, h0, c0, self._flat_weights(), mode=self.mode,
@@ -150,7 +159,7 @@ class RNNBase(Layer):
                 out = transpose(out, [1, 0, 2])
             return out, (h_n, c_n)
         if initial_states is None:
-            h0 = zeros([n_state, b, self.hidden_size], "float32")
+            h0 = zeros([n_state, b, self.hidden_size], sdtype)
         else:
             h0 = initial_states
         out, h_n = _rnn_forward(x, h0, None, self._flat_weights(), mode=self.mode,
@@ -190,7 +199,8 @@ class RNNCellBase(Layer):
         from ...ops.creation import full
 
         b = batch_ref.shape[batch_dim_idx]
-        return full([b, self.hidden_size], init_value, dtype or "float32")
+        return full([b, self.hidden_size], init_value,
+                    dtype or str(batch_ref.dtype))
 
 
 class SimpleRNNCell(RNNCellBase):

@@ -315,6 +315,10 @@ def _write_chrome_trace(events, path, xla_trace_dir=None, device_events=None,
     doc = {"traceEvents": trace_events, "displayTimeUnit": "ms"}
     if xla_trace_dir:
         doc["otherData"] = {"xla_trace_dir": xla_trace_dir}
+        if xla_t0_ns is not None:
+            # persisted so a load()ed result re-save()s the device spans on
+            # the ORIGINAL anchor, not the first-host-event fallback
+            doc["otherData"]["xla_t0_ns"] = int(xla_t0_ns)
     with open(path, "w") as f:
         json.dump(doc, f)
 
@@ -342,7 +346,8 @@ def load_profiler_result(filename: str) -> ProfilerResult:
             start_ns, start_ns + int(te["dur"] * 1e3), te.get("tid", 0),
             te.get("args", {}).get("step", 0)))
     xla_dir = doc.get("otherData", {}).get("xla_trace_dir")
-    return ProfilerResult(events, (0, 0), xla_dir)
+    xla_t0 = doc.get("otherData", {}).get("xla_t0_ns")
+    return ProfilerResult(events, (0, 0), xla_dir, xla_t0_ns=xla_t0)
 
 
 class Profiler:

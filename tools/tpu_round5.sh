@@ -69,8 +69,9 @@ stage decode_int8 env BENCH_DECODE_KV=int8 BENCH_NO_CACHE=1 \
     python bench.py --worker
 
 # 4) BASELINE suite at faithful TPU shapes (batch128/224px O2 resnet,
-#    BERT-base seq128, ...; shapes auto-select on_tpu in bench_suite.py)
-stage suite python bench_suite.py --configs lenet,resnet50,bert_dp
+#    BERT-base seq128; gpt_hybrid runs on its own 8-dev virtual CPU mesh —
+#    bench_suite gives each config its own subprocess env)
+stage suite python bench_suite.py --configs lenet,resnet50,bert_dp,gpt_hybrid
 
 echo "[tpu_round5] agenda complete; results:"
 echo "  - bench_cache.json (flagship live)"
