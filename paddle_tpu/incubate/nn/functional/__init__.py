@@ -7,6 +7,8 @@ it into neighbouring HLO; the per-op eager path still runs it as one cached exec
 """
 from __future__ import annotations
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
@@ -622,13 +624,145 @@ def masked_multihead_attention(
     return Tensor(out), Tensor(jnp.stack([ck, cvv]))
 
 
-def block_multihead_attention(*args, **kwargs):
-    """reference block_multihead_attention: paged-KV (block table) serving
-    attention. The paged block layout is a CUDA serving-kernel contract;
-    this build's serving path is models.llama_decode.LlamaDecodeEngine
-    (dense KV cache, optional int8 quantization, beam search), which covers
-    the capability without the page-table indirection."""
-    raise NotImplementedError(
-        "paged block-table attention is not provided; use "
-        "models.llama_decode.LlamaDecodeEngine (dense or int8 KV cache) "
-        "for serving decode")
+def block_multihead_attention(
+        qkv, key_cache, value_cache, seq_lens_encoder, seq_lens_decoder,
+        seq_lens_this_time, padding_offsets=None, cum_offsets=None,
+        cu_seqlens_q=None, cu_seqlens_k=None, block_tables=None,
+        pre_key_cache=None, pre_value_cache=None, cache_k_quant_scales=None,
+        cache_v_quant_scales=None, cache_k_dequant_scales=None,
+        cache_v_dequant_scales=None, qkv_out_scale=None, qkv_bias=None,
+        out_shift=None, out_smooth=None, max_enc_len_this_time=None,
+        max_dec_len_this_time=None, rope_emb=None, mask=None, tgt_mask=None,
+        max_seq_len=-1, block_size=64, use_neox_style=False,
+        use_dynamic_cachekv_quant=False, quant_round_type=1,
+        quant_max_bound=127.0, quant_min_bound=-127.0, out_scale=-1,
+        compute_dtype="default", rope_theta=10000.0, name=None):
+    """reference block_multihead_attention.py:33 — paged-KV (block-table)
+    serving attention. The KV cache is a POOL of fixed-size blocks; each
+    sequence's block_tables row lists the blocks it owns. TPU-first: the
+    block indirection is jnp gathers/scatters the compiler fuses into the
+    attention chain (models/paged_kv.py), not a page-table CUDA kernel.
+
+    Layouts follow the reference contract: ``qkv`` is varlen-packed rows
+    [token_num, (q_heads + 2*kv_heads) * head_dim]; ``key_cache``/
+    ``value_cache`` are [max_block_num, kv_heads, block_size, head_dim].
+    Two phases, per the reference semantics: prefill rows
+    (seq_lens_encoder > 0) run causal self-attention over the prompt and
+    write it into the blocks; decode rows (seq_lens_this_time == 1 with
+    seq_lens_decoder > 0) append one token and attend over the paged
+    history. Returns (out, qkv, key_cache, value_cache).
+
+    Quantized-cache / rotary / smooth-quant extras raise (the
+    masked_multihead_attention policy: reject, never silently ignore)."""
+    from ....framework.core import Tensor
+    from ....models import paged_kv as _pk
+
+    for bad_name, bad in (
+            ("cache_k_quant_scales", cache_k_quant_scales),
+            ("cache_v_quant_scales", cache_v_quant_scales),
+            ("cache_k_dequant_scales", cache_k_dequant_scales),
+            ("cache_v_dequant_scales", cache_v_dequant_scales),
+            ("qkv_out_scale", qkv_out_scale), ("out_shift", out_shift),
+            ("out_smooth", out_smooth), ("rope_emb", rope_emb),
+            ("pre_key_cache", pre_key_cache),
+            ("pre_value_cache", pre_value_cache)):
+        if bad is not None:
+            raise NotImplementedError(
+                f"block_multihead_attention: {bad_name} is not supported by "
+                "this build (apply rotary in the model; use "
+                "LlamaDecodeEngine(kv_cache_dtype='int8') for quantized KV)")
+    if use_dynamic_cachekv_quant or out_scale != -1:
+        raise NotImplementedError(
+            "block_multihead_attention: cache-KV quantization paths are not "
+            "supported here")
+    if mask is not None or tgt_mask is not None:
+        raise NotImplementedError(
+            "block_multihead_attention: custom mask/tgt_mask are not "
+            "supported; the paged path computes causal prefill and "
+            "full-history decode masking only")
+    if block_tables is None:
+        raise ValueError("block_tables is required")
+
+    def _v(x):
+        return x.value if isinstance(x, Tensor) else jnp.asarray(x)
+
+    qkv_v = _v(qkv)
+    kc = _v(key_cache)
+    vc = _v(value_cache)
+    tables = _v(block_tables).astype(jnp.int32)
+    enc = np.asarray(_v(seq_lens_encoder)).reshape(-1)
+    dec = np.asarray(_v(seq_lens_decoder)).reshape(-1)
+    this = np.asarray(_v(seq_lens_this_time)).reshape(-1)
+
+    n_kv, bs, hd = kc.shape[1], kc.shape[2], kc.shape[3]
+    n_q = qkv_v.shape[-1] // hd - 2 * n_kv
+    if qkv_bias is not None:
+        qkv_v = qkv_v + _v(qkv_bias).reshape(-1)
+
+    # reference layout [nb, kv, bs, d] <-> pool layout [nb, bs, kv, d]
+    kc_p = jnp.swapaxes(kc, 1, 2)
+    vc_p = jnp.swapaxes(vc, 1, 2)
+
+    is_prefill = enc.sum() > 0
+    B = tables.shape[0]
+    if is_prefill:
+        if dec.sum() != 0:
+            raise NotImplementedError(
+                "block_multihead_attention: mixed prefill+decode batches "
+                "are not supported; split the batch by phase")
+        if not (this == enc).all():
+            raise NotImplementedError(
+                "block_multihead_attention: chunked prefill "
+                "(seq_lens_this_time != seq_lens_encoder) is not supported "
+                f"(this={this.tolist()}, encoder={enc.tolist()})")
+        S = int(enc.max())
+        # unpack varlen rows -> padded [B, S, ...] in ONE scatter (a
+        # per-sequence .at[b, :L].set loop would copy the whole padded
+        # array B times)
+        row_b = np.repeat(np.arange(B), this)               # [token_num]
+        row_t = np.concatenate([np.arange(int(L)) for L in this])
+        rows_all = qkv_v.reshape(-1, n_q + 2 * n_kv, hd)
+        q_pad = jnp.zeros((B, S, n_q, hd), qkv_v.dtype).at[
+            row_b, row_t].set(rows_all[:, :n_q])
+        k_pad = jnp.zeros((B, S, n_kv, hd), qkv_v.dtype).at[
+            row_b, row_t].set(rows_all[:, n_q:n_q + n_kv])
+        v_pad = jnp.zeros((B, S, n_kv, hd), qkv_v.dtype).at[
+            row_b, row_t].set(rows_all[:, n_q + n_kv:])
+        lens = jnp.asarray(enc, jnp.int32)
+        kc_p, vc_p = _pk.paged_write_prefill(kc_p, vc_p, tables, lens,
+                                             k_pad, v_pad)
+        # causal self-attention over the prompt (fp32 softmax)
+        groups = n_q // n_kv
+        qg = q_pad.reshape(B, S, n_kv, groups, hd)
+        logits = jnp.einsum("bshgd,bthd->bhgst", qg.astype(jnp.float32),
+                            k_pad.astype(jnp.float32)) / np.sqrt(hd)
+        t_idx = jnp.arange(S)
+        causal = t_idx[None, :] <= t_idx[:, None]           # [S, S]
+        valid = t_idx[None, :] < lens[:, None]              # [B, S]
+        m = causal[None, None, None] & valid[:, None, None, None, :]
+        logits = jnp.where(m, logits, -1e30)
+        probs = jax.nn.softmax(logits, -1)
+        o = jnp.einsum("bhgst,bthd->bshgd", probs,
+                       v_pad.astype(jnp.float32)).astype(qkv_v.dtype)
+        # re-pack the padded output to varlen rows with one gather
+        out = o.reshape(B, S, n_q * hd)[row_b, row_t]
+    else:
+        if not (this == 1).all():
+            raise NotImplementedError(
+                "block_multihead_attention decode phase expects one token "
+                "per sequence (seq_lens_this_time == 1)")
+        rows = qkv_v.reshape(B, n_q + 2 * n_kv, hd)
+        q_new = rows[:, :n_q]
+        k_new = rows[:, n_q:n_q + n_kv]
+        v_new = rows[:, n_q + n_kv:]
+        lens = jnp.asarray(dec, jnp.int32)
+        kc_p, vc_p = _pk.paged_write_decode(kc_p, vc_p, tables, lens,
+                                            k_new, v_new)
+        o = _pk.paged_attention_decode(q_new, kc_p, vc_p, tables, lens)
+        out = o.reshape(B, n_q * hd)
+
+    kc_out = jnp.swapaxes(kc_p, 1, 2)
+    vc_out = jnp.swapaxes(vc_p, 1, 2)
+    # the returned qkv reflects the bias actually used for attention (the
+    # reference kernel applies qkv_bias in place)
+    return (Tensor(out), Tensor(qkv_v), Tensor(kc_out), Tensor(vc_out))

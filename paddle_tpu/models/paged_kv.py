@@ -1,0 +1,162 @@
+"""Paged KV cache: block-table attention for serving decode.
+
+Reference analog: paddle/incubate/nn/functional/block_multihead_attention.py
+(paged "Block Multi-head attention": the KV cache is a POOL of fixed-size
+blocks, each sequence owns a list of block ids — its block table — so cache
+memory is allocated block-at-a-time, sequences of very different lengths
+don't reserve max_len each, and finished sequences return their blocks).
+The reference implements it as a CUDA serving kernel
+(fluid/operators/fused/block_multi_head_attention_op.cu); TPU-first
+redesign: the pool is a [num_blocks, block_size, kv_heads, head_dim] array,
+the block table drives jnp gathers/scatters, and XLA fuses the
+gather -> attention -> reduce chain — no page-table indirection kernel is
+hand-written, the indexed reads ARE the indirection.
+
+Layout note: the reference kernel stores [max_blocks, kv_heads, block_size,
+head_dim]; here blocks are [block_size, kv_heads, head_dim]-major so the
+gathered view reshapes straight to the [B, S, H, D] attention layout with
+no transpose.
+
+Everything is functional and jit-compatible: cache arrays in, cache arrays
+out (donate-friendly), shapes static, per-sequence lengths as data.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["PagedKVCache", "alloc_blocks", "paged_write_decode",
+           "paged_write_prefill", "paged_attention_decode"]
+
+
+class PagedKVCache:
+    """Host-side block allocator + the device block pools for ONE layer set.
+
+    The allocator (free-list) is host logic — block grant/free decisions are
+    control flow, not device math (the reference's BlockManager is host C++
+    too). The pools and tables live on device and flow through jit.
+    """
+
+    def __init__(self, num_layers, num_blocks, block_size, kv_heads, head_dim,
+                 batch, max_blocks_per_seq, dtype=jnp.bfloat16):
+        self.block_size = int(block_size)
+        self.num_blocks = int(num_blocks)
+        self.max_blocks_per_seq = int(max_blocks_per_seq)
+        shape = (num_blocks, block_size, kv_heads, head_dim)
+        self.k = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
+        self.v = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
+        # block 0 is the permanently-reserved NULL block: unassigned table
+        # slots point at it, so gathers stay in-bounds without masking reads
+        self._free = list(range(num_blocks - 1, 0, -1))
+        self.block_tables = jnp.zeros((batch, max_blocks_per_seq), jnp.int32)
+        self.seq_lens = jnp.zeros((batch,), jnp.int32)
+
+    # -- host-side allocator -------------------------------------------------
+    def ensure_capacity(self, seq_lens_next):
+        """Grant blocks so every sequence can hold seq_lens_next[b] tokens.
+        Mutates the host table copy then re-uploads; called between steps
+        (not inside jit)."""
+        tables = np.asarray(self.block_tables).copy()
+        owned = (tables > 0).sum(axis=1)
+        for b, need_tok in enumerate(np.asarray(seq_lens_next)):
+            need = int(-(-int(need_tok) // self.block_size))  # ceil
+            while owned[b] < need:
+                if not self._free:
+                    raise RuntimeError(
+                        "paged KV pool exhausted: no free blocks "
+                        f"(pool={self.num_blocks}, block={self.block_size})")
+                tables[b, owned[b]] = self._free.pop()
+                owned[b] += 1
+        self.block_tables = jnp.asarray(tables)
+
+    def free_sequence(self, b):
+        """Return sequence b's blocks to the pool."""
+        tables = np.asarray(self.block_tables).copy()
+        for blk in tables[b]:
+            if blk > 0:
+                self._free.append(int(blk))
+        tables[b] = 0
+        self.block_tables = jnp.asarray(tables)
+        self.seq_lens = self.seq_lens.at[b].set(0)
+
+
+def alloc_blocks(batch, max_len, block_size):
+    """Static shape helper: blocks per sequence for a max_len budget."""
+    return -(-max_len // block_size)
+
+
+def paged_write_decode(cache_k, cache_v, block_tables, seq_lens, k_new, v_new):
+    """Write ONE new token per sequence into its current tail block.
+
+    k_new/v_new: [B, kv_heads, head_dim]; position = seq_lens[b].
+    Returns (cache_k, cache_v) with the writes applied (functional)."""
+    bs = cache_k.shape[1]
+    pos = seq_lens.astype(jnp.int32)
+    blk_idx = pos // bs
+    off = pos % bs
+    rows = jnp.arange(block_tables.shape[0])
+    phys = block_tables[rows, blk_idx]                  # [B]
+    cache_k = cache_k.at[phys, off].set(k_new.astype(cache_k.dtype))
+    cache_v = cache_v.at[phys, off].set(v_new.astype(cache_v.dtype))
+    return cache_k, cache_v
+
+
+def paged_write_prefill(cache_k, cache_v, block_tables, seq_lens,
+                        k_new, v_new):
+    """Write a full prompt per sequence: k_new/v_new [B, S, kv_heads, D],
+    token t of sequence b lands at block_tables[b, t // bs] offset t % bs
+    (only t < seq_lens[b] rows are written; the rest target the null block
+    but are masked by never being read — seq_lens bounds every gather)."""
+    B, S = k_new.shape[0], k_new.shape[1]
+    nb, bs = cache_k.shape[0], cache_k.shape[1]
+    t = jnp.arange(S)
+    blk_idx = t // bs                                   # [S]
+    off = t % bs
+    phys = block_tables[:, blk_idx]                     # [B, S]
+    valid = t[None, :] < seq_lens[:, None]              # [B, S]
+    # padding rows target an OUT-OF-BOUNDS block and are DROPPED by the
+    # scatter — redirecting them at any real block id (block 0 included)
+    # would clobber whichever sequence owns that block
+    phys = jnp.where(valid, phys, nb)
+    flat_phys = phys.reshape(-1)
+    flat_off = jnp.tile(off, B)
+    cache_k = cache_k.at[flat_phys, flat_off].set(
+        k_new.reshape(B * S, *k_new.shape[2:]).astype(cache_k.dtype),
+        mode="drop")
+    cache_v = cache_v.at[flat_phys, flat_off].set(
+        v_new.reshape(B * S, *v_new.shape[2:]).astype(cache_v.dtype),
+        mode="drop")
+    return cache_k, cache_v
+
+
+def paged_attention_decode(q, cache_k, cache_v, block_tables, seq_lens,
+                           scale=None):
+    """One decode step of attention against the paged cache.
+
+    q: [B, q_heads, head_dim] (GQA: q_heads a multiple of kv_heads).
+    Gathers each sequence's blocks into a [B, T_max, kv, D] view
+    (T_max = max_blocks_per_seq * block_size) and masks t <= seq_lens[b]
+    (inclusive: the current token was just written at position seq_lens).
+    XLA fuses gather + QK + softmax + PV; bandwidth matches the dense cache
+    read — the block indirection costs the index arithmetic only."""
+    B, n_q, D = q.shape
+    nb, bs, n_kv, _ = cache_k.shape
+    groups = n_q // n_kv
+    T = block_tables.shape[1] * bs
+
+    k = cache_k[block_tables].reshape(B, T, n_kv, D)
+    v = cache_v[block_tables].reshape(B, T, n_kv, D)
+
+    if scale is None:
+        scale = 1.0 / np.sqrt(D)
+    qg = q.reshape(B, n_kv, groups, D)
+    logits = jnp.einsum("bhgd,bthd->bhgt", qg.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    t = jnp.arange(T)[None, None, None, :]
+    mask = t <= seq_lens[:, None, None, None]
+    logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhgt,bthd->bhgd", probs, v.astype(jnp.float32))
+    return out.reshape(B, n_q, D).astype(q.dtype)

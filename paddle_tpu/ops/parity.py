@@ -138,7 +138,7 @@ ALIASES = {
     "fused_multi_transformer": "incubate.nn.FusedMultiTransformer",
     "fp8_fp8_half_gemm_fused": "quantization weight-only int8/fp8 matmul",
     "blha_get_max_len": "models.llama_decode KV cache bookkeeping",
-    "block_multihead_attention_": "models.llama_decode paged decode attention",
+    "block_multihead_attention_": "incubate.nn.functional.block_multihead_attention over models/paged_kv.py (block-table pool, prefill+decode)",
     "masked_multihead_attention_": "models.llama_decode decode attention",
     "qkv_unpack_mha": "flash_attention unpacked path",
     "resnet_basic_block": "paddle.vision.models.resnet BasicBlock (XLA fuses)",
