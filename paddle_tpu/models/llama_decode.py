@@ -47,21 +47,50 @@ def _rope_at(x, positions, theta):
     return x * cos + _rotate_half(x) * sin
 
 
+class _PagedCache:
+    """Cache value of the paged engine: the block pools (device) plus THEIR
+    pager (host allocator + tables). The pager travels with the cache, not
+    the engine, so interleaved prefills cannot cross-wire block tables."""
+
+    __slots__ = ("pager", "pools")
+
+    def __init__(self, pager, pools):
+        self.pager = pager
+        self.pools = pools
+
+
 class LlamaDecodeEngine:
     """Greedy/temperature decoding with a per-layer KV cache."""
 
-    def __init__(self, model, max_len=None, kv_cache_dtype=None):
+    def __init__(self, model, max_len=None, kv_cache_dtype=None,
+                 kv_cache_layout=None, block_size=64):
         """``kv_cache_dtype="int8"`` stores K/V quantized per (token, head)
         with fp32 absmax scales: half the KV-cache HBM footprint and read
         bandwidth — decode attention is KV-bandwidth-bound, so this is the
         serving lever (the reference's cache-KV int8 capability in
         quantized inference); dequantization happens after the int8 loads,
-        inside the compiled step."""
+        inside the compiled step.
+
+        ``kv_cache_layout="paged"`` stores K/V in a block pool indexed by
+        per-sequence block tables (models/paged_kv.py; the reference's
+        block_multihead_attention serving mode): blocks are granted lazily
+        on the host as decoding advances, so cache memory scales with
+        actual tokens, not batch * max_len."""
         cfg = model.config
         self.config = cfg
         if kv_cache_dtype not in (None, "int8"):
             raise ValueError(f"unsupported kv_cache_dtype {kv_cache_dtype!r}")
         self.kv_int8 = kv_cache_dtype == "int8"
+        if kv_cache_layout not in (None, "dense", "paged"):
+            raise ValueError(
+                f"unsupported kv_cache_layout {kv_cache_layout!r}")
+        self.paged = kv_cache_layout == "paged"
+        if self.paged and self.kv_int8:
+            raise NotImplementedError(
+                "paged + int8 KV cache are separate levers in this build; "
+                "pick one (quantized paged blocks are a follow-up)")
+        self.block_size = int(block_size)
+        self._pager = None   # built at prefill (batch known then)
         self.max_len = int(max_len or cfg.max_position_embeddings)
         self.num_heads = cfg.num_attention_heads
         self.num_kv = cfg.num_key_value_heads
@@ -201,6 +230,94 @@ class LlamaDecodeEngine:
         x = _rms(x, self.norm_w, self.eps)
         return x @ self.head_w, new_cache
 
+    # -- paged forward paths (models/paged_kv.py pool + tables) --------------
+    def _qkv_rope(self, p, x, positions):
+        """Shared pre-attention: rms -> q/k/v projections -> RoPE."""
+        B, S, _ = x.shape
+        h = _rms(x, p["ln1"], self.eps)
+        q = (h @ p["wq"]).reshape(B, S, self.num_heads, self.head_dim)
+        k = (h @ p["wk"]).reshape(B, S, self.num_kv, self.head_dim)
+        v = (h @ p["wv"]).reshape(B, S, self.num_kv, self.head_dim)
+        return (_rope_at(q, positions, self.theta),
+                _rope_at(k, positions, self.theta), v)
+
+    def _post_attn(self, p, x, attn):
+        """Shared epilogue: output proj + residual + rms + SwiGLU MLP."""
+        B, S = x.shape[0], x.shape[1]
+        x = x + attn.reshape(B, S, -1) @ p["wo"]
+        h2 = _rms(x, p["ln2"], self.eps)
+        mlp = (jax.nn.silu(h2 @ p["gate"]) * (h2 @ p["up"])) @ p["down"]
+        return x + mlp
+
+    def _block_paged_prefill(self, p, x, kpool, vpool, tables, lens):
+        """Prompt pass: causal self-attention within the prompt (the history
+        IS the prompt), k/v written into the sequence's blocks."""
+        from . import paged_kv as _pk
+
+        B, S, _ = x.shape
+        q, k, v = self._qkv_rope(p, x, jnp.arange(S))
+        kpool, vpool = _pk.paged_write_prefill(kpool, vpool, tables, lens,
+                                               k, v)
+        t_idx = jnp.arange(S)
+        pos_mask = jnp.broadcast_to(
+            t_idx[None, None, :] <= t_idx[None, :, None], (B, S, S))
+        attn = self._attend(q, k, v, pos_mask)
+        return self._post_attn(p, x, attn), kpool, vpool
+
+    def _block_paged_decode(self, p, x, kpool, vpool, tables, lens, pos):
+        from . import paged_kv as _pk
+
+        q, k, v = self._qkv_rope(p, x, pos + jnp.arange(1))
+        kpool, vpool = _pk.paged_write_decode(kpool, vpool, tables, lens,
+                                              k[:, 0], v[:, 0])
+        attn = _pk.paged_attention_decode(q[:, 0], kpool, vpool, tables,
+                                          lens)[:, None]
+        return self._post_attn(p, x, attn), kpool, vpool
+
+    @functools.cached_property
+    def _prefill_paged_jit(self):
+        def run(ids, pools, tables, lens):
+            x = self.emb[ids]
+            new_pools = []
+            for p, (kp, vp) in zip(self.layers, pools):
+                x, kp, vp = self._block_paged_prefill(p, x, kp, vp, tables,
+                                                      lens)
+                new_pools.append((kp, vp))
+            x = _rms(x, self.norm_w, self.eps)
+            return x @ self.head_w, new_pools
+
+        return jax.jit(run, donate_argnums=(1,))
+
+    @functools.cached_property
+    def _step_paged_jit(self):
+        def run(token, pools, tables, pos):
+            # lens derives from pos INSIDE the trace: the engine decodes in
+            # lockstep, so no per-token host-built array is needed
+            lens = jnp.full((token.shape[0],), pos, jnp.int32)
+            x = self.emb[token]
+            new_pools = []
+            for p, (kp, vp) in zip(self.layers, pools):
+                x, kp, vp = self._block_paged_decode(p, x, kp, vp, tables,
+                                                     lens, pos)
+                new_pools.append((kp, vp))
+            x = _rms(x, self.norm_w, self.eps)
+            return (x @ self.head_w)[:, -1], new_pools
+
+        return jax.jit(run, donate_argnums=(1,))
+
+    def _init_paged(self, batch):
+        from .paged_kv import PagedKVCache
+
+        max_blocks = -(-self.max_len // self.block_size)
+        # pool sized for the worst case + the reserved null block; blocks
+        # are still GRANTED lazily, so a short-lived batch touches few
+        pager = PagedKVCache(
+            num_layers=len(self.layers), num_blocks=batch * max_blocks + 1,
+            block_size=self.block_size, kv_heads=self.num_kv,
+            head_dim=self.head_dim, batch=batch,
+            max_blocks_per_seq=max_blocks, dtype=self.emb.dtype)
+        return pager, list(zip(pager.k, pager.v))
+
     # -- public API ----------------------------------------------------------
     @functools.cached_property
     def _prefill_jit(self):
@@ -217,9 +334,18 @@ class LlamaDecodeEngine:
 
     def prefill(self, input_ids):
         ids = jnp.asarray(getattr(input_ids, "value", input_ids), jnp.int32)
-        cache = self.init_cache(ids.shape[0])
+        B, S = ids.shape
+        if self.paged:
+            pager, pools = self._init_paged(B)
+            self._pager = pager   # introspection only; the CACHE owns it
+            pager.ensure_capacity([S] * B)
+            lens = jnp.full((B,), S, jnp.int32)
+            logits, pools = self._prefill_paged_jit(
+                ids, pools, pager.block_tables, lens)
+            return logits[:, -1], _PagedCache(pager, pools), S
+        cache = self.init_cache(B)
         logits, cache = self._prefill_jit(ids, cache)
-        return logits[:, -1], cache, ids.shape[1]
+        return logits[:, -1], cache, S
 
     def decode_step(self, token, cache, pos):
         """token (B, 1) int32 -> (next-token logits (B, V), cache')."""
@@ -230,6 +356,20 @@ class LlamaDecodeEngine:
                 f"decode position {int(pos)} exceeds the cache "
                 f"(max_len={self.max_len}); build the engine with a larger "
                 "max_len")
+        if self.paged:
+            if not isinstance(cache, _PagedCache):
+                raise TypeError(
+                    "paged decode_step needs the cache returned by "
+                    "prefill() (each prefill owns its own block tables; "
+                    "engine-level state would cross-wire interleaved "
+                    "sequences)")
+            pager = cache.pager
+            # host-side block grant for position pos (writes land AT pos)
+            pager.ensure_capacity([int(pos) + 1] * pager.batch)
+            logits, pools = self._step_paged_jit(
+                jnp.asarray(token, jnp.int32), cache.pools,
+                pager.block_tables, jnp.asarray(pos, jnp.int32))
+            return logits, _PagedCache(pager, pools)
         return self._step_jit(jnp.asarray(token, jnp.int32), cache,
                               jnp.asarray(pos, jnp.int32))
 
@@ -323,6 +463,11 @@ class LlamaDecodeEngine:
         scores by len**alpha (0 = raw log-prob sum). EOS-finished beams are
         frozen (their score stops accumulating and the tail pads with EOS).
         """
+        if self.paged:
+            raise NotImplementedError(
+                "beam_search over the paged cache needs block-table beam "
+                "reordering (copy-on-write block sharing); use the dense "
+                "cache engine for beams")
         ids = jnp.asarray(getattr(input_ids, "value", input_ids), jnp.int32)
         B, S = ids.shape
         K, V = int(beam_size), self.head_w.shape[-1]

@@ -50,16 +50,22 @@ class PagedKVCache:
         # block 0 is the permanently-reserved NULL block: unassigned table
         # slots point at it, so gathers stay in-bounds without masking reads
         self._free = list(range(num_blocks - 1, 0, -1))
-        self.block_tables = jnp.zeros((batch, max_blocks_per_seq), jnp.int32)
+        self.batch = int(batch)
+        self._tables_np = np.zeros((batch, max_blocks_per_seq), np.int32)
+        self.block_tables = jnp.asarray(self._tables_np)
         self.seq_lens = jnp.zeros((batch,), jnp.int32)
 
     # -- host-side allocator -------------------------------------------------
     def ensure_capacity(self, seq_lens_next):
         """Grant blocks so every sequence can hold seq_lens_next[b] tokens.
-        Mutates the host table copy then re-uploads; called between steps
-        (not inside jit)."""
-        tables = np.asarray(self.block_tables).copy()
+
+        The table lives host-side (numpy mirror); the device copy is
+        re-uploaded ONLY when a grant actually happened — most decode steps
+        grant nothing (blocks change once per block_size tokens), and a
+        per-token host->device upload would sit in the serving hot loop."""
+        tables = self._tables_np
         owned = (tables > 0).sum(axis=1)
+        changed = False
         for b, need_tok in enumerate(np.asarray(seq_lens_next)):
             need = int(-(-int(need_tok) // self.block_size))  # ceil
             while owned[b] < need:
@@ -69,11 +75,13 @@ class PagedKVCache:
                         f"(pool={self.num_blocks}, block={self.block_size})")
                 tables[b, owned[b]] = self._free.pop()
                 owned[b] += 1
-        self.block_tables = jnp.asarray(tables)
+                changed = True
+        if changed:
+            self.block_tables = jnp.asarray(tables)
 
     def free_sequence(self, b):
         """Return sequence b's blocks to the pool."""
-        tables = np.asarray(self.block_tables).copy()
+        tables = self._tables_np
         for blk in tables[b]:
             if blk > 0:
                 self._free.append(int(blk))
