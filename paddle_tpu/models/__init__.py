@@ -27,3 +27,5 @@ from .gpt import (  # noqa: F401
     GPTModel,
     GPTPretrainingCriterion,
 )
+from .llama_decode import LlamaDecodeEngine  # noqa: F401
+from .serving import ContinuousBatchingEngine  # noqa: F401

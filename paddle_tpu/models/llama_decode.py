@@ -47,6 +47,19 @@ def _rope_at(x, positions, theta):
     return x * cos + _rotate_half(x) * sin
 
 
+def _rope_at_rows(x, positions, theta):
+    """x: (B, 1, H, D) rotated at PER-ROW absolute `positions` (B,) — the
+    ragged-batch form (continuous batching decodes every slot at its own
+    position in one step)."""
+    d = x.shape[-1]
+    inv = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    freqs = jnp.asarray(positions, jnp.float32)[:, None] * inv   # (B, D/2)
+    emb = jnp.concatenate([freqs, freqs], -1)                    # (B, D)
+    cos = jnp.cos(emb).astype(x.dtype)[:, None, None, :]
+    sin = jnp.sin(emb).astype(x.dtype)[:, None, None, :]
+    return x * cos + _rotate_half(x) * sin
+
+
 class _PagedCache:
     """Cache value of the paged engine: the block pools (device) plus THEIR
     pager (host allocator + tables). The pager travels with the cache, not
@@ -187,12 +200,7 @@ class LlamaDecodeEngine:
 
     def _block(self, p, x, cache_kv, positions, pos_mask):
         B, S, _ = x.shape
-        h = _rms(x, p["ln1"], self.eps)
-        q = (h @ p["wq"]).reshape(B, S, self.num_heads, self.head_dim)
-        k = (h @ p["wk"]).reshape(B, S, self.num_kv, self.head_dim)
-        v = (h @ p["wv"]).reshape(B, S, self.num_kv, self.head_dim)
-        q = _rope_at(q, positions, self.theta)
-        k = _rope_at(k, positions, self.theta)
+        q, k, v = self._qkv_rope(p, x, positions)
         start = positions[0]
         if self.kv_int8:
             ck_q, ck_s, cv_q, cv_s = cache_kv
@@ -210,10 +218,7 @@ class LlamaDecodeEngine:
             cv = lax.dynamic_update_slice(cv, v, (0, start, 0, 0))
             new_cache = (ck, cv)
             attn = self._attend(q, ck, cv, pos_mask)
-        x = x + attn.reshape(B, S, -1) @ p["wo"]
-        h2 = _rms(x, p["ln2"], self.eps)
-        mlp = (jax.nn.silu(h2 @ p["gate"]) * (h2 @ p["up"])) @ p["down"]
-        return x + mlp, new_cache
+        return self._post_attn(p, x, attn), new_cache
 
     def _forward(self, ids, cache, start_pos):
         """ids: (B, S) absolute positions start_pos..start_pos+S-1."""
@@ -264,10 +269,19 @@ class LlamaDecodeEngine:
         attn = self._attend(q, k, v, pos_mask)
         return self._post_attn(p, x, attn), kpool, vpool
 
-    def _block_paged_decode(self, p, x, kpool, vpool, tables, lens, pos):
+    def _block_paged_decode(self, p, x, kpool, vpool, tables, lens):
+        """One decode token per row at PER-ROW position lens[b] (write and
+        RoPE both happen at that position) — the same block serves lockstep
+        decoding (lens = broadcast pos) and continuous batching (ragged)."""
         from . import paged_kv as _pk
 
-        q, k, v = self._qkv_rope(p, x, pos + jnp.arange(1))
+        B = x.shape[0]
+        h = _rms(x, p["ln1"], self.eps)
+        q = (h @ p["wq"]).reshape(B, 1, self.num_heads, self.head_dim)
+        k = (h @ p["wk"]).reshape(B, 1, self.num_kv, self.head_dim)
+        v = (h @ p["wv"]).reshape(B, 1, self.num_kv, self.head_dim)
+        q = _rope_at_rows(q, lens, self.theta)
+        k = _rope_at_rows(k, lens, self.theta)
         kpool, vpool = _pk.paged_write_decode(kpool, vpool, tables, lens,
                                               k[:, 0], v[:, 0])
         attn = _pk.paged_attention_decode(q[:, 0], kpool, vpool, tables,
@@ -298,7 +312,7 @@ class LlamaDecodeEngine:
             new_pools = []
             for p, (kp, vp) in zip(self.layers, pools):
                 x, kp, vp = self._block_paged_decode(p, x, kp, vp, tables,
-                                                     lens, pos)
+                                                     lens)
                 new_pools.append((kp, vp))
             x = _rms(x, self.norm_w, self.eps)
             return (x @ self.head_w)[:, -1], new_pools

@@ -53,7 +53,6 @@ class PagedKVCache:
         self.batch = int(batch)
         self._tables_np = np.zeros((batch, max_blocks_per_seq), np.int32)
         self.block_tables = jnp.asarray(self._tables_np)
-        self.seq_lens = jnp.zeros((batch,), jnp.int32)
 
     # -- host-side allocator -------------------------------------------------
     def ensure_capacity(self, seq_lens_next):
@@ -77,7 +76,10 @@ class PagedKVCache:
                 owned[b] += 1
                 changed = True
         if changed:
-            self.block_tables = jnp.asarray(tables)
+            # upload a COPY: jnp.asarray of an aligned numpy array may be
+            # zero-copy on CPU, and an in-flight async step could still be
+            # reading the previous device view while the host mirror mutates
+            self.block_tables = jnp.asarray(tables.copy())
 
     def free_sequence(self, b):
         """Return sequence b's blocks to the pool."""
@@ -86,8 +88,7 @@ class PagedKVCache:
             if blk > 0:
                 self._free.append(int(blk))
         tables[b] = 0
-        self.block_tables = jnp.asarray(tables)
-        self.seq_lens = self.seq_lens.at[b].set(0)
+        self.block_tables = jnp.asarray(tables.copy())
 
 
 def alloc_blocks(batch, max_len, block_size):

@@ -1,0 +1,183 @@
+"""Continuous-batching serving engine over the paged KV cache.
+
+Reference analog: the block_multihead_attention serving stack
+(incubate/nn/functional/block_multihead_attention.py) exists exactly to
+serve BATCHES OF SEQUENCES AT DIFFERENT POSITIONS — seq_lens_encoder /
+seq_lens_decoder / block tables are its admission contract. This module is
+the engine on top of that capability, TPU-first:
+
+- one compiled decode step serves every active slot regardless of where
+  each sequence is (per-row lengths drive the paged attention mask and
+  per-row RoPE); shapes are static at max_batch, so XLA compiles ONCE
+- admission (add_request) prefills the new prompt into its slot's blocks
+  while other slots keep their state — prompts pad to a small set of
+  length buckets so prefill compiles stay bounded
+- eviction frees the slot's blocks back to the pool (models/paged_kv.py)
+
+The scheduler here is deliberately minimal (greedy sampling, FIFO slots);
+it is the capability proof, not a production batch scheduler.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import paged_kv as _pk
+from .llama_decode import LlamaDecodeEngine, _rms
+
+__all__ = ["ContinuousBatchingEngine"]
+
+
+class ContinuousBatchingEngine:
+    """Slot-based continuous batching: requests join and leave the running
+    batch between steps; every step decodes all active slots at once."""
+
+    def __init__(self, model, max_batch=8, max_len=None, block_size=64,
+                 prefill_buckets=(32, 64, 128, 256, 512, 1024, 2048)):
+        self._inner = LlamaDecodeEngine(model, max_len=max_len,
+                                        kv_cache_layout="paged",
+                                        block_size=block_size)
+        e = self._inner
+        self.max_batch = int(max_batch)
+        self.max_len = e.max_len
+        self.block_size = int(block_size)
+        self._buckets = tuple(b for b in sorted(prefill_buckets)
+                              if b <= e.max_len) or (e.max_len,)
+        max_blocks = -(-e.max_len // self.block_size)
+        self._pager = _pk.PagedKVCache(
+            num_layers=len(e.layers),
+            num_blocks=self.max_batch * max_blocks + 1,
+            block_size=self.block_size, kv_heads=e.num_kv,
+            head_dim=e.head_dim, batch=self.max_batch,
+            max_blocks_per_seq=max_blocks, dtype=e.emb.dtype)
+        self._pools = list(zip(self._pager.k, self._pager.v))
+        # host-side slot state
+        self.lens = np.zeros(self.max_batch, np.int64)     # tokens in cache
+        self.active = np.zeros(self.max_batch, bool)
+        self.request_ids = [None] * self.max_batch
+        self.last_token = np.zeros((self.max_batch, 1), np.int32)
+        self.outputs = [[] for _ in range(self.max_batch)]
+        self._next_rid = 0
+        self._jit_cache = {}
+
+    # -- compiled paths ------------------------------------------------------
+    def _prefill_slot_jit(self, bucket):
+        e = self._inner
+        key = ("prefill", bucket)
+        cache = self._jit_cache
+        if key not in cache:
+            def run(ids, pools, row_tables, length):
+                # ids: (1, bucket) padded prompt; only `length` rows are
+                # real — causal masking keeps padding out of real rows'
+                # attention, and paged_write_prefill drops padded writes
+                x = e.emb[ids]
+                lens1 = jnp.asarray([length], jnp.int32)
+                new_pools = []
+                for p, (kp, vp) in zip(e.layers, pools):
+                    x, kp, vp = e._block_paged_prefill(p, x, kp, vp,
+                                                       row_tables, lens1)
+                    new_pools.append((kp, vp))
+                x = _rms(x, e.norm_w, e.eps)
+                logits = x @ e.head_w
+                return logits[0, length - 1], new_pools
+
+            cache[key] = jax.jit(run, donate_argnums=(1,))
+        return cache[key]
+
+    def _step_all_jit(self):
+        e = self._inner
+        cache = self._jit_cache
+        if "step" not in cache:
+            def run(tokens, pools, tables, lens):
+                # tokens (B, 1); lens (B,) per-row positions — ragged:
+                # _block_paged_decode ropes/writes/attends at lens[b]
+                x = e.emb[tokens]
+                new_pools = []
+                for p, (kp, vp) in zip(e.layers, pools):
+                    x, kp, vp = e._block_paged_decode(p, x, kp, vp, tables,
+                                                      lens)
+                    new_pools.append((kp, vp))
+                x = _rms(x, e.norm_w, e.eps)
+                logits = (x @ e.head_w)[:, -1]
+                return jnp.argmax(logits, -1).astype(jnp.int32), new_pools
+
+            cache["step"] = jax.jit(run, donate_argnums=(1,))
+        return cache["step"]
+
+    # -- admission / eviction ------------------------------------------------
+    def add_request(self, prompt_ids):
+        """Admit one prompt into a free slot; returns the request id (or
+        None when the batch is full — callers queue and retry)."""
+        prompt = np.asarray(getattr(prompt_ids, "value", prompt_ids),
+                            np.int32).reshape(-1)
+        L = len(prompt)
+        if L == 0 or L >= self.max_len:
+            raise ValueError(f"prompt length {L} out of range (1.."
+                             f"{self.max_len - 1})")
+        free = np.flatnonzero(~self.active)
+        if not len(free):
+            return None
+        slot = int(free[0])
+        bucket = next(b for b in self._buckets if b >= L) \
+            if L <= self._buckets[-1] else self.max_len
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :L] = prompt
+        # grant for ACTIVE slots + the admitted one only — lens_next+1 over
+        # every idle slot would park a block on each of them indefinitely
+        need = np.where(self.active, self.lens + 1, 0)
+        need[slot] = L + 1
+        self._pager.ensure_capacity(need)
+        row_tables = self._pager.block_tables[slot:slot + 1]
+        logits, self._pools = self._prefill_slot_jit(bucket)(
+            jnp.asarray(padded), self._pools, row_tables,
+            jnp.asarray(L, jnp.int32))
+        tok = int(np.asarray(jnp.argmax(logits, -1)))
+        rid = self._next_rid
+        self._next_rid += 1
+        self.active[slot] = True
+        self.lens[slot] = L
+        self.request_ids[slot] = rid
+        self.last_token[slot, 0] = tok
+        self.outputs[slot] = [tok]
+        return rid
+
+    def step(self, eos_token_id=None, max_new_tokens=None):
+        """One decode step for EVERY active slot. Returns the list of
+        finished (request_id, tokens) pairs evicted this step."""
+        if not self.active.any():
+            return []
+        self._pager.ensure_capacity(self.lens + self.active)
+        step = self._step_all_jit()
+        toks, self._pools = step(
+            jnp.asarray(self.last_token), self._pools,
+            self._pager.block_tables, jnp.asarray(self.lens, jnp.int32))
+        toks = np.asarray(toks)
+        finished = []
+        for slot in np.flatnonzero(self.active):
+            slot = int(slot)
+            self.lens[slot] += 1
+            tok = int(toks[slot])
+            self.outputs[slot].append(tok)
+            self.last_token[slot, 0] = tok
+            done = (eos_token_id is not None and tok == eos_token_id) \
+                or (max_new_tokens is not None
+                    and len(self.outputs[slot]) >= max_new_tokens) \
+                or self.lens[slot] + 1 >= self.max_len
+            if done:
+                finished.append((self.request_ids[slot],
+                                 list(self.outputs[slot])))
+                self._evict(slot)
+        return finished
+
+    def _evict(self, slot):
+        self._pager.free_sequence(slot)
+        self.active[slot] = False
+        self.lens[slot] = 0
+        self.request_ids[slot] = None
+        self.outputs[slot] = []
+
+    @property
+    def num_active(self):
+        return int(self.active.sum())
