@@ -147,9 +147,56 @@ def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
 
 
 # ---- interpolate (nearest/bilinear/bicubic/trilinear/area) -----------------
+def _cubic_taps(src, n_in, a=-0.75):
+    """Keys cubic-convolution taps/weights at fractional coords `src`.
+
+    a=-0.75 is the reference/torch kernel (bicubic_interp uses the OpenCV
+    convention); jax.image.resize's "cubic" is Catmull-Rom (a=-0.5), which
+    is why bicubic cannot delegate there. Edge taps clamp (border
+    replication) with weights kept, matching both reference kernels."""
+    f = jnp.floor(src)
+    t = src - f
+
+    def W(x):
+        ax = jnp.abs(x)
+        near = ((a + 2.0) * ax - (a + 3.0)) * ax * ax + 1.0
+        far = (((ax - 5.0) * ax + 8.0) * ax - 4.0) * a
+        return jnp.where(ax <= 1.0, near, jnp.where(ax < 2.0, far, 0.0))
+
+    ws = jnp.stack([W(t + 1.0), W(t), W(1.0 - t), W(2.0 - t)], -1)
+    idx = f[:, None].astype(jnp.int32) + jnp.arange(-1, 3)[None, :]
+    return jnp.clip(idx, 0, n_in - 1), ws
+
+
 @defop("interpolate_op")
-def _interp(v, size=None, method="nearest", align_corners=False):
+def _interp(v, size=None, method="nearest", align_corners=False, scales=None):
     out_shape = (v.shape[0],) + tuple(size) + (v.shape[-1],)
+    if method == "cubic":
+        # separable bicubic per spatial dim; src mapping per align mode.
+        # With an explicit scale_factor the RATIO is 1/scale (torch and the
+        # reference both feed the given scale into the coordinate mapping,
+        # not the floor(n*scale)/n quotient) — they differ for non-integer
+        # scales.
+        out = v
+        ct = jnp.promote_types(v.dtype, jnp.float32)  # bf16 -> f32, f64 stays
+        for d, (n_in, n_out) in enumerate(zip(v.shape[1:-1], size)):
+            axis = 1 + d
+            if n_in == 1:
+                src = jnp.zeros(n_out)
+            elif align_corners:
+                src = jnp.arange(n_out) * ((n_in - 1.0) / max(n_out - 1, 1))
+            else:
+                ratio = (1.0 / scales[d]) if scales else (n_in / n_out)
+                src = (jnp.arange(n_out) + 0.5) * ratio - 0.5
+            idx, ws = _cubic_taps(src, n_in)
+            shape = [1] * out.ndim
+            shape[axis] = n_out
+            acc = 0.0
+            for k in range(4):
+                wk = ws[:, k].reshape(shape).astype(ct)
+                acc = acc + jnp.take(out, idx[:, k], axis=axis).astype(ct) * wk
+            out = acc  # stay in the compute dtype across dims (one rounding)
+        return out.astype(v.dtype)
     if not align_corners or method == "nearest":
         return jax.image.resize(v, out_shape, method=method)
     # align_corners=True: corner pixels map exactly — gather with explicit coordinates
@@ -207,9 +254,11 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=F
     spatial = nd - 2
     xc = x if channel_last else _tr(x, [0] + list(range(2, nd)) + [1])
     in_spatial = xc.value.shape[1:-1]
+    scales = None
     if size is None:
         if isinstance(scale_factor, (int, float)):
             scale_factor = [scale_factor] * spatial
+        scales = tuple(float(f) for f in scale_factor)
         size = [int(s * f) for s, f in zip(in_spatial, scale_factor)]
     else:
         if isinstance(size, Tensor):
@@ -221,7 +270,8 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=F
     else:
         method = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
                   "trilinear": "linear", "bicubic": "cubic"}[mode_l]
-        out = _interp(xc, size=tuple(size), method=method, align_corners=bool(align_corners))
+        out = _interp(xc, size=tuple(size), method=method,
+                      align_corners=bool(align_corners), scales=scales)
     if not channel_last:
         return _tr(out, [0, nd - 1] + list(range(1, nd - 1)))
     return out

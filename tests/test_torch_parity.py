@@ -158,3 +158,141 @@ class TestOptimizerTrajectoryParity:
                 learning_rate=1e-2, epsilon=1e-10, parameters=ps),
             lambda ts: torch.optim.Adagrad(ts, lr=1e-2, eps=1e-10))
         np.testing.assert_allclose(p, t, rtol=3e-5, atol=1e-6)
+
+
+@pytest.mark.slow
+class TestConvNormPoolParity:
+    """Conv/norm/pool/resize semantics vs torch with identical weights —
+    padding arithmetic, stride/dilation corners, align_corners modes."""
+
+    def _cmp(self, pout, tout, tol=1e-9):
+        np.testing.assert_allclose(pout.numpy(), tout.detach().numpy(),
+                                   rtol=tol, atol=tol)
+
+    def test_conv2d_stride_pad_dilation_groups(self):
+        import torch
+
+        for stride, pad, dil, groups in ((1, 0, 1, 1), (2, 1, 1, 1),
+                                         (1, 2, 2, 1), (1, 1, 1, 2)):
+            torch.manual_seed(0)
+            tm = torch.nn.Conv2d(4, 6, 3, stride=stride, padding=pad,
+                                 dilation=dil, groups=groups).double()
+            pm = paddle.nn.Conv2D(4, 6, 3, stride=stride, padding=pad,
+                                  dilation=dil, groups=groups)
+            # astype BEFORE loading: set_state_dict casts to the existing
+            # param dtype, so f64 oracle weights would round through f32
+            pm = pm.astype("float64")
+            pm.set_state_dict({k: v.numpy() for k, v in tm.state_dict().items()})
+            x = np.random.RandomState(1).randn(2, 4, 11, 13)
+            self._cmp(pm(paddle.to_tensor(x)), tm(torch.from_numpy(x)))
+
+    def test_conv2d_transpose_output_padding(self):
+        import torch
+
+        torch.manual_seed(0)
+        tm = torch.nn.ConvTranspose2d(3, 5, 3, stride=2, padding=1,
+                                      output_padding=1).double()
+        pm = paddle.nn.Conv2DTranspose(3, 5, 3, stride=2, padding=1,
+                                       output_padding=1)
+        pm = pm.astype("float64")
+        pm.set_state_dict({k: v.numpy() for k, v in tm.state_dict().items()})
+        x = np.random.RandomState(2).randn(2, 3, 7, 9)
+        self._cmp(pm(paddle.to_tensor(x)), tm(torch.from_numpy(x)))
+
+    def test_group_and_instance_norm(self):
+        import torch
+
+        torch.manual_seed(0)
+        r = np.random.RandomState(3)
+        x = r.randn(2, 6, 5, 7)
+        # NON-TRIVIAL affine params: torch inits weight=1/bias=0 identical
+        # to ours, so un-randomized weights would make the transfer (and any
+        # affine-application bug) invisible
+        w = r.randn(6)
+        b = r.randn(6)
+
+        tg = torch.nn.GroupNorm(3, 6).double()
+        with torch.no_grad():
+            tg.weight.copy_(torch.from_numpy(w))
+            tg.bias.copy_(torch.from_numpy(b))
+        pg = paddle.nn.GroupNorm(num_groups=3, num_channels=6).astype("float64")
+        missing, unexpected = pg.set_state_dict(
+            {k: v.numpy() for k, v in tg.state_dict().items()})
+        assert not unexpected and not missing, (missing, unexpected)
+        self._cmp(pg(paddle.to_tensor(x)),
+                  tg(torch.from_numpy(x)), tol=1e-8)
+
+        ti = torch.nn.InstanceNorm2d(6, affine=True).double()
+        with torch.no_grad():
+            ti.weight.copy_(torch.from_numpy(w))
+            ti.bias.copy_(torch.from_numpy(b))
+        pi = paddle.nn.InstanceNorm2D(6).astype("float64")
+        # this build names the gain 'scale' (the reference's naming)
+        missing, unexpected = pi.set_state_dict(
+            {("scale" if k == "weight" else k): v.numpy()
+             for k, v in ti.state_dict().items()})
+        assert not unexpected and not missing, (missing, unexpected)
+        self._cmp(pi(paddle.to_tensor(x)),
+                  ti(torch.from_numpy(x)), tol=1e-8)
+
+    def test_pooling_modes(self):
+        import torch
+        import torch.nn.functional as TF
+
+        import paddle_tpu.nn.functional as F
+
+        x = np.random.RandomState(4).randn(2, 3, 9, 11)
+        px = paddle.to_tensor(x)
+        tx = torch.from_numpy(x)
+        # max pool with padding; avg pool with/without count_include_pad
+        self._cmp(F.max_pool2d(px, 3, stride=2, padding=1),
+                  TF.max_pool2d(tx, 3, stride=2, padding=1))
+        self._cmp(F.avg_pool2d(px, 2, stride=2, exclusive=False),
+                  TF.avg_pool2d(tx, 2, stride=2, count_include_pad=True))
+        self._cmp(F.avg_pool2d(px, 3, stride=2, padding=1, exclusive=True),
+                  TF.avg_pool2d(tx, 3, stride=2, padding=1,
+                                count_include_pad=False))
+        self._cmp(F.adaptive_avg_pool2d(px, (4, 5)),
+                  TF.adaptive_avg_pool2d(tx, (4, 5)))
+
+    def test_interpolate_modes(self):
+        import torch
+        import torch.nn.functional as TF
+
+        import paddle_tpu.nn.functional as F
+
+        x = np.random.RandomState(5).randn(2, 3, 6, 8)
+        px = paddle.to_tensor(x)
+        tx = torch.from_numpy(x)
+        cases = [
+            dict(size=(12, 16), mode="nearest"),
+            dict(size=(9, 13), mode="bilinear", align_corners=False),
+            dict(size=(9, 13), mode="bilinear", align_corners=True),
+            dict(size=(13, 5), mode="bicubic", align_corners=True),
+            dict(size=(13, 5), mode="bicubic", align_corners=False),
+            dict(size=(4, 3), mode="bicubic", align_corners=False),
+        ]
+        for kw in cases:
+            got = F.interpolate(px, **kw)
+            want = TF.interpolate(tx, **kw)
+            np.testing.assert_allclose(
+                got.numpy(), want.numpy(), rtol=1e-6, atol=1e-7,
+                err_msg=str(kw))
+
+
+def test_bicubic_scale_factor_noninteger_matches_torch():
+    """scale_factor (not size) must feed the coordinate mapping directly:
+    torch maps src=(i+0.5)/scale-0.5, NOT via the floor(n*scale)/n ratio —
+    they differ for non-integer scales."""
+    import torch
+    import torch.nn.functional as TF
+
+    import paddle_tpu.nn.functional as F
+
+    x = np.random.RandomState(6).randn(1, 2, 5, 7)
+    got = F.interpolate(paddle.to_tensor(x), scale_factor=2.5,
+                        mode="bicubic", align_corners=False)
+    want = TF.interpolate(torch.from_numpy(x), scale_factor=2.5,
+                          mode="bicubic", align_corners=False)
+    np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=1e-6,
+                               atol=1e-7)
