@@ -195,7 +195,9 @@ class LlamaDecodeEngine:
         logits = jnp.einsum("bshd,bthd->bhst", q, ck) / np.sqrt(self.head_dim)
         logits = jnp.where(pos_mask[:, None, :, :], logits,
                            jnp.asarray(-1e30, logits.dtype))
-        probs = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(q.dtype)
+        # promote, don't demote: f64 parity runs must stay f64
+        ct = jnp.promote_types(q.dtype, jnp.float32)
+        probs = jax.nn.softmax(logits.astype(ct), -1).astype(q.dtype)
         return jnp.einsum("bhst,bthd->bshd", probs, cv)
 
     def _block(self, p, x, cache_kv, positions, pos_mask):
@@ -378,10 +380,13 @@ class LlamaDecodeEngine:
                     "engine-level state would cross-wire interleaved "
                     "sequences)")
             pager = cache.pager
-            # host-side block grant for position pos (writes land AT pos)
+            # host-side block grant for position pos (writes land AT pos),
+            # then copy-on-write for any SHARED tail block (beam forks;
+            # cheap no-op when nothing is shared)
             pager.ensure_capacity([int(pos) + 1] * pager.batch)
+            pools = pager.make_tail_exclusive(int(pos), cache.pools)
             logits, pools = self._step_paged_jit(
-                jnp.asarray(token, jnp.int32), cache.pools,
+                jnp.asarray(token, jnp.int32), pools,
                 pager.block_tables, jnp.asarray(pos, jnp.int32))
             return logits, _PagedCache(pager, pools)
         return self._step_jit(jnp.asarray(token, jnp.int32), cache,
@@ -477,11 +482,6 @@ class LlamaDecodeEngine:
         scores by len**alpha (0 = raw log-prob sum). EOS-finished beams are
         frozen (their score stops accumulating and the tail pads with EOS).
         """
-        if self.paged:
-            raise NotImplementedError(
-                "beam_search over the paged cache needs block-table beam "
-                "reordering (copy-on-write block sharing); use the dense "
-                "cache engine for beams")
         ids = jnp.asarray(getattr(input_ids, "value", input_ids), jnp.int32)
         B, S = ids.shape
         K, V = int(beam_size), self.head_w.shape[-1]
@@ -493,13 +493,35 @@ class LlamaDecodeEngine:
             return (jnp.zeros((B, K, 0), jnp.int32),
                     jnp.zeros((B, K), jnp.float32))
 
-        logits, cache, pos = self.prefill(ids)
+        if self.paged:
+            # prefill the B prompts into rows b*K of a B*K-row pager; beams
+            # then FORK the prompt blocks (refcounted sharing, CoW on
+            # write) instead of copying the prompt KV K times
+            pager, pools = self._init_paged(B * K)
+            self._pager = pager
+            need = np.zeros(B * K, np.int64)
+            need[::K] = S
+            pager.ensure_capacity(need)
+            logits, pools = self._prefill_paged_jit(
+                ids, pools, pager.block_tables[::K],
+                jnp.full((B,), S, jnp.int32))
+            logits = logits[:, -1]
+            cache = _PagedCache(pager, pools)
+            pos = S
+        else:
+            logits, cache, pos = self.prefill(ids)
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)  # (B, V)
         scores, first = jax.lax.top_k(logp, K)                     # (B, K)
         # expand the cache to B*K rows: beam k of row b lives at b*K + k
-        base = (jnp.arange(B)[:, None] * jnp.ones((1, K), jnp.int32)
-                ).reshape(-1).astype(jnp.int32)
-        cache = self._reorder_jit(cache, base)
+        if self.paged:
+            # paged prompts were prefilled into rows b*K of the B*K-row
+            # pager — fork from THOSE rows (the dense base indexes the
+            # B-row cache instead)
+            cache.pager.fork_rows(np.repeat(np.arange(B) * K, K))
+        else:
+            base = (jnp.arange(B)[:, None] * jnp.ones((1, K), jnp.int32)
+                    ).reshape(-1).astype(jnp.int32)
+            cache = self._reorder_jit(cache, base)
         tokens = first.reshape(B, K, 1).astype(jnp.int32)
         finished = (jnp.zeros((B, K), bool) if eos_token_id is None
                     else first == eos_token_id)
@@ -523,7 +545,12 @@ class LlamaDecodeEngine:
             tokens = jnp.take_along_axis(tokens, parent[:, :, None], axis=1)
             tokens = jnp.concatenate([tokens, tok[:, :, None]], axis=-1)
             flat_parent = (jnp.arange(B)[:, None] * K + parent).reshape(-1)
-            cache = self._reorder_jit(cache, flat_parent.astype(jnp.int32))
+            if self.paged:
+                # adopt the surviving parents' block tables (shared blocks,
+                # CoW at the next write in decode_step)
+                cache.pager.fork_rows(np.asarray(flat_parent))
+            else:
+                cache = self._reorder_jit(cache, flat_parent.astype(jnp.int32))
             if eos_token_id is not None:
                 finished = jnp.take_along_axis(finished, parent, axis=1)
                 finished = finished | (tok == eos_token_id)

@@ -22,6 +22,8 @@ out (donate-friendly), shapes static, per-sequence lengths as data.
 """
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 import jax
@@ -53,6 +55,9 @@ class PagedKVCache:
         self.batch = int(batch)
         self._tables_np = np.zeros((batch, max_blocks_per_seq), np.int32)
         self.block_tables = jnp.asarray(self._tables_np)
+        # per-block reference counts: >1 after fork_rows (beam search shares
+        # prompt blocks); writes go copy-on-write via make_tail_exclusive
+        self._refs = np.zeros(num_blocks, np.int32)
 
     # -- host-side allocator -------------------------------------------------
     def ensure_capacity(self, seq_lens_next):
@@ -72,7 +77,9 @@ class PagedKVCache:
                     raise RuntimeError(
                         "paged KV pool exhausted: no free blocks "
                         f"(pool={self.num_blocks}, block={self.block_size})")
-                tables[b, owned[b]] = self._free.pop()
+                blk = self._free.pop()
+                tables[b, owned[b]] = blk
+                self._refs[blk] = 1
                 owned[b] += 1
                 changed = True
         if changed:
@@ -82,13 +89,81 @@ class PagedKVCache:
             self.block_tables = jnp.asarray(tables.copy())
 
     def free_sequence(self, b):
-        """Return sequence b's blocks to the pool."""
+        """Drop sequence b's block references; blocks return to the pool
+        when their last referencing row lets go."""
         tables = self._tables_np
         for blk in tables[b]:
             if blk > 0:
-                self._free.append(int(blk))
+                self._refs[blk] -= 1
+                if self._refs[blk] == 0:
+                    self._free.append(int(blk))
         tables[b] = 0
         self.block_tables = jnp.asarray(tables.copy())
+
+    # -- copy-on-write sharing (beam search) ---------------------------------
+    def fork_rows(self, parent_rows):
+        """Every row adopts parent_rows[b]'s block table (shared blocks,
+        refcounted) — the paged form of the dense cache's batch-axis beam
+        reorder. Writes afterwards must go through make_tail_exclusive."""
+        parent_rows = np.asarray(parent_rows, np.int64)
+        t = self._tables_np
+        new = t[parent_rows].copy()
+        if np.array_equal(new, t):
+            return   # identity fork (EOS-frozen beams): nothing changes
+        # vectorized refcount delta (this runs once per decoded token)
+        self._refs -= np.bincount(t[t > 0].ravel(),
+                                  minlength=self.num_blocks).astype(np.int32)
+        self._refs += np.bincount(new[new > 0].ravel(),
+                                  minlength=self.num_blocks).astype(np.int32)
+        # blocks nobody references anymore go back to the pool
+        for blk in np.unique(t[t > 0]):
+            if self._refs[blk] == 0:
+                self._free.append(int(blk))
+        self._tables_np = new
+        self.block_tables = jnp.asarray(new.copy())
+
+    def _cow_copy_fn(self):
+        fn = getattr(self, "_cow_jit", None)
+        if fn is None:
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def fn(pools, olds, news):
+                # donated: XLA scatters the copied blocks in place instead
+                # of duplicating every layer's whole pool per CoW event
+                return [(kp.at[news].set(kp[olds]),
+                         vp.at[news].set(vp[olds])) for kp, vp in pools]
+
+            self._cow_jit = fn
+        return fn
+
+    def make_tail_exclusive(self, pos, pools):
+        """Copy-on-write: before writing at position `pos`, any row whose
+        tail block (pos // block_size) is SHARED gets its own copy of it
+        (one donated gather/scatter over the pools). No-op (and cheap)
+        when nothing is shared — plain decoding always takes that path."""
+        if (self._refs <= 1).all():
+            return pools
+        bidx = int(pos) // self.block_size
+        t = self._tables_np
+        pairs = []
+        for b in range(len(t)):
+            phys = int(t[b, bidx])
+            if phys > 0 and self._refs[phys] > 1:
+                if not self._free:
+                    raise RuntimeError(
+                        "paged KV pool exhausted during copy-on-write "
+                        f"(pool={self.num_blocks})")
+                new = self._free.pop()
+                self._refs[new] = 1
+                self._refs[phys] -= 1
+                t[b, bidx] = new
+                pairs.append((phys, new))
+        if not pairs:
+            return pools
+        olds = jnp.asarray([o for o, _ in pairs], jnp.int32)
+        news = jnp.asarray([n for _, n in pairs], jnp.int32)
+        pools = self._cow_copy_fn()(pools, olds, news)
+        self.block_tables = jnp.asarray(t.copy())
+        return pools
 
 
 def alloc_blocks(batch, max_len, block_size):
@@ -160,12 +235,14 @@ def paged_attention_decode(q, cache_k, cache_v, block_tables, seq_lens,
 
     if scale is None:
         scale = 1.0 / np.sqrt(D)
+    # promote, don't demote: bf16 -> f32 for a stable softmax, f64 stays f64
+    ct = jnp.promote_types(q.dtype, jnp.float32)
     qg = q.reshape(B, n_kv, groups, D)
-    logits = jnp.einsum("bhgd,bthd->bhgt", qg.astype(jnp.float32),
-                        k.astype(jnp.float32)) * scale
+    logits = jnp.einsum("bhgd,bthd->bhgt", qg.astype(ct),
+                        k.astype(ct)) * scale
     t = jnp.arange(T)[None, None, None, :]
     mask = t <= seq_lens[:, None, None, None]
     logits = jnp.where(mask, logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bhgt,bthd->bhgd", probs, v.astype(jnp.float32))
+    out = jnp.einsum("bhgt,bthd->bhgd", probs, v.astype(ct))
     return out.reshape(B, n_q, D).astype(q.dtype)

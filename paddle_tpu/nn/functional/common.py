@@ -197,6 +197,25 @@ def _interp(v, size=None, method="nearest", align_corners=False, scales=None):
                 acc = acc + jnp.take(out, idx[:, k], axis=axis).astype(ct) * wk
             out = acc  # stay in the compute dtype across dims (one rounding)
         return out.astype(v.dtype)
+    if method == "linear" and scales and not align_corners:
+        # explicit scale_factor: the given scale feeds the coordinate
+        # mapping (torch/reference), which jax.image.resize's size-quotient
+        # cannot represent for non-integer scales — 2-tap lerp per dim
+        out = v
+        ct = jnp.promote_types(v.dtype, jnp.float32)
+        for d, (n_in, n_out) in enumerate(zip(v.shape[1:-1], size)):
+            axis = 1 + d
+            src = jnp.clip((jnp.arange(n_out) + 0.5) / scales[d] - 0.5,
+                           0.0, n_in - 1.0)
+            lo = jnp.floor(src).astype(jnp.int32)
+            hi = jnp.minimum(lo + 1, n_in - 1)
+            frac = (src - lo).astype(ct)
+            shape = [1] * out.ndim
+            shape[axis] = n_out
+            frac = frac.reshape(shape)
+            out = (jnp.take(out, lo, axis=axis).astype(ct) * (1 - frac)
+                   + jnp.take(out, hi, axis=axis).astype(ct) * frac)
+        return out.astype(v.dtype)
     if not align_corners or method == "nearest":
         return jax.image.resize(v, out_shape, method=method)
     # align_corners=True: corner pixels map exactly — gather with explicit coordinates

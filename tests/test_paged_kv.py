@@ -255,16 +255,41 @@ class TestPagedDecodeEngine:
         owned = (np.asarray(paged._pager.block_tables) > 0).sum(axis=1)
         assert (owned == 4).all(), owned
 
-    def test_paged_rejects_int8_combo_and_beams(self):
+    def test_paged_rejects_int8_combo(self):
         from paddle_tpu.models.llama_decode import LlamaDecodeEngine
 
         model = self._model()
         with pytest.raises(NotImplementedError, match="paged"):
             LlamaDecodeEngine(model, kv_cache_layout="paged",
                               kv_cache_dtype="int8")
-        eng = LlamaDecodeEngine(model, max_len=32, kv_cache_layout="paged")
-        with pytest.raises(NotImplementedError, match="beam"):
-            eng.beam_search(np.zeros((1, 4), "int32"))
+
+    def test_paged_beam_search_matches_dense_with_block_sharing(self):
+        """Beam search over paged blocks: prompt blocks are SHARED across
+        beams (refcounted fork) with copy-on-write at divergence — tokens
+        and scores must match the dense-cache beam search exactly."""
+        from paddle_tpu.models.llama_decode import LlamaDecodeEngine
+
+        # f64: the dense and paged attention paths are bitwise-identical
+        # there, so near-tie top-k flips (f32 gather-order noise on a
+        # random-weight model) cannot masquerade as failures
+        model = self._model().astype("float64")
+        rng = np.random.RandomState(4)
+        ids = rng.randint(0, 128, (2, 9)).astype("int32")
+        dense = LlamaDecodeEngine(model, max_len=64)
+        paged = LlamaDecodeEngine(model, max_len=64,
+                                  kv_cache_layout="paged", block_size=8)
+        td, sd = dense.beam_search(ids, beam_size=3, max_new_tokens=12,
+                                   eos_token_id=5, length_penalty=0.5)
+        tp, sp = paged.beam_search(ids, beam_size=3, max_new_tokens=12,
+                                   eos_token_id=5, length_penalty=0.5)
+        np.testing.assert_array_equal(np.asarray(tp), np.asarray(td))
+        np.testing.assert_allclose(np.asarray(sp), np.asarray(sd),
+                                   rtol=1e-5, atol=1e-6)
+        # sharing accounting: every live block is referenced >= once, and
+        # the pool books balance (free + referenced == pool size - null)
+        refs = paged._pager._refs
+        live = int((refs > 0).sum())
+        assert live + len(paged._pager._free) == paged._pager.num_blocks - 1
 
     def test_interleaved_prefills_do_not_cross_wire(self):
         """Each prefill's cache owns its own pager/tables: decoding cache A

@@ -280,6 +280,7 @@ class TestConvNormPoolParity:
                 err_msg=str(kw))
 
 
+@pytest.mark.slow
 def test_bicubic_scale_factor_noninteger_matches_torch():
     """scale_factor (not size) must feed the coordinate mapping directly:
     torch maps src=(i+0.5)/scale-0.5, NOT via the floor(n*scale)/n ratio —
@@ -290,9 +291,10 @@ def test_bicubic_scale_factor_noninteger_matches_torch():
     import paddle_tpu.nn.functional as F
 
     x = np.random.RandomState(6).randn(1, 2, 5, 7)
-    got = F.interpolate(paddle.to_tensor(x), scale_factor=2.5,
-                        mode="bicubic", align_corners=False)
-    want = TF.interpolate(torch.from_numpy(x), scale_factor=2.5,
-                          mode="bicubic", align_corners=False)
-    np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=1e-6,
-                               atol=1e-7)
+    for mode in ("bicubic", "bilinear"):
+        got = F.interpolate(paddle.to_tensor(x), scale_factor=2.5,
+                            mode=mode, align_corners=False)
+        want = TF.interpolate(torch.from_numpy(x), scale_factor=2.5,
+                              mode=mode, align_corners=False)
+        np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=1e-6,
+                                   atol=1e-7, err_msg=mode)
