@@ -98,10 +98,6 @@ class LlamaDecodeEngine:
             raise ValueError(
                 f"unsupported kv_cache_layout {kv_cache_layout!r}")
         self.paged = kv_cache_layout == "paged"
-        if self.paged and self.kv_int8:
-            raise NotImplementedError(
-                "paged + int8 KV cache are separate levers in this build; "
-                "pick one (quantized paged blocks are a follow-up)")
         self.block_size = int(block_size)
         self._pager = None   # built at prefill (batch known then)
         self.max_len = int(max_len or cfg.max_position_embeddings)
@@ -256,22 +252,32 @@ class LlamaDecodeEngine:
         mlp = (jax.nn.silu(h2 @ p["gate"]) * (h2 @ p["up"])) @ p["down"]
         return x + mlp
 
-    def _block_paged_prefill(self, p, x, kpool, vpool, tables, lens):
+    def _block_paged_prefill(self, p, x, pool, tables, lens):
         """Prompt pass: causal self-attention within the prompt (the history
         IS the prompt), k/v written into the sequence's blocks."""
         from . import paged_kv as _pk
 
         B, S, _ = x.shape
         q, k, v = self._qkv_rope(p, x, jnp.arange(S))
-        kpool, vpool = _pk.paged_write_prefill(kpool, vpool, tables, lens,
-                                               k, v)
         t_idx = jnp.arange(S)
         pos_mask = jnp.broadcast_to(
             t_idx[None, None, :] <= t_idx[None, :, None], (B, S, S))
-        attn = self._attend(q, k, v, pos_mask)
-        return self._post_attn(p, x, attn), kpool, vpool
+        if self.kv_int8:
+            kq, kscale = self._quantize_kv(k)
+            vq, vscale = self._quantize_kv(v)
+            pool = _pk.paged_write_prefill_int8(*pool, tables, lens,
+                                                kq, kscale, vq, vscale)
+            # attend the QUANTIZED prompt, exactly like the dense int8
+            # engine's prefill (_block -> _attend_int8 over the written
+            # cache) — full-precision prompt attention here would give the
+            # paged engine different logits than dense int8
+            attn = self._attend_int8(q, kq, kscale, vq, vscale, pos_mask)
+        else:
+            pool = _pk.paged_write_prefill(*pool, tables, lens, k, v)
+            attn = self._attend(q, k, v, pos_mask)
+        return self._post_attn(p, x, attn), pool
 
-    def _block_paged_decode(self, p, x, kpool, vpool, tables, lens):
+    def _block_paged_decode(self, p, x, pool, tables, lens):
         """One decode token per row at PER-ROW position lens[b] (write and
         RoPE both happen at that position) — the same block serves lockstep
         decoding (lens = broadcast pos) and continuous batching (ragged)."""
@@ -284,21 +290,29 @@ class LlamaDecodeEngine:
         v = (h @ p["wv"]).reshape(B, 1, self.num_kv, self.head_dim)
         q = _rope_at_rows(q, lens, self.theta)
         k = _rope_at_rows(k, lens, self.theta)
-        kpool, vpool = _pk.paged_write_decode(kpool, vpool, tables, lens,
-                                              k[:, 0], v[:, 0])
-        attn = _pk.paged_attention_decode(q[:, 0], kpool, vpool, tables,
-                                          lens)[:, None]
-        return self._post_attn(p, x, attn), kpool, vpool
+        if self.kv_int8:
+            kq, kscale = self._quantize_kv(k)      # (B, 1, kv, D) already
+            vq, vscale = self._quantize_kv(v)
+            pool = _pk.paged_write_decode_int8(
+                *pool, tables, lens, kq[:, 0], kscale[:, 0], vq[:, 0],
+                vscale[:, 0])
+            attn = _pk.paged_attention_decode_int8(
+                q[:, 0], *pool, tables, lens)[:, None]
+        else:
+            pool = _pk.paged_write_decode(*pool, tables, lens,
+                                          k[:, 0], v[:, 0])
+            attn = _pk.paged_attention_decode(q[:, 0], *pool, tables,
+                                              lens)[:, None]
+        return self._post_attn(p, x, attn), pool
 
     @functools.cached_property
     def _prefill_paged_jit(self):
         def run(ids, pools, tables, lens):
             x = self.emb[ids]
             new_pools = []
-            for p, (kp, vp) in zip(self.layers, pools):
-                x, kp, vp = self._block_paged_prefill(p, x, kp, vp, tables,
-                                                      lens)
-                new_pools.append((kp, vp))
+            for p, pool in zip(self.layers, pools):
+                x, pool = self._block_paged_prefill(p, x, pool, tables, lens)
+                new_pools.append(pool)
             x = _rms(x, self.norm_w, self.eps)
             return x @ self.head_w, new_pools
 
@@ -312,10 +326,9 @@ class LlamaDecodeEngine:
             lens = jnp.full((token.shape[0],), pos, jnp.int32)
             x = self.emb[token]
             new_pools = []
-            for p, (kp, vp) in zip(self.layers, pools):
-                x, kp, vp = self._block_paged_decode(p, x, kp, vp, tables,
-                                                     lens)
-                new_pools.append((kp, vp))
+            for p, pool in zip(self.layers, pools):
+                x, pool = self._block_paged_decode(p, x, pool, tables, lens)
+                new_pools.append(pool)
             x = _rms(x, self.norm_w, self.eps)
             return (x @ self.head_w)[:, -1], new_pools
 
@@ -331,7 +344,11 @@ class LlamaDecodeEngine:
             num_layers=len(self.layers), num_blocks=batch * max_blocks + 1,
             block_size=self.block_size, kv_heads=self.num_kv,
             head_dim=self.head_dim, batch=batch,
-            max_blocks_per_seq=max_blocks, dtype=self.emb.dtype)
+            max_blocks_per_seq=max_blocks, dtype=self.emb.dtype,
+            quantized=self.kv_int8)
+        if self.kv_int8:
+            return pager, list(zip(pager.k, pager.k_scale,
+                                   pager.v, pager.v_scale))
         return pager, list(zip(pager.k, pager.v))
 
     # -- public API ----------------------------------------------------------

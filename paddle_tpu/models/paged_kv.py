@@ -30,7 +30,9 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["PagedKVCache", "alloc_blocks", "paged_write_decode",
-           "paged_write_prefill", "paged_attention_decode"]
+           "paged_write_prefill", "paged_attention_decode",
+           "paged_write_decode_int8", "paged_write_prefill_int8",
+           "paged_attention_decode_int8"]
 
 
 class PagedKVCache:
@@ -42,13 +44,26 @@ class PagedKVCache:
     """
 
     def __init__(self, num_layers, num_blocks, block_size, kv_heads, head_dim,
-                 batch, max_blocks_per_seq, dtype=jnp.bfloat16):
+                 batch, max_blocks_per_seq, dtype=jnp.bfloat16,
+                 quantized=False):
         self.block_size = int(block_size)
         self.num_blocks = int(num_blocks)
         self.max_blocks_per_seq = int(max_blocks_per_seq)
+        self.quantized = bool(quantized)
         shape = (num_blocks, block_size, kv_heads, head_dim)
-        self.k = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
-        self.v = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
+        if quantized:
+            # int8 blocks + per-(token, head) fp32 absmax scales: the same
+            # halved-KV-bandwidth lever as the dense int8 cache, paged
+            sshape = shape[:-1]
+            self.k = [jnp.zeros(shape, jnp.int8) for _ in range(num_layers)]
+            self.v = [jnp.zeros(shape, jnp.int8) for _ in range(num_layers)]
+            self.k_scale = [jnp.zeros(sshape, jnp.float32)
+                            for _ in range(num_layers)]
+            self.v_scale = [jnp.zeros(sshape, jnp.float32)
+                            for _ in range(num_layers)]
+        else:
+            self.k = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
+            self.v = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
         # block 0 is the permanently-reserved NULL block: unassigned table
         # slots point at it, so gathers stay in-bounds without masking reads
         self._free = list(range(num_blocks - 1, 0, -1))
@@ -128,9 +143,11 @@ class PagedKVCache:
             @functools.partial(jax.jit, donate_argnums=(0,))
             def fn(pools, olds, news):
                 # donated: XLA scatters the copied blocks in place instead
-                # of duplicating every layer's whole pool per CoW event
-                return [(kp.at[news].set(kp[olds]),
-                         vp.at[news].set(vp[olds])) for kp, vp in pools]
+                # of duplicating every layer's whole pool per CoW event.
+                # tree_map covers both pool layouts ((k, v) and the int8
+                # (kq, ks, vq, vs)) — every leaf is block-major on axis 0
+                return jax.tree_util.tree_map(
+                    lambda a: a.at[news].set(a[olds]), pools)
 
             self._cow_jit = fn
         return fn
@@ -213,6 +230,75 @@ def paged_write_prefill(cache_k, cache_v, block_tables, seq_lens,
         v_new.reshape(B * S, *v_new.shape[2:]).astype(cache_v.dtype),
         mode="drop")
     return cache_k, cache_v
+
+
+def paged_write_decode_int8(kq, ks, vq, vs, block_tables, seq_lens,
+                            k_new_q, k_new_s, v_new_q, v_new_s):
+    """int8 form of paged_write_decode: values [B, kv, D] int8 plus their
+    per-(token, head) scales [B, kv]."""
+    bs = kq.shape[1]
+    pos = seq_lens.astype(jnp.int32)
+    blk_idx = pos // bs
+    off = pos % bs
+    rows = jnp.arange(block_tables.shape[0])
+    phys = block_tables[rows, blk_idx]
+    kq = kq.at[phys, off].set(k_new_q)
+    ks = ks.at[phys, off].set(k_new_s)
+    vq = vq.at[phys, off].set(v_new_q)
+    vs = vs.at[phys, off].set(v_new_s)
+    return kq, ks, vq, vs
+
+
+def paged_write_prefill_int8(kq, ks, vq, vs, block_tables, seq_lens,
+                             k_new_q, k_new_s, v_new_q, v_new_s):
+    """int8 form of paged_write_prefill (values [B, S, kv, D] int8 + scales
+    [B, S, kv]); padding rows drop via out-of-bounds scatter."""
+    B, S = k_new_q.shape[0], k_new_q.shape[1]
+    nb, bs = kq.shape[0], kq.shape[1]
+    t = jnp.arange(S)
+    blk_idx = t // bs
+    off = t % bs
+    phys = block_tables[:, blk_idx]
+    valid = t[None, :] < seq_lens[:, None]
+    phys = jnp.where(valid, phys, nb)
+    flat_phys = phys.reshape(-1)
+    flat_off = jnp.tile(off, B)
+
+    def w(pool, new):
+        return pool.at[flat_phys, flat_off].set(
+            new.reshape((B * S,) + new.shape[2:]), mode="drop")
+
+    return w(kq, k_new_q), w(ks, k_new_s), w(vq, v_new_q), w(vs, v_new_s)
+
+
+def paged_attention_decode_int8(q, kq, ks, vq, vs, block_tables, seq_lens,
+                                scale=None):
+    """One decode step against the int8 paged cache WITHOUT materializing a
+    dequantized copy: the per-(token, head) scales fold into the score and
+    value einsums (the paged form of the dense engine's _attend_int8)."""
+    B, n_q, D = q.shape
+    nb, bs, n_kv, _ = kq.shape
+    groups = n_q // n_kv
+    T = block_tables.shape[1] * bs
+
+    k = kq[block_tables].reshape(B, T, n_kv, D)
+    k_s = ks[block_tables].reshape(B, T, n_kv)
+    v = vq[block_tables].reshape(B, T, n_kv, D)
+    v_s = vs[block_tables].reshape(B, T, n_kv)
+
+    if scale is None:
+        scale = 1.0 / np.sqrt(D)
+    ct = jnp.promote_types(q.dtype, jnp.float32)
+    qg = q.reshape(B, n_kv, groups, D)
+    logits = jnp.einsum("bhgd,bthd->bhgt", qg.astype(ct), k.astype(ct))
+    logits = logits * jnp.transpose(k_s, (0, 2, 1))[:, :, None, :] * scale
+    t = jnp.arange(T)[None, None, None, :]
+    mask = t <= seq_lens[:, None, None, None]
+    logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    pv = probs * jnp.transpose(v_s, (0, 2, 1))[:, :, None, :]
+    out = jnp.einsum("bhgt,bthd->bhgd", pv, v.astype(ct))
+    return out.reshape(B, n_q, D).astype(q.dtype)
 
 
 def paged_attention_decode(q, cache_k, cache_v, block_tables, seq_lens,

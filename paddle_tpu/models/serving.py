@@ -52,7 +52,7 @@ class ContinuousBatchingEngine:
             block_size=self.block_size, kv_heads=e.num_kv,
             head_dim=e.head_dim, batch=self.max_batch,
             max_blocks_per_seq=max_blocks, dtype=e.emb.dtype)
-        self._pools = list(zip(self._pager.k, self._pager.v))
+        self._pools = list(zip(self._pager.k, self._pager.v))  # bf16 layout
         # host-side slot state
         self.lens = np.zeros(self.max_batch, np.int64)     # tokens in cache
         self.active = np.zeros(self.max_batch, bool)
@@ -75,10 +75,10 @@ class ContinuousBatchingEngine:
                 x = e.emb[ids]
                 lens1 = jnp.asarray([length], jnp.int32)
                 new_pools = []
-                for p, (kp, vp) in zip(e.layers, pools):
-                    x, kp, vp = e._block_paged_prefill(p, x, kp, vp,
-                                                       row_tables, lens1)
-                    new_pools.append((kp, vp))
+                for p, pool in zip(e.layers, pools):
+                    x, pool = e._block_paged_prefill(p, x, pool, row_tables,
+                                                     lens1)
+                    new_pools.append(pool)
                 x = _rms(x, e.norm_w, e.eps)
                 logits = x @ e.head_w
                 return logits[0, length - 1], new_pools
@@ -95,10 +95,9 @@ class ContinuousBatchingEngine:
                 # _block_paged_decode ropes/writes/attends at lens[b]
                 x = e.emb[tokens]
                 new_pools = []
-                for p, (kp, vp) in zip(e.layers, pools):
-                    x, kp, vp = e._block_paged_decode(p, x, kp, vp, tables,
-                                                      lens)
-                    new_pools.append((kp, vp))
+                for p, pool in zip(e.layers, pools):
+                    x, pool = e._block_paged_decode(p, x, pool, tables, lens)
+                    new_pools.append(pool)
                 x = _rms(x, e.norm_w, e.eps)
                 logits = (x @ e.head_w)[:, -1]
                 return jnp.argmax(logits, -1).astype(jnp.int32), new_pools

@@ -168,6 +168,20 @@ def _cubic_taps(src, n_in, a=-0.75):
     return jnp.clip(idx, 0, n_in - 1), ws
 
 
+def _lerp_axis(out, src, n_in, axis, n_out):
+    """2-tap linear resample of one axis at fractional coords `src` (shared
+    by the align-corners and explicit-scale branches of _interp)."""
+    ct = jnp.promote_types(out.dtype, jnp.float32)
+    lo = jnp.floor(src).astype(jnp.int32)
+    hi = jnp.minimum(lo + 1, n_in - 1)
+    frac = (src - lo).astype(ct)
+    shape = [1] * out.ndim
+    shape[axis] = n_out
+    frac = frac.reshape(shape)
+    return (jnp.take(out, lo, axis=axis).astype(ct) * (1 - frac)
+            + jnp.take(out, hi, axis=axis).astype(ct) * frac)
+
+
 @defop("interpolate_op")
 def _interp(v, size=None, method="nearest", align_corners=False, scales=None):
     out_shape = (v.shape[0],) + tuple(size) + (v.shape[-1],)
@@ -197,45 +211,30 @@ def _interp(v, size=None, method="nearest", align_corners=False, scales=None):
                 acc = acc + jnp.take(out, idx[:, k], axis=axis).astype(ct) * wk
             out = acc  # stay in the compute dtype across dims (one rounding)
         return out.astype(v.dtype)
-    if method == "linear" and scales and not align_corners:
-        # explicit scale_factor: the given scale feeds the coordinate
-        # mapping (torch/reference), which jax.image.resize's size-quotient
-        # cannot represent for non-integer scales — 2-tap lerp per dim
+    # non-integer explicit scale: the given scale feeds the coordinate
+    # mapping (torch/reference), which jax.image.resize's size-quotient
+    # cannot represent; integer scales produce identical grids, so they
+    # stay on the fused resize path
+    frac_scales = (scales and not align_corners and method == "linear"
+                   and any(float(f) != int(f) for f in scales))
+    if frac_scales:
         out = v
-        ct = jnp.promote_types(v.dtype, jnp.float32)
         for d, (n_in, n_out) in enumerate(zip(v.shape[1:-1], size)):
-            axis = 1 + d
             src = jnp.clip((jnp.arange(n_out) + 0.5) / scales[d] - 0.5,
                            0.0, n_in - 1.0)
-            lo = jnp.floor(src).astype(jnp.int32)
-            hi = jnp.minimum(lo + 1, n_in - 1)
-            frac = (src - lo).astype(ct)
-            shape = [1] * out.ndim
-            shape[axis] = n_out
-            frac = frac.reshape(shape)
-            out = (jnp.take(out, lo, axis=axis).astype(ct) * (1 - frac)
-                   + jnp.take(out, hi, axis=axis).astype(ct) * frac)
+            out = _lerp_axis(out, src, n_in, 1 + d, n_out)
         return out.astype(v.dtype)
     if not align_corners or method == "nearest":
         return jax.image.resize(v, out_shape, method=method)
     # align_corners=True: corner pixels map exactly — gather with explicit coordinates
-    in_spatial = v.shape[1:-1]
     out = v
-    for d, (n_in, n_out) in enumerate(zip(in_spatial, size)):
-        axis = 1 + d
+    for d, (n_in, n_out) in enumerate(zip(v.shape[1:-1], size)):
         if n_out == 1 or n_in == 1:
             coords = jnp.zeros(n_out)
         else:
             coords = jnp.linspace(0.0, n_in - 1.0, n_out)
-        lo = jnp.floor(coords).astype(jnp.int32)
-        hi = jnp.minimum(lo + 1, n_in - 1)
-        frac = (coords - lo).astype(v.dtype)
-        shape = [1] * out.ndim
-        shape[axis] = n_out
-        frac = frac.reshape(shape)
-        out = (jnp.take(out, lo, axis=axis) * (1 - frac)
-               + jnp.take(out, hi, axis=axis) * frac)
-    return out
+        out = _lerp_axis(out, coords, n_in, 1 + d, n_out)
+    return out.astype(v.dtype)
 
 
 @defop("interp_area")

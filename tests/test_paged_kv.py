@@ -255,14 +255,6 @@ class TestPagedDecodeEngine:
         owned = (np.asarray(paged._pager.block_tables) > 0).sum(axis=1)
         assert (owned == 4).all(), owned
 
-    def test_paged_rejects_int8_combo(self):
-        from paddle_tpu.models.llama_decode import LlamaDecodeEngine
-
-        model = self._model()
-        with pytest.raises(NotImplementedError, match="paged"):
-            LlamaDecodeEngine(model, kv_cache_layout="paged",
-                              kv_cache_dtype="int8")
-
     def test_paged_beam_search_matches_dense_with_block_sharing(self):
         """Beam search over paged blocks: prompt blocks are SHARED across
         beams (refcounted fork) with copy-on-write at divergence — tokens
@@ -317,3 +309,19 @@ class TestPagedDecodeEngine:
                         .astype("int32"))
         got = np.concatenate(toks, axis=1)
         np.testing.assert_array_equal(got, want)
+
+    def test_paged_int8_generate_matches_dense_int8(self):
+        """Quantized paged blocks: the int8 paged cache must reproduce the
+        dense int8 engine's greedy generation (same per-(token, head)
+        absmax quantization, paged storage)."""
+        from paddle_tpu.models.llama_decode import LlamaDecodeEngine
+
+        model = self._model()
+        rng = np.random.RandomState(5)
+        ids = rng.randint(0, 128, (2, 9)).astype("int32")
+        dense = LlamaDecodeEngine(model, max_len=64, kv_cache_dtype="int8")
+        paged = LlamaDecodeEngine(model, max_len=64, kv_cache_dtype="int8",
+                                  kv_cache_layout="paged", block_size=8)
+        out_d = np.asarray(dense.generate(ids, max_new_tokens=16))
+        out_p = np.asarray(paged.generate(ids, max_new_tokens=16))
+        np.testing.assert_array_equal(out_p, out_d)
