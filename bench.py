@@ -375,12 +375,18 @@ def _decode_bench(model, cfg, on_tpu):
     prefill, steps = (128, 32) if on_tpu else (16, 8)
     # BENCH_DECODE_KV=int8 measures the quantized KV cache (half the KV
     # read bandwidth — the decode bottleneck); any other value (bf16/fp16/
-    # unset) runs the full-precision default
+    # unset) runs the full-precision default. BENCH_DECODE_LAYOUT=paged
+    # runs the block-table cache (models/paged_kv.py) — ms/token should
+    # match dense (same gather bandwidth) while cache memory drops to
+    # blocks-actually-used.
     kv_env = (os.environ.get("BENCH_DECODE_KV") or "").strip().lower()
     kv_dtype = "int8" if kv_env == "int8" else None
+    layout_env = (os.environ.get("BENCH_DECODE_LAYOUT") or "").strip().lower()
+    layout = "paged" if layout_env == "paged" else None
     eng = LlamaDecodeEngine(model, max_len=prefill + steps + 1,
-                            kv_cache_dtype=kv_dtype)
-    kv_label = "int8" if kv_dtype else str(eng.emb.dtype)
+                            kv_cache_dtype=kv_dtype, kv_cache_layout=layout)
+    kv_label = ("int8" if kv_dtype else str(eng.emb.dtype)) \
+        + ("/paged" if layout else "")
     r = np.random.RandomState(0)
     ids = r.randint(0, cfg.vocab_size, (batch, prefill)).astype("int32")
 
@@ -423,7 +429,8 @@ _FLAGSHIP_ENV_DEFAULTS = {
     "BENCH_FUSED_CE": "0",
     # measurement-scope knobs: a run that skips sections or measures the
     # int8-KV decode variant is not the flagship artifact either
-    "BENCH_DECODE_KV": "", "BENCH_SKIP_DECODE": "", "BENCH_SKIP_DISPATCH": "",
+    "BENCH_DECODE_KV": "", "BENCH_DECODE_LAYOUT": "",
+    "BENCH_SKIP_DECODE": "", "BENCH_SKIP_DISPATCH": "",
     "BENCH_SKIP_FLASHCHECK": "",
 }
 

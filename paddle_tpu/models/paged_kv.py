@@ -188,17 +188,21 @@ def alloc_blocks(batch, max_len, block_size):
     return -(-max_len // block_size)
 
 
+def _decode_scatter_idx(block_tables, seq_lens, bs):
+    """(phys block, in-block offset) for writing one token at seq_lens[b]."""
+    pos = seq_lens.astype(jnp.int32)
+    blk_idx = pos // bs
+    off = pos % bs
+    rows = jnp.arange(block_tables.shape[0])
+    return block_tables[rows, blk_idx], off
+
+
 def paged_write_decode(cache_k, cache_v, block_tables, seq_lens, k_new, v_new):
     """Write ONE new token per sequence into its current tail block.
 
     k_new/v_new: [B, kv_heads, head_dim]; position = seq_lens[b].
     Returns (cache_k, cache_v) with the writes applied (functional)."""
-    bs = cache_k.shape[1]
-    pos = seq_lens.astype(jnp.int32)
-    blk_idx = pos // bs
-    off = pos % bs
-    rows = jnp.arange(block_tables.shape[0])
-    phys = block_tables[rows, blk_idx]                  # [B]
+    phys, off = _decode_scatter_idx(block_tables, seq_lens, cache_k.shape[1])
     cache_k = cache_k.at[phys, off].set(k_new.astype(cache_k.dtype))
     cache_v = cache_v.at[phys, off].set(v_new.astype(cache_v.dtype))
     return cache_k, cache_v
@@ -210,63 +214,53 @@ def paged_write_prefill(cache_k, cache_v, block_tables, seq_lens,
     token t of sequence b lands at block_tables[b, t // bs] offset t % bs
     (only t < seq_lens[b] rows are written; the rest target the null block
     but are masked by never being read — seq_lens bounds every gather)."""
-    B, S = k_new.shape[0], k_new.shape[1]
-    nb, bs = cache_k.shape[0], cache_k.shape[1]
+    phys, off = _prefill_scatter_idx(cache_k, block_tables, seq_lens,
+                                     k_new.shape[1])
+    cache_k = cache_k.at[phys, off].set(
+        _flat_rows(k_new).astype(cache_k.dtype), mode="drop")
+    cache_v = cache_v.at[phys, off].set(
+        _flat_rows(v_new).astype(cache_v.dtype), mode="drop")
+    return cache_k, cache_v
+
+
+def _prefill_scatter_idx(pool, block_tables, seq_lens, S):
+    """Flattened (phys, offset) for writing a [B, S, ...] prompt. Padding
+    rows target an OUT-OF-BOUNDS block and are DROPPED by the scatter —
+    redirecting them at any real block id (block 0 included) would clobber
+    whichever sequence owns that block."""
+    B = block_tables.shape[0]
+    nb, bs = pool.shape[0], pool.shape[1]
     t = jnp.arange(S)
     blk_idx = t // bs                                   # [S]
     off = t % bs
     phys = block_tables[:, blk_idx]                     # [B, S]
     valid = t[None, :] < seq_lens[:, None]              # [B, S]
-    # padding rows target an OUT-OF-BOUNDS block and are DROPPED by the
-    # scatter — redirecting them at any real block id (block 0 included)
-    # would clobber whichever sequence owns that block
     phys = jnp.where(valid, phys, nb)
-    flat_phys = phys.reshape(-1)
-    flat_off = jnp.tile(off, B)
-    cache_k = cache_k.at[flat_phys, flat_off].set(
-        k_new.reshape(B * S, *k_new.shape[2:]).astype(cache_k.dtype),
-        mode="drop")
-    cache_v = cache_v.at[flat_phys, flat_off].set(
-        v_new.reshape(B * S, *v_new.shape[2:]).astype(cache_v.dtype),
-        mode="drop")
-    return cache_k, cache_v
+    return phys.reshape(-1), jnp.tile(off, B)
+
+
+def _flat_rows(x):
+    return x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:])
 
 
 def paged_write_decode_int8(kq, ks, vq, vs, block_tables, seq_lens,
                             k_new_q, k_new_s, v_new_q, v_new_s):
     """int8 form of paged_write_decode: values [B, kv, D] int8 plus their
-    per-(token, head) scales [B, kv]."""
-    bs = kq.shape[1]
-    pos = seq_lens.astype(jnp.int32)
-    blk_idx = pos // bs
-    off = pos % bs
-    rows = jnp.arange(block_tables.shape[0])
-    phys = block_tables[rows, blk_idx]
-    kq = kq.at[phys, off].set(k_new_q)
-    ks = ks.at[phys, off].set(k_new_s)
-    vq = vq.at[phys, off].set(v_new_q)
-    vs = vs.at[phys, off].set(v_new_s)
-    return kq, ks, vq, vs
+    per-(token, head) scales [B, kv] — same scatter indices, four pools."""
+    phys, off = _decode_scatter_idx(block_tables, seq_lens, kq.shape[1])
+    return (kq.at[phys, off].set(k_new_q), ks.at[phys, off].set(k_new_s),
+            vq.at[phys, off].set(v_new_q), vs.at[phys, off].set(v_new_s))
 
 
 def paged_write_prefill_int8(kq, ks, vq, vs, block_tables, seq_lens,
                              k_new_q, k_new_s, v_new_q, v_new_s):
     """int8 form of paged_write_prefill (values [B, S, kv, D] int8 + scales
-    [B, S, kv]); padding rows drop via out-of-bounds scatter."""
-    B, S = k_new_q.shape[0], k_new_q.shape[1]
-    nb, bs = kq.shape[0], kq.shape[1]
-    t = jnp.arange(S)
-    blk_idx = t // bs
-    off = t % bs
-    phys = block_tables[:, blk_idx]
-    valid = t[None, :] < seq_lens[:, None]
-    phys = jnp.where(valid, phys, nb)
-    flat_phys = phys.reshape(-1)
-    flat_off = jnp.tile(off, B)
+    [B, S, kv]); padding rows drop via the shared out-of-bounds scatter."""
+    phys, off = _prefill_scatter_idx(kq, block_tables, seq_lens,
+                                     k_new_q.shape[1])
 
     def w(pool, new):
-        return pool.at[flat_phys, flat_off].set(
-            new.reshape((B * S,) + new.shape[2:]), mode="drop")
+        return pool.at[phys, off].set(_flat_rows(new), mode="drop")
 
     return w(kq, k_new_q), w(ks, k_new_s), w(vq, v_new_q), w(vs, v_new_s)
 
@@ -275,7 +269,10 @@ def paged_attention_decode_int8(q, kq, ks, vq, vs, block_tables, seq_lens,
                                 scale=None):
     """One decode step against the int8 paged cache WITHOUT materializing a
     dequantized copy: the per-(token, head) scales fold into the score and
-    value einsums (the paged form of the dense engine's _attend_int8)."""
+    value einsums. Arithmetic MIRRORS the dense engine's _attend_int8
+    op-for-op (QK/PV einsums in q.dtype, fp32 scale fold, divide by
+    sqrt(D)) so dense-int8 and paged-int8 stay bit-comparable in bf16 too,
+    not just fp32."""
     B, n_q, D = q.shape
     nb, bs, n_kv, _ = kq.shape
     groups = n_q // n_kv
@@ -286,18 +283,19 @@ def paged_attention_decode_int8(q, kq, ks, vq, vs, block_tables, seq_lens,
     v = vq[block_tables].reshape(B, T, n_kv, D)
     v_s = vs[block_tables].reshape(B, T, n_kv)
 
-    if scale is None:
-        scale = 1.0 / np.sqrt(D)
-    ct = jnp.promote_types(q.dtype, jnp.float32)
     qg = q.reshape(B, n_kv, groups, D)
-    logits = jnp.einsum("bhgd,bthd->bhgt", qg.astype(ct), k.astype(ct))
-    logits = logits * jnp.transpose(k_s, (0, 2, 1))[:, :, None, :] * scale
+    logits = jnp.einsum("bhgd,bthd->bhgt", qg, k.astype(q.dtype))
+    ct = jnp.promote_types(q.dtype, jnp.float32)
+    logits = (logits.astype(ct)
+              * jnp.transpose(k_s, (0, 2, 1))[:, :, None, :].astype(ct)
+              / (np.sqrt(D) if scale is None else 1.0 / scale))
     t = jnp.arange(T)[None, None, None, :]
     mask = t <= seq_lens[:, None, None, None]
-    logits = jnp.where(mask, logits, -1e30)
+    logits = jnp.where(mask, logits, jnp.asarray(-1e30, logits.dtype))
     probs = jax.nn.softmax(logits, axis=-1)
-    pv = probs * jnp.transpose(v_s, (0, 2, 1))[:, :, None, :]
-    out = jnp.einsum("bhgt,bthd->bhgd", pv, v.astype(ct))
+    pv = (probs * jnp.transpose(v_s, (0, 2, 1))[:, :, None, :].astype(ct)
+          ).astype(q.dtype)
+    out = jnp.einsum("bhgt,bthd->bhgd", pv, v.astype(q.dtype))
     return out.reshape(B, n_q, D).astype(q.dtype)
 
 

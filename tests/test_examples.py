@@ -83,3 +83,9 @@ def test_ps_train_under_launcher():
          os.path.join(EX, "ps_train.py")],
         env=env, capture_output=True, text=True, timeout=300, cwd=REPO)
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_serve_continuous_batching():
+    out = _run("serve_continuous_batching.py")
+    assert "[paged]" in out and "[beams]" in out
+    assert out.count("[serve] request") == 3

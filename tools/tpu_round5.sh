@@ -67,6 +67,9 @@ stage sweep_rest python tools/mfu_sweep.py \
 stage decode_int8 env BENCH_DECODE_KV=int8 BENCH_NO_CACHE=1 \
     BENCH_SKIP_FLASHCHECK=1 BENCH_SKIP_DISPATCH=1 BENCH_ITERS=3 \
     python bench.py --worker
+stage decode_paged env BENCH_DECODE_LAYOUT=paged BENCH_NO_CACHE=1 \
+    BENCH_SKIP_FLASHCHECK=1 BENCH_SKIP_DISPATCH=1 BENCH_ITERS=3 \
+    python bench.py --worker
 
 # 4) BASELINE suite at faithful TPU shapes (batch128/224px O2 resnet,
 #    BERT-base seq128; gpt_hybrid runs on its own 8-dev virtual CPU mesh —
