@@ -44,7 +44,9 @@ def _math_sdpa(q, k, v, attn_mask=None, causal=False, dropout_key=None, dropout_
             logits = jnp.where(attn_mask, logits, jnp.asarray(-1e30, logits.dtype))
         else:
             logits = logits + attn_mask.astype(logits.dtype)
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(qt.dtype)
+    # promote, don't demote: bf16 -> f32 for stability, f64 stays f64
+    ct = jnp.promote_types(qt.dtype, jnp.float32)
+    probs = jax.nn.softmax(logits.astype(ct), axis=-1).astype(qt.dtype)
     if dropout_p > 0.0 and dropout_key is not None:
         keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)
         probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
@@ -103,7 +105,8 @@ def _varlen(q, k, v, seg_q, seg_k, scale=None, causal=False):
     if causal:
         mask = mask & (jnp.arange(q.shape[0])[:, None] >= jnp.arange(k.shape[0])[None, :])
     logits = jnp.where(mask[None], logits, -1e30)
-    probs = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(q.dtype)
+    ct = jnp.promote_types(q.dtype, jnp.float32)
+    probs = jax.nn.softmax(logits.astype(ct), -1).astype(q.dtype)
     return jnp.einsum("hqk,khd->qhd", probs, v)
 
 

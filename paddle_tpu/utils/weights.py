@@ -27,8 +27,8 @@ import re
 import numpy as np
 
 __all__ = ["load_checkpoint", "convert_torch_state_dict",
-           "convert_hf_bert_state_dict", "load_pretrained",
-           "load_zoo_pretrained"]
+           "convert_hf_bert_state_dict", "convert_torch_mha_state_dict",
+           "load_pretrained", "load_zoo_pretrained"]
 
 
 def load_checkpoint(path):
@@ -121,6 +121,42 @@ def convert_hf_bert_state_dict(sd):
             k = pat.sub(rep, k)
         renamed[k] = np.asarray(v)
     return convert_torch_state_dict(renamed)
+
+
+def convert_torch_mha_state_dict(sd):
+    """torch.nn.MultiheadAttention (and the Transformer layers built on it)
+    pack q/k/v into one [3E, E] in_proj_weight / [3E] in_proj_bias; this
+    build (like the reference) keeps separate q/k/v projections. Split the
+    packed tensors into {q,k,v}_proj entries, then apply the generic torch
+    layout rules (linear transposes etc.). Works on full module trees: any
+    key ending in in_proj_weight/in_proj_bias is split in place.
+
+    torch MHA variants that do NOT pack (kdim/vdim != embed_dim uses
+    separate q_proj_weight/..., add_bias_kv adds bias_k/bias_v) carry a
+    different parameter contract — rejected explicitly rather than passed
+    through under their torch names (which set_state_dict would miss)."""
+    unpacked = sorted(k for k in sd
+                      if k.endswith(("q_proj_weight", "k_proj_weight",
+                                     "v_proj_weight", "bias_k", "bias_v")))
+    if unpacked:
+        raise NotImplementedError(
+            "convert_torch_mha_state_dict: unpacked-projection MHA keys "
+            f"{unpacked[:4]} (kdim/vdim != embed_dim or add_bias_kv) are "
+            "not supported; export a same-dim MHA or map the projections "
+            "manually")
+    out = {}
+    for k, v in sd.items():
+        v = np.asarray(v)
+        if k.endswith("in_proj_weight") or k.endswith("in_proj_bias"):
+            prefix = k[:k.rindex("in_proj")]
+            suffix = "weight" if k.endswith("weight") else "bias"
+            q, kk, vv = np.split(v, 3, axis=0)
+            out[f"{prefix}q_proj.{suffix}"] = q
+            out[f"{prefix}k_proj.{suffix}"] = kk
+            out[f"{prefix}v_proj.{suffix}"] = vv
+        else:
+            out[k] = v
+    return convert_torch_state_dict(out)
 
 
 def load_pretrained(model, path, source="auto", strict=True):

@@ -298,3 +298,67 @@ def test_bicubic_scale_factor_noninteger_matches_torch():
                               mode=mode, align_corners=False)
         np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=1e-6,
                                    atol=1e-7, err_msg=mode)
+
+
+@pytest.mark.slow
+class TestTransformerLayerParity:
+    """torch MultiheadAttention packs q/k/v into in_proj_weight;
+    convert_torch_mha_state_dict splits it onto this build's separate
+    projections — pinned by full-layer goldens."""
+
+    def test_multihead_attention_matches_torch(self):
+        import torch
+
+        from paddle_tpu.utils.weights import convert_torch_mha_state_dict
+
+        torch.manual_seed(0)
+        E, H, B, S = 16, 4, 2, 7
+        tm = torch.nn.MultiheadAttention(E, H, batch_first=True).double()
+        pm = paddle.nn.MultiHeadAttention(E, H).astype("float64")
+        sd = convert_torch_mha_state_dict(
+            {k: v.numpy() for k, v in tm.state_dict().items()})
+        missing, unexpected = pm.set_state_dict(sd)
+        assert not missing and not unexpected, (missing, unexpected)
+
+        x = np.random.RandomState(1).randn(B, S, E)
+        with torch.no_grad():
+            want, _ = tm(torch.from_numpy(x), torch.from_numpy(x),
+                         torch.from_numpy(x))
+        got = pm(paddle.to_tensor(x))
+        np.testing.assert_allclose(got.numpy(), want.numpy(),
+                                   rtol=1e-9, atol=1e-10)
+
+    def test_transformer_encoder_layer_matches_torch(self):
+        import torch
+
+        from paddle_tpu.utils.weights import convert_torch_mha_state_dict
+
+        torch.manual_seed(1)
+        E, H, FF, B, S = 16, 4, 32, 2, 6
+        tm = torch.nn.TransformerEncoderLayer(
+            E, H, dim_feedforward=FF, dropout=0.0, batch_first=True).double()
+        tm.eval()
+        pm = paddle.nn.TransformerEncoderLayer(
+            E, H, FF, dropout=0.0, activation="relu").astype("float64")
+        pm.eval()
+        sd = convert_torch_mha_state_dict(
+            {k: v.numpy() for k, v in tm.state_dict().items()})
+        missing, unexpected = pm.set_state_dict(sd)
+        assert not missing and not unexpected, (missing, unexpected)
+
+        x = np.random.RandomState(2).randn(B, S, E)
+        with torch.no_grad():
+            want = tm(torch.from_numpy(x))
+        got = pm(paddle.to_tensor(x))
+        np.testing.assert_allclose(got.numpy(), want.numpy(),
+                                   rtol=1e-9, atol=1e-9)
+
+    def test_unpacked_mha_variants_rejected(self):
+        import torch
+
+        from paddle_tpu.utils.weights import convert_torch_mha_state_dict
+
+        tm = torch.nn.MultiheadAttention(16, 4, kdim=8, vdim=8)
+        with pytest.raises(NotImplementedError, match="unpacked"):
+            convert_torch_mha_state_dict(
+                {k: v.numpy() for k, v in tm.state_dict().items()})
