@@ -97,5 +97,116 @@ class DistributedStrategy:
         return "\n".join(lines)
 
 
+class _ConfigGroup:
+    """Dot-access config group (reference auto_parallel/strategy.py
+    BaseConfig): ``strategy.amp.enable = True`` etc. Truthiness is the
+    group's ``enable`` flag, so code written against the flat
+    DistributedStrategy booleans (``if strategy.amp:``) keeps working."""
+
+    def __init__(self, **defaults):
+        self.__dict__.update(defaults)
+
+    def __bool__(self):
+        return bool(getattr(self, "enable", False))
+
+    def to_dict(self):
+        return dict(self.__dict__)
+
+    def get(self, k, d=None):
+        return self.__dict__.get(k, d)
+
+    def __repr__(self):
+        inner = ", ".join(f"{k}={v!r}" for k, v in self.__dict__.items())
+        return f"{type(self).__name__}({inner})"
+
+
 class Strategy(DistributedStrategy):
-    """auto_parallel Strategy (auto_parallel/strategy.py) — same knobs, dot-access groups."""
+    """auto_parallel Strategy (reference auto_parallel/strategy.py:191) —
+    the dot-access-group form of the knob surface::
+
+        s = dist.Strategy()
+        s.amp.enable = True
+        s.amp.level = "o2"
+        s.sharding.enable = True
+        s.sharding.stage = 2
+
+    The groups feed the same pass pipeline (distributed/passes) the flat
+    DistributedStrategy booleans do."""
+
+    def __init__(self, config=None):
+        super().__init__()
+        # group fields mirror the reference's typed configs (strategy.py:96+)
+        self.recompute = _ConfigGroup(enable=False, checkpoints=[],
+                                      checkpoint_policy=None)
+        self.amp = _ConfigGroup(
+            enable=False, dtype="float16", level="o1",
+            init_loss_scaling=32768.0, use_dynamic_loss_scaling=True,
+            custom_white_list=[], custom_black_list=[],
+            custom_black_varnames=[], use_fp16_guard=False,
+            use_bf16_guard=False, use_master_grad=False)
+        self.sharding = _ConfigGroup(enable=False, stage=1, degree=8)
+        self.gradient_merge = _ConfigGroup(enable=False, k_steps=1, avg=True)
+        self.pipeline = _ConfigGroup(enable=False, schedule_mode="1F1B",
+                                     micro_batch_size=1, accumulate_steps=1)
+        if config:
+            for cat, vals in dict(config).items():
+                group = getattr(self, cat, None)
+                if not isinstance(group, _ConfigGroup):
+                    raise ValueError(
+                        f"Strategy config: unknown category {cat!r} "
+                        f"(known: recompute/amp/sharding/gradient_merge/"
+                        f"pipeline)")
+                if not isinstance(vals, dict):
+                    raise ValueError(
+                        f"Strategy config[{cat!r}] must be a dict of group "
+                        f"fields, got {type(vals).__name__} (the flat "
+                        "boolean form belongs to DistributedStrategy)")
+                unknown = sorted(set(vals) - set(group.__dict__))
+                if unknown:
+                    raise ValueError(
+                        f"Strategy config[{cat!r}]: unknown field(s) "
+                        f"{unknown}; known: {sorted(group.__dict__)}")
+                group.__dict__.update(vals)
+
+    # -- live flat views -----------------------------------------------------
+    # Fleet-path consumers (meta_optimizers, hybrid_optimizer, Engine.cost,
+    # pipeline wrappers) read the flat *_configs dicts; on the dot-access
+    # Strategy those are VIEWS over the groups so both surfaces always agree.
+    # Setters exist because DistributedStrategy.__init__ assigns the flat
+    # dicts before the groups are created — writes before then are dropped
+    # (the group defaults carry the same values), afterwards they update the
+    # group in place.
+    @staticmethod
+    def _view(group_attr, mapper):
+        def getter(self):
+            g = self.__dict__.get(group_attr)
+            return mapper(g) if isinstance(g, _ConfigGroup) else {}
+
+        def setter(self, d):
+            g = self.__dict__.get(group_attr)
+            if isinstance(g, _ConfigGroup):
+                g.__dict__.update(
+                    {k: v for k, v in (d or {}).items()
+                     if k in g.__dict__})
+        return property(getter, setter)
+
+    gradient_merge_configs = _view.__func__(
+        "gradient_merge", lambda g: {"k_steps": g.k_steps, "avg": g.avg})
+    recompute_configs = _view.__func__(
+        "recompute", lambda g: {"checkpoints": list(g.checkpoints),
+                                "checkpoint_policy": g.checkpoint_policy})
+    sharding_configs = _view.__func__(
+        "sharding", lambda g: {"stage": g.stage, "degree": g.degree,
+                               "sharding_degree": g.degree})
+    pipeline_configs = _view.__func__(
+        "pipeline", lambda g: {"schedule_mode": g.schedule_mode,
+                               "micro_batch_size": g.micro_batch_size,
+                               "accumulate_steps": g.accumulate_steps})
+    amp_configs = _view.__func__(
+        "amp", lambda g: {"level": g.level, "dtype": g.dtype,
+                          "custom_white_list": list(g.custom_white_list),
+                          "custom_black_list": list(g.custom_black_list),
+                          "init_loss_scaling": g.init_loss_scaling,
+                          "use_dynamic_loss_scaling":
+                              g.use_dynamic_loss_scaling,
+                          "master_grad": g.use_master_grad})

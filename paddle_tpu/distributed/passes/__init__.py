@@ -253,25 +253,41 @@ class GradientMergePass(PassBase):
                               "avg": bool(self.attrs.get("avg", True))}
 
 
+def _knob(strategy, name):
+    """(enabled, config-dict) for one knob, accepting BOTH strategy forms:
+    the flat DistributedStrategy (bool + <name>_configs dict) and the
+    auto_parallel Strategy's dot-access groups (truthiness == .enable,
+    config fields on the group itself)."""
+    val = getattr(strategy, name, False)
+    if hasattr(val, "to_dict"):          # dot-access group
+        cfg = {k: v for k, v in val.to_dict().items() if k != "enable"}
+        return bool(val), cfg
+    return bool(val), dict(getattr(strategy, f"{name}_configs", {}) or {})
+
+
 def build_pipeline_from_strategy(strategy):
     """Map a DistributedStrategy/Strategy's enabled knobs onto the pass
     pipeline (the reference Engine does this wiring inside _parallel_pir)."""
     passes = []
-    if getattr(strategy, "amp", False):
-        cfg = dict(getattr(strategy, "amp_configs", {}) or {})
-        if "level" not in cfg:
+    on, cfg = _knob(strategy, "amp")
+    if on:
+        if "level" not in cfg or not cfg.get("level"):
             cfg["level"] = "O2" if cfg.get("use_pure_fp16") else "O1"
-        if "dtype" not in cfg:
+        if "dtype" not in cfg or not cfg.get("dtype"):
             cfg["dtype"] = ("bfloat16" if cfg.get("use_bf16", True)
                             else "float16")
         passes.append(new_pass("auto_parallel_amp", cfg))
-    if getattr(strategy, "recompute", False):
-        passes.append(new_pass("auto_parallel_recompute",
-                               getattr(strategy, "recompute_configs", {})))
-    if getattr(strategy, "sharding", False):
-        passes.append(new_pass("auto_parallel_sharding",
-                               getattr(strategy, "sharding_configs", {})))
-    if getattr(strategy, "gradient_merge", False):
-        passes.append(new_pass("auto_parallel_gradient_merge",
-                               getattr(strategy, "gradient_merge_configs", {})))
+    on, cfg = _knob(strategy, "recompute")
+    if on:
+        passes.append(new_pass("auto_parallel_recompute", cfg))
+    on, cfg = _knob(strategy, "sharding")
+    if on:
+        # ShardingPass reads stage/mesh/sharding_mesh_dim; the degree rides
+        # on the MESH under GSPMD (flat consumers like Engine.cost get it
+        # from the sharding_configs view), so it is dropped here
+        cfg.pop("degree", None)
+        passes.append(new_pass("auto_parallel_sharding", cfg))
+    on, cfg = _knob(strategy, "gradient_merge")
+    if on:
+        passes.append(new_pass("auto_parallel_gradient_merge", cfg))
     return PassManager(passes)

@@ -159,3 +159,85 @@ class TestComposedPipelineTrains:
         dm(paddle.to_tensor(batches[1][0]), paddle.to_tensor(batches[1][1]))
         w2 = np.asarray(w.value).copy()
         assert np.abs(w2 - w1).max() > 0        # apply step: update landed
+
+
+class TestDotAccessStrategy:
+    """Reference auto_parallel Strategy idiom (strategy.py:191):
+    strategy.amp.enable = True / strategy.sharding.stage = 2 — the groups
+    must drive the same pass pipeline as the flat booleans."""
+
+    def test_groups_wire_the_pipeline(self):
+        import paddle_tpu.distributed as dist
+
+        s = dist.Strategy()
+        assert not s.amp and not s.sharding.enable   # reference defaults
+        s.amp.enable = True
+        s.amp.level = "o2"
+        s.amp.dtype = "bfloat16"
+        s.recompute.enable = True
+        s.sharding.enable = True
+        s.sharding.stage = 2
+        s.sharding.degree = 4
+        s.gradient_merge.enable = True
+        s.gradient_merge.k_steps = 3
+        pm = build_pipeline_from_strategy(s)
+        assert pm.names == [
+            "auto_parallel_amp", "auto_parallel_recompute",
+            "auto_parallel_sharding", "auto_parallel_gradient_merge"]
+
+        ctx = PassContext()
+        pm.apply(ctx)
+        assert ctx.gradient_merge == {"k_steps": 3, "avg": True}
+        assert len(ctx.forward_guards) == 1   # the amp guard
+
+    def test_config_dict_ctor(self):
+        import paddle_tpu.distributed as dist
+
+        s = dist.Strategy({"sharding": {"enable": True, "stage": 3}})
+        assert s.sharding.enable and s.sharding.stage == 3
+
+    def test_dot_strategy_trains_through_engine(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.auto_parallel import Engine
+
+        model = _make_model(9)
+        opt = paddle.optimizer.SGD(learning_rate=0.3,
+                                   parameters=model.parameters())
+        s = dist.Strategy()
+        s.gradient_merge.enable = True
+        s.gradient_merge.k_steps = 2
+        eng = Engine(model=model, loss=paddle.nn.CrossEntropyLoss(),
+                     optimizer=opt, strategy=s)
+        hist = eng.fit(_data(steps=4), epochs=1)
+        assert len(hist["loss"]) == 4
+        assert hist["loss"][-1] < hist["loss"][0]
+
+    def test_flat_views_track_the_groups(self):
+        """Fleet-path consumers read *_configs dicts; on the dot Strategy
+        those must be LIVE views of the groups (a stale flat copy silently
+        ignored s.gradient_merge.k_steps for fleet.distributed_optimizer)."""
+        import paddle_tpu.distributed as dist
+
+        s = dist.Strategy()
+        s.gradient_merge.enable = True
+        s.gradient_merge.k_steps = 3
+        assert s.gradient_merge_configs == {"k_steps": 3, "avg": True}
+        s.sharding.stage = 2
+        s.sharding.degree = 4
+        assert s.sharding_configs["stage"] == 2
+        assert s.sharding_configs["sharding_degree"] == 4
+        s.pipeline.accumulate_steps = 5
+        assert s.pipeline_configs["accumulate_steps"] == 5
+        # writes through the flat surface land in the group too
+        s.amp_configs = {"level": "o2"}
+        assert s.amp.level == "o2"
+
+    def test_config_ctor_validates(self):
+        import paddle_tpu.distributed as dist
+
+        with pytest.raises(ValueError, match="unknown category"):
+            dist.Strategy({"gradient_mrege": {"enable": True}})
+        with pytest.raises(ValueError, match="unknown field"):
+            dist.Strategy({"amp": {"enabled": True}})
+        with pytest.raises(ValueError, match="must be a dict"):
+            dist.Strategy({"amp": True})
