@@ -14,13 +14,18 @@ import pytest
 import paddle_tpu as paddle
 
 
-def _copy_rnn_weights(tm, pm):
-    """torch RNN modules and RNNBase share the weight naming AND layout
-    ([gates*hidden, in]); copy verbatim."""
+def _copy_weights(tm, pm):
+    """Copy a torch module's state dict verbatim onto the paddle module,
+    asserting the key SETS match first (a naming divergence should fail as
+    a key diff, not a downstream numeric mismatch). Works wherever naming
+    and layout already agree (RNNs, convs, norms with weight/bias)."""
     sd = {k: v.numpy() for k, v in tm.state_dict().items()}
     target = pm.state_dict()
     assert set(sd) == set(target), (sorted(sd), sorted(target))
     pm.set_state_dict(sd)
+
+
+_copy_rnn_weights = _copy_weights
 
 
 @pytest.mark.slow
@@ -182,7 +187,7 @@ class TestConvNormPoolParity:
             # astype BEFORE loading: set_state_dict casts to the existing
             # param dtype, so f64 oracle weights would round through f32
             pm = pm.astype("float64")
-            pm.set_state_dict({k: v.numpy() for k, v in tm.state_dict().items()})
+            _copy_weights(tm, pm)
             x = np.random.RandomState(1).randn(2, 4, 11, 13)
             self._cmp(pm(paddle.to_tensor(x)), tm(torch.from_numpy(x)))
 
@@ -195,7 +200,7 @@ class TestConvNormPoolParity:
         pm = paddle.nn.Conv2DTranspose(3, 5, 3, stride=2, padding=1,
                                        output_padding=1)
         pm = pm.astype("float64")
-        pm.set_state_dict({k: v.numpy() for k, v in tm.state_dict().items()})
+        _copy_weights(tm, pm)
         x = np.random.RandomState(2).randn(2, 3, 7, 9)
         self._cmp(pm(paddle.to_tensor(x)), tm(torch.from_numpy(x)))
 
@@ -362,3 +367,158 @@ class TestTransformerLayerParity:
         with pytest.raises(NotImplementedError, match="unpacked"):
             convert_torch_mha_state_dict(
                 {k: v.numpy() for k, v in tm.state_dict().items()})
+
+
+@pytest.mark.slow
+class TestLossParity:
+    """Loss functions vs torch golden: ignore_index/label-smoothing/weights
+    semantics and the CTC forward (alpha recursion over blanks) are the
+    classic divergence points."""
+
+    def test_cross_entropy_variants(self):
+        import torch
+        import torch.nn.functional as TF
+
+        import paddle_tpu.nn.functional as F
+
+        r = np.random.RandomState(0)
+        logits = r.randn(6, 5)
+        labels = np.array([0, 4, 2, -100, 1, 3], np.int64)
+        weight = r.uniform(0.5, 2.0, 5)
+
+        for kw_t, kw_p in (
+                (dict(), dict()),
+                (dict(ignore_index=-100), dict(ignore_index=-100)),
+                (dict(label_smoothing=0.2), dict(label_smoothing=0.2)),
+                (dict(weight=torch.from_numpy(weight)),
+                 dict(weight=paddle.to_tensor(weight)))):
+            safe = labels.copy()
+            if "ignore_index" not in kw_t:
+                safe[safe == -100] = 1
+            want = TF.cross_entropy(torch.from_numpy(logits),
+                                    torch.from_numpy(safe), **kw_t)
+            got = F.cross_entropy(paddle.to_tensor(logits),
+                                  paddle.to_tensor(safe), **kw_p)
+            np.testing.assert_allclose(float(np.asarray(got.value)),
+                                       float(want), rtol=1e-9, atol=1e-12,
+                                       err_msg=str(kw_t))
+
+    def test_nll_kl_smoothl1_bce(self):
+        import torch
+        import torch.nn.functional as TF
+
+        import paddle_tpu.nn.functional as F
+
+        r = np.random.RandomState(1)
+        x = r.randn(4, 6)
+        logp = x - np.log(np.exp(x).sum(-1, keepdims=True))
+        tgt = np.abs(r.randn(4, 6)) + 0.1
+        tgt = tgt / tgt.sum(-1, keepdims=True)
+        lab = r.randint(0, 6, (4,)).astype("int64")
+
+        np.testing.assert_allclose(
+            float(np.asarray(F.nll_loss(paddle.to_tensor(logp),
+                                        paddle.to_tensor(lab)).value)),
+            float(TF.nll_loss(torch.from_numpy(logp),
+                              torch.from_numpy(lab))), rtol=1e-9)
+        np.testing.assert_allclose(
+            float(np.asarray(F.kl_div(paddle.to_tensor(logp),
+                                      paddle.to_tensor(tgt),
+                                      reduction="batchmean").value)),
+            float(TF.kl_div(torch.from_numpy(logp), torch.from_numpy(tgt),
+                            reduction="batchmean")), rtol=1e-9)
+        a, b = r.randn(5, 3), r.randn(5, 3)
+        np.testing.assert_allclose(
+            float(np.asarray(F.smooth_l1_loss(paddle.to_tensor(a),
+                                              paddle.to_tensor(b)).value)),
+            float(TF.smooth_l1_loss(torch.from_numpy(a),
+                                    torch.from_numpy(b))), rtol=1e-9)
+        p = 1 / (1 + np.exp(-a))
+        t = (b > 0).astype("float64")
+        np.testing.assert_allclose(
+            float(np.asarray(F.binary_cross_entropy(
+                paddle.to_tensor(p), paddle.to_tensor(t)).value)),
+            float(TF.binary_cross_entropy(torch.from_numpy(p),
+                                          torch.from_numpy(t))), rtol=1e-9)
+
+    def test_ctc_loss_matches_torch(self):
+        import torch
+        import torch.nn.functional as TF
+
+        import paddle_tpu.nn.functional as F
+
+        r = np.random.RandomState(2)
+        T, B, C = 12, 3, 7                  # time, batch, classes (0=blank)
+        x = r.randn(T, B, C)
+        logp = x - np.log(np.exp(x).sum(-1, keepdims=True))
+        labels = r.randint(1, C, (B, 5)).astype("int32")
+        in_lens = np.array([12, 10, 8], np.int64)
+        lab_lens = np.array([5, 3, 4], np.int64)
+
+        want = TF.ctc_loss(torch.from_numpy(logp),
+                           torch.from_numpy(labels.astype("int64")),
+                           torch.from_numpy(in_lens),
+                           torch.from_numpy(lab_lens),
+                           blank=0, reduction="none", zero_infinity=False)
+        got = F.ctc_loss(paddle.to_tensor(logp),
+                         paddle.to_tensor(labels),
+                         paddle.to_tensor(in_lens.astype("int64")),
+                         paddle.to_tensor(lab_lens.astype("int64")),
+                         blank=0, reduction="none", norm_by_times=False)
+        np.testing.assert_allclose(np.asarray(got.value).reshape(-1),
+                                   want.numpy().reshape(-1),
+                                   rtol=1e-8, atol=1e-9)
+
+
+@pytest.mark.slow
+class TestConvNdAndBatchNormStats:
+    def test_conv1d_conv3d_match_torch(self):
+        import torch
+
+        torch.manual_seed(2)
+        t1 = torch.nn.Conv1d(3, 5, 3, stride=2, padding=1).double()
+        p1 = paddle.nn.Conv1D(3, 5, 3, stride=2, padding=1).astype("float64")
+        _copy_weights(t1, p1)
+        x = np.random.RandomState(7).randn(2, 3, 13)
+        np.testing.assert_allclose(
+            p1(paddle.to_tensor(x)).numpy(),
+            t1(torch.from_numpy(x)).detach().numpy(), rtol=1e-9, atol=1e-10)
+
+        t3 = torch.nn.Conv3d(2, 4, 3, stride=1, padding=1).double()
+        p3 = paddle.nn.Conv3D(2, 4, 3, stride=1, padding=1).astype("float64")
+        _copy_weights(t3, p3)
+        x = np.random.RandomState(8).randn(1, 2, 5, 6, 7)
+        np.testing.assert_allclose(
+            p3(paddle.to_tensor(x)).numpy(),
+            t3(torch.from_numpy(x)).detach().numpy(), rtol=1e-9, atol=1e-10)
+
+    def test_batchnorm_train_running_stats_momentum_convention(self):
+        """paddle momentum=m means running = m*running + (1-m)*batch; torch
+        momentum=t means running = (1-t)*running + t*batch — equivalent at
+        m = 1-t. A sign/convention slip here corrupts EVERY eval-mode
+        forward after training, so pin the running stats themselves."""
+        import torch
+
+        tm = torch.nn.BatchNorm2d(4, momentum=0.3).double().train()
+        pm = paddle.nn.BatchNorm2D(4, momentum=0.7).astype("float64")
+        pm.train()
+        r = np.random.RandomState(9)
+        for _ in range(3):
+            x = r.randn(2, 4, 5, 5)
+            out_t = tm(torch.from_numpy(x))
+            out_p = pm(paddle.to_tensor(x))
+            np.testing.assert_allclose(out_p.numpy(),
+                                       out_t.detach().numpy(),
+                                       rtol=1e-9, atol=1e-9)
+        np.testing.assert_allclose(
+            np.asarray(pm._mean.value), tm.running_mean.numpy(),
+            rtol=1e-9, atol=1e-12)
+        np.testing.assert_allclose(
+            np.asarray(pm._variance.value), tm.running_var.numpy(),
+            rtol=1e-9, atol=1e-12)
+        # eval mode then uses the stats
+        tm.eval(); pm.eval()
+        x = r.randn(2, 4, 5, 5)
+        np.testing.assert_allclose(
+            pm(paddle.to_tensor(x)).numpy(),
+            tm(torch.from_numpy(x)).detach().numpy(), rtol=1e-9, atol=1e-9)
