@@ -207,6 +207,74 @@ def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
     return out + bias if bias is not None else out
 
 
+@defop("fused_gate_attention", amp_category="white")
+def _fused_gate_attention(query, key=None, query_weight=None, key_weight=None,
+                          value_weight=None, qkv_weight=None,
+                          gate_linear_weight=None, gate_linear_bias=None,
+                          out_linear_weight=None, out_linear_bias=None,
+                          nonbatched_bias=None, attn_mask=None,
+                          has_gating=True, merge_qkv=True):
+    """reference fused_gate_attention.py:26 — AlphaFold-style gated MSA
+    self-attention as ONE traced op (the reference fuses it as a CUDA
+    kernel; here XLA fuses the einsum chain). Shapes per the reference:
+    query [N, B, Q, A]; merged qkv_weight [3, H, D, A]; separate
+    query/key/value weights [A, H, D]; gating [A, H, D] + [H, D]; output
+    [H, D, A_out]; nonbatched_bias [N, H, Q, M] (unsqueezed over the msa
+    axis); attn_mask [N, B, 1, 1, M] added as a bias."""
+    if merge_qkv:
+        qkv = jnp.einsum("nbqa,thda->tnbqhd", query, qkv_weight)
+        q, k, v = qkv[0], qkv[1], qkv[2]
+    else:
+        kin = query if key is None else key
+        q = jnp.einsum("nbqa,ahd->nbqhd", query, query_weight)
+        k = jnp.einsum("nbka,ahd->nbkhd", kin, key_weight)
+        v = jnp.einsum("nbka,ahd->nbkhd", kin, value_weight)
+    head_dim = q.shape[-1]
+    q = q * (head_dim ** -0.5)
+    logits = jnp.einsum("nbqhd,nbkhd->nbhqk", q, k)
+    if attn_mask is not None:
+        logits = logits + attn_mask
+    if nonbatched_bias is not None:
+        logits = logits + nonbatched_bias[:, None]
+    ct = jnp.promote_types(logits.dtype, jnp.float32)
+    probs = jax.nn.softmax(logits.astype(ct), -1).astype(logits.dtype)
+    out = jnp.einsum("nbhqk,nbkhd->nbqhd", probs, v)
+    if has_gating:
+        gate = jnp.einsum("nbqa,ahd->nbqhd", query, gate_linear_weight)
+        if gate_linear_bias is not None:
+            gate = gate + gate_linear_bias
+        out = out * jax.nn.sigmoid(gate)
+    out = jnp.einsum("nbqhd,hdo->nbqo", out, out_linear_weight)
+    if out_linear_bias is not None:
+        out = out + out_linear_bias
+    return out
+
+
+def fused_gate_attention(query, key=None, query_weight=None, key_weight=None,
+                         value_weight=None, qkv_weight=None,
+                         gate_linear_weight=None, gate_linear_bias=None,
+                         out_linear_weight=None, out_linear_bias=None,
+                         nonbatched_bias=None, attn_mask=None,
+                         has_gating=True, merge_qkv=True,
+                         use_flash_attn=False):
+    """reference incubate/nn/functional/fused_gate_attention.py:26 public
+    surface. ``use_flash_attn`` is accepted (the XLA fusion plays that
+    role; the gate-attention shapes are small-res AlphaFold blocks, not
+    long-sequence flash territory)."""
+    if merge_qkv and key is not None:
+        # the merged path is self-attention only (reference contract):
+        # silently dropping `key` would return plausible-but-wrong numbers
+        raise ValueError(
+            "fused_gate_attention: merge_qkv=True is self-attention only "
+            "(qkv projected from `query`); pass merge_qkv=False with "
+            "query/key/value weights for cross-attention over `key`")
+    return _fused_gate_attention(
+        query, key, query_weight, key_weight, value_weight, qkv_weight,
+        gate_linear_weight, gate_linear_bias, out_linear_weight,
+        out_linear_bias, nonbatched_bias, attn_mask,
+        has_gating=bool(has_gating), merge_qkv=bool(merge_qkv))
+
+
 def fused_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
                                 is_causal=False, training=True,
                                 scaling_factor=None, name=None):

@@ -153,3 +153,94 @@ class TestNNQuant:
         m = M()
         assert any(isinstance(s, Q.Stub) for s in m.sublayers())
         m(paddle.to_tensor(np.ones((1, 2), "float32")))
+
+
+class TestFusedGateAttention:
+    """reference fused_gate_attention.py:26 (AlphaFold gated MSA
+    self-attention) vs a direct numpy oracle of its documented pseudo-code,
+    merged + unmerged qkv, gating on/off, with both bias inputs."""
+
+    def _oracle(self, q_data, m_data, qw, kw, vw, gw, gb, ow, ob, nb_bias,
+                mask, has_gating):
+        c = qw.shape[-1] ** -0.5
+        q = np.einsum("nbqa,ahc->nbqhc", q_data, qw) * c
+        k = np.einsum("nbka,ahc->nbkhc", m_data, kw)
+        v = np.einsum("nbka,ahc->nbkhc", m_data, vw)
+        logits = np.einsum("nbqhc,nbkhc->nbhqk", q, k)
+        if mask is not None:
+            logits = logits + mask
+        if nb_bias is not None:
+            logits = logits + nb_bias[:, None]
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        w = e / e.sum(-1, keepdims=True)
+        out = np.einsum("nbhqk,nbkhc->nbqhc", w, v)
+        if has_gating:
+            gate = 1 / (1 + np.exp(-(np.einsum("nbqa,ahc->nbqhc", q_data, gw)
+                                     + gb)))
+            out = out * gate
+        return np.einsum("nbqhc,hco->nbqo", out, ow) + ob
+
+    def test_merged_and_unmerged_match_oracle(self):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        r = np.random.RandomState(0)
+        N, B, Q, M, A, H, D = 2, 3, 4, 6, 8, 2, 5
+        x = r.randn(N, B, Q, A).astype("float64")
+        m = r.randn(N, B, M, A).astype("float64")   # DISTINCT key tensor
+        qw = r.randn(A, H, D).astype("float64")
+        kw = r.randn(A, H, D).astype("float64")
+        vw = r.randn(A, H, D).astype("float64")
+        gw = r.randn(A, H, D).astype("float64")
+        gb = r.randn(H, D).astype("float64")
+        ow = r.randn(H, D, A).astype("float64")
+        ob = r.randn(A).astype("float64")
+        nb_bias = r.randn(N, H, Q, M).astype("float64")
+        mask = np.where(r.rand(N, B, 1, 1, M) < 0.2, -1e9, 0.0)
+
+        for has_gating in (True, False):
+            # unmerged = CROSS attention over a distinct key tensor (a
+            # same-as-query key would mask q/k source mixups)
+            want = self._oracle(x, m, qw, kw, vw, gw, gb, ow, ob, nb_bias,
+                                mask, has_gating)
+            got_u = IF.fused_gate_attention(
+                paddle.to_tensor(x), key=paddle.to_tensor(m),
+                query_weight=paddle.to_tensor(qw),
+                key_weight=paddle.to_tensor(kw),
+                value_weight=paddle.to_tensor(vw),
+                gate_linear_weight=paddle.to_tensor(gw),
+                gate_linear_bias=paddle.to_tensor(gb),
+                out_linear_weight=paddle.to_tensor(ow),
+                out_linear_bias=paddle.to_tensor(ob),
+                nonbatched_bias=paddle.to_tensor(nb_bias),
+                attn_mask=paddle.to_tensor(mask),
+                has_gating=has_gating, merge_qkv=False)
+            np.testing.assert_allclose(np.asarray(got_u.value), want,
+                                       rtol=1e-9, atol=1e-10)
+
+            # merged form: self-attention (qkv from query) — oracle with
+            # m_data == q_data; qkv_weight [3, H, D, A] stacks transposes
+            want_m = self._oracle(x, x, qw, kw, vw, gw, gb, ow, ob,
+                                  nb_bias[..., :Q], mask[..., :Q],
+                                  has_gating)
+            qkv_w = np.stack([np.transpose(w, (1, 2, 0))
+                              for w in (qw, kw, vw)])
+            got_m = IF.fused_gate_attention(
+                paddle.to_tensor(x), qkv_weight=paddle.to_tensor(qkv_w),
+                gate_linear_weight=paddle.to_tensor(gw),
+                gate_linear_bias=paddle.to_tensor(gb),
+                out_linear_weight=paddle.to_tensor(ow),
+                out_linear_bias=paddle.to_tensor(ob),
+                nonbatched_bias=paddle.to_tensor(nb_bias[..., :Q]),
+                attn_mask=paddle.to_tensor(mask[..., :Q]),
+                has_gating=has_gating, merge_qkv=True)
+            np.testing.assert_allclose(np.asarray(got_m.value), want_m,
+                                       rtol=1e-9, atol=1e-10)
+
+    def test_merged_with_key_rejected(self):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        x = paddle.to_tensor(np.zeros((1, 1, 2, 4), "float32"))
+        with pytest.raises(ValueError, match="self-attention only"):
+            IF.fused_gate_attention(x, key=x,
+                                    qkv_weight=paddle.to_tensor(
+                                        np.zeros((3, 2, 2, 4), "float32")))

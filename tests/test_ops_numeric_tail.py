@@ -915,6 +915,9 @@ WAIVERS = {
     "gumbel_softmax_inner": "random gumbel noise; tested in test_nn",
     "gamma": "random sampling op (distribution tests cover moments)",
     "fused_dropout_add": "random mask; composition tested in test_models",
+    "fused_gate_attention": "10-input einsum composite; fp64 oracle parity "
+                            "(merged/unmerged, gating, both biases) in "
+                            "test_fused_functional.TestFusedGateAttention",
     # decompositions: outputs unique only up to sign/permutation — direct
     # numpy comparison is ill-posed; reconstruction tests live in
     # test_misc_kits linalg
