@@ -491,6 +491,19 @@ class Poisson(Distribution):
         return (value * ops.log(self.rate) - self.rate
                 - ops.lgamma(value + 1.0))
 
+    def entropy(self):
+        """reference poisson.py:141 — -sum p log p over a bounded support
+        approximation: mean + 30 sigma by the normal view (s_max = sqrt(max
+        rate), floored at 1), zero-rate entries masked to 0."""
+        rate = np.asarray(self.rate.value)
+        s_max = float(np.sqrt(rate.max())) if rate.max() >= 1.0 else 1.0
+        upper = int(rate.max() + 30.0 * s_max)
+        values = jnp.arange(0, max(upper, 1), dtype=self.rate.value.dtype)
+        values = values.reshape((-1,) + (1,) * len(self.batch_shape))
+        lp = self.log_prob(Tensor(values)).value
+        proposed = -(jnp.exp(lp) * lp).sum(0)
+        return Tensor(jnp.where(self.rate.value != 0, proposed, 0.0))
+
 
 class Binomial(Distribution):
     """binomial.py Binomial(total_count, probs)."""
@@ -529,14 +542,24 @@ class Binomial(Distribution):
 
 
 class Categorical(Distribution):
-    """categorical.py Categorical(logits) — NOTE the reference's ctor takes
-    LOGITS (unnormalized log probabilities)."""
+    """categorical.py Categorical(logits).
+
+    The reference uses TWO interpretations of ``logits`` in one class, and
+    this build mirrors both faithfully: ``probs``/``log_prob`` normalize
+    the RAW values (categorical.py:148 ``self._prob = logits / sum``, i.e.
+    logits are unnormalized probabilities), while ``entropy``/``kl_divergence``
+    /``sample`` work in SOFTMAX space (categorical.py:252/292 use
+    ``exp(logits)/sum(exp(logits))``). Construct with positive unnormalized
+    weights for the probability queries."""
 
     def __init__(self, logits, name=None):
         self.logits = _t(logits)
         from ..nn import functional as F
 
+        # softmax space: entropy / kl / sampling (reference :252, :292)
         self.probs = F.softmax(self.logits, axis=-1)
+        # raw normalization: prob/log_prob of a category (reference :148)
+        self._prob = self.logits / self.logits.sum(-1, keepdim=True)
         super().__init__(tuple(self.logits.shape[:-1]))
 
     def _sample(self, shape=()):
@@ -546,7 +569,7 @@ class Categorical(Distribution):
 
     def log_prob(self, value):
         value = _t(value).astype("int64")
-        logp = ops.log(self.probs)
+        logp = ops.log(self._prob)
         if len(self.batch_shape) == 0:
             return ops.gather(logp, value, axis=0)
         return ops.take_along_axis(
@@ -590,9 +613,12 @@ class Multinomial(Distribution):
     def log_prob(self, value):
         value = _t(value)
         logp = (value * ops.log(self.probs)).sum(-1)
-        n = float(self.total_count)
-        return (ops.lgamma(_t(n + 1.0)) - ops.lgamma(value + 1.0).sum(-1)
-                + logp)
+        # the lgamma(n+1) constant stays a python float on the RIGHT of a
+        # Tensor op: jnp weak typing keeps it exact in f64 expressions,
+        # whereas a left-operand float (or _t()) would coerce through the
+        # default float32 and poison f64 log-probs
+        return (logp - ops.lgamma(value + 1.0).sum(-1)
+                + math.lgamma(self.total_count + 1.0))
 
 
 class ContinuousBernoulli(Distribution):

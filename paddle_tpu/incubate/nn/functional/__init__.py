@@ -268,6 +268,22 @@ def fused_gate_attention(query, key=None, query_weight=None, key_weight=None,
             "fused_gate_attention: merge_qkv=True is self-attention only "
             "(qkv projected from `query`); pass merge_qkv=False with "
             "query/key/value weights for cross-attention over `key`")
+    if merge_qkv and qkv_weight is None:
+        raise ValueError(
+            "fused_gate_attention: merge_qkv=True needs qkv_weight "
+            "([3, num_heads, head_dim, q_dim])")
+    if not merge_qkv and (query_weight is None or key_weight is None
+                          or value_weight is None):
+        raise ValueError(
+            "fused_gate_attention: merge_qkv=False needs query_weight, "
+            "key_weight and value_weight ([dim, num_heads, head_dim])")
+    if has_gating and gate_linear_weight is None:
+        raise ValueError(
+            "fused_gate_attention: has_gating=True needs "
+            "gate_linear_weight (pass has_gating=False to skip gating)")
+    if out_linear_weight is None:
+        raise ValueError("fused_gate_attention: out_linear_weight is "
+                         "required ([num_heads, head_dim, out_dim])")
     return _fused_gate_attention(
         query, key, query_weight, key_weight, value_weight, qkv_weight,
         gate_linear_weight, gate_linear_bias, out_linear_weight,

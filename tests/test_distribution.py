@@ -110,8 +110,10 @@ class TestLogProbVsScipy:
                                    st.dirichlet.logpdf(x, a), rtol=1e-5)
 
     def test_categorical(self):
-        logits = np.log(np.array([0.2, 0.3, 0.5], "float32"))
-        d = D.Categorical(paddle.to_tensor(logits))
+        # reference categorical.py:148: prob/log_prob normalize the RAW
+        # logits (unnormalized probabilities), NOT softmax
+        weights = np.array([2.0, 3.0, 5.0], "float32")
+        d = D.Categorical(paddle.to_tensor(weights))
         got = np.asarray(d.log_prob(paddle.to_tensor(
             np.array([0, 2], "int64"))).value)
         np.testing.assert_allclose(got, np.log([0.2, 0.5]), rtol=1e-5)
