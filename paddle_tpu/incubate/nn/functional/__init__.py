@@ -577,18 +577,12 @@ def fused_multi_transformer(
         **unused):
     """reference fused_transformer.py fused_multi_transformer — the whole
     decoder stack as one call: per layer, fused attention + fused FFN."""
-    # Semantically significant decode/rotary args must not be silently
+    # Semantically significant rotary/varlen args must not be silently
     # dropped: a GPT-NeoX-style caller passing rotary_embs would get wrong
     # numerics without any signal (advisor r4).
-    if cache_kvs is not None:
-        raise NotImplementedError(
-            "fused_multi_transformer with cache_kvs (decode loop) is not "
-            "provided; use models.llama_decode.LlamaDecodeEngine for cached "
-            "generation")
     for arg_name, arg in (("rotary_embs", rotary_embs),
                           ("pre_caches", pre_caches),
-                          ("seq_lens", seq_lens),
-                          ("time_step", time_step)):
+                          ("seq_lens", seq_lens)):
         if arg is not None:
             raise NotImplementedError(
                 f"fused_multi_transformer: {arg_name} is not supported by "
@@ -599,6 +593,29 @@ def fused_multi_transformer(
         raise TypeError(
             "fused_multi_transformer: unexpected keyword arguments "
             f"{sorted(unused)}")
+    if not trans_qkvw:
+        raise NotImplementedError(
+            "fused_multi_transformer: trans_qkvw=False ([E, 3, H, D] weight "
+            "layout) is not supported; pass the default transposed "
+            "[3, H, D, E] layout")
+    if cache_kvs is not None:
+        if attn_mask is not None:
+            raise NotImplementedError(
+                "fused_multi_transformer: attn_mask with cache_kvs is not "
+                "supported (the cached path masks by position only); for "
+                "padded batches use models.serving.ContinuousBatchingEngine "
+                "or left-trim the prompts")
+        return _fused_multi_transformer_cached(
+            x, ln_scales, ln_biases, qkv_weights, qkv_biases, linear_weights,
+            linear_biases, ffn_ln_scales, ffn_ln_biases, ffn1_weights,
+            ffn1_biases, ffn2_weights, ffn2_biases,
+            pre_layer_norm=pre_layer_norm, epsilon=epsilon,
+            cache_kvs=cache_kvs, time_step=time_step,
+            activation=activation)
+    if time_step is not None:
+        raise ValueError(
+            "fused_multi_transformer: time_step needs cache_kvs (the "
+            "preallocated [2, B, H, max_len, D] per-layer caches)")
     out = x
     for i in range(len(qkv_weights)):
         out = fused_multi_head_attention(
@@ -625,6 +642,102 @@ def fused_multi_transformer(
             activation=activation, ln1_epsilon=epsilon, ln2_epsilon=epsilon,
             pre_layer_norm=pre_layer_norm, training=training, mode=mode)
     return out
+
+
+def _fused_multi_transformer_cached(
+        x, ln_scales, ln_biases, qkv_weights, qkv_biases, linear_weights,
+        linear_biases, ffn_ln_scales, ffn_ln_biases, ffn1_weights,
+        ffn1_biases, ffn2_weights, ffn2_biases, pre_layer_norm, epsilon,
+        cache_kvs, time_step, activation):
+    """The reference's cached generation contract
+    (fused_multi_transformer_op.cu): per-layer PREALLOCATED caches
+    [2, B, H, max_len, D]; with ``time_step=None`` the call is the context/
+    prefill phase (writes positions 0..S-1, causal attention within the
+    prompt); with ``time_step=t`` it is one decode step (x is [B, 1, E],
+    K/V written at position t, attention over positions <= t). Returns
+    (out, updated_cache_kvs). Inference semantics: dropout off."""
+    from ....framework.core import Tensor as _T
+    from ....nn import functional as F
+    from ....ops import manipulation as m
+
+    def _v(t):
+        return t.value if isinstance(t, _T) else jnp.asarray(t)
+
+    xv = _v(x)
+    B, S, E = xv.shape
+    t0 = None if time_step is None else int(
+        np.asarray(_v(time_step)).reshape(-1)[0])
+    if t0 is not None and S != 1:
+        raise ValueError(
+            "fused_multi_transformer decode (time_step given) expects one "
+            f"token per call, got S={S}")
+    max_len = int(_v(cache_kvs[0]).shape[3])
+    start0 = 0 if t0 is None else t0
+    if start0 + S > max_len:
+        # dynamic_update_slice would silently CLAMP an out-of-range write,
+        # corrupting the last cache slot instead of failing
+        raise ValueError(
+            f"fused_multi_transformer: writing positions "
+            f"{start0}..{start0 + S - 1} overflows the preallocated cache "
+            f"(max_len={max_len}); allocate larger cache_kvs")
+
+    out = x
+    new_caches = []
+    for i in range(len(qkv_weights)):
+        residual = out
+        h = out
+        if pre_layer_norm:
+            h = F.layer_norm(h, [E], ln_scales[i] if ln_scales else None,
+                             ln_biases[i] if ln_biases else None, epsilon)
+        three, heads, head_dim, _ = (int(s) for s in qkv_weights[i].shape)
+        w = m.reshape(qkv_weights[i], [3 * E, E])
+        qkv = fused_matmul_bias(
+            h, w, None if not qkv_biases
+            else m.reshape(qkv_biases[i], [3 * E]), transpose_y=True)
+        qkv_v = _v(qkv).reshape(B, S, 3, heads, head_dim)
+        q, k, v = qkv_v[:, :, 0], qkv_v[:, :, 1], qkv_v[:, :, 2]
+
+        cv = _v(cache_kvs[i])                  # [2, B, H, max_len, D]
+        k_btxd = jnp.swapaxes(k, 1, 2)         # [B, H, S, D]
+        v_btxd = jnp.swapaxes(v, 1, 2)
+        start = 0 if t0 is None else t0
+        ck = jax.lax.dynamic_update_slice(cv[0], k_btxd.astype(cv.dtype),
+                                          (0, 0, start, 0))
+        cvv = jax.lax.dynamic_update_slice(cv[1], v_btxd.astype(cv.dtype),
+                                           (0, 0, start, 0))
+        new_caches.append(_T(jnp.stack([ck, cvv])))
+
+        # attention over the cache with a position mask (dense decode-engine
+        # semantics: static shapes, one compiled program per phase)
+        positions = start + jnp.arange(S)                       # query pos
+        tpos = jnp.arange(max_len)[None, None, :]
+        pos_mask = tpos <= positions[None, :, None]             # [1, S, T]
+        ct = jnp.promote_types(q.dtype, jnp.float32)
+        logits = jnp.einsum("bshd,bhtd->bhst", q.astype(ct),
+                            ck.astype(ct)) / np.sqrt(head_dim)
+        logits = jnp.where(pos_mask[:, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, -1)
+        attn = jnp.einsum("bhst,bhtd->bshd", probs, cvv.astype(ct))
+        attn = attn.reshape(B, S, heads * head_dim).astype(xv.dtype)
+
+        o = fused_matmul_bias(_T(attn), linear_weights[i],
+                              linear_biases[i] if linear_biases else None)
+        o = residual + o
+        if not pre_layer_norm:
+            o = F.layer_norm(o, [E], ln_scales[i] if ln_scales else None,
+                             ln_biases[i] if ln_biases else None, epsilon)
+        out = fused_feedforward(
+            o, ffn1_weights[i], ffn2_weights[i],
+            linear1_bias=ffn1_biases[i] if ffn1_biases else None,
+            linear2_bias=ffn2_biases[i] if ffn2_biases else None,
+            ln1_scale=ffn_ln_scales[i] if ffn_ln_scales else None,
+            ln1_bias=ffn_ln_biases[i] if ffn_ln_biases else None,
+            ln2_scale=ffn_ln_scales[i] if ffn_ln_scales else None,
+            ln2_bias=ffn_ln_biases[i] if ffn_ln_biases else None,
+            dropout1_rate=0.0, dropout2_rate=0.0, activation=activation,
+            ln1_epsilon=epsilon, ln2_epsilon=epsilon,
+            pre_layer_norm=pre_layer_norm, training=False)
+    return out, new_caches
 
 
 def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size=None,

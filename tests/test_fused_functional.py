@@ -81,15 +81,6 @@ class TestFusedFFNAndStack:
             [w["ffn1"]] * 2, None, [w["ffn2"]] * 2, None, dropout_rate=0.0)
         assert out.shape == [B, S, E]
 
-    def test_multi_transformer_cache_rejected(self):
-        w = _weights()
-        with pytest.raises(NotImplementedError, match="LlamaDecodeEngine"):
-            IF.fused_multi_transformer(
-                w["x"], [w["ln_s"]], [w["ln_b"]], [w["qkv_w"]], None,
-                [w["lin_w"]], None, [w["ln_s"]], [w["ln_b"]], [w["ffn1"]],
-                None, [w["ffn2"]], None, cache_kvs=[paddle.to_tensor(
-                    np.zeros((2, B, H, 4, D), "float32"))])
-
     def test_linear_activation_and_bias_dropout_residual_ln(self):
         w = _weights()
         h = IF.fused_linear_activation(w["x"], w["ffn1"], activation="relu")
@@ -244,3 +235,92 @@ class TestFusedGateAttention:
             IF.fused_gate_attention(x, key=x,
                                     qkv_weight=paddle.to_tensor(
                                         np.zeros((3, 2, 2, 4), "float32")))
+
+
+class TestFusedMultiTransformerCached:
+    """The cached generation contract (reference fused_multi_transformer
+    with cache_kvs/time_step): prefill + per-token decode over preallocated
+    [2, B, H, max_len, D] caches must reproduce the uncached causal run."""
+
+    def _weights(self, L, E, H, seed=0):
+        r = np.random.RandomState(seed)
+        D = E // H
+
+        def t(*s):
+            return paddle.to_tensor(r.randn(*s).astype("float32") * 0.3)
+
+        return dict(
+            ln_scales=[t(E) for _ in range(L)],
+            ln_biases=[t(E) for _ in range(L)],
+            qkv_weights=[t(3, H, D, E) for _ in range(L)],
+            qkv_biases=[t(3, H, D) for _ in range(L)],
+            linear_weights=[t(E, E) for _ in range(L)],
+            linear_biases=[t(E) for _ in range(L)],
+            ffn_ln_scales=[t(E) for _ in range(L)],
+            ffn_ln_biases=[t(E) for _ in range(L)],
+            ffn1_weights=[t(E, 2 * E) for _ in range(L)],
+            ffn1_biases=[t(2 * E) for _ in range(L)],
+            ffn2_weights=[t(2 * E, E) for _ in range(L)],
+            ffn2_biases=[t(E) for _ in range(L)])
+
+    def test_prefill_decode_matches_uncached_causal(self):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        L, B, E, H = 2, 2, 16, 4
+        D = E // H
+        S, T = 5, 3
+        w = self._weights(L, E, H)
+        r = np.random.RandomState(1)
+        x_full = r.randn(B, S + T, E).astype("float32")
+
+        # uncached causal run over the full sequence (additive mask)
+        causal = np.where(
+            np.tril(np.ones((S + T, S + T), bool)), 0.0, -1e9
+        ).astype("float32")[None, None]
+        want = IF.fused_multi_transformer(
+            paddle.to_tensor(x_full), pre_layer_norm=True,
+            attn_mask=paddle.to_tensor(causal), dropout_rate=0.0,
+            training=False, **w)
+        want = np.asarray(want.value)
+
+        # cached: prefill S tokens, then 3 single-token decode steps
+        caches = [paddle.to_tensor(np.zeros((2, B, H, S + T, D), "float32"))
+                  for _ in range(L)]
+        out_p, caches = IF.fused_multi_transformer(
+            paddle.to_tensor(x_full[:, :S]), pre_layer_norm=True,
+            cache_kvs=caches, dropout_rate=0.0, training=False, **w)
+        np.testing.assert_allclose(np.asarray(out_p.value), want[:, :S],
+                                   rtol=2e-5, atol=2e-5)
+        for step in range(T):
+            out_d, caches = IF.fused_multi_transformer(
+                paddle.to_tensor(x_full[:, S + step:S + step + 1]),
+                pre_layer_norm=True, cache_kvs=caches,
+                time_step=paddle.to_tensor(np.array([S + step], "int32")),
+                dropout_rate=0.0, training=False, **w)
+            np.testing.assert_allclose(
+                np.asarray(out_d.value)[:, 0], want[:, S + step],
+                rtol=2e-5, atol=2e-5, err_msg=f"decode step {step}")
+
+    def test_time_step_without_cache_raises(self):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        w = self._weights(1, 8, 2)
+        with pytest.raises(ValueError, match="time_step needs cache_kvs"):
+            IF.fused_multi_transformer(
+                paddle.to_tensor(np.zeros((1, 1, 8), "float32")),
+                time_step=paddle.to_tensor(np.array([3], "int32")), **w)
+
+    def test_cache_overflow_and_mask_rejected(self):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        w = self._weights(1, 8, 2)
+        cache = [paddle.to_tensor(np.zeros((2, 1, 2, 4, 4), "float32"))]
+        x = paddle.to_tensor(np.zeros((1, 5, 8), "float32"))  # 5 > max_len 4
+        with pytest.raises(ValueError, match="overflows the preallocated"):
+            IF.fused_multi_transformer(x, cache_kvs=cache, **w)
+        with pytest.raises(NotImplementedError, match="attn_mask with"):
+            IF.fused_multi_transformer(
+                paddle.to_tensor(np.zeros((1, 2, 8), "float32")),
+                cache_kvs=cache,
+                attn_mask=paddle.to_tensor(np.zeros((1, 1, 2, 2),
+                                                    "float32")), **w)
