@@ -605,6 +605,11 @@ def fused_multi_transformer(
                 "supported (the cached path masks by position only); for "
                 "padded batches use models.serving.ContinuousBatchingEngine "
                 "or left-trim the prompts")
+        if training or dropout_rate:
+            raise ValueError(
+                "fused_multi_transformer: the cached path is inference-only "
+                "(pass training=False, dropout_rate=0.0) — silently "
+                "dropping dropout would diverge from the uncached path")
         return _fused_multi_transformer_cached(
             x, ln_scales, ln_biases, qkv_weights, qkv_biases, linear_weights,
             linear_biases, ffn_ln_scales, ffn_ln_biases, ffn1_weights,
@@ -767,13 +772,21 @@ def masked_multihead_attention(
     from ....framework.core import Tensor
 
     # Reject (rather than silently ignore) args that change the attention
-    # result: rotary embedding, masking, and the int8 quantization contract
-    # (advisor r4 — mirrors the existing explicit rejections below).
-    if rotary_tensor is not None or rotary_emb_dims:
+    # result: masking and the int8 quantization contract (advisor r4 —
+    # mirrors the existing explicit rejections below).
+    if rotary_emb_dims not in (0, 1):
         raise NotImplementedError(
-            "masked_multihead_attention: rotary_tensor/rotary_emb_dims are "
-            "not supported; apply rotary embeddings to q/k before the call "
-            "(see models/llama.py apply_rotary)")
+            "masked_multihead_attention: rotary_emb_dims=2 (extra position "
+            "ids) is not supported; the standard rotary_emb_dims=1 form is")
+    if rotary_tensor is None and rotary_emb_dims:
+        raise ValueError(
+            "masked_multihead_attention: rotary_emb_dims=1 needs "
+            "rotary_tensor ([2, B, max_seq, 1, head_dim] cos/sin tables)")
+    if rotary_tensor is not None and not rotary_emb_dims:
+        raise ValueError(
+            "masked_multihead_attention: rotary_tensor given but "
+            "rotary_emb_dims=0 (the reference kernel gates rotation on "
+            "rotary_emb_dims; pass rotary_emb_dims=1)")
     if src_mask is not None:
         raise NotImplementedError(
             "masked_multihead_attention: src_mask is not supported; decode "
@@ -810,6 +823,32 @@ def masked_multihead_attention(
           else jnp.asarray(sequence_lengths)).reshape(-1)
     pos = sl.astype(jnp.int32)                        # write position per row
     bidx = jnp.arange(B)
+    if rotary_tensor is not None and rotary_emb_dims:
+        # reference mmha_util.cu.h:46: rotary_emb [2, B, max_seq, 1, D]
+        # (cos at [0], sin at [1]); the kernel reads the row's CURRENT
+        # position and rotates q and k with the same tables. The default
+        # (use_neox_rotary_style=False) is the interleaved pairs-of-two
+        # pairing; neox is the half-split pairing.
+        rv = rotary_tensor.value if isinstance(rotary_tensor, Tensor) \
+            else jnp.asarray(rotary_tensor)
+        max_rot = int(rv.shape[2])
+        if int(np.asarray(sl).max()) >= max_rot:
+            # the gather would silently CLAMP to the last table row and
+            # reuse its cos/sin for every later step
+            raise ValueError(
+                f"masked_multihead_attention: position "
+                f"{int(np.asarray(sl).max())} exceeds the rotary table "
+                f"(max_seq={max_rot}); build larger rotary_tensor tables")
+        cos = rv[0][bidx, pos, 0].astype(q.dtype)[:, None, :]  # (B, 1, D)
+        sin = rv[1][bidx, pos, 0].astype(q.dtype)[:, None, :]
+
+        def _rot(t):
+            rot = (_rotate_half(t) if use_neox_rotary_style
+                   else _rotate_every_two(t))
+            return t * cos + rot * sin
+
+        q = _rot(q)
+        k = _rot(k)
     ck = cv[0].at[bidx, :, pos].set(k)
     cvv = cv[1].at[bidx, :, pos].set(v)
     t = jnp.arange(T)[None, None, :]

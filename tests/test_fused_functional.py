@@ -324,3 +324,88 @@ class TestFusedMultiTransformerCached:
                 cache_kvs=cache,
                 attn_mask=paddle.to_tensor(np.zeros((1, 1, 2, 2),
                                                     "float32")), **w)
+
+
+class TestMaskedMHARotary:
+    """masked_multihead_attention rotary path (reference mmha_util.cu.h:46:
+    rotary_emb [2, B, max_seq, 1, D] cos/sin read at the row's position):
+    must equal pre-rotating q/k by hand and calling the non-rotary path."""
+
+    def _run(self, neox):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        r = np.random.RandomState(0)
+        B, H, T, D = 2, 2, 8, 8
+        x = r.randn(B, 3 * H * D).astype("float32")
+        cache = r.randn(2, B, H, T, D).astype("float32")
+        seq_lens = np.array([3, 5], np.int32)
+
+        # rope tables over max_seq positions
+        inv = 1.0 / (10000.0 ** (np.arange(0, D, 2) / D))
+        tpos = np.arange(T)[:, None] * inv[None, :]
+        if neox:
+            emb = np.concatenate([tpos, tpos], -1)      # half-split pairing
+        else:
+            emb = np.repeat(tpos, 2, axis=-1)           # interleaved pairing
+        rot = np.stack([np.broadcast_to(np.cos(emb), (B, T, D)),
+                        np.broadcast_to(np.sin(emb), (B, T, D))])
+        rot = rot[:, :, :, None, :].transpose(0, 1, 2, 3, 4)  # [2,B,T,1,D]
+        rot = rot.reshape(2, B, T, 1, D).astype("float32")
+
+        got, got_cache = IF.masked_multihead_attention(
+            paddle.to_tensor(x), cache_kv=paddle.to_tensor(cache),
+            sequence_lengths=paddle.to_tensor(seq_lens),
+            rotary_tensor=paddle.to_tensor(rot), rotary_emb_dims=1,
+            use_neox_rotary_style=neox)
+
+        # oracle: rotate q/k by hand at each row's position, then the plain
+        # non-rotary call
+        def rotate(t, cos, sin):
+            if neox:
+                half = D // 2
+                r2 = np.concatenate([-t[..., half:], t[..., :half]], -1)
+            else:
+                r2 = np.stack([-t[..., 1::2], t[..., ::2]],
+                              -1).reshape(t.shape)
+            return t * cos + r2 * sin
+
+        xq = x.reshape(B, 3, H, D).copy()
+        for b in range(B):
+            cos = np.cos(emb)[seq_lens[b]]
+            sin = np.sin(emb)[seq_lens[b]]
+            xq[b, 0] = rotate(xq[b, 0], cos, sin)
+            xq[b, 1] = rotate(xq[b, 1], cos, sin)
+        want, want_cache = IF.masked_multihead_attention(
+            paddle.to_tensor(xq.reshape(B, 3 * H * D)),
+            cache_kv=paddle.to_tensor(cache),
+            sequence_lengths=paddle.to_tensor(seq_lens))
+        np.testing.assert_allclose(np.asarray(got.value),
+                                   np.asarray(want.value), rtol=1e-5,
+                                   atol=1e-6)
+        np.testing.assert_allclose(np.asarray(got_cache.value),
+                                   np.asarray(want_cache.value), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_interleaved_default_style(self):
+        self._run(neox=False)
+
+    def test_neox_half_split_style(self):
+        self._run(neox=True)
+
+    def test_rotary_dims_validation(self):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        with pytest.raises(NotImplementedError, match="rotary_emb_dims=2"):
+            IF.masked_multihead_attention(
+                paddle.to_tensor(np.zeros((1, 48), "float32")),
+                cache_kv=paddle.to_tensor(np.zeros((2, 1, 2, 4, 8),
+                                                   "float32")),
+                sequence_lengths=paddle.to_tensor(np.zeros(1, "int32")),
+                rotary_emb_dims=2)
+        with pytest.raises(ValueError, match="needs\\s+rotary_tensor"):
+            IF.masked_multihead_attention(
+                paddle.to_tensor(np.zeros((1, 48), "float32")),
+                cache_kv=paddle.to_tensor(np.zeros((2, 1, 2, 4, 8),
+                                                   "float32")),
+                sequence_lengths=paddle.to_tensor(np.zeros(1, "int32")),
+                rotary_emb_dims=1)
