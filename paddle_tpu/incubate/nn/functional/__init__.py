@@ -605,11 +605,15 @@ def fused_multi_transformer(
                 "supported (the cached path masks by position only); for "
                 "padded batches use models.serving.ContinuousBatchingEngine "
                 "or left-trim the prompts")
-        if training or dropout_rate:
+        if training or (dropout_rate and mode == "downscale_in_infer"):
+            # dropout_rate with training=False under the default
+            # upscale_in_train mode is a no-op in the uncached path too, so
+            # it is allowed; only combinations that would actually change
+            # inference numerics are rejected
             raise ValueError(
                 "fused_multi_transformer: the cached path is inference-only "
-                "(pass training=False, dropout_rate=0.0) — silently "
-                "dropping dropout would diverge from the uncached path")
+                "(training=False; downscale_in_infer dropout would change "
+                "eval numerics and is not supported with cache_kvs)")
         return _fused_multi_transformer_cached(
             x, ln_scales, ln_biases, qkv_weights, qkv_biases, linear_weights,
             linear_biases, ffn_ln_scales, ffn_ln_biases, ffn1_weights,
@@ -787,10 +791,6 @@ def masked_multihead_attention(
             "masked_multihead_attention: rotary_tensor given but "
             "rotary_emb_dims=0 (the reference kernel gates rotation on "
             "rotary_emb_dims; pass rotary_emb_dims=1)")
-    if src_mask is not None:
-        raise NotImplementedError(
-            "masked_multihead_attention: src_mask is not supported; decode "
-            "masking here is the causal write-position mask only")
     if beam_cache_offset is not None:
         raise NotImplementedError(
             "masked_multihead_attention: beam_cache_offset (beam-search KV "
@@ -822,6 +822,13 @@ def masked_multihead_attention(
     sl = (sequence_lengths.value if isinstance(sequence_lengths, Tensor)
           else jnp.asarray(sequence_lengths)).reshape(-1)
     pos = sl.astype(jnp.int32)                        # write position per row
+    if int(np.asarray(sl).max()) >= T:
+        # the scatter would silently drop/clamp the write while the causal
+        # mask opens the whole cache — plausible-but-wrong logits
+        raise ValueError(
+            f"masked_multihead_attention: write position "
+            f"{int(np.asarray(sl).max())} exceeds the cache "
+            f"(T={T}); allocate a longer cache_kv")
     bidx = jnp.arange(B)
     if rotary_tensor is not None and rotary_emb_dims:
         # reference mmha_util.cu.h:46: rotary_emb [2, B, max_seq, 1, D]
@@ -854,7 +861,19 @@ def masked_multihead_attention(
     t = jnp.arange(T)[None, None, :]
     mask = t <= pos[:, None, None]                    # (B, 1, T)
     logits = jnp.einsum("bhd,bhtd->bht", q, ck) / jnp.sqrt(jnp.asarray(D, jnp.float32)).astype(q.dtype)
-    logits = jnp.where(mask, logits.astype(jnp.float32), -1e30)
+    logits = logits.astype(jnp.float32)
+    if src_mask is not None:
+        # reference kernel: qk += mask (additive, [B, 1, 1, T] broadcast
+        # over heads — masked_multihead_attention_kernel.cu:385)
+        sm = src_mask.value if isinstance(src_mask, Tensor) \
+            else jnp.asarray(src_mask)
+        if sm.shape[-1] != T or sm.shape[0] not in (1, B):
+            raise ValueError(
+                "masked_multihead_attention: src_mask must be "
+                f"[B|1, 1, 1, T] with T={T} (the cache length); got "
+                f"{tuple(sm.shape)}")
+        logits = logits + sm.reshape(sm.shape[0], 1, T).astype(jnp.float32)
+    logits = jnp.where(mask, logits, -1e30)
     probs = jax.nn.softmax(logits, -1).astype(q.dtype)
     out = jnp.einsum("bht,bhtd->bhd", probs, cvv).reshape(B, H * D)
     return Tensor(out), Tensor(jnp.stack([ck, cvv]))

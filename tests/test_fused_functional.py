@@ -349,8 +349,7 @@ class TestMaskedMHARotary:
             emb = np.repeat(tpos, 2, axis=-1)           # interleaved pairing
         rot = np.stack([np.broadcast_to(np.cos(emb), (B, T, D)),
                         np.broadcast_to(np.sin(emb), (B, T, D))])
-        rot = rot[:, :, :, None, :].transpose(0, 1, 2, 3, 4)  # [2,B,T,1,D]
-        rot = rot.reshape(2, B, T, 1, D).astype("float32")
+        rot = rot[:, :, :, None, :].astype("float32")   # [2, B, T, 1, D]
 
         got, got_cache = IF.masked_multihead_attention(
             paddle.to_tensor(x), cache_kv=paddle.to_tensor(cache),
@@ -409,3 +408,41 @@ class TestMaskedMHARotary:
                                                    "float32")),
                 sequence_lengths=paddle.to_tensor(np.zeros(1, "int32")),
                 rotary_emb_dims=1)
+
+    def test_src_mask_additive(self):
+        """src_mask is ADDITIVE on the scores, broadcast over heads
+        (reference masked_multihead_attention_kernel.cu:385 qk += mask):
+        a -1e9 at a position must zero its attention weight."""
+        import paddle_tpu.incubate.nn.functional as IF
+
+        r = np.random.RandomState(1)
+        B, H, T, D = 2, 2, 6, 8
+        x = r.randn(B, 3 * H * D).astype("float32")
+        cache = r.randn(2, B, H, T, D).astype("float32")
+        seq_lens = np.array([4, 4], np.int32)
+        sm = np.zeros((B, 1, 1, T), "float32")
+        sm[:, :, :, 1] = -1e9                         # forbid position 1
+
+        got, _ = IF.masked_multihead_attention(
+            paddle.to_tensor(x), cache_kv=paddle.to_tensor(cache),
+            sequence_lengths=paddle.to_tensor(seq_lens),
+            src_mask=paddle.to_tensor(sm))
+        # oracle: plain call on a cache whose position-1 K is pushed to
+        # -inf attention by recomputing probabilities manually
+        xq = x.reshape(B, 3, H, D)
+        q, k, v = xq[:, 0], xq[:, 1], xq[:, 2]
+        ck = cache[0].copy()
+        cv = cache[1].copy()
+        for b in range(B):
+            ck[b, :, seq_lens[b]] = k[b]
+            cv[b, :, seq_lens[b]] = v[b]
+        want = np.zeros((B, H, D))
+        for b in range(B):
+            for h in range(H):
+                lg = (ck[b, h] @ q[b, h]) / np.sqrt(D)
+                lg = lg + sm[b, 0, 0]
+                lg[seq_lens[b] + 1:] = -np.inf
+                w = np.exp(lg - lg.max()); w /= w.sum()
+                want[b, h] = w @ cv[b, h]
+        np.testing.assert_allclose(np.asarray(got.value).reshape(B, H, D),
+                                   want, rtol=1e-5, atol=1e-6)
