@@ -248,7 +248,43 @@ class FusedMultiTransformer(Layer):
                 normalize_before=True)
             for _ in range(num_layers)])
 
-    def forward(self, src, attn_mask=None, caches=None, **kwargs):
+    def forward(self, src, attn_mask=None, caches=None, time_step=None,
+                **kwargs):
+        if time_step is not None and caches is None:
+            raise ValueError(
+                "FusedMultiTransformer: time_step needs caches (the "
+                "preallocated [2, B, H, max_len, D] per-layer cache list)")
+        if caches is not None:
+            # cached generation rides the functional's cache_kvs/time_step
+            # contract (preallocated [2, B, H, max_len, D] per layer);
+            # returns (out, updated_caches) like the reference layer
+            lyrs = list(self.layers)
+            biases = [l.fused_attn.qkv_bias for l in lyrs]
+            if any(b is None for b in biases) \
+                    and any(b is not None for b in biases):
+                raise ValueError(
+                    "FusedMultiTransformer cached forward: mixed per-layer "
+                    "qkv biases (some None, some parameters) cannot be "
+                    "represented by the functional's list-or-None contract")
+            out, caches = IF.fused_multi_transformer(
+                src,
+                ln_scales=[l.fused_attn.pre_ln_scale for l in lyrs],
+                ln_biases=[l.fused_attn.pre_ln_bias for l in lyrs],
+                qkv_weights=[l.fused_attn.qkv_weight for l in lyrs],
+                qkv_biases=(biases if all(b is not None for b in biases)
+                            else None),
+                linear_weights=[l.fused_attn.linear_weight for l in lyrs],
+                linear_biases=[l.fused_attn.linear_bias for l in lyrs],
+                ffn_ln_scales=[l.ffn.ln1_scale for l in lyrs],
+                ffn_ln_biases=[l.ffn.ln1_bias for l in lyrs],
+                ffn1_weights=[l.ffn.linear1.weight for l in lyrs],
+                ffn1_biases=[l.ffn.linear1.bias for l in lyrs],
+                ffn2_weights=[l.ffn.linear2.weight for l in lyrs],
+                ffn2_biases=[l.ffn.linear2.bias for l in lyrs],
+                pre_layer_norm=True, cache_kvs=caches, time_step=time_step,
+                attn_mask=attn_mask, dropout_rate=0.0, training=False,
+                activation=lyrs[0].ffn.activation)
+            return out, caches
         out = src
         for lyr in self.layers:
             out = lyr(out, src_mask=attn_mask)

@@ -109,3 +109,42 @@ class TestFusedEncoderAndStack:
         np.testing.assert_allclose(
             lin(x).numpy(),
             x.numpy() @ lin.weight.numpy().T + lin.bias.numpy(), rtol=1e-5)
+
+
+class TestFusedMultiTransformerCachedLayer:
+    """The layer's cached forward (caches/time_step, reference
+    fused_transformer.py:900 generation contract) must reproduce the
+    layer's own uncached causal run."""
+
+    def test_layer_prefill_decode_matches_uncached(self):
+        import paddle_tpu.incubate.nn as inn
+
+        paddle.seed(0)
+        L, B, E, H, FF = 2, 2, 16, 4, 32
+        D = E // H
+        S, T = 4, 3
+        m = inn.FusedMultiTransformer(E, H, FF, dropout_rate=0.0,
+                                      activation="gelu", num_layers=L)
+        m.eval()
+        r = np.random.RandomState(2)
+        x = r.randn(B, S + T, E).astype("float32")
+
+        causal = np.where(np.tril(np.ones((S + T, S + T), bool)),
+                          0.0, -1e9).astype("float32")[None, None]
+        want = np.asarray(
+            m(paddle.to_tensor(x),
+              attn_mask=paddle.to_tensor(causal)).value)
+
+        caches = [paddle.to_tensor(np.zeros((2, B, H, S + T, D), "float32"))
+                  for _ in range(L)]
+        out, caches = m(paddle.to_tensor(x[:, :S]), caches=caches)
+        np.testing.assert_allclose(np.asarray(out.value), want[:, :S],
+                                   rtol=2e-5, atol=2e-5)
+        for step in range(T):
+            out, caches = m(
+                paddle.to_tensor(x[:, S + step:S + step + 1]),
+                caches=caches,
+                time_step=paddle.to_tensor(np.array([S + step], "int32")))
+            np.testing.assert_allclose(
+                np.asarray(out.value)[:, 0], want[:, S + step],
+                rtol=2e-5, atol=2e-5, err_msg=f"step {step}")
