@@ -135,11 +135,11 @@ ALIASES = {
     "fused_moe": "incubate.distributed.models.moe.MoELayer dispatch einsums",
     "moe_combine": "MoE combine einsum (moe_layer.py)",
     "moe_dispatch": "MoE dispatch einsum (moe_layer.py)",
-    "fused_multi_transformer": "incubate.nn.FusedMultiTransformer",
+    "fused_multi_transformer": "incubate.nn.FusedMultiTransformer (incl. cache_kvs/time_step cached generation)",
     "fp8_fp8_half_gemm_fused": "quantization weight-only int8/fp8 matmul",
     "blha_get_max_len": "models.llama_decode KV cache bookkeeping",
     "block_multihead_attention_": "incubate.nn.functional.block_multihead_attention over models/paged_kv.py (block-table pool, prefill+decode)",
-    "masked_multihead_attention_": "models.llama_decode decode attention",
+    "masked_multihead_attention_": "incubate...masked_multihead_attention (rotary + src_mask + growing cache) / models.llama_decode",
     "qkv_unpack_mha": "flash_attention unpacked path",
     "resnet_basic_block": "paddle.vision.models.resnet BasicBlock (XLA fuses)",
     "resnet_unit": "paddle.vision.models.resnet unit (XLA fuses)",
