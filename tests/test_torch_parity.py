@@ -522,3 +522,54 @@ class TestConvNdAndBatchNormStats:
         np.testing.assert_allclose(
             pm(paddle.to_tensor(x)).numpy(),
             tm(torch.from_numpy(x)).detach().numpy(), rtol=1e-9, atol=1e-9)
+
+
+@pytest.mark.slow
+def test_activation_functions_match_torch():
+    """One sweep over the activation zoo vs torch.nn.functional (fp64) —
+    the OpCases compare against our own numpy refs, so an independent
+    oracle closes the self-reference loop (hard* breakpoints, selu/celu
+    constants, softplus threshold, mish/tanhshrink compositions)."""
+    import torch
+    import torch.nn.functional as TF
+
+    import paddle_tpu.nn.functional as F
+
+    x = np.random.RandomState(0).randn(4, 7) * 3.0
+    px, tx = paddle.to_tensor(x), torch.from_numpy(x)
+
+    cases = [
+        ("relu", F.relu, TF.relu, {}, {}),
+        ("relu6", F.relu6, TF.relu6, {}, {}),
+        ("elu", F.elu, TF.elu, {"alpha": 0.7}, {"alpha": 0.7}),
+        ("celu", F.celu, TF.celu, {"alpha": 1.3}, {"alpha": 1.3}),
+        ("selu", F.selu, TF.selu, {}, {}),
+        ("gelu", F.gelu, TF.gelu, {}, {}),
+        ("gelu_tanh", F.gelu, TF.gelu, {"approximate": True},
+         {"approximate": "tanh"}),
+        ("silu", F.silu, TF.silu, {}, {}),
+        ("mish", F.mish, TF.mish, {}, {}),
+        ("softplus", F.softplus, TF.softplus,
+         {"beta": 2.0, "threshold": 15.0}, {"beta": 2.0, "threshold": 15.0}),
+        ("softsign", F.softsign, TF.softsign, {}, {}),
+        ("tanhshrink", F.tanhshrink, TF.tanhshrink, {}, {}),
+        ("softshrink", F.softshrink, TF.softshrink,
+         {"threshold": 0.4}, {"lambd": 0.4}),
+        ("hardshrink", F.hardshrink, TF.hardshrink,
+         {"threshold": 0.4}, {"lambd": 0.4}),
+        ("hardtanh", F.hardtanh, TF.hardtanh,
+         {"min": -0.8, "max": 1.2}, {"min_val": -0.8, "max_val": 1.2}),
+        ("hardsigmoid", F.hardsigmoid, TF.hardsigmoid, {}, {}),
+        ("hardswish", F.hardswish, TF.hardswish, {}, {}),
+        ("leaky_relu", F.leaky_relu, TF.leaky_relu,
+         {"negative_slope": 0.15}, {"negative_slope": 0.15}),
+        ("log_sigmoid", F.log_sigmoid, TF.logsigmoid, {}, {}),
+        ("softmax", F.softmax, TF.softmax, {"axis": -1}, {"dim": -1}),
+        ("log_softmax", F.log_softmax, TF.log_softmax,
+         {"axis": -1}, {"dim": -1}),
+    ]
+    for name, pf, tf_, pkw, tkw in cases:
+        got = np.asarray(pf(px, **pkw).value)
+        want = tf_(tx, **tkw).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-12,
+                                   err_msg=name)
