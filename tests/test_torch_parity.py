@@ -573,3 +573,73 @@ def test_activation_functions_match_torch():
         want = tf_(tx, **tkw).numpy()
         np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-12,
                                    err_msg=name)
+
+
+@pytest.mark.slow
+def test_einsum_notation_sweep_vs_numpy():
+    """Einsum notation semantics vs numpy (fp64): implicit output ordering,
+    trace/diagonal, ellipsis broadcast, multi-operand contractions — the
+    notation handling itself is the bug surface, not the matmuls."""
+    r = np.random.RandomState(0)
+    a2 = r.randn(3, 4)
+    b2 = r.randn(4, 5)
+    sq = r.randn(4, 4)
+    a3 = r.randn(2, 3, 4)
+    b3 = r.randn(2, 4, 5)
+    v = r.randn(4)
+
+    cases = [
+        ("ij,jk->ik", (a2, b2)),
+        ("ij,jk", (a2, b2)),               # implicit output
+        ("ij->ji", (a2,)),
+        ("ii->", (sq,)),                   # trace
+        ("ii->i", (sq,)),                  # diagonal
+        ("ij->", (a2,)),                   # full sum
+        ("ij->j", (a2,)),
+        ("...ij,...jk->...ik", (a3, b3)),  # ellipsis batch
+        ("bij,bjk->bik", (a3, b3)),
+        ("ij,j->i", (a2, v)),
+        ("i,j->ij", (v, r.randn(3))),      # outer
+        ("ijk,ikl,lm->ijm", (a3, b3, r.randn(5, 6))),  # 3 operands
+    ]
+    for eq, ops_np in cases:
+        want = np.einsum(eq, *ops_np)
+        got = paddle.einsum(eq, *[paddle.to_tensor(o) for o in ops_np])
+        np.testing.assert_allclose(np.asarray(got.value), want,
+                                   rtol=1e-10, atol=1e-12, err_msg=eq)
+
+
+@pytest.mark.slow
+def test_linalg_solvers_vs_numpy():
+    """lstsq/pinv/slogdet/matrix_power/matrix_rank vs numpy (fp64,
+    batched where the reference API is batched)."""
+    r = np.random.RandomState(1)
+    A = r.randn(6, 4)
+    b = r.randn(6, 2)
+    sol = np.linalg.lstsq(A, b, rcond=None)[0]
+    got = paddle.linalg.lstsq(paddle.to_tensor(A), paddle.to_tensor(b))[0]
+    np.testing.assert_allclose(np.asarray(got.value), sol, rtol=1e-8,
+                               atol=1e-10)
+
+    M = r.randn(2, 5, 3)
+    np.testing.assert_allclose(
+        np.asarray(paddle.linalg.pinv(paddle.to_tensor(M)).value),
+        np.linalg.pinv(M), rtol=1e-8, atol=1e-10)
+
+    S = r.randn(3, 4, 4)
+    sign, logdet = np.linalg.slogdet(S)
+    got = np.asarray(paddle.linalg.slogdet(paddle.to_tensor(S)).value)
+    np.testing.assert_allclose(got[0], sign, rtol=1e-9)
+    np.testing.assert_allclose(got[1], logdet, rtol=1e-9)
+
+    P = r.randn(4, 4)
+    for n in (0, 1, 3, -2):
+        want = np.linalg.matrix_power(P, n)
+        got = paddle.linalg.matrix_power(paddle.to_tensor(P), n)
+        np.testing.assert_allclose(np.asarray(got.value), want,
+                                   rtol=1e-7, atol=1e-9, err_msg=f"n={n}")
+
+    R = r.randn(5, 3) @ r.randn(3, 5)      # rank 3
+    got = int(np.asarray(
+        paddle.linalg.matrix_rank(paddle.to_tensor(R)).value))
+    assert got == 3
