@@ -179,3 +179,70 @@ class TestKLParity:
             _chk(D.kl_divergence(pp, pq),
                  torch.distributions.kl_divergence(tp, tq), rtol=1e-8,
                  msg=type(pp).__name__)
+
+
+class TestTransformParity:
+    """Transform jacobian math vs torch.distributions.transforms: forward/
+    inverse and log|det J| are the error-prone parts (sign conventions,
+    chain composition order)."""
+
+    def test_affine_exp_sigmoid_jacobians(self):
+        import torch
+        import torch.distributions.transforms as TT
+
+        x = _R.randn(5)
+        pairs = [
+            (D.AffineTransform(paddle.to_tensor(np.array(2.0)),
+                               paddle.to_tensor(np.array(3.0))),
+             TT.AffineTransform(torch.tensor(2.0, dtype=torch.float64),
+                                torch.tensor(3.0, dtype=torch.float64))),
+            (D.ExpTransform(), TT.ExpTransform()),
+            (D.SigmoidTransform(), TT.SigmoidTransform()),
+        ]
+        for pt, tt in pairs:
+            name = type(pt).__name__
+            tx = torch.from_numpy(x)
+            want_y = tt(tx)
+            got_y = pt.forward(paddle.to_tensor(x))
+            np.testing.assert_allclose(np.asarray(got_y.value),
+                                       want_y.numpy(), rtol=1e-9,
+                                       err_msg=f"{name}.forward")
+            want_ldj = tt.log_abs_det_jacobian(tx, want_y)
+            got_ldj = pt.forward_log_det_jacobian(paddle.to_tensor(x))
+            np.testing.assert_allclose(np.asarray(got_ldj.value),
+                                       want_ldj.numpy(), rtol=1e-9,
+                                       err_msg=f"{name}.ldj")
+            back = pt.inverse(got_y)
+            np.testing.assert_allclose(np.asarray(back.value), x,
+                                       rtol=1e-8, atol=1e-10,
+                                       err_msg=f"{name}.inverse")
+
+    def test_transformed_distribution_log_prob(self):
+        import torch
+        import torch.distributions as TD
+
+        loc = _R.randn(4)
+        scale = np.abs(_R.randn(4)) + 0.3
+        base_p = D.Normal(paddle.to_tensor(loc), paddle.to_tensor(scale))
+        base_t = TD.Normal(_t(loc), _t(scale))
+
+        # log-normal via ExpTransform
+        pd = D.TransformedDistribution(base_p, [D.ExpTransform()])
+        td = TD.TransformedDistribution(base_t, [TD.ExpTransform()])
+        x = np.abs(_R.randn(4)) + 0.2
+        _chk(pd.log_prob(paddle.to_tensor(x)), td.log_prob(_t(x)),
+             rtol=1e-9, msg="exp-transformed")
+
+        # affine chain: y = 2*x + 1 after exp
+        pd2 = D.TransformedDistribution(
+            base_p, [D.ExpTransform(),
+                     D.AffineTransform(paddle.to_tensor(np.array(1.0)),
+                                       paddle.to_tensor(np.array(2.0)))])
+        td2 = TD.TransformedDistribution(
+            base_t, [TD.ExpTransform(),
+                     TD.AffineTransform(
+                         torch.tensor(1.0, dtype=torch.float64),
+                         torch.tensor(2.0, dtype=torch.float64))])
+        y = np.abs(_R.randn(4)) * 2 + 1.5
+        _chk(pd2.log_prob(paddle.to_tensor(y)), td2.log_prob(_t(y)),
+             rtol=1e-9, msg="exp+affine chain")
