@@ -605,3 +605,26 @@ class TestFractionalMaxPool:
         out = nn.FractionalMaxPool3D(2, random_u=0.7)(x)
         assert tuple(out.shape) == (1, 2, 2, 2, 2)
         assert float(out.numpy().max()) == float(x.numpy().max())
+
+
+def test_embedding_padding_idx_reference_semantics():
+    """Reference embedding_kernel.cc:80 MEMSETS padding rows of the OUTPUT
+    to zero (torch instead returns the frozen row) — pin the reference
+    behavior with a NONZERO weight row, plus the gradient side: padded
+    positions contribute nothing to the weight grad."""
+    import paddle_tpu.nn.functional as F
+
+    w = paddle.to_tensor(np.arange(12, dtype="float32").reshape(4, 3) + 1.0,
+                         stop_gradient=False)
+    ids = paddle.to_tensor(np.array([[0, 2, 2, 1]], "int64"))
+    out = F.embedding(ids, w, padding_idx=2)
+    got = np.asarray(out.value)[0]
+    np.testing.assert_array_equal(got[1], np.zeros(3))   # padded -> zeros
+    np.testing.assert_array_equal(got[2], np.zeros(3))
+    np.testing.assert_array_equal(got[0], np.arange(3) + 1.0)
+
+    out.sum().backward()
+    g = np.asarray(w.grad.value)
+    np.testing.assert_array_equal(g[2], np.zeros(3))     # frozen row grad
+    np.testing.assert_array_equal(g[0], np.ones(3))
+    np.testing.assert_array_equal(g[1], np.ones(3))
