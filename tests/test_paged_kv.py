@@ -325,3 +325,55 @@ class TestPagedDecodeEngine:
         out_d = np.asarray(dense.generate(ids, max_new_tokens=16))
         out_p = np.asarray(paged.generate(ids, max_new_tokens=16))
         np.testing.assert_array_equal(out_p, out_d)
+
+
+class TestAllocatorInvariants:
+    """Property test: under a random workload of grants, frees, forks and
+    CoW events, the allocator's books must always balance —
+    refs[blk] == number of table cells referencing blk, the free list is
+    disjoint from referenced blocks, and free + live == pool - null."""
+
+    def _check(self, c):
+        t = c._tables_np
+        counts = np.bincount(t[t > 0].ravel(), minlength=c.num_blocks)
+        live = np.flatnonzero(counts)
+        np.testing.assert_array_equal(c._refs[live], counts[live],
+                                      err_msg="refcount != table count")
+        assert (c._refs[counts == 0] == 0).all(), \
+            "nonzero refs on unreferenced blocks"
+        free = set(c._free)
+        assert free.isdisjoint(set(live.tolist())), "freed live block"
+        assert len(free) + len(live) == c.num_blocks - 1, (
+            len(free), len(live), c.num_blocks)
+        assert 0 not in free, "null block entered the free list"
+
+    def test_random_workload_books_balance(self):
+        rng = np.random.RandomState(0)
+        B, bs, max_blocks = 6, 4, 5
+        c = PagedKVCache(num_layers=1, num_blocks=B * max_blocks + 1,
+                         block_size=bs, kv_heads=1, head_dim=2, batch=B,
+                         max_blocks_per_seq=max_blocks, dtype=jnp.float32)
+        pools = [(c.k[0], c.v[0])]
+        lens = np.zeros(B, np.int64)
+        self._check(c)
+        for step in range(200):
+            op = rng.randint(4)
+            if op == 0:                        # grow a random row
+                b = rng.randint(B)
+                if lens[b] + 1 < bs * max_blocks:
+                    lens[b] += 1
+                    c.ensure_capacity(lens)
+            elif op == 1:                      # free a random row
+                b = rng.randint(B)
+                c.free_sequence(b)
+                lens[b] = 0
+            elif op == 2:                      # fork from random parents
+                parents = rng.randint(0, B, B)
+                c.fork_rows(parents)
+                lens = lens[parents]
+            else:                              # CoW at a random position
+                pos = int(lens.max()) if lens.max() > 0 else 0
+                c.ensure_capacity(np.maximum(lens, pos + 1)
+                                  * (lens > 0))
+                pools = [c.make_tail_exclusive(pos, pools[0])]
+            self._check(c)
