@@ -77,6 +77,7 @@ from . import jit  # noqa: F401,E402
 from . import ops  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
 from . import metric  # noqa: F401,E402
+from . import monitor  # noqa: F401,E402
 from . import utils  # noqa: F401,E402
 from . import static  # noqa: F401,E402
 from . import audio  # noqa: F401,E402

@@ -42,6 +42,18 @@ def amp_state():
     return _amp_state()
 
 
+_MON = None  # (monitor._state, amp-cast counter), bound on first cast
+
+
+def _mon():
+    global _MON
+    if _MON is None:
+        from .. import monitor as _m
+
+        _MON = (_m._state, _m.counter("paddle_tpu_dispatch_amp_casts_total"))
+    return _MON
+
+
 def amp_cast_inputs(opdef, args, kwargs):
     state = _amp_state()
     if state is None or not state.enable:
@@ -67,11 +79,15 @@ def amp_cast_inputs(opdef, args, kwargs):
         else:
             return args, kwargs
 
+    mon = _mon()
+
     def cast_leaf(x):
         if isinstance(x, Tensor) and dtype_mod.is_floating(x.dtype) and np.dtype(x.dtype) != target:
             # cast through the op layer so autograd casts the grad back
             from ..ops.manipulation import cast
 
+            if mon[0].on:
+                mon[1].inc()
             return cast(x, target)
         return x
 

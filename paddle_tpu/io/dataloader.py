@@ -21,6 +21,20 @@ from .sampler import BatchSampler
 
 _worker_info = threading.local()
 
+_MON = None  # (state, batches counter, fetch-latency histogram, now_ns)
+
+
+def _mon():
+    global _MON
+    if _MON is None:
+        from .. import monitor as _m
+
+        _MON = (_m._state,
+                _m.counter("paddle_tpu_dataloader_batches_total"),
+                _m.histogram("paddle_tpu_dataloader_fetch_latency_ns"),
+                _m.now_ns)
+    return _MON
+
 
 def get_worker_info():
     from .worker import get_worker_info as _mp_worker_info
@@ -229,16 +243,21 @@ class DataLoader:
                 bm.release_reader(self)
 
     def _iter_impl(self, bm):
+        mon = _mon()
         if not self.use_buffer_reader:
             it = iter(self._batches())
             while True:
                 if bm is not None:
                     bm.before_reader()
+                t0 = mon[3]() if mon[0].on else 0
                 try:
                     b = next(it)
                 except StopIteration:
                     return
                 staged = _to_device(b)
+                if mon[0].on:
+                    mon[2].observe_ns(mon[3]() - t0)
+                    mon[1].inc()
                 if bm is not None:
                     bm.after_reader()
                 yield staged
@@ -280,9 +299,15 @@ class DataLoader:
             while True:
                 if bm is not None:
                     bm.before_reader()
+                t0 = mon[3]() if mon[0].on else 0
                 item = q.get()
                 if item is sentinel:
                     break
+                if mon[0].on:
+                    # consumer-visible stall: ~0 while the producer keeps
+                    # the queue full, the fetch+stage time when it can't
+                    mon[2].observe_ns(mon[3]() - t0)
+                    mon[1].inc()
                 if bm is not None:
                     bm.after_reader()
                 yield item

@@ -53,6 +53,27 @@ from ..framework.core import Tensor
 from ..nn.layer.layers import Layer
 
 
+_MON = None  # monitor bindings: (state, compiles, hits, compile-time, sigs)
+
+
+def _mon():
+    global _MON
+    if _MON is None:
+        from .. import monitor as _m
+
+        _MON = (_m._state,
+                _m.counter("paddle_tpu_jit_compiles_total",
+                           labelnames=("function",)),
+                _m.counter("paddle_tpu_jit_cache_hits_total",
+                           labelnames=("function",)),
+                _m.histogram("paddle_tpu_jit_trace_compile_seconds",
+                             buckets=_m.DEFAULT_SECONDS_BUCKETS),
+                _m.gauge("paddle_tpu_jit_cached_signatures",
+                         labelnames=("function",)),
+                _m.now_ns)
+    return _MON
+
+
 class InputSpec:
     """paddle.static.InputSpec: symbolic input signature (shape with None = dynamic)."""
 
@@ -250,6 +271,29 @@ class StaticFunction:
 
     def _traced_call_keyed(self, key, treedef, leaves, t_idx, t_leaves,
                            tvals, state_tensors):
+        """Monitor shim over _run_keyed: a signature miss counts as one
+        compile (trace + XLA compile + first execution, timed wall-clock);
+        a hit bumps the hit counter. Zero extra work when the monitor is
+        off."""
+        mon = _mon()
+        if not mon[0].on:
+            return self._run_keyed(key, treedef, leaves, t_idx, t_leaves,
+                                   tvals, state_tensors)
+        fname = getattr(self._function, "__name__", "fn")
+        miss = key not in self._cache
+        t0 = mon[5]()
+        out = self._run_keyed(key, treedef, leaves, t_idx, t_leaves,
+                              tvals, state_tensors)
+        if miss:
+            mon[1].labels(fname).inc()
+            mon[3].observe((mon[5]() - t0) / 1e9)
+            mon[4].labels(fname).set(len(self._cache))
+        else:
+            mon[2].labels(fname).inc()
+        return out
+
+    def _run_keyed(self, key, treedef, leaves, t_idx, t_leaves,
+                   tvals, state_tensors):
         if key not in self._cache:
             self._cache[key] = self._build(treedef, leaves, t_idx, state_tensors)
         jitted, out_box = self._cache[key]

@@ -34,6 +34,20 @@ __all__ = ["PagedKVCache", "alloc_blocks", "paged_write_decode",
            "paged_write_decode_int8", "paged_write_prefill_int8",
            "paged_attention_decode_int8"]
 
+_MON = None  # (state, free-blocks gauge, CoW counter, exhaustion counter)
+
+
+def _mon():
+    global _MON
+    if _MON is None:
+        from .. import monitor as _m
+
+        _MON = (_m._state,
+                _m.gauge("paddle_tpu_kv_free_blocks"),
+                _m.counter("paddle_tpu_kv_cow_copies_total"),
+                _m.counter("paddle_tpu_kv_pool_exhausted_total"))
+    return _MON
+
 
 class PagedKVCache:
     """Host-side block allocator + the device block pools for ONE layer set.
@@ -85,10 +99,20 @@ class PagedKVCache:
         tables = self._tables_np
         owned = (tables > 0).sum(axis=1)
         changed = False
+        mon = _mon()
         for b, need_tok in enumerate(np.asarray(seq_lens_next)):
             need = int(-(-int(need_tok) // self.block_size))  # ceil
             while owned[b] < need:
                 if not self._free:
+                    if mon[0].on:
+                        mon[3].inc()
+                    if changed:
+                        # blocks already granted to earlier rows must reach
+                        # the device even on the failure path — a caller
+                        # that catches this would otherwise decode against
+                        # a stale device table (writes landing in the null
+                        # block) while the host mirror says all is granted
+                        self.block_tables = jnp.asarray(tables.copy())
                     raise RuntimeError(
                         "paged KV pool exhausted: no free blocks "
                         f"(pool={self.num_blocks}, block={self.block_size})")
@@ -97,6 +121,8 @@ class PagedKVCache:
                 self._refs[blk] = 1
                 owned[b] += 1
                 changed = True
+        if mon[0].on:
+            mon[1].set(len(self._free))
         if changed:
             # upload a COPY: jnp.asarray of an aligned numpy array may be
             # zero-copy on CPU, and an in-flight async step could still be
@@ -114,6 +140,9 @@ class PagedKVCache:
                     self._free.append(int(blk))
         tables[b] = 0
         self.block_tables = jnp.asarray(tables.copy())
+        mon = _mon()
+        if mon[0].on:
+            mon[1].set(len(self._free))
 
     # -- copy-on-write sharing (beam search) ---------------------------------
     def fork_rows(self, parent_rows):
@@ -136,6 +165,9 @@ class PagedKVCache:
                 self._free.append(int(blk))
         self._tables_np = new
         self.block_tables = jnp.asarray(new.copy())
+        mon = _mon()
+        if mon[0].on:
+            mon[1].set(len(self._free))
 
     def _cow_copy_fn(self):
         fn = getattr(self, "_cow_jit", None)
@@ -159,6 +191,7 @@ class PagedKVCache:
         when nothing is shared — plain decoding always takes that path."""
         if (self._refs <= 1).all():
             return pools
+        mon = _mon()
         bidx = int(pos) // self.block_size
         t = self._tables_np
         pairs = []
@@ -166,6 +199,8 @@ class PagedKVCache:
             phys = int(t[b, bidx])
             if phys > 0 and self._refs[phys] > 1:
                 if not self._free:
+                    if mon[0].on:
+                        mon[3].inc()
                     raise RuntimeError(
                         "paged KV pool exhausted during copy-on-write "
                         f"(pool={self.num_blocks})")
@@ -176,6 +211,9 @@ class PagedKVCache:
                 pairs.append((phys, new))
         if not pairs:
             return pools
+        if mon[0].on:
+            mon[2].inc(len(pairs))
+            mon[1].set(len(self._free))
         olds = jnp.asarray([o for o, _ in pairs], jnp.int32)
         news = jnp.asarray([n for _, n in pairs], jnp.int32)
         pools = self._cow_copy_fn()(pools, olds, news)

@@ -15,9 +15,15 @@ the engine on top of that capability, TPU-first:
 - eviction frees the slot's blocks back to the pool (models/paged_kv.py)
 
 The scheduler here is deliberately minimal (greedy sampling, FIFO slots);
-it is the capability proof, not a production batch scheduler.
+it is the capability proof, not a production batch scheduler. submit()
+adds a host-side FIFO admission queue in front of the slots (add_request
+keeps the refuse-when-full contract), and the engine is instrumented with
+the paddle_tpu.monitor serving metrics — queue depth, batch occupancy,
+prefill/decode latency, tokens, evictions, TTFT (docs/observability.md).
 """
 from __future__ import annotations
+
+import collections
 
 import numpy as np
 
@@ -28,6 +34,45 @@ from . import paged_kv as _pk
 from .llama_decode import LlamaDecodeEngine, _rms
 
 __all__ = ["ContinuousBatchingEngine"]
+
+
+class _Mon:
+    """Lazily-bound monitor handles (one attribute load per metric on the
+    serving hot path; nothing is touched while the monitor is off)."""
+
+    __slots__ = ("mod", "state", "queue_depth", "occupancy", "prefill",
+                 "decode", "tokens", "evictions", "ttft", "admitted",
+                 "rejected", "jit_compiles", "jit_hits", "jit_sigs")
+
+
+_MON = None
+
+
+def _mon():
+    global _MON
+    if _MON is None:
+        from .. import monitor as m
+
+        o = _Mon()
+        o.mod = m
+        o.state = m._state
+        o.queue_depth = m.gauge("paddle_tpu_serving_queue_depth")
+        o.occupancy = m.gauge("paddle_tpu_serving_batch_occupancy")
+        o.prefill = m.histogram("paddle_tpu_serving_prefill_latency_ns")
+        o.decode = m.histogram("paddle_tpu_serving_decode_step_latency_ns")
+        o.tokens = m.counter("paddle_tpu_serving_generated_tokens_total")
+        o.evictions = m.counter("paddle_tpu_serving_evictions_total")
+        o.ttft = m.histogram("paddle_tpu_serving_ttft_ns")
+        o.admitted = m.counter("paddle_tpu_serving_admitted_total")
+        o.rejected = m.counter("paddle_tpu_serving_rejected_total")
+        o.jit_compiles = m.counter("paddle_tpu_jit_compiles_total",
+                                   labelnames=("function",))
+        o.jit_hits = m.counter("paddle_tpu_jit_cache_hits_total",
+                               labelnames=("function",))
+        o.jit_sigs = m.gauge("paddle_tpu_jit_cached_signatures",
+                             labelnames=("function",))
+        _MON = o
+    return _MON
 
 
 class ContinuousBatchingEngine:
@@ -61,12 +106,20 @@ class ContinuousBatchingEngine:
         self.outputs = [[] for _ in range(self.max_batch)]
         self._next_rid = 0
         self._jit_cache = {}
+        # submit() queue: requests waiting for a free slot (host-side)
+        self._pending = collections.deque()
 
     # -- compiled paths ------------------------------------------------------
     def _prefill_slot_jit(self, bucket):
         e = self._inner
         key = ("prefill", bucket)
         cache = self._jit_cache
+        mon = _mon()
+        if mon.state.on:
+            if key in cache:
+                mon.jit_hits.labels("serving.prefill").inc()
+            else:
+                mon.jit_compiles.labels("serving.prefill").inc()
         if key not in cache:
             def run(ids, pools, row_tables, length):
                 # ids: (1, bucket) padded prompt; only `length` rows are
@@ -84,11 +137,21 @@ class ContinuousBatchingEngine:
                 return logits[0, length - 1], new_pools
 
             cache[key] = jax.jit(run, donate_argnums=(1,))
+            if mon.state.on:
+                mon.jit_sigs.labels("serving.prefill").set(
+                    sum(1 for k in cache if k != "step"))
         return cache[key]
 
     def _step_all_jit(self):
         e = self._inner
         cache = self._jit_cache
+        mon = _mon()
+        if mon.state.on:
+            if "step" in cache:
+                mon.jit_hits.labels("serving.decode_step").inc()
+            else:
+                mon.jit_compiles.labels("serving.decode_step").inc()
+                mon.jit_sigs.labels("serving.decode_step").set(1)
         if "step" not in cache:
             def run(tokens, pools, tables, lens):
                 # tokens (B, 1); lens (B,) per-row positions — ragged:
@@ -106,19 +169,106 @@ class ContinuousBatchingEngine:
         return cache["step"]
 
     # -- admission / eviction ------------------------------------------------
-    def add_request(self, prompt_ids):
-        """Admit one prompt into a free slot; returns the request id (or
-        None when the batch is full — callers queue and retry)."""
+    def _check_prompt(self, prompt_ids):
         prompt = np.asarray(getattr(prompt_ids, "value", prompt_ids),
                             np.int32).reshape(-1)
         L = len(prompt)
         if L == 0 or L >= self.max_len:
             raise ValueError(f"prompt length {L} out of range (1.."
                              f"{self.max_len - 1})")
+        # a prompt whose KV can never fit the whole pool would otherwise
+        # head-of-line-block the submit() queue forever (retried each step,
+        # never admittable) — refuse it up front, at the caller
+        need = -(-(L + 1) // self.block_size)
+        if need > self._pager.num_blocks - 1:  # block 0 is the null block
+            raise ValueError(
+                f"prompt needs {need} KV blocks but the pool only has "
+                f"{self._pager.num_blocks - 1}")
+        return prompt
+
+    def add_request(self, prompt_ids):
+        """Admit one prompt into a free slot; returns the request id (or
+        None when the batch is full — callers queue and retry, or use
+        submit() which queues host-side). Older submit()ed requests keep
+        FIFO priority: they are drained into free slots first."""
+        prompt = self._check_prompt(prompt_ids)
+        mon = _mon()
+        self._drain_pending()
         free = np.flatnonzero(~self.active)
         if not len(free):
+            if mon.state.on:
+                mon.rejected.inc()
             return None
+        rid = self._next_rid
+        self._next_rid += 1
+        t_submit = mon.mod.now_ns()
         slot = int(free[0])
+        try:
+            self._admit(slot, prompt, rid, t_submit)
+        except Exception:
+            if not self.active[slot]:
+                # undo any partial block grant the failed prefill made (and
+                # re-sync the device table copy)
+                self._pager.free_sequence(slot)
+            raise
+        return rid
+
+    def submit(self, prompt_ids):
+        """Always-accepting admission: the prompt is prefilled into a free
+        slot immediately when one exists, otherwise it waits in the
+        host-side queue and is admitted at the start of a later step().
+        Returns the request id right away (TTFT measures queue wait +
+        prefill)."""
+        prompt = self._check_prompt(prompt_ids)
+        mon = _mon()
+        rid = self._next_rid
+        self._next_rid += 1
+        self._pending.append((rid, prompt, mon.mod.now_ns()))
+        self._drain_pending()
+        if mon.state.on:
+            self._update_gauges(mon)
+        return rid
+
+    def _drain_pending(self):
+        """Admit queued requests into free slots, oldest first. NEVER
+        raises for a queued request: submit()/add_request/step() callers
+        must not receive a different request's failure. A transient
+        admission failure (KV pool exhausted while sequences still hold
+        blocks) keeps the request at the head — evictions free blocks and
+        a later drain retries. A failure with nothing active can never
+        resolve by retrying, so the request is dropped with a warning and
+        a rejection count."""
+        while self._pending:
+            free = np.flatnonzero(~self.active)
+            if not len(free):
+                return
+            rid, prompt, t_submit = self._pending[0]
+            slot = int(free[0])
+            try:
+                self._admit(slot, prompt, rid, t_submit)
+            except Exception as e:  # noqa: BLE001
+                if not self.active[slot]:
+                    # undo any partial block grant the failed prefill made
+                    self._pager.free_sequence(slot)
+                if self.active.any():
+                    return          # retry once evictions free blocks
+                self._pending.popleft()
+                mon = _mon()
+                if mon.state.on:
+                    mon.rejected.inc()
+                import warnings
+
+                warnings.warn(
+                    f"serving: dropping queued request {rid} — admission "
+                    f"failed with no active sequences to free resources "
+                    f"({type(e).__name__}: {e})", stacklevel=3)
+                continue            # the next request may still fit
+            self._pending.popleft()
+
+    def _admit(self, slot, prompt, rid, t_submit):
+        mon = _mon()
+        t0 = mon.mod.now_ns()
+        L = len(prompt)
         bucket = next(b for b in self._buckets if b >= L) \
             if L <= self._buckets[-1] else self.max_len
         padded = np.zeros((1, bucket), np.int32)
@@ -133,20 +283,31 @@ class ContinuousBatchingEngine:
             jnp.asarray(padded), self._pools, row_tables,
             jnp.asarray(L, jnp.int32))
         tok = int(np.asarray(jnp.argmax(logits, -1)))
-        rid = self._next_rid
-        self._next_rid += 1
         self.active[slot] = True
         self.lens[slot] = L
         self.request_ids[slot] = rid
         self.last_token[slot, 0] = tok
         self.outputs[slot] = [tok]
-        return rid
+        if mon.state.on:
+            t1 = mon.mod.now_ns()
+            mon.admitted.inc()
+            mon.tokens.inc()            # the prefill's first token
+            mon.prefill.observe(t1 - t0)
+            mon.ttft.observe(t1 - t_submit)
+            self._update_gauges(mon)
 
     def step(self, eos_token_id=None, max_new_tokens=None):
-        """One decode step for EVERY active slot. Returns the list of
-        finished (request_id, tokens) pairs evicted this step."""
+        """One decode step for EVERY active slot. Queued submit() requests
+        are admitted into free slots first. Returns the list of finished
+        (request_id, tokens) pairs evicted this step."""
+        mon = _mon()
+        self._drain_pending()
         if not self.active.any():
+            if mon.state.on:
+                self._update_gauges(mon)
             return []
+        t0 = mon.mod.now_ns()
+        n_decoded = int(self.active.sum())
         self._pager.ensure_capacity(self.lens + self.active)
         step = self._step_all_jit()
         toks, self._pools = step(
@@ -168,6 +329,11 @@ class ContinuousBatchingEngine:
                 finished.append((self.request_ids[slot],
                                  list(self.outputs[slot])))
                 self._evict(slot)
+        if mon.state.on:
+            mon.decode.observe(mon.mod.now_ns() - t0)
+            mon.tokens.inc(n_decoded)
+            self._update_gauges(mon)
+            mon.mod.sample()   # chrome-trace counter timeline, per step
         return finished
 
     def _evict(self, slot):
@@ -176,7 +342,19 @@ class ContinuousBatchingEngine:
         self.lens[slot] = 0
         self.request_ids[slot] = None
         self.outputs[slot] = []
+        mon = _mon()
+        if mon.state.on:
+            mon.evictions.inc()
+            self._update_gauges(mon)
+
+    def _update_gauges(self, mon):
+        mon.queue_depth.set(len(self._pending))
+        mon.occupancy.set(float(self.active.sum()) / self.max_batch)
 
     @property
     def num_active(self):
         return int(self.active.sum())
+
+    @property
+    def num_pending(self):
+        return len(self._pending)

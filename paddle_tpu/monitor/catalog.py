@@ -1,0 +1,112 @@
+"""Metric name catalog: the stable contract of the telemetry subsystem.
+
+Every metric this framework emits is declared here, named
+``paddle_tpu_<subsystem>_<name>`` (snake_case, counters end in ``_total``,
+histograms carry their unit as the trailing token, e.g. ``_ns`` /
+``_seconds``). Dashboards and downstream artifact validators key on these
+strings, so renaming an entry is a breaking change — add a new name and
+deprecate the old one instead. ``tools/check_metric_names.py`` lints both
+this table and every literal registration in the source tree against the
+convention.
+
+This module is deliberately dependency-free (no jax, no package-relative
+imports) so the lint tool can load it by file path without initializing the
+framework.
+"""
+
+# Subsystems a metric may belong to (the <subsystem> token of the name).
+SUBSYSTEMS = ("dispatch", "jit", "serving", "kv", "dataloader", "monitor")
+
+NAME_PATTERN = (
+    r"^paddle_tpu_(" + "|".join(SUBSYSTEMS) + r")_[a-z][a-z0-9_]*$"
+)
+
+# name -> (metric type, label names, help text)
+METRICS = {
+    # -- op dispatch (ops/_apply.py) -------------------------------------
+    "paddle_tpu_dispatch_op_calls_total": (
+        "counter", ("op",),
+        "Eager op dispatches through ops._apply.apply, labeled by op name."),
+    "paddle_tpu_dispatch_latency_ns": (
+        "histogram", (),
+        "Wall time of one eager op dispatch (AMP cast + kernel dispatch + "
+        "tape record), nanoseconds."),
+    "paddle_tpu_dispatch_amp_casts_total": (
+        "counter", (),
+        "Tensor inputs actually cast by AMP auto_cast on the dispatch path."),
+    # -- jit program caches (jit/api.py to_static + the serving engine's
+    #    compiled prefill/decode programs) -------------------------------
+    "paddle_tpu_jit_compiles_total": (
+        "counter", ("function",),
+        "Program-cache misses (trace + XLA compile), labeled by the cached "
+        "callable (to_static function name, serving.prefill, "
+        "serving.decode_step)."),
+    "paddle_tpu_jit_cache_hits_total": (
+        "counter", ("function",),
+        "Program-cache calls served by an already-compiled program."),
+    "paddle_tpu_jit_trace_compile_seconds": (
+        "histogram", (),
+        "Wall time of a to_static signature cache miss: trace + compile + "
+        "the first execution, seconds."),
+    "paddle_tpu_jit_cached_signatures": (
+        "gauge", ("function",),
+        "Live compiled signatures per cached callable."),
+    # -- serving engine (models/serving.py) ------------------------------
+    "paddle_tpu_serving_queue_depth": (
+        "gauge", (),
+        "Requests submitted but not yet admitted into the running batch."),
+    "paddle_tpu_serving_batch_occupancy": (
+        "gauge", (),
+        "Fraction of continuous-batching slots holding an active request "
+        "(0..1)."),
+    "paddle_tpu_serving_prefill_latency_ns": (
+        "histogram", (),
+        "Admission prefill wall time (pad + prefill program + first-token "
+        "argmax), nanoseconds."),
+    "paddle_tpu_serving_decode_step_latency_ns": (
+        "histogram", (),
+        "Wall time of one batched decode step over all active slots, "
+        "nanoseconds."),
+    "paddle_tpu_serving_generated_tokens_total": (
+        "counter", (),
+        "Tokens emitted across all requests (prefill first-token included)."),
+    "paddle_tpu_serving_evictions_total": (
+        "counter", (),
+        "Slots evicted (finished or length-capped requests)."),
+    "paddle_tpu_serving_ttft_ns": (
+        "histogram", (),
+        "Time to first token: submit/add_request to the prefill argmax, "
+        "nanoseconds."),
+    "paddle_tpu_serving_admitted_total": (
+        "counter", (),
+        "Requests admitted into a batch slot."),
+    "paddle_tpu_serving_rejected_total": (
+        "counter", (),
+        "add_request calls refused because the batch was full."),
+    # -- paged KV allocator (models/paged_kv.py) -------------------------
+    "paddle_tpu_kv_free_blocks": (
+        "gauge", (),
+        "Free blocks in the most recently updated paged-KV pool."),
+    "paddle_tpu_kv_cow_copies_total": (
+        "counter", (),
+        "Blocks copied by copy-on-write before a shared-tail write."),
+    "paddle_tpu_kv_pool_exhausted_total": (
+        "counter", (),
+        "Allocation attempts that failed because the block pool was empty."),
+    # -- dataloader (io/dataloader.py) -----------------------------------
+    "paddle_tpu_dataloader_batches_total": (
+        "counter", (),
+        "Batches yielded to the training loop."),
+    "paddle_tpu_dataloader_fetch_latency_ns": (
+        "histogram", (),
+        "Consumer-visible wait for the next staged batch, nanoseconds."),
+    # -- the monitor itself ----------------------------------------------
+    "paddle_tpu_monitor_samples_total": (
+        "counter", (),
+        "Timeline samples recorded for chrome-trace counter export."),
+}
+
+
+def spec(name):
+    """(type, labelnames, help) for a cataloged metric name, or None."""
+    return METRICS.get(name)

@@ -221,22 +221,45 @@ def _prof():
     return _PROF
 
 
+_MON = None    # (monitor._state, op-calls counter, latency histogram, clock)
+
+
+def _mon():
+    global _MON
+    if _MON is None:
+        from .. import monitor as _m
+
+        _MON = (_m._state,
+                _m.counter("paddle_tpu_dispatch_op_calls_total",
+                           labelnames=("op",)),
+                _m.histogram("paddle_tpu_dispatch_latency_ns"),
+                _m.now_ns)
+    return _MON
+
+
 def apply(opdef: OpDef, *args, **kwargs):
     """Dispatch one op call. Tensor leaves anywhere in args/kwargs are traced
     inputs. While a Profiler RECORD window is open, every dispatch emits an
     Operator host span (the reference records an event per generated op
     forward, eager_gen.py record-event preamble); the merged chrome trace
-    then shows these host defop spans over the XLA device kernel spans."""
+    then shows these host defop spans over the XLA device kernel spans.
+    With the monitor enabled the same span lands in the dispatch-latency
+    histogram and bumps the per-op call counter — one clock (monitor.now_ns)
+    feeds both consumers."""
     prof = _prof()
-    if prof[0].enabled:
-        import time as _time
-
-        t0 = _time.perf_counter_ns()
+    mon = _mon()
+    if prof[0].enabled or mon[0].on:
+        now_ns = mon[3]
+        t0 = now_ns()
         try:
             return _apply_impl(opdef, *args, **kwargs)
         finally:
-            prof[0].emit(f"op::{opdef.name}", prof[1], t0,
-                         _time.perf_counter_ns())
+            t1 = now_ns()
+            if mon[0].on:
+                mon[1].labels(opdef.name).inc()
+                mon[2].observe_ns(t1 - t0)
+            if prof[0].enabled:
+                prof[0].emit(f"op::{opdef.name}", prof[1], t0, t1)
     return _apply_impl(opdef, *args, **kwargs)
 
 

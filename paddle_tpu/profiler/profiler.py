@@ -312,6 +312,25 @@ def _write_chrome_trace(events, path, xla_trace_dir=None, device_events=None,
         t0 = xla_t0_ns if xla_t0_ns is not None else (
             min((e.start_ns for e in events), default=0))
         trace_events.extend(chrome_events(device_events, t0))
+    try:
+        # monitor counter timeline (same perf_counter_ns clock as the host
+        # spans): JIT/serving/KV/dispatch metrics render as stacked counter
+        # tracks on the span timeline. Samples are FILTERED to the recorded
+        # window (small slack for the per-step sample landing just past the
+        # final span) — the buffer holds the whole process lifetime, and
+        # merging it all would stretch the viewer's timeline far beyond the
+        # profiled region (or, on a re-saved loaded trace, inject another
+        # process's clock).
+        from .. import monitor as _monitor
+
+        if events:
+            w0 = min(e.start_ns for e in events) - 10_000_000
+            w1 = max(e.end_ns for e in events) + 10_000_000
+            trace_events.extend(
+                ev for ev in _monitor.chrome_counter_events()
+                if w0 <= ev["ts"] * 1e3 <= w1)
+    except Exception:  # noqa: BLE001 - telemetry must never break an export
+        pass
     doc = {"traceEvents": trace_events, "displayTimeUnit": "ms"}
     if xla_trace_dir:
         doc["otherData"] = {"xla_trace_dir": xla_trace_dir}
@@ -457,6 +476,14 @@ class Profiler:
             self.step_num += 1
             return
         self._close_step_span()
+        try:
+            # one metrics timeline sample per profiled step so counters move
+            # in lockstep with the ProfileStep spans in the merged trace
+            from .. import monitor as _monitor
+
+            _monitor.sample()
+        except Exception:  # noqa: BLE001
+            pass
         _collector.current_step = self.step_num + 1
         next_state = self._scheduler(self.step_num + 1)
         self._trigger_action(self.current_state, next_state, self.step_num + 1)

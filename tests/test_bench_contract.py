@@ -1,88 +1,131 @@
 """bench.py driver contract: always exit 0, always print exactly one JSON
 line, and replay the cached on-device measurement (stale=true) when the
-live TPU path fails — the round-2/round-4 wedged-tunnel lesson."""
+live TPU path fails — the round-2/round-4 wedged-tunnel lesson.
+
+Round-5 addendum: the cache is provenance-checked. Fixtures point at a tmp
+cache path (BENCH_CACHE_PATH) so tests never pollute the real replay
+artifact, and entries with a placeholder rev (``deadbee``) or a future
+timestamp are refused with a clear stale/invalid error instead of being
+replayed as real measurements.
+"""
 import json
 import os
-import shutil
 import subprocess
 import sys
+import time
 
 import pytest
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(ROOT, "bench.py")
-CACHE = os.path.join(ROOT, "bench_cache.json")
 
 
-def _run_bench(env_extra, timeout=560):
+def _real_rev():
+    out = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                         capture_output=True, text=True, timeout=10, cwd=ROOT)
+    return out.stdout.strip() or "a1b2c3d"
+
+
+def _utc(offset_s=0):
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(time.time()
+                                                           + offset_s))
+
+
+def _run_bench(env_extra, cache_path, timeout=560):
     env = dict(os.environ)
+    env["BENCH_CACHE_PATH"] = str(cache_path)
     env.update(env_extra)
     p = subprocess.run([sys.executable, BENCH], capture_output=True,
                        text=True, timeout=timeout, env=env, cwd=ROOT)
     assert p.returncode == 0, p.stderr[-500:]
     lines = [ln for ln in p.stdout.splitlines() if ln.strip().startswith("{")]
     assert len(lines) == 1, p.stdout  # exactly one JSON line on stdout
-    return json.loads(lines[0])
+    return json.loads(lines[0]), p.stderr
+
+
+# the probe child must not reach a live backend in any of these runs
+_NO_BACKEND = {"BENCH_PROBE_TIMEOUT": "1", "BENCH_TPU_ATTEMPTS": "1",
+               "JAX_PLATFORMS": "definitely_not_a_backend"}
 
 
 @pytest.mark.slow
 class TestBenchContract:
     def test_cache_replay_when_tpu_unreachable(self, tmp_path):
-        """With the probe forced to fail instantly and a cache present, the
-        orchestrator must replay the cached TPU number marked stale."""
-        backup = None
-        if os.path.exists(CACHE):
-            backup = tmp_path / "cache.bak"
-            shutil.copy(CACHE, backup)
-        try:
-            doc = {"metric": "llama_train_tokens_per_sec", "value": 111.0,
-                   "unit": "tokens/s", "vs_baseline": 0.42,
-                   "detail": {"device": "TPU test", "mfu": 0.42,
-                              "measured_at": "2030-01-01T00:00:00Z",
-                              "measured_git_rev": "deadbee"}}
-            with open(CACHE, "w") as f:
-                json.dump(doc, f)
-            out = _run_bench({"BENCH_PROBE_TIMEOUT": "1",
-                              "BENCH_TPU_ATTEMPTS": "1",
-                              # the probe child must not reach a live backend
-                              "JAX_PLATFORMS": "definitely_not_a_backend"},
-                             timeout=300)
-            d = out["detail"]
-            assert d.get("stale") is True
-            assert out["vs_baseline"] == 0.42
-            assert d["measured_git_rev"] == "deadbee"
-            assert "tpu_error" in d  # failure provenance preserved
-        finally:
-            if backup is not None:
-                shutil.copy(backup, CACHE)
-            elif os.path.exists(CACHE):
-                os.remove(CACHE)
+        """With the probe forced to fail instantly and a VALID cache
+        present, the orchestrator must replay the cached TPU number marked
+        stale."""
+        cache = tmp_path / "bench_cache.json"
+        doc = {"metric": "llama_train_tokens_per_sec", "value": 111.0,
+               "unit": "tokens/s", "vs_baseline": 0.42,
+               "detail": {"device": "TPU test", "mfu": 0.42,
+                          "measured_at": _utc(-3600),
+                          "measured_git_rev": _real_rev()}}
+        cache.write_text(json.dumps(doc))
+        out, _ = _run_bench(_NO_BACKEND, cache, timeout=300)
+        d = out["detail"]
+        assert d.get("stale") is True
+        assert out["vs_baseline"] == 0.42
+        assert "tpu_error" in d  # failure provenance preserved
+
+    def test_invalid_provenance_is_not_replayed(self, tmp_path):
+        """The round-5 bug class: a fixture with rev `deadbee` and a 2030
+        timestamp must NOT replay as a real benchmark — the orchestrator
+        surfaces a stale/invalid-cache error and falls through to the CPU
+        fallback."""
+        cache = tmp_path / "bench_cache.json"
+        doc = {"metric": "llama_train_tokens_per_sec", "value": 111.0,
+               "unit": "tokens/s", "vs_baseline": 0.42,
+               "detail": {"device": "TPU test", "mfu": 0.42,
+                          "measured_at": "2030-01-01T00:00:00Z",
+                          "measured_git_rev": "deadbee"}}
+        cache.write_text(json.dumps(doc))
+        out, stderr = _run_bench(_NO_BACKEND, cache)
+        d = out["detail"]
+        assert d.get("stale") is not True
+        assert out["vs_baseline"] != 0.42
+        errs = json.dumps(d.get("tpu_error", []) + d.get("error", []))
+        assert "stale/invalid cache" in errs or "stale/invalid cache" in stderr
+
+    def test_placeholder_rev_alone_refused(self, tmp_path):
+        """A placeholder rev is refused even when the timestamp is fresh."""
+        cache = tmp_path / "bench_cache.json"
+        doc = {"metric": "llama_train_tokens_per_sec", "value": 1.0,
+               "unit": "tokens/s", "vs_baseline": 0.9,
+               "detail": {"device": "TPU test", "mfu": 0.9,
+                          "measured_at": _utc(-60),
+                          "measured_git_rev": "deadbee"}}
+        cache.write_text(json.dumps(doc))
+        out, stderr = _run_bench(_NO_BACKEND, cache)
+        assert out["detail"].get("stale") is not True
+        assert "placeholder" in stderr
 
     def test_expired_cache_is_not_replayed(self, tmp_path):
         """Entries older than BENCH_CACHE_MAX_AGE_H must not replay (a
         long-broken TPU path cannot serve ancient numbers forever)."""
-        backup = None
-        if os.path.exists(CACHE):
-            backup = tmp_path / "cache.bak"
-            shutil.copy(CACHE, backup)
-        try:
-            doc = {"metric": "llama_train_tokens_per_sec", "value": 1.0,
-                   "unit": "tokens/s", "vs_baseline": 0.9,
-                   "detail": {"device": "TPU test", "mfu": 0.9,
-                              "measured_at": "2020-01-01T00:00:00Z"}}
-            with open(CACHE, "w") as f:
-                json.dump(doc, f)
-            # NO BENCH_FORCE_CPU here: the step-1 worker must genuinely
-            # fail (bogus backend) so the cache IS consulted; the expired
-            # entry must be skipped en route to the step-3 CPU fallback
-            out = _run_bench({"BENCH_PROBE_TIMEOUT": "1",
-                              "BENCH_TPU_ATTEMPTS": "1",
-                              "JAX_PLATFORMS": "definitely_not_a_backend"})
-            assert out["detail"].get("stale") is not True
-            assert out["detail"]["device"] == "cpu"
-            assert "tpu_error" in out["detail"]
-        finally:
-            if backup is not None:
-                shutil.copy(backup, CACHE)
-            elif os.path.exists(CACHE):
-                os.remove(CACHE)
+        cache = tmp_path / "bench_cache.json"
+        doc = {"metric": "llama_train_tokens_per_sec", "value": 1.0,
+               "unit": "tokens/s", "vs_baseline": 0.9,
+               "detail": {"device": "TPU test", "mfu": 0.9,
+                          "measured_at": "2020-01-01T00:00:00Z",
+                          "measured_git_rev": _real_rev()}}
+        cache.write_text(json.dumps(doc))
+        # NO BENCH_FORCE_CPU here: the step-1 worker must genuinely
+        # fail (bogus backend) so the cache IS consulted; the expired
+        # entry must be skipped en route to the step-3 CPU fallback
+        out, _ = _run_bench(_NO_BACKEND, cache)
+        assert out["detail"].get("stale") is not True
+        assert out["detail"]["device"] == "cpu"
+        assert "tpu_error" in out["detail"]
+
+    def test_worker_emits_provenance_block(self, tmp_path):
+        """The CPU worker's JSON carries a validatable provenance block
+        (real git rev, hostname, platform) in detail.provenance."""
+        cache = tmp_path / "bench_cache.json"
+        out, _ = _run_bench({"JAX_PLATFORMS": "cpu", "BENCH_FORCE_CPU": "1",
+                             "BENCH_PROBE_TIMEOUT": "60"}, cache)
+        prov = out["detail"].get("provenance")
+        assert prov, out["detail"].keys()
+        from paddle_tpu.monitor.provenance import validate
+
+        assert validate(prov) == []
+        assert prov["git_rev"] == _real_rev()
