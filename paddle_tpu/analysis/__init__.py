@@ -1,0 +1,129 @@
+"""graftlint: framework-aware static analysis for paddle_tpu.
+
+An AST-based rule engine that walks the source tree WITHOUT importing it
+and reports framework-specific hazards the test suite cannot see:
+
+- GL001 trace-impurity — impure host calls inside to_static/defop/jit
+  bodies bake one traced value into the compiled program;
+- GL002 host-sync-in-hot-path — hidden device→host round-trips in the
+  dispatch and serving/decode hot paths;
+- GL003 registry-consistency — defop registrations, AMP categories, and
+  docs/ops.md stay in agreement;
+- GL004 lock-discipline — no device dispatch or blocking wait inside a
+  lock body;
+- GL005 metric-name-contract — every registered metric is declared in
+  monitor/catalog.py and follows the naming convention (the engine form
+  of tools/check_metric_names.py).
+
+Run it as ``python -m paddle_tpu.analysis`` (or, without importing the
+framework at all, ``python tools/lint_framework.py``). Inline
+suppressions (``# graftlint: disable=GL002``), a checked-in baseline for
+grandfathered findings, and a tier-1 test keep the tree clean going
+forward; see docs/static_analysis.md.
+
+This package intentionally uses only the standard library — no jax, no
+framework imports — so ``tools/lint_framework.py`` can load it by file
+path in any venv.
+"""
+from __future__ import annotations
+
+import os
+
+from .core import (Finding, Project, load_baseline, partition, render_json,
+                   render_text, run, write_baseline)
+from .rules import ALL_RULES, RULES_BY_ID, Rule
+
+__all__ = ["Finding", "Project", "Rule", "ALL_RULES", "RULES_BY_ID",
+           "run", "partition", "load_baseline", "write_baseline",
+           "render_text", "render_json", "analyze", "main",
+           "DEFAULT_BASELINE", "repo_root"]
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+
+
+def repo_root():
+    """The tree this installation would lint by default (two levels above
+    this package: <root>/paddle_tpu/analysis)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def analyze(root=None, rules=None, baseline_path=None, include=("paddle_tpu",)):
+    """One-call API: (new, baselined, suppressed, rules) over a tree."""
+    project = Project(root or repo_root(), include=include)
+    rules = list(rules if rules is not None else ALL_RULES)
+    findings = run(project, rules)
+    baseline = load_baseline(
+        DEFAULT_BASELINE if baseline_path is None else baseline_path)
+    new, base, supp = partition(project, findings, baseline)
+    return new, base, supp, rules
+
+
+def main(argv=None):
+    """CLI: exit 0 when clean (baseline applied), 1 on new findings."""
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="graftlint: framework-aware static analysis "
+                    "(GL001–GL005)")
+    ap.add_argument("--root", default=None,
+                    help="tree to analyze (default: this repo)")
+    ap.add_argument("--include", default="paddle_tpu",
+                    help="comma-separated subdirs of root to scan "
+                         "(default: paddle_tpu; pass '' for the whole "
+                         "root — fixture trees)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids (default: all)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: the checked-in "
+                         "paddle_tpu/analysis/baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report grandfathered findings too")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from the current findings "
+                         "and exit 0")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in ALL_RULES:
+            print(f"{r.id}\t{r.name}\t{r.rationale}")
+        return 0
+
+    if args.rules:
+        try:
+            rules = [RULES_BY_ID[rid.strip()]
+                     for rid in args.rules.split(",") if rid.strip()]
+        except KeyError as e:
+            print(f"graftlint: unknown rule {e.args[0]!r} "
+                  f"(known: {', '.join(sorted(RULES_BY_ID))})",
+                  file=sys.stderr)
+            return 2
+    else:
+        rules = list(ALL_RULES)
+
+    include = tuple(i for i in args.include.split(",") if i) or None
+    baseline_path = args.baseline or DEFAULT_BASELINE
+    if args.no_baseline:
+        baseline_path = ""
+    new, base, supp, rules = analyze(
+        root=args.root, rules=rules, baseline_path=baseline_path,
+        include=include)
+
+    if args.update_baseline:
+        path = args.baseline or DEFAULT_BASELINE
+        write_baseline(path, new + base)
+        print(f"graftlint: baseline updated "
+              f"({len(new + base)} fingerprints) -> {path}")
+        return 0
+
+    if args.json:
+        print(render_json(new, base, supp, rules))
+    else:
+        print(render_text(new, base, supp, rules))
+    return 1 if new else 0

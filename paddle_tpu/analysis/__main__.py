@@ -1,0 +1,12 @@
+"""``python -m paddle_tpu.analysis`` — the graftlint CLI.
+
+(Importing the parent package pulls in the framework; for a venv without
+jax, ``python tools/lint_framework.py`` loads this package by file path
+instead and is otherwise identical.)
+"""
+import sys
+
+from . import main
+
+if __name__ == "__main__":
+    sys.exit(main())

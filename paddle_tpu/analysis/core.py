@@ -1,0 +1,299 @@
+"""graftlint engine core: source-tree walker, AST cache, suppressions,
+baseline, reporting.
+
+The engine NEVER imports the code it analyzes — every rule works on the
+parsed AST plus raw text (``tools/lint_framework.py`` loads this package by
+file path, so the lint runs in any CI venv without jax installed). The
+design mirrors what whole-program compilation made checkable in the first
+place (arxiv 2301.13062, 2206.14148): trace purity, host-device sync
+points, and registry consistency are all visible in the source structure.
+
+Vocabulary:
+
+- a :class:`Finding` is one rule violation at a source location;
+- a finding may be silenced three ways, in priority order:
+  1. inline ``# graftlint: disable=GL001[,GL002]`` (or bare ``disable``)
+     on the offending line,
+  2. file-level ``# graftlint: disable-file=GL001`` anywhere in the file,
+  3. a baseline entry (grandfathered findings checked into
+     ``paddle_tpu/analysis/baseline.json``) — keyed by a line-number-free
+     fingerprint so unrelated edits above a finding don't churn the file;
+- the engine exits 0 iff no *new* (non-suppressed, non-baselined)
+  findings remain.
+"""
+from __future__ import annotations
+
+import ast
+import collections
+import io
+import json
+import os
+import re
+import tokenize
+
+
+class Finding:
+    """One rule violation at a source location."""
+
+    __slots__ = ("rule", "path", "line", "col", "message", "scope")
+
+    def __init__(self, rule, path, line, col, message, scope=""):
+        self.rule = rule
+        self.path = path.replace(os.sep, "/")
+        self.line = int(line)
+        self.col = int(col)
+        self.message = message
+        self.scope = scope  # dotted enclosing-def chain, "" at module level
+
+    @property
+    def fingerprint(self):
+        """Baseline key: rule + file + enclosing scope + message, NO line
+        number — a finding survives unrelated edits shifting it up or down,
+        and disappears exactly when the offending code does."""
+        return f"{self.rule}:{self.path}:{self.scope}:{self.message}"
+
+    def as_dict(self):
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "scope": self.scope,
+                "message": self.message}
+
+    def __repr__(self):
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+class SourceFile:
+    """One parsed source file: text, lines, AST, parent links, scopes."""
+
+    def __init__(self, root, relpath):
+        self.relpath = relpath.replace(os.sep, "/")
+        self.path = os.path.join(root, relpath)
+        with open(self.path, encoding="utf-8") as f:
+            self.text = f.read()
+        self.lines = self.text.splitlines()
+        self.tree = None
+        self.parse_error = None
+        try:
+            self.tree = ast.parse(self.text, filename=self.relpath)
+        except SyntaxError as e:
+            self.parse_error = e
+            return
+        # parent links + enclosing-function scope per node (rules need both
+        # to answer "is this call guarded?" / "which def owns this line?")
+        self._parents = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self._parents[child] = node
+        self._supp = _parse_suppressions(_iter_comments(self.text))
+
+    def parent(self, node):
+        return self._parents.get(node)
+
+    def ancestors(self, node):
+        cur = self._parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self._parents.get(cur)
+
+    def scope_of(self, node):
+        """Dotted chain of enclosing def names ('' at module level)."""
+        names = [a.name for a in self.ancestors(node)
+                 if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef))]
+        return ".".join(reversed(names))
+
+    def suppressed(self, rule, line):
+        """True when an inline or file-level comment disables `rule` here."""
+        file_rules, line_rules = self._supp
+        if file_rules is not None and (not file_rules or rule in file_rules):
+            return True
+        at = line_rules.get(line)
+        if at is not None and (not at or rule in at):
+            return True
+        return False
+
+
+_SUPP_RE = re.compile(
+    r"#\s*graftlint:\s*(disable(?:-file)?)\s*(?:=\s*([A-Z0-9, ]+))?")
+
+
+def _iter_comments(text):
+    """(lineno, comment_text) for every COMMENT token. Tokenizing (rather
+    than regexing raw lines) keeps directives inside string literals and
+    docstrings — e.g. documentation QUOTING the suppression syntax — from
+    acting as real suppressions."""
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+            if tok.type == tokenize.COMMENT:
+                yield tok.start[0], tok.string
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return
+
+
+def _parse_suppressions(comments):
+    """(file_rules, {lineno: rules}) — rules is a set of ids, an EMPTY set
+    meaning 'all rules'; file_rules is None when no disable-file appears."""
+    file_rules = None
+    line_rules = {}
+    for i, line in comments:
+        m = _SUPP_RE.search(line)
+        if not m:
+            continue
+        ids = set()
+        if m.group(2):
+            ids = {t.strip() for t in m.group(2).split(",") if t.strip()}
+        if m.group(1) == "disable-file":
+            # empty set means "all rules" and is absorbing: a later
+            # rule-specific disable-file must not narrow it
+            if not ids or file_rules == set():
+                file_rules = set()
+            elif file_rules is None:
+                file_rules = ids
+            else:
+                file_rules |= ids
+        else:
+            line_rules[i] = ids
+    return file_rules, line_rules
+
+
+class Project:
+    """The analyzed tree: root dir + lazily parsed source files."""
+
+    EXCLUDE_DIRS = {"__pycache__", ".git", "fixtures", "build", "dist"}
+
+    def __init__(self, root, paths=None, include=None):
+        """``root`` anchors every relpath (rules match on paths like
+        ``paddle_tpu/ops/x.py``); ``include`` restricts discovery to those
+        subdirectories of root (the CLI default scans only the package
+        tree, not tests/tools); ``paths`` bypasses discovery entirely."""
+        self.root = os.path.abspath(root)
+        if paths is None:
+            starts = ([os.path.join(self.root, i) for i in include]
+                      if include else [self.root])
+            paths = []
+            for start in starts:
+                paths.extend(self._discover(self.root, start))
+        self.files = [SourceFile(self.root, rel) for rel in sorted(paths)]
+
+    @classmethod
+    def _discover(cls, root, start):
+        rels = []
+        for dirpath, dirnames, filenames in os.walk(start):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in cls.EXCLUDE_DIRS)
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    rels.append(os.path.relpath(os.path.join(dirpath, fn),
+                                                root))
+        return rels
+
+    def read_optional(self, relpath):
+        """Text of a non-Python project artifact (docs/ops.md, catalog) or
+        None when the tree doesn't carry it (fixture mini-trees)."""
+        path = os.path.join(self.root, relpath)
+        if not os.path.exists(path):
+            return None
+        with open(path, encoding="utf-8") as f:
+            return f.read()
+
+
+def dotted_name(node):
+    """'a.b.c' for a Name/Attribute chain, or None for anything else."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def run(project, rules):
+    """Run every rule over the project; returns all findings (suppression
+    and baseline filtering happen in :func:`partition`)."""
+    findings = []
+    for f in project.files:
+        if f.parse_error is not None:
+            findings.append(Finding(
+                "GL000", f.relpath, f.parse_error.lineno or 0, 0,
+                f"syntax error: {f.parse_error.msg}"))
+    for rule in rules:
+        findings.extend(rule.check(project))
+    findings.sort(key=lambda x: (x.path, x.line, x.col, x.rule))
+    return findings
+
+
+def partition(project, findings, baseline):
+    """Split raw findings into (new, baselined, suppressed) per the
+    silencing precedence documented on this module. ``baseline`` is a
+    fingerprint multiset: each entry absorbs exactly as many occurrences
+    as were grandfathered, so ADDING a second identical violation next to
+    a baselined one still reports as new."""
+    by_path = {f.relpath: f for f in project.files}
+    budget = collections.Counter(baseline)
+    new, base, supp = [], [], []
+    for f in findings:
+        src = by_path.get(f.path)
+        if src is not None and src.parse_error is None \
+                and src.suppressed(f.rule, f.line):
+            supp.append(f)
+        elif budget[f.fingerprint] > 0:
+            budget[f.fingerprint] -= 1
+            base.append(f)
+        else:
+            new.append(f)
+    return new, base, supp
+
+
+def load_baseline(path):
+    """Fingerprint multiset (Counter) from a baseline file; empty when
+    absent."""
+    if not path or not os.path.exists(path):
+        return collections.Counter()
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return collections.Counter(data.get("fingerprints", []))
+
+
+def write_baseline(path, findings):
+    """Persist findings as grandfathered fingerprints (sorted, one entry
+    per occurrence — the multiplicity is part of the grandfather)."""
+    data = {
+        "comment": "graftlint grandfathered findings — shrink, never grow. "
+                   "Regenerate with: python -m paddle_tpu.analysis "
+                   "--update-baseline",
+        "fingerprints": sorted(f.fingerprint for f in findings),
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def render_text(new, baselined, suppressed, rules):
+    """Human report: one line per new finding + a summary."""
+    out = [repr(f) for f in new]
+    counts = {}
+    for f in new:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    per_rule = " ".join(f"{r}={n}" for r, n in sorted(counts.items()))
+    out.append(
+        f"graftlint: {len(new)} finding(s)"
+        + (f" [{per_rule}]" if per_rule else "")
+        + f", {len(baselined)} baselined, {len(suppressed)} suppressed, "
+        f"{len(rules)} rule(s)")
+    return "\n".join(out)
+
+
+def render_json(new, baselined, suppressed, rules):
+    counts = {}
+    for f in new:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    return json.dumps({
+        "findings": [f.as_dict() for f in new],
+        "counts": counts,
+        "baselined": len(baselined),
+        "suppressed": len(suppressed),
+        "rules": [r.id for r in rules],
+        "ok": not new,
+    }, indent=1, sort_keys=True)

@@ -1,0 +1,584 @@
+"""graftlint rules GL001–GL005: framework-aware static checks.
+
+Each rule encodes one invariant the runtime cannot cheaply enforce —
+trace purity, host-sync hygiene, registry/doc consistency, lock
+discipline, metric-name contract — as a pure AST/text check. Rules
+receive the whole :class:`~paddle_tpu.analysis.core.Project` so cross-file
+rules (GL003, GL005) see registrations and their catalogs together.
+
+The rationale for each rule lives in docs/static_analysis.md; the short
+form is on the rule class.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Finding, dotted_name
+
+
+class Rule:
+    id = "GL000"
+    name = "base"
+    rationale = ""
+
+    def check(self, project):
+        raise NotImplementedError
+
+    def finding(self, srcfile, node, message):
+        return Finding(self.id, srcfile.relpath,
+                       getattr(node, "lineno", 0),
+                       getattr(node, "col_offset", 0),
+                       message, scope=srcfile.scope_of(node))
+
+
+def _contains(node, pred):
+    return any(pred(n) for n in ast.walk(node))
+
+
+def _decorator_tag(dec):
+    """'to_static' / 'defop' / 'jit' when the decorator compiles the body
+    into a traced program, else None. Handles bare names, dotted paths,
+    parameterized forms (@to_static(...)), and functools.partial(jax.jit)."""
+    if isinstance(dec, ast.Call):
+        fn = dotted_name(dec.func)
+        if fn and fn.rsplit(".", 1)[-1] == "partial" and dec.args:
+            return _decorator_tag(dec.args[0])
+        dec = dec.func
+    name = dotted_name(dec)
+    if name is None:
+        return None
+    last = name.rsplit(".", 1)[-1]
+    if last == "to_static" or last.endswith("defop"):
+        return last if last == "to_static" else "defop"
+    if name in ("jax.jit", "jit") or name.endswith(".jax.jit"):
+        return "jit"
+    return None
+
+
+class TraceImpurity(Rule):
+    """GL001: host-impure calls inside traced function bodies.
+
+    A function compiled by ``to_static``/``defop``/``jax.jit`` runs its
+    Python body ONCE, at trace time (jit/api.py:32 graph-break contract):
+    ``time.time()``, ``datetime.now()``, ``np.random.*`` and file I/O
+    evaluate to one concrete value that is then baked into the compiled
+    program for every later call — a silent wrong-result bug, not a crash.
+    Use ``monitor.now_ns`` outside the traced region for timing and the
+    framework RNG (``paddle.seed`` / keyed ``jax.random``) for randomness.
+    """
+
+    id = "GL001"
+    name = "trace-impurity"
+    rationale = ("impure host calls in traced bodies run once and bake "
+                 "their value into the compiled program")
+
+    IMPURE_EXACT = {
+        "time.time", "time.time_ns", "time.perf_counter",
+        "time.perf_counter_ns", "time.monotonic", "time.monotonic_ns",
+        "datetime.now", "datetime.utcnow", "datetime.datetime.now",
+        "datetime.datetime.utcnow", "os.urandom", "uuid.uuid4",
+        "open", "input",
+    }
+    IMPURE_PREFIX = ("np.random.", "numpy.random.", "random.")
+
+    def _impure(self, call):
+        name = dotted_name(call.func)
+        if name is None:
+            return None
+        if name in self.IMPURE_EXACT:
+            return name
+        for p in self.IMPURE_PREFIX:
+            if name.startswith(p):
+                return name
+        return None
+
+    @staticmethod
+    def _traced_functions(srcfile):
+        """{FunctionDef: tag} for every function the file compiles into a
+        traced program — decorator form (@to_static/@defop/@jax.jit) AND
+        call form (``jax.jit(run, ...)`` / ``to_static(fn)``), which is
+        how the serving engine builds its cached programs. Call-form
+        targets resolve to the def with the same name in the same
+        enclosing scope (two methods may each define a local ``run``)."""
+        traced = {}
+        defs = {}
+        for n in ast.walk(srcfile.tree):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault((n.name, srcfile.scope_of(n)), []).append(n)
+                tags = [t for t in map(_decorator_tag, n.decorator_list)
+                        if t]
+                if tags:
+                    traced.setdefault(n, tags[0])
+        for call in ast.walk(srcfile.tree):
+            if not isinstance(call, ast.Call) or not call.args:
+                continue
+            tag = _decorator_tag(call)
+            arg = call.args[0]
+            if tag and isinstance(arg, ast.Name):
+                cands = defs.get((arg.id, srcfile.scope_of(call)), ())
+                if len(cands) == 1:
+                    traced.setdefault(cands[0], tag)
+        return traced
+
+    def check(self, project):
+        out = []
+        for f in project.files:
+            if f.tree is None:
+                continue
+            for fn, tag in self._traced_functions(f).items():
+                for call in ast.walk(fn):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    name = self._impure(call)
+                    if name:
+                        out.append(self.finding(
+                            f, call,
+                            f"trace-impure call {name}() inside "
+                            f"@{tag} function '{fn.name}': evaluated "
+                            "once at trace time and baked into the "
+                            "compiled program"))
+        return out
+
+
+class HostSync(Rule):
+    """GL002: device→host syncs in the dispatch/serving hot paths.
+
+    ``.item()`` / ``.numpy()`` / ``float(jnp...)`` / ``np.asarray(jnp...)``
+    each block until the device value materializes on host — one hidden
+    round-trip per call, which serializes the async dispatch pipeline when
+    it sits in an op wrapper or a decode loop. The documented exception is
+    the API-normalization idiom guarded by ``isinstance(x, Tensor)`` /
+    ``hasattr(x, "numpy")`` (Tensor-valued shape/axis arguments are a
+    graph-break point by contract, jit/api.py:32).
+    """
+
+    id = "GL002"
+    name = "host-sync-in-hot-path"
+    rationale = ("each host read blocks the async device pipeline; hot "
+                 "paths must batch or hoist them")
+
+    SCOPES = ("paddle_tpu/ops/", "paddle_tpu/models/")
+    CASTS = {"float", "int", "bool"}
+    NP_COPIES = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+    # dtype/shape introspection runs on host metadata — no device value is
+    # ever materialized, so casting these is not a sync
+    METADATA = {"jnp.issubdtype", "jnp.promote_types", "jnp.result_type",
+                "jnp.iinfo", "jnp.finfo", "jnp.dtype", "jnp.ndim",
+                "jnp.shape"}
+    METADATA_PREFIX = ("jax.tree_util.", "jax.errors.")
+
+    @staticmethod
+    def _is_guard_call(n):
+        if not isinstance(n, ast.Call):
+            return False
+        fname = dotted_name(n.func)
+        if fname == "isinstance" and len(n.args) == 2:
+            return _contains(
+                n.args[1],
+                lambda m: (isinstance(m, ast.Name)
+                           and m.id in ("Tensor", "ndarray"))
+                or (isinstance(m, ast.Attribute)
+                    and m.attr in ("Tensor", "ndarray")))
+        if fname in ("hasattr", "getattr") and len(n.args) >= 2:
+            arg = n.args[1]
+            return (isinstance(arg, ast.Constant)
+                    and arg.value in ("numpy", "value", "item"))
+        return False
+
+    @classmethod
+    def _guard_polarity(cls, test):
+        """True when the test asserts the guard (``isinstance(x, Tensor)``
+        → the BODY branch is the guarded one), False when negated
+        (``not isinstance(...)`` → the ORELSE branch is), None when the
+        test is no guard at all."""
+        for n in ast.walk(test):
+            if cls._is_guard_call(n):
+                negs = sum(1 for m in ast.walk(test)
+                           if isinstance(m, ast.UnaryOp)
+                           and isinstance(m.op, ast.Not)
+                           and _contains(m.operand, cls._is_guard_call))
+                return negs % 2 == 0
+        return None
+
+    def _guarded(self, srcfile, node):
+        """True when `node` sits in the branch an isinstance/hasattr guard
+        actually selects — a sync in the OTHER branch (the else of
+        ``if isinstance(x, Tensor):``) is exactly the unguarded case."""
+        child = node
+        for anc in srcfile.ancestors(node):
+            if isinstance(anc, (ast.If, ast.IfExp)):
+                polarity = self._guard_polarity(anc.test)
+                if polarity is not None:
+                    branch = anc.body if polarity else anc.orelse
+                    nodes = branch if isinstance(branch, list) else [branch]
+                    if any(child is b for b in nodes):
+                        return True
+            child = anc
+        return False
+
+    @classmethod
+    def _has_device_expr(cls, node):
+        def pred(n):
+            if isinstance(n, ast.Call):
+                name = dotted_name(n.func)
+                if name and (name.startswith("jnp.")
+                             or name.startswith("jax.")) \
+                        and name not in cls.METADATA \
+                        and not name.startswith(cls.METADATA_PREFIX):
+                    return True
+            return False
+
+        return _contains(node, pred)
+
+    def check(self, project):
+        out = []
+        for f in project.files:
+            if f.tree is None or not f.relpath.startswith(self.SCOPES):
+                continue
+            for call in ast.walk(f.tree):
+                if not isinstance(call, ast.Call):
+                    continue
+                msg = self._classify(f, call)
+                if msg:
+                    out.append(self.finding(f, call, msg))
+        return out
+
+    def _classify(self, srcfile, call):
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr in ("item", "numpy"):
+            # .numpy().item(): one sync, one finding (at the .numpy())
+            recv = call.func.value
+            if isinstance(recv, ast.Call) \
+                    and isinstance(recv.func, ast.Attribute) \
+                    and recv.func.attr == "numpy":
+                return None
+            if self._guarded(srcfile, call):
+                return None
+            return (f".{call.func.attr}() forces a device→host sync in a "
+                    "hot path; hoist it out of the loop or guard it with "
+                    "the isinstance(x, Tensor) normalization idiom")
+        name = dotted_name(call.func)
+        if name in self.CASTS and len(call.args) == 1 \
+                and self._has_device_expr(call.args[0]) \
+                and not self._guarded(srcfile, call):
+            return (f"{name}(<device expr>) concretizes a jax value on "
+                    "host (hidden sync); keep the reduction on device or "
+                    "hoist the read out of the hot path")
+        if name in self.NP_COPIES and call.args \
+                and self._has_device_expr(call.args[0]) \
+                and not self._guarded(srcfile, call):
+            return (f"{name}(<device expr>) copies a device value to host "
+                    "(hidden sync); compute it inside the compiled program "
+                    "and transfer only the result")
+        return None
+
+
+class RegistryConsistency(Rule):
+    """GL003: the defop registry, docs/ops.md, and AMP metadata agree.
+
+    ``defop`` registrations ARE the op registry (ops/_apply.py:429);
+    docs/ops.md is its generated, reviewed rendering. An op registered in
+    source but absent from the doc (or carrying a different AMP category)
+    means the doc — which the AMP auto-cast policy and reviewers read — is
+    stale. Dynamic registrations (f-string names) make the reverse
+    direction undecidable statically, so stale-row checks only run on
+    trees with fully-literal registration.
+    """
+
+    id = "GL003"
+    name = "registry-consistency"
+    rationale = ("docs/ops.md and AMP categories must track the defop "
+                 "registry or reviewers act on stale op metadata")
+
+    AMP_CATEGORIES = {"white", "black", "fp32"}
+    DOC = "docs/ops.md"
+    _ROW = re.compile(r"^\|\s*`([^`]+)`\s*\|")
+    _COUNT = re.compile(r"^(\d+) ops registered")
+
+    @staticmethod
+    def _reg_call(call):
+        """(kind, name_node) for defop/register_op calls; plumbing
+        (the generic call inside the defop/register_op definitions) is
+        excluded by the caller via scope."""
+        name = dotted_name(call.func)
+        if name is None:
+            return None
+        last = name.rsplit(".", 1)[-1]
+        if last.endswith("defop") or last == "register_op":
+            return last
+        return None
+
+    def check(self, project):
+        doc_text = project.read_optional(self.DOC)
+        if doc_text is None:
+            return []
+        doc_rows, doc_count, count_line = self._parse_doc(doc_text)
+
+        regs = []        # (srcfile, call, name, amp or None, amp_known)
+        dynamic = []
+        for f in project.files:
+            if f.tree is None:
+                continue
+            for call in ast.walk(f.tree):
+                if not isinstance(call, ast.Call) or not self._reg_call(call):
+                    continue
+                scope = f.scope_of(call)
+                if scope.rsplit(".", 1)[-1] in ("defop", "register_op",
+                                                "deco"):
+                    continue  # the registry plumbing itself
+                if not call.args or not isinstance(call.args[0], ast.Constant) \
+                        or not isinstance(call.args[0].value, str):
+                    dynamic.append((f, call))
+                    continue
+                amp, amp_known = None, True
+                for kw in call.keywords:
+                    if kw.arg == "amp_category":
+                        if isinstance(kw.value, ast.Constant):
+                            amp = kw.value.value
+                        else:
+                            amp_known = False
+                regs.append((f, call, call.args[0].value, amp, amp_known))
+
+        out = []
+        seen = {}
+        for f, call, name, amp, amp_known in regs:
+            if name in seen:
+                out.append(self.finding(
+                    f, call,
+                    f"op '{name}' registered twice (also at "
+                    f"{seen[name]}); the registry is a name-keyed "
+                    "contract, the second registration silently wins"))
+            else:
+                seen[name] = f"{f.relpath}:{call.lineno}"
+            if amp is not None and amp not in self.AMP_CATEGORIES:
+                out.append(self.finding(
+                    f, call,
+                    f"op '{name}' has unknown amp_category {amp!r} "
+                    f"(expected one of {sorted(self.AMP_CATEGORIES)})"))
+            if name not in doc_rows:
+                out.append(self.finding(
+                    f, call,
+                    f"op '{name}' registered here but has no row in "
+                    f"{self.DOC} — regenerate it with "
+                    "`python -m paddle_tpu.ops.optable`"))
+            elif amp_known and (amp or "-") != doc_rows[name][1]:
+                out.append(self.finding(
+                    f, call,
+                    f"op '{name}' amp_category={(amp or '-')!r} here but "
+                    f"{self.DOC} says {doc_rows[name][1]!r} — stale doc, "
+                    "regenerate it"))
+        if not dynamic:
+            for name, (line, _amp) in sorted(doc_rows.items()):
+                if name not in seen:
+                    out.append(Finding(
+                        self.id, self.DOC, line, 0,
+                        f"doc row for op '{name}' has no registration in "
+                        "the source tree — stale doc, regenerate it"))
+        if doc_count is not None and doc_count != len(doc_rows):
+            out.append(Finding(
+                self.id, self.DOC, count_line, 0,
+                f"doc header claims {doc_count} ops but the table has "
+                f"{len(doc_rows)} rows — regenerate it"))
+        return out
+
+    def _parse_doc(self, text):
+        rows, count, count_line = {}, None, 0
+        for i, line in enumerate(text.splitlines(), 1):
+            m = self._ROW.match(line)
+            if m and m.group(1) != "op":
+                cols = [c.strip() for c in line.strip().strip("|").split("|")]
+                amp = cols[-1] if len(cols) >= 4 else "-"
+                rows[m.group(1)] = (i, amp)
+                continue
+            m = self._COUNT.match(line)
+            if m:
+                count, count_line = int(m.group(1)), i
+        return rows, count, count_line
+
+
+class LockDiscipline(Rule):
+    """GL004: no device dispatch or blocking wait inside a lock body.
+
+    ``with self._lock:`` bodies must be short, host-only critical
+    sections: a ``jax.*``/``jnp.*`` call under the lock can block on
+    device execution (or worse, re-enter instrumented dispatch that takes
+    the same lock), and ``time.sleep``/``.join()``/``.wait()`` turn the
+    metric registry or serving engine into a convoy. Move device work and
+    waits outside, keep only the state mutation inside.
+    """
+
+    id = "GL004"
+    name = "lock-discipline"
+    rationale = ("device dispatch or blocking waits under a lock convoy "
+                 "every other thread touching that lock")
+
+    BLOCKING_ATTRS = {"join", "wait", "acquire", "result"}
+    BLOCKING_EXACT = {"time.sleep"}
+
+    @staticmethod
+    def _lock_ctx(item):
+        name = dotted_name(item.context_expr)
+        return name is not None and name.rsplit(".", 1)[-1].lower().endswith(
+            "lock")
+
+    def check(self, project):
+        out = []
+        for f in project.files:
+            if f.tree is None:
+                continue
+            for w in ast.walk(f.tree):
+                if not isinstance(w, ast.With) \
+                        or not any(self._lock_ctx(i) for i in w.items):
+                    continue
+                lock = next(dotted_name(i.context_expr) for i in w.items
+                            if self._lock_ctx(i))
+                for call in ast.walk(w):
+                    msg = self._classify(call, lock)
+                    if msg:
+                        out.append(self.finding(f, call, msg))
+        return out
+
+    def _classify(self, call, lock):
+        if not isinstance(call, ast.Call):
+            return None
+        name = dotted_name(call.func)
+        if name and (name.startswith("jax.") or name.startswith("jnp.")):
+            return (f"device dispatch {name}() inside `with {lock}:` can "
+                    "block on the device (or re-enter instrumented "
+                    "dispatch) while every other thread waits on the lock")
+        if name in self.BLOCKING_EXACT:
+            return (f"{name}() sleeps while holding `{lock}` — every "
+                    "other thread touching the lock convoys behind it")
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr in self.BLOCKING_ATTRS \
+                and not isinstance(call.func.value, ast.Constant):
+            return (f".{call.func.attr}() blocks while holding `{lock}`; "
+                    "wait outside the critical section")
+        return None
+
+
+class MetricNameContract(Rule):
+    """GL005: the telemetry metric-name contract (absorbs
+    tools/check_metric_names.py, whose CLI stays as a thin shim).
+
+    Every ``paddle_tpu_*`` metric registered anywhere in the tree must be
+    declared in ``paddle_tpu/monitor/catalog.py`` and follow the
+    ``paddle_tpu_<subsystem>_<name>`` convention (counters end ``_total``)
+    — dashboards and artifact validators key on these exact strings, so an
+    undeclared or misnamed metric is a contract break, not a style issue.
+    """
+
+    id = "GL005"
+    name = "metric-name-contract"
+    rationale = ("metric names are a dashboard-facing contract; "
+                 "undeclared or misnamed series break consumers silently")
+
+    CATALOG = "paddle_tpu/monitor/catalog.py"
+    REG_FUNCS = {"counter", "gauge", "histogram"}
+    KINDS = ("counter", "gauge", "histogram")
+
+    @staticmethod
+    def load_catalog(path):
+        """Execute the (dependency-free by design) catalog module by file
+        path — shared with the tools/check_metric_names.py shim."""
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location("_graftlint_catalog",
+                                                      path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def strict_problems(self, project, findings=None):
+        """The PR 1 check_metric_names semantics, in one place for both
+        the shim CLI and the run_static_checks aggregator: no baseline,
+        inline suppressions honored, and a MISSING catalog is a failure
+        (the rule itself skips quietly on catalog-less fixture trees).
+        Pass ``findings`` to reuse an existing engine run."""
+        from .core import partition, run
+
+        if project.read_optional(self.CATALOG) is None:
+            return [f"{self.CATALOG}: catalog not found under "
+                    f"{project.root} — the metric-name contract cannot "
+                    "be checked"]
+        if findings is None:
+            findings = run(project, [self])
+        else:
+            findings = [f for f in findings if f.rule == self.id]
+        new, _base, _supp = partition(project, findings, ())
+        return [f"{f.path}:{f.line}: {f.message}" for f in new]
+
+    def check(self, project):
+        if project.read_optional(self.CATALOG) is None:
+            return []
+        import os
+
+        cat = self.load_catalog(os.path.join(project.root, self.CATALOG))
+        name_re = re.compile(cat.NAME_PATTERN)
+        out = []
+        catfile = next((f for f in project.files
+                        if f.relpath == self.CATALOG), None)
+
+        def cat_line(name):
+            if catfile is None:
+                return 0
+            for i, line in enumerate(catfile.lines, 1):
+                if f'"{name}"' in line:
+                    return i
+            return 0
+
+        for name, (kind, _labels, help_text) in sorted(cat.METRICS.items()):
+            loc = cat_line(name)
+            if not name_re.match(name):
+                out.append(Finding(
+                    self.id, self.CATALOG, loc, 0,
+                    f"catalog name {name} does not match paddle_tpu_"
+                    f"<{'|'.join(cat.SUBSYSTEMS)}>_<name>"))
+            if kind == "counter" and not name.endswith("_total"):
+                out.append(Finding(
+                    self.id, self.CATALOG, loc, 0,
+                    f"catalog counter {name} must end in _total"))
+            if kind not in self.KINDS:
+                out.append(Finding(
+                    self.id, self.CATALOG, loc, 0,
+                    f"catalog name {name} has unknown type {kind!r}"))
+            if not help_text:
+                out.append(Finding(
+                    self.id, self.CATALOG, loc, 0,
+                    f"catalog name {name} has no help text"))
+
+        declared = set(cat.METRICS)
+        for f in project.files:
+            if f.tree is None:
+                continue
+            for call in ast.walk(f.tree):
+                if not isinstance(call, ast.Call) or not call.args:
+                    continue
+                fname = dotted_name(call.func)
+                if fname is None \
+                        or fname.rsplit(".", 1)[-1] not in self.REG_FUNCS:
+                    continue
+                arg = call.args[0]
+                if not (isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)
+                        and arg.value.startswith("paddle_tpu_")):
+                    continue
+                name = arg.value
+                if name not in declared:
+                    out.append(self.finding(
+                        f, call,
+                        f"metric {name} registered but not declared in "
+                        f"{self.CATALOG}"))
+                elif not name_re.match(name):
+                    out.append(self.finding(
+                        f, call,
+                        f"metric {name} violates the naming convention "
+                        f"{cat.NAME_PATTERN}"))
+        return out
+
+
+ALL_RULES = (TraceImpurity(), HostSync(), RegistryConsistency(),
+             LockDiscipline(), MetricNameContract())
+
+RULES_BY_ID = {r.id: r for r in ALL_RULES}

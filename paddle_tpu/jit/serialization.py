@@ -16,6 +16,7 @@ import pickle
 import numpy as np
 
 import jax
+import jax.export  # noqa: F401  (jax>=0.4.36 stopped lazy-loading the submodule)
 import jax.numpy as jnp
 
 from ..framework.core import Tensor

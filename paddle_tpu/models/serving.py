@@ -108,6 +108,16 @@ class ContinuousBatchingEngine:
         self._jit_cache = {}
         # submit() queue: requests waiting for a free slot (host-side)
         self._pending = collections.deque()
+        # device-resident decode inputs: between admissions/evictions the
+        # step feeds back its own device outputs (tokens) and increments
+        # lens on device, so steady-state decoding does ZERO host→device
+        # uploads per token (GL002); the host arrays above stay the source
+        # of truth and re-seed the device copies whenever slot state
+        # changes (_host_dirty)
+        self._host_dirty = True
+        self._tok_dev = None
+        self._lens_dev = None
+        self._active_dev = None
 
     # -- compiled paths ------------------------------------------------------
     def _prefill_slot_jit(self, bucket):
@@ -134,7 +144,10 @@ class ContinuousBatchingEngine:
                     new_pools.append(pool)
                 x = _rms(x, e.norm_w, e.eps)
                 logits = x @ e.head_w
-                return logits[0, length - 1], new_pools
+                # argmax INSIDE the program: admission transfers one int32
+                # to host, not a vocab-size logits row (GL002 host-sync)
+                tok = jnp.argmax(logits[0, length - 1], -1)
+                return tok.astype(jnp.int32), new_pools
 
             cache[key] = jax.jit(run, donate_argnums=(1,))
             if mon.state.on:
@@ -279,15 +292,16 @@ class ContinuousBatchingEngine:
         need[slot] = L + 1
         self._pager.ensure_capacity(need)
         row_tables = self._pager.block_tables[slot:slot + 1]
-        logits, self._pools = self._prefill_slot_jit(bucket)(
+        tok_dev, self._pools = self._prefill_slot_jit(bucket)(
             jnp.asarray(padded), self._pools, row_tables,
             jnp.asarray(L, jnp.int32))
-        tok = int(np.asarray(jnp.argmax(logits, -1)))
+        tok = int(tok_dev)
         self.active[slot] = True
         self.lens[slot] = L
         self.request_ids[slot] = rid
         self.last_token[slot, 0] = tok
         self.outputs[slot] = [tok]
+        self._host_dirty = True
         if mon.state.on:
             t1 = mon.mod.now_ns()
             mon.admitted.inc()
@@ -309,11 +323,21 @@ class ContinuousBatchingEngine:
         t0 = mon.mod.now_ns()
         n_decoded = int(self.active.sum())
         self._pager.ensure_capacity(self.lens + self.active)
+        if self._host_dirty:
+            self._tok_dev = jnp.asarray(self.last_token)
+            self._lens_dev = jnp.asarray(self.lens, jnp.int32)
+            self._active_dev = jnp.asarray(self.active, jnp.int32)
+            self._host_dirty = False
         step = self._step_all_jit()
-        toks, self._pools = step(
-            jnp.asarray(self.last_token), self._pools,
-            self._pager.block_tables, jnp.asarray(self.lens, jnp.int32))
-        toks = np.asarray(toks)
+        toks_dev, self._pools = step(
+            self._tok_dev, self._pools,
+            self._pager.block_tables, self._lens_dev)
+        # feed the step's own outputs back for the next one (inactive rows
+        # carry garbage on device; they are re-seeded from host at the
+        # next admission via _host_dirty)
+        self._tok_dev = toks_dev[:, None]
+        self._lens_dev = self._lens_dev + self._active_dev
+        toks = np.asarray(toks_dev)
         finished = []
         for slot in np.flatnonzero(self.active):
             slot = int(slot)
@@ -342,6 +366,7 @@ class ContinuousBatchingEngine:
         self.lens[slot] = 0
         self.request_ids[slot] = None
         self.outputs[slot] = []
+        self._host_dirty = True
         mon = _mon()
         if mon.state.on:
             mon.evictions.inc()

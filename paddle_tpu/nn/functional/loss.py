@@ -314,58 +314,63 @@ def square_error_cost(input, label):  # noqa: A002
     return _mse_loss(input, label, reduction="none")
 
 
-def log_loss(input, label, epsilon=1e-4, name=None):  # noqa: A002
-    @defop("log_loss_op")
-    def _ll(input, label, epsilon=1e-4):  # noqa: A002
-        return -label * jnp.log(input + epsilon) - (1 - label) * jnp.log(1 - input + epsilon)
+# module level, not inside log_loss: a defop inside the function body
+# would re-register on every call (registry churn + a fresh OpDef identity
+# defeating the per-signature vjp cache) and never reach docs/ops.md (GL003)
+@defop("log_loss_op")
+def _log_loss_op(input, label, epsilon=1e-4):  # noqa: A002
+    return -label * jnp.log(input + epsilon) - (1 - label) * jnp.log(1 - input + epsilon)
 
-    return _ll(input, label, epsilon=float(epsilon))
+
+def log_loss(input, label, epsilon=1e-4, name=None):  # noqa: A002
+    return _log_loss_op(input, label, epsilon=float(epsilon))
+
+
+@defop("ctc_loss_op", amp_category="black")
+def _ctc_loss_op(log_probs, labels, input_lengths, label_lengths, blank=0):
+    # log_probs: (T, N, C) paddle layout
+    T, N, C = log_probs.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+    lbl = labels.astype(jnp.int32)
+    ext = jnp.full((N, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(lbl)
+    neg_inf = -1e30
+
+    # alpha init
+    alpha0 = jnp.full((N, S), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(log_probs[0, jnp.arange(N), blank])
+    first_lbl = log_probs[0, jnp.arange(N), ext[:, 1]]
+    alpha0 = alpha0.at[:, 1].set(jnp.where(label_lengths > 0, first_lbl, neg_inf))
+
+    same_as_prev2 = jnp.concatenate(
+        [jnp.zeros((N, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1
+    )
+
+    def step(alpha, t):
+        a_shift1 = jnp.concatenate([jnp.full((N, 1), neg_inf), alpha[:, :-1]], axis=1)
+        a_shift2 = jnp.concatenate([jnp.full((N, 2), neg_inf), alpha[:, :-2]], axis=1)
+        a_shift2 = jnp.where(same_as_prev2, neg_inf, a_shift2)
+        merged = jnp.logaddexp(alpha, jnp.logaddexp(a_shift1, a_shift2))
+        emit = log_probs[t][jnp.arange(N)[:, None], ext]
+        new_alpha = merged + emit
+        new_alpha = jnp.where(t < input_lengths[:, None], new_alpha, alpha)
+        return new_alpha, None
+
+    alphaT, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+    end_idx = 2 * label_lengths
+    last = alphaT[jnp.arange(N), end_idx]
+    last2 = jnp.where(end_idx - 1 >= 0, alphaT[jnp.arange(N), jnp.maximum(end_idx - 1, 0)],
+                      neg_inf)
+    ll = jnp.logaddexp(last, last2)
+    return -ll
 
 
 def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean",
              norm_by_times=False):
     """CTC via the standard forward algorithm under lax.scan (reference:
     nn/functional/loss.py ctc_loss over warpctc)."""
-    @defop("ctc_loss_op", amp_category="black")
-    def _ctc(log_probs, labels, input_lengths, label_lengths, blank=0):
-        # log_probs: (T, N, C) paddle layout
-        T, N, C = log_probs.shape
-        L = labels.shape[1]
-        S = 2 * L + 1
-        lbl = labels.astype(jnp.int32)
-        ext = jnp.full((N, S), blank, jnp.int32)
-        ext = ext.at[:, 1::2].set(lbl)
-        neg_inf = -1e30
-
-        # alpha init
-        alpha0 = jnp.full((N, S), neg_inf)
-        alpha0 = alpha0.at[:, 0].set(log_probs[0, jnp.arange(N), blank])
-        first_lbl = log_probs[0, jnp.arange(N), ext[:, 1]]
-        alpha0 = alpha0.at[:, 1].set(jnp.where(label_lengths > 0, first_lbl, neg_inf))
-
-        same_as_prev2 = jnp.concatenate(
-            [jnp.zeros((N, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1
-        )
-
-        def step(alpha, t):
-            a_shift1 = jnp.concatenate([jnp.full((N, 1), neg_inf), alpha[:, :-1]], axis=1)
-            a_shift2 = jnp.concatenate([jnp.full((N, 2), neg_inf), alpha[:, :-2]], axis=1)
-            a_shift2 = jnp.where(same_as_prev2, neg_inf, a_shift2)
-            merged = jnp.logaddexp(alpha, jnp.logaddexp(a_shift1, a_shift2))
-            emit = log_probs[t][jnp.arange(N)[:, None], ext]
-            new_alpha = merged + emit
-            new_alpha = jnp.where(t < input_lengths[:, None], new_alpha, alpha)
-            return new_alpha, None
-
-        alphaT, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
-        end_idx = 2 * label_lengths
-        last = alphaT[jnp.arange(N), end_idx]
-        last2 = jnp.where(end_idx - 1 >= 0, alphaT[jnp.arange(N), jnp.maximum(end_idx - 1, 0)],
-                          neg_inf)
-        ll = jnp.logaddexp(last, last2)
-        return -ll
-
-    loss = _ctc(log_probs, labels, input_lengths, label_lengths, blank=int(blank))
+    loss = _ctc_loss_op(log_probs, labels, input_lengths, label_lengths, blank=int(blank))
     if reduction == "mean":
         from ...ops.reduction import mean as mean_op
         from ...ops.math import divide
@@ -378,13 +383,14 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction
     return loss
 
 
-def dice_loss(input, label, epsilon=1e-5, name=None):  # noqa: A002
-    @defop("dice_loss_op")
-    def _dice(input, label, epsilon=1e-5):  # noqa: A002
-        lbl = jax.nn.one_hot(label.squeeze(-1), input.shape[-1], dtype=input.dtype)
-        red = tuple(range(1, input.ndim))
-        inter = jnp.sum(input * lbl, axis=red)
-        union = jnp.sum(input, axis=red) + jnp.sum(lbl, axis=red)
-        return jnp.mean(1.0 - (2 * inter + epsilon) / (union + epsilon))
+@defop("dice_loss_op")
+def _dice_loss_op(input, label, epsilon=1e-5):  # noqa: A002
+    lbl = jax.nn.one_hot(label.squeeze(-1), input.shape[-1], dtype=input.dtype)
+    red = tuple(range(1, input.ndim))
+    inter = jnp.sum(input * lbl, axis=red)
+    union = jnp.sum(input, axis=red) + jnp.sum(lbl, axis=red)
+    return jnp.mean(1.0 - (2 * inter + epsilon) / (union + epsilon))
 
-    return _dice(input, label, epsilon=float(epsilon))
+
+def dice_loss(input, label, epsilon=1e-5, name=None):  # noqa: A002
+    return _dice_loss_op(input, label, epsilon=float(epsilon))

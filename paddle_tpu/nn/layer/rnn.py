@@ -41,6 +41,21 @@ def _cell_step(mode, x_t, h, c, w_ih, w_hh, b_ih, b_hh):
     return act(gates), c
 
 
+# module level like gru_cell (a defop inside forward() would re-register
+# per call: registry churn, a fresh OpDef identity defeating the
+# per-signature vjp cache, and no docs/ops.md row — GL003)
+@defop("simple_rnn_cell")
+def _simple_rnn_cell(x, h, w_ih, w_hh, b_ih, b_hh, activation="tanh"):
+    g = x @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+    return jnp.tanh(g) if activation == "tanh" else jax.nn.relu(g)
+
+
+@defop("lstm_cell")
+def _lstm_cell(x, h, c, w_ih, w_hh, b_ih, b_hh):
+    h2, c2 = _cell_step("LSTM", x, h, c, w_ih, w_hh, b_ih, b_hh)
+    return h2, c2
+
+
 @defop("gru_cell")
 def _gru_cell_op(x, h, w_ih, w_hh, b_ih, b_hh):
     h2, _ = _cell_step("GRU", x, h, None, w_ih, w_hh, b_ih, b_hh)
@@ -223,14 +238,8 @@ class SimpleRNNCell(RNNCellBase):
     def forward(self, inputs, states=None):
         if states is None:
             states = self.get_initial_states(inputs)
-
-        @defop("simple_rnn_cell")
-        def _cell(x, h, w_ih, w_hh, b_ih, b_hh, activation="tanh"):
-            g = x @ w_ih.T + b_ih + h @ w_hh.T + b_hh
-            return jnp.tanh(g) if activation == "tanh" else jax.nn.relu(g)
-
-        h = _cell(inputs, states, self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh,
-                  activation=self.activation)
+        h = _simple_rnn_cell(inputs, states, self.weight_ih, self.weight_hh, self.bias_ih,
+                             self.bias_hh, activation=self.activation)
         return h, h
 
 
@@ -256,14 +265,8 @@ class LSTMCell(RNNCellBase):
             c = self.get_initial_states(inputs)
         else:
             h, c = states
-
-        @defop("lstm_cell")
-        def _cell(x, h, c, w_ih, w_hh, b_ih, b_hh):
-            h2, c2 = _cell_step("LSTM", x, h, c, w_ih, w_hh, b_ih, b_hh)
-            return h2, c2
-
-        h2, c2 = _cell(inputs, h, c, self.weight_ih, self.weight_hh, self.bias_ih,
-                       self.bias_hh)
+        h2, c2 = _lstm_cell(inputs, h, c, self.weight_ih, self.weight_hh, self.bias_ih,
+                            self.bias_hh)
         return h2, (h2, c2)
 
 

@@ -160,7 +160,7 @@ def _check_nan_inf(name, vals):
         return
     for v in vals:
         if hasattr(v, "dtype") and jnp.issubdtype(np.dtype(v.dtype), jnp.inexact):
-            bad = bool(jnp.any(~jnp.isfinite(v)))
+            bad = bool(jnp.any(~jnp.isfinite(v)))  # graftlint: disable=GL002 — flag-gated debug scan, off the default path
             if bad:
                 if flags.flag("check_nan_inf_level") > 0:
                     print(f"[paddle_tpu] nan/inf detected in output of op {name}")

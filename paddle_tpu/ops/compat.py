@@ -353,8 +353,12 @@ def standard_gamma(x, name=None):
 def binomial(count, prob, name=None):
     c = count.value if isinstance(count, Tensor) else jnp.asarray(count)
     p = prob.value if isinstance(prob, Tensor) else jnp.asarray(prob)
-    return Tensor(jax.random.binomial(rng.next_key(), c.astype(jnp.float32),
-                                      p).astype(jnp.int64))
+    # float64 internally: jax<=0.4.37's BTRS sampler mixes python-float
+    # constants (f64 under x64) with the count dtype, so f32 counts hit
+    # "lax.clamp requires arguments to have the same dtypes"
+    return Tensor(jax.random.binomial(rng.next_key(), c.astype(jnp.float64),
+                                      p.astype(jnp.float64))
+                  .astype(jnp.int64))
 
 
 def log_normal(mean=1.0, std=2.0, shape=None, name=None):

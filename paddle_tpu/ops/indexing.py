@@ -53,7 +53,7 @@ def getitem(x, idx):
             mask = idx[0]
             from .manipulation import gather, masked_select, reshape
 
-            m = np.asarray(mask.numpy())
+            m = np.asarray(mask.numpy())  # graftlint: disable=GL002 — bool-mask indexing is eager-only by contract (dynamic shape)
             if m.ndim == x.ndim:
                 return masked_select(x, mask)
             k = m.ndim

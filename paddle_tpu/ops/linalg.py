@@ -147,12 +147,12 @@ def eigvalsh(x, UPLO="L", name=None):
 
 def eig(x, name=None):
     # general eig is host-lapack in jax (CPU only); keep eager
-    w, v = np.linalg.eig(np.asarray(x.numpy()))
+    w, v = np.linalg.eig(np.asarray(x.numpy()))  # graftlint: disable=GL002 — host LAPACK: XLA has no nonsymmetric eig
     return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
 
 
 def eigvals(x, name=None):
-    w = np.linalg.eigvals(np.asarray(x.numpy()))
+    w = np.linalg.eigvals(np.asarray(x.numpy()))  # graftlint: disable=GL002 — host LAPACK: XLA has no nonsymmetric eig
     return Tensor(jnp.asarray(w))
 
 

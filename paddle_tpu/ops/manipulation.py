@@ -609,7 +609,7 @@ def _require_concrete(x, opname):
 
 def nonzero(x, as_tuple=False):
     _require_concrete(x, "nonzero")
-    idx = np.nonzero(np.asarray(x.numpy()))
+    idx = np.nonzero(np.asarray(x.numpy()))  # graftlint: disable=GL002 — dynamic output shape, eager-only (_require_concrete)
     if as_tuple:
         return tuple(Tensor(jnp.asarray(i[:, None])) for i in idx)
     return Tensor(jnp.asarray(np.stack(idx, axis=1).astype(np.int64)))
@@ -617,7 +617,7 @@ def nonzero(x, as_tuple=False):
 
 def masked_select(x, mask, name=None):
     _require_concrete(x, "masked_select")
-    m = np.asarray(mask.numpy()).astype(bool)
+    m = np.asarray(mask.numpy()).astype(bool)  # graftlint: disable=GL002 — dynamic output shape, eager-only (_require_concrete)
     flat_idx = np.nonzero(np.broadcast_to(m, x.value.shape).reshape(-1))[0]
     idx_t = Tensor(jnp.asarray(flat_idx))
     return gather(reshape(x, [-1]), idx_t)
@@ -638,7 +638,7 @@ def masked_scatter(x, mask, value, name=None):
 def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None,
            dtype="int64", name=None):
     _require_concrete(x, "unique")
-    arr = np.asarray(x.numpy())
+    arr = np.asarray(x.numpy())  # graftlint: disable=GL002 — dynamic output shape, eager-only (_require_concrete)
     res = np.unique(arr, return_index=True, return_inverse=True, return_counts=True, axis=axis)
     vals, index, inverse, counts = res
     outs = [Tensor(jnp.asarray(vals))]
@@ -654,7 +654,7 @@ def unique(x, return_index=False, return_inverse=False, return_counts=False, axi
 def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64",
                        name=None):
     _require_concrete(x, "unique_consecutive")
-    arr = np.asarray(x.numpy())
+    arr = np.asarray(x.numpy())  # graftlint: disable=GL002 — dynamic output shape, eager-only (_require_concrete)
     if axis is None:
         arr = arr.reshape(-1)
         keep = np.ones(arr.shape[0], bool)

@@ -100,6 +100,14 @@ class OptimizerWithMixedPrecision:
     # -- Program train-hook protocol (static/__init__.py Executor.run) ------
     def _amp_train_step(self, live_loss):
         if self._scaler is not None:
+            if str(live_loss.dtype).endswith("float16"):
+                # O2 replay leaves the loss in fp16; scaling must happen in
+                # fp32 or loss * 2**15 overflows fp16's 65504 max and every
+                # step is skipped (the reference forces the loss fp32 via
+                # its black-list rewrite before update_loss_scaling)
+                from ..ops.manipulation import cast
+
+                live_loss = cast(live_loss, "float32")
             scaled = self._scaler.scale(live_loss)
             scaled.backward()
             self._scaler.step(self._inner)

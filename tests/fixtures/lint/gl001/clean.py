@@ -1,0 +1,17 @@
+"""GL001 clean sample: traced bodies that stay pure."""
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.jit import to_static
+from paddle_tpu.ops._apply import defop
+
+
+@to_static
+def pure_forward(x, key):
+    # keyed randomness threads through the trace — re-randomized per call
+    return x + jax.random.normal(key, x.shape)
+
+
+@defop("scaled_tanh")
+def scaled_tanh(x, scale=1.0):
+    return jnp.tanh(x) * scale

@@ -1,0 +1,41 @@
+"""GL001 dirty sample: impure host calls inside traced bodies."""
+import random
+import time
+
+import numpy as np
+
+from paddle_tpu.jit import to_static
+from paddle_tpu.ops._apply import defop
+
+
+@to_static
+def stamped_forward(x):
+    # baked once at trace time: every later call sees the SAME timestamp
+    t = time.time()
+    return x * t
+
+
+@defop("noisy_scale")
+def noisy_scale(x):
+    # one random draw at trace time, constant forever after
+    return x * np.random.uniform(0.9, 1.1)
+
+
+@to_static(full_graph=False)
+def jittered(x):
+    return x + random.random()
+
+
+def plain_helper(x):
+    # NOT traced: impurity here is fine (rule must not fire)
+    return x * time.time()
+
+
+def build_step():
+    import jax
+
+    def run(pools, x):
+        # call-form tracing (the serving-engine pattern): still baked in
+        return pools, x * np.random.rand()
+
+    return jax.jit(run, donate_argnums=(0,))

@@ -1,0 +1,27 @@
+"""GL002 clean sample: host reads only behind the documented guards."""
+import jax.numpy as jnp
+
+from paddle_tpu.framework.core import Tensor
+
+
+def normalized_axis(x, axis):
+    # the documented API-normalization idiom: Tensor-valued axis args are
+    # a graph-break point by contract
+    if isinstance(axis, Tensor):
+        axis = int(axis.numpy())
+    return jnp.sum(x, axis=axis)
+
+
+def ternary_guard(shape):
+    return tuple(int(s.numpy()) if isinstance(s, Tensor) else int(s)
+                 for s in shape)
+
+
+def device_side(x):
+    # reduction stays on device — no sync
+    return jnp.max(jnp.abs(x))
+
+
+def metadata_only(x):
+    # dtype introspection is host metadata, not a device value
+    return bool(jnp.issubdtype(x.dtype, jnp.inexact))

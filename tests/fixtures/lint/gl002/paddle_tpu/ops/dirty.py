@@ -1,0 +1,27 @@
+"""GL002 dirty sample: hidden device→host syncs on the dispatch path."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def unguarded_reads(x, axis):
+    k = int(axis.numpy())           # unguarded host read
+    v = x.item()                    # unguarded host read
+    return k, v
+
+
+def hidden_reduction(x):
+    return float(jnp.max(jnp.abs(x)))   # concretizes a device value
+
+
+def hidden_copy(x):
+    return np.asarray(jnp.argmax(x, -1))   # device→host copy
+
+
+def wrong_branch(x, axis):
+    from paddle_tpu.framework.core import Tensor
+
+    if isinstance(axis, Tensor):
+        axis = 0
+    else:
+        axis = int(axis.numpy())   # the guard selects the OTHER branch
+    return jnp.sum(x, axis=axis)

@@ -1,0 +1,14 @@
+"""GL003 clean sample: every registration matches its docs/ops.md row."""
+import jax.numpy as jnp
+
+from paddle_tpu.ops._apply import defop
+
+
+@defop("fx_add")
+def fx_add(x, y):
+    return x + y
+
+
+@defop("fx_matmul", amp_category="white")
+def fx_matmul(x, y):
+    return jnp.matmul(x, y)

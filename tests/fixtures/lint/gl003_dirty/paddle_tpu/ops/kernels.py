@@ -1,0 +1,22 @@
+"""GL003 dirty sample: registry and docs/ops.md disagree four ways."""
+import jax.numpy as jnp
+
+from paddle_tpu.ops._apply import defop
+
+
+@defop("fx_undocumented")
+def fx_undocumented(x):
+    # registered here but docs/ops.md has no row for it
+    return x + 1
+
+
+@defop("fx_matmul", amp_category="black")
+def fx_matmul(x, y):
+    # docs/ops.md says amp=white — stale metadata
+    return jnp.matmul(x, y)
+
+
+@defop("fx_matmul", amp_category="bf16ish")
+def fx_matmul_again(x, y):
+    # duplicate registration (silently wins) + unknown amp category
+    return jnp.matmul(x, y)

@@ -1,0 +1,18 @@
+"""GL004 clean sample: short host-only critical sections."""
+import threading
+
+
+class GoodRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts = {}
+
+    def record(self, key, host_value):
+        # device work happens BEFORE the lock; the critical section is
+        # one dict mutation
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0) + host_value
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self._counts)

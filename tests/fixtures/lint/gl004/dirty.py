@@ -1,0 +1,22 @@
+"""GL004 dirty sample: device work and blocking waits under a lock."""
+import threading
+import time
+
+import jax.numpy as jnp
+
+
+class BadRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._total = 0.0
+
+    def record(self, values):
+        with self._lock:
+            # device dispatch under the lock: every other thread convoys
+            # behind XLA execution
+            self._total += float(jnp.sum(values))
+
+    def flush(self, worker):
+        with self._lock:
+            time.sleep(0.1)      # sleeping while holding the lock
+            worker.join()        # blocking join under the lock

@@ -1,0 +1,6 @@
+"""GL005 clean sample: every registered metric is declared."""
+
+
+def bind(monitor):
+    return (monitor.counter("paddle_tpu_serving_requests_total"),
+            monitor.gauge("paddle_tpu_dispatch_depth"))

@@ -1,0 +1,12 @@
+"""GL005 clean fixture catalog (dependency-free, loadable by file path)."""
+
+SUBSYSTEMS = ("serving", "dispatch")
+
+NAME_PATTERN = r"^paddle_tpu_(" + "|".join(SUBSYSTEMS) + r")_[a-z][a-z0-9_]*$"
+
+METRICS = {
+    "paddle_tpu_serving_requests_total": (
+        "counter", (), "Requests admitted."),
+    "paddle_tpu_dispatch_depth": (
+        "gauge", (), "Current dispatch queue depth."),
+}

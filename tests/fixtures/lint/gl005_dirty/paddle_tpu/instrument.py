@@ -1,0 +1,5 @@
+"""GL005 dirty sample: a registration the catalog never declared."""
+
+
+def bind(monitor):
+    return monitor.counter("paddle_tpu_serving_shadow_total")

@@ -1,0 +1,14 @@
+"""GL005 dirty fixture catalog: two in-catalog violations."""
+
+SUBSYSTEMS = ("serving", "dispatch")
+
+NAME_PATTERN = r"^paddle_tpu_(" + "|".join(SUBSYSTEMS) + r")_[a-z][a-z0-9_]*$"
+
+METRICS = {
+    # counter not ending in _total
+    "paddle_tpu_serving_requests": (
+        "counter", (), "Requests admitted."),
+    # unknown subsystem token + missing help text
+    "paddle_tpu_mystery_depth": (
+        "gauge", (), ""),
+}

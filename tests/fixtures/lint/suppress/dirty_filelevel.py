@@ -1,0 +1,11 @@
+"""File-level suppression sample: disable-file silences GL001 everywhere
+in this file (the violation below has no inline comment)."""
+# graftlint: disable-file=GL001
+import time
+
+from paddle_tpu.jit import to_static
+
+
+@to_static
+def stamped_forward(x):
+    return x * time.time()

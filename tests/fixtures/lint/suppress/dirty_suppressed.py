@@ -1,0 +1,17 @@
+"""Suppression sample: same GL001 violations as gl001/dirty.py, silenced
+inline and per-file — the engine must report nothing here."""
+import random
+import time
+
+from paddle_tpu.jit import to_static
+
+
+@to_static
+def stamped_forward(x):
+    t = time.time()  # graftlint: disable=GL001 — trace-time stamp is intended here
+    return x * t
+
+
+@to_static
+def jittered(x):
+    return x + random.random()  # graftlint: disable

@@ -197,6 +197,13 @@ def sps_expit_t(x):
     return paddle.nn.functional.sigmoid(x)
 
 
+def _dice_ref(p):
+    oh = np.eye(p.shape[-1])[_LBL4]
+    inter = (p * oh).sum(axis=1)
+    union = p.sum(axis=1) + oh.sum(axis=1)
+    return np.mean(1.0 - (2 * inter + 1e-5) / (union + 1e-5))
+
+
 def _index_add_ref(x, v):
     out = np.zeros_like(x)
     for k, i in enumerate(_IDX3):
@@ -652,6 +659,17 @@ TAIL_CASES = [
     OpCase("multi_margin_loss",
            lambda x: F.multi_margin_loss(x, paddle.to_tensor(_LBL4)),
            _multi_margin_ref, [S]),
+    OpCase("log_loss_op",
+           lambda x, y: F.log_loss(sps_expit_t(x), sps_expit_t(y),
+                                   epsilon=1e-4),
+           lambda x, y: (-sps.expit(y) * np.log(sps.expit(x) + 1e-4)
+                         - (1 - sps.expit(y))
+                         * np.log(1 - sps.expit(x) + 1e-4)),
+           [S, S], grad_inputs=[0]),
+    OpCase("dice_loss_op",
+           lambda x: F.dice_loss(sps_expit_t(x),
+                                 paddle.to_tensor(_LBL4[:, None])),
+           lambda x: _dice_ref(sps.expit(x)), [S]),
     OpCase("triplet_margin",
            lambda a, p, n: F.triplet_margin_loss(a, p, n, margin=1.0),
            # epsilon rides on |a-b| before the p-norm (reference loss.py)
@@ -932,6 +950,12 @@ WAIVERS = {
     # recurrent/scan kernels: sequence-level tests in test_nn rnn suites
     "rnn_scan": "lstm/gru sequence parity tests in test_nn",
     "gru_cell": "cell-level parity tests in test_nn",
+    "simple_rnn_cell": "cell drives the rnn_scan sequence suites; torch "
+                       "gate-order parity in test_torch_parity",
+    "lstm_cell": "cell drives the rnn_scan sequence suites; torch "
+                 "gate-order parity in test_torch_parity",
+    "ctc_loss_op": "forward-algorithm lattice; torch parity in "
+                   "test_torch_parity test_ctc_loss_matches_torch",
     "rnnt_loss": "lattice recursion tested against slow DP in test_nn",
     # kernels with dedicated suites where a flat numpy oracle would just
     # duplicate a weaker copy of the existing test

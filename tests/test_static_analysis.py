@@ -1,0 +1,264 @@
+"""graftlint (paddle_tpu/analysis): the framework-aware static-analysis
+gate, tier-1.
+
+Three contracts under test:
+
+1. the shipped tree is CLEAN — zero non-baselined findings over
+   paddle_tpu/ with the checked-in baseline (the same invariant
+   ``python -m paddle_tpu.analysis`` enforces with its exit code);
+2. every rule GL001–GL005 fires on its dirty fixture and stays silent on
+   its clean one (tests/fixtures/lint/ mini-trees);
+3. the silencing machinery works: inline + file-level suppressions, and
+   the baseline round-trip (grandfather findings, rerun clean).
+
+The CLI surfaces (tools/lint_framework.py without importing the
+framework, the PR 1 tools/check_metric_names.py exit-code contract, and
+the tools/run_static_checks.py aggregator) are exercised as subprocesses.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from paddle_tpu import analysis
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIX = os.path.join(ROOT, "tests", "fixtures", "lint")
+
+
+def _analyze(subdir, rules=None):
+    """(new, baselined, suppressed) over one fixture mini-tree, no
+    baseline."""
+    rule_objs = None
+    if rules is not None:
+        rule_objs = [analysis.RULES_BY_ID[r] for r in rules]
+    new, base, supp, _ = analysis.analyze(
+        root=os.path.join(FIX, subdir), rules=rule_objs,
+        baseline_path="", include=None)
+    return new, base, supp
+
+
+class TestShippedTree:
+    def test_tree_is_clean_with_shipped_baseline(self):
+        """The acceptance invariant: `python -m paddle_tpu.analysis`
+        exits 0 on this tree. Any new finding must be fixed, suppressed
+        with a rationale, or (exceptionally) baselined."""
+        new, _base, _supp, rules = analysis.analyze()
+        assert len(rules) == 5
+        assert not new, "new graftlint findings:\n" + "\n".join(
+            repr(f) for f in new)
+
+    def test_baseline_only_shrinks(self):
+        """The grandfathered-debt file stays small (self-clean shipped a
+        near-empty baseline; additions need a strong reason)."""
+        fps = analysis.load_baseline(analysis.DEFAULT_BASELINE)
+        assert len(fps) <= 8
+
+
+class TestRuleFixtures:
+    """One dirty + one clean sample per rule; dirty must fire exactly the
+    rule under test, clean must be silent."""
+
+    @pytest.mark.parametrize("subdir,rule,expect", [
+        # gl001 includes a call-form jax.jit(run) case; gl002 includes a
+        # sync in the unselected branch of an isinstance guard
+        ("gl001", "GL001", 4),
+        ("gl002", "GL002", 5),
+        ("gl003_dirty", "GL003", 7),
+        ("gl004", "GL004", 3),
+        ("gl005_dirty", "GL005", 4),
+    ])
+    def test_dirty_fixture_fires(self, subdir, rule, expect):
+        new, _, _ = _analyze(subdir)
+        assert {f.rule for f in new} == {rule}
+        assert len(new) == expect
+        # flat fixtures keep violations in dirty.py; clean.py is silent
+        for f in new:
+            assert "clean" not in f.path
+
+    @pytest.mark.parametrize("subdir", ["gl003_clean", "gl005_clean"])
+    def test_clean_trees_are_silent(self, subdir):
+        new, _, _ = _analyze(subdir)
+        assert new == []
+
+    def test_findings_carry_location_and_scope(self):
+        new, _, _ = _analyze("gl001")
+        f = next(x for x in new if "time.time" in x.message)
+        assert f.path == "dirty.py" and f.line > 0
+        assert f.scope == "stamped_forward"
+        assert f.rule == "GL001"
+        d = f.as_dict()
+        assert d["message"] and d["line"] == f.line
+
+    def test_rule_selection(self):
+        new, _, _ = _analyze("gl001", rules=["GL002"])
+        assert new == []
+
+
+class TestSuppression:
+    def test_inline_and_file_level(self):
+        new, _base, supp = _analyze("suppress")
+        assert new == []
+        assert len(supp) == 3  # two inline + one file-level GL001
+
+    def test_suppression_is_rule_specific(self):
+        src = os.path.join(FIX, "suppress", "dirty_suppressed.py")
+        f = analysis.Project(FIX, paths=[
+            os.path.relpath(src, FIX)]).files[0]
+        line = next(i for i, l in enumerate(f.lines, 1)
+                    if "disable=GL001" in l)
+        assert f.suppressed("GL001", line)
+        assert not f.suppressed("GL002", line)
+
+    def test_bare_disable_file_is_absorbing(self, tmp_path):
+        """A later rule-specific disable-file must not narrow an earlier
+        bare (all-rules) one — comment order must not matter."""
+        root = tmp_path / "tree"
+        root.mkdir()
+        (root / "mod.py").write_text(
+            "# graftlint: disable-file\n"
+            "# graftlint: disable-file=GL003\n"
+            "import time\n"
+            "from paddle_tpu.jit import to_static\n\n\n"
+            "@to_static\n"
+            "def f(x):\n"
+            "    return x * time.time()\n")
+        new, _, supp, _ = analysis.analyze(root=str(root), baseline_path="",
+                                           include=None)
+        assert new == []
+        assert [f.rule for f in supp] == ["GL001"]
+
+    def test_directive_inside_string_is_not_a_suppression(self, tmp_path):
+        """Only COMMENT tokens carry directives: documentation that QUOTES
+        the suppression syntax in a docstring must not silence the file."""
+        root = tmp_path / "tree"
+        root.mkdir()
+        (root / "mod.py").write_text(
+            '"""Docs: write `# graftlint: disable-file=GL001` to opt '
+            'out."""\n'
+            "import time\n"
+            "from paddle_tpu.jit import to_static\n\n\n"
+            "@to_static\n"
+            "def f(x):\n"
+            "    return x * time.time()\n")
+        new, _, supp, _ = analysis.analyze(root=str(root), baseline_path="",
+                                           include=None)
+        assert [f.rule for f in new] == ["GL001"]
+        assert supp == []
+
+
+class TestBaseline:
+    def test_roundtrip(self, tmp_path):
+        new, _, _ = _analyze("gl001")
+        assert new
+        bl = tmp_path / "baseline.json"
+        analysis.write_baseline(str(bl), new)
+        new2, base2, _, _ = analysis.analyze(
+            root=os.path.join(FIX, "gl001"), baseline_path=str(bl),
+            include=None)
+        assert new2 == []
+        assert len(base2) == len(new)
+
+    def test_fingerprint_survives_line_drift(self, tmp_path):
+        """Baseline keys carry no line number: prepending code above a
+        grandfathered finding must not resurrect it."""
+        root = tmp_path / "tree"
+        root.mkdir()
+        dirty = open(os.path.join(FIX, "gl001", "dirty.py")).read()
+        (root / "mod.py").write_text(dirty)
+        new, _, _, _ = analysis.analyze(root=str(root), baseline_path="",
+                                        include=None)
+        bl = tmp_path / "bl.json"
+        analysis.write_baseline(str(bl), new)
+        (root / "mod.py").write_text("# shifted\n# shifted\n" + dirty)
+        new2, base2, _, _ = analysis.analyze(
+            root=str(root), baseline_path=str(bl), include=None)
+        assert new2 == []
+        assert len(base2) == len(new)
+
+    def test_duplicate_violation_is_not_absorbed(self, tmp_path):
+        """The baseline is a multiset: grandfathering ONE .numpy() sync in
+        a scope must not silence a SECOND identical one added later."""
+        root = tmp_path / "tree"
+        (root / "paddle_tpu" / "ops").mkdir(parents=True)
+        mod = root / "paddle_tpu" / "ops" / "m.py"
+        mod.write_text("def f(x, y):\n    a = x.numpy()\n    return a\n")
+        new, _, _, _ = analysis.analyze(root=str(root), baseline_path="",
+                                        include=None)
+        assert len(new) == 1
+        bl = tmp_path / "bl.json"
+        analysis.write_baseline(str(bl), new)
+        mod.write_text("def f(x, y):\n    a = x.numpy()\n"
+                       "    b = y.numpy()\n    return a + b\n")
+        new2, base2, _, _ = analysis.analyze(
+            root=str(root), baseline_path=str(bl), include=None)
+        assert len(base2) == 1 and len(new2) == 1
+
+
+class TestCLISurfaces:
+    def _run(self, *cmd):
+        return subprocess.run([sys.executable, *cmd], cwd=ROOT,
+                              capture_output=True, text=True, timeout=120)
+
+    def test_lint_framework_runs_without_importing_the_framework(self):
+        """tools/lint_framework.py path-loads the analysis package: dirty
+        fixture -> exit 1 with parseable JSON; clean fixture -> exit 0."""
+        p = self._run("tools/lint_framework.py", "--root",
+                      os.path.join(FIX, "gl002"), "--include", "",
+                      "--no-baseline", "--json")
+        assert p.returncode == 1, p.stderr
+        report = json.loads(p.stdout)
+        assert report["counts"] == {"GL002": 5}
+        assert not report["ok"]
+        p = self._run("tools/lint_framework.py", "--root",
+                      os.path.join(FIX, "gl003_clean"), "--include", "")
+        assert p.returncode == 0, p.stdout + p.stderr
+
+    def test_check_metric_names_exit_contract(self):
+        """The PR 1 CLI (now a GL005 shim) still exits 0 on the clean
+        repo and still supports --list."""
+        p = self._run("tools/check_metric_names.py")
+        assert p.returncode == 0, p.stdout + p.stderr
+        assert "OK" in p.stdout
+        p = self._run("tools/check_metric_names.py", "--list")
+        assert p.returncode == 0
+        assert "paddle_tpu_dispatch_op_calls_total\tcounter" in p.stdout
+
+    def test_run_static_checks_aggregator(self):
+        p = self._run("tools/run_static_checks.py", "--json")
+        assert p.returncode == 0, p.stdout + p.stderr
+        summary = json.loads(p.stdout)
+        assert summary["ok"] is True
+        assert [c["check"] for c in summary["checks"]] == [
+            "graftlint", "check_metric_names"]
+        assert all(c["ok"] for c in summary["checks"])
+
+    def test_aggregator_and_shim_agree_on_suppressed_metric(self, tmp_path):
+        """A suppressed GL005 registration must pass BOTH strict surfaces
+        (they share strict_problems) — CI must never fail a row that no
+        documented CLI reproduces."""
+        import shutil
+
+        root = tmp_path / "tree"
+        (root / "paddle_tpu" / "monitor").mkdir(parents=True)
+        shutil.copy(os.path.join(ROOT, "paddle_tpu", "monitor",
+                                 "catalog.py"),
+                    root / "paddle_tpu" / "monitor" / "catalog.py")
+        (root / "paddle_tpu" / "rogue.py").write_text(
+            'def bind(m):\n'
+            '    return m.counter("paddle_tpu_dispatch_rogue_total")'
+            '  # graftlint: disable=GL005\n')
+        sys.path.insert(0, os.path.join(ROOT, "tools"))
+        try:
+            import check_metric_names as shim
+            import run_static_checks as agg
+
+            assert shim.check(root=str(root)) == []
+            rows = agg.run_checks(root=str(root))
+            assert [r["check"] for r in rows] == ["graftlint",
+                                                 "check_metric_names"]
+            assert rows[1]["ok"], rows[1]
+        finally:
+            sys.path.remove(os.path.join(ROOT, "tools"))

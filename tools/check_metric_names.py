@@ -1,89 +1,40 @@
 #!/usr/bin/env python
-"""Lint the telemetry metric-name contract.
+"""Lint the telemetry metric-name contract (PR 1 CLI, kept stable).
 
-Checks, without importing the framework (the catalog is loaded by file
-path, so this runs in any CI venv in milliseconds):
-
-1. every name in ``paddle_tpu/monitor/catalog.py`` matches the documented
-   ``paddle_tpu_<subsystem>_<name>`` convention (known subsystem token,
-   snake_case, counters end in ``_total``);
-2. every ``"paddle_tpu_*"`` string literal registered in the source tree
-   (``monitor.counter/gauge/histogram`` call sites) is declared in the
-   catalog — an undeclared metric is a contract violation, not a warning.
-
-Exit 0 when clean; exit 1 with one line per violation otherwise.
+Since the graftlint engine shipped, this is a thin shim over rule GL005
+(``paddle_tpu/analysis/rules.py``) — the catalog checks and the
+registration scan live there now, AST-based instead of regex. The CLI
+contract is unchanged: exit 0 when clean, exit 1 with one line per
+violation on stderr; ``--list`` prints the catalog. Nothing here imports
+the framework (the analysis package is stdlib-only and loaded by file
+path).
 """
 from __future__ import annotations
 
-import importlib.util
 import os
-import re
 import sys
 
-ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from lint_framework import ROOT, load_analysis  # noqa: E402
+
 CATALOG = os.path.join(ROOT, "paddle_tpu", "monitor", "catalog.py")
-
-# registration call followed (possibly across a line break) by the name
-# literal: m.counter(\n    "paddle_tpu_...", ...)
-_REG_RE = re.compile(
-    r"\b(?:counter|gauge|histogram)\s*\(\s*\n?\s*\"(paddle_tpu_[a-z0-9_]*)\"",
-    re.MULTILINE)
-
-
-def _load_catalog():
-    spec = importlib.util.spec_from_file_location("_mon_catalog", CATALOG)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
 
 
 def check(root=ROOT):
-    cat = _load_catalog()
-    name_re = re.compile(cat.NAME_PATTERN)
-    problems = []
-
-    for name, (kind, _labels, help_text) in sorted(cat.METRICS.items()):
-        if not name_re.match(name):
-            problems.append(
-                f"catalog: {name} does not match paddle_tpu_"
-                f"<{('|'.join(cat.SUBSYSTEMS))}>_<name>")
-        if kind == "counter" and not name.endswith("_total"):
-            problems.append(f"catalog: counter {name} must end in _total")
-        if kind not in ("counter", "gauge", "histogram"):
-            problems.append(f"catalog: {name} has unknown type {kind!r}")
-        if not help_text:
-            problems.append(f"catalog: {name} has no help text")
-
-    declared = set(cat.METRICS)
-    pkg = os.path.join(root, "paddle_tpu")
-    for dirpath, dirnames, filenames in os.walk(pkg):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fn in filenames:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            with open(path, encoding="utf-8") as f:
-                src = f.read()
-            for m in _REG_RE.finditer(src):
-                name = m.group(1)
-                if name not in declared:
-                    rel = os.path.relpath(path, root)
-                    line = src[:m.start()].count("\n") + 1
-                    problems.append(
-                        f"{rel}:{line}: metric {name} registered but not "
-                        "declared in paddle_tpu/monitor/catalog.py")
-                elif not name_re.match(name):
-                    rel = os.path.relpath(path, root)
-                    problems.append(
-                        f"{rel}: metric {name} violates the naming "
-                        "convention")
-    return problems
+    """[(message, ...)] of GL005 violations over `root` — strict mode:
+    no baseline, suppressions honored, missing catalog is a failure
+    (rules.MetricNameContract.strict_problems, one implementation shared
+    with tools/run_static_checks.py)."""
+    an = load_analysis()
+    project = an.Project(root, include=("paddle_tpu",))
+    return an.RULES_BY_ID["GL005"].strict_problems(project)
 
 
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else argv
     if "--list" in argv:
-        cat = _load_catalog()
+        an = load_analysis()
+        cat = an.RULES_BY_ID["GL005"].load_catalog(CATALOG)
         for name, (kind, labels, _help) in sorted(cat.METRICS.items()):
             print(f"{name}\t{kind}\t{','.join(labels) or '-'}")
         return 0

@@ -1,0 +1,41 @@
+#!/usr/bin/env python
+"""graftlint CLI that does NOT import the framework.
+
+``python -m paddle_tpu.analysis`` initializes paddle_tpu (and therefore
+jax) just to reach the linter; this shim loads ``paddle_tpu/analysis`` by
+file path — the package is stdlib-only by design — so the same checks run
+in any CI venv in milliseconds. Arguments and exit codes are identical to
+the module CLI.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PKG = os.path.join(ROOT, "paddle_tpu", "analysis")
+
+
+def load_analysis():
+    """The analysis package under a standalone alias (no paddle_tpu
+    import). Idempotent; also used by run_static_checks.py and the
+    check_metric_names.py shim."""
+    alias = "paddle_tpu_analysis_standalone"
+    if alias in sys.modules:
+        return sys.modules[alias]
+    spec = importlib.util.spec_from_file_location(
+        alias, os.path.join(_PKG, "__init__.py"),
+        submodule_search_locations=[_PKG])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[alias] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None):
+    return load_analysis().main(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

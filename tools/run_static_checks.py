@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Run every static check in one invocation (CI aggregator).
+
+One analysis pass (parse the tree once) feeds two result rows:
+
+1. graftlint (GL001–GL005 over paddle_tpu/, baseline + suppressions
+   applied — the tier-1 gate's view);
+2. the metric-name contract (GL005 strict: no baseline, inline
+   suppressions honored, and a missing catalog is a failure — identical
+   to tools/check_metric_names.py, which shares the same
+   strict_problems() implementation; that CLI's exit-code contract is
+   covered by the subprocess test in tests/test_static_analysis.py).
+
+Prints one status line per check, then a machine-readable JSON summary on
+stdout (``--json`` prints ONLY the JSON). Exit 0 iff every check passed.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from lint_framework import ROOT, load_analysis  # noqa: E402
+
+
+def run_checks(root=ROOT):
+    """[result-row, ...] — one shared parse of the tree for both rows."""
+    an = load_analysis()
+    t0 = time.perf_counter()
+    project = an.Project(root, include=("paddle_tpu",))
+    findings = an.run(project, list(an.ALL_RULES))
+    baseline = an.load_baseline(an.DEFAULT_BASELINE)
+    new, base, supp = an.partition(project, findings, baseline)
+    counts = {}
+    for f in new:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    rows = [{
+        "check": "graftlint",
+        "ok": not new,
+        "findings": len(new),
+        "counts": counts,
+        "baselined": len(base),
+        "suppressed": len(supp),
+        "detail": [repr(f) for f in new],
+        "seconds": round(time.perf_counter() - t0, 3),
+    }]
+
+    t0 = time.perf_counter()
+    problems = an.RULES_BY_ID["GL005"].strict_problems(project, findings)
+    rows.append({
+        "check": "check_metric_names",
+        "ok": not problems,
+        "findings": len(problems),
+        "detail": problems,
+        "seconds": round(time.perf_counter() - t0, 3),
+    })
+    return rows
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    json_only = "--json" in argv
+    try:
+        results = run_checks()
+    except Exception as e:  # a crashed checker is a failed check
+        results = [{"check": "run_static_checks", "ok": False,
+                    "findings": -1, "seconds": 0.0,
+                    "detail": [f"{type(e).__name__}: {e}"]}]
+    if not json_only:
+        for res in results:
+            status = "OK" if res["ok"] else f"FAIL ({res['findings']})"
+            print(f"[{status:>9}] {res['check']} ({res['seconds']}s)")
+            for line in () if res["ok"] else res["detail"]:
+                print(f"    {line}")
+    summary = {"ok": all(r["ok"] for r in results), "checks": results}
+    print(json.dumps(summary, indent=1, sort_keys=True) if json_only
+          else f"run_static_checks: "
+               f"{'OK' if summary['ok'] else 'FAILURES'} "
+               f"({len(results)} checks)")
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
