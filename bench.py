@@ -417,6 +417,50 @@ def _dispatch_bench():
     return out
 
 
+def _trace_overhead_bench():
+    """Span-tracing tax on the dispatch microbench: us/op with tracing
+    enabled vs disabled (the sampled dispatch.op spans are the only
+    enabled-mode cost on this path). Stamped as detail.trace_overhead so
+    future BENCH_*.json rounds track the trace tax like any other
+    regression."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.monitor import trace
+
+    y = paddle.to_tensor(np.random.RandomState(1).randn(4, 4).astype("float32"))
+    xg = paddle.to_tensor(np.random.RandomState(2).randn(4, 4).astype("float32"),
+                          stop_gradient=False)
+
+    def _t(f, n=60, reps=5):
+        # min-of-reps floor (tests/test_monitor.py _floor_us): the DELTA of
+        # two measurements is meaningless if either one eats a scheduler
+        # hiccup
+        f()
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                f()
+            best = min(best, (time.perf_counter() - t0) / n * 1e6)
+        return round(best, 2)
+
+    assert not trace.enabled()
+    off = _t(lambda: xg + y)
+    trace.enable()
+    try:
+        on = _t(lambda: xg + y)
+    finally:
+        trace.disable()
+        trace.reset()
+    return {
+        "add_tape_on_fwd_us_trace_off": off,
+        "add_tape_on_fwd_us_trace_on": on,
+        "delta_us": round(on - off, 2),
+        "dispatch_sample_every": trace.dispatch_sample_every(),
+    }
+
+
 # the donated fused train step + timing-loop machinery is shared with
 # bench_suite.py — see bench_common.py (the tunnel rules live there)
 
@@ -541,6 +585,14 @@ def worker():
     except Exception as e:  # noqa: BLE001 - the headline metric must survive
         dispatch_us = {"error": f"{type(e).__name__}: {e}"[:200]}
     _log(f"[bench] dispatch_us: {dispatch_us}")
+
+    try:
+        trace_overhead = ({"skipped": True}
+                          if os.environ.get("BENCH_SKIP_DISPATCH")
+                          else _trace_overhead_bench())
+    except Exception as e:  # noqa: BLE001 - the headline metric must survive
+        trace_overhead = {"error": f"{type(e).__name__}: {e}"[:200]}
+    _log(f"[bench] trace_overhead: {trace_overhead}")
     if on_tpu and not flash_info.get("skipped") and not flash_info.get("ok"):
         # kernel unproven on this chip -> train on the XLA math path rather than
         # risk a mid-bench compile failure; the JSON records why.
@@ -668,6 +720,7 @@ def worker():
                                              "full")},
             "flash_attention": flash_info,
             "dispatch_us": dispatch_us,
+            "trace_overhead": trace_overhead,
             "decode": decode_info,
         },
     }

@@ -138,6 +138,16 @@ def decorate(models, optimizers=None, level="O1", dtype="float16", master_weight
                     opt._use_master_weights = True if master_weight is None else master_weight
                 if hasattr(opt, "_use_master_grad"):
                     opt._use_master_grad = bool(master_grad)
+        # fp32 master gradients end to end: backward re-linearizes
+        # reduced-precision ops in fp32 (autograd/tape.py master grad) and
+        # the optimizer upcasts any reduced grad before its update. Every
+        # O2 decorate SETS the mode from its master_grad argument, so
+        # decorate(master_grad=False) restores the default instead of
+        # inheriting a stale process-wide True from an earlier decorate.
+        from ..autograd import tape as _tape
+
+        _tape.set_master_grad(bool(master_grad))
+        _amp_global_state.use_master_grad = bool(master_grad)
     if optimizers is None:
         return models
     return models, optimizers

@@ -1,10 +1,11 @@
-"""graftlint rules GL001–GL005: framework-aware static checks.
+"""graftlint rules GL001–GL006: framework-aware static checks.
 
 Each rule encodes one invariant the runtime cannot cheaply enforce —
 trace purity, host-sync hygiene, registry/doc consistency, lock
-discipline, metric-name contract — as a pure AST/text check. Rules
-receive the whole :class:`~paddle_tpu.analysis.core.Project` so cross-file
-rules (GL003, GL005) see registrations and their catalogs together.
+discipline, metric-name contract, span-name contract — as a pure AST/text
+check. Rules receive the whole
+:class:`~paddle_tpu.analysis.core.Project` so cross-file rules (GL003,
+GL005, GL006) see registrations and their catalogs together.
 
 The rationale for each rule lives in docs/static_analysis.md; the short
 form is on the rule class.
@@ -578,7 +579,128 @@ class MetricNameContract(Rule):
         return out
 
 
+class SpanNameContract(Rule):
+    """GL006: the trace span-name contract (the GL005 of the span layer).
+
+    Every span the framework emits (``monitor/trace.py``) must be declared
+    in ``paddle_tpu/monitor/catalog.py`` ``SPANS`` and follow the
+    ``<subsystem>.<name>`` convention — trace viewers, flight-recorder
+    consumers and the hang-dump workflow key on the exact strings, so an
+    undeclared or misnamed span is a contract break, not a style issue.
+    """
+
+    id = "GL006"
+    name = "span-name-contract"
+    rationale = ("span names are a trace-viewer/hang-dump contract; "
+                 "undeclared or misnamed spans break consumers silently")
+
+    CATALOG = "paddle_tpu/monitor/catalog.py"
+    # functions whose first string-literal argument is a span name
+    EMIT_FUNCS = {"span", "start_span", "record_span"}
+
+    load_catalog = staticmethod(MetricNameContract.load_catalog)
+
+    def strict_problems(self, project, findings=None):
+        """Aggregator semantics (tools/run_static_checks.py): no baseline,
+        inline suppressions honored, and a catalog without a SPANS table is
+        a failure (the rule itself skips quietly on span-less fixture
+        trees). Pass ``findings`` to reuse an existing engine run."""
+        from .core import partition, run
+
+        if project.read_optional(self.CATALOG) is None:
+            return [f"{self.CATALOG}: catalog not found under "
+                    f"{project.root} — the span-name contract cannot "
+                    "be checked"]
+        import os
+
+        cat = self.load_catalog(os.path.join(project.root, self.CATALOG))
+        if getattr(cat, "SPANS", None) is None:
+            return [f"{self.CATALOG}: no SPANS table — the span-name "
+                    "contract cannot be checked"]
+        if findings is None:
+            findings = run(project, [self])
+        else:
+            findings = [f for f in findings if f.rule == self.id]
+        new, _base, _supp = partition(project, findings, ())
+        return [f"{f.path}:{f.line}: {f.message}" for f in new]
+
+    def check(self, project):
+        if project.read_optional(self.CATALOG) is None:
+            return []
+        import os
+
+        cat = self.load_catalog(os.path.join(project.root, self.CATALOG))
+        spans = getattr(cat, "SPANS", None)
+        if spans is None:
+            return []   # metric-only fixture catalog: nothing to enforce
+        subsystems = tuple(getattr(cat, "SPAN_SUBSYSTEMS", ()))
+        name_re = re.compile(getattr(
+            cat, "SPAN_PATTERN",
+            r"^(" + "|".join(subsystems) + r")(\.[a-z][a-z0-9_]*)+$"))
+        out = []
+        catfile = next((f for f in project.files
+                        if f.relpath == self.CATALOG), None)
+
+        def cat_line(name):
+            if catfile is None:
+                return 0
+            for i, line in enumerate(catfile.lines, 1):
+                if f'"{name}"' in line:
+                    return i
+            return 0
+
+        for name, help_text in sorted(spans.items()):
+            loc = cat_line(name)
+            if not name_re.match(name):
+                out.append(Finding(
+                    self.id, self.CATALOG, loc, 0,
+                    f"catalog span {name} does not match "
+                    f"<{'|'.join(subsystems)}>.<name>"))
+            if not help_text:
+                out.append(Finding(
+                    self.id, self.CATALOG, loc, 0,
+                    f"catalog span {name} has no help text"))
+
+        declared = set(spans)
+        for f in project.files:
+            if f.tree is None:
+                continue
+            for call in ast.walk(f.tree):
+                if not isinstance(call, ast.Call) or not call.args:
+                    continue
+                fname = dotted_name(call.func)
+                if fname is not None:
+                    last = fname.rsplit(".", 1)[-1]
+                elif isinstance(call.func, ast.Attribute):
+                    # non-dotted receivers too (mon[5].record_span(...) —
+                    # the lazily-bound handle tuples of the instrument
+                    # sites): the method name alone identifies an emitter
+                    last = call.func.attr
+                else:
+                    continue
+                if last not in self.EMIT_FUNCS:
+                    continue
+                arg = call.args[0]
+                if not (isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)
+                        and "." in arg.value
+                        and arg.value.split(".", 1)[0] in subsystems):
+                    continue    # dynamic names / foreign span() calls
+                name = arg.value
+                if name not in declared:
+                    out.append(self.finding(
+                        f, call,
+                        f"span {name} emitted but not declared in "
+                        f"{self.CATALOG} SPANS"))
+                elif not name_re.match(name):
+                    out.append(self.finding(
+                        f, call,
+                        f"span {name} violates the naming convention "
+                        f"{name_re.pattern}"))
+        return out
+
+
 ALL_RULES = (TraceImpurity(), HostSync(), RegistryConsistency(),
-             LockDiscipline(), MetricNameContract())
+             LockDiscipline(), MetricNameContract(), SpanNameContract())
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}

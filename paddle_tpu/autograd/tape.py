@@ -11,6 +11,7 @@ dispatch layer so grad-of-grad is taped too.
 from __future__ import annotations
 
 import contextlib
+import functools
 import weakref
 
 import numpy as np
@@ -27,6 +28,11 @@ _GRAD_ENABLED = [True]
 # Functional mode: graph capture (jit.to_static) computes grads with jax.grad over the pure
 # function; the Python tape is suspended so tracing costs nothing.
 _FUNCTIONAL_MODE = [False]
+# Master-grad mode: pullbacks of reduced-precision (fp16/bf16) ops re-run in
+# fp32 and cotangents stay fp32 end to end, so a scaled loss (2**15) cannot
+# overflow the fp16 grads themselves (paddle.amp master_grad; the reference's
+# fp32 master gradient accumulation for O2 training).
+_MASTER_GRAD = [False]
 
 
 def is_grad_enabled() -> bool:
@@ -96,6 +102,28 @@ def functional_mode():
         yield
     finally:
         _FUNCTIONAL_MODE[0] = prev
+
+
+@contextlib.contextmanager
+def master_grad():
+    """Run backward passes inside this context with fp32 master gradients:
+    reduced-precision ops re-linearize in fp32 (see _master_vjp)."""
+    prev = _MASTER_GRAD[0]
+    _MASTER_GRAD[0] = True
+    try:
+        yield
+    finally:
+        _MASTER_GRAD[0] = prev
+
+
+def set_master_grad(mode):
+    """Process-wide master-grad switch (paddle.amp.decorate(master_grad=
+    True)); the master_grad() context scopes it per-backward."""
+    _MASTER_GRAD[0] = bool(mode)
+
+
+def master_grad_enabled():
+    return _MASTER_GRAD[0]
 
 
 def in_functional_mode() -> bool:
@@ -411,12 +439,111 @@ def _as_tensor(x):
     return x if isinstance(x, Tensor) else Tensor(x)
 
 
+_REDUCED = (jnp.float16, jnp.bfloat16)
+
+
+def _is_reduced(dt):
+    return np.dtype(dt) in (np.dtype(jnp.float16), np.dtype(jnp.bfloat16))
+
+
+@functools.lru_cache(maxsize=4096)
+def _master_bwd(pure_fn):
+    """Jitted fp32 re-linearization of an op's pure function: one trace per
+    (pure_fn, avals) signature, shared across backward steps. Cotangents
+    are conformed to the recomputed outputs' dtypes INSIDE the program.
+
+    ONLY for pure functions with stable identity (``master_cacheable``,
+    stamped by the per-signature caches in ops/_apply.py): caching the
+    fresh per-call closures of the fallback dispatch path would never hit
+    AND pin up to maxsize closures (each holding that call's input arrays)
+    — those take the uncached jax.vjp route in _master_vjp instead."""
+
+    @jax.jit
+    def bwd(vals, cots):
+        outs, vjp_fn = jax.vjp(pure_fn, *vals)
+        cots = _conform_cots(cots, outs)
+        return vjp_fn(cots)
+
+    return bwd
+
+
+def _conform_cots(cots, outs):
+    """Cast each inexact cotangent to its recomputed output's dtype."""
+    return tuple(
+        jnp.asarray(c, o.dtype)
+        if _is_inexact(getattr(c, "dtype", np.float32))
+        and np.dtype(o.dtype) != np.dtype(c.dtype) else c
+        for c, o in zip(cots, outs))
+
+
+def _master_vjp(node, cots):
+    """fp32 pullback for a reduced-precision op, or None to use the stored
+    (reduced-precision) pullback.
+
+    The mechanics of master grad here: the op's pure function is dtype-
+    polymorphic (one jax function serves fp16 and fp32), so re-linearizing
+    it at the fp32-upcast residuals evaluates the SAME pullback in fp32
+    arithmetic — grad values like 6 * 2**15 that overflow fp16's 65504 max
+    stay finite, and the resulting fp32 cotangents flow on to become fp32
+    leaf .grad (the master gradient) for fp16 and fp32 params alike.
+    ``cast`` nodes are the one non-polymorphic op (they hard-cast): their
+    pullback is mathematically the identity between inexact dtypes, so the
+    fp32 cotangent passes straight through instead of round-tripping
+    through the fp16 bottleneck that caused the overflow."""
+    in_vals = [ref.value for ref in node.inputs]
+    if node.name == "cast":
+        if (len(in_vals) == 1 and len(cots) == 1
+                and _is_inexact(in_vals[0].dtype)
+                and _is_inexact(node.out_avals[0].dtype)):
+            c = cots[0]
+            c = c.value if isinstance(c, Tensor) else c
+            if _is_reduced(getattr(c, "dtype", np.float32)):
+                c = jnp.asarray(c, jnp.float32)
+            tgt = in_vals[0].dtype
+            if not _is_reduced(tgt) \
+                    and np.dtype(getattr(c, "dtype", np.float32)) \
+                    != np.dtype(tgt):
+                c = jnp.asarray(c, tgt)   # e.g. an fp64 source stays fp64
+            return [c]
+        return None
+    involved = any(_is_reduced(v.dtype) for v in in_vals
+                   if hasattr(v, "dtype")) \
+        or any(_is_reduced(a.dtype) for a in node.out_avals
+               if _is_inexact(a.dtype))
+    if not involved or node.pure_fn is None:
+        return None
+    if not all(_is_inexact(a.dtype) for a in node.out_avals):
+        return None     # mixed int outputs: float0 cots, keep stored path
+    try:
+        vals32 = tuple(
+            jnp.asarray(v, jnp.float32) if hasattr(v, "dtype")
+            and _is_reduced(v.dtype) else v for v in in_vals)
+        cot_vals = []
+        for c in cots:
+            c = c.value if isinstance(c, Tensor) else c
+            if hasattr(c, "dtype") and _is_reduced(c.dtype):
+                c = jnp.asarray(c, jnp.float32)
+            cot_vals.append(c)
+        if getattr(node.pure_fn, "master_cacheable", False):
+            return list(_master_bwd(node.pure_fn)(vals32, tuple(cot_vals)))
+        # per-call closure (fallback dispatch path / apply_raw): re-vjp
+        # directly — no jit cache could ever hit on a fresh identity
+        outs, vjp_fn = jax.vjp(node.pure_fn, *vals32)
+        return list(vjp_fn(_conform_cots(tuple(cot_vals), outs)))
+    except Exception:  # noqa: BLE001 - non-conforming op: stored pullback
+        return None
+
+
 def _run_vjp(node, cots, create_graph):
     """Execute the node's pullback.
 
     create_graph: re-linearize through the op dispatcher so the computation is taped and
     residual-paths stay differentiable (the stored pullback treats residuals as constants,
     which would silently drop second-order terms)."""
+    if not create_graph and _MASTER_GRAD[0]:
+        out = _master_vjp(node, cots)
+        if out is not None:
+            return out
     if create_graph and node.pure_fn is not None:
         from ..ops._apply import apply_raw
 

@@ -41,6 +41,7 @@ class ElasticManager:
         self._threads = []
         self._known = set()
         self.status = ElasticStatus.HOLD
+        self.last_flight_dump = None     # path of the newest restart dump
 
     # -- registry ------------------------------------------------------------
     def _hb_key(self, node=None):
@@ -113,6 +114,7 @@ class ElasticManager:
                     old = sorted(self._known)
                     self._known = alive
                     self.status = ElasticStatus.RESTART
+                    self._flight_dump(old, sorted(alive))
                     if self._on_scale is not None:
                         self._on_scale(old, sorted(alive))
                 self._stop.wait(self._interval)
@@ -121,6 +123,23 @@ class ElasticManager:
             t = threading.Thread(target=fn, daemon=True)
             t.start()
             self._threads.append(t)
+
+    def _flight_dump(self, old, new):
+        """A membership change restarts the trainer — dump the trace
+        flight recorder first so what was in flight on THIS node survives
+        the relaunch (per-rank file, monitor.trace.flight_dump). Active
+        when tracing is on or PADDLE_TPU_FLIGHT_DIR is set; never raises."""
+        import os
+
+        try:
+            from ...monitor import trace
+
+            if trace._state.on or os.environ.get("PADDLE_TPU_FLIGHT_DIR"):
+                self.last_flight_dump = trace.flight_dump(
+                    reason=f"elastic membership change: {old} -> {new}",
+                    extra={"node_id": self._node_id, "job": self._job})
+        except Exception:  # noqa: BLE001
+            pass
 
     def exit(self, completed=True):
         self.status = (ElasticStatus.COMPLETED if completed

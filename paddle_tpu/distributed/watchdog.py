@@ -10,17 +10,35 @@ section (Task.wait, block_until_ready, TCPStore barriers); a single daemon
 scanner checks every in-flight section's age each tick and fires the timeout
 callback once per stuck section. Completed sections land in a bounded history
 for post-mortem dumps.
+
+With span tracing on (paddle_tpu.monitor.trace), each watched section also
+opens a ``comm.wait`` span, and a timeout writes the flight-recorder dump
+(open spans + last-N spans + metrics snapshot) to a per-rank file — the
+hang-dump workflow of docs/tracing.md.
 """
 from __future__ import annotations
 
 import collections
 import itertools
+import os
 import threading
 import time
 
 
 class WatchdogTimeout(RuntimeError):
     pass
+
+
+_TRACE = None
+
+
+def _trace():
+    global _TRACE
+    if _TRACE is None:
+        from ..monitor import trace as _t
+
+        _TRACE = _t
+    return _TRACE
 
 
 class CommWatchdog:
@@ -32,6 +50,7 @@ class CommWatchdog:
         self._ids = itertools.count()
         self.events = collections.deque(maxlen=max_history)  # (desc, start, end)
         self.timed_out = []
+        self.last_flight_dump = None     # path of the newest hang dump
         self._stop = threading.Event()
         self._scanner = None
 
@@ -58,11 +77,27 @@ class CommWatchdog:
                 if now - start > self.timeout:
                     fired.add(wid)
                     self.timed_out.append(desc)
+                    self._flight_dump(desc)
                     if self.on_timeout is not None:
                         self.on_timeout(desc, self.dump())
                     else:
                         print(f"[comm watchdog] {desc} exceeded "
                               f"{self.timeout}s\n{self.dump()}")
+
+    def _flight_dump(self, desc):
+        """Write the trace flight recorder (open spans = the hang
+        candidates, recent spans, metrics snapshot) to the per-rank file.
+        Active when tracing is on or PADDLE_TPU_FLIGHT_DIR is set; a dump
+        failure never masks the timeout it documents."""
+        try:
+            trace = _trace()
+            if trace._state.on or os.environ.get("PADDLE_TPU_FLIGHT_DIR"):
+                self.last_flight_dump = trace.flight_dump(
+                    reason=f"watchdog timeout: {desc} exceeded "
+                           f"{self.timeout}s",
+                    extra={"watchdog": self.dump()})
+        except Exception:  # noqa: BLE001
+            pass
 
     def stop(self):
         self._stop.set()
@@ -88,12 +123,18 @@ class _Watch:
     def __init__(self, dog, desc):
         self._dog = dog
         self._desc = desc
+        self._span = None
 
     def __enter__(self):
         dog = self._dog
         with dog._lock:
             self._id = next(dog._ids)
             dog._inflight[self._id] = (self._desc, time.monotonic())
+        trace = _trace()
+        if trace._state.on:
+            # an OPEN comm.wait span in a flight dump IS the hang candidate
+            self._span = trace.start_span("comm.wait",
+                                          attrs={"desc": self._desc})
         dog._ensure_scanner()
         return self
 
@@ -102,4 +143,5 @@ class _Watch:
         with dog._lock:
             desc, start = dog._inflight.pop(self._id)
             dog.events.append((desc, start, time.monotonic()))
+        _trace().end_span(self._span)
         return False

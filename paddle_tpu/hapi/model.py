@@ -18,8 +18,11 @@ from ..framework.core import Tensor
 from ..framework_io import load as _load, save as _save
 from ..io.dataloader import DataLoader
 from ..metric import Metric
+from ..monitor import trace as _trace
 from ..nn.layer.layers import Layer
 from .callbacks import config_callbacks
+
+_END = object()  # loader-exhausted sentinel for the traced fit loop
 
 
 def _to_list(x):
@@ -58,19 +61,26 @@ class Model:
 
     # -- single-batch APIs ----------------------------------------------------
     def train_batch(self, inputs, labels=None, update=True):
+        # stage spans (monitor.trace, no-ops when tracing is off) nest under
+        # the fit() loop's train.step root via implicit thread parenting —
+        # the training-step decomposition of docs/tracing.md
         self.network.train()
         inputs = [_to_tensor(x) for x in _to_list(inputs)]
         labels = [_to_tensor(x) for x in _to_list(labels)]
-        outputs = self.network(*inputs)
-        outs = _to_list(outputs)
-        losses = _to_list(self._loss(*(outs + labels))) if self._loss else outs
-        total = losses[0]
-        for l in losses[1:]:  # noqa: E741
-            total = total + l
-        total.backward()
+        with _trace.span("train.forward"):
+            outputs = self.network(*inputs)
+            outs = _to_list(outputs)
+            losses = (_to_list(self._loss(*(outs + labels)))
+                      if self._loss else outs)
+            total = losses[0]
+            for l in losses[1:]:  # noqa: E741
+                total = total + l
+        with _trace.span("train.backward"):
+            total.backward()
         if update:
-            self._optimizer.step()
-            self._optimizer.clear_grad()
+            with _trace.span("train.optimizer"):
+                self._optimizer.step()
+                self._optimizer.clear_grad()
         metrics = []
         for m in self._metrics:
             metrics.append(m.update(*_to_list(m.compute(*(outs + labels)))))
@@ -134,17 +144,30 @@ class Model:
                 m.reset()
             cbks.on_epoch_begin(epoch)
             logs = {}
-            for step, batch in enumerate(loader):
-                cbks.on_train_batch_begin(step)
-                ins, labels = self._split_batch(batch)
-                update = (step + 1) % accumulate_grad_batches == 0
-                res = self.train_batch(ins, labels, update=update)
-                logs = self._make_logs(res)
-                cbks.on_train_batch_end(step, logs)
+            it = iter(loader)
+            step = 0
+            while True:
+                # train.step root + dataload stage; train_batch adds the
+                # forward/backward/optimizer stages under the same root.
+                # (With tracing on, the epoch's final loader drain records
+                # one dataload-only step span — an honest measurement of
+                # the end-of-epoch fetch.)
+                with _trace.training_step(step=step) as ts:
+                    with ts.stage("dataload"):
+                        batch = next(it, _END)
+                    if batch is _END:
+                        break
+                    cbks.on_train_batch_begin(step)
+                    ins, labels = self._split_batch(batch)
+                    update = (step + 1) % accumulate_grad_batches == 0
+                    res = self.train_batch(ins, labels, update=update)
+                    logs = self._make_logs(res)
+                    cbks.on_train_batch_end(step, logs)
                 it_count += 1
                 if num_iters is not None and it_count >= num_iters:
                     self.stop_training = True
                     break
+                step += 1
             cbks.on_epoch_end(epoch, logs)
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 self.evaluate(eval_loader, verbose=verbose, callbacks=cbks,

@@ -21,7 +21,8 @@ from .sampler import BatchSampler
 
 _worker_info = threading.local()
 
-_MON = None  # (state, batches counter, fetch-latency histogram, now_ns)
+_MON = None  # (state, batches counter, fetch-latency histogram, now_ns,
+#              trace._state, trace module)
 
 
 def _mon():
@@ -32,7 +33,7 @@ def _mon():
         _MON = (_m._state,
                 _m.counter("paddle_tpu_dataloader_batches_total"),
                 _m.histogram("paddle_tpu_dataloader_fetch_latency_ns"),
-                _m.now_ns)
+                _m.now_ns, _m.trace._state, _m.trace)
     return _MON
 
 
@@ -249,15 +250,19 @@ class DataLoader:
             while True:
                 if bm is not None:
                     bm.before_reader()
-                t0 = mon[3]() if mon[0].on else 0
+                t0 = mon[3]() if (mon[0].on or mon[4].on) else 0
                 try:
                     b = next(it)
                 except StopIteration:
                     return
                 staged = _to_device(b)
-                if mon[0].on:
-                    mon[2].observe_ns(mon[3]() - t0)
-                    mon[1].inc()
+                if mon[0].on or mon[4].on:
+                    t1 = mon[3]()
+                    if mon[4].on:
+                        mon[5].record_span("dataloader.batch", t0, t1)
+                    if mon[0].on:
+                        mon[2].observe_ns(t1 - t0)
+                        mon[1].inc()
                 if bm is not None:
                     bm.after_reader()
                 yield staged
@@ -299,15 +304,19 @@ class DataLoader:
             while True:
                 if bm is not None:
                     bm.before_reader()
-                t0 = mon[3]() if mon[0].on else 0
+                t0 = mon[3]() if (mon[0].on or mon[4].on) else 0
                 item = q.get()
                 if item is sentinel:
                     break
-                if mon[0].on:
+                if mon[0].on or mon[4].on:
                     # consumer-visible stall: ~0 while the producer keeps
                     # the queue full, the fetch+stage time when it can't
-                    mon[2].observe_ns(mon[3]() - t0)
-                    mon[1].inc()
+                    t1 = mon[3]()
+                    if mon[4].on:
+                        mon[5].record_span("dataloader.batch", t0, t1)
+                    if mon[0].on:
+                        mon[2].observe_ns(t1 - t0)
+                        mon[1].inc()
                 if bm is not None:
                     bm.after_reader()
                 yield item

@@ -53,7 +53,8 @@ from ..framework.core import Tensor
 from ..nn.layer.layers import Layer
 
 
-_MON = None  # monitor bindings: (state, compiles, hits, compile-time, sigs)
+_MON = None  # monitor bindings: (state, compiles, hits, compile-time, sigs,
+#              now_ns, trace-state, trace module)
 
 
 def _mon():
@@ -70,7 +71,7 @@ def _mon():
                              buckets=_m.DEFAULT_SECONDS_BUCKETS),
                 _m.gauge("paddle_tpu_jit_cached_signatures",
                          labelnames=("function",)),
-                _m.now_ns)
+                _m.now_ns, _m.trace._state, _m.trace)
     return _MON
 
 
@@ -272,11 +273,13 @@ class StaticFunction:
     def _traced_call_keyed(self, key, treedef, leaves, t_idx, t_leaves,
                            tvals, state_tensors):
         """Monitor shim over _run_keyed: a signature miss counts as one
-        compile (trace + XLA compile + first execution, timed wall-clock);
-        a hit bumps the hit counter. Zero extra work when the monitor is
-        off."""
+        compile (trace + XLA compile + first execution, timed wall-clock)
+        and — with span tracing on — lands a ``jit.compile`` span on the
+        timeline; a hit bumps the hit counter. Zero extra work when both
+        are off."""
         mon = _mon()
-        if not mon[0].on:
+        tracing = mon[6].on
+        if not mon[0].on and not tracing:
             return self._run_keyed(key, treedef, leaves, t_idx, t_leaves,
                                    tvals, state_tensors)
         fname = getattr(self._function, "__name__", "fn")
@@ -285,10 +288,15 @@ class StaticFunction:
         out = self._run_keyed(key, treedef, leaves, t_idx, t_leaves,
                               tvals, state_tensors)
         if miss:
-            mon[1].labels(fname).inc()
-            mon[3].observe((mon[5]() - t0) / 1e9)
-            mon[4].labels(fname).set(len(self._cache))
-        else:
+            t1 = mon[5]()
+            if tracing:
+                mon[7].record_span("jit.compile", t0, t1,
+                                   attrs={"function": fname})
+            if mon[0].on:
+                mon[1].labels(fname).inc()
+                mon[3].observe((t1 - t0) / 1e9)
+                mon[4].labels(fname).set(len(self._cache))
+        elif mon[0].on:
             mon[2].labels(fname).inc()
         return out
 

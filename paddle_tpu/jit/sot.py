@@ -56,6 +56,17 @@ from ..framework.core import Tensor
 MAX_VARIANTS = 8          # guard-tree width per signature before eager-forever
 MAX_GUARD_ELEMS = 16      # host reads bigger than this disable segmentation
 
+_TRACE = None             # (trace module, now_ns) — lazy, off the eager path
+
+
+def _trace():
+    global _TRACE
+    if _TRACE is None:
+        from .. import monitor as _m
+
+        _TRACE = (_m.trace, _m.now_ns)
+    return _TRACE
+
 
 def _is_tensor(x):
     return isinstance(x, Tensor)
@@ -433,11 +444,23 @@ class SegmentedFunction:
         if (self._eager_only or _capture.active() is not None
                 or not tape_safe()):
             return self._function(*args, **kwargs)
+        trc, now_ns = _trace()
+        tracing = trc._state.on
         for variant in self._variants:
+            t0 = now_ns() if tracing else 0
             out = self._replay(variant, args, kwargs)
             if out is not _MISMATCH:
+                if tracing:
+                    trc.record_span("jit.sot_replay", t0, now_ns())
                 return out
-        return self._capture_variant(args, kwargs)
+        t0 = now_ns() if tracing else 0
+        result = self._capture_variant(args, kwargs)
+        if tracing:
+            trc.record_span(
+                "jit.sot_capture", t0, now_ns(),
+                attrs={"function": getattr(self._function, "__name__",
+                                           "fn")})
+        return result
 
     @property
     def compiled_segment_count(self):

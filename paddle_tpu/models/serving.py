@@ -19,7 +19,10 @@ it is the capability proof, not a production batch scheduler. submit()
 adds a host-side FIFO admission queue in front of the slots (add_request
 keeps the refuse-when-full contract), and the engine is instrumented with
 the paddle_tpu.monitor serving metrics — queue depth, batch occupancy,
-prefill/decode latency, tokens, evictions, TTFT (docs/observability.md).
+prefill/decode latency, tokens, evictions, TTFT (docs/observability.md) —
+plus, with span tracing on, a per-request trace tree (ONE trace id from
+admission to eviction: queue_wait/prefill/decode_step/evict spans, the
+TTFT decomposition; docs/tracing.md).
 """
 from __future__ import annotations
 
@@ -40,9 +43,10 @@ class _Mon:
     """Lazily-bound monitor handles (one attribute load per metric on the
     serving hot path; nothing is touched while the monitor is off)."""
 
-    __slots__ = ("mod", "state", "queue_depth", "occupancy", "prefill",
-                 "decode", "tokens", "evictions", "ttft", "admitted",
-                 "rejected", "jit_compiles", "jit_hits", "jit_sigs")
+    __slots__ = ("mod", "state", "trace", "tstate", "queue_depth",
+                 "occupancy", "prefill", "decode", "tokens", "evictions",
+                 "ttft", "admitted", "rejected", "jit_compiles", "jit_hits",
+                 "jit_sigs")
 
 
 _MON = None
@@ -56,6 +60,8 @@ def _mon():
         o = _Mon()
         o.mod = m
         o.state = m._state
+        o.trace = m.trace
+        o.tstate = m.trace._state
         o.queue_depth = m.gauge("paddle_tpu_serving_queue_depth")
         o.occupancy = m.gauge("paddle_tpu_serving_batch_occupancy")
         o.prefill = m.histogram("paddle_tpu_serving_prefill_latency_ns")
@@ -108,6 +114,10 @@ class ContinuousBatchingEngine:
         self._jit_cache = {}
         # submit() queue: requests waiting for a free slot (host-side)
         self._pending = collections.deque()
+        # per-request trace trees (monitor.trace): rid -> [root, queue_wait]
+        # — ONE trace id per request, root open from submit/add_request
+        # until eviction; bounded by max_batch + queue depth
+        self._req_spans = {}
         # device-resident decode inputs: between admissions/evictions the
         # step feeds back its own device outputs (tokens) and increments
         # lens on device, so steady-state decoding does ZERO host→device
@@ -223,6 +233,12 @@ class ContinuousBatchingEngine:
                 # undo any partial block grant the failed prefill made (and
                 # re-sync the device table copy)
                 self._pager.free_sequence(slot)
+            # add_request has no retry: abandon the trace tree _admit
+            # opened, or every failed call leaks an open root span
+            entry = self._req_spans.pop(rid, None)
+            if entry is not None:
+                mon.trace.drop(entry[1])
+                mon.trace.drop(entry[0])
             raise
         return rid
 
@@ -236,6 +252,11 @@ class ContinuousBatchingEngine:
         mon = _mon()
         rid = self._next_rid
         self._next_rid += 1
+        if mon.tstate.on:
+            root = mon.trace.start_span("serving.request",
+                                        attrs={"rid": rid})
+            self._req_spans[rid] = [
+                root, mon.trace.start_span("serving.queue_wait", parent=root)]
         self._pending.append((rid, prompt, mon.mod.now_ns()))
         self._drain_pending()
         if mon.state.on:
@@ -267,6 +288,11 @@ class ContinuousBatchingEngine:
                     return          # retry once evictions free blocks
                 self._pending.popleft()
                 mon = _mon()
+                entry = self._req_spans.pop(rid, None)
+                if entry is not None:
+                    # dropped before admission: abandon the open tree
+                    mon.trace.drop(entry[1])
+                    mon.trace.drop(entry[0])
                 if mon.state.on:
                     mon.rejected.inc()
                 import warnings
@@ -281,6 +307,13 @@ class ContinuousBatchingEngine:
     def _admit(self, slot, prompt, rid, t_submit):
         mon = _mon()
         t0 = mon.mod.now_ns()
+        if mon.tstate.on and rid not in self._req_spans:
+            # add_request path: the request root opens at admission (no
+            # queue wait — admission was immediate by contract)
+            self._req_spans[rid] = [
+                mon.trace.start_span("serving.request", attrs={"rid": rid}),
+                None]
+        entry = self._req_spans.get(rid)
         L = len(prompt)
         bucket = next(b for b in self._buckets if b >= L) \
             if L <= self._buckets[-1] else self.max_len
@@ -302,13 +335,25 @@ class ContinuousBatchingEngine:
         self.last_token[slot, 0] = tok
         self.outputs[slot] = [tok]
         self._host_dirty = True
-        if mon.state.on:
+        if mon.state.on or mon.tstate.on:
             t1 = mon.mod.now_ns()
-            mon.admitted.inc()
-            mon.tokens.inc()            # the prefill's first token
-            mon.prefill.observe(t1 - t0)
-            mon.ttft.observe(t1 - t_submit)
-            self._update_gauges(mon)
+            if entry is not None:
+                if entry[1] is not None:
+                    # queue wait ends at the start of the SUCCESSFUL
+                    # admission attempt (a failed transient attempt keeps
+                    # it open: the request was still waiting), so
+                    # queue_wait + prefill sums to the request's TTFT
+                    mon.trace.end_span(entry[1], t1_ns=t0)
+                    entry[1] = None
+                mon.trace.record_span(
+                    "serving.prefill", t0, t1, parent=entry[0],
+                    attrs={"slot": slot, "prompt_len": L, "bucket": bucket})
+            if mon.state.on:
+                mon.admitted.inc()
+                mon.tokens.inc()        # the prefill's first token
+                mon.prefill.observe(t1 - t0)
+                mon.ttft.observe(t1 - t_submit)
+                self._update_gauges(mon)
 
     def step(self, eos_token_id=None, max_new_tokens=None):
         """One decode step for EVERY active slot. Queued submit() requests
@@ -338,6 +383,16 @@ class ContinuousBatchingEngine:
         self._tok_dev = toks_dev[:, None]
         self._lens_dev = self._lens_dev + self._active_dev
         toks = np.asarray(toks_dev)
+        if mon.tstate.on and self._req_spans:
+            # one decode span per traced active request (same [t0,t1]): every
+            # request's trace tree carries its own decode timeline
+            t1 = mon.mod.now_ns()
+            for slot in np.flatnonzero(self.active):
+                entry = self._req_spans.get(self.request_ids[int(slot)])
+                if entry is not None:
+                    mon.trace.record_span(
+                        "serving.decode_step", t0, t1, parent=entry[0],
+                        attrs={"slot": int(slot), "n_active": n_decoded})
         finished = []
         for slot in np.flatnonzero(self.active):
             slot = int(slot)
@@ -361,13 +416,23 @@ class ContinuousBatchingEngine:
         return finished
 
     def _evict(self, slot):
+        mon = _mon()
+        rid = self.request_ids[slot]
+        entry = self._req_spans.pop(rid, None)
+        t0 = mon.mod.now_ns() if entry is not None else 0
+        n_tokens = len(self.outputs[slot])
         self._pager.free_sequence(slot)
         self.active[slot] = False
         self.lens[slot] = 0
         self.request_ids[slot] = None
         self.outputs[slot] = []
         self._host_dirty = True
-        mon = _mon()
+        if entry is not None:
+            t1 = mon.mod.now_ns()
+            mon.trace.drop(entry[1])   # only open if tracing toggled off
+            mon.trace.record_span("serving.evict", t0, t1, parent=entry[0],
+                                  attrs={"slot": slot, "tokens": n_tokens})
+            mon.trace.end_span(entry[0], t1_ns=t1)   # request tree complete
         if mon.state.on:
             mon.evictions.inc()
             self._update_gauges(mon)

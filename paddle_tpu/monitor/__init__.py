@@ -30,7 +30,7 @@ from __future__ import annotations
 import threading
 from collections import deque
 
-from . import catalog, provenance as _provenance_mod
+from . import catalog, provenance as _provenance_mod, trace  # noqa: F401
 from .export import (chrome_counter_events as _chrome_events,
                      prometheus_text as _prom_text, snapshot as _snapshot)
 from .registry import (Counter, Gauge, Histogram, Registry,  # noqa: F401
@@ -41,7 +41,7 @@ __all__ = [
     "enable", "disable", "enabled", "reset",
     "counter", "gauge", "histogram", "registry",
     "snapshot", "prometheus_text", "sample", "chrome_counter_events",
-    "provenance", "validate_provenance",
+    "provenance", "validate_provenance", "trace",
 ]
 
 
@@ -80,11 +80,12 @@ def enabled():
 
 
 def reset():
-    """Zero every metric and drop buffered timeline samples (test isolation
-    and between-run hygiene)."""
+    """Zero every metric, drop buffered timeline samples AND recorded trace
+    spans (test isolation and between-run hygiene)."""
     registry.reset()
     with _sample_lock:
         _samples.clear()
+    trace.reset()
 
 
 def _cataloged(kind, name, labelnames, help):

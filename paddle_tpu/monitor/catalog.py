@@ -1,4 +1,5 @@
-"""Metric name catalog: the stable contract of the telemetry subsystem.
+"""Metric and span name catalogs: the stable contract of the telemetry
+subsystem.
 
 Every metric this framework emits is declared here, named
 ``paddle_tpu_<subsystem>_<name>`` (snake_case, counters end in ``_total``,
@@ -8,6 +9,12 @@ strings, so renaming an entry is a breaking change — add a new name and
 deprecate the old one instead. ``tools/check_metric_names.py`` lints both
 this table and every literal registration in the source tree against the
 convention.
+
+Span names (``monitor/trace.py``) are the same kind of contract for the
+causal view: trace viewers, flight-recorder consumers and the hang-dump
+workflow key on the exact strings, so every span the framework emits is
+declared in ``SPANS`` (``<subsystem>.<name>``, dotted lowercase) and
+linted by graftlint rule GL006 exactly like GL005 lints metric names.
 
 This module is deliberately dependency-free (no jax, no package-relative
 imports) so the lint tool can load it by file path without initializing the
@@ -110,3 +117,75 @@ METRICS = {
 def spec(name):
     """(type, labelnames, help) for a cataloged metric name, or None."""
     return METRICS.get(name)
+
+
+# -- span catalog (monitor/trace.py) ------------------------------------------
+
+# Subsystems a span may belong to (the first dotted token of the name).
+SPAN_SUBSYSTEMS = ("dispatch", "jit", "serving", "dataloader", "train",
+                   "comm", "monitor")
+
+SPAN_PATTERN = (
+    r"^(" + "|".join(SPAN_SUBSYSTEMS)
+    + r")(\.[a-z][a-z0-9_]*)+$"
+)
+
+# name -> help text
+SPANS = {
+    # -- op dispatch (ops/_apply.py) -------------------------------------
+    "dispatch.op": (
+        "One SAMPLED eager op dispatch (AMP cast + kernel dispatch + tape "
+        "record); 1-in-N sampling keeps the span tax off the 40us eager "
+        "budget. attrs: op, sample_every."),
+    # -- jit (jit/api.py + jit/sot.py) -----------------------------------
+    "jit.compile": (
+        "to_static signature cache miss: trace + XLA compile + first "
+        "execution. attrs: function."),
+    "jit.sot_capture": (
+        "SOT cold run: eager execution with the op recorder attached, "
+        "segmentation + guard extraction included. attrs: function."),
+    "jit.sot_replay": (
+        "SOT variant replay: compiled segments + guard checks for one "
+        "call of a graph-broken signature."),
+    # -- serving engine (models/serving.py) ------------------------------
+    "serving.request": (
+        "Root span of one serving request, open from submit()/add_request "
+        "until eviction — ONE trace id per request; children decompose "
+        "TTFT. attrs: rid."),
+    "serving.queue_wait": (
+        "submit() admission-queue wait: enqueue until a slot frees "
+        "(child of serving.request)."),
+    "serving.prefill": (
+        "Admission prefill: pad + compiled prefill + first-token transfer "
+        "(child of serving.request). attrs: slot, prompt_len, bucket."),
+    "serving.decode_step": (
+        "One batched decode step, recorded per active request so each "
+        "trace tree carries its own decode timeline. attrs: slot, "
+        "n_active."),
+    "serving.evict": (
+        "Slot eviction: block free + host state clear (child of "
+        "serving.request). attrs: slot, tokens."),
+    # -- dataloader (io/dataloader.py) -----------------------------------
+    "dataloader.batch": (
+        "Consumer-visible wait for the next staged batch (fetch + "
+        "host-to-device staging when unbuffered)."),
+    # -- training step (monitor/trace.py training_step, hapi/model.py) ---
+    "train.step": (
+        "One training step (root of the dataload/forward/backward/"
+        "optimizer decomposition). attrs: step."),
+    "train.dataload": "Batch fetch portion of a training step.",
+    "train.forward": "Forward pass (+ loss) portion of a training step.",
+    "train.backward": "Backward pass portion of a training step.",
+    "train.optimizer": (
+        "Optimizer step + clear_grad portion of a training step."),
+    # -- distributed (distributed/watchdog.py) ---------------------------
+    "comm.wait": (
+        "Blocking collective/host wait watched by CommWatchdog — open "
+        "comm.wait spans in a flight dump are the hang candidates. "
+        "attrs: desc."),
+}
+
+
+def span_spec(name):
+    """Help text for a cataloged span name, or None."""
+    return SPANS.get(name)

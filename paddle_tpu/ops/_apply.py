@@ -110,6 +110,10 @@ def _cached_pos_fns(opdef, n_leaves, static_items, t_idx, stop_flags,
         out = fn(*buf)
         return out if isinstance(out, tuple) else (out,)
 
+    # stable per-signature identity: the tape's master-grad path may key a
+    # jit cache on this function object (tape._master_bwd)
+    pure.master_cacheable = True
+
     @jax.jit
     def bwd(tvals, cots):
         return jax.vjp(pure, *tvals)[1](cots)
@@ -140,6 +144,8 @@ def _cached_op_fns(opdef, treedef, n_leaves, static_items, t_idx, stop_flags,
         a, k = jax.tree_util.tree_unflatten(treedef, buf)
         out = fn(*a, **k)
         return out if isinstance(out, tuple) else (out,)
+
+    pure.master_cacheable = True   # stable identity (see _cached_pos_fns)
 
     # note the rematerialization tradeoff: this backward re-runs the primal to
     # rebuild residuals (fwd FLOPs x2 per differentiable op) in exchange for
@@ -221,7 +227,8 @@ def _prof():
     return _PROF
 
 
-_MON = None    # (monitor._state, op-calls counter, latency histogram, clock)
+_MON = None    # (monitor._state, op-calls counter, latency histogram, clock,
+#                trace._state, trace module)
 
 
 def _mon():
@@ -233,8 +240,18 @@ def _mon():
                 _m.counter("paddle_tpu_dispatch_op_calls_total",
                            labelnames=("op",)),
                 _m.histogram("paddle_tpu_dispatch_latency_ns"),
-                _m.now_ns)
+                _m.now_ns, _m.trace._state, _m.trace)
     return _MON
+
+
+def _trace_ticket(trace):
+    """SAMPLED dispatch spans: 1-in-N dispatches land a ``dispatch.op``
+    span (N = trace.dispatch_sample_every()). The ticket is drawn BEFORE
+    any timing so the 63-in-64 unsampled dispatches pay one atomic count
+    bump + a modulo, not two clock reads — the enabled-mode span tax
+    stays a fraction of the per-op cost (bench.py detail.trace_overhead
+    tracks it)."""
+    return next(trace._dispatch_tick) % trace._DISPATCH_SAMPLE_EVERY == 0
 
 
 def apply(opdef: OpDef, *args, **kwargs):
@@ -248,18 +265,25 @@ def apply(opdef: OpDef, *args, **kwargs):
     feeds both consumers."""
     prof = _prof()
     mon = _mon()
-    if prof[0].enabled or mon[0].on:
-        now_ns = mon[3]
-        t0 = now_ns()
-        try:
-            return _apply_impl(opdef, *args, **kwargs)
-        finally:
-            t1 = now_ns()
-            if mon[0].on:
-                mon[1].labels(opdef.name).inc()
-                mon[2].observe_ns(t1 - t0)
-            if prof[0].enabled:
-                prof[0].emit(f"op::{opdef.name}", prof[1], t0, t1)
+    if prof[0].enabled or mon[0].on or mon[4].on:
+        trace_this = mon[4].on and _trace_ticket(mon[5])
+        if prof[0].enabled or mon[0].on or trace_this:
+            now_ns = mon[3]
+            t0 = now_ns()
+            try:
+                return _apply_impl(opdef, *args, **kwargs)
+            finally:
+                t1 = now_ns()
+                if mon[0].on:
+                    mon[1].labels(opdef.name).inc()
+                    mon[2].observe_ns(t1 - t0)
+                if trace_this:
+                    mon[5].record_span(
+                        "dispatch.op", t0, t1,
+                        attrs={"op": opdef.name,
+                               "sample_every": mon[5]._DISPATCH_SAMPLE_EVERY})
+                if prof[0].enabled:
+                    prof[0].emit(f"op::{opdef.name}", prof[1], t0, t1)
     return _apply_impl(opdef, *args, **kwargs)
 
 

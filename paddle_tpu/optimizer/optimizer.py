@@ -161,7 +161,16 @@ class Optimizer:
                 states.append(self._accumulators[id(p)])
                 masters.append(self._master_weights.get(id(p)))
                 p_vals.append(p.value)
-                g_vals.append(g.value)
+                gv = g.value
+                if self._use_master_grad and np.dtype(gv.dtype) in (
+                    np.dtype(np.float16), np.dtype(jnp.bfloat16)
+                ):
+                    # master grad contract: updates consume fp32 gradients
+                    # even when a producer handed over a reduced-precision
+                    # one (the fused apply would upcast anyway; doing it
+                    # here keeps the jit signature honest about it)
+                    gv = gv.astype(jnp.float32)
+                g_vals.append(gv)
             new_ps, new_states, new_masters = self._fused_apply(
                 p_vals, g_vals, states, masters, lr_scalar, float(wd), hyper,
                 [getattr(p, "optimize_attr", {}).get("learning_rate", 1.0) for p, _ in pg],

@@ -329,6 +329,12 @@ def _write_chrome_trace(events, path, xla_trace_dir=None, device_events=None,
             trace_events.extend(
                 ev for ev in _monitor.chrome_counter_events()
                 if w0 <= ev["ts"] * 1e3 <= w1)
+            # monitor.trace spans share the same perf_counter_ns domain:
+            # request/compile/step spans land beside the host defop spans
+            # (window-filtered like the counter samples)
+            trace_events.extend(
+                ev for ev in _monitor.trace.chrome_span_events()
+                if w0 <= ev["ts"] * 1e3 <= w1)
     except Exception:  # noqa: BLE001 - telemetry must never break an export
         pass
     doc = {"traceEvents": trace_events, "displayTimeUnit": "ms"}
@@ -351,9 +357,10 @@ def load_profiler_result(filename: str) -> ProfilerResult:
         if te.get("ph") != "X":
             continue
         cat = te.get("cat", "UserDefined")
-        if cat == "DeviceOp":
-            # merged XLA device spans (xplane.chrome_events) are not host
-            # events; the loader reconstructs the HOST side only
+        if cat in ("DeviceOp", "TraceSpan"):
+            # merged XLA device spans (xplane.chrome_events) and monitor
+            # trace spans are not host events; the loader reconstructs the
+            # HOST side only (a re-save() re-merges the live buffers)
             continue
         try:
             etype = TracerEventType[cat]

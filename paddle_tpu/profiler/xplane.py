@@ -10,11 +10,22 @@ the device tracer is XLA's own profiler: jax.profiler.start_trace writes an
 it back via jax.profiler.ProfileData (no TensorBoard needed) and translates
 event times onto the host clock so both layers land in one timeline.
 
-Clock model: xplane event start_ns values are relative to the trace start;
-the Profiler records host perf_counter_ns immediately after
-jax.profiler.start_trace returns (xla_t0_ns). Device-absolute =
+Clock model: collect_device_events normalizes every event onto a
+trace-relative clock (earliest collected event = 0): the raw xplane epoch
+differs across builds (trace start on some, PROCESS start on the
+jax 0.4.37 CPU tracer), so the only portable anchor is the trace's own
+first event. The Profiler records host perf_counter_ns immediately after
+jax.profiler.start_trace returns (xla_t0_ns); device-absolute =
 xla_t0_ns + event.start_ns — the same translate-to-host-clock correlation
 the reference applies to CUPTI timestamps.
+
+Readers, tried in order (first available wins):
+
+1. ``jax.profiler.ProfileData`` — newer jax wheels bundle the xplane
+   reader;
+2. the raw ``xplane.pb`` proto via tensorflow's bundled
+   ``tsl.profiler.protobuf.xplane_pb2`` — jax 0.4.37 ships no reader, but
+   the wire format is the same XSpace proto.
 """
 from __future__ import annotations
 
@@ -37,53 +48,149 @@ def _is_device_plane(name):
     return name.startswith("/device:")
 
 
+def _iter_events_profile_data(path):
+    """(plane, line, name, start_ns, dur_ns, stats) via the bundled reader
+    of newer jax wheels. Raises ImportError when unavailable."""
+    from jax.profiler import ProfileData
+
+    pd = ProfileData.from_file(path)
+    for plane in pd.planes:
+        for line in plane.lines:
+            for ev in line.events:
+                stats = {}
+                try:
+                    stats = dict(ev.stats)
+                except Exception:  # noqa: BLE001 - stats are optional
+                    pass
+                yield (plane.name, line.name, ev.name,
+                       float(ev.start_ns), float(ev.duration_ns), stats)
+
+
+def _stat_value(stat, stat_metadata):
+    """Decode one XStat: strings usually arrive as ref_value indices into
+    the plane's stat_metadata (string interning), scalars as oneof fields."""
+    which = stat.WhichOneof("value")
+    if which is None:
+        return None
+    if which == "ref_value":
+        meta = stat_metadata.get(stat.ref_value)
+        return meta.name if meta is not None else None
+    return getattr(stat, which)
+
+
+def _iter_events_proto(path):
+    """(plane, line, name, start_ns, dur_ns, stats) straight off the
+    XSpace proto — jax 0.4.37 writes the trace but ships no reader, so
+    parse with tensorflow's tsl xplane_pb2 (same wire format). Raises
+    ImportError when tensorflow's protos are unavailable."""
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    xs = xplane_pb2.XSpace()
+    with open(path, "rb") as f:
+        xs.ParseFromString(f.read())
+    for plane in xs.planes:
+        emeta = plane.event_metadata
+        smeta = plane.stat_metadata
+        for line in plane.lines:
+            base_ns = float(line.timestamp_ns)
+            for ev in line.events:
+                meta = emeta.get(ev.metadata_id)
+                name = meta.name if meta is not None else ""
+                stats = {}
+                for s in ev.stats:
+                    sm = smeta.get(s.metadata_id)
+                    if sm is not None:
+                        stats[sm.name] = _stat_value(s, smeta)
+                yield (plane.name, line.name, name,
+                       base_ns + ev.offset_ps / 1e3, ev.duration_ps / 1e3,
+                       stats)
+
+
+def _iter_events(path):
+    for reader in (_iter_events_profile_data, _iter_events_proto):
+        try:
+            return list(reader(path))
+        except ImportError:
+            continue
+        except Exception:  # noqa: BLE001 - partial/foreign traces: skip file
+            return []
+    return []
+
+
 def collect_device_events(trace_dir, limit=200000):
     """Read every device-side op span from the trace dir.
 
     Returns a list of dicts: {plane, line, name, start_ns, dur_ns, hlo_module}
-    with start_ns RELATIVE to the trace start. Device planes ("/device:TPU:N")
-    contribute every op event; the "/host:CPU" plane (XLA-CPU backend, used by
-    the virtual-mesh tests) contributes only events carrying an hlo_op stat so
-    python-tracing noise stays out. Never raises — an unreadable trace yields
-    []."""
-    try:
-        from jax.profiler import ProfileData
-    except ImportError:
-        return []
+    with start_ns NORMALIZED to the trace (earliest collected event = 0 —
+    the raw epoch is build-dependent, see module docstring). Device planes
+    ("/device:TPU:N") contribute every op event; the "/host:CPU" plane
+    (XLA-CPU backend, used by the virtual-mesh tests) contributes only
+    events carrying an hlo_op stat so python-tracing noise stays out.
+    Never raises — an unreadable trace yields []."""
     out = []
     for path in _iter_xplane_files(trace_dir):
-        try:
-            pd = ProfileData.from_file(path)
-        except Exception:  # noqa: BLE001 - partial/foreign traces: skip file
-            continue
-        for plane in pd.planes:
-            on_device = _is_device_plane(plane.name)
-            for line in plane.lines:
-                if line.name in _SKIP_LINE_NAMES:
-                    continue
-                for ev in line.events:
-                    name = ev.name
-                    if any(name.startswith(p) for p in _SKIP_EVENT_PREFIXES):
-                        continue
-                    stats = {}
-                    try:
-                        stats = dict(ev.stats)
-                    except Exception:  # noqa: BLE001 - stats are optional
-                        pass
-                    if not on_device and "hlo_op" not in stats \
-                            and "hlo_module" not in stats:
-                        continue
-                    out.append({
-                        "plane": plane.name,
-                        "line": line.name,
-                        "name": name,
-                        "start_ns": float(ev.start_ns),
-                        "dur_ns": float(ev.duration_ns),
-                        "hlo_module": stats.get("hlo_module"),
-                    })
-                    if len(out) >= limit:
-                        return out
-    return out
+        for plane_name, line_name, name, start_ns, dur_ns, stats \
+                in _iter_events(path):
+            if line_name in _SKIP_LINE_NAMES:
+                continue
+            if any(name.startswith(p) for p in _SKIP_EVENT_PREFIXES):
+                continue
+            on_device = _is_device_plane(plane_name)
+            if not on_device and "hlo_op" not in stats \
+                    and "hlo_module" not in stats:
+                continue
+            out.append({
+                "plane": plane_name,
+                "line": line_name,
+                "name": name,
+                "start_ns": start_ns,
+                "dur_ns": dur_ns,
+                "hlo_module": stats.get("hlo_module"),
+            })
+            if len(out) >= limit:
+                break
+        if len(out) >= limit:
+            break
+    return _normalize_clock(out)
+
+
+_CLUSTER_GAP_NS = 5e9   # a >5s hole in device activity marks a foreign epoch
+
+
+def _normalize_clock(events):
+    """Shift start_ns onto a trace-relative clock (earliest event of the
+    DOMINANT cluster = 0). The jax 0.4.37 CPU tracer stamps a handful of
+    events without the session base (they land seconds away from the real
+    cluster); anchoring on the raw min would shove the whole timeline off
+    the host window. Only GLITCH-sized minorities are dropped: at a >5s
+    silence, a side holding under max(16, 1%) of the events is discarded;
+    a real multi-burst trace (two serving waves seconds apart) keeps every
+    burst, separated by its true gap."""
+    if not events:
+        return events
+    events.sort(key=lambda ev: ev["start_ns"])
+    lo, hi = 0, len(events)
+    glitch = max(16, len(events) // 100)
+    for _ in range(8):
+        gap_at, gap = None, _CLUSTER_GAP_NS
+        for i in range(lo + 1, hi):
+            d = events[i]["start_ns"] - events[i - 1]["start_ns"]
+            if d > gap:
+                gap_at, gap = i, d
+        if gap_at is None:
+            break
+        left, right = gap_at - lo, hi - gap_at
+        if right <= glitch and right < left:
+            hi = gap_at
+        elif left <= glitch and left < right:
+            lo = gap_at
+        else:
+            break   # both sides real: keep the whole trace
+    kept = events[lo:hi]
+    t0 = kept[0]["start_ns"]
+    for ev in kept:
+        ev["start_ns"] -= t0
+    return kept
 
 
 def device_op_stats(device_events):

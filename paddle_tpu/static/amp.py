@@ -109,7 +109,18 @@ class OptimizerWithMixedPrecision:
 
                 live_loss = cast(live_loss, "float32")
             scaled = self._scaler.scale(live_loss)
-            scaled.backward()
+            if self._level == "O2" and self._dtype == "float16":
+                # fp32 master grad: the backward of fp16 ops re-linearizes
+                # in fp32 so init_loss_scaling=2**15 cannot overflow the
+                # GRADS themselves (grads ~6 * 2**15 > fp16's 65504 would
+                # otherwise inf every step until the scale decays) — the
+                # reference's master gradient for pure-fp16 training
+                from ..autograd import tape as _tape
+
+                with _tape.master_grad():
+                    scaled.backward()
+            else:
+                scaled.backward()
             self._scaler.step(self._inner)
             self._scaler.update()
         else:

@@ -1,0 +1,9 @@
+"""GL006 clean sample: every emitted span is declared."""
+
+
+def run(trace):
+    with trace.span("serving.prefill"):
+        pass
+    sp = trace.start_span("serving.request")
+    trace.record_span("dispatch.op", 0, 1)
+    trace.end_span(sp)

@@ -1,0 +1,19 @@
+"""GL006 clean fixture catalog (dependency-free, loadable by file path)."""
+
+SUBSYSTEMS = ("serving", "dispatch")
+
+NAME_PATTERN = r"^paddle_tpu_(" + "|".join(SUBSYSTEMS) + r")_[a-z][a-z0-9_]*$"
+
+METRICS = {}
+
+SPAN_SUBSYSTEMS = ("serving", "dispatch")
+
+SPAN_PATTERN = (
+    r"^(" + "|".join(SPAN_SUBSYSTEMS) + r")(\.[a-z][a-z0-9_]*)+$"
+)
+
+SPANS = {
+    "serving.request": "Root span of one serving request.",
+    "serving.prefill": "Admission prefill.",
+    "dispatch.op": "One sampled eager op dispatch.",
+}

@@ -1,0 +1,12 @@
+"""GL006 dirty sample: spans the catalog never declared."""
+
+
+def run(trace):
+    with trace.span("serving.shadow_phase"):
+        pass
+
+
+def run_subscript(handles):
+    # subscript receiver (the lazily-bound handle-tuple idiom): the
+    # method name alone must be enough for the rule to see the emission
+    handles[5].record_span("serving.sneaky", 0, 1)

@@ -1,0 +1,20 @@
+"""GL006 dirty fixture catalog: two in-catalog violations."""
+
+SUBSYSTEMS = ("serving", "dispatch")
+
+NAME_PATTERN = r"^paddle_tpu_(" + "|".join(SUBSYSTEMS) + r")_[a-z][a-z0-9_]*$"
+
+METRICS = {}
+
+SPAN_SUBSYSTEMS = ("serving", "dispatch")
+
+SPAN_PATTERN = (
+    r"^(" + "|".join(SPAN_SUBSYSTEMS) + r")(\.[a-z][a-z0-9_]*)+$"
+)
+
+SPANS = {
+    # no dotted segment after the subsystem token
+    "serving": "Bare subsystem token.",
+    # help text missing
+    "dispatch.op": "",
+}

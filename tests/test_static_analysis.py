@@ -6,7 +6,7 @@ Three contracts under test:
 1. the shipped tree is CLEAN — zero non-baselined findings over
    paddle_tpu/ with the checked-in baseline (the same invariant
    ``python -m paddle_tpu.analysis`` enforces with its exit code);
-2. every rule GL001–GL005 fires on its dirty fixture and stays silent on
+2. every rule GL001–GL006 fires on its dirty fixture and stays silent on
    its clean one (tests/fixtures/lint/ mini-trees);
 3. the silencing machinery works: inline + file-level suppressions, and
    the baseline round-trip (grandfather findings, rerun clean).
@@ -46,7 +46,7 @@ class TestShippedTree:
         exits 0 on this tree. Any new finding must be fixed, suppressed
         with a rationale, or (exceptionally) baselined."""
         new, _base, _supp, rules = analysis.analyze()
-        assert len(rules) == 5
+        assert len(rules) == 6
         assert not new, "new graftlint findings:\n" + "\n".join(
             repr(f) for f in new)
 
@@ -69,6 +69,7 @@ class TestRuleFixtures:
         ("gl003_dirty", "GL003", 7),
         ("gl004", "GL004", 3),
         ("gl005_dirty", "GL005", 4),
+        ("gl006_dirty", "GL006", 4),
     ])
     def test_dirty_fixture_fires(self, subdir, rule, expect):
         new, _, _ = _analyze(subdir)
@@ -78,7 +79,8 @@ class TestRuleFixtures:
         for f in new:
             assert "clean" not in f.path
 
-    @pytest.mark.parametrize("subdir", ["gl003_clean", "gl005_clean"])
+    @pytest.mark.parametrize("subdir", ["gl003_clean", "gl005_clean",
+                                        "gl006_clean"])
     def test_clean_trees_are_silent(self, subdir):
         new, _, _ = _analyze(subdir)
         assert new == []
@@ -232,7 +234,7 @@ class TestCLISurfaces:
         summary = json.loads(p.stdout)
         assert summary["ok"] is True
         assert [c["check"] for c in summary["checks"]] == [
-            "graftlint", "check_metric_names"]
+            "graftlint", "check_metric_names", "check_span_names"]
         assert all(c["ok"] for c in summary["checks"])
 
     def test_aggregator_and_shim_agree_on_suppressed_metric(self, tmp_path):
@@ -258,7 +260,9 @@ class TestCLISurfaces:
             assert shim.check(root=str(root)) == []
             rows = agg.run_checks(root=str(root))
             assert [r["check"] for r in rows] == ["graftlint",
-                                                 "check_metric_names"]
+                                                 "check_metric_names",
+                                                 "check_span_names"]
             assert rows[1]["ok"], rows[1]
+            assert rows[2]["ok"], rows[2]
         finally:
             sys.path.remove(os.path.join(ROOT, "tools"))

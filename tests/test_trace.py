@@ -1,0 +1,494 @@
+"""monitor.trace: structured spans + flight recorder (ISSUE 3 tentpole).
+
+Contracts under test:
+
+1. span primitives — ids/parents/trace ids, implicit thread nesting,
+   explicit cross-step parenting, ring-buffer wraparound, concurrent
+   emission from many threads;
+2. disabled-by-default — zero recording and dispatch inside the SAME 40us
+   forward budget as tests/test_dispatch_perf.py;
+3. exporters — chrome "X" events + JSON span dump (provenance block)
+   round-trip, and the merge into the profiler's chrome timeline;
+4. wiring — serving submit() round-trip yields a single-trace-ID span
+   tree (admission/prefill/decode/evict), jit compiles and training steps
+   land spans, and a watchdog timeout writes a flight-recorder dump
+   containing the open spans.
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor
+from paddle_tpu.monitor import catalog, trace
+
+
+@pytest.fixture(autouse=True)
+def _clean_trace():
+    """Every test starts with tracing off and an empty recorder, and
+    cannot leak enabled-mode overhead into the rest of the suite."""
+    monitor.disable()
+    trace.disable()
+    trace.reset()
+    yield
+    monitor.disable()
+    trace.disable()
+    trace.reset()
+
+
+# --------------------------------------------------------------------------- #
+# span primitives
+# --------------------------------------------------------------------------- #
+
+class TestSpanPrimitives:
+    def test_ids_parents_and_trace_propagation(self):
+        trace.enable()
+        root = trace.start_span("serving.request", attrs={"rid": 7})
+        assert root.trace_id == root.span_id and root.parent_id is None
+        with trace.span("serving.prefill", parent=root) as outer:
+            assert outer.parent_id == root.span_id
+            assert outer.trace_id == root.trace_id
+            with trace.span("dispatch.op") as inner:   # implicit nesting
+                assert inner.parent_id == outer.span_id
+                assert inner.trace_id == root.trace_id
+        assert [s for s in trace.open_spans()] == [root]
+        trace.end_span(root)
+        names = [s.name for s in trace.spans()]
+        assert names == ["dispatch.op", "serving.prefill", "serving.request"]
+        assert not trace.open_spans()
+
+    def test_span_ids_are_unique_and_durations_positive(self):
+        trace.enable()
+        for _ in range(20):
+            with trace.span("train.forward"):
+                pass
+        got = trace.spans()
+        assert len({s.span_id for s in got}) == 20
+        assert all(s.duration_ns >= 0 for s in got)
+
+    def test_ring_wraparound_keeps_newest(self):
+        trace.enable()
+        trace.reset(capacity=8)
+        for i in range(20):
+            trace.record_span("dispatch.op", i, i + 1, attrs={"op": "add"})
+        got = trace.spans()
+        assert len(got) == 8
+        assert [s.t0_ns for s in got] == list(range(12, 20))  # oldest->newest
+
+    def test_end_span_tolerates_none_and_double_close(self):
+        trace.enable()
+        trace.end_span(None)
+        sp = trace.start_span("comm.wait")
+        trace.end_span(sp)
+        trace.end_span(sp)                      # no double record
+        assert len(trace.spans()) == 1
+
+    def test_drop_abandons_without_recording(self):
+        trace.enable()
+        sp = trace.start_span("serving.request")
+        trace.drop(sp)
+        assert trace.open_spans() == [] and trace.spans() == []
+
+    def test_concurrent_emission_from_threads(self):
+        """>=4 threads hammer the ring concurrently: every committed span
+        is intact (unique ids, sane times), nothing raises, and the ring
+        holds exactly its capacity of the newest spans."""
+        trace.enable()
+        trace.reset(capacity=256)
+        n_threads, per_thread = 6, 100
+        errs = []
+
+        def work(k):
+            try:
+                for i in range(per_thread):
+                    with trace.span("train.forward", attrs={"step": i}):
+                        trace.record_span("dispatch.op", i, i + 1)
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=work, args=(k,))
+                   for k in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        got = trace.spans()
+        assert len(got) == 256                      # full ring, no tears
+        assert len({s.span_id for s in got}) == 256
+        assert all(s.t1_ns is not None for s in got)
+        assert not trace.open_spans()
+
+    def test_training_step_decomposition(self):
+        trace.enable()
+        with trace.training_step(step=3) as ts:
+            with ts.stage("dataload"):
+                pass
+            with ts.stage("forward"):
+                pass
+        spans = {s.name: s for s in trace.spans()}
+        root = spans["train.step"]
+        assert root.attrs == {"step": 3}
+        for name in ("train.dataload", "train.forward"):
+            assert spans[name].parent_id == root.span_id
+            assert spans[name].trace_id == root.trace_id
+
+    def test_every_framework_span_name_is_cataloged(self):
+        """The runtime names used in this suite are the GL006 contract."""
+        for name in ("dispatch.op", "jit.compile", "serving.request",
+                     "serving.prefill", "serving.decode_step",
+                     "serving.evict", "serving.queue_wait",
+                     "dataloader.batch", "train.step", "comm.wait"):
+            assert catalog.span_spec(name), name
+
+
+# --------------------------------------------------------------------------- #
+# disabled mode: no recording, no budget
+# --------------------------------------------------------------------------- #
+
+def _floor_us(f, n=60):
+    import gc
+
+    f()  # warm: fills the per-signature caches
+    gc.collect()
+    ts = []
+    for _ in range(7):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            f()
+        ts.append((time.perf_counter() - t0) / n * 1e6)
+    return min(ts)
+
+
+class TestDisabledMode:
+    def test_disabled_records_nothing(self):
+        assert isinstance(trace.span("dispatch.op"), type(trace._NOOP))
+        assert trace.start_span("dispatch.op") is None
+        assert trace.record_span("dispatch.op", 0, 1) is None
+        x = paddle.to_tensor(np.ones((2, 2), "float32"))
+        (x + x) @ x
+        assert trace.spans() == [] and trace.open_spans() == []
+
+    def test_disabled_dispatch_overhead_within_forward_budget(self):
+        """Tier-1 overhead budget: with tracing disabled the instrumented
+        dispatch path must stay inside the SAME 40us forward budget
+        tests/test_dispatch_perf.py enforces — the span layer may not tax
+        the eager hot path when off."""
+        y = paddle.to_tensor(np.random.randn(4, 4).astype("float32"))
+        xg = paddle.to_tensor(np.random.randn(4, 4).astype("float32"),
+                              stop_gradient=False)
+        us = _floor_us(lambda: xg + y)
+        assert us < 40, f"trace-off dispatch {us:.0f}us exceeds 40us budget"
+
+    def test_enabled_dispatch_spans_are_sampled(self):
+        trace.enable()
+        assert trace.dispatch_sample_every() == 64
+        trace.set_dispatch_sampling(2)
+        try:
+            x = paddle.to_tensor(np.ones((2, 2), "float32"))
+            for _ in range(10):
+                x + x
+            got = [s for s in trace.spans() if s.name == "dispatch.op"]
+            assert got, "no sampled dispatch spans recorded"
+            assert len(got) <= 6                      # 1-in-2 of ~10
+            assert got[0].attrs["op"] == "add"
+            assert got[0].attrs["sample_every"] == 2
+        finally:
+            trace.set_dispatch_sampling(64)
+
+    def test_sampling_rate_validated(self):
+        with pytest.raises(ValueError):
+            trace.set_dispatch_sampling(0)
+
+
+# --------------------------------------------------------------------------- #
+# exporters
+# --------------------------------------------------------------------------- #
+
+class TestExporters:
+    def test_chrome_span_events_parse_and_roundtrip(self):
+        trace.enable()
+        root = trace.start_span("serving.request", attrs={"rid": 1})
+        with trace.span("serving.prefill", parent=root):
+            pass
+        trace.end_span(root)
+        events = json.loads(json.dumps(trace.chrome_span_events()))
+        assert len(events) == 2
+        for ev in events:
+            assert ev["ph"] == "X" and ev["dur"] > 0
+            assert ev["args"]["trace_id"] == root.trace_id
+        by_name = {ev["name"]: ev for ev in events}
+        child = by_name["serving.prefill"]
+        parent = by_name["serving.request"]
+        # child nested within parent on the exported microsecond clock
+        assert parent["ts"] <= child["ts"]
+        assert child["ts"] + child["dur"] <= parent["ts"] + parent["dur"] + 1
+        assert child["args"]["parent_id"] == parent["args"]["span_id"]
+
+    def test_open_spans_exported_on_request(self):
+        trace.enable()
+        sp = trace.start_span("comm.wait", attrs={"desc": "allreduce"})
+        assert trace.chrome_span_events() == []
+        opened = trace.chrome_span_events(include_open=True)
+        assert len(opened) == 1 and opened[0]["args"]["open"] is True
+        trace.end_span(sp)
+
+    def test_span_dump_provenance_and_roundtrip(self):
+        trace.enable()
+        with trace.span("jit.compile", attrs={"function": "f"}):
+            pass
+        doc = json.loads(json.dumps(trace.span_dump()))
+        assert monitor.validate_provenance(doc["provenance"]) == []
+        assert doc["clock"] == "perf_counter_ns"
+        (sp,) = doc["spans"]
+        assert sp["name"] == "jit.compile" and sp["dur_ns"] >= 0
+        assert sp["attrs"] == {"function": "f"}
+        assert doc["open_spans"] == []
+
+    def test_spans_merge_into_profiler_chrome_trace(self, tmp_path):
+        """Acceptance: the span export loads alongside the profiler
+        timeline — ONE chrome JSON holds host op spans AND trace spans on
+        the same clock, and the loader skips the merged spans."""
+        from paddle_tpu import profiler as prof_mod
+        from paddle_tpu.profiler import Profiler, load_profiler_result
+
+        trace.enable()
+        x = paddle.to_tensor(np.random.randn(4, 4).astype("float32"))
+        with Profiler(targets=[prof_mod.ProfilerTarget.CPU]) as p:
+            with trace.span("train.forward"):
+                (x + x) @ x
+            p.step()
+        out = str(tmp_path / "merged.json")
+        p.export(out)
+        doc = json.load(open(out))
+        evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        host_ops = [e for e in evs if e["name"].startswith("op::")]
+        tspans = [e for e in evs if e.get("cat") == "TraceSpan"]
+        assert host_ops and tspans
+        fwd = next(e for e in tspans if e["name"] == "train.forward")
+        # same clock domain: the op spans of the traced block sit inside
+        # the train.forward span's window
+        inside = [e for e in host_ops
+                  if fwd["ts"] <= e["ts"] <= fwd["ts"] + fwd["dur"]]
+        assert inside
+        loaded = load_profiler_result(out)
+        assert not any(e.name == "train.forward" for e in loaded.events)
+
+
+# --------------------------------------------------------------------------- #
+# wiring: serving / jit / dataloader / hapi
+# --------------------------------------------------------------------------- #
+
+def _tiny_engine():
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.serving import ContinuousBatchingEngine
+
+    paddle.seed(0)
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=1, num_attention_heads=4,
+                      num_key_value_heads=4, max_position_embeddings=64)
+    model = LlamaForCausalLM(cfg)
+    return ContinuousBatchingEngine(model, max_batch=2, max_len=32,
+                                    block_size=8, prefill_buckets=(8, 16))
+
+
+class TestServingTrace:
+    def test_submit_roundtrip_single_trace_id_tree(self):
+        """ISSUE 3 acceptance: one submit() round-trip = one trace id
+        covering admission (queue wait), prefill, every decode step and
+        the eviction, all parented on the serving.request root."""
+        eng = _tiny_engine()
+        trace.enable()
+        eng.submit(np.array([1, 2, 3], np.int32))
+        for _ in range(10):
+            if eng.step(max_new_tokens=3):
+                break
+        assert eng.num_active == 0
+        spans = trace.spans()
+        roots = [s for s in spans if s.name == "serving.request"]
+        assert len(roots) == 1
+        root = roots[0]
+        tree = [s for s in spans if s.trace_id == root.trace_id]
+        names = {s.name for s in tree}
+        assert names == {"serving.request", "serving.queue_wait",
+                         "serving.prefill", "serving.decode_step",
+                         "serving.evict"}
+        assert all(s.parent_id == root.span_id
+                   for s in tree if s is not root)
+        decode = [s for s in tree if s.name == "serving.decode_step"]
+        assert len(decode) == 2     # prefill emitted token 1; decodes 2..3
+        # TTFT decomposition: queue_wait then prefill, inside the root
+        qw = next(s for s in tree if s.name == "serving.queue_wait")
+        pf = next(s for s in tree if s.name == "serving.prefill")
+        assert root.t0_ns <= qw.t0_ns <= qw.t1_ns <= pf.t1_ns
+        assert pf.attrs["prompt_len"] == 3
+        assert not trace.open_spans()             # eviction closed the root
+
+    def test_two_requests_two_disjoint_trees(self):
+        eng = _tiny_engine()
+        trace.enable()
+        eng.submit(np.array([1, 2, 3], np.int32))
+        eng.submit(np.array([4, 5], np.int32))
+        for _ in range(12):
+            eng.step(max_new_tokens=2)
+            if eng.num_active == 0 and eng.num_pending == 0:
+                break
+        roots = [s for s in trace.spans() if s.name == "serving.request"]
+        assert len(roots) == 2
+        assert roots[0].trace_id != roots[1].trace_id
+        assert {r.attrs["rid"] for r in roots} == {0, 1}
+
+    def test_unfinished_request_stays_open_for_flight_recorder(self):
+        eng = _tiny_engine()
+        trace.enable()
+        eng.submit(np.array([1, 2, 3], np.int32))
+        eng.step()                                # still decoding
+        open_names = [s.name for s in trace.open_spans()]
+        assert open_names == ["serving.request"]
+
+
+class TestJitAndDataloaderTrace:
+    def test_to_static_compile_span(self):
+        from paddle_tpu.jit import to_static
+
+        trace.enable()
+
+        @to_static
+        def f(a):
+            return a * 2 + 1
+
+        x = paddle.to_tensor(np.ones((3,), "float32"))
+        f(x)
+        f(x)                                      # cache hit: no new span
+        compiles = [s for s in trace.spans() if s.name == "jit.compile"]
+        assert len(compiles) == 1
+        assert compiles[0].attrs == {"function": "f"}
+
+    def test_dataloader_batch_spans(self):
+        from paddle_tpu.io import DataLoader
+
+        class DS:
+            def __len__(self):
+                return 6
+
+            def __getitem__(self, i):
+                return np.full((2,), i, "float32")
+
+        trace.enable()
+        loader = DataLoader(DS(), batch_size=2, use_buffer_reader=False)
+        batches = list(loader)
+        got = [s for s in trace.spans() if s.name == "dataloader.batch"]
+        assert len(got) == len(batches) == 3
+
+    def test_hapi_fit_records_step_decomposition(self):
+        import paddle_tpu.nn as nn
+
+        class DS:
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                return (np.ones((3,), "float32"),
+                        np.zeros((1,), "float32"))
+
+        net = nn.Linear(3, 1)
+        model = paddle.Model(net)
+        model.prepare(paddle.optimizer.SGD(learning_rate=0.1,
+                                           parameters=net.parameters()),
+                      loss=nn.MSELoss())
+        trace.enable()
+        model.fit(DS(), batch_size=2, epochs=1, verbose=0)
+        spans = trace.spans()
+        steps = [s for s in spans if s.name == "train.step"]
+        assert len(steps) >= 2                    # 2 batches (+ drain step)
+        root = steps[0]
+        children = {s.name for s in spans
+                    if s.parent_id == root.span_id}
+        assert children == {"train.dataload", "train.forward",
+                            "train.backward", "train.optimizer"}
+
+
+# --------------------------------------------------------------------------- #
+# flight recorder / hang dump
+# --------------------------------------------------------------------------- #
+
+class TestFlightRecorder:
+    def test_flight_dump_contents_and_provenance(self, tmp_path):
+        trace.enable()
+        with trace.span("jit.compile", attrs={"function": "g"}):
+            pass
+        hang = trace.start_span("comm.wait", attrs={"desc": "allreduce#3"})
+        path = trace.flight_dump(path=str(tmp_path / "dump.json"),
+                                 reason="unit test")
+        doc = json.load(open(path))
+        assert doc["reason"] == "unit test"
+        assert monitor.validate_provenance(doc["provenance"]) == []
+        assert doc["monitor"] is not None         # metrics snapshot rides
+        assert [s["name"] for s in doc["open_spans"]] == ["comm.wait"]
+        assert any(s["name"] == "jit.compile" for s in doc["spans"])
+        trace.end_span(hang)
+
+    def test_flight_dump_tail_bounded(self, tmp_path):
+        trace.enable()
+        for i in range(50):
+            trace.record_span("dispatch.op", i, i + 1)
+        path = trace.flight_dump(path=str(tmp_path / "dump.json"), tail=10)
+        doc = json.load(open(path))
+        assert len(doc["spans"]) == 10
+        assert doc["spans"][-1]["t0_ns"] == 49    # the newest survive
+
+    def test_per_rank_default_path(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path))
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "3")
+        p = trace.default_flight_path()
+        assert p.startswith(str(tmp_path))
+        assert f"rank3_pid{os.getpid()}" in p
+
+    def test_watchdog_timeout_writes_flight_dump(self, monkeypatch,
+                                                 tmp_path):
+        """ISSUE 3 acceptance: a forced WatchdogTimeout writes a
+        flight-recorder dump containing the open spans (the hanging
+        comm.wait among them)."""
+        from paddle_tpu.distributed.watchdog import CommWatchdog
+
+        monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path))
+        trace.enable()
+        fired = []
+        dog = CommWatchdog(timeout=0.05,
+                           on_timeout=lambda desc, dump: fired.append(desc))
+        try:
+            with dog.watch("allreduce#hung"):
+                deadline = time.time() + 5
+                while not fired and time.time() < deadline:
+                    time.sleep(0.01)
+        finally:
+            dog.stop()
+        assert fired == ["allreduce#hung"]
+        assert dog.last_flight_dump and os.path.exists(dog.last_flight_dump)
+        doc = json.load(open(dog.last_flight_dump))
+        assert "watchdog timeout" in doc["reason"]
+        open_names = [s["name"] for s in doc["open_spans"]]
+        assert "comm.wait" in open_names
+        hung = next(s for s in doc["open_spans"] if s["name"] == "comm.wait")
+        assert hung["attrs"]["desc"] == "allreduce#hung"
+        assert "allreduce#hung" in doc["extra"]["watchdog"]
+
+    def test_elastic_restart_writes_flight_dump(self, monkeypatch,
+                                                tmp_path):
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager
+
+        monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path))
+        trace.enable()
+        mgr = ElasticManager.__new__(ElasticManager)
+        mgr._node_id = "n0"
+        mgr._job = "j"
+        mgr.last_flight_dump = None
+        mgr._flight_dump(["n0", "n1"], ["n0"])
+        assert mgr.last_flight_dump and os.path.exists(mgr.last_flight_dump)
+        doc = json.load(open(mgr.last_flight_dump))
+        assert "elastic membership change" in doc["reason"]
+        assert doc["extra"]["node_id"] == "n0"

@@ -3,13 +3,16 @@
 
 One analysis pass (parse the tree once) feeds two result rows:
 
-1. graftlint (GL001–GL005 over paddle_tpu/, baseline + suppressions
+1. graftlint (GL001–GL006 over paddle_tpu/, baseline + suppressions
    applied — the tier-1 gate's view);
 2. the metric-name contract (GL005 strict: no baseline, inline
    suppressions honored, and a missing catalog is a failure — identical
    to tools/check_metric_names.py, which shares the same
    strict_problems() implementation; that CLI's exit-code contract is
-   covered by the subprocess test in tests/test_static_analysis.py).
+   covered by the subprocess test in tests/test_static_analysis.py);
+3. the span-name contract (GL006 strict: same semantics over the
+   SPANS table in monitor/catalog.py — the trace vocabulary is linted
+   exactly like the metric vocabulary).
 
 Prints one status line per check, then a machine-readable JSON summary on
 stdout (``--json`` prints ONLY the JSON). Exit 0 iff every check passed.
@@ -51,6 +54,16 @@ def run_checks(root=ROOT):
     problems = an.RULES_BY_ID["GL005"].strict_problems(project, findings)
     rows.append({
         "check": "check_metric_names",
+        "ok": not problems,
+        "findings": len(problems),
+        "detail": problems,
+        "seconds": round(time.perf_counter() - t0, 3),
+    })
+
+    t0 = time.perf_counter()
+    problems = an.RULES_BY_ID["GL006"].strict_problems(project, findings)
+    rows.append({
+        "check": "check_span_names",
         "ok": not problems,
         "findings": len(problems),
         "detail": problems,
