@@ -461,6 +461,49 @@ def _trace_overhead_bench():
     }
 
 
+def _sanitizer_overhead_bench():
+    """graftsan tax on the dispatch microbench: us/op with sanitizers off
+    (the shipping default — must be ~zero: no hook in the concretize slot,
+    no wrapped locks on the dispatch path) vs fully enabled. Stamped as
+    detail.sanitizer_overhead so future BENCH_*.json rounds track the
+    sanitizer tax like the trace tax."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.analysis import sanitizers as san
+
+    y = paddle.to_tensor(np.random.RandomState(3).randn(4, 4).astype("float32"))
+    xg = paddle.to_tensor(np.random.RandomState(4).randn(4, 4).astype("float32"),
+                          stop_gradient=False)
+
+    def _t(f, n=60, reps=5):
+        f()
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                f()
+            best = min(best, (time.perf_counter() - t0) / n * 1e6)
+        return round(best, 2)
+
+    # force a clean 'off' measurement even when PADDLE_TPU_SANITIZE enabled
+    # them at import (the natural way a user looks at the sanitizer tax)
+    san.disable()
+    san.reset()
+    off = _t(lambda: xg + y)
+    san.enable()
+    try:
+        on = _t(lambda: xg + y)
+    finally:
+        san.disable()
+        san.reset()
+    return {
+        "add_tape_on_fwd_us_sanitize_off": off,
+        "add_tape_on_fwd_us_sanitize_on": on,
+        "delta_us": round(on - off, 2),
+    }
+
+
 # the donated fused train step + timing-loop machinery is shared with
 # bench_suite.py — see bench_common.py (the tunnel rules live there)
 
@@ -593,6 +636,14 @@ def worker():
     except Exception as e:  # noqa: BLE001 - the headline metric must survive
         trace_overhead = {"error": f"{type(e).__name__}: {e}"[:200]}
     _log(f"[bench] trace_overhead: {trace_overhead}")
+
+    try:
+        sanitizer_overhead = ({"skipped": True}
+                              if os.environ.get("BENCH_SKIP_DISPATCH")
+                              else _sanitizer_overhead_bench())
+    except Exception as e:  # noqa: BLE001 - the headline metric must survive
+        sanitizer_overhead = {"error": f"{type(e).__name__}: {e}"[:200]}
+    _log(f"[bench] sanitizer_overhead: {sanitizer_overhead}")
     if on_tpu and not flash_info.get("skipped") and not flash_info.get("ok"):
         # kernel unproven on this chip -> train on the XLA math path rather than
         # risk a mid-bench compile failure; the JSON records why.
@@ -721,6 +772,7 @@ def worker():
             "flash_attention": flash_info,
             "dispatch_us": dispatch_us,
             "trace_overhead": trace_overhead,
+            "sanitizer_overhead": sanitizer_overhead,
             "decode": decode_info,
         },
     }

@@ -332,3 +332,12 @@ def check_shape(shape):
                 raise TypeError(
                     "All elements in ``shape`` must be integers when it's "
                     "a list or tuple")
+
+
+# graftsan runtime sanitizers (analysis/sanitizers.py): opt-in via
+# PADDLE_TPU_SANITIZE=lock,recompile,hostsync — disabled (and costless)
+# otherwise. Installed at the END of package init so the lock wrapper sees
+# the monitor/trace module globals it swaps.
+from .analysis.sanitizers import install_from_env as _san_install  # noqa: E402
+
+_san_install()

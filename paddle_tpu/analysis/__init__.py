@@ -13,13 +13,27 @@ and reports framework-specific hazards the test suite cannot see:
   lock body;
 - GL005 metric-name-contract — every registered metric is declared in
   monitor/catalog.py and follows the naming convention (the engine form
-  of tools/check_metric_names.py).
+  of tools/check_metric_names.py);
+- GL006 span-name-contract — the same contract for trace span names;
+- GL007 lock-order-inversion — the static lock-acquisition graph (built
+  over the whole-tree call graph, callgraph.py) must stay acyclic;
+- GL008 recompile-hazard — per-call defop registration, shape/dtype
+  branching in jitted bodies, per-call-constructed static args.
+
+Since PR 4 the engine is INTERPROCEDURAL: ``callgraph.py`` builds a
+whole-tree call graph with per-function effect summaries, so GL001/
+GL002/GL004 flag an impure / host-syncing / blocking helper at the call
+site inside the traced body / hot path / lock region, with the
+propagation chain in the finding (render it with ``--explain GLxxx``).
+The runtime twins of GL007/GL008 (and a host-sync tripwire) live in
+``analysis/sanitizers.py`` ("graftsan", ``PADDLE_TPU_SANITIZE=...``);
+see docs/sanitizers.md.
 
 Run it as ``python -m paddle_tpu.analysis`` (or, without importing the
 framework at all, ``python tools/lint_framework.py``). Inline
 suppressions (``# graftlint: disable=GL002``), a checked-in baseline for
-grandfathered findings, and a tier-1 test keep the tree clean going
-forward; see docs/static_analysis.md.
+grandfathered findings (EMPTY since PR 4), and a tier-1 test keep the
+tree clean going forward; see docs/static_analysis.md.
 
 This package intentionally uses only the standard library — no jax, no
 framework imports — so ``tools/lint_framework.py`` can load it by file
@@ -68,7 +82,7 @@ def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="python -m paddle_tpu.analysis",
         description="graftlint: framework-aware static analysis "
-                    "(GL001–GL005)")
+                    "(GL001–GL008, interprocedural)")
     ap.add_argument("--root", default=None,
                     help="tree to analyze (default: this repo)")
     ap.add_argument("--include", default="paddle_tpu",
@@ -87,6 +101,11 @@ def main(argv=None):
                          "and exit 0")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable report on stdout")
+    ap.add_argument("--explain", metavar="GLXXX", default=None,
+                    help="run ONE rule and print every finding with its "
+                         "interprocedural propagation chain (file:line "
+                         "per hop) — the debugging view of a chain the "
+                         "finding message only names")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
@@ -94,6 +113,15 @@ def main(argv=None):
         for r in ALL_RULES:
             print(f"{r.id}\t{r.name}\t{r.rationale}")
         return 0
+
+    if args.explain:
+        rid = args.explain.strip().upper()
+        if rid not in RULES_BY_ID:
+            print(f"graftlint: unknown rule {rid!r} "
+                  f"(known: {', '.join(sorted(RULES_BY_ID))})",
+                  file=sys.stderr)
+            return 2
+        args.rules = rid
 
     if args.rules:
         try:
@@ -122,6 +150,15 @@ def main(argv=None):
               f"({len(new + base)} fingerprints) -> {path}")
         return 0
 
+    if args.explain:
+        for f in new:
+            print(repr(f))
+            for hop in f.chain:
+                print(f"    | {hop}")
+            if not f.chain:
+                print("    | (direct finding — no propagation chain)")
+        print(f"graftlint --explain {args.explain}: {len(new)} finding(s)")
+        return 1 if new else 0
     if args.json:
         print(render_json(new, base, supp, rules))
     else:

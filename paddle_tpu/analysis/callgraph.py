@@ -1,0 +1,477 @@
+"""Whole-tree call graph: module-level name resolution, per-function effect
+summaries, and transitive propagation.
+
+This is what upgrades graftlint from per-function (syntactic) to
+interprocedural: GL001/GL002/GL004 findings no longer stop at the function
+boundary — an impure or host-syncing helper called from a traced body is
+flagged AT THE CALL SITE, with the whole propagation chain in the finding —
+and GL007 builds the static lock-acquisition graph (which locks can be
+requested while which are held) the same way.
+
+Design constraints, inherited from the engine core:
+
+- pure AST, never imports the analyzed tree;
+- resolution is deliberately CONSERVATIVE: a call resolves to a target only
+  when the binding is statically unambiguous (a local/module-level def, an
+  ``import``ed project module's top-level def, a ``self.method`` on the
+  enclosing class, a re-export followed through at most 4 hops). Anything
+  else — higher-order calls, attribute chains on locals, stdlib/jax targets
+  — resolves to None and simply doesn't propagate. Missed propagation is a
+  false negative; wrong propagation would be a false positive in a gate
+  that must stay self-clean, so the trade is deliberate.
+
+Vocabulary:
+
+- a :class:`FuncInfo` is one function/method with its direct ``calls``
+  (resolved where possible), direct ``effects`` and ``lock_regions``;
+- an :class:`Effect` is one direct hazardous fact about a function body:
+  ``impure`` (GL001 vocabulary), ``hostsync`` (GL002), ``blocking``
+  (GL004) or ``acquire:<lockkey>`` (GL007). Effects on lines carrying the
+  matching inline suppression are NOT collected — a suppressed sync is an
+  accepted sync and must not propagate to its callers;
+- ``summary`` maps each effect kind to the nearest witness: either a direct
+  effect or a (callee, call-line) link whose chain :func:`CallGraph.chain`
+  reconstructs for the finding message and ``--explain``.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import dotted_name
+
+_MAX_REEXPORT_HOPS = 4
+
+
+class Effect:
+    """One direct hazardous fact in a function body."""
+
+    __slots__ = ("kind", "detail", "path", "line")
+
+    def __init__(self, kind, detail, path, line):
+        self.kind = kind
+        self.detail = detail
+        self.path = path
+        self.line = line
+
+    def __repr__(self):
+        return f"Effect({self.kind}, {self.detail} at {self.path}:{self.line})"
+
+
+class FuncInfo:
+    """One function/method: direct calls, direct effects, lock regions and
+    the propagated summary."""
+
+    __slots__ = ("key", "node", "srcfile", "calls", "effects",
+                 "lock_regions", "summary")
+
+    def __init__(self, key, node, srcfile):
+        self.key = key                  # (relpath, dotted qualname)
+        self.node = node
+        self.srcfile = srcfile
+        self.calls = []                 # [(call node, target key|None, disp)]
+        self.effects = []               # [Effect]
+        self.lock_regions = []          # [(lockkey, with node,
+        #                                  [(inner lockkey, lineno)],
+        #                                  [(call node, target, disp)])]
+        self.summary = {}               # kind -> (Effect, via|None)
+        # via = (callee key, call lineno, display name)
+
+    @property
+    def qualname(self):
+        return self.key[1]
+
+    @property
+    def path(self):
+        return self.key[0]
+
+
+def body_walk(fn_node):
+    """Walk a function's OWN body: descends statements and expressions but
+    not nested function/class/lambda bodies (those are separate FuncInfos —
+    a factory that defines an impure closure is not itself impure)."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _module_parts(relpath):
+    parts = relpath[:-3].split("/")
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return tuple(parts)
+
+
+def _package_of(relpath):
+    """The package a module's relative imports are anchored at."""
+    parts = relpath[:-3].split("/")
+    if parts[-1] == "__init__":
+        return tuple(parts[:-1])
+    return tuple(parts[:-1])
+
+
+class CallGraph:
+    """The whole-project graph. Build once per Project (cached on it via
+    :meth:`~paddle_tpu.analysis.core.Project.callgraph`)."""
+
+    def __init__(self, project):
+        self.project = project
+        self._mod_files = {}    # module parts tuple -> relpath
+        self.functions = {}     # (relpath, qualname) -> FuncInfo
+        self._by_node = {}      # id(FunctionDef node) -> FuncInfo
+        self._ambiguous = set()  # keys bound by >1 def (conditional defs):
+        #                          resolution refuses them — wrong
+        #                          propagation beats missed propagation
+        self._toplevel = {}     # (relpath, name) -> ("func"|"class", qual)
+        self._imports = {}      # relpath -> {local: ("mod", parts) |
+        #                                      ("sym", parts, orig)}
+        self._index()
+        self._collect()
+        self._propagate()
+
+    # -- indexing ------------------------------------------------------------
+    def _index(self):
+        for f in self.project.files:
+            self._mod_files[_module_parts(f.relpath)] = f.relpath
+        for f in self.project.files:
+            if f.tree is None:
+                continue
+            self._imports[f.relpath] = self._file_imports(f)
+            for node in ast.walk(f.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    scope = f.scope_of(node)
+                    qual = f"{scope}.{node.name}" if scope else node.name
+                    fi = FuncInfo((f.relpath, qual), node, f)
+                    if fi.key in self.functions:
+                        # duplicate binding (conditional defs): the runtime
+                        # winner is undecidable statically, so the key is
+                        # poisoned for resolution at ANY scope depth
+                        self._ambiguous.add(fi.key)
+                    else:
+                        self.functions[fi.key] = fi
+                    self._by_node[id(node)] = self.functions[fi.key]
+                    if not scope:
+                        if (f.relpath, node.name) in self._toplevel:
+                            self._toplevel[(f.relpath, node.name)] = None
+                        else:
+                            self._toplevel[(f.relpath, node.name)] = \
+                                ("func", qual)
+                elif isinstance(node, ast.ClassDef):
+                    scope = f.scope_of(node)
+                    if not scope:
+                        self._toplevel.setdefault(
+                            (f.relpath, node.name), ("class", node.name))
+
+    def _file_imports(self, f):
+        out = {}
+        pkg = _package_of(f.relpath)
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    parts = tuple(alias.name.split("."))
+                    if alias.asname:
+                        out[alias.asname] = ("mod", parts)
+                    else:
+                        out[parts[0]] = ("mod", (parts[0],))
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    if node.level - 1 > len(pkg):
+                        continue
+                    base = pkg[:len(pkg) - (node.level - 1)]
+                else:
+                    base = ()
+                base += tuple(node.module.split(".")) if node.module else ()
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    target = base + (alias.name,)
+                    local = alias.asname or alias.name
+                    if target in self._mod_files:
+                        out[local] = ("mod", target)
+                    else:
+                        out[local] = ("sym", base, alias.name)
+        return out
+
+    # -- resolution ----------------------------------------------------------
+    def resolve(self, srcfile, scope, call):
+        """Target FuncInfo key for a Call, or None when the binding is not
+        statically unambiguous."""
+        name = dotted_name(call.func)
+        if name is None:
+            return None
+        parts = name.split(".")
+        rel = srcfile.relpath
+        if parts[0] in ("self", "cls") and len(parts) == 2:
+            cls = self._enclosing_class(srcfile, call)
+            if cls is None:
+                return None
+            key = (rel, f"{cls}.{parts[1]}")
+            if key in self._ambiguous:
+                return None
+            return key if key in self.functions else None
+        if len(parts) == 1:
+            return self._resolve_bare(rel, scope, parts[0])
+        imp = self._imports.get(rel, {}).get(parts[0])
+        if imp is None:
+            return None
+        if imp[0] == "mod":
+            modparts = imp[1] + tuple(parts[1:-1])
+            return self._resolve_in_module(modparts, parts[-1])
+        if imp[0] == "sym" and len(parts) == 2:
+            # `from pkg import sub; sub.f()` where sub is itself a module
+            target = imp[1] + (imp[2],)
+            if target in self._mod_files:
+                return self._resolve_in_module(target, parts[1])
+        return None
+
+    def _resolve_bare(self, rel, scope, name):
+        scopes = scope.split(".") if scope else []
+        for i in range(len(scopes), -1, -1):
+            qual = ".".join(scopes[:i] + [name])
+            key = (rel, qual)
+            if key in self._ambiguous:
+                return None
+            if key in self.functions:
+                return key
+        entry = self._toplevel.get((rel, name))
+        if entry is not None:
+            return self._class_or_func(rel, entry)
+        imp = self._imports.get(rel, {}).get(name)
+        if imp is not None and imp[0] == "sym":
+            return self._resolve_in_module(imp[1], imp[2])
+        return None
+
+    def _resolve_in_module(self, modparts, name, depth=0):
+        relf = self._mod_files.get(modparts)
+        if relf is None:
+            return None
+        entry = self._toplevel.get((relf, name))
+        if entry is not None:
+            return self._class_or_func(relf, entry)
+        imp = self._imports.get(relf, {}).get(name)
+        if imp is not None and imp[0] == "sym" \
+                and depth < _MAX_REEXPORT_HOPS:
+            return self._resolve_in_module(imp[1], imp[2], depth + 1)
+        if imp is not None and imp[0] == "mod":
+            return None
+        return None
+
+    def _class_or_func(self, relf, entry):
+        if entry is None:
+            return None
+        kind, qual = entry
+        if kind == "class":
+            key = (relf, f"{qual}.__init__")
+        else:
+            key = (relf, qual)
+        if key in self._ambiguous:
+            return None
+        return key if key in self.functions else None
+
+    def _enclosing_class(self, srcfile, node):
+        for anc in srcfile.ancestors(node):
+            if isinstance(anc, ast.ClassDef):
+                scope = srcfile.scope_of(anc)
+                return f"{scope}.{anc.name}" if scope else anc.name
+        return None
+
+    # -- lock identity -------------------------------------------------------
+    def lock_key(self, srcfile, expr):
+        """Stable cross-file identity for a lock expression. ``self._lock``
+        keys on the enclosing class (the class IS the lock site);
+        module-level names key on their file; anything else keys on
+        file+expression so unrelated files can never alias."""
+        name = dotted_name(expr)
+        if name is None:
+            return None
+        parts = name.split(".")
+        if parts[0] in ("self", "cls") and len(parts) == 2:
+            cls = self._enclosing_class(srcfile, expr)
+            if cls is not None:
+                return f"{srcfile.relpath}:{cls}.{parts[1]}"
+        if len(parts) == 1:
+            return f"{srcfile.relpath}:{name}"
+        return f"{srcfile.relpath}:{name}"
+
+    # -- effect collection ---------------------------------------------------
+    def _collect(self):
+        # rules imports callgraph at module level; importing it back lazily
+        # here keeps the pattern tables single-source without a cycle
+        from .rules import HostSync, LockDiscipline, TraceImpurity
+
+        impure_of = TraceImpurity()._impure
+        hs = HostSync()
+        for fi in self.functions.values():
+            f = fi.srcfile
+            fn_qual = fi.qualname
+            for node in body_walk(fi.node):
+                if isinstance(node, ast.With):
+                    self._collect_lock_region(fi, node, fn_qual)
+                if not isinstance(node, ast.Call):
+                    continue
+                tgt = self.resolve(f, fn_qual, node)
+                disp = dotted_name(node.func) or "<call>"
+                fi.calls.append((node, tgt, disp))
+                line = getattr(node, "lineno", 0)
+                nm = impure_of(node)
+                if nm and not f.suppressed("GL001", line):
+                    fi.effects.append(Effect(
+                        "impure", f"{nm}()", f.relpath, line))
+                msg = hs._classify(f, node)
+                if msg and not f.suppressed("GL002", line):
+                    fi.effects.append(Effect(
+                        "hostsync", _sync_token(node), f.relpath, line))
+                blk = _blocking_token(node, LockDiscipline)
+                if blk and not f.suppressed("GL004", line):
+                    fi.effects.append(Effect(
+                        "blocking", blk, f.relpath, line))
+
+        for fi in self.functions.values():
+            for (lockkey, w, _inner, _calls) in fi.lock_regions:
+                if not fi.srcfile.suppressed("GL007", w.lineno):
+                    fi.effects.append(Effect(
+                        "acquire:" + lockkey, f"acquires {_short(lockkey)}",
+                        fi.srcfile.relpath, w.lineno))
+
+    def _collect_lock_region(self, fi, w, fn_qual):
+        from .rules import LockDiscipline
+
+        lock_items = [i for i in w.items if LockDiscipline._lock_ctx(i)]
+        if not lock_items:
+            return
+        f = fi.srcfile
+        lockkey = self.lock_key(f, lock_items[0].context_expr)
+        if lockkey is None:
+            return
+        inner, calls = [], []
+        for node in _region_walk(w):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    if LockDiscipline._lock_ctx(item):
+                        k = self.lock_key(f, item.context_expr)
+                        if k is not None:
+                            inner.append((k, node.lineno))
+            elif isinstance(node, ast.Call):
+                tgt = self.resolve(f, fn_qual, node)
+                if tgt is not None:
+                    calls.append((node, tgt, dotted_name(node.func)
+                                  or "<call>"))
+        fi.lock_regions.append((lockkey, w, inner, calls))
+
+    # -- propagation ---------------------------------------------------------
+    def _propagate(self):
+        for fi in self.functions.values():
+            for eff in fi.effects:
+                fi.summary.setdefault(eff.kind, (eff, None))
+        changed = True
+        while changed:
+            changed = False
+            for fi in self.functions.values():
+                for (call, tgt, disp) in fi.calls:
+                    if tgt is None or tgt == fi.key:
+                        continue
+                    for kind, (eff, _via) in \
+                            self.functions[tgt].summary.items():
+                        if kind not in fi.summary:
+                            fi.summary[kind] = (
+                                eff, (tgt, call.lineno, disp))
+                            changed = True
+
+    # -- queries -------------------------------------------------------------
+    def info_for_node(self, fn_node):
+        return self._by_node.get(id(fn_node))
+
+    def callee_summary(self, key, kind):
+        """(Effect, via) for a propagated effect on a function, or None."""
+        fi = self.functions.get(key)
+        return None if fi is None else fi.summary.get(kind)
+
+    def transitive_acquires(self, key):
+        """Lock keys a function may acquire, directly or via callees."""
+        fi = self.functions.get(key)
+        if fi is None:
+            return ()
+        return tuple(sorted(k[len("acquire:"):] for k in fi.summary
+                            if k.startswith("acquire:")))
+
+    def chain(self, key, kind):
+        """Propagation chain, caller-first, ending at the direct effect.
+        Each hop is a human-readable string with file:line detail (kept out
+        of finding MESSAGES so fingerprints stay line-number-free)."""
+        out = []
+        cur = key
+        seen = set()
+        while cur is not None and cur not in seen:
+            seen.add(cur)
+            entry = self.functions[cur].summary.get(kind)
+            if entry is None:
+                break
+            eff, via = entry
+            if via is None:
+                out.append(f"{self.functions[cur].qualname} "
+                           f"[{eff.detail} at {eff.path}:{eff.line}]")
+                return out
+            tgt, line, disp = via
+            out.append(f"{self.functions[cur].qualname} "
+                       f"({self.functions[cur].path}:{line} calls {disp})")
+            cur = tgt
+        return out
+
+    def chain_names(self, key, kind):
+        """The bare qualname hops of :meth:`chain` (for messages: stable
+        under line drift)."""
+        out = []
+        cur = key
+        seen = set()
+        while cur is not None and cur not in seen:
+            seen.add(cur)
+            entry = self.functions[cur].summary.get(kind)
+            if entry is None:
+                break
+            eff, via = entry
+            out.append(self.functions[cur].qualname)
+            if via is None:
+                out.append(eff.detail)
+                return out
+            cur = via[0]
+        return out
+
+
+def _region_walk(with_node):
+    """Walk a with-block's BODY (not its context expressions), staying out
+    of nested function/class bodies."""
+    stack = list(with_node.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _sync_token(call):
+    if isinstance(call.func, ast.Attribute) \
+            and call.func.attr in ("item", "numpy"):
+        return f".{call.func.attr}()"
+    name = dotted_name(call.func)
+    return f"{name}(<device expr>)" if name else "<host sync>"
+
+
+def _blocking_token(call, LockDiscipline):
+    name = dotted_name(call.func)
+    if name and (name.startswith("jax.") or name.startswith("jnp.")):
+        return f"{name}()"
+    if name in LockDiscipline.BLOCKING_EXACT:
+        return f"{name}()"
+    if LockDiscipline._blocking_attr_call(call):
+        return f".{call.func.attr}()"
+    return None
+
+
+def _short(lockkey):
+    return lockkey.split(":", 1)[-1]

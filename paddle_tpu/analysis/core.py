@@ -35,15 +35,20 @@ import tokenize
 class Finding:
     """One rule violation at a source location."""
 
-    __slots__ = ("rule", "path", "line", "col", "message", "scope")
+    __slots__ = ("rule", "path", "line", "col", "message", "scope", "chain")
 
-    def __init__(self, rule, path, line, col, message, scope=""):
+    def __init__(self, rule, path, line, col, message, scope="", chain=()):
         self.rule = rule
         self.path = path.replace(os.sep, "/")
         self.line = int(line)
         self.col = int(col)
         self.message = message
         self.scope = scope  # dotted enclosing-def chain, "" at module level
+        # interprocedural propagation chain (callgraph.py), caller-first,
+        # each hop with file:line detail. NOT part of the fingerprint and
+        # kept out of `message` — chains carry line numbers, which must not
+        # churn the baseline. Rendered by --explain.
+        self.chain = tuple(chain)
 
     @property
     def fingerprint(self):
@@ -53,9 +58,12 @@ class Finding:
         return f"{self.rule}:{self.path}:{self.scope}:{self.message}"
 
     def as_dict(self):
-        return {"rule": self.rule, "path": self.path, "line": self.line,
-                "col": self.col, "scope": self.scope,
-                "message": self.message}
+        d = {"rule": self.rule, "path": self.path, "line": self.line,
+             "col": self.col, "scope": self.scope,
+             "message": self.message}
+        if self.chain:
+            d["chain"] = list(self.chain)
+        return d
 
     def __repr__(self):
         return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
@@ -194,6 +202,16 @@ class Project:
             return None
         with open(path, encoding="utf-8") as f:
             return f.read()
+
+    def callgraph(self):
+        """The whole-tree call graph (callgraph.py), built once per project
+        and shared by every interprocedural rule in the run."""
+        cg = getattr(self, "_callgraph", None)
+        if cg is None:
+            from .callgraph import CallGraph
+
+            cg = self._callgraph = CallGraph(self)
+        return cg
 
 
 def dotted_name(node):

@@ -1,11 +1,14 @@
-"""graftlint rules GL001–GL006: framework-aware static checks.
+"""graftlint rules GL001–GL008: framework-aware static checks.
 
 Each rule encodes one invariant the runtime cannot cheaply enforce —
 trace purity, host-sync hygiene, registry/doc consistency, lock
-discipline, metric-name contract, span-name contract — as a pure AST/text
-check. Rules receive the whole
-:class:`~paddle_tpu.analysis.core.Project` so cross-file rules (GL003,
-GL005, GL006) see registrations and their catalogs together.
+discipline, metric-name contract, span-name contract, lock-order
+consistency, recompile hygiene — as a pure AST/text check. Rules receive
+the whole :class:`~paddle_tpu.analysis.core.Project` so cross-file rules
+(GL003, GL005, GL006) see registrations and their catalogs together, and
+the interprocedural rules (GL001/GL002/GL004 propagation, GL007, GL008)
+share one :class:`~paddle_tpu.analysis.callgraph.CallGraph` per run via
+``project.callgraph()``.
 
 The rationale for each rule lives in docs/static_analysis.md; the short
 form is on the rule class.
@@ -26,11 +29,24 @@ class Rule:
     def check(self, project):
         raise NotImplementedError
 
-    def finding(self, srcfile, node, message):
+    def finding(self, srcfile, node, message, chain=()):
         return Finding(self.id, srcfile.relpath,
                        getattr(node, "lineno", 0),
                        getattr(node, "col_offset", 0),
-                       message, scope=srcfile.scope_of(node))
+                       message, scope=srcfile.scope_of(node), chain=chain)
+
+    def strict_problems(self, project, findings=None):
+        """Aggregator semantics (tools/run_static_checks.py): this one rule
+        with NO baseline, inline suppressions honored. Pass ``findings`` to
+        reuse an existing engine run."""
+        from .core import partition, run
+
+        if findings is None:
+            findings = run(project, [self])
+        else:
+            findings = [f for f in findings if f.rule == self.id]
+        new, _base, _supp = partition(project, findings, ())
+        return [f"{f.path}:{f.line}: {f.message}" for f in new]
 
 
 def _contains(node, pred):
@@ -101,7 +117,12 @@ class TraceImpurity(Rule):
         call form (``jax.jit(run, ...)`` / ``to_static(fn)``), which is
         how the serving engine builds its cached programs. Call-form
         targets resolve to the def with the same name in the same
-        enclosing scope (two methods may each define a local ``run``)."""
+        enclosing scope (two methods may each define a local ``run``).
+        Memoized per file: three rules (GL001, GL002 interproc, GL008)
+        share one computation."""
+        memo = getattr(srcfile, "_traced_functions_memo", None)
+        if memo is not None:
+            return memo
         traced = {}
         defs = {}
         for n in ast.walk(srcfile.tree):
@@ -120,10 +141,12 @@ class TraceImpurity(Rule):
                 cands = defs.get((arg.id, srcfile.scope_of(call)), ())
                 if len(cands) == 1:
                     traced.setdefault(cands[0], tag)
+        srcfile._traced_functions_memo = traced
         return traced
 
     def check(self, project):
         out = []
+        cg = project.callgraph()
         for f in project.files:
             if f.tree is None:
                 continue
@@ -139,6 +162,26 @@ class TraceImpurity(Rule):
                             f"@{tag} function '{fn.name}': evaluated "
                             "once at trace time and baked into the "
                             "compiled program"))
+                        continue
+                    # interprocedural: the impurity hides behind a helper
+                    fi = cg.info_for_node(fn)
+                    if fi is None:
+                        continue
+                    tgt = cg.resolve(f, fi.qualname, call)
+                    if tgt is None or tgt == fi.key:
+                        continue
+                    entry = cg.callee_summary(tgt, "impure")
+                    if entry is None:
+                        continue
+                    eff = entry[0]
+                    via = " -> ".join(cg.chain_names(tgt, "impure"))
+                    out.append(self.finding(
+                        f, call,
+                        f"call into trace-impure helper reaches "
+                        f"{eff.detail} (via {via}) inside @{tag} "
+                        f"function '{fn.name}': evaluated once at trace "
+                        "time and baked into the compiled program",
+                        chain=cg.chain(tgt, "impure")))
         return out
 
 
@@ -243,6 +286,76 @@ class HostSync(Rule):
                 msg = self._classify(f, call)
                 if msg:
                     out.append(self.finding(f, call, msg))
+        out.extend(self._interprocedural(project))
+        return out
+
+    def _interprocedural(self, project):
+        """Syncs hiding behind helper calls. Two propagation surfaces:
+
+        1. a hot-path function (``SCOPES``) calling a helper OUTSIDE the
+           hot-path scopes whose body (transitively) host-syncs — the sync
+           site itself is not directly flagged, so the call site is;
+        2. a traced (``to_static``/``defop``/``jit``) body calling a
+           syncing helper anywhere — a host read under the trace is a
+           concretization error at runtime; the lint catches it at review
+           time.
+
+        Suppressed or isinstance-guarded syncs never propagate (the
+        callgraph drops them at effect collection)."""
+        cg = project.callgraph()
+        out = []
+        seen = set()
+
+        def emit(f, call, tgt, context):
+            entry = cg.callee_summary(tgt, "hostsync")
+            if entry is None:
+                return
+            key = (f.relpath, call.lineno, call.col_offset, tgt)
+            if key in seen:
+                return
+            seen.add(key)
+            eff = entry[0]
+            via = " -> ".join(cg.chain_names(tgt, "hostsync"))
+            out.append(self.finding(
+                f, call,
+                f"call into host-syncing helper reaches {eff.detail} "
+                f"(via {via}) {context}",
+                chain=cg.chain(tgt, "hostsync")))
+
+        for fi in cg.functions.values():
+            if not fi.path.startswith(self.SCOPES):
+                continue
+            f = fi.srcfile
+            for (call, tgt, _disp) in fi.calls:
+                if tgt is None or tgt == fi.key:
+                    continue
+                if cg.functions[tgt].path.startswith(self.SCOPES):
+                    continue    # the sync site is directly flagged there
+                if self._classify(f, call) or self._guarded(f, call):
+                    continue
+                emit(f, call, tgt,
+                     "in a hot path; hoist the read out or keep the "
+                     "reduction on device")
+
+        from .callgraph import body_walk
+
+        for f in project.files:
+            if f.tree is None:
+                continue
+            for fn, tag in TraceImpurity._traced_functions(f).items():
+                fi = cg.info_for_node(fn)
+                if fi is None:
+                    continue
+                for call in body_walk(fn):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    tgt = cg.resolve(f, fi.qualname, call)
+                    if tgt is None or tgt == fi.key:
+                        continue
+                    emit(f, call, tgt,
+                         f"inside @{tag} function '{fn.name}': a host "
+                         "read under the trace is a concretization "
+                         "error; hoist it out of the compiled region")
         return out
 
     def _classify(self, srcfile, call):
@@ -438,7 +551,50 @@ class LockDiscipline(Rule):
                     msg = self._classify(call, lock)
                     if msg:
                         out.append(self.finding(f, call, msg))
+        # interprocedural: a helper called under the lock blocks/dispatches
+        cg = project.callgraph()
+        for fi in cg.functions.values():
+            f = fi.srcfile
+            for (lockkey, _w, _inner, calls) in fi.lock_regions:
+                lock = lockkey.split(":", 1)[-1]
+                for (call, tgt, disp) in calls:
+                    if tgt == fi.key:
+                        continue
+                    if self._classify(call, lock):
+                        continue    # directly flagged above
+                    entry = cg.callee_summary(tgt, "blocking")
+                    if entry is None:
+                        continue
+                    eff = entry[0]
+                    via = " -> ".join(cg.chain_names(tgt, "blocking"))
+                    out.append(self.finding(
+                        f, call,
+                        f"call into blocking helper reaches {eff.detail} "
+                        f"(via {via}) inside `with {lock}:` — every other "
+                        "thread touching the lock convoys behind it; move "
+                        "the call outside the critical section",
+                        chain=cg.chain(tgt, "blocking")))
         return out
+
+    @classmethod
+    def _blocking_attr_call(cls, call):
+        """True for ``.join()``/``.wait()``/``.acquire()``/``.result()``
+        shapes that actually block: zero args or a single numeric timeout.
+        ``os.path.join(a, b)`` / ``sep.join(parts)`` take value arguments
+        and are pure — the arity is the distinguisher."""
+        if not isinstance(call.func, ast.Attribute) \
+                or call.func.attr not in cls.BLOCKING_ATTRS \
+                or isinstance(call.func.value, ast.Constant):
+            return False
+        if call.keywords:
+            return True         # .wait(timeout=...) etc.
+        if len(call.args) == 0:
+            return True
+        if len(call.args) == 1:  # numeric literal = a timeout, not a value
+            a = call.args[0]
+            return isinstance(a, ast.Constant) \
+                and isinstance(a.value, (int, float))
+        return False
 
     def _classify(self, call, lock):
         if not isinstance(call, ast.Call):
@@ -451,9 +607,7 @@ class LockDiscipline(Rule):
         if name in self.BLOCKING_EXACT:
             return (f"{name}() sleeps while holding `{lock}` — every "
                     "other thread touching the lock convoys behind it")
-        if isinstance(call.func, ast.Attribute) \
-                and call.func.attr in self.BLOCKING_ATTRS \
-                and not isinstance(call.func.value, ast.Constant):
+        if self._blocking_attr_call(call):
             return (f".{call.func.attr}() blocks while holding `{lock}`; "
                     "wait outside the critical section")
         return None
@@ -700,7 +854,330 @@ class SpanNameContract(Rule):
         return out
 
 
+class LockOrder(Rule):
+    """GL007: lock-order inversion across the runtime stack.
+
+    The serving engine, watchdog scanner, dataloader producer and monitor
+    exporters run as concurrent threads sharing a handful of locks. A
+    deadlock needs no bug in any single function — only two call paths
+    acquiring the same two locks in opposite orders. The call graph makes
+    the acquisition order static: ``with lockA:`` whose body (transitively,
+    through helpers) acquires ``lockB`` is an A→B edge; any cycle in that
+    graph is a potential deadlock and every participating order must be
+    made consistent. The runtime twin is graftsan's lock-order witness
+    (``analysis/sanitizers.py``), which checks the ACTUAL acquisition
+    orders the process performs.
+    """
+
+    id = "GL007"
+    name = "lock-order-inversion"
+    rationale = ("two paths acquiring the same locks in opposite orders "
+                 "deadlock under the right interleaving; the acquisition "
+                 "graph must stay acyclic")
+
+    def check(self, project):
+        cg = project.callgraph()
+        edges = {}   # (a, b) -> (srcfile, node, via text, chain)
+        for fi in cg.functions.values():
+            for (lockkey, w, inner, calls) in fi.lock_regions:
+                if fi.srcfile.suppressed(self.id, w.lineno):
+                    continue
+                for (k, line) in inner:
+                    if k != lockkey:
+                        edges.setdefault((lockkey, k), (
+                            fi.srcfile, w,
+                            f"{fi.qualname} nests the acquisitions",
+                            (f"{fi.qualname} acquires "
+                             f"{_lk(lockkey)} at {fi.path}:{w.lineno} then "
+                             f"{_lk(k)} at {fi.path}:{line}",)))
+                for (call, tgt, disp) in calls:
+                    if tgt == fi.key:
+                        continue
+                    for k in cg.transitive_acquires(tgt):
+                        if k == lockkey:
+                            continue
+                        hops = cg.chain(tgt, "acquire:" + k)
+                        edges.setdefault((lockkey, k), (
+                            fi.srcfile, call,
+                            f"{fi.qualname} calls {disp}",
+                            (f"{fi.qualname} holds {_lk(lockkey)} and "
+                             f"calls {disp} at {fi.path}:{call.lineno}",)
+                            + tuple(hops)))
+        return self._cycle_findings(edges)
+
+    def _cycle_findings(self, edges):
+        adj = {}
+        for (a, b) in edges:
+            adj.setdefault(a, set()).add(b)
+        out = []
+        reported = set()
+        for (a, b) in sorted(edges):
+            if (b, a) not in edges:
+                continue
+            pair = tuple(sorted((a, b)))
+            if pair in reported:
+                continue
+            reported.add(pair)
+            f1, n1, via1, chain1 = edges[(pair[0], pair[1])]
+            f2, n2, via2, chain2 = edges[(pair[1], pair[0])]
+            out.append(Finding(
+                self.id, f1.relpath, getattr(n1, "lineno", 0),
+                getattr(n1, "col_offset", 0),
+                f"lock-order inversion: {_lk(pair[0])} -> {_lk(pair[1])} "
+                f"({via1}) but {_lk(pair[1])} -> {_lk(pair[0])} ({via2}) — "
+                "a deadlock under the right interleaving; pick one order "
+                "and make every path follow it",
+                scope=f1.scope_of(n1),
+                chain=tuple(chain1) + ("-- versus --",) + tuple(chain2)))
+        # longer cycles: walk each simple cycle not already covered by a
+        # pairwise inversion (rotation-canonical so each reports once)
+        for cyc in self._simple_cycles(adj):
+            if len(cyc) == 2:
+                continue
+            canon = tuple(sorted(cyc))
+            if canon in reported:
+                continue
+            reported.add(canon)
+            first = min(cyc)
+            i = cyc.index(first)
+            order = cyc[i:] + cyc[:i]
+            f1, n1, _via, _chain = edges[(order[0], order[1])]
+            chain = []
+            for x, y in zip(order, order[1:] + order[:1]):
+                chain.extend(edges[(x, y)][3])
+            out.append(Finding(
+                self.id, f1.relpath, getattr(n1, "lineno", 0),
+                getattr(n1, "col_offset", 0),
+                "lock-order cycle: "
+                + " -> ".join(_lk(k) for k in order + (order[0],))
+                + " — a deadlock under the right interleaving; break the "
+                "cycle by fixing one global acquisition order",
+                scope=f1.scope_of(n1), chain=tuple(chain)))
+        out.sort(key=lambda x: (x.path, x.line))
+        return out
+
+    @staticmethod
+    def _simple_cycles(adj):
+        """Bounded DFS enumeration of simple cycles (the lock graph is tiny
+        — a handful of nodes — so exhaustive search is fine)."""
+        cycles = []
+        seen = set()
+        nodes = sorted(adj)
+
+        def dfs(start, cur, path):
+            for nxt in sorted(adj.get(cur, ())):
+                if nxt == start and len(path) > 1:
+                    canon = tuple(sorted(path))
+                    if canon not in seen:
+                        seen.add(canon)
+                        cycles.append(tuple(path))
+                elif nxt not in path and nxt > start and len(path) < 8:
+                    dfs(start, nxt, path + [nxt])
+
+        for n in nodes:
+            dfs(n, n, [n])
+        return cycles
+
+
+def _lk(lockkey):
+    """Human form of a lock key (drop the file prefix when unambiguous)."""
+    return lockkey.split(":", 1)[-1]
+
+
+class RecompileHazard(Rule):
+    """GL008: recompile storms visible from the source.
+
+    Whole-program compilation makes compile count the hidden cost center
+    (arxiv 2301.13062): each new signature pays a trace + XLA compile that
+    dwarfs the step it serves. Three statically-visible hazard shapes, each
+    a bug class this tree has actually shipped (PR 2 found the first by
+    hand):
+
+    1. **per-call registration** — a ``@defop`` inside a function body
+       whose wrapper is called in that same body re-registers the op per
+       call: a fresh OpDef identity per call defeats the per-signature vjp
+       cache (every backward retraces) and churns the registry. Factories
+       that REGISTER inside a helper but return the wrapper uncalled are
+       fine (registration runs once at import).
+    2. **shape/dtype branching in a jitted body** — ``if x.shape[0] > n:``
+       inside a ``to_static``/``jax.jit`` body compiles one program per
+       outcome; with unbucketed shapes that is one compile per distinct
+       shape (the recompile storm the serving engine's prefill buckets
+       exist to prevent). ``defop`` bodies are exempt: eager ops are
+       per-signature cached by design and shape normalization there is the
+       norm.
+    3. **per-call-constructed static args** — passing a ``lambda`` (or a
+       function defined in the calling function's body) to a compiled
+       callable keys the program cache on the object's ``repr`` — a fresh
+       address every call, so every call is a cache miss that compiles.
+
+    The runtime twin is graftsan's recompile sentinel
+    (``analysis/sanitizers.py``), which counts actual cache misses and
+    trips past a threshold.
+    """
+
+    id = "GL008"
+    name = "recompile-hazard"
+    rationale = ("every avoidable signature is a trace+compile that dwarfs "
+                 "the step it serves; registration, branching and cache "
+                 "keys must be compile-stable")
+
+    SHAPE_ATTRS = {"shape", "ndim", "dtype"}
+
+    def check(self, project):
+        out = []
+        for f in project.files:
+            if f.tree is None:
+                continue
+            out.extend(self._per_call_registration(f))
+            out.extend(self._shape_branching(f))
+            out.extend(self._weak_static_args(f))
+        return out
+
+    # -- pattern 1: per-call registration ------------------------------------
+    def _per_call_registration(self, f):
+        out = []
+        for node in ast.walk(f.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not any(self._is_reg_decorator(d) for d in node.decorator_list):
+                continue
+            owner = self._enclosing_function(f, node)
+            if owner is None:
+                continue    # module/class level: registered once at import
+            from .callgraph import body_walk
+
+            called = any(
+                isinstance(c, ast.Call) and isinstance(c.func, ast.Name)
+                and c.func.id == node.name
+                for c in body_walk(owner))
+            if called:
+                out.append(self.finding(
+                    f, node,
+                    f"op '{node.name}' is @defop-registered inside "
+                    f"'{owner.name}' and called there: re-registered on "
+                    "EVERY call — a fresh OpDef identity defeats the "
+                    "per-signature vjp cache (each backward retraces) and "
+                    "churns the registry; hoist the registration to module "
+                    "level"))
+        return out
+
+    @staticmethod
+    def _is_reg_decorator(dec):
+        if isinstance(dec, ast.Call):
+            dec = dec.func
+        name = dotted_name(dec)
+        return name is not None and (
+            name.rsplit(".", 1)[-1].endswith("defop")
+            or name.rsplit(".", 1)[-1] == "register_op")
+
+    @staticmethod
+    def _enclosing_function(f, node):
+        for anc in f.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    # -- pattern 2: shape/dtype branching in jitted bodies -------------------
+    def _shape_branching(self, f):
+        from .callgraph import body_walk
+
+        out = []
+        for fn, tag in TraceImpurity._traced_functions(f).items():
+            if tag == "defop":
+                continue    # eager ops are per-signature cached by design
+            params = {a.arg for a in fn.args.args + fn.args.kwonlyargs}
+            params.discard("self")
+            for node in body_walk(fn):
+                if not isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                    continue
+                hit = self._shape_test(node.test, params)
+                if hit:
+                    out.append(self.finding(
+                        f, node,
+                        f"branch on {hit} inside @{tag} function "
+                        f"'{fn.name}': one compiled program per outcome — "
+                        "with unbucketed inputs, one compile per distinct "
+                        "shape (recompile storm); pad/bucket the input or "
+                        "use a device-side select"))
+        return out
+
+    def _shape_test(self, test, params):
+        for n in ast.walk(test):
+            if isinstance(n, ast.Attribute) and n.attr in self.SHAPE_ATTRS \
+                    and isinstance(n.value, ast.Name) \
+                    and n.value.id in params:
+                return f"{n.value.id}.{n.attr}"
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                    and n.func.id == "len" and len(n.args) == 1 \
+                    and isinstance(n.args[0], ast.Name) \
+                    and n.args[0].id in params:
+                return f"len({n.args[0].id})"
+        return None
+
+    # -- pattern 3: per-call-constructed static args -------------------------
+    def _weak_static_args(self, f):
+        out = []
+        compiled = self._compiled_names(f)
+        if not compiled:
+            return out
+        local_defs = {}
+        for n in ast.walk(f.tree):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                local_defs.setdefault(n.name, []).append(f.scope_of(n))
+        for call in ast.walk(f.tree):
+            if not isinstance(call, ast.Call) \
+                    or not isinstance(call.func, ast.Name) \
+                    or call.func.id not in compiled:
+                continue
+            scope = f.scope_of(call)
+            for arg in list(call.args) + [k.value for k in call.keywords]:
+                if isinstance(arg, ast.Lambda):
+                    out.append(self.finding(
+                        f, call,
+                        f"lambda argument to compiled callable "
+                        f"'{call.func.id}': the program cache keys "
+                        "non-hashable constants by repr — a fresh object "
+                        "address every call, so EVERY call is a compile; "
+                        "hoist the function to module level"))
+                elif isinstance(arg, ast.Name) and scope:
+                    # a def in the calling function (or an enclosing one):
+                    # fresh function object per outer call
+                    nested = [s for s in local_defs.get(arg.id, ())
+                              if s and (scope == s
+                                        or scope.startswith(s + "."))]
+                    if nested:
+                        out.append(self.finding(
+                            f, call,
+                            f"locally-defined function '{arg.id}' passed "
+                            f"to compiled callable '{call.func.id}': a "
+                            "fresh function object per enclosing call "
+                            "keys a new signature each time (recompile "
+                            "storm); hoist it to module level"))
+        return out
+
+    @staticmethod
+    def _compiled_names(f):
+        """Local names statically known to be compiled callables: defs
+        decorated @to_static/@jax.jit (not @defop), and assignment targets
+        of ``to_static(...)`` / ``jax.jit(...)`` results."""
+        names = set()
+        for n in ast.walk(f.tree):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                tags = [t for t in map(_decorator_tag, n.decorator_list) if t]
+                if tags and tags[0] in ("to_static", "jit"):
+                    names.add(n.name)
+            elif isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+                tag = _decorator_tag(n.value)
+                if tag in ("to_static", "jit"):
+                    for tgt in n.targets:
+                        if isinstance(tgt, ast.Name):
+                            names.add(tgt.id)
+        return names
+
+
 ALL_RULES = (TraceImpurity(), HostSync(), RegistryConsistency(),
-             LockDiscipline(), MetricNameContract(), SpanNameContract())
+             LockDiscipline(), MetricNameContract(), SpanNameContract(),
+             LockOrder(), RecompileHazard())
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}

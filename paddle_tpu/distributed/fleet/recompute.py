@@ -104,7 +104,7 @@ def recompute(function, *args, **kwargs):
     outs = apply_raw("recompute", ckpt, [key_t] + state_tensors + t_leaves)
     out_vals = []
     for i, flag in enumerate(out_box["is_tensor"]):
-        out_vals.append(outs[i] if flag else outs[i].numpy())
+        out_vals.append(outs[i] if flag else outs[i].numpy())  # graftlint: disable=GL002 — non-Tensor out leaves only (aux scalars); one small read restores their host type at segment exit
     return jax.tree_util.tree_unflatten(out_box["tree"], out_vals)
 
 

@@ -24,6 +24,8 @@ import os
 import threading
 import time
 
+from ..analysis.sanitizers import new_lock as _new_lock
+
 
 class WatchdogTimeout(RuntimeError):
     pass
@@ -45,7 +47,10 @@ class CommWatchdog:
     def __init__(self, timeout=1800.0, on_timeout=None, max_history=10000):
         self.timeout = timeout
         self.on_timeout = on_timeout
-        self._lock = threading.Lock()
+        # graftsan known-lock site: the watchdog's lock is held by user
+        # threads (watch enter/exit) AND the scanner — exactly the kind of
+        # cross-thread lock the order witness exists for
+        self._lock = _new_lock("distributed.watchdog.CommWatchdog")
         self._inflight = {}                         # id -> (desc, start)
         self._ids = itertools.count()
         self.events = collections.deque(maxlen=max_history)  # (desc, start, end)

@@ -15,6 +15,7 @@ from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
+from ..analysis import sanitizers as _san
 from ..framework.core import Tensor
 from .dataset import IterableDataset
 from .sampler import BatchSampler
@@ -305,6 +306,11 @@ class DataLoader:
                 if bm is not None:
                     bm.before_reader()
                 t0 = mon[3]() if (mon[0].on or mon[4].on) else 0
+                if _san._state.lock:
+                    # dynamic GL004: a consumer blocking on the staging
+                    # queue while holding any sanitized lock would convoy
+                    # (or deadlock against) the producer thread
+                    _san.check_wait("io.dataloader.queue_get")
                 item = q.get()
                 if item is sentinel:
                     break

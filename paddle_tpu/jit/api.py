@@ -47,6 +47,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..analysis import sanitizers as _sanitizers
 from ..autograd import tape
 from ..framework import random as rng
 from ..framework.core import Tensor
@@ -303,6 +304,16 @@ class StaticFunction:
     def _run_keyed(self, key, treedef, leaves, t_idx, t_leaves,
                    tvals, state_tensors):
         if key not in self._cache:
+            san = _sanitizers
+            if san._state.recompile:
+                # graftsan recompile sentinel: every signature miss is one
+                # trace+compile; past the threshold it raises with the
+                # recent signature history (shape-varying loop, unhashable
+                # static args — the GL008 bug class, caught at runtime)
+                san.note_compile(
+                    "to_static." + getattr(self._function, "__name__",
+                                           "fn"),
+                    signature=key[1])
             self._cache[key] = self._build(treedef, leaves, t_idx, state_tensors)
         jitted, out_box = self._cache[key]
 

@@ -48,6 +48,7 @@ import numpy as np
 
 import jax
 
+from ..analysis import sanitizers as _sanitizers
 from ..autograd import tape
 from ..framework import capture as _capture
 from ..framework import core as _core
@@ -66,6 +67,8 @@ def _trace():
 
         _TRACE = (_m.trace, _m.now_ns)
     return _TRACE
+
+
 
 
 def _is_tensor(x):
@@ -246,6 +249,15 @@ class SegmentedFunction:
 
     # -- capture -------------------------------------------------------------
     def _capture_variant(self, args, kwargs):
+        san = _sanitizers
+        if san._state.recompile:
+            # a drifting guard (raw float read whose value changes every
+            # step) re-captures per call until MAX_VARIANTS — exactly a
+            # recompile storm; the sentinel trips it before the eager flip
+            # hides the cost
+            san.note_compile(
+                "sot." + getattr(self._function, "__name__", "fn"),
+                signature=f"variant#{len(self._variants)}")
         rec = _Recorder()
         arg_leaves, _ = jax.tree_util.tree_flatten((args, kwargs),
                                                    is_leaf=_is_tensor)

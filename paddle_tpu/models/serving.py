@@ -34,9 +34,14 @@ import jax
 import jax.numpy as jnp
 
 from . import paged_kv as _pk
+from ..analysis import sanitizers as _sanitizers
 from .llama_decode import LlamaDecodeEngine, _rms
 
 __all__ = ["ContinuousBatchingEngine"]
+
+import itertools
+
+_ENGINE_SEQ = itertools.count()
 
 
 class _Mon:
@@ -112,6 +117,10 @@ class ContinuousBatchingEngine:
         self.outputs = [[] for _ in range(self.max_batch)]
         self._next_rid = 0
         self._jit_cache = {}
+        # graftsan label qualifier: compile budgets are PER ENGINE (each
+        # instance's prefill compiles are bucket-bounded); a process-wide
+        # label would falsely trip the sentinel on the second engine
+        self._san_tag = f"e{next(_ENGINE_SEQ)}"
         # submit() queue: requests waiting for a free slot (host-side)
         self._pending = collections.deque()
         # per-request trace trees (monitor.trace): rid -> [root, queue_wait]
@@ -141,6 +150,14 @@ class ContinuousBatchingEngine:
             else:
                 mon.jit_compiles.labels("serving.prefill").inc()
         if key not in cache:
+            san = _sanitizers
+            if san._state.recompile:
+                # graftsan: prefill compiles are bounded by the bucket list
+                # BY DESIGN; an unbounded stream of new buckets here is the
+                # recompile storm the buckets exist to prevent
+                san.note_compile(f"serving.prefill[{self._san_tag}]",
+                                 signature=key)
+
             def run(ids, pools, row_tables, length):
                 # ids: (1, bucket) padded prompt; only `length` rows are
                 # real — causal masking keeps padding out of real rows'
@@ -176,6 +193,11 @@ class ContinuousBatchingEngine:
                 mon.jit_compiles.labels("serving.decode_step").inc()
                 mon.jit_sigs.labels("serving.decode_step").set(1)
         if "step" not in cache:
+            san = _sanitizers
+            if san._state.recompile:
+                san.note_compile(f"serving.decode_step[{self._san_tag}]",
+                                 signature="step")
+
             def run(tokens, pools, tables, lens):
                 # tokens (B, 1); lens (B,) per-row positions — ragged:
                 # _block_paged_decode ropes/writes/attends at lens[b]
@@ -359,6 +381,16 @@ class ContinuousBatchingEngine:
         """One decode step for EVERY active slot. Queued submit() requests
         are admitted into free slots first. Returns the list of finished
         (request_id, tokens) pairs evicted this step."""
+        san = _sanitizers
+        if san._state.hostsync:
+            # graftsan: the decode loop is device-resident by contract
+            # (GL002) — a Tensor host sync inside it is a regression the
+            # tripwire turns into an immediate raise
+            with san.protected_region("serving.step"):
+                return self._step_impl(eos_token_id, max_new_tokens)
+        return self._step_impl(eos_token_id, max_new_tokens)
+
+    def _step_impl(self, eos_token_id, max_new_tokens):
         mon = _mon()
         self._drain_pending()
         if not self.active.any():

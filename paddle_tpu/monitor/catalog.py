@@ -111,6 +111,11 @@ METRICS = {
     "paddle_tpu_monitor_samples_total": (
         "counter", (),
         "Timeline samples recorded for chrome-trace counter export."),
+    "paddle_tpu_monitor_sanitizer_trips_total": (
+        "counter", ("sanitizer",),
+        "graftsan sanitizer trips (lock-order inversion, recompile storm, "
+        "host-sync-in-span), labeled by sanitizer; each trip also raises "
+        "and flight-dumps (docs/sanitizers.md)."),
 }
 
 
@@ -183,6 +188,12 @@ SPANS = {
         "Blocking collective/host wait watched by CommWatchdog — open "
         "comm.wait spans in a flight dump are the hang candidates. "
         "attrs: desc."),
+    # -- graftsan (analysis/sanitizers.py) -------------------------------
+    "monitor.sanitizer_trip": (
+        "One graftsan trip (lock-order inversion / recompile storm / "
+        "host-sync-in-span), recorded at raise time so the flight dump "
+        "shows WHERE in the request/step timeline the hazard fired. "
+        "attrs: sanitizer."),
 }
 
 

@@ -20,8 +20,9 @@ from __future__ import annotations
 
 import bisect
 import re
-import threading
 import time
+
+from ..analysis.sanitizers import new_lock as _new_lock
 
 __all__ = ["Counter", "Gauge", "Histogram", "Registry", "now_ns",
            "DEFAULT_NS_BUCKETS", "DEFAULT_SECONDS_BUCKETS"]
@@ -69,7 +70,9 @@ class _Metric:
         self.name = name
         self.help = help
         self.labelnames = tuple(labelnames)
-        self._lock = threading.Lock()
+        # graftsan known-lock site: sanitized only when the lock sanitizer
+        # is enabled at construction, a plain threading.Lock otherwise
+        self._lock = _new_lock(f"monitor.registry.{type(self).__name__}")
         self._children = {}
         self._init_series()
 
@@ -284,7 +287,7 @@ class Registry:
     different type or label set is an error (names are a contract)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = _new_lock("monitor.registry.Registry")
         self._metrics = {}
 
     def _get_or_create(self, cls, name, help, labelnames, **kw):

@@ -43,7 +43,7 @@ from .registry import now_ns
 __all__ = [
     "Span", "enable", "disable", "enabled", "reset",
     "new_trace_id", "span", "start_span", "end_span", "record_span",
-    "current_span", "spans", "open_spans", "drop",
+    "current_span", "thread_span_stack", "spans", "open_spans", "drop",
     "chrome_span_events", "span_dump", "flight_dump",
     "training_step", "set_dispatch_sampling", "dispatch_sample_every",
 ]
@@ -186,6 +186,14 @@ def current_span():
     """The innermost span() open on THIS thread, or None."""
     st = getattr(_tls, "stack", None)
     return st[-1] if st else None
+
+
+def thread_span_stack():
+    """The implicit span() context stack of THIS thread, outermost first
+    (graftsan's host-sync tripwire scans it for protected train/serving
+    regions)."""
+    st = getattr(_tls, "stack", None)
+    return tuple(st) if st else ()
 
 
 def _commit(sp, t1_ns=None):

@@ -210,13 +210,19 @@ def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
     k = min(m, n)
     L = jnp.tril(lu_mat[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_mat.dtype)
     U = jnp.triu(lu_mat[..., :k, :])
-    piv = np.asarray(y.numpy()) - 1
-    P = np.eye(m)
-    perm = np.arange(m)
-    for i, p in enumerate(piv):
-        perm[[i, p]] = perm[[p, i]]
-    P = P[:, perm]
-    return Tensor(jnp.asarray(P, lu_mat.dtype)), Tensor(L), Tensor(U)
+    # pivot swaps -> permutation entirely on device: the sequential swap
+    # loop is a fori_loop over the device pivot vector, so no pivot value
+    # ever crosses to host (this used to be a grandfathered GL002 sync)
+    piv = y.value.astype(jnp.int32) - 1
+
+    def _swap(i, perm):
+        p = piv[i]
+        pi, pp = perm[i], perm[p]
+        return perm.at[i].set(pp).at[p].set(pi)
+
+    perm = jax.lax.fori_loop(0, piv.shape[-1], _swap, jnp.arange(m))
+    P = jnp.eye(m, dtype=lu_mat.dtype)[:, perm]
+    return Tensor(P), Tensor(L), Tensor(U)
 
 
 @defop("det")
@@ -322,10 +328,12 @@ def _bincount(x, weights=None, minlength=0):
 
 
 def bincount(x, weights=None, minlength=0, name=None):
-    # dynamic output length: eager-only
+    # dynamic output length: eager-only (was a grandfathered GL002 entry;
+    # the suppression below replaced the baseline debt with an explicit
+    # rationale at the sync site)
     from .manipulation import _require_concrete
 
     _require_concrete(x, "bincount")
-    length = max(int(np.asarray(x.numpy()).max(initial=-1)) + 1, minlength)
+    length = max(int(x.numpy().max(initial=-1)) + 1, minlength)  # graftlint: disable=GL002 — the output SHAPE is the data's max: an inherent one-int host read, eager-only by contract (_require_concrete)
     return Tensor(jnp.bincount(x.value, weights=None if weights is None else weights.value,
                                minlength=minlength, length=length))

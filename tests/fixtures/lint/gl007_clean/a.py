@@ -1,0 +1,26 @@
+"""GL007 clean sample: every path acquires the same locks in ONE global
+order (FRONT_LOCK before BACK_LOCK, A_LOCK before B_LOCK) — the graph is acyclic."""
+import threading
+
+import b
+
+FRONT_LOCK = threading.Lock()
+BACK_LOCK = threading.Lock()
+A_LOCK = threading.Lock()
+
+
+def one(sink):
+    with FRONT_LOCK:
+        with BACK_LOCK:
+            sink.push(1)
+
+
+def two(sink):
+    with FRONT_LOCK:
+        with BACK_LOCK:
+            sink.push(2)
+
+
+def step(sink):
+    with A_LOCK:
+        b.flush(sink)       # A_LOCK -> B_LOCK, the only direction anywhere

@@ -1,0 +1,15 @@
+"""GL007 clean sample, file 2: B_LOCK is only ever acquired after A_LOCK
+(via a.step) or alone — no reverse edge exists."""
+import threading
+
+B_LOCK = threading.Lock()
+
+
+def flush(sink):
+    with B_LOCK:
+        sink.push(4)
+
+
+def drain(sink):
+    with B_LOCK:
+        sink.push(5)

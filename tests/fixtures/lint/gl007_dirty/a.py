@@ -1,0 +1,32 @@
+"""GL007 dirty sample, file 1: inconsistent pairwise order inside one
+file, plus one half of a cross-file inversion that only the call graph can
+see (the other half lives in b.py)."""
+import threading
+
+import b
+
+FRONT_LOCK = threading.Lock()
+BACK_LOCK = threading.Lock()
+A_LOCK = threading.Lock()
+
+
+def one(sink):
+    with FRONT_LOCK:
+        with BACK_LOCK:            # order FRONT_LOCK -> BACK_LOCK
+            sink.push(1)
+
+
+def two(sink):
+    with BACK_LOCK:
+        with FRONT_LOCK:            # order BACK_LOCK -> FRONT_LOCK: pairwise inversion
+            sink.push(2)
+
+
+def step(sink):
+    with A_LOCK:
+        b.flush(sink)       # flush acquires B_LOCK: edge A_LOCK -> B_LOCK
+
+
+def helper(sink):
+    with A_LOCK:            # acquired by b.drain while B_LOCK is held
+        sink.push(3)

@@ -1,0 +1,19 @@
+"""GL007 dirty sample, file 2: the reverse half of the cross-file
+lock-order inversion — drain() holds B_LOCK and calls back into a.helper,
+which acquires A_LOCK; a.step holds A_LOCK and calls flush, which acquires
+B_LOCK."""
+import threading
+
+import a
+
+B_LOCK = threading.Lock()
+
+
+def flush(sink):
+    with B_LOCK:
+        sink.push(4)
+
+
+def drain(sink):
+    with B_LOCK:
+        a.helper(sink)      # helper acquires A_LOCK: edge B_LOCK -> A_LOCK

@@ -1,0 +1,29 @@
+"""GL008 clean sample: factories, bucketed prefill, stable cache keys."""
+import jax
+
+from paddle_tpu.ops._apply import defop
+
+BUCKETS = (32, 64, 128)
+
+
+def make_cell(name):
+    @defop(name)
+    def _cell(v):
+        return v
+
+    return _cell
+
+
+lstm_cell = make_cell("fixture_lstm_cell")
+
+
+def bucket_for(length):
+    for b in BUCKETS:
+        if length <= b:
+            return b
+    return BUCKETS[-1]
+
+
+@jax.jit
+def decode(tokens, lens):
+    return tokens + lens

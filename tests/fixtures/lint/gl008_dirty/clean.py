@@ -1,0 +1,38 @@
+"""GL008 dirty-tree control: the same shapes done right (must stay
+silent)."""
+import jax
+
+from paddle_tpu.jit import to_static
+from paddle_tpu.ops._apply import defop
+
+
+def make_op(name, factor):
+    # factory: registers inside a function but returns the wrapper UNCALLED
+    # — registration runs once, at import, where the factory is invoked
+    @defop(name)
+    def _op(v):
+        return v * factor
+
+    return _op
+
+
+scale_good = make_op("scale_good", 2)
+
+
+@jax.jit
+def stable(x, training):
+    # branching on a PYTHON argument is part of the signature by design
+    if training:
+        return x * 2
+    return x
+
+
+def _module_key(v):
+    return v + 1
+
+
+compiled = to_static(lambda v, fn: fn(v))
+
+
+def run_stable(x):
+    return compiled(x, _module_key)       # stable identity: one signature

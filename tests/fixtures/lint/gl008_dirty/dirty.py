@@ -1,0 +1,44 @@
+"""GL008 dirty sample: the three recompile-hazard shapes."""
+import jax
+
+from paddle_tpu.jit import to_static
+from paddle_tpu.ops._apply import defop
+
+
+def scale_api(x):
+    # per-call registration AND per-call use: a fresh OpDef identity on
+    # every call defeats the per-signature vjp cache
+    @defop("scale_bad")
+    def _op(v):
+        return v * 2
+
+    return _op(x)
+
+
+@jax.jit
+def branchy(x, bias):
+    # one compiled program per branch outcome = one per distinct shape
+    if x.shape[0] > 4:
+        return x * 2
+    if x.dtype == "float32":
+        return x + bias
+    return x
+
+
+@to_static
+def padded(x):
+    while len(x) > 8:
+        x = x[:-1]
+    return x
+
+
+compiled = to_static(lambda v, fn: fn(v))
+
+
+def run_per_call(x):
+    y = compiled(x, lambda v: v + 1)      # repr-keyed lambda: miss per call
+
+    def local_fn(v):
+        return v * 3
+
+    return compiled(y, local_fn)          # fresh function object per call

@@ -1,0 +1,17 @@
+"""Interprocedural clean sample: the same call shapes over pure helpers."""
+
+
+def stamp():
+    return 1.0
+
+
+def deep_stamp():
+    return stamp()
+
+
+def read_scalar(t):
+    return t.shape[0]
+
+
+def flush(worker):
+    worker.enqueue(None)

@@ -1,0 +1,11 @@
+"""Interprocedural clean sample: only non-blocking work under the lock."""
+import threading
+
+import helpers
+
+GUARD_LOCK = threading.Lock()
+
+
+def drain(worker):
+    with GUARD_LOCK:
+        helpers.flush(worker)

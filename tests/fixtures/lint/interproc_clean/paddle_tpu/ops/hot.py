@@ -1,0 +1,6 @@
+"""Interprocedural clean sample: hot path over a metadata-only helper."""
+import helpers
+
+
+def hot_read(x):
+    return helpers.read_scalar(x)

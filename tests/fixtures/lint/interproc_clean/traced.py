@@ -1,0 +1,9 @@
+"""Interprocedural clean sample: traced body over pure helpers."""
+import helpers
+
+from paddle_tpu.jit import to_static
+
+
+@to_static
+def fwd(x):
+    return x * helpers.deep_stamp()

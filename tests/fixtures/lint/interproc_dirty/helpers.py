@@ -1,0 +1,21 @@
+"""Interprocedural dirty sample: hazards hidden inside helpers. Nothing
+in THIS file is flagged directly — helpers.py is outside the GL002 hot
+paths and contains no traced body or lock — but every caller that reaches
+these through the call graph is."""
+import time
+
+
+def stamp():
+    return time.time()
+
+
+def deep_stamp():
+    return stamp()          # two-hop propagation
+
+
+def read_scalar(t):
+    return t.numpy()
+
+
+def flush(worker):
+    worker.join()

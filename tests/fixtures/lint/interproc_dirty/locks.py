@@ -1,0 +1,12 @@
+"""Interprocedural dirty sample: a blocking helper called under a lock —
+GL004 fires at the call site."""
+import threading
+
+import helpers
+
+GUARD_LOCK = threading.Lock()
+
+
+def drain(worker):
+    with GUARD_LOCK:
+        helpers.flush(worker)

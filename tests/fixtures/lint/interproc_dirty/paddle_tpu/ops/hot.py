@@ -1,0 +1,7 @@
+"""Interprocedural dirty sample: a hot-path function calling an
+out-of-scope helper that host-syncs — GL002 fires at the call site."""
+import helpers
+
+
+def hot_read(x):
+    return helpers.read_scalar(x)

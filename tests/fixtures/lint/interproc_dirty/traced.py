@@ -1,0 +1,10 @@
+"""Interprocedural dirty sample: a traced body calling an impure helper —
+GL001 fires at the call site with the propagation chain."""
+import helpers
+
+from paddle_tpu.jit import to_static
+
+
+@to_static
+def fwd(x):
+    return x * helpers.deep_stamp()

@@ -213,12 +213,23 @@ class TestDisabledMode:
         """Tier-1 overhead budget: with the monitor disabled the
         instrumented dispatch path must stay inside the SAME 40us forward
         budget tests/test_dispatch_perf.py enforces — the telemetry layer
-        may not tax the eager hot path when off."""
+        may not tax the eager hot path when off.
+
+        Retry-on-load pattern (PR 4): run standalone on a loaded 1-core
+        box, one min-of-7 floor can still eat a scheduler storm and
+        false-alarm; a real overhead regression raises the floor itself
+        and fails EVERY attempt, so up to three attempts keep the budget
+        meaningful without the flake."""
         y = paddle.to_tensor(np.random.randn(4, 4).astype("float32"))
         xg = paddle.to_tensor(np.random.randn(4, 4).astype("float32"),
                               stop_gradient=False)
-        us = _floor_us(lambda: xg + y)
-        assert us < 40, f"monitor-off dispatch {us:.0f}us exceeds 40us budget"
+        us = None
+        for _attempt in range(3):
+            us = _floor_us(lambda: xg + y)
+            if us < 40:
+                return
+        assert us < 40, \
+            f"monitor-off dispatch {us:.0f}us exceeds 40us budget (3 tries)"
 
 
 # --------------------------------------------------------------------------- #

@@ -1,0 +1,444 @@
+"""graftsan (paddle_tpu/analysis/sanitizers.py): the runtime sanitizers.
+
+The dynamic half of the PR-4 analysis work, mirroring the static rules:
+
+- lock-order witness (GL007's twin): a deliberately-inverted reproducer
+  raises LockOrderInversion — BEFORE blocking — with both first-witness
+  acquisition stacks in the message; check_wait() is the dynamic GL004;
+- recompile sentinel (GL008's twin): a shape-varying to_static loop and a
+  drifting SOT guard each trip RecompileStorm past the threshold, while a
+  stable loop stays silent at one compile;
+- host-sync tripwire: a Tensor concretization inside an active
+  train/serving span (or explicit protected_region) raises
+  HostSyncInProtectedRegion; outside, and under allow_host_sync(), it
+  does not;
+- trips export: metric bump + monitor.sanitizer_trip span + flight dump;
+- disabled mode: nothing installed, the concretize hook slot stays bare,
+  and the instrumented dispatch path holds the same 40us forward budget
+  as the monitor/trace layers (retry-on-load pattern, see
+  tests/test_monitor.py).
+"""
+import glob
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor
+from paddle_tpu.analysis import sanitizers as san
+from paddle_tpu.monitor import trace
+
+
+@pytest.fixture(autouse=True)
+def _clean_sanitizers():
+    """Every test starts with sanitizers off and witness state empty, and
+    cannot leak enabled-mode hooks into the rest of the suite."""
+    san.disable()
+    san.reset()
+    san.set_recompile_threshold(8)
+    monitor.disable()
+    monitor.reset()
+    yield
+    san.disable()
+    san.reset()
+    san.set_recompile_threshold(8)
+    monitor.disable()
+    monitor.reset()
+
+
+# --------------------------------------------------------------------------- #
+# enable / env plumbing
+# --------------------------------------------------------------------------- #
+
+class TestEnablePlumbing:
+    def test_default_off(self):
+        assert not san.enabled()
+        for k in ("lock", "recompile", "hostsync"):
+            assert not san.enabled(k)
+
+    def test_enable_subset(self):
+        san.enable("recompile")
+        assert san.enabled() and san.enabled("recompile")
+        assert not san.enabled("lock") and not san.enabled("hostsync")
+        san.disable("recompile")
+        assert not san.enabled()
+
+    def test_install_from_env_list_and_all(self):
+        assert san.install_from_env(env="lock,recompile") == (
+            "lock", "recompile")
+        assert san.enabled("lock") and san.enabled("recompile")
+        san.disable()
+        assert san.install_from_env(env="all") == ("lock", "recompile",
+                                                   "hostsync")
+        san.disable()
+        assert san.install_from_env(env="") == ()
+        assert not san.enabled()
+
+    def test_install_from_env_unknown_warns(self):
+        with pytest.warns(UserWarning, match="unknown sanitizer"):
+            kinds = san.install_from_env(env="lock,bogus")
+        assert kinds == ("lock",)
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown sanitizer"):
+            san.enable("turbo")
+        with pytest.raises(ValueError, match="unknown sanitizer"):
+            san.enabled("turbo")
+
+
+# --------------------------------------------------------------------------- #
+# lock-order witness
+# --------------------------------------------------------------------------- #
+
+class TestLockOrderWitness:
+    def test_inversion_raises_with_both_stacks_named(self):
+        san.enable("lock")
+        a = san.new_lock("engine_lock")
+        b = san.new_lock("pager_lock")
+        with a:
+            with b:
+                pass                     # witness engine -> pager
+        with pytest.raises(san.LockOrderInversion) as ei:
+            with b:
+                with a:                  # pager -> engine: inversion
+                    pass
+        msg = str(ei.value)
+        assert "engine_lock" in msg and "pager_lock" in msg
+        assert "first witness" in msg and "this acquisition" in msg
+        # both acquisition stacks name this test function
+        assert msg.count("test_inversion_raises_with_both_stacks_named") >= 2
+        assert ("lock", msg) in [(k, m) for k, m in san.trips()]
+
+    def test_consistent_order_stays_silent(self):
+        san.enable("lock")
+        a = san.new_lock("outer_lock")
+        b = san.new_lock("inner_lock")
+        for _ in range(50):
+            with a:
+                with b:
+                    pass
+        assert ("outer_lock", "inner_lock") in san.lock_order_edges()
+        assert san.trips() == []
+
+    def test_raises_instead_of_deadlocking(self):
+        """The witness checks BEFORE blocking: with the reverse edge known
+        and another thread actually holding the wanted lock, the acquire
+        raises immediately rather than deadlocking."""
+        san.enable("lock")
+        a = san.new_lock("held_lock")
+        b = san.new_lock("wanted_lock")
+        with a:
+            with b:
+                pass                     # witness held -> wanted
+        holding = threading.Event()
+        release = threading.Event()
+
+        def hog():
+            with a:
+                holding.set()
+                release.wait(5)
+
+        t = threading.Thread(target=hog, daemon=True)
+        t.start()
+        assert holding.wait(5)
+        t0 = time.monotonic()
+        try:
+            with pytest.raises(san.LockOrderInversion):
+                with b:
+                    a.acquire()          # would deadlock without graftsan
+        finally:
+            release.set()
+            t.join(5)
+        assert time.monotonic() - t0 < 2.0
+
+    def test_check_wait_trips_under_lock_only(self):
+        san.enable("lock")
+        lk = san.new_lock("consumer_lock")
+        san.check_wait("io.dataloader.queue_get")   # no lock held: fine
+        with pytest.raises(san.BlockingWaitUnderLock, match="queue_get"):
+            with lk:
+                san.check_wait("io.dataloader.queue_get")
+
+    def test_new_lock_is_plain_when_off(self):
+        lk = san.new_lock("anything")
+        assert not isinstance(lk, san.SanitizedLock)
+        san.enable("lock")
+        lk2 = san.new_lock("anything")
+        assert isinstance(lk2, san.SanitizedLock)
+
+    def test_sanitized_lock_semantics(self):
+        san.enable("lock")
+        lk = san.new_lock("sem_lock")
+        assert lk.acquire()
+        assert lk.locked()
+        lk.release()
+        assert not lk.locked()
+        assert lk.acquire(False)
+        lk.release()
+
+
+# --------------------------------------------------------------------------- #
+# recompile sentinel
+# --------------------------------------------------------------------------- #
+
+class TestRecompileSentinel:
+    def test_shape_varying_loop_trips(self):
+        san.enable("recompile")
+        san.set_recompile_threshold(4)
+
+        @paddle.jit.to_static
+        def f(x):
+            return x * 2
+
+        with pytest.raises(san.RecompileStorm) as ei:
+            for n in range(2, 12):
+                f(paddle.to_tensor(np.ones(n, "float32")))
+        msg = str(ei.value)
+        assert "to_static.f" in msg
+        assert "compiled 5 times" in msg
+        assert "Recent signatures" in msg
+
+    def test_stable_loop_stays_silent(self):
+        san.enable("recompile")
+        san.set_recompile_threshold(4)
+
+        @paddle.jit.to_static
+        def g(x):
+            return x + 1
+
+        for _ in range(30):
+            g(paddle.to_tensor(np.ones(4, "float32")))
+        assert san.compile_counts().get("to_static.g") == 1
+        assert san.trips() == []
+
+    def test_drifting_sot_guard_trips(self):
+        """A raw float() read whose value drifts re-captures a SOT variant
+        per call — the recompile storm MAX_VARIANTS would eventually hide;
+        the sentinel trips it first."""
+        san.enable("recompile")
+        san.set_recompile_threshold(3)
+
+        @paddle.jit.to_static(full_graph=False)
+        def h(x):
+            if float(x.sum()) > 100.0:   # drifting guard value
+                return x * 2
+            return x - 1
+
+        with pytest.raises(san.RecompileStorm) as ei:
+            with pytest.warns(UserWarning, match="graph break"):
+                for v in range(1, 10):
+                    h(paddle.to_tensor(np.full(3, float(v), "float32")))
+        assert "sot.h" in str(ei.value)
+
+    def test_threshold_validation(self):
+        with pytest.raises(ValueError):
+            san.set_recompile_threshold(0)
+        san.set_recompile_threshold(2)
+        assert san.recompile_threshold() == 2
+
+    def test_disabled_counts_nothing(self):
+        @paddle.jit.to_static
+        def f(x):
+            return x * 3
+
+        for n in range(2, 6):
+            f(paddle.to_tensor(np.ones(n, "float32")))
+        assert san.compile_counts() == {}
+
+
+# --------------------------------------------------------------------------- #
+# host-sync tripwire
+# --------------------------------------------------------------------------- #
+
+class TestHostSyncTripwire:
+    def test_fires_inside_span_not_outside(self):
+        san.enable("hostsync")
+        trace.enable()
+        try:
+            x = paddle.to_tensor(np.ones(3, "float32"))
+            x.numpy()                        # outside any span: fine
+            with trace.span("train.forward"):
+                with pytest.raises(san.HostSyncInProtectedRegion,
+                                   match="train.forward"):
+                    x.numpy()
+            x.numpy()                        # span closed: fine again
+        finally:
+            trace.disable()
+            trace.reset()
+
+    def test_item_and_float_also_trip(self):
+        san.enable("hostsync")
+        trace.enable()
+        try:
+            x = paddle.to_tensor(np.ones((), "float32"))
+            with trace.span("train.backward"):
+                with pytest.raises(san.HostSyncInProtectedRegion):
+                    x.item()
+                with pytest.raises(san.HostSyncInProtectedRegion):
+                    float(x)
+        finally:
+            trace.disable()
+            trace.reset()
+
+    def test_unprotected_span_is_silent(self):
+        san.enable("hostsync")
+        trace.enable()
+        try:
+            x = paddle.to_tensor(np.ones(3, "float32"))
+            with trace.span("dataloader.batch"):
+                x.numpy()                    # not a train/serving region
+        finally:
+            trace.disable()
+            trace.reset()
+
+    def test_allow_host_sync_escape(self):
+        san.enable("hostsync")
+        trace.enable()
+        try:
+            x = paddle.to_tensor(np.ones(3, "float32"))
+            with trace.span("train.step"):
+                with san.allow_host_sync():
+                    assert x.numpy().shape == (3,)
+        finally:
+            trace.disable()
+            trace.reset()
+
+    def test_protected_region_works_without_tracing(self):
+        """The serving engine marks its decode loop via protected_region —
+        the tripwire must fire there even with span tracing off."""
+        san.enable("hostsync")
+        x = paddle.to_tensor(np.ones(3, "float32"))
+        with san.protected_region("serving.step"):
+            with pytest.raises(san.HostSyncInProtectedRegion,
+                               match="serving.step"):
+                x.numpy()
+        x.numpy()
+
+    def test_hook_uninstalled_on_disable(self):
+        from paddle_tpu.framework import core
+
+        before = core._CONCRETIZE_HOOK[0]
+        san.enable("hostsync")
+        assert core._CONCRETIZE_HOOK[0] is not before
+        san.disable("hostsync")
+        assert core._CONCRETIZE_HOOK[0] is before
+
+    def test_disable_during_sot_hook_swap_does_not_self_chain(self):
+        """A disable() landing inside SOT's temporary concretize-hook swap
+        leaves the tripwire in the slot when SOT restores it; the next
+        enable() must not chain the tripwire to itself (RecursionError on
+        every .numpy())."""
+        from paddle_tpu.framework import core
+
+        san.enable("hostsync")
+        prev = core._CONCRETIZE_HOOK[0]     # the tripwire
+        core._CONCRETIZE_HOOK[0] = lambda t: None   # SOT capture swap
+        san.disable("hostsync")             # races the swap window
+        core._CONCRETIZE_HOOK[0] = prev     # SOT's finally restores
+        san.enable("hostsync")
+        try:
+            x = paddle.to_tensor(np.ones(2, "float32"))
+            assert x.numpy().shape == (2,)  # must not recurse
+        finally:
+            san.disable("hostsync")
+            core._CONCRETIZE_HOOK[0] = None
+
+
+# --------------------------------------------------------------------------- #
+# trip exports: metrics + spans + flight dump
+# --------------------------------------------------------------------------- #
+
+class TestTripExports:
+    def test_trip_bumps_metric_records_span_and_flight_dumps(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path))
+        monitor.enable()
+        trace.enable()
+        san.enable("lock")
+        try:
+            a = san.new_lock("dump_a_lock")
+            b = san.new_lock("dump_b_lock")
+            with a:
+                with b:
+                    pass
+            with pytest.raises(san.LockOrderInversion):
+                with b:
+                    with a:
+                        pass
+        finally:
+            trace.disable()
+        c = monitor.registry.get("paddle_tpu_monitor_sanitizer_trips_total")
+        assert c is not None and c.labels("lock").value == 1
+        assert any(sp.name == "monitor.sanitizer_trip"
+                   for sp in trace.spans())
+        dumps = glob.glob(os.path.join(str(tmp_path), "paddle_tpu_flight_"
+                                       "rank*_pid*.json"))
+        assert dumps, "flight dump not written"
+        with open(dumps[0]) as f:
+            doc = json.load(f)
+        assert doc["reason"].startswith("graftsan lock trip")
+        trace.reset()
+
+    def test_trip_record_survives_without_monitor(self):
+        """The raise is the contract even when telemetry is fully off."""
+        san.enable("lock")
+        a = san.new_lock("quiet_a_lock")
+        b = san.new_lock("quiet_b_lock")
+        with a:
+            with b:
+                pass
+        with pytest.raises(san.LockOrderInversion):
+            with b:
+                with a:
+                    pass
+        assert [k for k, _ in san.trips()] == ["lock"]
+
+
+# --------------------------------------------------------------------------- #
+# disabled-mode budget
+# --------------------------------------------------------------------------- #
+
+def _floor_us(f, n=60):
+    import gc
+
+    f()
+    gc.collect()
+    ts = []
+    for _ in range(7):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            f()
+        ts.append((time.perf_counter() - t0) / n * 1e6)
+    return min(ts)
+
+
+class TestDisabledOverhead:
+    def test_disabled_dispatch_overhead_within_forward_budget(self):
+        """With sanitizers off the dispatch path is untouched (no hook in
+        the concretize slot, no wrapped locks on the hot path): the same
+        40us forward budget the monitor/trace layers hold. Retry-on-load
+        pattern (see tests/test_monitor.py): a loaded 1-core CI box can
+        blow one measurement; a real regression fails every attempt."""
+        y = paddle.to_tensor(np.random.randn(4, 4).astype("float32"))
+        xg = paddle.to_tensor(np.random.randn(4, 4).astype("float32"),
+                              stop_gradient=False)
+        us = None
+        for _attempt in range(3):
+            us = _floor_us(lambda: xg + y)
+            if us < 40:
+                return
+        pytest.fail(f"sanitizer-off dispatch {us:.0f}us exceeds 40us "
+                    "budget in 3 attempts")
+
+    def test_disabled_concretize_slot_untouched(self):
+        from paddle_tpu.framework import core
+
+        x = paddle.to_tensor(np.ones(2, "float32"))
+        hook_before = core._CONCRETIZE_HOOK[0]
+        x.numpy()
+        assert core._CONCRETIZE_HOOK[0] is hook_before
+        assert not isinstance(hook_before, san.SanitizedLock)

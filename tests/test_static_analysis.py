@@ -1,19 +1,23 @@
 """graftlint (paddle_tpu/analysis): the framework-aware static-analysis
 gate, tier-1.
 
-Three contracts under test:
+Four contracts under test:
 
-1. the shipped tree is CLEAN — zero non-baselined findings over
-   paddle_tpu/ with the checked-in baseline (the same invariant
-   ``python -m paddle_tpu.analysis`` enforces with its exit code);
-2. every rule GL001–GL006 fires on its dirty fixture and stays silent on
-   its clean one (tests/fixtures/lint/ mini-trees);
-3. the silencing machinery works: inline + file-level suppressions, and
-   the baseline round-trip (grandfather findings, rerun clean).
-
-The CLI surfaces (tools/lint_framework.py without importing the
-framework, the PR 1 tools/check_metric_names.py exit-code contract, and
-the tools/run_static_checks.py aggregator) are exercised as subprocesses.
+1. the shipped tree is CLEAN — zero findings over paddle_tpu/ with an
+   EMPTY baseline (the same invariant ``python -m paddle_tpu.analysis``
+   enforces with its exit code) — including the interprocedural engine;
+2. every rule GL001–GL008 fires on its dirty fixture and stays silent on
+   its clean one (tests/fixtures/lint/ mini-trees), and the
+   interprocedural upgrades of GL001/GL002/GL004 flag helper-hidden
+   hazards at the call site with the propagation chain;
+3. the silencing machinery works: inline + file-level suppressions
+   (which also STOP propagation through the call graph), and the
+   baseline round-trip (grandfather findings, rerun clean);
+4. the CLI surfaces (tools/lint_framework.py without importing the
+   framework, the tools/check_metric_names.py exit-code contract,
+   ``--explain GLxxx`` chain rendering, and the
+   tools/run_static_checks.py aggregator incl. the check_lock_order /
+   check_recompile_hazards rows) behave as subprocesses.
 """
 import json
 import os
@@ -46,15 +50,15 @@ class TestShippedTree:
         exits 0 on this tree. Any new finding must be fixed, suppressed
         with a rationale, or (exceptionally) baselined."""
         new, _base, _supp, rules = analysis.analyze()
-        assert len(rules) == 6
+        assert len(rules) == 8
         assert not new, "new graftlint findings:\n" + "\n".join(
             repr(f) for f in new)
 
-    def test_baseline_only_shrinks(self):
-        """The grandfathered-debt file stays small (self-clean shipped a
-        near-empty baseline; additions need a strong reason)."""
+    def test_baseline_is_empty(self):
+        """PR 4 burned the grandfathered debt to zero; the baseline must
+        STAY empty — fix or suppress-with-rationale, never grandfather."""
         fps = analysis.load_baseline(analysis.DEFAULT_BASELINE)
-        assert len(fps) <= 8
+        assert len(fps) == 0
 
 
 class TestRuleFixtures:
@@ -63,13 +67,17 @@ class TestRuleFixtures:
 
     @pytest.mark.parametrize("subdir,rule,expect", [
         # gl001 includes a call-form jax.jit(run) case; gl002 includes a
-        # sync in the unselected branch of an isinstance guard
+        # sync in the unselected branch of an isinstance guard; gl007 has
+        # one intra-file pairwise inversion + one cross-file cycle only
+        # the call graph sees; gl008 covers all three hazard shapes
         ("gl001", "GL001", 4),
         ("gl002", "GL002", 5),
         ("gl003_dirty", "GL003", 7),
         ("gl004", "GL004", 3),
         ("gl005_dirty", "GL005", 4),
         ("gl006_dirty", "GL006", 4),
+        ("gl007_dirty", "GL007", 2),
+        ("gl008_dirty", "GL008", 6),
     ])
     def test_dirty_fixture_fires(self, subdir, rule, expect):
         new, _, _ = _analyze(subdir)
@@ -80,7 +88,8 @@ class TestRuleFixtures:
             assert "clean" not in f.path
 
     @pytest.mark.parametrize("subdir", ["gl003_clean", "gl005_clean",
-                                        "gl006_clean"])
+                                        "gl006_clean", "gl007_clean",
+                                        "gl008_clean", "interproc_clean"])
     def test_clean_trees_are_silent(self, subdir):
         new, _, _ = _analyze(subdir)
         assert new == []
@@ -96,6 +105,98 @@ class TestRuleFixtures:
 
     def test_rule_selection(self):
         new, _, _ = _analyze("gl001", rules=["GL002"])
+        assert new == []
+
+
+class TestInterprocedural:
+    """The call-graph upgrade: helper-hidden hazards flagged at the call
+    site, with the propagation chain, across module boundaries."""
+
+    def test_dirty_tree_fires_all_three_rules(self):
+        new, _, _ = _analyze("interproc_dirty")
+        by_rule = {}
+        for f in new:
+            by_rule.setdefault(f.rule, []).append(f)
+        assert {r: len(v) for r, v in by_rule.items()} == {
+            "GL001": 1, "GL002": 1, "GL004": 1}
+        # the finding sits at the CALL SITE, not in the helper
+        assert by_rule["GL001"][0].path == "traced.py"
+        assert by_rule["GL002"][0].path == "paddle_tpu/ops/hot.py"
+        assert by_rule["GL004"][0].path == "locks.py"
+
+    def test_chain_names_in_message_and_hops_in_chain(self):
+        """The message carries the qualname chain (line-number-free, so
+        fingerprints survive drift); the chain field carries file:line
+        hops for --explain."""
+        new, _, _ = _analyze("interproc_dirty")
+        gl001 = next(f for f in new if f.rule == "GL001")
+        assert "deep_stamp -> stamp -> time.time()" in gl001.message
+        assert "helpers.py:" not in gl001.message  # line-free fingerprint
+        assert gl001.chain  # hops present, with file:line detail
+        assert any("helpers.py:" in hop for hop in gl001.chain)
+        d = gl001.as_dict()
+        assert d["chain"] == list(gl001.chain)
+
+    def test_suppressing_the_helper_stops_propagation(self, tmp_path):
+        """An inline suppression on the helper's sync line is an ACCEPTED
+        sync: callers must not be flagged for reaching it."""
+        root = tmp_path / "tree"
+        (root / "paddle_tpu" / "ops").mkdir(parents=True)
+        (root / "helpers.py").write_text(
+            "def read_scalar(t):\n"
+            "    return t.numpy()  "
+            "# graftlint: disable=GL002 — sanctioned\n")
+        (root / "paddle_tpu" / "ops" / "hot.py").write_text(
+            "import helpers\n\n\n"
+            "def hot_read(x):\n"
+            "    return helpers.read_scalar(x)\n")
+        new, _, _, _ = analysis.analyze(root=str(root), baseline_path="",
+                                        include=None)
+        assert new == []
+
+    def test_guarded_call_site_is_exempt(self, tmp_path):
+        """The isinstance-guard normalization idiom applies to the CALL
+        SITE of a syncing helper exactly as it does to a direct sync."""
+        root = tmp_path / "tree"
+        (root / "paddle_tpu" / "ops").mkdir(parents=True)
+        (root / "helpers.py").write_text(
+            "def read_scalar(t):\n    return t.numpy()\n")
+        (root / "paddle_tpu" / "ops" / "hot.py").write_text(
+            "import helpers\n"
+            "from paddle_tpu.framework.core import Tensor\n\n\n"
+            "def hot_read(x):\n"
+            "    if isinstance(x, Tensor):\n"
+            "        return helpers.read_scalar(x)\n"
+            "    return x\n")
+        new, _, _, _ = analysis.analyze(root=str(root), baseline_path="",
+                                        include=None)
+        assert new == []
+
+    def test_lock_key_distinguishes_classes(self, tmp_path):
+        """Two different classes' ``self._lock`` must not alias into one
+        graph node: A holds its lock then the global lock, B holds the
+        global lock then ITS OWN lock — a naive 'self._lock' key would
+        report a false inversion; class-qualified keys must not."""
+        root = tmp_path / "tree"
+        root.mkdir()
+        (root / "mod.py").write_text(
+            "import threading\n\n"
+            "g_lock = threading.Lock()\n\n\n"
+            "class A:\n"
+            "    def go(self):\n"
+            "        with self._lock:\n"
+            "            with g_lock:\n"
+            "                pass\n\n\n"
+            "class B:\n"
+            "    def go(self):\n"
+            "        with g_lock:\n"
+            "            self.grab()\n\n"
+            "    def grab(self):\n"
+            "        with self._lock:\n"
+            "            pass\n")
+        new, _, _, _ = analysis.analyze(root=str(root), baseline_path="",
+                                        include=None, rules=[
+                                            analysis.RULES_BY_ID["GL007"]])
         assert new == []
 
 
@@ -234,8 +335,21 @@ class TestCLISurfaces:
         summary = json.loads(p.stdout)
         assert summary["ok"] is True
         assert [c["check"] for c in summary["checks"]] == [
-            "graftlint", "check_metric_names", "check_span_names"]
+            "graftlint", "check_metric_names", "check_span_names",
+            "check_lock_order", "check_recompile_hazards"]
         assert all(c["ok"] for c in summary["checks"])
+
+    def test_explain_prints_propagation_chain(self):
+        """--explain GLxxx: one rule, every finding followed by its
+        indented chain hops with file:line detail."""
+        p = self._run("tools/lint_framework.py", "--root",
+                      os.path.join(FIX, "interproc_dirty"), "--include",
+                      "", "--no-baseline", "--explain", "GL001")
+        assert p.returncode == 1, p.stdout + p.stderr
+        assert "deep_stamp -> stamp -> time.time()" in p.stdout
+        assert "| stamp [time.time() at helpers.py:" in p.stdout
+        p = self._run("tools/lint_framework.py", "--explain", "GL999")
+        assert p.returncode == 2
 
     def test_aggregator_and_shim_agree_on_suppressed_metric(self, tmp_path):
         """A suppressed GL005 registration must pass BOTH strict surfaces
@@ -261,8 +375,12 @@ class TestCLISurfaces:
             rows = agg.run_checks(root=str(root))
             assert [r["check"] for r in rows] == ["graftlint",
                                                  "check_metric_names",
-                                                 "check_span_names"]
+                                                 "check_span_names",
+                                                 "check_lock_order",
+                                                 "check_recompile_hazards"]
             assert rows[1]["ok"], rows[1]
             assert rows[2]["ok"], rows[2]
+            assert rows[3]["ok"], rows[3]
+            assert rows[4]["ok"], rows[4]
         finally:
             sys.path.remove(os.path.join(ROOT, "tools"))

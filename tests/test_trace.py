@@ -177,12 +177,21 @@ class TestDisabledMode:
         """Tier-1 overhead budget: with tracing disabled the instrumented
         dispatch path must stay inside the SAME 40us forward budget
         tests/test_dispatch_perf.py enforces — the span layer may not tax
-        the eager hot path when off."""
+        the eager hot path when off.
+
+        Retry-on-load pattern (PR 4, see tests/test_monitor.py): a loaded
+        1-core box can blow one min-of-7 floor; a real regression fails
+        all three attempts."""
         y = paddle.to_tensor(np.random.randn(4, 4).astype("float32"))
         xg = paddle.to_tensor(np.random.randn(4, 4).astype("float32"),
                               stop_gradient=False)
-        us = _floor_us(lambda: xg + y)
-        assert us < 40, f"trace-off dispatch {us:.0f}us exceeds 40us budget"
+        us = None
+        for _attempt in range(3):
+            us = _floor_us(lambda: xg + y)
+            if us < 40:
+                return
+        assert us < 40, \
+            f"trace-off dispatch {us:.0f}us exceeds 40us budget (3 tries)"
 
     def test_enabled_dispatch_spans_are_sampled(self):
         trace.enable()

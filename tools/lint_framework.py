@@ -4,8 +4,11 @@
 ``python -m paddle_tpu.analysis`` initializes paddle_tpu (and therefore
 jax) just to reach the linter; this shim loads ``paddle_tpu/analysis`` by
 file path — the package is stdlib-only by design — so the same checks run
-in any CI venv in milliseconds. Arguments and exit codes are identical to
-the module CLI.
+in any CI venv without jax. Arguments and exit codes are identical to the
+module CLI, including ``--explain GLxxx``: run one rule and print every
+finding followed by its interprocedural propagation chain, one
+``file:line`` hop per line (the debugging view of the call-graph engine,
+callgraph.py).
 """
 from __future__ import annotations
 

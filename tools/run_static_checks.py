@@ -12,7 +12,12 @@ One analysis pass (parse the tree once) feeds two result rows:
    covered by the subprocess test in tests/test_static_analysis.py);
 3. the span-name contract (GL006 strict: same semantics over the
    SPANS table in monitor/catalog.py — the trace vocabulary is linted
-   exactly like the metric vocabulary).
+   exactly like the metric vocabulary);
+4. the lock-order graph (GL007 strict: the static lock-acquisition graph
+   over the interprocedural call graph must be acyclic — no baseline);
+5. the recompile hazards (GL008 strict: per-call registration, shape/
+   dtype branching in jitted bodies, per-call-constructed static args —
+   no baseline).
 
 Prints one status line per check, then a machine-readable JSON summary on
 stdout (``--json`` prints ONLY the JSON). Exit 0 iff every check passed.
@@ -64,6 +69,26 @@ def run_checks(root=ROOT):
     problems = an.RULES_BY_ID["GL006"].strict_problems(project, findings)
     rows.append({
         "check": "check_span_names",
+        "ok": not problems,
+        "findings": len(problems),
+        "detail": problems,
+        "seconds": round(time.perf_counter() - t0, 3),
+    })
+
+    t0 = time.perf_counter()
+    problems = an.RULES_BY_ID["GL007"].strict_problems(project, findings)
+    rows.append({
+        "check": "check_lock_order",
+        "ok": not problems,
+        "findings": len(problems),
+        "detail": problems,
+        "seconds": round(time.perf_counter() - t0, 3),
+    })
+
+    t0 = time.perf_counter()
+    problems = an.RULES_BY_ID["GL008"].strict_problems(project, findings)
+    rows.append({
+        "check": "check_recompile_hazards",
         "ok": not problems,
         "findings": len(problems),
         "detail": problems,
