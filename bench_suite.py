@@ -36,7 +36,7 @@ import time
 ROOT = os.path.dirname(os.path.abspath(__file__))
 RESULTS = os.path.join(ROOT, "tools", "suite_results.jsonl")
 
-CONFIGS = ("lenet", "resnet50", "bert_dp", "gpt_hybrid")
+CONFIGS = ("lenet", "resnet50", "bert_dp", "gpt_hybrid", "serving")
 
 
 # --------------------------------------------------------------------------- #
@@ -268,6 +268,49 @@ def run_gpt_hybrid():
                       "trains": losses[-1] < losses[0]}})
 
 
+def run_serving(smoke=False):
+    """Config 5 — the serving engine: continuous batching (chunked
+    prefill + radix prefix cache) vs the static-batch baseline at equal
+    batch capacity on a Poisson open-loop mixed-length workload
+    (bench_common.serving_bench; docs/serving.md). ``smoke`` runs the
+    tier-1-safe tiny-model shape (`bench_suite.py --smoke serving`)."""
+    import numpy as np  # noqa: F401 - platform probe below imports jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    from bench_common import serving_bench
+
+    dev, on_tpu, kind = _device()
+    paddle.seed(0)
+    if smoke or not on_tpu:
+        cfg = LlamaConfig(vocab_size=96, hidden_size=64,
+                          intermediate_size=176, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=2,
+                          max_position_embeddings=256)
+        params = dict(max_batch=8, block_size=8, chunk_size=16,
+                      decode_burst=12, n_requests=20, n_groups=2,
+                      prefix_blocks=6, tail_range=(4, 12),
+                      new_range=(4, 64), repeats=3)
+    else:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5632, num_hidden_layers=8,
+                          num_attention_heads=16, num_key_value_heads=16,
+                          max_position_embeddings=1024, dtype="bfloat16")
+        params = dict(max_batch=16, block_size=64, chunk_size=128,
+                      decode_burst=8, n_requests=24, n_groups=3,
+                      prefix_blocks=4, tail_range=(32, 128),
+                      new_range=(32, 128), repeats=2)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu and not smoke:
+        model.to(dtype="bfloat16")
+    res = serving_bench(model, **params)
+    res["device"] = kind
+    res["smoke"] = bool(smoke)
+    _emit({"config": "serving", "value": res["serving_tokens_per_sec"],
+           "unit": "tokens/s", "detail": res})
+
+
 # --------------------------------------------------------------------------- #
 # orchestrator
 # --------------------------------------------------------------------------- #
@@ -318,7 +361,17 @@ def main():
     ap.add_argument("--configs", default=",".join(CONFIGS))
     ap.add_argument("--timeout", type=int,
                     default=int(os.environ.get("SUITE_TIMEOUT", "1500")))
+    ap.add_argument("--smoke", metavar="CONFIG",
+                    help="run ONE config in-process at tier-1-safe smoke "
+                         "shapes and print its JSON line (currently: "
+                         "serving)")
     args = ap.parse_args()
+
+    if args.smoke:
+        if args.smoke != "serving":
+            ap.error(f"--smoke supports 'serving', not {args.smoke!r}")
+        run_serving(smoke=True)
+        return
 
     rows = []
     for name in args.configs.split(","):
@@ -349,6 +402,7 @@ if __name__ == "__main__":
     if "--worker" in sys.argv:
         which = sys.argv[sys.argv.index("--worker") + 1]
         {"lenet": run_lenet, "resnet50": run_resnet50,
-         "bert_dp": run_bert_dp, "gpt_hybrid": run_gpt_hybrid}[which]()
+         "bert_dp": run_bert_dp, "gpt_hybrid": run_gpt_hybrid,
+         "serving": run_serving}[which]()
     else:
         main()

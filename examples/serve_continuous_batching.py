@@ -10,9 +10,12 @@ generation path + block_multihead_attention serving mode):
    beams SHARE prompt blocks (refcounted fork, copy-on-write at
    divergence) instead of duplicating the prompt KV per beam.
 3. `ContinuousBatchingEngine` — requests join and leave the running batch
-   between steps; ONE compiled step decodes every active slot at its own
-   position (per-row lengths/RoPE), so nothing recompiles as traffic
-   changes shape.
+   between steps; every step packs decode lanes and CHUNKED-PREFILL lanes
+   of newly admitted prompts into ONE fixed-shape compiled mixed step
+   (token-budget scheduling), so nothing recompiles as traffic changes
+   shape — and shared prompt prefixes ride the radix prefix cache:
+   their KV blocks map read-only into new requests instead of being
+   recomputed (copy-on-write at divergence; docs/serving.md).
 """
 import numpy as np
 
@@ -47,13 +50,13 @@ def main():
     print(f"[beams] best scores {np.asarray(scores)[:, 0]}, "
           f"{used} blocks live for {2 * 4} beams (prompt blocks shared)")
 
-    # -- tier 3: continuous batching ----------------------------------------
+    # -- tier 3: continuous batching (chunked prefill + radix cache) --------
     srv = ContinuousBatchingEngine(model, max_batch=4, max_len=128,
-                                   block_size=16, prefill_buckets=(16, 32))
+                                   block_size=16, chunk_size=16)
     rids = [srv.add_request(rng.randint(0, 256, (n,)).astype("int32"))
             for n in (9, 14)]
     done = {}
-    for step in range(40):
+    for step in range(60):
         for rid, toks in srv.step(max_new_tokens=12):
             done[rid] = toks
         if step == 2:   # a request arrives mid-flight
@@ -64,6 +67,19 @@ def main():
     for rid in rids:
         print(f"[serve] request {rid}: {len(done[rid])} tokens")
     assert srv.num_active == 0
+
+    # -- tier 4: prefix reuse — repeat prompts hit the radix cache ----------
+    shared = rng.randint(0, 256, (33,)).astype("int32")  # 2 blocks + tail
+    for round_ in ("cold", "warm"):
+        rid = srv.submit(shared, max_new_tokens=8)
+        while srv.num_active or srv.num_pending:
+            srv.step()
+        st = srv.pop_stats(rid)
+        print(f"[radix] {round_} run: {st['shared_tokens']} of "
+              f"{st['prompt_len']} prompt tokens served from the cache")
+    pc = srv.prefix_cache
+    print(f"[radix] cache: {len(pc)} blocks indexed, "
+          f"{pc.hits} hits / {pc.misses} misses")
 
 
 if __name__ == "__main__":

@@ -28,4 +28,6 @@ from .gpt import (  # noqa: F401
     GPTPretrainingCriterion,
 )
 from .llama_decode import LlamaDecodeEngine  # noqa: F401
-from .serving import ContinuousBatchingEngine  # noqa: F401
+from .radix_cache import PrefixCache  # noqa: F401
+from .serving import (AdmissionTimeout, ContinuousBatchingEngine,  # noqa: F401
+                      StaticBatchEngine)

@@ -305,6 +305,88 @@ class LlamaDecodeEngine:
                                               lens)[:, None]
         return self._post_attn(p, x, attn), pool
 
+    def _block_paged_mixed(self, p, x, pool, row_tables, positions, valid):
+        """One token per LANE at a per-lane position against a per-lane
+        block-table row — the transformer block of the continuous-batching
+        MIXED step, where decode lanes (one token per running request) and
+        chunked-prefill lanes (consecutive prompt tokens of an admitted
+        request) share one compiled program. Writes land before the
+        attention gather, so prefill lanes of the same chunk see each
+        other through the pool (causal by absolute position)."""
+        from . import paged_kv as _pk
+
+        B = x.shape[0]
+        h = _rms(x, p["ln1"], self.eps)
+        q = (h @ p["wq"]).reshape(B, 1, self.num_heads, self.head_dim)
+        k = (h @ p["wk"]).reshape(B, 1, self.num_kv, self.head_dim)
+        v = (h @ p["wv"]).reshape(B, 1, self.num_kv, self.head_dim)
+        q = _rope_at_rows(q, positions, self.theta)
+        k = _rope_at_rows(k, positions, self.theta)
+        pool = _pk.paged_write_mixed(*pool, row_tables, positions, valid,
+                                     k[:, 0], v[:, 0])
+        attn = _pk.paged_attention_decode(q[:, 0], *pool, row_tables,
+                                          positions)[:, None]
+        return self._post_attn(p, x, attn), pool
+
+    def build_mixed_step(self):
+        """The continuous-batching mixed step as a pure function for the
+        serving engine to jit (donated pools): a ``(token_ids, slot_ids,
+        positions)`` pack of ``T`` lanes — decode slots and prefill chunks
+        interleaved — runs ONE forward, writes every lane's K/V into its
+        slot's paged blocks, and returns the per-lane greedy token (read
+        only for lanes the scheduler marked as emitting). Shapes are fixed
+        by the token budget ``T``, so XLA compiles this exactly once."""
+        def run(pack, pools, tables, slot_ids, valid):
+            # pack (2, T) int32: row 0 = token ids, row 1 = positions
+            # (one fused upload per step — these are the only per-step
+            # transfers; slot_ids/valid are cached per pack composition)
+            token_ids, positions = pack[0], pack[1]
+            x = self.emb[token_ids][:, None]        # (T, 1, hidden)
+            row_tables = tables[slot_ids]           # (T, max_blocks)
+            new_pools = []
+            for p, pool in zip(self.layers, pools):
+                x, pool = self._block_paged_mixed(p, x, pool, row_tables,
+                                                  positions, valid)
+                new_pools.append(pool)
+            x = _rms(x, self.norm_w, self.eps)
+            logits = (x @ self.head_w)[:, -1]
+            # argmax INSIDE the program: the scheduler transfers one (T,)
+            # int32 lane vector per step, never a vocab-size logits row
+            return jnp.argmax(logits, -1).astype(jnp.int32), new_pools
+
+        return run
+
+    def build_decode_burst(self, k):
+        """``k`` ragged decode iterations fused into ONE program via
+        lax.scan — the serving engine's steady-state path when no prefill
+        or admission work is pending: one dispatch + one host round-trip
+        emits ``k`` tokens per slot instead of one. Inactive rows write
+        into the reserved null block (their table rows are zero), exactly
+        like the single-step path."""
+        def run(pack, pools, tables):
+            # pack (2, B) int32: row 0 = current tokens, row 1 = per-row
+            # positions (one fused upload per burst)
+            tokens, lens = pack[0][:, None], pack[1]
+
+            def body(carry, _):
+                toks, pools_c, lens_c = carry
+                x = self.emb[toks]
+                new_pools = []
+                for p, pool in zip(self.layers, pools_c):
+                    x, pool = self._block_paged_decode(p, x, pool, tables,
+                                                       lens_c)
+                    new_pools.append(pool)
+                x = _rms(x, self.norm_w, self.eps)
+                logits = (x @ self.head_w)[:, -1]
+                nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+                return (nxt[:, None], new_pools, lens_c + 1), nxt
+
+            (toks, pools, lens), outs = lax.scan(
+                body, (tokens, pools, lens), None, length=k)
+            return jnp.swapaxes(outs, 0, 1), pools    # (B, k)
+
+        return run
+
     @functools.cached_property
     def _prefill_paged_jit(self):
         def run(ids, pools, tables, lens):
@@ -401,7 +483,16 @@ class LlamaDecodeEngine:
             # then copy-on-write for any SHARED tail block (beam forks;
             # cheap no-op when nothing is shared)
             pager.ensure_capacity([int(pos) + 1] * pager.batch)
-            pools = pager.make_tail_exclusive(int(pos), cache.pools)
+            from .paged_kv import CowPoolExhausted
+
+            try:
+                pools = pager.make_tail_exclusive(int(pos), cache.pools)
+            except CowPoolExhausted as e:
+                # the CoW donated the cache's pools before running dry:
+                # adopt the replacement so a caller that frees rows and
+                # retries holds live buffers, not consumed ones
+                cache.pools = e.pools
+                raise
             logits, pools = self._step_paged_jit(
                 jnp.asarray(token, jnp.int32), pools,
                 pager.block_tables, jnp.asarray(pos, jnp.int32))

@@ -29,10 +29,22 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-__all__ = ["PagedKVCache", "alloc_blocks", "paged_write_decode",
-           "paged_write_prefill", "paged_attention_decode",
-           "paged_write_decode_int8", "paged_write_prefill_int8",
-           "paged_attention_decode_int8"]
+__all__ = ["PagedKVCache", "CowPoolExhausted", "alloc_blocks",
+           "paged_write_decode", "paged_write_prefill", "paged_write_mixed",
+           "paged_attention_decode", "paged_write_decode_int8",
+           "paged_write_prefill_int8", "paged_attention_decode_int8"]
+
+
+class CowPoolExhausted(RuntimeError):
+    """Copy-on-write ran out of free blocks. Copies that were already
+    remapped before the pool ran dry ARE applied (their table rows point
+    at initialized private blocks), and — because the copy DONATES the
+    pools it was handed — the replacement pool list travels on ``.pools``
+    so a caller may reclaim blocks and retry against live buffers."""
+
+    def __init__(self, msg, pools):
+        super().__init__(msg)
+        self.pools = pools
 
 _MON = None  # (state, free-blocks gauge, CoW counter, exhaustion counter)
 
@@ -95,12 +107,22 @@ class PagedKVCache:
         The table lives host-side (numpy mirror); the device copy is
         re-uploaded ONLY when a grant actually happened — most decode steps
         grant nothing (blocks change once per block_size tokens), and a
-        per-token host->device upload would sit in the serving hot loop."""
+        per-token host->device upload would sit in the serving hot loop.
+        The nothing-to-grant case is detected vectorized up front: it IS
+        the serving steady state, and a per-row python loop there costs
+        more than the compiled step saves."""
         tables = self._tables_np
         owned = (tables > 0).sum(axis=1)
-        changed = False
+        need_arr = np.asarray(seq_lens_next)
+        needed = -(-np.maximum(need_arr.astype(np.int64), 0)
+                   // self.block_size)
         mon = _mon()
-        for b, need_tok in enumerate(np.asarray(seq_lens_next)):
+        if (needed <= owned).all():
+            if mon[0].on:
+                mon[1].set(len(self._free))
+            return
+        changed = False
+        for b, need_tok in enumerate(need_arr):
             need = int(-(-int(need_tok) // self.block_size))  # ceil
             while owned[b] < need:
                 if not self._free:
@@ -144,6 +166,101 @@ class PagedKVCache:
         if mon[0].on:
             mon[1].set(len(self._free))
 
+    # -- external references (radix/prefix cache) ----------------------------
+    def retain_blocks(self, blocks):
+        """Take one extra reference on each block (the prefix cache's pin):
+        a retained block survives :meth:`free_sequence` of its original
+        owner and only returns to the pool when released."""
+        for blk in blocks:
+            blk = int(blk)
+            if not 0 < blk < self.num_blocks:
+                raise ValueError(f"block {blk} out of range")
+            if self._refs[blk] <= 0:
+                raise ValueError(f"block {blk} is free; cannot retain")
+            self._refs[blk] += 1
+
+    def release_blocks(self, blocks):
+        """Drop one reference per block (undo of retain_blocks); blocks
+        whose last reference goes return to the free pool."""
+        freed = 0
+        for blk in blocks:
+            blk = int(blk)
+            self._refs[blk] -= 1
+            if self._refs[blk] == 0:
+                self._free.append(blk)
+                freed += 1
+        mon = _mon()
+        if mon[0].on:
+            mon[1].set(len(self._free))
+        return freed
+
+    def adopt_blocks(self, b, blocks):
+        """Map shared ``blocks`` into the HEAD of row b's block table (one
+        new reference each) — the prefix-cache admission path: row b's
+        first ``len(blocks) * block_size`` positions read the shared KV.
+        Row b must hold no blocks yet (adoption happens at admission)."""
+        tables = self._tables_np
+        if (tables[b] > 0).any():
+            raise ValueError(f"row {b} already holds blocks")
+        if len(blocks) > self.max_blocks_per_seq:
+            raise ValueError("shared prefix longer than max_blocks_per_seq")
+        for i, blk in enumerate(blocks):
+            blk = int(blk)
+            if self._refs[blk] <= 0:
+                raise ValueError(f"block {blk} is free; cannot adopt")
+            tables[b, i] = blk
+            self._refs[blk] += 1
+        self.block_tables = jnp.asarray(tables.copy())
+
+    def make_positions_exclusive(self, rows, positions, pools):
+        """Copy-on-write for the mixed serving step: before row ``rows[i]``
+        writes at ``positions[i]``, any targeted block that is SHARED
+        (refs > 1 — prefix-cache hits, beam forks) is replaced by a private
+        copy in one donated gather/scatter. The generalized, per-row form
+        of :meth:`make_tail_exclusive`; plain unshared decode takes the
+        cheap all-refs<=1 early exit."""
+        if (self._refs <= 1).all():
+            return pools
+        mon = _mon()
+        t = self._tables_np
+        rows = np.asarray(rows, np.int64)
+        positions = np.asarray(positions, np.int64)
+        bidxs = positions // self.block_size
+        targets = t[rows, bidxs]
+        hot = np.flatnonzero((targets > 0) & (self._refs[targets] > 1))
+        pairs = []
+        exhausted = False
+        for i in hot:
+            b, bidx = int(rows[i]), int(bidxs[i])
+            phys = int(t[b, bidx])
+            if phys > 0 and self._refs[phys] > 1:
+                if not self._free:
+                    if mon[0].on:
+                        mon[3].inc()
+                    # raise only AFTER applying the pairs already
+                    # remapped: their tables/refs mutations are in, so
+                    # skipping their data copy would leave a retrying
+                    # caller (they now look unshared) reading
+                    # uninitialized KV
+                    exhausted = True
+                    break
+                new = self._free.pop()
+                self._refs[new] = 1
+                self._refs[phys] -= 1
+                t[b, bidx] = new
+                pairs.append((phys, new))
+        if pairs:
+            if mon[0].on:
+                mon[2].inc(len(pairs))
+                mon[1].set(len(self._free))
+            pools = self._cow_apply(pools, pairs)
+            self.block_tables = jnp.asarray(t.copy())
+        if exhausted:
+            raise CowPoolExhausted(
+                "paged KV pool exhausted during copy-on-write "
+                f"(pool={self.num_blocks})", pools)
+        return pools
+
     # -- copy-on-write sharing (beam search) ---------------------------------
     def fork_rows(self, parent_rows):
         """Every row adopts parent_rows[b]'s block table (shared blocks,
@@ -184,6 +301,22 @@ class PagedKVCache:
             self._cow_jit = fn
         return fn
 
+    def _cow_apply(self, pools, pairs):
+        """Run the donated CoW copy for ``pairs`` of (old, new) blocks.
+        The index vectors pad to a power-of-two length so the jitted copy
+        compiles for O(log) distinct shapes, not one per batch size —
+        padding entries copy the null block onto itself (benign)."""
+        n = 1
+        while n < len(pairs):
+            n *= 2
+        olds = np.zeros(n, np.int32)
+        news = np.zeros(n, np.int32)
+        for i, (o, w) in enumerate(pairs):
+            olds[i] = o
+            news[i] = w
+        return self._cow_copy_fn()(pools, jnp.asarray(olds),
+                                   jnp.asarray(news))
+
     def make_tail_exclusive(self, pos, pools):
         """Copy-on-write: before writing at position `pos`, any row whose
         tail block (pos // block_size) is SHARED gets its own copy of it
@@ -195,29 +328,32 @@ class PagedKVCache:
         bidx = int(pos) // self.block_size
         t = self._tables_np
         pairs = []
+        exhausted = False
         for b in range(len(t)):
             phys = int(t[b, bidx])
             if phys > 0 and self._refs[phys] > 1:
                 if not self._free:
                     if mon[0].on:
                         mon[3].inc()
-                    raise RuntimeError(
-                        "paged KV pool exhausted during copy-on-write "
-                        f"(pool={self.num_blocks})")
+                    # apply-then-raise, as in make_positions_exclusive:
+                    # already-remapped rows must get their data copy
+                    exhausted = True
+                    break
                 new = self._free.pop()
                 self._refs[new] = 1
                 self._refs[phys] -= 1
                 t[b, bidx] = new
                 pairs.append((phys, new))
-        if not pairs:
-            return pools
-        if mon[0].on:
-            mon[2].inc(len(pairs))
-            mon[1].set(len(self._free))
-        olds = jnp.asarray([o for o, _ in pairs], jnp.int32)
-        news = jnp.asarray([n for _, n in pairs], jnp.int32)
-        pools = self._cow_copy_fn()(pools, olds, news)
-        self.block_tables = jnp.asarray(t.copy())
+        if pairs:
+            if mon[0].on:
+                mon[2].inc(len(pairs))
+                mon[1].set(len(self._free))
+            pools = self._cow_apply(pools, pairs)
+            self.block_tables = jnp.asarray(t.copy())
+        if exhausted:
+            raise CowPoolExhausted(
+                "paged KV pool exhausted during copy-on-write "
+                f"(pool={self.num_blocks})", pools)
         return pools
 
 
@@ -243,6 +379,24 @@ def paged_write_decode(cache_k, cache_v, block_tables, seq_lens, k_new, v_new):
     phys, off = _decode_scatter_idx(block_tables, seq_lens, cache_k.shape[1])
     cache_k = cache_k.at[phys, off].set(k_new.astype(cache_k.dtype))
     cache_v = cache_v.at[phys, off].set(v_new.astype(cache_v.dtype))
+    return cache_k, cache_v
+
+
+def paged_write_mixed(cache_k, cache_v, row_tables, positions, valid,
+                      k_new, v_new):
+    """Write one token per LANE of a mixed (decode + chunked-prefill) pack.
+
+    ``row_tables`` is the per-lane view ``block_tables[slot_ids]`` — two
+    lanes of the same prefill chunk carry the same table row at different
+    ``positions``. Padding lanes (``valid`` False) are redirected at an
+    out-of-bounds block and DROPPED by the scatter, exactly like prefill
+    padding rows (any real block id would clobber its owner)."""
+    phys, off = _decode_scatter_idx(row_tables, positions, cache_k.shape[1])
+    phys = jnp.where(valid, phys, cache_k.shape[0])
+    cache_k = cache_k.at[phys, off].set(k_new.astype(cache_k.dtype),
+                                        mode="drop")
+    cache_v = cache_v.at[phys, off].set(v_new.astype(cache_v.dtype),
+                                        mode="drop")
     return cache_k, cache_v
 
 

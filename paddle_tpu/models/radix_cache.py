@@ -1,0 +1,198 @@
+"""Radix (prefix) cache over the paged KV pool: cross-request KV reuse.
+
+Reference analog: the radix-tree prompt cache of modern serving engines
+(SGLang's RadixAttention, vLLM's prefix caching): two requests that share a
+prompt prefix should share the KV blocks that prefix produced, not
+recompute and re-store them. The paged pool (models/paged_kv.py) already
+has everything the sharing needs — block granularity, per-block refcounts,
+copy-on-write — this module adds the CONTENT index on top:
+
+- every FULL block written at prefill time is registered under a chain
+  digest ``H(parent_digest, block_tokens)`` — because deep-layer K/V at
+  position t attends over everything before t, a block's KV content is a
+  function of the ENTIRE token prefix through that block, so equal chain
+  digests (with verified tokens) mean bit-equal KV;
+- admission walks the new prompt's blocks down the digest chain (the radix
+  descent) and maps every hit read-only into the request's block table via
+  :meth:`PagedKVCache.adopt_blocks` (one refcount each);
+- the cache holds its own reference on registered blocks
+  (:meth:`PagedKVCache.retain_blocks`), so shared prefixes SURVIVE eviction
+  of the request that first produced them; under pool pressure the engine
+  evicts cache entries in LRU order to hand blocks back;
+- digests are verified against the stored token content on lookup — a
+  digest collision (astronomically unlikely with blake2b, but the contract
+  must not depend on that) degrades to a miss instead of serving another
+  prompt's KV.
+
+Everything here is host-side bookkeeping (dict + refcounts); the device
+cost of a hit is zero — the new request simply never runs the prefill
+lanes for the shared tokens.
+"""
+from __future__ import annotations
+
+import collections
+import hashlib
+
+import numpy as np
+
+__all__ = ["PrefixCache"]
+
+
+def _digest(parent, tokens):
+    """Chain digest of one block: parent digest (b"" at the root) + the
+    block's token ids. Module-level so tests can monkeypatch it to force
+    collisions and pin the verified-tokens fallback."""
+    h = hashlib.blake2b(parent, digest_size=16)
+    h.update(np.asarray(tokens, np.int32).tobytes())
+    return h.digest()
+
+
+class _Entry:
+    __slots__ = ("digest", "parent", "tokens", "block")
+
+    def __init__(self, digest, parent, tokens, block):
+        self.digest = digest
+        self.parent = parent
+        self.tokens = tokens    # the block's token ids (collision check)
+        self.block = block      # physical block id in the pool
+
+
+class PrefixCache:
+    """Content index over one :class:`PagedKVCache` pool."""
+
+    def __init__(self, pager, capacity_blocks=None):
+        self._pager = pager
+        self.block_size = pager.block_size
+        # digest -> _Entry, insertion order = LRU order (move_to_end on use)
+        self._entries = collections.OrderedDict()
+        self._by_block = {}          # physical block -> digest
+        # digest -> number of live child entries chained under it: evict
+        # takes LEAVES first, so reclaiming a few blocks trims chains from
+        # the tail instead of beheading a root and stranding (still
+        # pinned, never matchable) descendants
+        self._nchildren = {}
+        self.capacity = capacity_blocks
+        self.hits = 0                # lookups that matched >= 1 block
+        self.misses = 0
+        self.blocks_shared = 0       # blocks mapped into admitted requests
+        self.collisions = 0          # digest hits with mismatched tokens
+        self.evicted = 0
+
+    def __len__(self):
+        return len(self._entries)
+
+    # -- lookup ---------------------------------------------------------------
+    def match(self, prompt):
+        """Longest cached prefix of ``prompt``: (blocks, n_tokens).
+
+        Walks full blocks down the digest chain. A block-aligned prompt may
+        match in FULL — the engine then re-runs only the last token for its
+        first-token logits, and that write copy-on-writes the shared tail
+        block (models/paged_kv.py make_positions_exclusive)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        bs = self.block_size
+        n_full = len(prompt) // bs
+        blocks, parent = [], b""
+        for i in range(n_full):
+            tokens = prompt[i * bs:(i + 1) * bs]
+            d = _digest(parent, tokens)
+            e = self._entries.get(d)
+            if e is None:
+                break
+            if not np.array_equal(e.tokens, tokens):
+                # digest collision: the stored content is NOT this prefix —
+                # serving it would hand the request another prompt's KV
+                self.collisions += 1
+                break
+            blocks.append(e.block)
+            self._entries.move_to_end(d)
+            parent = d
+        if blocks:
+            self.hits += 1
+            self.blocks_shared += len(blocks)
+        else:
+            self.misses += 1
+        return blocks, len(blocks) * bs
+
+    # -- registration ---------------------------------------------------------
+    def register(self, prompt, n_tokens_written, table_row):
+        """Index every FULL prompt block of ``table_row`` whose KV is
+        fully written (``n_tokens_written`` tokens so far). Idempotent per
+        digest; each newly indexed block is pinned with one cache
+        reference so it outlives its producing request."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        bs = self.block_size
+        n_full = min(len(prompt), int(n_tokens_written)) // bs
+        parent = b""
+        registered = 0
+        for i in range(n_full):
+            tokens = prompt[i * bs:(i + 1) * bs]
+            d = _digest(parent, tokens)
+            e = self._entries.get(d)
+            if e is None:
+                blk = int(table_row[i])
+                if blk <= 0:
+                    break   # row shorter than claimed; nothing to index
+                if blk in self._by_block:
+                    # the row adopted a cached block under ANOTHER digest
+                    # chain (cannot happen for verified matches, but a
+                    # collision-degraded row could): never double-index
+                    parent = d
+                    continue
+                self._pager.retain_blocks([blk])
+                self._entries[d] = _Entry(d, parent, tokens, blk)
+                self._by_block[blk] = d
+                if parent:
+                    self._nchildren[parent] = \
+                        self._nchildren.get(parent, 0) + 1
+                registered += 1
+            else:
+                self._entries.move_to_end(d)
+            parent = d
+        if self.capacity is not None and len(self._entries) > self.capacity:
+            self.evict(len(self._entries) - self.capacity)
+        return registered
+
+    # -- eviction -------------------------------------------------------------
+    def evict(self, n_blocks):
+        """Release up to ``n_blocks`` least-recently-used LEAF entries
+        whose block is referenced ONLY by the cache (refs == 1) — blocks
+        still mapped into live requests are never reclaimed, and an entry
+        with live children is skipped so chains shed from the tail (a
+        beheaded root would leave its descendants pinned but unmatchable).
+        Returns the number of blocks actually handed back to the pool."""
+        freed = 0
+        while freed < n_blocks:
+            progressed = False
+            for d in list(self._entries):
+                if freed >= n_blocks:
+                    break
+                e = self._entries[d]
+                if self._nchildren.get(d, 0) > 0 \
+                        or self._pager._refs[e.block] != 1:
+                    continue
+                self._drop(e)
+                freed += 1
+                self.evicted += 1
+                progressed = True
+            if not progressed:
+                break   # everything left is live or an interior node
+        return freed
+
+    def _drop(self, e):
+        del self._entries[e.digest]
+        del self._by_block[e.block]
+        self._nchildren.pop(e.digest, None)
+        if e.parent and e.parent in self._nchildren:
+            self._nchildren[e.parent] -= 1
+            if self._nchildren[e.parent] <= 0:
+                del self._nchildren[e.parent]
+        self._pager.release_blocks([e.block])
+
+    def clear(self):
+        """Drop the whole index (releases every cache pin)."""
+        for e in self._entries.values():
+            self._pager.release_blocks([e.block])
+        self._entries.clear()
+        self._by_block.clear()
+        self._nchildren.clear()

@@ -1,32 +1,54 @@
-"""Continuous-batching serving engine over the paged KV cache.
+"""Continuous-batching serving engine: chunked prefill + prefix-shared
+paged KV over one fixed-shape compiled step.
 
 Reference analog: the block_multihead_attention serving stack
 (incubate/nn/functional/block_multihead_attention.py) exists exactly to
 serve BATCHES OF SEQUENCES AT DIFFERENT POSITIONS — seq_lens_encoder /
 seq_lens_decoder / block tables are its admission contract. This module is
-the engine on top of that capability, TPU-first:
+the engine on top of that capability, TPU-first, rebuilt around three
+ideas (the design of modern continuous-batching servers — Orca's
+iteration-level scheduling, vLLM's paged prefix reuse — expressed as ONE
+XLA program):
 
-- one compiled decode step serves every active slot regardless of where
-  each sequence is (per-row lengths drive the paged attention mask and
-  per-row RoPE); shapes are static at max_batch, so XLA compiles ONCE
-- admission (add_request) prefills the new prompt into its slot's blocks
-  while other slots keep their state — prompts pad to a small set of
-  length buckets so prefill compiles stay bounded
-- eviction frees the slot's blocks back to the pool (models/paged_kv.py)
+1. **Token-budget mixed step.** Every step packs up to ``max_step_tokens``
+   lanes from a mix of decode slots (1 token each) and admitted-but-
+   unprefilled requests (prefill chunks of up to ``chunk_size`` tokens)
+   into a ``(token_ids, slot_ids, positions)`` pack consumed by one
+   jitted, donated program (models/llama_decode.py ``build_mixed_step``).
+   New requests join the running batch WITHOUT draining it, prompts never
+   pad to buckets, and the pack shape is fixed by the budget — XLA
+   compiles exactly once, so the recompile sentinel stays silent.
+2. **Radix prefix cache.** Full KV blocks are content-hashed at prefill
+   time (models/radix_cache.py); admission walks the new prompt down the
+   digest chain and maps every shared block read-only into the request's
+   block table (refcounts), so identical prompt prefixes neither recompute
+   nor re-store their KV. A block-aligned full hit re-runs only the last
+   prompt token — its write copy-on-writes the shared tail block
+   (the PR 1 CoW counters fire on exactly that path).
+3. **Scheduler policy + backpressure.** Prefill order is FCFS or
+   shortest-prefill-first; ``decode_priority`` bounds the prefill share of
+   each pack (the inter-token-latency lever of chunked prefill);
+   ``submit()`` blocks on a bounded admission queue and raises a typed
+   :class:`AdmissionTimeout` instead of waiting unboundedly.
 
-The scheduler here is deliberately minimal (greedy sampling, FIFO slots);
-it is the capability proof, not a production batch scheduler. submit()
-adds a host-side FIFO admission queue in front of the slots (add_request
-keeps the refuse-when-full contract), and the engine is instrumented with
-the paddle_tpu.monitor serving metrics — queue depth, batch occupancy,
-prefill/decode latency, tokens, evictions, TTFT (docs/observability.md) —
-plus, with span tracing on, a per-request trace tree (ONE trace id from
-admission to eviction: queue_wait/prefill/decode_step/evict spans, the
-TTFT decomposition; docs/tracing.md).
+:class:`StaticBatchEngine` keeps the OLD architecture — batch-synchronous
+waves, one bucket-padded compiled prefill per admission, lockstep decode —
+as the measured baseline the bench compares against (``bench.py`` serving
+block), at equal batch capacity.
+
+Instrumentation: the paddle_tpu.monitor serving metrics (queue depth,
+occupancy, pack fill, prefix-cache hits/misses/blocks-shared,
+chunked-prefill depth, TTFT — docs/observability.md) plus, with span
+tracing on, a per-request trace tree (ONE trace id from admission to
+eviction: queue_wait / prefill_chunk / prefill / decode_step / evict,
+and a per-step serving.pack_tokens span; docs/tracing.md).
 """
 from __future__ import annotations
 
 import collections
+import itertools
+import threading
+import time
 
 import numpy as np
 
@@ -36,12 +58,17 @@ import jax.numpy as jnp
 from . import paged_kv as _pk
 from ..analysis import sanitizers as _sanitizers
 from .llama_decode import LlamaDecodeEngine, _rms
+from .radix_cache import PrefixCache
 
-__all__ = ["ContinuousBatchingEngine"]
-
-import itertools
+__all__ = ["ContinuousBatchingEngine", "StaticBatchEngine",
+           "AdmissionTimeout"]
 
 _ENGINE_SEQ = itertools.count()
+
+
+class AdmissionTimeout(RuntimeError):
+    """submit() could not enqueue within the caller's timeout: the
+    admission queue stayed full (backpressure — shed load upstream)."""
 
 
 class _Mon:
@@ -50,8 +77,10 @@ class _Mon:
 
     __slots__ = ("mod", "state", "trace", "tstate", "queue_depth",
                  "occupancy", "prefill", "decode", "tokens", "evictions",
-                 "ttft", "admitted", "rejected", "jit_compiles", "jit_hits",
-                 "jit_sigs")
+                 "ttft", "admitted", "rejected", "adm_rejected",
+                 "pack", "chunk_depth", "pc_hits", "pc_misses", "pc_shared",
+                 "pc_blocks", "pc_evictions",
+                 "jit_compiles", "jit_hits", "jit_sigs")
 
 
 _MON = None
@@ -76,6 +105,19 @@ def _mon():
         o.ttft = m.histogram("paddle_tpu_serving_ttft_ns")
         o.admitted = m.counter("paddle_tpu_serving_admitted_total")
         o.rejected = m.counter("paddle_tpu_serving_rejected_total")
+        o.adm_rejected = m.counter(
+            "paddle_tpu_serving_admission_rejected_total")
+        o.pack = m.histogram("paddle_tpu_serving_pack_tokens")
+        o.chunk_depth = m.histogram(
+            "paddle_tpu_serving_chunked_prefill_depth")
+        o.pc_hits = m.counter("paddle_tpu_serving_prefix_cache_hits_total")
+        o.pc_misses = m.counter(
+            "paddle_tpu_serving_prefix_cache_misses_total")
+        o.pc_shared = m.counter(
+            "paddle_tpu_serving_prefix_blocks_shared_total")
+        o.pc_blocks = m.gauge("paddle_tpu_kv_prefix_cache_blocks")
+        o.pc_evictions = m.counter(
+            "paddle_tpu_kv_prefix_cache_evictions_total")
         o.jit_compiles = m.counter("paddle_tpu_jit_compiles_total",
                                    labelnames=("function",))
         o.jit_hits = m.counter("paddle_tpu_jit_cache_hits_total",
@@ -86,9 +128,706 @@ def _mon():
     return _MON
 
 
+class _Request:
+    """Host-side state of one admitted request (one slot)."""
+
+    __slots__ = ("rid", "prompt", "prefill_pos", "chunks", "shared_tokens",
+                 "max_new", "last_token", "outputs", "t_submit", "t_admit",
+                 "t_first")
+
+    def __init__(self, rid, prompt, max_new, t_submit):
+        self.rid = rid
+        self.prompt = prompt            # np.int32 (L,)
+        self.prefill_pos = 0            # prompt tokens already in KV
+        self.chunks = 0                 # prefill chunks consumed so far
+        self.shared_tokens = 0          # prompt tokens served by the cache
+        self.max_new = max_new          # per-request cap (None = step's)
+        self.last_token = 0
+        self.outputs = []
+        self.t_submit = t_submit
+        self.t_admit = 0
+        self.t_first = 0
+
+    @property
+    def prefilled(self):
+        return self.prefill_pos >= len(self.prompt)
+
+
 class ContinuousBatchingEngine:
-    """Slot-based continuous batching: requests join and leave the running
-    batch between steps; every step decodes all active slots at once."""
+    """Token-budget continuous batching: every step runs ONE fixed-shape
+    compiled program over a pack of decode lanes and chunked-prefill
+    lanes; requests join and leave between steps, shared prompt prefixes
+    ride the radix cache.
+
+    Threading contract: ``submit()`` is thread-safe (pure enqueue, any
+    number of producers). ``step()`` and ``add_request()`` mutate slot /
+    pager / cache state and belong to ONE driving thread."""
+
+    def __init__(self, model, max_batch=8, max_len=None, block_size=64,
+                 chunk_size=32, max_step_tokens=None, policy="fcfs",
+                 decode_priority=0.0, decode_burst=4, max_queue=None,
+                 prefix_cache=True, prefill_buckets=None):
+        """``max_step_tokens`` (default ``max_batch + chunk_size``) is the
+        per-step token budget: decode lanes first, prefill chunks fill the
+        remainder. ``policy`` orders prefill among admitted requests
+        ("fcfs" | "spf" = shortest-prefill-first). ``decode_priority`` in
+        [0, 1) additionally caps prefill at ``(1 - decode_priority) *
+        max_step_tokens`` lanes per step — raising it bounds the decode
+        latency a long prompt can add. ``decode_burst`` fuses up to that
+        many decode iterations into one dispatch via lax.scan when NO
+        prefill or admission work is pending (multi-step scheduling: the
+        per-dispatch overhead amortizes over burst tokens; admissions wait
+        at most one burst, and 1 disables it). ``max_queue`` bounds the
+        submit() admission queue (backpressure; None = unbounded).
+        ``prefill_buckets`` is accepted for backward compatibility and
+        ignored — chunked prefill replaced bucket-padded admission
+        prefills."""
+        del prefill_buckets  # legacy knob of the bucket-prefill engine
+        self._inner = LlamaDecodeEngine(model, max_len=max_len,
+                                        kv_cache_layout="paged",
+                                        block_size=block_size)
+        e = self._inner
+        self.max_batch = int(max_batch)
+        self.max_len = e.max_len
+        self.block_size = int(block_size)
+        self.chunk_size = int(chunk_size)
+        if self.chunk_size < 1:
+            raise ValueError("chunk_size must be >= 1")
+        self.max_step_tokens = int(max_step_tokens
+                                   or self.max_batch + self.chunk_size)
+        if self.max_step_tokens <= self.max_batch:
+            raise ValueError(
+                f"max_step_tokens ({self.max_step_tokens}) must exceed "
+                f"max_batch ({self.max_batch}): every active slot gets a "
+                "decode lane and prefill needs at least one more")
+        if policy not in ("fcfs", "spf"):
+            raise ValueError(f"unknown policy {policy!r} (fcfs | spf)")
+        self.policy = policy
+        self.decode_priority = float(decode_priority)
+        if not 0.0 <= self.decode_priority < 1.0:
+            raise ValueError("decode_priority must be in [0, 1)")
+        self.decode_burst = max(1, int(decode_burst))
+        self.max_queue = None if max_queue is None else int(max_queue)
+        max_blocks = -(-e.max_len // self.block_size)
+        self._pager = _pk.PagedKVCache(
+            num_layers=len(e.layers),
+            num_blocks=self.max_batch * max_blocks + 1,
+            block_size=self.block_size, kv_heads=e.num_kv,
+            head_dim=e.head_dim, batch=self.max_batch,
+            max_blocks_per_seq=max_blocks, dtype=e.emb.dtype)
+        self._pools = list(zip(self._pager.k, self._pager.v))
+        self.prefix_cache = PrefixCache(self._pager) if prefix_cache \
+            else None
+        # host-side slot state (numpy mirrors so pack assembly and
+        # capacity checks vectorize — the step's host tax is part of the
+        # serving hot path)
+        self.lens = np.zeros(self.max_batch, np.int64)  # tokens in cache
+        self._slots = [None] * self.max_batch           # _Request or None
+        self._active = np.zeros(self.max_batch, bool)
+        self._decode_ready = np.zeros(self.max_batch, bool)
+        self._last_tok = np.zeros(self.max_batch, np.int32)
+        # device lane vectors keyed by pack composition: in steady decode
+        # the composition repeats every step, so slot_ids/valid upload once
+        self._lane_cache = {}
+        self._next_rid = 0
+        self._jit_cache = {}
+        # graftsan label qualifier: compile budgets are PER ENGINE (ONE
+        # mixed-step program each); a process-wide label would falsely
+        # trip the sentinel on the second engine
+        self._san_tag = f"e{next(_ENGINE_SEQ)}"
+        # submit() queue (host-side); _submit_lock guards the bounded
+        # check+append only — nothing blocks and no jax dispatch runs
+        # under it (GL004)
+        self._pending = collections.deque()
+        self._submit_lock = threading.Lock()
+        # per-request trace trees (monitor.trace): rid -> [root, queue_wait]
+        self._req_spans = {}
+        # per-request stats kept for the caller (bench TTFT percentiles);
+        # popped via pop_stats, bounded so an indifferent caller can't leak
+        self._stats = collections.OrderedDict()
+
+    # -- compiled path -------------------------------------------------------
+    def _step_jit(self):
+        cache = self._jit_cache
+        mon = _mon()
+        if mon.state.on:
+            if "step" in cache:
+                mon.jit_hits.labels("serving.step").inc()
+            else:
+                mon.jit_compiles.labels("serving.step").inc()
+                mon.jit_sigs.labels("serving.step").set(1)
+        if "step" not in cache:
+            san = _sanitizers
+            if san._state.recompile:
+                # graftsan: the mixed step is ONE program by design — a
+                # second signature here is the recompile storm the token
+                # budget exists to prevent
+                san.note_compile(f"serving.step[{self._san_tag}]",
+                                 signature="step")
+            cache["step"] = jax.jit(self._inner.build_mixed_step(),
+                                    donate_argnums=(1,))
+        return cache["step"]
+
+    def _burst_jit(self):
+        cache = self._jit_cache
+        mon = _mon()
+        if mon.state.on:
+            if "burst" in cache:
+                mon.jit_hits.labels("serving.step").inc()
+            else:
+                mon.jit_compiles.labels("serving.step").inc()
+                mon.jit_sigs.labels("serving.step").set(2)
+        if "burst" not in cache:
+            san = _sanitizers
+            if san._state.recompile:
+                # the engine's SECOND (and last) program: burst size is a
+                # construction-time constant
+                san.note_compile(f"serving.step[{self._san_tag}]",
+                                 signature=("burst", self.decode_burst))
+            cache["burst"] = jax.jit(
+                self._inner.build_decode_burst(self.decode_burst),
+                donate_argnums=(1,))
+        return cache["burst"]
+
+    # -- admission -----------------------------------------------------------
+    def _check_prompt(self, prompt_ids):
+        prompt = np.asarray(getattr(prompt_ids, "value", prompt_ids),
+                            np.int32).reshape(-1)
+        L = len(prompt)
+        if L == 0 or L >= self.max_len:
+            raise ValueError(f"prompt length {L} out of range (1.."
+                             f"{self.max_len - 1})")
+        # a prompt whose KV can never fit the whole pool would otherwise
+        # head-of-line-block the admission queue forever — refuse it up
+        # front, at the caller
+        need = -(-(L + 1) // self.block_size)
+        if need > self._pager.num_blocks - 1:  # block 0 is the null block
+            raise ValueError(
+                f"prompt needs {need} KV blocks but the pool only has "
+                f"{self._pager.num_blocks - 1}")
+        return prompt
+
+    def add_request(self, prompt_ids, max_new_tokens=None):
+        """Admit one prompt into a free slot; returns the request id (or
+        None when the batch is full — callers queue and retry, or use
+        submit() which queues host-side). The prompt's KV is built by
+        chunked prefill inside subsequent step() packs; the first token
+        arrives from the step that consumes the last prompt token."""
+        prompt = self._check_prompt(prompt_ids)
+        mon = _mon()
+        self._drain_pending()
+        slot = self._free_slot()
+        if slot is None:
+            if mon.state.on:
+                mon.rejected.inc()
+            return None
+        with self._submit_lock:
+            # rid minting shares the counter with producer-thread
+            # submit()s — unlocked, two requests could get one id
+            rid = self._next_rid
+            self._next_rid += 1
+        req = _Request(rid, prompt, max_new_tokens, mon.mod.now_ns())
+        self._admit(slot, req)
+        return rid
+
+    def submit(self, prompt_ids, max_new_tokens=None, timeout=None):
+        """Always-queueing admission: the request waits host-side until
+        the DRIVING thread's next step() (or add_request()) assigns it a
+        free slot, then prefills chunk-by-chunk inside step packs.
+        Returns the request id (TTFT measures queue wait + chunked
+        prefill). submit() is the engine's one thread-safe entry point —
+        it only enqueues, never touching slot state, so any number of
+        producer threads may call it while one thread drives step().
+        With a bounded queue (``max_queue``), a full queue raises
+        :class:`AdmissionTimeout` — immediately when ``timeout`` is None,
+        else after blocking up to ``timeout`` seconds for the stepping
+        thread to drain space."""
+        prompt = self._check_prompt(prompt_ids)
+        mon = _mon()
+        t_submit = mon.mod.now_ns()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._submit_lock:
+                if self.max_queue is None \
+                        or len(self._pending) < self.max_queue:
+                    rid = self._next_rid
+                    self._next_rid += 1
+                    req = _Request(rid, prompt, max_new_tokens, t_submit)
+                    if mon.tstate.on:
+                        root = mon.trace.start_span("serving.request",
+                                                    attrs={"rid": rid})
+                        self._req_spans[rid] = [
+                            root, mon.trace.start_span("serving.queue_wait",
+                                                       parent=root)]
+                    self._pending.append(req)
+                    break
+            if deadline is None or time.monotonic() >= deadline:
+                if mon.state.on:
+                    mon.adm_rejected.inc()
+                raise AdmissionTimeout(
+                    f"admission queue full ({self.max_queue} requests)"
+                    + ("" if timeout is None
+                       else f" after {timeout}s wait"))
+            time.sleep(0.0005)   # poll; the lock is NEVER held while waiting
+        # NO _drain_pending here: admission mutates slot/pager/cache state
+        # and belongs to the driving thread alone — a concurrent drain
+        # from here could hand two requests the same slot
+        if mon.state.on:
+            self._update_gauges(mon)
+        return rid
+
+    def _free_slot(self):
+        for b in range(self.max_batch):
+            if self._slots[b] is None:
+                return b
+        return None
+
+    def _pop_pending(self):
+        """Next queued request per the admission policy (fcfs | spf)."""
+        with self._submit_lock:
+            if not self._pending:
+                return None
+            if self.policy == "spf":
+                req = min(self._pending, key=lambda r: len(r.prompt))
+                self._pending.remove(req)
+                return req
+            return self._pending.popleft()
+
+    def _drain_pending(self):
+        """Assign queued requests to free slots (no compute here: the
+        prompt KV is built by chunked prefill inside step packs). Driving
+        thread only — see the class threading contract."""
+        while True:
+            slot = self._free_slot()
+            if slot is None:
+                return
+            req = self._pop_pending()
+            if req is None:
+                return
+            self._admit(slot, req)
+
+    def _admit(self, slot, req):
+        mon = _mon()
+        req.t_admit = mon.mod.now_ns()
+        L = len(req.prompt)
+        if req.rid not in self._req_spans and mon.tstate.on:
+            # add_request path: the root opens at admission (no queue wait)
+            self._req_spans[req.rid] = [
+                mon.trace.start_span("serving.request",
+                                     attrs={"rid": req.rid}), None]
+        entry = self._req_spans.get(req.rid)
+        if entry is not None and entry[1] is not None:
+            mon.trace.end_span(entry[1], t1_ns=req.t_admit)
+            entry[1] = None
+        # radix descent: map every cached prefix block read-only into the
+        # new request's table; a FULL (block-aligned) hit still re-runs
+        # the last prompt token for its logits — that single write
+        # copy-on-writes the shared tail block
+        if self.prefix_cache is not None:
+            blocks, shared = self.prefix_cache.match(req.prompt)
+            if blocks:
+                self._pager.adopt_blocks(slot, blocks)
+                req.shared_tokens = shared
+                req.prefill_pos = min(shared, L - 1)
+            if mon.state.on:
+                (mon.pc_hits if blocks else mon.pc_misses).inc()
+                if blocks:
+                    mon.pc_shared.inc(len(blocks))
+        self.lens[slot] = req.prefill_pos
+        self._slots[slot] = req
+        self._active[slot] = True
+        self._decode_ready[slot] = False
+        self._stats[req.rid] = {
+            "rid": req.rid, "slot": slot, "prompt_len": L,
+            "shared_tokens": req.shared_tokens, "submit_ns": req.t_submit}
+        if len(self._stats) > 4096:
+            self._stats.popitem(last=False)
+        if mon.state.on:
+            mon.admitted.inc()
+            self._update_gauges(mon)
+
+    def pop_stats(self, rid):
+        """Per-request stats (ttft_ns, prefill chunks, shared prefix
+        tokens), retained until popped — the bench reads TTFT percentiles
+        from here after each eviction."""
+        return self._stats.pop(rid, None)
+
+    # -- the mixed step ------------------------------------------------------
+    def step(self, eos_token_id=None, max_new_tokens=None):
+        """ONE compiled mixed step: every prefilled slot decodes one
+        token; admitted-but-unprefilled slots consume prefill chunks from
+        the remaining token budget. Returns the finished
+        (request_id, tokens) pairs evicted this step."""
+        san = _sanitizers
+        if san._state.hostsync:
+            # graftsan: the step is device-resident by contract (GL002) —
+            # a Tensor host sync inside it is a regression the tripwire
+            # turns into an immediate raise
+            with san.protected_region("serving.step"):
+                return self._step_impl(eos_token_id, max_new_tokens)
+        return self._step_impl(eos_token_id, max_new_tokens)
+
+    def _ensure(self, need):
+        """ensure_capacity with radix-cache relief: pool exhaustion evicts
+        exactly the LRU cache-only blocks the grant is short of, then
+        retries once (blocks mapped into live requests are never taken)."""
+        try:
+            self._pager.ensure_capacity(need)
+            return
+        except RuntimeError:
+            if self.prefix_cache is None or not len(self.prefix_cache):
+                raise
+        pager = self._pager
+        owned = (pager._tables_np > 0).sum(axis=1)
+        want = -(-np.maximum(np.asarray(need, np.int64), 0)
+                 // self.block_size)
+        shortfall = int(np.maximum(want - owned, 0).sum()) \
+            - len(pager._free)
+        mon = _mon()
+        freed = self.prefix_cache.evict(max(shortfall, 1))
+        if mon.state.on and freed:
+            mon.pc_evictions.inc(freed)
+            mon.pc_blocks.set(len(self.prefix_cache))
+        self._pager.ensure_capacity(need)
+
+    def _step_impl(self, eos_token_id, max_new_tokens):
+        mon = _mon()
+        self._drain_pending()
+        if not self._active.any():
+            if mon.state.on:
+                self._update_gauges(mon)
+            return []
+        t0 = mon.mod.now_ns()
+        T = self.max_step_tokens
+        decode_slots = np.flatnonzero(self._decode_ready)
+        prefill_slots = np.flatnonzero(self._active
+                                       & ~self._decode_ready).tolist()
+        K = self.decode_burst
+        if K > 1 and not prefill_slots and len(decode_slots) \
+                and (self.lens[decode_slots] + K < self.max_len).all() \
+                and self._burst_useful(decode_slots, K, max_new_tokens):
+            # steady state: no prefill work in the batch — fuse K decode
+            # iterations into one dispatch (multi-step scheduling: the
+            # per-dispatch overhead amortizes K-fold). Queued requests
+            # lose nothing: _drain_pending just ran, so a non-empty queue
+            # means no slot is free until an eviction anyway.
+            need = np.where(self._active, self.lens, 0)
+            need[decode_slots] += K
+            self._ensure(need)
+            # every position the burst will write must target an
+            # UNSHARED block — CoW runs outside compiled code, so a
+            # shared write target forces the single-step path for this
+            # step (its per-position CoW handles it)
+            t = self._pager._tables_np
+            first = self.lens[decode_slots] // self.block_size
+            last = (self.lens[decode_slots] + K - 1) // self.block_size
+            targets = np.concatenate(
+                [t[b, f:g + 1] for b, f, g in
+                 zip(decode_slots, first, last)])
+            if not (self._pager._refs[targets] > 1).any():
+                return self._burst_impl(decode_slots, eos_token_id,
+                                        max_new_tokens, mon, t0)
+        if self.policy == "spf":
+            prefill_slots.sort(key=lambda b: (
+                len(self._slots[b].prompt) - self._slots[b].prefill_pos,
+                self._slots[b].rid))
+        else:
+            prefill_slots.sort(key=lambda b: self._slots[b].rid)
+        nd = len(decode_slots)
+        budget = T - nd
+        if self.decode_priority > 0.0:
+            # bound the prefill share of the pack, but never starve it to
+            # zero — an all-prefill engine must still make progress
+            budget = min(budget, max(1, int((1.0 - self.decode_priority)
+                                            * T)))
+        # capacity grants: decode slots MUST proceed; a prefill chunk that
+        # cannot get blocks (even after cache eviction) waits a step
+        need = np.where(self._active, self.lens, 0)
+        need[decode_slots] += 1
+        self._ensure(need)
+        chunks = []                     # (slot, start, take)
+        for b in prefill_slots:
+            if budget <= 0:
+                break
+            req = self._slots[b]
+            take = min(len(req.prompt) - req.prefill_pos, self.chunk_size,
+                       budget)
+            trial = need.copy()
+            trial[b] = req.prefill_pos + take
+            try:
+                self._ensure(trial)
+            except RuntimeError:
+                continue                # waits for evictions to free blocks
+            need = trial
+            chunks.append((b, req.prefill_pos, take))
+            budget -= take
+        if not nd and not chunks:
+            # admitted requests exist but nothing can make progress (pool
+            # fully pinned by live sequences) — surface it, the caller
+            # sized the pool too small for the batch
+            raise RuntimeError(
+                "serving step cannot pack any lane: paged KV pool "
+                "exhausted with no evictable prefix-cache blocks")
+        # pack assembly (vectorized — this runs every step): decode lanes
+        # first, then prefill chunks. tok_ids/positions ride ONE (2, T)
+        # upload; a fresh array each step so the async transfer never
+        # races a host-side reuse
+        pack_np = np.zeros((2, T), np.int32)
+        tok_ids, positions = pack_np[0], pack_np[1]
+        tok_ids[:nd] = self._last_tok[decode_slots]
+        positions[:nd] = self.lens[decode_slots]
+        lane = nd
+        emit_lanes = {}                 # slot -> lane of its LAST prompt tok
+        for b, start, take in chunks:
+            req = self._slots[b]
+            tok_ids[lane:lane + take] = req.prompt[start:start + take]
+            positions[lane:lane + take] = np.arange(start, start + take)
+            if start + take == len(req.prompt):
+                emit_lanes[b] = lane + take - 1
+            lane += take
+        n_lanes = lane
+        # copy-on-write: any lane writing into a SHARED block (prefix-
+        # cache full hits, beam-style forks) gets a private copy first;
+        # the all-refs<=1 guard keeps the unshared steady state free
+        if (self._pager._refs > 1).any():
+            rows = np.empty(n_lanes, np.int64)
+            rows[:nd] = decode_slots
+            lane = nd
+            for b, _start, take in chunks:
+                rows[lane:lane + take] = b
+                lane += take
+            try:
+                self._pools = self._pager.make_positions_exclusive(
+                    rows, positions[:n_lanes], self._pools)
+            except _pk.CowPoolExhausted as e:
+                # copies made before the pool ran dry ARE applied and the
+                # donated-in buffers were consumed — adopt the exception's
+                # replacement pools, hand cache-only blocks back, retry
+                self._pools = e.pools
+                if self.prefix_cache is None \
+                        or not len(self.prefix_cache):
+                    raise
+                freed = self.prefix_cache.evict(n_lanes)
+                if mon.state.on and freed:
+                    mon.pc_evictions.inc(freed)
+                    mon.pc_blocks.set(len(self.prefix_cache))
+                try:
+                    self._pools = self._pager.make_positions_exclusive(
+                        rows, positions[:n_lanes], self._pools)
+                except _pk.CowPoolExhausted as e2:
+                    # the retry donates buffers too: adopt its replacement
+                    # before propagating, or the engine is left holding
+                    # consumed device arrays
+                    self._pools = e2.pools
+                    raise
+        # slot-id/valid lane vectors depend only on the pack COMPOSITION,
+        # which repeats every step in steady decode — reuse the uploaded
+        # device copies instead of re-transferring them
+        key = (decode_slots.tobytes(),
+               tuple((b, take) for b, _s, take in chunks))
+        cached = self._lane_cache.get(key)
+        if cached is None:
+            slot_np = np.zeros(T, np.int32)
+            valid_np = np.zeros(T, bool)
+            slot_np[:nd] = decode_slots
+            lane = nd
+            for b, _start, take in chunks:
+                slot_np[lane:lane + take] = b
+                lane += take
+            valid_np[:n_lanes] = True
+            cached = (jnp.asarray(slot_np), jnp.asarray(valid_np))
+            if len(self._lane_cache) > 256:
+                self._lane_cache.clear()
+            self._lane_cache[key] = cached
+        slots_dev, valid_dev = cached
+        if mon.tstate.on:
+            mon.trace.record_span(
+                "serving.pack_tokens", t0, mon.mod.now_ns(),
+                attrs={"n_decode": nd, "n_prefill": n_lanes - nd,
+                       "budget": T})
+        step = self._step_jit()
+        toks_dev, self._pools = step(
+            jnp.asarray(pack_np), self._pools, self._pager.block_tables,
+            slots_dev, valid_dev)
+        toks = np.asarray(toks_dev)
+        t1 = mon.mod.now_ns()
+        if mon.tstate.on:
+            for b in decode_slots:
+                entry = self._req_spans.get(self._slots[b].rid)
+                if entry is not None:
+                    mon.trace.record_span(
+                        "serving.decode_step", t0, t1, parent=entry[0],
+                        attrs={"slot": int(b), "n_active": nd})
+            for b, start, take in chunks:
+                entry = self._req_spans.get(self._slots[b].rid)
+                if entry is not None:
+                    mon.trace.record_span(
+                        "serving.prefill_chunk", t0, t1, parent=entry[0],
+                        attrs={"slot": int(b), "start": start,
+                               "tokens": take})
+        # route decode results
+        finished = []
+        emitted = 0
+        for i, b in enumerate(decode_slots):
+            self.lens[b] += 1
+            emitted += 1
+            self._note_token(b, int(toks[i]), eos_token_id, max_new_tokens,
+                             finished, mon, t1)
+        # route prefill progress (+ first tokens of completed prefills)
+        for b, start, take in chunks:
+            req = self._slots[b]
+            req.prefill_pos = start + take
+            req.chunks += 1
+            self.lens[b] = req.prefill_pos
+            if self.prefix_cache is not None:
+                n = self.prefix_cache.register(
+                    req.prompt, req.prefill_pos, self._pager._tables_np[b])
+                if mon.state.on and n:
+                    mon.pc_blocks.set(len(self.prefix_cache))
+            if req.prefilled:
+                req.t_first = t1
+                self._decode_ready[b] = True
+                emitted += 1
+                st = self._stats.get(req.rid)
+                if st is not None:
+                    st["ttft_ns"] = t1 - req.t_submit
+                    st["prefill_chunks"] = req.chunks
+                if mon.state.on:
+                    mon.ttft.observe(t1 - req.t_submit)
+                    mon.prefill.observe(t1 - req.t_admit)
+                    mon.chunk_depth.observe(req.chunks)
+                entry = self._req_spans.get(req.rid)
+                if entry is not None:
+                    mon.trace.record_span(
+                        "serving.prefill", req.t_admit, t1,
+                        parent=entry[0],
+                        attrs={"slot": int(b),
+                               "prompt_len": len(req.prompt),
+                               "chunks": req.chunks,
+                               "shared_tokens": req.shared_tokens})
+                self._note_token(b, int(toks[emit_lanes[b]]), eos_token_id,
+                                 max_new_tokens, finished, mon, t1)
+        if mon.state.on:
+            mon.decode.observe(t1 - t0)
+            mon.tokens.inc(emitted)
+            mon.pack.observe(n_lanes)
+            self._update_gauges(mon)
+            mon.mod.sample()   # chrome-trace counter timeline, per step
+        return finished
+
+    def _burst_useful(self, decode_slots, K, max_new_tokens):
+        """Worth bursting only when at least half the fused lanes would
+        emit kept tokens — slots at the edge of their max_new budget (or
+        requests queued behind an imminent eviction) prefer the
+        single-step path's per-token scheduling."""
+        useful = 0
+        for b in decode_slots:
+            req = self._slots[b]
+            limit = req.max_new if req.max_new is not None \
+                else max_new_tokens
+            useful += K if limit is None \
+                else min(K, max(limit - len(req.outputs), 0))
+        return 2 * useful >= K * len(decode_slots)
+
+    def _burst_impl(self, decode_slots, eos_token_id, max_new_tokens,
+                    mon, t0):
+        """Steady-state fast path: K fused decode iterations, one
+        dispatch, one (2, B) upload, one (B, K) download."""
+        K = self.decode_burst
+        pack = np.empty((2, self.max_batch), np.int32)
+        pack[0] = self._last_tok
+        pack[1] = self.lens
+        toks_dev, self._pools = self._burst_jit()(
+            jnp.asarray(pack), self._pools, self._pager.block_tables)
+        toks = np.asarray(toks_dev)            # (B, K)
+        t1 = mon.mod.now_ns()
+        nd = len(decode_slots)
+        if mon.tstate.on:
+            for b in decode_slots:
+                entry = self._req_spans.get(self._slots[b].rid)
+                if entry is not None:
+                    mon.trace.record_span(
+                        "serving.decode_step", t0, t1, parent=entry[0],
+                        attrs={"slot": int(b), "n_active": nd,
+                               "burst": K})
+        finished = []
+        emitted = 0
+        for b in decode_slots:
+            for i in range(K):
+                if self._slots[b] is None:
+                    break               # finished mid-burst: the rest of
+                self.lens[b] += 1       # its lane is discarded
+                emitted += 1
+                self._note_token(b, int(toks[b, i]), eos_token_id,
+                                 max_new_tokens, finished, mon, t1)
+        if mon.state.on:
+            mon.decode.observe(t1 - t0)
+            mon.tokens.inc(emitted)
+            self._update_gauges(mon)
+            mon.mod.sample()
+        return finished
+
+    def _note_token(self, slot, tok, eos_token_id, max_new_tokens,
+                    finished, mon, t_now):
+        req = self._slots[slot]
+        req.outputs.append(tok)
+        req.last_token = tok
+        self._last_tok[slot] = tok
+        limit = req.max_new if req.max_new is not None else max_new_tokens
+        done = (eos_token_id is not None and tok == eos_token_id) \
+            or (limit is not None and len(req.outputs) >= limit) \
+            or self.lens[slot] + 1 >= self.max_len
+        if done:
+            finished.append((req.rid, list(req.outputs)))
+            self._evict(slot, t_now)
+
+    def _evict(self, slot, t0=None):
+        mon = _mon()
+        req = self._slots[slot]
+        entry = self._req_spans.pop(req.rid, None)
+        t0 = t0 or (mon.mod.now_ns() if entry is not None else 0)
+        st = self._stats.get(req.rid)
+        if st is not None:
+            st["tokens"] = len(req.outputs)
+        self._pager.free_sequence(slot)
+        self._slots[slot] = None
+        self._active[slot] = False
+        self._decode_ready[slot] = False
+        self.lens[slot] = 0
+        if entry is not None:
+            t1 = mon.mod.now_ns()
+            mon.trace.drop(entry[1])   # only open if tracing toggled off
+            mon.trace.record_span("serving.evict", t0, t1, parent=entry[0],
+                                  attrs={"slot": slot,
+                                         "tokens": len(req.outputs)})
+            mon.trace.end_span(entry[0], t1_ns=t1)   # request tree complete
+        if mon.state.on:
+            mon.evictions.inc()
+            self._update_gauges(mon)
+
+    def _update_gauges(self, mon):
+        mon.queue_depth.set(len(self._pending))
+        mon.occupancy.set(float(self._active.sum()) / self.max_batch)
+
+    @property
+    def num_active(self):
+        return int(self._active.sum())
+
+    @property
+    def num_pending(self):
+        return len(self._pending)
+
+
+class StaticBatchEngine:
+    """The batch-synchronous BASELINE the continuous engine is measured
+    against (bench.py serving block), at equal batch capacity: admit a
+    full wave of requests, prefill each prompt as its own bucket-padded
+    compiled call, decode every wave slot in lockstep until the LAST
+    request of the wave finishes, then evict all and admit the next wave.
+    This is the pre-chunked-prefill architecture — a request arriving
+    mid-wave waits for the whole wave to drain, early finishers burn
+    decode lanes until the wave's longest request completes, and every
+    prompt pays bucket padding."""
 
     def __init__(self, model, max_batch=8, max_len=None, block_size=64,
                  prefill_buckets=(32, 64, 128, 256, 512, 1024, 2048)):
@@ -108,60 +847,30 @@ class ContinuousBatchingEngine:
             block_size=self.block_size, kv_heads=e.num_kv,
             head_dim=e.head_dim, batch=self.max_batch,
             max_blocks_per_seq=max_blocks, dtype=e.emb.dtype)
-        self._pools = list(zip(self._pager.k, self._pager.v))  # bf16 layout
-        # host-side slot state
-        self.lens = np.zeros(self.max_batch, np.int64)     # tokens in cache
-        self.active = np.zeros(self.max_batch, bool)
-        self.request_ids = [None] * self.max_batch
-        self.last_token = np.zeros((self.max_batch, 1), np.int32)
-        self.outputs = [[] for _ in range(self.max_batch)]
+        self._pools = list(zip(self._pager.k, self._pager.v))
+        self.lens = np.zeros(self.max_batch, np.int64)
+        self._slots = [None] * self.max_batch
+        self._done = np.zeros(self.max_batch, bool)
+        self._pending = collections.deque()
         self._next_rid = 0
         self._jit_cache = {}
-        # graftsan label qualifier: compile budgets are PER ENGINE (each
-        # instance's prefill compiles are bucket-bounded); a process-wide
-        # label would falsely trip the sentinel on the second engine
         self._san_tag = f"e{next(_ENGINE_SEQ)}"
-        # submit() queue: requests waiting for a free slot (host-side)
-        self._pending = collections.deque()
-        # per-request trace trees (monitor.trace): rid -> [root, queue_wait]
-        # — ONE trace id per request, root open from submit/add_request
-        # until eviction; bounded by max_batch + queue depth
-        self._req_spans = {}
-        # device-resident decode inputs: between admissions/evictions the
-        # step feeds back its own device outputs (tokens) and increments
-        # lens on device, so steady-state decoding does ZERO host→device
-        # uploads per token (GL002); the host arrays above stay the source
-        # of truth and re-seed the device copies whenever slot state
-        # changes (_host_dirty)
-        self._host_dirty = True
-        self._tok_dev = None
-        self._lens_dev = None
-        self._active_dev = None
+        self._stats = collections.OrderedDict()
 
-    # -- compiled paths ------------------------------------------------------
+    # -- compiled paths (the legacy shapes: per-bucket prefill + lockstep
+    #    ragged decode) -------------------------------------------------------
     def _prefill_slot_jit(self, bucket):
         e = self._inner
         key = ("prefill", bucket)
         cache = self._jit_cache
-        mon = _mon()
-        if mon.state.on:
-            if key in cache:
-                mon.jit_hits.labels("serving.prefill").inc()
-            else:
-                mon.jit_compiles.labels("serving.prefill").inc()
         if key not in cache:
             san = _sanitizers
             if san._state.recompile:
-                # graftsan: prefill compiles are bounded by the bucket list
-                # BY DESIGN; an unbounded stream of new buckets here is the
-                # recompile storm the buckets exist to prevent
+                # bounded by the bucket list BY DESIGN
                 san.note_compile(f"serving.prefill[{self._san_tag}]",
                                  signature=key)
 
             def run(ids, pools, row_tables, length):
-                # ids: (1, bucket) padded prompt; only `length` rows are
-                # real — causal masking keeps padding out of real rows'
-                # attention, and paged_write_prefill drops padded writes
                 x = e.emb[ids]
                 lens1 = jnp.asarray([length], jnp.int32)
                 new_pools = []
@@ -171,27 +880,15 @@ class ContinuousBatchingEngine:
                     new_pools.append(pool)
                 x = _rms(x, e.norm_w, e.eps)
                 logits = x @ e.head_w
-                # argmax INSIDE the program: admission transfers one int32
-                # to host, not a vocab-size logits row (GL002 host-sync)
                 tok = jnp.argmax(logits[0, length - 1], -1)
                 return tok.astype(jnp.int32), new_pools
 
             cache[key] = jax.jit(run, donate_argnums=(1,))
-            if mon.state.on:
-                mon.jit_sigs.labels("serving.prefill").set(
-                    sum(1 for k in cache if k != "step"))
         return cache[key]
 
     def _step_all_jit(self):
         e = self._inner
         cache = self._jit_cache
-        mon = _mon()
-        if mon.state.on:
-            if "step" in cache:
-                mon.jit_hits.labels("serving.decode_step").inc()
-            else:
-                mon.jit_compiles.labels("serving.decode_step").inc()
-                mon.jit_sigs.labels("serving.decode_step").set(1)
         if "step" not in cache:
             san = _sanitizers
             if san._state.recompile:
@@ -199,8 +896,6 @@ class ContinuousBatchingEngine:
                                  signature="step")
 
             def run(tokens, pools, tables, lens):
-                # tokens (B, 1); lens (B,) per-row positions — ragged:
-                # _block_paged_decode ropes/writes/attends at lens[b]
                 x = e.emb[tokens]
                 new_pools = []
                 for p, pool in zip(e.layers, pools):
@@ -213,269 +908,129 @@ class ContinuousBatchingEngine:
             cache["step"] = jax.jit(run, donate_argnums=(1,))
         return cache["step"]
 
-    # -- admission / eviction ------------------------------------------------
-    def _check_prompt(self, prompt_ids):
+    # -- API (mirrors the continuous engine's driving surface) ---------------
+    def submit(self, prompt_ids, max_new_tokens=None):
         prompt = np.asarray(getattr(prompt_ids, "value", prompt_ids),
                             np.int32).reshape(-1)
         L = len(prompt)
         if L == 0 or L >= self.max_len:
             raise ValueError(f"prompt length {L} out of range (1.."
                              f"{self.max_len - 1})")
-        # a prompt whose KV can never fit the whole pool would otherwise
-        # head-of-line-block the submit() queue forever (retried each step,
-        # never admittable) — refuse it up front, at the caller
-        need = -(-(L + 1) // self.block_size)
-        if need > self._pager.num_blocks - 1:  # block 0 is the null block
-            raise ValueError(
-                f"prompt needs {need} KV blocks but the pool only has "
-                f"{self._pager.num_blocks - 1}")
-        return prompt
-
-    def add_request(self, prompt_ids):
-        """Admit one prompt into a free slot; returns the request id (or
-        None when the batch is full — callers queue and retry, or use
-        submit() which queues host-side). Older submit()ed requests keep
-        FIFO priority: they are drained into free slots first."""
-        prompt = self._check_prompt(prompt_ids)
-        mon = _mon()
-        self._drain_pending()
-        free = np.flatnonzero(~self.active)
-        if not len(free):
-            if mon.state.on:
-                mon.rejected.inc()
-            return None
         rid = self._next_rid
         self._next_rid += 1
-        t_submit = mon.mod.now_ns()
-        slot = int(free[0])
-        try:
-            self._admit(slot, prompt, rid, t_submit)
-        except Exception:
-            if not self.active[slot]:
-                # undo any partial block grant the failed prefill made (and
-                # re-sync the device table copy)
-                self._pager.free_sequence(slot)
-            # add_request has no retry: abandon the trace tree _admit
-            # opened, or every failed call leaks an open root span
-            entry = self._req_spans.pop(rid, None)
-            if entry is not None:
-                mon.trace.drop(entry[1])
-                mon.trace.drop(entry[0])
-            raise
+        req = _Request(rid, prompt, max_new_tokens,
+                       time.perf_counter_ns())
+        self._pending.append(req)
+        self._stats[rid] = {"rid": rid, "prompt_len": L,
+                            "submit_ns": req.t_submit}
+        if len(self._stats) > 4096:
+            self._stats.popitem(last=False)
         return rid
 
-    def submit(self, prompt_ids):
-        """Always-accepting admission: the prompt is prefilled into a free
-        slot immediately when one exists, otherwise it waits in the
-        host-side queue and is admitted at the start of a later step().
-        Returns the request id right away (TTFT measures queue wait +
-        prefill)."""
-        prompt = self._check_prompt(prompt_ids)
-        mon = _mon()
-        rid = self._next_rid
-        self._next_rid += 1
-        if mon.tstate.on:
-            root = mon.trace.start_span("serving.request",
-                                        attrs={"rid": rid})
-            self._req_spans[rid] = [
-                root, mon.trace.start_span("serving.queue_wait", parent=root)]
-        self._pending.append((rid, prompt, mon.mod.now_ns()))
-        self._drain_pending()
-        if mon.state.on:
-            self._update_gauges(mon)
-        return rid
+    def pop_stats(self, rid):
+        return self._stats.pop(rid, None)
 
-    def _drain_pending(self):
-        """Admit queued requests into free slots, oldest first. NEVER
-        raises for a queued request: submit()/add_request/step() callers
-        must not receive a different request's failure. A transient
-        admission failure (KV pool exhausted while sequences still hold
-        blocks) keeps the request at the head — evictions free blocks and
-        a later drain retries. A failure with nothing active can never
-        resolve by retrying, so the request is dropped with a warning and
-        a rejection count."""
-        while self._pending:
-            free = np.flatnonzero(~self.active)
-            if not len(free):
-                return
-            rid, prompt, t_submit = self._pending[0]
-            slot = int(free[0])
-            try:
-                self._admit(slot, prompt, rid, t_submit)
-            except Exception as e:  # noqa: BLE001
-                if not self.active[slot]:
-                    # undo any partial block grant the failed prefill made
-                    self._pager.free_sequence(slot)
-                if self.active.any():
-                    return          # retry once evictions free blocks
-                self._pending.popleft()
-                mon = _mon()
-                entry = self._req_spans.pop(rid, None)
-                if entry is not None:
-                    # dropped before admission: abandon the open tree
-                    mon.trace.drop(entry[1])
-                    mon.trace.drop(entry[0])
-                if mon.state.on:
-                    mon.rejected.inc()
-                import warnings
-
-                warnings.warn(
-                    f"serving: dropping queued request {rid} — admission "
-                    f"failed with no active sequences to free resources "
-                    f"({type(e).__name__}: {e})", stacklevel=3)
-                continue            # the next request may still fit
-            self._pending.popleft()
-
-    def _admit(self, slot, prompt, rid, t_submit):
-        mon = _mon()
-        t0 = mon.mod.now_ns()
-        if mon.tstate.on and rid not in self._req_spans:
-            # add_request path: the request root opens at admission (no
-            # queue wait — admission was immediate by contract)
-            self._req_spans[rid] = [
-                mon.trace.start_span("serving.request", attrs={"rid": rid}),
-                None]
-        entry = self._req_spans.get(rid)
-        L = len(prompt)
-        bucket = next(b for b in self._buckets if b >= L) \
-            if L <= self._buckets[-1] else self.max_len
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :L] = prompt
-        # grant for ACTIVE slots + the admitted one only — lens_next+1 over
-        # every idle slot would park a block on each of them indefinitely
-        need = np.where(self.active, self.lens + 1, 0)
-        need[slot] = L + 1
-        self._pager.ensure_capacity(need)
-        row_tables = self._pager.block_tables[slot:slot + 1]
-        tok_dev, self._pools = self._prefill_slot_jit(bucket)(
-            jnp.asarray(padded), self._pools, row_tables,
-            jnp.asarray(L, jnp.int32))
-        tok = int(tok_dev)
-        self.active[slot] = True
-        self.lens[slot] = L
-        self.request_ids[slot] = rid
-        self.last_token[slot, 0] = tok
-        self.outputs[slot] = [tok]
-        self._host_dirty = True
-        if mon.state.on or mon.tstate.on:
-            t1 = mon.mod.now_ns()
-            if entry is not None:
-                if entry[1] is not None:
-                    # queue wait ends at the start of the SUCCESSFUL
-                    # admission attempt (a failed transient attempt keeps
-                    # it open: the request was still waiting), so
-                    # queue_wait + prefill sums to the request's TTFT
-                    mon.trace.end_span(entry[1], t1_ns=t0)
-                    entry[1] = None
-                mon.trace.record_span(
-                    "serving.prefill", t0, t1, parent=entry[0],
-                    attrs={"slot": slot, "prompt_len": L, "bucket": bucket})
-            if mon.state.on:
-                mon.admitted.inc()
-                mon.tokens.inc()        # the prefill's first token
-                mon.prefill.observe(t1 - t0)
-                mon.ttft.observe(t1 - t_submit)
-                self._update_gauges(mon)
+    def _admit_wave(self):
+        for b in range(self.max_batch):
+            if not self._pending:
+                break
+            req = self._pending.popleft()
+            L = len(req.prompt)
+            bucket = next((k for k in self._buckets if k >= L),
+                          self.max_len)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :L] = req.prompt
+            need = np.where([s is not None for s in self._slots],
+                            self.lens + 1, 0)
+            need[b] = L + 1
+            self._pager.ensure_capacity(need)
+            row_tables = self._pager.block_tables[b:b + 1]
+            tok_dev, self._pools = self._prefill_slot_jit(bucket)(
+                jnp.asarray(padded), self._pools, row_tables,
+                jnp.asarray(L, jnp.int32))
+            tok = int(tok_dev)
+            req.prefill_pos = L
+            req.last_token = tok
+            req.outputs = [tok]
+            req.t_first = time.perf_counter_ns()
+            self._slots[b] = req
+            self.lens[b] = L
+            self._done[b] = False
+            st = self._stats.get(req.rid)
+            if st is not None:
+                st["ttft_ns"] = req.t_first - req.t_submit
+                st["tokens"] = 1
 
     def step(self, eos_token_id=None, max_new_tokens=None):
-        """One decode step for EVERY active slot. Queued submit() requests
-        are admitted into free slots first. Returns the list of finished
-        (request_id, tokens) pairs evicted this step."""
-        san = _sanitizers
-        if san._state.hostsync:
-            # graftsan: the decode loop is device-resident by contract
-            # (GL002) — a Tensor host sync inside it is a regression the
-            # tripwire turns into an immediate raise
-            with san.protected_region("serving.step"):
-                return self._step_impl(eos_token_id, max_new_tokens)
-        return self._step_impl(eos_token_id, max_new_tokens)
-
-    def _step_impl(self, eos_token_id, max_new_tokens):
-        mon = _mon()
-        self._drain_pending()
-        if not self.active.any():
-            if mon.state.on:
-                self._update_gauges(mon)
-            return []
-        t0 = mon.mod.now_ns()
-        n_decoded = int(self.active.sum())
-        self._pager.ensure_capacity(self.lens + self.active)
-        if self._host_dirty:
-            self._tok_dev = jnp.asarray(self.last_token)
-            self._lens_dev = jnp.asarray(self.lens, jnp.int32)
-            self._active_dev = jnp.asarray(self.active, jnp.int32)
-            self._host_dirty = False
+        """One wave-synchronous step. With no wave in flight, admits (and
+        prefills) the next wave; otherwise decodes EVERY wave slot in
+        lockstep — finished rows keep burning their lane until the whole
+        wave completes (the static-batching cost being measured)."""
+        finished = []
+        active = [b for b in range(self.max_batch)
+                  if self._slots[b] is not None]
+        if not active:
+            if not self._pending:
+                return []
+            self._admit_wave()
+            active = [b for b in range(self.max_batch)
+                      if self._slots[b] is not None]
+            # first tokens may already complete single-token requests
+            for b in active:
+                self._check_done(b, eos_token_id, max_new_tokens)
+            return self._maybe_drain_wave(active, finished)
+        tokens = np.zeros((self.max_batch, 1), np.int32)
+        for b in active:
+            tokens[b, 0] = self._slots[b].last_token
+        need = np.where([s is not None for s in self._slots],
+                        self.lens + 1, 0)
+        self._pager.ensure_capacity(need)
         step = self._step_all_jit()
         toks_dev, self._pools = step(
-            self._tok_dev, self._pools,
-            self._pager.block_tables, self._lens_dev)
-        # feed the step's own outputs back for the next one (inactive rows
-        # carry garbage on device; they are re-seeded from host at the
-        # next admission via _host_dirty)
-        self._tok_dev = toks_dev[:, None]
-        self._lens_dev = self._lens_dev + self._active_dev
+            jnp.asarray(tokens), self._pools, self._pager.block_tables,
+            jnp.asarray(self.lens, jnp.int32))
         toks = np.asarray(toks_dev)
-        if mon.tstate.on and self._req_spans:
-            # one decode span per traced active request (same [t0,t1]): every
-            # request's trace tree carries its own decode timeline
-            t1 = mon.mod.now_ns()
-            for slot in np.flatnonzero(self.active):
-                entry = self._req_spans.get(self.request_ids[int(slot)])
-                if entry is not None:
-                    mon.trace.record_span(
-                        "serving.decode_step", t0, t1, parent=entry[0],
-                        attrs={"slot": int(slot), "n_active": n_decoded})
-        finished = []
-        for slot in np.flatnonzero(self.active):
-            slot = int(slot)
-            self.lens[slot] += 1
-            tok = int(toks[slot])
-            self.outputs[slot].append(tok)
-            self.last_token[slot, 0] = tok
-            done = (eos_token_id is not None and tok == eos_token_id) \
-                or (max_new_tokens is not None
-                    and len(self.outputs[slot]) >= max_new_tokens) \
-                or self.lens[slot] + 1 >= self.max_len
-            if done:
-                finished.append((self.request_ids[slot],
-                                 list(self.outputs[slot])))
-                self._evict(slot)
-        if mon.state.on:
-            mon.decode.observe(mon.mod.now_ns() - t0)
-            mon.tokens.inc(n_decoded)
-            self._update_gauges(mon)
-            mon.mod.sample()   # chrome-trace counter timeline, per step
+        for b in active:
+            req = self._slots[b]
+            if self._done[b]:
+                # a finished row burns its decode lane until the wave
+                # drains (the static-batching waste being measured), but
+                # its position is FROZEN: it re-writes garbage over its
+                # last slot instead of growing past its block table
+                continue
+            self.lens[b] += 1
+            tok = int(toks[b])
+            req.outputs.append(tok)
+            req.last_token = tok
+            st = self._stats.get(req.rid)
+            if st is not None:
+                st["tokens"] = len(req.outputs)
+            self._check_done(b, eos_token_id, max_new_tokens)
+        return self._maybe_drain_wave(active, finished)
+
+    def _check_done(self, b, eos_token_id, max_new_tokens):
+        req = self._slots[b]
+        limit = req.max_new if req.max_new is not None else max_new_tokens
+        tok = req.outputs[-1]
+        if (eos_token_id is not None and tok == eos_token_id) \
+                or (limit is not None and len(req.outputs) >= limit) \
+                or self.lens[b] + 1 >= self.max_len:
+            self._done[b] = True
+
+    def _maybe_drain_wave(self, active, finished):
+        if active and all(self._done[b] for b in active):
+            for b in active:
+                req = self._slots[b]
+                finished.append((req.rid, list(req.outputs)))
+                self._pager.free_sequence(b)
+                self._slots[b] = None
+                self.lens[b] = 0
+                self._done[b] = False
         return finished
-
-    def _evict(self, slot):
-        mon = _mon()
-        rid = self.request_ids[slot]
-        entry = self._req_spans.pop(rid, None)
-        t0 = mon.mod.now_ns() if entry is not None else 0
-        n_tokens = len(self.outputs[slot])
-        self._pager.free_sequence(slot)
-        self.active[slot] = False
-        self.lens[slot] = 0
-        self.request_ids[slot] = None
-        self.outputs[slot] = []
-        self._host_dirty = True
-        if entry is not None:
-            t1 = mon.mod.now_ns()
-            mon.trace.drop(entry[1])   # only open if tracing toggled off
-            mon.trace.record_span("serving.evict", t0, t1, parent=entry[0],
-                                  attrs={"slot": slot, "tokens": n_tokens})
-            mon.trace.end_span(entry[0], t1_ns=t1)   # request tree complete
-        if mon.state.on:
-            mon.evictions.inc()
-            self._update_gauges(mon)
-
-    def _update_gauges(self, mon):
-        mon.queue_depth.set(len(self._pending))
-        mon.occupancy.set(float(self.active.sum()) / self.max_batch)
 
     @property
     def num_active(self):
-        return int(self.active.sum())
+        return sum(1 for s in self._slots if s is not None)
 
     @property
     def num_pending(self):

@@ -68,8 +68,9 @@ METRICS = {
         "(0..1)."),
     "paddle_tpu_serving_prefill_latency_ns": (
         "histogram", (),
-        "Admission prefill wall time (pad + prefill program + first-token "
-        "argmax), nanoseconds."),
+        "Per-request prefill wall time: slot admission to the step that "
+        "consumed the last prompt token (chunked prefill spans several "
+        "steps), nanoseconds."),
     "paddle_tpu_serving_decode_step_latency_ns": (
         "histogram", (),
         "Wall time of one batched decode step over all active slots, "
@@ -90,6 +91,29 @@ METRICS = {
     "paddle_tpu_serving_rejected_total": (
         "counter", (),
         "add_request calls refused because the batch was full."),
+    "paddle_tpu_serving_admission_rejected_total": (
+        "counter", (),
+        "submit() calls that raised AdmissionTimeout: the bounded "
+        "admission queue stayed full past the caller's timeout "
+        "(backpressure)."),
+    "paddle_tpu_serving_pack_tokens": (
+        "histogram", (),
+        "Real lanes (decode tokens + prefill-chunk tokens) packed into "
+        "one mixed step, out of the max_step_tokens budget."),
+    "paddle_tpu_serving_chunked_prefill_depth": (
+        "histogram", (),
+        "Prefill chunks a request's prompt took (1 = the whole prompt "
+        "rode one step's budget), observed at prefill completion."),
+    "paddle_tpu_serving_prefix_cache_hits_total": (
+        "counter", (),
+        "Admissions whose prompt matched >= 1 cached prefix block."),
+    "paddle_tpu_serving_prefix_cache_misses_total": (
+        "counter", (),
+        "Admissions with no cached prefix block to share."),
+    "paddle_tpu_serving_prefix_blocks_shared_total": (
+        "counter", (),
+        "KV blocks mapped read-only from the radix cache into admitted "
+        "requests (prompt tokens neither recomputed nor re-stored)."),
     # -- paged KV allocator (models/paged_kv.py) -------------------------
     "paddle_tpu_kv_free_blocks": (
         "gauge", (),
@@ -100,6 +124,14 @@ METRICS = {
     "paddle_tpu_kv_pool_exhausted_total": (
         "counter", (),
         "Allocation attempts that failed because the block pool was empty."),
+    "paddle_tpu_kv_prefix_cache_blocks": (
+        "gauge", (),
+        "KV blocks currently indexed (and pinned) by the radix prefix "
+        "cache."),
+    "paddle_tpu_kv_prefix_cache_evictions_total": (
+        "counter", (),
+        "Cache-only blocks released back to the pool under allocation "
+        "pressure (LRU order)."),
     # -- dataloader (io/dataloader.py) -----------------------------------
     "paddle_tpu_dataloader_batches_total": (
         "counter", (),
@@ -161,11 +193,22 @@ SPANS = {
         "submit() admission-queue wait: enqueue until a slot frees "
         "(child of serving.request)."),
     "serving.prefill": (
-        "Admission prefill: pad + compiled prefill + first-token transfer "
-        "(child of serving.request). attrs: slot, prompt_len, bucket."),
+        "One request's WHOLE prefill: slot admission to the step that "
+        "consumed its last prompt token, recorded at completion (child "
+        "of serving.request; the chunk-level view is "
+        "serving.prefill_chunk). attrs: slot, prompt_len, chunks, "
+        "shared_tokens."),
+    "serving.prefill_chunk": (
+        "One chunked-prefill contribution to a mixed step: `tokens` "
+        "prompt tokens of one request packed alongside the decode lanes "
+        "(child of serving.request). attrs: slot, start, tokens."),
+    "serving.pack_tokens": (
+        "Per-step pack assembly of the mixed continuous-batching step: "
+        "how many decode lanes and prefill-chunk lanes filled the token "
+        "budget. attrs: n_decode, n_prefill, budget."),
     "serving.decode_step": (
-        "One batched decode step, recorded per active request so each "
-        "trace tree carries its own decode timeline. attrs: slot, "
+        "One mixed serving step, recorded per active decoding request so "
+        "each trace tree carries its own decode timeline. attrs: slot, "
         "n_active."),
     "serving.evict": (
         "Slot eviction: block free + host state clear (child of "

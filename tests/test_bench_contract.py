@@ -34,6 +34,10 @@ def _utc(offset_s=0):
 def _run_bench(env_extra, cache_path, timeout=560):
     env = dict(os.environ)
     env["BENCH_CACHE_PATH"] = str(cache_path)
+    # these tests exercise the orchestrator/cache contract, not the
+    # serving workload — skip its block to keep each fallback worker fast
+    # (bench_suite --smoke serving + tests/test_serving.py cover it)
+    env.setdefault("BENCH_SKIP_SERVING", "1")
     env.update(env_extra)
     p = subprocess.run([sys.executable, BENCH], capture_output=True,
                        text=True, timeout=timeout, env=env, cwd=ROOT)
@@ -98,6 +102,44 @@ class TestBenchContract:
         out, stderr = _run_bench(_NO_BACKEND, cache)
         assert out["detail"].get("stale") is not True
         assert "placeholder" in stderr
+
+    def test_forged_nested_provenance_refused_at_load(self, tmp_path):
+        """ISSUE 5 regression: the round-5 fixture class, one layer down.
+        An entry whose top-level measured_git_rev / measured_at are CLEAN
+        but whose nested detail.provenance block carries a placeholder
+        rev (the worker stamps that block; a fixture can forge it) must
+        be refused at cache LOAD, not replayed."""
+        cache = tmp_path / "bench_cache.json"
+        doc = {"metric": "llama_train_tokens_per_sec", "value": 111.0,
+               "unit": "tokens/s", "vs_baseline": 0.42,
+               "detail": {"device": "TPU test", "mfu": 0.42,
+                          "measured_at": _utc(-3600),
+                          "measured_git_rev": _real_rev(),
+                          "provenance": {"git_rev": "deadbee",
+                                         "hostname": "fixture",
+                                         "platform": "tpu"}}}
+        cache.write_text(json.dumps(doc))
+        out, stderr = _run_bench(_NO_BACKEND, cache)
+        assert out["detail"].get("stale") is not True
+        assert out["vs_baseline"] != 0.42
+        assert "provenance block fails validation" in stderr
+
+    def test_future_nested_provenance_refused_at_load(self, tmp_path):
+        """Same hole, timestamp flavor: a clean top level with a
+        year-2030 wall time inside detail.provenance must not replay."""
+        cache = tmp_path / "bench_cache.json"
+        doc = {"metric": "llama_train_tokens_per_sec", "value": 111.0,
+               "unit": "tokens/s", "vs_baseline": 0.42,
+               "detail": {"device": "TPU test", "mfu": 0.42,
+                          "measured_at": _utc(-3600),
+                          "measured_git_rev": _real_rev(),
+                          "provenance": {
+                              "git_rev": _real_rev(),
+                              "wall_time": "2030-01-01T00:00:00Z"}}}
+        cache.write_text(json.dumps(doc))
+        out, stderr = _run_bench(_NO_BACKEND, cache)
+        assert out["detail"].get("stale") is not True
+        assert "provenance block fails validation" in stderr
 
     def test_expired_cache_is_not_replayed(self, tmp_path):
         """Entries older than BENCH_CACHE_MAX_AGE_H must not replay (a

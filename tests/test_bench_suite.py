@@ -28,6 +28,41 @@ def _run(configs, timeout=560):
     return rows
 
 
+class TestServingSmoke:
+    # fast tier on purpose: `bench_suite.py --smoke serving` is the
+    # tier-1-safe invocation of the serving benchmark (ISSUE 5)
+    def test_smoke_serving_meets_acceptance(self):
+        env = dict(os.environ)
+        env["PADDLE_TPU_PLATFORM"] = "cpu"
+        env["JAX_PLATFORMS"] = "cpu"
+        out = subprocess.run(
+            [sys.executable, SUITE, "--smoke", "serving"],
+            capture_output=True, text=True, timeout=300, env=env, cwd=ROOT)
+        assert out.returncode == 0, out.stderr[-800:]
+        row = json.loads(out.stdout.strip().splitlines()[-1])
+        assert row["config"] == "serving"
+        assert row["unit"] == "tokens/s"
+        d = row["detail"]
+        assert row["value"] == d["serving_tokens_per_sec"] > 0
+        # ISSUE 5 acceptance: continuous batching + chunked prefill at
+        # >= 2x the static-batch engine's tokens/s, equal batch capacity
+        assert d["speedup_vs_static"] >= 2.0, d
+        # ... with exact shared-block reuse and a fully warm cache pass
+        assert d["warm_tokens_match"] is True
+        assert d["prefix_hit_rate"] == 1.0
+        assert d["static_tokens_per_sec"] > 0
+        for k in ("p50", "p99"):
+            assert d["ttft_ms"][k] > 0
+            assert d["static_ttft_ms"][k] > 0
+
+    def test_smoke_rejects_unknown_config(self):
+        out = subprocess.run(
+            [sys.executable, SUITE, "--smoke", "lenet"],
+            capture_output=True, text=True, timeout=60, cwd=ROOT)
+        assert out.returncode != 0
+        assert "serving" in out.stderr
+
+
 @pytest.mark.slow
 class TestBenchSuite:
     def test_lenet_and_bert(self):

@@ -538,13 +538,17 @@ class TestServingIntegration:
         probe = paddle.to_tensor(np.ones((4, 4), "float32"))
         (probe + probe) @ probe
         eng = ContinuousBatchingEngine(model, max_batch=2, max_len=32,
-                                       block_size=8, prefill_buckets=(16,))
+                                       block_size=8, chunk_size=8)
         rng = np.random.RandomState(0)
         rids = [eng.submit(rng.randint(0, 96, (n,)).astype("int32"))
                 for n in (5, 7, 4)]
-        assert eng.num_pending == 1     # third request queued, batch of 2
+        # submit() is a pure enqueue; the driving thread admits at step()
+        assert eng.num_pending == 3
         done = {}
-        steps = 0
+        for rid, toks in eng.step(max_new_tokens=5):
+            done[rid] = toks
+        steps = 1
+        assert eng.num_pending == 1     # third request queued, batch of 2
         while len(done) < 3 and steps < 40:
             for rid, toks in eng.step(max_new_tokens=5):
                 done[rid] = toks
@@ -561,16 +565,26 @@ class TestServingIntegration:
         assert m["paddle_tpu_serving_admitted_total"]["values"][""] == 3
         assert m["paddle_tpu_serving_queue_depth"]["values"][""] == 0
         assert m["paddle_tpu_serving_ttft_ns"]["values"][""]["count"] == 3
+        # chunked prefill: every prompt fits one chunk (<= chunk_size)
+        assert m["paddle_tpu_serving_chunked_prefill_depth"]["values"][
+            ""]["count"] == 3
+        # one latency observation per step (mixed or burst alike)
         assert m["paddle_tpu_serving_decode_step_latency_ns"]["values"][
             ""]["count"] == steps
+        # prefix cache: 3 distinct prompts, all cold
+        assert m["paddle_tpu_serving_prefix_cache_misses_total"]["values"][
+            ""] == 3
         # dispatch + jit caches saw real traffic
         disp = m["paddle_tpu_dispatch_op_calls_total"]["values"]
         assert sum(disp.values()) > 0
+        # the engine's whole program set: the mixed step and (if the run
+        # reached steady decode) the burst — every step() call is either
+        # a compile or a hit of label serving.step, never a new signature
         jit_c = m["paddle_tpu_jit_compiles_total"]["values"]
         jit_h = m["paddle_tpu_jit_cache_hits_total"]["values"]
-        assert jit_c["function=serving.prefill"] >= 1
-        assert jit_c["function=serving.decode_step"] == 1
-        assert jit_h["function=serving.decode_step"] == steps - 1
+        assert 1 <= jit_c["function=serving.step"] <= 2
+        assert jit_c["function=serving.step"] \
+            + jit_h["function=serving.step"] == steps
         # KV gauge consistent with the allocator's internal state
         pk = eng._pager
         gauge = m["paddle_tpu_kv_free_blocks"]["values"][""]

@@ -347,6 +347,104 @@ class TestAllocatorInvariants:
             len(free), len(live), c.num_blocks)
         assert 0 not in free, "null block entered the free list"
 
+    def test_retained_blocks_survive_owner_eviction(self):
+        """The prefix cache's pin: a retained block stays allocated when
+        its producing sequence frees, can be adopted by a new row, and
+        only returns to the pool once every reference lets go."""
+        c = PagedKVCache(num_layers=1, num_blocks=8, block_size=4,
+                         kv_heads=2, head_dim=8, batch=2,
+                         max_blocks_per_seq=4)
+        c.ensure_capacity([8, 0])
+        shared = [int(b) for b in c._tables_np[0] if b > 0]
+        c.retain_blocks(shared)                     # the cache's pin
+        c.free_sequence(0)                          # owner evicted
+        assert all(c._refs[b] == 1 for b in shared)
+        assert not set(shared) & set(c._free), "pinned block freed"
+        c.adopt_blocks(1, shared)                   # new request shares
+        assert all(c._refs[b] == 2 for b in shared)
+        np.testing.assert_array_equal(
+            np.asarray(c.block_tables)[1, :2], shared)
+        c.free_sequence(1)
+        assert c.release_blocks(shared) == len(shared)  # pin released
+        assert set(shared) <= set(c._free)
+
+    def test_retain_free_block_rejected(self):
+        c = PagedKVCache(num_layers=1, num_blocks=8, block_size=4,
+                         kv_heads=2, head_dim=8, batch=2,
+                         max_blocks_per_seq=4)
+        with pytest.raises(ValueError, match="free"):
+            c.retain_blocks([3])
+        with pytest.raises(ValueError, match="out of range"):
+            c.retain_blocks([0])
+
+    def test_adopt_requires_empty_row(self):
+        c = PagedKVCache(num_layers=1, num_blocks=8, block_size=4,
+                         kv_heads=2, head_dim=8, batch=2,
+                         max_blocks_per_seq=4)
+        c.ensure_capacity([4, 4])
+        blk = int(c._tables_np[0, 0])
+        with pytest.raises(ValueError, match="already holds"):
+            c.adopt_blocks(1, [blk])
+
+    def test_cow_under_pool_exhaustion(self):
+        """make_positions_exclusive must raise (not corrupt) when a shared
+        write target needs a copy and the pool has no free block."""
+        c = PagedKVCache(num_layers=1, num_blocks=3, block_size=4,
+                         kv_heads=1, head_dim=2, batch=2,
+                         max_blocks_per_seq=2, dtype=jnp.float32)
+        c.ensure_capacity([4, 0])          # row 0 owns block A
+        blk = int(c._tables_np[0, 0])
+        c.retain_blocks([blk])             # shared: refs == 2
+        c.ensure_capacity([4, 4])          # row 1 takes the LAST free block
+        pools = (c.k[0], c.v[0])
+        with pytest.raises(RuntimeError, match="copy-on-write"):
+            c.make_positions_exclusive([0], [3], pools)
+        # books stay balanced: the failed CoW granted nothing
+        assert c._refs[blk] == 2 and not c._free
+
+    def test_cow_partial_exhaustion_applies_completed_copies(self):
+        """When the pool runs dry mid-CoW, the copies already remapped
+        must still receive their DATA (their rows now look unshared, so
+        a retrying caller would otherwise read uninitialized KV), and
+        the donated-in pools' replacement must ride the exception."""
+        from paddle_tpu.models.paged_kv import CowPoolExhausted
+
+        c = PagedKVCache(num_layers=1, num_blocks=5, block_size=4,
+                         kv_heads=1, head_dim=2, batch=3,
+                         max_blocks_per_seq=2, dtype=jnp.float32)
+        c.ensure_capacity([4, 4, 0])       # rows 0 and 1 own one block each
+        b0, b1 = int(c._tables_np[0, 0]), int(c._tables_np[1, 0])
+        c.retain_blocks([b0, b1])          # both shared (refs == 2)
+        c.ensure_capacity([4, 4, 4])       # row 2: ONE free block remains
+        k = c.k[0].at[b0].set(7.0).at[b1].set(9.0)
+        pools = (k, c.v[0])
+        with pytest.raises(CowPoolExhausted, match="copy-on-write") as ei:
+            c.make_positions_exclusive([0, 1], [3, 3], pools)
+        # row 0's copy was remapped before exhaustion: its new private
+        # block must CONTAIN block b0's data, and the books must show
+        # exactly one transfer of ownership
+        new0 = int(c._tables_np[0, 0])
+        assert new0 != b0 and c._refs[b0] == 1 and c._refs[new0] == 1
+        assert (np.asarray(ei.value.pools[0][new0]) == 7.0).all()
+        # row 1 never got a block: still shared, retryable
+        assert int(c._tables_np[1, 0]) == b1 and c._refs[b1] == 2
+
+    def test_positions_exclusive_copies_once_per_block(self):
+        """Two lanes writing the SAME shared block (a prefill chunk
+        spanning it) trigger exactly one copy."""
+        c = PagedKVCache(num_layers=1, num_blocks=8, block_size=4,
+                         kv_heads=1, head_dim=2, batch=2,
+                         max_blocks_per_seq=4, dtype=jnp.float32)
+        c.ensure_capacity([8, 0])
+        blk = int(c._tables_np[0, 1])      # row 0's second block
+        c.retain_blocks([blk])
+        free0 = len(c._free)
+        pools = (c.k[0], c.v[0])
+        pools = c.make_positions_exclusive([0, 0], [5, 6], pools)
+        assert len(c._free) == free0 - 1
+        assert int(c._tables_np[0, 1]) != blk
+        assert c._refs[blk] == 1           # only the pin remains
+
     def test_random_workload_books_balance(self):
         rng = np.random.RandomState(0)
         B, bs, max_blocks = 6, 4, 5

@@ -1,0 +1,203 @@
+"""Radix prefix cache (models/radix_cache.py): the content index over the
+paged KV pool — chain-digest matching, LRU eviction under pool pressure,
+and the digest-collision fallback (verified tokens, never another
+prompt's KV)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddle_tpu.models import radix_cache
+from paddle_tpu.models.paged_kv import PagedKVCache
+from paddle_tpu.models.radix_cache import PrefixCache
+
+
+def _pager(batch=4, blocks=32, bs=4):
+    return PagedKVCache(num_layers=1, num_blocks=blocks, block_size=bs,
+                        kv_heads=1, head_dim=2, batch=batch,
+                        max_blocks_per_seq=8, dtype=jnp.float32)
+
+
+def _written(pager, row, n_tokens):
+    """Simulate a prefilled row: grant blocks for n_tokens."""
+    need = np.zeros(pager.batch, np.int64)
+    need[row] = n_tokens
+    pager.ensure_capacity(need)
+    return pager._tables_np[row]
+
+
+class TestMatchRegister:
+    def test_chain_match_is_longest_prefix(self):
+        pager = _pager()
+        pc = PrefixCache(pager)
+        prompt = np.arange(10, dtype=np.int32)          # 2 full blocks @ 4
+        row = _written(pager, 0, 10)
+        assert pc.register(prompt, 10, row) == 2
+        # identical prompt: both full blocks match
+        blocks, n = pc.match(prompt)
+        assert n == 8 and len(blocks) == 2
+        assert blocks == [int(row[0]), int(row[1])]
+        # diverges inside block 2: only block 1 matches
+        other = prompt.copy()
+        other[6] = 99
+        blocks, n = pc.match(other)
+        assert n == 4 and blocks == [int(row[0])]
+        # diverges in block 1: no match
+        other = prompt.copy()
+        other[0] = 99
+        assert pc.match(other) == ([], 0)
+
+    def test_register_only_full_written_blocks(self):
+        pager = _pager()
+        pc = PrefixCache(pager)
+        prompt = np.arange(10, dtype=np.int32)
+        row = _written(pager, 0, 10)
+        # only 5 tokens written so far -> one full block indexable
+        assert pc.register(prompt, 5, row) == 1
+        assert pc.register(prompt, 10, row) == 1        # the second one
+        assert pc.register(prompt, 10, row) == 0        # idempotent
+
+    def test_registration_pins_blocks(self):
+        pager = _pager()
+        pc = PrefixCache(pager)
+        prompt = np.arange(8, dtype=np.int32)
+        row = _written(pager, 0, 8)
+        pc.register(prompt, 8, row)
+        blocks = [int(row[0]), int(row[1])]
+        pager.free_sequence(0)                          # owner gone
+        assert all(pager._refs[b] == 1 for b in blocks)
+        assert pc.match(prompt)[0] == blocks            # still servable
+
+
+class TestCollisions:
+    def test_digest_collision_degrades_to_miss(self, monkeypatch):
+        """With the digest function maliciously constant, every lookup
+        collides — the token comparison must turn that into a miss rather
+        than serve another prompt's KV."""
+        monkeypatch.setattr(radix_cache, "_digest",
+                            lambda parent, tokens: b"same")
+        pager = _pager()
+        pc = PrefixCache(pager)
+        p1 = np.arange(4, dtype=np.int32)
+        p2 = np.arange(4, dtype=np.int32) + 50
+        row = _written(pager, 0, 4)
+        pc.register(p1, 4, row)
+        assert pc.match(p2) == ([], 0)
+        assert pc.collisions == 1
+        assert pc.match(p1)[1] == 4                     # the real owner hits
+
+    def test_collision_on_register_never_double_indexes(self, monkeypatch):
+        monkeypatch.setattr(radix_cache, "_digest",
+                            lambda parent, tokens: b"same")
+        pager = _pager()
+        pc = PrefixCache(pager)
+        row0 = _written(pager, 0, 4)
+        row1 = _written(pager, 1, 4)
+        pc.register(np.arange(4, dtype=np.int32), 4, row0)
+        pc.register(np.arange(4, dtype=np.int32) + 9, 4, row1)
+        assert len(pc) == 1                             # second one skipped
+        assert pager._refs[int(row1[0])] == 1           # and NOT pinned
+
+
+class TestEviction:
+    def test_lru_evicts_cache_only_blocks(self):
+        pager = _pager(batch=2, blocks=16)
+        pc = PrefixCache(pager)
+        old = np.arange(4, dtype=np.int32)
+        new = np.arange(4, dtype=np.int32) + 10
+        row0 = _written(pager, 0, 4)
+        pc.register(old, 4, row0)
+        old_blk = int(row0[0])
+        pager.free_sequence(0)
+        row1 = _written(pager, 0, 4)
+        pc.register(new, 4, row1)
+        pc.match(new)                                   # touches: new is MRU
+        freed = pc.evict(1)
+        assert freed == 1 and pc.evicted == 1
+        assert old_blk in pager._free                   # LRU entry went
+        assert pc.match(old) == ([], 0)
+        assert pc.match(new)[1] == 4
+
+    def test_evict_takes_leaves_before_roots(self):
+        """Chains shed from the tail: evicting one block of a 2-block
+        chain must take the LEAF, keeping the 1-block prefix matchable —
+        a beheaded root would strand its pinned descendant forever."""
+        pager = _pager(batch=2, blocks=32)
+        pc = PrefixCache(pager)
+        prompt = np.arange(8, dtype=np.int32)           # 2-block chain
+        row = _written(pager, 0, 8)
+        pc.register(prompt, 8, row)
+        root_blk, leaf_blk = int(row[0]), int(row[1])
+        pager.free_sequence(0)
+        assert pc.evict(1) == 1
+        assert leaf_blk in pager._free and root_blk not in pager._free
+        blocks, n = pc.match(prompt)                    # shorter prefix lives
+        assert n == 4 and blocks == [root_blk]
+        assert pc.evict(1) == 1 and root_blk in pager._free
+
+    def test_evict_frees_whole_chain_tail_to_root(self):
+        pager = _pager(batch=2, blocks=32)
+        pc = PrefixCache(pager)
+        prompt = np.arange(12, dtype=np.int32)          # 3-block chain
+        row = _written(pager, 0, 12)
+        pc.register(prompt, 12, row)
+        pager.free_sequence(0)
+        assert pc.evict(8) == 3                         # multi-sweep
+        assert len(pc) == 0
+
+    def test_evict_skips_live_blocks(self):
+        pager = _pager(batch=2)
+        pc = PrefixCache(pager)
+        prompt = np.arange(4, dtype=np.int32)
+        row = _written(pager, 0, 4)
+        pc.register(prompt, 4, row)                     # refs: row + pin = 2
+        assert pc.evict(4) == 0                         # mapped: untouchable
+        pager.free_sequence(0)
+        assert pc.evict(4) == 1                         # now reclaimable
+
+    def test_capacity_bound_evicts_on_register(self):
+        pager = _pager(batch=4, blocks=64, bs=4)
+        pc = PrefixCache(pager, capacity_blocks=2)
+        for i in range(4):
+            prompt = (np.arange(4, dtype=np.int32) + 17 * i)
+            row = _written(pager, i % 4, 4)
+            pc.register(prompt, 4, row)
+            pager.free_sequence(i % 4)
+        assert len(pc) <= 2
+
+    def test_clear_releases_every_pin(self):
+        pager = _pager()
+        pc = PrefixCache(pager)
+        row = _written(pager, 0, 8)
+        pc.register(np.arange(8, dtype=np.int32), 8, row)
+        pager.free_sequence(0)
+        free_before = len(pager._free)
+        pc.clear()
+        assert len(pc) == 0
+        assert len(pager._free) == free_before + 2
+        assert (pager._refs == 0).sum() == pager.num_blocks - 1 + 1
+
+
+def test_reregistered_parent_reconnects_orphaned_children():
+    """Evicting a parent strands its child entry; re-registering the same
+    prefix (same content digest) makes the child reachable again — the
+    content-addressed chain heals itself."""
+    pager = _pager(batch=2, blocks=32)
+    pc = PrefixCache(pager)
+    prompt = np.arange(8, dtype=np.int32)               # blocks P0, P1
+    row = _written(pager, 0, 8)
+    pc.register(prompt, 8, row)
+    child_blk = int(row[1])
+    pager.free_sequence(0)
+    pc.match(np.concatenate([prompt[4:], prompt[:4]]))  # parent-less probe
+    # evict ONLY the parent (it is LRU: match() above touched neither)
+    parent_digest = next(iter(pc._entries))
+    parent_blk = pc._entries[parent_digest].block
+    pager.release_blocks([parent_blk])
+    del pc._by_block[parent_blk]
+    del pc._entries[parent_digest]
+    assert pc.match(prompt) == ([], 0)                  # chain broken
+    row1 = _written(pager, 1, 4)
+    pc.register(prompt[:4], 4, row1)                    # parent reborn
+    blocks, n = pc.match(prompt)
+    assert n == 8 and blocks[1] == child_blk            # child reattached

@@ -1,58 +1,82 @@
-"""Continuous batching over the paged KV cache (models/serving.py).
+"""Continuous batching: chunked prefill + prefix-shared paged KV
+(models/serving.py).
 
-The acceptance bar: requests admitted at DIFFERENT times, decoded in one
-shared compiled step at ragged positions, must each reproduce the tokens
-the single-sequence paged engine produces for the same prompt — and slots
-must recycle blocks after eviction.
+The acceptance bars:
+- requests admitted at DIFFERENT times, packed into one mixed compiled
+  step at ragged positions, must each reproduce the tokens the SAME
+  engine produces for that prompt alone (batching never changes results);
+- a warm prefix-cache run emits tokens bit-identical to the cold run
+  (shared-block reuse is exact, not approximate);
+- slots recycle blocks after eviction; the scheduler knobs and submit()
+  backpressure behave as documented;
+- a steady-state run under PADDLE_TPU_SANITIZE=all stays silent: the
+  token-budget pack holds the engine at its two compiled programs and the
+  decode loop never host-syncs a Tensor.
 """
+import threading
+import time
+
 import numpy as np
 import pytest
 
 import paddle_tpu as paddle
+from paddle_tpu import monitor
+from paddle_tpu.analysis import sanitizers as san
 from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
-from paddle_tpu.models.llama_decode import LlamaDecodeEngine
-from paddle_tpu.models.serving import ContinuousBatchingEngine
+from paddle_tpu.models.serving import (AdmissionTimeout,
+                                       ContinuousBatchingEngine,
+                                       StaticBatchEngine)
 
 
-def _model():
+def _model(vocab=96, layers=2):
     paddle.seed(0)
-    cfg = LlamaConfig(vocab_size=96, hidden_size=64, intermediate_size=176,
-                      num_hidden_layers=2, num_attention_heads=4,
-                      num_key_value_heads=2, max_position_embeddings=128)
+    cfg = LlamaConfig(vocab_size=vocab, hidden_size=64,
+                      intermediate_size=176, num_hidden_layers=layers,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=128)
     return LlamaForCausalLM(cfg)
+
+
+def _run_all(eng, max_steps=60, **step_kw):
+    done = {}
+    for _ in range(max_steps):
+        for rid, toks in eng.step(**step_kw):
+            done[rid] = np.asarray(toks)
+        if not (eng.num_active or eng.num_pending):
+            break
+    return done
 
 
 @pytest.mark.slow
 class TestContinuousBatching:
-    def test_staggered_requests_match_single_sequence(self):
+    def test_staggered_requests_match_single_request(self):
+        """Mid-flight admission at ragged positions reproduces each
+        prompt's solo tokens — the mixed pack computes every lane
+        independently of its neighbours."""
         model = _model()
         rng = np.random.RandomState(0)
         prompts = [rng.randint(0, 96, (n,)).astype("int32")
                    for n in (9, 5, 13)]
-
-        # oracle: each prompt alone through the paged engine (greedy)
-        single = LlamaDecodeEngine(model, max_len=64,
-                                   kv_cache_layout="paged", block_size=8)
-        want = {i: np.asarray(single.generate(p[None], max_new_tokens=10))[0]
-                for i, p in enumerate(prompts)}
+        want = {}
+        for i, p in enumerate(prompts):
+            solo = ContinuousBatchingEngine(model, max_batch=1, max_len=64,
+                                            block_size=8, chunk_size=16,
+                                            prefix_cache=False,
+                                            decode_burst=1)
+            solo.add_request(p)
+            want[i] = list(_run_all(solo, max_new_tokens=10).values())[0]
 
         eng = ContinuousBatchingEngine(model, max_batch=4, max_len=64,
-                                       block_size=8,
-                                       prefill_buckets=(16, 32))
+                                       block_size=8, chunk_size=16)
         rid0 = eng.add_request(prompts[0])
         eng.step(max_new_tokens=10)              # request 0 alone
         rid1 = eng.add_request(prompts[1])       # joins mid-flight
         eng.step(max_new_tokens=10)
         rid2 = eng.add_request(prompts[2])       # three at ragged positions
-        done = {}
-        for _ in range(20):
-            for rid, toks in eng.step(max_new_tokens=10):
-                done[rid] = np.asarray(toks)
-            if len(done) == 3:
-                break
+        done = _run_all(eng, max_new_tokens=10)
         assert set(done) == {rid0, rid1, rid2}
         for rid, idx in ((rid0, 0), (rid1, 1), (rid2, 2)):
-            np.testing.assert_array_equal(done[rid], want[idx][:10],
+            np.testing.assert_array_equal(done[rid], want[idx],
                                           err_msg=f"request {idx}")
         assert eng.num_active == 0
 
@@ -60,7 +84,8 @@ class TestContinuousBatching:
         model = _model()
         rng = np.random.RandomState(1)
         eng = ContinuousBatchingEngine(model, max_batch=2, max_len=32,
-                                       block_size=8, prefill_buckets=(16,))
+                                       block_size=8, chunk_size=8,
+                                       prefix_cache=False)
         free0 = len(eng._pager._free)
         for round_ in range(3):
             a = eng.add_request(rng.randint(0, 96, (6,)).astype("int32"))
@@ -68,26 +93,288 @@ class TestContinuousBatching:
             assert a is not None and b is not None
             # full batch: third request must be refused, not crash
             assert eng.add_request(np.ones(3, "int32")) is None
-            while eng.num_active:
-                eng.step(max_new_tokens=6)
+            _run_all(eng, max_new_tokens=6)
         assert len(eng._pager._free) == free0, "blocks leaked across rounds"
 
-    def test_prompt_length_validation(self):
-        eng = ContinuousBatchingEngine(_model(), max_batch=2, max_len=16)
-        with pytest.raises(ValueError, match="out of range"):
-            eng.add_request(np.zeros(0, "int32"))
-        with pytest.raises(ValueError, match="out of range"):
-            eng.add_request(np.zeros(16, "int32"))
+    def test_spf_policy_prefills_shortest_first(self):
+        """shortest-prefill-first: with one prefill lane of budget, the
+        short prompt finishes its prefill (and emits) before the long
+        one that was admitted first."""
+        model = _model()
+        rng = np.random.RandomState(2)
+        long_p = rng.randint(0, 96, (24,)).astype("int32")
+        short_p = rng.randint(0, 96, (4,)).astype("int32")
+        eng = ContinuousBatchingEngine(model, max_batch=2, max_len=64,
+                                       block_size=8, chunk_size=4,
+                                       max_step_tokens=6, policy="spf",
+                                       prefix_cache=False, decode_burst=1)
+        rid_long = eng.submit(long_p, max_new_tokens=1)
+        rid_short = eng.submit(short_p, max_new_tokens=1)
+        finished_order = []
+        for _ in range(30):
+            for rid, _toks in eng.step():
+                finished_order.append(rid)
+            if len(finished_order) == 2:
+                break
+        assert finished_order == [rid_short, rid_long]
+
+    def test_decode_priority_caps_prefill_share(self):
+        """decode_priority=0.5 with budget 8: prefill may take at most
+        (1-0.5)*8 = 4 lanes per step, so a 12-token prompt needs 3 chunks
+        even though the chunk_size would allow fewer."""
+        model = _model()
+        eng = ContinuousBatchingEngine(model, max_batch=2, max_len=64,
+                                       block_size=8, chunk_size=8,
+                                       max_step_tokens=8,
+                                       decode_priority=0.5,
+                                       prefix_cache=False)
+        rid = eng.add_request(np.arange(12, dtype="int32") % 96,
+                              max_new_tokens=2)
+        _run_all(eng)
+        st = eng.pop_stats(rid)
+        assert st["prefill_chunks"] == 3
 
 
-def test_admission_grants_only_needed_blocks():
-    """add_request must not park blocks on idle slots (one block per idle
-    slot would be withheld from the pool indefinitely)."""
+class TestPrefixCacheExactness:
+    def test_warm_cache_bit_identical_to_cold(self):
+        """ISSUE 5 acceptance: a warm prefix-cache run emits tokens
+        bit-identical to the cold-path run — including a block-aligned
+        full-prompt hit, which re-runs only its last token through
+        copy-on-write."""
+        model = _model()
+        rng = np.random.RandomState(7)
+        prefix = rng.randint(0, 96, (16,)).astype("int32")   # 2 blocks @ 8
+        prompts = [np.concatenate([prefix,
+                                   rng.randint(0, 96, (n,)).astype("int32")])
+                   for n in (5, 3)]
+        # 24 tokens = 3 aligned blocks: the full-hit + CoW path
+        prompts.append(np.concatenate(
+            [prefix, rng.randint(0, 96, (8,)).astype("int32")]))
+
+        monitor.reset()
+        monitor.enable()
+        try:
+            eng = ContinuousBatchingEngine(model, max_batch=4, max_len=64,
+                                           block_size=8, chunk_size=16)
+
+            def run():
+                rids = [eng.submit(p, max_new_tokens=8) for p in prompts]
+                done = _run_all(eng)
+                return [done[r] for r in rids]
+
+            cold = run()
+            assert eng.prefix_cache.hits == 0
+            warm = run()
+            assert eng.prefix_cache.hits == len(prompts)
+            for c, w in zip(cold, warm):
+                np.testing.assert_array_equal(c, w)
+            snap = monitor.snapshot()["metrics"]
+            # the aligned full hit recomputed its last token into a
+            # copy-on-write private block — the PR 1 counter fires
+            assert snap["paddle_tpu_kv_cow_copies_total"]["values"][""] >= 1
+            assert snap["paddle_tpu_serving_prefix_cache_hits_total"][
+                "values"][""] == len(prompts)
+            assert snap["paddle_tpu_serving_prefix_blocks_shared_total"][
+                "values"][""] >= 2 * len(prompts)
+        finally:
+            monitor.disable()
+            monitor.reset()
+
+    def test_shared_blocks_survive_owner_eviction(self):
+        """The radix cache pins registered blocks: after the producing
+        request is evicted its prefix blocks stay out of the free pool
+        and a later identical prompt adopts them."""
+        model = _model()
+        rng = np.random.RandomState(3)
+        prompt = rng.randint(0, 96, (20,)).astype("int32")
+        eng = ContinuousBatchingEngine(model, max_batch=2, max_len=64,
+                                       block_size=8, chunk_size=32)
+        eng.add_request(prompt, max_new_tokens=3)
+        _run_all(eng)
+        assert eng.num_active == 0
+        assert len(eng.prefix_cache) == 2          # 20 tokens -> 2 full blocks
+        pinned = [e.block for e in eng.prefix_cache._entries.values()]
+        assert all(eng._pager._refs[b] == 1 for b in pinned)
+        assert not set(pinned) & set(eng._pager._free)
+        rid = eng.add_request(prompt, max_new_tokens=3)
+        _run_all(eng)
+        st = eng.pop_stats(rid)
+        assert st["shared_tokens"] == 16
+
+
+class TestBackpressure:
+    def test_full_queue_raises_immediately_without_timeout(self):
+        eng = ContinuousBatchingEngine(_model(), max_batch=1, max_len=32,
+                                       block_size=8, max_queue=2)
+        p = np.arange(5, dtype="int32")
+        eng.submit(p)
+        eng.step()                     # driving thread admits to the slot
+        eng.submit(p), eng.submit(p)   # fills the queue
+        with pytest.raises(AdmissionTimeout, match="queue full"):
+            eng.submit(p)
+
+    def test_timeout_blocks_then_raises(self):
+        eng = ContinuousBatchingEngine(_model(), max_batch=1, max_len=32,
+                                       block_size=8, max_queue=1)
+        p = np.arange(5, dtype="int32")
+        eng.submit(p)
+        eng.step()                     # driving thread admits to the slot
+        eng.submit(p)
+        t0 = time.monotonic()
+        with pytest.raises(AdmissionTimeout, match="after 0.2s"):
+            eng.submit(p, timeout=0.2)
+        assert time.monotonic() - t0 >= 0.2
+
+    def test_blocking_submit_resolves_when_stepping_thread_drains(self):
+        eng = ContinuousBatchingEngine(_model(), max_batch=1, max_len=32,
+                                       block_size=8, chunk_size=8,
+                                       max_queue=1)
+        p = np.arange(5, dtype="int32")
+        eng.submit(p, max_new_tokens=2)
+        eng.step()                     # driving thread admits to the slot
+        eng.submit(p, max_new_tokens=2)
+        stop = threading.Event()
+
+        def drive():
+            while not stop.is_set():
+                eng.step()
+                time.sleep(0.001)
+
+        th = threading.Thread(target=drive)
+        th.start()
+        try:
+            rid = eng.submit(p, max_new_tokens=2, timeout=30.0)
+            assert rid is not None
+        finally:
+            stop.set()
+            th.join()
+
+    def test_admission_rejected_counter(self):
+        monitor.reset()
+        monitor.enable()
+        try:
+            eng = ContinuousBatchingEngine(_model(), max_batch=1,
+                                           max_len=32, block_size=8,
+                                           max_queue=1)
+            p = np.arange(4, dtype="int32")
+            eng.submit(p)
+            eng.step()                 # driving thread admits to the slot
+            eng.submit(p)
+            with pytest.raises(AdmissionTimeout):
+                eng.submit(p)
+            snap = monitor.snapshot()["metrics"]
+            assert snap["paddle_tpu_serving_admission_rejected_total"][
+                "values"][""] == 1
+        finally:
+            monitor.disable()
+            monitor.reset()
+
+
+class TestSanitizedSteadyState:
+    def test_sanitize_all_steady_state_is_silent(self):
+        """ISSUE 5 acceptance: under PADDLE_TPU_SANITIZE=all, steady-state
+        serving (repeated admissions + chunked prefill + decode) triggers
+        neither the recompile sentinel nor the host-sync tripwire, and
+        the jit cache holds misses at zero after warmup: the engine's two
+        programs (mixed step, decode burst) each compile exactly once."""
+        model = _model()
+        assert san.install_from_env("all") != ()
+        try:
+            eng = ContinuousBatchingEngine(model, max_batch=2, max_len=64,
+                                           block_size=8, chunk_size=16)
+            rng = np.random.RandomState(0)
+            for _ in range(6):   # admissions keep arriving mid-decode
+                eng.submit(rng.randint(0, 96, (int(rng.randint(3, 20)),))
+                           .astype("int32"), max_new_tokens=6)
+                for _ in range(10):
+                    eng.step()
+            _run_all(eng)
+            assert san.trips() == []
+            counts = {k: v for k, v in san.compile_counts().items()
+                      if k.startswith("serving.step")}
+            assert counts and all(v <= 2 for v in counts.values()), counts
+        finally:
+            san.disable()
+            san.reset()
+
+
+class TestStaticBatchEngine:
+    def test_wave_synchronous_barrier(self):
+        """The baseline's defining cost: a request submitted after the
+        wave started waits for the WHOLE wave to drain before admission,
+        and all wave members evict together."""
+        model = _model()
+        rng = np.random.RandomState(5)
+        eng = StaticBatchEngine(model, max_batch=2, max_len=64,
+                                block_size=8, prefill_buckets=(16,))
+        r1 = eng.submit(rng.randint(0, 96, (6,)).astype("int32"),
+                        max_new_tokens=2)
+        r2 = eng.submit(rng.randint(0, 96, (4,)).astype("int32"),
+                        max_new_tokens=8)
+        eng.step()                      # admits + prefills the wave
+        r3 = eng.submit(rng.randint(0, 96, (5,)).astype("int32"),
+                        max_new_tokens=2)
+        assert eng.num_active == 2 and eng.num_pending == 1
+        finished = []
+        for _ in range(12):
+            finished += eng.step()
+            if finished:
+                break
+        # r1 finished at 2 tokens but was held until r2's 8 drained
+        assert sorted(r for r, _ in finished) == [r1, r2]
+        assert dict(finished)[r1].__len__() == 2
+        assert eng.num_pending == 1
+        eng.step()                      # next wave admits r3
+        assert eng.num_active == 1 and eng.num_pending == 0
+        done = {r: t for r, t in _run_all(eng).items()}
+        assert len(done[r3]) == 2
+
+    def test_early_finisher_never_overruns_its_block_table(self):
+        """A row finishing early keeps burning its lane until the wave
+        drains, but its position must FREEZE — a long-prompt early
+        finisher next to a long-running short-prompt peer would otherwise
+        grow past max_blocks_per_seq and crash the allocator."""
+        model = _model()
+        rng = np.random.RandomState(6)
+        eng = StaticBatchEngine(model, max_batch=2, max_len=32,
+                                block_size=8, prefill_buckets=(32,))
+        ra = eng.submit(rng.randint(0, 96, (20,)).astype("int32"),
+                        max_new_tokens=2)       # done at lens 21
+        rb = eng.submit(rng.randint(0, 96, (4,)).astype("int32"),
+                        max_new_tokens=26)      # decodes ~25 more steps
+        done = _run_all(eng, max_steps=40)
+        assert len(done[ra]) == 2 and len(done[rb]) == 26
+        assert eng.lens.max() == 0              # wave fully evicted
+
+    def test_static_stats_carry_ttft(self):
+        model = _model()
+        eng = StaticBatchEngine(model, max_batch=1, max_len=32,
+                                block_size=8, prefill_buckets=(16,))
+        rid = eng.submit(np.arange(6, dtype="int32"), max_new_tokens=2)
+        _run_all(eng)
+        st = eng.pop_stats(rid)
+        assert st["ttft_ns"] > 0 and st["tokens"] == 2
+
+
+def test_prompt_length_validation():
+    eng = ContinuousBatchingEngine(_model(), max_batch=2, max_len=16)
+    with pytest.raises(ValueError, match="out of range"):
+        eng.add_request(np.zeros(0, "int32"))
+    with pytest.raises(ValueError, match="out of range"):
+        eng.add_request(np.zeros(16, "int32"))
+
+
+def test_admission_grants_no_blocks_before_prefill():
+    """Admission is free: blocks are granted chunk-by-chunk as prefill
+    consumes budget, so idle slots and freshly admitted requests park
+    nothing on the pool."""
     model = _model()
     eng = ContinuousBatchingEngine(model, max_batch=8, max_len=32,
-                                   block_size=8, prefill_buckets=(16,))
+                                   block_size=8, chunk_size=16,
+                                   prefix_cache=False)
     free0 = len(eng._pager._free)
     eng.add_request(np.arange(6, dtype="int32") % 96)
-    # 6-token prompt + next write at block 8 => exactly 1 block granted
-    assert free0 - len(eng._pager._free) == 1, (
-        free0, len(eng._pager._free))
+    assert len(eng._pager._free) == free0
+    eng.step(max_new_tokens=4)
+    # 6-token prompt + first token => exactly 1 block granted
+    assert free0 - len(eng._pager._free) == 1

@@ -301,15 +301,19 @@ def _tiny_engine():
                       num_hidden_layers=1, num_attention_heads=4,
                       num_key_value_heads=4, max_position_embeddings=64)
     model = LlamaForCausalLM(cfg)
+    # decode_burst=1: one decode_step span per generated token, so the
+    # tree-shape assertions below are deterministic
     return ContinuousBatchingEngine(model, max_batch=2, max_len=32,
-                                    block_size=8, prefill_buckets=(8, 16))
+                                    block_size=8, chunk_size=8,
+                                    decode_burst=1)
 
 
 class TestServingTrace:
     def test_submit_roundtrip_single_trace_id_tree(self):
-        """ISSUE 3 acceptance: one submit() round-trip = one trace id
-        covering admission (queue wait), prefill, every decode step and
-        the eviction, all parented on the serving.request root."""
+        """ISSUE 3 acceptance, chunked-prefill era: one submit()
+        round-trip = one trace id covering admission (queue wait), the
+        prefill chunk(s), the prefill summary, every decode step and the
+        eviction, all parented on the serving.request root."""
         eng = _tiny_engine()
         trace.enable()
         eng.submit(np.array([1, 2, 3], np.int32))
@@ -324,17 +328,20 @@ class TestServingTrace:
         tree = [s for s in spans if s.trace_id == root.trace_id]
         names = {s.name for s in tree}
         assert names == {"serving.request", "serving.queue_wait",
-                         "serving.prefill", "serving.decode_step",
-                         "serving.evict"}
+                         "serving.prefill", "serving.prefill_chunk",
+                         "serving.decode_step", "serving.evict"}
         assert all(s.parent_id == root.span_id
                    for s in tree if s is not root)
         decode = [s for s in tree if s.name == "serving.decode_step"]
         assert len(decode) == 2     # prefill emitted token 1; decodes 2..3
+        chunks = [s for s in tree if s.name == "serving.prefill_chunk"]
+        assert len(chunks) == 1 and chunks[0].attrs["tokens"] == 3
         # TTFT decomposition: queue_wait then prefill, inside the root
         qw = next(s for s in tree if s.name == "serving.queue_wait")
         pf = next(s for s in tree if s.name == "serving.prefill")
         assert root.t0_ns <= qw.t0_ns <= qw.t1_ns <= pf.t1_ns
         assert pf.attrs["prompt_len"] == 3
+        assert pf.attrs["chunks"] == 1
         assert not trace.open_spans()             # eviction closed the root
 
     def test_two_requests_two_disjoint_trees(self):
