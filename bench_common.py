@@ -6,6 +6,7 @@ backend (fetch one element instead), and long unforced donated chains are
 pathologically slow (force every couple of steps)."""
 from __future__ import annotations
 
+import threading
 import time
 
 
@@ -263,6 +264,242 @@ def serving_bench(model, *, max_batch=8, block_size=8, chunk_size=16,
             warm_hits / max(warm_hits + warm_misses, 1), 3),
         "prefix_blocks_shared": pc.blocks_shared - bs0,
         "warm_tokens_match": bool(match),
+    }
+
+
+def _drive_until_done(eng, rid2prompt, deadline_s=60.0, tenant=""):
+    """Driver-mode collector: poll pop_results/pop_aborted until every
+    live rid resolves, RESUBMITTING each aborted request (same prompt,
+    same budget, same ``tenant`` — the crash-recovery contract: the
+    caller retries with the partial tokens in hand, the warm radix
+    cache makes the retry cheap). Returns
+    ({final_rid: tokens}, {original_rid: final_rid}, n_aborted)."""
+    remap = {rid: rid for rid in rid2prompt}
+    results = {}
+    aborted = 0
+    t0 = time.perf_counter()
+    # completion = every TRACKED rid resolved; pop_results may also hand
+    # back other tenants' finishes (the overload drill's bronze flood
+    # shares the engine), so a bare len(results) count would exit early
+    while any(cur not in results for cur in remap.values()) \
+            and time.perf_counter() - t0 < deadline_s:
+        for rid, toks in eng.pop_results():
+            results[rid] = list(toks)
+        for err in eng.pop_aborted():
+            orig = next((o for o, cur in remap.items()
+                         if cur == err.rid), None)
+            if orig is None:
+                continue
+            aborted += 1
+            prompt, max_new = rid2prompt[orig]
+            remap[orig] = eng.submit(prompt, max_new_tokens=max_new,
+                                     timeout=deadline_s, tenant=tenant)
+        time.sleep(0.001)
+    out = {orig: results.get(cur) for orig, cur in remap.items()}
+    return out, remap, aborted
+
+
+def chaos_bench(model, *, max_batch=4, block_size=8, chunk_size=16,
+                decode_burst=4, max_queue=6, n_requests=8,
+                n_bronze=24, prompt_len=14, max_new=10, kill_nth=5,
+                seed=0, deadline_s=90.0):
+    """The serving resilience drill (docs/serving.md, resilience):
+
+    1. **Kill drill** — a reference pass (driving thread, no faults)
+       records every request's tokens; a chaos pass over the SAME
+       workload arms ``serving.drive:raise:nth=kill_nth`` so the driving
+       thread dies mid-decode. The engine must recover (flight dump,
+       typed aborts, warm radix restart, self-relaunch), the bench
+       resubmits the aborted requests, and every final output must be
+       BIT-IDENTICAL to the reference pass. Reports recovery latency and
+       whether re-admissions prefix-hit (recovered WARM).
+    2. **Overload/QoS drill** — a 'gold' tenant (priority 1) first runs
+       its workload alone (isolated goodput), then again with a 'bronze'
+       (priority 0) flood against a bounded admission queue. Bronze
+       arrivals must shed with typed rejections; gold goodput under
+       overload is reported as a fraction of its isolated goodput (the
+       acceptance bar: >= 0.9).
+
+    Deterministic in ``seed``; CPU-smoke-safe at the default shapes."""
+    import numpy as np
+
+    from paddle_tpu import monitor
+    from paddle_tpu.monitor import trace
+    from paddle_tpu.analysis import faultinject as fi
+    from paddle_tpu.models.serving import (ContinuousBatchingEngine,
+                                           RequestShed)
+
+    vocab = model.config.vocab_size
+    rng = np.random.RandomState(seed)
+    prompts = [rng.randint(0, vocab, (prompt_len,)).astype("int32")
+               for _ in range(n_requests)]
+    workload = {i: (p, max_new) for i, p in enumerate(prompts)}
+
+    def eng():
+        return ContinuousBatchingEngine(
+            model, max_batch=max_batch, block_size=block_size,
+            chunk_size=chunk_size, decode_burst=decode_burst,
+            max_queue=max_queue)
+
+    # -- kill drill -----------------------------------------------------
+    fi.reset()
+    mon_was, trace_was = monitor.enabled(), trace.enabled()
+    monitor.enable()
+    trace.enable()      # recover()'s flight dump needs the recorder on
+    e1 = e2 = None
+    try:
+        e1 = eng()
+        e1.start_driver()
+        rids = {i: e1.submit(p, max_new_tokens=mn, timeout=deadline_s)
+                for i, (p, mn) in workload.items()}
+        t0 = time.perf_counter()
+        ref, _, _ = _drive_until_done(
+            e1, {rids[i]: workload[i] for i in workload}, deadline_s)
+        ref_wall = time.perf_counter() - t0
+        e1.stop_driver()
+        ref = {i: ref[rids[i]] for i in workload}
+
+        e2 = eng()
+        pc = e2.prefix_cache
+        fi.arm("serving.drive", action="raise", nth=kill_nth)
+        e2.start_driver()
+        rids2 = {i: e2.submit(p, max_new_tokens=mn, timeout=deadline_s)
+                 for i, (p, mn) in workload.items()}
+        hits0 = pc.hits
+        t0 = time.perf_counter()
+        out, _, n_aborted = _drive_until_done(
+            e2, {rids2[i]: workload[i] for i in workload}, deadline_s)
+        chaos_wall = time.perf_counter() - t0
+        e2.stop_driver()
+        out = {i: out[rids2[i]] for i in workload}
+        match = all(out[i] == ref[i] for i in workload)
+        rec = e2.recovery_stats[0] if e2.recovery_stats else {}
+        kill = {
+            "killed": bool(fi.trips()),
+            "recoveries": len(e2.recovery_stats),
+            "recovery_ms": round(rec.get("ms", -1.0), 2),
+            "aborted": n_aborted,
+            "flight_dump": rec.get("dump"),
+            "recovered_warm": pc.hits > hits0,   # re-admissions prefix-hit
+            "tokens_match_reference": bool(match),
+            "reference_wall_s": round(ref_wall, 2),
+            "chaos_wall_s": round(chaos_wall, 2),
+        }
+    finally:
+        fi.reset()
+        for e in (e1, e2):
+            if e is not None:
+                e.stop_driver()
+        if not trace_was:
+            trace.disable()
+        if not mon_was:
+            monitor.disable()
+
+    # -- overload/QoS drill ---------------------------------------------
+    # strict_priority = the graceful-degradation mode under drill: the
+    # bronze flood must never join a gold batch (gold keeps its isolated
+    # steady state; bronze drains into idle capacity or sheds)
+    e3 = ContinuousBatchingEngine(
+        model, max_batch=max_batch, block_size=block_size,
+        chunk_size=chunk_size, decode_burst=decode_burst,
+        max_queue=max_queue, strict_priority=True)
+    e3.set_tenant("gold", weight=2.0, priority=1)
+    e3.set_tenant("bronze", weight=1.0, priority=0)
+    e3.start_driver()
+    # untimed warmup: compile both step programs and populate the prefix
+    # cache with the gold workload, so isolated vs overload compares warm
+    # steady states instead of charging compilation to the isolated pass
+    # (which would make any goodput ratio look great)
+    warm_rids = {i: e3.submit(p, max_new_tokens=mn, tenant="gold",
+                              timeout=deadline_s)
+                 for i, (p, mn) in workload.items()}
+    _drive_until_done(e3, {warm_rids[i]: workload[i] for i in workload},
+                      deadline_s)
+
+    def gold_pass():
+        rids = {i: e3.submit(p, max_new_tokens=mn, tenant="gold",
+                             timeout=deadline_s)
+                for i, (p, mn) in workload.items()}
+        t0 = time.perf_counter()
+        out, _, _ = _drive_until_done(
+            e3, {rids[i]: workload[i] for i in workload}, deadline_s,
+            tenant="gold")
+        wall = time.perf_counter() - t0
+        return {i: out[rids[i]] for i in workload}, wall
+
+    # best-of-N both sides: the flood thread's host contention is
+    # one-sided noise on a shared CPU, and min-wall is robust to it —
+    # the same discipline serving_bench uses for its headline
+    repeats = 3
+    iso, iso_wall = gold_pass()
+    for _ in range(repeats - 1):
+        o, w = gold_pass()
+        if w < iso_wall:
+            iso, iso_wall = o, w
+    iso_tokens = sum(len(t) for t in iso.values() if t)
+    iso_goodput = iso_tokens / max(iso_wall, 1e-9)
+
+    shed = {"n": 0}
+    submitted = {"n": 0}   # bronze submissions actually attempted (the
+    # flood stops when its gold pass ends, so n_bronze is a ceiling, not
+    # the shed-rate denominator)
+    bronze_prompts = [rng.randint(0, vocab, (prompt_len,)).astype("int32")
+                      for _ in range(n_bronze)]
+    over = over_wall = None
+    for _ in range(repeats):
+        stop_flood = threading.Event()
+
+        def flood():
+            for p in bronze_prompts:
+                if stop_flood.is_set():
+                    return
+                submitted["n"] += 1
+                try:
+                    e3.submit(p, max_new_tokens=max_new, tenant="bronze")
+                except RequestShed:
+                    shed["n"] += 1   # the typed rejection the drill demands
+                # 3ms cadence: with strict_priority no bronze is admitted
+                # while gold runs, so the queue fills once and every
+                # later arrival sheds — overload is sustained at any
+                # cadence, and a hotter loop only adds GIL noise to the
+                # goodput measurement
+                time.sleep(0.003)
+
+        flooder = threading.Thread(target=flood, daemon=True)
+        flooder.start()
+        o, w = gold_pass()
+        stop_flood.set()
+        flooder.join(timeout=5)
+        if over is None or w < over_wall:
+            over, over_wall = o, w
+    # drain whatever bronze work was admitted so the driver stops clean
+    t0d = time.perf_counter()
+    while (e3.num_active or e3.num_pending) \
+            and time.perf_counter() - t0d < deadline_s:
+        e3.pop_results()
+        time.sleep(0.001)
+    e3.stop_driver()
+    shed["n"] += len(e3.pop_shed())   # queued bronze displaced by gold
+    over_tokens = sum(len(t) for t in over.values() if t)
+    over_goodput = over_tokens / max(over_wall, 1e-9)
+    gold_match = all(over[i] == iso[i] for i in workload)
+
+    return {
+        "requests": n_requests, "max_batch": max_batch,
+        "block_size": block_size, "chunk_size": chunk_size,
+        "max_queue": max_queue, "kill_nth": kill_nth,
+        "kill_drill": kill,
+        "overload": {
+            "gold_isolated_tokens_per_sec": round(iso_goodput, 1),
+            "gold_overload_tokens_per_sec": round(over_goodput, 1),
+            "gold_goodput_ratio": round(
+                over_goodput / max(iso_goodput, 1e-9), 3),
+            "gold_tokens_match_isolated": bool(gold_match),
+            "bronze_submitted": submitted["n"],
+            "bronze_shed": shed["n"],
+            "bronze_shed_rate": round(
+                shed["n"] / max(submitted["n"], 1), 3),
+        },
     }
 
 

@@ -36,7 +36,8 @@ import time
 ROOT = os.path.dirname(os.path.abspath(__file__))
 RESULTS = os.path.join(ROOT, "tools", "suite_results.jsonl")
 
-CONFIGS = ("lenet", "resnet50", "bert_dp", "gpt_hybrid", "serving")
+CONFIGS = ("lenet", "resnet50", "bert_dp", "gpt_hybrid", "serving",
+           "chaos")
 
 
 # --------------------------------------------------------------------------- #
@@ -311,6 +312,61 @@ def run_serving(smoke=False):
            "unit": "tokens/s", "detail": res})
 
 
+def run_chaos(smoke=False):
+    """Config 6 — the serving resilience drill (bench_common.chaos_bench):
+    kill the driving thread mid-decode and verify recovery time, warm
+    restart and bit-identical outputs; overload a bounded queue with a
+    low-priority flood and verify high-priority goodput holds while the
+    flood sheds with typed rejections. ``smoke`` is the tier-1-safe shape
+    (`bench_suite.py --smoke chaos`)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    from bench_common import chaos_bench
+
+    dev, on_tpu, kind = _device()
+    paddle.seed(0)
+    if smoke or not on_tpu:
+        cfg = LlamaConfig(vocab_size=96, hidden_size=64,
+                          intermediate_size=176, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=2,
+                          max_position_embeddings=256)
+        params = dict(max_batch=4, block_size=8, chunk_size=16,
+                      decode_burst=4, max_queue=6, n_requests=8,
+                      n_bronze=24, prompt_len=14, max_new=10, kill_nth=5)
+    else:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5632, num_hidden_layers=8,
+                          num_attention_heads=16, num_key_value_heads=16,
+                          max_position_embeddings=1024, dtype="bfloat16")
+        params = dict(max_batch=8, block_size=64, chunk_size=128,
+                      decode_burst=8, max_queue=12, n_requests=12,
+                      n_bronze=48, prompt_len=96, max_new=64, kill_nth=9)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu and not smoke:
+        model.to(dtype="bfloat16")
+    res = chaos_bench(model, **params)
+    res["device"] = kind
+    res["smoke"] = bool(smoke)
+    if smoke:
+        # the drill's own bounds (tier-1 gates on this exit code): the
+        # kill must have happened and recovery must be warm, fast and
+        # bit-exact; the flood must shed with typed rejections while
+        # gold's outputs stay identical to its isolated run
+        k, o = res["kill_drill"], res["overload"]
+        assert k["killed"] and k["recoveries"] >= 1, k
+        assert k["flight_dump"], k
+        assert k["recovered_warm"], k
+        assert k["tokens_match_reference"], k
+        assert 0 < k["recovery_ms"] < 5000, k
+        assert o["bronze_shed"] > 0, o
+        assert 0.05 <= o["bronze_shed_rate"] <= 0.95, o
+        assert o["gold_tokens_match_isolated"], o
+    _emit({"config": "chaos",
+           "value": res["overload"]["gold_goodput_ratio"],
+           "unit": "goodput_ratio", "detail": res})
+
+
 # --------------------------------------------------------------------------- #
 # orchestrator
 # --------------------------------------------------------------------------- #
@@ -364,13 +420,15 @@ def main():
     ap.add_argument("--smoke", metavar="CONFIG",
                     help="run ONE config in-process at tier-1-safe smoke "
                          "shapes and print its JSON line (currently: "
-                         "serving)")
+                         "serving, chaos)")
     args = ap.parse_args()
 
     if args.smoke:
-        if args.smoke != "serving":
-            ap.error(f"--smoke supports 'serving', not {args.smoke!r}")
-        run_serving(smoke=True)
+        smokes = {"serving": run_serving, "chaos": run_chaos}
+        if args.smoke not in smokes:
+            ap.error(f"--smoke supports {sorted(smokes)}, "
+                     f"not {args.smoke!r}")
+        smokes[args.smoke](smoke=True)
         return
 
     rows = []
@@ -403,6 +461,6 @@ if __name__ == "__main__":
         which = sys.argv[sys.argv.index("--worker") + 1]
         {"lenet": run_lenet, "resnet50": run_resnet50,
          "bert_dp": run_bert_dp, "gpt_hybrid": run_gpt_hybrid,
-         "serving": run_serving}[which]()
+         "serving": run_serving, "chaos": run_chaos}[which]()
     else:
         main()

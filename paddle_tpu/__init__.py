@@ -341,3 +341,10 @@ def check_shape(shape):
 from .analysis.sanitizers import install_from_env as _san_install  # noqa: E402
 
 _san_install()
+
+# fault-injection harness (analysis/faultinject.py): opt-in via
+# PADDLE_TPU_FAULTS=point:action:trigger;... — the offensive twin of the
+# sanitizers, arming named chaos-drill points in the serving/KV stack.
+from .analysis.faultinject import install_from_env as _fi_install  # noqa: E402
+
+_fi_install()

@@ -1,0 +1,296 @@
+"""Fault injection harness: the hazards the resilience layer must be
+DRILLED against, injected deterministically at named points.
+
+The observability stack (metrics, spans, flight recorder, sanitizers)
+records what went wrong; this module makes things go wrong ON PURPOSE so
+the recovery paths are exercised in tier-1 instead of trusted. It is the
+offensive twin of ``sanitizers.py`` and follows the same discipline:
+
+- **disabled by default** — every instrumented site guards on a one-slot
+  ``_state.on`` load, so the cost when off is a few nanoseconds;
+- **env-gated** — ``PADDLE_TPU_FAULTS=point:action:trigger;...`` at
+  process start (``install_from_env`` runs at the end of package init),
+  or programmatically via :func:`arm`;
+- **stdlib-only** — no jax, no framework imports; runtime sites import
+  THIS module, and monitor bindings resolve lazily at trip time.
+
+Every injection point is DECLARED in :data:`POINTS` and fired by name at
+exactly one (or more) code site via ``_fi.fire("<point>")``;
+``tools/run_static_checks.py`` (``check_fault_points``) pins the
+catalog and the sites to each other — an undeclared ``fire()`` or a
+declared-but-unfired point fails CI.
+
+Trigger specs are deterministic: ``nth=N`` fires from the Nth call on
+(bounded by ``times``, default 1), ``prob=P`` draws from an explicit
+``seed`` (``times`` default unlimited). Actions:
+
+- ``raise`` — raise :class:`InjectedFault` at the site (kill drills);
+- ``delay`` — sleep ``delay_s`` at the site (hang drills: long enough
+  delays trip the serving watchdog);
+- ``flag``  — return the armed spec to the site, which raises its OWN
+  typed error with local context (e.g. a real ``CowPoolExhausted``
+  carrying the live pools) or corrupts a value (radix digest).
+
+Every trip is recorded (:func:`trips`) and mirrored best-effort into
+``paddle_tpu_monitor_fault_injections_total{point}`` plus a
+``monitor.fault_injection`` span, so a chaos run's telemetry shows WHERE
+the drill hit. See docs/serving.md (resilience section).
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+
+__all__ = [
+    "InjectedFault", "POINTS", "ACTIONS",
+    "enable", "disable", "enabled", "install_from_env", "reset",
+    "arm", "disarm", "fire", "trips", "armed",
+]
+
+# The fault-point catalog: every name a code site may fire. The strict
+# check in tools/run_static_checks.py keys on this dict — add the row
+# here AND the ``fire()`` site together.
+POINTS = {
+    "serving.step": (
+        "Entry of ContinuousBatchingEngine.step(), before any slot/pager "
+        "mutation. raise = the step dies with a typed error; delay = the "
+        "step hangs (the serving watchdog's drill)."),
+    "serving.drive": (
+        "One iteration of the engine's driving thread loop, before "
+        "step(). raise = the driving thread dies mid-decode (the "
+        "crash-recovery drill)."),
+    "serving.admission": (
+        "Entry of the driving thread's queue drain (_drain_pending). "
+        "delay = admission stalls while decode continues."),
+    "paged_kv.ensure": (
+        "Entry of PagedKVCache.ensure_capacity. flag = the site raises "
+        "the allocator's typed pool-exhausted RuntimeError without "
+        "touching the free list (drills the engine's eviction relief)."),
+    "paged_kv.cow": (
+        "Entry of make_positions_exclusive, before any copy. flag = the "
+        "site raises a real CowPoolExhausted carrying the live pools "
+        "(drills the adopt-pools-and-retry contract)."),
+    "radix.digest": (
+        "Prefix-cache lookup digest chain. flag = the match walk reads a "
+        "WRONG cache entry for the computed digest, so the verified-"
+        "tokens fallback must degrade it to a miss/collision instead of "
+        "serving another prompt's KV."),
+}
+
+ACTIONS = ("raise", "delay", "flag")
+
+
+class InjectedFault(RuntimeError):
+    """A fault-injection point fired with action=raise."""
+
+    def __init__(self, message, point=""):
+        super().__init__(message)
+        self.point = point
+
+
+class _State:
+    """One slot load per ``fire()`` when disabled."""
+
+    __slots__ = ("on",)
+
+    def __init__(self):
+        self.on = False
+
+
+_state = _State()
+_lock = threading.Lock()
+_specs = {}          # point -> _Spec
+_trips = []          # [(point, action)] in trip order
+
+
+class _Spec:
+    __slots__ = ("point", "action", "delay_s", "nth", "prob", "seed",
+                 "times", "calls", "trip_count", "_rng")
+
+    def __init__(self, point, action, delay_s, nth, prob, seed, times):
+        self.point = point
+        self.action = action
+        self.delay_s = delay_s
+        self.nth = nth
+        self.prob = prob
+        self.seed = seed
+        # default bound: nth-triggers fire once (a kill drill kills once,
+        # then the recovered engine must run clean); prob-triggers keep
+        # drawing unless bounded
+        self.times = times if times is not None \
+            else (1 if nth is not None else None)
+        self.calls = 0
+        self.trip_count = 0
+        self._rng = random.Random(seed)
+
+    def triggered(self):
+        self.calls += 1
+        if self.times is not None and self.trip_count >= self.times:
+            return False
+        if self.nth is not None:
+            if self.calls < self.nth:
+                return False
+        elif self.prob is not None:
+            if self._rng.random() >= self.prob:
+                return False
+        self.trip_count += 1
+        return True
+
+
+def enabled():
+    return _state.on
+
+
+def enable():
+    _state.on = True
+
+
+def disable():
+    _state.on = False
+
+
+def armed():
+    """Snapshot of armed points: {point: (action, trips_so_far)}."""
+    with _lock:
+        return {p: (s.action, s.trip_count) for p, s in _specs.items()}
+
+
+def arm(point, action="raise", delay_s=0.05, nth=None, prob=None, seed=0,
+        times=None):
+    """Arm one injection point. ``nth=N`` triggers from the Nth call on
+    (``times`` bounds total trips, default 1 for nth-triggers);
+    ``prob=P`` triggers with probability P per call, drawn from the
+    explicit ``seed`` so runs replay. Arming enables the harness."""
+    if point not in POINTS:
+        raise ValueError(f"unknown fault point {point!r} "
+                         f"(known: {sorted(POINTS)})")
+    if action not in ACTIONS:
+        raise ValueError(f"unknown action {action!r} (known: {ACTIONS})")
+    if nth is None and prob is None:
+        nth = 1
+    with _lock:
+        _specs[point] = _Spec(point, action, float(delay_s),
+                              None if nth is None else int(nth),
+                              None if prob is None else float(prob),
+                              int(seed), times)
+    _state.on = True
+
+
+def disarm(point=None):
+    """Disarm one point (or all); the harness disables when nothing
+    stays armed."""
+    with _lock:
+        if point is None:
+            _specs.clear()
+        else:
+            _specs.pop(point, None)
+        if not _specs:
+            _state.on = False
+
+
+def reset():
+    """Disarm everything and drop the trip record (test isolation)."""
+    with _lock:
+        _specs.clear()
+        del _trips[:]
+    _state.on = False
+
+
+def trips():
+    """[(point, action)] recorded by every trip so far."""
+    return list(_trips)
+
+
+def _export(point):
+    """Best-effort telemetry for one trip: counter + span. Never raises —
+    the drill is the contract, the telemetry documents it."""
+    try:
+        from .. import monitor as _m
+
+        if _m._state.on:
+            _m.counter("paddle_tpu_monitor_fault_injections_total",
+                       labelnames=("point",)).labels(point).inc()
+        t = _m.trace
+        if t._state.on:
+            now = _m.now_ns()
+            t.record_span("monitor.fault_injection", now, now,
+                          attrs={"point": point})
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def fire(point):
+    """One call of the named injection point. Returns None when disarmed
+    or not triggered. When triggered: ``raise`` raises
+    :class:`InjectedFault`, ``delay`` sleeps ``delay_s`` then returns the
+    spec, ``flag`` returns the spec for the site to interpret (typed
+    local error, corrupted value)."""
+    if not _state.on:
+        return None
+    with _lock:
+        spec = _specs.get(point)
+        if spec is None or not spec.triggered():
+            return None
+        _trips.append((point, spec.action))
+    _export(point)
+    if spec.action == "raise":
+        raise InjectedFault(
+            f"injected fault at {point!r} (trip {spec.trip_count})",
+            point=point)
+    if spec.action == "delay":
+        time.sleep(spec.delay_s)
+    return spec
+
+
+def install_from_env(env=None):
+    """Arm from ``PADDLE_TPU_FAULTS``: semicolon-separated
+    ``point:action[:k=v[,k=v...]]`` specs, e.g.
+    ``serving.drive:raise:nth=12;paged_kv.cow:flag:prob=0.5,seed=7``.
+    Unknown points/actions warn and are skipped (a typo must not turn
+    the drill into a silent no-op AND must not crash serving). Returns
+    the armed point names."""
+    spec = (env if env is not None
+            else os.environ.get("PADDLE_TPU_FAULTS", "")).strip()
+    if not spec:
+        return ()
+    armed_points = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        point = fields[0].strip()
+        action = fields[1].strip() if len(fields) > 1 and fields[1] \
+            else "raise"
+        kwargs = {}
+        bad = False
+        if len(fields) > 2 and fields[2].strip():
+            for kv in fields[2].split(","):
+                if "=" not in kv:
+                    bad = True
+                    break
+                k, v = kv.split("=", 1)
+                k = k.strip()
+                try:
+                    if k in ("nth", "times", "seed"):
+                        kwargs[k] = int(v)
+                    elif k in ("prob", "delay_s"):
+                        kwargs[k] = float(v)
+                    else:
+                        bad = True
+                except ValueError:
+                    bad = True
+                if bad:
+                    break
+        if bad or point not in POINTS or action not in ACTIONS:
+            import warnings
+
+            warnings.warn(f"PADDLE_TPU_FAULTS: bad spec {part!r} "
+                          f"(points: {sorted(POINTS)}; actions: "
+                          f"{ACTIONS}); skipped", stacklevel=2)
+            continue
+        arm(point, action, **kwargs)
+        armed_points.append(point)
+    return tuple(armed_points)

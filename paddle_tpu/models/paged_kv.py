@@ -29,7 +29,10 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..analysis import faultinject as _fi
+
 __all__ = ["PagedKVCache", "CowPoolExhausted", "alloc_blocks",
+           "read_blocks",
            "paged_write_decode", "paged_write_prefill", "paged_write_mixed",
            "paged_attention_decode", "paged_write_decode_int8",
            "paged_write_prefill_int8", "paged_attention_decode_int8"]
@@ -111,6 +114,14 @@ class PagedKVCache:
         The nothing-to-grant case is detected vectorized up front: it IS
         the serving steady state, and a per-row python loop there costs
         more than the compiled step saves."""
+        _sp = _fi.fire("paged_kv.ensure")
+        if _sp is not None and _sp.action == "flag":
+            # chaos drill: the allocator's typed exhaustion error without
+            # touching the free list — the engine's eviction/spill relief
+            # must absorb it (a delay spec just slept inside fire())
+            raise RuntimeError(
+                "paged KV pool exhausted: no free blocks (injected fault; "
+                f"pool={self.num_blocks}, block={self.block_size})")
         tables = self._tables_np
         owned = (tables > 0).sum(axis=1)
         need_arr = np.asarray(seq_lens_next)
@@ -212,6 +223,71 @@ class PagedKVCache:
             self._refs[blk] += 1
         self.block_tables = jnp.asarray(tables.copy())
 
+    # -- host-RAM spill/restore (serving resilience) -------------------------
+    def take_blocks(self, n):
+        """Pop ``n`` free blocks for a restore (spilled radix prefixes,
+        preempted-request KV): each comes back with one reference — the
+        restorer owns it. Returns None (taking nothing) when the pool
+        lacks headroom, so a restore can degrade to a recompute instead
+        of starving live sequences."""
+        n = int(n)
+        if n <= 0 or len(self._free) < n:
+            return None
+        blks = [self._free.pop() for _ in range(n)]
+        for blk in blks:
+            self._refs[blk] = 1
+        mon = _mon()
+        if mon[0].on:
+            mon[1].set(len(self._free))
+        return blks
+
+    def place_blocks(self, b, blocks):
+        """Map ``blocks`` (owned by the caller via :meth:`take_blocks`)
+        into the HEAD of empty row ``b`` — the restore path of a
+        preempted request: its spilled KV re-uploads into these blocks
+        at the same in-block offsets, so the continuation is bit-exact."""
+        tables = self._tables_np
+        if (tables[b] > 0).any():
+            raise ValueError(f"row {b} already holds blocks")
+        if len(blocks) > self.max_blocks_per_seq:
+            raise ValueError("restore longer than max_blocks_per_seq")
+        for i, blk in enumerate(blocks):
+            tables[b, i] = int(blk)
+        self.block_tables = jnp.asarray(tables.copy())
+
+    def write_block_contents(self, pools, blocks, contents):
+        """Upload host-RAM block contents into pool ``blocks`` (one
+        donated scatter): ``contents`` is a per-layer list of
+        ``(k, v)`` numpy arrays shaped ``[n, block_size, kv_heads,
+        head_dim]``. Index vectors pad to a power-of-two length (padding
+        writes zeros into the null block — benign) so the jitted upload
+        compiles for O(log) distinct shapes, exactly like the CoW copy."""
+        n = len(blocks)
+        if n == 0:
+            return pools
+        m = 1
+        while m < n:
+            m *= 2
+        blks = np.zeros(m, np.int32)
+        blks[:n] = np.asarray(blocks, np.int32)
+        padded = []
+        for k_np, v_np in contents:
+            if m != n:
+                pad = ((0, m - n),) + ((0, 0),) * (k_np.ndim - 1)
+                k_np = np.pad(k_np, pad)
+                v_np = np.pad(v_np, pad)
+            padded.append((k_np, v_np))
+        fn = getattr(self, "_restore_jit", None)
+        if fn is None:
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def fn(pools, blks, vals):
+                return [(pk.at[blks].set(k.astype(pk.dtype)),
+                         pv.at[blks].set(v.astype(pv.dtype)))
+                        for (pk, pv), (k, v) in zip(pools, vals)]
+
+            self._restore_jit = fn
+        return fn(pools, jnp.asarray(blks), padded)
+
     def make_positions_exclusive(self, rows, positions, pools):
         """Copy-on-write for the mixed serving step: before row ``rows[i]``
         writes at ``positions[i]``, any targeted block that is SHARED
@@ -219,6 +295,14 @@ class PagedKVCache:
         copy in one donated gather/scatter. The generalized, per-row form
         of :meth:`make_tail_exclusive`; plain unshared decode takes the
         cheap all-refs<=1 early exit."""
+        _sp = _fi.fire("paged_kv.cow")
+        if _sp is not None and _sp.action == "flag":
+            # chaos drill: a REAL CowPoolExhausted carrying the live
+            # (unconsumed) pools, raised before any copy — the caller's
+            # adopt-pools-evict-retry path runs against valid buffers
+            raise CowPoolExhausted(
+                "paged KV pool exhausted during copy-on-write (injected "
+                f"fault; pool={self.num_blocks})", pools)
         if (self._refs <= 1).all():
             return pools
         mon = _mon()
@@ -360,6 +444,21 @@ class PagedKVCache:
 def alloc_blocks(batch, max_len, block_size):
     """Static shape helper: blocks per sequence for a max_len budget."""
     return -(-max_len // block_size)
+
+
+def read_blocks(pools, blocks):
+    """Download pool ``blocks`` to host RAM (the SPILL read): a per-layer
+    list of ``(k, v)`` numpy arrays ``[n, block_size, kv_heads,
+    head_dim]``. This is a deliberate device→host transfer on the
+    resilience path (pool pressure / preemption), never the serving hot
+    loop — the spilled bits round-trip exactly, which is what makes
+    restore-then-decode bit-identical."""
+    blks = jnp.asarray(np.asarray(blocks, np.int32))
+    out = []
+    for k, v in pools:
+        out.append((np.asarray(jax.device_get(k[blks])),    # graftlint: disable=GL002
+                    np.asarray(jax.device_get(v[blks]))))   # graftlint: disable=GL002
+    return out
 
 
 def _decode_scatter_idx(block_tables, seq_lens, bs):

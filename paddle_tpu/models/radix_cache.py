@@ -35,7 +35,22 @@ import hashlib
 
 import numpy as np
 
+from ..analysis import faultinject as _fi
+
 __all__ = ["PrefixCache"]
+
+_MON = None  # (state, spilled-blocks gauge, restores counter)
+
+
+def _mon():
+    global _MON
+    if _MON is None:
+        from .. import monitor as _m
+
+        _MON = (_m._state,
+                _m.gauge("paddle_tpu_kv_spilled_blocks"),
+                _m.counter("paddle_tpu_kv_spill_restores_total"))
+    return _MON
 
 
 def _digest(parent, tokens):
@@ -57,10 +72,24 @@ class _Entry:
         self.block = block      # physical block id in the pool
 
 
+class _SpillEntry:
+    """One evicted-but-hot block parked in host RAM: the chain metadata
+    plus the block's exact KV bits per layer (``(k, v)`` numpy pairs)."""
+
+    __slots__ = ("digest", "parent", "tokens", "payload")
+
+    def __init__(self, digest, parent, tokens, payload):
+        self.digest = digest
+        self.parent = parent
+        self.tokens = tokens
+        self.payload = payload
+
+
 class PrefixCache:
     """Content index over one :class:`PagedKVCache` pool."""
 
-    def __init__(self, pager, capacity_blocks=None):
+    def __init__(self, pager, capacity_blocks=None, spill=False,
+                 spill_capacity_blocks=None):
         self._pager = pager
         self.block_size = pager.block_size
         # digest -> _Entry, insertion order = LRU order (move_to_end on use)
@@ -72,11 +101,18 @@ class PrefixCache:
         # pinned, never matchable) descendants
         self._nchildren = {}
         self.capacity = capacity_blocks
+        # host-RAM spill store (serving resilience, ROADMAP 5b): evicted
+        # entries park their exact KV bits here instead of vanishing, and
+        # a later prefix match restores them into fresh pool blocks
+        self.spill = bool(spill)
+        self.spill_capacity = spill_capacity_blocks
+        self._spilled = collections.OrderedDict()  # digest -> _SpillEntry
         self.hits = 0                # lookups that matched >= 1 block
         self.misses = 0
         self.blocks_shared = 0       # blocks mapped into admitted requests
         self.collisions = 0          # digest hits with mismatched tokens
         self.evicted = 0
+        self.restores = 0            # spilled blocks restored to the pool
 
     def __len__(self):
         return len(self._entries)
@@ -97,6 +133,17 @@ class PrefixCache:
             tokens = prompt[i * bs:(i + 1) * bs]
             d = _digest(parent, tokens)
             e = self._entries.get(d)
+            # the fire() is gated on a non-empty cache so an nth trigger
+            # is never consumed by a lookup the corruption cannot touch
+            _sp = _fi.fire("radix.digest") if self._entries else None
+            if _sp is not None and _sp.action == "flag":
+                # chaos drill: the digest chain hands back a WRONG entry
+                # (index corruption: right digest, other content) — the
+                # verified-tokens fallback below must degrade this to a
+                # collision/miss, never serve another prompt's KV
+                blk = next(iter(self._entries.values())).block
+                e = _Entry(d, parent, (tokens + 1).astype(tokens.dtype),
+                           blk)
             if e is None:
                 break
             if not np.array_equal(e.tokens, tokens):
@@ -154,13 +201,16 @@ class PrefixCache:
         return registered
 
     # -- eviction -------------------------------------------------------------
-    def evict(self, n_blocks):
+    def evict(self, n_blocks, pools=None):
         """Release up to ``n_blocks`` least-recently-used LEAF entries
         whose block is referenced ONLY by the cache (refs == 1) — blocks
         still mapped into live requests are never reclaimed, and an entry
         with live children is skipped so chains shed from the tail (a
         beheaded root would leave its descendants pinned but unmatchable).
-        Returns the number of blocks actually handed back to the pool."""
+        With spill enabled (and the live ``pools`` passed), each evicted
+        block's exact KV bits park in host RAM first, restorable on a
+        later prefix match. Returns the number of blocks actually handed
+        back to the pool."""
         freed = 0
         while freed < n_blocks:
             progressed = False
@@ -171,6 +221,8 @@ class PrefixCache:
                 if self._nchildren.get(d, 0) > 0 \
                         or self._pager._refs[e.block] != 1:
                     continue
+                if self.spill and pools is not None:
+                    self._spill_entry(e, pools)
                 self._drop(e)
                 freed += 1
                 self.evicted += 1
@@ -178,6 +230,81 @@ class PrefixCache:
             if not progressed:
                 break   # everything left is live or an interior node
         return freed
+
+    def _spill_entry(self, e, pools):
+        from . import paged_kv as _pk
+
+        payload = [(k[0], v[0]) for k, v in
+                   _pk.read_blocks(pools, [e.block])]
+        self._spilled[e.digest] = _SpillEntry(e.digest, e.parent,
+                                              e.tokens, payload)
+        self._spilled.move_to_end(e.digest)
+        if self.spill_capacity is not None:
+            while len(self._spilled) > self.spill_capacity:
+                self._spilled.popitem(last=False)
+        mon = _mon()
+        if mon[0].on:
+            mon[1].set(len(self._spilled))
+
+    def restore_chain(self, prompt, blocks, shared, pools):
+        """Continue a :meth:`match` result through the host-RAM spill
+        store: every spilled entry chaining past the device-resident
+        prefix is restored into a freshly allocated pool block (exact KV
+        bits re-uploaded) and re-indexed. Returns the extended
+        ``(blocks, shared_tokens, pools)`` — unchanged when nothing is
+        spilled or the pool lacks headroom (the miss then recomputes,
+        which is always correct)."""
+        if not self._spilled:
+            return blocks, shared, pools
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        bs = self.block_size
+        n_full = len(prompt) // bs
+        parent = b""
+        for i in range(shared // bs):
+            parent = _digest(parent, prompt[i * bs:(i + 1) * bs])
+        todo = []
+        for i in range(shared // bs, n_full):
+            tokens = prompt[i * bs:(i + 1) * bs]
+            d = _digest(parent, tokens)
+            se = self._spilled.get(d)
+            if se is None or d in self._entries \
+                    or not np.array_equal(se.tokens, tokens):
+                break
+            todo.append(se)
+            parent = d
+        if not todo:
+            return blocks, shared, pools
+        blks = self._pager.take_blocks(len(todo))
+        if blks is None:
+            return blocks, shared, pools
+        contents = []
+        for layer in range(len(todo[0].payload)):
+            contents.append((
+                np.stack([se.payload[layer][0] for se in todo]),
+                np.stack([se.payload[layer][1] for se in todo])))
+        pools = self._pager.write_block_contents(pools, blks, contents)
+        for se, blk in zip(todo, blks):
+            del self._spilled[se.digest]
+            self._entries[se.digest] = _Entry(se.digest, se.parent,
+                                              se.tokens, blk)
+            self._by_block[blk] = se.digest
+            if se.parent:
+                self._nchildren[se.parent] = \
+                    self._nchildren.get(se.parent, 0) + 1
+        self.restores += len(todo)
+        self.blocks_shared += len(todo)
+        if not blocks:
+            # the device index missed only because the whole chain was
+            # parked in host RAM — the lookup DID match cached KV, so
+            # reclassify the miss match() just counted (re-admission
+            # prefix-hit counters must fire on a warm restore)
+            self.hits += 1
+            self.misses -= 1
+        mon = _mon()
+        if mon[0].on:
+            mon[1].set(len(self._spilled))
+            mon[2].inc(len(todo))
+        return blocks + blks, shared + len(todo) * bs, pools
 
     def _drop(self, e):
         del self._entries[e.digest]
@@ -190,9 +317,14 @@ class PrefixCache:
         self._pager.release_blocks([e.block])
 
     def clear(self):
-        """Drop the whole index (releases every cache pin)."""
+        """Drop the whole index AND the spill store (releases every
+        cache pin; the next pass starts genuinely cold)."""
         for e in self._entries.values():
             self._pager.release_blocks([e.block])
         self._entries.clear()
         self._by_block.clear()
         self._nchildren.clear()
+        self._spilled.clear()
+        mon = _mon()
+        if mon[0].on:
+            mon[1].set(0)   # no phantom spilled blocks after a clear

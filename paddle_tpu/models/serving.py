@@ -47,6 +47,7 @@ from __future__ import annotations
 
 import collections
 import itertools
+import os
 import threading
 import time
 
@@ -56,12 +57,13 @@ import jax
 import jax.numpy as jnp
 
 from . import paged_kv as _pk
+from ..analysis import faultinject as _fi
 from ..analysis import sanitizers as _sanitizers
 from .llama_decode import LlamaDecodeEngine, _rms
 from .radix_cache import PrefixCache
 
 __all__ = ["ContinuousBatchingEngine", "StaticBatchEngine",
-           "AdmissionTimeout"]
+           "AdmissionTimeout", "RequestShed", "RequestAborted"]
 
 _ENGINE_SEQ = itertools.count()
 
@@ -69,6 +71,31 @@ _ENGINE_SEQ = itertools.count()
 class AdmissionTimeout(RuntimeError):
     """submit() could not enqueue within the caller's timeout: the
     admission queue stayed full (backpressure — shed load upstream)."""
+
+
+class RequestShed(AdmissionTimeout):
+    """Typed load-shedding rejection: under sustained overload the engine
+    sheds the LOWEST-priority work — this request (or a queued victim,
+    surfaced via :meth:`ContinuousBatchingEngine.pop_shed`) was it.
+    Subclasses :class:`AdmissionTimeout` so existing backpressure
+    handlers keep working; ``tenant`` names who was shed."""
+
+    def __init__(self, message, tenant="", rid=None):
+        super().__init__(message)
+        self.tenant = tenant
+        self.rid = rid
+
+
+class RequestAborted(RuntimeError):
+    """An in-flight request was aborted by engine recovery (driving-
+    thread death or hang): ``tokens`` carries the partial output so the
+    caller can resume/retry instead of hanging silently."""
+
+    def __init__(self, message, rid=None, tokens=(), tenant=""):
+        super().__init__(message)
+        self.rid = rid
+        self.tokens = list(tokens)
+        self.tenant = tenant
 
 
 class _Mon:
@@ -80,6 +107,8 @@ class _Mon:
                  "ttft", "admitted", "rejected", "adm_rejected",
                  "pack", "chunk_depth", "pc_hits", "pc_misses", "pc_shared",
                  "pc_blocks", "pc_evictions",
+                 "shed", "tenant_depth", "aborted", "recoveries",
+                 "preemptions",
                  "jit_compiles", "jit_hits", "jit_sigs")
 
 
@@ -118,6 +147,13 @@ def _mon():
         o.pc_blocks = m.gauge("paddle_tpu_kv_prefix_cache_blocks")
         o.pc_evictions = m.counter(
             "paddle_tpu_kv_prefix_cache_evictions_total")
+        o.shed = m.counter("paddle_tpu_serving_shed_total",
+                           labelnames=("tenant",))
+        o.tenant_depth = m.gauge("paddle_tpu_serving_tenant_queue_depth",
+                                 labelnames=("tenant",))
+        o.aborted = m.counter("paddle_tpu_serving_aborted_total")
+        o.recoveries = m.counter("paddle_tpu_serving_recoveries_total")
+        o.preemptions = m.counter("paddle_tpu_serving_preemptions_total")
         o.jit_compiles = m.counter("paddle_tpu_jit_compiles_total",
                                    labelnames=("function",))
         o.jit_hits = m.counter("paddle_tpu_jit_cache_hits_total",
@@ -133,9 +169,10 @@ class _Request:
 
     __slots__ = ("rid", "prompt", "prefill_pos", "chunks", "shared_tokens",
                  "max_new", "last_token", "outputs", "t_submit", "t_admit",
-                 "t_first")
+                 "t_first", "tenant", "priority", "spill")
 
-    def __init__(self, rid, prompt, max_new, t_submit):
+    def __init__(self, rid, prompt, max_new, t_submit, tenant="",
+                 priority=0):
         self.rid = rid
         self.prompt = prompt            # np.int32 (L,)
         self.prefill_pos = 0            # prompt tokens already in KV
@@ -147,10 +184,43 @@ class _Request:
         self.t_submit = t_submit
         self.t_admit = 0
         self.t_first = 0
+        self.tenant = tenant
+        self.priority = priority
+        # preemption payload: (tokens_in_kv, per-layer host KV contents,
+        # decode_ready) — present only between a preempt and the
+        # re-admission that restores it bit-exact
+        self.spill = None
 
     @property
     def prefilled(self):
         return self.prefill_pos >= len(self.prompt)
+
+
+class _Tenant:
+    """One tenant's admission lane: weighted-fair share (stride
+    scheduling over ``1 / weight``) within its priority class."""
+
+    __slots__ = ("name", "weight", "priority", "vtime", "queue")
+
+    def __init__(self, name, weight=1.0, priority=0):
+        self.name = name
+        self.weight = float(weight)
+        if self.weight <= 0:
+            raise ValueError("tenant weight must be > 0")
+        self.priority = int(priority)
+        self.vtime = 0.0
+        self.queue = collections.deque()
+
+
+def _drain(dq):
+    """Drain a deque that concurrent threads may still be appending to
+    (popleft-until-empty is the one atomic deque idiom; no lock)."""
+    out = []
+    while True:
+        try:
+            out.append(dq.popleft())
+        except IndexError:
+            return out
 
 
 class ContinuousBatchingEngine:
@@ -166,7 +236,8 @@ class ContinuousBatchingEngine:
     def __init__(self, model, max_batch=8, max_len=None, block_size=64,
                  chunk_size=32, max_step_tokens=None, policy="fcfs",
                  decode_priority=0.0, decode_burst=4, max_queue=None,
-                 prefix_cache=True, prefill_buckets=None):
+                 prefix_cache=True, prefill_buckets=None, kv_spill=False,
+                 spill_capacity_blocks=None, strict_priority=False):
         """``max_step_tokens`` (default ``max_batch + chunk_size``) is the
         per-step token budget: decode lanes first, prefill chunks fill the
         remainder. ``policy`` orders prefill among admitted requests
@@ -181,7 +252,18 @@ class ContinuousBatchingEngine:
         submit() admission queue (backpressure; None = unbounded).
         ``prefill_buckets`` is accepted for backward compatibility and
         ignored — chunked prefill replaced bucket-padded admission
-        prefills."""
+        prefills. ``kv_spill`` enables the host-RAM resilience layer:
+        radix-cache evictions spill their KV bits to host (restorable on
+        a later prefix match) and, under pool pressure, the lowest-
+        priority active request is PREEMPTED — KV spilled, blocks freed,
+        request requeued and later restored bit-exact — instead of the
+        step failing (docs/serving.md, resilience). ``strict_priority``
+        hardens the QoS lever: queued work is DEFERRED while any
+        strictly-higher-priority request is active, so a low-priority
+        flood can never join a high-priority batch (high-priority lanes
+        keep their isolated steady state — decode bursts and all — and
+        the flood drains only into idle capacity, shedding under queue
+        pressure; the graceful-degradation mode of docs/serving.md)."""
         del prefill_buckets  # legacy knob of the bucket-prefill engine
         self._inner = LlamaDecodeEngine(model, max_len=max_len,
                                         kv_cache_layout="paged",
@@ -208,6 +290,7 @@ class ContinuousBatchingEngine:
             raise ValueError("decode_priority must be in [0, 1)")
         self.decode_burst = max(1, int(decode_burst))
         self.max_queue = None if max_queue is None else int(max_queue)
+        self.strict_priority = bool(strict_priority)
         max_blocks = -(-e.max_len // self.block_size)
         self._pager = _pk.PagedKVCache(
             num_layers=len(e.layers),
@@ -216,7 +299,10 @@ class ContinuousBatchingEngine:
             head_dim=e.head_dim, batch=self.max_batch,
             max_blocks_per_seq=max_blocks, dtype=e.emb.dtype)
         self._pools = list(zip(self._pager.k, self._pager.v))
-        self.prefix_cache = PrefixCache(self._pager) if prefix_cache \
+        self.kv_spill = bool(kv_spill)
+        self.prefix_cache = PrefixCache(
+            self._pager, spill=self.kv_spill,
+            spill_capacity_blocks=spill_capacity_blocks) if prefix_cache \
             else None
         # host-side slot state (numpy mirrors so pack assembly and
         # capacity checks vectorize — the step's host tax is part of the
@@ -235,16 +321,33 @@ class ContinuousBatchingEngine:
         # mixed-step program each); a process-wide label would falsely
         # trip the sentinel on the second engine
         self._san_tag = f"e{next(_ENGINE_SEQ)}"
-        # submit() queue (host-side); _submit_lock guards the bounded
-        # check+append only — nothing blocks and no jax dispatch runs
-        # under it (GL004)
-        self._pending = collections.deque()
+        # submit() queues (host-side, one lane per tenant); _submit_lock
+        # guards the bounded check+append only — nothing blocks and no
+        # jax dispatch runs under it (GL004)
+        self._tenants = {"": _Tenant("")}
+        self._vnow = 0.0                # WFQ virtual clock (last pop)
         self._submit_lock = threading.Lock()
         # per-request trace trees (monitor.trace): rid -> [root, queue_wait]
         self._req_spans = {}
         # per-request stats kept for the caller (bench TTFT percentiles);
         # popped via pop_stats, bounded so an indifferent caller can't leak
         self._stats = collections.OrderedDict()
+        # -- resilience state (recover / driving thread / shedding) ------
+        self._epoch = 0                 # bumped by every recover()
+        self._recover_lock = threading.Lock()
+        self._shed = collections.deque(maxlen=4096)     # RequestShed
+        self._aborted = collections.deque(maxlen=4096)  # RequestAborted
+        # driver-mode finished pairs; bounded like _shed/_aborted so a
+        # dead consumer can't grow host RSS without bound
+        self._results = collections.deque(maxlen=4096)
+        self._driver = None
+        self._drive_stop = threading.Event()
+        self._drive_args = None
+        self._dog = None
+        # [{reason, ms, aborted, cold}]; bounded: a flapping engine must
+        # not leak one record per crash loop iteration
+        self.recovery_stats = collections.deque(maxlen=256)
+        self.last_recovery_dump = None
 
     # -- compiled path -------------------------------------------------------
     def _step_jit(self):
@@ -307,7 +410,78 @@ class ContinuousBatchingEngine:
                 f"{self._pager.num_blocks - 1}")
         return prompt
 
-    def add_request(self, prompt_ids, max_new_tokens=None):
+    # -- tenants (weighted-fair queuing, priority lanes, load shedding) ------
+    def set_tenant(self, name, weight=1.0, priority=0):
+        """Configure (or reconfigure) a tenant lane: ``weight`` is the
+        weighted-fair share of admissions within the tenant's priority
+        class (stride scheduling — a weight-4 tenant admits 4x a
+        weight-1 peer under contention), ``priority`` the lane class
+        (higher admits first; under sustained overload the LOWEST
+        priority sheds first, with typed :class:`RequestShed`
+        rejections). Tenants submitted without configuration default to
+        weight 1, priority 0."""
+        with self._submit_lock:
+            t = self._tenants.get(name)
+            if t is None:
+                t = self._tenants[name] = _Tenant(name, weight, priority)
+                t.vtime = self._vnow
+            else:
+                new_w = float(weight)
+                if new_w <= 0:
+                    raise ValueError("tenant weight must be > 0")
+                t.weight = new_w
+                t.priority = int(priority)
+
+    def _tenant_locked(self, name):
+        t = self._tenants.get(name)
+        if t is None:
+            t = self._tenants[name] = _Tenant(name)
+            t.vtime = self._vnow
+        return t
+
+    def _prioritized(self):
+        return len({t.priority for t in list(self._tenants.values())}) > 1
+
+    def _shed_victim_locked(self, priority):
+        """The queued request shed for a priority-``priority`` arrival:
+        newest request of the lowest-priority non-empty lane STRICTLY
+        below the arrival (equal-priority work is never displaced)."""
+        best = None
+        for t in self._tenants.values():
+            if not t.queue or t.priority >= priority:
+                continue
+            if best is None or t.priority < best.priority:
+                best = t
+        if best is None:
+            return None
+        return best, best.queue.pop()    # newest: it waited least
+
+    def _shed_locked(self, ten, req, mon, why):
+        err = RequestShed(
+            f"request {req.rid} (tenant {ten.name!r}) shed under "
+            f"overload: {why}", tenant=ten.name, rid=req.rid)
+        self._shed.append(err)
+        entry = self._req_spans.pop(req.rid, None)
+        if entry is not None:
+            mon.trace.drop(entry[1])
+            mon.trace.end_span(entry[0])
+        self._stats[req.rid] = {
+            "rid": req.rid, "tenant": ten.name, "shed": True,
+            "prompt_len": len(req.prompt), "submit_ns": req.t_submit}
+        while len(self._stats) > 4096:
+            self._stats.popitem(last=False)
+        if mon.state.on:
+            mon.shed.labels(ten.name).inc()
+
+    def pop_shed(self):
+        """Drain the typed :class:`RequestShed` records of queued
+        requests displaced by higher-priority arrivals (the shed
+        request's owner learns here; an arrival shed on ITS OWN submit
+        gets the exception directly)."""
+        return _drain(self._shed)
+
+    # -- admission -----------------------------------------------------------
+    def add_request(self, prompt_ids, max_new_tokens=None, tenant=""):
         """Admit one prompt into a free slot; returns the request id (or
         None when the batch is full — callers queue and retry, or use
         submit() which queues host-side). The prompt's KV is built by
@@ -324,13 +498,16 @@ class ContinuousBatchingEngine:
         with self._submit_lock:
             # rid minting shares the counter with producer-thread
             # submit()s — unlocked, two requests could get one id
+            ten = self._tenant_locked(tenant)
             rid = self._next_rid
             self._next_rid += 1
-        req = _Request(rid, prompt, max_new_tokens, mon.mod.now_ns())
+        req = _Request(rid, prompt, max_new_tokens, mon.mod.now_ns(),
+                       tenant=tenant, priority=ten.priority)
         self._admit(slot, req)
         return rid
 
-    def submit(self, prompt_ids, max_new_tokens=None, timeout=None):
+    def submit(self, prompt_ids, max_new_tokens=None, timeout=None,
+               tenant=""):
         """Always-queueing admission: the request waits host-side until
         the DRIVING thread's next step() (or add_request()) assigns it a
         free slot, then prefills chunk-by-chunk inside step packs.
@@ -338,32 +515,61 @@ class ContinuousBatchingEngine:
         prefill). submit() is the engine's one thread-safe entry point —
         it only enqueues, never touching slot state, so any number of
         producer threads may call it while one thread drives step().
-        With a bounded queue (``max_queue``), a full queue raises
-        :class:`AdmissionTimeout` — immediately when ``timeout`` is None,
-        else after blocking up to ``timeout`` seconds for the stepping
-        thread to drain space."""
+        With a bounded queue (``max_queue``), a full queue first sheds
+        the newest QUEUED request of any strictly-lower-priority tenant
+        (typed :class:`RequestShed`, surfaced via :meth:`pop_shed`) to
+        make room; when nothing outranks, it raises — immediately when
+        ``timeout`` is None, else after blocking up to ``timeout``
+        seconds for the stepping thread to drain space. The raise is a
+        :class:`RequestShed` when priority lanes are configured (this
+        arrival IS the lowest-priority work), else the plain
+        :class:`AdmissionTimeout`."""
         prompt = self._check_prompt(prompt_ids)
         mon = _mon()
         t_submit = mon.mod.now_ns()
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             with self._submit_lock:
-                if self.max_queue is None \
-                        or len(self._pending) < self.max_queue:
+                ten = self._tenant_locked(tenant)
+                total = sum(len(t.queue)
+                            for t in self._tenants.values())
+                victim = None
+                if self.max_queue is not None and total >= self.max_queue:
+                    victim = self._shed_victim_locked(ten.priority)
+                if self.max_queue is None or total < self.max_queue \
+                        or victim is not None:
+                    if victim is not None:
+                        self._shed_locked(
+                            victim[0], victim[1], mon,
+                            f"displaced by a priority-{ten.priority} "
+                            f"arrival (queue full at {self.max_queue})")
                     rid = self._next_rid
                     self._next_rid += 1
-                    req = _Request(rid, prompt, max_new_tokens, t_submit)
+                    req = _Request(rid, prompt, max_new_tokens, t_submit,
+                                   tenant=tenant, priority=ten.priority)
                     if mon.tstate.on:
-                        root = mon.trace.start_span("serving.request",
-                                                    attrs={"rid": rid})
+                        root = mon.trace.start_span(
+                            "serving.request", attrs={"rid": rid})
                         self._req_spans[rid] = [
                             root, mon.trace.start_span("serving.queue_wait",
                                                        parent=root)]
-                    self._pending.append(req)
+                    if not ten.queue:
+                        # an idle lane re-syncs to the virtual clock, or
+                        # its lagging vtime would grant an unfair burst
+                        ten.vtime = max(ten.vtime, self._vnow)
+                    ten.queue.append(req)
                     break
             if deadline is None or time.monotonic() >= deadline:
                 if mon.state.on:
                     mon.adm_rejected.inc()
+                if self._prioritized():
+                    if mon.state.on:
+                        mon.shed.labels(tenant).inc()
+                    raise RequestShed(
+                        f"load shed: admission queue full "
+                        f"({self.max_queue} requests) and tenant "
+                        f"{tenant!r} (priority {ten.priority}) outranks "
+                        "no queued work", tenant=tenant)
                 raise AdmissionTimeout(
                     f"admission queue full ({self.max_queue} requests)"
                     + ("" if timeout is None
@@ -383,20 +589,44 @@ class ContinuousBatchingEngine:
         return None
 
     def _pop_pending(self):
-        """Next queued request per the admission policy (fcfs | spf)."""
+        """Next queued request: highest priority class first, weighted-
+        fair (stride scheduling on ``1 / weight``) among that class's
+        tenants, then the admission policy (fcfs | spf) within the
+        chosen tenant's lane."""
         with self._submit_lock:
-            if not self._pending:
+            ready = [t for t in self._tenants.values() if t.queue]
+            if not ready:
                 return None
+            pmax = max(t.priority for t in ready)
+            if self.strict_priority:
+                # defer queued work that a strictly-higher-priority
+                # ACTIVE request outranks: the flood never joins a
+                # high-priority batch (slots read-only here; the driving
+                # thread owns them and is the only _pop_pending caller)
+                act = [s.priority for s in self._slots if s is not None]
+                if act and pmax < max(act):
+                    return None
+            cands = [t for t in ready if t.priority == pmax]
+            ten = min(cands, key=lambda t: (t.vtime, t.name))
+            self._vnow = ten.vtime
+            ten.vtime += 1.0 / ten.weight
             if self.policy == "spf":
-                req = min(self._pending, key=lambda r: len(r.prompt))
-                self._pending.remove(req)
+                req = min(ten.queue, key=lambda r: len(r.prompt))
+                ten.queue.remove(req)
                 return req
-            return self._pending.popleft()
+            return ten.queue.popleft()
+
+    def _requeue_front(self, req):
+        """Head-of-lane requeue for a PREEMPTED request (it was already
+        admitted once; it resumes before new arrivals of its tenant)."""
+        with self._submit_lock:
+            self._tenant_locked(req.tenant).queue.appendleft(req)
 
     def _drain_pending(self):
         """Assign queued requests to free slots (no compute here: the
         prompt KV is built by chunked prefill inside step packs). Driving
         thread only — see the class threading contract."""
+        _fi.fire("serving.admission")
         while True:
             slot = self._free_slot()
             if slot is None:
@@ -404,7 +634,21 @@ class ContinuousBatchingEngine:
             req = self._pop_pending()
             if req is None:
                 return
-            self._admit(slot, req)
+            if req.spill is not None:
+                if not self._restore(slot, req):
+                    # the pool lacks headroom to restore the preempted
+                    # KV: park the request back at the head of its lane
+                    # and stop admitting — an eviction must free blocks.
+                    # Refund the WFQ charge _pop_pending just took, or a
+                    # stalled restore inflates the tenant's vtime once
+                    # per blocked step and starves its later arrivals.
+                    self._requeue_front(req)
+                    with self._submit_lock:
+                        ten = self._tenant_locked(req.tenant)
+                        ten.vtime -= 1.0 / ten.weight
+                    return
+            else:
+                self._admit(slot, req)
 
     def _admit(self, slot, req):
         mon = _mon()
@@ -425,6 +669,12 @@ class ContinuousBatchingEngine:
         # copy-on-writes the shared tail block
         if self.prefix_cache is not None:
             blocks, shared = self.prefix_cache.match(req.prompt)
+            if self.kv_spill:
+                # evicted-but-hot prefixes parked in host RAM rejoin the
+                # chain here: restored bit-exact into fresh pool blocks
+                blocks, shared, self._pools = \
+                    self.prefix_cache.restore_chain(
+                        req.prompt, blocks, shared, self._pools)
             if blocks:
                 self._pager.adopt_blocks(slot, blocks)
                 req.shared_tokens = shared
@@ -439,6 +689,7 @@ class ContinuousBatchingEngine:
         self._decode_ready[slot] = False
         self._stats[req.rid] = {
             "rid": req.rid, "slot": slot, "prompt_len": L,
+            "tenant": req.tenant,
             "shared_tokens": req.shared_tokens, "submit_ns": req.t_submit}
         if len(self._stats) > 4096:
             self._stats.popitem(last=False)
@@ -452,20 +703,144 @@ class ContinuousBatchingEngine:
         from here after each eviction."""
         return self._stats.pop(rid, None)
 
+    # -- preemption + restore (host-RAM KV spill under pool pressure) --------
+    def _preempt_lowest(self, exclude=()):
+        """Preempt the lowest-priority active request (ties: newest
+        first): its exact KV bits spill to host RAM, its blocks return to
+        the pool, and the request rejoins the HEAD of its tenant's lane —
+        restored bit-exact by :meth:`_restore` on re-admission. Returns
+        the freed slot, or None when nothing is preemptible."""
+        skip = set(int(b) for b in exclude)
+        cands = [b for b in range(self.max_batch)
+                 if self._slots[b] is not None and b not in skip]
+        if not cands:
+            return None
+        slot = min(cands, key=lambda b: (self._slots[b].priority,
+                                         -self._slots[b].rid))
+        mon = _mon()
+        t0 = mon.mod.now_ns()
+        req = self._slots[slot]
+        n_tok = int(self.lens[slot])
+        nblk = -(-n_tok // self.block_size) if n_tok else 0
+        contents = None
+        if nblk:
+            blocks = [int(b) for b in self._pager._tables_np[slot][:nblk]]
+            contents = _pk.read_blocks(self._pools, blocks)
+        req.spill = (n_tok, contents, bool(self._decode_ready[slot]))
+        self._pager.free_sequence(slot)
+        self._slots[slot] = None
+        self._active[slot] = False
+        self._decode_ready[slot] = False
+        self.lens[slot] = 0
+        self._requeue_front(req)
+        if mon.tstate.on:
+            entry = self._req_spans.get(req.rid)
+            mon.trace.record_span(
+                "serving.preempt", t0, mon.mod.now_ns(),
+                parent=None if entry is None else entry[0],
+                attrs={"slot": slot, "rid": req.rid,
+                       "tokens_in_kv": n_tok})
+        if mon.state.on:
+            mon.preemptions.inc()
+            self._update_gauges(mon)
+        return slot
+
+    def _restore(self, slot, req):
+        """Re-admit a preempted request: fresh blocks, the spilled KV
+        bits re-uploaded at the same in-block offsets, slot state
+        rebuilt — the continuation is bit-identical to an undisturbed
+        run. Returns False (leaving the request untouched) when the pool
+        lacks headroom even after cache relief."""
+        n_tok, contents, decode_ready = req.spill
+        nblk = -(-n_tok // self.block_size) if n_tok else 0
+        blks = []
+        if nblk:
+            blks = self._pager.take_blocks(nblk)
+            if blks is None and self.prefix_cache is not None \
+                    and len(self.prefix_cache):
+                mon = _mon()
+                freed = self.prefix_cache.evict(nblk, pools=self._pools)
+                if mon.state.on and freed:
+                    mon.pc_evictions.inc(freed)
+                    mon.pc_blocks.set(len(self.prefix_cache))
+                blks = self._pager.take_blocks(nblk)
+            if blks is None:
+                return False
+        mon = _mon()
+        req.t_admit = mon.mod.now_ns()
+        if nblk:
+            self._pager.place_blocks(slot, blks)
+            self._pools = self._pager.write_block_contents(
+                self._pools, blks, contents)
+        req.spill = None
+        self.lens[slot] = n_tok
+        self._slots[slot] = req
+        self._active[slot] = True
+        self._decode_ready[slot] = decode_ready
+        self._last_tok[slot] = req.last_token
+        st = self._stats.get(req.rid)
+        if st is None:
+            st = self._stats[req.rid] = {
+                "rid": req.rid, "prompt_len": len(req.prompt),
+                "tenant": req.tenant,
+                "shared_tokens": req.shared_tokens,
+                "submit_ns": req.t_submit}
+        st["slot"] = slot
+        st["restored"] = True
+        if mon.state.on:
+            self._update_gauges(mon)
+        return True
+
     # -- the mixed step ------------------------------------------------------
     def step(self, eos_token_id=None, max_new_tokens=None):
         """ONE compiled mixed step: every prefilled slot decodes one
         token; admitted-but-unprefilled slots consume prefill chunks from
         the remaining token budget. Returns the finished
         (request_id, tokens) pairs evicted this step."""
-        san = _sanitizers
-        if san._state.hostsync:
-            # graftsan: the step is device-resident by contract (GL002) —
-            # a Tensor host sync inside it is a regression the tripwire
-            # turns into an immediate raise
-            with san.protected_region("serving.step"):
-                return self._step_impl(eos_token_id, max_new_tokens)
-        return self._step_impl(eos_token_id, max_new_tokens)
+        epoch = self._epoch
+        mon = _mon()
+        sp = None
+        if mon.tstate.on:
+            # an OPEN serving.step span is what a flight dump names when
+            # the driving thread hangs or dies mid-step
+            sp = mon.trace.start_span("serving.step",
+                                      attrs={"engine": self._san_tag})
+        try:
+            # chaos drills kill/hang the step INSIDE the open span, so
+            # the hang dump lists serving.step among its open spans
+            _fi.fire("serving.step")
+            if epoch != self._epoch:
+                # a recovery superseded this step while it was stuck at
+                # the injection point — the new epoch owns the slot state
+                return []
+            san = _sanitizers
+            try:
+                if san._state.hostsync:
+                    # graftsan: the step is device-resident by contract
+                    # (GL002) — a Tensor host sync inside it is a
+                    # regression the tripwire turns into a raise
+                    with san.protected_region("serving.step"):
+                        finished = self._step_impl(eos_token_id,
+                                                   max_new_tokens)
+                else:
+                    finished = self._step_impl(eos_token_id,
+                                               max_new_tokens)
+            except Exception:
+                if epoch != self._epoch:
+                    # a hang recovery superseded this SLOW-but-alive
+                    # step mid-flight (e.g. the watchdog timeout was
+                    # tighter than a compile): its crash hit the dead
+                    # epoch's state, not the recovered engine's
+                    return []
+                raise
+            if epoch != self._epoch:
+                # recovery aborted (and possibly re-admitted) every
+                # request this step computed for — its results belong
+                # to the dead epoch and must not double-report
+                return []
+            return finished
+        finally:
+            mon.trace.end_span(sp)
 
     def _ensure(self, need):
         """ensure_capacity with radix-cache relief: pool exhaustion evicts
@@ -484,13 +859,20 @@ class ContinuousBatchingEngine:
         shortfall = int(np.maximum(want - owned, 0).sum()) \
             - len(pager._free)
         mon = _mon()
-        freed = self.prefix_cache.evict(max(shortfall, 1))
+        freed = self.prefix_cache.evict(max(shortfall, 1),
+                                        pools=self._pools)
         if mon.state.on and freed:
             mon.pc_evictions.inc(freed)
             mon.pc_blocks.set(len(self.prefix_cache))
         self._pager.ensure_capacity(need)
 
     def _step_impl(self, eos_token_id, max_new_tokens):
+        # a hang (watchdog-recovered) almost always sits in the compiled
+        # dispatch below, so the epoch captured here + the fence after
+        # the dispatch fetch bound what a superseded step can touch (the
+        # microsecond host-side window before dispatch is accepted —
+        # recover() documents it)
+        epoch = self._epoch
         mon = _mon()
         self._drain_pending()
         if not self._active.any():
@@ -513,26 +895,37 @@ class ContinuousBatchingEngine:
             # means no slot is free until an eviction anyway.
             need = np.where(self._active, self.lens, 0)
             need[decode_slots] += K
-            self._ensure(need)
-            # every position the burst will write must target an
-            # UNSHARED block — CoW runs outside compiled code, so a
-            # shared write target forces the single-step path for this
-            # step (its per-position CoW handles it)
-            t = self._pager._tables_np
-            first = self.lens[decode_slots] // self.block_size
-            last = (self.lens[decode_slots] + K - 1) // self.block_size
-            targets = np.concatenate(
-                [t[b, f:g + 1] for b, f, g in
-                 zip(decode_slots, first, last)])
-            if not (self._pager._refs[targets] > 1).any():
-                return self._burst_impl(decode_slots, eos_token_id,
-                                        max_new_tokens, mon, t0)
+            try:
+                self._ensure(need)
+                granted = True
+            except RuntimeError:
+                if not self.kv_spill:
+                    raise
+                granted = False   # single-step path preempts for room
+            if granted:
+                # every position the burst will write must target an
+                # UNSHARED block — CoW runs outside compiled code, so a
+                # shared write target forces the single-step path for
+                # this step (its per-position CoW handles it)
+                t = self._pager._tables_np
+                first = self.lens[decode_slots] // self.block_size
+                last = (self.lens[decode_slots] + K - 1) // self.block_size
+                targets = np.concatenate(
+                    [t[b, f:g + 1] for b, f, g in
+                     zip(decode_slots, first, last)])
+                if not (self._pager._refs[targets] > 1).any():
+                    return self._burst_impl(decode_slots, eos_token_id,
+                                            max_new_tokens, mon, t0,
+                                            epoch)
         if self.policy == "spf":
             prefill_slots.sort(key=lambda b: (
+                -self._slots[b].priority,
                 len(self._slots[b].prompt) - self._slots[b].prefill_pos,
                 self._slots[b].rid))
         else:
-            prefill_slots.sort(key=lambda b: self._slots[b].rid)
+            # priority lanes first (the QoS lever), then admission order
+            prefill_slots.sort(key=lambda b: (-self._slots[b].priority,
+                                              self._slots[b].rid))
         nd = len(decode_slots)
         budget = T - nd
         if self.decode_priority > 0.0:
@@ -541,10 +934,25 @@ class ContinuousBatchingEngine:
             budget = min(budget, max(1, int((1.0 - self.decode_priority)
                                             * T)))
         # capacity grants: decode slots MUST proceed; a prefill chunk that
-        # cannot get blocks (even after cache eviction) waits a step
+        # cannot get blocks (even after cache eviction) waits a step.
+        # With kv_spill, a grant the cache cannot relieve PREEMPTS the
+        # lowest-priority non-decoding request (KV to host RAM, blocks
+        # back to the pool) instead of failing the step.
         need = np.where(self._active, self.lens, 0)
         need[decode_slots] += 1
-        self._ensure(need)
+        while True:
+            try:
+                self._ensure(need)
+                break
+            except RuntimeError:
+                if not self.kv_spill:
+                    raise
+                victim = self._preempt_lowest(exclude=decode_slots)
+                if victim is None:
+                    raise
+                need[victim] = 0
+                if victim in prefill_slots:
+                    prefill_slots.remove(victim)
         chunks = []                     # (slot, start, take)
         for b in prefill_slots:
             if budget <= 0:
@@ -562,6 +970,11 @@ class ContinuousBatchingEngine:
             chunks.append((b, req.prefill_pos, take))
             budget -= take
         if not nd and not chunks:
+            if self.kv_spill and self._preempt_lowest() is not None:
+                # pool fully pinned and nothing can progress: spill one
+                # request's KV to host RAM; the freed blocks unstick the
+                # rest next step and the victim resumes bit-exact later
+                return []
             # admitted requests exist but nothing can make progress (pool
             # fully pinned by live sequences) — surface it, the caller
             # sized the pool too small for the batch
@@ -607,7 +1020,8 @@ class ContinuousBatchingEngine:
                 if self.prefix_cache is None \
                         or not len(self.prefix_cache):
                     raise
-                freed = self.prefix_cache.evict(n_lanes)
+                freed = self.prefix_cache.evict(n_lanes,
+                                                pools=self._pools)
                 if mon.state.on and freed:
                     mon.pc_evictions.inc(freed)
                     mon.pc_blocks.set(len(self.prefix_cache))
@@ -650,6 +1064,16 @@ class ContinuousBatchingEngine:
             jnp.asarray(pack_np), self._pools, self._pager.block_tables,
             slots_dev, valid_dev)
         toks = np.asarray(toks_dev)
+        if epoch != self._epoch:
+            # a hang recovery superseded this step while it sat in
+            # compile/dispatch. The pools rebind above MUST stand — the
+            # jit result is the only live buffer set on donation
+            # platforms, and the radix cache's pinned blocks live in it
+            # untouched (the step only wrote positions the dead epoch's
+            # tables mapped, all freed by the recovery) — but every host
+            # slot/table/token mutation now belongs to the new epoch:
+            # apply nothing.
+            return []
         t1 = mon.mod.now_ns()
         if mon.tstate.on:
             for b in decode_slots:
@@ -730,7 +1154,7 @@ class ContinuousBatchingEngine:
         return 2 * useful >= K * len(decode_slots)
 
     def _burst_impl(self, decode_slots, eos_token_id, max_new_tokens,
-                    mon, t0):
+                    mon, t0, epoch):
         """Steady-state fast path: K fused decode iterations, one
         dispatch, one (2, B) upload, one (B, K) download."""
         K = self.decode_burst
@@ -740,6 +1164,11 @@ class ContinuousBatchingEngine:
         toks_dev, self._pools = self._burst_jit()(
             jnp.asarray(pack), self._pools, self._pager.block_tables)
         toks = np.asarray(toks_dev)            # (B, K)
+        if epoch != self._epoch:
+            # superseded mid-dispatch: keep the pools rebind (buffer
+            # validity + the warm radix blocks), apply no host state —
+            # same fence as the mixed step
+            return []
         t1 = mon.mod.now_ns()
         nd = len(decode_slots)
         if mon.tstate.on:
@@ -806,7 +1235,12 @@ class ContinuousBatchingEngine:
             self._update_gauges(mon)
 
     def _update_gauges(self, mon):
-        mon.queue_depth.set(len(self._pending))
+        depth = 0
+        for t in list(self._tenants.values()):
+            n = len(t.queue)
+            depth += n
+            mon.tenant_depth.labels(t.name).set(n)
+        mon.queue_depth.set(depth)
         mon.occupancy.set(float(self._active.sum()) / self.max_batch)
 
     @property
@@ -815,7 +1249,192 @@ class ContinuousBatchingEngine:
 
     @property
     def num_pending(self):
-        return len(self._pending)
+        return sum(len(t.queue) for t in list(self._tenants.values()))
+
+    # -- crash/hang recovery (the drilled path) ------------------------------
+    def recover(self, reason="", stuck=""):
+        """Tear down the slot state of a dead or hung epoch and restart
+        WARM: a flight dump documents what was running (coalescing with
+        any watchdog dump of the same hang into ONE file), every in-
+        flight request is aborted with a typed :class:`RequestAborted`
+        carrying its partial tokens (drained via :meth:`pop_aborted` —
+        no caller hangs silently), slots and pager rows are freed, and
+        the radix cache SURVIVES — re-submissions of the same prompts
+        prefix-hit instead of recomputing (and with ``kv_spill``,
+        spilled prefixes restore from host RAM). Queued requests stay
+        queued. Thread-safe and idempotent per hang: concurrent
+        observers (the dying driving thread, the hang watchdog) collapse
+        to one recovery — the loser returns immediately. A SLOW-but-
+        alive step this recovery supersedes is fenced on the epoch: it
+        wakes from its dispatch, re-binds only the pool buffers (which
+        the warm restart deliberately shares — the radix cache's pinned
+        blocks live there) and applies no host slot/table state; the
+        remaining unfenced window is the microseconds of host-side pack
+        assembly before its dispatch, vs the seconds-scale hang timeout
+        that triggers a recovery at all."""
+        if not self._recover_lock.acquire(blocking=False):
+            # another observer of the same failure is already recovering
+            return None
+        try:
+            mon = _mon()
+            t0 = mon.mod.now_ns()
+            # the epoch bump FIRST: a step stuck at its injection point
+            # wakes, sees the new epoch, and returns without touching
+            # the state this recovery owns
+            self._epoch += 1
+            open_serving = [s.name for s in mon.trace.open_spans()
+                            if s.name.startswith("serving.")]
+            path = None
+            try:
+                if mon.tstate.on or os.environ.get("PADDLE_TPU_FLIGHT_DIR"):
+                    path = mon.trace.flight_dump(
+                        reason=f"serving recovery ({self._san_tag}): "
+                               f"{reason}"
+                               + (f"; stuck span: {stuck}" if stuck
+                                  else ""),
+                        extra={"engine": self._san_tag,
+                               "open_serving_spans": open_serving,
+                               "active": int(self._active.sum()),
+                               "epoch": self._epoch})
+            except Exception:  # noqa: BLE001 - a dump failure never
+                pass           # masks the recovery it documents
+            self.last_recovery_dump = path
+            aborted = 0
+            for b in range(self.max_batch):
+                req = self._slots[b]
+                if req is None:
+                    continue
+                self._aborted.append(RequestAborted(
+                    f"request {req.rid} aborted by engine recovery: "
+                    f"{reason}", rid=req.rid, tokens=req.outputs,
+                    tenant=req.tenant))
+                aborted += 1
+                entry = self._req_spans.pop(req.rid, None)
+                if entry is not None:
+                    mon.trace.drop(entry[1])
+                    mon.trace.end_span(entry[0])
+                st = self._stats.get(req.rid)
+                if st is not None:
+                    st["aborted"] = True
+                    st["tokens"] = len(req.outputs)
+                self._pager.free_sequence(b)
+                self._slots[b] = None
+            self._active[:] = False
+            self._decode_ready[:] = False
+            self.lens[:] = 0
+            self._last_tok[:] = 0
+            self._lane_cache.clear()
+            # NOT torn down: the compiled programs (still valid), the
+            # admission queues, and the radix cache + its pinned blocks
+            # (request refs were freed above; cache refs keep the prefix
+            # KV alive) — that is what makes the restart WARM
+            cold = self.prefix_cache is None or not len(self.prefix_cache)
+            t1 = mon.mod.now_ns()
+            self.recovery_stats.append({
+                "reason": reason, "ms": (t1 - t0) / 1e6,
+                "aborted": aborted, "cold": cold, "dump": path})
+            if mon.tstate.on:
+                mon.trace.record_span(
+                    "serving.recover", t0, t1,
+                    attrs={"reason": reason[:120], "aborted": aborted,
+                           "cold": cold})
+            if mon.state.on:
+                mon.recoveries.inc()
+                if aborted:
+                    mon.aborted.inc(aborted)
+                self._update_gauges(mon)
+            return aborted
+        finally:
+            self._recover_lock.release()
+
+    def pop_aborted(self):
+        """Drain the typed :class:`RequestAborted` records of requests a
+        recovery cut short (each carries the partial ``tokens``)."""
+        return _drain(self._aborted)
+
+    # -- driving thread (crash/hang drills run against THIS loop) ------------
+    def start_driver(self, eos_token_id=None, max_new_tokens=None,
+                     hang_timeout=None, poll_s=0.0005):
+        """Spawn the engine's driving thread: it drains admissions and
+        steps whenever work is pending, parking finished
+        ``(rid, tokens)`` pairs for :meth:`pop_results`. Producers keep
+        calling :meth:`submit` from any thread. If the thread DIES
+        (anything step() raises — an injected fault, a real allocator
+        bug), it runs :meth:`recover` and relaunches itself warm.
+        ``hang_timeout`` arms a hang watchdog: a step stuck longer than
+        that many seconds gets a watchdog flight dump naming the stuck
+        section AND a recovery from the scanner thread (the two dumps
+        coalesce into one file; the stuck step returns empty on wake-up
+        via the epoch check)."""
+        if self._driver is not None and self._driver.is_alive():
+            return
+        self._drive_args = (eos_token_id, max_new_tokens, float(poll_s))
+        self._drive_stop.clear()
+        if hang_timeout is not None:
+            from ..distributed.watchdog import CommWatchdog
+
+            self._dog = CommWatchdog(timeout=float(hang_timeout),
+                                     on_timeout=self._on_hang)
+        self._spawn_driver()
+
+    def stop_driver(self, timeout=5.0):
+        """Stop the driving thread (current step completes first)."""
+        self._drive_stop.set()
+        drv = self._driver
+        if drv is not None and drv.is_alive():
+            drv.join(timeout=timeout)
+        if self._dog is not None:
+            self._dog.stop()
+            self._dog = None
+        self._driver = None
+
+    def pop_results(self):
+        """Drain finished ``(rid, tokens)`` pairs collected by the
+        driving thread."""
+        return _drain(self._results)
+
+    def _spawn_driver(self):
+        t = threading.Thread(target=self._drive_loop, daemon=True,
+                             name=f"serving-driver-{self._san_tag}")
+        self._driver = t
+        t.start()
+
+    def _on_hang(self, desc, dump):
+        """Watchdog scanner callback: a watched step exceeded the hang
+        timeout. The watchdog already wrote its flight dump; recover()'s
+        dump coalesces with it (same file, both reasons)."""
+        self.recover(f"watchdog-detected hang: {desc} exceeded "
+                     f"{self._dog.timeout}s", stuck=desc)
+
+    def _drive_loop(self):
+        eos, max_new, poll = self._drive_args
+        while not self._drive_stop.is_set():
+            try:
+                if not (self._active.any() or self.num_pending):
+                    time.sleep(poll)
+                    continue
+                # chaos drills kill the driving thread here, right before
+                # a step that HAS work (an idle poll never burns the
+                # trigger count) — the except below IS the crash-recovery
+                # path being drilled
+                _fi.fire("serving.drive")
+                if self._dog is not None:
+                    with self._dog.watch("serving.step"):
+                        finished = self.step(eos, max_new)
+                else:
+                    finished = self.step(eos, max_new)
+                self._results.extend(finished)
+            except Exception as e:  # noqa: BLE001 - the drill contract:
+                # ANY driving-thread death recovers + relaunches warm
+                if self._drive_stop.is_set():
+                    return
+                point = getattr(e, "point", "")
+                self.recover(
+                    f"driving thread died: {type(e).__name__}: {e}",
+                    stuck=point or "serving.step")
+                if not self._drive_stop.is_set():
+                    self._spawn_driver()
+                return
 
 
 class StaticBatchEngine:

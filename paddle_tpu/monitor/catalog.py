@@ -114,6 +114,26 @@ METRICS = {
         "counter", (),
         "KV blocks mapped read-only from the radix cache into admitted "
         "requests (prompt tokens neither recomputed nor re-stored)."),
+    "paddle_tpu_serving_shed_total": (
+        "counter", ("tenant",),
+        "Requests shed under sustained overload (queued victims removed "
+        "for a higher-priority arrival, or arrivals refused with "
+        "RequestShed), labeled by tenant."),
+    "paddle_tpu_serving_tenant_queue_depth": (
+        "gauge", ("tenant",),
+        "Per-tenant admission-queue depth (submitted, not yet admitted)."),
+    "paddle_tpu_serving_aborted_total": (
+        "counter", (),
+        "In-flight requests aborted by engine recovery (typed "
+        "RequestAborted with partial tokens)."),
+    "paddle_tpu_serving_recoveries_total": (
+        "counter", (),
+        "Engine recover() passes (driving-thread death, watchdog-"
+        "detected hang, or manual drill)."),
+    "paddle_tpu_serving_preemptions_total": (
+        "counter", (),
+        "Active requests preempted under pool pressure: KV spilled to "
+        "host RAM, request requeued at the head of its tenant queue."),
     # -- paged KV allocator (models/paged_kv.py) -------------------------
     "paddle_tpu_kv_free_blocks": (
         "gauge", (),
@@ -132,6 +152,14 @@ METRICS = {
         "counter", (),
         "Cache-only blocks released back to the pool under allocation "
         "pressure (LRU order)."),
+    "paddle_tpu_kv_spilled_blocks": (
+        "gauge", (),
+        "Radix-cache blocks currently spilled to host RAM (evicted from "
+        "the device pool but restorable on a prefix match)."),
+    "paddle_tpu_kv_spill_restores_total": (
+        "counter", (),
+        "Spilled KV blocks restored from host RAM into freshly "
+        "allocated pool blocks (bit-exact round trip)."),
     # -- dataloader (io/dataloader.py) -----------------------------------
     "paddle_tpu_dataloader_batches_total": (
         "counter", (),
@@ -148,6 +176,11 @@ METRICS = {
         "graftsan sanitizer trips (lock-order inversion, recompile storm, "
         "host-sync-in-span), labeled by sanitizer; each trip also raises "
         "and flight-dumps (docs/sanitizers.md)."),
+    "paddle_tpu_monitor_fault_injections_total": (
+        "counter", ("point",),
+        "Fault-injection trips (analysis/faultinject.py, "
+        "PADDLE_TPU_FAULTS=...), labeled by injection point — a chaos "
+        "run's telemetry shows where the drill hit."),
 }
 
 
@@ -213,6 +246,18 @@ SPANS = {
     "serving.evict": (
         "Slot eviction: block free + host state clear (child of "
         "serving.request). attrs: slot, tokens."),
+    "serving.step": (
+        "One whole engine step, OPEN while the step runs — the span a "
+        "flight dump names when the driving thread hangs or dies "
+        "mid-step. attrs: engine."),
+    "serving.recover": (
+        "One engine recovery pass: flight dump, in-flight aborts "
+        "(typed RequestAborted with partial tokens), warm restart from "
+        "the radix cache. attrs: reason, aborted, cold."),
+    "serving.preempt": (
+        "One request preempted under pool pressure: its KV spilled to "
+        "host RAM, its blocks freed, the request requeued (restored "
+        "bit-exact on re-admission). attrs: slot, rid, tokens_in_kv."),
     # -- dataloader (io/dataloader.py) -----------------------------------
     "dataloader.batch": (
         "Consumer-visible wait for the next staged batch (fetch + "
@@ -237,6 +282,10 @@ SPANS = {
         "host-sync-in-span), recorded at raise time so the flight dump "
         "shows WHERE in the request/step timeline the hazard fired. "
         "attrs: sanitizer."),
+    "monitor.fault_injection": (
+        "One fault-injection trip (analysis/faultinject.py), recorded "
+        "at fire time so a chaos run's trace shows where the drill hit. "
+        "attrs: point."),
 }
 
 

@@ -36,6 +36,7 @@ import itertools
 import json
 import os
 import threading
+import time
 
 from . import provenance as _prov
 from .registry import now_ns
@@ -153,6 +154,11 @@ def reset(capacity=None):
     _ids = itertools.count(1)
     _dispatch_tick = itertools.count()
     _tls.__dict__.clear()
+    with _dump_lock:
+        _last_dump["path"] = None
+        _last_dump["t"] = 0.0
+        _last_dump["reasons"] = []
+        _last_dump["extras"] = []
 
 
 def set_dispatch_sampling(every):
@@ -432,17 +438,59 @@ def default_flight_path(rank=None):
     return os.path.join(d, f"paddle_tpu_flight_rank{r}_pid{os.getpid()}.json")
 
 
-def flight_dump(path=None, reason="", tail=256, extra=None):
+# Dump coalescing: one hang is often observed by SEVERAL watchers (the
+# comm watchdog's scanner, the serving engine's recovery, a sanitizer
+# trip). Within the window, dumps to the same path MERGE — the file
+# carries every observer's reason and (being written last) every
+# observer's open spans — instead of the last partial dump clobbering
+# the first.
+DUMP_COALESCE_S = 10.0
+_dump_lock = threading.Lock()
+_last_dump = {"path": None, "t": 0.0, "reasons": [], "extras": []}
+
+
+def flight_dump(path=None, reason="", tail=256, extra=None,
+                coalesce_s=None):
     """Write the flight-recorder post-mortem: last-``tail`` completed spans,
     every OPEN span, the monitor metrics snapshot and the provenance block,
-    to a per-rank file. Called by the watchdog timeout path and elastic
-    restarts; never raises (a failing dump must not mask the hang it
-    documents). Returns the path written, or None."""
+    to a per-rank file. Called by the watchdog timeout path, serving
+    recovery and elastic restarts; never raises (a failing dump must not
+    mask the hang it documents). Dumps to the same path within
+    ``coalesce_s`` (default :data:`DUMP_COALESCE_S`) seconds merge their
+    reasons into ONE file (``reasons`` list + joined ``reason``) — a hang
+    the watchdog and the engine both observe produces a single dump
+    naming both, not two partial ones. Returns the path written, or
+    None."""
     try:
         from . import snapshot as _metrics_snapshot
 
         doc = span_dump(tail=tail)
-        doc["reason"] = reason
+        window = DUMP_COALESCE_S if coalesce_s is None else coalesce_s
+        target = path or default_flight_path()
+        with _dump_lock:
+            now_mono = time.monotonic()
+            if _last_dump["path"] == target \
+                    and now_mono - _last_dump["t"] < window:
+                reasons = _last_dump["reasons"] + [reason]
+                extras = _last_dump["extras"] + ([extra] if extra else [])
+            else:
+                reasons = [reason]
+                extras = [extra] if extra else []
+                # anchor the window to the FIRST dump of the series: a
+                # recurring fault (recovery loop dumping every few
+                # seconds) must start a fresh file once the window
+                # elapses, not merge — and grow — forever
+                _last_dump["t"] = now_mono
+            _last_dump["path"] = target
+            _last_dump["reasons"] = reasons
+            _last_dump["extras"] = extras
+        doc["reason"] = "; ".join(r for r in reasons if r)
+        doc["reasons"] = reasons
+        if extras:
+            # every coalesced observer's state view survives in the one
+            # file — the watchdog's stuck-section table AND the engine's
+            # recovery context, not just the last writer's
+            doc["extras"] = extras
         doc["rank"] = _rank()
         doc["pid"] = os.getpid()
         doc["tracing_enabled"] = _state.on
@@ -452,7 +500,7 @@ def flight_dump(path=None, reason="", tail=256, extra=None):
             doc["monitor"] = None
         if extra:
             doc["extra"] = extra
-        path = path or default_flight_path()
+        path = target
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)

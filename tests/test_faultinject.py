@@ -1,0 +1,408 @@
+"""Fault injection (paddle_tpu/analysis/faultinject.py) + the serving
+resilience drills it exists for (ISSUE 6).
+
+Two layers:
+
+1. the harness itself — trigger determinism (nth / seeded prob / times
+   bounds), env-spec parsing, trip accounting, telemetry export;
+2. the chaos drills — for every injection point in the catalog, with
+   sanitizers ON where the engine supports it: (a) a TYPED error
+   surfaces (InjectedFault, CowPoolExhausted, the allocator's
+   RuntimeError — never a hang or a wrong token), (b) the engine
+   recovers WARM (radix prefix-hit counters fire on re-admission),
+   (c) post-recovery tokens are BIT-IDENTICAL to an undisturbed run.
+
+The kill/hang drills here are the ISSUE 6 acceptance criteria, run at
+tier-1 shapes.
+"""
+import glob
+import json
+import os
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor
+from paddle_tpu.analysis import faultinject as fi
+from paddle_tpu.analysis import sanitizers as san
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.serving import ContinuousBatchingEngine
+from paddle_tpu.models import paged_kv as pk
+from paddle_tpu.monitor import trace
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """Every test starts and ends with the harness disarmed (a leaked
+    armed point would make an unrelated test's serving call explode)."""
+    fi.reset()
+    yield
+    fi.reset()
+    san.disable()
+    san.reset()
+    monitor.disable()
+    monitor.reset()
+    trace.disable()
+    trace.reset()
+
+
+def _model():
+    paddle.seed(0)
+    cfg = LlamaConfig(vocab_size=96, hidden_size=64,
+                      intermediate_size=176, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=128)
+    return LlamaForCausalLM(cfg)
+
+
+def _engine(model, **kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("chunk_size", 16)
+    return ContinuousBatchingEngine(model, **kw)
+
+
+def _run_all(eng, max_steps=200, **step_kw):
+    done = {}
+    for _ in range(max_steps):
+        for rid, toks in eng.step(**step_kw):
+            done[rid] = list(toks)
+        if not (eng.num_active or eng.num_pending):
+            break
+    return done
+
+
+def _drive(eng, rid2prompt, max_new, deadline_s=60.0):
+    """Driver-mode collector: resubmit aborted requests, return
+    ({original_rid: tokens}, n_aborted)."""
+    remap = {rid: rid for rid in rid2prompt}
+    results = {}
+    aborted = 0
+    t0 = time.perf_counter()
+    while len(results) < len(remap) \
+            and time.perf_counter() - t0 < deadline_s:
+        for rid, toks in eng.pop_results():
+            results[rid] = list(toks)
+        for err in eng.pop_aborted():
+            orig = next(o for o, cur in remap.items() if cur == err.rid)
+            aborted += 1
+            remap[orig] = eng.submit(rid2prompt[orig],
+                                     max_new_tokens=max_new, timeout=10.0)
+        time.sleep(0.001)
+    return {o: results.get(c) for o, c in remap.items()}, aborted
+
+
+# --------------------------------------------------------------------------- #
+# the harness
+# --------------------------------------------------------------------------- #
+
+class TestHarness:
+    def test_default_off_and_fire_is_noop(self):
+        assert not fi.enabled()
+        assert fi.fire("serving.step") is None
+        assert fi.trips() == []
+
+    def test_unknown_point_and_action_raise(self):
+        with pytest.raises(ValueError, match="unknown fault point"):
+            fi.arm("serving.nope")
+        with pytest.raises(ValueError, match="unknown action"):
+            fi.arm("serving.step", action="explode")
+
+    def test_nth_trigger_fires_once_from_nth_call(self):
+        fi.arm("serving.step", action="flag", nth=3)
+        assert fi.fire("serving.step") is None
+        assert fi.fire("serving.step") is None
+        assert fi.fire("serving.step") is not None
+        # nth-triggers default to ONE trip: the drill kills once, the
+        # recovered engine must then run clean
+        assert fi.fire("serving.step") is None
+        assert fi.trips() == [("serving.step", "flag")]
+
+    def test_times_bounds_total_trips(self):
+        fi.arm("serving.step", action="flag", nth=1, times=2)
+        hits = sum(fi.fire("serving.step") is not None for _ in range(5))
+        assert hits == 2
+
+    def test_prob_trigger_replays_from_seed(self):
+        fi.arm("serving.step", action="flag", prob=0.5, seed=7)
+        a = [fi.fire("serving.step") is not None for _ in range(32)]
+        fi.reset()
+        fi.arm("serving.step", action="flag", prob=0.5, seed=7)
+        b = [fi.fire("serving.step") is not None for _ in range(32)]
+        assert a == b and 0 < sum(a) < 32
+
+    def test_raise_action_is_typed_with_point(self):
+        fi.arm("serving.drive", action="raise")
+        with pytest.raises(fi.InjectedFault) as ei:
+            fi.fire("serving.drive")
+        assert ei.value.point == "serving.drive"
+
+    def test_delay_action_sleeps(self):
+        fi.arm("serving.admission", action="delay", delay_s=0.05)
+        t0 = time.perf_counter()
+        assert fi.fire("serving.admission") is not None
+        assert time.perf_counter() - t0 >= 0.05
+
+    def test_disarm_last_point_disables(self):
+        fi.arm("serving.step", action="flag")
+        fi.arm("radix.digest", action="flag")
+        fi.disarm("serving.step")
+        assert fi.enabled()
+        fi.disarm("radix.digest")
+        assert not fi.enabled()
+        assert fi.armed() == {}
+
+    def test_install_from_env_parses_spec(self):
+        pts = fi.install_from_env(
+            "serving.drive:raise:nth=12;paged_kv.cow:flag:prob=0.5,seed=7")
+        assert pts == ("serving.drive", "paged_kv.cow")
+        armed = fi.armed()
+        assert armed["serving.drive"] == ("raise", 0)
+        assert armed["paged_kv.cow"] == ("flag", 0)
+
+    def test_install_from_env_bad_specs_warn_and_skip(self):
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            pts = fi.install_from_env(
+                "serving.nope:raise;serving.step:frobnicate;"
+                "serving.step:raise:nth=x;serving.step:delay:delay_s=0.01")
+        assert pts == ("serving.step",)
+        assert len(w) == 3
+        assert fi.armed()["serving.step"] == ("delay", 0)
+
+    def test_install_from_env_empty_is_noop(self):
+        assert fi.install_from_env("") == ()
+        assert not fi.enabled()
+
+    def test_trip_exports_metric_and_span(self):
+        monitor.enable()
+        trace.enable()
+        fi.arm("radix.digest", action="flag")
+        fi.fire("radix.digest")
+        snap = monitor.snapshot()
+        row = snap["metrics"]["paddle_tpu_monitor_fault_injections_total"]
+        assert row["values"]["point=radix.digest"] == 1
+        assert any(sp.name == "monitor.fault_injection"
+                   for sp in trace.spans())
+
+    def test_catalog_matches_code_sites(self):
+        """The strict CI row, in-process: every declared point is fired
+        somewhere in the tree, every fired point is declared."""
+        import importlib.util
+        import sys
+
+        spec = importlib.util.spec_from_file_location(
+            "_rsc", os.path.join(os.path.dirname(__file__), os.pardir,
+                                 "tools", "run_static_checks.py"))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules["_rsc"] = mod
+        try:
+            spec.loader.exec_module(mod)
+            an = mod.load_analysis()
+            assert mod.fault_point_problems(an) == []
+        finally:
+            sys.modules.pop("_rsc", None)
+
+
+# --------------------------------------------------------------------------- #
+# the drills (ISSUE 6 acceptance)
+# --------------------------------------------------------------------------- #
+
+class TestKillRecoveryDrill:
+    def test_killed_driver_recovers_warm_bit_identical(self, monkeypatch,
+                                                       tmp_path):
+        """THE acceptance drill: kill the driving thread mid-decode. The
+        engine must write a flight dump naming the stuck point, abort
+        in-flight requests with typed partial-token errors, restart WARM
+        from the radix cache (prefix hits on re-admission), and the
+        resubmitted requests' outputs must be bit-identical to an
+        undisturbed run."""
+        monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path))
+        monitor.enable()
+        trace.enable()
+        model = _model()
+        r = np.random.RandomState(0)
+        prompts = {i: r.randint(0, 96, (12,)).astype("int32")
+                   for i in range(4)}
+
+        eng = _engine(model)
+        eng.start_driver()
+        rids = {eng.submit(p, max_new_tokens=8, timeout=10.0): i
+                for i, p in prompts.items()}
+        ref, ab0 = _drive(eng, {rid: prompts[i]
+                                for rid, i in rids.items()}, 8)
+        eng.stop_driver()
+        assert ab0 == 0 and all(v for v in ref.values())
+        ref = {rids[rid]: toks for rid, toks in ref.items()}
+
+        eng2 = _engine(model)
+        pc = eng2.prefix_cache
+        fi.arm("serving.drive", action="raise", nth=4)
+        eng2.start_driver()
+        rids2 = {eng2.submit(p, max_new_tokens=8, timeout=10.0): i
+                 for i, p in prompts.items()}
+        hits0 = pc.hits
+        out, aborted = _drive(eng2, {rid: prompts[i]
+                                     for rid, i in rids2.items()}, 8)
+        eng2.stop_driver()
+        out = {rids2[rid]: toks for rid, toks in out.items()}
+
+        assert fi.trips() == [("serving.drive", "raise")]
+        assert aborted >= 1                       # typed partial aborts
+        assert len(eng2.recovery_stats) == 1
+        rec = eng2.recovery_stats[0]
+        assert "serving.drive" in rec["reason"]
+        assert not rec["cold"]                    # radix cache survived
+        assert pc.hits > hits0                    # re-admissions hit it
+        dump = rec["dump"]
+        assert dump and os.path.exists(dump)
+        doc = json.load(open(dump))
+        assert "serving.drive" in doc["reason"]   # names the stuck point
+        # the drilled contract: recovery is EXACT, not approximate
+        assert out == ref
+        snap = monitor.snapshot()["metrics"]
+        assert snap["paddle_tpu_serving_recoveries_total"]["values"][""] == 1
+        assert snap["paddle_tpu_serving_aborted_total"]["values"][""] \
+            == aborted
+
+    def test_aborted_requests_carry_partial_tokens(self):
+        model = _model()
+        eng = _engine(model, decode_burst=1)   # one token per step
+        rid = eng.add_request(np.arange(10, dtype=np.int32),
+                              max_new_tokens=20)
+        for _ in range(6):
+            eng.step()
+        req = next(s for s in eng._slots if s is not None)
+        n_partial = len(req.outputs)
+        assert n_partial >= 1
+        eng.recover("drill")
+        (err,) = eng.pop_aborted()
+        assert err.rid == rid and len(err.tokens) == n_partial
+        assert eng.num_active == 0
+
+
+class TestHangRecoveryDrill:
+    def test_hang_watchdog_and_recovery_share_one_dump(self, monkeypatch,
+                                                       tmp_path):
+        """A hang observed by BOTH the comm watchdog and the engine's
+        recovery writes ONE flight file carrying both observers' reasons
+        and views (the dedupe satellite), and the engine finishes the
+        workload after recovering."""
+        monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path))
+        monitor.enable()
+        trace.enable()
+        model = _model()
+        r = np.random.RandomState(1)
+        eng = _engine(model)
+        # prewarm both programs so a compile can't fake a hang
+        eng.add_request(r.randint(0, 96, (9,)).astype("int32"),
+                        max_new_tokens=6)
+        _run_all(eng)
+        trace.reset()
+        trace.enable()
+
+        fi.arm("serving.step", action="delay", delay_s=2.0, nth=2)
+        eng.start_driver(hang_timeout=0.4)
+        prompts = {i: r.randint(0, 96, (9,)).astype("int32")
+                   for i in range(3)}
+        rids = {eng.submit(p, max_new_tokens=6, timeout=10.0): i
+                for i, p in prompts.items()}
+        out, aborted = _drive(eng, {rid: prompts[i]
+                                    for rid, i in rids.items()}, 6)
+        eng.stop_driver()
+        assert all(v for v in out.values())
+        assert any("hang" in rec["reason"]
+                   for rec in eng.recovery_stats)
+        files = glob.glob(str(tmp_path / "*.json"))
+        assert len(files) == 1                    # ONE coalesced file
+        doc = json.load(open(files[0]))
+        assert any("watchdog timeout" in rsn for rsn in doc["reasons"])
+        assert any("serving recovery" in rsn for rsn in doc["reasons"])
+        assert "serving.step" in doc["reason"]    # names the stuck span
+        # both observers' state views survive the merge
+        assert any("watchdog" in e for e in doc["extras"])
+        assert any("open_serving_spans" in e for e in doc["extras"])
+
+
+class TestInjectionPointDrills:
+    """Per-point: typed error, warm recovery, bit-identical outputs —
+    with sanitizers armed, so the drills and the tripwires coexist."""
+
+    def _ref_engine_and_tokens(self, model, prompt, max_new=5):
+        eng = _engine(model)
+        rid = eng.add_request(prompt, max_new_tokens=max_new)
+        return eng, _run_all(eng)[rid]
+
+    def test_step_raise_surfaces_typed_then_recovers(self):
+        model = _model()
+        r = np.random.RandomState(2)
+        prompt = r.randint(0, 96, (11,)).astype("int32")
+        eng, ref = self._ref_engine_and_tokens(model, prompt)
+        fi.arm("serving.step", action="raise", nth=2)
+        rid = eng.add_request(prompt, max_new_tokens=5)
+        with pytest.raises(fi.InjectedFault):
+            _run_all(eng)
+        eng.recover("step drill")
+        assert eng.pop_aborted()[0].rid == rid
+        rid2 = eng.add_request(prompt, max_new_tokens=5)
+        assert _run_all(eng)[rid2] == ref
+
+    def test_cow_exhaustion_absorbed_by_evict_retry(self):
+        assert san.install_from_env("all") != ()
+        model = _model()
+        r = np.random.RandomState(3)
+        # block-aligned prompt: the repeat admission FULL-hits the cache
+        # and its recompute lane write CoWs the shared tail block
+        prompt = r.randint(0, 96, (16,)).astype("int32")
+        eng, ref = self._ref_engine_and_tokens(model, prompt, max_new=4)
+        fi.arm("paged_kv.cow", action="flag", nth=1)
+        rid = eng.add_request(prompt, max_new_tokens=4)
+        out = _run_all(eng)[rid]
+        assert fi.trips() == [("paged_kv.cow", "flag")]
+        assert out == ref
+        assert san.trips() == []
+
+    def test_pool_exhaustion_absorbed_by_cache_relief(self):
+        model = _model()
+        r = np.random.RandomState(4)
+        prompt = r.randint(0, 96, (11,)).astype("int32")
+        eng, ref = self._ref_engine_and_tokens(model, prompt)
+        fi.arm("paged_kv.ensure", action="flag", nth=1)
+        rid = eng.add_request(prompt, max_new_tokens=5)
+        out = _run_all(eng)[rid]
+        assert fi.trips() == [("paged_kv.ensure", "flag")]
+        assert out == ref
+
+    def test_pool_exhaustion_without_cache_is_typed(self):
+        model = _model()
+        eng = _engine(model, prefix_cache=False)
+        fi.arm("paged_kv.ensure", action="flag", nth=1)
+        eng.add_request(np.arange(9, dtype=np.int32), max_new_tokens=4)
+        with pytest.raises(RuntimeError, match="injected fault"):
+            _run_all(eng)
+
+    def test_corrupted_digest_degrades_to_collision_never_wrong_kv(self):
+        model = _model()
+        r = np.random.RandomState(5)
+        prompt = r.randint(0, 96, (17,)).astype("int32")
+        eng, ref = self._ref_engine_and_tokens(model, prompt)
+        c0 = eng.prefix_cache.collisions
+        fi.arm("radix.digest", action="flag", nth=1)
+        rid = eng.add_request(prompt, max_new_tokens=5)
+        out = _run_all(eng)[rid]
+        assert eng.prefix_cache.collisions == c0 + 1
+        assert out == ref     # the corrupt entry was never served
+
+    def test_admission_stall_delays_but_loses_nothing(self):
+        model = _model()
+        r = np.random.RandomState(6)
+        prompt = r.randint(0, 96, (11,)).astype("int32")
+        eng, ref = self._ref_engine_and_tokens(model, prompt)
+        fi.arm("serving.admission", action="delay", delay_s=0.05, nth=1)
+        rid = eng.add_request(prompt, max_new_tokens=5)
+        out = _run_all(eng)[rid]
+        assert fi.trips() == [("serving.admission", "delay")]
+        assert out == ref

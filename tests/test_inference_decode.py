@@ -161,20 +161,39 @@ class TestBeamSearch:
         np.testing.assert_array_equal(np.asarray(beams)[:, 0], greedy)
         assert np.isfinite(np.asarray(scores)).all()
 
-    def test_wider_beam_never_scores_worse(self):
-        """The best of 4 beams must reach at least the greedy (beam-1)
-        sequence log-probability — the defining beam-search property."""
+    def test_beam_scores_are_exact_sequence_logprobs(self):
+        """Every returned beam's score must equal the teacher-forced
+        log-probability of its token sequence under the model — the
+        property beam search actually guarantees. (This replaces the old
+        "best-of-4 >= greedy" assertion, which beam search does NOT
+        guarantee: the greedy prefix is pruned whenever K other partial
+        hypotheses outscore it mid-search — the classic beam-search
+        non-monotonicity, observed at this very seed where beam-2 scores
+        below beam-1 and beam-8 above it. See docs/COVERAGE.md.)"""
         model, _ = _model()
         r = np.random.RandomState(2)
-        ids = paddle.to_tensor(r.randint(0, 64, (2, 4)).astype("int64"))
+        ids_np = r.randint(0, 64, (2, 4)).astype("int64")
         eng = LlamaDecodeEngine(model, max_len=32)
-        _, s1 = eng.beam_search(ids, beam_size=1, max_new_tokens=6)
-        beams4, s4 = eng.beam_search(ids, beam_size=4, max_new_tokens=6)
-        s1, s4 = np.asarray(s1), np.asarray(s4)
-        assert (s4[:, 0] >= s1[:, 0] - 1e-4).all(), (s4[:, 0], s1[:, 0])
-        # sorted best-first
+        beams4, s4 = eng.beam_search(paddle.to_tensor(ids_np), beam_size=4,
+                                     max_new_tokens=6)
+        beams4, s4 = np.asarray(beams4), np.asarray(s4)
+        assert beams4.shape == (2, 4, 6)
+        # sorted best-first, and all K hypotheses per row are distinct
         assert (np.diff(s4, axis=1) <= 1e-6).all()
-        assert np.asarray(beams4).shape == (2, 4, 6)
+        for b in range(2):
+            assert len({tuple(row) for row in beams4[b]}) == 4
+            for k in range(4):
+                seq = np.concatenate([ids_np[b], beams4[b, k]])
+                logits = model(
+                    paddle.to_tensor(seq[None].astype("int64"))
+                ).numpy()[0].astype(np.float64)
+                lse = np.log(np.exp(
+                    logits - logits.max(-1, keepdims=True)).sum(-1)) \
+                    + logits.max(-1)
+                S = ids_np.shape[1]
+                want = sum(logits[S - 1 + t, beams4[b, k, t]]
+                           - lse[S - 1 + t] for t in range(6))
+                np.testing.assert_allclose(s4[b, k], want, atol=2e-3)
 
     def test_eos_freezes_beams(self):
         model, _ = _model()

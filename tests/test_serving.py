@@ -23,8 +23,10 @@ import paddle_tpu as paddle
 from paddle_tpu import monitor
 from paddle_tpu.analysis import sanitizers as san
 from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.analysis import faultinject as fi
 from paddle_tpu.models.serving import (AdmissionTimeout,
                                        ContinuousBatchingEngine,
+                                       RequestShed,
                                        StaticBatchEngine)
 
 
@@ -378,3 +380,303 @@ def test_admission_grants_no_blocks_before_prefill():
     eng.step(max_new_tokens=4)
     # 6-token prompt + first token => exactly 1 block granted
     assert free0 - len(eng._pager._free) == 1
+
+
+# --------------------------------------------------------------------------- #
+# ISSUE 6: per-tenant QoS (weighted-fair queuing, priority lanes, shedding)
+# --------------------------------------------------------------------------- #
+
+class TestTenants:
+    def test_priority_lane_pops_first(self):
+        eng = ContinuousBatchingEngine(_model(), max_batch=2, max_len=32,
+                                       block_size=8)
+        eng.set_tenant("gold", priority=2)
+        eng.set_tenant("bronze", priority=0)
+        r = np.random.RandomState(0)
+        b1 = eng.submit(r.randint(0, 96, (5,)).astype("int32"),
+                        tenant="bronze")
+        b2 = eng.submit(r.randint(0, 96, (5,)).astype("int32"),
+                        tenant="bronze")
+        g1 = eng.submit(r.randint(0, 96, (5,)).astype("int32"),
+                        tenant="gold")
+        order = [eng._pop_pending().rid for _ in range(3)]
+        assert order == [g1, b1, b2]
+
+    def test_weighted_fair_share_is_stride_scheduled(self):
+        eng = ContinuousBatchingEngine(_model(), max_batch=2, max_len=32,
+                                       block_size=8)
+        eng.set_tenant("heavy", weight=2.0)
+        eng.set_tenant("light", weight=1.0)
+        r = np.random.RandomState(0)
+        for _ in range(6):
+            eng.submit(r.randint(0, 96, (5,)).astype("int32"),
+                       tenant="heavy")
+        for _ in range(6):
+            eng.submit(r.randint(0, 96, (5,)).astype("int32"),
+                       tenant="light")
+        first9 = [eng._pop_pending().tenant for _ in range(9)]
+        # stride scheduling on 1/weight: a weight-2 lane admits twice
+        # per weight-1 admission under contention
+        assert first9.count("heavy") == 6 and first9.count("light") == 3
+
+    def test_idle_lane_cannot_bank_an_unfair_burst(self):
+        eng = ContinuousBatchingEngine(_model(), max_batch=2, max_len=32,
+                                       block_size=8)
+        eng.set_tenant("a", weight=1.0)
+        eng.set_tenant("b", weight=1.0)
+        r = np.random.RandomState(0)
+        for _ in range(4):
+            eng.submit(r.randint(0, 96, (5,)).astype("int32"), tenant="a")
+            eng._pop_pending()
+        # b was idle the whole time; its lane re-syncs to the virtual
+        # clock on first use instead of replaying its lag as a burst
+        for _ in range(2):
+            eng.submit(r.randint(0, 96, (5,)).astype("int32"), tenant="a")
+            eng.submit(r.randint(0, 96, (5,)).astype("int32"), tenant="b")
+        pops = [eng._pop_pending().tenant for _ in range(4)]
+        assert pops.count("a") == 2 and pops.count("b") == 2
+
+    def test_full_queue_sheds_newest_lowest_priority_victim(self):
+        monitor.enable()
+        monitor.reset()
+        try:
+            eng = ContinuousBatchingEngine(_model(), max_batch=2,
+                                           max_len=32, block_size=8,
+                                           max_queue=2)
+            eng.set_tenant("gold", priority=1)
+            r = np.random.RandomState(0)
+            b1 = eng.submit(r.randint(0, 96, (5,)).astype("int32"))
+            b2 = eng.submit(r.randint(0, 96, (5,)).astype("int32"))
+            g1 = eng.submit(r.randint(0, 96, (5,)).astype("int32"),
+                            tenant="gold")
+            (shed,) = eng.pop_shed()
+            assert isinstance(shed, RequestShed)
+            assert shed.rid == b2 and shed.tenant == ""  # newest victim
+            assert isinstance(shed, AdmissionTimeout)    # handler compat
+            order = [eng._pop_pending().rid for _ in range(2)]
+            assert order == [g1, b1]
+            snap = monitor.snapshot()["metrics"]
+            vals = snap["paddle_tpu_serving_shed_total"]["values"]
+            assert vals == {"tenant=": 1}
+        finally:
+            monitor.disable()
+            monitor.reset()
+
+    def test_lowest_priority_arrival_is_shed_typed(self):
+        eng = ContinuousBatchingEngine(_model(), max_batch=2, max_len=32,
+                                       block_size=8, max_queue=1)
+        eng.set_tenant("gold", priority=1)
+        r = np.random.RandomState(0)
+        eng.submit(r.randint(0, 96, (5,)).astype("int32"), tenant="gold")
+        with pytest.raises(RequestShed) as ei:
+            eng.submit(r.randint(0, 96, (5,)).astype("int32"),
+                       tenant="bronze")
+        assert ei.value.tenant == "bronze"
+
+    def test_equal_priority_never_displaced(self):
+        """Without priority lanes the old backpressure contract holds:
+        plain AdmissionTimeout, nothing shed."""
+        eng = ContinuousBatchingEngine(_model(), max_batch=2, max_len=32,
+                                       block_size=8, max_queue=1)
+        r = np.random.RandomState(0)
+        eng.submit(r.randint(0, 96, (5,)).astype("int32"))
+        with pytest.raises(AdmissionTimeout) as ei:
+            eng.submit(r.randint(0, 96, (5,)).astype("int32"))
+        assert not isinstance(ei.value, RequestShed)
+        assert eng.pop_shed() == []
+
+    def test_tenant_validation(self):
+        eng = ContinuousBatchingEngine(_model(), max_batch=2, max_len=32,
+                                       block_size=8)
+        with pytest.raises(ValueError, match="weight"):
+            eng.set_tenant("x", weight=0.0)
+        eng.set_tenant("y", weight=1.0, priority=1)
+        with pytest.raises(ValueError, match="weight"):
+            eng.set_tenant("y", weight=-1.0)
+
+    def test_priority_tenants_keep_goodput_under_overload(self):
+        """The QoS acceptance shape, in-process: gold requests finish
+        with the same tokens whether bronze floods or not, and bronze
+        sheds typed instead of starving gold."""
+        model = _model()
+        eng = ContinuousBatchingEngine(model, max_batch=2, max_len=64,
+                                       block_size=8, chunk_size=16,
+                                       max_queue=3)
+        eng.set_tenant("gold", weight=2.0, priority=1)
+        eng.set_tenant("bronze", weight=1.0, priority=0)
+        r = np.random.RandomState(7)
+        gold_prompts = [r.randint(0, 96, (9,)).astype("int32")
+                        for _ in range(3)]
+        iso = {}
+        rids = [eng.submit(p, max_new_tokens=4, tenant="gold")
+                for p in gold_prompts]
+        for rid, toks in _run_all(eng).items():
+            iso[rid] = list(toks)
+        shed = 0
+        gold_rids = [eng.submit(p, max_new_tokens=4, tenant="gold",
+                                timeout=10.0) for p in gold_prompts]
+        for _ in range(8):
+            try:
+                eng.submit(r.randint(0, 96, (9,)).astype("int32"),
+                           max_new_tokens=4, tenant="bronze")
+            except RequestShed:
+                shed += 1
+        done = _run_all(eng, max_steps=400)
+        assert shed > 0
+        for old_rid, new_rid in zip(rids, gold_rids):
+            assert list(done[new_rid]) == iso[old_rid]
+
+
+# --------------------------------------------------------------------------- #
+# ISSUE 6: host-RAM KV spill/restore (preemption + spilled radix prefixes)
+# --------------------------------------------------------------------------- #
+
+class TestKVSpill:
+    def test_preemption_under_pool_pressure_restores_bit_exact(self):
+        """An injected pool exhaustion on the DECODE grant PREEMPTS the
+        non-decoding request mid-prefill: its partial KV spills to host
+        RAM, its blocks return to the pool, and it later resumes
+        bit-identically to an undisturbed run. The radix cache is OFF so
+        the exhaustion cannot be absorbed by cache relief — preemption
+        is the request-KV spill path, independent of the prefix store."""
+        model = _model()
+        eng = ContinuousBatchingEngine(model, max_batch=2, max_len=64,
+                                       block_size=8, chunk_size=8,
+                                       decode_burst=1, kv_spill=True,
+                                       prefix_cache=False)
+        r = np.random.RandomState(8)
+        pA = r.randint(0, 96, (10,)).astype("int32")
+        pB = r.randint(0, 96, (20,)).astype("int32")
+        ref = {}
+        for p in (pA, pB):
+            rid = eng.add_request(p, max_new_tokens=8)
+            ref[len(ref)] = _run_all(eng)[rid]
+        monitor.enable()
+        monitor.reset()
+        fi.reset()
+        try:
+            done = {}
+            ridA = eng.add_request(pA, max_new_tokens=8)
+            while not eng._decode_ready.any():   # A through prefill
+                done.update(eng.step())
+            ridB = eng.add_request(pB, max_new_tokens=8)
+            done.update(eng.step())              # B's first prefill chunk
+            assert eng.lens[[s is not None and s.rid == ridB
+                             for s in eng._slots].index(True)] > 0
+            # next step's decode grant explodes: A must keep decoding,
+            # so mid-prefill B is the preemption victim
+            fi.arm("paged_kv.ensure", action="flag", nth=1)
+            for _ in range(400):
+                done.update(eng.step())
+                if not (eng.num_active or eng.num_pending):
+                    break
+            assert fi.trips() == [("paged_kv.ensure", "flag")]
+            snap = monitor.snapshot()["metrics"]
+            assert snap["paddle_tpu_serving_preemptions_total"][
+                "values"][""] >= 1
+            assert list(done[ridA]) == list(ref[0])
+            assert list(done[ridB]) == list(ref[1])
+        finally:
+            fi.reset()
+            monitor.disable()
+            monitor.reset()
+
+    def test_spilled_radix_prefix_restores_from_host_ram(self):
+        """Evicted-but-hot prefixes survive in host RAM: a later match
+        restores them into fresh pool blocks bit-exact (the restores
+        counter + spilled-blocks gauge document the round trip)."""
+        model = _model()
+        eng = ContinuousBatchingEngine(model, max_batch=2, max_len=64,
+                                       block_size=8, chunk_size=32,
+                                       kv_spill=True)
+        r = np.random.RandomState(9)
+        prompt = r.randint(0, 96, (24,)).astype("int32")
+        rid = eng.add_request(prompt, max_new_tokens=6)
+        ref = _run_all(eng)[rid]
+        pc = eng.prefix_cache
+        n_cached = len(pc)
+        assert n_cached >= 3
+        monitor.enable()
+        monitor.reset()
+        try:
+            # pool pressure evicts the whole chain: payloads park in
+            # host RAM instead of vanishing
+            freed = pc.evict(n_cached, pools=eng._pools)
+            assert freed == n_cached and len(pc._spilled) == freed
+            snap = monitor.snapshot()["metrics"]
+            assert snap["paddle_tpu_kv_spilled_blocks"]["values"][""] \
+                == freed
+            hits0 = pc.hits
+            rid2 = eng.add_request(prompt, max_new_tokens=6)
+            assert pc.restores == freed      # the chain came back whole
+            assert pc.hits == hits0 + 1
+            assert np.array_equal(_run_all(eng)[rid2], ref)
+            snap = monitor.snapshot()["metrics"]
+            assert snap["paddle_tpu_kv_spill_restores_total"][
+                "values"][""] == freed
+        finally:
+            monitor.disable()
+            monitor.reset()
+
+    def test_spill_disabled_drops_evicted_entries(self):
+        model = _model()
+        eng = ContinuousBatchingEngine(model, max_batch=2, max_len=64,
+                                       block_size=8, chunk_size=32,
+                                       kv_spill=False)
+        r = np.random.RandomState(10)
+        prompt = r.randint(0, 96, (24,)).astype("int32")
+        rid = eng.add_request(prompt, max_new_tokens=4)
+        _run_all(eng)
+        pc = eng.prefix_cache
+        freed = pc.evict(len(pc), pools=eng._pools)
+        assert freed and len(pc._spilled) == 0
+        assert pc.restores == 0
+
+
+class TestDriverAndRecovery:
+    def test_recover_on_idle_engine_is_clean(self):
+        eng = ContinuousBatchingEngine(_model(), max_batch=2, max_len=32,
+                                       block_size=8)
+        assert eng.recover("manual drill") == 0
+        assert eng.pop_aborted() == []
+        assert len(eng.recovery_stats) == 1
+        assert eng.recovery_stats[0]["aborted"] == 0
+
+    def test_start_driver_is_idempotent_and_stops_clean(self):
+        eng = ContinuousBatchingEngine(_model(), max_batch=2, max_len=32,
+                                       block_size=8)
+        eng.start_driver(max_new_tokens=3)
+        first = eng._driver
+        eng.start_driver(max_new_tokens=3)
+        assert eng._driver is first
+        rid = eng.submit(np.arange(5, dtype=np.int32), max_new_tokens=3,
+                         timeout=5.0)
+        t0 = time.monotonic()
+        out = {}
+        while rid not in out and time.monotonic() - t0 < 30:
+            out.update(eng.pop_results())
+            time.sleep(0.005)
+        eng.stop_driver()
+        assert len(out[rid]) == 3
+        assert not eng._drive_stop.is_set() or eng._driver is None
+
+    def test_tenant_queue_depth_gauge_tracks_lanes(self):
+        monitor.enable()
+        monitor.reset()
+        try:
+            eng = ContinuousBatchingEngine(_model(), max_batch=2,
+                                           max_len=32, block_size=8)
+            eng.set_tenant("t1")
+            r = np.random.RandomState(0)
+            eng.submit(r.randint(0, 96, (5,)).astype("int32"),
+                       tenant="t1")
+            eng.submit(r.randint(0, 96, (5,)).astype("int32"),
+                       tenant="t1")
+            snap = monitor.snapshot()["metrics"]
+            vals = snap["paddle_tpu_serving_tenant_queue_depth"]["values"]
+            assert vals["tenant=t1"] == 2
+            assert snap["paddle_tpu_serving_queue_depth"]["values"][""] \
+                == 2
+        finally:
+            monitor.disable()
+            monitor.reset()

@@ -336,7 +336,8 @@ class TestCLISurfaces:
         assert summary["ok"] is True
         assert [c["check"] for c in summary["checks"]] == [
             "graftlint", "check_metric_names", "check_span_names",
-            "check_lock_order", "check_recompile_hazards"]
+            "check_lock_order", "check_recompile_hazards",
+            "check_fault_points"]
         assert all(c["ok"] for c in summary["checks"])
 
     def test_explain_prints_propagation_chain(self):
@@ -377,10 +378,12 @@ class TestCLISurfaces:
                                                  "check_metric_names",
                                                  "check_span_names",
                                                  "check_lock_order",
-                                                 "check_recompile_hazards"]
+                                                 "check_recompile_hazards",
+                                                 "check_fault_points"]
             assert rows[1]["ok"], rows[1]
             assert rows[2]["ok"], rows[2]
             assert rows[3]["ok"], rows[3]
             assert rows[4]["ok"], rows[4]
+            assert rows[5]["ok"], rows[5]
         finally:
             sys.path.remove(os.path.join(ROOT, "tools"))

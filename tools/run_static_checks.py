@@ -17,13 +17,19 @@ One analysis pass (parse the tree once) feeds two result rows:
    over the interprocedural call graph must be acyclic — no baseline);
 5. the recompile hazards (GL008 strict: per-call registration, shape/
    dtype branching in jitted bodies, per-call-constructed static args —
-   no baseline).
+   no baseline);
+6. the fault-point catalog (analysis/faultinject.py POINTS strict: every
+   declared injection point is fired by at least one
+   ``faultinject.fire("<point>")`` site in the tree, and every fired
+   point is declared — an undeclared drill or a dead catalog row is a
+   CI failure, no baseline).
 
 Prints one status line per check, then a machine-readable JSON summary on
 stdout (``--json`` prints ONLY the JSON). Exit 0 iff every check passed.
 """
 from __future__ import annotations
 
+import ast
 import json
 import os
 import sys
@@ -31,6 +37,76 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from lint_framework import ROOT, load_analysis  # noqa: E402
+
+
+def fault_point_problems(an, root=ROOT, project=None):
+    """The fault-point catalog contract: declared POINTS and
+    ``faultinject.fire("<point>")`` code sites must pin each other.
+    Stdlib-only and tree-local — the catalog is AST-parsed from the
+    analyzed tree's own ``analysis/faultinject.py`` (never imported,
+    same discipline as the lint engine), the sites come from the shared
+    parsed ``Project`` (run_checks hands over its own; direct callers
+    get one built here). A tree without the harness (fixture
+    mini-trees) has no catalog: only undeclarable ``fire()`` sites can
+    fail it."""
+    if project is None:
+        project = an.Project(root, include=("paddle_tpu",))
+    harness_rel = "paddle_tpu/analysis/faultinject.py"
+    harness = next((sf for sf in project.files
+                    if sf.relpath == harness_rel), None)
+    declared = set()
+    problems = []
+    if harness is not None:
+        if harness.tree is None:
+            return [f"analysis/faultinject.py: unparseable catalog: "
+                    f"{harness.parse_error}"]
+        for node in harness.tree.body:
+            if isinstance(node, ast.Assign) \
+                    and any(isinstance(t, ast.Name)
+                            and t.id == "POINTS"
+                            for t in node.targets):
+                try:
+                    declared = set(ast.literal_eval(node.value))
+                except ValueError as e:
+                    return [f"analysis/faultinject.py: unparseable "
+                            f"catalog: {e}"]
+                break
+        else:
+            problems.append(
+                "analysis/faultinject.py: no POINTS catalog found")
+    fired = {}                   # point -> [file:line, ...]
+    for sf in project.files:
+        if sf.relpath == harness_rel:
+            continue             # the harness itself defines fire()
+        if sf.tree is None:
+            problems.append(f"{sf.relpath}: unparseable: {sf.parse_error}")
+            continue
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "fire"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in ("_fi", "faultinject")):
+                continue
+            where = f"{sf.relpath}:{node.lineno}"
+            if not (node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                problems.append(
+                    f"{where}: faultinject.fire() with a non-literal "
+                    "point name (the catalog check cannot pin it)")
+                continue
+            fired.setdefault(node.args[0].value, []).append(where)
+    for point, sites in sorted(fired.items()):
+        if point not in declared:
+            problems.append(
+                f"fired but not declared in faultinject.POINTS: "
+                f"{point!r} at {', '.join(sites)}")
+    for point in sorted(declared - set(fired)):
+        problems.append(
+            f"declared in faultinject.POINTS but never fired: {point!r} "
+            "(dead catalog row — drill it or drop it)")
+    return problems
 
 
 def run_checks(root=ROOT):
@@ -89,6 +165,16 @@ def run_checks(root=ROOT):
     problems = an.RULES_BY_ID["GL008"].strict_problems(project, findings)
     rows.append({
         "check": "check_recompile_hazards",
+        "ok": not problems,
+        "findings": len(problems),
+        "detail": problems,
+        "seconds": round(time.perf_counter() - t0, 3),
+    })
+
+    t0 = time.perf_counter()
+    problems = fault_point_problems(an, root, project=project)
+    rows.append({
+        "check": "check_fault_points",
         "ok": not problems,
         "findings": len(problems),
         "detail": problems,
