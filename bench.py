@@ -583,20 +583,37 @@ def _serving_bench(model, cfg, on_tpu):
     prefix cache, models/serving.py) vs the static-batch baseline at
     equal batch capacity, on a Poisson open-loop mixed-length workload
     with shared prompt prefixes. Emits serving_tokens_per_sec, TTFT
-    p50/p99 and the prefix-hit rate (docs/serving.md)."""
-    from bench_common import serving_bench
+    p50/p99 and the prefix-hit rate, plus the speculative-decoding rows
+    (spec-on vs spec-off tokens/s, drafted/accepted counts, accept rate;
+    bench_common.spec_bench) (docs/serving.md)."""
+    from bench_common import serving_bench, spec_bench
 
     if on_tpu:
         params = dict(max_batch=16, block_size=64, chunk_size=128,
                       max_step_tokens=None, decode_burst=8, n_requests=24,
                       n_groups=3, prefix_blocks=4, tail_range=(32, 128),
                       new_range=(32, 128), repeats=2)
+        spec_params = dict(max_batch=4, block_size=64, chunk_size=64,
+                           max_step_tokens=128, decode_burst=8,
+                           spec_lookahead=16, n_requests=12, n_groups=3,
+                           pattern_len=64, head_len=16, max_new=256,
+                           repeats=2)
     else:
         params = dict(max_batch=8, block_size=8, chunk_size=16,
                       decode_burst=12, n_requests=20, n_groups=2,
                       prefix_blocks=6, tail_range=(4, 12),
                       new_range=(4, 64), repeats=3)
-    return serving_bench(model, **params)
+        spec_params = dict(max_batch=1, block_size=8, chunk_size=8,
+                           max_step_tokens=24, decode_burst=4,
+                           spec_lookahead=22, n_requests=6, n_groups=2,
+                           max_new=160, repeats=3)
+    out = serving_bench(model, **params)
+    spec = spec_bench(model, **spec_params)
+    out.update({k: spec[k] for k in (
+        "spec_off_tokens_per_sec", "spec_on_tokens_per_sec",
+        "spec_speedup", "spec_drafted_tokens", "spec_accepted_tokens",
+        "spec_accept_rate", "spec_tokens_match", "spec_lookahead")})
+    return out
 
 
 from bench_common import force as _force  # noqa: E402

@@ -64,6 +64,11 @@ POINTS = {
     "serving.admission": (
         "Entry of the driving thread's queue drain (_drain_pending). "
         "delay = admission stalls while decode continues."),
+    "serving.spec_verify": (
+        "The speculative-decoding verify site (draft collection for the "
+        "mixed step's verify lanes). flag = the drafter degrades to "
+        "plain 1-token decode for the step — outputs stay correct "
+        "(drafts are only ever verified), the speedup is sacrificed."),
     "paged_kv.ensure": (
         "Entry of PagedKVCache.ensure_capacity. flag = the site raises "
         "the allocator's typed pool-exhausted RuntimeError without "

@@ -322,24 +322,51 @@ class LlamaDecodeEngine:
         v = (h @ p["wv"]).reshape(B, 1, self.num_kv, self.head_dim)
         q = _rope_at_rows(q, positions, self.theta)
         k = _rope_at_rows(k, positions, self.theta)
-        pool = _pk.paged_write_mixed(*pool, row_tables, positions, valid,
-                                     k[:, 0], v[:, 0])
-        attn = _pk.paged_attention_decode(q[:, 0], *pool, row_tables,
-                                          positions)[:, None]
+        if self.kv_int8:
+            kq, kscale = self._quantize_kv(k)      # (B, 1, kv, D)
+            vq, vscale = self._quantize_kv(v)
+            pool = _pk.paged_write_mixed_int8(
+                *pool, row_tables, positions, valid, kq[:, 0], kscale[:, 0],
+                vq[:, 0], vscale[:, 0])
+            attn = _pk.paged_attention_decode_int8(
+                q[:, 0], *pool, row_tables, positions)[:, None]
+        else:
+            pool = _pk.paged_write_mixed(*pool, row_tables, positions, valid,
+                                         k[:, 0], v[:, 0])
+            attn = _pk.paged_attention_decode(q[:, 0], *pool, row_tables,
+                                              positions)[:, None]
         return self._post_attn(p, x, attn), pool
 
     def build_mixed_step(self):
         """The continuous-batching mixed step as a pure function for the
         serving engine to jit (donated pools): a ``(token_ids, slot_ids,
-        positions)`` pack of ``T`` lanes — decode slots and prefill chunks
-        interleaved — runs ONE forward, writes every lane's K/V into its
-        slot's paged blocks, and returns the per-lane greedy token (read
-        only for lanes the scheduler marked as emitting). Shapes are fixed
-        by the token budget ``T``, so XLA compiles this exactly once."""
-        def run(pack, pools, tables, slot_ids, valid):
+        positions)`` pack of ``T`` lanes — decode slots, draft-verify
+        lanes and prefill chunks interleaved — runs ONE forward, writes
+        every lane's K/V into its slot's paged blocks, and returns the
+        per-lane greedy token (read only for lanes the scheduler marked
+        as emitting). Shapes are fixed by the token budget ``T``, so XLA
+        compiles this exactly once.
+
+        Verify mode (self-speculative decoding) rides the SAME program:
+        ``chain[i]`` marks lane ``i`` as carrying a DRAFT token that
+        continues lane ``i-1``'s sequence. The program scores every lane
+        as usual (each lane's attention masks to its own position, so a
+        draft lane is arithmetically identical to the single decode step
+        it speculates) and additionally computes, device-side, the
+        longest-agreeing-prefix accept flags: draft lane ``i`` is
+        accepted iff every draft before it in its chain was accepted AND
+        lane ``i-1``'s greedy token equals the draft lane ``i`` carries.
+        Rejected lanes wrote KV at positions past the accept fence — the
+        scheduler rolls them back by simply not advancing ``seq_lens``
+        (paged writes are position-addressed; the stale positions are
+        overwritten before any mask can read them). With ``chain`` all
+        False (speculation off) the flags are all zero and row 0 is the
+        plain mixed step — one program serves both modes, so greedy
+        outputs are bit-identical with speculation on or off."""
+        def run(pack, pools, tables, slot_ids, valid, chain):
             # pack (2, T) int32: row 0 = token ids, row 1 = positions
             # (one fused upload per step — these are the only per-step
-            # transfers; slot_ids/valid are cached per pack composition)
+            # transfers; slot_ids/valid/chain are cached per composition)
             token_ids, positions = pack[0], pack[1]
             x = self.emb[token_ids][:, None]        # (T, 1, hidden)
             row_tables = tables[slot_ids]           # (T, max_blocks)
@@ -350,9 +377,25 @@ class LlamaDecodeEngine:
                 new_pools.append(pool)
             x = _rms(x, self.norm_w, self.eps)
             logits = (x @ self.head_w)[:, -1]
-            # argmax INSIDE the program: the scheduler transfers one (T,)
-            # int32 lane vector per step, never a vocab-size logits row
-            return jnp.argmax(logits, -1).astype(jnp.int32), new_pools
+            # argmax INSIDE the program: the scheduler transfers one
+            # (2, T) int32 lane matrix per step, never a vocab logits row
+            nt = jnp.argmax(logits, -1).astype(jnp.int32)
+            # segmented running-AND along draft chains (accept = my draft
+            # token equals the previous lane's greedy token, and every
+            # draft before me agreed): a (value, segment-start) monoid so
+            # the scan is O(log T) on device
+            prev = jnp.roll(nt, 1)
+            agree = jnp.where(chain, prev == token_ids, True)
+            start = ~chain
+
+            def comb(a, b):
+                av, as_ = a
+                bv, bs_ = b
+                return jnp.where(bs_, bv, av & bv), as_ | bs_
+
+            acc, _ = lax.associative_scan(comb, (agree, start))
+            accept = acc & chain
+            return jnp.stack([nt, accept.astype(jnp.int32)]), new_pools
 
         return run
 

@@ -35,7 +35,8 @@ __all__ = ["PagedKVCache", "CowPoolExhausted", "alloc_blocks",
            "read_blocks",
            "paged_write_decode", "paged_write_prefill", "paged_write_mixed",
            "paged_attention_decode", "paged_write_decode_int8",
-           "paged_write_prefill_int8", "paged_attention_decode_int8"]
+           "paged_write_prefill_int8", "paged_write_mixed_int8",
+           "paged_attention_decode_int8"]
 
 
 class CowPoolExhausted(RuntimeError):
@@ -257,11 +258,13 @@ class PagedKVCache:
 
     def write_block_contents(self, pools, blocks, contents):
         """Upload host-RAM block contents into pool ``blocks`` (one
-        donated scatter): ``contents`` is a per-layer list of
-        ``(k, v)`` numpy arrays shaped ``[n, block_size, kv_heads,
-        head_dim]``. Index vectors pad to a power-of-two length (padding
-        writes zeros into the null block — benign) so the jitted upload
-        compiles for O(log) distinct shapes, exactly like the CoW copy."""
+        donated scatter): ``contents`` is a per-layer list of pool-leaf
+        tuples — ``(k, v)`` for bf16 pools, ``(kq, ks, vq, vs)`` for the
+        quantized layout — each numpy array shaped ``[n, block_size,
+        ...]`` (block-major on axis 0, exactly like the pools). Index
+        vectors pad to a power-of-two length (padding writes zeros into
+        the null block — benign) so the jitted upload compiles for
+        O(log) distinct shapes, exactly like the CoW copy."""
         n = len(blocks)
         if n == 0:
             return pools
@@ -271,19 +274,21 @@ class PagedKVCache:
         blks = np.zeros(m, np.int32)
         blks[:n] = np.asarray(blocks, np.int32)
         padded = []
-        for k_np, v_np in contents:
-            if m != n:
-                pad = ((0, m - n),) + ((0, 0),) * (k_np.ndim - 1)
-                k_np = np.pad(k_np, pad)
-                v_np = np.pad(v_np, pad)
-            padded.append((k_np, v_np))
+        for entry in contents:
+            leaves = []
+            for arr in entry:
+                if m != n:
+                    pad = ((0, m - n),) + ((0, 0),) * (arr.ndim - 1)
+                    arr = np.pad(arr, pad)
+                leaves.append(arr)
+            padded.append(tuple(leaves))
         fn = getattr(self, "_restore_jit", None)
         if fn is None:
             @functools.partial(jax.jit, donate_argnums=(0,))
             def fn(pools, blks, vals):
-                return [(pk.at[blks].set(k.astype(pk.dtype)),
-                         pv.at[blks].set(v.astype(pv.dtype)))
-                        for (pk, pv), (k, v) in zip(pools, vals)]
+                return [tuple(pl.at[blks].set(c.astype(pl.dtype))
+                              for pl, c in zip(entry, cs))
+                        for entry, cs in zip(pools, vals)]
 
             self._restore_jit = fn
         return fn(pools, jnp.asarray(blks), padded)
@@ -448,16 +453,18 @@ def alloc_blocks(batch, max_len, block_size):
 
 def read_blocks(pools, blocks):
     """Download pool ``blocks`` to host RAM (the SPILL read): a per-layer
-    list of ``(k, v)`` numpy arrays ``[n, block_size, kv_heads,
-    head_dim]``. This is a deliberate device→host transfer on the
+    list of pool-leaf tuples of numpy arrays ``[n, block_size, ...]`` —
+    ``(k, v)`` for bf16 pools, the 4-leaf ``(kq, ks, vq, vs)`` for the
+    quantized layout. This is a deliberate device→host transfer on the
     resilience path (pool pressure / preemption), never the serving hot
     loop — the spilled bits round-trip exactly, which is what makes
     restore-then-decode bit-identical."""
     blks = jnp.asarray(np.asarray(blocks, np.int32))
     out = []
-    for k, v in pools:
-        out.append((np.asarray(jax.device_get(k[blks])),    # graftlint: disable=GL002
-                    np.asarray(jax.device_get(v[blks]))))   # graftlint: disable=GL002
+    for entry in pools:
+        out.append(tuple(
+            np.asarray(jax.device_get(leaf[blks]))    # graftlint: disable=GL002
+            for leaf in entry))
     return out
 
 
@@ -541,6 +548,21 @@ def paged_write_decode_int8(kq, ks, vq, vs, block_tables, seq_lens,
     phys, off = _decode_scatter_idx(block_tables, seq_lens, kq.shape[1])
     return (kq.at[phys, off].set(k_new_q), ks.at[phys, off].set(k_new_s),
             vq.at[phys, off].set(v_new_q), vs.at[phys, off].set(v_new_s))
+
+
+def paged_write_mixed_int8(kq, ks, vq, vs, row_tables, positions, valid,
+                           k_new_q, k_new_s, v_new_q, v_new_s):
+    """int8 form of paged_write_mixed: one quantized token per LANE of a
+    mixed (decode + chunked-prefill + draft-verify) pack — values
+    [T, kv, D] int8 plus per-(token, head) scales [T, kv], the same
+    per-lane scatter indices across four pools. Padding lanes (``valid``
+    False) redirect at an out-of-bounds block and DROP."""
+    phys, off = _decode_scatter_idx(row_tables, positions, kq.shape[1])
+    phys = jnp.where(valid, phys, kq.shape[0])
+    return (kq.at[phys, off].set(k_new_q, mode="drop"),
+            ks.at[phys, off].set(k_new_s, mode="drop"),
+            vq.at[phys, off].set(v_new_q, mode="drop"),
+            vs.at[phys, off].set(v_new_s, mode="drop"))
 
 
 def paged_write_prefill_int8(kq, ks, vq, vs, block_tables, seq_lens,

@@ -100,6 +100,11 @@ class PrefixCache:
         # the tail instead of beheading a root and stranding (still
         # pinned, never matchable) descendants
         self._nchildren = {}
+        # parent digest (b"" at the root) -> [child digests]: the
+        # DOWNWARD edges of the radix tree, walked by the speculative
+        # drafter (continue_tokens) to propose the tokens another
+        # prompt's chain stored past the current context
+        self._children = {}
         self.capacity = capacity_blocks
         # host-RAM spill store (serving resilience, ROADMAP 5b): evicted
         # entries park their exact KV bits here instead of vanishing, and
@@ -161,20 +166,73 @@ class PrefixCache:
             self.misses += 1
         return blocks, len(blocks) * bs
 
+    def continue_tokens(self, parent, partial, k):
+        """Speculative-draft source (models/spec_decode.py): the tokens a
+        cached chain stores PAST the current context. ``parent`` is the
+        digest of the context's last full block (``b""`` at the root),
+        ``partial`` the context tokens past that boundary. A child block
+        whose stored tokens start with ``partial`` proposes its following
+        tokens, and the walk continues down the chain until ``k`` tokens
+        are gathered or it runs dry — a request with this exact prefix
+        already wrote them, so the model plausibly continues the same way
+        (for a REPEATED prompt whose previous run registered its decode
+        blocks, greedy determinism makes the proposal exact). Read-only
+        and verified (token comparison, never digest trust); a miss
+        returns None and the drafter falls back to its n-gram index."""
+        partial = np.asarray(partial, np.int32).reshape(-1)
+        out = []
+        while len(out) < k:
+            r = len(partial)
+            nxt = None
+            for d in reversed(self._children.get(parent, ())):
+                e = self._entries.get(d)
+                if e is None:
+                    continue
+                if r < len(e.tokens) \
+                        and np.array_equal(e.tokens[:r], partial):
+                    nxt = e
+                    break
+            if nxt is None:
+                break
+            out.extend(nxt.tokens[r:r + (k - len(out))])
+            parent = nxt.digest
+            partial = partial[:0]
+        if not out:
+            return None
+        return np.asarray(out, np.int32)
+
     # -- registration ---------------------------------------------------------
     def register(self, prompt, n_tokens_written, table_row):
         """Index every FULL prompt block of ``table_row`` whose KV is
         fully written (``n_tokens_written`` tokens so far). Idempotent per
         digest; each newly indexed block is pinned with one cache
         reference so it outlives its producing request."""
-        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        return self.register_from((0, b""), prompt, n_tokens_written,
+                                  table_row)[0]
+
+    def register_from(self, cursor, tokens, n_tokens_written, table_row):
+        """Incremental :meth:`register`: resume the chain walk at
+        ``cursor = (n_blocks_done, parent_digest)`` instead of
+        re-digesting from the root — the serving engine registers a
+        growing generation once per block crossing, and without the
+        cursor that walk is quadratic in generation length. ``tokens``
+        holds the sequence FROM the cursor block onward (``tokens[0]``
+        is absolute position ``n_blocks_done * block_size``; the whole
+        sequence for a root cursor), so callers pass O(new tokens) per
+        resume, not the full context. ``n_tokens_written`` and
+        ``table_row`` stay absolute. Returns ``(n_registered,
+        new_cursor)``; the cursor is only valid for the SAME token
+        sequence (chains are content-addressed: any edit before the
+        cursor invalidates it)."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
         bs = self.block_size
-        n_full = min(len(prompt), int(n_tokens_written)) // bs
-        parent = b""
+        done, parent = int(cursor[0]), cursor[1]
+        base = done
+        n_full = min(base * bs + len(tokens), int(n_tokens_written)) // bs
         registered = 0
-        for i in range(n_full):
-            tokens = prompt[i * bs:(i + 1) * bs]
-            d = _digest(parent, tokens)
+        for i in range(done, n_full):
+            blk_tokens = tokens[(i - base) * bs:(i - base + 1) * bs]
+            d = _digest(parent, blk_tokens)
             e = self._entries.get(d)
             if e is None:
                 blk = int(table_row[i])
@@ -185,10 +243,13 @@ class PrefixCache:
                     # chain (cannot happen for verified matches, but a
                     # collision-degraded row could): never double-index
                     parent = d
+                    done = i + 1
                     continue
                 self._pager.retain_blocks([blk])
-                self._entries[d] = _Entry(d, parent, tokens, blk)
+                self._entries[d] = _Entry(d, parent, tokens=blk_tokens,
+                                          block=blk)
                 self._by_block[blk] = d
+                self._children.setdefault(parent, []).append(d)
                 if parent:
                     self._nchildren[parent] = \
                         self._nchildren.get(parent, 0) + 1
@@ -196,9 +257,10 @@ class PrefixCache:
             else:
                 self._entries.move_to_end(d)
             parent = d
+            done = i + 1
         if self.capacity is not None and len(self._entries) > self.capacity:
             self.evict(len(self._entries) - self.capacity)
-        return registered
+        return registered, (done, parent)
 
     # -- eviction -------------------------------------------------------------
     def evict(self, n_blocks, pools=None):
@@ -234,8 +296,10 @@ class PrefixCache:
     def _spill_entry(self, e, pools):
         from . import paged_kv as _pk
 
-        payload = [(k[0], v[0]) for k, v in
-                   _pk.read_blocks(pools, [e.block])]
+        # one per-layer tuple of pool leaves ((k, v), or the quantized
+        # 4-leaf (kq, ks, vq, vs)) — whatever layout the pool carries
+        payload = [tuple(leaf[0] for leaf in entry)
+                   for entry in _pk.read_blocks(pools, [e.block])]
         self._spilled[e.digest] = _SpillEntry(e.digest, e.parent,
                                               e.tokens, payload)
         self._spilled.move_to_end(e.digest)
@@ -278,16 +342,17 @@ class PrefixCache:
         if blks is None:
             return blocks, shared, pools
         contents = []
-        for layer in range(len(todo[0].payload)):
-            contents.append((
-                np.stack([se.payload[layer][0] for se in todo]),
-                np.stack([se.payload[layer][1] for se in todo])))
+        for layer, entry0 in enumerate(todo[0].payload):
+            contents.append(tuple(
+                np.stack([se.payload[layer][i] for se in todo])
+                for i in range(len(entry0))))
         pools = self._pager.write_block_contents(pools, blks, contents)
         for se, blk in zip(todo, blks):
             del self._spilled[se.digest]
             self._entries[se.digest] = _Entry(se.digest, se.parent,
                                               se.tokens, blk)
             self._by_block[blk] = se.digest
+            self._children.setdefault(se.parent, []).append(se.digest)
             if se.parent:
                 self._nchildren[se.parent] = \
                     self._nchildren.get(se.parent, 0) + 1
@@ -314,6 +379,20 @@ class PrefixCache:
             self._nchildren[e.parent] -= 1
             if self._nchildren[e.parent] <= 0:
                 del self._nchildren[e.parent]
+        kids = self._children.get(e.parent)
+        if kids is not None:
+            try:
+                kids.remove(e.digest)
+            except ValueError:
+                pass
+            if not kids:
+                del self._children[e.parent]
+        # the dropped entry's own DOWNWARD edges stay: digests are
+        # content-addressed, so a reborn parent (re-registered or
+        # restored) reconnects to its still-cached children — exactly
+        # like match()'s orphan healing, but for continue_tokens. Every
+        # digest IN a child list is a live entry (this method removes it
+        # when the child drops), so the map stays bounded by the cache.
         self._pager.release_blocks([e.block])
 
     def clear(self):
@@ -324,6 +403,7 @@ class PrefixCache:
         self._entries.clear()
         self._by_block.clear()
         self._nchildren.clear()
+        self._children.clear()
         self._spilled.clear()
         mon = _mon()
         if mon[0].on:

@@ -109,6 +109,7 @@ class _Mon:
                  "pc_blocks", "pc_evictions",
                  "shed", "tenant_depth", "aborted", "recoveries",
                  "preemptions",
+                 "spec_drafted", "spec_accepted", "spec_rate", "pool_bytes",
                  "jit_compiles", "jit_hits", "jit_sigs")
 
 
@@ -154,6 +155,12 @@ def _mon():
         o.aborted = m.counter("paddle_tpu_serving_aborted_total")
         o.recoveries = m.counter("paddle_tpu_serving_recoveries_total")
         o.preemptions = m.counter("paddle_tpu_serving_preemptions_total")
+        o.spec_drafted = m.counter(
+            "paddle_tpu_serving_spec_draft_tokens_total")
+        o.spec_accepted = m.counter(
+            "paddle_tpu_serving_spec_accepted_tokens_total")
+        o.spec_rate = m.gauge("paddle_tpu_serving_spec_accept_rate")
+        o.pool_bytes = m.gauge("paddle_tpu_serving_kv_pool_bytes")
         o.jit_compiles = m.counter("paddle_tpu_jit_compiles_total",
                                    labelnames=("function",))
         o.jit_hits = m.counter("paddle_tpu_jit_cache_hits_total",
@@ -223,6 +230,21 @@ def _drain(dq):
             return out
 
 
+def _pool_layout(pager, kv_int8):
+    """The engine-facing per-layer pool entries plus their total device
+    bytes. Quantized pools are 4-leaf — int8 K/V values + fp32
+    per-(token, head) scales, about half the bytes per token — bf16
+    pools are 2-leaf; every pool consumer (mixed step, CoW, spill)
+    treats the entry as an opaque leaf tuple."""
+    if kv_int8:
+        pools = list(zip(pager.k, pager.k_scale, pager.v, pager.v_scale))
+    else:
+        pools = list(zip(pager.k, pager.v))
+    nbytes = int(sum(leaf.size * leaf.dtype.itemsize
+                     for entry in pools for leaf in entry))
+    return pools, nbytes
+
+
 class ContinuousBatchingEngine:
     """Token-budget continuous batching: every step runs ONE fixed-shape
     compiled program over a pack of decode lanes and chunked-prefill
@@ -237,7 +259,9 @@ class ContinuousBatchingEngine:
                  chunk_size=32, max_step_tokens=None, policy="fcfs",
                  decode_priority=0.0, decode_burst=4, max_queue=None,
                  prefix_cache=True, prefill_buckets=None, kv_spill=False,
-                 spill_capacity_blocks=None, strict_priority=False):
+                 spill_capacity_blocks=None, strict_priority=False,
+                 kv_cache_dtype=None, spec_lookahead=0, spec_ngram=3,
+                 pool_blocks=None):
         """``max_step_tokens`` (default ``max_batch + chunk_size``) is the
         per-step token budget: decode lanes first, prefill chunks fill the
         remainder. ``policy`` orders prefill among admitted requests
@@ -263,11 +287,31 @@ class ContinuousBatchingEngine:
         flood can never join a high-priority batch (high-priority lanes
         keep their isolated steady state — decode bursts and all — and
         the flood drains only into idle capacity, shedding under queue
-        pressure; the graceful-degradation mode of docs/serving.md)."""
+        pressure; the graceful-degradation mode of docs/serving.md).
+        ``kv_cache_dtype="int8"`` runs the WHOLE engine — prefill
+        chunks, decode lanes, CoW, radix sharing, spill/restore —
+        against quantized pools (int8 values + per-(token, head) fp32
+        scales): roughly half the KV bytes per token, so the same pool
+        byte budget admits ~2x the concurrent requests (docs/serving.md,
+        quantized KV). ``spec_lookahead`` > 0 enables self-speculative
+        decoding: an n-gram/prompt-lookup drafter (models/spec_decode.py
+        — no second model) proposes up to that many tokens per decode
+        lane; the scheduler packs them as extra ragged lanes of the SAME
+        compiled mixed step, which verifies them device-side (longest
+        agreeing prefix, rejected tokens rolled back by not advancing
+        seq_lens) — greedy outputs stay bit-identical with speculation
+        on or off, accepted drafts just arrive several-per-dispatch.
+        ``spec_ngram`` bounds the drafter's n-gram match length.
+        ``pool_blocks`` overrides the KV pool size (default: exactly
+        enough for max_batch max-length requests) — radix-cache-heavy
+        serving sizes the pool PAST the live batch so shared prefixes
+        and registered decode chains survive between requests instead of
+        churning through LRU eviction."""
         del prefill_buckets  # legacy knob of the bucket-prefill engine
         self._inner = LlamaDecodeEngine(model, max_len=max_len,
                                         kv_cache_layout="paged",
-                                        block_size=block_size)
+                                        block_size=block_size,
+                                        kv_cache_dtype=kv_cache_dtype)
         e = self._inner
         self.max_batch = int(max_batch)
         self.max_len = e.max_len
@@ -292,18 +336,44 @@ class ContinuousBatchingEngine:
         self.max_queue = None if max_queue is None else int(max_queue)
         self.strict_priority = bool(strict_priority)
         max_blocks = -(-e.max_len // self.block_size)
+        # default pool: exactly max_batch worst-case requests (+ null);
+        # pool_blocks sizes it independently — prefix-cache-heavy serving
+        # wants headroom so registered chains outlive their producers
+        num_blocks = self.max_batch * max_blocks + 1 if pool_blocks is None \
+            else max(int(pool_blocks), max_blocks + 2)
         self._pager = _pk.PagedKVCache(
             num_layers=len(e.layers),
-            num_blocks=self.max_batch * max_blocks + 1,
+            num_blocks=num_blocks,
             block_size=self.block_size, kv_heads=e.num_kv,
             head_dim=e.head_dim, batch=self.max_batch,
-            max_blocks_per_seq=max_blocks, dtype=e.emb.dtype)
-        self._pools = list(zip(self._pager.k, self._pager.v))
+            max_blocks_per_seq=max_blocks, dtype=e.emb.dtype,
+            quantized=e.kv_int8)
+        # the capacity lever the pool-bytes gauge documents: equal byte
+        # budgets admit ~2x the requests when the pools are quantized
+        self._pools, self.kv_pool_bytes = _pool_layout(self._pager,
+                                                       e.kv_int8)
+        self.kv_cache_dtype = kv_cache_dtype
         self.kv_spill = bool(kv_spill)
         self.prefix_cache = PrefixCache(
             self._pager, spill=self.kv_spill,
             spill_capacity_blocks=spill_capacity_blocks) if prefix_cache \
             else None
+        self.spec_lookahead = max(0, int(spec_lookahead))
+        if self.spec_lookahead:
+            from .spec_decode import SuffixDrafter
+
+            self._drafter = SuffixDrafter(
+                lookahead=self.spec_lookahead, max_ngram=int(spec_ngram),
+                prefix_cache=self.prefix_cache)
+        else:
+            self._drafter = None
+        # host counters behind the spec metrics (the bench reads these
+        # directly so accept rates report with the monitor off too)
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        # per-slot radix-registration cursors (see _register_decode_blocks);
+        # content-addressed, so any slot reuse invalidates the entry
+        self._chain_cursors = {}
         # host-side slot state (numpy mirrors so pack assembly and
         # capacity checks vectorize — the step's host tax is part of the
         # serving hot path)
@@ -687,6 +757,9 @@ class ContinuousBatchingEngine:
         self._slots[slot] = req
         self._active[slot] = True
         self._decode_ready[slot] = False
+        self._chain_cursors.pop(slot, None)
+        if self._drafter is not None:
+            self._drafter.admit(req.rid, req.prompt)
         self._stats[req.rid] = {
             "rid": req.rid, "slot": slot, "prompt_len": L,
             "tenant": req.tenant,
@@ -732,6 +805,9 @@ class ContinuousBatchingEngine:
         self._active[slot] = False
         self._decode_ready[slot] = False
         self.lens[slot] = 0
+        self._chain_cursors.pop(slot, None)
+        if self._drafter is not None:
+            self._drafter.drop(req.rid)   # _restore re-admits the context
         self._requeue_front(req)
         if mon.tstate.on:
             entry = self._req_spans.get(req.rid)
@@ -778,6 +854,15 @@ class ContinuousBatchingEngine:
         self._active[slot] = True
         self._decode_ready[slot] = decode_ready
         self._last_tok[slot] = req.last_token
+        self._chain_cursors.pop(slot, None)
+        if self._drafter is not None:
+            # rebuild the draft context (prompt + everything emitted so
+            # far) so the restored continuation speculates like an
+            # undisturbed run
+            ctx = req.prompt if not req.outputs else np.concatenate(
+                [req.prompt, np.asarray(req.outputs, np.int32)])
+            self._drafter.drop(req.rid)
+            self._drafter.admit(req.rid, ctx)
         st = self._stats.get(req.rid)
         if st is None:
             st = self._stats[req.rid] = {
@@ -884,8 +969,31 @@ class ContinuousBatchingEngine:
         decode_slots = np.flatnonzero(self._decode_ready)
         prefill_slots = np.flatnonzero(self._active
                                        & ~self._decode_ready).tolist()
+        nd = len(decode_slots)
+        draft_map = {}
+        spec_ok = False
+        if self._drafter is not None and nd:
+            # THE verify site of the speculative path: a flag fault here
+            # degrades the drafter to plain 1-token decode for this step
+            # — outputs stay correct (drafts are only ever verified),
+            # just no speedup while the drill holds
+            _sp = _fi.fire("serving.spec_verify")
+            spec_ok = _sp is None or _sp.action != "flag"
+        if spec_ok and not prefill_slots:
+            # steady state: the whole spare budget is draft-verify lanes.
+            # Grant their blocks HERE, before the burst gate — a pool
+            # that cannot fund the drafts must fall back to the K-token
+            # burst, not to bare 1-token steps (the grant is idempotent:
+            # the mixed path's later _grant_drafts re-ensures owned
+            # blocks through the no-grant fast path)
+            draft_map = self._collect_drafts(decode_slots, T - nd,
+                                             max_new_tokens)
+            if draft_map:
+                base = np.where(self._active, self.lens, 0)
+                base[decode_slots] += 1
+                _trial, draft_map = self._grant_drafts(base, draft_map)
         K = self.decode_burst
-        if K > 1 and not prefill_slots and len(decode_slots) \
+        if K > 1 and not prefill_slots and not draft_map and nd \
                 and (self.lens[decode_slots] + K < self.max_len).all() \
                 and self._burst_useful(decode_slots, K, max_new_tokens):
             # steady state: no prefill work in the batch — fuse K decode
@@ -926,7 +1034,6 @@ class ContinuousBatchingEngine:
             # priority lanes first (the QoS lever), then admission order
             prefill_slots.sort(key=lambda b: (-self._slots[b].priority,
                                               self._slots[b].rid))
-        nd = len(decode_slots)
         budget = T - nd
         if self.decode_priority > 0.0:
             # bound the prefill share of the pack, but never starve it to
@@ -953,6 +1060,10 @@ class ContinuousBatchingEngine:
                 need[victim] = 0
                 if victim in prefill_slots:
                     prefill_slots.remove(victim)
+        # draft-verify lanes write one position each past the decode
+        # fence — their blocks grant opportunistically (speculation is
+        # best-effort: a pool that cannot cover the drafts decodes plain)
+        need, draft_map = self._grant_drafts(need, draft_map)
         chunks = []                     # (slot, start, take)
         for b in prefill_slots:
             if budget <= 0:
@@ -969,6 +1080,14 @@ class ContinuousBatchingEngine:
             need = trial
             chunks.append((b, req.prefill_pos, take))
             budget -= take
+        if spec_ok and not draft_map and prefill_slots:
+            # mixed steps spend prefill first (it unblocks new streams);
+            # lanes the chunks left over still carry draft verification
+            left = T - nd - sum(take for _b, _s, take in chunks)
+            if left > 0:
+                draft_map = self._collect_drafts(decode_slots, left,
+                                                 max_new_tokens)
+                need, draft_map = self._grant_drafts(need, draft_map)
         if not nd and not chunks:
             if self.kv_spill and self._preempt_lowest() is not None:
                 # pool fully pinned and nothing can progress: spill one
@@ -982,14 +1101,40 @@ class ContinuousBatchingEngine:
                 "serving step cannot pack any lane: paged KV pool "
                 "exhausted with no evictable prefix-cache blocks")
         # pack assembly (vectorized — this runs every step): decode lanes
-        # first, then prefill chunks. tok_ids/positions ride ONE (2, T)
-        # upload; a fresh array each step so the async transfer never
-        # races a host-side reuse
+        # (each followed by its draft-verify lanes, so accept chains are
+        # contiguous for the device-side scan) first, then prefill
+        # chunks. tok_ids/positions ride ONE (2, T) upload; a fresh array
+        # each step so the async transfer never races a host-side reuse
         pack_np = np.zeros((2, T), np.int32)
         tok_ids, positions = pack_np[0], pack_np[1]
-        tok_ids[:nd] = self._last_tok[decode_slots]
-        positions[:nd] = self.lens[decode_slots]
-        lane = nd
+        if draft_map:
+            dec_lanes = []              # (slot, base lane, n drafts)
+            lane = 0
+            for b in decode_slots:
+                d = draft_map.get(int(b))
+                kb = 0 if d is None else len(d)
+                tok_ids[lane] = self._last_tok[b]
+                positions[lane] = self.lens[b]
+                if kb:
+                    # draft j rides position lens+j — exactly where the
+                    # serial decode would have fed it; a rejected
+                    # draft's write past the accept fence is rolled back
+                    # by simply not advancing lens (the position is
+                    # re-written before any lane's mask can read it)
+                    tok_ids[lane + 1:lane + 1 + kb] = d
+                    positions[lane + 1:lane + 1 + kb] = \
+                        self.lens[b] + 1 + np.arange(kb)
+                dec_lanes.append((int(b), lane, kb))
+                lane += 1 + kb
+            n_dec_lanes = lane
+        else:
+            # the draft-free pack (every non-spec engine, every step):
+            # keep the PR 5 vectorized assembly — no per-slot loop in
+            # the hot path
+            dec_lanes = None
+            tok_ids[:nd] = self._last_tok[decode_slots]
+            positions[:nd] = self.lens[decode_slots]
+            lane = n_dec_lanes = nd
         emit_lanes = {}                 # slot -> lane of its LAST prompt tok
         for b, start, take in chunks:
             req = self._slots[b]
@@ -1004,8 +1149,12 @@ class ContinuousBatchingEngine:
         # the all-refs<=1 guard keeps the unshared steady state free
         if (self._pager._refs > 1).any():
             rows = np.empty(n_lanes, np.int64)
-            rows[:nd] = decode_slots
-            lane = nd
+            if dec_lanes is None:
+                rows[:nd] = decode_slots
+            else:
+                for b, lane0, kb in dec_lanes:
+                    rows[lane0:lane0 + 1 + kb] = b
+            lane = n_dec_lanes
             for b, _start, take in chunks:
                 rows[lane:lane + take] = b
                 lane += take
@@ -1034,36 +1183,46 @@ class ContinuousBatchingEngine:
                     # consumed device arrays
                     self._pools = e2.pools
                     raise
-        # slot-id/valid lane vectors depend only on the pack COMPOSITION,
-        # which repeats every step in steady decode — reuse the uploaded
-        # device copies instead of re-transferring them
+        # slot-id/valid/chain lane vectors depend only on the pack
+        # COMPOSITION, which repeats every step in steady decode — reuse
+        # the uploaded device copies instead of re-transferring them
         key = (decode_slots.tobytes(),
+               () if dec_lanes is None
+               else tuple(kb for _b, _l, kb in dec_lanes),
                tuple((b, take) for b, _s, take in chunks))
         cached = self._lane_cache.get(key)
         if cached is None:
             slot_np = np.zeros(T, np.int32)
             valid_np = np.zeros(T, bool)
-            slot_np[:nd] = decode_slots
-            lane = nd
+            chain_np = np.zeros(T, bool)
+            if dec_lanes is None:
+                slot_np[:nd] = decode_slots
+            else:
+                for b, lane0, kb in dec_lanes:
+                    slot_np[lane0:lane0 + 1 + kb] = b
+                    chain_np[lane0 + 1:lane0 + 1 + kb] = True
+            lane = n_dec_lanes
             for b, _start, take in chunks:
                 slot_np[lane:lane + take] = b
                 lane += take
             valid_np[:n_lanes] = True
-            cached = (jnp.asarray(slot_np), jnp.asarray(valid_np))
+            cached = (jnp.asarray(slot_np), jnp.asarray(valid_np),
+                      jnp.asarray(chain_np))
             if len(self._lane_cache) > 256:
                 self._lane_cache.clear()
             self._lane_cache[key] = cached
-        slots_dev, valid_dev = cached
+        slots_dev, valid_dev, chain_dev = cached
         if mon.tstate.on:
             mon.trace.record_span(
                 "serving.pack_tokens", t0, mon.mod.now_ns(),
-                attrs={"n_decode": nd, "n_prefill": n_lanes - nd,
-                       "budget": T})
+                attrs={"n_decode": nd, "n_draft": n_dec_lanes - nd,
+                       "n_prefill": n_lanes - n_dec_lanes, "budget": T})
         step = self._step_jit()
-        toks_dev, self._pools = step(
+        out_dev, self._pools = step(
             jnp.asarray(pack_np), self._pools, self._pager.block_tables,
-            slots_dev, valid_dev)
-        toks = np.asarray(toks_dev)
+            slots_dev, valid_dev, chain_dev)
+        out = np.asarray(out_dev)
+        toks, acc = out[0], out[1]
         if epoch != self._epoch:
             # a hang recovery superseded this step while it sat in
             # compile/dispatch. The pools rebind above MUST stand — the
@@ -1089,14 +1248,53 @@ class ContinuousBatchingEngine:
                         "serving.prefill_chunk", t0, t1, parent=entry[0],
                         attrs={"slot": int(b), "start": start,
                                "tokens": take})
-        # route decode results
+        # route decode results: every slot emits its base token plus one
+        # token per ACCEPTED draft (longest agreeing prefix, computed on
+        # device) — the greedy sequence, just several tokens per dispatch
         finished = []
         emitted = 0
-        for i, b in enumerate(decode_slots):
-            self.lens[b] += 1
-            emitted += 1
-            self._note_token(b, int(toks[i]), eos_token_id, max_new_tokens,
-                             finished, mon, t1)
+        n_draft = n_dec_lanes - nd
+        n_accept = 0
+        if dec_lanes is None:
+            for i, b in enumerate(decode_slots):
+                pre = int(self.lens[b])
+                self.lens[b] += 1
+                emitted += 1
+                self._note_token(b, int(toks[i]), eos_token_id,
+                                 max_new_tokens, finished, mon, t1)
+                self._register_decode_blocks(b, pre, mon)
+        else:
+            for b, lane0, kb in dec_lanes:
+                a = int(acc[lane0 + 1:lane0 + 1 + kb].sum()) if kb else 0
+                pre = int(self.lens[b])
+                routed = 0
+                for j in range(a + 1):
+                    if self._slots[b] is None:
+                        break           # finished mid-verify: the rest
+                    self.lens[b] += 1   # of its lane is discarded
+                    emitted += 1
+                    routed += 1
+                    self._note_token(b, int(toks[lane0 + j]),
+                                     eos_token_id, max_new_tokens,
+                                     finished, mon, t1)
+                # accepted = draft tokens actually DELIVERED: an eos
+                # mid-chain discards the rest of the lane, and the
+                # cataloged counter promises emitted tokens
+                n_accept += max(routed - 1, 0)
+                self._register_decode_blocks(b, pre, mon)
+        if n_draft:
+            self.spec_drafted += n_draft
+            self.spec_accepted += n_accept
+            if mon.state.on:
+                mon.spec_drafted.inc(n_draft)
+                mon.spec_accepted.inc(n_accept)
+                mon.spec_rate.set(self.spec_accepted
+                                  / max(self.spec_drafted, 1))
+            if mon.tstate.on:
+                mon.trace.record_span(
+                    "serving.spec_verify", t0, t1,
+                    attrs={"drafted": n_draft, "accepted": n_accept,
+                           "lanes": nd})
         # route prefill progress (+ first tokens of completed prefills)
         for b, start, take in chunks:
             req = self._slots[b]
@@ -1138,6 +1336,97 @@ class ContinuousBatchingEngine:
             self._update_gauges(mon)
             mon.mod.sample()   # chrome-trace counter timeline, per step
         return finished
+
+    def _register_decode_blocks(self, slot, pre_lens, mon):
+        """With speculation on, GENERATED full blocks join the radix
+        chain too (prompt blocks already do, at prefill): a repeated
+        prompt then finds its previous run's whole continuation as chain
+        children, and the drafter's radix source proposes it — greedy
+        decoding is deterministic, so those drafts verify near-perfectly.
+        Only spec engines pay the pins: without a drafter nothing would
+        ever read the decode chain. ``pre_lens=None`` registers
+        unconditionally (the eviction-time tail sweep); otherwise only
+        when this step crossed a block boundary."""
+        if self._drafter is None or self.prefix_cache is None:
+            return
+        req = self._slots[slot]
+        if req is None or not req.outputs:
+            return
+        bs = self.block_size
+        if pre_lens is not None \
+                and int(self.lens[slot]) // bs == int(pre_lens) // bs:
+            return                      # no block filled this step
+        # resume the chain walk where the last crossing left it (the
+        # context is append-only, so the cursor digest stays valid) and
+        # hand register_from only the tokens past the cursor block —
+        # re-digesting (or re-copying) the whole context on every
+        # crossing is quadratic in generation length, on the serving
+        # hot path
+        cursor = self._chain_cursors.get(slot, (0, b""))
+        start = int(cursor[0]) * bs
+        lp = len(req.prompt)
+        if start < lp:
+            tail = np.concatenate([
+                np.asarray(req.prompt[start:], np.int32),
+                np.asarray(req.outputs, np.int32)])
+        else:
+            tail = np.asarray(req.outputs[start - lp:], np.int32)
+        n, cursor = self.prefix_cache.register_from(
+            cursor, tail, int(self.lens[slot]),
+            self._pager._tables_np[slot])
+        self._chain_cursors[slot] = cursor
+        if mon.state.on and n:
+            mon.pc_blocks.set(len(self.prefix_cache))
+
+    def _collect_drafts(self, decode_slots, budget, max_new_tokens):
+        """Ask the drafter (models/spec_decode.py) for up to
+        ``spec_lookahead`` tokens per decode lane, bounded by the step's
+        spare lane budget, the cache capacity, and the request's
+        remaining token allowance — drafting past any of them would burn
+        lanes that can never emit."""
+        draft_map = {}
+        left = int(budget)
+        for b in decode_slots:
+            if left <= 0:
+                break
+            req = self._slots[b]
+            cap = min(self.spec_lookahead, left,
+                      self.max_len - 1 - int(self.lens[b]))
+            limit = req.max_new if req.max_new is not None \
+                else max_new_tokens
+            if limit is not None:
+                cap = min(cap, limit - len(req.outputs) - 1)
+            if cap <= 0:
+                continue
+            d = self._drafter.draft(req.rid, cap)
+            if len(d):
+                draft_map[int(b)] = d
+                left -= len(d)
+        return draft_map
+
+    def _grant_drafts(self, need, draft_map):
+        """Opportunistic block grant for draft-verify lanes: every
+        drafted position may be written (rejected drafts included), so
+        each needs a granted block. Speculation is best-effort — a slot
+        whose drafts the pool cannot cover just decodes plainly this
+        step, WITHOUT dropping the other slots' drafts (per-slot
+        grants). The grant goes to the RAW allocator, never through
+        _ensure's radix relief: speculation must not evict (or spill)
+        the very cache blocks its chain drafts read from."""
+        if not draft_map:
+            return need, draft_map
+        trial = need.copy()
+        kept = {}
+        for b, d in draft_map.items():
+            t2 = trial.copy()
+            t2[b] += len(d)
+            try:
+                self._pager.ensure_capacity(t2)
+            except RuntimeError:
+                continue
+            trial = t2
+            kept[b] = d
+        return trial, kept
 
     def _burst_useful(self, decode_slots, K, max_new_tokens):
         """Worth bursting only when at least half the fused lanes would
@@ -1182,6 +1471,7 @@ class ContinuousBatchingEngine:
         finished = []
         emitted = 0
         for b in decode_slots:
+            pre = int(self.lens[b])
             for i in range(K):
                 if self._slots[b] is None:
                     break               # finished mid-burst: the rest of
@@ -1189,6 +1479,7 @@ class ContinuousBatchingEngine:
                 emitted += 1
                 self._note_token(b, int(toks[b, i]), eos_token_id,
                                  max_new_tokens, finished, mon, t1)
+            self._register_decode_blocks(b, pre, mon)
         if mon.state.on:
             mon.decode.observe(t1 - t0)
             mon.tokens.inc(emitted)
@@ -1202,6 +1493,8 @@ class ContinuousBatchingEngine:
         req.outputs.append(tok)
         req.last_token = tok
         self._last_tok[slot] = tok
+        if self._drafter is not None:
+            self._drafter.note(req.rid, tok)
         limit = req.max_new if req.max_new is not None else max_new_tokens
         done = (eos_token_id is not None and tok == eos_token_id) \
             or (limit is not None and len(req.outputs) >= limit) \
@@ -1218,11 +1511,19 @@ class ContinuousBatchingEngine:
         st = self._stats.get(req.rid)
         if st is not None:
             st["tokens"] = len(req.outputs)
+        # last chance to chain the generation's tail blocks: a finishing
+        # request's final block-crossings happen inside the same routing
+        # loop that evicts it, so register (and pin) them before the row
+        # is freed — a repeated prompt then drafts the WHOLE previous run
+        self._register_decode_blocks(slot, None, mon)
         self._pager.free_sequence(slot)
         self._slots[slot] = None
         self._active[slot] = False
         self._decode_ready[slot] = False
         self.lens[slot] = 0
+        self._chain_cursors.pop(slot, None)
+        if self._drafter is not None:
+            self._drafter.drop(req.rid)
         if entry is not None:
             t1 = mon.mod.now_ns()
             mon.trace.drop(entry[1])   # only open if tracing toggled off
@@ -1242,6 +1543,7 @@ class ContinuousBatchingEngine:
             mon.tenant_depth.labels(t.name).set(n)
         mon.queue_depth.set(depth)
         mon.occupancy.set(float(self._active.sum()) / self.max_batch)
+        mon.pool_bytes.set(self.kv_pool_bytes)
 
     @property
     def num_active(self):
@@ -1319,11 +1621,14 @@ class ContinuousBatchingEngine:
                     st["tokens"] = len(req.outputs)
                 self._pager.free_sequence(b)
                 self._slots[b] = None
+                if self._drafter is not None:
+                    self._drafter.drop(req.rid)
             self._active[:] = False
             self._decode_ready[:] = False
             self.lens[:] = 0
             self._last_tok[:] = 0
             self._lane_cache.clear()
+            self._chain_cursors.clear()
             # NOT torn down: the compiled programs (still valid), the
             # admission queues, and the radix cache + its pinned blocks
             # (request refs were freed above; cache refs keep the prefix
@@ -1449,10 +1754,12 @@ class StaticBatchEngine:
     prompt pays bucket padding."""
 
     def __init__(self, model, max_batch=8, max_len=None, block_size=64,
-                 prefill_buckets=(32, 64, 128, 256, 512, 1024, 2048)):
+                 prefill_buckets=(32, 64, 128, 256, 512, 1024, 2048),
+                 kv_cache_dtype=None):
         self._inner = LlamaDecodeEngine(model, max_len=max_len,
                                         kv_cache_layout="paged",
-                                        block_size=block_size)
+                                        block_size=block_size,
+                                        kv_cache_dtype=kv_cache_dtype)
         e = self._inner
         self.max_batch = int(max_batch)
         self.max_len = e.max_len
@@ -1465,8 +1772,11 @@ class StaticBatchEngine:
             num_blocks=self.max_batch * max_blocks + 1,
             block_size=self.block_size, kv_heads=e.num_kv,
             head_dim=e.head_dim, batch=self.max_batch,
-            max_blocks_per_seq=max_blocks, dtype=e.emb.dtype)
-        self._pools = list(zip(self._pager.k, self._pager.v))
+            max_blocks_per_seq=max_blocks, dtype=e.emb.dtype,
+            quantized=e.kv_int8)
+        self._pools, self.kv_pool_bytes = _pool_layout(self._pager,
+                                                       e.kv_int8)
+        self.kv_cache_dtype = kv_cache_dtype
         self.lens = np.zeros(self.max_batch, np.int64)
         self._slots = [None] * self.max_batch
         self._done = np.zeros(self.max_batch, bool)

@@ -134,6 +134,24 @@ METRICS = {
         "counter", (),
         "Active requests preempted under pool pressure: KV spilled to "
         "host RAM, request requeued at the head of its tenant queue."),
+    "paddle_tpu_serving_spec_draft_tokens_total": (
+        "counter", (),
+        "Speculative draft tokens packed into mixed-step verify lanes "
+        "(the n-gram/radix drafter's proposals, models/spec_decode.py)."),
+    "paddle_tpu_serving_spec_accepted_tokens_total": (
+        "counter", (),
+        "Draft tokens accepted by the device-side longest-agreeing-"
+        "prefix verification (each one is a greedy token emitted without "
+        "its own decode dispatch)."),
+    "paddle_tpu_serving_spec_accept_rate": (
+        "gauge", (),
+        "Cumulative speculative accept rate: accepted / drafted tokens "
+        "since engine construction (0..1)."),
+    "paddle_tpu_serving_kv_pool_bytes": (
+        "gauge", (),
+        "Device bytes held by the engine's paged KV pools (all layers, "
+        "values + scales) — the capacity lever quantized int8 pools "
+        "halve: equal byte budgets admit ~2x the concurrent requests."),
     # -- paged KV allocator (models/paged_kv.py) -------------------------
     "paddle_tpu_kv_free_blocks": (
         "gauge", (),
@@ -258,6 +276,11 @@ SPANS = {
         "One request preempted under pool pressure: its KV spilled to "
         "host RAM, its blocks freed, the request requeued (restored "
         "bit-exact on re-admission). attrs: slot, rid, tokens_in_kv."),
+    "serving.spec_verify": (
+        "One mixed step's speculative verification: draft tokens packed "
+        "as extra ragged lanes, accepted by the device-side longest-"
+        "agreeing-prefix rule, rejects rolled back by rewinding "
+        "seq_lens. attrs: drafted, accepted, lanes."),
     # -- dataloader (io/dataloader.py) -----------------------------------
     "dataloader.batch": (
         "Consumer-visible wait for the next staged batch (fetch + "

@@ -105,6 +105,50 @@ class TestChaosSmoke:
         assert row["value"] == o["gold_goodput_ratio"] >= 0.9, o
 
 
+class TestSpecSmoke:
+    # fast tier on purpose: `bench_suite.py --smoke spec` is the ISSUE 7
+    # speculative-decoding + quantized-KV acceptance — spec-on vs
+    # spec-off at equal engine config on a repeat-heavy workload, plus
+    # the int8 pool capacity check
+    def test_smoke_spec_meets_acceptance(self):
+        env = dict(os.environ)
+        env["PADDLE_TPU_PLATFORM"] = "cpu"
+        env["JAX_PLATFORMS"] = "cpu"
+        # the speedup is a wall-clock measurement on a shared CPU: retry
+        # up to 3 runs for the >= 1.3x bar (the repo's flaky-budget
+        # pattern); every run must pass the bench's own hard bounds
+        # (bit-exactness, accept rate, capacity — asserted inside
+        # run_spec, a non-zero exit fails here)
+        row = None
+        for _ in range(3):
+            out = subprocess.run(
+                [sys.executable, SUITE, "--smoke", "spec"],
+                capture_output=True, text=True, timeout=300, env=env,
+                cwd=ROOT)
+            assert out.returncode == 0, out.stderr[-800:]
+            row = json.loads(out.stdout.strip().splitlines()[-1])
+            if row["value"] >= 1.3:
+                break
+        assert row["config"] == "spec"
+        assert row["unit"] == "speedup_vs_nonspec"
+        d = row["detail"]
+        # ISSUE 7 acceptance: >= 1.3x serving tokens/s on the
+        # repetitive workload, with the accept rate reported and greedy
+        # outputs bit-identical to the non-spec pass
+        assert row["value"] == d["spec_speedup"] >= 1.3, d
+        assert d["spec_tokens_match"] is True
+        assert d["spec_accepted_tokens"] > 0
+        assert 0 < d["spec_accept_rate"] <= 1.0
+        assert d["spec_on_tokens_per_sec"] > d["spec_off_tokens_per_sec"] > 0
+        # ... and the quantized pools admit >= 1.8x the concurrent
+        # requests of the full-precision engine at an equal-or-smaller
+        # byte budget (read from the pool-bytes gauge)
+        cap = d["int8_capacity"]
+        assert cap["request_ratio"] >= 1.8, cap
+        assert cap["bytes_ratio"] <= 1.0, cap
+        assert cap["int8"]["pool_bytes"] <= cap["ref"]["pool_bytes"]
+
+
 @pytest.mark.slow
 class TestBenchSuite:
     def test_lenet_and_bert(self):

@@ -475,3 +475,70 @@ class TestAllocatorInvariants:
                                   * (lens > 0))
                 pools = [c.make_tail_exclusive(pos, pools[0])]
             self._check(c)
+
+
+class TestQuantizedSpillRoundTrip:
+    """ISSUE 7 satellite: the spill read/restore path must carry the
+    quantized pool layout's FOUR leaves per layer (int8 K/V values +
+    fp32 per-(token, head) scales) bit-exactly — read_blocks downloads
+    whatever leaf tuple the pool holds, write_block_contents scatters it
+    back, and no leaf may be dropped, reordered, or recast."""
+
+    def _pool(self, quantized, blocks=9, bs=4, layers=2):
+        return PagedKVCache(num_layers=layers, num_blocks=blocks,
+                            block_size=bs, kv_heads=2, head_dim=8,
+                            batch=2, max_blocks_per_seq=4,
+                            dtype=jnp.float32, quantized=quantized)
+
+    def test_quantized_roundtrip_bit_exact(self):
+        from paddle_tpu.models.paged_kv import read_blocks
+        c = self._pool(True)
+        pools = list(zip(c.k, c.k_scale, c.v, c.v_scale))
+        rng = np.random.RandomState(0)
+        blks = [2, 5, 7]                      # 3 blocks: pads to 4 inside
+        vshape = (len(blks),) + tuple(c.k[0].shape[1:])
+        sshape = (len(blks),) + tuple(c.k_scale[0].shape[1:])
+        want = [tuple(
+            rng.randint(-128, 128, vshape).astype(np.int8) if i % 2 == 0
+            else rng.rand(*sshape).astype(np.float32)
+            for i in range(4)) for _ in range(2)]
+        pools = c.write_block_contents(pools, blks, want)
+        got = read_blocks(pools, blks)
+        assert len(got) == 2
+        for wl, gl in zip(want, got):
+            assert len(gl) == 4               # (kq, ks, vq, vs)
+            for w, g in zip(wl, gl):
+                assert g.dtype == w.dtype
+                np.testing.assert_array_equal(g, w)
+
+    def test_quantized_roundtrip_leaves_other_blocks_alone(self):
+        from paddle_tpu.models.paged_kv import read_blocks
+        c = self._pool(True, layers=1)
+        pools = [(c.k[0], c.k_scale[0], c.v[0], c.v_scale[0])]
+        rng = np.random.RandomState(1)
+        vshape = (1,) + tuple(c.k[0].shape[1:])
+        sshape = (1,) + tuple(c.k_scale[0].shape[1:])
+        content = [(rng.randint(-128, 128, vshape).astype(np.int8),
+                    rng.rand(*sshape).astype(np.float32),
+                    rng.randint(-128, 128, vshape).astype(np.int8),
+                    rng.rand(*sshape).astype(np.float32))]
+        pools = c.write_block_contents(pools, [3], content)
+        # the power-of-two padding wrote only the null block; every
+        # other real block stays zero
+        others = [b for b in range(1, c.num_blocks) if b != 3]
+        for leaf in read_blocks(pools, others)[0]:
+            assert not leaf.any()
+
+    def test_full_precision_roundtrip_still_two_leaves(self):
+        from paddle_tpu.models.paged_kv import read_blocks
+        c = self._pool(False, layers=1)
+        pools = [(c.k[0], c.v[0])]
+        rng = np.random.RandomState(2)
+        shape = (2,) + tuple(c.k[0].shape[1:])
+        content = [(rng.rand(*shape).astype(np.float32),
+                    rng.rand(*shape).astype(np.float32))]
+        pools = c.write_block_contents(pools, [1, 4], content)
+        got = read_blocks(pools, [1, 4])
+        assert len(got[0]) == 2
+        for w, g in zip(content[0], got[0]):
+            np.testing.assert_array_equal(g, w)

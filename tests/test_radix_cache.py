@@ -201,3 +201,106 @@ def test_reregistered_parent_reconnects_orphaned_children():
     pc.register(prompt[:4], 4, row1)                    # parent reborn
     blocks, n = pc.match(prompt)
     assert n == 8 and blocks[1] == child_blk            # child reattached
+
+
+class TestContinueTokens:
+    """The speculative drafter's radix source (ISSUE 7): a cached chain
+    proposes the tokens it stores PAST the current context — verified by
+    token comparison, walked block to block, None on any mismatch."""
+
+    def test_walks_down_the_chain(self):
+        pager = _pager(blocks=32, bs=4)
+        pc = PrefixCache(pager)
+        prompt = np.arange(12, dtype=np.int32)          # 3 full blocks @ 4
+        row = _written(pager, 0, 12)
+        assert pc.register(prompt, 12, row) == 3
+        # context = first 6 tokens: 1 full block + partial [4, 5]
+        parent = next(d for d, e in pc._entries.items()
+                      if e.tokens[0] == 0)
+        got = pc.continue_tokens(parent, [4, 5], 10)
+        np.testing.assert_array_equal(got, [6, 7, 8, 9, 10, 11])
+        # k caps the proposal
+        np.testing.assert_array_equal(
+            pc.continue_tokens(parent, [4, 5], 3), [6, 7, 8])
+
+    def test_block_aligned_context_continues_from_child(self):
+        pager = _pager(blocks=32, bs=4)
+        pc = PrefixCache(pager)
+        prompt = np.arange(8, dtype=np.int32)
+        row = _written(pager, 0, 8)
+        pc.register(prompt, 8, row)
+        parent = next(d for d, e in pc._entries.items()
+                      if e.tokens[0] == 0)
+        got = pc.continue_tokens(parent, [], 8)
+        np.testing.assert_array_equal(got, [4, 5, 6, 7])
+
+    def test_mismatched_partial_returns_none(self):
+        pager = _pager(blocks=32, bs=4)
+        pc = PrefixCache(pager)
+        prompt = np.arange(8, dtype=np.int32)
+        row = _written(pager, 0, 8)
+        pc.register(prompt, 8, row)
+        parent = next(d for d, e in pc._entries.items()
+                      if e.tokens[0] == 0)
+        assert pc.continue_tokens(parent, [99], 8) is None      # diverges
+        assert pc.continue_tokens(b"nope", [4, 5], 8) is None   # no chain
+        # context already past everything the chain stores
+        assert pc.continue_tokens(parent, [4, 5, 6, 7], 8) is None
+
+    def test_newest_matching_child_wins(self):
+        """Two children extend the same parent with different partials
+        (the same prompt re-decoded after divergence): the proposal must
+        come from a child whose stored tokens MATCH the context, not
+        whichever registered first."""
+        pager = _pager(batch=2, blocks=32, bs=4)
+        pc = PrefixCache(pager)
+        a = np.array([0, 1, 2, 3, 4, 5, 6, 7], np.int32)
+        b = np.array([0, 1, 2, 3, 9, 8, 7, 6], np.int32)
+        row0 = _written(pager, 0, 8)
+        row1 = _written(pager, 1, 8)
+        pc.register(a, 8, row0)
+        pc.register(b, 8, row1)
+        parent = next(d for d, e in pc._entries.items()
+                      if list(e.tokens) == [0, 1, 2, 3])
+        np.testing.assert_array_equal(
+            pc.continue_tokens(parent, [4, 5], 4), [6, 7])
+        np.testing.assert_array_equal(
+            pc.continue_tokens(parent, [9, 8], 4), [7, 6])
+
+    def test_eviction_unlinks_child_edges(self):
+        pager = _pager(blocks=32, bs=4)
+        pc = PrefixCache(pager)
+        prompt = np.arange(8, dtype=np.int32)
+        row = _written(pager, 0, 8)
+        pc.register(prompt, 8, row)
+        pager.free_sequence(0)
+        assert pc.evict(2, pools=[(pager.k[0], pager.v[0])]) == 2
+        assert pc._children == {}
+        parent = b""
+        assert pc.continue_tokens(parent, [0, 1], 8) is None
+
+    def test_reborn_parent_reconnects_continue_tokens(self):
+        """Downward edges survive their entry's eviction (digests are
+        content-addressed): evicting a chain's root and re-registering
+        the same prefix must bring continue_tokens back for the still-
+        cached child — the drafter's radix source heals exactly like
+        match() does."""
+        pager = _pager(batch=2, blocks=32, bs=4)
+        pc = PrefixCache(pager)
+        prompt = np.arange(8, dtype=np.int32)           # blocks P0, P1
+        row = _written(pager, 0, 8)
+        pc.register(prompt, 8, row)
+        parent_digest = next(d for d, e in pc._entries.items()
+                             if e.tokens[0] == 0)
+        pager.free_sequence(0)
+        # evict ONLY the root (P1 stays cached, now orphaned)
+        pc._drop(pc._entries[parent_digest])
+        assert pc.continue_tokens(b"", [0, 1], 8) is None
+        # the orphan's edge is still reachable under the DEAD digest —
+        # content addressing makes that correct, not stale
+        np.testing.assert_array_equal(
+            pc.continue_tokens(parent_digest, [4, 5], 8), [6, 7])
+        row1 = _written(pager, 1, 4)
+        pc.register(prompt[:4], 4, row1)                # root reborn
+        np.testing.assert_array_equal(
+            pc.continue_tokens(b"", [0, 1], 8), [2, 3, 4, 5, 6, 7])

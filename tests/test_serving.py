@@ -633,6 +633,243 @@ class TestKVSpill:
         assert pc.restores == 0
 
 
+class TestSpeculativeDecoding:
+    def test_spec_on_bit_identical_to_off(self):
+        """ISSUE 7 acceptance: greedy outputs are bit-identical with
+        speculation on vs off — drafts ride extra verify lanes of the
+        same compiled mixed step and only the longest agreeing prefix is
+        kept, so a wrong draft costs a lane, never a token."""
+        model = _model()
+        rng = np.random.RandomState(11)
+        # a repetitive prompt (the n-gram drafter's home turf) plus two
+        # random ones: the accept rate varies per lane, the tokens don't
+        prompts = [np.tile(rng.randint(0, 96, (4,)).astype("int32"), 5),
+                   rng.randint(0, 96, (9,)).astype("int32"),
+                   rng.randint(0, 96, (13,)).astype("int32")]
+        outs = {}
+        for la in (0, 6):
+            eng = ContinuousBatchingEngine(model, max_batch=4, max_len=64,
+                                           block_size=8, chunk_size=16,
+                                           spec_lookahead=la)
+            rids = [eng.submit(p, max_new_tokens=12) for p in prompts]
+            done = _run_all(eng, max_steps=200)
+            outs[la] = [done[r] for r in rids]
+            if la:
+                assert eng.spec_drafted > 0
+                assert 0 < eng.spec_accepted <= eng.spec_drafted
+        for off, on in zip(outs[0], outs[6]):
+            np.testing.assert_array_equal(off, on)
+
+    def test_repeated_prompt_drafts_from_radix_chain(self):
+        """The second draft source: spec engines register DECODE blocks
+        into the radix chain, so a repeated prompt finds its previous
+        run's continuation as chain tokens — greedy determinism makes
+        those drafts near-perfect (the production repeat/template
+        shape the spec bench measures)."""
+        model = _model()
+        rng = np.random.RandomState(12)
+        prompt = rng.randint(0, 96, (10,)).astype("int32")
+        eng = ContinuousBatchingEngine(model, max_batch=1, max_len=64,
+                                       block_size=8, chunk_size=16,
+                                       spec_lookahead=8, pool_blocks=24)
+        rid = eng.submit(prompt, max_new_tokens=16)
+        first = _run_all(eng, max_steps=200)[rid]
+        d0, a0 = eng.spec_drafted, eng.spec_accepted
+        rid = eng.submit(prompt, max_new_tokens=16)
+        second = _run_all(eng, max_steps=200)[rid]
+        np.testing.assert_array_equal(first, second)
+        drafted = eng.spec_drafted - d0
+        accepted = eng.spec_accepted - a0
+        assert drafted > 0
+        # the warm pass drafts from the registered chain: most drafted
+        # tokens are the previous run's exact greedy output
+        assert accepted / drafted >= 0.75, (accepted, drafted)
+
+    def test_spec_metrics_and_verify_span(self):
+        """The cataloged telemetry: drafted/accepted counters, the
+        accept-rate gauge, the pool-bytes gauge, and one
+        serving.spec_verify span per speculating step."""
+        from paddle_tpu.monitor import trace
+        model = _model()
+        monitor.reset()
+        monitor.enable()
+        trace.enable()
+        try:
+            eng = ContinuousBatchingEngine(model, max_batch=2, max_len=64,
+                                           block_size=8, chunk_size=16,
+                                           spec_lookahead=6)
+            rng = np.random.RandomState(13)
+            eng.submit(np.tile(rng.randint(0, 96, (4,)).astype("int32"), 4),
+                       max_new_tokens=10)
+            _run_all(eng, max_steps=200)
+            assert eng.spec_drafted > 0
+            snap = monitor.snapshot()["metrics"]
+            drafted = snap["paddle_tpu_serving_spec_draft_tokens_total"][
+                "values"][""]
+            accepted = snap["paddle_tpu_serving_spec_accepted_tokens_total"][
+                "values"][""]
+            assert drafted == eng.spec_drafted
+            assert accepted == eng.spec_accepted
+            rate = snap["paddle_tpu_serving_spec_accept_rate"]["values"][""]
+            assert abs(rate - accepted / max(drafted, 1)) < 1e-9
+            assert snap["paddle_tpu_serving_kv_pool_bytes"]["values"][""] \
+                == eng.kv_pool_bytes > 0
+            spans = [s for s in trace.span_dump()["spans"]
+                     if s["name"] == "serving.spec_verify"]
+            assert spans
+            assert all(s["attrs"]["drafted"] >= s["attrs"]["accepted"] >= 0
+                       for s in spans)
+        finally:
+            trace.disable()
+            monitor.disable()
+            monitor.reset()
+
+    def test_spec_verify_fault_degrades_to_plain_decode(self):
+        """ISSUE 7 satellite: a flag fault at serving.spec_verify makes
+        the drafter degrade to plain 1-token decode — zero drafts while
+        the drill holds, outputs bit-identical to the unspeculated run
+        (never wrong output, only sacrificed speedup)."""
+        model = _model()
+        rng = np.random.RandomState(14)
+        prompts = [np.tile(rng.randint(0, 96, (4,)).astype("int32"), 5),
+                   rng.randint(0, 96, (9,)).astype("int32")]
+
+        ref_eng = ContinuousBatchingEngine(model, max_batch=2, max_len=64,
+                                           block_size=8, chunk_size=16)
+        ref_rids = [ref_eng.submit(p, max_new_tokens=12) for p in prompts]
+        ref = _run_all(ref_eng, max_steps=200)
+        fi.reset()
+        try:
+            eng = ContinuousBatchingEngine(model, max_batch=2, max_len=64,
+                                           block_size=8, chunk_size=16,
+                                           spec_lookahead=6)
+            fi.arm("serving.spec_verify", action="flag", nth=1,
+                   times=10 ** 6)
+            rids = [eng.submit(p, max_new_tokens=12) for p in prompts]
+            done = _run_all(eng, max_steps=200)
+            assert eng.spec_drafted == 0
+            trips = fi.trips()
+            assert trips and all(t == ("serving.spec_verify", "flag")
+                                 for t in trips)
+            for rid, rr in zip(rids, ref_rids):
+                np.testing.assert_array_equal(done[rid], ref[rr])
+        finally:
+            fi.reset()
+
+    def test_sanitize_all_spec_steady_state_single_program(self):
+        """ISSUE 7 satellite: with speculation on, the fixed pack shape
+        holds for EVERY accept count 0..K — under PADDLE_TPU_SANITIZE=all
+        a varied-accept workload stays at the engine's compiled programs
+        (no recompile storm, no host-sync trips)."""
+        model = _model()
+        assert san.install_from_env("all") != ()
+        try:
+            eng = ContinuousBatchingEngine(model, max_batch=2, max_len=64,
+                                           block_size=8, chunk_size=16,
+                                           spec_lookahead=6)
+            rng = np.random.RandomState(15)
+            for i in range(6):   # repeats + fresh prompts: accept counts
+                if i % 2:        # swing between 0 and K across steps
+                    p = np.tile(rng.randint(0, 96, (3,)).astype("int32"), 6)
+                else:
+                    p = rng.randint(0, 96, (int(rng.randint(3, 20)),)) \
+                        .astype("int32")
+                eng.submit(p, max_new_tokens=8)
+                for _ in range(10):
+                    eng.step()
+            _run_all(eng, max_steps=200)
+            assert eng.spec_drafted > 0
+            assert san.trips() == []
+            counts = {k: v for k, v in san.compile_counts().items()
+                      if k.startswith("serving.step")}
+            assert counts and all(v <= 2 for v in counts.values()), counts
+        finally:
+            san.disable()
+            san.reset()
+
+
+class TestQuantizedKV:
+    def test_int8_divergence_bounded_vs_full_precision(self):
+        """ISSUE 7 satellite: the int8 engine's outputs stay close to the
+        full-precision engine on identical prompts — quantization noise
+        may eventually flip an argmax, but most tokens (and the whole
+        early sequence) must agree, and the quantized pools must cost
+        under half the full-precision bytes."""
+        model = _model()
+        rng = np.random.RandomState(16)
+        prompts = [rng.randint(0, 96, (n,)).astype("int32")
+                   for n in (9, 5, 13)]
+        outs, bytes_ = {}, {}
+        for dt in (None, "int8"):
+            eng = ContinuousBatchingEngine(model, max_batch=4, max_len=64,
+                                           block_size=8, chunk_size=16,
+                                           kv_cache_dtype=dt)
+            rids = [eng.submit(p, max_new_tokens=12) for p in prompts]
+            done = _run_all(eng, max_steps=200)
+            outs[dt] = [done[r] for r in rids]
+            bytes_[dt] = eng.kv_pool_bytes
+        assert bytes_["int8"] < 0.5 * bytes_[None]
+        for full, q in zip(outs[None], outs["int8"]):
+            n = min(len(full), len(q))
+            assert n >= 8
+            agree = (np.asarray(full[:n]) == np.asarray(q[:n])).mean()
+            assert agree >= 0.75, (full, q)
+            np.testing.assert_array_equal(full[:4], q[:4])
+
+    def test_int8_spec_bit_identical_to_int8_plain(self):
+        """Speculation exactness is dtype-independent: drafts verified
+        against quantized pools keep the int8 engine's own greedy outputs
+        bit-identical, spec on vs off."""
+        model = _model()
+        rng = np.random.RandomState(17)
+        prompts = [np.tile(rng.randint(0, 96, (4,)).astype("int32"), 5),
+                   rng.randint(0, 96, (9,)).astype("int32")]
+        outs = {}
+        for la in (0, 6):
+            eng = ContinuousBatchingEngine(model, max_batch=2, max_len=64,
+                                           block_size=8, chunk_size=16,
+                                           kv_cache_dtype="int8",
+                                           spec_lookahead=la)
+            rids = [eng.submit(p, max_new_tokens=12) for p in prompts]
+            done = _run_all(eng, max_steps=200)
+            outs[la] = [done[r] for r in rids]
+            if la:
+                assert eng.spec_drafted > 0
+        for off, on in zip(outs[0], outs[6]):
+            np.testing.assert_array_equal(off, on)
+
+    def test_quantized_spill_restore_roundtrip_engine(self):
+        """ISSUE 7 satellite: the host KV spill store parks/restores the
+        quantized 4-leaf (kq, ks, vq, vs) layout bit-exactly — evicting a
+        cached chain from int8 pools and re-admitting the prompt restores
+        from host RAM and reproduces the outputs."""
+        model = _model()
+        eng = ContinuousBatchingEngine(model, max_batch=2, max_len=64,
+                                       block_size=8, chunk_size=32,
+                                       kv_cache_dtype="int8",
+                                       kv_spill=True)
+        r = np.random.RandomState(18)
+        prompt = r.randint(0, 96, (24,)).astype("int32")
+        rid = eng.add_request(prompt, max_new_tokens=6)
+        ref = _run_all(eng, max_steps=200)[rid]
+        pc = eng.prefix_cache
+        n_cached = len(pc)
+        assert n_cached >= 3
+        freed = pc.evict(n_cached, pools=eng._pools)
+        assert freed == n_cached and len(pc._spilled) == freed
+        # every parked payload carries all four quantized leaves
+        for se in pc._spilled.values():
+            for entry in se.payload:
+                assert len(entry) == 4
+                kq, ks, vq, vs = entry
+                assert kq.dtype == np.int8 and vq.dtype == np.int8
+                assert ks.dtype == np.float32 and vs.dtype == np.float32
+        rid2 = eng.add_request(prompt, max_new_tokens=6)
+        assert pc.restores == freed
+        np.testing.assert_array_equal(_run_all(eng, max_steps=200)[rid2],
+                                      ref)
+
+
 class TestDriverAndRecovery:
     def test_recover_on_idle_engine_is_clean(self):
         eng = ContinuousBatchingEngine(_model(), max_batch=2, max_len=32,
