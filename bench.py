@@ -632,6 +632,7 @@ _FLAGSHIP_ENV_DEFAULTS = {
     "BENCH_DECODE_KV": "", "BENCH_DECODE_LAYOUT": "",
     "BENCH_SKIP_DECODE": "", "BENCH_SKIP_DISPATCH": "",
     "BENCH_SKIP_FLASHCHECK": "", "BENCH_SKIP_SERVING": "",
+    "BENCH_SKIP_MESH": "",
 }
 
 
@@ -807,6 +808,20 @@ def worker():
         serving_info = {"error": f"{type(e).__name__}: {e}"[:200]}
     _log(f"[bench] serving: {serving_info}")
 
+    # mesh SPMD training (paddle_tpu.mesh): needs >= 8 devices, so on a
+    # single chip/CPU worker mesh_bench reports itself skipped; the 8-device
+    # run is `bench_suite.py --smoke mesh` / the mesh suite config
+    try:
+        if os.environ.get("BENCH_SKIP_MESH"):
+            mesh_info = {"skipped": True}
+        else:
+            from bench_common import mesh_bench
+
+            mesh_info = mesh_bench(iters=2)
+    except Exception as e:  # noqa: BLE001 - headline metric must survive
+        mesh_info = {"error": f"{type(e).__name__}: {e}"[:200]}
+    _log(f"[bench] mesh: {mesh_info}")
+
     # 6*N FLOPs/token (fwd+bwd) + causal attention term 12*L*H*S/2... use the
     # standard PaLM appendix-B accounting: 6N + 12*L*h*S (h=hidden) per token.
     n_params = sum(int(np.prod(p.shape)) for p in params)
@@ -837,6 +852,7 @@ def worker():
             "sanitizer_overhead": sanitizer_overhead,
             "decode": decode_info,
             "serving": serving_info,
+            "mesh": mesh_info,
         },
     }
     try:

@@ -37,7 +37,7 @@ ROOT = os.path.dirname(os.path.abspath(__file__))
 RESULTS = os.path.join(ROOT, "tools", "suite_results.jsonl")
 
 CONFIGS = ("lenet", "resnet50", "bert_dp", "gpt_hybrid", "serving",
-           "chaos", "spec")
+           "chaos", "spec", "mesh")
 
 
 # --------------------------------------------------------------------------- #
@@ -439,14 +439,59 @@ def run_spec(smoke=False):
            "unit": "speedup_vs_nonspec", "detail": res})
 
 
+def run_mesh(smoke=False):
+    """Config 8 — simulated-mesh SPMD training (paddle_tpu.mesh): DP=8 and
+    DP x TP = 4x2 llama training under shard_map on the 8-device virtual
+    CPU mesh vs the single-device step (bench_common.mesh_bench), plus the
+    ZeRO-1 per-replica optimizer-state-bytes lever. ``smoke`` is the
+    tier-1-safe shape (`bench_suite.py --smoke mesh`)."""
+    # the virtual mesh must exist BEFORE jax's backends initialize
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (flags +
+                                   " --xla_force_host_platform_device_count=8")
+    os.environ.setdefault("PADDLE_TPU_PLATFORM", "cpu")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import paddle_tpu as paddle  # noqa: F401 - initializes the 8-device view
+
+    from bench_common import mesh_bench
+
+    if smoke:
+        params = dict(dp=8, tp=2, batch=8, seq=8, iters=1, vocab=64,
+                      hidden=32, layers=2, heads=4, ffn=64)
+    else:
+        params = dict(dp=8, tp=2, batch=16, seq=64, iters=4, vocab=512,
+                      hidden=128, layers=4, heads=4, ffn=352)
+    res = mesh_bench(**params)
+    if "skipped" in res:
+        _emit({"config": "mesh", "error": res["skipped"]})
+        return
+    if smoke:
+        # the bounds tier-1 gates on (exit code): losses must match the
+        # single-device run within fp tolerance on every pass, the compiled
+        # programs must actually communicate, and ZeRO-1 must shrink
+        # per-replica optimizer state to ~1/dp of the replicated layout
+        assert res["dp8_loss_close"], res
+        assert res["zero1_loss_close"], res
+        assert res["hybrid_loss_close"], res
+        assert res["collectives"]["dp8"].get("all_reduce", 0) >= 1, res
+        assert res["collectives"]["dp8_zero1"].get("reduce_scatter", 0) >= 1, res
+        assert res["collectives"]["dp8_zero1"].get("all_gather", 0) >= 1, res
+        b = res["opt_state_bytes"]
+        assert b["ratio"] <= 1.0 / params["dp"] + 0.02, b
+    _emit({"config": "mesh", "value": res["dp8_tokens_per_sec"],
+           "unit": "tokens/s", "detail": res})
+
+
 # --------------------------------------------------------------------------- #
 # orchestrator
 # --------------------------------------------------------------------------- #
 
 def _run_config(name, timeout):
     env = dict(os.environ)
-    if name == "gpt_hybrid":
-        # hybrid mechanics always run on the 8-device virtual CPU mesh
+    if name in ("gpt_hybrid", "mesh"):
+        # hybrid/mesh mechanics always run on the 8-device virtual CPU mesh
         # (single-chip TPU cannot host a dp2 x mp2 x pp2 mesh)
         env["PADDLE_TPU_PLATFORM"] = "cpu"
         flags = env.get("XLA_FLAGS", "")
@@ -497,7 +542,7 @@ def main():
 
     if args.smoke:
         smokes = {"serving": run_serving, "chaos": run_chaos,
-                  "spec": run_spec}
+                  "spec": run_spec, "mesh": run_mesh}
         if args.smoke not in smokes:
             ap.error(f"--smoke supports {sorted(smokes)}, "
                      f"not {args.smoke!r}")
@@ -535,6 +580,6 @@ if __name__ == "__main__":
         {"lenet": run_lenet, "resnet50": run_resnet50,
          "bert_dp": run_bert_dp, "gpt_hybrid": run_gpt_hybrid,
          "serving": run_serving, "chaos": run_chaos,
-         "spec": run_spec}[which]()
+         "spec": run_spec, "mesh": run_mesh}[which]()
     else:
         main()

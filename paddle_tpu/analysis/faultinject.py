@@ -82,6 +82,13 @@ POINTS = {
         "WRONG cache entry for the computed digest, so the verified-"
         "tokens fallback must degrade it to a miss/collision instead of "
         "serving another prompt's KV."),
+    "mesh.collective": (
+        "The SPMD rule engine's resharding site (mesh/spmd_rules.py): an "
+        "input whose placement disagrees with the op's sharding rule is "
+        "about to be redistributed (all-gather / all-to-all / shard). "
+        "flag = the site raises a typed ReshardFault naming the mesh "
+        "axis, drilling callers that must survive a poisoned "
+        "redistribution."),
 }
 
 ACTIONS = ("raise", "delay", "flag")

@@ -29,6 +29,7 @@ from .parallelize import (  # noqa: F401
     get_mesh,
     is_available,
     parallelize,
+    parallelize_step,
     set_mesh,
     spawn,
     to_distributed,

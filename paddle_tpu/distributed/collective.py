@@ -19,6 +19,8 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax import lax
+from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..framework.core import Tensor
@@ -188,27 +190,145 @@ def _maybe_inplace(tensor, new_val, sync_op=True):
 
 
 # ---------------------------------------------------------------------------
-# Collectives over stacked per-rank tensors ([world, ...] with row i = rank i's local view)
+# Collectives over stacked per-rank tensors ([world, ...] with row i = rank i's
+# local view). Each one dispatches a REAL jax.lax collective: the stacked array
+# is shard_map'd over the group mesh (one row per device) and the body runs
+# psum / pmax / pmin / pmean / psum_scatter / all_gather / all_to_all — XLA
+# lays the exchange onto ICI exactly like the compiled-training path
+# (distributed/in_jit.py). Rows whose leading dim does not match the group (or
+# degenerate scalar rows) fall back to the equivalent local math — silently:
+# only dispatches that really ran a collective program are counted in
+# paddle_tpu_comm_collectives_total{op} and spanned as comm.collective.
 # ---------------------------------------------------------------------------
+_COMM_MON = None  # (monitor module, collectives counter) — lazy hot-path bind
+
+
+def _comm_mon():
+    global _COMM_MON
+    if _COMM_MON is None:
+        from .. import monitor as _m
+
+        _COMM_MON = (_m, _m.counter("paddle_tpu_comm_collectives_total",
+                                    labelnames=("op",)))
+    return _COMM_MON
+
+
+class _comm_span:
+    """comm.collective span + collective counter around one eager dispatch
+    (zero-cost when monitor and trace are both off). ``ready=False`` (the
+    degenerate local-math fallback) records nothing — the census counts only
+    ops that really dispatched a collective program."""
+
+    __slots__ = ("op", "group", "t0")
+
+    def __init__(self, op, group, ready=True):
+        self.op = op
+        self.group = group if ready else None
+
+    def __enter__(self):
+        if self.group is None:
+            self.t0 = 0
+            return self
+        m, _ = _comm_mon()
+        self.t0 = m.now_ns() if (m._state.on or m.trace._state.on) else 0
+        return self
+
+    def __exit__(self, *exc):
+        if not self.t0:
+            return False
+        m, ctr = _comm_mon()
+        t1 = m.now_ns()
+        if m._state.on:
+            ctr.labels(self.op).inc()
+        if m.trace._state.on:
+            m.trace.record_span(
+                "comm.collective", self.t0, t1,
+                attrs={"op": self.op, "group": self.group.name,
+                       "nranks": self.group.nranks})
+        return False
+
+
+def _group_program(group, key, builder):
+    """One jitted shard_map program per (group, collective signature); jax's
+    own jit cache handles per-shape/dtype specialization underneath."""
+    progs = group.__dict__.setdefault("_programs", {})
+    fn = progs.get(key)
+    if fn is None:
+        fn = jax.jit(shard_map(builder, mesh=group.jax_mesh(),
+                               in_specs=P("g"), out_specs=P("g")))
+        progs[key] = fn
+    return fn
+
+
+def _collective_ready(v, group):
+    """The stacked layout a real collective needs: one row per group device."""
+    return (v.ndim >= 1 and v.shape[0] == group.nranks
+            and group.nranks <= jax.device_count())
+
+
+_LAX_REDUCERS = {
+    ReduceOp.SUM: lambda x: lax.psum(x, "g"),
+    ReduceOp.MAX: lambda x: lax.pmax(x, "g"),
+    ReduceOp.MIN: lambda x: lax.pmin(x, "g"),
+    ReduceOp.AVG: lambda x: lax.pmean(x, "g"),
+}
+
+
+def _body_reduce(op, dtype):
+    """Reduction of the (1, ...) local row across the group axis, staying
+    (1, ...). PROD (no lax primitive) and bool SUM/AVG ride a REAL all-gather
+    then reduce rows locally — same wire traffic, exact local-math
+    semantics."""
+    fn = _LAX_REDUCERS.get(op)
+    if fn is not None and not (np.dtype(dtype) == np.bool_
+                               and op in (ReduceOp.SUM, ReduceOp.AVG)):
+        return fn
+
+    def gather_reduce(x):
+        rows = lax.all_gather(x, "g", axis=0, tiled=True)  # (n, ...)
+        return _REDUCE_FNS[op](rows, 0)[None]
+
+    return gather_reduce
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """Rows of the stacked tensor are reduced; every rank sees the result."""
     group = _resolve_group(group)
     v = _val(tensor)
-    red = _REDUCE_FNS[op](v, 0)
-    out = jnp.broadcast_to(red[None], v.shape)
-    out = _shard_stacked(out, group)
+    ready = _collective_ready(v, group)
+    with _comm_span("all_reduce", group, ready):
+        if ready:
+            prog = _group_program(group, ("all_reduce", op, str(v.dtype)),
+                                  _body_reduce(op, v.dtype))
+            out = prog(_shard_stacked(v, group))
+        else:
+            red = _REDUCE_FNS[op](v, 0)
+            out = _shard_stacked(jnp.broadcast_to(red[None], v.shape), group)
     return _maybe_inplace(tensor, out, sync_op)
 
 
 def reduce(tensor, dst, op=ReduceOp.SUM, group=None, sync_op=True):
     group = _resolve_group(group)
     v = _val(tensor)
-    red = _REDUCE_FNS[op](v, 0)
     dst_idx = group.get_group_rank(dst)
     if dst_idx < 0:
         raise ValueError(f"reduce dst rank {dst} is not in group {group.ranks}")
-    out = v.at[dst_idx].set(red)
-    out = _shard_stacked(out, group)
+    ready = _collective_ready(v, group)
+    with _comm_span("reduce", group, ready):
+        if ready:
+            reducer = _body_reduce(op, v.dtype)
+
+            def body(x):
+                red = reducer(x)
+                idx = lax.axis_index("g")
+                return jnp.where(idx == dst_idx, red.astype(x.dtype), x)
+
+            prog = _group_program(group, ("reduce", op, dst_idx,
+                                          str(v.dtype)), body)
+            out = prog(_shard_stacked(v, group))
+        else:
+            red = _REDUCE_FNS[op](v, 0)
+            out = _shard_stacked(v.at[dst_idx].set(red), group)
     return _maybe_inplace(tensor, out, sync_op)
 
 
@@ -227,10 +347,22 @@ def all_gather_concat(tensor, group=None, axis=0):
     """Functional all-gather: stacked [n, ...] -> concatenated along `axis`, replicated."""
     group = _resolve_group(group)
     v = _val(tensor)
-    parts = [v[i] for i in range(v.shape[0])]
-    out = jnp.concatenate(parts, axis=axis)
-    out = jnp.broadcast_to(out[None], (v.shape[0],) + out.shape)
-    return Tensor(_shard_stacked(out, group))
+    ready = _collective_ready(v, group) and v.ndim >= 2
+    with _comm_span("all_gather", group, ready):
+        if ready:
+
+            def body(x):
+                # x: (1, row...); gather the rows concatenated along `axis`
+                return lax.all_gather(x[0], "g", axis=axis, tiled=True)[None]
+
+            prog = _group_program(group, ("all_gather_concat", axis), body)
+            out = prog(_shard_stacked(v, group))
+        else:
+            parts = [v[i] for i in range(v.shape[0])]
+            cat = jnp.concatenate(parts, axis=axis)
+            out = _shard_stacked(
+                jnp.broadcast_to(cat[None], (v.shape[0],) + cat.shape), group)
+    return Tensor(out)
 
 
 def broadcast(tensor, src, group=None, sync_op=True):
@@ -239,8 +371,19 @@ def broadcast(tensor, src, group=None, sync_op=True):
     src_idx = group.get_group_rank(src)
     if src_idx < 0:
         raise ValueError(f"broadcast src rank {src} is not in group {group.ranks}")
-    out = jnp.broadcast_to(v[src_idx][None], v.shape)
-    out = _shard_stacked(out, group)
+    ready = _collective_ready(v, group)
+    with _comm_span("broadcast", group, ready):
+        if ready:
+
+            def body(x):
+                rows = lax.all_gather(x, "g", axis=0, tiled=True)  # (n, ...)
+                return rows[src_idx][None]
+
+            prog = _group_program(group, ("broadcast", src_idx), body)
+            out = prog(_shard_stacked(v, group))
+        else:
+            out = _shard_stacked(
+                jnp.broadcast_to(v[src_idx][None], v.shape), group)
     return _maybe_inplace(tensor, out, sync_op)
 
 
@@ -259,18 +402,45 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None, s
     """Reduce rows then scatter slices: rank i gets slice i of the reduction."""
     group = _resolve_group(group)
     src = tensor_or_tensor_list
+    n = group.nranks
     if isinstance(src, (list, tuple)):
         v = jnp.stack([jnp.stack([_val(t) for t in src])] * len(src))  # replicated input
-        red = _REDUCE_FNS[op](v, 0)
     else:
         v = _val(src)  # [n, n*chunk, ...] or [n, n, chunk...]
-        red = _REDUCE_FNS[op](v, 0)
-    n = group.nranks
-    if red.shape[0] == n:
-        out = red  # already [n, chunk...] — row i to rank i
-    else:
-        out = red.reshape((n, red.shape[0] // n) + red.shape[1:])
-    out = _shard_stacked(out, group)
+    ready = (_collective_ready(v, group) and v.ndim >= 2
+             and v.shape[1] % n == 0)
+    with _comm_span("reduce_scatter", group, ready):
+        if ready:
+            row_len = v.shape[1]
+
+            def body(x):
+                # x: (1, row...); for SUM a native reduce-scatter moves 1/n of
+                # the reduction to each member (bool can't psum: it rides the
+                # gather path like _body_reduce); other ops gather + reduce +
+                # slice (the portable-redistribution fallback)
+                if op == ReduceOp.SUM and np.dtype(v.dtype) != np.bool_:
+                    sl = lax.psum_scatter(x[0], "g", scatter_dimension=0,
+                                          tiled=True)
+                else:
+                    rows = lax.all_gather(x, "g", axis=0, tiled=True)
+                    red = _REDUCE_FNS[op](rows, 0)
+                    idx = lax.axis_index("g")
+                    sl = lax.dynamic_slice_in_dim(
+                        red, idx * (row_len // n), row_len // n, axis=0)
+                if row_len == n:
+                    sl = sl[0]  # [n, chunk...] rows: member i takes row i
+                return sl[None]
+
+            prog = _group_program(group, ("reduce_scatter", op, row_len,
+                                          str(v.dtype)), body)
+            out = prog(_shard_stacked(v, group))
+        else:
+            red = _REDUCE_FNS[op](v, 0)
+            if red.shape[0] == n:
+                out = red  # already [n, chunk...] — row i to rank i
+            else:
+                out = red.reshape((n, red.shape[0] // n) + red.shape[1:])
+            out = _shard_stacked(out, group)
     return _maybe_inplace(tensor, out, sync_op)
 
 
@@ -282,18 +452,30 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     else:
         v = _val(in_tensor_list)
     n = group.nranks
-    # v: [n_src, n_dst, ...] per-rank rows of per-dst chunks -> transpose src/dst
-    if v.ndim >= 2 and v.shape[0] == n and v.shape[1] == n:
-        out = jnp.swapaxes(v, 0, 1)
-    else:
-        # [n, n*chunk, ...] split-concat form (alltoall_single)
-        chunk = v.shape[1] // n
-        out = (
-            v.reshape((n, n, chunk) + v.shape[2:])
-            .swapaxes(0, 1)
-            .reshape((n, n * chunk) + v.shape[2:])
-        )
-    out = _shard_stacked(out, group)
+    ready = (_collective_ready(v, group) and v.ndim >= 2
+             and v.shape[1] % n == 0)
+    with _comm_span("alltoall", group, ready):
+        if ready:
+
+            def body(x):
+                # x: (1, n*chunk, ...); lax.all_to_all tiled sends chunk j of
+                # this member's row to member j and concatenates the received
+                # chunks — the block transpose, on the wire
+                return lax.all_to_all(x[0], "g", split_axis=0, concat_axis=0,
+                                      tiled=True)[None]
+
+            prog = _group_program(group, ("alltoall", v.shape[1]), body)
+            out = prog(_shard_stacked(v, group))
+        elif v.ndim >= 2 and v.shape[0] == n and v.shape[1] == n:
+            # v: [n_src, n_dst, ...] rows of per-dst chunks -> transpose
+            out = _shard_stacked(jnp.swapaxes(v, 0, 1), group)
+        else:
+            # [n, n*chunk, ...] split-concat form (alltoall_single)
+            chunk = v.shape[1] // n
+            out = _shard_stacked(
+                v.reshape((n, n, chunk) + v.shape[2:])
+                .swapaxes(0, 1)
+                .reshape((n, n * chunk) + v.shape[2:]), group)
     if isinstance(out_tensor_list, list):
         del out_tensor_list[:]
         for i in range(n):

@@ -26,7 +26,8 @@ from . import api as dist_api
 from .placement import Replicate, Shard
 from .process_mesh import ProcessMesh
 
-__all__ = ["set_mesh", "get_mesh", "parallelize", "ColWiseParallel",
+__all__ = ["set_mesh", "get_mesh", "parallelize", "parallelize_step",
+           "ColWiseParallel",
            "RowWiseParallel", "SequenceParallelBegin", "SequenceParallelEnd",
            "SequenceParallelEnable", "SequenceParallelDisable",
            "PrepareLayerInput", "PrepareLayerOutput", "SplitPoint",
@@ -301,6 +302,20 @@ def to_distributed(model, optimizer, dataloader, device_num=None,
     the global mesh and return (model, optimizer, dataloader)."""
     model, optimizer = parallelize(model, optimizer, config=config)
     return model, optimizer, dataloader
+
+
+def parallelize_step(model, optimizer, loss_fn, batch, mesh=None,
+                     config=None):
+    """The EXECUTION form of parallelize: lower the fleet hybrid config
+    (dp_degree / mp_degree / shard_optimizer) onto mesh axes and return a
+    ``paddle_tpu.mesh.MeshParallel`` handle whose ``step(*batch)`` runs the
+    real train step under shard_map with donated sharded state
+    (docs/distributed.md). ``parallelize`` above annotates a model's
+    placements; this runs it."""
+    from ..mesh import parallelize as _mesh_parallelize
+
+    return _mesh_parallelize(model, optimizer, loss_fn, batch, mesh=mesh,
+                             config=config)
 
 
 def is_available():

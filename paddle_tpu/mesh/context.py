@@ -1,0 +1,187 @@
+"""MeshContext: the bridge from the distributed API surface to a real device mesh.
+
+Reference analog: the reference's ``ProcessMesh``/``TensorDistAttr`` pair drives a
+59-file per-op SPMD rule library (phi/infermeta/spmd_rules/). TPU-first redesign:
+a ``MeshContext`` lowers a ``distributed.process_mesh.ProcessMesh`` to ONE
+``jax.sharding.Mesh`` and maps ``placement`` lists (Shard/Replicate/Partial) to
+``PartitionSpec``s; GSPMD + the rule registry in ``mesh/spmd_rules.py`` replace
+the hand-written rule files. The CPU bootstrap
+(``XLA_FLAGS=--xla_force_host_platform_device_count=8``, set by
+``bootstrap_virtual_devices`` or the tier-1 conftest BEFORE jax initializes)
+makes every multi-device path testable single-host.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..distributed.placement import (DistAttr, Replicate, Shard,
+                                     to_partition_spec)
+from ..distributed.process_mesh import ProcessMesh
+
+__all__ = ["MeshContext", "bootstrap_virtual_devices", "current_mesh_context",
+           "spec_for_placements", "placements_for_spec"]
+
+
+def bootstrap_virtual_devices(n=8, env=None):
+    """Request an ``n``-device virtual CPU backend BEFORE jax initializes.
+
+    Appends ``--xla_force_host_platform_device_count=n`` to ``XLA_FLAGS`` if no
+    such flag is present yet. Returns True when the running process can actually
+    see >= n devices afterwards; False when jax was already initialized with a
+    smaller device view (the flag cannot retroactively split an initialized
+    backend — callers should skip mesh work in that case rather than poison the
+    process's device view).
+    """
+    environ = env if env is not None else os.environ
+    flags = environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={int(n)}")
+    return jax.device_count() >= int(n)
+
+
+def spec_for_placements(placements, mesh):
+    """placement list (per mesh dim) -> PartitionSpec (per tensor dim).
+
+    The one mapping table (docs/distributed.md): Shard(d) on mesh dim i puts
+    axis name i at spec entry d (several mesh dims co-sharding one tensor dim
+    become a tuple entry); Replicate contributes nothing; Partial carries no
+    spec entry either — it is tracked on DistAttr and materialized by reshard.
+    """
+    return to_partition_spec(placements, mesh)
+
+
+def placements_for_spec(spec, mesh):
+    """PartitionSpec -> placement list (per mesh dim): the inverse mapping used
+    when rule-propagated specs are attached back onto Tensors as DistAttr."""
+    placements = [Replicate() for _ in range(mesh.ndim)]
+    for dim, entry in enumerate(tuple(spec)):
+        if entry is None:
+            continue
+        for name in (entry if isinstance(entry, tuple) else (entry,)):
+            placements[mesh.dim_names.index(name)] = Shard(dim)
+    return placements
+
+
+_CURRENT = []
+
+
+class MeshContext:
+    """A ProcessMesh bound to real devices, plus the manual/auto split the
+    shard_map train step uses.
+
+    ``manual_axes`` are the axes the step hand-places collectives over (the
+    data-parallel axis: grad psum, ZeRO-1 scatter/gather); ``auto_axes`` stay
+    under GSPMD inside the body (the tensor-parallel axis: the fleet TP layers'
+    sharding constraints keep working unchanged).
+    """
+
+    def __init__(self, process_mesh, manual_axes=None, auto_axes=()):
+        if not isinstance(process_mesh, ProcessMesh):
+            raise TypeError(
+                f"MeshContext needs a ProcessMesh, got {type(process_mesh)}")
+        self.process_mesh = process_mesh
+        names = process_mesh.dim_names
+        self.auto_axes = tuple(a for a in auto_axes if a in names)
+        if manual_axes is None:
+            manual_axes = tuple(n for n in names if n not in self.auto_axes)
+        self.manual_axes = tuple(manual_axes)
+        self._jax_mesh = None
+
+    # -- constructors --------------------------------------------------------
+    @classmethod
+    def from_degrees(cls, dp=1, mp=1, dp_axis="dp", mp_axis="mp"):
+        """Build a dp x mp mesh over the first dp*mp visible devices — the
+        lowering of a fleet hybrid config's {dp_degree, mp_degree}."""
+        dp, mp = int(dp), int(mp)
+        need = dp * mp
+        n = jax.device_count()
+        if need > n:
+            raise RuntimeError(
+                f"mesh dp={dp} x mp={mp} needs {need} devices; {n} visible. "
+                "For CPU tests set "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=8 before "
+                "jax initializes (tests/conftest.py does).")
+        pm = ProcessMesh(np.arange(need).reshape(dp, mp), [dp_axis, mp_axis])
+        return cls(pm, manual_axes=(dp_axis,),
+                   auto_axes=(mp_axis,) if mp > 1 else ())
+
+    @classmethod
+    def from_fleet(cls, hcg=None, dp_axis="dp", auto_axes=("mp",)):
+        """Adopt the fleet topology's global mesh (all hybrid axes); manual =
+        the dp axis, auto = the tensor-parallel axis (mp) so the mpu TP layers'
+        constraints ride GSPMD inside the step body."""
+        if hcg is None:
+            from ..distributed.fleet.topology import get_hybrid_parallel_group
+
+            hcg = get_hybrid_parallel_group()
+        if hcg is None:
+            raise RuntimeError(
+                "MeshContext.from_fleet: no hybrid topology — call "
+                "fleet.init(strategy with hybrid_configs) first")
+        pm = hcg.global_mesh
+        auto = tuple(a for a in auto_axes
+                     if a in pm.dim_names and pm.get_dim_size(a) > 1)
+        return cls(pm, manual_axes=None, auto_axes=auto)
+
+    # -- lowering ------------------------------------------------------------
+    @property
+    def jax_mesh(self) -> Mesh:
+        if self._jax_mesh is None:
+            self._jax_mesh = self.process_mesh.jax_mesh()
+        return self._jax_mesh
+
+    @property
+    def axis_names(self):
+        return tuple(self.process_mesh.dim_names)
+
+    def axis_size(self, name):
+        return self.process_mesh.get_dim_size(name)
+
+    def spec(self, placements):
+        return spec_for_placements(placements, self.process_mesh)
+
+    def placements(self, spec):
+        return placements_for_spec(spec, self.process_mesh)
+
+    def sharding(self, placements=None, spec=None):
+        if spec is None:
+            spec = self.spec(placements or [])
+        return NamedSharding(self.jax_mesh, spec)
+
+    def place(self, value, placements=None, spec=None):
+        """Lay a raw array out over the mesh per placements/spec."""
+        return jax.device_put(value, self.sharding(placements, spec))
+
+    def dist_attr(self, placements):
+        return DistAttr(self.process_mesh, list(placements))
+
+    def batch_spec(self, ndim, axis=None):
+        """PartitionSpec sharding tensor dim 0 over the data-parallel axis."""
+        axis = axis or (self.manual_axes[0] if self.manual_axes else None)
+        if axis is None:
+            return PartitionSpec()
+        return PartitionSpec(*([axis] + [None] * (ndim - 1)))
+
+    # -- scope ---------------------------------------------------------------
+    def __enter__(self):
+        _CURRENT.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _CURRENT.pop()
+        return False
+
+    def __repr__(self):
+        return (f"MeshContext(shape={self.process_mesh.shape}, "
+                f"axes={self.axis_names}, manual={self.manual_axes}, "
+                f"auto={self.auto_axes})")
+
+
+def current_mesh_context():
+    return _CURRENT[-1] if _CURRENT else None

@@ -1,0 +1,472 @@
+"""Per-op SPMD sharding rules: spec propagation + explicit resharding.
+
+Reference analog: the 59-file per-op rule library the reference keeps in
+phi/infermeta/spmd_rules/ (matmul.cc, embedding.cc, layer_norm.cc,
+elementwise.cc, reduction.cc, softmax.cc ...), each rule inferring output
+``dims_mapping`` from inputs and flagging inputs that need resharding.
+
+TPU-first redesign: a rule here is a small pure function over
+``PartitionSpec``-shaped entry tuples. The registry drives two consumers:
+
+- :func:`propagate` — the standalone inference API (tests, planners);
+- :class:`SpecPropagator` — the eager hook installed into ``ops/_apply``:
+  every ``defop`` dispatch whose inputs carry a ``DistAttr`` gets its output
+  specs inferred and attached, and inputs whose current spec disagrees with
+  the rule's requirement are EXPLICITLY resharded first (one ``device_put``
+  to the required ``NamedSharding`` — XLA emits exactly the collective the
+  placement change implies: s->r all-gather, s->s' all-to-all, p->s
+  reduce-scatter), counted in ``paddle_tpu_mesh_reshards_total{kind}`` and
+  spanned as ``mesh.reshard``. Where specs agree, NO data movement is
+  inserted (memory-efficient redistribution discipline, arXiv 2112.01075).
+
+The hook is disabled by default; ``enable_propagation()`` installs it (one
+slot load per dispatch when off — the same discipline as graftsan). The
+resharding site is also a fault-injection point (``mesh.collective``):
+``flag`` makes it raise a typed :class:`ReshardFault` naming the mesh axis,
+drilling callers that must survive a poisoned redistribution.
+"""
+from __future__ import annotations
+
+import threading
+
+from ..analysis import faultinject as _fi
+
+__all__ = ["sharding_rule", "rule_for", "propagate", "enable_propagation",
+           "disable_propagation", "ReshardFault", "SpecPropagator"]
+
+RULES = {}
+
+
+class ReshardFault(RuntimeError):
+    """An injected redistribution failure at the mesh.collective fault point.
+
+    Carries the mesh ``axis`` whose collective was poisoned and the reshard
+    ``kind`` (all_gather / all_to_all / shard / replicate)."""
+
+    def __init__(self, message, axis="", kind=""):
+        super().__init__(message)
+        self.axis = axis
+        self.kind = kind
+
+
+def sharding_rule(*names):
+    """Register a rule under one or more op names (the defop name)."""
+
+    def deco(fn):
+        for n in names:
+            RULES[n] = fn
+        return fn
+
+    return deco
+
+
+def rule_for(name):
+    return RULES.get(name)
+
+
+# --------------------------------------------------------------------------- #
+# spec algebra: a spec is a tuple of entries (None | axis | tuple of axes),
+# one per tensor dim
+# --------------------------------------------------------------------------- #
+
+def _norm(spec, ndim):
+    entries = tuple(spec) if spec is not None else ()
+    entries = entries[:ndim]
+    return entries + (None,) * (ndim - len(entries))
+
+
+def _axes_of(entry):
+    if entry is None:
+        return ()
+    return tuple(entry) if isinstance(entry, (tuple, list)) else (entry,)
+
+
+def _dedupe(entries):
+    """An axis name may shard at most one tensor dim: first claim wins."""
+    seen = set()
+    out = []
+    for e in entries:
+        kept = tuple(a for a in _axes_of(e) if a not in seen)
+        seen.update(kept)
+        out.append(None if not kept else kept[0] if len(kept) == 1 else kept)
+    return tuple(out)
+
+
+def _merge_entry(a, b):
+    """Elementwise merge of one dim's entries: equal -> keep; one-sided ->
+    the non-None side; conflict -> the FIRST operand's entry wins (the second
+    operand is the one resharded)."""
+    if a == b or b is None:
+        return a, False
+    if a is None:
+        return b, False
+    return a, True
+
+
+# --------------------------------------------------------------------------- #
+# rules — signature: rule(specs, shapes, args, kwargs) ->
+#   (required_specs, out_specs); specs/shapes align with the op's Tensor
+#   inputs in positional order
+# --------------------------------------------------------------------------- #
+
+@sharding_rule("add", "subtract", "multiply", "divide", "maximum", "minimum",
+               "swiglu")
+def _elementwise_rule(specs, shapes, args, kwargs):
+    ndim = max(len(s) for s in shapes)
+    required = []
+    out = [None] * ndim
+    conflict_dims = set()
+    for spec, shape in zip(specs, shapes):
+        spec = _norm(spec, len(shape))
+        off = ndim - len(shape)
+        req = list(spec)
+        for d, e in enumerate(spec):
+            merged, conflict = _merge_entry(out[off + d], e)
+            if conflict or (conflict_dims and off + d in conflict_dims):
+                req[d] = out[off + d]
+                conflict_dims.add(off + d)
+            else:
+                out[off + d] = merged
+        required.append(tuple(req))
+    return required, [_dedupe(out)]
+
+
+@sharding_rule("silu", "gelu", "relu", "tanh_fn", "sigmoid", "exp", "scale")
+def _unary_rule(specs, shapes, args, kwargs):
+    s = _norm(specs[0], len(shapes[0]))
+    return [s], [s]
+
+
+@sharding_rule("matmul")
+def _matmul_rule(specs, shapes, args, kwargs):
+    ta = bool(kwargs.get("transpose_x", args[2] if len(args) > 2 else False))
+    tb = bool(kwargs.get("transpose_y", args[3] if len(args) > 3 else False))
+    sa, sb = _norm(specs[0], len(shapes[0])), _norm(specs[1], len(shapes[1]))
+    na, nb = len(sa), len(sb)
+    ka = na - 2 if ta and na >= 2 else na - 1           # a's contract dim
+    ma = na - 1 if ta and na >= 2 else na - 2           # a's row dim (if any)
+    kb = (nb - 1 if tb else nb - 2) if nb >= 2 else 0   # b's contract dim
+    cb = (nb - 2 if tb else nb - 1) if nb >= 2 else None  # b's col dim
+    # contracted entries must agree: the SECOND operand is resharded to match
+    req_a, req_b = list(sa), list(sb)
+    if sb[kb] != sa[ka]:
+        req_b[kb] = sa[ka]
+    contracted = sa[ka]
+    out = []
+    if na >= 2:
+        out.extend(sa[:na - 2] + (sa[ma],))  # batch dims + row dim
+    if cb is not None:
+        out.append(sb[cb])
+    # a contracted sharded dim disappears into an XLA all-reduce: its axes
+    # must not resurface in the output
+    used = set(_axes_of(contracted))
+    out = [tuple(a for a in _axes_of(e) if a not in used) or None
+           if e is not None else None for e in out]
+    out = [e[0] if isinstance(e, tuple) and len(e) == 1 else e for e in out]
+    return [tuple(req_a), tuple(req_b)], [_dedupe(out)]
+
+
+@sharding_rule("linear")
+def _linear_rule(specs, shapes, args, kwargs):
+    req, out = _matmul_rule(specs[:2], shapes[:2], (), {})
+    if len(specs) > 2:  # bias: must match the output's last dim
+        req.append((out[0][-1],) if shapes[2] else ())
+    return req, out
+
+
+@sharding_rule("embedding_op")
+def _embedding_rule(specs, shapes, args, kwargs):
+    s_ids = _norm(specs[0], len(shapes[0]))
+    s_w = _norm(specs[1], len(shapes[1]))
+    # vocab-sharded weight is fine (masked lookup + psum under GSPMD); the
+    # hidden dim's sharding flows to the output's last dim
+    out = _dedupe(tuple(s_ids) + (s_w[-1],))
+    return [s_ids, s_w], [out]
+
+
+@sharding_rule("layer_norm", "rms_norm")
+def _norm_rule(specs, shapes, args, kwargs):
+    s = _norm(specs[0], len(shapes[0]))
+    req = s[:-1] + (None,)  # the normalized dim must be whole on-device
+    required = [req]
+    for sp, sh in zip(specs[1:], shapes[1:]):  # weight / bias replicated
+        required.append((None,) * len(sh))
+    return required, [req]
+
+
+@sharding_rule("softmax", "log_softmax")
+def _softmax_rule(specs, shapes, args, kwargs):
+    axis = kwargs.get("axis", args[1] if len(args) > 1 else -1)
+    try:
+        axis = int(axis)
+    except (TypeError, ValueError):
+        axis = -1
+    s = list(_norm(specs[0], len(shapes[0])))
+    s[axis] = None  # the softmax dim reduces on-device
+    req = tuple(s)
+    return [req], [req]
+
+
+@sharding_rule("flash_attention")
+def _attention_rule(specs, shapes, args, kwargs):
+    # (B, S, H, D): batch and head dims may stay sharded (dp / TP heads);
+    # sequence and head_dim must be whole for the causal softmax
+    required = []
+    for sp, sh in zip(specs[:3], shapes[:3]):
+        s = list(_norm(sp, len(sh)))
+        for d in range(len(s)):
+            if d not in (0, 2):
+                s[d] = None
+        required.append(tuple(s))
+    while len(required) < len(specs):
+        required.append(_norm(specs[len(required)],
+                              len(shapes[len(required)])))
+    return required, [required[0]]
+
+
+@sharding_rule("sum", "mean", "max", "min", "prod")
+def _reduction_rule(specs, shapes, args, kwargs):
+    s = _norm(specs[0], len(shapes[0]))
+    axis = kwargs.get("axis", args[1] if len(args) > 1 else None)
+    keepdim = bool(kwargs.get("keepdim", args[2] if len(args) > 2 else False))
+    if axis is None:
+        axes = tuple(range(len(s)))
+    elif isinstance(axis, (tuple, list)):
+        axes = tuple(int(a) % len(s) for a in axis)
+    else:
+        axes = (int(axis) % len(s),)
+    out = []
+    for d, e in enumerate(s):
+        if d in axes:
+            if keepdim:
+                out.append(None)  # reduced shard -> XLA all-reduces it away
+        else:
+            out.append(e)
+    return [s], [tuple(out)]
+
+
+@sharding_rule("transpose")
+def _transpose_rule(specs, shapes, args, kwargs):
+    perm = kwargs.get("perm", args[1] if len(args) > 1 else None)
+    s = _norm(specs[0], len(shapes[0]))
+    if perm is None:
+        out = tuple(reversed(s))
+    else:
+        out = tuple(s[int(p)] for p in perm)
+    return [s], [out]
+
+
+@sharding_rule("reshape")
+def _reshape_rule(specs, shapes, args, kwargs):
+    s = _norm(specs[0], len(shapes[0]))
+    new_shape = kwargs.get("shape", args[1] if len(args) > 1 else None)
+    if all(e is None for e in s):
+        return [s], [(None,) * (len(new_shape) if new_shape else len(s))]
+    if (new_shape and shapes[0] and int(new_shape[0]) in (shapes[0][0], -1, 0)
+            and all(e is None for e in s[1:])):
+        # leading (batch) dim preserved: its sharding survives the reshape
+        return [s], [(s[0],) + (None,) * (len(new_shape) - 1)]
+    # sharded dims fold into others: require a whole tensor (all-gather)
+    req = (None,) * len(s)
+    return [req], [(None,) * (len(new_shape) if new_shape else len(s))]
+
+
+@sharding_rule("squeeze")
+def _squeeze_rule(specs, shapes, args, kwargs):
+    s = _norm(specs[0], len(shapes[0]))
+    axis = kwargs.get("axis", args[1] if len(args) > 1 else None)
+    if axis is None:
+        out = tuple(e for e, n in zip(s, shapes[0]) if n != 1)
+    else:
+        axes = {int(a) % len(s) for a in
+                (axis if isinstance(axis, (tuple, list)) else (axis,))}
+        out = tuple(e for d, e in enumerate(s) if d not in axes)
+    return [s], [out]
+
+
+@sharding_rule("concat")
+def _concat_rule(specs, shapes, args, kwargs):
+    required, out = _elementwise_rule(specs, shapes, (), {})
+    axis = kwargs.get("axis", 0)
+    try:
+        axis = int(axis) % len(out[0])
+    except (TypeError, ValueError):
+        axis = 0
+    o = list(out[0])
+    o[axis] = None  # concatenation along a sharded dim interleaves: keep whole
+    required = [tuple(r[:axis] + (None,) + r[axis + 1:])
+                if len(r) > axis else r for r in required]
+    return required, [tuple(o)]
+
+
+# --------------------------------------------------------------------------- #
+# standalone propagation API
+# --------------------------------------------------------------------------- #
+
+def propagate(op, specs, shapes, args=(), kwargs=None):
+    """Infer (required_input_specs, output_specs) for ``op``.
+
+    ``specs``/``shapes`` align with the op's Tensor inputs in order. Returns
+    None when no rule is registered (the caller propagates nothing).
+    """
+    rule = RULES.get(op)
+    if rule is None:
+        return None
+    specs = [_norm(s, len(sh)) for s, sh in zip(specs, shapes)]
+    return rule(specs, list(shapes), tuple(args), dict(kwargs or {}))
+
+
+# --------------------------------------------------------------------------- #
+# the eager hook: propagation through defop dispatch + explicit resharding
+# --------------------------------------------------------------------------- #
+
+def _classify_reshard(cur, req):
+    """Name the collective a cur->req placement change implies."""
+    cur_axes = {a for e in cur for a in _axes_of(e)}
+    req_axes = {a for e in req for a in _axes_of(e)}
+    if cur_axes and not req_axes:
+        return "all_gather"
+    if cur_axes and req_axes:
+        return "all_to_all"
+    return "shard"
+
+
+class SpecPropagator:
+    """The ops/_apply hook: pre() reshards disagreeing inputs, post()
+    attaches inferred DistAttrs to the outputs."""
+
+    def __init__(self):
+        self._tls = threading.local()
+        self._mon = None  # (monitor module, reshard counter) lazy binding
+
+    # -- telemetry ----------------------------------------------------------
+    def _record_reshard(self, kind, axis, t0, t1):
+        if self._mon is None:
+            from .. import monitor as _m
+
+            self._mon = (_m, _m.counter("paddle_tpu_mesh_reshards_total",
+                                        labelnames=("kind",)))
+        _m, ctr = self._mon
+        if _m._state.on:
+            ctr.labels(kind).inc()
+        if _m.trace._state.on:
+            _m.trace.record_span("mesh.reshard", t0, t1,
+                                 attrs={"kind": kind, "axis": axis})
+
+    def _reshard(self, tensor, mesh, req_spec, op):
+        from .. import monitor as _m
+        from ..distributed import api as dist_api
+        from .context import placements_for_spec
+
+        cur_spec = self._spec_of(tensor, mesh)
+        kind = _classify_reshard(cur_spec, req_spec)
+        axis = ",".join(sorted(
+            {a for e in cur_spec for a in _axes_of(e)}
+            | {a for e in req_spec for a in _axes_of(e)}))
+        fault = _fi.fire("mesh.collective")
+        if fault is not None and fault.action == "flag":
+            raise ReshardFault(
+                f"injected redistribution failure resharding an input of "
+                f"{op!r} over mesh axis {axis!r} ({kind})",
+                axis=axis, kind=kind)
+        t0 = _m.now_ns()
+        out = dist_api.reshard(tensor, mesh,
+                               placements_for_spec(req_spec, mesh))
+        self._record_reshard(kind, axis, t0, _m.now_ns())
+        return out
+
+    @staticmethod
+    def _spec_of(tensor, mesh):
+        attr = tensor._dist_attr
+        if attr is None:
+            return (None,) * len(tensor.shape)
+        from .context import spec_for_placements
+
+        return _norm(tuple(spec_for_placements(attr.placements, mesh)),
+                     len(tensor.shape))
+
+    # -- the hook pair ------------------------------------------------------
+    def pre(self, name, args, kwargs):
+        from ..framework.core import Tensor
+
+        self._tls.pending = None
+        # cheap scan: top-level tensor args + one level into list/tuple args
+        t_inputs = []
+        mesh = None
+        flat = []
+        for a in args:
+            if isinstance(a, (list, tuple)):
+                flat.extend(a)
+            else:
+                flat.append(a)
+        flat.extend(kwargs.values())
+        for a in flat:
+            if isinstance(a, Tensor):
+                t_inputs.append(a)
+                if a._dist_attr is not None and mesh is None:
+                    mesh = a._dist_attr.process_mesh
+        if mesh is None:
+            return args, kwargs
+        rule = RULES.get(name)
+        if rule is None:
+            return args, kwargs
+        specs = [self._spec_of(t, mesh) for t in t_inputs]
+        shapes = [tuple(t.shape) for t in t_inputs]
+        try:
+            required, out_specs = rule(specs, shapes, tuple(args), kwargs)
+        except Exception:  # noqa: BLE001 - a rule bug must not break dispatch
+            return args, kwargs
+        replace = {}
+        for t, cur, req in zip(t_inputs, specs, required):
+            if _norm(req, len(cur)) != cur:
+                replace[id(t)] = self._reshard(t, mesh, _norm(req, len(cur)),
+                                               name)
+
+        def sub(a):
+            if isinstance(a, Tensor):
+                return replace.get(id(a), a)
+            if isinstance(a, list):
+                return [replace.get(id(x), x) if isinstance(x, Tensor) else x
+                        for x in a]
+            if isinstance(a, tuple):
+                return tuple(replace.get(id(x), x)
+                             if isinstance(x, Tensor) else x for x in a)
+            return a
+
+        if replace:
+            args = tuple(sub(a) for a in args)
+            kwargs = {k: sub(v) for k, v in kwargs.items()}
+        self._tls.pending = (mesh, out_specs)
+        return args, kwargs
+
+    def post(self, name, outputs):
+        pending = getattr(self._tls, "pending", None)
+        if pending is None:
+            return
+        self._tls.pending = None
+        mesh, out_specs = pending
+        from ..distributed.placement import DistAttr
+        from .context import placements_for_spec
+
+        for t, spec in zip(outputs, out_specs):
+            if spec is not None:
+                t._dist_attr = DistAttr(
+                    mesh, placements_for_spec(_norm(spec, len(t.shape)),
+                                              mesh))
+
+
+_PROPAGATOR = SpecPropagator()
+
+
+def enable_propagation():
+    """Install the spec-propagation hook into op dispatch (idempotent)."""
+    from ..ops import _apply
+
+    _apply._MESH_RULES[0] = _PROPAGATOR
+    return _PROPAGATOR
+
+
+def disable_propagation():
+    from ..ops import _apply
+
+    _apply._MESH_RULES[0] = None

@@ -22,7 +22,8 @@ framework.
 """
 
 # Subsystems a metric may belong to (the <subsystem> token of the name).
-SUBSYSTEMS = ("dispatch", "jit", "serving", "kv", "dataloader", "monitor")
+SUBSYSTEMS = ("dispatch", "jit", "serving", "kv", "dataloader", "monitor",
+              "mesh", "comm")
 
 NAME_PATTERN = (
     r"^paddle_tpu_(" + "|".join(SUBSYSTEMS) + r")_[a-z][a-z0-9_]*$"
@@ -178,6 +179,25 @@ METRICS = {
         "counter", (),
         "Spilled KV blocks restored from host RAM into freshly "
         "allocated pool blocks (bit-exact round trip)."),
+    # -- mesh execution (mesh/spmd_rules.py, mesh/parallelize.py) --------
+    "paddle_tpu_mesh_reshards_total": (
+        "counter", ("kind",),
+        "Explicit redistributions inserted by the SPMD rule engine where "
+        "an input's placement disagreed with the op's sharding rule, "
+        "labeled by the implied collective (all_gather / all_to_all / "
+        "shard)."),
+    "paddle_tpu_mesh_optimizer_state_bytes": (
+        "gauge", (),
+        "Per-replica optimizer-state bytes of the active mesh train step "
+        "— the ZeRO-1 lever: shard_optimizer=True shrinks this ~1/dp vs "
+        "the replicated layout."),
+    # -- eager collectives (distributed/collective.py) -------------------
+    "paddle_tpu_comm_collectives_total": (
+        "counter", ("op",),
+        "Eager collectives dispatched as real jax.lax collective "
+        "programs over a group mesh (all_reduce / all_gather / "
+        "reduce_scatter / broadcast / alltoall / reduce), labeled by "
+        "operation."),
     # -- dataloader (io/dataloader.py) -----------------------------------
     "paddle_tpu_dataloader_batches_total": (
         "counter", (),
@@ -211,7 +231,7 @@ def spec(name):
 
 # Subsystems a span may belong to (the first dotted token of the name).
 SPAN_SUBSYSTEMS = ("dispatch", "jit", "serving", "dataloader", "train",
-                   "comm", "monitor")
+                   "comm", "monitor", "mesh")
 
 SPAN_PATTERN = (
     r"^(" + "|".join(SPAN_SUBSYSTEMS)
@@ -299,6 +319,20 @@ SPANS = {
         "Blocking collective/host wait watched by CommWatchdog — open "
         "comm.wait spans in a flight dump are the hang candidates. "
         "attrs: desc."),
+    # -- mesh execution (distributed/collective.py, mesh/parallelize.py) -
+    "comm.collective": (
+        "One eager collective dispatched as a real jax.lax collective "
+        "program over a group mesh (distributed/collective.py). attrs: "
+        "op, group, nranks."),
+    "comm.mesh_step": (
+        "One shard_map mesh train-step dispatch (mesh/parallelize.py); "
+        "attrs carry the collective census of the compiled program "
+        "(all_reduce/all_gather/reduce_scatter/all_to_all counts from "
+        "HLO) plus dp degree and the ZeRO knob."),
+    "mesh.reshard": (
+        "One explicit redistribution inserted by the SPMD rule engine "
+        "where an input's placement disagreed with the op's sharding "
+        "rule (mesh/spmd_rules.py). attrs: kind, axis."),
     # -- graftsan (analysis/sanitizers.py) -------------------------------
     "monitor.sanitizer_trip": (
         "One graftsan trip (lock-order inversion / recompile storm / "

@@ -54,6 +54,11 @@ _AMP = None  # lazily bound amp.auto_cast module (hot-path import guard)
 _SAVED_HOOKS = []  # autograd.saved_tensors_hooks (pack, unpack) stack
 _INEXACT_MEMO = {}
 
+# mesh/spmd_rules.SpecPropagator install slot: sharding-spec propagation +
+# explicit resharding through defop dispatch. One-slot disabled guard (same
+# discipline as graftsan): when None the cost is a single load per dispatch.
+_MESH_RULES = [None]
+
 
 def _inexact(dt):
     r = _INEXACT_MEMO.get(dt)
@@ -212,6 +217,8 @@ def _finish_outputs(opdef, name, out_vals, requires_grad, vjp_fn, pure,
     if requires_grad:
         out_avals = [tape.OutAval(v.shape, v.dtype) for v in out_vals]
         tape.record(name, t_leaves, vjp_fn, pure, out_avals, outputs)
+    if _MESH_RULES[0] is not None:
+        _MESH_RULES[0].post(name, outputs)
     return outputs
 
 
@@ -296,6 +303,12 @@ def _apply_impl(opdef: OpDef, *args, **kwargs):
         _AMP = (_amp_state, amp_cast_inputs)
     if _AMP[0]() is not None:
         args, kwargs = _AMP[1](opdef, args, kwargs)
+
+    # ---- SPMD spec propagation (mesh/spmd_rules.py): reshard inputs whose
+    # placements disagree with the op's sharding rule, remember the inferred
+    # output specs for _finish_outputs ----
+    if _MESH_RULES[0] is not None:
+        args, kwargs = _MESH_RULES[0].pre(opdef.name, args, kwargs)
 
     # ---- fast path: flat positional call (the overwhelmingly common shape:
     # no kwargs, no nested containers) skips tree flatten/unflatten and calls

@@ -19,3 +19,20 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    """The 8-device virtual CPU mesh the distributed/mesh tests run on.
+
+    The pre-import hook above forces the device count BEFORE jax's backends
+    initialize; if some other entry point initialized jax single-device first
+    (e.g. a bare pytest invocation of one file with jax already imported), the
+    flag cannot retroactively split the backend — skip cleanly instead of
+    poisoning every mesh assertion."""
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 virtual devices: jax initialized before the "
+                    "--xla_force_host_platform_device_count=8 hook ran")
+    return jax.devices()[:8]

@@ -9,8 +9,24 @@ import sys
 
 import pytest
 
+from _retry import retry_smoke
+
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SUITE = os.path.join(ROOT, "bench_suite.py")
+
+
+def _run_smoke(config, timeout):
+    """One `bench_suite.py --smoke <config>` pass -> its JSON row. The
+    worker's own hard bounds are asserted inside the bench (non-zero exit
+    fails here immediately)."""
+    env = dict(os.environ)
+    env["PADDLE_TPU_PLATFORM"] = "cpu"
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, SUITE, "--smoke", config],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=ROOT)
+    assert out.returncode == 0, out.stderr[-800:]
+    return json.loads(out.stdout.strip().splitlines()[-1])
 
 
 def _run(configs, timeout=560):
@@ -32,14 +48,11 @@ class TestServingSmoke:
     # fast tier on purpose: `bench_suite.py --smoke serving` is the
     # tier-1-safe invocation of the serving benchmark (ISSUE 5)
     def test_smoke_serving_meets_acceptance(self):
-        env = dict(os.environ)
-        env["PADDLE_TPU_PLATFORM"] = "cpu"
-        env["JAX_PLATFORMS"] = "cpu"
-        out = subprocess.run(
-            [sys.executable, SUITE, "--smoke", "serving"],
-            capture_output=True, text=True, timeout=300, env=env, cwd=ROOT)
-        assert out.returncode == 0, out.stderr[-800:]
-        row = json.loads(out.stdout.strip().splitlines()[-1])
+        # the >= 2x speedup is a wall-clock ratio on a shared CPU: the
+        # repo's retry-up-to-3 flaky-budget helper (tests/_retry.py)
+        row = retry_smoke(
+            lambda: _run_smoke("serving", 300),
+            lambda r: r["detail"]["speedup_vs_static"] >= 2.0)
         assert row["config"] == "serving"
         assert row["unit"] == "tokens/s"
         d = row["detail"]
@@ -68,23 +81,12 @@ class TestChaosSmoke:
     # resilience drill — kill the driving thread mid-decode, recover
     # warm, and hold gold goodput under a shedding bronze flood
     def test_smoke_chaos_meets_acceptance(self):
-        env = dict(os.environ)
-        env["PADDLE_TPU_PLATFORM"] = "cpu"
-        env["JAX_PLATFORMS"] = "cpu"
         # the goodput ratio is a wall-clock measurement on a shared CPU:
-        # retry up to 3 runs for the >= 0.9 bar (the repo's flaky-budget
-        # pattern); every run must pass the drill's own hard bounds
-        # (asserted inside run_chaos — a non-zero exit fails here)
-        row = None
-        for _ in range(3):
-            out = subprocess.run(
-                [sys.executable, SUITE, "--smoke", "chaos"],
-                capture_output=True, text=True, timeout=560, env=env,
-                cwd=ROOT)
-            assert out.returncode == 0, out.stderr[-800:]
-            row = json.loads(out.stdout.strip().splitlines()[-1])
-            if row["value"] >= 0.9:
-                break
+        # retry up to 3 runs for the >= 0.9 bar (tests/_retry.py); every
+        # run must pass the drill's own hard bounds (asserted inside
+        # run_chaos — a non-zero exit fails here)
+        row = retry_smoke(lambda: _run_smoke("chaos", 560),
+                          lambda r: r["value"] >= 0.9)
         assert row["config"] == "chaos"
         assert row["unit"] == "goodput_ratio"
         d = row["detail"]
@@ -111,24 +113,13 @@ class TestSpecSmoke:
     # spec-off at equal engine config on a repeat-heavy workload, plus
     # the int8 pool capacity check
     def test_smoke_spec_meets_acceptance(self):
-        env = dict(os.environ)
-        env["PADDLE_TPU_PLATFORM"] = "cpu"
-        env["JAX_PLATFORMS"] = "cpu"
         # the speedup is a wall-clock measurement on a shared CPU: retry
-        # up to 3 runs for the >= 1.3x bar (the repo's flaky-budget
-        # pattern); every run must pass the bench's own hard bounds
-        # (bit-exactness, accept rate, capacity — asserted inside
-        # run_spec, a non-zero exit fails here)
-        row = None
-        for _ in range(3):
-            out = subprocess.run(
-                [sys.executable, SUITE, "--smoke", "spec"],
-                capture_output=True, text=True, timeout=300, env=env,
-                cwd=ROOT)
-            assert out.returncode == 0, out.stderr[-800:]
-            row = json.loads(out.stdout.strip().splitlines()[-1])
-            if row["value"] >= 1.3:
-                break
+        # up to 3 runs for the >= 1.3x bar (tests/_retry.py); every run
+        # must pass the bench's own hard bounds (bit-exactness, accept
+        # rate, capacity — asserted inside run_spec, a non-zero exit
+        # fails here)
+        row = retry_smoke(lambda: _run_smoke("spec", 300),
+                          lambda r: r["value"] >= 1.3)
         assert row["config"] == "spec"
         assert row["unit"] == "speedup_vs_nonspec"
         d = row["detail"]
@@ -147,6 +138,37 @@ class TestSpecSmoke:
         assert cap["request_ratio"] >= 1.8, cap
         assert cap["bytes_ratio"] <= 1.0, cap
         assert cap["int8"]["pool_bytes"] <= cap["ref"]["pool_bytes"]
+
+
+class TestMeshSmoke:
+    # fast tier on purpose: `bench_suite.py --smoke mesh` is the ISSUE 8
+    # acceptance — DP=8 and DP x TP = 4x2 training of the llama step on
+    # the simulated 8-device mesh, losses matching single-device, real
+    # collectives in the compiled programs, ZeRO-1 state ~1/dp
+    def test_smoke_mesh_meets_acceptance(self):
+        # tokens/s is wall-clock on a shared CPU (reported, not gated);
+        # retry only guards scheduler-noise zeros — the real bounds are
+        # hard-asserted inside run_mesh (non-zero exit fails here)
+        row = retry_smoke(lambda: _run_smoke("mesh", 560),
+                          lambda r: r.get("value", 0) > 0)
+        assert row["config"] == "mesh"
+        assert row["unit"] == "tokens/s"
+        d = row["detail"]
+        assert row["value"] == d["dp8_tokens_per_sec"] > 0
+        # ISSUE 8 acceptance: losses match single-device within fp
+        # tolerance on every mesh pass
+        assert d["dp8_loss_close"] is True
+        assert d["zero1_loss_close"] is True
+        assert d["hybrid_loss_close"] is True
+        # ... with real collectives in the compiled step programs
+        assert d["collectives"]["dp8"]["all_reduce"] >= 1
+        assert d["collectives"]["dp8_zero1"]["reduce_scatter"] >= 1
+        assert d["collectives"]["dp8_zero1"]["all_gather"] >= 1
+        # ... and the ZeRO-1 knob shrinking per-replica optimizer state
+        # to <= ~(1/dp + eps) of the replicated layout
+        b = d["opt_state_bytes"]
+        assert b["zero1_per_replica"] < b["replicated"]
+        assert b["ratio"] <= 1.0 / d["dp"] + 0.02, b
 
 
 @pytest.mark.slow

@@ -1,0 +1,520 @@
+"""paddle_tpu.mesh — real SPMD mesh execution (ISSUE 8).
+
+Covers: MeshContext lowering + the placement->PartitionSpec mapping, the
+per-op SPMD rule registry (propagation + explicit resharding only where
+specs disagree), the mesh.collective fault drill, eager collectives backed
+by real jax.lax programs, and the acceptance bars: DP=8 / ZeRO-1 training
+of the mlp+llama step on the simulated 8-device mesh matching the
+single-device run, with zero post-warmup recompiles under graftsan and
+>= 1 real collective visible in comm.* spans.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import mesh as pmesh
+from paddle_tpu import monitor
+from paddle_tpu.distributed import api as dist_api
+from paddle_tpu.distributed.placement import Partial, Replicate, Shard
+from paddle_tpu.distributed.process_mesh import ProcessMesh
+from paddle_tpu.monitor import trace
+
+
+def _mlp():
+    return paddle.nn.Sequential(
+        paddle.nn.Linear(16, 32), paddle.nn.Tanh(),
+        paddle.nn.Linear(32, 16))
+
+
+def _mse(m, x, y):
+    return ((m(x) - y) ** 2).mean()
+
+
+def _single_device_losses(factory, loss_fn, batch, steps, lr=1e-2,
+                          opt_cls=None):
+    from bench_common import build_step
+
+    paddle.seed(0)
+    model = factory()
+    opt_cls = opt_cls or paddle.optimizer.Adam
+    opt = opt_cls(learning_rate=lr, parameters=model.parameters())
+    step, state, _ = build_step(model, opt, loss_fn)
+    pv, av, mv = state()
+    losses = []
+    for _ in range(steps):
+        loss, pv, av, mv = step(pv, av, mv, *batch)
+        losses.append(float(loss))
+    return losses
+
+
+class TestMeshContext:
+    def test_from_degrees_and_spec_mapping(self, mesh8):
+        ctx = pmesh.MeshContext.from_degrees(dp=4, mp=2)
+        assert ctx.axis_names == ("dp", "mp")
+        assert ctx.axis_size("dp") == 4 and ctx.axis_size("mp") == 2
+        assert ctx.manual_axes == ("dp",) and ctx.auto_axes == ("mp",)
+        # placement list (per MESH dim) -> PartitionSpec (per TENSOR dim)
+        spec = ctx.spec([Shard(0), Shard(1)])
+        assert tuple(spec) == ("dp", "mp")
+        spec = ctx.spec([Replicate(), Shard(0)])
+        assert tuple(spec) == ("mp",)
+        # co-shard: two mesh dims on one tensor dim -> tuple entry
+        spec = ctx.spec([Shard(1), Shard(1)])
+        assert tuple(spec) == (None, ("dp", "mp"))
+
+    def test_placements_spec_round_trip(self, mesh8):
+        ctx = pmesh.MeshContext.from_degrees(dp=8)
+        pl = [Shard(0), Replicate()]
+        assert ctx.placements(ctx.spec(pl)) == pl
+
+    def test_device_count_guard(self, mesh8):
+        with pytest.raises(RuntimeError, match="devices"):
+            pmesh.MeshContext.from_degrees(dp=jax.device_count() * 2)
+
+    def test_bootstrap_idempotent(self, mesh8):
+        env = {"XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+        assert pmesh.bootstrap_virtual_devices(8, env=env)
+        assert env["XLA_FLAGS"].count("host_platform_device_count") == 1
+
+    def test_current_context_scope(self, mesh8):
+        ctx = pmesh.MeshContext.from_degrees(dp=8)
+        assert pmesh.current_mesh_context() is None
+        with ctx:
+            assert pmesh.current_mesh_context() is ctx
+        assert pmesh.current_mesh_context() is None
+
+    def test_batch_spec(self, mesh8):
+        ctx = pmesh.MeshContext.from_degrees(dp=8)
+        assert tuple(ctx.batch_spec(3)) == ("dp", None, None)
+
+
+class TestSpmdRules:
+    def test_matmul_dp_batch(self):
+        req, out = pmesh.propagate(
+            "matmul", [("dp", None, None), (None, None)],
+            [(8, 16, 32), (32, 64)])
+        assert out == [("dp", None, None)]
+        assert req[1] == (None, None)  # no reshard needed
+
+    def test_matmul_tp_column(self):
+        _, out = pmesh.propagate(
+            "matmul", [(None, None), (None, "mp")], [(8, 32), (32, 64)])
+        assert out == [(None, "mp")]
+
+    def test_matmul_contract_sharded_vanishes(self):
+        # both operands sharded on the contracted dim: specs AGREE (no
+        # reshard) and the axis disappears into an XLA all-reduce
+        req, out = pmesh.propagate(
+            "matmul", [(None, "mp"), ("mp", None)], [(8, 32), (32, 64)])
+        assert req[1] == ("mp", None)
+        assert out == [(None, None)]
+
+    def test_matmul_mismatch_requires_reshard(self):
+        req, _ = pmesh.propagate(
+            "matmul", [(None, "dp"), ("mp", None)], [(8, 32), (32, 64)])
+        assert req[1][0] == "dp"  # b's contract dim resharded to match a
+
+    def test_norm_forces_whole_last_dim(self):
+        for op in ("layer_norm", "rms_norm"):
+            req, out = pmesh.propagate(
+                op, [("dp", None, "mp"), ("mp",)], [(8, 16, 32), (32,)])
+            assert req[0] == ("dp", None, None)
+            assert req[1] == (None,)
+            assert out == [("dp", None, None)]
+
+    def test_softmax_reduces_on_device(self):
+        req, out = pmesh.propagate(
+            "softmax", [("dp", None, "mp")], [(8, 16, 32)],
+            kwargs={"axis": -1})
+        assert req[0] == ("dp", None, None) == out[0]
+
+    def test_elementwise_merge_and_conflict(self):
+        req, out = pmesh.propagate(
+            "add", [("dp", None), (None, "mp")], [(8, 16), (8, 16)])
+        assert out == [("dp", "mp")]
+        # conflict: second operand resharded to the first's placement
+        req, out = pmesh.propagate(
+            "add", [("dp", None), ("mp", None)], [(8, 16), (8, 16)])
+        assert out == [("dp", None)]
+        assert req[1][0] == "dp"
+
+    def test_reduction_drops_reduced_dims(self):
+        _, out = pmesh.propagate("sum", [("dp", "mp")], [(8, 16)],
+                                 kwargs={"axis": 1})
+        assert out == [("dp",)]
+        _, out = pmesh.propagate("mean", [("dp", "mp")], [(8, 16)])
+        assert out == [()]  # full reduction
+
+    def test_embedding_flows_hidden_shard(self):
+        _, out = pmesh.propagate(
+            "embedding_op", [("dp", None), (None, "mp")],
+            [(8, 16), (100, 64)])
+        assert out == [("dp", None, "mp")]
+
+    def test_transpose_permutes(self):
+        _, out = pmesh.propagate(
+            "transpose", [("dp", None, "mp")], [(8, 16, 32)],
+            kwargs={"perm": [1, 0, 2]})
+        assert out == [(None, "dp", "mp")]
+
+    def test_reshape_preserves_leading_or_gathers(self):
+        _, out = pmesh.propagate(
+            "reshape", [("dp", None, None)], [(8, 4, 16)],
+            kwargs={"shape": [8, 64]})
+        assert out == [("dp", None)]
+        req, out = pmesh.propagate(
+            "reshape", [(None, "mp", None)], [(8, 4, 16)],
+            kwargs={"shape": [8, 64]})
+        assert req[0] == (None, None, None)  # sharded dim folds: gather
+
+    def test_unknown_op_propagates_nothing(self):
+        assert pmesh.propagate("no_such_op", [("dp",)], [(8,)]) is None
+
+
+class TestEagerPropagation:
+    @pytest.fixture(autouse=True)
+    def _prop(self, mesh8):
+        self.ctx = pmesh.MeshContext.from_degrees(dp=8)
+        pmesh.enable_propagation()
+        yield
+        pmesh.disable_propagation()
+
+    def test_specs_flow_through_defop_outputs(self):
+        x = dist_api.shard_tensor(
+            np.random.randn(16, 32).astype("float32"),
+            self.ctx.process_mesh, [Shard(0), Replicate()])
+        w = paddle.to_tensor(np.random.randn(32, 8).astype("float32"))
+        y = paddle.matmul(x, w)
+        assert y._dist_attr is not None
+        assert y._dist_attr.placements[0] == Shard(0)
+        # chain: elementwise keeps the annotation
+        s = (y + y)
+        assert s._dist_attr.placements[0] == Shard(0)
+
+    def test_no_dist_inputs_is_a_no_op(self):
+        a = paddle.to_tensor(np.ones((4, 4), "float32"))
+        out = paddle.matmul(a, a)
+        assert out._dist_attr is None
+
+    def test_disagreeing_spec_inserts_reshard_with_telemetry(self):
+        mon_was, tr_was = monitor.enabled(), trace.enabled()
+        monitor.enable()
+        trace.enable()
+        try:
+            ctr = monitor.counter("paddle_tpu_mesh_reshards_total",
+                                  labelnames=("kind",)).labels("all_gather")
+            before = ctr.value
+            x = dist_api.shard_tensor(
+                np.random.randn(16, 32).astype("float32"),
+                self.ctx.process_mesh, [Shard(1), Replicate()])
+            w = paddle.to_tensor(np.ones(32, "float32"))
+            out = paddle.nn.functional.rms_norm(x, w)
+            assert ctr.value == before + 1
+            assert out._dist_attr.placements == [Replicate(), Replicate()]
+            names = [s.name for s in trace.spans()]
+            assert "mesh.reshard" in names
+        finally:
+            if not mon_was:
+                monitor.disable()
+            if not tr_was:
+                trace.disable()
+
+    def test_values_unchanged_by_resharding(self):
+        xv = np.random.RandomState(0).randn(16, 32).astype("float32")
+        w = np.ones(32, "float32")
+        ref = paddle.nn.functional.rms_norm(
+            paddle.to_tensor(xv), paddle.to_tensor(w))
+        x = dist_api.shard_tensor(xv, self.ctx.process_mesh,
+                                  [Shard(1), Replicate()])
+        out = paddle.nn.functional.rms_norm(x, paddle.to_tensor(w))
+        np.testing.assert_allclose(np.asarray(out.value),
+                                   np.asarray(ref.value), rtol=1e-6)
+
+    def test_gradients_flow_through_inserted_reshard(self):
+        xv = np.random.RandomState(1).randn(8, 16).astype("float32")
+        x = dist_api.shard_tensor(xv, self.ctx.process_mesh,
+                                  [Shard(1), Replicate()],
+                                  stop_gradient=False)
+        w = paddle.to_tensor(np.ones(16, "float32"))
+        out = paddle.nn.functional.rms_norm(x, w)
+        out.sum().backward()
+        assert x.grad is not None
+        assert np.all(np.isfinite(np.asarray(x.grad.value)))
+
+
+class TestReshardFaultDrill:
+    def test_mesh_collective_flag_raises_typed_fault(self, mesh8):
+        from paddle_tpu.analysis import faultinject as fi
+
+        ctx = pmesh.MeshContext.from_degrees(dp=8)
+        pmesh.enable_propagation()
+        fi.reset()
+        try:
+            fi.arm("mesh.collective", action="flag")
+            x = dist_api.shard_tensor(
+                np.random.randn(16, 32).astype("float32"),
+                ctx.process_mesh, [Shard(1), Replicate()])
+            w = paddle.to_tensor(np.ones(32, "float32"))
+            with pytest.raises(pmesh.ReshardFault) as ei:
+                paddle.nn.functional.rms_norm(x, w)
+            assert ei.value.axis == "dp"  # the poisoned mesh axis, by name
+            assert ei.value.kind == "all_gather"
+            assert ("mesh.collective", "flag") in fi.trips()
+            # disarmed: the same reshard succeeds
+            fi.reset()
+            out = paddle.nn.functional.rms_norm(x, w)
+            assert out._dist_attr is not None
+        finally:
+            fi.reset()
+            pmesh.disable_propagation()
+
+
+class TestEagerCollectivesReal:
+    """distributed/collective.py now dispatches real jax.lax collective
+    programs: semantics unchanged, wire ops real, telemetry attached."""
+
+    def test_all_reduce_program_contains_collective(self, mesh8):
+        from paddle_tpu.distributed import collective as C
+
+        v = paddle.to_tensor(np.arange(24, dtype="float32").reshape(8, 3))
+        C.all_reduce(v)
+        expect = np.arange(24, dtype="float32").reshape(8, 3).sum(0)
+        for row in np.asarray(v.value):
+            np.testing.assert_allclose(row, expect)
+        g = C._world_group()
+        prog = g._programs[("all_reduce", C.ReduceOp.SUM, "float32")]
+        sharded = jax.device_put(jnp.zeros((8, 3)), C._stacked_sharding(g))
+        hlo = prog.lower(sharded).compile().as_text()
+        assert "all-reduce" in hlo
+
+    def test_collectives_counted_and_spanned(self, mesh8):
+        from paddle_tpu.distributed import collective as C
+
+        mon_was, tr_was = monitor.enabled(), trace.enabled()
+        monitor.enable()
+        trace.enable()
+        try:
+            ctr = monitor.counter("paddle_tpu_comm_collectives_total",
+                                  labelnames=("op",))
+            before = ctr.labels("broadcast").value
+            v = paddle.to_tensor(np.arange(8, dtype="float32")[:, None])
+            C.broadcast(v, src=3)
+            np.testing.assert_allclose(np.asarray(v.value).ravel(),
+                                       np.full(8, 3.0))
+            assert ctr.labels("broadcast").value == before + 1
+            spans = [s for s in trace.spans() if s.name == "comm.collective"]
+            assert spans and spans[-1].attrs["op"] == "broadcast"
+            assert spans[-1].attrs["nranks"] == 8
+        finally:
+            if not mon_was:
+                monitor.disable()
+            if not tr_was:
+                trace.disable()
+
+    def test_reduce_scatter_and_alltoall_semantics(self, mesh8):
+        from paddle_tpu.distributed import collective as C
+
+        out = paddle.to_tensor(np.zeros((8, 2), "float32"))
+        C.reduce_scatter(out, paddle.to_tensor(np.ones((8, 16), "float32")))
+        np.testing.assert_allclose(np.asarray(out.value),
+                                   np.full((8, 2), 8.0))
+        ol = []
+        vin = np.arange(64, dtype="float32").reshape(8, 8)
+        C.alltoall(ol, paddle.to_tensor(vin))
+        np.testing.assert_allclose(np.asarray(ol[0].value), vin[:, 0])
+        np.testing.assert_allclose(np.asarray(ol[5].value), vin[:, 5])
+
+
+class TestMeshTrainParity:
+    def test_dp8_mlp_matches_single_device(self, mesh8):
+        r = np.random.RandomState(0)
+        xb = r.randn(16, 16).astype("float32")
+        yb = r.randn(16, 16).astype("float32")
+        ref = _single_device_losses(_mlp, _mse, (xb, yb), 3)
+
+        paddle.seed(0)
+        m = _mlp()
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=m.parameters())
+        mp = pmesh.parallelize(m, opt, _mse, (xb, yb),
+                               config={"dp_degree": 8})
+        got = [float(mp.step(xb, yb)) for _ in range(3)]
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+        assert mp.collective_counts(xb, yb).get("all_reduce", 0) >= 1
+
+    def test_dp8_is_deterministic_bit_exact(self, mesh8):
+        r = np.random.RandomState(1)
+        xb = r.randn(8, 16).astype("float32")
+        yb = r.randn(8, 16).astype("float32")
+
+        def run():
+            paddle.seed(0)
+            m = _mlp()
+            opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                        parameters=m.parameters())
+            mp = pmesh.parallelize(m, opt, _mse, (xb, yb),
+                                   config={"dp_degree": 8})
+            return [float(mp.step(xb, yb)) for _ in range(3)]
+
+        assert run() == run()  # DP bit-exact for the same global batch
+
+    def test_zero1_matches_and_shrinks_state(self, mesh8):
+        r = np.random.RandomState(0)
+        xb = r.randn(16, 16).astype("float32")
+        yb = r.randn(16, 16).astype("float32")
+        ref = _single_device_losses(_mlp, _mse, (xb, yb), 3)
+
+        paddle.seed(0)
+        m = _mlp()
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=m.parameters())
+        mz = pmesh.parallelize(m, opt, _mse, (xb, yb),
+                               config={"dp_degree": 8,
+                                       "shard_optimizer": True})
+        got = [float(mz.step(xb, yb)) for _ in range(3)]
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+        # the ZeRO-1 exchange is a real reduce-scatter + all-gather pair
+        coll = mz.collective_counts(xb, yb)
+        assert coll.get("reduce_scatter", 0) >= 1
+        assert coll.get("all_gather", 0) >= 1
+        # per-replica optimizer state ~1/dp of replicated
+        paddle.seed(0)
+        m2 = _mlp()
+        o2 = paddle.optimizer.Adam(learning_rate=1e-2,
+                                   parameters=m2.parameters())
+        mp = pmesh.parallelize(m2, o2, _mse, (xb, yb),
+                               config={"dp_degree": 8})
+        ratio = mz.optimizer_state_bytes() / mp.optimizer_state_bytes()
+        assert ratio <= 1 / 8 + 0.02, ratio
+
+    def test_zero1_state_bytes_gauge(self, mesh8):
+        mon_was = monitor.enabled()
+        monitor.enable()
+        try:
+            r = np.random.RandomState(0)
+            xb = r.randn(8, 16).astype("float32")
+            yb = r.randn(8, 16).astype("float32")
+            paddle.seed(0)
+            m = _mlp()
+            opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                        parameters=m.parameters())
+            mz = pmesh.parallelize(m, opt, _mse, (xb, yb),
+                                   config={"dp_degree": 8,
+                                           "shard_optimizer": True})
+            mz.step(xb, yb)
+            snap = monitor.snapshot()["metrics"]
+            gauge = snap["paddle_tpu_mesh_optimizer_state_bytes"]["values"][""]
+            assert gauge == mz.optimizer_state_bytes() > 0
+        finally:
+            if not mon_was:
+                monitor.disable()
+
+    def test_shard_optimizer_rejects_global_norm_clip(self, mesh8):
+        paddle.seed(0)
+        m = _mlp()
+        opt = paddle.optimizer.Adam(
+            learning_rate=1e-2, parameters=m.parameters(),
+            grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0))
+        xb = np.zeros((8, 16), "float32")
+        with pytest.raises(ValueError, match="shard_optimizer"):
+            pmesh.parallelize(m, opt, _mse, (xb, xb),
+                              config={"dp_degree": 8,
+                                      "shard_optimizer": True})
+
+    def test_batch_divisibility_guard(self, mesh8):
+        paddle.seed(0)
+        m = _mlp()
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=m.parameters())
+        xb = np.zeros((8, 16), "float32")
+        mp = pmesh.parallelize(m, opt, _mse, (xb, xb),
+                               config={"dp_degree": 8})
+        with pytest.raises(ValueError, match="divisible"):
+            mp.step(np.zeros((6, 16), "float32"), np.zeros((6, 16), "float32"))
+
+    def test_finalize_writes_back_trained_state(self, mesh8):
+        r = np.random.RandomState(0)
+        xb = r.randn(8, 16).astype("float32")
+        yb = r.randn(8, 16).astype("float32")
+        paddle.seed(0)
+        m = _mlp()
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=m.parameters())
+        mz = pmesh.parallelize(m, opt, _mse, (xb, yb),
+                               config={"dp_degree": 8,
+                                       "shard_optimizer": True})
+        mz.step(xb, yb)
+        mz.finalize()
+        for _, p in m.named_parameters():
+            v = np.asarray(p.value)
+            assert np.all(np.isfinite(v))
+            st = opt._accumulators[id(p)]
+            for k, sv in st.items():
+                assert sv.shape == tuple(p.shape)  # gathered back whole
+
+
+class TestMeshLlamaAcceptance:
+    """ISSUE 8 acceptance on the real llama step (tiny shape, tier-1)."""
+
+    def _llama(self):
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+        cfg = LlamaConfig(vocab_size=64, hidden_size=32,
+                          intermediate_size=64, num_hidden_layers=2,
+                          num_attention_heads=2, num_key_value_heads=2,
+                          max_position_embeddings=16)
+        return LlamaForCausalLM(cfg)
+
+    @staticmethod
+    def _loss(m, ids, labels):
+        loss, _ = m(ids, labels=labels)
+        return loss
+
+    def test_dp8_llama_parity_sanitized_steady_state_comm_spans(self, mesh8):
+        """The ISSUE 8 bar in one pass (one compile cycle, tier-1 budget):
+        DP=8 llama losses match single-device within fp tolerance, the
+        PADDLE_TPU_SANITIZE discipline holds (zero post-warmup recompiles,
+        no host-sync trips), and >= 1 real collective is visible in comm.*
+        spans."""
+        from paddle_tpu.analysis import sanitizers as san
+
+        r = np.random.RandomState(0)
+        ids = r.randint(0, 64, (8, 8)).astype("int64")
+        labels = r.randint(0, 64, (8, 8, 1)).astype("int64")
+        ref = _single_device_losses(self._llama, self._loss, (ids, labels),
+                                    4, lr=1e-3,
+                                    opt_cls=paddle.optimizer.AdamW)
+        paddle.seed(0)
+        m = self._llama()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=m.parameters())
+        mp = pmesh.parallelize(m, opt, self._loss, (ids, labels),
+                               config={"dp_degree": 8})
+        got = [float(mp.step(ids, labels))]  # warmup: the one allowed compile
+        tr_was = trace.enabled()
+        trace.enable()
+        san.reset()
+        san.enable("recompile", "hostsync")
+        try:
+            compiles_before = mp._jitted._cache_size()
+            got += [float(mp.step(ids, labels)) for _ in range(3)]
+            np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+            assert mp._jitted._cache_size() == compiles_before, \
+                "mesh step recompiled post-warmup"
+            assert san.trips() == []
+            spans = [s for s in trace.spans() if s.name == "comm.mesh_step"]
+            assert spans, "no comm.mesh_step span recorded"
+            attrs = spans[-1].attrs
+            assert attrs["dp"] == 8
+            assert attrs.get("all_reduce", 0) >= 1, attrs
+        finally:
+            # reset() drops counts but leaves ENABLE state untouched — the
+            # sentinel must also be disabled or every later to_static test
+            # in the session inherits a ticking recompile budget
+            san.reset()
+            san.disable("recompile", "hostsync")
+            if not tr_was:
+                trace.disable()
