@@ -6,6 +6,7 @@ backend (fetch one element instead), and long unforced donated chains are
 pathologically slow (force every couple of steps)."""
 from __future__ import annotations
 
+import os
 import threading
 import time
 
@@ -756,6 +757,127 @@ def mesh_bench(*, dp=8, tp=2, batch=8, seq=16, iters=3, vocab=128, hidden=64,
             "zero1_per_replica": int(zero_bytes),
             "ratio": round(zero_bytes / max(replicated_bytes, 1), 4),
         },
+    }
+
+
+def train_chaos_bench(*, dp=8, steps=8, kill_at=6, ckpt_every=2, batch=8,
+                      seq=8, vocab=64, hidden=32, layers=2, heads=4,
+                      ffn=64, lr=1e-3, seed=0, shard_optimizer=True,
+                      ckpt_dir=None):
+    """The TRAINING resilience drill (mesh/trainer.py + checkpoint/):
+    kill a DP=``dp`` llama train run mid-step and measure warm recovery.
+
+    1. A reference pass (no faults) trains ``steps`` steps with periodic
+       async checkpoints, recording every step's loss.
+    2. A chaos pass over the SAME workload and seed arms
+       ``mesh.step:raise:nth=kill_at`` so the ``kill_at``-th step attempt
+       dies. fit() must recover — flight dump naming the stuck point,
+       state reload from the last committed checkpoint (the compiled
+       step program survives = warm), replay — and the final per-step
+       losses must be BIT-IDENTICAL to the reference pass.
+
+    Reports recovery wall time (the <5s warm bar), the restored step,
+    whether the replay was bit-identical, and the compiled-program count
+    after recovery (1 = zero post-recovery recompiles). Deterministic in
+    ``seed``; CPU-smoke-safe at the default shapes."""
+    import tempfile
+
+    import numpy as np
+
+    import jax
+
+    if jax.device_count() < dp:
+        return {"skipped": f"needs {dp} devices, {jax.device_count()} "
+                           "visible (set XLA_FLAGS="
+                           "--xla_force_host_platform_device_count=8)"}
+
+    import paddle_tpu as paddle
+    from paddle_tpu import mesh as pmesh
+    from paddle_tpu.analysis import faultinject as fi
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.monitor import trace
+
+    cfg = LlamaConfig(
+        vocab_size=vocab, hidden_size=hidden, intermediate_size=ffn,
+        num_hidden_layers=layers, num_attention_heads=heads,
+        num_key_value_heads=heads, max_position_embeddings=max(seq, 16))
+    r = np.random.RandomState(seed)
+    ids = r.randint(0, vocab, (batch, seq)).astype("int64")
+    labels = r.randint(0, vocab, (batch, seq, 1)).astype("int64")
+
+    def loss_fn(m, ids_t, labels_t):
+        loss, _ = m(ids_t, labels=labels_t)
+        return loss
+
+    def data(step):
+        return (ids, labels)
+
+    def make_trainer(directory, **kw):
+        paddle.seed(seed)
+        m = LlamaForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=lr,
+                                     parameters=m.parameters())
+        return pmesh.MeshTrainer(
+            m, opt, loss_fn, (ids, labels),
+            config={"dp_degree": dp, "shard_optimizer": shard_optimizer},
+            checkpoint=directory, **kw)
+
+    own_dir = ckpt_dir is None
+    base = ckpt_dir or tempfile.mkdtemp(prefix="trainchaos-")
+    ref_trainer = chaos_trainer = None
+    trace_was = trace.enabled()
+    try:
+        # -- reference pass (uninterrupted) -----------------------------
+        fi.reset()
+        t0 = time.perf_counter()
+        ref_trainer = make_trainer(os.path.join(base, "ref"))
+        ref = ref_trainer.fit(data, steps, ckpt_every=ckpt_every)
+        ref_wall = time.perf_counter() - t0
+        tokens = batch * seq * steps
+
+        # -- chaos pass: die at the kill_at-th step attempt -------------
+        trace.enable()    # recover()'s flight dump needs the recorder on
+        chaos_trainer = make_trainer(os.path.join(base, "chaos"))
+        fi.arm("mesh.step", action="raise", nth=kill_at)
+        t0 = time.perf_counter()
+        got = chaos_trainer.fit(data, steps, ckpt_every=ckpt_every)
+        chaos_wall = time.perf_counter() - t0
+        killed = bool(fi.trips())
+        rec = (chaos_trainer.recovery_stats[0]
+               if chaos_trainer.recovery_stats else {})
+        identical = sorted(got) == sorted(ref) \
+            and all(got[k] == ref[k] for k in ref)
+        compiled = chaos_trainer.handle._jitted._cache_size()
+        committed = chaos_trainer.manager.steps()
+    finally:
+        fi.reset()
+        for t in (ref_trainer, chaos_trainer):
+            if t is not None:
+                t.close()
+        if not trace_was:
+            trace.disable()
+        if own_dir:
+            import shutil
+
+            shutil.rmtree(base, ignore_errors=True)
+    return {
+        "dp": dp, "steps": steps, "kill_at": kill_at,
+        "ckpt_every": ckpt_every, "batch": batch, "seq": seq,
+        "hidden": hidden, "layers": layers,
+        "zero1": bool(shard_optimizer),
+        "killed": killed,
+        "recoveries": len(chaos_trainer.recovery_stats),
+        "recovery_ms": round(rec.get("ms", -1.0), 2),
+        "restored_step": rec.get("restored_step", -1),
+        "flight_dump": rec.get("dump"),
+        "losses_bit_identical": bool(identical),
+        "final_loss_ref": ref[max(ref)] if ref else None,
+        "final_loss_chaos": got[max(got)] if got else None,
+        "compiled_programs_after_recovery": compiled,
+        "committed_steps": committed,
+        "reference_wall_s": round(ref_wall, 2),
+        "chaos_wall_s": round(chaos_wall, 2),
+        "ref_tokens_per_sec": round(tokens / max(ref_wall, 1e-9), 1),
     }
 
 

@@ -37,7 +37,7 @@ ROOT = os.path.dirname(os.path.abspath(__file__))
 RESULTS = os.path.join(ROOT, "tools", "suite_results.jsonl")
 
 CONFIGS = ("lenet", "resnet50", "bert_dp", "gpt_hybrid", "serving",
-           "chaos", "spec", "mesh")
+           "chaos", "spec", "mesh", "trainchaos")
 
 
 # --------------------------------------------------------------------------- #
@@ -439,19 +439,25 @@ def run_spec(smoke=False):
            "unit": "speedup_vs_nonspec", "detail": res})
 
 
-def run_mesh(smoke=False):
-    """Config 8 — simulated-mesh SPMD training (paddle_tpu.mesh): DP=8 and
-    DP x TP = 4x2 llama training under shard_map on the 8-device virtual
-    CPU mesh vs the single-device step (bench_common.mesh_bench), plus the
-    ZeRO-1 per-replica optimizer-state-bytes lever. ``smoke`` is the
-    tier-1-safe shape (`bench_suite.py --smoke mesh`)."""
-    # the virtual mesh must exist BEFORE jax's backends initialize
+def _force_virtual_mesh():
+    """The 8-device virtual CPU mesh env, set BEFORE jax's backends
+    initialize (shared by the mesh-family workers; _run_config applies
+    the same flags to its subprocess env dict)."""
     flags = os.environ.get("XLA_FLAGS", "")
     if "host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = (flags +
                                    " --xla_force_host_platform_device_count=8")
     os.environ.setdefault("PADDLE_TPU_PLATFORM", "cpu")
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def run_mesh(smoke=False):
+    """Config 8 — simulated-mesh SPMD training (paddle_tpu.mesh): DP=8 and
+    DP x TP = 4x2 llama training under shard_map on the 8-device virtual
+    CPU mesh vs the single-device step (bench_common.mesh_bench), plus the
+    ZeRO-1 per-replica optimizer-state-bytes lever. ``smoke`` is the
+    tier-1-safe shape (`bench_suite.py --smoke mesh`)."""
+    _force_virtual_mesh()
 
     import paddle_tpu as paddle  # noqa: F401 - initializes the 8-device view
 
@@ -484,13 +490,58 @@ def run_mesh(smoke=False):
            "unit": "tokens/s", "detail": res})
 
 
+def run_trainchaos(smoke=False):
+    """Config 9 — the TRAINING resilience drill (bench_common.
+    train_chaos_bench, mesh/trainer.py + checkpoint/): kill a DP=8 llama
+    train run mid-step, recover WARM from the last committed async
+    checkpoint (<5s, compiled step program survives) and verify the
+    replayed per-step losses are bit-identical to an uninterrupted
+    reference pass. ``smoke`` is the tier-1-safe shape
+    (`bench_suite.py --smoke trainchaos`)."""
+    _force_virtual_mesh()
+
+    import paddle_tpu as paddle  # noqa: F401 - initializes the 8-device view
+
+    from bench_common import train_chaos_bench
+
+    if smoke:
+        params = dict(dp=8, steps=8, kill_at=6, ckpt_every=2, batch=8,
+                      seq=8, vocab=64, hidden=32, layers=2, heads=4,
+                      ffn=64)
+    else:
+        params = dict(dp=8, steps=16, kill_at=12, ckpt_every=4, batch=16,
+                      seq=32, vocab=256, hidden=96, layers=3, heads=4,
+                      ffn=256)
+    res = train_chaos_bench(**params)
+    if "skipped" in res:
+        _emit({"config": "trainchaos", "error": res["skipped"]})
+        return
+    if smoke:
+        # the drill's own hard bounds (tier-1 gates on this exit code):
+        # the kill happened, ONE recovery fired a flight dump, restored
+        # from a committed checkpoint, the replay was bit-identical and
+        # the compiled step survived (zero post-recovery recompiles).
+        # The <5s warm-recovery bar is wall-clock: it lives in the
+        # tier-1 test behind the tests/_retry.py contention-aware floor
+        # (the worker only sanity-caps it, so an oversubscribed runner
+        # can still relax the bar instead of dying in-process)
+        assert res["killed"] and res["recoveries"] == 1, res
+        assert res["flight_dump"], res
+        assert res["restored_step"] >= 0, res
+        assert res["losses_bit_identical"], res
+        assert res["compiled_programs_after_recovery"] == 1, res
+        assert 0 < res["recovery_ms"] < 30000, res
+    _emit({"config": "trainchaos", "value": res["recovery_ms"],
+           "unit": "recovery_ms", "detail": res})
+
+
 # --------------------------------------------------------------------------- #
 # orchestrator
 # --------------------------------------------------------------------------- #
 
 def _run_config(name, timeout):
     env = dict(os.environ)
-    if name in ("gpt_hybrid", "mesh"):
+    if name in ("gpt_hybrid", "mesh", "trainchaos"):
         # hybrid/mesh mechanics always run on the 8-device virtual CPU mesh
         # (single-chip TPU cannot host a dp2 x mp2 x pp2 mesh)
         env["PADDLE_TPU_PLATFORM"] = "cpu"
@@ -542,7 +593,8 @@ def main():
 
     if args.smoke:
         smokes = {"serving": run_serving, "chaos": run_chaos,
-                  "spec": run_spec, "mesh": run_mesh}
+                  "spec": run_spec, "mesh": run_mesh,
+                  "trainchaos": run_trainchaos}
         if args.smoke not in smokes:
             ap.error(f"--smoke supports {sorted(smokes)}, "
                      f"not {args.smoke!r}")
@@ -580,6 +632,7 @@ if __name__ == "__main__":
         {"lenet": run_lenet, "resnet50": run_resnet50,
          "bert_dp": run_bert_dp, "gpt_hybrid": run_gpt_hybrid,
          "serving": run_serving, "chaos": run_chaos,
-         "spec": run_spec, "mesh": run_mesh}[which]()
+         "spec": run_spec, "mesh": run_mesh,
+         "trainchaos": run_trainchaos}[which]()
     else:
         main()

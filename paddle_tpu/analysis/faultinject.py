@@ -89,6 +89,28 @@ POINTS = {
         "flag = the site raises a typed ReshardFault naming the mesh "
         "axis, drilling callers that must survive a poisoned "
         "redistribution."),
+    "mesh.step": (
+        "Entry of MeshTrainer.train_step (mesh/trainer.py), before any "
+        "state is touched. raise = the train step dies (the kill drill: "
+        "fit() must recover warm from the last committed checkpoint and "
+        "resume bit-identical); delay = the step hangs (the mesh "
+        "watchdog's drill — the scanner recovers, the stuck step wakes "
+        "into the new epoch and raises TrainStepSuperseded)."),
+    "ckpt.write": (
+        "The checkpoint writer thread, after the temp directory exists "
+        "and before any shard lands (checkpoint/manager.py). raise = a "
+        "torn write: the step is never committed and restore must fall "
+        "back to the previous commit; flag = one shard's on-disk bytes "
+        "are corrupted AFTER its digest was recorded, so restore's "
+        "verification must reject the checkpoint."),
+    "ckpt.restore": (
+        "Entry of CheckpointManager.restore (checkpoint/manager.py). "
+        "raise = the restore path itself dies (a recovery that cannot "
+        "reload must propagate, not loop); delay = a slow restore."),
+    "data.next": (
+        "CursorLoader.__next__ (io/dataloader.py): the resumable batch "
+        "cursor the trainer checkpoints. raise = the data pipeline dies "
+        "mid-epoch; delay = a stalled fetch."),
 }
 
 ACTIONS = ("raise", "delay", "flag")

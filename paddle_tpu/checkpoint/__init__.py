@@ -1,0 +1,22 @@
+"""paddle_tpu.checkpoint: async sharded training checkpoints.
+
+The production checkpoint subsystem the fault-tolerant mesh trainer rides
+(``mesh/trainer.py``): digest-verified shards, atomic-rename commits,
+double-buffered async writes, bounded retention, and ZeRO-1 per-replica
+state that re-shards onto a DIFFERENT dp degree at restore time. The
+API-shaped flat-shard format of ``distributed/checkpoint`` (reference
+``save_state_dict``/``load_state_dict`` compatibility) is unchanged and
+separate. See docs/checkpoint.md.
+"""
+from .manager import (  # noqa: F401
+    FORMAT,
+    MANIFEST,
+    CheckpointCorrupt,
+    CheckpointError,
+    CheckpointManager,
+    NoCheckpoint,
+    RestoredCheckpoint,
+    read_manifest,
+    step_dirs,
+    verify_checkpoint,
+)

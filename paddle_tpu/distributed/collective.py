@@ -24,6 +24,7 @@ from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..framework.core import Tensor
+from . import watchdog as _watchdog
 
 
 class ReduceOp:
@@ -250,14 +251,29 @@ class _comm_span:
 
 def _group_program(group, key, builder):
     """One jitted shard_map program per (group, collective signature); jax's
-    own jit cache handles per-shape/dtype specialization underneath."""
+    own jit cache handles per-shape/dtype specialization underneath. When a
+    process-wide watchdog is installed (``distributed.watchdog.
+    set_default_watchdog`` — the mesh trainer's hang-recovery companion),
+    the returned callable runs inside a watched, execution-fenced section:
+    the block_until_ready is what lets the scanner OBSERVE a hung
+    collective, and it is only paid while a watchdog is armed."""
     progs = group.__dict__.setdefault("_programs", {})
     fn = progs.get(key)
     if fn is None:
         fn = jax.jit(shard_map(builder, mesh=group.jax_mesh(),
                                in_specs=P("g"), out_specs=P("g")))
         progs[key] = fn
-    return fn
+    dog = _watchdog._DEFAULT[0]
+    if dog is None:
+        return fn
+
+    def watched(*args):
+        with dog.watch(f"comm.{key[0]}[{group.name}]"):
+            out = fn(*args)
+            jax.block_until_ready(out)
+        return out
+
+    return watched
 
 
 def _collective_ready(v, group):

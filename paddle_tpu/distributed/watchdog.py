@@ -21,6 +21,7 @@ from __future__ import annotations
 import collections
 import itertools
 import os
+import sys
 import threading
 import time
 
@@ -29,6 +30,25 @@ from ..analysis.sanitizers import new_lock as _new_lock
 
 class WatchdogTimeout(RuntimeError):
     pass
+
+
+# One optional process-wide watchdog: when set, the eager collectives in
+# distributed/collective.py wrap their program dispatch in a watched,
+# execution-fenced section, so a hung collective is observed without every
+# call site threading a dog through. One slot load when unset.
+_DEFAULT = [None]
+
+
+def set_default_watchdog(dog):
+    """Install (or clear, with None) the process-wide watchdog the eager
+    collective layer arms itself with. Returns the previous one."""
+    prev = _DEFAULT[0]
+    _DEFAULT[0] = dog
+    return prev
+
+
+def default_watchdog():
+    return _DEFAULT[0]
 
 
 _TRACE = None
@@ -83,11 +103,23 @@ class CommWatchdog:
                     fired.add(wid)
                     self.timed_out.append(desc)
                     self._flight_dump(desc)
-                    if self.on_timeout is not None:
-                        self.on_timeout(desc, self.dump())
-                    else:
-                        print(f"[comm watchdog] {desc} exceeded "
-                              f"{self.timeout}s\n{self.dump()}")
+                    try:
+                        if self.on_timeout is not None:
+                            self.on_timeout(desc, self.dump())
+                        else:
+                            print(f"[comm watchdog] {desc} exceeded "
+                                  f"{self.timeout}s\n{self.dump()}")
+                    except Exception as e:  # noqa: BLE001 - a failing
+                        # timeout callback (e.g. a recovery with no
+                        # restore target) must not kill the scanner —
+                        # later hangs still need an observer — but the
+                        # failure must not vanish either
+                        import traceback
+
+                        print(f"[comm watchdog] on_timeout callback for "
+                              f"{desc} raised {type(e).__name__}: {e}\n"
+                              f"{traceback.format_exc()}",
+                              file=sys.stderr)
 
     def _flight_dump(self, desc):
         """Write the trace flight recorder (open spans = the hang

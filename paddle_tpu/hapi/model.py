@@ -127,7 +127,18 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
+            accumulate_grad_batches=1, num_iters=None, checkpoint=None,
+            checkpoint_freq=1, resume=True):
+        """``checkpoint`` (a ``paddle_tpu.checkpoint.CheckpointManager``
+        or a directory path) turns on resumable training: every
+        ``checkpoint_freq`` trained batches the network + optimizer state,
+        the RNG key and the exact (epoch, batch) cursor are snapshotted
+        asynchronously (digest-verified, atomically committed); with
+        ``resume`` (default) a restarted ``fit()`` reloads the newest
+        valid checkpoint and fast-forwards the loader to the saved
+        cursor. Resume-determinism requires a deterministically ordered
+        loader (``shuffle=False`` or a seeded sampler) — see
+        docs/checkpoint.md."""
         loader = self._loader(train_data, batch_size, shuffle, num_workers,
                               drop_last=drop_last)
         eval_loader = self._loader(eval_data, batch_size, False, num_workers)
@@ -136,16 +147,76 @@ class Model:
                                 log_freq=log_freq, verbose=verbose,
                                 save_freq=save_freq, save_dir=save_dir,
                                 metrics=self._metrics_name())
+        ckpt_mgr = self._ckpt_manager(checkpoint)
+        own_mgr = ckpt_mgr is not None and ckpt_mgr is not checkpoint
+        if ckpt_mgr is not None and shuffle \
+                and not isinstance(train_data, DataLoader):
+            import warnings
+
+            warnings.warn(
+                "Model.fit(checkpoint=...) with shuffle=True: the resume "
+                "cursor fast-forwards a RESHUFFLED loader, so a resumed "
+                "run trains different batches than the interrupted one. "
+                "Pass shuffle=False (or a deterministically seeded "
+                "loader) for the resume-determinism contract "
+                "(docs/checkpoint.md).", stacklevel=2)
+        start_epoch = 0
+        skip_steps = 0
+        it_count = 0
+        if ckpt_mgr is not None and not resume \
+                and ckpt_mgr.latest_step() is not None:
+            # fresh run over a directory holding prior commits: purge
+            # them — saves are skip-if-committed (atomicity), so stale
+            # steps would otherwise shadow this run's snapshots
+            ckpt_mgr.clear()
+        if ckpt_mgr is not None and resume \
+                and ckpt_mgr.latest_step() is not None:
+            cursor = self._apply_checkpoint(ckpt_mgr.restore_latest_valid())
+            start_epoch = cursor["epoch"]
+            skip_steps = cursor["step_in_epoch"]
+            it_count = cursor["iteration"]
+            if steps is not None and skip_steps >= steps:
+                # the checkpoint landed on the epoch's FINAL batch:
+                # resume at the next epoch instead of draining an empty
+                # fast-forward that would re-fire epoch-end callbacks
+                # (and re-run eval) for the already-completed epoch
+                start_epoch += 1
+                skip_steps = 0
         self.stop_training = False
         cbks.on_train_begin()
-        it_count = 0
-        for epoch in range(epochs):
+        try:
+            self._fit_loop(loader, eval_loader, cbks, epochs, start_epoch,
+                           skip_steps, it_count, eval_freq, verbose,
+                           accumulate_grad_batches, num_iters, ckpt_mgr,
+                           checkpoint_freq)
+        finally:
+            # the fit-owned writer thread must stop (and a failed async
+            # write surface) even when training itself raised
+            if ckpt_mgr is not None:
+                try:
+                    ckpt_mgr.wait()
+                finally:
+                    if own_mgr:
+                        ckpt_mgr.close()
+
+    def _fit_loop(self, loader, eval_loader, cbks, epochs, start_epoch,
+                  skip_steps, it_count, eval_freq, verbose,
+                  accumulate_grad_batches, num_iters, ckpt_mgr,
+                  checkpoint_freq):
+        for epoch in range(start_epoch, epochs):
             for m in self._metrics:
                 m.reset()
             cbks.on_epoch_begin(epoch)
             logs = {}
             it = iter(loader)
             step = 0
+            if epoch == start_epoch and skip_steps:
+                # resume cursor: fast-forward the already-trained batches
+                # of the interrupted epoch (deterministic order contract)
+                for _ in range(skip_steps):
+                    next(it, _END)
+                step = skip_steps
+                skip_steps = 0
             while True:
                 # train.step root + dataload stage; train_batch adds the
                 # forward/backward/optimizer stages under the same root.
@@ -164,6 +235,13 @@ class Model:
                     logs = self._make_logs(res)
                     cbks.on_train_batch_end(step, logs)
                 it_count += 1
+                # checkpoints align to accumulation boundaries: a
+                # snapshot between them would drop the accumulated-but-
+                # unapplied grads and break resume-determinism
+                if ckpt_mgr is not None and checkpoint_freq and update \
+                        and it_count % checkpoint_freq == 0:
+                    self._save_checkpoint(ckpt_mgr, it_count, epoch,
+                                          step + 1)
                 if num_iters is not None and it_count >= num_iters:
                     self.stop_training = True
                     break
@@ -175,6 +253,112 @@ class Model:
             if self.stop_training:
                 break
         cbks.on_train_end()
+
+    # -- resumable-fit checkpoint plumbing ------------------------------------
+    @staticmethod
+    def _ckpt_manager(checkpoint):
+        if checkpoint is None:
+            return None
+        from ..checkpoint import CheckpointManager
+
+        if isinstance(checkpoint, CheckpointManager):
+            return checkpoint
+        return CheckpointManager(checkpoint)
+
+    @staticmethod
+    def _flatten_tree(prefix, tree, arrays, scalars):
+        """dict tree -> flat {prefix/path: leaf}; tensor-like leaves go to
+        ``arrays``, JSON-able leaves to ``scalars``."""
+        for key, val in tree.items():
+            path = f"{prefix}/{key}"
+            if isinstance(val, dict):
+                Model._flatten_tree(path, val, arrays, scalars)
+            elif isinstance(val, Tensor):
+                arrays[path] = val.value
+            elif hasattr(val, "shape") and hasattr(val, "dtype"):
+                arrays[path] = val
+            else:
+                scalars[path] = val
+
+    @staticmethod
+    def _unflatten_tree(prefix, arrays, scalars):
+        nested = {}
+        for src in (arrays, scalars):
+            for path, val in src.items():
+                if not path.startswith(prefix + "/"):
+                    continue
+                parts = path[len(prefix) + 1:].split("/")
+                cur = nested
+                for part in parts[:-1]:
+                    cur = cur.setdefault(part, {})
+                cur[parts[-1]] = val
+        return nested
+
+    def _save_checkpoint(self, mgr, iteration, epoch, step_in_epoch):
+        import jax
+
+        from ..framework import random as _rng
+        from ..optimizer.lr import LRScheduler
+
+        arrays, scalars = {}, {}
+        self._flatten_tree("net", self.network.state_dict(), arrays,
+                           scalars)
+        opt = self._optimizer
+        if opt is not None:
+            # optimizer state is keyed STRUCTURALLY (parameter position),
+            # not by p.name — auto-names ride a process-global counter,
+            # so a fresh model instance could never match them back
+            for i, p in enumerate(opt._parameter_list_flat()):
+                for k, v in (opt._accumulators.get(id(p)) or {}).items():
+                    arrays[f"opt/acc/{i}/{k}"] = v
+                mw = opt._master_weights.get(id(p))
+                if mw is not None:
+                    arrays[f"opt/master/{i}"] = mw
+            scalars["opt/@step"] = opt._step_count
+            if isinstance(opt._learning_rate, LRScheduler):
+                scalars["opt/@lr"] = opt._learning_rate.state_dict()
+        arrays["rng/key"] = np.asarray(
+            jax.random.key_data(_rng.get_rng_state()))
+        mgr.save(iteration, arrays,
+                 meta={"epoch": epoch, "step_in_epoch": step_in_epoch,
+                       "iteration": iteration, "scalars": scalars})
+
+    def _apply_checkpoint(self, rc):
+        import jax
+        import jax.numpy as jnp
+
+        from ..framework import random as _rng
+        from ..optimizer.lr import LRScheduler
+
+        scalars = rc.meta.get("scalars", {})
+        self.network.set_state_dict(
+            self._unflatten_tree("net", rc.arrays, scalars))
+        opt = self._optimizer
+        if opt is not None:
+            for i, p in enumerate(opt._parameter_list_flat()):
+                acc = opt._init_state(p)
+                found = False
+                for k in list(acc):
+                    v = rc.arrays.get(f"opt/acc/{i}/{k}")
+                    if v is not None:
+                        acc[k] = jnp.asarray(np.asarray(v))
+                        found = True
+                if found:
+                    opt._accumulators[id(p)] = opt._apply_shard_fn(p, acc)
+                mw = rc.arrays.get(f"opt/master/{i}")
+                if mw is not None:
+                    opt._master_weights[id(p)] = jnp.asarray(
+                        np.asarray(mw))
+            opt._step_count = int(scalars.get("opt/@step", 0))
+            if isinstance(opt._learning_rate, LRScheduler) \
+                    and scalars.get("opt/@lr"):
+                opt._learning_rate.set_state_dict(scalars["opt/@lr"])
+        key = rc.arrays.get("rng/key")
+        if key is not None:
+            _rng.set_rng_state(jax.random.wrap_key_data(jnp.asarray(key)))
+        return {"epoch": int(rc.meta.get("epoch", 0)),
+                "step_in_epoch": int(rc.meta.get("step_in_epoch", 0)),
+                "iteration": int(rc.meta.get("iteration", rc.step))}
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, num_samples=None, _inner=False):

@@ -14,4 +14,5 @@ from .sampler import (  # noqa: F401
     BatchSampler, DistributedBatchSampler, RandomSampler, Sampler, SequenceSampler,
     SubsetRandomSampler, WeightedRandomSampler,
 )
-from .dataloader import DataLoader, default_collate_fn, get_worker_info  # noqa: F401
+from .dataloader import (CursorLoader, DataLoader,  # noqa: F401
+                         default_collate_fn, get_worker_info)

@@ -15,6 +15,7 @@ from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
+from ..analysis import faultinject as _fi
 from ..analysis import sanitizers as _san
 from ..framework.core import Tensor
 from .dataset import IterableDataset
@@ -82,6 +83,73 @@ def _to_device(batch, to_tensor=True):
         out = [_to_device(v) for v in batch]
         return out if isinstance(batch, list) else tuple(out)
     return batch
+
+
+class CursorLoader:
+    """A resumable batch stream with an EXACT integer cursor — the
+    dataloader half of the checkpoint resume-determinism contract
+    (docs/checkpoint.md).
+
+    Wraps any deterministically-ordered loader/iterable and yields its
+    batches forever (cycling epochs), counting every batch yielded. The
+    cursor (``state_dict()``) rides each training checkpoint;
+    ``set_state_dict()`` rewinds by re-iterating from the start and
+    skipping exactly ``cursor`` batches, so the batch a restored step
+    sees is the batch the original step saw. The wrapped loader must
+    produce the same order every pass (``shuffle=False``, or a
+    deterministic seeded sampler) — resume-determinism is only as strong
+    as the underlying order.
+    """
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.cursor = 0        # total batches yielded across epochs
+        self.epoch = 0
+        self._it = None
+
+    def state_dict(self):
+        return {"cursor": self.cursor, "epoch": self.epoch}
+
+    def set_state_dict(self, state):
+        """Rewind to an exact cursor: restart the underlying loader and
+        fast-forward ``cursor`` batches (deterministic order required).
+        Completed epochs of a SIZED loader are skipped arithmetically —
+        only the partial epoch's batches are actually re-fetched, so a
+        deep resume costs O(batches into the current epoch), not
+        O(total batches ever trained)."""
+        target = int(state["cursor"])
+        self.cursor = 0
+        self.epoch = 0
+        self._it = None
+        try:
+            per_epoch = len(self.loader)
+        except TypeError:          # unsized (IterableDataset): replay all
+            per_epoch = 0
+        if per_epoch > 0:
+            self.epoch, remainder = divmod(target, per_epoch)
+            self.cursor = target - remainder
+        for _ in range(target - self.cursor):
+            self._advance()
+
+    def _advance(self):
+        if self._it is None:
+            self._it = iter(self.loader)
+        try:
+            batch = next(self._it)
+        except StopIteration:
+            self.epoch += 1
+            self._it = iter(self.loader)
+            batch = next(self._it)     # an empty loader IS an error
+        self.cursor += 1
+        return batch
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        # the drillable data-pipeline hazard (kill/stall mid-epoch)
+        _fi.fire("data.next")
+        return self._advance()
 
 
 class DataLoader:

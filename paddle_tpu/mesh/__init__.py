@@ -20,7 +20,10 @@ Pieces (docs/distributed.md):
   (cross-replica weight-update sharding, arXiv 2004.13336);
 - :mod:`~paddle_tpu.mesh.parallelize` — lowers fleet hybrid configs
   (dp_degree/mp_degree) onto mesh axes and runs the real train step
-  under ``shard_map`` with donated sharded state.
+  under ``shard_map`` with donated sharded state;
+- :mod:`~paddle_tpu.mesh.trainer` — ``MeshTrainer``: fault-tolerant
+  training on top of ``parallelize`` (async sharded checkpoints, step
+  watchdog, drilled warm recovery with a bounded fit() retry loop).
 """
 from .context import (MeshContext, bootstrap_virtual_devices,  # noqa: F401
                       current_mesh_context, spec_for_placements)
@@ -28,6 +31,7 @@ from .spmd_rules import (ReshardFault, disable_propagation,  # noqa: F401
                          enable_propagation, propagate, rule_for,
                          sharding_rule)
 from .parallelize import MeshParallel, build_mesh_step, parallelize  # noqa: F401
+from .trainer import MeshTrainer, TrainStepSuperseded  # noqa: F401
 
 __all__ = [
     "MeshContext", "bootstrap_virtual_devices", "current_mesh_context",
@@ -35,4 +39,5 @@ __all__ = [
     "sharding_rule", "rule_for", "propagate", "enable_propagation",
     "disable_propagation", "ReshardFault",
     "MeshParallel", "build_mesh_step", "parallelize",
+    "MeshTrainer", "TrainStepSuperseded",
 ]

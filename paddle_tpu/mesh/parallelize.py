@@ -265,6 +265,9 @@ class MeshParallel:
         self._pv, self._av, self._mv = state_fn()
         self._acc_keys = [sorted(optimizer._accumulators[id(p)].keys())
                           for p in self.params]
+        by_id = {id(p): n for n, p in model.named_parameters()}
+        self.param_names = [by_id.get(id(p), f"param_{i}")
+                            for i, p in enumerate(self.params)]
         self._steps = 0
         self._collectives = None
         self._mon = None
@@ -355,6 +358,21 @@ class MeshParallel:
                 attrs.update(self.collective_counts(*batch))
                 _m.trace.record_span("comm.mesh_step", t0, t1, attrs=attrs)
         return Tensor(loss)
+
+    def set_state(self, pv, av, mv):
+        """Replace the step's donated state lists (params / accumulators /
+        masters) — the warm-restart hook: the compiled program and its
+        shardings survive, only the VALUES change. Callers (the
+        checkpoint restore path) must hand back arrays already placed
+        with the same mesh shardings ``state_fn()`` committed, or the
+        next step pays a one-time layout recompile."""
+        if (len(pv) != len(self._pv)
+                or [len(r) for r in av] != [len(r) for r in self._av]
+                or len(mv) != len(self._mv)):
+            raise ValueError(
+                "set_state: structure mismatch with the live step state")
+        self._pv, self._av, self._mv = list(pv), [list(r) for r in av], \
+            list(mv)
 
     def finalize(self):
         """Write the trained values back onto the live Parameter/Optimizer

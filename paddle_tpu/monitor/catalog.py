@@ -23,7 +23,7 @@ framework.
 
 # Subsystems a metric may belong to (the <subsystem> token of the name).
 SUBSYSTEMS = ("dispatch", "jit", "serving", "kv", "dataloader", "monitor",
-              "mesh", "comm")
+              "mesh", "comm", "ckpt", "train")
 
 NAME_PATTERN = (
     r"^paddle_tpu_(" + "|".join(SUBSYSTEMS) + r")_[a-z][a-z0-9_]*$"
@@ -191,6 +191,25 @@ METRICS = {
         "Per-replica optimizer-state bytes of the active mesh train step "
         "— the ZeRO-1 lever: shard_optimizer=True shrinks this ~1/dp vs "
         "the replicated layout."),
+    # -- training checkpoints (checkpoint/manager.py) --------------------
+    "paddle_tpu_ckpt_saves_total": (
+        "counter", (),
+        "Checkpoints COMMITTED (atomic rename landed) by the async "
+        "writer thread — a torn or failed write never counts."),
+    "paddle_tpu_ckpt_bytes": (
+        "gauge", (),
+        "Total shard + manifest bytes of the most recently committed "
+        "checkpoint."),
+    "paddle_tpu_ckpt_save_seconds": (
+        "histogram", (),
+        "Wall time of one checkpoint save, from the step thread's "
+        "device->host copy to the atomic commit, seconds."),
+    # -- fault-tolerant training (mesh/trainer.py) -----------------------
+    "paddle_tpu_train_recoveries_total": (
+        "counter", (),
+        "MeshTrainer recover() passes (train-step death, watchdog-"
+        "detected hang, or manual drill): epoch bump, flight dump, warm "
+        "state reload from the last committed checkpoint."),
     # -- eager collectives (distributed/collective.py) -------------------
     "paddle_tpu_comm_collectives_total": (
         "counter", ("op",),
@@ -231,7 +250,7 @@ def spec(name):
 
 # Subsystems a span may belong to (the first dotted token of the name).
 SPAN_SUBSYSTEMS = ("dispatch", "jit", "serving", "dataloader", "train",
-                   "comm", "monitor", "mesh")
+                   "comm", "monitor", "mesh", "ckpt")
 
 SPAN_PATTERN = (
     r"^(" + "|".join(SPAN_SUBSYSTEMS)
@@ -314,6 +333,20 @@ SPANS = {
     "train.backward": "Backward pass portion of a training step.",
     "train.optimizer": (
         "Optimizer step + clear_grad portion of a training step."),
+    "train.recover": (
+        "One MeshTrainer warm-recovery pass (mesh/trainer.py): epoch "
+        "bump, flight dump naming the stuck span + the step program's "
+        "collective census, state reload from the last committed "
+        "checkpoint. attrs: reason, stuck, restored_step."),
+    # -- training checkpoints (checkpoint/manager.py) --------------------
+    "ckpt.save": (
+        "One checkpoint save, recorded at commit time on the writer "
+        "thread (the step thread only paid the device->host copy). "
+        "attrs: step, shards, bytes."),
+    "ckpt.restore": (
+        "One digest-verified checkpoint restore (shard re-hash + host "
+        "assembly; the trainer re-shards ZeRO rows onto the current dp "
+        "degree afterwards). attrs: step, shards, bytes."),
     # -- distributed (distributed/watchdog.py) ---------------------------
     "comm.wait": (
         "Blocking collective/host wait watched by CommWatchdog — open "

@@ -1,22 +1,72 @@
-"""The repo's flaky-budget helper: retry a wall-clock-sensitive smoke
-assertion up to N times.
+"""The repo's ONE flaky-budget gate for wall-clock-sensitive smokes.
 
 Tier-1 runs on shared CPU runners, so any assertion comparing two measured
-wall clocks (serving speedup vs static, chaos goodput ratio, spec speedup)
-can lose a run to scheduler contention. The discipline (PR 6/7): every run
-must pass its own HARD bounds (bit-exactness, typed-rejection counts —
-asserted inside the bench worker, a non-zero exit fails immediately), and
-only the wall-clock RATIO gets up to three attempts.
+wall clocks (serving speedup vs static, chaos goodput ratio, spec speedup,
+train-chaos recovery latency) can lose a run to scheduler contention. The
+discipline (PR 6/7, hardened here): every run must pass its own HARD
+bounds (bit-exactness, typed-rejection counts, recovery correctness —
+asserted inside the bench worker), while the wall-clock bars route through
+THIS module instead of per-test retry tuning:
+
+- :func:`retry_smoke` re-runs an attempt whether the accept predicate
+  fails OR the attempt itself raises — a worker whose in-process
+  wall-clock bound tripped under contention (non-zero exit -> the runner
+  asserts and raises) consumes a retry instead of failing the test on its
+  first unlucky run (the PR 7 flake);
+- :func:`wall_clock_floor` is the contention-aware floor: the full bar on
+  a quiet runner, a relaxed-but-still-meaningful bar when the machine is
+  oversubscribed (load average per core above ``threshold``); tests
+  assert against the SAME floor the accept predicate used, so the bar and
+  the gate can never drift apart;
+- attempt counts scale with contention too (3 quiet, 5 oversubscribed).
 """
+import os
 
 
-def retry_smoke(run, accept, attempts=3):
-    """Call ``run()`` up to ``attempts`` times until ``accept(result)`` is
-    truthy; returns the last result (the caller asserts on it, so the final
-    failure message shows the real measured values)."""
+def contention_factor():
+    """Runnable load per core (1-minute load average / cpu count); > 1
+    means more runnable work than cores. 0.0 where loadavg is
+    unavailable."""
+    try:
+        load = os.getloadavg()[0]
+    except (AttributeError, OSError):
+        return 0.0
+    return load / max(os.cpu_count() or 1, 1)
+
+
+def contended(threshold=1.5):
+    """True when the runner is oversubscribed past ``threshold`` runnable
+    threads per core — the regime where wall-clock ratios stop measuring
+    the code under test."""
+    return contention_factor() > threshold
+
+
+def wall_clock_floor(base, relaxed, threshold=1.5):
+    """The single contention-aware floor for a wall-clock bar: ``base``
+    on a quiet runner, ``relaxed`` on an oversubscribed one. Use the SAME
+    returned value in the retry accept predicate and the final assert."""
+    return relaxed if contended(threshold) else base
+
+
+def retry_smoke(run, accept, attempts=None):
+    """Call ``run()`` until ``accept(result)`` is truthy, up to
+    ``attempts`` times (default 3; 5 when the runner is contended). A
+    raising attempt (a bench worker's own in-process wall-clock bound
+    tripping exits non-zero and the runner asserts) consumes a retry; the
+    LAST attempt's raise propagates, and the last result is returned even
+    when not accepted so the caller's assert shows the real measured
+    values."""
+    if attempts is None:
+        attempts = 5 if contended() else 3
     result = None
-    for _ in range(attempts):
-        result = run()
+    for i in range(attempts):
+        last = i == attempts - 1
+        try:
+            result = run()
+        except Exception:
+            if last:
+                raise
+            continue
         if accept(result):
             break
     return result

@@ -1,0 +1,362 @@
+"""paddle_tpu.checkpoint (ISSUE 10): async digest-verified sharded
+checkpoints with atomic commit, bounded retention, dp-elastic ZeRO
+restore, the resumable dataloader cursor, the hapi Model.fit resume path,
+and the tools/ckpt_inspect.py CLI contract.
+"""
+import glob
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor
+from paddle_tpu.analysis import faultinject as fi
+from paddle_tpu.checkpoint import (CheckpointCorrupt, CheckpointManager,
+                                   NoCheckpoint, verify_checkpoint)
+from paddle_tpu.io import CursorLoader, DataLoader, Dataset
+from paddle_tpu.monitor import trace
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fi.reset()
+    yield
+    fi.reset()
+
+
+def _state(seed=0, n=24):
+    r = np.random.RandomState(seed)
+    arrays = {
+        "param/w": r.randn(4, 6).astype("float32"),
+        "param/b": r.randn(6).astype("float32"),
+        "rng/key": np.array([seed, seed + 1], np.uint32),
+    }
+    flat = r.randn(n).astype("float32")
+    k8 = -(-n // 8)
+    padded = np.concatenate([flat, np.zeros(8 * k8 - n, np.float32)])
+    zero = {"acc/w/m": (padded.reshape(8, k8), n)}
+    return arrays, zero, flat
+
+
+class TestSaveRestore:
+    def test_round_trip_and_manifest(self, tmp_path):
+        arrays, zero, flat = _state()
+        m = CheckpointManager(tmp_path, keep=3)
+        m.save(3, arrays, zero=zero, meta={"loss_scale": 128.0,
+                                           "data_cursor": {"cursor": 7}},
+               block=True)
+        assert m.steps() == [3]
+        rc = m.restore()
+        assert rc.step == 3
+        for k in ("param/w", "param/b", "rng/key"):
+            assert np.array_equal(rc.arrays[k], arrays[k])
+        assert np.array_equal(rc.zero["acc/w/m"], flat)
+        assert rc.meta["loss_scale"] == 128.0
+        assert rc.meta["data_cursor"] == {"cursor": 7}
+        # the manifest is the inspection contract: per-shard digests,
+        # bytes, kinds
+        doc = verify_checkpoint(rc.path)
+        assert doc["step"] == 3
+        ent = doc["entries"]["acc/w/m"]
+        assert ent["kind"] == "zero" and ent["dp"] == 8
+        assert len(ent["shards"]) == 8
+        assert all(sh["digest"] and sh["bytes"] > 0
+                   for sh in ent["shards"])
+
+    def test_zero_reshard_dp8_to_dp4_and_dp1(self, tmp_path):
+        arrays, zero, flat = _state(n=26)   # deliberately not divisible
+        m = CheckpointManager(tmp_path)
+        m.save(1, arrays, zero=zero, block=True)
+        rc = m.restore()
+        for dp in (8, 4, 2, 1):
+            rows = rc.zero_sharded("acc/w/m", dp)
+            k = -(-26 // dp)
+            assert rows.shape == (dp, k)
+            assert np.array_equal(rows.reshape(-1)[:26], flat)
+            assert not rows.reshape(-1)[26:].any()   # zero padding
+
+    def test_async_save_does_not_block_the_step_thread(self, tmp_path):
+        """The no-blocking property: with the writer stalled (delay fault
+        at ckpt.write), save() still returns promptly — only the host
+        copy rides the caller; encode+fsync+commit ride the writer."""
+        arrays, zero, _ = _state()
+        m = CheckpointManager(tmp_path)
+        fi.arm("ckpt.write", action="delay", delay_s=0.5, nth=1, times=1)
+        t0 = time.perf_counter()
+        m.save(1, arrays, zero=zero)          # writer sleeps 0.5s
+        m.save(2, arrays, zero=zero)          # stages into the 2nd buffer
+        dt = time.perf_counter() - t0
+        assert dt < 0.4, f"save() blocked on the writer ({dt:.2f}s)"
+        m.wait()
+        assert m.steps() == [1, 2]
+
+    def test_atomic_commit_rejects_torn_write(self, tmp_path):
+        """A writer killed mid-save (raise at ckpt.write) leaves NO
+        committed step — only an ignored temp dir — and restore falls
+        back to the previous commit."""
+        arrays, zero, _ = _state()
+        m = CheckpointManager(tmp_path)
+        m.save(1, arrays, zero=zero, block=True)
+        fi.arm("ckpt.write", action="raise", nth=1)
+        m.save(2, arrays, zero=zero)
+        with pytest.raises(Exception, match="injected fault"):
+            m.wait()
+        assert m.steps() == [1]               # step 2 never committed
+        rc = m.restore_latest_valid()
+        assert rc.step == 1
+        # a fresh manager cleans the stale temp dir
+        CheckpointManager(tmp_path)
+        assert not [p for p in os.listdir(tmp_path)
+                    if p.startswith(".tmp-")]
+
+    def test_corrupted_digest_rejected_with_fallback(self, tmp_path):
+        """flag at ckpt.write corrupts one shard's bytes AFTER its digest
+        was recorded: restore() must reject the checkpoint and
+        restore_latest_valid() fall back to the previous commit."""
+        arrays, zero, _ = _state()
+        m = CheckpointManager(tmp_path)
+        m.save(1, arrays, zero=zero, block=True)
+        fi.arm("ckpt.write", action="flag", nth=1)
+        m.save(2, arrays, zero=zero, block=True)
+        assert m.steps() == [1, 2]            # committed, but poisoned
+        with pytest.raises(CheckpointCorrupt, match="digest mismatch"):
+            m.restore(2)
+        rc = m.restore_latest_valid()
+        assert rc.step == 1
+        assert rc.meta is not None
+
+    def test_on_disk_corruption_detected(self, tmp_path):
+        arrays, zero, _ = _state()
+        m = CheckpointManager(tmp_path)
+        m.save(5, arrays, zero=zero, block=True)
+        shard = sorted(glob.glob(
+            os.path.join(str(tmp_path), "step_00000005", "s*.npy")))[0]
+        blob = open(shard, "rb").read()
+        with open(shard, "wb") as f:
+            f.write(blob[:-1] + bytes([blob[-1] ^ 0xFF]))
+        with pytest.raises(CheckpointCorrupt, match="digest mismatch"):
+            m.restore()
+        with pytest.raises(NoCheckpoint):
+            m.restore_latest_valid()          # nothing valid left
+
+    def test_prepare_copies_never_alias_device_buffers(self, tmp_path):
+        """The snapshot host copy must be a REAL copy: np.asarray of a
+        jax CPU array can alias the device buffer zero-copy, and the
+        caller's next DONATED step would overwrite it while the writer
+        thread is still encoding — a corrupted checkpoint under a valid
+        digest."""
+        import jax.numpy as jnp
+
+        m = CheckpointManager(tmp_path)
+        x = jnp.arange(8, dtype=jnp.float32)
+        z = jnp.ones((4, 2), jnp.float32)
+        job = m._prepare(1, {"x": x}, {"z": (z, 8)}, {})
+        assert not np.shares_memory(job["full"]["x"][0], np.asarray(x))
+        assert not np.shares_memory(job["zero"]["z"][0], np.asarray(z))
+
+    def test_retention_keeps_newest(self, tmp_path):
+        arrays, zero, _ = _state()
+        m = CheckpointManager(tmp_path, keep=2)
+        for s in (1, 2, 3, 4):
+            m.save(s, arrays, zero=zero, block=True)
+        assert m.steps() == [3, 4]
+
+    def test_recommit_keeps_existing_commit(self, tmp_path):
+        """Re-saving an already-committed step is a no-op: a
+        deterministic replay reproduces the same bytes, and a
+        delete-then-rewrite would open a crash window that can destroy a
+        good commit."""
+        m = CheckpointManager(tmp_path)
+        m.save(1, {"x": np.zeros(4, np.float32)}, block=True)
+        m.save(1, {"x": np.ones(4, np.float32)}, block=True)
+        assert np.array_equal(m.restore(1).arrays["x"],
+                              np.zeros(4, np.float32))
+
+    def test_clear_purges_committed_steps(self, tmp_path):
+        arrays, zero, _ = _state()
+        m = CheckpointManager(tmp_path)
+        for s in (1, 2):
+            m.save(s, arrays, zero=zero, block=True)
+        m.clear()
+        assert m.steps() == []
+        with pytest.raises(NoCheckpoint):
+            m.restore()
+
+    def test_restore_missing_step_raises(self, tmp_path):
+        m = CheckpointManager(tmp_path)
+        with pytest.raises(NoCheckpoint):
+            m.restore()
+        arrays, zero, _ = _state()
+        m.save(1, arrays, zero=zero, block=True)
+        with pytest.raises(NoCheckpoint):
+            m.restore(9)
+
+    def test_bfloat16_round_trip(self, tmp_path):
+        import ml_dtypes
+
+        a = np.arange(8, dtype=np.float32).astype(ml_dtypes.bfloat16)
+        m = CheckpointManager(tmp_path)
+        m.save(1, {"x": a}, block=True)
+        rc = m.restore()
+        assert rc.arrays["x"].dtype == ml_dtypes.bfloat16
+        assert np.array_equal(rc.arrays["x"].view(np.uint16),
+                              a.view(np.uint16))
+
+    def test_ckpt_restore_fault_point_fires(self, tmp_path):
+        arrays, zero, _ = _state()
+        m = CheckpointManager(tmp_path)
+        m.save(1, arrays, zero=zero, block=True)
+        fi.arm("ckpt.restore", action="raise", nth=1)
+        with pytest.raises(Exception, match="injected fault"):
+            m.restore()
+        assert ("ckpt.restore", "raise") in fi.trips()
+
+    def test_save_telemetry(self, tmp_path):
+        mon_was, trace_was = monitor.enabled(), trace.enabled()
+        monitor.enable()
+        trace.enable()
+        try:
+            arrays, zero, _ = _state()
+            m = CheckpointManager(tmp_path)
+            m.save(1, arrays, zero=zero, block=True)
+            m.restore()
+            snap = monitor.snapshot()
+            mets = snap["metrics"]
+            assert mets["paddle_tpu_ckpt_saves_total"]["values"][""] >= 1
+            assert mets["paddle_tpu_ckpt_bytes"]["values"][""] > 0
+            names = [s.name for s in trace.spans()]
+            assert "ckpt.save" in names
+            assert "ckpt.restore" in names
+        finally:
+            if not trace_was:
+                trace.disable()
+            if not mon_was:
+                monitor.disable()
+
+
+class _SeqDataset(Dataset):
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        r = np.random.RandomState(i)
+        return r.randn(4).astype("float32"), r.randn(2).astype("float32")
+
+
+class TestCursorLoader:
+    def _loader(self):
+        return CursorLoader(DataLoader(_SeqDataset(), batch_size=2,
+                                       shuffle=False))
+
+    def test_cursor_round_trip_across_epochs(self):
+        cl = self._loader()
+        for _ in range(6):                    # 4 per epoch: into epoch 2
+            next(cl)
+        st = cl.state_dict()
+        assert st == {"cursor": 6, "epoch": 1}
+        nxt = np.asarray(next(cl)[0].numpy())
+
+        cl2 = self._loader()
+        cl2.set_state_dict(st)
+        assert cl2.cursor == 6
+        assert np.array_equal(np.asarray(next(cl2)[0].numpy()), nxt)
+
+    def test_data_next_fault_point(self):
+        cl = self._loader()
+        next(cl)
+        fi.arm("data.next", action="raise", nth=1)
+        with pytest.raises(Exception, match="injected fault"):
+            next(cl)
+        assert ("data.next", "raise") in fi.trips()
+
+
+class TestModelFitResume:
+    def _make_model(self):
+        paddle.seed(0)
+        net = paddle.nn.Sequential(paddle.nn.Linear(4, 8),
+                                   paddle.nn.ReLU(),
+                                   paddle.nn.Linear(8, 2))
+        m = paddle.Model(net)
+        m.prepare(optimizer=paddle.optimizer.Adam(
+                      learning_rate=1e-2, parameters=net.parameters()),
+                  loss=paddle.nn.MSELoss())
+        return m
+
+    @staticmethod
+    def _params(m):
+        return {k: np.asarray(v.value)
+                for k, v in m.network.state_dict().items()}
+
+    def test_interrupted_fit_resumes_bit_identical(self, tmp_path):
+        ref = self._make_model()
+        ref.fit(_SeqDataset(), batch_size=2, epochs=2, shuffle=False,
+                verbose=0)
+        p_ref = self._params(ref)
+
+        d = str(tmp_path)
+        m1 = self._make_model()
+        m1.fit(_SeqDataset(), batch_size=2, epochs=2, shuffle=False,
+               verbose=0, num_iters=6, checkpoint=d, checkpoint_freq=2)
+        m2 = self._make_model()                # FRESH network + optimizer
+        m2.fit(_SeqDataset(), batch_size=2, epochs=2, shuffle=False,
+               verbose=0, checkpoint=d, checkpoint_freq=2)
+        p_got = self._params(m2)
+        assert set(p_ref) == set(p_got)
+        for k in p_ref:
+            assert np.array_equal(p_ref[k], p_got[k]), k
+
+    def test_fresh_dir_trains_from_scratch(self, tmp_path):
+        m = self._make_model()
+        m.fit(_SeqDataset(), batch_size=2, epochs=1, shuffle=False,
+              verbose=0, checkpoint=str(tmp_path), checkpoint_freq=2)
+        mgr = CheckpointManager(str(tmp_path))
+        assert mgr.latest_step() == 4          # 4 batches/epoch, freq 2
+
+
+class TestCkptInspectCLI:
+    def _save_one(self, tmp_path):
+        arrays, zero, _ = _state()
+        CheckpointManager(tmp_path).save(
+            7, arrays, zero=zero, meta={"loss_scale": None}, block=True)
+
+    def _run(self, *args):
+        return subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools",
+                                          "ckpt_inspect.py"), *args],
+            capture_output=True, text=True, timeout=120, cwd=ROOT)
+
+    def test_prints_and_verifies(self, tmp_path):
+        self._save_one(tmp_path)
+        out = self._run(str(tmp_path))
+        assert out.returncode == 0, out.stderr
+        assert "step 7" in out.stdout and "verified" in out.stdout
+        assert "blake2b:" in out.stdout and "zero" in out.stdout
+        doc = json.loads(self._run(str(tmp_path), "--json").stdout)
+        assert doc[0]["step"] == 7
+        assert doc[0]["n_shards"] == 11        # 3 full + 8 zero rows
+        assert all(r["digest"] for r in doc[0]["entries"])
+
+    def test_exit_nonzero_on_corruption(self, tmp_path):
+        self._save_one(tmp_path)
+        shard = sorted(glob.glob(
+            os.path.join(str(tmp_path), "step_00000007", "s*.npy")))[0]
+        blob = open(shard, "rb").read()
+        with open(shard, "wb") as f:
+            f.write(blob[:-1] + bytes([blob[-1] ^ 1]))
+        out = self._run(str(tmp_path))
+        assert out.returncode == 1
+        assert "digest mismatch" in out.stderr
+        # --no-verify still prints the manifest
+        assert self._run(str(tmp_path), "--no-verify").returncode == 0
+
+    def test_exit_nonzero_on_empty_dir(self, tmp_path):
+        out = self._run(str(tmp_path))
+        assert out.returncode == 1
+        assert "no committed checkpoint" in out.stderr

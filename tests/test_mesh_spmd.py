@@ -8,6 +8,8 @@ of the mlp+llama step on the simulated 8-device mesh matching the
 single-device run, with zero post-warmup recompiles under graftsan and
 >= 1 real collective visible in comm.* spans.
 """
+import json
+
 import numpy as np
 import pytest
 
@@ -518,3 +520,315 @@ class TestMeshLlamaAcceptance:
             san.disable("recompile", "hostsync")
             if not tr_was:
                 trace.disable()
+
+
+class TestFaultTolerantTraining:
+    """ISSUE 10: the training twin of the serving resilience layer —
+    kill/hang drills with bit-identical resume from async checkpoints,
+    corrupted-checkpoint fallback, the dp 8->4 elastic restore, and the
+    watchdog over eager collectives."""
+
+    @pytest.fixture(autouse=True)
+    def _clean_faults(self):
+        from paddle_tpu.analysis import faultinject as fi
+
+        fi.reset()
+        yield
+        fi.reset()
+
+    @staticmethod
+    def _batch(seed=0):
+        r = np.random.RandomState(seed)
+        return (r.randn(16, 16).astype("float32"),
+                r.randn(16, 16).astype("float32"))
+
+    def _trainer(self, ckpt_dir, batch, dp=8, shard_optimizer=False, **kw):
+        paddle.seed(0)
+        m = _mlp()
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=m.parameters())
+        return pmesh.MeshTrainer(
+            m, opt, _mse, batch,
+            config={"dp_degree": dp, "shard_optimizer": shard_optimizer},
+            checkpoint=str(ckpt_dir), **kw)
+
+    def test_kill_mid_step_resumes_bit_identical(self, mesh8, tmp_path):
+        """THE kill acceptance drill: the step dies mid-run, recover()
+        reloads the last committed checkpoint WARM (compiled program
+        survives, zero recompiles under the sentinel) and the replayed
+        losses are bit-identical to an uninterrupted run."""
+        from paddle_tpu.analysis import faultinject as fi
+        from paddle_tpu.analysis import sanitizers as san
+
+        batch = self._batch()
+        data = lambda step: batch  # noqa: E731
+        ref = self._trainer(tmp_path / "ref", batch).fit(
+            data, 6, ckpt_every=2)
+
+        t = self._trainer(tmp_path / "chaos", batch)
+        san.reset()
+        san.enable("recompile")
+        fi.arm("mesh.step", action="raise", nth=4)
+        try:
+            t.fit(data, 6, ckpt_every=2)        # warmup compile is step 1
+            compiles = t.handle._jitted._cache_size()
+            assert san.trips() == []
+        finally:
+            san.reset()
+            san.disable("recompile")
+        assert t.losses == ref                  # bit-identical floats
+        assert ("mesh.step", "raise") in fi.trips()
+        assert len(t.recovery_stats) == 1
+        rec = t.recovery_stats[0]
+        assert rec["restored_step"] == 2        # the last committed save
+        assert rec["stuck"] == "mesh.step"
+        assert compiles == 1, "post-recovery recompile (restart not warm)"
+
+    def test_hang_watchdog_recovers_with_coalesced_dump(self, mesh8,
+                                                       tmp_path):
+        """The hang drill: a delayed step trips the CommWatchdog; the
+        scanner thread recovers (epoch bump), the stuck step wakes into
+        the new epoch (TrainStepSuperseded, no state touched), ONE
+        coalesced flight dump names BOTH observers, and the resumed
+        losses are bit-identical."""
+        from paddle_tpu.analysis import faultinject as fi
+
+        batch = self._batch(1)
+        data = lambda step: batch  # noqa: E731
+        ref = self._trainer(tmp_path / "ref", batch).fit(
+            data, 6, ckpt_every=2)
+
+        tr_was = trace.enabled()
+        trace.enable()
+        t = self._trainer(tmp_path / "chaos", batch, hang_timeout=0.4)
+        fi.arm("mesh.step", action="delay", delay_s=1.5, nth=4)
+        try:
+            got = t.fit(data, 6, ckpt_every=2)
+        finally:
+            t.close()
+            if not tr_was:
+                trace.disable()
+        assert got == ref
+        assert len(t.recovery_stats) == 1
+        assert t.last_recovery_dump
+        with open(t.last_recovery_dump) as f:
+            doc = json.load(f)
+        reasons = doc["reasons"]
+        assert any("watchdog timeout" in r for r in reasons), reasons
+        assert any("mesh train recovery" in r for r in reasons), reasons
+        assert t.handle._jitted._cache_size() == 1
+
+    def test_corrupted_checkpoint_falls_back_to_previous(self, mesh8,
+                                                         tmp_path):
+        """The torn/corrupt drill: the newest checkpoint's bytes are
+        poisoned post-digest; a later kill must restore from the
+        PREVIOUS committed step, and still replay bit-identical."""
+        from paddle_tpu.analysis import faultinject as fi
+
+        batch = self._batch(2)
+        data = lambda step: batch  # noqa: E731
+        ref = self._trainer(tmp_path / "ref", batch).fit(
+            data, 6, ckpt_every=2)
+
+        t = self._trainer(tmp_path / "chaos", batch)
+        # writes: anchor(step 0), step 2, step 4(corrupted), then a kill
+        fi.arm("ckpt.write", action="flag", nth=3)
+        fi.arm("mesh.step", action="raise", nth=6)
+        got = t.fit(data, 6, ckpt_every=2)
+        assert got == ref
+        assert len(t.recovery_stats) == 1
+        assert t.recovery_stats[0]["restored_step"] == 2, \
+            t.recovery_stats[0]
+
+    def test_torn_write_never_commits(self, mesh8, tmp_path):
+        """raise at ckpt.write = the writer dies mid-save: the step is
+        never committed; recovery (after a kill) restores the previous
+        commit and records the surfaced write error."""
+        from paddle_tpu.analysis import faultinject as fi
+
+        batch = self._batch(3)
+        data = lambda step: batch  # noqa: E731
+        ref = self._trainer(tmp_path / "ref", batch).fit(
+            data, 6, ckpt_every=2)
+
+        t = self._trainer(tmp_path / "chaos", batch)
+        fi.arm("ckpt.write", action="raise", nth=3)   # step 4's write
+        fi.arm("mesh.step", action="raise", nth=6)
+        got = t.fit(data, 6, ckpt_every=2)
+        assert got == ref
+        rec = t.recovery_stats[0]
+        assert rec["restored_step"] == 2
+        assert rec["write_error"] and "InjectedFault" in rec["write_error"]
+
+    def test_elastic_dp8_to_dp4_restore_continues(self, mesh8, tmp_path):
+        """The elastic drill: a ZeRO-1 dp=8 run checkpoints, a FRESH
+        dp=4 trainer restores from it (per-replica rows gathered and
+        re-sliced onto the new degree) and the continuation's losses
+        match an uninterrupted dp=8 run within fp tolerance."""
+        batch = self._batch(4)
+        data = lambda step: batch  # noqa: E731
+        ckpt = tmp_path / "elastic"
+        t8 = self._trainer(ckpt, batch, dp=8, shard_optimizer=True)
+        t8.fit(data, 3, ckpt_every=1)
+        assert t8.manager.latest_step() == 3
+
+        t4 = self._trainer(ckpt, batch, dp=4, shard_optimizer=True)
+        cont = t4.fit(data, 6, ckpt_every=1)
+        assert t4.step_idx == 6
+        assert sorted(cont) == [3, 4, 5]        # resumed AT step 3
+
+        ref = self._trainer(tmp_path / "ref", batch, dp=8,
+                            shard_optimizer=True).fit(data, 6,
+                                                      ckpt_every=0)
+        np.testing.assert_allclose(
+            [cont[s] for s in (3, 4, 5)], [ref[s] for s in (3, 4, 5)],
+            rtol=2e-4, atol=1e-6)
+
+    def test_elastic_zero_to_plain_restore(self, mesh8, tmp_path):
+        """A ZeRO checkpoint also restores into a plain-DP trainer (rows
+        gathered to full state) — the layout conversion matrix both
+        ways."""
+        batch = self._batch(5)
+        data = lambda step: batch  # noqa: E731
+        ckpt = tmp_path / "mixed"
+        tz = self._trainer(ckpt, batch, dp=8, shard_optimizer=True)
+        tz.fit(data, 2, ckpt_every=1)
+        tp = self._trainer(ckpt, batch, dp=8, shard_optimizer=False)
+        cont = tp.fit(data, 4, ckpt_every=1)
+        ref = self._trainer(tmp_path / "ref", batch, dp=8,
+                            shard_optimizer=True).fit(data, 4,
+                                                      ckpt_every=0)
+        np.testing.assert_allclose(
+            [cont[s] for s in (2, 3)], [ref[s] for s in (2, 3)],
+            rtol=2e-4, atol=1e-6)
+
+    def test_recover_telemetry_and_metrics(self, mesh8, tmp_path):
+        from paddle_tpu.analysis import faultinject as fi
+
+        batch = self._batch(6)
+        data = lambda step: batch  # noqa: E731
+        mon_was, tr_was = monitor.enabled(), trace.enabled()
+        monitor.enable()
+        trace.enable()
+        t = self._trainer(tmp_path / "tele", batch)
+        fi.arm("mesh.step", action="raise", nth=3)
+        try:
+            t.fit(data, 4, ckpt_every=1)
+            snap = monitor.snapshot()
+            rec = snap["metrics"][
+                "paddle_tpu_train_recoveries_total"]["values"][""]
+            assert rec >= 1
+            names = [s.name for s in trace.spans()]
+            assert "train.recover" in names
+            assert "ckpt.save" in names
+        finally:
+            if not tr_was:
+                trace.disable()
+            if not mon_was:
+                monitor.disable()
+
+    def test_recovery_budget_exhausts_with_typed_raise(self, mesh8,
+                                                       tmp_path):
+        """max_recoveries bounds the retry loop: a fault that keeps
+        firing eventually propagates instead of looping forever."""
+        from paddle_tpu.analysis import faultinject as fi
+
+        batch = self._batch(7)
+        data = lambda step: batch  # noqa: E731
+        t = self._trainer(tmp_path / "boom", batch, max_recoveries=2,
+                          backoff_s=0.01)
+        fi.arm("mesh.step", action="raise", nth=1, times=10)
+        with pytest.raises(Exception, match="injected fault"):
+            t.fit(data, 4, ckpt_every=1)
+        assert len(t.recovery_stats) == 2       # budget, then raise
+
+    def test_resume_false_purges_prior_run_commits(self, mesh8, tmp_path):
+        """resume=False over a directory with a PRIOR run's checkpoints:
+        the old commits are purged, so a recovery in the fresh run can
+        never restore_latest_valid() into foreign state."""
+        from paddle_tpu.analysis import faultinject as fi
+
+        batch = self._batch(10)
+        data = lambda step: batch  # noqa: E731
+        ckpt = tmp_path / "shared"
+        old = self._trainer(ckpt, batch)
+        old.fit(data, 5, ckpt_every=1)          # commits up to step 5
+        old.close()
+
+        t = self._trainer(ckpt, batch)
+        fi.arm("mesh.step", action="raise", nth=2)
+        got = t.fit(data, 3, ckpt_every=1, resume=False)
+        assert sorted(got) == [0, 1, 2]
+        # the kill at step 1 restored THIS run's commit, not old step 5
+        assert t.recovery_stats[0]["restored_step"] <= 1
+        assert max(t.manager.steps()) == 3
+
+    def test_hang_without_manager_keeps_scanner_alive(self, mesh8):
+        """checkpoint=None + a hang: there is no restore target, so the
+        watchdog callback must NOT recover (and must never kill the
+        scanner thread with a CheckpointError) — the slow step simply
+        completes and training continues."""
+        from paddle_tpu.analysis import faultinject as fi
+
+        batch = self._batch(9)
+        data = lambda step: batch  # noqa: E731
+        paddle.seed(0)
+        m = _mlp()
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=m.parameters())
+        t = pmesh.MeshTrainer(m, opt, _mse, batch,
+                              config={"dp_degree": 8},
+                              checkpoint=None, hang_timeout=0.2)
+        fi.arm("mesh.step", action="delay", delay_s=0.8, nth=2)
+        try:
+            losses = t.fit(data, 3, ckpt_every=0)
+        finally:
+            dog = t._dog
+            t.close()
+        assert sorted(losses) == [0, 1, 2]
+        assert len(t.recovery_stats) == 0       # nothing to restore from
+        assert dog.timed_out                    # the hang WAS observed
+
+    def test_persistent_hang_exhausts_recovery_budget(self, mesh8,
+                                                      tmp_path):
+        """A step that hangs EVERY time consumes the same bounded
+        max_recoveries budget as repeated deaths — fit() raises instead
+        of looping through scanner recoveries forever."""
+        from paddle_tpu.analysis import faultinject as fi
+
+        batch = self._batch(8)
+        data = lambda step: batch  # noqa: E731
+        t = self._trainer(tmp_path / "hang", batch, hang_timeout=0.3,
+                          max_recoveries=2, backoff_s=0.01)
+        fi.arm("mesh.step", action="delay", delay_s=1.2, nth=1, times=50)
+        try:
+            with pytest.raises(pmesh.TrainStepSuperseded):
+                t.fit(data, 4, ckpt_every=1)
+        finally:
+            t.close()
+        assert len(t.recovery_stats) == 3   # budget of 2 + the last raise
+
+    def test_default_watchdog_watches_eager_collectives(self, mesh8):
+        """set_default_watchdog arms the eager collective layer: a real
+        all_reduce dispatch runs inside a watched section (visible in
+        the watchdog's event history)."""
+        from paddle_tpu.distributed.watchdog import (CommWatchdog,
+                                                     set_default_watchdog)
+
+        from paddle_tpu.distributed import collective as C
+
+        dog = CommWatchdog(timeout=30.0)
+        prev = set_default_watchdog(dog)
+        try:
+            v = paddle.to_tensor(
+                np.arange(16, dtype="float32").reshape(8, 2))
+            C.all_reduce(v)
+            expect = np.arange(16, dtype="float32").reshape(8, 2).sum(0)
+            for row in np.asarray(v.value):
+                np.testing.assert_allclose(row, expect)
+            descs = [d for d, _, _ in dog.events]
+            assert any(d.startswith("comm.all_reduce") for d in descs), \
+                descs
+        finally:
+            set_default_watchdog(prev)
+            dog.stop()

@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Inspect a paddle_tpu training checkpoint directory WITHOUT importing
+the framework.
+
+Prints, per committed step, the manifest view an operator debugs from:
+step, per-entry kind (full vs per-replica ZeRO rows), dtype, shape/numel,
+shard files with their blake2b digests and byte sizes — and (default on)
+re-hashes every shard against the manifest, exiting non-zero on the first
+mismatch. This is the same verification walk ``restore()`` gates on
+(``checkpoint/manager.py verify_checkpoint``), so a checkpoint this tool
+calls clean is a checkpoint the trainer will accept.
+
+Usage::
+
+    python tools/ckpt_inspect.py <ckpt-dir> [--step N] [--no-verify]
+    python tools/ckpt_inspect.py <ckpt-dir> --json
+
+``checkpoint/manager.py`` is numpy+stdlib by design and loaded by file
+path (the ``lint_framework.py`` discipline) — no jax, no package init.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_MANAGER = os.path.join(ROOT, "paddle_tpu", "checkpoint", "manager.py")
+
+
+def load_manager():
+    """The checkpoint manager module under a standalone alias (no
+    paddle_tpu import). Idempotent."""
+    alias = "paddle_tpu_ckpt_manager_standalone"
+    if alias in sys.modules:
+        return sys.modules[alias]
+    spec = importlib.util.spec_from_file_location(alias, _MANAGER)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[alias] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _entry_rows(doc):
+    rows = []
+    for name in sorted(doc["entries"]):
+        ent = doc["entries"][name]
+        if ent["kind"] == "zero":
+            shape = f"flat[{ent['numel']}] as {ent['dp']}x{ent['slice_len']}"
+        else:
+            shape = "x".join(str(d) for d in ent["shape"]) or "scalar"
+        for sh in ent["shards"]:
+            rows.append({
+                "entry": name, "kind": ent["kind"], "dtype": ent["dtype"],
+                "shape": shape, "row": sh.get("row"),
+                "file": sh["file"], "bytes": sh["bytes"],
+                "digest": sh["digest"],
+            })
+    return rows
+
+
+def inspect_dir(mgr_mod, directory, step=None, verify=True):
+    """[per-step report dict, ...]; raises the manager's typed errors on
+    a missing/corrupt checkpoint."""
+    committed = mgr_mod.step_dirs(directory)
+    if not committed:
+        raise mgr_mod.NoCheckpoint(
+            f"no committed checkpoint under {directory!r}")
+    if step is not None:
+        committed = [(s, p) for s, p in committed if s == int(step)]
+        if not committed:
+            raise mgr_mod.NoCheckpoint(
+                f"step {step} is not committed under {directory!r}")
+    reports = []
+    for s, path in committed:
+        doc = (mgr_mod.verify_checkpoint(path) if verify
+               else mgr_mod.read_manifest(path))
+        reports.append({
+            "step": s, "path": path, "verified": bool(verify),
+            "n_shards": doc.get("n_shards", 0),
+            "total_bytes": doc.get("total_bytes", 0),
+            "meta": {k: v for k, v in (doc.get("meta") or {}).items()
+                     if k != "scalars"},
+            "entries": _entry_rows(doc),
+        })
+    return reports
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="print + digest-verify a paddle_tpu checkpoint "
+                    "directory")
+    ap.add_argument("directory")
+    ap.add_argument("--step", type=int, default=None,
+                    help="inspect one committed step (default: all)")
+    ap.add_argument("--no-verify", action="store_true",
+                    help="print the manifest without re-hashing shards")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+    mgr_mod = load_manager()
+    try:
+        reports = inspect_dir(mgr_mod, args.directory, step=args.step,
+                              verify=not args.no_verify)
+    except mgr_mod.CheckpointError as e:
+        print(f"ckpt_inspect: FAIL: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(reports, indent=1, sort_keys=True))
+        return 0
+    for rep in reports:
+        status = "verified" if rep["verified"] else "NOT verified"
+        print(f"step {rep['step']}  [{status}]  "
+              f"{rep['n_shards']} shards  {rep['total_bytes']} bytes  "
+              f"meta={rep['meta']}")
+        width = max((len(r["entry"]) for r in rep["entries"]),
+                    default=10) + 2
+        for r in rep["entries"]:
+            row = "" if r["row"] is None else f" row {r['row']}"
+            print(f"  {r['entry']:<{width}}{r['kind']:<6}"
+                  f"{r['dtype']:<10}{r['shape']:<24}"
+                  f"{r['file']}{row}  {r['bytes']}B  "
+                  f"blake2b:{r['digest'][:12]}")
+    print(f"ckpt_inspect: OK ({len(reports)} step(s))")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
