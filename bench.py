@@ -191,6 +191,15 @@ def _load_cache():
             and isinstance(doc.get("detail", {}), dict)):
         return None, "malformed cache entry (missing metric/detail)"
     detail = doc.get("detail", {})
+    # the r03/r04/r05 class the ROADMAP perf note warns about: an entry
+    # that ALREADY carries detail.stale=true was never a fresh
+    # measurement — it is a replay (or a hand-seeded row) and must not
+    # become a headline number a second time
+    if detail.get("stale"):
+        return None, ("stale/invalid cache: entry already carries "
+                      "detail.stale=true (a replayed or hand-seeded row) "
+                      "— refusing to replay a replay as a headline "
+                      "number")
     rev = detail.get("measured_git_rev")
     # an absent rev means the measurement came from an unversioned (non-git)
     # deployment — replayable; a PRESENT placeholder/malformed rev marks a
@@ -233,6 +242,8 @@ def _load_cache():
 
 
 def _save_cache(doc):
+    if doc.get("detail", {}).get("stale"):
+        return  # a replay must never re-enter the cache as a measurement
     try:
         cached = dict(doc)
         cached.setdefault("detail", {})
@@ -289,6 +300,16 @@ def orchestrate():
     if cached is not None:
         cached.setdefault("detail", {})["stale"] = True
         cached["detail"]["tpu_error"] = errors
+        # the staleness reason rides the provenance block, so downstream
+        # consumers (and the next _load_cache, which refuses
+        # detail.stale entries) see WHY this number is a replay
+        prov = cached["detail"].setdefault("provenance", {})
+        if isinstance(prov, dict):
+            prov["staleness"] = (
+                f"replay of the cached on-device measurement from "
+                f"{cached['detail'].get('measured_at', '?')}: the live "
+                "TPU path failed this round "
+                f"({len(errors)} error(s), see detail.tpu_error)")
         print(json.dumps(cached))
         return
     if cache_err:
@@ -300,13 +321,19 @@ def orchestrate():
                            WORKER_TIMEOUT_CPU)
     if doc is not None:
         doc.setdefault("detail", {})["tpu_error"] = errors
+        if cache_err:
+            prov = doc["detail"].setdefault("provenance", {})
+            if isinstance(prov, dict):
+                prov["cache_refusal"] = cache_err
         print(json.dumps(doc))
         return
     errors.append(f"cpu fallback: {err}")
     print(json.dumps({
         "metric": "llama_train_tokens_per_sec", "value": 0.0,
         "unit": "tokens/s", "vs_baseline": 0.0,
-        "detail": {"error": errors},
+        "detail": {"error": errors,
+                   **({"provenance": {"cache_refusal": cache_err}}
+                      if cache_err else {})},
     }))
 
 
@@ -762,12 +789,16 @@ def worker():
     # force_every-sized chunks, recorded in detail.force_every
     force_every = max(1, int(os.environ.get("BENCH_FORCE_EVERY", "2")))
 
+    step_box = {}   # the live compiled step + final state, for the HBM row
+
     def measure():
         step, state_fn, params = build_step(model, optimizer, loss_fn)
         _log(f"[bench] timed loop: {iters} steps (force every {force_every})...")
         dt, (pv, av, mv), loss = timed_loop(
             step, state_fn(), (ids, labels), iters, force_every,
             log=lambda m: _log(f"[bench]   {m}"))
+        step_box["step"] = step
+        step_box["state"] = (pv, av, mv)
         return dt, params, pv, loss
 
     try:
@@ -822,6 +853,36 @@ def worker():
         mesh_info = {"error": f"{type(e).__name__}: {e}"[:200]}
     _log(f"[bench] mesh: {mesh_info}")
 
+    # graftir HBM row: the GI003 static estimate of THIS run's train step
+    # (trace-only) vs the live program's bytes — jax.Array state bytes
+    # always, plus the compiler's own memory analysis where the extra AOT
+    # compile is cheap (CPU; on TPU it would re-pay a multi-minute
+    # compile, so it is opt-in via BENCH_HBM_MEASURE=1)
+    try:
+        if os.environ.get("BENCH_SKIP_HBM"):
+            hbm_info = {"skipped": True}
+        else:
+            from paddle_tpu.analysis import jaxpr as _graftir
+
+            _hargs = (*step_box["state"], ids, labels)
+            _est = _graftir.estimate_fn(step_box["step"], _hargs,
+                                        name="bench.train_step")
+            hbm_info = {
+                "estimate_peak_bytes": _est["peak_bytes"],
+                "estimate_bounds": [_est["peak_sched_bytes"],
+                                    _est["peak_order_bytes"]],
+                "args_bytes": _est["args_bytes"],
+                "live_state_bytes": int(sum(
+                    getattr(v, "nbytes", 0) for v in
+                    jax.tree_util.tree_leaves(step_box["state"]))),
+            }
+            if not on_tpu or os.environ.get("BENCH_HBM_MEASURE"):
+                hbm_info["measured"] = _graftir.measure_compiled(
+                    step_box["step"], _hargs)
+    except Exception as e:  # noqa: BLE001 - headline metric must survive
+        hbm_info = {"error": f"{type(e).__name__}: {e}"[:200]}
+    _log(f"[bench] hbm: {hbm_info}")
+
     # 6*N FLOPs/token (fwd+bwd) + causal attention term 12*L*H*S/2... use the
     # standard PaLM appendix-B accounting: 6N + 12*L*h*S (h=hidden) per token.
     n_params = sum(int(np.prod(p.shape)) for p in params)
@@ -853,6 +914,7 @@ def worker():
             "decode": decode_info,
             "serving": serving_info,
             "mesh": mesh_info,
+            "hbm_estimate": hbm_info,
         },
     }
     try:

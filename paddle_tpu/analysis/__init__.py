@@ -18,7 +18,10 @@ and reports framework-specific hazards the test suite cannot see:
 - GL007 lock-order-inversion — the static lock-acquisition graph (built
   over the whole-tree call graph, callgraph.py) must stay acyclic;
 - GL008 recompile-hazard — per-call defop registration, shape/dtype
-  branching in jitted bodies, per-call-constructed static args.
+  branching in jitted bodies, per-call-constructed static args;
+- GL009 mutable-global-capture — jitted/to_static bodies closing over a
+  mutable module global (trace-time contents baked in; mutations apply
+  only after an unrelated recompile).
 
 Since PR 4 the engine is INTERPROCEDURAL: ``callgraph.py`` builds a
 whole-tree call graph with per-function effect summaries, so GL001/
@@ -82,7 +85,7 @@ def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="python -m paddle_tpu.analysis",
         description="graftlint: framework-aware static analysis "
-                    "(GL001–GL008, interprocedural)")
+                    "(GL001–GL009, interprocedural)")
     ap.add_argument("--root", default=None,
                     help="tree to analyze (default: this repo)")
     ap.add_argument("--include", default="paddle_tpu",

@@ -111,6 +111,13 @@ POINTS = {
         "CursorLoader.__next__ (io/dataloader.py): the resumable batch "
         "cursor the trainer checkpoints. raise = the data pipeline dies "
         "mid-epoch; delay = a stalled fetch."),
+    "ir.analyze": (
+        "graftir's per-pass analysis site (analysis/jaxpr/ir.py "
+        "analyze_program, fired once per pass per program). raise = the "
+        "pass dies mid-analysis, drilling the isolation contract: the "
+        "failure must surface as a typed AnalysisError carrying the "
+        "program name and pass id — a crashing analyzer must never "
+        "fail a build opaquely."),
 }
 
 ACTIONS = ("raise", "delay", "flag")

@@ -1,0 +1,270 @@
+"""graftir: jaxpr-level static analysis for paddle_tpu.
+
+graftlint (the parent package) walks Python ASTs and graftsan watches
+the runtime; NEITHER ever inspects the traced IR that actually runs on
+the device. graftir closes that gap (ROADMAP item 3): a jaxpr-walking
+pass engine over any traced callable — and, crucially, over the three
+FLAGSHIP live programs (the serving ``build_mixed_step``,
+``decode_burst``, and the ``parallelize()`` DP=8 ZeRO-1 mesh train
+step), analyzed through the same builder code paths production jits:
+
+- GI001 collective-consistency — divergent collective sequences across
+  ``cond`` branches / unbound collective axes = SPMD deadlock hazard
+  (shares its collective vocabulary with the trainer's
+  ``comm.mesh_step`` span census: ``collectives.py``);
+- GI002 donation-safety — donated-but-unaliased invars (silently
+  doubled HBM), donated invars read after their alias materializes
+  (defensive copies), large un-donated state in a donating step;
+- GI003 hbm-budget — a per-device peak-residency liveness estimator
+  (``hbm.py``) gated by the declared per-program manifest
+  (``budgets.json``) and the ``assert_hbm_budget(fn, args, budget)``
+  API — the static half of the memory-budget remat planner;
+- GI004 fusion-opportunity — convert round-trips, duplicated expensive
+  subexpressions, operand shardings that force GSPMD reshards (arXiv
+  2301.13062's statically visible missed-fusion shapes).
+
+Analysis is TRACE-only (``jax.make_jaxpr``): no XLA compile, no device
+dispatch. Findings carry location-free fingerprints against a
+shrink-only ``baseline.json`` (same schema and discipline as the lint
+baseline, EMPTY from day one). Run it as
+``python -m paddle_tpu.analysis.jaxpr`` (or ``tools/ir_report.py``,
+which defers the jax import until after argument parsing); CI consumes
+:func:`static_check_rows` via ``tools/run_static_checks.py``. A
+crashing pass raises a typed :class:`AnalysisError` naming program and
+pass — drilled by the ``ir.analyze`` fault point. See
+docs/ir_analysis.md.
+
+Importing this package costs stdlib only; jax loads the first time a
+callable is traced.
+"""
+from __future__ import annotations
+
+from . import collectives
+from .hbm import (DEFAULT_BUDGETS, HBMBudgetExceeded, assert_hbm_budget,
+                  estimate, estimate_fn, load_budgets, measure_compiled)
+from .ir import (DEFAULT_BASELINE, AnalysisError, IRFinding, IRPass,
+                 ProgramIR, analyze_program, load_baseline,
+                 partition_findings, trace, write_baseline)
+from .passes import (ALL_PASSES, PASSES_BY_ID, CollectiveConsistency,
+                     DonationSafety, FusionOpportunity, HBMBudget)
+from .programs import (FLAGSHIP, build_program, ensure_virtual_devices,
+                       flagship_programs)
+
+__all__ = [
+    "AnalysisError", "IRFinding", "IRPass", "ProgramIR",
+    "ALL_PASSES", "PASSES_BY_ID", "CollectiveConsistency",
+    "DonationSafety", "HBMBudget", "FusionOpportunity",
+    "trace", "analyze_program", "analyze_fn", "analyze_flagship",
+    "partition_findings", "load_baseline", "write_baseline",
+    "DEFAULT_BASELINE", "estimate", "estimate_fn", "assert_hbm_budget",
+    "measure_compiled", "load_budgets", "DEFAULT_BUDGETS",
+    "HBMBudgetExceeded", "FLAGSHIP", "build_program",
+    "flagship_programs", "ensure_virtual_devices", "collectives",
+    "static_check_rows", "main",
+]
+
+
+def analyze_fn(fn, args, name="<fn>", passes=None, donate_argnums=None,
+               baseline_path=""):
+    """One-call API over ANY traced callable: trace ``fn(*args)`` and
+    run the passes. Returns ``(new, baselined, program)`` — pass
+    ``baseline_path=None`` for the checked-in default baseline, the
+    empty string for none."""
+    program = trace(fn, args, name, donate_argnums=donate_argnums)
+    findings = analyze_program(
+        program, list(passes if passes is not None else ALL_PASSES))
+    new, base = partition_findings(findings, load_baseline(baseline_path))
+    return new, base, program
+
+
+def analyze_flagship(names=None, passes=None, baseline_path=None):
+    """Analyze the flagship live programs. Returns
+    ``(new, baselined, programs, errors)`` where ``errors`` maps a
+    program name to the typed :class:`AnalysisError` that kept it from
+    being analyzed (one broken build must not hide the others)."""
+    passes = list(passes if passes is not None else ALL_PASSES)
+    findings, programs, errors = [], {}, {}
+    for name, prog in flagship_programs(names):
+        if isinstance(prog, AnalysisError):
+            errors[name] = prog
+            continue
+        programs[name] = prog
+        findings.extend(analyze_program(prog, passes))
+    new, base = partition_findings(findings, load_baseline(baseline_path))
+    return new, base, programs, errors
+
+
+def _hbm_table(programs):
+    rows = []
+    for name, prog in sorted(programs.items()):
+        est = prog.meta.get("hbm_estimate") or estimate(prog)
+        budget = load_budgets().get(name)
+        rows.append({"program": name, **est,
+                     "budget_bytes": budget})
+    return rows
+
+
+def static_check_rows(passes_by_check=None):
+    """The three graftir CI rows ``tools/run_static_checks.py`` prints:
+    one strict (no-baseline) row per contract over every flagship
+    program. A program whose BUILD fails contributes its typed error to
+    every row; ``check_hbm_budgets`` additionally fails when a flagship
+    program has no manifest row (a budget nobody declared gates
+    nothing)."""
+    import time
+
+    checks = passes_by_check or (
+        ("check_collective_consistency", "GI001"),
+        ("check_donation", "GI002"),
+        ("check_hbm_budgets", "GI003"),
+    )
+    built = flagship_programs()
+    budgets = load_budgets()
+    rows = []
+    for check, pass_id in checks:
+        t0 = time.perf_counter()
+        problems = []
+        for name, prog in built:
+            if isinstance(prog, AnalysisError):
+                problems.append(f"{name}: {type(prog).__name__}: {prog}")
+                continue
+            try:
+                for f in analyze_program(prog, [PASSES_BY_ID[pass_id]]):
+                    problems.append(repr(f))
+            except AnalysisError as e:
+                problems.append(f"{name}: {type(e).__name__}: {e}")
+            if pass_id == "GI003" and name not in budgets:
+                problems.append(
+                    f"{name}: no budget row in budgets.json — declare "
+                    "one (see docs/ir_analysis.md)")
+        rows.append({"check": check, "ok": not problems,
+                     "findings": len(problems), "detail": problems,
+                     "seconds": round(time.perf_counter() - t0, 3)})
+    return rows
+
+
+def main(argv=None):
+    """CLI: exit 0 when every analyzed program is clean (baseline
+    applied), 1 on new findings, 2 on usage errors."""
+    import argparse
+    import json
+    import sys
+
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis.jaxpr",
+        description="graftir: jaxpr-level static analysis over the "
+                    "flagship live programs (GI001-GI004)")
+    ap.add_argument("--programs", default=None,
+                    help="comma-separated flagship program names "
+                         "(default: all three)")
+    ap.add_argument("--passes", default=None,
+                    help="comma-separated pass ids (default: all)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: the checked-in "
+                         "analysis/jaxpr/baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report grandfathered findings too")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from current findings "
+                         "and exit 0")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--hbm", action="store_true",
+                    help="print the per-program HBM estimate table")
+    ap.add_argument("--checks-json", action="store_true",
+                    help="emit the three run_static_checks rows as JSON "
+                         "(the CI aggregator's consumer interface)")
+    ap.add_argument("--list-passes", action="store_true")
+    ap.add_argument("--list-programs", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_passes:
+        for p in ALL_PASSES:
+            print(f"{p.id}\t{p.name}\t{p.rationale}")
+        return 0
+    if args.list_programs:
+        for name, desc in FLAGSHIP.items():
+            print(f"{name}\t{desc}")
+        return 0
+
+    # usage errors stay instant: validate names BEFORE any jax touch
+    passes = None
+    if args.passes:
+        try:
+            passes = [PASSES_BY_ID[p.strip().upper()]
+                      for p in args.passes.split(",") if p.strip()]
+        except KeyError as e:
+            print(f"graftir: unknown pass {e.args[0]!r} "
+                  f"(known: {', '.join(sorted(PASSES_BY_ID))})",
+                  file=sys.stderr)
+            return 2
+    names = None
+    if args.programs:
+        names = [n.strip() for n in args.programs.split(",") if n.strip()]
+        unknown = [n for n in names if n not in FLAGSHIP]
+        if unknown:
+            print(f"graftir: unknown program(s) {unknown} "
+                  f"(known: {', '.join(sorted(FLAGSHIP))})",
+                  file=sys.stderr)
+            return 2
+
+    # the mesh program needs the 8-device virtual backend, but
+    # ``python -m`` imports the framework (and initializes jax's
+    # backend) before this function runs — when that left us short,
+    # re-exec ONCE with XLA_FLAGS set up front (tools/ir_report.py
+    # avoids this by setting the env before any import)
+    import os
+
+    if not ensure_virtual_devices(8) \
+            and os.environ.get("PADDLE_TPU_GRAFTIR_REEXEC") != "1":
+        os.environ["PADDLE_TPU_GRAFTIR_REEXEC"] = "1"
+        os.execv(sys.executable,
+                 [sys.executable, "-m", "paddle_tpu.analysis.jaxpr"]
+                 + list(sys.argv[1:] if argv is None else argv))
+
+    if args.checks_json:
+        rows = static_check_rows()
+        print(json.dumps({"ok": all(r["ok"] for r in rows),
+                          "checks": rows}, indent=1, sort_keys=True))
+        return 0 if all(r["ok"] for r in rows) else 1
+
+    baseline_path = "" if args.no_baseline else args.baseline
+    new, base, programs, errors = analyze_flagship(
+        names=names, passes=passes, baseline_path=baseline_path)
+
+    if args.update_baseline:
+        path = args.baseline or DEFAULT_BASELINE
+        write_baseline(path, new + base)
+        print(f"graftir: baseline updated ({len(new + base)} "
+              f"fingerprints) -> {path}")
+        return 0
+
+    if args.json:
+        print(json.dumps({
+            "findings": [f.as_dict() for f in new],
+            "baselined": len(base),
+            "errors": {k: str(v) for k, v in errors.items()},
+            "programs": sorted(programs),
+            "hbm": _hbm_table(programs),
+            "ok": not new and not errors,
+        }, indent=1, sort_keys=True))
+    else:
+        for f in new:
+            print(repr(f))
+        for name, e in sorted(errors.items()):
+            print(f"{name}: ANALYSIS ERROR: {e}", file=sys.stderr)
+        if args.hbm:
+            hdr = (f"{'program':<24} {'peak':>12} {'args':>12} "
+                   f"{'consts':>12} {'donated':>12} {'budget':>12}")
+            print(hdr)
+            print("-" * len(hdr))
+            for row in _hbm_table(programs):
+                budget = row["budget_bytes"]
+                print(f"{row['program']:<24} {row['peak_bytes']:>12} "
+                      f"{row['args_bytes']:>12} {row['consts_bytes']:>12} "
+                      f"{row['donated_bytes']:>12} "
+                      f"{budget if budget is not None else '-':>12}")
+        print(f"graftir: {len(new)} finding(s), {len(base)} baselined, "
+              f"{len(errors)} build error(s), "
+              f"{len(programs)} program(s) analyzed")
+    return 1 if (new or errors) else 0

@@ -1,0 +1,11 @@
+"""``python -m paddle_tpu.analysis.jaxpr`` — the graftir CLI.
+
+(``tools/ir_report.py`` is the same surface without importing jax at
+module load: it parses arguments first, then defers here.)
+"""
+import sys
+
+from . import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,126 @@
+"""Collective census: ONE vocabulary of collective ops, shared by the
+trainer's ``comm.mesh_step`` spans and graftir's GI001 pass.
+
+Two census surfaces over the same vocabulary:
+
+- :func:`census_hlo` counts collectives in compiler TEXT (StableHLO or
+  optimized HLO — both spellings match), the live-program view
+  ``MeshParallel.collective_counts`` attaches to every ``comm.mesh_step``
+  span (PR 8 embedded a private copy of this regex in
+  ``mesh/parallelize.py``; this module is its one home now);
+- :func:`census_jaxpr` / :func:`collective_sequence` walk a traced
+  jaxpr for collective PRIMITIVES with their axis names — the static
+  view GI001 compares across cond branches and while bodies, where a
+  per-device divergence in the collective sequence is an SPMD deadlock.
+
+Stdlib-only at import time: the jaxpr walkers duck-type jax's eqn
+objects (``eqn.primitive.name`` / ``eqn.params``), so importing this
+module never initializes a backend.
+"""
+from __future__ import annotations
+
+import re
+
+__all__ = ["COLLECTIVE_RE", "COLLECTIVE_PRIMITIVES", "census_hlo",
+           "census_lowered", "census_jaxpr", "collective_sequence",
+           "iter_subjaxprs"]
+
+# matches both optimized-HLO (all-reduce) and StableHLO
+# (stablehlo.all_reduce) spellings — the census reader accepts either
+# text form
+COLLECTIVE_RE = re.compile(
+    r"(all[-_]reduce|all[-_]gather|reduce[-_]scatter|"
+    r"collective[-_]permute|all[-_]to[-_]all)")
+
+# the jaxpr-level (primitive) spellings of the same vocabulary; psum is
+# HLO all-reduce, psum_scatter is reduce-scatter, ppermute is
+# collective-permute. pmean lowers through psum and never appears as its
+# own primitive.
+COLLECTIVE_PRIMITIVES = {
+    "psum": "all_reduce",
+    "pmax": "all_reduce",
+    "pmin": "all_reduce",
+    "all_gather": "all_gather",
+    "psum_scatter": "reduce_scatter",
+    "reduce_scatter": "reduce_scatter",
+    "all_to_all": "all_to_all",
+    "ppermute": "collective_permute",
+    "pbroadcast": "collective_permute",
+}
+
+
+def census_hlo(text):
+    """{canonical-collective: count} over compiler text (StableHLO or
+    optimized HLO)."""
+    counts = {}
+    for m in COLLECTIVE_RE.finditer(text):
+        k = m.group(1).replace("-", "_")
+        counts[k] = counts.get(k, 0) + 1
+    return counts
+
+
+def census_lowered(lowered):
+    """Census of a ``jit(...).lower(...)`` result. The cheap path parses
+    the StableHLO from the trace (manual-axis collectives a shard_map
+    body hand-places are explicit ops there); only if that shows nothing
+    (everything GSPMD-inserted) does it pay a full AOT compile for the
+    optimized HLO."""
+    counts = census_hlo(lowered.as_text())
+    if not counts:
+        counts = census_hlo(lowered.compile().as_text())
+    return counts
+
+
+def _axis_names(eqn):
+    """Normalized axis-name tuple of one collective eqn (the params
+    spelling differs per primitive: psum uses ``axes``, all_gather uses
+    ``axis_name``, ...)."""
+    for key in ("axes", "axis_name", "axis"):
+        if key in eqn.params:
+            v = eqn.params[key]
+            if isinstance(v, (tuple, list, frozenset, set)):
+                return tuple(sorted(str(a) for a in v))
+            return (str(v),)
+    return ()
+
+
+def iter_subjaxprs(eqn):
+    """(slot, jaxpr) for every sub-jaxpr a call-like eqn carries —
+    cond branches, while cond/body, scan/pjit/remat/custom_* bodies,
+    shard_map's open jaxpr. Duck-typed: a "jaxpr" is anything with
+    ``.eqns``; ClosedJaxpr wrappers are unwrapped."""
+    for key, val in eqn.params.items():
+        items = val if isinstance(val, (tuple, list)) else (val,)
+        for i, item in enumerate(items):
+            inner = getattr(item, "jaxpr", item)  # ClosedJaxpr -> Jaxpr
+            if hasattr(inner, "eqns"):
+                slot = f"{key}[{i}]" if isinstance(val, (tuple, list)) \
+                    else key
+                yield slot, inner
+
+
+def collective_sequence(jaxpr):
+    """The ORDERED collective signature of a jaxpr: a tuple of
+    ``(canonical_name, axis_names)`` pairs, recursing into every
+    sub-jaxpr in program order. Two sub-programs that may run on
+    different devices of one mesh (cond branches) must produce EQUAL
+    sequences or the mesh deadlocks — this is the comparison key."""
+    seq = []
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        canon = COLLECTIVE_PRIMITIVES.get(name)
+        if canon is not None:
+            seq.append((canon, _axis_names(eqn)))
+        for _slot, sub in iter_subjaxprs(eqn):
+            seq.extend(collective_sequence(sub))
+    return tuple(seq)
+
+
+def census_jaxpr(jaxpr):
+    """{canonical-collective: count} over a traced jaxpr (recursive) —
+    the static twin of :func:`census_hlo`. NOTE: a scan/while body's
+    collectives count ONCE here (per trace) but run per iteration live."""
+    counts = {}
+    for name, _axes in collective_sequence(jaxpr):
+        counts[name] = counts.get(name, 0) + 1
+    return counts

@@ -1,0 +1,346 @@
+"""graftir passes GI001–GI004: invariants of the traced programs that
+actually run on the device, checked statically over their jaxprs.
+
+Each pass encodes one hazard class the test suite cannot cheaply see:
+
+- GI001 collective-consistency — divergent collective sequences across
+  ``cond`` branches (and collectives over axes no enclosing shard_map
+  binds) are SPMD deadlocks: one device enters an all-reduce its peers
+  never reach;
+- GI002 donation-safety — a donated invar that aliases NO output wastes
+  its donation (HBM silently doubled: the runtime keeps input and
+  output buffers both); a donated invar read after its aliased output
+  materializes forces a defensive copy; a large un-donated invar that
+  flows to a same-shaped output is a donation left on the table;
+- GI003 hbm-budget — the static per-device peak (hbm.py) must fit the
+  declared per-program budget manifest (budgets.json);
+- GI004 fusion-opportunity — convert round-trips severing elementwise
+  chains, duplicated expensive subexpressions (missed CSE), and operand
+  shardings pinned to disagreeing specs (a GSPMD reshard collective the
+  ``paddle_tpu_mesh_reshards_total`` counter will pay at run time) —
+  the statically visible shapes from "Operator Fusion in XLA"
+  (arXiv 2301.13062).
+
+Rationale long-forms live in docs/ir_analysis.md.
+"""
+from __future__ import annotations
+
+from . import collectives as _coll
+from . import hbm as _hbm
+from .ir import IRPass, _aval_bytes
+
+__all__ = ["CollectiveConsistency", "DonationSafety", "HBMBudget",
+           "FusionOpportunity", "ALL_PASSES", "PASSES_BY_ID"]
+
+
+def _is_var(v):
+    return hasattr(v, "aval") and not hasattr(v, "val")
+
+
+def _walk_eqns(jaxpr, path=""):
+    """(path, jaxpr, eqn_index, eqn) over every level, depth-first."""
+    for i, eqn in enumerate(jaxpr.eqns):
+        yield path, jaxpr, i, eqn
+        for slot, sub in _coll.iter_subjaxprs(eqn):
+            sub_path = f"{path}/{eqn.primitive.name}[{i}].{slot}" \
+                if path else f"{eqn.primitive.name}[{i}].{slot}"
+            yield from _walk_eqns(sub, sub_path)
+
+
+def _fmt_seq(seq):
+    return "[" + ", ".join(
+        f"{name}@{'+'.join(axes) if axes else '?'}"
+        for name, axes in seq) + "]"
+
+
+class CollectiveConsistency(IRPass):
+    """GI001: every device of the mesh must execute the SAME collective
+    sequence. A ``cond`` whose branches disagree (one psums, the other
+    doesn't — or they psum over different axes) deadlocks the mesh the
+    first time the predicate diverges across devices; a collective over
+    an axis no enclosing shard_map binds never lowers to a real ring at
+    all. This is the first trap the 1F1B pipeline schedule (ROADMAP
+    item 1) will spring: per-stage branches with per-stage collective
+    mixes."""
+
+    id = "GI001"
+    name = "collective-consistency"
+    rationale = ("mismatched collective sequences across branches or "
+                 "unbound collective axes deadlock the SPMD mesh")
+
+    def check(self, program):
+        out = []
+        self._visit(program, program.jaxpr, "", (), out)
+        return out
+
+    def _visit(self, program, jaxpr, path, bound_axes, out):
+        for i, eqn in enumerate(jaxpr.eqns):
+            name = eqn.primitive.name
+            here = f"{path}/{name}[{i}]" if path else f"{name}[{i}]"
+            canon = _coll.COLLECTIVE_PRIMITIVES.get(name)
+            if canon is not None:
+                axes = _coll._axis_names(eqn)
+                missing = [a for a in axes if a not in bound_axes]
+                if missing:
+                    out.append(self.finding(
+                        program, here,
+                        f"collective {canon} over axis "
+                        f"{'/'.join(missing)} with no enclosing "
+                        "shard_map binding it — the op cannot lower to "
+                        "a device ring"))
+            if name == "cond":
+                seqs = [_coll.collective_sequence(
+                            getattr(b, "jaxpr", b))
+                        for b in eqn.params.get("branches", ())]
+                if len(set(seqs)) > 1 and any(seqs):
+                    desc = " vs ".join(_fmt_seq(s) for s in seqs)
+                    out.append(self.finding(
+                        program, here,
+                        f"collective sequence diverges across cond "
+                        f"branches ({desc}) — if the predicate differs "
+                        "across devices the mesh deadlocks"))
+            new_axes = bound_axes
+            if name == "shard_map":
+                mesh = eqn.params.get("mesh")
+                axis_names = tuple(getattr(mesh, "axis_names", ()))
+                auto = eqn.params.get("auto", frozenset())
+                new_axes = bound_axes + tuple(
+                    a for a in axis_names if a not in auto)
+            for slot, sub in _coll.iter_subjaxprs(eqn):
+                self._visit(program, sub, f"{here}.{slot}", new_axes, out)
+
+
+class DonationSafety(IRPass):
+    """GI002: the donation contract of a donated, jitted step. Donation
+    is the mechanism that lets params/pools update in place; broken
+    donation doesn't crash — it silently doubles residency or inserts
+    copies, and only shows up as an OOM one batch-size later."""
+
+    id = "GI002"
+    name = "donation-safety"
+    rationale = ("unaliased or re-read donated buffers silently double "
+                 "HBM / insert defensive copies")
+
+    # an un-donated invar at least this large, flowing to a same-shaped
+    # output, is a donation left on the table
+    LARGE_BYTES = 1 << 20
+
+    def check(self, program):
+        out = []
+        jaxpr = program.jaxpr
+        donated = program.donated
+        if len(donated) != len(jaxpr.invars):
+            return out
+
+        def _key(v):
+            aval = v.aval
+            return (tuple(getattr(aval, "shape", ())),
+                    str(getattr(aval, "dtype", "?")))
+
+        out_keys = {}
+        for v in jaxpr.outvars:
+            if _is_var(v):
+                out_keys[_key(v)] = out_keys.get(_key(v), 0) + 1
+
+        # producer eqn index per var + last use per invar, top level
+        producer = {}
+        for i, eqn in enumerate(jaxpr.eqns):
+            for ov in eqn.outvars:
+                producer[id(ov)] = i
+        last_use = {}
+        for i, eqn in enumerate(jaxpr.eqns):
+            for v in eqn.invars:
+                if _is_var(v):
+                    last_use[id(v)] = i
+
+        avail = dict(out_keys)
+        for idx, (v, d) in enumerate(zip(jaxpr.invars, donated)):
+            if not d:
+                continue
+            k = _key(v)
+            if avail.get(k, 0) > 0:
+                avail[k] -= 1
+            else:
+                out.append(self.finding(
+                    program, f"invar[{idx}]",
+                    f"donated invar {k[1]}{list(k[0])} aliases no "
+                    "output — the donation is wasted and the buffer is "
+                    "silently kept alongside its successor (doubled "
+                    "HBM)"))
+                continue
+            # latest producer of ANY output this invar could alias: a
+            # read after that point would read an overwritten buffer, so
+            # the runtime must copy defensively
+            latest = max((producer.get(id(ov), -1)
+                          for ov in jaxpr.outvars
+                          if _is_var(ov) and _key(ov) == k), default=-1)
+            if latest >= 0 and last_use.get(id(v), -1) > latest:
+                out.append(self.finding(
+                    program, f"invar[{idx}]",
+                    f"donated invar {k[1]}{list(k[0])} is read after "
+                    "every output it could alias is already "
+                    "materialized — the aliasing forces a defensive "
+                    "copy of the whole buffer"))
+
+        if any(donated):
+            for idx, (v, d) in enumerate(zip(jaxpr.invars, donated)):
+                if d or not _is_var(v):
+                    continue
+                b = _aval_bytes(v.aval)
+                if b >= self.LARGE_BYTES and out_keys.get(_key(v), 0) > 0:
+                    out.append(self.finding(
+                        program, f"invar[{idx}]",
+                        f"large un-donated invar "
+                        f"{_key(v)[1]}{list(_key(v)[0])} "
+                        f"({b >> 20} MiB) flows to a same-shaped output "
+                        "in a step that already donates — donate it or "
+                        "pay double residency for the state"))
+        return out
+
+
+class HBMBudget(IRPass):
+    """GI003: the static per-device peak (hbm.py liveness walk) must fit
+    the program's declared budget from budgets.json. Programs without a
+    manifest row only report (the estimate lands in ``program.meta``);
+    the three flagship programs MUST have rows."""
+
+    id = "GI003"
+    name = "hbm-budget"
+    rationale = ("a declared per-program HBM budget catches peak-"
+                 "residency regressions before the OOM does")
+
+    def __init__(self, budgets=None):
+        self._budgets = budgets
+
+    def check(self, program):
+        budgets = self._budgets if self._budgets is not None \
+            else _hbm.load_budgets()
+        est = _hbm.estimate(program)
+        program.meta["hbm_estimate"] = est
+        budget = budgets.get(program.name)
+        if budget is None:
+            return []
+        if est["peak_bytes"] > budget:
+            return [self.finding(
+                program, "",
+                f"estimated per-device peak {est['peak_bytes']} bytes "
+                f"exceeds the declared budget {budget} bytes "
+                f"(args={est['args_bytes']}, consts="
+                f"{est['consts_bytes']}, donated="
+                f"{est['donated_bytes']})")]
+        return []
+
+
+class FusionOpportunity(IRPass):
+    """GI004: statically visible missed-fusion shapes. None of these are
+    wrong results — each is a buffer XLA materializes (or a collective
+    GSPMD inserts) that a small rewrite avoids, and the decode/train hot
+    paths pay it every step."""
+
+    id = "GI004"
+    name = "fusion-opportunity"
+    rationale = ("convert churn, duplicate subexpressions and "
+                 "disagreeing operand shardings each cost an avoidable "
+                 "buffer or collective per step")
+
+    EXPENSIVE = {"dot_general", "conv_general_dilated", "exp", "log",
+                 "rsqrt", "sqrt", "tanh", "erf", "logistic",
+                 "integer_pow", "div", "reduce_sum", "reduce_max",
+                 "reduce_min", "cumsum", "cumlogsumexp", "sort",
+                 "argmax", "argmin"}
+
+    def check(self, program):
+        out = []
+        for path, jaxpr in self._jaxpr_levels(program.jaxpr):
+            self._convert_churn(program, path, jaxpr, out)
+            self._duplicates(program, path, jaxpr, out)
+            self._sharding_disagreement(program, path, jaxpr, out)
+        return out
+
+    # -- helpers -------------------------------------------------------------
+    def _jaxpr_levels(self, jaxpr, path=""):
+        yield path, jaxpr
+        for i, eqn in enumerate(jaxpr.eqns):
+            for slot, sub in _coll.iter_subjaxprs(eqn):
+                sub_path = f"{path}/{eqn.primitive.name}[{i}].{slot}" \
+                    if path else f"{eqn.primitive.name}[{i}].{slot}"
+                yield from self._jaxpr_levels(sub, sub_path)
+
+    def _convert_churn(self, program, path, jaxpr, out):
+        producer = {}
+        for i, eqn in enumerate(jaxpr.eqns):
+            for ov in eqn.outvars:
+                producer[id(ov)] = eqn
+        for i, eqn in enumerate(jaxpr.eqns):
+            if eqn.primitive.name != "convert_element_type":
+                continue
+            src = eqn.invars[0]
+            if not _is_var(src):
+                continue
+            prev = producer.get(id(src))
+            if prev is None or prev.primitive.name != "convert_element_type":
+                continue
+            origin = prev.invars[0]
+            o_dt = getattr(getattr(origin, "aval", None), "dtype", None)
+            mid_dt = getattr(src.aval, "dtype", None)
+            new_dt = getattr(eqn.outvars[0].aval, "dtype", None)
+            if o_dt is not None and o_dt == new_dt and mid_dt != new_dt:
+                where = f"{path}/convert[{i}]" if path else f"convert[{i}]"
+                out.append(self.finding(
+                    program, where,
+                    f"convert round-trip {o_dt} -> {mid_dt} -> {new_dt} "
+                    "severs the elementwise chain — two casts and an "
+                    "extra buffer for a no-op"))
+
+    def _duplicates(self, program, path, jaxpr, out):
+        seen = {}
+        for i, eqn in enumerate(jaxpr.eqns):
+            name = eqn.primitive.name
+            if name not in self.EXPENSIVE:
+                continue
+            if not all(_is_var(v) for v in eqn.invars):
+                continue
+            if next(_coll.iter_subjaxprs(eqn), None) is not None:
+                continue
+            params = tuple(sorted((k, repr(v))
+                                  for k, v in eqn.params.items()))
+            key = (name, params, tuple(id(v) for v in eqn.invars))
+            first = seen.get(key)
+            if first is None:
+                seen[key] = i
+                continue
+            where = f"{path}/{name}[{i}]" if path else f"{name}[{i}]"
+            out.append(self.finding(
+                program, where,
+                f"duplicated subexpression: {name} over the same "
+                f"operands already computed at eqn {first} — XLA does "
+                "not CSE across fusion boundaries; hoist it"))
+
+    def _sharding_disagreement(self, program, path, jaxpr, out):
+        pinned = {}
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name != "sharding_constraint":
+                continue
+            spec = repr(getattr(eqn.params.get("sharding"), "spec",
+                                eqn.params.get("sharding")))
+            for ov in eqn.outvars:
+                pinned[id(ov)] = spec
+        for i, eqn in enumerate(jaxpr.eqns):
+            if eqn.primitive.name == "sharding_constraint":
+                continue
+            specs = sorted({pinned[id(v)] for v in eqn.invars
+                            if _is_var(v) and id(v) in pinned})
+            if len(specs) > 1:
+                where = f"{path}/{eqn.primitive.name}[{i}]" if path \
+                    else f"{eqn.primitive.name}[{i}]"
+                out.append(self.finding(
+                    program, where,
+                    f"operands pinned to disagreeing shardings "
+                    f"({' vs '.join(specs)}) — GSPMD must insert a "
+                    "reshard collective here (counted live in "
+                    "paddle_tpu_mesh_reshards_total)"))
+
+
+ALL_PASSES = (CollectiveConsistency(), DonationSafety(), HBMBudget(),
+              FusionOpportunity())
+PASSES_BY_ID = {p.id: p for p in ALL_PASSES}

@@ -1,9 +1,10 @@
-"""graftlint rules GL001–GL008: framework-aware static checks.
+"""graftlint rules GL001–GL009: framework-aware static checks.
 
 Each rule encodes one invariant the runtime cannot cheaply enforce —
 trace purity, host-sync hygiene, registry/doc consistency, lock
 discipline, metric-name contract, span-name contract, lock-order
-consistency, recompile hygiene — as a pure AST/text check. Rules receive
+consistency, recompile hygiene, mutable-global capture — as a pure
+AST/text check. Rules receive
 the whole :class:`~paddle_tpu.analysis.core.Project` so cross-file rules
 (GL003, GL005, GL006) see registrations and their catalogs together, and
 the interprocedural rules (GL001/GL002/GL004 propagation, GL007, GL008)
@@ -1176,8 +1177,114 @@ class RecompileHazard(Rule):
         return names
 
 
+class MutableGlobalCapture(Rule):
+    """GL009: jitted/to_static bodies that close over a MUTABLE module
+    global.
+
+    A traced body runs its Python ONCE: reading a module-level list/
+    dict/set bakes the values seen at trace time into the compiled
+    program. Later mutations of the global are silently ignored — until
+    an unrelated recompile (new shape, evicted cache) re-traces and
+    picks them up, so behavior CHANGES at a point no code changed. That
+    staleness-then-divergence is nastier than a plain wrong constant
+    (GL001's territory) because it is green in every test that traces
+    exactly once. Pass the value as an argument (retrace on change) or
+    bind it to an immutable module constant.
+    """
+
+    id = "GL009"
+    name = "mutable-global-capture"
+    rationale = ("a traced body reading a mutable module global bakes "
+                 "trace-time contents in; later mutations apply only "
+                 "after an unrelated recompile")
+
+    MUTABLE_CALLS = {"list", "dict", "set", "defaultdict", "OrderedDict",
+                     "Counter", "deque", "bytearray"}
+
+    def _mutable_globals(self, srcfile):
+        """{name: kind} for module-level bindings whose value is a
+        mutable container (display literal, comprehension, or a bare
+        constructor call)."""
+        out = {}
+        for node in srcfile.tree.body:
+            if isinstance(node, ast.Assign):
+                targets = [t for t in node.targets
+                           if isinstance(t, ast.Name)]
+                value = node.value
+            elif isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Name) \
+                    and node.value is not None:
+                targets = [node.target]
+                value = node.value
+            else:
+                continue
+            kind = None
+            if isinstance(value, (ast.List, ast.ListComp)):
+                kind = "list"
+            elif isinstance(value, (ast.Dict, ast.DictComp)):
+                kind = "dict"
+            elif isinstance(value, (ast.Set, ast.SetComp)):
+                kind = "set"
+            elif isinstance(value, ast.Call):
+                name = dotted_name(value.func)
+                if name and name.rsplit(".", 1)[-1] in self.MUTABLE_CALLS:
+                    kind = name.rsplit(".", 1)[-1]
+            if kind:
+                for t in targets:
+                    out[t.id] = kind
+        return out
+
+    def check(self, project):
+        out = []
+        for f in project.files:
+            if f.tree is None:
+                continue
+            mutables = self._mutable_globals(f)
+            if not mutables:
+                continue
+            for fn, tag in TraceImpurity._traced_functions(f).items():
+                # any name bound inside the function (params of every
+                # kind, stores, comprehension targets, nested defs)
+                # shadows the global
+                bound = set()
+                for n in ast.walk(fn):
+                    a = getattr(n, "args", None)
+                    if isinstance(a, ast.arguments):
+                        for arg in (list(a.posonlyargs) + list(a.args)
+                                    + list(a.kwonlyargs)
+                                    + [x for x in (a.vararg, a.kwarg)
+                                       if x is not None]):
+                            bound.add(arg.arg)
+                for n in ast.walk(fn):
+                    if isinstance(n, ast.Name) \
+                            and isinstance(n.ctx, (ast.Store, ast.Del)):
+                        bound.add(n.id)
+                    elif isinstance(n, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef,
+                                        ast.ClassDef)) and n is not fn:
+                        bound.add(n.name)
+                seen = set()
+                for n in ast.walk(fn):
+                    if not (isinstance(n, ast.Name)
+                            and isinstance(n.ctx, ast.Load)):
+                        continue
+                    kind = mutables.get(n.id)
+                    if kind is None or n.id in bound or n.id in seen:
+                        continue
+                    seen.add(n.id)
+                    out.append(self.finding(
+                        f, n,
+                        f"@{tag} function '{fn.name}' closes over "
+                        f"mutable module-global '{n.id}' ({kind}): the "
+                        "traced program bakes in the contents seen at "
+                        "trace time, and later mutations apply only "
+                        "after an unrelated recompile — pass it as an "
+                        "argument or make it an immutable constant"))
+        return out
+
+
 ALL_RULES = (TraceImpurity(), HostSync(), RegistryConsistency(),
              LockDiscipline(), MetricNameContract(), SpanNameContract(),
-             LockOrder(), RecompileHazard())
+             LockOrder(), RecompileHazard(), MutableGlobalCapture())
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}

@@ -21,8 +21,6 @@ eager model code IS the distributed program.
 """
 from __future__ import annotations
 
-import re
-
 import numpy as np
 
 import jax
@@ -30,18 +28,15 @@ import jax.numpy as jnp
 from jax.experimental.shard_map import shard_map
 from jax.sharding import PartitionSpec as P
 
+# the collective census shares ONE vocabulary with graftir's GI001 pass
+# (PR 11 factored the PR 8 private regex out of this module)
+from ..analysis.jaxpr import collectives as _collectives
 from ..framework import random as rng
 from ..framework.core import Tensor
 from . import zero
 from .context import MeshContext
 
 __all__ = ["build_mesh_step", "MeshParallel", "parallelize"]
-
-# matches both optimized-HLO (all-reduce) and StableHLO (stablehlo.all_reduce)
-# spellings — the census reader accepts either text form
-_COLLECTIVE_RE = re.compile(
-    r"(all[-_]reduce|all[-_]gather|reduce[-_]scatter|"
-    r"collective[-_]permute|all[-_]to[-_]all)")
 
 
 def _dp_axis_of(ctx):
@@ -295,27 +290,18 @@ class MeshParallel:
         return total
 
     def collective_counts(self, *batch):
-        """{collective: count} of the step program. The cheap path parses
-        the StableHLO from an AOT lower (trace only — the manual-axis
-        collectives the body hand-places are already explicit ops there);
-        only if that shows nothing (everything GSPMD-inserted) does it pay
-        a full AOT compile for the optimized HLO."""
+        """{collective: count} of the step program, via the shared
+        census (``analysis/jaxpr/collectives.py`` — the same vocabulary
+        GI001 walks statically). The cheap path parses the StableHLO
+        from an AOT lower (trace only — the manual-axis collectives the
+        body hand-places are already explicit ops there); only if that
+        shows nothing (everything GSPMD-inserted) does it pay a full
+        AOT compile for the optimized HLO."""
         if self._collectives is None:
             vals = [b.value if isinstance(b, Tensor) else jnp.asarray(b)
                     for b in batch]
             lowered = self._jitted.lower(self._pv, self._av, self._mv, *vals)
-
-            def census(text):
-                counts = {}
-                for m in _COLLECTIVE_RE.finditer(text):
-                    k = m.group(1).replace("-", "_")
-                    counts[k] = counts.get(k, 0) + 1
-                return counts
-
-            counts = census(lowered.as_text())
-            if not counts:
-                counts = census(lowered.compile().as_text())
-            self._collectives = counts
+            self._collectives = _collectives.census_lowered(lowered)
         return self._collectives
 
     # -- the step ------------------------------------------------------------

@@ -1,0 +1,21 @@
+"""GL009 clean fixture: a tree with mutable globals AND traced bodies,
+but no traced body ever reads one."""
+import jax
+
+_CACHE = {}                          # host-side memo, eager access only
+
+
+def lookup(key):
+    return _CACHE.get(key)
+
+
+@jax.jit
+def forward(x, table):
+    # the table arrives as an ARGUMENT: retraces when the caller's
+    # pytree changes, never silently stale
+    return x * table["scale"]
+
+
+def run_eager(x):
+    got = lookup("y")
+    return got if got is not None else forward(x, {"scale": 1.0})

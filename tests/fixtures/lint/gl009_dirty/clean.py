@@ -1,0 +1,21 @@
+"""GL009 clean half of the dirty tree: mutable globals are fine outside
+traced code, shadowed names are not captures, and immutable constants
+never fire."""
+import jax
+
+_REQUEST_LOG = []                    # mutated freely: eager-only reader
+_LIMITS = (8, 16, 32)                # immutable: never a GL009
+
+
+def record(entry):
+    _REQUEST_LOG.append(entry)       # not a traced body
+
+
+@jax.jit
+def bounded(x, _REQUEST_LOG):        # param shadows the module global
+    return x[: _LIMITS[0]] + len(_REQUEST_LOG)
+
+
+@jax.jit
+def bounded_kw(x, *, _REQUEST_LOG=()):   # keyword-only shadow, same rule
+    return x[: _LIMITS[0]] + len(_REQUEST_LOG)

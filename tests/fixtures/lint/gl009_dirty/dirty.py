@@ -1,0 +1,34 @@
+"""GL009 dirty fixture: traced bodies closing over mutable module
+globals — decorator form, to_static form, and call form."""
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.jit import to_static
+
+_SCALE_TABLE = {"default": 1.0}      # mutated by configure() below
+_WARM_SHAPES = []                    # appended per request
+_SEEN = set()
+
+
+def configure(name, value):
+    _SCALE_TABLE[name] = value
+
+
+@jax.jit
+def scaled_forward(x):
+    # bakes trace-time _SCALE_TABLE contents into the program
+    return x * _SCALE_TABLE["default"]
+
+
+@to_static
+def padded_forward(x):
+    if len(_WARM_SHAPES) > 2:
+        return x
+    return jnp.pad(x, 1)
+
+
+def build_step():
+    def run(x):
+        return x.sum() + len(_SEEN)
+
+    return jax.jit(run)

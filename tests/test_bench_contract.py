@@ -35,9 +35,12 @@ def _run_bench(env_extra, cache_path, timeout=560):
     env = dict(os.environ)
     env["BENCH_CACHE_PATH"] = str(cache_path)
     # these tests exercise the orchestrator/cache contract, not the
-    # serving workload — skip its block to keep each fallback worker fast
-    # (bench_suite --smoke serving + tests/test_serving.py cover it)
+    # serving workload — skip its block (and the graftir HBM row's extra
+    # AOT compile) to keep each fallback worker fast (bench_suite
+    # --smoke serving + tests/test_serving.py / test_ir_analysis.py
+    # cover them)
     env.setdefault("BENCH_SKIP_SERVING", "1")
+    env.setdefault("BENCH_SKIP_HBM", "1")
     env.update(env_extra)
     p = subprocess.run([sys.executable, BENCH], capture_output=True,
                        text=True, timeout=timeout, env=env, cwd=ROOT)
@@ -70,6 +73,8 @@ class TestBenchContract:
         assert d.get("stale") is True
         assert out["vs_baseline"] == 0.42
         assert "tpu_error" in d  # failure provenance preserved
+        # ISSUE 11: the staleness reason rides the provenance block
+        assert "replay" in d.get("provenance", {}).get("staleness", "")
 
     def test_invalid_provenance_is_not_replayed(self, tmp_path):
         """The round-5 bug class: a fixture with rev `deadbee` and a 2030
@@ -158,6 +163,30 @@ class TestBenchContract:
         assert out["detail"].get("stale") is not True
         assert out["detail"]["device"] == "cpu"
         assert "tpu_error" in out["detail"]
+
+    def test_stale_entry_is_not_replayed_as_headline(self, tmp_path):
+        """ISSUE 11 satellite: a cache entry that ALREADY carries
+        detail.stale=true (the hand-seeded r03/r04/r05 class — a replay
+        of a replay) must be refused as a headline number even when its
+        rev and timestamps are clean, with the refusal reason surfaced
+        in detail.provenance.cache_refusal of the fallback doc."""
+        cache = tmp_path / "bench_cache.json"
+        doc = {"metric": "llama_train_tokens_per_sec", "value": 32235.48,
+               "unit": "tokens/s", "vs_baseline": 0.598,
+               "detail": {"device": "TPU v5 lite", "mfu": 0.598,
+                          "measured_at": _utc(-3600),
+                          "measured_git_rev": _real_rev(),
+                          "stale": True,
+                          "source": "seeded manually"}}
+        cache.write_text(json.dumps(doc))
+        out, stderr = _run_bench(_NO_BACKEND, cache)
+        d = out["detail"]
+        assert d.get("stale") is not True
+        assert out["vs_baseline"] != 0.598
+        assert "refusing to replay a replay" in stderr
+        prov = d.get("provenance") or {}
+        assert "refusing to replay a replay" in prov.get(
+            "cache_refusal", "")
 
     def test_worker_emits_provenance_block(self, tmp_path):
         """The CPU worker's JSON carries a validatable provenance block

@@ -3,7 +3,7 @@
 
 One analysis pass (parse the tree once) feeds two result rows:
 
-1. graftlint (GL001–GL006 over paddle_tpu/, baseline + suppressions
+1. graftlint (GL001–GL009 over paddle_tpu/, baseline + suppressions
    applied — the tier-1 gate's view);
 2. the metric-name contract (GL005 strict: no baseline, inline
    suppressions honored, and a missing catalog is a failure — identical
@@ -22,7 +22,16 @@ One analysis pass (parse the tree once) feeds two result rows:
    declared injection point is fired by at least one
    ``faultinject.fire("<point>")`` site in the tree, and every fired
    point is declared — an undeclared drill or a dead catalog row is a
-   CI failure, no baseline).
+   CI failure, no baseline);
+7.-9. the graftir rows (``check_collective_consistency`` /
+   ``check_donation`` / ``check_hbm_budgets``): GI001/GI002/GI003 run
+   strict (no baseline) over the three FLAGSHIP live programs — the
+   serving mixed step, the decode burst, and the DP=8 ZeRO-1 mesh train
+   step — in ONE subprocess (``python -m paddle_tpu.analysis.jaxpr
+   --checks-json``), because the traced-IR checks need jax while this
+   aggregator itself stays importable without it. The rows run only for
+   THIS repo's root (fixture mini-trees have no live programs), and a
+   subprocess that dies contributes three failed rows, never a crash.
 
 Prints one status line per check, then a machine-readable JSON summary on
 stdout (``--json`` prints ONLY the JSON). Exit 0 iff every check passed.
@@ -32,6 +41,7 @@ from __future__ import annotations
 import ast
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -109,6 +119,52 @@ def fault_point_problems(an, root=ROOT, project=None):
     return problems
 
 
+GRAFTIR_CHECKS = ("check_collective_consistency", "check_donation",
+                  "check_hbm_budgets")
+
+
+def graftir_rows(root=ROOT, timeout=600):
+    """The three jaxpr-level rows, produced by one
+    ``python -m paddle_tpu.analysis.jaxpr --checks-json`` subprocess
+    with the 8-device virtual CPU mesh provisioned up front. Foreign
+    roots (fixture mini-trees) get NO rows — the flagship programs are
+    this repo's live programs, not the analyzed tree's."""
+    if os.path.abspath(root) != os.path.abspath(ROOT):
+        return []
+    # the env half of analysis/jaxpr/programs.ensure_virtual_devices
+    # (the canonical copy) — inlined so this aggregator stays importable
+    # without jax or the framework
+    env = dict(os.environ)
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    t0 = time.perf_counter()
+    detail = []
+    try:
+        p = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.analysis.jaxpr",
+             "--checks-json"],
+            capture_output=True, text=True, timeout=timeout, env=env,
+            cwd=ROOT)
+        rows = json.loads(p.stdout)["checks"]
+        if [r.get("check") for r in rows] == list(GRAFTIR_CHECKS):
+            return rows
+        detail = [f"unexpected rows from --checks-json: "
+                  f"{[r.get('check') for r in rows]}"]
+    except Exception as e:  # noqa: BLE001 - a dead subprocess = failed rows
+        tail = ""
+        if "p" in locals():
+            tail = (p.stderr or p.stdout or "")[-300:]
+        detail = [f"graftir subprocess failed: {type(e).__name__}: {e}"
+                  + (f" | {tail}" if tail else "")]
+    seconds = round(time.perf_counter() - t0, 3)
+    return [{"check": c, "ok": False, "findings": -1, "detail": detail,
+             "seconds": seconds if i == 0 else 0.0}
+            for i, c in enumerate(GRAFTIR_CHECKS)]
+
+
 def run_checks(root=ROOT):
     """[result-row, ...] — one shared parse of the tree for both rows."""
     an = load_analysis()
@@ -180,6 +236,7 @@ def run_checks(root=ROOT):
         "detail": problems,
         "seconds": round(time.perf_counter() - t0, 3),
     })
+    rows.extend(graftir_rows(root))
     return rows
 
 
