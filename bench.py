@@ -643,6 +643,81 @@ def _serving_bench(model, cfg, on_tpu):
     return out
 
 
+def _fusion_bench(model, optimizer, loss_fn, step_box, ids, labels, on_tpu):
+    """detail.fusion: the graftopt transform over THIS run's live train
+    step — applied rewrites, eqn/fusible-region deltas, GI003 peak
+    before/after, and (CPU, where the extra compile is cheap) the
+    optimized program's step time vs the original. Plus the remat
+    planner's answer for this model at 95% of the unoptimized GI003
+    peak: the plan size the budget knob would buy (flags restored —
+    this is a what-if, not a mutation of the measured run)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.analysis.jaxpr import estimate, trace
+    from paddle_tpu.analysis.jaxpr import opt as gopt
+    from paddle_tpu.analysis.jaxpr import planner as gplanner
+
+    step = step_box["step"]
+    state = step_box["state"]
+    args = (*state, ids, labels)
+    prog = trace(step, args, "bench.train_step")
+    est_before = estimate(prog)
+    oprog, res = gopt.optimize_program(prog)
+    est_after = estimate(oprog)
+    info = {
+        "rewrites": res.by_rule(),
+        "eqns": [res.eqns_before, res.eqns_after],
+        "regions": [res.regions_before, res.regions_after],
+        "gi003_peak": [est_before["peak_bytes"], est_after["peak_bytes"]],
+    }
+
+    if not on_tpu or os.environ.get("BENCH_FUSION_MEASURE"):
+        # rebuild + re-jit the optimized program and race it against the
+        # original (threaded donated state, fresh copies per side)
+        opt_fn, _ = gopt.optimize_jitted(step, args, name="bench.train_step")
+
+        def run(f, n=3):
+            pv, av, mv = jax.tree_util.tree_map(jnp.array, state)
+            loss, pv, av, mv = f(pv, av, mv, ids, labels)   # warm/compile
+            _force(loss)
+            t0 = time.perf_counter()
+            for _ in range(n):
+                loss, pv, av, mv = f(pv, av, mv, ids, labels)
+            _force(loss)
+            return (time.perf_counter() - t0) / n, loss
+
+        t_raw, l_raw = run(step)
+        t_opt, l_opt = run(opt_fn)
+        info["step_ms"] = [round(t_raw * 1e3, 2), round(t_opt * 1e3, 2)]
+        info["speedup"] = round(t_raw / max(t_opt, 1e-9), 3)
+        info["loss_match"] = bool(gopt.bit_exact(l_raw, l_opt))
+
+    # the budget knob's what-if: plan size at 95% of the unoptimized peak
+    cands = gplanner.remat_candidates(model)
+    saved = [(layer, layer._recompute) for _n, layer in cands]
+    try:
+        budget = int(est_before["peak_bytes"] * 0.95)
+        plan = gplanner.plan_for_model(model, optimizer, loss_fn,
+                                       (ids, labels), budget)
+        info["remat_plan"] = {
+            "budget_bytes": budget,
+            "base_peak_bytes": plan["base_peak_bytes"],
+            "planned_peak_bytes": plan["planned_peak_bytes"],
+            "plan_size": len(plan["sites"]),
+            "sites": plan["sites"],
+            "n_traces": plan["n_traces"],
+        }
+    except gplanner.RematPlanError as e:
+        info["remat_plan"] = {"budget_bytes": int(
+            est_before["peak_bytes"] * 0.95),
+            "unsatisfiable": str(e)[:160]}
+    finally:
+        for layer, flag in saved:
+            layer._recompute = flag
+    return info
+
+
 from bench_common import force as _force  # noqa: E402
 
 # the flagship config the cache replay artifact stands for — a direct
@@ -659,7 +734,7 @@ _FLAGSHIP_ENV_DEFAULTS = {
     "BENCH_DECODE_KV": "", "BENCH_DECODE_LAYOUT": "",
     "BENCH_SKIP_DECODE": "", "BENCH_SKIP_DISPATCH": "",
     "BENCH_SKIP_FLASHCHECK": "", "BENCH_SKIP_SERVING": "",
-    "BENCH_SKIP_MESH": "",
+    "BENCH_SKIP_MESH": "", "BENCH_SKIP_FUSION": "",
 }
 
 
@@ -883,6 +958,19 @@ def worker():
         hbm_info = {"error": f"{type(e).__name__}: {e}"[:200]}
     _log(f"[bench] hbm: {hbm_info}")
 
+    # graftopt fusion row: rewrites + region deltas + (CPU) optimized-vs-
+    # raw step race over THIS run's live step, and the remat planner's
+    # plan size at 95% of the unoptimized GI003 peak (docs/ir_analysis.md)
+    try:
+        if os.environ.get("BENCH_SKIP_FUSION") or "step" not in step_box:
+            fusion_info = {"skipped": True}
+        else:
+            fusion_info = _fusion_bench(model, optimizer, loss_fn,
+                                        step_box, ids, labels, on_tpu)
+    except Exception as e:  # noqa: BLE001 - headline metric must survive
+        fusion_info = {"error": f"{type(e).__name__}: {e}"[:200]}
+    _log(f"[bench] fusion: {fusion_info}")
+
     # 6*N FLOPs/token (fwd+bwd) + causal attention term 12*L*H*S/2... use the
     # standard PaLM appendix-B accounting: 6N + 12*L*h*S (h=hidden) per token.
     n_params = sum(int(np.prod(p.shape)) for p in params)
@@ -915,6 +1003,7 @@ def worker():
             "serving": serving_info,
             "mesh": mesh_info,
             "hbm_estimate": hbm_info,
+            "fusion": fusion_info,
         },
     }
     try:

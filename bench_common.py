@@ -716,6 +716,7 @@ def mesh_bench(*, dp=8, tp=2, batch=8, seq=16, iters=3, vocab=128, hidden=64,
     dp8_dt, dp8_loss = run_mesh_pass(dp8)
     replicated_bytes = dp8.optimizer_state_bytes()
     dp8_coll = dp8.collective_counts(ids, labels)
+    dp8_bytes = dp8.collective_bytes(ids, labels)
 
     m2, o2 = make()
     zero1 = pmesh.parallelize(m2, o2, loss_fn, (ids, labels),
@@ -724,6 +725,7 @@ def mesh_bench(*, dp=8, tp=2, batch=8, seq=16, iters=3, vocab=128, hidden=64,
     zero_dt, zero_loss = run_mesh_pass(zero1)
     zero_bytes = zero1.optimizer_state_bytes()
     zero_coll = zero1.collective_counts(ids, labels)
+    zero_coll_bytes = zero1.collective_bytes(ids, labels)
 
     # -- DP x TP (the hybrid lowering path: fleet config -> mesh axes) ------
     dp2 = dp // tp
@@ -735,6 +737,7 @@ def mesh_bench(*, dp=8, tp=2, batch=8, seq=16, iters=3, vocab=128, hidden=64,
     hybrid = pmesh.MeshParallel(m3, o3, loss_fn, ctx, (ids, labels))
     hyb_dt, hyb_loss = run_mesh_pass(hybrid)
     hyb_coll = hybrid.collective_counts(ids, labels)
+    hyb_bytes = hybrid.collective_bytes(ids, labels)
 
     tol = 5e-3 * max(1.0, abs(single_losses[-1]))
     return {
@@ -752,12 +755,181 @@ def mesh_bench(*, dp=8, tp=2, batch=8, seq=16, iters=3, vocab=128, hidden=64,
         "hybrid_loss_close": bool(abs(hyb_loss - single_losses[-1]) < tol),
         "collectives": {"dp8": dp8_coll, "dp8_zero1": zero_coll,
                         "hybrid": hyb_coll},
+        # per-pass BYTES-on-wire (per-device payload of each hand-placed
+        # collective, from the shared jaxpr byte census — the ROADMAP
+        # item 2 prep; GSPMD-inserted collectives are counted above but
+        # not priced here)
+        "collective_bytes": {"dp8": dp8_bytes, "dp8_zero1": zero_coll_bytes,
+                             "hybrid": hyb_bytes},
         "opt_state_bytes": {
             "replicated": int(replicated_bytes),
             "zero1_per_replica": int(zero_bytes),
             "ratio": round(zero_bytes / max(replicated_bytes, 1), 4),
         },
     }
+
+
+def fusion_bench(*, iters=4, dp=8, seed=0):
+    """The graftopt drill (ISSUE 12): fusion rewrites + budget-driven
+    remat over the LIVE flagship programs, on the 8-device virtual mesh.
+
+    Section ``fusion`` — for each flagship program (serving mixed step,
+    decode burst, DP=8 ZeRO-1 mesh train step, built through the SAME
+    production builders graftir analyzes): the applied-rewrite counts,
+    total-eqn and fusible-REGION deltas (regions = dispatch-count
+    accounting: an outlined closure is one region), the GI003 peak
+    before/after, wall time per step of the original jitted program vs
+    the rebuilt optimized one (fresh donated-arg copies per call, best
+    of ``iters``), and OUTPUT BIT-EXACTNESS — the hard gate: a rewrite
+    that changes a single bit is a bug, not an optimization.
+
+    Section ``remat`` — the budget drill: declare an HBM budget BELOW
+    the unoptimized GI003 peak of the DP=8 ZeRO-1 llama step; the
+    planner must emit a program whose GI003 estimate fits the budget,
+    the compiler's own measured bytes must confirm it (the existing
+    15% band), losses must match the no-remat step, and the compiled
+    step must not recompile past warmup (one-program invariant).
+    Wall-clock ratios are REPORTED; every gate here is deterministic.
+    """
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    if jax.device_count() < dp:
+        return {"skipped": f"needs {dp} devices, {jax.device_count()} "
+                           "visible (set XLA_FLAGS="
+                           "--xla_force_host_platform_device_count=8)"}
+
+    import paddle_tpu as paddle
+    from paddle_tpu import mesh as pmesh
+    from paddle_tpu.analysis.jaxpr import (build_program, estimate,
+                                           measure_compiled, trace)
+    from paddle_tpu.analysis.jaxpr import opt as gopt
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    def copy_args(a):
+        return jax.tree_util.tree_map(
+            lambda x: jnp.array(x) if isinstance(x, jax.Array) else x, a)
+
+    # -- fusion: rewrite each flagship, verify bits, time both ---------------
+    fusion = {}
+    for name in ("serving.mixed_step", "serving.decode_burst",
+                 "mesh.train_step"):
+        prog, fn, args = build_program(name, with_callable=True)
+        est_before = estimate(prog)
+        oprog, res = gopt.optimize_program(prog)
+        est_after = estimate(oprog)
+        opt_fn, _ = gopt.optimize_jitted(fn, copy_args(args), name=name)
+        exact = gopt.bit_exact(fn(*copy_args(args)),
+                               opt_fn(*copy_args(args)))
+
+        def best_of(f):
+            ts = []
+            for _ in range(iters):
+                a = copy_args(args)      # donated pools: fresh per call
+                t0 = time.perf_counter()
+                out = f(*a)
+                force(out)
+                ts.append(time.perf_counter() - t0)
+            return min(ts)
+
+        t_raw = best_of(fn)
+        t_opt = best_of(opt_fn)
+        fusion[name] = {
+            "rewrites": res.by_rule(),
+            "eqns": [res.eqns_before, res.eqns_after],
+            "regions": [res.regions_before, res.regions_after],
+            "gi003_peak": [est_before["peak_bytes"],
+                           est_after["peak_bytes"]],
+            "step_ms": [round(t_raw * 1e3, 3), round(t_opt * 1e3, 3)],
+            "speedup": round(t_raw / max(t_opt, 1e-9), 3),
+            "bit_exact": bool(exact),
+        }
+
+    # -- remat: the budget drill on the DP=8 ZeRO-1 llama step ---------------
+    def make():
+        paddle.seed(seed)
+        cfg = LlamaConfig(vocab_size=64, hidden_size=32,
+                          intermediate_size=64, num_hidden_layers=2,
+                          num_attention_heads=2, num_key_value_heads=2,
+                          max_position_embeddings=32)
+        m = LlamaForCausalLM(cfg)
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=m.parameters())
+        return m, opt
+
+    def loss_fn(model, ids, labels):
+        loss, _ = model(ids, labels=labels)
+        return loss
+
+    r = np.random.RandomState(seed)
+    ids = r.randint(0, 64, (8, 8)).astype("int64")
+    labels = r.randint(0, 64, (8, 8, 1)).astype("int64")
+
+    peaks = {}
+    for policy in ("none", "all"):
+        m, o = make()
+        mp = pmesh.parallelize(m, o, loss_fn, (ids, labels),
+                               config={"dp_degree": dp,
+                                       "shard_optimizer": True,
+                                       "recompute_policy": policy})
+        peaks[policy] = estimate(trace(
+            mp._jitted, (mp._pv, mp._av, mp._mv, ids, labels),
+            f"remat.{policy}"))["peak_bytes"]
+
+    # a budget strictly BELOW the unoptimized peak (and above full
+    # remat, so it is satisfiable): the planner must do real work
+    budget = (peaks["none"] + peaks["all"]) // 2
+    m, o = make()
+    planned = pmesh.parallelize(m, o, loss_fn, (ids, labels),
+                                config={"dp_degree": dp,
+                                        "shard_optimizer": True,
+                                        "recompute_policy": "budget",
+                                        "hbm_budget": budget})
+    plan = planned.remat_plan
+    meas = measure_compiled(planned._jitted,
+                            (planned._pv, planned._av, planned._mv,
+                             ids, labels))
+    est_ratio = plan["planned_peak_bytes"] / max(meas["peak_bytes"], 1)
+
+    # loss parity vs the unoptimized (no-remat) step + recompile
+    # silence past warmup (the one-program invariant)
+    m2, o2 = make()
+    baseline = pmesh.parallelize(m2, o2, loss_fn, (ids, labels),
+                                 config={"dp_degree": dp,
+                                         "shard_optimizer": True,
+                                         "recompute_policy": "none"})
+    planned_losses, base_losses = [], []
+    planned.step(ids, labels)        # warmup/compile
+    baseline.step(ids, labels)
+    cache_after_warm = planned._jitted._cache_size()
+    for _ in range(2):
+        planned_losses.append(float(planned.step(ids, labels)))
+        base_losses.append(float(baseline.step(ids, labels)))
+    tol = 5e-3 * max(1.0, abs(base_losses[-1]))
+    remat = {
+        "budget_bytes": int(budget),
+        "unoptimized_peak_bytes": int(peaks["none"]),
+        "full_remat_peak_bytes": int(peaks["all"]),
+        "plan_sites": plan["sites"],
+        "plan_size": len(plan["sites"]),
+        "planned_peak_bytes": int(plan["planned_peak_bytes"]),
+        "planned_bracket": plan["planned_bracket"],
+        "fits_budget": bool(plan["planned_peak_bytes"] <= budget),
+        "measured_peak_bytes": int(meas["peak_bytes"]),
+        "estimate_vs_measured": round(est_ratio, 4),
+        "within_band": bool(abs(est_ratio - 1.0) <= 0.15),
+        "planned_losses": planned_losses,
+        "baseline_losses": base_losses,
+        "loss_parity": bool(all(
+            abs(a - b) < tol
+            for a, b in zip(planned_losses, base_losses))),
+        "recompiles_post_warmup": int(planned._jitted._cache_size()
+                                      - cache_after_warm),
+        "n_traces": plan["n_traces"],
+    }
+    return {"dp": dp, "iters": iters, "fusion": fusion, "remat": remat}
 
 
 def train_chaos_bench(*, dp=8, steps=8, kill_at=6, ckpt_every=2, batch=8,

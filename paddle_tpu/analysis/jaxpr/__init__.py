@@ -39,14 +39,19 @@ callable is traced.
 """
 from __future__ import annotations
 
-from . import collectives
+from . import collectives, opt, planner
 from .hbm import (DEFAULT_BUDGETS, HBMBudgetExceeded, assert_hbm_budget,
                   estimate, estimate_fn, load_budgets, measure_compiled)
 from .ir import (DEFAULT_BASELINE, AnalysisError, IRFinding, IRPass,
                  ProgramIR, analyze_program, load_baseline,
                  partition_findings, trace, write_baseline)
+from .opt import (DEFAULT_REWRITES, AppliedRewrite, OptimizeResult,
+                  bit_exact, optimize_closed, optimize_jitted,
+                  optimize_program)
 from .passes import (ALL_PASSES, PASSES_BY_ID, CollectiveConsistency,
                      DonationSafety, FusionOpportunity, HBMBudget)
+from .planner import (RematPlanError, apply_remat_plan, plan_budget_remat,
+                      plan_for_mesh_step, plan_for_model, remat_candidates)
 from .programs import (FLAGSHIP, build_program, ensure_virtual_devices,
                        flagship_programs)
 
@@ -60,7 +65,11 @@ __all__ = [
     "measure_compiled", "load_budgets", "DEFAULT_BUDGETS",
     "HBMBudgetExceeded", "FLAGSHIP", "build_program",
     "flagship_programs", "ensure_virtual_devices", "collectives",
-    "static_check_rows", "main",
+    "opt", "planner", "DEFAULT_REWRITES", "AppliedRewrite",
+    "OptimizeResult", "bit_exact", "optimize_closed", "optimize_jitted",
+    "optimize_program", "RematPlanError", "remat_candidates",
+    "apply_remat_plan", "plan_budget_remat", "plan_for_mesh_step",
+    "plan_for_model", "static_check_rows", "main",
 ]
 
 
@@ -105,12 +114,15 @@ def _hbm_table(programs):
 
 
 def static_check_rows(passes_by_check=None):
-    """The three graftir CI rows ``tools/run_static_checks.py`` prints:
+    """The four graftir CI rows ``tools/run_static_checks.py`` prints:
     one strict (no-baseline) row per contract over every flagship
     program. A program whose BUILD fails contributes its typed error to
     every row; ``check_hbm_budgets`` additionally fails when a flagship
     program has no manifest row (a budget nobody declared gates
-    nothing)."""
+    nothing); ``check_opt_parity`` runs the graftopt transform on every
+    flagship and asserts the OPTIMIZED program re-analyzes clean under
+    GI001–GI004 (budgets included — a rewrite must never grow peak past
+    the manifest)."""
     import time
 
     checks = passes_by_check or (
@@ -140,7 +152,94 @@ def static_check_rows(passes_by_check=None):
         rows.append({"check": check, "ok": not problems,
                      "findings": len(problems), "detail": problems,
                      "seconds": round(time.perf_counter() - t0, 3)})
+
+    t0 = time.perf_counter()
+    problems = []
+    rewrites = {}
+    for name, prog in built:
+        if isinstance(prog, AnalysisError):
+            problems.append(f"{name}: {type(prog).__name__}: {prog}")
+            continue
+        try:
+            oprog, res = opt.optimize_program(prog)
+            rewrites[name] = res.by_rule()
+            for f in analyze_program(oprog, list(ALL_PASSES)):
+                problems.append(f"optimized {f!r}")
+        except AnalysisError as e:
+            problems.append(f"{name}: {type(e).__name__}: {e}")
+        except Exception as e:  # noqa: BLE001 - a crashed rewrite = failed row
+            problems.append(f"{name}: optimize crashed: "
+                            f"{type(e).__name__}: {e}")
+    rows.append({"check": "check_opt_parity", "ok": not problems,
+                 "findings": len(problems), "detail": problems,
+                 "rewrites": rewrites,
+                 "seconds": round(time.perf_counter() - t0, 3)})
     return rows
+
+
+def _main_optimize(names, passes, json_out=False):
+    """The ``--optimize`` report: per program, the applied-rewrite
+    table, eqn/region deltas and the GI003 bracket before/after the
+    transform; findings (strict, no baseline) run on the OPTIMIZED
+    program. Exit 0 iff every optimized program is clean."""
+    import json as _json
+    import sys
+
+    rows, errors = [], {}
+    for name in (names or FLAGSHIP):
+        try:
+            prog = build_program(name)
+        except AnalysisError as e:
+            errors[name] = e
+            continue
+        before = estimate(prog)
+        oprog, res = opt.optimize_program(prog)
+        after = estimate(oprog)
+        findings = analyze_program(
+            oprog, list(passes if passes is not None else ALL_PASSES))
+        rows.append({
+            "program": name,
+            "rewrites": res.by_rule(),
+            "eqns": [res.eqns_before, res.eqns_after],
+            "regions": [res.regions_before, res.regions_after],
+            "peak_before": before["peak_bytes"],
+            "bracket_before": [before["peak_sched_bytes"],
+                               before["peak_order_bytes"]],
+            "peak_after": after["peak_bytes"],
+            "bracket_after": [after["peak_sched_bytes"],
+                              after["peak_order_bytes"]],
+            "findings": [f.as_dict() for f in findings],
+            "applied": [a.as_dict() for a in res.applied],
+        })
+    n_findings = sum(len(r["findings"]) for r in rows)
+    if json_out:
+        print(_json.dumps({"optimize": rows,
+                           "errors": {k: str(v)
+                                      for k, v in errors.items()},
+                           "ok": not n_findings and not errors},
+                          indent=1, sort_keys=True))
+        return 1 if (n_findings or errors) else 0
+    hdr = (f"{'program':<24} {'eqns':>11} {'regions':>11} "
+           f"{'peak before':>12} {'peak after':>12}  rewrites")
+    print(hdr)
+    print("-" * len(hdr))
+    for r in rows:
+        rw = ", ".join(f"{k}:{v}" for k, v in sorted(r["rewrites"].items())) \
+            or "-"
+        print(f"{r['program']:<24} "
+              f"{r['eqns'][0]:>5}>{r['eqns'][1]:<5} "
+              f"{r['regions'][0]:>5}>{r['regions'][1]:<5} "
+              f"{r['peak_before']:>12} {r['peak_after']:>12}  {rw}")
+        for a in r["applied"]:
+            print(f"    [{a['rule']}] {a['where']}: {a['detail']}")
+        for f in r["findings"]:
+            print(f"    FINDING {f['rule']} {f['where']}: {f['message']}")
+    for name, e in sorted(errors.items()):
+        print(f"{name}: ANALYSIS ERROR: {e}", file=sys.stderr)
+    print(f"graftopt: {len(rows)} program(s) optimized, "
+          f"{n_findings} finding(s) on optimized programs, "
+          f"{len(errors)} build error(s)")
+    return 1 if (n_findings or errors) else 0
 
 
 def main(argv=None):
@@ -171,8 +270,13 @@ def main(argv=None):
                     help="machine-readable report on stdout")
     ap.add_argument("--hbm", action="store_true",
                     help="print the per-program HBM estimate table")
+    ap.add_argument("--optimize", action="store_true",
+                    help="run the graftopt transform on each program and "
+                         "print the before/after GI003 bracket plus the "
+                         "applied-rewrite table (findings are computed "
+                         "on the OPTIMIZED programs)")
     ap.add_argument("--checks-json", action="store_true",
-                    help="emit the three run_static_checks rows as JSON "
+                    help="emit the four run_static_checks rows as JSON "
                          "(the CI aggregator's consumer interface)")
     ap.add_argument("--list-passes", action="store_true")
     ap.add_argument("--list-programs", action="store_true")
@@ -227,6 +331,9 @@ def main(argv=None):
         print(json.dumps({"ok": all(r["ok"] for r in rows),
                           "checks": rows}, indent=1, sort_keys=True))
         return 0 if all(r["ok"] for r in rows) else 1
+
+    if args.optimize:
+        return _main_optimize(names, passes, json_out=args.json)
 
     baseline_path = "" if args.no_baseline else args.baseline
     new, base, programs, errors = analyze_flagship(

@@ -22,8 +22,8 @@ from __future__ import annotations
 import re
 
 __all__ = ["COLLECTIVE_RE", "COLLECTIVE_PRIMITIVES", "census_hlo",
-           "census_lowered", "census_jaxpr", "collective_sequence",
-           "iter_subjaxprs"]
+           "census_lowered", "census_jaxpr", "byte_census_jaxpr",
+           "collective_sequence", "iter_subjaxprs"]
 
 # matches both optimized-HLO (all-reduce) and StableHLO
 # (stablehlo.all_reduce) spellings — the census reader accepts either
@@ -124,3 +124,50 @@ def census_jaxpr(jaxpr):
     for name, _axes in collective_sequence(jaxpr):
         counts[name] = counts.get(name, 0) + 1
     return counts
+
+
+def _aval_bytes(aval):
+    """Buffer bytes of one abstract value (duck-typed; 0 for tokens)."""
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * dtype.itemsize
+
+
+def byte_census_jaxpr(jaxpr):
+    """Per-collective BYTE sizes over a traced jaxpr (recursive):
+    ``{canonical-collective: {"count": n, "bytes": b}}``, the
+    bytes-on-wire prep ROADMAP item 2 asks for.
+
+    ``bytes`` is each collective eqn's per-device PAYLOAD — the larger
+    of its operand and result buffer bytes (an ``all_gather``'s output
+    is what moves; a ``reduce_scatter``'s input is) as the jaxpr sees
+    them: inside a ``shard_map`` body avals are already local, so the
+    number is per device, not global. This is payload accounting, not
+    a ring-algorithm model (a ring all-reduce moves ~2x its payload);
+    and like :func:`census_jaxpr` it counts a scan/while body ONCE per
+    trace while the live program pays it per iteration. Collectives
+    GSPMD inserts on auto axes exist only post-compile — the HLO
+    census counts them, this one cannot price them."""
+    out = {}
+
+    def _visit(j):
+        for eqn in j.eqns:
+            canon = COLLECTIVE_PRIMITIVES.get(eqn.primitive.name)
+            if canon is not None:
+                b_in = sum(_aval_bytes(getattr(v, "aval", None))
+                           for v in eqn.invars)
+                b_out = sum(_aval_bytes(getattr(v, "aval", None))
+                            for v in eqn.outvars)
+                row = out.setdefault(canon, {"count": 0, "bytes": 0})
+                row["count"] += 1
+                row["bytes"] += max(b_in, b_out)
+            for _slot, sub in iter_subjaxprs(eqn):
+                _visit(sub)
+
+    _visit(jaxpr)
+    return out

@@ -37,6 +37,34 @@ def _is_var(v):
     return hasattr(v, "aval") and not hasattr(v, "val")
 
 
+#: primitives expensive enough that a duplicate is worth flagging —
+#: ONE set shared by GI004's lint and graftopt's CSE rewrite (opt.py),
+#: so what the lint flags is exactly what the rewrite folds
+EXPENSIVE_PRIMS = frozenset({
+    "dot_general", "conv_general_dilated", "exp", "log", "rsqrt",
+    "sqrt", "tanh", "erf", "logistic", "integer_pow", "pow", "div",
+    "reduce_sum", "reduce_max", "reduce_min", "cumsum", "cumlogsumexp",
+    "sort", "argmax", "argmin",
+})
+
+
+def eqn_structural_key(eqn):
+    """Structural identity of one sub-jaxpr-free eqn: primitive, params,
+    and operands — vars by identity, LITERALS by value+dtype (the
+    per-parameter bias-correction ``pow(beta, step)`` shape). The one
+    key both GI004's duplicate lint and graftopt's CSE fold on."""
+    ops = []
+    for v in eqn.invars:
+        if _is_var(v):
+            ops.append(id(v))
+        else:
+            aval = getattr(v, "aval", None)
+            ops.append(("lit", repr(getattr(v, "val", None)),
+                        str(getattr(aval, "dtype", "?"))))
+    params = tuple(sorted((k, repr(v)) for k, v in eqn.params.items()))
+    return (eqn.primitive.name, params, tuple(ops))
+
+
 def _walk_eqns(jaxpr, path=""):
     """(path, jaxpr, eqn_index, eqn) over every level, depth-first."""
     for i, eqn in enumerate(jaxpr.eqns):
@@ -243,11 +271,7 @@ class FusionOpportunity(IRPass):
                  "disagreeing operand shardings each cost an avoidable "
                  "buffer or collective per step")
 
-    EXPENSIVE = {"dot_general", "conv_general_dilated", "exp", "log",
-                 "rsqrt", "sqrt", "tanh", "erf", "logistic",
-                 "integer_pow", "div", "reduce_sum", "reduce_max",
-                 "reduce_min", "cumsum", "cumlogsumexp", "sort",
-                 "argmax", "argmin"}
+    EXPENSIVE = EXPENSIVE_PRIMS
 
     def check(self, program):
         out = []
@@ -298,13 +322,9 @@ class FusionOpportunity(IRPass):
             name = eqn.primitive.name
             if name not in self.EXPENSIVE:
                 continue
-            if not all(_is_var(v) for v in eqn.invars):
-                continue
             if next(_coll.iter_subjaxprs(eqn), None) is not None:
                 continue
-            params = tuple(sorted((k, repr(v))
-                                  for k, v in eqn.params.items()))
-            key = (name, params, tuple(id(v) for v in eqn.invars))
+            key = eqn_structural_key(eqn)
             first = seen.get(key)
             if first is None:
                 seen[key] = i

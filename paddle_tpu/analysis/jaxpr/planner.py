@@ -1,0 +1,363 @@
+"""The HBM-budget-driven rematerialization planner ("Memory Safe
+Computations with XLA", arXiv 2206.14148): remat granularity chosen
+FROM the declared memory budget, not a boolean.
+
+The all-or-nothing path (``LlamaConfig(recompute=True)`` wrapping EVERY
+decoder layer in ``recompute()``) trades maximum compute for maximum
+headroom whether the step needs it or not. This planner consumes what
+``assert_hbm_budget`` / ``budgets.json`` already declare and nothing
+consumed before PR 12:
+
+1. trace the train step with NO remat and run GI003's liveness walk
+   (:mod:`.hbm`) — if the bracket already fits the budget the plan is
+   EMPTY (zero recompute paid);
+2. otherwise rank the candidate remat sites (the decoder layers — any
+   sublayer carrying a ``_recompute`` flag and a ``_block`` body) by
+   bytes-freed-per-flop-recomputed: bytes freed measured by re-tracing
+   with exactly one site rematted and diffing the GI003 estimate,
+   flops recomputed priced analytically at ``2 * site params * tokens``
+   (one extra forward through the site's matmuls per backward).
+   Structurally identical sites (same class, same param count — the
+   homogeneous-decoder common case) rank uniformly, and the planner
+   then BISECTS over the prefix length instead of paying one trace per
+   site;
+3. greedily grow the remat set in rank order (deterministic index tie
+   break), re-estimating after each addition, until the GI003 estimate
+   fits; then sweep once backwards dropping any site whose removal
+   still fits — the minimal-set polish. Same budget, same model, same
+   batch ⇒ same plan (the tier-1 determinism test).
+
+Everything here is TRACE-only (``jax.make_jaxpr`` through graftir's
+:func:`~.ir.trace`): planning never compiles, never dispatches, and
+costs ``O(candidates + log candidates)`` traces in the ranked case,
+``O(log candidates)`` in the uniform case.
+
+Importing this module costs stdlib only; the framework loads when a
+plan is built.
+"""
+from __future__ import annotations
+
+from .hbm import HBMBudgetExceeded, estimate
+from .ir import trace
+
+__all__ = ["RematPlanError", "remat_candidates", "apply_remat_plan",
+           "candidate_flops", "plan_budget_remat", "plan_for_mesh_step",
+           "plan_for_model"]
+
+
+class RematPlanError(HBMBudgetExceeded):
+    """No remat set over the declared candidates brings the program
+    under budget — the budget is unsatisfiable at this batch/model
+    shape (shrink the batch, grow the budget, or add remat sites)."""
+
+
+def remat_candidates(model):
+    """Ordered ``[(name, layer)]`` remat sites of a model: every
+    sublayer carrying both a ``_recompute`` flag and a ``_block`` body
+    (the llama/gpt decoder-layer contract). Order is the model's own
+    traversal order, which makes plans reproducible."""
+    out = []
+    seen = set()
+    for name, sub in model.named_sublayers():
+        if (hasattr(sub, "_recompute") and hasattr(sub, "_block")
+                and id(sub) not in seen):
+            seen.add(id(sub))
+            out.append((name, sub))
+    return out
+
+
+def apply_remat_plan(candidates, site_indices):
+    """Set each candidate's ``_recompute`` flag from the plan (True for
+    chosen sites, False otherwise) and return the chosen names."""
+    chosen = set(site_indices)
+    names = []
+    for k, (name, layer) in enumerate(candidates):
+        layer._recompute = k in chosen
+        if k in chosen:
+            names.append(name)
+    return names
+
+
+def candidate_flops(layer, tokens):
+    """Analytic recompute price of one site: ~2 * params * tokens FLOPs
+    (one extra forward through the site's matmuls per backward pass)."""
+    import numpy as np
+
+    n = 0
+    for _name, p in layer.named_parameters():
+        shape = tuple(p.shape)
+        n += int(np.prod(shape)) if shape else 1
+    return 2 * n * max(int(tokens), 1)
+
+
+def _uniform(candidates):
+    """True when every candidate is structurally identical (same class,
+    same parameter count) — per-site bytes-freed traces would all
+    measure the same thing, so ranking is trivial and the planner can
+    bisect the prefix length instead."""
+    import numpy as np
+
+    sig = set()
+    for _name, layer in candidates:
+        n = sum(int(np.prod(tuple(p.shape)) if tuple(p.shape) else 1)
+                for _k, p in layer.named_parameters())
+        sig.add((type(layer).__name__, n))
+    return len(sig) <= 1
+
+
+def plan_budget_remat(estimate_for, candidates, budget, tokens=1,
+                      policy="budget"):
+    """Core algorithm: choose the minimal remat site set bringing the
+    GI003 estimate of ``estimate_for(site_indices)`` under ``budget``.
+
+    ``estimate_for`` is a caller-supplied closure: given a tuple of
+    candidate indices to remat, rebuild + trace the step and return the
+    GI003 estimate dict. Returns the plan dict (stamped into
+    ``MeshParallel.meta['remat_plan']`` and bench provenance); raises
+    :class:`RematPlanError` when even the full set does not fit.
+    """
+    budget = int(budget)
+    n = len(candidates)
+    traces = [0]
+
+    cache = {}
+
+    def est(sites):
+        sites = tuple(sorted(sites))
+        if sites not in cache:
+            traces[0] += 1
+            cache[sites] = estimate_for(sites)
+        return cache[sites]
+
+    base = est(())
+    plan = {
+        "policy": policy, "budget_bytes": budget,
+        "base_peak_bytes": base["peak_bytes"],
+        "base_bracket": [base["peak_sched_bytes"],
+                         base["peak_order_bytes"]],
+        "n_candidates": n,
+    }
+    if base["peak_bytes"] <= budget or n == 0:
+        if base["peak_bytes"] > budget:
+            raise RematPlanError(
+                f"budget {budget} bytes unsatisfiable: no remat "
+                f"candidates and the no-remat estimate is "
+                f"{base['peak_bytes']} bytes",
+                estimate=base["peak_bytes"], budget=budget)
+        plan.update({"sites": [], "site_indices": [],
+                     "planned_peak_bytes": base["peak_bytes"],
+                     "planned_bracket": plan["base_bracket"],
+                     "uniform": True, "n_traces": traces[0],
+                     "scores": {}})
+        return plan
+
+    uniform = _uniform(candidates)
+    scores = {}
+    if uniform:
+        # identical sites: rank = index order; bisect the prefix length
+        order = list(range(n))
+        lo, hi = 1, n
+        full = est(tuple(range(n)))
+        if full["peak_bytes"] > budget:
+            raise RematPlanError(
+                f"budget {budget} bytes unsatisfiable: even full remat "
+                f"of all {n} candidate site(s) estimates "
+                f"{full['peak_bytes']} bytes",
+                estimate=full["peak_bytes"], budget=budget)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if est(tuple(range(mid)))["peak_bytes"] <= budget:
+                hi = mid
+            else:
+                lo = mid + 1
+        chosen = list(range(lo))
+    else:
+        for k, (name, layer) in enumerate(candidates):
+            freed = max(base["peak_bytes"]
+                        - est((k,))["peak_bytes"], 0)
+            flops = max(candidate_flops(layer, tokens), 1)
+            scores[name] = freed / flops
+        order = sorted(range(n),
+                       key=lambda k: (-scores[candidates[k][0]], k))
+        chosen = []
+        for k in order:
+            chosen.append(k)
+            if est(tuple(chosen))["peak_bytes"] <= budget:
+                break
+        else:
+            full = est(tuple(chosen))
+            raise RematPlanError(
+                f"budget {budget} bytes unsatisfiable: even full remat "
+                f"of all {n} candidate site(s) estimates "
+                f"{full['peak_bytes']} bytes",
+                estimate=full["peak_bytes"], budget=budget)
+        # minimal-set polish: drop any member whose removal still fits
+        # (reverse addition order so the cheapest wins stay longest)
+        for k in list(reversed(chosen)):
+            if len(chosen) == 1:
+                break
+            rest = [c for c in chosen if c != k]
+            if est(tuple(rest))["peak_bytes"] <= budget:
+                chosen = rest
+
+    final = est(tuple(chosen))
+    plan.update({
+        "sites": [candidates[k][0] for k in sorted(chosen)],
+        "site_indices": sorted(chosen),
+        "planned_peak_bytes": final["peak_bytes"],
+        "planned_bracket": [final["peak_sched_bytes"],
+                            final["peak_order_bytes"]],
+        "uniform": uniform, "n_traces": traces[0],
+        "scores": scores,
+    })
+    return plan
+
+
+def plan_for_mesh_step(model, optimizer, loss_fn, ctx, batch, budget, *,
+                       shard_optimizer=False, program="mesh.train_step"):
+    """Plan + apply budget remat for the ``parallelize()`` mesh train
+    step: each probe rebuilds the step through the SAME production
+    builder (``mesh.parallelize.build_mesh_step``) with the probe's
+    remat flags set, traces it (make_jaxpr only — the state from the
+    first build is reused, so probes never re-place arrays on the
+    mesh), and reads the GI003 estimate. On return the model's layer
+    flags hold the chosen plan."""
+    from ...framework.core import Tensor
+    from ...mesh.parallelize import build_mesh_step
+
+    candidates = remat_candidates(model)
+    saved = [layer._recompute for _name, layer in candidates]
+    batch_vals = [b.value if isinstance(b, Tensor) else b for b in batch]
+    tokens = 1
+    if batch_vals and getattr(batch_vals[0], "ndim", 0) >= 2:
+        tokens = (int(batch_vals[0].shape[0])
+                  * int(batch_vals[0].shape[1]))
+    state_box = {}
+
+    def estimate_for(sites):
+        apply_remat_plan(candidates, sites)
+        jitted, state_fn, _params, _meta = build_mesh_step(
+            model, optimizer, loss_fn, ctx, batch,
+            shard_optimizer=shard_optimizer)
+        if "state" not in state_box:
+            state_box["state"] = state_fn()
+        pv, av, mv = state_box["state"]
+        prog = trace(jitted, (pv, av, mv, *batch_vals),
+                     f"{program}[remat={sorted(sites)}]")
+        return estimate(prog)
+
+    try:
+        with _optimizer_host_state(optimizer):
+            plan = plan_budget_remat(estimate_for, candidates, budget,
+                                     tokens=tokens)
+    except Exception:
+        for (name, layer), flag in zip(candidates, saved):
+            layer._recompute = flag
+        raise
+    apply_remat_plan(candidates, plan["site_indices"])
+    plan["program"] = program
+    return plan
+
+
+def _optimizer_host_state(optimizer):
+    """Context manager: planning probes trace ``optimizer.step()``,
+    whose HOST-side bookkeeping (step count, lazily-created master
+    weights) must not drift with the number of traces — a plan is a
+    read-only question. Accumulator VALUES are already restored by the
+    step bodies' own try/finally."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _guard():
+        step_count = optimizer._step_count
+        masters = dict(optimizer._master_weights)
+        try:
+            yield
+        finally:
+            optimizer._step_count = step_count
+            optimizer._master_weights = masters
+
+    return _guard()
+
+
+def plan_for_model(model, optimizer, loss_fn, batch, budget, *,
+                   program="train_step"):
+    """Plan + apply budget remat for a SINGLE-DEVICE train step (the
+    ``Model``/eager fit path): probes trace a functional train step —
+    loss, backward, optimizer update threaded exactly like
+    ``parallelize()``'s body, minus the collectives — with params /
+    accumulators / masters donated, so the GI003 walk prices the step
+    the way the jitted trainer would run it."""
+    import jax
+
+    from ...autograd import tape as _tape  # noqa: F401 - tape must be live
+    from ...framework import random as rng
+    from ...framework.core import Tensor
+
+    candidates = remat_candidates(model)
+    saved = [layer._recompute for _name, layer in candidates]
+    params = [p for _name, p in model.named_parameters()]
+    for p in params:
+        if id(p) not in optimizer._accumulators:
+            optimizer._accumulators[id(p)] = optimizer._init_state(p)
+    acc_keys = [sorted(optimizer._accumulators[id(p)].keys())
+                for p in params]
+    batch_vals = [b.value if isinstance(b, Tensor) else b for b in batch]
+    tokens = 1
+    if batch_vals and getattr(batch_vals[0], "ndim", 0) >= 2:
+        tokens = (int(batch_vals[0].shape[0])
+                  * int(batch_vals[0].shape[1]))
+
+    def make_step():
+        # a FRESH function object per probe: jax keys trace caches on
+        # function identity, and a cached jaxpr would freeze the FIRST
+        # probe's remat flags into every later probe
+        def step(param_values, acc_values, *bvals):
+            with rng.trace_key(jax.random.PRNGKey(0)):
+                saved_p = [(p, p._value) for p in params]
+                saved_a = {id(p): dict(optimizer._accumulators[id(p)])
+                           for p in params}
+                try:
+                    for p, v in zip(params, param_values):
+                        p._replace_value(v)
+                    loss = loss_fn(model, *[Tensor(b) for b in bvals])
+                    loss.backward()
+                    for p, ks, vs in zip(params, acc_keys, acc_values):
+                        for k, v in zip(ks, vs):
+                            optimizer._accumulators[id(p)][k] = v
+                    optimizer.step()
+                    optimizer.clear_grad()
+                    new_p = [p._value for p in params]
+                    new_a = [[optimizer._accumulators[id(p)][k]
+                              for k in ks]
+                             for p, ks in zip(params, acc_keys)]
+                    return loss.value, new_p, new_a
+                finally:
+                    for p, v in saved_p:
+                        p._replace_value(v)
+                    for p in params:
+                        optimizer._accumulators[id(p)] = saved_a[id(p)]
+        return step
+
+    pv = [p.value for p in params]
+    av = [[optimizer._accumulators[id(p)][k] for k in ks]
+          for p, ks in zip(params, acc_keys)]
+
+    def estimate_for(sites):
+        apply_remat_plan(candidates, sites)
+        prog = trace(make_step(), (pv, av, *batch_vals),
+                     f"{program}[remat={sorted(sites)}]",
+                     donate_argnums=(0, 1))
+        return estimate(prog)
+
+    try:
+        with _optimizer_host_state(optimizer):
+            plan = plan_budget_remat(estimate_for, candidates, budget,
+                                     tokens=tokens)
+    except Exception:
+        for (name, layer), flag in zip(candidates, saved):
+            layer._recompute = flag
+        raise
+    apply_remat_plan(candidates, plan["site_indices"])
+    plan["program"] = program
+    return plan

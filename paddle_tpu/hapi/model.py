@@ -47,6 +47,7 @@ class Model:
         self._optimizer = None
         self._loss = None
         self._metrics = []
+        self._remat_plan = None
         self.stop_training = False
 
     # -- setup ---------------------------------------------------------------
@@ -59,11 +60,58 @@ class Model:
         self._metrics = _to_list(metrics)
         return self
 
+    def plan_remat(self, inputs, labels=None, budget=None):
+        """Budget-driven remat for the eager fit path: trace a
+        functional train step over this batch, run the graftopt planner
+        (``analysis/jaxpr/planner.plan_for_model``) against ``budget``
+        bytes of per-device HBM (default: the network config's
+        ``hbm_budget``), and APPLY the minimal per-layer remat set —
+        the ``recompute_policy="budget"`` replacement for the
+        all-or-nothing ``recompute=True``. Returns the plan dict; a
+        network whose config declares ``recompute_policy="budget"``
+        plans automatically on its first ``train_batch``/``fit``
+        batch."""
+        from ..analysis.jaxpr import planner as _planner
+
+        if self._optimizer is None:
+            raise RuntimeError(
+                "plan_remat needs an optimizer: call prepare() first")
+        cfg = getattr(self.network, "config", None)
+        if budget is None:
+            budget = getattr(cfg, "hbm_budget", None)
+        if budget is None:
+            raise ValueError(
+                "plan_remat needs a budget: pass budget= or set "
+                "hbm_budget on the network config")
+        self.network.train()  # remat wraps only in training mode
+        ins = [_to_tensor(x) for x in _to_list(inputs)]
+        lbs = [_to_tensor(x) for x in _to_list(labels)]
+        n_in = len(ins)
+        loss_obj = self._loss
+
+        def loss_fn(net, *tensors):
+            outs = _to_list(net(*tensors[:n_in]))
+            losses = (_to_list(loss_obj(*(outs + list(tensors[n_in:]))))
+                      if loss_obj else outs)
+            total = losses[0]
+            for l in losses[1:]:  # noqa: E741
+                total = total + l
+            return total
+
+        self._remat_plan = _planner.plan_for_model(
+            self.network, self._optimizer, loss_fn, tuple(ins + lbs),
+            budget)
+        return self._remat_plan
+
     # -- single-batch APIs ----------------------------------------------------
     def train_batch(self, inputs, labels=None, update=True):
         # stage spans (monitor.trace, no-ops when tracing is off) nest under
         # the fit() loop's train.step root via implicit thread parenting —
         # the training-step decomposition of docs/tracing.md
+        if (self._remat_plan is None and self._optimizer is not None
+                and getattr(getattr(self.network, "config", None),
+                            "recompute_policy", None) == "budget"):
+            self.plan_remat(inputs, labels)
         self.network.train()
         inputs = [_to_tensor(x) for x in _to_list(inputs)]
         labels = [_to_tensor(x) for x in _to_list(labels)]

@@ -249,14 +249,22 @@ class MeshParallel:
     gauge, recompile accounting for graftsan)."""
 
     def __init__(self, model, optimizer, loss_fn, ctx, batch, *,
-                 shard_optimizer=False):
+                 shard_optimizer=False, recompute_policy=None,
+                 hbm_budget=None):
         self.model = model
         self.optimizer = optimizer
         self.ctx = ctx
         self.shard_optimizer = bool(shard_optimizer)
+        self.remat_plan = None
+        if recompute_policy is not None:
+            self.remat_plan = _resolve_remat(
+                model, optimizer, loss_fn, ctx, batch, recompute_policy,
+                hbm_budget, shard_optimizer)
         (self._jitted, state_fn, self.params,
          self.meta) = build_mesh_step(model, optimizer, loss_fn, ctx, batch,
                                       shard_optimizer=shard_optimizer)
+        if self.remat_plan is not None:
+            self.meta["remat_plan"] = self.remat_plan
         self._pv, self._av, self._mv = state_fn()
         self._acc_keys = [sorted(optimizer._accumulators[id(p)].keys())
                           for p in self.params]
@@ -265,6 +273,7 @@ class MeshParallel:
                             for i, p in enumerate(self.params)]
         self._steps = 0
         self._collectives = None
+        self._collective_bytes = None
         self._mon = None
         self._gauge_set = False
 
@@ -304,6 +313,25 @@ class MeshParallel:
             self._collectives = _collectives.census_lowered(lowered)
         return self._collectives
 
+    def collective_bytes(self, *batch):
+        """Per-collective BYTES-on-wire of the step program
+        (``analysis/jaxpr/collectives.byte_census_jaxpr`` over the
+        traced step): ``{collective: {"count", "bytes"}}`` with bytes
+        the per-device payload of each hand-placed (manual-axis)
+        collective. GSPMD-inserted collectives on auto axes are priced
+        0 here — the HLO census in :meth:`collective_counts` still
+        counts their ops. Cached after the first trace; surfaced as
+        ``<collective>_bytes`` attrs on ``comm.mesh_step`` spans and
+        in the mesh_bench rows (ROADMAP item 2's prep)."""
+        if self._collective_bytes is None:
+            vals = [b.value if isinstance(b, Tensor) else jnp.asarray(b)
+                    for b in batch]
+            closed = jax.make_jaxpr(self._jitted)(
+                self._pv, self._av, self._mv, *vals)
+            self._collective_bytes = _collectives.byte_census_jaxpr(
+                closed.jaxpr)
+        return self._collective_bytes
+
     # -- the step ------------------------------------------------------------
     def step(self, *batch):
         """Run one donated mesh train step on a GLOBAL batch; returns the
@@ -342,6 +370,8 @@ class MeshParallel:
                 attrs = {"dp": dp, "step": self._steps,
                          "zero": self.shard_optimizer}
                 attrs.update(self.collective_counts(*batch))
+                for coll, row in self.collective_bytes(*batch).items():
+                    attrs[f"{coll}_bytes"] = row["bytes"]
                 _m.trace.record_span("comm.mesh_step", t0, t1, attrs=attrs)
         return Tensor(loss)
 
@@ -381,6 +411,50 @@ class MeshParallel:
         return self.model
 
 
+def _resolve_remat(model, optimizer, loss_fn, ctx, batch, policy, budget,
+                   shard_optimizer):
+    """Resolve a ``recompute_policy`` into applied per-layer remat flags
+    and a plan dict (stamped into ``meta['remat_plan']`` and bench
+    provenance). ``"none"``/``"all"`` are the legacy endpoints of the
+    old boolean; ``"budget"`` runs the graftopt planner against the
+    declared HBM headroom (``hbm_budget``, falling back to the
+    flagship ``budgets.json`` row for ``mesh.train_step``)."""
+    import logging
+
+    from ..analysis.jaxpr import planner as _planner
+
+    candidates = _planner.remat_candidates(model)
+    if policy in ("none", "all"):
+        sites = range(len(candidates)) if policy == "all" else ()
+        names = _planner.apply_remat_plan(candidates, sites)
+        plan = {"policy": policy, "sites": names,
+                "site_indices": sorted(sites),
+                "n_candidates": len(candidates),
+                "program": "mesh.train_step"}
+    elif policy == "budget":
+        if budget is None:
+            from ..analysis.jaxpr import load_budgets
+
+            budget = load_budgets().get("mesh.train_step")
+        if budget is None:
+            raise ValueError(
+                "recompute_policy='budget' needs a budget: pass "
+                "config={'hbm_budget': bytes} or declare a "
+                "mesh.train_step row in analysis/jaxpr/budgets.json")
+        plan = _planner.plan_for_mesh_step(
+            model, optimizer, loss_fn, ctx, batch, budget,
+            shard_optimizer=shard_optimizer)
+    else:
+        raise ValueError(
+            f"unknown recompute_policy {policy!r} "
+            "(expected 'none', 'all' or 'budget')")
+    logging.getLogger("paddle_tpu.graftopt").info(
+        "remat plan (%s): %d/%d site(s) %s, planned peak %s bytes",
+        plan["policy"], len(plan["sites"]), plan["n_candidates"],
+        plan["sites"], plan.get("planned_peak_bytes", "n/a"))
+    return plan
+
+
 def parallelize(model, optimizer, loss_fn, batch, mesh=None, config=None):
     """Lower a fleet-style hybrid config onto mesh axes and return a
     :class:`MeshParallel` step.
@@ -389,12 +463,23 @@ def parallelize(model, optimizer, loss_fn, batch, mesh=None, config=None):
     ``dp_degree`` (default: all visible devices), ``mp_degree`` (default 1 —
     >1 requires the model to be built with the fleet TP layers under an
     initialized hybrid topology), ``shard_optimizer`` (ZeRO-1 knob, default
-    False). An explicit ``mesh`` (MeshContext) overrides the degrees; when
-    fleet is initialized and no mesh/config pins the degrees, the fleet
-    topology is adopted.
+    False), ``recompute_policy`` (``'none'`` / ``'all'`` / ``'budget'`` —
+    the budget planner replaces the all-or-nothing per-layer
+    ``recompute()``; defaults to the model config's own
+    ``recompute_policy`` when it declares one) and ``hbm_budget`` (bytes
+    of per-device HBM the ``'budget'`` policy plans against; defaults to
+    the model config's ``hbm_budget``, then the ``mesh.train_step``
+    budgets.json row). An explicit ``mesh`` (MeshContext) overrides the
+    degrees; when fleet is initialized and no mesh/config pins the
+    degrees, the fleet topology is adopted.
     """
     config = dict(config or {})
     shard_opt = bool(config.pop("shard_optimizer", False))
+    model_cfg = getattr(model, "config", None)
+    policy = config.pop("recompute_policy",
+                        getattr(model_cfg, "recompute_policy", None))
+    budget = config.pop("hbm_budget",
+                        getattr(model_cfg, "hbm_budget", None))
     if mesh is None:
         dp = config.get("dp_degree")
         mp = int(config.get("mp_degree", 1))
@@ -408,4 +493,5 @@ def parallelize(model, optimizer, loss_fn, batch, mesh=None, config=None):
                 dp = max(1, jax.device_count() // mp)
             mesh = MeshContext.from_degrees(dp=int(dp), mp=mp)
     return MeshParallel(model, optimizer, loss_fn, mesh, batch,
-                        shard_optimizer=shard_opt)
+                        shard_optimizer=shard_opt,
+                        recompute_policy=policy, hbm_budget=budget)

@@ -35,6 +35,8 @@ class GPTConfig:
         sequence_parallel=False,
         pipeline_parallel_degree=1,
         recompute=False,
+        recompute_policy=None,
+        hbm_budget=None,
         **kwargs,
     ):
         self.vocab_size = vocab_size
@@ -53,6 +55,10 @@ class GPTConfig:
         self.sequence_parallel = sequence_parallel
         self.pipeline_parallel_degree = pipeline_parallel_degree
         self.recompute = recompute
+        # same contract as LlamaConfig: "none"/"all"/"budget", with
+        # "budget" consuming hbm_budget via the graftopt remat planner
+        self.recompute_policy = recompute_policy
+        self.hbm_budget = hbm_budget
         for k, v in kwargs.items():
             setattr(self, k, v)
 

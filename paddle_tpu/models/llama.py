@@ -53,6 +53,8 @@ class LlamaConfig:
         pipeline_parallel_degree=1,
         recompute=False,
         recompute_granularity="full",
+        recompute_policy=None,
+        hbm_budget=None,
         fused_head_ce=False,
         dtype="float32",
         **kwargs,
@@ -78,6 +80,13 @@ class LlamaConfig:
         self.pipeline_parallel_degree = pipeline_parallel_degree
         self.recompute = recompute
         self.recompute_granularity = recompute_granularity
+        # recompute_policy replaces the all-or-nothing `recompute` bool:
+        # "none"/"all" are its endpoints; "budget" lets the graftopt
+        # planner (analysis/jaxpr/planner.py) pick the MINIMAL per-layer
+        # remat set that fits hbm_budget bytes of per-device HBM —
+        # consumed by mesh.parallelize() and hapi.Model.plan_remat()
+        self.recompute_policy = recompute_policy
+        self.hbm_budget = hbm_budget
         self.fused_head_ce = fused_head_ce
         self.dtype = dtype
         for k, v in kwargs.items():
@@ -105,7 +114,12 @@ def _mp_linears(config):
     return ColumnParallelLinear, RowParallelLinear
 
 
+@ops.fuse(static_argnums=(0, 1, 2, 3))
 def _rope_cos_sin(seq_len, head_dim, theta, dtype):
+    # every argument is static, so the eager path pays ONE cached
+    # dispatch per (seq, dim) instead of rebuilding the table op by op
+    # each attention layer (ops/fused.py — the elementwise-chain twin
+    # of the graftopt outline rewrite)
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
     t = jnp.arange(seq_len, dtype=jnp.float32)
     freqs = jnp.outer(t, inv_freq)            # (S, D/2)

@@ -7,6 +7,7 @@ from __future__ import annotations
 
 from ..framework.core import Tensor
 from ._apply import apply, apply_raw, defop, get_registry, register_op  # noqa: F401
+from .fused import fuse  # noqa: F401
 
 from .creation import (  # noqa: F401
     arange, assign, clone, complex, diag, diag_embed, diagflat, empty, empty_like, eye, full,

@@ -1,0 +1,50 @@
+"""Elementwise-chain fusion at the ops layer: fold a pure jnp chain
+into ONE dispatch region.
+
+The graftopt jaxpr rewrites (``analysis/jaxpr/opt.py``) fold chains the
+COMPILED programs carry; this is the eager-side twin for hot chains in
+model code that run outside any jit ("Operator Fusion in XLA", arXiv
+2301.13062 — a chain the author already knows is one fusible region
+should be handed to XLA as one region, not rediscovered op by op):
+
+- eager call: the chain dispatches as ONE cached XLA executable
+  (``jax.jit`` keyed on avals + static args) instead of one tiny
+  executable per primitive — the dispatch-count win the rope-table
+  build in ``models/llama.py`` pays every attention layer;
+- under an outer trace the wrapper inlines as a single ``pjit`` region
+  (the "fused closure" of ROADMAP item 3), so jitted step programs are
+  unchanged in semantics and the GI003 walk prices it like any inline
+  call.
+
+This is for RAW-jnp helpers only. Tensor-level chains belong in a
+``defop`` (one tape node, one cached vjp) — see ``ops/_apply.py``.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+__all__ = ["fuse"]
+
+
+def fuse(fn=None, *, static_argnums=()):
+    """Decorator: run a pure jnp elementwise chain as one fused region.
+
+    ``static_argnums`` marks python-value arguments (shapes, dtypes,
+    scalars) that select the compiled variant — exactly
+    ``jax.jit``'s contract. The wrapped function keeps its eager
+    signature and numerics bit-for-bit (same ops, same order; XLA
+    fusion does not reassociate floats).
+    """
+    def deco(f):
+        jf = jax.jit(f, static_argnums=static_argnums)
+
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            return jf(*args, **kwargs)
+
+        wrapper.__wrapped__ = f
+        return wrapper
+
+    return deco(fn) if fn is not None else deco

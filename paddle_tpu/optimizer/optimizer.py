@@ -131,6 +131,15 @@ class Optimizer:
     def _hyper(self, group):
         return {}
 
+    def _precompute(self, step, hyper):
+        """Per-STEP shared subexpressions of the update rule, computed
+        ONCE per fused apply and passed to every per-param ``_rule``
+        call. Adam's bias corrections ``1 - beta^t`` were traced once
+        per PARAMETER before this hook — the duplicate-subexpression
+        shape graftir's GI004 flags (and graftopt's CSE rewrite would
+        fold); hoisting them here burns the finding at the source."""
+        return {}
+
     # -- step ----------------------------------------------------------------
     @jax.named_scope("optimizer_step")
     def step(self):
@@ -189,10 +198,12 @@ class Optimizer:
         fn = self._jit_cache.get(key)
         if fn is None:
             rule = self._rule
+            precompute = self._precompute
             coupled = self._coupled_decay
 
             def apply_all(p_vals, g_vals, states, masters, lr, step):
                 outs, out_states, out_masters = [], [], []
+                shared = precompute(step, hyper)
                 for pv, gv, st, mw, mult in zip(p_vals, g_vals, states, masters,
                                                 list(lr_mults)):
                     p32 = mw if mw is not None else pv.astype(jnp.float32)
@@ -202,7 +213,7 @@ class Optimizer:
                     elif wd and coupled == "l1":
                         g32 = g32 + wd * jnp.sign(p32)
                     new_p32, new_st = rule(p32, g32, st, lr * mult, step=step, wd=wd,
-                                           **hyper)
+                                           **hyper, **shared)
                     outs.append(new_p32.astype(pv.dtype))
                     out_states.append(new_st)
                     out_masters.append(new_p32 if mw is not None else None)
@@ -343,11 +354,21 @@ class Adam(Optimizer):
             "eps": self._eps,
         }
 
-    def _rule(self, p, g, state, lr, beta1=0.9, beta2=0.999, eps=1e-8, step=1.0, **kw):
+    def _precompute(self, step, hyper):
+        # bias corrections are functions of the STEP alone: one pair of
+        # pow()s per apply, not one per parameter (GI004 duplicate-
+        # subexpression burn; bit-identical — same ops, same order)
+        beta1 = hyper.get("beta1", self._beta1)
+        beta2 = hyper.get("beta2", self._beta2)
+        return {"bias1": 1 - jnp.power(beta1, step),
+                "bias2": 1 - jnp.power(beta2, step)}
+
+    def _rule(self, p, g, state, lr, beta1=0.9, beta2=0.999, eps=1e-8, step=1.0,
+              bias1=None, bias2=None, **kw):
         m = beta1 * state["moment1"] + (1 - beta1) * g
         v = beta2 * state["moment2"] + (1 - beta2) * jnp.square(g)
-        mhat = m / (1 - jnp.power(beta1, step))
-        vhat = v / (1 - jnp.power(beta2, step))
+        mhat = m / (bias1 if bias1 is not None else 1 - jnp.power(beta1, step))
+        vhat = v / (bias2 if bias2 is not None else 1 - jnp.power(beta2, step))
         new_state = {"moment1": m, "moment2": v}
         if self._amsgrad:
             vmax = jnp.maximum(state["moment2_max"], vhat)
@@ -373,7 +394,8 @@ class AdamW(Adam):
     def _rule(self, p, g, state, lr, beta1=0.9, beta2=0.999, eps=1e-8, step=1.0, wd=0.0,
               **kw):
         p = p * (1 - lr * wd)
-        return super()._rule(p, g, state, lr, beta1, beta2, eps, step=step)
+        # kw threads the hoisted bias1/bias2 through to Adam's rule
+        return super()._rule(p, g, state, lr, beta1, beta2, eps, step=step, **kw)
 
     def step(self):
         # honor apply_decay_param_fun by zeroing decay for excluded params via groups
@@ -407,10 +429,16 @@ class Adamax(Optimizer):
     def _hyper(self, group):
         return {"beta1": self._beta1, "beta2": self._beta2, "eps": self._eps}
 
-    def _rule(self, p, g, state, lr, beta1=0.9, beta2=0.999, eps=1e-8, step=1.0, **kw):
+    def _precompute(self, step, hyper):
+        return {"bias1": 1 - jnp.power(hyper.get("beta1", self._beta1),
+                                       step)}
+
+    def _rule(self, p, g, state, lr, beta1=0.9, beta2=0.999, eps=1e-8, step=1.0,
+              bias1=None, **kw):
         m = beta1 * state["moment"] + (1 - beta1) * g
         u = jnp.maximum(beta2 * state["inf_norm"], jnp.abs(g))
-        p_new = p - lr / (1 - jnp.power(beta1, step)) * m / (u + eps)
+        bc = bias1 if bias1 is not None else 1 - jnp.power(beta1, step)
+        p_new = p - lr / bc * m / (u + eps)
         return p_new, {"moment": m, "inf_norm": u}
 
 

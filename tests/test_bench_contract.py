@@ -41,6 +41,7 @@ def _run_bench(env_extra, cache_path, timeout=560):
     # cover them)
     env.setdefault("BENCH_SKIP_SERVING", "1")
     env.setdefault("BENCH_SKIP_HBM", "1")
+    env.setdefault("BENCH_SKIP_FUSION", "1")
     env.update(env_extra)
     p = subprocess.run([sys.executable, BENCH], capture_output=True,
                        text=True, timeout=timeout, env=env, cwd=ROOT)

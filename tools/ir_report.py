@@ -28,7 +28,9 @@ def main(argv=None):
         print("\nFlags pass through to `python -m paddle_tpu.analysis."
               "jaxpr` (--json, --programs, --passes, --baseline, "
               "--no-baseline, --update-baseline, --checks-json, "
-              "--list-passes, --list-programs).")
+              "--optimize, --list-passes, --list-programs). "
+              "--optimize prints the before/after GI003 bracket and "
+              "the applied-rewrite table of the graftopt transform.")
         return 0
 
     # the env half of programs.ensure_virtual_devices (the canonical
@@ -45,7 +47,8 @@ def main(argv=None):
     from paddle_tpu.analysis import jaxpr as graftir
 
     if not ({"--json", "--checks-json", "--update-baseline",
-             "--list-passes", "--list-programs", "--hbm"} & set(argv)):
+             "--list-passes", "--list-programs", "--hbm",
+             "--optimize"} & set(argv)):
         argv.append("--hbm")    # the report view this shim exists for
     return graftir.main(argv)
 

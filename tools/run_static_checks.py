@@ -23,15 +23,19 @@ One analysis pass (parse the tree once) feeds two result rows:
    ``faultinject.fire("<point>")`` site in the tree, and every fired
    point is declared — an undeclared drill or a dead catalog row is a
    CI failure, no baseline);
-7.-9. the graftir rows (``check_collective_consistency`` /
-   ``check_donation`` / ``check_hbm_budgets``): GI001/GI002/GI003 run
-   strict (no baseline) over the three FLAGSHIP live programs — the
-   serving mixed step, the decode burst, and the DP=8 ZeRO-1 mesh train
-   step — in ONE subprocess (``python -m paddle_tpu.analysis.jaxpr
-   --checks-json``), because the traced-IR checks need jax while this
-   aggregator itself stays importable without it. The rows run only for
-   THIS repo's root (fixture mini-trees have no live programs), and a
-   subprocess that dies contributes three failed rows, never a crash.
+7.-10. the graftir rows (``check_collective_consistency`` /
+   ``check_donation`` / ``check_hbm_budgets`` / ``check_opt_parity``):
+   GI001/GI002/GI003 run strict (no baseline) over the three FLAGSHIP
+   live programs — the serving mixed step, the decode burst, and the
+   DP=8 ZeRO-1 mesh train step — and ``check_opt_parity`` additionally
+   runs the graftopt transform (``analysis/jaxpr/opt.py``) on each
+   flagship and re-analyzes the OPTIMIZED program strict under
+   GI001–GI004 (budgets included), all in ONE subprocess
+   (``python -m paddle_tpu.analysis.jaxpr --checks-json``), because the
+   traced-IR checks need jax while this aggregator itself stays
+   importable without it. The rows run only for THIS repo's root
+   (fixture mini-trees have no live programs), and a subprocess that
+   dies contributes four failed rows, never a crash.
 
 Prints one status line per check, then a machine-readable JSON summary on
 stdout (``--json`` prints ONLY the JSON). Exit 0 iff every check passed.
@@ -120,11 +124,11 @@ def fault_point_problems(an, root=ROOT, project=None):
 
 
 GRAFTIR_CHECKS = ("check_collective_consistency", "check_donation",
-                  "check_hbm_budgets")
+                  "check_hbm_budgets", "check_opt_parity")
 
 
 def graftir_rows(root=ROOT, timeout=600):
-    """The three jaxpr-level rows, produced by one
+    """The four jaxpr-level rows, produced by one
     ``python -m paddle_tpu.analysis.jaxpr --checks-json`` subprocess
     with the 8-device virtual CPU mesh provisioned up front. Foreign
     roots (fixture mini-trees) get NO rows — the flagship programs are
