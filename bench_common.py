@@ -727,6 +727,41 @@ def mesh_bench(*, dp=8, tp=2, batch=8, seq=16, iters=3, vocab=128, hidden=64,
     zero_coll = zero1.collective_counts(ids, labels)
     zero_coll_bytes = zero1.collective_bytes(ids, labels)
 
+    # -- communication efficiency (ISSUE 13): int8 grad reduction with
+    # error feedback + bucketed backward-overlapped collectives, both on
+    # the ZeRO-1 step. Bytes come from the SAME jaxpr byte census (the
+    # compressed exchange's all_to_all eqns carry int8 avals), parity is
+    # the compressed-vs-uncompressed final-loss gap.
+    bucket_kib = 64                       # small models: force >1 bucket
+    m4, o4 = make()
+    comp = pmesh.parallelize(m4, o4, loss_fn, (ids, labels),
+                             config={"dp_degree": dp,
+                                     "shard_optimizer": True,
+                                     "grad_compression": "int8",
+                                     "overlap_grad_comm": True,
+                                     "bucket_bytes": bucket_kib << 10})
+    comp_dt, comp_loss = run_mesh_pass(comp)
+    comp_bytes = comp.collective_bytes(ids, labels)
+    comp_report = comp.comm_report(ids, labels)
+
+    m5, o5 = make()
+    over = pmesh.parallelize(m5, o5, loss_fn, (ids, labels),
+                             config={"dp_degree": dp,
+                                     "shard_optimizer": True,
+                                     "overlap_grad_comm": True,
+                                     "bucket_bytes": bucket_kib << 10})
+    over_dt, over_loss = run_mesh_pass(over)
+    over_report = over.comm_report(ids, labels)
+
+    # grad-reduction bytes on the wire: the uncompressed ZeRO exchange is
+    # the psum_scatter rows, the compressed one the all_to_all rows
+    # (payload + scales); the param all_gather is identical on both sides
+    grad_bytes_uncompressed = zero_coll_bytes.get(
+        "reduce_scatter", {}).get("bytes", 0)
+    grad_bytes_compressed = comp_bytes.get(
+        "all_to_all", {}).get("bytes", 0)
+    parity_bound = 2e-2 * max(1.0, abs(zero_loss))
+
     # -- DP x TP (the hybrid lowering path: fleet config -> mesh axes) ------
     dp2 = dp // tp
     strategy = fleet.DistributedStrategy()
@@ -757,10 +792,36 @@ def mesh_bench(*, dp=8, tp=2, batch=8, seq=16, iters=3, vocab=128, hidden=64,
                         "hybrid": hyb_coll},
         # per-pass BYTES-on-wire (per-device payload of each hand-placed
         # collective, from the shared jaxpr byte census — the ROADMAP
-        # item 2 prep; GSPMD-inserted collectives are counted above but
-        # not priced here)
+        # item 2 prep; GSPMD-inserted collectives are counted above and
+        # priced from the compiled text where the jaxpr cannot see them)
         "collective_bytes": {"dp8": dp8_bytes, "dp8_zero1": zero_coll_bytes,
-                             "hybrid": hyb_bytes},
+                             "hybrid": hyb_bytes,
+                             "dp8_zero1_int8": comp_bytes},
+        # the ISSUE 13 communication-efficiency rows: int8+error-feedback
+        # and bucketed-overlap passes on the DP=8 ZeRO-1 step
+        "comm_opt": {
+            "int8": {
+                "tokens_per_sec": round(batch * seq / comp_dt, 1),
+                "loss": comp_loss,
+                "loss_gap": abs(comp_loss - zero_loss),
+                "parity_bound": parity_bound,
+                "loss_parity": bool(abs(comp_loss - zero_loss)
+                                    <= parity_bound),
+                "buckets": comp_report["bucket_count"],
+                "compressed_bytes": comp_report["compressed_bytes"],
+                "grad_bytes_compressed": int(grad_bytes_compressed),
+                "grad_bytes_uncompressed": int(grad_bytes_uncompressed),
+                "grad_bytes_ratio": round(
+                    grad_bytes_compressed
+                    / max(grad_bytes_uncompressed, 1), 4),
+            },
+            "overlap": {
+                "tokens_per_sec": round(batch * seq / over_dt, 1),
+                "loss": over_loss,
+                "loss_bit_identical": bool(over_loss == zero_loss),
+                "buckets": over_report["bucket_count"],
+            },
+        },
         "opt_state_bytes": {
             "replicated": int(replicated_bytes),
             "zero1_per_replica": int(zero_bytes),

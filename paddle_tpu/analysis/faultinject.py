@@ -96,6 +96,13 @@ POINTS = {
         "resume bit-identical); delay = the step hangs (the mesh "
         "watchdog's drill — the scanner recovers, the stuck step wakes "
         "into the new epoch and raises TrainStepSuperseded)."),
+    "comm.quantize": (
+        "The quantized grad-reduction resolve site (mesh/comm_opt.py "
+        "resolve_compression, fired when a compressed mesh step is "
+        "built). flag = the build degrades to the UNCOMPRESSED "
+        "reduction — the step still trains with exact parity, the "
+        "bandwidth win is sacrificed (meta records the fallback; "
+        "drilled in tier-1)."),
     "ckpt.write": (
         "The checkpoint writer thread, after the temp directory exists "
         "and before any shard lands (checkpoint/manager.py). raise = a "

@@ -22,8 +22,9 @@ from __future__ import annotations
 import re
 
 __all__ = ["COLLECTIVE_RE", "COLLECTIVE_PRIMITIVES", "census_hlo",
-           "census_lowered", "census_jaxpr", "byte_census_jaxpr",
-           "collective_sequence", "iter_subjaxprs"]
+           "census_lowered", "census_lowered_text", "census_jaxpr",
+           "byte_census_jaxpr", "byte_census_hlo", "collective_sequence",
+           "iter_subjaxprs"]
 
 # matches both optimized-HLO (all-reduce) and StableHLO
 # (stablehlo.all_reduce) spellings — the census reader accepts either
@@ -59,16 +60,26 @@ def census_hlo(text):
     return counts
 
 
-def census_lowered(lowered):
+def census_lowered(lowered, force_compile=False):
     """Census of a ``jit(...).lower(...)`` result. The cheap path parses
     the StableHLO from the trace (manual-axis collectives a shard_map
     body hand-places are explicit ops there); only if that shows nothing
-    (everything GSPMD-inserted) does it pay a full AOT compile for the
-    optimized HLO."""
-    counts = census_hlo(lowered.as_text())
-    if not counts:
-        counts = census_hlo(lowered.compile().as_text())
-    return counts
+    (everything GSPMD-inserted) — or the caller forces it because the
+    program has auto axes GSPMD may insert collectives on — does it pay
+    a full AOT compile for the optimized HLO."""
+    return census_lowered_text(lowered, force_compile=force_compile)[0]
+
+
+def census_lowered_text(lowered, force_compile=False):
+    """(counts, text) of :func:`census_lowered` — the text is what was
+    actually parsed (StableHLO on the cheap path, optimized HLO on the
+    compiled one), so byte pricers can reuse it without re-lowering."""
+    text = lowered.as_text()
+    counts = census_hlo(text)
+    if not counts or force_compile:
+        text = lowered.compile().as_text()
+        counts = census_hlo(text)
+    return counts, text
 
 
 def _axis_names(eqn):
@@ -147,12 +158,15 @@ def byte_census_jaxpr(jaxpr):
     of its operand and result buffer bytes (an ``all_gather``'s output
     is what moves; a ``reduce_scatter``'s input is) as the jaxpr sees
     them: inside a ``shard_map`` body avals are already local, so the
-    number is per device, not global. This is payload accounting, not
-    a ring-algorithm model (a ring all-reduce moves ~2x its payload);
-    and like :func:`census_jaxpr` it counts a scan/while body ONCE per
-    trace while the live program pays it per iteration. Collectives
-    GSPMD inserts on auto axes exist only post-compile — the HLO
-    census counts them, this one cannot price them."""
+    number is per device, not global. Quantized exchanges are priced
+    at their true wire width (an int8/f8 ``all_to_all`` aval is 1
+    byte/element). This is payload accounting, not a ring-algorithm
+    model (a ring all-reduce moves ~2x its payload); and like
+    :func:`census_jaxpr` it counts a scan/while body ONCE per trace
+    while the live program pays it per iteration. Collectives GSPMD
+    inserts on auto axes exist only post-compile — price those with
+    :func:`byte_census_hlo` over the compiled text (the
+    ``MeshParallel.collective_bytes`` merge does)."""
     out = {}
 
     def _visit(j):
@@ -170,4 +184,84 @@ def byte_census_jaxpr(jaxpr):
                 _visit(sub)
 
     _visit(jaxpr)
+    return out
+
+
+# shaped-type spellings in compiler text: optimized-HLO ``f32[8,16]{1,0}``
+# and StableHLO/MLIR ``tensor<8x16xf32>``
+_HLO_TYPE_RE = re.compile(
+    r"\b(pred|bf16|f8e4m3fn|f8e5m2|f8e4m3|[fsu]\d+)\[([0-9,]*)\]")
+_MLIR_TYPE_RE = re.compile(
+    r"tensor<((?:\d+x)*)"
+    r"(i1|bf16|f8E4M3FN|f8E5M2|[fiu]\d+|ui\d+)>")
+_DTYPE_BYTES = {
+    "pred": 1, "i1": 1, "s8": 1, "u8": 1, "i8": 1, "ui8": 1,
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3": 1, "f8E4M3FN": 1, "f8E5M2": 1,
+    "bf16": 2, "f16": 2, "s16": 2, "u16": 2, "i16": 2, "ui16": 2,
+    "f32": 4, "s32": 4, "u32": 4, "i32": 4, "ui32": 4,
+    "f64": 8, "s64": 8, "u64": 8, "i64": 8, "ui64": 8,
+}
+
+
+def _shaped_bytes(line):
+    """Buffer bytes of every shaped type spelled on one compiler-text
+    line (both HLO and MLIR spellings)."""
+    out = []
+    for dt, dims in _HLO_TYPE_RE.findall(line):
+        n = 1
+        for d in dims.split(","):
+            if d.strip():
+                n *= int(d)
+        out.append(n * _DTYPE_BYTES.get(dt, 4))
+    for dims, dt in _MLIR_TYPE_RE.findall(line):
+        n = 1
+        for d in dims.split("x"):
+            if d:
+                n *= int(d)
+        out.append(n * _DTYPE_BYTES.get(dt, 4))
+    return out
+
+
+def byte_census_hlo(text):
+    """Per-collective BYTE sizes over compiler TEXT (StableHLO or
+    optimized HLO): ``{canonical-collective: {"count", "bytes"}}``.
+
+    This is how collectives invisible to :func:`byte_census_jaxpr` get
+    priced — GSPMD-inserted exchanges on auto axes and the collectives a
+    routed ``device_put`` reshard lowers to exist only in compiler
+    output. Pricing is per matching LINE: the largest shaped type
+    spelled on the line (optimized HLO carries the RESULT type inline,
+    so an all-gather prices its grown output; StableHLO carries operand
+    and result types, so the max mirrors the jaxpr census's
+    max(in, out) payload rule). Like every text census this is payload
+    accounting of the program text — a line that mentions a collective
+    without being one (a metadata string) would be counted; compiler
+    output keeps those off the op lines in practice.
+
+    StableHLO REGION ops (``"stablehlo.all_reduce"(%x) ({ ... }) :
+    (tensor<..>) -> tensor<..>``) carry their types on the region's
+    closing ``}) : ...`` line, several lines after the op name — the
+    walker remembers the pending op and prices it from that closer."""
+    out = {}
+
+    def _price(name, sizes):
+        row = out.setdefault(name, {"count": 0, "bytes": 0})
+        row["count"] += 1
+        row["bytes"] += max(sizes)
+
+    pending = None
+    for line in text.splitlines():
+        m = COLLECTIVE_RE.search(line)
+        sizes = _shaped_bytes(line)
+        if m is not None:
+            pending = None
+            k = m.group(1).replace("-", "_")
+            if sizes:
+                _price(k, sizes)
+            else:
+                pending = k        # a region op: types come at `}) :`
+        elif pending is not None and sizes and "}" in line \
+                and ":" in line:
+            _price(pending, sizes)
+            pending = None
     return out

@@ -18,6 +18,11 @@ Pieces (docs/distributed.md):
   from a placement change) only where specs disagree;
 - :mod:`~paddle_tpu.mesh.zero` — ZeRO-1 flatten/scatter/gather helpers
   (cross-replica weight-update sharding, arXiv 2004.13336);
+- :mod:`~paddle_tpu.mesh.comm_opt` — the communication-efficiency
+  layer: int8/fp8 quantized grad reduction with error-feedback
+  residuals (EQuARX, arXiv 2506.17615), bucketed backward-overlapped
+  grad collectives, and the multi-hop reshard router (arXiv
+  2112.01075) the SPMD rule engine lowers placement changes through;
 - :mod:`~paddle_tpu.mesh.parallelize` — lowers fleet hybrid configs
   (dp_degree/mp_degree) onto mesh axes and runs the real train step
   under ``shard_map`` with donated sharded state;
@@ -27,6 +32,8 @@ Pieces (docs/distributed.md):
 """
 from .context import (MeshContext, bootstrap_virtual_devices,  # noqa: F401
                       current_mesh_context, spec_for_placements)
+from .comm_opt import (CommOptConfig, classify_placement_change,  # noqa: F401
+                       route_spec_change)
 from .spmd_rules import (ReshardFault, disable_propagation,  # noqa: F401
                          enable_propagation, propagate, rule_for,
                          sharding_rule)
@@ -36,6 +43,7 @@ from .trainer import MeshTrainer, TrainStepSuperseded  # noqa: F401
 __all__ = [
     "MeshContext", "bootstrap_virtual_devices", "current_mesh_context",
     "spec_for_placements",
+    "CommOptConfig", "classify_placement_change", "route_spec_change",
     "sharding_rule", "rule_for", "propagate", "enable_propagation",
     "disable_propagation", "ReshardFault",
     "MeshParallel", "build_mesh_step", "parallelize",

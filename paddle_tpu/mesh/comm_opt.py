@@ -1,0 +1,512 @@
+"""Communication-efficient mesh training: quantized grad reduction with
+error feedback, bucketed backward-overlapped grad collectives, and the
+multi-hop reshard router.
+
+Three coupled pieces (ROADMAP item 2; docs/distributed.md "Communication
+efficiency"):
+
+1. **Quantized grad reduction** (EQuARX, arXiv 2506.17615) — the dp-axis
+   gradient exchange runs at 1 byte/element: each replica projects its
+   (residual-corrected) gradient onto the int8 or e4m3 grid with
+   per-(param, destination-row) fp32 scales, ``lax.all_to_all``s the wire
+   payload + scales, and dequantizes + sums the received rows locally —
+   a quantized reduce-scatter whose collective eqns carry int8/f8 avals,
+   so the shared jaxpr byte census prices the compression honestly.
+   **Error feedback** (the residual ``r``): the step quantizes
+   ``v = g + r`` and carries ``r' = v - dequant(quant(v))`` forward as
+   extra donated train state, so the quantization error is re-applied
+   next step instead of lost — compressed training converges (and the
+   residuals ride MeshTrainer checkpoints).
+
+2. **Bucketed, backward-overlapped grad communication** — parameters are
+   grouped into size-targeted buckets in REVERSE-AUTODIFF COMPLETION
+   ORDER (recorded by leaf grad hooks during the traced backward) and
+   each bucket's collective is emitted as soon as its last pullback has
+   completed, inside the ONE donated shard_map program. Each bucket's
+   collective depends only on that bucket's gradients, so XLA's
+   latency-hiding scheduler can overlap a fired bucket's communication
+   with the remaining backward compute — no host sync, no second
+   program. Fewer, larger collectives also amortize per-collective
+   latency (one psum_scatter per bucket instead of one per parameter).
+
+3. **Multi-hop reshard routing** (arXiv 2112.01075) — the SPMD rule
+   engine's redistribution site classifies every src->dst placement
+   pair: agreements move nothing, single-collective pairs stay one hop
+   (a shard-axis swap is lowered onto an EXPLICIT ``lax.all_to_all``
+   program instead of a bare device_put the compiler may widen into
+   all-gather + slice), and cross-axis pairs become an explicit chain of
+   hops (gather off the old axis, re-shard onto the new), each hop
+   counted in ``paddle_tpu_mesh_reshards_total{kind}``.
+
+Projection note: quantization is computed as an f32 GRID PROJECTION
+(round/clip for int8, an frexp/ldexp mantissa round for e4m3) and only
+then cast to the wire dtype — the cast is exact, the local dequantized
+value never takes a lossy convert round-trip (GI004 stays clean on the
+compressed program), and the fp8 path works even where the backend has
+no native float8 arithmetic (the wire cast is pure data movement).
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..analysis import faultinject as _fi
+
+__all__ = [
+    "COMPRESSION_MODES", "CommOptConfig", "resolve_compression",
+    "assign_buckets", "block_layout", "blockify", "unblockify",
+    "quantize_block", "bucket_reduce", "wire_itemsize",
+    "route_spec_change", "classify_placement_change", "alltoall_reshard",
+]
+
+COMPRESSION_MODES = ("none", "int8", "fp8")
+
+#: symmetric-scale quantization ceilings (int8 keeps -127..127 so the
+#: grid is symmetric; e4m3's largest finite magnitude is 448)
+_QMAX = {"int8": 127.0, "fp8": 448.0}
+
+
+class CommOptConfig:
+    """The parsed communication-efficiency knobs of one parallelize()
+    handle. All defaults preserve the legacy per-param fp32 exchange
+    bit-for-bit (``active`` is False unless a knob is switched on)."""
+
+    __slots__ = ("compression", "error_feedback", "overlap", "bucket_bytes")
+
+    def __init__(self, compression="none", error_feedback=True,
+                 overlap=False, bucket_bytes=1 << 20):
+        if compression not in COMPRESSION_MODES:
+            raise ValueError(
+                f"unknown grad_compression {compression!r} "
+                f"(expected one of {COMPRESSION_MODES})")
+        self.compression = compression
+        self.error_feedback = bool(error_feedback)
+        self.overlap = bool(overlap)
+        self.bucket_bytes = int(bucket_bytes)
+        if self.bucket_bytes <= 0:
+            raise ValueError("bucket_bytes must be positive")
+
+    @classmethod
+    def from_config(cls, config):
+        """Pop the comm keys out of a parallelize() config dict (the dict
+        is mutated, like the other parallelize knobs)."""
+        return cls(
+            compression=str(config.pop("grad_compression", "none")),
+            error_feedback=bool(config.pop("error_feedback", True)),
+            overlap=bool(config.pop("overlap_grad_comm", False)),
+            bucket_bytes=int(config.pop("bucket_bytes", 1 << 20)))
+
+    @property
+    def active(self):
+        """Does this config change the gradient exchange at all?"""
+        return self.compression != "none" or self.overlap
+
+    @property
+    def use_residuals(self):
+        """Error-feedback residual state exists only when compressing."""
+        return self.compression != "none" and self.error_feedback
+
+    def describe(self):
+        return {"compression": self.compression,
+                "error_feedback": self.error_feedback,
+                "overlap": self.overlap,
+                "bucket_bytes": self.bucket_bytes}
+
+
+def resolve_compression(mode):
+    """The effective compression mode at step-build time — also the
+    ``comm.quantize`` fault-point fire site: ``flag`` degrades the build
+    to the UNCOMPRESSED reduction (the step still trains, parity exact,
+    the bandwidth win is sacrificed), drilling callers that must survive
+    a poisoned quantizer."""
+    if mode == "none":
+        return mode
+    fault = _fi.fire("comm.quantize")
+    if fault is not None and fault.action == "flag":
+        return "none"
+    return mode
+
+
+def wire_itemsize(mode):
+    """Bytes per element on the wire for a compression mode."""
+    return 4 if mode == "none" else 1
+
+
+# --------------------------------------------------------------------------- #
+# bucketing
+# --------------------------------------------------------------------------- #
+
+def assign_buckets(order, nbytes, bucket_bytes, overlap):
+    """Group parameter indices into communication buckets.
+
+    ``order`` is the reverse-autodiff completion order (first-completed
+    first); ``nbytes[i]`` is param i's gradient payload. With ``overlap``
+    off everything lands in ONE bucket (the legacy tape-end barrier,
+    fused); with it on, buckets close as soon as they reach
+    ``bucket_bytes`` so each can fire while later pullbacks still run.
+    """
+    order = list(order)
+    if not order:
+        return []
+    if not overlap:
+        return [order]
+    buckets, cur, size = [], [], 0
+    for idx in order:
+        cur.append(idx)
+        size += int(nbytes[idx])
+        if size >= bucket_bytes:
+            buckets.append(cur)
+            cur, size = [], 0
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+# --------------------------------------------------------------------------- #
+# (degree, k) block layout — the ZeRO row layout generalized to buckets
+# --------------------------------------------------------------------------- #
+
+def block_layout(shape, degree):
+    """(numel, k) of one param's padded (degree, k) gradient block —
+    ``k`` is ``zero.padded_slice_len``, the ONE slice-length rule the
+    ZeRO state layout and the bucketed exchange share."""
+    from .zero import padded_slice_len
+
+    n = int(np.prod(shape)) if tuple(shape) else 1
+    return n, padded_slice_len(shape, degree)
+
+
+def blockify(grad, degree):
+    """Full local gradient -> its (degree, k) destination-row layout
+    (row r = the slice replica r will own), zero-padded, f32."""
+    import jax.numpy as jnp
+
+    _, k = block_layout(grad.shape, degree)
+    flat = grad.astype(jnp.float32).reshape(-1)
+    pad = degree * k - flat.shape[0]
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat.reshape(degree, k)
+
+
+def unblockify(rows, shape):
+    """(degree, k) row layout -> the full tensor of ``shape``."""
+    n = int(np.prod(shape)) if tuple(shape) else 1
+    return rows.reshape(-1)[:n].reshape(shape)
+
+
+# --------------------------------------------------------------------------- #
+# quantization: f32 grid projection, then an EXACT cast to the wire dtype
+# --------------------------------------------------------------------------- #
+
+def _e4m3_project(x):
+    """Project f32 values in [-448, 448] onto the float8_e4m3 grid using
+    f32 arithmetic only (frexp/ldexp mantissa rounding, subnormal step
+    2^-9, saturating at +-448). The subsequent cast to the f8 wire dtype
+    is exact, so the local dequantized value needs no f8->f32 convert."""
+    import jax.numpy as jnp
+
+    m, e = jnp.frexp(x)                      # x = m * 2**e, |m| in [0.5, 1)
+    mq = jnp.round(m * 16.0) / 16.0          # 3 mantissa bits + implicit
+    y = jnp.ldexp(mq, e)
+    step = 2.0 ** -9                         # e4m3 subnormal granularity
+    sub = jnp.round(x / step) * step
+    y = jnp.where(jnp.abs(x) < 2.0 ** -6, sub, y)
+    return jnp.clip(y, -448.0, 448.0)
+
+
+def quantize_block(v, mode):
+    """One (degree, k) f32 block -> (projected, wire, scale).
+
+    ``projected`` is the dequantized value in f32 (``wire`` decodes to
+    exactly ``projected * scale`` — the error-feedback reference);
+    ``wire`` is the 1-byte on-the-wire array (int8 or float8_e4m3fn);
+    ``scale`` is the per-destination-row fp32 scale, shape (degree, 1).
+    """
+    import jax.numpy as jnp
+
+    qmax = _QMAX[mode]
+    amax = jnp.max(jnp.abs(v), axis=1, keepdims=True).astype(jnp.float32)
+    scale = jnp.maximum(amax, jnp.float32(1e-30)) / jnp.float32(qmax)
+    scaled = v / scale
+    if mode == "int8":
+        proj = jnp.clip(jnp.round(scaled), -127.0, 127.0)
+        wire = proj.astype(jnp.int8)
+    else:
+        proj = _e4m3_project(scaled)
+        wire = proj.astype(jnp.float8_e4m3fn)
+    return proj, wire, scale
+
+
+# --------------------------------------------------------------------------- #
+# the in-body bucket reduction (runs inside the shard_map trace)
+# --------------------------------------------------------------------------- #
+
+def bucket_reduce(blocks, axis_name, degree, mode, want):
+    """Reduce one bucket of (degree, k_i) f32 blocks across the dp axis.
+
+    ``want='slice'`` (ZeRO-1): returns each param's reduced-MEAN (k_i,)
+    slice — uncompressed this is ONE fused ``lax.psum_scatter`` over the
+    concatenated bucket; compressed it is the quantized reduce-scatter
+    (all_to_all of wire payload + scales, local dequant + sum).
+
+    ``want='full'`` (plain DP): returns each param's full-shape-flat
+    (degree, k_i) reduced-mean rows on every replica — uncompressed one
+    ``lax.pmean``; compressed the quantized reduce-scatter followed by a
+    requantized ``lax.all_gather`` of the reduced slices.
+
+    Returns ``(outputs, local_dequant, wire_bytes)``: ``local_dequant``
+    aligns with ``blocks`` and is the error-feedback reference
+    (``None`` per entry when uncompressed), ``wire_bytes`` the
+    per-device payload this bucket puts on the wire (what the jaxpr
+    byte census will price for these eqns).
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    ks = [b.shape[1] for b in blocks]
+    K = sum(ks)
+
+    if mode == "none":
+        cat = jnp.concatenate(blocks, axis=1) if len(blocks) > 1 else blocks[0]
+        if want == "slice":
+            red = lax.psum_scatter(cat, axis_name, scatter_dimension=0,
+                                   tiled=True).reshape(K) / degree
+            wire = 4 * degree * K
+        else:
+            red = lax.pmean(cat, axis_name)
+            wire = 4 * degree * K
+        outs, off = [], 0
+        for k in ks:
+            outs.append(red[off:off + k] if want == "slice"
+                        else red[:, off:off + k])
+            off += k
+        return outs, [None] * len(blocks), wire
+
+    # -- quantized reduce-scatter: project, wire-cast, all_to_all, dequant --
+    projs, wires, scales = zip(*[quantize_block(b, mode) for b in blocks])
+    qcat = jnp.concatenate(wires, axis=1) if len(wires) > 1 else wires[0]
+    scat = jnp.concatenate(scales, axis=1)           # (degree, P) f32
+    recv_q = lax.all_to_all(qcat, axis_name, split_axis=0, concat_axis=0,
+                            tiled=True)              # row s = from replica s
+    recv_s = lax.all_to_all(scat, axis_name, split_axis=0, concat_axis=0,
+                            tiled=True)
+    wire = degree * K * wire_itemsize(mode) + 4 * degree * len(blocks)
+    slices, off = [], 0
+    for i, k in enumerate(ks):
+        blk = recv_q[:, off:off + k].astype(jnp.float32) \
+            * recv_s[:, i:i + 1]
+        slices.append(blk.sum(axis=0) / degree)      # reduced-MEAN (k,)
+        off += k
+    local_dq = [p * s for p, s in zip(projs, scales)]
+
+    if want == "slice":
+        return slices, local_dq, wire
+
+    # -- plain DP: requantize the reduced slices, all_gather the wire form --
+    qmax = _QMAX[mode]
+    out_scales, out_wire = [], []
+    for sl in slices:
+        amax = jnp.max(jnp.abs(sl))
+        s2 = jnp.maximum(amax, jnp.float32(1e-30)) / jnp.float32(qmax)
+        scaled = sl / s2
+        if mode == "int8":
+            p2 = jnp.clip(jnp.round(scaled), -127.0, 127.0)
+            w2 = p2.astype(jnp.int8)
+        else:
+            p2 = _e4m3_project(scaled)
+            w2 = p2.astype(jnp.float8_e4m3fn)
+        out_scales.append(s2.reshape(1))
+        out_wire.append(w2)
+    qcat2 = jnp.concatenate(out_wire) if len(out_wire) > 1 else out_wire[0]
+    scat2 = jnp.concatenate(out_scales).reshape(1, -1)  # (1, P)
+    g_q = lax.all_gather(qcat2, axis_name, axis=0,
+                         tiled=True).reshape(degree, K)
+    g_s = lax.all_gather(scat2, axis_name, axis=0, tiled=True)  # (degree, P)
+    wire += degree * K * wire_itemsize(mode) + 4 * degree * len(blocks)
+    outs, off = [], 0
+    for i, k in enumerate(ks):
+        outs.append(g_q[:, off:off + k].astype(jnp.float32)
+                    * g_s[:, i:i + 1])               # (degree, k) full rows
+        off += k
+    return outs, local_dq, wire
+
+
+# --------------------------------------------------------------------------- #
+# multi-hop reshard routing (arXiv 2112.01075)
+# --------------------------------------------------------------------------- #
+
+def _axes_of(entry):
+    if entry is None:
+        return ()
+    return tuple(entry) if isinstance(entry, (tuple, list)) else (entry,)
+
+
+def _spec_axes(spec):
+    """{axis: tensor dim} of one normalized spec tuple."""
+    out = {}
+    for dim, entry in enumerate(spec):
+        for a in _axes_of(entry):
+            out[a] = dim
+    return out
+
+
+def _drop_axes(spec, axes):
+    out = []
+    for entry in spec:
+        kept = tuple(a for a in _axes_of(entry) if a not in axes)
+        out.append(None if not kept
+                   else kept[0] if len(kept) == 1 else kept)
+    return tuple(out)
+
+
+def _move_axis(spec, axis, dst_dim, dst_entry):
+    """Relocate one mesh axis to ``dst_dim`` of the spec, ordering the
+    combined entry like the DESTINATION's (major/minor order of co-shard
+    tuples is semantic — blocking changes with it)."""
+    spec = list(_drop_axes(spec, {axis}))
+    combined = list(_axes_of(spec[dst_dim])) + [axis]
+    order = list(_axes_of(dst_entry))
+    combined.sort(key=lambda a: order.index(a) if a in order
+                  else len(order))
+    spec[dst_dim] = combined[0] if len(combined) == 1 else tuple(combined)
+    return tuple(spec)
+
+
+def _gain_is_slice(prev_entry, dst_entry):
+    """Adding axes to a dim is a pure LOCAL slice only when the existing
+    axes stay the MAJOR prefix (the new axes subdivide each existing
+    block); any other order change moves data between devices."""
+    prev = _axes_of(prev_entry)
+    return _axes_of(dst_entry)[:len(prev)] == prev
+
+
+def route_spec_change(cur, dst):
+    """The reshard route: ``cur`` -> ``dst`` as an ordered hop chain.
+
+    Each hop is ``(next_spec, kind, explicit)`` where ``kind`` names the
+    implied collective (``all_to_all`` / ``all_gather`` / ``shard``) and
+    ``explicit`` marks hops the router lowers onto an explicit
+    ``lax.all_to_all`` program (the shard-axis swap) rather than a
+    device_put. The classification table (docs/distributed.md):
+
+    - equal specs -> no hops (agreement moves nothing);
+    - a co-shard tuple reordering its axes on one dim (major/minor
+      blocking change) -> one ``all_to_all`` exchange hop;
+    - an axis present in both but on a DIFFERENT tensor dim -> one
+      ``all_to_all`` hop per moved axis (a pure single-axis swap is
+      lowered onto the explicit program);
+    - axes only in ``cur`` -> one ``all_gather`` hop dropping them;
+    - axes only in ``dst`` -> one final hop adding them: ``shard``
+      (a local slice, no wire traffic) when the existing axes stay the
+      major prefix, ``all_to_all`` when the blocking order changes.
+
+    A chain of length >= 2 is a multi-hop reshard (e.g. shard over axis
+    a -> shard over axis b lowers to gather-off-a then shard-onto-b).
+    """
+    cur, dst = tuple(cur), tuple(dst)
+    if cur == dst:
+        return []
+    cur_ax, dst_ax = _spec_axes(cur), _spec_axes(dst)
+    hops = []
+    spec = cur
+    # 1. within-dim co-shard reorders: same axis set, different
+    #    major/minor order — a REAL exchange, not a slice
+    for d in range(min(len(spec), len(dst))):
+        a_cur, a_dst = _axes_of(spec[d]), _axes_of(dst[d])
+        if a_cur != a_dst and set(a_cur) == set(a_dst) and len(a_cur) > 1:
+            spec = spec[:d] + (dst[d],) + spec[d + 1:]
+            hops.append((spec, "all_to_all", False))
+    # 2. same-axis dim moves: an all_to_all per moved axis (the pure
+    #    single-axis swap runs the explicit program)
+    for a in sorted(set(cur_ax) & set(dst_ax)):
+        moved_from = _spec_axes(spec).get(a)
+        if moved_from is not None and moved_from != dst_ax[a]:
+            spec = _move_axis(spec, a, dst_ax[a], dst[dst_ax[a]])
+            hops.append((spec, "all_to_all", True))
+    # 3. axes leaving the layout: one gather hop drops them all
+    gone = set(cur_ax) - set(dst_ax)
+    if gone:
+        spec = _drop_axes(spec, gone)
+        hops.append((spec, "all_gather", False))
+    # 4. axes joining the layout: slice when the blocking refines,
+    #    exchange when the order changes
+    if spec != dst:
+        slice_only = all(
+            _gain_is_slice(p, d)
+            for p, d in zip(spec, dst) if p != d)
+        hops.append((dst, "shard" if slice_only else "all_to_all",
+                     False))
+    return hops
+
+
+def classify_placement_change(cur, dst):
+    """The placement-pair table entry for a src->dst change:
+    ``("agree", [])`` / ``("direct", [kind])`` /
+    ``("multi_hop", [kind, ...])``."""
+    hops = route_spec_change(cur, dst)
+    kinds = [k for _, k, _ in hops]
+    if not hops:
+        return "agree", kinds
+    if len(hops) == 1:
+        return "direct", kinds
+    return "multi_hop", kinds
+
+
+_A2A_PROGRAMS = {}
+_A2A_LOCK = threading.Lock()
+
+
+def alltoall_reshard(value, jax_mesh, axis, src_dim, dst_dim,
+                     cur_spec, dst_spec):
+    """The explicit shard-axis-swap program: move mesh ``axis`` from
+    tensor dim ``src_dim`` to ``dst_dim`` with ONE ``lax.all_to_all``
+    instead of a device_put the compiler may lower as all-gather +
+    dynamic-slice (2x the wire traffic of the direct exchange).
+
+    Only the PURE single-axis swap is lowered here — ``src_dim`` must
+    be sharded by exactly ``axis`` and ``dst_dim`` unsharded in
+    ``cur_spec`` (so the LOCAL block's split axis IS the full global
+    dim and the global divisibility check is the local one); co-shard
+    entries on either dim fall back to the device_put hop. Returns
+    None whenever the swap cannot be expressed as a tiled all_to_all —
+    the caller owns the fallback. Raw-array in, raw-array out; the
+    caller owns differentiability (it wraps the hop with
+    ``apply_raw``).
+    """
+    import jax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    size = jax_mesh.shape[axis]
+    if value.ndim <= max(src_dim, dst_dim):
+        return None
+    cur_spec, dst_spec = tuple(cur_spec), tuple(dst_spec)
+    if (_axes_of(cur_spec[src_dim]) != (axis,)
+            or _axes_of(cur_spec[dst_dim]) != ()
+            or _axes_of(dst_spec[dst_dim]) != (axis,)
+            or _axes_of(dst_spec[src_dim]) != ()):
+        return None               # not the pure swap: device_put owns it
+    if value.shape[dst_dim] % size or value.shape[src_dim] % size:
+        return None
+    key = (jax_mesh, axis, src_dim, dst_dim, cur_spec, dst_spec)
+    with _A2A_LOCK:
+        prog = _A2A_PROGRAMS.get(key)
+    if prog is None:
+        def body(x):
+            return jax.lax.all_to_all(x, axis, split_axis=dst_dim,
+                                      concat_axis=src_dim, tiled=True)
+
+        prog = jax.jit(shard_map(
+            body, mesh=jax_mesh, in_specs=P(*cur_spec),
+            out_specs=P(*dst_spec), check_rep=False))
+        with _A2A_LOCK:
+            # racing builders of the same key collapse to one program
+            prog = _A2A_PROGRAMS.setdefault(key, prog)
+    try:
+        return prog(value)
+    except ValueError:
+        # a layout this guard did not anticipate: the device_put hop
+        # still lands the data — never fail the op over the fast path
+        return None

@@ -33,7 +33,7 @@ from jax.sharding import PartitionSpec as P
 from ..analysis.jaxpr import collectives as _collectives
 from ..framework import random as rng
 from ..framework.core import Tensor
-from . import zero
+from . import comm_opt, zero
 from .context import MeshContext
 
 __all__ = ["build_mesh_step", "MeshParallel", "parallelize"]
@@ -52,25 +52,47 @@ def _dp_axis_of(ctx):
 
 
 def build_mesh_step(model, optimizer, loss_fn, ctx, batch, *,
-                    shard_optimizer=False, dp_axis=None):
+                    shard_optimizer=False, dp_axis=None, comm=None):
     """One donated fused train step under shard_map over ``ctx``'s mesh.
 
     Returns ``(jitted, state_fn, params, meta)``:
 
-    - ``jitted(param_values, acc_values, master_values, *batch)`` ->
-      ``(loss, new_params, new_accs, new_masters)`` with args 0-2 donated;
-    - ``state_fn()`` -> the initial ``(params, accs, masters)`` value lists
-      (ZeRO states already in their sharded ``(dp, k)`` layout);
+    - ``jitted(param_values, acc_values, master_values[, residuals],
+      *batch)`` -> ``(loss, new_params, new_accs, new_masters[,
+      new_residuals])`` with the state args donated (the residual lists
+      exist only when ``comm`` compresses with error feedback);
+    - ``state_fn()`` -> the initial state value lists (ZeRO states
+      already in their sharded ``(dp, k)`` layout, residuals zeroed);
     - ``params`` -> the live Parameter objects (rebind after the run);
-    - ``meta`` -> dict with ``dp_axis``/``degree``/``sharded`` flags.
+    - ``meta`` -> dict with ``dp_axis``/``degree``/``sharded`` flags plus
+      the resolved ``comm`` knobs and the trace-time ``comm_runtime``
+      holder (bucket assignment, wire bytes).
 
     ``batch`` is an example global batch (arrays or Tensors) used to fix the
     per-argument partition specs; every later call must keep its ranks.
     ``loss_fn(model, *batch_tensors)`` returns the scalar loss Tensor.
+    ``comm`` is a :class:`~paddle_tpu.mesh.comm_opt.CommOptConfig`; the
+    default (None / all-off) keeps the legacy per-param fp32 exchange
+    bit-for-bit.
     """
     dp_axis = dp_axis or _dp_axis_of(ctx)
     degree = ctx.axis_size(dp_axis)
     mesh = ctx.jax_mesh
+
+    requested = comm.describe() if comm is not None else None
+    if comm is not None and comm.active:
+        # the comm.quantize fault-point fire site: flag degrades THIS
+        # build to the uncompressed reduction (drilled in tier-1)
+        mode = comm_opt.resolve_compression(comm.compression)
+        comm_eff = comm_opt.CommOptConfig(
+            compression=mode, error_feedback=comm.error_feedback,
+            overlap=comm.overlap, bucket_bytes=comm.bucket_bytes)
+        if not comm_eff.active:
+            comm_eff = None
+    else:
+        comm_eff = None
+    use_res = comm_eff is not None and comm_eff.use_residuals
+    comm_info = {}      # filled at trace time by the body (host-side)
 
     if shard_optimizer and getattr(optimizer, "_grad_clip", None) is not None:
         raise ValueError(
@@ -97,21 +119,131 @@ def build_mesh_step(model, optimizer, loss_fn, ctx, batch, *,
         for p, ks in zip(params, acc_keys)]
     shapes = [tuple(p.shape) for p in params]
 
-    def body(param_values, acc_values, master_values, *batch_vals):
+    def _exchange_grads(param_values, res_values):
+        """The communication-efficient gradient exchange: bucketed (in
+        reverse-autodiff completion order, recorded by the leaf hooks),
+        optionally quantized with error feedback. Returns the per-param
+        ``sliced`` flags (ZeRO bookkeeping) and the new residual list.
+        Runs INSIDE the trace — every collective it emits depends only
+        on its own bucket's gradients, so XLA can overlap a bucket's
+        communication with the remaining backward compute."""
+        with_grad = [i for i, p in enumerate(params)
+                     if p.grad is not None]
+        seq = comm_info.pop("_seq", {})
+        order = sorted(with_grad, key=lambda i: seq.get(i, i))
+        nbytes = {i: int(np.prod(shapes[i]) if shapes[i] else 1) * 4
+                  for i in with_grad}
+        buckets = comm_opt.assign_buckets(
+            order, nbytes, comm_eff.bucket_bytes, comm_eff.overlap)
+        want = "slice" if shard_optimizer else "full"
+        mode = comm_eff.compression
+        wire_total = 0
+        baseline = 0
+        reduced, new_res = {}, {}
+        for bucket in buckets:
+            blocks = []
+            for i in bucket:
+                blk = comm_opt.blockify(params[i].grad.value, degree)
+                if use_res:
+                    blk = blk + res_values[i][0]
+                blocks.append(blk)
+                baseline += 4 * degree * blk.shape[1] if shard_optimizer \
+                    else nbytes[i]
+            outs, local_dq, wire = comm_opt.bucket_reduce(
+                blocks, dp_axis, degree, mode, want)
+            wire_total += wire
+            for i, out, blk, dq in zip(bucket, outs, blocks, local_dq):
+                reduced[i] = out
+                if use_res:
+                    new_res[i] = blk - dq
+        comm_info.update({
+            "buckets": [[i for i in b] for b in buckets],
+            "bucket_count": len(buckets),
+            "compressed_bytes": int(wire_total),
+            "uncompressed_bytes": int(baseline),
+            "compression": mode,
+            "overlap": comm_eff.overlap,
+            "error_feedback": use_res,
+        })
+        sliced = []
+        for i, p in enumerate(params):
+            if i not in reduced:
+                sliced.append(False)          # frozen: stays whole
+                continue
+            if shard_optimizer:
+                p._replace_value(zero.local_slice(param_values[i],
+                                                  dp_axis, degree))
+                p.grad = Tensor(reduced[i].astype(p.grad.value.dtype))
+                sliced.append(True)
+            else:
+                full = comm_opt.unblockify(reduced[i], shapes[i])
+                p.grad = Tensor(full.astype(p.grad.value.dtype))
+                sliced.append(False)
+        return sliced, new_res
+
+    def body(param_values, acc_values, master_values, *rest):
+        if use_res:
+            res_values, batch_vals = rest[0], rest[1:]
+        else:
+            res_values, batch_vals = [], rest
         with rng.trace_key(jax.random.PRNGKey(0)):
             saved_p = [(p, p._value) for p in params]
             saved_a = {id(p): dict(optimizer._accumulators[id(p)])
                        for p in params}
             saved_m = dict(optimizer._master_weights)
+            hook_handles = []
             try:
                 for p, v in zip(params, param_values):
                     p._replace_value(v)
+                if comm_eff is not None:
+                    # record reverse-autodiff COMPLETION order: the leaf
+                    # hook fires on every cotangent accumulation; the
+                    # last fire per param is its completion tick, and
+                    # bucket assignment follows that order
+                    seq, tick = {}, [0]
+                    comm_info["_seq"] = seq
+
+                    def _mk(idx):
+                        def _hook(g, _i=idx):
+                            tick[0] += 1
+                            seq[_i] = tick[0]
+                            return None
+                        return _hook
+
+                    for i, p in enumerate(params):
+                        if not p.stop_gradient:
+                            hook_handles.append(
+                                p.register_hook(_mk(i)))
                 loss = loss_fn(model, *[Tensor(b) for b in batch_vals])
                 loss.backward()
-                sliced = []
-                if shard_optimizer:
+                for h in hook_handles:
+                    h.remove()
+                hook_handles = []
+                new_res_map = {}
+                if comm_eff is not None:
+                    sliced, new_res_map = _exchange_grads(param_values,
+                                                          res_values)
+                    if shard_optimizer:
+                        for p, ks, vs, sh in zip(params, acc_keys,
+                                                 acc_values, acc_sharded):
+                            for k, v, s in zip(ks, vs, sh):
+                                optimizer._accumulators[id(p)][k] = \
+                                    v.reshape(-1) if s else v
+                        if use_masters:
+                            for p, mv in zip(params, master_values):
+                                optimizer._master_weights[id(p)] = \
+                                    mv.reshape(-1)
+                    else:
+                        for p, ks, vs in zip(params, acc_keys, acc_values):
+                            for k, v in zip(ks, vs):
+                                optimizer._accumulators[id(p)][k] = v
+                        if use_masters:
+                            for p, mv in zip(params, master_values):
+                                optimizer._master_weights[id(p)] = mv
+                elif shard_optimizer:
                     # ZeRO-1: reduce-scatter grads, update this replica's
                     # slice of params/state, all-gather updated params
+                    sliced = []
                     for p, pv in zip(params, param_values):
                         g = p.grad
                         if g is None:
@@ -135,6 +267,7 @@ def build_mesh_step(model, optimizer, loss_fn, ctx, batch, *,
                 else:
                     # plain DP: all-reduce (mean) grads; every replica runs
                     # the identical full update
+                    sliced = [False] * len(params)
                     for p in params:
                         if p.grad is not None:
                             p.grad = Tensor(jax.lax.pmean(p.grad.value,
@@ -169,8 +302,17 @@ def build_mesh_step(model, optimizer, loss_fn, ctx, batch, *,
                     new_m = ([optimizer._master_weights[id(p)]
                               for p in params]
                              if use_masters else master_values)
-                return jax.lax.pmean(loss.value, dp_axis), new_p, new_a, new_m
+                out = (jax.lax.pmean(loss.value, dp_axis), new_p, new_a,
+                       new_m)
+                if use_res:
+                    new_r = [new_res_map[i][None] if i in new_res_map
+                             else res_values[i]
+                             for i in range(len(params))]
+                    out = out + (new_r,)
+                return out
             finally:
+                for h in hook_handles:
+                    h.remove()
                 for p, v in saved_p:
                     p._replace_value(v)
                 for p in params:
@@ -190,13 +332,24 @@ def build_mesh_step(model, optimizer, loss_fn, ctx, batch, *,
         ctx.batch_spec(np.ndim(b.value if isinstance(b, Tensor) else b),
                        axis=dp_axis)
         for b in batch)
+    if use_res:
+        # each replica's residual is ITS OWN quantization error: a
+        # per-replica (degree, k) block, stacked P(dp) over the mesh
+        r_specs = [P(dp_axis)] * len(params)
+        in_specs = (p_specs, a_specs, m_specs, r_specs) + b_specs
+        out_specs = (P(), p_specs, a_specs, m_specs, r_specs)
+        donate = (0, 1, 2, 3)
+    else:
+        in_specs = (p_specs, a_specs, m_specs) + b_specs
+        out_specs = (P(), p_specs, a_specs, m_specs)
+        donate = (0, 1, 2)
     sm = shard_map(
         body, mesh=mesh,
-        in_specs=(p_specs, a_specs, m_specs) + b_specs,
-        out_specs=(P(), p_specs, a_specs, m_specs),
+        in_specs=in_specs,
+        out_specs=out_specs,
         check_rep=False,
         auto=frozenset(ctx.auto_axes))
-    jitted = jax.jit(sm, donate_argnums=(0, 1, 2))
+    jitted = jax.jit(sm, donate_argnums=donate)
 
     def _prep(v):
         """Pre-commit a replicated value to the mesh so the FIRST call's
@@ -234,12 +387,29 @@ def build_mesh_step(model, optimizer, loss_fn, ctx, batch, *,
                       for p in params]
         else:
             mv = []
-        return pv, av, mv
+        if not use_res:
+            return pv, av, mv
+        rv = []
+        for shape in shapes:
+            _, k = comm_opt.block_layout(shape, degree)
+            rv.append(ctx.place(jnp.zeros((degree, degree, k),
+                                          dtype=jnp.float32),
+                                spec=P(dp_axis)))
+        return pv, av, mv, rv
 
     meta = {"dp_axis": dp_axis, "degree": degree,
             "shard_optimizer": bool(shard_optimizer),
             "auto_axes": ctx.auto_axes, "acc_sharded": acc_sharded,
-            "use_masters": use_masters}
+            "use_masters": use_masters,
+            "use_residuals": use_res,
+            "comm": (comm_eff.describe() if comm_eff is not None else None),
+            "comm_requested": requested,
+            "comm_fault_fallback": bool(
+                requested is not None
+                and requested.get("compression", "none") != "none"
+                and (comm_eff is None
+                     or comm_eff.compression == "none")),
+            "comm_runtime": comm_info}
     return jitted, state_fn, params, meta
 
 
@@ -250,7 +420,7 @@ class MeshParallel:
 
     def __init__(self, model, optimizer, loss_fn, ctx, batch, *,
                  shard_optimizer=False, recompute_policy=None,
-                 hbm_budget=None):
+                 hbm_budget=None, comm=None):
         self.model = model
         self.optimizer = optimizer
         self.ctx = ctx
@@ -262,10 +432,14 @@ class MeshParallel:
                 hbm_budget, shard_optimizer)
         (self._jitted, state_fn, self.params,
          self.meta) = build_mesh_step(model, optimizer, loss_fn, ctx, batch,
-                                      shard_optimizer=shard_optimizer)
+                                      shard_optimizer=shard_optimizer,
+                                      comm=comm)
         if self.remat_plan is not None:
             self.meta["remat_plan"] = self.remat_plan
-        self._pv, self._av, self._mv = state_fn()
+        if self.meta["use_residuals"]:
+            self._pv, self._av, self._mv, self._rv = state_fn()
+        else:
+            (self._pv, self._av, self._mv), self._rv = state_fn(), None
         self._acc_keys = [sorted(optimizer._accumulators[id(p)].keys())
                           for p in self.params]
         by_id = {id(p): n for n, p in model.named_parameters()}
@@ -274,8 +448,10 @@ class MeshParallel:
         self._steps = 0
         self._collectives = None
         self._collective_bytes = None
+        self._hlo_text = None
         self._mon = None
         self._gauge_set = False
+        self._comm_ctr = None
 
     # -- telemetry -----------------------------------------------------------
     def _monitor(self):
@@ -307,10 +483,14 @@ class MeshParallel:
         shows nothing (everything GSPMD-inserted) does it pay a full
         AOT compile for the optimized HLO."""
         if self._collectives is None:
-            vals = [b.value if isinstance(b, Tensor) else jnp.asarray(b)
-                    for b in batch]
-            lowered = self._jitted.lower(self._pv, self._av, self._mv, *vals)
-            self._collectives = _collectives.census_lowered(lowered)
+            lowered = self._jitted.lower(*self._step_args(batch))
+            # auto axes: GSPMD may insert collectives that exist only in
+            # compiled HLO — force the compile so the byte merge in
+            # collective_bytes prices them (pure-manual meshes keep the
+            # cheap StableHLO path, where the census is already complete)
+            self._collectives, self._hlo_text = \
+                _collectives.census_lowered_text(
+                    lowered, force_compile=bool(self.meta["auto_axes"]))
         return self._collectives
 
     def collective_bytes(self, *batch):
@@ -318,19 +498,57 @@ class MeshParallel:
         (``analysis/jaxpr/collectives.byte_census_jaxpr`` over the
         traced step): ``{collective: {"count", "bytes"}}`` with bytes
         the per-device payload of each hand-placed (manual-axis)
-        collective. GSPMD-inserted collectives on auto axes are priced
-        0 here — the HLO census in :meth:`collective_counts` still
-        counts their ops. Cached after the first trace; surfaced as
-        ``<collective>_bytes`` attrs on ``comm.mesh_step`` spans and
-        in the mesh_bench rows (ROADMAP item 2's prep)."""
+        collective — int8/f8 wire avals of the compressed exchange are
+        priced at their true 1 byte/element. Collectives the jaxpr walk
+        cannot see (GSPMD-inserted on auto axes, or post-compile
+        lowerings of routed device_puts) are priced from the SAME
+        compiler text :meth:`collective_counts` already parsed, via
+        ``byte_census_hlo`` (entries carry ``priced_by: "hlo"``).
+        Cached after the first trace; surfaced as ``<collective>_bytes``
+        attrs on ``comm.mesh_step`` spans and in the mesh_bench rows."""
         if self._collective_bytes is None:
-            vals = [b.value if isinstance(b, Tensor) else jnp.asarray(b)
-                    for b in batch]
-            closed = jax.make_jaxpr(self._jitted)(
-                self._pv, self._av, self._mv, *vals)
-            self._collective_bytes = _collectives.byte_census_jaxpr(
-                closed.jaxpr)
+            closed = jax.make_jaxpr(self._jitted)(*self._step_args(batch))
+            census = _collectives.byte_census_jaxpr(closed.jaxpr)
+            # merge the HLO-text pricing for ops the jaxpr cannot see
+            self.collective_counts(*batch)
+            hlo = _collectives.byte_census_hlo(self._hlo_text or "")
+            for op, row in hlo.items():
+                if op not in census:
+                    census[op] = {"count": row["count"],
+                                  "bytes": row["bytes"],
+                                  "priced_by": "hlo"}
+            self._collective_bytes = census
         return self._collective_bytes
+
+    def comm_report(self, *batch):
+        """The communication-efficiency report of this step program:
+        the trace-time bucket assignment (names, count), compressed
+        wire bytes per step vs the uncompressed-equivalent baseline,
+        and the resolved knobs. Forces one trace when the step has not
+        run yet; None when the handle runs the legacy exchange."""
+        if self.meta["comm"] is None:
+            return None
+        if not self.meta["comm_runtime"] and batch:
+            jax.make_jaxpr(self._jitted)(*self._step_args(batch))
+        rt = self.meta["comm_runtime"]
+        report = {k: v for k, v in rt.items() if not k.startswith("_")}
+        if "buckets" in report:
+            report["buckets"] = [[self.param_names[i] for i in b]
+                                 for b in report["buckets"]]
+        if report.get("uncompressed_bytes"):
+            report["bytes_ratio"] = round(
+                report["compressed_bytes"]
+                / report["uncompressed_bytes"], 4)
+        report.update(self.meta["comm"])
+        report["fault_fallback"] = self.meta["comm_fault_fallback"]
+        return report
+
+    def _step_args(self, batch):
+        vals = [b.value if isinstance(b, Tensor) else jnp.asarray(b)
+                for b in batch]
+        if self._rv is not None:
+            return [self._pv, self._av, self._mv, self._rv] + vals
+        return [self._pv, self._av, self._mv] + vals
 
     # -- the step ------------------------------------------------------------
     def step(self, *batch):
@@ -348,8 +566,12 @@ class MeshParallel:
             vals.append(v)
         before = self._jitted._cache_size()
         t0 = _m.now_ns() if (_m._state.on or _m.trace._state.on) else 0
-        loss, self._pv, self._av, self._mv = self._jitted(
-            self._pv, self._av, self._mv, *vals)
+        if self._rv is not None:
+            loss, self._pv, self._av, self._mv, self._rv = self._jitted(
+                self._pv, self._av, self._mv, self._rv, *vals)
+        else:
+            loss, self._pv, self._av, self._mv = self._jitted(
+                self._pv, self._av, self._mv, *vals)
         self._steps += 1
         if self._jitted._cache_size() > before:
             try:
@@ -362,10 +584,22 @@ class MeshParallel:
                 pass
         if t0:
             t1 = _m.now_ns()
+            rt = self.meta["comm_runtime"]
             if _m._state.on and not self._gauge_set:
                 _m.gauge("paddle_tpu_mesh_optimizer_state_bytes").set(
                     self.optimizer_state_bytes())
+                if rt:
+                    _m.gauge("paddle_tpu_mesh_grad_buckets").set(
+                        rt.get("bucket_count", 0))
                 self._gauge_set = True
+            if _m._state.on and rt and rt.get("compression",
+                                              "none") != "none":
+                # the counter is COMPRESSED wire bytes only — an
+                # overlap-only step's fp32 exchange must not inflate it
+                if self._comm_ctr is None:
+                    self._comm_ctr = _m.counter(
+                        "paddle_tpu_mesh_comm_compressed_bytes_total")
+                self._comm_ctr.inc(rt.get("compressed_bytes", 0))
             if _m.trace._state.on:
                 attrs = {"dp": dp, "step": self._steps,
                          "zero": self.shard_optimizer}
@@ -373,22 +607,42 @@ class MeshParallel:
                 for coll, row in self.collective_bytes(*batch).items():
                     attrs[f"{coll}_bytes"] = row["bytes"]
                 _m.trace.record_span("comm.mesh_step", t0, t1, attrs=attrs)
+                if rt:
+                    _m.trace.record_span(
+                        "comm.bucket_reduce", t0, t1,
+                        attrs={"buckets": rt.get("bucket_count", 0),
+                               "compression": rt.get("compression",
+                                                     "none"),
+                               "overlap": rt.get("overlap", False),
+                               "compressed_bytes":
+                                   rt.get("compressed_bytes", 0),
+                               "uncompressed_bytes":
+                                   rt.get("uncompressed_bytes", 0)})
         return Tensor(loss)
 
-    def set_state(self, pv, av, mv):
+    def set_state(self, pv, av, mv, rv=None):
         """Replace the step's donated state lists (params / accumulators /
-        masters) — the warm-restart hook: the compiled program and its
-        shardings survive, only the VALUES change. Callers (the
-        checkpoint restore path) must hand back arrays already placed
-        with the same mesh shardings ``state_fn()`` committed, or the
-        next step pays a one-time layout recompile."""
+        masters / error-feedback residuals) — the warm-restart hook: the
+        compiled program and its shardings survive, only the VALUES
+        change. Callers (the checkpoint restore path) must hand back
+        arrays already placed with the same mesh shardings
+        ``state_fn()`` committed, or the next step pays a one-time
+        layout recompile. ``rv`` is required iff the step carries
+        error-feedback residuals."""
         if (len(pv) != len(self._pv)
                 or [len(r) for r in av] != [len(r) for r in self._av]
                 or len(mv) != len(self._mv)):
             raise ValueError(
                 "set_state: structure mismatch with the live step state")
+        if (self._rv is None) != (rv is None) or (
+                rv is not None and len(rv) != len(self._rv)):
+            raise ValueError(
+                "set_state: residual-state mismatch with the live step "
+                "(error-feedback residuals are part of train state)")
         self._pv, self._av, self._mv = list(pv), [list(r) for r in av], \
             list(mv)
+        if rv is not None:
+            self._rv = list(rv)
 
     def finalize(self):
         """Write the trained values back onto the live Parameter/Optimizer
@@ -469,12 +723,26 @@ def parallelize(model, optimizer, loss_fn, batch, mesh=None, config=None):
     ``recompute_policy`` when it declares one) and ``hbm_budget`` (bytes
     of per-device HBM the ``'budget'`` policy plans against; defaults to
     the model config's ``hbm_budget``, then the ``mesh.train_step``
-    budgets.json row). An explicit ``mesh`` (MeshContext) overrides the
+    budgets.json row).
+
+    Communication-efficiency knobs (docs/distributed.md "Communication
+    efficiency"; all default to the legacy bit-exact exchange):
+    ``grad_compression`` (``'none'`` / ``'int8'`` / ``'fp8'`` —
+    quantized grad reduction with per-bucket scales),
+    ``error_feedback`` (default True: quantization error carried as
+    extra donated residual state, added back before the next quantize —
+    residuals ride MeshTrainer checkpoints), ``overlap_grad_comm``
+    (bucketed grad collectives fired in reverse-autodiff completion
+    order so XLA overlaps comm with the remaining backward compute) and
+    ``bucket_bytes`` (bucket size target, default 1 MiB).
+
+    An explicit ``mesh`` (MeshContext) overrides the
     degrees; when fleet is initialized and no mesh/config pins the
     degrees, the fleet topology is adopted.
     """
     config = dict(config or {})
     shard_opt = bool(config.pop("shard_optimizer", False))
+    comm = comm_opt.CommOptConfig.from_config(config)
     model_cfg = getattr(model, "config", None)
     policy = config.pop("recompute_policy",
                         getattr(model_cfg, "recompute_policy", None))
@@ -494,4 +762,5 @@ def parallelize(model, optimizer, loss_fn, batch, mesh=None, config=None):
             mesh = MeshContext.from_degrees(dp=int(dp), mp=mp)
     return MeshParallel(model, optimizer, loss_fn, mesh, batch,
                         shard_optimizer=shard_opt,
-                        recompute_policy=policy, hbm_budget=budget)
+                        recompute_policy=policy, hbm_budget=budget,
+                        comm=comm)

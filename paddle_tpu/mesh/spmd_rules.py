@@ -321,7 +321,9 @@ def propagate(op, specs, shapes, args=(), kwargs=None):
 # --------------------------------------------------------------------------- #
 
 def _classify_reshard(cur, req):
-    """Name the collective a cur->req placement change implies."""
+    """Name the collective a cur->req placement change implies (the
+    NET classification — the router below may decompose it into a
+    multi-hop chain)."""
     cur_axes = {a for e in cur for a in _axes_of(e)}
     req_axes = {a for e in req for a in _axes_of(e)}
     if cur_axes and not req_axes:
@@ -329,6 +331,11 @@ def _classify_reshard(cur, req):
     if cur_axes and req_axes:
         return "all_to_all"
     return "shard"
+
+
+class _NonDivisible(Exception):
+    """Internal: the explicit all_to_all program cannot express this
+    swap (a non-divisible dim) — fall back to the device_put hop."""
 
 
 class SpecPropagator:
@@ -340,22 +347,38 @@ class SpecPropagator:
         self._mon = None  # (monitor module, reshard counter) lazy binding
 
     # -- telemetry ----------------------------------------------------------
-    def _record_reshard(self, kind, axis, t0, t1):
+    def _bind_mon(self):
+        """(monitor module, reshard counter) — one lazy hot-path bind
+        shared by the per-hop counter and the per-reshard span."""
         if self._mon is None:
             from .. import monitor as _m
 
             self._mon = (_m, _m.counter("paddle_tpu_mesh_reshards_total",
                                         labelnames=("kind",)))
-        _m, ctr = self._mon
-        if _m._state.on:
-            ctr.labels(kind).inc()
+        return self._mon
+
+    def _record_reshard(self, kind, axis, t0, t1, hops=1, route=None):
+        """One span per ROUTED reshard (the counter is bumped per HOP
+        by :meth:`_record_hop` — a multi-hop chain counts each of its
+        collectives)."""
+        _m, _ctr = self._bind_mon()
         if _m.trace._state.on:
-            _m.trace.record_span("mesh.reshard", t0, t1,
-                                 attrs={"kind": kind, "axis": axis})
+            _m.trace.record_span(
+                "mesh.reshard", t0, t1,
+                attrs={"kind": kind, "axis": axis, "hops": hops,
+                       "route": ",".join(route or [kind])})
 
     def _reshard(self, tensor, mesh, req_spec, op):
+        """Redistribute one disagreeing input along the ROUTED hop
+        chain (mesh/comm_opt.py ``route_spec_change``, arXiv
+        2112.01075): agreements move nothing, a shard-axis swap lowers
+        onto an explicit ``lax.all_to_all`` program, cross-axis changes
+        become an explicit chain of hops — each hop counted in
+        ``paddle_tpu_mesh_reshards_total{kind}`` and the span carrying
+        the full route."""
         from .. import monitor as _m
         from ..distributed import api as dist_api
+        from . import comm_opt
         from .context import placements_for_spec
 
         cur_spec = self._spec_of(tensor, mesh)
@@ -369,11 +392,67 @@ class SpecPropagator:
                 f"injected redistribution failure resharding an input of "
                 f"{op!r} over mesh axis {axis!r} ({kind})",
                 axis=axis, kind=kind)
+        hops = comm_opt.route_spec_change(cur_spec, req_spec)
+        if not hops:
+            return tensor
         t0 = _m.now_ns()
-        out = dist_api.reshard(tensor, mesh,
-                               placements_for_spec(req_spec, mesh))
-        self._record_reshard(kind, axis, t0, _m.now_ns())
+        out = tensor
+        route = []
+        for next_spec, hop_kind, explicit in hops:
+            applied = None
+            if explicit:
+                applied = self._explicit_alltoall(
+                    out, mesh, self._spec_of(out, mesh), next_spec)
+            if applied is None:
+                applied = dist_api.reshard(
+                    out, mesh, placements_for_spec(next_spec, mesh))
+            out = applied
+            route.append(hop_kind)
+            self._record_hop(hop_kind)
+        self._record_reshard(kind, axis, t0, _m.now_ns(),
+                             hops=len(hops), route=route)
         return out
+
+    @staticmethod
+    def _explicit_alltoall(tensor, mesh, cur_spec, next_spec):
+        """Lower one shard-axis-swap hop onto an explicit all_to_all
+        program (differentiable: rides apply_raw like device_put
+        reshards). None -> the caller falls back to device_put."""
+        from ..ops._apply import apply_raw
+        from . import comm_opt
+        from .context import placements_for_spec
+        from ..distributed.placement import DistAttr
+
+        cur_ax = comm_opt._spec_axes(cur_spec)
+        nxt_ax = comm_opt._spec_axes(next_spec)
+        moved = [(a, cur_ax[a], nxt_ax[a]) for a in cur_ax
+                 if a in nxt_ax and cur_ax[a] != nxt_ax[a]]
+        if len(moved) != 1:
+            return None
+        a, src_dim, dst_dim = moved[0]
+        jax_mesh = mesh.jax_mesh() if hasattr(mesh, "jax_mesh") else mesh
+
+        def transform(v):
+            out = comm_opt.alltoall_reshard(
+                v, jax_mesh, a, src_dim, dst_dim, cur_spec, next_spec)
+            if out is None:
+                raise _NonDivisible()
+            return out
+
+        try:
+            out = apply_raw("reshard", transform, [tensor])[0]
+        except _NonDivisible:
+            return None
+        out.stop_gradient = tensor.stop_gradient
+        out.name = tensor.name
+        out._dist_attr = DistAttr(
+            mesh, placements_for_spec(next_spec, mesh))
+        return out
+
+    def _record_hop(self, kind):
+        _m, ctr = self._bind_mon()
+        if _m._state.on:
+            ctr.labels(kind).inc()
 
     @staticmethod
     def _spec_of(tensor, mesh):

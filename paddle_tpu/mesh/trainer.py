@@ -182,6 +182,12 @@ class MeshTrainer:
                     zero[f"master/{n}"] = (v, _prod(p.shape))
                 else:
                     arrays[f"master/{n}"] = v
+        if h._rv is not None:
+            # error-feedback residuals are PART OF TRAIN STATE: dropping
+            # them on restore would replay the quantization error twice
+            # (once lost, once re-applied) and break bit-identical resume
+            for n, v in zip(h.param_names, h._rv):
+                arrays[f"resid/{n}"] = v
         arrays["rng/key"] = np.asarray(
             jax.random.key_data(rng.get_rng_state()))
         meta = {"step": self.step_idx, "dp_degree": mh["degree"],
@@ -273,7 +279,20 @@ class MeshTrainer:
                     if mh["shard_optimizer"] \
                     else full_of(name, tuple(v_old.shape))
                 mv.append(place_like(a, v_old))
-        h.set_state(pv, av, mv)
+        rv = None
+        if h._rv is not None:
+            rv = []
+            for n, v_old in zip(h.param_names, h._rv):
+                a = rc.arrays.get(f"resid/{n}")
+                if a is None or tuple(np.asarray(a).shape) \
+                        != tuple(v_old.shape):
+                    # a checkpoint from an uncompressed run, or an
+                    # ELASTIC degree change (residuals are per-replica
+                    # quantization errors — meaningless across a
+                    # different dp): reset to zero, convergence-safe
+                    a = np.zeros(tuple(v_old.shape), np.float32)
+                rv.append(place_like(a, v_old))
+        h.set_state(pv, av, mv, rv)
         key_data = rc.arrays.get("rng/key")
         if key_data is not None:
             rng.set_rng_state(jax.random.wrap_key_data(

@@ -13,6 +13,12 @@ These helpers are pure functions meant to run INSIDE a ``shard_map`` body
 whose data-parallel axis is manual: :func:`scatter_grad` lowers to
 ``lax.psum_scatter``, :func:`gather_param` to ``lax.all_gather`` — the two
 real collectives of the ZeRO-1 update.
+
+:func:`padded_slice_len` is the ONE slice-length rule: the bucketed /
+quantized gradient exchange (``mesh/comm_opt.py``) lays its ``(degree,
+k)`` destination-row blocks out with the same ``k``, so a compressed
+step's reduced slices drop into the per-param ZeRO state layout
+unchanged (``comm_opt.block_layout`` delegates here).
 """
 from __future__ import annotations
 

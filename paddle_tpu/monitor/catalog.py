@@ -191,6 +191,18 @@ METRICS = {
         "Per-replica optimizer-state bytes of the active mesh train step "
         "— the ZeRO-1 lever: shard_optimizer=True shrinks this ~1/dp vs "
         "the replicated layout."),
+    "paddle_tpu_mesh_comm_compressed_bytes_total": (
+        "counter", (),
+        "Per-device wire bytes of the COMPRESSED gradient exchange "
+        "(int8/fp8 payload + fp32 scales), summed per mesh train step — "
+        "compare against the <op>_bytes attrs on comm.mesh_step spans "
+        "for the uncompressed-equivalent baseline."),
+    "paddle_tpu_mesh_grad_buckets": (
+        "gauge", (),
+        "Gradient-communication buckets of the active mesh train step "
+        "(size-targeted, reverse-autodiff completion order); 1 = the "
+        "single tape-end barrier, >1 = backward-overlapped bucketed "
+        "collectives."),
     # -- training checkpoints (checkpoint/manager.py) --------------------
     "paddle_tpu_ckpt_saves_total": (
         "counter", (),
@@ -357,6 +369,11 @@ SPANS = {
         "One eager collective dispatched as a real jax.lax collective "
         "program over a group mesh (distributed/collective.py). attrs: "
         "op, group, nranks."),
+    "comm.bucket_reduce": (
+        "The bucketed gradient exchange of one mesh train-step dispatch "
+        "(mesh/parallelize.py, knobs from mesh/comm_opt.py). attrs: "
+        "buckets, compression, overlap, compressed_bytes, "
+        "uncompressed_bytes."),
     "comm.mesh_step": (
         "One shard_map mesh train-step dispatch (mesh/parallelize.py); "
         "attrs carry the collective census of the compiled program "
