@@ -268,6 +268,210 @@ def serving_bench(model, *, max_batch=8, block_size=8, chunk_size=16,
     }
 
 
+def _drive_fleet(fl, prompts, new_tokens, arrivals, deadline_s=90.0,
+                 on_submitted=None):
+    """Open-loop fleet driver: submit request i once the wall clock
+    passes arrivals[i], collect results/merged stats from the router's
+    replica threads. ``on_submitted(i)`` (optional) runs right after
+    request i's submit — the drain drill hooks it to trigger mid-
+    workload. Returns (wall_s, outputs, ttfts_ms, n_complete)."""
+    n = len(prompts)
+    outputs = [None] * n
+    ttfts = [0.0] * n
+    frid2idx = {}
+    submitted = done = 0
+    t0 = time.perf_counter()
+    while done < n and time.perf_counter() - t0 < deadline_s:
+        now = time.perf_counter() - t0
+        while submitted < n and arrivals[submitted] <= now:
+            frid = fl.submit(prompts[submitted],
+                             max_new_tokens=int(new_tokens[submitted]))
+            frid2idx[frid] = submitted
+            submitted += 1
+            if on_submitted is not None:
+                on_submitted(submitted - 1)
+        for frid, toks in fl.pop_results():
+            i = frid2idx.get(frid)
+            if i is None:
+                continue
+            st = fl.pop_stats(frid) or {}
+            ttfts[i] = st.get("ttft_ns", 0) / 1e6
+            outputs[i] = list(toks)
+            done += 1
+        time.sleep(0.0005)
+    return time.perf_counter() - t0, outputs, ttfts, done
+
+
+def fleet_bench(model, *, replicas=3, max_batch=2, block_size=8,
+                chunk_size=16, decode_burst=2, n_requests=12, n_groups=2,
+                prefix_blocks=2, tail_range=(4, 10), max_new=8,
+                mean_interarrival_s=0.002, kill_nth=6, drain_replica=1,
+                seed=0, deadline_s=90.0):
+    """The fleet resilience drill (docs/serving.md, Fleet):
+
+    1. **Reference pass** — an undisturbed ``replicas``-engine
+       FleetRouter serves the Poisson mixed prefix-shared workload;
+       every request's tokens and the fleet goodput/TTFT are recorded.
+    2. **Kill drill** — a fresh fleet over the SAME workload arms
+       ``fleet.replica_step:raise:nth=kill_nth`` so one replica's
+       driving loop dies mid-decode. The router must fail over (engine
+       recovery, typed aborts re-seeded onto survivors from their
+       partial tokens), every request must complete with outputs
+       BIT-IDENTICAL to the reference pass, and the survivors must stay
+       WARM: the graftsan recompile sentinel (threshold 1) is armed
+       after warmup, so a single post-warmup compile raises — zero
+       recompiles is asserted, not sampled.
+    3. **Drain drill** — back on the healthy reference fleet, the same
+       workload runs while ``drain(drain_replica)`` fires mid-stream:
+       queued work migrates to peers, active work finishes, the replica
+       parks, and ZERO requests are lost (outputs again bit-identical).
+
+    Deterministic in ``seed``; CPU-smoke-safe at the default shapes."""
+    import numpy as np
+
+    from paddle_tpu import monitor
+    from paddle_tpu.monitor import trace
+    from paddle_tpu.analysis import faultinject as fi
+    from paddle_tpu.analysis import sanitizers as san
+    from paddle_tpu.serving import FleetRouter
+
+    vocab = model.config.vocab_size
+    rng = np.random.RandomState(seed)
+    prefix_len = prefix_blocks * block_size
+    prefixes = [rng.randint(0, vocab, (prefix_len,)).astype("int32")
+                for _ in range(n_groups)]
+    prompts, new_tokens = [], []
+    for _ in range(n_requests):
+        g = int(rng.randint(n_groups))
+        tail = rng.randint(
+            0, vocab,
+            (int(rng.randint(tail_range[0], tail_range[1] + 1)),)
+        ).astype("int32")
+        prompts.append(np.concatenate([prefixes[g], tail]))
+        new_tokens.append(max_new)
+    arrivals = np.cumsum(
+        rng.exponential(mean_interarrival_s, n_requests)) \
+        if mean_interarrival_s > 0 else np.zeros(n_requests)
+    warm_prompt = rng.randint(0, vocab, (6,)).astype("int32")
+
+    def fleet():
+        return FleetRouter(
+            model, replicas=replicas,
+            engine_kwargs=dict(max_batch=max_batch, block_size=block_size,
+                               chunk_size=chunk_size,
+                               decode_burst=decode_burst),
+            max_new_tokens=max_new)
+
+    fi.reset()
+    mon_was, trace_was = monitor.enabled(), trace.enabled()
+    monitor.enable()
+    trace.enable()          # recovery flight dumps need the recorder on
+    f_ref = f_kill = None
+    thr0 = san.recompile_threshold()
+    recompile_was = san.enabled("recompile")
+    try:
+        # -- reference pass (and later the drain drill's substrate) ------
+        f_ref = fleet()
+        f_ref.warmup(warm_prompt)
+        ref_wall, ref_out, ref_ttft, ref_done = _drive_fleet(
+            f_ref, prompts, new_tokens, arrivals, deadline_s)
+        ref_tokens = sum(len(t) for t in ref_out if t)
+
+        # -- kill drill --------------------------------------------------
+        f_kill = fleet()
+        f_kill.warmup(warm_prompt)
+        programs0 = [len(r.engine._jit_cache) for r in f_kill.replicas]
+        # zero post-warmup recompiles is a HARD gate: sentinel threshold
+        # 1 turns any compile into a raise at the compile site
+        san.reset()
+        san.set_recompile_threshold(1)
+        san.enable("recompile")
+        fi.arm("fleet.replica_step", action="raise", nth=kill_nth)
+        kill_wall, kill_out, _kill_ttft, kill_done = _drive_fleet(
+            f_kill, prompts, new_tokens, arrivals, deadline_s)
+        san.disable("recompile")
+        # the sentinel saw EVERY post-warmup program-cache miss (and a
+        # second one would have raised at the site, threshold 1); the
+        # program-set sizes double-check the warm-restart contract
+        sentinel_compiles = sum(san.compile_counts().values())
+        programs1 = [len(r.engine._jit_cache) for r in f_kill.replicas]
+        recs = [(r, rec) for r in f_kill.replicas
+                for rec in r.engine.recovery_stats]
+        rec = recs[0][1] if recs else {}
+        kill = {
+            "killed": bool(fi.trips()),
+            "failovers": int(f_kill.failovers),
+            "recoveries": len(recs),
+            "recovery_ms": round(rec.get("ms", -1.0), 2),
+            "flight_dump": rec.get("dump"),
+            "down_replica": recs[0][0].tag if recs else None,
+            "all_complete": kill_done == n_requests,
+            "tokens_match_reference": kill_out == ref_out,
+            "recompiles_post_warmup": int(sentinel_compiles
+                                          + sum(programs1)
+                                          - sum(programs0)),
+            "sentinel_trips": len(san.trips()),
+            "reference_wall_s": round(ref_wall, 2),
+            "chaos_wall_s": round(kill_wall, 2),
+        }
+        fi.reset()
+
+        # -- drain drill -------------------------------------------------
+        drained = {}
+
+        def on_submitted(i):
+            # fire the drain mid-stream, once a few requests are in
+            if i == n_requests // 2 and not drained:
+                drained.update(f_ref.drain(drain_replica,
+                                           timeout=deadline_s))
+
+        drain_wall, drain_out, _d_ttft, drain_done = _drive_fleet(
+            f_ref, prompts, new_tokens, arrivals, deadline_s,
+            on_submitted=on_submitted)
+        if not drained:     # tiny workloads: everything landed first
+            drained.update(f_ref.drain(drain_replica, timeout=deadline_s))
+        drain = {
+            "migrated": int(drained.get("migrated", 0)),
+            "parked": bool(drained.get("parked")),
+            "all_complete": drain_done == n_requests,
+            "lost": n_requests - drain_done,
+            "tokens_match_reference": drain_out == ref_out,
+            "drained_replica": drained.get("replica"),
+            "states": f_ref.states(),
+            "wall_s": round(drain_wall, 2),
+        }
+    finally:
+        fi.reset()
+        san.disable("recompile")
+        if recompile_was:
+            san.enable("recompile")
+        san.set_recompile_threshold(thr0)
+        san.reset()
+        for f in (f_ref, f_kill):
+            if f is not None:
+                f.stop()
+        if not trace_was:
+            trace.disable()
+        if not mon_was:
+            monitor.disable()
+
+    def pct(xs, q):
+        return round(float(np.percentile(np.asarray(xs), q)), 2)
+
+    return {
+        "replicas": replicas, "requests": n_requests,
+        "max_batch": max_batch, "block_size": block_size,
+        "chunk_size": chunk_size, "max_new": max_new,
+        "kill_nth": kill_nth,
+        "fleet_tokens_per_sec": round(ref_tokens / max(ref_wall, 1e-9),
+                                      1),
+        "ttft_ms": {"p50": pct(ref_ttft, 50), "p99": pct(ref_ttft, 99)},
+        "all_complete_reference": ref_done == n_requests,
+        "kill_drill": kill,
+        "drain_drill": drain,
+    }
+
+
 def spec_bench(model, *, max_batch=1, block_size=8, chunk_size=8,
                max_step_tokens=24, decode_burst=4, spec_lookahead=22,
                n_requests=6, n_groups=2, pattern_len=4, head_len=2,

@@ -37,7 +37,7 @@ ROOT = os.path.dirname(os.path.abspath(__file__))
 RESULTS = os.path.join(ROOT, "tools", "suite_results.jsonl")
 
 CONFIGS = ("lenet", "resnet50", "bert_dp", "gpt_hybrid", "serving",
-           "chaos", "spec", "mesh", "trainchaos", "fusion")
+           "chaos", "spec", "mesh", "trainchaos", "fusion", "fleet")
 
 
 # --------------------------------------------------------------------------- #
@@ -439,6 +439,71 @@ def run_spec(smoke=False):
            "unit": "speedup_vs_nonspec", "detail": res})
 
 
+def run_fleet(smoke=False):
+    """Config 11 — the FLEET resilience drill (bench_common.fleet_bench,
+    paddle_tpu/serving/fleet.py): an N-replica health-checked router
+    under the Poisson mixed prefix-shared workload. Kill drill: one of
+    the replicas dies mid-decode → failover re-seeds every in-flight
+    request onto the survivors and every output is bit-identical to an
+    undisturbed fleet, with zero post-warmup recompiles under the
+    graftsan sentinel. Drain drill: a mid-stream graceful drain loses
+    zero requests. ``smoke`` is the tier-1-safe shape
+    (`bench_suite.py --smoke fleet`)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    from bench_common import fleet_bench
+
+    dev, on_tpu, kind = _device()
+    paddle.seed(0)
+    if smoke or not on_tpu:
+        cfg = LlamaConfig(vocab_size=96, hidden_size=64,
+                          intermediate_size=176, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=2,
+                          max_position_embeddings=256)
+        params = dict(replicas=3, max_batch=2, block_size=8,
+                      chunk_size=16, decode_burst=2, n_requests=12,
+                      n_groups=2, prefix_blocks=2, tail_range=(4, 10),
+                      max_new=8, kill_nth=6)
+    else:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5632, num_hidden_layers=8,
+                          num_attention_heads=16, num_key_value_heads=16,
+                          max_position_embeddings=1024, dtype="bfloat16")
+        params = dict(replicas=3, max_batch=8, block_size=64,
+                      chunk_size=128, decode_burst=8, n_requests=24,
+                      n_groups=3, prefix_blocks=4, tail_range=(32, 96),
+                      max_new=64, kill_nth=12)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu and not smoke:
+        model.to(dtype="bfloat16")
+    res = fleet_bench(model, **params)
+    res["device"] = kind
+    res["smoke"] = bool(smoke)
+    if smoke:
+        # the drill's own hard bounds (tier-1 gates on this exit code):
+        # ISSUE 14 acceptance — 1-of-3 replicas killed mid-workload →
+        # every request completes, outputs bit-identical to the
+        # undisturbed fleet, >= 1 failover counted, warm recovery (zero
+        # post-warmup recompiles under the sentinel), and the drain
+        # drill loses zero requests
+        k, d = res["kill_drill"], res["drain_drill"]
+        assert res["all_complete_reference"], res
+        assert k["killed"] and k["recoveries"] >= 1, k
+        assert k["failovers"] >= 1, k
+        assert k["flight_dump"], k
+        assert k["all_complete"], k
+        assert k["tokens_match_reference"], k
+        assert k["recompiles_post_warmup"] == 0, k
+        assert k["sentinel_trips"] == 0, k
+        assert 0 < k["recovery_ms"] < 5000, k
+        assert d["lost"] == 0 and d["all_complete"], d
+        assert d["parked"], d
+        assert d["tokens_match_reference"], d
+    _emit({"config": "fleet", "value": res["fleet_tokens_per_sec"],
+           "unit": "tokens/s", "detail": res})
+
+
 def _force_virtual_mesh():
     """The 8-device virtual CPU mesh env, set BEFORE jax's backends
     initialize (shared by the mesh-family workers; _run_config applies
@@ -649,7 +714,8 @@ def main():
     if args.smoke:
         smokes = {"serving": run_serving, "chaos": run_chaos,
                   "spec": run_spec, "mesh": run_mesh,
-                  "trainchaos": run_trainchaos, "fusion": run_fusion}
+                  "trainchaos": run_trainchaos, "fusion": run_fusion,
+                  "fleet": run_fleet}
         if args.smoke not in smokes:
             ap.error(f"--smoke supports {sorted(smokes)}, "
                      f"not {args.smoke!r}")
@@ -688,6 +754,7 @@ if __name__ == "__main__":
          "bert_dp": run_bert_dp, "gpt_hybrid": run_gpt_hybrid,
          "serving": run_serving, "chaos": run_chaos,
          "spec": run_spec, "mesh": run_mesh,
-         "trainchaos": run_trainchaos, "fusion": run_fusion}[which]()
+         "trainchaos": run_trainchaos, "fusion": run_fusion,
+         "fleet": run_fleet}[which]()
     else:
         main()

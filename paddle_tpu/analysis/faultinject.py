@@ -69,6 +69,24 @@ POINTS = {
         "mixed step's verify lanes). flag = the drafter degrades to "
         "plain 1-token decode for the step — outputs stay correct "
         "(drafts are only ever verified), the speedup is sacrificed."),
+    "fleet.route": (
+        "The FleetRouter's routing decision (serving/fleet.py _route), "
+        "before a replica is chosen. raise = routing itself dies — the "
+        "submit must surface a typed error, never strand the request; "
+        "delay = a slow control plane while replicas keep serving."),
+    "fleet.replica_step": (
+        "One iteration of a fleet replica's driving loop, before a step "
+        "that HAS work (serving/fleet.py _replica_loop — the fleet twin "
+        "of serving.drive). raise = the replica dies mid-decode: THE "
+        "fleet kill drill — failover must re-seed every in-flight "
+        "request onto a surviving replica, bit-identical outputs; "
+        "delay = the replica hangs (the per-replica watchdog drill)."),
+    "fleet.health": (
+        "One pass of the fleet health monitor's scan loop "
+        "(serving/fleet.py _health_loop). delay = health/hedging "
+        "decisions stall while replicas keep serving; raise = the "
+        "monitor thread dies and must be relaunched, never silently "
+        "absent."),
     "paged_kv.ensure": (
         "Entry of PagedKVCache.ensure_capacity. flag = the site raises "
         "the allocator's typed pool-exhausted RuntimeError without "

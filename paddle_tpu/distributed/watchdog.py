@@ -64,9 +64,15 @@ def _trace():
 
 
 class CommWatchdog:
-    def __init__(self, timeout=1800.0, on_timeout=None, max_history=10000):
+    def __init__(self, timeout=1800.0, on_timeout=None, max_history=10000,
+                 flight_key=None):
         self.timeout = timeout
         self.on_timeout = on_timeout
+        # flight-dump path key: a watchdog observing ONE engine/replica
+        # dumps to that component's per-replica file, so its dump
+        # coalesces with the component's own recovery dump (same path)
+        # and never blends with a sibling replica's
+        self.flight_key = flight_key
         # graftsan known-lock site: the watchdog's lock is held by user
         # threads (watch enter/exit) AND the scanner — exactly the kind of
         # cross-thread lock the order witness exists for
@@ -132,7 +138,8 @@ class CommWatchdog:
                 self.last_flight_dump = trace.flight_dump(
                     reason=f"watchdog timeout: {desc} exceeded "
                            f"{self.timeout}s",
-                    extra={"watchdog": self.dump()})
+                    extra={"watchdog": self.dump()},
+                    key=self.flight_key)
         except Exception:  # noqa: BLE001
             pass
 

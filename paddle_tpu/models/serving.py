@@ -89,13 +89,20 @@ class RequestShed(AdmissionTimeout):
 class RequestAborted(RuntimeError):
     """An in-flight request was aborted by engine recovery (driving-
     thread death or hang): ``tokens`` carries the partial output so the
-    caller can resume/retry instead of hanging silently."""
+    caller can resume/retry instead of hanging silently, and ``stats``
+    carries the request's partial pop_stats record (ttft_ns if the
+    first token had already landed, prefill chunks, shared prefix
+    tokens) so a router re-routing the work can merge them into the
+    replacement request's final stats — fleet TTFT percentiles stay
+    honest across a failover instead of restarting the clock."""
 
-    def __init__(self, message, rid=None, tokens=(), tenant=""):
+    def __init__(self, message, rid=None, tokens=(), tenant="",
+                 stats=None):
         super().__init__(message)
         self.rid = rid
         self.tokens = list(tokens)
         self.tenant = tenant
+        self.stats = stats
 
 
 class _Mon:
@@ -108,7 +115,7 @@ class _Mon:
                  "pack", "chunk_depth", "pc_hits", "pc_misses", "pc_shared",
                  "pc_blocks", "pc_evictions",
                  "shed", "tenant_depth", "aborted", "recoveries",
-                 "preemptions",
+                 "preemptions", "cancelled",
                  "spec_drafted", "spec_accepted", "spec_rate", "pool_bytes",
                  "jit_compiles", "jit_hits", "jit_sigs")
 
@@ -155,6 +162,7 @@ def _mon():
         o.aborted = m.counter("paddle_tpu_serving_aborted_total")
         o.recoveries = m.counter("paddle_tpu_serving_recoveries_total")
         o.preemptions = m.counter("paddle_tpu_serving_preemptions_total")
+        o.cancelled = m.counter("paddle_tpu_serving_cancelled_total")
         o.spec_drafted = m.counter(
             "paddle_tpu_serving_spec_draft_tokens_total")
         o.spec_accepted = m.counter(
@@ -418,6 +426,17 @@ class ContinuousBatchingEngine:
         # not leak one record per crash loop iteration
         self.recovery_stats = collections.deque(maxlen=256)
         self.last_recovery_dump = None
+        # -- fleet-facing surface (serving/fleet.py) ---------------------
+        # cancellation requests (thread-safe enqueue; the driving thread
+        # applies them at the next step boundary) — the hedging loser's
+        # exit path
+        self._cancel_q = collections.deque()
+        self.cancelled = 0
+        # monotonic timestamp of the step currently executing (None when
+        # no step is in flight): the host-side mirror of the open
+        # serving.step span, readable without tracing on — the fleet
+        # health monitor's step-staleness signal
+        self.step_open_since = None
 
     # -- compiled path -------------------------------------------------------
     def _step_jit(self):
@@ -885,6 +904,10 @@ class ContinuousBatchingEngine:
         epoch = self._epoch
         mon = _mon()
         sp = None
+        # the host-side twin of the open serving.step span: set while a
+        # step runs, cleared on exit — a fleet health monitor reads its
+        # age as the step-staleness signal without needing tracing on
+        self.step_open_since = time.monotonic()
         if mon.tstate.on:
             # an OPEN serving.step span is what a flight dump names when
             # the driving thread hangs or dies mid-step
@@ -925,6 +948,7 @@ class ContinuousBatchingEngine:
                 return []
             return finished
         finally:
+            self.step_open_since = None
             mon.trace.end_span(sp)
 
     def _ensure(self, need):
@@ -959,6 +983,10 @@ class ContinuousBatchingEngine:
         # recover() documents it)
         epoch = self._epoch
         mon = _mon()
+        # cancellations first: a cancelled queued request must not be
+        # admitted by the drain below, and a cancelled active slot frees
+        # its lane (and blocks) before the pack assembles
+        self._apply_cancels()
         self._drain_pending()
         if not self._active.any():
             if mon.state.on:
@@ -1553,6 +1581,76 @@ class ContinuousBatchingEngine:
     def num_pending(self):
         return sum(len(t.queue) for t in list(self._tenants.values()))
 
+    # -- fleet-facing surface (cancellation + queue withdrawal) --------------
+    def cancel(self, rid):
+        """Request cancellation of one request (thread-safe: pure
+        enqueue, like submit()). The DRIVING thread applies it at the
+        next step boundary: a queued request leaves its tenant lane, an
+        active request's slot is evicted (blocks freed) without emitting
+        a result. A request that already finished is unaffected — its
+        result stands. This is the tail-hedging loser's exit path
+        (serving/fleet.py): the slower duplicate stops burning lanes
+        the moment the winner lands."""
+        self._cancel_q.append(rid)
+
+    def _apply_cancels(self):
+        """Driving thread only: apply every pending cancellation."""
+        rids = set(_drain(self._cancel_q))
+        if not rids:
+            return
+        mon = _mon()
+        n = 0
+        with self._submit_lock:
+            for ten in self._tenants.values():
+                for req in [r for r in ten.queue if r.rid in rids]:
+                    ten.queue.remove(req)
+                    rids.discard(req.rid)
+                    self._stats.pop(req.rid, None)
+                    entry = self._req_spans.pop(req.rid, None)
+                    if entry is not None:
+                        mon.trace.drop(entry[1])
+                        mon.trace.end_span(entry[0])
+                    n += 1
+        for b in range(self.max_batch):
+            req = self._slots[b]
+            if req is not None and req.rid in rids:
+                self._evict(b)          # frees blocks; no result emitted
+                self._stats.pop(req.rid, None)
+                n += 1
+        if n:
+            self.cancelled += n
+            if mon.state.on:
+                mon.cancelled.inc(n)
+                self._update_gauges(mon)
+
+    def withdraw_pending(self):
+        """Pull every QUEUED (not yet admitted) request out of the
+        tenant lanes (thread-safe: queue surgery under the submit lock
+        only — slot/pager state is untouched). Returns a list of
+        ``{"rid", "prompt", "max_new", "tenant", "outputs"}`` dicts
+        (``outputs`` is non-empty for a preempted request re-queued
+        mid-generation). The fleet router uses this to MIGRATE a
+        draining or circuit-broken replica's queued work to its peers
+        — zero requests stranded behind a down replica."""
+        mon = _mon()
+        out = []
+        with self._submit_lock:
+            for ten in self._tenants.values():
+                while ten.queue:
+                    req = ten.queue.popleft()
+                    self._stats.pop(req.rid, None)
+                    entry = self._req_spans.pop(req.rid, None)
+                    if entry is not None:
+                        mon.trace.drop(entry[1])
+                        mon.trace.end_span(entry[0])
+                    out.append({"rid": req.rid, "prompt": req.prompt,
+                                "max_new": req.max_new,
+                                "tenant": req.tenant,
+                                "outputs": list(req.outputs)})
+        if out and mon.state.on:
+            self._update_gauges(mon)
+        return out
+
     # -- crash/hang recovery (the drilled path) ------------------------------
     def recover(self, reason="", stuck=""):
         """Tear down the slot state of a dead or hung epoch and restart
@@ -1597,7 +1695,11 @@ class ContinuousBatchingEngine:
                         extra={"engine": self._san_tag,
                                "open_serving_spans": open_serving,
                                "active": int(self._active.sum()),
-                               "epoch": self._epoch})
+                               "epoch": self._epoch},
+                        # per-engine dump file: this recovery coalesces
+                        # with THIS engine's watchdog dump and never
+                        # blends with a sibling replica's
+                        key=self._san_tag)
             except Exception:  # noqa: BLE001 - a dump failure never
                 pass           # masks the recovery it documents
             self.last_recovery_dump = path
@@ -1606,19 +1708,24 @@ class ContinuousBatchingEngine:
                 req = self._slots[b]
                 if req is None:
                     continue
+                # the partial stats ride the typed abort (popped, not
+                # orphaned: nobody ever pops the dead rid's record —
+                # callers track the replacement) so a router can merge
+                # ttft/chunks/shared into the re-routed request's final
+                # stats and fleet TTFT percentiles stay honest
+                st = self._stats.pop(req.rid, None)
+                if st is not None:
+                    st["aborted"] = True
+                    st["tokens"] = len(req.outputs)
                 self._aborted.append(RequestAborted(
                     f"request {req.rid} aborted by engine recovery: "
                     f"{reason}", rid=req.rid, tokens=req.outputs,
-                    tenant=req.tenant))
+                    tenant=req.tenant, stats=st))
                 aborted += 1
                 entry = self._req_spans.pop(req.rid, None)
                 if entry is not None:
                     mon.trace.drop(entry[1])
                     mon.trace.end_span(entry[0])
-                st = self._stats.get(req.rid)
-                if st is not None:
-                    st["aborted"] = True
-                    st["tokens"] = len(req.outputs)
                 self._pager.free_sequence(b)
                 self._slots[b] = None
                 if self._drafter is not None:
@@ -1679,7 +1786,8 @@ class ContinuousBatchingEngine:
             from ..distributed.watchdog import CommWatchdog
 
             self._dog = CommWatchdog(timeout=float(hang_timeout),
-                                     on_timeout=self._on_hang)
+                                     on_timeout=self._on_hang,
+                                     flight_key=self._san_tag)
         self._spawn_driver()
 
     def stop_driver(self, timeout=5.0):

@@ -23,7 +23,7 @@ framework.
 
 # Subsystems a metric may belong to (the <subsystem> token of the name).
 SUBSYSTEMS = ("dispatch", "jit", "serving", "kv", "dataloader", "monitor",
-              "mesh", "comm", "ckpt", "train")
+              "mesh", "comm", "ckpt", "train", "fleet")
 
 NAME_PATTERN = (
     r"^paddle_tpu_(" + "|".join(SUBSYSTEMS) + r")_[a-z][a-z0-9_]*$"
@@ -135,6 +135,11 @@ METRICS = {
         "counter", (),
         "Active requests preempted under pool pressure: KV spilled to "
         "host RAM, request requeued at the head of its tenant queue."),
+    "paddle_tpu_serving_cancelled_total": (
+        "counter", (),
+        "Requests cancelled via engine.cancel() (queued requests "
+        "removed from their lane, active slots evicted without a "
+        "result) — the tail-hedging loser's exit path."),
     "paddle_tpu_serving_spec_draft_tokens_total": (
         "counter", (),
         "Speculative draft tokens packed into mixed-step verify lanes "
@@ -153,6 +158,46 @@ METRICS = {
         "Device bytes held by the engine's paged KV pools (all layers, "
         "values + scales) — the capacity lever quantized int8 pools "
         "halve: equal byte budgets admit ~2x the concurrent requests."),
+    # -- serving fleet (serving/fleet.py) --------------------------------
+    "paddle_tpu_fleet_requests_total": (
+        "counter", (),
+        "Requests submitted through the FleetRouter (each is routed to "
+        "exactly one replica engine; failover/hedge duplicates are not "
+        "re-counted here)."),
+    "paddle_tpu_fleet_routed_total": (
+        "counter", ("replica",),
+        "Routing decisions per replica (least-queue-depth placement; "
+        "failover re-routes and hedge duplicates included), labeled by "
+        "replica tag."),
+    "paddle_tpu_fleet_failovers_total": (
+        "counter", (),
+        "In-flight requests re-routed to a surviving replica after a "
+        "replica death or hang — re-seeded from RequestAborted.tokens "
+        "(prompt + partial output re-prefilled), so the caller's final "
+        "result is one uninterrupted sequence."),
+    "paddle_tpu_fleet_hedges_total": (
+        "counter", (),
+        "Tail-hedging duplicates spawned: a request past its latency "
+        "SLO ran a bounded second copy on another replica (first "
+        "finisher wins, loser cancelled)."),
+    "paddle_tpu_fleet_hedge_wins_total": (
+        "counter", (),
+        "Hedged requests whose DUPLICATE finished first (the hedge "
+        "paid off; the primary was cancelled)."),
+    "paddle_tpu_fleet_healthy_replicas": (
+        "gauge", (),
+        "Replicas currently in the healthy state (admitting without "
+        "restriction)."),
+    "paddle_tpu_fleet_replica_state": (
+        "gauge", ("replica",),
+        "Per-replica health state code: 0=healthy, 1=suspect (stale "
+        "heartbeat or half-open probe admission), 2=down (circuit "
+        "broken, backing off), 3=draining, 4=parked."),
+    "paddle_tpu_fleet_drains_total": (
+        "counter", (),
+        "Graceful drains completed: admission stopped, queued work "
+        "migrated to peers, in-flight work finished, replica parked "
+        "with zero lost requests."),
     # -- paged KV allocator (models/paged_kv.py) -------------------------
     "paddle_tpu_kv_free_blocks": (
         "gauge", (),
@@ -262,7 +307,7 @@ def spec(name):
 
 # Subsystems a span may belong to (the first dotted token of the name).
 SPAN_SUBSYSTEMS = ("dispatch", "jit", "serving", "dataloader", "train",
-                   "comm", "monitor", "mesh", "ckpt")
+                   "comm", "monitor", "mesh", "ckpt", "fleet")
 
 SPAN_PATTERN = (
     r"^(" + "|".join(SPAN_SUBSYSTEMS)
@@ -332,6 +377,30 @@ SPANS = {
         "as extra ragged lanes, accepted by the device-side longest-"
         "agreeing-prefix rule, rejects rolled back by rewinding "
         "seq_lens. attrs: drafted, accepted, lanes."),
+    # -- serving fleet (serving/fleet.py) --------------------------------
+    "fleet.route": (
+        "One FleetRouter routing decision: the admissible replica with "
+        "the least queue depth takes the request (prefix-affinity hook "
+        "stubbed for the ROADMAP item 4 follow-up). attrs: replica, "
+        "depth, frid."),
+    "fleet.failover": (
+        "One failover pass after a replica death or hang: every "
+        "aborted in-flight request re-seeded (prompt + partial tokens) "
+        "onto a surviving replica, queued work migrated. attrs: "
+        "replica, rerouted, migrated, reason."),
+    "fleet.hedge": (
+        "One tail-hedging duplicate spawned for a request past its "
+        "latency SLO (first finisher wins, loser cancelled). attrs: "
+        "frid, primary, hedge."),
+    "fleet.drain": (
+        "One graceful drain: admission stopped, queued requests "
+        "migrated to peers, in-flight work finished, replica parked. "
+        "attrs: replica, migrated, waited_ms."),
+    "fleet.health": (
+        "One replica health-state TRANSITION observed by the fleet "
+        "monitor (healthy/suspect/down/draining/parked — scans "
+        "themselves are not spanned). attrs: replica, from, to, "
+        "reason."),
     # -- dataloader (io/dataloader.py) -----------------------------------
     "dataloader.batch": (
         "Consumer-visible wait for the next staged batch (fetch + "

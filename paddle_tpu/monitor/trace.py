@@ -155,10 +155,7 @@ def reset(capacity=None):
     _dispatch_tick = itertools.count()
     _tls.__dict__.clear()
     with _dump_lock:
-        _last_dump["path"] = None
-        _last_dump["t"] = 0.0
-        _last_dump["reasons"] = []
-        _last_dump["extras"] = []
+        _last_dumps.clear()
 
 
 def set_dispatch_sampling(every):
@@ -430,12 +427,17 @@ def _rank():
     return 0
 
 
-def default_flight_path(rank=None):
+def default_flight_path(rank=None, key=None):
     """Per-rank flight-dump file: ``$PADDLE_TPU_FLIGHT_DIR`` (default
-    /tmp) / paddle_tpu_flight_rank<r>_pid<pid>.json."""
+    /tmp) / paddle_tpu_flight_rank<r>_pid<pid>[_<key>].json. ``key``
+    names the observed component (e.g. a serving engine/replica tag):
+    a multi-engine process dumps each engine's post-mortem to ITS OWN
+    file instead of blending replicas."""
     d = os.environ.get("PADDLE_TPU_FLIGHT_DIR") or "/tmp"
     r = _rank() if rank is None else rank
-    return os.path.join(d, f"paddle_tpu_flight_rank{r}_pid{os.getpid()}.json")
+    suffix = f"_{key}" if key else ""
+    return os.path.join(
+        d, f"paddle_tpu_flight_rank{r}_pid{os.getpid()}{suffix}.json")
 
 
 # Dump coalescing: one hang is often observed by SEVERAL watchers (the
@@ -443,36 +445,43 @@ def default_flight_path(rank=None):
 # trip). Within the window, dumps to the same path MERGE — the file
 # carries every observer's reason and (being written last) every
 # observer's open spans — instead of the last partial dump clobbering
-# the first.
+# the first. The merge state is PER PATH: a fleet of in-process engine
+# replicas dumps one file per replica (`key=` above), and replica A's
+# observers keep coalescing with each other even when replica B dumps
+# in between — never across paths.
 DUMP_COALESCE_S = 10.0
 _dump_lock = threading.Lock()
-_last_dump = {"path": None, "t": 0.0, "reasons": [], "extras": []}
+_last_dumps = {}     # path -> {"t": first-dump monotonic, reasons, extras}
 
 
 def flight_dump(path=None, reason="", tail=256, extra=None,
-                coalesce_s=None):
+                coalesce_s=None, key=None):
     """Write the flight-recorder post-mortem: last-``tail`` completed spans,
     every OPEN span, the monitor metrics snapshot and the provenance block,
     to a per-rank file. Called by the watchdog timeout path, serving
     recovery and elastic restarts; never raises (a failing dump must not
-    mask the hang it documents). Dumps to the same path within
+    mask the hang it documents). ``key`` suffixes the default path with
+    the observed component (engine/replica tag) so a multi-replica
+    process yields one dump per replica. Dumps to the same path within
     ``coalesce_s`` (default :data:`DUMP_COALESCE_S`) seconds merge their
     reasons into ONE file (``reasons`` list + joined ``reason``) — a hang
     the watchdog and the engine both observe produces a single dump
-    naming both, not two partial ones. Returns the path written, or
+    naming both, not two partial ones — while dumps to different paths
+    (two different replicas) never fuse. Returns the path written, or
     None."""
     try:
         from . import snapshot as _metrics_snapshot
 
         doc = span_dump(tail=tail)
         window = DUMP_COALESCE_S if coalesce_s is None else coalesce_s
-        target = path or default_flight_path()
+        target = path or default_flight_path(key=key)
         with _dump_lock:
             now_mono = time.monotonic()
-            if _last_dump["path"] == target \
-                    and now_mono - _last_dump["t"] < window:
-                reasons = _last_dump["reasons"] + [reason]
-                extras = _last_dump["extras"] + ([extra] if extra else [])
+            last = _last_dumps.get(target)
+            if last is not None and now_mono - last["t"] < window:
+                reasons = last["reasons"] + [reason]
+                extras = last["extras"] + ([extra] if extra else [])
+                t_anchor = last["t"]
             else:
                 reasons = [reason]
                 extras = [extra] if extra else []
@@ -480,10 +489,15 @@ def flight_dump(path=None, reason="", tail=256, extra=None,
                 # recurring fault (recovery loop dumping every few
                 # seconds) must start a fresh file once the window
                 # elapses, not merge — and grow — forever
-                _last_dump["t"] = now_mono
-            _last_dump["path"] = target
-            _last_dump["reasons"] = reasons
-            _last_dump["extras"] = extras
+                t_anchor = now_mono
+            if len(_last_dumps) > 64:
+                # bounded: drop expired windows (a long-lived process
+                # cycling many paths must not grow this forever)
+                for p in [p for p, d in _last_dumps.items()
+                          if now_mono - d["t"] >= window and p != target]:
+                    _last_dumps.pop(p)
+            _last_dumps[target] = {"t": t_anchor, "reasons": reasons,
+                                   "extras": extras}
         doc["reason"] = "; ".join(r for r in reasons if r)
         doc["reasons"] = reasons
         if extras:

@@ -1,0 +1,18 @@
+"""Serving-tier orchestration above the single engine.
+
+``paddle_tpu.models.serving`` is ONE continuous-batching engine;
+this package is the layer that makes N of them a fleet:
+
+- :mod:`paddle_tpu.serving.fleet` — :class:`FleetRouter`, the
+  health-checked multi-replica router (failover, tail hedging,
+  graceful drain) of docs/serving.md's "Fleet" section.
+
+Importing this package is cheap (no jax work beyond what the engine
+module itself already did); the router spawns its threads only when
+constructed.
+"""
+from .fleet import (FleetRouter, FleetUnavailable, HEALTHY, SUSPECT,
+                    DOWN, DRAINING, PARKED)
+
+__all__ = ["FleetRouter", "FleetUnavailable", "HEALTHY", "SUSPECT",
+           "DOWN", "DRAINING", "PARKED"]

@@ -265,6 +265,45 @@ class TestFusionSmoke:
         assert rm["recompiles_post_warmup"] == 0
 
 
+class TestFleetSmoke:
+    # fast tier on purpose: `bench_suite.py --smoke fleet` is the
+    # ISSUE 14 resilience drill — kill 1 of 3 router-driven replicas
+    # mid-workload, fail over bit-identically, and drain gracefully
+    def test_smoke_fleet_meets_acceptance(self):
+        # every gate inside run_fleet is deterministic except the
+        # recovery-latency wall clock; retry_smoke absorbs a contended
+        # runner (a worker whose own bounds tripped consumes a retry)
+        row = retry_smoke(lambda: _run_smoke("fleet", 560),
+                          lambda r: r.get("value", 0) > 0)
+        assert row["config"] == "fleet"
+        assert row["unit"] == "tokens/s"
+        d = row["detail"]
+        assert row["value"] == d["fleet_tokens_per_sec"] > 0
+        assert d["all_complete_reference"] is True
+        k = d["kill_drill"]
+        # ISSUE 14 acceptance: 1-of-3 replicas killed mid-workload →
+        # every request completes, outputs bit-identical to the
+        # undisturbed fleet, >= 1 failover counted ...
+        assert k["killed"] is True
+        assert k["recoveries"] >= 1
+        assert k["failovers"] >= 1
+        assert k["all_complete"] is True
+        assert k["tokens_match_reference"] is True
+        # ... warm recovery: survivors' compiled programs untouched
+        # (zero post-warmup recompiles under the graftsan sentinel),
+        # a per-replica flight dump, and a bounded recovery
+        assert k["recompiles_post_warmup"] == 0
+        assert k["sentinel_trips"] == 0
+        assert k["flight_dump"] and k["down_replica"] in k["flight_dump"]
+        assert 0 < k["recovery_ms"] < 5000
+        # ... and the drain drill loses zero requests
+        dd = d["drain_drill"]
+        assert dd["lost"] == 0 and dd["all_complete"] is True
+        assert dd["parked"] is True
+        assert dd["tokens_match_reference"] is True
+        assert dd["states"][dd["drained_replica"]] == "parked"
+
+
 @pytest.mark.slow
 class TestBenchSuite:
     def test_lenet_and_bert(self):

@@ -464,6 +464,53 @@ class TestFlightRecorder:
         assert p.startswith(str(tmp_path))
         assert f"rank3_pid{os.getpid()}" in p
 
+    def test_dump_key_suffixes_default_path(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path))
+        p = trace.default_flight_path(key="e7")
+        assert p.endswith(f"_pid{os.getpid()}_e7.json")
+        # the keyless path is unchanged (single-engine callers)
+        assert trace.default_flight_path().endswith(
+            f"_pid{os.getpid()}.json")
+
+    def test_coalescing_is_per_path_never_across_replicas(self,
+                                                          monkeypatch,
+                                                          tmp_path):
+        """The multi-engine coalescing satellite: same-key dumps within
+        the window merge into ONE file (observer pairs), while dumps
+        from a DIFFERENT replica interleaved between them neither fuse
+        with nor break the first replica's series."""
+        monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path))
+        trace.enable()
+        pa1 = trace.flight_dump(reason="watchdog timeout: eA stuck",
+                                key="eA", extra={"watchdog": "tbl"})
+        pb = trace.flight_dump(reason="serving recovery (eB): crash",
+                               key="eB")
+        pa2 = trace.flight_dump(reason="serving recovery (eA): hang",
+                                key="eA", extra={"engine": "eA"})
+        assert pa1 == pa2 and pa1 != pb
+        assert len(list(tmp_path.glob("*.json"))) == 2
+        doc_a = json.load(open(pa1))
+        # replica A's two observers merged, replica B stayed out
+        assert doc_a["reasons"] == ["watchdog timeout: eA stuck",
+                                    "serving recovery (eA): hang"]
+        assert [e for e in doc_a["extras"]] == [{"watchdog": "tbl"},
+                                                {"engine": "eA"}]
+        doc_b = json.load(open(pb))
+        assert doc_b["reasons"] == ["serving recovery (eB): crash"]
+
+    def test_coalescing_window_expires_per_path(self, monkeypatch,
+                                                tmp_path):
+        monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path))
+        trace.enable()
+        p1 = trace.flight_dump(reason="first", key="eC",
+                               coalesce_s=0.05)
+        time.sleep(0.08)
+        p2 = trace.flight_dump(reason="second", key="eC",
+                               coalesce_s=0.05)
+        assert p1 == p2
+        doc = json.load(open(p2))
+        assert doc["reasons"] == ["second"]   # a fresh series, not a blend
+
     def test_watchdog_timeout_writes_flight_dump(self, monkeypatch,
                                                  tmp_path):
         """ISSUE 3 acceptance: a forced WatchdogTimeout writes a
