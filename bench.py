@@ -971,11 +971,15 @@ def worker():
         fusion_info = {"error": f"{type(e).__name__}: {e}"[:200]}
     _log(f"[bench] fusion: {fusion_info}")
 
-    # 6*N FLOPs/token (fwd+bwd) + causal attention term 12*L*H*S/2... use the
-    # standard PaLM appendix-B accounting: 6N + 12*L*h*S (h=hidden) per token.
+    # 6*N FLOPs/token (fwd+bwd) + causal attention term — the standard
+    # PaLM appendix-B accounting, owned by monitor/timeline.py since
+    # ISSUE 15 (one formula, shared with obs_bench/perf analytics)
+    from paddle_tpu.monitor.timeline import transformer_flops_per_token
+
     n_params = sum(int(np.prod(p.shape)) for p in params)
-    attn_flops = 12 * cfg.num_hidden_layers * cfg.hidden_size * seq
-    flops_per_token = 6 * n_params + attn_flops
+    flops_per_token = transformer_flops_per_token(
+        n_params, num_layers=cfg.num_hidden_layers,
+        hidden=cfg.hidden_size, seq=seq)
     mfu = tokens_per_s * flops_per_token / _peak_flops(dev)
 
     doc = {

@@ -348,3 +348,10 @@ _san_install()
 from .analysis.faultinject import install_from_env as _fi_install  # noqa: E402
 
 _fi_install()
+
+# graftscope debug endpoint (monitor/server.py): opt-in via
+# PADDLE_TPU_DEBUG_PORT=<port> — without it no listening socket and no
+# server thread ever exist (the introspection plane's off-cost is zero).
+from .monitor.server import install_from_env as _obs_install  # noqa: E402
+
+_obs_install()

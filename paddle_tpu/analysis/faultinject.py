@@ -143,6 +143,15 @@ POINTS = {
         "failure must surface as a typed AnalysisError carrying the "
         "program name and pass id — a crashing analyzer must never "
         "fail a build opaquely."),
+    "obs.scrape": (
+        "The graftscope debug endpoint's request handler "
+        "(monitor/server.py do_GET, fired once per scrape before any "
+        "route dispatch). flag (or raise) = the endpoint answers 503 "
+        "while the engine underneath keeps serving untouched — the "
+        "drill that pins the introspection plane's failure domain to "
+        "itself (zero recompiles, no hostsync trips, bit-identical "
+        "outputs under PADDLE_TPU_SANITIZE=all; "
+        "tests/test_obs_server.py)."),
 }
 
 ACTIONS = ("raise", "delay", "flag")

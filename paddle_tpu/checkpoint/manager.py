@@ -496,6 +496,21 @@ class CheckpointManager:
             self._writer.join(timeout=30)
         self._writer = None
 
+    def status(self):
+        """The manager's graftscope /statusz section (embedded in the
+        trainer's): commit state read from the directory listing —
+        numpy+stdlib only, like everything in this module."""
+        steps = self.steps()
+        return {
+            "directory": str(self.directory),
+            "committed": len(steps),
+            "steps": steps[-5:],
+            "latest_step": steps[-1] if steps else None,
+            "keep": self.keep,
+            "writer_alive": bool(self._writer is not None
+                                 and self._writer.is_alive()),
+        }
+
     # -- restore -------------------------------------------------------------
     def steps(self):
         """Committed step numbers, ascending."""

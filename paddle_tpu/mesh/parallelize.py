@@ -448,6 +448,7 @@ class MeshParallel:
         self._steps = 0
         self._collectives = None
         self._collective_bytes = None
+        self._closed_jaxpr = None
         self._hlo_text = None
         self._mon = None
         self._gauge_set = False
@@ -493,6 +494,17 @@ class MeshParallel:
                     lowered, force_compile=bool(self.meta["auto_axes"]))
         return self._collectives
 
+    def step_jaxpr(self, *batch):
+        """The traced (closed) jaxpr of this step program, cached after
+        the first trace — the input of the jaxpr-walking consumers: the
+        byte census, graftir passes, and graftscope's modeled
+        comm-overlap timeline
+        (``monitor.timeline.modeled_overlap_report``)."""
+        if self._closed_jaxpr is None:
+            self._closed_jaxpr = jax.make_jaxpr(self._jitted)(
+                *self._step_args(batch))
+        return self._closed_jaxpr
+
     def collective_bytes(self, *batch):
         """Per-collective BYTES-on-wire of the step program
         (``analysis/jaxpr/collectives.byte_census_jaxpr`` over the
@@ -507,7 +519,7 @@ class MeshParallel:
         Cached after the first trace; surfaced as ``<collective>_bytes``
         attrs on ``comm.mesh_step`` spans and in the mesh_bench rows."""
         if self._collective_bytes is None:
-            closed = jax.make_jaxpr(self._jitted)(*self._step_args(batch))
+            closed = self.step_jaxpr(*batch)
             census = _collectives.byte_census_jaxpr(closed.jaxpr)
             # merge the HLO-text pricing for ops the jaxpr cannot see
             self.collective_counts(*batch)

@@ -107,6 +107,11 @@ class MeshTrainer:
 
             self._dog = CommWatchdog(timeout=float(hang_timeout),
                                      on_timeout=self._on_hang)
+        # graftscope: the trainer (and its checkpoint manager) is a
+        # /statusz section, held via WeakMethod; close() unregisters
+        from ..monitor import server as _obs
+
+        _obs.register_status_provider("trainer", self.status)
 
     # -- the fenced step -----------------------------------------------------
     def train_step(self, *batch):
@@ -490,11 +495,35 @@ class MeshTrainer:
             batch = data
         return batch if isinstance(batch, tuple) else tuple(batch)
 
+    def status(self):
+        """The trainer's graftscope /statusz section: step/epoch
+        cursors, recovery history and the checkpoint manager's commit
+        state — host-readable only, safe from the scrape thread."""
+        doc = {
+            "health": "ok",
+            "step": self.step_idx,
+            "epoch": self._epoch,
+            "dp_degree": self.handle.meta["degree"],
+            "shard_optimizer": self.handle.shard_optimizer,
+            "recoveries": len(self.recovery_stats),
+            "max_recoveries": self.max_recoveries,
+            "losses_recorded": len(self.losses),
+            "watchdog_armed": self._dog is not None,
+        }
+        if self.recovery_stats:
+            doc["last_recovery"] = dict(self.recovery_stats[-1])
+        if self.manager is not None:
+            doc["checkpoint"] = self.manager.status()
+        return doc
+
     def close(self):
         """Stop the watchdog and flush outstanding checkpoint writes; a
         manager THIS trainer constructed also has its writer thread
         stopped (a caller-provided manager may be shared — only
         flushed)."""
+        from ..monitor import server as _obs
+
+        _obs.unregister_status_provider("trainer", self.status)
         if self._dog is not None:
             self._dog.stop()
         if self.manager is not None:

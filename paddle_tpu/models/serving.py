@@ -437,6 +437,12 @@ class ContinuousBatchingEngine:
         # serving.step span, readable without tracing on — the fleet
         # health monitor's step-staleness signal
         self.step_open_since = None
+        # graftscope: every engine is a /statusz section (held via
+        # WeakMethod, so an engine stays collectable while registered)
+        from ..monitor import server as _obs
+
+        _obs.register_status_provider(f"serving.{self._san_tag}",
+                                      self.status)
 
     # -- compiled path -------------------------------------------------------
     def _step_jit(self):
@@ -794,6 +800,50 @@ class ContinuousBatchingEngine:
         tokens), retained until popped — the bench reads TTFT percentiles
         from here after each eviction."""
         return self._stats.pop(rid, None)
+
+    def status(self):
+        """The engine's graftscope ``/statusz`` section: host-readable
+        state only (counters, pool headroom, compile counts, last
+        recovery) — no jax dispatch, no locks, safe to call from the
+        scrape thread while another thread drives step()."""
+        pager = self._pager
+        free = len(pager._free)
+        total = pager.num_blocks - 1          # block 0 is the null block
+        doc = {
+            "engine": self._san_tag,
+            "health": "ok",
+            "active": int(self._active.sum()),
+            "pending": self.num_pending,
+            "max_batch": self.max_batch,
+            "kv": {
+                "free_blocks": free,
+                "total_blocks": total,
+                "headroom": round(free / max(total, 1), 4),
+                "pool_bytes": int(self.kv_pool_bytes),
+                "dtype": self.kv_cache_dtype or "full",
+            },
+            "compiled_programs": len(self._jit_cache),
+            "epoch": self._epoch,
+            "recoveries": len(self.recovery_stats),
+            "cancelled": self.cancelled,
+            "driver_alive": bool(self._driver is not None
+                                 and self._driver.is_alive()),
+        }
+        if self.recovery_stats:
+            doc["last_recovery"] = dict(self.recovery_stats[-1])
+        opened = self.step_open_since
+        if opened is not None:
+            doc["step_open_s"] = round(time.monotonic() - opened, 4)
+        if self._drafter is not None:
+            doc["spec"] = {
+                "drafted": self.spec_drafted,
+                "accepted": self.spec_accepted,
+                "accept_rate": round(
+                    self.spec_accepted / max(self.spec_drafted, 1), 4),
+            }
+        if self.prefix_cache is not None:
+            doc["kv"]["prefix_cache_blocks"] = len(self.prefix_cache)
+        return doc
 
     # -- preemption + restore (host-RAM KV spill under pool pressure) --------
     def _preempt_lowest(self, exclude=()):

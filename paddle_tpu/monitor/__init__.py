@@ -42,6 +42,7 @@ __all__ = [
     "counter", "gauge", "histogram", "registry",
     "snapshot", "prometheus_text", "sample", "chrome_counter_events",
     "provenance", "validate_provenance", "trace",
+    "server", "slo", "timeline",
 ]
 
 
@@ -172,3 +173,10 @@ def provenance():
 def validate_provenance(prov, now=None):
     """List of problems with a provenance block ([] = trustworthy)."""
     return _provenance_mod.validate(prov, now=now)
+
+
+# graftscope (ISSUE 15): the introspection plane above this module —
+# imported LAST so their lazy back-references into the (by now fully
+# initialized) monitor package resolve; all three are stdlib-only at
+# import time and hold no thread/socket until explicitly started.
+from . import server, slo, timeline  # noqa: E402,F401

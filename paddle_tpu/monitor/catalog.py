@@ -198,6 +198,24 @@ METRICS = {
         "Graceful drains completed: admission stopped, queued work "
         "migrated to peers, in-flight work finished, replica parked "
         "with zero lost requests."),
+    "paddle_tpu_fleet_replica_inflight": (
+        "gauge", ("replica",),
+        "Fleet-routed requests in flight per replica (the routing "
+        "signal), emitted in the FleetRouter's replica-labeled "
+        "/metricsz document (host counters — present with the monitor "
+        "off too)."),
+    "paddle_tpu_fleet_replica_active": (
+        "gauge", ("replica",),
+        "Active engine slots per replica (the fleet /metricsz "
+        "aggregation document)."),
+    "paddle_tpu_fleet_replica_pending": (
+        "gauge", ("replica",),
+        "Queued (submitted, not yet admitted) engine requests per "
+        "replica (the fleet /metricsz aggregation document)."),
+    "paddle_tpu_fleet_replica_steps_total": (
+        "counter", ("replica",),
+        "Engine steps driven per replica since fleet construction "
+        "(the fleet /metricsz aggregation document)."),
     # -- paged KV allocator (models/paged_kv.py) -------------------------
     "paddle_tpu_kv_free_blocks": (
         "gauge", (),
@@ -295,6 +313,22 @@ METRICS = {
         "Fault-injection trips (analysis/faultinject.py, "
         "PADDLE_TPU_FAULTS=...), labeled by injection point — a chaos "
         "run's telemetry shows where the drill hit."),
+    "paddle_tpu_monitor_scrapes_total": (
+        "counter", ("endpoint",),
+        "Requests handled by the graftscope debug endpoint "
+        "(monitor/server.py), labeled by endpoint path — the scrape "
+        "plane's own traffic accounting."),
+    "paddle_tpu_monitor_slo_alerts_total": (
+        "counter", ("objective",),
+        "SLO burn-rate alert EDGES (monitor/slo.py): fast AND slow "
+        "windows burning past the threshold, labeled by "
+        "objective[/tenant] series. Observational only — alerts never "
+        "drive routing."),
+    "paddle_tpu_monitor_slo_burn_rate": (
+        "gauge", ("objective", "window"),
+        "Current burn rate (bad fraction / error budget) per SLO "
+        "series and window (fast | slow), refreshed by every "
+        "SLOTracker.scan()."),
 }
 
 
@@ -462,6 +496,16 @@ SPANS = {
         "One fault-injection trip (analysis/faultinject.py), recorded "
         "at fire time so a chaos run's trace shows where the drill hit. "
         "attrs: point."),
+    "monitor.scrape": (
+        "One request handled by the graftscope debug endpoint "
+        "(monitor/server.py) — the scrape plane's own footprint on the "
+        "timeline, so scrape-vs-serve interference is visible in the "
+        "same trace it observes. attrs: endpoint, status."),
+    "monitor.slo_alert": (
+        "One SLO burn-rate alert EDGE (monitor/slo.py): the instant "
+        "both windows crossed the threshold, so the alert lands on the "
+        "request timeline it indicts. attrs: objective, fast_burn, "
+        "slow_burn."),
 }
 
 

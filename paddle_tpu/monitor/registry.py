@@ -114,6 +114,23 @@ class _Metric:
                 return sorted(self._children.items())
         return [((), self)]
 
+    def remove(self, *values, **kv):
+        """Drop one label-value combination's child series (no-op when
+        absent). The escape hatch for caller-supplied label values
+        (e.g. per-tenant SLO series): a family whose children are never
+        removed grows the registry — and every later exposition — with
+        the label-value history of the whole process lifetime."""
+        if not self.labelnames:
+            raise ValueError(f"{self.name} is not a labeled metric")
+        if kv:
+            if values:
+                raise ValueError("pass label values positionally or by "
+                                 "keyword, not both")
+            values = tuple(kv[ln] for ln in self.labelnames)
+        values = tuple(str(v) for v in values)
+        with self._lock:
+            self._children.pop(values, None)
+
     def _require_series(self):
         if self.labelnames:
             raise ValueError(

@@ -1,0 +1,550 @@
+"""Span-timeline perf analytics: graftscope's analysis wing.
+
+The trace layer (PR 3) records WHAT happened — spans with explicit
+parent/trace links in a bounded ring; this module answers the derived
+perf questions ROADMAP items 1/2 keep asking of that record:
+
+- **per-train-step phase breakdown** — how one ``train.step`` window
+  splits across dataload / forward / backward / optimizer child stages
+  plus the ``comm.*`` spans that landed inside it;
+- **bubble fraction** — the idle gap per step: time inside a step window
+  covered by NO child stage and no comm span (the pipeline-parallelism
+  primitive ROADMAP item 1's bench needs);
+- **comm-overlap fraction** — ``comm.*`` span time overlapped with
+  compute spans: ``|union(comm) ∩ union(compute)| / |union(comm)|``
+  (the verification instrument for the PR 13 backward-overlapped
+  bucketed collectives);
+- **serving TTFT decomposition** — from the PR 3 request trees: one
+  ``serving.request`` root per request with ``serving.queue_wait`` /
+  ``serving.prefill`` children, so TTFT splits into queue wait +
+  chunked prefill + the (small) scheduling gap, components summing to
+  the measured TTFT by construction;
+- **MFU** — tokens x flops-per-token vs wall against a peak-FLOP/s
+  denominator (the bench.py formula, importable instead of copied).
+
+Everything here is pure computation over span DICTS (``Span.to_dict()``
+shape, or ``span_dump()`` output) — no jax, no framework import, no
+clock reads, so analytics over a flight dump work offline in any
+process. :func:`perf_report` assembles every section the live ring can
+support and backs the debug server's ``/perfz`` endpoint
+(``monitor/server.py``; docs/introspection.md has the exact formulas).
+
+The **modeled schedule** half (:func:`modeled_step_timeline`) bridges
+the one place wall-clock spans cannot see: a single fused XLA program
+dispatches as ONE host span, so the comm/compute overlap INSIDE the
+mesh train step is invisible to the ring. The model walks the traced
+jaxpr (duck-typed eqns, same discipline as
+``analysis/jaxpr/collectives.py``) under a two-stream schedule —
+compute eqns execute sequentially in program order on the compute
+stream; collective eqns execute in program order on ONE in-order comm
+stream, each starting as soon as its operands are ready (start = max of
+data-ready and the comm stream becoming free — collective-start hoisted
+up to the data dependence) and stalling compute only at the first
+consumer. That is what makes the PR 13 bucketed build measurable: the
+legacy exchange iterates params in FORWARD order, so its first
+collective waits on the LAST-completing gradient and convoys every
+later one behind it on the in-order stream, while completion-ordered
+buckets drain as the backward produces them and overlap the remaining
+backward compute. The synthetic spans it returns (``compute`` busy
+intervals + ``comm.<collective>`` intervals) feed the SAME
+:func:`comm_overlap` formula as real spans.
+"""
+from __future__ import annotations
+
+import statistics
+
+__all__ = [
+    "comm_overlap", "step_phases", "bubble_fraction",
+    "ttft_decomposition", "mfu", "transformer_flops_per_token",
+    "perf_report", "modeled_step_timeline", "modeled_overlap_report",
+    "COMPUTE_SPAN_NAMES", "TRAIN_STAGES",
+]
+
+# wall-clock span names that count as device/compute work for the
+# overlap formula (the modeled schedule adds its own "compute" spans)
+COMPUTE_SPAN_NAMES = frozenset({
+    "train.forward", "train.backward", "train.optimizer", "compute",
+})
+
+TRAIN_STAGES = ("dataload", "forward", "backward", "optimizer")
+
+
+# -- span plumbing -----------------------------------------------------------
+
+def _as_dict(sp):
+    if isinstance(sp, dict):
+        return sp
+    return sp.to_dict()
+
+
+def _closed(spans):
+    """Completed spans as dicts (open spans have no t1 and are skipped)."""
+    out = []
+    for sp in spans:
+        d = _as_dict(sp)
+        if d.get("t1_ns") is not None:
+            out.append(d)
+    return out
+
+
+def _union(intervals):
+    """Merge [t0, t1) intervals into a sorted disjoint list."""
+    ivs = sorted((t0, t1) for t0, t1 in intervals if t1 > t0)
+    out = []
+    for t0, t1 in ivs:
+        if out and t0 <= out[-1][1]:
+            if t1 > out[-1][1]:
+                out[-1] = (out[-1][0], t1)
+        else:
+            out.append((t0, t1))
+    return out
+
+
+def _total(union_ivs):
+    return sum(t1 - t0 for t0, t1 in union_ivs)
+
+
+def _intersect(a, b):
+    """Total overlap length of two DISJOINT-SORTED interval lists."""
+    i = j = 0
+    total = 0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if hi > lo:
+            total += hi - lo
+        if a[i][1] <= b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+def _clip(ivs, t0, t1):
+    return [(max(a, t0), min(b, t1)) for a, b in ivs
+            if min(b, t1) > max(a, t0)]
+
+
+# -- comm/compute overlap ----------------------------------------------------
+
+def comm_overlap(spans, comm_prefix="comm.",
+                 compute_names=COMPUTE_SPAN_NAMES):
+    """The comm-overlap fraction of a span set.
+
+    Formula (docs/introspection.md): with ``C = union of [t0, t1) over
+    spans named comm.*`` and ``X = union over compute spans``,
+
+        overlap_fraction = |C ∩ X| / |C|
+
+    Both unions merge their own overlaps first, so concurrent comm spans
+    never double-count. Returns zeros (fraction 0.0) when no comm span
+    completed.
+    """
+    closed = _closed(spans)
+    comm = _union((d["t0_ns"], d["t1_ns"]) for d in closed
+                  if d["name"].startswith(comm_prefix))
+    compute = _union((d["t0_ns"], d["t1_ns"]) for d in closed
+                     if d["name"] in compute_names)
+    comm_ns = _total(comm)
+    overlapped = _intersect(comm, compute)
+    return {
+        "comm_ns": comm_ns,
+        "compute_ns": _total(compute),
+        "overlapped_ns": overlapped,
+        "overlap_fraction": overlapped / comm_ns if comm_ns else 0.0,
+    }
+
+
+# -- train-step phase breakdown + bubble -------------------------------------
+
+def _children_of(closed, root):
+    return [d for d in closed if d.get("parent_id") == root["span_id"]]
+
+
+def _comm_in_window(closed, t0, t1):
+    return [(d["t0_ns"], d["t1_ns"]) for d in closed
+            if d["name"].startswith("comm.")
+            and min(d["t1_ns"], t1) > max(d["t0_ns"], t0)]
+
+
+def step_phases(spans, root="train.step"):
+    """Per-step phase breakdown over every completed ``root`` span.
+
+    Child-stage time is summed by name (``train.forward`` -> "forward");
+    ``comm.*`` spans are attributed by WINDOW overlap (clipped to the
+    step) because collective spans are recorded unparented. Returns
+    ``{"steps", "rows": [per-step dicts], "mean_ns": {stage: mean}}``.
+    """
+    closed = _closed(spans)
+    rows = []
+    for rd in closed:
+        if rd["name"] != root:
+            continue
+        t0, t1 = rd["t0_ns"], rd["t1_ns"]
+        phases = {}
+        for ch in _children_of(closed, rd):
+            stage = ch["name"].split(".", 1)[-1]
+            phases[stage] = phases.get(stage, 0) \
+                + (ch["t1_ns"] - ch["t0_ns"])
+        comm = _union(_clip(_comm_in_window(closed, t0, t1), t0, t1))
+        if comm:
+            phases["comm"] = _total(comm)
+        row = {"step_ns": t1 - t0, "phases": phases}
+        if rd.get("attrs"):
+            row["step"] = rd["attrs"].get("step")
+        rows.append(row)
+    stages = sorted({k for r in rows for k in r["phases"]})
+    mean_ns = {
+        s: statistics.fmean([r["phases"].get(s, 0) for r in rows])
+        for s in stages
+    } if rows else {}
+    return {"steps": len(rows), "rows": rows, "mean_ns": mean_ns}
+
+
+def bubble_fraction(spans, root="train.step"):
+    """The idle-gap ("bubble") fraction of every completed ``root``
+    span: step time covered by NO direct child span and no ``comm.*``
+    span clipped into the window, over total step time —
+
+        bubble_fraction = sum(step_ns - |union(children ∪ comm)|)
+                          / sum(step_ns)
+
+    The pipeline-parallelism primitive: a microbatch schedule's bubble
+    is exactly the per-step time no stage span covers.
+    """
+    closed = _closed(spans)
+    busy_ns = step_ns = 0
+    steps = 0
+    for rd in closed:
+        if rd["name"] != root:
+            continue
+        t0, t1 = rd["t0_ns"], rd["t1_ns"]
+        ivs = [(c["t0_ns"], c["t1_ns"]) for c in _children_of(closed, rd)]
+        ivs += _comm_in_window(closed, t0, t1)
+        busy = _total(_union(_clip(ivs, t0, t1)))
+        busy_ns += busy
+        step_ns += t1 - t0
+        steps += 1
+    return {
+        "steps": steps,
+        "step_ns": step_ns,
+        "busy_ns": busy_ns,
+        "bubble_ns": step_ns - busy_ns,
+        "bubble_fraction": (step_ns - busy_ns) / step_ns if step_ns
+        else 0.0,
+    }
+
+
+# -- serving TTFT decomposition ----------------------------------------------
+
+def ttft_decomposition(spans):
+    """Per-request TTFT decomposition from the PR 3 request trees.
+
+    For every ``serving.request`` root whose ``serving.prefill`` child
+    completed (the prefill span's end IS the first-token time):
+
+        ttft       = prefill.t1 - root.t0
+        queue_wait = the serving.queue_wait child's duration (0 for the
+                     add_request path, which has no queue)
+        prefill    = the serving.prefill child's duration
+        gap        = ttft - queue_wait - prefill
+
+    so the three components sum to the measured TTFT exactly; ``gap`` is
+    the submit->enqueue plus admit-bookkeeping slack (small by
+    construction: queue_wait ends and prefill starts on the SAME
+    admission timestamp). ``decode_ns`` (total serving.decode_step time
+    after the first token) is reported alongside but is not a TTFT
+    component. Returns per-request rows plus p50 medians in ms.
+    """
+    closed = _closed(spans)
+    by_trace = {}
+    for d in closed:
+        by_trace.setdefault(d["trace_id"], []).append(d)
+    rows = []
+    for tid, group in sorted(by_trace.items()):
+        root = next((d for d in group if d["name"] == "serving.request"),
+                    None)
+        if root is None:
+            continue
+        prefill = next((d for d in group
+                        if d["name"] == "serving.prefill"), None)
+        if prefill is None:
+            continue
+        qw = next((d for d in group
+                   if d["name"] == "serving.queue_wait"), None)
+        ttft = prefill["t1_ns"] - root["t0_ns"]
+        queue_wait = (qw["t1_ns"] - qw["t0_ns"]) if qw else 0
+        prefill_ns = prefill["t1_ns"] - prefill["t0_ns"]
+        rows.append({
+            "trace_id": tid,
+            "rid": (root.get("attrs") or {}).get("rid"),
+            "ttft_ns": ttft,
+            "queue_wait_ns": queue_wait,
+            "prefill_ns": prefill_ns,
+            "gap_ns": ttft - queue_wait - prefill_ns,
+            "decode_ns": sum(d["t1_ns"] - d["t0_ns"] for d in group
+                             if d["name"] == "serving.decode_step"),
+            "prefill_chunks": sum(1 for d in group
+                                  if d["name"] == "serving.prefill_chunk"),
+        })
+    p50 = {}
+    if rows:
+        for k in ("ttft_ns", "queue_wait_ns", "prefill_ns", "gap_ns",
+                  "decode_ns"):
+            p50[k[:-3] + "_ms"] = round(
+                statistics.median(r[k] for r in rows) / 1e6, 4)
+    return {"requests": len(rows), "rows": rows, "p50_ms": p50}
+
+
+# -- MFU ---------------------------------------------------------------------
+
+def transformer_flops_per_token(n_params, num_layers=0, hidden=0, seq=0):
+    """The decoder-transformer train-step FLOPs/token formula bench.py
+    stamps MFU with: ``6 * n_params`` (fwd+bwd matmuls) plus the
+    attention term ``12 * L * H * seq``."""
+    return 6 * int(n_params) + 12 * int(num_layers) * int(hidden) \
+        * int(seq)
+
+
+def mfu(tokens, wall_s, flops_per_token, peak_flops):
+    """Model-FLOPs utilization: ``tokens * flops_per_token / (wall_s *
+    peak_flops)`` — the fraction of the chip's peak matmul throughput
+    the measured pass sustained."""
+    if wall_s <= 0 or peak_flops <= 0:
+        return 0.0
+    return tokens * flops_per_token / (wall_s * peak_flops)
+
+
+# -- the assembled report (/perfz) -------------------------------------------
+
+def perf_report(spans=None):
+    """Every analytics section the given span set (default: the live
+    trace ring's completed spans) supports — the document behind the
+    debug server's ``/perfz``. Sections are present only when their
+    spans are: ``train`` (phase breakdown + bubble + comm overlap) when
+    a ``train.step`` completed, ``serving`` (TTFT decomposition) when a
+    request tree did."""
+    from .provenance import provenance as _provenance
+    if spans is None:
+        from . import trace as _trace
+
+        spans = _trace.spans()
+    closed = _closed(spans)
+    doc = {
+        "provenance": _provenance(),
+        "clock": "perf_counter_ns",
+        "span_count": len(closed),
+    }
+    names = {d["name"] for d in closed}
+    if "train.step" in names:
+        doc["train"] = {
+            "phases": step_phases(closed),
+            "bubble": bubble_fraction(closed),
+            "comm_overlap": comm_overlap(closed),
+        }
+    elif any(n.startswith("comm.") for n in names):
+        doc["comm_overlap"] = comm_overlap(closed)
+    if "serving.request" in names:
+        doc["serving"] = {"ttft": ttft_decomposition(closed)}
+    return doc
+
+
+# -- the modeled two-stream schedule over a traced program -------------------
+
+# jaxpr-level collective spellings (analysis/jaxpr/collectives.py is the
+# one home; imported lazily so this module stays framework-free at
+# import time for offline dump analysis)
+def _collectives_mod():
+    from ..analysis.jaxpr import collectives as c
+
+    return c
+
+
+def _aval_elems(aval):
+    shape = getattr(aval, "shape", None)
+    if shape is None:
+        return 0
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+# pure layout/metadata primitives: XLA fuses these into their consumers
+# (or elides them entirely), so the model treats them as FREE
+# pass-throughs — zero compute time, output ready = input ready. This is
+# what lets a collective's readiness reflect its GRADIENT's completion
+# time instead of the position of its reshape/pad wrapper in the traced
+# program (the whole exchange section is traced after the backward).
+_FREE_PRIMITIVES = frozenset({
+    "reshape", "transpose", "squeeze", "expand_dims", "broadcast_in_dim",
+    "pad", "concatenate", "slice", "dynamic_slice", "rev",
+    "convert_element_type", "bitcast_convert_type", "copy",
+    "stop_gradient", "sharding_constraint",
+})
+
+
+def _eqn_flops(eqn):
+    """Modeled compute cost of one non-collective eqn: dot_general pays
+    ``2 * out_elems * contracted_size``; everything else one flop per
+    output element (a relative cost model — only the schedule's shape
+    matters, not absolute time)."""
+    out_elems = sum(_aval_elems(getattr(v, "aval", None))
+                    for v in eqn.outvars)
+    if eqn.primitive.name == "dot_general":
+        try:
+            (lhs_c, _rhs_c), _batch = eqn.params["dimension_numbers"]
+            lhs_shape = eqn.invars[0].aval.shape
+            k = 1
+            for d in lhs_c:
+                k *= int(lhs_shape[d])
+            first_out = _aval_elems(eqn.outvars[0].aval)
+            return 2 * first_out * max(k, 1)
+        except Exception:  # noqa: BLE001 - fall through to the default
+            pass
+    return max(out_elems, 1)
+
+
+class _Sched:
+    __slots__ = ("compute_t", "comm_free", "busy", "comm_spans",
+                 "stall_ns", "flop_ns", "byte_ns")
+
+    def __init__(self, flops_per_s, bytes_per_s):
+        self.compute_t = 0.0
+        self.comm_free = 0.0
+        self.busy = []          # compute (t0, t1) intervals
+        self.comm_spans = []    # (canonical collective, t0, t1, bytes)
+        self.stall_ns = 0.0
+        self.flop_ns = 1e9 / float(flops_per_s)
+        self.byte_ns = 1e9 / float(bytes_per_s)
+
+
+def _is_literal(v):
+    return hasattr(v, "val") and not hasattr(v, "count")
+
+
+def _ready(env, v):
+    if _is_literal(v):
+        return 0.0
+    return env.get(v, 0.0)
+
+
+def _walk_schedule(jaxpr, env, st):
+    coll = _collectives_mod()
+    for eqn in jaxpr.eqns:
+        canon = coll.COLLECTIVE_PRIMITIVES.get(eqn.primitive.name)
+        t_ready = max([_ready(env, v) for v in eqn.invars], default=0.0)
+        if canon is not None:
+            # async collective on ONE in-order comm stream: collectives
+            # execute in program order, but each may START as soon as
+            # its operands are ready (collective-start hoisted up to the
+            # data dependence) — so a program whose FIRST exchange waits
+            # on the LAST-completing gradient convoys every later one
+            # behind it, while completion-ordered buckets drain as the
+            # backward produces them. Compute stalls only at consumers.
+            nbytes = max(
+                sum(coll._aval_bytes(getattr(v, "aval", None))
+                    for v in eqn.invars),
+                sum(coll._aval_bytes(getattr(v, "aval", None))
+                    for v in eqn.outvars))
+            issue = max(t_ready, st.comm_free)
+            done = issue + nbytes * st.byte_ns
+            st.comm_free = done
+            st.comm_spans.append((canon, issue, done, nbytes))
+            for v in eqn.outvars:
+                env[v] = done
+            continue
+        subs = list(coll.iter_subjaxprs(eqn))
+        if subs:
+            # inline every sub-jaxpr (cond branches both count —
+            # conservative; scan/while bodies count once per trace, the
+            # same caveat as the byte census). Bind invars/outvars
+            # tail-aligned so cond's leading predicate drops out.
+            for _slot, sub in subs:
+                for cv in getattr(sub, "constvars", ()):
+                    env.setdefault(cv, 0.0)
+                n = min(len(eqn.invars), len(sub.invars))
+                if n:
+                    for outer, inner in zip(eqn.invars[-n:],
+                                            sub.invars[-n:]):
+                        env[inner] = _ready(env, outer)
+                _walk_schedule(sub, env, st)
+                m = min(len(eqn.outvars), len(sub.outvars))
+                if m:
+                    for outer, inner in zip(eqn.outvars[-m:],
+                                            sub.outvars[-m:]):
+                        env[outer] = _ready(env, inner)
+            for v in eqn.outvars:
+                env.setdefault(v, st.compute_t)
+            continue
+        if eqn.primitive.name in _FREE_PRIMITIVES:
+            # fused-away layout op: free, and a pure dependence
+            # pass-through (does not occupy or wait for the compute
+            # stream)
+            for v in eqn.outvars:
+                env[v] = t_ready
+            continue
+        start = max(st.compute_t, t_ready)
+        if start > st.compute_t:
+            st.stall_ns += start - st.compute_t
+        end = start + _eqn_flops(eqn) * st.flop_ns
+        if end > start:
+            st.busy.append((start, end))
+        st.compute_t = end
+        for v in eqn.outvars:
+            env[v] = end
+
+
+def modeled_step_timeline(jaxpr, *, flops_per_s=1e12, bytes_per_s=1e11):
+    """Synthetic span set for one traced program under the two-stream
+    schedule (module docstring): ``compute`` spans for the merged
+    compute-busy intervals and one ``comm.<collective>`` span per
+    collective eqn. Deterministic in the program alone; feed the result
+    to :func:`comm_overlap` / :func:`modeled_overlap_report`."""
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)    # ClosedJaxpr -> Jaxpr
+    st = _Sched(flops_per_s, bytes_per_s)
+    env = {}
+    for v in list(getattr(jaxpr, "constvars", ())) \
+            + list(getattr(jaxpr, "invars", ())):
+        env[v] = 0.0
+    _walk_schedule(jaxpr, env, st)
+    spans = []
+    sid = 1
+    for t0, t1 in _union(st.busy):
+        spans.append({"name": "compute", "span_id": sid, "trace_id": 0,
+                      "parent_id": None, "t0_ns": int(round(t0)),
+                      "t1_ns": int(round(t1))})
+        sid += 1
+    for canon, t0, t1, nbytes in st.comm_spans:
+        spans.append({"name": f"comm.{canon}", "span_id": sid,
+                      "trace_id": 0, "parent_id": None,
+                      "t0_ns": int(round(t0)), "t1_ns": int(round(t1)),
+                      "attrs": {"bytes": int(nbytes)}})
+        sid += 1
+    spans.sort(key=lambda d: d["t0_ns"])
+    return spans, {"stall_ns": int(round(st.stall_ns)),
+                   "makespan_ns": int(round(max(st.compute_t,
+                                                st.comm_free)))}
+
+
+def modeled_overlap_report(jaxpr, *, flops_per_s=1e12, bytes_per_s=1e11):
+    """The modeled comm-overlap report of one traced step program:
+    :func:`comm_overlap` over the modeled span set, plus the compute
+    stall (time the compute stream waited on a collective's result) and
+    the modeled makespan. The one number ROADMAP item 2 left
+    unmeasured: the PR 13 bucketed-overlap build reports a strictly
+    higher ``overlap_fraction`` than the legacy tape-end exchange of
+    the same model (mesh_bench's ``timeline`` rows)."""
+    spans, extra = modeled_step_timeline(
+        jaxpr, flops_per_s=flops_per_s, bytes_per_s=bytes_per_s)
+    rep = comm_overlap(spans, compute_names=frozenset({"compute"}))
+    makespan = max((d["t1_ns"] for d in spans), default=0)
+    rep.update({
+        "collectives": sum(1 for d in spans
+                           if d["name"].startswith("comm.")),
+        "comm_stall_ns": extra["stall_ns"],
+        "makespan_ns": makespan,
+        "comm_stall_fraction": (extra["stall_ns"] / makespan)
+        if makespan else 0.0,
+    })
+    return rep

@@ -172,7 +172,8 @@ class _Replica:
 
     __slots__ = ("idx", "tag", "engine", "state", "suspect_reason",
                  "heartbeat", "failures", "backoff_until", "inflight",
-                 "rid2att", "unclaimed", "cancelled_rids",
+                 "rid2att", "unclaimed", "unclaimed_aborts",
+                 "cancelled_rids",
                  "_cancel_order", "thread", "dog", "fail_lock", "steps")
 
     def __init__(self, idx, engine):
@@ -191,6 +192,12 @@ class _Replica:
         # call returns); bounded — an unclaimed result is a bug, not a
         # leak vector
         self.unclaimed = collections.deque(maxlen=1024)
+        # the ABORT-side twin: (rid, tokens, stats) of aborts/
+        # withdrawals that raced the same mapping gap — a failover or
+        # drain landing in the instant between engine.submit()
+        # returning and rid2att recording must re-seed, not strand the
+        # caller (claimed back in _submit_attempt)
+        self.unclaimed_aborts = collections.deque(maxlen=1024)
         # BOUNDED recently-cancelled record: a successfully cancelled
         # request never emits a result (nothing would ever discard its
         # entry), so insertion order evicts the oldest past the bound
@@ -241,7 +248,7 @@ class FleetRouter:
                  hedge_after_s=None, max_hedges=2,
                  suspect_after_s=1.0, backoff_base_s=0.05,
                  backoff_cap_s=2.0, health_poll_s=0.02, poll_s=0.0005,
-                 start=True):
+                 slo=None, start=True):
         if engines is None:
             kw = dict(engine_kwargs or {})
             engines = [ContinuousBatchingEngine(model, **kw)
@@ -283,15 +290,39 @@ class FleetRouter:
         # bounded transition log: [(tag, old, new, reason)] — the health
         # state machine's test surface
         self.state_log = collections.deque(maxlen=1024)
+        # SLO burn-rate tracking (monitor/slo.py) — OBSERVATIONAL: the
+        # tracker's verdicts land in the status snapshot and the alert
+        # telemetry, never in a routing decision. slo=True builds the
+        # default serving objectives; pass an SLOTracker to configure.
+        if slo is True:
+            from ..monitor.slo import SLOTracker, serving_objectives
+
+            slo = SLOTracker(serving_objectives())
+        self._slo = slo or None
+        # graftscope: the fleet is ONE scrape target — a /statusz
+        # section (per-replica health/breaker state) and a /metricsz
+        # appendix (the replica-labeled series). Held via WeakMethod;
+        # start() re-registers so a stop()/start() cycle stays visible,
+        # stop() unregisters explicitly for deterministic teardown.
+        self._register_providers()
         self._stop = threading.Event()
         self._health_thread = None
         if start:
             self.start()
 
     # -- lifecycle -----------------------------------------------------------
+    def _register_providers(self):
+        from ..monitor import server as _obs
+
+        _obs.register_status_provider("fleet", self.status)
+        _obs.register_metrics_provider("fleet", self._metrics_appendix)
+
     def start(self):
         """Spawn one driver thread per replica plus the health monitor
-        (idempotent)."""
+        (idempotent). Re-registers the graftscope providers, so a
+        stop()/start() rolling cycle never leaves a serving fleet
+        invisible to /statusz//metricsz."""
+        self._register_providers()
         self._stop.clear()
         for rep in self._replicas:
             if rep.thread is None or not rep.thread.is_alive():
@@ -328,6 +359,10 @@ class FleetRouter:
                 and self._health_thread.is_alive():
             self._health_thread.join(timeout=timeout)
         self._health_thread = None
+        from ..monitor import server as _obs
+
+        _obs.unregister_status_provider("fleet", self.status)
+        _obs.unregister_metrics_provider("fleet", self._metrics_appendix)
 
     def _make_hang_handler(self, rep):
         def _on_hang(desc, dump):
@@ -359,9 +394,20 @@ class FleetRouter:
                            mon.mod.now_ns())
         att = _Attempt(fr, prefix=(), hedge=False)
         fr.primary = att
-        self._submit_attempt(att, timeout=timeout)
+        try:
+            self._submit_attempt(att, timeout=timeout)
+        except Exception:
+            # typed admission failures are SLO budget spend (shed/error
+            # rate) — recorded, then surfaced unchanged
+            self._slo_record("admission", good=False, tenant=tenant)
+            raise
+        self._slo_record("admission", good=True, tenant=tenant)
         with self._lock:
-            self._requests[frid] = fr
+            if not fr.done:
+                # a request the driver already finished (the claimed-
+                # result race) must not re-enter the ledger: nothing
+                # would ever remove it again
+                self._requests[frid] = fr
         self.requests_total += 1
         if mon.state.on:
             mon.requests.inc()
@@ -406,7 +452,8 @@ class FleetRouter:
             fr.primary = att
             self._submit_attempt(att, rep=rep)
             with self._lock:
-                self._requests[frid] = fr
+                if not fr.done:
+                    self._requests[frid] = fr
             frs.append(fr)
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline \
@@ -491,12 +538,18 @@ class FleetRouter:
         att.rep = chosen
         att.rid = rid
         claimed = None
+        claimed_abort = None
         with self._lock:
             chosen.rid2att[rid] = att
             for pair in list(chosen.unclaimed):
                 if pair[0] == rid:
                     chosen.unclaimed.remove(pair)
                     claimed = pair
+                    break
+            for entry in list(chosen.unclaimed_aborts):
+                if entry[0] == rid:
+                    chosen.unclaimed_aborts.remove(entry)
+                    claimed_abort = entry
                     break
         if mon.state.on:
             mon.routed.labels(chosen.tag).inc()
@@ -509,6 +562,14 @@ class FleetRouter:
             # the driver finished this rid before the mapping landed
             with self._lock:
                 self._complete_locked(chosen, claimed[0], claimed[1], mon)
+        elif claimed_abort is not None:
+            # a failover/drain withdrew this rid before the mapping
+            # landed: fold the abort in now that the mapping exists and
+            # re-seed — the caller must never be stranded by the race
+            with self._lock:
+                reroute = self._absorb_abort_locked(
+                    chosen, rid, claimed_abort[1], claimed_abort[2])
+            self._resubmit(reroute, mon)
         return chosen
 
     # -- replica driver loops ------------------------------------------------
@@ -608,10 +669,18 @@ class FleetRouter:
             fr.done = True
             fr.tokens = list(att.prefix)
             self._requests.pop(fr.frid, None)
-            self._merge_stats_locked(fr, None, False)
+            self._merge_stats_locked(fr, None, False, completed=False)
             self._results.append((fr.frid, fr.tokens))
 
-    def _merge_stats_locked(self, fr, st, hedged):
+    def _slo_record(self, objective, **kw):
+        """Record one SLO event if a tracker is wired and declares the
+        objective (a custom tracker without it must not turn routing
+        into a raise site)."""
+        slo = self._slo
+        if slo is not None and objective in slo.objectives:
+            slo.record(objective, **kw)
+
+    def _merge_stats_locked(self, fr, st, hedged, completed=True):
         final = {"frid": fr.frid, "tenant": fr.tenant,
                  "prompt_len": len(fr.prompt),
                  "failovers": fr.failovers, "hedged": hedged,
@@ -631,6 +700,11 @@ class FleetRouter:
         self._final_stats[fr.frid] = final
         while len(self._final_stats) > 4096:
             self._final_stats.popitem(last=False)
+        # SLO budget accounting: completion (a terminated partial is
+        # budget spend) + the per-tenant TTFT latency objective
+        self._slo_record("completion", good=completed, tenant=fr.tenant)
+        if ttft is not None:
+            self._slo_record("ttft", value=ttft, tenant=fr.tenant)
 
     # -- failover ------------------------------------------------------------
     def _fail_replica(self, rep, reason):
@@ -662,25 +736,7 @@ class FleetRouter:
                     reroute.extend(
                         self._absorb_abort_locked(rep, item["rid"],
                                                   item["outputs"], None))
-            rerouted = 0
-            for att in reroute:
-                att.fr.failovers += 1
-                self.failovers += 1
-                if mon.state.on:
-                    mon.failovers.inc()
-                try:
-                    self._submit_attempt(att)
-                    rerouted += 1
-                except FleetUnavailable:
-                    # total outage: park the work; the health monitor
-                    # re-routes it the moment a replica heals
-                    self._stranded.append(att)
-                except Exception:  # noqa: BLE001 - a request that can
-                    # never be re-placed (e.g. re-seeded prompt past the
-                    # survivor's limits) terminates with its partial
-                    # tokens rather than killing the failover pass or
-                    # hanging its caller forever
-                    self._terminate_attempt(att)
+            rerouted = self._resubmit(reroute, mon)
             if mon.tstate.on:
                 mon.trace.record_span(
                     "fleet.failover", t0, mon.mod.now_ns(),
@@ -690,12 +746,48 @@ class FleetRouter:
         finally:
             rep.fail_lock.release()
 
+    def _resubmit(self, reroute, mon):
+        """Re-place replacement attempts with the failover pass's
+        protection: a replacement lands on a peer, strands for the
+        health monitor (total outage), or terminates with its partial
+        tokens — withdrawn work is NEVER dropped and the caller never
+        hangs. Returns how many re-placed."""
+        rerouted = 0
+        for att in reroute:
+            att.fr.failovers += 1
+            self.failovers += 1
+            if mon.state.on:
+                mon.failovers.inc()
+            try:
+                self._submit_attempt(att)
+                rerouted += 1
+            except FleetUnavailable:
+                # total outage: park the work; the health monitor
+                # re-routes it the moment a replica heals
+                self._stranded.append(att)
+            except Exception:  # noqa: BLE001 - a request that can
+                # never be re-placed (e.g. re-seeded prompt past the
+                # survivor's limits) terminates with its partial
+                # tokens rather than killing the failover pass or
+                # hanging its caller forever
+                self._terminate_attempt(att)
+        return rerouted
+
     def _absorb_abort_locked(self, rep, rid, tokens, stats):
         """Fold one aborted/withdrawn engine request back into its fleet
         request; returns the replacement attempts to submit (empty when
         a live duplicate already covers the work)."""
         att = rep.rid2att.pop(rid, None)
         if att is None:
+            if rid in rep.cancelled_rids:
+                # a cancelled hedge the recovery aborted before the
+                # driving thread applied the cancel: nothing to re-seed
+                rep.cancelled_rids.discard(rid)
+                return []
+            # the mapping has not landed yet (the submit/failover race):
+            # park the abort for _submit_attempt to claim — dropping it
+            # would strand the caller and leak the reserved inflight
+            rep.unclaimed_aborts.append((rid, list(tokens), stats))
             return []
         rep.inflight -= 1
         fr = att.fr
@@ -708,9 +800,13 @@ class FleetRouter:
             fr.stats_base["chunks"] += stats.get("prefill_chunks", 0)
             fr.stats_base["shared_tokens"] += stats.get("shared_tokens",
                                                         0)
-        if att is fr.hedge:
+        if att.hedge:
             # the duplicate died; the primary still covers the request
-            fr.hedge = None
+            # (att.hedge, not identity with fr.hedge: a hedge aborted in
+            # the instant before _maybe_hedge records it must not be
+            # re-seeded as the PRIMARY)
+            if fr.hedge is att:
+                fr.hedge = None
             return []
         if fr.hedge is not None:
             # the primary died but a live hedge covers the request:
@@ -793,6 +889,12 @@ class FleetRouter:
                     self._terminate_attempt(att)
         if self.hedge_after_s is not None:
             self._maybe_hedge(mon, now)
+        if self._slo is not None:
+            # observational: the scan fires alert telemetry and burn
+            # gauges; its verdicts NEVER feed a routing decision.
+            # Rate-limited: the health loop ticks ~50x/s, burn-rate
+            # alerting needs ~1 Hz — no bucket walk on most ticks
+            self._slo.scan(min_interval_s=1.0)
 
     def _maybe_hedge(self, mon, now):
         """Tail hedging: requests past the latency SLO get a bounded
@@ -932,6 +1034,106 @@ class FleetRouter:
         """{replica tag: health state} snapshot."""
         with self._lock:
             return {rep.tag: rep.state for rep in self._replicas}
+
+    def replica_snapshot(self):
+        """One row per replica: health/breaker state plus the engine's
+        host counters — the substance of the fleet's /statusz section
+        and the replica-labeled /metricsz series."""
+        now = time.monotonic()
+        with self._lock:
+            rows = [{
+                "replica": rep.tag,
+                "state": rep.state,
+                "failures": rep.failures,
+                "backoff_remaining_s": round(
+                    max(0.0, rep.backoff_until - now), 4)
+                if rep.state == DOWN else 0.0,
+                "suspect_reason": rep.suspect_reason,
+                "inflight": rep.inflight,
+                "steps": rep.steps,
+                "heartbeat_age_s": round(now - rep.heartbeat, 4),
+                "thread_alive": bool(rep.thread is not None
+                                     and rep.thread.is_alive()),
+            } for rep in self._replicas]
+        for row, rep in zip(rows, self._replicas):
+            # engine host counters, read OUTSIDE the router lock (no
+            # engine call ever runs under it)
+            row["active"] = rep.engine.num_active
+            row["pending"] = rep.engine.num_pending
+        return rows
+
+    def status(self):
+        """The fleet's graftscope /statusz section: per-replica
+        health/breaker rows, each engine's own status, the router's
+        host counters and (when wired) the SLO burn snapshot."""
+        rows = self.replica_snapshot()
+        admissible = sum(1 for r in rows
+                         if r["state"] in (HEALTHY, SUSPECT))
+        doc = {
+            "health": "ok" if admissible else "degraded",
+            "replicas": rows,
+            "engines": {rep.tag: rep.engine.status()
+                        for rep in self._replicas},
+            "requests_total": self.requests_total,
+            "inflight": self.num_inflight,
+            "stranded": self.num_stranded,
+            "failovers": self.failovers,
+            "hedges": self.hedges,
+            "hedge_wins": self.hedge_wins,
+            "drains": self.drains,
+            "hedge_after_s": self.hedge_after_s,
+            "max_hedges": self.max_hedges,
+        }
+        if self._slo is not None:
+            doc["slo"] = self._slo.statusz()
+        return doc
+
+    # the /metricsz appendix series: (catalog name, kind, snapshot key)
+    _METRIC_ROWS = (
+        ("paddle_tpu_fleet_replica_inflight", "gauge", "inflight"),
+        ("paddle_tpu_fleet_replica_active", "gauge", "active"),
+        ("paddle_tpu_fleet_replica_pending", "gauge", "pending"),
+        ("paddle_tpu_fleet_replica_steps_total", "counter", "steps"),
+    )
+
+    def _metrics_appendix(self):
+        """The replica-labeled series the process registry does not
+        carry (host counters — present with the monitor off too),
+        appended to /metricsz by the debug server."""
+        from ..monitor import catalog as _catalog
+
+        rows = self.replica_snapshot()
+        lines = []
+        for name, kind, key in self._METRIC_ROWS:
+            spec = _catalog.spec(name)
+            if spec is not None and spec[2]:
+                lines.append(f"# HELP {name} {spec[2]}")
+            lines.append(f"# TYPE {name} {kind}")
+            for r in rows:
+                lines.append(
+                    f'{name}{{replica="{r["replica"]}"}} {r[key]}')
+        return "\n".join(lines) + "\n"
+
+    def fleet_prometheus_text(self):
+        """ONE replica-labeled Prometheus document for the whole fleet:
+        the process registry's exposition (every engine records into it)
+        plus the per-replica appendix — what a 3-replica fleet serves
+        from /metricsz as a single scrape target."""
+        from .. import monitor as _m
+
+        text = _m.prometheus_text()
+        if not text.endswith("\n"):
+            text += "\n"
+        return text + self._metrics_appendix()
+
+    def fleet_snapshot(self):
+        """The JSON twin of :meth:`fleet_prometheus_text`: the monitor
+        snapshot (provenance included) plus the fleet status section."""
+        from .. import monitor as _m
+
+        doc = _m.snapshot()
+        doc["fleet"] = self.status()
+        return doc
 
     @property
     def replicas(self):

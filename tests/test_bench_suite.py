@@ -190,6 +190,18 @@ class TestMeshSmoke:
         assert "dp8_zero1_int8" in d["collective_bytes"]
         assert d["collective_bytes"]["dp8_zero1_int8"][
             "all_to_all"]["bytes"] == c["grad_bytes_compressed"]
+        # ISSUE 15 acceptance: the graftscope modeled timeline finally
+        # MEASURES the PR 13 overlap claim — the completion-ordered
+        # bucketed build strictly above the legacy tape-end exchange
+        # (deterministic: the model depends only on the traced programs)
+        t = d["timeline"]
+        assert t["overlap_strictly_higher"] is True
+        assert t["overlapped"]["overlap_fraction"] \
+            > t["non_overlapped"]["overlap_fraction"]
+        assert 0.0 <= t["non_overlapped"]["overlap_fraction"] <= 1.0
+        assert 0.0 <= t["overlapped"]["overlap_fraction"] <= 1.0
+        assert t["overlapped"]["collectives"] \
+            < t["non_overlapped"]["collectives"]
 
 
 class TestTrainChaosSmoke:
@@ -302,6 +314,41 @@ class TestFleetSmoke:
         assert dd["parked"] is True
         assert dd["tokens_match_reference"] is True
         assert dd["states"][dd["drained_replica"]] == "parked"
+
+
+class TestObsSmoke:
+    # fast tier on purpose: `bench_suite.py --smoke obs` is the
+    # ISSUE 15 graftscope drill — the serving smoke workload under a
+    # 10 Hz scraper polling the live debug endpoint
+    def test_smoke_obs_meets_acceptance(self):
+        # the <=3% overhead bound is a wall-clock ratio on a shared
+        # CPU: the single contention-aware gate in tests/_retry.py
+        # (retry budget + floor relax together under measured
+        # oversubscription); every other gate in run_obs is
+        # deterministic and asserted in-worker
+        floor = wall_clock_floor(0.97, 0.80)
+        row = retry_smoke(
+            lambda: _run_smoke("obs", 400),
+            lambda r: r["detail"]["overhead_ratio"] >= floor)
+        assert row["config"] == "obs"
+        assert row["unit"] == "scraped_vs_unscraped_ratio"
+        d = row["detail"]
+        # ISSUE 15 acceptance: a 10 Hz scraper costs <= 3% tokens/s
+        # (contention-relaxed floor on oversubscribed runners) ...
+        assert d["overhead_ratio"] >= floor, d
+        assert d["scrapes"] >= 5 and d["scrape_errors"] == 0
+        # ... while changing NOTHING but wall clock: outputs
+        # bit-identical to the unscraped pass
+        assert d["tokens_match"] is True
+        # ... and the timeline decomposition stays SANE: components
+        # non-negative and inside the measured TTFT for every request
+        # (the sum identity holds by construction; this is the
+        # falsifiable half)
+        dec = d["ttft_decomposition"]
+        assert dec["components_sane"] is True
+        assert dec["requests"] == d["requests"]
+        assert dec["p50_ms"]["ttft_ms"] > 0
+        assert dec["p50_ms"]["prefill_ms"] > 0
 
 
 @pytest.mark.slow

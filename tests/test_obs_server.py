@@ -1,0 +1,531 @@
+"""graftscope: the live introspection plane (ISSUE 15).
+
+The acceptance bars:
+
+- ENDPOINT CONTRACTS: /metricsz, /statusz, /tracez, /flightz, /perfz,
+  /healthz served from an ephemeral port via plain urllib; 404 lists
+  the valid endpoints; /healthz flips 200 -> 503 with an unhealthy
+  provider;
+- PROVIDERS: registration/unregistration, latest-wins replacement,
+  weak-ref auto-prune when the providing object dies, and a raising
+  provider contributing an error section without a 500;
+- DISABLED BUDGET: fully off => NO listening socket and NO server
+  thread (plus the existing monitor/trace disabled-overhead tests,
+  untouched);
+- THE obs.scrape DRILL under PADDLE_TPU_SANITIZE=all: the endpoint
+  503s while armed, and a scraper polling an ACTIVE serving engine
+  perturbs nothing — zero recompiles, no sanitizer trips, outputs
+  bit-identical;
+- THE 3-REPLICA FLEET acceptance: /metricsz carries every replica's
+  labeled series, /statusz the per-replica health/breaker state, and
+  /perfz a TTFT decomposition whose components sum to the measured
+  TTFT.
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor
+from paddle_tpu.analysis import faultinject as fi
+from paddle_tpu.analysis import sanitizers as san
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.serving import ContinuousBatchingEngine
+from paddle_tpu.monitor import server as obs
+from paddle_tpu.monitor import trace
+from paddle_tpu.serving import FleetRouter
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    obs.shutdown()
+    fi.reset()
+    san.disable()
+    san.reset()
+    monitor.disable()
+    monitor.reset()
+    trace.disable()
+    trace.reset()
+
+
+_MODEL = None
+
+
+def _model():
+    global _MODEL
+    if _MODEL is None:
+        paddle.seed(0)
+        cfg = LlamaConfig(vocab_size=96, hidden_size=64,
+                          intermediate_size=176, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=2,
+                          max_position_embeddings=128)
+        _MODEL = LlamaForCausalLM(cfg)
+    return _MODEL
+
+
+def _get(port, path, timeout=10.0):
+    """(status, parsed body) — HTTP errors return their status+body."""
+    url = f"http://127.0.0.1:{port}{path}"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            body = resp.read().decode()
+            code = resp.status
+            ctype = resp.headers.get("Content-Type", "")
+    except urllib.error.HTTPError as e:
+        body = e.read().decode()
+        code = e.code
+        ctype = e.headers.get("Content-Type", "")
+    if "json" in ctype:
+        return code, json.loads(body)
+    return code, body
+
+
+def _run_all(eng, deadline_s=60.0):
+    out = {}
+    t0 = time.time()
+    while (eng.num_active or eng.num_pending) \
+            and time.time() - t0 < deadline_s:
+        for rid, toks in eng.step():
+            out[rid] = list(toks)
+    return out
+
+
+class TestLifecycleAndBudget:
+    def test_fully_off_no_socket_no_thread(self):
+        """The acceptance bar: debug server off => no listening socket,
+        no thread. (The 40us disabled-overhead budget tests in
+        test_monitor/test_trace cover the hot path — the server adds
+        nothing to it.)"""
+        assert not obs.serving()
+        assert obs.port() is None
+        assert not any("graftscope" in t.name
+                       for t in threading.enumerate())
+
+    def test_serve_is_idempotent_and_shutdown_tears_down(self):
+        p1 = obs.serve()
+        assert obs.serving() and obs.port() == p1
+        assert obs.serve() == p1            # second serve: same server
+        code, doc = _get(p1, "/healthz")
+        assert code == 200 and doc["ok"] is True
+        obs.shutdown()
+        assert not obs.serving() and obs.port() is None
+        assert not any("graftscope" in t.name
+                       for t in threading.enumerate())
+        with pytest.raises(Exception):      # noqa: B017 - conn refused
+            _get(p1, "/healthz", timeout=2.0)
+
+    def test_install_from_env(self):
+        assert obs.install_from_env("") is None
+        assert not obs.serving()
+        p = obs.install_from_env("0")
+        assert obs.serving() and obs.port() == p
+        obs.shutdown()
+        with pytest.warns(UserWarning):
+            assert obs.install_from_env("not-a-port") is None
+        assert not obs.serving()
+
+
+class TestEndpointContracts:
+    def test_unknown_endpoint_404_lists_routes(self):
+        p = obs.serve()
+        code, doc = _get(p, "/nope")
+        assert code == 404
+        assert sorted(doc["endpoints"]) == sorted(obs.ENDPOINTS)
+
+    def test_statusz_builtin_sections(self):
+        p = obs.serve()
+        fi.arm("obs.scrape", "flag", nth=99)    # armed, far from firing
+        code, doc = _get(p, "/statusz")
+        assert code == 200
+        assert doc["monitor"]["metrics_enabled"] is False
+        assert "git_rev" in doc["provenance"]
+        assert doc["sanitizers"]["lock"] is False
+        assert "obs.scrape" in doc["faults"]["armed"]
+
+    def test_metricsz_is_prometheus_text(self):
+        monitor.enable()
+        monitor.counter("paddle_tpu_serving_admitted_total").inc(3)
+        p = obs.serve()
+        code, body = _get(p, "/metricsz")
+        assert code == 200
+        assert "paddle_tpu_serving_admitted_total 3" in body
+        # the scrape itself counts, labeled by endpoint
+        code, body = _get(p, "/metricsz")
+        assert 'paddle_tpu_monitor_scrapes_total{endpoint="/metricsz"}' \
+            in body
+
+    def test_tracez_open_and_tail(self):
+        trace.enable()
+        sp = trace.start_span("serving.step", attrs={"engine": "eX"})
+        for _ in range(5):
+            with trace.span("jit.compile"):
+                pass
+        p = obs.serve()
+        code, doc = _get(p, "/tracez?tail=3")
+        assert code == 200
+        assert doc["tracing_enabled"] is True
+        assert [d["name"] for d in doc["open_spans"]] == ["serving.step"]
+        assert len(doc["spans"]) == 3
+        trace.end_span(sp)
+
+    def test_flightz_triggers_and_returns_dump(self, tmp_path,
+                                               monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path))
+        p = obs.serve()
+        code, doc = _get(p, "/flightz")
+        assert code == 200
+        assert "graftscope /flightz scrape" in doc["reason"]
+        assert doc["path"].startswith(str(tmp_path))
+        with open(doc["path"]) as f:
+            on_disk = json.load(f)
+        assert on_disk["reasons"] == doc["reasons"]
+
+    def test_perfz_serving_section(self):
+        trace.enable()
+        eng = ContinuousBatchingEngine(_model(), max_batch=2, max_len=64,
+                                       block_size=8, chunk_size=16)
+        rng = np.random.RandomState(0)
+        for _ in range(3):
+            eng.submit(rng.randint(0, 96, (9,)).astype("int32"),
+                       max_new_tokens=4)
+        _run_all(eng)
+        p = obs.serve()
+        code, doc = _get(p, "/perfz")
+        assert code == 200
+        dec = doc["serving"]["ttft"]
+        assert dec["requests"] == 3
+        for r in dec["rows"]:
+            # the falsifiable half of the decomposition contract (the
+            # sum identity holds by construction): components
+            # non-negative and inside the measured TTFT
+            assert r["gap_ns"] >= 0 and r["queue_wait_ns"] >= 0
+            assert 0 < r["prefill_ns"] <= r["ttft_ns"]
+
+    def test_healthz_flips_on_unhealthy_provider(self):
+        p = obs.serve()
+        obs.register_status_provider("sick", lambda: {"health": "down"})
+        try:
+            code, doc = _get(p, "/healthz")
+            assert code == 503
+            assert doc["ok"] is False and doc["unhealthy"] == ["sick"]
+        finally:
+            obs.unregister_status_provider("sick")
+        code, doc = _get(p, "/healthz")
+        assert code == 200 and doc["ok"] is True
+
+
+class TestProviders:
+    def test_register_unregister_and_latest_wins(self):
+        obs.register_status_provider("x", lambda: {"v": 1})
+        obs.register_status_provider("x", lambda: {"v": 2})
+        try:
+            assert obs.status_document()["providers"]["x"] == {"v": 2}
+        finally:
+            obs.unregister_status_provider("x")
+        assert "x" not in obs.status_document()["providers"]
+
+    def test_unregister_with_fn_guard(self):
+        """Unregistering a REPLACED provider by its old fn is a no-op —
+        an object tearing down after a successor took its name must not
+        evict the successor."""
+        old = lambda: {"v": "old"}          # noqa: E731
+        new = lambda: {"v": "new"}          # noqa: E731
+        obs.register_status_provider("y", old)
+        obs.register_status_provider("y", new)
+        obs.unregister_status_provider("y", old)
+        try:
+            assert obs.status_document()["providers"]["y"] == {"v": "new"}
+        finally:
+            obs.unregister_status_provider("y")
+
+    def test_bound_method_provider_pruned_on_gc(self):
+        class Thing:
+            def status(self):
+                return {"alive": True}
+
+        t = Thing()
+        obs.register_status_provider("thing", t.status)
+        assert obs.status_document()["providers"]["thing"] == {
+            "alive": True}
+        del t
+        import gc
+
+        gc.collect()
+        assert "thing" not in obs.status_document()["providers"]
+
+    def test_raising_provider_contributes_error_not_500(self):
+        def boom():
+            raise RuntimeError("nope")
+
+        obs.register_status_provider("boom", boom)
+        p = obs.serve()
+        try:
+            code, doc = _get(p, "/statusz")
+            assert code == 200
+            sec = doc["providers"]["boom"]
+            assert "RuntimeError: nope" in sec["error"]
+            code, doc = _get(p, "/healthz")
+            assert code == 503 and doc["unhealthy"] == ["boom"]
+        finally:
+            obs.unregister_status_provider("boom")
+
+    def test_engine_registers_itself(self):
+        eng = ContinuousBatchingEngine(_model(), max_batch=2, max_len=64,
+                                       block_size=8, chunk_size=16)
+        doc = obs.status_document()["providers"]
+        sec = doc[f"serving.{eng._san_tag}"]
+        assert sec["health"] == "ok"
+        assert sec["active"] == 0 and sec["pending"] == 0
+        assert sec["kv"]["free_blocks"] == sec["kv"]["total_blocks"]
+        assert 0 <= sec["kv"]["headroom"] <= 1.0
+
+
+class TestScrapeDrill:
+    def test_obs_scrape_fault_and_sanitized_scrape_vs_serve(self):
+        """The ISSUE 15 obs.scrape drill: under PADDLE_TPU_SANITIZE=all
+        a scraper polls an ACTIVE serving engine — zero post-warmup
+        recompiles, no hostsync trips, outputs bit-identical to an
+        unobserved run; arming obs.scrape flips the ENDPOINT to 503
+        while the engine keeps serving, provably unaffected."""
+        model = _model()
+        assert san.install_from_env("all") != ()
+        try:
+            eng = ContinuousBatchingEngine(model, max_batch=2, max_len=64,
+                                           block_size=8, chunk_size=16)
+            rng = np.random.RandomState(3)
+            prompts = [rng.randint(0, 96, (int(rng.randint(4, 16)),))
+                       .astype("int32") for _ in range(4)]
+            for pr in prompts:              # warmup / reference pass
+                eng.submit(pr, max_new_tokens=6)
+            ref = _run_all(eng)
+            baseline_counts = dict(san.compile_counts())
+
+            port = obs.serve()
+            stop = threading.Event()
+            seen = {"ok": 0, "faulted": 0, "other": 0}
+
+            def scraper():
+                i = 0
+                paths = ("/metricsz", "/statusz", "/healthz")
+                while not stop.is_set():
+                    try:
+                        code, _ = _get(port, paths[i % 3], timeout=5.0)
+                    except Exception:  # noqa: BLE001
+                        code = -1
+                    i += 1
+                    if code in (200, 503):
+                        seen["ok" if code == 200 else "faulted"] += 1
+                    else:
+                        seen["other"] += 1
+                    stop.wait(0.005)
+
+            t = threading.Thread(target=scraper, daemon=True)
+            t.start()
+            try:
+                for pr in prompts:          # scraped pass
+                    eng.submit(pr, max_new_tokens=6)
+                scraped = _run_all(eng)
+                fi.arm("obs.scrape", "flag", prob=1.0)  # every scrape
+                deadline = time.time() + 10
+                while seen["faulted"] < 2 and time.time() < deadline:
+                    for pr in prompts:      # engine serves while armed
+                        eng.submit(pr, max_new_tokens=6)
+                    armed = _run_all(eng)
+                fi.disarm("obs.scrape")
+            finally:
+                stop.set()
+                t.join(timeout=5.0)
+            # the endpoint faulted; the engine never noticed
+            assert seen["faulted"] >= 2, seen
+            assert seen["ok"] >= 2, seen
+            assert seen["other"] == 0, seen
+            # rid order == submission order, so position i compares the
+            # same prompt's outputs across passes (eviction ORDER may
+            # differ cold vs warm; the tokens must not)
+            assert [scraped[r] for r in sorted(scraped)] \
+                == [ref[r] for r in sorted(ref)]
+            assert [armed[r] for r in sorted(armed)] \
+                == [ref[r] for r in sorted(ref)]
+            assert san.trips() == []
+            assert dict(san.compile_counts()) == baseline_counts
+            assert [p for p, _ in fi.trips()] \
+                and all(p == "obs.scrape" for p, _ in fi.trips())
+        finally:
+            san.disable()
+            san.reset()
+
+
+class TestFleetAcceptance:
+    def test_three_replica_fleet_scrapes_as_one_target(self):
+        """ISSUE 15 acceptance: a 3-replica fleet serves /metricsz with
+        ALL replicas labeled, /statusz with per-replica health/breaker
+        state, and /perfz with a TTFT decomposition whose components
+        sum to the measured TTFT."""
+        trace.enable()
+        fl = FleetRouter(_model(), replicas=3,
+                         engine_kwargs=dict(max_batch=2, block_size=8,
+                                            chunk_size=16,
+                                            decode_burst=1),
+                         max_new_tokens=4, slo=True)
+        try:
+            rng = np.random.RandomState(0)
+            fl.warmup(rng.randint(0, 96, (12,)).astype("int32"))
+            frids = [fl.submit(rng.randint(0, 96,
+                                           (int(rng.randint(6, 14)),))
+                               .astype("int32")) for _ in range(6)]
+            got = {}
+            t0 = time.time()
+            while len(got) < len(frids) and time.time() - t0 < 60:
+                for frid, toks in fl.pop_results():
+                    got[frid] = toks
+                time.sleep(0.005)
+            assert len(got) == len(frids)
+
+            p = obs.serve()
+            tags = [rep.tag for rep in fl.replicas]
+            code, body = _get(p, "/metricsz")
+            assert code == 200
+            for tag in tags:
+                assert (f'paddle_tpu_fleet_replica_steps_total'
+                        f'{{replica="{tag}"}}') in body
+                assert (f'paddle_tpu_fleet_replica_inflight'
+                        f'{{replica="{tag}"}}') in body
+            code, doc = _get(p, "/statusz")
+            assert code == 200
+            fleet = doc["providers"]["fleet"]
+            assert fleet["health"] == "ok"
+            by_tag = {r["replica"]: r for r in fleet["replicas"]}
+            assert sorted(by_tag) == sorted(tags)
+            for row in by_tag.values():
+                assert row["state"] == "healthy"
+                assert row["failures"] == 0
+                assert row["backoff_remaining_s"] == 0.0
+            assert set(fleet["engines"]) == set(tags)
+            assert fleet["slo"]["series"], fleet["slo"]
+            code, doc = _get(p, "/perfz")
+            assert code == 200
+            dec = doc["serving"]["ttft"]
+            assert dec["requests"] >= len(frids)
+            for r in dec["rows"]:
+                assert r["gap_ns"] >= 0 and r["queue_wait_ns"] >= 0
+                assert 0 < r["prefill_ns"] <= r["ttft_ns"]
+            # the in-process aggregation twins match the endpoint's view
+            assert all(f'replica="{t}"' in fl.fleet_prometheus_text()
+                       for t in tags)
+            snap = fl.fleet_snapshot()
+            assert set(snap["fleet"]["engines"]) == set(tags)
+            assert "metrics" in snap and "provenance" in snap
+        finally:
+            fl.stop()
+        # stop() unregisters: the fleet section is gone
+        assert "fleet" not in obs.status_document()["providers"]
+
+
+class TestObsProbeCLI:
+    def _probe(self, *args, env=None):
+        import os
+        import subprocess
+        import sys
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+        return subprocess.run(
+            [sys.executable, os.path.join(root, "tools",
+                                          "obs_probe.py"), *args],
+            capture_output=True, text=True, timeout=60,
+            env=env or dict(os.environ))
+
+    def test_healthy_exit_0_and_json(self):
+        p = obs.serve()
+        out = self._probe("--port", str(p))
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert out.stdout.startswith("HEALTHY")
+        out = self._probe("--port", str(p), "--json")
+        assert out.returncode == 0
+        doc = json.loads(out.stdout)
+        assert doc["ok"] is True and doc["healthz_status"] == 200
+
+    def test_unhealthy_exit_1(self):
+        p = obs.serve()
+        obs.register_status_provider("sick", lambda: {"health": "down"})
+        try:
+            out = self._probe("--port", str(p), "--json")
+            assert out.returncode == 1, out.stdout + out.stderr
+            assert json.loads(out.stdout)["unhealthy"] == ["sick"]
+        finally:
+            obs.unregister_status_provider("sick")
+
+    def test_unreachable_exit_2_and_usage(self):
+        out = self._probe("--port", "1")     # nothing listens there
+        assert out.returncode == 2, out.stdout + out.stderr
+        assert "UNREACHABLE" in out.stdout
+        out = self._probe()                  # no --port/--url
+        assert out.returncode == 2
+
+    def test_never_imports_jax_or_the_framework(self, tmp_path):
+        """The CLI must stay importless (pure stdlib): run it with
+        POISONED jax/paddle_tpu modules first on sys.path — any import
+        of either would crash instead of probing."""
+        import os
+
+        for name in ("jax", "paddle_tpu"):
+            (tmp_path / f"{name}.py").write_text(
+                f'raise ImportError("poisoned {name}")\n')
+        env = dict(os.environ)
+        env["PYTHONPATH"] = str(tmp_path)
+        p = obs.serve()
+        out = self._probe("--port", str(p), env=env)
+        assert out.returncode == 0, out.stdout + out.stderr
+
+
+class TestConcurrentScrape:
+    def test_concurrent_scrapers_and_writers(self):
+        """Thread soak: 3 scrapers hammer every endpoint while spans and
+        metrics are recorded concurrently — every response is a clean
+        200/404, no handler 500s, no deadlock."""
+        monitor.enable()
+        trace.enable()
+        p = obs.serve()
+        stop = threading.Event()
+        errors = []
+
+        def scraper(paths):
+            while not stop.is_set():
+                for path in paths:
+                    try:
+                        code, _ = _get(p, path, timeout=5.0)
+                        if code != 200:
+                            errors.append((path, code))
+                    except Exception as e:  # noqa: BLE001
+                        errors.append((path, repr(e)))
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                monitor.counter(
+                    "paddle_tpu_serving_generated_tokens_total").inc()
+                with trace.span("jit.compile", attrs={"i": i}):
+                    i += 1
+
+        threads = [
+            threading.Thread(target=scraper,
+                             args=(["/metricsz", "/statusz"],)),
+            threading.Thread(target=scraper,
+                             args=(["/tracez", "/perfz"],)),
+            threading.Thread(target=scraper, args=(["/healthz"],)),
+            threading.Thread(target=writer),
+        ]
+        for t in threads:
+            t.daemon = True
+            t.start()
+        time.sleep(0.5)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        assert errors == []

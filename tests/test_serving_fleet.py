@@ -551,3 +551,83 @@ class TestSubmitRecoverRace:
         while len(eng._pager._free) != free0 and time.time() - t0 < 5:
             time.sleep(0.01)
         assert len(eng._pager._free) == free0        # no leaked blocks
+
+
+class TestSubmitRacingWithdraw:
+    """ISSUE 15 review hardening: an abort/withdrawal landing in the
+    instant between the engine accepting a request and the router
+    recording its rid mapping must be CLAIMED and re-seeded (the
+    abort-side twin of the unclaimed-result race), and a request the
+    driver finished inside that same gap must not re-enter the ledger
+    where nothing would ever remove it."""
+
+    def test_unrecorded_abort_claimed_and_reseeded(self):
+        from paddle_tpu.serving import fleet as fleet_mod
+
+        fl = _fleet(_shared_model(), replicas=2, start=False)
+        try:
+            rep0 = fl.replicas[0]
+            # the race, reproduced deterministically: the withdrawal
+            # arrives while rid 7 has no rid2att mapping yet
+            with fl._lock:
+                out = fl._absorb_abort_locked(rep0, 7, [5, 6], None)
+            assert out == []
+            assert list(rep0.unclaimed_aborts) == [(7, [5, 6], None)]
+            # ... then the submit path records the mapping for rid 7:
+            # the parked abort must be claimed and the request re-seeded
+            # with the partial tokens as its prefix
+            fr = fleet_mod._FleetRequest(0, np.arange(4, dtype=np.int32),
+                                         6, "", 0)
+            att = fleet_mod._Attempt(fr, prefix=(), hedge=False)
+            fr.primary = att
+            orig = rep0.engine.submit
+            rep0.engine.submit = lambda *a, **k: 7
+            try:
+                fl._submit_attempt(att, rep=rep0)
+            finally:
+                rep0.engine.submit = orig
+            assert not rep0.unclaimed_aborts          # claimed
+            new = fr.primary
+            assert new is not att                     # re-seeded
+            assert new.prefix == [5, 6]
+            assert fr.failovers == 1 and fl.failovers == 1
+            # the reservation is balanced: exactly the replacement's
+            # inflight remains, mapped to the replacement attempt
+            total = sum(r.inflight for r in fl.replicas)
+            assert total == 1
+            assert new.rep.rid2att[new.rid] is new
+        finally:
+            fl.stop()
+
+    def test_unrecorded_abort_of_cancelled_rid_dropped(self):
+        fl = _fleet(_shared_model(), replicas=1, start=False)
+        try:
+            rep = fl.replicas[0]
+            rep.mark_cancelled(9)
+            with fl._lock:
+                assert fl._absorb_abort_locked(rep, 9, [1], None) == []
+            # a cancelled hedge's abort re-seeds nothing and parks
+            # nothing — its entry is simply consumed
+            assert not rep.unclaimed_aborts
+            assert 9 not in rep.cancelled_rids
+        finally:
+            fl.stop()
+
+    def test_done_request_not_reinserted_into_ledger(self):
+        fl = _fleet(_shared_model(), replicas=1, start=False)
+        try:
+            rep = fl.replicas[0]
+            # the driver "finished" rid 3 before the mapping landed
+            rep.unclaimed.append((3, [9, 9]))
+            orig = rep.engine.submit
+            rep.engine.submit = lambda *a, **k: 3
+            try:
+                frid = fl.submit(np.arange(4, dtype=np.int32))
+            finally:
+                rep.engine.submit = orig
+            # the claimed result completed the request; the ledger must
+            # stay EMPTY (nothing would ever remove a done entry)
+            assert fl.pop_results() == [(frid, [9, 9])]
+            assert fl.num_inflight == 0
+        finally:
+            fl.stop()

@@ -346,9 +346,10 @@ class TestCLISurfaces:
         assert "paddle_tpu_dispatch_op_calls_total\tcounter" in p.stdout
 
     def test_run_static_checks_aggregator(self):
-        """10/10: the six source-level rows plus the four graftir rows
-        (one jax subprocess analyzing — and graftopt-transforming — the
-        flagship live programs)."""
+        """11/11: the seven source-level rows (incl. the ISSUE 15
+        check_doc_rows telemetry-doc contract) plus the four graftir
+        rows (one jax subprocess analyzing — and graftopt-transforming —
+        the flagship live programs)."""
         p = self._run_slow("tools/run_static_checks.py", "--json")
         assert p.returncode == 0, p.stdout + p.stderr
         summary = json.loads(p.stdout)
@@ -356,7 +357,8 @@ class TestCLISurfaces:
         assert [c["check"] for c in summary["checks"]] == [
             "graftlint", "check_metric_names", "check_span_names",
             "check_lock_order", "check_recompile_hazards",
-            "check_fault_points", "check_collective_consistency",
+            "check_fault_points", "check_doc_rows",
+            "check_collective_consistency",
             "check_donation", "check_hbm_budgets", "check_opt_parity"]
         assert all(c["ok"] for c in summary["checks"])
 

@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""Scrape a running paddle_tpu process's graftscope debug endpoint.
+
+Pure stdlib (urllib + json) and ZERO framework imports — point it at any
+process started with ``PADDLE_TPU_DEBUG_PORT`` (or an in-code
+``monitor.server.serve()``) from any machine that can reach the port::
+
+    python tools/obs_probe.py --port 8899
+    python tools/obs_probe.py --port 8899 --json
+    python tools/obs_probe.py --url http://10.0.0.7:8899
+
+Fetches ``/healthz`` + ``/statusz`` (and a ``/metricsz`` series count),
+prints a human summary (or the raw JSON with ``--json``) and exits
+
+- 0: reachable and healthy (every provider reports ``health: ok``);
+- 1: reachable but UNHEALTHY (a provider votes down, reports an error
+  section, or /healthz answers 503) — the alerting hook;
+- 2: unreachable / malformed response (connection refused, timeout).
+
+See docs/introspection.md for the endpoint and provider contracts.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.request
+
+__all__ = ["probe", "main"]
+
+
+def _fetch(base, path, timeout):
+    """(status_code, parsed-or-text body); HTTP errors return their
+    status + body instead of raising (503 from /healthz is an ANSWER)."""
+    url = base.rstrip("/") + path
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            body = resp.read().decode("utf-8", "replace")
+            code = resp.status
+    except urllib.error.HTTPError as e:
+        body = e.read().decode("utf-8", "replace")
+        code = e.code
+    if path == "/metricsz":
+        return code, body
+    try:
+        return code, json.loads(body)
+    except json.JSONDecodeError:
+        return code, body
+
+
+def probe(base, timeout=5.0):
+    """One probe pass. Returns ``(exit_code, doc)`` where doc carries
+    the healthz verdict, the statusz document and the /metricsz series
+    count."""
+    try:
+        h_code, health = _fetch(base, "/healthz", timeout)
+        s_code, status = _fetch(base, "/statusz", timeout)
+        m_code, metrics = _fetch(base, "/metricsz", timeout)
+    except Exception as e:  # noqa: BLE001 - unreachable = exit 2
+        return 2, {"error": f"{type(e).__name__}: {e}", "url": base}
+    if not isinstance(health, dict) or not isinstance(status, dict):
+        return 2, {"error": "malformed response", "url": base,
+                   "healthz": health, "statusz": status}
+    series = sum(1 for line in metrics.splitlines()
+                 if line and not line.startswith("#")) \
+        if isinstance(metrics, str) and m_code == 200 else 0
+    unhealthy = list(health.get("unhealthy", []))
+    for name, sec in (status.get("providers") or {}).items():
+        if isinstance(sec, dict) and "error" in sec \
+                and name not in unhealthy:
+            unhealthy.append(name)
+    ok = h_code == 200 and health.get("ok") is True and not unhealthy
+    doc = {
+        "url": base,
+        "ok": bool(ok),
+        "healthz_status": h_code,
+        "unhealthy": sorted(unhealthy),
+        "providers": sorted((status.get("providers") or {})),
+        "metric_series": series,
+        "statusz": status,
+    }
+    return (0 if ok else 1), doc
+
+
+def _summary(doc):
+    if "error" in doc:
+        return [f"UNREACHABLE {doc['url']}: {doc['error']}"]
+    lines = [
+        f"{'HEALTHY' if doc['ok'] else 'UNHEALTHY'} {doc['url']} "
+        f"(healthz {doc['healthz_status']}, "
+        f"{doc['metric_series']} metric series)"]
+    st = doc["statusz"]
+    mon = st.get("monitor", {})
+    lines.append(f"  monitor: metrics={mon.get('metrics_enabled')} "
+                 f"tracing={mon.get('tracing_enabled')} "
+                 f"open_spans={mon.get('open_spans')}")
+    for name in doc["providers"]:
+        sec = st["providers"][name]
+        if not isinstance(sec, dict):
+            lines.append(f"  {name}: {sec!r}")
+            continue
+        health = sec.get("health", "ok")
+        detail = ""
+        if "error" in sec:
+            detail = f" — {sec['error']}"
+        elif "replicas" in sec:
+            states = {}
+            for r in sec["replicas"]:
+                states[r["state"]] = states.get(r["state"], 0) + 1
+            detail = " — " + ", ".join(f"{v} {k}"
+                                       for k, v in sorted(states.items()))
+        elif "active" in sec:
+            detail = (f" — active={sec.get('active')} "
+                      f"pending={sec.get('pending')}")
+        lines.append(f"  {name}: {health}{detail}")
+    if doc["unhealthy"]:
+        lines.append(f"  unhealthy: {', '.join(doc['unhealthy'])}")
+    return lines
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="scrape a paddle_tpu graftscope debug endpoint "
+                    "(exit 0 healthy / 1 unhealthy / 2 unreachable)")
+    ap.add_argument("--url", help="full base URL "
+                                  "(e.g. http://10.0.0.7:8899)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int)
+    ap.add_argument("--timeout", type=float, default=5.0)
+    ap.add_argument("--json", action="store_true",
+                    help="print the raw probe document instead of the "
+                         "summary")
+    args = ap.parse_args(argv)
+    if args.url:
+        base = args.url
+    elif args.port is not None:
+        base = f"http://{args.host}:{args.port}"
+    else:
+        ap.error("pass --port (with optional --host) or --url")
+    code, doc = probe(base, timeout=args.timeout)
+    if args.json:
+        print(json.dumps(doc, indent=1, sort_keys=True, default=str))
+    else:
+        for line in _summary(doc):
+            print(line)
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,7 +23,11 @@ One analysis pass (parse the tree once) feeds two result rows:
    ``faultinject.fire("<point>")`` site in the tree, and every fired
    point is declared — an undeclared drill or a dead catalog row is a
    CI failure, no baseline);
-7.-10. the graftir rows (``check_collective_consistency`` /
+7. the telemetry DOC rows (``check_doc_rows``, this repo's root only:
+   every cataloged metric has a docs/observability.md table row, every
+   cataloged span appears in docs/tracing.md, and no observability
+   table row names an uncataloged metric — zero baseline);
+8.-11. the graftir rows (``check_collective_consistency`` /
    ``check_donation`` / ``check_hbm_budgets`` / ``check_opt_parity``):
    GI001/GI002/GI003 run strict (no baseline) over the three FLAGSHIP
    live programs — the serving mixed step, the decode burst, and the
@@ -120,6 +124,75 @@ def fault_point_problems(an, root=ROOT, project=None):
         problems.append(
             f"declared in faultinject.POINTS but never fired: {point!r} "
             "(dead catalog row — drill it or drop it)")
+    return problems
+
+
+def doc_row_problems(root=ROOT):
+    """``check_doc_rows``: the telemetry DOC contract. Every metric in
+    ``monitor/catalog.py`` METRICS must have a table row in
+    docs/observability.md (a line starting ``| `<name>` ``), every
+    span in SPANS must appear backticked in docs/tracing.md, and every
+    metric named by an observability table row must exist in the
+    catalog — 15 PRs of hand-maintained doc tables, made mechanical.
+    Stdlib-only: the catalog is AST-parsed (never imported), the docs
+    are read as text; ZERO baseline by policy. The caller (run_checks)
+    gates this to THIS repo's root — fixture mini-trees document
+    nothing."""
+    cat_path = os.path.join(root, "paddle_tpu", "monitor", "catalog.py")
+    problems = []
+    try:
+        with open(cat_path) as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError) as e:
+        return [f"paddle_tpu/monitor/catalog.py: unreadable catalog: {e}"]
+    tables = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id in ("METRICS",
+                                                        "SPANS"):
+                    try:
+                        tables[t.id] = ast.literal_eval(node.value)
+                    except ValueError as e:
+                        problems.append(
+                            f"catalog {t.id} not a literal dict: {e}")
+    for name in ("METRICS", "SPANS"):
+        if name not in tables:
+            problems.append(f"catalog has no literal {name} table")
+    if problems:
+        return problems
+
+    def read(rel):
+        try:
+            with open(os.path.join(root, rel)) as f:
+                return f.read()
+        except OSError:
+            problems.append(f"{rel}: missing (the doc half of the "
+                            "telemetry contract)")
+            return None
+
+    obs = read("docs/observability.md")
+    tr = read("docs/tracing.md")
+    if problems:
+        return problems
+    import re
+
+    rowed = set(re.findall(r"^\|\s*`(paddle_tpu_[a-z0-9_]+)`",
+                           obs, re.MULTILINE))
+    for name in sorted(tables["METRICS"]):
+        if name not in rowed:
+            problems.append(
+                f"docs/observability.md: no table row for cataloged "
+                f"metric {name}")
+    for name in sorted(rowed - set(tables["METRICS"])):
+        problems.append(
+            f"docs/observability.md: table row for {name} names no "
+            "cataloged metric (stale doc row)")
+    for name in sorted(tables["SPANS"]):
+        if f"`{name}`" not in tr:
+            problems.append(
+                f"docs/tracing.md: cataloged span {name} never "
+                "mentioned (add it to the span table)")
     return problems
 
 
@@ -240,6 +313,16 @@ def run_checks(root=ROOT):
         "detail": problems,
         "seconds": round(time.perf_counter() - t0, 3),
     })
+    if os.path.abspath(root) == os.path.abspath(ROOT):
+        t0 = time.perf_counter()
+        problems = doc_row_problems(root)
+        rows.append({
+            "check": "check_doc_rows",
+            "ok": not problems,
+            "findings": len(problems),
+            "detail": problems,
+            "seconds": round(time.perf_counter() - t0, 3),
+        })
     rows.extend(graftir_rows(root))
     return rows
 
