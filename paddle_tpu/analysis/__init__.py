@@ -21,15 +21,24 @@ and reports framework-specific hazards the test suite cannot see:
   branching in jitted bodies, per-call-constructed static args;
 - GL009 mutable-global-capture — jitted/to_static bodies closing over a
   mutable module global (trace-time contents baked in; mutations apply
-  only after an unrelated recompile).
+  only after an unrelated recompile);
+- GL010 unguarded-shared-state — a ``self.<attr>`` written under a lock
+  anywhere in its class but touched lock-free in a method reachable from
+  an inferred thread root (``locksets.py``: thread-root inference +
+  entry-lockset fixpoint over the call graph), thread-entry chain in the
+  finding;
+- GL011 guarded-by-inconsistency — one attribute guarded by DIFFERENT
+  locks at different write sites (no common lock), and mutable
+  containers escaping their lock region via a bare return/yield.
 
 Since PR 4 the engine is INTERPROCEDURAL: ``callgraph.py`` builds a
 whole-tree call graph with per-function effect summaries, so GL001/
 GL002/GL004 flag an impure / host-syncing / blocking helper at the call
 site inside the traced body / hot path / lock region, with the
 propagation chain in the finding (render it with ``--explain GLxxx``).
-The runtime twins of GL007/GL008 (and a host-sync tripwire) live in
-``analysis/sanitizers.py`` ("graftsan", ``PADDLE_TPU_SANITIZE=...``);
+The GL010/GL011 lockset analysis (``locksets.py``) rides the same graph.
+The runtime twins of GL007/GL008/GL010 (and a host-sync tripwire) live
+in ``analysis/sanitizers.py`` ("graftsan", ``PADDLE_TPU_SANITIZE=...``);
 see docs/sanitizers.md.
 
 Run it as ``python -m paddle_tpu.analysis`` (or, without importing the
@@ -85,7 +94,7 @@ def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="python -m paddle_tpu.analysis",
         description="graftlint: framework-aware static analysis "
-                    "(GL001–GL009, interprocedural)")
+                    "(GL001–GL011, interprocedural)")
     ap.add_argument("--root", default=None,
                     help="tree to analyze (default: this repo)")
     ap.add_argument("--include", default="paddle_tpu",

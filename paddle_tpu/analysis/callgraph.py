@@ -200,13 +200,25 @@ class CallGraph:
     def resolve(self, srcfile, scope, call):
         """Target FuncInfo key for a Call, or None when the binding is not
         statically unambiguous."""
-        name = dotted_name(call.func)
+        return self.resolve_callable(srcfile, scope, call.func, call)
+
+    def resolve_callable(self, srcfile, scope, expr, anchor=None):
+        """Target FuncInfo key for a bare callable REFERENCE — a Name or
+        dotted Attribute used as a value rather than called directly
+        (``threading.Thread(target=self._loop)``, ``pool.submit(fetch)``).
+        Same conservative rules as :meth:`resolve`: ambiguous bindings
+        resolve to None. ``anchor`` is the AST node whose ancestry decides
+        the enclosing class for ``self.method`` references (defaults to
+        the expression itself)."""
+        if anchor is None:
+            anchor = expr
+        name = dotted_name(expr)
         if name is None:
             return None
         parts = name.split(".")
         rel = srcfile.relpath
         if parts[0] in ("self", "cls") and len(parts) == 2:
-            cls = self._enclosing_class(srcfile, call)
+            cls = self._enclosing_class(srcfile, anchor)
             if cls is None:
                 return None
             key = (rel, f"{cls}.{parts[1]}")

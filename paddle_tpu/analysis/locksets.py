@@ -1,0 +1,502 @@
+"""Lockset data-race detection (graftlint v3): thread-root inference and
+field-sensitive per-class guarded-by analysis over the interprocedural
+call graph.
+
+The host tier is deeply multithreaded — serving driver threads, the
+FleetRouter health/replica loops, the checkpoint writer, the CommWatchdog
+scanner, obs-server scrape threads — and the last two PRs each shipped
+hand-found data-race fixes. This module makes that bug class statically
+checkable, the same way ``callgraph.py`` made hidden syncs checkable:
+
+1. **thread-root inference** — callables handed to
+   ``threading.Thread(target=...)``, ``threading.Timer``, and executor
+   ``.submit(fn, ...)`` are thread roots; everything transitively callable
+   from a root (through the conservative resolver) is *concurrent*. The
+   in-tree spawn helpers (``start_driver``, the fleet health/replica
+   loops, the checkpoint writer's ``_ensure_writer``, the watchdog's
+   ``start``) all contain their ``Thread(...)`` call directly, so the
+   generic inference covers them without a special-case table.
+2. **entry-lockset inference** — a method whose every resolved call site
+   (within the concurrent subgraph) sits inside ``with <lock>:`` regions
+   holding lock L is analyzed as holding L at entry. This is what keeps
+   the ``*_locked`` helper convention (fleet, registry) clean without
+   annotations: the lock is held by contract at every caller.
+3. **GL010 unguarded-shared-state** — per class, a ``self.<attr>``
+   written under a nonempty lockset anywhere (outside ``__init__``) is
+   *lock-managed* state; any access to it with an EMPTY lockset from a
+   concurrent-reachable method is flagged at the unguarded site, with the
+   thread-entry chain (spawn site → call hops) in ``Finding.chain``.
+4. **GL011 guarded-by inconsistency** — (a) the guarded writes of one
+   attribute hold locksets with an empty common intersection (two sites,
+   two different locks: no single lock actually protects the field);
+   (b) a mutable container attribute (list/dict/set/deque built in
+   ``__init__``) that is mutated under the lock elsewhere escapes its
+   lock region via a bare ``return self.<attr>`` / ``yield self.<attr>``
+   — the caller holds a live reference it will iterate or mutate outside
+   the lock.
+
+Annotations: a ``# guarded_by: <lock>`` comment on an access line
+declares protection the analysis cannot see (external synchronization, a
+caller contract outside the resolvable graph). The named lock joins that
+line's lockset — so it both silences GL010 *and* participates in GL011's
+consistency check (annotating ``self._a`` while every real write holds
+``self._b`` is itself a finding). Accesses that are deliberately
+lock-free (GIL-atomic monotonic stamps, append-only telemetry deques)
+take the standard ``# graftlint: disable=GL010 — reason`` suppression.
+
+Excluded from the field table: synchronization primitives themselves
+(attrs assigned from ``threading.*``/``queue.Queue``/``new_lock`` in
+``__init__``, or whose name ends in ``lock``/``cond``/``event``/``sem``)
+— a Lock/Queue/Event is its own synchronization, not data it guards.
+
+Like the rest of the engine: pure AST, never imports the analyzed tree,
+conservative resolution (a missed edge is a false negative, never a
+false positive). The runtime twin is graftsan's ``race`` sanitizer
+(Eraser-style per-field candidate-lockset intersection over the actual
+locks held at actual accesses — ``analysis/sanitizers.py``).
+"""
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+
+from .core import dotted_name
+
+# The spawn APIs the thread-root inference recognizes (the last dotted
+# component): a callable reference handed to one of these runs on another
+# thread. Docs render this as the thread-root table.
+SPAWN_CALLS = ("Thread", "Timer")
+SPAWN_SUBMIT = "submit"
+
+# self.<attr>.<method>(...) calls that mutate the container in place.
+MUTATORS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "remove", "discard", "pop", "popleft", "popitem", "clear", "update",
+    "setdefault", "sort", "reverse", "rotate",
+})
+
+# __init__ constructors marking an attr as a mutable container (GL011b).
+MUTABLE_CALLS = frozenset({
+    "list", "dict", "set", "deque", "defaultdict", "OrderedDict",
+    "Counter", "bytearray",
+})
+
+# __init__ constructors marking an attr as a synchronization primitive
+# (excluded from the field table — the primitive is the synchronization).
+_SYNC_CTORS = ("Lock", "RLock", "Condition", "Event", "Semaphore",
+               "BoundedSemaphore", "Barrier", "Queue", "LifoQueue",
+               "PriorityQueue", "SimpleQueue", "new_lock", "local")
+_SYNC_SUFFIXES = ("lock", "cond", "event", "sem")
+
+_GUARDED_BY_RE = re.compile(r"#\s*guarded_by:\s*([A-Za-z_][A-Za-z0-9_.]*)")
+
+
+def guarded_by_lines(srcfile):
+    """{lineno: lock name} for every ``# guarded_by: <lock>`` comment in
+    the file. Tokenized (not regexed over raw lines) so documentation
+    quoting the annotation inside a string never declares anything —
+    same discipline as the suppression parser. Memoized per file."""
+    memo = getattr(srcfile, "_guarded_by_memo", None)
+    if memo is not None:
+        return memo
+    out = {}
+    try:
+        for tok in tokenize.generate_tokens(
+                io.StringIO(srcfile.text).readline):
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _GUARDED_BY_RE.search(tok.string)
+            if m:
+                out[tok.start[0]] = m.group(1)
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass
+    srcfile._guarded_by_memo = out
+    return out
+
+
+class Access:
+    """One ``self.<attr>`` access: site, kind, and the static lockset."""
+
+    __slots__ = ("attr", "node", "line", "write", "method_key", "locks",
+                 "annotated")
+
+    def __init__(self, attr, node, write, method_key, locks, annotated):
+        self.attr = attr
+        self.node = node
+        self.line = getattr(node, "lineno", 0)
+        self.write = write
+        self.method_key = method_key    # FuncInfo key of the method
+        self.locks = locks              # frozenset of lock keys
+        self.annotated = annotated      # guarded_by annotation applied
+
+
+class LocksetAnalysis:
+    """The shared result both GL010 and GL011 read. Build once per
+    Project via :func:`analysis_for`."""
+
+    def __init__(self, project):
+        self.project = project
+        self.cg = project.callgraph()
+        # key -> (parent key|None, spawn/call description, path, line)
+        self.spawn_of = {}
+        self.roots = self._find_thread_roots()
+        self.concurrent = self._reach()
+        self.entry_locks = self._infer_entry_locks()
+        # (relpath, Class) -> {attr: [Access, ...]}
+        self.classes = {}
+        # (relpath, Class) -> {attr: kind} of mutable-container attrs
+        self.mutable_attrs = {}
+        # (relpath, Class) -> set of sync-primitive attr names
+        self.sync_attrs = {}
+        self._collect_accesses()
+
+    # -- thread roots --------------------------------------------------------
+    def _spawn_target(self, call):
+        """The callable expression a spawn call hands to another thread,
+        or None when ``call`` is not a spawn site."""
+        name = dotted_name(call.func)
+        if name is None:
+            return None
+        last = name.rsplit(".", 1)[-1]
+        if last in SPAWN_CALLS:
+            for kw in call.keywords:
+                if kw.arg in ("target", "function"):
+                    return kw.value
+            if last == "Timer" and len(call.args) >= 2:
+                return call.args[1]
+            return None
+        if last == SPAWN_SUBMIT and call.args:
+            # executor.submit(fn, ...): only a resolvable function
+            # reference makes this a spawn — data-bearing .submit()
+            # methods (the fleet router's) pass values, which the
+            # resolver refuses, so they never become roots
+            return call.args[0]
+        return None
+
+    def _find_thread_roots(self):
+        roots = {}
+        for fi in self.cg.functions.values():
+            for (call, _tgt, _disp) in fi.calls:
+                expr = self._spawn_target(call)
+                if expr is None:
+                    continue
+                key = self.cg.resolve_callable(fi.srcfile, fi.qualname,
+                                               expr, call)
+                if key is None or key not in self.cg.functions:
+                    continue
+                api = dotted_name(call.func)
+                disp = dotted_name(expr) or "<target>"
+                if key not in roots:
+                    roots[key] = (fi, call, api, disp)
+                    self.spawn_of[key] = (
+                        None,
+                        f"spawned: {api}({disp}) in {fi.qualname}",
+                        fi.path, call.lineno)
+        return roots
+
+    def _reach(self):
+        """Concurrent-reachable closure from the thread roots, recording
+        one parent hop per function for the thread-entry chain."""
+        seen = set(self.roots)
+        queue = list(self.roots)
+        while queue:
+            key = queue.pop(0)
+            fi = self.cg.functions[key]
+            for (call, tgt, disp) in fi.calls:
+                if tgt is None or tgt not in self.cg.functions:
+                    continue
+                if tgt in seen:
+                    continue
+                seen.add(tgt)
+                self.spawn_of[tgt] = (
+                    key, f"{fi.qualname} calls {disp}",
+                    fi.path, call.lineno)
+                queue.append(tgt)
+        return seen
+
+    def thread_chain(self, key):
+        """Thread-entry chain for a concurrent method, spawn site first,
+        one ``file:line`` hop per entry (rendered by ``--explain``)."""
+        hops = []
+        cur = key
+        seen = set()
+        while cur is not None and cur not in seen:
+            seen.add(cur)
+            entry = self.spawn_of.get(cur)
+            if entry is None:
+                break
+            parent, descr, path, line = entry
+            hops.append(f"{descr} at {path}:{line}")
+            cur = parent
+        return tuple(reversed(hops))
+
+    def thread_root_of(self, key):
+        """Qualname of the thread root a concurrent method is reached
+        from (for line-number-free finding messages)."""
+        cur = key
+        seen = set()
+        while cur is not None and cur not in seen:
+            seen.add(cur)
+            entry = self.spawn_of.get(cur)
+            if entry is None or entry[0] is None:
+                break
+            cur = entry[0]
+        fi = self.cg.functions.get(cur)
+        return fi.qualname if fi is not None else "?"
+
+    # -- entry locksets ------------------------------------------------------
+    def _locks_enclosing(self, fi, node):
+        """Lock keys of every ``with <lock>:`` region between ``node``
+        and the function root."""
+        from .rules import LockDiscipline
+
+        out = set()
+        f = fi.srcfile
+        for anc in f.ancestors(node):
+            if anc is fi.node:
+                break
+            if isinstance(anc, ast.With):
+                for item in anc.items:
+                    if LockDiscipline._lock_ctx(item):
+                        k = self.cg.lock_key(f, item.context_expr)
+                        if k is not None:
+                            out.add(k)
+        return frozenset(out)
+
+    def _infer_entry_locks(self):
+        """{key: frozenset(lock keys held at entry)} over the concurrent
+        subgraph. Roots enter with nothing held; every other method's
+        entry set is the intersection over its resolved call sites of
+        (caller's entry set | locks enclosing the call). Monotone
+        shrinking from TOP (None), so the fixed point is reached in a
+        few sweeps on this graph."""
+        entry = {k: None for k in self.concurrent}       # None = TOP
+        for k in self.roots:
+            entry[k] = frozenset()
+        changed = True
+        while changed:
+            changed = False
+            for key in self.concurrent:
+                base = entry[key]
+                if base is None:
+                    continue
+                fi = self.cg.functions[key]
+                for (call, tgt, _disp) in fi.calls:
+                    if tgt not in self.concurrent or tgt == key:
+                        continue
+                    held = base | self._locks_enclosing(fi, call)
+                    cur = entry[tgt]
+                    new = held if cur is None else (cur & held)
+                    if new != cur:
+                        entry[tgt] = new
+                        changed = True
+        return {k: (v if v is not None else frozenset())
+                for k, v in entry.items()}
+
+    # -- field-access collection ---------------------------------------------
+    def _enclosing_class(self, fi):
+        for anc in fi.srcfile.ancestors(fi.node):
+            if isinstance(anc, ast.ClassDef):
+                scope = fi.srcfile.scope_of(anc)
+                return f"{scope}.{anc.name}" if scope else anc.name
+        return None
+
+    def _annotation_key(self, srcfile, cls, name):
+        """Lock key for a ``# guarded_by: <lock>`` annotation value,
+        through the same identity rules as ``CallGraph.lock_key``."""
+        if name.startswith(("self.", "cls.")):
+            return f"{srcfile.relpath}:{cls}.{name.split('.', 1)[1]}"
+        return f"{srcfile.relpath}:{name}"
+
+    def _classify_access(self, f, node):
+        """('write'|'read') for one self.<attr> Attribute node."""
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            return "write"
+        parent = f.parent(node)
+        if isinstance(parent, ast.AugAssign) and parent.target is node:
+            return "write"
+        # self.d[k] = v / del self.d[k] / self.d[k][j] = v
+        cur, p = node, parent
+        while isinstance(p, ast.Subscript) and p.value is cur:
+            if isinstance(p.ctx, (ast.Store, ast.Del)):
+                return "write"
+            cur, p = p, f.parent(p)
+        if isinstance(p, ast.AugAssign) and p.target is cur \
+                and cur is not node:
+            return "write"          # self.d[k] += v
+        # self.attr.append(...) and friends
+        if isinstance(parent, ast.Attribute) and parent.value is node \
+                and parent.attr in MUTATORS:
+            gp = f.parent(parent)
+            if isinstance(gp, ast.Call) and gp.func is parent:
+                return "write"
+        return "read"
+
+    def _init_attr_kinds(self, fi):
+        """{attr: ('mutable', kind) | ('sync',)} from one __init__."""
+        from .callgraph import body_walk
+
+        out = {}
+        for node in body_walk(fi.node):
+            if not isinstance(node, ast.Assign) or \
+                    not isinstance(node.value, ast.Call):
+                continue
+            name = dotted_name(node.value.func)
+            if name is None:
+                continue
+            last = name.rsplit(".", 1)[-1]
+            for tgt in node.targets:
+                if not (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    continue
+                if last in _SYNC_CTORS:
+                    out[tgt.attr] = ("sync",)
+                elif last in MUTABLE_CALLS:
+                    out[tgt.attr] = ("mutable", last)
+        return out
+
+    def _collect_accesses(self):
+        from .callgraph import body_walk
+
+        for key, fi in self.cg.functions.items():
+            cls = self._enclosing_class(fi)
+            if cls is None:
+                continue
+            ckey = (fi.path, cls)
+            method = fi.qualname.rsplit(".", 1)[-1]
+            if method == "__init__":
+                kinds = self._init_attr_kinds(fi)
+                mut = self.mutable_attrs.setdefault(ckey, {})
+                syn = self.sync_attrs.setdefault(ckey, set())
+                for attr, kind in kinds.items():
+                    if kind[0] == "sync":
+                        syn.add(attr)
+                    else:
+                        mut[attr] = kind[1]
+                continue
+            f = fi.srcfile
+            entry = self.entry_locks.get(key, frozenset())
+            ann = guarded_by_lines(f)
+            table = self.classes.setdefault(ckey, {})
+            for node in body_walk(fi.node):
+                if not (isinstance(node, ast.Attribute)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id == "self"):
+                    continue
+                attr = node.attr
+                if attr.endswith(_SYNC_SUFFIXES):
+                    continue
+                kind = self._classify_access(f, node)
+                locks = set(entry) if key in self.concurrent \
+                    else set()
+                locks |= self._locks_enclosing(fi, node)
+                annotated = False
+                a = ann.get(getattr(node, "lineno", 0))
+                if a:
+                    locks.add(self._annotation_key(f, cls, a))
+                    annotated = True
+                table.setdefault(attr, []).append(Access(
+                    attr, node, kind == "write", key,
+                    frozenset(locks), annotated))
+
+    # -- the two rule queries ------------------------------------------------
+    def unguarded_shared_state(self):
+        """GL010 raw results:
+        [(srcfile, access, class name, guard lock key, root qualname)]
+        — one per (class, attr, method), first unguarded site wins."""
+        out = []
+        for (path, cls), table in sorted(self.classes.items()):
+            syn = self.sync_attrs.get((path, cls), set())
+            for attr, accesses in sorted(table.items()):
+                if attr in syn:
+                    continue
+                guarded_writes = [a for a in accesses
+                                  if a.write and a.locks]
+                if not guarded_writes:
+                    continue
+                guard = sorted(guarded_writes[0].locks)[0]
+                flagged_methods = set()
+                for a in sorted(accesses, key=lambda x: x.line):
+                    if a.locks or a.method_key not in self.concurrent:
+                        continue
+                    if a.method_key in flagged_methods:
+                        continue
+                    flagged_methods.add(a.method_key)
+                    fi = self.cg.functions[a.method_key]
+                    out.append((fi.srcfile, a, cls, guard,
+                                self.thread_root_of(a.method_key)))
+        return out
+
+    def inconsistent_guards(self):
+        """GL011a raw results: [(srcfile, access, class, lock menu)] —
+        attributes whose guarded writes share NO common lock."""
+        out = []
+        for (path, cls), table in sorted(self.classes.items()):
+            syn = self.sync_attrs.get((path, cls), set())
+            for attr, accesses in sorted(table.items()):
+                if attr in syn:
+                    continue
+                guarded_writes = sorted(
+                    (a for a in accesses if a.write and a.locks),
+                    key=lambda x: x.line)
+                if len(guarded_writes) < 2:
+                    continue
+                common = frozenset.intersection(
+                    *[a.locks for a in guarded_writes])
+                if common:
+                    continue
+                menu = sorted({lk for a in guarded_writes
+                               for lk in a.locks})
+                out.append((guarded_writes[0], cls, menu,
+                            [(a.line, sorted(a.locks))
+                             for a in guarded_writes]))
+        return out
+
+    def lock_region_escapes(self):
+        """GL011b raw results: [(srcfile, node, class, attr, kind, lock)]
+        — bare ``return self.<attr>`` / ``yield self.<attr>`` of a
+        mutable container inside the lock region that guards its
+        mutations elsewhere."""
+        from .callgraph import _region_walk
+
+        out = []
+        for fi in self.cg.functions.values():
+            cls = self._enclosing_class(fi)
+            if cls is None:
+                continue
+            ckey = (fi.path, cls)
+            mutable = self.mutable_attrs.get(ckey, {})
+            if not mutable:
+                continue
+            table = self.classes.get(ckey, {})
+            for (lockkey, w, _inner, _calls) in fi.lock_regions:
+                for node in _region_walk(w):
+                    if not isinstance(node, (ast.Return, ast.Yield)):
+                        continue
+                    v = node.value
+                    if not (isinstance(v, ast.Attribute)
+                            and isinstance(v.value, ast.Name)
+                            and v.value.id == "self"
+                            and v.attr in mutable):
+                        continue
+                    mutated_under = any(
+                        a.write and lockkey in a.locks
+                        for a in table.get(v.attr, ()))
+                    if not mutated_under:
+                        continue
+                    out.append((fi.srcfile, node, cls, v.attr,
+                                mutable[v.attr], lockkey))
+        out.sort(key=lambda t: (t[0].relpath, t[1].lineno))
+        return out
+
+
+def analysis_for(project):
+    """The per-project LocksetAnalysis, built once and shared by GL010
+    and GL011 (the same memoization discipline as the call graph)."""
+    la = getattr(project, "_lockset_analysis", None)
+    if la is None:
+        la = project._lockset_analysis = LocksetAnalysis(project)
+    return la

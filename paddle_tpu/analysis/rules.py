@@ -1,14 +1,17 @@
-"""graftlint rules GL001–GL009: framework-aware static checks.
+"""graftlint rules GL001–GL011: framework-aware static checks.
 
 Each rule encodes one invariant the runtime cannot cheaply enforce —
 trace purity, host-sync hygiene, registry/doc consistency, lock
 discipline, metric-name contract, span-name contract, lock-order
-consistency, recompile hygiene, mutable-global capture — as a pure
-AST/text check. Rules receive
+consistency, recompile hygiene, mutable-global capture, unguarded
+shared state, guarded-by consistency — as a pure AST/text check. Rules
+receive
 the whole :class:`~paddle_tpu.analysis.core.Project` so cross-file rules
 (GL003, GL005, GL006) see registrations and their catalogs together, and
-the interprocedural rules (GL001/GL002/GL004 propagation, GL007, GL008)
-share one :class:`~paddle_tpu.analysis.callgraph.CallGraph` per run via
+the interprocedural rules (GL001/GL002/GL004 propagation, GL007, GL008,
+and the GL010/GL011 lockset analysis in
+:mod:`~paddle_tpu.analysis.locksets`) share one
+:class:`~paddle_tpu.analysis.callgraph.CallGraph` per run via
 ``project.callgraph()``.
 
 The rationale for each rule lives in docs/static_analysis.md; the short
@@ -1283,8 +1286,106 @@ class MutableGlobalCapture(Rule):
         return out
 
 
+class UnguardedSharedState(Rule):
+    """GL010: a ``self.<attr>`` written under a lock anywhere in its
+    class but accessed lock-free from a thread-reachable method.
+
+    The write-under-lock is the author's own declaration that the field
+    is shared mutable state; the lock-free access from a method another
+    thread can reach is then a data race by the author's own contract.
+    The static lockset at an access is the union of the enclosing
+    ``with <lock>:`` regions, the locks provably held at every call site
+    on the thread path (the ``*_locked`` helper convention), and any
+    ``# guarded_by: <lock>`` annotation on the line. ``Finding.chain``
+    carries the thread-entry chain — the ``Thread(target=...)`` spawn
+    site and the call hops from it to the unguarded access — rendered by
+    ``--explain`` exactly like the GL001/GL002 propagation chains.
+    Deliberately lock-free fields (GIL-atomic monotonic counters,
+    append-only telemetry) take ``# graftlint: disable=GL010`` with a
+    rationale; externally synchronized ones take ``# guarded_by:``.
+    """
+
+    id = "GL010"
+    name = "unguarded-shared-state"
+    rationale = ("a field written under a lock is shared state by the "
+                 "author's own declaration; touching it lock-free from "
+                 "a thread-reachable method is a data race")
+
+    def check(self, project):
+        from .locksets import analysis_for
+
+        out = []
+        la = analysis_for(project)
+        for (srcfile, access, cls, guard, root) in \
+                la.unguarded_shared_state():
+            method = la.cg.functions[access.method_key].qualname
+            kind = "written" if access.write else "read"
+            out.append(self.finding(
+                srcfile, access.node,
+                f"'self.{access.attr}' of class '{cls}' is written "
+                f"under lock '{_lk(guard)}' elsewhere but {kind} "
+                f"lock-free in '{method}', which runs on a thread "
+                f"spawned via '{root}' — hold the lock here, or mark "
+                "the line '# guarded_by: <lock>' if it is synchronized "
+                "externally",
+                chain=la.thread_chain(access.method_key)))
+        return out
+
+
+class GuardedByInconsistency(Rule):
+    """GL011: lock/field associations that are internally contradictory.
+
+    (a) the guarded writes of one attribute hold locksets with an empty
+    common intersection — two sites each "hold a lock", but not the
+    *same* lock, so neither excludes the other (this also catches a
+    ``# guarded_by:`` annotation naming a lock the real writes never
+    hold); (b) a mutable container built in ``__init__`` and mutated
+    under a lock escapes that lock's region via a bare
+    ``return self.<attr>`` / ``yield self.<attr>`` — the caller iterates
+    or mutates the live object after the lock is released. Return a
+    snapshot (``list(...)``, ``dict(...)``) instead.
+    """
+
+    id = "GL011"
+    name = "guarded-by-inconsistency"
+    rationale = ("a field guarded by different locks at different sites "
+                 "is guarded by none; a mutable structure returned from "
+                 "inside its lock region escapes the lock")
+
+    def check(self, project):
+        from .locksets import analysis_for
+
+        out = []
+        la = analysis_for(project)
+        for (access, cls, menu, sites) in la.inconsistent_guards():
+            fi = la.cg.functions[access.method_key]
+            chain = tuple(
+                f"write under {{{', '.join(_lk(l) for l in locks)}}} "
+                f"at {fi.path}:{line}"
+                for (line, locks) in sites)
+            out.append(self.finding(
+                fi.srcfile, access.node,
+                f"'self.{access.attr}' of class '{cls}' is guarded by "
+                f"different locks at different write sites "
+                f"({', '.join(_lk(l) for l in menu)} — no common "
+                "lock): every writer must hold the same lock for "
+                "mutual exclusion to mean anything",
+                chain=chain))
+        for (srcfile, node, cls, attr, kind, lockkey) in \
+                la.lock_region_escapes():
+            out.append(self.finding(
+                srcfile, node,
+                f"mutable {kind} 'self.{attr}' of class '{cls}' "
+                f"escapes the '{_lk(lockkey)}' region via a bare "
+                "return/yield while being mutated under that lock "
+                "elsewhere — the caller sees live unlocked state; "
+                "return a copy instead"))
+        return out
+
+
 ALL_RULES = (TraceImpurity(), HostSync(), RegistryConsistency(),
              LockDiscipline(), MetricNameContract(), SpanNameContract(),
-             LockOrder(), RecompileHazard(), MutableGlobalCapture())
+             LockOrder(), RecompileHazard(), MutableGlobalCapture(),
+             UnguardedSharedState(), GuardedByInconsistency())
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}

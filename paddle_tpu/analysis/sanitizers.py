@@ -1,8 +1,9 @@
 """graftsan: opt-in runtime sanitizers for the hazards graftlint can only
 approximate statically.
 
-Three sanitizers, enabled via ``PADDLE_TPU_SANITIZE=lock,recompile,hostsync``
-(or ``all``) at process start, or programmatically with :func:`enable`:
+Four sanitizers, enabled via
+``PADDLE_TPU_SANITIZE=lock,recompile,hostsync,race`` (or ``all``) at
+process start, or programmatically with :func:`enable`:
 
 - **lock** — a lock-order witness (the dynamic twin of GL007): the stack's
   known locks are wrapped so every acquisition-while-holding records an
@@ -23,6 +24,15 @@ Three sanitizers, enabled via ``PADDLE_TPU_SANITIZE=lock,recompile,hostsync``
   ``trace.training_step`` / ``serving`` span — or any
   :func:`protected_region` — raises :class:`HostSyncInProtectedRegion`.
   Reads wrapped in :func:`allow_host_sync` are sanctioned.
+- **race** — a data-race witness (the dynamic twin of GL010): instrumented
+  hot classes (the serving engine's stats/span tables, FleetRouter,
+  SLOTracker, CheckpointManager) report field accesses via
+  :func:`race_access`; an Eraser-style candidate-lockset intersection over
+  the SanitizedLock held-set per (owner, field) raises :class:`DataRace`
+  when a mutated field's candidate set empties — both conflicting access
+  stacks named, no lucky-timing crash required. Enabling ``race`` makes
+  :func:`new_lock` return sanitized locks (held-set maintenance) even when
+  the order witness is off.
 
 Discipline matches monitor/trace: **disabled by default**, every guard is
 one slot load on a preallocated ``_state`` object, nothing is wrapped or
@@ -47,16 +57,17 @@ import traceback
 
 __all__ = [
     "SanitizerError", "LockOrderInversion", "RecompileStorm",
-    "HostSyncInProtectedRegion", "BlockingWaitUnderLock",
+    "HostSyncInProtectedRegion", "BlockingWaitUnderLock", "DataRace",
     "enable", "disable", "enabled", "install_from_env", "reset",
     "SanitizedLock", "new_lock", "wrap_lock", "lock_order_edges",
     "check_wait",
     "note_compile", "compile_counts", "recompile_threshold",
     "set_recompile_threshold",
     "protected_region", "allow_host_sync", "trips",
+    "race_access", "race_fields",
 ]
 
-_KINDS = ("lock", "recompile", "hostsync")
+_KINDS = ("lock", "recompile", "hostsync", "race")
 
 
 class SanitizerError(RuntimeError):
@@ -79,16 +90,27 @@ class BlockingWaitUnderLock(SanitizerError):
     """A declared blocking wait ran while holding a sanitized lock."""
 
 
-class _State:
-    """One slot load per guard when disabled — the monitor discipline."""
+class DataRace(SanitizerError):
+    """An instrumented field's candidate lockset emptied while mutated —
+    two threads touch it with no common lock."""
 
-    __slots__ = ("on", "lock", "recompile", "hostsync")
+
+class _State:
+    """One slot load per guard when disabled — the monitor discipline.
+    ``locktrack`` is the derived held-set-maintenance flag: on when the
+    order witness OR the race witness needs to know which sanitized
+    locks each thread holds."""
+
+    __slots__ = ("on", "lock", "recompile", "hostsync", "race",
+                 "locktrack")
 
     def __init__(self):
         self.on = False
         self.lock = False
         self.recompile = False
         self.hostsync = False
+        self.race = False
+        self.locktrack = False
 
 
 _state = _state_singleton = _State()
@@ -99,6 +121,11 @@ _tls = threading.local()
 _graph_lock = threading.Lock()
 _edges = {}          # (held, acquired) -> first-witness stack (str)
 _trips = []          # [(kind, message)] — test/postmortem introspection
+
+# -- race witness -------------------------------------------------------------
+
+_race_lock = threading.Lock()
+_fields = {}         # (owner, field) -> _FieldAccess
 
 # -- recompile sentinel -------------------------------------------------------
 
@@ -124,7 +151,7 @@ def enabled(kind=None):
 
 
 def enable(*kinds):
-    """Enable sanitizers (all three when called bare). Module-level monitor
+    """Enable sanitizers (all four when called bare). Module-level monitor
     locks are wrapped now; locks constructed AFTER this call pick up
     wrapping via :func:`new_lock` at their construction sites."""
     kinds = kinds or _KINDS
@@ -133,7 +160,8 @@ def enable(*kinds):
             raise ValueError(f"unknown sanitizer {k!r} (known: {_KINDS})")
         setattr(_state, k, True)
     _state.on = True
-    if _state.lock:
+    _state.locktrack = _state.lock or _state.race
+    if _state.locktrack:
         _wrap_known_locks()
     if _state.hostsync:
         _install_hook()
@@ -146,7 +174,9 @@ def disable(*kinds):
         if k not in _KINDS:
             raise ValueError(f"unknown sanitizer {k!r} (known: {_KINDS})")
         setattr(_state, k, False)
-    _state.on = _state.lock or _state.recompile or _state.hostsync
+    _state.on = (_state.lock or _state.recompile or _state.hostsync
+                 or _state.race)
+    _state.locktrack = _state.lock or _state.race
     if not _state.hostsync:
         _uninstall_hook()
 
@@ -185,6 +215,8 @@ def reset():
     isolation). Enable state is untouched."""
     with _graph_lock:
         _edges.clear()
+    with _race_lock:
+        _fields.clear()
     with _recompile_lock:
         _compiles.clear()
         _signatures.clear()
@@ -247,7 +279,9 @@ class SanitizedLock:
         if _state.lock:
             self._witness()
         ok = self._inner.acquire(blocking, timeout)
-        if ok and _state.lock:
+        if ok and _state.locktrack:
+            # the race witness reads this held-set too, so maintenance
+            # stays on whenever either consumer is enabled
             _held().append(self.name)
         return ok
 
@@ -312,7 +346,7 @@ def new_lock(name, factory=threading.Lock):
     (watchdog, registry): sanitized when the lock sanitizer is on at
     construction, a plain lock (zero overhead) otherwise."""
     inner = factory()
-    return SanitizedLock(name, inner) if _state.lock else inner
+    return SanitizedLock(name, inner) if _state.locktrack else inner
 
 
 def wrap_lock(name, lock):
@@ -343,10 +377,14 @@ def check_wait(site):
 
 
 def _wrap_known_locks():
-    """Swap the module-level monitor/trace locks for sanitized proxies.
-    Instrument sites reference the module globals by name, so the swap
-    takes effect everywhere at once. Lazy: pulls in the monitor package
-    (already imported in any running process)."""
+    """Swap the module-level monitor/trace/obs-server locks for sanitized
+    proxies. Instrument sites reference the module globals by name, so the
+    swap takes effect everywhere at once. Lazy: pulls in the monitor
+    package (already imported in any running process). Instance locks in
+    the fleet/checkpoint tier (FleetRouter, SLOTracker, per-metric
+    Registry locks, the checkpoint writer's error lock) are constructed
+    through :func:`new_lock` and pick up wrapping at construction — enable
+    sanitizers before building the objects you want witnessed."""
     try:
         from .. import monitor as _m
         from ..monitor import trace as _t
@@ -361,6 +399,91 @@ def _wrap_known_locks():
                                       _m.registry._lock)
     except Exception:  # noqa: BLE001 — partial bootstrap must not fail
         pass
+    try:
+        # the obs-server module lock guards the scrape/statusz section
+        # registry from request-handler threads (also import-time state)
+        from ..monitor import server as _srv
+
+        _srv._lock = wrap_lock("monitor.server._lock", _srv._lock)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+# -- race witness -------------------------------------------------------------
+
+class _FieldAccess:
+    """Eraser state for one (owner, field): ``exclusive`` while a single
+    thread touches it (initialization), then ``shared``/``shared_mod``
+    with a candidate lockset that intersects toward the truth."""
+
+    __slots__ = ("state", "tid", "written", "lockset", "stack",
+                 "stack_locks", "tripped")
+
+    def __init__(self, tid, written):
+        self.state = "exclusive"
+        self.tid = tid
+        self.written = written
+        self.lockset = None         # TOP until a second thread arrives
+        self.stack = None           # first conflicting-access stack
+        self.stack_locks = None
+        self.tripped = False
+
+
+def race_access(owner, field, write=False):
+    """One access to an instrumented shared field. ``owner`` names the
+    instance (the engine's ``_san_tag``, ``fleet.<tag>``), ``field`` the
+    attribute. Per (owner, field), the candidate lockset starts at TOP
+    during single-threaded initialization and intersects with the
+    caller's sanitized-lock held-set on every access once a second
+    thread arrives (Eraser). An empty candidate set on a written field
+    raises :class:`DataRace` naming BOTH conflicting stacks — the first
+    cross-thread access and this one. Disabled cost: one slot load."""
+    if not _state.race:
+        return
+    held = frozenset(_held())
+    me = threading.get_ident()
+    trip = None
+    with _race_lock:
+        fa = _fields.get((owner, field))
+        if fa is None:
+            _fields[(owner, field)] = _FieldAccess(me, write)
+            return
+        if fa.state == "exclusive" and fa.tid == me:
+            fa.written = fa.written or write
+            return
+        if fa.state == "exclusive":
+            # second thread: initialization is over, constraints begin
+            fa.state = "shared_mod" if (write or fa.written) else "shared"
+            fa.lockset = set(held)
+            fa.stack = "".join(traceback.format_stack(limit=12))
+            fa.stack_locks = held
+        else:
+            fa.lockset &= held
+            if write and fa.state == "shared":
+                fa.state = "shared_mod"
+        if fa.state == "shared_mod" and not fa.lockset \
+                and not fa.tripped:
+            fa.tripped = True     # one report per field, not a cascade
+            here = "".join(traceback.format_stack(limit=12))
+            trip = (
+                f"data race on '{field}' of '{owner}': the candidate "
+                "lockset is EMPTY for a written shared field — no "
+                "single lock is held at every access, so two threads "
+                "can interleave on it.\n"
+                f"-- first cross-thread access (held "
+                f"{sorted(fa.stack_locks or ())}):\n{fa.stack}\n"
+                f"-- this access (held {sorted(held)}):\n{here}")
+    if trip is not None:
+        _trip(DataRace, "race", trip)
+
+
+def race_fields():
+    """Snapshot: {(owner, field): (state, sorted candidate locks|None)}
+    for every instrumented field seen while enabled."""
+    with _race_lock:
+        return {k: (fa.state,
+                    None if fa.lockset is None else sorted(fa.lockset))
+                for k, fa in _fields.items()}
 
 
 # -- recompile sentinel -------------------------------------------------------

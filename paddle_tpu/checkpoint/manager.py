@@ -56,6 +56,24 @@ except ImportError:  # pragma: no cover - tools/ckpt_inspect.py path-load
         def fire(point):
             return None
 
+try:  # graftsan witnesses (lock order + data race); inert when path-loaded
+    from ..analysis import sanitizers as _san
+except ImportError:  # pragma: no cover - tools/ckpt_inspect.py path-load
+
+    class _san:  # noqa: N801 - module-shaped stub
+        @staticmethod
+        def new_lock(name, factory=threading.Lock):
+            return factory()
+
+        @staticmethod
+        def race_access(owner, field, write=False):
+            return None
+
+import itertools as _itertools
+
+# per-manager tag for the graftsan race witness (owner identity)
+_CKPT_SEQ = _itertools.count(1)
+
 
 __all__ = [
     "CheckpointError", "CheckpointCorrupt", "NoCheckpoint",
@@ -295,7 +313,10 @@ class CheckpointManager:
         self._pending = queue.Queue(maxsize=1)  # + 1 in flight = 2 buffers
         self._writer = None
         self._errors = []
-        self._err_lock = threading.Lock()
+        # graftsan-witnessed when sanitizers are enabled at construction
+        self._err_lock = _san.new_lock(
+            "checkpoint.CheckpointManager._err_lock")
+        self._san_tag = f"ckpt{next(_CKPT_SEQ)}"
         self._clean_stale_tmp()
 
     # -- save ----------------------------------------------------------------
@@ -351,6 +372,8 @@ class CheckpointManager:
                 self._write(job)
             except BaseException as e:  # surfaced by wait()
                 with self._err_lock:
+                    _san.race_access(self._san_tag, "_errors",
+                                     write=True)
                     self._errors.append(e)
             finally:
                 self._pending.task_done()
@@ -485,6 +508,7 @@ class CheckpointManager:
         time)."""
         self._pending.join()
         with self._err_lock:
+            _san.race_access(self._san_tag, "_errors", write=True)
             errors, self._errors = self._errors, []
         if errors:
             raise errors[0]

@@ -141,7 +141,9 @@ class PSServer:
             # the in-flight accept() holds the listening fd until its timeout
             # expires; wait so the port is genuinely free on return
             self._stopped.wait(timeout=2.0)
-        for t in self._sparse.values():
+        with self._lock:
+            tables = list(self._sparse.values())
+        for t in tables:
             if hasattr(t, "close"):  # SSD tier: flush + drop temp spill file
                 try:
                     t.close()
@@ -174,6 +176,13 @@ class PSServer:
         finally:
             conn.close()
 
+    def _table(self, kind, name):
+        """One registered table, looked up under the registration lock —
+        handler threads pull/push concurrently with registrations from
+        late-joining trainers' own connections."""
+        with self._lock:
+            return (self._dense if kind == "dense" else self._sparse)[name]
+
     def _dispatch(self, cmd, p):
         if cmd == _CMD_REGISTER_DENSE:
             name, init_value, opt_cfg, trainers, sync = p
@@ -188,13 +197,13 @@ class PSServer:
             return t.version
         if cmd == _CMD_PULL_DENSE:
             name, min_version = p
-            return self._dense[name].pull(min_version)
+            return self._table("dense", name).pull(min_version)
         if cmd == _CMD_PUSH_DENSE:
             name, grad, lr = p
-            return self._dense[name].push_grad(grad, lr)
+            return self._table("dense", name).push_grad(grad, lr)
         if cmd == _CMD_SET_DENSE:
             name, value = p
-            self._dense[name].set_value(value)
+            self._table("dense", name).set_value(value)
             return None
         if cmd == _CMD_REGISTER_SPARSE:
             name, dim, opt_cfg, init_scale, seed, trainers, sync = p[:7]
@@ -220,10 +229,10 @@ class PSServer:
             return None
         if cmd == _CMD_PULL_SPARSE:
             name, ids = p
-            return self._sparse[name].pull(ids)
+            return self._table("sparse", name).pull(ids)
         if cmd == _CMD_PUSH_SPARSE:
             name, ids, grads, lr = p
-            self._sparse[name].push_grad(ids, grads, lr)
+            self._table("sparse", name).push_grad(ids, grads, lr)
             return None
         if cmd == _CMD_BARRIER:
             key, n = p

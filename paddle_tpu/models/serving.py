@@ -404,7 +404,10 @@ class ContinuousBatchingEngine:
         # jax dispatch runs under it (GL004)
         self._tenants = {"": _Tenant("")}
         self._vnow = 0.0                # WFQ virtual clock (last pop)
-        self._submit_lock = threading.Lock()
+        # graftsan-witnessed (lock order + the race witness's held-set)
+        # when sanitizers are enabled at construction
+        self._submit_lock = _sanitizers.new_lock(
+            f"serving.engine[{self._san_tag}]._submit_lock")
         # per-request trace trees (monitor.trace): rid -> [root, queue_wait]
         self._req_spans = {}
         # per-request stats kept for the caller (bench TTFT percentiles);
@@ -645,6 +648,8 @@ class ContinuousBatchingEngine:
                     if mon.tstate.on:
                         root = mon.trace.start_span(
                             "serving.request", attrs={"rid": rid})
+                        _sanitizers.race_access(self._san_tag,
+                                                "_req_spans", write=True)
                         self._req_spans[rid] = [
                             root, mon.trace.start_span("serving.queue_wait",
                                                        parent=root)]
@@ -749,12 +754,13 @@ class ContinuousBatchingEngine:
         mon = _mon()
         req.t_admit = mon.mod.now_ns()
         L = len(req.prompt)
-        if req.rid not in self._req_spans and mon.tstate.on:
-            # add_request path: the root opens at admission (no queue wait)
-            self._req_spans[req.rid] = [
-                mon.trace.start_span("serving.request",
-                                     attrs={"rid": req.rid}), None]
-        entry = self._req_spans.get(req.rid)
+        with self._submit_lock:
+            if req.rid not in self._req_spans and mon.tstate.on:
+                # add_request path: root opens at admission (no queue wait)
+                self._req_spans[req.rid] = [
+                    mon.trace.start_span("serving.request",
+                                         attrs={"rid": req.rid}), None]
+            entry = self._req_spans.get(req.rid)
         if entry is not None and entry[1] is not None:
             mon.trace.end_span(entry[1], t1_ns=req.t_admit)
             entry[1] = None
@@ -785,12 +791,15 @@ class ContinuousBatchingEngine:
         self._chain_cursors.pop(slot, None)
         if self._drafter is not None:
             self._drafter.admit(req.rid, req.prompt)
-        self._stats[req.rid] = {
-            "rid": req.rid, "slot": slot, "prompt_len": L,
-            "tenant": req.tenant,
-            "shared_tokens": req.shared_tokens, "submit_ns": req.t_submit}
-        if len(self._stats) > 4096:
-            self._stats.popitem(last=False)
+        with self._submit_lock:
+            _sanitizers.race_access(self._san_tag, "_stats", write=True)
+            self._stats[req.rid] = {
+                "rid": req.rid, "slot": slot, "prompt_len": L,
+                "tenant": req.tenant,
+                "shared_tokens": req.shared_tokens,
+                "submit_ns": req.t_submit}
+            if len(self._stats) > 4096:
+                self._stats.popitem(last=False)
         if mon.state.on:
             mon.admitted.inc()
             self._update_gauges(mon)
@@ -799,7 +808,18 @@ class ContinuousBatchingEngine:
         """Per-request stats (ttft_ns, prefill chunks, shared prefix
         tokens), retained until popped — the bench reads TTFT percentiles
         from here after each eviction."""
-        return self._stats.pop(rid, None)
+        with self._submit_lock:
+            _sanitizers.race_access(self._san_tag, "_stats", write=True)
+            return self._stats.pop(rid, None)
+
+    def _span_entry(self, rid):
+        """The [root, queue_wait] span pair of one in-flight request.
+        The returned list is mutated only by the driving thread; the
+        table itself is shared with submit/abort and stays under the
+        submit lock."""
+        with self._submit_lock:
+            _sanitizers.race_access(self._san_tag, "_req_spans")
+            return self._req_spans.get(rid)
 
     def status(self):
         """The engine's graftscope ``/statusz`` section: host-readable
@@ -879,7 +899,8 @@ class ContinuousBatchingEngine:
             self._drafter.drop(req.rid)   # _restore re-admits the context
         self._requeue_front(req)
         if mon.tstate.on:
-            entry = self._req_spans.get(req.rid)
+            with self._submit_lock:
+                entry = self._req_spans.get(req.rid)
             mon.trace.record_span(
                 "serving.preempt", t0, mon.mod.now_ns(),
                 parent=None if entry is None else entry[0],
@@ -932,15 +953,16 @@ class ContinuousBatchingEngine:
                 [req.prompt, np.asarray(req.outputs, np.int32)])
             self._drafter.drop(req.rid)
             self._drafter.admit(req.rid, ctx)
-        st = self._stats.get(req.rid)
-        if st is None:
-            st = self._stats[req.rid] = {
-                "rid": req.rid, "prompt_len": len(req.prompt),
-                "tenant": req.tenant,
-                "shared_tokens": req.shared_tokens,
-                "submit_ns": req.t_submit}
-        st["slot"] = slot
-        st["restored"] = True
+        with self._submit_lock:
+            st = self._stats.get(req.rid)
+            if st is None:
+                st = self._stats[req.rid] = {
+                    "rid": req.rid, "prompt_len": len(req.prompt),
+                    "tenant": req.tenant,
+                    "shared_tokens": req.shared_tokens,
+                    "submit_ns": req.t_submit}
+            st["slot"] = slot
+            st["restored"] = True
         if mon.state.on:
             self._update_gauges(mon)
         return True
@@ -1314,13 +1336,13 @@ class ContinuousBatchingEngine:
         t1 = mon.mod.now_ns()
         if mon.tstate.on:
             for b in decode_slots:
-                entry = self._req_spans.get(self._slots[b].rid)
+                entry = self._span_entry(self._slots[b].rid)
                 if entry is not None:
                     mon.trace.record_span(
                         "serving.decode_step", t0, t1, parent=entry[0],
                         attrs={"slot": int(b), "n_active": nd})
             for b, start, take in chunks:
-                entry = self._req_spans.get(self._slots[b].rid)
+                entry = self._span_entry(self._slots[b].rid)
                 if entry is not None:
                     mon.trace.record_span(
                         "serving.prefill_chunk", t0, t1, parent=entry[0],
@@ -1388,15 +1410,16 @@ class ContinuousBatchingEngine:
                 req.t_first = t1
                 self._decode_ready[b] = True
                 emitted += 1
-                st = self._stats.get(req.rid)
-                if st is not None:
-                    st["ttft_ns"] = t1 - req.t_submit
-                    st["prefill_chunks"] = req.chunks
+                with self._submit_lock:
+                    st = self._stats.get(req.rid)
+                    if st is not None:
+                        st["ttft_ns"] = t1 - req.t_submit
+                        st["prefill_chunks"] = req.chunks
                 if mon.state.on:
                     mon.ttft.observe(t1 - req.t_submit)
                     mon.prefill.observe(t1 - req.t_admit)
                     mon.chunk_depth.observe(req.chunks)
-                entry = self._req_spans.get(req.rid)
+                entry = self._span_entry(req.rid)
                 if entry is not None:
                     mon.trace.record_span(
                         "serving.prefill", req.t_admit, t1,
@@ -1540,7 +1563,7 @@ class ContinuousBatchingEngine:
         nd = len(decode_slots)
         if mon.tstate.on:
             for b in decode_slots:
-                entry = self._req_spans.get(self._slots[b].rid)
+                entry = self._span_entry(self._slots[b].rid)
                 if entry is not None:
                     mon.trace.record_span(
                         "serving.decode_step", t0, t1, parent=entry[0],
@@ -1584,11 +1607,15 @@ class ContinuousBatchingEngine:
     def _evict(self, slot, t0=None):
         mon = _mon()
         req = self._slots[slot]
-        entry = self._req_spans.pop(req.rid, None)
+        with self._submit_lock:
+            _sanitizers.race_access(self._san_tag, "_req_spans",
+                                    write=True)
+            _sanitizers.race_access(self._san_tag, "_stats", write=True)
+            entry = self._req_spans.pop(req.rid, None)
+            st = self._stats.get(req.rid)
+            if st is not None:
+                st["tokens"] = len(req.outputs)
         t0 = t0 or (mon.mod.now_ns() if entry is not None else 0)
-        st = self._stats.get(req.rid)
-        if st is not None:
-            st["tokens"] = len(req.outputs)
         # last chance to chain the generation's tail blocks: a finishing
         # request's final block-crossings happen inside the same routing
         # loop that evicts it, so register (and pin) them before the row
@@ -1615,10 +1642,12 @@ class ContinuousBatchingEngine:
 
     def _update_gauges(self, mon):
         depth = 0
-        for t in list(self._tenants.values()):
-            n = len(t.queue)
+        with self._submit_lock:
+            lanes = [(t.name, len(t.queue))
+                     for t in self._tenants.values()]
+        for name, n in lanes:
             depth += n
-            mon.tenant_depth.labels(t.name).set(n)
+            mon.tenant_depth.labels(name).set(n)
         mon.queue_depth.set(depth)
         mon.occupancy.set(float(self._active.sum()) / self.max_batch)
         mon.pool_bytes.set(self.kv_pool_bytes)
@@ -1665,7 +1694,8 @@ class ContinuousBatchingEngine:
             req = self._slots[b]
             if req is not None and req.rid in rids:
                 self._evict(b)          # frees blocks; no result emitted
-                self._stats.pop(req.rid, None)
+                with self._submit_lock:
+                    self._stats.pop(req.rid, None)
                 n += 1
         if n:
             self.cancelled += n
@@ -1763,16 +1793,17 @@ class ContinuousBatchingEngine:
                 # callers track the replacement) so a router can merge
                 # ttft/chunks/shared into the re-routed request's final
                 # stats and fleet TTFT percentiles stay honest
-                st = self._stats.pop(req.rid, None)
-                if st is not None:
-                    st["aborted"] = True
-                    st["tokens"] = len(req.outputs)
+                with self._submit_lock:
+                    st = self._stats.pop(req.rid, None)
+                    if st is not None:
+                        st["aborted"] = True
+                        st["tokens"] = len(req.outputs)
+                    entry = self._req_spans.pop(req.rid, None)
                 self._aborted.append(RequestAborted(
                     f"request {req.rid} aborted by engine recovery: "
                     f"{reason}", rid=req.rid, tokens=req.outputs,
                     tenant=req.tenant, stats=st))
                 aborted += 1
-                entry = self._req_spans.pop(req.rid, None)
                 if entry is not None:
                     mon.trace.drop(entry[1])
                     mon.trace.end_span(entry[0])

@@ -306,8 +306,8 @@ METRICS = {
     "paddle_tpu_monitor_sanitizer_trips_total": (
         "counter", ("sanitizer",),
         "graftsan sanitizer trips (lock-order inversion, recompile storm, "
-        "host-sync-in-span), labeled by sanitizer; each trip also raises "
-        "and flight-dumps (docs/sanitizers.md)."),
+        "host-sync-in-span, data race), labeled by sanitizer; each trip "
+        "also raises and flight-dumps (docs/sanitizers.md)."),
     "paddle_tpu_monitor_fault_injections_total": (
         "counter", ("point",),
         "Fault-injection trips (analysis/faultinject.py, "
@@ -489,9 +489,9 @@ SPANS = {
     # -- graftsan (analysis/sanitizers.py) -------------------------------
     "monitor.sanitizer_trip": (
         "One graftsan trip (lock-order inversion / recompile storm / "
-        "host-sync-in-span), recorded at raise time so the flight dump "
-        "shows WHERE in the request/step timeline the hazard fired. "
-        "attrs: sanitizer."),
+        "host-sync-in-span / data race), recorded at raise time so the "
+        "flight dump shows WHERE in the request/step timeline the hazard "
+        "fired. attrs: sanitizer."),
     "monitor.fault_injection": (
         "One fault-injection trip (analysis/faultinject.py), recorded "
         "at fire time so a chaos run's trace shows where the drill hit. "

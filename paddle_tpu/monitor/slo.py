@@ -35,11 +35,16 @@ control loops belong to the router's own breaker machinery
 from __future__ import annotations
 
 import collections
+import itertools
 import time
 
 from ..analysis.sanitizers import new_lock as _new_lock
+from ..analysis.sanitizers import race_access as _race_access
 
 __all__ = ["Objective", "SLOTracker", "serving_objectives"]
+
+# per-tracker tag for the graftsan race witness (owner identity)
+_SLO_SEQ = itertools.count(1)
 
 
 class Objective:
@@ -130,6 +135,7 @@ class SLOTracker:
         self._alerting = set()          # (objective, tenant) currently firing
         self.alerts = collections.deque(maxlen=256)
         self._lock = _new_lock("monitor.slo.SLOTracker")
+        self._san_tag = f"slo{next(_SLO_SEQ)}"
         self._mon = None
         self._last_scan_t = None
         self._last_rows = []
@@ -147,6 +153,7 @@ class SLOTracker:
         sec = int(self._now())
         key = (objective, str(tenant))
         with self._lock:
+            _race_access(self._san_tag, "_buckets", write=True)
             dq = self._buckets.get(key)
             if dq is None:
                 dq = self._buckets[key] = collections.deque()
@@ -196,6 +203,7 @@ class SLOTracker:
         obj = self.objectives[objective]
         now = self._now() if now is None else now
         with self._lock:
+            _race_access(self._san_tag, "_buckets")
             dq = self._buckets.get((objective, str(tenant)))
             if not dq:
                 return 0.0
@@ -228,6 +236,7 @@ class SLOTracker:
             if min_interval_s and self._last_scan_t is not None \
                     and now - self._last_scan_t < min_interval_s:
                 return list(self._last_rows)
+            _race_access(self._san_tag, "_buckets")
             keys = list(self._buckets)
         rows = []
         edges = []          # (series, fast, slow) export OUTSIDE the lock
@@ -238,6 +247,7 @@ class SLOTracker:
             if obj is None:
                 continue
             with self._lock:
+                _race_access(self._san_tag, "_buckets", write=True)
                 dq = self._buckets.get(key)
                 if dq is None:
                     # a concurrent scan dropped this series between the

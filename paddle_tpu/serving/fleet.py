@@ -70,6 +70,7 @@ import numpy as np
 
 from ..analysis import faultinject as _fi
 from ..analysis.sanitizers import new_lock as _new_lock
+from ..analysis.sanitizers import race_access as _race_access
 from ..models.serving import ContinuousBatchingEngine
 
 __all__ = ["FleetRouter", "FleetUnavailable",
@@ -83,6 +84,10 @@ DRAINING = "draining"    # admission stopped, finishing in-flight work
 PARKED = "parked"        # drained and idle (rolling-restart slot)
 
 _STATE_CODE = {HEALTHY: 0, SUSPECT: 1, DOWN: 2, DRAINING: 3, PARKED: 4}
+
+# per-router tag for the graftsan race witness: two routers in one
+# process must not share (owner, field) candidate-lockset state
+_FLEET_SEQ = itertools.count(1)
 
 
 class FleetUnavailable(RuntimeError):
@@ -274,6 +279,7 @@ class FleetRouter:
         # counters; engine calls that can block (submit) or dispatch
         # never run under it
         self._lock = _new_lock("serving.fleet.FleetRouter")
+        self._san_tag = f"fleet{next(_FLEET_SEQ)}"
         self._frids = itertools.count()
         self._requests = {}             # frid -> _FleetRequest (in flight)
         self._results = collections.deque(maxlen=65536)
@@ -407,6 +413,7 @@ class FleetRouter:
                 # a request the driver already finished (the claimed-
                 # result race) must not re-enter the ledger: nothing
                 # would ever remove it again
+                _race_access(self._san_tag, "_requests", write=True)
                 self._requests[frid] = fr
         self.requests_total += 1
         if mon.state.on:
@@ -453,6 +460,7 @@ class FleetRouter:
             self._submit_attempt(att, rep=rep)
             with self._lock:
                 if not fr.done:
+                    _race_access(self._san_tag, "_requests", write=True)
                     self._requests[frid] = fr
             frs.append(fr)
         deadline = time.monotonic() + timeout
@@ -642,6 +650,7 @@ class FleetRouter:
                     mon.hedge_wins.inc()
             if loser is not None and loser.rep is not None:
                 self._cancel_attempt_locked(loser.rep, loser.rid)
+        _race_access(self._san_tag, "_requests", write=True)
         self._requests.pop(fr.frid, None)
         self._merge_stats_locked(fr, st, hedged)
         self._results.append((fr.frid, fr.tokens))
@@ -668,6 +677,7 @@ class FleetRouter:
                 return
             fr.done = True
             fr.tokens = list(att.prefix)
+            _race_access(self._san_tag, "_requests", write=True)
             self._requests.pop(fr.frid, None)
             self._merge_stats_locked(fr, None, False, completed=False)
             self._results.append((fr.frid, fr.tokens))
@@ -901,6 +911,7 @@ class FleetRouter:
         duplicate on a second replica; first finisher wins."""
         todo = []
         with self._lock:
+            _race_access(self._san_tag, "_requests")
             live_hedges = sum(1 for fr in self._requests.values()
                               if fr.hedge is not None and not fr.done)
             budget = self.max_hedges - live_hedges
@@ -1142,6 +1153,7 @@ class FleetRouter:
     @property
     def num_inflight(self):
         with self._lock:
+            _race_access(self._san_tag, "_requests")
             return len(self._requests)
 
     @property

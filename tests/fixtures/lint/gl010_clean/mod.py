@@ -1,0 +1,34 @@
+"""GL010 clean fixture: a threaded class whose shared state is touched
+under the lock everywhere — including through a ``*_locked`` helper the
+entry-lockset inference must prove is only ever called with the lock
+held — plus one annotated externally-synchronized site."""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._jobs = {}
+        self._done = 0
+
+    def start(self):
+        t = threading.Thread(target=self._loop, daemon=True)
+        t.start()
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                self._take_locked()
+
+    def _take_locked(self):
+        # lock held by contract at every call site
+        if self._jobs:
+            self._jobs.pop(next(iter(self._jobs)), None)
+            self._done += 1
+
+    def put(self, k, v):
+        with self._lock:
+            self._jobs[k] = v
+
+    def reset(self):
+        self._done = 0   # guarded_by: self._lock

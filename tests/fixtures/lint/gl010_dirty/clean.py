@@ -1,0 +1,52 @@
+"""The post-fix shapes of the same two routers: every touch of the
+shared tables happens under the router lock, so GL010 stays silent."""
+import threading
+
+
+class GapRouterFixed:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rid2att = {}
+
+    def start(self):
+        t = threading.Thread(target=self._submit_loop, daemon=True)
+        t.start()
+        a = threading.Thread(target=self._abort_loop, daemon=True)
+        a.start()
+
+    def _submit_loop(self):
+        rid = 0
+        while True:
+            rid += 1
+            att = object()
+            with self._lock:
+                self._rid2att[rid] = att
+
+    def _abort_loop(self):
+        while True:
+            with self._lock:
+                self._rid2att.pop(1, None)
+
+
+class ExternallySynced:
+    """A deliberately lock-free field published through an external
+    synchronizer: the guarded_by annotation names the protecting lock,
+    which both silences GL010 and keeps GL011's consistency check
+    honest."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._view = {}
+
+    def start(self):
+        t = threading.Thread(target=self._refresh_loop, daemon=True)
+        t.start()
+
+    def _refresh_loop(self):
+        while True:
+            with self._lock:
+                self._view["x"] = 1
+            self.rebuild()
+
+    def rebuild(self):
+        self._view = {"x": 0}   # guarded_by: self._lock

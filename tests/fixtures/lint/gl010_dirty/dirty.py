@@ -1,0 +1,63 @@
+"""GL010 fixture: the two PR 15 fleet races, pre-fix shapes.
+
+Race 1 — abort landing in the submit→rid2att mapping gap: the submit
+side publishes the rid→attempt mapping WITHOUT the router lock, so an
+abort arriving in the gap (which pops the mapping under the lock) can
+interleave with the bare store and resurrect the dead attempt.
+
+Race 2 — finished request re-entering the ledger: the resubmit path
+re-inserts the request record lock-free, racing the completion loop
+that pops it under the lock — a request that already finished re-enters
+the ledger and is served twice.
+"""
+import threading
+
+
+class GapRouter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rid2att = {}
+
+    def start(self):
+        t = threading.Thread(target=self._submit_loop, daemon=True)
+        t.start()
+        a = threading.Thread(target=self._abort_loop, daemon=True)
+        a.start()
+
+    def _submit_loop(self):
+        rid = 0
+        while True:
+            rid += 1
+            att = object()
+            # pre-fix: mapping published outside the lock (the gap)
+            self._rid2att[rid] = att
+
+    def _abort_loop(self):
+        while True:
+            with self._lock:
+                self._rid2att.pop(1, None)
+
+
+class LedgerRouter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._requests = {}
+
+    def start(self):
+        t = threading.Thread(target=self._resubmit_loop, daemon=True)
+        t.start()
+        c = threading.Thread(target=self._complete_loop, daemon=True)
+        c.start()
+
+    def _resubmit_loop(self):
+        frid = 0
+        while True:
+            frid += 1
+            fr = object()
+            # pre-fix: a finished request re-enters the ledger lock-free
+            self._requests[frid] = fr
+
+    def _complete_loop(self):
+        while True:
+            with self._lock:
+                self._requests.pop(1, None)

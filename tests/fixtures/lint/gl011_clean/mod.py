@@ -1,0 +1,21 @@
+"""GL011 clean fixture: one lock per field at every write site, and
+lock-region snapshots copied before they escape."""
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = dict()
+
+    def put(self, k, v):
+        with self._lock:
+            self._rows[k] = v
+
+    def drop(self, k):
+        with self._lock:
+            self._rows.pop(k, None)
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self._rows)

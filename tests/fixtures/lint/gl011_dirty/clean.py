@@ -1,0 +1,32 @@
+"""The consistent counterparts: one lock per field, snapshots copied
+out of the lock region."""
+import collections
+import threading
+
+
+class OneBrain:
+    def __init__(self):
+        self._tlock = threading.Lock()
+        self._table = {}
+
+    def put(self, k, v):
+        with self._tlock:
+            self._table[k] = v
+
+    def drop(self, k):
+        with self._tlock:
+            self._table.pop(k, None)
+
+
+class CopiesOut:
+    def __init__(self):
+        self._qlock = threading.Lock()
+        self._items = collections.deque()
+
+    def add(self, x):
+        with self._qlock:
+            self._items.append(x)
+
+    def snapshot(self):
+        with self._qlock:
+            return list(self._items)

@@ -1,0 +1,41 @@
+"""GL011 fixture: guarded-by inconsistency shapes.
+
+(a) ``SplitBrain._table`` is written under ``self._read_lock`` at one
+site and ``self._write_lock`` at another — each writer "holds a lock",
+but never the SAME lock, so neither excludes the other.
+
+(b) ``Escapee.snapshot`` returns the live ``self._items`` deque from
+inside the lock region that guards its mutations — the caller iterates
+the live container after the lock is released.
+"""
+import collections
+import threading
+
+
+class SplitBrain:
+    def __init__(self):
+        self._read_lock = threading.Lock()
+        self._write_lock = threading.Lock()
+        self._table = {}
+
+    def put(self, k, v):
+        with self._read_lock:
+            self._table[k] = v
+
+    def drop(self, k):
+        with self._write_lock:
+            self._table.pop(k, None)
+
+
+class Escapee:
+    def __init__(self):
+        self._qlock = threading.Lock()
+        self._items = collections.deque()
+
+    def add(self, x):
+        with self._qlock:
+            self._items.append(x)
+
+    def snapshot(self):
+        with self._qlock:
+            return self._items

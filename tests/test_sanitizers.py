@@ -12,6 +12,11 @@ The dynamic half of the PR-4 analysis work, mirroring the static rules:
   train/serving span (or explicit protected_region) raises
   HostSyncInProtectedRegion; outside, and under allow_host_sync(), it
   does not;
+- race witness (GL010's twin, Eraser lockset intersection): a seeded
+  no-common-lock access pattern raises DataRace with BOTH conflicting
+  stacks (and their held locks) in the message; lock-disciplined and
+  read-only sharing stay silent; a concurrency soak (registry gauge
+  removal racing an SLOTracker scan) runs clean under =race;
 - trips export: metric bump + monitor.sanitizer_trip span + flight dump;
 - disabled mode: nothing installed, the concretize hook slot stays bare,
   and the instrumented dispatch path holds the same 40us forward budget
@@ -57,7 +62,7 @@ def _clean_sanitizers():
 class TestEnablePlumbing:
     def test_default_off(self):
         assert not san.enabled()
-        for k in ("lock", "recompile", "hostsync"):
+        for k in ("lock", "recompile", "hostsync", "race"):
             assert not san.enabled(k)
 
     def test_enable_subset(self):
@@ -73,7 +78,7 @@ class TestEnablePlumbing:
         assert san.enabled("lock") and san.enabled("recompile")
         san.disable()
         assert san.install_from_env(env="all") == ("lock", "recompile",
-                                                   "hostsync")
+                                                   "hostsync", "race")
         san.disable()
         assert san.install_from_env(env="") == ()
         assert not san.enabled()
@@ -346,6 +351,192 @@ class TestHostSyncTripwire:
         finally:
             san.disable("hostsync")
             core._CONCRETIZE_HOOK[0] = None
+
+
+# --------------------------------------------------------------------------- #
+# race witness
+# --------------------------------------------------------------------------- #
+
+def _on_thread(fn):
+    """Run fn on a fresh thread; return the DataRace it raised, if any."""
+    box = {}
+
+    def body():
+        try:
+            fn()
+        except san.DataRace as e:
+            box["err"] = e
+
+    t = threading.Thread(target=body, daemon=True)
+    t.start()
+    t.join(5)
+    assert not t.is_alive()
+    return box.get("err")
+
+
+class TestRaceWitness:
+    def test_no_common_lock_trips_with_both_stacks_named(self):
+        """The Eraser core: a field written under lock A on one thread
+        and read under lock B on another has an EMPTY candidate lockset
+        — DataRace, naming both conflicting stacks and the locks each
+        held."""
+        san.enable("race")
+        route_lock = san.new_lock("route_lock")
+        stats_lock = san.new_lock("stats_lock")
+
+        def submit_side_write():
+            with route_lock:
+                san.race_access("eng1", "_stats", write=True)
+
+        assert _on_thread(submit_side_write) is None  # init: exclusive
+
+        def scrape_side_read():
+            with stats_lock:
+                san.race_access("eng1", "_stats")
+
+        scrape_side_read()           # candidate set -> {route? no: stats}
+        err = _on_thread(submit_side_write)   # {stats} & {route} = {}
+        assert isinstance(err, san.DataRace)
+        msg = str(err)
+        assert "data race on '_stats' of 'eng1'" in msg
+        assert "-- first cross-thread access (held ['stats_lock'])" in msg
+        assert "-- this access (held ['route_lock'])" in msg
+        assert "scrape_side_read" in msg and "submit_side_write" in msg
+        assert ("race", msg) in san.trips()
+        # one report per field, not a cascade
+        san.race_access("eng1", "_stats", write=True)
+        assert len(san.trips()) == 1
+
+    def test_common_lock_discipline_stays_silent(self):
+        san.enable("race")
+        lk = san.new_lock("shared_state_lock")
+
+        def disciplined():
+            for _ in range(100):
+                with lk:
+                    san.race_access("eng2", "_jobs", write=True)
+
+        threads = [threading.Thread(target=disciplined, daemon=True)
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        disciplined()
+        assert san.trips() == []
+        state, candidates = san.race_fields()[("eng2", "_jobs")]
+        assert state == "shared_mod"
+        assert candidates == ["shared_state_lock"]
+
+    def test_read_only_sharing_is_silent(self):
+        """No write anywhere = no race, even with no lock at all
+        (config read from many threads)."""
+        san.enable("race")
+        san.race_access("eng3", "_config")
+        assert _on_thread(
+            lambda: san.race_access("eng3", "_config")) is None
+        san.race_access("eng3", "_config")
+        assert san.trips() == []
+        assert san.race_fields()[("eng3", "_config")][0] == "shared"
+
+    def test_trip_exports_metric_span_and_flight_dump(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path))
+        monitor.enable()
+        trace.enable()
+        san.enable("race")
+        try:
+            san.race_access("eng4", "_ledger", write=True)
+            assert _on_thread(lambda: san.race_access(
+                "eng4", "_ledger", write=True)) is not None
+        finally:
+            trace.disable()
+        c = monitor.registry.get("paddle_tpu_monitor_sanitizer_trips_total")
+        assert c is not None and c.labels("race").value == 1
+        assert any(sp.name == "monitor.sanitizer_trip"
+                   for sp in trace.spans())
+        dumps = glob.glob(os.path.join(str(tmp_path), "paddle_tpu_flight_"
+                                       "rank*_pid*.json"))
+        assert dumps, "flight dump not written"
+        with open(dumps[0]) as f:
+            doc = json.load(f)
+        assert doc["reason"].startswith("graftsan race trip")
+        trace.reset()
+
+    def test_new_lock_sanitized_under_race_alone(self):
+        """The race witness needs the held-set, so new_lock must wrap
+        even when the ORDER witness is off."""
+        san.enable("race")
+        assert not san.enabled("lock")
+        lk = san.new_lock("race_only_lock")
+        assert isinstance(lk, san.SanitizedLock)
+        with lk:
+            san.race_access("eng5", "_f", write=True)
+        # order witnessing itself stays off: inverted order is fine
+        a, b = san.new_lock("ra_lock"), san.new_lock("rb_lock")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        assert san.trips() == []
+
+    def test_registry_remove_racing_slo_scan_is_silent(self):
+        """Concurrency soak (the fixed PR 16 shapes): SLOTracker.record
+        on two threads racing scan()/burn_rate() and burn-gauge child
+        removal on the main thread, all under =race — the instrumented
+        `_buckets` field and the registry series must stay disciplined
+        (zero trips) for ~a second of contention."""
+        from paddle_tpu.monitor.slo import Objective, SLOTracker
+
+        assert san.install_from_env(env="race") == ("race",)
+        monitor.enable()
+        trk = SLOTracker([Objective("avail", target=0.99)],
+                         fast_window_s=10.0, slow_window_s=100.0,
+                         burn_threshold=2.0, min_events=5)
+        stop = threading.Event()
+
+        def pound(tenant):
+            i = 0
+            while not stop.is_set():
+                trk.record("avail", good=(i % 7 != 0), tenant=tenant)
+                i += 1
+
+        threads = [threading.Thread(target=pound, args=(f"t{i}",),
+                                    daemon=True) for i in range(2)]
+        for t in threads:
+            t.start()
+        try:
+            deadline = time.monotonic() + 1.0
+            while time.monotonic() < deadline:
+                trk.scan()
+                trk.burn_rate("avail", 10.0)
+                g = monitor.registry.get(
+                    "paddle_tpu_monitor_slo_burn_rate")
+                if g is not None:
+                    g.remove("avail/t0", "fast")
+                    g.remove("avail/t0", "slow")
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(10)
+        assert san.trips() == []
+        assert any(owner.startswith("slo")
+                   for (owner, field) in san.race_fields())
+
+    def test_disabled_race_access_overhead(self):
+        """race_access with sanitizers off is one slot load — the same
+        40us budget (retry-on-load) as every other instrument site."""
+        assert not san.enabled()
+        us = None
+        for _attempt in range(3):
+            us = _floor_us(lambda: san.race_access("ovh", "_field"),
+                           n=1000)
+            if us < 40:
+                return
+        pytest.fail(f"disabled race_access {us:.2f}us exceeds 40us "
+                    "budget in 3 attempts")
 
 
 # --------------------------------------------------------------------------- #

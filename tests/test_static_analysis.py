@@ -6,10 +6,12 @@ Four contracts under test:
 1. the shipped tree is CLEAN — zero findings over paddle_tpu/ with an
    EMPTY baseline (the same invariant ``python -m paddle_tpu.analysis``
    enforces with its exit code) — including the interprocedural engine;
-2. every rule GL001–GL009 fires on its dirty fixture and stays silent on
-   its clean one (tests/fixtures/lint/ mini-trees), and the
+2. every rule GL001–GL011 fires on its dirty fixture and stays silent on
+   its clean one (tests/fixtures/lint/ mini-trees), the
    interprocedural upgrades of GL001/GL002/GL004 flag helper-hidden
-   hazards at the call site with the propagation chain;
+   hazards at the call site with the propagation chain, and the GL010/
+   GL011 lockset analysis (thread-root inference, entry-lockset
+   fixpoint, guarded_by annotations, thread-entry chains) behaves;
 3. the silencing machinery works: inline + file-level suppressions
    (which also STOP propagation through the call graph), and the
    baseline round-trip (grandfather findings, rerun clean);
@@ -50,7 +52,7 @@ class TestShippedTree:
         exits 0 on this tree. Any new finding must be fixed, suppressed
         with a rationale, or (exceptionally) baselined."""
         new, _base, _supp, rules = analysis.analyze()
-        assert len(rules) == 9
+        assert len(rules) == 11
         assert not new, "new graftlint findings:\n" + "\n".join(
             repr(f) for f in new)
 
@@ -81,6 +83,15 @@ class TestRuleFixtures:
         # gl009 covers decorator, to_static and call-form captures;
         # its clean.py shadows the global via a parameter
         ("gl009_dirty", "GL009", 3),
+        # gl010 pins the two PR 15 fleet races as fixture shapes: the
+        # ledger insert landing AFTER the spawned worker can abort
+        # (submit→rid2att gap), and a finished request re-entering the
+        # ledger from a lock-free resubmit loop
+        ("gl010_dirty", "GL010", 2),
+        # gl011 covers both halves: split-lock guarding (no common
+        # lock across write sites) and a deque escaping its lock
+        # region via a bare return
+        ("gl011_dirty", "GL011", 2),
     ])
     def test_dirty_fixture_fires(self, subdir, rule, expect):
         new, _, _ = _analyze(subdir)
@@ -93,6 +104,7 @@ class TestRuleFixtures:
     @pytest.mark.parametrize("subdir", ["gl003_clean", "gl005_clean",
                                         "gl006_clean", "gl007_clean",
                                         "gl008_clean", "gl009_clean",
+                                        "gl010_clean", "gl011_clean",
                                         "interproc_clean"])
     def test_clean_trees_are_silent(self, subdir):
         new, _, _ = _analyze(subdir)
@@ -202,6 +214,101 @@ class TestInterprocedural:
                                         include=None, rules=[
                                             analysis.RULES_BY_ID["GL007"]])
         assert new == []
+
+
+class TestLocksets:
+    """The GL010/GL011 guarded-by analysis: thread-entry chains, the
+    entry-lockset fixpoint, and the guarded_by annotation's two-way
+    contract (silences GL010, feeds GL011)."""
+
+    def test_gl010_chain_carries_spawn_site(self):
+        """The finding sits at the unguarded access; Finding.chain leads
+        with the Thread(target=...) spawn site, file:line per hop; the
+        MESSAGE stays line-free so fingerprints survive drift."""
+        new, _, _ = _analyze("gl010_dirty")
+        gap = next(f for f in new if "'_rid2att'" in f.message
+                   or "_rid2att" in f.message)
+        assert gap.scope == "GapRouter._submit_loop"
+        assert "spawned via 'GapRouter._submit_loop'" in gap.message
+        assert "dirty.py:" not in gap.message      # line-free fingerprint
+        assert gap.chain
+        assert "spawned: threading.Thread(self._submit_loop) " \
+               "in GapRouter.start at dirty.py:" in gap.chain[0]
+        assert gap.as_dict()["chain"] == list(gap.chain)
+
+    def test_entry_lockset_needs_every_call_site_locked(self, tmp_path):
+        """A *_locked helper is only exempt while EVERY resolved call
+        site holds the lock: adding one unlocked caller must resurrect
+        the finding (the fixpoint intersects, it does not union)."""
+        root = tmp_path / "tree"
+        root.mkdir()
+        (root / "mod.py").write_text(
+            "import threading\n\n\n"
+            "class W:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._jobs = {}\n\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._loop).start()\n\n"
+            "    def _loop(self):\n"
+            "        with self._lock:\n"
+            "            self._take_locked()\n"
+            "        self._take_locked()\n\n"   # the unlocked call site
+            "    def _take_locked(self):\n"
+            "        self._jobs.pop(1, None)\n\n"
+            "    def put(self, k, v):\n"
+            "        with self._lock:\n"
+            "            self._jobs[k] = v\n")
+        new, _, _, _ = analysis.analyze(
+            root=str(root), baseline_path="", include=None,
+            rules=[analysis.RULES_BY_ID["GL010"]])
+        assert [f.scope for f in new] == ["W._take_locked"]
+
+    def test_guarded_by_wrong_lock_feeds_gl011(self, tmp_path):
+        """`# guarded_by: <lock>` is an assertion, not an off switch: it
+        silences GL010 at the site, but naming a DIFFERENT lock than the
+        real write sites hold trips the GL011 consistency check."""
+        root = tmp_path / "tree"
+        root.mkdir()
+        src = ("import threading\n\n\n"
+               "class W:\n"
+               "    def __init__(self):\n"
+               "        self._lock = threading.Lock()\n"
+               "        self._other_lock = threading.Lock()\n"
+               "        self._view = {}\n\n"
+               "    def start(self):\n"
+               "        threading.Thread(target=self._loop).start()\n\n"
+               "    def _loop(self):\n"
+               "        self._view = {}   # guarded_by: ANN\n\n"
+               "    def put(self, k, v):\n"
+               "        with self._lock:\n"
+               "            self._view[k] = v\n")
+        (root / "mod.py").write_text(src.replace("ANN", "self._other_lock"))
+        new, _, _, _ = analysis.analyze(root=str(root), baseline_path="",
+                                        include=None)
+        assert [f.rule for f in new] == ["GL011"]
+        assert "no common lock" in new[0].message
+        (root / "mod.py").write_text(src.replace("ANN", "self._lock"))
+        new, _, _, _ = analysis.analyze(root=str(root), baseline_path="",
+                                        include=None)
+        assert new == []
+
+    def test_gl011_escape_says_return_a_copy(self):
+        new, _, _ = _analyze("gl011_dirty")
+        esc = next(f for f in new if "escapes" in f.message)
+        assert "return a copy instead" in esc.message
+        split = next(f for f in new if "no common lock" in f.message)
+        assert split.chain     # the write sites, file:line per hop
+        assert all("dirty.py:" in hop for hop in split.chain)
+
+    def test_explain_gl010_renders_chain(self):
+        p = subprocess.run(
+            [sys.executable, "tools/lint_framework.py", "--root",
+             os.path.join(FIX, "gl010_dirty"), "--include", "",
+             "--no-baseline", "--explain", "GL010"],
+            cwd=ROOT, capture_output=True, text=True, timeout=120)
+        assert p.returncode == 1, p.stdout + p.stderr
+        assert "| spawned: threading.Thread(" in p.stdout
 
 
 class TestSuppression:
@@ -346,10 +453,12 @@ class TestCLISurfaces:
         assert "paddle_tpu_dispatch_op_calls_total\tcounter" in p.stdout
 
     def test_run_static_checks_aggregator(self):
-        """11/11: the seven source-level rows (incl. the ISSUE 15
-        check_doc_rows telemetry-doc contract) plus the four graftir
+        """12/12: the eight source-level rows (incl. the ISSUE 15
+        check_doc_rows telemetry-doc contract and the ISSUE 17
+        check_shared_state lockset row) plus the four graftir
         rows (one jax subprocess analyzing — and graftopt-transforming —
-        the flagship live programs)."""
+        the flagship live programs). The summary stamps per-row wall
+        time as one flat map."""
         p = self._run_slow("tools/run_static_checks.py", "--json")
         assert p.returncode == 0, p.stdout + p.stderr
         summary = json.loads(p.stdout)
@@ -357,10 +466,14 @@ class TestCLISurfaces:
         assert [c["check"] for c in summary["checks"]] == [
             "graftlint", "check_metric_names", "check_span_names",
             "check_lock_order", "check_recompile_hazards",
+            "check_shared_state",
             "check_fault_points", "check_doc_rows",
             "check_collective_consistency",
             "check_donation", "check_hbm_budgets", "check_opt_parity"]
         assert all(c["ok"] for c in summary["checks"])
+        assert set(summary["seconds"]) == {c["check"]
+                                           for c in summary["checks"]}
+        assert summary["total_seconds"] >= summary["seconds"]["graftlint"]
 
     def test_explain_prints_propagation_chain(self):
         """--explain GLxxx: one rule, every finding followed by its
@@ -401,11 +514,9 @@ class TestCLISurfaces:
                                                  "check_span_names",
                                                  "check_lock_order",
                                                  "check_recompile_hazards",
+                                                 "check_shared_state",
                                                  "check_fault_points"]
-            assert rows[1]["ok"], rows[1]
-            assert rows[2]["ok"], rows[2]
-            assert rows[3]["ok"], rows[3]
-            assert rows[4]["ok"], rows[4]
-            assert rows[5]["ok"], rows[5]
+            for row in rows[1:]:
+                assert row["ok"], row
         finally:
             sys.path.remove(os.path.join(ROOT, "tools"))

@@ -18,16 +18,20 @@ One analysis pass (parse the tree once) feeds two result rows:
 5. the recompile hazards (GL008 strict: per-call registration, shape/
    dtype branching in jitted bodies, per-call-constructed static args —
    no baseline);
-6. the fault-point catalog (analysis/faultinject.py POINTS strict: every
+6. the shared-state race rows (``check_shared_state``, GL010 + GL011
+   strict: unguarded shared fields reachable from inferred thread
+   roots, and guarded-by inconsistencies / lock-region escapes — the
+   lockset analysis of analysis/locksets.py with no baseline);
+7. the fault-point catalog (analysis/faultinject.py POINTS strict: every
    declared injection point is fired by at least one
    ``faultinject.fire("<point>")`` site in the tree, and every fired
    point is declared — an undeclared drill or a dead catalog row is a
    CI failure, no baseline);
-7. the telemetry DOC rows (``check_doc_rows``, this repo's root only:
+8. the telemetry DOC rows (``check_doc_rows``, this repo's root only:
    every cataloged metric has a docs/observability.md table row, every
    cataloged span appears in docs/tracing.md, and no observability
    table row names an uncataloged metric — zero baseline);
-8.-11. the graftir rows (``check_collective_consistency`` /
+9.-12. the graftir rows (``check_collective_consistency`` /
    ``check_donation`` / ``check_hbm_budgets`` / ``check_opt_parity``):
    GI001/GI002/GI003 run strict (no baseline) over the three FLAGSHIP
    live programs — the serving mixed step, the decode burst, and the
@@ -42,7 +46,10 @@ One analysis pass (parse the tree once) feeds two result rows:
    dies contributes four failed rows, never a crash.
 
 Prints one status line per check, then a machine-readable JSON summary on
-stdout (``--json`` prints ONLY the JSON). Exit 0 iff every check passed.
+stdout (``--json`` prints ONLY the JSON). Every row carries its own
+``seconds`` and the summary stamps a ``seconds`` {check: wall-time} map
+plus ``total_seconds``, so a check-runtime regression shows up in CI
+history like any other number. Exit 0 iff every check passed.
 """
 from __future__ import annotations
 
@@ -305,6 +312,17 @@ def run_checks(root=ROOT):
     })
 
     t0 = time.perf_counter()
+    problems = an.RULES_BY_ID["GL010"].strict_problems(project, findings)
+    problems += an.RULES_BY_ID["GL011"].strict_problems(project, findings)
+    rows.append({
+        "check": "check_shared_state",
+        "ok": not problems,
+        "findings": len(problems),
+        "detail": problems,
+        "seconds": round(time.perf_counter() - t0, 3),
+    })
+
+    t0 = time.perf_counter()
     problems = fault_point_problems(an, root, project=project)
     rows.append({
         "check": "check_fault_points",
@@ -342,7 +360,15 @@ def main(argv=None):
             print(f"[{status:>9}] {res['check']} ({res['seconds']}s)")
             for line in () if res["ok"] else res["detail"]:
                 print(f"    {line}")
-    summary = {"ok": all(r["ok"] for r in results), "checks": results}
+    summary = {
+        "ok": all(r["ok"] for r in results),
+        "checks": results,
+        # per-row wall time, stamped at the summary level so a CI
+        # runtime regression diffs as one flat map
+        "seconds": {r["check"]: r.get("seconds", 0.0) for r in results},
+        "total_seconds": round(
+            sum(r.get("seconds", 0.0) for r in results), 3),
+    }
     print(json.dumps(summary, indent=1, sort_keys=True) if json_only
           else f"run_static_checks: "
                f"{'OK' if summary['ok'] else 'FAILURES'} "
