@@ -38,7 +38,7 @@ RESULTS = os.path.join(ROOT, "tools", "suite_results.jsonl")
 
 CONFIGS = ("lenet", "resnet50", "bert_dp", "gpt_hybrid", "serving",
            "chaos", "spec", "mesh", "trainchaos", "fusion", "fleet",
-           "obs")
+           "obs", "control")
 
 
 # --------------------------------------------------------------------------- #
@@ -567,6 +567,74 @@ def run_obs(smoke=False):
            "unit": "scraped_vs_unscraped_ratio", "detail": res})
 
 
+def run_control(smoke=False):
+    """Config 13 — the graftpilot diurnal load sweep
+    (bench_common.control_bench, paddle_tpu/control/): the same
+    quiet -> peak -> quiet arrival pattern over a fleet that starts
+    with one active replica, served static vs controlled vs
+    controller-off. The controller resumes drained replicas from queue
+    depth, moves the serving knobs within their declared bounds, and
+    records every decision; the record must REPLAY to the identical
+    decision sequence. ``smoke`` is the tier-1-safe shape
+    (`bench_suite.py --smoke control`)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    from bench_common import control_bench
+
+    dev, on_tpu, kind = _device()
+    paddle.seed(0)
+    if smoke or not on_tpu:
+        cfg = LlamaConfig(vocab_size=96, hidden_size=64,
+                          intermediate_size=176, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=2,
+                          max_position_embeddings=256)
+        params = dict(replicas=3, max_batch=2, block_size=8,
+                      chunk_size=16, decode_burst=2, n_quiet=5,
+                      n_peak=24, n_groups=2, prefix_blocks=2,
+                      tail_range=(4, 10), max_new=48, ttft_slo_ms=150.0)
+    else:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5632, num_hidden_layers=8,
+                          num_attention_heads=16, num_key_value_heads=16,
+                          max_position_embeddings=1024, dtype="bfloat16")
+        params = dict(replicas=3, max_batch=8, block_size=64,
+                      chunk_size=128, decode_burst=8, n_quiet=8,
+                      n_peak=24, n_groups=3, prefix_blocks=4,
+                      tail_range=(32, 96), max_new=32,
+                      ttft_slo_ms=500.0)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu and not smoke:
+        model.to(dtype="bfloat16")
+    res = control_bench(model, **params)
+    res["device"] = kind
+    res["smoke"] = bool(smoke)
+    if smoke:
+        # the sweep's DETERMINISTIC bounds (tier-1 gates on this exit
+        # code): every pass completes, the decision record replays to
+        # the bit-identical sequence, every actuation respected its
+        # declared min/max/slew, the autoscaler actually scaled up
+        # under the peak, and neither the running nor the off
+        # controller changed a single output token. The comparative
+        # violation-minutes bar (controlled <= static) is wall clock
+        # and lives in TestControlSmoke behind the tests/_retry.py
+        # discipline, not here.
+        c = res["controlled"]
+        assert res["static"]["all_complete"], res
+        assert c["all_complete"], res
+        assert res["off"]["all_complete"], res
+        assert c["decisions"] > 0, c
+        assert c["scale_ups"] >= 1, c
+        assert c["replay_identical"] is True, c
+        assert c["bounds_violations"] == [], c
+        assert c["degraded"] is False, c
+        assert res["controlled_tokens_match_static"] is True, res
+        assert res["off_tokens_match_static"] is True, res
+    _emit({"config": "control",
+           "value": res["controlled"]["slo_violation_minutes"],
+           "unit": "slo_violation_minutes", "detail": res})
+
+
 def _force_virtual_mesh():
     """The 8-device virtual CPU mesh env, set BEFORE jax's backends
     initialize (shared by the mesh-family workers; _run_config applies
@@ -779,14 +847,16 @@ def main():
     ap.add_argument("--smoke", metavar="CONFIG",
                     help="run ONE config in-process at tier-1-safe smoke "
                          "shapes and print its JSON line (serving, chaos, "
-                         "spec, mesh, trainchaos, fusion, fleet, obs)")
+                         "spec, mesh, trainchaos, fusion, fleet, obs, "
+                         "control)")
     args = ap.parse_args()
 
     if args.smoke:
         smokes = {"serving": run_serving, "chaos": run_chaos,
                   "spec": run_spec, "mesh": run_mesh,
                   "trainchaos": run_trainchaos, "fusion": run_fusion,
-                  "fleet": run_fleet, "obs": run_obs}
+                  "fleet": run_fleet, "obs": run_obs,
+                  "control": run_control}
         if args.smoke not in smokes:
             ap.error(f"--smoke supports {sorted(smokes)}, "
                      f"not {args.smoke!r}")
@@ -826,6 +896,7 @@ if __name__ == "__main__":
          "serving": run_serving, "chaos": run_chaos,
          "spec": run_spec, "mesh": run_mesh,
          "trainchaos": run_trainchaos, "fusion": run_fusion,
-         "fleet": run_fleet, "obs": run_obs}[which]()
+         "fleet": run_fleet, "obs": run_obs,
+         "control": run_control}[which]()
     else:
         main()

@@ -152,6 +152,22 @@ POINTS = {
         "itself (zero recompiles, no hostsync trips, bit-identical "
         "outputs under PADDLE_TPU_SANITIZE=all; "
         "tests/test_obs_server.py)."),
+    "control.tick": (
+        "The graftpilot controller's decision site "
+        "(control/controller.py Controller.tick, fired once per cycle "
+        "before the telemetry read). raise = the whole tick fails — "
+        "recorded as an error decision, and max_failures consecutive "
+        "trips degrade the controller to the static configuration "
+        "while serving continues untouched; delay = a slow controller "
+        "that must never block a request path (the loop runs on its "
+        "own thread)."),
+    "control.actuate": (
+        "The graftpilot actuation site (control/controller.py "
+        "Controller._actuate, fired once per knob move / hook action "
+        "before the setter runs). raise = the actuation fails AFTER "
+        "the decision: the knob holds its old value, the decision "
+        "records outcome=error, and the rules keep proposing — the "
+        "drill that pins 'a failing actuator never half-applies'."),
 }
 
 ACTIONS = ("raise", "delay", "flag")

@@ -42,7 +42,43 @@ from .ir import trace
 
 __all__ = ["RematPlanError", "remat_candidates", "apply_remat_plan",
            "candidate_flops", "plan_budget_remat", "plan_for_mesh_step",
-           "plan_for_model"]
+           "plan_for_model", "make_replan_hook"]
+
+
+def make_replan_hook(plan_fn, default_budget=None, on_plan=None):
+    """Adapt a planner entry point into a graftpilot ``replan`` hook.
+
+    The controller's HBM-pressure guard (``control/rules.py``
+    ``HbmGuardRule``) reacts to the GI003 live estimate approaching the
+    budget by firing the ``replan`` action ONCE before shrinking
+    admission; this adapter is the glue: ``plan_fn(budget_bytes)`` is
+    any of the planner entries above partially applied (e.g.
+    ``lambda b: plan_for_model(model, opt, loss, batch, b)``), called
+    with the ``hbm_budget_bytes`` the telemetry snapshot carried (or
+    ``default_budget``). Every plan produced is appended to
+    ``hook.plans`` — so the re-plan a 3am decision record points at is
+    inspectable next morning — and forwarded to ``on_plan`` when given.
+    A raising planner propagates: the controller records the failed
+    actuation (outcome=error) and falls through to admission control.
+    """
+    plans = []
+
+    def hook(telemetry):
+        budget = (telemetry or {}).get("hbm_budget_bytes",
+                                       default_budget)
+        if budget is None:
+            budget = default_budget
+        if budget is None:
+            raise ValueError("replan hook needs hbm_budget_bytes in the "
+                             "telemetry snapshot or a default_budget")
+        plan = plan_fn(int(budget))
+        plans.append(plan)
+        if on_plan is not None:
+            on_plan(plan)
+        return plan
+
+    hook.plans = plans
+    return hook
 
 
 class RematPlanError(HBMBudgetExceeded):

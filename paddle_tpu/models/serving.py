@@ -430,6 +430,11 @@ class ContinuousBatchingEngine:
         self.recovery_stats = collections.deque(maxlen=256)
         self.last_recovery_dump = None
         # -- fleet-facing surface (serving/fleet.py) ---------------------
+        # staged knob changes (paddle_tpu/control/): request_knobs()
+        # stores under _submit_lock, step() applies at its entry on the
+        # single driving thread — a knob never changes mid-step, and an
+        # engine nobody tunes never takes this branch (empty-dict check)
+        self._pending_knobs = {}
         # cancellation requests (thread-safe enqueue; the driving thread
         # applies them at the next step boundary) — the hedging loser's
         # exit path
@@ -481,8 +486,10 @@ class ContinuousBatchingEngine:
         if "burst" not in cache:
             san = _sanitizers
             if san._state.recompile:
-                # the engine's SECOND (and last) program: burst size is a
-                # construction-time constant
+                # the engine's SECOND program. Burst size only changes
+                # through request_knobs (which drops this cache entry),
+                # so every signature here is an intentional, slew-bounded
+                # actuation — visible to the sentinel, never a storm
                 san.note_compile(f"serving.step[{self._san_tag}]",
                                  signature=("burst", self.decode_burst))
             cache["burst"] = jax.jit(
@@ -848,6 +855,12 @@ class ContinuousBatchingEngine:
             "cancelled": self.cancelled,
             "driver_alive": bool(self._driver is not None
                                  and self._driver.is_alive()),
+            "knobs": {
+                "chunk_size": self.chunk_size,
+                "decode_burst": self.decode_burst,
+                "decode_priority": self.decode_priority,
+                "max_queue": self.max_queue,
+            },
         }
         if self.recovery_stats:
             doc["last_recovery"] = dict(self.recovery_stats[-1])
@@ -967,6 +980,55 @@ class ContinuousBatchingEngine:
             self._update_gauges(mon)
         return True
 
+    # -- staged knob changes (paddle_tpu/control/) ---------------------------
+    _KNOB_NAMES = ("chunk_size", "decode_burst", "decode_priority",
+                   "max_queue")
+
+    def request_knobs(self, **knobs):
+        """Stage serving-knob changes for the next step boundary
+        (thread-safe): ``chunk_size`` / ``decode_burst`` /
+        ``decode_priority`` / ``max_queue``. Values are validated HERE
+        (a controller with a typo must fail at the actuation site, not
+        corrupt a step); the driving thread applies them at the top of
+        :meth:`step`, so a knob never changes mid-step. A
+        ``decode_burst`` change drops the compiled burst program — the
+        next burst-eligible step recompiles ONE program under the
+        graftsan compile sentinel (signature ``("burst", K)``); the
+        knob's declared slew limit is what bounds the recompile rate."""
+        staged = {}
+        for name, v in knobs.items():
+            if name not in self._KNOB_NAMES:
+                raise ValueError(f"unknown serving knob {name!r} "
+                                 f"(known: {self._KNOB_NAMES})")
+            if name == "max_queue":
+                v = None if v is None else max(1, int(v))
+            elif name == "decode_priority":
+                v = float(v)
+                if not 0.0 <= v < 1.0:
+                    raise ValueError("decode_priority must be in [0, 1)")
+            else:
+                v = max(1, int(v))
+            staged[name] = v
+        with self._submit_lock:
+            self._pending_knobs.update(staged)
+
+    def _apply_pending_knobs(self):
+        """Apply staged knobs (driving thread, step entry). The
+        emptiness check lives under the lock too, so the common
+        nothing-staged step is one uncontended acquire, no lock-free
+        peek at shared state."""
+        with self._submit_lock:
+            if not self._pending_knobs:
+                return
+            knobs, self._pending_knobs = self._pending_knobs, {}
+        for name, v in knobs.items():
+            if name == "decode_burst" and v != self.decode_burst:
+                # invalidate the compiled burst program; the cache key is
+                # stable ("burst"), so the sentinel sees ONE recompile
+                # with the new signature, not a cache leak
+                self._jit_cache.pop("burst", None)
+            setattr(self, name, v)
+
     # -- the mixed step ------------------------------------------------------
     def step(self, eos_token_id=None, max_new_tokens=None):
         """ONE compiled mixed step: every prefilled slot decodes one
@@ -975,6 +1037,9 @@ class ContinuousBatchingEngine:
         (request_id, tokens) pairs evicted this step."""
         epoch = self._epoch
         mon = _mon()
+        # staged controller knobs land here, on the driving thread,
+        # before any slot state is read — never mid-step
+        self._apply_pending_knobs()
         sp = None
         # the host-side twin of the open serving.step span: set while a
         # step runs, cleared on exit — a fleet health monitor reads its

@@ -23,7 +23,7 @@ framework.
 
 # Subsystems a metric may belong to (the <subsystem> token of the name).
 SUBSYSTEMS = ("dispatch", "jit", "serving", "kv", "dataloader", "monitor",
-              "mesh", "comm", "ckpt", "train", "fleet")
+              "mesh", "comm", "ckpt", "train", "fleet", "control")
 
 NAME_PATTERN = (
     r"^paddle_tpu_(" + "|".join(SUBSYSTEMS) + r")_[a-z][a-z0-9_]*$"
@@ -329,6 +329,21 @@ METRICS = {
         "Current burn rate (bad fraction / error budget) per SLO "
         "series and window (fast | slow), refreshed by every "
         "SLOTracker.scan()."),
+    # -- graftpilot controller (control/controller.py) -------------------
+    "paddle_tpu_control_ticks_total": (
+        "counter", (),
+        "Controller ticks executed (telemetry snapshot read + rule "
+        "evaluation), whether or not any rule fired."),
+    "paddle_tpu_control_decisions_total": (
+        "counter", ("rule",),
+        "Recorded controller decisions by rule (knob moves, hook "
+        "actions, fenced errors) — the metric twin of the /controlz "
+        "decision record."),
+    "paddle_tpu_control_knob_value": (
+        "gauge", ("knob",),
+        "Current value of each actuated knob (fleet.replicas, "
+        "fleet.hedge_after_s, engine.chunk_size, engine.decode_burst, "
+        "engine.max_queue), set on every actuation."),
 }
 
 
@@ -341,7 +356,7 @@ def spec(name):
 
 # Subsystems a span may belong to (the first dotted token of the name).
 SPAN_SUBSYSTEMS = ("dispatch", "jit", "serving", "dataloader", "train",
-                   "comm", "monitor", "mesh", "ckpt", "fleet")
+                   "comm", "monitor", "mesh", "ckpt", "fleet", "control")
 
 SPAN_PATTERN = (
     r"^(" + "|".join(SPAN_SUBSYSTEMS)
@@ -506,6 +521,11 @@ SPANS = {
         "both windows crossed the threshold, so the alert lands on the "
         "request timeline it indicts. attrs: objective, fast_burn, "
         "slow_burn."),
+    "control.tick": (
+        "One graftpilot controller cycle (control/controller.py): "
+        "telemetry snapshot read, rules evaluated, proposals actuated "
+        "— so every knob move lands on the request timeline it "
+        "reshapes. attrs: tick, decisions."),
 }
 
 

@@ -23,6 +23,10 @@ endpoint   payload
            returns the written document + its path
 /perfz     ``timeline.perf_report()``: train-step phase breakdown,
            bubble fraction, comm overlap, serving TTFT decomposition
+/controlz  JSON: one section per registered CONTROL provider — the
+           graftpilot controller's decision record (telemetry snapshot
+           read, rule fired, knob old→new, outcome per tick; see
+           docs/control.md)
 /healthz   200 when every provider reports ``health: ok`` (503
            otherwise) — the ``tools/obs_probe.py`` liveness contract
 ========== ===========================================================
@@ -60,17 +64,20 @@ __all__ = [
     "serve", "shutdown", "serving", "port", "install_from_env",
     "register_status_provider", "unregister_status_provider",
     "register_metrics_provider", "unregister_metrics_provider",
-    "status_document", "health_document", "metrics_text", "ENDPOINTS",
+    "register_control_provider", "unregister_control_provider",
+    "status_document", "health_document", "metrics_text",
+    "control_document", "ENDPOINTS",
 ]
 
 ENDPOINTS = ("/metricsz", "/statusz", "/tracez", "/flightz", "/perfz",
-             "/healthz")
+             "/controlz", "/healthz")
 
 _lock = threading.Lock()        # guards the module singletons below
 _server = None
 _thread = None
 _status_providers = {}          # name -> WeakMethod | callable
 _metrics_providers = {}
+_control_providers = {}
 
 
 # -- provider registry -------------------------------------------------------
@@ -132,6 +139,19 @@ def unregister_metrics_provider(name, fn=None):
     _unregister(_metrics_providers, name, fn)
 
 
+def register_control_provider(name, fn):
+    """Register one ``/controlz`` section: ``fn()`` -> the controller's
+    JSON-able decision record (``Controller.controlz``). Same weak-ref
+    lifetime rules as the status registry — a collected controller
+    unregisters itself."""
+    with _lock:
+        _control_providers[str(name)] = _ref(fn)
+
+
+def unregister_control_provider(name, fn=None):
+    _unregister(_control_providers, name, fn)
+
+
 def _unregister(providers, name, fn):
     with _lock:
         ref = providers.get(str(name))
@@ -190,6 +210,22 @@ def health_document():
         and sec.get("health", "ok") not in ("ok", "healthy"))
     return {"ok": not unhealthy, "unhealthy": unhealthy,
             "providers": sorted(doc["providers"])}
+
+
+def control_document():
+    """The ``/controlz`` document: one section per registered control
+    provider (empty ``controllers`` when no controller is wired — the
+    endpoint exists either way, so probes can distinguish "no
+    controller" from "no graftscope")."""
+    doc = {"controllers": {}}
+    for name, fn in _resolve(_control_providers):
+        try:
+            doc["controllers"][name] = fn()
+        except Exception as e:  # noqa: BLE001 - one bad controller must
+            # not take down the decision-record plane
+            doc["controllers"][name] = {
+                "error": f"{type(e).__name__}: {e}"}
+    return doc
 
 
 def metrics_text():
@@ -294,6 +330,8 @@ class _Handler(BaseHTTPRequestHandler):
                 body, ctype = _flightz(query), "application/json"
             elif route == "/perfz":
                 body, ctype = _perfz(query), "application/json"
+            elif route == "/controlz":
+                body, ctype = control_document(), "application/json"
             else:
                 code = 404
                 body, ctype = ({"error": f"unknown endpoint {route!r}",

@@ -1,5 +1,5 @@
 """Rolling multi-window burn-rate SLO tracking — graftscope's alerting
-wing, OBSERVATIONAL ONLY.
+wing.
 
 An SLO here is an :class:`Objective`: "``target`` fraction of events
 must be good" — e.g. 99% of requests under the TTFT threshold, 99.9% of
@@ -26,11 +26,15 @@ alert EDGE (not-alerting -> alerting) is cataloged telemetry:
 monitor is enabled.
 
 The serving fleet (``serving/fleet.py``) wires a tracker into its
-result/admission paths and scans it from the health loop; the tracker's
-verdicts land in the fleet's ``/statusz`` health snapshot but NEVER
-drive routing — alerting that re-routes traffic is a control loop, and
-control loops belong to the router's own breaker machinery
-(docs/introspection.md, SLO section).
+result/admission paths and scans it from the health loop. Originally
+the verdicts were observational only; PR 18 promotes them to DECLARED
+control inputs, each individually opt-in: the graftpilot controller
+(``paddle_tpu/control/``) reads burn rates/alerts through its telemetry
+snapshots, and ``FleetRouter(burn_aware_routing=True)`` deprioritizes a
+replica whose per-replica error burn is alerting (queried via
+:meth:`SLOTracker.is_alerting`). With both opt-ins off the tracker
+remains purely observational — ad-hoc alerting that silently re-routes
+traffic is still a bug, not a feature (docs/control.md).
 """
 from __future__ import annotations
 
@@ -212,6 +216,14 @@ class SLOTracker:
         if not n:
             return 0.0
         return (bad / n) / obj.budget
+
+    def is_alerting(self, objective, tenant=""):
+        """Whether one (objective, tenant) series is currently firing
+        (as of the last :meth:`scan`). This is the DECLARED control
+        surface — the burn-aware router and the graftpilot controller
+        query it (docs/control.md) instead of reaching into scan rows."""
+        with self._lock:
+            return (str(objective), str(tenant)) in self._alerting
 
     # -- scanning / alerting -------------------------------------------------
     def _monitor(self):

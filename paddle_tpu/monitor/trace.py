@@ -46,6 +46,7 @@ __all__ = [
     "new_trace_id", "span", "start_span", "end_span", "record_span",
     "current_span", "thread_span_stack", "spans", "open_spans", "drop",
     "chrome_span_events", "span_dump", "flight_dump",
+    "register_flight_section", "unregister_flight_section",
     "training_step", "set_dispatch_sampling", "dispatch_sample_every",
 ]
 
@@ -453,6 +454,64 @@ DUMP_COALESCE_S = 10.0
 _dump_lock = threading.Lock()
 _last_dumps = {}     # path -> {"t": first-dump monotonic, reasons, extras}
 
+# Flight-dump sections: subsystems that want their host-readable state
+# merged into every post-mortem (the graftpilot controller registers its
+# decision tail here). Same weak-ref lifetime contract as the graftscope
+# provider registries — a collected owner never leaks a section.
+_section_lock = threading.Lock()
+_flight_sections = {}      # name -> WeakMethod | callable
+
+
+def register_flight_section(name, fn):
+    """Register one flight-dump section: ``fn()`` -> JSON-able value,
+    written under ``doc["sections"][name]`` in every dump. Bound methods
+    are held weakly; a raising/dead section is skipped (a failing
+    contributor must not mask the hang the dump documents)."""
+    import weakref
+
+    ref = weakref.WeakMethod(fn) if hasattr(fn, "__self__") else fn
+    with _section_lock:
+        _flight_sections[str(name)] = ref
+
+
+def unregister_flight_section(name, fn=None):
+    import weakref
+
+    with _section_lock:
+        ref = _flight_sections.get(str(name))
+        if ref is None:
+            return
+        if fn is not None:
+            cur = ref() if isinstance(ref, weakref.WeakMethod) else ref
+            if cur is not None and cur != fn:
+                return
+        _flight_sections.pop(str(name), None)
+
+
+def _collect_sections():
+    """{name: section} of the live registered contributors (best
+    effort: dead weakrefs pruned, raising sections skipped)."""
+    import weakref
+
+    with _section_lock:
+        items = list(_flight_sections.items())
+    out, dead = {}, []
+    for name, ref in items:
+        fn = ref() if isinstance(ref, weakref.WeakMethod) else ref
+        if fn is None:
+            dead.append((name, ref))
+            continue
+        try:
+            out[name] = fn()
+        except Exception:  # noqa: BLE001 - a failing section is dropped
+            pass
+    if dead:
+        with _section_lock:
+            for name, ref in dead:
+                if _flight_sections.get(name) is ref:
+                    _flight_sections.pop(name)
+    return out
+
 
 def flight_dump(path=None, reason="", tail=256, extra=None,
                 coalesce_s=None, key=None):
@@ -512,6 +571,9 @@ def flight_dump(path=None, reason="", tail=256, extra=None,
             doc["monitor"] = _metrics_snapshot()
         except Exception:  # noqa: BLE001 - spans alone still diagnose
             doc["monitor"] = None
+        sections = _collect_sections()
+        if sections:
+            doc["sections"] = sections
         if extra:
             doc["extra"] = extra
         path = target

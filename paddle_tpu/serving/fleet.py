@@ -49,7 +49,18 @@ Four coupled capabilities:
 Routing itself stays simple this PR: least fleet-level queue depth among
 admissible replicas, with the prefix-affinity placement hook
 (:meth:`FleetRouter._affinity_hint`) left as a stub for the ROADMAP
-item 4 perf follow-up.
+item 4 perf follow-up. With ``burn_aware_routing=True`` (off by
+default) the PR 15 SLOTracker is promoted from observational to a
+routing input: a replica whose per-replica error burn
+(``completion`` objective, tenant ``replica:<tag>``) is alerting sorts
+AFTER every non-alerting candidate — still least-inflight within each
+tier, and an alerting replica is preferred over shedding when it is the
+only candidate. The fleet is also the substrate the graftpilot
+controller (``paddle_tpu/control/``) actuates: ``scale_to`` moves the
+active replica count through drain/resume, ``set_engine_knobs``
+forwards staged knob changes to every replica engine, and the rolling
+``recent_ttft_ms`` / ``recent_arrivals`` deques feed its telemetry
+snapshots (docs/control.md).
 
 Fault points ``fleet.route`` / ``fleet.replica_step`` / ``fleet.health``
 drill the router (analysis/faultinject.py); fleet metrics and spans are
@@ -245,6 +256,11 @@ class FleetRouter:
     - ``backoff_base_s`` / ``backoff_cap_s``: the circuit breaker's
       capped exponential backoff between a failure and its half-open
       probe window.
+    - ``burn_aware_routing``: OFF by default. When on (and an SLO
+      tracker is wired), per-replica completion events are recorded
+      under tenant ``replica:<tag>`` and a replica whose error burn is
+      alerting is deprioritized by ``_pick_locked`` — routing stays
+      strictly least-inflight when the flag is off.
     """
 
     def __init__(self, model, replicas=3, *, engines=None,
@@ -253,7 +269,7 @@ class FleetRouter:
                  hedge_after_s=None, max_hedges=2,
                  suspect_after_s=1.0, backoff_base_s=0.05,
                  backoff_cap_s=2.0, health_poll_s=0.02, poll_s=0.0005,
-                 slo=None, start=True):
+                 slo=None, burn_aware_routing=False, start=True):
         if engines is None:
             kw = dict(engine_kwargs or {})
             engines = [ContinuousBatchingEngine(model, **kw)
@@ -296,15 +312,24 @@ class FleetRouter:
         # bounded transition log: [(tag, old, new, reason)] — the health
         # state machine's test surface
         self.state_log = collections.deque(maxlen=1024)
-        # SLO burn-rate tracking (monitor/slo.py) — OBSERVATIONAL: the
+        # rolling host-side telemetry for the graftpilot controller
+        # (control/serving.py): fleet-clock TTFTs and submit stamps —
+        # bounded, appended under the router lock
+        self.recent_ttft_ms = collections.deque(maxlen=512)
+        self.recent_arrivals = collections.deque(maxlen=1024)
+        # SLO burn-rate tracking (monitor/slo.py). By default the
         # tracker's verdicts land in the status snapshot and the alert
-        # telemetry, never in a routing decision. slo=True builds the
-        # default serving objectives; pass an SLOTracker to configure.
+        # telemetry only; with burn_aware_routing=True (PR 18) the
+        # per-replica completion burn becomes a routing input — an
+        # alerting replica is deprioritized, never excluded. slo=True
+        # builds the default serving objectives; pass an SLOTracker to
+        # configure.
         if slo is True:
             from ..monitor.slo import SLOTracker, serving_objectives
 
             slo = SLOTracker(serving_objectives())
         self._slo = slo or None
+        self.burn_aware_routing = bool(burn_aware_routing)
         # graftscope: the fleet is ONE scrape target — a /statusz
         # section (per-replica health/breaker state) and a /metricsz
         # appendix (the replica-labeled series). Held via WeakMethod;
@@ -396,6 +421,7 @@ class FleetRouter:
                             np.int32).reshape(-1)
         with self._lock:
             frid = next(self._frids)
+            self.recent_arrivals.append(time.monotonic())
         fr = _FleetRequest(frid, prompt, max_new_tokens, tenant,
                            mon.mod.now_ns())
         att = _Attempt(fr, prefix=(), hedge=False)
@@ -500,6 +526,15 @@ class FleetRouter:
         hint = self._affinity_hint(prompt, cands)
         if hint is not None:
             return hint
+        if self.burn_aware_routing and self._slo is not None:
+            # flag-gated (PR 18): an error-burn-alerting replica sorts
+            # after every quiet candidate — deprioritized, not excluded,
+            # so a fleet whose every replica is alerting still serves
+            slo = self._slo
+            return min(cands, key=lambda r: (
+                1 if slo.is_alerting("completion",
+                                     f"replica:{r.tag}") else 0,
+                r.inflight, r.idx))
         return min(cands, key=lambda r: (r.inflight, r.idx))
 
     def _submit_attempt(self, att, rep=None, timeout=None):
@@ -630,6 +665,12 @@ class FleetRouter:
                 rep.unclaimed.append((rid, list(toks)))
             return
         rep.inflight -= 1
+        if self.burn_aware_routing:
+            # per-replica burn accounting (flag-gated so the default
+            # fleet records NOTHING extra): this replica served one
+            # request end to end
+            self._slo_record("completion", good=True,
+                             tenant=f"replica:{rep.tag}")
         fr = att.fr
         st = rep.engine.pop_stats(rid)
         if rep.state == SUSPECT:
@@ -703,6 +744,9 @@ class FleetRouter:
             ttft = st["ttft_ns"] + st["submit_ns"] - fr.t_submit_ns
         if ttft is not None:
             final["ttft_ns"] = ttft
+            # rolling fleet-clock TTFT window: the controller's hedge
+            # rule reads quantiles over this (control/serving.py)
+            self.recent_ttft_ms.append(ttft / 1e6)
         final["prefill_chunks"] = fr.stats_base["chunks"] \
             + (0 if st is None else st.get("prefill_chunks", 0))
         final["shared_tokens"] = fr.stats_base["shared_tokens"] \
@@ -800,6 +844,11 @@ class FleetRouter:
             rep.unclaimed_aborts.append((rid, list(tokens), stats))
             return []
         rep.inflight -= 1
+        if self.burn_aware_routing:
+            # flag-gated per-replica burn spend: this replica aborted /
+            # withdrew an attempt it had accepted
+            self._slo_record("completion", good=False,
+                             tenant=f"replica:{rep.tag}")
         fr = att.fr
         if fr.done:
             return []
@@ -900,8 +949,9 @@ class FleetRouter:
         if self.hedge_after_s is not None:
             self._maybe_hedge(mon, now)
         if self._slo is not None:
-            # observational: the scan fires alert telemetry and burn
-            # gauges; its verdicts NEVER feed a routing decision.
+            # the scan fires alert telemetry and burn gauges, and (only
+            # when burn_aware_routing is on) refreshes the per-replica
+            # alert set _pick_locked deprioritizes by.
             # Rate-limited: the health loop ticks ~50x/s, burn-rate
             # alerting needs ~1 Hz — no bucket walk on most ticks
             self._slo.scan(min_interval_s=1.0)
@@ -1022,6 +1072,41 @@ class FleetRouter:
             rep.failures = 0
             self._set_state_locked(rep, HEALTHY, "resumed", mon)
 
+    # -- controller actuators (paddle_tpu/control/) --------------------------
+    def active_replicas(self):
+        """Replicas currently in rotation (everything but PARKED)."""
+        with self._lock:
+            return sum(1 for r in self._replicas if r.state != PARKED)
+
+    def scale_to(self, n, drain_timeout=10.0):
+        """Move the active replica count to ``n`` (clamped to
+        ``[1, len(replicas)]``) through the lossless drain/resume
+        machinery: scale-up resumes parked replicas (warm engines, no
+        recompile), scale-down drains the highest-index active ones —
+        zero requests lost by construction. Returns the active count
+        after the move. This is the ``fleet.replicas`` knob's setter."""
+        n = max(1, min(int(n), len(self._replicas)))
+        with self._lock:
+            active = [r for r in self._replicas if r.state != PARKED]
+            parked = [r for r in self._replicas if r.state == PARKED]
+        cur = len(active)
+        if n > cur:
+            for rep in parked[:n - cur]:
+                self.resume(rep.idx)
+        elif n < cur:
+            for rep in sorted(active, key=lambda r: -r.idx)[:cur - n]:
+                self.drain(rep.idx, timeout=drain_timeout)
+        return self.active_replicas()
+
+    def set_engine_knobs(self, **knobs):
+        """Stage engine knob changes (``chunk_size`` / ``decode_burst``
+        / ``max_queue`` / ``decode_priority``) on EVERY replica engine;
+        each applies them at its next step boundary
+        (:meth:`~paddle_tpu.models.serving.ContinuousBatchingEngine
+        .request_knobs`)."""
+        for rep in self._replicas:
+            rep.engine.request_knobs(**knobs)
+
     # -- introspection -------------------------------------------------------
     def _set_state_locked(self, rep, new, reason, mon=None):
         old = rep.state
@@ -1094,6 +1179,7 @@ class FleetRouter:
             "drains": self.drains,
             "hedge_after_s": self.hedge_after_s,
             "max_hedges": self.max_hedges,
+            "burn_aware_routing": self.burn_aware_routing,
         }
         if self._slo is not None:
             doc["slo"] = self._slo.statusz()

@@ -453,12 +453,13 @@ class TestCLISurfaces:
         assert "paddle_tpu_dispatch_op_calls_total\tcounter" in p.stdout
 
     def test_run_static_checks_aggregator(self):
-        """12/12: the eight source-level rows (incl. the ISSUE 15
-        check_doc_rows telemetry-doc contract and the ISSUE 17
-        check_shared_state lockset row) plus the four graftir
-        rows (one jax subprocess analyzing — and graftopt-transforming —
-        the flagship live programs). The summary stamps per-row wall
-        time as one flat map."""
+        """13/13: the nine source-level rows (incl. the ISSUE 15
+        check_doc_rows telemetry-doc contract, the ISSUE 17
+        check_shared_state lockset row and the ISSUE 18
+        check_control_bounds actuation-bounds row) plus the four
+        graftir rows (one jax subprocess analyzing — and
+        graftopt-transforming — the flagship live programs). The
+        summary stamps per-row wall time as one flat map."""
         p = self._run_slow("tools/run_static_checks.py", "--json")
         assert p.returncode == 0, p.stdout + p.stderr
         summary = json.loads(p.stdout)
@@ -468,6 +469,7 @@ class TestCLISurfaces:
             "check_lock_order", "check_recompile_hazards",
             "check_shared_state",
             "check_fault_points", "check_doc_rows",
+            "check_control_bounds",
             "check_collective_consistency",
             "check_donation", "check_hbm_budgets", "check_opt_parity"]
         assert all(c["ok"] for c in summary["checks"])

@@ -9,8 +9,11 @@ process started with ``PADDLE_TPU_DEBUG_PORT`` (or an in-code
     python tools/obs_probe.py --port 8899 --json
     python tools/obs_probe.py --url http://10.0.0.7:8899
 
-Fetches ``/healthz`` + ``/statusz`` (and a ``/metricsz`` series count),
-prints a human summary (or the raw JSON with ``--json``) and exits
+Fetches ``/healthz`` + ``/statusz`` (and a ``/metricsz`` series count,
+plus ``/controlz`` when the process serves one — older processes
+without the graftpilot endpoint 404 it, which probes as "no
+controllers", not as a failure), prints a human summary (or the raw
+JSON with ``--json``) and exits
 
 - 0: reachable and healthy (every provider reports ``health: ok``);
 - 1: reachable but UNHEALTHY (a provider votes down, reports an error
@@ -57,6 +60,7 @@ def probe(base, timeout=5.0):
         h_code, health = _fetch(base, "/healthz", timeout)
         s_code, status = _fetch(base, "/statusz", timeout)
         m_code, metrics = _fetch(base, "/metricsz", timeout)
+        c_code, control = _fetch(base, "/controlz", timeout)
     except Exception as e:  # noqa: BLE001 - unreachable = exit 2
         return 2, {"error": f"{type(e).__name__}: {e}", "url": base}
     if not isinstance(health, dict) or not isinstance(status, dict):
@@ -79,6 +83,8 @@ def probe(base, timeout=5.0):
         "providers": sorted((status.get("providers") or {})),
         "metric_series": series,
         "statusz": status,
+        "controlz": control.get("controllers", {})
+        if c_code == 200 and isinstance(control, dict) else {},
     }
     return (0 if ok else 1), doc
 
@@ -114,6 +120,21 @@ def _summary(doc):
             detail = (f" — active={sec.get('active')} "
                       f"pending={sec.get('pending')}")
         lines.append(f"  {name}: {health}{detail}")
+    for name, sec in sorted(doc.get("controlz", {}).items()):
+        if not isinstance(sec, dict) or "error" in sec:
+            lines.append(f"  controller {name}: error — "
+                         f"{sec.get('error') if isinstance(sec, dict) else sec!r}")
+            continue
+        age = sec.get("last_decision_age_s")
+        lines.append(
+            f"  controller {name}: "
+            f"{'enabled' if sec.get('enabled') else 'DISABLED'}"
+            f"{' (degraded)' if sec.get('degraded') else ''} — "
+            f"{sec.get('ticks', 0)} ticks, "
+            f"{sec.get('decisions', 0)} decisions, "
+            f"rules [{', '.join(sec.get('rules', []))}], "
+            f"last decision "
+            f"{'never' if age is None else f'{age:.1f}s ago'}")
     if doc["unhealthy"]:
         lines.append(f"  unhealthy: {', '.join(doc['unhealthy'])}")
     return lines

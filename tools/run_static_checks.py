@@ -31,7 +31,14 @@ One analysis pass (parse the tree once) feeds two result rows:
    every cataloged metric has a docs/observability.md table row, every
    cataloged span appears in docs/tracing.md, and no observability
    table row names an uncataloged metric — zero baseline);
-9.-12. the graftir rows (``check_collective_consistency`` /
+9. the actuation-bounds contract (``check_control_bounds``, this
+   repo's root only: every knob the graftpilot controller can actuate
+   is declared in the ``control/knobs.py`` KNOB_BOUNDS literal with
+   numeric min / max / per-tick slew, and every literal
+   ``Knob("<name>", ...)`` construction site in the tree names a
+   declared knob — an unbounded actuator is a CI failure, no
+   baseline);
+10.-13. the graftir rows (``check_collective_consistency`` /
    ``check_donation`` / ``check_hbm_budgets`` / ``check_opt_parity``):
    GI001/GI002/GI003 run strict (no baseline) over the three FLAGSHIP
    live programs — the serving mixed step, the decode burst, and the
@@ -203,6 +210,88 @@ def doc_row_problems(root=ROOT):
     return problems
 
 
+def control_bounds_problems(root=ROOT, project=None):
+    """``check_control_bounds``: the actuation-bounds contract. The
+    graftpilot controller may only move knobs through
+    ``control/knobs.py`` KNOB_BOUNDS, so that table IS the blast-radius
+    declaration — this check pins it both ways. Stdlib-only, same
+    discipline as the fault-point check: the bounds table is AST-parsed
+    (never imported); every row must declare numeric ``min`` < ``max``
+    and a positive ``slew``; every literal ``Knob("<name>", ...)``
+    construction site in the tree must name a declared row (a
+    non-literal name can't be pinned and is itself a finding). ZERO
+    baseline by policy — an unbounded actuator never lands."""
+    knobs_rel = "paddle_tpu/control/knobs.py"
+    problems = []
+    try:
+        with open(os.path.join(root, knobs_rel)) as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError) as e:
+        return [f"{knobs_rel}: unreadable bounds table: {e}"]
+    bounds = None
+    for node in tree.body:
+        if isinstance(node, ast.Assign) \
+                and any(isinstance(t, ast.Name) and t.id == "KNOB_BOUNDS"
+                        for t in node.targets):
+            try:
+                bounds = ast.literal_eval(node.value)
+            except ValueError as e:
+                return [f"{knobs_rel}: KNOB_BOUNDS not a literal dict: "
+                        f"{e}"]
+            break
+    if bounds is None:
+        return [f"{knobs_rel}: no literal KNOB_BOUNDS table found"]
+    for name, spec in sorted(bounds.items()):
+        if not isinstance(spec, dict):
+            problems.append(f"{knobs_rel}: {name}: bounds row is not a "
+                            "dict")
+            continue
+        for key in ("min", "max", "slew"):
+            if not isinstance(spec.get(key), (int, float)) \
+                    or isinstance(spec.get(key), bool):
+                problems.append(
+                    f"{knobs_rel}: {name}: missing or non-numeric "
+                    f"{key!r} (every actuated knob declares "
+                    "min/max/slew)")
+        if isinstance(spec.get("min"), (int, float)) \
+                and isinstance(spec.get("max"), (int, float)) \
+                and not spec["min"] < spec["max"]:
+            problems.append(f"{knobs_rel}: {name}: min must be < max")
+        if isinstance(spec.get("slew"), (int, float)) \
+                and not spec["slew"] > 0:
+            problems.append(f"{knobs_rel}: {name}: slew must be > 0")
+    if project is None:
+        an = load_analysis()
+        project = an.Project(root, include=("paddle_tpu",))
+    for sf in project.files:
+        if sf.tree is None:
+            continue             # graftlint already reports parse errors
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call)
+                    and (isinstance(node.func, ast.Name)
+                         and node.func.id == "Knob"
+                         or isinstance(node.func, ast.Attribute)
+                         and node.func.attr == "Knob")
+                    and node.args):
+                continue
+            where = f"{sf.relpath}:{node.lineno}"
+            first = node.args[0]
+            if not (isinstance(first, ast.Constant)
+                    and isinstance(first.value, str)):
+                # inside the package the ctor's own runtime validation
+                # is the guard (replay() rebuilds knobs from a record
+                # whose names were validated when recorded)
+                if not sf.relpath.startswith("paddle_tpu/control/"):
+                    problems.append(
+                        f"{where}: Knob() with a non-literal name (the "
+                        "bounds check cannot pin it)")
+            elif first.value not in bounds:
+                problems.append(
+                    f"{where}: Knob({first.value!r}) names no "
+                    "KNOB_BOUNDS row (undeclared actuator)")
+    return problems
+
+
 GRAFTIR_CHECKS = ("check_collective_consistency", "check_donation",
                   "check_hbm_budgets", "check_opt_parity")
 
@@ -336,6 +425,15 @@ def run_checks(root=ROOT):
         problems = doc_row_problems(root)
         rows.append({
             "check": "check_doc_rows",
+            "ok": not problems,
+            "findings": len(problems),
+            "detail": problems,
+            "seconds": round(time.perf_counter() - t0, 3),
+        })
+        t0 = time.perf_counter()
+        problems = control_bounds_problems(root, project=project)
+        rows.append({
+            "check": "check_control_bounds",
             "ok": not problems,
             "findings": len(problems),
             "detail": problems,
