@@ -547,6 +547,50 @@ def _sanitizer_overhead_bench():
     }
 
 
+def _numerics_overhead_bench():
+    """numsan tax at a step boundary: us/check with the numerics
+    sanitizer off (the shipping default — one slot load, nothing else)
+    vs on (the compiled all-finite reduction and its ONE host bool over
+    a serving-shaped region set). Stamped as detail.numerics beside
+    detail.sanitizer_overhead so BENCH_*.json rounds track the sentinel
+    tax the same way."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from paddle_tpu.analysis import sanitizers as san
+
+    toks = jnp.asarray(np.zeros((8, 4), np.int32))
+    pools = jnp.asarray(
+        np.random.RandomState(5).randn(64, 128).astype("float32"))
+    regions = (("tokens", toks), ("kv_pools", pools))
+
+    def _t(f, n=60, reps=5):
+        f()
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                f()
+            best = min(best, (time.perf_counter() - t0) / n * 1e6)
+        return round(best, 2)
+
+    san.disable()
+    san.reset()
+    off = _t(lambda: san.numsan_check("bench.step", regions))
+    san.enable("numerics")
+    try:
+        on = _t(lambda: san.numsan_check("bench.step", regions))
+    finally:
+        san.disable()
+        san.reset()
+    return {
+        "numsan_check_us_off": off,
+        "numsan_check_us_on": on,
+        "delta_us": round(on - off, 2),
+    }
+
+
 # the donated fused train step + timing-loop machinery is shared with
 # bench_suite.py — see bench_common.py (the tunnel rules live there)
 
@@ -801,6 +845,14 @@ def worker():
     except Exception as e:  # noqa: BLE001 - the headline metric must survive
         sanitizer_overhead = {"error": f"{type(e).__name__}: {e}"[:200]}
     _log(f"[bench] sanitizer_overhead: {sanitizer_overhead}")
+
+    try:
+        numerics = ({"skipped": True}
+                    if os.environ.get("BENCH_SKIP_DISPATCH")
+                    else _numerics_overhead_bench())
+    except Exception as e:  # noqa: BLE001 - the headline metric must survive
+        numerics = {"error": f"{type(e).__name__}: {e}"[:200]}
+    _log(f"[bench] numerics: {numerics}")
     if on_tpu and not flash_info.get("skipped") and not flash_info.get("ok"):
         # kernel unproven on this chip -> train on the XLA math path rather than
         # risk a mid-bench compile failure; the JSON records why.
@@ -1003,6 +1055,7 @@ def worker():
             "dispatch_us": dispatch_us,
             "trace_overhead": trace_overhead,
             "sanitizer_overhead": sanitizer_overhead,
+            "numerics": numerics,
             "decode": decode_info,
             "serving": serving_info,
             "mesh": mesh_info,

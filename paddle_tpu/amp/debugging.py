@@ -81,6 +81,29 @@ def _op_filter(op_name):
     return True
 
 
+def _scan_op_outputs(name, vals):
+    """The tensor checker's per-op scan, invoked through the dispatcher's
+    ``_NAN_INF_HOOK`` slot when FLAGS ``check_nan_inf`` is on. Each float
+    output runs the same compiled device-side all-finite reduction numsan
+    uses (analysis/numerics) — one bool to host per scanned output. For
+    always-on step-boundary coverage without the per-op sync, enable the
+    numerics sanitizer instead (``PADDLE_TPU_SANITIZE=numerics``)."""
+    if not _op_filter(name):
+        return
+    from ..analysis import numerics as _num
+
+    for v in vals:
+        if hasattr(v, "dtype") and jnp.issubdtype(np.dtype(v.dtype),
+                                                  jnp.inexact):
+            if not _num.all_finite(v):
+                if flags.flag("check_nan_inf_level") > 0:
+                    print(f"[paddle_tpu] nan/inf detected in output of "
+                          f"op {name}")
+                else:
+                    raise FloatingPointError(
+                        f"nan/inf detected in output of op {name}")
+
+
 def enable_tensor_checker(checker_config: TensorCheckerConfig):
     """Turn on the per-op NaN/Inf scan (reference debugging.py:653)."""
     if not checker_config.enable:

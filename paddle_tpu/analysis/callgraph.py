@@ -141,7 +141,7 @@ class CallGraph:
             if f.tree is None:
                 continue
             self._imports[f.relpath] = self._file_imports(f)
-            for node in ast.walk(f.tree):
+            for node in f.walk():
                 if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                     scope = f.scope_of(node)
                     qual = f"{scope}.{node.name}" if scope else node.name
@@ -169,7 +169,7 @@ class CallGraph:
     def _file_imports(self, f):
         out = {}
         pkg = _package_of(f.relpath)
-        for node in ast.walk(f.tree):
+        for node in f.walk():
             if isinstance(node, ast.Import):
                 for alias in node.names:
                     parts = tuple(alias.name.split("."))

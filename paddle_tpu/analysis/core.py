@@ -86,12 +86,34 @@ class SourceFile:
             self.parse_error = e
             return
         # parent links + enclosing-function scope per node (rules need both
-        # to answer "is this call guarded?" / "which def owns this line?")
+        # to answer "is this call guarded?" / "which def owns this line?").
+        # The same BFS pass caches the full node list: rules and the call
+        # graph re-traverse every file several times per run, and one
+        # shared ``walk()`` order (identical to ``ast.walk``) is much
+        # cheaper than a dozen generator walks over ~400k nodes.
         self._parents = {}
-        for node in ast.walk(self.tree):
+        nodes = []
+        todo = collections.deque([self.tree])
+        while todo:
+            node = todo.popleft()
+            nodes.append(node)
             for child in ast.iter_child_nodes(node):
                 self._parents[child] = node
-        self._supp = _parse_suppressions(_iter_comments(self.text))
+                todo.append(child)
+        self.nodes = nodes
+        # Tokenizing every file just to find directive comments is the
+        # single biggest parse-time cost; a file with no "graftlint"
+        # substring cannot contain one, so skip the tokenizer entirely.
+        if "graftlint" in self.text:
+            self._supp = _parse_suppressions(_iter_comments(self.text))
+        else:
+            self._supp = (None, {})
+
+    def walk(self):
+        """Every node of ``self.tree`` in ``ast.walk`` (BFS) order, from
+        the one traversal done at parse time. Use this instead of
+        ``ast.walk(sf.tree)`` for full-tree scans."""
+        return self.nodes
 
     def parent(self, node):
         return self._parents.get(node)

@@ -168,6 +168,13 @@ POINTS = {
         "the decision: the knob holds its old value, the decision "
         "records outcome=error, and the rules keep proposing — the "
         "drill that pins 'a failing actuator never half-applies'."),
+    "numsan.check": (
+        "numsan's step-boundary finiteness check "
+        "(analysis/sanitizers.py numsan_check, fired once per enabled "
+        "check before the compiled reduction). flag = the check sees "
+        "region ``seed % len(regions)`` with one NaN leaf appended "
+        "host-side — the trip/bisection drill; the engine's own values "
+        "are never touched, so outputs stay bit-exact."),
 }
 
 ACTIONS = ("raise", "delay", "flag")

@@ -21,7 +21,18 @@ step), analyzed through the same builder code paths production jits:
   API — the static half of the memory-budget remat planner;
 - GI004 fusion-opportunity — convert round-trips, duplicated expensive
   subexpressions, operand shardings that force GSPMD reshards (arXiv
-  2301.13062's statically visible missed-fusion shapes).
+  2301.13062's statically visible missed-fusion shapes);
+- GI005 precision-flow — fp16/bf16 accumulation over large axes and
+  downcast→sum→widen chains (the lossy sibling of GI004's convert
+  round-trips, axis-size-aware severity);
+- GI006 overflow/underflow-hazard — exp without the max-shift,
+  zero-crossing log/div/rsqrt on reduced-precision values, fp16 dots
+  past 65504, under an abstract value-range interpretation
+  (``precision.py``) that recognizes the stabilization idioms;
+- GI007 loss-scale-coverage — fp16 gradients crossing collectives
+  outside the scaled region, reduced-precision state committed without
+  an fp32 master copy (cross-checked against the static/amp.py scaler
+  and the PR 13 error-feedback design).
 
 Analysis is TRACE-only (``jax.make_jaxpr``): no XLA compile, no device
 dispatch. Findings carry location-free fingerprints against a
@@ -49,7 +60,8 @@ from .opt import (DEFAULT_REWRITES, AppliedRewrite, OptimizeResult,
                   bit_exact, optimize_closed, optimize_jitted,
                   optimize_program)
 from .passes import (ALL_PASSES, PASSES_BY_ID, CollectiveConsistency,
-                     DonationSafety, FusionOpportunity, HBMBudget)
+                     DonationSafety, FusionOpportunity, HBMBudget,
+                     LossScaleCoverage, NumericHazard, PrecisionFlow)
 from .planner import (RematPlanError, apply_remat_plan, plan_budget_remat,
                       plan_for_mesh_step, plan_for_model, remat_candidates)
 from .programs import (FLAGSHIP, build_program, ensure_virtual_devices,
@@ -59,6 +71,7 @@ __all__ = [
     "AnalysisError", "IRFinding", "IRPass", "ProgramIR",
     "ALL_PASSES", "PASSES_BY_ID", "CollectiveConsistency",
     "DonationSafety", "HBMBudget", "FusionOpportunity",
+    "PrecisionFlow", "NumericHazard", "LossScaleCoverage",
     "trace", "analyze_program", "analyze_fn", "analyze_flagship",
     "partition_findings", "load_baseline", "write_baseline",
     "DEFAULT_BASELINE", "estimate", "estimate_fn", "assert_hbm_budget",
@@ -114,26 +127,32 @@ def _hbm_table(programs):
 
 
 def static_check_rows(passes_by_check=None):
-    """The four graftir CI rows ``tools/run_static_checks.py`` prints:
+    """The six graftir CI rows ``tools/run_static_checks.py`` prints:
     one strict (no-baseline) row per contract over every flagship
     program. A program whose BUILD fails contributes its typed error to
     every row; ``check_hbm_budgets`` additionally fails when a flagship
     program has no manifest row (a budget nobody declared gates
-    nothing); ``check_opt_parity`` runs the graftopt transform on every
-    flagship and asserts the OPTIMIZED program re-analyzes clean under
-    GI001–GI004 (budgets included — a rewrite must never grow peak past
-    the manifest)."""
+    nothing); ``check_precision_flow`` runs the graftnum GI005+GI007
+    dtype-flow passes and ``check_numeric_hazards`` the GI006
+    range-propagation pass; ``check_opt_parity`` runs the graftopt
+    transform on every flagship and asserts the OPTIMIZED program
+    re-analyzes clean under ALL passes (budgets included — a rewrite
+    must never grow peak past the manifest)."""
     import time
 
     checks = passes_by_check or (
         ("check_collective_consistency", "GI001"),
         ("check_donation", "GI002"),
         ("check_hbm_budgets", "GI003"),
+        ("check_precision_flow", ("GI005", "GI007")),
+        ("check_numeric_hazards", "GI006"),
     )
     built = flagship_programs()
     budgets = load_budgets()
     rows = []
-    for check, pass_id in checks:
+    for check, pass_ids in checks:
+        if isinstance(pass_ids, str):
+            pass_ids = (pass_ids,)
         t0 = time.perf_counter()
         problems = []
         for name, prog in built:
@@ -141,11 +160,12 @@ def static_check_rows(passes_by_check=None):
                 problems.append(f"{name}: {type(prog).__name__}: {prog}")
                 continue
             try:
-                for f in analyze_program(prog, [PASSES_BY_ID[pass_id]]):
+                for f in analyze_program(
+                        prog, [PASSES_BY_ID[p] for p in pass_ids]):
                     problems.append(repr(f))
             except AnalysisError as e:
                 problems.append(f"{name}: {type(e).__name__}: {e}")
-            if pass_id == "GI003" and name not in budgets:
+            if "GI003" in pass_ids and name not in budgets:
                 problems.append(
                     f"{name}: no budget row in budgets.json — declare "
                     "one (see docs/ir_analysis.md)")
@@ -252,7 +272,7 @@ def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="python -m paddle_tpu.analysis.jaxpr",
         description="graftir: jaxpr-level static analysis over the "
-                    "flagship live programs (GI001-GI004)")
+                    "flagship live programs (GI001-GI007)")
     ap.add_argument("--programs", default=None,
                     help="comma-separated flagship program names "
                          "(default: all three)")
@@ -276,7 +296,7 @@ def main(argv=None):
                          "applied-rewrite table (findings are computed "
                          "on the OPTIMIZED programs)")
     ap.add_argument("--checks-json", action="store_true",
-                    help="emit the four run_static_checks rows as JSON "
+                    help="emit the six run_static_checks rows as JSON "
                          "(the CI aggregator's consumer interface)")
     ap.add_argument("--list-passes", action="store_true")
     ap.add_argument("--list-programs", action="store_true")

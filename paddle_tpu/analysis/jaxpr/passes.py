@@ -1,5 +1,7 @@
 """graftir passes GI001–GI004: invariants of the traced programs that
 actually run on the device, checked statically over their jaxprs.
+(The graftnum precision passes GI005–GI007 live in ``precision.py``
+and join :data:`ALL_PASSES` below.)
 
 Each pass encodes one hazard class the test suite cannot cheaply see:
 
@@ -28,9 +30,11 @@ from __future__ import annotations
 from . import collectives as _coll
 from . import hbm as _hbm
 from .ir import IRPass, _aval_bytes
+from .precision import LossScaleCoverage, NumericHazard, PrecisionFlow
 
 __all__ = ["CollectiveConsistency", "DonationSafety", "HBMBudget",
-           "FusionOpportunity", "ALL_PASSES", "PASSES_BY_ID"]
+           "FusionOpportunity", "PrecisionFlow", "NumericHazard",
+           "LossScaleCoverage", "ALL_PASSES", "PASSES_BY_ID"]
 
 
 def _is_var(v):
@@ -362,5 +366,6 @@ class FusionOpportunity(IRPass):
 
 
 ALL_PASSES = (CollectiveConsistency(), DonationSafety(), HBMBudget(),
-              FusionOpportunity())
+              FusionOpportunity(), PrecisionFlow(), NumericHazard(),
+              LossScaleCoverage())
 PASSES_BY_ID = {p.id: p for p in ALL_PASSES}

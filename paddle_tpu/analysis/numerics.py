@@ -1,0 +1,71 @@
+"""graftnum runtime: the compiled device-side finiteness checks behind
+:func:`paddle_tpu.analysis.sanitizers.numsan_check` and the eager tensor
+checker in ``amp/debugging.py``.
+
+``sanitizers.py`` is stdlib-only by contract, so everything that touches
+jax lives here and is imported lazily, on the first enabled check. The
+fleet check is ONE jitted all-finite reduction over every float leaf of
+every registered region — one bool crosses to the host per step, no
+per-op sync, no data leaves the device. The per-region checks used to
+localize a trip compile only on the trip path, so the steady state pays
+exactly one compiled program per (shapes, dtypes) signature;
+:func:`cache_size` exposes the underlying jit cache size so tests can
+assert zero steady-state recompiles.
+"""
+from __future__ import annotations
+
+__all__ = ["all_finite", "first_bad_region", "poisoned", "cache_size"]
+
+import jax
+import jax.numpy as jnp
+
+
+def _float_leaves(tree):
+    return [x for x in jax.tree_util.tree_leaves(tree)
+            if hasattr(x, "dtype")
+            and jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact)]
+
+
+@jax.jit
+def _all_finite(leaves):
+    ok = jnp.bool_(True)
+    for x in leaves:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(x)))
+    return ok
+
+
+def all_finite(tree):
+    """One device-side reduction over every float leaf of ``tree`` and a
+    single bool read back. Non-float leaves (int token ids, the int8 KV
+    pools) are skipped — finiteness is not a question for them. The read
+    is a raw ``jax.Array`` bool, not a Tensor concretization, so it does
+    not cross the hostsync tripwire."""
+    leaves = _float_leaves(tree)
+    if not leaves:
+        return True
+    return bool(_all_finite(leaves))
+
+
+def first_bad_region(regions):
+    """Bisect ``((tag, tree), ...)`` to the first region (registration
+    order) holding a non-finite float leaf. Only runs on the trip path,
+    so its per-region compiles never touch the steady state. Returns the
+    tag, or None when the combined check tripped but every region checks
+    clean in isolation (a region mutated between the two checks)."""
+    for tag, tree in regions:
+        if not all_finite(tree):
+            return tag
+    return None
+
+
+def poisoned(tree):
+    """``tree`` plus one appended NaN leaf — the ``numsan.check`` fault
+    drill. The engine's own values are never touched, so outputs stay
+    bit-exact whether or not the drill (or numsan itself) is on."""
+    return (tree, jnp.float32(jnp.nan))
+
+
+def cache_size():
+    """Compiled-program count of the fleet check's jit cache (the
+    zero-steady-state-recompile assertion)."""
+    return _all_finite._cache_size()

@@ -129,14 +129,14 @@ class TraceImpurity(Rule):
             return memo
         traced = {}
         defs = {}
-        for n in ast.walk(srcfile.tree):
+        for n in srcfile.walk():
             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 defs.setdefault((n.name, srcfile.scope_of(n)), []).append(n)
                 tags = [t for t in map(_decorator_tag, n.decorator_list)
                         if t]
                 if tags:
                     traced.setdefault(n, tags[0])
-        for call in ast.walk(srcfile.tree):
+        for call in srcfile.walk():
             if not isinstance(call, ast.Call) or not call.args:
                 continue
             tag = _decorator_tag(call)
@@ -284,7 +284,7 @@ class HostSync(Rule):
         for f in project.files:
             if f.tree is None or not f.relpath.startswith(self.SCOPES):
                 continue
-            for call in ast.walk(f.tree):
+            for call in f.walk():
                 if not isinstance(call, ast.Call):
                     continue
                 msg = self._classify(f, call)
@@ -438,7 +438,7 @@ class RegistryConsistency(Rule):
         for f in project.files:
             if f.tree is None:
                 continue
-            for call in ast.walk(f.tree):
+            for call in f.walk():
                 if not isinstance(call, ast.Call) or not self._reg_call(call):
                     continue
                 scope = f.scope_of(call)
@@ -545,7 +545,7 @@ class LockDiscipline(Rule):
         for f in project.files:
             if f.tree is None:
                 continue
-            for w in ast.walk(f.tree):
+            for w in f.walk():
                 if not isinstance(w, ast.With) \
                         or not any(self._lock_ctx(i) for i in w.items):
                     continue
@@ -711,7 +711,7 @@ class MetricNameContract(Rule):
         for f in project.files:
             if f.tree is None:
                 continue
-            for call in ast.walk(f.tree):
+            for call in f.walk():
                 if not isinstance(call, ast.Call) or not call.args:
                     continue
                 fname = dotted_name(call.func)
@@ -823,7 +823,7 @@ class SpanNameContract(Rule):
         for f in project.files:
             if f.tree is None:
                 continue
-            for call in ast.walk(f.tree):
+            for call in f.walk():
                 if not isinstance(call, ast.Call) or not call.args:
                     continue
                 fname = dotted_name(call.func)
@@ -1041,7 +1041,7 @@ class RecompileHazard(Rule):
     # -- pattern 1: per-call registration ------------------------------------
     def _per_call_registration(self, f):
         out = []
-        for node in ast.walk(f.tree):
+        for node in f.walk():
             if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
             if not any(self._is_reg_decorator(d) for d in node.decorator_list):
@@ -1126,10 +1126,10 @@ class RecompileHazard(Rule):
         if not compiled:
             return out
         local_defs = {}
-        for n in ast.walk(f.tree):
+        for n in f.walk():
             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 local_defs.setdefault(n.name, []).append(f.scope_of(n))
-        for call in ast.walk(f.tree):
+        for call in f.walk():
             if not isinstance(call, ast.Call) \
                     or not isinstance(call.func, ast.Name) \
                     or call.func.id not in compiled:
@@ -1166,7 +1166,7 @@ class RecompileHazard(Rule):
         decorated @to_static/@jax.jit (not @defop), and assignment targets
         of ``to_static(...)`` / ``jax.jit(...)`` results."""
         names = set()
-        for n in ast.walk(f.tree):
+        for n in f.walk():
             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 tags = [t for t in map(_decorator_tag, n.decorator_list) if t]
                 if tags and tags[0] in ("to_static", "jit"):

@@ -1,9 +1,9 @@
 """graftsan: opt-in runtime sanitizers for the hazards graftlint can only
 approximate statically.
 
-Four sanitizers, enabled via
-``PADDLE_TPU_SANITIZE=lock,recompile,hostsync,race`` (or ``all``) at
-process start, or programmatically with :func:`enable`:
+Five sanitizers, enabled via
+``PADDLE_TPU_SANITIZE=lock,recompile,hostsync,race,numerics`` (or
+``all``) at process start, or programmatically with :func:`enable`:
 
 - **lock** — a lock-order witness (the dynamic twin of GL007): the stack's
   known locks are wrapped so every acquisition-while-holding records an
@@ -33,6 +33,16 @@ process start, or programmatically with :func:`enable`:
   stacks named, no lucky-timing crash required. Enabling ``race`` makes
   :func:`new_lock` return sanitized locks (held-set maintenance) even when
   the order witness is off.
+- **numerics** — numsan, the runtime twin of graftir's GI005–GI007: one
+  compiled device-side all-finite reduction over the registered step
+  outputs at every step/burst boundary (:func:`numsan_check`), ONE bool
+  to the host per step — no per-op sync. A non-finite value raises
+  :class:`NumericsTrip` naming the step and the first non-finite region
+  tag (the registered regions are re-checked in order to localize it);
+  drilled via the ``numsan.check`` fault point. This replaces the old
+  flag-gated per-op host NaN scanner on the hot paths; the eager
+  per-op checker in ``amp/debugging.py`` remains for interactive
+  debugging and now shares numsan's compiled check.
 
 Discipline matches monitor/trace: **disabled by default**, every guard is
 one slot load on a preallocated ``_state`` object, nothing is wrapped or
@@ -41,12 +51,15 @@ the tier-1 dispatch budget holds with sanitizers off.
 
 Every trip also (best-effort) bumps
 ``paddle_tpu_monitor_sanitizer_trips_total``, records a
-``monitor.sanitizer_trip`` span, and writes the trace flight-recorder dump
-(the hang/post-mortem workflow of docs/tracing.md) before raising.
+``monitor.sanitizer_trip`` span (``monitor.numsan_trip`` for numerics,
+which carries the site/step/region attrs), and writes the trace
+flight-recorder dump (the hang/post-mortem workflow of docs/tracing.md)
+before raising.
 
 This module is stdlib-only (no jax, no framework imports) like the rest of
 ``paddle_tpu.analysis``; runtime integration points import IT, and the
-monitor/trace bindings resolve lazily at trip time.
+monitor/trace bindings — and numsan's jax half in ``numerics.py`` —
+resolve lazily at trip/check time.
 """
 from __future__ import annotations
 
@@ -58,6 +71,7 @@ import traceback
 __all__ = [
     "SanitizerError", "LockOrderInversion", "RecompileStorm",
     "HostSyncInProtectedRegion", "BlockingWaitUnderLock", "DataRace",
+    "NumericsTrip",
     "enable", "disable", "enabled", "install_from_env", "reset",
     "SanitizedLock", "new_lock", "wrap_lock", "lock_order_edges",
     "check_wait",
@@ -65,9 +79,10 @@ __all__ = [
     "set_recompile_threshold",
     "protected_region", "allow_host_sync", "trips",
     "race_access", "race_fields",
+    "numsan_check", "numsan_counts",
 ]
 
-_KINDS = ("lock", "recompile", "hostsync", "race")
+_KINDS = ("lock", "recompile", "hostsync", "race", "numerics")
 
 
 class SanitizerError(RuntimeError):
@@ -95,6 +110,10 @@ class DataRace(SanitizerError):
     two threads touch it with no common lock."""
 
 
+class NumericsTrip(SanitizerError):
+    """A registered step-boundary region holds a non-finite value."""
+
+
 class _State:
     """One slot load per guard when disabled — the monitor discipline.
     ``locktrack`` is the derived held-set-maintenance flag: on when the
@@ -102,7 +121,7 @@ class _State:
     locks each thread holds."""
 
     __slots__ = ("on", "lock", "recompile", "hostsync", "race",
-                 "locktrack")
+                 "numerics", "locktrack")
 
     def __init__(self):
         self.on = False
@@ -110,6 +129,7 @@ class _State:
         self.recompile = False
         self.hostsync = False
         self.race = False
+        self.numerics = False
         self.locktrack = False
 
 
@@ -140,6 +160,11 @@ _threshold = [_DEFAULT_THRESHOLD]
 _prev_hook = [None]
 _hook_installed = [False]
 
+# -- numerics sentinel --------------------------------------------------------
+
+_numsan_lock = threading.Lock()
+_numsan_counts = {}  # site -> device-side checks issued
+
 
 def enabled(kind=None):
     """Whether any sanitizer (or one specific kind) is enabled."""
@@ -151,7 +176,7 @@ def enabled(kind=None):
 
 
 def enable(*kinds):
-    """Enable sanitizers (all four when called bare). Module-level monitor
+    """Enable sanitizers (all five when called bare). Module-level monitor
     locks are wrapped now; locks constructed AFTER this call pick up
     wrapping via :func:`new_lock` at their construction sites."""
     kinds = kinds or _KINDS
@@ -175,7 +200,7 @@ def disable(*kinds):
             raise ValueError(f"unknown sanitizer {k!r} (known: {_KINDS})")
         setattr(_state, k, False)
     _state.on = (_state.lock or _state.recompile or _state.hostsync
-                 or _state.race)
+                 or _state.race or _state.numerics)
     _state.locktrack = _state.lock or _state.race
     if not _state.hostsync:
         _uninstall_hook()
@@ -220,6 +245,8 @@ def reset():
     with _recompile_lock:
         _compiles.clear()
         _signatures.clear()
+    with _numsan_lock:
+        _numsan_counts.clear()
     del _trips[:]
     _tls.__dict__.clear()
 
@@ -650,3 +677,91 @@ def _uninstall_hook():
         _core._CONCRETIZE_HOOK[0] = _prev_hook[0]
     _prev_hook[0] = None
     _hook_installed[0] = False
+
+
+# -- numerics sentinel (numsan) -----------------------------------------------
+
+def numsan_check(site, regions, step=None):
+    """One device-side all-finite check over ``regions`` at a step/burst
+    boundary. ``regions`` is ``((tag, pytree), ...)`` — the step's
+    committed outputs (serving tokens + KV pools, the mesh step's loss /
+    params / optimizer state), in the order the bisection should report
+    them. Callers guard on ``_state.numerics`` so the disabled cost is
+    one slot load; the enabled cost is one compiled reduction and ONE
+    bool to the host per step (a raw jax.Array read, not a Tensor
+    concretization — it cannot cross the hostsync tripwire).
+
+    The ``numsan.check`` fault point drills the path: armed with
+    ``action="flag"``, the check sees region ``seed % len(regions)``
+    with one extra NaN leaf appended host-side — the engine's values are
+    never touched, so step outputs stay bit-exact whether or not the
+    drill (or numsan itself) is on.
+    """
+    if not _state.numerics:
+        return
+    regions = tuple(regions)
+    if not regions:
+        return
+    from . import faultinject as _fi
+    from . import numerics as _num
+
+    spec = _fi.fire("numsan.check")
+    if spec is not None:
+        k = spec.seed % len(regions)
+        tag, tree = regions[k]
+        regions = (regions[:k] + ((tag, _num.poisoned(tree)),)
+                   + regions[k + 1:])
+    with _numsan_lock:
+        _numsan_counts[site] = _numsan_counts.get(site, 0) + 1
+    try:
+        from .. import monitor as _m
+
+        if _m._state.on:
+            _m.counter("paddle_tpu_monitor_numsan_checks_total",
+                       labelnames=("site",)).labels(site).inc()
+    except Exception:  # noqa: BLE001 — telemetry must not break the check
+        pass
+    if _num.all_finite(tuple(t for _, t in regions)):
+        return
+    bad = _num.first_bad_region(regions)
+    at = f"step {step}" if step is not None else "an untracked step"
+    msg = (f"non-finite value at {site} ({at}): first non-finite region "
+           f"is '{bad or '<combined check only>'}' of "
+           f"{[t for t, _ in regions]} — a NaN/inf crossed the step "
+           "boundary; replay under the eager checker "
+           "(amp.debugging.enable_tensor_checker) to name the op, or "
+           "run the GI006 hazard report for the static candidates")
+    _numsan_trip(site, step, bad, msg)
+
+
+def numsan_counts():
+    """Snapshot: {site: device-side checks issued} while enabled."""
+    with _numsan_lock:
+        return dict(_numsan_counts)
+
+
+def _numsan_trip(site, step, region, message):
+    """The numerics flavor of :func:`_trip`: same record/export/raise
+    contract, but the span is ``monitor.numsan_trip`` carrying the
+    site/step/region the bisection localized."""
+    _trips.append(("numerics", message))
+    try:
+        from .. import monitor as _m
+
+        if _m._state.on:
+            _m.counter("paddle_tpu_monitor_sanitizer_trips_total",
+                       labelnames=("sanitizer",)).labels("numerics").inc()
+        t = _m.trace
+        if t._state.on:
+            now = _m.now_ns()
+            t.record_span("monitor.numsan_trip", now, now,
+                          attrs={"site": site,
+                                 "step": "?" if step is None
+                                 else str(step),
+                                 "region": region or "?"})
+        if t._state.on or os.environ.get("PADDLE_TPU_FLIGHT_DIR"):
+            t.flight_dump(
+                reason=f"graftsan numerics trip: {message[:300]}")
+    except Exception:  # noqa: BLE001 — telemetry must not mask the trip
+        pass
+    raise NumericsTrip(message)

@@ -30,6 +30,7 @@ from jax.sharding import PartitionSpec as P
 
 # the collective census shares ONE vocabulary with graftir's GI001 pass
 # (PR 11 factored the PR 8 private regex out of this module)
+from ..analysis import sanitizers as _sanitizers
 from ..analysis.jaxpr import collectives as _collectives
 from ..framework import random as rng
 from ..framework.core import Tensor
@@ -585,6 +586,13 @@ class MeshParallel:
             loss, self._pv, self._av, self._mv = self._jitted(
                 self._pv, self._av, self._mv, *vals)
         self._steps += 1
+        if _sanitizers._state.numerics:
+            regions = [("loss", loss), ("params", self._pv),
+                       ("opt_state", (self._av, self._mv))]
+            if self._rv is not None:
+                regions.append(("residuals", self._rv))
+            _sanitizers.numsan_check("mesh.train_step", regions,
+                                     step=self._steps)
         if self._jitted._cache_size() > before:
             try:
                 from ..analysis import sanitizers as _san

@@ -399,6 +399,9 @@ class ContinuousBatchingEngine:
         # mixed-step program each); a process-wide label would falsely
         # trip the sentinel on the second engine
         self._san_tag = f"e{next(_ENGINE_SEQ)}"
+        # numsan step index: bumped only while the numerics sanitizer is
+        # on, so trip dumps name the step the NaN crossed, not wall time
+        self._san_steps = 0
         # submit() queues (host-side, one lane per tenant); _submit_lock
         # guards the bounded check+append only — nothing blocks and no
         # jax dispatch runs under it (GL004)
@@ -1386,6 +1389,12 @@ class ContinuousBatchingEngine:
         out_dev, self._pools = step(
             jnp.asarray(pack_np), self._pools, self._pager.block_tables,
             slots_dev, valid_dev, chain_dev)
+        if _sanitizers._state.numerics:
+            self._san_steps += 1
+            _sanitizers.numsan_check(
+                "serving.mixed_step",
+                (("tokens", out_dev), ("kv_pools", self._pools)),
+                step=self._san_steps)
         out = np.asarray(out_dev)
         toks, acc = out[0], out[1]
         if epoch != self._epoch:
@@ -1618,6 +1627,12 @@ class ContinuousBatchingEngine:
         pack[1] = self.lens
         toks_dev, self._pools = self._burst_jit()(
             jnp.asarray(pack), self._pools, self._pager.block_tables)
+        if _sanitizers._state.numerics:
+            self._san_steps += 1
+            _sanitizers.numsan_check(
+                "serving.decode_burst",
+                (("tokens", toks_dev), ("kv_pools", self._pools)),
+                step=self._san_steps)
         toks = np.asarray(toks_dev)            # (B, K)
         if epoch != self._epoch:
             # superseded mid-dispatch: keep the pools rebind (buffer

@@ -306,8 +306,14 @@ METRICS = {
     "paddle_tpu_monitor_sanitizer_trips_total": (
         "counter", ("sanitizer",),
         "graftsan sanitizer trips (lock-order inversion, recompile storm, "
-        "host-sync-in-span, data race), labeled by sanitizer; each trip "
-        "also raises and flight-dumps (docs/sanitizers.md)."),
+        "host-sync-in-span, data race, numerics), labeled by sanitizer; "
+        "each trip also raises and flight-dumps (docs/sanitizers.md)."),
+    "paddle_tpu_monitor_numsan_checks_total": (
+        "counter", ("site",),
+        "numsan device-side step-boundary finiteness checks issued while "
+        "the numerics sanitizer is on, labeled by step site "
+        "(serving.mixed_step / serving.decode_burst / mesh.train_step) — "
+        "one compiled reduction and ONE host bool per check."),
     "paddle_tpu_monitor_fault_injections_total": (
         "counter", ("point",),
         "Fault-injection trips (analysis/faultinject.py, "
@@ -507,6 +513,11 @@ SPANS = {
         "host-sync-in-span / data race), recorded at raise time so the "
         "flight dump shows WHERE in the request/step timeline the hazard "
         "fired. attrs: sanitizer."),
+    "monitor.numsan_trip": (
+        "One numsan numerics trip: a registered step-boundary region "
+        "held a non-finite value; recorded at raise time with the "
+        "bisection result so the flight dump names the step AND the "
+        "first non-finite region. attrs: site, step, region."),
     "monitor.fault_injection": (
         "One fault-injection trip (analysis/faultinject.py), recorded "
         "at fire time so a chaos run's trace shows where the drill hit. "

@@ -164,19 +164,21 @@ def _cached_op_fns(opdef, treedef, n_leaves, static_items, t_idx, stop_flags,
     return pure, bwd
 
 
-def _check_nan_inf(name, vals):
-    from ..amp.debugging import _op_filter
+_NAN_INF_HOOK = [None]  # lazily bound to amp.debugging._scan_op_outputs
 
-    if not _op_filter(name):
-        return
-    for v in vals:
-        if hasattr(v, "dtype") and jnp.issubdtype(np.dtype(v.dtype), jnp.inexact):
-            bad = bool(jnp.any(~jnp.isfinite(v)))  # graftlint: disable=GL002 — flag-gated debug scan, off the default path
-            if bad:
-                if flags.flag("check_nan_inf_level") > 0:
-                    print(f"[paddle_tpu] nan/inf detected in output of op {name}")
-                else:
-                    raise FloatingPointError(f"nan/inf detected in output of op {name}")
+
+def _scan_nan_inf(name, vals):
+    """Per-op NaN/Inf scan behind FLAGS check_nan_inf. The scan body
+    lives in amp/debugging and rides the compiled device-side finite
+    check of analysis/numerics (numsan's kernel) — one bool to host per
+    scanned output, replacing the old per-element host scan this module
+    used to carry."""
+    hook = _NAN_INF_HOOK[0]
+    if hook is None:
+        from ..amp import debugging as _dbg
+
+        hook = _NAN_INF_HOOK[0] = _dbg._scan_op_outputs
+    hook(name, vals)
 
 
 _DBG_OP_STATS = None  # lazily bound to amp.debugging._OP_STATS (hot-path guard)
@@ -199,7 +201,7 @@ def _finish_outputs(opdef, name, out_vals, requires_grad, vjp_fn, pure,
     """Shared dispatch postlude: nan scan, op stats, output Tensor wrap with
     stop_gradient propagation, tape record."""
     if flags.flag("check_nan_inf"):
-        _check_nan_inf(name, out_vals)
+        _scan_nan_inf(name, out_vals)
     _maybe_record_op_stats(name, out_vals)
 
     if tape.in_functional_mode():

@@ -5,13 +5,16 @@ Five contracts under test:
 
 1. the FLAGSHIP gate — the three live programs (serving mixed step,
    decode burst, DP=8 ZeRO-1 mesh train step) analyze clean under
-   GI001–GI004 with an EMPTY baseline, and every flagship program has a
+   GI001–GI007 with an EMPTY baseline, and every flagship program has a
    budget row in the manifest;
 2. every pass fires on its dirty traced fixture and stays silent on its
    clean one — branch-divergent psum (GI001), donated-unaliased /
    donated-read-after-alias / large-un-donated (GI002), budget
    over/under (GI003), convert churn / duplicate subexpression /
-   disagreeing shardings (GI004);
+   disagreeing shardings (GI004), fp16 accumulation / downcast-sum-widen
+   (GI005), raw-vs-stabilized softmax / eps-less rsqrt / fp16 dot
+   overflow via the abstract value-range walk (GI006), unscaled fp16
+   collective crossings and masterless committed state (GI007);
 3. the GI003 estimator is held to the LIVE program: its per-device peak
    for the DP=8 ZeRO-1 llama step lands within 15% of the compiled
    executable's own memory analysis (the ISSUE 11 acceptance bar);
@@ -311,6 +314,204 @@ class TestGI004Fusion:
         new, _ = _analyze(jax.jit(f), (jnp.ones((8, 8)),
                                        jnp.ones((8, 8))), "GI004")
         assert new == []
+
+
+class TestGI005PrecisionFlow:
+    def _dot(self, acc):
+        return lambda a, b: jax.lax.dot_general(
+            a, b, (((1,), (0,)), ((), ())), preferred_element_type=acc)
+
+    def test_fp16_dot_accumulation_fires(self):
+        a = jnp.ones((64, 2048), jnp.float16)
+        b = jnp.ones((2048, 64), jnp.float16)
+        prog = gi.trace(self._dot(jnp.float16), (a, b), "fixture.GI005")
+        new = gi.analyze_program(prog, _pass("GI005"))
+        assert len(new) == 1
+        assert "dot_general accumulates in float16" in new[0].message
+        assert "2048 contracted elements" in new[0].message
+
+    def test_fp32_accumulating_dot_is_silent(self):
+        a = jnp.ones((64, 2048), jnp.float16)
+        b = jnp.ones((2048, 64), jnp.float16)
+        prog = gi.trace(self._dot(jnp.float32), (a, b), "fixture.GI005")
+        assert gi.analyze_program(prog, _pass("GI005")) == []
+
+    def test_fp16_reduce_sum_over_large_axis_fires(self):
+        # jnp.sum upcasts fp16 internally; bind the primitive directly
+        # for a true reduced-precision accumulation
+        def f(x):
+            return jax.lax.reduce_sum_p.bind(x, axes=(1,))
+
+        prog = gi.trace(f, (jnp.ones((8, 2048), jnp.float16),),
+                        "fixture.GI005")
+        new = gi.analyze_program(prog, _pass("GI005"))
+        assert len(new) == 1
+        assert "reduce_sum accumulates in float16" in new[0].message
+
+    def test_small_axis_fp16_sum_is_silent(self):
+        def f(x):
+            return jax.lax.reduce_sum_p.bind(x, axes=(1,))
+
+        prog = gi.trace(f, (jnp.ones((8, 16), jnp.float16),),
+                        "fixture.GI005")
+        assert gi.analyze_program(prog, _pass("GI005")) == []
+
+    def test_downcast_sum_widen_fires(self):
+        """f32 -> f16 -> sum whose result flows wide again: the downcast
+        bought nothing but the accumulation error."""
+        def f(x):
+            return jnp.sum(x.astype(jnp.float16), axis=1)
+
+        prog = gi.trace(f, (jnp.ones((8, 2048), jnp.float32),),
+                        "fixture.GI005")
+        new = gi.analyze_program(prog, _pass("GI005"))
+        assert len(new) == 1
+        assert "downcast float32 -> float16 feeds a reduce_sum" \
+            in new[0].message
+
+    def test_upcast_before_sum_is_silent(self):
+        def f(x):
+            return jnp.sum(x.astype(jnp.float32), axis=1)
+
+        prog = gi.trace(f, (jnp.ones((8, 2048), jnp.float16),),
+                        "fixture.GI005")
+        assert gi.analyze_program(prog, _pass("GI005")) == []
+
+
+class TestGI006NumericHazard:
+    def _count(self, fn, args):
+        prog = gi.trace(fn, args, "fixture.GI006")
+        return gi.analyze_program(prog, _pass("GI006"))
+
+    def test_raw_softmax_fires_exp_and_div(self):
+        def raw_softmax(x):
+            e = jnp.exp(x)
+            return e / jnp.sum(e, axis=-1, keepdims=True)
+
+        new = self._count(raw_softmax, (jnp.ones((4, 128), jnp.float16),))
+        assert len(new) == 2
+        msgs = " | ".join(f.message for f in new)
+        assert "exp over values that may reach" in msgs
+        assert "div by a reduced-precision-derived denominator" in msgs
+        # f32 input: the div denominator is full-precision, only the
+        # unshifted exp remains hazardous
+        new32 = self._count(raw_softmax, (jnp.ones((4, 128), jnp.float32),))
+        assert len(new32) == 1
+        assert "exp over values that may reach" in new32[0].message
+
+    def test_stabilized_softmax_is_silent(self):
+        """jax.nn.softmax max-shifts: the range walk must see exp fed
+        values in [-inf, 0] and a denominator with a sum floor."""
+        for dt in (jnp.float32, jnp.float16):
+            assert self._count(lambda x: jax.nn.softmax(x, axis=-1),
+                               (jnp.ones((4, 128), dt),)) == []
+
+    def test_logsumexp_guard_is_silent(self):
+        assert self._count(lambda x: jax.nn.logsumexp(x, axis=-1),
+                           (jnp.ones((4, 128), jnp.float32),)) == []
+
+    def test_rsqrt_without_eps_fires_with_eps_silent(self):
+        def rms_noeps(x):
+            return x * jax.lax.rsqrt(jnp.mean(x * x, axis=-1,
+                                              keepdims=True))
+
+        def rms_eps(x):
+            return x * jax.lax.rsqrt(jnp.mean(x * x, axis=-1,
+                                              keepdims=True) + 1e-5)
+
+        x = jnp.ones((4, 64), jnp.float16)
+        new = self._count(rms_noeps, (x,))
+        assert len(new) == 1
+        assert "rsqrt over reduced-precision-derived values" \
+            in new[0].message
+        assert self._count(rms_eps, (x,)) == []
+
+    def test_log_without_eps_fires_with_eps_silent(self):
+        x = jnp.ones((4, 8), jnp.float16)
+        new = self._count(lambda v: jnp.log(jnp.sum(v * v, axis=-1)),
+                          (x,))
+        assert len(new) == 1
+        assert "log over reduced-precision-derived values" \
+            in new[0].message
+        assert self._count(
+            lambda v: jnp.log(jnp.sum(v * v, axis=-1) + 1e-6), (x,)) == []
+
+    def test_fp16_dot_output_bound_fires_only_when_it_can_overflow(self):
+        def dot16(a, b):
+            return jax.lax.dot_general(
+                a, b, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float16)
+
+        # unbounded f16 operands over K=4096: bound 65504*4096 >> 65504
+        new = self._count(dot16, (jnp.ones((8, 4096), jnp.float16),
+                                  jnp.ones((4096, 8), jnp.float16)))
+        assert len(new) == 1
+        assert "static output bound" in new[0].message
+        # softmax @ tanh: both operands in [-1, 1], bound K=64 — clean
+        def bounded(a, b):
+            return dot16(jax.nn.softmax(a, axis=-1), jnp.tanh(b))
+
+        assert self._count(bounded, (jnp.ones((8, 64), jnp.float16),
+                                     jnp.ones((64, 8), jnp.float16))) == []
+
+
+class TestGI007LossScaleCoverage:
+    def _psum(self, mesh8, fn, args, in_specs):
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        mesh = Mesh(np.array(mesh8), ("dp",))
+        sm = jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                           out_specs=P("dp"), check_rep=False)
+        return gi.trace(sm, args, "fixture.GI007")
+
+    def test_unscaled_fp16_psum_fires(self, mesh8):
+        from jax.sharding import PartitionSpec as P
+
+        prog = self._psum(mesh8, lambda t: jax.lax.psum(t, "dp"),
+                          (jnp.ones((8, 16), jnp.float16),), (P("dp"),))
+        new = gi.analyze_program(prog, _pass("GI007"))
+        assert len(new) == 1
+        assert "float16 value crosses collective all_reduce" \
+            in new[0].message
+
+    def test_scaled_fp16_psum_is_silent(self, mesh8):
+        from jax.sharding import PartitionSpec as P
+
+        def scaled(t, s):
+            return jax.lax.psum(t * s.astype(jnp.float16), "dp")
+
+        prog = self._psum(mesh8, scaled,
+                          (jnp.ones((8, 16), jnp.float16),
+                           jnp.float32(1024.0)), (P("dp"), P()))
+        assert gi.analyze_program(prog, _pass("GI007")) == []
+
+    def test_bf16_psum_is_exempt(self, mesh8):
+        from jax.sharding import PartitionSpec as P
+
+        prog = self._psum(mesh8, lambda t: jax.lax.psum(t, "dp"),
+                          (jnp.ones((8, 16), jnp.bfloat16),), (P("dp"),))
+        assert gi.analyze_program(prog, _pass("GI007")) == []
+
+    def test_fp16_state_without_master_copy_fires(self):
+        def step(p, g):
+            return p - jnp.float16(0.01) * g
+
+        prog = gi.trace(step, (jnp.ones((16,), jnp.float16),
+                               jnp.ones((16,), jnp.float16)),
+                        "fixture.GI007", donate_argnums=(0,))
+        new = gi.analyze_program(prog, _pass("GI007"))
+        assert len(new) == 1
+        assert "no fp32 master copy" in new[0].message
+
+    def test_fp16_state_from_fp32_master_is_silent(self):
+        def step(p, g):
+            return (p.astype(jnp.float32)
+                    - 0.01 * g.astype(jnp.float32)).astype(jnp.float16)
+
+        prog = gi.trace(step, (jnp.ones((16,), jnp.float16),
+                               jnp.ones((16,), jnp.float16)),
+                        "fixture.GI007", donate_argnums=(0,))
+        assert gi.analyze_program(prog, _pass("GI007")) == []
 
 
 class TestBaselineAndIsolation:

@@ -584,7 +584,8 @@ class TestCLI:
         rows = gi.static_check_rows()
         names = [r["check"] for r in rows]
         assert names == ["check_collective_consistency", "check_donation",
-                         "check_hbm_budgets", "check_opt_parity"]
+                         "check_hbm_budgets", "check_precision_flow",
+                         "check_numeric_hazards", "check_opt_parity"]
         parity = rows[-1]
         assert parity["ok"], parity["detail"]
         assert set(parity["rewrites"]) == set(gi.FLAGSHIP)

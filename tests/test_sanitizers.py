@@ -17,6 +17,12 @@ The dynamic half of the PR-4 analysis work, mirroring the static rules:
   stacks (and their held locks) in the message; lock-disciplined and
   read-only sharing stay silent; a concurrency soak (registry gauge
   removal racing an SLOTracker scan) runs clean under =race;
+- numerics sentinel (GI005–GI007's runtime twin): a clean step issues
+  ONE compiled device-side check per site with zero steady-state
+  recompiles; a NaN region (real or drilled via the ``numsan.check``
+  fault point) raises NumericsTrip naming the step and the FIRST
+  non-finite region in registration order, and the drill never mutates
+  the caller's values (bit-exact outputs);
 - trips export: metric bump + monitor.sanitizer_trip span + flight dump;
 - disabled mode: nothing installed, the concretize hook slot stays bare,
   and the instrumented dispatch path holds the same 40us forward budget
@@ -62,7 +68,7 @@ def _clean_sanitizers():
 class TestEnablePlumbing:
     def test_default_off(self):
         assert not san.enabled()
-        for k in ("lock", "recompile", "hostsync", "race"):
+        for k in ("lock", "recompile", "hostsync", "race", "numerics"):
             assert not san.enabled(k)
 
     def test_enable_subset(self):
@@ -77,8 +83,8 @@ class TestEnablePlumbing:
             "lock", "recompile")
         assert san.enabled("lock") and san.enabled("recompile")
         san.disable()
-        assert san.install_from_env(env="all") == ("lock", "recompile",
-                                                   "hostsync", "race")
+        assert san.install_from_env(env="all") == (
+            "lock", "recompile", "hostsync", "race", "numerics")
         san.disable()
         assert san.install_from_env(env="") == ()
         assert not san.enabled()
@@ -536,6 +542,129 @@ class TestRaceWitness:
             if us < 40:
                 return
         pytest.fail(f"disabled race_access {us:.2f}us exceeds 40us "
+                    "budget in 3 attempts")
+
+
+# --------------------------------------------------------------------------- #
+# numerics sentinel (numsan)
+# --------------------------------------------------------------------------- #
+
+class TestNumsan:
+    def _regions(self):
+        import jax.numpy as jnp
+
+        return (("tokens", jnp.zeros((8, 4), jnp.int32)),
+                ("kv_pools", jnp.ones((16, 32), jnp.float32)))
+
+    def test_clean_checks_count_with_zero_steady_state_recompiles(self):
+        from paddle_tpu.analysis import numerics as num
+
+        san.enable("numerics")
+        regions = self._regions()
+        san.numsan_check("serving.mixed_step", regions, step=1)
+        c0 = num.cache_size()
+        for s in range(2, 6):
+            san.numsan_check("serving.mixed_step", regions, step=s)
+        assert san.numsan_counts() == {"serving.mixed_step": 5}
+        assert num.cache_size() == c0, "steady-state check recompiled"
+        assert san.trips() == []
+
+    def test_disabled_check_issues_nothing(self):
+        assert not san.enabled("numerics")
+        san.numsan_check("serving.mixed_step", self._regions(), step=1)
+        assert san.numsan_counts() == {}
+
+    def test_nan_trips_naming_step_and_first_bad_region(self):
+        import jax.numpy as jnp
+
+        san.enable("numerics")
+        regions = (("tokens", jnp.zeros((4,), jnp.int32)),
+                   ("kv_pools", jnp.array([1.0, jnp.nan], jnp.float32)))
+        with pytest.raises(san.NumericsTrip) as ei:
+            san.numsan_check("serving.decode_burst", regions, step=7)
+        msg = str(ei.value)
+        assert "serving.decode_burst" in msg and "step 7" in msg
+        assert "first non-finite region is 'kv_pools'" in msg
+        assert ("numerics", msg) in san.trips()
+
+    def test_drill_localizes_seeded_region_and_exports(
+            self, tmp_path, monkeypatch):
+        """The numsan.check drill: an injected NaN in region
+        seed % len(regions) must surface as a NumericsTrip that names
+        THAT region, with the metric / span / flight-dump exports."""
+        from paddle_tpu.analysis import faultinject as fi
+
+        monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path))
+        monitor.enable()
+        trace.enable()
+        san.enable("numerics")
+        fi.reset()
+        fi.arm("numsan.check", action="flag", seed=1)
+        try:
+            with pytest.raises(san.NumericsTrip) as ei:
+                san.numsan_check("mesh.train_step", self._regions(),
+                                 step=3)
+        finally:
+            fi.reset()
+            trace.disable()
+        # seed=1 over 2 regions -> 'kv_pools' was poisoned
+        assert "first non-finite region is 'kv_pools'" in str(ei.value)
+        c = monitor.registry.get("paddle_tpu_monitor_sanitizer_trips_total")
+        assert c is not None and c.labels("numerics").value == 1
+        k = monitor.registry.get("paddle_tpu_monitor_numsan_checks_total")
+        assert k is not None and k.labels("mesh.train_step").value == 1
+        (sp,) = [s for s in trace.spans()
+                 if s.name == "monitor.numsan_trip"]
+        assert sp.attrs["site"] == "mesh.train_step"
+        assert sp.attrs["step"] == "3"
+        assert sp.attrs["region"] == "kv_pools"
+        dumps = glob.glob(os.path.join(str(tmp_path), "paddle_tpu_flight_"
+                                       "rank*_pid*.json"))
+        assert dumps, "flight dump not written"
+        with open(dumps[0]) as f:
+            doc = json.load(f)
+        assert doc["reason"].startswith("graftsan numerics trip:")
+        trace.reset()
+
+    def test_drill_never_mutates_caller_values(self):
+        """The poison is a NaN leaf APPENDED host-side — the engine's
+        arrays are never touched, so step outputs stay bit-exact whether
+        or not the drill fires."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.analysis import faultinject as fi
+
+        san.enable("numerics")
+        tok = jnp.arange(8, dtype=jnp.int32)
+        kv = jnp.ones((4, 4), jnp.float32)
+        tok_before = np.asarray(tok).copy()
+        kv_before = np.asarray(kv).copy()
+        fi.reset()
+        fi.arm("numsan.check", action="flag", seed=0)
+        try:
+            with pytest.raises(san.NumericsTrip) as ei:
+                san.numsan_check(
+                    "serving.mixed_step",
+                    (("tokens", tok), ("kv_pools", kv)), step=1)
+        finally:
+            fi.reset()
+        assert "first non-finite region is 'tokens'" in str(ei.value)
+        assert np.array_equal(np.asarray(tok), tok_before)
+        assert np.array_equal(np.asarray(kv), kv_before)
+
+    def test_disabled_numsan_check_overhead(self):
+        """numsan_check with the sanitizer off is one slot load — the
+        same 40us budget (retry-on-load) as every other instrument
+        site."""
+        assert not san.enabled()
+        regions = self._regions()
+        us = None
+        for _attempt in range(3):
+            us = _floor_us(lambda: san.numsan_check("ovh.step", regions),
+                           n=1000)
+            if us < 40:
+                return
+        pytest.fail(f"disabled numsan_check {us:.2f}us exceeds 40us "
                     "budget in 3 attempts")
 
 

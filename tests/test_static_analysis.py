@@ -453,13 +453,14 @@ class TestCLISurfaces:
         assert "paddle_tpu_dispatch_op_calls_total\tcounter" in p.stdout
 
     def test_run_static_checks_aggregator(self):
-        """13/13: the nine source-level rows (incl. the ISSUE 15
+        """15/15: the nine source-level rows (incl. the ISSUE 15
         check_doc_rows telemetry-doc contract, the ISSUE 17
         check_shared_state lockset row and the ISSUE 18
-        check_control_bounds actuation-bounds row) plus the four
+        check_control_bounds actuation-bounds row) plus the six
         graftir rows (one jax subprocess analyzing — and
-        graftopt-transforming — the flagship live programs). The
-        summary stamps per-row wall time as one flat map."""
+        graftopt-transforming — the flagship live programs, now incl.
+        the ISSUE 19 check_precision_flow and check_numeric_hazards
+        rows). The summary stamps per-row wall time as one flat map."""
         p = self._run_slow("tools/run_static_checks.py", "--json")
         assert p.returncode == 0, p.stdout + p.stderr
         summary = json.loads(p.stdout)
@@ -471,11 +472,51 @@ class TestCLISurfaces:
             "check_fault_points", "check_doc_rows",
             "check_control_bounds",
             "check_collective_consistency",
-            "check_donation", "check_hbm_budgets", "check_opt_parity"]
+            "check_donation", "check_hbm_budgets",
+            "check_precision_flow", "check_numeric_hazards",
+            "check_opt_parity"]
         assert all(c["ok"] for c in summary["checks"])
         assert set(summary["seconds"]) == {c["check"]
                                            for c in summary["checks"]}
         assert summary["total_seconds"] >= summary["seconds"]["graftlint"]
+
+    def test_sarif_emitter_shapes_rules_and_locations(self):
+        """sarif_report: one reporting rule per check row; a failing
+        detail with a leading path:line becomes a physical location, a
+        graftir-style ``program[where]`` finding a logical one. (The
+        emitter runs in-process on fabricated rows — the live aggregator
+        already pays its subprocess once in the 15/15 test, and the
+        --sarif flag shares main()'s exit-code contract.)"""
+        sys.path.insert(0, os.path.join(ROOT, "tools"))
+        try:
+            import run_static_checks as agg
+
+            rows = [
+                {"check": "graftlint", "ok": True, "findings": 0,
+                 "seconds": 0.1, "detail": []},
+                {"check": "check_doc_rows", "ok": False, "findings": 1,
+                 "seconds": 0.1,
+                 "detail": ["docs/observability.md:12 missing row"]},
+                {"check": "check_numeric_hazards", "ok": False,
+                 "findings": 1, "seconds": 0.1,
+                 "detail": ["serving.mixed_step[exp[4]]: exp overflow"]},
+            ]
+            doc = agg.sarif_report(rows)
+            assert doc["version"] == "2.1.0"
+            (run,) = doc["runs"]
+            rules = run["tool"]["driver"]["rules"]
+            assert [r["id"] for r in rules] == [
+                "graftlint", "check_doc_rows", "check_numeric_hazards"]
+            results = run["results"]
+            assert len(results) == 2      # only the failing rows
+            phys = results[0]["locations"][0]["physicalLocation"]
+            assert phys["artifactLocation"]["uri"] == \
+                "docs/observability.md"
+            assert phys["region"]["startLine"] == 12
+            logical = results[1]["locations"][0]["logicalLocations"][0]
+            assert logical["name"] == "serving.mixed_step"
+        finally:
+            sys.path.remove(os.path.join(ROOT, "tools"))
 
     def test_explain_prints_propagation_chain(self):
         """--explain GLxxx: one rule, every finding followed by its

@@ -38,25 +38,33 @@ One analysis pass (parse the tree once) feeds two result rows:
    ``Knob("<name>", ...)`` construction site in the tree names a
    declared knob — an unbounded actuator is a CI failure, no
    baseline);
-10.-13. the graftir rows (``check_collective_consistency`` /
-   ``check_donation`` / ``check_hbm_budgets`` / ``check_opt_parity``):
-   GI001/GI002/GI003 run strict (no baseline) over the three FLAGSHIP
-   live programs — the serving mixed step, the decode burst, and the
-   DP=8 ZeRO-1 mesh train step — and ``check_opt_parity`` additionally
-   runs the graftopt transform (``analysis/jaxpr/opt.py``) on each
-   flagship and re-analyzes the OPTIMIZED program strict under
-   GI001–GI004 (budgets included), all in ONE subprocess
+10.-15. the graftir rows (``check_collective_consistency`` /
+   ``check_donation`` / ``check_hbm_budgets`` /
+   ``check_precision_flow`` / ``check_numeric_hazards`` /
+   ``check_opt_parity``): GI001/GI002/GI003 — and the graftnum
+   precision rows, GI005/GI007 under ``check_precision_flow`` and the
+   GI006 abstract-range hazards under ``check_numeric_hazards`` — run
+   strict (no baseline) over the three FLAGSHIP live programs — the
+   serving mixed step, the decode burst, and the DP=8 ZeRO-1 mesh
+   train step — and ``check_opt_parity`` additionally runs the
+   graftopt transform (``analysis/jaxpr/opt.py``) on each flagship and
+   re-analyzes the OPTIMIZED program strict under GI001–GI007 (budgets
+   included), all in ONE subprocess
    (``python -m paddle_tpu.analysis.jaxpr --checks-json``), because the
    traced-IR checks need jax while this aggregator itself stays
    importable without it. The rows run only for THIS repo's root
    (fixture mini-trees have no live programs), and a subprocess that
-   dies contributes four failed rows, never a crash.
+   dies contributes six failed rows, never a crash.
 
 Prints one status line per check, then a machine-readable JSON summary on
-stdout (``--json`` prints ONLY the JSON). Every row carries its own
-``seconds`` and the summary stamps a ``seconds`` {check: wall-time} map
-plus ``total_seconds``, so a check-runtime regression shows up in CI
-history like any other number. Exit 0 iff every check passed.
+stdout (``--json`` prints ONLY the JSON; ``--sarif`` prints ONLY a SARIF
+2.1.0 log of the same rows, one result per failing detail line with
+file:line parsed out where present, so CI can annotate findings at
+file/program granularity — the exit-code contract is identical). Every
+row carries its own ``seconds`` and the summary stamps a ``seconds``
+{check: wall-time} map plus ``total_seconds``, so a check-runtime
+regression shows up in CI history like any other number. Exit 0 iff
+every check passed.
 """
 from __future__ import annotations
 
@@ -293,11 +301,12 @@ def control_bounds_problems(root=ROOT, project=None):
 
 
 GRAFTIR_CHECKS = ("check_collective_consistency", "check_donation",
-                  "check_hbm_budgets", "check_opt_parity")
+                  "check_hbm_budgets", "check_precision_flow",
+                  "check_numeric_hazards", "check_opt_parity")
 
 
 def graftir_rows(root=ROOT, timeout=600):
-    """The four jaxpr-level rows, produced by one
+    """The six jaxpr-level rows, produced by one
     ``python -m paddle_tpu.analysis.jaxpr --checks-json`` subprocess
     with the 8-device virtual CPU mesh provisioned up front. Foreign
     roots (fixture mini-trees) get NO rows — the flagship programs are
@@ -443,16 +452,60 @@ def run_checks(root=ROOT):
     return rows
 
 
+def sarif_report(results):
+    """SARIF 2.1.0 view of the same result rows: one reporting rule per
+    check, one result per failing detail line. A leading ``path:line``
+    in the detail becomes a physical location (file-granular CI
+    annotations); otherwise the flagship program name (graftir rows
+    spell findings ``program[where]: ...``) becomes a logical location,
+    so every result is at least program-granular."""
+    import re
+
+    rules, sarif_results = [], []
+    for res in results:
+        rules.append({"id": res["check"],
+                      "shortDescription": {"text": res["check"]}})
+        if res["ok"]:
+            continue
+        for line in res.get("detail") or [f"{res['check']} failed"]:
+            result = {"ruleId": res["check"], "level": "error",
+                      "message": {"text": line}}
+            m = re.match(r"(?P<path>[\w./-]+\.[A-Za-z]{1,4}):"
+                         r"(?P<line>\d+)", line)
+            if m:
+                result["locations"] = [{"physicalLocation": {
+                    "artifactLocation": {"uri": m.group("path")},
+                    "region": {"startLine": int(m.group("line"))},
+                }}]
+            else:
+                pm = re.match(r"(?:optimized )?(?P<prog>[\w.]+)\[", line)
+                result["locations"] = [{"logicalLocations": [{
+                    "name": pm.group("prog") if pm else res["check"],
+                    "kind": "module",
+                }]}]
+            sarif_results.append(result)
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {"name": "run_static_checks",
+                                "rules": rules}},
+            "results": sarif_results,
+        }],
+    }
+
+
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else argv
     json_only = "--json" in argv
+    sarif = "--sarif" in argv
     try:
         results = run_checks()
     except Exception as e:  # a crashed checker is a failed check
         results = [{"check": "run_static_checks", "ok": False,
                     "findings": -1, "seconds": 0.0,
                     "detail": [f"{type(e).__name__}: {e}"]}]
-    if not json_only:
+    if not json_only and not sarif:
         for res in results:
             status = "OK" if res["ok"] else f"FAIL ({res['findings']})"
             print(f"[{status:>9}] {res['check']} ({res['seconds']}s)")
@@ -467,10 +520,15 @@ def main(argv=None):
         "total_seconds": round(
             sum(r.get("seconds", 0.0) for r in results), 3),
     }
-    print(json.dumps(summary, indent=1, sort_keys=True) if json_only
-          else f"run_static_checks: "
-               f"{'OK' if summary['ok'] else 'FAILURES'} "
-               f"({len(results)} checks)")
+    if sarif:
+        print(json.dumps(sarif_report(results), indent=1,
+                         sort_keys=True))
+    elif json_only:
+        print(json.dumps(summary, indent=1, sort_keys=True))
+    else:
+        print(f"run_static_checks: "
+              f"{'OK' if summary['ok'] else 'FAILURES'} "
+              f"({len(results)} checks)")
     return 0 if summary["ok"] else 1
 
 
